@@ -1,0 +1,24 @@
+"""nornicdb_tpu — a TPU-native graph database framework.
+
+A ground-up rebuild of the capabilities of orneryd/NornicDB (a Neo4j-compatible
+graph database with GPU vector search and local LLM inference) designed
+TPU-first: the compute path is JAX/XLA/Pallas over a `jax.sharding.Mesh`;
+embedding models and the assistant SLM are jit'd XLA graphs; brute-force
+cosine scoring, top-k and k-means run as fused TPU kernels; the vector corpus
+shards across chips with per-shard top-k merged via ICI all-gather.
+
+Layer map (mirrors reference SURVEY.md §1):
+  storage/    — graph storage engines, WAL, schema      (ref: pkg/storage)
+  ops/        — TPU similarity / top-k / k-means        (ref: pkg/gpu, pkg/simd)
+  parallel/   — mesh, sharded index, collectives        (ref: clustering roadmap)
+  models/     — bge-m3 encoder, Qwen2 decoder in JAX    (ref: lib/llama, pkg/localllm)
+  embed/      — embedder interfaces + background queue  (ref: pkg/embed, embed_queue)
+  search/     — hybrid vector+BM25 search service       (ref: pkg/search)
+  cypher/     — Cypher parser + executor                (ref: pkg/cypher)
+  decay/ filter/ inference/ linkpredict/ temporal/      (ref: learning layer)
+  multidb/ auth/ server/ replication/ apoc/             (ref: protocol + ops layer)
+"""
+
+__version__ = "0.1.0"
+
+from nornicdb_tpu.db import DB, open as open_db  # noqa: E402,F401

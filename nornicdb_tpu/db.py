@@ -1,0 +1,286 @@
+"""Core DB facade (ref: /root/reference/pkg/nornicdb/db.go).
+
+`open()` assembles the storage chain, schema manager, search service, embed
+queue, decay manager and inference engine, and exposes the memory-centric API:
+Store / Recall / Remember / Link / Neighbors / Forget / Cypher
+(ref: db.go:1365-1776).
+
+Subsystems are attached progressively; the facade stays importable with only
+the storage layer present.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Any, Optional
+
+from nornicdb_tpu.errors import NotFoundError
+from nornicdb_tpu.storage import (
+    Edge,
+    Engine,
+    Node,
+    SchemaManager,
+    new_id,
+    open_storage,
+)
+
+
+@dataclass
+class Config:
+    """DB configuration (ref: pkg/config/config.go:82-420, subset)."""
+
+    async_writes: bool = True
+    flush_interval: float = 0.05
+    wal_sync: bool = False
+    auto_compact: bool = False
+    auto_compact_interval: float = 300.0
+    # embedding
+    embed_enabled: bool = True
+    embed_dimensions: int = 1024
+    embed_chunk_tokens: int = 512
+    embed_chunk_overlap: int = 50
+    embed_workers: int = 1
+    # decay
+    decay_enabled: bool = False
+    decay_interval: float = 3600.0
+    archive_threshold: float = 0.05
+    # inference (auto-TLP)
+    inference_enabled: bool = True
+    similarity_threshold: float = 0.85
+    # search
+    search_brute_force_max: int = 5000
+    feature_flags: dict[str, bool] = field(default_factory=dict)
+
+
+class DB:
+    """The core database handle (ref: nornicdb.DB db.go:434)."""
+
+    def __init__(self, data_dir: str = "", config: Optional[Config] = None):
+        self.config = config or Config()
+        self.data_dir = data_dir
+        self.storage: Engine = open_storage(
+            data_dir,
+            async_writes=self.config.async_writes,
+            flush_interval=self.config.flush_interval,
+            wal_sync=self.config.wal_sync,
+            auto_compact=self.config.auto_compact,
+            auto_compact_interval=self.config.auto_compact_interval,
+        )
+        self.schema = SchemaManager()
+        self.schema.attach(self.storage)
+        self._lock = threading.RLock()
+        self._closed = False
+        # attached lazily by subsystem setters
+        self._embedder = None
+        self._embed_worker = None
+        self._search = None
+        self._decay = None
+        self._inference = None
+        self._executor = None
+
+    # -- subsystem wiring --------------------------------------------------
+    def set_embedder(self, embedder) -> None:
+        """(ref: DB.SetEmbedder db.go:1074) — also starts the embed worker."""
+        self._embedder = embedder
+        if self.config.embed_enabled and embedder is not None:
+            from nornicdb_tpu.embed.queue import EmbedWorker, EmbedWorkerConfig
+
+            self._embed_worker = EmbedWorker(
+                self.storage,
+                embedder,
+                EmbedWorkerConfig(
+                    chunk_tokens=self.config.embed_chunk_tokens,
+                    chunk_overlap=self.config.embed_chunk_overlap,
+                    workers=self.config.embed_workers,
+                ),
+            )
+            self._embed_worker.start()
+
+    @property
+    def embedder(self):
+        return self._embedder
+
+    @property
+    def search(self):
+        if self._search is None:
+            from nornicdb_tpu.search.service import SearchService
+
+            self._search = SearchService(
+                self.storage,
+                embedder=self._embedder,
+                brute_force_max=self.config.search_brute_force_max,
+            )
+        return self._search
+
+    @property
+    def executor(self):
+        if self._executor is None:
+            from nornicdb_tpu.cypher.executor import CypherExecutor
+
+            self._executor = CypherExecutor(self.storage, schema=self.schema, db=self)
+        return self._executor
+
+    @property
+    def decay(self):
+        if self._decay is None:
+            from nornicdb_tpu.decay.decay import DecayManager
+
+            self._decay = DecayManager(
+                self.storage,
+                archive_threshold=self.config.archive_threshold,
+            )
+        return self._decay
+
+    @property
+    def inference(self):
+        if self._inference is None:
+            from nornicdb_tpu.inference.engine import InferenceEngine
+
+            self._inference = InferenceEngine(
+                self.storage,
+                similarity_fn=self._similarity_candidates,
+                similarity_threshold=self.config.similarity_threshold,
+            )
+        return self._inference
+
+    def _similarity_candidates(self, embedding, k: int = 10):
+        if self._search is None:
+            return []
+        return self._search.vector_candidates(embedding, k=k)
+
+    # -- memory-centric API (ref: db.go:1365-1776) --------------------------
+    def store(
+        self,
+        content: str,
+        *,
+        labels: Optional[list[str]] = None,
+        properties: Optional[dict[str, Any]] = None,
+        memory_type: str = "semantic",
+        node_id: Optional[str] = None,
+    ) -> Node:
+        """Store a memory node; queues it for auto-embedding (ref: Store db.go:1365)."""
+        props = dict(properties or {})
+        props.setdefault("content", content)
+        node = Node(
+            id=node_id or new_id(),
+            labels=list(labels or ["Memory"]),
+            properties=props,
+            memory_type=memory_type,
+        )
+        created = self.storage.create_node(node)
+        if self.config.embed_enabled:
+            self.storage.mark_pending_embed(created.id)
+        if self.config.inference_enabled and self._inference is not None:
+            self._inference.on_store(created)
+        return created
+
+    def recall(self, query: str, limit: int = 10) -> list[dict[str, Any]]:
+        """Hybrid search over stored memories (ref: Recall db.go)."""
+        results = self.search.search(query, limit=limit)
+        for r in results:
+            self.touch(r["id"])
+        return results
+
+    def remember(self, node_id: str) -> Node:
+        """Fetch + reinforce a memory (ref: Remember db.go)."""
+        node = self.touch(node_id)
+        if self.config.inference_enabled and self._inference is not None:
+            self._inference.on_access(node_id)
+        return node
+
+    def touch(self, node_id: str) -> Node:
+        """Record an access: bump access_count + last_accessed."""
+        try:
+            node = self.storage.get_node(node_id)
+        except NotFoundError:
+            raise
+        node.access_count += 1
+        node.last_accessed = time.time()
+        return self.storage.update_node(node)
+
+    def link(
+        self,
+        from_id: str,
+        to_id: str,
+        rel_type: str = "RELATED_TO",
+        *,
+        properties: Optional[dict[str, Any]] = None,
+        confidence: float = 1.0,
+        auto_generated: bool = False,
+    ) -> Edge:
+        """(ref: Link db.go)"""
+        edge = Edge(
+            start_node=from_id,
+            end_node=to_id,
+            type=rel_type,
+            properties=dict(properties or {}),
+            confidence=confidence,
+            auto_generated=auto_generated,
+        )
+        return self.storage.create_edge(edge)
+
+    def neighbors(self, node_id: str, depth: int = 1) -> list[Node]:
+        """BFS neighborhood (ref: Neighbors db.go)."""
+        seen = {node_id}
+        frontier = [node_id]
+        out: list[Node] = []
+        for _ in range(depth):
+            nxt: list[str] = []
+            for nid in frontier:
+                for e in self.storage.get_outgoing_edges(nid):
+                    if e.end_node not in seen:
+                        seen.add(e.end_node)
+                        nxt.append(e.end_node)
+                for e in self.storage.get_incoming_edges(nid):
+                    if e.start_node not in seen:
+                        seen.add(e.start_node)
+                        nxt.append(e.start_node)
+            out.extend(self.storage.batch_get_nodes(nxt))
+            frontier = nxt
+        return out
+
+    def forget(self, node_id: str) -> None:
+        """(ref: Forget db.go)"""
+        if self._search is not None:
+            self._search.remove_node(node_id)
+        self.storage.delete_node(node_id)
+
+    # -- Cypher ------------------------------------------------------------
+    def cypher(self, query: str, params: Optional[dict[str, Any]] = None):
+        """Execute a Cypher query (ref: ExecuteCypher db.go)."""
+        return self.executor.execute(query, params or {})
+
+    execute_cypher = cypher
+
+    # -- maintenance -------------------------------------------------------
+    def process_pending_embeddings(self, batch: int = 0) -> int:
+        """Synchronously drain the pending-embed queue (test/CLI hook)."""
+        if self._embed_worker is None:
+            return 0
+        return self._embed_worker.drain(batch)
+
+    def flush(self) -> None:
+        self.storage.flush()
+
+    def close(self) -> None:
+        if self._closed:
+            return
+        self._closed = True
+        if self._embed_worker is not None:
+            self._embed_worker.stop()
+        if self._decay is not None:
+            self._decay.stop()
+        self.storage.close()
+
+    def __enter__(self) -> "DB":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+
+def open(data_dir: str = "", config: Optional[Config] = None) -> DB:  # noqa: A001
+    """Open a database (ref: nornicdb.Open db.go:750)."""
+    return DB(data_dir, config)

@@ -1,0 +1,50 @@
+"""Framework-wide error types (ref: error values in pkg/storage/types.go)."""
+
+
+class NornicError(Exception):
+    """Base class for all framework errors."""
+
+
+class NotFoundError(NornicError):
+    """Entity (node/edge/database/index) does not exist."""
+
+
+class AlreadyExistsError(NornicError):
+    """Entity already exists (duplicate id, unique-constraint violation)."""
+
+
+class ConstraintViolationError(NornicError):
+    """Schema constraint violated."""
+
+
+class ClosedError(NornicError):
+    """Operation on a closed engine / database."""
+
+
+class CypherSyntaxError(NornicError):
+    """Cypher query failed to parse."""
+
+    def __init__(self, message: str, position: int = -1, line: int = -1):
+        super().__init__(message)
+        self.position = position
+        self.line = line
+
+
+class CypherTypeError(NornicError):
+    """Runtime type error during Cypher evaluation."""
+
+
+class AuthError(NornicError):
+    """Authentication / authorization failure."""
+
+
+class TransactionError(NornicError):
+    """Transaction lifecycle error."""
+
+
+class ReplicationError(NornicError):
+    """Replication subsystem error."""
+
+
+class WALCorruptionError(NornicError):
+    """WAL record failed CRC / magic validation."""

@@ -1,0 +1,105 @@
+"""Graph storage engines (ref: /root/reference/pkg/storage/).
+
+Engine decorator chain mirrors the reference assembly in
+pkg/nornicdb/db.go:750-914:
+
+    NamespacedEngine -> AsyncEngine -> WALEngine -> MemoryEngine (+WAL files)
+
+`open_storage("")` yields a pure in-memory chain (the reference's Open("")
+path, db.go:898-913) so tests never touch disk.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+from nornicdb_tpu.storage.async_engine import AsyncEngine
+from nornicdb_tpu.storage.namespaced import NamespacedEngine
+from nornicdb_tpu.storage.schema import (
+    INDEX_COMPOSITE,
+    INDEX_FULLTEXT,
+    INDEX_PROPERTY,
+    INDEX_RANGE,
+    INDEX_VECTOR,
+    ConstraintDef,
+    IndexDef,
+    SchemaManager,
+)
+from nornicdb_tpu.storage.types import (
+    EDGE_CREATED,
+    EDGE_DELETED,
+    EDGE_UPDATED,
+    EPISODIC,
+    NODE_CREATED,
+    NODE_DELETED,
+    NODE_UPDATED,
+    PROCEDURAL,
+    SEMANTIC,
+    Edge,
+    Engine,
+    MemoryEngine,
+    Node,
+    new_id,
+)
+from nornicdb_tpu.storage.wal import WAL, WALEngine, WALEntry
+
+__all__ = [
+    "AsyncEngine",
+    "NamespacedEngine",
+    "SchemaManager",
+    "IndexDef",
+    "ConstraintDef",
+    "INDEX_PROPERTY",
+    "INDEX_COMPOSITE",
+    "INDEX_FULLTEXT",
+    "INDEX_VECTOR",
+    "INDEX_RANGE",
+    "Edge",
+    "Engine",
+    "MemoryEngine",
+    "Node",
+    "new_id",
+    "WAL",
+    "WALEngine",
+    "WALEntry",
+    "EPISODIC",
+    "SEMANTIC",
+    "PROCEDURAL",
+    "NODE_CREATED",
+    "NODE_UPDATED",
+    "NODE_DELETED",
+    "EDGE_CREATED",
+    "EDGE_UPDATED",
+    "EDGE_DELETED",
+    "open_storage",
+]
+
+
+def open_storage(
+    data_dir: str = "",
+    *,
+    async_writes: bool = True,
+    flush_interval: float = 0.05,
+    wal_sync: bool = False,
+    auto_compact: bool = False,
+    auto_compact_interval: float = 300.0,
+) -> Engine:
+    """Assemble the storage chain (ref: pkg/nornicdb/db.go:750-914).
+
+    data_dir == "" -> in-memory only (no WAL), mirroring reference Open("").
+    """
+    base: Engine = MemoryEngine()
+    if data_dir:
+        os.makedirs(data_dir, exist_ok=True)
+        wal = WAL(os.path.join(data_dir, "wal"), sync=wal_sync)
+        wal.recover(base)
+        base = WALEngine(
+            base,
+            wal,
+            auto_compact=auto_compact,
+            auto_compact_interval=auto_compact_interval,
+        )
+    if async_writes:
+        base = AsyncEngine(base, flush_interval=flush_interval)
+    return base

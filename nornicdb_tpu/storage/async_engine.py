@@ -1,0 +1,246 @@
+"""Write-behind async engine decorator.
+
+Behavioral reference: /root/reference/pkg/storage/async_engine.go —
+mutations buffer in an in-memory overlay and flush to the base engine on a
+short interval (~50ms in the reference); reads consult the overlay first so
+the engine is read-your-writes consistent; counts combine overlay + base
+(the reference grew dedicated regression tests for that:
+async_engine_count_flush_race_test.go, async_count_bug_test.go).
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Iterable, Iterator, Optional
+
+from nornicdb_tpu.errors import AlreadyExistsError, NotFoundError
+from nornicdb_tpu.storage.types import Edge, Engine, Node
+
+_TOMBSTONE = object()
+
+
+class AsyncEngine(Engine):
+    def __init__(self, base: Engine, flush_interval: float = 0.05):
+        super().__init__()
+        self.base = base
+        self.flush_interval = flush_interval
+        self._lock = threading.RLock()
+        # overlay: id -> Node/Edge (pending upsert) or _TOMBSTONE (pending delete)
+        self._nodes: dict[str, object] = {}
+        self._edges: dict[str, object] = {}
+        self._node_is_create: set[str] = set()
+        self._edge_is_create: set[str] = set()
+        self._closed = False
+        base.on_event(self._emit)
+        self._flusher = threading.Thread(target=self._flush_loop, daemon=True)
+        self._flusher.start()
+
+    # -- flush loop --------------------------------------------------------
+    def _flush_loop(self) -> None:
+        stop = threading.Event()
+        while not self._closed:
+            stop.wait(self.flush_interval)
+            try:
+                self.flush()
+            except Exception:
+                pass
+
+    def flush(self) -> None:
+        """Drain the overlay into the base engine, preserving op order per id."""
+        with self._lock:
+            nodes = list(self._nodes.items())
+            node_creates = set(self._node_is_create)
+            edges = list(self._edges.items())
+            edge_creates = set(self._edge_is_create)
+            self._nodes.clear()
+            self._edges.clear()
+            self._node_is_create.clear()
+            self._edge_is_create.clear()
+        for nid, val in nodes:
+            try:
+                if val is _TOMBSTONE:
+                    try:
+                        self.base.delete_node(nid)
+                    except NotFoundError:
+                        pass
+                elif nid in node_creates:
+                    self.base.create_node(val)  # type: ignore[arg-type]
+                else:
+                    self.base.update_node(val)  # type: ignore[arg-type]
+            except Exception:
+                pass
+        for eid, val in edges:
+            try:
+                if val is _TOMBSTONE:
+                    try:
+                        self.base.delete_edge(eid)
+                    except NotFoundError:
+                        pass
+                elif eid in edge_creates:
+                    self.base.create_edge(val)  # type: ignore[arg-type]
+                else:
+                    self.base.update_edge(val)  # type: ignore[arg-type]
+            except Exception:
+                pass
+        self.base.flush()
+
+    # -- nodes -------------------------------------------------------------
+    def create_node(self, node: Node) -> Node:
+        with self._lock:
+            existing = self._nodes.get(node.id)
+            if existing is not None and existing is not _TOMBSTONE:
+                raise AlreadyExistsError(f"node {node.id} already exists")
+            if existing is None:
+                try:
+                    self.base.get_node(node.id)
+                    raise AlreadyExistsError(f"node {node.id} already exists")
+                except NotFoundError:
+                    pass
+            stored = node.copy()
+            self._nodes[node.id] = stored
+            self._node_is_create.add(node.id)
+        self._emit("node_created", stored.copy())
+        return stored.copy()
+
+    def get_node(self, node_id: str) -> Node:
+        with self._lock:
+            val = self._nodes.get(node_id)
+            if val is _TOMBSTONE:
+                raise NotFoundError(f"node {node_id} not found")
+            if val is not None:
+                return val.copy()  # type: ignore[union-attr]
+        return self.base.get_node(node_id)
+
+    def update_node(self, node: Node) -> Node:
+        with self._lock:
+            val = self._nodes.get(node.id)
+            if val is _TOMBSTONE:
+                raise NotFoundError(f"node {node.id} not found")
+            if val is None:
+                self.base.get_node(node.id)  # raises if absent
+            stored = node.copy()
+            was_create = node.id in self._node_is_create
+            self._nodes[node.id] = stored
+            if was_create:
+                self._node_is_create.add(node.id)
+        self._emit("node_updated", stored.copy())
+        return stored.copy()
+
+    def delete_node(self, node_id: str) -> None:
+        # Node deletion cascades to attached edges in the base engine; a
+        # tombstone overlay cannot mirror that cascade, so counts and edge
+        # reads would go stale until flush (the class of bug behind the
+        # reference's async_count_bug_test.go). Deletes are rare: flush and
+        # delete synchronously.
+        self.flush()
+        self.base.delete_node(node_id)
+
+    def get_nodes_by_label(self, label: str) -> list[Node]:
+        self.flush()
+        return self.base.get_nodes_by_label(label)
+
+    def all_nodes(self) -> Iterator[Node]:
+        self.flush()
+        return self.base.all_nodes()
+
+    # -- edges -------------------------------------------------------------
+    def create_edge(self, edge: Edge) -> Edge:
+        # Endpoint validation must see overlay nodes too.
+        self.get_node(edge.start_node)
+        self.get_node(edge.end_node)
+        with self._lock:
+            existing = self._edges.get(edge.id)
+            if existing is not None and existing is not _TOMBSTONE:
+                raise AlreadyExistsError(f"edge {edge.id} already exists")
+            stored = edge.copy()
+            self._edges[edge.id] = stored
+            self._edge_is_create.add(edge.id)
+        self._emit("edge_created", stored.copy())
+        return stored.copy()
+
+    def get_edge(self, edge_id: str) -> Edge:
+        with self._lock:
+            val = self._edges.get(edge_id)
+            if val is _TOMBSTONE:
+                raise NotFoundError(f"edge {edge_id} not found")
+            if val is not None:
+                return val.copy()  # type: ignore[union-attr]
+        return self.base.get_edge(edge_id)
+
+    def update_edge(self, edge: Edge) -> Edge:
+        with self._lock:
+            val = self._edges.get(edge.id)
+            if val is _TOMBSTONE:
+                raise NotFoundError(f"edge {edge.id} not found")
+            if val is None:
+                self.base.get_edge(edge.id)
+            stored = edge.copy()
+            self._edges[edge.id] = stored
+        self._emit("edge_updated", stored.copy())
+        return stored.copy()
+
+    def delete_edge(self, edge_id: str) -> None:
+        with self._lock:
+            val = self._edges.get(edge_id)
+            if val is _TOMBSTONE:
+                raise NotFoundError(f"edge {edge_id} not found")
+            if val is None:
+                self.base.get_edge(edge_id)
+            if edge_id in self._edge_is_create:
+                self._edges.pop(edge_id, None)
+                self._edge_is_create.discard(edge_id)
+            else:
+                self._edges[edge_id] = _TOMBSTONE
+
+    def get_edges_by_type(self, edge_type: str) -> list[Edge]:
+        self.flush()
+        return self.base.get_edges_by_type(edge_type)
+
+    def get_outgoing_edges(self, node_id: str) -> list[Edge]:
+        self.flush()
+        return self.base.get_outgoing_edges(node_id)
+
+    def get_incoming_edges(self, node_id: str) -> list[Edge]:
+        self.flush()
+        return self.base.get_incoming_edges(node_id)
+
+    def all_edges(self) -> Iterator[Edge]:
+        self.flush()
+        return self.base.all_edges()
+
+    # -- counts: overlay-aware (ref: async_count_bug_test.go) --------------
+    def node_count(self) -> int:
+        with self._lock:
+            delta = 0
+            for nid, val in self._nodes.items():
+                if val is _TOMBSTONE:
+                    delta -= 1
+                elif nid in self._node_is_create:
+                    delta += 1
+        return self.base.node_count() + delta
+
+    def edge_count(self) -> int:
+        with self._lock:
+            delta = 0
+            for eid, val in self._edges.items():
+                if val is _TOMBSTONE:
+                    delta -= 1
+                elif eid in self._edge_is_create:
+                    delta += 1
+        return self.base.edge_count() + delta
+
+    # -- pending embed -----------------------------------------------------
+    def mark_pending_embed(self, node_id: str) -> None:
+        self.flush()
+        self.base.mark_pending_embed(node_id)
+
+    def unmark_pending_embed(self, node_id: str) -> None:
+        self.base.unmark_pending_embed(node_id)
+
+    def pending_embed_ids(self, limit: int = 0) -> list[str]:
+        return self.base.pending_embed_ids(limit)
+
+    def close(self) -> None:
+        self._closed = True
+        self.flush()
+        self.base.close()

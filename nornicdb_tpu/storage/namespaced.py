@@ -1,0 +1,157 @@
+"""Namespace-prefixing engine decorator for multi-database support.
+
+Behavioral reference: /root/reference/pkg/storage/namespaced.go — IDs are
+stored as "<db>:<id>" in the shared base engine; the decorator strips/adds
+the prefix transparently so each logical database sees bare IDs
+(ref: pkg/multidb/manager.go:43, §9 of SURVEY.md).
+"""
+
+from __future__ import annotations
+
+from typing import Iterable, Iterator
+
+from nornicdb_tpu.storage.types import Edge, Engine, Node
+
+
+class NamespacedEngine(Engine):
+    def __init__(self, base: Engine, namespace: str):
+        super().__init__()
+        self.base = base
+        self.namespace = namespace
+        self._prefix = namespace + ":"
+        base.on_event(self._forward_event)
+
+    # -- prefix helpers ----------------------------------------------------
+    def _add(self, bare_id: str) -> str:
+        return self._prefix + bare_id
+
+    def _strip(self, full_id: str) -> str:
+        if full_id.startswith(self._prefix):
+            return full_id[len(self._prefix) :]
+        return full_id
+
+    def _owns(self, full_id: str) -> bool:
+        return full_id.startswith(self._prefix)
+
+    def _strip_node(self, n: Node) -> Node:
+        out = n.copy()
+        out.id = self._strip(n.id)
+        return out
+
+    def _strip_edge(self, e: Edge) -> Edge:
+        out = e.copy()
+        out.id = self._strip(e.id)
+        out.start_node = self._strip(e.start_node)
+        out.end_node = self._strip(e.end_node)
+        return out
+
+    def _forward_event(self, kind: str, entity) -> None:
+        if isinstance(entity, Node):
+            if self._owns(entity.id):
+                self._emit(kind, self._strip_node(entity))
+        elif isinstance(entity, Edge):
+            if self._owns(entity.id):
+                self._emit(kind, self._strip_edge(entity))
+
+    # -- nodes -------------------------------------------------------------
+    def create_node(self, node: Node) -> Node:
+        stored = node.copy()
+        stored.id = self._add(node.id)
+        return self._strip_node(self.base.create_node(stored))
+
+    def get_node(self, node_id: str) -> Node:
+        return self._strip_node(self.base.get_node(self._add(node_id)))
+
+    def update_node(self, node: Node) -> Node:
+        stored = node.copy()
+        stored.id = self._add(node.id)
+        return self._strip_node(self.base.update_node(stored))
+
+    def delete_node(self, node_id: str) -> None:
+        self.base.delete_node(self._add(node_id))
+
+    def get_nodes_by_label(self, label: str) -> list[Node]:
+        return [
+            self._strip_node(n)
+            for n in self.base.get_nodes_by_label(label)
+            if self._owns(n.id)
+        ]
+
+    def all_nodes(self) -> Iterator[Node]:
+        return (self._strip_node(n) for n in self.base.all_nodes() if self._owns(n.id))
+
+    def batch_get_nodes(self, ids: Iterable[str]) -> list[Node]:
+        return [
+            self._strip_node(n)
+            for n in self.base.batch_get_nodes(self._add(i) for i in ids)
+        ]
+
+    # -- edges -------------------------------------------------------------
+    def create_edge(self, edge: Edge) -> Edge:
+        stored = edge.copy()
+        stored.id = self._add(edge.id)
+        stored.start_node = self._add(edge.start_node)
+        stored.end_node = self._add(edge.end_node)
+        return self._strip_edge(self.base.create_edge(stored))
+
+    def get_edge(self, edge_id: str) -> Edge:
+        return self._strip_edge(self.base.get_edge(self._add(edge_id)))
+
+    def update_edge(self, edge: Edge) -> Edge:
+        stored = edge.copy()
+        stored.id = self._add(edge.id)
+        stored.start_node = self._add(edge.start_node)
+        stored.end_node = self._add(edge.end_node)
+        return self._strip_edge(self.base.update_edge(stored))
+
+    def delete_edge(self, edge_id: str) -> None:
+        self.base.delete_edge(self._add(edge_id))
+
+    def get_edges_by_type(self, edge_type: str) -> list[Edge]:
+        return [
+            self._strip_edge(e)
+            for e in self.base.get_edges_by_type(edge_type)
+            if self._owns(e.id)
+        ]
+
+    def get_outgoing_edges(self, node_id: str) -> list[Edge]:
+        return [
+            self._strip_edge(e) for e in self.base.get_outgoing_edges(self._add(node_id))
+        ]
+
+    def get_incoming_edges(self, node_id: str) -> list[Edge]:
+        return [
+            self._strip_edge(e) for e in self.base.get_incoming_edges(self._add(node_id))
+        ]
+
+    def all_edges(self) -> Iterator[Edge]:
+        return (self._strip_edge(e) for e in self.base.all_edges() if self._owns(e.id))
+
+    # -- counts (namespace-scoped) ----------------------------------------
+    def node_count(self) -> int:
+        return sum(1 for _ in self.all_nodes())
+
+    def edge_count(self) -> int:
+        return sum(1 for _ in self.all_edges())
+
+    # -- pending embed -----------------------------------------------------
+    def mark_pending_embed(self, node_id: str) -> None:
+        self.base.mark_pending_embed(self._add(node_id))
+
+    def unmark_pending_embed(self, node_id: str) -> None:
+        self.base.unmark_pending_embed(self._add(node_id))
+
+    def pending_embed_ids(self, limit: int = 0) -> list[str]:
+        out = [
+            self._strip(i)
+            for i in self.base.pending_embed_ids(0)
+            if self._owns(i)
+        ]
+        return out[:limit] if limit > 0 else out
+
+    def flush(self) -> None:
+        self.base.flush()
+
+    def close(self) -> None:
+        # shared base engine: owner (the DatabaseManager) closes it
+        pass

@@ -1,0 +1,212 @@
+"""Schema manager: constraints and index metadata.
+
+Behavioral reference: /root/reference/pkg/storage/schema.go:42 — unique
+constraints, property / composite / fulltext / vector / range indexes.
+Here the property index also maintains a live value->ids map used by the
+Cypher executor for index-backed lookups (the reference's Badger engine gets
+this from key-prefix scans; a TPU-host build keeps it as a hash index).
+"""
+
+from __future__ import annotations
+
+import threading
+from dataclasses import dataclass, field
+from typing import Any, Optional
+
+from nornicdb_tpu.errors import AlreadyExistsError, ConstraintViolationError, NotFoundError
+from nornicdb_tpu.storage.types import Engine, Node
+
+INDEX_PROPERTY = "property"
+INDEX_COMPOSITE = "composite"
+INDEX_FULLTEXT = "fulltext"
+INDEX_VECTOR = "vector"
+INDEX_RANGE = "range"
+
+
+@dataclass
+class IndexDef:
+    name: str
+    kind: str
+    label: str
+    properties: list[str]
+    options: dict[str, Any] = field(default_factory=dict)  # vector: dimensions, similarity
+
+
+@dataclass
+class ConstraintDef:
+    name: str
+    label: str
+    properties: list[str]
+    kind: str = "unique"
+
+
+def _freeze(v: Any) -> Any:
+    if isinstance(v, list):
+        return tuple(_freeze(x) for x in v)
+    if isinstance(v, dict):
+        return tuple(sorted((k, _freeze(x)) for k, x in v.items()))
+    return v
+
+
+class SchemaManager:
+    def __init__(self) -> None:
+        self._lock = threading.RLock()
+        self._indexes: dict[str, IndexDef] = {}
+        self._constraints: dict[str, ConstraintDef] = {}
+        # (label, props-tuple) -> frozen-value-tuple -> set of node ids
+        self._prop_maps: dict[tuple, dict[Any, set[str]]] = {}
+        # node id -> set of (map-key, value-tuple) it is indexed under,
+        # so updates can drop stale entries
+        self._node_entries: dict[str, set[tuple]] = {}
+
+    # -- index DDL ---------------------------------------------------------
+    def create_index(
+        self,
+        name: str,
+        kind: str,
+        label: str,
+        properties: list[str],
+        options: Optional[dict[str, Any]] = None,
+        if_not_exists: bool = False,
+    ) -> IndexDef:
+        with self._lock:
+            if name in self._indexes:
+                if if_not_exists:
+                    return self._indexes[name]
+                raise AlreadyExistsError(f"index {name} already exists")
+            idx = IndexDef(name, kind, label, list(properties), options or {})
+            self._indexes[name] = idx
+            if kind in (INDEX_PROPERTY, INDEX_COMPOSITE, INDEX_RANGE):
+                self._prop_maps.setdefault((label, tuple(properties)), {})
+            return idx
+
+    def drop_index(self, name: str, if_exists: bool = False) -> None:
+        with self._lock:
+            idx = self._indexes.pop(name, None)
+            if idx is None:
+                if if_exists:
+                    return
+                raise NotFoundError(f"index {name} not found")
+            key = (idx.label, tuple(idx.properties))
+            if not any(
+                (i.label, tuple(i.properties)) == key
+                for i in self._indexes.values()
+                if i.kind in (INDEX_PROPERTY, INDEX_COMPOSITE, INDEX_RANGE)
+            ):
+                self._prop_maps.pop(key, None)
+
+    def get_index(self, name: str) -> Optional[IndexDef]:
+        with self._lock:
+            return self._indexes.get(name)
+
+    def list_indexes(self) -> list[IndexDef]:
+        with self._lock:
+            return list(self._indexes.values())
+
+    def vector_indexes(self) -> list[IndexDef]:
+        return [i for i in self.list_indexes() if i.kind == INDEX_VECTOR]
+
+    def find_index(self, label: str, properties: list[str]) -> Optional[IndexDef]:
+        with self._lock:
+            for i in self._indexes.values():
+                if i.label == label and i.properties == list(properties):
+                    return i
+        return None
+
+    # -- constraints -------------------------------------------------------
+    def create_constraint(
+        self,
+        name: str,
+        label: str,
+        properties: list[str],
+        kind: str = "unique",
+        if_not_exists: bool = False,
+    ) -> ConstraintDef:
+        with self._lock:
+            if name in self._constraints:
+                if if_not_exists:
+                    return self._constraints[name]
+                raise AlreadyExistsError(f"constraint {name} already exists")
+            c = ConstraintDef(name, label, list(properties), kind)
+            self._constraints[name] = c
+            self._prop_maps.setdefault((label, tuple(properties)), {})
+            return c
+
+    def drop_constraint(self, name: str, if_exists: bool = False) -> None:
+        with self._lock:
+            if self._constraints.pop(name, None) is None and not if_exists:
+                raise NotFoundError(f"constraint {name} not found")
+
+    def list_constraints(self) -> list[ConstraintDef]:
+        with self._lock:
+            return list(self._constraints.values())
+
+    # -- maintenance (called from engine event stream) ----------------------
+    def check_unique(self, node: Node, exclude_id: Optional[str] = None) -> None:
+        """Raise ConstraintViolationError if `node` collides with an existing
+        unique-constrained value."""
+        with self._lock:
+            for c in self._constraints.values():
+                if c.kind != "unique" or c.label not in node.labels:
+                    continue
+                vals = tuple(_freeze(node.properties.get(p)) for p in c.properties)
+                if any(v is None for v in vals):
+                    continue
+                ids = self._prop_maps.get((c.label, tuple(c.properties)), {}).get(vals)
+                if ids and any(i != (exclude_id or node.id) for i in ids):
+                    raise ConstraintViolationError(
+                        f"unique constraint {c.name} violated on {c.label}"
+                        f"({', '.join(c.properties)})"
+                    )
+
+    def index_node(self, node: Node) -> None:
+        with self._lock:
+            self._unindex_locked(node.id)
+            entries = set()
+            for (label, props), valmap in self._prop_maps.items():
+                if label in node.labels:
+                    vals = tuple(_freeze(node.properties.get(p)) for p in props)
+                    if any(v is None for v in vals):
+                        continue
+                    valmap.setdefault(vals, set()).add(node.id)
+                    entries.add(((label, props), vals))
+            if entries:
+                self._node_entries[node.id] = entries
+
+    def _unindex_locked(self, node_id: str) -> None:
+        for key, vals in self._node_entries.pop(node_id, set()):
+            valmap = self._prop_maps.get(key)
+            if valmap is None:
+                continue
+            ids = valmap.get(vals)
+            if ids:
+                ids.discard(node_id)
+                if not ids:
+                    valmap.pop(vals, None)
+
+    def unindex_node(self, node: Node) -> None:
+        with self._lock:
+            self._unindex_locked(node.id)
+
+    def lookup(self, label: str, properties: list[str], values: list[Any]) -> Optional[set[str]]:
+        """Index-backed equality lookup; None when no such index exists."""
+        with self._lock:
+            valmap = self._prop_maps.get((label, tuple(properties)))
+            if valmap is None:
+                return None
+            return set(valmap.get(tuple(_freeze(v) for v in values), set()))
+
+    def attach(self, engine: Engine) -> None:
+        """Subscribe to engine events so index maps stay current."""
+
+        def _on(kind: str, entity) -> None:
+            if not isinstance(entity, Node):
+                return
+            if kind == "node_created" or kind == "node_updated":
+                self.index_node(entity)
+            elif kind == "node_deleted":
+                self.unindex_node(entity)
+
+        engine.on_event(_on)
+        for n in engine.all_nodes():
+            self.index_node(n)

@@ -1,0 +1,377 @@
+"""Storage engine tests (modeled on reference pkg/storage tests:
+memory_test.go, wal_corruption_test.go, wal_durability_test.go,
+async_engine_count_flush_race_test.go, badger_count_bug_test.go)."""
+
+import os
+import threading
+
+import numpy as np
+import pytest
+
+from nornicdb_tpu.errors import (
+    AlreadyExistsError,
+    ConstraintViolationError,
+    NotFoundError,
+)
+from nornicdb_tpu.storage import (
+    WAL,
+    AsyncEngine,
+    MemoryEngine,
+    NamespacedEngine,
+    Node,
+    Edge,
+    SchemaManager,
+    WALEngine,
+    open_storage,
+)
+
+
+# ---------------------------------------------------------------- memory
+class TestMemoryEngine:
+    def test_node_crud(self):
+        eng = MemoryEngine()
+        n = eng.create_node(Node(id="a", labels=["Person"], properties={"name": "Ada"}))
+        assert n.id == "a"
+        got = eng.get_node("a")
+        assert got.properties["name"] == "Ada"
+        got.properties["name"] = "Grace"
+        eng.update_node(got)
+        assert eng.get_node("a").properties["name"] == "Grace"
+        eng.delete_node("a")
+        with pytest.raises(NotFoundError):
+            eng.get_node("a")
+
+    def test_duplicate_create_raises(self):
+        eng = MemoryEngine()
+        eng.create_node(Node(id="a"))
+        with pytest.raises(AlreadyExistsError):
+            eng.create_node(Node(id="a"))
+
+    def test_label_index_tracks_updates(self):
+        eng = MemoryEngine()
+        eng.create_node(Node(id="a", labels=["Person"]))
+        n = eng.get_node("a")
+        n.labels = ["Robot"]
+        eng.update_node(n)
+        assert eng.get_nodes_by_label("Person") == []
+        assert [x.id for x in eng.get_nodes_by_label("Robot")] == ["a"]
+
+    def test_edges_and_degree(self):
+        eng = MemoryEngine()
+        eng.create_node(Node(id="a"))
+        eng.create_node(Node(id="b"))
+        e = eng.create_edge(Edge(id="e1", start_node="a", end_node="b", type="KNOWS"))
+        assert e.type == "KNOWS"
+        assert [x.id for x in eng.get_outgoing_edges("a")] == ["e1"]
+        assert [x.id for x in eng.get_incoming_edges("b")] == ["e1"]
+        assert eng.degree("a") == 1
+        assert eng.degree("a", "in") == 0
+        assert [x.id for x in eng.get_edges_by_type("KNOWS")] == ["e1"]
+
+    def test_edge_requires_endpoints(self):
+        eng = MemoryEngine()
+        eng.create_node(Node(id="a"))
+        with pytest.raises(NotFoundError):
+            eng.create_edge(Edge(start_node="a", end_node="missing"))
+
+    def test_delete_node_cascades_edges(self):
+        eng = MemoryEngine()
+        eng.create_node(Node(id="a"))
+        eng.create_node(Node(id="b"))
+        eng.create_edge(Edge(id="e1", start_node="a", end_node="b"))
+        eng.delete_node("b")
+        assert eng.edge_count() == 0
+        assert eng.get_outgoing_edges("a") == []
+
+    def test_events_fire(self):
+        eng = MemoryEngine()
+        events = []
+        eng.on_event(lambda kind, ent: events.append(kind))
+        eng.create_node(Node(id="a"))
+        eng.create_node(Node(id="b"))
+        eng.create_edge(Edge(id="e", start_node="a", end_node="b"))
+        eng.delete_node("a")
+        assert events == [
+            "node_created",
+            "node_created",
+            "edge_created",
+            "edge_deleted",
+            "node_deleted",
+        ]
+
+    def test_copy_isolation(self):
+        eng = MemoryEngine()
+        eng.create_node(Node(id="a", properties={"x": 1}))
+        got = eng.get_node("a")
+        got.properties["x"] = 99
+        assert eng.get_node("a").properties["x"] == 1
+
+    def test_embedding_roundtrip(self):
+        eng = MemoryEngine()
+        v = np.arange(4, dtype=np.float32)
+        eng.create_node(Node(id="a", embedding=v, named_embeddings={"alt": v * 2}))
+        got = eng.get_node("a")
+        np.testing.assert_array_equal(got.embedding, v)
+        np.testing.assert_array_equal(got.named_embeddings["alt"], v * 2)
+
+    def test_pending_embed_fifo(self):
+        eng = MemoryEngine()
+        for i in "abc":
+            eng.create_node(Node(id=i))
+            eng.mark_pending_embed(i)
+        assert eng.pending_embed_ids() == ["a", "b", "c"]
+        assert eng.pending_embed_ids(limit=2) == ["a", "b"]
+        eng.unmark_pending_embed("b")
+        assert eng.pending_embed_ids() == ["a", "c"]
+
+
+# ---------------------------------------------------------------- WAL
+class TestWAL:
+    def test_append_and_replay(self, tmp_path):
+        wal = WAL(str(tmp_path / "wal"))
+        eng = MemoryEngine()
+        weng = WALEngine(eng, wal)
+        weng.create_node(Node(id="a", properties={"k": 1}))
+        weng.create_node(Node(id="b"))
+        weng.create_edge(Edge(id="e", start_node="a", end_node="b"))
+        weng.delete_node("b")
+        wal2 = WAL(str(tmp_path / "wal"))
+        fresh = MemoryEngine()
+        n = wal2.recover(fresh)
+        assert n == 4
+        assert fresh.node_count() == 1
+        assert fresh.get_node("a").properties["k"] == 1
+
+    def test_snapshot_truncate_recover(self, tmp_path):
+        wal = WAL(str(tmp_path / "wal"))
+        eng = MemoryEngine()
+        weng = WALEngine(eng, wal)
+        for i in range(5):
+            weng.create_node(Node(id=f"n{i}"))
+        weng.compact()
+        weng.create_node(Node(id="after"))
+        wal2 = WAL(str(tmp_path / "wal"))
+        fresh = MemoryEngine()
+        wal2.recover(fresh)
+        assert fresh.node_count() == 6
+        assert fresh.get_node("after")
+
+    def test_torn_tail_is_truncated(self, tmp_path):
+        wal = WAL(str(tmp_path / "wal"))
+        eng = MemoryEngine()
+        weng = WALEngine(eng, wal)
+        weng.create_node(Node(id="good"))
+        weng.create_node(Node(id="torn"))
+        wal.close()
+        # chop bytes off the tail to simulate a crash mid-write
+        path = tmp_path / "wal" / "wal.log"
+        raw = path.read_bytes()
+        path.write_bytes(raw[:-12])  # > max padding (7), so the footer is torn
+        wal2 = WAL(str(tmp_path / "wal"))
+        fresh = MemoryEngine()
+        wal2.recover(fresh)
+        assert fresh.node_count() == 1
+        assert fresh.get_node("good")
+
+    def test_corrupt_payload_stops_replay(self, tmp_path):
+        wal = WAL(str(tmp_path / "wal"))
+        eng = MemoryEngine()
+        weng = WALEngine(eng, wal)
+        weng.create_node(Node(id="a"))
+        weng.create_node(Node(id="b"))
+        wal.close()
+        path = tmp_path / "wal" / "wal.log"
+        raw = bytearray(path.read_bytes())
+        # flip a byte inside the second record's payload
+        raw[len(raw) // 2 + 10] ^= 0xFF
+        path.write_bytes(bytes(raw))
+        wal2 = WAL(str(tmp_path / "wal"))
+        fresh = MemoryEngine()
+        wal2.recover(fresh)
+        assert fresh.node_count() <= 1
+
+    def test_incomplete_transaction_undone(self, tmp_path):
+        wal = WAL(str(tmp_path / "wal"))
+        eng = MemoryEngine()
+        weng = WALEngine(eng, wal)
+        weng.create_node(Node(id="outside"))
+        weng.tx_begin("tx1")
+        weng.create_node(Node(id="in-tx"))
+        # crash before commit: recovery must drop the tx ops
+        wal2 = WAL(str(tmp_path / "wal"))
+        fresh = MemoryEngine()
+        wal2.recover(fresh)
+        assert fresh.node_count() == 1
+        assert fresh.get_node("outside")
+        with pytest.raises(NotFoundError):
+            fresh.get_node("in-tx")
+
+    def test_committed_transaction_replayed(self, tmp_path):
+        wal = WAL(str(tmp_path / "wal"))
+        weng = WALEngine(MemoryEngine(), wal)
+        weng.tx_begin("tx1")
+        weng.create_node(Node(id="a"))
+        weng.tx_commit("tx1")
+        wal2 = WAL(str(tmp_path / "wal"))
+        fresh = MemoryEngine()
+        wal2.recover(fresh)
+        assert fresh.get_node("a")
+
+
+# ---------------------------------------------------------------- async
+class TestAsyncEngine:
+    def test_read_your_writes(self):
+        eng = AsyncEngine(MemoryEngine(), flush_interval=10)  # no auto flush
+        eng.create_node(Node(id="a", properties={"v": 1}))
+        assert eng.get_node("a").properties["v"] == 1
+        eng.delete_node("a")
+        with pytest.raises(NotFoundError):
+            eng.get_node("a")
+        eng.close()
+
+    def test_count_includes_unflushed(self):
+        base = MemoryEngine()
+        eng = AsyncEngine(base, flush_interval=10)
+        for i in range(5):
+            eng.create_node(Node(id=f"n{i}"))
+        assert eng.node_count() == 5  # overlay-aware (ref async_count_bug_test)
+        eng.flush()
+        assert base.node_count() == 5
+        assert eng.node_count() == 5
+        eng.close()
+
+    def test_create_delete_before_flush_cancels(self):
+        base = MemoryEngine()
+        eng = AsyncEngine(base, flush_interval=10)
+        eng.create_node(Node(id="x"))
+        eng.delete_node("x")
+        eng.flush()
+        assert base.node_count() == 0
+        assert eng.node_count() == 0
+        eng.close()
+
+    def test_concurrent_create_and_count(self):
+        # ref: async_engine_count_flush_race_test.go
+        eng = AsyncEngine(MemoryEngine(), flush_interval=0.001)
+        errs = []
+
+        def writer(start):
+            try:
+                for i in range(50):
+                    eng.create_node(Node(id=f"w{start}-{i}"))
+            except Exception as e:  # pragma: no cover
+                errs.append(e)
+
+        threads = [threading.Thread(target=writer, args=(t,)) for t in range(4)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        eng.flush()
+        assert not errs
+        assert eng.node_count() == 200
+        eng.close()
+
+    def test_edge_across_overlay_nodes(self):
+        eng = AsyncEngine(MemoryEngine(), flush_interval=10)
+        eng.create_node(Node(id="a"))
+        eng.create_node(Node(id="b"))
+        eng.create_edge(Edge(id="e", start_node="a", end_node="b"))
+        eng.flush()
+        assert eng.get_edge("e").start_node == "a"
+        eng.close()
+
+
+# ---------------------------------------------------------------- namespaced
+class TestNamespacedEngine:
+    def test_isolation_between_namespaces(self):
+        base = MemoryEngine()
+        db1 = NamespacedEngine(base, "db1")
+        db2 = NamespacedEngine(base, "db2")
+        db1.create_node(Node(id="a", labels=["X"]))
+        db2.create_node(Node(id="a", labels=["X"]))  # same bare id, no clash
+        assert db1.get_node("a").id == "a"
+        assert db1.node_count() == 1
+        assert db2.node_count() == 1
+        assert base.node_count() == 2
+        assert {n.id for n in base.all_nodes()} == {"db1:a", "db2:a"}
+        assert [n.id for n in db1.get_nodes_by_label("X")] == ["a"]
+
+    def test_edges_prefixed(self):
+        base = MemoryEngine()
+        db1 = NamespacedEngine(base, "db1")
+        db1.create_node(Node(id="a"))
+        db1.create_node(Node(id="b"))
+        db1.create_edge(Edge(id="e", start_node="a", end_node="b"))
+        e = db1.get_edge("e")
+        assert (e.start_node, e.end_node) == ("a", "b")
+        assert base.get_edge("db1:e").start_node == "db1:a"
+
+    def test_events_scoped_and_stripped(self):
+        base = MemoryEngine()
+        db1 = NamespacedEngine(base, "db1")
+        db2 = NamespacedEngine(base, "db2")
+        seen1, seen2 = [], []
+        db1.on_event(lambda k, e: seen1.append(e.id))
+        db2.on_event(lambda k, e: seen2.append(e.id))
+        db1.create_node(Node(id="a"))
+        assert seen1 == ["a"]
+        assert seen2 == []
+
+
+# ---------------------------------------------------------------- schema
+class TestSchema:
+    def test_unique_constraint(self):
+        eng = MemoryEngine()
+        schema = SchemaManager()
+        schema.attach(eng)
+        schema.create_constraint("uq_email", "Person", ["email"])
+        n1 = Node(id="a", labels=["Person"], properties={"email": "x@y.z"})
+        schema.check_unique(n1)
+        eng.create_node(n1)
+        dup = Node(id="b", labels=["Person"], properties={"email": "x@y.z"})
+        with pytest.raises(ConstraintViolationError):
+            schema.check_unique(dup)
+
+    def test_property_index_lookup(self):
+        eng = MemoryEngine()
+        schema = SchemaManager()
+        schema.attach(eng)
+        schema.create_index("idx_name", "property", "Person", ["name"])
+        eng.create_node(Node(id="a", labels=["Person"], properties={"name": "Ada"}))
+        eng.create_node(Node(id="b", labels=["Person"], properties={"name": "Bob"}))
+        assert schema.lookup("Person", ["name"], ["Ada"]) == {"a"}
+        # update moves index entry
+        n = eng.get_node("a")
+        n.properties["name"] = "Ada2"
+        eng.update_node(n)
+        assert schema.lookup("Person", ["name"], ["Ada"]) == set()
+        assert schema.lookup("Person", ["name"], ["Ada2"]) == {"a"}
+        eng.delete_node("b")
+        assert schema.lookup("Person", ["name"], ["Bob"]) == set()
+
+    def test_no_index_returns_none(self):
+        schema = SchemaManager()
+        assert schema.lookup("Person", ["name"], ["Ada"]) is None
+
+
+# ---------------------------------------------------------------- full chain
+class TestOpenStorage:
+    def test_memory_chain(self):
+        eng = open_storage("")
+        eng.create_node(Node(id="a"))
+        assert eng.node_count() == 1
+        eng.close()
+
+    def test_durable_chain_survives_reopen(self, tmp_path):
+        d = str(tmp_path / "data")
+        eng = open_storage(d)
+        eng.create_node(Node(id="a", properties={"v": 42}))
+        eng.create_node(Node(id="b"))
+        eng.create_edge(Edge(id="e", start_node="a", end_node="b"))
+        eng.close()
+        eng2 = open_storage(d)
+        assert eng2.node_count() == 2
+        assert eng2.get_node("a").properties["v"] == 42
+        assert eng2.get_edge("e").end_node == "b"
+        eng2.close()
