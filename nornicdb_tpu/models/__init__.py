@@ -1,0 +1,18 @@
+"""TPU model zoo: bge-m3 encoder (embeddings) + Qwen2 decoder (assistant).
+
+Replaces the reference's llama.cpp stack (lib/llama, pkg/localllm) — see
+SURVEY.md §2.2 row 9.
+"""
+
+from nornicdb_tpu.models import bge_m3, qwen2, training, weights
+from nornicdb_tpu.models.tokenizer import HashTokenizer, HFTokenizer, load_tokenizer
+
+__all__ = [
+    "bge_m3",
+    "qwen2",
+    "training",
+    "weights",
+    "HashTokenizer",
+    "HFTokenizer",
+    "load_tokenizer",
+]

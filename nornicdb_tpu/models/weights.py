@@ -1,0 +1,143 @@
+"""Model weight I/O: minimal safetensors reader/writer (no external deps).
+
+Replaces the reference's GGUF loading path (pkg/localllm/llama.go mmap load,
+scripts/build-llama.sh) — TPU models load from safetensors checkpoints.
+
+safetensors layout: [8-byte LE header length][JSON header][raw tensor bytes].
+"""
+
+from __future__ import annotations
+
+import json
+import struct
+from typing import Any
+
+import jax
+import numpy as np
+
+_DTYPES = {
+    "F64": np.float64,
+    "F32": np.float32,
+    "F16": np.float16,
+    "BF16": None,  # handled specially
+    "I64": np.int64,
+    "I32": np.int32,
+    "I16": np.int16,
+    "I8": np.int8,
+    "U8": np.uint8,
+    "BOOL": np.bool_,
+}
+
+
+def _bf16_to_f32(raw: bytes, shape) -> np.ndarray:
+    u16 = np.frombuffer(raw, dtype=np.uint16)
+    u32 = u16.astype(np.uint32) << 16
+    return u32.view(np.float32).reshape(shape)
+
+
+def _f32_to_bf16_bytes(arr: np.ndarray) -> bytes:
+    u32 = np.ascontiguousarray(arr, dtype=np.float32).view(np.uint32)
+    return ((u32 + 0x8000) >> 16).astype(np.uint16).tobytes()
+
+
+def load_safetensors(path: str) -> dict[str, np.ndarray]:
+    with open(path, "rb") as f:
+        (hlen,) = struct.unpack("<Q", f.read(8))
+        header = json.loads(f.read(hlen).decode("utf-8"))
+        data = f.read()
+    out: dict[str, np.ndarray] = {}
+    for name, meta in header.items():
+        if name == "__metadata__":
+            continue
+        dt, shape = meta["dtype"], meta["shape"]
+        start, end = meta["data_offsets"]
+        raw = data[start:end]
+        if dt == "BF16":
+            out[name] = _bf16_to_f32(raw, shape)
+        else:
+            out[name] = np.frombuffer(raw, dtype=_DTYPES[dt]).reshape(shape).copy()
+    return out
+
+
+def save_safetensors(path: str, tensors: dict[str, np.ndarray]) -> None:
+    header: dict[str, Any] = {}
+    blobs: list[bytes] = []
+    offset = 0
+    for name, arr in tensors.items():
+        arr = np.asarray(arr)
+        if arr.dtype.name == "bfloat16":  # ml_dtypes (jnp bf16 via np.asarray)
+            dt, blob = "BF16", _f32_to_bf16_bytes(arr.astype(np.float32))
+        elif arr.dtype == np.float64:
+            dt, blob = "F64", arr.tobytes()
+        elif arr.dtype == np.float32:
+            dt, blob = "F32", arr.tobytes()
+        elif arr.dtype == np.float16:
+            dt, blob = "F16", arr.tobytes()
+        elif arr.dtype == np.int64:
+            dt, blob = "I64", arr.tobytes()
+        elif arr.dtype == np.int32:
+            dt, blob = "I32", arr.tobytes()
+        elif arr.dtype == np.int16:
+            dt, blob = "I16", arr.tobytes()
+        elif arr.dtype == np.int8:
+            dt, blob = "I8", arr.tobytes()
+        elif arr.dtype == np.uint8:
+            dt, blob = "U8", arr.tobytes()
+        elif arr.dtype == np.bool_:
+            dt, blob = "BOOL", arr.tobytes()
+        else:
+            raise ValueError(f"unsupported dtype for safetensors: {arr.dtype} ({name})")
+        header[name] = {
+            "dtype": dt,
+            "shape": list(arr.shape),
+            "data_offsets": [offset, offset + len(blob)],
+        }
+        blobs.append(blob)
+        offset += len(blob)
+    hjson = json.dumps(header, separators=(",", ":")).encode("utf-8")
+    with open(path, "wb") as f:
+        f.write(struct.pack("<Q", len(hjson)))
+        f.write(hjson)
+        for b in blobs:
+            f.write(b)
+
+
+def flatten_params(params, prefix: str = "") -> dict[str, np.ndarray]:
+    """Pytree -> flat {"a.b.0.w": array} for checkpointing."""
+    out: dict[str, np.ndarray] = {}
+
+    def walk(node, path):
+        if isinstance(node, dict):
+            for k, v in node.items():
+                walk(v, f"{path}.{k}" if path else k)
+        elif isinstance(node, (list, tuple)):
+            for i, v in enumerate(node):
+                walk(v, f"{path}.{i}")
+        else:
+            out[path] = np.asarray(node)
+
+    walk(params, prefix)
+    return out
+
+
+def unflatten_params(flat: dict[str, np.ndarray], template) -> Any:
+    """Reshape a flat dict back onto the structure of `template`."""
+    import jax.numpy as jnp
+
+    def walk(node, path):
+        if isinstance(node, dict):
+            return {k: walk(v, f"{path}.{k}" if path else k) for k, v in node.items()}
+        if isinstance(node, (list, tuple)):
+            return [walk(v, f"{path}.{i}") for i, v in enumerate(node)]
+        arr = flat[path]
+        return jnp.asarray(arr, dtype=node.dtype).reshape(node.shape)
+
+    return walk(template, "")
+
+
+def save_params(path: str, params) -> None:
+    save_safetensors(path, flatten_params(params))
+
+
+def load_params(path: str, template):
+    return unflatten_params(load_safetensors(path), template)

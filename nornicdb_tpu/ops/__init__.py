@@ -1,0 +1,54 @@
+"""TPU compute ops: similarity, top-k, k-means, Pallas kernels.
+
+Replaces the reference's device stack (pkg/gpu CUDA/Metal/Vulkan/OpenCL,
+pkg/simd) with JAX/XLA/Pallas — see SURVEY.md §2.2.
+"""
+
+from nornicdb_tpu.ops.kmeans import (
+    KMeansResult,
+    assign_clusters,
+    kmeans_fit,
+    kmeans_pp_init,
+    lloyd,
+    nearest_clusters,
+    optimal_k,
+    pairwise_sq_dists,
+)
+from nornicdb_tpu.ops.pallas_kernels import fused_cosine_scores, fused_cosine_topk
+from nornicdb_tpu.ops.similarity import (
+    LANE,
+    DeviceCorpus,
+    HostCorpus,
+    cosine_scores,
+    cosine_topk,
+    dot_scores,
+    euclidean_scores,
+    l2_normalize,
+    merge_topk,
+    pad_to_multiple,
+    score_subset,
+)
+
+__all__ = [
+    "LANE",
+    "DeviceCorpus",
+    "HostCorpus",
+    "cosine_scores",
+    "cosine_topk",
+    "dot_scores",
+    "euclidean_scores",
+    "l2_normalize",
+    "merge_topk",
+    "pad_to_multiple",
+    "score_subset",
+    "KMeansResult",
+    "assign_clusters",
+    "kmeans_fit",
+    "kmeans_pp_init",
+    "lloyd",
+    "nearest_clusters",
+    "optimal_k",
+    "pairwise_sq_dists",
+    "fused_cosine_scores",
+    "fused_cosine_topk",
+]

@@ -1,0 +1,149 @@
+"""K-means clustering on TPU via jit'd JAX.
+
+Replaces the reference's Metal/CUDA k-means kernels
+(/root/reference/pkg/gpu/metal/kmeans_kernels_darwin.metal:
+kmeans_compute_distances :71, assign_clusters :124, accumulate/finalize
+centroids :192-226, compute_drift :259, pp_distances (k-means++) :330)
+and the host loop in pkg/gpu/kmeans.go (ClusterIndex :144, Cluster :232,
+optimalK :323, SearchWithClusters :816).
+
+TPU-first: the assign step is one (N, D) x (D, K) GEMM on the MXU; the update
+step is a segment-sum; Lloyd iterations run under lax.scan so the whole fit is
+a single XLA program (no host round-trips per iteration).
+"""
+
+from __future__ import annotations
+
+import functools
+import math
+from dataclasses import dataclass
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def optimal_k(n: int) -> int:
+    """Rule-of-thumb cluster count ≈ sqrt(n/2) (ref: optimalK kmeans.go:323)."""
+    if n <= 1:
+        return 1
+    return max(1, int(math.sqrt(n / 2)))
+
+
+@jax.jit
+def pairwise_sq_dists(x: jax.Array, c: jax.Array) -> jax.Array:
+    """(N, D), (K, D) -> (N, K) squared distances; cross term on the MXU
+    (ref: kmeans_compute_distances kmeans_kernels_darwin.metal:71)."""
+    xn = jnp.sum(x * x, axis=1, keepdims=True)
+    cn = jnp.sum(c * c, axis=1)[None, :]
+    cross = jax.lax.dot_general(
+        x, c, dimension_numbers=(((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )
+    return jnp.maximum(xn - 2.0 * cross + cn, 0.0)
+
+
+@jax.jit
+def assign_clusters(x: jax.Array, centroids: jax.Array) -> jax.Array:
+    """(ref: assign_clusters kmeans_kernels_darwin.metal:124)"""
+    return jnp.argmin(pairwise_sq_dists(x, centroids), axis=1)
+
+
+@functools.partial(jax.jit, static_argnames=("k",))
+def _update_centroids(
+    x: jax.Array, assign: jax.Array, old: jax.Array, k: int
+) -> jax.Array:
+    """Segment-sum centroid update; empty clusters keep their old centroid
+    (ref: accumulate_centroids/finalize_centroids metal kernels :192-226)."""
+    sums = jax.ops.segment_sum(x, assign, num_segments=k)
+    counts = jax.ops.segment_sum(jnp.ones((x.shape[0],), x.dtype), assign, num_segments=k)
+    fresh = sums / jnp.maximum(counts[:, None], 1.0)
+    return jnp.where(counts[:, None] > 0, fresh, old)
+
+
+@functools.partial(jax.jit, static_argnames=("k", "iters"))
+def lloyd(
+    x: jax.Array, init_centroids: jax.Array, k: int, iters: int
+) -> tuple[jax.Array, jax.Array, jax.Array]:
+    """Fixed-iteration Lloyd refinement as one lax.scan program.
+
+    Returns (centroids (K, D), assignments (N,), drift (iters,)) where drift
+    is the mean centroid movement per iteration (ref: compute_drift :259).
+    """
+
+    def step(c, _):
+        a = assign_clusters(x, c)
+        c2 = _update_centroids(x, a, c, k)
+        drift = jnp.mean(jnp.linalg.norm(c2 - c, axis=1))
+        return c2, drift
+
+    centroids, drifts = jax.lax.scan(step, init_centroids, None, length=iters)
+    return centroids, assign_clusters(x, centroids), drifts
+
+
+@functools.partial(jax.jit, static_argnames=("k",))
+def kmeans_pp_init(key: jax.Array, x: jax.Array, k: int) -> jax.Array:
+    """k-means++ seeding (ref: pp_distances kmeans_kernels_darwin.metal:330,
+    kmeans.go k-means++ init). D^2-weighted sampling, one candidate at a time,
+    expressed as a lax.scan over k-1 picks."""
+    n = x.shape[0]
+    key, sub = jax.random.split(key)
+    first = jax.random.randint(sub, (), 0, n)
+    centroids = jnp.zeros((k, x.shape[1]), x.dtype).at[0].set(x[first])
+
+    def pick(carry, i):
+        cents, best_d2, key = carry
+        # distance to the most recently added centroid
+        d2_new = jnp.sum((x - cents[i - 1][None, :]) ** 2, axis=1)
+        best_d2 = jnp.minimum(best_d2, d2_new)
+        key, sub = jax.random.split(key)
+        probs = best_d2 / jnp.maximum(jnp.sum(best_d2), 1e-12)
+        idx = jax.random.choice(sub, n, p=probs)
+        cents = cents.at[i].set(x[idx])
+        return (cents, best_d2, key), None
+
+    init_d2 = jnp.full((n,), jnp.inf, x.dtype)
+    (centroids, _, _), _ = jax.lax.scan(
+        pick, (centroids, init_d2, key), jnp.arange(1, k)
+    )
+    return centroids
+
+
+@dataclass
+class KMeansResult:
+    centroids: np.ndarray  # (K, D)
+    assignments: np.ndarray  # (N,)
+    drift: np.ndarray  # (iters,)
+    k: int
+
+
+def kmeans_fit(
+    data: np.ndarray,
+    k: int = 0,
+    iters: int = 10,
+    seed: int = 0,
+) -> KMeansResult:
+    """Full fit: k-means++ init + Lloyd (ref: ClusterIndex.Cluster kmeans.go:232)."""
+    x = jnp.asarray(np.asarray(data, np.float32))
+    n = x.shape[0]
+    if k <= 0:
+        k = optimal_k(n)
+    k = min(k, n)
+    key = jax.random.PRNGKey(seed)
+    init = kmeans_pp_init(key, x, k)
+    centroids, assign, drift = lloyd(x, init, k, iters)
+    return KMeansResult(
+        centroids=np.asarray(centroids),
+        assignments=np.asarray(assign),
+        drift=np.asarray(drift),
+        k=k,
+    )
+
+
+@functools.partial(jax.jit, static_argnames=("n_probe",))
+def nearest_clusters(query: jax.Array, centroids: jax.Array, n_probe: int) -> jax.Array:
+    """Pick the n_probe closest centroids for cluster-pruned search
+    (ref: SearchWithClusters kmeans.go:816)."""
+    d = pairwise_sq_dists(query.reshape(1, -1), centroids)[0]
+    _, idx = jax.lax.top_k(-d, n_probe)
+    return idx

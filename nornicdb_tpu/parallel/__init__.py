@@ -1,0 +1,30 @@
+"""Distribution layer: mesh, sharded vector index, ring attention.
+
+Two communication planes (SURVEY.md §5 "Distributed communication backend"):
+  - device plane: XLA collectives over ICI, expressed inside jit'd programs
+    (this package) — replaces the reference's *planned* shard layer;
+  - host plane: WAL shipping / Raft / snapshots over DCN
+    (nornicdb_tpu.replication) — mirrors pkg/replication/transport.go.
+"""
+
+from nornicdb_tpu.parallel.mesh import (
+    data_sharding,
+    local_device_count,
+    make_mesh,
+    replicated,
+)
+from nornicdb_tpu.parallel.ring_attention import (
+    make_ring_attention,
+    reference_attention,
+)
+from nornicdb_tpu.parallel.sharded_index import ShardedCorpus
+
+__all__ = [
+    "data_sharding",
+    "local_device_count",
+    "make_mesh",
+    "replicated",
+    "make_ring_attention",
+    "reference_attention",
+    "ShardedCorpus",
+]

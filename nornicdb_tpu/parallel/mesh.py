@@ -1,0 +1,54 @@
+"""Device mesh helpers.
+
+The reference's distribution layer is a planned-only sharded vector index
+(docs/architecture/clustering-roadmap.md, "Sharded ... Planned") plus a
+host-side TCP transport (pkg/replication/transport.go). The TPU-native design
+promotes the data plane to first-class XLA collectives over ICI: pick a Mesh,
+annotate shardings, let XLA insert the collectives (scaling-book recipe).
+
+Axis conventions used across the framework:
+  "data"  — shards the corpus / batch dimension (vector search, DP training)
+  "model" — shards model weights (TP)
+  "seq"   — shards the sequence dimension (ring attention / context parallel)
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+
+def make_mesh(
+    axis_shapes: Optional[dict[str, int]] = None,
+    devices: Optional[Sequence[jax.Device]] = None,
+) -> Mesh:
+    """Build a Mesh. Default: all devices on one "data" axis.
+
+    make_mesh({"data": 4, "model": 2}) lays an 8-device mesh as 4x2.
+    """
+    devs = list(devices if devices is not None else jax.devices())
+    if not axis_shapes:
+        axis_shapes = {"data": len(devs)}
+    names = tuple(axis_shapes)
+    shape = tuple(axis_shapes[n] for n in names)
+    total = int(np.prod(shape))
+    if total != len(devs):
+        raise ValueError(f"mesh shape {shape} needs {total} devices, have {len(devs)}")
+    arr = np.array(devs).reshape(shape)
+    return Mesh(arr, names)
+
+
+def data_sharding(mesh: Mesh, axis: str = "data") -> NamedSharding:
+    """Rows sharded across `axis`, features replicated."""
+    return NamedSharding(mesh, P(axis, None))
+
+
+def replicated(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, P())
+
+
+def local_device_count() -> int:
+    return len(jax.devices())

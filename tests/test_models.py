@@ -1,0 +1,201 @@
+"""Model tests: encoder/decoder forward, decode loop, weights IO, training."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from nornicdb_tpu.models import bge_m3, qwen2, training, weights
+from nornicdb_tpu.models.tokenizer import HashTokenizer
+from nornicdb_tpu.parallel import make_mesh
+
+
+@pytest.fixture(scope="module")
+def bge_params():
+    return bge_m3.init_params(bge_m3.BGE_SMALL, jax.random.PRNGKey(0))
+
+
+@pytest.fixture(scope="module")
+def qwen_params():
+    return qwen2.init_params(qwen2.QWEN_SMALL, jax.random.PRNGKey(0))
+
+
+class TestBge:
+    def test_forward_shape_and_norm(self, bge_params):
+        cfg = bge_m3.BGE_SMALL
+        ids = jnp.asarray([[0, 5, 6, 2], [0, 7, 2, 1]], jnp.int32)
+        mask = jnp.asarray([[1, 1, 1, 1], [1, 1, 1, 0]], jnp.int32)
+        emb = bge_m3.forward(bge_params, cfg, ids, mask)
+        assert emb.shape == (2, cfg.dims)
+        np.testing.assert_allclose(
+            np.linalg.norm(np.asarray(emb), axis=1), 1.0, atol=1e-5
+        )
+
+    def test_padding_invariance(self, bge_params):
+        """Extra padding must not change the embedding (mask correctness)."""
+        cfg = bge_m3.BGE_SMALL
+        ids1 = jnp.asarray([[0, 5, 6, 2]], jnp.int32)
+        mask1 = jnp.asarray([[1, 1, 1, 1]], jnp.int32)
+        ids2 = jnp.asarray([[0, 5, 6, 2, 1, 1, 1, 1]], jnp.int32)
+        mask2 = jnp.asarray([[1, 1, 1, 1, 0, 0, 0, 0]], jnp.int32)
+        e1 = np.asarray(bge_m3.forward(bge_params, cfg, ids1, mask1))
+        e2 = np.asarray(bge_m3.forward(bge_params, cfg, ids2, mask2))
+        np.testing.assert_allclose(e1, e2, atol=2e-2)
+
+    def test_deterministic(self, bge_params):
+        cfg = bge_m3.BGE_SMALL
+        ids = jnp.asarray([[0, 9, 2]], jnp.int32)
+        mask = jnp.ones_like(ids)
+        e1 = np.asarray(bge_m3.forward(bge_params, cfg, ids, mask))
+        e2 = np.asarray(bge_m3.forward(bge_params, cfg, ids, mask))
+        np.testing.assert_array_equal(e1, e2)
+
+    def test_real_config_shapes(self):
+        # param-count sanity for the full bge-m3 (~568M); init only 2 layers
+        cfg = bge_m3.BGE_M3
+        assert cfg.hidden == 1024 and cfg.layers == 24 and cfg.vocab_size == 250002
+
+
+class TestQwen:
+    def test_forward_logits(self, qwen_params):
+        cfg = qwen2.QWEN_SMALL
+        ids = jnp.asarray([[1, 2, 3, 4]], jnp.int32)
+        logits = qwen2.forward(qwen_params, cfg, ids)
+        assert logits.shape == (1, 4, cfg.vocab_size)
+        assert bool(jnp.all(jnp.isfinite(logits)))
+
+    def test_causality(self, qwen_params):
+        """Changing a future token must not change past logits."""
+        cfg = qwen2.QWEN_SMALL
+        a = jnp.asarray([[1, 2, 3, 4]], jnp.int32)
+        b = jnp.asarray([[1, 2, 3, 9]], jnp.int32)
+        la = np.asarray(qwen2.forward(qwen_params, cfg, a))
+        lb = np.asarray(qwen2.forward(qwen_params, cfg, b))
+        np.testing.assert_allclose(la[:, :3], lb[:, :3], atol=1e-4)
+        assert np.abs(la[:, 3] - lb[:, 3]).max() > 1e-3
+
+    def test_kv_cache_decode_matches_full_forward(self, qwen_params):
+        """Greedy decode with KV cache == argmax over repeated full forwards."""
+        cfg = qwen2.QWEN_SMALL
+        prompt = [1, 2, 3]
+        got = qwen2.generate(qwen_params, cfg, prompt, max_new_tokens=5)
+        # reference: repeated full forward
+        ids = list(prompt)
+        want = []
+        for _ in range(5):
+            logits = qwen2.forward(
+                qwen_params, cfg, jnp.asarray([ids], jnp.int32)
+            )
+            nxt = int(jnp.argmax(logits[0, -1]))
+            want.append(nxt)
+            ids.append(nxt)
+        assert got == want
+
+    def test_eos_stops(self, qwen_params):
+        cfg = qwen2.QWEN_SMALL
+        out = qwen2.generate(
+            qwen_params, cfg, [1, 2], max_new_tokens=8, eos_id=99999
+        )
+        assert len(out) == 8  # eos never sampled -> full length
+
+
+class TestTokenizer:
+    def test_stable_and_bounded(self):
+        tok = HashTokenizer(256)
+        a = tok.encode("hello world")
+        b = tok.encode("hello world")
+        assert a == b
+        assert all(0 <= t < 256 for t in a)
+        assert a[0] == tok.cls_id and a[-1] == tok.eos_id
+
+    def test_batch_padding(self):
+        tok = HashTokenizer(256)
+        ids, masks = tok.encode_batch(["one two three", "one"])
+        assert len(ids[0]) == len(ids[1])
+        assert masks[1][-1] == 0
+
+
+class TestWeights:
+    def test_safetensors_roundtrip(self, tmp_path):
+        tensors = {
+            "a.w": np.arange(6, dtype=np.float32).reshape(2, 3),
+            "b": np.asarray([1, 2, 3], np.int64),
+        }
+        p = str(tmp_path / "m.safetensors")
+        weights.save_safetensors(p, tensors)
+        back = weights.load_safetensors(p)
+        np.testing.assert_array_equal(back["a.w"], tensors["a.w"])
+        np.testing.assert_array_equal(back["b"], tensors["b"])
+
+    def test_params_roundtrip(self, tmp_path, qwen_params):
+        p = str(tmp_path / "qwen.safetensors")
+        weights.save_params(p, qwen_params)
+        loaded = weights.load_params(p, qwen_params)
+        for a, b in zip(jax.tree.leaves(qwen_params), jax.tree.leaves(loaded)):
+            np.testing.assert_allclose(
+                np.asarray(a, np.float32), np.asarray(b, np.float32), atol=1e-2
+            )
+
+
+class TestTraining:
+    def test_loss_decreases_single_device(self):
+        cfg = bge_m3.BGE_SMALL
+        opt = training.make_optimizer(1e-3)
+        state = training.init_train_state(cfg, opt, seed=1)
+        step = training.make_train_step(cfg, opt)
+        rng = np.random.default_rng(0)
+        batch = {
+            "ids_a": jnp.asarray(rng.integers(4, 1000, (8, 16)), jnp.int32),
+            "mask_a": jnp.ones((8, 16), jnp.int32),
+            "ids_b": jnp.asarray(rng.integers(4, 1000, (8, 16)), jnp.int32),
+            "mask_b": jnp.ones((8, 16), jnp.int32),
+        }
+        # positive pairs = same text
+        batch["ids_b"] = batch["ids_a"]
+        losses = []
+        for _ in range(5):
+            state, loss = step(state, batch)
+            losses.append(float(loss))
+        assert losses[-1] < losses[0]
+
+    def test_sharded_train_step_runs(self):
+        mesh = make_mesh({"data": 4, "model": 2})
+        cfg = bge_m3.BGE_SMALL
+        opt = training.make_optimizer(1e-3)
+        state = training.init_train_state(cfg, opt, seed=2)
+        state = training.shard_train_state(state, cfg, mesh)
+        step = training.make_sharded_train_step(cfg, opt, mesh)
+        rng = np.random.default_rng(1)
+        batch = {
+            "ids_a": jnp.asarray(rng.integers(4, 1000, (8, 16)), jnp.int32),
+            "mask_a": jnp.ones((8, 16), jnp.int32),
+            "ids_b": jnp.asarray(rng.integers(4, 1000, (8, 16)), jnp.int32),
+            "mask_b": jnp.ones((8, 16), jnp.int32),
+        }
+        batch = training.shard_batch(batch, mesh)
+        state2, loss = step(state, batch)
+        assert np.isfinite(float(loss))
+        # params keep their TP sharding after the update
+        qshard = state2.params["blocks"][0]["q"]["w"].sharding
+        assert "model" in str(qshard.spec) or qshard.is_fully_replicated is False
+
+    def test_sharded_matches_unsharded(self):
+        cfg = bge_m3.BGE_SMALL
+        opt = training.make_optimizer(1e-3)
+        mesh = make_mesh({"data": 4, "model": 2})
+        rng = np.random.default_rng(2)
+        batch = {
+            "ids_a": jnp.asarray(rng.integers(4, 1000, (8, 16)), jnp.int32),
+            "mask_a": jnp.ones((8, 16), jnp.int32),
+            "ids_b": jnp.asarray(rng.integers(4, 1000, (8, 16)), jnp.int32),
+            "mask_b": jnp.ones((8, 16), jnp.int32),
+        }
+        s1 = training.init_train_state(cfg, opt, seed=3)
+        _, loss1 = training.make_train_step(cfg, opt)(s1, batch)
+        s2 = training.init_train_state(cfg, opt, seed=3)
+        s2 = training.shard_train_state(s2, cfg, mesh)
+        _, loss2 = training.make_sharded_train_step(cfg, opt, mesh)(
+            s2, training.shard_batch(batch, mesh)
+        )
+        assert float(loss1) == pytest.approx(float(loss2), abs=2e-2)

@@ -1,0 +1,194 @@
+"""Ops tests (modeled on reference pkg/simd/simd_test.go,
+pkg/gpu/kmeans.go tests, pkg/gpu score_subset_race_test.go)."""
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from nornicdb_tpu.ops import (
+    DeviceCorpus,
+    assign_clusters,
+    cosine_scores,
+    cosine_topk,
+    euclidean_scores,
+    fused_cosine_topk,
+    kmeans_fit,
+    l2_normalize,
+    merge_topk,
+    nearest_clusters,
+    optimal_k,
+    pad_to_multiple,
+)
+
+
+def _rand(n, d, seed=0):
+    rng = np.random.default_rng(seed)
+    return rng.standard_normal((n, d)).astype(np.float32)
+
+
+class TestSimilarity:
+    def test_l2_normalize(self):
+        x = _rand(8, 16)
+        n = np.asarray(l2_normalize(jnp.asarray(x)))
+        np.testing.assert_allclose(np.linalg.norm(n, axis=1), 1.0, atol=1e-5)
+
+    def test_l2_normalize_zero_row_safe(self):
+        x = np.zeros((2, 4), np.float32)
+        n = np.asarray(l2_normalize(jnp.asarray(x)))
+        assert np.all(np.isfinite(n))
+
+    def test_cosine_scores_match_numpy(self):
+        q, c = _rand(4, 32, 1), _rand(10, 32, 2)
+        got = np.asarray(cosine_scores(jnp.asarray(q), jnp.asarray(c), use_bf16=False))
+        qn = q / np.linalg.norm(q, axis=1, keepdims=True)
+        cn = c / np.linalg.norm(c, axis=1, keepdims=True)
+        np.testing.assert_allclose(got, qn @ cn.T, atol=1e-4)
+
+    def test_cosine_topk_identity(self):
+        c = _rand(pad_to_multiple(64), 16, 3)
+        q = c[:4]
+        valid = jnp.ones(c.shape[0], bool)
+        vals, idx = cosine_topk(
+            l2_normalize(jnp.asarray(q)), l2_normalize(jnp.asarray(c)), valid, 1,
+            use_bf16=False,
+        )
+        # each query's best match is itself
+        assert list(np.asarray(idx[:, 0])) == [0, 1, 2, 3]
+        np.testing.assert_allclose(np.asarray(vals[:, 0]), 1.0, atol=1e-3)
+
+    def test_cosine_topk_masks_invalid(self):
+        c = jnp.asarray(_rand(128, 8))
+        q = l2_normalize(c[:1])
+        valid = jnp.zeros(128, bool).at[5].set(True)
+        vals, idx = cosine_topk(q, l2_normalize(c), valid, 3, use_bf16=False)
+        assert int(idx[0, 0]) == 5
+        assert not bool(jnp.isfinite(vals[0, 1]))  # only one valid row
+
+    def test_euclidean(self):
+        q, c = _rand(2, 8, 4), _rand(5, 8, 5)
+        got = np.asarray(euclidean_scores(jnp.asarray(q), jnp.asarray(c)))
+        want = ((q[:, None, :] - c[None, :, :]) ** 2).sum(-1)
+        np.testing.assert_allclose(got, want, rtol=1e-3, atol=1e-3)
+
+    def test_merge_topk(self):
+        # two shards, one query, k=2
+        vals = jnp.asarray([[[0.9, 0.1]], [[0.8, 0.7]]])  # (S=2, Q=1, k=2)
+        idx = jnp.asarray([[[0, 1]], [[100, 101]]])
+        v, i = merge_topk(vals, idx, 2)
+        assert list(np.asarray(v[0])) == pytest.approx([0.9, 0.8])
+        assert list(np.asarray(i[0])) == [0, 100]
+
+
+class TestDeviceCorpus:
+    def test_add_search(self):
+        dc = DeviceCorpus(dims=16)
+        data = _rand(50, 16, 7)
+        for i, v in enumerate(data):
+            dc.add(f"n{i}", v)
+        res = dc.search(data[17], k=3)
+        assert res[0][0][0] == "n17"
+        assert res[0][0][1] == pytest.approx(1.0, abs=1e-2)
+
+    def test_remove_then_search(self):
+        dc = DeviceCorpus(dims=8, compact_ratio=0.9)
+        data = _rand(10, 8, 8)
+        for i, v in enumerate(data):
+            dc.add(f"n{i}", v)
+        dc.remove("n3")
+        res = dc.search(data[3], k=10)
+        ids = [r[0] for r in res[0]]
+        assert "n3" not in ids
+        assert len(dc) == 9
+
+    def test_compaction(self):
+        dc = DeviceCorpus(dims=8, compact_ratio=0.2)
+        data = _rand(20, 8, 9)
+        for i, v in enumerate(data):
+            dc.add(f"n{i}", v)
+        for i in range(10):
+            dc.remove(f"n{i}")
+        assert dc._tombstones <= 1  # compaction ran (last removal may re-tombstone)
+        assert len(dc._ids) < 20  # slots were reclaimed
+        res = dc.search(data[15], k=1)
+        assert res[0][0][0] == "n15"
+
+    def test_update_in_place(self):
+        dc = DeviceCorpus(dims=4)
+        dc.add("a", np.array([1, 0, 0, 0], np.float32))
+        dc.add("a", np.array([0, 1, 0, 0], np.float32))
+        assert len(dc) == 1
+        res = dc.search(np.array([0, 1, 0, 0], np.float32), k=1)
+        assert res[0][0][1] == pytest.approx(1.0, abs=1e-3)
+
+    def test_min_similarity_filter(self):
+        dc = DeviceCorpus(dims=4)
+        dc.add("same", np.array([1, 0, 0, 0], np.float32))
+        dc.add("orth", np.array([0, 1, 0, 0], np.float32))
+        res = dc.search(np.array([1, 0, 0, 0], np.float32), k=5, min_similarity=0.5)
+        assert [r[0] for r in res[0]] == ["same"]
+
+    def test_score_subset(self):
+        dc = DeviceCorpus(dims=4)
+        dc.add("a", np.array([1, 0, 0, 0], np.float32))
+        dc.add("b", np.array([0, 1, 0, 0], np.float32))
+        pairs = dc.score_subset(
+            np.array([1, 0, 0, 0], np.float32), ["a", "missing", "b"]
+        )
+        assert [p[0] for p in pairs] == ["a", "b"]  # unknown id omitted, not shifted
+        assert pairs[0][1] == pytest.approx(1.0, abs=1e-3)
+        assert pairs[1][1] == pytest.approx(0.0, abs=1e-3)
+
+    def test_growth(self):
+        dc = DeviceCorpus(dims=4, capacity=8)
+        for i in range(300):
+            dc.add(f"n{i}", _rand(1, 4, i)[0])
+        assert len(dc) == 300
+        assert dc.capacity >= 300
+
+
+class TestKMeans:
+    def test_optimal_k(self):
+        assert optimal_k(0) == 1
+        assert optimal_k(200) == 10
+        assert optimal_k(20000) == 100
+
+    def test_clusters_separate_blobs(self):
+        rng = np.random.default_rng(0)
+        blob1 = rng.normal(0, 0.1, (50, 8)).astype(np.float32)
+        blob2 = rng.normal(5, 0.1, (50, 8)).astype(np.float32)
+        data = np.vstack([blob1, blob2])
+        res = kmeans_fit(data, k=2, iters=8)
+        a = res.assignments
+        assert len(set(a[:50])) == 1
+        assert len(set(a[50:])) == 1
+        assert a[0] != a[50]
+
+    def test_drift_decreases(self):
+        data = _rand(200, 8, 11)
+        res = kmeans_fit(data, k=5, iters=10)
+        assert res.drift[-1] <= res.drift[0] + 1e-6
+
+    def test_k_capped_at_n(self):
+        data = _rand(3, 4, 12)
+        res = kmeans_fit(data, k=10, iters=2)
+        assert res.k == 3
+
+    def test_assign_and_nearest_clusters(self):
+        data = _rand(100, 8, 13)
+        res = kmeans_fit(data, k=4, iters=5)
+        a = np.asarray(assign_clusters(jnp.asarray(data), jnp.asarray(res.centroids)))
+        np.testing.assert_array_equal(a, res.assignments)
+        probe = nearest_clusters(jnp.asarray(data[0]), jnp.asarray(res.centroids), 2)
+        assert int(probe[0]) == int(res.assignments[0])
+
+
+class TestPallasKernels:
+    def test_fused_matches_xla(self):
+        q = l2_normalize(jnp.asarray(_rand(8, 128, 20)))
+        c = jnp.asarray(_rand(512, 128, 21))
+        valid = jnp.ones(512, bool)
+        v1, i1 = fused_cosine_topk(q, c, valid, 5, tile_n=128)
+        v2, i2 = cosine_topk(q, l2_normalize(c), valid, 5, use_bf16=False)
+        np.testing.assert_array_equal(np.asarray(i1), np.asarray(i2))
+        np.testing.assert_allclose(np.asarray(v1), np.asarray(v2), atol=1e-4)
