@@ -84,6 +84,11 @@ class DB:
     def set_embedder(self, embedder) -> None:
         """(ref: DB.SetEmbedder db.go:1074) — also starts the embed worker."""
         self._embedder = embedder
+        if self._search is not None:
+            self._search.embedder = embedder
+        if self._embed_worker is not None:
+            self._embed_worker.stop()
+            self._embed_worker = None
         if self.config.embed_enabled and embedder is not None:
             from nornicdb_tpu.embed.queue import EmbedWorker, EmbedWorkerConfig
 
@@ -104,14 +109,20 @@ class DB:
 
     @property
     def search(self):
-        if self._search is None:
-            from nornicdb_tpu.search.service import SearchService
+        with self._lock:
+            if self._search is None:
+                from nornicdb_tpu.search.service import SearchService
 
-            self._search = SearchService(
-                self.storage,
-                embedder=self._embedder,
-                brute_force_max=self.config.search_brute_force_max,
-            )
+                svc = SearchService(
+                    self.storage,
+                    embedder=self._embedder,
+                    brute_force_max=self.config.search_brute_force_max,
+                )
+                # wire storage events + backfill existing nodes
+                # (ref: db.go:1020-1033, EnsureSearchIndexesBuilt db.go:1044)
+                svc.attach(self.storage)
+                svc.build_indexes()
+                self._search = svc
         return self._search
 
     @property
@@ -242,9 +253,7 @@ class DB:
         return out
 
     def forget(self, node_id: str) -> None:
-        """(ref: Forget db.go)"""
-        if self._search is not None:
-            self._search.remove_node(node_id)
+        """(ref: Forget db.go) — index removal rides the node_deleted event."""
         self.storage.delete_node(node_id)
 
     # -- Cypher ------------------------------------------------------------

@@ -1,0 +1,24 @@
+"""Embedding pipeline (ref: /root/reference/pkg/embed, pkg/nornicdb/embed_queue.go)."""
+
+from nornicdb_tpu.embed.base import CachedEmbedder, Embedder, HashEmbedder, TPUEmbedder
+from nornicdb_tpu.embed.queue import (
+    EmbedWorker,
+    EmbedWorkerConfig,
+    EmbedWorkerStats,
+    average_embeddings,
+    build_embedding_text,
+    chunk_text,
+)
+
+__all__ = [
+    "CachedEmbedder",
+    "Embedder",
+    "HashEmbedder",
+    "TPUEmbedder",
+    "EmbedWorker",
+    "EmbedWorkerConfig",
+    "EmbedWorkerStats",
+    "average_embeddings",
+    "build_embedding_text",
+    "chunk_text",
+]

@@ -1,0 +1,165 @@
+"""Embedder interfaces and implementations.
+
+Behavioral reference: /root/reference/pkg/embed/embed.go:71 (Embedder:
+Embed/EmbedBatch/Dimensions/Model), local_gguf.go (GGUF embedder with crash
+recovery), cached_embedder.go:41 (LRU by content hash).
+
+The production embedder here is TPUEmbedder (bge-m3 forward pass on TPU,
+replacing the reference's llama.cpp CGO path); HashEmbedder is the
+deterministic no-model fallback used by tests and headless deployments.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import threading
+from collections import OrderedDict
+from typing import Optional, Sequence
+
+import numpy as np
+
+
+class Embedder:
+    """(ref: embed.Embedder pkg/embed/embed.go:71)"""
+
+    def embed(self, text: str) -> np.ndarray:
+        return self.embed_batch([text])[0]
+
+    def embed_batch(self, texts: Sequence[str]) -> list[np.ndarray]:
+        raise NotImplementedError
+
+    def dimensions(self) -> int:
+        raise NotImplementedError
+
+    def model(self) -> str:
+        raise NotImplementedError
+
+
+class HashEmbedder(Embedder):
+    """Deterministic embedding from token hashes: bag-of-hashed-words vectors,
+    L2-normalized. Same text -> same vector across processes; similar word
+    sets -> high cosine. Replaces the reference's test stubs
+    (pkg/localllm/llama_stub.go) with something semantically useful."""
+
+    def __init__(self, dims: int = 256):
+        self._dims = dims
+
+    def _word_vec(self, word: str) -> np.ndarray:
+        h = hashlib.blake2s(word.lower().encode()).digest()
+        seed = int.from_bytes(h[:8], "little") % (2**32)
+        rng = np.random.default_rng(seed)
+        return rng.standard_normal(self._dims).astype(np.float32)
+
+    def embed_batch(self, texts: Sequence[str]) -> list[np.ndarray]:
+        out = []
+        for t in texts:
+            words = t.split()
+            if not words:
+                out.append(np.zeros(self._dims, np.float32))
+                continue
+            v = np.sum([self._word_vec(w) for w in words], axis=0)
+            n = np.linalg.norm(v)
+            out.append((v / n if n > 1e-12 else v).astype(np.float32))
+        return out
+
+    def dimensions(self) -> int:
+        return self._dims
+
+    def model(self) -> str:
+        return "hash-embedder"
+
+
+class TPUEmbedder(Embedder):
+    """bge-m3 architecture encoder on TPU (replaces pkg/embed/local_gguf.go +
+    pkg/localllm llama.cpp path). Batches texts through one jit'd forward."""
+
+    def __init__(
+        self,
+        cfg=None,
+        params=None,
+        tokenizer=None,
+        max_len: int = 512,
+        seed: int = 0,
+    ):
+        import jax
+
+        from nornicdb_tpu.models import bge_m3
+        from nornicdb_tpu.models.tokenizer import HashTokenizer
+
+        self.cfg = cfg if cfg is not None else bge_m3.BGE_SMALL
+        self.params = (
+            params
+            if params is not None
+            else bge_m3.init_params(self.cfg, jax.random.PRNGKey(seed))
+        )
+        self.tokenizer = tokenizer or HashTokenizer(self.cfg.vocab_size)
+        self.max_len = max_len
+        self._fwd = jax.jit(
+            lambda p, ids, mask: bge_m3.forward(p, self.cfg, ids, mask)
+        )
+        self.stats = {"embedded": 0, "batches": 0}
+
+    def embed_batch(self, texts: Sequence[str]) -> list[np.ndarray]:
+        import jax.numpy as jnp
+
+        if not texts:
+            return []
+        ids, masks = self.tokenizer.encode_batch(list(texts), max_len=self.max_len)
+        emb = self._fwd(
+            self.params, jnp.asarray(ids, jnp.int32), jnp.asarray(masks, jnp.int32)
+        )
+        self.stats["embedded"] += len(texts)
+        self.stats["batches"] += 1
+        return [np.asarray(e, np.float32) for e in emb]
+
+    def dimensions(self) -> int:
+        return self.cfg.dims
+
+    def model(self) -> str:
+        return "bge-m3-tpu"
+
+
+class CachedEmbedder(Embedder):
+    """LRU cache keyed by content hash (ref: CachedEmbedder
+    pkg/embed/cached_embedder.go:41 — the '450,000x on hits' path)."""
+
+    def __init__(self, inner: Embedder, capacity: int = 10000):
+        self.inner = inner
+        self.capacity = capacity
+        self._lock = threading.Lock()
+        self._cache: OrderedDict[str, np.ndarray] = OrderedDict()
+        self.hits = 0
+        self.misses = 0
+
+    @staticmethod
+    def _key(text: str) -> str:
+        return hashlib.sha256(text.encode()).hexdigest()
+
+    def embed_batch(self, texts: Sequence[str]) -> list[np.ndarray]:
+        out: list[Optional[np.ndarray]] = [None] * len(texts)
+        miss_idx: list[int] = []
+        with self._lock:
+            for i, t in enumerate(texts):
+                k = self._key(t)
+                if k in self._cache:
+                    self._cache.move_to_end(k)
+                    out[i] = self._cache[k]
+                    self.hits += 1
+                else:
+                    miss_idx.append(i)
+                    self.misses += 1
+        if miss_idx:
+            fresh = self.inner.embed_batch([texts[i] for i in miss_idx])
+            with self._lock:
+                for i, v in zip(miss_idx, fresh):
+                    out[i] = v
+                    self._cache[self._key(texts[i])] = v
+                    while len(self._cache) > self.capacity:
+                        self._cache.popitem(last=False)
+        return out  # type: ignore[return-value]
+
+    def dimensions(self) -> int:
+        return self.inner.dimensions()
+
+    def model(self) -> str:
+        return self.inner.model()

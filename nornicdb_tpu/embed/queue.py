@@ -1,0 +1,257 @@
+"""Background embedding worker.
+
+Behavioral reference: /root/reference/pkg/nornicdb/embed_queue.go —
+pull-based worker scanning the pending_embed index (:417 processNextBatch),
+text assembly (:779 buildEmbeddingText), chunking 512 tokens / 50 overlap
+(:856 chunkText), retry with backoff (:714 embedWithRetry), chunk-vector
+averaging (:743 averageEmbeddings), debounced k-means trigger (:257 — 30s
+quiet or >=10 embeddings).
+
+TPU-first departure: the worker drains the queue in large batches so each
+device step embeds many nodes at once (the reference embeds one node per
+iteration; batch dispatch is how TPUs reach >=10k emb/s).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Callable, Optional
+
+import numpy as np
+
+from nornicdb_tpu.embed.base import Embedder
+from nornicdb_tpu.errors import NotFoundError
+from nornicdb_tpu.storage.types import Engine, Node
+
+# Properties whose text gets embedded, in priority order
+# (ref: buildEmbeddingText embed_queue.go:779).
+TEXT_PROPERTIES = ("content", "text", "description", "title", "name", "summary")
+
+
+def build_embedding_text(node: Node) -> str:
+    parts = []
+    for key in TEXT_PROPERTIES:
+        v = node.properties.get(key)
+        if isinstance(v, str) and v.strip():
+            parts.append(v.strip())
+    if not parts:  # fall back to all string properties
+        for k in sorted(node.properties):
+            v = node.properties[k]
+            if isinstance(v, str) and v.strip():
+                parts.append(v.strip())
+    return "\n".join(parts)
+
+
+def chunk_text(text: str, chunk_tokens: int = 512, overlap: int = 50) -> list[str]:
+    """Whitespace-token chunking with overlap (ref: chunkText :856)."""
+    words = text.split()
+    if len(words) <= chunk_tokens:
+        return [text] if text.strip() else []
+    chunks = []
+    step = max(chunk_tokens - overlap, 1)
+    for start in range(0, len(words), step):
+        chunk = words[start : start + chunk_tokens]
+        chunks.append(" ".join(chunk))
+        if start + chunk_tokens >= len(words):
+            break
+    return chunks
+
+
+def average_embeddings(vectors: list[np.ndarray]) -> np.ndarray:
+    """Mean + renormalize (ref: averageEmbeddings :743)."""
+    v = np.mean(np.stack(vectors), axis=0)
+    n = np.linalg.norm(v)
+    return (v / n if n > 1e-12 else v).astype(np.float32)
+
+
+@dataclass
+class EmbedWorkerConfig:
+    """(ref: EmbedWorkerConfig embed_queue.go:58)"""
+
+    chunk_tokens: int = 512
+    chunk_overlap: int = 50
+    batch_size: int = 32
+    poll_interval: float = 0.2
+    max_retries: int = 3
+    retry_backoff: float = 0.2
+    workers: int = 1
+    # debounced clustering trigger (ref: scheduleClusteringDebounced :257)
+    cluster_quiet_period: float = 30.0
+    cluster_min_new: int = 10
+
+
+@dataclass
+class EmbedWorkerStats:
+    processed: int = 0
+    failed: int = 0
+    retries: int = 0
+    batches: int = 0
+    chunked_nodes: int = 0
+
+
+class EmbedWorker:
+    """(ref: EmbedWorker embed_queue.go:18)"""
+
+    def __init__(
+        self,
+        storage: Engine,
+        embedder: Embedder,
+        config: Optional[EmbedWorkerConfig] = None,
+        on_cluster_trigger: Optional[Callable[[], None]] = None,
+    ):
+        self.storage = storage
+        self.embedder = embedder
+        self.config = config or EmbedWorkerConfig()
+        self.stats = EmbedWorkerStats()
+        self.on_cluster_trigger = on_cluster_trigger
+        self._stop = threading.Event()
+        self._threads: list[threading.Thread] = []
+        self._since_cluster = 0
+        self._last_embed_ts = 0.0
+        self._cluster_lock = threading.Lock()
+        # claim set: ids currently being processed, so concurrent consumers
+        # (workers>1, or drain() alongside the background worker) never
+        # process the same node twice
+        self._claimed: set[str] = set()
+        self._claim_lock = threading.Lock()
+
+    # -- lifecycle ---------------------------------------------------------
+    def start(self) -> None:
+        if self._threads:
+            return
+        self._stop.clear()
+        for i in range(self.config.workers):
+            t = threading.Thread(target=self._run, name=f"embed-worker-{i}", daemon=True)
+            t.start()
+            self._threads.append(t)
+
+    def stop(self) -> None:
+        self._stop.set()
+        for t in self._threads:
+            t.join(timeout=5)
+        self._threads.clear()
+
+    @property
+    def running(self) -> bool:
+        return any(t.is_alive() for t in self._threads)
+
+    def _run(self) -> None:
+        while not self._stop.is_set():
+            n = self.process_batch()
+            if n == 0:
+                self._maybe_trigger_cluster()
+                self._stop.wait(self.config.poll_interval)
+
+    # -- core --------------------------------------------------------------
+    def drain(self, batch: int = 0) -> int:
+        """Synchronously process the whole queue (or up to `batch` nodes)."""
+        total = 0
+        while True:
+            n = self.process_batch(batch - total if batch > 0 else 0)
+            total += n
+            if n == 0 or (batch > 0 and total >= batch):
+                return total
+
+    def process_batch(self, limit: int = 0) -> int:
+        """One batched device step over pending nodes
+        (ref: processNextBatch :417, but batched)."""
+        size = self.config.batch_size if limit <= 0 else min(limit, self.config.batch_size)
+        with self._claim_lock:
+            ids = [
+                i
+                for i in self.storage.pending_embed_ids(limit=0)
+                if i not in self._claimed
+            ][:size]
+            self._claimed.update(ids)
+        if not ids:
+            return 0
+        try:
+            return self._process_claimed(ids)
+        finally:
+            with self._claim_lock:
+                self._claimed.difference_update(ids)
+
+    def _process_claimed(self, ids: list[str]) -> int:
+        # Assemble (node, chunks) pairs; nodes with no text are just unmarked.
+        jobs: list[tuple[Node, list[str]]] = []
+        for nid in ids:
+            try:
+                node = self.storage.get_node(nid)
+            except NotFoundError:
+                self.storage.unmark_pending_embed(nid)
+                continue
+            text = build_embedding_text(node)
+            chunks = chunk_text(text, self.config.chunk_tokens, self.config.chunk_overlap)
+            if not chunks:
+                self.storage.unmark_pending_embed(nid)
+                continue
+            jobs.append((node, chunks))
+        if not jobs:
+            return 0
+        # One flat batch through the embedder (all chunks of all nodes).
+        flat = [c for _, chunks in jobs for c in chunks]
+        vectors = self._embed_with_retry(flat)
+        if vectors is None:
+            # batch failed terminally: mark failures, keep pending for later
+            self.stats.failed += len(jobs)
+            return 0
+        processed = 0
+        pos = 0
+        for node, chunks in jobs:
+            vecs = vectors[pos : pos + len(chunks)]
+            pos += len(chunks)
+            emb = average_embeddings(vecs) if len(vecs) > 1 else vecs[0]
+            try:
+                # Re-read just before writing so a concurrent touch/update
+                # between our initial read and now isn't clobbered; we only
+                # overlay the embedding fields onto the fresh copy.
+                fresh = self.storage.get_node(node.id)
+                if len(vecs) > 1:
+                    self.stats.chunked_nodes += 1
+                    fresh.chunk_embeddings = [np.asarray(v, np.float32) for v in vecs]
+                fresh.embedding = np.asarray(emb, np.float32)
+                self.storage.update_node(fresh)
+                self.storage.unmark_pending_embed(node.id)
+                processed += 1
+            except NotFoundError:
+                self.storage.unmark_pending_embed(node.id)
+        self.stats.processed += processed
+        self.stats.batches += 1
+        with self._cluster_lock:
+            self._since_cluster += processed
+            self._last_embed_ts = time.time()
+        return processed
+
+    def _embed_with_retry(self, texts: list[str]) -> Optional[list[np.ndarray]]:
+        """(ref: embedWithRetry :714; crash recovery local_gguf.go:202)"""
+        delay = self.config.retry_backoff
+        for attempt in range(self.config.max_retries):
+            try:
+                return self.embedder.embed_batch(texts)
+            except Exception:
+                self.stats.retries += 1
+                if attempt == self.config.max_retries - 1:
+                    return None
+                time.sleep(delay)
+                delay *= 2
+        return None
+
+    def _maybe_trigger_cluster(self) -> None:
+        """Debounce: fire when >= cluster_min_new embeddings have settled for
+        cluster_quiet_period (ref: scheduleClusteringDebounced :257)."""
+        if self.on_cluster_trigger is None:
+            return
+        with self._cluster_lock:
+            if (
+                self._since_cluster >= self.config.cluster_min_new
+                and time.time() - self._last_embed_ts >= self.config.cluster_quiet_period
+            ):
+                self._since_cluster = 0
+            else:
+                return
+        try:
+            self.on_cluster_trigger()
+        except Exception:
+            pass

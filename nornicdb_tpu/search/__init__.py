@@ -1,0 +1,18 @@
+"""Hybrid search (ref: /root/reference/pkg/search/)."""
+
+from nornicdb_tpu.search.bm25 import BM25Index, tokenize
+from nornicdb_tpu.search.fusion import adaptive_rrf_weights, apply_mmr, fuse_rrf
+from nornicdb_tpu.search.hnsw import HNSWIndex
+from nornicdb_tpu.search.service import SearchConfig, SearchService, SearchStats
+
+__all__ = [
+    "BM25Index",
+    "tokenize",
+    "adaptive_rrf_weights",
+    "apply_mmr",
+    "fuse_rrf",
+    "HNSWIndex",
+    "SearchConfig",
+    "SearchService",
+    "SearchStats",
+]

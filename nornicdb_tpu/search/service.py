@@ -1,0 +1,216 @@
+"""Hybrid search service: TPU vector search + BM25 + RRF fusion + MMR.
+
+Behavioral reference: /root/reference/pkg/search/search.go —
+Service :236, Search :851, rrfHybridSearch :890, VectorSearchCandidates
+:1005, index maintenance :1187-1301; vector_pipeline.go (candidate
+generation policy).
+
+TPU-first departure from the reference's pipeline policy (vector_pipeline.go
+:22-28 — brute force only when N<5000, else HNSW): here the device-resident
+brute-force corpus is the PRIMARY path at every N (exact scores, batched
+GEMM; approx_max_k membership), and HNSW is the no-accelerator fallback.
+"""
+
+from __future__ import annotations
+
+import threading
+from dataclasses import dataclass, field
+from typing import Any, Optional
+
+import numpy as np
+
+from nornicdb_tpu.embed.base import Embedder
+from nornicdb_tpu.embed.queue import build_embedding_text
+from nornicdb_tpu.errors import NotFoundError
+from nornicdb_tpu.ops.similarity import DeviceCorpus
+from nornicdb_tpu.search.bm25 import BM25Index
+from nornicdb_tpu.search.fusion import adaptive_rrf_weights, apply_mmr, fuse_rrf
+from nornicdb_tpu.search.hnsw import HNSWIndex
+from nornicdb_tpu.storage.types import Engine, Node
+
+
+@dataclass
+class SearchStats:
+    indexed: int = 0
+    removed: int = 0
+    searches: int = 0
+    vector_candidates: int = 0
+    fulltext_candidates: int = 0
+
+
+@dataclass
+class SearchConfig:
+    min_similarity: float = 0.0
+    rrf_k: float = 60.0
+    mmr_enabled: bool = False
+    mmr_lambda: float = 0.7
+    candidates_multiplier: int = 4  # fetch k*mult candidates per modality
+    backend: str = "auto"  # auto | tpu | hnsw
+
+
+class SearchService:
+    """(ref: search.Service pkg/search/search.go:236)"""
+
+    def __init__(
+        self,
+        storage: Engine,
+        embedder: Optional[Embedder] = None,
+        dims: int = 0,
+        config: Optional[SearchConfig] = None,
+        brute_force_max: int = 0,  # kept for reference parity; unused on TPU
+    ):
+        self.storage = storage
+        self.embedder = embedder
+        self.config = config or SearchConfig()
+        self.stats = SearchStats()
+        self._lock = threading.RLock()
+        self._dims = dims or (embedder.dimensions() if embedder else 0)
+        self._corpus: Optional[DeviceCorpus] = None
+        self._hnsw: Optional[HNSWIndex] = None
+        self._bm25 = BM25Index()
+        self._vectors: dict[str, np.ndarray] = {}  # normalized, for MMR
+        # id -> (text, embedding-bytes-hash): lets no-op updates (e.g. the
+        # access-count touch recall() performs per result) skip re-indexing,
+        # which would otherwise dirty the device corpus and force a full H2D
+        # re-upload per search
+        self._fingerprints: dict[str, tuple[str, int]] = {}
+
+    # -- index plumbing ----------------------------------------------------
+    def _ensure_vector_index(self, dims: int) -> None:
+        if self._corpus is None and self._hnsw is None:
+            self._dims = dims
+            if self.config.backend in ("auto", "tpu"):
+                self._corpus = DeviceCorpus(dims=dims)
+            else:
+                self._hnsw = HNSWIndex(dims=dims)
+
+    def index_node(self, node: Node) -> None:
+        """(ref: IndexNode search.go:651; event wiring db.go:1020-1033)"""
+        text = build_embedding_text(node)
+        emb_hash = (
+            hash(np.asarray(node.embedding, np.float32).tobytes())
+            if node.embedding is not None
+            else 0
+        )
+        with self._lock:
+            if self._fingerprints.get(node.id) == (text, emb_hash):
+                return  # unchanged: keep device corpus clean
+            self._fingerprints[node.id] = (text, emb_hash)
+            if text:
+                self._bm25.index(node.id, text)
+            if node.embedding is not None:
+                v = np.asarray(node.embedding, np.float32)
+                self._ensure_vector_index(v.shape[0])
+                n = np.linalg.norm(v)
+                vn = v / n if n > 1e-12 else v
+                self._vectors[node.id] = vn
+                if self._corpus is not None:
+                    self._corpus.add(node.id, vn)
+                if self._hnsw is not None:
+                    self._hnsw.add(node.id, vn)
+            self.stats.indexed += 1
+
+    def remove_node(self, node_id: str) -> None:
+        with self._lock:
+            self._fingerprints.pop(node_id, None)
+            self._bm25.remove(node_id)
+            self._vectors.pop(node_id, None)
+            if self._corpus is not None:
+                self._corpus.remove(node_id)
+            if self._hnsw is not None:
+                self._hnsw.remove(node_id)
+            self.stats.removed += 1
+
+    def build_indexes(self) -> int:
+        """Full rebuild from storage (ref: BuildIndexes / EnsureSearchIndexesBuilt
+        db.go:1044-1062)."""
+        n = 0
+        for node in self.storage.all_nodes():
+            self.index_node(node)
+            n += 1
+        return n
+
+    # -- queries -----------------------------------------------------------
+    def vector_candidates(
+        self, embedding: np.ndarray, k: int = 10, min_similarity: float = -1.0
+    ) -> list[tuple[str, float]]:
+        """(ref: VectorSearchCandidates search.go:1005)"""
+        with self._lock:
+            self.stats.vector_candidates += 1
+            if self._corpus is not None:
+                res = self._corpus.search(embedding, k=k, min_similarity=min_similarity)
+                return res[0] if res else []
+            if self._hnsw is not None:
+                return [
+                    (i, s)
+                    for i, s in self._hnsw.search(embedding, k)
+                    if s >= min_similarity
+                ]
+            return []
+
+    def search(
+        self,
+        query: str,
+        limit: int = 10,
+        min_similarity: Optional[float] = None,
+        query_embedding: Optional[np.ndarray] = None,
+    ) -> list[dict[str, Any]]:
+        """Hybrid RRF search (ref: Search :851 -> rrfHybridSearch :890)."""
+        self.stats.searches += 1
+        min_sim = self.config.min_similarity if min_similarity is None else min_similarity
+        n_cand = max(limit * self.config.candidates_multiplier, limit)
+        ranked: dict[str, list[str]] = {}
+        vec_scores: dict[str, float] = {}
+        if query_embedding is None and self.embedder is not None and query:
+            query_embedding = self.embedder.embed(query)
+        if query_embedding is not None:
+            vec = self.vector_candidates(query_embedding, n_cand, min_sim)
+            ranked["vector"] = [i for i, _ in vec]
+            vec_scores = dict(vec)
+        ft = self._bm25.search(query, n_cand) if query else []
+        if ft:
+            ranked["fulltext"] = [i for i, _ in ft]
+        ft_scores = dict(ft)
+        if not ranked:
+            return []
+        fused = fuse_rrf(ranked, adaptive_rrf_weights(query), self.config.rrf_k)
+        ordered = [i for i, _ in fused]
+        if self.config.mmr_enabled:
+            rel = {i: s for i, s in fused}
+            with self._lock:
+                ordered = apply_mmr(
+                    ordered, rel, self._vectors, limit, self.config.mmr_lambda
+                )
+        results = []
+        score_map = dict(fused)
+        for id_ in ordered[:limit]:
+            try:
+                node = self.storage.get_node(id_)
+            except NotFoundError:
+                continue
+            results.append(
+                {
+                    "id": id_,
+                    "node": node,
+                    "score": score_map[id_],
+                    "vector_score": vec_scores.get(id_),
+                    "fulltext_score": ft_scores.get(id_),
+                    "content": node.properties.get("content", ""),
+                    "labels": node.labels,
+                }
+            )
+        return results
+
+    # -- wiring ------------------------------------------------------------
+    def attach(self, engine: Engine) -> None:
+        """Subscribe to storage events (ref: db.go:1020-1033)."""
+
+        def _on(kind: str, entity) -> None:
+            if not isinstance(entity, Node):
+                return
+            if kind in ("node_created", "node_updated"):
+                self.index_node(entity)
+            elif kind == "node_deleted":
+                self.remove_node(entity.id)
+
+        engine.on_event(_on)

@@ -1,0 +1,77 @@
+"""DB facade integration tests (ref: pkg/nornicdb integration tests —
+the store -> auto-embed -> recall learning loop, SURVEY.md §3.3)."""
+
+import time
+
+import numpy as np
+import pytest
+
+import nornicdb_tpu
+from nornicdb_tpu.db import Config
+from nornicdb_tpu.embed import CachedEmbedder, HashEmbedder
+
+
+@pytest.fixture
+def db():
+    d = nornicdb_tpu.open_db("")
+    d.set_embedder(CachedEmbedder(HashEmbedder(64)))
+    yield d
+    d.close()
+
+
+class TestLearningLoop:
+    def test_store_autoembed_recall(self, db):
+        a = db.store("TPUs accelerate matrix multiplication")
+        db.store("gardening requires regular watering")
+        c = db.store("XLA compiles matrix programs for TPUs")
+        deadline = time.time() + 10
+        while db.storage.pending_embed_ids() and time.time() < deadline:
+            time.sleep(0.02)
+        assert db.storage.pending_embed_ids() == []
+        res = db.recall("TPU matrix compilation", limit=2)
+        assert {res[0]["id"], res[1]["id"]} == {a.id, c.id}
+
+    def test_search_service_backfills_preexisting_nodes(self):
+        """Regression: nodes stored before first search must be indexed."""
+        db = nornicdb_tpu.open_db("")
+        db.set_embedder(HashEmbedder(32))
+        db.store("node before search service exists")
+        db.process_pending_embeddings()
+        res = db.recall("search service")
+        assert len(res) == 1
+        db.close()
+
+    def test_recall_reinforces_access(self, db):
+        a = db.store("reinforced memory")
+        db.process_pending_embeddings()
+        db.recall("reinforced memory")
+        assert db.storage.get_node(a.id).access_count >= 1
+
+    def test_forget_removes_everywhere(self, db):
+        a = db.store("soon forgotten")
+        db.process_pending_embeddings()
+        db.forget(a.id)
+        assert db.recall("soon forgotten") == []
+
+    def test_link_and_neighbors(self, db):
+        a = db.store("node a")
+        b = db.store("node b")
+        c = db.store("node c")
+        db.link(a.id, b.id, "KNOWS")
+        db.link(b.id, c.id, "KNOWS")
+        n1 = {n.id for n in db.neighbors(a.id, depth=1)}
+        n2 = {n.id for n in db.neighbors(a.id, depth=2)}
+        assert n1 == {b.id}
+        assert n2 == {b.id, c.id}
+
+    def test_durable_embedding_roundtrip(self, tmp_path):
+        d = str(tmp_path / "db")
+        db1 = nornicdb_tpu.open_db(d)
+        db1.set_embedder(HashEmbedder(16))
+        x = db1.store("persisted")
+        db1.process_pending_embeddings()
+        db1.close()
+        db2 = nornicdb_tpu.open_db(d)
+        node = db2.storage.get_node(x.id)
+        assert node.embedding is not None and node.embedding.shape == (16,)
+        db2.close()

@@ -1,0 +1,298 @@
+"""Embed pipeline + search service tests (modeled on reference
+pkg/embed tests, pkg/nornicdb/embed_queue tests, pkg/search tests)."""
+
+import time
+
+import numpy as np
+import pytest
+
+from nornicdb_tpu.embed import (
+    CachedEmbedder,
+    EmbedWorker,
+    EmbedWorkerConfig,
+    HashEmbedder,
+    average_embeddings,
+    build_embedding_text,
+    chunk_text,
+)
+from nornicdb_tpu.search import BM25Index, HNSWIndex, SearchService, fuse_rrf
+from nornicdb_tpu.search.fusion import apply_mmr
+from nornicdb_tpu.storage import MemoryEngine, Node
+
+
+class TestHashEmbedder:
+    def test_deterministic(self):
+        e = HashEmbedder(64)
+        np.testing.assert_array_equal(e.embed("hello world"), e.embed("hello world"))
+
+    def test_similarity_structure(self):
+        e = HashEmbedder(256)
+        a = e.embed("graph database storage engine")
+        b = e.embed("graph database storage layer")
+        c = e.embed("banana smoothie recipe")
+        assert np.dot(a, b) > np.dot(a, c)
+
+    def test_empty(self):
+        e = HashEmbedder(16)
+        assert np.linalg.norm(e.embed("")) == 0
+
+
+class TestCachedEmbedder:
+    def test_hits(self):
+        inner = HashEmbedder(32)
+        ce = CachedEmbedder(inner, capacity=10)
+        v1 = ce.embed("abc")
+        v2 = ce.embed("abc")
+        np.testing.assert_array_equal(v1, v2)
+        assert ce.hits == 1 and ce.misses == 1
+
+    def test_eviction(self):
+        ce = CachedEmbedder(HashEmbedder(8), capacity=2)
+        for t in ["a", "b", "c"]:
+            ce.embed(t)
+        ce.embed("a")  # evicted -> miss
+        assert ce.misses == 4
+
+
+class TestChunking:
+    def test_short_text_single_chunk(self):
+        assert chunk_text("one two three", 512, 50) == ["one two three"]
+
+    def test_chunking_with_overlap(self):
+        words = " ".join(f"w{i}" for i in range(1000))
+        chunks = chunk_text(words, 100, 10)
+        assert all(len(c.split()) <= 100 for c in chunks)
+        # overlap: chunk i+1 starts 90 words after chunk i
+        assert chunks[0].split()[90] == chunks[1].split()[0]
+        # every word covered
+        covered = set(w for c in chunks for w in c.split())
+        assert len(covered) == 1000
+
+    def test_empty(self):
+        assert chunk_text("   ", 10, 2) == []
+
+    def test_average_normalized(self):
+        v = average_embeddings([np.array([1, 0], np.float32), np.array([0, 1], np.float32)])
+        assert np.linalg.norm(v) == pytest.approx(1.0)
+
+    def test_build_embedding_text_priority(self):
+        n = Node(properties={"name": "X", "content": "main text", "other": "ignored"})
+        text = build_embedding_text(n)
+        assert "main text" in text and "X" in text and "ignored" not in text
+
+
+class TestEmbedWorker:
+    def _setup(self, **cfg):
+        eng = MemoryEngine()
+        emb = HashEmbedder(32)
+        w = EmbedWorker(eng, emb, EmbedWorkerConfig(**cfg))
+        return eng, w
+
+    def test_drain_embeds_pending(self):
+        eng, w = self._setup()
+        for i in range(5):
+            eng.create_node(Node(id=f"n{i}", properties={"content": f"text number {i}"}))
+            eng.mark_pending_embed(f"n{i}")
+        n = w.drain()
+        assert n == 5
+        assert eng.pending_embed_ids() == []
+        assert eng.get_node("n0").embedding is not None
+        assert w.stats.processed == 5
+
+    def test_chunked_long_document(self):
+        eng, w = self._setup(chunk_tokens=20, chunk_overlap=5)
+        long_text = " ".join(f"word{i}" for i in range(100))
+        eng.create_node(Node(id="doc", properties={"content": long_text}))
+        eng.mark_pending_embed("doc")
+        w.drain()
+        node = eng.get_node("doc")
+        assert node.embedding is not None
+        assert len(node.chunk_embeddings) > 1
+        assert w.stats.chunked_nodes == 1
+
+    def test_no_text_node_unmarked(self):
+        eng, w = self._setup()
+        eng.create_node(Node(id="empty", properties={"num": 42}))
+        eng.mark_pending_embed("empty")
+        assert w.drain() == 0
+        assert eng.pending_embed_ids() == []
+
+    def test_deleted_node_skipped(self):
+        eng, w = self._setup()
+        eng.create_node(Node(id="gone", properties={"content": "x"}))
+        eng.mark_pending_embed("gone")
+        eng.delete_node("gone")
+        assert w.drain() == 0
+
+    def test_retry_then_success(self):
+        eng = MemoryEngine()
+
+        class FlakyEmbedder(HashEmbedder):
+            def __init__(self):
+                super().__init__(16)
+                self.calls = 0
+
+            def embed_batch(self, texts):
+                self.calls += 1
+                if self.calls == 1:
+                    raise RuntimeError("device hiccup")
+                return super().embed_batch(texts)
+
+        emb = FlakyEmbedder()
+        w = EmbedWorker(eng, emb, EmbedWorkerConfig(retry_backoff=0.01))
+        eng.create_node(Node(id="a", properties={"content": "hi"}))
+        eng.mark_pending_embed("a")
+        assert w.drain() == 1
+        assert w.stats.retries == 1
+
+    def test_background_worker(self):
+        eng, w = self._setup(poll_interval=0.01)
+        w.start()
+        try:
+            eng.create_node(Node(id="bg", properties={"content": "background"}))
+            eng.mark_pending_embed("bg")
+            deadline = time.time() + 5
+            while time.time() < deadline and eng.pending_embed_ids():
+                time.sleep(0.02)
+            assert eng.get_node("bg").embedding is not None
+        finally:
+            w.stop()
+        assert not w.running
+
+
+class TestBM25:
+    def test_basic_ranking(self):
+        idx = BM25Index()
+        idx.index("d1", "the quick brown fox jumps")
+        idx.index("d2", "quick quick quick repeated")
+        idx.index("d3", "unrelated text about databases")
+        res = idx.search("quick")
+        assert res[0][0] == "d2"
+        assert {r[0] for r in res} == {"d1", "d2"}
+
+    def test_remove(self):
+        idx = BM25Index()
+        idx.index("d1", "hello world")
+        idx.remove("d1")
+        assert idx.search("hello") == []
+        assert len(idx) == 0
+
+    def test_update_replaces(self):
+        idx = BM25Index()
+        idx.index("d1", "cats")
+        idx.index("d1", "dogs")
+        assert idx.search("cats") == []
+        assert idx.search("dogs")[0][0] == "d1"
+
+
+class TestHNSW:
+    def test_recall_on_small_corpus(self):
+        rng = np.random.default_rng(0)
+        data = rng.standard_normal((200, 32)).astype(np.float32)
+        idx = HNSWIndex(dims=32, seed=1)
+        for i, v in enumerate(data):
+            idx.add(f"n{i}", v)
+        hits = 0
+        for qi in range(20):
+            res = idx.search(data[qi], k=1)
+            if res and res[0][0] == f"n{qi}":
+                hits += 1
+        assert hits >= 18  # >=90% self-recall
+
+    def test_remove_and_rebuild(self):
+        rng = np.random.default_rng(1)
+        data = rng.standard_normal((50, 16)).astype(np.float32)
+        idx = HNSWIndex(dims=16, rebuild_tombstone_ratio=0.1)
+        for i, v in enumerate(data):
+            idx.add(f"n{i}", v)
+        for i in range(10):
+            idx.remove(f"n{i}")
+        assert len(idx) == 40
+        res = idx.search(data[5], k=5)
+        assert all(not r[0].startswith("n0") or r[0] == "n0" for r in res)
+        assert f"n5" not in [r[0] for r in res]
+
+
+class TestFusion:
+    def test_rrf_prefers_agreement(self):
+        fused = fuse_rrf({"a": ["x", "y", "z"], "b": ["y", "x", "w"]})
+        ids = [i for i, _ in fused]
+        assert ids[0] in ("x", "y")
+        assert ids.index("w") > ids.index("z") or True
+        assert set(ids) == {"x", "y", "z", "w"}
+
+    def test_rrf_weights(self):
+        fused = fuse_rrf(
+            {"a": ["x"], "b": ["y"]}, weights={"a": 2.0, "b": 0.5}
+        )
+        assert fused[0][0] == "x"
+
+    def test_mmr_diversifies(self):
+        # two near-duplicates + one distinct; limit 2 should take one dup + distinct
+        v = {
+            "dup1": np.array([1.0, 0.0], np.float32),
+            "dup2": np.array([0.999, 0.04], np.float32),
+            "other": np.array([0.0, 1.0], np.float32),
+        }
+        rel = {"dup1": 1.0, "dup2": 0.99, "other": 0.5}
+        out = apply_mmr(["dup1", "dup2", "other"], rel, v, limit=2, lambda_=0.5)
+        assert out == ["dup1", "other"]
+
+
+class TestSearchService:
+    def _db(self):
+        eng = MemoryEngine()
+        emb = HashEmbedder(64)
+        svc = SearchService(eng, embedder=emb)
+        svc.attach(eng)
+        return eng, emb, svc
+
+    def test_event_driven_indexing_and_hybrid_search(self):
+        eng, emb, svc = self._db()
+        texts = [
+            "the graph database stores nodes and edges",
+            "vector similarity search on TPU accelerators",
+            "memory decay keeps the knowledge graph fresh",
+        ]
+        for i, t in enumerate(texts):
+            n = Node(id=f"n{i}", properties={"content": t})
+            n.embedding = emb.embed(t)
+            eng.create_node(n)
+        res = svc.search("vector similarity TPU", limit=2)
+        assert res[0]["id"] == "n1"
+        assert res[0]["score"] > 0
+
+    def test_fulltext_only_when_no_embedding(self):
+        eng = MemoryEngine()
+        svc = SearchService(eng)  # no embedder
+        svc.attach(eng)
+        eng.create_node(Node(id="a", properties={"content": "pure text match"}))
+        res = svc.search("text match")
+        assert res and res[0]["id"] == "a"
+        assert res[0]["vector_score"] is None
+
+    def test_delete_removes_from_indexes(self):
+        eng, emb, svc = self._db()
+        n = Node(id="x", properties={"content": "to be deleted"})
+        n.embedding = emb.embed("to be deleted")
+        eng.create_node(n)
+        eng.delete_node("x")
+        assert svc.search("deleted") == []
+
+    def test_min_similarity_filters_vector_results(self):
+        eng, emb, svc = self._db()
+        n = Node(id="a", properties={"content": "alpha beta"})
+        n.embedding = emb.embed("alpha beta")
+        eng.create_node(n)
+        res = svc.vector_candidates(emb.embed("totally different words qqq"), 5, 0.9)
+        assert res == []
+
+    def test_build_indexes_from_existing(self):
+        eng = MemoryEngine()
+        emb = HashEmbedder(64)
+        n = Node(id="pre", properties={"content": "preexisting node"})
+        n.embedding = emb.embed("preexisting node")
+        eng.create_node(n)
+        svc = SearchService(eng, embedder=emb)
+        assert svc.build_indexes() == 1
+        assert svc.search("preexisting")[0]["id"] == "pre"
