@@ -1,0 +1,7 @@
+"""Cypher query engine (ref: /root/reference/pkg/cypher/ — rebuilt as a real
+parser -> AST -> executor pipeline per SURVEY.md §7)."""
+
+from nornicdb_tpu.cypher.executor import CypherExecutor, Result, Stats
+from nornicdb_tpu.cypher.parser import parse
+
+__all__ = ["CypherExecutor", "Result", "Stats", "parse"]

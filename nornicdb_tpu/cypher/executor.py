@@ -1,0 +1,1216 @@
+"""Cypher executor: clause pipeline over binding rows.
+
+Behavioral reference: /root/reference/pkg/cypher/executor.go (Execute
+:490-695), match.go, create.go, merge.go, executor_mutations.go, call.go,
+call_vector.go, call_fulltext.go. The architecture differs deliberately
+(SURVEY.md §7): parsed AST -> row pipeline, not keyword re-dispatch.
+
+Explicit transactions implement ROLLBACK with an executor-level undo log
+(inverse operations), mirroring the reference's transaction-aware WAL undo
+(pkg/storage/wal.go:1845).
+"""
+
+from __future__ import annotations
+
+import csv as csv_mod
+import io
+import time
+import uuid
+from dataclasses import dataclass, field
+from typing import Any, Callable, Iterable, Optional
+
+import numpy as np
+
+from nornicdb_tpu.cypher import ast
+from nornicdb_tpu.cypher.expr import EvalContext, evaluate
+from nornicdb_tpu.cypher.functions import FUNCTIONS, is_aggregate
+from nornicdb_tpu.cypher.matcher import PatternMatcher, make_path
+from nornicdb_tpu.cypher.parser import parse
+from nornicdb_tpu.errors import (
+    CypherSyntaxError,
+    CypherTypeError,
+    NotFoundError,
+    TransactionError,
+)
+from nornicdb_tpu.storage.schema import SchemaManager
+from nornicdb_tpu.storage.types import Edge, Engine, Node, new_id
+
+
+@dataclass
+class Stats:
+    nodes_created: int = 0
+    nodes_deleted: int = 0
+    relationships_created: int = 0
+    relationships_deleted: int = 0
+    properties_set: int = 0
+    labels_added: int = 0
+    labels_removed: int = 0
+    indexes_added: int = 0
+    constraints_added: int = 0
+
+    def as_dict(self) -> dict[str, int]:
+        return {k: v for k, v in self.__dict__.items() if v}
+
+
+@dataclass
+class Result:
+    columns: list[str]
+    rows: list[list[Any]]
+    stats: Stats = field(default_factory=Stats)
+    plan: Optional[str] = None
+
+    def rows_as_dicts(self) -> list[dict[str, Any]]:
+        return [dict(zip(self.columns, r)) for r in self.rows]
+
+    def single(self) -> Optional[list[Any]]:
+        return self.rows[0] if self.rows else None
+
+
+ProcedureFn = Callable[["CypherExecutor", list[Any], dict[str, Any]], tuple[list[str], list[list[Any]]]]
+PROCEDURES: dict[str, ProcedureFn] = {}
+
+
+def procedure(name: str):
+    def deco(fn):
+        PROCEDURES[name.lower()] = fn
+        return fn
+
+    return deco
+
+
+class CypherExecutor:
+    """(ref: cypher.StorageExecutor executor.go:187)"""
+
+    def __init__(
+        self,
+        storage: Engine,
+        schema: Optional[SchemaManager] = None,
+        db=None,
+    ):
+        self.storage = storage
+        self.schema = schema or SchemaManager()
+        self.db = db  # DB facade: embedder, search service, multidb hooks
+        self.matcher = PatternMatcher(storage, self.schema, self)
+        self._plugin_functions: dict[str, Callable] = {}
+        # explicit transaction state (ref: executor.go tx statements :611)
+        self._tx_undo: Optional[list[Callable[[], None]]] = None
+        self._last_call_columns: list[str] = []
+        self.query_count = 0
+
+    # -- public ----------------------------------------------------------------
+    def execute(self, query: str, params: Optional[dict[str, Any]] = None) -> Result:
+        """(ref: Execute executor.go:490)"""
+        self.query_count += 1
+        params = params or {}
+        stmt = parse(query)
+        return self.execute_statement(stmt, params)
+
+    def execute_statement(self, stmt: ast.Statement, params: dict[str, Any]) -> Result:
+        if isinstance(stmt, ast.Query):
+            if stmt.explain or stmt.profile:
+                plan = self._explain(stmt)
+                if stmt.explain:
+                    return Result(["plan"], [[plan]], plan=plan)
+            t0 = time.time()
+            result = self._run_query(stmt, params)
+            if stmt.profile:
+                result.plan = (self._explain(stmt)
+                               + f"\nruntime: {(time.time()-t0)*1000:.2f} ms"
+                               + f", rows: {len(result.rows)}")
+            return result
+        if isinstance(stmt, ast.CreateIndex):
+            return self._create_index(stmt)
+        if isinstance(stmt, ast.DropIndex):
+            self.schema.drop_index(stmt.name, stmt.if_exists)
+            return Result([], [])
+        if isinstance(stmt, ast.CreateConstraint):
+            self.schema.create_constraint(
+                stmt.name, stmt.label, stmt.properties, stmt.kind, stmt.if_not_exists
+            )
+            r = Result([], [])
+            r.stats.constraints_added = 1
+            return r
+        if isinstance(stmt, ast.DropConstraint):
+            self.schema.drop_constraint(stmt.name, stmt.if_exists)
+            return Result([], [])
+        if isinstance(stmt, ast.ShowCommand):
+            return self._show(stmt)
+        if isinstance(stmt, ast.DatabaseCommand):
+            return self._database_command(stmt)
+        if isinstance(stmt, ast.UseCommand):
+            return self._use_command(stmt, params)
+        if isinstance(stmt, ast.TxCommand):
+            return self._tx_command(stmt)
+        raise CypherSyntaxError(f"unsupported statement {type(stmt).__name__}")
+
+    # -- query pipeline -----------------------------------------------------------
+    def _run_query(self, q: ast.Query, params: dict[str, Any]) -> Result:
+        result = self._run_single(q, params)
+        for sub, all_ in q.unions:
+            other = self._run_single(sub, params)
+            if other.columns != result.columns:
+                raise CypherSyntaxError("UNION queries must return the same columns")
+            result.rows.extend(other.rows)
+            if not all_:
+                seen = set()
+                unique = []
+                for r in result.rows:
+                    key = _hashable(r)
+                    if key not in seen:
+                        seen.add(key)
+                        unique.append(r)
+                result.rows = unique
+        return result
+
+    def _run_single(self, q: ast.Query, params: dict[str, Any]) -> Result:
+        rows: list[dict[str, Any]] = [{}]
+        stats = Stats()
+        columns: list[str] = []
+        out_rows: list[list[Any]] = []
+        produced = False
+        for clause in q.clauses:
+            if isinstance(clause, ast.ReturnClause):
+                columns, out_rows = self._project(clause, rows, params, stats)
+                produced = True
+                break
+            rows = self._apply_clause(clause, rows, params, stats)
+        if not produced:
+            last = q.clauses[-1] if q.clauses else None
+            if isinstance(last, ast.CallClause):
+                # standalone CALL: its yielded columns are the result
+                if last.yield_items:
+                    columns = [a or n for n, a in last.yield_items]
+                else:
+                    columns = self._last_call_columns
+                out_rows = [[r.get(c) for c in columns] for r in rows]
+        return Result(columns, out_rows, stats)
+
+    def _apply_clause(
+        self, clause: ast.Clause, rows: list[dict], params: dict, stats: Stats
+    ) -> list[dict]:
+        if isinstance(clause, ast.MatchClause):
+            return self._match(clause, rows, params)
+        if isinstance(clause, ast.CreateClause):
+            return self._create(clause, rows, params, stats)
+        if isinstance(clause, ast.MergeClause):
+            return self._merge(clause, rows, params, stats)
+        if isinstance(clause, ast.SetClause):
+            return self._set(clause.items, rows, params, stats)
+        if isinstance(clause, ast.RemoveClause):
+            return self._remove(clause.items, rows, params, stats)
+        if isinstance(clause, ast.DeleteClause):
+            return self._delete(clause, rows, params, stats)
+        if isinstance(clause, ast.WithClause):
+            return self._with(clause, rows, params, stats)
+        if isinstance(clause, ast.UnwindClause):
+            return self._unwind(clause, rows, params)
+        if isinstance(clause, ast.CallClause):
+            return self._call(clause, rows, params, stats)
+        if isinstance(clause, ast.CallSubquery):
+            return self._call_subquery(clause, rows, params, stats)
+        if isinstance(clause, ast.ForeachClause):
+            return self._foreach(clause, rows, params, stats)
+        if isinstance(clause, ast.LoadCsvClause):
+            return self._load_csv(clause, rows, params)
+        raise CypherSyntaxError(f"unsupported clause {type(clause).__name__}")
+
+    # -- MATCH -----------------------------------------------------------------
+    def _match(self, clause: ast.MatchClause, rows: list[dict], params: dict) -> list[dict]:
+        out: list[dict] = []
+        for row in rows:
+            matched: list[dict] = [row]
+            for pattern in clause.patterns:
+                nxt: list[dict] = []
+                for r in matched:
+                    nxt.extend(self.matcher.match_path(pattern, r, params))
+                matched = nxt
+            if clause.where is not None:
+                matched = [
+                    r
+                    for r in matched
+                    if evaluate(clause.where, EvalContext(r, params, self)) is True
+                ]
+            if clause.optional and not matched:
+                null_row = dict(row)
+                for pattern in clause.patterns:
+                    for var in _pattern_variables(pattern):
+                        null_row.setdefault(var, None)
+                out.append(null_row)
+            else:
+                out.extend(matched)
+        return out
+
+    # -- CREATE ------------------------------------------------------------------
+    def _create(
+        self, clause: ast.CreateClause, rows: list[dict], params: dict, stats: Stats
+    ) -> list[dict]:
+        out = []
+        for row in rows:
+            new_row = dict(row)
+            for pattern in clause.patterns:
+                self._create_path(pattern, new_row, params, stats)
+            out.append(new_row)
+        return out
+
+    def _create_path(
+        self, pattern: ast.PatternPath, row: dict, params: dict, stats: Stats
+    ) -> None:
+        elements = pattern.elements
+        nodes: list[Node] = []
+        rels: list[Edge] = []
+        prev_node: Optional[Node] = None
+        i = 0
+        while i < len(elements):
+            el = elements[i]
+            if isinstance(el, ast.NodePattern):
+                node = self._resolve_or_create_node(el, row, params, stats)
+                nodes.append(node)
+                if i > 0:
+                    rel_pat = elements[i - 1]
+                    edge = self._create_edge(rel_pat, prev_node, node, row, params, stats)
+                    rels.append(edge)
+                prev_node = node
+                i += 1
+            else:
+                i += 1
+        if pattern.name:
+            row[pattern.name] = make_path(nodes, rels)
+
+    def _resolve_or_create_node(
+        self, pat: ast.NodePattern, row: dict, params: dict, stats: Stats
+    ) -> Node:
+        if pat.variable and pat.variable in row:
+            v = row[pat.variable]
+            if not isinstance(v, Node):
+                raise CypherTypeError(f"variable `{pat.variable}` is not a node")
+            if pat.labels or pat.properties:
+                raise CypherSyntaxError(
+                    f"variable `{pat.variable}` already declared"
+                )
+            return v
+        props = {}
+        if pat.properties is not None:
+            props = evaluate(pat.properties, EvalContext(row, params, self)) or {}
+        node = Node(labels=list(pat.labels), properties=dict(props))
+        self.schema.check_unique(node)
+        created = self.storage.create_node(node)
+        self._record_undo(lambda nid=created.id: self.storage.delete_node(nid))
+        if self.db is not None and getattr(self.db.config, "embed_enabled", False):
+            self.storage.mark_pending_embed(created.id)
+        stats.nodes_created += 1
+        stats.properties_set += len(props)
+        stats.labels_added += len(pat.labels)
+        if pat.variable:
+            row[pat.variable] = created
+        return created
+
+    def _create_edge(
+        self, rel_pat: ast.RelPattern, start: Node, end: Node, row, params, stats
+    ) -> Edge:
+        if rel_pat.direction == "both":
+            raise CypherSyntaxError("CREATE requires a directed relationship")
+        if rel_pat.var_length:
+            raise CypherSyntaxError("cannot CREATE a variable-length relationship")
+        props = {}
+        if rel_pat.properties is not None:
+            props = evaluate(rel_pat.properties, EvalContext(row, params, self)) or {}
+        rel_type = rel_pat.types[0] if rel_pat.types else "RELATED_TO"
+        s, t = (start, end) if rel_pat.direction == "out" else (end, start)
+        edge = Edge(start_node=s.id, end_node=t.id, type=rel_type, properties=dict(props))
+        created = self.storage.create_edge(edge)
+        self._record_undo(lambda eid=created.id: self.storage.delete_edge(eid))
+        stats.relationships_created += 1
+        stats.properties_set += len(props)
+        if rel_pat.variable:
+            row[rel_pat.variable] = created
+        return created
+
+    # -- MERGE --------------------------------------------------------------------
+    def _merge(
+        self, clause: ast.MergeClause, rows: list[dict], params: dict, stats: Stats
+    ) -> list[dict]:
+        """(ref: merge.go)"""
+        out = []
+        for row in rows:
+            matches = list(self.matcher.match_path(clause.pattern, row, params))
+            if matches:
+                for m in matches:
+                    if clause.on_match:
+                        self._set(clause.on_match, [m], params, stats)
+                        m = self._refresh_row(m)
+                    out.append(m)
+            else:
+                new_row = dict(row)
+                self._create_path(clause.pattern, new_row, params, stats)
+                if clause.on_create:
+                    self._set(clause.on_create, [new_row], params, stats)
+                    new_row = self._refresh_row(new_row)
+                out.append(new_row)
+        return out
+
+    def _refresh_row(self, row: dict) -> dict:
+        """Re-fetch entities after SET so later clauses see fresh copies."""
+        out = {}
+        for k, v in row.items():
+            if isinstance(v, Node):
+                try:
+                    out[k] = self.storage.get_node(v.id)
+                except NotFoundError:
+                    out[k] = v
+            elif isinstance(v, Edge):
+                try:
+                    out[k] = self.storage.get_edge(v.id)
+                except NotFoundError:
+                    out[k] = v
+            else:
+                out[k] = v
+        return out
+
+    # -- SET / REMOVE ----------------------------------------------------------------
+    def _set(
+        self, items: list[ast.SetItem], rows: list[dict], params: dict, stats: Stats
+    ) -> list[dict]:
+        for row in rows:
+            ctx = EvalContext(row, params, self)
+            for item in items:
+                if item.kind == "property":
+                    assert isinstance(item.target, ast.Property)
+                    entity = evaluate(item.target.subject, ctx)
+                    if entity is None:
+                        continue
+                    value = evaluate(item.value, ctx) if item.value is not None else None
+                    self._set_property(entity, item.target.key, value, stats)
+                elif item.kind == "variable":
+                    entity = evaluate(item.target, ctx)
+                    if entity is None:
+                        continue
+                    value = evaluate(item.value, ctx)
+                    if not isinstance(value, dict):
+                        if isinstance(value, (Node, Edge)):
+                            value = dict(value.properties)
+                        else:
+                            raise CypherTypeError("SET n = expects a map")
+                    self._set_all_properties(entity, value, item.merge, stats)
+                elif item.kind == "label":
+                    entity = evaluate(item.target, ctx)
+                    if entity is None:
+                        continue
+                    if not isinstance(entity, Node):
+                        raise CypherTypeError("labels can only be set on nodes")
+                    self._add_labels(entity, item.labels, stats)
+            # refresh entity bindings so subsequent clauses see updates
+            refreshed = self._refresh_row(row)
+            row.clear()
+            row.update(refreshed)
+        return rows
+
+    def _set_property(self, entity, key: str, value, stats: Stats) -> None:
+        if isinstance(entity, Node):
+            node = self.storage.get_node(entity.id)
+            old = node.copy()
+            if value is None:
+                node.properties.pop(key, None)
+            else:
+                node.properties[key] = _to_storable(value)
+            self.schema.check_unique(node, exclude_id=node.id)
+            self.storage.update_node(node)
+            self._record_undo(lambda o=old: self.storage.update_node(o))
+            stats.properties_set += 1
+        elif isinstance(entity, Edge):
+            edge = self.storage.get_edge(entity.id)
+            old = edge.copy()
+            if value is None:
+                edge.properties.pop(key, None)
+            else:
+                edge.properties[key] = _to_storable(value)
+            self.storage.update_edge(edge)
+            self._record_undo(lambda o=old: self.storage.update_edge(o))
+            stats.properties_set += 1
+        else:
+            raise CypherTypeError("SET target must be a node or relationship")
+
+    def _set_all_properties(self, entity, value: dict, merge: bool, stats: Stats) -> None:
+        value = {k: _to_storable(v) for k, v in value.items()}
+        if isinstance(entity, Node):
+            node = self.storage.get_node(entity.id)
+            old = node.copy()
+            if merge:
+                node.properties.update(value)
+            else:
+                node.properties = dict(value)
+            self.schema.check_unique(node, exclude_id=node.id)
+            self.storage.update_node(node)
+            self._record_undo(lambda o=old: self.storage.update_node(o))
+            stats.properties_set += len(value)
+        elif isinstance(entity, Edge):
+            edge = self.storage.get_edge(entity.id)
+            old = edge.copy()
+            if merge:
+                edge.properties.update(value)
+            else:
+                edge.properties = dict(value)
+            self.storage.update_edge(edge)
+            self._record_undo(lambda o=old: self.storage.update_edge(o))
+            stats.properties_set += len(value)
+        else:
+            raise CypherTypeError("SET target must be a node or relationship")
+
+    def _add_labels(self, entity: Node, labels: list[str], stats: Stats) -> None:
+        node = self.storage.get_node(entity.id)
+        old = node.copy()
+        added = 0
+        for lbl in labels:
+            if lbl not in node.labels:
+                node.labels.append(lbl)
+                added += 1
+        if added:
+            self.storage.update_node(node)
+            self._record_undo(lambda o=old: self.storage.update_node(o))
+            stats.labels_added += added
+
+    def _remove(
+        self, items: list[ast.SetItem], rows: list[dict], params: dict, stats: Stats
+    ) -> list[dict]:
+        for row in rows:
+            ctx = EvalContext(row, params, self)
+            for item in items:
+                if item.kind == "property":
+                    assert isinstance(item.target, ast.Property)
+                    entity = evaluate(item.target.subject, ctx)
+                    if entity is None:
+                        continue
+                    self._set_property(entity, item.target.key, None, stats)
+                elif item.kind == "label":
+                    entity = evaluate(item.target, ctx)
+                    if entity is None:
+                        continue
+                    node = self.storage.get_node(entity.id)
+                    old = node.copy()
+                    removed = 0
+                    for lbl in item.labels:
+                        if lbl in node.labels:
+                            node.labels.remove(lbl)
+                            removed += 1
+                    if removed:
+                        self.storage.update_node(node)
+                        self._record_undo(lambda o=old: self.storage.update_node(o))
+                        stats.labels_removed += removed
+            refreshed = self._refresh_row(row)
+            row.clear()
+            row.update(refreshed)
+        return rows
+
+    # -- DELETE ------------------------------------------------------------------
+    def _delete(
+        self, clause: ast.DeleteClause, rows: list[dict], params: dict, stats: Stats
+    ) -> list[dict]:
+        deleted_nodes: set[str] = set()
+        deleted_edges: set[str] = set()
+        for row in rows:
+            ctx = EvalContext(row, params, self)
+            for expr in clause.exprs:
+                v = evaluate(expr, ctx)
+                items = v if isinstance(v, list) else [v]
+                for item in items:
+                    if item is None:
+                        continue
+                    if isinstance(item, Node):
+                        if item.id in deleted_nodes:
+                            continue
+                        attached = self.storage.degree(item.id)
+                        if attached and not clause.detach:
+                            raise CypherTypeError(
+                                "cannot delete node with relationships; use DETACH DELETE"
+                            )
+                        old = self.storage.get_node(item.id)
+                        old_edges = (
+                            self.storage.get_outgoing_edges(item.id)
+                            + self.storage.get_incoming_edges(item.id)
+                        )
+                        self.storage.delete_node(item.id)
+                        deleted_nodes.add(item.id)
+                        stats.nodes_deleted += 1
+                        stats.relationships_deleted += len(
+                            {e.id for e in old_edges} - deleted_edges
+                        )
+                        deleted_edges.update(e.id for e in old_edges)
+
+                        def undo_node(o=old, es=old_edges):
+                            self.storage.create_node(o)
+                            for e in es:
+                                try:
+                                    self.storage.create_edge(e)
+                                except Exception:
+                                    pass
+
+                        self._record_undo(undo_node)
+                    elif isinstance(item, Edge):
+                        if item.id in deleted_edges:
+                            continue
+                        old_e = self.storage.get_edge(item.id)
+                        self.storage.delete_edge(item.id)
+                        deleted_edges.add(item.id)
+                        stats.relationships_deleted += 1
+                        self._record_undo(
+                            lambda o=old_e: self.storage.create_edge(o)
+                        )
+                    elif isinstance(item, dict) and item.get("__path__"):
+                        for e in item.get("relationships", []):
+                            if e.id not in deleted_edges:
+                                self.storage.delete_edge(e.id)
+                                deleted_edges.add(e.id)
+                                stats.relationships_deleted += 1
+                    else:
+                        raise CypherTypeError("DELETE expects nodes/relationships")
+        return rows
+
+    # -- WITH / RETURN projection ---------------------------------------------------
+    def _with(
+        self, clause: ast.WithClause, rows: list[dict], params: dict, stats: Stats
+    ) -> list[dict]:
+        ret = ast.ReturnClause(
+            clause.items, clause.distinct, clause.order_by, clause.skip,
+            clause.limit, clause.star,
+        )
+        columns, data = self._project(ret, rows, params, stats, star_keep=clause.star,
+                                      original_rows=rows)
+        out = [dict(zip(columns, r)) for r in data]
+        if clause.where is not None:
+            out = [
+                r for r in out
+                if evaluate(clause.where, EvalContext(r, params, self)) is True
+            ]
+        return out
+
+    def _project(
+        self,
+        clause: ast.ReturnClause,
+        rows: list[dict],
+        params: dict,
+        stats: Stats,
+        star_keep: bool = False,
+        original_rows: Optional[list[dict]] = None,
+    ) -> tuple[list[str], list[list[Any]]]:
+        items = list(clause.items)
+        star = getattr(clause, "star", False)
+        # RETURN * / WITH * expands to all bound variables
+        if star:
+            star_cols = sorted({k for r in rows for k in r.keys()})
+            star_items = [ast.ReturnItem(ast.Variable(c), c) for c in star_cols]
+            items = star_items + items
+        columns = [it.key for it in items]
+        has_agg = any(_contains_aggregate(it.expr) for it in items)
+        if has_agg:
+            data = self._aggregate_project(items, rows, params)
+            source_rows: list[dict] = [{} for _ in data]
+        else:
+            data = []
+            source_rows = []
+            for row in rows:
+                ctx = EvalContext(row, params, self)
+                data.append([evaluate(it.expr, ctx) for it in items])
+                source_rows.append(row)
+        if clause.distinct:
+            seen = set()
+            unique, unique_src = [], []
+            for r, src in zip(data, source_rows):
+                key = _hashable(r)
+                if key not in seen:
+                    seen.add(key)
+                    unique.append(r)
+                    unique_src.append(src)
+            data, source_rows = unique, unique_src
+        if clause.order_by:
+            data = self._order_by(
+                clause.order_by, columns, data, source_rows, params
+            )
+        if clause.skip is not None:
+            n = evaluate(clause.skip, EvalContext({}, params, self))
+            data = data[int(n):]
+        if clause.limit is not None:
+            n = evaluate(clause.limit, EvalContext({}, params, self))
+            data = data[: int(n)]
+        return columns, data
+
+    def _order_by(self, order_items, columns, data, source_rows, params):
+        # ORDER BY may reference output columns OR pre-projection variables
+        def sort_key(pair):
+            row_vals, src = pair
+            binding = dict(src)
+            binding.update(dict(zip(columns, row_vals)))
+            keys = []
+            for oi in order_items:
+                if isinstance(oi.expr, ast.Variable) and oi.expr.name in binding:
+                    v = binding[oi.expr.name]
+                else:
+                    v = evaluate(oi.expr, EvalContext(binding, params, self))
+                keys.append(_SortKey(v, oi.descending))
+            return keys
+
+        return [d for d, _ in sorted(zip(data, source_rows), key=sort_key)]
+
+    def _aggregate_project(self, items, rows, params) -> list[list[Any]]:
+        group_idx = [i for i, it in enumerate(items) if not _contains_aggregate(it.expr)]
+        agg_idx = [i for i, it in enumerate(items) if _contains_aggregate(it.expr)]
+        groups: dict[Any, dict] = {}
+        order: list[Any] = []
+        for row in rows:
+            ctx = EvalContext(row, params, self)
+            gkey_vals = [evaluate(items[i].expr, ctx) for i in group_idx]
+            gkey = _hashable(gkey_vals)
+            if gkey not in groups:
+                groups[gkey] = {"key_vals": gkey_vals, "rows": []}
+                order.append(gkey)
+            groups[gkey]["rows"].append(row)
+        if not rows and not group_idx:
+            groups[()] = {"key_vals": [], "rows": []}
+            order.append(())
+        out = []
+        for gkey in order:
+            g = groups[gkey]
+            vals: list[Any] = [None] * len(items)
+            for pos, i in enumerate(group_idx):
+                vals[i] = g["key_vals"][pos]
+            for i in agg_idx:
+                vals[i] = self._eval_aggregate(items[i].expr, g["rows"], params)
+            out.append(vals)
+        return out
+
+    def _eval_aggregate(self, expr: ast.Expr, rows: list[dict], params: dict) -> Any:
+        if isinstance(expr, ast.FunctionCall) and is_aggregate(expr.name):
+            name = expr.name
+            if name == "count" and expr.args and isinstance(expr.args[0], ast.Literal) \
+                    and expr.args[0].value == "*":
+                return len(rows)
+            values = []
+            for row in rows:
+                ctx = EvalContext(row, params, self)
+                v = evaluate(expr.args[0], ctx) if expr.args else None
+                if v is not None:
+                    values.append(v)
+            if expr.distinct:
+                seen = set()
+                uniq = []
+                for v in values:
+                    k = _hashable([v])
+                    if k not in seen:
+                        seen.add(k)
+                        uniq.append(v)
+                values = uniq
+            if name == "count":
+                return len(values)
+            if name == "collect":
+                return values
+            if name == "sum":
+                return sum(values) if values else 0
+            if name == "avg":
+                return sum(values) / len(values) if values else None
+            if name == "min":
+                return min(values) if values else None
+            if name == "max":
+                return max(values) if values else None
+            if name in ("stdev", "stdevp"):
+                if len(values) < 2:
+                    return 0.0
+                arr = np.asarray(values, np.float64)
+                return float(arr.std(ddof=1 if name == "stdev" else 0))
+            if name == "percentilecont":
+                raise CypherSyntaxError("percentileCont needs two args")
+        if isinstance(expr, ast.FunctionCall) and expr.name in (
+            "percentilecont", "percentiledisc",
+        ):
+            pass
+        # expression containing aggregates, e.g. count(x) + 1
+        if isinstance(expr, ast.BinaryOp):
+            left = (
+                self._eval_aggregate(expr.left, rows, params)
+                if _contains_aggregate(expr.left)
+                else evaluate(expr.left, EvalContext(rows[0] if rows else {}, params, self))
+            )
+            right = (
+                self._eval_aggregate(expr.right, rows, params)
+                if _contains_aggregate(expr.right)
+                else evaluate(expr.right, EvalContext(rows[0] if rows else {}, params, self))
+            )
+            return _binary_value(expr.op, left, right)
+        if isinstance(expr, ast.FunctionCall):
+            # scalar fn over aggregate args, e.g. round(avg(x))
+            args = [
+                self._eval_aggregate(a, rows, params)
+                if _contains_aggregate(a)
+                else evaluate(a, EvalContext(rows[0] if rows else {}, params, self))
+                for a in expr.args
+            ]
+            fn = FUNCTIONS.get(expr.name) or self.lookup_function(expr.name)
+            if fn is None:
+                raise CypherSyntaxError(f"unknown function {expr.name}()")
+            return fn(*args)
+        raise CypherSyntaxError("invalid aggregate expression")
+
+    # -- UNWIND / CALL / FOREACH / LOAD CSV -----------------------------------------
+    def _unwind(self, clause: ast.UnwindClause, rows, params) -> list[dict]:
+        out = []
+        for row in rows:
+            v = evaluate(clause.expr, EvalContext(row, params, self))
+            if v is None:
+                continue
+            items = v if isinstance(v, list) else [v]
+            for item in items:
+                nr = dict(row)
+                nr[clause.variable] = item
+                out.append(nr)
+        return out
+
+    def _call(self, clause: ast.CallClause, rows, params, stats) -> list[dict]:
+        fn = PROCEDURES.get(clause.procedure)
+        if fn is None:
+            raise CypherSyntaxError(f"unknown procedure {clause.procedure}")
+        self._last_call_columns: list[str] = []
+        out = []
+        for row in rows:
+            args = [
+                evaluate(a, EvalContext(row, params, self)) for a in clause.args
+            ]
+            cols, data = fn(self, args, row)
+            self._last_call_columns = list(cols)
+            if not clause.yield_items and not clause.yield_star:
+                # no YIELD: procedure acts as a side effect / passthrough
+                if not data:
+                    out.append(row)
+                for r in data:
+                    nr = dict(row)
+                    nr.update(dict(zip(cols, r)))
+                    out.append(nr)
+                continue
+            names = (
+                [(c, None) for c in cols] if clause.yield_star else clause.yield_items
+            )
+            for r in data:
+                rec = dict(zip(cols, r))
+                nr = dict(row)
+                for name, alias in names:
+                    if name not in rec:
+                        raise CypherSyntaxError(
+                            f"procedure {clause.procedure} does not yield `{name}`"
+                        )
+                    nr[alias or name] = rec[name]
+                if clause.where is not None and evaluate(
+                    clause.where, EvalContext(nr, params, self)
+                ) is not True:
+                    continue
+                out.append(nr)
+        return out
+
+    def _call_subquery(self, clause: ast.CallSubquery, rows, params, stats) -> list[dict]:
+        out = []
+        for row in rows:
+            inner_rows = [dict(row)]
+            produced_return = False
+            for c in clause.query.clauses:
+                if isinstance(c, ast.ReturnClause):
+                    cols, data = self._project(c, inner_rows, params, stats)
+                    for r in data:
+                        nr = dict(row)
+                        nr.update(dict(zip(cols, r)))
+                        out.append(nr)
+                    produced_return = True
+                    break
+                inner_rows = self._apply_clause(c, inner_rows, params, stats)
+            if not produced_return:
+                out.append(row)
+        return out
+
+    def _foreach(self, clause: ast.ForeachClause, rows, params, stats) -> list[dict]:
+        for row in rows:
+            v = evaluate(clause.expr, EvalContext(row, params, self))
+            if v is None:
+                continue
+            if not isinstance(v, list):
+                raise CypherTypeError("FOREACH expects a list")
+            for item in v:
+                inner = dict(row)
+                inner[clause.variable] = item
+                inner_rows = [inner]
+                for c in clause.updates:
+                    inner_rows = self._apply_clause(c, inner_rows, params, stats)
+        return rows
+
+    def _load_csv(self, clause: ast.LoadCsvClause, rows, params) -> list[dict]:
+        out = []
+        for row in rows:
+            url = evaluate(clause.url, EvalContext(row, params, self))
+            path = str(url)
+            if path.startswith("file://"):
+                path = path[7:]
+            elif "://" in path:
+                raise CypherTypeError(
+                    "only file:// URLs are supported for LOAD CSV (zero-egress)"
+                )
+            with open(path, newline="") as f:
+                reader = csv_mod.reader(f, delimiter=clause.field_terminator)
+                data = list(reader)
+            if clause.with_headers:
+                if not data:
+                    continue
+                headers = data[0]
+                for rec in data[1:]:
+                    nr = dict(row)
+                    nr[clause.variable] = dict(zip(headers, rec))
+                    out.append(nr)
+            else:
+                for rec in data:
+                    nr = dict(row)
+                    nr[clause.variable] = list(rec)
+                    out.append(nr)
+        return out
+
+    # -- pattern expressions (WHERE (a)-[:X]->(b), EXISTS {}, COUNT {}) -----------
+    def eval_pattern_expr(self, e, ctx: EvalContext) -> Any:
+        if isinstance(e, ast.PatternPredicate):
+            it = self.matcher.match_path(e.pattern, ctx.bindings, ctx.params)
+            return next(iter(it), None) is not None
+        if isinstance(e, (ast.ExistsSubquery, ast.CountSubquery)):
+            count = 0
+            for r in self.matcher.match_path(e.pattern, ctx.bindings, ctx.params):
+                if e.where is None or evaluate(
+                    e.where, EvalContext(r, ctx.params, self)
+                ) is True:
+                    count += 1
+                    if isinstance(e, ast.ExistsSubquery):
+                        return True
+            return count if isinstance(e, ast.CountSubquery) else False
+        raise CypherTypeError("unknown pattern expression")
+
+    # -- hooks -------------------------------------------------------------------
+    def get_node_or_none(self, node_id: str) -> Optional[Node]:
+        try:
+            return self.storage.get_node(node_id)
+        except NotFoundError:
+            return None
+
+    def lookup_function(self, name: str) -> Optional[Callable]:
+        """Plugin / APOC function lookup (ref: PluginFunctionLookup db.go:933)."""
+        fn = self._plugin_functions.get(name)
+        if fn is not None:
+            return fn
+        if name.startswith("apoc."):
+            try:
+                from nornicdb_tpu.apoc import lookup as apoc_lookup
+
+                return apoc_lookup(name)
+            except ImportError:
+                return None
+        return None
+
+    def register_function(self, name: str, fn: Callable) -> None:
+        self._plugin_functions[name.lower()] = fn
+
+    # -- transactions ---------------------------------------------------------------
+    def _tx_command(self, stmt: ast.TxCommand) -> Result:
+        if stmt.op == "begin":
+            if self._tx_undo is not None:
+                raise TransactionError("transaction already open")
+            self._tx_undo = []
+            self._tx_id = str(uuid.uuid4())
+            wal = getattr(self.storage, "tx_begin", None)
+            if callable(wal):
+                wal(self._tx_id)
+        elif stmt.op == "commit":
+            if self._tx_undo is None:
+                raise TransactionError("no open transaction")
+            wal = getattr(self.storage, "tx_commit", None)
+            if callable(wal):
+                wal(self._tx_id)
+            self._tx_undo = None
+        elif stmt.op == "rollback":
+            if self._tx_undo is None:
+                raise TransactionError("no open transaction")
+            for undo in reversed(self._tx_undo):
+                try:
+                    undo()
+                except Exception:
+                    pass
+            wal = getattr(self.storage, "tx_rollback", None)
+            if callable(wal):
+                wal(self._tx_id)
+            self._tx_undo = None
+        return Result([], [])
+
+    def _record_undo(self, fn: Callable[[], None]) -> None:
+        if self._tx_undo is not None:
+            self._tx_undo.append(fn)
+
+    # -- DDL / admin ------------------------------------------------------------------
+    def _create_index(self, stmt: ast.CreateIndex) -> Result:
+        self.schema.create_index(
+            stmt.name, stmt.kind, stmt.label, stmt.properties, stmt.options,
+            stmt.if_not_exists,
+        )
+        r = Result([], [])
+        r.stats.indexes_added = 1
+        return r
+
+    def _show(self, stmt: ast.ShowCommand) -> Result:
+        if stmt.what == "indexes":
+            cols = ["name", "type", "labelsOrTypes", "properties", "options"]
+            rows = [
+                [i.name, i.kind, [i.label], i.properties, i.options]
+                for i in self.schema.list_indexes()
+            ]
+            return Result(cols, rows)
+        if stmt.what == "constraints":
+            cols = ["name", "type", "labelsOrTypes", "properties"]
+            rows = [
+                [c.name, c.kind.upper(), [c.label], c.properties]
+                for c in self.schema.list_constraints()
+            ]
+            return Result(cols, rows)
+        if stmt.what == "databases":
+            mgr = getattr(self.db, "database_manager", None) if self.db else None
+            if mgr is not None:
+                return Result(
+                    ["name", "default"],
+                    [[n, n == mgr.default_database] for n in mgr.list_databases()],
+                )
+            return Result(["name", "default"], [["neo4j", True]])
+        if stmt.what == "procedures":
+            return Result(["name"], [[p] for p in sorted(PROCEDURES)])
+        if stmt.what == "functions":
+            names = sorted(set(FUNCTIONS) | set(self._plugin_functions))
+            return Result(["name"], [[f] for f in names])
+        if stmt.what == "aliases":
+            mgr = getattr(self.db, "database_manager", None) if self.db else None
+            if mgr is not None:
+                return Result(
+                    ["name", "database"], [[a, t] for a, t in mgr.list_aliases()]
+                )
+            return Result(["name", "database"], [])
+        raise CypherSyntaxError(f"unsupported SHOW {stmt.what}")
+
+    def _database_command(self, stmt: ast.DatabaseCommand) -> Result:
+        mgr = getattr(self.db, "database_manager", None) if self.db else None
+        if mgr is None:
+            raise CypherSyntaxError("multi-database commands require a DatabaseManager")
+        if stmt.op == "create":
+            mgr.create_database(stmt.name, if_not_exists=stmt.if_not_exists)
+        elif stmt.op == "drop":
+            mgr.drop_database(stmt.name, if_exists=stmt.if_exists)
+        elif stmt.op == "create_alias":
+            mgr.create_alias(stmt.name, stmt.options["target"])
+        elif stmt.op == "drop_alias":
+            mgr.drop_alias(stmt.name)
+        elif stmt.op == "create_composite":
+            mgr.create_composite(stmt.name)
+        else:
+            raise CypherSyntaxError(f"unsupported database command {stmt.op}")
+        return Result([], [])
+
+    def _use_command(self, stmt: ast.UseCommand, params: dict) -> Result:
+        if self.db is None or getattr(self.db, "database_manager", None) is None:
+            raise CypherSyntaxError("USE requires a DatabaseManager")
+        ex = self.db.executor_for(stmt.database)
+        if stmt.query is None:
+            return Result([], [])
+        return ex.execute_statement(stmt.query, params)
+
+    def _explain(self, q: ast.Query) -> str:
+        lines = ["Query plan:"]
+        for c in q.clauses:
+            lines.append(f"  {type(c).__name__}")
+        return "\n".join(lines)
+
+
+# ---------------------------------------------------------------- helpers
+class _SortKey:
+    """Comparable wrapper: mixed-type tolerant, nulls sort last (asc),
+    honours per-key DESC."""
+
+    __slots__ = ("v", "desc")
+
+    def __init__(self, v, desc: bool):
+        self.v = v
+        self.desc = desc
+
+    def _cmp(self, other) -> int:
+        a, b = self.v, other.v
+        if a is None and b is None:
+            return 0
+        if a is None:
+            return 1  # nulls last in ascending
+        if b is None:
+            return -1
+        if isinstance(a, (Node, Edge)):
+            a = a.id
+        if isinstance(b, (Node, Edge)):
+            b = b.id
+        try:
+            if a == b:
+                return 0
+            return -1 if a < b else 1
+        except TypeError:
+            ta, tb = type(a).__name__, type(b).__name__
+            if ta != tb:
+                return -1 if ta < tb else 1
+            sa, sb = str(a), str(b)
+            return 0 if sa == sb else (-1 if sa < sb else 1)
+
+    def __lt__(self, other) -> bool:
+        c = self._cmp(other)
+        return c > 0 if self.desc else c < 0
+
+    def __eq__(self, other) -> bool:
+        return self._cmp(other) == 0
+
+
+def _pattern_variables(pattern: ast.PatternPath) -> list[str]:
+    out = []
+    if pattern.name:
+        out.append(pattern.name)
+    for el in pattern.elements:
+        v = getattr(el, "variable", None)
+        if v:
+            out.append(v)
+    return out
+
+
+def _contains_aggregate(e: ast.Expr) -> bool:
+    if isinstance(e, ast.FunctionCall):
+        if is_aggregate(e.name):
+            return True
+        return any(_contains_aggregate(a) for a in e.args)
+    if isinstance(e, ast.BinaryOp):
+        return _contains_aggregate(e.left) or _contains_aggregate(e.right)
+    if isinstance(e, ast.UnaryOp):
+        return _contains_aggregate(e.operand)
+    if isinstance(e, ast.Property):
+        return _contains_aggregate(e.subject)
+    return False
+
+
+def _hashable(vals: Iterable[Any]) -> Any:
+    out = []
+    for v in vals:
+        if isinstance(v, (Node, Edge)):
+            out.append(("__ent__", v.id))
+        elif isinstance(v, list):
+            out.append(_hashable(v))
+        elif isinstance(v, dict):
+            out.append(tuple(sorted((k, _hashable([x])) for k, x in v.items())))
+        elif isinstance(v, np.ndarray):
+            out.append(v.tobytes())
+        else:
+            out.append(v)
+    return tuple(out)
+
+
+def _to_storable(v: Any) -> Any:
+    if isinstance(v, (Node, Edge)):
+        raise CypherTypeError("cannot store an entity as a property")
+    return v
+
+
+def _binary_value(op: str, a: Any, b: Any) -> Any:
+    from nornicdb_tpu.cypher.expr import _binary  # reuse via tiny shim
+
+    e = ast.BinaryOp(op, ast.Literal(a), ast.Literal(b))
+    return _binary(e, EvalContext({}, {}, None))
+
+
+# ---------------------------------------------------------------- procedures
+@procedure("db.labels")
+def proc_labels(ex: CypherExecutor, args, row):
+    labels = sorted({l for n in ex.storage.all_nodes() for l in n.labels})
+    return ["label"], [[l] for l in labels]
+
+
+@procedure("db.relationshiptypes")
+def proc_rel_types(ex: CypherExecutor, args, row):
+    types = sorted({e.type for e in ex.storage.all_edges()})
+    return ["relationshipType"], [[t] for t in types]
+
+
+@procedure("db.propertykeys")
+def proc_prop_keys(ex: CypherExecutor, args, row):
+    keys: set[str] = set()
+    for n in ex.storage.all_nodes():
+        keys.update(n.properties.keys())
+    for e in ex.storage.all_edges():
+        keys.update(e.properties.keys())
+    return ["propertyKey"], [[k] for k in sorted(keys)]
+
+
+@procedure("dbms.components")
+def proc_components(ex: CypherExecutor, args, row):
+    from nornicdb_tpu import __version__
+
+    return (
+        ["name", "versions", "edition"],
+        [["NornicDB-TPU", [__version__], "tpu"]],
+    )
+
+
+@procedure("db.index.vector.querynodes")
+def proc_vector_query(ex: CypherExecutor, args, row):
+    """(ref: call_vector.go:35 — accepts a vector OR a string; strings are
+    auto-embedded server-side)."""
+    if len(args) < 3:
+        raise CypherSyntaxError(
+            "db.index.vector.queryNodes(indexName, k, vectorOrText)"
+        )
+    index_name, k, query = args[0], int(args[1]), args[2]
+    if isinstance(query, str):
+        embedder = getattr(ex.db, "embedder", None) if ex.db else None
+        if embedder is None:
+            raise CypherTypeError(
+                "string query requires an embedder (SetEmbedder)"
+            )
+        query = embedder.embed(query)
+    vec = np.asarray(query, np.float32)
+    svc = ex.db.search if ex.db is not None else None
+    if svc is None:
+        raise CypherTypeError("vector search requires the DB search service")
+    hits = svc.vector_candidates(vec, k=k)
+    out = []
+    for nid, score in hits:
+        node = ex.get_node_or_none(nid)
+        if node is not None:
+            out.append([node, float(score)])
+    return ["node", "score"], out
+
+
+@procedure("db.index.fulltext.querynodes")
+def proc_fulltext_query(ex: CypherExecutor, args, row):
+    """(ref: call_fulltext.go)"""
+    if len(args) < 2:
+        raise CypherSyntaxError("db.index.fulltext.queryNodes(indexName, query)")
+    query = str(args[1])
+    limit = int(args[2]) if len(args) > 2 else 10
+    svc = ex.db.search if ex.db is not None else None
+    if svc is None:
+        raise CypherTypeError("fulltext search requires the DB search service")
+    hits = svc._bm25.search(query, limit)
+    out = []
+    for nid, score in hits:
+        node = ex.get_node_or_none(nid)
+        if node is not None:
+            out.append([node, float(score)])
+    return ["node", "score"], out
+
+
+@procedure("db.index.vector.createnodeindex")
+def proc_vector_create(ex: CypherExecutor, args, row):
+    # legacy creation form (name, label, prop, dims, similarity)
+    name, label, prop = str(args[0]), str(args[1]), str(args[2])
+    dims = int(args[3]) if len(args) > 3 else 0
+    sim = str(args[4]) if len(args) > 4 else "cosine"
+    ex.schema.create_index(
+        name, "vector", label, [prop],
+        {"vector.dimensions": dims, "vector.similarity_function": sim},
+        if_not_exists=True,
+    )
+    return [], []
+
+
+@procedure("db.awaitindexes")
+def proc_await_indexes(ex: CypherExecutor, args, row):
+    return [], []

@@ -1,0 +1,469 @@
+"""Cypher scalar/list/string/math function registry.
+
+Behavioral reference: /root/reference/pkg/cypher/fn/registry.go and the
+function surface exercised by the reference's compat tests
+(neo4j_compat_test.go, documentation_examples_test.go).
+"""
+
+from __future__ import annotations
+
+import math
+import random
+import re
+import time
+import uuid
+from typing import Any, Callable, Optional
+
+from nornicdb_tpu.errors import CypherTypeError
+from nornicdb_tpu.storage.types import Edge, Node
+
+FUNCTIONS: dict[str, Callable[..., Any]] = {}
+
+
+def register(name: str):
+    def deco(fn):
+        FUNCTIONS[name] = fn
+        return fn
+
+    return deco
+
+
+def _null_in(*args) -> bool:
+    return any(a is None for a in args)
+
+
+# ---------------------------------------------------------------- entity fns
+@register("id")
+def fn_id(x):
+    if x is None:
+        return None
+    if isinstance(x, (Node, Edge)):
+        return x.id
+    raise CypherTypeError("id() expects a node or relationship")
+
+
+@register("elementid")
+def fn_element_id(x):
+    return fn_id(x)
+
+
+@register("labels")
+def fn_labels(x):
+    if x is None:
+        return None
+    if isinstance(x, Node):
+        return list(x.labels)
+    raise CypherTypeError("labels() expects a node")
+
+
+@register("type")
+def fn_type(x):
+    if x is None:
+        return None
+    if isinstance(x, Edge):
+        return x.type
+    raise CypherTypeError("type() expects a relationship")
+
+
+@register("properties")
+def fn_properties(x):
+    if x is None:
+        return None
+    if isinstance(x, (Node, Edge)):
+        return dict(x.properties)
+    if isinstance(x, dict):
+        return dict(x)
+    raise CypherTypeError("properties() expects a node, relationship or map")
+
+
+@register("keys")
+def fn_keys(x):
+    if x is None:
+        return None
+    if isinstance(x, (Node, Edge)):
+        return sorted(x.properties.keys())
+    if isinstance(x, dict):
+        return sorted(x.keys())
+    raise CypherTypeError("keys() expects a node, relationship or map")
+
+
+@register("startnode")
+def fn_start_node(x):
+    # resolved by the executor (needs storage access); placeholder raises
+    raise CypherTypeError("startNode() requires executor context")
+
+
+@register("exists")
+def fn_exists(x):
+    return x is not None
+
+
+# ---------------------------------------------------------------- scalars
+@register("coalesce")
+def fn_coalesce(*args):
+    for a in args:
+        if a is not None:
+            return a
+    return None
+
+
+@register("size")
+def fn_size(x):
+    if x is None:
+        return None
+    if isinstance(x, (list, str, dict)):
+        return len(x)
+    raise CypherTypeError("size() expects a list, string or map")
+
+
+@register("length")
+def fn_length(x):
+    if x is None:
+        return None
+    if isinstance(x, dict) and x.get("__path__"):
+        return len(x.get("relationships", []))
+    if isinstance(x, (list, str)):
+        return len(x)
+    raise CypherTypeError("length() expects a path, list or string")
+
+
+@register("head")
+def fn_head(x):
+    if x is None or not isinstance(x, list) or not x:
+        return None
+    return x[0]
+
+
+@register("last")
+def fn_last(x):
+    if x is None or not isinstance(x, list) or not x:
+        return None
+    return x[-1]
+
+
+@register("tail")
+def fn_tail(x):
+    if x is None or not isinstance(x, list):
+        return None
+    return x[1:]
+
+
+@register("reverse")
+def fn_reverse(x):
+    if x is None:
+        return None
+    if isinstance(x, list):
+        return list(reversed(x))
+    if isinstance(x, str):
+        return x[::-1]
+    raise CypherTypeError("reverse() expects a list or string")
+
+
+@register("range")
+def fn_range(start, end, step=1):
+    if _null_in(start, end):
+        return None
+    step = int(step)
+    if step == 0:
+        raise CypherTypeError("range() step must not be zero")
+    out = []
+    i = int(start)
+    end = int(end)
+    if step > 0:
+        while i <= end:
+            out.append(i)
+            i += step
+    else:
+        while i >= end:
+            out.append(i)
+            i += step
+    return out
+
+
+@register("randomuuid")
+def fn_random_uuid():
+    return str(uuid.uuid4())
+
+
+@register("rand")
+def fn_rand():
+    return random.random()
+
+
+@register("timestamp")
+def fn_timestamp():
+    return int(time.time() * 1000)
+
+
+@register("toboolean")
+def fn_to_boolean(x):
+    if x is None:
+        return None
+    if isinstance(x, bool):
+        return x
+    if isinstance(x, str):
+        low = x.lower()
+        if low == "true":
+            return True
+        if low == "false":
+            return False
+        return None
+    if isinstance(x, int):
+        return x != 0
+    return None
+
+
+@register("tointeger")
+def fn_to_integer(x):
+    if x is None:
+        return None
+    try:
+        if isinstance(x, str):
+            return int(float(x)) if ("." in x or "e" in x.lower()) else int(x)
+        if isinstance(x, bool):
+            return 1 if x else 0
+        return int(x)
+    except (ValueError, TypeError):
+        return None
+
+
+@register("tofloat")
+def fn_to_float(x):
+    if x is None:
+        return None
+    try:
+        return float(x)
+    except (ValueError, TypeError):
+        return None
+
+
+@register("tostring")
+def fn_to_string(x):
+    if x is None:
+        return None
+    if isinstance(x, bool):
+        return "true" if x else "false"
+    if isinstance(x, float) and x.is_integer():
+        return f"{x:.1f}"
+    return str(x)
+
+
+# ---------------------------------------------------------------- strings
+@register("tolower")
+@register("lower")
+def fn_to_lower(x):
+    return None if x is None else str(x).lower()
+
+
+@register("toupper")
+@register("upper")
+def fn_to_upper(x):
+    return None if x is None else str(x).upper()
+
+
+@register("trim")
+def fn_trim(x):
+    return None if x is None else str(x).strip()
+
+
+@register("ltrim")
+def fn_ltrim(x):
+    return None if x is None else str(x).lstrip()
+
+
+@register("rtrim")
+def fn_rtrim(x):
+    return None if x is None else str(x).rstrip()
+
+
+@register("replace")
+def fn_replace(s, search, repl):
+    if _null_in(s, search, repl):
+        return None
+    return str(s).replace(str(search), str(repl))
+
+
+@register("split")
+def fn_split(s, sep):
+    if _null_in(s, sep):
+        return None
+    return str(s).split(str(sep))
+
+
+@register("substring")
+def fn_substring(s, start, length=None):
+    if _null_in(s, start):
+        return None
+    s = str(s)
+    start = int(start)
+    if length is None:
+        return s[start:]
+    return s[start : start + int(length)]
+
+
+@register("left")
+def fn_left(s, n):
+    if _null_in(s, n):
+        return None
+    return str(s)[: int(n)]
+
+
+@register("right")
+def fn_right(s, n):
+    if _null_in(s, n):
+        return None
+    n = int(n)
+    return str(s)[-n:] if n > 0 else ""
+
+
+# ---------------------------------------------------------------- math
+@register("abs")
+def fn_abs(x):
+    return None if x is None else abs(x)
+
+
+@register("sign")
+def fn_sign(x):
+    if x is None:
+        return None
+    return 0 if x == 0 else (1 if x > 0 else -1)
+
+
+@register("round")
+def fn_round(x, precision=0):
+    if x is None:
+        return None
+    if precision == 0:
+        return float(math.floor(x + 0.5)) if isinstance(x, float) else float(x)
+    return round(float(x), int(precision))
+
+
+@register("floor")
+def fn_floor(x):
+    return None if x is None else float(math.floor(x))
+
+
+@register("ceil")
+def fn_ceil(x):
+    return None if x is None else float(math.ceil(x))
+
+
+@register("sqrt")
+def fn_sqrt(x):
+    if x is None:
+        return None
+    return math.sqrt(x) if x >= 0 else None
+
+
+@register("exp")
+def fn_exp(x):
+    return None if x is None else math.exp(x)
+
+
+@register("log")
+def fn_log(x):
+    if x is None or x <= 0:
+        return None
+    return math.log(x)
+
+
+@register("log10")
+def fn_log10(x):
+    if x is None or x <= 0:
+        return None
+    return math.log10(x)
+
+
+@register("sin")
+def fn_sin(x):
+    return None if x is None else math.sin(x)
+
+
+@register("cos")
+def fn_cos(x):
+    return None if x is None else math.cos(x)
+
+
+@register("tan")
+def fn_tan(x):
+    return None if x is None else math.tan(x)
+
+
+@register("atan2")
+def fn_atan2(y, x):
+    if _null_in(y, x):
+        return None
+    return math.atan2(y, x)
+
+
+@register("pi")
+def fn_pi():
+    return math.pi
+
+
+@register("e")
+def fn_e():
+    return math.e
+
+
+@register("toupper")
+def _dup_toupper(x):  # keep registry import-stable
+    return fn_to_upper(x)
+
+
+# ---------------------------------------------------------------- list fns
+@register("nodes")
+def fn_nodes(p):
+    if p is None:
+        return None
+    if isinstance(p, dict) and p.get("__path__"):
+        return p.get("nodes", [])
+    raise CypherTypeError("nodes() expects a path")
+
+
+@register("relationships")
+def fn_relationships(p):
+    if p is None:
+        return None
+    if isinstance(p, dict) and p.get("__path__"):
+        return p.get("relationships", [])
+    raise CypherTypeError("relationships() expects a path")
+
+
+@register("reduce")
+def fn_reduce(*a):
+    raise CypherTypeError("reduce() requires executor context")
+
+
+# vector similarity (ref: vector.similarity.cosine in Neo4j 5 / NornicDB)
+@register("vector.similarity.cosine")
+def fn_vec_cosine(a, b):
+    if _null_in(a, b):
+        return None
+    import numpy as np
+
+    va = np.asarray(a, np.float32)
+    vb = np.asarray(b, np.float32)
+    na, nb = np.linalg.norm(va), np.linalg.norm(vb)
+    if na < 1e-12 or nb < 1e-12:
+        return 0.0
+    return float(np.dot(va, vb) / (na * nb))
+
+
+@register("vector.similarity.euclidean")
+def fn_vec_euclidean(a, b):
+    if _null_in(a, b):
+        return None
+    import numpy as np
+
+    va = np.asarray(a, np.float32)
+    vb = np.asarray(b, np.float32)
+    return float(1.0 / (1.0 + np.sum((va - vb) ** 2)))
+
+
+AGGREGATES = {"count", "sum", "avg", "min", "max", "collect", "stdev",
+              "stdevp", "percentilecont", "percentiledisc"}
+
+
+def is_aggregate(name: str) -> bool:
+    return name in AGGREGATES

@@ -1,0 +1,337 @@
+"""Graph pattern matching: binds MATCH/MERGE patterns against storage.
+
+Behavioral reference: /root/reference/pkg/cypher/match.go:124 (executeMatch),
+traversal.go:886-1330 (BFS findPaths :1127, shortestPath :1332). Uses the
+schema property index for equality lookups when available (the reference's
+pattern fastpaths, optimized_executors.go).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Iterator, Optional
+
+from nornicdb_tpu.cypher import ast
+from nornicdb_tpu.cypher.expr import EvalContext, evaluate
+from nornicdb_tpu.errors import CypherTypeError, NotFoundError
+from nornicdb_tpu.storage.schema import SchemaManager
+from nornicdb_tpu.storage.types import Edge, Engine, Node
+
+MAX_VAR_LENGTH = 15  # traversal depth cap (ref: traversal.go bounds)
+
+
+def make_path(nodes: list[Node], rels: list[Edge]) -> dict[str, Any]:
+    return {"__path__": True, "nodes": nodes, "relationships": rels}
+
+
+class PatternMatcher:
+    def __init__(self, storage: Engine, schema: Optional[SchemaManager] = None,
+                 executor=None):
+        self.storage = storage
+        self.schema = schema
+        self.executor = executor
+
+    # -- public --------------------------------------------------------------
+    def match_path(
+        self,
+        path: ast.PatternPath,
+        row: dict[str, Any],
+        params: dict[str, Any],
+    ) -> Iterator[dict[str, Any]]:
+        """Yield binding rows extending `row` with this path's variables."""
+        if path.shortest:
+            yield from self._match_shortest(path, row, params)
+            return
+        yield from self._match_elements(path, row, params, 0, row, [], [])
+
+    # -- node candidates -------------------------------------------------------
+    def _node_props(
+        self, node_pat: ast.NodePattern, row: dict, params: dict
+    ) -> Optional[dict[str, Any]]:
+        if node_pat.properties is None:
+            return None
+        ctx = EvalContext(row, params, self.executor)
+        v = evaluate(node_pat.properties, ctx)
+        if not isinstance(v, dict):
+            raise CypherTypeError("node pattern properties must be a map")
+        return v
+
+    def _node_matches(
+        self, node: Node, node_pat: ast.NodePattern, props: Optional[dict]
+    ) -> bool:
+        if node_pat.labels and not any(l in node.labels for l in node_pat.labels):
+            return False
+        if props:
+            for k, v in props.items():
+                if not _value_eq(node.properties.get(k), v):
+                    return False
+        return True
+
+    def _candidates(
+        self, node_pat: ast.NodePattern, row: dict, params: dict
+    ) -> list[Node]:
+        # bound variable -> single candidate
+        if node_pat.variable and node_pat.variable in row:
+            v = row[node_pat.variable]
+            if v is None:
+                return []
+            if not isinstance(v, Node):
+                raise CypherTypeError(
+                    f"variable `{node_pat.variable}` is not a node"
+                )
+            props = self._node_props(node_pat, row, params)
+            return [v] if self._node_matches(v, node_pat, props) else []
+        props = self._node_props(node_pat, row, params)
+        # index-backed equality lookup (ref: optimized_executors.go fastpath)
+        if self.schema is not None and node_pat.labels and props:
+            for label in node_pat.labels:
+                keys = sorted(props.keys())
+                ids = self.schema.lookup(label, keys, [props[k] for k in keys])
+                if ids is None and len(keys) > 1:
+                    for k in keys:
+                        ids = self.schema.lookup(label, [k], [props[k]])
+                        if ids is not None:
+                            break
+                if ids is not None:
+                    nodes = self.storage.batch_get_nodes(sorted(ids))
+                    return [n for n in nodes if self._node_matches(n, node_pat, props)]
+        if node_pat.labels:
+            seen: dict[str, Node] = {}
+            for label in node_pat.labels:
+                for n in self.storage.get_nodes_by_label(label):
+                    seen[n.id] = n
+            nodes = sorted(seen.values(), key=lambda n: n.id)
+            return [n for n in nodes if self._node_matches(n, node_pat, props)]
+        return [
+            n
+            for n in sorted(self.storage.all_nodes(), key=lambda n: n.id)
+            if self._node_matches(n, node_pat, props)
+        ]
+
+    # -- relationship matching ---------------------------------------------------
+    def _rel_props(
+        self, rel_pat: ast.RelPattern, row: dict, params: dict
+    ) -> Optional[dict[str, Any]]:
+        if rel_pat.properties is None:
+            return None
+        ctx = EvalContext(row, params, self.executor)
+        return evaluate(rel_pat.properties, ctx)
+
+    def _rel_matches(self, edge: Edge, rel_pat: ast.RelPattern, props) -> bool:
+        if rel_pat.types and edge.type not in rel_pat.types:
+            return False
+        if props:
+            for k, v in props.items():
+                if not _value_eq(edge.properties.get(k), v):
+                    return False
+        return True
+
+    def _expand(
+        self, node_id: str, rel_pat: ast.RelPattern, props
+    ) -> list[tuple[Edge, str]]:
+        """Edges leaving `node_id` per the pattern direction -> (edge, other_id)."""
+        out: list[tuple[Edge, str]] = []
+        if rel_pat.direction in ("out", "both"):
+            for e in self.storage.get_outgoing_edges(node_id):
+                if self._rel_matches(e, rel_pat, props):
+                    out.append((e, e.end_node))
+        if rel_pat.direction in ("in", "both"):
+            for e in self.storage.get_incoming_edges(node_id):
+                if self._rel_matches(e, rel_pat, props):
+                    out.append((e, e.start_node))
+        out.sort(key=lambda t: t[0].id)
+        return out
+
+    # -- recursive path walk ------------------------------------------------------
+    def _match_elements(
+        self,
+        path: ast.PatternPath,
+        base_row: dict,
+        params: dict,
+        idx: int,
+        row: dict,
+        path_nodes: list[Node],
+        path_rels: list[Edge],
+    ) -> Iterator[dict[str, Any]]:
+        elements = path.elements
+        if idx >= len(elements):
+            out = dict(row)
+            if path.name:
+                out[path.name] = make_path(path_nodes, path_rels)
+            yield out
+            return
+        el = elements[idx]
+        if isinstance(el, ast.NodePattern):
+            if idx == 0:
+                for node in self._candidates(el, row, params):
+                    new_row = dict(row)
+                    if el.variable:
+                        new_row[el.variable] = node
+                    yield from self._match_elements(
+                        path, base_row, params, idx + 1, new_row,
+                        path_nodes + [node], path_rels,
+                    )
+            else:
+                raise CypherTypeError("internal: node pattern out of sequence")
+            return
+        # relationship element: el, followed by target node element
+        rel_pat = el
+        target_pat = elements[idx + 1]
+        src = path_nodes[-1]
+        props = self._rel_props(rel_pat, row, params)
+        tprops = self._node_props(target_pat, row, params)
+        if rel_pat.var_length:
+            yield from self._match_var_length(
+                path, params, idx, row, path_nodes, path_rels, rel_pat,
+                target_pat, props, tprops, src,
+            )
+            return
+        for edge, other_id in self._expand(src.id, rel_pat, props):
+            if any(e.id == edge.id for e in path_rels):
+                continue  # relationship isomorphism
+            try:
+                other = self.storage.get_node(other_id)
+            except NotFoundError:
+                continue
+            if not self._node_matches(other, target_pat, tprops):
+                continue
+            if target_pat.variable and target_pat.variable in row:
+                bound = row[target_pat.variable]
+                if not isinstance(bound, Node) or bound.id != other.id:
+                    continue
+            new_row = dict(row)
+            if rel_pat.variable:
+                new_row[rel_pat.variable] = edge
+            if target_pat.variable:
+                new_row[target_pat.variable] = other
+            yield from self._match_elements(
+                path, row, params, idx + 2, new_row,
+                path_nodes + [other], path_rels + [edge],
+            )
+
+    def _match_var_length(
+        self, path, params, idx, row, path_nodes, path_rels,
+        rel_pat, target_pat, props, tprops, src,
+    ) -> Iterator[dict[str, Any]]:
+        """Variable-length expansion via DFS with edge-set de-dup
+        (ref: findPaths traversal.go:1127)."""
+        max_h = min(rel_pat.max_hops, MAX_VAR_LENGTH)
+        min_h = rel_pat.min_hops
+
+        def walk(curr: Node, hops: int, rels: list[Edge], nodes: list[Node]):
+            if hops >= min_h:
+                if self._node_matches(curr, target_pat, tprops):
+                    if target_pat.variable and target_pat.variable in row:
+                        bound = row[target_pat.variable]
+                        ok = isinstance(bound, Node) and bound.id == curr.id
+                    else:
+                        ok = True
+                    if ok:
+                        new_row = dict(row)
+                        if rel_pat.variable:
+                            new_row[rel_pat.variable] = list(rels)
+                        if target_pat.variable:
+                            new_row[target_pat.variable] = curr
+                        yield new_row, list(nodes), list(rels)
+            if hops >= max_h:
+                return
+            for edge, other_id in self._expand(curr.id, rel_pat, props):
+                if any(e.id == edge.id for e in rels) or any(
+                    e.id == edge.id for e in path_rels
+                ):
+                    continue
+                try:
+                    other = self.storage.get_node(other_id)
+                except NotFoundError:
+                    continue
+                yield from walk(other, hops + 1, rels + [edge], nodes + [other])
+
+        start_nodes = list(path_nodes)
+        if min_h == 0:
+            # zero-length: current node is also the target
+            for new_row, nodes, rels in walk(src, 0, [], []):
+                yield from self._match_elements(
+                    path, row, params, idx + 2, new_row,
+                    start_nodes + nodes, path_rels + rels,
+                )
+            return
+        for new_row, nodes, rels in walk(src, 0, [], []):
+            if not rels:
+                continue
+            yield from self._match_elements(
+                path, row, params, idx + 2, new_row,
+                start_nodes + nodes, path_rels + rels,
+            )
+
+    # -- shortest path -------------------------------------------------------------
+    def _match_shortest(
+        self, path: ast.PatternPath, row: dict, params: dict
+    ) -> Iterator[dict[str, Any]]:
+        """(ref: shortestPath traversal.go:1332) — BFS between two bound/matched
+        endpoints over the middle relationship pattern."""
+        if len(path.elements) != 3:
+            raise CypherTypeError("shortestPath expects (a)-[rel]-(b)")
+        start_pat, rel_pat, end_pat = path.elements
+        props = self._rel_props(rel_pat, row, params)
+        max_h = min(rel_pat.max_hops if rel_pat.var_length else MAX_VAR_LENGTH,
+                    MAX_VAR_LENGTH)
+        for start in self._candidates(start_pat, row, params):
+            for end in self._candidates(end_pat, row, params):
+                found = self._bfs_shortest(
+                    start, end, rel_pat, props, max_h,
+                    all_paths=(path.shortest == "allshortest"),
+                )
+                for nodes, rels in found:
+                    out = dict(row)
+                    if start_pat.variable:
+                        out[start_pat.variable] = start
+                    if end_pat.variable:
+                        out[end_pat.variable] = end
+                    if rel_pat.variable:
+                        out[rel_pat.variable] = rels
+                    if path.name:
+                        out[path.name] = make_path(nodes, rels)
+                    yield out
+
+    def _bfs_shortest(
+        self, start: Node, end: Node, rel_pat, props, max_h: int,
+        all_paths: bool = False,
+    ) -> list[tuple[list[Node], list[Edge]]]:
+        if start.id == end.id:
+            return [([start], [])]
+        frontier: list[tuple[str, list[Node], list[Edge]]] = [(start.id, [start], [])]
+        visited = {start.id}
+        results: list[tuple[list[Node], list[Edge]]] = []
+        for _ in range(max_h):
+            nxt: list[tuple[str, list[Node], list[Edge]]] = []
+            level_visited: set[str] = set()
+            for nid, nodes, rels in frontier:
+                for edge, other_id in self._expand(nid, rel_pat, props):
+                    if other_id in visited:
+                        continue
+                    try:
+                        other = self.storage.get_node(other_id)
+                    except NotFoundError:
+                        continue
+                    p = (nodes + [other], rels + [edge])
+                    if other_id == end.id:
+                        results.append(p)
+                        if not all_paths:
+                            return results
+                        continue
+                    level_visited.add(other_id)
+                    nxt.append((other_id, p[0], p[1]))
+            if results:
+                return results
+            visited |= level_visited
+            frontier = nxt
+            if not frontier:
+                break
+        return results
+
+
+def _value_eq(a: Any, b: Any) -> bool:
+    if a is None and b is None:
+        return True
+    if isinstance(a, bool) != isinstance(b, bool):
+        return False
+    return a == b

@@ -100,6 +100,9 @@ class DB:
                     chunk_overlap=self.config.embed_chunk_overlap,
                     workers=self.config.embed_workers,
                 ),
+                # debounced k-means refit after bulk embedding
+                # (ref: scheduleClusteringDebounced embed_queue.go:257)
+                on_cluster_trigger=lambda: self.search.recluster(),
             )
             self._embed_worker.start()
 

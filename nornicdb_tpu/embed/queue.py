@@ -156,14 +156,16 @@ class EmbedWorker:
 
     def process_batch(self, limit: int = 0) -> int:
         """One batched device step over pending nodes
-        (ref: processNextBatch :417, but batched)."""
+        (ref: processNextBatch :417, but batched).
+
+        Returns the number of queue entries HANDLED (embedded or unmarked as
+        unembeddable) — not just embedded — so drain() keeps going while a
+        batch full of textless/deleted nodes still made progress."""
         size = self.config.batch_size if limit <= 0 else min(limit, self.config.batch_size)
         with self._claim_lock:
-            ids = [
-                i
-                for i in self.storage.pending_embed_ids(limit=0)
-                if i not in self._claimed
-            ][:size]
+            # fetch just enough head-of-queue ids to fill a batch past claims
+            head = self.storage.pending_embed_ids(limit=size + len(self._claimed))
+            ids = [i for i in head if i not in self._claimed][:size]
             self._claimed.update(ids)
         if not ids:
             return 0
@@ -174,29 +176,33 @@ class EmbedWorker:
                 self._claimed.difference_update(ids)
 
     def _process_claimed(self, ids: list[str]) -> int:
-        # Assemble (node, chunks) pairs; nodes with no text are just unmarked.
+        # Assemble (node, chunks) pairs; nodes with no text are just unmarked
+        # (still counted as handled so drain() doesn't stop early).
         jobs: list[tuple[Node, list[str]]] = []
+        skipped = 0
         for nid in ids:
             try:
                 node = self.storage.get_node(nid)
             except NotFoundError:
                 self.storage.unmark_pending_embed(nid)
+                skipped += 1
                 continue
             text = build_embedding_text(node)
             chunks = chunk_text(text, self.config.chunk_tokens, self.config.chunk_overlap)
             if not chunks:
                 self.storage.unmark_pending_embed(nid)
+                skipped += 1
                 continue
             jobs.append((node, chunks))
         if not jobs:
-            return 0
+            return skipped
         # One flat batch through the embedder (all chunks of all nodes).
         flat = [c for _, chunks in jobs for c in chunks]
         vectors = self._embed_with_retry(flat)
         if vectors is None:
             # batch failed terminally: mark failures, keep pending for later
             self.stats.failed += len(jobs)
-            return 0
+            return skipped
         processed = 0
         pos = 0
         for node, chunks in jobs:
@@ -222,7 +228,7 @@ class EmbedWorker:
         with self._cluster_lock:
             self._since_cluster += processed
             self._last_embed_ts = time.time()
-        return processed
+        return processed + skipped
 
     def _embed_with_retry(self, texts: list[str]) -> Optional[list[np.ndarray]]:
         """(ref: embedWithRetry :714; crash recovery local_gguf.go:202)"""

@@ -170,6 +170,7 @@ class HNSWIndex:
             curr = self._greedy_closest(v, curr, lc)
         for lc in range(min(level, self._max_level), -1, -1):
             cands = self._search_layer(v, curr, self.ef_construction, lc)
+            cands.sort()  # (neg_sim, id): ascending neg_sim = best first
             ids = [i for _, i in cands]
             m = self.m0 if lc == 0 else self.m
             selected = self._select_neighbors(v, ids, m)

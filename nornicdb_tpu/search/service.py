@@ -69,11 +69,13 @@ class SearchService:
         self._hnsw: Optional[HNSWIndex] = None
         self._bm25 = BM25Index()
         self._vectors: dict[str, np.ndarray] = {}  # normalized, for MMR
-        # id -> (text, embedding-bytes-hash): lets no-op updates (e.g. the
+        # id -> (text-digest, embedding-digest): lets no-op updates (e.g. the
         # access-count touch recall() performs per result) skip re-indexing,
         # which would otherwise dirty the device corpus and force a full H2D
         # re-upload per search
-        self._fingerprints: dict[str, tuple[str, int]] = {}
+        self._fingerprints: dict[str, tuple[bytes, bytes]] = {}
+        self.cluster_result = None
+        self.cluster_assignments: dict[str, int] = {}
 
     # -- index plumbing ----------------------------------------------------
     def _ensure_vector_index(self, dims: int) -> None:
@@ -86,18 +88,25 @@ class SearchService:
 
     def index_node(self, node: Node) -> None:
         """(ref: IndexNode search.go:651; event wiring db.go:1020-1033)"""
+        import hashlib
+
         text = build_embedding_text(node)
-        emb_hash = (
-            hash(np.asarray(node.embedding, np.float32).tobytes())
+        fp = (
+            hashlib.blake2s(text.encode()).digest(),
+            hashlib.blake2s(
+                np.asarray(node.embedding, np.float32).tobytes()
+            ).digest()
             if node.embedding is not None
-            else 0
+            else b"",
         )
         with self._lock:
-            if self._fingerprints.get(node.id) == (text, emb_hash):
+            if self._fingerprints.get(node.id) == fp:
                 return  # unchanged: keep device corpus clean
-            self._fingerprints[node.id] = (text, emb_hash)
+            self._fingerprints[node.id] = fp
             if text:
                 self._bm25.index(node.id, text)
+            else:
+                self._bm25.remove(node.id)  # text dropped on update
             if node.embedding is not None:
                 v = np.asarray(node.embedding, np.float32)
                 self._ensure_vector_index(v.shape[0])
@@ -108,6 +117,12 @@ class SearchService:
                     self._corpus.add(node.id, vn)
                 if self._hnsw is not None:
                     self._hnsw.add(node.id, vn)
+            elif node.id in self._vectors:  # embedding dropped on update
+                self._vectors.pop(node.id, None)
+                if self._corpus is not None:
+                    self._corpus.remove(node.id)
+                if self._hnsw is not None:
+                    self._hnsw.remove(node.id)
             self.stats.indexed += 1
 
     def remove_node(self, node_id: str) -> None:
@@ -200,6 +215,26 @@ class SearchService:
                 }
             )
         return results
+
+    # -- clustering (ref: gpu.ClusterIndex kmeans.go:144; debounced trigger
+    # embed_queue.go:257) -----------------------------------------------------
+    def recluster(self, k: int = 0, iters: int = 10) -> Optional[dict[str, int]]:
+        """Re-fit k-means over the current vector set on TPU; stores
+        id->cluster assignments for cluster-pruned candidate generation and
+        the inference engine's cluster integration."""
+        with self._lock:
+            ids = list(self._vectors.keys())
+            if len(ids) < 2:
+                return None
+            mat = np.stack([self._vectors[i] for i in ids])
+        from nornicdb_tpu.ops.kmeans import kmeans_fit
+
+        res = kmeans_fit(mat, k=k, iters=iters)
+        assignments = {id_: int(c) for id_, c in zip(ids, res.assignments)}
+        with self._lock:
+            self.cluster_result = res
+            self.cluster_assignments = assignments
+        return assignments
 
     # -- wiring ------------------------------------------------------------
     def attach(self, engine: Engine) -> None:

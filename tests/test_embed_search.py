@@ -114,7 +114,8 @@ class TestEmbedWorker:
         eng, w = self._setup()
         eng.create_node(Node(id="empty", properties={"num": 42}))
         eng.mark_pending_embed("empty")
-        assert w.drain() == 0
+        assert w.drain() == 1  # handled (unmarked), not embedded
+        assert w.stats.processed == 0
         assert eng.pending_embed_ids() == []
 
     def test_deleted_node_skipped(self):
@@ -122,7 +123,21 @@ class TestEmbedWorker:
         eng.create_node(Node(id="gone", properties={"content": "x"}))
         eng.mark_pending_embed("gone")
         eng.delete_node("gone")
-        assert w.drain() == 0
+        assert w.drain() == 0  # delete_node already unmarked it
+        assert eng.pending_embed_ids() == []
+
+    def test_drain_continues_past_textless_batch(self):
+        """Regression: a full batch of textless nodes must not stop drain()
+        before embeddable nodes behind them are processed."""
+        eng, w = self._setup(batch_size=4)
+        for i in range(4):
+            eng.create_node(Node(id=f"e{i}", properties={"num": i}))
+            eng.mark_pending_embed(f"e{i}")
+        eng.create_node(Node(id="real", properties={"content": "actual text"}))
+        eng.mark_pending_embed("real")
+        w.drain()
+        assert eng.pending_embed_ids() == []
+        assert eng.get_node("real").embedding is not None
 
     def test_retry_then_success(self):
         eng = MemoryEngine()
@@ -208,9 +223,11 @@ class TestHNSW:
         for i in range(10):
             idx.remove(f"n{i}")
         assert len(idx) == 40
-        res = idx.search(data[5], k=5)
-        assert all(not r[0].startswith("n0") or r[0] == "n0" for r in res)
-        assert f"n5" not in [r[0] for r in res]
+        res = idx.search(data[15], k=5)
+        ids = [r[0] for r in res]
+        # removed ids n0..n9 must never surface
+        assert not any(i in ids for i in [f"n{j}" for j in range(10)])
+        assert "n15" in ids  # live self-match survives the rebuild
 
 
 class TestFusion:
@@ -218,7 +235,9 @@ class TestFusion:
         fused = fuse_rrf({"a": ["x", "y", "z"], "b": ["y", "x", "w"]})
         ids = [i for i, _ in fused]
         assert ids[0] in ("x", "y")
-        assert ids.index("w") > ids.index("z") or True
+        # single third-place appearance ranks below double appearances
+        assert ids.index("w") > ids.index("x")
+        assert ids.index("w") > ids.index("y")
         assert set(ids) == {"x", "y", "z", "w"}
 
     def test_rrf_weights(self):
