@@ -3,5 +3,6 @@ parser -> AST -> executor pipeline per SURVEY.md §7)."""
 
 from nornicdb_tpu.cypher.executor import CypherExecutor, Result, Stats
 from nornicdb_tpu.cypher.parser import parse
+from nornicdb_tpu.cypher import gds_procedures  # noqa: F401 — registers procs/fns
 
 __all__ = ["CypherExecutor", "Result", "Stats", "parse"]

@@ -144,10 +144,15 @@ class CypherExecutor:
         raise CypherSyntaxError(f"unsupported statement {type(stmt).__name__}")
 
     # -- query pipeline -----------------------------------------------------------
-    def _run_query(self, q: ast.Query, params: dict[str, Any]) -> Result:
-        result = self._run_single(q, params)
+    def _run_query(
+        self,
+        q: ast.Query,
+        params: dict[str, Any],
+        start_rows: Optional[list[dict]] = None,
+    ) -> Result:
+        result = self._run_single(q, params, start_rows)
         for sub, all_ in q.unions:
-            other = self._run_single(sub, params)
+            other = self._run_single(sub, params, start_rows)
             if other.columns != result.columns:
                 raise CypherSyntaxError("UNION queries must return the same columns")
             result.rows.extend(other.rows)
@@ -162,8 +167,15 @@ class CypherExecutor:
                 result.rows = unique
         return result
 
-    def _run_single(self, q: ast.Query, params: dict[str, Any]) -> Result:
-        rows: list[dict[str, Any]] = [{}]
+    def _run_single(
+        self,
+        q: ast.Query,
+        params: dict[str, Any],
+        start_rows: Optional[list[dict]] = None,
+    ) -> Result:
+        rows: list[dict[str, Any]] = (
+            [dict(r) for r in start_rows] if start_rows is not None else [{}]
+        )
         stats = Stats()
         columns: list[str] = []
         out_rows: list[list[Any]] = []
@@ -555,11 +567,31 @@ class CypherExecutor:
                             lambda o=old_e: self.storage.create_edge(o)
                         )
                     elif isinstance(item, dict) and item.get("__path__"):
+                        # deleting a path deletes its relationships AND nodes
                         for e in item.get("relationships", []):
                             if e.id not in deleted_edges:
+                                old_e = self.storage.get_edge(e.id)
                                 self.storage.delete_edge(e.id)
                                 deleted_edges.add(e.id)
                                 stats.relationships_deleted += 1
+                                self._record_undo(
+                                    lambda o=old_e: self.storage.create_edge(o)
+                                )
+                        for pn in item.get("nodes", []):
+                            if pn.id in deleted_nodes:
+                                continue
+                            if self.storage.degree(pn.id) and not clause.detach:
+                                raise CypherTypeError(
+                                    "cannot delete node with relationships; "
+                                    "use DETACH DELETE"
+                                )
+                            old_n = self.storage.get_node(pn.id)
+                            self.storage.delete_node(pn.id)
+                            deleted_nodes.add(pn.id)
+                            stats.nodes_deleted += 1
+                            self._record_undo(
+                                lambda o=old_n: self.storage.create_node(o)
+                            )
                     else:
                         raise CypherTypeError("DELETE expects nodes/relationships")
         return rows
@@ -714,12 +746,24 @@ class CypherExecutor:
                     return 0.0
                 arr = np.asarray(values, np.float64)
                 return float(arr.std(ddof=1 if name == "stdev" else 0))
-            if name == "percentilecont":
-                raise CypherSyntaxError("percentileCont needs two args")
-        if isinstance(expr, ast.FunctionCall) and expr.name in (
-            "percentilecont", "percentiledisc",
-        ):
-            pass
+            if name in ("percentilecont", "percentiledisc"):
+                if len(expr.args) != 2:
+                    raise CypherSyntaxError(f"{name} expects (value, percentile)")
+                p = evaluate(
+                    expr.args[1],
+                    EvalContext(rows[0] if rows else {}, params, self),
+                )
+                if not values:
+                    return None
+                arr = np.sort(np.asarray(values, np.float64))
+                if name == "percentilecont":
+                    return float(np.quantile(arr, float(p)))
+                # nearest-rank (discrete)
+                idx = max(int(np.ceil(float(p) * len(arr))) - 1, 0)
+                v = arr[min(idx, len(arr) - 1)]
+                return int(v) if float(v).is_integer() and all(
+                    isinstance(x, int) for x in values
+                ) else float(v)
         # expression containing aggregates, e.g. count(x) + 1
         if isinstance(expr, ast.BinaryOp):
             left = (
@@ -803,21 +847,19 @@ class CypherExecutor:
 
     def _call_subquery(self, clause: ast.CallSubquery, rows, params, stats) -> list[dict]:
         out = []
+        returns = any(
+            isinstance(c, ast.ReturnClause) for c in clause.query.clauses
+        )
         for row in rows:
-            inner_rows = [dict(row)]
-            produced_return = False
-            for c in clause.query.clauses:
-                if isinstance(c, ast.ReturnClause):
-                    cols, data = self._project(c, inner_rows, params, stats)
-                    for r in data:
-                        nr = dict(row)
-                        nr.update(dict(zip(cols, r)))
-                        out.append(nr)
-                    produced_return = True
-                    break
-                inner_rows = self._apply_clause(c, inner_rows, params, stats)
-            if not produced_return:
+            # full query semantics per input row — including UNION branches
+            res = self._run_query(clause.query, params, start_rows=[row])
+            if not returns:
                 out.append(row)
+                continue
+            for r in res.rows:
+                nr = dict(row)
+                nr.update(dict(zip(res.columns, r)))
+                out.append(nr)
         return out
 
     def _foreach(self, clause: ast.ForeachClause, rows, params, stats) -> list[dict]:
@@ -995,6 +1037,9 @@ class CypherExecutor:
             mgr.create_database(stmt.name, if_not_exists=stmt.if_not_exists)
         elif stmt.op == "drop":
             mgr.drop_database(stmt.name, if_exists=stmt.if_exists)
+            invalidate = getattr(self.db, "invalidate_database_cache", None)
+            if callable(invalidate):
+                invalidate(stmt.name)
         elif stmt.op == "create_alias":
             mgr.create_alias(stmt.name, stmt.options["target"])
         elif stmt.op == "drop_alias":

@@ -1,0 +1,144 @@
+"""GDS-compatible procedures + Kalman Cypher functions.
+
+Behavioral reference: /root/reference/pkg/cypher/linkprediction.go
+(gds.linkPrediction.* procedures over pkg/linkpredict),
+kalman_functions.go:115-195 (kalman.* scalar functions),
+fastrp.go:361-652 (gds.fastRP.* node embeddings).
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import numpy as np
+
+from nornicdb_tpu.cypher.executor import CypherExecutor, procedure
+from nornicdb_tpu.cypher.functions import register
+from nornicdb_tpu.errors import CypherSyntaxError, CypherTypeError
+from nornicdb_tpu.filter.kalman import Kalman, KalmanConfig
+from nornicdb_tpu.linkpredict.topology import (
+    SCORERS,
+    build_graph,
+    score_pair,
+    top_candidates,
+)
+from nornicdb_tpu.storage.types import Node
+
+
+def _method_from_name(proc_name: str) -> str:
+    # gds.linkprediction.adamicadar -> adamicAdar
+    tail = proc_name.rsplit(".", 1)[-1]
+    for m in SCORERS:
+        if m.lower() == tail:
+            return m
+    raise CypherSyntaxError(f"unknown link prediction method {tail}")
+
+
+def _lp_pair(ex: CypherExecutor, args: list[Any], method: str):
+    if len(args) < 2:
+        raise CypherSyntaxError("expected (node1, node2)")
+    a, b = args[0], args[1]
+    a_id = a.id if isinstance(a, Node) else str(a)
+    b_id = b.id if isinstance(b, Node) else str(b)
+    g = build_graph(ex.storage)
+    return ["score"], [[score_pair(g, a_id, b_id, method)]]
+
+
+for _m in list(SCORERS):
+    def _make(meth):
+        def fn(ex, args, row):
+            return _lp_pair(ex, args, meth)
+
+        return fn
+
+    procedure(f"gds.linkprediction.{_m.lower()}")(_make(_m))
+
+
+@procedure("gds.linkprediction.suggest")
+def proc_lp_suggest(ex: CypherExecutor, args, row):
+    """Top non-adjacent candidate pairs (ref: linkprediction.go suggest)."""
+    method = str(args[0]) if args else "adamicAdar"
+    limit = int(args[1]) if len(args) > 1 else 20
+    g = build_graph(ex.storage)
+    rows = []
+    for a_id, b_id, score in top_candidates(g, method, limit):
+        na, nb = ex.get_node_or_none(a_id), ex.get_node_or_none(b_id)
+        if na is not None and nb is not None:
+            rows.append([na, nb, score])
+    return ["node1", "node2", "score"], rows
+
+
+@procedure("gds.fastrp.stream")
+def proc_fastrp(ex: CypherExecutor, args, row):
+    """FastRP node embeddings (ref: fastrp.go:361-652): iterative neighbor
+    averaging over random projections, here computed as adjacency matmuls."""
+    cfg = args[0] if args and isinstance(args[0], dict) else {}
+    dims = int(cfg.get("embeddingDimension", 128))
+    iterations = int(cfg.get("iterationWeights") and len(cfg["iterationWeights"]) or 3)
+    weights = cfg.get("iterationWeights") or [0.0, 1.0, 1.0][:iterations]
+    g = build_graph(ex.storage)
+    if g.n == 0:
+        return ["nodeId", "embedding"], []
+    rng = np.random.default_rng(int(cfg.get("randomSeed", 42)))
+    # sparse random projection init (+-1/sqrt(dims))
+    emb = rng.choice(
+        [-1.0, 0.0, 1.0], size=(g.n, dims), p=[1 / 6, 2 / 3, 1 / 6]
+    ).astype(np.float32) * np.sqrt(3.0 / dims)
+    a = np.zeros((g.n, g.n), np.float32)
+    for i, nbrs in enumerate(g.neighbors):
+        for j in nbrs:
+            a[i, j] = 1.0
+    deg = np.maximum(a.sum(axis=1, keepdims=True), 1.0)
+    a = a / deg  # row-normalized
+    out = np.zeros_like(emb)
+    curr = emb
+    for w in weights:
+        curr = a @ curr
+        norms = np.maximum(np.linalg.norm(curr, axis=1, keepdims=True), 1e-12)
+        curr = curr / norms
+        out += float(w) * curr
+    norms = np.maximum(np.linalg.norm(out, axis=1, keepdims=True), 1e-12)
+    out = out / norms
+    return (
+        ["nodeId", "embedding"],
+        [[g.ids[i], out[i].tolist()] for i in range(g.n)],
+    )
+
+
+# ---------------------------------------------------------------- kalman fns
+_KALMAN_STATES: dict[str, Kalman] = {}
+
+
+@register("kalman.filter")
+def fn_kalman_filter(key, measurement, process_noise=1e-3, measurement_noise=1e-1):
+    """Stateful named scalar filter (ref: kalman_functions.go:115-195)."""
+    if key is None or measurement is None:
+        return None
+    k = _KALMAN_STATES.get(str(key))
+    if k is None:
+        k = Kalman(KalmanConfig(float(process_noise), float(measurement_noise)))
+        _KALMAN_STATES[str(key)] = k
+    return k.process(float(measurement))
+
+
+@register("kalman.predict")
+def fn_kalman_predict(key):
+    k = _KALMAN_STATES.get(str(key))
+    return None if k is None else k.predict()
+
+
+@register("kalman.reset")
+def fn_kalman_reset(key):
+    _KALMAN_STATES.pop(str(key), None)
+    return True
+
+
+@register("kalman.smooth")
+def fn_kalman_smooth(values, process_noise=1e-3, measurement_noise=1e-1):
+    """Smooth a list of measurements in one call."""
+    if values is None:
+        return None
+    if not isinstance(values, list):
+        raise CypherTypeError("kalman.smooth expects a list")
+    k = Kalman(KalmanConfig(float(process_noise), float(measurement_noise)))
+    return [k.process(float(v)) for v in values]

@@ -60,7 +60,7 @@ class DB:
     def __init__(self, data_dir: str = "", config: Optional[Config] = None):
         self.config = config or Config()
         self.data_dir = data_dir
-        self.storage: Engine = open_storage(
+        self._base_storage: Engine = open_storage(
             data_dir,
             async_writes=self.config.async_writes,
             flush_interval=self.config.flush_interval,
@@ -68,6 +68,16 @@ class DB:
             auto_compact=self.config.auto_compact,
             auto_compact_interval=self.config.auto_compact_interval,
         )
+        # The default database is itself a namespace on the shared base
+        # engine, exactly like the reference's "nornic" namespace
+        # (ref: NamespacedEngine wrap, db.go:896) — so multi-database views
+        # never leak into default-DB scans.
+        from nornicdb_tpu.multidb import DEFAULT_DB
+        from nornicdb_tpu.storage import NamespacedEngine
+
+        self.default_database = DEFAULT_DB
+        self._migrate_unprefixed(self._base_storage, DEFAULT_DB)
+        self.storage: Engine = NamespacedEngine(self._base_storage, DEFAULT_DB)
         self.schema = SchemaManager()
         self.schema.attach(self.storage)
         self._lock = threading.RLock()
@@ -78,7 +88,43 @@ class DB:
         self._search = None
         self._decay = None
         self._inference = None
+        self._temporal = None
         self._executor = None
+        self._dbmanager = None
+        self._db_executors: dict[str, Any] = {}
+
+    @staticmethod
+    def _migrate_unprefixed(base: Engine, namespace: str) -> None:
+        """Re-key data persisted before namespacing (bare uuid ids) into the
+        default namespace so old data dirs keep working."""
+        stale_nodes = [n for n in base.all_nodes() if ":" not in n.id]
+        if not stale_nodes:
+            return
+        stale_edges = [e for e in base.all_edges() if ":" not in e.id]
+        pending = set(base.pending_embed_ids())
+        for e in stale_edges:
+            base.delete_edge(e.id)
+        for n in stale_nodes:
+            base.delete_node(n.id)
+        for n in stale_nodes:
+            migrated = n.copy()
+            migrated.id = f"{namespace}:{n.id}"
+            base.create_node(migrated)
+            if n.id in pending:
+                base.mark_pending_embed(migrated.id)
+        for e in stale_edges:
+            migrated = e.copy()
+            migrated.id = f"{namespace}:{e.id}"
+            if ":" not in migrated.start_node:
+                migrated.start_node = f"{namespace}:{migrated.start_node}"
+            if ":" not in migrated.end_node:
+                migrated.end_node = f"{namespace}:{migrated.end_node}"
+            base.create_edge(migrated)
+
+    def invalidate_database_cache(self, name: str) -> None:
+        """Drop the cached per-DB executor after DROP DATABASE / limit changes."""
+        with self._lock:
+            self._db_executors.pop(name, None)
 
     # -- subsystem wiring --------------------------------------------------
     def set_embedder(self, embedder) -> None:
@@ -93,7 +139,9 @@ class DB:
             from nornicdb_tpu.embed.queue import EmbedWorker, EmbedWorkerConfig
 
             self._embed_worker = EmbedWorker(
-                self.storage,
+                # the worker drains the BASE engine so pending nodes from
+                # every database namespace get embedded, not just the default
+                self._base_storage,
                 embedder,
                 EmbedWorkerConfig(
                     chunk_tokens=self.config.embed_chunk_tokens,
@@ -103,8 +151,20 @@ class DB:
                 # debounced k-means refit after bulk embedding
                 # (ref: scheduleClusteringDebounced embed_queue.go:257)
                 on_cluster_trigger=lambda: self.search.recluster(),
+                # the learning loop: freshly-embedded nodes feed auto-TLP
+                # (ref: SURVEY.md §3.3 embed -> inference.OnStore)
+                on_embedded=self._on_embedded,
             )
             self._embed_worker.start()
+
+    def _on_embedded(self, node) -> None:
+        # node comes from the base engine with a namespaced id; auto-TLP
+        # currently runs over the default database only
+        prefix = f"{self.default_database}:"
+        if self.config.inference_enabled and node.id.startswith(prefix):
+            bare = node.copy()
+            bare.id = node.id[len(prefix):]
+            self.inference.on_store(bare)
 
     @property
     def embedder(self):
@@ -159,10 +219,44 @@ class DB:
             )
         return self._inference
 
+    @property
+    def database_manager(self):
+        """(ref: multidb.NewDatabaseManager cmd/nornicdb/main.go:501)"""
+        with self._lock:
+            if self._dbmanager is None:
+                from nornicdb_tpu.multidb import DatabaseManager
+
+                self._dbmanager = DatabaseManager(self._base_storage)
+            return self._dbmanager
+
+    def executor_for(self, database: str):
+        """Per-database Cypher executor over the namespaced engine
+        (ref: :USE handling executor.go:500-541)."""
+        if self.database_manager.resolve(database) == self.default_database:
+            return self.executor
+        with self._lock:
+            ex = self._db_executors.get(database)
+            if ex is None:
+                from nornicdb_tpu.cypher.executor import CypherExecutor
+                from nornicdb_tpu.storage import SchemaManager
+
+                storage = self.database_manager.get_storage(database)
+                schema = SchemaManager()
+                schema.attach(storage)
+                ex = CypherExecutor(storage, schema=schema, db=self)
+                self._db_executors[database] = ex
+            return ex
+
+    @property
+    def temporal(self):
+        if self._temporal is None:
+            from nornicdb_tpu.temporal.tracker import TemporalTracker
+
+            self._temporal = TemporalTracker()
+        return self._temporal
+
     def _similarity_candidates(self, embedding, k: int = 10):
-        if self._search is None:
-            return []
-        return self._search.vector_candidates(embedding, k=k)
+        return self.search.vector_candidates(embedding, k=k)
 
     # -- memory-centric API (ref: db.go:1365-1776) --------------------------
     def store(
@@ -200,18 +294,17 @@ class DB:
     def remember(self, node_id: str) -> Node:
         """Fetch + reinforce a memory (ref: Remember db.go)."""
         node = self.touch(node_id)
-        if self.config.inference_enabled and self._inference is not None:
-            self._inference.on_access(node_id)
+        if self.config.inference_enabled:
+            self.inference.on_access(node_id)
         return node
 
     def touch(self, node_id: str) -> Node:
         """Record an access: bump access_count + last_accessed."""
-        try:
-            node = self.storage.get_node(node_id)
-        except NotFoundError:
-            raise
+        node = self.storage.get_node(node_id)
         node.access_count += 1
         node.last_accessed = time.time()
+        if self._temporal is not None:
+            self._temporal.record_access(node_id)
         return self.storage.update_node(node)
 
     def link(
@@ -284,7 +377,7 @@ class DB:
             self._embed_worker.stop()
         if self._decay is not None:
             self._decay.stop()
-        self.storage.close()
+        self._base_storage.close()
 
     def __enter__(self) -> "DB":
         return self

@@ -1,0 +1,15 @@
+"""Memory decay (ref: /root/reference/pkg/decay/)."""
+
+from nornicdb_tpu.decay.decay import (
+    ARCHIVED_LABEL,
+    HALF_LIVES,
+    DecayConfig,
+    DecayManager,
+    DecayStats,
+    half_life,
+)
+
+__all__ = [
+    "ARCHIVED_LABEL", "HALF_LIVES", "DecayConfig", "DecayManager",
+    "DecayStats", "half_life",
+]

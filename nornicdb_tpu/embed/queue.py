@@ -100,12 +100,16 @@ class EmbedWorker:
         embedder: Embedder,
         config: Optional[EmbedWorkerConfig] = None,
         on_cluster_trigger: Optional[Callable[[], None]] = None,
+        on_embedded: Optional[Callable[[Node], None]] = None,
     ):
         self.storage = storage
         self.embedder = embedder
         self.config = config or EmbedWorkerConfig()
         self.stats = EmbedWorkerStats()
         self.on_cluster_trigger = on_cluster_trigger
+        # fired once per freshly-embedded node — the auto-TLP inference hook
+        # (ref: the learning loop SURVEY.md §3.3: embed -> OnStore)
+        self.on_embedded = on_embedded
         self._stop = threading.Event()
         self._threads: list[threading.Thread] = []
         self._since_cluster = 0
@@ -218,9 +222,14 @@ class EmbedWorker:
                     self.stats.chunked_nodes += 1
                     fresh.chunk_embeddings = [np.asarray(v, np.float32) for v in vecs]
                 fresh.embedding = np.asarray(emb, np.float32)
-                self.storage.update_node(fresh)
+                updated = self.storage.update_node(fresh)
                 self.storage.unmark_pending_embed(node.id)
                 processed += 1
+                if self.on_embedded is not None:
+                    try:
+                        self.on_embedded(updated)
+                    except Exception:
+                        pass
             except NotFoundError:
                 self.storage.unmark_pending_embed(node.id)
         self.stats.processed += processed

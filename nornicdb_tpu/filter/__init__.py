@@ -1,0 +1,17 @@
+"""Kalman filter library (ref: /root/reference/pkg/filter/)."""
+
+from nornicdb_tpu.filter.kalman import (
+    CO_ACCESS,
+    DECAY_PREDICTION,
+    LATENCY,
+    AdaptiveKalman,
+    Kalman,
+    KalmanConfig,
+    VelocityKalman,
+    process_if_enabled,
+)
+
+__all__ = [
+    "CO_ACCESS", "DECAY_PREDICTION", "LATENCY", "AdaptiveKalman",
+    "Kalman", "KalmanConfig", "VelocityKalman", "process_if_enabled",
+]

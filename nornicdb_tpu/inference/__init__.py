@@ -1,0 +1,15 @@
+"""Automatic relationship inference (ref: /root/reference/pkg/inference/)."""
+
+from nornicdb_tpu.inference.engine import (
+    CO_ACCESSED,
+    RELATED_TO,
+    SIMILAR_TO,
+    InferenceConfig,
+    InferenceEngine,
+    InferenceStats,
+)
+
+__all__ = [
+    "CO_ACCESSED", "RELATED_TO", "SIMILAR_TO", "InferenceConfig",
+    "InferenceEngine", "InferenceStats",
+]

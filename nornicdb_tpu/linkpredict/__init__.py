@@ -1,0 +1,17 @@
+"""GDS-compatible link prediction (ref: /root/reference/pkg/linkpredict/)."""
+
+from nornicdb_tpu.linkpredict.topology import (
+    SCORERS,
+    Graph,
+    HybridConfig,
+    batch_scores,
+    build_graph,
+    hybrid_score,
+    score_pair,
+    top_candidates,
+)
+
+__all__ = [
+    "SCORERS", "Graph", "HybridConfig", "batch_scores", "build_graph",
+    "hybrid_score", "score_pair", "top_candidates",
+]

@@ -1,0 +1,15 @@
+"""Multi-database support (ref: /root/reference/pkg/multidb/)."""
+
+from nornicdb_tpu.multidb.manager import (
+    DEFAULT_DB,
+    SYSTEM_DB,
+    CompositeEngine,
+    DatabaseLimits,
+    DatabaseManager,
+    LimitedEngine,
+)
+
+__all__ = [
+    "DEFAULT_DB", "SYSTEM_DB", "CompositeEngine", "DatabaseLimits",
+    "DatabaseManager", "LimitedEngine",
+]

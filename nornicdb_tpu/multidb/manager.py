@@ -1,0 +1,340 @@
+"""Multi-database management over one shared storage engine.
+
+Behavioral reference: /root/reference/pkg/multidb/manager.go:43 —
+DatabaseManager (CreateDatabase :275, GetStorage :356), ID namespacing
+"<db>:<id>" via NamespacedEngine, the reserved "system" DB, aliases,
+composite (federated) databases (composite.go:56-253, routing.go:13),
+per-DB resource limits (limits.go, enforcement.go), metadata persisted in
+the system DB (metadata.go).
+"""
+
+from __future__ import annotations
+
+import threading
+from dataclasses import dataclass, field
+from typing import Iterator, Optional
+
+from nornicdb_tpu.errors import AlreadyExistsError, NornicError, NotFoundError
+from nornicdb_tpu.storage.namespaced import NamespacedEngine
+from nornicdb_tpu.storage.types import Edge, Engine, Node
+
+SYSTEM_DB = "system"
+DEFAULT_DB = "neo4j"
+_META_LABEL = "_Database"
+_ALIAS_LABEL = "_Alias"
+
+
+@dataclass
+class DatabaseLimits:
+    """(ref: limits.go)"""
+
+    max_nodes: int = 0  # 0 = unlimited
+    max_edges: int = 0
+
+
+class LimitedEngine(NamespacedEngine):
+    """Namespaced engine with per-DB resource enforcement
+    (ref: enforcement.go)."""
+
+    def __init__(self, base: Engine, namespace: str, limits: DatabaseLimits):
+        super().__init__(base, namespace)
+        self.limits = limits
+
+    def create_node(self, node: Node) -> Node:
+        if self.limits.max_nodes and self.node_count() >= self.limits.max_nodes:
+            raise NornicError(
+                f"database {self.namespace} node limit reached ({self.limits.max_nodes})"
+            )
+        return super().create_node(node)
+
+    def create_edge(self, edge: Edge) -> Edge:
+        if self.limits.max_edges and self.edge_count() >= self.limits.max_edges:
+            raise NornicError(
+                f"database {self.namespace} edge limit reached ({self.limits.max_edges})"
+            )
+        return super().create_edge(edge)
+
+
+class CompositeEngine(Engine):
+    """Read-only federated view over constituent databases
+    (ref: pkg/storage/composite_engine.go, pkg/multidb/composite.go)."""
+
+    def __init__(self, constituents: dict[str, Engine]):
+        super().__init__()
+        self.constituents = constituents
+
+    def _no_write(self, *a, **k):
+        raise NornicError("composite databases are read-only")
+
+    create_node = _no_write
+    update_node = _no_write
+    delete_node = _no_write
+    create_edge = _no_write
+    update_edge = _no_write
+    delete_edge = _no_write
+    mark_pending_embed = _no_write
+    unmark_pending_embed = _no_write
+
+    def _qualify(self, name: str, entity):
+        out = entity.copy()
+        out.id = f"{name}.{entity.id}"
+        if isinstance(out, Edge):
+            out.start_node = f"{name}.{entity.start_node}"
+            out.end_node = f"{name}.{entity.end_node}"
+        return out
+
+    def _route(self, qualified_id: str) -> tuple[Engine, str]:
+        """(ref: routing.go:13 — constituent routing by id prefix)"""
+        if "." in qualified_id:
+            db, bare = qualified_id.split(".", 1)
+            eng = self.constituents.get(db)
+            if eng is not None:
+                return eng, bare
+        raise NotFoundError(f"id {qualified_id} not found in composite")
+
+    def get_node(self, node_id: str) -> Node:
+        eng, bare = self._route(node_id)
+        db = node_id.split(".", 1)[0]
+        return self._qualify(db, eng.get_node(bare))
+
+    def get_edge(self, edge_id: str) -> Edge:
+        eng, bare = self._route(edge_id)
+        db = edge_id.split(".", 1)[0]
+        return self._qualify(db, eng.get_edge(bare))
+
+    def get_nodes_by_label(self, label: str) -> list[Node]:
+        out = []
+        for name, eng in self.constituents.items():
+            out.extend(self._qualify(name, n) for n in eng.get_nodes_by_label(label))
+        return out
+
+    def all_nodes(self) -> Iterator[Node]:
+        for name, eng in self.constituents.items():
+            for n in eng.all_nodes():
+                yield self._qualify(name, n)
+
+    def all_edges(self) -> Iterator[Edge]:
+        for name, eng in self.constituents.items():
+            for e in eng.all_edges():
+                yield self._qualify(name, e)
+
+    def get_edges_by_type(self, edge_type: str) -> list[Edge]:
+        out = []
+        for name, eng in self.constituents.items():
+            out.extend(self._qualify(name, e) for e in eng.get_edges_by_type(edge_type))
+        return out
+
+    def get_outgoing_edges(self, node_id: str) -> list[Edge]:
+        eng, bare = self._route(node_id)
+        db = node_id.split(".", 1)[0]
+        return [self._qualify(db, e) for e in eng.get_outgoing_edges(bare)]
+
+    def get_incoming_edges(self, node_id: str) -> list[Edge]:
+        eng, bare = self._route(node_id)
+        db = node_id.split(".", 1)[0]
+        return [self._qualify(db, e) for e in eng.get_incoming_edges(bare)]
+
+    def node_count(self) -> int:
+        return sum(e.node_count() for e in self.constituents.values())
+
+    def edge_count(self) -> int:
+        return sum(e.edge_count() for e in self.constituents.values())
+
+    def pending_embed_ids(self, limit: int = 0) -> list[str]:
+        return []
+
+
+class DatabaseManager:
+    """(ref: multidb.DatabaseManager manager.go:43)"""
+
+    def __init__(self, base: Engine, default_database: str = DEFAULT_DB):
+        self.base = base
+        self.default_database = default_database
+        self._lock = threading.RLock()
+        self._limits: dict[str, DatabaseLimits] = {}
+        self._composites: dict[str, list[str]] = {}
+        self._engines: dict[str, Engine] = {}
+        self._system = NamespacedEngine(base, SYSTEM_DB)
+        self._load_metadata()
+        # implicit databases
+        for name in (SYSTEM_DB, default_database):
+            if name not in self._databases:
+                self._databases.add(name)
+                self._persist_db(name)
+
+    # -- metadata (persisted as nodes in the system DB, ref: metadata.go) ----
+    def _load_metadata(self) -> None:
+        self._databases: set[str] = set()
+        self._aliases: dict[str, str] = {}
+        for n in self._system.get_nodes_by_label(_META_LABEL):
+            self._databases.add(n.properties["name"])
+            if n.properties.get("composite"):
+                self._composites[n.properties["name"]] = list(
+                    n.properties.get("constituents", [])
+                )
+        for n in self._system.get_nodes_by_label(_ALIAS_LABEL):
+            self._aliases[n.properties["alias"]] = n.properties["target"]
+
+    def _persist_db(self, name: str, composite: Optional[list[str]] = None) -> None:
+        props = {"name": name}
+        if composite is not None:
+            props["composite"] = True
+            props["constituents"] = composite
+        self._system.create_node(
+            Node(id=f"db-{name}", labels=[_META_LABEL], properties=props)
+        )
+
+    # -- database lifecycle ----------------------------------------------------
+    def create_database(self, name: str, if_not_exists: bool = False,
+                        limits: Optional[DatabaseLimits] = None) -> None:
+        """(ref: CreateDatabase manager.go:275)"""
+        with self._lock:
+            if name in self._databases or name in self._aliases:
+                if if_not_exists:
+                    return
+                raise AlreadyExistsError(f"database {name} already exists")
+            self._databases.add(name)
+            if limits is not None:
+                self._limits[name] = limits
+            self._persist_db(name)
+
+    def drop_database(self, name: str, if_exists: bool = False) -> None:
+        with self._lock:
+            if name == SYSTEM_DB:
+                raise NornicError("cannot drop the system database")
+            if name not in self._databases:
+                if if_exists:
+                    return
+                raise NotFoundError(f"database {name} not found")
+            if name not in self._composites:
+                # delete all namespaced data; composites own no data — only
+                # metadata is removed for them (constituents are untouched)
+                eng = self.get_storage(name)
+                for e in list(eng.all_edges()):
+                    eng.delete_edge(e.id)
+                for n in list(eng.all_nodes()):
+                    eng.delete_node(n.id)
+            self._databases.discard(name)
+            self._engines.pop(name, None)
+            self._composites.pop(name, None)
+            try:
+                self._system.delete_node(f"db-{name}")
+            except NotFoundError:
+                pass
+            # drop aliases pointing at it
+            for alias, target in list(self._aliases.items()):
+                if target == name:
+                    self.drop_alias(alias)
+
+    def create_composite(self, name: str, constituents: Optional[list[str]] = None) -> None:
+        """(ref: composite.go:56-253)"""
+        with self._lock:
+            if name in self._databases:
+                raise AlreadyExistsError(f"database {name} already exists")
+            constituents = constituents or []
+            for c in constituents:
+                if c not in self._databases:
+                    raise NotFoundError(f"constituent database {c} not found")
+            self._databases.add(name)
+            self._composites[name] = constituents
+            self._persist_db(name, composite=constituents)
+
+    def add_constituent(self, composite: str, database: str) -> None:
+        with self._lock:
+            if composite not in self._composites:
+                raise NotFoundError(f"composite {composite} not found")
+            if database not in self._databases:
+                raise NotFoundError(f"database {database} not found")
+            if database not in self._composites[composite]:
+                self._composites[composite].append(database)
+                try:
+                    self._system.delete_node(f"db-{composite}")
+                except NotFoundError:
+                    pass
+                self._persist_db(composite, composite=self._composites[composite])
+                self._engines.pop(composite, None)
+
+    # -- aliases -------------------------------------------------------------------
+    def create_alias(self, alias: str, target: str) -> None:
+        with self._lock:
+            if alias in self._databases or alias in self._aliases:
+                raise AlreadyExistsError(f"name {alias} already in use")
+            if target not in self._databases:
+                raise NotFoundError(f"database {target} not found")
+            self._aliases[alias] = target
+            self._system.create_node(
+                Node(
+                    id=f"alias-{alias}",
+                    labels=[_ALIAS_LABEL],
+                    properties={"alias": alias, "target": target},
+                )
+            )
+
+    def drop_alias(self, alias: str) -> None:
+        with self._lock:
+            if self._aliases.pop(alias, None) is None:
+                raise NotFoundError(f"alias {alias} not found")
+            try:
+                self._system.delete_node(f"alias-{alias}")
+            except NotFoundError:
+                pass
+
+    def list_aliases(self) -> list[tuple[str, str]]:
+        with self._lock:
+            return sorted(self._aliases.items())
+
+    # -- resolution ---------------------------------------------------------------
+    def resolve(self, name: str) -> str:
+        with self._lock:
+            seen = set()
+            while name in self._aliases:
+                if name in seen:
+                    raise NornicError(f"alias cycle at {name}")
+                seen.add(name)
+                name = self._aliases[name]
+            return name
+
+    def list_databases(self) -> list[str]:
+        with self._lock:
+            return sorted(self._databases)
+
+    def exists(self, name: str) -> bool:
+        with self._lock:
+            return self.resolve(name) in self._databases
+
+    def get_storage(self, name: str) -> Engine:
+        """(ref: GetStorage manager.go:356)"""
+        with self._lock:
+            name = self.resolve(name)
+            if name not in self._databases:
+                raise NotFoundError(f"database {name} not found")
+            eng = self._engines.get(name)
+            if eng is None:
+                if name in self._composites:
+                    eng = CompositeEngine(
+                        {
+                            c: self.get_storage(c)
+                            for c in self._composites[name]
+                        }
+                    )
+                else:
+                    limits = self._limits.get(name)
+                    if limits is not None:
+                        eng = LimitedEngine(self.base, name, limits)
+                    else:
+                        eng = NamespacedEngine(self.base, name)
+                self._engines[name] = eng
+            return eng
+
+    def set_limits(self, name: str, limits: DatabaseLimits) -> None:
+        with self._lock:
+            self._limits[self.resolve(name)] = limits
+            self._engines.pop(self.resolve(name), None)
+
+    def storage_stats(self) -> dict[str, dict[str, int]]:
+        """(ref: storage-size accounting manager.go)"""
+        out = {}
+        for name in self.list_databases():
+            eng = self.get_storage(name)
+            out[name] = {"nodes": eng.node_count(), "edges": eng.edge_count()}
+        return out

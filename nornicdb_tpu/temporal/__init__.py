@@ -1,0 +1,10 @@
+"""Temporal access tracking (ref: /root/reference/pkg/temporal/)."""
+
+from nornicdb_tpu.temporal.tracker import (
+    AccessRecord,
+    SessionDetector,
+    TemporalTracker,
+    TrackerConfig,
+)
+
+__all__ = ["AccessRecord", "SessionDetector", "TemporalTracker", "TrackerConfig"]

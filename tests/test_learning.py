@@ -1,0 +1,351 @@
+"""Learning layer tests: Kalman, decay, temporal, linkpredict, inference
+(modeled on reference pkg/filter, pkg/decay, pkg/temporal, pkg/linkpredict,
+pkg/inference tests)."""
+
+import math
+
+import numpy as np
+import pytest
+
+from nornicdb_tpu.decay import ARCHIVED_LABEL, DecayConfig, DecayManager, half_life
+from nornicdb_tpu.filter import AdaptiveKalman, Kalman, KalmanConfig, VelocityKalman
+from nornicdb_tpu.inference import InferenceConfig, InferenceEngine, SIMILAR_TO
+from nornicdb_tpu.linkpredict import (
+    Graph,
+    batch_scores,
+    build_graph,
+    hybrid_score,
+    score_pair,
+    top_candidates,
+)
+from nornicdb_tpu.storage import Edge, MemoryEngine, Node
+from nornicdb_tpu.temporal import SessionDetector, TemporalTracker, TrackerConfig
+from nornicdb_tpu.temporal.tracker import AccessRecord
+
+
+class TestKalman:
+    def test_converges_to_constant(self):
+        k = Kalman(KalmanConfig(process_noise=1e-5, measurement_noise=0.5))
+        for _ in range(100):
+            est = k.process(10.0)
+        assert est == pytest.approx(10.0, abs=0.01)
+
+    def test_smooths_noise(self):
+        rng = np.random.default_rng(0)
+        k = Kalman(KalmanConfig(process_noise=1e-4, measurement_noise=1.0))
+        ests = [k.process(5.0 + rng.normal(0, 1)) for _ in range(200)]
+        assert abs(np.mean(ests[-50:]) - 5.0) < 0.3
+        assert np.std(ests[-50:]) < 0.3  # much less than measurement noise
+
+    def test_uncertainty_decreases(self):
+        k = Kalman()
+        k.process(1.0)
+        _, u1 = k.predict_with_uncertainty()
+        for _ in range(20):
+            k.process(1.0)
+        _, u2 = k.predict_with_uncertainty()
+        assert u2 < u1
+
+    def test_velocity_tracks_trend(self):
+        k = VelocityKalman(KalmanConfig(process_noise=1e-3, measurement_noise=0.1))
+        for t in range(50):
+            k.process(2.0 * t, float(t))
+        assert k.velocity == pytest.approx(2.0, abs=0.2)
+        assert k.predict_at(60.0) == pytest.approx(120.0, abs=3.0)
+
+    def test_adaptive_r_grows_with_noise(self):
+        ak = AdaptiveKalman(KalmanConfig(measurement_noise=0.01), alpha=0.5)
+        rng = np.random.default_rng(1)
+        for _ in range(100):
+            ak.process(rng.normal(0, 5.0))
+        assert ak.config.measurement_noise > 0.01
+
+    def test_reset(self):
+        k = Kalman()
+        k.process(9.0)
+        k.reset()
+        assert not k.initialized and k.updates == 0
+
+
+class TestDecay:
+    def _node(self, mtype, last=0.0, count=0, importance=0.5):
+        n = Node(memory_type=mtype, properties={"importance": importance})
+        n.last_accessed = last
+        n.access_count = count
+        return n
+
+    def test_half_lives(self):
+        assert half_life("episodic") == 7 * 86400
+        assert half_life("semantic") == 69 * 86400
+        assert half_life("procedural") == 693 * 86400
+        assert half_life("unknown") == 69 * 86400
+
+    def test_episodic_decays_faster(self):
+        eng = MemoryEngine()
+        mgr = DecayManager(eng, now_fn=lambda: 30 * 86400.0)  # day 30
+        epi = self._node("episodic")
+        sem = self._node("semantic")
+        assert mgr.calculate_score(epi) < mgr.calculate_score(sem)
+
+    def test_recency_halves_at_half_life(self):
+        eng = MemoryEngine()
+        cfg = DecayConfig(recency_weight=1.0, frequency_weight=0.0, importance_weight=0.0)
+        mgr = DecayManager(eng, config=cfg, now_fn=lambda: 7 * 86400.0)
+        n = self._node("episodic", last=0.0)
+        assert mgr.calculate_score(n) == pytest.approx(0.5, abs=1e-6)
+
+    def test_frequency_and_importance_contribute(self):
+        eng = MemoryEngine()
+        mgr = DecayManager(eng, now_fn=lambda: 1000.0)
+        low = self._node("semantic", last=1000.0, count=0, importance=0.0)
+        high = self._node("semantic", last=1000.0, count=20, importance=1.0)
+        assert mgr.calculate_score(high) > mgr.calculate_score(low)
+
+    def test_recalculate_archives(self):
+        eng = MemoryEngine()
+        now = [0.0]
+        mgr = DecayManager(eng, now_fn=lambda: now[0])
+        n = self._node("episodic", last=0.0, importance=0.0)
+        eng.create_node(n)
+        now[0] = 400 * 86400.0  # ~57 half-lives
+        scored, archived = mgr.recalculate_all()
+        assert (scored, archived) == (1, 1)
+        assert ARCHIVED_LABEL in eng.get_node(n.id).labels
+
+    def test_reinforce_boosts_and_resurrects(self):
+        eng = MemoryEngine()
+        mgr = DecayManager(eng, now_fn=lambda: 100.0)
+        n = Node(labels=[ARCHIVED_LABEL])
+        n.decay_score = 0.02
+        eng.create_node(n)
+        score = mgr.reinforce(n.id)
+        assert score > 0.02
+        assert ARCHIVED_LABEL not in eng.get_node(n.id).labels
+
+
+class TestTemporal:
+    def test_session_boundaries(self):
+        det = SessionDetector(gap=100.0)
+        det.observe(AccessRecord("a", 0.0))
+        det.observe(AccessRecord("b", 50.0))
+        assert det.observe(AccessRecord("c", 500.0))  # new session
+        assert len(det.sessions) == 1
+        assert len(det.sessions[0]) == 2
+
+    def test_co_access_within_window(self):
+        now = [0.0]
+        t = TemporalTracker(TrackerConfig(co_access_window=60.0), now_fn=lambda: now[0])
+        t.record_access("a")
+        now[0] = 10.0
+        t.record_access("b")
+        now[0] = 200.0
+        t.record_access("c")  # outside window of a/b
+        pairs = t.co_access_pairs(min_count=1)
+        assert pairs == [("a", "b", 1)]
+        assert t.co_accessed_with("a") == [("b", 1)]
+
+    def test_predict_next_access(self):
+        now = [0.0]
+        t = TemporalTracker(now_fn=lambda: now[0])
+        for i in range(6):
+            now[0] = i * 10.0
+            t.record_access("x")
+        pred = t.predict_next_access("x")
+        assert pred == pytest.approx(60.0, abs=5.0)
+
+    def test_access_count_ring(self):
+        t = TemporalTracker(TrackerConfig(history_size=4))
+        for i in range(10):
+            t.record_access("x", ts=float(i))
+        assert t.access_count("x") == 4
+        assert t.last_access("x") == 9.0
+
+
+def _chain_graph():
+    """a-b, b-c, a-d, c-d : common neighbors etc."""
+    eng = MemoryEngine()
+    for i in "abcd":
+        eng.create_node(Node(id=i))
+    eng.create_edge(Edge(id="e1", start_node="a", end_node="b"))
+    eng.create_edge(Edge(id="e2", start_node="b", end_node="c"))
+    eng.create_edge(Edge(id="e3", start_node="a", end_node="d"))
+    eng.create_edge(Edge(id="e4", start_node="c", end_node="d"))
+    return eng
+
+
+class TestLinkPredict:
+    def test_pair_scorers(self):
+        g = build_graph(_chain_graph())
+        # a and c share neighbors b and d
+        assert score_pair(g, "a", "c", "commonNeighbors") == 2.0
+        assert score_pair(g, "a", "c", "jaccard") == pytest.approx(1.0)
+        assert score_pair(g, "a", "c", "adamicAdar") == pytest.approx(
+            2.0 / math.log(2), rel=1e-6
+        )
+        assert score_pair(g, "a", "c", "preferentialAttachment") == 4.0
+        assert score_pair(g, "a", "c", "resourceAllocation") == pytest.approx(1.0)
+
+    def test_batch_matches_pairwise(self):
+        g = build_graph(_chain_graph())
+        for method in ("commonNeighbors", "jaccard", "adamicAdar",
+                       "preferentialAttachment", "resourceAllocation"):
+            s = batch_scores(g, method, use_device=False)
+            for a in "abcd":
+                for b in "abcd":
+                    if a == b:
+                        continue
+                    want = score_pair(g, a, b, method)
+                    got = s[g.index[a], g.index[b]]
+                    assert got == pytest.approx(want, rel=1e-5), (method, a, b)
+
+    def test_top_candidates_excludes_existing(self):
+        g = build_graph(_chain_graph())
+        cands = top_candidates(g, "commonNeighbors", limit=10)
+        pairs = {(a, b) for a, b, _ in cands}
+        assert ("a", "b") not in pairs  # existing edge
+        assert ("a", "c") in pairs or ("b", "d") in pairs
+
+    def test_hybrid_blend(self):
+        g = build_graph(_chain_graph())
+        ea = np.array([1.0, 0.0], np.float32)
+        ec = np.array([1.0, 0.0], np.float32)
+        full = hybrid_score(g, "a", "c", ea, ec)
+        topo_only = hybrid_score(g, "a", "c", None, None)
+        assert full > topo_only  # perfect semantic agreement lifts the score
+
+
+class TestInference:
+    def _engine(self, eng, sims=None, **cfg):
+        config = InferenceConfig(**cfg) if cfg else InferenceConfig(min_evidence=2)
+        return InferenceEngine(
+            eng, similarity_fn=(lambda v, k: sims or []), config=config,
+            now_fn=lambda: self._now[0],
+        )
+
+    def setup_method(self):
+        self._now = [1000.0]
+
+    def test_similarity_creates_edge_after_evidence(self):
+        eng = MemoryEngine()
+        a = eng.create_node(Node(id="a", embedding=np.ones(4, np.float32)))
+        eng.create_node(Node(id="b"))
+        inf = self._engine(eng, sims=[("b", 0.95)], min_evidence=2, cooldown=0.0)
+        assert inf.on_store(a) == []  # first observation: evidence only
+        edges = inf.on_store(a)  # second observation: edge created
+        assert len(edges) == 1
+        e = edges[0]
+        assert e.type == SIMILAR_TO and e.auto_generated
+        assert e.confidence == pytest.approx(0.95, abs=1e-3)
+
+    def test_below_threshold_ignored(self):
+        eng = MemoryEngine()
+        a = eng.create_node(Node(id="a", embedding=np.ones(4, np.float32)))
+        eng.create_node(Node(id="b"))
+        inf = self._engine(eng, sims=[("b", 0.5)])
+        assert inf.on_store(a) == []
+        assert inf.on_store(a) == []
+        assert eng.edge_count() == 0
+
+    def test_cooldown_suppresses(self):
+        eng = MemoryEngine()
+        a = eng.create_node(Node(id="a", embedding=np.ones(4, np.float32)))
+        eng.create_node(Node(id="b"))
+        inf = self._engine(eng, sims=[("b", 0.9)], min_evidence=1, cooldown=100.0)
+        assert len(inf.on_store(a)) == 1
+        eng.delete_edge(list(eng.all_edges())[0].id)
+        assert inf.on_store(a) == []  # in cooldown
+        assert inf.stats.suppressed_cooldown >= 1
+        self._now[0] += 200.0
+        assert len(inf.on_store(a)) == 1  # cooldown expired
+
+    def test_existing_edge_not_duplicated(self):
+        eng = MemoryEngine()
+        a = eng.create_node(Node(id="a", embedding=np.ones(4, np.float32)))
+        eng.create_node(Node(id="b"))
+        eng.create_edge(Edge(start_node="a", end_node="b", type=SIMILAR_TO))
+        inf = self._engine(eng, sims=[("b", 0.9)], min_evidence=1, cooldown=0.0)
+        assert inf.on_store(a) == []
+        assert inf.stats.suppressed_existing == 1
+
+    def test_co_access_suggestion(self):
+        eng = MemoryEngine()
+        eng.create_node(Node(id="x"))
+        eng.create_node(Node(id="y"))
+        inf = self._engine(eng, min_evidence=1, co_access_min=2, cooldown=0.0)
+        for _ in range(3):
+            inf.on_access("x")
+            inf.on_access("y")
+        edges = [e for e in eng.all_edges() if e.type == "CO_ACCESSED_WITH"]
+        assert len(edges) == 1
+
+    def test_transitive_suggestion(self):
+        eng = MemoryEngine()
+        for i in "abc":
+            eng.create_node(Node(id=i))
+        eng.create_edge(Edge(start_node="a", end_node="b", confidence=1.0))
+        eng.create_edge(Edge(start_node="b", end_node="c", confidence=1.0))
+        inf = self._engine(eng, min_evidence=1, cooldown=0.0)
+        created = inf.suggest_transitive("a")
+        assert len(created) == 1
+        assert created[0].start_node == "a" and created[0].end_node == "c"
+
+    def test_decay_inferred_edges(self):
+        eng = MemoryEngine()
+        eng.create_node(Node(id="a"))
+        eng.create_node(Node(id="b"))
+        eng.create_edge(
+            Edge(start_node="a", end_node="b", auto_generated=True, confidence=0.05)
+        )
+        eng.create_edge(Edge(start_node="a", end_node="b", confidence=0.05))
+        inf = self._engine(eng)
+        assert inf.decay_inferred_edges(min_confidence=0.1) == 1
+        assert eng.edge_count() == 1  # manual edge untouched
+
+
+class TestGdsProcedures:
+    def test_linkprediction_procs(self):
+        from nornicdb_tpu.cypher import CypherExecutor
+
+        eng = _chain_graph()
+        ex = CypherExecutor(eng)
+        r = ex.execute(
+            "MATCH (a {}), (c {}) WHERE id(a) = 'a' AND id(c) = 'c' "
+            "CALL gds.linkPrediction.commonNeighbors(a, c) YIELD score RETURN score"
+        )
+        assert r.rows == [[2.0]]
+
+    def test_lp_suggest(self):
+        from nornicdb_tpu.cypher import CypherExecutor
+
+        ex = CypherExecutor(_chain_graph())
+        r = ex.execute(
+            "CALL gds.linkPrediction.suggest('commonNeighbors', 5) "
+            "YIELD node1, node2, score RETURN id(node1), id(node2), score"
+        )
+        assert len(r.rows) >= 1
+        assert r.rows[0][2] > 0
+
+    def test_fastrp(self):
+        from nornicdb_tpu.cypher import CypherExecutor
+
+        ex = CypherExecutor(_chain_graph())
+        r = ex.execute(
+            "CALL gds.fastRP.stream({embeddingDimension: 16}) "
+            "YIELD nodeId, embedding RETURN nodeId, size(embedding)"
+        )
+        assert len(r.rows) == 4
+        assert all(row[1] == 16 for row in r.rows)
+
+    def test_kalman_functions(self):
+        from nornicdb_tpu.cypher import CypherExecutor
+        from nornicdb_tpu.storage import MemoryEngine
+
+        ex = CypherExecutor(MemoryEngine())
+        r = ex.execute(
+            "UNWIND [10.0, 10.0, 10.0] AS m "
+            "RETURN kalman.filter('test-k', m) AS est"
+        )
+        assert r.rows[-1][0] == pytest.approx(10.0, abs=0.5)
+        r = ex.execute("RETURN kalman.smooth([1.0, 1.0, 1.0]) AS s")
+        assert len(r.rows[0][0]) == 3
+        ex.execute("RETURN kalman.reset('test-k')")

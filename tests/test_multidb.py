@@ -1,0 +1,131 @@
+"""Multi-database tests (ref: pkg/multidb tests, pkg/server/multi_database_e2e_test.go)."""
+
+import pytest
+
+import nornicdb_tpu
+from nornicdb_tpu.errors import AlreadyExistsError, NornicError, NotFoundError
+from nornicdb_tpu.multidb import DatabaseLimits, DatabaseManager, SYSTEM_DB
+from nornicdb_tpu.storage import Edge, MemoryEngine, Node
+
+
+class TestDatabaseManager:
+    def test_implicit_databases(self):
+        mgr = DatabaseManager(MemoryEngine())
+        assert set(mgr.list_databases()) >= {SYSTEM_DB, "neo4j"}
+
+    def test_create_drop(self):
+        mgr = DatabaseManager(MemoryEngine())
+        mgr.create_database("sales")
+        assert "sales" in mgr.list_databases()
+        with pytest.raises(AlreadyExistsError):
+            mgr.create_database("sales")
+        mgr.create_database("sales", if_not_exists=True)  # no raise
+        mgr.drop_database("sales")
+        assert "sales" not in mgr.list_databases()
+        with pytest.raises(NotFoundError):
+            mgr.drop_database("sales")
+        mgr.drop_database("sales", if_exists=True)
+
+    def test_cannot_drop_system(self):
+        mgr = DatabaseManager(MemoryEngine())
+        with pytest.raises(NornicError):
+            mgr.drop_database(SYSTEM_DB)
+
+    def test_isolation(self):
+        mgr = DatabaseManager(MemoryEngine())
+        mgr.create_database("a")
+        mgr.create_database("b")
+        sa, sb = mgr.get_storage("a"), mgr.get_storage("b")
+        sa.create_node(Node(id="x", labels=["T"]))
+        assert sa.node_count() == 1
+        assert sb.node_count() == 0
+        with pytest.raises(NotFoundError):
+            sb.get_node("x")
+
+    def test_drop_deletes_data(self):
+        base = MemoryEngine()
+        mgr = DatabaseManager(base)
+        mgr.create_database("tmp")
+        s = mgr.get_storage("tmp")
+        s.create_node(Node(id="n1"))
+        s.create_node(Node(id="n2"))
+        s.create_edge(Edge(id="e", start_node="n1", end_node="n2"))
+        mgr.drop_database("tmp")
+        assert all(not n.id.startswith("tmp:") for n in base.all_nodes())
+
+    def test_aliases(self):
+        mgr = DatabaseManager(MemoryEngine())
+        mgr.create_database("prod")
+        mgr.create_alias("main", "prod")
+        assert mgr.resolve("main") == "prod"
+        s = mgr.get_storage("main")
+        s.create_node(Node(id="via-alias"))
+        assert mgr.get_storage("prod").get_node("via-alias")
+        assert mgr.list_aliases() == [("main", "prod")]
+        mgr.drop_alias("main")
+        with pytest.raises(NotFoundError):
+            mgr.get_storage("main")
+
+    def test_metadata_persists(self):
+        base = MemoryEngine()
+        mgr = DatabaseManager(base)
+        mgr.create_database("persisted")
+        mgr.create_alias("p", "persisted")
+        mgr2 = DatabaseManager(base)  # fresh manager, same storage
+        assert "persisted" in mgr2.list_databases()
+        assert mgr2.resolve("p") == "persisted"
+
+    def test_limits_enforced(self):
+        mgr = DatabaseManager(MemoryEngine())
+        mgr.create_database("small", limits=DatabaseLimits(max_nodes=2))
+        s = mgr.get_storage("small")
+        s.create_node(Node(id="1"))
+        s.create_node(Node(id="2"))
+        with pytest.raises(NornicError):
+            s.create_node(Node(id="3"))
+
+    def test_composite_federation(self):
+        mgr = DatabaseManager(MemoryEngine())
+        mgr.create_database("east")
+        mgr.create_database("west")
+        mgr.get_storage("east").create_node(Node(id="e1", labels=["City"]))
+        mgr.get_storage("west").create_node(Node(id="w1", labels=["City"]))
+        mgr.create_composite("world", ["east", "west"])
+        comp = mgr.get_storage("world")
+        assert comp.node_count() == 2
+        labels = {n.id for n in comp.get_nodes_by_label("City")}
+        assert labels == {"east.e1", "west.w1"}
+        # routing by qualified id
+        assert comp.get_node("east.e1").id == "east.e1"
+        with pytest.raises(NornicError):
+            comp.create_node(Node(id="nope"))
+
+    def test_storage_stats(self):
+        mgr = DatabaseManager(MemoryEngine())
+        mgr.create_database("s1")
+        mgr.get_storage("s1").create_node(Node(id="a"))
+        stats = mgr.storage_stats()
+        assert stats["s1"] == {"nodes": 1, "edges": 0}
+
+
+class TestCypherMultidb:
+    def test_create_show_use_drop(self):
+        db = nornicdb_tpu.open_db("")
+        db.cypher("CREATE DATABASE hr")
+        r = db.cypher("SHOW DATABASES")
+        names = [row[0] for row in r.rows]
+        assert "hr" in names and "system" in names
+        db.cypher("USE hr CREATE (:Emp {name: 'Ann'})")
+        r = db.cypher("USE hr MATCH (e:Emp) RETURN e.name")
+        assert r.rows == [["Ann"]]
+        # default DB unaffected
+        r = db.cypher("MATCH (e:Emp) RETURN count(e)")
+        assert r.rows == [[0]]
+        db.cypher("CREATE ALIAS people FOR DATABASE hr")
+        r = db.cypher("USE people MATCH (e:Emp) RETURN count(e)")
+        assert r.rows == [[1]]
+        db.cypher("DROP ALIAS people")
+        db.cypher("DROP DATABASE hr")
+        r = db.cypher("SHOW DATABASES")
+        assert "hr" not in [row[0] for row in r.rows]
+        db.close()
