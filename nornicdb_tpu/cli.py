@@ -1,0 +1,205 @@
+"""Command-line interface.
+
+Behavioral reference: /root/reference/cmd/nornicdb/main.go:71-208 — cobra
+commands serve / init / import / shell / decay {recalculate,archive,stats};
+runServe wiring (:210-649): config -> DB -> embedder -> auth -> HTTP + Bolt
+servers -> signal handling.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import signal
+import sys
+import time
+
+
+def _open_db(args):
+    import nornicdb_tpu
+    from nornicdb_tpu.db import Config
+
+    cfg = Config()
+    return nornicdb_tpu.open_db(args.data_dir, cfg)
+
+
+def cmd_serve(args) -> int:
+    """(ref: runServe main.go:210)"""
+    from nornicdb_tpu.auth import Authenticator, ROLE_ADMIN
+    from nornicdb_tpu.embed import CachedEmbedder, HashEmbedder, TPUEmbedder
+    from nornicdb_tpu.multidb import SYSTEM_DB
+    from nornicdb_tpu.server import BoltServer, HttpServer
+
+    db = _open_db(args)
+    # embedder: TPU bge-m3 when requested, hash fallback otherwise
+    if args.embedder == "tpu":
+        from nornicdb_tpu.models import bge_m3
+
+        cfg_name = getattr(bge_m3, args.model_preset.upper().replace("-", "_"))
+        embedder = TPUEmbedder(cfg=cfg_name)
+    else:
+        embedder = HashEmbedder(args.embed_dims)
+    db.set_embedder(CachedEmbedder(embedder))
+
+    authenticator = None
+    if args.auth:
+        system = db.database_manager.get_storage(SYSTEM_DB)
+        authenticator = Authenticator(system)
+        try:
+            authenticator.create_user(
+                "admin", os.environ.get("NORNICDB_ADMIN_PASSWORD", "admin"),
+                ROLE_ADMIN,
+            )
+        except Exception:
+            pass  # exists from a previous run
+
+    http_server = HttpServer(
+        db, host=args.host, port=args.http_port,
+        authenticator=authenticator, auth_required=args.auth,
+    )
+    http_server.start()
+    bolt_server = BoltServer(
+        lambda q, p, d: (db.executor_for(d) if d else db.executor).execute(q, p),
+        host=args.host, port=args.bolt_port,
+        authenticator=authenticator, auth_required=args.auth,
+    )
+    bolt_server.start()
+    print(f"NornicDB-TPU serving: bolt://{args.host}:{bolt_server.port} "
+          f"http://{args.host}:{http_server.port} (data: {args.data_dir or 'memory'})")
+
+    stop = []
+    signal.signal(signal.SIGINT, lambda *a: stop.append(1))
+    signal.signal(signal.SIGTERM, lambda *a: stop.append(1))
+    try:
+        while not stop:
+            time.sleep(0.2)
+    finally:
+        print("shutting down...")
+        bolt_server.stop()
+        http_server.stop()
+        db.close()
+    return 0
+
+
+def cmd_init(args) -> int:
+    db = _open_db(args)
+    db.close()
+    print(f"initialized data directory {args.data_dir}")
+    return 0
+
+
+def cmd_shell(args) -> int:
+    """(ref: nornicdb shell)"""
+    db = _open_db(args)
+    print("NornicDB-TPU shell. Cypher queries, or :quit")
+    try:
+        while True:
+            try:
+                line = input("cypher> ").strip()
+            except EOFError:
+                break
+            if not line:
+                continue
+            if line in (":quit", ":exit", "quit", "exit"):
+                break
+            try:
+                result = db.cypher(line)
+                if result.columns:
+                    print("\t".join(result.columns))
+                    for row in result.rows:
+                        print("\t".join(str(v) for v in row))
+                stats = result.stats.as_dict()
+                if stats:
+                    print(f"-- {stats}")
+            except Exception as e:
+                print(f"error: {e}")
+    finally:
+        db.close()
+    return 0
+
+
+def cmd_import(args) -> int:
+    """Neo4j-style JSON import (ref: nornicdb import, storage loaders)."""
+    from nornicdb_tpu.storage import Edge, Node
+
+    db = _open_db(args)
+    with open(args.file) as f:
+        data = json.load(f)
+    n_nodes = n_edges = 0
+    for nd in data.get("nodes", []):
+        node = Node(
+            id=str(nd.get("id")),
+            labels=list(nd.get("labels", [])),
+            properties=dict(nd.get("properties", {})),
+        )
+        db.storage.create_node(node)
+        n_nodes += 1
+    for ed in data.get("relationships", data.get("edges", [])):
+        edge = Edge(
+            id=str(ed.get("id")),
+            start_node=str(ed.get("startNode", ed.get("start_node"))),
+            end_node=str(ed.get("endNode", ed.get("end_node"))),
+            type=ed.get("type", "RELATED_TO"),
+            properties=dict(ed.get("properties", {})),
+        )
+        db.storage.create_edge(edge)
+        n_edges += 1
+    db.close()
+    print(f"imported {n_nodes} nodes, {n_edges} relationships")
+    return 0
+
+
+def cmd_decay(args) -> int:
+    """(ref: nornicdb decay {recalculate,archive,stats})"""
+    db = _open_db(args)
+    try:
+        if args.action == "recalculate":
+            scored, archived = db.decay.recalculate_all()
+            print(f"scored {scored} nodes, archived {archived}")
+        elif args.action == "stats":
+            print(json.dumps(vars(db.decay.stats)))
+        elif args.action == "archive":
+            nodes = db.decay.archived_nodes()
+            print(f"{len(nodes)} archived nodes")
+    finally:
+        db.close()
+    return 0
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(prog="nornicdb", description="NornicDB-TPU")
+    p.add_argument("--data-dir", default=os.environ.get("NORNICDB_DATA_DIR", ""),
+                   help="data directory (empty = in-memory)")
+    sub = p.add_subparsers(dest="command", required=True)
+
+    s = sub.add_parser("serve", help="run the database server")
+    s.add_argument("--host", default="0.0.0.0")
+    s.add_argument("--bolt-port", type=int, default=7687)
+    s.add_argument("--http-port", type=int, default=7474)
+    s.add_argument("--auth", action="store_true", help="require authentication")
+    s.add_argument("--embedder", choices=["hash", "tpu"], default="tpu")
+    s.add_argument("--embed-dims", type=int, default=1024)
+    s.add_argument("--model-preset", default="bge_small")
+    s.set_defaults(fn=cmd_serve)
+
+    s = sub.add_parser("init", help="initialize a data directory")
+    s.set_defaults(fn=cmd_init)
+
+    s = sub.add_parser("shell", help="interactive Cypher shell")
+    s.set_defaults(fn=cmd_shell)
+
+    s = sub.add_parser("import", help="import Neo4j-style JSON")
+    s.add_argument("file")
+    s.set_defaults(fn=cmd_import)
+
+    s = sub.add_parser("decay", help="memory decay operations")
+    s.add_argument("action", choices=["recalculate", "archive", "stats"])
+    s.set_defaults(fn=cmd_decay)
+
+    args = p.parse_args(argv)
+    return args.fn(args)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -149,10 +149,11 @@ class CypherExecutor:
         q: ast.Query,
         params: dict[str, Any],
         start_rows: Optional[list[dict]] = None,
+        stats: Optional[Stats] = None,
     ) -> Result:
-        result = self._run_single(q, params, start_rows)
+        result = self._run_single(q, params, start_rows, stats)
         for sub, all_ in q.unions:
-            other = self._run_single(sub, params, start_rows)
+            other = self._run_single(sub, params, start_rows, stats)
             if other.columns != result.columns:
                 raise CypherSyntaxError("UNION queries must return the same columns")
             result.rows.extend(other.rows)
@@ -172,11 +173,12 @@ class CypherExecutor:
         q: ast.Query,
         params: dict[str, Any],
         start_rows: Optional[list[dict]] = None,
+        stats: Optional[Stats] = None,
     ) -> Result:
         rows: list[dict[str, Any]] = (
             [dict(r) for r in start_rows] if start_rows is not None else [{}]
         )
-        stats = Stats()
+        stats = stats if stats is not None else Stats()
         columns: list[str] = []
         out_rows: list[list[Any]] = []
         produced = False
@@ -527,74 +529,76 @@ class CypherExecutor:
                     if item is None:
                         continue
                     if isinstance(item, Node):
-                        if item.id in deleted_nodes:
-                            continue
-                        attached = self.storage.degree(item.id)
-                        if attached and not clause.detach:
-                            raise CypherTypeError(
-                                "cannot delete node with relationships; use DETACH DELETE"
-                            )
-                        old = self.storage.get_node(item.id)
-                        old_edges = (
-                            self.storage.get_outgoing_edges(item.id)
-                            + self.storage.get_incoming_edges(item.id)
-                        )
-                        self.storage.delete_node(item.id)
-                        deleted_nodes.add(item.id)
-                        stats.nodes_deleted += 1
-                        stats.relationships_deleted += len(
-                            {e.id for e in old_edges} - deleted_edges
-                        )
-                        deleted_edges.update(e.id for e in old_edges)
-
-                        def undo_node(o=old, es=old_edges):
-                            self.storage.create_node(o)
-                            for e in es:
-                                try:
-                                    self.storage.create_edge(e)
-                                except Exception:
-                                    pass
-
-                        self._record_undo(undo_node)
+                        self._delete_node(item.id, clause.detach, deleted_nodes,
+                                          deleted_edges, stats)
                     elif isinstance(item, Edge):
-                        if item.id in deleted_edges:
-                            continue
-                        old_e = self.storage.get_edge(item.id)
-                        self.storage.delete_edge(item.id)
-                        deleted_edges.add(item.id)
-                        stats.relationships_deleted += 1
-                        self._record_undo(
-                            lambda o=old_e: self.storage.create_edge(o)
-                        )
+                        self._delete_edge(item.id, deleted_edges, stats)
                     elif isinstance(item, dict) and item.get("__path__"):
                         # deleting a path deletes its relationships AND nodes
                         for e in item.get("relationships", []):
-                            if e.id not in deleted_edges:
-                                old_e = self.storage.get_edge(e.id)
-                                self.storage.delete_edge(e.id)
-                                deleted_edges.add(e.id)
-                                stats.relationships_deleted += 1
-                                self._record_undo(
-                                    lambda o=old_e: self.storage.create_edge(o)
-                                )
+                            self._delete_edge(e.id, deleted_edges, stats)
                         for pn in item.get("nodes", []):
-                            if pn.id in deleted_nodes:
-                                continue
-                            if self.storage.degree(pn.id) and not clause.detach:
-                                raise CypherTypeError(
-                                    "cannot delete node with relationships; "
-                                    "use DETACH DELETE"
-                                )
-                            old_n = self.storage.get_node(pn.id)
-                            self.storage.delete_node(pn.id)
-                            deleted_nodes.add(pn.id)
-                            stats.nodes_deleted += 1
-                            self._record_undo(
-                                lambda o=old_n: self.storage.create_node(o)
-                            )
+                            self._delete_node(pn.id, clause.detach, deleted_nodes,
+                                              deleted_edges, stats)
                     else:
                         raise CypherTypeError("DELETE expects nodes/relationships")
         return rows
+
+    def _delete_node(
+        self,
+        node_id: str,
+        detach: bool,
+        deleted_nodes: set[str],
+        deleted_edges: set[str],
+        stats: Stats,
+    ) -> None:
+        if node_id in deleted_nodes:
+            return
+        try:
+            old = self.storage.get_node(node_id)
+        except NotFoundError:
+            deleted_nodes.add(node_id)  # already gone (e.g. earlier cascade)
+            return
+        old_edges = {
+            e.id: e
+            for e in self.storage.get_outgoing_edges(node_id)
+            + self.storage.get_incoming_edges(node_id)
+        }
+        if old_edges and not detach:
+            raise CypherTypeError(
+                "cannot delete node with relationships; use DETACH DELETE"
+            )
+        self.storage.delete_node(node_id)
+        deleted_nodes.add(node_id)
+        stats.nodes_deleted += 1
+        cascaded = set(old_edges) - deleted_edges
+        stats.relationships_deleted += len(cascaded)
+        deleted_edges.update(old_edges)
+
+        def undo_node(o=old, es=[old_edges[i] for i in cascaded]):
+            self.storage.create_node(o)
+            for e in es:
+                try:
+                    self.storage.create_edge(e)
+                except Exception:
+                    pass
+
+        self._record_undo(undo_node)
+
+    def _delete_edge(
+        self, edge_id: str, deleted_edges: set[str], stats: Stats
+    ) -> None:
+        if edge_id in deleted_edges:
+            return
+        try:
+            old_e = self.storage.get_edge(edge_id)
+        except NotFoundError:
+            deleted_edges.add(edge_id)  # cascaded away by an earlier node delete
+            return
+        self.storage.delete_edge(edge_id)
+        deleted_edges.add(edge_id)
+        stats.relationships_deleted += 1
+        self._record_undo(lambda o=old_e: self.storage.create_edge(o))
 
     # -- WITH / RETURN projection ---------------------------------------------------
     def _with(
@@ -851,8 +855,9 @@ class CypherExecutor:
             isinstance(c, ast.ReturnClause) for c in clause.query.clauses
         )
         for row in rows:
-            # full query semantics per input row — including UNION branches
-            res = self._run_query(clause.query, params, start_rows=[row])
+            # full query semantics per input row — including UNION branches;
+            # writes inside the subquery accumulate into the outer stats
+            res = self._run_query(clause.query, params, start_rows=[row], stats=stats)
             if not returns:
                 out.append(row)
                 continue

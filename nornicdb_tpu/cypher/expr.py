@@ -335,4 +335,6 @@ def _function(e: ast.FunctionCall, ctx: EvalContext) -> Any:
     if fn is None:
         raise CypherSyntaxError(f"unknown function {name}()")
     args = [evaluate(a, ctx) for a in e.args]
+    if getattr(fn, "needs_executor", False):
+        return fn(ctx.executor, *args)
     return fn(*args)

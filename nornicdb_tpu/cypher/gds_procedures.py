@@ -34,13 +34,26 @@ def _method_from_name(proc_name: str) -> str:
     raise CypherSyntaxError(f"unknown link prediction method {tail}")
 
 
+def _cached_graph(ex: CypherExecutor):
+    """Per-executor graph projection cache, invalidated by count changes —
+    avoids a full O(N+E) rebuild per input row (the reference builds one
+    projection per procedure call too, graph_builder.go)."""
+    key = (ex.storage.node_count(), ex.storage.edge_count())
+    cached = getattr(ex, "_lp_graph_cache", None)
+    if cached is not None and cached[0] == key:
+        return cached[1]
+    g = build_graph(ex.storage)
+    ex._lp_graph_cache = (key, g)
+    return g
+
+
 def _lp_pair(ex: CypherExecutor, args: list[Any], method: str):
     if len(args) < 2:
         raise CypherSyntaxError("expected (node1, node2)")
     a, b = args[0], args[1]
     a_id = a.id if isinstance(a, Node) else str(a)
     b_id = b.id if isinstance(b, Node) else str(b)
-    g = build_graph(ex.storage)
+    g = _cached_graph(ex)
     return ["score"], [[score_pair(g, a_id, b_id, method)]]
 
 
@@ -106,31 +119,48 @@ def proc_fastrp(ex: CypherExecutor, args, row):
 
 
 # ---------------------------------------------------------------- kalman fns
-_KALMAN_STATES: dict[str, Kalman] = {}
+def _kalman_states(ex: CypherExecutor) -> dict[str, Kalman]:
+    """Per-executor state (not module-global) so independent DB instances /
+    databases never share or leak filter state."""
+    states = getattr(ex, "_kalman_states", None)
+    if states is None:
+        states = {}
+        ex._kalman_states = states
+    return states
 
 
 @register("kalman.filter")
-def fn_kalman_filter(key, measurement, process_noise=1e-3, measurement_noise=1e-1):
+def fn_kalman_filter(ex, key, measurement, process_noise=1e-3, measurement_noise=1e-1):
     """Stateful named scalar filter (ref: kalman_functions.go:115-195)."""
     if key is None or measurement is None:
         return None
-    k = _KALMAN_STATES.get(str(key))
+    states = _kalman_states(ex)
+    k = states.get(str(key))
     if k is None:
         k = Kalman(KalmanConfig(float(process_noise), float(measurement_noise)))
-        _KALMAN_STATES[str(key)] = k
+        states[str(key)] = k
     return k.process(float(measurement))
 
 
+fn_kalman_filter.needs_executor = True
+
+
 @register("kalman.predict")
-def fn_kalman_predict(key):
-    k = _KALMAN_STATES.get(str(key))
+def fn_kalman_predict(ex, key):
+    k = _kalman_states(ex).get(str(key))
     return None if k is None else k.predict()
 
 
+fn_kalman_predict.needs_executor = True
+
+
 @register("kalman.reset")
-def fn_kalman_reset(key):
-    _KALMAN_STATES.pop(str(key), None)
+def fn_kalman_reset(ex, key):
+    _kalman_states(ex).pop(str(key), None)
     return True
+
+
+fn_kalman_reset.needs_executor = True
 
 
 @register("kalman.smooth")

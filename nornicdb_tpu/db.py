@@ -226,13 +226,18 @@ class DB:
             if self._dbmanager is None:
                 from nornicdb_tpu.multidb import DatabaseManager
 
-                self._dbmanager = DatabaseManager(self._base_storage)
+                self._dbmanager = DatabaseManager(
+                    self._base_storage,
+                    on_invalidate=self.invalidate_database_cache,
+                )
             return self._dbmanager
 
     def executor_for(self, database: str):
         """Per-database Cypher executor over the namespaced engine
-        (ref: :USE handling executor.go:500-541)."""
-        if self.database_manager.resolve(database) == self.default_database:
+        (ref: :USE handling executor.go:500-541). Cached under the RESOLVED
+        name so alias-routed executors die with their target database."""
+        database = self.database_manager.resolve(database)
+        if database == self.default_database:
             return self.executor
         with self._lock:
             ex = self._db_executors.get(database)

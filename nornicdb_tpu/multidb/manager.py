@@ -147,9 +147,13 @@ class CompositeEngine(Engine):
 class DatabaseManager:
     """(ref: multidb.DatabaseManager manager.go:43)"""
 
-    def __init__(self, base: Engine, default_database: str = DEFAULT_DB):
+    def __init__(self, base: Engine, default_database: str = DEFAULT_DB,
+                 on_invalidate=None):
         self.base = base
         self.default_database = default_database
+        # called with the db name whenever its engine view becomes stale
+        # (drop, limit change) so holders of cached executors can evict
+        self.on_invalidate = on_invalidate
         self._lock = threading.RLock()
         self._limits: dict[str, DatabaseLimits] = {}
         self._composites: dict[str, list[str]] = {}
@@ -217,6 +221,7 @@ class DatabaseManager:
             self._databases.discard(name)
             self._engines.pop(name, None)
             self._composites.pop(name, None)
+            self._limits.pop(name, None)  # a re-created DB must not inherit
             try:
                 self._system.delete_node(f"db-{name}")
             except NotFoundError:
@@ -225,6 +230,8 @@ class DatabaseManager:
             for alias, target in list(self._aliases.items()):
                 if target == name:
                     self.drop_alias(alias)
+        if self.on_invalidate is not None:
+            self.on_invalidate(name)
 
     def create_composite(self, name: str, constituents: Optional[list[str]] = None) -> None:
         """(ref: composite.go:56-253)"""
@@ -328,8 +335,11 @@ class DatabaseManager:
 
     def set_limits(self, name: str, limits: DatabaseLimits) -> None:
         with self._lock:
-            self._limits[self.resolve(name)] = limits
-            self._engines.pop(self.resolve(name), None)
+            name = self.resolve(name)
+            self._limits[name] = limits
+            self._engines.pop(name, None)
+        if self.on_invalidate is not None:
+            self.on_invalidate(name)
 
     def storage_stats(self) -> dict[str, dict[str, int]]:
         """(ref: storage-size accounting manager.go)"""

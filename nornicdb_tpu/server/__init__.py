@@ -1,0 +1,7 @@
+"""Protocol servers (ref: /root/reference/pkg/bolt, pkg/server, pkg/mcp)."""
+
+from nornicdb_tpu.server.bolt import BoltServer
+from nornicdb_tpu.server.http import HttpServer
+from nornicdb_tpu.server.packstream import Structure, pack, to_wire, unpack
+
+__all__ = ["BoltServer", "HttpServer", "Structure", "pack", "to_wire", "unpack"]

@@ -1,0 +1,529 @@
+"""HTTP server: Neo4j transaction API, search REST, admin, metrics, MCP.
+
+Behavioral reference: /root/reference/pkg/server/server_router.go:53-240 —
+/db/{name}/tx/commit (Neo4j HTTP tx API, server_db.go),
+/nornicdb/search|similar|embed (server_nornicdb.go:236),
+/auth/* endpoints, /admin/stats, /health, /status, /metrics (Prometheus
+text, server_public.go:141-200), MCP mounting (pkg/mcp — 6 tools,
+tools.go:63-332).
+"""
+
+from __future__ import annotations
+
+import base64
+import json
+import re
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Any, Optional
+
+import numpy as np
+
+from nornicdb_tpu.errors import AuthError, NornicError
+from nornicdb_tpu.storage.types import Edge, Node
+
+
+_WRITE_RE = re.compile(
+    r"\b(CREATE|MERGE|SET|DELETE|REMOVE|DROP|DETACH|LOAD)\b", re.IGNORECASE
+)
+
+
+def _is_write_query(query: str) -> bool:
+    return _WRITE_RE.search(query) is not None
+
+
+def _jsonable(v: Any) -> Any:
+    if isinstance(v, Node):
+        return {
+            "id": v.id,
+            "labels": list(v.labels),
+            "properties": _jsonable(v.properties),
+        }
+    if isinstance(v, Edge):
+        return {
+            "id": v.id,
+            "type": v.type,
+            "startNode": v.start_node,
+            "endNode": v.end_node,
+            "properties": _jsonable(v.properties),
+        }
+    if isinstance(v, dict):
+        if v.get("__path__"):
+            return {
+                "nodes": [_jsonable(n) for n in v.get("nodes", [])],
+                "relationships": [_jsonable(e) for e in v.get("relationships", [])],
+            }
+        return {k: _jsonable(x) for k, x in v.items()}
+    if isinstance(v, (list, tuple)):
+        return [_jsonable(x) for x in v]
+    if isinstance(v, np.ndarray):
+        return v.tolist()
+    if isinstance(v, (np.integer,)):
+        return int(v)
+    if isinstance(v, (np.floating,)):
+        return float(v)
+    return v
+
+
+class HttpServer:
+    """(ref: server.New pkg/server/server.go)"""
+
+    def __init__(
+        self,
+        db,
+        host: str = "127.0.0.1",
+        port: int = 7474,
+        authenticator=None,
+        auth_required: bool = False,
+    ):
+        self.db = db
+        self.host = host
+        self.port = port
+        self.authenticator = authenticator
+        self.auth_required = auth_required
+        self.started_at = time.time()
+        self.requests = 0
+        self.errors = 0
+        self.slow_queries = 0
+        self.slow_threshold = 1.0
+        self._httpd: Optional[ThreadingHTTPServer] = None
+        self._thread: Optional[threading.Thread] = None
+
+    # -- request handling ----------------------------------------------------
+    def _make_handler(server_self):  # noqa: N805
+        class Handler(BaseHTTPRequestHandler):
+            protocol_version = "HTTP/1.1"
+
+            def log_message(self, *args):  # quiet
+                pass
+
+            def _send(self, code: int, body: Any, content_type="application/json"):
+                data = (
+                    json.dumps(body).encode()
+                    if content_type == "application/json"
+                    else body.encode()
+                )
+                self.send_response(code)
+                self.send_header("Content-Type", content_type)
+                self.send_header("Content-Length", str(len(data)))
+                self.send_header("Access-Control-Allow-Origin", "*")
+                self.end_headers()
+                self.wfile.write(data)
+
+            def _body(self) -> dict:
+                length = int(self.headers.get("Content-Length") or 0)
+                if not length:
+                    return {}
+                try:
+                    return json.loads(self.rfile.read(length) or b"{}")
+                except json.JSONDecodeError:
+                    raise NornicError("invalid JSON body")
+
+            def _auth(self, permission: str = "read") -> Optional[dict]:
+                if not server_self.auth_required or server_self.authenticator is None:
+                    return {"sub": "anonymous", "role": "admin"}
+                hdr = self.headers.get("Authorization", "")
+                auth = server_self.authenticator
+                if hdr.startswith("Bearer "):
+                    return auth.authorize(hdr[7:], permission)
+                if hdr.startswith("Basic "):
+                    try:
+                        user, pw = (
+                            base64.b64decode(hdr[6:]).decode().split(":", 1)
+                        )
+                    except Exception:
+                        raise AuthError("malformed Basic auth")
+                    token = auth.authenticate(user, pw)
+                    return auth.authorize(token, permission)
+                raise AuthError("authentication required")
+
+            def do_OPTIONS(self):  # CORS preflight
+                self.send_response(204)
+                self.send_header("Access-Control-Allow-Origin", "*")
+                self.send_header(
+                    "Access-Control-Allow-Methods", "GET, POST, DELETE, OPTIONS"
+                )
+                self.send_header(
+                    "Access-Control-Allow-Headers", "Authorization, Content-Type"
+                )
+                self.send_header("Content-Length", "0")
+                self.end_headers()
+
+            def do_GET(self):
+                server_self.requests += 1
+                try:
+                    server_self._route_get(self)
+                except AuthError as e:
+                    self._send(401, {"error": str(e)})
+                except Exception as e:
+                    server_self.errors += 1
+                    self._send(500, {"error": str(e)})
+
+            def do_POST(self):
+                server_self.requests += 1
+                try:
+                    server_self._route_post(self)
+                except AuthError as e:
+                    self._send(401, {"error": str(e)})
+                except Exception as e:
+                    server_self.errors += 1
+                    self._send(400, {"error": str(e)})
+
+        return Handler
+
+    # -- GET routes --------------------------------------------------------------
+    def _route_get(self, h) -> None:
+        path = h.path.split("?")[0]
+        if path == "/health":
+            h._send(200, {"status": "ok"})
+            return
+        if path == "/status":
+            h._send(
+                200,
+                {
+                    "status": "running",
+                    "uptime_seconds": round(time.time() - self.started_at, 1),
+                    "nodes": self.db.storage.node_count(),
+                    "edges": self.db.storage.edge_count(),
+                    "version": "1.0.0",
+                },
+            )
+            return
+        if path == "/metrics":
+            h._send(200, self._prometheus(), content_type="text/plain; version=0.0.4")
+            return
+        if path == "/admin/stats":
+            h._auth("admin")
+            stats = {
+                "requests": self.requests,
+                "errors": self.errors,
+                "slow_queries": self.slow_queries,
+                "nodes": self.db.storage.node_count(),
+                "edges": self.db.storage.edge_count(),
+                "pending_embeddings": len(self.db.storage.pending_embed_ids()),
+                "databases": self.db.database_manager.storage_stats(),
+            }
+            if self.db._embed_worker is not None:
+                stats["embed_worker"] = vars(self.db._embed_worker.stats)
+            h._send(200, stats)
+            return
+        h._send(404, {"error": f"not found: {path}"})
+
+    def _prometheus(self) -> str:
+        """(ref: server_public.go:141-200 — hand-rendered text format)"""
+        lines = [
+            "# TYPE nornicdb_uptime_seconds gauge",
+            f"nornicdb_uptime_seconds {time.time() - self.started_at:.1f}",
+            "# TYPE nornicdb_requests_total counter",
+            f"nornicdb_requests_total {self.requests}",
+            "# TYPE nornicdb_errors_total counter",
+            f"nornicdb_errors_total {self.errors}",
+            "# TYPE nornicdb_nodes gauge",
+            f"nornicdb_nodes {self.db.storage.node_count()}",
+            "# TYPE nornicdb_edges gauge",
+            f"nornicdb_edges {self.db.storage.edge_count()}",
+            "# TYPE nornicdb_pending_embeddings gauge",
+            f"nornicdb_pending_embeddings {len(self.db.storage.pending_embed_ids())}",
+            "# TYPE nornicdb_slow_queries_total counter",
+            f"nornicdb_slow_queries_total {self.slow_queries}",
+        ]
+        if self.db._embed_worker is not None:
+            s = self.db._embed_worker.stats
+            lines += [
+                "# TYPE nornicdb_embeddings_processed_total counter",
+                f"nornicdb_embeddings_processed_total {s.processed}",
+                "# TYPE nornicdb_embeddings_failed_total counter",
+                f"nornicdb_embeddings_failed_total {s.failed}",
+            ]
+        return "\n".join(lines) + "\n"
+
+    # -- POST routes ---------------------------------------------------------------
+    def _route_post(self, h) -> None:
+        path = h.path.split("?")[0]
+        m = re.fullmatch(r"/db/([^/]+)/tx/commit", path)
+        if m:
+            body = h._body()
+            # permission is per-statement: read-only queries work for viewers
+            perm = "read"
+            for stmt in body.get("statements", []):
+                if _is_write_query(stmt.get("statement", "")):
+                    perm = "write"
+                    break
+            h._auth(perm)
+            self._tx_commit(h, m.group(1), body)
+            return
+        if path == "/nornicdb/search":
+            h._auth("read")
+            body = h._body()
+            results = self.db.search.search(
+                body.get("query", ""), limit=int(body.get("limit", 10))
+            )
+            h._send(
+                200,
+                {
+                    "results": [
+                        {
+                            "id": r["id"],
+                            "score": r["score"],
+                            "content": r["content"],
+                            "labels": r["labels"],
+                            "properties": _jsonable(r["node"].properties),
+                        }
+                        for r in results
+                    ]
+                },
+            )
+            return
+        if path == "/nornicdb/similar":
+            h._auth("read")
+            body = h._body()
+            node = self.db.storage.get_node(body["id"])
+            if node.embedding is None:
+                h._send(200, {"results": []})
+                return
+            hits = self.db.search.vector_candidates(
+                node.embedding, k=int(body.get("limit", 10)) + 1
+            )
+            h._send(
+                200,
+                {
+                    "results": [
+                        {"id": i, "score": s}
+                        for i, s in hits
+                        if i != node.id
+                    ][: int(body.get("limit", 10))]
+                },
+            )
+            return
+        if path == "/nornicdb/embed":
+            h._auth("write")
+            body = h._body()
+            if self.db.embedder is None:
+                h._send(503, {"error": "no embedder configured"})
+                return
+            vec = self.db.embedder.embed(body.get("text", ""))
+            h._send(200, {"embedding": _jsonable(vec), "dimensions": len(vec)})
+            return
+        if path == "/nornicdb/search/rebuild":
+            h._auth("admin")
+            n = self.db.search.build_indexes()
+            h._send(200, {"indexed": n})
+            return
+        if path == "/auth/login":
+            body = h._body()
+            if self.authenticator is None:
+                h._send(503, {"error": "auth not configured"})
+                return
+            token = self.authenticator.authenticate(
+                body.get("username", ""), body.get("password", "")
+            )
+            h._send(200, {"token": token})
+            return
+        if path == "/auth/logout":
+            body = h._body()
+            if self.authenticator is not None:
+                self.authenticator.logout(body.get("token", ""))
+            h._send(200, {"ok": True})
+            return
+        if path == "/mcp":
+            h._auth("write")
+            h._send(200, self._mcp(h._body()))
+            return
+        h._send(404, {"error": f"not found: {path}"})
+
+    def _tx_commit(self, h, database: str, body: dict) -> None:
+        """Neo4j HTTP transaction API (ref: server_db.go)."""
+        out_results = []
+        errors = []
+        for stmt in body.get("statements", []):
+            query = stmt.get("statement", "")
+            params = stmt.get("parameters", {})
+            t0 = time.time()
+            try:
+                ex = self.db.executor_for(database)
+                result = ex.execute(query, params)
+            except Exception as e:
+                errors.append(
+                    {"code": "Neo.ClientError.Statement.SyntaxError", "message": str(e)}
+                )
+                break
+            if time.time() - t0 > self.slow_threshold:
+                self.slow_queries += 1
+            out_results.append(
+                {
+                    "columns": result.columns,
+                    "data": [
+                        {"row": [_jsonable(v) for v in row], "meta": []}
+                        for row in result.rows
+                    ],
+                    "stats": result.stats.as_dict(),
+                }
+            )
+        h._send(200, {"results": out_results, "errors": errors})
+
+    # -- MCP (ref: pkg/mcp/tools.go:63-332 — 6 tools) -----------------------------
+    MCP_TOOLS = [
+        {
+            "name": "store",
+            "description": "Store a memory in the knowledge graph",
+            "inputSchema": {
+                "type": "object",
+                "properties": {
+                    "content": {"type": "string"},
+                    "labels": {"type": "array", "items": {"type": "string"}},
+                },
+                "required": ["content"],
+            },
+        },
+        {
+            "name": "recall",
+            "description": "Search memories by meaning",
+            "inputSchema": {
+                "type": "object",
+                "properties": {
+                    "query": {"type": "string"},
+                    "limit": {"type": "integer"},
+                },
+                "required": ["query"],
+            },
+        },
+        {
+            "name": "discover",
+            "description": "Find related memories via graph neighborhood",
+            "inputSchema": {
+                "type": "object",
+                "properties": {"id": {"type": "string"}, "depth": {"type": "integer"}},
+                "required": ["id"],
+            },
+        },
+        {
+            "name": "link",
+            "description": "Create a relationship between two memories",
+            "inputSchema": {
+                "type": "object",
+                "properties": {
+                    "from": {"type": "string"},
+                    "to": {"type": "string"},
+                    "type": {"type": "string"},
+                },
+                "required": ["from", "to"],
+            },
+        },
+        {
+            "name": "task",
+            "description": "Create a task node",
+            "inputSchema": {
+                "type": "object",
+                "properties": {
+                    "title": {"type": "string"},
+                    "status": {"type": "string"},
+                },
+                "required": ["title"],
+            },
+        },
+        {
+            "name": "tasks",
+            "description": "List task nodes",
+            "inputSchema": {
+                "type": "object",
+                "properties": {"status": {"type": "string"}},
+            },
+        },
+    ]
+
+    def _mcp(self, req: dict) -> dict:
+        """JSON-RPC 2.0 dispatcher (ref: pkg/mcp/server.go)."""
+        rid = req.get("id")
+        method = req.get("method", "")
+        params = req.get("params", {}) or {}
+
+        def ok(result):
+            return {"jsonrpc": "2.0", "id": rid, "result": result}
+
+        def err(code, msg):
+            return {"jsonrpc": "2.0", "id": rid, "error": {"code": code, "message": msg}}
+
+        if method == "initialize":
+            return ok(
+                {
+                    "protocolVersion": "2024-11-05",
+                    "serverInfo": {"name": "nornicdb-tpu", "version": "1.0.0"},
+                    "capabilities": {"tools": {}},
+                }
+            )
+        if method == "tools/list":
+            return ok({"tools": self.MCP_TOOLS})
+        if method == "tools/call":
+            name = params.get("name", "")
+            args = params.get("arguments", {}) or {}
+            try:
+                result = self._mcp_tool(name, args)
+            except Exception as e:
+                return err(-32000, str(e))
+            return ok(
+                {"content": [{"type": "text", "text": json.dumps(_jsonable(result))}]}
+            )
+        return err(-32601, f"unknown method {method}")
+
+    def _mcp_tool(self, name: str, args: dict) -> Any:
+        db = self.db
+        if name == "store":
+            node = db.store(args["content"], labels=args.get("labels"))
+            return {"id": node.id}
+        if name == "recall":
+            results = db.recall(args["query"], limit=int(args.get("limit", 5)))
+            return [
+                {"id": r["id"], "content": r["content"], "score": r["score"]}
+                for r in results
+            ]
+        if name == "discover":
+            nodes = db.neighbors(args["id"], depth=int(args.get("depth", 1)))
+            return [
+                {"id": n.id, "content": n.properties.get("content", "")}
+                for n in nodes
+            ]
+        if name == "link":
+            edge = db.link(args["from"], args["to"], args.get("type", "RELATED_TO"))
+            return {"id": edge.id, "type": edge.type}
+        if name == "task":
+            node = db.store(
+                args["title"],
+                labels=["Task"],
+                properties={
+                    "title": args["title"],
+                    "status": args.get("status", "open"),
+                },
+            )
+            return {"id": node.id}
+        if name == "tasks":
+            status = args.get("status")
+            tasks = db.storage.get_nodes_by_label("Task")
+            return [
+                {
+                    "id": t.id,
+                    "title": t.properties.get("title", ""),
+                    "status": t.properties.get("status", ""),
+                }
+                for t in tasks
+                if status is None or t.properties.get("status") == status
+            ]
+        raise NornicError(f"unknown tool {name}")
+
+    # -- lifecycle --------------------------------------------------------------------
+    def start(self) -> None:
+        self._httpd = ThreadingHTTPServer(
+            (self.host, self.port), self._make_handler()
+        )
+        self.port = self._httpd.server_address[1]
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever, daemon=True, name="http-server"
+        )
+        self._thread.start()
+
+    def stop(self) -> None:
+        if self._httpd is not None:
+            self._httpd.shutdown()
+            self._httpd.server_close()
+        if self._thread is not None:
+            self._thread.join(timeout=5)

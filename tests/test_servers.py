@@ -1,0 +1,409 @@
+"""Protocol server tests: PackStream codec, Bolt over a raw socket (the
+official neo4j driver is not in this image, so the tests speak the wire
+protocol directly — same approach as the reference's javascript_compat_test),
+HTTP tx API + search + MCP, auth."""
+
+import base64
+import json
+import socket
+import struct
+import time
+import urllib.request
+
+import pytest
+
+import nornicdb_tpu
+from nornicdb_tpu.auth import Authenticator, ROLE_ADMIN, ROLE_VIEWER
+from nornicdb_tpu.embed import HashEmbedder
+from nornicdb_tpu.errors import AuthError
+from nornicdb_tpu.server import BoltServer, HttpServer
+from nornicdb_tpu.server.packstream import Structure, pack, to_wire, unpack
+from nornicdb_tpu.storage import MemoryEngine, Node
+
+
+class TestPackStream:
+    @pytest.mark.parametrize(
+        "value",
+        [
+            None, True, False, 0, 1, -1, 42, -17, 127, -128, 1000, -1000,
+            2**31, -(2**31) - 1, 3.14, -2.5, "", "hello", "x" * 300,
+            [], [1, 2, 3], ["a", [1, None]], {}, {"k": "v"},
+            {"nested": {"list": [1, 2]}}, b"\x01\x02",
+        ],
+    )
+    def test_roundtrip(self, value):
+        assert unpack(pack(value)) == value
+
+    def test_structure_roundtrip(self):
+        s = Structure(0x4E, [1, ["Person"], {"name": "Ada"}, "id-1"])
+        assert unpack(pack(s)) == s
+
+    def test_node_to_wire(self):
+        n = Node(id="n1", labels=["P"], properties={"x": 1})
+        s = to_wire(n)
+        assert s.tag == 0x4E
+        assert s.fields[1] == ["P"]
+        assert s.fields[3] == "n1"  # element_id
+
+    def test_large_string_and_list(self):
+        big = "y" * 70000
+        assert unpack(pack(big)) == big
+        lst = list(range(300))
+        assert unpack(pack(lst)) == lst
+
+
+class _BoltClient:
+    """Minimal Bolt 4.4 client for tests."""
+
+    def __init__(self, port):
+        self.sock = socket.create_connection(("127.0.0.1", port), timeout=5)
+        self.sock.sendall(b"\x60\x60\xb0\x17")
+        # propose 4.4 only
+        self.sock.sendall(
+            struct.pack(">I", (4 << 0) | (4 << 8)) + b"\x00" * 12
+        )
+        chosen = self._recv_exact(4)
+        assert chosen[3] == 4, f"server picked {chosen!r}"
+
+    def _recv_exact(self, n):
+        buf = b""
+        while len(buf) < n:
+            part = self.sock.recv(n - len(buf))
+            if not part:
+                raise ConnectionError("closed")
+            buf += part
+        return buf
+
+    def send(self, tag, fields):
+        payload = pack(Structure(tag, fields))
+        msg = b""
+        for i in range(0, len(payload), 0xFFFF):
+            part = payload[i : i + 0xFFFF]
+            msg += struct.pack(">H", len(part)) + part
+        msg += b"\x00\x00"
+        self.sock.sendall(msg)
+
+    def recv_message(self):
+        chunks = b""
+        while True:
+            (size,) = struct.unpack(">H", self._recv_exact(2))
+            if size == 0:
+                if chunks:
+                    return unpack(chunks)
+                continue
+            chunks += self._recv_exact(size)
+
+    def run(self, query, params=None):
+        self.send(0x10, [query, params or {}, {}])
+        success = self.recv_message()
+        assert success.tag == 0x70, success
+        columns = success.fields[0].get("fields", [])
+        self.send(0x3F, [{"n": -1}])
+        rows = []
+        while True:
+            msg = self.recv_message()
+            if msg.tag == 0x71:
+                rows.append(msg.fields[0])
+            elif msg.tag == 0x70:
+                return columns, rows, msg.fields[0]
+            else:
+                raise AssertionError(f"unexpected {msg}")
+
+    def close(self):
+        self.sock.close()
+
+
+@pytest.fixture
+def bolt_db():
+    db = nornicdb_tpu.open_db("")
+    server = BoltServer(
+        lambda q, p, d: (db.executor_for(d) if d else db.executor).execute(q, p),
+        port=0,
+    )
+    server.start()
+    yield db, server
+    server.stop()
+    db.close()
+
+
+class TestBolt:
+    def test_handshake_hello_run_pull(self, bolt_db):
+        db, server = bolt_db
+        c = _BoltClient(server.port)
+        c.send(0x01, [{"user_agent": "test/1.0", "scheme": "none"}])
+        hello = c.recv_message()
+        assert hello.tag == 0x70
+        assert "NornicDB-TPU" in hello.fields[0]["server"]
+        cols, rows, summary = c.run("RETURN 1 AS one, 'two' AS two")
+        assert cols == ["one", "two"]
+        assert rows == [[1, "two"]]
+        c.close()
+
+    def test_create_and_match_nodes(self, bolt_db):
+        db, server = bolt_db
+        c = _BoltClient(server.port)
+        c.send(0x01, [{"scheme": "none"}])
+        c.recv_message()
+        _, _, summary = c.run("CREATE (:City {name: 'Oslo', pop: 709037})")
+        assert summary["stats"]["nodes_created"] == 1
+        cols, rows, _ = c.run("MATCH (c:City) RETURN c")
+        node = rows[0][0]
+        assert node.tag == 0x4E
+        assert node.fields[1] == ["City"]
+        assert node.fields[2]["name"] == "Oslo"
+        c.close()
+
+    def test_parameters_and_types(self, bolt_db):
+        db, server = bolt_db
+        c = _BoltClient(server.port)
+        c.send(0x01, [{"scheme": "none"}])
+        c.recv_message()
+        cols, rows, _ = c.run(
+            "RETURN $int + 1 AS i, $str AS s, $list AS l, $map.k AS m, $f AS f",
+            {"int": 41, "str": "hi", "list": [1, 2], "map": {"k": "v"}, "f": 1.5},
+        )
+        assert rows == [[42, "hi", [1, 2], "v", 1.5]]
+        c.close()
+
+    def test_failure_then_reset(self, bolt_db):
+        db, server = bolt_db
+        c = _BoltClient(server.port)
+        c.send(0x01, [{"scheme": "none"}])
+        c.recv_message()
+        c.send(0x10, ["THIS IS NOT CYPHER", {}, {}])
+        failure = c.recv_message()
+        assert failure.tag == 0x7F
+        assert "SyntaxError" in failure.fields[0]["code"]
+        # subsequent messages ignored until RESET
+        c.send(0x3F, [{"n": -1}])
+        assert c.recv_message().tag == 0x7E
+        c.send(0x0F, [])
+        assert c.recv_message().tag == 0x70
+        cols, rows, _ = c.run("RETURN 1 AS x")
+        assert rows == [[1]]
+        c.close()
+
+    def test_explicit_transaction(self, bolt_db):
+        db, server = bolt_db
+        c = _BoltClient(server.port)
+        c.send(0x01, [{"scheme": "none"}])
+        c.recv_message()
+        c.send(0x11, [{}])  # BEGIN
+        assert c.recv_message().tag == 0x70
+        c.run("CREATE (:TxNode)")
+        c.send(0x13, [{}])  # ROLLBACK
+        assert c.recv_message().tag == 0x70
+        cols, rows, _ = c.run("MATCH (t:TxNode) RETURN count(t)")
+        assert rows == [[0]]
+        c.close()
+
+    def test_route_message(self, bolt_db):
+        db, server = bolt_db
+        c = _BoltClient(server.port)
+        c.send(0x01, [{"scheme": "none"}])
+        c.recv_message()
+        c.send(0x66, [{}, [], None])
+        msg = c.recv_message()
+        assert msg.tag == 0x70
+        roles = {s["role"] for s in msg.fields[0]["rt"]["servers"]}
+        assert roles == {"READ", "WRITE", "ROUTE"}
+        c.close()
+
+
+@pytest.fixture
+def http_db():
+    db = nornicdb_tpu.open_db("")
+    db.set_embedder(HashEmbedder(64))
+    server = HttpServer(db, port=0)
+    server.start()
+    yield db, server
+    server.stop()
+    db.close()
+
+
+def _post(port, path, body, headers=None):
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{port}{path}",
+        data=json.dumps(body).encode(),
+        headers={"Content-Type": "application/json", **(headers or {})},
+    )
+    with urllib.request.urlopen(req) as resp:
+        return json.loads(resp.read())
+
+
+def _get(port, path):
+    with urllib.request.urlopen(f"http://127.0.0.1:{port}{path}") as resp:
+        return resp.read().decode(), resp.headers.get("Content-Type", "")
+
+
+class TestHttp:
+    def test_health_status_metrics(self, http_db):
+        db, server = http_db
+        body, _ = _get(server.port, "/health")
+        assert json.loads(body)["status"] == "ok"
+        body, _ = _get(server.port, "/status")
+        assert json.loads(body)["status"] == "running"
+        body, ctype = _get(server.port, "/metrics")
+        assert "nornicdb_nodes" in body and "text/plain" in ctype
+
+    def test_tx_commit_api(self, http_db):
+        db, server = http_db
+        out = _post(
+            server.port,
+            "/db/neo4j/tx/commit",
+            {
+                "statements": [
+                    {"statement": "CREATE (:P {name: $n}) RETURN 1",
+                     "parameters": {"n": "Ada"}},
+                    {"statement": "MATCH (p:P) RETURN p.name, p"},
+                ]
+            },
+        )
+        assert out["errors"] == []
+        assert out["results"][1]["data"][0]["row"][0] == "Ada"
+        assert out["results"][1]["data"][0]["row"][1]["properties"]["name"] == "Ada"
+
+    def test_tx_commit_error_shape(self, http_db):
+        db, server = http_db
+        out = _post(
+            server.port, "/db/neo4j/tx/commit",
+            {"statements": [{"statement": "NOT CYPHER"}]},
+        )
+        assert out["errors"] and "SyntaxError" in out["errors"][0]["code"]
+
+    def test_search_endpoint(self, http_db):
+        db, server = http_db
+        db.store("the TPU accelerates vector search")
+        db.process_pending_embeddings()
+        out = _post(server.port, "/nornicdb/search", {"query": "TPU vector", "limit": 3})
+        assert out["results"] and "TPU" in out["results"][0]["content"]
+
+    def test_embed_endpoint(self, http_db):
+        db, server = http_db
+        out = _post(server.port, "/nornicdb/embed", {"text": "hello"})
+        assert out["dimensions"] == 64
+
+    def test_mcp_flow(self, http_db):
+        db, server = http_db
+        out = _post(server.port, "/mcp", {"jsonrpc": "2.0", "id": 1, "method": "tools/list"})
+        names = [t["name"] for t in out["result"]["tools"]]
+        assert names == ["store", "recall", "discover", "link", "task", "tasks"]
+        out = _post(
+            server.port, "/mcp",
+            {"jsonrpc": "2.0", "id": 2, "method": "tools/call",
+             "params": {"name": "store", "arguments": {"content": "mcp memory"}}},
+        )
+        stored = json.loads(out["result"]["content"][0]["text"])
+        assert "id" in stored
+        out = _post(
+            server.port, "/mcp",
+            {"jsonrpc": "2.0", "id": 3, "method": "tools/call",
+             "params": {"name": "task", "arguments": {"title": "write tests"}}},
+        )
+        out = _post(
+            server.port, "/mcp",
+            {"jsonrpc": "2.0", "id": 4, "method": "tools/call",
+             "params": {"name": "tasks", "arguments": {}}},
+        )
+        tasks = json.loads(out["result"]["content"][0]["text"])
+        assert tasks and tasks[0]["title"] == "write tests"
+
+
+class TestAuth:
+    def _auth(self):
+        eng = MemoryEngine()
+        return Authenticator(eng)
+
+    def test_password_hash_verify(self):
+        from nornicdb_tpu.auth import hash_password, verify_password
+
+        h = hash_password("s3cret")
+        assert verify_password("s3cret", h)
+        assert not verify_password("wrong", h)
+
+    def test_create_authenticate_authorize(self):
+        auth = self._auth()
+        auth.create_user("alice", "pw", ROLE_ADMIN)
+        token = auth.authenticate("alice", "pw")
+        payload = auth.authorize(token, "admin")
+        assert payload["sub"] == "alice"
+
+    def test_wrong_password_and_lockout(self):
+        auth = self._auth()
+        auth.config.lockout_threshold = 3
+        auth.create_user("bob", "pw", ROLE_VIEWER)
+        for _ in range(3):
+            with pytest.raises(AuthError):
+                auth.authenticate("bob", "nope")
+        with pytest.raises(AuthError, match="locked"):
+            auth.authenticate("bob", "pw")
+
+    def test_rbac_denies(self):
+        auth = self._auth()
+        auth.create_user("carol", "pw", ROLE_VIEWER)
+        token = auth.authenticate("carol", "pw")
+        auth.authorize(token, "read")
+        with pytest.raises(AuthError):
+            auth.authorize(token, "write")
+
+    def test_logout_revokes(self):
+        auth = self._auth()
+        auth.create_user("dan", "pw", ROLE_ADMIN)
+        token = auth.authenticate("dan", "pw")
+        auth.logout(token)
+        assert auth.validate_token(token) is None
+
+    def test_tampered_token_rejected(self):
+        auth = self._auth()
+        auth.create_user("eve", "pw", ROLE_VIEWER)
+        token = auth.authenticate("eve", "pw")
+        h, p, s = token.split(".")
+        forged = json.dumps({"sub": "eve", "role": "admin", "exp": 9999999999})
+        tampered = f"{h}.{base64.urlsafe_b64encode(forged.encode()).rstrip(b'=').decode()}.{s}"
+        assert auth.validate_token(tampered) is None
+
+    def test_http_auth_required(self):
+        db = nornicdb_tpu.open_db("")
+        auth = Authenticator(MemoryEngine())
+        auth.create_user("admin", "adminpw", ROLE_ADMIN)
+        server = HttpServer(db, port=0, authenticator=auth, auth_required=True)
+        server.start()
+        try:
+            with pytest.raises(urllib.error.HTTPError) as e:
+                _post(server.port, "/nornicdb/search", {"query": "x"})
+            assert e.value.code == 401
+            basic = base64.b64encode(b"admin:adminpw").decode()
+            out = _post(
+                server.port, "/nornicdb/search", {"query": "x"},
+                headers={"Authorization": f"Basic {basic}"},
+            )
+            assert out == {"results": []}
+        finally:
+            server.stop()
+            db.close()
+
+    def test_bolt_auth(self):
+        db = nornicdb_tpu.open_db("")
+        auth = Authenticator(MemoryEngine())
+        auth.create_user("neo", "matrix", ROLE_ADMIN)
+        server = BoltServer(
+            lambda q, p, d: db.executor.execute(q, p),
+            port=0, authenticator=auth, auth_required=True,
+        )
+        server.start()
+        try:
+            c = _BoltClient(server.port)
+            c.send(0x01, [{"scheme": "basic", "principal": "neo",
+                           "credentials": "wrong"}])
+            assert c.recv_message().tag == 0x7F  # FAILURE
+            c.close()
+            c2 = _BoltClient(server.port)
+            c2.send(0x01, [{"scheme": "basic", "principal": "neo",
+                            "credentials": "matrix"}])
+            assert c2.recv_message().tag == 0x70
+            cols, rows, _ = c2.run("RETURN 1 AS ok")
+            assert rows == [[1]]
+            c2.close()
+        finally:
+            server.stop()
+            db.close()
