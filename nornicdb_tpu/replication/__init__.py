@@ -1,0 +1,35 @@
+"""Replication library (ref: /root/reference/pkg/replication/ — standalone,
+exercised by its own tests; HA WAL shipping, Raft consensus, chaos-tested
+transport over DCN)."""
+
+from nornicdb_tpu.replication.chaos import ChaosConfig, ChaosTransport
+from nornicdb_tpu.replication.ha_standby import (
+    HAConfig,
+    HAPrimary,
+    HAStandby,
+    ReplicatedEngine,
+    apply_op,
+)
+from nornicdb_tpu.replication.raft import (
+    CANDIDATE,
+    FOLLOWER,
+    LEADER,
+    LogEntry,
+    RaftCluster,
+    RaftConfig,
+    RaftNode,
+)
+from nornicdb_tpu.replication.transport import (
+    InProcNetwork,
+    InProcTransport,
+    Message,
+    TcpTransport,
+    Transport,
+)
+
+__all__ = [
+    "ChaosConfig", "ChaosTransport", "HAConfig", "HAPrimary", "HAStandby",
+    "ReplicatedEngine", "apply_op", "CANDIDATE", "FOLLOWER", "LEADER",
+    "LogEntry", "RaftCluster", "RaftConfig", "RaftNode", "InProcNetwork",
+    "InProcTransport", "Message", "TcpTransport", "Transport",
+]

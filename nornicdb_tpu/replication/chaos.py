@@ -1,0 +1,98 @@
+"""Chaos transport: fault injection for replication tests.
+
+Behavioral reference: /root/reference/pkg/replication/chaos_test.go:446
+(ChaosTransport) — packet loss, latency (incl. cross-region spikes), data
+corruption, connection drops, duplication, reordering, mixed failures.
+"""
+
+from __future__ import annotations
+
+import random
+import threading
+import time
+from dataclasses import dataclass
+
+from nornicdb_tpu.errors import ReplicationError
+from nornicdb_tpu.replication.transport import Message, Transport
+
+
+@dataclass
+class ChaosConfig:
+    loss_rate: float = 0.0  # drop outgoing messages
+    duplicate_rate: float = 0.0
+    corrupt_rate: float = 0.0  # flip payload bytes
+    reorder_rate: float = 0.0  # delay to shuffle ordering
+    latency: float = 0.0  # fixed added latency (s)
+    latency_jitter: float = 0.0
+    drop_connections: bool = False  # every send raises
+    seed: int = 0
+
+
+class ChaosTransport(Transport):
+    """Wraps any Transport, injecting faults on the send path."""
+
+    def __init__(self, inner: Transport, config: ChaosConfig):
+        super().__init__(inner.node_id)
+        self.inner = inner
+        self.config = config
+        self.rng = random.Random(config.seed)
+        self.stats = {"sent": 0, "dropped": 0, "duplicated": 0, "corrupted": 0,
+                      "reordered": 0}
+        # our handler chain must observe inner deliveries
+        inner.set_handler(self._on_inner)
+
+    def _on_inner(self, msg: Message):
+        self._deliver(msg)
+        return None
+
+    def set_handler(self, handler):
+        self.handler = handler
+
+    def peers(self):
+        return self.inner.peers()
+
+    def close(self):
+        self.inner.close()
+
+    def send(self, peer: str, msg: Message) -> None:
+        cfg = self.config
+        self.stats["sent"] += 1
+        if cfg.drop_connections:
+            raise ReplicationError("connection dropped (chaos)")
+        if self.rng.random() < cfg.loss_rate:
+            self.stats["dropped"] += 1
+            return  # silently lost
+        if self.rng.random() < cfg.corrupt_rate:
+            self.stats["corrupted"] += 1
+            msg = self._corrupt(msg)
+        sends = 1
+        if self.rng.random() < cfg.duplicate_rate:
+            self.stats["duplicated"] += 1
+            sends = 2
+        delay = cfg.latency + self.rng.random() * cfg.latency_jitter
+        if self.rng.random() < cfg.reorder_rate:
+            self.stats["reordered"] += 1
+            delay += self.rng.random() * 0.05
+        for _ in range(sends):
+            if delay > 0:
+                threading.Timer(
+                    delay, self._safe_send, args=(peer, msg)
+                ).start()
+            else:
+                self._safe_send(peer, msg)
+
+    def _safe_send(self, peer: str, msg: Message) -> None:
+        try:
+            self.inner.send(peer, msg)
+        except ReplicationError:
+            pass
+
+    def _corrupt(self, msg: Message) -> Message:
+        """Corrupt a payload value; receivers must survive garbage."""
+        bad = Message(msg.type, dict(msg.payload), msg.request_id, msg.sender)
+        if bad.payload:
+            k = self.rng.choice(list(bad.payload))
+            bad.payload[k] = "\x00CORRUPT\xff"
+        else:
+            bad.payload = {"__garbage__": self.rng.random()}
+        return bad

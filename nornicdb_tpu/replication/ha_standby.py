@@ -1,0 +1,315 @@
+"""HA hot-standby replication: primary ships WAL batches to a standby.
+
+Behavioral reference: /root/reference/pkg/replication/ha_standby.go:169-336 —
+primary streams WAL entry batches, heartbeats, fencing (FenceRequest :148),
+standby promote (:159). Storage bridging mirrors storage_adapter.go.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Any, Optional
+
+from nornicdb_tpu.errors import ReplicationError
+from nornicdb_tpu.replication.transport import (
+    MSG_FENCE,
+    MSG_HEARTBEAT,
+    MSG_PROMOTE,
+    MSG_WAL_BATCH,
+    Message,
+    Transport,
+)
+from nornicdb_tpu.storage.types import Edge, Engine, Node
+from nornicdb_tpu.storage.wal import (
+    OP_CREATE_EDGE,
+    OP_CREATE_NODE,
+    OP_DELETE_EDGE,
+    OP_DELETE_NODE,
+    OP_UPDATE_EDGE,
+    OP_UPDATE_NODE,
+    apply_storage_op,
+)
+
+
+def apply_op(engine: Engine, op: str, data: dict[str, Any]) -> None:
+    """Apply one replicated op — shared dispatch with WAL recovery
+    (ref: storage_adapter.go; nornicdb_tpu.storage.wal.apply_storage_op)."""
+    apply_storage_op(engine, op, data)
+
+
+class ReplicatedEngine(Engine):
+    """Engine decorator that records ops into an in-memory log for shipping
+    (the primary side of WAL shipping)."""
+
+    def __init__(self, base: Engine):
+        super().__init__()
+        self.base = base
+        self._log: list[tuple[int, str, dict]] = []
+        self._seq = 0
+        self._lock = threading.Lock()
+        self.fenced = False
+        base.on_event(self._emit)
+
+    def _record(self, op: str, data: dict) -> None:
+        with self._lock:
+            self._seq += 1
+            self._log.append((self._seq, op, data))
+
+    def prune_through(self, seq: int) -> None:
+        """Drop acked entries so log memory and scan cost stay bounded by the
+        unshipped backlog."""
+        with self._lock:
+            self._log = [e for e in self._log if e[0] > seq]
+
+    def _check_fence(self) -> None:
+        if self.fenced:
+            raise ReplicationError("primary is fenced (ref: FenceRequest)")
+
+    def entries_since(self, seq: int) -> list[tuple[int, str, dict]]:
+        with self._lock:
+            return [e for e in self._log if e[0] > seq]
+
+    @property
+    def last_seq(self) -> int:
+        with self._lock:
+            return self._seq
+
+    # mutations: fence-checked + logged
+    def create_node(self, node: Node) -> Node:
+        self._check_fence()
+        out = self.base.create_node(node)
+        self._record(OP_CREATE_NODE, out.to_dict())
+        return out
+
+    def update_node(self, node: Node) -> Node:
+        self._check_fence()
+        out = self.base.update_node(node)
+        self._record(OP_UPDATE_NODE, out.to_dict())
+        return out
+
+    def delete_node(self, node_id: str) -> None:
+        self._check_fence()
+        self.base.delete_node(node_id)
+        self._record(OP_DELETE_NODE, {"id": node_id})
+
+    def create_edge(self, edge: Edge) -> Edge:
+        self._check_fence()
+        out = self.base.create_edge(edge)
+        self._record(OP_CREATE_EDGE, out.to_dict())
+        return out
+
+    def update_edge(self, edge: Edge) -> Edge:
+        self._check_fence()
+        out = self.base.update_edge(edge)
+        self._record(OP_UPDATE_EDGE, out.to_dict())
+        return out
+
+    def delete_edge(self, edge_id: str) -> None:
+        self._check_fence()
+        self.base.delete_edge(edge_id)
+        self._record(OP_DELETE_EDGE, {"id": edge_id})
+
+    # reads delegate
+    def get_node(self, node_id):
+        return self.base.get_node(node_id)
+
+    def get_nodes_by_label(self, label):
+        return self.base.get_nodes_by_label(label)
+
+    def all_nodes(self):
+        return self.base.all_nodes()
+
+    def get_edge(self, edge_id):
+        return self.base.get_edge(edge_id)
+
+    def get_edges_by_type(self, t):
+        return self.base.get_edges_by_type(t)
+
+    def get_outgoing_edges(self, node_id):
+        return self.base.get_outgoing_edges(node_id)
+
+    def get_incoming_edges(self, node_id):
+        return self.base.get_incoming_edges(node_id)
+
+    def all_edges(self):
+        return self.base.all_edges()
+
+    def node_count(self):
+        return self.base.node_count()
+
+    def edge_count(self):
+        return self.base.edge_count()
+
+    def mark_pending_embed(self, node_id):
+        self.base.mark_pending_embed(node_id)
+
+    def unmark_pending_embed(self, node_id):
+        self.base.unmark_pending_embed(node_id)
+
+    def pending_embed_ids(self, limit=0):
+        return self.base.pending_embed_ids(limit)
+
+
+@dataclass
+class HAConfig:
+    batch_interval: float = 0.05
+    heartbeat_interval: float = 0.2
+    heartbeat_timeout: float = 1.0
+
+
+class HAPrimary:
+    """(ref: HAStandbyReplicator primary role ha_standby.go:169)"""
+
+    def __init__(
+        self,
+        engine: ReplicatedEngine,
+        transport: Transport,
+        standby_id: str,
+        config: Optional[HAConfig] = None,
+    ):
+        self.engine = engine
+        self.transport = transport
+        self.standby_id = standby_id
+        self.config = config or HAConfig()
+        self._shipped_seq = 0
+        self._stop = threading.Event()
+        self._threads: list[threading.Thread] = []
+        transport.set_handler(self._on_message)
+
+    def start(self) -> None:
+        for fn in (self._ship_loop, self._heartbeat_loop):
+            t = threading.Thread(target=fn, daemon=True)
+            t.start()
+            self._threads.append(t)
+
+    def stop(self) -> None:
+        self._stop.set()
+        for t in self._threads:
+            t.join(timeout=2)
+
+    def _ship_loop(self) -> None:
+        while not self._stop.wait(self.config.batch_interval):
+            self.ship_now()
+
+    def ship_now(self) -> int:
+        """Ship outstanding entries; returns how many were sent."""
+        entries = self.engine.entries_since(self._shipped_seq)
+        if not entries:
+            return 0
+        payload = {
+            "entries": [
+                {"seq": s, "op": op, "data": data} for s, op, data in entries
+            ]
+        }
+        try:
+            resp = self.transport.request(
+                self.standby_id, Message(MSG_WAL_BATCH, payload), timeout=2.0
+            )
+            payload_in = resp.payload if isinstance(resp.payload, dict) else {}
+            acked = payload_in.get("acked_seq", self._shipped_seq)
+            if not isinstance(acked, (int, float)):
+                return 0  # malformed ack (e.g. chaos corruption): retry later
+            self._shipped_seq = max(self._shipped_seq, int(acked))
+            self.engine.prune_through(self._shipped_seq)
+            return len(entries)
+        except ReplicationError:
+            return 0
+        except Exception:
+            # never let a bad response kill the ship loop thread
+            return 0
+
+    def _heartbeat_loop(self) -> None:
+        while not self._stop.wait(self.config.heartbeat_interval):
+            try:
+                self.transport.send(
+                    self.standby_id,
+                    Message(MSG_HEARTBEAT, {"seq": self.engine.last_seq,
+                                            "ts": time.time()}),
+                )
+            except ReplicationError:
+                pass
+
+    def fence(self) -> None:
+        """Stop accepting writes (split-brain prevention, ref: :148)."""
+        self.engine.fenced = True
+
+    def _on_message(self, msg: Message) -> Optional[Message]:
+        if msg.type == MSG_FENCE:
+            self.fence()
+            return Message(0, {"fenced": True})
+        return None
+
+
+class HAStandby:
+    """(ref: standby role + promote ha_standby.go:159-336)"""
+
+    def __init__(
+        self,
+        engine: Engine,
+        transport: Transport,
+        primary_id: str,
+        config: Optional[HAConfig] = None,
+    ):
+        self.engine = engine
+        self.transport = transport
+        self.primary_id = primary_id
+        self.config = config or HAConfig()
+        self.applied_seq = 0
+        self.last_heartbeat = time.time()
+        self.promoted = False
+        self._lock = threading.Lock()
+        transport.set_handler(self._on_message)
+
+    def _on_message(self, msg: Message) -> Optional[Message]:
+        if msg.type == MSG_WAL_BATCH:
+            return self._apply_batch(msg)
+        if msg.type == MSG_HEARTBEAT:
+            self.last_heartbeat = time.time()
+            return None
+        if msg.type == MSG_PROMOTE:
+            self.promote()
+            return Message(0, {"promoted": True})
+        return None
+
+    def _apply_batch(self, msg: Message) -> Message:
+        with self._lock:
+            if self.promoted:
+                # refuse the old primary's stream after promotion so a failed
+                # fence cannot split-brain our engine
+                return Message(0, {"acked_seq": self.applied_seq,
+                                   "error": "promoted"})
+            entries = msg.payload.get("entries")
+            if not isinstance(entries, list):
+                return Message(0, {"acked_seq": self.applied_seq, "error": "bad batch"})
+            for e in entries:
+                seq = e.get("seq") if isinstance(e, dict) else None
+                if not isinstance(seq, int):
+                    break  # corrupted entry: ack up to the gap; retransmit heals
+                if seq <= self.applied_seq:
+                    continue  # duplicate / replay
+                if seq != self.applied_seq + 1:
+                    break  # out-of-order hole: wait for retransmit
+                op = e.get("op")
+                data = e.get("data")
+                if not isinstance(op, str) or not isinstance(data, dict):
+                    break  # corrupted payload: don't skip past it
+                apply_op(self.engine, op, data)
+                self.applied_seq = seq
+            return Message(0, {"acked_seq": self.applied_seq})
+
+    def heartbeat_healthy(self) -> bool:
+        return (time.time() - self.last_heartbeat) < self.config.heartbeat_timeout
+
+    def promote(self) -> ReplicatedEngine:
+        """Become the writable primary (ref: promote :159): fence the old
+        primary (best effort), then wrap our engine for future shipping."""
+        try:
+            self.transport.request(
+                self.primary_id, Message(MSG_FENCE, {}), timeout=1.0
+            )
+        except ReplicationError:
+            pass  # primary is gone — that's why we're promoting
+        self.promoted = True
+        return ReplicatedEngine(self.engine)

@@ -1,0 +1,410 @@
+"""Raft consensus for replicated graph storage.
+
+Behavioral reference: /root/reference/pkg/replication/raft.go:97-1368 —
+hand-written Raft: randomized election timers, RequestVote RPCs (:248-360),
+log replication via AppendEntries, commit index advancement, apply loop,
+AddVoter (:1368). Consensus runs on the host plane (CPU) over DCN; the
+device plane is untouched (SURVEY.md §2.3).
+"""
+
+from __future__ import annotations
+
+import random
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Any, Callable, Optional
+
+from nornicdb_tpu.errors import ReplicationError
+from nornicdb_tpu.replication.ha_standby import apply_op
+from nornicdb_tpu.replication.transport import (
+    MSG_APPEND_ENTRIES,
+    MSG_VOTE_REQUEST,
+    Message,
+    Transport,
+)
+from nornicdb_tpu.storage.types import Engine
+
+FOLLOWER = "follower"
+CANDIDATE = "candidate"
+LEADER = "leader"
+
+
+@dataclass
+class LogEntry:
+    term: int
+    index: int
+    op: str = ""
+    data: dict[str, Any] = field(default_factory=dict)
+
+
+@dataclass
+class RaftConfig:
+    election_timeout_min: float = 0.15
+    election_timeout_max: float = 0.3
+    heartbeat_interval: float = 0.05
+
+
+class RaftNode:
+    """(ref: RaftReplicator raft.go:97)"""
+
+    def __init__(
+        self,
+        node_id: str,
+        transport: Transport,
+        peers: list[str],
+        storage: Optional[Engine] = None,
+        config: Optional[RaftConfig] = None,
+        seed: Optional[int] = None,
+    ):
+        self.node_id = node_id
+        self.transport = transport
+        self.peer_ids = [p for p in peers if p != node_id]
+        self.storage = storage
+        self.config = config or RaftConfig()
+        self.rng = random.Random(seed if seed is not None else hash(node_id))
+        # persistent state
+        self.current_term = 0
+        self.voted_for: Optional[str] = None
+        self.log: list[LogEntry] = []
+        # volatile
+        self.state = FOLLOWER
+        self.commit_index = 0
+        self.last_applied = 0
+        self.leader_id: Optional[str] = None
+        self.next_index: dict[str, int] = {}
+        self.match_index: dict[str, int] = {}
+        self._lock = threading.RLock()
+        self._stop = threading.Event()
+        self._last_heard = time.time()
+        self._election_deadline = self._new_deadline()
+        self._threads: list[threading.Thread] = []
+        self.on_apply: Optional[Callable[[LogEntry], None]] = None
+        transport.set_handler(self._on_message)
+
+    # -- lifecycle -----------------------------------------------------------
+    def start(self) -> None:
+        self._stop.clear()
+        t = threading.Thread(target=self._tick_loop, daemon=True,
+                             name=f"raft-{self.node_id}")
+        t.start()
+        self._threads.append(t)
+
+    def stop(self) -> None:
+        self._stop.set()
+        for t in self._threads:
+            t.join(timeout=2)
+        self._threads.clear()
+
+    def _new_deadline(self) -> float:
+        return time.time() + self.rng.uniform(
+            self.config.election_timeout_min, self.config.election_timeout_max
+        )
+
+    def _tick_loop(self) -> None:
+        while not self._stop.wait(0.01):
+            with self._lock:
+                state = self.state
+                deadline = self._election_deadline
+            if state == LEADER:
+                self._broadcast_append_entries()
+                self._stop.wait(self.config.heartbeat_interval)
+            elif time.time() >= deadline:
+                self._start_election()
+
+    # -- elections (ref: raft.go:248-360) ------------------------------------
+    def _start_election(self) -> None:
+        with self._lock:
+            self.state = CANDIDATE
+            self.current_term += 1
+            term = self.current_term
+            self.voted_for = self.node_id
+            self.leader_id = None
+            self._election_deadline = self._new_deadline()
+            last_idx = len(self.log)
+            last_term = self.log[-1].term if self.log else 0
+        votes = 1
+        vote_lock = threading.Lock()
+        majority = (len(self.peer_ids) + 1) // 2 + 1
+        done = threading.Event()
+
+        def ask(peer: str):
+            nonlocal votes
+            try:
+                resp = self.transport.request(
+                    peer,
+                    Message(
+                        MSG_VOTE_REQUEST,
+                        {
+                            "term": term,
+                            "candidate": self.node_id,
+                            "last_log_index": last_idx,
+                            "last_log_term": last_term,
+                        },
+                    ),
+                    timeout=self.config.election_timeout_min,
+                )
+            except ReplicationError:
+                return
+            payload = resp.payload
+            if not isinstance(payload, dict):
+                return
+            rterm = payload.get("term", 0)
+            if isinstance(rterm, int) and rterm > term:
+                with self._lock:
+                    self._step_down(rterm)
+                done.set()
+                return
+            if payload.get("vote_granted") is True:
+                with vote_lock:
+                    votes += 1
+                    if votes >= majority:
+                        done.set()
+
+        threads = [threading.Thread(target=ask, args=(p,), daemon=True)
+                   for p in self.peer_ids]
+        for t in threads:
+            t.start()
+        done.wait(self.config.election_timeout_max)
+        with self._lock:
+            if self.state == CANDIDATE and self.current_term == term and votes >= majority:
+                self._become_leader()
+
+    def _become_leader(self) -> None:
+        self.state = LEADER
+        self.leader_id = self.node_id
+        for p in self.peer_ids:
+            self.next_index[p] = len(self.log) + 1
+            self.match_index[p] = 0
+        # immediate heartbeat to assert leadership
+        threading.Thread(target=self._broadcast_append_entries, daemon=True).start()
+
+    def _step_down(self, term: int) -> None:
+        self.current_term = term
+        self.state = FOLLOWER
+        self.voted_for = None
+        self._election_deadline = self._new_deadline()
+
+    # -- log replication --------------------------------------------------------
+    def propose(self, op: str, data: dict[str, Any]) -> int:
+        """Leader-only: append an op, replicate, return its index."""
+        with self._lock:
+            if self.state != LEADER:
+                raise ReplicationError(f"not the leader (leader={self.leader_id})")
+            entry = LogEntry(self.current_term, len(self.log) + 1, op, data)
+            self.log.append(entry)
+            index = entry.index
+            if not self.peer_ids:
+                # single-node cluster: a majority of one holds it already
+                self._advance_commit()
+        self._broadcast_append_entries()
+        return index
+
+    def _broadcast_append_entries(self) -> None:
+        for peer in self.peer_ids:
+            threading.Thread(
+                target=self._send_append, args=(peer,), daemon=True
+            ).start()
+
+    def _send_append(self, peer: str) -> None:
+        with self._lock:
+            if self.state != LEADER:
+                return
+            term = self.current_term
+            next_idx = self.next_index.get(peer, len(self.log) + 1)
+            prev_idx = next_idx - 1
+            prev_term = self.log[prev_idx - 1].term if prev_idx >= 1 and prev_idx <= len(self.log) else 0
+            entries = [
+                {"term": e.term, "index": e.index, "op": e.op, "data": e.data}
+                for e in self.log[next_idx - 1 :]
+            ]
+            commit = self.commit_index
+        try:
+            resp = self.transport.request(
+                peer,
+                Message(
+                    MSG_APPEND_ENTRIES,
+                    {
+                        "term": term,
+                        "leader": self.node_id,
+                        "prev_log_index": prev_idx,
+                        "prev_log_term": prev_term,
+                        "entries": entries,
+                        "leader_commit": commit,
+                    },
+                ),
+                timeout=0.5,
+            )
+        except ReplicationError:
+            return
+        payload = resp.payload if isinstance(resp.payload, dict) else {}
+        rterm = payload.get("term", 0)
+        with self._lock:
+            if isinstance(rterm, int) and rterm > self.current_term:
+                self._step_down(rterm)
+                return
+            if self.state != LEADER:
+                return
+            if payload.get("success") is True:
+                match = prev_idx + len(entries)
+                self.match_index[peer] = max(self.match_index.get(peer, 0), match)
+                self.next_index[peer] = self.match_index[peer] + 1
+                self._advance_commit()
+            else:
+                self.next_index[peer] = max(1, self.next_index.get(peer, 1) - 1)
+
+    def _advance_commit(self) -> None:
+        """Commit entries replicated to a majority (current-term only)."""
+        for idx in range(len(self.log), self.commit_index, -1):
+            if self.log[idx - 1].term != self.current_term:
+                continue
+            count = 1 + sum(
+                1 for p in self.peer_ids if self.match_index.get(p, 0) >= idx
+            )
+            if count >= (len(self.peer_ids) + 1) // 2 + 1:
+                self.commit_index = idx
+                self._apply_committed()
+                break
+
+    def _apply_committed(self) -> None:
+        while self.last_applied < self.commit_index:
+            self.last_applied += 1
+            entry = self.log[self.last_applied - 1]
+            if self.storage is not None and entry.op:
+                apply_op(self.storage, entry.op, entry.data)
+            if self.on_apply is not None:
+                try:
+                    self.on_apply(entry)
+                except Exception:
+                    pass
+
+    # -- RPC handlers ----------------------------------------------------------------
+    def _on_message(self, msg: Message) -> Optional[Message]:
+        if msg.type == MSG_VOTE_REQUEST:
+            return self._handle_vote(msg)
+        if msg.type == MSG_APPEND_ENTRIES:
+            return self._handle_append(msg)
+        return None
+
+    def _handle_vote(self, msg: Message) -> Message:
+        p = msg.payload if isinstance(msg.payload, dict) else {}
+        term = p.get("term")
+        candidate = p.get("candidate")
+        if not isinstance(term, int) or not isinstance(candidate, str):
+            return Message(0, {"term": self.current_term, "vote_granted": False})
+        with self._lock:
+            if term > self.current_term:
+                self._step_down(term)
+            granted = False
+            if term == self.current_term and self.voted_for in (None, candidate):
+                # candidate log must be at least as up-to-date (ref: §5.4.1)
+                last_term = self.log[-1].term if self.log else 0
+                cand_last_term = p.get("last_log_term", 0)
+                cand_last_idx = p.get("last_log_index", 0)
+                if not isinstance(cand_last_term, int) or not isinstance(cand_last_idx, int):
+                    cand_last_term, cand_last_idx = -1, -1
+                up_to_date = (cand_last_term, cand_last_idx) >= (last_term, len(self.log))
+                if up_to_date:
+                    granted = True
+                    self.voted_for = candidate
+                    self._election_deadline = self._new_deadline()
+            return Message(0, {"term": self.current_term, "vote_granted": granted})
+
+    def _handle_append(self, msg: Message) -> Message:
+        p = msg.payload if isinstance(msg.payload, dict) else {}
+        term = p.get("term")
+        if not isinstance(term, int):
+            return Message(0, {"term": self.current_term, "success": False})
+        with self._lock:
+            if term < self.current_term:
+                return Message(0, {"term": self.current_term, "success": False})
+            if term > self.current_term or self.state != FOLLOWER:
+                self._step_down(term)
+            leader = p.get("leader")
+            if isinstance(leader, str):
+                self.leader_id = leader
+            self._election_deadline = self._new_deadline()
+            prev_idx = p.get("prev_log_index", 0)
+            prev_term = p.get("prev_log_term", 0)
+            if not isinstance(prev_idx, int) or not isinstance(prev_term, int):
+                return Message(0, {"term": self.current_term, "success": False})
+            if prev_idx > len(self.log):
+                return Message(0, {"term": self.current_term, "success": False})
+            if prev_idx >= 1 and self.log[prev_idx - 1].term != prev_term:
+                self.log = self.log[: prev_idx - 1]  # conflict: truncate
+                return Message(0, {"term": self.current_term, "success": False})
+            entries = p.get("entries", [])
+            if not isinstance(entries, list):
+                # malformed batch: success would falsely advance the leader's
+                # match_index and let it commit entries we never appended
+                return Message(0, {"term": self.current_term, "success": False})
+            for e in entries:
+                if not isinstance(e, dict):
+                    return Message(0, {"term": self.current_term, "success": False})
+                idx = e.get("index")
+                eterm = e.get("term")
+                if not isinstance(idx, int) or not isinstance(eterm, int):
+                    return Message(0, {"term": self.current_term, "success": False})
+                if idx <= len(self.log):
+                    if self.log[idx - 1].term != eterm:
+                        self.log = self.log[: idx - 1]
+                    else:
+                        continue
+                if idx == len(self.log) + 1:
+                    self.log.append(
+                        LogEntry(
+                            eterm, idx, e.get("op", ""),
+                            e.get("data", {}) if isinstance(e.get("data"), dict) else {},
+                        )
+                    )
+                else:
+                    return Message(0, {"term": self.current_term, "success": False})
+            leader_commit = p.get("leader_commit", 0)
+            if isinstance(leader_commit, int) and leader_commit > self.commit_index:
+                self.commit_index = min(leader_commit, len(self.log))
+                self._apply_committed()
+            return Message(0, {"term": self.current_term, "success": True})
+
+    # -- membership (ref: AddVoter raft.go:1368) -----------------------------------
+    def add_voter(self, node_id: str) -> None:
+        with self._lock:
+            if node_id not in self.peer_ids and node_id != self.node_id:
+                self.peer_ids.append(node_id)
+                if self.state == LEADER:
+                    self.next_index[node_id] = len(self.log) + 1
+                    self.match_index[node_id] = 0
+
+
+class RaftCluster:
+    """Test/embedding helper: spin up N in-process Raft nodes."""
+
+    def __init__(self, n: int, network, storages: Optional[list[Engine]] = None,
+                 config: Optional[RaftConfig] = None, transports=None):
+        from nornicdb_tpu.replication.transport import InProcTransport
+
+        ids = [f"node-{i}" for i in range(n)]
+        self.nodes: list[RaftNode] = []
+        for i, nid in enumerate(ids):
+            t = transports[i] if transports else InProcTransport(nid, network)
+            storage = storages[i] if storages else None
+            self.nodes.append(
+                RaftNode(nid, t, ids, storage=storage, config=config, seed=i)
+            )
+
+    def start(self):
+        for n in self.nodes:
+            n.start()
+
+    def stop(self):
+        for n in self.nodes:
+            n.stop()
+
+    def leader(self, timeout: float = 5.0) -> Optional[RaftNode]:
+        deadline = time.time() + timeout
+        while time.time() < deadline:
+            leaders = [n for n in self.nodes if n.state == LEADER]
+            if len(leaders) == 1:
+                # stable when every live node agrees
+                return leaders[0]
+            time.sleep(0.02)
+        return None

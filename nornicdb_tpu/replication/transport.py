@@ -1,0 +1,250 @@
+"""Replication transport: typed, length-prefixed messages with
+request/response correlation.
+
+Behavioral reference: /root/reference/pkg/replication/transport.go:46-520 —
+1-byte message type + 4-byte length + JSON payload framing, pending-map
+request correlation (:359-435), TLS-optional TCP. Two implementations:
+
+  - InProcTransport: in-memory pipes for tests (the reference's MockTransport
+    pattern — replication_test.go mocks)
+  - TcpTransport: real sockets over DCN between TPU-VM hosts
+
+The device plane (search/top-k merge) never touches this layer — it rides
+ICI inside jit'd programs (SURVEY.md §5).
+"""
+
+from __future__ import annotations
+
+import json
+import socket
+import socketserver
+import struct
+import threading
+import time
+import uuid
+from dataclasses import dataclass, field
+from typing import Any, Callable, Optional
+
+from nornicdb_tpu.errors import ReplicationError
+
+# message types (ref: transport.go message type byte)
+MSG_REQUEST = 1
+MSG_RESPONSE = 2
+MSG_HEARTBEAT = 3
+MSG_WAL_BATCH = 4
+MSG_VOTE_REQUEST = 5
+MSG_VOTE_RESPONSE = 6
+MSG_APPEND_ENTRIES = 7
+MSG_APPEND_RESPONSE = 8
+MSG_FENCE = 9
+MSG_PROMOTE = 10
+MSG_SNAPSHOT = 11
+
+
+@dataclass
+class Message:
+    type: int
+    payload: dict[str, Any] = field(default_factory=dict)
+    request_id: str = ""
+    sender: str = ""
+
+    def encode(self) -> bytes:
+        body = json.dumps(
+            {"payload": self.payload, "request_id": self.request_id,
+             "sender": self.sender},
+            separators=(",", ":"),
+        ).encode()
+        return bytes([self.type]) + struct.pack(">I", len(body)) + body
+
+    @staticmethod
+    def decode(data: bytes) -> "Message":
+        if len(data) < 5:
+            raise ReplicationError("short message")
+        mtype = data[0]
+        (length,) = struct.unpack(">I", data[1:5])
+        body = data[5 : 5 + length]
+        obj = json.loads(body)
+        return Message(
+            mtype, obj.get("payload", {}), obj.get("request_id", ""),
+            obj.get("sender", ""),
+        )
+
+
+Handler = Callable[[Message], Optional[Message]]
+
+
+class Transport:
+    """Abstract peer-to-peer transport."""
+
+    def __init__(self, node_id: str):
+        self.node_id = node_id
+        self.handler: Optional[Handler] = None
+        self._pending: dict[str, threading.Event] = {}
+        self._responses: dict[str, Message] = {}
+        self._plock = threading.Lock()
+
+    def set_handler(self, handler: Handler) -> None:
+        self.handler = handler
+
+    # -- to be implemented --------------------------------------------------
+    def send(self, peer: str, msg: Message) -> None:
+        raise NotImplementedError
+
+    def peers(self) -> list[str]:
+        raise NotImplementedError
+
+    def close(self) -> None:
+        pass
+
+    # -- request/response correlation (ref: transport.go:359-435) -----------
+    def request(self, peer: str, msg: Message, timeout: float = 5.0) -> Message:
+        msg.request_id = str(uuid.uuid4())
+        msg.sender = self.node_id
+        ev = threading.Event()
+        with self._plock:
+            self._pending[msg.request_id] = ev
+        try:
+            self.send(peer, msg)
+            if not ev.wait(timeout):
+                raise ReplicationError(f"request to {peer} timed out")
+            with self._plock:
+                return self._responses.pop(msg.request_id)
+        finally:
+            with self._plock:
+                self._pending.pop(msg.request_id, None)
+                # a response landing between the timeout and this cleanup
+                # would otherwise be orphaned forever
+                self._responses.pop(msg.request_id, None)
+
+    def _deliver(self, msg: Message) -> None:
+        """Called by implementations when a message arrives."""
+        if msg.type == MSG_RESPONSE and msg.request_id:
+            with self._plock:
+                ev = self._pending.get(msg.request_id)
+                if ev is not None:
+                    self._responses[msg.request_id] = msg
+                    ev.set()
+                    return
+        if self.handler is not None:
+            reply = self.handler(msg)
+            if reply is not None and msg.request_id:
+                reply.type = MSG_RESPONSE
+                reply.request_id = msg.request_id
+                reply.sender = self.node_id
+                try:
+                    self.send(msg.sender, reply)
+                except Exception:
+                    pass
+
+
+class InProcNetwork:
+    """Shared registry connecting InProcTransports (test cluster in one
+    process — ref: replication mocks)."""
+
+    def __init__(self) -> None:
+        self.nodes: dict[str, "InProcTransport"] = {}
+        self._lock = threading.Lock()
+
+    def register(self, t: "InProcTransport") -> None:
+        with self._lock:
+            self.nodes[t.node_id] = t
+
+    def unregister(self, node_id: str) -> None:
+        with self._lock:
+            self.nodes.pop(node_id, None)
+
+    def route(self, target: str, msg: Message) -> None:
+        with self._lock:
+            node = self.nodes.get(target)
+        if node is None or not node.alive:
+            raise ReplicationError(f"peer {target} unreachable")
+        node._incoming(msg)
+
+
+class InProcTransport(Transport):
+    def __init__(self, node_id: str, network: InProcNetwork):
+        super().__init__(node_id)
+        self.network = network
+        self.alive = True
+        network.register(self)
+
+    def send(self, peer: str, msg: Message) -> None:
+        if not self.alive:
+            raise ReplicationError("transport closed")
+        if not msg.sender:
+            msg.sender = self.node_id
+        # deliver on a worker thread: network IO is asynchronous
+        encoded = msg.encode()  # exercise the wire codec
+
+        def _deliver():
+            try:
+                self.network.route(peer, Message.decode(encoded))
+            except ReplicationError:
+                pass
+
+        threading.Thread(target=_deliver, daemon=True).start()
+
+    def _incoming(self, msg: Message) -> None:
+        self._deliver(msg)
+
+    def peers(self) -> list[str]:
+        return [n for n in self.network.nodes if n != self.node_id]
+
+    def close(self) -> None:
+        self.alive = False
+        self.network.unregister(self.node_id)
+
+
+class TcpTransport(Transport):
+    """Real TCP transport (ref: transport.go TCP+TLS). Peer addresses are
+    provided as {node_id: (host, port)}."""
+
+    def __init__(self, node_id: str, bind: tuple[str, int],
+                 peer_addrs: dict[str, tuple[str, int]]):
+        super().__init__(node_id)
+        self.peer_addrs = dict(peer_addrs)
+        outer = self
+
+        class _Handler(socketserver.BaseRequestHandler):
+            def handle(self):
+                try:
+                    header = _read_exact(self.request, 5)
+                    (length,) = struct.unpack(">I", header[1:5])
+                    body = _read_exact(self.request, length)
+                    outer._deliver(Message.decode(header + body))
+                except Exception:
+                    pass
+
+        self._server = socketserver.ThreadingTCPServer(bind, _Handler)
+        self._server.daemon_threads = True
+        self.bind = self._server.server_address
+        self._thread = threading.Thread(
+            target=self._server.serve_forever, daemon=True
+        )
+        self._thread.start()
+
+    def send(self, peer: str, msg: Message) -> None:
+        addr = self.peer_addrs.get(peer)
+        if addr is None:
+            raise ReplicationError(f"unknown peer {peer}")
+        if not msg.sender:
+            msg.sender = self.node_id
+        with socket.create_connection(addr, timeout=5) as s:
+            s.sendall(msg.encode())
+
+    def peers(self) -> list[str]:
+        return list(self.peer_addrs)
+
+    def close(self) -> None:
+        self._server.shutdown()
+        self._server.server_close()
+
+
+def _read_exact(sock, n: int) -> bytes:
+    buf = b""
+    while len(buf) < n:
+        part = sock.recv(n - len(buf))
+        if not part:
+            raise ReplicationError("connection closed")
+        buf += part
+    return buf

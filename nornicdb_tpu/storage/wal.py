@@ -65,6 +65,34 @@ class WALEntry:
         return rec + b"\x00" * pad
 
 
+def apply_storage_op(engine: Engine, op: str, d: dict[str, Any]) -> None:
+    """Apply one logged storage op. Shared by WAL recovery and the
+    replication layer (HA shipping, Raft apply) so the dispatch never forks.
+
+    Idempotent-best-effort: duplicate create / missing delete after a
+    snapshot race is not fatal (ref: wal.go replay tolerates
+    AlreadyExists/NotFound during recovery)."""
+    try:
+        if op == OP_CREATE_NODE:
+            engine.create_node(Node.from_dict(d))
+        elif op == OP_UPDATE_NODE:
+            engine.update_node(Node.from_dict(d))
+        elif op == OP_DELETE_NODE:
+            engine.delete_node(d["id"])
+        elif op == OP_CREATE_EDGE:
+            engine.create_edge(Edge.from_dict(d))
+        elif op == OP_UPDATE_EDGE:
+            engine.update_edge(Edge.from_dict(d))
+        elif op == OP_DELETE_EDGE:
+            engine.delete_edge(d["id"])
+        elif op == OP_MARK_PENDING:
+            engine.mark_pending_embed(d["id"])
+        elif op == OP_UNMARK_PENDING:
+            engine.unmark_pending_embed(d["id"])
+    except Exception:
+        pass
+
+
 @dataclass
 class WALStats:
     entries: int = 0
@@ -234,29 +262,7 @@ class WAL:
 
     @staticmethod
     def _apply(engine: Engine, e: WALEntry) -> None:
-        op, d = e.op, e.data
-        try:
-            if op == OP_CREATE_NODE:
-                engine.create_node(Node.from_dict(d))
-            elif op == OP_UPDATE_NODE:
-                engine.update_node(Node.from_dict(d))
-            elif op == OP_DELETE_NODE:
-                engine.delete_node(d["id"])
-            elif op == OP_CREATE_EDGE:
-                engine.create_edge(Edge.from_dict(d))
-            elif op == OP_UPDATE_EDGE:
-                engine.update_edge(Edge.from_dict(d))
-            elif op == OP_DELETE_EDGE:
-                engine.delete_edge(d["id"])
-            elif op == OP_MARK_PENDING:
-                engine.mark_pending_embed(d["id"])
-            elif op == OP_UNMARK_PENDING:
-                engine.unmark_pending_embed(d["id"])
-        except Exception:
-            # Replay is idempotent-best-effort: duplicate create / missing
-            # delete after a snapshot race is not fatal (ref: wal.go replay
-            # tolerates AlreadyExists/NotFound during recovery).
-            pass
+        apply_storage_op(engine, e.op, e.data)
 
     def close(self) -> None:
         with self._lock:

@@ -1,0 +1,289 @@
+"""Replication tests — modeled on the reference's in-process distributed
+testing strategy (pkg/replication/replication_test.go mocks,
+chaos_test.go:446 ChaosTransport, scenario_test.go election/failover/
+promote/fencing scenarios). No real cluster needed."""
+
+import time
+
+import pytest
+
+from nornicdb_tpu.errors import ReplicationError
+from nornicdb_tpu.replication import (
+    LEADER,
+    ChaosConfig,
+    ChaosTransport,
+    HAConfig,
+    HAPrimary,
+    HAStandby,
+    InProcNetwork,
+    InProcTransport,
+    Message,
+    RaftCluster,
+    RaftConfig,
+    ReplicatedEngine,
+    TcpTransport,
+)
+from nornicdb_tpu.replication.transport import MSG_REQUEST
+from nornicdb_tpu.storage import MemoryEngine, Node
+
+
+def _wait(pred, timeout=5.0, interval=0.02):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        if pred():
+            return True
+        time.sleep(interval)
+    return False
+
+
+class TestTransport:
+    def test_message_codec_roundtrip(self):
+        m = Message(MSG_REQUEST, {"k": [1, "two", None]}, "rid", "n1")
+        back = Message.decode(m.encode())
+        assert (back.type, back.payload, back.request_id, back.sender) == (
+            m.type, m.payload, m.request_id, m.sender,
+        )
+
+    def test_inproc_request_response(self):
+        net = InProcNetwork()
+        a = InProcTransport("a", net)
+        b = InProcTransport("b", net)
+        b.set_handler(lambda msg: Message(0, {"echo": msg.payload["x"] * 2}))
+        resp = a.request("b", Message(MSG_REQUEST, {"x": 21}))
+        assert resp.payload["echo"] == 42
+        a.close(); b.close()
+
+    def test_unreachable_peer_times_out(self):
+        net = InProcNetwork()
+        a = InProcTransport("a", net)
+        with pytest.raises(ReplicationError):
+            a.request("ghost", Message(MSG_REQUEST, {}), timeout=0.2)
+        a.close()
+
+    def test_tcp_transport(self):
+        t1 = TcpTransport("t1", ("127.0.0.1", 0), {})
+        t2 = TcpTransport("t2", ("127.0.0.1", 0), {})
+        t1.peer_addrs["t2"] = t2.bind
+        t2.peer_addrs["t1"] = t1.bind
+        t2.set_handler(lambda msg: Message(0, {"pong": True}))
+        resp = t1.request("t2", Message(MSG_REQUEST, {"ping": 1}), timeout=3)
+        assert resp.payload == {"pong": True}
+        t1.close(); t2.close()
+
+
+class TestHAStandby:
+    def _pair(self, chaos: ChaosConfig = None):
+        net = InProcNetwork()
+        pt = InProcTransport("primary", net)
+        st = InProcTransport("standby", net)
+        if chaos is not None:
+            pt = ChaosTransport(pt, chaos)
+        p_eng = ReplicatedEngine(MemoryEngine())
+        s_eng = MemoryEngine()
+        cfg = HAConfig(batch_interval=0.02, heartbeat_interval=0.02,
+                       heartbeat_timeout=0.3)
+        primary = HAPrimary(p_eng, pt, "standby", cfg)
+        standby = HAStandby(s_eng, st, "primary", cfg)
+        return primary, standby, p_eng, s_eng
+
+    def test_wal_shipping(self):
+        primary, standby, p_eng, s_eng = self._pair()
+        primary.start()
+        try:
+            p_eng.create_node(Node(id="a", properties={"v": 1}))
+            p_eng.create_node(Node(id="b"))
+            p_eng.create_edge(
+                __import__("nornicdb_tpu.storage", fromlist=["Edge"]).Edge(
+                    id="e", start_node="a", end_node="b"
+                )
+            )
+            assert _wait(lambda: s_eng.node_count() == 2 and s_eng.edge_count() == 1)
+            assert s_eng.get_node("a").properties["v"] == 1
+        finally:
+            primary.stop()
+
+    def test_update_delete_replicate(self):
+        primary, standby, p_eng, s_eng = self._pair()
+        primary.start()
+        try:
+            p_eng.create_node(Node(id="x", properties={"v": 1}))
+            n = p_eng.get_node("x")
+            n.properties["v"] = 2
+            p_eng.update_node(n)
+            assert _wait(lambda: s_eng.node_count() == 1
+                         and s_eng.get_node("x").properties.get("v") == 2)
+            p_eng.delete_node("x")
+            assert _wait(lambda: s_eng.node_count() == 0)
+        finally:
+            primary.stop()
+
+    def test_heartbeat_detection(self):
+        primary, standby, p_eng, s_eng = self._pair()
+        primary.start()
+        assert _wait(lambda: standby.heartbeat_healthy())
+        primary.stop()
+        assert _wait(lambda: not standby.heartbeat_healthy(), timeout=2.0)
+
+    def test_fencing_blocks_writes(self):
+        primary, standby, p_eng, s_eng = self._pair()
+        primary.fence()
+        with pytest.raises(ReplicationError):
+            p_eng.create_node(Node(id="nope"))
+
+    def test_promote_fences_old_primary(self):
+        primary, standby, p_eng, s_eng = self._pair()
+        primary.start()
+        try:
+            p_eng.create_node(Node(id="pre"))
+            assert _wait(lambda: s_eng.node_count() == 1)
+            new_engine = standby.promote()
+            assert standby.promoted
+            # old primary is fenced now
+            assert _wait(lambda: p_eng.fenced, timeout=2.0)
+            with pytest.raises(ReplicationError):
+                p_eng.create_node(Node(id="after-fence"))
+            # new primary accepts writes
+            new_engine.create_node(Node(id="post-promote"))
+            assert s_eng.node_count() == 2
+        finally:
+            primary.stop()
+
+    def test_shipping_survives_packet_loss(self):
+        """(ref: chaos_test.go loss scenarios) — at-least-once shipping with
+        dedup by sequence number."""
+        chaos = ChaosConfig(loss_rate=0.3, seed=7)
+        primary, standby, p_eng, s_eng = self._pair(chaos)
+        primary.start()
+        try:
+            for i in range(30):
+                p_eng.create_node(Node(id=f"n{i}"))
+            assert _wait(lambda: s_eng.node_count() == 30, timeout=10)
+        finally:
+            primary.stop()
+
+    def test_shipping_survives_duplication_and_reorder(self):
+        chaos = ChaosConfig(duplicate_rate=0.4, reorder_rate=0.4,
+                            latency_jitter=0.01, seed=3)
+        primary, standby, p_eng, s_eng = self._pair(chaos)
+        primary.start()
+        try:
+            for i in range(20):
+                p_eng.create_node(Node(id=f"d{i}", properties={"i": i}))
+            assert _wait(lambda: s_eng.node_count() == 20, timeout=10)
+            # exactly once applied despite duplicates
+            assert s_eng.node_count() == 20
+        finally:
+            primary.stop()
+
+    def test_corrupted_batches_dont_crash_standby(self):
+        chaos = ChaosConfig(corrupt_rate=0.5, seed=11)
+        primary, standby, p_eng, s_eng = self._pair(chaos)
+        primary.start()
+        try:
+            for i in range(20):
+                p_eng.create_node(Node(id=f"c{i}"))
+            # corrupted batches are skipped; retries eventually deliver all
+            assert _wait(lambda: s_eng.node_count() == 20, timeout=10)
+        finally:
+            primary.stop()
+
+
+FAST = RaftConfig(election_timeout_min=0.05, election_timeout_max=0.15,
+                  heartbeat_interval=0.02)
+
+
+class TestRaft:
+    def test_elects_single_leader(self):
+        net = InProcNetwork()
+        cluster = RaftCluster(3, net, config=FAST)
+        cluster.start()
+        try:
+            leader = cluster.leader()
+            assert leader is not None
+            others = [n for n in cluster.nodes if n is not leader]
+            assert _wait(lambda: all(n.leader_id == leader.node_id for n in others))
+        finally:
+            cluster.stop()
+
+    def test_replicates_and_applies_to_storage(self):
+        net = InProcNetwork()
+        storages = [MemoryEngine() for _ in range(3)]
+        cluster = RaftCluster(3, net, storages=storages, config=FAST)
+        cluster.start()
+        try:
+            leader = cluster.leader()
+            node = Node(id="raft-node", properties={"v": 1})
+            leader.propose("create_node", node.to_dict())
+            assert _wait(
+                lambda: all(s.node_count() == 1 for s in storages), timeout=5
+            )
+            assert storages[0].get_node("raft-node").properties["v"] == 1
+        finally:
+            cluster.stop()
+
+    def test_follower_rejects_propose(self):
+        net = InProcNetwork()
+        cluster = RaftCluster(3, net, config=FAST)
+        cluster.start()
+        try:
+            leader = cluster.leader()
+            follower = next(n for n in cluster.nodes if n is not leader)
+            with pytest.raises(ReplicationError):
+                follower.propose("create_node", {})
+        finally:
+            cluster.stop()
+
+    def test_failover_elects_new_leader(self):
+        """(ref: scenario_test.go failover scenarios)"""
+        net = InProcNetwork()
+        cluster = RaftCluster(3, net, config=FAST)
+        cluster.start()
+        try:
+            leader = cluster.leader()
+            # kill the leader
+            leader.stop()
+            leader.transport.close()
+            remaining = [n for n in cluster.nodes if n is not leader]
+            assert _wait(
+                lambda: any(n.state == LEADER for n in remaining), timeout=5
+            )
+            new_leader = next(n for n in remaining if n.state == LEADER)
+            assert new_leader.current_term > leader.current_term - 1
+        finally:
+            cluster.stop()
+
+    def test_committed_entries_survive_failover(self):
+        net = InProcNetwork()
+        storages = [MemoryEngine() for _ in range(3)]
+        cluster = RaftCluster(3, net, storages=storages, config=FAST)
+        cluster.start()
+        try:
+            leader = cluster.leader()
+            leader.propose("create_node", Node(id="durable").to_dict())
+            assert _wait(lambda: all(s.node_count() == 1 for s in storages))
+            leader.stop()
+            leader.transport.close()
+            remaining = [n for n in cluster.nodes if n is not leader]
+            assert _wait(lambda: any(n.state == LEADER for n in remaining))
+            new_leader = next(n for n in remaining if n.state == LEADER)
+            new_leader.propose("create_node", Node(id="post-failover").to_dict())
+            live = [s for n, s in zip(cluster.nodes, storages) if n is not leader]
+            assert _wait(lambda: all(s.node_count() == 2 for s in live))
+        finally:
+            cluster.stop()
+
+    def test_election_under_packet_loss(self):
+        """(ref: chaos_test.go mixed failures)"""
+        net = InProcNetwork()
+        transports = [
+            ChaosTransport(InProcTransport(f"node-{i}", net),
+                           ChaosConfig(loss_rate=0.15, seed=i))
+            for i in range(3)
+        ]
+        cluster = RaftCluster(3, net, config=FAST, transports=transports)
+        cluster.start()
+        try:
+            leader = cluster.leader(timeout=10)
+            assert leader is not None
+        finally:
+            cluster.stop()
