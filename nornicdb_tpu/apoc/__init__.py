@@ -1,0 +1,15 @@
+"""APOC-compatible function/procedure library (ref: /root/reference/apoc/ —
+850+ functions in ~45 categories; this build implements the core categories:
+coll, text, map, math, number, convert, json, date, temporal, hashing, meta,
+label, node, rel, any, util, create, merge, refactor, neighbors, path,
+periodic)."""
+
+from nornicdb_tpu.apoc import functions as _functions  # noqa: F401 — registers
+from nornicdb_tpu.apoc.registry import all_functions, call, categories, lookup
+
+__all__ = ["all_functions", "call", "categories", "lookup"]
+
+
+def register_procedures() -> None:
+    """Import the storage-touching procedures into the Cypher registry."""
+    from nornicdb_tpu.apoc import procedures as _procedures  # noqa: F401

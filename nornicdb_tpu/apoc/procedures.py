@@ -1,0 +1,219 @@
+"""APOC graph procedures (storage-touching).
+
+Behavioral reference: /root/reference/apoc/create, merge, refactor, path(s),
+periodic, neighbors categories; wired through the Cypher procedure registry
+the way the reference routes CALL apoc.* via its registry
+(pkg/cypher/call.go, apoc/apoc.go:121).
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+from nornicdb_tpu.cypher.executor import CypherExecutor, procedure
+from nornicdb_tpu.errors import CypherSyntaxError
+from nornicdb_tpu.storage.types import Edge, Node, new_id
+
+
+@procedure("apoc.create.node")
+def apoc_create_node(ex: CypherExecutor, args, row):
+    labels = args[0] if args else []
+    props = args[1] if len(args) > 1 else {}
+    node = Node(labels=list(labels or []), properties=dict(props or {}))
+    created = ex.storage.create_node(node)
+    return ["node"], [[created]]
+
+
+@procedure("apoc.create.nodes")
+def apoc_create_nodes(ex: CypherExecutor, args, row):
+    labels = args[0] if args else []
+    props_list = args[1] if len(args) > 1 else []
+    out = []
+    for props in props_list or []:
+        node = Node(labels=list(labels or []), properties=dict(props or {}))
+        out.append([ex.storage.create_node(node)])
+    return ["node"], out
+
+
+@procedure("apoc.create.relationship")
+def apoc_create_rel(ex: CypherExecutor, args, row):
+    if len(args) < 4:
+        raise CypherSyntaxError("apoc.create.relationship(from, type, props, to)")
+    from_n, rel_type, props, to_n = args[0], args[1], args[2], args[3]
+    edge = Edge(
+        start_node=from_n.id if isinstance(from_n, Node) else str(from_n),
+        end_node=to_n.id if isinstance(to_n, Node) else str(to_n),
+        type=str(rel_type),
+        properties=dict(props or {}),
+    )
+    created = ex.storage.create_edge(edge)
+    return ["rel"], [[created]]
+
+
+@procedure("apoc.create.uuid")
+def apoc_uuid(ex: CypherExecutor, args, row):
+    return ["uuid"], [[new_id()]]
+
+
+@procedure("apoc.merge.node")
+def apoc_merge_node(ex: CypherExecutor, args, row):
+    """(ref: apoc/merge) — match on identProps, set onCreateProps when new."""
+    labels = args[0] if args else []
+    ident = args[1] if len(args) > 1 else {}
+    on_create = args[2] if len(args) > 2 else {}
+    if not ident:
+        raise CypherSyntaxError(
+            "apoc.merge.node: you need to supply at least one identifying property"
+        )
+    for n in ex.storage.get_nodes_by_label(labels[0]) if labels else ex.storage.all_nodes():
+        if all(n.properties.get(k) == v for k, v in (ident or {}).items()):
+            if all(l in n.labels for l in labels or []):
+                return ["node"], [[n]]
+    node = Node(labels=list(labels or []),
+                properties={**(ident or {}), **(on_create or {})})
+    return ["node"], [[ex.storage.create_node(node)]]
+
+
+@procedure("apoc.merge.relationship")
+def apoc_merge_rel(ex: CypherExecutor, args, row):
+    from_n, rel_type = args[0], str(args[1])
+    ident = args[2] if len(args) > 2 else {}
+    on_create = args[3] if len(args) > 3 else {}
+    to_n = args[4] if len(args) > 4 else None
+    for e in ex.storage.get_outgoing_edges(from_n.id):
+        if e.type == rel_type and e.end_node == to_n.id and all(
+            e.properties.get(k) == v for k, v in (ident or {}).items()
+        ):
+            return ["rel"], [[e]]
+    edge = Edge(
+        start_node=from_n.id, end_node=to_n.id, type=rel_type,
+        properties={**(ident or {}), **(on_create or {})},
+    )
+    return ["rel"], [[ex.storage.create_edge(edge)]]
+
+
+@procedure("apoc.refactor.rename.label")
+def apoc_rename_label(ex: CypherExecutor, args, row):
+    old, new = str(args[0]), str(args[1])
+    count = 0
+    for n in ex.storage.get_nodes_by_label(old):
+        n.labels = [new if l == old else l for l in n.labels]
+        ex.storage.update_node(n)
+        count += 1
+    return ["total"], [[count]]
+
+
+@procedure("apoc.refactor.rename.type")
+def apoc_rename_type(ex: CypherExecutor, args, row):
+    old, new = str(args[0]), str(args[1])
+    count = 0
+    for e in ex.storage.get_edges_by_type(old):
+        e.type = new
+        ex.storage.update_edge(e)
+        count += 1
+    return ["total"], [[count]]
+
+
+@procedure("apoc.node.degree")
+def apoc_node_degree(ex: CypherExecutor, args, row):
+    node = args[0]
+    direction = str(args[1]) if len(args) > 1 else "both"
+    d = ex.storage.degree(node.id, direction.lower().strip("<>") or "both")
+    return ["value"], [[d]]
+
+
+@procedure("apoc.neighbors.tohop")
+def apoc_neighbors(ex: CypherExecutor, args, row):
+    node = args[0]
+    hops = int(args[2]) if len(args) > 2 else int(args[1]) if len(args) > 1 and not isinstance(args[1], str) else 1
+    seen = {node.id}
+    frontier = [node.id]
+    out = []
+    for _ in range(hops):
+        nxt = []
+        for nid in frontier:
+            for e in ex.storage.get_outgoing_edges(nid):
+                if e.end_node not in seen:
+                    seen.add(e.end_node)
+                    nxt.append(e.end_node)
+            for e in ex.storage.get_incoming_edges(nid):
+                if e.start_node not in seen:
+                    seen.add(e.start_node)
+                    nxt.append(e.start_node)
+        for nid in nxt:
+            n = ex.get_node_or_none(nid)
+            if n is not None:
+                out.append([n])
+        frontier = nxt
+    return ["node"], out
+
+
+@procedure("apoc.path.subgraphnodes")
+def apoc_subgraph_nodes(ex: CypherExecutor, args, row):
+    node = args[0]
+    cfg = args[1] if len(args) > 1 and isinstance(args[1], dict) else {}
+    max_level = int(cfg.get("maxLevel", 3))
+    seen = {node.id}
+    frontier = [node.id]
+    out = [[node]]
+    for _ in range(max_level):
+        nxt = []
+        for nid in frontier:
+            for e in ex.storage.get_outgoing_edges(nid):
+                if e.end_node not in seen:
+                    seen.add(e.end_node)
+                    nxt.append(e.end_node)
+            for e in ex.storage.get_incoming_edges(nid):
+                if e.start_node not in seen:
+                    seen.add(e.start_node)
+                    nxt.append(e.start_node)
+        for nid in nxt:
+            n = ex.get_node_or_none(nid)
+            if n is not None:
+                out.append([n])
+        frontier = nxt
+    return ["node"], out
+
+
+@procedure("apoc.periodic.iterate")
+def apoc_periodic_iterate(ex: CypherExecutor, args, row):
+    """(ref: apoc/periodic, pkg/cypher/call_apoc_periodic.go) — run the outer
+    query, then the inner update in batches binding each outer row."""
+    if len(args) < 2:
+        raise CypherSyntaxError(
+            "apoc.periodic.iterate(outerQuery, innerQuery, config)"
+        )
+    outer_q, inner_q = str(args[0]), str(args[1])
+    cfg = args[2] if len(args) > 2 and isinstance(args[2], dict) else {}
+    batch_size = int(cfg.get("batchSize", 1000))
+    outer = ex.execute(outer_q)
+    total = len(outer.rows)
+    batches = 0
+    failed = 0
+    from nornicdb_tpu.cypher.parser import parse as _parse
+    from nornicdb_tpu.cypher import ast as _ast
+
+    inner_stmt = _parse(inner_q)
+    if not isinstance(inner_stmt, _ast.Query):
+        raise CypherSyntaxError("inner query must be a Cypher query")
+    for start in range(0, total, batch_size):
+        batch_rows = [
+            dict(zip(outer.columns, r)) for r in outer.rows[start : start + batch_size]
+        ]
+        batches += 1
+        try:
+            ex._run_query(inner_stmt, {}, start_rows=batch_rows)
+        except Exception:
+            failed += 1
+    return (
+        ["batches", "total", "errorMessages", "failedBatches"],
+        [[batches, total, {}, failed]],
+    )
+
+
+@procedure("apoc.help")
+def apoc_help(ex: CypherExecutor, args, row):
+    from nornicdb_tpu.apoc.registry import all_functions
+
+    prefix = str(args[0]).lower() if args else ""
+    return ["name"], [[f] for f in all_functions() if prefix in f]

@@ -1,0 +1,199 @@
+"""Configuration: YAML file + environment + runtime feature flags.
+
+Behavioral reference: /root/reference/pkg/config/config.go:82-420
+(Config, LoadFromFile/LoadFromEnv, FindConfigFile discovery),
+feature_flags.go:210-506 (mutex-guarded flag registry with helpers like
+IsKalmanEnabled/IsAutoTLPEnabled and test helpers WithXEnabled).
+Precedence: explicit args > YAML > env > defaults
+(ref: cmd/nornicdb/main.go:246-309).
+"""
+
+from __future__ import annotations
+
+import contextlib
+import os
+import threading
+from dataclasses import dataclass, field, fields
+from typing import Any, Optional
+
+CONFIG_FILENAMES = ("nornicdb.yaml", "nornicdb.yml", ".nornicdb.yaml")
+ENV_PREFIX = "NORNICDB_"
+
+
+@dataclass
+class ServerConfig:
+    host: str = "0.0.0.0"
+    http_port: int = 7474
+    bolt_port: int = 7687
+    auth_enabled: bool = False
+    base_path: str = ""
+
+
+@dataclass
+class DatabaseConfig:
+    data_dir: str = ""
+    encryption_enabled: bool = False
+    encryption_key: str = ""
+    async_writes: bool = True
+    wal_sync: bool = False
+    auto_compact_interval: float = 300.0
+
+
+@dataclass
+class EmbeddingConfig:
+    enabled: bool = True
+    provider: str = "tpu"  # tpu | hash
+    dimensions: int = 1024
+    chunk_tokens: int = 512
+    chunk_overlap: int = 50
+    workers: int = 1
+    cache_size: int = 10000
+
+
+@dataclass
+class MemoryConfig:
+    decay_enabled: bool = False
+    decay_interval: float = 3600.0
+    archive_threshold: float = 0.05
+    query_cache_size: int = 1000
+    query_cache_ttl: float = 60.0
+
+
+@dataclass
+class ComplianceConfig:
+    audit_enabled: bool = False
+    audit_path: str = ""
+    retention_enabled: bool = False
+
+
+@dataclass
+class AppConfig:
+    server: ServerConfig = field(default_factory=ServerConfig)
+    database: DatabaseConfig = field(default_factory=DatabaseConfig)
+    embedding: EmbeddingConfig = field(default_factory=EmbeddingConfig)
+    memory: MemoryConfig = field(default_factory=MemoryConfig)
+    compliance: ComplianceConfig = field(default_factory=ComplianceConfig)
+
+
+def find_config_file(start_dir: str = ".") -> Optional[str]:
+    """(ref: FindConfigFile config.go)"""
+    d = os.path.abspath(start_dir)
+    while True:
+        for name in CONFIG_FILENAMES:
+            p = os.path.join(d, name)
+            if os.path.exists(p):
+                return p
+        parent = os.path.dirname(d)
+        if parent == d:
+            return None
+        d = parent
+
+
+def _apply_dict(cfg: Any, data: dict) -> None:
+    for f in fields(cfg):
+        if f.name in data:
+            v = data[f.name]
+            current = getattr(cfg, f.name)
+            if hasattr(current, "__dataclass_fields__") and isinstance(v, dict):
+                _apply_dict(current, v)
+            else:
+                setattr(cfg, f.name, type(current)(v) if current is not None else v)
+
+
+def load_from_file(path: str, cfg: Optional[AppConfig] = None) -> AppConfig:
+    import yaml
+
+    cfg = cfg or AppConfig()
+    with open(path) as f:
+        data = yaml.safe_load(f) or {}
+    _apply_dict(cfg, data)
+    return cfg
+
+
+def load_from_env(cfg: Optional[AppConfig] = None) -> AppConfig:
+    """NORNICDB_<SECTION>_<FIELD> (ref: LoadFromEnv)."""
+    cfg = cfg or AppConfig()
+    for section_field in fields(cfg):
+        section = getattr(cfg, section_field.name)
+        for f in fields(section):
+            env = f"{ENV_PREFIX}{section_field.name.upper()}_{f.name.upper()}"
+            if env in os.environ:
+                raw = os.environ[env]
+                current = getattr(section, f.name)
+                if isinstance(current, bool):
+                    setattr(section, f.name, raw.lower() in ("1", "true", "yes"))
+                elif isinstance(current, int):
+                    setattr(section, f.name, int(raw))
+                elif isinstance(current, float):
+                    setattr(section, f.name, float(raw))
+                else:
+                    setattr(section, f.name, raw)
+    return cfg
+
+
+def load(start_dir: str = ".") -> AppConfig:
+    cfg = AppConfig()
+    path = find_config_file(start_dir)
+    if path:
+        load_from_file(path, cfg)
+    load_from_env(cfg)
+    return cfg
+
+
+# ---------------------------------------------------------------- flags
+class FeatureFlags:
+    """Runtime feature-flag registry (ref: feature_flags.go:210-506)."""
+
+    DEFAULTS = {
+        "kalman": True,
+        "auto_tlp": True,
+        "llm_qc": False,
+        "gpu_clustering": True,  # kept name for parity; means TPU k-means
+        "cooldowns": True,
+        "mmr": False,
+        "cross_encoder_rerank": False,
+        "query_cache": True,
+    }
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._flags = dict(self.DEFAULTS)
+        # env overrides: NORNICDB_FLAG_<NAME>=true/false
+        for name in list(self._flags):
+            env = os.environ.get(f"{ENV_PREFIX}FLAG_{name.upper()}")
+            if env is not None:
+                self._flags[name] = env.lower() in ("1", "true", "yes")
+
+    def is_enabled(self, name: str) -> bool:
+        with self._lock:
+            return bool(self._flags.get(name, False))
+
+    def set(self, name: str, value: bool) -> None:
+        with self._lock:
+            self._flags[name] = value
+
+    def all(self) -> dict[str, bool]:
+        with self._lock:
+            return dict(self._flags)
+
+    @contextlib.contextmanager
+    def with_enabled(self, name: str, value: bool = True):
+        """Test helper (ref: WithXEnabled test helpers)."""
+        with self._lock:
+            old = self._flags.get(name)
+            self._flags[name] = value
+        try:
+            yield
+        finally:
+            with self._lock:
+                self._flags[name] = old
+
+    # parity helpers (ref: IsKalmanEnabled :350, IsAutoTLPEnabled :430)
+    def is_kalman_enabled(self) -> bool:
+        return self.is_enabled("kalman")
+
+    def is_auto_tlp_enabled(self) -> bool:
+        return self.is_enabled("auto_tlp")
+
+
+flags = FeatureFlags()

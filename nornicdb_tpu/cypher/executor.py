@@ -86,10 +86,12 @@ class CypherExecutor:
         storage: Engine,
         schema: Optional[SchemaManager] = None,
         db=None,
+        cache=None,
     ):
         self.storage = storage
         self.schema = schema or SchemaManager()
         self.db = db  # DB facade: embedder, search service, multidb hooks
+        self.cache = cache  # QueryCache (ref: pkg/cache wiring main.go:320)
         self.matcher = PatternMatcher(storage, self.schema, self)
         self._plugin_functions: dict[str, Callable] = {}
         # explicit transaction state (ref: executor.go tx statements :611)
@@ -99,10 +101,36 @@ class CypherExecutor:
 
     # -- public ----------------------------------------------------------------
     def execute(self, query: str, params: Optional[dict[str, Any]] = None) -> Result:
-        """(ref: Execute executor.go:490)"""
+        """(ref: Execute executor.go:490 — analyze -> cache -> route)"""
         self.query_count += 1
         params = params or {}
         stmt = parse(query)
+        if self.cache is not None and isinstance(stmt, ast.Query):
+            write = _is_write_query(stmt)
+            if self._tx_undo is not None and not write:
+                # reads inside an explicit tx bypass the cache entirely:
+                # no stale serve, no spurious invalidation
+                return self.execute_statement(stmt, params)
+            if not write:
+                hit = self.cache.get(query, params)
+                if hit is not None:
+                    return hit
+                result = self.execute_statement(stmt, params)
+                if not _is_nondeterministic(stmt):
+                    # reads with unlabeled dependencies get EMPTY label sets,
+                    # which invalidate_labels always drops — soundness over
+                    # retention
+                    self.cache.put(
+                        query, params, result, _read_cache_labels(stmt)
+                    )
+                return result
+            result = self.execute_statement(stmt, params)
+            labels = _write_labels(stmt)
+            if labels:
+                self.cache.invalidate_labels(labels)
+            else:
+                self.cache.clear()  # unscoped write: drop everything
+            return result
         return self.execute_statement(stmt, params)
 
     def execute_statement(self, stmt: ast.Statement, params: dict[str, Any]) -> Result:
@@ -1071,6 +1099,163 @@ class CypherExecutor:
 
 
 # ---------------------------------------------------------------- helpers
+_WRITE_CLAUSES = (
+    ast.CreateClause, ast.MergeClause, ast.SetClause, ast.RemoveClause,
+    ast.DeleteClause, ast.ForeachClause, ast.LoadCsvClause,
+)
+
+
+# procedures known to be pure reads; everything else is treated as a write
+_READONLY_PROCEDURES = (
+    "db.labels", "db.relationshiptypes", "db.propertykeys",
+    "dbms.components", "db.index.vector.querynodes",
+    "db.index.fulltext.querynodes", "apoc.help", "gds.linkprediction.",
+    "gds.fastrp.",
+)
+
+_NONDETERMINISTIC_FNS = {
+    "rand", "randomuuid", "timestamp",
+    "apoc.create.uuid", "apoc.text.random", "apoc.date.currenttimestamp",
+    "apoc.coll.shuffle", "apoc.coll.randomitem",
+}
+
+
+def _is_write_query(q: ast.Query) -> bool:
+    for c in q.clauses:
+        if isinstance(c, _WRITE_CLAUSES):
+            return True
+        if isinstance(c, ast.CallClause) and not c.procedure.startswith(
+            _READONLY_PROCEDURES
+        ):
+            return True  # index DDL procs / apoc.create / unknown may mutate
+        if isinstance(c, ast.CallSubquery) and _is_write_query(c.query):
+            return True
+    return any(_is_write_query(sub) for sub, _ in q.unions)
+
+
+def _walk_exprs(q: ast.Query):
+    """Yield every expression node reachable from the query's clauses."""
+
+    def walk(e):
+        if e is None:
+            return
+        yield e
+        for f in getattr(e, "__dataclass_fields__", {}):
+            v = getattr(e, f)
+            if isinstance(v, list):
+                for x in v:
+                    if hasattr(x, "__dataclass_fields__"):
+                        yield from walk(x)
+            elif isinstance(v, dict):
+                for x in v.values():
+                    if hasattr(x, "__dataclass_fields__"):
+                        yield from walk(x)
+            elif hasattr(v, "__dataclass_fields__"):
+                yield from walk(v)
+
+    for c in q.clauses:
+        yield from walk(c)
+    for sub, _ in q.unions:
+        yield from _walk_exprs(sub)
+
+
+def _is_nondeterministic(q: ast.Query) -> bool:
+    for node in _walk_exprs(q):
+        if isinstance(node, ast.FunctionCall):
+            name = node.name
+            if name in _NONDETERMINISTIC_FNS or name.startswith("kalman."):
+                return True
+    return False
+
+
+def _pattern_labels(p: ast.PatternPath) -> tuple[set[str], bool]:
+    """(labels, fully_labeled): fully_labeled=False when any node pattern
+    has no label (the read could match anything)."""
+    labels: set[str] = set()
+    fully = True
+    for el in p.elements:
+        if isinstance(el, ast.NodePattern):
+            if el.labels:
+                labels.update(el.labels)
+            else:
+                fully = False
+    return labels, fully
+
+
+def _read_cache_labels(q: ast.Query) -> set[str]:
+    """Labels a cached read depends on. Returns the EMPTY set (= invalidated
+    by every write) unless every dependency is label-scoped — pattern
+    predicates and EXISTS/COUNT subqueries also force the unscoped bucket."""
+    labels: set[str] = set()
+    for c in q.clauses:
+        pats = list(getattr(c, "patterns", []) or [])
+        if isinstance(c, ast.MergeClause):
+            pats.append(c.pattern)
+        for p in pats:
+            got, fully = _pattern_labels(p)
+            if not fully:
+                return set()
+            labels.update(got)
+        if isinstance(c, ast.CallClause):
+            return set()  # procedure reads scan arbitrary data
+        if isinstance(c, ast.CallSubquery):
+            inner = _read_cache_labels(c.query)
+            if not inner:
+                return set()
+            labels.update(inner)
+    for node in _walk_exprs(q):
+        if isinstance(
+            node, (ast.PatternPredicate, ast.ExistsSubquery, ast.CountSubquery)
+        ):
+            return set()
+    for sub, _ in q.unions:
+        inner = _read_cache_labels(sub)
+        if not inner:
+            return set()
+        labels.update(inner)
+    return labels
+
+
+def _write_labels(q: ast.Query) -> set[str]:
+    """Labels a write may affect — includes labels added/removed via
+    SET/REMOVE/MERGE items. Empty set means 'unscoped: clear everything'."""
+    labels: set[str] = set()
+    unscoped = False
+    for c in q.clauses:
+        pats = list(getattr(c, "patterns", []) or [])
+        if isinstance(c, ast.MergeClause):
+            pats.append(c.pattern)
+            for item in list(c.on_create) + list(c.on_match):
+                labels.update(item.labels)
+        for p in pats:
+            got, fully = _pattern_labels(p)
+            labels.update(got)
+            if not fully and isinstance(c, (ast.CreateClause, ast.MergeClause)):
+                unscoped = True
+        if isinstance(c, (ast.SetClause, ast.RemoveClause)):
+            for item in c.items:
+                labels.update(item.labels)
+        if isinstance(c, ast.ForeachClause):
+            unscoped = True  # nested updates: play safe
+        if isinstance(c, ast.CallClause) and not c.procedure.startswith(
+            _READONLY_PROCEDURES
+        ):
+            unscoped = True
+        if isinstance(c, ast.CallSubquery):
+            inner = _write_labels(c.query)
+            if inner:
+                labels.update(inner)
+            elif _is_write_query(c.query):
+                unscoped = True
+    for sub, _ in q.unions:
+        inner = _write_labels(sub)
+        if inner:
+            labels.update(inner)
+        elif _is_write_query(sub):
+            unscoped = True
+    return set() if unscoped else labels
+
+
 class _SortKey:
     """Comparable wrapper: mixed-type tolerant, nulls sort last (asc),
     honours per-key DESC."""

@@ -51,6 +51,10 @@ class Config:
     similarity_threshold: float = 0.85
     # search
     search_brute_force_max: int = 5000
+    # query cache (ref: pkg/cache, ConfigureGlobalCache main.go:320)
+    query_cache_enabled: bool = True
+    query_cache_size: int = 1000
+    query_cache_ttl: float = 60.0
     feature_flags: dict[str, bool] = field(default_factory=dict)
 
 
@@ -92,6 +96,8 @@ class DB:
         self._executor = None
         self._dbmanager = None
         self._db_executors: dict[str, Any] = {}
+        self._query_cache = None
+        self._heimdall = None
 
     @staticmethod
     def _migrate_unprefixed(base: Engine, namespace: str) -> None:
@@ -189,12 +195,40 @@ class DB:
         return self._search
 
     @property
+    def query_cache(self):
+        if self._query_cache is None:
+            from nornicdb_tpu.cache import QueryCache
+
+            self._query_cache = QueryCache(
+                capacity=self.config.query_cache_size,
+                ttl=self.config.query_cache_ttl,
+            )
+        return self._query_cache
+
+    @property
     def executor(self):
         if self._executor is None:
             from nornicdb_tpu.cypher.executor import CypherExecutor
 
-            self._executor = CypherExecutor(self.storage, schema=self.schema, db=self)
+            cache = self.query_cache if self.config.query_cache_enabled else None
+            self._executor = CypherExecutor(
+                self.storage, schema=self.schema, db=self, cache=cache
+            )
         return self._executor
+
+    @property
+    def heimdall(self):
+        """(ref: pkg/heimdall manager wiring)"""
+        if self._heimdall is None:
+            from nornicdb_tpu.heimdall import HeimdallManager, TemplateGenerator
+
+            self._heimdall = HeimdallManager(TemplateGenerator(self), db=self)
+        return self._heimdall
+
+    def set_heimdall_generator(self, generator) -> None:
+        from nornicdb_tpu.heimdall import HeimdallManager
+
+        self._heimdall = HeimdallManager(generator, db=self)
 
     @property
     def decay(self):

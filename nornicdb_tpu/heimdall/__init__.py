@@ -1,0 +1,15 @@
+"""Heimdall AI assistant (ref: /root/reference/pkg/heimdall/)."""
+
+from nornicdb_tpu.heimdall.manager import (
+    Bifrost,
+    Generator,
+    HeimdallManager,
+    HeimdallMetrics,
+    QwenGenerator,
+    TemplateGenerator,
+)
+
+__all__ = [
+    "Bifrost", "Generator", "HeimdallManager", "HeimdallMetrics",
+    "QwenGenerator", "TemplateGenerator",
+]

@@ -1,0 +1,266 @@
+"""Heimdall: the in-process AI assistant (TPU SLM).
+
+Behavioral reference: /root/reference/pkg/heimdall/ —
+Manager.Generate (scheduler.go:178), Handler.handleChatCompletions
+(handler.go:207), action parsing from model output (tryParseAction :516),
+streaming (:561), Bifrost SSE notification bus (bifrost.go:15), model
+registry (types.go:20-37), plugin actions (plugin.go), metrics
+(metrics.go).
+
+The generation backend is the Qwen2 decoder on TPU
+(nornicdb_tpu.models.qwen2 — replaces pkg/localllm llama.cpp), with a
+deterministic template fallback when no weights are mounted.
+"""
+
+from __future__ import annotations
+
+import json
+import queue
+import re
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Any, Callable, Iterator, Optional
+
+# model kinds (ref: types.go:20-37)
+MODEL_EMBEDDING = "embedding"
+MODEL_REASONING = "reasoning"
+MODEL_CLASSIFICATION = "classification"
+
+
+@dataclass
+class HeimdallMetrics:
+    generations: int = 0
+    tokens_generated: int = 0
+    actions_executed: int = 0
+    errors: int = 0
+    total_latency: float = 0.0
+
+
+class Bifrost:
+    """Notification bus to UI subscribers (ref: bifrost.go:15 — SSE bus)."""
+
+    def __init__(self) -> None:
+        self._subs: list[queue.Queue] = []
+        self._lock = threading.Lock()
+
+    def subscribe(self) -> queue.Queue:
+        q: queue.Queue = queue.Queue()
+        with self._lock:
+            self._subs.append(q)
+        return q
+
+    def unsubscribe(self, q: queue.Queue) -> None:
+        with self._lock:
+            if q in self._subs:
+                self._subs.remove(q)
+
+    def broadcast(self, event: str, data: Any) -> None:
+        with self._lock:
+            subs = list(self._subs)
+        for q in subs:
+            try:
+                q.put_nowait({"event": event, "data": data, "ts": time.time()})
+            except queue.Full:
+                pass
+
+
+class Generator:
+    """Abstract generation backend (ref: generator_cgo.go / generator_yzma.go)."""
+
+    def generate(self, prompt: str, max_tokens: int = 128) -> str:
+        raise NotImplementedError
+
+    def generate_stream(self, prompt: str, max_tokens: int = 128) -> Iterator[str]:
+        yield self.generate(prompt, max_tokens)
+
+
+class QwenGenerator(Generator):
+    """Qwen2-on-TPU backend (replaces llama.cpp generation)."""
+
+    def __init__(self, cfg=None, params=None, tokenizer=None, seed: int = 0):
+        import jax
+
+        from nornicdb_tpu.models import qwen2
+        from nornicdb_tpu.models.tokenizer import HashTokenizer
+
+        self.cfg = cfg if cfg is not None else qwen2.QWEN_SMALL
+        self.params = (
+            params if params is not None
+            else qwen2.init_params(self.cfg, jax.random.PRNGKey(seed))
+        )
+        self.tokenizer = tokenizer or HashTokenizer(self.cfg.vocab_size)
+        self.qwen2 = qwen2
+
+    def generate(self, prompt: str, max_tokens: int = 128) -> str:
+        ids = self.tokenizer.encode(prompt, add_special=False)[-256:] or [1]
+        out = self.qwen2.generate(
+            self.params, self.cfg, ids, max_new_tokens=max_tokens,
+        )
+        return self.tokenizer.decode(out)
+
+
+class TemplateGenerator(Generator):
+    """Deterministic fallback when no trained weights are mounted: answers
+    from DB context using templates (keeps the assistant functional in
+    headless/test environments, like the reference's stub builds)."""
+
+    def __init__(self, db=None):
+        self.db = db
+
+    def generate(self, prompt: str, max_tokens: int = 128) -> str:
+        low = prompt.lower()
+        if self.db is not None:
+            if "how many" in low and "node" in low:
+                return f"The graph currently holds {self.db.storage.node_count()} nodes."
+            if "how many" in low and ("edge" in low or "relationship" in low):
+                return (
+                    f"The graph currently holds {self.db.storage.edge_count()} "
+                    "relationships."
+                )
+            m = re.search(r"(?:search|find|recall)\s+(?:for\s+)?(.+)", low)
+            if m:
+                results = self.db.recall(m.group(1).strip(" ?.!"), limit=3)
+                if results:
+                    lines = [f"- {r['content'][:80]}" for r in results]
+                    return "Here is what I found:\n" + "\n".join(lines)
+                return "I could not find matching memories."
+            if "status" in low or "health" in low:
+                return json.dumps(
+                    {"action": "status", "params": {}}
+                )
+        return "I am Heimdall, the NornicDB assistant. Ask me about the graph."
+
+
+ActionFn = Callable[[dict[str, Any]], Any]
+
+
+class HeimdallManager:
+    """(ref: heimdall.Manager scheduler.go:178)"""
+
+    SYSTEM_PROMPT = (
+        "You are Heimdall, the NornicDB graph assistant. Answer questions "
+        "about the graph; when an operation is needed reply with JSON "
+        '{"action": name, "params": {...}}.'
+    )
+
+    def __init__(self, generator: Generator, db=None):
+        self.generator = generator
+        self.db = db
+        self.bifrost = Bifrost()
+        self.metrics = HeimdallMetrics()
+        self._actions: dict[str, ActionFn] = {}
+        self._lock = threading.Lock()
+        # built-in actions (ref: plugins/heimdall reference plugin actions)
+        self.register_action("status", self._action_status)
+        self.register_action("hello", lambda p: {"message": "Heimdall online"})
+
+    # -- actions (ref: plugin.go ActionFunc) ---------------------------------
+    def register_action(self, name: str, fn: ActionFn) -> None:
+        with self._lock:
+            self._actions[name] = fn
+
+    def _action_status(self, params: dict) -> dict:
+        out = {"status": "ok"}
+        if self.db is not None:
+            out["nodes"] = self.db.storage.node_count()
+            out["edges"] = self.db.storage.edge_count()
+        return out
+
+    @staticmethod
+    def try_parse_action(text: str) -> Optional[dict[str, Any]]:
+        """Extract a JSON action from model output (ref: tryParseAction
+        handler.go:516)."""
+        marker = text.find('"action"')
+        if marker == -1:
+            return None
+        # expand to the balanced braces enclosing the marker
+        start = text.rfind("{", 0, marker)
+        if start == -1:
+            return None
+        depth = 0
+        for i in range(start, len(text)):
+            if text[i] == "{":
+                depth += 1
+            elif text[i] == "}":
+                depth -= 1
+                if depth == 0:
+                    try:
+                        obj = json.loads(text[start : i + 1])
+                    except json.JSONDecodeError:
+                        return None
+                    if isinstance(obj, dict) and "action" in obj:
+                        return obj
+                    return None
+        return None
+
+    # -- generation (ref: Generate scheduler.go:178) ---------------------------
+    def generate(self, prompt: str, max_tokens: int = 128) -> str:
+        t0 = time.time()
+        try:
+            out = self.generator.generate(prompt, max_tokens)
+            self.metrics.generations += 1
+            self.metrics.tokens_generated += len(out.split())
+            return out
+        except Exception:
+            self.metrics.errors += 1
+            raise
+        finally:
+            self.metrics.total_latency += time.time() - t0
+
+    def chat(self, messages: list[dict[str, str]], max_tokens: int = 128) -> dict:
+        """OpenAI-compatible chat completion (ref: handleChatCompletions
+        handler.go:207) + action execution."""
+        prompt_parts = [self.SYSTEM_PROMPT]
+        for m in messages:
+            prompt_parts.append(f"{m.get('role', 'user')}: {m.get('content', '')}")
+        prompt_parts.append("assistant:")
+        text = self.generate("\n".join(prompt_parts), max_tokens)
+        action_result = None
+        action = self.try_parse_action(text)
+        if action is not None:
+            fn = self._actions.get(str(action.get("action")))
+            if fn is not None:
+                try:
+                    action_result = fn(action.get("params") or {})
+                    self.metrics.actions_executed += 1
+                except Exception as e:
+                    action_result = {"error": str(e)}
+        self.bifrost.broadcast("chat", {"content": text[:200]})
+        response = {
+            "id": f"chatcmpl-{int(time.time() * 1000)}",
+            "object": "chat.completion",
+            "model": "heimdall",
+            "choices": [
+                {
+                    "index": 0,
+                    "message": {"role": "assistant", "content": text},
+                    "finish_reason": "stop",
+                }
+            ],
+        }
+        if action_result is not None:
+            response["action_result"] = action_result
+        return response
+
+    def chat_stream(self, messages: list[dict[str, str]],
+                    max_tokens: int = 128) -> Iterator[dict]:
+        """Streaming chunks (ref: streaming handler.go:561)."""
+        full = self.chat(messages, max_tokens)
+        content = full["choices"][0]["message"]["content"]
+        words = content.split(" ")
+        for i, w in enumerate(words):
+            yield {
+                "object": "chat.completion.chunk",
+                "choices": [
+                    {
+                        "index": 0,
+                        "delta": {"content": w + (" " if i < len(words) - 1 else "")},
+                        "finish_reason": None,
+                    }
+                ],
+            }
+        yield {
+            "object": "chat.completion.chunk",
+            "choices": [{"index": 0, "delta": {}, "finish_reason": "stop"}],
+        }

@@ -330,6 +330,14 @@ class HttpServer:
             h._auth("write")
             h._send(200, self._mcp(h._body()))
             return
+        if path in ("/api/bifrost/chat/completions", "/v1/chat/completions"):
+            # (ref: server_router.go:215 -> heimdall handler.go:207)
+            h._auth("read")
+            body = h._body()
+            messages = body.get("messages", [])
+            max_tokens = int(body.get("max_tokens", 128))
+            h._send(200, self.db.heimdall.chat(messages, max_tokens))
+            return
         h._send(404, {"error": f"not found: {path}"})
 
     def _tx_commit(self, h, database: str, body: dict) -> None:

@@ -1,0 +1,129 @@
+"""APOC tests (ref: apoc/ category tests in the reference)."""
+
+import pytest
+
+from nornicdb_tpu.apoc import all_functions, call, categories
+from nornicdb_tpu.cypher import CypherExecutor
+from nornicdb_tpu.storage import MemoryEngine, Node
+
+
+@pytest.fixture
+def ex():
+    return CypherExecutor(MemoryEngine())
+
+
+class TestCollText:
+    def test_coll_basics(self, ex):
+        r = ex.execute(
+            "RETURN apoc.coll.sum([1,2,3]) AS s, apoc.coll.sort([3,1,2]) AS so, "
+            "apoc.coll.toSet([1,1,2]) AS st, apoc.coll.flatten([[1,2],[3]]) AS f, "
+            "apoc.coll.intersection([1,2,3],[2,3,4]) AS i, "
+            "apoc.coll.partition([1,2,3,4,5], 2) AS p"
+        )
+        assert r.rows == [[6, [1, 2, 3], [1, 2], [1, 2, 3], [2, 3], [[1, 2], [3, 4], [5]]]]
+
+    def test_text_basics(self, ex):
+        r = ex.execute(
+            "RETURN apoc.text.join(['a','b'], '-') AS j, "
+            "apoc.text.capitalize('hello') AS c, "
+            "apoc.text.slug('Hello World!') AS s, "
+            "apoc.text.levenshteinDistance('kitten','sitting') AS l, "
+            "apoc.text.camelCase('foo_bar baz') AS cc"
+        )
+        assert r.rows == [["a-b", "Hello", "hello-world", 3, "fooBarBaz"]]
+
+    def test_map_basics(self, ex):
+        r = ex.execute(
+            "RETURN apoc.map.merge({a:1},{b:2}) AS m, "
+            "apoc.map.fromPairs([['x',1],['y',2]]) AS fp, "
+            "apoc.map.removeKey({a:1,b:2},'a') AS rk, "
+            "apoc.map.flatten({a:{b:1}}) AS fl"
+        )
+        assert r.rows == [[{"a": 1, "b": 2}, {"x": 1, "y": 2}, {"b": 2}, {"a.b": 1}]]
+
+    def test_convert_json(self, ex):
+        r = ex.execute(
+            "RETURN apoc.convert.toJson({a:[1,2]}) AS j, "
+            "apoc.convert.fromJsonMap('{\"k\":5}') AS m, "
+            "apoc.json.path('{\"a\":{\"b\":[10,20]}}', '$.a.b[1]') AS p"
+        )
+        assert r.rows == [['{"a": [1, 2]}', {"k": 5}, 20]]
+
+    def test_date(self, ex):
+        r = ex.execute(
+            "RETURN apoc.date.format(0, 's', 'yyyy-MM-dd') AS d, "
+            "apoc.date.parse('1970-01-02 00:00:00', 's') AS p"
+        )
+        assert r.rows == [["1970-01-01", 86400]]
+
+    def test_hashing_meta(self, ex):
+        r = ex.execute(
+            "RETURN apoc.hashing.md5('x') AS h, apoc.meta.type(1) AS t1, "
+            "apoc.meta.type('s') AS t2, apoc.meta.type([1]) AS t3"
+        )
+        assert r.rows[0][1:] == ["INTEGER", "STRING", "LIST"]
+        assert len(r.rows[0][0]) == 32
+
+    def test_registry_surface(self):
+        fns = all_functions()
+        assert len(fns) > 100
+        cats = categories()
+        assert {"coll", "text", "map", "convert", "date"} <= set(cats)
+        assert call("apoc.coll.sum", [1, 2]) == 3
+
+
+class TestApocProcedures:
+    def test_create_node_and_relationship(self, ex):
+        r = ex.execute(
+            "CALL apoc.create.node(['Person'], {name:'Ada'}) YIELD node RETURN node.name"
+        )
+        assert r.rows == [["Ada"]]
+        r = ex.execute(
+            "MATCH (a:Person) CALL apoc.create.node(['City'], {name:'Oslo'}) YIELD node "
+            "CALL apoc.create.relationship(a, 'LIVES_IN', {since: 2020}, node) YIELD rel "
+            "RETURN type(rel), rel.since"
+        )
+        assert r.rows == [["LIVES_IN", 2020]]
+
+    def test_merge_node_idempotent(self, ex):
+        ex.execute("CALL apoc.merge.node(['K'], {k:1}, {created:true}) YIELD node RETURN node")
+        ex.execute("CALL apoc.merge.node(['K'], {k:1}, {created:true}) YIELD node RETURN node")
+        r = ex.execute("MATCH (n:K) RETURN count(n)")
+        assert r.rows == [[1]]
+
+    def test_refactor_rename(self, ex):
+        ex.execute("CREATE (:Old {x:1}), (:Old {x:2})")
+        r = ex.execute("CALL apoc.refactor.rename.label('Old','New') YIELD total RETURN total")
+        assert r.rows == [[2]]
+        assert ex.execute("MATCH (n:New) RETURN count(n)").rows == [[2]]
+
+    def test_node_degree(self, ex):
+        ex.execute("CREATE (a:D {k:1})-[:R]->(:D), (a)-[:R]->(:D)")
+        r = ex.execute(
+            "MATCH (a:D {k:1}) CALL apoc.node.degree(a) YIELD value RETURN value"
+        )
+        assert r.rows == [[2]]
+
+    def test_periodic_iterate(self, ex):
+        ex.execute("UNWIND range(1, 10) AS i CREATE (:Item {v: i})")
+        r = ex.execute(
+            "CALL apoc.periodic.iterate("
+            "'MATCH (n:Item) RETURN n', "
+            "'SET n.doubled = n.v * 2', {batchSize: 3}) "
+            "YIELD batches, total RETURN batches, total"
+        )
+        assert r.rows == [[4, 10]]
+        r = ex.execute("MATCH (n:Item {v: 5}) RETURN n.doubled")
+        assert r.rows == [[10]]
+
+    def test_neighbors_tohop(self, ex):
+        ex.execute("CREATE (:H {k:1})-[:R]->(:H {k:2})-[:R]->(:H {k:3})")
+        r = ex.execute(
+            "MATCH (a:H {k:1}) CALL apoc.neighbors.toHop(a, 'R', 2) YIELD node "
+            "RETURN node.k ORDER BY node.k"
+        )
+        assert [row[0] for row in r.rows] == [2, 3]
+
+    def test_apoc_help(self, ex):
+        r = ex.execute("CALL apoc.help('coll.sum') YIELD name RETURN name")
+        assert r.rows == [["apoc.coll.sum"]]
