@@ -256,8 +256,8 @@ def text_camel(s):
 def text_snake(s):
     if s is None:
         return None
-    s = re.sub(r"([a-z0-9])([A-Z])", r"\1-\2", s)
-    return re.sub(r"[\s_\-]+", "-", s).lower()
+    s = re.sub(r"([a-z0-9])([A-Z])", r"\1_\2", s)
+    return re.sub(r"[\s_\-]+", "_", s).lower()
 
 
 @register("apoc.text.random")
@@ -686,8 +686,10 @@ def date_now():
 
 @register("apoc.date.add")
 def date_add(epoch, unit, value, value_unit):
-    mult = {"ms": 1, "s": 1000, "m": 60000, "h": 3600000, "d": 86400000}
-    return int(epoch) + int(value) * mult.get(value_unit, 1)
+    ms = {"ms": 1, "s": 1000, "m": 60000, "h": 3600000, "d": 86400000}
+    delta_ms = int(value) * ms.get(value_unit, 1)
+    # the addend converts into the epoch's own unit
+    return int(epoch) + delta_ms // ms.get(unit, 1)
 
 
 @register("apoc.date.convert")

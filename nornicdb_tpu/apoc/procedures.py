@@ -125,6 +125,10 @@ def apoc_node_degree(ex: CypherExecutor, args, row):
 @procedure("apoc.neighbors.tohop")
 def apoc_neighbors(ex: CypherExecutor, args, row):
     node = args[0]
+    rel_types: set[str] = set()
+    if len(args) > 1 and isinstance(args[1], str):
+        # "KNOWS|WORKS_WITH>" style spec; direction arrows are stripped
+        rel_types = {t.strip("<>") for t in args[1].split("|") if t.strip("<>")}
     hops = int(args[2]) if len(args) > 2 else int(args[1]) if len(args) > 1 and not isinstance(args[1], str) else 1
     seen = {node.id}
     frontier = [node.id]
@@ -133,10 +137,14 @@ def apoc_neighbors(ex: CypherExecutor, args, row):
         nxt = []
         for nid in frontier:
             for e in ex.storage.get_outgoing_edges(nid):
+                if rel_types and e.type not in rel_types:
+                    continue
                 if e.end_node not in seen:
                     seen.add(e.end_node)
                     nxt.append(e.end_node)
             for e in ex.storage.get_incoming_edges(nid):
+                if rel_types and e.type not in rel_types:
+                    continue
                 if e.start_node not in seen:
                     seen.add(e.start_node)
                     nxt.append(e.start_node)

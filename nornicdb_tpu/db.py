@@ -198,11 +198,36 @@ class DB:
     def query_cache(self):
         if self._query_cache is None:
             from nornicdb_tpu.cache import QueryCache
+            from nornicdb_tpu.storage import Edge as _Edge, Node as _Node
 
-            self._query_cache = QueryCache(
+            cache = QueryCache(
                 capacity=self.config.query_cache_size,
                 ttl=self.config.query_cache_ttl,
             )
+
+            # Direct storage mutations (store/forget, decay, retention,
+            # Qdrant upserts) must invalidate too — not just Cypher writes.
+            def _on_event(kind: str, entity) -> None:
+                if isinstance(entity, _Node):
+                    if entity.labels:
+                        cache.invalidate_labels(set(entity.labels))
+                    else:
+                        cache.clear()
+                elif isinstance(entity, _Edge):
+                    labels: set = set()
+                    for nid in (entity.start_node, entity.end_node):
+                        try:
+                            labels.update(self.storage.get_node(nid).labels)
+                        except Exception:
+                            cache.clear()
+                            return
+                    if labels:
+                        cache.invalidate_labels(labels)
+                    else:
+                        cache.clear()
+
+            self.storage.on_event(_on_event)
+            self._query_cache = cache
         return self._query_cache
 
     @property

@@ -174,24 +174,27 @@ class HeimdallManager:
         marker = text.find('"action"')
         if marker == -1:
             return None
-        # expand to the balanced braces enclosing the marker
-        start = text.rfind("{", 0, marker)
-        if start == -1:
-            return None
-        depth = 0
-        for i in range(start, len(text)):
-            if text[i] == "{":
-                depth += 1
-            elif text[i] == "}":
-                depth -= 1
-                if depth == 0:
-                    try:
-                        obj = json.loads(text[start : i + 1])
-                    except json.JSONDecodeError:
-                        return None
-                    if isinstance(obj, dict) and "action" in obj:
-                        return obj
-                    return None
+        # try every opening brace before the marker, outermost first, so a
+        # nested object preceding "action" (key order is unguaranteed) still
+        # resolves to the enclosing action object
+        starts = [i for i, ch in enumerate(text[: marker + 1]) if ch == "{"]
+        for start in starts:
+            depth = 0
+            for i in range(start, len(text)):
+                if text[i] == "{":
+                    depth += 1
+                elif text[i] == "}":
+                    depth -= 1
+                    if depth == 0:
+                        if i < marker:
+                            break  # object closed before "action": not it
+                        try:
+                            obj = json.loads(text[start : i + 1])
+                        except json.JSONDecodeError:
+                            break
+                        if isinstance(obj, dict) and "action" in obj:
+                            return obj
+                        break
         return None
 
     # -- generation (ref: Generate scheduler.go:178) ---------------------------

@@ -66,6 +66,36 @@ def _jsonable(v: Any) -> Any:
     return v
 
 
+class RateLimiter:
+    """Token-bucket per client (ref: pkg/security/middleware.go rate limiting)."""
+
+    def __init__(self, rate: float = 100.0, burst: int = 200):
+        self.rate = rate
+        self.burst = burst
+        self._buckets: dict[str, tuple[float, float]] = {}  # ip -> (tokens, ts)
+        self._lock = threading.Lock()
+
+    MAX_BUCKETS = 10_000
+
+    def allow(self, client: str) -> bool:
+        now = time.time()
+        with self._lock:
+            if len(self._buckets) > self.MAX_BUCKETS:
+                # prune clients whose buckets have refilled (idle long enough)
+                self._buckets = {
+                    ip: (t, ts)
+                    for ip, (t, ts) in self._buckets.items()
+                    if t + (now - ts) * self.rate < self.burst
+                }
+            tokens, ts = self._buckets.get(client, (float(self.burst), now))
+            tokens = min(self.burst, tokens + (now - ts) * self.rate)
+            if tokens < 1.0:
+                self._buckets[client] = (tokens, now)
+                return False
+            self._buckets[client] = (tokens - 1.0, now)
+            return True
+
+
 class HttpServer:
     """(ref: server.New pkg/server/server.go)"""
 
@@ -76,6 +106,7 @@ class HttpServer:
         port: int = 7474,
         authenticator=None,
         auth_required: bool = False,
+        rate_limit: float = 0.0,  # requests/sec per client; 0 = unlimited
     ):
         self.db = db
         self.host = host
@@ -87,8 +118,22 @@ class HttpServer:
         self.errors = 0
         self.slow_queries = 0
         self.slow_threshold = 1.0
+        self.rate_limiter = (
+            RateLimiter(rate_limit, burst=max(int(rate_limit * 2), 1))
+            if rate_limit > 0
+            else None
+        )
+        self._qdrant = None
         self._httpd: Optional[ThreadingHTTPServer] = None
         self._thread: Optional[threading.Thread] = None
+
+    @property
+    def qdrant(self):
+        if self._qdrant is None:
+            from nornicdb_tpu.server.qdrant import QdrantCollections
+
+            self._qdrant = QdrantCollections(self.db.storage)
+        return self._qdrant
 
     # -- request handling ----------------------------------------------------
     def _make_handler(server_self):  # noqa: N805
@@ -150,27 +195,60 @@ class HttpServer:
                 self.send_header("Content-Length", "0")
                 self.end_headers()
 
-            def do_GET(self):
+            def _limited(self) -> bool:
+                rl = server_self.rate_limiter
+                if rl is not None and not rl.allow(self.client_address[0]):
+                    self._send(429, {"error": "rate limit exceeded"})
+                    return True
+                return False
+
+            def _dispatch(self, method: str):
                 server_self.requests += 1
+                if self._limited():
+                    return
                 try:
-                    server_self._route_get(self)
+                    path = self.path.split("?")[0]
+                    if path.startswith("/collections"):
+                        server_self._route_qdrant(self, method, path)
+                        return
+                    if method == "GET":
+                        server_self._route_get(self)
+                    elif method == "POST":
+                        server_self._route_post(self)
+                    else:
+                        self._send(405, {"error": f"{method} not allowed on {path}"})
                 except AuthError as e:
                     self._send(401, {"error": str(e)})
                 except Exception as e:
                     server_self.errors += 1
-                    self._send(500, {"error": str(e)})
+                    self._send(400 if method != "GET" else 500, {"error": str(e)})
+
+            def do_GET(self):
+                self._dispatch("GET")
 
             def do_POST(self):
-                server_self.requests += 1
-                try:
-                    server_self._route_post(self)
-                except AuthError as e:
-                    self._send(401, {"error": str(e)})
-                except Exception as e:
-                    server_self.errors += 1
-                    self._send(400, {"error": str(e)})
+                self._dispatch("POST")
+
+            def do_PUT(self):
+                self._dispatch("PUT")
+
+            def do_DELETE(self):
+                self._dispatch("DELETE")
 
         return Handler
+
+    def _route_qdrant(self, h, method: str, path: str) -> None:
+        """Qdrant-compatible vector API (ref: pkg/qdrantgrpc, REST shapes)."""
+        from nornicdb_tpu.server.qdrant import handle_qdrant
+
+        h._auth("read" if method == "GET" else "write")
+        body = h._body() if method in ("POST", "PUT", "DELETE") else {}
+        routed = handle_qdrant(self.qdrant, method, path, body)
+        if routed is None:
+            h._send(404, {"error": f"not found: {path}"})
+            return
+        code, payload = routed
+        h._send(code, _jsonable(payload))
 
     # -- GET routes --------------------------------------------------------------
     def _route_get(self, h) -> None:

@@ -1,0 +1,247 @@
+"""Qdrant-compatible vector API.
+
+Behavioral reference: /root/reference/pkg/qdrantgrpc/ — Collections/Points
+services (collections_service.go, points_service.go), collection registry
+mapped onto graph nodes with label "QdrantPoint" (registry.go), named-vector
+support; points indexed into the same search service (server.go:207).
+
+The reference speaks Qdrant's gRPC; this build exposes the same operations
+over Qdrant's REST shapes (grpcio is not in the image), mounted on the HTTP
+server under /collections/*.
+"""
+
+from __future__ import annotations
+
+import re
+import threading
+import time
+from typing import Any, Optional
+
+import numpy as np
+
+from nornicdb_tpu.errors import NotFoundError
+from nornicdb_tpu.ops.similarity import DeviceCorpus
+from nornicdb_tpu.storage.types import Engine, Node
+
+POINT_LABEL = "QdrantPoint"
+
+
+class QdrantCollections:
+    """Collection registry over graph nodes (ref: registry.go:149 analogue —
+    per-collection vector space + device corpus)."""
+
+    def __init__(self, storage: Engine):
+        self.storage = storage
+        self._lock = threading.RLock()
+        self._collections: dict[str, dict[str, Any]] = {}
+        self._corpora: dict[str, DeviceCorpus] = {}
+        # rebuild registry from persisted points
+        for n in storage.get_nodes_by_label(POINT_LABEL):
+            coll = n.properties.get("_collection")
+            if coll and coll not in self._collections and n.embedding is not None:
+                self._collections[coll] = {
+                    "size": int(n.embedding.shape[0]),
+                    "distance": "Cosine",
+                }
+        for name in self._collections:
+            self._rebuild_corpus(name)
+
+    def _rebuild_corpus(self, name: str) -> None:
+        info = self._collections[name]
+        corpus = DeviceCorpus(dims=info["size"])
+        for n in self.storage.get_nodes_by_label(POINT_LABEL):
+            if n.properties.get("_collection") == name and n.embedding is not None:
+                corpus.add(n.id, n.embedding)
+        self._corpora[name] = corpus
+
+    # -- collections -------------------------------------------------------
+    def create(self, name: str, size: int, distance: str = "Cosine") -> None:
+        with self._lock:
+            self._collections[name] = {"size": int(size), "distance": distance}
+            self._corpora[name] = DeviceCorpus(dims=int(size))
+
+    def drop(self, name: str) -> bool:
+        with self._lock:
+            existed = self._collections.pop(name, None) is not None
+            self._corpora.pop(name, None)
+        for n in list(self.storage.get_nodes_by_label(POINT_LABEL)):
+            if n.properties.get("_collection") == name:
+                self.storage.delete_node(n.id)
+        return existed
+
+    def list(self) -> list[dict[str, Any]]:
+        with self._lock:
+            return [{"name": n} for n in sorted(self._collections)]
+
+    def info(self, name: str) -> Optional[dict[str, Any]]:
+        with self._lock:
+            meta = self._collections.get(name)
+            if meta is None:
+                return None
+            count = sum(
+                1
+                for n in self.storage.get_nodes_by_label(POINT_LABEL)
+                if n.properties.get("_collection") == name
+            )
+            return {
+                "status": "green",
+                "vectors_count": count,
+                "points_count": count,
+                "config": {
+                    "params": {
+                        "vectors": {"size": meta["size"], "distance": meta["distance"]}
+                    }
+                },
+            }
+
+    # -- points ------------------------------------------------------------
+    def _node_id(self, collection: str, point_id: Any) -> str:
+        return f"qdrant-{collection}-{point_id}"
+
+    def upsert(self, collection: str, points: list[dict[str, Any]]) -> int:
+        with self._lock:
+            if collection not in self._collections:
+                raise NotFoundError(f"collection {collection} not found")
+            corpus = self._corpora[collection]
+        n = 0
+        for p in points:
+            vec = np.asarray(p["vector"], np.float32)
+            nid = self._node_id(collection, p["id"])
+            payload = p.get("payload") or {}
+            node = Node(
+                id=nid,
+                labels=[POINT_LABEL],
+                properties={"_collection": collection, "_point_id": p["id"],
+                            **payload},
+                embedding=vec,
+            )
+            try:
+                self.storage.create_node(node)
+            except Exception:
+                existing = self.storage.get_node(nid)
+                existing.properties = dict(node.properties)
+                existing.embedding = vec
+                self.storage.update_node(existing)
+            corpus.add(nid, vec)
+            n += 1
+        return n
+
+    def delete_points(self, collection: str, ids: list[Any]) -> int:
+        with self._lock:
+            corpus = self._corpora.get(collection)
+        n = 0
+        for pid in ids:
+            nid = self._node_id(collection, pid)
+            try:
+                self.storage.delete_node(nid)
+                n += 1
+            except NotFoundError:
+                continue
+            if corpus is not None:
+                corpus.remove(nid)
+        return n
+
+    def search(
+        self,
+        collection: str,
+        vector: list[float],
+        limit: int = 10,
+        score_threshold: float = -1.0,
+        with_payload: bool = True,
+    ) -> list[dict[str, Any]]:
+        with self._lock:
+            corpus = self._corpora.get(collection)
+        if corpus is None:
+            raise NotFoundError(f"collection {collection} not found")
+        res = corpus.search(
+            np.asarray(vector, np.float32), k=limit,
+            min_similarity=score_threshold,
+        )
+        out = []
+        for nid, score in res[0] if res else []:
+            try:
+                node = self.storage.get_node(nid)
+            except NotFoundError:
+                continue
+            item = {"id": node.properties.get("_point_id"), "score": score,
+                    "version": 0}
+            if with_payload:
+                item["payload"] = {
+                    k: v for k, v in node.properties.items()
+                    if not k.startswith("_")
+                }
+            out.append(item)
+        return out
+
+    def retrieve(self, collection: str, ids: list[Any]) -> list[dict[str, Any]]:
+        out = []
+        for pid in ids:
+            try:
+                node = self.storage.get_node(self._node_id(collection, pid))
+            except NotFoundError:
+                continue
+            out.append(
+                {
+                    "id": pid,
+                    "payload": {
+                        k: v for k, v in node.properties.items()
+                        if not k.startswith("_")
+                    },
+                    "vector": (
+                        node.embedding.tolist()
+                        if node.embedding is not None
+                        else None
+                    ),
+                }
+            )
+        return out
+
+
+def handle_qdrant(registry: QdrantCollections, method: str, path: str,
+                  body: dict) -> Optional[tuple[int, dict]]:
+    """Route a /collections/* request; None if the path isn't Qdrant's."""
+
+    def ok(result: Any, code: int = 200) -> tuple[int, dict]:
+        return code, {"result": result, "status": "ok", "time": 0.0}
+
+    m = re.fullmatch(r"/collections", path)
+    if m and method == "GET":
+        return ok({"collections": registry.list()})
+    m = re.fullmatch(r"/collections/([^/]+)", path)
+    if m:
+        name = m.group(1)
+        if method == "PUT":
+            vectors = body.get("vectors", {})
+            size = vectors.get("size", body.get("size", 0))
+            distance = vectors.get("distance", "Cosine")
+            registry.create(name, int(size), distance)
+            return ok(True)
+        if method == "GET":
+            info = registry.info(name)
+            if info is None:
+                return 404, {"status": {"error": f"collection {name} not found"}}
+            return ok(info)
+        if method == "DELETE":
+            return ok(registry.drop(name))
+    m = re.fullmatch(r"/collections/([^/]+)/points", path)
+    if m and method == "PUT":
+        n = registry.upsert(m.group(1), body.get("points", []))
+        return ok({"operation_id": 0, "status": "completed", "upserted": n})
+    m = re.fullmatch(r"/collections/([^/]+)/points/search", path)
+    if m and method == "POST":
+        hits = registry.search(
+            m.group(1),
+            body.get("vector", []),
+            limit=int(body.get("limit", 10)),
+            score_threshold=float(body.get("score_threshold", -1.0)),
+            with_payload=bool(body.get("with_payload", True)),
+        )
+        return ok(hits)
+    m = re.fullmatch(r"/collections/([^/]+)/points/delete", path)
+    if m and method == "POST":
+        n = registry.delete_points(m.group(1), body.get("points", []))
+        return ok({"operation_id": 0, "status": "completed", "deleted": n})
+    m = re.fullmatch(r"/collections/([^/]+)/points", path)
+    if m and method == "POST":
+        return ok(registry.retrieve(m.group(1), body.get("ids", [])))
+    return None

@@ -1,0 +1,123 @@
+"""Qdrant-compatible API tests (ref: pkg/qdrantgrpc tests,
+qdrant_official_e2e_test.go — exercised over the REST twin here)."""
+
+import json
+import urllib.request
+
+import numpy as np
+import pytest
+
+import nornicdb_tpu
+from nornicdb_tpu.server import HttpServer
+from nornicdb_tpu.server.http import RateLimiter
+
+
+def _req(port, method, path, body=None):
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{port}{path}",
+        data=json.dumps(body).encode() if body is not None else None,
+        headers={"Content-Type": "application/json"},
+        method=method,
+    )
+    with urllib.request.urlopen(req) as resp:
+        return json.loads(resp.read())
+
+
+@pytest.fixture
+def server():
+    db = nornicdb_tpu.open_db("")
+    srv = HttpServer(db, port=0)
+    srv.start()
+    yield srv
+    srv.stop()
+    db.close()
+
+
+class TestQdrantApi:
+    def test_collection_lifecycle(self, server):
+        out = _req(server.port, "PUT", "/collections/docs",
+                   {"vectors": {"size": 4, "distance": "Cosine"}})
+        assert out["status"] == "ok"
+        out = _req(server.port, "GET", "/collections")
+        assert {"name": "docs"} in out["result"]["collections"]
+        out = _req(server.port, "GET", "/collections/docs")
+        assert out["result"]["config"]["params"]["vectors"]["size"] == 4
+        out = _req(server.port, "DELETE", "/collections/docs")
+        assert out["result"] is True
+
+    def test_upsert_search_delete_points(self, server):
+        _req(server.port, "PUT", "/collections/vecs",
+             {"vectors": {"size": 4, "distance": "Cosine"}})
+        _req(server.port, "PUT", "/collections/vecs/points", {
+            "points": [
+                {"id": 1, "vector": [1, 0, 0, 0], "payload": {"tag": "x"}},
+                {"id": 2, "vector": [0, 1, 0, 0], "payload": {"tag": "y"}},
+                {"id": 3, "vector": [0.9, 0.1, 0, 0], "payload": {"tag": "z"}},
+            ]
+        })
+        out = _req(server.port, "GET", "/collections/vecs")
+        assert out["result"]["points_count"] == 3
+        out = _req(server.port, "POST", "/collections/vecs/points/search",
+                   {"vector": [1, 0, 0, 0], "limit": 2})
+        hits = out["result"]
+        assert [h["id"] for h in hits] == [1, 3]
+        assert hits[0]["payload"]["tag"] == "x"
+        assert hits[0]["score"] == pytest.approx(1.0, abs=1e-3)
+        out = _req(server.port, "POST", "/collections/vecs/points/delete",
+                   {"points": [1]})
+        assert out["result"]["deleted"] == 1
+        out = _req(server.port, "POST", "/collections/vecs/points/search",
+                   {"vector": [1, 0, 0, 0], "limit": 3})
+        assert [h["id"] for h in out["result"]] == [3, 2]
+
+    def test_points_are_graph_nodes(self, server):
+        """Qdrant points land in the same graph (ref: QdrantPoint label)."""
+        _req(server.port, "PUT", "/collections/g", {"vectors": {"size": 2}})
+        _req(server.port, "PUT", "/collections/g/points",
+             {"points": [{"id": 7, "vector": [1, 0], "payload": {"k": "v"}}]})
+        nodes = server.db.storage.get_nodes_by_label("QdrantPoint")
+        assert len(nodes) == 1
+        assert nodes[0].properties["k"] == "v"
+
+    def test_search_score_threshold(self, server):
+        _req(server.port, "PUT", "/collections/t", {"vectors": {"size": 2}})
+        _req(server.port, "PUT", "/collections/t/points", {
+            "points": [
+                {"id": 1, "vector": [1, 0]},
+                {"id": 2, "vector": [0, 1]},
+            ]
+        })
+        out = _req(server.port, "POST", "/collections/t/points/search",
+                   {"vector": [1, 0], "limit": 10, "score_threshold": 0.5})
+        assert [h["id"] for h in out["result"]] == [1]
+
+    def test_unknown_collection_404(self, server):
+        with pytest.raises(urllib.error.HTTPError) as e:
+            _req(server.port, "GET", "/collections/nope")
+        assert e.value.code == 404
+
+
+class TestRateLimiter:
+    def test_token_bucket(self):
+        rl = RateLimiter(rate=10.0, burst=2)
+        assert rl.allow("a")
+        assert rl.allow("a")
+        assert not rl.allow("a")  # burst exhausted
+        assert rl.allow("b")  # separate client
+
+    def test_http_rate_limiting(self):
+        db = nornicdb_tpu.open_db("")
+        srv = HttpServer(db, port=0, rate_limit=2.0)
+        srv.start()
+        try:
+            codes = []
+            for _ in range(6):
+                try:
+                    _req(srv.port, "GET", "/health")
+                    codes.append(200)
+                except urllib.error.HTTPError as e:
+                    codes.append(e.code)
+            assert 429 in codes
+        finally:
+            srv.stop()
+            db.close()
