@@ -26,6 +26,7 @@ from dataclasses import dataclass, field
 from typing import Any, Callable, Optional
 
 from nornicdb_tpu.errors import WALCorruptionError
+from nornicdb_tpu.storage import native as _native
 from nornicdb_tpu.storage.types import Edge, Engine, Node
 
 MAGIC = b"NWAL"
@@ -59,6 +60,10 @@ class WALEntry:
             {"op": self.op, "data": self.data, "txid": self.txid},
             separators=(",", ":"),
         ).encode("utf-8")
+        if _native.enabled():
+            native_rec = _native.encode(payload, self.seq)
+            if native_rec is not None:
+                return native_rec
         rec = _HEADER.pack(MAGIC, VERSION, len(payload)) + payload
         rec += _FOOTER.pack(zlib.crc32(payload) & 0xFFFFFFFF, self.seq)
         pad = (-len(rec)) % 8
@@ -146,6 +151,28 @@ class WAL:
             with open(self._path, "rb") as f:
                 buf = f.read()
         except FileNotFoundError:
+            return entries
+        # opt-in native path: C++ does framing + CRC sweep; Python parses JSON
+        native_out = _native.scan(buf) if _native.enabled() else None
+        if native_out is not None:
+            records, valid_bytes = native_out
+            if valid_bytes < len(buf):
+                if strict:
+                    raise WALCorruptionError(
+                        f"bad record at offset {valid_bytes}"
+                    )
+                self.stats.truncated_tail_records += 1
+            for payload, seq in records:
+                try:
+                    obj = json.loads(payload.decode("utf-8"))
+                except Exception:
+                    if strict:
+                        raise WALCorruptionError("bad payload")
+                    break
+                entries.append(
+                    WALEntry(seq=seq, op=obj["op"], data=obj.get("data", {}),
+                             txid=obj.get("txid"))
+                )
             return entries
         off = 0
         n = len(buf)
