@@ -24,8 +24,8 @@ import time
 N = 1_000_000
 D = 1024
 K = 100
-BATCH = 256
-ITERS = 10
+BATCH = 1024
+ITERS = 40
 
 
 def main() -> None:
@@ -65,10 +65,14 @@ def main() -> None:
     v, i = scan_search(qb, corpus, valid, K)
     np.asarray(v)  # compile + full sync
 
-    t0 = time.perf_counter()
-    v, i = scan_search(qb, corpus, valid, K)
-    np.asarray(v)  # D2H fetch = completion barrier
-    dt = time.perf_counter() - t0
+    # median of 3 trials: the dev-tunnel adds noisy per-dispatch latency
+    times = []
+    for _ in range(3):
+        t0 = time.perf_counter()
+        v, i = scan_search(qb, corpus, valid, K)
+        np.asarray(v)  # D2H fetch = completion barrier
+        times.append(time.perf_counter() - t0)
+    dt = sorted(times)[1]
 
     qps = BATCH * ITERS / dt
     baseline_qps = 1000.0  # A100 CUDA @1M x 1024d, gpu-acceleration.md:121
