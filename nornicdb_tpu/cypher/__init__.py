@@ -4,6 +4,7 @@ parser -> AST -> executor pipeline per SURVEY.md §7)."""
 from nornicdb_tpu.cypher.executor import CypherExecutor, Result, Stats
 from nornicdb_tpu.cypher.parser import parse
 from nornicdb_tpu.cypher import gds_procedures  # noqa: F401 — registers procs/fns
+from nornicdb_tpu.cypher import temporal_fns  # noqa: F401 — date/datetime/duration
 from nornicdb_tpu.apoc import register_procedures as _register_apoc
 
 _register_apoc()  # CALL apoc.* procedures (functions route via lookup_function)

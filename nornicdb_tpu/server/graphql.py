@@ -1,0 +1,340 @@
+"""GraphQL API: hand-rolled executor for the reference's GraphQL surface.
+
+Behavioral reference: /root/reference/pkg/graphql/ — gqlgen-based schema with
+node/edge CRUD, search, Cypher pass-through and traversals (handler.go,
+schema/, resolvers/). graphql-core is not in this image, so this module
+implements a small GraphQL subset natively: query/mutation operations,
+field arguments (literals + $variables), nested selection sets (projected
+onto results), aliases. No fragments/directives yet.
+
+Root fields:
+  query:    node(id) nodes(label, limit) relationships(type, limit)
+            search(query, limit) similar(id, limit) cypher(statement,
+            parameters) neighbors(id, depth) stats
+  mutation: createNode(labels, properties) updateNode(id, properties)
+            deleteNode(id) createRelationship(from, to, type, properties)
+            deleteRelationship(id)
+"""
+
+from __future__ import annotations
+
+import json
+import re
+from typing import Any, Optional
+
+from nornicdb_tpu.errors import CypherSyntaxError, NornicError, NotFoundError
+from nornicdb_tpu.storage.types import Edge, Node
+
+_TOKEN = re.compile(
+    r"""(?P<ws>[\s,]+|\#[^\n]*)|(?P<name>[_A-Za-z][_0-9A-Za-z]*)"""
+    r"""|(?P<string>"(?:\\.|[^"\\])*")|(?P<float>-?\d+\.\d+)"""
+    r"""|(?P<int>-?\d+)|(?P<punct>[{}()\[\]:$=!@])|(?P<spread>\.\.\.)"""
+)
+
+
+class _Parser:
+    def __init__(self, src: str):
+        self.tokens = []
+        last_end = 0
+        for m in _TOKEN.finditer(src):
+            if m.start() != last_end:
+                raise CypherSyntaxError(
+                    f"GraphQL: unexpected character {src[last_end]!r}"
+                )
+            last_end = m.end()
+            kind = m.lastgroup
+            if kind != "ws":
+                self.tokens.append((kind, m.group(0)))
+        if last_end != len(src):
+            raise CypherSyntaxError(
+                f"GraphQL: unexpected character {src[last_end]!r}"
+            )
+        self.pos = 0
+
+    def peek(self):
+        return self.tokens[self.pos] if self.pos < len(self.tokens) else ("eof", "")
+
+    def next(self):
+        t = self.peek()
+        if t[0] == "eof":
+            raise CypherSyntaxError("GraphQL: unexpected end of query")
+        self.pos += 1
+        return t
+
+    def expect(self, value: str):
+        kind, v = self.next()
+        if v != value:
+            raise CypherSyntaxError(f"GraphQL: expected {value!r}, got {v!r}")
+
+    def parse_document(self) -> dict:
+        kind, v = self.peek()
+        op = "query"
+        name = None
+        variables: dict[str, Any] = {}
+        if v in ("query", "mutation"):
+            op = v
+            self.next()
+            if self.peek()[0] == "name":
+                name = self.next()[1]
+            if self.peek()[1] == "(":
+                self.next()
+                while self.peek()[1] != ")":
+                    self.expect("$")
+                    self.next()  # var name
+                    self.expect(":")
+                    while self.peek()[1] not in (")", "$"):
+                        self.next()  # skip type tokens incl. ! and defaults
+                self.expect(")")
+        selections = self.parse_selection_set()
+        return {"operation": op, "name": name, "selections": selections}
+
+    def parse_selection_set(self) -> list[dict]:
+        self.expect("{")
+        out = []
+        while self.peek()[1] != "}":
+            out.append(self.parse_field())
+        self.expect("}")
+        return out
+
+    def parse_field(self) -> dict:
+        kind, name = self.next()
+        if kind != "name":
+            raise CypherSyntaxError(f"GraphQL: expected field name, got {name!r}")
+        alias = None
+        if self.peek()[1] == ":":
+            self.next()
+            alias, name = name, self.next()[1]
+        args = {}
+        if self.peek()[1] == "(":
+            self.next()
+            while self.peek()[1] != ")":
+                akind, aname = self.next()
+                self.expect(":")
+                args[aname] = self.parse_value()
+            self.expect(")")
+        sub = None
+        if self.peek()[1] == "{":
+            sub = self.parse_selection_set()
+        return {"name": name, "alias": alias or name, "args": args,
+                "selections": sub}
+
+    def parse_value(self) -> Any:
+        kind, v = self.next()
+        if kind == "string":
+            return json.loads(v)
+        if kind == "int":
+            return int(v)
+        if kind == "float":
+            return float(v)
+        if kind == "name":
+            if v == "true":
+                return True
+            if v == "false":
+                return False
+            if v == "null":
+                return None
+            return v  # enum-ish
+        if v == "$":
+            return _Var(self.next()[1])
+        if v == "[":
+            out = []
+            while self.peek()[1] != "]":
+                out.append(self.parse_value())
+            self.next()
+            return out
+        if v == "{":
+            out = {}
+            while self.peek()[1] != "}":
+                k = self.next()[1]
+                self.expect(":")
+                out[k] = self.parse_value()
+            self.next()
+            return out
+        raise CypherSyntaxError(f"GraphQL: unexpected value token {v!r}")
+
+
+class _Var:
+    def __init__(self, name: str):
+        self.name = name
+
+
+def parse_operation(query: str) -> str:
+    """Operation type of a document ("query"/"mutation"); "query" on parse
+    failure (the executor will produce the real error)."""
+    try:
+        return _Parser(query).parse_document()["operation"]
+    except Exception:
+        return "query"
+
+
+def _resolve_args(args: dict, variables: dict) -> dict:
+    def res(v):
+        if isinstance(v, _Var):
+            return variables.get(v.name)
+        if isinstance(v, list):
+            return [res(x) for x in v]
+        if isinstance(v, dict):
+            return {k: res(x) for k, x in v.items()}
+        return v
+
+    return {k: res(v) for k, v in args.items()}
+
+
+def _node_obj(n: Node) -> dict:
+    return {
+        "id": n.id,
+        "labels": list(n.labels),
+        "properties": dict(n.properties),
+        "decayScore": n.decay_score,
+        "accessCount": n.access_count,
+    }
+
+
+def _edge_obj(e: Edge) -> dict:
+    return {
+        "id": e.id,
+        "type": e.type,
+        "from": e.start_node,
+        "to": e.end_node,
+        "properties": dict(e.properties),
+        "confidence": e.confidence,
+        "autoGenerated": e.auto_generated,
+    }
+
+
+def _project(value: Any, selections: Optional[list[dict]]) -> Any:
+    """Apply a selection set to a result (GraphQL field projection)."""
+    if selections is None or value is None:
+        return value
+    if isinstance(value, list):
+        return [_project(v, selections) for v in value]
+    if not isinstance(value, dict):
+        return value
+    out = {}
+    for sel in selections:
+        out[sel["alias"]] = _project(value.get(sel["name"]), sel["selections"])
+    return out
+
+
+class GraphQLExecutor:
+    """(ref: pkg/graphql/handler.go + resolvers/)"""
+
+    def __init__(self, db):
+        self.db = db
+
+    def execute(self, query: str, variables: Optional[dict] = None) -> dict:
+        variables = variables or {}
+        try:
+            doc = _Parser(query).parse_document()
+        except Exception as e:
+            return {"errors": [{"message": f"parse error: {e}"}]}
+        data = {}
+        errors = []
+        for sel in doc["selections"]:
+            try:
+                args = _resolve_args(sel["args"], variables)
+                value = self._resolve(doc["operation"], sel["name"], args)
+                data[sel["alias"]] = _project(value, sel["selections"])
+            except Exception as e:
+                errors.append({"message": str(e), "path": [sel["alias"]]})
+                data[sel["alias"]] = None
+        out: dict[str, Any] = {"data": data}
+        if errors:
+            out["errors"] = errors
+        return out
+
+    # -- resolvers ----------------------------------------------------------
+    def _resolve(self, op: str, field: str, args: dict) -> Any:
+        db = self.db
+        if op == "query":
+            if field == "node":
+                return _node_obj(db.storage.get_node(args["id"]))
+            if field == "nodes":
+                label = args.get("label")
+                limit = int(args.get("limit", 100))
+                nodes = (
+                    db.storage.get_nodes_by_label(label)
+                    if label
+                    else list(db.storage.all_nodes())
+                )
+                return [_node_obj(n) for n in sorted(nodes, key=lambda n: n.id)[:limit]]
+            if field == "relationships":
+                rtype = args.get("type")
+                limit = int(args.get("limit", 100))
+                edges = (
+                    db.storage.get_edges_by_type(rtype)
+                    if rtype
+                    else list(db.storage.all_edges())
+                )
+                return [_edge_obj(e) for e in sorted(edges, key=lambda e: e.id)[:limit]]
+            if field == "search":
+                results = db.search.search(
+                    args.get("query", ""), limit=int(args.get("limit", 10))
+                )
+                return [
+                    {
+                        "id": r["id"],
+                        "score": r["score"],
+                        "content": r["content"],
+                        "node": _node_obj(r["node"]),
+                    }
+                    for r in results
+                ]
+            if field == "similar":
+                node = db.storage.get_node(args["id"])
+                if node.embedding is None:
+                    return []
+                hits = db.search.vector_candidates(
+                    node.embedding, k=int(args.get("limit", 10)) + 1
+                )
+                return [
+                    {"id": i, "score": s} for i, s in hits if i != node.id
+                ][: int(args.get("limit", 10))]
+            if field == "cypher":
+                result = db.executor.execute(
+                    args.get("statement", ""), args.get("parameters") or {}
+                )
+                from nornicdb_tpu.server.http import _jsonable
+
+                return {
+                    "columns": result.columns,
+                    "rows": [[_jsonable(v) for v in row] for row in result.rows],
+                    "stats": result.stats.as_dict(),
+                }
+            if field == "neighbors":
+                nodes = db.neighbors(args["id"], depth=int(args.get("depth", 1)))
+                return [_node_obj(n) for n in nodes]
+            if field == "stats":
+                return {
+                    "nodes": db.storage.node_count(),
+                    "edges": db.storage.edge_count(),
+                    "pendingEmbeddings": len(db.storage.pending_embed_ids()),
+                }
+            raise NornicError(f"unknown query field {field}")
+        if op == "mutation":
+            if field == "createNode":
+                node = Node(
+                    labels=list(args.get("labels") or []),
+                    properties=dict(args.get("properties") or {}),
+                )
+                return _node_obj(db.storage.create_node(node))
+            if field == "updateNode":
+                node = db.storage.get_node(args["id"])
+                node.properties.update(args.get("properties") or {})
+                return _node_obj(db.storage.update_node(node))
+            if field == "deleteNode":
+                db.storage.delete_node(args["id"])
+                return True
+            if field == "createRelationship":
+                edge = Edge(
+                    start_node=args["from"],
+                    end_node=args["to"],
+                    type=args.get("type", "RELATED_TO"),
+                    properties=dict(args.get("properties") or {}),
+                )
+                return _edge_obj(db.storage.create_edge(edge))
+            if field == "deleteRelationship":
+                db.storage.delete_edge(args["id"])
+                return True
+            raise NornicError(f"unknown mutation field {field}")
+        raise NornicError(f"unknown operation {op}")

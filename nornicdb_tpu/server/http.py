@@ -408,6 +408,19 @@ class HttpServer:
             h._auth("write")
             h._send(200, self._mcp(h._body()))
             return
+        if path == "/graphql":
+            # (ref: pkg/graphql mounted at /graphql, handler.go)
+            h._auth("read")  # gate before touching the body
+            body = h._body()
+            q = body.get("query", "")
+            from nornicdb_tpu.server.graphql import GraphQLExecutor, parse_operation
+
+            if parse_operation(q) == "mutation":
+                h._auth("write")
+            h._send(200, _jsonable(
+                GraphQLExecutor(self.db).execute(q, body.get("variables"))
+            ))
+            return
         if path in ("/api/bifrost/chat/completions", "/v1/chat/completions"):
             # (ref: server_router.go:215 -> heimdall handler.go:207)
             h._auth("read")

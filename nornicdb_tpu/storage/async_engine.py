@@ -30,6 +30,7 @@ class AsyncEngine(Engine):
         self._edges: dict[str, object] = {}
         self._node_is_create: set[str] = set()
         self._edge_is_create: set[str] = set()
+        self._flush_lock = threading.Lock()
         self._closed = False
         base.on_event(self._emit)
         self._flusher = threading.Thread(target=self._flush_loop, daemon=True)
@@ -46,7 +47,15 @@ class AsyncEngine(Engine):
                 pass
 
     def flush(self) -> None:
-        """Drain the overlay into the base engine, preserving op order per id."""
+        """Drain the overlay into the base engine, preserving op order per id.
+
+        Serialized: an explicit flush must not return while a background
+        flush that already popped overlay entries is still applying them
+        (counts would transiently miss those entries)."""
+        with self._flush_lock:
+            self._flush_locked()
+
+    def _flush_locked(self) -> None:
         with self._lock:
             nodes = list(self._nodes.items())
             node_creates = set(self._node_is_create)
@@ -109,7 +118,14 @@ class AsyncEngine(Engine):
                 raise NotFoundError(f"node {node_id} not found")
             if val is not None:
                 return val.copy()  # type: ignore[union-attr]
-        return self.base.get_node(node_id)
+        try:
+            return self.base.get_node(node_id)
+        except NotFoundError:
+            # a background flush may have popped the entry from the overlay
+            # but not yet applied it to the base; retry once the in-flight
+            # flush (if any) has drained
+            with self._flush_lock:
+                return self.base.get_node(node_id)
 
     def update_node(self, node: Node) -> Node:
         with self._lock:
@@ -208,26 +224,29 @@ class AsyncEngine(Engine):
         self.flush()
         return self.base.all_edges()
 
-    # -- counts: overlay-aware (ref: async_count_bug_test.go) --------------
+    # -- counts: overlay-aware (ref: async_count_bug_test.go). The flush
+    # lock keeps the popped-but-not-yet-applied window out of the count.
     def node_count(self) -> int:
-        with self._lock:
-            delta = 0
-            for nid, val in self._nodes.items():
-                if val is _TOMBSTONE:
-                    delta -= 1
-                elif nid in self._node_is_create:
-                    delta += 1
-        return self.base.node_count() + delta
+        with self._flush_lock:
+            with self._lock:
+                delta = 0
+                for nid, val in self._nodes.items():
+                    if val is _TOMBSTONE:
+                        delta -= 1
+                    elif nid in self._node_is_create:
+                        delta += 1
+            return self.base.node_count() + delta
 
     def edge_count(self) -> int:
-        with self._lock:
-            delta = 0
-            for eid, val in self._edges.items():
-                if val is _TOMBSTONE:
-                    delta -= 1
-                elif eid in self._edge_is_create:
-                    delta += 1
-        return self.base.edge_count() + delta
+        with self._flush_lock:
+            with self._lock:
+                delta = 0
+                for eid, val in self._edges.items():
+                    if val is _TOMBSTONE:
+                        delta -= 1
+                    elif eid in self._edge_is_create:
+                        delta += 1
+            return self.base.edge_count() + delta
 
     # -- pending embed -----------------------------------------------------
     def mark_pending_embed(self, node_id: str) -> None:

@@ -1,0 +1,135 @@
+"""GraphQL endpoint + Cypher temporal function tests
+(ref: pkg/graphql resolvers; Neo4j temporal semantics)."""
+
+import json
+import urllib.request
+
+import pytest
+
+import nornicdb_tpu
+from nornicdb_tpu.cypher import CypherExecutor
+from nornicdb_tpu.server import HttpServer
+from nornicdb_tpu.server.graphql import GraphQLExecutor
+from nornicdb_tpu.storage import MemoryEngine
+
+
+@pytest.fixture
+def db():
+    d = nornicdb_tpu.open_db("")
+    yield d
+    d.close()
+
+
+class TestGraphQL:
+    def test_create_and_query_nodes(self, db):
+        gq = GraphQLExecutor(db)
+        out = gq.execute(
+            'mutation { createNode(labels: ["City"], properties: {name: "Oslo", pop: 700000}) { id properties } }'
+        )
+        assert out["data"]["createNode"]["properties"]["name"] == "Oslo"
+        out = gq.execute('{ nodes(label: "City") { id labels properties } }')
+        assert len(out["data"]["nodes"]) == 1
+        assert out["data"]["nodes"][0]["labels"] == ["City"]
+
+    def test_field_projection_and_alias(self, db):
+        gq = GraphQLExecutor(db)
+        gq.execute('mutation { createNode(labels: ["P"], properties: {a: 1, b: 2}) { id } }')
+        out = gq.execute('{ people: nodes(label: "P") { props: properties } }')
+        row = out["data"]["people"][0]
+        assert set(row.keys()) == {"props"}  # only selected fields
+        assert row["props"] == {"a": 1, "b": 2}
+
+    def test_relationships_and_neighbors(self, db):
+        gq = GraphQLExecutor(db)
+        a = gq.execute('mutation { createNode(labels: ["N"]) { id } }')["data"]["createNode"]["id"]
+        b = gq.execute('mutation { createNode(labels: ["N"]) { id } }')["data"]["createNode"]["id"]
+        out = gq.execute(
+            'mutation($f: ID, $t: ID) { createRelationship(from: $f, to: $t, type: "LINKS") { id type } }',
+            {"f": a, "t": b},
+        )
+        assert out["data"]["createRelationship"]["type"] == "LINKS"
+        out = gq.execute(f'{{ neighbors(id: "{a}") {{ id }} }}')
+        assert out["data"]["neighbors"][0]["id"] == b
+
+    def test_cypher_passthrough(self, db):
+        gq = GraphQLExecutor(db)
+        out = gq.execute(
+            'query($s: String) { cypher(statement: $s) { columns rows } }',
+            {"s": "RETURN 1 + 1 AS two"},
+        )
+        assert out["data"]["cypher"] == {"columns": ["two"], "rows": [[2]]}
+
+    def test_errors_reported_per_field(self, db):
+        gq = GraphQLExecutor(db)
+        out = gq.execute('{ node(id: "missing") { id } stats { nodes } }')
+        assert out["data"]["node"] is None
+        assert out["data"]["stats"]["nodes"] == 0
+        assert out["errors"][0]["path"] == ["node"]
+
+    def test_http_graphql_endpoint(self, db):
+        server = HttpServer(db, port=0)
+        server.start()
+        try:
+            req = urllib.request.Request(
+                f"http://127.0.0.1:{server.port}/graphql",
+                data=json.dumps(
+                    {"query": "{ stats { nodes edges } }"}
+                ).encode(),
+                headers={"Content-Type": "application/json"},
+            )
+            with urllib.request.urlopen(req) as resp:
+                out = json.loads(resp.read())
+            assert out["data"]["stats"] == {"nodes": 0, "edges": 0}
+        finally:
+            server.stop()
+
+
+class TestTemporal:
+    @pytest.fixture
+    def ex(self):
+        return CypherExecutor(MemoryEngine())
+
+    def test_date_and_accessors(self, ex):
+        r = ex.execute("RETURN date('2024-03-15') AS d")
+        d = r.rows[0][0]
+        assert (d["year"], d["month"], d["day"]) == (2024, 3, 15)
+        r = ex.execute("RETURN date('2024-03-15').year AS y")
+        assert r.rows == [[2024]]
+
+    def test_datetime_parse_and_epoch(self, ex):
+        r = ex.execute("RETURN datetime('2024-01-01T00:00:00Z') AS dt")
+        dt = r.rows[0][0]
+        assert dt["epochMillis"] == 1704067200000
+        r = ex.execute("RETURN datetime.fromEpochMillis(0).year AS y")
+        assert r.rows == [[1970]]
+
+    def test_duration(self, ex):
+        r = ex.execute("RETURN duration('P1DT2H30M') AS d")
+        d = r.rows[0][0]
+        assert d["days"] == 1 and d["hours"] == 2 and d["minutes"] == 30
+        assert d["milliseconds"] == (86400 + 2 * 3600 + 30 * 60) * 1000
+        r = ex.execute("RETURN duration({hours: 2}).iso AS i")
+        assert r.rows == [["PT2H"]]
+
+    def test_duration_between(self, ex):
+        r = ex.execute(
+            "RETURN duration.between(datetime('2024-01-01T00:00:00Z'), "
+            "datetime('2024-01-02T03:00:00Z')) AS d"
+        )
+        d = r.rows[0][0]
+        assert d["days"] == 1 and d["hours"] == 3
+
+    def test_truncate_and_ordering(self, ex):
+        r = ex.execute("RETURN date.truncate('month', datetime('2024-03-15T10:00:00Z')).day AS d")
+        assert r.rows == [[1]]
+        # iso strings sort correctly
+        r = ex.execute(
+            "UNWIND ['2024-05-01', '2023-01-01', '2024-01-01'] AS s "
+            "RETURN date(s).iso AS d ORDER BY d"
+        )
+        assert [row[0] for row in r.rows] == ["2023-01-01", "2024-01-01", "2024-05-01"]
+
+    def test_store_datetime_property(self, ex):
+        ex.execute("CREATE (:E {at: datetime('2024-06-01T12:00:00Z').epochMillis})")
+        r = ex.execute("MATCH (e:E) WHERE e.at > 0 RETURN e.at")
+        assert r.rows == [[1717243200000]]
