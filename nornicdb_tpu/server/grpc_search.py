@@ -1,0 +1,247 @@
+"""Native gRPC search service.
+
+Behavioral reference: /root/reference/pkg/nornicgrpc/ —
+proto/nornicdb_search.proto + search_service.go: a lean gRPC surface for
+high-throughput vector/hybrid search (the reference's fastest endpoint:
+29,331 ops/s in testing/e2e/README.md).
+
+grpc_tools (protoc's Python plugin) is not in this image, so the protobuf
+messages are hand-encoded against the wire format (varint/tag codec below)
+and the service is registered through grpc.GenericRpcHandler — no generated
+stubs. Wire-compatible message shapes:
+
+  SearchRequest  { string query = 1; int32 limit = 2;
+                   repeated float vector = 3; float min_score = 4; }
+  SearchHit      { string id = 1; float score = 2; string content = 3; }
+  SearchResponse { repeated SearchHit hits = 1; int64 took_micros = 2; }
+
+Service: nornicdb.SearchService / Search
+"""
+
+from __future__ import annotations
+
+import struct
+import time
+from typing import Any, Iterator, Optional
+
+SERVICE_NAME = "nornicdb.SearchService"
+
+
+# ---------------------------------------------------------------- protobuf
+def _varint(v: int) -> bytes:
+    out = bytearray()
+    while True:
+        b = v & 0x7F
+        v >>= 7
+        if v:
+            out.append(b | 0x80)
+        else:
+            out.append(b)
+            return bytes(out)
+
+
+def _read_varint(buf: bytes, pos: int) -> tuple[int, int]:
+    out = 0
+    shift = 0
+    while True:
+        b = buf[pos]
+        pos += 1
+        out |= (b & 0x7F) << shift
+        if not b & 0x80:
+            return out, pos
+        shift += 7
+
+
+def _tag(field: int, wire: int) -> bytes:
+    return _varint((field << 3) | wire)
+
+
+def encode_search_request(
+    query: str = "", limit: int = 10,
+    vector: Optional[list[float]] = None, min_score: float = 0.0,
+) -> bytes:
+    out = bytearray()
+    if query:
+        q = query.encode()
+        out += _tag(1, 2) + _varint(len(q)) + q
+    if limit:
+        out += _tag(2, 0) + _varint(limit)
+    if vector:
+        packed = b"".join(struct.pack("<f", float(x)) for x in vector)
+        out += _tag(3, 2) + _varint(len(packed)) + packed
+    if min_score:
+        out += _tag(4, 5) + struct.pack("<f", min_score)
+    return bytes(out)
+
+
+def decode_search_request(buf: bytes) -> dict[str, Any]:
+    pos = 0
+    out: dict[str, Any] = {"query": "", "limit": 10, "vector": [], "min_score": 0.0}
+    while pos < len(buf):
+        key, pos = _read_varint(buf, pos)
+        field, wire = key >> 3, key & 7
+        if wire == 0:
+            v, pos = _read_varint(buf, pos)
+            if field == 2:
+                out["limit"] = v
+        elif wire == 2:
+            ln, pos = _read_varint(buf, pos)
+            data = buf[pos : pos + ln]
+            pos += ln
+            if field == 1:
+                out["query"] = data.decode()
+            elif field == 3:
+                out["vector"] = [
+                    struct.unpack_from("<f", data, i)[0]
+                    for i in range(0, len(data), 4)
+                ]
+        elif wire == 5:
+            (v,) = struct.unpack_from("<f", buf, pos)
+            pos += 4
+            if field == 4:
+                out["min_score"] = v
+        else:
+            raise ValueError(f"unsupported wire type {wire}")
+    return out
+
+
+def encode_search_response(hits: list[dict[str, Any]], took_micros: int) -> bytes:
+    out = bytearray()
+    for h in hits:
+        hit = bytearray()
+        hid = str(h["id"]).encode()
+        hit += _tag(1, 2) + _varint(len(hid)) + hid
+        hit += _tag(2, 5) + struct.pack("<f", float(h["score"]))
+        content = str(h.get("content", "")).encode()
+        if content:
+            hit += _tag(3, 2) + _varint(len(content)) + content
+        out += _tag(1, 2) + _varint(len(hit)) + bytes(hit)
+    out += _tag(2, 0) + _varint(took_micros)
+    return bytes(out)
+
+
+def decode_search_response(buf: bytes) -> dict[str, Any]:
+    pos = 0
+    hits = []
+    took = 0
+    while pos < len(buf):
+        key, pos = _read_varint(buf, pos)
+        field, wire = key >> 3, key & 7
+        if wire == 2 and field == 1:
+            ln, pos = _read_varint(buf, pos)
+            sub = buf[pos : pos + ln]
+            pos += ln
+            hit = {"id": "", "score": 0.0, "content": ""}
+            spos = 0
+            while spos < len(sub):
+                skey, spos = _read_varint(sub, spos)
+                sfield, swire = skey >> 3, skey & 7
+                if swire == 2:
+                    sln, spos = _read_varint(sub, spos)
+                    data = sub[spos : spos + sln]
+                    spos += sln
+                    if sfield == 1:
+                        hit["id"] = data.decode()
+                    elif sfield == 3:
+                        hit["content"] = data.decode()
+                elif swire == 5:
+                    (hit["score"],) = struct.unpack_from("<f", sub, spos)
+                    spos += 4
+                else:
+                    v, spos = _read_varint(sub, spos)
+            hits.append(hit)
+        elif wire == 0 and field == 2:
+            took, pos = _read_varint(buf, pos)
+        else:
+            break
+    return {"hits": hits, "took_micros": took}
+
+
+# ---------------------------------------------------------------- service
+class GrpcSearchServer:
+    """(ref: nornicgrpc search_service.go) — generic handler, no stubs."""
+
+    def __init__(self, db, host: str = "127.0.0.1", port: int = 0,
+                 max_workers: int = 8):
+        import grpc
+        from concurrent import futures
+
+        self.db = db
+        outer = self
+
+        class Handler(grpc.GenericRpcHandler):
+            def service(self, handler_call_details):
+                if handler_call_details.method == f"/{SERVICE_NAME}/Search":
+                    return grpc.unary_unary_rpc_method_handler(
+                        outer._search,
+                        request_deserializer=lambda b: b,
+                        response_serializer=lambda b: b,
+                    )
+                return None
+
+        self._server = grpc.server(
+            futures.ThreadPoolExecutor(max_workers=max_workers)
+        )
+        self._server.add_generic_rpc_handlers((Handler(),))
+        self.port = self._server.add_insecure_port(f"{host}:{port}")
+        self.host = host
+
+    def _search(self, request: bytes, context) -> bytes:
+        t0 = time.perf_counter()
+        req = decode_search_request(request)
+        if req["vector"]:
+            import numpy as np
+
+            hits = self.db.search.vector_candidates(
+                np.asarray(req["vector"], np.float32),
+                k=req["limit"], min_similarity=req["min_score"],
+            )
+            out = []
+            for nid, score in hits:
+                node = None
+                try:
+                    node = self.db.storage.get_node(nid)
+                except Exception:
+                    pass
+                out.append(
+                    {
+                        "id": nid,
+                        "score": score,
+                        "content": node.properties.get("content", "") if node else "",
+                    }
+                )
+        else:
+            results = self.db.search.search(req["query"], limit=req["limit"])
+            out = [
+                {"id": r["id"], "score": r["score"], "content": r["content"]}
+                for r in results
+            ]
+        took = int((time.perf_counter() - t0) * 1e6)
+        return encode_search_response(out, took)
+
+    def start(self) -> None:
+        self._server.start()
+
+    def stop(self) -> None:
+        self._server.stop(grace=1)
+
+
+def search_over_grpc(
+    host: str, port: int, query: str = "",
+    vector: Optional[list[float]] = None, limit: int = 10,
+    min_score: float = 0.0,
+) -> dict[str, Any]:
+    """Client helper (used by tests/CLI; any protobuf-speaking Qdrant/neo4j
+    ecosystem client can hit the same endpoint with generated stubs)."""
+    import grpc
+
+    channel = grpc.insecure_channel(f"{host}:{port}")
+    fn = channel.unary_unary(
+        f"/{SERVICE_NAME}/Search",
+        request_serializer=lambda b: b,
+        response_deserializer=lambda b: b,
+    )
+    req = encode_search_request(query, limit, vector, min_score)
+    resp = fn(req, timeout=10)
+    channel.close()
+    return decode_search_response(resp)

@@ -407,3 +407,69 @@ class TestAuth:
         finally:
             server.stop()
             db.close()
+
+
+class TestGrpcSearch:
+    """(ref: pkg/nornicgrpc — the reference's fastest protocol endpoint)"""
+
+    def _server(self):
+        db = nornicdb_tpu.open_db("")
+        db.set_embedder(HashEmbedder(32))
+        from nornicdb_tpu.server.grpc_search import GrpcSearchServer
+
+        srv = GrpcSearchServer(db, port=0)
+        srv.start()
+        return db, srv
+
+    def test_protobuf_codec_roundtrip(self):
+        from nornicdb_tpu.server.grpc_search import (
+            decode_search_request,
+            decode_search_response,
+            encode_search_request,
+            encode_search_response,
+        )
+
+        req = decode_search_request(
+            encode_search_request("hello", 5, [0.5, -1.5], 0.25)
+        )
+        assert req["query"] == "hello" and req["limit"] == 5
+        assert req["vector"] == [0.5, -1.5]
+        assert abs(req["min_score"] - 0.25) < 1e-6
+        resp = decode_search_response(
+            encode_search_response(
+                [{"id": "a", "score": 0.9, "content": "text"}], 123
+            )
+        )
+        assert resp["hits"][0]["id"] == "a"
+        assert resp["took_micros"] == 123
+
+    def test_text_search_over_grpc(self):
+        from nornicdb_tpu.server.grpc_search import search_over_grpc
+
+        db, srv = self._server()
+        try:
+            db.store("the grpc endpoint serves vectors fast")
+            db.process_pending_embeddings()
+            out = search_over_grpc("127.0.0.1", srv.port, query="grpc vectors")
+            assert out["hits"] and "grpc" in out["hits"][0]["content"]
+            assert out["took_micros"] > 0
+        finally:
+            srv.stop()
+            db.close()
+
+    def test_vector_search_over_grpc(self):
+        from nornicdb_tpu.server.grpc_search import search_over_grpc
+
+        db, srv = self._server()
+        try:
+            n = db.store("target document")
+            db.process_pending_embeddings()
+            vec = db.storage.get_node(n.id).embedding
+            out = search_over_grpc(
+                "127.0.0.1", srv.port, vector=list(map(float, vec)), limit=1
+            )
+            assert out["hits"][0]["id"] == n.id
+            assert out["hits"][0]["score"] > 0.99
+        finally:
+            srv.stop()
+            db.close()
