@@ -34,6 +34,8 @@ class Config:
     async_writes: bool = True
     flush_interval: float = 0.05
     wal_sync: bool = False
+    # at-rest encryption (ref: db.go:781-809 — PBKDF2-derived key)
+    encryption_passphrase: str = ""
     auto_compact: bool = False
     auto_compact_interval: float = 300.0
     # embedding
@@ -71,6 +73,7 @@ class DB:
             wal_sync=self.config.wal_sync,
             auto_compact=self.config.auto_compact,
             auto_compact_interval=self.config.auto_compact_interval,
+            encryption_passphrase=self.config.encryption_passphrase,
         )
         # The default database is itself a namespace on the shared base
         # engine, exactly like the reference's "nornic" namespace

@@ -84,6 +84,7 @@ def open_storage(
     wal_sync: bool = False,
     auto_compact: bool = False,
     auto_compact_interval: float = 300.0,
+    encryption_passphrase: str = "",
 ) -> Engine:
     """Assemble the storage chain (ref: pkg/nornicdb/db.go:750-914).
 
@@ -92,7 +93,8 @@ def open_storage(
     base: Engine = MemoryEngine()
     if data_dir:
         os.makedirs(data_dir, exist_ok=True)
-        wal = WAL(os.path.join(data_dir, "wal"), sync=wal_sync)
+        wal = WAL(os.path.join(data_dir, "wal"), sync=wal_sync,
+                  passphrase=encryption_passphrase or None)
         wal.recover(base)
         base = WALEngine(
             base,

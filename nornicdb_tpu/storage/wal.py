@@ -55,11 +55,13 @@ class WALEntry:
     data: dict[str, Any] = field(default_factory=dict)
     txid: Optional[str] = None
 
-    def encode(self) -> bytes:
+    def encode(self, encryptor=None) -> bytes:
         payload = json.dumps(
             {"op": self.op, "data": self.data, "txid": self.txid},
             separators=(",", ":"),
         ).encode("utf-8")
+        if encryptor is not None:
+            payload = encryptor.encrypt(payload)
         if _native.enabled():
             native_rec = _native.encode(payload, self.seq)
             if native_rec is not None:
@@ -108,18 +110,40 @@ class WALStats:
 
 
 class WAL:
-    """Append-only log file + snapshot management (ref: storage.WAL wal.go:263)."""
+    """Append-only log file + snapshot management (ref: storage.WAL wal.go:263).
+
+    With a passphrase, record payloads and snapshots are encrypted at rest
+    with AES-256-GCM (the reference delegates at-rest encryption to Badger
+    with a PBKDF2-derived key, db.go:781-809; here the WAL is the storage of
+    record so it encrypts its own payloads). The PBKDF2 salt persists next
+    to the log.
+    """
 
     LOG_NAME = "wal.log"
     SNAPSHOT_NAME = "snapshot.json"
+    SALT_NAME = "wal.salt"
 
-    def __init__(self, directory: str, sync: bool = False):
+    def __init__(self, directory: str, sync: bool = False,
+                 passphrase: Optional[str] = None):
         self.dir = directory
         self.sync = sync
         os.makedirs(directory, exist_ok=True)
         self._path = os.path.join(directory, self.LOG_NAME)
         self._lock = threading.Lock()
         self.stats = WALStats()
+        self._encryptor = None
+        if passphrase:
+            from nornicdb_tpu.encryption import Encryptor, new_salt
+
+            salt_path = os.path.join(directory, self.SALT_NAME)
+            if os.path.exists(salt_path):
+                with open(salt_path, "rb") as f:
+                    salt = f.read()
+            else:
+                salt = new_salt()
+                with open(salt_path, "wb") as f:
+                    f.write(salt)
+            self._encryptor = Encryptor.from_passphrase(passphrase, salt)
         self._seq = self._scan_last_seq()
         self._f = open(self._path, "ab")
 
@@ -128,7 +152,7 @@ class WAL:
         with self._lock:
             self._seq += 1
             entry = WALEntry(seq=self._seq, op=op, data=data, txid=txid)
-            raw = entry.encode()
+            raw = entry.encode(self._encryptor)
             self._f.write(raw)
             self._f.flush()
             if self.sync:
@@ -140,6 +164,11 @@ class WAL:
     @property
     def last_seq(self) -> int:
         return self._seq
+
+    def _decrypt(self, payload: bytes) -> bytes:
+        if self._encryptor is None:
+            return payload
+        return self._encryptor.decrypt(payload)
 
     # -- read / replay -----------------------------------------------------
     def read_all(self, strict: bool = False) -> list[WALEntry]:
@@ -164,7 +193,7 @@ class WAL:
                 self.stats.truncated_tail_records += 1
             for payload, seq in records:
                 try:
-                    obj = json.loads(payload.decode("utf-8"))
+                    obj = json.loads(self._decrypt(payload).decode("utf-8"))
                 except Exception:
                     if strict:
                         raise WALCorruptionError("bad payload")
@@ -192,7 +221,7 @@ class WAL:
                 self.stats.truncated_tail_records += 1
                 break
             try:
-                obj = json.loads(payload.decode("utf-8"))
+                obj = json.loads(self._decrypt(payload).decode("utf-8"))
             except Exception:
                 if strict:
                     raise WALCorruptionError(f"bad payload at offset {off}")
@@ -226,8 +255,11 @@ class WAL:
         }
         path = os.path.join(self.dir, self.SNAPSHOT_NAME)
         tmp = path + ".tmp"
-        with open(tmp, "w") as f:
-            json.dump(snap, f)
+        blob = json.dumps(snap).encode("utf-8")
+        if self._encryptor is not None:
+            blob = b"NSNAPENC" + self._encryptor.encrypt(blob)
+        with open(tmp, "wb") as f:
+            f.write(blob)
             f.flush()
             os.fsync(f.fileno())
         os.replace(tmp, path)
@@ -245,8 +277,15 @@ class WAL:
         path = os.path.join(self.dir, self.SNAPSHOT_NAME)
         if not os.path.exists(path):
             return None
-        with open(path) as f:
-            return json.load(f)
+        with open(path, "rb") as f:
+            blob = f.read()
+        if blob.startswith(b"NSNAPENC"):
+            if self._encryptor is None:
+                raise WALCorruptionError(
+                    "snapshot is encrypted; passphrase required"
+                )
+            blob = self._encryptor.decrypt(blob[8:])
+        return json.loads(blob.decode("utf-8"))
 
     # -- recovery ----------------------------------------------------------
     def recover(self, engine: Engine) -> int:

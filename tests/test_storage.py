@@ -375,3 +375,46 @@ class TestOpenStorage:
         assert eng2.get_node("a").properties["v"] == 42
         assert eng2.get_edge("e").end_node == "b"
         eng2.close()
+
+
+class TestEncryptedWAL:
+    """At-rest encryption (ref: encryption_e2e_test.go in the reference)."""
+
+    def test_roundtrip_and_ciphertext_on_disk(self, tmp_path):
+        import nornicdb_tpu
+        from nornicdb_tpu.db import Config
+
+        d = str(tmp_path / "enc")
+        cfg = Config(encryption_passphrase="hunter2")
+        db = nornicdb_tpu.open_db(d, cfg)
+        db.store("top secret payload contents")
+        db.flush()
+        db.close()
+        # raw log must not contain the plaintext
+        raw = (tmp_path / "enc" / "wal" / "wal.log").read_bytes()
+        snap = (tmp_path / "enc" / "wal" / "snapshot.json").read_bytes()
+        assert b"top secret" not in raw
+        assert b"top secret" not in snap
+        # reopen with the right passphrase recovers
+        db2 = nornicdb_tpu.open_db(d, Config(encryption_passphrase="hunter2"))
+        nodes = list(db2.storage.all_nodes())
+        assert nodes and nodes[0].properties["content"].startswith("top secret")
+        db2.close()
+
+    def test_wrong_passphrase_recovers_nothing(self, tmp_path):
+        import nornicdb_tpu
+        from nornicdb_tpu.db import Config
+        from nornicdb_tpu.errors import WALCorruptionError
+
+        d = str(tmp_path / "enc2")
+        db = nornicdb_tpu.open_db(d, Config(encryption_passphrase="right"))
+        db.store("secret")
+        db.flush()
+        db.close()
+        with pytest.raises(Exception):
+            db2 = nornicdb_tpu.open_db(d, Config(encryption_passphrase="wrong"))
+            try:
+                assert db2.storage.node_count() == 0
+                raise WALCorruptionError("decryption produced no data")
+            finally:
+                db2.close()
