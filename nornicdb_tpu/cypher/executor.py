@@ -1021,6 +1021,16 @@ class CypherExecutor:
             stmt.name, stmt.kind, stmt.label, stmt.properties, stmt.options,
             stmt.if_not_exists,
         )
+        if stmt.kind == "vector" and self.db is not None:
+            registry = getattr(self.db, "vectorspaces", None)
+            if registry is not None:
+                from nornicdb_tpu.vectorspace import VectorSpaceKey
+
+                opts = stmt.options.get("indexConfig", stmt.options) or {}
+                dims = int(opts.get("vector.dimensions", 0) or 0)
+                sim = str(opts.get("vector.similarity_function", "cosine"))
+                if dims:
+                    registry.register(VectorSpaceKey(stmt.name, dims, sim.lower()))
         r = Result([], [])
         r.stats.indexes_added = 1
         return r

@@ -101,6 +101,7 @@ class DB:
         self._db_executors: dict[str, Any] = {}
         self._query_cache = None
         self._heimdall = None
+        self._vectorspaces = None
 
     @staticmethod
     def _migrate_unprefixed(base: Engine, namespace: str) -> None:
@@ -189,6 +190,7 @@ class DB:
                     self.storage,
                     embedder=self._embedder,
                     brute_force_max=self.config.search_brute_force_max,
+                    vectorspaces=self.vectorspaces,
                 )
                 # wire storage events + backfill existing nodes
                 # (ref: db.go:1020-1033, EnsureSearchIndexesBuilt db.go:1044)
@@ -196,6 +198,16 @@ class DB:
                 svc.build_indexes()
                 self._search = svc
         return self._search
+
+    @property
+    def vectorspaces(self):
+        """Canonical named vector spaces (ref: pkg/vectorspace registry)."""
+        with self._lock:
+            if self._vectorspaces is None:
+                from nornicdb_tpu.vectorspace import VectorSpaceRegistry
+
+                self._vectorspaces = VectorSpaceRegistry()
+            return self._vectorspaces
 
     @property
     def query_cache(self):

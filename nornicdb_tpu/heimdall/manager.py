@@ -150,6 +150,9 @@ class HeimdallManager:
         self.bifrost = Bifrost()
         self.metrics = HeimdallMetrics()
         self._actions: dict[str, ActionFn] = {}
+        # a PluginHost installs itself here so chat-path actions run through
+        # the pre/post-execute hooks (incl. veto)
+        self.action_dispatcher: Optional[Callable[[dict], Any]] = None
         self._lock = threading.Lock()
         # built-in actions (ref: plugins/heimdall reference plugin actions)
         self.register_action("status", self._action_status)
@@ -222,13 +225,20 @@ class HeimdallManager:
         action_result = None
         action = self.try_parse_action(text)
         if action is not None:
-            fn = self._actions.get(str(action.get("action")))
-            if fn is not None:
+            if self.action_dispatcher is not None:
                 try:
-                    action_result = fn(action.get("params") or {})
+                    action_result = self.action_dispatcher(action)
                     self.metrics.actions_executed += 1
                 except Exception as e:
                     action_result = {"error": str(e)}
+            else:
+                fn = self._actions.get(str(action.get("action")))
+                if fn is not None:
+                    try:
+                        action_result = fn(action.get("params") or {})
+                        self.metrics.actions_executed += 1
+                    except Exception as e:
+                        action_result = {"error": str(e)}
         self.bifrost.broadcast("chat", {"content": text[:200]})
         response = {
             "id": f"chatcmpl-{int(time.time() * 1000)}",

@@ -1,0 +1,226 @@
+"""Heimdall plugin system: lifecycle hooks, actions, health, DB monitoring.
+
+Behavioral reference: /root/reference/pkg/heimdall/plugin.go (1,488 LoC —
+PrePrompt/PreExecute/PostExecute hooks, plugin lifecycle, health, config
+schema) and plugins/heimdall/plugin.go:62-424 (the "Watcher" reference
+plugin: hello/status/health/config actions); directory loading mirrors
+pkg/nornicdb/plugins.go:56 (Python modules instead of Go .so files).
+"""
+
+from __future__ import annotations
+
+import importlib.util
+import os
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Any, Callable, Optional
+
+
+@dataclass
+class PluginInfo:
+    name: str
+    version: str = "0.0.1"
+    description: str = ""
+    healthy: bool = True
+    started_at: float = 0.0
+
+
+class HeimdallPlugin:
+    """Base class for plugins (ref: plugin.go lifecycle interface)."""
+
+    name = "plugin"
+    version = "0.0.1"
+    description = ""
+
+    # lifecycle ------------------------------------------------------------
+    def on_start(self, manager) -> None:
+        pass
+
+    def on_stop(self) -> None:
+        pass
+
+    def health(self) -> bool:
+        return True
+
+    # generation hooks (ref: PrePrompt/PreExecute/PostExecute) -------------
+    def pre_prompt(self, prompt: str) -> str:
+        return prompt
+
+    def pre_execute(self, action: dict[str, Any]) -> Optional[dict[str, Any]]:
+        """Return modified action, or None to veto execution."""
+        return action
+
+    def post_execute(self, action: dict[str, Any], result: Any) -> Any:
+        return result
+
+    # actions --------------------------------------------------------------
+    def actions(self) -> dict[str, Callable[[dict], Any]]:
+        return {}
+
+    # storage event monitoring (ref: DB event monitoring) ------------------
+    def on_db_event(self, kind: str, entity: Any) -> None:
+        pass
+
+
+class PluginHost:
+    """Plugin lifecycle manager wired into a HeimdallManager + DB."""
+
+    def __init__(self, manager, db=None):
+        self.manager = manager
+        self.db = db
+        self._lock = threading.Lock()
+        self._plugins: dict[str, HeimdallPlugin] = {}
+        self._info: dict[str, PluginInfo] = {}
+        if db is not None:
+            db.storage.on_event(self._on_db_event)
+        self._install_hooks()
+
+    # -- registration -------------------------------------------------------
+    def register(self, plugin: HeimdallPlugin) -> PluginInfo:
+        with self._lock:
+            self._plugins[plugin.name] = plugin
+            info = PluginInfo(
+                plugin.name, plugin.version, plugin.description,
+                started_at=time.time(),
+            )
+            self._info[plugin.name] = info
+        plugin.on_start(self.manager)
+        registered = []
+        for action, fn in plugin.actions().items():
+            # namespaced always; bare name only when it doesn't clobber a
+            # built-in or another plugin's action
+            namespaced = f"{plugin.name}.{action}"
+            self.manager.register_action(namespaced, fn)
+            registered.append(namespaced)
+            if action not in self.manager._actions:
+                self.manager.register_action(action, fn)
+                registered.append(action)
+        with self._lock:
+            self._registered_actions = getattr(self, "_registered_actions", {})
+            self._registered_actions[plugin.name] = registered
+        return info
+
+    def unregister(self, name: str) -> None:
+        with self._lock:
+            plugin = self._plugins.pop(name, None)
+            self._info.pop(name, None)
+            actions = getattr(self, "_registered_actions", {}).pop(name, [])
+        for a in actions:
+            self.manager._actions.pop(a, None)
+        if plugin is not None:
+            plugin.on_stop()
+
+    def load_directory(self, path: str) -> list[PluginInfo]:
+        """Load every *.py module exposing PLUGIN (ref: LoadPluginsFromDir
+        pkg/nornicdb/plugins.go:56 — Python modules instead of .so)."""
+        out = []
+        if not os.path.isdir(path):
+            return out
+        for fname in sorted(os.listdir(path)):
+            if not fname.endswith(".py") or fname.startswith("_"):
+                continue
+            mod_path = os.path.join(path, fname)
+            spec = importlib.util.spec_from_file_location(
+                f"heimdall_plugin_{fname[:-3]}", mod_path
+            )
+            try:
+                mod = importlib.util.module_from_spec(spec)
+                spec.loader.exec_module(mod)  # type: ignore[union-attr]
+                plugin = getattr(mod, "PLUGIN", None)
+                if isinstance(plugin, HeimdallPlugin):
+                    out.append(self.register(plugin))
+            except Exception:
+                continue  # a broken plugin must not break the host
+        return out
+
+    # -- status ------------------------------------------------------------
+    def plugins(self) -> list[PluginInfo]:
+        with self._lock:
+            infos = list(self._info.values())
+        for info in infos:
+            plugin = self._plugins.get(info.name)
+            if plugin is not None:
+                try:
+                    info.healthy = bool(plugin.health())
+                except Exception:
+                    info.healthy = False
+        return infos
+
+    # -- hook plumbing ------------------------------------------------------
+    def _install_hooks(self) -> None:
+        mgr = self.manager
+        mgr.action_dispatcher = self.run_action  # chat-path actions get hooks
+        original_generate = mgr.generate
+
+        def generate_with_hooks(prompt: str, max_tokens: int = 128) -> str:
+            with self._lock:
+                plugins = list(self._plugins.values())
+            for p in plugins:
+                try:
+                    prompt = p.pre_prompt(prompt)
+                except Exception:
+                    pass
+            return original_generate(prompt, max_tokens)
+
+        mgr.generate = generate_with_hooks  # type: ignore[method-assign]
+
+    def run_action(self, action: dict[str, Any]) -> Any:
+        """Execute an action through pre/post hooks."""
+        with self._lock:
+            plugins = list(self._plugins.values())
+        for p in plugins:
+            try:
+                modified = p.pre_execute(action)
+            except Exception:
+                continue
+            if modified is None:
+                return {"vetoed_by": p.name}
+            action = modified
+        fn = self.manager._actions.get(str(action.get("action")))
+        result = fn(action.get("params") or {}) if fn else None
+        for p in plugins:
+            try:
+                result = p.post_execute(action, result)
+            except Exception:
+                pass
+        return result
+
+    def _on_db_event(self, kind: str, entity: Any) -> None:
+        with self._lock:
+            plugins = list(self._plugins.values())
+        for p in plugins:
+            try:
+                p.on_db_event(kind, entity)
+            except Exception:
+                pass
+
+
+class WatcherPlugin(HeimdallPlugin):
+    """Reference plugin (ref: plugins/heimdall/plugin.go:62-424 'Watcher'):
+    hello/status/health/config actions + db event counting."""
+
+    name = "watcher"
+    version = "1.0.0"
+    description = "Counts DB events and answers hello/status/health/config"
+
+    def __init__(self) -> None:
+        self.events: dict[str, int] = {}
+        self.config: dict[str, Any] = {"verbose": False}
+        self._manager = None
+
+    def on_start(self, manager) -> None:
+        self._manager = manager
+
+    def actions(self):
+        return {
+            "hello": lambda p: {"message": f"hello from {self.name}"},
+            "status": lambda p: {"events": dict(self.events)},
+            "health": lambda p: {"healthy": self.health()},
+            "config": lambda p: (
+                self.config.update(p or {}) or dict(self.config)
+            ),
+        }
+
+    def on_db_event(self, kind: str, entity) -> None:
+        self.events[kind] = self.events.get(kind, 0) + 1

@@ -58,11 +58,13 @@ class SearchService:
         dims: int = 0,
         config: Optional[SearchConfig] = None,
         brute_force_max: int = 0,  # kept for reference parity; unused on TPU
+        vectorspaces=None,
     ):
         self.storage = storage
         self.embedder = embedder
         self.config = config or SearchConfig()
         self.stats = SearchStats()
+        self.vectorspaces = vectorspaces
         self._lock = threading.RLock()
         self._dims = dims or (embedder.dimensions() if embedder else 0)
         self._corpus: Optional[DeviceCorpus] = None
@@ -81,6 +83,10 @@ class SearchService:
     def _ensure_vector_index(self, dims: int) -> None:
         if self._corpus is None and self._hnsw is None:
             self._dims = dims
+            if self.vectorspaces is not None:
+                from nornicdb_tpu.vectorspace import VectorSpaceKey
+
+                self.vectorspaces.register(VectorSpaceKey("default", dims))
             if self.config.backend in ("auto", "tpu"):
                 self._corpus = DeviceCorpus(dims=dims)
             else:

@@ -132,7 +132,10 @@ class HttpServer:
         if self._qdrant is None:
             from nornicdb_tpu.server.qdrant import QdrantCollections
 
-            self._qdrant = QdrantCollections(self.db.storage)
+            self._qdrant = QdrantCollections(
+                self.db.storage,
+                vectorspaces=getattr(self.db, 'vectorspaces', None),
+            )
         return self._qdrant
 
     # -- request handling ----------------------------------------------------

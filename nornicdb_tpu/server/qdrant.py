@@ -30,8 +30,9 @@ class QdrantCollections:
     """Collection registry over graph nodes (ref: registry.go:149 analogue —
     per-collection vector space + device corpus)."""
 
-    def __init__(self, storage: Engine):
+    def __init__(self, storage: Engine, vectorspaces=None):
         self.storage = storage
+        self.vectorspaces = vectorspaces
         self._lock = threading.RLock()
         self._collections: dict[str, dict[str, Any]] = {}
         self._corpora: dict[str, DeviceCorpus] = {}
@@ -56,6 +57,12 @@ class QdrantCollections:
 
     # -- collections -------------------------------------------------------
     def create(self, name: str, size: int, distance: str = "Cosine") -> None:
+        if self.vectorspaces is not None:
+            from nornicdb_tpu.vectorspace import VectorSpaceKey
+
+            self.vectorspaces.register(
+                VectorSpaceKey(f"qdrant:{name}", int(size), distance.lower())
+            )
         with self._lock:
             self._collections[name] = {"size": int(size), "distance": distance}
             self._corpora[name] = DeviceCorpus(dims=int(size))

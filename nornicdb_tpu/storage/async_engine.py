@@ -32,9 +32,18 @@ class AsyncEngine(Engine):
         self._edge_is_create: set[str] = set()
         self._flush_lock = threading.Lock()
         self._closed = False
-        base.on_event(self._emit)
+        # Creates/updates are emitted by THIS engine at write time; the base
+        # engine's events for those same ops fire later at flush and would
+        # double-notify listeners. Deletes are the opposite: they run
+        # directly against the base (incl. edge cascades), so only the
+        # base's delete events are authoritative.
+        base.on_event(self._forward_base_event)
         self._flusher = threading.Thread(target=self._flush_loop, daemon=True)
         self._flusher.start()
+
+    def _forward_base_event(self, kind: str, entity) -> None:
+        if kind in ("node_deleted", "edge_deleted"):
+            self._emit(kind, entity)
 
     # -- flush loop --------------------------------------------------------
     def _flush_loop(self) -> None:

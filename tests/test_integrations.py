@@ -1,0 +1,201 @@
+"""Tests: vectorspace registry, inference integrations, heimdall plugins,
+query-load / relationship evolution (ref: pkg/vectorspace, pkg/inference
+integration adapters, pkg/heimdall/plugin.go, pkg/temporal)."""
+
+import numpy as np
+import pytest
+
+from nornicdb_tpu.errors import NornicError
+from nornicdb_tpu.heimdall import HeimdallManager, TemplateGenerator
+from nornicdb_tpu.heimdall.plugins import (
+    HeimdallPlugin,
+    PluginHost,
+    WatcherPlugin,
+)
+from nornicdb_tpu.inference import InferenceConfig, InferenceEngine
+from nornicdb_tpu.inference.integrations import (
+    ClusterIntegration,
+    HeimdallQC,
+    TopologyIntegration,
+)
+from nornicdb_tpu.storage import Edge, MemoryEngine, Node
+from nornicdb_tpu.temporal.query_load import QueryLoadTracker, RelationshipEvolution
+from nornicdb_tpu.vectorspace import (
+    BACKEND_TPU,
+    VectorSpaceKey,
+    VectorSpaceRegistry,
+)
+
+
+class TestVectorSpaceRegistry:
+    def test_register_get_canonical(self):
+        reg = VectorSpaceRegistry()
+        key = reg.register(VectorSpaceKey("Docs", 1024))
+        assert reg.get("docs") == key
+        assert key.canonical() == "docs:1024:cosine:tpu"
+        assert len(key.hash()) == 16
+
+    def test_dims_mismatch_rejected(self):
+        reg = VectorSpaceRegistry()
+        reg.register(VectorSpaceKey("a", 64))
+        with pytest.raises(NornicError):
+            reg.register(VectorSpaceKey("a", 128))
+
+    def test_list_and_drop(self):
+        reg = VectorSpaceRegistry()
+        reg.register(VectorSpaceKey("b", 8))
+        reg.register(VectorSpaceKey("a", 8))
+        assert [k.name for k in reg.list()] == ["a", "b"]
+        assert reg.drop("a") and not reg.drop("a")
+
+
+def _graph_engine():
+    eng = MemoryEngine()
+    for i in "abcd":
+        eng.create_node(Node(id=i))
+    eng.create_edge(Edge(id="e1", start_node="a", end_node="b"))
+    eng.create_edge(Edge(id="e2", start_node="b", end_node="c"))
+    eng.create_edge(Edge(id="e3", start_node="a", end_node="d"))
+    eng.create_edge(Edge(id="e4", start_node="c", end_node="d"))
+    return eng
+
+
+class TestInferenceIntegrations:
+    def test_topology_boosts_connected_pairs(self):
+        eng = _graph_engine()
+        topo = TopologyIntegration(eng, weight=0.5)
+        # a-c share two common neighbors; a-b are directly adjacent only
+        boosted = topo.adjust_confidence("a", "c", 0.5)
+        assert boosted > 0.5
+
+    def test_topology_attach_changes_created_confidence(self):
+        eng = _graph_engine()
+        inf = InferenceEngine(
+            eng, config=InferenceConfig(min_evidence=1, cooldown=0.0)
+        )
+        TopologyIntegration(eng, weight=0.5).attach(inf)
+        edge = inf.process_suggestion("a", "c", "SIMILAR_TO", 0.5)
+        assert edge is not None and edge.confidence > 0.5
+
+    def test_cluster_integration(self):
+        ci = ClusterIntegration(lambda: {"x": 0, "y": 0, "z": 1})
+        assert ci.adjust_confidence("x", "y", 0.5) == pytest.approx(0.55)
+        assert ci.adjust_confidence("x", "z", 0.5) == pytest.approx(0.45)
+        assert ci.adjust_confidence("x", "unknown", 0.5) == 0.5
+
+    def test_heimdall_qc_review(self):
+        eng = MemoryEngine()
+        eng.create_node(Node(id="a", properties={"content": "alpha"}))
+        eng.create_node(Node(id="b", properties={"content": "beta"}))
+
+        class RejectingGenerator(TemplateGenerator):
+            def generate(self, prompt, max_tokens=128):
+                return '{"keep": false}'
+
+        mgr = HeimdallManager(RejectingGenerator())
+        qc = HeimdallQC(mgr, eng)
+        assert qc.review([("a", "b", "SIMILAR_TO")]) == [False]
+        assert qc.rejected == 1
+
+
+class TestHeimdallPlugins:
+    def test_watcher_lifecycle_and_db_events(self):
+        import nornicdb_tpu
+
+        db = nornicdb_tpu.open_db("")
+        host = PluginHost(db.heimdall, db=db)
+        info = host.register(WatcherPlugin())
+        assert info.name == "watcher"
+        db.cypher("CREATE (:W)")
+        plugin = host._plugins["watcher"]
+        assert plugin.events.get("node_created") == 1
+        # bare "status" stays bound to the manager built-in (no clobber);
+        # the plugin's action lives at its namespaced name
+        result = host.run_action({"action": "watcher.status", "params": {}})
+        assert result["events"]["node_created"] == 1
+        builtin = host.run_action({"action": "status", "params": {}})
+        assert builtin["nodes"] == 1
+        assert host.plugins()[0].healthy
+        host.unregister("watcher")
+        assert "watcher.status" not in db.heimdall._actions  # actions removed
+        db.close()
+
+    def test_pre_execute_veto(self):
+        mgr = HeimdallManager(TemplateGenerator(None))
+        host = PluginHost(mgr)
+
+        class VetoPlugin(HeimdallPlugin):
+            name = "veto"
+
+            def pre_execute(self, action):
+                return None if action.get("action") == "danger" else action
+
+        host.register(VetoPlugin())
+        out = host.run_action({"action": "danger"})
+        assert out == {"vetoed_by": "veto"}
+
+    def test_pre_prompt_hook(self):
+        mgr = HeimdallManager(TemplateGenerator(None))
+        host = PluginHost(mgr)
+        seen = []
+
+        class PromptPlugin(HeimdallPlugin):
+            name = "prompter"
+
+            def pre_prompt(self, prompt):
+                seen.append(prompt)
+                return prompt + " [augmented]"
+
+        host.register(PromptPlugin())
+        mgr.generate("hello")
+        assert seen and seen[0] == "hello"
+
+    def test_load_directory(self, tmp_path):
+        (tmp_path / "myplug.py").write_text(
+            "from nornicdb_tpu.heimdall.plugins import HeimdallPlugin\n"
+            "class P(HeimdallPlugin):\n"
+            "    name = 'dirplug'\n"
+            "    def actions(self):\n"
+            "        return {'ping': lambda p: {'pong': True}}\n"
+            "PLUGIN = P()\n"
+        )
+        (tmp_path / "broken.py").write_text("raise RuntimeError('nope')\n")
+        mgr = HeimdallManager(TemplateGenerator(None))
+        host = PluginHost(mgr)
+        infos = host.load_directory(str(tmp_path))
+        assert [i.name for i in infos] == ["dirplug"]
+        assert host.run_action({"action": "ping"}) == {"pong": True}
+
+
+class TestQueryLoad:
+    def test_qps_window(self):
+        now = [1000.0]
+        t = QueryLoadTracker(window=10.0, now_fn=lambda: now[0])
+        for i in range(5):
+            now[0] = 1000.0 + i
+            t.record(latency=0.01)
+        assert t.qps() > 0.5
+        assert t.total == 5
+        now[0] = 1020.0  # everything outside the window
+        assert t.qps() == 0.0
+        assert t.smoothed_latency() == pytest.approx(0.01, abs=0.01)
+
+    def test_relationship_evolution(self):
+        eng = MemoryEngine()
+        eng.create_node(Node(id="a"))
+        eng.create_node(Node(id="b"))
+        eng.create_edge(
+            Edge(id="auto", start_node="a", end_node="b",
+                 auto_generated=True, confidence=0.06)
+        )
+        eng.create_edge(
+            Edge(id="manual", start_node="a", end_node="b", confidence=1.0)
+        )
+        evo = RelationshipEvolution(eng, strengthen=0.1, decay=0.02)
+        assert evo.on_traversal("auto") == pytest.approx(0.16)
+        out = evo.decay_pass(min_confidence=0.1)  # 0.16 -> 0.14: weakened
+        assert out == {"weakened": 1, "removed": 0}
+        for _ in range(10):  # decays past the floor -> removed
+            evo.decay_pass(min_confidence=0.1)
+        assert eng.get_edge("manual").confidence == 1.0  # manual untouched
+        assert "auto" not in [e.id for e in eng.all_edges()]
