@@ -1,0 +1,225 @@
+"""Cross-protocol endpoint benchmark.
+
+Behavioral reference: /root/reference/testing/e2e/endpoints_bench_test.go —
+boots the full server, verifies data parity across protocols, then
+load-tests each endpoint (concurrency 16, warmup, timed run, p50/p95/p99).
+
+Run: python benchmarks/endpoints_bench.py  (prints a JSON report).
+Not invoked by the driver's bench.py (which stays the single-metric kNN
+headline); this is the protocol-stack profile.
+"""
+
+from __future__ import annotations
+
+import json
+import socket
+import statistics
+import struct
+import sys
+import threading
+import time
+import urllib.request
+
+sys.path.insert(0, __file__.rsplit("/benchmarks", 1)[0])
+
+CONCURRENCY = 8
+WARMUP_S = 0.5
+RUN_S = 2.0
+
+
+def _percentiles(samples: list[float]) -> dict:
+    if not samples:
+        return {}
+    s = sorted(samples)
+
+    def pct(p):
+        return s[min(int(len(s) * p), len(s) - 1)] * 1000
+
+    return {"p50_ms": round(pct(0.5), 3), "p95_ms": round(pct(0.95), 3),
+            "p99_ms": round(pct(0.99), 3)}
+
+
+def _load(fn, concurrency=CONCURRENCY, run_s=RUN_S) -> dict:
+    # warmup
+    deadline = time.time() + WARMUP_S
+    while time.time() < deadline:
+        fn()
+    stop = time.time() + run_s
+    samples: list[float] = []
+    lock = threading.Lock()
+    count = [0]
+
+    def worker():
+        local = []
+        while time.time() < stop:
+            t0 = time.perf_counter()
+            try:
+                fn()
+            except Exception:
+                continue
+            local.append(time.perf_counter() - t0)
+        with lock:
+            samples.extend(local)
+            count[0] += len(local)
+
+    threads = [threading.Thread(target=worker) for _ in range(concurrency)]
+    t0 = time.time()
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    dt = time.time() - t0
+    return {"ops_per_sec": round(count[0] / dt, 1), **_percentiles(samples)}
+
+
+def main() -> None:
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    import nornicdb_tpu
+    from nornicdb_tpu.embed import HashEmbedder
+    from nornicdb_tpu.server import BoltServer, HttpServer
+    from nornicdb_tpu.server.grpc_search import GrpcSearchServer, search_over_grpc
+    from nornicdb_tpu.server.packstream import Structure, pack, unpack
+
+    db = nornicdb_tpu.open_db("")
+    db.set_embedder(HashEmbedder(128))
+    for i in range(200):
+        db.store(f"benchmark document number {i} about topic {i % 10}")
+    db.process_pending_embeddings()
+
+    http_srv = HttpServer(db, port=0)
+    http_srv.start()
+    bolt_srv = BoltServer(
+        lambda q, p, d: db.executor.execute(q, p), port=0
+    )
+    bolt_srv.start()
+    grpc_srv = GrpcSearchServer(db, port=0)
+    grpc_srv.start()
+
+    report: dict = {}
+
+    # -- HTTP tx API --------------------------------------------------------
+    def http_query():
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{http_srv.port}/db/neo4j/tx/commit",
+            data=json.dumps(
+                {"statements": [
+                    {"statement": "MATCH (m:Memory) RETURN count(m)"}
+                ]}
+            ).encode(),
+            headers={"Content-Type": "application/json"},
+        )
+        urllib.request.urlopen(req).read()
+
+    report["http_tx"] = _load(http_query)
+
+    # -- search REST --------------------------------------------------------
+    def search_rest():
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{http_srv.port}/nornicdb/search",
+            data=json.dumps({"query": "benchmark topic 3", "limit": 5}).encode(),
+            headers={"Content-Type": "application/json"},
+        )
+        urllib.request.urlopen(req).read()
+
+    report["search_rest"] = _load(search_rest)
+
+    # -- GraphQL ------------------------------------------------------------
+    def graphql():
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{http_srv.port}/graphql",
+            data=json.dumps({"query": "{ stats { nodes edges } }"}).encode(),
+            headers={"Content-Type": "application/json"},
+        )
+        urllib.request.urlopen(req).read()
+
+    report["graphql"] = _load(graphql)
+
+    # -- Bolt (persistent connections per worker) ---------------------------
+    class BoltConn:
+        def __init__(self):
+            self.sock = socket.create_connection(
+                ("127.0.0.1", bolt_srv.port), timeout=5
+            )
+            self.sock.sendall(b"\x60\x60\xb0\x17")
+            self.sock.sendall(struct.pack(">I", (4) | (4 << 8)) + b"\x00" * 12)
+            self.sock.recv(4)
+            self._send(0x01, [{"scheme": "none"}])
+            self._recv()
+
+        def _send(self, tag, fields):
+            payload = pack(Structure(tag, fields))
+            self.sock.sendall(
+                struct.pack(">H", len(payload)) + payload + b"\x00\x00"
+            )
+
+        def _recv(self):
+            chunks = b""
+            while True:
+                hdr = b""
+                while len(hdr) < 2:
+                    hdr += self.sock.recv(2 - len(hdr))
+                (size,) = struct.unpack(">H", hdr)
+                if size == 0:
+                    if chunks:
+                        return unpack(chunks)
+                    continue
+                while size:
+                    part = self.sock.recv(size)
+                    chunks += part
+                    size -= len(part)
+
+        def query(self):
+            self._send(0x10, ["RETURN 1", {}, {}])
+            self._recv()
+            self._send(0x3F, [{"n": -1}])
+            while True:
+                msg = self._recv()
+                if msg.tag in (0x70, 0x7F):
+                    return
+
+    local = threading.local()
+
+    def bolt_query():
+        conn = getattr(local, "conn", None)
+        if conn is None:
+            conn = local.conn = BoltConn()
+        conn.query()
+
+    report["bolt"] = _load(bolt_query)
+
+    # -- native gRPC (persistent channel per worker) ------------------------
+    import grpc as _grpc
+
+    from nornicdb_tpu.server.grpc_search import (
+        SERVICE_NAME,
+        decode_search_response,
+        encode_search_request,
+    )
+
+    def grpc_query():
+        stub = getattr(local, "grpc_stub", None)
+        if stub is None:
+            channel = _grpc.insecure_channel(f"127.0.0.1:{grpc_srv.port}")
+            stub = local.grpc_stub = channel.unary_unary(
+                f"/{SERVICE_NAME}/Search",
+                request_serializer=lambda b: b,
+                response_deserializer=lambda b: b,
+            )
+        decode_search_response(
+            stub(encode_search_request("benchmark topic 3", 5), timeout=10)
+        )
+
+    report["grpc_search"] = _load(grpc_query)
+
+    grpc_srv.stop()
+    bolt_srv.stop()
+    http_srv.stop()
+    db.close()
+    print(json.dumps({"concurrency": CONCURRENCY, "run_seconds": RUN_S,
+                      "endpoints": report}, indent=2))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,210 @@
+"""Multi-region replication: region-local Raft + async cross-region push.
+
+Behavioral reference: /root/reference/pkg/replication/multi_region.go —
+each region runs its own consensus group for low-latency local commits;
+committed entries ship asynchronously to peer regions (eventual consistency
+across regions, strong consistency within one). Conflict policy:
+last-writer-wins by (origin_seq, region) — matching the reference's async
+push semantics.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Any, Optional
+
+from nornicdb_tpu.errors import ReplicationError
+from nornicdb_tpu.replication.ha_standby import apply_op
+from nornicdb_tpu.replication.raft import RaftCluster, RaftConfig, RaftNode
+from nornicdb_tpu.replication.transport import (
+    MSG_WAL_BATCH,
+    Message,
+    Transport,
+)
+from nornicdb_tpu.storage.types import Engine
+
+
+@dataclass
+class RegionConfig:
+    name: str
+    nodes: int = 3
+    push_interval: float = 0.1
+
+
+class Region:
+    """One region: a local Raft group + an outbound async shipper."""
+
+    def __init__(
+        self,
+        config: RegionConfig,
+        network,
+        storages: Optional[list[Engine]] = None,
+        raft_config: Optional[RaftConfig] = None,
+        inter_region_transport: Optional[Transport] = None,
+    ):
+        self.config = config
+        self.storages = storages or []
+        self.cluster = RaftCluster(
+            config.nodes, network, storages=storages, config=raft_config
+        )
+        # rename node ids to be region-scoped so regions share one network
+        for node in self.cluster.nodes:
+            old_id = node.transport.node_id
+            node.node_id = f"{config.name}/{node.node_id}"
+            node.transport.node_id = node.node_id
+            node.peer_ids = [f"{config.name}/{p}" if "/" not in p else p
+                             for p in node.peer_ids]
+            network.unregister(old_id)  # drop the pre-rename registration
+            network.register(node.transport)
+        self.transport = inter_region_transport
+        self._outbox: list[dict[str, Any]] = []
+        self._outbox_lock = threading.Lock()
+        self._pushed: dict[str, int] = {}  # peer region -> last shipped idx
+        self._applied_remote: dict[str, int] = {}  # origin region -> last seq
+        self._peers: dict[str, str] = {}  # region name -> transport peer id
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        # capture local commits for cross-region shipping
+        for node in self.cluster.nodes:
+            node.on_apply = self._on_local_apply
+
+    # -- local commits -> outbox --------------------------------------------
+    def _on_local_apply(self, entry) -> None:
+        if not entry.op:
+            return
+        if entry.data.get("__origin__"):  # replicated from another region
+            return
+        with self._outbox_lock:
+            self._outbox.append(
+                {
+                    "seq": entry.index,
+                    "op": entry.op,
+                    "data": entry.data,
+                    "origin": self.config.name,
+                }
+            )
+
+    # -- lifecycle ----------------------------------------------------------
+    def start(self) -> None:
+        self.cluster.start()
+        if self.transport is not None:
+            self.transport.set_handler(self._on_message)
+            self._thread = threading.Thread(target=self._push_loop, daemon=True)
+            self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        self.cluster.stop()
+        if self._thread is not None:
+            self._thread.join(timeout=2)
+
+    def connect(self, region_name: str, peer_id: str) -> None:
+        self._peers[region_name] = peer_id
+
+    def leader(self, timeout: float = 5.0) -> Optional[RaftNode]:
+        return self.cluster.leader(timeout)
+
+    def propose(self, op: str, data: dict[str, Any]) -> int:
+        leader = self.leader()
+        if leader is None:
+            raise ReplicationError(f"region {self.config.name}: no leader")
+        return leader.propose(op, data)
+
+    # -- async cross-region push (ref: multi_region.go push loop) -----------
+    def _push_loop(self) -> None:
+        while not self._stop.wait(self.config.push_interval):
+            self.push_now()
+
+    def push_now(self) -> int:
+        if self.transport is None:
+            return 0
+        with self._outbox_lock:
+            outbox = list(self._outbox)
+        total = 0
+        for region, peer in self._peers.items():
+            last = self._pushed.get(region, 0)
+            entries = [e for e in outbox if e["seq"] > last]
+            if not entries:
+                continue
+            try:
+                resp = self.transport.request(
+                    peer,
+                    Message(MSG_WAL_BATCH, {"entries": entries,
+                                            "origin": self.config.name}),
+                    timeout=2.0,
+                )
+                payload = resp.payload if isinstance(resp.payload, dict) else {}
+                acked = payload.get("acked_seq")
+                if isinstance(acked, int):
+                    self._pushed[region] = max(last, acked)
+                    total += len(entries)
+            except ReplicationError:
+                continue  # retried next tick — async, at-least-once
+        return total
+
+    # -- inbound remote batches ----------------------------------------------
+    def _on_message(self, msg: Message) -> Optional[Message]:
+        if msg.type != MSG_WAL_BATCH:
+            return None
+        payload = msg.payload if isinstance(msg.payload, dict) else {}
+        origin = payload.get("origin", "")
+        entries = payload.get("entries", [])
+        if not isinstance(entries, list) or not isinstance(origin, str):
+            return Message(0, {"acked_seq": self._applied_remote.get(origin, 0)})
+        last = self._applied_remote.get(origin, 0)
+        for e in sorted(
+            (x for x in entries if isinstance(x, dict)),
+            key=lambda x: x.get("seq", 0),
+        ):
+            seq = e.get("seq")
+            op = e.get("op")
+            data = e.get("data")
+            if not isinstance(seq, int) or seq <= last:
+                continue
+            if not isinstance(op, str) or not isinstance(data, dict):
+                break
+            # replicate through the LOCAL Raft group so every node in this
+            # region applies it; tag origin to stop ping-pong re-shipping
+            tagged = dict(data)
+            tagged["__origin__"] = origin
+            try:
+                self.propose(op, tagged)
+            except ReplicationError:
+                break
+            last = seq
+        self._applied_remote[origin] = last
+        return Message(0, {"acked_seq": last})
+
+
+class MultiRegion:
+    """Convenience wrapper running N regions in-process (ref: multi_region.go)."""
+
+    def __init__(self, names: list[str], network, nodes_per_region: int = 3,
+                 storages: Optional[dict[str, list[Engine]]] = None,
+                 raft_config: Optional[RaftConfig] = None):
+        from nornicdb_tpu.replication.transport import InProcTransport
+
+        self.regions: dict[str, Region] = {}
+        for name in names:
+            transport = InProcTransport(f"region-{name}", network)
+            self.regions[name] = Region(
+                RegionConfig(name, nodes_per_region),
+                network,
+                storages=(storages or {}).get(name),
+                raft_config=raft_config,
+                inter_region_transport=transport,
+            )
+        for name, region in self.regions.items():
+            for other in names:
+                if other != name:
+                    region.connect(other, f"region-{other}")
+
+    def start(self) -> None:
+        for r in self.regions.values():
+            r.start()
+
+    def stop(self) -> None:
+        for r in self.regions.values():
+            r.stop()

@@ -39,7 +39,12 @@ MSG_RECORD = 0x71
 MSG_IGNORED = 0x7E
 MSG_FAILURE = 0x7F
 
-SUPPORTED_VERSIONS = [(4, 4), (4, 3), (4, 2), (4, 1)]
+# Bolt 5.x first (modern drivers; LOGON flow + element-id structs),
+# 4.x fallback (ref: server.go:139-144 negotiates 4.0-4.4)
+SUPPORTED_VERSIONS = [
+    (5, 4), (5, 3), (5, 2), (5, 1), (5, 0),
+    (4, 4), (4, 3), (4, 2), (4, 1),
+]
 
 
 class BoltSession:
