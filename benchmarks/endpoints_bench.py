@@ -159,7 +159,10 @@ def main() -> None:
             while True:
                 hdr = b""
                 while len(hdr) < 2:
-                    hdr += self.sock.recv(2 - len(hdr))
+                    part = self.sock.recv(2 - len(hdr))
+                    if not part:
+                        raise ConnectionError("bolt connection closed")
+                    hdr += part
                 (size,) = struct.unpack(">H", hdr)
                 if size == 0:
                     if chunks:
@@ -167,6 +170,8 @@ def main() -> None:
                     continue
                 while size:
                     part = self.sock.recv(size)
+                    if not part:
+                        raise ConnectionError("bolt connection closed")
                     chunks += part
                     size -= len(part)
 

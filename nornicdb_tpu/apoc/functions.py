@@ -803,3 +803,199 @@ def any_properties(v):
 def any_property(v, key):
     props = any_properties(v)
     return None if props is None else props.get(key)
+
+
+# ================================================================= agg
+@register("apoc.agg.first", category="agg")
+def agg_first(xs):
+    return xs[0] if xs else None
+
+
+@register("apoc.agg.last", category="agg")
+def agg_last(xs):
+    return xs[-1] if xs else None
+
+
+@register("apoc.agg.median", category="agg")
+def agg_median(xs):
+    return statistics.median(xs) if xs else None
+
+
+@register("apoc.agg.percentiles", category="agg")
+def agg_percentiles(xs, ps=None):
+    if not xs:
+        return {}
+    ps = ps or [0.5, 0.75, 0.9, 0.95, 0.99]
+    ordered = sorted(xs)
+    out = {}
+    for p in ps:
+        idx = max(min(int(round(p * (len(ordered) - 1))), len(ordered) - 1), 0)
+        out[str(p)] = ordered[idx]
+    return out
+
+
+@register("apoc.agg.product", category="agg")
+def agg_product(xs):
+    out = 1
+    for x in xs or []:
+        out *= x
+    return out
+
+
+@register("apoc.agg.statistics", category="agg")
+def agg_statistics(xs):
+    if not xs:
+        return {"count": 0}
+    return {
+        "count": len(xs),
+        "sum": sum(xs),
+        "min": min(xs),
+        "max": max(xs),
+        "mean": sum(xs) / len(xs),
+        "stdev": statistics.pstdev(xs) if len(xs) > 1 else 0.0,
+    }
+
+
+# ================================================================= atomic
+# (ref: apoc/atomic — numeric read-modify-write on properties; the executor
+# passes entities by value so these operate on maps/lists functionally)
+@register("apoc.atomic.add", category="atomic")
+def atomic_add(m, key, value):
+    out = dict(m or {})
+    out[key] = (out.get(key) or 0) + value
+    return out
+
+
+@register("apoc.atomic.subtract", category="atomic")
+def atomic_subtract(m, key, value):
+    return atomic_add(m, key, -value)
+
+
+@register("apoc.atomic.concat", category="atomic")
+def atomic_concat(m, key, value):
+    out = dict(m or {})
+    out[key] = str(out.get(key) or "") + str(value)
+    return out
+
+
+@register("apoc.atomic.insert", category="atomic")
+def atomic_insert(m, key, value):
+    out = dict(m or {})
+    lst = list(out.get(key) or [])
+    lst.append(value)
+    out[key] = lst
+    return out
+
+
+# ================================================================= load
+@register("apoc.load.json", category="load")
+def load_json(url):
+    """file:// JSON loader, gated like the reference's import setting
+    (requires NORNICDB_APOC_IMPORT_ENABLED=true — arbitrary local file reads
+    must be an explicit operator decision, not a default)."""
+    import os as _os
+
+    if _os.environ.get("NORNICDB_APOC_IMPORT_ENABLED", "").lower() not in (
+        "1", "true", "yes",
+    ):
+        raise ValueError(
+            "apoc.load.json is disabled; set NORNICDB_APOC_IMPORT_ENABLED=true"
+        )
+    path = str(url)
+    if path.startswith("file://"):
+        path = path[7:]
+    elif "://" in path:
+        raise ValueError("only file:// URLs are supported (zero-egress)")
+    with open(path) as f:
+        return _json.load(f)
+
+
+@register("apoc.load.jsonArray", category="load")
+def load_json_array(url):
+    v = load_json(url)
+    return v if isinstance(v, list) else [v]
+
+
+# ================================================================= more coll
+@register("apoc.coll.duplicates")
+def coll_duplicates(xs):
+    seen, dups, out = set(), set(), []
+    for x in xs or []:
+        k = _json.dumps(x, sort_keys=True, default=str)
+        if k in seen and k not in dups:
+            dups.add(k)
+            out.append(x)
+        seen.add(k)
+    return out
+
+
+@register("apoc.coll.dropDuplicateNeighbors")
+def coll_drop_dup_neighbors(xs):
+    out = []
+    for x in xs or []:
+        if not out or out[-1] != x:
+            out.append(x)
+    return out
+
+
+@register("apoc.coll.fill")
+def coll_fill(item, count):
+    return [item] * int(count)
+
+
+@register("apoc.coll.sumLongs")
+def coll_sum_longs(xs):
+    return int(sum(int(x) for x in xs or []))
+
+
+@register("apoc.coll.containsAll")
+def coll_contains_all(xs, values):
+    pool = {_json.dumps(x, sort_keys=True, default=str) for x in xs or []}
+    return all(
+        _json.dumps(v, sort_keys=True, default=str) in pool for v in values or []
+    )
+
+
+@register("apoc.coll.runningTotal")
+def coll_running_total(xs):
+    out, acc = [], 0
+    for x in xs or []:
+        acc += x
+        out.append(acc)
+    return out
+
+
+# ================================================================= more text
+@register("apoc.text.fuzzyMatch")
+def text_fuzzy_match(a, b):
+    if a is None or b is None:
+        return None
+    return text_levenshtein_sim(a.lower(), b.lower()) > 0.7
+
+
+@register("apoc.text.sorensenDiceSimilarity")
+def text_dice(a, b):
+    if a is None or b is None:
+        return None
+    def bigrams(s):
+        s = s.lower()
+        return {s[i : i + 2] for i in range(len(s) - 1)}
+    ba, bb = bigrams(a), bigrams(b)
+    if not ba and not bb:
+        return 1.0
+    return 2 * len(ba & bb) / (len(ba) + len(bb))
+
+
+@register("apoc.text.repeat")
+def text_repeat(s, count):
+    return None if s is None else s * int(count)
+
+
+@register("apoc.text.byteCount")
+def text_byte_count(s, charset="UTF-8"):
+    return None if s is None else len(s.encode(charset))
+
+
+@register("apoc.text.swapCase")
+def text_swap_case(s):
+    return None if s is None else s.swapcase()

@@ -120,33 +120,41 @@ def cmd_shell(args) -> int:
 
 
 def cmd_import(args) -> int:
-    """Neo4j-style JSON import (ref: nornicdb import, storage loaders)."""
-    from nornicdb_tpu.storage import Edge, Node
+    """Neo4j-style JSON / Mimir JSONL import (ref: nornicdb import,
+    storage loader.go + mimir_loader.go)."""
+    from nornicdb_tpu.storage.io import import_json, load_mimir
 
     db = _open_db(args)
-    with open(args.file) as f:
-        data = json.load(f)
-    n_nodes = n_edges = 0
-    for nd in data.get("nodes", []):
-        node = Node(
-            id=str(nd.get("id")),
-            labels=list(nd.get("labels", [])),
-            properties=dict(nd.get("properties", {})),
-        )
-        db.storage.create_node(node)
-        n_nodes += 1
-    for ed in data.get("relationships", data.get("edges", [])):
-        edge = Edge(
-            id=str(ed.get("id")),
-            start_node=str(ed.get("startNode", ed.get("start_node"))),
-            end_node=str(ed.get("endNode", ed.get("end_node"))),
-            type=ed.get("type", "RELATED_TO"),
-            properties=dict(ed.get("properties", {})),
-        )
-        db.storage.create_edge(edge)
-        n_edges += 1
-    db.close()
+    try:
+        if args.format == "mimir":
+            n_nodes, n_edges = load_mimir(db.storage, args.file)
+        else:
+            with open(args.file) as f:
+                data = json.load(f)
+            n_nodes, n_edges = import_json(db.storage, data)
+    finally:
+        db.close()
     print(f"imported {n_nodes} nodes, {n_edges} relationships")
+    return 0
+
+
+def cmd_export(args) -> int:
+    """Neo4j-style JSON export (ref: types.go:475-707)."""
+    from nornicdb_tpu.storage.io import export_json
+
+    db = _open_db(args)
+    try:
+        data = export_json(db.storage)
+    finally:
+        db.close()
+    out = json.dumps(data, indent=2, default=str)
+    if args.file == "-":
+        print(out)
+    else:
+        with open(args.file, "w") as f:
+            f.write(out)
+        print(f"exported {len(data['nodes'])} nodes, "
+              f"{len(data['relationships'])} relationships to {args.file}")
     return 0
 
 
@@ -189,9 +197,14 @@ def main(argv=None) -> int:
     s = sub.add_parser("shell", help="interactive Cypher shell")
     s.set_defaults(fn=cmd_shell)
 
-    s = sub.add_parser("import", help="import Neo4j-style JSON")
+    s = sub.add_parser("import", help="import Neo4j-style JSON or Mimir JSONL")
     s.add_argument("file")
+    s.add_argument("--format", choices=["json", "mimir"], default="json")
     s.set_defaults(fn=cmd_import)
+
+    s = sub.add_parser("export", help="export the graph as Neo4j-style JSON")
+    s.add_argument("file", help="output path, or - for stdout")
+    s.set_defaults(fn=cmd_export)
 
     s = sub.add_parser("decay", help="memory decay operations")
     s.add_argument("action", choices=["recalculate", "archive", "stats"])

@@ -59,7 +59,7 @@ class Region:
             network.unregister(old_id)  # drop the pre-rename registration
             network.register(node.transport)
         self.transport = inter_region_transport
-        self._outbox: list[dict[str, Any]] = []
+        self._outbox: dict[int, dict[str, Any]] = {}  # index -> entry (deduped)
         self._outbox_lock = threading.Lock()
         self._pushed: dict[str, int] = {}  # peer region -> last shipped idx
         self._applied_remote: dict[str, int] = {}  # origin region -> last seq
@@ -77,14 +77,14 @@ class Region:
         if entry.data.get("__origin__"):  # replicated from another region
             return
         with self._outbox_lock:
-            self._outbox.append(
-                {
-                    "seq": entry.index,
-                    "op": entry.op,
-                    "data": entry.data,
-                    "origin": self.config.name,
-                }
-            )
+            # every node in the region applies the same committed entry;
+            # keying by index dedups to one outbox copy
+            self._outbox[entry.index] = {
+                "seq": entry.index,
+                "op": entry.op,
+                "data": entry.data,
+                "origin": self.config.name,
+            }
 
     # -- lifecycle ----------------------------------------------------------
     def start(self) -> None:
@@ -121,7 +121,7 @@ class Region:
         if self.transport is None:
             return 0
         with self._outbox_lock:
-            outbox = list(self._outbox)
+            outbox = sorted(self._outbox.values(), key=lambda e: e["seq"])
         total = 0
         for region, peer in self._peers.items():
             last = self._pushed.get(region, 0)
@@ -142,6 +142,17 @@ class Region:
                     total += len(entries)
             except ReplicationError:
                 continue  # retried next tick — async, at-least-once
+        # prune entries every peer has acked (bounded memory; same idea as
+        # ReplicatedEngine.prune_through)
+        if self._peers:
+            floor = min(
+                self._pushed.get(r, 0) for r in self._peers
+            )
+            if floor:
+                with self._outbox_lock:
+                    self._outbox = {
+                        i: e for i, e in self._outbox.items() if i > floor
+                    }
         return total
 
     # -- inbound remote batches ----------------------------------------------
@@ -169,10 +180,22 @@ class Region:
             # region applies it; tag origin to stop ping-pong re-shipping
             tagged = dict(data)
             tagged["__origin__"] = origin
+            leader = self.leader(timeout=1.0)
+            if leader is None:
+                break
             try:
-                self.propose(op, tagged)
+                index = leader.propose(op, tagged)
             except ReplicationError:
                 break
+            # ack only after the entry COMMITS locally — an ack on a bare
+            # leader append could be lost to a leader crash and never resent
+            deadline = time.time() + 2.0
+            while leader.commit_index < index:
+                if time.time() > deadline or leader.state != "leader":
+                    break
+                time.sleep(0.005)
+            if leader.commit_index < index:
+                break  # not committed: don't ack; origin retries
             last = seq
         self._applied_remote[origin] = last
         return Message(0, {"acked_seq": last})

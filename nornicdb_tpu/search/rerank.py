@@ -1,0 +1,71 @@
+"""Cross-encoder reranking.
+
+Behavioral reference: /root/reference/pkg/search/rerank.go
+(applyCrossEncoderRerank search.go:1639, feature-flag-gated) — a second-stage
+model scores (query, document) pairs jointly and reorders the fused top-k.
+
+TPU implementation: the bge encoder runs over "[CLS] query [SEP] doc" pairs
+batched into ONE forward pass; a linear head over the CLS embedding yields
+the relevance score. With random weights this reorders arbitrarily, so the
+service gates it behind SearchConfig.rerank_enabled (the reference gates via
+feature flags likewise); load trained weights via models.weights to make it
+real.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import numpy as np
+
+
+class CrossEncoderReranker:
+    def __init__(self, cfg=None, params=None, tokenizer=None,
+                 max_len: int = 256, seed: int = 0):
+        import jax
+        import jax.numpy as jnp
+
+        from nornicdb_tpu.models import bge_m3
+        from nornicdb_tpu.models.tokenizer import HashTokenizer
+
+        self.cfg = cfg if cfg is not None else bge_m3.BGE_SMALL
+        self.params = (
+            params if params is not None
+            else bge_m3.init_params(self.cfg, jax.random.PRNGKey(seed))
+        )
+        # linear relevance head over the encoder's pooled output
+        key = jax.random.PRNGKey(seed + 1)
+        self.head = {
+            "w": jax.random.normal(key, (self.cfg.dims,), jnp.float32) * 0.02,
+            "b": jnp.zeros((), jnp.float32),
+        }
+        self.tokenizer = tokenizer or HashTokenizer(self.cfg.vocab_size)
+        self.max_len = max_len
+        self._jnp = jnp
+
+        def fwd(params, head, ids, mask):
+            emb = bge_m3.forward(params, self.cfg, ids, mask)  # (B, D)
+            return emb @ head["w"] + head["b"]
+
+        self._score = jax.jit(fwd)
+
+    def score_pairs(self, query: str, docs: Sequence[str]) -> np.ndarray:
+        if not docs:
+            return np.zeros(0, np.float32)
+        jnp = self._jnp
+        pairs = [f"{query} [SEP] {d}" for d in docs]
+        ids, masks = self.tokenizer.encode_batch(pairs, max_len=self.max_len)
+        scores = self._score(
+            self.params, self.head,
+            jnp.asarray(ids, jnp.int32), jnp.asarray(masks, jnp.int32),
+        )
+        return np.asarray(scores, np.float32)
+
+    def rerank(
+        self, query: str, candidates: list[tuple[str, str]], limit: int = 0
+    ) -> list[tuple[str, float]]:
+        """candidates: [(id, text)] -> [(id, score)] best-first."""
+        scores = self.score_pairs(query, [t for _, t in candidates])
+        order = np.argsort(-scores)
+        out = [(candidates[i][0], float(scores[i])) for i in order]
+        return out[:limit] if limit else out

@@ -45,7 +45,11 @@ class SearchConfig:
     mmr_enabled: bool = False
     mmr_lambda: float = 0.7
     candidates_multiplier: int = 4  # fetch k*mult candidates per modality
-    backend: str = "auto"  # auto | tpu | hnsw
+    backend: str = "auto"  # auto | tpu | sharded | hnsw
+    # cross-encoder second stage (ref: applyCrossEncoderRerank search.go:1639,
+    # feature-flag-gated like the reference)
+    rerank_enabled: bool = False
+    rerank_candidates: int = 20
 
 
 class SearchService:
@@ -87,7 +91,13 @@ class SearchService:
                 from nornicdb_tpu.vectorspace import VectorSpaceKey
 
                 self.vectorspaces.register(VectorSpaceKey("default", dims))
-            if self.config.backend in ("auto", "tpu"):
+            if self.config.backend == "sharded":
+                # corpus rows sharded over the device mesh, per-shard top-k
+                # merged via ICI all-gather (parallel.ShardedCorpus)
+                from nornicdb_tpu.parallel import ShardedCorpus
+
+                self._corpus = ShardedCorpus(dims=dims)
+            elif self.config.backend in ("auto", "tpu"):
                 self._corpus = DeviceCorpus(dims=dims)
             else:
                 self._hnsw = HNSWIndex(dims=dims)
@@ -196,6 +206,8 @@ class SearchService:
             return []
         fused = fuse_rrf(ranked, adaptive_rrf_weights(query), self.config.rrf_k)
         ordered = [i for i, _ in fused]
+        if self.config.rerank_enabled and query:
+            ordered = self._apply_rerank(query, ordered)
         if self.config.mmr_enabled:
             rel = {i: s for i, s in fused}
             with self._lock:
@@ -221,6 +233,33 @@ class SearchService:
                 }
             )
         return results
+
+    # -- cross-encoder second stage (ref: rerank.go; search.go:1639) --------
+    def set_reranker(self, reranker) -> None:
+        self._reranker = reranker
+
+    def _apply_rerank(self, query: str, ordered: list[str]) -> list[str]:
+        reranker = getattr(self, "_reranker", None)
+        if reranker is None:
+            from nornicdb_tpu.search.rerank import CrossEncoderReranker
+
+            reranker = self._reranker = CrossEncoderReranker()
+        head = ordered[: self.config.rerank_candidates]
+        candidates = []
+        missing = []  # lookup failures keep their head position, not the tail
+        for id_ in head:
+            try:
+                node = self.storage.get_node(id_)
+            except NotFoundError:
+                missing.append(id_)
+                continue
+            candidates.append((id_, build_embedding_text(node)[:1000]))
+        if not candidates:
+            return ordered
+        reranked = [i for i, _ in reranker.rerank(query, candidates)]
+        new_head = reranked + missing
+        head_set = set(new_head)
+        return new_head + [i for i in ordered if i not in head_set]
 
     # -- clustering (ref: gpu.ClusterIndex kmeans.go:144; debounced trigger
     # embed_queue.go:257) -----------------------------------------------------

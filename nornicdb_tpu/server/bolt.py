@@ -316,15 +316,13 @@ class BoltServer:
             proposals = await reader.readexactly(16)
             chosen = (0, 0)
             for i in range(4):
+                # proposal bytes: [00, range, minor, major] — the client
+                # supports (major, minor-range) .. (major, minor)
+                rng = proposals[i * 4 + 1]
                 minor, major = proposals[i * 4 + 2], proposals[i * 4 + 3]
-                # version encoded little-endianish: [00 range minor major]
-                for v in SUPPORTED_VERSIONS:
-                    rng = proposals[i * 4 + 1]
-                    if major == v[0] and v[1] <= minor <= v[1] + rng:
-                        chosen = v if minor == v[1] else (major, minor)
-                        break
-                    if (major, minor) == v:
-                        chosen = v
+                for v in SUPPORTED_VERSIONS:  # ordered best-first
+                    if v[0] == major and (minor - rng) <= v[1] <= minor:
+                        chosen = v  # always a version WE support
                         break
                 if chosen != (0, 0):
                     break

@@ -1,0 +1,153 @@
+"""Tests: import/export + Mimir loader, cross-encoder rerank, sharded search
+backend, new APOC categories (ref: storage loaders, rerank.go, apoc/agg)."""
+
+import json
+
+import numpy as np
+import pytest
+
+import nornicdb_tpu
+from nornicdb_tpu.apoc import call
+from nornicdb_tpu.cli import main as cli_main
+from nornicdb_tpu.search.rerank import CrossEncoderReranker
+from nornicdb_tpu.search.service import SearchConfig, SearchService
+from nornicdb_tpu.storage import Edge, MemoryEngine, Node
+from nornicdb_tpu.storage.io import export_json, import_json, load_mimir
+
+
+class TestImportExport:
+    def test_roundtrip(self):
+        eng = MemoryEngine()
+        eng.create_node(Node(id="a", labels=["X"], properties={"k": 1}))
+        eng.create_node(Node(id="b"))
+        eng.create_edge(Edge(id="e", start_node="a", end_node="b", type="R"))
+        data = export_json(eng)
+        eng2 = MemoryEngine()
+        n, m = import_json(eng2, data)
+        assert (n, m) == (2, 1)
+        assert export_json(eng2) == data
+
+    def test_skip_existing(self):
+        eng = MemoryEngine()
+        eng.create_node(Node(id="a"))
+        n, _ = import_json(eng, {"nodes": [{"id": "a"}, {"id": "b"}]})
+        assert n == 1
+
+    def test_mimir_loader(self, tmp_path):
+        p = tmp_path / "mimir.jsonl"
+        p.write_text(
+            json.dumps({"type": "memory", "id": "m1", "content": "first",
+                        "importance": 0.9}) + "\n"
+            + json.dumps({"type": "memory", "id": "m2", "content": "second"}) + "\n"
+            + json.dumps({"type": "relation", "from": "m1", "to": "m2",
+                          "relation": "FOLLOWS"}) + "\n"
+        )
+        eng = MemoryEngine()
+        n, m = load_mimir(eng, str(p))
+        assert (n, m) == (2, 1)
+        assert eng.get_node("m1").properties["importance"] == 0.9
+        assert eng.pending_embed_ids() == ["m1", "m2"]
+        assert eng.get_edges_by_type("FOLLOWS")
+
+    def test_cli_export_import(self, tmp_path, capsys):
+        d1 = str(tmp_path / "db1")
+        db = nornicdb_tpu.open_db(d1)
+        db.cypher("CREATE (:T {v: 1})-[:L]->(:T {v: 2})")
+        db.flush(); db.close()
+        out_file = str(tmp_path / "dump.json")
+        cli_main(["--data-dir", d1, "export", out_file])
+        d2 = str(tmp_path / "db2")
+        cli_main(["--data-dir", d2, "import", out_file])
+        db2 = nornicdb_tpu.open_db(d2)
+        assert db2.cypher("MATCH (t:T) RETURN count(t)").rows == [[2]]
+        assert db2.cypher("MATCH ()-[l:L]->() RETURN count(l)").rows == [[1]]
+        db2.close()
+
+
+class TestRerank:
+    def test_rerank_machinery(self):
+        rr = CrossEncoderReranker()
+        out = rr.rerank("query text", [("a", "doc one"), ("b", "doc two")])
+        assert {i for i, _ in out} == {"a", "b"}
+        assert out[0][1] >= out[1][1]  # best-first
+
+    def test_service_gated_rerank(self):
+        from nornicdb_tpu.embed import HashEmbedder
+
+        eng = MemoryEngine()
+        emb = HashEmbedder(32)
+        svc = SearchService(
+            eng, embedder=emb,
+            config=SearchConfig(rerank_enabled=True, rerank_candidates=5),
+        )
+        svc.attach(eng)
+
+        class FixedReranker:
+            def rerank(self, query, candidates, limit=0):
+                # deterministic: reverse candidate order
+                return [(i, 1.0) for i, _ in reversed(candidates)]
+
+        svc.set_reranker(FixedReranker())
+        for i in range(3):
+            n = Node(id=f"n{i}", properties={"content": f"shared words {i}"})
+            n.embedding = emb.embed(n.properties["content"])
+            eng.create_node(n)
+        res = svc.search("shared words", limit=3)
+        assert len(res) == 3  # reranker applied without dropping results
+
+
+class TestShardedBackend:
+    def test_sharded_search_service(self):
+        from nornicdb_tpu.embed import HashEmbedder
+
+        eng = MemoryEngine()
+        emb = HashEmbedder(32)
+        svc = SearchService(
+            eng, embedder=emb, config=SearchConfig(backend="sharded")
+        )
+        svc.attach(eng)
+        for i in range(50):
+            n = Node(id=f"n{i}", properties={"content": f"document {i} alpha"})
+            n.embedding = emb.embed(n.properties["content"])
+            eng.create_node(n)
+        from nornicdb_tpu.parallel import ShardedCorpus
+
+        assert isinstance(svc._corpus, ShardedCorpus)
+        res = svc.search("document 7 alpha", limit=3)
+        assert res and res[0]["id"] == "n7"
+
+
+class TestNewApoc:
+    def test_agg(self):
+        assert call("apoc.agg.median", [1, 2, 3, 4]) == 2.5
+        assert call("apoc.agg.product", [2, 3, 4]) == 24
+        stats = call("apoc.agg.statistics", [1.0, 2.0, 3.0])
+        assert stats["mean"] == 2.0 and stats["count"] == 3
+
+    def test_atomic(self):
+        m = call("apoc.atomic.add", {"n": 1}, "n", 5)
+        assert m["n"] == 6
+        m = call("apoc.atomic.concat", {}, "s", "x")
+        assert m["s"] == "x"
+
+    def test_load_json(self, tmp_path, monkeypatch):
+        p = tmp_path / "d.json"
+        p.write_text('{"k": [1, 2]}')
+        with pytest.raises(ValueError):  # gated off by default
+            call("apoc.load.json", f"file://{p}")
+        monkeypatch.setenv("NORNICDB_APOC_IMPORT_ENABLED", "true")
+        assert call("apoc.load.json", f"file://{p}") == {"k": [1, 2]}
+        with pytest.raises(ValueError):
+            call("apoc.load.json", "http://example.com/x.json")
+
+    def test_coll_extras(self):
+        assert call("apoc.coll.duplicates", [1, 2, 2, 3, 3, 3]) == [2, 3]
+        assert call("apoc.coll.dropDuplicateNeighbors", [1, 1, 2, 1]) == [1, 2, 1]
+        assert call("apoc.coll.runningTotal", [1, 2, 3]) == [1, 3, 6]
+        assert call("apoc.coll.containsAll", [1, 2, 3], [1, 3])
+
+    def test_text_extras(self):
+        assert call("apoc.text.fuzzyMatch", "hello", "helo") is True
+        assert call("apoc.text.sorensenDiceSimilarity", "night", "nacht") > 0.2
+        assert call("apoc.text.swapCase", "aB") == "Ab"
+        assert call("apoc.text.repeat", "ab", 3) == "ababab"
