@@ -158,6 +158,30 @@ def cmd_export(args) -> int:
     return 0
 
 
+def cmd_eval(args) -> int:
+    """Search-quality evaluation (ref: cmd/eval, pkg/eval harness)."""
+    from nornicdb_tpu.embed import HashEmbedder
+    from nornicdb_tpu.eval import Harness
+
+    db = _open_db(args)
+    try:
+        if db.embedder is None:
+            db.set_embedder(HashEmbedder(args.embed_dims))
+            db.process_pending_embeddings()
+        cases = Harness.load_suite(args.suite)
+        thresholds = json.loads(args.thresholds) if args.thresholds else {}
+        harness = Harness(
+            lambda q, k: [r["id"] for r in db.search.search(q, limit=k)],
+            k=args.k, thresholds=thresholds,
+        )
+        report = harness.run(cases)
+        print(json.dumps({"metrics": report.metrics.as_dict(),
+                          "passed": report.passed}, indent=2))
+        return 0 if report.passed else 1
+    finally:
+        db.close()
+
+
 def cmd_decay(args) -> int:
     """(ref: nornicdb decay {recalculate,archive,stats})"""
     db = _open_db(args)
@@ -205,6 +229,13 @@ def main(argv=None) -> int:
     s = sub.add_parser("export", help="export the graph as Neo4j-style JSON")
     s.add_argument("file", help="output path, or - for stdout")
     s.set_defaults(fn=cmd_export)
+
+    s = sub.add_parser("eval", help="run a search-quality evaluation suite")
+    s.add_argument("suite", help="JSON suite: [{query, relevant: [ids]}]")
+    s.add_argument("--k", type=int, default=10)
+    s.add_argument("--embed-dims", type=int, default=256)
+    s.add_argument("--thresholds", default="", help='JSON e.g. {"mrr": 0.8}')
+    s.set_defaults(fn=cmd_eval)
 
     s = sub.add_parser("decay", help="memory decay operations")
     s.add_argument("action", choices=["recalculate", "archive", "stats"])

@@ -62,6 +62,7 @@ class Region:
         self._outbox: dict[int, dict[str, Any]] = {}  # index -> entry (deduped)
         self._outbox_lock = threading.Lock()
         self._pushed: dict[str, int] = {}  # peer region -> last shipped idx
+        self._prune_floor = 0  # outbox entries <= floor have been discarded
         self._applied_remote: dict[str, int] = {}  # origin region -> last seq
         self._peers: dict[str, str] = {}  # region name -> transport peer id
         self._stop = threading.Event()
@@ -101,7 +102,11 @@ class Region:
             self._thread.join(timeout=2)
 
     def connect(self, region_name: str, peer_id: str) -> None:
+        """Register a peer region. A peer joining AFTER outbox pruning starts
+        from the prune floor — entries below it need a snapshot bootstrap
+        (import/export), same as adding a fresh Raft voter mid-life."""
         self._peers[region_name] = peer_id
+        self._pushed.setdefault(region_name, self._prune_floor)
 
     def leader(self, timeout: float = 5.0) -> Optional[RaftNode]:
         return self.cluster.leader(timeout)
@@ -148,7 +153,8 @@ class Region:
             floor = min(
                 self._pushed.get(r, 0) for r in self._peers
             )
-            if floor:
+            if floor > self._prune_floor:
+                self._prune_floor = floor
                 with self._outbox_lock:
                     self._outbox = {
                         i: e for i, e in self._outbox.items() if i > floor

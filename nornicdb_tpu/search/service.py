@@ -50,6 +50,8 @@ class SearchConfig:
     # feature-flag-gated like the reference)
     rerank_enabled: bool = False
     rerank_candidates: int = 20
+    # IVF cluster pruning (ref: kmeans_candidate_gen.go): 0 = full scan
+    n_probe: int = 0
 
 
 class SearchService:
@@ -169,7 +171,12 @@ class SearchService:
         with self._lock:
             self.stats.vector_candidates += 1
             if self._corpus is not None:
-                res = self._corpus.search(embedding, k=k, min_similarity=min_similarity)
+                kwargs = {}
+                if self.config.n_probe > 0 and hasattr(self._corpus, "cluster"):
+                    kwargs["n_probe"] = self.config.n_probe
+                res = self._corpus.search(
+                    embedding, k=k, min_similarity=min_similarity, **kwargs
+                )
                 return res[0] if res else []
             if self._hnsw is not None:
                 return [
@@ -265,8 +272,8 @@ class SearchService:
     # embed_queue.go:257) -----------------------------------------------------
     def recluster(self, k: int = 0, iters: int = 10) -> Optional[dict[str, int]]:
         """Re-fit k-means over the current vector set on TPU; stores
-        id->cluster assignments for cluster-pruned candidate generation and
-        the inference engine's cluster integration."""
+        id->cluster assignments for cluster-pruned candidate generation
+        (DeviceCorpus IVF) and the inference engine's cluster integration."""
         with self._lock:
             ids = list(self._vectors.keys())
             if len(ids) < 2:
@@ -279,6 +286,11 @@ class SearchService:
         with self._lock:
             self.cluster_result = res
             self.cluster_assignments = assignments
+            corpus = self._corpus
+        if corpus is not None and hasattr(corpus, "set_clusters"):
+            # reuse the one fit: map assignments onto corpus slots (no second
+            # k-means, and nothing heavy runs under the service lock)
+            corpus.set_clusters(res.centroids, assignments)
         return assignments
 
     # -- wiring ------------------------------------------------------------

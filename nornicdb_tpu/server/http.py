@@ -401,6 +401,34 @@ class HttpServer:
             )
             h._send(200, {"token": token})
             return
+        if path == "/auth/oauth/token":
+            # OAuth2 token endpoint (ref: pkg/auth/oauth.go; cmd/oauth-provider):
+            # password and client_credentials grants map onto the JWT issuer
+            body = h._body()
+            if self.authenticator is None:
+                h._send(503, {"error": "auth not configured"})
+                return
+            grant = body.get("grant_type", "")
+            if grant == "password":
+                token = self.authenticator.authenticate(
+                    body.get("username", ""), body.get("password", "")
+                )
+            elif grant == "client_credentials":
+                token = self.authenticator.authenticate(
+                    body.get("client_id", ""), body.get("client_secret", "")
+                )
+            else:
+                h._send(400, {"error": "unsupported_grant_type"})
+                return
+            h._send(
+                200,
+                {
+                    "access_token": token,
+                    "token_type": "Bearer",
+                    "expires_in": int(self.authenticator.config.token_ttl),
+                },
+            )
+            return
         if path == "/auth/logout":
             body = h._body()
             if self.authenticator is not None:

@@ -89,8 +89,10 @@ def load_mimir(engine: Engine, path: str) -> tuple[int, int]:
             obj = json.loads(line)
             kind = obj.get("type", "memory")
             if kind == "memory":
+                from nornicdb_tpu.storage.types import new_id as _new_id
+
                 node = Node(
-                    id=str(obj.get("id")),
+                    id=str(obj["id"]) if obj.get("id") is not None else _new_id(),
                     labels=["Memory"] + list(obj.get("labels", [])),
                     properties={
                         "content": obj.get("content", obj.get("text", "")),

@@ -192,3 +192,64 @@ class TestPallasKernels:
         v2, i2 = cosine_topk(q, l2_normalize(c), valid, 5, use_bf16=False)
         np.testing.assert_array_equal(np.asarray(i1), np.asarray(i2))
         np.testing.assert_allclose(np.asarray(v1), np.asarray(v2), atol=1e-4)
+
+
+class TestClusterPrunedSearch:
+    """(ref: ClusterIndex kmeans.go:144, SearchWithClusters :816,
+    kmeans_candidate_gen.go)"""
+
+    def _corpus(self):
+        rng = np.random.default_rng(0)
+        dc = DeviceCorpus(dims=16)
+        # three well-separated blobs
+        centers = np.eye(3, 16, dtype=np.float32) * 10
+        data = np.concatenate(
+            [centers[i] + rng.normal(0, 0.3, (40, 16)).astype(np.float32)
+             for i in range(3)]
+        )
+        dc.add_batch([f"n{i}" for i in range(120)], data)
+        return dc, data
+
+    def test_cluster_and_pruned_search(self):
+        dc, data = self._corpus()
+        k = dc.cluster(k=3, iters=8)
+        assert k == 3
+        res = dc.search(data[5], k=3, n_probe=1)
+        assert res[0][0][0] == "n5"  # self-match survives pruning
+        assert res[0][0][1] > 0.9
+
+    def test_pruned_matches_full_on_separated_data(self):
+        dc, data = self._corpus()
+        dc.cluster(k=3, iters=8)
+        full = dc.search(data[50], k=5)[0]
+        pruned = dc.search(data[50], k=5, n_probe=1)[0]
+        assert [p[0] for p in pruned] == [f[0] for f in full]
+
+    def test_no_clusters_falls_back_to_full(self):
+        dc, data = self._corpus()
+        res = dc.search(data[7], k=1, n_probe=4)  # no cluster() called
+        assert res[0][0][0] == "n7"
+
+    def test_clear_clusters(self):
+        dc, data = self._corpus()
+        dc.cluster(k=3)
+        dc.clear_clusters()
+        res = dc.search(data[7], k=1, n_probe=2)
+        assert res[0][0][0] == "n7"
+
+    def test_growth_invalidates_clusters(self):
+        dc, data = self._corpus()
+        dc.cluster(k=3)
+        extra = np.random.default_rng(5).standard_normal((200, 16)).astype(np.float32)
+        dc.add_batch([f"x{i}" for i in range(200)], extra)  # triggers _grow
+        res = dc.search(data[5], k=1, n_probe=1)  # falls back to full scan
+        assert res[0][0][0] == "n5"
+
+    def test_set_clusters_external(self):
+        dc, data = self._corpus()
+        from nornicdb_tpu.ops import kmeans_fit
+        res = kmeans_fit(data, k=3, iters=8)
+        dc.set_clusters(res.centroids,
+                        {f"n{i}": int(c) for i, c in enumerate(res.assignments)})
+        out = dc.search(data[5], k=1, n_probe=1)
+        assert out[0][0][0] == "n5"

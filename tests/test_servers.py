@@ -473,3 +473,34 @@ class TestGrpcSearch:
         finally:
             srv.stop()
             db.close()
+
+
+class TestOAuthToken:
+    def test_password_and_client_credentials_grants(self):
+        db = nornicdb_tpu.open_db("")
+        auth = Authenticator(MemoryEngine())
+        auth.create_user("svc", "secret", ROLE_ADMIN)
+        server = HttpServer(db, port=0, authenticator=auth, auth_required=True)
+        server.start()
+        try:
+            out = _post(server.port, "/auth/oauth/token",
+                        {"grant_type": "password", "username": "svc",
+                         "password": "secret"})
+            assert out["token_type"] == "Bearer"
+            # the issued token works as a Bearer credential
+            out2 = _post(
+                server.port, "/nornicdb/search", {"query": "x"},
+                headers={"Authorization": f"Bearer {out['access_token']}"},
+            )
+            assert out2 == {"results": []}
+            out3 = _post(server.port, "/auth/oauth/token",
+                         {"grant_type": "client_credentials",
+                          "client_id": "svc", "client_secret": "secret"})
+            assert out3["access_token"]
+            with pytest.raises(urllib.error.HTTPError) as e:
+                _post(server.port, "/auth/oauth/token",
+                      {"grant_type": "implicit"})
+            assert e.value.code == 400
+        finally:
+            server.stop()
+            db.close()
