@@ -1,0 +1,25 @@
+# NornicDB-TPU (ref: the reference's Makefile test/build targets)
+
+.PHONY: test test-fast bench native e2e-bench clean
+
+test:
+	python -m pytest tests/ -q
+
+test-fast:
+	python -m pytest tests/ -q -x
+
+bench:
+	python bench.py
+
+e2e-bench:
+	python benchmarks/endpoints_bench.py
+
+native:
+	$(MAKE) -C native
+
+graft-check:
+	python __graft_entry__.py
+
+clean:
+	$(MAKE) -C native clean
+	find . -name __pycache__ -type d -exec rm -rf {} + 2>/dev/null; true

@@ -1,0 +1,52 @@
+"""NornicDB-TPU quickstart: the learning loop end to end.
+
+Run: python examples/quickstart.py
+"""
+
+import sys
+import time
+
+sys.path.insert(0, __file__.rsplit("/examples", 1)[0])
+
+import nornicdb_tpu
+from nornicdb_tpu.db import Config
+from nornicdb_tpu.embed import CachedEmbedder, HashEmbedder
+
+# 1. open a database (pass a path for durability; "" = in-memory)
+db = nornicdb_tpu.open_db("", Config(similarity_threshold=0.5))
+db.inference.config.min_evidence = 1  # demo: link on first observation
+db.set_embedder(CachedEmbedder(HashEmbedder(256)))  # or embed.TPUEmbedder()
+
+# 2. store memories — they embed in the background and auto-link
+facts = [
+    "TPUs use a systolic array to multiply matrices",
+    "TPUs use a systolic array for fast matrix math",
+    "The espresso machine needs descaling every month",
+]
+ids = [db.store(f).id for f in facts]
+while db.storage.pending_embed_ids():
+    time.sleep(0.05)
+time.sleep(0.3)  # let inference observe the embeddings
+
+# 3. hybrid recall (vector + BM25, RRF-fused)
+print("recall('matrix hardware'):")
+for r in db.recall("matrix hardware", limit=2):
+    print(f"  {r['score']:.3f}  {r['content']}")
+
+# 4. the graph learned: similar facts got linked automatically
+auto = [e for e in db.storage.all_edges() if e.auto_generated]
+print(f"auto-inferred edges: {[(e.type, round(e.confidence, 2)) for e in auto]}")
+
+# 5. Cypher over the same graph
+print(db.cypher(
+    "MATCH (m:Memory) WHERE m.content CONTAINS 'systolic' "
+    "RETURN count(m) AS tpu_facts").rows_as_dicts())
+
+# 6. vector search from Cypher with server-side auto-embedding
+rows = db.cypher(
+    "CALL db.index.vector.queryNodes('memories', 2, 'matrix multiplication') "
+    "YIELD node, score RETURN node.content AS content, round(score * 100) AS pct"
+).rows
+print("vector procedure:", rows)
+
+db.close()
