@@ -93,6 +93,23 @@ class ListComprehension:
 
 
 @dataclass
+class MapProjection:
+    """n {.a, .b, .*, key: expr, var} — Neo4j map projection."""
+
+    subject: "Expr"
+    items: list[tuple[str, Any]]  # (kind, payload): prop/all/alias/var
+
+
+@dataclass
+class PatternComprehension:
+    """[(a)-[:R]->(b) WHERE p | expr]"""
+
+    pattern: "PatternPath"
+    where: Optional["Expr"]
+    projection: "Expr"
+
+
+@dataclass
 class PatternPredicate:
     """A bare pattern used as a boolean predicate, e.g. WHERE (a)-[:KNOWS]->(b)."""
 
@@ -136,7 +153,7 @@ Expr = Union[
     Literal, Parameter, Variable, Property, ListLiteral, MapLiteral,
     FunctionCall, UnaryOp, BinaryOp, IsNull, Subscript, Slice, CaseExpr,
     ListComprehension, PatternPredicate, ExistsSubquery, CountSubquery,
-    Quantifier, ReduceExpr,
+    Quantifier, ReduceExpr, MapProjection, PatternComprehension,
 ]
 
 
@@ -146,6 +163,7 @@ class NodePattern:
     variable: Optional[str]
     labels: list[str]
     properties: Optional[MapLiteral]
+    where: Optional["Expr"] = None  # inline (n:L WHERE n.x > 1)
 
 
 @dataclass

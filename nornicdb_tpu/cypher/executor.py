@@ -956,6 +956,17 @@ class CypherExecutor:
             return count if isinstance(e, ast.CountSubquery) else False
         raise CypherTypeError("unknown pattern expression")
 
+    def eval_pattern_comprehension(self, e, ctx: EvalContext) -> list:
+        """[(a)-[:R]->(b) WHERE p | expr] — match from current bindings,
+        filter, project."""
+        out = []
+        for row in self.matcher.match_path(e.pattern, ctx.bindings, ctx.params):
+            row_ctx = EvalContext(row, ctx.params, self)
+            if e.where is not None and evaluate(e.where, row_ctx) is not True:
+                continue
+            out.append(evaluate(e.projection, row_ctx))
+        return out
+
     # -- hooks -------------------------------------------------------------------
     def get_node_or_none(self, node_id: str) -> Optional[Node]:
         try:

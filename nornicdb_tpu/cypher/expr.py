@@ -128,6 +128,32 @@ def evaluate(e: ast.Expr, ctx: EvalContext) -> Any:
             return not any(truths)
         if e.kind == "single":
             return sum(truths) == 1
+    if isinstance(e, ast.MapProjection):
+        subject = evaluate(e.subject, ctx)
+        if subject is None:
+            return None
+        if isinstance(subject, (Node, Edge)):
+            props = subject.properties
+        elif isinstance(subject, dict):
+            props = subject
+        else:
+            raise CypherTypeError("map projection needs a node/relationship/map")
+        out: dict[str, Any] = {}
+        for kind, payload in e.items:
+            if kind == "all":
+                out.update(props)
+            elif kind == "prop":
+                out[payload] = props.get(payload)
+            elif kind == "alias":
+                name, expr2 = payload
+                out[name] = evaluate(expr2, ctx)
+            elif kind == "var":
+                out[payload] = evaluate(ast.Variable(payload), ctx)
+        return out
+    if isinstance(e, ast.PatternComprehension):
+        if ctx.executor is None:
+            raise CypherTypeError("pattern comprehension requires executor context")
+        return ctx.executor.eval_pattern_comprehension(e, ctx)
     if isinstance(e, ast.ReduceExpr):
         src = evaluate(e.source, ctx)
         if src is None:

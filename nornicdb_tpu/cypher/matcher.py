@@ -66,6 +66,19 @@ class PatternMatcher:
                     return False
         return True
 
+    def _passes_inline_where(
+        self, node: Node, node_pat: ast.NodePattern, row: dict, params: dict
+    ) -> bool:
+        """Inline predicate (n:L WHERE n.x > 1) — evaluated with the node
+        bound under its pattern variable."""
+        if node_pat.where is None:
+            return True
+        bindings = dict(row)
+        if node_pat.variable:
+            bindings[node_pat.variable] = node
+        ctx = EvalContext(bindings, params, self.executor)
+        return evaluate(node_pat.where, ctx) is True
+
     def _candidates(
         self, node_pat: ast.NodePattern, row: dict, params: dict
     ) -> list[Node]:
@@ -163,6 +176,8 @@ class PatternMatcher:
         if isinstance(el, ast.NodePattern):
             if idx == 0:
                 for node in self._candidates(el, row, params):
+                    if not self._passes_inline_where(node, el, row, params):
+                        continue
                     new_row = dict(row)
                     if el.variable:
                         new_row[el.variable] = node
@@ -194,6 +209,8 @@ class PatternMatcher:
                 continue
             if not self._node_matches(other, target_pat, tprops):
                 continue
+            if not self._passes_inline_where(other, target_pat, row, params):
+                continue
             if target_pat.variable and target_pat.variable in row:
                 bound = row[target_pat.variable]
                 if not isinstance(bound, Node) or bound.id != other.id:
@@ -219,7 +236,8 @@ class PatternMatcher:
 
         def walk(curr: Node, hops: int, rels: list[Edge], nodes: list[Node]):
             if hops >= min_h:
-                if self._node_matches(curr, target_pat, tprops):
+                if self._node_matches(curr, target_pat, tprops) and \
+                        self._passes_inline_where(curr, target_pat, row, params):
                     if target_pat.variable and target_pat.variable in row:
                         bound = row[target_pat.variable]
                         ok = isinstance(bound, Node) and bound.id == curr.id

@@ -642,8 +642,11 @@ class Parser:
             props = self.parse_map_literal()
         if self.cur.kind == "PARAM":  # (n $props)
             props = ast.MapLiteral({"__param__": ast.Parameter(self.advance().value)})
+        where = None
+        if self.accept_kw("WHERE"):  # inline predicate: (n:L WHERE n.x > 1)
+            where = self.parse_expr()
         self.expect_op(")")
-        return ast.NodePattern(var, labels, props)
+        return ast.NodePattern(var, labels, props, where)
 
     def parse_rel_pattern(self) -> ast.RelPattern:
         direction = "both"
@@ -805,6 +808,15 @@ class Parser:
     def parse_postfix(self) -> ast.Expr:
         e = self.parse_atom()
         while True:
+            # map projection: n {.a, .b, .*, key: expr, other_var}
+            if (
+                isinstance(e, ast.Variable)
+                and self.at_op("{")
+                and self.peek().kind == "OP"
+                and self.peek().value in (".", "}")
+            ):
+                e = self.parse_map_projection(e)
+                continue
             if self.at_op("."):
                 # property access; but don't eat ".." (range)
                 self.advance()
@@ -825,6 +837,26 @@ class Parser:
                 self.expect_op("]")
             else:
                 return e
+
+    def parse_map_projection(self, subject: ast.Variable) -> ast.MapProjection:
+        self.expect_op("{")
+        items: list[tuple[str, object]] = []
+        while not self.at_op("}"):
+            if self.accept_op("."):
+                if self.accept_op("*"):
+                    items.append(("all", None))
+                else:
+                    items.append(("prop", self.expect_ident()))
+            else:
+                name = self.expect_ident()
+                if self.accept_op(":"):
+                    items.append(("alias", (name, self.parse_expr())))
+                else:
+                    items.append(("var", name))
+            if not self.accept_op(","):
+                break
+        self.expect_op("}")
+        return ast.MapProjection(subject, items)
 
     def parse_atom(self) -> ast.Expr:
         t = self.cur
@@ -1036,6 +1068,21 @@ class Parser:
             self.expect_op("]")
             return ast.ListComprehension(var, src, where, proj)
         self.pos = save
+        # pattern comprehension: [(a)-[:R]->(b) WHERE p | expr]
+        if self.at_op("("):
+            try:
+                pattern = self._parse_path_elements()
+                if len(pattern.elements) >= 3:  # must include a relationship
+                    where = None
+                    if self.accept_kw("WHERE"):
+                        where = self.parse_expr()
+                    self.expect_op("|")
+                    proj = self.parse_expr()
+                    self.expect_op("]")
+                    return ast.PatternComprehension(pattern, where, proj)
+                raise CypherSyntaxError("not a pattern comprehension")
+            except CypherSyntaxError:
+                self.pos = save
         items = [self.parse_expr()]
         while self.accept_op(","):
             items.append(self.parse_expr())

@@ -1,0 +1,115 @@
+"""Micro-batching for vector search dispatch.
+
+SURVEY.md §7 hard part (f): "keeping p50 low while the embed worker streams
+updates — separate compute streams / program instances for query vs ingest".
+On TPU the equivalent lever is batching concurrent queries into ONE device
+program: each dispatch has fixed overhead (compile cache hit + transfer +
+launch; ~65ms through the dev tunnel, ~0.1ms on a TPU-VM host), so N
+concurrent single-query searches collapse into one (N, D) GEMM.
+
+QueryBatcher: callers block up to `window` seconds while a batch
+accumulates; one worker flushes the batch through the corpus and fans
+results back out. Under low concurrency a query waits at most `window`
+(default 2ms); under load, throughput multiplies by the batch size.
+"""
+
+from __future__ import annotations
+
+import threading
+from dataclasses import dataclass, field
+from typing import Any, Callable, Optional
+
+import numpy as np
+
+
+@dataclass
+class _Pending:
+    query: np.ndarray
+    k: int
+    min_similarity: float
+    event: threading.Event = field(default_factory=threading.Event)
+    result: Optional[list] = None
+    error: Optional[Exception] = None
+
+
+@dataclass
+class BatcherStats:
+    queries: int = 0
+    batches: int = 0
+    max_batch: int = 0
+
+    @property
+    def avg_batch(self) -> float:
+        return self.queries / self.batches if self.batches else 0.0
+
+
+class QueryBatcher:
+    """Coalesce concurrent search calls into one device dispatch.
+
+    search_batch_fn(queries (N, D), k, min_similarity) -> list of per-query
+    [(id, score)] — the DeviceCorpus/ShardedCorpus.search signature.
+    """
+
+    def __init__(
+        self,
+        search_batch_fn: Callable[[np.ndarray, int, float], list],
+        window: float = 0.002,
+        max_batch: int = 256,
+    ):
+        self.search_batch_fn = search_batch_fn
+        self.window = window
+        self.max_batch = max_batch
+        self.stats = BatcherStats()
+        self._lock = threading.Lock()
+        self._pending: list[_Pending] = []
+        self._flusher: Optional[threading.Thread] = None
+
+    def search(
+        self, query: np.ndarray, k: int, min_similarity: float = -1.0
+    ) -> list:
+        p = _Pending(np.asarray(query, np.float32).reshape(-1), k, min_similarity)
+        with self._lock:
+            self._pending.append(p)
+            if self._flusher is None:
+                # first caller of the window becomes responsible for flushing
+                self._flusher = threading.Thread(target=self._flush_after_window,
+                                                 daemon=True)
+                self._flusher.start()
+            elif len(self._pending) >= self.max_batch:
+                pending, self._pending = self._pending, []
+                threading.Thread(
+                    target=self._run_batch, args=(pending,), daemon=True
+                ).start()
+        p.event.wait()
+        if p.error is not None:
+            raise p.error
+        return p.result
+
+    def _flush_after_window(self) -> None:
+        threading.Event().wait(self.window)
+        with self._lock:
+            pending, self._pending = self._pending, []
+            self._flusher = None
+        if pending:
+            self._run_batch(pending)
+
+    def _run_batch(self, pending: list[_Pending]) -> None:
+        try:
+            queries = np.stack([p.query for p in pending])
+            k = max(p.k for p in pending)
+            min_sim = min(p.min_similarity for p in pending)
+            results = self.search_batch_fn(queries, k, min_sim)
+            with self._lock:
+                self.stats.queries += len(pending)
+                self.stats.batches += 1
+                self.stats.max_batch = max(self.stats.max_batch, len(pending))
+            for p, res in zip(pending, results):
+                # per-caller k / min_similarity re-applied on the shared batch
+                p.result = [
+                    (i, s) for i, s in res if s >= p.min_similarity
+                ][: p.k]
+                p.event.set()
+        except Exception as e:  # fan the failure out — nobody hangs
+            for p in pending:
+                p.error = e
+                p.event.set()

@@ -52,6 +52,11 @@ class SearchConfig:
     rerank_candidates: int = 20
     # IVF cluster pruning (ref: kmeans_candidate_gen.go): 0 = full scan
     n_probe: int = 0
+    # micro-batching of concurrent searches into one device dispatch
+    # (SURVEY §7 hard part f)
+    batching_enabled: bool = False
+    batch_window: float = 0.002
+    batch_max: int = 256
 
 
 class SearchService:
@@ -164,10 +169,30 @@ class SearchService:
         return n
 
     # -- queries -----------------------------------------------------------
+    def _batched_corpus_search(
+        self, queries: np.ndarray, k: int, min_similarity: float
+    ) -> list:
+        return self._corpus.search(queries, k=k, min_similarity=min_similarity)
+
     def vector_candidates(
         self, embedding: np.ndarray, k: int = 10, min_similarity: float = -1.0
     ) -> list[tuple[str, float]]:
         """(ref: VectorSearchCandidates search.go:1005)"""
+        if (
+            self.config.batching_enabled
+            and self._corpus is not None
+        ):
+            batcher = getattr(self, "_batcher", None)
+            if batcher is None:
+                from nornicdb_tpu.search.batcher import QueryBatcher
+
+                batcher = self._batcher = QueryBatcher(
+                    self._batched_corpus_search,
+                    window=self.config.batch_window,
+                    max_batch=self.config.batch_max,
+                )
+            self.stats.vector_candidates += 1
+            return batcher.search(embedding, k, min_similarity)
         with self._lock:
             self.stats.vector_candidates += 1
             if self._corpus is not None:

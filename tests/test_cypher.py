@@ -567,3 +567,61 @@ class TestExplain:
         r = ex.execute("PROFILE MATCH (n:X) RETURN count(n)")
         assert r.rows == [[1]]
         assert "runtime" in r.plan
+
+
+class TestMapProjections:
+    def test_basic_projection(self, movies):
+        r = movies.execute(
+            "MATCH (p:Person {name: 'Keanu Reeves'}) RETURN p {.name, .born} AS m"
+        )
+        assert r.rows == [[{"name": "Keanu Reeves", "born": 1964}]]
+
+    def test_star_alias_and_var(self, movies):
+        r = movies.execute(
+            "MATCH (m:Movie {title: 'Speed'}) "
+            "WITH m, 99 AS rank RETURN m {.*, rank, label: 'film'} AS out"
+        )
+        out = r.rows[0][0]
+        assert out == {"title": "Speed", "released": 1994, "rank": 99,
+                       "label": "film"}
+
+    def test_missing_prop_is_null(self, movies):
+        r = movies.execute(
+            "MATCH (p:Person {name: 'Keanu Reeves'}) RETURN p {.nope} AS m"
+        )
+        assert r.rows == [[{"nope": None}]]
+
+
+class TestInlineWhere:
+    def test_first_node_inline_where(self, movies):
+        r = movies.execute(
+            "MATCH (p:Person WHERE p.born > 1962) RETURN p.name ORDER BY p.name"
+        )
+        assert [x[0] for x in r.rows] == ["Carrie-Anne Moss", "Keanu Reeves"]
+
+    def test_target_node_inline_where(self, movies):
+        r = movies.execute(
+            "MATCH (p:Person)-[:ACTED_IN]->(m:Movie WHERE m.released < 1999) "
+            "RETURN p.name, m.title"
+        )
+        assert r.rows == [["Keanu Reeves", "Speed"]]
+
+
+class TestPatternComprehensions:
+    def test_project_neighbors(self, movies):
+        r = movies.execute(
+            "MATCH (p:Person {name: 'Keanu Reeves'}) "
+            "RETURN [(p)-[:ACTED_IN]->(m) | m.title] AS titles"
+        )
+        assert sorted(r.rows[0][0]) == ["Speed", "The Matrix"]
+
+    def test_with_where(self, movies):
+        r = movies.execute(
+            "MATCH (p:Person {name: 'Keanu Reeves'}) "
+            "RETURN [(p)-[:ACTED_IN]->(m) WHERE m.released > 1995 | m.title] AS t"
+        )
+        assert r.rows == [[["The Matrix"]]]
+
+    def test_list_literal_with_parens_still_works(self, ex):
+        r = ex.execute("RETURN [(1 + 2), 3] AS l")
+        assert r.rows == [[[3, 3]]]

@@ -315,3 +315,87 @@ class TestSearchService:
         svc = SearchService(eng, embedder=emb)
         assert svc.build_indexes() == 1
         assert svc.search("preexisting")[0]["id"] == "pre"
+
+
+class TestQueryBatcher:
+    """(SURVEY §7 hard part f — micro-batched device dispatch)"""
+
+    def test_concurrent_queries_batch_into_one_dispatch(self):
+        import threading
+
+        from nornicdb_tpu.search.batcher import QueryBatcher
+
+        calls = []
+
+        def batch_fn(queries, k, min_sim):
+            calls.append(queries.shape[0])
+            return [
+                [(f"id{int(q[0])}", float(q[0]))] * min(k, 1) for q in queries
+            ]
+
+        b = QueryBatcher(batch_fn, window=0.05)
+        results = {}
+
+        def one(i):
+            results[i] = b.search(np.full(4, float(i), np.float32), k=1)
+
+        threads = [threading.Thread(target=one, args=(i,)) for i in range(8)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert sum(calls) == 8
+        assert len(calls) <= 2  # coalesced, not 8 dispatches
+        assert results[3] == [("id3", 3.0)]
+        assert b.stats.max_batch >= 4
+
+    def test_per_caller_k_and_threshold(self):
+        from nornicdb_tpu.search.batcher import QueryBatcher
+
+        def batch_fn(queries, k, min_sim):
+            return [[("a", 0.9), ("b", 0.5), ("c", 0.1)][:k] for _ in queries]
+
+        b = QueryBatcher(batch_fn, window=0.001)
+        out = b.search(np.zeros(4, np.float32), k=2, min_similarity=0.4)
+        assert out == [("a", 0.9), ("b", 0.5)]
+
+    def test_error_fans_out(self):
+        from nornicdb_tpu.search.batcher import QueryBatcher
+
+        def batch_fn(queries, k, min_sim):
+            raise RuntimeError("device fell over")
+
+        b = QueryBatcher(batch_fn, window=0.001)
+        with pytest.raises(RuntimeError):
+            b.search(np.zeros(4, np.float32), k=1)
+
+    def test_service_integration(self):
+        import threading
+
+        from nornicdb_tpu.search.service import SearchConfig, SearchService
+        from nornicdb_tpu.storage import MemoryEngine, Node
+
+        eng = MemoryEngine()
+        emb = HashEmbedder(32)
+        svc = SearchService(
+            eng, embedder=emb,
+            config=SearchConfig(batching_enabled=True, batch_window=0.01),
+        )
+        svc.attach(eng)
+        for i in range(20):
+            n = Node(id=f"n{i}", properties={"content": f"text number {i}"})
+            n.embedding = emb.embed(n.properties["content"])
+            eng.create_node(n)
+        outs = {}
+
+        def q(i):
+            outs[i] = svc.vector_candidates(emb.embed(f"text number {i}"), k=1)
+
+        threads = [threading.Thread(target=q, args=(i,)) for i in range(6)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        for i in range(6):
+            assert outs[i][0][0] == f"n{i}"
+        assert svc._batcher.stats.batches <= 3
