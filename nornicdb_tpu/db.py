@@ -36,6 +36,8 @@ class Config:
     wal_sync: bool = False
     # at-rest encryption (ref: db.go:781-809 — PBKDF2-derived key)
     encryption_passphrase: str = ""
+    # durable engine: wal (memory + WAL replay) | segment (native C++ KV)
+    storage_engine: str = "wal"
     auto_compact: bool = False
     auto_compact_interval: float = 300.0
     # embedding
@@ -74,6 +76,7 @@ class DB:
             auto_compact=self.config.auto_compact,
             auto_compact_interval=self.config.auto_compact_interval,
             encryption_passphrase=self.config.encryption_passphrase,
+            engine=self.config.storage_engine,
         )
         # The default database is itself a namespace on the shared base
         # engine, exactly like the reference's "nornic" namespace

@@ -14,6 +14,7 @@ from __future__ import annotations
 import os
 from typing import Optional
 
+from nornicdb_tpu.errors import NornicError
 from nornicdb_tpu.storage.async_engine import AsyncEngine
 from nornicdb_tpu.storage.namespaced import NamespacedEngine
 from nornicdb_tpu.storage.schema import (
@@ -85,13 +86,25 @@ def open_storage(
     auto_compact: bool = False,
     auto_compact_interval: float = 300.0,
     encryption_passphrase: str = "",
+    engine: str = "wal",  # wal (memory+WAL replay) | segment (native C++ KV)
 ) -> Engine:
     """Assemble the storage chain (ref: pkg/nornicdb/db.go:750-914).
 
     data_dir == "" -> in-memory only (no WAL), mirroring reference Open("").
+    engine="segment" uses the native C++ segment store (the BadgerEngine
+    role) as the durable base instead of WAL-replayed memory.
     """
     base: Engine = MemoryEngine()
-    if data_dir:
+    if data_dir and engine == "segment":
+        if encryption_passphrase:
+            raise NornicError(
+                "storage_engine='segment' does not support at-rest encryption "
+                "yet; use the WAL engine for encrypted stores"
+            )
+        from nornicdb_tpu.storage.segment import SegmentEngine
+
+        base = SegmentEngine(data_dir, sync=wal_sync)
+    elif data_dir:
         os.makedirs(data_dir, exist_ok=True)
         wal = WAL(os.path.join(data_dir, "wal"), sync=wal_sync,
                   passphrase=encryption_passphrase or None)
