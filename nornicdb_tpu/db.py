@@ -53,6 +53,9 @@ class Config:
     # inference (auto-TLP)
     inference_enabled: bool = True
     similarity_threshold: float = 0.85
+    # integration adapters (ref: topology_integration.go, cluster_integration.go)
+    topology_integration: bool = False
+    cluster_integration: bool = False
     # search
     search_brute_force_max: int = 5000
     # query cache (ref: pkg/cache, ConfigureGlobalCache main.go:320)
@@ -92,6 +95,7 @@ class DB:
         self.schema.attach(self.storage)
         self._lock = threading.RLock()
         self._closed = False
+        self._decay_started = False
         # attached lazily by subsystem setters
         self._embedder = None
         self._embed_worker = None
@@ -105,6 +109,8 @@ class DB:
         self._query_cache = None
         self._heimdall = None
         self._vectorspaces = None
+        if self.config.decay_enabled:
+            _ = self.decay  # starts the periodic recalculation ticker
 
     @staticmethod
     def _migrate_unprefixed(base: Engine, namespace: str) -> None:
@@ -276,12 +282,19 @@ class DB:
     @property
     def decay(self):
         if self._decay is None:
-            from nornicdb_tpu.decay.decay import DecayManager
+            from nornicdb_tpu.decay.decay import DecayConfig, DecayManager
 
             self._decay = DecayManager(
                 self.storage,
-                archive_threshold=self.config.archive_threshold,
+                config=DecayConfig(
+                    archive_threshold=self.config.archive_threshold,
+                    interval=self.config.decay_interval,
+                ),
             )
+            if self.config.decay_enabled and not self._decay_started:
+                # periodic recalculation ticker (ref: decay.Start decay.go:643)
+                self._decay.start()
+                self._decay_started = True
         return self._decay
 
     @property
@@ -289,11 +302,22 @@ class DB:
         if self._inference is None:
             from nornicdb_tpu.inference.engine import InferenceEngine
 
-            self._inference = InferenceEngine(
+            engine = InferenceEngine(
                 self.storage,
                 similarity_fn=self._similarity_candidates,
                 similarity_threshold=self.config.similarity_threshold,
             )
+            if self.config.topology_integration:
+                from nornicdb_tpu.inference.integrations import TopologyIntegration
+
+                TopologyIntegration(self.storage).attach(engine)
+            if self.config.cluster_integration:
+                from nornicdb_tpu.inference.integrations import ClusterIntegration
+
+                ClusterIntegration(
+                    lambda: self.search.cluster_assignments
+                ).attach(engine)
+            self._inference = engine
         return self._inference
 
     @property

@@ -107,8 +107,10 @@ class HttpServer:
         authenticator=None,
         auth_required: bool = False,
         rate_limit: float = 0.0,  # requests/sec per client; 0 = unlimited
+        serve_ui: bool = True,  # False = headless (ref: -tags noui)
     ):
         self.db = db
+        self.serve_ui = serve_ui
         self.host = host
         self.port = port
         self.authenticator = authenticator
@@ -256,6 +258,16 @@ class HttpServer:
     # -- GET routes --------------------------------------------------------------
     def _route_get(self, h) -> None:
         path = h.path.split("?")[0]
+        if path in ("/", "/ui", "/browser"):
+            # embedded console (ref: ui/embed.go — SPA at the root; set
+            # serve_ui=False for the reference's -tags noui equivalent)
+            if not self.serve_ui:
+                h._send(404, {"error": "ui disabled"})
+                return
+            from nornicdb_tpu.server.ui import UI_HTML
+
+            h._send(200, UI_HTML, content_type="text/html; charset=utf-8")
+            return
         if path == "/health":
             h._send(200, {"status": "ok"})
             return

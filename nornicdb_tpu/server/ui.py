@@ -1,0 +1,125 @@
+"""Embedded web console.
+
+Behavioral reference: /root/reference/ui/ — a React SPA (query console, AI
+assistant, login) embedded via go:embed; headless builds exclude it
+(-tags noui). This build embeds a single-file console (no build step, no
+dependencies) serving the same three panes: Cypher console, hybrid search,
+and Heimdall chat, all speaking the existing HTTP endpoints.
+"""
+
+UI_HTML = """<!DOCTYPE html>
+<html>
+<head>
+<meta charset="utf-8">
+<title>NornicDB-TPU Console</title>
+<style>
+  :root { --bg:#11151c; --panel:#1a2029; --fg:#d8dee9; --accent:#5fb3b3;
+          --muted:#6c7a89; --err:#bf616a; }
+  * { box-sizing: border-box; }
+  body { margin:0; background:var(--bg); color:var(--fg);
+         font:14px/1.5 ui-monospace, Menlo, monospace; }
+  header { padding:12px 20px; border-bottom:1px solid #2a313c;
+           display:flex; justify-content:space-between; align-items:center; }
+  header b { color:var(--accent); }
+  #stats { color:var(--muted); font-size:12px; }
+  main { display:grid; grid-template-columns:1fr 1fr; gap:14px; padding:14px; }
+  section { background:var(--panel); border-radius:8px; padding:14px; }
+  section.wide { grid-column: 1 / span 2; }
+  h2 { margin:0 0 10px; font-size:13px; color:var(--accent);
+       text-transform:uppercase; letter-spacing:1px; }
+  textarea, input { width:100%; background:#0d1117; color:var(--fg);
+      border:1px solid #2a313c; border-radius:6px; padding:8px;
+      font:inherit; }
+  textarea { min-height:72px; resize:vertical; }
+  button { margin-top:8px; background:var(--accent); color:#0d1117;
+      border:0; border-radius:6px; padding:7px 16px; font:inherit;
+      font-weight:bold; cursor:pointer; }
+  pre { background:#0d1117; border-radius:6px; padding:10px; overflow:auto;
+        max-height:320px; white-space:pre-wrap; }
+  .err { color:var(--err); }
+  table { border-collapse:collapse; width:100%; }
+  td, th { border:1px solid #2a313c; padding:4px 8px; text-align:left; }
+  th { color:var(--accent); }
+</style>
+</head>
+<body>
+<header>
+  <div><b>NornicDB-TPU</b> console</div>
+  <div id="stats">loading…</div>
+</header>
+<main>
+  <section class="wide">
+    <h2>Cypher</h2>
+    <textarea id="cypher">MATCH (n) RETURN n LIMIT 10</textarea>
+    <button onclick="runCypher()">Run (Ctrl-Enter)</button>
+    <pre id="cypher-out"></pre>
+  </section>
+  <section>
+    <h2>Hybrid search</h2>
+    <input id="q" placeholder="semantic + fulltext query">
+    <button onclick="runSearch()">Search</button>
+    <pre id="search-out"></pre>
+  </section>
+  <section>
+    <h2>Heimdall</h2>
+    <input id="chat" placeholder="ask the assistant">
+    <button onclick="runChat()">Send</button>
+    <pre id="chat-out"></pre>
+  </section>
+</main>
+<script>
+async function post(path, body) {
+  const r = await fetch(path, {method:'POST',
+    headers:{'Content-Type':'application/json'}, body:JSON.stringify(body)});
+  return r.json();
+}
+function esc(s){const d=document.createElement('div');d.innerText=s;return d.innerHTML;}
+async function refreshStats() {
+  try {
+    const s = await (await fetch('/status')).json();
+    document.getElementById('stats').innerText =
+      `${s.nodes} nodes · ${s.edges} edges · up ${Math.round(s.uptime_seconds)}s`;
+  } catch (e) {}
+}
+async function runCypher() {
+  const out = document.getElementById('cypher-out');
+  const stmt = document.getElementById('cypher').value;
+  try {
+    const r = await post('/db/neo4j/tx/commit', {statements:[{statement:stmt}]});
+    if (r.errors && r.errors.length) {
+      out.innerHTML = '<span class="err">' + esc(r.errors[0].message) + '</span>';
+    } else {
+      const res = r.results[0] || {columns:[], data:[]};
+      let html = '<table><tr>' + res.columns.map(c=>'<th>'+esc(c)+'</th>').join('') + '</tr>';
+      for (const row of res.data) {
+        html += '<tr>' + row.row.map(v=>'<td>'+esc(JSON.stringify(v))+'</td>').join('') + '</tr>';
+      }
+      out.innerHTML = html + '</table>' +
+        (res.stats && Object.keys(res.stats).length
+          ? '<div>'+esc(JSON.stringify(res.stats))+'</div>' : '');
+    }
+  } catch (e) { out.innerHTML = '<span class="err">'+esc(String(e))+'</span>'; }
+  refreshStats();
+}
+async function runSearch() {
+  const out = document.getElementById('search-out');
+  const r = await post('/nornicdb/search',
+    {query: document.getElementById('q').value, limit: 8});
+  out.innerText = (r.results||[]).map(
+    x => x.score.toFixed(3) + '  ' + x.content).join('\\n') || '(no results)';
+}
+async function runChat() {
+  const out = document.getElementById('chat-out');
+  const r = await post('/api/bifrost/chat/completions',
+    {messages:[{role:'user', content: document.getElementById('chat').value}]});
+  out.innerText = r.choices ? r.choices[0].message.content : JSON.stringify(r);
+}
+document.getElementById('cypher').addEventListener('keydown', e => {
+  if (e.key === 'Enter' && (e.ctrlKey || e.metaKey)) runCypher();
+});
+refreshStats();
+setInterval(refreshStats, 5000);
+</script>
+</body>
+</html>
+"""

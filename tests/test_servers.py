@@ -504,3 +504,25 @@ class TestOAuthToken:
         finally:
             server.stop()
             db.close()
+
+
+class TestEmbeddedUI:
+    def test_console_served_at_root(self, http_db):
+        db, server = http_db
+        body, ctype = _get(server.port, "/")
+        assert "NornicDB-TPU" in body and "runCypher" in body
+        assert "text/html" in ctype
+        body2, _ = _get(server.port, "/ui")
+        assert body2 == body
+
+    def test_headless_mode(self):
+        db = nornicdb_tpu.open_db("")
+        server = HttpServer(db, port=0, serve_ui=False)
+        server.start()
+        try:
+            with pytest.raises(urllib.error.HTTPError) as e:
+                _get(server.port, "/")
+            assert e.value.code == 404
+        finally:
+            server.stop()
+            db.close()
