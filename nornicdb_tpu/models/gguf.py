@@ -1,0 +1,189 @@
+"""GGUF model-file reader/writer (metadata + unquantized tensors).
+
+Behavioral reference: /root/reference/lib/llama/gguf.h + pkg/localllm
+(llama.cpp loads bge-m3/Qwen GGUF files; scripts/build-llama.sh pins the
+runtime; neural/export_to_gguf.py produces them). This reader lets the TPU
+build consume the same artifacts: metadata KV + F32/F16/BF16 tensors are
+parsed into numpy arrays (quantized blocks like Q4_K raise — dequantization
+is a later round; bf16/f32 exports cover the TPU serving path).
+
+GGUF v3 layout:
+  magic "GGUF" | u32 version | u64 n_tensors | u64 n_kv
+  kv*: string key | u32 type | value
+  tensor infos*: string name | u32 n_dims | u64 dims[] | u32 dtype | u64 offset
+  padding to `general.alignment` (default 32) | tensor data blob
+"""
+
+from __future__ import annotations
+
+import struct
+from typing import Any, BinaryIO
+
+import numpy as np
+
+MAGIC = b"GGUF"
+
+# metadata value types
+T_U8, T_I8, T_U16, T_I16, T_U32, T_I32, T_F32, T_BOOL = range(8)
+T_STRING, T_ARRAY, T_U64, T_I64, T_F64 = 8, 9, 10, 11, 12
+
+# tensor dtypes (ggml_type)
+GGML_F32, GGML_F16 = 0, 1
+GGML_BF16 = 30
+_SUPPORTED_TENSOR_TYPES = {GGML_F32: np.float32, GGML_F16: np.float16}
+
+_SCALAR_FMT = {
+    T_U8: "<B", T_I8: "<b", T_U16: "<H", T_I16: "<h",
+    T_U32: "<I", T_I32: "<i", T_F32: "<f", T_U64: "<Q",
+    T_I64: "<q", T_F64: "<d",
+}
+
+
+def _read_str(f: BinaryIO) -> str:
+    (n,) = struct.unpack("<Q", f.read(8))
+    return f.read(n).decode("utf-8")
+
+
+def _read_value(f: BinaryIO, vtype: int) -> Any:
+    if vtype == T_BOOL:
+        return f.read(1) != b"\x00"
+    if vtype == T_STRING:
+        return _read_str(f)
+    if vtype == T_ARRAY:
+        (etype,) = struct.unpack("<I", f.read(4))
+        (n,) = struct.unpack("<Q", f.read(8))
+        return [_read_value(f, etype) for _ in range(n)]
+    fmt = _SCALAR_FMT[vtype]
+    return struct.unpack(fmt, f.read(struct.calcsize(fmt)))[0]
+
+
+def _write_str(f: BinaryIO, s: str) -> None:
+    b = s.encode("utf-8")
+    f.write(struct.pack("<Q", len(b)))
+    f.write(b)
+
+
+def _write_value(f: BinaryIO, value: Any) -> None:
+    if isinstance(value, bool):
+        f.write(struct.pack("<I", T_BOOL))
+        f.write(b"\x01" if value else b"\x00")
+    elif isinstance(value, int):
+        f.write(struct.pack("<I", T_I64))
+        f.write(struct.pack("<q", value))
+    elif isinstance(value, float):
+        f.write(struct.pack("<I", T_F32))
+        f.write(struct.pack("<f", value))
+    elif isinstance(value, str):
+        f.write(struct.pack("<I", T_STRING))
+        _write_str(f, value)
+    elif isinstance(value, list):
+        f.write(struct.pack("<I", T_ARRAY))
+        if value and isinstance(value[0], str):
+            f.write(struct.pack("<I", T_STRING))
+            f.write(struct.pack("<Q", len(value)))
+            for v in value:
+                _write_str(f, v)
+        else:
+            f.write(struct.pack("<I", T_F32))
+            f.write(struct.pack("<Q", len(value)))
+            for v in value:
+                f.write(struct.pack("<f", float(v)))
+    else:
+        raise ValueError(f"unsupported metadata value {type(value)}")
+
+
+def load_gguf(path: str, load_tensors: bool = True):
+    """Returns (metadata dict, tensors dict name -> np.ndarray)."""
+    with open(path, "rb") as f:
+        if f.read(4) != MAGIC:
+            raise ValueError("not a GGUF file")
+        (version,) = struct.unpack("<I", f.read(4))
+        if version < 2:
+            raise ValueError(f"unsupported GGUF version {version}")
+        n_tensors, n_kv = struct.unpack("<QQ", f.read(16))
+        metadata: dict[str, Any] = {}
+        for _ in range(n_kv):
+            key = _read_str(f)
+            (vtype,) = struct.unpack("<I", f.read(4))
+            metadata[key] = _read_value(f, vtype)
+        infos = []
+        for _ in range(n_tensors):
+            name = _read_str(f)
+            (n_dims,) = struct.unpack("<I", f.read(4))
+            dims = struct.unpack(f"<{n_dims}Q", f.read(8 * n_dims))
+            dtype, offset = struct.unpack("<IQ", f.read(12))
+            infos.append((name, dims, dtype, offset))
+        tensors: dict[str, np.ndarray] = {}
+        if load_tensors:
+            alignment = int(metadata.get("general.alignment", 32))
+            base = f.tell()
+            base += (-base) % alignment
+            for name, dims, dtype, offset in infos:
+                np_dtype = _SUPPORTED_TENSOR_TYPES.get(dtype)
+                if np_dtype is None:
+                    raise ValueError(
+                        f"tensor {name}: ggml type {dtype} not supported "
+                        "(quantized blocks need dequantization — export "
+                        "f32/f16 for the TPU path)"
+                    )
+                # GGUF dims are innermost-first; numpy wants outermost-first
+                shape = tuple(reversed(dims))
+                count = int(np.prod(shape)) if shape else 1
+                f.seek(base + offset)
+                data = np.frombuffer(
+                    f.read(count * np.dtype(np_dtype).itemsize), dtype=np_dtype
+                )
+                tensors[name] = data.reshape(shape)
+        return metadata, tensors
+
+
+def save_gguf(path: str, metadata: dict[str, Any],
+              tensors: dict[str, np.ndarray]) -> None:
+    """Writer (testing + export parity with neural/export_to_gguf.py)."""
+    alignment = int(metadata.get("general.alignment", 32))
+    with open(path, "wb") as f:
+        f.write(MAGIC)
+        f.write(struct.pack("<I", 3))
+        f.write(struct.pack("<QQ", len(tensors), len(metadata)))
+        for key, value in metadata.items():
+            _write_str(f, key)
+            _write_value(f, value)
+        offset = 0
+        blobs = []
+        for name, arr in tensors.items():
+            arr = np.asarray(arr)
+            if arr.dtype == np.float16:
+                dtype = GGML_F16
+            else:
+                arr = arr.astype(np.float32)
+                dtype = GGML_F32
+            _write_str(f, name)
+            dims = tuple(reversed(arr.shape))
+            f.write(struct.pack("<I", len(dims)))
+            f.write(struct.pack(f"<{len(dims)}Q", *dims))
+            f.write(struct.pack("<IQ", dtype, offset))
+            blob = arr.tobytes()
+            blobs.append(blob)
+            offset += len(blob)
+            offset += (-offset) % alignment
+        pad = (-f.tell()) % alignment
+        f.write(b"\x00" * pad)
+        for blob in blobs:
+            f.write(blob)
+            f.write(b"\x00" * ((-len(blob)) % alignment))
+
+
+def load_params_from_gguf(path: str, template, name_map) -> Any:
+    """Load a GGUF into a params pytree: name_map maps flat param paths
+    (weights.flatten_params keys) -> GGUF tensor names."""
+    from nornicdb_tpu.models.weights import flatten_params, unflatten_params
+
+    _, tensors = load_gguf(path)
+    flat_template = flatten_params(template)
+    flat: dict[str, np.ndarray] = {}
+    for pkey in flat_template:
+        gname = name_map(pkey) if callable(name_map) else name_map.get(pkey)
+        if gname is None or gname not in tensors:
+            raise KeyError(f"GGUF missing tensor for param {pkey!r} ({gname!r})")
+        flat[pkey] = np.asarray(tensors[gname], np.float32)
+    return unflatten_params(flat, template)

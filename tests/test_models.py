@@ -199,3 +199,70 @@ class TestTraining:
             s2, training.shard_batch(batch, mesh)
         )
         assert float(loss1) == pytest.approx(float(loss2), abs=2e-2)
+
+
+class TestGGUF:
+    """(ref: lib/llama/gguf.h, neural/export_to_gguf.py)"""
+
+    def test_metadata_and_tensor_roundtrip(self, tmp_path):
+        from nornicdb_tpu.models import gguf
+
+        meta = {
+            "general.architecture": "bert",
+            "general.name": "test-model",
+            "bert.embedding_length": 128,
+            "bert.block_count": 2,
+            "general.alignment": 32,
+            "tokenizer.ggml.tokens": ["<s>", "</s>", "hello"],
+            "some.float": 1.5,
+            "some.bool": True,
+        }
+        rng = np.random.default_rng(0)
+        tensors = {
+            "token_embd.weight": rng.standard_normal((64, 128)).astype(np.float32),
+            "blk.0.attn_q.weight": rng.standard_normal((128, 128)).astype(np.float16),
+            "output_norm.bias": rng.standard_normal(128).astype(np.float32),
+        }
+        p = str(tmp_path / "m.gguf")
+        gguf.save_gguf(p, meta, tensors)
+        meta2, tensors2 = gguf.load_gguf(p)
+        assert meta2["general.architecture"] == "bert"
+        assert meta2["bert.embedding_length"] == 128
+        assert meta2["tokenizer.ggml.tokens"] == ["<s>", "</s>", "hello"]
+        assert meta2["some.bool"] is True
+        for name, arr in tensors.items():
+            np.testing.assert_array_equal(tensors2[name], arr)
+
+    def test_params_from_gguf(self, tmp_path, qwen_params):
+        from nornicdb_tpu.models import gguf, weights
+
+        flat = weights.flatten_params(qwen_params)
+        tensors = {f"t.{k}": np.asarray(v, np.float32) for k, v in flat.items()}
+        p = str(tmp_path / "qwen.gguf")
+        gguf.save_gguf(p, {"general.architecture": "qwen2"}, tensors)
+        loaded = gguf.load_params_from_gguf(
+            p, qwen_params, lambda k: f"t.{k}"
+        )
+        for a, b in zip(jax.tree.leaves(qwen_params), jax.tree.leaves(loaded)):
+            np.testing.assert_allclose(
+                np.asarray(a, np.float32), np.asarray(b, np.float32), atol=1e-6
+            )
+
+    def test_rejects_quantized(self, tmp_path):
+        from nornicdb_tpu.models import gguf
+        import struct as _s
+
+        p = str(tmp_path / "q.gguf")
+        gguf.save_gguf(p, {}, {"w": np.zeros((4, 4), np.float32)})
+        raw = bytearray(open(p, "rb").read())
+        # patch the tensor dtype field to a quantized type (Q4_0 = 2):
+        # find tensor info: after header+0 kv entries
+        idx = raw.find(b"w\x00") - 7  # name len prefix start
+        # easier: locate dtype by structure — name(8+1) ndims(4) dims(16) dtype(4)
+        base = 4 + 4 + 16  # magic+version+counts
+        name_block = 8 + 1 + 4 + 16
+        dtype_off = base + name_block
+        _s.pack_into("<I", raw, dtype_off, 2)
+        open(p, "wb").write(bytes(raw))
+        with pytest.raises(ValueError, match="not supported"):
+            gguf.load_gguf(p)
