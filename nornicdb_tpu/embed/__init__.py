@@ -1,6 +1,13 @@
 """Embedding pipeline (ref: /root/reference/pkg/embed, pkg/nornicdb/embed_queue.go)."""
 
-from nornicdb_tpu.embed.base import CachedEmbedder, Embedder, HashEmbedder, TPUEmbedder
+from nornicdb_tpu.embed.base import (
+    CachedEmbedder,
+    Embedder,
+    HashEmbedder,
+    OllamaEmbedder,
+    OpenAIEmbedder,
+    TPUEmbedder,
+)
 from nornicdb_tpu.embed.queue import (
     EmbedWorker,
     EmbedWorkerConfig,
@@ -14,6 +21,8 @@ __all__ = [
     "CachedEmbedder",
     "Embedder",
     "HashEmbedder",
+    "OllamaEmbedder",
+    "OpenAIEmbedder",
     "TPUEmbedder",
     "EmbedWorker",
     "EmbedWorkerConfig",

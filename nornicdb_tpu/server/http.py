@@ -129,6 +129,13 @@ class HttpServer:
         self._httpd: Optional[ThreadingHTTPServer] = None
         self._thread: Optional[threading.Thread] = None
 
+    def _retention(self):
+        if getattr(self, "_retention_mgr", None) is None:
+            from nornicdb_tpu.retention import RetentionManager
+
+            self._retention_mgr = RetentionManager(self.db.storage)
+        return self._retention_mgr
+
     @property
     def qdrant(self):
         if self._qdrant is None:
@@ -158,6 +165,10 @@ class HttpServer:
                 self.send_header("Content-Type", content_type)
                 self.send_header("Content-Length", str(len(data)))
                 self.send_header("Access-Control-Allow-Origin", "*")
+                # security headers (ref: pkg/security/middleware.go)
+                self.send_header("X-Content-Type-Options", "nosniff")
+                self.send_header("X-Frame-Options", "DENY")
+                self.send_header("Referrer-Policy", "no-referrer")
                 self.end_headers()
                 self.wfile.write(data)
 
@@ -412,6 +423,37 @@ class HttpServer:
                 body.get("username", ""), body.get("password", "")
             )
             h._send(200, {"token": token})
+            return
+        if path == "/gdpr/export":
+            # GDPR data export (ref: server_router.go /gdpr/export)
+            h._auth("read")
+            body = h._body()
+            subject = body.get("subject", "")
+            if not subject:
+                h._send(400, {"error": "subject required"})
+                return
+            h._send(200, {"subject": subject,
+                          "records": _jsonable(self._retention().export_subject(subject))})
+            return
+        if path == "/gdpr/delete":
+            # GDPR erasure: request -> approve -> execute in one call when
+            # confirm=true (ref: /gdpr/delete + pkg/retention workflow)
+            h._auth("delete")
+            body = h._body()
+            subject = body.get("subject", "")
+            if not subject:
+                h._send(400, {"error": "subject required"})
+                return
+            mgr = self._retention()
+            req = mgr.request_erasure(subject)
+            if not body.get("confirm", False):
+                h._send(202, {"request_id": req.id, "status": req.status,
+                              "note": "re-POST with confirm=true to execute"})
+                return
+            mgr.approve_erasure(req.id)
+            done = mgr.execute_erasure(req.id)
+            h._send(200, {"request_id": done.id, "status": done.status,
+                          "erased": done.erased_count})
             return
         if path == "/auth/oauth/token":
             # OAuth2 token endpoint (ref: pkg/auth/oauth.go; cmd/oauth-provider):

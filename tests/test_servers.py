@@ -526,3 +526,81 @@ class TestEmbeddedUI:
         finally:
             server.stop()
             db.close()
+
+
+class TestGdprEndpoints:
+    def test_export_and_delete_flow(self, http_db):
+        db, server = http_db
+        db.cypher("CREATE (:Doc {owner: 'user-9', content: 'theirs'})")
+        db.cypher("CREATE (:Doc {owner: 'else', content: 'not theirs'})")
+        out = _post(server.port, "/gdpr/export", {"subject": "user-9"})
+        assert len(out["records"]) == 1
+        assert out["records"][0]["properties"]["content"] == "theirs"
+        # two-phase: first call returns a pending request
+        out = _post(server.port, "/gdpr/delete", {"subject": "user-9"})
+        assert out["status"] == "pending"
+        out = _post(server.port, "/gdpr/delete",
+                    {"subject": "user-9", "confirm": True})
+        assert out["status"] == "completed" and out["erased"] == 1
+        assert db.cypher("MATCH (d:Doc) RETURN count(d)").rows == [[1]]
+
+    def test_security_headers(self, http_db):
+        db, server = http_db
+        import urllib.request
+
+        with urllib.request.urlopen(
+            f"http://127.0.0.1:{server.port}/health"
+        ) as resp:
+            assert resp.headers["X-Content-Type-Options"] == "nosniff"
+            assert resp.headers["X-Frame-Options"] == "DENY"
+
+
+class TestHttpEmbedders:
+    def test_ollama_and_openai_against_mock(self):
+        """(ref: pkg/embed HTTP providers) — zero-egress image, so the tests
+        run a local mock server speaking both protocols."""
+        import json as _json
+        from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+        import threading
+
+        class Mock(BaseHTTPRequestHandler):
+            def log_message(self, *a):
+                pass
+
+            def do_POST(self):
+                n = int(self.headers.get("Content-Length", 0))
+                body = _json.loads(self.rfile.read(n))
+                if self.path == "/api/embeddings":
+                    out = {"embedding": [0.1, 0.2, 0.3]}
+                elif self.path == "/v1/embeddings":
+                    assert self.headers["Authorization"] == "Bearer sk-test"
+                    out = {"data": [
+                        {"index": i, "embedding": [float(i), 1.0]}
+                        for i in range(len(body["input"]))
+                    ]}
+                else:
+                    self.send_response(404)
+                    self.end_headers()
+                    return
+                data = _json.dumps(out).encode()
+                self.send_response(200)
+                self.send_header("Content-Length", str(len(data)))
+                self.end_headers()
+                self.wfile.write(data)
+
+        srv = ThreadingHTTPServer(("127.0.0.1", 0), Mock)
+        threading.Thread(target=srv.serve_forever, daemon=True).start()
+        try:
+            from nornicdb_tpu.embed import OllamaEmbedder, OpenAIEmbedder
+
+            ollama = OllamaEmbedder(f"http://127.0.0.1:{srv.server_address[1]}")
+            v = ollama.embed("hi")
+            assert list(v) == pytest.approx([0.1, 0.2, 0.3])
+            assert ollama.dimensions() == 3
+            openai = OpenAIEmbedder(
+                f"http://127.0.0.1:{srv.server_address[1]}", api_key="sk-test"
+            )
+            vs = openai.embed_batch(["a", "b"])
+            assert [list(x) for x in vs] == [[0.0, 1.0], [1.0, 1.0]]
+        finally:
+            srv.shutdown()
