@@ -288,6 +288,9 @@ class CallClause:
 class CallSubquery:
     query: "Query"
     imported: list[str] = field(default_factory=list)
+    # CALL { ... } IN TRANSACTIONS [OF n ROWS]
+    in_transactions: bool = False
+    batch_rows: int = 1000
 
 
 @dataclass

@@ -878,6 +878,8 @@ class CypherExecutor:
         return out
 
     def _call_subquery(self, clause: ast.CallSubquery, rows, params, stats) -> list[dict]:
+        if clause.in_transactions:
+            return self._call_in_transactions(clause, rows, params, stats)
         out = []
         returns = any(
             isinstance(c, ast.ReturnClause) for c in clause.query.clauses
@@ -893,6 +895,46 @@ class CypherExecutor:
                 nr = dict(row)
                 nr.update(dict(zip(res.columns, r)))
                 out.append(nr)
+        return out
+
+    def _call_in_transactions(
+        self, clause: ast.CallSubquery, rows, params, stats
+    ) -> list[dict]:
+        """CALL { ... } IN TRANSACTIONS OF n ROWS — input rows run through
+        the subquery in committed batches (Neo4j ON ERROR FAIL semantics:
+        earlier batches stay committed, the failing batch aborts the query).
+        WAL transaction markers bracket each batch when the storage chain
+        supports them."""
+        out = []
+        returns = any(
+            isinstance(c, ast.ReturnClause) for c in clause.query.clauses
+        )
+        batch = max(clause.batch_rows, 1)
+        tx_begin = getattr(self.storage, "tx_begin", None)
+        tx_commit = getattr(self.storage, "tx_commit", None)
+        for start in range(0, len(rows), batch):
+            chunk = rows[start : start + batch]
+            txid = str(uuid.uuid4())
+            if callable(tx_begin):
+                tx_begin(txid)
+            try:
+                for row in chunk:
+                    res = self._run_query(
+                        clause.query, params, start_rows=[row], stats=stats
+                    )
+                    if returns:
+                        for r in res.rows:
+                            nr = dict(row)
+                            nr.update(dict(zip(res.columns, r)))
+                            out.append(nr)
+                    else:
+                        out.append(row)
+            except Exception:
+                if callable(getattr(self.storage, "tx_rollback", None)):
+                    self.storage.tx_rollback(txid)
+                raise
+            if callable(tx_commit):
+                tx_commit(txid)
         return out
 
     def _foreach(self, clause: ast.ForeachClause, rows, params, stats) -> list[dict]:

@@ -296,6 +296,11 @@ def _binary(e: ast.BinaryOp, ctx: EvalContext) -> Any:
             raise CypherSyntaxError(f"invalid regex: {b!r}")
     if a is None or b is None:
         return None
+    # temporal arithmetic: datetime/date ± duration, duration ± duration
+    if op in ("+", "-") and (_temporal_kind(a) or _temporal_kind(b)):
+        out = _temporal_arith(op, a, b)
+        if out is not None:
+            return out
     if op == "+":
         if isinstance(a, list) and isinstance(b, list):
             return a + b
@@ -331,6 +336,35 @@ def _binary(e: ast.BinaryOp, ctx: EvalContext) -> Any:
     if op == "^":
         return float(a) ** float(b)
     raise CypherTypeError(f"unknown operator {op}")
+
+
+def _temporal_kind(v: Any) -> Optional[str]:
+    if isinstance(v, dict):
+        return v.get("__temporal__")
+    return None
+
+
+def _temporal_arith(op: str, a: Any, b: Any) -> Any:
+    """datetime/date ± duration → datetime/date; duration ± duration →
+    duration; datetime - datetime → duration. None = not a temporal combo
+    (caller falls through to numeric/list semantics)."""
+    from nornicdb_tpu.cypher import temporal_fns as t
+
+    ka, kb = _temporal_kind(a), _temporal_kind(b)
+    if ka in ("datetime", "date") and kb == "duration":
+        ms = a["epochMillis"] + (b["milliseconds"] if op == "+" else -b["milliseconds"])
+        out = t.fn_from_epoch_millis(ms)
+        return t.fn_date(out) if ka == "date" else out
+    if ka == "duration" and kb in ("datetime", "date") and op == "+":
+        return _temporal_arith("+", b, a)
+    if ka == "duration" and kb == "duration":
+        ms = a["milliseconds"] + (b["milliseconds"] if op == "+" else -b["milliseconds"])
+        return t.fn_duration({"seconds": ms / 1000.0})
+    if ka in ("datetime", "date") and kb in ("datetime", "date") and op == "-":
+        return t.fn_duration(
+            {"seconds": (a["epochMillis"] - b["epochMillis"]) / 1000.0}
+        )
+    return None
 
 
 def _cmod(a: int, b: int) -> int:

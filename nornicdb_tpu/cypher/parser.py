@@ -518,7 +518,22 @@ class Parser:
             self.advance()
             inner = self.parse_query()
             self.expect_op("}")
-            return ast.CallSubquery(inner)
+            sub = ast.CallSubquery(inner)
+            # CALL { ... } IN TRANSACTIONS [OF n ROWS]
+            if self.accept_kw("IN"):
+                self.expect_ident_value("transactions")
+                sub.in_transactions = True
+                if self.cur.kind == "KEYWORD" and self.cur.value == "OF":
+                    self.advance()
+                    if self.cur.kind == "NUMBER":
+                        sub.batch_rows = int(self.advance().value)
+                    self.expect_ident_value("rows")
+                elif self.cur.kind == "IDENT" and self.cur.value.lower() == "of":
+                    self.advance()
+                    if self.cur.kind == "NUMBER":
+                        sub.batch_rows = int(self.advance().value)
+                    self.expect_ident_value("rows")
+            return sub
         name = self.expect_ident()
         while self.accept_op("."):
             name += "." + self.expect_ident()

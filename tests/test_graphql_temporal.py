@@ -133,3 +133,63 @@ class TestTemporal:
         ex.execute("CREATE (:E {at: datetime('2024-06-01T12:00:00Z').epochMillis})")
         r = ex.execute("MATCH (e:E) WHERE e.at > 0 RETURN e.at")
         assert r.rows == [[1717243200000]]
+
+
+class TestTemporalArithmetic:
+    @pytest.fixture
+    def ex(self):
+        return CypherExecutor(MemoryEngine())
+
+    def test_datetime_plus_duration(self, ex):
+        r = ex.execute(
+            "RETURN (datetime('2024-01-01T00:00:00Z') + duration('P1DT2H')).iso AS i"
+        )
+        assert r.rows == [["2024-01-02T02:00:00+00:00"]]
+
+    def test_datetime_minus_duration_and_date(self, ex):
+        r = ex.execute(
+            "RETURN (datetime('2024-01-02T00:00:00Z') - duration({hours: 24})).day AS d, "
+            "(date('2024-03-15') + duration({days: 20})).iso AS i"
+        )
+        assert r.rows == [[1, "2024-04-04"]]
+
+    def test_datetime_difference_is_duration(self, ex):
+        r = ex.execute(
+            "RETURN (datetime('2024-01-02T03:00:00Z') - datetime('2024-01-01T00:00:00Z'))"
+            ".milliseconds AS ms"
+        )
+        assert r.rows == [[(27 * 3600) * 1000]]
+
+    def test_duration_sum(self, ex):
+        r = ex.execute(
+            "RETURN (duration({hours: 1}) + duration({minutes: 30})).milliseconds AS ms"
+        )
+        assert r.rows == [[5400000]]
+
+
+class TestCallInTransactions:
+    @pytest.fixture
+    def ex(self):
+        return CypherExecutor(MemoryEngine())
+
+    def test_batched_import(self, ex):
+        r = ex.execute(
+            "UNWIND range(1, 10) AS i "
+            "CALL { CREATE (:Batch {v: i}) } IN TRANSACTIONS OF 3 ROWS "
+            "RETURN count(*) AS n"
+        )
+        assert r.rows == [[10]]
+        assert ex.execute("MATCH (b:Batch) RETURN count(b)").rows == [[10]]
+
+    def test_failure_keeps_committed_batches(self, ex):
+        ex.execute("CREATE CONSTRAINT uq FOR (n:U) REQUIRE n.v IS UNIQUE")
+        ex.schema.attach(ex.storage)
+        with pytest.raises(Exception):
+            ex.execute(
+                "UNWIND [1, 2, 3, 4, 5, 5, 7] AS i "
+                "CALL { CREATE (:U {v: i}) } IN TRANSACTIONS OF 2 ROWS "
+                "RETURN count(*)"
+            )
+        # batches before the duplicate committed; the failing one aborted
+        n = ex.execute("MATCH (u:U) RETURN count(u)").rows[0][0]
+        assert 4 <= n <= 5
