@@ -56,16 +56,39 @@ class QdrantCollections:
         self._corpora[name] = corpus
 
     # -- collections -------------------------------------------------------
-    def create(self, name: str, size: int, distance: str = "Cosine") -> None:
+    def create(self, name: str, size: int = 0, distance: str = "Cosine",
+               named: Optional[dict[str, dict]] = None) -> None:
+        """size/distance for the default vector; `named` maps vector names
+        to {"size", "distance"} for named-vector collections
+        (ref: named-vector support, pkg/qdrantgrpc registry.go)."""
+        named = named or {}
+        with self._lock:
+            self._collections[name] = {
+                "size": int(size), "distance": distance,
+                "named": {k: {"size": int(v.get("size", 0)),
+                              "distance": v.get("distance", "Cosine")}
+                          for k, v in named.items()},
+            }
+            if size:
+                self._corpora[name] = DeviceCorpus(dims=int(size))
+            for vec_name, spec in named.items():
+                self._corpora[f"{name}/{vec_name}"] = DeviceCorpus(
+                    dims=int(spec.get("size", 0)) or 1
+                )
         if self.vectorspaces is not None:
             from nornicdb_tpu.vectorspace import VectorSpaceKey
 
-            self.vectorspaces.register(
-                VectorSpaceKey(f"qdrant:{name}", int(size), distance.lower())
-            )
-        with self._lock:
-            self._collections[name] = {"size": int(size), "distance": distance}
-            self._corpora[name] = DeviceCorpus(dims=int(size))
+            if size:
+                self.vectorspaces.register(
+                    VectorSpaceKey(f"qdrant:{name}", int(size), distance.lower())
+                )
+            for vec_name, spec in named.items():
+                self.vectorspaces.register(
+                    VectorSpaceKey(
+                        f"qdrant:{name}:{vec_name}", int(spec.get("size", 0)),
+                        str(spec.get("distance", "Cosine")).lower(),
+                    )
+                )
 
     def drop(self, name: str) -> bool:
         with self._lock:
@@ -109,10 +132,17 @@ class QdrantCollections:
         with self._lock:
             if collection not in self._collections:
                 raise NotFoundError(f"collection {collection} not found")
-            corpus = self._corpora[collection]
+            corpus = self._corpora.get(collection)
         n = 0
         for p in points:
-            vec = np.asarray(p["vector"], np.float32)
+            raw_vec = p["vector"]
+            named_vecs: dict[str, np.ndarray] = {}
+            vec = None
+            if isinstance(raw_vec, dict):
+                named_vecs = {k: np.asarray(v, np.float32)
+                              for k, v in raw_vec.items()}
+            else:
+                vec = np.asarray(raw_vec, np.float32)
             nid = self._node_id(collection, p["id"])
             payload = p.get("payload") or {}
             node = Node(
@@ -121,6 +151,7 @@ class QdrantCollections:
                 properties={"_collection": collection, "_point_id": p["id"],
                             **payload},
                 embedding=vec,
+                named_embeddings=named_vecs,
             )
             try:
                 self.storage.create_node(node)
@@ -128,8 +159,16 @@ class QdrantCollections:
                 existing = self.storage.get_node(nid)
                 existing.properties = dict(node.properties)
                 existing.embedding = vec
+                existing.named_embeddings = named_vecs
                 self.storage.update_node(existing)
-            corpus.add(nid, vec)
+            if vec is not None and corpus is not None:
+                corpus.add(nid, vec)
+            for vec_name, v in named_vecs.items():
+                nc = self._corpora.get(f"{collection}/{vec_name}")
+                if nc is not None:
+                    if nc.dims != v.shape[0]:
+                        nc = self._corpora[f"{collection}/{vec_name}"] =                             DeviceCorpus(dims=v.shape[0])
+                    nc.add(nid, v)
             n += 1
         return n
 
@@ -151,13 +190,17 @@ class QdrantCollections:
     def search(
         self,
         collection: str,
-        vector: list[float],
+        vector,
         limit: int = 10,
         score_threshold: float = -1.0,
         with_payload: bool = True,
     ) -> list[dict[str, Any]]:
+        key = collection
+        if isinstance(vector, dict):  # named vector: {"name": ..., "vector": [...]}
+            key = f"{collection}/{vector.get('name', '')}"
+            vector = vector.get("vector", [])
         with self._lock:
-            corpus = self._corpora.get(collection)
+            corpus = self._corpora.get(key)
         if corpus is None:
             raise NotFoundError(f"collection {collection} not found")
         res = corpus.search(
@@ -219,9 +262,13 @@ def handle_qdrant(registry: QdrantCollections, method: str, path: str,
         name = m.group(1)
         if method == "PUT":
             vectors = body.get("vectors", {})
-            size = vectors.get("size", body.get("size", 0))
-            distance = vectors.get("distance", "Cosine")
-            registry.create(name, int(size), distance)
+            if isinstance(vectors, dict) and "size" in vectors:
+                registry.create(name, int(vectors["size"]),
+                                vectors.get("distance", "Cosine"))
+            elif isinstance(vectors, dict) and vectors:
+                registry.create(name, named=vectors)  # named-vector config
+            else:
+                registry.create(name, int(body.get("size", 0)))
             return ok(True)
         if method == "GET":
             info = registry.info(name)
@@ -251,4 +298,20 @@ def handle_qdrant(registry: QdrantCollections, method: str, path: str,
     m = re.fullmatch(r"/collections/([^/]+)/points", path)
     if m and method == "POST":
         return ok(registry.retrieve(m.group(1), body.get("ids", [])))
+    m = re.fullmatch(r"/collections/([^/]+)/snapshots", path)
+    if m and method == "POST":
+        # snapshot = Neo4j-JSON export of the collection's points
+        # (ref: snapshots_service.go; storage-level snapshot here)
+        from nornicdb_tpu.storage.io import export_json
+
+        name = m.group(1)
+        if registry.info(name) is None:
+            return 404, {"status": {"error": f"collection {name} not found"}}
+        data = export_json(registry.storage)
+        points = [
+            n for n in data["nodes"]
+            if n["properties"].get("_collection") == name
+        ]
+        return ok({"name": f"{name}-snapshot", "points": points,
+                   "count": len(points)})
     return None

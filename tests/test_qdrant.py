@@ -121,3 +121,30 @@ class TestRateLimiter:
         finally:
             srv.stop()
             db.close()
+
+
+class TestNamedVectors:
+    def test_named_vector_collection(self, server):
+        _req(server.port, "PUT", "/collections/multi",
+             {"vectors": {"text": {"size": 4, "distance": "Cosine"},
+                          "image": {"size": 2, "distance": "Cosine"}}})
+        _req(server.port, "PUT", "/collections/multi/points", {
+            "points": [
+                {"id": 1, "vector": {"text": [1, 0, 0, 0], "image": [1, 0]}},
+                {"id": 2, "vector": {"text": [0, 1, 0, 0], "image": [0, 1]}},
+            ]
+        })
+        out = _req(server.port, "POST", "/collections/multi/points/search",
+                   {"vector": {"name": "image", "vector": [1, 0]}, "limit": 1})
+        assert out["result"][0]["id"] == 1
+        out = _req(server.port, "POST", "/collections/multi/points/search",
+                   {"vector": {"name": "text", "vector": [0, 1, 0, 0]}, "limit": 1})
+        assert out["result"][0]["id"] == 2
+
+    def test_snapshot_endpoint(self, server):
+        _req(server.port, "PUT", "/collections/snap", {"vectors": {"size": 2}})
+        _req(server.port, "PUT", "/collections/snap/points",
+             {"points": [{"id": 5, "vector": [1, 0], "payload": {"k": "v"}}]})
+        out = _req(server.port, "POST", "/collections/snap/snapshots", {})
+        assert out["result"]["count"] == 1
+        assert out["result"]["points"][0]["properties"]["k"] == "v"
