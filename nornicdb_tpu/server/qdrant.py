@@ -19,7 +19,7 @@ from typing import Any, Optional
 
 import numpy as np
 
-from nornicdb_tpu.errors import NotFoundError
+from nornicdb_tpu.errors import NornicError, NotFoundError
 from nornicdb_tpu.ops.similarity import DeviceCorpus
 from nornicdb_tpu.storage.types import Engine, Node
 
@@ -36,24 +36,40 @@ class QdrantCollections:
         self._lock = threading.RLock()
         self._collections: dict[str, dict[str, Any]] = {}
         self._corpora: dict[str, DeviceCorpus] = {}
-        # rebuild registry from persisted points
+        # rebuild registry from persisted points (default AND named vectors)
         for n in storage.get_nodes_by_label(POINT_LABEL):
             coll = n.properties.get("_collection")
-            if coll and coll not in self._collections and n.embedding is not None:
-                self._collections[coll] = {
-                    "size": int(n.embedding.shape[0]),
-                    "distance": "Cosine",
-                }
+            if not coll:
+                continue
+            meta = self._collections.setdefault(
+                coll, {"size": 0, "distance": "Cosine", "named": {}}
+            )
+            if n.embedding is not None and not meta["size"]:
+                meta["size"] = int(n.embedding.shape[0])
+            for vec_name, v in n.named_embeddings.items():
+                meta.setdefault("named", {}).setdefault(
+                    vec_name, {"size": int(v.shape[0]), "distance": "Cosine"}
+                )
         for name in self._collections:
             self._rebuild_corpus(name)
 
     def _rebuild_corpus(self, name: str) -> None:
         info = self._collections[name]
-        corpus = DeviceCorpus(dims=info["size"])
-        for n in self.storage.get_nodes_by_label(POINT_LABEL):
-            if n.properties.get("_collection") == name and n.embedding is not None:
-                corpus.add(n.id, n.embedding)
-        self._corpora[name] = corpus
+        if info.get("size"):
+            corpus = DeviceCorpus(dims=info["size"])
+            for n in self.storage.get_nodes_by_label(POINT_LABEL):
+                if n.properties.get("_collection") == name and n.embedding is not None:
+                    corpus.add(n.id, n.embedding)
+            self._corpora[name] = corpus
+        for vec_name, spec in (info.get("named") or {}).items():
+            nc = DeviceCorpus(dims=int(spec.get("size", 1)) or 1)
+            for n in self.storage.get_nodes_by_label(POINT_LABEL):
+                if n.properties.get("_collection") != name:
+                    continue
+                v = n.named_embeddings.get(vec_name)
+                if v is not None:
+                    nc.add(n.id, v)
+            self._corpora[f"{name}/{vec_name}"] = nc
 
     # -- collections -------------------------------------------------------
     def create(self, name: str, size: int = 0, distance: str = "Cosine",
@@ -94,6 +110,8 @@ class QdrantCollections:
         with self._lock:
             existed = self._collections.pop(name, None) is not None
             self._corpora.pop(name, None)
+            for key in [k for k in self._corpora if k.startswith(f"{name}/")]:
+                self._corpora.pop(key, None)
         for n in list(self.storage.get_nodes_by_label(POINT_LABEL)):
             if n.properties.get("_collection") == name:
                 self.storage.delete_node(n.id)
@@ -165,16 +183,23 @@ class QdrantCollections:
                 corpus.add(nid, vec)
             for vec_name, v in named_vecs.items():
                 nc = self._corpora.get(f"{collection}/{vec_name}")
-                if nc is not None:
-                    if nc.dims != v.shape[0]:
-                        nc = self._corpora[f"{collection}/{vec_name}"] =                             DeviceCorpus(dims=v.shape[0])
-                    nc.add(nid, v)
+                if nc is None:
+                    continue
+                if nc.dims != v.shape[0]:
+                    raise NornicError(
+                        f"vector '{vec_name}' has {v.shape[0]} dims, "
+                        f"collection expects {nc.dims}"
+                    )
+                nc.add(nid, v)
             n += 1
         return n
 
     def delete_points(self, collection: str, ids: list[Any]) -> int:
         with self._lock:
-            corpus = self._corpora.get(collection)
+            corpora = [
+                c for key, c in self._corpora.items()
+                if key == collection or key.startswith(f"{collection}/")
+            ]
         n = 0
         for pid in ids:
             nid = self._node_id(collection, pid)
@@ -183,8 +208,8 @@ class QdrantCollections:
                 n += 1
             except NotFoundError:
                 continue
-            if corpus is not None:
-                corpus.remove(nid)
+            for c in corpora:
+                c.remove(nid)
         return n
 
     def search(
@@ -230,6 +255,18 @@ class QdrantCollections:
                 node = self.storage.get_node(self._node_id(collection, pid))
             except NotFoundError:
                 continue
+            if node.named_embeddings:
+                vector: Any = {
+                    k: v.tolist() for k, v in node.named_embeddings.items()
+                }
+                if node.embedding is not None:
+                    vector[""] = node.embedding.tolist()
+            else:
+                vector = (
+                    node.embedding.tolist()
+                    if node.embedding is not None
+                    else None
+                )
             out.append(
                 {
                     "id": pid,
@@ -237,11 +274,7 @@ class QdrantCollections:
                         k: v for k, v in node.properties.items()
                         if not k.startswith("_")
                     },
-                    "vector": (
-                        node.embedding.tolist()
-                        if node.embedding is not None
-                        else None
-                    ),
+                    "vector": vector,
                 }
             )
         return out
@@ -264,11 +297,14 @@ def handle_qdrant(registry: QdrantCollections, method: str, path: str,
             vectors = body.get("vectors", {})
             if isinstance(vectors, dict) and "size" in vectors:
                 registry.create(name, int(vectors["size"]),
-                                vectors.get("distance", "Cosine"))
-            elif isinstance(vectors, dict) and vectors:
+                                str(vectors.get("distance", "Cosine")))
+            elif isinstance(vectors, dict) and vectors and all(
+                isinstance(v, dict) for v in vectors.values()
+            ):
                 registry.create(name, named=vectors)  # named-vector config
             else:
-                registry.create(name, int(body.get("size", 0)))
+                registry.create(name, int(body.get("size", 0)),
+                                str(body.get("distance", "Cosine")))
             return ok(True)
         if method == "GET":
             info = registry.info(name)
@@ -300,18 +336,30 @@ def handle_qdrant(registry: QdrantCollections, method: str, path: str,
         return ok(registry.retrieve(m.group(1), body.get("ids", [])))
     m = re.fullmatch(r"/collections/([^/]+)/snapshots", path)
     if m and method == "POST":
-        # snapshot = Neo4j-JSON export of the collection's points
-        # (ref: snapshots_service.go; storage-level snapshot here)
-        from nornicdb_tpu.storage.io import export_json
-
+        # snapshot of the collection's points INCLUDING vectors
+        # (ref: snapshots_service.go) — scans only QdrantPoint nodes
         name = m.group(1)
         if registry.info(name) is None:
             return 404, {"status": {"error": f"collection {name} not found"}}
-        data = export_json(registry.storage)
-        points = [
-            n for n in data["nodes"]
-            if n["properties"].get("_collection") == name
-        ]
+        points = []
+        for n in registry.storage.get_nodes_by_label(POINT_LABEL):
+            if n.properties.get("_collection") != name:
+                continue
+            points.append(
+                {
+                    "id": n.properties.get("_point_id"),
+                    "payload": {
+                        k: v for k, v in n.properties.items()
+                        if not k.startswith("_")
+                    },
+                    "vector": (
+                        {k: v.tolist() for k, v in n.named_embeddings.items()}
+                        if n.named_embeddings
+                        else (n.embedding.tolist()
+                              if n.embedding is not None else None)
+                    ),
+                }
+            )
         return ok({"name": f"{name}-snapshot", "points": points,
                    "count": len(points)})
     return None

@@ -147,4 +147,56 @@ class TestNamedVectors:
              {"points": [{"id": 5, "vector": [1, 0], "payload": {"k": "v"}}]})
         out = _req(server.port, "POST", "/collections/snap/snapshots", {})
         assert out["result"]["count"] == 1
-        assert out["result"]["points"][0]["properties"]["k"] == "v"
+        point = out["result"]["points"][0]
+        assert point["payload"]["k"] == "v"
+        assert point["vector"] == [1.0, 0.0]  # snapshots preserve vectors
+
+    def test_named_collection_survives_restart(self):
+        """Named-vector collections rebuild from persisted named_embeddings."""
+        from nornicdb_tpu.server.qdrant import QdrantCollections
+        from nornicdb_tpu.storage import MemoryEngine
+
+        eng = MemoryEngine()
+        reg = QdrantCollections(eng)
+        reg.create("m", named={"text": {"size": 2}})
+        reg.upsert("m", [{"id": 1, "vector": {"text": [1, 0]}}])
+        reg2 = QdrantCollections(eng)  # fresh registry, same storage
+        assert reg2.info("m") is not None
+        out = reg2.search("m", {"name": "text", "vector": [1, 0]}, limit=1)
+        assert out[0]["id"] == 1
+
+    def test_delete_removes_from_named_corpora(self):
+        from nornicdb_tpu.server.qdrant import QdrantCollections
+        from nornicdb_tpu.storage import MemoryEngine
+
+        reg = QdrantCollections(MemoryEngine())
+        reg.create("m", named={"t": {"size": 2}})
+        reg.upsert("m", [{"id": 1, "vector": {"t": [1, 0]}},
+                         {"id": 2, "vector": {"t": [0, 1]}}])
+        reg.delete_points("m", [1])
+        out = reg.search("m", {"name": "t", "vector": [1, 0]}, limit=2)
+        assert [h["id"] for h in out] == [2]
+
+    def test_dims_mismatch_rejected(self):
+        from nornicdb_tpu.errors import NornicError
+        from nornicdb_tpu.server.qdrant import QdrantCollections
+        from nornicdb_tpu.storage import MemoryEngine
+
+        reg = QdrantCollections(MemoryEngine())
+        reg.create("m", named={"t": {"size": 4}})
+        reg.upsert("m", [{"id": 1, "vector": {"t": [1, 0, 0, 0]}}])
+        with pytest.raises(NornicError):
+            reg.upsert("m", [{"id": 2, "vector": {"t": [1, 0]}}])
+        # prior vectors intact
+        out = reg.search("m", {"name": "t", "vector": [1, 0, 0, 0]}, limit=1)
+        assert out[0]["id"] == 1
+
+    def test_retrieve_includes_named_vectors(self):
+        from nornicdb_tpu.server.qdrant import QdrantCollections
+        from nornicdb_tpu.storage import MemoryEngine
+
+        reg = QdrantCollections(MemoryEngine())
+        reg.create("m", named={"t": {"size": 2}})
+        reg.upsert("m", [{"id": 1, "vector": {"t": [1, 0]}}])
+        out = reg.retrieve("m", [1])
+        assert out[0]["vector"] == {"t": [1.0, 0.0]}
