@@ -171,6 +171,106 @@ class CypherExecutor:
             return self._tx_command(stmt)
         raise CypherSyntaxError(f"unsupported statement {type(stmt).__name__}")
 
+    # -- pattern fastpaths (ref: DetectQueryPattern query_patterns.go,
+    # ExecuteOptimized optimized_executors.go — the reference's hottest
+    # shapes skip the general pipeline) ------------------------------------
+    def _try_fastpath(self, q: ast.Query, params: dict) -> Optional[Result]:
+        if q.unions or len(q.clauses) != 2:
+            return None
+        match, ret = q.clauses
+        if not isinstance(match, ast.MatchClause) or match.optional:
+            return None
+        if not isinstance(ret, ast.ReturnClause):
+            return None
+        if (
+            match.where is not None
+            or ret.distinct
+            or ret.order_by
+            or ret.skip is not None
+            or ret.limit is not None
+            or ret.star
+            or len(match.patterns) != 1
+            or len(ret.items) != 1
+        ):
+            return None
+        item = ret.items[0]
+        expr = item.expr
+        if not (
+            isinstance(expr, ast.FunctionCall)
+            and expr.name == "count"
+            and not expr.distinct
+            and len(expr.args) == 1
+        ):
+            return None
+        pattern = match.patterns[0]
+        if pattern.name or pattern.shortest:
+            return None
+        els = pattern.elements
+        arg = expr.args[0]
+
+        def count_result(n: int) -> Result:
+            return Result([item.key], [[n]])
+
+        # MATCH (n[:L]) RETURN count(n|*)
+        if len(els) == 1 and isinstance(els[0], ast.NodePattern):
+            node = els[0]
+            if node.properties is not None or node.where is not None:
+                return None
+            counts_node = (
+                isinstance(arg, ast.Literal) and arg.value == "*"
+            ) or (
+                isinstance(arg, ast.Variable) and arg.name == node.variable
+            )
+            if not counts_node:
+                return None
+            if not node.labels:
+                return count_result(self.storage.node_count())
+            if len(node.labels) == 1:
+                return count_result(
+                    self.storage.count_nodes_by_label(node.labels[0])
+                )
+            seen: set[str] = set()
+            for lbl in node.labels:
+                seen.update(n.id for n in self.storage.get_nodes_by_label(lbl))
+            return count_result(len(seen))
+        # MATCH ()-[r[:T]]->() RETURN count(r|*)
+        if (
+            len(els) == 3
+            and isinstance(els[0], ast.NodePattern)
+            and isinstance(els[1], ast.RelPattern)
+            and isinstance(els[2], ast.NodePattern)
+        ):
+            a, rel, b = els
+            if (
+                a.labels or a.properties or a.where
+                or b.labels or b.properties or b.where
+                or rel.properties or rel.var_length
+                or rel.direction != "out"
+            ):
+                return None
+            counts_rel = (
+                isinstance(arg, ast.Literal) and arg.value == "*"
+            ) or (
+                isinstance(arg, ast.Variable) and arg.name == rel.variable
+            )
+            if not counts_rel:
+                return None
+            if not rel.types:
+                return count_result(self.storage.edge_count())
+            if len(rel.types) == 1:
+                return count_result(
+                    self.storage.count_edges_by_type(rel.types[0])
+                )
+            total = 0
+            seen_e: set[str] = set()
+            for t in rel.types:
+                for edge in self.storage.get_edges_by_type(t):
+                    if edge.id not in seen_e:
+                        seen_e.add(edge.id)
+                        total += 1
+            return count_result(total)
+        return None
+
     # -- query pipeline -----------------------------------------------------------
     def _run_query(
         self,
@@ -203,6 +303,10 @@ class CypherExecutor:
         start_rows: Optional[list[dict]] = None,
         stats: Optional[Stats] = None,
     ) -> Result:
+        if start_rows is None:
+            fast = self._try_fastpath(q, params)
+            if fast is not None:
+                return fast
         rows: list[dict[str, Any]] = (
             [dict(r) for r in start_rows] if start_rows is not None else [{}]
         )

@@ -257,6 +257,14 @@ class AsyncEngine(Engine):
                         delta += 1
             return self.base.edge_count() + delta
 
+    def count_nodes_by_label(self, label: str) -> int:
+        self.flush()
+        return self.base.count_nodes_by_label(label)
+
+    def count_edges_by_type(self, edge_type: str) -> int:
+        self.flush()
+        return self.base.count_edges_by_type(edge_type)
+
     # -- pending embed -----------------------------------------------------
     def mark_pending_embed(self, node_id: str) -> None:
         self.flush()

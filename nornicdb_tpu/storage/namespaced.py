@@ -127,6 +127,16 @@ class NamespacedEngine(Engine):
     def all_edges(self) -> Iterator[Edge]:
         return (self._strip_edge(e) for e in self.base.all_edges() if self._owns(e.id))
 
+    def count_nodes_by_label(self, label: str) -> int:
+        return sum(
+            1 for n in self.base.get_nodes_by_label(label) if self._owns(n.id)
+        )
+
+    def count_edges_by_type(self, edge_type: str) -> int:
+        return sum(
+            1 for e in self.base.get_edges_by_type(edge_type) if self._owns(e.id)
+        )
+
     # -- counts (namespace-scoped) ----------------------------------------
     def node_count(self) -> int:
         return sum(1 for _ in self.all_nodes())

@@ -380,6 +380,14 @@ class SegmentEngine(Engine):
         with self._lock:
             return self._node_count
 
+    def count_nodes_by_label(self, label: str) -> int:
+        with self._lock:
+            return len(self._by_label.get(label, ()))
+
+    def count_edges_by_type(self, edge_type: str) -> int:
+        with self._lock:
+            return len(self._by_type.get(edge_type, ()))
+
     def edge_count(self) -> int:
         with self._lock:
             return self._edge_count

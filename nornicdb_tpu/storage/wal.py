@@ -457,6 +457,12 @@ class WALEngine(Engine):
     def edge_count(self) -> int:
         return self.base.edge_count()
 
+    def count_nodes_by_label(self, label: str) -> int:
+        return self.base.count_nodes_by_label(label)
+
+    def count_edges_by_type(self, edge_type: str) -> int:
+        return self.base.count_edges_by_type(edge_type)
+
     def pending_embed_ids(self, limit: int = 0) -> list[str]:
         return self.base.pending_embed_ids(limit)
 
