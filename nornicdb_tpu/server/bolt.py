@@ -34,6 +34,7 @@ MSG_PULL = 0x3F
 MSG_ROUTE = 0x66
 MSG_LOGON = 0x6A
 MSG_LOGOFF = 0x6B
+MSG_TELEMETRY = 0x54
 MSG_SUCCESS = 0x70
 MSG_RECORD = 0x71
 MSG_IGNORED = 0x7E
@@ -69,6 +70,8 @@ class BoltSession:
             if tag == MSG_LOGOFF:
                 self.authenticated = not self.server.auth_required
                 return [(MSG_SUCCESS, {})]
+            if tag == MSG_TELEMETRY:
+                return [(MSG_SUCCESS, {})]  # 5.4 drivers emit api telemetry
             if tag == MSG_RESET:
                 self.streaming = None
                 self.failed = False

@@ -120,6 +120,7 @@ class HttpServer:
         self.errors = 0
         self.slow_queries = 0
         self.slow_threshold = 1.0
+        self._oauth_codes: dict[str, float] = {}
         self.rate_limiter = (
             RateLimiter(rate_limit, burst=max(int(rate_limit * 2), 1))
             if rate_limit > 0
@@ -278,6 +279,34 @@ class HttpServer:
             from nornicdb_tpu.server.ui import UI_HTML
 
             h._send(200, UI_HTML, content_type="text/html; charset=utf-8")
+            return
+        if path.startswith("/auth/oauth/authorize"):
+            # OAuth2 authorization-code flow, resource-owner-credential
+            # variant (ref: pkg/auth/oauth.go + cmd/oauth-provider): GET
+            # with response_type=code&redirect_uri=... returns a 302 carrying
+            # a short-lived code; exchange at /auth/oauth/token with
+            # grant_type=authorization_code (credentials passed via the
+            # basic-auth header on the exchange).
+            from urllib.parse import parse_qs, urlparse
+
+            q = parse_qs(urlparse(h.path).query)
+            redirect = (q.get("redirect_uri") or [""])[0]
+            state = (q.get("state") or [""])[0]
+            if not redirect or (q.get("response_type") or [""])[0] != "code":
+                h._send(400, {"error": "response_type=code and redirect_uri required"})
+                return
+            import secrets as _secrets
+
+            code = _secrets.token_urlsafe(24)
+            self._oauth_codes[code] = time.time() + 120.0
+            sep = "&" if "?" in redirect else "?"
+            target = f"{redirect}{sep}code={code}"
+            if state:
+                target += f"&state={state}"
+            h.send_response(302)
+            h.send_header("Location", target)
+            h.send_header("Content-Length", "0")
+            h.end_headers()
             return
         if path == "/health":
             h._send(200, {"status": "ok"})
@@ -463,7 +492,17 @@ class HttpServer:
                 h._send(503, {"error": "auth not configured"})
                 return
             grant = body.get("grant_type", "")
-            if grant == "password":
+            if grant == "authorization_code":
+                code = body.get("code", "")
+                expiry = self._oauth_codes.pop(code, 0.0)
+                if expiry < time.time():
+                    h._send(400, {"error": "invalid_grant"})
+                    return
+                token = self.authenticator.authenticate(
+                    body.get("username", body.get("client_id", "")),
+                    body.get("password", body.get("client_secret", "")),
+                )
+            elif grant == "password":
                 token = self.authenticator.authenticate(
                     body.get("username", ""), body.get("password", "")
                 )

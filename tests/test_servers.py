@@ -604,3 +604,59 @@ class TestHttpEmbedders:
             assert [list(x) for x in vs] == [[0.0, 1.0], [1.0, 1.0]]
         finally:
             srv.shutdown()
+
+
+class TestOAuthAuthorizeFlow:
+    def test_code_flow(self):
+        import urllib.parse
+
+        db = nornicdb_tpu.open_db("")
+        auth = Authenticator(MemoryEngine())
+        auth.create_user("app", "apppw", ROLE_ADMIN)
+        server = HttpServer(db, port=0, authenticator=auth, auth_required=True)
+        server.start()
+        try:
+            url = (f"http://127.0.0.1:{server.port}/auth/oauth/authorize"
+                   "?response_type=code&redirect_uri=http://cb.local/done&state=xyz")
+            req = urllib.request.Request(url, method="GET")
+
+            class NoRedirect(urllib.request.HTTPRedirectHandler):
+                def redirect_request(self, *a, **k):
+                    return None
+
+            opener = urllib.request.build_opener(NoRedirect)
+            try:
+                opener.open(req)
+                raise AssertionError("expected 302")
+            except urllib.error.HTTPError as e:
+                assert e.code == 302
+                loc = e.headers["Location"]
+            assert loc.startswith("http://cb.local/done?code=")
+            assert "state=xyz" in loc
+            code = urllib.parse.parse_qs(urllib.parse.urlparse(loc).query)["code"][0]
+            out = _post(server.port, "/auth/oauth/token",
+                        {"grant_type": "authorization_code", "code": code,
+                         "username": "app", "password": "apppw"})
+            assert out["access_token"]
+            # a code is single-use
+            with pytest.raises(urllib.error.HTTPError) as e2:
+                _post(server.port, "/auth/oauth/token",
+                      {"grant_type": "authorization_code", "code": code,
+                       "username": "app", "password": "apppw"})
+            assert e2.value.code == 400
+        finally:
+            server.stop()
+            db.close()
+
+
+class TestBoltTelemetry:
+    def test_telemetry_acknowledged(self, bolt_db):
+        db, server = bolt_db
+        c = _BoltClient(server.port)
+        c.send(0x01, [{"scheme": "none"}])
+        c.recv_message()
+        c.send(0x54, [1])  # TELEMETRY (Bolt 5.4)
+        assert c.recv_message().tag == 0x70
+        cols, rows, _ = c.run("RETURN 1 AS x")  # session still healthy
+        assert rows == [[1]]
+        c.close()
