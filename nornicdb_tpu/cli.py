@@ -63,6 +63,7 @@ def cmd_serve(args) -> int:
         lambda q, p, d: (db.executor_for(d) if d else db.executor).execute(q, p),
         host=args.host, port=args.bolt_port,
         authenticator=authenticator, auth_required=args.auth,
+        session_executor_factory=db.session_executor,
     )
     bolt_server.start()
     print(f"NornicDB-TPU serving: bolt://{args.host}:{bolt_server.port} "

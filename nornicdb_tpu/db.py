@@ -333,6 +333,23 @@ class DB:
                 )
             return self._dbmanager
 
+    def session_executor(self, database: Optional[str] = None):
+        """A FRESH executor with its own explicit-transaction scope, for
+        per-connection sessions (Bolt BEGIN/COMMIT isolation). Shares
+        storage, schema, facade hooks and the query cache."""
+        from nornicdb_tpu.cypher.executor import CypherExecutor
+
+        if database and self.database_manager.resolve(database) != self.default_database:
+            storage = self.database_manager.get_storage(database)
+            from nornicdb_tpu.storage import SchemaManager
+
+            schema = SchemaManager()
+            schema.attach(storage)
+            return CypherExecutor(storage, schema=schema, db=self)
+        cache = self.query_cache if self.config.query_cache_enabled else None
+        return CypherExecutor(self.storage, schema=self.schema, db=self,
+                              cache=cache)
+
     def executor_for(self, database: str):
         """Per-database Cypher executor over the namespaced engine
         (ref: :USE handling executor.go:500-541). Cached under the RESOLVED

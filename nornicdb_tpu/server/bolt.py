@@ -48,6 +48,10 @@ SUPPORTED_VERSIONS = [
 ]
 
 
+def _is_tx_keyword(query: str) -> bool:
+    return query.strip().upper() in ("BEGIN", "COMMIT", "ROLLBACK")
+
+
 class BoltSession:
     """Per-connection state machine (ref: Session server.go:815)."""
 
@@ -59,6 +63,9 @@ class BoltSession:
         self.in_tx = False
         self.failed = False
         self.database: Optional[str] = None
+        # explicit transactions are session-scoped: two connections doing
+        # BEGIN must not share one executor's tx state
+        self._session_executor = None
 
     def handle(self, tag: int, fields: list[Any]) -> list[tuple[int, Any]]:
         """Process one message, return response messages [(tag, metadata)]."""
@@ -197,6 +204,12 @@ class BoltSession:
             self.authenticated = not self.server.auth_required
 
     def _execute(self, query: str, params: dict):
+        factory = self.server.session_executor_factory
+        if factory is not None and (self.in_tx or _is_tx_keyword(query)):
+            # route tx-scoped statements through this session's own executor
+            if self._session_executor is None:
+                self._session_executor = factory(self.database)
+            return self._session_executor.execute(query, params)
         return self.server.executor_fn(query, params, self.database)
 
     def _run(self, fields: list[Any]) -> list[tuple[int, Any]]:
@@ -269,10 +282,14 @@ class BoltServer:
         port: int = 7687,
         authenticator=None,
         auth_required: bool = False,
+        session_executor_factory=None,
     ):
         """executor_fn(query, params, database) -> cypher Result
-        (ref: QueryExecutor interface server.go:249)."""
+        (ref: QueryExecutor interface server.go:249).
+        session_executor_factory(database) -> executor, used to give each
+        connection its own transaction scope (BEGIN/COMMIT isolation)."""
         self.executor_fn = executor_fn
+        self.session_executor_factory = session_executor_factory
         self.host = host
         self.port = port
         self.authenticator = authenticator

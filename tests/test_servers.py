@@ -119,6 +119,7 @@ def bolt_db():
     server = BoltServer(
         lambda q, p, d: (db.executor_for(d) if d else db.executor).execute(q, p),
         port=0,
+        session_executor_factory=db.session_executor,
     )
     server.start()
     yield db, server
@@ -660,3 +661,28 @@ class TestBoltTelemetry:
         cols, rows, _ = c.run("RETURN 1 AS x")  # session still healthy
         assert rows == [[1]]
         c.close()
+
+
+class TestSessionTransactionIsolation:
+    def test_concurrent_begin_on_two_sessions(self, bolt_db):
+        """Two connections holding explicit transactions must not collide
+        (transactions are session-scoped, like Neo4j)."""
+        db, server = bolt_db
+        c1, c2 = _BoltClient(server.port), _BoltClient(server.port)
+        for c in (c1, c2):
+            c.send(0x01, [{"scheme": "none"}])
+            c.recv_message()
+        c1.send(0x11, [{}])  # BEGIN on session 1
+        assert c1.recv_message().tag == 0x70
+        c2.send(0x11, [{}])  # BEGIN on session 2 — must NOT conflict
+        assert c2.recv_message().tag == 0x70
+        c1.run("CREATE (:S1)")
+        c2.run("CREATE (:S2)")
+        c1.send(0x13, [{}])  # ROLLBACK session 1
+        assert c1.recv_message().tag == 0x70
+        c2.send(0x12, [{}])  # COMMIT session 2
+        assert c2.recv_message().tag == 0x70
+        cols, rows, _ = c1.run("MATCH (n) WHERE 'S1' IN labels(n) OR 'S2' IN labels(n) "
+                               "RETURN labels(n)")
+        assert [r[0] for r in rows] == [["S2"]]  # S1 rolled back, S2 kept
+        c1.close(); c2.close()
