@@ -225,3 +225,54 @@ def apoc_help(ex: CypherExecutor, args, row):
 
     prefix = str(args[0]).lower() if args else ""
     return ["name"], [[f] for f in all_functions() if prefix in f]
+
+
+def _trigger_manager(ex: CypherExecutor):
+    mgr = getattr(ex, "_trigger_manager", None)
+    if mgr is None:
+        from nornicdb_tpu.apoc.triggers import TriggerManager
+
+        mgr = ex._trigger_manager = TriggerManager(ex)
+    return mgr
+
+
+@procedure("apoc.trigger.add")
+def apoc_trigger_add(ex: CypherExecutor, args, row):
+    """(ref: apoc/trigger) apoc.trigger.add(name, statement, selector)"""
+    if len(args) < 2:
+        raise CypherSyntaxError("apoc.trigger.add(name, statement, selector)")
+    selector = args[2] if len(args) > 2 and isinstance(args[2], dict) else {}
+    t = _trigger_manager(ex).add(str(args[0]), str(args[1]), selector)
+    return ["name", "query", "paused"], [[t.name, t.statement, t.paused]]
+
+
+@procedure("apoc.trigger.remove")
+def apoc_trigger_remove(ex: CypherExecutor, args, row):
+    removed = _trigger_manager(ex).remove(str(args[0]))
+    return ["name", "removed"], [[str(args[0]), removed]]
+
+
+@procedure("apoc.trigger.removeall")
+def apoc_trigger_remove_all(ex: CypherExecutor, args, row):
+    return ["removed"], [[_trigger_manager(ex).remove_all()]]
+
+
+@procedure("apoc.trigger.pause")
+def apoc_trigger_pause(ex: CypherExecutor, args, row):
+    t = _trigger_manager(ex).pause(str(args[0]), True)
+    return ["name", "paused"], [[str(args[0]), t.paused if t else None]]
+
+
+@procedure("apoc.trigger.resume")
+def apoc_trigger_resume(ex: CypherExecutor, args, row):
+    t = _trigger_manager(ex).pause(str(args[0]), False)
+    return ["name", "paused"], [[str(args[0]), t.paused if t else None]]
+
+
+@procedure("apoc.trigger.list")
+def apoc_trigger_list(ex: CypherExecutor, args, row):
+    return (
+        ["name", "query", "paused", "fired", "errors"],
+        [[t.name, t.statement, t.paused, t.fired, t.errors]
+         for t in _trigger_manager(ex).list()],
+    )

@@ -1,0 +1,103 @@
+"""apoc.trigger — Cypher statements fired by storage events.
+
+Behavioral reference: /root/reference/apoc/trigger — triggers registered as
+(name, cypher, selector); on write events the statement runs with the
+affected entities bound ($createdNodes, $deletedNodes,
+$createdRelationships, $deletedRelationships, $assignedNodeProperties).
+Triggers are paused/resumed/removed by name; nested trigger cascades are
+suppressed (the reference fires triggers post-transaction, not
+recursively).
+"""
+
+from __future__ import annotations
+
+import threading
+from dataclasses import dataclass, field
+from typing import Any, Optional
+
+from nornicdb_tpu.storage.types import Edge, Engine, Node
+
+_EVENT_PARAM = {
+    "node_created": "createdNodes",
+    "node_deleted": "deletedNodes",
+    "node_updated": "assignedNodeProperties",
+    "edge_created": "createdRelationships",
+    "edge_deleted": "deletedRelationships",
+}
+
+
+@dataclass
+class Trigger:
+    name: str
+    statement: str
+    selector: dict[str, Any] = field(default_factory=dict)
+    paused: bool = False
+    fired: int = 0
+    errors: int = 0
+
+
+class TriggerManager:
+    """Holds the trigger registry for one executor + storage pair."""
+
+    def __init__(self, executor):
+        self.executor = executor
+        self._lock = threading.RLock()
+        self._triggers: dict[str, Trigger] = {}
+        self._firing = threading.local()
+        executor.storage.on_event(self._on_event)
+
+    # -- registry -----------------------------------------------------------
+    def add(self, name: str, statement: str,
+            selector: Optional[dict] = None) -> Trigger:
+        with self._lock:
+            t = Trigger(name, statement, selector or {})
+            self._triggers[name] = t
+            return t
+
+    def remove(self, name: str) -> bool:
+        with self._lock:
+            return self._triggers.pop(name, None) is not None
+
+    def remove_all(self) -> int:
+        with self._lock:
+            n = len(self._triggers)
+            self._triggers.clear()
+            return n
+
+    def pause(self, name: str, paused: bool = True) -> Optional[Trigger]:
+        with self._lock:
+            t = self._triggers.get(name)
+            if t is not None:
+                t.paused = paused
+            return t
+
+    def list(self) -> list[Trigger]:
+        with self._lock:
+            return list(self._triggers.values())
+
+    # -- firing --------------------------------------------------------------
+    def _on_event(self, kind: str, entity: Any) -> None:
+        param = _EVENT_PARAM.get(kind)
+        if param is None:
+            return
+        if getattr(self._firing, "active", False):
+            return  # no recursive cascades (ref: post-tx firing)
+        with self._lock:
+            triggers = [t for t in self._triggers.values() if not t.paused]
+        if not triggers:
+            return
+        params: dict[str, Any] = {p: [] for p in _EVENT_PARAM.values()}
+        params[param] = [entity]
+        self._firing.active = True
+        try:
+            for t in triggers:
+                phase = t.selector.get("phase")
+                if phase and phase not in ("after", "afterAsync"):
+                    continue
+                try:
+                    self.executor.execute(t.statement, params)
+                    t.fired += 1
+                except Exception:
+                    t.errors += 1  # a broken trigger must not break writes
+        finally:
+            self._firing.active = False

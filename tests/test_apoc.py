@@ -127,3 +127,52 @@ class TestApocProcedures:
     def test_apoc_help(self, ex):
         r = ex.execute("CALL apoc.help('coll.sum') YIELD name RETURN name")
         assert ["apoc.coll.sum"] in r.rows  # sumLongs also matches the prefix
+
+
+class TestTriggers:
+    """(ref: apoc/trigger category)"""
+
+    def test_trigger_fires_on_create(self, ex):
+        ex.execute(
+            "CALL apoc.trigger.add('stamp', "
+            "'UNWIND $createdNodes AS n MATCH (m) WHERE id(m) = id(n) "
+            "SET m.stamped = true', {}) YIELD name RETURN name"
+        )
+        ex.execute("CREATE (:T {v: 1})")
+        r = ex.execute("MATCH (t:T) RETURN t.stamped")
+        assert r.rows == [[True]]
+        r = ex.execute("CALL apoc.trigger.list() YIELD name, fired RETURN name, fired")
+        assert r.rows[0][0] == "stamp" and r.rows[0][1] >= 1
+
+    def test_no_recursive_cascade(self, ex):
+        ex.execute(
+            "CALL apoc.trigger.add('spawner', "
+            "'CREATE (:Spawned)', {}) YIELD name RETURN name"
+        )
+        ex.execute("CREATE (:Origin)")
+        # the trigger created ONE Spawned; its own create didn't re-fire
+        r = ex.execute("MATCH (s:Spawned) RETURN count(s)")
+        assert r.rows == [[1]]
+
+    def test_pause_resume_remove(self, ex):
+        ex.execute(
+            "CALL apoc.trigger.add('p', 'CREATE (:Fired)', {}) YIELD name RETURN name"
+        )
+        ex.execute("CALL apoc.trigger.pause('p') YIELD name RETURN name")
+        ex.execute("CREATE (:A1)")
+        assert ex.execute("MATCH (f:Fired) RETURN count(f)").rows == [[0]]
+        ex.execute("CALL apoc.trigger.resume('p') YIELD name RETURN name")
+        ex.execute("CREATE (:A2)")
+        assert ex.execute("MATCH (f:Fired) RETURN count(f)").rows == [[1]]
+        ex.execute("CALL apoc.trigger.remove('p') YIELD name RETURN name")
+        ex.execute("CREATE (:A3)")
+        assert ex.execute("MATCH (f:Fired) RETURN count(f)").rows == [[1]]
+
+    def test_broken_trigger_does_not_break_writes(self, ex):
+        ex.execute(
+            "CALL apoc.trigger.add('bad', 'THIS IS NOT CYPHER', {}) YIELD name RETURN name"
+        )
+        ex.execute("CREATE (:Works)")  # must not raise
+        assert ex.execute("MATCH (w:Works) RETURN count(w)").rows == [[1]]
+        r = ex.execute("CALL apoc.trigger.list() YIELD errors RETURN errors")
+        assert r.rows[0][0] >= 1
