@@ -228,12 +228,9 @@ def apoc_help(ex: CypherExecutor, args, row):
 
 
 def _trigger_manager(ex: CypherExecutor):
-    mgr = getattr(ex, "_trigger_manager", None)
-    if mgr is None:
-        from nornicdb_tpu.apoc.triggers import TriggerManager
+    from nornicdb_tpu.apoc.triggers import manager_for
 
-        mgr = ex._trigger_manager = TriggerManager(ex)
-    return mgr
+    return manager_for(ex)  # database-global registry, shared by sessions
 
 
 @procedure("apoc.trigger.add")
@@ -248,8 +245,9 @@ def apoc_trigger_add(ex: CypherExecutor, args, row):
 
 @procedure("apoc.trigger.remove")
 def apoc_trigger_remove(ex: CypherExecutor, args, row):
-    removed = _trigger_manager(ex).remove(str(args[0]))
-    return ["name", "removed"], [[str(args[0]), removed]]
+    if not _trigger_manager(ex).remove(str(args[0])):
+        raise CypherSyntaxError(f"trigger {args[0]!r} not found")
+    return ["name", "removed"], [[str(args[0]), True]]
 
 
 @procedure("apoc.trigger.removeall")
@@ -260,13 +258,17 @@ def apoc_trigger_remove_all(ex: CypherExecutor, args, row):
 @procedure("apoc.trigger.pause")
 def apoc_trigger_pause(ex: CypherExecutor, args, row):
     t = _trigger_manager(ex).pause(str(args[0]), True)
-    return ["name", "paused"], [[str(args[0]), t.paused if t else None]]
+    if t is None:
+        raise CypherSyntaxError(f"trigger {args[0]!r} not found")
+    return ["name", "paused"], [[t.name, t.paused]]
 
 
 @procedure("apoc.trigger.resume")
 def apoc_trigger_resume(ex: CypherExecutor, args, row):
     t = _trigger_manager(ex).pause(str(args[0]), False)
-    return ["name", "paused"], [[str(args[0]), t.paused if t else None]]
+    if t is None:
+        raise CypherSyntaxError(f"trigger {args[0]!r} not found")
+    return ["name", "paused"], [[t.name, t.paused]]
 
 
 @procedure("apoc.trigger.list")

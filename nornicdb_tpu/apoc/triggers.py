@@ -1,12 +1,21 @@
 """apoc.trigger — Cypher statements fired by storage events.
 
-Behavioral reference: /root/reference/apoc/trigger — triggers registered as
-(name, cypher, selector); on write events the statement runs with the
-affected entities bound ($createdNodes, $deletedNodes,
-$createdRelationships, $deletedRelationships, $assignedNodeProperties).
-Triggers are paused/resumed/removed by name; nested trigger cascades are
-suppressed (the reference fires triggers post-transaction, not
-recursively).
+Behavioral reference: /root/reference/apoc/trigger (trigger.go) — triggers
+registered as (name, cypher, selector {label, event, phase}); statements run
+with the affected entities bound ($createdNodes, $deletedNodes,
+$createdRelationships, $deletedRelationships, $assignedNodeProperties,
+$assignedRelationshipProperties). The registry is database-global (one per
+storage engine), shared across sessions.
+
+Known deviations, documented rather than faked:
+  - Firing is synchronous per storage event (the reference batches
+    post-transaction). Phases "before"/"after"/"afterAsync" all fire at
+    the same point; selecting them filters nothing out.
+  - $assignedNodeProperties / $assignedRelationshipProperties carry
+    {key: [{node|relationship, key, new}]} without `old` values — the
+    event stream has no pre-images yet.
+Recursive cascades are suppressed (a trigger's own writes don't re-fire
+triggers), matching the reference's post-tx semantics in effect.
 """
 
 from __future__ import annotations
@@ -23,7 +32,20 @@ _EVENT_PARAM = {
     "node_updated": "assignedNodeProperties",
     "edge_created": "createdRelationships",
     "edge_deleted": "deletedRelationships",
+    "edge_updated": "assignedRelationshipProperties",
 }
+
+# selector {"event": ...} values accepted per event kind (ref: trigger.go)
+_EVENT_NAME = {
+    "node_created": "create",
+    "node_deleted": "delete",
+    "node_updated": "update",
+    "edge_created": "create",
+    "edge_deleted": "delete",
+    "edge_updated": "update",
+}
+
+_PHASES = ("before", "after", "afterAsync")
 
 
 @dataclass
@@ -36,11 +58,26 @@ class Trigger:
     errors: int = 0
 
 
-class TriggerManager:
-    """Holds the trigger registry for one executor + storage pair."""
+def manager_for(executor) -> "TriggerManager":
+    """Database-global registry: ONE manager per storage engine, shared by
+    every session executor (ref: APOC's per-database trigger store)."""
+    storage = executor.storage
+    mgr = getattr(storage, "_apoc_trigger_manager", None)
+    if mgr is None:
+        mgr = TriggerManager(executor)
+        storage._apoc_trigger_manager = mgr
+    return mgr
 
+
+class TriggerManager:
     def __init__(self, executor):
-        self.executor = executor
+        # dedicated executor so trigger statements never share a session's
+        # explicit-transaction state
+        from nornicdb_tpu.cypher.executor import CypherExecutor
+
+        self.executor = CypherExecutor(
+            executor.storage, schema=executor.schema, db=executor.db
+        )
         self._lock = threading.RLock()
         self._triggers: dict[str, Trigger] = {}
         self._firing = threading.local()
@@ -53,6 +90,10 @@ class TriggerManager:
             t = Trigger(name, statement, selector or {})
             self._triggers[name] = t
             return t
+
+    def get(self, name: str) -> Optional[Trigger]:
+        with self._lock:
+            return self._triggers.get(name)
 
     def remove(self, name: str) -> bool:
         with self._lock:
@@ -76,24 +117,63 @@ class TriggerManager:
             return list(self._triggers.values())
 
     # -- firing --------------------------------------------------------------
+    @staticmethod
+    def _matches_selector(t: Trigger, kind: str, entity: Any) -> bool:
+        sel = t.selector or {}
+        want_event = sel.get("event")
+        if want_event and want_event != _EVENT_NAME.get(kind):
+            return False
+        want_label = sel.get("label")
+        if want_label:
+            if isinstance(entity, Node):
+                if want_label not in entity.labels:
+                    return False
+            elif isinstance(entity, Edge):
+                if want_label != entity.type:
+                    return False
+        phase = sel.get("phase")
+        if phase and phase not in _PHASES:
+            return False  # unknown phase: never fire (registration-time typo)
+        return True
+
+    @staticmethod
+    def _params_for(kind: str, entity: Any) -> dict[str, Any]:
+        params: dict[str, Any] = {
+            "createdNodes": [], "deletedNodes": [],
+            "createdRelationships": [], "deletedRelationships": [],
+            "assignedNodeProperties": {}, "assignedRelationshipProperties": {},
+        }
+        param = _EVENT_PARAM[kind]
+        if kind == "node_updated":
+            params[param] = {
+                k: [{"node": entity, "key": k, "new": v}]
+                for k, v in entity.properties.items()
+            }
+        elif kind == "edge_updated":
+            params[param] = {
+                k: [{"relationship": entity, "key": k, "new": v}]
+                for k, v in entity.properties.items()
+            }
+        else:
+            params[param] = [entity]
+        return params
+
     def _on_event(self, kind: str, entity: Any) -> None:
-        param = _EVENT_PARAM.get(kind)
-        if param is None:
+        if kind not in _EVENT_PARAM:
             return
         if getattr(self._firing, "active", False):
-            return  # no recursive cascades (ref: post-tx firing)
+            return  # no recursive cascades
         with self._lock:
-            triggers = [t for t in self._triggers.values() if not t.paused]
+            triggers = [
+                t for t in self._triggers.values()
+                if not t.paused and self._matches_selector(t, kind, entity)
+            ]
         if not triggers:
             return
-        params: dict[str, Any] = {p: [] for p in _EVENT_PARAM.values()}
-        params[param] = [entity]
+        params = self._params_for(kind, entity)
         self._firing.active = True
         try:
             for t in triggers:
-                phase = t.selector.get("phase")
-                if phase and phase not in ("after", "afterAsync"):
-                    continue
                 try:
                     self.executor.execute(t.statement, params)
                     t.fired += 1

@@ -176,3 +176,45 @@ class TestTriggers:
         assert ex.execute("MATCH (w:Works) RETURN count(w)").rows == [[1]]
         r = ex.execute("CALL apoc.trigger.list() YIELD errors RETURN errors")
         assert r.rows[0][0] >= 1
+
+    def test_selector_label_and_event(self, ex):
+        ex.execute(
+            "CALL apoc.trigger.add('scoped', 'CREATE (:Hit)', "
+            "{label: 'Watched', event: 'create'}) YIELD name RETURN name"
+        )
+        ex.execute("CREATE (:Other)")  # wrong label: no fire
+        assert ex.execute("MATCH (h:Hit) RETURN count(h)").rows == [[0]]
+        ex.execute("CREATE (:Watched)")
+        assert ex.execute("MATCH (h:Hit) RETURN count(h)").rows == [[1]]
+        ex.execute("MATCH (w:Watched) SET w.x = 1")  # update, not create
+        assert ex.execute("MATCH (h:Hit) RETURN count(h)").rows == [[1]]
+
+    def test_registry_is_database_global(self, ex):
+        from nornicdb_tpu.cypher import CypherExecutor as CE
+
+        ex.execute("CALL apoc.trigger.add('global', 'CREATE (:G)', {}) "
+                   "YIELD name RETURN name")
+        other = CE(ex.storage, schema=ex.schema)  # a second "session"
+        r = other.execute("CALL apoc.trigger.list() YIELD name RETURN name")
+        assert ["global"] in r.rows
+        other.execute("CALL apoc.trigger.remove('global') YIELD name RETURN name")
+        assert ex.execute("CALL apoc.trigger.list() YIELD name RETURN name").rows == []
+
+    def test_missing_trigger_errors(self, ex):
+        from nornicdb_tpu.errors import CypherSyntaxError as E
+
+        with pytest.raises(E):
+            ex.execute("CALL apoc.trigger.remove('nope') YIELD name RETURN name")
+        with pytest.raises(E):
+            ex.execute("CALL apoc.trigger.pause('nope') YIELD name RETURN name")
+
+    def test_assigned_properties_shape(self, ex):
+        ex.execute(
+            "CALL apoc.trigger.add('props', "
+            "'UNWIND keys($assignedNodeProperties) AS k "
+            "CREATE (:Seen {key: k})', {event: 'update'}) YIELD name RETURN name"
+        )
+        ex.execute("CREATE (:P2 {a: 1})")
+        ex.execute("MATCH (p:P2) SET p.b = 2")
+        keys = {r[0] for r in ex.execute("MATCH (s:Seen) RETURN s.key").rows}
+        assert "b" in keys  # APOC-shaped {key: [...]} map
