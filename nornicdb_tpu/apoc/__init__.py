@@ -1,10 +1,12 @@
 """APOC-compatible function/procedure library (ref: /root/reference/apoc/ —
 850+ functions in ~45 categories; this build implements the core categories:
 coll, text, map, math, number, convert, json, date, temporal, hashing, meta,
-label, node, rel, any, util, create, merge, refactor, neighbors, path,
-periodic)."""
+label, node, rel, any, util, bitwise, diff, stats, spatial, scoring, xml,
+create, merge, refactor, neighbors, path, periodic, trigger, cypher, schema,
+nodes, log)."""
 
 from nornicdb_tpu.apoc import functions as _functions  # noqa: F401 — registers
+from nornicdb_tpu.apoc import functions_ext as _functions_ext  # noqa: F401
 from nornicdb_tpu.apoc.registry import all_functions, call, categories, lookup
 
 __all__ = ["all_functions", "call", "categories", "lookup"]

@@ -640,23 +640,6 @@ def convert_from_json_list(s):
     return v if isinstance(v, list) else None
 
 
-@register("apoc.json.path", category="json")
-def json_path(s, path):
-    """Minimal $.a.b[0] JSON path."""
-    v = _json.loads(s) if isinstance(s, str) else s
-    for part in re.findall(r"\.(\w+)|\[(\d+)\]", path):
-        key, idx = part
-        if key:
-            if not isinstance(v, dict):
-                return None
-            v = v.get(key)
-        else:
-            if not isinstance(v, list) or int(idx) >= len(v):
-                return None
-            v = v[int(idx)]
-    return v
-
-
 # ================================================================= date
 @register("apoc.date.format")
 def date_format(epoch, unit="ms", fmt="yyyy-MM-dd HH:mm:ss"):

@@ -1,0 +1,914 @@
+"""APOC extended pure-function categories.
+
+Behavioral reference: /root/reference/apoc/{bitwise,json,diff,stats,
+spatial,scoring,xml}/ — each is a Go package of pure helpers
+(bitwise/bitwise.go, json/json.go, diff/diff.go, stats/stats.go,
+spatial/spatial.go, scoring/scoring.go, xml/xml.go). Reimplemented from
+observed behavior; signatures follow the APOC dotted-name convention and
+null-in/null-out semantics used throughout functions.py.
+"""
+
+from __future__ import annotations
+
+import json as _json
+import math as _math
+import re
+import statistics
+import xml.etree.ElementTree as _ET
+from typing import Any, Optional
+
+from nornicdb_tpu.apoc.registry import register
+
+# ---------------------------------------------------------------------------
+# apoc.bitwise.* (ref: apoc/bitwise/bitwise.go — Op/And/Or/Xor/shifts/bits)
+# ---------------------------------------------------------------------------
+
+
+@register("apoc.bitwise.op")
+def bitwise_op(a, op, b):
+    if a is None or b is None:
+        return None
+    a, b = int(a), int(b)
+    op = str(op).upper()
+    if op in ("&", "AND"):
+        return a & b
+    if op in ("|", "OR"):
+        return a | b
+    if op in ("^", "XOR"):
+        return a ^ b
+    if op in ("<<", "LEFT_SHIFT", "LEFT SHIFT"):
+        return a << b
+    if op in (">>", "RIGHT_SHIFT", "RIGHT SHIFT"):
+        return a >> b
+    if op in ("~", "NOT"):
+        return ~a
+    return 0
+
+
+@register("apoc.bitwise.and")
+def bitwise_and(*values):
+    vals = values[0] if len(values) == 1 and isinstance(values[0], list) else values
+    if not vals:
+        return 0
+    out = int(vals[0])
+    for v in vals[1:]:
+        out &= int(v)
+    return out
+
+
+@register("apoc.bitwise.or")
+def bitwise_or(*values):
+    vals = values[0] if len(values) == 1 and isinstance(values[0], list) else values
+    out = 0
+    for v in vals:
+        out |= int(v)
+    return out
+
+
+@register("apoc.bitwise.xor")
+def bitwise_xor(*values):
+    vals = values[0] if len(values) == 1 and isinstance(values[0], list) else values
+    out = 0
+    for v in vals:
+        out ^= int(v)
+    return out
+
+
+@register("apoc.bitwise.not")
+def bitwise_not(a):
+    return None if a is None else ~int(a)
+
+
+@register("apoc.bitwise.leftShift")
+def bitwise_lshift(a, n):
+    return None if a is None else int(a) << int(n)
+
+
+@register("apoc.bitwise.rightShift")
+def bitwise_rshift(a, n):
+    return None if a is None else int(a) >> int(n)
+
+
+@register("apoc.bitwise.setBit")
+def bitwise_set_bit(a, pos):
+    return None if a is None else int(a) | (1 << int(pos))
+
+
+@register("apoc.bitwise.clearBit")
+def bitwise_clear_bit(a, pos):
+    return None if a is None else int(a) & ~(1 << int(pos))
+
+
+@register("apoc.bitwise.toggleBit")
+def bitwise_toggle_bit(a, pos):
+    return None if a is None else int(a) ^ (1 << int(pos))
+
+
+@register("apoc.bitwise.testBit")
+def bitwise_test_bit(a, pos):
+    return None if a is None else bool(int(a) & (1 << int(pos)))
+
+
+@register("apoc.bitwise.countBits")
+def bitwise_count_bits(a):
+    if a is None:
+        return None
+    v = int(a)
+    return bin(v & 0xFFFFFFFFFFFFFFFF).count("1") if v < 0 else bin(v).count("1")
+
+
+# ---------------------------------------------------------------------------
+# apoc.json.* (ref: apoc/json/json.go — Path/Validate/Parse/Stringify/…)
+# ---------------------------------------------------------------------------
+
+
+def _json_path(obj: Any, path: str) -> Any:
+    """Dotted/bracket path: `a.b[0].c` (ref json.go Path). `$.` prefix ok."""
+    if path.startswith("$"):
+        path = path[1:].lstrip(".")
+    cur = obj
+    for part in re.findall(r"[^.\[\]]+|\[\d+\]", path):
+        if cur is None:
+            return None
+        if part.startswith("["):
+            idx = int(part[1:-1])
+            if not isinstance(cur, list) or idx >= len(cur):
+                return None
+            cur = cur[idx]
+        else:
+            if isinstance(cur, dict):
+                cur = cur.get(part)
+            elif isinstance(cur, list) and part.isdigit():
+                i = int(part)
+                cur = cur[i] if i < len(cur) else None
+            else:
+                return None
+    return cur
+
+
+@register("apoc.json.path")
+def json_path(value, path):
+    if value is None:
+        return None
+    obj = _json.loads(value) if isinstance(value, str) else value
+    return _json_path(obj, str(path or ""))
+
+
+@register("apoc.json.validate")
+def json_validate(s):
+    if s is None:
+        return False
+    try:
+        _json.loads(s)
+        return True
+    except (ValueError, TypeError):
+        return False
+
+
+@register("apoc.json.parse")
+def json_parse(s):
+    return None if s is None else _json.loads(s)
+
+
+@register("apoc.json.stringify")
+def json_stringify(v):
+    return _json.dumps(v, default=str)
+
+
+@register("apoc.json.pretty")
+def json_pretty(v):
+    obj = _json.loads(v) if isinstance(v, str) else v
+    return _json.dumps(obj, indent=2, default=str)
+
+
+@register("apoc.json.compact")
+def json_compact(v):
+    obj = _json.loads(v) if isinstance(v, str) else v
+    return _json.dumps(obj, separators=(",", ":"), default=str)
+
+
+@register("apoc.json.keys")
+def json_keys(v):
+    obj = _json.loads(v) if isinstance(v, str) else v
+    return sorted(obj.keys()) if isinstance(obj, dict) else []
+
+
+@register("apoc.json.size")
+def json_size(v):
+    obj = _json.loads(v) if isinstance(v, str) else v
+    if isinstance(obj, (dict, list, str)):
+        return len(obj)
+    return 0
+
+
+@register("apoc.json.merge")
+def json_merge(a, b):
+    da = _json.loads(a) if isinstance(a, str) else dict(a or {})
+    db = _json.loads(b) if isinstance(b, str) else dict(b or {})
+    return {**da, **db}
+
+
+@register("apoc.json.flatten")
+def json_flatten(v, delimiter="."):
+    """{"a": {"b": 1}} -> {"a.b": 1} (ref json.go Flatten)."""
+    obj = _json.loads(v) if isinstance(v, str) else v
+    out: dict[str, Any] = {}
+
+    def walk(prefix, node):
+        if isinstance(node, dict) and node:
+            for k, val in node.items():
+                walk(f"{prefix}{delimiter}{k}" if prefix else str(k), val)
+        elif isinstance(node, list) and node:
+            for i, val in enumerate(node):
+                walk(f"{prefix}[{i}]", val)
+        else:
+            out[prefix] = node
+
+    walk("", obj)
+    return out
+
+
+@register("apoc.json.set")
+def json_set(v, path, value):
+    obj = _json.loads(v) if isinstance(v, str) else dict(v or {})
+    parts = str(path).split(".")
+    cur = obj
+    for p in parts[:-1]:
+        nxt = cur.get(p)
+        if not isinstance(nxt, dict):
+            nxt = {}
+            cur[p] = nxt
+        cur = nxt
+    cur[parts[-1]] = value
+    return obj
+
+
+@register("apoc.json.delete")
+def json_delete(v, path):
+    obj = _json.loads(v) if isinstance(v, str) else dict(v or {})
+    parts = str(path).split(".")
+    cur = obj
+    for p in parts[:-1]:
+        cur = cur.get(p) if isinstance(cur, dict) else None
+        if cur is None:
+            return obj
+    if isinstance(cur, dict):
+        cur.pop(parts[-1], None)
+    return obj
+
+
+# ---------------------------------------------------------------------------
+# apoc.diff.* (ref: apoc/diff/diff.go — Nodes/Maps/Lists/Strings)
+# ---------------------------------------------------------------------------
+
+
+def _props_of(x) -> dict:
+    return dict(getattr(x, "properties", x) or {})
+
+
+@register("apoc.diff.nodes")
+def diff_nodes(a, b):
+    """{leftOnly, rightOnly, inCommon, different} (ref diff.go Nodes)."""
+    return diff_maps(_props_of(a), _props_of(b))
+
+
+@register("apoc.diff.relationships")
+def diff_relationships(a, b):
+    return diff_maps(_props_of(a), _props_of(b))
+
+
+@register("apoc.diff.maps")
+def diff_maps(a, b):
+    a, b = dict(a or {}), dict(b or {})
+    left_only = {k: v for k, v in a.items() if k not in b}
+    right_only = {k: v for k, v in b.items() if k not in a}
+    in_common = {k: v for k, v in a.items() if k in b and b[k] == v}
+    different = {
+        k: {"left": a[k], "right": b[k]}
+        for k in a
+        if k in b and b[k] != a[k]
+    }
+    return {
+        "leftOnly": left_only,
+        "rightOnly": right_only,
+        "inCommon": in_common,
+        "different": different,
+    }
+
+
+@register("apoc.diff.lists")
+def diff_lists(a, b):
+    a, b = list(a or []), list(b or [])
+    return {
+        "leftOnly": [x for x in a if x not in b],
+        "rightOnly": [x for x in b if x not in a],
+        "inCommon": [x for x in a if x in b],
+    }
+
+
+@register("apoc.diff.strings")
+def diff_strings(a, b):
+    if a is None or b is None:
+        return None
+    a, b = str(a), str(b)
+    prefix = 0
+    while prefix < min(len(a), len(b)) and a[prefix] == b[prefix]:
+        prefix += 1
+    suffix = 0
+    while (
+        suffix < min(len(a), len(b)) - prefix
+        and a[len(a) - 1 - suffix] == b[len(b) - 1 - suffix]
+    ):
+        suffix += 1
+    return {
+        "equal": a == b,
+        "commonPrefix": a[:prefix],
+        "commonSuffix": a[len(a) - suffix :] if suffix else "",
+        "leftDelta": a[prefix : len(a) - suffix],
+        "rightDelta": b[prefix : len(b) - suffix],
+    }
+
+
+# ---------------------------------------------------------------------------
+# apoc.stats.* (ref: apoc/stats/stats.go — Mean/Median/StdDev/…/Histogram)
+# ---------------------------------------------------------------------------
+
+
+def _nums(xs) -> list[float]:
+    return [float(x) for x in (xs or []) if x is not None]
+
+
+@register("apoc.stats.mean")
+def stats_mean(xs):
+    v = _nums(xs)
+    return statistics.fmean(v) if v else None
+
+
+@register("apoc.stats.median")
+def stats_median(xs):
+    v = _nums(xs)
+    return statistics.median(v) if v else None
+
+
+@register("apoc.stats.mode")
+def stats_mode(xs):
+    v = _nums(xs)
+    return statistics.mode(v) if v else None
+
+
+@register("apoc.stats.stdev")
+def stats_stdev(xs, population=False):
+    v = _nums(xs)
+    if len(v) < 2:
+        return 0.0 if v else None
+    return statistics.pstdev(v) if population else statistics.stdev(v)
+
+
+@register("apoc.stats.variance")
+def stats_variance(xs, population=False):
+    v = _nums(xs)
+    if len(v) < 2:
+        return 0.0 if v else None
+    return statistics.pvariance(v) if population else statistics.variance(v)
+
+
+@register("apoc.stats.percentile")
+def stats_percentile(xs, p):
+    """Linear-interpolation percentile, p in [0,1] or [0,100]."""
+    v = sorted(_nums(xs))
+    if not v:
+        return None
+    p = float(p)
+    if p > 1.0:
+        p /= 100.0
+    idx = p * (len(v) - 1)
+    lo, hi = int(_math.floor(idx)), int(_math.ceil(idx))
+    if lo == hi:
+        return v[lo]
+    return v[lo] + (v[hi] - v[lo]) * (idx - lo)
+
+
+@register("apoc.stats.quartiles")
+def stats_quartiles(xs):
+    v = _nums(xs)
+    if not v:
+        return None
+    return {
+        "q1": stats_percentile(v, 0.25),
+        "q2": stats_percentile(v, 0.5),
+        "q3": stats_percentile(v, 0.75),
+    }
+
+
+@register("apoc.stats.iqr")
+def stats_iqr(xs):
+    q = stats_quartiles(xs)
+    return None if q is None else q["q3"] - q["q1"]
+
+
+@register("apoc.stats.zscore")
+def stats_zscore(xs):
+    v = _nums(xs)
+    if len(v) < 2:
+        return [0.0] * len(v)
+    mu, sd = statistics.fmean(v), statistics.pstdev(v)
+    if sd == 0:
+        return [0.0] * len(v)
+    return [(x - mu) / sd for x in v]
+
+
+@register("apoc.stats.normalize")
+def stats_normalize(xs):
+    """Min-max normalize into [0,1]."""
+    v = _nums(xs)
+    if not v:
+        return []
+    lo, hi = min(v), max(v)
+    if hi == lo:
+        return [0.0] * len(v)
+    return [(x - lo) / (hi - lo) for x in v]
+
+
+@register("apoc.stats.skewness")
+def stats_skewness(xs):
+    v = _nums(xs)
+    if len(v) < 3:
+        return None
+    mu, sd = statistics.fmean(v), statistics.pstdev(v)
+    if sd == 0:
+        return 0.0
+    return sum(((x - mu) / sd) ** 3 for x in v) / len(v)
+
+
+@register("apoc.stats.kurtosis")
+def stats_kurtosis(xs):
+    """Excess kurtosis (normal -> 0)."""
+    v = _nums(xs)
+    if len(v) < 4:
+        return None
+    mu, sd = statistics.fmean(v), statistics.pstdev(v)
+    if sd == 0:
+        return 0.0
+    return sum(((x - mu) / sd) ** 4 for x in v) / len(v) - 3.0
+
+
+@register("apoc.stats.correlation")
+def stats_correlation(xs, ys):
+    a, b = _nums(xs), _nums(ys)
+    if len(a) != len(b) or len(a) < 2:
+        return None
+    try:
+        return statistics.correlation(a, b)
+    except statistics.StatisticsError:
+        return None
+
+
+@register("apoc.stats.covariance")
+def stats_covariance(xs, ys):
+    a, b = _nums(xs), _nums(ys)
+    if len(a) != len(b) or len(a) < 2:
+        return None
+    return statistics.covariance(a, b)
+
+
+@register("apoc.stats.histogram")
+def stats_histogram(xs, bins=10):
+    v = _nums(xs)
+    if not v:
+        return []
+    lo, hi = min(v), max(v)
+    bins = max(1, int(bins))
+    width = (hi - lo) / bins or 1.0
+    counts = [0] * bins
+    for x in v:
+        idx = min(int((x - lo) / width), bins - 1)
+        counts[idx] += 1
+    return [
+        {"min": lo + i * width, "max": lo + (i + 1) * width, "count": c}
+        for i, c in enumerate(counts)
+    ]
+
+
+@register("apoc.stats.outliers")
+def stats_outliers(xs):
+    """IQR-fence outliers (ref stats.go Outliers)."""
+    v = _nums(xs)
+    q = stats_quartiles(v)
+    if q is None:
+        return []
+    iqr = q["q3"] - q["q1"]
+    lo, hi = q["q1"] - 1.5 * iqr, q["q3"] + 1.5 * iqr
+    return [x for x in v if x < lo or x > hi]
+
+
+@register("apoc.stats.summary")
+def stats_summary(xs):
+    v = _nums(xs)
+    if not v:
+        return None
+    return {
+        "count": len(v),
+        "min": min(v),
+        "max": max(v),
+        "sum": sum(v),
+        "mean": statistics.fmean(v),
+        "median": statistics.median(v),
+        "stdev": statistics.pstdev(v) if len(v) > 1 else 0.0,
+    }
+
+
+# ---------------------------------------------------------------------------
+# apoc.spatial.* (ref: apoc/spatial/spatial.go — haversine/bearing/geohash)
+# ---------------------------------------------------------------------------
+
+_EARTH_R_M = 6371008.8  # mean earth radius, meters
+
+
+def _latlon(p) -> tuple[float, float]:
+    if isinstance(p, dict):
+        return float(p.get("latitude", p.get("lat", 0.0))), float(
+            p.get("longitude", p.get("lon", p.get("lng", 0.0)))
+        )
+    lat, lon = p
+    return float(lat), float(lon)
+
+
+@register("apoc.spatial.distance")
+def spatial_distance(p1, p2):
+    """Haversine great-circle distance in meters."""
+    if p1 is None or p2 is None:
+        return None
+    lat1, lon1 = _latlon(p1)
+    lat2, lon2 = _latlon(p2)
+    phi1, phi2 = _math.radians(lat1), _math.radians(lat2)
+    dphi = _math.radians(lat2 - lat1)
+    dlam = _math.radians(lon2 - lon1)
+    a = (
+        _math.sin(dphi / 2) ** 2
+        + _math.cos(phi1) * _math.cos(phi2) * _math.sin(dlam / 2) ** 2
+    )
+    return 2 * _EARTH_R_M * _math.asin(_math.sqrt(a))
+
+
+@register("apoc.spatial.bearing")
+def spatial_bearing(p1, p2):
+    """Initial bearing in degrees [0, 360)."""
+    if p1 is None or p2 is None:
+        return None
+    lat1, lon1 = _latlon(p1)
+    lat2, lon2 = _latlon(p2)
+    phi1, phi2 = _math.radians(lat1), _math.radians(lat2)
+    dlam = _math.radians(lon2 - lon1)
+    y = _math.sin(dlam) * _math.cos(phi2)
+    x = _math.cos(phi1) * _math.sin(phi2) - _math.sin(phi1) * _math.cos(
+        phi2
+    ) * _math.cos(dlam)
+    return (_math.degrees(_math.atan2(y, x)) + 360.0) % 360.0
+
+
+@register("apoc.spatial.destination")
+def spatial_destination(p, distance_m, bearing_deg):
+    if p is None:
+        return None
+    lat, lon = _latlon(p)
+    phi1, lam1 = _math.radians(lat), _math.radians(lon)
+    delta = float(distance_m) / _EARTH_R_M
+    theta = _math.radians(float(bearing_deg))
+    phi2 = _math.asin(
+        _math.sin(phi1) * _math.cos(delta)
+        + _math.cos(phi1) * _math.sin(delta) * _math.cos(theta)
+    )
+    lam2 = lam1 + _math.atan2(
+        _math.sin(theta) * _math.sin(delta) * _math.cos(phi1),
+        _math.cos(delta) - _math.sin(phi1) * _math.sin(phi2),
+    )
+    return {
+        "latitude": _math.degrees(phi2),
+        "longitude": (_math.degrees(lam2) + 540.0) % 360.0 - 180.0,
+    }
+
+
+@register("apoc.spatial.midpoint")
+def spatial_midpoint(p1, p2):
+    if p1 is None or p2 is None:
+        return None
+    d = spatial_distance(p1, p2)
+    b = spatial_bearing(p1, p2)
+    return spatial_destination(p1, d / 2.0, b)
+
+
+@register("apoc.spatial.boundingBox")
+def spatial_bbox(points):
+    pts = [_latlon(p) for p in (points or []) if p is not None]
+    if not pts:
+        return None
+    lats = [p[0] for p in pts]
+    lons = [p[1] for p in pts]
+    return {
+        "minLatitude": min(lats),
+        "maxLatitude": max(lats),
+        "minLongitude": min(lons),
+        "maxLongitude": max(lons),
+    }
+
+
+@register("apoc.spatial.within")
+def spatial_within(p, bbox):
+    if p is None or bbox is None:
+        return None
+    lat, lon = _latlon(p)
+    return (
+        bbox["minLatitude"] <= lat <= bbox["maxLatitude"]
+        and bbox["minLongitude"] <= lon <= bbox["maxLongitude"]
+    )
+
+
+@register("apoc.spatial.withinDistance")
+def spatial_within_distance(p1, p2, max_m):
+    d = spatial_distance(p1, p2)
+    return None if d is None else d <= float(max_m)
+
+
+@register("apoc.spatial.centroid")
+def spatial_centroid(points):
+    pts = [_latlon(p) for p in (points or []) if p is not None]
+    if not pts:
+        return None
+    return {
+        "latitude": sum(p[0] for p in pts) / len(pts),
+        "longitude": sum(p[1] for p in pts) / len(pts),
+    }
+
+
+_GEOHASH32 = "0123456789bcdefghjkmnpqrstuvwxyz"
+
+
+@register("apoc.spatial.encodeGeohash")
+def spatial_encode_geohash(p, precision=9):
+    if p is None:
+        return None
+    lat, lon = _latlon(p)
+    lat_rng, lon_rng = [-90.0, 90.0], [-180.0, 180.0]
+    out, bits, ch, even = [], 0, 0, True
+    while len(out) < int(precision):
+        rng, v = (lon_rng, lon) if even else (lat_rng, lat)
+        mid = (rng[0] + rng[1]) / 2
+        ch <<= 1
+        if v >= mid:
+            ch |= 1
+            rng[0] = mid
+        else:
+            rng[1] = mid
+        even = not even
+        bits += 1
+        if bits == 5:
+            out.append(_GEOHASH32[ch])
+            bits, ch = 0, 0
+    return "".join(out)
+
+
+@register("apoc.spatial.decodeGeohash")
+def spatial_decode_geohash(gh):
+    if not gh:
+        return None
+    lat_rng, lon_rng = [-90.0, 90.0], [-180.0, 180.0]
+    even = True
+    for c in str(gh).lower():
+        idx = _GEOHASH32.find(c)
+        if idx < 0:
+            return None
+        for bit in (16, 8, 4, 2, 1):
+            rng = lon_rng if even else lat_rng
+            mid = (rng[0] + rng[1]) / 2
+            if idx & bit:
+                rng[0] = mid
+            else:
+                rng[1] = mid
+            even = not even
+    return {
+        "latitude": (lat_rng[0] + lat_rng[1]) / 2,
+        "longitude": (lon_rng[0] + lon_rng[1]) / 2,
+    }
+
+
+# ---------------------------------------------------------------------------
+# apoc.scoring.* (ref: apoc/scoring/scoring.go — similarity + rank metrics)
+# ---------------------------------------------------------------------------
+
+
+@register("apoc.scoring.existence")
+def scoring_existence(score, exists):
+    """(ref scoring.go Existence) score if exists else 0."""
+    return float(score) if exists else 0.0
+
+
+@register("apoc.scoring.pareto")
+def scoring_pareto(minimum_threshold, eighty_percent_value, maximum_value, score):
+    """(ref scoring.go Pareto) 80/20 cumulative-exponential scoring."""
+    score = float(score)
+    if score < float(minimum_threshold):
+        return 0.0
+    k = _math.log(5.0) / float(eighty_percent_value)
+    return float(maximum_value) * (1.0 - _math.exp(-k * score))
+
+
+@register("apoc.scoring.cosine")
+def scoring_cosine(a, b):
+    a, b = _nums(a), _nums(b)
+    if len(a) != len(b) or not a:
+        return None
+    dot = sum(x * y for x, y in zip(a, b))
+    na = _math.sqrt(sum(x * x for x in a))
+    nb = _math.sqrt(sum(y * y for y in b))
+    if na == 0 or nb == 0:
+        return 0.0
+    return dot / (na * nb)
+
+
+@register("apoc.scoring.euclidean")
+def scoring_euclidean(a, b):
+    a, b = _nums(a), _nums(b)
+    if len(a) != len(b) or not a:
+        return None
+    return _math.sqrt(sum((x - y) ** 2 for x, y in zip(a, b)))
+
+
+@register("apoc.scoring.manhattan")
+def scoring_manhattan(a, b):
+    a, b = _nums(a), _nums(b)
+    if len(a) != len(b) or not a:
+        return None
+    return sum(abs(x - y) for x, y in zip(a, b))
+
+
+@register("apoc.scoring.jaccard")
+def scoring_jaccard(a, b):
+    sa, sb = set(a or []), set(b or [])
+    if not sa and not sb:
+        return 1.0
+    return len(sa & sb) / len(sa | sb)
+
+
+@register("apoc.scoring.overlap")
+def scoring_overlap(a, b):
+    sa, sb = set(a or []), set(b or [])
+    denom = min(len(sa), len(sb))
+    return len(sa & sb) / denom if denom else 0.0
+
+
+@register("apoc.scoring.dice")
+def scoring_dice(a, b):
+    sa, sb = set(a or []), set(b or [])
+    if not sa and not sb:
+        return 1.0
+    return 2 * len(sa & sb) / (len(sa) + len(sb))
+
+
+@register("apoc.scoring.pearson")
+def scoring_pearson(a, b):
+    return stats_correlation(a, b)
+
+
+@register("apoc.scoring.sigmoid")
+def scoring_sigmoid(x):
+    return None if x is None else 1.0 / (1.0 + _math.exp(-float(x)))
+
+
+@register("apoc.scoring.softmax")
+def scoring_softmax(xs):
+    v = _nums(xs)
+    if not v:
+        return []
+    m = max(v)
+    exps = [_math.exp(x - m) for x in v]
+    s = sum(exps)
+    return [e / s for e in exps]
+
+
+@register("apoc.scoring.minMax")
+def scoring_minmax(xs):
+    return stats_normalize(xs)
+
+
+@register("apoc.scoring.rank")
+def scoring_rank(xs, descending=True):
+    """1-based ranks; ties share the lower rank."""
+    v = _nums(xs)
+    order = sorted(v, reverse=bool(descending))
+    return [order.index(x) + 1 for x in v]
+
+
+@register("apoc.scoring.topK")
+def scoring_topk(xs, k):
+    v = _nums(xs)
+    return sorted(v, reverse=True)[: int(k)]
+
+
+@register("apoc.scoring.tfidf")
+def scoring_tfidf(term_count, doc_len, n_docs, docs_with_term):
+    """tf * idf with smooth idf (ref scoring.go TFIDF)."""
+    if not doc_len or not n_docs:
+        return 0.0
+    tf = float(term_count) / float(doc_len)
+    idf = _math.log((1.0 + float(n_docs)) / (1.0 + float(docs_with_term))) + 1.0
+    return tf * idf
+
+
+# ---------------------------------------------------------------------------
+# apoc.xml.* (ref: apoc/xml/xml.go — Parse/ToMap/ToJson/escape helpers)
+# ---------------------------------------------------------------------------
+
+
+def _xml_to_map(el: _ET.Element) -> dict:
+    out: dict[str, Any] = {"_type": el.tag}
+    if el.attrib:
+        out.update(el.attrib)
+    text = (el.text or "").strip()
+    if text:
+        out["_text"] = text
+    children = [_xml_to_map(c) for c in el]
+    if children:
+        out["_children"] = children
+    return out
+
+
+@register("apoc.xml.parse")
+def xml_parse(s):
+    """XML string -> nested map {_type, attrs..., _text, _children}."""
+    if s is None:
+        return None
+    try:
+        return _xml_to_map(_ET.fromstring(s))
+    except _ET.ParseError:
+        return None
+
+
+@register("apoc.xml.validate")
+def xml_validate(s):
+    if s is None:
+        return False
+    try:
+        _ET.fromstring(s)
+        return True
+    except _ET.ParseError:
+        return False
+
+
+@register("apoc.xml.toJson")
+def xml_to_json(s):
+    m = xml_parse(s)
+    return None if m is None else _json.dumps(m)
+
+
+@register("apoc.xml.escape")
+def xml_escape(s):
+    if s is None:
+        return None
+    return (
+        str(s)
+        .replace("&", "&amp;")
+        .replace("<", "&lt;")
+        .replace(">", "&gt;")
+        .replace('"', "&quot;")
+        .replace("'", "&apos;")
+    )
+
+
+@register("apoc.xml.unescape")
+def xml_unescape(s):
+    if s is None:
+        return None
+    return (
+        str(s)
+        .replace("&apos;", "'")
+        .replace("&quot;", '"')
+        .replace("&gt;", ">")
+        .replace("&lt;", "<")
+        .replace("&amp;", "&")
+    )
+
+
+@register("apoc.xml.getAttribute")
+def xml_get_attribute(s, tag, attr):
+    if s is None:
+        return None
+    try:
+        root = _ET.fromstring(s)
+    except _ET.ParseError:
+        return None
+    if root.tag == tag and attr in root.attrib:
+        return root.attrib[attr]
+    el = root.find(f".//{tag}")
+    return el.attrib.get(attr) if el is not None else None
+
+
+@register("apoc.xml.getText")
+def xml_get_text(s, tag):
+    if s is None:
+        return None
+    try:
+        root = _ET.fromstring(s)
+    except _ET.ParseError:
+        return None
+    el = root if root.tag == tag else root.find(f".//{tag}")
+    return (el.text or "").strip() if el is not None else None

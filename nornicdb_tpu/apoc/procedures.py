@@ -278,3 +278,331 @@ def apoc_trigger_list(ex: CypherExecutor, args, row):
         [[t.name, t.statement, t.paused, t.fired, t.errors]
          for t in _trigger_manager(ex).list()],
     )
+
+
+# ---------------------------------------------------------------------------
+# apoc.cypher.* (ref: apoc/cypher/cypher.go — Run/RunMany/DoIt/RunFirstColumn)
+# ---------------------------------------------------------------------------
+
+
+@procedure("apoc.cypher.run")
+def apoc_cypher_run(ex: CypherExecutor, args, row):
+    """apoc.cypher.run(statement, params) -> value rows as maps."""
+    if not args:
+        raise CypherSyntaxError("apoc.cypher.run(statement, params)")
+    params = args[1] if len(args) > 1 and isinstance(args[1], dict) else {}
+    res = ex.execute(str(args[0]), params)
+    return ["value"], [[dict(zip(res.columns, r))] for r in res.rows]
+
+
+@procedure("apoc.cypher.doit")
+def apoc_cypher_doit(ex: CypherExecutor, args, row):
+    """Like apoc.cypher.run but explicitly allowed to write (same here:
+    the inner executor enforces RBAC at the session layer, not here)."""
+    return apoc_cypher_run(ex, args, row)
+
+
+@procedure("apoc.cypher.runmany")
+def apoc_cypher_run_many(ex: CypherExecutor, args, row):
+    """Semicolon-separated statements, each run in order; returns per-
+    statement row counts (ref cypher.go RunMany)."""
+    if not args:
+        raise CypherSyntaxError("apoc.cypher.runMany(statements, params)")
+    params = args[1] if len(args) > 1 and isinstance(args[1], dict) else {}
+    out = []
+    for i, stmt in enumerate(s.strip() for s in _split_statements(str(args[0]))):
+        if not stmt:
+            continue
+        res = ex.execute(stmt, params)
+        out.append([i, len(res.rows)])
+    return ["statement", "rowCount"], out
+
+
+def _split_statements(text: str) -> list[str]:
+    """Split on ';' outside of Cypher string literals / backtick names."""
+    parts, buf = [], []
+    quote = None
+    i = 0
+    while i < len(text):
+        c = text[i]
+        if quote:
+            buf.append(c)
+            if c == "\\" and quote in "'\"" and i + 1 < len(text):
+                buf.append(text[i + 1])
+                i += 1
+            elif c == quote:
+                quote = None
+        elif c in ("'", '"', "`"):
+            quote = c
+            buf.append(c)
+        elif c == ";":
+            parts.append("".join(buf))
+            buf = []
+        else:
+            buf.append(c)
+        i += 1
+    parts.append("".join(buf))
+    return parts
+
+
+@procedure("apoc.cypher.runfirstcolumnsingle")
+def apoc_cypher_first_single(ex: CypherExecutor, args, row):
+    if not args:
+        raise CypherSyntaxError("apoc.cypher.runFirstColumnSingle(statement, params)")
+    params = args[1] if len(args) > 1 and isinstance(args[1], dict) else {}
+    res = ex.execute(str(args[0]), params)
+    val = res.rows[0][0] if res.rows and res.rows[0] else None
+    return ["value"], [[val]]
+
+
+@procedure("apoc.cypher.runfirstcolumnmany")
+def apoc_cypher_first_many(ex: CypherExecutor, args, row):
+    if not args:
+        raise CypherSyntaxError("apoc.cypher.runFirstColumnMany(statement, params)")
+    params = args[1] if len(args) > 1 and isinstance(args[1], dict) else {}
+    res = ex.execute(str(args[0]), params)
+    return ["value"], [[r[0]] for r in res.rows if r]
+
+
+# ---------------------------------------------------------------------------
+# apoc.schema.* (ref: apoc/schema/schema.go — Nodes/Assert/index+constraint
+# introspection against the SchemaManager)
+# ---------------------------------------------------------------------------
+
+
+@procedure("apoc.schema.nodes")
+def apoc_schema_nodes(ex: CypherExecutor, args, row):
+    """Rows follow apoc's contract: status is the online state, type is the
+    index/constraint kind (e.g. RANGE, UNIQUENESS)."""
+    out = []
+    for idx in ex.schema.list_indexes():
+        out.append([f":{idx.label}({','.join(idx.properties)})",
+                    idx.label, idx.properties, "ONLINE",
+                    str(idx.kind).upper()])
+    for c in ex.schema.list_constraints():
+        kind = "UNIQUENESS" if c.kind == "unique" else str(c.kind).upper()
+        out.append([f":{c.label}({','.join(c.properties)})",
+                    c.label, c.properties, "ONLINE", kind])
+    return ["name", "label", "properties", "status", "type"], out
+
+
+@procedure("apoc.schema.relationships")
+def apoc_schema_rels(ex: CypherExecutor, args, row):
+    return ["name", "type", "properties", "status"], []
+
+
+@procedure("apoc.schema.assert")
+def apoc_schema_assert(ex: CypherExecutor, args, row):
+    """apoc.schema.assert(indexMap, constraintMap[, dropExisting]) —
+    declaratively converge schema: create what's listed, drop the rest
+    when dropExisting (default true), matching apoc's contract."""
+    want_idx = args[0] if args and isinstance(args[0], dict) else {}
+    want_con = args[1] if len(args) > 1 and isinstance(args[1], dict) else {}
+    drop_existing = bool(args[2]) if len(args) > 2 else True
+    out = []
+    existing_con = {
+        (c.label, tuple(c.properties)): c for c in ex.schema.list_constraints()
+    }
+    wanted_idx_keys = set()
+    for label, prop_lists in (want_idx or {}).items():
+        for props in prop_lists or []:
+            props = props if isinstance(props, list) else [props]
+            wanted_idx_keys.add((label, tuple(props)))
+            if ex.schema.find_index(label, props) is not None:
+                out.append([label, props, "KEPT", "INDEX"])
+                continue
+            name = f"apoc_idx_{label}_{'_'.join(props)}"
+            ex.schema.create_index(name, "property", label, props,
+                                   if_not_exists=True)
+            out.append([label, props, "CREATED", "INDEX"])
+    wanted_con_keys = set()
+    for label, prop_lists in (want_con or {}).items():
+        for props in prop_lists or []:
+            props = props if isinstance(props, list) else [props]
+            wanted_con_keys.add((label, tuple(props)))
+            if (label, tuple(props)) in existing_con:
+                out.append([label, props, "KEPT", "CONSTRAINT"])
+                continue
+            name = f"apoc_con_{label}_{'_'.join(props)}"
+            ex.schema.create_constraint(name, label, props,
+                                        if_not_exists=True)
+            out.append([label, props, "CREATED", "CONSTRAINT"])
+    if drop_existing:
+        for idx in list(ex.schema.list_indexes()):
+            if idx.kind == "vector":
+                continue  # vector indexes back live search; never implicit-drop
+            if (idx.label, tuple(idx.properties)) not in wanted_idx_keys:
+                ex.schema.drop_index(idx.name, if_exists=True)
+                out.append([idx.label, idx.properties, "DROPPED", "INDEX"])
+        for c in list(ex.schema.list_constraints()):
+            if (c.label, tuple(c.properties)) not in wanted_con_keys:
+                ex.schema.drop_constraint(c.name, if_exists=True)
+                out.append([c.label, c.properties, "DROPPED", "CONSTRAINT"])
+    return ["label", "key", "action", "type"], out
+
+
+# ---------------------------------------------------------------------------
+# apoc.nodes.* (ref: apoc/nodes/nodes.go — Link/Delete/Connected/Collapse)
+# ---------------------------------------------------------------------------
+
+
+@procedure("apoc.nodes.link")
+def apoc_nodes_link(ex: CypherExecutor, args, row):
+    """Chain a list of nodes with rels of the given type (ref nodes.go Link)."""
+    if len(args) < 2:
+        raise CypherSyntaxError("apoc.nodes.link(nodes, relType)")
+    nodes, rel_type = args[0] or [], str(args[1])
+    created = 0
+    for a, b in zip(nodes, nodes[1:]):
+        ex.storage.create_edge(Edge(start_node=a.id, end_node=b.id,
+                                    type=rel_type))
+        created += 1
+    return ["created"], [[created]]
+
+
+@procedure("apoc.nodes.delete")
+def apoc_nodes_delete(ex: CypherExecutor, args, row):
+    """Detach-delete the given nodes (ref nodes.go Delete)."""
+    nodes = args[0] or []
+    if isinstance(nodes, Node):
+        nodes = [nodes]
+    count = 0
+    for n in nodes:
+        for e in list(ex.storage.get_outgoing_edges(n.id)) + list(
+            ex.storage.get_incoming_edges(n.id)
+        ):
+            ex.storage.delete_edge(e.id)
+        ex.storage.delete_node(n.id)
+        count += 1
+    return ["value"], [[count]]
+
+
+@procedure("apoc.nodes.connected")
+def apoc_nodes_connected(ex: CypherExecutor, args, row):
+    if len(args) < 2:
+        raise CypherSyntaxError("apoc.nodes.connected(a, b[, types])")
+    a, b = args[0], args[1]
+    want = set()
+    if len(args) > 2 and args[2]:
+        want = {t.strip("<>") for t in str(args[2]).split("|")}
+    for e in ex.storage.get_outgoing_edges(a.id):
+        if e.end_node == b.id and (not want or e.type in want):
+            return ["value"], [[True]]
+    for e in ex.storage.get_incoming_edges(a.id):
+        if e.start_node == b.id and (not want or e.type in want):
+            return ["value"], [[True]]
+    return ["value"], [[False]]
+
+
+@procedure("apoc.nodes.collapse")
+def apoc_nodes_collapse(ex: CypherExecutor, args, row):
+    """Merge a list of nodes into the first: union labels/props, rewire
+    edges, delete the rest (ref nodes.go Collapse)."""
+    nodes = args[0] or []
+    # dedup by id: collect() without DISTINCT can repeat the target, and
+    # treating a duplicate as an "other" would delete the merged node
+    seen_ids: set[str] = set()
+    nodes = [n for n in nodes if n.id not in seen_ids and not seen_ids.add(n.id)]
+    if len(nodes) < 2:
+        return ["node"], [[nodes[0]]] if nodes else []
+    target = nodes[0]
+    for other in nodes[1:]:
+        for l in other.labels:
+            if l not in target.labels:
+                target.labels.append(l)
+        for k, v in other.properties.items():
+            target.properties.setdefault(k, v)
+        for e in list(ex.storage.get_outgoing_edges(other.id)):
+            ex.storage.delete_edge(e.id)
+            if e.end_node != target.id:
+                ex.storage.create_edge(Edge(start_node=target.id,
+                                            end_node=e.end_node, type=e.type,
+                                            properties=e.properties))
+        for e in list(ex.storage.get_incoming_edges(other.id)):
+            ex.storage.delete_edge(e.id)
+            if e.start_node != target.id:
+                ex.storage.create_edge(Edge(start_node=e.start_node,
+                                            end_node=target.id, type=e.type,
+                                            properties=e.properties))
+        ex.storage.delete_node(other.id)
+    ex.storage.update_node(target)
+    return ["node"], [[target]]
+
+
+# ---------------------------------------------------------------------------
+# apoc.log.* (ref: apoc/log/log.go — levelled logging through the server's
+# logger rather than a side-channel)
+# ---------------------------------------------------------------------------
+
+
+def _apoc_log(level: str, args):
+    import logging
+
+    msg = str(args[0]) if args else ""
+    params = args[1:] if len(args) > 1 else ()
+    try:
+        msg = msg % tuple(params) if params else msg
+    except (TypeError, ValueError):
+        msg = " ".join([msg] + [str(p) for p in params])
+    logging.getLogger("nornicdb.apoc").log(
+        getattr(logging, level.upper(), logging.INFO), "%s", msg
+    )
+    return ["value"], [[msg]]
+
+
+@procedure("apoc.log.info")
+def apoc_log_info(ex, args, row):
+    return _apoc_log("info", args)
+
+
+@procedure("apoc.log.debug")
+def apoc_log_debug(ex, args, row):
+    return _apoc_log("debug", args)
+
+
+@procedure("apoc.log.warn")
+def apoc_log_warn(ex, args, row):
+    return _apoc_log("warning", args)
+
+
+@procedure("apoc.log.error")
+def apoc_log_error(ex, args, row):
+    return _apoc_log("error", args)
+
+
+# ---------------------------------------------------------------------------
+# apoc.graph.fromData (ref: apoc/graph/graph.go — virtual graph handles)
+# ---------------------------------------------------------------------------
+
+
+@procedure("apoc.graph.fromdata")
+def apoc_graph_from_data(ex: CypherExecutor, args, row):
+    """Bundle nodes+rels into a named virtual graph map (not persisted)."""
+    nodes = args[0] if args else []
+    rels = args[1] if len(args) > 1 else []
+    name = str(args[2]) if len(args) > 2 else "graph"
+    props = args[3] if len(args) > 3 and isinstance(args[3], dict) else {}
+    return ["graph"], [[{
+        "name": name, "nodes": list(nodes or []),
+        "relationships": list(rels or []), "properties": props,
+    }]]
+
+
+@procedure("apoc.meta.stats")
+def apoc_meta_stats(ex: CypherExecutor, args, row):
+    """(ref: apoc/meta — label/type counts for the whole database)."""
+    labels: dict[str, int] = {}
+    n_nodes = 0
+    for n in ex.storage.all_nodes():
+        n_nodes += 1
+        for l in n.labels:
+            labels[l] = labels.get(l, 0) + 1
+    types: dict[str, int] = {}
+    n_edges = 0
+    for e in ex.storage.all_edges():
+        n_edges += 1
+        types[e.type] = types.get(e.type, 0) + 1
+    return (
+        ["nodeCount", "relCount", "labels", "relTypes"],
+        [[n_nodes, n_edges, labels, types]],
+    )

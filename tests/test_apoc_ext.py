@@ -1,0 +1,334 @@
+"""APOC extended categories: bitwise/json/diff/stats/spatial/scoring/xml
+functions and cypher/schema/nodes/log/graph procedures.
+
+Mirrors the reference's per-category unit tests (apoc/*/**_test.go).
+"""
+
+import math
+
+import pytest
+
+from nornicdb_tpu.apoc import call
+from nornicdb_tpu.cypher.executor import CypherExecutor
+from nornicdb_tpu.storage.schema import SchemaManager
+from nornicdb_tpu.storage.types import MemoryEngine
+
+
+@pytest.fixture
+def ex():
+    import nornicdb_tpu.apoc as apoc
+
+    apoc.register_procedures()
+    storage = MemoryEngine()
+    schema = SchemaManager()
+    schema.attach(storage)
+    return CypherExecutor(storage, schema=schema)
+
+
+# -- bitwise ----------------------------------------------------------------
+
+def test_bitwise():
+    assert call("apoc.bitwise.op", 12, "&", 10) == 8
+    assert call("apoc.bitwise.op", 12, "OR", 10) == 14
+    assert call("apoc.bitwise.op", 1, "<<", 4) == 16
+    assert call("apoc.bitwise.and", 12, 10) == 8
+    assert call("apoc.bitwise.or", [12, 10, 1]) == 15
+    assert call("apoc.bitwise.xor", 12, 10) == 6
+    assert call("apoc.bitwise.not", 0) == -1
+    assert call("apoc.bitwise.setBit", 0, 3) == 8
+    assert call("apoc.bitwise.clearBit", 15, 0) == 14
+    assert call("apoc.bitwise.toggleBit", 8, 3) == 0
+    assert call("apoc.bitwise.testBit", 8, 3) is True
+    assert call("apoc.bitwise.countBits", 255) == 8
+    assert call("apoc.bitwise.countBits", -1) == 64
+    assert call("apoc.bitwise.op", None, "&", 1) is None
+
+
+# -- json -------------------------------------------------------------------
+
+def test_json_path_and_tools():
+    doc = '{"a": {"b": [{"c": 42}]}, "xs": [1,2,3]}'
+    assert call("apoc.json.path", doc, "a.b[0].c") == 42
+    assert call("apoc.json.path", doc, "$.xs[2]") == 3
+    assert call("apoc.json.path", doc, "missing.deep") is None
+    assert call("apoc.json.validate", doc) is True
+    assert call("apoc.json.validate", "{nope") is False
+    assert call("apoc.json.parse", "[1,2]") == [1, 2]
+    assert call("apoc.json.stringify", {"k": 1}) == '{"k": 1}'
+    assert call("apoc.json.keys", doc) == ["a", "xs"]
+    assert call("apoc.json.size", '{"a":1,"b":2}') == 2
+    assert call("apoc.json.merge", {"a": 1}, {"b": 2}) == {"a": 1, "b": 2}
+    flat = call("apoc.json.flatten", {"a": {"b": 1}, "xs": [5, 6]})
+    assert flat == {"a.b": 1, "xs[0]": 5, "xs[1]": 6}
+    assert call("apoc.json.set", {"a": {}}, "a.b", 7) == {"a": {"b": 7}}
+    assert call("apoc.json.delete", {"a": 1, "b": 2}, "a") == {"b": 2}
+
+
+# -- diff -------------------------------------------------------------------
+
+def test_diff_maps_lists_strings():
+    d = call("apoc.diff.maps", {"a": 1, "b": 2, "c": 3}, {"b": 2, "c": 9, "d": 4})
+    assert d["leftOnly"] == {"a": 1}
+    assert d["rightOnly"] == {"d": 4}
+    assert d["inCommon"] == {"b": 2}
+    assert d["different"] == {"c": {"left": 3, "right": 9}}
+
+    l = call("apoc.diff.lists", [1, 2, 3], [2, 3, 4])
+    assert l == {"leftOnly": [1], "rightOnly": [4], "inCommon": [2, 3]}
+
+    s = call("apoc.diff.strings", "hello world", "hello there world")
+    assert s["equal"] is False
+    assert s["commonPrefix"].startswith("hello ")
+    assert s["commonSuffix"].endswith("world")
+
+
+def test_diff_nodes_uses_properties():
+    from nornicdb_tpu.storage.types import Node
+
+    a = Node(labels=["A"], properties={"x": 1})
+    b = Node(labels=["A"], properties={"x": 2})
+    d = call("apoc.diff.nodes", a, b)
+    assert d["different"] == {"x": {"left": 1, "right": 2}}
+
+
+# -- stats ------------------------------------------------------------------
+
+def test_stats_suite():
+    xs = [2.0, 4.0, 4.0, 4.0, 5.0, 5.0, 7.0, 9.0]
+    assert call("apoc.stats.mean", xs) == 5.0
+    assert call("apoc.stats.median", xs) == 4.5
+    assert call("apoc.stats.mode", xs) == 4.0
+    assert call("apoc.stats.stdev", xs, True) == 2.0
+    assert call("apoc.stats.variance", xs, True) == 4.0
+    assert call("apoc.stats.percentile", xs, 0.5) == 4.5
+    assert call("apoc.stats.percentile", xs, 50) == 4.5
+    q = call("apoc.stats.quartiles", xs)
+    assert q["q2"] == 4.5
+    assert call("apoc.stats.iqr", xs) == q["q3"] - q["q1"]
+    z = call("apoc.stats.zscore", xs)
+    assert abs(sum(z)) < 1e-9
+    n = call("apoc.stats.normalize", xs)
+    assert min(n) == 0.0 and max(n) == 1.0
+    assert call("apoc.stats.correlation", [1, 2, 3], [2, 4, 6]) == pytest.approx(1.0)
+    hist = call("apoc.stats.histogram", xs, 2)
+    assert sum(b["count"] for b in hist) == len(xs)
+    assert call("apoc.stats.outliers", [1, 2, 3, 2, 100]) == [100]
+    s = call("apoc.stats.summary", xs)
+    assert s["count"] == 8 and s["min"] == 2.0 and s["max"] == 9.0
+    assert call("apoc.stats.mean", []) is None
+
+
+# -- spatial ----------------------------------------------------------------
+
+def test_spatial_geodesy():
+    paris = {"latitude": 48.8566, "longitude": 2.3522}
+    london = {"latitude": 51.5074, "longitude": -0.1278}
+    d = call("apoc.spatial.distance", paris, london)
+    assert 330_000 < d < 350_000  # ~344 km
+    b = call("apoc.spatial.bearing", paris, london)
+    assert 300 < b < 340  # roughly NW
+    dest = call("apoc.spatial.destination", paris, d, b)
+    assert abs(dest["latitude"] - london["latitude"]) < 0.01
+    mid = call("apoc.spatial.midpoint", paris, london)
+    assert 48.8 < mid["latitude"] < 51.6
+    assert call("apoc.spatial.withinDistance", paris, london, 400_000) is True
+    assert call("apoc.spatial.withinDistance", paris, london, 100_000) is False
+    box = call("apoc.spatial.boundingBox", [paris, london])
+    assert call("apoc.spatial.within", mid, box) is True
+    c = call("apoc.spatial.centroid", [paris, london])
+    assert abs(c["latitude"] - (48.8566 + 51.5074) / 2) < 1e-9
+
+
+def test_spatial_geohash_roundtrip():
+    p = {"latitude": 37.7749, "longitude": -122.4194}
+    gh = call("apoc.spatial.encodeGeohash", p, 9)
+    assert len(gh) == 9
+    back = call("apoc.spatial.decodeGeohash", gh)
+    assert abs(back["latitude"] - p["latitude"]) < 0.001
+    assert abs(back["longitude"] - p["longitude"]) < 0.001
+    assert call("apoc.spatial.decodeGeohash", "!!") is None
+
+
+# -- scoring ----------------------------------------------------------------
+
+def test_scoring_metrics():
+    assert call("apoc.scoring.existence", 5.0, True) == 5.0
+    assert call("apoc.scoring.existence", 5.0, False) == 0.0
+    # pareto: at the 80% value the score reaches 80% of max
+    p = call("apoc.scoring.pareto", 0, 10, 100, 10)
+    assert abs(p - 80.0) < 1e-6
+    assert call("apoc.scoring.pareto", 5, 10, 100, 3) == 0.0
+    assert call("apoc.scoring.cosine", [1, 0], [1, 0]) == pytest.approx(1.0)
+    assert call("apoc.scoring.cosine", [1, 0], [0, 1]) == pytest.approx(0.0)
+    assert call("apoc.scoring.euclidean", [0, 0], [3, 4]) == 5.0
+    assert call("apoc.scoring.manhattan", [0, 0], [3, 4]) == 7.0
+    assert call("apoc.scoring.jaccard", [1, 2, 3], [2, 3, 4]) == 0.5
+    assert call("apoc.scoring.dice", [1, 2], [2, 3]) == 0.5
+    assert call("apoc.scoring.pearson", [1, 2, 3], [3, 2, 1]) == pytest.approx(-1.0)
+    assert call("apoc.scoring.sigmoid", 0) == 0.5
+    sm = call("apoc.scoring.softmax", [1.0, 1.0])
+    assert sm == [0.5, 0.5]
+    assert call("apoc.scoring.rank", [10, 30, 20]) == [3, 1, 2]
+    assert call("apoc.scoring.topK", [5, 1, 9, 3], 2) == [9, 5]
+    assert call("apoc.scoring.tfidf", 0, 100, 10, 1) == 0.0
+    assert call("apoc.scoring.tfidf", 3, 100, 10, 1) > 0
+
+
+# -- xml --------------------------------------------------------------------
+
+def test_xml_parse_and_helpers():
+    doc = '<root id="1"><item name="a">hello</item><item name="b"/></root>'
+    m = call("apoc.xml.parse", doc)
+    assert m["_type"] == "root" and m["id"] == "1"
+    assert m["_children"][0]["_text"] == "hello"
+    assert call("apoc.xml.validate", doc) is True
+    assert call("apoc.xml.validate", "<broken") is False
+    assert call("apoc.xml.parse", "<broken") is None
+    assert '"_type": "root"' in call("apoc.xml.toJson", doc)
+    assert call("apoc.xml.escape", '<a href="x">') == "&lt;a href=&quot;x&quot;&gt;"
+    assert call("apoc.xml.unescape", "&lt;x&gt;") == "<x>"
+    assert call("apoc.xml.getAttribute", doc, "item", "name") == "a"
+    assert call("apoc.xml.getText", doc, "item") == "hello"
+
+
+# -- procedures -------------------------------------------------------------
+
+def test_apoc_cypher_run(ex):
+    ex.execute("CREATE (:P {name: 'a'}), (:P {name: 'b'})")
+    res = ex.execute(
+        "CALL apoc.cypher.run('MATCH (p:P) RETURN p.name AS name ORDER BY name', {}) "
+        "YIELD value RETURN value.name AS n"
+    )
+    assert [r[0] for r in res.rows] == ["a", "b"]
+
+
+def test_apoc_cypher_run_many_and_first_column(ex):
+    ex.execute(
+        "CALL apoc.cypher.runMany('CREATE (:Q {v: 1}); CREATE (:Q {v: 2})', {})"
+    )
+    res = ex.execute(
+        "CALL apoc.cypher.runFirstColumnSingle('MATCH (q:Q) RETURN count(q)', {}) "
+        "YIELD value RETURN value"
+    )
+    assert res.rows[0][0] == 2
+    res = ex.execute(
+        "CALL apoc.cypher.runFirstColumnMany('MATCH (q:Q) RETURN q.v ORDER BY q.v', {}) "
+        "YIELD value RETURN value"
+    )
+    assert [r[0] for r in res.rows] == [1, 2]
+
+
+def test_apoc_schema_nodes_and_assert(ex):
+    ex.schema.create_index("i1", "property", "Person", ["name"])
+    res = ex.execute("CALL apoc.schema.nodes()")
+    assert any("Person" in str(r) for r in res.rows)
+    # assert converges: creates listed, drops unlisted
+    res = ex.execute(
+        "CALL apoc.schema.assert({City: [['name']]}, {}) "
+        "YIELD label, action RETURN label, action"
+    )
+    actions = {(r[0], r[1]) for r in res.rows}
+    assert ("City", "CREATED") in actions
+    assert ("Person", "DROPPED") in actions
+    names = {i.label for i in ex.schema.list_indexes()}
+    assert names == {"City"}
+
+
+def test_apoc_nodes_link_connected_delete(ex):
+    ex.execute("CREATE (:N {i: 1}), (:N {i: 2}), (:N {i: 3})")
+    res = ex.execute(
+        "MATCH (n:N) WITH n ORDER BY n.i WITH collect(n) AS ns "
+        "CALL apoc.nodes.link(ns, 'NEXT') YIELD created RETURN created"
+    )
+    assert res.rows[0][0] == 2
+    res = ex.execute(
+        "MATCH (a:N {i: 1}), (b:N {i: 2}) "
+        "CALL apoc.nodes.connected(a, b) YIELD value RETURN value"
+    )
+    assert res.rows[0][0] is True
+    res = ex.execute(
+        "MATCH (a:N {i: 1}), (b:N {i: 3}) "
+        "CALL apoc.nodes.connected(a, b) YIELD value RETURN value"
+    )
+    assert res.rows[0][0] is False
+    ex.execute("MATCH (n:N) WITH collect(n) AS ns CALL apoc.nodes.delete(ns) YIELD value RETURN value")
+    assert ex.execute("MATCH (n:N) RETURN count(n)").rows[0][0] == 0
+
+
+def test_apoc_nodes_collapse(ex):
+    ex.execute(
+        "CREATE (a:A {k: 1})-[:R]->(b:B {k: 2}), (c:C)-[:S]->(b)"
+    )
+    res = ex.execute(
+        "MATCH (a:A), (b:B) "
+        "CALL apoc.nodes.collapse([a, b]) YIELD node RETURN node"
+    )
+    merged = res.rows[0][0]
+    assert set(merged.labels) == {"A", "B"}
+    assert merged.properties["k"] == 1  # first node's props win
+    # c's edge rewired to merged node
+    res = ex.execute("MATCH (:C)-[:S]->(x) RETURN labels(x)")
+    assert set(res.rows[0][0]) == {"A", "B"}
+
+
+def test_apoc_log_and_graph(ex):
+    res = ex.execute("CALL apoc.log.info('hello %s', 'world') YIELD value RETURN value")
+    assert res.rows[0][0] == "hello world"
+    res = ex.execute(
+        "MATCH (n) WITH collect(n) AS ns "
+        "CALL apoc.graph.fromData(ns, [], 'g', {k: 1}) YIELD graph RETURN graph.name"
+    )
+    assert res.rows[0][0] == "g"
+
+
+def test_apoc_meta_stats(ex):
+    ex.execute("CREATE (:X)-[:R]->(:Y), (:X)")
+    res = ex.execute(
+        "CALL apoc.meta.stats() YIELD nodeCount, relCount, labels "
+        "RETURN nodeCount, relCount, labels"
+    )
+    nc, rc, labels = res.rows[0]
+    assert nc == 3 and rc == 1
+    assert labels == {"X": 2, "Y": 1}
+
+
+# -- review regressions -----------------------------------------------------
+
+def test_run_many_semicolon_in_string_literal(ex):
+    res = ex.execute(
+        "CALL apoc.cypher.runMany(\"CREATE (:S {name: 'a;b'}); CREATE (:S {name: 'c'})\", {})"
+    )
+    assert len(res.rows) == 2
+    got = ex.execute("MATCH (s:S) RETURN s.name ORDER BY s.name")
+    assert [r[0] for r in got.rows] == ["a;b", "c"]
+
+
+def test_collapse_duplicate_target_survives(ex):
+    ex.execute("CREATE (:D {k: 1})")
+    res = ex.execute(
+        "MATCH (d:D) CALL apoc.nodes.collapse([d, d]) YIELD node RETURN node"
+    )
+    assert res.rows[0][0].properties["k"] == 1
+    assert ex.execute("MATCH (d:D) RETURN count(d)").rows[0][0] == 1
+
+
+def test_schema_assert_keeps_equivalent_index(ex):
+    ex.schema.create_index("my_idx", "property", "Person", ["name"])
+    res = ex.execute(
+        "CALL apoc.schema.assert({Person: [['name']]}, {}) "
+        "YIELD label, action RETURN label, action"
+    )
+    assert res.rows == [["Person", "KEPT"]]
+    assert len(ex.schema.list_indexes()) == 1  # no duplicate created
+
+
+def test_json_path_canonical():
+    # the functions_ext implementation is the single registration
+    assert call("apoc.json.path", None, "a.b") is None
+    assert call("apoc.json.path", {"a": {"b": 1}}, "a.b") == 1
+
+
+def test_first_column_no_args_is_syntax_error(ex):
+    from nornicdb_tpu.errors import CypherSyntaxError
+    with pytest.raises(CypherSyntaxError):
+        ex.execute("CALL apoc.cypher.runFirstColumnSingle()")
