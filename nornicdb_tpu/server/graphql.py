@@ -4,8 +4,10 @@ Behavioral reference: /root/reference/pkg/graphql/ — gqlgen-based schema with
 node/edge CRUD, search, Cypher pass-through and traversals (handler.go,
 schema/, resolvers/). graphql-core is not in this image, so this module
 implements a small GraphQL subset natively: query/mutation operations,
-field arguments (literals + $variables), nested selection sets (projected
-onto results), aliases. No fragments/directives yet.
+field arguments (literals + $variables with defaults), nested selection
+sets (projected onto results), aliases, named + inline fragments,
+@include/@skip directives, __typename, and enough of the introspection
+schema (__schema/__type) for clients that probe capabilities.
 
 Root fields:
   query:    node(id) nodes(label, limit) relationships(type, limit)
@@ -67,10 +69,37 @@ class _Parser:
             raise CypherSyntaxError(f"GraphQL: expected {value!r}, got {v!r}")
 
     def parse_document(self) -> dict:
+        """Full document: one operation + any number of named fragments."""
+        operation = None
+        fragments: dict[str, dict] = {}
+        while self.peek()[0] != "eof":
+            kind, v = self.peek()
+            if v == "fragment":
+                self.next()
+                fname = self.next()[1]
+                self.expect("on")
+                ftype = self.next()[1]
+                fragments[fname] = {
+                    "type": ftype,
+                    "selections": self.parse_selection_set(),
+                }
+            else:
+                op = self.parse_operation_def()
+                if operation is not None:
+                    raise CypherSyntaxError(
+                        "GraphQL: multiple operations in one document"
+                    )
+                operation = op
+        if operation is None:
+            raise CypherSyntaxError("GraphQL: no operation in document")
+        operation["fragments"] = fragments
+        return operation
+
+    def parse_operation_def(self) -> dict:
         kind, v = self.peek()
         op = "query"
         name = None
-        variables: dict[str, Any] = {}
+        var_defaults: dict[str, Any] = {}
         if v in ("query", "mutation"):
             op = v
             self.next()
@@ -80,13 +109,33 @@ class _Parser:
                 self.next()
                 while self.peek()[1] != ")":
                     self.expect("$")
-                    self.next()  # var name
+                    vname = self.next()[1]
                     self.expect(":")
-                    while self.peek()[1] not in (")", "$"):
-                        self.next()  # skip type tokens incl. ! and defaults
+                    # type tokens (Name, [Name!]!, …): consume until the next
+                    # variable, a default marker, or the close paren
+                    consumed = 0
+                    while self.peek()[1] not in ("=", ")", "$"):
+                        tk, tv = self.next()
+                        if tk != "name" and tv not in ("[", "]", "!"):
+                            raise CypherSyntaxError(
+                                f"GraphQL: bad variable type near {tv!r}"
+                            )
+                        consumed += 1
+                    if consumed == 0:
+                        raise CypherSyntaxError(
+                            f"GraphQL: missing type for ${vname}"
+                        )
+                    if self.peek()[1] == "=":
+                        self.next()
+                        var_defaults[vname] = self.parse_value()
                 self.expect(")")
         selections = self.parse_selection_set()
-        return {"operation": op, "name": name, "selections": selections}
+        return {
+            "operation": op,
+            "name": name,
+            "selections": selections,
+            "var_defaults": var_defaults,
+        }
 
     def parse_selection_set(self) -> list[dict]:
         self.expect("{")
@@ -98,6 +147,19 @@ class _Parser:
 
     def parse_field(self) -> dict:
         kind, name = self.next()
+        if kind == "spread":
+            # ...FragmentName | ... on Type { ... }
+            nk, nv = self.peek()
+            if nv == "on":
+                self.next()
+                ftype = self.next()[1]
+                directives = self.parse_directives()
+                return {"inline": ftype, "directives": directives,
+                        "selections": self.parse_selection_set()}
+            if nk != "name":
+                raise CypherSyntaxError("GraphQL: expected fragment name after '...'")
+            fname = self.next()[1]
+            return {"spread": fname, "directives": self.parse_directives()}
         if kind != "name":
             raise CypherSyntaxError(f"GraphQL: expected field name, got {name!r}")
         alias = None
@@ -112,11 +174,28 @@ class _Parser:
                 self.expect(":")
                 args[aname] = self.parse_value()
             self.expect(")")
+        directives = self.parse_directives()
         sub = None
         if self.peek()[1] == "{":
             sub = self.parse_selection_set()
         return {"name": name, "alias": alias or name, "args": args,
-                "selections": sub}
+                "directives": directives, "selections": sub}
+
+    def parse_directives(self) -> list[dict]:
+        out = []
+        while self.peek()[1] == "@":
+            self.next()
+            dname = self.next()[1]
+            dargs = {}
+            if self.peek()[1] == "(":
+                self.next()
+                while self.peek()[1] != ")":
+                    ak, an = self.next()
+                    self.expect(":")
+                    dargs[an] = self.parse_value()
+                self.expect(")")
+            out.append({"name": dname, "args": dargs})
+        return out
 
     def parse_value(self) -> Any:
         kind, v = self.next()
@@ -182,6 +261,7 @@ def _resolve_args(args: dict, variables: dict) -> dict:
 
 def _node_obj(n: Node) -> dict:
     return {
+        "__typename": "Node",
         "id": n.id,
         "labels": list(n.labels),
         "properties": dict(n.properties),
@@ -192,6 +272,7 @@ def _node_obj(n: Node) -> dict:
 
 def _edge_obj(e: Edge) -> dict:
     return {
+        "__typename": "Relationship",
         "id": e.id,
         "type": e.type,
         "from": e.start_node,
@@ -202,17 +283,119 @@ def _edge_obj(e: Edge) -> dict:
     }
 
 
-def _project(value: Any, selections: Optional[list[dict]]) -> Any:
+def _directive_allows(directives: list[dict], variables: dict) -> bool:
+    """Evaluate @include(if:)/@skip(if:) (the two spec-mandated directives).
+    A missing `if` or undefined variable is an error, not a silent drop —
+    the spec types `if` as Boolean! and undefined variables fail validation."""
+    for d in directives or []:
+        if d["name"] not in ("include", "skip"):
+            continue  # unknown directives are ignored, matching lenient servers
+        if "if" not in d["args"]:
+            raise CypherSyntaxError(
+                f"GraphQL: @{d['name']} requires an 'if' argument"
+            )
+        cond = d["args"]["if"]
+        if isinstance(cond, _Var):
+            if cond.name not in variables:
+                raise CypherSyntaxError(
+                    f"GraphQL: undefined variable ${cond.name} in @{d['name']}"
+                )
+            cond = variables[cond.name]
+        if d["name"] == "include" and not cond:
+            return False
+        if d["name"] == "skip" and cond:
+            return False
+    return True
+
+
+def _flatten_selections(
+    selections: list[dict],
+    fragments: dict[str, dict],
+    variables: dict,
+    typename: Optional[str],
+    _depth: int = 0,
+) -> list[dict]:
+    """Expand fragment spreads / inline fragments into plain fields,
+    honoring type conditions and @include/@skip."""
+    if _depth > 16:
+        raise CypherSyntaxError("GraphQL: fragment nesting too deep (cycle?)")
+    out: list[dict] = []
+    for sel in selections:
+        if not _directive_allows(sel.get("directives"), variables):
+            continue
+        if "spread" in sel:
+            frag = fragments.get(sel["spread"])
+            if frag is None:
+                raise CypherSyntaxError(
+                    f"GraphQL: unknown fragment {sel['spread']!r}"
+                )
+            if typename is None or frag["type"] == typename:
+                out.extend(_flatten_selections(
+                    frag["selections"], fragments, variables, typename,
+                    _depth + 1))
+        elif "inline" in sel:
+            if typename is None or sel["inline"] == typename:
+                out.extend(_flatten_selections(
+                    sel["selections"], fragments, variables, typename,
+                    _depth + 1))
+        else:
+            out.append(sel)
+    return _merge_fields(out)
+
+
+def _merge_fields(selections: list[dict]) -> list[dict]:
+    """Spec field merging: same response key selected twice (the normal
+    composed-fragments pattern) concatenates sub-selections instead of
+    last-wins, and the resolver runs once per key."""
+    by_alias: dict[str, dict] = {}
+    order: list[str] = []
+    for sel in selections:
+        prev = by_alias.get(sel["alias"])
+        if prev is None:
+            by_alias[sel["alias"]] = dict(sel)
+            order.append(sel["alias"])
+        elif sel["selections"] and prev["selections"]:
+            prev["selections"] = prev["selections"] + sel["selections"]
+        elif sel["selections"]:
+            prev["selections"] = sel["selections"]
+    return [by_alias[a] for a in order]
+
+
+def _validate_spreads(selections: list[dict], fragments: dict[str, dict]) -> None:
+    """Document-level validation: every ...spread must name a known fragment
+    (real GraphQL validates before execution, so empty results still error)."""
+    for sel in selections:
+        if "spread" in sel:
+            if sel["spread"] not in fragments:
+                raise CypherSyntaxError(
+                    f"GraphQL: unknown fragment {sel['spread']!r}"
+                )
+        elif sel.get("selections"):
+            _validate_spreads(sel["selections"], fragments)
+
+
+def _project(
+    value: Any,
+    selections: Optional[list[dict]],
+    fragments: dict[str, dict],
+    variables: dict,
+) -> Any:
     """Apply a selection set to a result (GraphQL field projection)."""
     if selections is None or value is None:
         return value
     if isinstance(value, list):
-        return [_project(v, selections) for v in value]
+        return [_project(v, selections, fragments, variables) for v in value]
     if not isinstance(value, dict):
         return value
+    flat = _flatten_selections(
+        selections, fragments, variables, value.get("__typename"))
     out = {}
-    for sel in selections:
-        out[sel["alias"]] = _project(value.get(sel["name"]), sel["selections"])
+    for sel in flat:
+        if sel["name"] == "__typename":
+            out[sel["alias"]] = value.get("__typename")
+        else:
+            out[sel["alias"]] = _project(
+                value.get(sel["name"]), sel["selections"], fragments, variables)
     return out
 
 
@@ -223,18 +406,31 @@ class GraphQLExecutor:
         self.db = db
 
     def execute(self, query: str, variables: Optional[dict] = None) -> dict:
-        variables = variables or {}
+        variables = dict(variables or {})
         try:
             doc = _Parser(query).parse_document()
+            for k, v in doc.get("var_defaults", {}).items():
+                variables.setdefault(k, v)
+            fragments = doc.get("fragments", {})
+            _validate_spreads(doc["selections"], fragments)
+            for frag in fragments.values():
+                _validate_spreads(frag["selections"], fragments)
+            root_type = "Query" if doc["operation"] == "query" else "Mutation"
+            root = _flatten_selections(
+                doc["selections"], fragments, variables, root_type)
         except Exception as e:
             return {"errors": [{"message": f"parse error: {e}"}]}
         data = {}
         errors = []
-        for sel in doc["selections"]:
+        for sel in root:
             try:
+                if sel["name"] == "__typename":
+                    data[sel["alias"]] = root_type
+                    continue
                 args = _resolve_args(sel["args"], variables)
                 value = self._resolve(doc["operation"], sel["name"], args)
-                data[sel["alias"]] = _project(value, sel["selections"])
+                data[sel["alias"]] = _project(
+                    value, sel["selections"], fragments, variables)
             except Exception as e:
                 errors.append({"message": str(e), "path": [sel["alias"]]})
                 data[sel["alias"]] = None
@@ -247,6 +443,14 @@ class GraphQLExecutor:
     def _resolve(self, op: str, field: str, args: dict) -> Any:
         db = self.db
         if op == "query":
+            if field == "__schema":
+                return _introspection_schema()
+            if field == "__type":
+                want = args.get("name")
+                for t in _introspection_schema()["types"]:
+                    if t["name"] == want:
+                        return t
+                return None
             if field == "node":
                 return _node_obj(db.storage.get_node(args["id"]))
             if field == "nodes":
@@ -273,6 +477,7 @@ class GraphQLExecutor:
                 )
                 return [
                     {
+                        "__typename": "SearchResult",
                         "id": r["id"],
                         "score": r["score"],
                         "content": r["content"],
@@ -288,7 +493,8 @@ class GraphQLExecutor:
                     node.embedding, k=int(args.get("limit", 10)) + 1
                 )
                 return [
-                    {"id": i, "score": s} for i, s in hits if i != node.id
+                    {"__typename": "SimilarResult", "id": i, "score": s}
+                    for i, s in hits if i != node.id
                 ][: int(args.get("limit", 10))]
             if field == "cypher":
                 result = db.executor.execute(
@@ -297,6 +503,7 @@ class GraphQLExecutor:
                 from nornicdb_tpu.server.http import _jsonable
 
                 return {
+                    "__typename": "CypherResult",
                     "columns": result.columns,
                     "rows": [[_jsonable(v) for v in row] for row in result.rows],
                     "stats": result.stats.as_dict(),
@@ -306,6 +513,7 @@ class GraphQLExecutor:
                 return [_node_obj(n) for n in nodes]
             if field == "stats":
                 return {
+                    "__typename": "Stats",
                     "nodes": db.storage.node_count(),
                     "edges": db.storage.edge_count(),
                     "pendingEmbeddings": len(db.storage.pending_embed_ids()),
@@ -338,3 +546,112 @@ class GraphQLExecutor:
                 return True
             raise NornicError(f"unknown mutation field {field}")
         raise NornicError(f"unknown operation {op}")
+
+
+# -- introspection (ref: pkg/graphql gqlgen emits the full spec schema;
+# this is the minimal subset clients use for capability probing) ------------
+
+def _t(name: str, kind: str = "SCALAR") -> dict:
+    return {"__typename": "__Type", "kind": kind, "name": name, "ofType": None}
+
+
+def _list(inner: dict) -> dict:
+    """Spec wrapper type: kind LIST has name=null and ofType=element."""
+    return {"__typename": "__Type", "kind": "LIST", "name": None,
+            "ofType": inner}
+
+
+def _f(name: str, type_: dict, args: Optional[list] = None) -> dict:
+    return {
+        "__typename": "__Field",
+        "name": name,
+        "args": args or [],
+        "type": type_,
+        "isDeprecated": False,
+        "deprecationReason": None,
+    }
+
+
+def _arg(name: str, type_: dict) -> dict:
+    return {"__typename": "__InputValue", "name": name, "type": type_,
+            "defaultValue": None}
+
+
+def _obj(name: str, fields: list[dict]) -> dict:
+    return {
+        "__typename": "__Type",
+        "kind": "OBJECT",
+        "name": name,
+        "fields": fields,
+        "ofType": None,
+        "interfaces": [],
+        "possibleTypes": None,
+        "enumValues": None,
+        "inputFields": None,
+    }
+
+
+def _introspection_schema() -> dict:
+    STR, INT, BOOL, JSONT, ID = (
+        _t("String"), _t("Int"), _t("Boolean"), _t("JSON"), _t("ID"))
+    node = _obj("Node", [
+        _f("id", ID), _f("labels", _list(_t("String", "SCALAR"))),
+        _f("properties", JSONT), _f("decayScore", _t("Float")),
+        _f("accessCount", INT),
+    ])
+    rel = _obj("Relationship", [
+        _f("id", ID), _f("type", STR), _f("from", ID), _f("to", ID),
+        _f("properties", JSONT), _f("confidence", _t("Float")),
+        _f("autoGenerated", BOOL),
+    ])
+    search_result = _obj("SearchResult", [
+        _f("id", ID), _f("score", _t("Float")), _f("content", STR),
+        _f("node", _t("Node", "OBJECT")),
+    ])
+    cypher_result = _obj("CypherResult", [
+        _f("columns", _list(_t("String", "SCALAR"))), _f("rows", JSONT),
+        _f("stats", JSONT),
+    ])
+    stats = _obj("Stats", [
+        _f("nodes", INT), _f("edges", INT), _f("pendingEmbeddings", INT),
+    ])
+    query = _obj("Query", [
+        _f("node", _t("Node", "OBJECT"), [_arg("id", ID)]),
+        _f("nodes", _list(_t("Node", "OBJECT")),
+           [_arg("label", STR), _arg("limit", INT)]),
+        _f("relationships", _list(_t("Relationship", "OBJECT")),
+           [_arg("type", STR), _arg("limit", INT)]),
+        _f("search", _list(_t("SearchResult", "OBJECT")),
+           [_arg("query", STR), _arg("limit", INT)]),
+        _f("similar", JSONT, [_arg("id", ID), _arg("limit", INT)]),
+        _f("cypher", _t("CypherResult", "OBJECT"),
+           [_arg("statement", STR), _arg("parameters", JSONT)]),
+        _f("neighbors", _list(_t("Node", "OBJECT")),
+           [_arg("id", ID), _arg("depth", INT)]),
+        _f("stats", _t("Stats", "OBJECT")),
+    ])
+    mutation = _obj("Mutation", [
+        _f("createNode", _t("Node", "OBJECT"),
+           [_arg("labels", _list(_t("String", "SCALAR"))), _arg("properties", JSONT)]),
+        _f("updateNode", _t("Node", "OBJECT"),
+           [_arg("id", ID), _arg("properties", JSONT)]),
+        _f("deleteNode", BOOL, [_arg("id", ID)]),
+        _f("createRelationship", _t("Relationship", "OBJECT"),
+           [_arg("from", ID), _arg("to", ID), _arg("type", STR),
+            _arg("properties", JSONT)]),
+        _f("deleteRelationship", BOOL, [_arg("id", ID)]),
+    ])
+    return {
+        "__typename": "__Schema",
+        "queryType": {"__typename": "__Type", "name": "Query"},
+        "mutationType": {"__typename": "__Type", "name": "Mutation"},
+        "subscriptionType": None,
+        "types": [query, mutation, node, rel, search_result, cypher_result,
+                  stats, STR, INT, BOOL, _t("Float"), ID, JSONT],
+        "directives": [
+            {"__typename": "__Directive", "name": "include",
+             "locations": ["FIELD"], "args": [_arg("if", BOOL)]},
+            {"__typename": "__Directive", "name": "skip",
+             "locations": ["FIELD"], "args": [_arg("if", BOOL)]},
+        ],
+    }

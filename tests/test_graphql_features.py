@@ -1,0 +1,216 @@
+"""GraphQL fragments, directives, variable defaults, __typename and
+introspection (ref: pkg/graphql — gqlgen serves the full spec; this suite
+pins the subset our hand-rolled executor supports)."""
+
+import pytest
+
+import nornicdb_tpu
+from nornicdb_tpu.server.graphql import GraphQLExecutor
+
+
+@pytest.fixture
+def gq():
+    db = nornicdb_tpu.open_db("")
+    yield GraphQLExecutor(db)
+    db.close()
+
+
+def _seed(gq):
+    gq.execute('mutation { createNode(labels: ["City"], properties: {name: "Oslo"}) { id } }')
+
+
+def test_named_fragment(gq):
+    _seed(gq)
+    out = gq.execute(
+        'query { nodes(label: "City") { ...CityBits } } '
+        "fragment CityBits on Node { id labels properties }"
+    )
+    assert "errors" not in out
+    row = out["data"]["nodes"][0]
+    assert set(row.keys()) == {"id", "labels", "properties"}
+
+
+def test_fragment_before_operation_and_nesting(gq):
+    _seed(gq)
+    out = gq.execute(
+        "fragment Inner on Node { labels } "
+        "fragment Outer on Node { id ...Inner } "
+        'query { nodes(label: "City") { ...Outer } }'
+    )
+    assert "errors" not in out
+    assert set(out["data"]["nodes"][0].keys()) == {"id", "labels"}
+
+
+def test_unknown_fragment_is_error(gq):
+    out = gq.execute("query { nodes { ...Nope } }")
+    assert "errors" in out
+
+
+def test_fragment_cycle_is_error_not_hang(gq):
+    _seed(gq)
+    out = gq.execute(
+        "fragment A on Node { ...B } fragment B on Node { ...A } "
+        'query { nodes(label: "City") { ...A } }'
+    )
+    assert "errors" in out
+    assert "deep" in out["errors"][0]["message"]
+
+
+def test_inline_fragment_type_condition(gq):
+    _seed(gq)
+    out = gq.execute(
+        'query { nodes(label: "City") { '
+        "... on Node { id } ... on Relationship { type } } }"
+    )
+    row = out["data"]["nodes"][0]
+    assert "id" in row and "type" not in row  # Relationship branch skipped
+
+
+def test_include_skip_directives(gq):
+    _seed(gq)
+    out = gq.execute(
+        "query Q($yes: Boolean = true, $no: Boolean = false) { "
+        'nodes(label: "City") { '
+        "id @include(if: $yes) labels @include(if: $no) "
+        "properties @skip(if: $yes) } }"
+    )
+    row = out["data"]["nodes"][0]
+    assert set(row.keys()) == {"id"}
+
+
+def test_variable_defaults_and_override(gq):
+    _seed(gq)
+    out = gq.execute(
+        'query Q($l: String = "City") { nodes(label: $l) { id } }'
+    )
+    assert len(out["data"]["nodes"]) == 1
+    out = gq.execute(
+        'query Q($l: String = "City") { nodes(label: $l) { id } }',
+        {"l": "Nope"},
+    )
+    assert out["data"]["nodes"] == []
+
+
+def test_typename_at_all_levels(gq):
+    _seed(gq)
+    out = gq.execute(
+        'query { __typename nodes(label: "City") { __typename id } }'
+    )
+    assert out["data"]["__typename"] == "Query"
+    assert out["data"]["nodes"][0]["__typename"] == "Node"
+
+
+def test_introspection_schema(gq):
+    out = gq.execute(
+        "query { __schema { queryType { name } mutationType { name } "
+        "types { name kind } } }"
+    )
+    assert "errors" not in out
+    schema = out["data"]["__schema"]
+    assert schema["queryType"]["name"] == "Query"
+    assert schema["mutationType"]["name"] == "Mutation"
+    names = {t["name"] for t in schema["types"]}
+    assert {"Query", "Mutation", "Node", "Relationship"} <= names
+
+
+def test_introspection_type_fields(gq):
+    out = gq.execute(
+        'query { __type(name: "Node") { name fields { name type { name } } } }'
+    )
+    t = out["data"]["__type"]
+    assert t["name"] == "Node"
+    fields = {f["name"] for f in t["fields"]}
+    assert {"id", "labels", "properties"} <= fields
+
+
+def test_introspection_unknown_type_is_null(gq):
+    out = gq.execute('query { __type(name: "Nope") { name } }')
+    assert out["data"]["__type"] is None
+
+
+def test_complex_variable_types_parse(gq):
+    _seed(gq)
+    out = gq.execute(
+        "query Q($ls: [String!]! = []) { "
+        'nodes(label: "City") { id } }'
+    )
+    assert "errors" not in out
+    assert len(out["data"]["nodes"]) == 1
+
+
+def test_multiple_operations_rejected(gq):
+    out = gq.execute("query A { stats { nodes } } query B { stats { nodes } }")
+    assert "errors" in out
+
+
+def test_mutation_root_typename(gq):
+    out = gq.execute(
+        'mutation { __typename createNode(labels: ["X"]) { __typename id } }'
+    )
+    assert out["data"]["__typename"] == "Mutation"
+    assert out["data"]["createNode"]["__typename"] == "Node"
+
+
+# -- review regressions -----------------------------------------------------
+
+def test_fragment_field_merging(gq):
+    """Composed fragments selecting into the same field merge, not clobber."""
+    out = gq.execute(
+        "query { ...A ...B } "
+        "fragment A on Query { stats { nodes } } "
+        "fragment B on Query { stats { edges } }"
+    )
+    assert "errors" not in out
+    assert set(out["data"]["stats"].keys()) == {"nodes", "edges"}
+
+
+def test_duplicate_root_mutation_resolves_once(gq):
+    out = gq.execute(
+        'mutation { createNode(labels: ["Once"]) { id } '
+        'createNode(labels: ["Once"]) { labels } }'
+    )
+    assert "errors" not in out
+    check = gq.execute('query { nodes(label: "Once") { id } }')
+    assert len(check["data"]["nodes"]) == 1  # merged key -> one execution
+
+
+def test_introspection_list_wrapper_shape(gq):
+    out = gq.execute(
+        'query { __type(name: "Query") { fields { name type { kind name '
+        "ofType { name kind } } } } }"
+    )
+    fields = {f["name"]: f["type"] for f in out["data"]["__type"]["fields"]}
+    t = fields["nodes"]
+    assert t["kind"] == "LIST" and t["name"] is None
+    assert t["ofType"] == {"name": "Node", "kind": "OBJECT"}
+
+
+def test_typename_on_stats_and_search_objects(gq):
+    out = gq.execute("query { stats { __typename nodes } }")
+    assert out["data"]["stats"]["__typename"] == "Stats"
+
+
+def test_query_fragment_does_not_leak_into_node(gq):
+    _seed(gq)
+    out = gq.execute(
+        'query { nodes(label: "City") { ...Meta id } } '
+        "fragment Meta on Query { stats }"
+    )
+    row = out["data"]["nodes"][0]
+    assert "stats" not in row  # Query-conditioned fragment skipped inside Node
+
+
+def test_include_without_if_is_error(gq):
+    _seed(gq)
+    out = gq.execute('query { nodes(label: "City") { id @include } }')
+    assert "errors" in out
+    assert "'if'" in out["errors"][0]["message"]
+
+
+def test_include_undefined_variable_is_error(gq):
+    _seed(gq)
+    out = gq.execute(
+        'query { nodes(label: "City") { id @include(if: $typo) } }'
+    )
+    assert "errors" in out
+    assert "$typo" in out["errors"][0]["message"]
