@@ -51,3 +51,24 @@ class Encryptor:
     def decrypt(self, blob: bytes, aad: Optional[bytes] = None) -> bytes:
         nonce, ct = blob[:NONCE_BYTES], blob[NONCE_BYTES:]
         return self._aead.decrypt(nonce, ct, aad)
+
+
+def load_or_create_salt(path: str) -> bytes:
+    """Persist-or-load a PBKDF2 salt file, shared by every at-rest layer
+    (WAL, segment store) so salt handling can't silently diverge. An empty
+    or short file (crash mid-write) is treated as absent and regenerated —
+    safe because a salt only matters once records encrypted under it exist,
+    and those are written strictly after the salt file."""
+    if os.path.exists(path):
+        with open(path, "rb") as f:
+            salt = f.read()
+        if len(salt) >= 16:
+            return salt
+    salt = new_salt()
+    tmp = path + ".tmp"
+    with open(tmp, "wb") as f:
+        f.write(salt)
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, path)
+    return salt

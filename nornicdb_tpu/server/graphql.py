@@ -236,6 +236,12 @@ class _Var:
     def __init__(self, name: str):
         self.name = name
 
+    def __eq__(self, other):  # same $var in two selections must merge cleanly
+        return isinstance(other, _Var) and other.name == self.name
+
+    def __hash__(self):
+        return hash(("_Var", self.name))
+
 
 def parse_operation(query: str) -> str:
     """Operation type of a document ("query"/"mutation"); "query" on parse
@@ -354,10 +360,19 @@ def _merge_fields(selections: list[dict]) -> list[dict]:
         if prev is None:
             by_alias[sel["alias"]] = dict(sel)
             order.append(sel["alias"])
-        elif sel["selections"] and prev["selections"]:
-            prev["selections"] = prev["selections"] + sel["selections"]
-        elif sel["selections"]:
-            prev["selections"] = sel["selections"]
+        else:
+            if prev["name"] != sel["name"] or prev["args"] != sel["args"]:
+                # spec: OverlappingFieldsCanBeMerged — same response key
+                # with different field/args is a document error, not a
+                # silent last-wins
+                raise CypherSyntaxError(
+                    f"GraphQL: fields for key {sel['alias']!r} conflict "
+                    "(different field or arguments)"
+                )
+            if sel["selections"] and prev["selections"]:
+                prev["selections"] = prev["selections"] + sel["selections"]
+            elif sel["selections"]:
+                prev["selections"] = sel["selections"]
     return [by_alias[a] for a in order]
 
 

@@ -96,14 +96,10 @@ def open_storage(
     """
     base: Engine = MemoryEngine()
     if data_dir and engine == "segment":
-        if encryption_passphrase:
-            raise NornicError(
-                "storage_engine='segment' does not support at-rest encryption "
-                "yet; use the WAL engine for encrypted stores"
-            )
         from nornicdb_tpu.storage.segment import SegmentEngine
 
-        base = SegmentEngine(data_dir, sync=wal_sync)
+        base = SegmentEngine(data_dir, sync=wal_sync,
+                             passphrase=encryption_passphrase or None)
     elif data_dir:
         os.makedirs(data_dir, exist_ok=True)
         wal = WAL(os.path.join(data_dir, "wal"), sync=wal_sync,

@@ -12,7 +12,12 @@ and compaction). Key prefixes mirror the reference:
 Secondary indexes (labels, types, adjacency) are rebuilt in memory on open
 by a single native key scan + value reads, like Badger's prefix iterations.
 Compaction triggers at tombstone_ratio like the HNSW/corpus rebuild policy.
-"""
+
+At-rest encryption (ref: db.go:781-809 — the reference hands a PBKDF2-derived
+key to Badger's built-in encryption): values are AES-256-GCM sealed with the
+key id as AAD before they reach the native store; keys stay plaintext so the
+native prefix scans keep working. Salt lives in seg.salt; a sentinel record
+(m:chk) rejects wrong passphrases at open."""
 
 from __future__ import annotations
 
@@ -157,15 +162,92 @@ class _SegKV:
             self._h = None
 
 
+class _EncKV:
+    """Value-encrypting view over _SegKV: AES-256-GCM with the record key as
+    AAD (so a ciphertext can't be replayed under a different key). Keys are
+    left plaintext — native prefix scans and compaction never see plaintext
+    values (ref: Badger's value-only encryption, db.go:781-809)."""
+
+    def __init__(self, kv: "_SegKV", enc) -> None:
+        self._kv = kv
+        self._enc = enc
+
+    def put(self, key: bytes, value: bytes) -> None:
+        self._kv.put(key, self._enc.encrypt(value, aad=key))
+
+    def get(self, key: bytes) -> Optional[bytes]:
+        raw = self._kv.get(key)
+        if raw is None:
+            return None
+        try:
+            return self._enc.decrypt(raw, aad=key)
+        except Exception as e:
+            raise NornicError(
+                f"segment store decrypt failed for {key!r} (wrong passphrase "
+                f"or corrupted data): {e}"
+            ) from e
+
+    def __getattr__(self, name: str):
+        return getattr(self._kv, name)
+
+
 class SegmentEngine(Engine):
     """(ref: BadgerEngine badger.go:67 — the durable engine role)"""
 
     COMPACT_RATIO = 0.5
 
-    def __init__(self, data_dir: str, sync: bool = False):
+    _CHK_KEY = b"m:chk"
+    _CHK_PLAINTEXT = b"nornicdb-segment"
+
+    def __init__(self, data_dir: str, sync: bool = False,
+                 passphrase: Optional[str] = None):
         super().__init__()
         os.makedirs(data_dir, exist_ok=True)
         self._kv = _SegKV(os.path.join(data_dir, "graph.seg"), sync=sync)
+        salt_path = os.path.join(data_dir, "seg.salt")
+        try:
+            if passphrase:
+                from nornicdb_tpu.encryption import (
+                    Encryptor,
+                    load_or_create_salt,
+                )
+
+                chk = self._kv.get(self._CHK_KEY)
+                if chk is None and self._kv.count() > 0:
+                    # existing plaintext store: refuse BEFORE persisting a
+                    # salt/sentinel, or the intact data becomes unreachable
+                    # under both open modes
+                    raise NornicError(
+                        "segment store at %r holds unencrypted data; "
+                        "encrypting an existing store in place is not "
+                        "supported (export, then re-import into a store "
+                        "created with the passphrase)" % data_dir
+                    )
+                salt = load_or_create_salt(salt_path)
+                enc = Encryptor.from_passphrase(passphrase, salt)
+                if chk is None:
+                    self._kv.put(
+                        self._CHK_KEY,
+                        enc.encrypt(self._CHK_PLAINTEXT, aad=self._CHK_KEY))
+                else:
+                    try:
+                        ok = (enc.decrypt(chk, aad=self._CHK_KEY)
+                              == self._CHK_PLAINTEXT)
+                    except Exception:
+                        ok = False
+                    if not ok:
+                        raise NornicError(
+                            "segment store: wrong encryption passphrase"
+                        )
+                self._kv = _EncKV(self._kv, enc)
+            elif os.path.exists(salt_path):
+                raise NornicError(
+                    "segment store at %r is encrypted; an "
+                    "encryption_passphrase is required to open it" % data_dir
+                )
+        except BaseException:
+            self._kv.close()
+            raise
         self._lock = threading.RLock()
         # in-memory secondary indexes (ref: Badger prefix scans)
         self._by_label: dict[str, set[str]] = {}
@@ -174,7 +256,13 @@ class SegmentEngine(Engine):
         self._in: dict[str, set[str]] = {}
         self._node_count = 0
         self._edge_count = 0
-        self._rebuild_indexes()
+        try:
+            self._rebuild_indexes()
+        except BaseException:
+            # a corrupted record surfacing here must not leak the native
+            # handle/fd (callers may retry open in a loop)
+            self._kv.close()
+            raise
 
     # -- recovery ------------------------------------------------------------
     def _rebuild_indexes(self) -> None:

@@ -133,16 +133,9 @@ class WAL:
         self.stats = WALStats()
         self._encryptor = None
         if passphrase:
-            from nornicdb_tpu.encryption import Encryptor, new_salt
+            from nornicdb_tpu.encryption import Encryptor, load_or_create_salt
 
-            salt_path = os.path.join(directory, self.SALT_NAME)
-            if os.path.exists(salt_path):
-                with open(salt_path, "rb") as f:
-                    salt = f.read()
-            else:
-                salt = new_salt()
-                with open(salt_path, "wb") as f:
-                    f.write(salt)
+            salt = load_or_create_salt(os.path.join(directory, self.SALT_NAME))
             self._encryptor = Encryptor.from_passphrase(passphrase, salt)
         self._seq = self._scan_last_seq()
         self._f = open(self._path, "ab")

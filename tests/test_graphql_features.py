@@ -214,3 +214,19 @@ def test_include_undefined_variable_is_error(gq):
     )
     assert "errors" in out
     assert "$typo" in out["errors"][0]["message"]
+
+
+def test_conflicting_same_key_fields_rejected(gq):
+    out = gq.execute('query { node(id: "a") { id } node(id: "b") { labels } }')
+    assert "errors" in out
+    assert "conflict" in out["errors"][0]["message"]
+
+
+def test_same_var_args_merge_cleanly(gq):
+    _seed(gq)
+    out = gq.execute(
+        'query Q($l: String = "City") '
+        "{ nodes(label: $l) { id } nodes(label: $l) { labels } }"
+    )
+    assert "errors" not in out
+    assert set(out["data"]["nodes"][0].keys()) == {"id", "labels"}

@@ -113,3 +113,87 @@ class TestSegmentThroughFacade:
         db2 = nornicdb_tpu.open_db(str(tmp_path / "segdb"), cfg)
         assert db2.cypher("MATCH (c:City) RETURN count(c)").rows == [[2]]
         db2.close()
+
+
+# -- at-rest encryption (ref: db.go:781-809 — Badger built-in encryption) ----
+
+class TestSegmentEncryption:
+    def _open(self, d, passphrase=None):
+        from nornicdb_tpu.storage.segment import SegmentEngine
+        return SegmentEngine(d, passphrase=passphrase)
+
+    def test_roundtrip_and_restart(self, tmp_path):
+        from nornicdb_tpu.storage.types import Node
+        d = str(tmp_path / "enc")
+        eng = self._open(d, passphrase="hunter2")
+        n = eng.create_node(Node(labels=["Secret"], properties={"k": "classified"}))
+        eng.close()
+        eng2 = self._open(d, passphrase="hunter2")
+        got = eng2.get_node(n.id)
+        assert got.properties["k"] == "classified"
+        assert got.labels == ["Secret"]
+        eng2.close()
+
+    def test_plaintext_never_on_disk(self, tmp_path):
+        from nornicdb_tpu.storage.types import Node
+        d = str(tmp_path / "enc")
+        eng = self._open(d, passphrase="hunter2")
+        eng.create_node(Node(labels=["Secret"], properties={"k": "classified-payload"}))
+        eng.close()
+        raw = open(f"{d}/graph.seg", "rb").read()
+        assert b"classified-payload" not in raw
+        assert b"Secret" not in raw
+
+    def test_wrong_passphrase_rejected(self, tmp_path):
+        import pytest
+        from nornicdb_tpu.errors import NornicError
+        d = str(tmp_path / "enc")
+        self._open(d, passphrase="right").close()
+        with pytest.raises(NornicError, match="passphrase"):
+            self._open(d, passphrase="wrong")
+
+    def test_missing_passphrase_rejected(self, tmp_path):
+        import pytest
+        from nornicdb_tpu.errors import NornicError
+        d = str(tmp_path / "enc")
+        self._open(d, passphrase="right").close()
+        with pytest.raises(NornicError, match="encrypted"):
+            self._open(d)
+
+    def test_unencrypted_store_still_plain(self, tmp_path):
+        from nornicdb_tpu.storage.types import Node
+        d = str(tmp_path / "plain")
+        eng = self._open(d)
+        eng.create_node(Node(labels=["Open"], properties={"k": 1}))
+        eng.close()
+        assert b"Open" in open(f"{d}/graph.seg", "rb").read()
+
+    def test_db_facade_with_encrypted_segment(self, tmp_path):
+        import nornicdb_tpu
+        from nornicdb_tpu.db import Config
+        d = str(tmp_path / "db")
+        cfg = Config(storage_engine="segment", encryption_passphrase="pp",
+                     embed_enabled=False)
+        db = nornicdb_tpu.open_db(d, cfg)
+        db.cypher("CREATE (:V {name: 'x'})")
+        db.flush()
+        db.close()
+        db2 = nornicdb_tpu.open_db(d, cfg)
+        assert db2.cypher("MATCH (v:V) RETURN count(v)").rows[0][0] == 1
+        db2.close()
+
+    def test_passphrase_on_existing_plaintext_store_refused_safely(self, tmp_path):
+        import os, pytest
+        from nornicdb_tpu.errors import NornicError
+        from nornicdb_tpu.storage.types import Node
+        d = str(tmp_path / "plain2")
+        eng = self._open(d)
+        n = eng.create_node(Node(labels=["Keep"], properties={"k": 1}))
+        eng.close()
+        with pytest.raises(NornicError, match="unencrypted data"):
+            self._open(d, passphrase="pp")
+        # refusal must not have persisted a salt or sentinel: plain reopen works
+        assert not os.path.exists(f"{d}/seg.salt")
+        eng2 = self._open(d)
+        assert eng2.get_node(n.id).properties["k"] == 1
+        eng2.close()
