@@ -14,4 +14,5 @@ __all__ = ["all_functions", "call", "categories", "lookup"]
 
 def register_procedures() -> None:
     """Import the storage-touching procedures into the Cypher registry."""
+    from nornicdb_tpu.apoc import export_import as _export_import  # noqa: F401
     from nornicdb_tpu.apoc import procedures as _procedures  # noqa: F401

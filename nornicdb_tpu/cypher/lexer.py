@@ -91,13 +91,25 @@ def tokenize(query: str) -> list[Token]:
             tokens.append(Token("STRING", "".join(buf), i, line))
             i = j + 1
             continue
-        # backtick-quoted identifiers
+        # backtick-quoted identifiers; `` is an escaped literal backtick
+        # (Neo4j identifier quoting)
         if c == "`":
-            j = query.find("`", i + 1)
-            if j == -1:
-                raise CypherSyntaxError("unterminated backtick identifier", i, line)
-            tokens.append(Token("IDENT", query[i + 1 : j], i, line))
-            i = j + 1
+            parts = []
+            j = i + 1
+            while True:
+                k = query.find("`", j)
+                if k == -1:
+                    raise CypherSyntaxError(
+                        "unterminated backtick identifier", i, line)
+                parts.append(query[j:k])
+                if k + 1 < n and query[k + 1] == "`":
+                    parts.append("`")
+                    j = k + 2
+                else:
+                    j = k + 1
+                    break
+            tokens.append(Token("IDENT", "".join(parts), i, line))
+            i = j
             continue
         # numbers
         if c.isdigit() or (c == "." and i + 1 < n and query[i + 1].isdigit()):
