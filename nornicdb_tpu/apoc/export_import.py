@@ -72,6 +72,16 @@ def _json_payload(nodes: list[Node], rels: list[Edge]) -> str:
     return out.getvalue()
 
 
+_CSV_RESERVED = {"_id", "_labels", "_start", "_end", "_type"}
+
+
+def _csv_col(key: str) -> str:
+    """Header column for a property key; reserved names are aliased so a
+    user property literally named `_id` can't shadow the structural
+    columns."""
+    return "_prop" + key if key in _CSV_RESERVED else key
+
+
 def _csv_payload(nodes: list[Node], rels: list[Edge]) -> str:
     """Union-of-keys header over BOTH node and relationship properties (the
     reference uses first-node keys, which drops columns — deliberately
@@ -81,7 +91,8 @@ def _csv_payload(nodes: list[Node], rels: list[Edge]) -> str:
     w = csv.writer(out)
     prop_keys = sorted({k for n in nodes for k in n.properties}
                        | {k for e in rels for k in e.properties})
-    w.writerow(["_id", "_labels"] + prop_keys + ["_start", "_end", "_type"])
+    w.writerow(["_id", "_labels"] + [_csv_col(k) for k in prop_keys] +
+               ["_start", "_end", "_type"])
     for n in nodes:
         w.writerow([n.id, ";".join(n.labels)] +
                    [_csv_val(n.properties.get(k)) for k in prop_keys] +
@@ -296,13 +307,16 @@ def import_csv(ex: CypherExecutor, args, row):
     if not rows:
         return ["nodes", "relationships"], [[0, 0]]
     header = rows[0]
-    prop_keys = [h for h in header if not h.startswith("_")]
+    # property columns: everything except the structural ones; `_prop<name>`
+    # aliases map back to their reserved-looking original keys
+    prop_cols = [(h, h[5:] if h.startswith("_prop") else h)
+                 for h in header if h not in _CSV_RESERVED]
     idx = {h: i for i, h in enumerate(header)}
     n_nodes = n_rels = 0
     for r in rows[1:]:
         if not r:
             continue
-        props = {k: r[idx[k]] for k in prop_keys if r[idx[k]] != ""}
+        props = {k: r[idx[h]] for h, k in prop_cols if r[idx[h]] != ""}
         if r[idx["_start"]]:  # edge rows are the ones with endpoints
             kwargs = {"id": r[idx["_id"]]} if r[idx["_id"]] else {}
             ex.storage.create_edge(Edge(
@@ -405,7 +419,7 @@ def _parse_label_filter(spec: Optional[str]) -> tuple[set[str], set[str]]:
 
 def _expand(ex, start: Node, rel_spec, label_spec, min_level: int,
             max_level: int, uniqueness: str = "RELATIONSHIP_PATH",
-            limit: Optional[int] = None) -> list[dict]:
+            limit: Optional[int] = None, bfs: bool = False) -> list[dict]:
     out_t, in_t = _parse_rel_filter(rel_spec)
     no_filter = not rel_spec
     white, black = _parse_label_filter(label_spec)
@@ -418,14 +432,20 @@ def _expand(ex, start: Node, rel_spec, label_spec, min_level: int,
             return False
         return True
 
-    # iterative DFS (deep graphs with large maxLevel must not hit the
+    # iterative walk (deep graphs with large maxLevel must not hit the
     # interpreter recursion limit); RELATIONSHIP_PATH uniqueness derives
     # the per-path seen-sets from the path itself, NODE_GLOBAL keeps one
-    # shared visited set (first path to a node claims it — spanning tree)
+    # shared visited set (first path to a node claims it). NODE_GLOBAL
+    # callers (spanningTree) need BFS order so the claiming path is a
+    # shortest one — DFS would claim nodes via long detours and then
+    # truncate their subtrees at maxLevel.
+    from collections import deque
+
     global_seen = {start.id}
-    stack: list[tuple[Node, list[Node], list[Edge]]] = [(start, [start], [])]
+    stack: deque[tuple[Node, list[Node], list[Edge]]] = deque(
+        [(start, [start], [])])
     while stack:
-        node, nodes, rels = stack.pop()
+        node, nodes, rels = stack.popleft() if bfs else stack.pop()
         if limit is not None and len(results) >= limit:
             break
         depth = len(rels)
@@ -501,7 +521,7 @@ def apoc_path_spanning_tree(ex: CypherExecutor, args, row):
     paths = _expand(
         ex, start,
         cfg.get("relationshipFilter"), cfg.get("labelFilter"),
-        1, int(cfg.get("maxLevel", 3)), uniqueness="NODE_GLOBAL",
+        1, int(cfg.get("maxLevel", 3)), uniqueness="NODE_GLOBAL", bfs=True,
     )
     return ["path"], [[p] for p in paths]
 

@@ -172,3 +172,288 @@ def fn_kalman_smooth(values, process_noise=1e-3, measurement_noise=1e-1):
         raise CypherTypeError("kalman.smooth expects a list")
     k = Kalman(KalmanConfig(float(process_noise), float(measurement_noise)))
     return [k.process(float(v)) for v in values]
+
+
+# ---------------------------------------------------------------- algorithms
+# (ref: /root/reference/apoc/algo/ + /root/reference/apoc/community/ —
+# exposed both under gds.* stream procedures and apoc.algo.* aliases)
+
+from nornicdb_tpu.ops import graph_algos as _ga  # noqa: E402
+
+
+def _edge_arrays(ex: CypherExecutor):
+    """Directed (src, dst) index arrays + sorted id list, cached per
+    executor and invalidated on count change (same policy as
+    _cached_graph)."""
+    key = (ex.storage.node_count(), ex.storage.edge_count())
+    cached = getattr(ex, "_algo_graph_cache", None)
+    if cached is not None and cached[0] == key:
+        return cached[1]
+    ids = sorted(n.id for n in ex.storage.all_nodes())
+    index = {id_: i for i, id_ in enumerate(ids)}
+    src, dst = [], []
+    for e in ex.storage.all_edges():
+        a, b = index.get(e.start_node), index.get(e.end_node)
+        if a is not None and b is not None:
+            src.append(a)
+            dst.append(b)
+    out = (ids, index,
+           np.asarray(src, dtype=np.int32), np.asarray(dst, dtype=np.int32))
+    ex._algo_graph_cache = (key, out)
+    return out
+
+
+def _node_rows(ex, ids, values, col):
+    rows = []
+    for i, v in enumerate(values):
+        n = ex.get_node_or_none(ids[i])
+        if n is not None:
+            rows.append([n, v])
+    return ["node", col], rows
+
+
+@procedure("gds.pagerank.stream")
+def proc_pagerank(ex: CypherExecutor, args, row):
+    """(ref: apoc/algo PageRank — damped power iteration, on-TPU
+    segment_sum program)"""
+    cfg = args[0] if args and isinstance(args[0], dict) else {}
+    ids, _, src, dst = _edge_arrays(ex)
+    scores = _ga.pagerank(src, dst, len(ids),
+                          damping=float(cfg.get("dampingFactor", 0.85)),
+                          iters=int(cfg.get("maxIterations", 20)))
+    return _node_rows(ex, ids, [float(s) for s in scores], "score")
+
+
+@procedure("gds.wcc.stream")
+def proc_wcc(ex: CypherExecutor, args, row):
+    """(ref: community WeaklyConnectedComponents — min-label propagation)"""
+    ids, _, src, dst = _edge_arrays(ex)
+    comp = _ga.connected_components(src, dst, len(ids))
+    return _node_rows(ex, ids, [int(c) for c in comp], "componentId")
+
+
+@procedure("gds.scc.stream")
+def proc_scc(ex: CypherExecutor, args, row):
+    """(ref: community StronglyConnectedComponents — Tarjan)"""
+    ids, _, src, dst = _edge_arrays(ex)
+    comp = _ga.strongly_connected_components(src, dst, len(ids))
+    return _node_rows(ex, ids, [int(c) for c in comp], "componentId")
+
+
+@procedure("gds.labelpropagation.stream")
+def proc_label_prop(ex: CypherExecutor, args, row):
+    cfg = args[0] if args and isinstance(args[0], dict) else {}
+    ids, _, src, dst = _edge_arrays(ex)
+    labels = _ga.label_propagation(src, dst, len(ids),
+                                   iters=int(cfg.get("maxIterations", 10)))
+    return _node_rows(ex, ids, [int(c) for c in labels], "communityId")
+
+
+@procedure("gds.louvain.stream")
+def proc_louvain(ex: CypherExecutor, args, row):
+    """(ref: community Louvain — greedy modularity local moves)"""
+    ids, _, src, dst = _edge_arrays(ex)
+    labels = _ga.louvain(src, dst, len(ids))
+    return _node_rows(ex, ids, [int(c) for c in labels], "communityId")
+
+
+@procedure("gds.trianglecount.stream")
+def proc_triangles(ex: CypherExecutor, args, row):
+    ids, _, src, dst = _edge_arrays(ex)
+    tri = _ga.triangle_counts(src, dst, len(ids))
+    return _node_rows(ex, ids, [int(t) for t in tri], "triangleCount")
+
+
+@procedure("gds.localclusteringcoefficient.stream")
+def proc_clustering(ex: CypherExecutor, args, row):
+    ids, _, src, dst = _edge_arrays(ex)
+    cc = _ga.clustering_coefficient(src, dst, len(ids))
+    return _node_rows(ex, ids, [float(c) for c in cc], "localClusteringCoefficient")
+
+
+_ORIENTATIONS = {
+    # GDS-standard names plus the plain aliases
+    "natural": "out", "reverse": "in", "undirected": "both",
+    "out": "out", "in": "in", "both": "both",
+}
+
+
+@procedure("gds.degree.stream")
+def proc_degree(ex: CypherExecutor, args, row):
+    cfg = args[0] if args and isinstance(args[0], dict) else {}
+    raw = str(cfg.get("orientation", "UNDIRECTED")).lower()
+    direction = _ORIENTATIONS.get(raw)
+    if direction is None:
+        raise CypherSyntaxError(
+            f"gds.degree.stream: unknown orientation {raw!r} "
+            "(NATURAL, REVERSE, UNDIRECTED)")
+    ids, _, src, dst = _edge_arrays(ex)
+    deg = _ga.degree_centrality(src, dst, len(ids), direction=direction)
+    return _node_rows(ex, ids, [float(d) for d in deg], "score")
+
+
+@procedure("gds.closeness.stream")
+def proc_closeness(ex: CypherExecutor, args, row):
+    ids, _, src, dst = _edge_arrays(ex)
+    c = _ga.closeness_centrality(src, dst, len(ids))
+    return _node_rows(ex, ids, [float(x) for x in c], "score")
+
+
+@procedure("gds.betweenness.stream")
+def proc_betweenness(ex: CypherExecutor, args, row):
+    ids, _, src, dst = _edge_arrays(ex)
+    b = _ga.betweenness_centrality(src, dst, len(ids))
+    return _node_rows(ex, ids, [float(x) for x in b], "score")
+
+
+@procedure("gds.kcore.stream")
+def proc_kcore(ex: CypherExecutor, args, row):
+    ids, _, src, dst = _edge_arrays(ex)
+    core = _ga.k_core(src, dst, len(ids))
+    return _node_rows(ex, ids, [int(c) for c in core], "coreValue")
+
+
+@procedure("gds.graph.density")
+def proc_density(ex: CypherExecutor, args, row):
+    ids, _, src, dst = _edge_arrays(ex)
+    return ["density"], [[_ga.density(src, dst, len(ids))]]
+
+
+@procedure("gds.modularity")
+def proc_modularity(ex: CypherExecutor, args, row):
+    """gds.modularity(communityMap) — {nodeId/elementId: communityId}."""
+    if not args or not isinstance(args[0], dict):
+        raise CypherSyntaxError("gds.modularity({nodeId: communityId})")
+    ids, index, src, dst = _edge_arrays(ex)
+    labels = np.arange(len(ids))
+    for nid, c in args[0].items():
+        i = index.get(str(nid))
+        if i is not None:
+            labels[i] = int(c)
+    return ["modularity"], [[_ga.modularity(src, dst, len(ids), labels)]]
+
+
+def _weighted_adj(ex, index, weight_prop, orientation: str = "natural"):
+    """Directed by default (GDS NATURAL); UNDIRECTED symmetrizes. Self-loops
+    contribute one entry either way."""
+    undirected = str(orientation).lower() == "undirected"
+    adj: dict[int, list[tuple[int, float]]] = {}
+    for e in ex.storage.all_edges():
+        a, b = index.get(e.start_node), index.get(e.end_node)
+        if a is None or b is None:
+            continue
+        w = 1.0
+        if weight_prop:
+            try:
+                w = float(e.properties.get(weight_prop, 1.0))
+            except (TypeError, ValueError):
+                w = 1.0
+        adj.setdefault(a, []).append((b, w))
+        if undirected and b != a:
+            adj.setdefault(b, []).append((a, w))
+    return adj
+
+
+def _path_edges(ex, ids, path_idx, weight_prop):
+    """Cheapest connecting edge per consecutive node pair, so the returned
+    __path__ carries real relationships (length()/apoc.path.* depend on
+    them)."""
+    rels = []
+    for i, j in zip(path_idx, path_idx[1:]):
+        best = None
+        best_w = None
+        # an UNDIRECTED search may traverse an edge against its direction,
+        # so check both orientations for the connecting relationship
+        candidates = [e for e in ex.storage.get_outgoing_edges(ids[i])
+                      if e.end_node == ids[j]]
+        candidates += [e for e in ex.storage.get_incoming_edges(ids[i])
+                       if e.start_node == ids[j]]
+        for e in candidates:
+            w = 1.0
+            if weight_prop:
+                try:
+                    w = float(e.properties.get(weight_prop, 1.0))
+                except (TypeError, ValueError):
+                    w = 1.0
+            if best is None or w < best_w:
+                best, best_w = e, w
+        if best is not None:
+            rels.append(best)
+    return rels
+
+
+@procedure("gds.shortestpath.dijkstra.stream")
+def proc_dijkstra(ex: CypherExecutor, args, row):
+    """gds.shortestPath.dijkstra.stream(source, target, config) —
+    config.relationshipWeightProperty selects the cost property."""
+    if len(args) < 2:
+        raise CypherSyntaxError(
+            "gds.shortestPath.dijkstra.stream(source, target, config)")
+    src_n, dst_n = args[0], args[1]
+    cfg = args[2] if len(args) > 2 and isinstance(args[2], dict) else {}
+    ids, index, _, _ = _edge_arrays(ex)
+    s, t = index.get(src_n.id), index.get(dst_n.id)
+    if s is None or t is None:
+        return ["totalCost", "nodeIds", "path"], []
+    weight_prop = cfg.get("relationshipWeightProperty")
+    adj = _weighted_adj(ex, index, weight_prop,
+                        orientation=cfg.get("orientation", "natural"))
+    dist, prev = _ga.dijkstra(adj, s, goal=t)
+    if t not in dist:
+        return ["totalCost", "nodeIds", "path"], []
+    path_idx = _ga.reconstruct_path(prev, s, t)
+    nodes = [ex.get_node_or_none(ids[i]) for i in path_idx]
+    rels = _path_edges(ex, ids, path_idx, weight_prop)
+    return (["totalCost", "nodeIds", "path"],
+            [[dist[t], [ids[i] for i in path_idx],
+              {"__path__": True, "nodes": nodes, "relationships": rels}]])
+
+
+@procedure("gds.shortestpath.astar.stream")
+def proc_astar(ex: CypherExecutor, args, row):
+    """A* with haversine heuristic over config.latitudeProperty/
+    longitudeProperty (ref: apoc/algo AStar)."""
+    if len(args) < 2:
+        raise CypherSyntaxError(
+            "gds.shortestPath.astar.stream(source, target, config)")
+    src_n, dst_n = args[0], args[1]
+    cfg = args[2] if len(args) > 2 and isinstance(args[2], dict) else {}
+    lat_p = cfg.get("latitudeProperty", "latitude")
+    lon_p = cfg.get("longitudeProperty", "longitude")
+    ids, index, _, _ = _edge_arrays(ex)
+    s, t = index.get(src_n.id), index.get(dst_n.id)
+    if s is None or t is None:
+        return ["totalCost", "nodeIds"], []
+    coords = {}
+    for nid, i in index.items():
+        n = ex.get_node_or_none(nid)
+        if n is not None and lat_p in n.properties and lon_p in n.properties:
+            coords[i] = (float(n.properties[lat_p]), float(n.properties[lon_p]))
+    goal_xy = coords.get(t)
+
+    def heuristic(v):
+        xy = coords.get(v)
+        if xy is None or goal_xy is None:
+            return 0.0
+        from nornicdb_tpu.apoc.functions_ext import spatial_distance
+        return spatial_distance(
+            {"latitude": xy[0], "longitude": xy[1]},
+            {"latitude": goal_xy[0], "longitude": goal_xy[1]})
+
+    adj = _weighted_adj(ex, index, cfg.get("relationshipWeightProperty"),
+                        orientation=cfg.get("orientation", "natural"))
+    dist, prev = _ga.dijkstra(adj, s, goal=t, heuristic=heuristic)
+    if t not in dist:
+        return ["totalCost", "nodeIds"], []
+    path_idx = _ga.reconstruct_path(prev, s, t)
+    return ["totalCost", "nodeIds"], [[dist[t], [ids[i] for i in path_idx]]]
+
+
+# apoc.algo.* aliases (the reference exposes the same algorithms there)
+procedure("apoc.algo.pagerank")(proc_pagerank)
+procedure("apoc.algo.betweenness")(proc_betweenness)
+procedure("apoc.algo.closeness")(proc_closeness)
+procedure("apoc.algo.community")(proc_louvain)
+procedure("apoc.algo.wcc")(proc_wcc)
+procedure("apoc.algo.dijkstra")(proc_dijkstra)
+procedure("apoc.algo.astar")(proc_astar)

@@ -302,3 +302,33 @@ def test_path_expand_deep_chain_no_recursion_error(ex):
         "maxLevel: 100000}) YIELD path RETURN count(path)"
     )
     assert res.rows[0][0] == 1199
+
+
+def test_csv_roundtrip_with_reserved_property_names(ex, tmp_path, monkeypatch):
+    monkeypatch.setenv("NORNICDB_APOC_EXPORT_ENABLED", "1")
+    monkeypatch.setenv("NORNICDB_APOC_IMPORT_ENABLED", "1")
+    ex.execute("CREATE (a:T {`_id`: 'boom', `_weird`: 'w'})-[:L]->(b:T2)")
+    f = str(tmp_path / "res.csv")
+    ex.execute(f"CALL apoc.export.csv.all('{f}')")
+    ex2 = _fresh_ex()
+    ex2.execute(f"CALL apoc.import.csv('{f}')")
+    got = ex2.execute("MATCH (t:T) RETURN t.`_id`, t.`_weird`")
+    assert got.rows[0] == ["boom", "w"]
+    # the edge survived: endpoints resolved by REAL ids, not the prop
+    assert ex2.execute("MATCH (:T)-[l:L]->(:T2) RETURN count(l)").rows[0][0] == 1
+
+
+def test_spanning_tree_bfs_reaches_via_shortest(ex):
+    # DFS would claim y via the long branch and truncate z at maxLevel
+    from nornicdb_tpu.storage.types import Edge, Node
+    for nid in ["a", "b", "c", "y", "d", "z"]:
+        ex.storage.create_node(Node(id=nid, labels=["S2"], properties={"name": nid}))
+    for s, t in [("a", "b"), ("b", "c"), ("c", "y"), ("a", "d"), ("d", "y"),
+                 ("y", "z")]:
+        ex.storage.create_edge(Edge(start_node=s, end_node=t, type="R"))
+    res = ex.execute(
+        "MATCH (a:S2 {name: 'a'}) "
+        "CALL apoc.path.spanningTree(a, {maxLevel: 3}) "
+        "YIELD path RETURN count(path)"
+    )
+    assert res.rows[0][0] == 5  # b, c, d, y, z all reached

@@ -193,11 +193,15 @@ class TestCrashRecoveryE2E:
             [sys.executable, str(script)], stdout=subprocess.PIPE, text=True,
             env={**os.environ, "JAX_PLATFORMS": "cpu"},
         )
-        # wait until it has written a decent stream, then kill -9
+        # wait until it has written a decent stream, then kill -9. Generous
+        # deadline: the subprocess cold-imports jax, which under full-suite
+        # load can take tens of seconds before the first write.
         written = 0
-        deadline = time.time() + 60
+        deadline = time.time() + 180
         while time.time() < deadline:
             line = proc.stdout.readline()
+            if not line:  # writer died before reaching the target
+                break
             if line.startswith("W "):
                 written = int(line.split()[1])
                 if written >= 25:

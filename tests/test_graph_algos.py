@@ -1,0 +1,300 @@
+"""Graph algorithms: ops-level numerics + gds.* procedure surface
+(ref: apoc/algo/*_test.go, apoc/community/*_test.go)."""
+
+import numpy as np
+import pytest
+
+from nornicdb_tpu.cypher.executor import CypherExecutor
+from nornicdb_tpu.ops import graph_algos as ga
+from nornicdb_tpu.storage.schema import SchemaManager
+from nornicdb_tpu.storage.types import MemoryEngine
+
+
+# -- ops level ---------------------------------------------------------------
+
+def _star():
+    # hub 0 <- spokes 1..4
+    src = np.array([1, 2, 3, 4], dtype=np.int32)
+    dst = np.array([0, 0, 0, 0], dtype=np.int32)
+    return src, dst, 5
+
+
+def test_pagerank_hub_dominates():
+    src, dst, n = _star()
+    r = ga.pagerank(src, dst, n)
+    assert r[0] == max(r)
+    assert r.sum() == pytest.approx(1.0, abs=1e-3)
+
+
+def test_pagerank_empty_graph():
+    assert list(ga.pagerank(np.array([], dtype=np.int32),
+                            np.array([], dtype=np.int32), 3)) == [
+        pytest.approx(1 / 3)] * 3
+
+
+def test_wcc_two_components():
+    src = np.array([0, 1, 3], dtype=np.int32)
+    dst = np.array([1, 2, 4], dtype=np.int32)
+    comp = ga.connected_components(src, dst, 5)
+    assert comp[0] == comp[1] == comp[2]
+    assert comp[3] == comp[4]
+    assert comp[0] != comp[3]
+
+
+def test_scc_cycle_vs_chain():
+    # 0->1->2->0 is one SCC; 3->4 are singletons
+    src = np.array([0, 1, 2, 3], dtype=np.int32)
+    dst = np.array([1, 2, 0, 4], dtype=np.int32)
+    comp = ga.strongly_connected_components(src, dst, 5)
+    assert comp[0] == comp[1] == comp[2]
+    assert len({comp[3], comp[4], comp[0]}) == 3
+
+
+def test_label_propagation_two_cliques():
+    # two triangles joined by one bridge edge
+    src = np.array([0, 1, 2, 3, 4, 5, 2], dtype=np.int32)
+    dst = np.array([1, 2, 0, 4, 5, 3, 3], dtype=np.int32)
+    labels = ga.label_propagation(src, dst, 6, iters=20)
+    assert labels[0] == labels[1] == labels[2]
+    assert labels[3] == labels[4] == labels[5]
+
+
+def test_louvain_two_cliques_and_modularity():
+    src = np.array([0, 1, 2, 3, 4, 5, 2], dtype=np.int32)
+    dst = np.array([1, 2, 0, 4, 5, 3, 3], dtype=np.int32)
+    labels = ga.louvain(src, dst, 6)
+    assert labels[0] == labels[1] == labels[2]
+    assert labels[3] == labels[4] == labels[5]
+    assert labels[0] != labels[3]
+    q = ga.modularity(src, dst, 6, labels)
+    assert q > 0.25  # clearly better than random
+    assert ga.modularity(src, dst, 6, np.zeros(6)) == pytest.approx(0.0, abs=1e-9)
+
+
+def test_triangles_and_clustering():
+    # triangle 0-1-2 plus pendant 3
+    src = np.array([0, 1, 2, 2], dtype=np.int32)
+    dst = np.array([1, 2, 0, 3], dtype=np.int32)
+    tri = ga.triangle_counts(src, dst, 4)
+    assert list(tri) == [1, 1, 1, 0]
+    cc = ga.clustering_coefficient(src, dst, 4)
+    assert cc[0] == pytest.approx(1.0)
+    assert cc[2] == pytest.approx(1 / 3)  # deg 3, one closed pair
+
+
+def test_degree_closeness_betweenness_path():
+    # path 0-1-2-3-4: middle node 2 has max betweenness
+    src = np.array([0, 1, 2, 3], dtype=np.int32)
+    dst = np.array([1, 2, 3, 4], dtype=np.int32)
+    deg = ga.degree_centrality(src, dst, 5)
+    assert deg[2] == 2.0 and deg[0] == 1.0
+    b = ga.betweenness_centrality(src, dst, 5)
+    assert b[2] == max(b)
+    assert b[0] == 0.0
+    c = ga.closeness_centrality(src, dst, 5)
+    assert c[2] == max(c)
+
+
+def test_kcore_peeling():
+    # clique of 4 (core 3) with a tail (core 1)
+    src = np.array([0, 0, 0, 1, 1, 2, 3], dtype=np.int32)
+    dst = np.array([1, 2, 3, 2, 3, 3, 4], dtype=np.int32)
+    core = ga.k_core(src, dst, 5)
+    assert list(core[:4]) == [3, 3, 3, 3]
+    assert core[4] == 1
+
+
+def test_dijkstra_weighted_and_astar_heuristic():
+    adj = {0: [(1, 1.0), (2, 5.0)], 1: [(2, 1.0)], 2: []}
+    dist, prev = ga.dijkstra(adj, 0, goal=2)
+    assert dist[2] == 2.0
+    assert ga.reconstruct_path(prev, 0, 2) == [0, 1, 2]
+    # admissible zero heuristic == dijkstra
+    dist2, _ = ga.dijkstra(adj, 0, goal=2, heuristic=lambda v: 0.0)
+    assert dist2[2] == 2.0
+
+
+def test_density_and_conductance():
+    src = np.array([0, 1], dtype=np.int32)
+    dst = np.array([1, 2], dtype=np.int32)
+    assert ga.density(src, dst, 3) == pytest.approx(2 / 6)
+    labels = np.array([0, 0, 1])
+    # one cut edge (1-2); vol(S)=3 endpoints, vol(~S)=1
+    assert ga.conductance(src, dst, 3, labels, 1) == pytest.approx(1.0)
+
+
+# -- procedure surface -------------------------------------------------------
+
+@pytest.fixture
+def ex():
+    storage = MemoryEngine()
+    schema = SchemaManager()
+    schema.attach(storage)
+    return CypherExecutor(storage, schema=schema)
+
+
+def _communities(ex):
+    ex.execute(
+        "CREATE (a:P {g: 1}), (b:P {g: 1}), (c:P {g: 1}), "
+        "(d:P {g: 2}), (e:P {g: 2}), (f:P {g: 2}), "
+        "(a)-[:R]->(b), (b)-[:R]->(c), (c)-[:R]->(a), "
+        "(d)-[:R]->(e), (e)-[:R]->(f), (f)-[:R]->(d), (c)-[:R]->(d)"
+    )
+
+
+def test_gds_pagerank_stream(ex):
+    _communities(ex)
+    res = ex.execute(
+        "CALL gds.pageRank.stream() YIELD node, score "
+        "RETURN node.g, score ORDER BY score DESC"
+    )
+    assert len(res.rows) == 6
+    assert all(isinstance(r[1], float) for r in res.rows)
+
+
+def test_gds_louvain_and_wcc_stream(ex):
+    _communities(ex)
+    res = ex.execute(
+        "CALL gds.louvain.stream() YIELD node, communityId "
+        "RETURN node.g AS g, communityId"
+    )
+    by_group = {}
+    for g, c in res.rows:
+        by_group.setdefault(g, set()).add(c)
+    assert len(by_group[1]) == 1 and len(by_group[2]) == 1
+    assert by_group[1] != by_group[2]
+    res = ex.execute(
+        "CALL gds.wcc.stream() YIELD componentId RETURN count(DISTINCT componentId)"
+    )
+    assert res.rows[0][0] == 1  # bridge joins everything weakly
+
+
+def test_gds_triangle_and_degree_stream(ex):
+    _communities(ex)
+    res = ex.execute(
+        "CALL gds.triangleCount.stream() YIELD triangleCount "
+        "RETURN sum(triangleCount)"
+    )
+    assert res.rows[0][0] == 6  # 2 triangles × 3 member nodes
+    res = ex.execute(
+        "CALL gds.degree.stream() YIELD score RETURN max(score)"
+    )
+    assert res.rows[0][0] == 3.0
+
+
+def test_gds_dijkstra_stream_weighted(ex):
+    ex.execute(
+        "CREATE (a:C {name:'a'}), (b:C {name:'b'}), (c:C {name:'c'}), "
+        "(a)-[:ROAD {cost: 1.0}]->(b), (b)-[:ROAD {cost: 1.0}]->(c), "
+        "(a)-[:ROAD {cost: 5.0}]->(c)"
+    )
+    res = ex.execute(
+        "MATCH (a:C {name:'a'}), (c:C {name:'c'}) "
+        "CALL gds.shortestPath.dijkstra.stream(a, c, "
+        "{relationshipWeightProperty: 'cost'}) "
+        "YIELD totalCost, nodeIds RETURN totalCost, size(nodeIds)"
+    )
+    assert res.rows[0][0] == 2.0
+    assert res.rows[0][1] == 3
+
+
+def test_gds_astar_stream(ex):
+    ex.execute(
+        "CREATE (a:G {name:'a', lat: 0.0, lon: 0.0}), "
+        "(b:G {name:'b', lat: 0.5, lon: 0.5}), "
+        "(c:G {name:'c', lat: 1.0, lon: 1.0}), "
+        "(a)-[:E]->(b), (b)-[:E]->(c)"
+    )
+    res = ex.execute(
+        "MATCH (a:G {name:'a'}), (c:G {name:'c'}) "
+        "CALL gds.shortestPath.astar.stream(a, c, "
+        "{latitudeProperty: 'lat', longitudeProperty: 'lon'}) "
+        "YIELD totalCost, nodeIds RETURN totalCost, size(nodeIds)"
+    )
+    assert res.rows[0] == [2.0, 3]
+
+
+def test_apoc_algo_aliases(ex):
+    _communities(ex)
+    res = ex.execute(
+        "CALL apoc.algo.pageRank() YIELD score RETURN count(score)"
+    )
+    assert res.rows[0][0] == 6
+
+
+def test_unreachable_dijkstra_empty(ex):
+    ex.execute("CREATE (a:I {name:'a'}), (b:I {name:'b'})")
+    res = ex.execute(
+        "MATCH (a:I {name:'a'}), (b:I {name:'b'}) "
+        "CALL gds.shortestPath.dijkstra.stream(a, b, {}) "
+        "YIELD totalCost RETURN totalCost"
+    )
+    assert res.rows == []
+
+
+# -- review regressions -----------------------------------------------------
+
+def test_degree_gds_orientations(ex):
+    ex.execute("CREATE (a:O)-[:R]->(b:O)")
+    res = ex.execute(
+        "CALL gds.degree.stream({orientation: 'UNDIRECTED'}) YIELD score "
+        "RETURN sum(score)"
+    )
+    assert res.rows[0][0] == 2.0
+    res = ex.execute(
+        "CALL gds.degree.stream({orientation: 'NATURAL'}) YIELD score "
+        "RETURN max(score)"
+    )
+    assert res.rows[0][0] == 1.0
+    from nornicdb_tpu.errors import CypherSyntaxError
+    with pytest.raises(CypherSyntaxError, match="orientation"):
+        ex.execute("CALL gds.degree.stream({orientation: 'SIDEWAYS'})")
+
+
+def test_dijkstra_respects_direction(ex):
+    # a->b, c->b: no directed path a..c
+    ex.execute(
+        "CREATE (a:D2 {name:'a'})-[:R]->(b:D2 {name:'b'}), "
+        "(c:D2 {name:'c'})-[:R]->(b)"
+    )
+    res = ex.execute(
+        "MATCH (a:D2 {name:'a'}), (c:D2 {name:'c'}) "
+        "CALL gds.shortestPath.dijkstra.stream(a, c, {}) "
+        "YIELD totalCost RETURN totalCost"
+    )
+    assert res.rows == []
+    # but UNDIRECTED finds a->b<-c
+    res = ex.execute(
+        "MATCH (a:D2 {name:'a'}), (c:D2 {name:'c'}) "
+        "CALL gds.shortestPath.dijkstra.stream(a, c, "
+        "{orientation: 'UNDIRECTED'}) YIELD totalCost RETURN totalCost"
+    )
+    assert res.rows[0][0] == 2.0
+
+
+def test_dijkstra_path_has_relationships(ex):
+    ex.execute(
+        "CREATE (a:D3 {name:'a'})-[:R {cost: 1.0}]->(b:D3 {name:'b'})"
+        "-[:R {cost: 1.0}]->(c:D3 {name:'c'})"
+    )
+    res = ex.execute(
+        "MATCH (a:D3 {name:'a'}), (c:D3 {name:'c'}) "
+        "CALL gds.shortestPath.dijkstra.stream(a, c, "
+        "{relationshipWeightProperty: 'cost'}) "
+        "YIELD path RETURN length(path), size(relationships(path))"
+    )
+    assert res.rows[0] == [2, 2]
+
+
+def test_undirected_dijkstra_path_relationships_complete(ex):
+    # path traverses f<-e<-d against edge direction
+    ex.execute(
+        "CREATE (a:U {n:'a'})-[:R]->(b:U {n:'b'}), (c:U {n:'c'})-[:R]->(b)"
+    )
+    res = ex.execute(
+        "MATCH (a:U {n:'a'}), (c:U {n:'c'}) "
+        "CALL gds.shortestPath.dijkstra.stream(a, c, "
+        "{orientation: 'UNDIRECTED'}) "
+        "YIELD totalCost, path RETURN totalCost, length(path)"
+    )
+    assert res.rows[0] == [2.0, 2]
