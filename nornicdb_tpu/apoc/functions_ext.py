@@ -912,3 +912,86 @@ def xml_get_text(s, tag):
         return None
     el = root if root.tag == tag else root.find(f".//{tag}")
     return (el.text or "").strip() if el is not None else None
+
+
+# ---------------------------------------------------------------------------
+# apoc.agg.* gaps (ref: apoc/agg — Nth/Slice/Mode/MinItems/MaxItems/
+# Frequencies; the rest live in functions.py)
+# ---------------------------------------------------------------------------
+
+
+@register("apoc.agg.nth", category="agg")
+def agg_nth(xs, offset):
+    xs = list(xs or [])
+    i = int(offset)
+    return xs[i] if -len(xs) <= i < len(xs) else None
+
+
+@register("apoc.agg.slice", category="agg")
+def agg_slice(xs, start=0, length=None):
+    xs = list(xs or [])
+    start = int(start)
+    if length is None:
+        return xs[start:]
+    return xs[start : start + int(length)]
+
+
+def _agg_key(v: Any) -> Any:
+    """Canonical hashable key for Cypher values (lists/maps are legal
+    aggregation inputs but unhashable in Python)."""
+    if isinstance(v, (list, dict)):
+        return _json.dumps(v, sort_keys=True, default=str)
+    return v
+
+
+@register("apoc.agg.mode", category="agg")
+def agg_mode(xs):
+    xs = [x for x in (xs or []) if x is not None]
+    if not xs:
+        return None
+    counts: dict[Any, int] = {}
+    for x in xs:
+        k = _agg_key(x)
+        counts[k] = counts.get(k, 0) + 1
+    best = max(counts.values())
+    # deterministic: first value reaching the max count
+    for x in xs:
+        if counts[_agg_key(x)] == best:
+            return x
+    return None
+
+
+@register("apoc.agg.minItems", category="agg")
+def agg_min_items(items, values=None):
+    """All items tied for the minimum value. One-arg form reduces the list
+    itself; two-arg form pairs items with their sort values."""
+    items = list(items or [])
+    vals = list(values) if values is not None else items
+    pairs = [(v, i) for i, v in zip(items, vals) if v is not None]
+    if not pairs:
+        return {"value": None, "items": []}
+    lo = min(p[0] for p in pairs)
+    return {"value": lo, "items": [i for v, i in pairs if v == lo]}
+
+
+@register("apoc.agg.maxItems", category="agg")
+def agg_max_items(items, values=None):
+    items = list(items or [])
+    vals = list(values) if values is not None else items
+    pairs = [(v, i) for i, v in zip(items, vals) if v is not None]
+    if not pairs:
+        return {"value": None, "items": []}
+    hi = max(p[0] for p in pairs)
+    return {"value": hi, "items": [i for v, i in pairs if v == hi]}
+
+
+@register("apoc.agg.frequencies", category="agg")
+def agg_frequencies(xs):
+    counts: dict[Any, int] = {}
+    order: list[tuple[Any, Any]] = []  # (key, original value)
+    for x in xs or []:
+        k = _agg_key(x)
+        if k not in counts:
+            order.append((k, x))
+        counts[k] = counts.get(k, 0) + 1
+    return [{"item": x, "count": counts[k]} for k, x in order]

@@ -263,6 +263,13 @@ class SegmentEngine(Engine):
             # handle/fd (callers may retry open in a loop)
             self._kv.close()
             raise
+        # GC: every mutation path ratio-checks inline (_maybe_compact at
+        # the create/update/delete sites), which covers steady state
+        # without a background thread. The only gap is garbage above the
+        # ratio left behind by a previous run — collect it once now,
+        # post-recovery. (The reference needs Badger's value-log GC ticker
+        # because its LSM defers reclamation; our inline check doesn't.)
+        self._maybe_compact()
 
     # -- recovery ------------------------------------------------------------
     def _rebuild_indexes(self) -> None:
@@ -285,8 +292,10 @@ class SegmentEngine(Engine):
             self._edge_count += 1
 
     def _maybe_compact(self) -> None:
-        live = self._kv.count()
-        if live and self._kv.tombstones() / max(live, 1) > self.COMPACT_RATIO:
+        # no `live and` guard: a store whose every record was deleted
+        # (live == 0, tombstones > 0) is exactly the one most worth
+        # compacting — the old guard let that garbage grow unbounded
+        if self._kv.tombstones() / max(self._kv.count(), 1) > self.COMPACT_RATIO:
             self._kv.compact()
 
     # -- nodes ----------------------------------------------------------------
@@ -504,4 +513,5 @@ class SegmentEngine(Engine):
             self._kv.compact()
 
     def close(self) -> None:
-        self._kv.close()
+        with self._lock:
+            self._kv.close()

@@ -332,3 +332,15 @@ def test_first_column_no_args_is_syntax_error(ex):
     from nornicdb_tpu.errors import CypherSyntaxError
     with pytest.raises(CypherSyntaxError):
         ex.execute("CALL apoc.cypher.runFirstColumnSingle()")
+
+
+def test_agg_gap_functions():
+    assert call("apoc.agg.nth", [10, 20, 30], 1) == 20
+    assert call("apoc.agg.nth", [10], 5) is None
+    assert call("apoc.agg.slice", [1, 2, 3, 4], 1, 2) == [2, 3]
+    assert call("apoc.agg.mode", [1, 2, 2, 3]) == 2
+    assert call("apoc.agg.mode", [[1], [1], [2]]) == [1]  # unhashable values ok
+    mi = call("apoc.agg.minItems", ["a", "b", "c"], [2, 1, 1])
+    assert mi == {"value": 1, "items": ["b", "c"]}
+    fr = call("apoc.agg.frequencies", [{"k": 1}, {"k": 1}, {"k": 2}])
+    assert fr[0] == {"item": {"k": 1}, "count": 2}
