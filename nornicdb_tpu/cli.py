@@ -159,6 +159,41 @@ def cmd_export(args) -> int:
     return 0
 
 
+def _require_data_dir(args) -> bool:
+    """backup/restore against an empty data dir would silently operate on an
+    ephemeral in-memory engine — success messages with nothing persisted."""
+    if not args.data_dir:
+        print("error: --data-dir (or NORNICDB_DATA_DIR) is required for "
+              "this command", file=sys.stderr)
+        return False
+    return True
+
+
+def cmd_backup(args) -> int:
+    """Full-fidelity backup archive (ref: badger_backup.go role)."""
+    if not _require_data_dir(args):
+        return 2
+    db = _open_db(args)
+    try:
+        path = db.backup(args.file if args.file != "-" else None)
+    finally:
+        db.close()
+    print(f"backup written to {path}")
+    return 0
+
+
+def cmd_restore(args) -> int:
+    if not _require_data_dir(args):
+        return 2
+    db = _open_db(args)
+    try:
+        counts = db.restore(args.file)
+    finally:
+        db.close()
+    print(f"restored {counts['nodes']} nodes, {counts['edges']} edges")
+    return 0
+
+
 def cmd_eval(args) -> int:
     """Search-quality evaluation (ref: cmd/eval, pkg/eval harness)."""
     from nornicdb_tpu.embed import HashEmbedder
@@ -230,6 +265,15 @@ def main(argv=None) -> int:
     s = sub.add_parser("export", help="export the graph as Neo4j-style JSON")
     s.add_argument("file", help="output path, or - for stdout")
     s.set_defaults(fn=cmd_export)
+
+    s = sub.add_parser("backup", help="write a full-fidelity backup archive")
+    s.add_argument("file", nargs="?", default="-",
+                   help="output .json.gz path (default: <data-dir>/backups/)")
+    s.set_defaults(fn=cmd_backup)
+
+    s = sub.add_parser("restore", help="restore a backup archive")
+    s.add_argument("file", help="backup .json.gz path")
+    s.set_defaults(fn=cmd_restore)
 
     s = sub.add_parser("eval", help="run a search-quality evaluation suite")
     s.add_argument("suite", help="JSON suite: [{query, relevant: [ids]}]")

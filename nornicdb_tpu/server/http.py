@@ -12,6 +12,7 @@ from __future__ import annotations
 
 import base64
 import json
+import os
 import re
 import threading
 import time
@@ -442,6 +443,23 @@ class HttpServer:
             h._auth("admin")
             n = self.db.search.build_indexes()
             h._send(200, {"indexed": n})
+            return
+        if path == "/admin/backup":
+            # (ref: server_router.go /admin/backup -> badger_backup.go)
+            h._auth("admin")
+            body = h._body()
+            dest = self.db.backup(body.get("path") or None)
+            h._send(200, {"file": dest})
+            return
+        if path == "/admin/restore":
+            h._auth("admin")
+            body = h._body()
+            src = body.get("path", "")
+            if not src or not os.path.exists(src):
+                h._send(400, {"error": f"backup file not found: {src!r}"})
+                return
+            counts = self.db.restore(src)
+            h._send(200, counts)
             return
         if path == "/auth/login":
             body = h._body()

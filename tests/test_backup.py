@@ -1,0 +1,122 @@
+"""Backup/restore: full-fidelity archive incl. embeddings + schema
+(ref: badger_backup.go, /admin/backup in server_router.go)."""
+
+import gzip
+import json
+
+import numpy as np
+import pytest
+
+import nornicdb_tpu
+from nornicdb_tpu.db import Config
+
+
+def test_backup_restore_full_fidelity(tmp_path):
+    d = str(tmp_path / "src")
+    db = nornicdb_tpu.open_db(d, Config(embed_enabled=False))
+    db.cypher("CREATE (:Doc {text: 'hello'})-[:REL {w: 2}]->(:Doc {text: 'world'})")
+    # give one node an embedding + decay state (export_json would drop these)
+    node = next(iter(db.storage.all_nodes()))
+    node.embedding = [0.1, 0.2, 0.3]
+    node.decay_score = 0.7
+    db.storage.update_node(node)
+    db.schema.create_index("idx_doc", "property", "Doc", ["text"])
+    db.flush()
+    path = db.backup(str(tmp_path / "b.json.gz"))
+    db.close()
+
+    db2 = nornicdb_tpu.open_db("", Config(embed_enabled=False))
+    counts = db2.restore(path)
+    assert counts == {"nodes": 2, "edges": 1}
+    restored = db2.storage.get_node(node.id)
+    assert list(np.asarray(restored.embedding)) == pytest.approx([0.1, 0.2, 0.3])
+    assert restored.decay_score == 0.7
+    assert db2.schema.find_index("Doc", ["text"]) is not None
+    assert db2.cypher("MATCH (:Doc)-[r:REL]->(:Doc) RETURN r.w").rows == [[2]]
+    db2.close()
+
+
+def test_backup_default_path_and_atomicity(tmp_path):
+    import os
+    d = str(tmp_path / "src")
+    db = nornicdb_tpu.open_db(d, Config(embed_enabled=False))
+    db.cypher("CREATE (:X)")
+    path = db.backup()
+    assert path.startswith(os.path.join(d, "backups"))
+    assert path.endswith(".json.gz")
+    assert not os.path.exists(path + ".tmp")  # atomic rename, no debris
+    with gzip.open(path, "rt") as f:
+        payload = json.load(f)
+    assert payload["version"] == 1 and len(payload["nodes"]) == 1
+    db.close()
+
+
+def test_restore_skip_existing(tmp_path):
+    d = str(tmp_path / "src")
+    db = nornicdb_tpu.open_db(d, Config(embed_enabled=False))
+    db.cypher("CREATE (:Y {k: 1})")
+    path = db.backup()
+    counts = db.restore(path)  # restoring into itself: everything exists
+    assert counts == {"nodes": 0, "edges": 0}
+    assert db.cypher("MATCH (y:Y) RETURN count(y)").rows == [[1]]
+    db.close()
+
+
+# -- review regressions -----------------------------------------------------
+
+def test_restored_indexed_match_and_unique_constraint(tmp_path):
+    """Property-index lookups and unique constraints must work on RESTORED
+    data, not just data written after the DDL existed."""
+    d = str(tmp_path / "src")
+    db = nornicdb_tpu.open_db(d, Config(embed_enabled=False))
+    db.schema.create_index("idx", "property", "Doc", ["text"])
+    db.schema.create_constraint("uq", "User", ["email"])
+    db.cypher("CREATE (:Doc {text: 'hello'}), (:User {email: 'a@x'})")
+    db.flush()
+    path = db.backup()
+    db.close()
+
+    db2 = nornicdb_tpu.open_db("", Config(embed_enabled=False))
+    db2.restore(path)
+    # indexed equality match sees restored rows
+    assert db2.cypher("MATCH (d:Doc {text: 'hello'}) RETURN d.text").rows == [["hello"]]
+    # unique constraint enforced against restored values
+    with pytest.raises(Exception):
+        db2.cypher("CREATE (:User {email: 'a@x'})")
+    db2.close()
+
+
+def test_restore_dangling_edge_skipped_not_fatal(tmp_path):
+    import gzip as _gzip, json as _json
+    archive = {
+        "version": 1,
+        "nodes": [{"id": "n1", "labels": ["Z"], "properties": {}}],
+        "edges": [{"id": "e1", "type": "R", "start_node": "n1",
+                   "end_node": "missing", "properties": {}}],
+        "pending_embed": [], "schema": {},
+    }
+    p = str(tmp_path / "dangling.json.gz")
+    with _gzip.open(p, "wt") as f:
+        _json.dump(archive, f)
+    db = nornicdb_tpu.open_db("", Config(embed_enabled=False))
+    counts = db.restore(p)
+    assert counts["nodes"] == 1
+    assert counts.get("skipped_edges") == 1  # reported, not fatal
+    db.close()
+
+
+def test_backup_unique_filenames_same_second(tmp_path):
+    d = str(tmp_path / "src")
+    db = nornicdb_tpu.open_db(d, Config(embed_enabled=False))
+    db.cypher("CREATE (:X)")
+    p1 = db.backup()
+    p2 = db.backup()  # same wall-clock second
+    assert p1 != p2
+    db.close()
+
+
+def test_cli_backup_requires_data_dir(tmp_path, capsys):
+    from nornicdb_tpu.cli import main
+    rc = main(["--data-dir", "", "backup"])
+    assert rc == 2
+    assert "data-dir" in capsys.readouterr().err
