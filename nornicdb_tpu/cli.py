@@ -20,7 +20,11 @@ def _open_db(args):
     import nornicdb_tpu
     from nornicdb_tpu.db import Config
 
-    cfg = Config()
+    cfg = Config(log_queries=bool(getattr(args, "log_queries", False)))
+    if cfg.log_queries:
+        import logging
+
+        logging.basicConfig(level=logging.INFO)
     return nornicdb_tpu.open_db(args.data_dir, cfg)
 
 
@@ -249,6 +253,8 @@ def main(argv=None) -> int:
     s.add_argument("--embedder", choices=["hash", "tpu"], default="tpu")
     s.add_argument("--embed-dims", type=int, default=1024)
     s.add_argument("--model-preset", default="bge_small")
+    s.add_argument("--log-queries", action="store_true",
+                   help="log every Cypher statement with wall time")
     s.set_defaults(fn=cmd_serve)
 
     s = sub.add_parser("init", help="initialize a data directory")

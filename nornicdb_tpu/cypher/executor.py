@@ -12,6 +12,8 @@ Explicit transactions implement ROLLBACK with an executor-level undo log
 
 from __future__ import annotations
 
+import logging
+
 import csv as csv_mod
 import io
 import time
@@ -78,6 +80,9 @@ def procedure(name: str):
     return deco
 
 
+_query_log = logging.getLogger("nornicdb.query")
+
+
 class CypherExecutor:
     """(ref: cypher.StorageExecutor executor.go:187)"""
 
@@ -87,11 +92,15 @@ class CypherExecutor:
         schema: Optional[SchemaManager] = None,
         db=None,
         cache=None,
+        log_queries: bool = False,
     ):
         self.storage = storage
         self.schema = schema or SchemaManager()
         self.db = db  # DB facade: embedder, search service, multidb hooks
         self.cache = cache  # QueryCache (ref: pkg/cache wiring main.go:320)
+        # per-executor (NOT process-global: two DBs in one process must not
+        # leak each other's query text into logs)
+        self.log_queries = log_queries
         self.matcher = PatternMatcher(storage, self.schema, self)
         self._plugin_functions: dict[str, Callable] = {}
         # explicit transaction state (ref: executor.go tx statements :611)
@@ -102,6 +111,19 @@ class CypherExecutor:
     # -- public ----------------------------------------------------------------
     def execute(self, query: str, params: Optional[dict[str, Any]] = None) -> Result:
         """(ref: Execute executor.go:490 — analyze -> cache -> route)"""
+        if not self.log_queries:
+            return self._execute_traced(query, params)
+        # --log-queries (ref: cmd/nornicdb/main.go:137): every statement with
+        # wall time, through the standard logging module
+        t0 = time.perf_counter()
+        try:
+            return self._execute_traced(query, params)
+        finally:
+            _query_log.info("%.1fms %s", (time.perf_counter() - t0) * 1e3,
+                            " ".join(query.split()))
+
+    def _execute_traced(self, query: str,
+                        params: Optional[dict[str, Any]] = None) -> Result:
         self.query_count += 1
         params = params or {}
         stmt = parse(query)

@@ -63,6 +63,7 @@ class Config:
     query_cache_enabled: bool = True
     query_cache_size: int = 1000
     query_cache_ttl: float = 60.0
+    log_queries: bool = False  # (ref: --log-queries cmd/nornicdb/main.go:137)
     feature_flags: dict[str, bool] = field(default_factory=dict)
 
 
@@ -262,7 +263,8 @@ class DB:
 
             cache = self.query_cache if self.config.query_cache_enabled else None
             self._executor = CypherExecutor(
-                self.storage, schema=self.schema, db=self, cache=cache
+                self.storage, schema=self.schema, db=self, cache=cache,
+                log_queries=self.config.log_queries,
             )
         return self._executor
 
@@ -346,10 +348,12 @@ class DB:
 
             schema = SchemaManager()
             schema.attach(storage)
-            return CypherExecutor(storage, schema=schema, db=self)
+            return CypherExecutor(storage, schema=schema, db=self,
+                                  log_queries=self.config.log_queries)
         cache = self.query_cache if self.config.query_cache_enabled else None
         return CypherExecutor(self.storage, schema=self.schema, db=self,
-                              cache=cache)
+                              cache=cache,
+                              log_queries=self.config.log_queries)
 
     def executor_for(self, database: str):
         """Per-database Cypher executor over the namespaced engine
@@ -367,7 +371,8 @@ class DB:
                 storage = self.database_manager.get_storage(database)
                 schema = SchemaManager()
                 schema.attach(storage)
-                ex = CypherExecutor(storage, schema=schema, db=self)
+                ex = CypherExecutor(storage, schema=schema, db=self,
+                                    log_queries=self.config.log_queries)
                 self._db_executors[database] = ex
             return ex
 
@@ -492,6 +497,17 @@ class DB:
 
     def flush(self) -> None:
         self.storage.flush()
+
+    def wal_stats(self) -> Optional[dict[str, Any]]:
+        """WAL health incl. degraded-mode flag (ref: wal_degraded.go), or
+        None when the store has no WAL (in-memory / segment engine)."""
+        eng = self._base_storage
+        while eng is not None:
+            wal = getattr(eng, "wal", None)
+            if wal is not None:
+                return dict(vars(wal.stats))
+            eng = getattr(eng, "base", None)
+        return None
 
     # -- backup / restore (ref: badger_backup.go + /admin/backup,
     # db_admin.go admin ops) -----------------------------------------------

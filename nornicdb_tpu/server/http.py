@@ -313,16 +313,18 @@ class HttpServer:
             h._send(200, {"status": "ok"})
             return
         if path == "/status":
-            h._send(
-                200,
-                {
-                    "status": "running",
-                    "uptime_seconds": round(time.time() - self.started_at, 1),
-                    "nodes": self.db.storage.node_count(),
-                    "edges": self.db.storage.edge_count(),
-                    "version": "1.0.0",
-                },
-            )
+            wal = self.db.wal_stats()
+            degraded = bool(wal and wal.get("degraded"))
+            body = {
+                "status": "degraded" if degraded else "running",
+                "uptime_seconds": round(time.time() - self.started_at, 1),
+                "nodes": self.db.storage.node_count(),
+                "edges": self.db.storage.edge_count(),
+                "version": "1.0.0",
+            }
+            if degraded:
+                body["wal_corruption"] = wal.get("corruption_info", "")
+            h._send(200, body)
             return
         if path == "/metrics":
             h._send(200, self._prometheus(), content_type="text/plain; version=0.0.4")
@@ -340,6 +342,9 @@ class HttpServer:
             }
             if self.db._embed_worker is not None:
                 stats["embed_worker"] = vars(self.db._embed_worker.stats)
+            wal = self.db.wal_stats()
+            if wal is not None:
+                stats["wal"] = wal
             h._send(200, stats)
             return
         h._send(404, {"error": f"not found: {path}"})

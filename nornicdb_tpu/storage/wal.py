@@ -107,6 +107,11 @@ class WALStats:
     snapshots: int = 0
     recovered_entries: int = 0
     truncated_tail_records: int = 0
+    # degraded mode (ref: wal_degraded.go): recovery stopped at MID-FILE
+    # corruption with real records after it — data was lost, unlike the
+    # benign torn-tail case. Surfaced via /status and /admin/stats.
+    degraded: bool = False
+    corruption_info: str = ""
 
 
 class WAL:
@@ -138,6 +143,8 @@ class WAL:
             salt = load_or_create_salt(os.path.join(directory, self.SALT_NAME))
             self._encryptor = Encryptor.from_passphrase(passphrase, salt)
         self._seq = self._scan_last_seq()
+        if self.stats.degraded:
+            self._quarantine_corrupt_log()
         self._f = open(self._path, "ab")
 
     # -- append ------------------------------------------------------------
@@ -184,12 +191,22 @@ class WAL:
                         f"bad record at offset {valid_bytes}"
                     )
                 self.stats.truncated_tail_records += 1
-            for payload, seq in records:
+                self._note_corruption(valid_bytes, len(buf), buf)
+            for idx, (payload, seq) in enumerate(records):
                 try:
                     obj = json.loads(self._decrypt(payload).decode("utf-8"))
                 except Exception:
                     if strict:
                         raise WALCorruptionError("bad payload")
+                    self.stats.truncated_tail_records += 1
+                    if idx < len(records) - 1:
+                        # CRC-valid records FOLLOW the undecodable one:
+                        # committed data is being dropped -> degraded
+                        self.stats.degraded = True
+                        self.stats.corruption_info = (
+                            f"undecodable payload at record {idx}; "
+                            f"{len(records) - idx - 1} later records skipped"
+                        )
                     break
                 entries.append(
                     WALEntry(seq=seq, op=obj["op"], data=obj.get("data", {}),
@@ -205,6 +222,7 @@ class WAL:
                 if strict:
                     raise WALCorruptionError(f"bad record header at offset {off}")
                 self.stats.truncated_tail_records += 1
+                self._note_corruption(off, n, buf)
                 break
             payload = buf[off + _HEADER.size : off + _HEADER.size + oplen]
             crc, seq = _FOOTER.unpack_from(buf, off + _HEADER.size + oplen)
@@ -212,16 +230,98 @@ class WAL:
                 if strict:
                     raise WALCorruptionError(f"CRC mismatch at offset {off}")
                 self.stats.truncated_tail_records += 1
+                self._note_corruption(off, n, buf)
                 break
             try:
                 obj = json.loads(self._decrypt(payload).decode("utf-8"))
             except Exception:
                 if strict:
                     raise WALCorruptionError(f"bad payload at offset {off}")
+                self.stats.truncated_tail_records += 1
+                self._note_corruption(off, n, buf)
                 break
             entries.append(
                 WALEntry(seq=seq, op=obj["op"], data=obj.get("data", {}), txid=obj.get("txid"))
             )
+            off = body_end + ((-(body_end - off)) % 8)
+        return entries
+
+    def _note_corruption(self, offset: int, total: int,
+                         buf: Optional[bytes] = None) -> None:
+        """Classify a recovery stop (ref: wal_degraded.go). A torn tail
+        (crash mid-append: the FINAL record is partial) is expected and
+        benign. If any intact record exists after the corruption point,
+        committed data was lost -> degraded mode."""
+        if buf is None or not self._has_valid_record_after(buf, offset):
+            return
+        self.stats.degraded = True
+        self.stats.corruption_info = (
+            f"unreadable record at offset {offset} with intact records "
+            f"after it; {total - offset} bytes were skipped"
+        )
+
+    @staticmethod
+    def _has_valid_record_after(buf: bytes, offset: int) -> bool:
+        pos = buf.find(MAGIC, offset + 1)
+        while pos != -1:
+            if pos + _HEADER.size <= len(buf):
+                magic, ver, oplen = _HEADER.unpack_from(buf, pos)
+                end = pos + _HEADER.size + oplen + _FOOTER.size
+                if ver == VERSION and end <= len(buf):
+                    payload = buf[pos + _HEADER.size : pos + _HEADER.size + oplen]
+                    crc, _seq = _FOOTER.unpack_from(buf, pos + _HEADER.size + oplen)
+                    if zlib.crc32(payload) & 0xFFFFFFFF == crc:
+                        return True
+            pos = buf.find(MAGIC, pos + 1)
+        return False
+
+    def _quarantine_corrupt_log(self) -> None:
+        """Degraded open: appending after a corrupt region would strand
+        every new record behind it on the next recovery (read_all stops at
+        the corruption). Preserve the damaged file for forensics, then
+        rewrite the log with only the readable records so subsequent
+        appends stay recoverable. The degraded flag stays set."""
+        n = 1
+        while os.path.exists(f"{self._path}.corrupt-{n}"):
+            n += 1
+        os.replace(self._path, f"{self._path}.corrupt-{n}")
+        try:
+            with open(f"{self._path}.corrupt-{n}", "rb") as f:
+                buf = f.read()
+        except FileNotFoundError:
+            buf = b""
+        with open(self._path, "wb") as out:
+            for e in self._parse_buffer(buf):
+                out.write(e.encode(self._encryptor))
+            out.flush()
+            os.fsync(out.fileno())
+        self.stats.corruption_info += (
+            f"; valid prefix rewritten, damaged log kept as "
+            f"{os.path.basename(self._path)}.corrupt-{n}"
+        )
+
+    def _parse_buffer(self, buf: bytes) -> list[WALEntry]:
+        """Parse records from a raw buffer (decrypted), stopping at the
+        first unreadable record. Used by quarantine; does not touch stats."""
+        entries: list[WALEntry] = []
+        off = 0
+        n = len(buf)
+        while off + _HEADER.size <= n:
+            magic, ver, oplen = _HEADER.unpack_from(buf, off)
+            body_end = off + _HEADER.size + oplen + _FOOTER.size
+            if magic != MAGIC or ver != VERSION or body_end > n:
+                break
+            payload = buf[off + _HEADER.size : off + _HEADER.size + oplen]
+            crc, seq = _FOOTER.unpack_from(buf, off + _HEADER.size + oplen)
+            if zlib.crc32(payload) & 0xFFFFFFFF != crc:
+                break
+            try:
+                obj = json.loads(self._decrypt(payload).decode("utf-8"))
+            except Exception:
+                break
+            entries.append(WALEntry(seq=seq, op=obj["op"],
+                                    data=obj.get("data", {}),
+                                    txid=obj.get("txid")))
             off = body_end + ((-(body_end - off)) % 8)
         return entries
 
