@@ -995,3 +995,64 @@ def agg_frequencies(xs):
             order.append((k, x))
         counts[k] = counts.get(k, 0) + 1
     return [{"item": x, "count": counts[k]} for k, x in order]
+
+
+# ---------------------------------------------------------------------------
+# apoc.util.* gaps (ref: apoc/util/util.go — sleep/validate/compress/
+# base64/url/timestamps; md5/sha* live in functions.py)
+# ---------------------------------------------------------------------------
+
+
+@register("apoc.util.sleep")
+def util_sleep(ms):
+    """Capped at 10s: an unbounded sleep inside a query is a DoS lever
+    (the reference sleeps uncapped; deliberate deviation)."""
+    import time as _t
+
+    _t.sleep(min(max(float(ms or 0), 0.0), 10_000.0) / 1000.0)
+    return None
+
+
+@register("apoc.util.validate")
+def util_validate(predicate, message, params=None):
+    """Raise with `message` when predicate is truthy (ref util.go Validate:
+    used for inline assertions in write queries)."""
+    if predicate:
+        msg = str(message or "validation failed")
+        for i, p in enumerate(params or []):
+            msg = msg.replace("%s", str(p), 1).replace(f"{{{i}}}", str(p))
+        raise ValueError(msg)
+    return None
+
+
+@register("apoc.util.compress")
+def util_compress(s, config=None):
+    import gzip as _gzip
+
+    if s is None:
+        return None
+    return list(_gzip.compress(str(s).encode("utf-8")))
+
+
+@register("apoc.util.decompress")
+def util_decompress(data, config=None):
+    import gzip as _gzip
+
+    if data is None:
+        return None
+    return _gzip.decompress(bytes(bytearray(int(b) & 0xFF for b in data))).decode("utf-8")
+
+
+# base64/url codecs already exist as apoc.text.*; register the util names
+# as aliases of the SAME functions so a fix in one spelling reaches both
+from nornicdb_tpu.apoc.functions import (  # noqa: E402
+    text_b64,
+    text_unb64,
+    text_urldecode,
+    text_urlencode,
+)
+
+register("apoc.util.encodeBase64")(text_b64)
+register("apoc.util.decodeBase64")(text_unb64)
+register("apoc.util.encodeUrl")(text_urlencode)
+register("apoc.util.decodeUrl")(text_urldecode)

@@ -606,3 +606,158 @@ def apoc_meta_stats(ex: CypherExecutor, args, row):
         ["nodeCount", "relCount", "labels", "relTypes"],
         [[n_nodes, n_edges, labels, types]],
     )
+
+
+# ---------------------------------------------------------------------------
+# apoc.lock.* (ref: apoc/lock/lock.go — advisory per-entity locks in a
+# database-global registry). Deviations, documented:
+#   - the reference releases at transaction end; here release is explicit
+#     (unlockNodes/unlockAll) because executor transactions are per-session;
+#   - blocking acquires are BOUNDED (default 30s) and raise on timeout —
+#     an unbounded in-query block is a DoS lever, same rationale as the
+#     apoc.util.sleep cap;
+#   - unlockNodes/unlockAll release only locks held by THIS session;
+#     apoc.lock.clear (admin escape hatch, ref lock.go Clear) force-releases
+#     everything, e.g. after a crashed session leaked its locks.
+# ---------------------------------------------------------------------------
+
+_LOCK_WAIT_DEFAULT_MS = 30_000.0
+
+
+def _lock_registry(ex: CypherExecutor):
+    storage = ex.storage
+    reg = getattr(storage, "_apoc_lock_registry", None)
+    if reg is None:
+        import threading
+
+        # locks: eid -> Lock; owners: eid -> (owner_key, count)
+        reg = {"mu": threading.Lock(), "locks": {}, "owners": {}}
+        storage._apoc_lock_registry = reg
+    return reg
+
+
+def _entity_ids(args) -> list[str]:
+    items = args[0] if args else []
+    if not isinstance(items, list):
+        items = [items]
+    # sorted for a stable order, deduped so one call never self-deadlocks
+    return sorted({x.id if hasattr(x, "id") else str(x) for x in items})
+
+
+def _acquire(reg, eid: str, owner, timeout_s: float) -> bool:
+    """Owner-aware acquire: reentrant for the same session (count bump),
+    bounded wait otherwise."""
+    import threading
+
+    with reg["mu"]:
+        lk = reg["locks"].setdefault(eid, threading.Lock())
+        holder = reg["owners"].get(eid)
+        if holder is not None and holder[0] == owner:
+            reg["owners"][eid] = (owner, holder[1] + 1)
+            return True
+    got = lk.acquire(timeout=timeout_s) if timeout_s > 0 else lk.acquire(
+        blocking=False)
+    if got:
+        with reg["mu"]:
+            reg["owners"][eid] = (owner, 1)
+    return got
+
+
+def _release(reg, eid: str, owner) -> bool:
+    with reg["mu"]:
+        holder = reg["owners"].get(eid)
+        if holder is None or holder[0] != owner:
+            return False  # not ours: never release another session's lock
+        if holder[1] > 1:
+            reg["owners"][eid] = (owner, holder[1] - 1)
+            return True
+        del reg["owners"][eid]
+        reg["locks"][eid].release()
+        return True
+
+
+@procedure("apoc.lock.nodes")
+def apoc_lock_nodes(ex: CypherExecutor, args, row):
+    """Acquire advisory locks (sorted order; bounded wait, raises on
+    timeout rather than hanging the session)."""
+    reg = _lock_registry(ex)
+    ids = _entity_ids(args)
+    timeout_s = (float(args[1]) if len(args) > 1 and args[1] is not None
+                 else _LOCK_WAIT_DEFAULT_MS) / 1000.0
+    acquired: list[str] = []
+    for eid in ids:
+        if not _acquire(reg, eid, ex, timeout_s):
+            for got in acquired:  # all-or-nothing
+                _release(reg, got, ex)
+            raise CypherSyntaxError(
+                f"apoc.lock.nodes: timed out waiting for lock on {eid!r}")
+        acquired.append(eid)
+    return ["locked"], [[len(ids)]]
+
+
+@procedure("apoc.lock.trylock")
+def apoc_lock_try(ex: CypherExecutor, args, row):
+    """apoc.lock.tryLock(nodeOrList, timeoutMs) -> acquired (all-or-nothing
+    when given a list)."""
+    if not args:
+        raise CypherSyntaxError("apoc.lock.tryLock(node, timeoutMs)")
+    reg = _lock_registry(ex)
+    ids = _entity_ids(args)  # handles both a single node and a list
+    timeout_s = float(args[1]) / 1000.0 if len(args) > 1 else 0.0
+    acquired: list[str] = []
+    ok = True
+    for eid in ids:
+        if _acquire(reg, eid, ex, timeout_s):
+            acquired.append(eid)
+        else:
+            ok = False
+            break
+    if not ok:
+        for eid in acquired:
+            _release(reg, eid, ex)
+    return ["acquired"], [[ok]]
+
+
+@procedure("apoc.lock.islocked")
+def apoc_lock_islocked(ex: CypherExecutor, args, row):
+    reg = _lock_registry(ex)
+    eid = _entity_ids(args)[0] if args else ""
+    with reg["mu"]:
+        return ["locked"], [[eid in reg["owners"]]]
+
+
+@procedure("apoc.lock.unlocknodes")
+def apoc_lock_unlock(ex: CypherExecutor, args, row):
+    reg = _lock_registry(ex)
+    released = sum(1 for eid in _entity_ids(args) if _release(reg, eid, ex))
+    return ["released"], [[released]]
+
+
+@procedure("apoc.lock.unlockall")
+def apoc_lock_unlock_all(ex: CypherExecutor, args, row):
+    """Release every lock THIS session holds."""
+    reg = _lock_registry(ex)
+    with reg["mu"]:
+        mine = {eid: count for eid, (owner, count) in reg["owners"].items()
+                if owner is ex}
+    for eid, count in mine.items():
+        for _ in range(count):  # fully unwind reentrant holds
+            _release(reg, eid, ex)
+    return ["released"], [[len(mine)]]
+
+
+@procedure("apoc.lock.clear")
+def apoc_lock_clear(ex: CypherExecutor, args, row):
+    """Force-release ALL locks regardless of owner (ref: lock.go Clear) —
+    the admin escape hatch for locks leaked by a dead session."""
+    reg = _lock_registry(ex)
+    with reg["mu"]:
+        n = len(reg["owners"])
+        for eid in list(reg["owners"]):
+            del reg["owners"][eid]
+            reg["locks"][eid].release()
+    return ["cleared"], [[n]]
+
+
+procedure("apoc.lock.relationships")(apoc_lock_nodes)  # same registry
+procedure("apoc.lock.unlockrelationships")(apoc_lock_unlock)

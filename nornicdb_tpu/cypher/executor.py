@@ -1306,6 +1306,7 @@ _NONDETERMINISTIC_FNS = {
     "rand", "randomuuid", "timestamp",
     "apoc.create.uuid", "apoc.text.random", "apoc.date.currenttimestamp",
     "apoc.coll.shuffle", "apoc.coll.randomitem",
+    "apoc.util.sleep",  # side effect: caching it would skip the delay
 }
 
 

@@ -344,3 +344,74 @@ def test_agg_gap_functions():
     assert mi == {"value": 1, "items": ["b", "c"]}
     fr = call("apoc.agg.frequencies", [{"k": 1}, {"k": 1}, {"k": 2}])
     assert fr[0] == {"item": {"k": 1}, "count": 2}
+
+
+def test_apoc_util_gaps(ex):
+    res = ex.execute("RETURN apoc.util.encodeBase64('abc'), apoc.util.encodeUrl('a b&c')")
+    assert res.rows[0] == ["YWJj", "a%20b%26c"]
+    from nornicdb_tpu.errors import NornicError
+    with pytest.raises(Exception, match="must be positive"):
+        ex.execute("RETURN apoc.util.validate(true, 'must be positive %s', [1])")
+    # falsy predicate: no error
+    assert ex.execute("RETURN apoc.util.validate(false, 'x', [])").rows == [[None]]
+
+
+def _second_session(ex):
+    from nornicdb_tpu.cypher.executor import CypherExecutor
+    return CypherExecutor(ex.storage, schema=ex.schema)
+
+
+def test_apoc_lock_procedures(ex):
+    ex.execute("CREATE (:L {name: 'a'})")
+    res = ex.execute(
+        "MATCH (l:L) CALL apoc.lock.nodes([l]) YIELD locked RETURN locked")
+    assert res.rows[0][0] == 1
+    res = ex.execute(
+        "MATCH (l:L) CALL apoc.lock.isLocked(l) YIELD locked RETURN locked")
+    assert res.rows[0][0] is True
+    # same session re-lock is reentrant (rows can bind a node twice)
+    res = ex.execute(
+        "MATCH (l:L) CALL apoc.lock.tryLock(l, 50) YIELD acquired RETURN acquired")
+    assert res.rows[0][0] is True
+    # a DIFFERENT session fails fast
+    other = _second_session(ex)
+    res = other.execute(
+        "MATCH (l:L) CALL apoc.lock.tryLock(l, 50) YIELD acquired RETURN acquired")
+    assert res.rows[0][0] is False
+    # other session cannot release our lock
+    res = other.execute(
+        "MATCH (l:L) CALL apoc.lock.unlockNodes([l]) YIELD released RETURN released")
+    assert res.rows[0][0] == 0
+    res = other.execute("CALL apoc.lock.unlockAll() YIELD released RETURN released")
+    assert res.rows[0][0] == 0
+    # unlockAll unwinds our reentrant holds; other can then acquire
+    assert ex.execute(
+        "CALL apoc.lock.unlockAll() YIELD released RETURN released").rows[0][0] == 1
+    res = other.execute(
+        "MATCH (l:L) CALL apoc.lock.tryLock(l, 50) YIELD acquired RETURN acquired")
+    assert res.rows[0][0] is True
+    # admin escape hatch releases foreign locks
+    assert ex.execute("CALL apoc.lock.clear() YIELD cleared RETURN cleared").rows[0][0] == 1
+
+
+def test_apoc_lock_duplicate_ids_no_self_deadlock(ex):
+    ex.execute("CREATE (:L2 {name: 'x'})")
+    res = ex.execute(
+        "MATCH (l:L2) CALL apoc.lock.nodes([l, l]) YIELD locked RETURN locked")
+    assert res.rows[0][0] == 1  # deduped, returned promptly
+    ex.execute("CALL apoc.lock.clear()")
+
+
+def test_apoc_lock_trylock_list_all_or_nothing(ex):
+    ex.execute("CREATE (:L3 {name: 'p'}), (:L3 {name: 'q'})")
+    other = _second_session(ex)
+    # other session takes q; our list tryLock must fail AND not hold p
+    other.execute("MATCH (l:L3 {name: 'q'}) CALL apoc.lock.nodes([l]) YIELD locked RETURN locked")
+    res = ex.execute(
+        "MATCH (l:L3) WITH collect(l) AS ls "
+        "CALL apoc.lock.tryLock(ls, 50) YIELD acquired RETURN acquired")
+    assert res.rows[0][0] is False
+    res = other.execute(
+        "MATCH (l:L3 {name: 'p'}) CALL apoc.lock.tryLock(l, 50) YIELD acquired RETURN acquired")
+    assert res.rows[0][0] is True  # p was rolled back, not leaked
+    other.execute("CALL apoc.lock.clear()")
