@@ -189,26 +189,32 @@ class TestCrashRecoveryE2E:
             "    r = db.cypher('CREATE (:A {i: $i})-[:L]->(:B {i: $i})', {'i': i})\n"
             "    print('W', i, flush=True)\n"
         )
-        proc = subprocess.Popen(
-            [sys.executable, str(script)], stdout=subprocess.PIPE, text=True,
-            env={**os.environ, "JAX_PLATFORMS": "cpu"},
-        )
-        # wait until it has written a decent stream, then kill -9. Generous
-        # deadline: the subprocess cold-imports jax, which under full-suite
-        # load can take tens of seconds before the first write.
-        written = 0
-        deadline = time.time() + 180
-        while time.time() < deadline:
-            line = proc.stdout.readline()
-            if not line:  # writer died before reaching the target
-                break
-            if line.startswith("W "):
-                written = int(line.split()[1])
-                if written >= 25:
+        stderr_path = tmp_path / "writer.err"
+        with open(stderr_path, "w") as errf:
+            proc = subprocess.Popen(
+                [sys.executable, str(script)], stdout=subprocess.PIPE,
+                stderr=errf, text=True,
+                env={**os.environ, "JAX_PLATFORMS": "cpu"},
+            )
+            # wait until it has written a decent stream, then kill -9.
+            # Generous deadline: the subprocess cold-imports jax, which under
+            # full-suite load can take tens of seconds before the first write.
+            written = 0
+            deadline = time.time() + 180
+            while time.time() < deadline:
+                line = proc.stdout.readline()
+                if not line:  # writer died before reaching the target
                     break
-        proc.kill()
-        proc.wait()
-        assert written >= 25
+                if line.startswith("W "):
+                    written = int(line.split()[1])
+                    if written >= 25:
+                        break
+            proc.kill()
+            proc.wait()
+        assert written >= 25, (
+            f"writer reached {written} writes; stderr:\n"
+            + stderr_path.read_text()[-2000:]
+        )
         # reopen and verify consistency
         db = nornicdb_tpu.open_db(data_dir)
         nodes = {n.id: n for n in db.storage.all_nodes()}
