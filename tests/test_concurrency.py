@@ -199,9 +199,16 @@ class TestCrashRecoveryE2E:
             # wait until it has written a decent stream, then kill -9.
             # Generous deadline: the subprocess cold-imports jax, which under
             # full-suite load can take tens of seconds before the first write.
+            import select
+
             written = 0
             deadline = time.time() + 180
             while time.time() < deadline:
+                # select-bounded read: a hung writer must not turn the
+                # deadline into an infinite readline() block
+                ready, _, _ = select.select([proc.stdout], [], [], 1.0)
+                if not ready:
+                    continue
                 line = proc.stdout.readline()
                 if not line:  # writer died before reaching the target
                     break
