@@ -761,3 +761,122 @@ def apoc_lock_clear(ex: CypherExecutor, args, row):
 
 procedure("apoc.lock.relationships")(apoc_lock_nodes)  # same registry
 procedure("apoc.lock.unlockrelationships")(apoc_lock_unlock)
+
+
+# ---------------------------------------------------------------------------
+# apoc.search.* (ref: apoc/search/search.go — label+property scans with
+# operator support; here they use the label index instead of full scans)
+# ---------------------------------------------------------------------------
+
+
+def _search_op(val, op: str, want) -> bool:
+    """Delegates to the Cypher expression helpers so CALL apoc.search.*
+    results always agree with the equivalent WHERE filter (same null,
+    bool-vs-int, and string-coercion semantics)."""
+    from nornicdb_tpu.cypher.expr import _compare, _eq
+
+    if op in ("=", "==", "exact"):
+        return _eq(val, want) is True
+    if op in ("!=", "<>"):
+        return _eq(val, want) is False
+    if op == "contains":
+        return (isinstance(val, str) and isinstance(want, str)
+                and want in val)
+    if op in ("starts with", "startswith", "prefix"):
+        return (isinstance(val, str) and isinstance(want, str)
+                and val.startswith(want))
+    if op in ("ends with", "endswith", "suffix"):
+        return (isinstance(val, str) and isinstance(want, str)
+                and val.endswith(want))
+    if op in (">", ">=", "<", "<="):
+        return _compare(op, val, want) is True
+    return False
+
+
+def _criteria_match(props: dict, criteria: dict, mode: str) -> bool:
+    """all/any criteria with Cypher equality: a missing key or a null
+    criterion never matches (three-valued logic, matching WHERE)."""
+    from nornicdb_tpu.cypher.expr import _eq
+
+    checks = (k in props and _eq(props[k], v) is True
+              for k, v in criteria.items())
+    return all(checks) if mode == "all" else any(checks)
+
+
+@procedure("apoc.search.node")
+def apoc_search_node(ex: CypherExecutor, args, row):
+    """apoc.search.node(label, property, value[, operator='='])"""
+    if len(args) < 3:
+        raise CypherSyntaxError("apoc.search.node(label, property, value)")
+    label, prop, value = str(args[0]), str(args[1]), args[2]
+    op = str(args[3]).lower() if len(args) > 3 else "="
+    out = []
+    for n in ex.storage.get_nodes_by_label(label):
+        if prop in n.properties and _search_op(n.properties[prop], op, value):
+            out.append([n])
+    return ["node"], out
+
+
+@procedure("apoc.search.nodeall")
+def apoc_search_node_all(ex: CypherExecutor, args, row):
+    """apoc.search.nodeAll(label, criteriaMap) — every criterion must hold."""
+    if len(args) < 2:
+        raise CypherSyntaxError("apoc.search.nodeAll(label, criteria)")
+    label = str(args[0])
+    criteria = args[1] if isinstance(args[1], dict) else {}
+    out = []
+    for n in ex.storage.get_nodes_by_label(label):
+        if _criteria_match(n.properties, criteria, "all"):
+            out.append([n])
+    return ["node"], out
+
+
+@procedure("apoc.search.nodeany")
+def apoc_search_node_any(ex: CypherExecutor, args, row):
+    if len(args) < 2:
+        raise CypherSyntaxError("apoc.search.nodeAny(label, criteria)")
+    label = str(args[0])
+    criteria = args[1] if isinstance(args[1], dict) else {}
+    out = []
+    for n in ex.storage.get_nodes_by_label(label):
+        if _criteria_match(n.properties, criteria, "any"):
+            out.append([n])
+    return ["node"], out
+
+
+@procedure("apoc.search.multisearchall")
+def apoc_search_multi_all(ex: CypherExecutor, args, row):
+    """apoc.search.multiSearchAll(labels, criteria) — union over labels,
+    all-criteria match, deduped by node id."""
+    if len(args) < 2:
+        raise CypherSyntaxError("apoc.search.multiSearchAll(labels, criteria)")
+    labels = args[0] if isinstance(args[0], list) else [args[0]]
+    criteria = args[1] if isinstance(args[1], dict) else {}
+    seen: set[str] = set()
+    out = []
+    for label in labels:
+        for n in ex.storage.get_nodes_by_label(str(label)):
+            if n.id in seen:
+                continue
+            if _criteria_match(n.properties, criteria, "all"):
+                seen.add(n.id)
+                out.append([n])
+    return ["node"], out
+
+
+@procedure("apoc.search.multisearchany")
+def apoc_search_multi_any(ex: CypherExecutor, args, row):
+    if len(args) < 2:
+        raise CypherSyntaxError("apoc.search.multiSearchAny(labels, criteria)")
+    labels = args[0] if isinstance(args[0], list) else [args[0]]
+    criteria = args[1] if isinstance(args[1], dict) else {}
+    seen: set[str] = set()
+    out = []
+    for label in labels:
+        for n in ex.storage.get_nodes_by_label(str(label)):
+            if n.id in seen:
+                continue
+            if _criteria_match(n.properties, criteria, "any"):
+                seen.add(n.id)
+                out.append([n])
+    return ["node"], out

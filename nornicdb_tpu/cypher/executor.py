@@ -1298,8 +1298,14 @@ _WRITE_CLAUSES = (
 _READONLY_PROCEDURES = (
     "db.labels", "db.relationshiptypes", "db.propertykeys",
     "dbms.components", "db.index.vector.querynodes",
-    "db.index.fulltext.querynodes", "apoc.help", "gds.linkprediction.",
-    "gds.fastrp.",
+    "db.index.fulltext.querynodes", "apoc.help",
+    # every gds.* procedure streams read-only results
+    "gds.",
+    # read-only graph scans/traversals; NOT apoc.lock./apoc.export. etc. —
+    # side-effectful-but-non-mutating procedures must stay write-classified
+    # or the cache would skip their side effects on repeat calls
+    "apoc.search.", "apoc.path.", "apoc.meta.",
+    "apoc.schema.nodes", "apoc.schema.relationships",
 )
 
 _NONDETERMINISTIC_FNS = {

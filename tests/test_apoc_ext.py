@@ -415,3 +415,57 @@ def test_apoc_lock_trylock_list_all_or_nothing(ex):
         "MATCH (l:L3 {name: 'p'}) CALL apoc.lock.tryLock(l, 50) YIELD acquired RETURN acquired")
     assert res.rows[0][0] is True  # p was rolled back, not leaked
     other.execute("CALL apoc.lock.clear()")
+
+
+def test_apoc_search_procedures(ex):
+    ex.execute(
+        "CREATE (:Emp {name: 'Ann', dept: 'eng', age: 30}), "
+        "(:Emp {name: 'Bob', dept: 'eng', age: 45}), "
+        "(:Mgr {name: 'Cat', dept: 'eng', age: 50}), "
+        "(:Emp {name: 'Dee', dept: 'hr', age: 30})"
+    )
+    r = ex.execute("CALL apoc.search.node('Emp', 'dept', 'eng') YIELD node RETURN count(node)")
+    assert r.rows[0][0] == 2
+    r = ex.execute("CALL apoc.search.node('Emp', 'age', 40, '>') YIELD node RETURN node.name")
+    assert [x[0] for x in r.rows] == ["Bob"]
+    r = ex.execute("CALL apoc.search.node('Emp', 'name', 'A', 'starts with') YIELD node RETURN node.name")
+    assert [x[0] for x in r.rows] == ["Ann"]
+    r = ex.execute(
+        "CALL apoc.search.nodeAll('Emp', {dept: 'eng', age: 30}) YIELD node RETURN node.name")
+    assert [x[0] for x in r.rows] == ["Ann"]
+    r = ex.execute(
+        "CALL apoc.search.nodeAny('Emp', {dept: 'hr', age: 45}) YIELD node RETURN count(node)")
+    assert r.rows[0][0] == 2  # Bob (age) + Dee (dept)
+    r = ex.execute(
+        "CALL apoc.search.multiSearchAll(['Emp', 'Mgr'], {dept: 'eng'}) YIELD node RETURN count(node)")
+    assert r.rows[0][0] == 3
+    r = ex.execute(
+        "CALL apoc.search.multiSearchAny(['Emp', 'Mgr'], {age: 50}) YIELD node RETURN node.name")
+    assert [x[0] for x in r.rows] == ["Cat"]
+
+
+def test_apoc_search_null_and_bool_semantics(ex):
+    ex.execute("CREATE (:S2 {flag: true}), (:S2 {n: 5})")
+    # null criterion matches nothing (three-valued logic), not missing-key nodes
+    r = ex.execute("CALL apoc.search.nodeAll('S2', {nickname: null}) YIELD node RETURN count(node)")
+    assert r.rows[0][0] == 0
+    # boolean true does not equal integer 1 (Cypher equality)
+    r = ex.execute("CALL apoc.search.node('S2', 'flag', 1) YIELD node RETURN count(node)")
+    assert r.rows[0][0] == 0
+    r = ex.execute("CALL apoc.search.node('S2', 'flag', true) YIELD node RETURN count(node)")
+    assert r.rows[0][0] == 1
+
+
+def test_apoc_search_does_not_clear_query_cache(ex):
+    from nornicdb_tpu.cache import QueryCache
+    ex.cache = QueryCache(capacity=10, ttl=60.0)
+    ex.execute("CREATE (:C1 {v: 1})")
+    r1 = ex.execute("MATCH (c:C1) RETURN c.v")  # populates cache
+    ex.execute("CALL apoc.search.node('C1', 'v', 1) YIELD node RETURN node")
+    # read-classified: the cached MATCH result must still be served
+    stats_before = ex.cache.stats.hits if hasattr(ex.cache, "stats") else None
+    r2 = ex.execute("MATCH (c:C1) RETURN c.v")
+    assert r2.rows == r1.rows
+    if stats_before is not None:
+        assert ex.cache.stats.hits == stats_before + 1
+    ex.cache = None
