@@ -880,3 +880,154 @@ def apoc_search_multi_any(ex: CypherExecutor, args, row):
                 seen.add(n.id)
                 out.append([n])
     return ["node"], out
+
+
+# ---------------------------------------------------------------------------
+# apoc.refactor.* gaps (ref: apoc/refactor/refactor.go — CloneNodes,
+# SetType, InvertRelationship, RedirectRelationship, RenameProperty,
+# ExtractNode, NormalizeAsBoolean; rename.label/type live above)
+# ---------------------------------------------------------------------------
+
+
+@procedure("apoc.refactor.clonenodes")
+def apoc_clone_nodes(ex: CypherExecutor, args, row):
+    """apoc.refactor.cloneNodes(nodes[, withRelationships=false])"""
+    nodes = (args[0] or []) if args else []
+    if isinstance(nodes, Node):
+        nodes = [nodes]
+    with_rels = bool(args[1]) if len(args) > 1 else False
+    out = []
+    for n in nodes:
+        # snapshot BOTH edge lists before any insert, or the incoming scan
+        # picks up the clone edges we just created; self-loops appear in
+        # both lists, so dedup by id and remap both endpoints to the clone
+        outgoing = list(ex.storage.get_outgoing_edges(n.id)) if with_rels else []
+        incoming = [e for e in ex.storage.get_incoming_edges(n.id)
+                    if e.start_node != n.id] if with_rels else []
+        clone = ex.storage.create_node(
+            Node(labels=list(n.labels), properties=dict(n.properties)))
+        for e in outgoing:
+            end = clone.id if e.end_node == n.id else e.end_node
+            ex.storage.create_edge(Edge(
+                start_node=clone.id, end_node=end, type=e.type,
+                properties=dict(e.properties)))
+        for e in incoming:
+            ex.storage.create_edge(Edge(
+                start_node=e.start_node, end_node=clone.id, type=e.type,
+                properties=dict(e.properties)))
+        out.append([n, clone])
+    return ["input", "output"], out
+
+
+@procedure("apoc.refactor.settype")
+def apoc_set_type(ex: CypherExecutor, args, row):
+    """apoc.refactor.setType(rel, newType) — in-place mutation; update_edge
+    re-indexes the type map, so the edge keeps its id and created_at."""
+    if len(args) < 2:
+        raise CypherSyntaxError("apoc.refactor.setType(rel, newType)")
+    e, new_type = args[0], str(args[1])
+    updated = e.copy()
+    updated.type = new_type
+    updated = ex.storage.update_edge(updated)
+    return ["input", "output"], [[e, updated]]
+
+
+@procedure("apoc.refactor.invert")
+def apoc_invert_rel(ex: CypherExecutor, args, row):
+    """Flip a relationship's direction."""
+    if not args:
+        raise CypherSyntaxError("apoc.refactor.invert(rel)")
+    e = args[0]
+    # endpoint changes need delete+recreate (adjacency maps key on the
+    # endpoints); create FIRST so a failure never destroys the original
+    created = ex.storage.create_edge(Edge(
+        start_node=e.end_node, end_node=e.start_node, type=e.type,
+        properties=dict(e.properties)))
+    ex.storage.delete_edge(e.id)
+    return ["input", "output"], [[e, created]]
+
+
+@procedure("apoc.refactor.to")
+def apoc_redirect_to(ex: CypherExecutor, args, row):
+    """apoc.refactor.to(rel, newEndNode) — redirect the end node."""
+    if len(args) < 2:
+        raise CypherSyntaxError("apoc.refactor.to(rel, endNode)")
+    e, target = args[0], args[1]
+    created = ex.storage.create_edge(Edge(  # create-then-delete: a missing
+        start_node=e.start_node, end_node=target.id, type=e.type,  # target
+        properties=dict(e.properties)))  # must not destroy the original
+    ex.storage.delete_edge(e.id)
+    return ["input", "output"], [[e, created]]
+
+
+@procedure("apoc.refactor.from")
+def apoc_redirect_from(ex: CypherExecutor, args, row):
+    if len(args) < 2:
+        raise CypherSyntaxError("apoc.refactor.from(rel, startNode)")
+    e, source = args[0], args[1]
+    created = ex.storage.create_edge(Edge(
+        start_node=source.id, end_node=e.end_node, type=e.type,
+        properties=dict(e.properties)))
+    ex.storage.delete_edge(e.id)
+    return ["input", "output"], [[e, created]]
+
+
+@procedure("apoc.refactor.rename.nodeproperty")
+def apoc_rename_node_prop(ex: CypherExecutor, args, row):
+    if len(args) < 2:
+        raise CypherSyntaxError(
+            "apoc.refactor.rename.nodeProperty(old, new[, nodes])")
+    old_name, new_name = str(args[0]), str(args[1])
+    scope = args[2] if len(args) > 2 and args[2] else None
+    targets = scope if scope is not None else list(ex.storage.all_nodes())
+    count = 0
+    for n in targets:
+        if old_name in n.properties:
+            n.properties[new_name] = n.properties.pop(old_name)
+            ex.storage.update_node(n)
+            count += 1
+    return ["total"], [[count]]
+
+
+@procedure("apoc.refactor.extractnode")
+def apoc_extract_node(ex: CypherExecutor, args, row):
+    """Turn a relationship into a node with connecting edges
+    (rel A-[R]->B  becomes  A-[OUT]->(:R)-[IN]->B)."""
+    if not args:
+        raise CypherSyntaxError(
+            "apoc.refactor.extractNode(rel[, labels, outType, inType])")
+    e = args[0]
+    labels = args[1] if len(args) > 1 and args[1] else [e.type]
+    out_type = str(args[2]) if len(args) > 2 else "OUT"
+    in_type = str(args[3]) if len(args) > 3 else "IN"
+    mid = ex.storage.create_node(Node(labels=list(labels),
+                                      properties=dict(e.properties)))
+    ex.storage.delete_edge(e.id)
+    ex.storage.create_edge(Edge(start_node=e.start_node, end_node=mid.id,
+                                type=out_type))
+    ex.storage.create_edge(Edge(start_node=mid.id, end_node=e.end_node,
+                                type=in_type))
+    return ["input", "output"], [[e, mid]]
+
+
+@procedure("apoc.refactor.normalizeasboolean")
+def apoc_normalize_bool(ex: CypherExecutor, args, row):
+    """apoc.refactor.normalizeAsBoolean(entity, prop, trueValues, falseValues)"""
+    if len(args) < 4:
+        raise CypherSyntaxError(
+            "apoc.refactor.normalizeAsBoolean(entity, prop, trues, falses)")
+    entity, prop = args[0], str(args[1])
+    trues = args[2] or []
+    falses = args[3] or []
+    val = entity.properties.get(prop)
+    if val in trues:
+        entity.properties[prop] = True
+    elif val in falses:
+        entity.properties[prop] = False
+    else:
+        entity.properties.pop(prop, None)  # unmappable: drop, per apoc
+    if isinstance(entity, Node):
+        ex.storage.update_node(entity)
+    else:
+        ex.storage.update_edge(entity)
+    return ["entity"], [[entity]]

@@ -469,3 +469,98 @@ def test_apoc_search_does_not_clear_query_cache(ex):
     if stats_before is not None:
         assert ex.cache.stats.hits == stats_before + 1
     ex.cache = None
+
+
+def test_refactor_clone_settype_invert_redirect(ex):
+    ex.execute("CREATE (a:RA {k: 1})-[:REL {w: 2}]->(b:RB)")
+    # clone with relationships (clone copies properties, so match count after)
+    r = ex.execute(
+        "MATCH (a:RA) CALL apoc.refactor.cloneNodes([a], true) "
+        "YIELD output RETURN output.k")
+    assert r.rows[0][0] == 1
+    assert ex.execute("MATCH (:RA)-[r:REL]->(:RB) RETURN count(r)").rows[0][0] == 2
+    # setType on ONE rel (both RA nodes carry k:1 — the clone is faithful)
+    ex.execute(
+        "MATCH (a:RA)-[r:REL]->(:RB) WITH r LIMIT 1 "
+        "CALL apoc.refactor.setType(r, 'KNOWS') YIELD output RETURN output")
+    assert ex.execute("MATCH ()-[r:KNOWS]->() RETURN r.w").rows[0][0] == 2
+    assert ex.execute("MATCH ()-[r:KNOWS]->() RETURN count(r)").rows[0][0] == 1
+    # invert
+    ex.execute("MATCH ()-[r:KNOWS]->() CALL apoc.refactor.invert(r) YIELD output RETURN output")
+    assert ex.execute("MATCH (:RB)-[r:KNOWS]->(:RA) RETURN count(r)").rows[0][0] == 1
+
+
+def test_refactor_redirect_and_rename_property(ex):
+    ex.execute("CREATE (a:RC)-[:R2]->(b:RD), (c:RE)")
+    ex.execute(
+        "MATCH (a:RC)-[r:R2]->(), (c:RE) "
+        "CALL apoc.refactor.to(r, c) YIELD output RETURN output")
+    assert ex.execute("MATCH (:RC)-[r:R2]->(:RE) RETURN count(r)").rows[0][0] == 1
+    ex.execute("CREATE (:RF {old_name: 'x'}), (:RG {old_name: 'y'})")
+    r = ex.execute(
+        "CALL apoc.refactor.rename.nodeProperty('old_name', 'name') "
+        "YIELD total RETURN total")
+    assert r.rows[0][0] == 2
+    assert ex.execute("MATCH (f:RF) RETURN f.name").rows[0][0] == "x"
+
+
+def test_refactor_extract_node_and_normalize_bool(ex):
+    ex.execute("CREATE (a:RH)-[:WORKS_AT {since: 2020}]->(b:RI)")
+    r = ex.execute(
+        "MATCH ()-[r:WORKS_AT]->() "
+        "CALL apoc.refactor.extractNode(r, ['Job'], 'HAS', 'AT') "
+        "YIELD output RETURN output.since")
+    assert r.rows[0][0] == 2020
+    assert ex.execute(
+        "MATCH (:RH)-[:HAS]->(j:Job)-[:AT]->(:RI) RETURN count(j)").rows[0][0] == 1
+    ex.execute("CREATE (:RJ {active: 'yes'}), (:RJ {active: 'no'}), (:RJ {active: 'maybe'})")
+    ex.execute(
+        "MATCH (n:RJ) CALL apoc.refactor.normalizeAsBoolean(n, 'active', "
+        "['yes'], ['no']) YIELD entity RETURN entity")
+    rows = ex.execute(
+        "MATCH (n:RJ) RETURN n.active ORDER BY toString(n.active)").rows
+    assert sorted([r[0] for r in rows], key=str) == [False, None, True]
+
+
+def test_refactor_clone_self_loop(ex):
+    ex.execute("CREATE (a:SL)-[:SELF]->(a)")
+    ex.execute("MATCH (a:SL) CALL apoc.refactor.cloneNodes([a], true) YIELD output RETURN output")
+    # exactly one new self-loop on the clone, nothing cross-wired
+    assert ex.execute("MATCH ()-[r:SELF]->() RETURN count(r)").rows[0][0] == 2
+    r = ex.execute("MATCH (n:SL)-[:SELF]->(n) RETURN count(n)")
+    assert r.rows[0][0] == 2  # both are self-loops
+
+
+def test_refactor_settype_preserves_identity(ex):
+    ex.execute("CREATE (:RK)-[:OLD {w: 1}]->(:RL)")
+    before = ex.execute("MATCH ()-[r:OLD]->() RETURN r").rows[0][0]
+    ex.execute("MATCH ()-[r:OLD]->() CALL apoc.refactor.setType(r, 'NEW') YIELD output RETURN output")
+    after = ex.execute("MATCH ()-[r:NEW]->() RETURN r").rows[0][0]
+    assert after.id == before.id  # same edge, re-typed in place
+
+
+def test_refactor_to_missing_target_not_destructive(ex):
+    ex.execute("CREATE (a:RM)-[:R3]->(b:RN)")
+    from nornicdb_tpu.storage.types import Node
+    ghost = Node(id="never-stored", labels=["Ghost"])  # not in storage
+    r = ex.execute("MATCH ()-[r:R3]->() RETURN count(r)")
+    assert r.rows[0][0] == 1
+    import pytest as _pt
+    from nornicdb_tpu.errors import NotFoundError
+    with _pt.raises(Exception):
+        from nornicdb_tpu.apoc.procedures import apoc_redirect_to
+        e = ex.execute("MATCH ()-[r:R3]->() RETURN r").rows[0][0]
+        apoc_redirect_to(ex, [e, ghost], {})
+    # the original edge survived the failed redirect
+    assert ex.execute("MATCH ()-[r:R3]->() RETURN count(r)").rows[0][0] == 1
+
+
+def test_refactor_rename_property_scoped(ex):
+    ex.execute("CREATE (:RP {v: 1}), (:RQ {v: 2})")
+    r = ex.execute(
+        "MATCH (n:RP) WITH collect(n) AS ns "
+        "CALL apoc.refactor.rename.nodeProperty('v', 'val', ns) "
+        "YIELD total RETURN total")
+    assert r.rows[0][0] == 1
+    assert ex.execute("MATCH (n:RQ) RETURN n.v").rows[0][0] == 2  # untouched
+    assert ex.execute("MATCH (n:RP) RETURN n.val").rows[0][0] == 1
