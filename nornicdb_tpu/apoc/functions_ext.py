@@ -937,11 +937,17 @@ def agg_slice(xs, start=0, length=None):
 
 
 def _agg_key(v: Any) -> Any:
-    """Canonical hashable key for Cypher values (lists/maps are legal
-    aggregation inputs but unhashable in Python)."""
+    """Canonical hashable key for Cypher values. Type-tagged so a string
+    never collides with a structurally-equal serialized list/map and
+    booleans stay distinct from 0/1 (Cypher equality treats 1 = 1.0 as
+    equal, so plain numbers share a key)."""
+    if isinstance(v, bool):
+        return ("bool", v)
     if isinstance(v, (list, dict)):
-        return _json.dumps(v, sort_keys=True, default=str)
-    return v
+        return ("json", _json.dumps(v, sort_keys=True, default=str))
+    if isinstance(v, str):
+        return ("str", v)
+    return ("val", v)
 
 
 @register("apoc.agg.mode", category="agg")
@@ -1056,3 +1062,195 @@ register("apoc.util.encodeBase64")(text_b64)
 register("apoc.util.decodeBase64")(text_unb64)
 register("apoc.util.encodeUrl")(text_urlencode)
 register("apoc.util.decodeUrl")(text_urldecode)
+
+
+# ---------------------------------------------------------------------------
+# apoc.convert.* gaps (ref: apoc/convert/convert.go — typed lists, sets,
+# sorted json, json property helpers)
+# ---------------------------------------------------------------------------
+
+
+@register("apoc.convert.toSet")
+def convert_to_set(xs):
+    """Dedup preserving first-seen order (apoc returns a list)."""
+    if xs is None:
+        return None
+    seen = set()
+    out = []
+    for x in xs if isinstance(xs, list) else [xs]:
+        k = _agg_key(x)
+        if k not in seen:
+            seen.add(k)
+            out.append(x)
+    return out
+
+
+@register("apoc.convert.toSortedJsonMap")
+def convert_sorted_json(v):
+    return None if v is None else _json.dumps(v, sort_keys=True, default=str)
+
+
+def _to_typed_list(xs, cast):
+    if xs is None:
+        return None
+    out = []
+    for x in xs if isinstance(xs, list) else [xs]:
+        try:
+            out.append(None if x is None else cast(x))
+        except (TypeError, ValueError):
+            out.append(None)
+    return out
+
+
+@register("apoc.convert.toIntList")
+def convert_int_list(xs):
+    def cast(v):
+        try:
+            return int(v)  # exact for big ints; int(float()) would round 2^53+
+        except (TypeError, ValueError):
+            return int(float(v))  # decimal strings like "2.7"
+    return _to_typed_list(xs, cast)
+
+
+@register("apoc.convert.toFloatList")
+def convert_float_list(xs):
+    return _to_typed_list(xs, float)
+
+
+@register("apoc.convert.toStringList")
+def convert_string_list(xs):
+    return _to_typed_list(xs, str)
+
+
+@register("apoc.convert.toBooleanList")
+def convert_bool_list(xs):
+    def cast(v):
+        if isinstance(v, str):
+            return v.lower() in ("true", "yes", "1")
+        return bool(v)
+    return _to_typed_list(xs, cast)
+
+
+@register("apoc.convert.getJsonProperty")
+def convert_get_json_prop(entity, key, path=None):
+    """Parse a JSON-string property and optionally descend a path. Accepts
+    a node, a property map, or a raw JSON string (ref convert.go:237 takes
+    the JSON string form)."""
+    if isinstance(entity, str):
+        # reference form: the FIRST arg is the JSON document; the value is
+        # returned as-is (no double parse)
+        try:
+            doc = _json.loads(entity)
+        except ValueError:
+            return None
+        if not isinstance(doc, dict):
+            return None
+        val = doc.get(key)
+        return _json_path(val, str(path)) if path else val
+    # node/map form: the property VALUE is a JSON string to parse
+    props = getattr(entity, "properties", entity) or {}
+    if not isinstance(props, dict):
+        return None
+    raw = props.get(key)
+    if raw is None:
+        return None
+    try:
+        obj = _json.loads(raw) if isinstance(raw, str) else raw
+    except ValueError:
+        return None
+    return _json_path(obj, str(path)) if path else obj
+
+
+@register("apoc.convert.setJsonProperty")
+def convert_set_json_prop(entity, key, value):
+    """Serialize value into a JSON-string property. For a node/map input
+    the entity is returned; for a raw JSON-string input the updated JSON
+    string is returned (ref convert.go SetJsonProperty)."""
+    if isinstance(entity, str):
+        try:
+            obj = _json.loads(entity)
+        except ValueError:
+            return None
+        if not isinstance(obj, dict):
+            return None
+        obj[key] = value
+        return _json.dumps(obj, default=str)
+    props = getattr(entity, "properties", entity)
+    props[key] = _json.dumps(value, default=str)
+    return entity
+
+
+# ---------------------------------------------------------------------------
+# apoc.date.* gaps (ref: apoc/date/date.go — ISO8601 + unix + fields)
+# ---------------------------------------------------------------------------
+
+
+@register("apoc.date.toISO8601")
+def date_to_iso(epoch, unit="ms"):
+    import datetime as _dt
+
+    if epoch is None:
+        return None
+    secs = float(epoch) / (1000.0 if unit == "ms" else 1.0)
+    return _dt.datetime.fromtimestamp(
+        secs, tz=_dt.timezone.utc).strftime("%Y-%m-%dT%H:%M:%S.%f")[:-3] + "Z"
+
+
+@register("apoc.date.fromISO8601")
+def date_from_iso(s):
+    import datetime as _dt
+
+    if s is None:
+        return None
+    s = str(s).replace("Z", "+00:00")
+    dt = _dt.datetime.fromisoformat(s)
+    if dt.tzinfo is None:
+        dt = dt.replace(tzinfo=_dt.timezone.utc)
+    return int(dt.timestamp() * 1000)
+
+
+@register("apoc.date.toUnixTime")
+def date_to_unix(epoch_ms):
+    return None if epoch_ms is None else int(float(epoch_ms) / 1000.0)
+
+
+@register("apoc.date.fromUnixTime")
+def date_from_unix(secs):
+    return None if secs is None else int(float(secs) * 1000.0)
+
+
+@register("apoc.date.field")
+def date_field(epoch_ms, unit="d"):
+    """Extract a field from an epoch-ms timestamp (UTC)."""
+    import datetime as _dt
+
+    if epoch_ms is None:
+        return None
+    dt = _dt.datetime.fromtimestamp(float(epoch_ms) / 1000.0,
+                                    tz=_dt.timezone.utc)
+    unit = str(unit).lower()
+    return {
+        "years": dt.year, "year": dt.year, "y": dt.year,
+        "months": dt.month, "month": dt.month,
+        "days": dt.day, "day": dt.day, "d": dt.day,
+        "hours": dt.hour, "hour": dt.hour, "h": dt.hour,
+        # 'm' means MINUTES (ref date.go duration units), not month
+        "minutes": dt.minute, "minute": dt.minute, "m": dt.minute,
+        "seconds": dt.second, "second": dt.second, "s": dt.second,
+    }.get(unit)
+
+
+@register("apoc.date.fields")
+def date_fields(epoch_ms):
+    import datetime as _dt
+
+    if epoch_ms is None:
+        return None
+    dt = _dt.datetime.fromtimestamp(float(epoch_ms) / 1000.0,
+                                    tz=_dt.timezone.utc)
+    # key names follow the reference's Fields map (date.go:80)
+    return {"year": dt.year, "month": dt.month, "day": dt.day,
+            "hour": dt.hour, "minute": dt.minute, "second": dt.second,
+            "dayOfWeek": dt.isoweekday(),
+            "dayOfYear": dt.timetuple().tm_yday,
+            "weekOfYear": dt.isocalendar()[1]}

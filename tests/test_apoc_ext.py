@@ -564,3 +564,44 @@ def test_refactor_rename_property_scoped(ex):
     assert r.rows[0][0] == 1
     assert ex.execute("MATCH (n:RQ) RETURN n.v").rows[0][0] == 2  # untouched
     assert ex.execute("MATCH (n:RP) RETURN n.val").rows[0][0] == 1
+
+
+def test_convert_gaps():
+    assert call("apoc.convert.toSet", [1, 2, 2, 1, 3]) == [1, 2, 3]
+    assert call("apoc.convert.toSet", [{"a": 1}, {"a": 1}]) == [{"a": 1}]
+    assert call("apoc.convert.toSortedJsonMap", {"b": 1, "a": 2}) == '{"a": 2, "b": 1}'
+    assert call("apoc.convert.toIntList", ["1", "2.7", None, "x"]) == [1, 2, None, None]
+    assert call("apoc.convert.toBooleanList", ["true", "no", 1, 0]) == [True, False, True, False]
+    from nornicdb_tpu.storage.types import Node
+    n = Node(properties={"meta": '{"a": {"b": 5}}'})
+    assert call("apoc.convert.getJsonProperty", n, "meta", "a.b") == 5
+    call("apoc.convert.setJsonProperty", n, "cfg", {"x": 1})
+    assert n.properties["cfg"] == '{"x": 1}'
+
+
+def test_date_gaps():
+    ms = call("apoc.date.fromISO8601", "2026-07-29T12:30:00Z")
+    assert call("apoc.date.toISO8601", ms) == "2026-07-29T12:30:00.000Z"
+    assert call("apoc.date.toUnixTime", ms) == ms // 1000
+    assert call("apoc.date.fromUnixTime", ms // 1000) == ms
+    assert call("apoc.date.field", ms, "year") == 2026
+    assert call("apoc.date.field", ms, "h") == 12
+    assert call("apoc.date.field", ms, "m") == 30  # minutes, not month
+    f = call("apoc.date.fields", ms)
+    assert (f["year"], f["month"], f["day"], f["hour"]) == (2026, 7, 29, 12)
+    assert f["dayOfWeek"] == 3 and f["dayOfYear"] == 210  # Wed, day 210
+    assert call("apoc.date.fromISO8601", None) is None
+
+
+def test_convert_review_regressions():
+    big = 9007199254740993  # 2^53 + 1: int(float()) would corrupt it
+    assert call("apoc.convert.toIntList", [big]) == [big]
+    # string vs structurally-equal list stay distinct
+    assert call("apoc.convert.toSet", ["[1, 2]", [1, 2]]) == ["[1, 2]", [1, 2]]
+    assert call("apoc.convert.toSet", [1, True]) == [1, True]
+    # reference JSON-string forms
+    assert call("apoc.convert.getJsonProperty", '{"name": "Alice"}', "name") == "Alice"
+    out = call("apoc.convert.setJsonProperty", '{"a": 1}', "b", 2)
+    import json as _j
+    assert _j.loads(out) == {"a": 1, "b": 2}
+    assert call("apoc.convert.getJsonProperty", "{broken", "x") is None
