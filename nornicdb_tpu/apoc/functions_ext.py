@@ -1254,3 +1254,148 @@ def date_fields(epoch_ms):
             "dayOfWeek": dt.isoweekday(),
             "dayOfYear": dt.timetuple().tm_yday,
             "weekOfYear": dt.isocalendar()[1]}
+
+
+# ---------------------------------------------------------------------------
+# apoc.temporal.* gaps (ref: apoc/temporal/temporal.go — epoch-ms calendar
+# helpers: StartOf/EndOf/IsWeekend/Quarter/IsLeapYear/DaysInMonth/
+# Difference/Age; apoc.temporal.format lives in functions.py)
+# ---------------------------------------------------------------------------
+
+
+def _dt_utc(epoch_ms):
+    import datetime as _dt
+
+    return _dt.datetime.fromtimestamp(float(epoch_ms) / 1000.0,
+                                      tz=_dt.timezone.utc)
+
+
+@register("apoc.temporal.startOf")
+def temporal_start_of(epoch_ms, unit="day"):
+    import datetime as _dt
+
+    if epoch_ms is None:
+        return None
+    dt = _dt_utc(epoch_ms)
+    unit = str(unit).lower()
+    if unit in ("year", "years"):
+        dt = dt.replace(month=1, day=1, hour=0, minute=0, second=0,
+                        microsecond=0)
+    elif unit in ("month", "months"):
+        dt = dt.replace(day=1, hour=0, minute=0, second=0, microsecond=0)
+    elif unit in ("week", "weeks"):
+        dt = (dt - _dt.timedelta(days=dt.isoweekday() - 1)).replace(
+            hour=0, minute=0, second=0, microsecond=0)
+    elif unit in ("day", "days"):
+        dt = dt.replace(hour=0, minute=0, second=0, microsecond=0)
+    elif unit in ("hour", "hours"):
+        dt = dt.replace(minute=0, second=0, microsecond=0)
+    elif unit in ("minute", "minutes"):
+        dt = dt.replace(second=0, microsecond=0)
+    else:
+        return None
+    return int(dt.timestamp() * 1000)
+
+
+def _next_period_start(dt, unit: str):
+    """Start of the FOLLOWING unit period; shared by endOf so the unit
+    dispatch can never drift from startOf's. Returns None for unknown
+    units (same contract as startOf)."""
+    import datetime as _dt
+
+    if unit in ("year", "years"):
+        return dt.replace(year=dt.year + 1)
+    if unit in ("month", "months"):
+        return (dt.replace(year=dt.year + 1, month=1) if dt.month == 12
+                else dt.replace(month=dt.month + 1))
+    if unit in ("week", "weeks"):
+        return dt + _dt.timedelta(days=7)
+    if unit in ("day", "days"):
+        return dt + _dt.timedelta(days=1)
+    if unit in ("hour", "hours"):
+        return dt + _dt.timedelta(hours=1)
+    if unit in ("minute", "minutes"):
+        return dt + _dt.timedelta(minutes=1)
+    return None
+
+
+@register("apoc.temporal.endOf")
+def temporal_end_of(epoch_ms, unit="day"):
+    if epoch_ms is None:
+        return None
+    start = temporal_start_of(epoch_ms, unit)
+    if start is None:
+        return None
+    nxt = _next_period_start(_dt_utc(start), str(unit).lower())
+    if nxt is None:
+        return None
+    return int(nxt.timestamp() * 1000) - 1
+
+
+@register("apoc.temporal.isWeekend")
+def temporal_is_weekend(epoch_ms):
+    return None if epoch_ms is None else _dt_utc(epoch_ms).isoweekday() >= 6
+
+
+@register("apoc.temporal.isWeekday")
+def temporal_is_weekday(epoch_ms):
+    return None if epoch_ms is None else _dt_utc(epoch_ms).isoweekday() <= 5
+
+
+@register("apoc.temporal.quarter")
+def temporal_quarter(epoch_ms):
+    if epoch_ms is None:
+        return None
+    return (_dt_utc(epoch_ms).month - 1) // 3 + 1
+
+
+@register("apoc.temporal.isLeapYear")
+def temporal_is_leap(year):
+    import calendar
+
+    return None if year is None else calendar.isleap(int(year))
+
+
+@register("apoc.temporal.daysInMonth")
+def temporal_days_in_month(year, month):
+    import calendar
+
+    if year is None or month is None:
+        return None
+    return calendar.monthrange(int(year), int(month))[1]
+
+
+@register("apoc.temporal.difference")
+def temporal_difference(a_ms, b_ms, unit="ms"):
+    """Signed difference b - a, truncated toward zero (ref temporal.go
+    Difference — the sign tells callers which side is later). months/years
+    use the reference's fixed 30/365-day approximations."""
+    if a_ms is None or b_ms is None:
+        return None
+    diff = float(b_ms) - float(a_ms)
+    divisors = {
+        "ms": 1.0,
+        "s": 1e3, "second": 1e3, "seconds": 1e3,
+        "m": 6e4, "minute": 6e4, "minutes": 6e4,
+        "h": 3.6e6, "hour": 3.6e6, "hours": 3.6e6,
+        "d": 8.64e7, "day": 8.64e7, "days": 8.64e7,
+        "month": 30 * 8.64e7, "months": 30 * 8.64e7,
+        "year": 365 * 8.64e7, "years": 365 * 8.64e7,
+    }
+    div = divisors.get(str(unit).lower())
+    return None if div is None else int(diff / div)
+
+
+@register("apoc.temporal.age")
+def temporal_age(birth_ms, now_ms=None):
+    """Whole years between birth and now (calendar-aware)."""
+    if birth_ms is None:
+        return None
+    import time as _t
+
+    b = _dt_utc(birth_ms)
+    n = _dt_utc(now_ms if now_ms is not None else _t.time() * 1000.0)
+    years = n.year - b.year
+    if (n.month, n.day) < (b.month, b.day):
+        years -= 1
+    return years

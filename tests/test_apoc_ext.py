@@ -605,3 +605,36 @@ def test_convert_review_regressions():
     import json as _j
     assert _j.loads(out) == {"a": 1, "b": 2}
     assert call("apoc.convert.getJsonProperty", "{broken", "x") is None
+
+
+def test_temporal_calendar_helpers():
+    ms = call("apoc.date.fromISO8601", "2026-07-29T12:30:45Z")  # Wednesday
+    assert call("apoc.temporal.startOf", ms, "day") == call(
+        "apoc.date.fromISO8601", "2026-07-29T00:00:00Z")
+    assert call("apoc.temporal.startOf", ms, "month") == call(
+        "apoc.date.fromISO8601", "2026-07-01T00:00:00Z")
+    assert call("apoc.temporal.startOf", ms, "week") == call(
+        "apoc.date.fromISO8601", "2026-07-27T00:00:00Z")  # Monday
+    assert call("apoc.temporal.endOf", ms, "day") == call(
+        "apoc.date.fromISO8601", "2026-07-30T00:00:00Z") - 1
+    assert call("apoc.temporal.isWeekend", ms) is False
+    assert call("apoc.temporal.isWeekday", ms) is True
+    assert call("apoc.temporal.quarter", ms) == 3
+    assert call("apoc.temporal.isLeapYear", 2024) is True
+    assert call("apoc.temporal.isLeapYear", 2026) is False
+    assert call("apoc.temporal.daysInMonth", 2026, 2) == 28
+    assert call("apoc.temporal.daysInMonth", 2024, 2) == 29
+    day = 86_400_000
+    assert call("apoc.temporal.difference", ms, ms + 3 * day, "days") == 3
+    assert call("apoc.temporal.difference", ms, ms + 90_000, "m") == 1
+    # signed: earlier - later is negative (ref temporal.go semantics)
+    assert call("apoc.temporal.difference", ms + 3 * day, ms, "days") == -3
+    assert call("apoc.temporal.difference", ms, ms + 70 * day, "months") == 2
+    assert call("apoc.temporal.difference", ms, ms + 400 * day, "year") == 1
+    assert call("apoc.temporal.difference", ms, ms + 120_000, "minute") == 2
+    birth = call("apoc.date.fromISO8601", "2000-08-15T00:00:00Z")
+    assert call("apoc.temporal.age", birth, ms) == 25  # birthday not yet
+    birth2 = call("apoc.date.fromISO8601", "2000-07-01T00:00:00Z")
+    assert call("apoc.temporal.age", birth2, ms) == 26
+    assert call("apoc.temporal.startOf", None, "day") is None
+    assert call("apoc.temporal.startOf", ms, "nope") is None
