@@ -1399,3 +1399,102 @@ def temporal_age(birth_ms, now_ms=None):
     if (n.month, n.day) < (b.month, b.day):
         years -= 1
     return years
+
+
+# ---------------------------------------------------------------------------
+# apoc.map.* gaps (ref: apoc/map/map.go — FromValues/SetEntry/SetPairs/
+# SetLists/SetValues/MGet/Keys/Unflatten/UpdateTree/DropNullValues)
+# ---------------------------------------------------------------------------
+
+
+@register("apoc.map.fromValues")
+def map_from_values(xs):
+    """Alternating [k1, v1, k2, v2, ...] -> map."""
+    xs = list(xs or [])
+    return {str(xs[i]): xs[i + 1] for i in range(0, len(xs) - 1, 2)}
+
+
+# setEntry is the reference's alias for SetKey — register the SAME function
+from nornicdb_tpu.apoc.functions import map_set_key as _map_set_key  # noqa: E402
+
+register("apoc.map.setEntry")(_map_set_key)
+
+
+@register("apoc.map.setPairs")
+def map_set_pairs(m, pairs):
+    out = dict(m or {})
+    for pair in pairs or []:
+        if isinstance(pair, (list, tuple)) and len(pair) >= 2:
+            out[str(pair[0])] = pair[1]
+    return out
+
+
+@register("apoc.map.setLists")
+def map_set_lists(m, keys, values):
+    out = dict(m or {})
+    for k, v in zip(keys or [], values or []):
+        out[str(k)] = v
+    return out
+
+
+@register("apoc.map.setValues")
+def map_set_values(m, xs):
+    """Alternating [k1, v1, ...] merged into m."""
+    out = dict(m or {})
+    xs = list(xs or [])
+    for i in range(0, len(xs) - 1, 2):
+        out[str(xs[i])] = xs[i + 1]
+    return out
+
+
+@register("apoc.map.mget")
+def map_mget(m, keys, default=None):
+    m = m or {}
+    return [m.get(str(k), default) for k in keys or []]
+
+
+@register("apoc.map.keys")
+def map_keys(m):
+    return sorted((m or {}).keys())  # ref map.go Keys sorts
+
+
+@register("apoc.map.unflatten")
+def map_unflatten(m, delimiter="."):
+    """{"a.b": 1} -> {"a": {"b": 1}} (inverse of apoc.map.flatten)."""
+    out: dict[str, Any] = {}
+    for k, v in (m or {}).items():
+        parts = str(k).split(delimiter)
+        cur = out
+        for p in parts[:-1]:
+            nxt = cur.get(p)
+            if not isinstance(nxt, dict):
+                nxt = {}
+                cur[p] = nxt
+            cur = nxt
+        cur[parts[-1]] = v
+    return out
+
+
+@register("apoc.map.updateTree")
+def map_update_tree(m, path, value):
+    """Set a value at a dot-delimited path, creating intermediate maps
+    (ref map.go UpdateTree). Non-map intermediates are replaced rather
+    than panicking like the reference's type assertion."""
+    out = dict(m or {})
+    parts = str(path).split(".")
+    cur = out
+    for p in parts[:-1]:
+        nxt = cur.get(p)
+        if not isinstance(nxt, dict):
+            nxt = {}
+        else:
+            nxt = dict(nxt)  # copy-on-write down the path
+        cur[p] = nxt
+        cur = nxt
+    cur[parts[-1]] = value
+    return out
+
+
+@register("apoc.map.dropNullValues")
+def map_drop_nulls(m):
+    return {k: v for k, v in (m or {}).items() if v is not None}

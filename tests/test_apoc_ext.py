@@ -638,3 +638,28 @@ def test_temporal_calendar_helpers():
     assert call("apoc.temporal.age", birth2, ms) == 26
     assert call("apoc.temporal.startOf", None, "day") is None
     assert call("apoc.temporal.startOf", ms, "nope") is None
+
+
+def test_map_gaps():
+    assert call("apoc.map.fromValues", ["a", 1, "b", 2]) == {"a": 1, "b": 2}
+    assert call("apoc.map.setEntry", {"a": 1}, "b", 2) == {"a": 1, "b": 2}
+    assert call("apoc.map.setPairs", {}, [["x", 1], ["y", 2]]) == {"x": 1, "y": 2}
+    assert call("apoc.map.setLists", {}, ["p", "q"], [1, 2]) == {"p": 1, "q": 2}
+    assert call("apoc.map.setValues", {"a": 0}, ["a", 1, "b", 2]) == {"a": 1, "b": 2}
+    assert call("apoc.map.mget", {"a": 1}, ["a", "zz"], -1) == [1, -1]
+    assert call("apoc.map.keys", {"b": 2, "a": 1}) == ["a", "b"]  # sorted
+    flat = {"a.b": 1, "a.c": 2, "d": 3}
+    assert call("apoc.map.unflatten", flat) == {"a": {"b": 1, "c": 2}, "d": 3}
+    # flatten/unflatten round-trip
+    nested = {"x": {"y": {"z": 9}}, "w": 1}
+    assert call("apoc.map.unflatten", call("apoc.map.flatten", nested)) == nested
+    tree = {"a": {"b": 1}, "keep": True}
+    out = call("apoc.map.updateTree", tree, "a.b", 2)
+    assert out == {"a": {"b": 2}, "keep": True}
+    assert tree["a"]["b"] == 1  # copy-on-write, original untouched
+    assert call("apoc.map.updateTree", {}, "x.y.z", 7) == {"x": {"y": {"z": 7}}}
+    assert call("apoc.map.dropNullValues", {"a": 1, "b": None}) == {"a": 1}
+    # original maps untouched (functional semantics)
+    m = {"a": 1}
+    call("apoc.map.setEntry", m, "b", 2)
+    assert m == {"a": 1}
