@@ -1498,3 +1498,167 @@ def map_update_tree(m, path, value):
 @register("apoc.map.dropNullValues")
 def map_drop_nulls(m):
     return {k: v for k, v in (m or {}).items() if v is not None}
+
+
+# ---------------------------------------------------------------------------
+# apoc.coll.* gaps (ref: apoc/coll/coll.go — ContainsAny/Sorted, Different,
+# Disjunction, DuplicatesWithCount, InsertAll, IsEmpty/IsNotEmpty,
+# PairsMin, RemoveAll, Set, Slice, SortMaps, UnionAll, FrequenciesAsMap)
+# ---------------------------------------------------------------------------
+
+
+@register("apoc.coll.containsAny")
+def coll_contains_any(xs, candidates):
+    if xs is None or candidates is None:
+        return None
+    keys = {_agg_key(x) for x in xs}
+    return any(_agg_key(c) in keys for c in candidates)
+
+
+@register("apoc.coll.containsSorted")
+def coll_contains_sorted(xs, value):
+    """Binary search over an already-sorted list (ref coll.go
+    ContainsSorted). A probe that isn't order-comparable with the
+    elements is simply not contained."""
+    import bisect
+
+    if xs is None:
+        return None
+    try:
+        i = bisect.bisect_left(xs, value)
+    except TypeError:
+        return False
+    return i < len(xs) and xs[i] == value
+
+
+@register("apoc.coll.different")
+def coll_different(xs):
+    """True when ALL elements are unique (apoc semantics: any repeat
+    makes it false)."""
+    if xs is None:
+        return None
+    xs = list(xs)
+    return len({_agg_key(x) for x in xs}) == len(xs)
+
+
+@register("apoc.coll.disjunction")
+def coll_disjunction(a, b):
+    """Symmetric difference, first-seen order."""
+    a, b = list(a or []), list(b or [])
+    ka = {_agg_key(x) for x in a}
+    kb = {_agg_key(x) for x in b}
+    out = []
+    emitted: set[Any] = set()  # result is a SET (ref applies ToSet)
+    for x in a:
+        k = _agg_key(x)
+        if k not in kb and k not in emitted:
+            emitted.add(k)
+            out.append(x)
+    for x in b:
+        k = _agg_key(x)
+        if k not in ka and k not in emitted:
+            emitted.add(k)
+            out.append(x)
+    return out
+
+
+@register("apoc.coll.duplicatesWithCount")
+def coll_dupes_with_count(xs):
+    counts: dict[Any, int] = {}
+    order: list[tuple[Any, Any]] = []
+    for x in xs or []:
+        k = _agg_key(x)
+        if k not in counts:
+            order.append((k, x))
+        counts[k] = counts.get(k, 0) + 1
+    return [{"item": x, "count": counts[k]} for k, x in order if counts[k] > 1]
+
+
+@register("apoc.coll.insertAll")
+def coll_insert_all(xs, index, values):
+    xs = list(xs or [])
+    i = int(index)
+    if not 0 <= i <= len(xs):
+        return xs  # out-of-range is a no-op (ref + coll.set convention)
+    return xs[:i] + list(values or []) + xs[i:]
+
+
+@register("apoc.coll.isEmpty")
+def coll_is_empty(xs):
+    return None if xs is None else len(xs) == 0
+
+
+@register("apoc.coll.isNotEmpty")
+def coll_is_not_empty(xs):
+    return None if xs is None else len(xs) > 0
+
+
+@register("apoc.coll.pairsMin")
+def coll_pairs_min(xs):
+    """Adjacent pairs WITHOUT the trailing [last, null] that pairs()
+    emits (ref coll.go PairsMin)."""
+    xs = list(xs or [])
+    return [[xs[i], xs[i + 1]] for i in range(len(xs) - 1)]
+
+
+@register("apoc.coll.removeAll")
+def coll_remove_all(xs, to_remove):
+    kill = {_agg_key(x) for x in (to_remove or [])}
+    return [x for x in (xs or []) if _agg_key(x) not in kill]
+
+
+@register("apoc.coll.set")
+def coll_set(xs, index, value):
+    xs = list(xs or [])
+    i = int(index)
+    if 0 <= i < len(xs):
+        xs[i] = value
+    return xs
+
+
+@register("apoc.coll.slice")
+def coll_slice(xs, offset, length=None):
+    xs = list(xs or [])
+    off = max(0, int(offset))
+    if length is None:
+        return xs[off:]
+    return xs[off : off + max(0, int(length))]
+
+
+@register("apoc.coll.sortMaps")
+def coll_sort_maps(maps, key, descending=True):
+    """Sort a list of maps by a key (ref coll.go SortMaps — descending by
+    default, matching apoc); null-valued entries sort last."""
+    maps = list(maps or [])
+    with_val = [m for m in maps if isinstance(m, dict) and m.get(key) is not None]
+    without = [m for m in maps if not (isinstance(m, dict) and m.get(key) is not None)]
+    # heterogeneous property values are normal graph data: sort within
+    # type groups (type-tagged key) instead of raising TypeError
+    def sort_key(m):
+        v = m[key]
+        if isinstance(v, bool):
+            return (0, v)
+        if isinstance(v, (int, float)):
+            return (1, v)
+        if isinstance(v, str):
+            return (2, v)
+        return (3, str(v))
+    with_val.sort(key=sort_key, reverse=bool(descending))
+    return with_val + without
+
+
+@register("apoc.coll.unionAll")
+def coll_union_all(a, b):
+    """Concatenation keeping duplicates (union() dedups)."""
+    return list(a or []) + list(b or [])
+
+
+@register("apoc.coll.frequenciesAsMap")
+def coll_frequencies_as_map(xs):
+    """Same keying as apoc.coll.frequencies (json form), so int 1 and
+    string "1" stay distinct buckets and the two functions agree."""
+    counts: dict[str, int] = {}
+    for x in xs or []:
+        k = _json.dumps(x, sort_keys=True, default=str)
+        counts[k] = counts.get(k, 0) + 1
+    return counts

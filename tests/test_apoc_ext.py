@@ -663,3 +663,43 @@ def test_map_gaps():
     m = {"a": 1}
     call("apoc.map.setEntry", m, "b", 2)
     assert m == {"a": 1}
+
+
+def test_coll_gaps():
+    assert call("apoc.coll.containsAny", [1, 2, 3], [9, 2]) is True
+    assert call("apoc.coll.containsAny", [1, 2], [9]) is False
+    assert call("apoc.coll.containsSorted", [1, 3, 5, 7], 5) is True
+    assert call("apoc.coll.containsSorted", [1, 3, 5, 7], 4) is False
+    assert call("apoc.coll.different", [1, 1, 2]) is False  # repeat -> False
+    assert call("apoc.coll.different", [1, 2]) is True  # all unique
+    assert call("apoc.coll.disjunction", [1, 2, 3], [2, 3, 4]) == [1, 4]
+    d = call("apoc.coll.duplicatesWithCount", ["a", "b", "a", "a"])
+    assert d == [{"item": "a", "count": 3}]
+    assert call("apoc.coll.insertAll", [1, 4], 1, [2, 3]) == [1, 2, 3, 4]
+    assert call("apoc.coll.isEmpty", []) is True
+    assert call("apoc.coll.isNotEmpty", [1]) is True
+    assert call("apoc.coll.pairsMin", [1, 2, 3]) == [[1, 2], [2, 3]]
+    assert call("apoc.coll.removeAll", [1, 2, 3, 2], [2]) == [1, 3]
+    assert call("apoc.coll.set", [1, 2, 3], 1, 9) == [1, 9, 3]
+    assert call("apoc.coll.set", [1], 5, 9) == [1]  # out of range: unchanged
+    assert call("apoc.coll.slice", [1, 2, 3, 4], 1, 2) == [2, 3]
+    maps = [{"n": 1}, {"n": 3}, {"x": 0}, {"n": 2}]
+    assert call("apoc.coll.sortMaps", maps, "n") == [
+        {"n": 3}, {"n": 2}, {"n": 1}, {"x": 0}]
+    assert call("apoc.coll.unionAll", [1, 2], [2, 3]) == [1, 2, 2, 3]
+    fam = call("apoc.coll.frequenciesAsMap", ["a", "b", "a", 1, "1"])
+    assert fam['"a"'] == 2 and fam["1"] == 1 and fam['"1"'] == 1  # 1 != "1"
+    assert call("apoc.coll.isEmpty", None) is None
+
+
+def test_coll_review_regressions():
+    # disjunction dedups (set semantics)
+    assert call("apoc.coll.disjunction", [1, 1, 2], [2, 3]) == [1, 3]
+    # non-comparable probe is just not contained, not a crash
+    assert call("apoc.coll.containsSorted", ["a", "b"], 3) is False
+    # mixed-type sort keys don't crash; groups by type
+    out = call("apoc.coll.sortMaps", [{"n": 1}, {"n": "x"}, {"n": 2}], "n")
+    assert [m["n"] for m in out] == ["x", 2, 1]  # strings > numbers, desc
+    # OOB insertAll is a no-op
+    assert call("apoc.coll.insertAll", [1, 2], 99, [3]) == [1, 2]
+    assert call("apoc.coll.insertAll", [1, 2], -1, [3]) == [1, 2]
