@@ -1532,13 +1532,13 @@ def coll_contains_sorted(xs, value):
 
 
 @register("apoc.coll.different")
-def coll_different(xs):
-    """True when ALL elements are unique (apoc semantics: any repeat
-    makes it false)."""
-    if xs is None:
+def coll_different(a, b):
+    """Elements of the first list absent from the second (ref coll.go
+    Different(list1, list2) — a list difference, not a predicate)."""
+    if a is None:
         return None
-    xs = list(xs)
-    return len({_agg_key(x) for x in xs}) == len(xs)
+    kb = {_agg_key(x) for x in (b or [])}
+    return [x for x in a if _agg_key(x) not in kb]
 
 
 @register("apoc.coll.disjunction")
@@ -1595,10 +1595,10 @@ def coll_is_not_empty(xs):
 
 @register("apoc.coll.pairsMin")
 def coll_pairs_min(xs):
-    """Adjacent pairs WITHOUT the trailing [last, null] that pairs()
-    emits (ref coll.go PairsMin)."""
+    """NON-overlapping pairs, stepping by two; a trailing odd element is
+    dropped (ref coll.go PairsMin i += 2)."""
     xs = list(xs or [])
-    return [[xs[i], xs[i + 1]] for i in range(len(xs) - 1)]
+    return [[xs[i], xs[i + 1]] for i in range(0, len(xs) - 1, 2)]
 
 
 @register("apoc.coll.removeAll")
@@ -1626,9 +1626,10 @@ def coll_slice(xs, offset, length=None):
 
 
 @register("apoc.coll.sortMaps")
-def coll_sort_maps(maps, key, descending=True):
-    """Sort a list of maps by a key (ref coll.go SortMaps — descending by
-    default, matching apoc); null-valued entries sort last."""
+def coll_sort_maps(maps, key, descending=False):
+    """Sort a list of maps by a key, ASCENDING like the reference
+    (coll.go SortMaps has no direction param); null-valued entries sort
+    last. The optional descending flag is a convenience superset."""
     maps = list(maps or [])
     with_val = [m for m in maps if isinstance(m, dict) and m.get(key) is not None]
     without = [m for m in maps if not (isinstance(m, dict) and m.get(key) is not None)]
@@ -1655,10 +1656,173 @@ def coll_union_all(a, b):
 
 @register("apoc.coll.frequenciesAsMap")
 def coll_frequencies_as_map(xs):
-    """Same keying as apoc.coll.frequencies (json form), so int 1 and
-    string "1" stay distinct buckets and the two functions agree."""
-    counts: dict[str, int] = {}
-    for x in xs or []:
-        k = _json.dumps(x, sort_keys=True, default=str)
-        counts[k] = counts.get(k, 0) + 1
-    return counts
+    """List of {item, count} rows, exactly the reference's shape
+    (coll.go FrequenciesAsMap returns []map, not a dict — the name is
+    historical)."""
+    from nornicdb_tpu.apoc.functions import coll_frequencies
+
+    return coll_frequencies(xs)
+
+
+# ---------------------------------------------------------------------------
+# apoc.text.* gaps (ref: apoc/text/text.go — CapitalizeAll/DecapitalizeAll/
+# Reverse/Trim family/IndexesOf/FromCodePoint/Bytes/Hamming/JaroWinkler/
+# Phonetic/DoubleMetaphone)
+# ---------------------------------------------------------------------------
+
+
+@register("apoc.text.capitalizeAll")
+def text_capitalize_all(s):
+    # ref text.go CapitalizeAll is strings.ToUpper (not title-case)
+    return None if s is None else str(s).upper()
+
+
+@register("apoc.text.decapitalizeAll")
+def text_decapitalize_all(s):
+    return None if s is None else str(s).lower()
+
+
+@register("apoc.text.reverse")
+def text_reverse(s):
+    return None if s is None else str(s)[::-1]
+
+
+@register("apoc.text.trim")
+def text_trim(s):
+    return None if s is None else str(s).strip()
+
+
+@register("apoc.text.ltrim")
+def text_ltrim(s):
+    return None if s is None else str(s).lstrip()
+
+
+@register("apoc.text.rtrim")
+def text_rtrim(s):
+    return None if s is None else str(s).rstrip()
+
+
+@register("apoc.text.indexesOf")
+def text_indexes_of(s, lookup, from_=0, to=None):
+    if s is None or lookup is None:
+        return None
+    s, lookup = str(s), str(lookup)
+    end = len(s) if to is None else int(to)
+    out = []
+    i = int(from_)
+    while True:
+        i = s.find(lookup, i, end)
+        if i == -1:
+            break
+        out.append(i)
+        i += 1
+    return out
+
+
+@register("apoc.text.fromCodePoint")
+def text_from_code_point(*codes):
+    vals = codes[0] if len(codes) == 1 and isinstance(codes[0], list) else codes
+    return "".join(chr(int(c)) for c in vals)
+
+
+@register("apoc.text.bytes")
+def text_bytes(s, charset="UTF-8"):
+    return None if s is None else list(str(s).encode(charset))
+
+
+@register("apoc.text.bytesToString")
+def text_bytes_to_string(data, charset="UTF-8"):
+    if data is None:
+        return None
+    return bytes(bytearray(int(b) & 0xFF for b in data)).decode(charset)
+
+
+@register("apoc.text.hammingDistance")
+def text_hamming(a, b):
+    if a is None or b is None:
+        return None
+    a, b = str(a), str(b)
+    if len(a) != len(b):
+        return -1  # ref text.go: unequal lengths are invalid, sentinel -1
+    return sum(x != y for x, y in zip(a, b))
+
+
+@register("apoc.text.jaroWinklerDistance")
+def text_jaro_winkler(a, b):
+    """Jaro-Winkler SIMILARITY in [0,1] (apoc's name says distance but it
+    returns similarity, matching the reference)."""
+    if a is None or b is None:
+        return None
+    s1, s2 = str(a), str(b)
+    if s1 == s2:
+        return 1.0
+    if not s1 or not s2:
+        return 0.0
+    window = max(max(len(s1), len(s2)) // 2 - 1, 1)  # ref clamps to >= 1
+    m1, m2 = [False] * len(s1), [False] * len(s2)
+    matches = 0
+    for i, c in enumerate(s1):
+        lo, hi = max(0, i - window), min(len(s2), i + window + 1)
+        for j in range(lo, hi):
+            if not m2[j] and s2[j] == c:
+                m1[i] = m2[j] = True
+                matches += 1
+                break
+    if matches == 0:
+        return 0.0
+    t = 0
+    k = 0
+    for i in range(len(s1)):
+        if m1[i]:
+            while not m2[k]:
+                k += 1
+            if s1[i] != s2[k]:
+                t += 1
+            k += 1
+    jaro = (matches / len(s1) + matches / len(s2)
+            + (matches - t / 2) / matches) / 3.0
+    prefix = 0
+    for x, y in zip(s1, s2):
+        if x != y or prefix == 4:
+            break
+        prefix += 1
+    return jaro + prefix * 0.1 * (1.0 - jaro)
+
+
+def _soundex(s: str) -> str:
+    """Classic Soundex (ref text.go Phonetic)."""
+    codes = {
+        **dict.fromkeys("BFPV", "1"), **dict.fromkeys("CGJKQSXZ", "2"),
+        **dict.fromkeys("DT", "3"), "L": "4",
+        **dict.fromkeys("MN", "5"), "R": "6",
+    }
+    s = "".join(c for c in s.upper() if c.isalpha())
+    if not s:
+        return ""
+    out = s[0]
+    prev = codes.get(s[0], "")
+    for c in s[1:]:
+        code = codes.get(c, "")
+        if code and code != prev:
+            out += code
+            if len(out) == 4:
+                break
+        if c not in "HW":
+            prev = code
+    return (out + "000")[:4]
+
+
+@register("apoc.text.phonetic")
+def text_phonetic(s):
+    if s is None:
+        return None
+    return "".join(_soundex(w) for w in str(s).split())
+
+
+@register("apoc.text.phoneticDelta")
+def text_phonetic_delta(a, b):
+    """0 = identical soundex codes, 4 = different (ref text.go
+    PhoneticDelta — a DELTA, so zero means phonetically the same)."""
+    if a is None or b is None:
+        return None
+    return 0 if _soundex(str(a)) == _soundex(str(b)) else 4

@@ -670,25 +670,24 @@ def test_coll_gaps():
     assert call("apoc.coll.containsAny", [1, 2], [9]) is False
     assert call("apoc.coll.containsSorted", [1, 3, 5, 7], 5) is True
     assert call("apoc.coll.containsSorted", [1, 3, 5, 7], 4) is False
-    assert call("apoc.coll.different", [1, 1, 2]) is False  # repeat -> False
-    assert call("apoc.coll.different", [1, 2]) is True  # all unique
+    assert call("apoc.coll.different", [1, 2, 3, 4], [2, 4]) == [1, 3]
     assert call("apoc.coll.disjunction", [1, 2, 3], [2, 3, 4]) == [1, 4]
     d = call("apoc.coll.duplicatesWithCount", ["a", "b", "a", "a"])
     assert d == [{"item": "a", "count": 3}]
     assert call("apoc.coll.insertAll", [1, 4], 1, [2, 3]) == [1, 2, 3, 4]
     assert call("apoc.coll.isEmpty", []) is True
     assert call("apoc.coll.isNotEmpty", [1]) is True
-    assert call("apoc.coll.pairsMin", [1, 2, 3]) == [[1, 2], [2, 3]]
+    assert call("apoc.coll.pairsMin", [1, 2, 3, 4, 5]) == [[1, 2], [3, 4]]
     assert call("apoc.coll.removeAll", [1, 2, 3, 2], [2]) == [1, 3]
     assert call("apoc.coll.set", [1, 2, 3], 1, 9) == [1, 9, 3]
     assert call("apoc.coll.set", [1], 5, 9) == [1]  # out of range: unchanged
     assert call("apoc.coll.slice", [1, 2, 3, 4], 1, 2) == [2, 3]
     maps = [{"n": 1}, {"n": 3}, {"x": 0}, {"n": 2}]
     assert call("apoc.coll.sortMaps", maps, "n") == [
-        {"n": 3}, {"n": 2}, {"n": 1}, {"x": 0}]
+        {"n": 1}, {"n": 2}, {"n": 3}, {"x": 0}]  # ascending, nulls last
     assert call("apoc.coll.unionAll", [1, 2], [2, 3]) == [1, 2, 2, 3]
-    fam = call("apoc.coll.frequenciesAsMap", ["a", "b", "a", 1, "1"])
-    assert fam['"a"'] == 2 and fam["1"] == 1 and fam['"1"'] == 1  # 1 != "1"
+    fam = call("apoc.coll.frequenciesAsMap", ["a", "b", "a"])
+    assert {"item": "a", "count": 2} in fam  # reference list-of-maps shape
     assert call("apoc.coll.isEmpty", None) is None
 
 
@@ -697,9 +696,36 @@ def test_coll_review_regressions():
     assert call("apoc.coll.disjunction", [1, 1, 2], [2, 3]) == [1, 3]
     # non-comparable probe is just not contained, not a crash
     assert call("apoc.coll.containsSorted", ["a", "b"], 3) is False
-    # mixed-type sort keys don't crash; groups by type
+    # mixed-type sort keys don't crash; groups by type (ascending default)
     out = call("apoc.coll.sortMaps", [{"n": 1}, {"n": "x"}, {"n": 2}], "n")
-    assert [m["n"] for m in out] == ["x", 2, 1]  # strings > numbers, desc
+    assert [m["n"] for m in out] == [1, 2, "x"]
     # OOB insertAll is a no-op
     assert call("apoc.coll.insertAll", [1, 2], 99, [3]) == [1, 2]
     assert call("apoc.coll.insertAll", [1, 2], -1, [3]) == [1, 2]
+
+
+def test_text_gaps():
+    assert call("apoc.text.capitalizeAll", "hello world") == "HELLO WORLD"
+    assert call("apoc.text.decapitalizeAll", "Hello World") == "hello world"
+    assert call("apoc.text.reverse", "abc") == "cba"
+    assert call("apoc.text.trim", "  x  ") == "x"
+    assert call("apoc.text.ltrim", "  x ") == "x "
+    assert call("apoc.text.indexesOf", "banana", "a") == [1, 3, 5]
+    assert call("apoc.text.indexesOf", "banana", "a", 2) == [3, 5]
+    assert call("apoc.text.fromCodePoint", [72, 105]) == "Hi"
+    assert call("apoc.text.bytesToString", call("apoc.text.bytes", "héllo")) == "héllo"
+    assert call("apoc.text.hammingDistance", "karolin", "kathrin") == 3
+    assert call("apoc.text.hammingDistance", "abc", "abcd") == -1  # ref sentinel
+    jw = call("apoc.text.jaroWinklerDistance", "MARTHA", "MARHTA")
+    assert abs(jw - 0.9611) < 0.001  # canonical example
+    assert call("apoc.text.jaroWinklerDistance", "x", "x") == 1.0
+    assert call("apoc.text.phonetic", "Robert") == "R163"
+    assert call("apoc.text.phonetic", "Rupert") == "R163"
+    assert call("apoc.text.phoneticDelta", "Robert", "Rupert") == 0  # same code
+    assert call("apoc.text.phoneticDelta", "Robert", "Xylophone") == 4
+    assert call("apoc.text.reverse", None) is None
+
+
+def test_jaro_winkler_short_strings():
+    # window clamps to 1: transposed 2-char strings are similar, not 0
+    assert call("apoc.text.jaroWinklerDistance", "ab", "ba") > 0.5
