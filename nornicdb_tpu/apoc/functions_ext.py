@@ -1826,3 +1826,226 @@ def text_phonetic_delta(a, b):
     if a is None or b is None:
         return None
     return 0 if _soundex(str(a)) == _soundex(str(b)) else 4
+
+
+# ---------------------------------------------------------------------------
+# apoc.number.* gaps (ref: apoc/number/number.go — romanize/arabize, base
+# conversions, clamp/lerp, primality, gcd/lcm, factorial, fibonacci)
+# ---------------------------------------------------------------------------
+
+_ROMAN = [(1000, "M"), (900, "CM"), (500, "D"), (400, "CD"), (100, "C"),
+          (90, "XC"), (50, "L"), (40, "XL"), (10, "X"), (9, "IX"),
+          (5, "V"), (4, "IV"), (1, "I")]
+
+
+@register("apoc.number.romanize")
+def number_romanize(n):
+    if n is None:
+        return None
+    n = int(n)
+    if not 0 < n < 4000:
+        return None
+    out = []
+    for val, sym in _ROMAN:
+        while n >= val:
+            out.append(sym)
+            n -= val
+    return "".join(out)
+
+
+@register("apoc.number.arabize")
+def number_arabize(s):
+    if not s:
+        return None
+    vals = {"I": 1, "V": 5, "X": 10, "L": 50, "C": 100, "D": 500, "M": 1000}
+    s = str(s).upper()
+    total = 0
+    prev = 0  # value of the PREVIOUS char (right-to-left), not a running max
+    for c in reversed(s):
+        v = vals.get(c)
+        if v is None:
+            return None
+        total += v if v >= prev else -v
+        prev = v
+    return total
+
+
+_INT64_MAX = (1 << 63) - 1
+_INT64_MIN = -(1 << 63)
+_BASE_DIGITS = "0123456789abcdefghijklmnopqrstuvwxyz"
+
+
+def _parse_int_strict(s, base: int):
+    """strconv.ParseInt-shaped parsing: optional sign, strict per-base
+    charset (no 0x/0b prefixes, no underscores, no whitespace), int64
+    bounds. Returns None on any violation — shared by every from* codec
+    so their leniency can never diverge."""
+    if s is None:
+        return None
+    s = str(s)
+    body = s[1:] if s[:1] in "+-" else s
+    if not body:
+        return None
+    allowed = set(_BASE_DIGITS[:base])
+    if any(c not in allowed for c in body.lower()):
+        return None
+    v = int(s, base)
+    if not _INT64_MIN <= v <= _INT64_MAX:
+        return None
+    return v
+
+
+@register("apoc.number.toHex")
+def number_to_hex(n):
+    # reference uppercases (number.go ToHex: strings.ToUpper)
+    return None if n is None else format(int(n), "X")
+
+
+@register("apoc.number.fromHex")
+def number_from_hex(s):
+    return _parse_int_strict(s, 16)
+
+
+@register("apoc.number.toBinary")
+def number_to_binary(n):
+    return None if n is None else format(int(n), "b")
+
+
+@register("apoc.number.fromBinary")
+def number_from_binary(s):
+    return _parse_int_strict(s, 2)
+
+
+@register("apoc.number.toOctal")
+def number_to_octal(n):
+    return None if n is None else format(int(n), "o")
+
+
+@register("apoc.number.fromOctal")
+def number_from_octal(s):
+    return _parse_int_strict(s, 8)
+
+
+@register("apoc.number.toBase")
+def number_to_base(n, base):
+    if n is None or base is None:
+        return None
+    base = int(base)
+    if not 2 <= base <= 36:
+        return None
+    n = int(n)
+    if n == 0:
+        return "0"
+    neg = n < 0
+    n = abs(n)
+    out = []
+    while n:
+        out.append(_BASE_DIGITS[n % base])
+        n //= base
+    # reference uppercases base-converted output (number.go ToBase)
+    return (("-" if neg else "") + "".join(reversed(out))).upper()
+
+
+@register("apoc.number.fromBase")
+def number_from_base(s, base):
+    try:
+        base = int(base)
+    except (TypeError, ValueError):
+        return None
+    if not 2 <= base <= 36:
+        return None
+    return _parse_int_strict(s, base)
+
+
+# ---------------------------------------------------------------------------
+# apoc.math.* gaps (ref: apoc/math/math.go — clamp/lerp/gcd/lcm/factorial/
+# fibonacci/isPrime/nextPrime/logit and the trig family)
+# ---------------------------------------------------------------------------
+
+
+@register("apoc.math.clamp")
+def math_clamp(v, lo, hi):
+    if v is None or lo is None or hi is None:
+        return None
+    return max(float(lo), min(float(hi), float(v)))
+
+
+@register("apoc.math.lerp")
+def math_lerp(a, b, t):
+    if a is None or b is None or t is None:
+        return None
+    return float(a) + (float(b) - float(a)) * float(t)
+
+
+@register("apoc.math.gcd")
+def math_gcd(a, b):
+    return None if a is None or b is None else _math.gcd(int(a), int(b))
+
+
+@register("apoc.math.lcm")
+def math_lcm(a, b):
+    if a is None or b is None:
+        return None
+    a, b = int(a), int(b)
+    return 0 if a == 0 or b == 0 else abs(a * b) // _math.gcd(a, b)
+
+
+@register("apoc.math.factorial")
+def math_factorial(n):
+    if n is None:
+        return None
+    n = int(n)
+    if n <= 1:
+        return 1  # ref math.go Factorial: n <= 1 (incl. negatives) -> 1
+    if n > 20:
+        return None  # 21! overflows int64; the reference silently wraps
+    return _math.factorial(n)
+
+
+@register("apoc.math.fibonacci")
+def math_fibonacci(n):
+    if n is None or int(n) < 0:
+        return None
+    a, b = 0, 1
+    for _ in range(int(n)):
+        a, b = b, a + b
+    return a
+
+
+@register("apoc.math.isPrime")
+def math_is_prime(n):
+    if n is None:
+        return None
+    n = int(n)
+    if n < 2:
+        return False
+    if n < 4:
+        return True
+    if n % 2 == 0:
+        return False
+    i = 3
+    while i * i <= n:
+        if n % i == 0:
+            return False
+        i += 2
+    return True
+
+
+@register("apoc.math.nextPrime")
+def math_next_prime(n):
+    if n is None:
+        return None
+    c = int(n) + 1
+    while not math_is_prime(c):
+        c += 1
+    return c
+
+
+@register("apoc.math.logit")
+def math_logit(p):
+    if p is None:
+        return None
+    p = float(p)
+    if not 0.0 < p < 1.0:
+        return None
+    return _math.log(p / (1.0 - p))

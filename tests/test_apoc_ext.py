@@ -729,3 +729,43 @@ def test_text_gaps():
 def test_jaro_winkler_short_strings():
     # window clamps to 1: transposed 2-char strings are similar, not 0
     assert call("apoc.text.jaroWinklerDistance", "ab", "ba") > 0.5
+
+
+def test_number_gaps():
+    assert call("apoc.number.romanize", 1994) == "MCMXCIV"
+    assert call("apoc.number.arabize", "MCMXCIV") == 1994
+    assert call("apoc.number.arabize", call("apoc.number.romanize", 3888)) == 3888
+    assert call("apoc.number.romanize", 0) is None
+    assert call("apoc.number.toHex", 255) == "FF"  # ref uppercases
+    assert call("apoc.number.fromHex", "ff") == 255
+    assert call("apoc.number.fromHex", "FF") == 255
+    assert call("apoc.number.toBinary", 10) == "1010"
+    assert call("apoc.number.fromBinary", "1010") == 10
+    assert call("apoc.number.toOctal", 8) == "10"
+    assert call("apoc.number.toBase", 255, 36) == "73"
+    assert call("apoc.number.fromBase", "73", 36) == 255
+    assert call("apoc.number.toBase", -10, 2) == "-1010"
+    assert call("apoc.number.fromHex", "zz") is None
+    # strconv strictness: prefixes/underscores/overflow all rejected
+    assert call("apoc.number.fromHex", "0xff") is None
+    assert call("apoc.number.fromBinary", "0b1") is None
+    assert call("apoc.number.fromHex", "f_f") is None
+    assert call("apoc.number.fromHex", "ffffffffffffffffff") is None  # >int64
+    assert call("apoc.number.arabize", "VIX") == 14  # ref's subtractive rule
+
+
+def test_math_gaps():
+    assert call("apoc.math.clamp", 15, 0, 10) == 10.0
+    assert call("apoc.math.lerp", 0, 10, 0.5) == 5.0
+    assert call("apoc.math.gcd", 12, 18) == 6
+    assert call("apoc.math.lcm", 4, 6) == 12
+    assert call("apoc.math.factorial", 5) == 120
+    assert call("apoc.math.factorial", -1) == 1  # ref: n <= 1 -> 1
+    assert call("apoc.math.factorial", 21) is None  # int64 overflow guard
+    assert call("apoc.math.fibonacci", 10) == 55
+    assert call("apoc.math.isPrime", 97) is True
+    assert call("apoc.math.isPrime", 1) is False
+    assert call("apoc.math.nextPrime", 97) == 101
+    import math as _m
+    assert abs(call("apoc.math.logit", 0.5)) < 1e-12
+    assert call("apoc.math.logit", 1.5) is None
