@@ -2049,3 +2049,134 @@ def math_logit(p):
     if not 0.0 < p < 1.0:
         return None
     return _math.log(p / (1.0 - p))
+
+
+# ---------------------------------------------------------------------------
+# apoc.hashing.* gaps (ref: apoc/hashing/hashing.go — FNV1a 32/64,
+# MurmurHash3 32, JumpHash, ConsistentHash, Fingerprint; md5/sha live in
+# functions.py)
+# ---------------------------------------------------------------------------
+
+_U32 = 0xFFFFFFFF
+_U64 = 0xFFFFFFFFFFFFFFFF
+
+
+@register("apoc.hashing.fnv1a")
+def hashing_fnv1a(s):
+    if s is None:
+        return None
+    h = 0x811C9DC5
+    for b in str(s).encode("utf-8"):
+        h = ((h ^ b) * 0x01000193) & _U32
+    return h
+
+
+@register("apoc.hashing.fnv1a64")
+def hashing_fnv1a64(s):
+    if s is None:
+        return None
+    h = 0xCBF29CE484222325
+    for b in str(s).encode("utf-8"):
+        h = ((h ^ b) * 0x100000001B3) & _U64
+    return h
+
+
+@register("apoc.hashing.murmur3")
+def hashing_murmur3(s, seed=0):
+    """MurmurHash3 x86 32-bit (ref hashing.go murmur3_32)."""
+    if s is None:
+        return None
+    data = str(s).encode("utf-8")
+    c1, c2 = 0xCC9E2D51, 0x1B873593
+    h = int(seed) & _U32
+    n_blocks = len(data) // 4
+    for i in range(n_blocks):
+        k = int.from_bytes(data[i * 4 : i * 4 + 4], "little")
+        k = (k * c1) & _U32
+        k = ((k << 15) | (k >> 17)) & _U32
+        k = (k * c2) & _U32
+        h ^= k
+        h = ((h << 13) | (h >> 19)) & _U32
+        h = (h * 5 + 0xE6546B64) & _U32
+    tail = data[n_blocks * 4 :]
+    k = 0
+    for i, b in enumerate(tail):
+        k |= b << (8 * i)
+    if tail:
+        k = (k * c1) & _U32
+        k = ((k << 15) | (k >> 17)) & _U32
+        k = (k * c2) & _U32
+        h ^= k
+    h ^= len(data)
+    h ^= h >> 16
+    h = (h * 0x85EBCA6B) & _U32
+    h ^= h >> 13
+    h = (h * 0xC2B2AE35) & _U32
+    h ^= h >> 16
+    return h
+
+
+@register("apoc.hashing.jumpHash")
+def hashing_jump_hash(key, buckets):
+    """Jump consistent hash (ref hashing.go JumpHash — Lamping-Veach).
+    String keys are fnv1a64-hashed first."""
+    if key is None or buckets is None:
+        return None
+    buckets = int(buckets)
+    if buckets <= 0:
+        return None
+    k = hashing_fnv1a64(key) if isinstance(key, str) else int(key) & _U64
+    b, j = -1, 0
+    while j < buckets:
+        b = j
+        k = (k * 2862933555777941757 + 1) & _U64
+        j = int(float(b + 1) * (float(1 << 31) / float((k >> 33) + 1)))
+    return b
+
+
+@register("apoc.hashing.consistentHash")
+def hashing_consistent(key, buckets):
+    """fnv1a64(key) % buckets -> bucket index (ref hashing.go
+    ConsistentHash). For ring-with-named-nodes semantics use jumpHash
+    over an index into your node list."""
+    if key is None or buckets is None:
+        return None
+    try:
+        buckets = int(buckets)
+    except (TypeError, ValueError):
+        return None
+    if buckets <= 0:
+        return None
+    return hashing_fnv1a64(str(key)) % buckets
+
+
+@register("apoc.hashing.fingerprint")
+def hashing_fingerprint(entity, exclude=None):
+    """Content fingerprint of a node/relationship/map: sha256 over the
+    sorted properties (minus excluded keys) + labels/type (ref
+    hashing.go Fingerprint)."""
+    import hashlib as _hl
+
+    if entity is None:
+        return None
+    exclude = set(exclude or [])
+    props = getattr(entity, "properties", None)
+    if props is None and isinstance(entity, dict):
+        props = entity
+    if props is None:
+        # scalar/list input: hash the value itself (ref hashes %v), so
+        # distinct scalars get distinct fingerprints
+        blob = _json.dumps(entity, sort_keys=True, default=str)
+        return _hl.sha256(blob.encode("utf-8")).hexdigest()
+    payload = {k: v for k, v in dict(props).items() if k not in exclude}
+    # unambiguous envelope: labels/type ride INSIDE the json, so
+    # ['A|B'] vs ['A','B'] can never collide and type never clobbers labels
+    envelope = {"properties": payload}
+    labels = getattr(entity, "labels", None)
+    if labels is not None:
+        envelope["labels"] = sorted(labels)
+    etype = getattr(entity, "type", None)
+    if isinstance(etype, str):
+        envelope["type"] = etype
+    blob = _json.dumps(envelope, sort_keys=True, default=str)
+    return _hl.sha256(blob.encode("utf-8")).hexdigest()

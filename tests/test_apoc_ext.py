@@ -769,3 +769,48 @@ def test_math_gaps():
     import math as _m
     assert abs(call("apoc.math.logit", 0.5)) < 1e-12
     assert call("apoc.math.logit", 1.5) is None
+
+
+def test_hashing_gaps():
+    # FNV-1a known vectors
+    assert call("apoc.hashing.fnv1a", "") == 0x811C9DC5
+    assert call("apoc.hashing.fnv1a", "a") == 0xE40C292C
+    assert call("apoc.hashing.fnv1a64", "a") == 0xAF63DC4C8601EC8C
+    # murmur3 x86_32 known vectors (seed 0)
+    assert call("apoc.hashing.murmur3", "") == 0
+    assert call("apoc.hashing.murmur3", "hello") == 0x248BFA47
+    # jump hash: stable, in-range, minimal reshuffling on growth
+    b10 = [call("apoc.hashing.jumpHash", f"k{i}", 10) for i in range(50)]
+    assert all(0 <= b < 10 for b in b10)
+    b11 = [call("apoc.hashing.jumpHash", f"k{i}", 11) for i in range(50)]
+    moved = sum(1 for x, y in zip(b10, b11) if x != y)
+    assert moved <= 15  # ~1/11 expected to move, never a full reshuffle
+    # consistent hash: fnv1a64 % buckets (reference API: bucket COUNT)
+    pick = call("apoc.hashing.consistentHash", "user-42", 100)
+    assert 0 <= pick < 100
+    assert call("apoc.hashing.consistentHash", "user-42", 100) == pick
+    assert pick == call("apoc.hashing.fnv1a64", "user-42") % 100
+    assert call("apoc.hashing.consistentHash", "k", 0) is None
+    # fingerprint: property-order independent, exclude list honored
+    from nornicdb_tpu.storage.types import Node
+    a = Node(labels=["P"], properties={"x": 1, "y": 2})
+    b = Node(labels=["P"], properties={"y": 2, "x": 1})
+    assert call("apoc.hashing.fingerprint", a) == call("apoc.hashing.fingerprint", b)
+    c = Node(labels=["P"], properties={"x": 1, "y": 2, "updated_at": 999})
+    assert call("apoc.hashing.fingerprint", a, ["updated_at"]) == call(
+        "apoc.hashing.fingerprint", c, ["updated_at"])
+    assert call("apoc.hashing.fingerprint", a) != call("apoc.hashing.fingerprint", c)
+    assert call("apoc.hashing.fnv1a", None) is None
+
+
+def test_fingerprint_review_regressions():
+    from nornicdb_tpu.storage.types import Node
+    # scalars hash their value, not an empty map
+    assert call("apoc.hashing.fingerprint", "hello") != call(
+        "apoc.hashing.fingerprint", "world")
+    assert call("apoc.hashing.fingerprint", 42) != call(
+        "apoc.hashing.fingerprint", [1, 2, 3])
+    # label-list encoding is unambiguous
+    a = Node(labels=["A|B"], properties={"x": 1})
+    b = Node(labels=["A", "B"], properties={"x": 1})
+    assert call("apoc.hashing.fingerprint", a) != call("apoc.hashing.fingerprint", b)
