@@ -2180,3 +2180,109 @@ def hashing_fingerprint(entity, exclude=None):
         envelope["type"] = etype
     blob = _json.dumps(envelope, sort_keys=True, default=str)
     return _hl.sha256(blob.encode("utf-8")).hexdigest()
+
+
+# ---------------------------------------------------------------------------
+# apoc.node./rel./label./any. gaps (ref: apoc/node/node.go, rel/rel.go,
+# label/label.go, any/any.go — pure accessors over bound entities)
+# ---------------------------------------------------------------------------
+
+
+@register("apoc.node.id")
+def node_id(n):
+    return None if n is None else getattr(n, "id", None)
+
+
+@register("apoc.node.labels")
+def node_labels(n):
+    return None if n is None else list(getattr(n, "labels", []) or [])
+
+
+@register("apoc.node.hasLabel")
+def node_has_label(n, label):
+    if n is None or label is None:
+        return None
+    return str(label) in (getattr(n, "labels", []) or [])
+
+
+@register("apoc.node.hasLabels")
+def node_has_labels(n, labels):
+    if n is None or labels is None:
+        return None
+    if isinstance(labels, str):
+        labels = [labels]  # a bare string is ONE label, not a char list
+    have = set(getattr(n, "labels", []) or [])
+    return all(str(l) in have for l in labels)
+
+
+@register("apoc.rel.id")
+def rel_id(e):
+    return None if e is None else getattr(e, "id", None)
+
+
+@register("apoc.rel.startNode")
+def rel_start(ex, e):
+    """Resolves the NODE (not its id), like the builtin startNode() and
+    the reference's Storage.GetNode path (rel.go StartNode)."""
+    if e is None:
+        return None
+    if ex is None:
+        raise ValueError("apoc.rel.startNode requires executor context")
+    return ex.get_node_or_none(getattr(e, "start_node", None))
+
+
+rel_start.needs_executor = True
+
+
+@register("apoc.rel.endNode")
+def rel_end(ex, e):
+    if e is None:
+        return None
+    if ex is None:
+        raise ValueError("apoc.rel.endNode requires executor context")
+    return ex.get_node_or_none(getattr(e, "end_node", None))
+
+
+rel_end.needs_executor = True
+
+
+@register("apoc.rel.isType")
+def rel_is_type(e, rel_type):
+    if e is None or rel_type is None:
+        return None
+    return getattr(e, "type", None) == str(rel_type)
+
+
+@register("apoc.rel.isLoop")
+def rel_is_loop(e):
+    from nornicdb_tpu.storage.types import Edge as _Edge
+
+    if e is None:
+        return None
+    if not isinstance(e, _Edge):
+        return None  # not a relationship: no sentinel-equality surprises
+    return e.start_node == e.end_node
+
+
+from nornicdb_tpu.storage.types import Edge as _EdgeT  # noqa: E402
+from nornicdb_tpu.storage.types import Node as _NodeT  # noqa: E402
+
+
+# reference registers these under apoc.util.* (apoc.go:482-484); the
+# any.* spellings stay as aliases for symmetry with any.properties
+@register("apoc.util.isNode")
+@register("apoc.any.isNode")
+def any_is_node(v):
+    return isinstance(v, _NodeT)
+
+
+@register("apoc.util.isRelationship")
+@register("apoc.any.isRelationship")
+def any_is_rel(v):
+    return isinstance(v, _EdgeT)
+
+
+@register("apoc.util.isPath")
+@register("apoc.any.isPath")
+def any_is_path(v):
+    return isinstance(v, dict) and bool(v.get("__path__"))

@@ -814,3 +814,33 @@ def test_fingerprint_review_regressions():
     a = Node(labels=["A|B"], properties={"x": 1})
     b = Node(labels=["A", "B"], properties={"x": 1})
     assert call("apoc.hashing.fingerprint", a) != call("apoc.hashing.fingerprint", b)
+
+
+def test_entity_accessor_gaps():
+    from nornicdb_tpu.storage.types import Edge, Node
+    n = Node(id="n1", labels=["A", "B"])
+    e = Edge(id="e1", start_node="n1", end_node="n1", type="SELF")
+    assert call("apoc.node.id", n) == "n1"
+    assert call("apoc.node.labels", n) == ["A", "B"]
+    assert call("apoc.node.hasLabel", n, "A") is True
+    assert call("apoc.node.hasLabels", n, ["A", "B"]) is True
+    assert call("apoc.node.hasLabels", n, ["A", "Z"]) is False
+    assert call("apoc.rel.id", e) == "e1"
+    assert call("apoc.rel.isType", e, "SELF") is True
+    assert call("apoc.rel.isLoop", e) is True
+    assert call("apoc.any.isNode", n) is True
+    assert call("apoc.any.isNode", e) is False
+    assert call("apoc.any.isRelationship", e) is True
+    assert call("apoc.any.isPath", {"__path__": True, "nodes": [], "relationships": []}) is True
+    assert call("apoc.util.isNode", n) is True  # reference spelling
+    assert call("apoc.node.hasLabels", n, "A") is True  # bare string = 1 label
+    assert call("apoc.node.id", None) is None
+
+
+def test_rel_startnode_resolves_node(ex):
+    ex.execute("CREATE (:SA {name: 'src'})-[:R4]->(:SB {name: 'dst'})")
+    r = ex.execute(
+        "MATCH ()-[r:R4]->() "
+        "RETURN apoc.rel.startNode(r).name, apoc.rel.endNode(r).name, "
+        "apoc.util.isNode(apoc.rel.startNode(r))")
+    assert r.rows[0] == ["src", "dst", True]
