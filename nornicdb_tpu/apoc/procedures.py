@@ -1031,3 +1031,102 @@ def apoc_normalize_bool(ex: CypherExecutor, args, row):
     else:
         ex.storage.update_edge(entity)
     return ["entity"], [[entity]]
+
+
+# ---------------------------------------------------------------------------
+# apoc.meta.* introspection (ref: apoc/meta/meta.go — Schema/Data/
+# NodeTypeProperties/RelTypeProperties)
+# ---------------------------------------------------------------------------
+
+
+def _cypher_type_of(v) -> str:
+    """Delegates to apoc.meta.type so schema introspection and the
+    meta.type function can never disagree on a value's type name."""
+    from nornicdb_tpu.apoc.functions import meta_type
+
+    return str(meta_type(v)).upper()
+
+
+@procedure("apoc.meta.schema")
+def apoc_meta_schema(ex: CypherExecutor, args, row):
+    """One map describing every label: property names -> {type, count} and
+    outgoing relationship types (ref meta.go Schema)."""
+    schema: dict[str, Any] = {}
+    nodes_by_id: dict[str, Any] = {}
+    for n in ex.storage.all_nodes():
+        nodes_by_id[n.id] = n
+        for label in n.labels:
+            entry = schema.setdefault(
+                label, {"type": "node", "count": 0, "properties": {},
+                        "relationships": {}})
+            entry["count"] += 1
+            for k, v in n.properties.items():
+                p = entry["properties"].setdefault(
+                    k, {"type": _cypher_type_of(v), "count": 0})
+                p["count"] += 1
+                if p["type"] != _cypher_type_of(v):
+                    p["type"] = "ANY"  # mixed types across nodes
+    for e in ex.storage.all_edges():
+        src = nodes_by_id.get(e.start_node)
+        if src is None:
+            continue
+        for label in src.labels:
+            entry = schema.get(label)
+            if entry is not None:
+                rel = entry["relationships"].setdefault(
+                    e.type, {"direction": "out", "count": 0})
+                rel["count"] += 1
+    return ["value"], [[schema]]
+
+
+@procedure("apoc.meta.nodetypeproperties")
+def apoc_meta_node_type_props(ex: CypherExecutor, args, row):
+    """Row per (label, property): observed types + counts (ref meta.go
+    NodeTypeProperties / db.schema.nodeTypeProperties shape)."""
+    seen: dict[tuple, dict] = {}
+    totals: dict[str, int] = {}
+    for n in ex.storage.all_nodes():
+        for label in n.labels:
+            totals[label] = totals.get(label, 0) + 1
+            for k, v in n.properties.items():
+                rec = seen.setdefault((label, k), {"types": set(), "count": 0})
+                rec["types"].add(_cypher_type_of(v))
+                rec["count"] += 1
+    rows = []
+    for (label, prop), rec in sorted(seen.items()):
+        rows.append([f":`{label}`", [label], prop,
+                     sorted(rec["types"]), rec["count"] == totals[label]])
+    return (["nodeType", "nodeLabels", "propertyName", "propertyTypes",
+             "mandatory"], rows)
+
+
+@procedure("apoc.meta.reltypeproperties")
+def apoc_meta_rel_type_props(ex: CypherExecutor, args, row):
+    seen: dict[tuple, dict] = {}
+    totals: dict[str, int] = {}
+    for e in ex.storage.all_edges():
+        totals[e.type] = totals.get(e.type, 0) + 1
+        for k, v in e.properties.items():
+            rec = seen.setdefault((e.type, k), {"types": set(), "count": 0})
+            rec["types"].add(_cypher_type_of(v))
+            rec["count"] += 1
+    rows = []
+    for (rtype, prop), rec in sorted(seen.items()):
+        rows.append([f":`{rtype}`", prop, sorted(rec["types"]),
+                     rec["count"] == totals[rtype]])
+    return ["relType", "propertyName", "propertyTypes", "mandatory"], rows
+
+
+@procedure("apoc.meta.data")
+def apoc_meta_data(ex: CypherExecutor, args, row):
+    """Row per (label, property/relationship) — the tabular twin of
+    apoc.meta.schema (ref meta.go Data)."""
+    _, rows_ = apoc_meta_schema(ex, args, row)
+    schema = rows_[0][0]
+    out = []
+    for label, entry in sorted(schema.items()):
+        for prop, info in sorted(entry["properties"].items()):
+            out.append([label, prop, info["type"], False, info["count"]])
+        for rtype, info in sorted(entry["relationships"].items()):
+            out.append([label, rtype, "RELATIONSHIP", True, info["count"]])
+    return ["label", "property", "type", "isRelationship", "count"], out

@@ -844,3 +844,35 @@ def test_rel_startnode_resolves_node(ex):
         "RETURN apoc.rel.startNode(r).name, apoc.rel.endNode(r).name, "
         "apoc.util.isNode(apoc.rel.startNode(r))")
     assert r.rows[0] == ["src", "dst", True]
+
+
+def test_meta_schema_and_type_properties(ex):
+    ex.execute(
+        "CREATE (a:User {name: 'ann', age: 30})-[:FOLLOWS {since: 1}]->"
+        "(:User {name: 'bob'}), (a)-[:WROTE]->(:Post {title: 't', views: 2.5})"
+    )
+    r = ex.execute("CALL apoc.meta.schema() YIELD value RETURN value")
+    schema = r.rows[0][0]
+    assert schema["User"]["count"] == 2
+    assert schema["User"]["properties"]["name"]["type"] == "STRING"
+    assert schema["User"]["properties"]["age"]["count"] == 1
+    assert schema["User"]["relationships"]["FOLLOWS"]["count"] == 1
+    assert schema["Post"]["properties"]["views"]["type"] == "FLOAT"
+
+    r = ex.execute(
+        "CALL apoc.meta.nodeTypeProperties() "
+        "YIELD nodeLabels, propertyName, propertyTypes, mandatory "
+        "RETURN nodeLabels, propertyName, propertyTypes, mandatory")
+    by_key = {(tuple(x[0]), x[1]): (x[2], x[3]) for x in r.rows}
+    assert by_key[(("User",), "name")] == (["STRING"], True)  # on both users
+    assert by_key[(("User",), "age")][1] is False  # only one user has it
+
+    r = ex.execute(
+        "CALL apoc.meta.relTypeProperties() "
+        "YIELD relType, propertyName, mandatory RETURN relType, propertyName, mandatory")
+    assert [":`FOLLOWS`", "since", True] in r.rows
+
+    r = ex.execute(
+        "CALL apoc.meta.data() YIELD label, property, isRelationship "
+        "RETURN count(*)")
+    assert r.rows[0][0] >= 5
