@@ -1,0 +1,13 @@
+#!/bin/bash
+# Probe the axon TPU relay; append dated status to relay log.
+TS=$(date -u +"%Y-%m-%dT%H:%M:%SZ")
+OUT=$(timeout 75 python -c "
+import jax, numpy as np, jax.numpy as jnp
+ds = jax.devices()
+x = jnp.ones((128,128)); y = np.asarray(x @ x)
+print('UP', ds[0].platform, len(ds))
+" 2>/dev/null)
+RC=$?
+case "$OUT" in UP*) STATUS="$OUT";; *) STATUS="DOWN rc=$RC";; esac
+echo "$TS $STATUS" >> /root/repo/.relay/log.txt
+echo "$TS $STATUS"

@@ -1316,6 +1316,38 @@ _NONDETERMINISTIC_FNS = {
 }
 
 
+def classify_query_text(query: str) -> str:
+    """Permission class ("read" | "write") of a raw query string.
+
+    AST-based, shared by the HTTP tx API and Bolt RBAC gates: any CALL of a
+    procedure not in _READONLY_PROCEDURES counts as a write, so mutating
+    procedures (CALL apoc.refactor.*, apoc.trigger.add, ...) can't slip past
+    a keyword regex under a viewer token (ref: auth gating of
+    /db/{db}/tx/commit, server_middleware.go). Unparseable input classifies
+    as write — the executor rejects it anyway, and the conservative class
+    cannot leak privileges.
+    """
+    try:
+        stmt = parse(query)
+    except Exception:
+        return "write"
+    if isinstance(stmt, ast.Query):
+        return "write" if _is_write_query(stmt) else "read"
+    if isinstance(stmt, ast.UseCommand):
+        if stmt.query is not None:
+            return "write" if _is_write_query(stmt.query) else "read"
+        return "read"
+    if isinstance(stmt, ast.ShowCommand):
+        return "read"
+    # TxCommand (BEGIN/COMMIT/ROLLBACK) classifies as write: on the stateless
+    # HTTP endpoint a viewer-opened BEGIN would pin the shared executor's tx
+    # open forever (deferring WAL compaction unboundedly) and let a later
+    # ROLLBACK wipe other users' writes. Bolt exempts tx keywords from this
+    # gate (read-only explicit transactions stay allowed there, where the
+    # session owns and cleans up its tx).
+    return "write"  # TxCommand, index/constraint DDL, database commands
+
+
 def _is_write_query(q: ast.Query) -> bool:
     for c in q.clauses:
         if isinstance(c, _WRITE_CLAUSES):

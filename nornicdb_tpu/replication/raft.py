@@ -9,6 +9,8 @@ device plane is untouched (SURVEY.md §2.3).
 
 from __future__ import annotations
 
+import json
+import os
 import random
 import threading
 import time
@@ -56,6 +58,7 @@ class RaftNode:
         storage: Optional[Engine] = None,
         config: Optional[RaftConfig] = None,
         seed: Optional[int] = None,
+        state_dir: Optional[str] = None,
     ):
         self.node_id = node_id
         self.transport = transport
@@ -63,10 +66,32 @@ class RaftNode:
         self.storage = storage
         self.config = config or RaftConfig()
         self.rng = random.Random(seed if seed is not None else hash(node_id))
-        # persistent state
+        # persistent state (term/vote/log are durable when state_dir is set;
+        # fsynced BEFORE replying to RPCs, so a restarted node cannot vote
+        # twice in one term — Raft's election-safety invariant, ref:
+        # raft.go persistent state handling)
         self.current_term = 0
         self.voted_for: Optional[str] = None
         self.log: list[LogEntry] = []
+        self._state_path: Optional[str] = None
+        self._log_path: Optional[str] = None
+        self._log_f = None
+        self._state_dir = state_dir
+        if state_dir:
+            os.makedirs(state_dir, exist_ok=True)
+            self._state_path = os.path.join(state_dir, f"raft-{node_id}.state")
+            self._log_path = os.path.join(state_dir, f"raft-{node_id}.log")
+            good_bytes = self._load_persistent()
+            # chop a torn tail (crash mid-append) BEFORE reopening in append
+            # mode — otherwise the next entry lands on the partial line and
+            # every later fsync'd entry is unreadable on the following restart
+            if good_bytes is not None:
+                try:
+                    if os.path.getsize(self._log_path) > good_bytes:
+                        os.truncate(self._log_path, good_bytes)
+                except OSError:
+                    pass
+            self._log_f = open(self._log_path, "ab")
         # volatile
         self.state = FOLLOWER
         self.commit_index = 0
@@ -84,6 +109,12 @@ class RaftNode:
 
     # -- lifecycle -----------------------------------------------------------
     def start(self) -> None:
+        # a stop()/start() cycle must reopen the durable log: with _log_f
+        # still None the persist helpers would silently no-op while
+        # _handle_append keeps acking — a durability promise never written
+        with self._lock:
+            if self._log_path is not None and self._log_f is None:
+                self._log_f = open(self._log_path, "ab")
         self._stop.clear()
         t = threading.Thread(target=self._tick_loop, daemon=True,
                              name=f"raft-{self.node_id}")
@@ -95,6 +126,108 @@ class RaftNode:
         for t in self._threads:
             t.join(timeout=2)
         self._threads.clear()
+        # close under the RPC lock: a late AppendEntries dispatched by the
+        # transport must see _log_f is None, not a closed file object
+        with self._lock:
+            if self._log_f is not None:
+                f, self._log_f = self._log_f, None
+                f.close()
+
+    # -- durable state (term/vote/log) ------------------------------------
+    def _load_persistent(self) -> Optional[int]:
+        """Returns the byte offset of the last intact log line (for torn-tail
+        truncation), or None when there is no log file."""
+        try:
+            with open(self._state_path) as f:
+                st = json.load(f)
+            self.current_term = int(st.get("current_term", 0))
+            self.voted_for = st.get("voted_for")
+        except (OSError, ValueError):
+            pass
+        good = None
+        try:
+            with open(self._log_path, "rb") as f:
+                good = 0
+                for line in f:
+                    if not line.endswith(b"\n"):
+                        break  # torn tail
+                    stripped = line.strip()
+                    if stripped:
+                        try:
+                            e = json.loads(stripped)
+                            self.log.append(
+                                LogEntry(e["term"], e["index"],
+                                         e.get("op", ""), e.get("data", {}))
+                            )
+                        except (ValueError, KeyError, TypeError):
+                            # TypeError: valid JSON that is not an object
+                            # ('null', '5', '[..]') must also truncate, not
+                            # crash the node on every restart
+                            break  # corrupt line: keep only the prefix
+                    good += len(line)
+        except OSError:
+            pass
+        return good
+
+    def _fsync_dir(self) -> None:
+        """Durably record renames: fsync the state directory itself, or an
+        os.replace'd file can vanish on power loss after the RPC reply."""
+        if not self._state_dir:
+            return
+        try:
+            fd = os.open(self._state_dir, os.O_RDONLY)
+            try:
+                os.fsync(fd)
+            finally:
+                os.close(fd)
+        except OSError:
+            pass
+
+    def _persist_state(self) -> None:
+        if self._state_path is None:
+            return
+        tmp = self._state_path + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(
+                {"current_term": self.current_term, "voted_for": self.voted_for},
+                f,
+            )
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, self._state_path)
+        self._fsync_dir()
+
+    def _persist_log_append(self, entries: list[LogEntry]) -> None:
+        if self._log_f is None:
+            return
+        for e in entries:
+            self._log_f.write(
+                json.dumps(
+                    {"term": e.term, "index": e.index, "op": e.op, "data": e.data}
+                ).encode() + b"\n"
+            )
+        self._log_f.flush()
+        os.fsync(self._log_f.fileno())
+
+    def _persist_log_rewrite(self) -> None:
+        """Full rewrite after a conflict truncation (rare path)."""
+        if self._log_path is None or self._log_f is None:
+            return
+        self._log_f.close()
+        tmp = self._log_path + ".tmp"
+        with open(tmp, "wb") as f:
+            for e in self.log:
+                f.write(
+                    json.dumps(
+                        {"term": e.term, "index": e.index, "op": e.op,
+                         "data": e.data}
+                    ).encode() + b"\n"
+                )
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, self._log_path)
+        self._fsync_dir()
+        self._log_f = open(self._log_path, "ab")
 
     def _new_deadline(self) -> float:
         return time.time() + self.rng.uniform(
@@ -119,6 +252,7 @@ class RaftNode:
             self.current_term += 1
             term = self.current_term
             self.voted_for = self.node_id
+            self._persist_state()  # durable before any vote request leaves
             self.leader_id = None
             self._election_deadline = self._new_deadline()
             last_idx = len(self.log)
@@ -180,9 +314,14 @@ class RaftNode:
         threading.Thread(target=self._broadcast_append_entries, daemon=True).start()
 
     def _step_down(self, term: int) -> None:
-        self.current_term = term
+        # voted_for only resets when the term actually increases: clearing it
+        # on a same-term transition (e.g. candidate seeing the elected
+        # leader's AppendEntries) would let this node vote twice in one term
+        if term > self.current_term:
+            self.current_term = term
+            self.voted_for = None
+            self._persist_state()
         self.state = FOLLOWER
-        self.voted_for = None
         self._election_deadline = self._new_deadline()
 
     # -- log replication --------------------------------------------------------
@@ -193,6 +332,7 @@ class RaftNode:
                 raise ReplicationError(f"not the leader (leader={self.leader_id})")
             entry = LogEntry(self.current_term, len(self.log) + 1, op, data)
             self.log.append(entry)
+            self._persist_log_append([entry])
             index = entry.index
             if not self.peer_ids:
                 # single-node cluster: a majority of one holds it already
@@ -307,6 +447,7 @@ class RaftNode:
                 if up_to_date:
                     granted = True
                     self.voted_for = candidate
+                    self._persist_state()  # fsync the vote before replying
                     self._election_deadline = self._new_deadline()
             return Message(0, {"term": self.current_term, "vote_granted": granted})
 
@@ -332,33 +473,51 @@ class RaftNode:
                 return Message(0, {"term": self.current_term, "success": False})
             if prev_idx >= 1 and self.log[prev_idx - 1].term != prev_term:
                 self.log = self.log[: prev_idx - 1]  # conflict: truncate
+                self._persist_log_rewrite()
                 return Message(0, {"term": self.current_term, "success": False})
             entries = p.get("entries", [])
             if not isinstance(entries, list):
                 # malformed batch: success would falsely advance the leader's
                 # match_index and let it commit entries we never appended
                 return Message(0, {"term": self.current_term, "success": False})
+            truncated = False
+            appended: list[LogEntry] = []
+
+            def _reject():
+                if truncated:
+                    self._persist_log_rewrite()
+                elif appended:
+                    self._persist_log_append(appended)
+                return Message(0, {"term": self.current_term, "success": False})
+
             for e in entries:
                 if not isinstance(e, dict):
-                    return Message(0, {"term": self.current_term, "success": False})
+                    return _reject()
                 idx = e.get("index")
                 eterm = e.get("term")
                 if not isinstance(idx, int) or not isinstance(eterm, int):
-                    return Message(0, {"term": self.current_term, "success": False})
+                    return _reject()
                 if idx <= len(self.log):
                     if self.log[idx - 1].term != eterm:
                         self.log = self.log[: idx - 1]
+                        truncated = True
                     else:
                         continue
                 if idx == len(self.log) + 1:
-                    self.log.append(
-                        LogEntry(
-                            eterm, idx, e.get("op", ""),
-                            e.get("data", {}) if isinstance(e.get("data"), dict) else {},
-                        )
+                    entry = LogEntry(
+                        eterm, idx, e.get("op", ""),
+                        e.get("data", {}) if isinstance(e.get("data"), dict) else {},
                     )
+                    self.log.append(entry)
+                    appended.append(entry)
                 else:
-                    return Message(0, {"term": self.current_term, "success": False})
+                    return _reject()
+            # fsync the durable log before acking (success advances the
+            # leader's match_index — the ack is a durability promise)
+            if truncated:
+                self._persist_log_rewrite()
+            elif appended:
+                self._persist_log_append(appended)
             leader_commit = p.get("leader_commit", 0)
             if isinstance(leader_commit, int) and leader_commit > self.commit_index:
                 self.commit_index = min(leader_commit, len(self.log))

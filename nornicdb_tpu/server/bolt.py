@@ -17,6 +17,8 @@ import struct
 import threading
 from typing import Any, Optional
 
+from nornicdb_tpu.cypher.executor import classify_query_text
+from nornicdb_tpu.errors import AuthError
 from nornicdb_tpu.server.packstream import Structure, pack, to_wire, unpack
 
 MAGIC = b"\x60\x60\xb0\x17"
@@ -58,6 +60,11 @@ class BoltSession:
     def __init__(self, server: "BoltServer"):
         self.server = server
         self.authenticated = not server.auth_required
+        # RBAC: role resolved at HELLO/LOGON, enforced per-RUN with the same
+        # AST-based write classification as the HTTP tx endpoint (ref: Bolt
+        # auth adapter auth_adapter.go + permission model auth.go:171-176).
+        # No authenticator / auth disabled => full access.
+        self.role = "admin" if self.authenticated else "none"
         self.ready = False
         self.streaming: Optional[dict] = None  # {columns, rows, pos, stats}
         self.in_tx = False
@@ -76,13 +83,14 @@ class BoltSession:
                 return self._logon(fields)
             if tag == MSG_LOGOFF:
                 self.authenticated = not self.server.auth_required
+                self.role = "admin" if self.authenticated else "none"
                 return [(MSG_SUCCESS, {})]
             if tag == MSG_TELEMETRY:
                 return [(MSG_SUCCESS, {})]  # 5.4 drivers emit api telemetry
             if tag == MSG_RESET:
+                self.abort_tx()  # RESET mid-tx must ROLLBACK, not leak it
                 self.streaming = None
                 self.failed = False
-                self.in_tx = False
                 return [(MSG_SUCCESS, {})]
             if tag == MSG_GOODBYE:
                 return []
@@ -189,19 +197,43 @@ class BoltSession:
     def _try_auth(self, meta: dict) -> None:
         if self.server.authenticator is None:
             self.authenticated = True
+            self.role = "admin"
             return
         scheme = (meta or {}).get("scheme", "none")
         if scheme == "basic":
             user = meta.get("principal", "")
             pw = meta.get("credentials", "")
             self.authenticated = self.server.authenticator.check_password(user, pw)
+            if self.authenticated:
+                try:
+                    self.role = self.server.authenticator.get_user(user).role
+                except Exception:
+                    self.role = "none"
         elif scheme == "bearer":
             token = meta.get("credentials", "")
-            self.authenticated = (
-                self.server.authenticator.validate_token(token) is not None
-            )
+            payload = self.server.authenticator.validate_token(token)
+            self.authenticated = payload is not None
+            if payload is not None:
+                self.role = payload.get("role", "none")
         else:
             self.authenticated = not self.server.auth_required
+            self.role = "admin" if self.authenticated else "none"
+        if not self.authenticated:
+            self.role = "none"
+
+    def abort_tx(self) -> None:
+        """Roll back an open explicit transaction (RESET / disconnect).
+
+        Without this, a client that BEGINs and vanishes leaves the engine's
+        tx id set forever — which, among other things, permanently defers
+        WAL auto-compaction (wal.py compact() skips while a tx is open)."""
+        if not self.in_tx:
+            return
+        self.in_tx = False
+        try:
+            self._execute("ROLLBACK", {})
+        except Exception:
+            pass
 
     def _execute(self, query: str, params: dict):
         factory = self.server.session_executor_factory
@@ -218,6 +250,12 @@ class BoltSession:
         extra = fields[2] if len(fields) > 2 else {}
         if isinstance(extra, dict) and extra.get("db"):
             self.database = extra["db"]
+        if self.server.authenticator is not None and not _is_tx_keyword(query):
+            perm = classify_query_text(query)
+            if not self.server.authenticator.has_permission(self.role, perm):
+                raise AuthError(
+                    f"permission {perm} denied for role {self.role}"
+                )
         result = self._execute(query, params or {})
         self.streaming = {
             "columns": result.columns,
@@ -327,6 +365,7 @@ class BoltServer:
         self, reader: asyncio.StreamReader, writer: asyncio.StreamWriter
     ) -> None:
         self.connections += 1
+        session = None
         try:
             # handshake (ref: server.go:867-898)
             magic = await reader.readexactly(4)
@@ -373,6 +412,11 @@ class BoltServer:
             pass
         finally:
             self.connections -= 1
+            if session is not None:
+                try:
+                    session.abort_tx()  # dropped connection mid-tx: roll back
+                except Exception:
+                    pass
             try:
                 writer.close()
             except Exception:

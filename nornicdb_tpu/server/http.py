@@ -25,13 +25,7 @@ from nornicdb_tpu.errors import AuthError, NornicError
 from nornicdb_tpu.storage.types import Edge, Node
 
 
-_WRITE_RE = re.compile(
-    r"\b(CREATE|MERGE|SET|DELETE|REMOVE|DROP|DETACH|LOAD)\b", re.IGNORECASE
-)
-
-
-def _is_write_query(query: str) -> bool:
-    return _WRITE_RE.search(query) is not None
+from nornicdb_tpu.cypher.executor import classify_query_text
 
 
 def _jsonable(v: Any) -> Any:
@@ -390,7 +384,7 @@ class HttpServer:
             # permission is per-statement: read-only queries work for viewers
             perm = "read"
             for stmt in body.get("statements", []):
-                if _is_write_query(stmt.get("statement", "")):
+                if classify_query_text(stmt.get("statement", "")) == "write":
                     perm = "write"
                     break
             h._auth(perm)

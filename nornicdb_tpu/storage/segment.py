@@ -45,14 +45,20 @@ def _load_lib():
     with _lib_lock:
         if _lib is not None:
             return _lib
-        if not os.path.exists(_LIB_PATH):
+        src = os.path.join(_NATIVE_DIR, "segstore.cc")
+        stale = not os.path.exists(_LIB_PATH) or (
+            os.path.exists(src)
+            and os.path.getmtime(src) > os.path.getmtime(_LIB_PATH)
+        )
+        if stale:
             import subprocess
 
             try:
                 subprocess.run(["make", "-C", _NATIVE_DIR], check=True,
                                capture_output=True, timeout=120)
             except Exception:
-                return None
+                if not os.path.exists(_LIB_PATH):
+                    return None
         try:
             lib = ctypes.CDLL(_LIB_PATH)
         except OSError:

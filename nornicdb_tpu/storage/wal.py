@@ -143,6 +143,16 @@ class WAL:
             salt = load_or_create_salt(os.path.join(directory, self.SALT_NAME))
             self._encryptor = Encryptor.from_passphrase(passphrase, salt)
         self._seq = self._scan_last_seq()
+        # seq must stay monotonic across restarts even when compact() left an
+        # empty log: recovery filters replay on `seq > snapshot seq`, so a
+        # counter reseeded from the (empty) log alone would hand out seqs the
+        # filter silently drops — losing every write acked since the restart
+        try:
+            snap = self.load_snapshot()
+            if snap is not None:
+                self._seq = max(self._seq, int(snap.get("seq", 0)))
+        except Exception:
+            pass  # corrupt/locked snapshot surfaces at recover(), not here
         if self.stats.degraded:
             self._quarantine_corrupt_log()
         self._f = open(self._path, "ab")
@@ -338,14 +348,21 @@ class WAL:
         return len(entries), self.stats.truncated_tail_records == before
 
     # -- snapshot / compaction --------------------------------------------
-    def create_snapshot(self, engine: Engine) -> str:
-        """Full engine dump (ref: WAL.CreateSnapshot wal.go:819)."""
-        snap = {
+    def snapshot_state(self, engine: Engine) -> dict[str, Any]:
+        """In-memory engine dump (no IO) — callable under a write-blocking
+        lock so serialization and disk writes can happen outside it."""
+        return {
             "seq": self._seq,
             "nodes": [n.to_dict() for n in engine.all_nodes()],
             "edges": [e.to_dict() for e in engine.all_edges()],
             "pending_embed": engine.pending_embed_ids(),
         }
+
+    def create_snapshot(self, engine: Engine) -> str:
+        """Full engine dump (ref: WAL.CreateSnapshot wal.go:819)."""
+        return self.write_snapshot(self.snapshot_state(engine))
+
+    def write_snapshot(self, snap: dict[str, Any]) -> str:
         path = os.path.join(self.dir, self.SNAPSHOT_NAME)
         tmp = path + ".tmp"
         blob = json.dumps(snap).encode("utf-8")
@@ -365,6 +382,22 @@ class WAL:
         with self._lock:
             self._f.close()
             self._f = open(self._path, "wb")
+
+    def truncate_up_to(self, seq: int) -> None:
+        """Rewrite the log keeping only entries with seq > `seq` (appended
+        while the snapshot was being written; recovery replays exactly those
+        on top of the snapshot). Atomic via tmp+replace."""
+        with self._lock:
+            self._f.close()
+            keep = [e for e in self.read_all() if e.seq > seq]
+            tmp = self._path + ".tmp"
+            with open(tmp, "wb") as f:
+                for e in keep:
+                    f.write(e.encode(self._encryptor))
+                f.flush()
+                os.fsync(f.fileno())
+            os.replace(tmp, self._path)
+            self._f = open(self._path, "ab")
 
     def load_snapshot(self) -> Optional[dict[str, Any]]:
         path = os.path.join(self.dir, self.SNAPSHOT_NAME)
@@ -444,6 +477,16 @@ class WALEngine(Engine):
         self.base = base
         self.wal = wal
         self._txid: Optional[str] = None  # set by transaction scope
+        # serializes whole mutations (log + apply) against compaction: a
+        # record appended after the snapshot's engine dump but before the
+        # truncate would otherwise be erased yet absent from the snapshot,
+        # losing the write on recovery (reachable via the auto-compact timer)
+        self._mut_lock = threading.RLock()
+        # serializes compact-vs-compact: Timer.cancel() cannot stop an
+        # already-running tick, so close()'s final compact could otherwise
+        # interleave with it (older snapshot overwriting a newer one while
+        # the log is truncated past it)
+        self._compact_lock = threading.Lock()
         self._compact_timer: Optional[threading.Timer] = None
         self._auto_compact_interval = auto_compact_interval
         self._closed = False
@@ -466,55 +509,88 @@ class WALEngine(Engine):
         self._schedule_compact()
 
     def compact(self) -> None:
-        """Snapshot + truncate (ref: wal_engine.go:65-149, 5-min default)."""
-        self.wal.create_snapshot(self.base)
-        self.wal.truncate_after_snapshot()
+        """Snapshot + truncate (ref: wal_engine.go:65-149, 5-min default).
+
+        _mut_lock is held only for the in-memory engine dump; serialization,
+        fsync, and the log rewrite happen outside it, so writes stall for the
+        copy, not the disk IO. The truncate keeps entries newer than the
+        snapshot's seq (appended during the write) — recovery replays exactly
+        those on top of the snapshot (recover() filters on seq > snap seq).
+
+        Deferred while an explicit transaction is open: the base engine holds
+        the tx's uncommitted ops, so a snapshot taken now would bake them in
+        while dropping their txid-tagged records — recovery could then no
+        longer undo an incomplete transaction (ref: tx-aware recovery
+        wal.go:1845). The auto-compact timer retries next interval; protocol
+        layers roll back on RESET/disconnect so a vanished client cannot
+        defer compaction forever (bolt.py abort_tx).
+        """
+        with self._compact_lock:
+            if self._closed:
+                return
+            with self._mut_lock:
+                if self._txid is not None:
+                    return
+                snap = self.wal.snapshot_state(self.base)
+            self.wal.write_snapshot(snap)
+            self.wal.truncate_up_to(snap["seq"])
 
     # -- transaction scoping ----------------------------------------------
     def tx_begin(self, txid: str) -> None:
-        self.wal.append(OP_TX_BEGIN, {}, txid=txid)
-        self._txid = txid
+        with self._mut_lock:
+            self.wal.append(OP_TX_BEGIN, {}, txid=txid)
+            self._txid = txid
 
     def tx_commit(self, txid: str) -> None:
-        self.wal.append(OP_TX_COMMIT, {}, txid=txid)
-        self._txid = None
+        with self._mut_lock:
+            self.wal.append(OP_TX_COMMIT, {}, txid=txid)
+            self._txid = None
 
     def tx_rollback(self, txid: str) -> None:
-        self.wal.append(OP_TX_ROLLBACK, {}, txid=txid)
-        self._txid = None
+        with self._mut_lock:
+            self.wal.append(OP_TX_ROLLBACK, {}, txid=txid)
+            self._txid = None
 
-    # -- mutations (log first, then apply) ---------------------------------
+    # -- mutations (log first, then apply; atomic vs compact) ---------------
     def create_node(self, node: Node) -> Node:
-        self.wal.append(OP_CREATE_NODE, node.to_dict(), txid=self._txid)
-        return self.base.create_node(node)
+        with self._mut_lock:
+            self.wal.append(OP_CREATE_NODE, node.to_dict(), txid=self._txid)
+            return self.base.create_node(node)
 
     def update_node(self, node: Node) -> Node:
-        self.wal.append(OP_UPDATE_NODE, node.to_dict(), txid=self._txid)
-        return self.base.update_node(node)
+        with self._mut_lock:
+            self.wal.append(OP_UPDATE_NODE, node.to_dict(), txid=self._txid)
+            return self.base.update_node(node)
 
     def delete_node(self, node_id: str) -> None:
-        self.wal.append(OP_DELETE_NODE, {"id": node_id}, txid=self._txid)
-        self.base.delete_node(node_id)
+        with self._mut_lock:
+            self.wal.append(OP_DELETE_NODE, {"id": node_id}, txid=self._txid)
+            self.base.delete_node(node_id)
 
     def create_edge(self, edge: Edge) -> Edge:
-        self.wal.append(OP_CREATE_EDGE, edge.to_dict(), txid=self._txid)
-        return self.base.create_edge(edge)
+        with self._mut_lock:
+            self.wal.append(OP_CREATE_EDGE, edge.to_dict(), txid=self._txid)
+            return self.base.create_edge(edge)
 
     def update_edge(self, edge: Edge) -> Edge:
-        self.wal.append(OP_UPDATE_EDGE, edge.to_dict(), txid=self._txid)
-        return self.base.update_edge(edge)
+        with self._mut_lock:
+            self.wal.append(OP_UPDATE_EDGE, edge.to_dict(), txid=self._txid)
+            return self.base.update_edge(edge)
 
     def delete_edge(self, edge_id: str) -> None:
-        self.wal.append(OP_DELETE_EDGE, {"id": edge_id}, txid=self._txid)
-        self.base.delete_edge(edge_id)
+        with self._mut_lock:
+            self.wal.append(OP_DELETE_EDGE, {"id": edge_id}, txid=self._txid)
+            self.base.delete_edge(edge_id)
 
     def mark_pending_embed(self, node_id: str) -> None:
-        self.wal.append(OP_MARK_PENDING, {"id": node_id}, txid=self._txid)
-        self.base.mark_pending_embed(node_id)
+        with self._mut_lock:
+            self.wal.append(OP_MARK_PENDING, {"id": node_id}, txid=self._txid)
+            self.base.mark_pending_embed(node_id)
 
     def unmark_pending_embed(self, node_id: str) -> None:
-        self.wal.append(OP_UNMARK_PENDING, {"id": node_id}, txid=self._txid)
-        self.base.unmark_pending_embed(node_id)
+        with self._mut_lock:
+            self.wal.append(OP_UNMARK_PENDING, {"id": node_id}, txid=self._txid)
+            self.base.unmark_pending_embed(node_id)
 
     # -- reads: delegate ---------------------------------------------------
     def get_node(self, node_id: str) -> Node:
@@ -563,9 +639,11 @@ class WALEngine(Engine):
         self.base.flush()
 
     def close(self) -> None:
-        self._closed = True
         if self._compact_timer is not None:
             self._compact_timer.cancel()
-        self.compact()
+        self.compact()  # final snapshot; serialized with any in-flight tick
+        with self._compact_lock:
+            # an in-flight tick has finished; nothing may touch the WAL after
+            self._closed = True
         self.wal.close()
         self.base.close()

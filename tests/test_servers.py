@@ -686,3 +686,197 @@ class TestSessionTransactionIsolation:
                                "RETURN labels(n)")
         assert [r[0] for r in rows] == [["S2"]]  # S1 rolled back, S2 kept
         c1.close(); c2.close()
+
+
+class TestRBACGates:
+    """Write-classification gates (advisor round-1 findings): mutating
+    procedures must not pass a read-only token (HTTP), and Bolt must enforce
+    role permissions, not just authentication."""
+
+    def test_classify_query_text(self):
+        from nornicdb_tpu.cypher.executor import classify_query_text
+
+        assert classify_query_text("MATCH (n) RETURN n") == "read"
+        assert classify_query_text("CREATE (n)") == "write"
+        # CALL of a mutating procedure is a write even with no write keyword
+        assert classify_query_text(
+            "CALL apoc.trigger.add('t', 'RETURN 1', {})") == "write"
+        assert classify_query_text(
+            "MATCH (n) CALL apoc.refactor.setType(n, 'X') YIELD rel RETURN rel"
+        ) == "write"
+        # read-only procedures stay reads
+        assert classify_query_text("CALL db.labels()") == "read"
+        # unparseable input classifies conservatively
+        assert classify_query_text("garbage ( [") == "write"
+        # DDL statements are writes; SHOW is a read
+        assert classify_query_text("CREATE INDEX FOR (n:P) ON (n.x)") == "write"
+        assert classify_query_text("SHOW INDEXES") == "read"
+
+    def test_http_viewer_cannot_call_mutating_procedure(self):
+        db = nornicdb_tpu.open_db("")
+        auth = Authenticator(MemoryEngine())
+        auth.create_user("viewer", "pw", ROLE_VIEWER)
+        server = HttpServer(db, port=0, authenticator=auth, auth_required=True)
+        server.start()
+        basic = base64.b64encode(b"viewer:pw").decode()
+        hdrs = {"Authorization": f"Basic {basic}"}
+        try:
+            # reads are allowed for viewers
+            out = _post(server.port, "/db/neo4j/tx/commit",
+                        {"statements": [{"statement": "RETURN 1 AS x"}]},
+                        headers=hdrs)
+            assert out["results"][0]["data"][0]["row"] == [1]
+            # a CALL of a mutating procedure has no CREATE/SET/... keyword —
+            # the old regex classified it read; it must be denied
+            with pytest.raises(urllib.error.HTTPError) as e:
+                _post(server.port, "/db/neo4j/tx/commit",
+                      {"statements": [{"statement":
+                          "CALL apoc.trigger.add('t', 'RETURN 1', {})"}]},
+                      headers=hdrs)
+            assert e.value.code == 401
+        finally:
+            server.stop()
+            db.close()
+
+    def test_bolt_viewer_cannot_write(self):
+        db = nornicdb_tpu.open_db("")
+        auth = Authenticator(MemoryEngine())
+        auth.create_user("ro", "pw", ROLE_VIEWER)
+        auth.create_user("rw", "pw", ROLE_ADMIN)
+        server = BoltServer(
+            lambda q, p, d: db.executor.execute(q, p),
+            port=0, authenticator=auth, auth_required=True,
+        )
+        server.start()
+        try:
+            c = _BoltClient(server.port)
+            c.send(0x01, [{"scheme": "basic", "principal": "ro",
+                           "credentials": "pw"}])
+            assert c.recv_message().tag == 0x70
+            # read works
+            cols, rows, _ = c.run("RETURN 1 AS ok")
+            assert rows == [[1]]
+            # write denied with Unauthorized
+            c.send(0x10, ["CREATE (:Sneaky)", {}, {}])
+            msg = c.recv_message()
+            assert msg.tag == 0x7F
+            assert "Unauthorized" in msg.fields[0]["code"]
+            c.close()
+            # an editor/admin on the same server still writes fine
+            c2 = _BoltClient(server.port)
+            c2.send(0x01, [{"scheme": "basic", "principal": "rw",
+                            "credentials": "pw"}])
+            assert c2.recv_message().tag == 0x70
+            c2.run("CREATE (:Allowed)")
+            c2.close()
+            assert db.executor.execute(
+                "MATCH (n:Sneaky) RETURN count(n)").rows[0][0] == 0
+            assert db.executor.execute(
+                "MATCH (n:Allowed) RETURN count(n)").rows[0][0] == 1
+        finally:
+            server.stop()
+            db.close()
+
+    def test_bolt_viewer_cannot_call_mutating_procedure(self):
+        db = nornicdb_tpu.open_db("")
+        auth = Authenticator(MemoryEngine())
+        auth.create_user("ro2", "pw", ROLE_VIEWER)
+        server = BoltServer(
+            lambda q, p, d: db.executor.execute(q, p),
+            port=0, authenticator=auth, auth_required=True,
+        )
+        server.start()
+        try:
+            c = _BoltClient(server.port)
+            c.send(0x01, [{"scheme": "basic", "principal": "ro2",
+                           "credentials": "pw"}])
+            assert c.recv_message().tag == 0x70
+            c.send(0x10, ["CALL apoc.trigger.add('t', 'RETURN 1', {})", {}, {}])
+            msg = c.recv_message()
+            assert msg.tag == 0x7F
+            assert "Unauthorized" in msg.fields[0]["code"]
+            c.close()
+        finally:
+            server.stop()
+            db.close()
+
+
+class TestBoltTxLeak:
+    """A client that BEGINs and vanishes (or RESETs) must not leave the
+    engine's transaction open — a leaked tx defers WAL compaction forever."""
+
+    def _servers(self):
+        db = nornicdb_tpu.open_db("")
+        server = BoltServer(
+            lambda q, p, d: db.executor.execute(q, p),
+            port=0,
+            session_executor_factory=lambda d: db.executor,
+        )
+        server.start()
+        return db, server
+
+    def test_reset_rolls_back_open_tx(self):
+        db, server = self._servers()
+        try:
+            c = _BoltClient(server.port)
+            c.send(0x01, [{"scheme": "none"}])
+            c.recv_message()
+            c.send(0x11, [{}])  # BEGIN
+            assert c.recv_message().tag == 0x70
+            c.run("CREATE (:LeakReset)")
+            c.send(0x0F, [])  # RESET mid-tx
+            assert c.recv_message().tag == 0x70
+            # the tx was rolled back: no node, no open executor tx
+            assert db.executor.execute(
+                "MATCH (n:LeakReset) RETURN count(n)").rows[0][0] == 0
+            assert db.executor._tx_undo is None
+            c.close()
+        finally:
+            server.stop()
+            db.close()
+
+    def test_disconnect_rolls_back_open_tx(self):
+        db, server = self._servers()
+        try:
+            c = _BoltClient(server.port)
+            c.send(0x01, [{"scheme": "none"}])
+            c.recv_message()
+            c.send(0x11, [{}])  # BEGIN
+            assert c.recv_message().tag == 0x70
+            c.run("CREATE (:LeakDrop)")
+            c.close()  # vanish mid-tx
+            deadline = time.time() + 5
+            while time.time() < deadline and db.executor._tx_undo is not None:
+                time.sleep(0.02)
+            assert db.executor._tx_undo is None
+            assert db.executor.execute(
+                "MATCH (n:LeakDrop) RETURN count(n)").rows[0][0] == 0
+        finally:
+            server.stop()
+            db.close()
+
+
+class TestHttpTxCommandGate:
+    def test_viewer_cannot_begin_on_http(self):
+        """BEGIN via the stateless HTTP endpoint would pin the shared
+        executor's tx open forever; it classifies as write."""
+        from nornicdb_tpu.cypher.executor import classify_query_text
+
+        assert classify_query_text("BEGIN") == "write"
+        assert classify_query_text("ROLLBACK") == "write"
+
+        db = nornicdb_tpu.open_db("")
+        auth = Authenticator(MemoryEngine())
+        auth.create_user("v2", "pw", ROLE_VIEWER)
+        server = HttpServer(db, port=0, authenticator=auth, auth_required=True)
+        server.start()
+        basic = base64.b64encode(b"v2:pw").decode()
+        try:
+            with pytest.raises(urllib.error.HTTPError) as e:
+                _post(server.port, "/db/neo4j/tx/commit",
+                      {"statements": [{"statement": "BEGIN"}]},
+                      headers={"Authorization": f"Basic {basic}"})
+            assert e.value.code == 401
+        finally:
+            server.stop()
+            db.close()
