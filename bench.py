@@ -13,6 +13,12 @@ keeps it device-resident; ingest is a one-time cost), queries are processed
 in batches under one jit'd lax.scan program (the service's batched dispatch
 path), and timing ends only after results are fetched to host (D2H), because
 on the tunneled dev chip block_until_ready returns early.
+
+Two serving paths are A/B'd and the better one reported (both are wired into
+DeviceCorpus.search via ops.similarity.topk_backend):
+  xla       — bf16 GEMM + lax.approx_max_k (materializes (Q, N) scores)
+  streaming — Pallas kernel (ops/pallas_kernels.py streaming_cosine_topk):
+              one corpus read, running per-bin max in VMEM, no (Q, N)
 """
 
 from __future__ import annotations
@@ -26,6 +32,25 @@ D = 1024
 K = 100
 BATCH = 1024
 ITERS = 40
+# streaming path: smaller query block so the running bins fit VMEM (~16MB)
+SBATCH = 256
+STILE = 512
+SROWS = 4  # B = SROWS*STILE = 2048 bins -> expected recall ~0.976 at k=100
+# no power of two >= 128 divides 1,000,000 — pad the device corpus up to a
+# tile multiple with masked rows so both paths see identical inputs
+NP = ((N + STILE - 1) // STILE) * STILE
+
+
+def _median3(fn) -> float:
+    import numpy as np
+
+    times = []
+    for _ in range(3):
+        t0 = time.perf_counter()
+        v = fn()
+        np.asarray(v)  # D2H fetch = completion barrier
+        times.append(time.perf_counter() - t0)
+    return sorted(times)[1]
 
 
 def main() -> None:
@@ -34,15 +59,17 @@ def main() -> None:
     import numpy as np
 
     from nornicdb_tpu.ops import l2_normalize
+    from nornicdb_tpu.ops.pallas_kernels import streaming_cosine_topk
 
     dev = jax.devices()[0]
+    on_tpu = dev.platform == "tpu"
 
     @jax.jit
     def make_corpus(key):
-        return l2_normalize(jax.random.normal(key, (N, D), jnp.bfloat16))
+        return l2_normalize(jax.random.normal(key, (NP, D), jnp.bfloat16))
 
     corpus = make_corpus(jax.random.PRNGKey(0))
-    valid = jnp.ones((N,), bool)
+    valid = jnp.arange(NP) < N  # padding rows masked out of every search
 
     @functools.partial(jax.jit, static_argnames=("k",))
     def scan_search(qbatches, corpus, valid, k):
@@ -59,22 +86,43 @@ def main() -> None:
         _, out = jax.lax.scan(one, 0, qbatches)
         return out
 
+    @functools.partial(jax.jit, static_argnames=("k",))
+    def scan_search_streaming(qchunks, corpus, valid, k):
+        def one(carry, q):
+            v, i = streaming_cosine_topk(
+                q, corpus, valid, k, tile_n=STILE, rows=SROWS,
+            )
+            return carry, (v, i)
+
+        _, out = jax.lax.scan(one, 0, qchunks)
+        return out
+
+    total_q = BATCH * ITERS
     qb = l2_normalize(
         jax.random.normal(jax.random.PRNGKey(1), (ITERS, BATCH, D), jnp.bfloat16)
     )
-    v, i = scan_search(qb, corpus, valid, K)
+
+    results = {}
+    errors = {}
+    v, _ = scan_search(qb, corpus, valid, K)
     np.asarray(v)  # compile + full sync
+    results["xla"] = _median3(lambda: scan_search(qb, corpus, valid, K)[0])
 
-    # median of 3 trials: the dev-tunnel adds noisy per-dispatch latency
-    times = []
-    for _ in range(3):
-        t0 = time.perf_counter()
-        v, i = scan_search(qb, corpus, valid, K)
-        np.asarray(v)  # D2H fetch = completion barrier
-        times.append(time.perf_counter() - t0)
-    dt = sorted(times)[1]
+    if on_tpu:
+        # same queries, re-chunked for the VMEM-bounded streaming kernel
+        qs = qb.reshape(total_q // SBATCH, SBATCH, D)
+        try:
+            v, _ = scan_search_streaming(qs, corpus, valid, K)
+            np.asarray(v)
+            results["streaming"] = _median3(
+                lambda: scan_search_streaming(qs, corpus, valid, K)[0]
+            )
+        except Exception as e:  # keep the artifact, but surface the failure
+            errors["streaming"] = f"{type(e).__name__}: {e}"[:200]
 
-    qps = BATCH * ITERS / dt
+    path = min(results, key=results.get)
+    dt = results[path]
+    qps = total_q / dt
     baseline_qps = 1000.0  # A100 CUDA @1M x 1024d, gpu-acceleration.md:121
     print(
         json.dumps(
@@ -88,6 +136,12 @@ def main() -> None:
                     "batches": ITERS,
                     "ms_per_batch": round(dt / ITERS * 1000.0, 3),
                     "device": str(dev),
+                    "path": path,
+                    "paths_ms": {
+                        p: round(t * 1000.0 / ITERS, 3)
+                        for p, t in results.items()
+                    },
+                    **({"errors": errors} if errors else {}),
                 },
             }
         )

@@ -99,6 +99,57 @@ def cosine_topk(
     return jax.lax.approx_max_k(scores, k, recall_target=recall_target)
 
 
+# streaming Pallas top-k engages above this corpus size; below it the (Q, N)
+# score matrix is small enough that the XLA GEMM+approx_max_k path wins on
+# dispatch overhead
+STREAMING_MIN_ROWS = 65_536
+
+
+def topk_backend(
+    queries: jax.Array,
+    corpus: jax.Array,
+    valid: jax.Array,
+    k: int,
+    exact: bool = False,
+    use_bf16: bool = True,
+    streaming: Optional[bool] = None,
+) -> tuple[jax.Array, jax.Array]:
+    """Top-k dispatch for normalized inputs: the streaming Pallas kernel
+    (ops.pallas_kernels.streaming_cosine_topk — one corpus read, no (Q, N)
+    materialization) on TPU for large corpora, else the XLA
+    GEMM+approx_max_k path. `streaming=None` auto-selects; tests force it on
+    small corpora (interpret mode runs the same kernel off-TPU). The kernel
+    scores in bf16, so an explicit use_bf16=False keeps the XLA f32 path."""
+    from nornicdb_tpu.ops.pallas_kernels import (
+        _on_tpu,
+        pick_tile_n,
+        streaming_cosine_topk,
+        streaming_rows_for,
+    )
+
+    n = int(corpus.shape[0])
+    on_tpu = _on_tpu()
+    if streaming is None:
+        streaming = (
+            (not exact) and use_bf16 and on_tpu and n >= STREAMING_MIN_ROWS
+        )
+    if streaming and not exact:
+        tile = pick_tile_n(n)
+        rows = min(streaming_rows_for(k, tile), max(n // tile, 1))
+        # tile must divide n (corpus capacities are 128-multiples, but a
+        # sharded slice need not be) and the bins must hold a full top-k;
+        # otherwise fall through to the XLA path instead of crashing
+        if n % tile == 0 and rows * tile >= k:
+            return streaming_cosine_topk(
+                queries, corpus, valid, min(k, n),
+                tile_n=tile, rows=rows, interpret=not on_tpu,
+            )
+    return cosine_topk(
+        queries, corpus, valid, k, normalized=True, use_bf16=use_bf16,
+        exact=exact,
+    )
+
+
 @functools.partial(jax.jit, static_argnames=("use_bf16",))
 def score_subset(
     query: jax.Array, corpus: jax.Array, indices: jax.Array, use_bf16: bool = True
@@ -404,13 +455,15 @@ class DeviceCorpus(HostCorpus):
         min_similarity: float = -1.0,
         exact: bool = False,
         n_probe: int = 0,
+        streaming: Optional[bool] = None,
     ) -> list[list[tuple[str, float]]]:
         """Brute-force cosine top-k. Returned scores are exact; with the
         default exact=False, candidate membership uses the TPU-native
-        approx_max_k (recall_target 0.95 — the same contract as the
-        reference's HNSW ANN path); exact=True gives recall 1.0 at the cost
-        of a full sort. With n_probe > 0 and a fitted cluster index, only
-        the n_probe nearest clusters are scored (IVF pruning,
+        approx_max_k or (on TPU at scale, the default serving path) the
+        streaming Pallas kernel — both honoring the ~0.95 recall contract of
+        the reference's HNSW ANN path; exact=True gives recall 1.0 at the
+        cost of a full sort. With n_probe > 0 and a fitted cluster index,
+        only the n_probe nearest clusters are scored (IVF pruning,
         ref: SearchWithClusters kmeans.go:816). Returns per-query
         [(id, score)] filtered by min_similarity (ref: Search gpu.go:1519,
         MinSimilarity semantics search.go:157-205)."""
@@ -423,9 +476,9 @@ class DeviceCorpus(HostCorpus):
                 return pruned
         corpus, valid = self.device_arrays()
         kk = min(k, self.capacity)
-        vals, idx = cosine_topk(
+        vals, idx = topk_backend(
             l2_normalize(jnp.asarray(q, dtype=self.dtype)), corpus, valid, kk,
-            exact=exact,
+            exact=exact, streaming=streaming,
         )
         return self._format_results(
             np.asarray(vals, np.float32), np.asarray(idx), q.shape[0], k,

@@ -30,12 +30,15 @@ from nornicdb_tpu.ops.similarity import (
     cosine_topk,
     l2_normalize,
     merge_topk,
+    topk_backend,
 )
 from nornicdb_tpu.parallel.mesh import make_mesh
 
 
 @functools.partial(
-    jax.jit, static_argnames=("k", "axis", "mesh_static", "use_bf16", "exact")
+    jax.jit,
+    static_argnames=("k", "axis", "mesh_static", "use_bf16", "exact",
+                     "streaming"),
 )
 def _sharded_search(
     queries: jax.Array,
@@ -46,15 +49,19 @@ def _sharded_search(
     mesh_static: Mesh,
     use_bf16: bool = True,
     exact: bool = False,
+    streaming: Optional[bool] = None,
 ):
-    """One XLA program: per-shard GEMM + top-k, ICI all-gather, global merge."""
+    """One XLA program: per-shard GEMM + top-k, ICI all-gather, global merge.
+    Per-shard scoring dispatches through topk_backend, so on TPU at scale
+    each chip runs the streaming Pallas kernel over its corpus shard."""
 
     def shard_fn(q, c, v):
         local_n = c.shape[0]
         n_shards = mesh_static.shape[axis]
         local_k = min(k, local_n)  # a shard holds at most local_n candidates
-        vals, idx = cosine_topk(
-            q, c, v, local_k, normalized=True, use_bf16=use_bf16, exact=exact
+        vals, idx = topk_backend(
+            q, c, v, local_k, exact=exact, use_bf16=use_bf16,
+            streaming=streaming,
         )
         shard = jax.lax.axis_index(axis)
         gidx = idx + shard * local_n
@@ -98,7 +105,10 @@ class ShardedCorpus(HostCorpus):
         self.n_shards = self.mesh.shape[axis]
         super().__init__(
             dims,
-            align=int(np.lcm(128, self.n_shards)),
+            # 128 * n_shards (not lcm): every PER-SHARD slice must itself be
+            # a lane multiple, or the per-shard streaming kernel's tile
+            # cannot divide the local row count
+            align=128 * self.n_shards,
             compact_ratio=compact_ratio,
         )
         self._dev = None
@@ -122,11 +132,12 @@ class ShardedCorpus(HostCorpus):
         k: int,
         min_similarity: float = -1.0,
         exact: bool = False,
+        streaming: Optional[bool] = None,
     ) -> list[list[tuple[str, float]]]:
         """Sharded cosine top-k: per-shard GEMM + top-k, ICI all-gather merge.
         Scores are exact; with the default exact=False per-shard candidate
-        membership uses approx_max_k (recall_target 0.95); exact=True gives
-        recall 1.0."""
+        membership uses approx_max_k or the streaming Pallas kernel
+        (recall ~0.95+); exact=True gives recall 1.0."""
         q = np.atleast_2d(np.asarray(queries, np.float32))
         if len(self._slot_of) == 0:
             return [[] for _ in range(q.shape[0])]
@@ -134,7 +145,7 @@ class ShardedCorpus(HostCorpus):
         qd = l2_normalize(jnp.asarray(q, dtype=self.dtype))
         vals, idx = _sharded_search(
             qd, self._dev, self._dev_valid, min(k, self.capacity),
-            self.axis, self.mesh, exact=exact,
+            self.axis, self.mesh, exact=exact, streaming=streaming,
         )
         return self._format_results(
             np.asarray(vals, np.float32), np.asarray(idx), q.shape[0], k,
