@@ -39,15 +39,33 @@ def _percentiles(samples: list[float]) -> dict:
             "p99_ms": round(pct(0.99), 3)}
 
 
+def _use_process_clients() -> bool:
+    """Forked client processes only pay off when there are spare cores —
+    client work then escapes the server's GIL (the comparable setup to the
+    reference's no-GIL in-process Go clients). On a single-core box (this
+    dev rig: nproc=1) forking only adds context-switch overhead, so threads
+    drive the load instead and client+server share the one core either way."""
+    import os
+
+    try:
+        return len(os.sched_getaffinity(0)) > 1
+    except AttributeError:
+        return (os.cpu_count() or 1) > 1
+
+
 def _load(fn, concurrency=CONCURRENCY, run_s=RUN_S) -> dict:
-    # warmup
+    if _use_process_clients():
+        return _load_procs(fn, concurrency, run_s)
+    return _load_threads(fn, concurrency, run_s)
+
+
+def _load_threads(fn, concurrency, run_s) -> dict:
     deadline = time.time() + WARMUP_S
     while time.time() < deadline:
         fn()
     stop = time.time() + run_s
     samples: list[float] = []
     lock = threading.Lock()
-    count = [0]
 
     def worker():
         local = []
@@ -60,7 +78,6 @@ def _load(fn, concurrency=CONCURRENCY, run_s=RUN_S) -> dict:
             local.append(time.perf_counter() - t0)
         with lock:
             samples.extend(local)
-            count[0] += len(local)
 
     threads = [threading.Thread(target=worker) for _ in range(concurrency)]
     t0 = time.time()
@@ -69,7 +86,49 @@ def _load(fn, concurrency=CONCURRENCY, run_s=RUN_S) -> dict:
     for t in threads:
         t.join()
     dt = time.time() - t0
-    return {"ops_per_sec": round(count[0] / dt, 1), **_percentiles(samples)}
+    return {"ops_per_sec": round(len(samples) / dt, 1),
+            **_percentiles(samples)}
+
+
+def _load_procs(fn, concurrency, run_s) -> dict:
+    import multiprocessing as mp
+
+    ctx = mp.get_context("fork")
+    q = ctx.Queue()
+    # all clients warm up INSIDE their own child (no pre-fork client state:
+    # grpc channels and sockets created in the parent break across fork),
+    # then rendezvous so the timed window has every worker running
+    barrier = ctx.Barrier(concurrency)
+
+    def worker():
+        deadline = time.time() + WARMUP_S
+        while time.time() < deadline:
+            try:
+                fn()
+            except Exception:
+                pass
+        barrier.wait()
+        stop = time.time() + run_s
+        local = []
+        while time.time() < stop:
+            t0 = time.perf_counter()
+            try:
+                fn()
+            except Exception:
+                continue
+            local.append(time.perf_counter() - t0)
+        q.put(local)
+
+    procs = [ctx.Process(target=worker) for _ in range(concurrency)]
+    for p in procs:
+        p.start()
+    samples: list[float] = []
+    for _ in procs:
+        samples.extend(q.get())
+    for p in procs:
+        p.join()
+    return {"ops_per_sec": round(len(samples) / run_s, 1),
+            **_percentiles(samples)}
 
 
 def main() -> None:
@@ -109,8 +168,11 @@ def main() -> None:
         local = threading.local()
 
         def call():
+            import os
+            # forked children must NOT reuse the parent's socket fd
             conn = getattr(local, "conn", None)
-            if conn is None:
+            if conn is None or getattr(local, "pid", None) != os.getpid():
+                local.pid = os.getpid()
                 conn = local.conn = _hc.HTTPConnection(
                     "127.0.0.1", http_srv.port, timeout=10)
             try:
@@ -193,8 +255,10 @@ def main() -> None:
     local = threading.local()
 
     def bolt_query():
+        import os
         conn = getattr(local, "conn", None)
-        if conn is None:
+        if conn is None or getattr(local, "bolt_pid", None) != os.getpid():
+            local.bolt_pid = os.getpid()
             conn = local.conn = BoltConn()
         conn.query()
 
@@ -210,8 +274,10 @@ def main() -> None:
     )
 
     def grpc_query():
+        import os
         stub = getattr(local, "grpc_stub", None)
-        if stub is None:
+        if stub is None or getattr(local, "grpc_pid", None) != os.getpid():
+            local.grpc_pid = os.getpid()
             channel = _grpc.insecure_channel(f"127.0.0.1:{grpc_srv.port}")
             stub = local.grpc_stub = channel.unary_unary(
                 f"/{SERVICE_NAME}/Search",
@@ -228,7 +294,12 @@ def main() -> None:
     bolt_srv.stop()
     http_srv.stop()
     db.close()
+    import os
+    cores = len(os.sched_getaffinity(0))
     print(json.dumps({"concurrency": CONCURRENCY, "run_seconds": RUN_S,
+                      "cores": cores,
+                      "client_mode": "procs" if _use_process_clients()
+                      else "threads",
                       "endpoints": report}, indent=2))
 
 

@@ -14,6 +14,8 @@ GEMM; approx_max_k membership), and HNSW is the no-accelerator fallback.
 from __future__ import annotations
 
 import threading
+import time
+from collections import OrderedDict
 from dataclasses import dataclass, field
 from typing import Any, Optional
 
@@ -89,6 +91,20 @@ class SearchService:
         self._fingerprints: dict[str, tuple[bytes, bytes]] = {}
         self.cluster_result = None
         self.cluster_assignments: dict[str, int] = {}
+        # ranked-result cache (ref: the reference's query cache pkg/cache +
+        # embedding cache "450,000x speedup on hits", system-design.md:39).
+        # Keyed by (query, limit, min_sim); stores only the ranked
+        # (id, score, vec, ft) tuples — node data is re-fetched per hit so
+        # property updates that don't reindex (access counts, decay scores)
+        # never go stale. Invalidation is generation-based: any index
+        # mutation bumps _generation, making every older entry dead on
+        # lookup (O(1) invalidation, no sweeps).
+        self._generation = 0
+        self._rank_cache: "OrderedDict[tuple, tuple[int, float, list]]" = (
+            OrderedDict()
+        )
+        self._rank_cache_max = 2048
+        self._rank_cache_ttl = 30.0
 
     # -- index plumbing ----------------------------------------------------
     def _ensure_vector_index(self, dims: int) -> None:
@@ -126,6 +142,7 @@ class SearchService:
             if self._fingerprints.get(node.id) == fp:
                 return  # unchanged: keep device corpus clean
             self._fingerprints[node.id] = fp
+            self._generation += 1  # kills every cached ranking
             if text:
                 self._bm25.index(node.id, text)
             else:
@@ -150,6 +167,7 @@ class SearchService:
 
     def remove_node(self, node_id: str) -> None:
         with self._lock:
+            self._generation += 1
             self._fingerprints.pop(node_id, None)
             self._bm25.remove(node_id)
             self._vectors.pop(node_id, None)
@@ -221,6 +239,48 @@ class SearchService:
         """Hybrid RRF search (ref: Search :851 -> rrfHybridSearch :890)."""
         self.stats.searches += 1
         min_sim = self.config.min_similarity if min_similarity is None else min_similarity
+        cache_key = None
+        if query_embedding is None and query:
+            cache_key = (query, limit, min_sim)
+            with self._lock:
+                hit = self._rank_cache.get(cache_key)
+                if hit is not None:
+                    gen, ts, rank = hit
+                    if (
+                        gen == self._generation
+                        and time.monotonic() - ts < self._rank_cache_ttl
+                    ):
+                        self._rank_cache.move_to_end(cache_key)
+                    else:
+                        del self._rank_cache[cache_key]
+                        hit = None
+            if hit is not None:
+                # enrich OUTSIDE the lock: node fetches must not serialize
+                # concurrent hits or block index writers
+                return self._enrich(hit[2], limit)
+        # snapshot the generation BEFORE ranking: a mutation racing _rank()
+        # must make this entry dead on arrival, not cached as current
+        gen_before = self._generation
+        rank = self._rank(query, limit, min_sim, query_embedding)
+        if cache_key is not None:
+            with self._lock:
+                self._rank_cache[cache_key] = (
+                    gen_before, time.monotonic(), rank,
+                )
+                self._rank_cache.move_to_end(cache_key)
+                while len(self._rank_cache) > self._rank_cache_max:
+                    self._rank_cache.popitem(last=False)
+        return self._enrich(rank, limit)
+
+    def _rank(
+        self,
+        query: str,
+        limit: int,
+        min_sim: float,
+        query_embedding: Optional[np.ndarray],
+    ) -> list[tuple[str, float, Optional[float], Optional[float]]]:
+        """The expensive half of a search: embed + vector + BM25 + fusion
+        (+ rerank/MMR). Returns ordered (id, score, vec_score, ft_score)."""
         n_cand = max(limit * self.config.candidates_multiplier, limit)
         ranked: dict[str, list[str]] = {}
         vec_scores: dict[str, float] = {}
@@ -246,9 +306,24 @@ class SearchService:
                 ordered = apply_mmr(
                     ordered, rel, self._vectors, limit, self.config.mmr_lambda
                 )
-        results = []
         score_map = dict(fused)
-        for id_ in ordered[:limit]:
+        return [
+            (id_, score_map[id_], vec_scores.get(id_), ft_scores.get(id_))
+            for id_ in ordered[: max(limit, self.config.rerank_candidates)]
+        ]
+
+    def _enrich(
+        self,
+        rank: list[tuple[str, float, Optional[float], Optional[float]]],
+        limit: int,
+    ) -> list[dict[str, Any]]:
+        """Fetch nodes for the ranked head (ref: enrichResults search.go:1932).
+        Always reads storage, so cached rankings serve fresh node data; ids
+        deleted since ranking simply drop out."""
+        results = []
+        for id_, score, vs, fs in rank:
+            if len(results) >= limit:
+                break
             try:
                 node = self.storage.get_node(id_)
             except NotFoundError:
@@ -257,9 +332,9 @@ class SearchService:
                 {
                     "id": id_,
                     "node": node,
-                    "score": score_map[id_],
-                    "vector_score": vec_scores.get(id_),
-                    "fulltext_score": ft_scores.get(id_),
+                    "score": score,
+                    "vector_score": vs,
+                    "fulltext_score": fs,
                     "content": node.properties.get("content", ""),
                     "labels": node.labels,
                 }

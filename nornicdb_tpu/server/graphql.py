@@ -22,6 +22,7 @@ from __future__ import annotations
 
 import json
 import re
+import threading
 from typing import Any, Optional
 
 from nornicdb_tpu.errors import CypherSyntaxError, NornicError, NotFoundError
@@ -243,11 +244,33 @@ class _Var:
         return hash(("_Var", self.name))
 
 
+# document memo (same rationale as the Cypher AST memo, cypher/parser.py:1127:
+# re-parsing identical documents dominated repeat-query time; parsed docs are
+# execution-immutable — merging/flattening copies selection dicts before
+# mutating). Epoch eviction: clear at cap, zero bookkeeping on hits.
+_DOC_CACHE: dict[str, dict] = {}
+_DOC_LOCK = threading.Lock()
+_DOC_CACHE_MAX = 256
+
+
+def parse_document_cached(query: str) -> dict:
+    with _DOC_LOCK:
+        doc = _DOC_CACHE.get(query)
+    if doc is not None:
+        return doc
+    doc = _Parser(query).parse_document()
+    with _DOC_LOCK:
+        if len(_DOC_CACHE) >= _DOC_CACHE_MAX:
+            _DOC_CACHE.clear()
+        _DOC_CACHE[query] = doc
+    return doc
+
+
 def parse_operation(query: str) -> str:
     """Operation type of a document ("query"/"mutation"); "query" on parse
     failure (the executor will produce the real error)."""
     try:
-        return _Parser(query).parse_document()["operation"]
+        return parse_document_cached(query)["operation"]
     except Exception:
         return "query"
 
@@ -423,7 +446,7 @@ class GraphQLExecutor:
     def execute(self, query: str, variables: Optional[dict] = None) -> dict:
         variables = dict(variables or {})
         try:
-            doc = _Parser(query).parse_document()
+            doc = parse_document_cached(query)
             for k, v in doc.get("var_defaults", {}).items():
                 variables.setdefault(k, v)
             fragments = doc.get("fragments", {})

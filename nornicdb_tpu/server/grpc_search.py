@@ -162,10 +162,19 @@ class GrpcSearchServer:
     """(ref: nornicgrpc search_service.go) — generic handler, no stubs."""
 
     def __init__(self, db, host: str = "127.0.0.1", port: int = 0,
-                 max_workers: int = 8):
+                 max_workers: int = 0):
         import grpc
+        import os
         from concurrent import futures
 
+        if max_workers <= 0:
+            # handler work is tiny (cached search + hand-rolled protobuf);
+            # on few-core boxes extra handler threads just add GIL churn
+            try:
+                cores = len(os.sched_getaffinity(0))
+            except AttributeError:
+                cores = os.cpu_count() or 1
+            max_workers = max(2, min(8, cores * 2))
         self.db = db
         outer = self
 

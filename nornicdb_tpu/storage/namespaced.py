@@ -8,6 +8,7 @@ the prefix transparently so each logical database sees bare IDs
 
 from __future__ import annotations
 
+import threading
 from typing import Iterable, Iterator
 
 from nornicdb_tpu.storage.types import Edge, Engine, Node
@@ -19,6 +20,19 @@ class NamespacedEngine(Engine):
         self.base = base
         self.namespace = namespace
         self._prefix = namespace + ":"
+        # event-maintained counts: node_count()/edge_count() were O(N) scans
+        # that deep-copied every entity (every /graphql stats call, every
+        # /status). Seeded HERE — construction happens at open/CREATE
+        # DATABASE with no concurrent writers, so a lazy seed's
+        # scan-vs-event race cannot arise — then ownership-filtered events
+        # keep them current under a lock (+= is not GIL-atomic).
+        self._count_lock = threading.Lock()
+        self._node_count = sum(
+            1 for n in base.all_nodes() if n.id.startswith(self._prefix)
+        )
+        self._edge_count = sum(
+            1 for e in base.all_edges() if e.id.startswith(self._prefix)
+        )
         base.on_event(self._forward_event)
 
     # -- prefix helpers ----------------------------------------------------
@@ -48,9 +62,21 @@ class NamespacedEngine(Engine):
     def _forward_event(self, kind: str, entity) -> None:
         if isinstance(entity, Node):
             if self._owns(entity.id):
+                if kind == "node_created":
+                    with self._count_lock:
+                        self._node_count += 1
+                elif kind == "node_deleted":
+                    with self._count_lock:
+                        self._node_count = max(0, self._node_count - 1)
                 self._emit(kind, self._strip_node(entity))
         elif isinstance(entity, Edge):
             if self._owns(entity.id):
+                if kind == "edge_created":
+                    with self._count_lock:
+                        self._edge_count += 1
+                elif kind == "edge_deleted":
+                    with self._count_lock:
+                        self._edge_count = max(0, self._edge_count - 1)
                 self._emit(kind, self._strip_edge(entity))
 
     # -- nodes -------------------------------------------------------------
@@ -137,12 +163,12 @@ class NamespacedEngine(Engine):
             1 for e in self.base.get_edges_by_type(edge_type) if self._owns(e.id)
         )
 
-    # -- counts (namespace-scoped) ----------------------------------------
+    # -- counts (namespace-scoped, seeded at construction, event-maintained)
     def node_count(self) -> int:
-        return sum(1 for _ in self.all_nodes())
+        return self._node_count
 
     def edge_count(self) -> int:
-        return sum(1 for _ in self.all_edges())
+        return self._edge_count
 
     # -- pending embed -----------------------------------------------------
     def mark_pending_embed(self, node_id: str) -> None:

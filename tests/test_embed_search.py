@@ -399,3 +399,80 @@ class TestQueryBatcher:
         for i in range(6):
             assert outs[i][0][0] == f"n{i}"
         assert svc._batcher.stats.batches <= 3
+
+
+class TestRankCache:
+    """Generation-invalidated ranked-result cache (ref: the reference's query
+    cache pkg/cache + cached embedder, system-design.md:39)."""
+
+    def _svc(self):
+        from nornicdb_tpu.search.service import SearchService
+        from nornicdb_tpu.storage import MemoryEngine, Node
+        from nornicdb_tpu.embed import HashEmbedder
+
+        eng = MemoryEngine()
+        svc = SearchService(eng, embedder=HashEmbedder(32))
+        for i in range(20):
+            n = Node(id=f"n{i}", properties={"content": f"text topic {i % 3}"})
+            eng.create_node(n)
+            n.embedding = svc.embedder.embed(n.properties["content"])
+            svc.index_node(n)
+        return eng, svc
+
+    def test_hit_serves_fresh_node_data(self):
+        eng, svc = self._svc()
+        r1 = svc.search("text topic 1", limit=3)
+        assert r1
+        top = r1[0]["id"]
+        # mutate node properties WITHOUT reindexing (like an access-count
+        # touch): a cached ranking must still serve the fresh node
+        n = eng.get_node(top)
+        n.properties["content"] = "updated content"
+        eng.update_node(n)
+        r2 = svc.search("text topic 1", limit=3)
+        assert r2[0]["id"] == top
+        assert r2[0]["content"] == "updated content"
+
+    def test_index_mutation_invalidates(self):
+        eng, svc = self._svc()
+        svc.search("text topic 2", limit=3)
+        gen0 = svc._generation
+        from nornicdb_tpu.storage import Node
+        nn = Node(id="fresh", properties={"content": "text topic 2 fresh"})
+        eng.create_node(nn)
+        nn.embedding = svc.embedder.embed(nn.properties["content"])
+        svc.index_node(nn)
+        assert svc._generation > gen0
+        r = svc.search("text topic 2 fresh", limit=5)
+        assert any(x["id"] == "fresh" for x in r)
+
+    def test_deleted_id_drops_out_on_hit(self):
+        eng, svc = self._svc()
+        r1 = svc.search("text topic 0", limit=3)
+        top = r1[0]["id"]
+        # delete from storage only (index removal would bump the generation;
+        # the stale cached ranking must cope with a missing node)
+        eng.delete_node(top)
+        r2 = svc.search("text topic 0", limit=3)
+        assert all(x["id"] != top for x in r2)
+
+
+class TestNamespacedCounts:
+    def test_event_maintained_counts(self):
+        from nornicdb_tpu.storage import MemoryEngine, NamespacedEngine, Node, Edge
+
+        base = MemoryEngine()
+        a = NamespacedEngine(base, "a")
+        b = NamespacedEngine(base, "b")
+        for i in range(5):
+            a.create_node(Node(id=f"x{i}"))
+        b.create_node(Node(id="y"))
+        assert a.node_count() == 5
+        assert b.node_count() == 1
+        a.create_edge(Edge(id="e", start_node="x0", end_node="x1"))
+        assert a.edge_count() == 1
+        assert b.edge_count() == 0
+        a.delete_node("x0")  # cascades the edge
+        assert a.node_count() == 4
+        assert a.edge_count() == 0
+        assert b.node_count() == 1
