@@ -119,14 +119,24 @@ def _load_procs(fn, concurrency, run_s) -> dict:
             local.append(time.perf_counter() - t0)
         q.put(local)
 
+    import queue as _queue
+
     procs = [ctx.Process(target=worker) for _ in range(concurrency)]
     for p in procs:
         p.start()
     samples: list[float] = []
+    # bounded waits: a crashed child (broken barrier, OOM kill) must not
+    # hang the benchmark — report what arrived instead
+    deadline = time.time() + WARMUP_S + run_s + 30
     for _ in procs:
-        samples.extend(q.get())
+        try:
+            samples.extend(q.get(timeout=max(1.0, deadline - time.time())))
+        except _queue.Empty:
+            break
     for p in procs:
-        p.join()
+        p.join(timeout=5)
+        if p.is_alive():
+            p.terminate()
     return {"ops_per_sec": round(len(samples) / run_s, 1),
             **_percentiles(samples)}
 

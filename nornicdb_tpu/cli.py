@@ -70,8 +70,28 @@ def cmd_serve(args) -> int:
         session_executor_factory=db.session_executor,
     )
     bolt_server.start()
+    # Qdrant gRPC on :6334, feature-flagged like the reference
+    # (NORNICDB_QDRANT_GRPC_ENABLED, ref: server.go feature flag)
+    qdrant_server = None
+    if os.environ.get("NORNICDB_QDRANT_GRPC_ENABLED", "").lower() in (
+        "1", "true", "yes",
+    ):
+        from nornicdb_tpu.server.qdrant_grpc import QdrantGrpcServer
+
+        qdrant_server = QdrantGrpcServer(
+            http_server.qdrant,  # shared registry: REST + gRPC, one index
+            host=args.host,
+            port=int(os.environ.get("NORNICDB_QDRANT_GRPC_PORT", "6334")),
+            authenticator=authenticator,
+            snapshot_dir=os.path.join(args.data_dir, "qdrant-snapshots")
+            if args.data_dir else None,
+        )
+        qdrant_server.start()
     print(f"NornicDB-TPU serving: bolt://{args.host}:{bolt_server.port} "
-          f"http://{args.host}:{http_server.port} (data: {args.data_dir or 'memory'})")
+          f"http://{args.host}:{http_server.port}"
+          + (f" qdrant-grpc://{args.host}:{qdrant_server.port}"
+             if qdrant_server else "")
+          + f" (data: {args.data_dir or 'memory'})")
 
     stop = []
     signal.signal(signal.SIGINT, lambda *a: stop.append(1))
@@ -81,6 +101,8 @@ def cmd_serve(args) -> int:
             time.sleep(0.2)
     finally:
         print("shutting down...")
+        if qdrant_server is not None:
+            qdrant_server.stop()
         bolt_server.stop()
         http_server.stop()
         db.close()

@@ -5,9 +5,10 @@ services (collections_service.go, points_service.go), collection registry
 mapped onto graph nodes with label "QdrantPoint" (registry.go), named-vector
 support; points indexed into the same search service (server.go:207).
 
-The reference speaks Qdrant's gRPC; this build exposes the same operations
-over Qdrant's REST shapes (grpcio is not in the image), mounted on the HTTP
-server under /collections/*.
+Two transports share this module's registry: Qdrant REST shapes mounted on
+the HTTP server under /collections/* (this file), and the Qdrant v1.16
+gRPC services on their own port (qdrant_grpc.py — Collections/Points/
+Snapshots with auth interceptors, mirroring pkg/qdrantgrpc/server.go:207).
 """
 
 from __future__ import annotations

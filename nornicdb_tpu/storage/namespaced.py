@@ -9,7 +9,7 @@ the prefix transparently so each logical database sees bare IDs
 from __future__ import annotations
 
 import threading
-from typing import Iterable, Iterator
+from typing import Iterable, Iterator, Optional
 
 from nornicdb_tpu.storage.types import Edge, Engine, Node
 
@@ -22,18 +22,30 @@ class NamespacedEngine(Engine):
         self._prefix = namespace + ":"
         # event-maintained counts: node_count()/edge_count() were O(N) scans
         # that deep-copied every entity (every /graphql stats call, every
-        # /status). Seeded HERE — construction happens at open/CREATE
-        # DATABASE with no concurrent writers, so a lazy seed's
-        # scan-vs-event race cannot arise — then ownership-filtered events
-        # keep them current under a lock (+= is not GIL-atomic).
+        # /status). Seeding must be exact even if a writer races engine
+        # construction (multidb creates engines lazily in get_storage):
+        # subscribe FIRST, buffer events by id while scanning, then
+        # reconcile as id-sets — a mutation seen by both the scan and the
+        # buffer lands once, one seen by neither cannot exist.
         self._count_lock = threading.Lock()
-        self._node_count = sum(
-            1 for n in base.all_nodes() if n.id.startswith(self._prefix)
-        )
-        self._edge_count = sum(
-            1 for e in base.all_edges() if e.id.startswith(self._prefix)
-        )
+        self._seed_buffer: Optional[list[tuple[str, str]]] = []
+        self._node_count = 0
+        self._edge_count = 0
         base.on_event(self._forward_event)
+        node_ids = {n.id for n in base.all_nodes()
+                    if n.id.startswith(self._prefix)}
+        edge_ids = {e.id for e in base.all_edges()
+                    if e.id.startswith(self._prefix)}
+        with self._count_lock:
+            for kind, full_id in self._seed_buffer:
+                target = node_ids if kind.startswith("node") else edge_ids
+                if kind.endswith("_created"):
+                    target.add(full_id)
+                elif kind.endswith("_deleted"):
+                    target.discard(full_id)
+            self._seed_buffer = None
+            self._node_count = len(node_ids)
+            self._edge_count = len(edge_ids)
 
     # -- prefix helpers ----------------------------------------------------
     def _add(self, bare_id: str) -> str:
@@ -62,22 +74,25 @@ class NamespacedEngine(Engine):
     def _forward_event(self, kind: str, entity) -> None:
         if isinstance(entity, Node):
             if self._owns(entity.id):
-                if kind == "node_created":
-                    with self._count_lock:
-                        self._node_count += 1
-                elif kind == "node_deleted":
-                    with self._count_lock:
-                        self._node_count = max(0, self._node_count - 1)
+                self._count_event(kind, entity.id, node=True)
                 self._emit(kind, self._strip_node(entity))
         elif isinstance(entity, Edge):
             if self._owns(entity.id):
-                if kind == "edge_created":
-                    with self._count_lock:
-                        self._edge_count += 1
-                elif kind == "edge_deleted":
-                    with self._count_lock:
-                        self._edge_count = max(0, self._edge_count - 1)
+                self._count_event(kind, entity.id, node=False)
                 self._emit(kind, self._strip_edge(entity))
+
+    def _count_event(self, kind: str, full_id: str, node: bool) -> None:
+        if not kind.endswith(("_created", "_deleted")):
+            return
+        with self._count_lock:
+            if self._seed_buffer is not None:  # still scanning: defer
+                self._seed_buffer.append((kind, full_id))
+                return
+            delta = 1 if kind.endswith("_created") else -1
+            if node:
+                self._node_count = max(0, self._node_count + delta)
+            else:
+                self._edge_count = max(0, self._edge_count + delta)
 
     # -- nodes -------------------------------------------------------------
     def create_node(self, node: Node) -> Node:
