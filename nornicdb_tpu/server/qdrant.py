@@ -27,6 +27,120 @@ from nornicdb_tpu.storage.types import Engine, Node
 POINT_LABEL = "QdrantPoint"
 
 
+# ------------------------------------------------------------- filters
+def _payload_get(payload: dict, key: str):
+    """Dotted-path payload access (ref: Qdrant nested payload keys)."""
+    cur: Any = payload
+    for part in key.split("."):
+        if not isinstance(cur, dict) or part not in cur:
+            return None
+        cur = cur[part]
+    return cur
+
+
+def _eq(a: Any, b: Any) -> bool:
+    """Type-strict equality: True != 1 (payload bools vs integers)."""
+    return isinstance(a, bool) == isinstance(b, bool) and a == b
+
+
+def _match_one(value: Any, match: dict) -> bool:
+    """Qdrant Match semantics: equality for keyword/integer/boolean, substring
+    for text, membership for any/except; list-valued payloads match if any
+    element matches (ref: pkg/qdrantgrpc points filters)."""
+    values = value if isinstance(value, list) else [value]
+    if "text" in match:
+        needle = str(match["text"])
+        return any(isinstance(v, str) and needle in v for v in values)
+    if "any" in match:
+        allowed = match["any"] if isinstance(match["any"], list) else []
+        return any(any(_eq(v, a) for a in allowed) for v in values)
+    if "except" in match:
+        banned = match["except"] if isinstance(match["except"], list) else []
+        return value is not None and all(
+            not any(_eq(v, b) for b in banned) for v in values
+        )
+    for k in ("value", "keyword", "integer", "boolean"):
+        if k in match:
+            return any(_eq(v, match[k]) for v in values)
+    raise NornicError(f"invalid match clause {match!r}")
+
+
+def _range_ok(value: Any, rng: dict) -> bool:
+    if not isinstance(value, (int, float)) or isinstance(value, bool):
+        return False
+    if "gt" in rng and rng["gt"] is not None and not value > rng["gt"]:
+        return False
+    if "gte" in rng and rng["gte"] is not None and not value >= rng["gte"]:
+        return False
+    if "lt" in rng and rng["lt"] is not None and not value < rng["lt"]:
+        return False
+    if "lte" in rng and rng["lte"] is not None and not value <= rng["lte"]:
+        return False
+    return True
+
+
+def _eval_condition(cond: dict, point_id: Any, payload: dict) -> bool:
+    if not isinstance(cond, dict):
+        raise NornicError(f"invalid filter condition {cond!r}")
+    if "must" in cond or "should" in cond or "must_not" in cond:
+        return eval_filter(cond, point_id, payload)  # nested Filter
+    if "filter" in cond:
+        return eval_filter(cond["filter"], point_id, payload)
+    if "has_id" in cond:
+        ids = cond["has_id"]
+        return point_id in (ids if isinstance(ids, list) else [ids])
+    if "is_empty" in cond:
+        v = _payload_get(payload, cond["is_empty"].get("key", ""))
+        return v is None or v == [] or v == ""
+    if "is_null" in cond:
+        key = cond["is_null"].get("key", "")
+        return _payload_get(payload, key) is None and _has_key(payload, key)
+    key = cond.get("key")
+    if key is None:
+        raise NornicError(f"invalid filter condition {cond!r}")
+    value = _payload_get(payload, key)
+    if "match" in cond:
+        return value is not None and _match_one(value, cond["match"])
+    if "range" in cond:
+        return _range_ok(value, cond["range"])
+    raise NornicError(f"unsupported filter condition {cond!r}")
+
+
+def _has_key(payload: dict, key: str) -> bool:
+    parts = key.split(".")
+    cur: Any = payload
+    for part in parts:
+        if not isinstance(cur, dict) or part not in cur:
+            return False
+        cur = cur[part]
+    return True
+
+
+def eval_filter(flt: Optional[dict], point_id: Any, payload: dict) -> bool:
+    """Evaluate a Qdrant Filter (must AND / should OR / must_not NONE, each a
+    list of Conditions; conditions may nest Filters). JSON-dict form shared by
+    the REST transport and the gRPC decoder (ref: pkg/qdrantgrpc filter
+    handling in points_service.go)."""
+    if not flt:
+        return True
+    must = flt.get("must") or []
+    should = flt.get("should") or []
+    must_not = flt.get("must_not") or []
+    if isinstance(must, dict):
+        must = [must]
+    if isinstance(should, dict):
+        should = [should]
+    if isinstance(must_not, dict):
+        must_not = [must_not]
+    if any(_eval_condition(c, point_id, payload) for c in must_not):
+        return False
+    if not all(_eval_condition(c, point_id, payload) for c in must):
+        return False
+    if should and not any(_eval_condition(c, point_id, payload) for c in should):
+        return False
+    return True
+
+
 class QdrantCollections:
     """Collection registry over graph nodes (ref: registry.go:149 analogue —
     per-collection vector space + device corpus)."""
@@ -122,6 +236,19 @@ class QdrantCollections:
         with self._lock:
             return [{"name": n} for n in sorted(self._collections)]
 
+    def params(self, name: str) -> Optional[dict[str, Any]]:
+        """Public copy of a collection's vector params (size/distance/named),
+        so transports never reach into the locked internal registry."""
+        with self._lock:
+            meta = self._collections.get(name)
+            if meta is None:
+                return None
+            return {
+                "size": meta.get("size", 0),
+                "distance": meta.get("distance", "Cosine"),
+                "named": {k: dict(v) for k, v in (meta.get("named") or {}).items()},
+            }
+
     def info(self, name: str) -> Optional[dict[str, Any]]:
         with self._lock:
             meta = self._collections.get(name)
@@ -163,7 +290,10 @@ class QdrantCollections:
             else:
                 vec = np.asarray(raw_vec, np.float32)
             nid = self._node_id(collection, p["id"])
-            payload = p.get("payload") or {}
+            # underscore-prefixed keys are internal bookkeeping (_collection,
+            # _point_id) — client payloads must never clobber them
+            payload = {k: v for k, v in (p.get("payload") or {}).items()
+                       if not k.startswith("_")}
             node = Node(
                 id=nid,
                 labels=[POINT_LABEL],
@@ -213,6 +343,50 @@ class QdrantCollections:
                 c.remove(nid)
         return n
 
+    def _iter_points(self, collection: str):
+        for n in self.storage.get_nodes_by_label(POINT_LABEL):
+            if n.properties.get("_collection") == collection:
+                yield n
+
+    def matching_ids(self, collection: str,
+                     query_filter: Optional[dict]) -> list[Any]:
+        """Point ids in `collection` whose payload satisfies the Qdrant
+        filter (all points when the filter is empty)."""
+        if self.info(collection) is None:
+            raise NotFoundError(f"collection {collection} not found")
+        out = []
+        for n in self._iter_points(collection):
+            pid = n.properties.get("_point_id")
+            payload = {k: v for k, v in n.properties.items()
+                       if not k.startswith("_")}
+            if eval_filter(query_filter, pid, payload):
+                out.append(pid)
+        return out
+
+    def count(self, collection: str,
+              query_filter: Optional[dict] = None) -> int:
+        if not query_filter:
+            info = self.info(collection)
+            if info is None:
+                raise NotFoundError(f"collection {collection} not found")
+            return info["points_count"]
+        return len(self.matching_ids(collection, query_filter))
+
+    def scroll(self, collection: str, offset: Any = None, limit: int = 10,
+               query_filter: Optional[dict] = None
+               ) -> tuple[list[Any], Optional[Any]]:
+        """Stable id-ordered page of point ids; returns (page, next_offset)
+        (ref: points_service.go Scroll — deterministic paging)."""
+        pts = sorted(
+            self.matching_ids(collection, query_filter),
+            key=lambda p: (isinstance(p, str), str(p)),
+        )
+        if offset is not None:
+            key = (isinstance(offset, str), str(offset))
+            pts = [p for p in pts if (isinstance(p, str), str(p)) >= key]
+        page, rest = pts[:limit], pts[limit:]
+        return page, (rest[0] if rest else None)
+
     def search(
         self,
         collection: str,
@@ -220,6 +394,7 @@ class QdrantCollections:
         limit: int = 10,
         score_threshold: float = -1.0,
         with_payload: bool = True,
+        query_filter: Optional[dict] = None,
     ) -> list[dict[str, Any]]:
         key = collection
         if isinstance(vector, dict):  # named vector: {"name": ..., "vector": [...]}
@@ -229,12 +404,25 @@ class QdrantCollections:
             corpus = self._corpora.get(key)
         if corpus is None:
             raise NotFoundError(f"collection {collection} not found")
+        allowed = None
+        k = limit
+        if query_filter:
+            allowed = {
+                self._node_id(collection, pid)
+                for pid in self.matching_ids(collection, query_filter)
+            }
+            # filtering happens post-top-k, so rank the whole corpus to
+            # guarantee `limit` survivors when they exist (exact, like the
+            # reference's filtered search; ANN-with-filter is a later lever)
+            k = max(limit, len(corpus))
         res = corpus.search(
-            np.asarray(vector, np.float32), k=limit,
+            np.asarray(vector, np.float32), k=k,
             min_similarity=score_threshold,
         )
         out = []
         for nid, score in res[0] if res else []:
+            if allowed is not None and nid not in allowed:
+                continue
             try:
                 node = self.storage.get_node(nid)
             except NotFoundError:
@@ -247,6 +435,8 @@ class QdrantCollections:
                     if not k.startswith("_")
                 }
             out.append(item)
+            if len(out) >= limit:
+                break
         return out
 
     def retrieve(self, collection: str, ids: list[Any]) -> list[dict[str, Any]]:
@@ -326,8 +516,21 @@ def handle_qdrant(registry: QdrantCollections, method: str, path: str,
             limit=int(body.get("limit", 10)),
             score_threshold=float(body.get("score_threshold", -1.0)),
             with_payload=bool(body.get("with_payload", True)),
+            query_filter=body.get("filter"),
         )
         return ok(hits)
+    m = re.fullmatch(r"/collections/([^/]+)/points/count", path)
+    if m and method == "POST":
+        return ok({"count": registry.count(m.group(1), body.get("filter"))})
+    m = re.fullmatch(r"/collections/([^/]+)/points/scroll", path)
+    if m and method == "POST":
+        page, nxt = registry.scroll(
+            m.group(1), offset=body.get("offset"),
+            limit=int(body.get("limit", 10)),
+            query_filter=body.get("filter"),
+        )
+        return ok({"points": registry.retrieve(m.group(1), page),
+                   "next_page_offset": nxt})
     m = re.fullmatch(r"/collections/([^/]+)/points/delete", path)
     if m and method == "POST":
         n = registry.delete_points(m.group(1), body.get("points", []))

@@ -126,13 +126,19 @@ def _parse(buf: bytes) -> dict[int, list[tuple[int, Any]]]:
         if wire == 0:
             v, pos = _read_varint(buf, pos)
         elif wire == 1:
+            if pos + 8 > n:
+                raise NornicError("truncated fixed64 field")
             v = buf[pos : pos + 8]
             pos += 8
         elif wire == 5:
+            if pos + 4 > n:
+                raise NornicError("truncated fixed32 field")
             v = buf[pos : pos + 4]
             pos += 4
         elif wire == 2:
             ln, pos = _read_varint(buf, pos)
+            if pos + ln > n:
+                raise NornicError("truncated length-delimited field")
             v = buf[pos : pos + ln]
             pos += ln
         else:
@@ -222,6 +228,85 @@ def dec_payload_map(entries: list[tuple[int, Any]]) -> dict[str, Any]:
         f = _parse(raw)
         k = _first(f, 1, b"").decode("utf-8")
         out[k] = dec_value(_first(f, 2, b""))
+    return out
+
+
+# --------------------------------------------------------------- Filter
+# points.proto Filter: should=1, must=2, must_not=3 (repeated Condition).
+# Condition oneof: FieldCondition field=1, IsEmptyCondition is_empty=2,
+# HasIdCondition has_id=3, Filter filter=4, IsNullCondition is_null=5.
+# FieldCondition: key=1, Match match=2, Range range=3.
+# Match oneof: keyword=1, integer=2, boolean=3, text=4,
+#   RepeatedStrings keywords=5 {strings=1}, RepeatedIntegers integers=6
+#   {integers=1}, except_integers=7, except_keywords=8.
+# Range: lt=1, gt=2, gte=3, lte=4 (doubles).
+# Decodes to the JSON-dict form evaluated by qdrant.eval_filter, so both
+# transports share one evaluator (ref: pkg/qdrantgrpc points_service.go).
+def _dec_match(raw: bytes) -> dict:
+    f = _parse(raw)
+    if 1 in f:
+        return {"keyword": f[1][0][1].decode("utf-8")}
+    if 2 in f:
+        return {"integer": _i64(f[2][0][1])}
+    if 3 in f:
+        return {"boolean": bool(f[3][0][1])}
+    if 4 in f:
+        return {"text": f[4][0][1].decode("utf-8")}
+    if 5 in f:
+        rs = _parse(f[5][0][1])
+        return {"any": [r.decode("utf-8") for _, r in rs.get(1, [])]}
+    if 6 in f:
+        ri = _parse(f[6][0][1])
+        return {"any": [_i64(v) for _, v in ri.get(1, [])]}
+    if 7 in f:
+        ri = _parse(f[7][0][1])
+        return {"except": [_i64(v) for _, v in ri.get(1, [])]}
+    if 8 in f:
+        rs = _parse(f[8][0][1])
+        return {"except": [r.decode("utf-8") for _, r in rs.get(1, [])]}
+    raise NornicError("empty match clause")
+
+
+def _dec_condition(raw: bytes) -> dict:
+    f = _parse(raw)
+    if 1 in f:  # FieldCondition
+        ff = _parse(f[1][0][1])
+        cond: dict = {"key": _first(ff, 1, b"").decode("utf-8")}
+        if 2 in ff:
+            cond["match"] = _dec_match(ff[2][0][1])
+        elif 3 in ff:
+            rf = _parse(ff[3][0][1])
+            rng = {}
+            for num, name in ((1, "lt"), (2, "gt"), (3, "gte"), (4, "lte")):
+                if num in rf:
+                    rng[name] = struct.unpack("<d", rf[num][0][1])[0]
+            cond["range"] = rng
+        else:
+            raise NornicError(
+                f"unsupported field condition on {cond['key']!r} "
+                "(match and range are supported)"
+            )
+        return cond
+    if 2 in f:
+        ef = _parse(f[2][0][1])
+        return {"is_empty": {"key": _first(ef, 1, b"").decode("utf-8")}}
+    if 3 in f:
+        hf = _parse(f[3][0][1])
+        return {"has_id": [dec_point_id(r) for _, r in hf.get(1, [])]}
+    if 4 in f:
+        return {"filter": dec_filter(f[4][0][1])}
+    if 5 in f:
+        nf = _parse(f[5][0][1])
+        return {"is_null": {"key": _first(nf, 1, b"").decode("utf-8")}}
+    raise NornicError("unsupported filter condition")
+
+
+def dec_filter(raw: bytes) -> dict:
+    f = _parse(raw)
+    out: dict = {}
+    for num, name in ((1, "should"), (2, "must"), (3, "must_not")):
+        if num in f:
+            out[name] = [_dec_condition(r) for _, r in f[num]]
     return out
 
 
@@ -545,7 +630,7 @@ class QdrantGrpcServer:
         info = self.registry.info(name)
         if info is None:
             raise NotFoundError(f"collection {name} not found")
-        meta = self.registry._collections.get(name, {})
+        meta = self.registry.params(name) or {}
         vec_params = _vi(1, int(meta.get("size", 0))) + _vi(
             2, _DISTANCE_TO_NUM.get(meta.get("distance", "Cosine"), 1)
         )
@@ -609,17 +694,15 @@ class QdrantGrpcServer:
                 item["id"], item.get("payload"), item.get("vector")))
         return body + _f64(2, time.perf_counter() - t0)
 
-    def _selector_ids(self, f: dict, field: int, context) -> list:
+    def _selector_ids(self, coll: str, f: dict, field: int, context) -> list:
         """Decode PointsSelector at `field`: oneof { PointsIdsList points=1;
-        Filter filter=2 }. Filter selectors are not implemented — refuse
-        loudly rather than acknowledge an operation that touched nothing."""
+        Filter filter=2 }. Filter selectors resolve to the matching point
+        ids via the shared evaluator."""
         if field not in f:
             return []
         sf = _parse(f[field][0][1])
         if 2 in sf:
-            context.abort(self._grpc.StatusCode.UNIMPLEMENTED,
-                          "filter-based point selectors are not supported; "
-                          "select by id list")
+            return self.registry.matching_ids(coll, dec_filter(sf[2][0][1]))
         if 1 in sf:
             lf = _parse(sf[1][0][1])
             return [dec_point_id(raw) for _, raw in lf.get(1, [])]
@@ -627,23 +710,24 @@ class QdrantGrpcServer:
 
     def _points_delete(self, request: bytes, context) -> bytes:
         """DeletePoints: collection_name=1, points=3 PointsSelector
-        {points=1 PointsIdsList{ids=1}}."""
+        {points=1 PointsIdsList{ids=1} | filter=2}."""
         t0 = time.perf_counter()
         f = _parse(request)
         coll = _first(f, 1, b"").decode("utf-8")
-        ids = self._selector_ids(f, 3, context)
+        ids = self._selector_ids(coll, f, 3, context)
         self.registry.delete_points(coll, ids)
         return _update_result_response(t0)
 
     def _points_search(self, request: bytes, context) -> bytes:
-        """SearchPoints: collection_name=1, vector=2 packed floats, limit=4,
-        with_payload=6 WithPayloadSelector{enable=1}, score_threshold=8,
-        vector_name=10, with_vectors=11 -> SearchResponse: result=1 rep
-        ScoredPoint, time=2."""
+        """SearchPoints: collection_name=1, vector=2 packed floats, filter=3,
+        limit=4, with_payload=6 WithPayloadSelector{enable=1},
+        score_threshold=8, vector_name=10, with_vectors=11 ->
+        SearchResponse: result=1 rep ScoredPoint, time=2."""
         t0 = time.perf_counter()
         f = _parse(request)
         coll = _first(f, 1, b"").decode("utf-8")
         vector = _floats(_first(f, 2, b""))
+        flt = dec_filter(f[3][0][1]) if 3 in f else None
         limit = int(_first(f, 4, 10))
         with_payload = True
         if 6 in f:
@@ -664,7 +748,7 @@ class QdrantGrpcServer:
             query = {"name": vec_name, "vector": vector}
         hits = self.registry.search(
             coll, query, limit=limit, score_threshold=threshold,
-            with_payload=with_payload,
+            with_payload=with_payload, query_filter=flt,
         )
         body = b""
         vec_by_id = {}
@@ -679,47 +763,36 @@ class QdrantGrpcServer:
         return body + _f64(2, time.perf_counter() - t0)
 
     def _points_count(self, request: bytes, context) -> bytes:
-        """CountPoints -> CountResponse: result=1 {count=1}, time=2."""
-        t0 = time.perf_counter()
-        coll = _first(_parse(request), 1, b"").decode("utf-8")
-        info = self.registry.info(coll)
-        if info is None:
-            raise NotFoundError(f"collection {coll} not found")
-        return _ld(1, _vi(1, info["points_count"])) + _f64(
-            2, time.perf_counter() - t0
-        )
-
-    def _points_scroll(self, request: bytes, context) -> bytes:
-        """ScrollPoints: collection_name=1, offset=3 PointId, limit=4 ->
-        ScrollResponse: next_page_offset=1, result=2 rep RetrievedPoint,
-        time=3. Points are ordered by point id (stringified) for a stable
-        scroll, matching the reference's deterministic paging."""
+        """CountPoints: collection_name=1, filter=2 -> CountResponse:
+        result=1 {count=1}, time=2."""
         t0 = time.perf_counter()
         f = _parse(request)
         coll = _first(f, 1, b"").decode("utf-8")
+        flt = dec_filter(f[2][0][1]) if 2 in f else None
+        count = self.registry.count(coll, flt)
+        return _ld(1, _vi(1, count)) + _f64(2, time.perf_counter() - t0)
+
+    def _points_scroll(self, request: bytes, context) -> bytes:
+        """ScrollPoints: collection_name=1, filter=2, offset=3 PointId,
+        limit=4 -> ScrollResponse: next_page_offset=1, result=2 rep
+        RetrievedPoint, time=3. Points are ordered by point id (stringified)
+        for a stable scroll, matching the reference's deterministic paging."""
+        t0 = time.perf_counter()
+        f = _parse(request)
+        coll = _first(f, 1, b"").decode("utf-8")
+        flt = dec_filter(f[2][0][1]) if 2 in f else None
         offset = dec_point_id(_first(f, 3, b"")) if 3 in f else None
         limit = int(_first(f, 4, 10))
-        if self.registry.info(coll) is None:
-            raise NotFoundError(f"collection {coll} not found")
-        pts = sorted(
-            (
-                n.properties.get("_point_id")
-                for n in self.registry.storage.get_nodes_by_label(POINT_LABEL)
-                if n.properties.get("_collection") == coll
-            ),
-            key=lambda p: (isinstance(p, str), str(p)),
+        page, nxt = self.registry.scroll(
+            coll, offset=offset, limit=limit, query_filter=flt
         )
-        if offset is not None:
-            key = (isinstance(offset, str), str(offset))
-            pts = [p for p in pts if (isinstance(p, str), str(p)) >= key]
-        page, rest = pts[:limit], pts[limit:]
         body = b""
         for item in self.registry.retrieve(coll, page):
             body += _ld(2, _retrieved_point(
                 item["id"], item.get("payload"), item.get("vector")))
         out = b""
-        if rest:
-            out += _ld(1, enc_point_id(rest[0]))
+        if nxt is not None:
+            out += _ld(1, enc_point_id(nxt))
         return out + body + _f64(3, time.perf_counter() - t0)
 
     # -- payload ops (ref: points_service.go payload ops) -------------------
@@ -729,7 +802,7 @@ class QdrantGrpcServer:
         payload map / key list — never a selector); ClearPayload carries it
         at field 3."""
         coll = _first(f, 1, b"").decode("utf-8")
-        return coll, self._selector_ids(f, selector_field, context)
+        return coll, self._selector_ids(coll, f, selector_field, context)
 
     def _mutate_payload(self, coll: str, ids: list, fn) -> None:
         if self.registry.info(coll) is None:
@@ -749,7 +822,10 @@ class QdrantGrpcServer:
         t0 = time.perf_counter()
         f = _parse(request)
         coll, ids = self._payload_targets(f, context)
-        payload = dec_payload_map(f.get(3, []))
+        # underscore keys are internal (_collection, _point_id) — a client
+        # payload must not clobber them (DeletePayload/Clear guard likewise)
+        payload = {k: v for k, v in dec_payload_map(f.get(3, [])).items()
+                   if not k.startswith("_")}
         self._mutate_payload(
             coll, ids, lambda n: n.properties.update(payload)
         )
@@ -759,7 +835,8 @@ class QdrantGrpcServer:
         t0 = time.perf_counter()
         f = _parse(request)
         coll, ids = self._payload_targets(f, context)
-        payload = dec_payload_map(f.get(3, []))
+        payload = {k: v for k, v in dec_payload_map(f.get(3, [])).items()
+                   if not k.startswith("_")}
 
         def overwrite(n):
             keep = {k: v for k, v in n.properties.items()

@@ -60,13 +60,6 @@ class TestValueCodec:
         assert named == {"text": [1.0, 0.0], "img": [0.5]}
 
 
-def _channel_fn(port, method):
-    channel = grpc.insecure_channel(f"127.0.0.1:{port}")
-    return channel, channel.unary_unary(
-        method, request_serializer=lambda b: b,
-        response_deserializer=lambda b: b)
-
-
 class _Client:
     def __init__(self, port, metadata=None):
         self.port = port
@@ -339,22 +332,111 @@ class TestHardening:
         assert e.value.code() in (grpc.StatusCode.INVALID_ARGUMENT,
                                   grpc.StatusCode.NOT_FOUND)
 
-    def test_filter_selector_unimplemented(self, qdrant_grpc):
-        _, _, c = qdrant_grpc
-        _create_collection(c, "docs", 2)
-        _upsert(c, "docs", 1, [1.0, 0.0])
-        # PointsSelector with a Filter (field 2) must refuse loudly, not
-        # silently ack Completed while deleting nothing
-        sel = _ld(2, _ld(2, _ld(1, _s(1, "k"))))  # filter{must{field{key}}}
-        with pytest.raises(grpc.RpcError) as e:
-            c.call("/qdrant.Points/Delete", _s(1, "docs") + _ld(3, sel))
-        assert e.value.code() == grpc.StatusCode.UNIMPLEMENTED
-        f = _parse(c.call("/qdrant.Points/Count", _s(1, "docs")))
-        assert _parse(f[1][0][1])[1][0][1] == 1  # nothing deleted
-
     def test_malformed_frame_is_invalid_argument(self, qdrant_grpc):
         _, _, c = qdrant_grpc
         with pytest.raises(grpc.RpcError) as e:
             # truncated: tag promises a length-delimited field of 200 bytes
             c.call("/qdrant.Collections/Get", b"\x0a\xc8")
         assert e.value.code() == grpc.StatusCode.INVALID_ARGUMENT
+
+    def test_truncated_length_delimited_rejected(self, qdrant_grpc):
+        _, _, c = qdrant_grpc
+        with pytest.raises(grpc.RpcError) as e:
+            # field 1 declares 100 bytes but only 2 are present — must not
+            # silently decode the short prefix as a valid collection name
+            c.call("/qdrant.Collections/Get", b"\x0a\x64xx")
+        assert e.value.code() == grpc.StatusCode.INVALID_ARGUMENT
+
+    def test_payload_cannot_clobber_internal_keys(self, qdrant_grpc):
+        registry, _, c = qdrant_grpc
+        _create_collection(c, "docs", 2)
+        _upsert(c, "docs", 9, [1.0, 0.0], {"_collection": "evil", "ok": 1})
+        sel = _ld(5, _ld(1, _ld(1, enc_point_id(9))))
+        c.call("/qdrant.Points/SetPayload",
+               _s(1, "docs") + enc_payload_map(3, {"_point_id": 404}) + sel)
+        assert registry.info("docs")["points_count"] == 1
+        item = registry.retrieve("docs", [9])[0]
+        assert item["payload"] == {"ok": 1}
+
+
+def _match_cond(key, match: dict) -> bytes:
+    """Condition{field=1 FieldCondition{key=1, match=2 Match{...}}}"""
+    if "keyword" in match:
+        m = _s(1, match["keyword"])
+    elif "integer" in match:
+        m = _vi(2, match["integer"])
+    elif "boolean" in match:
+        m = _vi(3, 1 if match["boolean"] else 0)
+    elif "text" in match:
+        m = _s(4, match["text"])
+    else:
+        raise AssertionError(match)
+    return _ld(1, _s(1, key) + _ld(2, m))
+
+
+def _f64le(field, v):
+    return bytes([(field << 3) | 1]) + struct.pack("<d", v)
+
+
+class TestFilters:
+    """Qdrant Filter support over gRPC (ref: points filters in
+    pkg/qdrantgrpc/points_service.go — must/should/must_not, match, range,
+    has_id; also exercised on the shared registry for the REST transport)."""
+
+    @pytest.fixture
+    def seeded(self, qdrant_grpc):
+        registry, srv, c = qdrant_grpc
+        _create_collection(c, "docs", 2)
+        _upsert(c, "docs", 1, [1.0, 0.0], {"city": "Oslo", "pop": 700})
+        _upsert(c, "docs", 2, [0.9, 0.1], {"city": "Bergen", "pop": 290})
+        _upsert(c, "docs", 3, [0.0, 1.0], {"city": "Oslo", "pop": 700,
+                                           "tags": ["a", "b"]})
+        return registry, c
+
+    def test_search_with_match_filter(self, seeded):
+        _, c = seeded
+        flt = _ld(3, _ld(2, _match_cond("city", {"keyword": "Oslo"})))
+        req = (_s(1, "docs") + _packed_f32(2, [1.0, 0.0]) + flt + _vi(4, 10))
+        f = _parse(c.call("/qdrant.Points/Search", req))
+        ids = sorted(dec_point_id(_parse(raw)[1][0][1]) for _, raw in f[1])
+        assert ids == [1, 3]
+
+    def test_count_with_range_filter(self, seeded):
+        _, c = seeded
+        # Range{gte=3 (double) 300}
+        rng = _ld(1, _s(1, "pop") + _ld(3, _f64le(3, 300.0)))
+        flt = _ld(2, _ld(2, rng))  # CountPoints.filter=2, Filter.must=2
+        f = _parse(c.call("/qdrant.Points/Count", _s(1, "docs") + flt))
+        assert _parse(f[1][0][1])[1][0][1] == 2  # pids 1 and 3 (pop 700)
+
+    def test_scroll_with_must_not(self, seeded):
+        _, c = seeded
+        flt = _ld(2, _ld(3, _match_cond("city", {"keyword": "Oslo"})))
+        f = _parse(c.call("/qdrant.Points/Scroll",
+                          _s(1, "docs") + flt + _vi(4, 10)))
+        ids = [dec_point_id(_parse(raw)[1][0][1]) for _, raw in f[2]]
+        assert ids == [2]
+
+    def test_delete_by_filter_selector(self, seeded):
+        _, c = seeded
+        sel = _ld(2, _ld(2, _match_cond("city", {"keyword": "Bergen"})))
+        c.call("/qdrant.Points/Delete", _s(1, "docs") + _ld(3, sel))
+        f = _parse(c.call("/qdrant.Points/Count", _s(1, "docs")))
+        assert _parse(f[1][0][1])[1][0][1] == 2
+
+    def test_rest_shares_the_evaluator(self, seeded):
+        registry, _ = seeded
+        hits = registry.search(
+            "docs", [1.0, 0.0], limit=10,
+            query_filter={"must": [{"key": "tags",
+                                    "match": {"value": "a"}}]})
+        assert [h["id"] for h in hits] == [3]
+        assert registry.count(
+            "docs", {"must_not": [{"key": "city",
+                                   "match": {"value": "Oslo"}}]}) == 1
+        assert registry.count("docs", {"must": [{"has_id": [1, 2]}]}) == 2
+        page, nxt = registry.scroll(
+            "docs", limit=1,
+            query_filter={"should": [
+                {"key": "city", "match": {"value": "Oslo"}}]})
+        assert page == [1] and nxt == 3
