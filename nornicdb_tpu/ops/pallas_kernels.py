@@ -113,20 +113,32 @@ def fused_cosine_topk(
 # VMEM-resident queries, and folded into a running per-bin max that lives in
 # VMEM across all grid steps. HBM traffic is one corpus read + O(Q*B) state,
 # vs. the XLA approx_max_k path which round-trips the (Q, N) score matrix
-# (1 GB at Q=256, N=1M) through HBM.
+# (4 GB at Q=1024, N=1M) through HBM.
 #
 # Selection scheme: bins. Tile t, column j maps to bin (t % rows, j) — i.e.
-# B = rows * tile_n bins, each keeping the max score (and its global index)
-# of the ~N/B columns hashed to it. The exact top-k over the (Q, B) bins runs
-# as a tiny XLA epilogue. Two true top-k members collide (one lost) only if
+# B = rows * tile_n bins, each keeping the best (score, tile) of the ~N/B
+# columns hashed to it. Two true top-k members collide (one lost) only if
 # they share a bin: expected recall ~= 1 - (k-1)/(2B); rows is sized so
 # B >= 20*k, giving >= ~0.975 for k=100 — the same contract as the
 # lax.approx_max_k path it replaces (and as the reference's HNSW ANN).
 # When n_tiles <= rows every column gets its own bin and the result is exact.
+#
+# Packed-bin encoding (the VPU-cost trick): scores are biased into [2, 4)
+# (+3 for valid columns, -3 for masked ones), where the f32 bit pattern is
+# monotonic as a signed int32. The low `tile_bits` mantissa bits are replaced
+# by the tile index, so one int32 carries (score, provenance) and the whole
+# per-tile merge is a single integer max — measured free on the VPU (kernel
+# body == pure-GEMM cost) vs ~2x body cost for the separate (vals, idx)
+# two-array merge, at half the VMEM. Masked columns stay negative and lose
+# every signed compare. The dropped mantissa bits cost ~2^-11 of score
+# resolution — an order of magnitude below the bf16 GEMM noise (~2^-8
+# relative) that both this path and the XLA approx_max_k path already carry,
+# so scores are decoded straight from the packed bits (a gather+rescore
+# epilogue was measured at +9ms/batch: TPU row gathers don't vectorize).
 
 
-def _streaming_topk_kernel(q_ref, c_ref, m_ref, vals_ref, idx_ref,
-                           *, tile_n: int, rows: int):
+def _streaming_topk_kernel(q_ref, c_ref, b_ref, bins_ref,
+                           *, rows: int, tile_bits: int):
     i = pl.program_id(0)
     scores = jax.lax.dot_general(
         q_ref[:].astype(jnp.bfloat16),
@@ -134,21 +146,22 @@ def _streaming_topk_kernel(q_ref, c_ref, m_ref, vals_ref, idx_ref,
         dimension_numbers=(((1,), (1,)), ((), ())),
         preferred_element_type=jnp.float32,
     )  # (Q, TILE_N)
-    scores = jnp.where(m_ref[:] > 0.5, scores, -jnp.inf)  # mask broadcasts over Q
-    col = jax.lax.broadcasted_iota(jnp.int32, scores.shape, 1) + i * tile_n
+    # bias +3 valid / -3 masked, then bitcast: valid scores land in [2, 4)
+    # where the int32 view is positive and monotonic; masked go negative
+    biased = scores + b_ref[:]
+    packed = (
+        jax.lax.bitcast_convert_type(biased, jnp.int32)
+        & jnp.int32(-(1 << tile_bits))
+    ) | i
     r = i % rows
 
     @pl.when(i < rows)
     def _init():
-        vals_ref[r] = scores
-        idx_ref[r] = col
+        bins_ref[r] = packed
 
     @pl.when(i >= rows)
     def _merge():
-        cur = vals_ref[r]
-        take = scores > cur
-        vals_ref[r] = jnp.where(take, scores, cur)
-        idx_ref[r] = jnp.where(take, col, idx_ref[r])
+        bins_ref[r] = jnp.maximum(bins_ref[r], packed)
 
 
 @functools.partial(
@@ -159,8 +172,8 @@ def streaming_cosine_topk(
     corpus: jax.Array,
     valid: jax.Array,
     k: int,
-    tile_n: int = 1024,
-    rows: int = 2,
+    tile_n: int = 512,
+    rows: int = 4,
     interpret: bool = False,
 ) -> tuple[jax.Array, jax.Array]:
     """Single-pass cosine top-k that never materializes (Q, N).
@@ -168,8 +181,9 @@ def streaming_cosine_topk(
     queries: (Q, D) L2-normalized; corpus: (N, D) L2-normalized rows
     (padding/tombstone rows are excluded by `valid`, so their content is
     irrelevant); valid: (N,) bool. N must be a multiple of tile_n.
-    Returns (values (Q, k), indices (Q, k)); values of masked-out rows never
-    appear (they score -inf).
+    Returns (values (Q, k), indices (Q, k)); values carry bf16-GEMM-level
+    accuracy (see packed-bin note above); masked-out rows never appear
+    (they score -inf).
     """
     q, d = queries.shape
     n = corpus.shape[0]
@@ -177,9 +191,12 @@ def streaming_cosine_topk(
         raise ValueError(f"N ({n}) must be a multiple of tile_n ({tile_n})")
     n_tiles = n // tile_n
     rows = min(rows, n_tiles)
-    mask = valid.astype(jnp.float32).reshape(1, n)
-    kern = functools.partial(_streaming_topk_kernel, tile_n=tile_n, rows=rows)
-    vals, idx = pl.pallas_call(
+    tile_bits = max(1, (n_tiles - 1).bit_length())
+    bias = jnp.where(valid, 3.0, -3.0).astype(jnp.float32).reshape(1, n)
+    kern = functools.partial(
+        _streaming_topk_kernel, rows=rows, tile_bits=tile_bits
+    )
+    bins = pl.pallas_call(
         kern,
         grid=(n_tiles,),
         in_specs=[
@@ -189,31 +206,141 @@ def streaming_cosine_topk(
             pl.BlockSpec((1, tile_n), lambda i: (0, i),
                          memory_space=pltpu.VMEM),
         ],
-        out_shape=[
-            jax.ShapeDtypeStruct((rows, q, tile_n), jnp.float32),
-            jax.ShapeDtypeStruct((rows, q, tile_n), jnp.int32),
-        ],
+        out_shape=jax.ShapeDtypeStruct((rows, q, tile_n), jnp.int32),
         # every grid step maps to the same block: the running bins stay
         # VMEM-resident for the whole sweep and are written back once
-        out_specs=[
-            pl.BlockSpec((rows, q, tile_n), lambda i: (0, 0, 0),
-                         memory_space=pltpu.VMEM),
-            pl.BlockSpec((rows, q, tile_n), lambda i: (0, 0, 0),
-                         memory_space=pltpu.VMEM),
-        ],
+        out_specs=pl.BlockSpec((rows, q, tile_n), lambda i: (0, 0, 0),
+                               memory_space=pltpu.VMEM),
         cost_estimate=pl.CostEstimate(
             flops=2 * q * n * d,
             bytes_accessed=n * d * corpus.dtype.itemsize
-            + q * d * queries.dtype.itemsize + 2 * rows * q * tile_n * 4,
+            + q * d * queries.dtype.itemsize + rows * q * tile_n * 4,
             transcendentals=0,
         ),
         interpret=interpret,
-    )(queries, corpus, mask)
-    # tiny exact top-k over the B = rows*tile_n bins — same merge as the
-    # sharded ICI epilogue (lazy import: similarity imports this module)
-    from nornicdb_tpu.ops.similarity import merge_topk
+    )(queries, corpus, bias)
 
-    return merge_topk(vals, idx, k)
+    # epilogue: exact top-k over the B = rows*tile_n packed bins (int sort =
+    # score order), then decode score + provenance from the packed bits
+    return _decode_packed(
+        bins, k=k, n=n, rows=rows, tile_n=tile_n, tile_bits=tile_bits
+    )
+
+
+# ------------------------------------------------- int8 streaming top-k
+#
+# Same packed-bin scheme, but the MXU runs at the int8 rate (2x bf16 on
+# v5e) over an int8-quantized corpus mirror (half the HBM read). Rows are
+# symmetric-quantized per-row (scale = 127/max|x|); the per-row dequant
+# multiplier rides the same (1, tile) VPU FMA that applies the mask bias, and
+# the per-query scale divides out at decode (scaling a query doesn't change
+# its ranking). Measured ~1.3x end-to-end over the bf16 kernel at 1M x 1024
+# with recall within 0.005 of it (int8 rounding noise ~1e-3 on cosine scores,
+# same order as the bf16 GEMM noise both paths already carry).
+
+
+def _streaming_topk_int8_kernel(q_ref, c_ref, s_ref, b_ref, bins_ref,
+                                *, rows: int, tile_bits: int):
+    i = pl.program_id(0)
+    acc = jax.lax.dot_general(
+        q_ref[:], c_ref[:],
+        dimension_numbers=(((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.int32,
+    )  # (Q, TILE_N) int32
+    biased = acc.astype(jnp.float32) * s_ref[:] + b_ref[:]
+    packed = (jax.lax.bitcast_convert_type(biased, jnp.int32)
+              & jnp.int32(-(1 << tile_bits))) | i
+    r = i % rows
+
+    @pl.when(i < rows)
+    def _init():
+        bins_ref[r] = packed
+
+    @pl.when(i >= rows)
+    def _merge():
+        bins_ref[r] = jnp.maximum(bins_ref[r], packed)
+
+
+@jax.jit
+def quantize_rows(x: jax.Array) -> tuple[jax.Array, jax.Array]:
+    """Per-row symmetric int8 quantization: returns (int8 rows, scales) with
+    x ~= int8 / scale."""
+    xf = x.astype(jnp.float32)
+    s = 127.0 / jnp.maximum(jnp.max(jnp.abs(xf), axis=1), 1e-9)
+    return jnp.round(xf * s[:, None]).astype(jnp.int8), s
+
+
+def _decode_packed(bins, *, k, n, rows, tile_n, tile_bits):
+    """Exact top-k over packed bins + decode (score, global row)."""
+    q = bins.shape[1]
+    b_total = rows * tile_n
+    flat = jnp.swapaxes(bins, 0, 1).reshape(q, b_total)
+    top_packed, top_bin = jax.lax.top_k(flat, min(k, b_total))
+    low_mask = (1 << tile_bits) - 1
+    tile_idx = top_packed & low_mask
+    idx = tile_idx * tile_n + top_bin % tile_n
+    # midpoint-reconstruct the truncated mantissa bits, then un-bias
+    score_bits = (top_packed & ~low_mask) | (1 << (tile_bits - 1))
+    vals = jax.lax.bitcast_convert_type(score_bits, jnp.float32) - 3.0
+    vals = jnp.where(top_packed > 0, vals, -jnp.inf)
+    return vals, jnp.clip(idx, 0, n - 1)
+
+
+@functools.partial(
+    jax.jit, static_argnames=("k", "tile_n", "rows", "interpret")
+)
+def streaming_cosine_topk_int8(
+    q_i8: jax.Array,
+    q_scale: jax.Array,
+    c_i8: jax.Array,
+    c_scale: jax.Array,
+    valid: jax.Array,
+    k: int,
+    tile_n: int = 512,
+    rows: int = 4,
+    interpret: bool = False,
+) -> tuple[jax.Array, jax.Array]:
+    """int8 single-pass cosine top-k (see module comment). Inputs are
+    quantize_rows() outputs of L2-normalized queries/corpus; valid: (N,)
+    bool. Returns (values (Q, k) ~cosine scores, indices (Q, k))."""
+    q, d = q_i8.shape
+    n = c_i8.shape[0]
+    if n % tile_n != 0:
+        raise ValueError(f"N ({n}) must be a multiple of tile_n ({tile_n})")
+    n_tiles = n // tile_n
+    rows = min(rows, n_tiles)
+    tile_bits = max(1, (n_tiles - 1).bit_length())
+    scale = jnp.where(valid, 1.0 / c_scale, 0.0).astype(jnp.float32)
+    bias = jnp.where(valid, 3.0, -3.0).astype(jnp.float32)
+    kern = functools.partial(
+        _streaming_topk_int8_kernel, rows=rows, tile_bits=tile_bits
+    )
+    bins = pl.pallas_call(
+        kern,
+        grid=(n_tiles,),
+        in_specs=[
+            pl.BlockSpec((q, d), lambda i: (0, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((tile_n, d), lambda i: (i, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, tile_n), lambda i: (0, i),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, tile_n), lambda i: (0, i),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_shape=jax.ShapeDtypeStruct((rows, q, tile_n), jnp.int32),
+        out_specs=pl.BlockSpec((rows, q, tile_n), lambda i: (0, 0, 0),
+                               memory_space=pltpu.VMEM),
+        cost_estimate=pl.CostEstimate(
+            flops=2 * q * n * d,
+            bytes_accessed=n * d + q * d + rows * q * tile_n * 4,
+            transcendentals=0,
+        ),
+        interpret=interpret,
+    )(q_i8, c_i8, scale.reshape(1, n), bias.reshape(1, n))
+    vals, idx = _decode_packed(
+        bins, k=k, n=n, rows=rows, tile_n=tile_n, tile_bits=tile_bits
+    )
+    return vals / q_scale[:, None], idx
 
 
 def pick_tile_n(n: int, preferred: int = 1024) -> int:
