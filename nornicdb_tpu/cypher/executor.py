@@ -107,6 +107,19 @@ class CypherExecutor:
         self._tx_undo: Optional[list[Callable[[], None]]] = None
         self._last_call_columns: list[str] = []
         self.query_count = 0
+        self._colindex: Any = None  # lazy ColumnarScanIndex; False = unusable
+
+    def _scan_index(self):
+        """Lazily attach the event-maintained columnar scan index
+        (cypher/colindex.py) to this executor's storage."""
+        if self._colindex is None:
+            try:
+                from nornicdb_tpu.cypher.colindex import ColumnarScanIndex
+
+                self._colindex = ColumnarScanIndex(self.storage)
+            except Exception:
+                self._colindex = False
+        return self._colindex or None
 
     # -- public ----------------------------------------------------------------
     def execute(self, query: str, params: Optional[dict[str, Any]] = None) -> Result:
@@ -205,15 +218,35 @@ class CypherExecutor:
         if not isinstance(ret, ast.ReturnClause):
             return None
         if (
-            match.where is not None
-            or ret.distinct
+            ret.distinct
             or ret.order_by
             or ret.skip is not None
             or ret.limit is not None
             or ret.star
             or len(match.patterns) != 1
-            or len(ret.items) != 1
         ):
+            return None
+        pattern = match.patterns[0]
+        if pattern.name or pattern.shortest:
+            return None
+        els = pattern.elements
+        for detector in (
+            self._fp_count,
+            self._fp_group_count,
+            self._fp_edge_agg,
+            self._fp_mutual_rel,
+        ):
+            r = detector(match, ret, els, params)
+            if r is not None:
+                return r
+        return None
+
+    def _fp_count(self, match, ret, els, params) -> Optional[Result]:
+        """count(n)/count(r)/count(*) single-scan counts; node counts with a
+        fully-columnar WHERE count via the compiled mask without
+        materializing rows (ref: PatternIncomingCountAgg family sits below;
+        this is the plain count shape)."""
+        if len(ret.items) != 1:
             return None
         item = ret.items[0]
         expr = item.expr
@@ -224,19 +257,15 @@ class CypherExecutor:
             and len(expr.args) == 1
         ):
             return None
-        pattern = match.patterns[0]
-        if pattern.name or pattern.shortest:
-            return None
-        els = pattern.elements
         arg = expr.args[0]
 
         def count_result(n: int) -> Result:
             return Result([item.key], [[n]])
 
-        # MATCH (n[:L]) RETURN count(n|*)
+        # MATCH (n[:L]) [WHERE <columnar>] RETURN count(n|*)
         if len(els) == 1 and isinstance(els[0], ast.NodePattern):
             node = els[0]
-            if node.properties is not None or node.where is not None:
+            if node.properties is not None:
                 return None
             counts_node = (
                 isinstance(arg, ast.Literal) and arg.value == "*"
@@ -245,6 +274,26 @@ class CypherExecutor:
             )
             if not counts_node:
                 return None
+            where = _and_exprs(node.where, match.where)
+            if where is not None:
+                if not node.variable:
+                    return None
+                from nornicdb_tpu.cypher.parallel import compile_where
+
+                cw = compile_where(where, node.variable)
+                if not cw.has_columnar or cw.residual is not None:
+                    return None
+                if len(node.labels) == 1:
+                    idx = self._scan_index()
+                    if idx is not None:
+                        n = idx.count(node.labels[0], cw, params)
+                        if n is not None:
+                            return count_result(n)
+                candidates = self.matcher._candidates(
+                    ast.NodePattern(node.variable, node.labels, None),
+                    {}, params,
+                )
+                return count_result(int(cw.mask(candidates, params).sum()))
             if not node.labels:
                 return count_result(self.storage.node_count())
             if len(node.labels) == 1:
@@ -256,6 +305,8 @@ class CypherExecutor:
                 seen.update(n.id for n in self.storage.get_nodes_by_label(lbl))
             return count_result(len(seen))
         # MATCH ()-[r[:T]]->() RETURN count(r|*)
+        if match.where is not None:
+            return None
         if (
             len(els) == 3
             and isinstance(els[0], ast.NodePattern)
@@ -292,6 +343,214 @@ class CypherExecutor:
                         total += 1
             return count_result(total)
         return None
+
+    @staticmethod
+    def _bare_rel_triple(els) -> Optional[tuple]:
+        """(a, rel, b) when els is a single-hop pattern with unadorned
+        endpoints (no labels/props/inline where) and a plain rel."""
+        if not (
+            len(els) == 3
+            and isinstance(els[0], ast.NodePattern)
+            and isinstance(els[1], ast.RelPattern)
+            and isinstance(els[2], ast.NodePattern)
+        ):
+            return None
+        a, rel, b = els
+        if (
+            a.labels or a.properties or a.where
+            or b.labels or b.properties or b.where
+            or rel.properties or rel.var_length
+        ):
+            return None
+        return a, rel, b
+
+    def _fp_group_count(self, match, ret, els, params) -> Optional[Result]:
+        """MATCH (x)<-[:T]-(y) / (x)-[:T]->(y) RETURN x[.prop], count(y|*) —
+        one pass over the type-T edges instead of per-node expansion
+        (ref: detectIncomingCountAgg/detectOutgoingCountAgg
+        query_patterns.go:283,315)."""
+        if match.where is not None or len(ret.items) != 2:
+            return None
+        triple = self._bare_rel_triple(els)
+        if triple is None:
+            return None
+        a, rel, b = triple
+        if len(rel.types) != 1 or rel.direction == "both":
+            return None
+        if not a.variable or not b.variable or a.variable == b.variable:
+            return None
+        key_item, cnt_item = ret.items
+        cexpr = cnt_item.expr
+        if not (
+            isinstance(cexpr, ast.FunctionCall)
+            and cexpr.name == "count"
+            and not cexpr.distinct
+            and len(cexpr.args) == 1
+        ):
+            return None
+        carg = cexpr.args[0]
+        counts_other = (
+            isinstance(carg, ast.Literal) and carg.value == "*"
+        ) or (
+            isinstance(carg, ast.Variable) and carg.name == b.variable
+        )
+        # the rel variable also counts one-per-row
+        if not counts_other and rel.variable:
+            counts_other = (
+                isinstance(carg, ast.Variable) and carg.name == rel.variable
+            )
+        if not counts_other:
+            return None
+        kexpr = key_item.expr
+        if isinstance(kexpr, ast.Variable) and kexpr.name == a.variable:
+            key_of = None  # whole node
+        elif (
+            isinstance(kexpr, ast.Property)
+            and isinstance(kexpr.subject, ast.Variable)
+            and kexpr.subject.name == a.variable
+        ):
+            key_of = kexpr.key
+        else:
+            return None
+        # group on the anchor side: 'out' anchors the start node of each
+        # edge, 'in' the end node ((x)<-[:T]-(y): x is the edge's target)
+        anchor_is_start = rel.direction == "out"
+        counts: dict[str, int] = {}
+        for edge in self.storage.get_edges_by_type(rel.types[0]):
+            nid = edge.start_node if anchor_is_start else edge.end_node
+            counts[nid] = counts.get(nid, 0) + 1
+        rows_out: list[list[Any]] = []
+        for nid in sorted(counts):
+            node = self.get_node_or_none(nid)
+            if node is None:
+                continue
+            keyv = node if key_of is None else node.properties.get(key_of)
+            rows_out.append([keyv, counts[nid]])
+        return Result([key_item.key, cnt_item.key], rows_out)
+
+    def _fp_edge_agg(self, match, ret, els, params) -> Optional[Result]:
+        """MATCH ()-[r:T]-() RETURN agg(r.prop), ... — one edge scan per
+        query, no node expansion (ref: detectEdgePropertyAgg
+        query_patterns.go:393). Undirected patterns double each edge, same
+        as the generic two-orientation expansion."""
+        if match.where is not None or not ret.items:
+            return None
+        triple = self._bare_rel_triple(els)
+        if triple is None:
+            return None
+        a, rel, b = triple
+        if a.variable or b.variable:
+            return None  # endpoint vars could be grouped on — generic path
+        if len(rel.types) > 1:
+            return None
+        plan: list[tuple[str, Optional[str]]] = []  # (agg, prop|None)
+        for item in ret.items:
+            e = item.expr
+            if not (
+                isinstance(e, ast.FunctionCall)
+                and e.name in ("count", "sum", "avg", "min", "max")
+                and not e.distinct
+                and len(e.args) == 1
+            ):
+                return None
+            arg = e.args[0]
+            if e.name == "count" and (
+                (isinstance(arg, ast.Literal) and arg.value == "*")
+                or (isinstance(arg, ast.Variable) and arg.name == rel.variable)
+            ):
+                plan.append(("count_rows", None))
+                continue
+            if (
+                isinstance(arg, ast.Property)
+                and isinstance(arg.subject, ast.Variable)
+                and arg.subject.name == rel.variable
+            ):
+                plan.append((e.name, arg.key))
+                continue
+            return None
+        mult = 2 if rel.direction == "both" else 1
+        edges = (
+            self.storage.get_edges_by_type(rel.types[0])
+            if rel.types
+            else self.storage.all_edges()
+        )
+        n_rows = 0
+        values: dict[str, list] = {p: [] for _, p in plan if p is not None}
+        for edge in edges:
+            n_rows += mult
+            for prop in values:
+                v = edge.properties.get(prop)
+                if v is not None:
+                    values[prop].extend([v] * mult)
+        out: list[Any] = []
+        for agg, prop in plan:
+            if agg == "count_rows":
+                out.append(n_rows)
+                continue
+            vals = values[prop]
+            if agg == "count":
+                out.append(len(vals))
+            elif agg == "sum":
+                out.append(sum(vals) if vals else 0)
+            elif agg == "avg":
+                out.append(sum(vals) / len(vals) if vals else None)
+            elif agg == "min":
+                out.append(min(vals) if vals else None)
+            else:
+                out.append(max(vals) if vals else None)
+        return Result([it.key for it in ret.items], [out])
+
+    def _fp_mutual_rel(self, match, ret, els, params) -> Optional[Result]:
+        """MATCH (a)-[:T]->(b)-[:T]->(a) RETURN count(*) — single-pass edge
+        set intersection instead of nested expansion (ref:
+        detectMutualRelationship query_patterns.go:238). Multiplicity
+        follows relationship isomorphism: pairs of distinct edges."""
+        if match.where is not None or len(ret.items) != 1:
+            return None
+        if not (
+            len(els) == 5
+            and isinstance(els[0], ast.NodePattern)
+            and isinstance(els[1], ast.RelPattern)
+            and isinstance(els[2], ast.NodePattern)
+            and isinstance(els[3], ast.RelPattern)
+            and isinstance(els[4], ast.NodePattern)
+        ):
+            return None
+        a, r1, b, r2, a2 = els
+        for n in (a, b, a2):
+            if n.labels or n.properties or n.where:
+                return None
+        for r in (r1, r2):
+            if r.properties or r.var_length or r.variable or r.direction != "out":
+                return None
+        if not (
+            a.variable and a2.variable == a.variable
+            and b.variable and b.variable != a.variable
+        ):
+            return None
+        if len(r1.types) != 1 or r1.types != r2.types:
+            return None
+        e = ret.items[0].expr
+        if not (
+            isinstance(e, ast.FunctionCall)
+            and e.name == "count"
+            and not e.distinct
+            and len(e.args) == 1
+            and isinstance(e.args[0], ast.Literal)
+            and e.args[0].value == "*"
+        ):
+            return None
+        cnt: dict[tuple[str, str], int] = {}
+        for edge in self.storage.get_edges_by_type(r1.types[0]):
+            k = (edge.start_node, edge.end_node)
+            cnt[k] = cnt.get(k, 0) + 1
+        total = 0
+        for (s, d), c in cnt.items():
+            if s == d:
+                total += c * (c - 1)  # same edge can't bind both rels
+            else:
+                total += c * cnt.get((d, s), 0)
+        return Result([ret.items[0].key], [[total]])
 
     # -- query pipeline -----------------------------------------------------------
     def _run_query(
@@ -384,6 +643,9 @@ class CypherExecutor:
 
     # -- MATCH -----------------------------------------------------------------
     def _match(self, clause: ast.MatchClause, rows: list[dict], params: dict) -> list[dict]:
+        fast = self._match_scan_fast(clause, rows, params)
+        if fast is not None:
+            return fast
         out: list[dict] = []
         for row in rows:
             matched: list[dict] = [row]
@@ -406,6 +668,82 @@ class CypherExecutor:
                 out.append(null_row)
             else:
                 out.extend(matched)
+        return out
+
+    def _match_scan_fast(
+        self, clause: ast.MatchClause, rows: list[dict], params: dict
+    ) -> Optional[list[dict]]:
+        """Large single-node-pattern scans with a WHERE: columnar mask over
+        the candidate list + thread-pooled residual filter, instead of a
+        full expression-tree walk per row (ref: parallelFilterNodes
+        parallel.go:99 + the MinBatchSize gate :100; columnar design note in
+        cypher/parallel.py). Semantics-identical to the generic path — the
+        chaos suite runs both and compares."""
+        from nornicdb_tpu.cypher.parallel import (
+            compile_where,
+            get_parallel_config,
+            parallel_filter,
+        )
+
+        if len(clause.patterns) != 1 or len(rows) != 1:
+            return None
+        pattern = clause.patterns[0]
+        if pattern.name or pattern.shortest or len(pattern.elements) != 1:
+            return None
+        node_pat = pattern.elements[0]
+        if not isinstance(node_pat, ast.NodePattern) or not node_pat.variable:
+            return None
+        row = rows[0]
+        if node_pat.variable in row:
+            return None
+        where = _and_exprs(node_pat.where, clause.where)
+        if where is None:
+            return None  # unfiltered scan is already a single pass
+        cfg = get_parallel_config()
+        if not cfg.enabled:
+            return None
+        cw = compile_where(where, node_pat.variable)
+        nodes: Optional[list] = None
+        # preferred: columns straight from the scan index — only survivors
+        # ever materialize as Nodes
+        if (
+            cw.has_columnar
+            and len(node_pat.labels) == 1
+            and node_pat.properties is None
+        ):
+            label = node_pat.labels[0]
+            if self.storage.count_nodes_by_label(label) < cfg.min_batch_size:
+                return None
+            idx = self._scan_index()
+            if idx is not None:
+                ids = idx.masked_ids(label, cw, params)
+                if ids is not None:
+                    nodes = self.storage.batch_get_nodes(sorted(ids))
+        if nodes is None:
+            candidates = self.matcher._candidates(
+                ast.NodePattern(node_pat.variable, node_pat.labels,
+                                node_pat.properties),
+                row, params,
+            )
+            if len(candidates) < cfg.min_batch_size:
+                return None
+            nodes = candidates
+            if cw.has_columnar:
+                mask = cw.mask(nodes, params)
+                nodes = [n for n, m in zip(nodes, mask) if m]
+        if cw.residual is not None:
+            res = cw.residual
+            var = node_pat.variable
+
+            def pred(n):
+                return evaluate(res, EvalContext({**row, var: n}, params, self))
+
+            nodes = parallel_filter(nodes, pred)
+        out = [{**row, node_pat.variable: n} for n in nodes]
+        if clause.optional and not out:
+            null_row = dict(row)
+            null_row.setdefault(node_pat.variable, None)
+            return [null_row]
         return out
 
     # -- CREATE ------------------------------------------------------------------
@@ -1548,6 +1886,16 @@ def _contains_aggregate(e: ast.Expr) -> bool:
     if isinstance(e, ast.Property):
         return _contains_aggregate(e.subject)
     return False
+
+
+def _and_exprs(
+    a: Optional[ast.Expr], b: Optional[ast.Expr]
+) -> Optional[ast.Expr]:
+    if a is None:
+        return b
+    if b is None:
+        return a
+    return ast.BinaryOp("AND", a, b)
 
 
 def _hashable(vals: Iterable[Any]) -> Any:
