@@ -7,6 +7,11 @@ nodes, log)."""
 
 from nornicdb_tpu.apoc import functions as _functions  # noqa: F401 — registers
 from nornicdb_tpu.apoc import functions_ext as _functions_ext  # noqa: F401
+from nornicdb_tpu.apoc import functions_graph as _functions_graph  # noqa: F401
+from nornicdb_tpu.apoc import functions_graph2 as _functions_graph2  # noqa: F401
+from nornicdb_tpu.apoc import functions_ops as _functions_ops  # noqa: F401
+from nornicdb_tpu.apoc import functions_pure as _functions_pure  # noqa: F401
+from nornicdb_tpu.apoc import functions_tail as _functions_tail  # noqa: F401
 from nornicdb_tpu.apoc.registry import all_functions, call, categories, lookup
 
 __all__ = ["all_functions", "call", "categories", "lookup"]
