@@ -1989,17 +1989,44 @@ def proc_vector_query(ex: CypherExecutor, args, row):
     return ["node", "score"], out
 
 
+# built-in fulltext index names that work without explicit creation
+# (ref: neo4j_compat_test.go:265 — 'node_search' and 'default' must answer
+# on a bare store, Mimir compatibility)
+_BUILTIN_FULLTEXT = ("node_search", "default")
+
+
 @procedure("db.index.fulltext.querynodes")
 def proc_fulltext_query(ex: CypherExecutor, args, row):
-    """(ref: call_fulltext.go)"""
+    """(ref: call_fulltext.go; builtin-index contract
+    neo4j_compat_test.go:243 — unknown index errors immediately, built-in
+    names answer with BM25 over node text even without the DB facade)."""
     if len(args) < 2:
         raise CypherSyntaxError("db.index.fulltext.queryNodes(indexName, query)")
+    index_name = str(args[0])
     query = str(args[1])
     limit = int(args[2]) if len(args) > 2 else 10
     svc = ex.db.search if ex.db is not None else None
-    if svc is None:
-        raise CypherTypeError("fulltext search requires the DB search service")
-    hits = svc._bm25.search(query, limit)
+    if svc is not None:
+        hits = svc._bm25.search(query, limit)
+    else:
+        known = index_name in _BUILTIN_FULLTEXT or any(
+            i.name == index_name and i.kind == "fulltext"
+            for i in ex.schema.list_indexes()
+        )
+        if not known:
+            raise CypherTypeError(
+                f"there is no such fulltext schema index: {index_name}"
+            )
+        from nornicdb_tpu.search.bm25 import BM25Index
+
+        idx = BM25Index()
+        for n in ex.storage.all_nodes():
+            text = " ".join(
+                str(v) for v in n.properties.values() if isinstance(v, str)
+            )
+            if text:
+                idx.index(n.id, text)
+        hits = idx.search(query, limit)
     out = []
     for nid, score in hits:
         node = ex.get_node_or_none(nid)

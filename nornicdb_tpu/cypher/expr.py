@@ -51,7 +51,13 @@ def evaluate(e: ast.Expr, ctx: EvalContext) -> Any:
         if subject is None:
             return None
         if isinstance(subject, (Node, Edge)):
-            return subject.properties.get(e.key)
+            v = subject.properties.get(e.key)
+            if v is None and e.key == "id":
+                # `n.id` falls back to the entity id when no id property
+                # exists (ref contract: neo4j_compat_test.go:299 returns the
+                # storage ID for nodes created without an id property)
+                return subject.id
+            return v
         if isinstance(subject, dict):
             return subject.get(e.key)
         raise CypherTypeError(f"cannot access property .{e.key} on {type(subject).__name__}")

@@ -197,11 +197,14 @@ def _split_and(e: ast.Expr) -> list[ast.Expr]:
 
 
 def _prop_key(e: ast.Expr, var: str) -> Optional[str]:
-    """Matches `var.key` property access."""
+    """Matches `var.key` property access. `id` is excluded: the evaluator
+    falls back to the entity id for a missing id property (expr.py), which
+    a raw property column cannot reproduce — those leaves stay residual."""
     if (
         isinstance(e, ast.Property)
         and isinstance(e.subject, ast.Variable)
         and e.subject.name == var
+        and e.key != "id"
     ):
         return e.key
     return None

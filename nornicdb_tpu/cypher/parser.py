@@ -903,9 +903,12 @@ class Parser:
                 return ast.Literal(None)
             if t.value == "CASE":
                 return self.parse_case()
-            if t.value == "COUNT":
+            if t.value == "COUNT" and self.peek().value in ("(", "{"):
+                # bare `count` stays usable as a variable name — Neo4j allows
+                # `WITH count(*) AS count RETURN count ORDER BY count`
+                # (ref: documentation_examples_test.go CountByCategory)
                 return self.parse_count_atom()
-            if t.value == "EXISTS":
+            if t.value == "EXISTS" and self.peek().value in ("(", "{"):
                 return self.parse_exists_atom()
             if t.value in ("ALL", "NOT"):
                 pass  # handled elsewhere

@@ -1,0 +1,717 @@
+"""Neo4j compatibility + documentation-example spec suites, ported from
+the reference's behavior corpus (assertions translated, not code):
+
+- /root/reference/pkg/cypher/neo4j_compat_test.go — each Test*/t.Run maps
+  to a class/method of the same name below.
+- /root/reference/pkg/cypher/documentation_examples_test.go — ditto.
+
+These are the drop-in-replacement contracts discovered from the
+reference's Mimir integration (CREATE...SET, WITH-score pipelines,
+DETACH DELETE WHERE, built-in fulltext indexes)."""
+
+import pytest
+
+from nornicdb_tpu.cypher.executor import CypherExecutor
+from nornicdb_tpu.errors import NornicError
+from nornicdb_tpu.storage import MemoryEngine, NamespacedEngine
+from nornicdb_tpu.storage.types import Node
+
+
+@pytest.fixture
+def ex():
+    # same stack as the reference tests: namespaced view over a memory engine
+    return CypherExecutor(NamespacedEngine(MemoryEngine(), "test"))
+
+
+# ============================================================ neo4j_compat
+class TestCreateWithSetNeo4jCompat:
+    """neo4j_compat_test.go:30 TestCreateWithSetNeo4jCompat."""
+
+    def test_create_single_node_then_set_property(self, ex):
+        res = ex.execute(
+            "CREATE (n:Node {id: 'test_update_123', type: 'memory', "
+            "title: 'Update Test'})\n"
+            "SET n.content = 'Updated content for testing'\n"
+            "RETURN n")
+        assert len(res.rows) == 1
+        node = res.rows[0][0]
+        assert isinstance(node, Node)
+        assert node.properties["id"] == "test_update_123"
+        assert node.properties["type"] == "memory"
+        assert node.properties["title"] == "Update Test"
+        assert node.properties["content"] == "Updated content for testing"
+
+    def test_create_with_parameterized_set(self, ex):
+        res = ex.execute(
+            "CREATE (n:Node {id: $id, type: 'memory', title: 'Update Test'})\n"
+            "SET n.content = $newContent\nRETURN n",
+            {"id": "test_param_123", "newContent": "Parameterized content"})
+        assert len(res.rows) == 1
+        node = res.rows[0][0]
+        assert node.properties["id"] == "test_param_123"
+        assert node.properties["content"] == "Parameterized content"
+
+    def test_create_multiple_nodes_then_set(self, ex):
+        res = ex.execute(
+            "CREATE (a:Person {name: 'Alice'}), (b:Person {name: 'Bob'})\n"
+            "SET a.age = 30, b.age = 25\nRETURN a, b")
+        assert len(res.rows) == 1
+        a, b = res.rows[0]
+        assert a.properties["age"] == 30
+        assert b.properties["age"] == 25
+
+    def test_create_node_and_relationship_then_set(self, ex):
+        res = ex.execute(
+            "CREATE (a:Person {name: 'Charlie'})-[r:KNOWS]->"
+            "(b:Person {name: 'Diana'})\nSET r.since = 2020\nRETURN a, r, b")
+        assert len(res.rows) == 1
+
+    def test_create_with_set_plus_equals_operator(self, ex):
+        res = ex.execute(
+            "CREATE (n:Node {id: 'merge_test'})\n"
+            "SET n += {extra: 'value', count: 5}\nRETURN n")
+        assert len(res.rows) == 1
+        node = res.rows[0][0]
+        assert node.properties["id"] == "merge_test"
+        assert node.properties["extra"] == "value"
+        assert node.properties["count"] == 5
+
+
+class TestPropertyAccessAfterYieldNeo4jCompat:
+    """neo4j_compat_test.go:126."""
+
+    @pytest.fixture(autouse=True)
+    def _data(self, ex):
+        ex.execute(
+            "CREATE (n1:TestNode {id: 'node1', type: 'memory', "
+            "title: 'Test Node 1'}) "
+            "CREATE (n2:TestNode {id: 'node2', type: 'file', "
+            "title: 'Test Node 2'})")
+
+    def test_property_access_in_return_after_yield(self, ex):
+        res = ex.execute(
+            "MATCH (n:TestNode)\nWITH n, 0.5 as score\n"
+            "RETURN n.id as id, n.type as type, score\nLIMIT 10")
+        assert len(res.rows) >= 1
+        assert "id" in res.columns
+        assert "type" in res.columns
+        assert "score" in res.columns
+
+    def test_property_access_with_where_after_yield(self, ex):
+        res = ex.execute(
+            "MATCH (n:TestNode)\nWITH n, 0.5 as score\n"
+            "WHERE n.type IN ['memory', 'file']\n"
+            "RETURN n.id as id, n.type as type, score")
+        assert len(res.rows) >= 1
+
+
+class TestDetachDeleteWithWhereNeo4jCompat:
+    """neo4j_compat_test.go:179."""
+
+    def test_detach_delete_with_starts_with(self, ex):
+        for i in range(10):
+            ex.execute(
+                "CREATE (n:TestCleanup {id: $id, value: $value})",
+                {"id": f"integration_test_{chr(ord('A') + i)}", "value": i})
+        ex.execute(
+            "MATCH (n:TestCleanup)\n"
+            "WHERE n.id STARTS WITH 'integration_test_'\nDETACH DELETE n")
+        res = ex.execute(
+            "MATCH (n:TestCleanup) "
+            "WHERE n.id STARTS WITH 'integration_test_' "
+            "RETURN count(n) as count")
+        assert res.rows == [[0]]
+
+    def test_detach_delete_with_in_list(self, ex):
+        ex.execute("CREATE (n:ToDelete {id: 'del1'})")
+        ex.execute("CREATE (n:ToDelete {id: 'del2'})")
+        ex.execute(
+            "MATCH (n:ToDelete)\nWHERE n.id IN ['del1', 'del2']\n"
+            "DETACH DELETE n")
+        assert ex.execute(
+            "MATCH (n:ToDelete) RETURN count(n)").rows == [[0]]
+
+
+class TestFulltextWithoutIndexNeo4jCompat:
+    """neo4j_compat_test.go:243."""
+
+    def test_fulltext_query_on_nonexistent_index_errors(self, ex):
+        with pytest.raises(Exception) as e:
+            ex.execute(
+                "CALL db.index.fulltext.queryNodes("
+                "'nonexistent_index', 'test query')\n"
+                "YIELD node, score\nRETURN node.id as id, score\nLIMIT 5")
+        assert "index" in str(e.value).lower()
+
+    def test_node_search_builtin_index_works_without_creation(self, ex):
+        ex.storage.create_node(Node(
+            id="test-memory-1", labels=["Memory"],
+            properties={
+                "type": "memory",
+                "title": "Authentication System Design",
+                "content": "The authentication system uses JWT tokens for "
+                           "session management",
+            }))
+        ex.storage.create_node(Node(
+            id="test-memory-2", labels=["Memory"],
+            properties={
+                "type": "memory",
+                "title": "Database Schema",
+                "content": "PostgreSQL database with user tables",
+            }))
+        res = ex.execute(
+            "CALL db.index.fulltext.queryNodes('node_search', "
+            "'authentication')\nYIELD node, score\n"
+            "RETURN node.id as id, node.title as title, score\n"
+            "ORDER BY score DESC\nLIMIT 10")
+        assert len(res.rows) >= 1
+        assert res.rows[0][0] == "test-memory-1"
+        assert res.rows[0][2] > 0.0  # positive BM25 score
+
+    def test_default_builtin_index_also_works(self, ex):
+        ex.storage.create_node(Node(
+            id="m1", labels=["Memory"],
+            properties={"content": "authentication flows"}))
+        res = ex.execute(
+            "CALL db.index.fulltext.queryNodes('default', 'authentication')\n"
+            "YIELD node, score\nRETURN node.id as id, score\nLIMIT 5")
+        assert len(res.rows) >= 1
+
+
+class TestCreateSetWhitespaceVariations:
+    """neo4j_compat_test.go:325 — CREATE...SET across whitespace shapes."""
+
+    @pytest.mark.parametrize("name,query", [
+        ("single line",
+         "CREATE (n:Node {id: 'ws1'}) SET n.value = 1 RETURN n"),
+        ("newline before SET",
+         "CREATE (n:Node {id: 'ws2'})\nSET n.value = 2 RETURN n"),
+        ("newline after SET",
+         "CREATE (n:Node {id: 'ws3'}) SET\nn.value = 3 RETURN n"),
+        ("multiple newlines",
+         "CREATE (n:Node {id: 'ws4'})\n\nSET n.value = 4\n\nRETURN n"),
+        ("tabs instead of spaces",
+         "CREATE (n:Node {id: 'ws5'})\tSET n.value = 5\tRETURN n"),
+        ("mixed whitespace",
+         "CREATE (n:Node {id: 'ws6'})\n\tSET n.value = 6\n\tRETURN n"),
+    ])
+    def test_whitespace_variation(self, ex, name, query):
+        res = ex.execute(query)
+        assert len(res.rows) == 1, name
+
+
+class TestMimirSearchPatternNeo4jCompat:
+    """neo4j_compat_test.go:384 — the complex Mimir search pipeline."""
+
+    @pytest.fixture(autouse=True)
+    def _data(self, ex):
+        ex.execute(
+            "CREATE (f:File {id: 'file1', path: '/test/file.ts', "
+            "name: 'file.ts', type: 'file'}) "
+            "CREATE (c1:FileChunk {id: 'chunk1', type: 'file_chunk', "
+            "content: 'function test() {}'}) "
+            "CREATE (c2:FileChunk {id: 'chunk2', type: 'file_chunk', "
+            "content: 'class Example {}'})")
+        ex.execute(
+            "MATCH (f:File {id: 'file1'}), (c:FileChunk)\n"
+            "WHERE c.id IN ['chunk1', 'chunk2']\n"
+            "CREATE (f)-[:HAS_CHUNK]->(c)")
+
+    def test_verify_test_data_exists(self, ex):
+        res = ex.execute("MATCH (n:FileChunk) RETURN n.id, n.type")
+        assert len(res.rows) == 2
+        res = ex.execute(
+            "MATCH (f:File)-[:HAS_CHUNK]->(c:FileChunk) RETURN f.id, c.id")
+        assert len(res.rows) == 2
+
+    def test_simple_with_clause_with_literal_value(self, ex):
+        res = ex.execute(
+            "MATCH (node:FileChunk) WITH node, 0.75 as score "
+            "RETURN node.id, score")
+        assert len(res.rows) == 2
+
+    def test_with_clause_followed_by_where(self, ex):
+        res = ex.execute(
+            "MATCH (node:FileChunk) WITH node, 0.75 as score "
+            "WHERE score >= 0.5 RETURN node.id, score")
+        assert len(res.rows) == 2
+
+    def test_optional_match_after_with(self, ex):
+        res = ex.execute(
+            "MATCH (node:FileChunk)\nWITH node, 0.75 as score\n"
+            "OPTIONAL MATCH (node)<-[:HAS_CHUNK]-(parentFile:File)\n"
+            "RETURN node.id, score, parentFile.id")
+        assert len(res.rows) == 2
+        # stronger than the reference, which logs a known bug where the
+        # WITH-introduced score is lost: here it must survive
+        assert all(row[1] == 0.75 for row in res.rows)
+
+    def test_simple_case_expression_in_return(self, ex):
+        res = ex.execute(
+            "MATCH (node:FileChunk)\n"
+            "RETURN CASE WHEN node.type = 'file_chunk' THEN 'yes' "
+            "ELSE 'no' END AS is_chunk")
+        assert len(res.rows) == 2
+        assert all(row[0] == "yes" for row in res.rows)
+
+    def test_case_with_property_access(self, ex):
+        res = ex.execute(
+            "MATCH (node:FileChunk)\n"
+            "RETURN CASE WHEN node.type = 'file_chunk' THEN node.id "
+            "ELSE 'unknown' END AS result_id")
+        assert len(res.rows) == 2
+        assert {row[0] for row in res.rows} == {"chunk1", "chunk2"}
+
+    def test_case_with_is_not_null_check(self, ex):
+        res = ex.execute(
+            "MATCH (node:FileChunk)\n"
+            "OPTIONAL MATCH (node)<-[:HAS_CHUNK]-(parentFile:File)\n"
+            "RETURN CASE WHEN parentFile IS NOT NULL THEN parentFile.path "
+            "ELSE node.id END AS result")
+        assert len(res.rows) == 2
+        assert all(row[0] == "/test/file.ts" for row in res.rows)
+
+    def test_coalesce_function(self, ex):
+        res = ex.execute(
+            "MATCH (node:FileChunk)\n"
+            "RETURN COALESCE(node.title, node.name, node.id) AS display_name")
+        assert len(res.rows) == 2
+
+    def test_case_with_compound_and_condition(self, ex):
+        res = ex.execute(
+            "MATCH (node:FileChunk)\n"
+            "OPTIONAL MATCH (node)<-[:HAS_CHUNK]-(parentFile:File)\n"
+            "RETURN CASE \n"
+            "         WHEN node.type = 'file_chunk' AND "
+            "parentFile IS NOT NULL \n"
+            "         THEN parentFile.path \n"
+            "         ELSE node.id\n"
+            "       END AS result")
+        assert len(res.rows) == 2
+
+    def test_with_then_optional_match_then_case(self, ex):
+        res = ex.execute(
+            "MATCH (node:FileChunk)\nWITH node, 0.75 as score\n"
+            "OPTIONAL MATCH (node)<-[:HAS_CHUNK]-(parentFile:File)\n"
+            "RETURN node.id, parentFile.path, score")
+        assert len(res.rows) == 2
+        assert all(row[2] == 0.75 for row in res.rows)
+
+    def test_with_where_optional_match(self, ex):
+        res = ex.execute(
+            "MATCH (node:FileChunk)\nWITH node, 0.75 as score\n"
+            "WHERE score >= 0.5\n"
+            "OPTIONAL MATCH (node)<-[:HAS_CHUNK]-(parentFile:File)\n"
+            "RETURN node.id, parentFile.path, score")
+        assert len(res.rows) == 2
+
+    def test_with_optional_match_case_expression(self, ex):
+        res = ex.execute(
+            "MATCH (node:FileChunk)\nWITH node, 0.75 as score\n"
+            "OPTIONAL MATCH (node)<-[:HAS_CHUNK]-(parentFile:File)\n"
+            "RETURN CASE \n"
+            "         WHEN parentFile IS NOT NULL \n"
+            "         THEN parentFile.path \n"
+            "         ELSE node.id\n"
+            "       END AS result, score")
+        assert len(res.rows) == 2
+
+    def test_multiple_case_expressions_in_return(self, ex):
+        res = ex.execute(
+            "MATCH (node:FileChunk)\n"
+            "OPTIONAL MATCH (node)<-[:HAS_CHUNK]-(parentFile:File)\n"
+            "RETURN CASE WHEN parentFile IS NOT NULL THEN parentFile.path "
+            "ELSE node.id END AS id,\n       node.type AS type")
+        assert len(res.rows) == 2
+
+    def test_complex_aggregation_query_pattern(self, ex):
+        res = ex.execute(
+            "MATCH (node:FileChunk)\nWITH node, 0.75 as score\n"
+            "WHERE score >= 0.5\n\n"
+            "OPTIONAL MATCH (node)<-[:HAS_CHUNK]-(parentFile:File)\n\n"
+            "RETURN CASE \n"
+            "         WHEN node.type = 'file_chunk' AND "
+            "parentFile IS NOT NULL \n"
+            "         THEN parentFile.path \n"
+            "         ELSE COALESCE(node.id, node.path)\n"
+            "       END AS id,\n"
+            "       node.type AS type,\n"
+            "       CASE \n"
+            "         WHEN node.type = 'file_chunk' AND "
+            "parentFile IS NOT NULL \n"
+            "         THEN parentFile.name \n"
+            "         ELSE COALESCE(node.title, node.name)\n"
+            "       END AS title,\n"
+            "       score AS similarity\n"
+            "ORDER BY score DESC\nLIMIT 10")
+        assert len(res.rows) >= 1
+        assert "id" in res.columns
+        assert "type" in res.columns
+        assert "similarity" in res.columns
+
+
+# ==================================================== documentation examples
+class TestDocumentationExamples_FirstQueries:
+    """documentation_examples_test.go:16."""
+
+    @pytest.fixture()
+    def fex(self, ex):
+        ex.execute(
+            'CREATE (alice:Person {name: "Alice Johnson", age: 30, '
+            'email: "alice@example.com"}) RETURN alice')
+        ex.execute(
+            'CREATE (bob:Person {name: "Bob Smith", age: 35}), '
+            '(carol:Person {name: "Carol White", age: 28}), '
+            '(company:Company {name: "TechCorp", founded: 2010})')
+        return ex
+
+    def test_create_first_node(self, ex):
+        res = ex.execute(
+            'CREATE (alice:Person {name: "Alice Johnson", age: 30, '
+            'email: "alice@example.com"}) RETURN alice')
+        assert len(res.rows) == 1
+        node = res.rows[0][0]
+        assert isinstance(node, Node)
+        assert node.properties["name"] == "Alice Johnson"
+
+    def test_create_multiple_nodes(self, ex):
+        res = ex.execute(
+            'CREATE (bob:Person {name: "Bob Smith", age: 35}), '
+            '(carol:Person {name: "Carol White", age: 28}), '
+            '(company:Company {name: "TechCorp", founded: 2010}) '
+            'RETURN bob, carol, company')
+        assert len(res.rows) == 1
+        assert res.rows[0][0].properties["name"] == "Bob Smith"
+        assert res.rows[0][2].properties["name"] == "TechCorp"
+
+    def test_create_relationship(self, fex):
+        res = fex.execute(
+            'MATCH (alice:Person {name: "Alice Johnson"}), '
+            '(company:Company {name: "TechCorp"}) '
+            'CREATE (alice)-[r:WORKS_AT {since: 2020, role: "Engineer"}]->'
+            "(company) RETURN alice, r, company")
+        assert len(res.rows) == 1
+
+    def test_find_all_people(self, fex):
+        res = fex.execute(
+            "MATCH (p:Person) RETURN p.name, p.age ORDER BY p.age DESC")
+        assert len(res.rows) >= 3
+        ages = [row[1] for row in res.rows]
+        assert ages == sorted(ages, reverse=True)
+
+    def test_find_relationships(self, fex):
+        fex.execute(
+            'MATCH (alice:Person {name: "Alice Johnson"}), '
+            '(company:Company {name: "TechCorp"}) '
+            'CREATE (alice)-[:WORKS_AT {since: 2020, role: "Engineer"}]->'
+            "(company)")
+        res = fex.execute(
+            "MATCH (p:Person)-[r:WORKS_AT]->(c:Company) "
+            "RETURN p.name, c.name")
+        assert len(res.rows) >= 1
+
+
+class TestDocumentationExamples_QueryPatterns:
+    """documentation_examples_test.go:116."""
+
+    @pytest.fixture(autouse=True)
+    def _data(self, ex):
+        for q in [
+            'CREATE (a:Person {name: "Alice", age: 30, city: "New York"})',
+            'CREATE (b:Person {name: "Bob", age: 25, city: "Boston"})',
+            'CREATE (c:Person {name: "Charlie", age: 35, city: "New York"})',
+            'CREATE (d:Person {name: "Diana", age: 28, city: "Boston"})',
+        ]:
+            ex.execute(q)
+
+    def test_where_clause_equality(self, ex):
+        res = ex.execute(
+            "MATCH (p:Person) WHERE p.city = 'New York' RETURN p.name")
+        assert len(res.rows) == 2
+
+    def test_where_clause_comparison(self, ex):
+        res = ex.execute(
+            "MATCH (p:Person) WHERE p.age >= 30 RETURN p.name, p.age")
+        assert len(res.rows) == 2
+
+    def test_where_clause_and(self, ex):
+        res = ex.execute(
+            "MATCH (p:Person) WHERE p.age > 25 AND p.city = 'Boston' "
+            "RETURN p.name")
+        assert len(res.rows) == 1
+        assert res.rows[0][0] == "Diana"
+
+    def test_order_by_ascending(self, ex):
+        res = ex.execute(
+            "MATCH (p:Person) RETURN p.name, p.age ORDER BY p.age")
+        assert len(res.rows) >= 4
+        ages = [row[1] for row in res.rows]
+        assert ages == sorted(ages)
+
+    def test_order_by_descending(self, ex):
+        res = ex.execute(
+            "MATCH (p:Person) RETURN p.name, p.age ORDER BY p.age DESC")
+        assert len(res.rows) >= 4
+
+    def test_limit_results(self, ex):
+        res = ex.execute("MATCH (p:Person) RETURN p.name LIMIT 2")
+        assert len(res.rows) == 2
+
+    def test_skip_results(self, ex):
+        res = ex.execute(
+            "MATCH (p:Person) RETURN p.name ORDER BY p.name SKIP 1 LIMIT 2")
+        assert len(res.rows) == 2
+        assert res.rows[0][0] == "Bob"  # Alice skipped
+
+
+class TestDocumentationExamples_Aggregations:
+    """documentation_examples_test.go:216."""
+
+    @pytest.fixture(autouse=True)
+    def _data(self, ex):
+        for q in [
+            'CREATE (a:Product {name: "Widget", category: "Electronics", '
+            "price: 29.99})",
+            'CREATE (b:Product {name: "Gadget", category: "Electronics", '
+            "price: 49.99})",
+            'CREATE (c:Product {name: "Gizmo", category: "Electronics", '
+            "price: 19.99})",
+            'CREATE (d:Product {name: "Tool", category: "Hardware", '
+            "price: 15.99})",
+            'CREATE (e:Product {name: "Supply", category: "Hardware", '
+            "price: 9.99})",
+        ]:
+            ex.execute(q)
+
+    def test_count_all(self, ex):
+        res = ex.execute("MATCH (p:Product) RETURN count(*) as total")
+        assert res.rows == [[5]]
+
+    def test_count_by_category(self, ex):
+        res = ex.execute(
+            "MATCH (p:Product) WITH p.category as category, "
+            "count(*) as count RETURN category, count ORDER BY count DESC")
+        assert len(res.rows) == 2
+
+    def test_sum_prices(self, ex):
+        res = ex.execute("MATCH (p:Product) RETURN sum(p.price) as total")
+        assert len(res.rows) == 1
+        assert abs(res.rows[0][0] - 125.95) < 0.01
+
+    def test_avg_price(self, ex):
+        res = ex.execute("MATCH (p:Product) RETURN avg(p.price) as average")
+        assert abs(res.rows[0][0] - 25.19) < 0.01
+
+    def test_collect_names(self, ex):
+        res = ex.execute(
+            "MATCH (p:Product) WHERE p.category = 'Electronics' "
+            "RETURN collect(p.name) as names")
+        assert len(res.rows) == 1
+        assert len(res.rows[0][0]) == 3
+
+
+class TestDocumentationExamples_Updates:
+    """documentation_examples_test.go:303."""
+
+    def test_set_property(self, ex):
+        ex.execute('CREATE (p:Person {name: "Test", age: 25})')
+        res = ex.execute(
+            'MATCH (p:Person {name: "Test"}) SET p.age = 26 RETURN p.age')
+        assert res.rows == [[26]]
+
+    def test_set_multiple_properties(self, ex):
+        ex.execute('CREATE (p:Person {name: "Multi"})')
+        res = ex.execute(
+            'MATCH (p:Person {name: "Multi"}) '
+            'SET p.age = 30, p.city = "Boston" '
+            "RETURN p.name, p.age, p.city")
+        assert res.rows == [["Multi", 30, "Boston"]]
+
+    def test_merge_create(self, ex):
+        res = ex.execute(
+            'MERGE (p:Person {name: "NewPerson"}) '
+            "ON CREATE SET p.created = true RETURN p.name, p.created")
+        assert res.rows == [["NewPerson", True]]
+
+    def test_merge_match(self, ex):
+        ex.execute('CREATE (p:Person {name: "Existing"})')
+        res = ex.execute(
+            'MERGE (p:Person {name: "Existing"}) '
+            "ON MATCH SET p.updated = true RETURN p.name, p.updated")
+        assert res.rows == [["Existing", True]]
+
+
+class TestDocumentationExamples_Delete:
+    """documentation_examples_test.go:370."""
+
+    def test_delete_node(self, ex):
+        ex.execute('CREATE (p:Person {name: "ToDelete"})')
+        assert len(ex.execute(
+            'MATCH (p:Person {name: "ToDelete"}) RETURN p').rows) == 1
+        ex.execute('MATCH (p:Person {name: "ToDelete"}) DELETE p')
+        assert len(ex.execute(
+            'MATCH (p:Person {name: "ToDelete"}) RETURN p').rows) == 0
+
+    def test_detach_delete(self, ex):
+        ex.execute(
+            'CREATE (a:Person {name: "A"})-[:KNOWS]->(b:Person {name: "B"})')
+        ex.execute('MATCH (p:Person {name: "A"}) DETACH DELETE p')
+        assert len(ex.execute(
+            'MATCH (p:Person {name: "A"}) RETURN p').rows) == 0
+
+
+class TestDocumentationExamples_Functions:
+    """documentation_examples_test.go:414."""
+
+    @pytest.fixture(autouse=True)
+    def _data(self, ex):
+        ex.execute(
+            'CREATE (p:Person:Employee {name: "FuncTest", '
+            'email: "test@example.com"})')
+
+    def test_id_function(self, ex):
+        res = ex.execute('MATCH (p:Person {name: "FuncTest"}) RETURN id(p)')
+        assert len(res.rows) == 1 and res.rows[0][0] is not None
+
+    def test_labels_function(self, ex):
+        res = ex.execute(
+            'MATCH (p:Person {name: "FuncTest"}) RETURN labels(p)')
+        assert len(res.rows[0][0]) >= 2
+
+    def test_keys_function(self, ex):
+        res = ex.execute('MATCH (p:Person {name: "FuncTest"}) RETURN keys(p)')
+        assert len(res.rows[0][0]) >= 2
+
+    def test_coalesce_function(self, ex):
+        ex.execute('CREATE (p:Person {name: "CoalesceTest"})')
+        res = ex.execute(
+            'MATCH (p:Person {name: "CoalesceTest"}) '
+            "RETURN coalesce(p.nickname, p.name) as displayName")
+        assert res.rows == [["CoalesceTest"]]
+
+    def test_to_string_function(self, ex):
+        ex.execute('CREATE (p:Person {name: "StringTest", age: 42})')
+        res = ex.execute(
+            'MATCH (p:Person {name: "StringTest"}) RETURN toString(p.age)')
+        assert res.rows == [["42"]]
+
+
+class TestDocumentationExamples_StringFunctions:
+    """documentation_examples_test.go:493."""
+
+    def test_to_upper_to_lower(self, ex):
+        res = ex.execute(
+            "RETURN toUpper('hello') as upper, toLower('WORLD') as lower")
+        assert res.rows == [["HELLO", "world"]]
+
+    def test_trim_function(self, ex):
+        assert ex.execute(
+            "RETURN trim('  hello  ') as trimmed").rows == [["hello"]]
+
+    def test_substring_function(self, ex):
+        assert ex.execute(
+            "RETURN substring('hello world', 0, 5) as sub").rows == [["hello"]]
+
+    def test_replace_function(self, ex):
+        assert ex.execute(
+            "RETURN replace('hello world', 'world', 'cypher') as replaced"
+        ).rows == [["hello cypher"]]
+
+    def test_size_function(self, ex):
+        assert ex.execute("RETURN size('hello') as len").rows == [[5]]
+
+
+class TestDocumentationExamples_ListFunctions:
+    """documentation_examples_test.go:543."""
+
+    def test_range_function(self, ex):
+        res = ex.execute("RETURN range(1, 5) as nums")
+        assert len(res.rows[0][0]) == 5
+
+    def test_head_tail_functions(self, ex):
+        res = ex.execute(
+            "WITH [1, 2, 3, 4, 5] as nums "
+            "RETURN head(nums) as first, last(nums) as last")
+        assert res.rows == [[1, 5]]
+
+    def test_size_of_list(self, ex):
+        assert ex.execute(
+            "RETURN size([1, 2, 3, 4, 5]) as count").rows == [[5]]
+
+    def test_reverse_function(self, ex):
+        assert ex.execute(
+            "RETURN reverse([1, 2, 3]) as reversed").rows == [[[3, 2, 1]]]
+
+
+class TestDocumentationExamples_CaseExpression:
+    """documentation_examples_test.go:587."""
+
+    def test_simple_case_when(self, ex):
+        for q in [
+            'CREATE (a:Person {name: "Young", age: 18})',
+            'CREATE (b:Person {name: "Adult", age: 35})',
+            'CREATE (c:Person {name: "Senior", age: 70})',
+        ]:
+            ex.execute(q)
+        res = ex.execute(
+            "MATCH (p:Person)\nRETURN p.name,\n"
+            "  CASE\n    WHEN p.age < 20 THEN 'Young'\n"
+            "    WHEN p.age < 60 THEN 'Adult'\n    ELSE 'Senior'\n"
+            "  END as category\nORDER BY p.name")
+        assert len(res.rows) == 3
+        assert res.rows == [["Adult", "Adult"], ["Senior", "Senior"],
+                            ["Young", "Young"]]
+
+
+class TestDocumentationExamples_UnwindClause:
+    """documentation_examples_test.go:623."""
+
+    def test_unwind_simple_list(self, ex):
+        assert len(ex.execute(
+            "UNWIND [1, 2, 3, 4, 5] AS x RETURN x").rows) == 5
+
+    def test_unwind_range(self, ex):
+        assert len(ex.execute(
+            "UNWIND range(1, 10) AS x RETURN x").rows) == 10
+
+    def test_unwind_with_match(self, ex):
+        ex.execute('CREATE (p:Person:Developer {name: "UnwindTest"})')
+        res = ex.execute(
+            'MATCH (p:Person {name: "UnwindTest"}) '
+            "UNWIND labels(p) as label RETURN label")
+        assert len(res.rows) >= 2
+
+
+class TestDocumentationExamples_ListComprehension:
+    """documentation_examples_test.go:667."""
+
+    def test_simple_list_comprehension(self, ex):
+        res = ex.execute("RETURN [x IN [1, 2, 3, 4, 5]] as nums")
+        assert len(res.rows[0][0]) == 5
+
+    def test_list_comprehension_with_filter(self, ex):
+        res = ex.execute("RETURN [x IN [1, 2, 3, 4, 5] WHERE x > 2] as f")
+        assert res.rows == [[[3, 4, 5]]]
+
+    def test_list_comprehension_with_transform(self, ex):
+        res = ex.execute("RETURN [x IN [1, 2, 3] | x * 2] as doubled")
+        assert res.rows == [[[2, 4, 6]]]
+
+
+class TestDocumentationExamples_Procedures:
+    """documentation_examples_test.go:706."""
+
+    def test_dbms_components(self, ex):
+        assert len(ex.execute("CALL dbms.components()").rows) == 1
+
+    def test_db_labels(self, ex):
+        ex.execute("CREATE (:TestLabel1), (:TestLabel2)")
+        assert len(ex.execute("CALL db.labels()").rows) >= 2
+
+    def test_db_relationship_types(self, ex):
+        res = ex.execute("CALL db.relationshipTypes()")
+        assert res is not None
+
+    def test_db_property_keys(self, ex):
+        res = ex.execute("CALL db.propertyKeys()")
+        assert res is not None
