@@ -12,10 +12,23 @@
 //         vlen == 0xFFFFFFFF marks a tombstone (no value bytes).
 // A torn/corrupt tail terminates recovery at the last good record.
 //
+// Reads go through a read-only mmap of the file (remapped as appends grow
+// it; FILE* fallback when mmap is unavailable) — the role of Badger's
+// value-log mmap. Compaction is TWO-PHASE so it runs online (the role of
+// Badger's background GC, pkg/storage/badger.go:67): phase 1 copies a
+// snapshot of the live index to a temp file WITHOUT the store lock (the
+// file is append-only, so snapshot offsets are immutable); phase 2 takes
+// the lock only to replay the delta (keys added/changed/deleted during
+// phase 1), fsync, and atomically swap. Readers and writers are blocked
+// only for the delta, not the full rewrite.
+//
 // Build: make -C native  (produces libsegstore.so)
 
 #include <cstdint>
 #ifndef _WIN32
+#include <fcntl.h>
+#include <sys/mman.h>
+#include <sys/stat.h>
 #include <unistd.h>
 #endif
 #include <cstdio>
@@ -64,12 +77,49 @@ struct Store {
   std::mutex mu;
   std::string path;
   FILE* f = nullptr;   // append handle
-  FILE* rf = nullptr;  // persistent read handle
+  FILE* rf = nullptr;  // persistent read handle (mmap fallback)
   std::unordered_map<std::string, Entry> index;
   uint64_t valid_bytes = 0;
   uint64_t tombstones = 0;  // dead records: deletes AND overwritten versions
   bool sync = false;
+  bool compacting = false;  // one online compaction at a time
+  uint8_t* map = nullptr;   // read-only view of the segment file
+  uint64_t map_len = 0;
 };
+
+#ifndef _WIN32
+void unmap_locked(Store* s) {
+  if (s->map) {
+    munmap(s->map, s->map_len);
+    s->map = nullptr;
+    s->map_len = 0;
+  }
+}
+
+// (Re)map the file read-only at its current size; returns true when the
+// mapping covers `need` bytes. Appends via FILE* land in the same page
+// cache, so an existing mapping stays coherent for already-covered bytes.
+bool remap_locked(Store* s, uint64_t need) {
+  unmap_locked(s);
+  int fd = open(s->path.c_str(), O_RDONLY);
+  if (fd < 0) return false;
+  struct stat st;
+  if (fstat(fd, &st) != 0 || st.st_size <= 0) {
+    close(fd);
+    return false;
+  }
+  void* m = mmap(nullptr, static_cast<size_t>(st.st_size), PROT_READ,
+                 MAP_SHARED, fd, 0);
+  close(fd);
+  if (m == MAP_FAILED) return false;
+  s->map = static_cast<uint8_t*>(m);
+  s->map_len = static_cast<uint64_t>(st.st_size);
+  return need <= s->map_len;
+}
+#else
+void unmap_locked(Store*) {}
+bool remap_locked(Store*, uint64_t) { return false; }
+#endif
 
 bool read_exact(FILE* f, void* buf, uint64_t n) {
   return std::fread(buf, 1, n, f) == n;
@@ -138,6 +188,9 @@ extern "C" {
 void* seg_open(const char* path) {
   auto* s = new Store();
   s->path = path;
+  // a crash mid-compaction leaves a stale temp file; the live store is the
+  // source of truth, so drop it
+  std::remove((s->path + ".compact").c_str());
   if (!load(s)) {
     delete s;
     return nullptr;
@@ -174,6 +227,7 @@ void seg_set_sync(void* handle, int32_t enabled) {
 
 void seg_close(void* handle) {
   auto* s = static_cast<Store*>(handle);
+  unmap_locked(s);
   if (s->f) std::fclose(s->f);
   if (s->rf) std::fclose(s->rf);
   delete s;
@@ -206,6 +260,12 @@ int64_t seg_get(void* handle, const uint8_t* key, uint32_t klen,
   const Entry& e = it->second;
   if (e.len == 0) return 0;
   if (out_cap < e.len) return -static_cast<int64_t>(e.len) - 2;
+  // mmap fast path (remap when appends have grown the file past the view)
+  if (e.offset + e.len > s->map_len) remap_locked(s, e.offset + e.len);
+  if (s->map && e.offset + e.len <= s->map_len) {
+    std::memcpy(out, s->map + e.offset, e.len);
+    return e.len;
+  }
   if (!s->rf) s->rf = std::fopen(s->path.c_str(), "rb");
   if (!s->rf) return -2;
   if (std::fseek(s->rf, static_cast<long>(e.offset), SEEK_SET) != 0) return -2;
@@ -267,63 +327,125 @@ int64_t seg_keys(void* handle, const uint8_t* prefix, uint32_t plen,
   return static_cast<int64_t>(off);
 }
 
-// Rewrite the file with only live records (drops tombstones + stale
-// versions). Payload bytes never leave C++.
+namespace {
+
+// Copy one live record from `in` to `out`; updates idx/off. Payload bytes
+// never leave C++.
+bool copy_record(FILE* in, FILE* out, const std::string& k, const Entry& e,
+                 std::unordered_map<std::string, Entry>& idx,
+                 uint64_t& new_off, std::vector<uint8_t>& val) {
+  val.resize(e.len);
+  if (std::fseek(in, static_cast<long>(e.offset), SEEK_SET) != 0) return false;
+  if (e.len && std::fread(val.data(), 1, e.len, in) != e.len) return false;
+  uint32_t klen = static_cast<uint32_t>(k.size());
+  uint32_t vlen = e.len;
+  uint32_t crc = crc32_of(reinterpret_cast<const uint8_t*>(k.data()), klen,
+                          val.data(), vlen);
+  if (std::fwrite(&klen, 1, 4, out) != 4 ||
+      std::fwrite(&vlen, 1, 4, out) != 4 ||
+      std::fwrite(k.data(), 1, klen, out) != klen ||
+      (vlen && std::fwrite(val.data(), 1, vlen, out) != vlen) ||
+      std::fwrite(&crc, 1, 4, out) != 4)
+    return false;
+  idx[k] = Entry{new_off + 8 + klen, vlen};
+  new_off += 8ull + klen + vlen + 4;
+  return true;
+}
+
+}  // namespace
+
+// Online compaction: rewrite only live records (drops tombstones + stale
+// versions). Two-phase — the store lock is held only while replaying the
+// delta of writes that landed during the snapshot copy, so concurrent
+// readers/writers are not blocked by the bulk rewrite (the role of
+// Badger's background value-log GC, pkg/storage/badger.go:67).
 int32_t seg_compact(void* handle) {
   auto* s = static_cast<Store*>(handle);
-  std::lock_guard<std::mutex> lock(s->mu);
-  std::string tmp = s->path + ".compact";
-  FILE* out = std::fopen(tmp.c_str(), "wb");
-  if (!out) return -1;
-  FILE* in = std::fopen(s->path.c_str(), "rb");
-  if (!in) {
-    std::fclose(out);
-    return -1;
+  std::string path;
+  std::unordered_map<std::string, Entry> snap;
+  {
+    std::lock_guard<std::mutex> lock(s->mu);
+    if (s->compacting) return -3;
+    s->compacting = true;
+    snap = s->index;
+    path = s->path;
   }
-  std::unordered_map<std::string, Entry> new_index;
+  std::string tmp = path + ".compact";
+  FILE* out = std::fopen(tmp.c_str(), "wb");
+  FILE* in = std::fopen(path.c_str(), "rb");
+  std::unordered_map<std::string, Entry> written;
   uint64_t new_off = 0;
   std::vector<uint8_t> val;
-  bool ok = true;
-  for (const auto& kv : s->index) {
-    const std::string& k = kv.first;
-    const Entry& e = kv.second;
-    val.resize(e.len);
-    if (std::fseek(in, static_cast<long>(e.offset), SEEK_SET) != 0) { ok = false; break; }
-    if (e.len && std::fread(val.data(), 1, e.len, in) != e.len) { ok = false; break; }
-    uint32_t klen = static_cast<uint32_t>(k.size());
-    uint32_t vlen = e.len;
-    uint32_t crc = crc32_of(reinterpret_cast<const uint8_t*>(k.data()), klen,
-                            val.data(), vlen);
-    if (std::fwrite(&klen, 1, 4, out) != 4 ||
-        std::fwrite(&vlen, 1, 4, out) != 4 ||
-        std::fwrite(k.data(), 1, klen, out) != klen ||
-        (vlen && std::fwrite(val.data(), 1, vlen, out) != vlen) ||
-        std::fwrite(&crc, 1, 4, out) != 4) { ok = false; break; }
-    new_index[k] = Entry{new_off + 8 + klen, vlen};
-    new_off += 8ull + klen + vlen + 4;
+  bool ok = out && in;
+  // phase 1 (unlocked): snapshot offsets are immutable in an append-only
+  // file, so the copy races nothing
+  if (ok) {
+    for (const auto& kv : snap) {
+      if (!copy_record(in, out, kv.first, kv.second, written, new_off, val)) {
+        ok = false;
+        break;
+      }
+    }
   }
-  std::fclose(in);
-  ok = ok && std::fflush(out) == 0;
+  {
+    std::lock_guard<std::mutex> lock(s->mu);
+    s->compacting = false;
+    if (ok) {
+      // phase 2 (locked): keep only entries still current, append the
+      // records that changed/arrived during phase 1, swap atomically
+      std::unordered_map<std::string, Entry> new_index;
+      uint64_t dead = 0;
+      for (const auto& kv : written) {
+        auto it = s->index.find(kv.first);
+        const auto sit = snap.find(kv.first);
+        if (it != s->index.end() && sit != snap.end() &&
+            it->second.offset == sit->second.offset &&
+            it->second.len == sit->second.len) {
+          new_index[kv.first] = kv.second;
+        } else {
+          dead++;  // deleted or overwritten while phase 1 ran
+        }
+      }
+      for (const auto& kv : s->index) {
+        if (new_index.count(kv.first)) continue;
+        if (!copy_record(in, out, kv.first, kv.second, new_index, new_off,
+                         val)) {
+          ok = false;
+          break;
+        }
+      }
+      ok = ok && std::fflush(out) == 0;
 #ifndef _WIN32
-  ok = ok && fsync(fileno(out)) == 0;
+      ok = ok && fsync(fileno(out)) == 0;
 #endif
-  std::fclose(out);
-  if (!ok) {
-    std::remove(tmp.c_str());  // abort: the live store is untouched
-    return -1;
+      if (ok) {
+        std::fclose(in);
+        in = nullptr;
+        std::fclose(out);
+        out = nullptr;
+        unmap_locked(s);
+        std::fclose(s->f);
+        if (s->rf) {
+          std::fclose(s->rf);
+          s->rf = nullptr;
+        }
+        if (std::rename(tmp.c_str(), path.c_str()) != 0) {
+          s->f = std::fopen(path.c_str(), "ab");
+          return s->f ? -1 : -2;
+        }
+        s->f = std::fopen(path.c_str(), "ab");
+        s->rf = std::fopen(path.c_str(), "rb");
+        s->index = std::move(new_index);
+        s->valid_bytes = new_off;
+        s->tombstones = dead;
+        return s->f ? 0 : -2;
+      }
+    }
   }
-  std::fclose(s->f);
-  if (s->rf) { std::fclose(s->rf); s->rf = nullptr; }
-  if (std::rename(tmp.c_str(), s->path.c_str()) != 0) {
-    s->f = std::fopen(s->path.c_str(), "ab");
-    return -1;
-  }
-  s->f = std::fopen(s->path.c_str(), "ab");
-  s->rf = std::fopen(s->path.c_str(), "rb");
-  s->index = std::move(new_index);
-  s->valid_bytes = new_off;
-  s->tombstones = 0;
-  return s->f ? 0 : -1;
+  if (in) std::fclose(in);
+  if (out) std::fclose(out);
+  std::remove(tmp.c_str());  // abort: the live store is untouched
+  return -1;
 }
 
 }  // extern "C"

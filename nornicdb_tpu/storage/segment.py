@@ -159,7 +159,10 @@ class _SegKV:
             return out
 
     def compact(self) -> None:
-        if self._lib.seg_compact(self._h) != 0:
+        rc = self._lib.seg_compact(self._h)
+        if rc == -3:
+            return  # another thread's compaction is already running
+        if rc != 0:
             raise NornicError("segment store compaction failed")
 
     def close(self) -> None:
@@ -206,7 +209,8 @@ class SegmentEngine(Engine):
     _CHK_PLAINTEXT = b"nornicdb-segment"
 
     def __init__(self, data_dir: str, sync: bool = False,
-                 passphrase: Optional[str] = None):
+                 passphrase: Optional[str] = None,
+                 auto_compact_interval: float = 30.0):
         super().__init__()
         os.makedirs(data_dir, exist_ok=True)
         self._kv = _SegKV(os.path.join(data_dir, "graph.seg"), sync=sync)
@@ -270,12 +274,32 @@ class SegmentEngine(Engine):
             self._kv.close()
             raise
         # GC: every mutation path ratio-checks inline (_maybe_compact at
-        # the create/update/delete sites), which covers steady state
-        # without a background thread. The only gap is garbage above the
-        # ratio left behind by a previous run — collect it once now,
-        # post-recovery. (The reference needs Badger's value-log GC ticker
-        # because its LSM defers reclamation; our inline check doesn't.)
+        # the create/update/delete sites), which covers steady state; a
+        # post-recovery pass collects garbage a previous run left behind;
+        # and a background thread (the role of Badger's value-log GC
+        # ticker, pkg/storage/badger.go:67) sweeps periodically. The
+        # native compaction is two-phase/online, so the sweep blocks
+        # readers only for the write-delta replay.
         self._maybe_compact()
+        self._compact_stop = threading.Event()
+        self._compact_thread: Optional[threading.Thread] = None
+        if auto_compact_interval > 0:
+            self._compact_thread = threading.Thread(
+                target=self._compact_loop, args=(auto_compact_interval,),
+                daemon=True, name="seg-compact",
+            )
+            self._compact_thread.start()
+
+    def _compact_loop(self, interval: float) -> None:
+        while not self._compact_stop.wait(interval):
+            try:
+                # ratio check without the engine lock; the native two-phase
+                # compaction serializes against writers itself
+                if (self._kv.tombstones() / max(self._kv.count(), 1)
+                        > self.COMPACT_RATIO):
+                    self._kv.compact()
+            except Exception:
+                pass  # storage may be mid-close; the next tick retries
 
     # -- recovery ------------------------------------------------------------
     def _rebuild_indexes(self) -> None:
@@ -519,5 +543,8 @@ class SegmentEngine(Engine):
             self._kv.compact()
 
     def close(self) -> None:
+        self._compact_stop.set()
+        if self._compact_thread is not None:
+            self._compact_thread.join(timeout=5.0)
         with self._lock:
             self._kv.close()
