@@ -1,11 +1,14 @@
-"""GGUF model-file reader/writer (metadata + unquantized tensors).
+"""GGUF model-file reader/writer (metadata + tensors incl. quantized blocks).
 
 Behavioral reference: /root/reference/lib/llama/gguf.h + pkg/localllm
-(llama.cpp loads bge-m3/Qwen GGUF files; scripts/build-llama.sh pins the
-runtime; neural/export_to_gguf.py produces them). This reader lets the TPU
-build consume the same artifacts: metadata KV + F32/F16/BF16 tensors are
-parsed into numpy arrays (quantized blocks like Q4_K raise — dequantization
-is a later round; bf16/f32 exports cover the TPU serving path).
+(llama.cpp loads Q-quantized bge-m3/Qwen GGUF files, llama.go:498;
+neural/export_to_gguf.py produces them). This reader lets the TPU build
+consume the same artifacts: metadata KV + F32/F16/BF16 tensors parse into
+numpy arrays, and the standard quantized block formats — Q4_0, Q4_1, Q5_0,
+Q5_1, Q8_0 and the K-quants Q4_K, Q6_K — dequantize to float32 with
+vectorized numpy decoders written clean-room from the public GGML block
+layouts. (TPU serving then runs bf16; dequantized weights are cast on
+device upload.)
 
 GGUF v3 layout:
   magic "GGUF" | u32 version | u64 n_tensors | u64 n_kv
@@ -17,7 +20,7 @@ GGUF v3 layout:
 from __future__ import annotations
 
 import struct
-from typing import Any, BinaryIO
+from typing import Any, BinaryIO, Optional
 
 import numpy as np
 
@@ -29,6 +32,8 @@ T_STRING, T_ARRAY, T_U64, T_I64, T_F64 = 8, 9, 10, 11, 12
 
 # tensor dtypes (ggml_type)
 GGML_F32, GGML_F16 = 0, 1
+GGML_Q4_0, GGML_Q4_1, GGML_Q5_0, GGML_Q5_1, GGML_Q8_0 = 2, 3, 6, 7, 8
+GGML_Q4_K, GGML_Q6_K = 12, 14
 GGML_BF16 = 30
 _SUPPORTED_TENSOR_TYPES = {GGML_F32: np.float32, GGML_F16: np.float16}
 
@@ -37,6 +42,195 @@ _SCALAR_FMT = {
     T_U32: "<I", T_I32: "<i", T_F32: "<f", T_U64: "<Q",
     T_I64: "<q", T_F64: "<d",
 }
+
+
+# ----------------------------------------------------- quantized blocks
+# (element count per block, bytes per block) — public GGML block layouts
+_QUANT_BLOCKS = {
+    GGML_Q4_0: (32, 18),   # f16 d | 16B nibbles            v = d*(q-8)
+    GGML_Q4_1: (32, 20),   # f16 d | f16 m | 16B nibbles    v = d*q + m
+    GGML_Q5_0: (32, 22),   # f16 d | u32 qh | 16B ql        v = d*(q-16)
+    GGML_Q5_1: (32, 24),   # f16 d | f16 m | u32 qh | 16B   v = d*q + m
+    GGML_Q8_0: (32, 34),   # f16 d | 32 x i8                v = d*q
+    GGML_Q4_K: (256, 144), # f16 d | f16 dmin | 12B 6-bit scales | 128B
+    GGML_Q6_K: (256, 210), # 128B ql | 64B qh | 16 x i8 scales | f16 d
+}
+
+
+def _f16(b: np.ndarray) -> np.ndarray:
+    return np.ascontiguousarray(b).view(np.float16).astype(np.float32)
+
+
+def _nibbles(qs: np.ndarray) -> tuple[np.ndarray, np.ndarray]:
+    """(low nibbles -> elements 0..15, high nibbles -> 16..31) per block."""
+    return (qs & 0x0F).astype(np.float32), (qs >> 4).astype(np.float32)
+
+
+def _dequant_q4_0(a: np.ndarray) -> np.ndarray:
+    d = _f16(a[:, :2])  # (B, 1)
+    lo, hi = _nibbles(a[:, 2:])
+    return d * (np.concatenate([lo, hi], axis=1) - 8.0)
+
+
+def _dequant_q4_1(a: np.ndarray) -> np.ndarray:
+    d = _f16(a[:, :2])
+    m = _f16(a[:, 2:4])
+    lo, hi = _nibbles(a[:, 4:])
+    return d * np.concatenate([lo, hi], axis=1) + m
+
+
+def _high_bits(qh: np.ndarray) -> np.ndarray:
+    """(B, 4) u8 -> (B, 32) fifth bits from the packed u32."""
+    bits = np.unpackbits(
+        np.ascontiguousarray(qh).view(np.uint32).view(np.uint8),
+        axis=1, bitorder="little",
+    )
+    return bits[:, :32]
+
+
+def _dequant_q5_0(a: np.ndarray) -> np.ndarray:
+    d = _f16(a[:, :2])
+    h = _high_bits(a[:, 2:6]).astype(np.float32) * 16.0
+    lo, hi = _nibbles(a[:, 6:])
+    q = np.concatenate([lo, hi], axis=1) + h
+    return d * (q - 16.0)
+
+
+def _dequant_q5_1(a: np.ndarray) -> np.ndarray:
+    d = _f16(a[:, :2])
+    m = _f16(a[:, 2:4])
+    h = _high_bits(a[:, 4:8]).astype(np.float32) * 16.0
+    lo, hi = _nibbles(a[:, 8:])
+    return d * (np.concatenate([lo, hi], axis=1) + h) + m
+
+
+def _dequant_q8_0(a: np.ndarray) -> np.ndarray:
+    d = _f16(a[:, :2])
+    qs = np.ascontiguousarray(a[:, 2:]).view(np.int8).astype(np.float32)
+    return d * qs
+
+
+def _q4k_scales(sc: np.ndarray) -> tuple[np.ndarray, np.ndarray]:
+    """Unpack the 12-byte 6-bit (scale, min) pairs of a q4_K/q5_K
+    super-block -> two (B, 8) arrays (public get_scale_min_k4 layout)."""
+    B = sc.shape[0]
+    scales = np.empty((B, 8), np.float32)
+    mins = np.empty((B, 8), np.float32)
+    for j in range(4):
+        scales[:, j] = (sc[:, j] & 63).astype(np.float32)
+        mins[:, j] = (sc[:, j + 4] & 63).astype(np.float32)
+    for j in range(4, 8):
+        scales[:, j] = ((sc[:, j + 4] & 0x0F)
+                        | ((sc[:, j - 4] >> 6) << 4)).astype(np.float32)
+        mins[:, j] = ((sc[:, j + 4] >> 4)
+                      | ((sc[:, j] >> 6) << 4)).astype(np.float32)
+    return scales, mins
+
+
+def _dequant_q4_k(a: np.ndarray) -> np.ndarray:
+    B = a.shape[0]
+    d = _f16(a[:, 0:2])        # (B, 1)
+    dmin = _f16(a[:, 2:4])
+    scales, mins = _q4k_scales(a[:, 4:16])
+    qs = a[:, 16:144]          # (B, 128) nibbles
+    out = np.empty((B, 256), np.float32)
+    # per 64-element chunk: 32 bytes; low nibbles -> first 32, high -> next
+    for chunk in range(4):
+        q = qs[:, chunk * 32:(chunk + 1) * 32]
+        s0 = d * scales[:, 2 * chunk:2 * chunk + 1]
+        m0 = dmin * mins[:, 2 * chunk:2 * chunk + 1]
+        s1 = d * scales[:, 2 * chunk + 1:2 * chunk + 2]
+        m1 = dmin * mins[:, 2 * chunk + 1:2 * chunk + 2]
+        out[:, chunk * 64:chunk * 64 + 32] = \
+            s0 * (q & 0x0F).astype(np.float32) - m0
+        out[:, chunk * 64 + 32:chunk * 64 + 64] = \
+            s1 * (q >> 4).astype(np.float32) - m1
+    return out
+
+
+def _dequant_q6_k(a: np.ndarray) -> np.ndarray:
+    B = a.shape[0]
+    ql = a[:, 0:128]
+    qh = a[:, 128:192]
+    sc = np.ascontiguousarray(a[:, 192:208]).view(np.int8).astype(np.float32)
+    d = _f16(a[:, 208:210])
+    out = np.empty((B, 256), np.float32)
+    for half in range(2):  # 128 elements per half
+        l_ = ql[:, half * 64:half * 64 + 64]
+        h = qh[:, half * 32:half * 32 + 32]
+        s = sc[:, half * 8:half * 8 + 8]
+        base = half * 128
+        l0, l1 = l_[:, :32], l_[:, 32:]
+        q1 = ((l0 & 0x0F) | ((h & 3) << 4)).astype(np.float32) - 32.0
+        q2 = ((l1 & 0x0F) | (((h >> 2) & 3) << 4)).astype(np.float32) - 32.0
+        q3 = ((l0 >> 4) | (((h >> 4) & 3) << 4)).astype(np.float32) - 32.0
+        q4 = ((l1 >> 4) | (((h >> 6) & 3) << 4)).astype(np.float32) - 32.0
+        # scale index is l//16 within each 32-lane group
+        srep = np.repeat(s, 16, axis=1)  # (B, 128): sc[0]x16 sc[1]x16 ...
+        out[:, base:base + 32] = d * srep[:, 0:32] * q1
+        out[:, base + 32:base + 64] = d * srep[:, 32:64] * q2
+        out[:, base + 64:base + 96] = d * srep[:, 64:96] * q3
+        out[:, base + 96:base + 128] = d * srep[:, 96:128] * q4
+    return out
+
+
+_DEQUANT = {
+    GGML_Q4_0: _dequant_q4_0,
+    GGML_Q4_1: _dequant_q4_1,
+    GGML_Q5_0: _dequant_q5_0,
+    GGML_Q5_1: _dequant_q5_1,
+    GGML_Q8_0: _dequant_q8_0,
+    GGML_Q4_K: _dequant_q4_k,
+    GGML_Q6_K: _dequant_q6_k,
+}
+
+
+def dequantize(raw: bytes, ggml_type: int, count: int) -> np.ndarray:
+    """Decode `count` elements of a quantized tensor blob to float32."""
+    if ggml_type not in _QUANT_BLOCKS:
+        raise ValueError(f"ggml type {ggml_type} is not a known quant format")
+    elems, nbytes = _QUANT_BLOCKS[ggml_type]
+    if count % elems != 0:
+        raise ValueError(
+            f"element count {count} not a multiple of block size {elems}")
+    blocks = count // elems
+    a = np.frombuffer(raw, np.uint8, count=blocks * nbytes)
+    return _DEQUANT[ggml_type](a.reshape(blocks, nbytes)).reshape(-1)
+
+
+def quantize_q8_0(arr: np.ndarray) -> bytes:
+    """Encode float data as q8_0 blocks (export parity with llama.cpp's
+    quantize_row_q8_0_ref: d = max|x|/127, q = round(x/d))."""
+    x = np.asarray(arr, np.float32).reshape(-1)
+    if x.size % 32 != 0:
+        raise ValueError("q8_0 needs a multiple of 32 elements")
+    xb = x.reshape(-1, 32)
+    amax = np.max(np.abs(xb), axis=1, keepdims=True)
+    d = amax / 127.0
+    inv = np.where(d > 0, 1.0 / np.maximum(d, 1e-30), 0.0)
+    q = np.clip(np.round(xb * inv), -127, 127).astype(np.int8)
+    out = np.empty((xb.shape[0], 34), np.uint8)
+    out[:, :2] = d.astype(np.float16).view(np.uint8)
+    out[:, 2:] = q.view(np.uint8)
+    return out.tobytes()
+
+
+def quantize_q4_0(arr: np.ndarray) -> bytes:
+    """Encode float data as q4_0 blocks (quantize_row_q4_0_ref: d =
+    signed-max/-8, q = round(x/d) + 8 clamped to [0, 15])."""
+    x = np.asarray(arr, np.float32).reshape(-1)
+    if x.size % 32 != 0:
+        raise ValueError("q4_0 needs a multiple of 32 elements")
+    xb = x.reshape(-1, 32)
+    idx = np.argmax(np.abs(xb), axis=1)
+    signed_max = xb[np.arange(xb.shape[0]), idx]
+    d = (signed_max / -8.0).reshape(-1, 1)
+    inv = np.divide(1.0, d, out=np.zeros_like(d), where=d != 0)
+    q = np.clip(np.round(xb * inv) + 8, 0, 15).astype(np.uint8)
+    out = np.empty((xb.shape[0], 18), np.uint8)
+    out[:, :2] = d.astype(np.float16).view(np.uint8)
+    out[:, 2:] = q[:, :16] | (q[:, 16:] << 4)
+    return out.tobytes()
 
 
 def _read_str(f: BinaryIO) -> str:
@@ -119,17 +313,29 @@ def load_gguf(path: str, load_tensors: bool = True):
             base = f.tell()
             base += (-base) % alignment
             for name, dims, dtype, offset in infos:
-                np_dtype = _SUPPORTED_TENSOR_TYPES.get(dtype)
-                if np_dtype is None:
-                    raise ValueError(
-                        f"tensor {name}: ggml type {dtype} not supported "
-                        "(quantized blocks need dequantization — export "
-                        "f32/f16 for the TPU path)"
-                    )
                 # GGUF dims are innermost-first; numpy wants outermost-first
                 shape = tuple(reversed(dims))
                 count = int(np.prod(shape)) if shape else 1
                 f.seek(base + offset)
+                if dtype in _QUANT_BLOCKS:
+                    elems, nbytes = _QUANT_BLOCKS[dtype]
+                    raw = f.read((count // elems) * nbytes)
+                    tensors[name] = dequantize(raw, dtype, count).reshape(shape)
+                    continue
+                if dtype == GGML_BF16:
+                    u16 = np.frombuffer(f.read(count * 2), dtype=np.uint16)
+                    tensors[name] = (
+                        (u16.astype(np.uint32) << 16).view(np.float32)
+                        .reshape(shape)
+                    )
+                    continue
+                np_dtype = _SUPPORTED_TENSOR_TYPES.get(dtype)
+                if np_dtype is None:
+                    raise ValueError(
+                        f"tensor {name}: ggml type {dtype} not supported "
+                        "(supported: f32/f16/bf16, q4_0/q4_1/q5_0/q5_1/"
+                        "q8_0, q4_K/q6_K)"
+                    )
                 data = np.frombuffer(
                     f.read(count * np.dtype(np_dtype).itemsize), dtype=np_dtype
                 )
@@ -137,35 +343,58 @@ def load_gguf(path: str, load_tensors: bool = True):
         return metadata, tensors
 
 
+_QUANTIZERS = {"q8_0": (GGML_Q8_0, quantize_q8_0),
+               "q4_0": (GGML_Q4_0, quantize_q4_0)}
+
+
 def save_gguf(path: str, metadata: dict[str, Any],
-              tensors: dict[str, np.ndarray]) -> None:
-    """Writer (testing + export parity with neural/export_to_gguf.py)."""
+              tensors: dict[str, np.ndarray],
+              quantize: Optional[dict[str, str]] = None,
+              raw_tensors: Optional[dict[str, tuple]] = None) -> None:
+    """Writer (testing + export parity with neural/export_to_gguf.py).
+
+    quantize: {tensor name: 'q8_0'|'q4_0'} encodes those tensors as blocks.
+    raw_tensors: {name: (ggml_type, shape, raw_bytes)} writes pre-encoded
+    blobs verbatim (synthetic quantized fixtures for tests)."""
     alignment = int(metadata.get("general.alignment", 32))
+    quantize = quantize or {}
+    raw_tensors = raw_tensors or {}
     with open(path, "wb") as f:
         f.write(MAGIC)
         f.write(struct.pack("<I", 3))
-        f.write(struct.pack("<QQ", len(tensors), len(metadata)))
+        f.write(struct.pack("<QQ", len(tensors) + len(raw_tensors),
+                            len(metadata)))
         for key, value in metadata.items():
             _write_str(f, key)
             _write_value(f, value)
         offset = 0
         blobs = []
+
+        def emit(name, shape, dtype, blob):
+            nonlocal offset
+            _write_str(f, name)
+            dims = tuple(reversed(shape))
+            f.write(struct.pack("<I", len(dims)))
+            f.write(struct.pack(f"<{len(dims)}Q", *dims))
+            f.write(struct.pack("<IQ", dtype, offset))
+            blobs.append(blob)
+            offset += len(blob)
+            offset += (-offset) % alignment
+
         for name, arr in tensors.items():
             arr = np.asarray(arr)
+            if name in quantize:
+                dtype, enc = _QUANTIZERS[quantize[name]]
+                emit(name, arr.shape, dtype, enc(arr))
+                continue
             if arr.dtype == np.float16:
                 dtype = GGML_F16
             else:
                 arr = arr.astype(np.float32)
                 dtype = GGML_F32
-            _write_str(f, name)
-            dims = tuple(reversed(arr.shape))
-            f.write(struct.pack("<I", len(dims)))
-            f.write(struct.pack(f"<{len(dims)}Q", *dims))
-            f.write(struct.pack("<IQ", dtype, offset))
-            blob = arr.tobytes()
-            blobs.append(blob)
-            offset += len(blob)
-            offset += (-offset) % alignment
+            emit(name, arr.shape, dtype, arr.tobytes())
+        for name, (dtype, shape, blob) in raw_tensors.items():
+            emit(name, tuple(shape), int(dtype), bytes(blob))
         pad = (-f.tell()) % alignment
         f.write(b"\x00" * pad)
         for blob in blobs:
