@@ -7,6 +7,7 @@ Two communication planes (SURVEY.md §5 "Distributed communication backend"):
     (nornicdb_tpu.replication) — mirrors pkg/replication/transport.go.
 """
 
+from nornicdb_tpu.parallel.dp_embed import DataParallelEmbedder
 from nornicdb_tpu.parallel.mesh import (
     data_sharding,
     local_device_count,
@@ -20,6 +21,7 @@ from nornicdb_tpu.parallel.ring_attention import (
 from nornicdb_tpu.parallel.sharded_index import ShardedCorpus
 
 __all__ = [
+    "DataParallelEmbedder",
     "data_sharding",
     "local_device_count",
     "make_mesh",

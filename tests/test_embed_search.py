@@ -476,3 +476,52 @@ class TestNamespacedCounts:
         assert a.node_count() == 4
         assert a.edge_count() == 0
         assert b.node_count() == 1
+
+
+class TestBucketedBatching:
+    """Round-2 measured batching policy (PROGRESS table): length buckets +
+    batch classes, bounded jit cache, order-stable output."""
+
+    def test_mixed_lengths_order_stable(self):
+        import numpy as np
+
+        from nornicdb_tpu.embed import TPUEmbedder
+
+        e = TPUEmbedder()
+        texts = ["short", "medium one two three four five six",
+                 " ".join(["w"] * 100), "tiny", " ".join(["x"] * 400)]
+        out = e.embed_batch(texts)
+        assert len(out) == len(texts)
+        assert all(o.shape == (e.cfg.dims,) for o in out)
+        # same text -> same vector regardless of batch composition
+        solo = e.embed_batch([texts[2]])[0]
+        assert np.allclose(out[2], solo, atol=1e-5)
+
+    def test_batch_classes_bound_compile_shapes(self):
+        from nornicdb_tpu.embed import TPUEmbedder
+
+        e = TPUEmbedder(opt_batch=8)
+        assert e._batch_class(1) == 1
+        assert e._batch_class(3) == 4
+        assert e._batch_class(8) == 8
+        assert e._batch_class(100) == 8  # capped at opt_batch
+        assert e._bucket_len(5) == 32
+        assert e._bucket_len(33) == 64
+        assert e._bucket_len(513) == e.max_len
+
+    def test_data_parallel_embedder_on_mesh(self):
+        import numpy as np
+
+        from nornicdb_tpu.embed import TPUEmbedder
+        from nornicdb_tpu.parallel import DataParallelEmbedder
+
+        inner = TPUEmbedder()
+        dp = DataParallelEmbedder(inner, n_devices=4)
+        assert dp.n_devices == 4
+        texts = [f"document number {i} " + "w " * (i * 7 % 40)
+                 for i in range(10)]  # 10 rows pad to 12 over 4 devices
+        out = dp.embed_batch(texts)
+        assert len(out) == 10
+        ref = inner.embed_batch(texts)
+        for a, b in zip(out, ref):
+            assert np.allclose(a, b, atol=1e-4)
