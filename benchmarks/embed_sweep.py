@@ -1,0 +1,66 @@
+"""Short-seq bge-m3 throughput sweep on the real chip.
+
+Fills in the T=64/128/256 rows deferred in PROGRESS.md (relay went down
+mid-sweep in the earlier session). Uses the same measurement protocol as
+the original sweep: random ids at the target length, bf16 params, 4
+scan iterations per timed call, best-of-3, D2H fence (the axon relay's
+block_until_ready returns early).
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from nornicdb_tpu.models.bge_m3 import BgeConfig, forward, init_params
+
+
+def measure(cfg: BgeConfig, params, B: int, T: int, iters: int = 4, reps: int = 3):
+    ids = jnp.asarray(np.random.randint(0, cfg.vocab_size, (B, T)), jnp.int32)
+    mask = jnp.ones((B, T), jnp.int32)
+
+    @jax.jit
+    def run(ids, mask):
+        def body(c, _):
+            out = forward(params, cfg, ids, mask)
+            return c + out.mean(), None
+        acc, _ = jax.lax.scan(body, jnp.float32(0), None, length=iters)
+        return acc
+
+    _ = np.asarray(run(ids, mask))  # compile + warm
+    best = float("inf")
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        _ = np.asarray(run(ids, mask))  # D2H fence
+        best = min(best, (time.perf_counter() - t0) / iters)
+    toks = B * T / best
+    return toks, toks / T  # tok/s, emb/s at this doc length
+
+
+def main():
+    cfg = BgeConfig()
+    params = init_params(cfg, jax.random.PRNGKey(0))
+    params = jax.tree.map(lambda x: x.astype(jnp.bfloat16), params)
+    print(f"device={jax.devices()[0]}")
+    print("| B | T | tok/s | emb/s |")
+    print("|---|---|---|---|")
+    for T in (64, 128, 256, 512):
+        for B in (32, 64, 128, 256):
+            if B * T > 32 * 512 * 4:  # keep activation memory bounded
+                continue
+            try:
+                toks, embs = measure(cfg, params, B, T)
+                print(f"| {B} | {T} | {toks/1e3:.1f}k | {embs:.0f} |", flush=True)
+            except Exception as e:  # OOM etc. — record and continue
+                print(f"| {B} | {T} | ERR {type(e).__name__} | - |", flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -17,6 +17,7 @@ import hashlib
 import hmac
 import json
 import os
+import re
 import secrets
 import threading
 import time
@@ -132,11 +133,17 @@ class Authenticator:
     def _user_node_id(self, username: str) -> str:
         return f"user-{username}"
 
+    _USERNAME_RE = re.compile(r"^[A-Za-z0-9._@-]{1,64}$")
+
     def create_user(
         self, username: str, password: str, role: str = ROLE_VIEWER
     ) -> User:
         if role not in ROLE_PERMISSIONS:
             raise AuthError(f"unknown role {role}")
+        if not self._USERNAME_RE.match(username):
+            raise AuthError(
+                "invalid username (allowed: letters, digits, . _ @ -, max 64)"
+            )
         user = User(username=username, role=role, password_hash=hash_password(password))
         node = Node(
             id=self._user_node_id(username),
@@ -208,6 +215,16 @@ class Authenticator:
         self._save_user(user)
         self._audit("password_changed", {"username": username})
 
+    def set_disabled(self, username: str, disabled: bool) -> None:
+        """(ref: DisableUser/EnableUser, server_auth.go handleUserByID PUT)"""
+        user = self.get_user(username)
+        user.disabled = disabled
+        self._save_user(user)
+        self._audit(
+            "user_disabled" if disabled else "user_enabled",
+            {"username": username},
+        )
+
     def set_role(self, username: str, role: str) -> None:
         if role not in ROLE_PERMISSIONS:
             raise AuthError(f"unknown role {role}")
@@ -218,10 +235,13 @@ class Authenticator:
 
     # -- authentication -----------------------------------------------------------
     def check_password(self, username: str, password: str) -> bool:
+        """Side-effect-free verification (no lockout counters, no audit
+        login events, no token minting) — for password-change flows."""
         try:
-            return self.authenticate(username, password) is not None
+            user = self.get_user(username)
         except AuthError:
             return False
+        return verify_password(password, user.password_hash)
 
     def authenticate(self, username: str, password: str) -> str:
         """Returns a JWT on success (ref: Authenticate auth.go:970)."""
@@ -250,14 +270,16 @@ class Authenticator:
         return token
 
     # -- JWT ---------------------------------------------------------------------
-    def issue_token(self, username: str, role: str) -> str:
+    def issue_token(
+        self, username: str, role: str, ttl: Optional[float] = None
+    ) -> str:
         header = {"alg": "HS256", "typ": "JWT"}
         now = int(time.time())
         payload = {
             "sub": username,
             "role": role,
             "iat": now,
-            "exp": now + int(self.config.token_ttl),
+            "exp": now + int(ttl if ttl is not None else self.config.token_ttl),
             "jti": secrets.token_hex(8),
         }
         h = _b64(json.dumps(header, separators=(",", ":")).encode())
@@ -296,6 +318,15 @@ class Authenticator:
         payload = self.validate_token(token)
         if payload is None:
             raise AuthError("invalid or expired token")
+        # cut off live sessions of disabled accounts: a still-valid JWT for
+        # a user the admin has since disabled must stop authorizing (API
+        # tokens whose subject isn't a stored user are unaffected)
+        try:
+            user = self.get_user(payload.get("sub", ""))
+        except AuthError:
+            user = None
+        if user is not None and user.disabled:
+            raise AuthError("account disabled")
         if not self.has_permission(payload.get("role", ROLE_NONE), permission):
             raise AuthError(f"permission {permission} denied")
         return payload

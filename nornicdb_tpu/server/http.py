@@ -155,7 +155,13 @@ class HttpServer:
             def log_message(self, *args):  # quiet
                 pass
 
-            def _send(self, code: int, body: Any, content_type="application/json"):
+            def _send(
+                self,
+                code: int,
+                body: Any,
+                content_type="application/json",
+                extra_headers: Optional[dict[str, str]] = None,
+            ):
                 data = (
                     json.dumps(body).encode()
                     if content_type == "application/json"
@@ -164,6 +170,8 @@ class HttpServer:
                 self.send_response(code)
                 self.send_header("Content-Type", content_type)
                 self.send_header("Content-Length", str(len(data)))
+                for k, v in (extra_headers or {}).items():
+                    self.send_header(k, v)
                 self.send_header("Access-Control-Allow-Origin", "*")
                 # security headers (ref: pkg/security/middleware.go)
                 self.send_header("X-Content-Type-Options", "nosniff")
@@ -197,7 +205,20 @@ class HttpServer:
                         raise AuthError("malformed Basic auth")
                     token = auth.authenticate(user, pw)
                     return auth.authorize(token, permission)
+                # browser sessions authenticate via the HttpOnly cookie set
+                # by POST /auth/token (ref: server_auth.go handleToken's
+                # SetCookie("nornicdb_token", ...))
+                token = self._cookie_token()
+                if token:
+                    return auth.authorize(token, permission)
                 raise AuthError("authentication required")
+
+            def _cookie_token(self) -> str:
+                for part in (self.headers.get("Cookie") or "").split(";"):
+                    k, _, v = part.strip().partition("=")
+                    if k == "nornicdb_token":
+                        return v
+                return ""
 
             def do_OPTIONS(self):  # CORS preflight
                 self.send_response(204)
@@ -231,6 +252,8 @@ class HttpServer:
                         server_self._route_get(self)
                     elif method == "POST":
                         server_self._route_post(self)
+                    elif path.startswith("/auth/users/"):
+                        server_self._route_user_by_name(self, method, path)
                     else:
                         self._send(405, {"error": f"{method} not allowed on {path}"})
                 except AuthError as e:
@@ -269,9 +292,11 @@ class HttpServer:
     # -- GET routes --------------------------------------------------------------
     def _route_get(self, h) -> None:
         path = h.path.split("?")[0]
-        if path in ("/", "/ui", "/browser"):
-            # embedded console (ref: ui/embed.go — SPA at the root; set
-            # serve_ui=False for the reference's -tags noui equivalent)
+        if path in ("/", "/ui", "/browser", "/login", "/security", "/admin"):
+            # embedded console (ref: ui/embed.go — SPA at the root, with
+            # deep links /login and /security served by the same handler,
+            # server_router.go:59-64; set serve_ui=False for the
+            # reference's -tags noui equivalent)
             if not self.serve_ui:
                 h._send(404, {"error": "ui disabled"})
                 return
@@ -326,6 +351,104 @@ class HttpServer:
             return
         if path == "/metrics":
             h._send(200, self._prometheus(), content_type="text/plain; version=0.0.4")
+            return
+        if path == "/auth/config":
+            # UI bootstrap: is auth on, which OAuth providers exist
+            # (ref: server_auth.go:215 handleAuthConfig)
+            providers = []
+            if os.environ.get("NORNICDB_AUTH_PROVIDER") == "oauth":
+                providers.append(
+                    {
+                        "name": "oauth",
+                        "url": "/auth/oauth/authorize",
+                        "displayName": "OAuth",
+                    }
+                )
+            h._send(
+                200,
+                {
+                    "devLoginEnabled": True,
+                    "securityEnabled": bool(
+                        self.auth_required and self.authenticator is not None
+                    ),
+                    "oauthProviders": providers,
+                },
+            )
+            return
+        if path == "/auth/me":
+            # current user for the UI session (ref: server_auth.go:368)
+            if not self.auth_required or self.authenticator is None:
+                h._send(
+                    200,
+                    {
+                        "id": "anonymous",
+                        "username": "anonymous",
+                        "roles": ["admin"],
+                        "enabled": True,
+                    },
+                )
+                return
+            payload = h._auth("read")
+            try:
+                user = self.authenticator.get_user(payload["sub"])
+                body = {
+                    "id": f"user-{user.username}",
+                    "username": user.username,
+                    "roles": [user.role],
+                    "created_at": user.created_at,
+                    "disabled": user.disabled,
+                }
+            except AuthError:
+                # token subject without a stored user (e.g. API token)
+                body = {
+                    "id": payload["sub"],
+                    "username": payload["sub"],
+                    "roles": [payload.get("role", "none")],
+                    "disabled": False,
+                }
+            h._send(200, body)
+            return
+        if path == "/auth/users":
+            # admin user list (ref: server_auth.go:549 handleUsers GET)
+            h._auth("user_manage")
+            if self.authenticator is None:
+                h._send(503, {"error": "auth not configured"})
+                return
+            h._send(
+                200,
+                [
+                    {
+                        "username": u.username,
+                        "roles": [u.role],
+                        "created_at": u.created_at,
+                        "disabled": u.disabled,
+                    }
+                    for u in self.authenticator.list_users()
+                ],
+            )
+            return
+        if path.startswith("/auth/users/"):
+            h._auth("user_manage")
+            if self.authenticator is None:
+                h._send(503, {"error": "auth not configured"})
+                return
+            from urllib.parse import unquote
+
+            name = unquote(path[len("/auth/users/"):])
+            try:
+                u = self.authenticator.get_user(name)
+            except AuthError:
+                h._send(404, {"error": f"user {name} not found"})
+                return
+            h._send(
+                200,
+                {
+                    "username": u.username,
+                    "roles": [u.role],
+                    "created_at": u.created_at,
+                    "disabled": u.disabled,
+                },
+            )
             return
         if path == "/admin/stats":
             h._auth("admin")
@@ -474,6 +597,103 @@ class HttpServer:
             )
             h._send(200, {"token": token})
             return
+        if path == "/auth/token":
+            # browser login: JWT in body + HttpOnly session cookie
+            # (ref: server_auth.go:19 handleToken)
+            body = h._body()
+            if self.authenticator is None:
+                h._send(503, {"error": "auth not configured"})
+                return
+            grant = body.get("grant_type", "")
+            if grant and grant != "password":
+                h._send(400, {"error": "unsupported grant_type"})
+                return
+            token = self.authenticator.authenticate(
+                body.get("username", ""), body.get("password", "")
+            )
+            h._send(
+                200,
+                {
+                    "access_token": token,
+                    "token_type": "Bearer",
+                    "expires_in": int(self.authenticator.config.token_ttl),
+                },
+                extra_headers={
+                    "Set-Cookie": (
+                        f"nornicdb_token={token}; Path=/; HttpOnly; "
+                        f"SameSite=Lax; Max-Age={7 * 86400}"
+                    )
+                },
+            )
+            return
+        if path == "/auth/password":
+            # change own password, old password re-verified
+            # (ref: server_auth.go handleChangePassword, PermRead-gated)
+            payload = h._auth("read")
+            if self.authenticator is None:
+                h._send(503, {"error": "auth not configured"})
+                return
+            body = h._body()
+            username = payload["sub"]
+            if not self.authenticator.check_password(
+                username, body.get("old_password", "")
+            ):
+                h._send(401, {"error": "current password incorrect"})
+                return
+            new = body.get("new_password", "")
+            if len(new) < 4:
+                h._send(400, {"error": "new password too short"})
+                return
+            self.authenticator.set_password(username, new)
+            h._send(200, {"status": "password changed"})
+            return
+        if path == "/auth/api-token":
+            # admin-only stateless API token with a subject label, for MCP
+            # servers etc. (ref: server_auth.go handleGenerateAPIToken)
+            payload = h._auth("admin")
+            if self.authenticator is None:
+                h._send(503, {"error": "auth not configured"})
+                return
+            body = h._body()
+            subject = body.get("subject") or "api-token"
+            ttl = float(body.get("expires_in") or 365 * 86400)
+            token = self.authenticator.issue_token(
+                subject, payload.get("role", "admin"), ttl=ttl
+            )
+            h._send(
+                200,
+                {
+                    "token": token,
+                    "subject": subject,
+                    "expires_in": int(ttl),
+                    "token_type": "Bearer",
+                },
+            )
+            return
+        if path == "/auth/users":
+            # create user (ref: server_auth.go:549 handleUsers POST)
+            h._auth("user_manage")
+            if self.authenticator is None:
+                h._send(503, {"error": "auth not configured"})
+                return
+            body = h._body()
+            roles = body.get("roles") or [body.get("role", "viewer")]
+            try:
+                u = self.authenticator.create_user(
+                    body.get("username", ""), body.get("password", ""), roles[0]
+                )
+            except AuthError as e:
+                h._send(400, {"error": str(e)})
+                return
+            h._send(
+                201,
+                {
+                    "username": u.username,
+                    "roles": [u.role],
+                    "created_at": u.created_at,
+                },
+            )
+            return
         if path == "/gdpr/export":
             # GDPR data export (ref: server_router.go /gdpr/export)
             h._auth("read")
@@ -546,8 +766,16 @@ class HttpServer:
         if path == "/auth/logout":
             body = h._body()
             if self.authenticator is not None:
-                self.authenticator.logout(body.get("token", ""))
-            h._send(200, {"ok": True})
+                token = body.get("token", "") or h._cookie_token()
+                self.authenticator.logout(token)
+            # clear the browser session cookie (ref: handleLogout MaxAge=-1)
+            h._send(
+                200,
+                {"ok": True},
+                extra_headers={
+                    "Set-Cookie": "nornicdb_token=; Path=/; HttpOnly; Max-Age=0"
+                },
+            )
             return
         if path == "/mcp":
             h._auth("write")
@@ -575,6 +803,49 @@ class HttpServer:
             h._send(200, self.db.heimdall.chat(messages, max_tokens))
             return
         h._send(404, {"error": f"not found: {path}"})
+
+    def _route_user_by_name(self, h, method: str, path: str) -> None:
+        """PUT (roles/disabled) and DELETE for /auth/users/{name}
+        (ref: server_auth.go handleUserByID)."""
+        h._auth("user_manage")
+        if self.authenticator is None:
+            h._send(503, {"error": "auth not configured"})
+            return
+        from urllib.parse import unquote
+
+        from nornicdb_tpu.auth.auth import ROLE_PERMISSIONS
+
+        name = unquote(path[len("/auth/users/"):])
+        auth = self.authenticator
+        if method == "DELETE":
+            try:
+                auth.delete_user(name)
+            except AuthError:
+                h._send(404, {"error": f"user {name} not found"})
+                return
+            h._send(200, {"status": "deleted"})
+            return
+        if method == "PUT":
+            body = h._body()
+            roles = body.get("roles") or (
+                [body["role"]] if body.get("role") else []
+            )
+            # validation errors are 400; a missing user is 404
+            if roles and roles[0] not in ROLE_PERMISSIONS:
+                h._send(400, {"error": f"unknown role {roles[0]}"})
+                return
+            try:
+                auth.get_user(name)  # existence check up front, atomically-ish
+                if roles:
+                    auth.set_role(name, roles[0])
+                if body.get("disabled") is not None:
+                    auth.set_disabled(name, bool(body["disabled"]))
+            except AuthError as e:
+                h._send(404, {"error": str(e)})
+                return
+            h._send(200, {"status": "updated"})
+            return
+        h._send(405, {"error": f"{method} not allowed on {path}"})
 
     def _tx_commit(self, h, database: str, body: dict) -> None:
         """Neo4j HTTP transaction API (ref: server_db.go)."""
