@@ -48,12 +48,12 @@ def main():
     cfg = BgeConfig()
     params = init_params(cfg, jax.random.PRNGKey(0))
     params = jax.tree.map(lambda x: x.astype(jnp.bfloat16), params)
-    print(f"device={jax.devices()[0]}")
-    print("| B | T | tok/s | emb/s |")
-    print("|---|---|---|---|")
-    for T in (64, 128, 256, 512):
-        for B in (32, 64, 128, 256):
-            if B * T > 32 * 512 * 4:  # keep activation memory bounded
+    print(f"device={jax.devices()[0]}", flush=True)
+    print("| B | T | tok/s | emb/s |", flush=True)
+    print("|---|---|---|---|", flush=True)
+    for T in (64, 128, 256):
+        for B in (32, 64, 128):
+            if B * T > 32 * 512 * 2:  # keep activation memory bounded
                 continue
             try:
                 toks, embs = measure(cfg, params, B, T)

@@ -235,8 +235,18 @@ class Authenticator:
 
     # -- authentication -----------------------------------------------------------
     def check_password(self, username: str, password: str) -> bool:
+        """Full login-semantics check for protocol authentication (Bolt,
+        Qdrant gRPC): enforces disabled accounts, lockout counters, and
+        audit events exactly like authenticate()."""
+        try:
+            return self.authenticate(username, password) is not None
+        except AuthError:
+            return False
+
+    def verify_current_password(self, username: str, password: str) -> bool:
         """Side-effect-free verification (no lockout counters, no audit
-        login events, no token minting) — for password-change flows."""
+        login events, no token minting) — for password-change flows where
+        the caller already holds an authorized session."""
         try:
             user = self.get_user(username)
         except AuthError:

@@ -28,6 +28,7 @@ from nornicdb_tpu.cypher.expr import EvalContext, evaluate
 from nornicdb_tpu.cypher.functions import FUNCTIONS, is_aggregate
 from nornicdb_tpu.cypher.matcher import PatternMatcher, make_path
 from nornicdb_tpu.cypher.parser import parse
+from nornicdb_tpu.cypher.validator import strict_mode_enabled, validate
 from nornicdb_tpu.errors import (
     CypherSyntaxError,
     CypherTypeError,
@@ -108,6 +109,10 @@ class CypherExecutor:
         self._last_call_columns: list[str] = []
         self.query_count = 0
         self._colindex: Any = None  # lazy ColumnarScanIndex; False = unusable
+        # opt-in strict OpenCypher semantic validation (ref: the ANTLR
+        # validation mode, executor.go:1572-1584, NORNICDB_PARSER=antlr;
+        # here NORNICDB_PARSER=strict, with `antlr` accepted as an alias)
+        self.strict_validation = strict_mode_enabled()
 
     def _scan_index(self):
         """Lazily attach the event-maintained columnar scan index
@@ -140,6 +145,8 @@ class CypherExecutor:
         self.query_count += 1
         params = params or {}
         stmt = parse(query)
+        if self.strict_validation:
+            validate(stmt)
         if self.cache is not None and isinstance(stmt, ast.Query):
             write = _is_write_query(stmt)
             if self._tx_undo is not None and not write:

@@ -875,6 +875,25 @@ class Parser:
         self.expect_op("}")
         return ast.MapProjection(subject, items)
 
+    def try_parse_quantifier(self, kind: str) -> Optional[ast.Quantifier]:
+        """all/any/none/single(x IN list WHERE p); rewinds and returns
+        None if the parenthesized body isn't quantifier-shaped (then the
+        name parses as an ordinary function call)."""
+        save = self.pos
+        try:
+            self.advance()
+            self.expect_op("(")
+            var = self.expect_ident()
+            self.expect_kw("IN")
+            src = self.parse_expr()
+            self.expect_kw("WHERE")
+            pred = self.parse_expr()
+            self.expect_op(")")
+            return ast.Quantifier(kind, var, src, pred)
+        except CypherSyntaxError:
+            self.pos = save
+            return None
+
     def parse_atom(self) -> ast.Expr:
         t = self.cur
         if t.kind == "NUMBER":
@@ -910,6 +929,11 @@ class Parser:
                 return self.parse_count_atom()
             if t.value == "EXISTS" and self.peek().value in ("(", "{"):
                 return self.parse_exists_atom()
+            if t.value == "ALL" and self.peek().value == "(":
+                # ALL is a keyword (UNION ALL) but also the all() quantifier
+                q = self.try_parse_quantifier("all")
+                if q is not None:
+                    return q
             if t.value in ("ALL", "NOT"):
                 pass  # handled elsewhere
             if t.value == "SHORTESTPATH" or t.value == "ALLSHORTESTPATHS":
@@ -925,19 +949,9 @@ class Parser:
             # quantifiers: all/any/none/single(x IN list WHERE p)
             low = t.value.lower()
             if low in ("all", "any", "none", "single") and self.peek().value == "(":
-                save = self.pos
-                try:
-                    self.advance()
-                    self.expect_op("(")
-                    var = self.expect_ident()
-                    self.expect_kw("IN")
-                    src = self.parse_expr()
-                    self.expect_kw("WHERE")
-                    pred = self.parse_expr()
-                    self.expect_op(")")
-                    return ast.Quantifier(low, var, src, pred)
-                except CypherSyntaxError:
-                    self.pos = save
+                q = self.try_parse_quantifier(low)
+                if q is not None:
+                    return q
             # function call (possibly dotted)
             if self.peek().kind == "OP" and self.peek().value in ("(", "."):
                 save = self.pos

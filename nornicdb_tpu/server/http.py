@@ -635,7 +635,7 @@ class HttpServer:
                 return
             body = h._body()
             username = payload["sub"]
-            if not self.authenticator.check_password(
+            if not self.authenticator.verify_current_password(
                 username, body.get("old_password", "")
             ):
                 h._send(401, {"error": "current password incorrect"})
