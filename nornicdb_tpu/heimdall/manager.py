@@ -22,10 +22,21 @@ import time
 from dataclasses import dataclass, field
 from typing import Any, Callable, Iterator, Optional
 
-# model kinds (ref: types.go:20-37)
-MODEL_EMBEDDING = "embedding"
-MODEL_REASONING = "reasoning"
-MODEL_CLASSIFICATION = "classification"
+from nornicdb_tpu.heimdall.context import (
+    GenerateParams,
+    PromptContext,
+    PromptExample,
+    estimate_tokens,
+)
+from nornicdb_tpu.heimdall.registry import (
+    MODEL_CLASSIFICATION,
+    MODEL_EMBEDDING,
+    MODEL_REASONING,
+    EventDispatcher,
+    MetricsRegistry,
+    ModelInfo,
+    ModelRegistry,
+)
 
 
 @dataclass
@@ -135,6 +146,15 @@ class TemplateGenerator(Generator):
 ActionFn = Callable[[dict[str, Any]], Any]
 
 
+def _brief(v: Any, limit: int = 200) -> Any:
+    """Row values trimmed for chat-sized payloads."""
+    if isinstance(v, str) and len(v) > limit:
+        return v[:limit] + "…"
+    if hasattr(v, "id") and hasattr(v, "properties"):
+        return {"id": v.id, "properties": dict(v.properties)}
+    return v
+
+
 class HeimdallManager:
     """(ref: heimdall.Manager scheduler.go:178)"""
 
@@ -149,19 +169,66 @@ class HeimdallManager:
         self.db = db
         self.bifrost = Bifrost()
         self.metrics = HeimdallMetrics()
+        # named-metrics registry with Prometheus rendering
+        # (ref: pkg/heimdall/metrics.go)
+        self.metrics_registry = MetricsRegistry()
+        # model registry; the construction generator is the default
+        # reasoning model (ref: ModelInfo types.go:32, scheduler model pick)
+        self.models = ModelRegistry()
+        self.models.register(
+            ModelInfo(name="heimdall", type=MODEL_REASONING,
+                      backend=generator, loaded=True),
+            default=True,
+        )
+        # async DB-event fan-out to plugins (ref: plugin.go:1345
+        # dbEventDispatcher — bounded queue + background thread)
+        self.events = EventDispatcher()
         self._actions: dict[str, ActionFn] = {}
+        self._action_descriptions: dict[str, str] = {}
+        # plugin-installed hooks that mutate the per-request PromptContext
+        # (ref: PrePrompt receiving *PromptContext, plugin.go)
+        self.context_hooks: list[Callable[[PromptContext], None]] = []
+        # default few-shot examples (ref: handler.go:324 example injection)
+        self.default_examples: list[PromptExample] = [
+            PromptExample("how many nodes are there?",
+                          '{"action": "query", "params": {"cypher": '
+                          '"MATCH (n) RETURN count(n)"}}'),
+            PromptExample("is the database healthy?",
+                          '{"action": "status", "params": {}}'),
+        ]
         # a PluginHost installs itself here so chat-path actions run through
         # the pre/post-execute hooks (incl. veto)
         self.action_dispatcher: Optional[Callable[[dict], Any]] = None
+        self.plugin_host = None  # set by PluginHost.__init__
         self._lock = threading.Lock()
         # built-in actions (ref: plugins/heimdall reference plugin actions)
-        self.register_action("status", self._action_status)
-        self.register_action("hello", lambda p: {"message": "Heimdall online"})
+        self.register_action("status", self._action_status,
+                             "Report database health and entity counts")
+        self.register_action(
+            "hello", lambda p: {"message": "Heimdall online"},
+            "Liveness check",
+        )
+        self.register_action("query", self._action_query,
+                             "Run a Cypher query: params {cypher: string}")
 
     # -- actions (ref: plugin.go ActionFunc) ---------------------------------
-    def register_action(self, name: str, fn: ActionFn) -> None:
+    def register_action(
+        self, name: str, fn: ActionFn, description: str = ""
+    ) -> None:
         with self._lock:
             self._actions[name] = fn
+            if description:
+                self._action_descriptions[name] = description
+
+    def action_prompt(self) -> str:
+        """Registered-action catalog injected (immutably) into every
+        prompt (ref: PromptContext.ActionPrompt types.go:294)."""
+        with self._lock:
+            lines = []
+            for name in sorted(self._actions):
+                desc = self._action_descriptions.get(name, "")
+                lines.append(f"- {name}: {desc}" if desc else f"- {name}")
+        return "\n".join(lines)
 
     def _action_status(self, params: dict) -> dict:
         out = {"status": "ok"}
@@ -169,6 +236,30 @@ class HeimdallManager:
             out["nodes"] = self.db.storage.node_count()
             out["edges"] = self.db.storage.edge_count()
         return out
+
+    def _action_query(self, params: dict) -> dict:
+        """Cypher pass-through action (ref: heimdall.watcher.query in the
+        reference plugin + the CypherPrimer ACTION MODE examples).
+
+        Read-only: the chat endpoint is gated at read scope
+        (http.py h._auth("read")), so a model steered into emitting a
+        write statement must not become a privilege escalation — write-
+        classified Cypher is refused here, mirroring the per-statement
+        gate on /db/{db}/tx/commit."""
+        if self.db is None:
+            return {"error": "no database attached"}
+        cypher = str(params.get("cypher", "")).strip()
+        if not cypher:
+            return {"error": "params.cypher required"}
+        from nornicdb_tpu.cypher.executor import classify_query_text
+
+        if classify_query_text(cypher) == "write":
+            return {"error": "query action is read-only; use the Cypher "
+                             "API for writes"}
+        result = self.db.cypher(cypher)
+        rows = [[_brief(v) for v in row] for row in result.rows[:50]]
+        return {"columns": result.columns, "rows": rows,
+                "row_count": len(result.rows)}
 
     @staticmethod
     def try_parse_action(text: str) -> Optional[dict[str, Any]]:
@@ -201,10 +292,17 @@ class HeimdallManager:
         return None
 
     # -- generation (ref: Generate scheduler.go:178) ---------------------------
-    def generate(self, prompt: str, max_tokens: int = 128) -> str:
+    def generate(self, prompt: str, max_tokens: int = 128,
+                 generator: Optional[Generator] = None) -> str:
+        """One generation with metric/error accounting. PluginHost wraps
+        this method to apply pre_prompt hooks — any alternate-model path
+        must also flow through here, never call a backend directly, or
+        plugin guards (redaction, veto) become evadable by picking a
+        different registered model."""
         t0 = time.time()
+        backend = generator if generator is not None else self.generator
         try:
-            out = self.generator.generate(prompt, max_tokens)
+            out = backend.generate(prompt, max_tokens)
             self.metrics.generations += 1
             self.metrics.tokens_generated += len(out.split())
             return out
@@ -214,14 +312,98 @@ class HeimdallManager:
         finally:
             self.metrics.total_latency += time.time() - t0
 
-    def chat(self, messages: list[dict[str, str]], max_tokens: int = 128) -> dict:
+    def build_context(
+        self, messages: list[dict[str, str]]
+    ) -> PromptContext:
+        """Assemble the per-request PromptContext: immutable action
+        catalog, default examples, DB context, then plugin hooks
+        (ref: handler.go:207-340 prompt assembly + PrePrompt)."""
+        user_message = ""
+        for m in reversed(messages):
+            if m.get("role", "user") == "user":
+                user_message = m.get("content", "")
+                break
+        ctx = PromptContext(
+            user_message=user_message,
+            messages=messages,
+            action_prompt=self.action_prompt(),
+        )
+        ctx.bifrost = self.bifrost
+        ctx.examples.extend(self.default_examples)
+        if self.db is not None:
+            # DB context injection (ref: handler.go DatabaseReader):
+            # schema-level summary the model can ground answers in
+            try:
+                ctx.additional_instructions = (
+                    f"Current graph: {self.db.storage.node_count()} nodes, "
+                    f"{self.db.storage.edge_count()} relationships."
+                )
+            except Exception:
+                pass
+        for hook in list(self.context_hooks):
+            try:
+                hook(ctx)
+            except Exception:
+                pass
+            if ctx.cancelled:
+                break
+        return ctx
+
+    def chat(
+        self,
+        messages: list[dict[str, str]],
+        max_tokens: int = 128,
+        model: Optional[str] = None,
+        temperature: Optional[float] = None,
+    ) -> dict:
         """OpenAI-compatible chat completion (ref: handleChatCompletions
         handler.go:207) + action execution."""
-        prompt_parts = [self.SYSTEM_PROMPT]
+        ctx = self.build_context(messages)
+        if ctx.cancelled:
+            # a PrePrompt hook aborted the request (ref: Cancel types.go:343)
+            self.metrics_registry.inc("requests_cancelled")
+            return {
+                "id": f"chatcmpl-{ctx.request_id}",
+                "object": "chat.completion",
+                "model": model or "heimdall",
+                "choices": [{
+                    "index": 0,
+                    "message": {
+                        "role": "assistant",
+                        "content": f"Request cancelled: {ctx.cancel_reason}",
+                    },
+                    "finish_reason": "cancelled",
+                }],
+                "cancelled_by": ctx.cancelled_by,
+            }
+        prompt_parts = [ctx.build_final_prompt()]
         for m in messages:
             prompt_parts.append(f"{m.get('role', 'user')}: {m.get('content', '')}")
         prompt_parts.append("assistant:")
-        text = self.generate("\n".join(prompt_parts), max_tokens)
+        prompt = "\n".join(prompt_parts)
+        # model selection through the registry (ref: ChatRequest.Model)
+        generator = self.generator
+        if model and model not in ("heimdall", ""):
+            info = self.models.get(model)
+            if info is None:
+                return {"error": {
+                    "message": f"model {model!r} not found",
+                    "type": "invalid_request_error",
+                }}
+            generator = self.models.acquire(model)
+            if generator is None:
+                return {"error": {
+                    "message": f"model {model!r} has no loaded backend",
+                    "type": "invalid_request_error",
+                }}
+        else:
+            self.models.acquire("heimdall")
+        text = self._generate_with(generator, prompt, max_tokens)
+        prompt_toks = estimate_tokens(prompt)
+        completion_toks = estimate_tokens(text)
+        self.metrics_registry.inc("chat_requests")
+        self.metrics_registry.inc("prompt_tokens", prompt_toks)
+        self.metrics_registry.inc("completion_tokens", completion_toks)
         action_result = None
         action = self.try_parse_action(text)
         if action is not None:
@@ -241,9 +423,10 @@ class HeimdallManager:
                         action_result = {"error": str(e)}
         self.bifrost.broadcast("chat", {"content": text[:200]})
         response = {
-            "id": f"chatcmpl-{int(time.time() * 1000)}",
+            "id": f"chatcmpl-{ctx.request_id}",
             "object": "chat.completion",
-            "model": "heimdall",
+            "model": model or "heimdall",
+            "created": int(ctx.request_time),
             "choices": [
                 {
                     "index": 0,
@@ -251,15 +434,49 @@ class HeimdallManager:
                     "finish_reason": "stop",
                 }
             ],
+            # (ref: ChatUsage types.go:80)
+            "usage": {
+                "prompt_tokens": prompt_toks,
+                "completion_tokens": completion_toks,
+                "total_tokens": prompt_toks + completion_toks,
+            },
         }
+        notes = ctx.drain_notifications()
+        if notes:
+            response["notifications"] = [vars(n) for n in notes]
         if action_result is not None:
             response["action_result"] = action_result
         return response
 
+    def _generate_with(self, generator, prompt: str, max_tokens: int) -> str:
+        """Dispatch through self.generate so the PluginHost wrapper (and
+        its pre_prompt hooks) applies to every backend."""
+        if generator is self.generator:
+            return self.generate(prompt, max_tokens)
+        return self.generate(prompt, max_tokens, generator=generator)
+
     def chat_stream(self, messages: list[dict[str, str]],
-                    max_tokens: int = 128) -> Iterator[dict]:
-        """Streaming chunks (ref: streaming handler.go:561)."""
-        full = self.chat(messages, max_tokens)
+                    max_tokens: int = 128, model: Optional[str] = None,
+                    ) -> Iterator[dict]:
+        """Streaming chunks (ref: streaming handler.go:561; queued
+        notifications are flushed ahead of content chunks to preserve
+        ordering, ref: notificationQueue types.go:321-324)."""
+        full = self.chat(messages, max_tokens, model=model)
+        if "choices" not in full:
+            # error response (unknown model etc.): one error event, done
+            yield {
+                "object": "chat.completion.chunk",
+                "choices": [],
+                "error": full.get("error",
+                                  {"message": "generation failed"}),
+            }
+            return
+        for note in full.pop("notifications", []):
+            yield {
+                "object": "chat.completion.chunk",
+                "choices": [],
+                "notification": note,
+            }
         content = full["choices"][0]["message"]["content"]
         words = content.split(" ")
         for i, w in enumerate(words):

@@ -47,6 +47,19 @@ class HeimdallPlugin:
     def pre_prompt(self, prompt: str) -> str:
         return prompt
 
+    def pre_prompt_context(self, ctx) -> None:
+        """Mutate the per-request PromptContext: add examples, additional
+        instructions, plugin_data; call ctx.cancel() to veto the request
+        (ref: PrePrompt receiving *PromptContext, types.go:284)."""
+
+    # observability (ref: Summary/RecentEvents in the plugin interface,
+    # plugin.go:162-164, SubsystemEvent :485)
+    def summary(self) -> str:
+        return self.description
+
+    def recent_events(self, limit: int = 10) -> list[dict]:
+        return []
+
     def pre_execute(self, action: dict[str, Any]) -> Optional[dict[str, Any]]:
         """Return modified action, or None to veto execution."""
         return action
@@ -68,12 +81,22 @@ class PluginHost:
 
     def __init__(self, manager, db=None):
         self.manager = manager
+        manager.plugin_host = self  # surfaced in /api/bifrost/status
         self.db = db
         self._lock = threading.Lock()
         self._plugins: dict[str, HeimdallPlugin] = {}
         self._info: dict[str, PluginInfo] = {}
         if db is not None:
-            db.storage.on_event(self._on_db_event)
+            # storage events flow through the manager's async dispatcher
+            # (bounded queue + worker thread — ref: plugin.go:1345
+            # dbEventDispatcher), never synchronously in the write path
+            dispatcher = getattr(manager, "events", None)
+            if dispatcher is not None:
+                dispatcher.subscribe(self._deliver_db_event)
+                dispatcher.start()
+                db.storage.on_event(self._emit_storage_event)
+            else:
+                db.storage.on_event(self._on_db_event)
         self._install_hooks()
 
     # -- registration -------------------------------------------------------
@@ -153,7 +176,8 @@ class PluginHost:
         mgr.action_dispatcher = self.run_action  # chat-path actions get hooks
         original_generate = mgr.generate
 
-        def generate_with_hooks(prompt: str, max_tokens: int = 128) -> str:
+        def generate_with_hooks(prompt: str, max_tokens: int = 128,
+                                **kwargs) -> str:
             with self._lock:
                 plugins = list(self._plugins.values())
             for p in plugins:
@@ -161,9 +185,27 @@ class PluginHost:
                     prompt = p.pre_prompt(prompt)
                 except Exception:
                     pass
-            return original_generate(prompt, max_tokens)
+            return original_generate(prompt, max_tokens, **kwargs)
 
         mgr.generate = generate_with_hooks  # type: ignore[method-assign]
+
+        # PromptContext hooks (ref: PrePrompt with *PromptContext):
+        # every plugin gets a chance to mutate/cancel the request context
+        def context_hook(ctx) -> None:
+            with self._lock:
+                plugins = list(self._plugins.values())
+            for p in plugins:
+                try:
+                    p.pre_prompt_context(ctx)
+                except Exception:
+                    pass
+                if ctx.cancelled:
+                    if not ctx.cancelled_by:
+                        ctx.cancel(ctx.cancel_reason, p.name)
+                    return
+
+        if hasattr(mgr, "context_hooks"):
+            mgr.context_hooks.append(context_hook)
 
     def run_action(self, action: dict[str, Any]) -> Any:
         """Execute an action through pre/post hooks."""
@@ -185,6 +227,34 @@ class PluginHost:
             except Exception:
                 pass
         return result
+
+    def _emit_storage_event(self, kind: str, entity: Any) -> None:
+        """Storage callback → typed DatabaseEvent on the async queue
+        (non-blocking; drop-on-full matches the reference)."""
+        dispatcher = self.manager.events
+        if hasattr(entity, "type") and hasattr(entity, "start_node"):
+            dispatcher.emit_relationship_event(
+                kind, getattr(entity, "id", ""), entity.type,
+                entity.start_node, entity.end_node,
+            )
+        else:
+            dispatcher.emit_node_event(
+                kind, getattr(entity, "id", ""),
+                list(getattr(entity, "labels", []) or []),
+            )
+
+    def _deliver_db_event(self, event) -> None:
+        """Dispatcher worker → plugin on_db_event(kind, event). Existing
+        plugins that only inspect `kind` are unaffected; the payload is
+        the typed DatabaseEvent rather than the raw Node/Edge (the async
+        boundary must not retain live storage objects)."""
+        with self._lock:
+            plugins = list(self._plugins.values())
+        for p in plugins:
+            try:
+                p.on_db_event(event.type, event)
+            except Exception:
+                pass
 
     def _on_db_event(self, kind: str, entity: Any) -> None:
         with self._lock:

@@ -450,6 +450,71 @@ class HttpServer:
                 },
             )
             return
+        if path == "/api/bifrost/status":
+            # assistant status: metrics, models, plugins
+            # (ref: server_router.go:211 -> heimdall handler status)
+            h._auth("read")
+            mgr = self.db.heimdall
+            body = {
+                "status": "ok",
+                "metrics": vars(mgr.metrics),
+                "named_metrics": mgr.metrics_registry.snapshot(),
+                "models": [m.as_dict() for m in mgr.models.list()],
+                "events": {
+                    "delivered": mgr.events.delivered,
+                    "dropped": mgr.events.dropped,
+                },
+            }
+            host = getattr(mgr, "plugin_host", None)
+            if host is not None:
+                body["plugins"] = [vars(p) for p in host.plugins()]
+            h._send(200, body)
+            return
+        if path == "/v1/models":
+            # OpenAI-compatible model listing from the registry
+            h._auth("read")
+            h._send(200, {
+                "object": "list",
+                "data": [
+                    {"id": m.name, "object": "model", "owned_by": "nornicdb",
+                     "type": m.type, "loaded": m.loaded}
+                    for m in self.db.heimdall.models.list()
+                ],
+            })
+            return
+        if path == "/api/bifrost/events":
+            # SSE notification bus (ref: server_router.go:219 -> bifrost.go)
+            h._auth("read")
+            import queue as _queue
+
+            bus = self.db.heimdall.bifrost
+            q = bus.subscribe()
+            h.send_response(200)
+            h.send_header("Content-Type", "text/event-stream")
+            h.send_header("Cache-Control", "no-cache")
+            h.send_header("Connection", "close")
+            h.end_headers()
+            try:
+                while True:
+                    try:
+                        event = q.get(timeout=15.0)
+                    except _queue.Empty:
+                        h.wfile.write(b": keepalive\n\n")
+                        h.wfile.flush()
+                        continue
+                    h.wfile.write(
+                        f"event: {event['event']}\n".encode()
+                        + b"data: " + json.dumps(
+                            event["data"], default=str
+                        ).encode() + b"\n\n"
+                    )
+                    h.wfile.flush()
+            except (BrokenPipeError, ConnectionResetError, OSError):
+                pass
+            finally:
+                bus.unsubscribe(q)
+            h.close_connection = True
+            return
         if path == "/admin/stats":
             h._auth("admin")
             stats = {
@@ -496,6 +561,12 @@ class HttpServer:
                 "# TYPE nornicdb_embeddings_failed_total counter",
                 f"nornicdb_embeddings_failed_total {s.failed}",
             ]
+        # heimdall named metrics when the assistant has been used
+        # (ref: pkg/heimdall/metrics.go Prometheus rendering)
+        if self.db._heimdall is not None:
+            rendered = self.db._heimdall.metrics_registry.render_prometheus()
+            if rendered:
+                lines.append(rendered.rstrip("\n"))
         return "\n".join(lines) + "\n"
 
     # -- POST routes ---------------------------------------------------------------
@@ -800,7 +871,29 @@ class HttpServer:
             body = h._body()
             messages = body.get("messages", [])
             max_tokens = int(body.get("max_tokens", 128))
-            h._send(200, self.db.heimdall.chat(messages, max_tokens))
+            model = body.get("model") or None
+            if body.get("stream"):
+                # SSE streaming (ref: handler.go:561 streaming responses)
+                h.send_response(200)
+                h.send_header("Content-Type", "text/event-stream")
+                h.send_header("Cache-Control", "no-cache")
+                h.send_header("Connection", "close")
+                h.end_headers()
+                try:
+                    for chunk in self.db.heimdall.chat_stream(
+                        messages, max_tokens, model=model
+                    ):
+                        h.wfile.write(
+                            b"data: " + json.dumps(chunk).encode() + b"\n\n"
+                        )
+                    h.wfile.write(b"data: [DONE]\n\n")
+                except (BrokenPipeError, ConnectionResetError):
+                    pass
+                h.close_connection = True
+                return
+            result = self.db.heimdall.chat(messages, max_tokens, model=model)
+            # OpenAI-compatible: invalid_request_error -> 404/400 status
+            h._send(404 if "error" in result else 200, result)
             return
         h._send(404, {"error": f"not found: {path}"})
 

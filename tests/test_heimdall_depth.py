@@ -1,0 +1,382 @@
+"""Heimdall depth: prompt/context machinery, model registry, async DB
+event dispatcher, metrics registry, status/models/SSE endpoints.
+
+Behavioral reference: /root/reference/pkg/heimdall/types.go
+(PromptContext :284, PromptExample :429, token budget :456-511,
+BuildFinalPrompt :513), plugin.go:1345 (dbEventDispatcher),
+metrics.go, handler.go:207-561, server_router.go:204-221.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+import urllib.request
+
+import pytest
+
+import nornicdb_tpu
+from nornicdb_tpu.heimdall import (
+    MODEL_CLASSIFICATION,
+    MODEL_REASONING,
+    DatabaseEvent,
+    EventDispatcher,
+    Generator,
+    HeimdallManager,
+    MetricsRegistry,
+    ModelInfo,
+    ModelRegistry,
+    PromptContext,
+    PromptExample,
+    TemplateGenerator,
+    TokenBudget,
+    estimate_tokens,
+)
+from nornicdb_tpu.heimdall.plugins import HeimdallPlugin, PluginHost
+
+
+class EchoGenerator(Generator):
+    """Deterministic backend capturing the prompt it was given."""
+
+    def __init__(self, reply: str = "ok"):
+        self.reply = reply
+        self.last_prompt = ""
+
+    def generate(self, prompt: str, max_tokens: int = 128) -> str:
+        self.last_prompt = prompt
+        return self.reply
+
+
+@pytest.fixture
+def db():
+    d = nornicdb_tpu.open_db("")
+    yield d
+    d.close()
+
+
+class TestPromptContext:
+    def test_full_prompt_sections(self):
+        ctx = PromptContext("hello", action_prompt="- status: health")
+        ctx.additional_instructions = "Graph has 5 nodes."
+        ctx.examples.append(PromptExample("hi", '{"action": "hello"}'))
+        p = ctx.build_final_prompt()
+        assert "AVAILABLE ACTIONS" in p and "- status: health" in p
+        assert "CYPHER QUERY REFERENCE" in p
+        assert "ADDITIONAL CONTEXT" in p and "5 nodes" in p
+        assert 'User: "hi"' in p
+
+    def test_minimal_fallback_when_over_budget(self):
+        ctx = PromptContext(
+            "q", action_prompt="- a: b",
+            budget=TokenBudget(max_system=50),
+        )
+        ctx.additional_instructions = "x" * 4000
+        p = ctx.build_final_prompt()
+        assert "ACTIONS" in p
+        assert "ADDITIONAL CONTEXT" not in p  # minimal prompt won
+
+    def test_token_estimate_and_budget_validation(self):
+        assert estimate_tokens("a" * 400) == 100
+        ctx = PromptContext("u" * 400, budget=TokenBudget(max_user=10))
+        err = ctx.validate_token_budget()
+        assert err is not None and "user message" in err
+
+    def test_cancellation(self):
+        ctx = PromptContext("q")
+        assert not ctx.cancelled
+        ctx.cancel("policy", "guard-plugin")
+        assert ctx.cancelled and ctx.cancel_reason == "policy"
+        assert ctx.cancelled_by == "guard-plugin"
+
+    def test_notification_queue_drains_once(self):
+        ctx = PromptContext("q")
+        ctx.notify_info("t", "m")
+        ctx.notify_warning("t2", "m2")
+        notes = ctx.drain_notifications()
+        assert [n.type for n in notes] == ["info", "warning"]
+        assert ctx.drain_notifications() == []
+
+
+class TestModelRegistry:
+    def test_register_default_and_select(self):
+        reg = ModelRegistry()
+        reg.register(ModelInfo(name="m1", type=MODEL_REASONING, backend="b1"))
+        reg.register(ModelInfo(name="m2", type=MODEL_REASONING, backend="b2"),
+                     default=True)
+        assert reg.default_for(MODEL_REASONING).name == "m2"
+        assert reg.acquire("m1") == "b1"
+        assert reg.get("m1").last_used > 0
+
+    def test_lazy_loader_and_unload(self):
+        loads = []
+        reg = ModelRegistry()
+        reg.register(ModelInfo(
+            name="lazy", type=MODEL_CLASSIFICATION,
+            loader=lambda: loads.append(1) or "backend",
+        ))
+        assert reg.get("lazy").loaded is False
+        assert reg.acquire("lazy") == "backend"
+        assert reg.get("lazy").loaded is True and loads == [1]
+        assert reg.unload("lazy") is True
+        assert reg.get("lazy").loaded is False
+
+    def test_unknown_type_rejected(self):
+        with pytest.raises(ValueError):
+            ModelRegistry().register(ModelInfo(name="x", type="nope"))
+
+
+class TestMetricsRegistry:
+    def test_counters_gauges_prometheus(self):
+        m = MetricsRegistry(prefix="heimdall")
+        m.inc("chat_requests")
+        m.inc("chat_requests", 2)
+        m.set_gauge("queue_depth", 7)
+        assert m.get("chat_requests") == 3
+        text = m.render_prometheus()
+        assert "# TYPE heimdall_chat_requests counter" in text
+        assert "heimdall_queue_depth 7" in text
+
+
+class TestEventDispatcher:
+    def test_async_delivery_and_stop(self):
+        d = EventDispatcher()
+        seen = []
+        d.subscribe(seen.append)
+        d.start()
+        assert d.emit_node_event("created", "n1", ["A"]) is True
+        assert d.emit_relationship_event("created", "e1", "KNOWS",
+                                         "n1", "n2") is True
+        deadline = time.time() + 5
+        while len(seen) < 2 and time.time() < deadline:
+            time.sleep(0.01)
+        assert [e.type for e in seen] == ["created", "created"]
+        assert seen[1].relationship_type == "KNOWS"
+        d.stop()
+        assert d.emit(DatabaseEvent(type="x")) is False  # stopped
+
+    def test_broken_subscriber_isolated(self):
+        d = EventDispatcher()
+        seen = []
+        d.subscribe(lambda e: 1 / 0)
+        d.subscribe(seen.append)
+        d.start()
+        d.emit_query_event("slow_query", "MATCH (n) RETURN n", 2.5)
+        deadline = time.time() + 5
+        while not seen and time.time() < deadline:
+            time.sleep(0.01)
+        assert seen[0].query == "MATCH (n) RETURN n"
+        d.stop()
+
+
+class TestChatMachinery:
+    def test_chat_prompt_includes_actions_and_examples(self, db):
+        gen = EchoGenerator("plain answer")
+        mgr = HeimdallManager(gen, db=db)
+        out = mgr.chat([{"role": "user", "content": "what is up"}])
+        assert "AVAILABLE ACTIONS" in gen.last_prompt
+        assert "- query:" in gen.last_prompt
+        assert "usage" in out and out["usage"]["total_tokens"] > 0
+
+    def test_query_action_executes_cypher(self, db):
+        db.cypher("CREATE (:T {v: 1}), (:T {v: 2})")
+        gen = EchoGenerator(
+            '{"action": "query", "params": {"cypher": '
+            '"MATCH (n:T) RETURN count(n)"}}'
+        )
+        mgr = HeimdallManager(gen, db=db)
+        out = mgr.chat([{"role": "user", "content": "count T"}])
+        assert out["action_result"]["rows"] == [[2]]
+
+    def test_query_action_rejects_writes(self, db):
+        # the chat endpoint is read-gated; write Cypher through the model
+        # must not escalate (review finding)
+        db.cypher("CREATE (:Keep)")
+        gen = EchoGenerator(
+            '{"action": "query", "params": {"cypher": '
+            '"MATCH (n) DETACH DELETE n"}}'
+        )
+        mgr = HeimdallManager(gen, db=db)
+        out = mgr.chat([{"role": "user", "content": "wipe it"}])
+        assert "read-only" in out["action_result"]["error"]
+        assert db.storage.node_count() == 1  # nothing deleted
+
+    def test_alt_model_still_passes_plugin_hooks(self, db):
+        # selecting a registered alternate model must not bypass
+        # pre_prompt hooks (review finding)
+        alt = EchoGenerator("alt")
+        mgr = HeimdallManager(EchoGenerator("default"), db=db)
+        host = PluginHost(mgr, db=db)
+
+        class Stamp(HeimdallPlugin):
+            name = "stamp"
+
+            def pre_prompt(self, prompt: str) -> str:
+                return "STAMPED\n" + prompt
+
+        host.register(Stamp())
+        mgr.models.register(ModelInfo(name="alt", type=MODEL_REASONING,
+                                      backend=alt, loaded=True))
+        mgr.chat([{"role": "user", "content": "x"}], model="alt")
+        assert alt.last_prompt.startswith("STAMPED")
+
+    def test_backendless_model_errors_cleanly(self, db):
+        mgr = HeimdallManager(EchoGenerator(), db=db)
+        mgr.models.register(ModelInfo(name="meta", type=MODEL_REASONING))
+        out = mgr.chat([{"role": "user", "content": "x"}], model="meta")
+        assert out["error"]["type"] == "invalid_request_error"
+
+    def test_stream_error_chunk_for_unknown_model(self, db):
+        mgr = HeimdallManager(EchoGenerator(), db=db)
+        chunks = list(mgr.chat_stream([{"role": "user", "content": "x"}],
+                                      model="ghost"))
+        assert len(chunks) == 1 and "error" in chunks[0]
+
+    def test_model_selection_and_unknown_model(self, db):
+        mgr = HeimdallManager(EchoGenerator("default"), db=db)
+        mgr.models.register(ModelInfo(
+            name="alt", type=MODEL_REASONING, backend=EchoGenerator("alt!"),
+            loaded=True,
+        ))
+        out = mgr.chat([{"role": "user", "content": "x"}], model="alt")
+        assert out["choices"][0]["message"]["content"] == "alt!"
+        assert out["model"] == "alt"
+        err = mgr.chat([{"role": "user", "content": "x"}], model="ghost")
+        assert err["error"]["type"] == "invalid_request_error"
+
+    def test_plugin_context_hook_cancels(self, db):
+        mgr = HeimdallManager(EchoGenerator(), db=db)
+        host = PluginHost(mgr, db=db)
+
+        class Guard(HeimdallPlugin):
+            name = "guard"
+
+            def pre_prompt_context(self, ctx) -> None:
+                if "forbidden" in ctx.user_message:
+                    ctx.cancel("blocked by policy")
+
+        host.register(Guard())
+        out = mgr.chat([{"role": "user", "content": "forbidden topic"}])
+        assert out["choices"][0]["finish_reason"] == "cancelled"
+        assert out["cancelled_by"] == "guard"
+        ok = mgr.chat([{"role": "user", "content": "fine"}])
+        assert ok["choices"][0]["finish_reason"] == "stop"
+
+    def test_plugin_context_hook_adds_examples(self, db):
+        gen = EchoGenerator()
+        mgr = HeimdallManager(gen, db=db)
+        host = PluginHost(mgr, db=db)
+
+        class Domain(HeimdallPlugin):
+            name = "domain"
+
+            def pre_prompt_context(self, ctx) -> None:
+                ctx.examples.append(
+                    PromptExample("special", '{"action": "special"}')
+                )
+
+        host.register(Domain())
+        mgr.chat([{"role": "user", "content": "hi"}])
+        assert 'User: "special"' in gen.last_prompt
+
+    def test_stream_flushes_notifications_first(self, db):
+        mgr = HeimdallManager(EchoGenerator("streamed words here"), db=db)
+
+        def hook(ctx):
+            ctx.notify_progress("working", "thinking")
+
+        mgr.context_hooks.append(hook)
+        chunks = list(mgr.chat_stream([{"role": "user", "content": "x"}]))
+        assert "notification" in chunks[0]
+        assert chunks[0]["notification"]["type"] == "progress"
+        content = "".join(
+            c["choices"][0]["delta"].get("content", "")
+            for c in chunks[1:] if c.get("choices")
+        )
+        assert content == "streamed words here"
+
+    def test_async_db_events_reach_plugins(self, db):
+        mgr = HeimdallManager(TemplateGenerator(db), db=db)
+        host = PluginHost(mgr, db=db)
+        seen = []
+
+        class Watch(HeimdallPlugin):
+            name = "watch"
+
+            def on_db_event(self, kind, event) -> None:
+                seen.append((kind, event))
+
+        host.register(Watch())
+        db.cypher("CREATE (:Evt {x: 1})-[:R]->(:Evt {x: 2})")
+        deadline = time.time() + 5
+        while len(seen) < 3 and time.time() < deadline:
+            time.sleep(0.01)
+        kinds = [k for k, _ in seen]
+        assert any("creat" in k for k in kinds)
+        rel_events = [e for _, e in seen if e.relationship_type == "R"]
+        assert rel_events and rel_events[0].source_node_id
+
+
+class TestHttpSurface:
+    def _req(self, port, path, method="GET", body=None):
+        data = json.dumps(body).encode() if body is not None else None
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{port}{path}", data=data, method=method,
+            headers={"Content-Type": "application/json"},
+        )
+        resp = urllib.request.urlopen(req)
+        return resp.status, json.loads(resp.read())
+
+    @pytest.fixture
+    def server(self, db):
+        from nornicdb_tpu.server.http import HttpServer
+
+        s = HttpServer(db, port=0)
+        s.start()
+        yield s
+        s.stop()
+
+    def test_bifrost_status(self, db, server):
+        db.heimdall.chat([{"role": "user", "content": "hello"}])
+        status, body = self._req(server.port, "/api/bifrost/status")
+        assert status == 200
+        assert body["named_metrics"]["chat_requests"] >= 1
+        assert any(m["name"] == "heimdall" for m in body["models"])
+
+    def test_v1_models(self, db, server):
+        status, body = self._req(server.port, "/v1/models")
+        assert status == 200
+        assert body["object"] == "list"
+        assert any(m["id"] == "heimdall" for m in body["data"])
+
+    def test_streaming_chat_sse(self, db, server):
+        import http.client
+
+        conn = http.client.HTTPConnection("127.0.0.1", server.port, timeout=30)
+        conn.request(
+            "POST", "/v1/chat/completions",
+            json.dumps({"messages": [{"role": "user", "content": "hi"}],
+                        "stream": True}),
+            {"Content-Type": "application/json"},
+        )
+        resp = conn.getresponse()
+        assert resp.getheader("Content-Type") == "text/event-stream"
+        raw = resp.read().decode()
+        conn.close()
+        assert "data: [DONE]" in raw
+        payloads = [
+            json.loads(line[6:])
+            for line in raw.splitlines()
+            if line.startswith("data: ") and line != "data: [DONE]"
+        ]
+        assert any(
+            c.get("choices") and c["choices"][0]["delta"].get("content")
+            for c in payloads
+        )
+
+    def test_heimdall_metrics_in_prometheus(self, db, server):
+        db.heimdall.chat([{"role": "user", "content": "hello"}])
+        with urllib.request.urlopen(
+            f"http://127.0.0.1:{server.port}/metrics"
+        ) as resp:
+            text = resp.read().decode()
+        assert "heimdall_chat_requests" in text

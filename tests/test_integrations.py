@@ -2,6 +2,8 @@
 query-load / relationship evolution (ref: pkg/vectorspace, pkg/inference
 integration adapters, pkg/heimdall/plugin.go, pkg/temporal)."""
 
+import time
+
 import numpy as np
 import pytest
 
@@ -108,6 +110,11 @@ class TestHeimdallPlugins:
         assert info.name == "watcher"
         db.cypher("CREATE (:W)")
         plugin = host._plugins["watcher"]
+        # DB events are now delivered asynchronously (bounded queue +
+        # worker thread, ref: plugin.go:1345 dbEventDispatcher)
+        deadline = time.time() + 5
+        while not plugin.events.get("node_created") and time.time() < deadline:
+            time.sleep(0.01)
         assert plugin.events.get("node_created") == 1
         # bare "status" stays bound to the manager built-in (no clobber);
         # the plugin's action lives at its namespaced name
