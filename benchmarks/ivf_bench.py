@@ -1,0 +1,87 @@
+"""Fused IVF vs full-scan latency on the real chip (small-batch regime).
+
+The IVF win is in bandwidth-bound small batches: a full scan reads the
+whole corpus per batch; probing P of K clusters reads ~P/K of it.
+Measures p50 latency at B in {1, 8, 32} on a 1M x 1024 corpus, plus
+recall@10 vs the exact scan.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+
+def main():
+    import jax
+
+    from nornicdb_tpu.ops.ivf import build_ivf_layout, ivf_search
+    from nornicdb_tpu.ops import similarity as sim
+
+    n, d, k_clusters = 1_000_000, 1024, 1024
+    rng = np.random.default_rng(0)
+    print(f"device={jax.devices()[0]} corpus={n}x{d} K={k_clusters}",
+          flush=True)
+    centers = rng.normal(size=(k_clusters, d)).astype(np.float32)
+    centers /= np.linalg.norm(centers, axis=1, keepdims=True)
+    assign = rng.integers(0, k_clusters, size=n).astype(np.int32)
+    rows = centers[assign] + 0.2 * rng.normal(size=(n, d)).astype(np.float32)
+    rows /= np.linalg.norm(rows, axis=1, keepdims=True)
+    slots = np.arange(n)
+    t0 = time.perf_counter()
+    lay = build_ivf_layout(rows, slots, assign, centers,
+                           dtype=__import__("jax.numpy", fromlist=["x"]).bfloat16)
+    print(f"layout built in {time.perf_counter()-t0:.1f}s "
+          f"cmax={lay.cmax} spill={(lay.residual_slots >= 0).sum()}",
+          flush=True)
+
+    import jax.numpy as jnp
+
+    corpus_dev = jnp.asarray(rows, jnp.bfloat16)
+    valid = jnp.ones(n, bool)
+
+    queries = rows[rng.integers(0, n, 128)] + 0.05 * rng.normal(
+        size=(128, d)).astype(np.float32)
+
+    def time_fn(fn, reps=5):
+        fn()  # warm/compile
+        best = float("inf")
+        for _ in range(reps):
+            t = time.perf_counter()
+            fn()
+            best = min(best, time.perf_counter() - t)
+        return best
+
+    print("| B | full-scan ms | IVF P=8 ms | speedup | recall@10 |")
+    print("|---|---|---|---|---|")
+    for b in (1, 8, 32):
+        q = queries[:b]
+
+        def full():
+            v, i = sim.topk_backend(
+                sim.l2_normalize(jnp.asarray(q)), corpus_dev, valid, 10,
+                exact=False, streaming=False,
+            )
+            np.asarray(v)  # D2H fence
+
+        def ivf():
+            ivf_search(lay, q, k=10, n_probe=8)
+
+        tf = time_fn(full) * 1e3
+        ti = time_fn(ivf) * 1e3
+        exact_ids = np.argsort(-(q @ rows.T), axis=1)[:, :10]
+        _, got = ivf_search(lay, q, k=10, n_probe=8)
+        recall = np.mean([
+            len(set(got[i]) & set(exact_ids[i])) / 10 for i in range(b)
+        ])
+        print(f"| {b} | {tf:.2f} | {ti:.2f} | {tf/ti:.1f}x | {recall:.3f} |",
+              flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -56,7 +56,9 @@ class Bifrost:
         self._lock = threading.Lock()
 
     def subscribe(self) -> queue.Queue:
-        q: queue.Queue = queue.Queue()
+        # bounded: a stalled SSE client must not grow memory without
+        # limit — broadcast's drop-on-full branch handles overflow
+        q: queue.Queue = queue.Queue(maxsize=1000)
         with self._lock:
             self._subs.append(q)
         return q
@@ -147,22 +149,26 @@ ActionFn = Callable[[dict[str, Any]], Any]
 
 
 def _brief(v: Any, limit: int = 200) -> Any:
-    """Row values trimmed for chat-sized payloads."""
+    """Row values trimmed for chat-sized payloads — including property
+    values inside nodes/edges (a 10MB document property must not balloon
+    the chat JSON)."""
     if isinstance(v, str) and len(v) > limit:
         return v[:limit] + "…"
     if hasattr(v, "id") and hasattr(v, "properties"):
-        return {"id": v.id, "properties": dict(v.properties)}
+        return {
+            "id": v.id,
+            "properties": {
+                k: _brief(p, limit) for k, p in dict(v.properties).items()
+            },
+        }
+    if isinstance(v, (list, tuple)):
+        return [_brief(x, limit) for x in list(v)[:20]]
     return v
 
 
 class HeimdallManager:
-    """(ref: heimdall.Manager scheduler.go:178)"""
-
-    SYSTEM_PROMPT = (
-        "You are Heimdall, the NornicDB graph assistant. Answer questions "
-        "about the graph; when an operation is needed reply with JSON "
-        '{"action": name, "params": {...}}.'
-    )
+    """(ref: heimdall.Manager scheduler.go:178). The system prompt is
+    assembled per-request by PromptContext.build_final_prompt()."""
 
     def __init__(self, generator: Generator, db=None):
         self.generator = generator

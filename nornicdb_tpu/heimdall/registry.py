@@ -65,6 +65,9 @@ class ModelRegistry:
         self._models: dict[str, ModelInfo] = {}
         self._default: dict[str, str] = {}  # type -> model name
         self._lock = threading.Lock()
+        # per-model load locks so two threads never run the same (large)
+        # loader concurrently, without serializing unrelated loads
+        self._load_locks: dict[str, threading.Lock] = {}
 
     def register(self, info: ModelInfo, default: bool = False) -> None:
         if info.type not in _MODEL_TYPES:
@@ -101,16 +104,20 @@ class ModelRegistry:
         stamping last_used (ref: Loaded/LastUsed bookkeeping)."""
         with self._lock:
             info = self._models.get(name)
-        if info is None:
-            raise KeyError(f"model {name!r} not registered")
+            if info is None:
+                raise KeyError(f"model {name!r} not registered")
+            load_lock = self._load_locks.setdefault(name, threading.Lock())
         if info.backend is None and info.loader is not None:
-            backend = info.loader()
-            with self._lock:
+            # the expensive load runs under the per-model lock so a
+            # concurrent request for the same model waits instead of
+            # double-loading a multi-GB backend
+            with load_lock:
                 if info.backend is None:
-                    info.backend = backend
-        info.loaded = info.backend is not None
-        info.last_used = time.time()
-        return info.backend
+                    info.backend = info.loader()
+        with self._lock:
+            info.loaded = info.backend is not None
+            info.last_used = time.time()
+            return info.backend
 
     def unload(self, name: str) -> bool:
         """Drop the backend reference (memory reclaim on next GC)."""
@@ -218,7 +225,13 @@ class EventDispatcher:
             if not self._running:
                 return
             self._running = False
-        self._queue.put(None)  # wake the worker
+        try:
+            # non-blocking wake: a full queue means the worker is active
+            # and will observe _running on its own — a blocking put here
+            # could hang stop() behind a wedged subscriber
+            self._queue.put_nowait(None)
+        except queue.Full:
+            pass
         if self._thread is not None:
             self._thread.join(timeout=5)
             self._thread = None
@@ -265,7 +278,15 @@ class EventDispatcher:
 
     def _run(self) -> None:
         while True:
-            event = self._queue.get()
+            try:
+                # bounded wait so the worker re-checks _running even when
+                # stop()'s wake sentinel couldn't be enqueued (full queue)
+                event = self._queue.get(timeout=0.5)
+            except queue.Empty:
+                with self._lock:
+                    if not self._running:
+                        return
+                continue
             if event is None:
                 with self._lock:
                     if not self._running:

@@ -229,6 +229,10 @@ class HostCorpus:
         self._valid = np.zeros(cap, bool)
         self._tombstones = 0
         self._dirty = True
+        # mutation epoch: consumers holding derived layouts (IVF blocks)
+        # compare epochs to detect staleness (stale layout would serve
+        # stale vectors, not just degraded recall)
+        self._epoch = 0
 
     def __len__(self) -> int:
         return len(self._slot_of)
@@ -252,6 +256,7 @@ class HostCorpus:
         self._host[slot] = v
         self._valid[slot] = True
         self._dirty = True
+        self._epoch += 1
 
     def add_batch(self, ids: list[str], vectors: np.ndarray) -> None:
         vectors = np.asarray(vectors, np.float32)
@@ -268,6 +273,7 @@ class HostCorpus:
             self._host[slot] = vectors[i]
             self._valid[slot] = True
         self._dirty = True
+        self._epoch += 1
 
     def remove(self, id_: str) -> bool:
         slot = self._slot_of.pop(id_, None)
@@ -277,6 +283,7 @@ class HostCorpus:
         self._valid[slot] = False
         self._tombstones += 1
         self._dirty = True
+        self._epoch += 1
         if self._ids and self._tombstones / len(self._ids) > self.compact_ratio:
             self._compact()
         return True
@@ -305,6 +312,7 @@ class HostCorpus:
         self._ids, self._slot_of = ids, slot_of
         self._tombstones = 0
         self._dirty = True
+        self._epoch += 1
 
     def _format_results(
         self,
@@ -361,6 +369,9 @@ class DeviceCorpus(HostCorpus):
         # IVF state: (K, D) centroids + per-slot assignment (-1 = unassigned)
         self._centroids: Optional[jax.Array] = None
         self._assignments: Optional[np.ndarray] = None
+        # fused cluster-contiguous layout (ops/ivf.py); valid only while
+        # its epoch matches the corpus mutation epoch
+        self._ivf = None
 
     # -- cluster pruning ----------------------------------------------------
     def cluster(self, k: int = 0, iters: int = 10, seed: int = 0) -> int:
@@ -378,11 +389,26 @@ class DeviceCorpus(HostCorpus):
             assignments[slot] = res.assignments[row]
         self._centroids = jnp.asarray(res.centroids, dtype=self.dtype)
         self._assignments = assignments
+        self._build_ivf_layout(np.asarray(live), res.assignments,
+                               res.centroids)
         return res.k
+
+    def _build_ivf_layout(self, live_slots: np.ndarray,
+                          live_assignments: np.ndarray,
+                          centroids: np.ndarray) -> None:
+        """Cluster-contiguous block layout for the fused one-program IVF
+        path (ops/ivf.py). Invalidated by any corpus mutation."""
+        from nornicdb_tpu.ops.ivf import build_ivf_layout
+
+        self._ivf = build_ivf_layout(
+            self._host[live_slots], live_slots, live_assignments,
+            centroids, dtype=self.dtype, epoch=self._epoch,
+        )
 
     def clear_clusters(self) -> None:
         self._centroids = None
         self._assignments = None
+        self._ivf = None
 
     def set_clusters(
         self, centroids: np.ndarray, assignments_by_id: dict[str, int]
@@ -396,6 +422,13 @@ class DeviceCorpus(HostCorpus):
                 slot_assignments[slot] = c
         self._centroids = jnp.asarray(centroids, dtype=self.dtype)
         self._assignments = slot_assignments
+        # the old layout describes the replaced clustering — drop it even
+        # when no live rows match (else the epoch guard keeps serving it)
+        self._ivf = None
+        live = np.nonzero((slot_assignments >= 0) & self._valid)[0]
+        if live.size:
+            self._build_ivf_layout(live, slot_assignments[live],
+                                   np.asarray(centroids, np.float32))
 
     def _grow(self, min_capacity: int = 0) -> None:
         super()._grow(min_capacity)
@@ -418,6 +451,24 @@ class DeviceCorpus(HostCorpus):
 
         if self._centroids is None or self._assignments is None:
             return None
+        # fused one-program path: valid only while the layout matches the
+        # corpus epoch (a stale layout would serve stale VECTORS — worse
+        # than stale assignments, which only degrade recall)
+        if self._ivf is not None and self._ivf.epoch == self._epoch:
+            from nornicdb_tpu.ops.ivf import ivf_search
+
+            vals, slots = ivf_search(self._ivf, q, k, n_probe)
+            out: list[list[tuple[str, float]]] = []
+            for qi in range(vals.shape[0]):
+                row: list[tuple[str, float]] = []
+                for s, slot in zip(vals[qi], slots[qi]):
+                    if slot < 0 or not np.isfinite(s) or s < min_similarity:
+                        continue
+                    id_ = self._ids[slot] if slot < len(self._ids) else None
+                    if id_ is not None:
+                        row.append((id_, float(s)))
+                out.append(row[:k])
+            return out
         n_probe = min(n_probe, int(self._centroids.shape[0]))
         out: list[list[tuple[str, float]]] = []
         corpus, _ = self.device_arrays()

@@ -1,0 +1,141 @@
+"""Fused one-program IVF: layout construction, spill handling, recall,
+staleness invalidation.
+
+Behavioral reference: /root/reference/pkg/gpu/kmeans.go
+SearchWithClusters :816 (probe n_probe nearest centroids, score member
+rows, exact scores on candidates) + kmeans_candidate_gen.go.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+from nornicdb_tpu.ops.ivf import build_ivf_layout, ivf_search
+from nornicdb_tpu.ops.similarity import DeviceCorpus
+
+
+def _random_clustered(n, d, k, seed=0):
+    rng = np.random.default_rng(seed)
+    centers = rng.normal(size=(k, d)).astype(np.float32)
+    centers /= np.linalg.norm(centers, axis=1, keepdims=True)
+    assign = rng.integers(0, k, size=n)
+    rows = centers[assign] + 0.15 * rng.normal(size=(n, d)).astype(np.float32)
+    rows /= np.linalg.norm(rows, axis=1, keepdims=True)
+    return rows.astype(np.float32), assign.astype(np.int32), centers
+
+
+class TestLayout:
+    def test_blocks_and_counts(self):
+        rows, assign, centers = _random_clustered(300, 32, 5)
+        slots = np.arange(300)
+        lay = build_ivf_layout(rows, slots, assign, centers)
+        assert lay.k == 5
+        assert lay.n_rows == 300
+        counts = np.asarray(lay.counts)
+        spill = int((lay.residual_slots >= 0).sum())
+        assert counts.sum() + spill == 300
+        # every slot appears exactly once across blocks + residual
+        all_slots = set(lay.slotmap[lay.slotmap >= 0].tolist())
+        all_slots |= set(lay.residual_slots[lay.residual_slots >= 0].tolist())
+        assert all_slots == set(range(300))
+
+    def test_oversized_cluster_spills(self):
+        # one giant cluster forces the Cmax clamp + residual spill
+        rows, _, _ = _random_clustered(256, 16, 4)
+        assign = np.zeros(256, np.int32)  # everything in cluster 0
+        centers = np.zeros((4, 16), np.float32)
+        centers[:, 0] = 1.0
+        lay = build_ivf_layout(rows, np.arange(256), assign, centers,
+                               max_block_factor=2.0)
+        assert lay.residual is not None
+        assert lay.n_rows == 256
+        # spilled rows are still found (residual scanned by every query)
+        vals, slots = ivf_search(lay, rows[:3], k=1, n_probe=1)
+        assert (slots[:, 0] == np.arange(3)).all()
+        assert np.allclose(vals[:, 0], 1.0, atol=2e-2)
+
+
+class TestSearch:
+    def test_self_query_top1(self):
+        rows, assign, centers = _random_clustered(500, 64, 8, seed=1)
+        lay = build_ivf_layout(rows, np.arange(500), assign, centers)
+        vals, slots = ivf_search(lay, rows[10:20], k=3, n_probe=3)
+        assert (slots[:, 0] == np.arange(10, 20)).all()
+
+    def test_recall_vs_exact(self):
+        rows, assign, centers = _random_clustered(2000, 64, 16, seed=2)
+        lay = build_ivf_layout(rows, np.arange(2000), assign, centers)
+        rng = np.random.default_rng(3)
+        queries = rows[rng.integers(0, 2000, 32)] + 0.05 * rng.normal(
+            size=(32, 64)
+        ).astype(np.float32)
+        exact = np.argsort(-(queries @ rows.T), axis=1)[:, :10]
+        _, got = ivf_search(lay, queries, k=10, n_probe=4)
+        recall = np.mean([
+            len(set(got[i]) & set(exact[i])) / 10 for i in range(32)
+        ])
+        assert recall >= 0.9, recall
+
+    def test_min_k_padding(self):
+        rows, assign, centers = _random_clustered(20, 16, 4)
+        lay = build_ivf_layout(rows, np.arange(20), assign, centers)
+        vals, slots = ivf_search(lay, rows[:1], k=50, n_probe=1)
+        assert vals.shape == (1, 50) and slots.shape == (1, 50)
+        assert (slots[0] == -1).any()  # padded beyond available candidates
+
+
+class TestDeviceCorpusIntegration:
+    def _corpus(self, n=400, d=32, k=6, seed=0):
+        rows, _, _ = _random_clustered(n, d, k, seed)
+        c = DeviceCorpus(dims=d)
+        c.add_batch([f"n{i}" for i in range(n)], rows)
+        return c, rows
+
+    def test_fused_path_used_and_correct(self):
+        c, rows = self._corpus()
+        assert c.cluster(k=6) > 0
+        assert c._ivf is not None
+        res = c.search(rows[5], k=3, n_probe=3)
+        assert res[0][0][0] == "n5"
+        assert res[0][0][1] > 0.99
+
+    def test_matches_full_scan_top1(self):
+        c, rows = self._corpus(seed=4)
+        c.cluster(k=6)
+        full = c.search(rows[:20], k=1)
+        pruned = c.search(rows[:20], k=1, n_probe=4)
+        agree = sum(
+            1 for f, p in zip(full, pruned)
+            if f and p and f[0][0] == p[0][0]
+        )
+        assert agree >= 18  # ≥90% top-1 agreement at n_probe=4/6
+
+    def test_mutation_invalidates_layout(self):
+        c, rows = self._corpus()
+        c.cluster(k=6)
+        epoch = c._ivf.epoch
+        c.add("extra", np.ones(32, np.float32))
+        assert c._epoch != epoch
+        # the fused path must NOT serve the stale layout; fallback still
+        # finds the new row via the mask path (stale assignments only)
+        res = c.search(np.ones(32, np.float32), k=1, n_probe=6)
+        # fallback path can't know the new row's cluster (assignment -1),
+        # but a full search must find it
+        res_full = c.search(np.ones(32, np.float32), k=1)
+        assert res_full[0][0][0] == "extra"
+
+    def test_recluster_rebuilds_layout(self):
+        c, rows = self._corpus()
+        c.cluster(k=6)
+        c.add("extra", rows[0] * -1.0)
+        c.cluster(k=6)
+        assert c._ivf is not None and c._ivf.epoch == c._epoch
+        res = c.search(rows[0] * -1.0, k=1, n_probe=6)
+        assert res[0][0][0] == "extra"
+
+    def test_min_similarity_filter(self):
+        c, rows = self._corpus()
+        c.cluster(k=6)
+        res = c.search(rows[0], k=10, n_probe=3, min_similarity=0.999)
+        assert all(s >= 0.999 for _, s in res[0])
