@@ -161,6 +161,8 @@ def _brief(v: Any, limit: int = 200) -> Any:
                 k: _brief(p, limit) for k, p in dict(v.properties).items()
             },
         }
+    if isinstance(v, dict):
+        return {k: _brief(x, limit) for k, x in v.items()}
     if isinstance(v, (list, tuple)):
         return [_brief(x, limit) for x in list(v)[:20]]
     return v

@@ -51,6 +51,7 @@ class IVFLayout:
     slotmap: np.ndarray      # (K, Cmax) int32 -> corpus slot, -1 = pad
     residual: Optional[jax.Array]   # (Rp, D) spilled rows (None if none)
     residual_slots: np.ndarray      # (Rp,) int32 -> corpus slot, -1 = pad
+    residual_valid: Optional[jax.Array]  # (Rp,) device mask, built once
     cmax: int
     k: int
     epoch: int               # corpus mutation epoch at build time
@@ -114,9 +115,11 @@ def build_ivf_layout(
         residual_slots = np.full(rp, -1, np.int32)
         residual_slots[: spill_slot_arr.shape[0]] = spill_slot_arr
         residual_dev = jnp.asarray(residual, dtype=dtype)
+        residual_valid = jnp.asarray(residual_slots >= 0)
     else:
         residual_dev = None
         residual_slots = np.empty(0, np.int32)
+        residual_valid = None
     return IVFLayout(
         blocks=jnp.asarray(blocks, dtype=dtype),
         counts=jnp.asarray(counts),
@@ -124,6 +127,7 @@ def build_ivf_layout(
         slotmap=slotmap,
         residual=residual_dev,
         residual_slots=residual_slots,
+        residual_valid=residual_valid,
         cmax=cmax,
         k=k,
         epoch=epoch,
@@ -179,16 +183,24 @@ def ivf_search(
     Returns (scores (B, k), corpus slots (B, k)); slot -1 = no candidate
     (short clusters). Scores of returned rows are exact bf16-GEMM scores,
     identical in kind to the full-scan path."""
-    q = jnp.asarray(np.atleast_2d(np.asarray(queries, np.float32)))
-    qn = l2_normalize(q)
+    q2 = np.atleast_2d(np.asarray(queries, np.float32))
+    b = q2.shape[0]
+    # bucket B and k to powers of two so the jit caches a handful of
+    # shape classes instead of recompiling per client-supplied batch/limit
+    # (same rationale as the fallback path's candidate buckets)
+    b_pad = _next_pow2(b)
+    if b_pad != b:
+        q2 = np.concatenate([q2, np.zeros((b_pad - b, q2.shape[1]),
+                                          np.float32)])
+    k_prog = _next_pow2(max(k, 8))
+    qn = l2_normalize(jnp.asarray(q2))
     n_probe = max(1, min(n_probe, layout.k))
     vals, idx, probes = _ivf_topk_program(
-        qn, layout.centroids, layout.blocks, layout.counts, n_probe, k
+        qn, layout.centroids, layout.blocks, layout.counts, n_probe, k_prog
     )
-    vals = np.asarray(vals, np.float32)
-    idx = np.asarray(idx)
-    probes_np = np.asarray(probes)
-    b = vals.shape[0]
+    vals = np.asarray(vals, np.float32)[:b, :k]
+    idx = np.asarray(idx)[:b, :k]
+    probes_np = np.asarray(probes)[:b]
     # resolve flat (p, c) -> corpus slot through the host slotmap
     p_pos = idx // layout.cmax
     c_pos = idx % layout.cmax
@@ -196,10 +208,11 @@ def ivf_search(
     slots = layout.slotmap[cluster_ids, c_pos]
     slots = np.where(np.isfinite(vals), slots, -1)
     if layout.residual is not None:
-        rvalid = jnp.asarray(layout.residual_slots >= 0)
-        rvals, ridx = _residual_topk(qn, layout.residual, rvalid, k)
-        rvals = np.asarray(rvals, np.float32)
-        rslots = layout.residual_slots[np.asarray(ridx)]
+        rvals, ridx = _residual_topk(
+            qn, layout.residual, layout.residual_valid, k_prog
+        )
+        rvals = np.asarray(rvals, np.float32)[:b]
+        rslots = layout.residual_slots[np.asarray(ridx)[:b]]
         rslots = np.where(np.isfinite(rvals), rslots, -1)
         # merge the two k-lists per query (host merge of 2k items)
         merged_scores = np.concatenate([vals, rvals], axis=1)

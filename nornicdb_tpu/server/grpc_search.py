@@ -176,6 +176,9 @@ class GrpcSearchServer:
                 cores = os.cpu_count() or 1
             max_workers = max(2, min(8, cores * 2))
         self.db = db
+        from nornicdb_tpu.server.respcache import ResponseCache
+
+        self._resp_cache = ResponseCache(lambda: db.search._generation)
         outer = self
 
         class Handler(grpc.GenericRpcHandler):
@@ -196,6 +199,13 @@ class GrpcSearchServer:
         self.host = host
 
     def _search(self, request: bytes, context) -> bytes:
+        # serialized-response cache: generation-invalidated + short TTL,
+        # shared policy with the HTTP search cache (server/respcache.py) —
+        # skips decode, rank, node fetch, and protobuf encode on hits
+        cached = self._resp_cache.get(request)
+        if cached is not None:
+            return cached
+        gen_before = self._resp_cache.generation()
         t0 = time.perf_counter()
         req = decode_search_request(request)
         if req["vector"]:
@@ -226,7 +236,9 @@ class GrpcSearchServer:
                 for r in results
             ]
         took = int((time.perf_counter() - t0) * 1e6)
-        return encode_search_response(out, took)
+        payload = encode_search_response(out, took)
+        self._resp_cache.put(request, payload, gen_before)
+        return payload
 
     def start(self) -> None:
         self._server.start()

@@ -125,6 +125,26 @@ class HttpServer:
         self._httpd: Optional[ThreadingHTTPServer] = None
         self._thread: Optional[threading.Thread] = None
 
+    @staticmethod
+    def _parse_body(raw: bytes) -> dict:
+        if not raw:
+            return {}
+        try:
+            return json.loads(raw or b"{}")
+        except json.JSONDecodeError:
+            raise NornicError("invalid JSON body")
+
+    # -- hot-path response cache (shared policy: server/respcache.py) -----
+    @property
+    def response_cache(self):
+        if getattr(self, "_resp_cache", None) is None:
+            from nornicdb_tpu.server.respcache import ResponseCache
+
+            self._resp_cache = ResponseCache(
+                lambda: self.db.search._generation
+            )
+        return self._resp_cache
+
     def _retention(self):
         if getattr(self, "_retention_mgr", None) is None:
             from nornicdb_tpu.retention import RetentionManager
@@ -167,6 +187,16 @@ class HttpServer:
                     if content_type == "application/json"
                     else body.encode()
                 )
+                self._send_raw(code, data, content_type, extra_headers)
+
+            def _send_raw(
+                self,
+                code: int,
+                data: bytes,
+                content_type="application/json",
+                extra_headers: Optional[dict[str, str]] = None,
+            ) -> None:
+                """Pre-encoded body with the standard header set."""
                 self.send_response(code)
                 self.send_header("Content-Type", content_type)
                 self.send_header("Content-Length", str(len(data)))
@@ -180,14 +210,12 @@ class HttpServer:
                 self.end_headers()
                 self.wfile.write(data)
 
-            def _body(self) -> dict:
+            def _raw_body(self) -> bytes:
                 length = int(self.headers.get("Content-Length") or 0)
-                if not length:
-                    return {}
-                try:
-                    return json.loads(self.rfile.read(length) or b"{}")
-                except json.JSONDecodeError:
-                    raise NornicError("invalid JSON body")
+                return self.rfile.read(length) if length else b""
+
+            def _body(self) -> dict:
+                return server_self._parse_body(self._raw_body())
 
             def _auth(self, permission: str = "read") -> Optional[dict]:
                 if not server_self.auth_required or server_self.authenticator is None:
@@ -586,12 +614,24 @@ class HttpServer:
             return
         if path == "/nornicdb/search":
             h._auth("read")
-            body = h._body()
+            raw = h._raw_body()
+            # hot-path response byte cache: generation-invalidated (any
+            # index mutation kills it) + short TTL so decay/access-count
+            # drift is bounded to TTL seconds (the rank layer underneath
+            # already caches for 30s; ref: pkg/cache LRU+TTL query cache)
+            cache = self.response_cache
+            cached = cache.get((path, raw))
+            if cached is not None:
+                h._send_raw(200, cached)
+                return
+            # snapshot BEFORE searching: a mutation racing the search
+            # must make this entry dead on arrival
+            gen_before = cache.generation()
+            body = self._parse_body(raw)
             results = self.db.search.search(
                 body.get("query", ""), limit=int(body.get("limit", 10))
             )
-            h._send(
-                200,
+            payload = json.dumps(
                 {
                     "results": [
                         {
@@ -603,8 +643,10 @@ class HttpServer:
                         }
                         for r in results
                     ]
-                },
-            )
+                }
+            ).encode()
+            cache.put((path, raw), payload, gen_before)
+            h._send_raw(200, payload)
             return
         if path == "/nornicdb/similar":
             h._auth("read")

@@ -279,6 +279,21 @@ class TestHttp:
         out = _post(server.port, "/nornicdb/search", {"query": "TPU vector", "limit": 3})
         assert out["results"] and "TPU" in out["results"][0]["content"]
 
+    def test_search_response_cache_invalidated_by_mutation(self, http_db):
+        # the HTTP byte cache must die on index mutation (generation bump),
+        # so new documents appear immediately despite the 1s TTL
+        db, server = http_db
+        db.store("alpha document about caching")
+        db.process_pending_embeddings()
+        body = {"query": "caching document", "limit": 5}
+        first = _post(server.port, "/nornicdb/search", body)
+        again = _post(server.port, "/nornicdb/search", body)  # cache hit
+        assert again == first
+        db.store("beta document about caching too")
+        db.process_pending_embeddings()
+        after = _post(server.port, "/nornicdb/search", body)
+        assert len(after["results"]) == len(first["results"]) + 1
+
     def test_embed_endpoint(self, http_db):
         db, server = http_db
         out = _post(server.port, "/nornicdb/embed", {"text": "hello"})
@@ -454,6 +469,28 @@ class TestGrpcSearch:
             out = search_over_grpc("127.0.0.1", srv.port, query="grpc vectors")
             assert out["hits"] and "grpc" in out["hits"][0]["content"]
             assert out["took_micros"] > 0
+        finally:
+            srv.stop()
+            db.close()
+
+    def test_grpc_response_cache_invalidated_by_mutation(self):
+        from nornicdb_tpu.server.grpc_search import search_over_grpc
+
+        db, srv = self._server()
+        try:
+            db.store("gamma grpc cache doc")
+            db.process_pending_embeddings()
+            first = search_over_grpc("127.0.0.1", srv.port,
+                                     query="grpc cache doc")
+            cached = search_over_grpc("127.0.0.1", srv.port,
+                                      query="grpc cache doc")
+            assert [h["id"] for h in cached["hits"]] == \
+                [h["id"] for h in first["hits"]]
+            db.store("delta grpc cache doc two")
+            db.process_pending_embeddings()
+            after = search_over_grpc("127.0.0.1", srv.port,
+                                     query="grpc cache doc")
+            assert len(after["hits"]) == len(first["hits"]) + 1
         finally:
             srv.stop()
             db.close()
