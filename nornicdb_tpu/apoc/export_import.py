@@ -20,7 +20,7 @@ from xml.sax.saxutils import escape as _xml_escape
 from xml.sax.saxutils import quoteattr as _xml_attr
 
 from nornicdb_tpu.cypher.executor import CypherExecutor, procedure
-from nornicdb_tpu.errors import CypherSyntaxError, NornicError
+from nornicdb_tpu.errors import CypherSyntaxError, CypherTypeError, NornicError
 from nornicdb_tpu.storage.types import Edge, Node
 
 
@@ -417,9 +417,24 @@ def _parse_label_filter(spec: Optional[str]) -> tuple[set[str], set[str]]:
     return white, black
 
 
-def _expand(ex, start: Node, rel_spec, label_spec, min_level: int,
+def _resolve_start(ex, start) -> Node:
+    """The start argument accepts a Node, an id string, or a map carrying
+    an `id` key (ref: apoc.path.* taking {id: ...} in the reference
+    tests)."""
+    if isinstance(start, Node):
+        return start
+    if isinstance(start, dict):
+        start = start.get("id")
+    node = ex.get_node_or_none(str(start)) if start is not None else None
+    if node is None:
+        raise CypherTypeError(f"start node not found: {start!r}")
+    return node
+
+
+def _expand(ex, start, rel_spec, label_spec, min_level: int,
             max_level: int, uniqueness: str = "RELATIONSHIP_PATH",
             limit: Optional[int] = None, bfs: bool = False) -> list[dict]:
+    start = _resolve_start(ex, start)
     out_t, in_t = _parse_rel_filter(rel_spec)
     no_filter = not rel_spec
     white, black = _parse_label_filter(label_spec)

@@ -129,6 +129,22 @@ class CountSubquery:
 
 
 @dataclass
+class CollectSubquery:
+    """COLLECT { MATCH ... RETURN expr } — Neo4j 5 collect subquery;
+    evaluates the inner single-column query per row, returns the list."""
+
+    query: "Query"
+
+
+@dataclass
+class LabelPredicate:
+    """n:Label[:Label...] used as a boolean expression (WHERE n:Person)."""
+
+    subject: "Expr"
+    labels: list[str]
+
+
+@dataclass
 class ReduceExpr:
     """reduce(acc = init, x IN list | expr)"""
 
@@ -153,6 +169,7 @@ Expr = Union[
     Literal, Parameter, Variable, Property, ListLiteral, MapLiteral,
     FunctionCall, UnaryOp, BinaryOp, IsNull, Subscript, Slice, CaseExpr,
     ListComprehension, PatternPredicate, ExistsSubquery, CountSubquery,
+    CollectSubquery, LabelPredicate,
     Quantifier, ReduceExpr, MapProjection, PatternComprehension,
 ]
 
@@ -273,6 +290,8 @@ class ReturnClause:
 class UnwindClause:
     expr: Expr
     variable: str
+    # reference-dialect extension: UNWIND ... AS x WHERE pred row filter
+    where: Optional[Expr] = None
 
 
 @dataclass
@@ -282,6 +301,10 @@ class CallClause:
     yield_items: list[tuple[str, Optional[str]]]  # (name, alias)
     where: Optional[Expr] = None
     yield_star: bool = False
+    # standalone-call tail without RETURN (CALL ... YIELD ... LIMIT n)
+    order_by: list["OrderItem"] = field(default_factory=list)
+    skip: Optional[Expr] = None
+    limit: Optional[Expr] = None
 
 
 @dataclass
@@ -291,6 +314,10 @@ class CallSubquery:
     # CALL { ... } IN TRANSACTIONS [OF n ROWS]
     in_transactions: bool = False
     batch_rows: int = 1000
+    # reference-dialect tail without RETURN (CALL { ... } ORDER BY ...)
+    order_by: list["OrderItem"] = field(default_factory=list)
+    skip: Optional["Expr"] = None
+    limit: Optional["Expr"] = None
 
 
 @dataclass

@@ -30,6 +30,7 @@ from nornicdb_tpu.cypher.matcher import PatternMatcher, make_path
 from nornicdb_tpu.cypher.parser import parse
 from nornicdb_tpu.cypher.validator import strict_mode_enabled, validate
 from nornicdb_tpu.errors import (
+    AlreadyExistsError,
     CypherSyntaxError,
     CypherTypeError,
     NotFoundError,
@@ -144,6 +145,17 @@ class CypherExecutor:
                         params: Optional[dict[str, Any]] = None) -> Result:
         self.query_count += 1
         params = params or {}
+        stripped = query.lstrip()
+        if stripped[:4].lower() == ":use":
+            # browser-style :use prefix (ref: executor.go:500-541 — the
+            # :USE line selects the database for the rest of the text)
+            rest = stripped[4:].lstrip()
+            parts = rest.split(None, 1)
+            if not parts:
+                raise CypherSyntaxError(":use requires a database name")
+            query = f"USE {parts[0]}" + (
+                f" {parts[1]}" if len(parts) > 1 else ""
+            )
         stmt = parse(query)
         if self.strict_validation:
             validate(stmt)
@@ -1305,6 +1317,11 @@ class CypherExecutor:
             for item in items:
                 nr = dict(row)
                 nr[clause.variable] = item
+                if clause.where is not None and evaluate(
+                    clause.where, EvalContext(nr, params, self)
+                ) is not True:
+                    # UNWIND ... WHERE row filter (reference dialect)
+                    continue
                 out.append(nr)
         return out
 
@@ -1346,7 +1363,42 @@ class CypherExecutor:
                 ) is not True:
                     continue
                 out.append(nr)
-        return out
+        return self._apply_order_skip_limit(
+            out, clause.order_by, clause.skip, clause.limit, params
+        )
+
+    def _apply_order_skip_limit(self, rows, order_by, skip, limit, params):
+        """Shared ORDER BY/SKIP/LIMIT tail for the RETURN-less CALL forms
+        (standalone CALL ... YIELD and CALL { ... } subqueries)."""
+        if order_by:
+            def sort_keys(r):
+                return [
+                    _SortKey(
+                        evaluate(oi.expr, EvalContext(r, params, self)),
+                        oi.descending,
+                    )
+                    for oi in order_by
+                ]
+
+            rows.sort(key=sort_keys)
+        if skip is not None:
+            rows = rows[int(evaluate(skip, EvalContext({}, params, self))):]
+        if limit is not None:
+            rows = rows[: int(evaluate(limit, EvalContext({}, params, self)))]
+        return rows
+
+    def eval_collect_subquery(self, e, ctx: EvalContext) -> list:
+        """COLLECT { ... RETURN expr } — correlated single-column subquery
+        per row; returns the column values as a list (Neo4j 5)."""
+        res = self._run_query(
+            e.query, ctx.params, start_rows=[dict(ctx.bindings)],
+            stats=Stats(),
+        )
+        if len(res.columns) != 1:
+            raise CypherSyntaxError(
+                "COLLECT subquery must return exactly one column"
+            )
+        return [row[0] for row in res.rows]
 
     def _call_subquery(self, clause: ast.CallSubquery, rows, params, stats) -> list[dict]:
         if clause.in_transactions:
@@ -1366,7 +1418,10 @@ class CypherExecutor:
                 nr = dict(row)
                 nr.update(dict(zip(res.columns, r)))
                 out.append(nr)
-        return out
+        # reference-dialect tail: CALL { ... } ORDER BY/SKIP/LIMIT
+        return self._apply_order_skip_limit(
+            out, clause.order_by, clause.skip, clause.limit, params
+        )
 
     def _call_in_transactions(
         self, clause: ast.CallSubquery, rows, params, stats
@@ -1613,6 +1668,32 @@ class CypherExecutor:
             mgr.drop_alias(stmt.name)
         elif stmt.op == "create_composite":
             mgr.create_composite(stmt.name)
+        elif stmt.op == "composite_add_alias":
+            # ALTER COMPOSITE DATABASE c ADD ALIAS a FOR DATABASE t:
+            # the alias becomes a constituent route into the composite
+            alias = stmt.options["alias"]
+            target = stmt.options["target"]
+            if alias != target:
+                try:
+                    mgr.create_alias(alias, target)
+                except AlreadyExistsError:
+                    # tolerable only when the existing name already routes
+                    # to the same target; a collision with a different
+                    # database must surface, not half-apply
+                    if mgr.resolve(alias) != target:
+                        raise
+            mgr.add_constituent(stmt.name, target)
+        elif stmt.op == "composite_drop_alias":
+            alias = stmt.options["alias"]
+            target = mgr.resolve(alias)
+            constituents = mgr._composites.get(stmt.name, [])
+            if target == alias and alias not in constituents:
+                raise NotFoundError(
+                    f"alias {alias} not found in composite {stmt.name}"
+                )
+            mgr.remove_constituent(stmt.name, target)
+            if target != alias:
+                mgr.drop_alias(alias)
         else:
             raise CypherSyntaxError(f"unsupported database command {stmt.op}")
         return Result([], [])
@@ -2059,3 +2140,175 @@ def proc_vector_create(ex: CypherExecutor, args, row):
 @procedure("db.awaitindexes")
 def proc_await_indexes(ex: CypherExecutor, args, row):
     return [], []
+
+
+@procedure("db.awaitindex")
+def proc_await_index(ex: CypherExecutor, args, row):
+    """db.awaitIndex(name[, timeoutSeconds]) — indexes are maintained
+    synchronously here, so an existing index is always online; an unknown
+    name errors like the reference."""
+    name = str(args[0]) if args else ""
+    if name and not any(i.name == name for i in ex.schema.list_indexes()):
+        raise CypherTypeError(f"no such index: {name}")
+    return [], []
+
+
+@procedure("db.resampleindex")
+def proc_resample_index(ex: CypherExecutor, args, row):
+    """db.resampleIndex(name) — statistics resampling is a no-op (no
+    cost-based planner statistics in this engine)."""
+    return [], []
+
+
+@procedure("db.resampleoutdatedindexes")
+def proc_resample_outdated(ex: CypherExecutor, args, row):
+    return [], []
+
+
+@procedure("db.ping")
+def proc_ping(ex: CypherExecutor, args, row):
+    return ["success"], [[True]]
+
+
+@procedure("db.info")
+def proc_db_info(ex: CypherExecutor, args, row):
+    import time as _time
+
+    return (
+        ["id", "name", "creationDate", "nodeCount", "edgeCount"],
+        [[
+            "nornicdb-tpu", "neo4j",
+            _time.strftime("%Y-%m-%dT%H:%M:%SZ", _time.gmtime()),
+            ex.storage.node_count(), ex.storage.edge_count(),
+        ]],
+    )
+
+
+@procedure("db.clearquerycaches")
+def proc_clear_query_caches(ex: CypherExecutor, args, row):
+    if ex.cache is not None:
+        ex.cache.clear()
+    return ["value"], [["Query caches cleared"]]
+
+
+# db.stats.* query-statistics collection (ref: the reference's db.stats
+# surface; stats here are the executor's own counters)
+@procedure("db.stats.collect")
+def proc_stats_collect(ex: CypherExecutor, args, row):
+    ex._stats_collecting = True
+    return ["section", "success", "message"], [
+        [str(args[0]) if args else "QUERIES", True, "collection started"]
+    ]
+
+
+@procedure("db.stats.stop")
+def proc_stats_stop(ex: CypherExecutor, args, row):
+    ex._stats_collecting = False
+    return ["section", "success", "message"], [
+        [str(args[0]) if args else "QUERIES", True, "collection stopped"]
+    ]
+
+
+@procedure("db.stats.status")
+def proc_stats_status(ex: CypherExecutor, args, row):
+    collecting = bool(getattr(ex, "_stats_collecting", False))
+    return ["section", "status"], [
+        ["QUERIES", "collecting" if collecting else "idle"]
+    ]
+
+
+@procedure("db.stats.retrieve")
+def proc_stats_retrieve(ex: CypherExecutor, args, row):
+    section = str(args[0]) if args else "QUERIES"
+    return ["section", "data"], [
+        [section, {"queryCount": ex.query_count}]
+    ]
+
+
+@procedure("db.stats.clear")
+def proc_stats_clear(ex: CypherExecutor, args, row):
+    return ["section", "success"], [["QUERIES", True]]
+
+
+@procedure("dbms.info")
+def proc_dbms_info(ex: CypherExecutor, args, row):
+    from nornicdb_tpu import __version__
+
+    return (
+        ["id", "name", "creationDate"],
+        [["nornicdb-tpu", "DBMS", __version__]],
+    )
+
+
+@procedure("dbms.listconfig")
+def proc_dbms_list_config(ex: CypherExecutor, args, row):
+    cfg = getattr(ex.db, "config", None) if ex.db else None
+    rows = []
+    if cfg is not None:
+        for k, v in sorted(vars(cfg).items()):
+            if isinstance(v, (str, int, float, bool)) or v is None:
+                rows.append([k, str(v)])
+    return ["name", "value"], rows
+
+
+@procedure("dbms.clientconfig")
+def proc_dbms_client_config(ex: CypherExecutor, args, row):
+    return ["name", "value"], []
+
+
+@procedure("dbms.listconnections")
+def proc_dbms_list_connections(ex: CypherExecutor, args, row):
+    return (
+        ["connectionId", "connectTime", "connector", "username"],
+        [],
+    )
+
+
+@procedure("dbms.procedures")
+def proc_dbms_procedures(ex: CypherExecutor, args, row):
+    return (
+        ["name", "signature"],
+        [[name, f"{name}(...)"] for name in sorted(PROCEDURES)],
+    )
+
+
+@procedure("tx.setmetadata")
+def proc_tx_set_metadata(ex: CypherExecutor, args, row):
+    """tx.setMetaData(map) — attaches metadata to the current transaction
+    (surfaced through dbms.listConnections in the reference; stored on
+    the executor here)."""
+    ex._tx_metadata = args[0] if args and isinstance(args[0], dict) else {}
+    return [], []
+
+
+@procedure("db.index.fulltext.createnodeindex")
+def proc_fulltext_create(ex: CypherExecutor, args, row):
+    """db.index.fulltext.createNodeIndex(name, labelsOrLabel, propsOrProp)
+    — legacy creation form (ref: call_fulltext.go)."""
+    name = str(args[0]) if args else ""
+    labels = args[1] if len(args) > 1 else []
+    props = args[2] if len(args) > 2 else []
+    if isinstance(labels, str):
+        labels = [labels]
+    if isinstance(props, str):
+        props = [props]
+    ex.schema.create_index(
+        name, "fulltext", str(labels[0]) if labels else "",
+        [str(p) for p in props], {}, if_not_exists=True,
+    )
+    return [], []
+
+
+@procedure("db.index.fulltext.drop")
+def proc_fulltext_drop(ex: CypherExecutor, args, row):
+    name = str(args[0]) if args else ""
+    ex.schema.drop_index(name, if_exists=True)
+    return [], []
+
+
+@procedure("db.index.fulltext.listavailableanalyzers")
+def proc_fulltext_analyzers(ex: CypherExecutor, args, row):
+    return (
+        ["analyzer", "description"],
+        [["standard", "BM25 tokenizer (lowercase, word boundaries)"]],
+    )

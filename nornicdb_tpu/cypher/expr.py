@@ -174,6 +174,24 @@ def evaluate(e: ast.Expr, ctx: EvalContext) -> Any:
         if ctx.executor is None:
             raise CypherTypeError("pattern predicate requires executor context")
         return ctx.executor.eval_pattern_expr(e, ctx)
+    if isinstance(e, ast.LabelPredicate):
+        # n:Label[:Label...] — true iff the subject node has EVERY label;
+        # on a relationship, r:TYPE checks the relationship type (Neo4j 5
+        # relationship type expressions)
+        subject = evaluate(e.subject, ctx)
+        if subject is None:
+            return None
+        if isinstance(subject, Edge):
+            return subject.type in e.labels
+        if not isinstance(subject, Node):
+            raise CypherTypeError(
+                "label predicate expects a node or relationship"
+            )
+        return all(label in subject.labels for label in e.labels)
+    if isinstance(e, ast.CollectSubquery):
+        if ctx.executor is None:
+            raise CypherTypeError("COLLECT subquery requires executor context")
+        return ctx.executor.eval_collect_subquery(e, ctx)
     raise CypherTypeError(f"cannot evaluate {type(e).__name__}")
 
 
@@ -261,7 +279,7 @@ def _binary(e: ast.BinaryOp, ctx: EvalContext) -> Any:
     b = evaluate(e.right, ctx)
     if op == "=":
         return _eq(a, b)
-    if op == "<>":
+    if op in ("<>", "!="):  # != is the reference-dialect alias for <>
         r = _eq(a, b)
         return None if r is None else not r
     if op in ("<", ">", "<=", ">="):

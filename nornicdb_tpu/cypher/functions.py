@@ -316,6 +316,70 @@ def fn_right(s, n):
     return str(s)[-n:] if n > 0 else ""
 
 
+@register("lpad")
+def fn_lpad(s, length, pad=" "):
+    """lpad(string, length, padString) (ref:
+    functions_eval_functions.go:1229)."""
+    if _null_in(s, length, pad):
+        return None
+    s, pad = str(s), str(pad) or " "
+    need = int(length) - len(s)
+    if need <= 0:
+        return s
+    padding = (pad * (need // len(pad) + 1))[:need]
+    return padding + s
+
+
+@register("rpad")
+def fn_rpad(s, length, pad=" "):
+    """rpad(string, length, padString) (ref:
+    functions_eval_functions.go:1259)."""
+    if _null_in(s, length, pad):
+        return None
+    s, pad = str(s), str(pad) or " "
+    need = int(length) - len(s)
+    if need <= 0:
+        return s
+    padding = (pad * (need // len(pad) + 1))[:need]
+    return s + padding
+
+
+@register("format")
+def fn_format(template, *args):
+    """format(template, ...) — printf-style %s/%d/%f/%v
+    (ref: functions_eval_functions.go:1290)."""
+    if template is None:
+        return None
+    out = []
+    it = iter(args)
+    i = 0
+    t = str(template)
+    while i < len(t):
+        ch = t[i]
+        if ch == "%" and i + 1 < len(t):
+            spec = t[i + 1]
+            if spec == "%":
+                out.append("%")
+                i += 2
+                continue
+            if spec in "sdfv":
+                try:
+                    v = next(it)
+                except StopIteration:
+                    v = None
+                if spec == "d":
+                    out.append(str(int(v)) if v is not None else "null")
+                elif spec == "f":
+                    out.append(f"{float(v):f}" if v is not None else "null")
+                else:
+                    out.append("null" if v is None else str(v))
+                i += 2
+                continue
+        out.append(ch)
+        i += 1
+    return "".join(out)
+
+
 # ---------------------------------------------------------------- math
 @register("abs")
 def fn_abs(x):

@@ -67,6 +67,103 @@ for _m in list(SCORERS):
     procedure(f"gds.linkprediction.{_m.lower()}")(_make(_m))
 
 
+def _source_candidates(
+    ex: CypherExecutor, method: str, source: str, top_k: int
+) -> list[list[Any]]:
+    """Per-source candidate streaming: score `source` against every
+    non-adjacent node (ref: the map-config .stream form,
+    gds.linkPrediction.X.stream({sourceNode, topK}) linkprediction.go)."""
+    g = _cached_graph(ex)
+    if source not in g.index:
+        return []
+    si = g.index[source]
+    scored = []
+    for j in range(g.n):
+        if j == si or j in g.neighbors[si]:
+            continue
+        v = score_pair(g, source, g.ids[j], method)
+        if v > 0:
+            scored.append((g.ids[j], v))
+    scored.sort(key=lambda t: -t[1])
+    rows = []
+    for b_id, v in scored[:top_k]:
+        na, nb = ex.get_node_or_none(source), ex.get_node_or_none(b_id)
+        if na is not None and nb is not None:
+            rows.append([na, nb, v])
+    return rows
+
+
+def _stream_config(args: list[Any]) -> tuple[str, int]:
+    cfg = args[0] if args and isinstance(args[0], dict) else {}
+    source = cfg.get("sourceNode", "")
+    # accept a Node object or an id string (same normalization as _lp_pair)
+    source = source.id if isinstance(source, Node) else str(source)
+    top_k = int(cfg.get("topK", 10))
+    return source, top_k
+
+
+for _m in list(SCORERS):
+    def _make_stream(meth):
+        def fn(ex, args, row):
+            source, top_k = _stream_config(args)
+            if not source:
+                raise CypherSyntaxError("sourceNode required")
+            return (["node1", "node2", "score"],
+                    _source_candidates(ex, meth, source, top_k))
+
+        return fn
+
+    procedure(f"gds.linkprediction.{_m.lower()}.stream")(_make_stream(_m))
+
+
+@procedure("gds.linkprediction.predict.stream")
+def proc_lp_predict_stream(ex: CypherExecutor, args, row):
+    """Hybrid topology+semantic prediction stream (ref: hybrid.go:61-222,
+    gds.linkPrediction.predict.stream)."""
+    from nornicdb_tpu.linkpredict.topology import HybridConfig, hybrid_score
+
+    cfg = args[0] if args and isinstance(args[0], dict) else {}
+    source = cfg.get("sourceNode", "")
+    source = source.id if isinstance(source, Node) else str(source)
+    if not source:
+        raise CypherSyntaxError("sourceNode required")
+    top_k = int(cfg.get("topK", 10))
+    method = str(cfg.get("algorithm", "adamic_adar"))
+    method = {"adamic_adar": "adamicAdar", "common_neighbors":
+              "commonNeighbors", "preferential_attachment":
+              "preferentialAttachment", "resource_allocation":
+              "resourceAllocation"}.get(method, method)
+    hcfg = HybridConfig(
+        topology_weight=float(cfg.get("topologyWeight", 0.5)),
+        semantic_weight=float(cfg.get("semanticWeight", 0.5)),
+    )
+    if method in SCORERS:
+        hcfg.methods = [method]
+    g = _cached_graph(ex)
+    if source not in g.index:
+        return ["node1", "node2", "score"], []
+    src_node = ex.get_node_or_none(source)
+    emb_a = src_node.embedding if src_node is not None else None
+    si = g.index[source]
+    scored = []
+    for j in range(g.n):
+        if j == si or j in g.neighbors[si]:
+            continue
+        b_id = g.ids[j]
+        nb = ex.get_node_or_none(b_id)
+        emb_b = nb.embedding if nb is not None else None
+        v = hybrid_score(g, source, b_id, emb_a, emb_b, hcfg)
+        if v > 0:
+            scored.append((b_id, v))
+    scored.sort(key=lambda t: -t[1])
+    rows = []
+    for b_id, v in scored[:top_k]:
+        nb = ex.get_node_or_none(b_id)
+        if src_node is not None and nb is not None:
+            rows.append([src_node, nb, v])
+    return ["node1", "node2", "score"], rows
+
+
 @procedure("gds.linkprediction.suggest")
 def proc_lp_suggest(ex: CypherExecutor, args, row):
     """Top non-adjacent candidate pairs (ref: linkprediction.go suggest)."""
@@ -79,6 +176,18 @@ def proc_lp_suggest(ex: CypherExecutor, args, row):
         if na is not None and nb is not None:
             rows.append([na, nb, score])
     return ["node1", "node2", "score"], rows
+
+
+@procedure("gds.fastrp.stats")
+def proc_fastrp_stats(ex: CypherExecutor, args, row):
+    """gds.fastRP.stats(name, config) — summary counts without streaming
+    embeddings (ref: fastrp.go stats mode)."""
+    cfg = next((a for a in args if isinstance(a, dict)), {})
+    g = _cached_graph(ex)
+    return (
+        ["nodeCount", "embeddingDimension"],
+        [[g.n, int(cfg.get("embeddingDimension", 128))]],
+    )
 
 
 @procedure("gds.fastrp.stream")
@@ -127,6 +236,59 @@ def _kalman_states(ex: CypherExecutor) -> dict[str, Kalman]:
         states = {}
         ex._kalman_states = states
     return states
+
+
+@register("kalman.init")
+def fn_kalman_init(config=None):
+    """kalman.init([config]) -> state JSON string stored on a node
+    property (ref: kalman_functions.go:254 kalmanInit — Q scales
+    processNoise by 0.001, defaults R=88, P=30, varianceScale=10)."""
+    import json as _json
+
+    state = {
+        "x": 0.0, "p": 30.0, "q": 0.1 * 0.001, "r": 88.0,
+        "varianceScale": 10.0, "initialized": False,
+    }
+    if isinstance(config, dict):
+        if config.get("processNoise") is not None:
+            state["q"] = float(config["processNoise"]) * 0.001
+        if config.get("measurementNoise") is not None:
+            state["r"] = float(config["measurementNoise"])
+        if config.get("initialCovariance") is not None:
+            state["p"] = float(config["initialCovariance"])
+        if config.get("varianceScale") is not None:
+            state["varianceScale"] = float(config["varianceScale"])
+    return _json.dumps(state)
+
+
+@register("kalman.process")
+def fn_kalman_process(measurement, state):
+    """kalman.process(measurement, stateJson) -> {value, state}
+    (ref: kalmanProcess — returns the smoothed value plus the updated
+    state JSON to store back on the node)."""
+    import json as _json
+
+    if measurement is None or state is None:
+        return None
+    s = _json.loads(state)
+    z = float(measurement)
+    if not s.get("initialized"):
+        s["x"] = z
+        s["initialized"] = True
+    else:
+        p = s["p"] + s["q"]
+        k = p / (p + s["r"])
+        s["x"] = s["x"] + k * (z - s["x"])
+        s["p"] = (1 - k) * p
+    return {"value": s["x"], "state": _json.dumps(s)}
+
+
+@register("kalman.state")
+def fn_kalman_state(state):
+    """kalman.state(stateJson) -> MAP view of the stored filter state."""
+    import json as _json
+
+    return None if state is None else _json.loads(state)
 
 
 @register("kalman.filter")

@@ -26,6 +26,7 @@ KEYWORDS = {
     "POINT", "LOOKUP", "BTREE", "BEGIN", "COMMIT", "ROLLBACK", "EXPLAIN",
     "PROFILE", "INDEXES", "CONSTRAINTS", "PROCEDURES", "FUNCTIONS", "ALIAS",
     "ALIASES", "COMPOSITE", "SHORTESTPATH", "ALLSHORTESTPATHS", "OPTIONS",
+    "ALTER", "ADD", "COLLECT",
 }
 
 
@@ -40,7 +41,7 @@ class Token:
         return f"{self.kind}:{self.value}"
 
 
-_MULTI_OPS = ["<>", "<=", ">=", "=~", "->", "<-", "..", "+=", "||"]
+_MULTI_OPS = ["<>", "<=", ">=", "=~", "->", "<-", "..", "+=", "||", "!="]
 _SINGLE_OPS = "()[]{}.,:;|=<>+-*/%^"
 
 

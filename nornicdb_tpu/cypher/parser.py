@@ -119,9 +119,36 @@ class Parser:
             "LOOKUP", "BTREE", "DATABASE", "COMPOSITE", "ALIAS", "OR",
         ):
             return self.parse_ddl_create()
+        if self.at_kw("ALTER"):
+            return self.parse_alter()
         if self.at_kw("DROP"):
             return self.parse_ddl_drop()
         return self.parse_query()
+
+    def parse_alter(self) -> ast.DatabaseCommand:
+        """ALTER COMPOSITE DATABASE name ADD|DROP ALIAS a [FOR DATABASE t]
+        (ref: composite management, pkg/multidb/composite.go + the
+        reference's system-command tests)."""
+        self.expect_kw("ALTER")
+        self.expect_kw("COMPOSITE")
+        self.expect_kw("DATABASE")
+        name = self.expect_ident()
+        if self.accept_kw("ADD"):
+            self.expect_kw("ALIAS")
+            alias = self.expect_ident()
+            self.expect_kw("FOR")
+            self.expect_kw("DATABASE")
+            target = self.expect_ident()
+            return ast.DatabaseCommand(
+                "composite_add_alias", name,
+                options={"alias": alias, "target": target},
+            )
+        self.expect_kw("DROP")
+        self.expect_kw("ALIAS")
+        alias = self.expect_ident()
+        return ast.DatabaseCommand(
+            "composite_drop_alias", name, options={"alias": alias}
+        )
 
     # -- USE / SHOW / DDL ------------------------------------------------------
     def parse_use(self) -> ast.UseCommand:
@@ -210,9 +237,15 @@ class Parser:
         label = self.expect_ident()
         self.expect_op(")")
         self.expect_kw("ON")
-        # ON EACH [(n.prop)] for fulltext; ON (n.prop, ...) otherwise
+        # ON EACH [n.prop, ...] for fulltext (Neo4j bracket form);
+        # ON (n.prop, ...) otherwise — both delimiters accepted for both
         self.accept_ident_value("each")
-        self.expect_op("(")
+        if self.at_op("["):
+            self.advance()
+            closer = "]"
+        else:
+            self.expect_op("(")
+            closer = ")"
         props = []
         while True:
             v = self.expect_ident()
@@ -220,7 +253,7 @@ class Parser:
             props.append(self.expect_ident())
             if not self.accept_op(","):
                 break
-        self.expect_op(")")
+        self.expect_op(closer)
         options: dict[str, Any] = {}
         if self.accept_kw("OPTIONS"):
             m = self.parse_map_literal()
@@ -363,7 +396,12 @@ class Parser:
             expr = self.parse_expr()
             self.expect_kw("AS")
             var = self.expect_ident()
-            return ast.UnwindClause(expr, var)
+            where = None
+            if self.accept_kw("WHERE"):
+                # UNWIND ... WHERE: reference-dialect extension used by
+                # the Mimir workloads (a row filter on the unwound var)
+                where = self.parse_expr()
+            return ast.UnwindClause(expr, var, where)
         if self.at_kw("CALL"):
             return self.parse_call()
         if self.at_kw("FOREACH"):
@@ -535,6 +573,11 @@ class Parser:
                     if self.cur.kind == "NUMBER":
                         sub.batch_rows = int(self.advance().value)
                     self.expect_ident_value("rows")
+            # reference-dialect tail: CALL { ... } ORDER BY/SKIP/LIMIT
+            # applied to the subquery's output rows without a RETURN
+            if self.at_kw("ORDER", "SKIP", "LIMIT"):
+                sub.order_by, sub.skip, sub.limit = \
+                    self.parse_order_skip_limit()
             return sub
         name = self.expect_ident()
         while self.accept_op("."):
@@ -542,9 +585,25 @@ class Parser:
         args: list[ast.Expr] = []
         if self.accept_op("("):
             if not self.at_op(")"):
-                args.append(self.parse_expr())
-                while self.accept_op(","):
+                if (
+                    self.cur.kind == "IDENT"
+                    and self.peek().kind == "OP"
+                    and self.peek().value == ":"
+                ):
+                    # named-argument form CALL p(key: v, ...) — reference
+                    # dialect for gds.* config; folds into one map arg
+                    items: dict[str, ast.Expr] = {}
+                    while True:
+                        key = self.expect_ident()
+                        self.expect_op(":")
+                        items[key] = self.parse_expr()
+                        if not self.accept_op(","):
+                            break
+                    args.append(ast.MapLiteral(items))
+                else:
                     args.append(self.parse_expr())
+                    while self.accept_op(","):
+                        args.append(self.parse_expr())
             self.expect_op(")")
         yields: list[tuple[str, Optional[str]]] = []
         ystar = False
@@ -563,7 +622,12 @@ class Parser:
                         break
             if self.accept_kw("WHERE"):
                 where = self.parse_expr()
-        return ast.CallClause(name.lower(), args, yields, where, ystar)
+        call = ast.CallClause(name.lower(), args, yields, where, ystar)
+        # standalone-call tail: CALL ... YIELD ... [ORDER BY][SKIP][LIMIT]
+        # without a RETURN (used by the reference's fulltext tests)
+        if (ystar or yields) and self.at_kw("ORDER", "SKIP", "LIMIT"):
+            call.order_by, call.skip, call.limit = self.parse_order_skip_limit()
+        return call
 
     def parse_foreach(self) -> ast.ForeachClause:
         self.expect_kw("FOREACH")
@@ -724,6 +788,11 @@ class Parser:
         if not self.at_op("}"):
             while True:
                 key = self.expect_ident() if self.cur.kind != "STRING" else self.advance().value
+                # dotted config keys (vector.dimensions: 768 — the
+                # reference's index OPTIONS maps use them unquoted)
+                while self.at_op(".") :
+                    self.advance()
+                    key += "." + self.expect_ident()
                 self.expect_op(":")
                 items[key] = self.parse_expr()
                 if not self.accept_op(","):
@@ -765,7 +834,7 @@ class Parser:
     def parse_comparison(self) -> ast.Expr:
         left = self.parse_additive()
         while True:
-            if self.at_op("=", "<>", "<", ">", "<=", ">=", "=~"):
+            if self.at_op("=", "<>", "!=", "<", ">", "<=", ">=", "=~"):
                 op = self.advance().value
                 left = ast.BinaryOp(op, left, self.parse_additive())
             elif self.at_kw("IN"):
@@ -833,6 +902,20 @@ class Parser:
                 and self.peek().value in (".", "}")
             ):
                 e = self.parse_map_projection(e)
+                continue
+            # label predicate: n:Label[:Label...] as a boolean expression
+            # (WHERE p:Employee — Neo4j label expression)
+            if (
+                isinstance(e, (ast.Variable, ast.LabelPredicate))
+                and self.at_op(":")
+                and self.peek().kind in ("IDENT", "KEYWORD")
+            ):
+                self.advance()
+                label = self.expect_ident()
+                if isinstance(e, ast.LabelPredicate):
+                    e.labels.append(label)
+                else:
+                    e = ast.LabelPredicate(e, [label])
                 continue
             if self.at_op("."):
                 # property access; but don't eat ".." (range)
@@ -929,6 +1012,14 @@ class Parser:
                 return self.parse_count_atom()
             if t.value == "EXISTS" and self.peek().value in ("(", "{"):
                 return self.parse_exists_atom()
+            if t.value == "COLLECT" and self.peek().value == "{":
+                # COLLECT { MATCH ... RETURN expr } — Neo4j 5 collect
+                # subquery (single-column full query -> list)
+                self.advance()
+                self.expect_op("{")
+                inner = self.parse_query()
+                self.expect_op("}")
+                return ast.CollectSubquery(inner)
             if t.value == "ALL" and self.peek().value == "(":
                 # ALL is a keyword (UNION ALL) but also the all() quantifier
                 q = self.try_parse_quantifier("all")

@@ -261,6 +261,20 @@ class DatabaseManager:
                 self._persist_db(composite, composite=self._composites[composite])
                 self._engines.pop(composite, None)
 
+    def remove_constituent(self, composite: str, database: str) -> None:
+        """(ref: ALTER COMPOSITE DATABASE ... DROP ALIAS, composite.go)"""
+        with self._lock:
+            if composite not in self._composites:
+                raise NotFoundError(f"composite {composite} not found")
+            if database in self._composites[composite]:
+                self._composites[composite].remove(database)
+                try:
+                    self._system.delete_node(f"db-{composite}")
+                except NotFoundError:
+                    pass
+                self._persist_db(composite, composite=self._composites[composite])
+                self._engines.pop(composite, None)
+
     # -- aliases -------------------------------------------------------------------
     def create_alias(self, alias: str, target: str) -> None:
         with self._lock:
