@@ -27,6 +27,10 @@ class ServerConfig:
     bolt_port: int = 7687
     auth_enabled: bool = False
     base_path: str = ""
+    jwt_secret: str = ""
+    token_ttl: float = 24 * 3600.0
+    max_failed_logins: int = 5
+    lockout_duration: float = 300.0
 
 
 @dataclass
@@ -110,24 +114,70 @@ def load_from_file(path: str, cfg: Optional[AppConfig] = None) -> AppConfig:
     return cfg
 
 
+# the reference's flat env names -> (section, field) here, so a user
+# migrating from the reference keeps their environment working
+# (ref: pkg/config/config.go LoadFromEnv + cmd/nornicdb/main.go:108-141)
+ENV_ALIASES: dict[str, tuple[str, str]] = {
+    "NORNICDB_DATA_DIR": ("database", "data_dir"),
+    "NORNICDB_HTTP_PORT": ("server", "http_port"),
+    "NORNICDB_BOLT_PORT": ("server", "bolt_port"),
+    "NORNICDB_ADDRESS": ("server", "host"),
+    "NORNICDB_HOST": ("server", "host"),
+    "NORNICDB_AUTH": ("server", "auth_enabled"),
+    "NORNICDB_AUTH_ENABLED": ("server", "auth_enabled"),
+    "NORNICDB_BASE_PATH": ("server", "base_path"),
+    "NORNICDB_AUTH_JWT_SECRET": ("server", "jwt_secret"),
+    "NORNICDB_AUTH_TOKEN_EXPIRY": ("server", "token_ttl"),
+    "NORNICDB_MAX_FAILED_LOGINS": ("server", "max_failed_logins"),
+    "NORNICDB_LOCKOUT_DURATION": ("server", "lockout_duration"),
+    "NORNICDB_ENCRYPTION_AT_REST": ("database", "encryption_enabled"),
+    "NORNICDB_ENCRYPTION_KEY": ("database", "encryption_key"),
+    "NORNICDB_ASYNC_WRITES_ENABLED": ("database", "async_writes"),
+    "NORNICDB_STRICT_DURABILITY": ("database", "wal_sync"),
+    "NORNICDB_EMBEDDING_ENABLED": ("embedding", "enabled"),
+    "NORNICDB_EMBEDDING_PROVIDER": ("embedding", "provider"),
+    "NORNICDB_EMBEDDING_DIMENSIONS": ("embedding", "dimensions"),
+    "NORNICDB_EMBEDDING_CACHE_SIZE": ("embedding", "cache_size"),
+    "NORNICDB_EMBEDDING_WORKERS": ("embedding", "workers"),
+    "NORNICDB_MEMORY_DECAY_ENABLED": ("memory", "decay_enabled"),
+    "NORNICDB_MEMORY_DECAY_INTERVAL": ("memory", "decay_interval"),
+    "NORNICDB_QUERY_CACHE_SIZE": ("memory", "query_cache_size"),
+    "NORNICDB_QUERY_CACHE_TTL": ("memory", "query_cache_ttl"),
+    "NORNICDB_AUDIT_ENABLED": ("compliance", "audit_enabled"),
+    "NORNICDB_AUDIT_LOG_PATH": ("compliance", "audit_path"),
+    "NORNICDB_RETENTION_ENABLED": ("compliance", "retention_enabled"),
+}
+
+
+def _coerce_env(current: Any, raw: str) -> Any:
+    if isinstance(current, bool):
+        # the reference's WAL sync mode takes words, not just booleans
+        return raw.lower() in ("1", "true", "yes", "always", "sync")
+    if isinstance(current, int):
+        return int(raw)
+    if isinstance(current, float):
+        return float(raw)
+    return raw
+
+
 def load_from_env(cfg: Optional[AppConfig] = None) -> AppConfig:
-    """NORNICDB_<SECTION>_<FIELD> (ref: LoadFromEnv)."""
+    """NORNICDB_<SECTION>_<FIELD>, plus the reference's flat names via
+    ENV_ALIASES; the section form wins when both are set
+    (ref: LoadFromEnv)."""
     cfg = cfg or AppConfig()
+    for env, (section_name, field_name) in ENV_ALIASES.items():
+        if env in os.environ:
+            section = getattr(cfg, section_name)
+            current = getattr(section, field_name)
+            setattr(section, field_name, _coerce_env(current, os.environ[env]))
     for section_field in fields(cfg):
         section = getattr(cfg, section_field.name)
         for f in fields(section):
             env = f"{ENV_PREFIX}{section_field.name.upper()}_{f.name.upper()}"
             if env in os.environ:
-                raw = os.environ[env]
                 current = getattr(section, f.name)
-                if isinstance(current, bool):
-                    setattr(section, f.name, raw.lower() in ("1", "true", "yes"))
-                elif isinstance(current, int):
-                    setattr(section, f.name, int(raw))
-                elif isinstance(current, float):
-                    setattr(section, f.name, float(raw))
-                else:
-                    setattr(section, f.name, raw)
+                setattr(section, f.name,
+                        _coerce_env(current, os.environ[env]))
     return cfg
 
 
@@ -155,10 +205,26 @@ class FeatureFlags:
         "query_cache": True,
     }
 
+    # the reference's flag env names (feature_flags.go) -> flag keys here
+    ENV_FLAG_ALIASES = {
+        "NORNICDB_KALMAN_ENABLED": "kalman",
+        "NORNICDB_AUTO_TLP_ENABLED": "auto_tlp",
+        "NORNICDB_AUTO_TLP_LLM_QC_ENABLED": "llm_qc",
+        "NORNICDB_KMEANS_CLUSTERING_ENABLED": "gpu_clustering",
+        "NORNICDB_COOLDOWNS_ENABLED": "cooldowns",
+        "NORNICDB_MMR_ENABLED": "mmr",
+        "NORNICDB_RERANK_ENABLED": "cross_encoder_rerank",
+        "NORNICDB_QUERY_CACHE_ENABLED": "query_cache",
+    }
+
     def __init__(self) -> None:
         self._lock = threading.Lock()
         self._flags = dict(self.DEFAULTS)
-        # env overrides: NORNICDB_FLAG_<NAME>=true/false
+        # reference-style names first, NORNICDB_FLAG_<NAME> wins over them
+        for env, name in self.ENV_FLAG_ALIASES.items():
+            raw = os.environ.get(env)
+            if raw is not None:
+                self._flags[name] = raw.lower() in ("1", "true", "yes")
         for name in list(self._flags):
             env = os.environ.get(f"{ENV_PREFIX}FLAG_{name.upper()}")
             if env is not None:
