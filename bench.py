@@ -31,7 +31,132 @@ from __future__ import annotations
 
 import functools
 import json
+import os
+import subprocess
+import sys
 import time
+
+# --- backend acquisition (the relay to the dev chip provably flaps) --------
+#
+# A failed TPU-backend init poisons the JAX process (the error is cached), and
+# a down relay can also HANG jax.devices() for minutes. So the orchestration
+# is out-of-process: the parent polls for the backend with short-lived probe
+# subprocesses, then runs the actual bench as a child process, and retries the
+# whole child if it dies with a backend-unavailable error. stdout stays
+# reserved for the single JSON result line; all orchestration chatter goes to
+# stderr.
+
+_CHILD_ENV = "NORNICDB_BENCH_CHILD"
+ACQUIRE_BUDGET_S = float(os.environ.get("NORNICDB_BENCH_ACQUIRE_BUDGET_S", "900"))
+PROBE_TIMEOUT_S = 150.0  # jax.devices() hangs >90s when the relay is down
+CHILD_TIMEOUT_S = float(os.environ.get("NORNICDB_BENCH_CHILD_TIMEOUT_S", "1500"))
+
+_BACKEND_ERR_MARKERS = (
+    "UNAVAILABLE",
+    "Unable to initialize backend",
+    "TPU backend setup",
+    "DEADLINE_EXCEEDED",
+    "failed to connect",
+)
+
+
+def _log(msg: str) -> None:
+    print(f"[bench] {msg}", file=sys.stderr, flush=True)
+
+
+def _probe_backend() -> str | None:
+    """Check backend health in a throwaway subprocess. Returns platform or None."""
+    try:
+        r = subprocess.run(
+            [sys.executable, "-c", "import jax; print(jax.devices()[0].platform)"],
+            capture_output=True,
+            text=True,
+            timeout=PROBE_TIMEOUT_S,
+        )
+    except subprocess.TimeoutExpired:
+        _log(f"probe hung >{PROBE_TIMEOUT_S:.0f}s (relay down), will retry")
+        return None
+    if r.returncode == 0 and r.stdout.strip():
+        return r.stdout.strip().splitlines()[-1]
+    tail = (r.stderr or "").strip().splitlines()
+    _log(f"probe failed rc={r.returncode}: {tail[-1] if tail else '?'}")
+    return None
+
+
+def _acquire_backend(deadline: float) -> str | None:
+    """Poll until the backend answers or the budget runs out."""
+    delay = 20.0
+    attempt = 0
+    while True:
+        attempt += 1
+        platform = _probe_backend()
+        if platform is not None:
+            _log(f"backend up (platform={platform}) after {attempt} probe(s)")
+            return platform
+        remaining = deadline - time.monotonic()
+        if remaining <= 0:
+            return None
+        sleep_s = min(delay, remaining)
+        _log(f"backend down; retrying in {sleep_s:.0f}s ({remaining:.0f}s budget left)")
+        time.sleep(sleep_s)
+        delay = min(delay * 1.7, 120.0)
+
+
+def _run_child() -> int | None:
+    """Run the real bench in a child; forward its stdout JSON line through.
+
+    Returns the final exit code, or None when the attempt is retryable
+    (timeout, backend-unavailable error, or signal death — a crashing TPU
+    client is a relay symptom too)."""
+    env = dict(os.environ, **{_CHILD_ENV: "1"})
+    try:
+        r = subprocess.run(
+            [sys.executable, os.path.abspath(__file__)],
+            capture_output=True,
+            text=True,
+            timeout=CHILD_TIMEOUT_S,
+            env=env,
+        )
+    except subprocess.TimeoutExpired as e:
+        for buf in (e.stderr, e.stdout):
+            if buf:
+                sys.stderr.write(
+                    buf if isinstance(buf, str) else buf.decode(errors="replace")
+                )
+        _log(f"bench child exceeded {CHILD_TIMEOUT_S:.0f}s; will retry if budget allows")
+        return None
+    if r.stderr:
+        sys.stderr.write(r.stderr)
+    if r.returncode == 0:
+        # forward only the result line(s) to stdout
+        for line in r.stdout.splitlines():
+            if line.startswith("{"):
+                print(line, flush=True)
+        return 0
+    if r.returncode < 0:
+        _log(f"bench child died with signal {-r.returncode}; retryable")
+        return None
+    tail = "\n".join((r.stderr or "").strip().splitlines()[-30:])
+    if any(m in tail for m in _BACKEND_ERR_MARKERS):
+        _log("bench child died with a backend-unavailable error; retryable")
+        return None
+    _log(f"bench child failed non-retryably rc={r.returncode}")
+    sys.stderr.write(r.stdout)
+    return r.returncode
+
+
+def _orchestrate() -> int:
+    deadline = time.monotonic() + ACQUIRE_BUDGET_S
+    while True:
+        if _acquire_backend(deadline) is None:
+            _log(f"backend never came up within {ACQUIRE_BUDGET_S:.0f}s; giving up")
+            return 2
+        rc = _run_child()
+        if rc is not None:
+            return rc
+        if time.monotonic() >= deadline:
+            _log("retry budget exhausted after child failure; giving up")
+            return 2
 
 N = 1_000_000
 D = 1024
@@ -184,4 +309,7 @@ def main() -> None:
 
 
 if __name__ == "__main__":
-    main()
+    if os.environ.get(_CHILD_ENV) == "1":
+        main()
+    else:
+        sys.exit(_orchestrate())

@@ -244,14 +244,35 @@ class Authenticator:
             return False
 
     def verify_current_password(self, username: str, password: str) -> bool:
-        """Side-effect-free verification (no lockout counters, no audit
-        login events, no token minting) — for password-change flows where
-        the caller already holds an authorized session."""
-        try:
-            user = self.get_user(username)
-        except AuthError:
-            return False
-        return verify_password(password, user.password_hash)
+        """Verification for password-change flows: no token minting and no
+        login_ok/login_failed events, but failed attempts DO count toward
+        the account lockout and are audited — otherwise a hijacked session
+        could brute-force the current password unthrottled through
+        POST /auth/password while authenticate()'s lockout never engages."""
+        with self._lock:
+            try:
+                user = self.get_user(username)
+            except AuthError:
+                return False
+            now = time.time()
+            if user.locked_until > now:
+                self._audit(
+                    "password_verify_rejected",
+                    {"username": username, "reason": "locked"},
+                )
+                return False
+            if not verify_password(password, user.password_hash):
+                user.failed_attempts += 1
+                if user.failed_attempts >= self.config.lockout_threshold:
+                    user.locked_until = now + self.config.lockout_duration
+                    user.failed_attempts = 0
+                self._save_user(user)
+                self._audit("password_verify_failed", {"username": username})
+                return False
+            if user.failed_attempts:
+                user.failed_attempts = 0
+                self._save_user(user)
+            return True
 
     def authenticate(self, username: str, password: str) -> str:
         """Returns a JWT on success (ref: Authenticate auth.go:970)."""

@@ -351,6 +351,46 @@ class Query:
     profile: bool = False
 
 
+_UPDATING_CLAUSES = (
+    CreateClause, MergeClause, SetClause, RemoveClause, DeleteClause,
+    ForeachClause, LoadCsvClause,
+)
+
+# procedures known to be pure reads; every other CALL is treated as updating.
+# Shared with the executor's read/write classification so the parse-time
+# COLLECT gate and RBAC/cacheability never disagree on what counts as a write.
+READONLY_PROCEDURES = (
+    "db.labels", "db.relationshiptypes", "db.propertykeys",
+    "dbms.components", "db.index.vector.querynodes",
+    "db.index.fulltext.querynodes", "apoc.help",
+    # every gds.* procedure streams read-only results
+    "gds.",
+    # read-only graph scans/traversals; NOT apoc.lock./apoc.export. etc. —
+    # side-effectful-but-non-mutating procedures must stay write-classified
+    # or the cache would skip their side effects on repeat calls
+    "apoc.search.", "apoc.path.", "apoc.meta.",
+    "apoc.schema.nodes", "apoc.schema.relationships",
+)
+
+
+def has_updating_clause(q: "Query") -> bool:
+    """True if the query (or a nested CALL { } subquery / UNION branch)
+    contains an updating clause, including CALLs of procedures not known to
+    be read-only. Used to reject writes where Neo4j forbids them
+    (COLLECT { } subqueries) and to keep read/write classification honest
+    for expression-level subqueries."""
+    for c in q.clauses:
+        if isinstance(c, _UPDATING_CLAUSES):
+            return True
+        if isinstance(c, CallClause) and not c.procedure.startswith(
+            READONLY_PROCEDURES
+        ):
+            return True
+        if isinstance(c, CallSubquery) and has_updating_clause(c.query):
+            return True
+    return any(has_updating_clause(sub) for sub, _ in q.unions)
+
+
 # ---------------------------------------------------------------- DDL / admin
 @dataclass
 class CreateIndex:

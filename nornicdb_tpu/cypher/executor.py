@@ -1687,7 +1687,10 @@ class CypherExecutor:
             alias = stmt.options["alias"]
             target = mgr.resolve(alias)
             constituents = mgr._composites.get(stmt.name, [])
-            if target == alias and alias not in constituents:
+            # the resolved target must actually be a constituent — otherwise
+            # remove_constituent would no-op while drop_alias still deleted
+            # the global alias, half-applying the command
+            if target not in constituents:
                 raise NotFoundError(
                     f"alias {alias} not found in composite {stmt.name}"
                 )
@@ -1714,25 +1717,14 @@ class CypherExecutor:
 
 
 # ---------------------------------------------------------------- helpers
-_WRITE_CLAUSES = (
-    ast.CreateClause, ast.MergeClause, ast.SetClause, ast.RemoveClause,
-    ast.DeleteClause, ast.ForeachClause, ast.LoadCsvClause,
-)
+# single source of truth in ast.py, shared with has_updating_clause so the
+# parse-time COLLECT gate and RBAC/cache classification can't diverge
+_WRITE_CLAUSES = ast._UPDATING_CLAUSES
 
 
 # procedures known to be pure reads; everything else is treated as a write
-_READONLY_PROCEDURES = (
-    "db.labels", "db.relationshiptypes", "db.propertykeys",
-    "dbms.components", "db.index.vector.querynodes",
-    "db.index.fulltext.querynodes", "apoc.help",
-    # every gds.* procedure streams read-only results
-    "gds.",
-    # read-only graph scans/traversals; NOT apoc.lock./apoc.export. etc. —
-    # side-effectful-but-non-mutating procedures must stay write-classified
-    # or the cache would skip their side effects on repeat calls
-    "apoc.search.", "apoc.path.", "apoc.meta.",
-    "apoc.schema.nodes", "apoc.schema.relationships",
-)
+# (single source of truth in ast.py, shared with has_updating_clause)
+_READONLY_PROCEDURES = ast.READONLY_PROCEDURES
 
 _NONDETERMINISTIC_FNS = {
     "rand", "randomuuid", "timestamp",
@@ -1783,6 +1775,13 @@ def _is_write_query(q: ast.Query) -> bool:
         ):
             return True  # index DDL procs / apoc.create / unknown may mutate
         if isinstance(c, ast.CallSubquery) and _is_write_query(c.query):
+            return True
+    # defense-in-depth: query-bearing expressions (COLLECT { }) are rejected
+    # at parse time when they contain updating clauses, but classification
+    # must not depend on that — an AST built another way still classifies
+    # correctly for RBAC and cacheability.
+    for node in _walk_exprs(q):
+        if isinstance(node, ast.CollectSubquery) and _is_write_query(node.query):
             return True
     return any(_is_write_query(sub) for sub, _ in q.unions)
 
@@ -1859,7 +1858,13 @@ def _read_cache_labels(q: ast.Query) -> set[str]:
             labels.update(inner)
     for node in _walk_exprs(q):
         if isinstance(
-            node, (ast.PatternPredicate, ast.ExistsSubquery, ast.CountSubquery)
+            node,
+            (
+                ast.PatternPredicate,
+                ast.ExistsSubquery,
+                ast.CountSubquery,
+                ast.CollectSubquery,
+            ),
         ):
             return set()
     for sub, _ in q.unions:

@@ -1019,6 +1019,13 @@ class Parser:
                 self.expect_op("{")
                 inner = self.parse_query()
                 self.expect_op("}")
+                if ast.has_updating_clause(inner):
+                    # Neo4j: "A Collect Expression cannot contain any updating
+                    # clauses". Rejecting here also keeps the executor's
+                    # read/write classification (RBAC, cacheability) sound.
+                    raise self.error(
+                        "a COLLECT expression cannot contain updating clauses"
+                    )
                 return ast.CollectSubquery(inner)
             if t.value == "ALL" and self.peek().value == "(":
                 # ALL is a keyword (UNION ALL) but also the all() quantifier

@@ -103,9 +103,15 @@ class HttpServer:
         auth_required: bool = False,
         rate_limit: float = 0.0,  # requests/sec per client; 0 = unlimited
         serve_ui: bool = True,  # False = headless (ref: -tags noui)
+        cookie_secure: Optional[bool] = None,  # None = NORNICDB_COOKIE_SECURE
     ):
         self.db = db
         self.serve_ui = serve_ui
+        if cookie_secure is None:
+            cookie_secure = os.environ.get(
+                "NORNICDB_COOKIE_SECURE", ""
+            ).lower() in ("1", "true", "yes")
+        self.cookie_secure = cookie_secure
         self.host = host
         self.port = port
         self.authenticator = authenticator
@@ -732,9 +738,15 @@ class HttpServer:
                     "expires_in": int(self.authenticator.config.token_ttl),
                 },
                 extra_headers={
+                    # Max-Age tracks the JWT TTL (a longer-lived cookie would
+                    # just carry an expired bearer token); Secure when the
+                    # deployment terminates TLS (NORNICDB_COOKIE_SECURE=1 or
+                    # cookie_secure=True)
                     "Set-Cookie": (
                         f"nornicdb_token={token}; Path=/; HttpOnly; "
-                        f"SameSite=Lax; Max-Age={7 * 86400}"
+                        f"SameSite=Lax; "
+                        f"Max-Age={int(self.authenticator.config.token_ttl)}"
+                        + ("; Secure" if self.cookie_secure else "")
                     )
                 },
             )

@@ -749,6 +749,84 @@ class TestRBACGates:
         assert classify_query_text("CREATE INDEX FOR (n:P) ON (n.x)") == "write"
         assert classify_query_text("SHOW INDEXES") == "read"
 
+    def test_collect_subquery_rejects_updating_clauses(self):
+        """Advisor round-2 high: writes inside COLLECT { } bypassed
+        read/write classification. Neo4j rejects updating clauses in
+        COLLECT subqueries — so do we, at parse time."""
+        import pytest
+
+        from nornicdb_tpu.cypher.executor import classify_query_text
+        from nornicdb_tpu.errors import CypherSyntaxError
+
+        db = nornicdb_tpu.open_db("")
+        try:
+            with pytest.raises(CypherSyntaxError):
+                db.cypher("RETURN COLLECT { CREATE (n:X) RETURN n.id } AS c")
+            with pytest.raises(CypherSyntaxError):
+                # nested via CALL { } inside the collect subquery
+                db.cypher(
+                    "RETURN COLLECT { CALL { CREATE (m:Y) RETURN m } "
+                    "RETURN m.id } AS c"
+                )
+            # nothing executed
+            assert db.cypher("MATCH (n:X) RETURN count(n) AS c").rows[0][0] == 0
+            # read-only collect subqueries still work
+            db.cypher("CREATE (:P {k: 1})")
+            r = db.cypher("RETURN COLLECT { MATCH (p:P) RETURN p.k } AS ks")
+            assert r.rows[0][0] == [1]
+        finally:
+            db.close()
+        # defense-in-depth: even an AST built without the parse-time gate
+        # classifies as a write (RBAC + cacheability stay sound)
+        from nornicdb_tpu.cypher import ast
+        from nornicdb_tpu.cypher.executor import _is_write_query
+
+        inner = ast.Query(
+            clauses=[
+                ast.CreateClause(
+                    patterns=[
+                        ast.PatternPath(
+                            elements=[ast.NodePattern(None, ["X"], {})]
+                        )
+                    ]
+                ),
+                ast.ReturnClause(items=[ast.ReturnItem(ast.Literal(1), "one")]),
+            ]
+        )
+        outer = ast.Query(
+            clauses=[
+                ast.ReturnClause(
+                    items=[ast.ReturnItem(ast.CollectSubquery(inner), "c")]
+                )
+            ]
+        )
+        assert _is_write_query(outer)
+        # string form classifies conservatively too (parse rejects -> write)
+        assert (
+            classify_query_text("RETURN COLLECT { CREATE (n:X) RETURN n.id }")
+            == "write"
+        )
+
+    def test_composite_drop_alias_requires_constituent(self):
+        """Advisor round-2 low: ALTER COMPOSITE ... DROP ALIAS half-applied
+        (global alias deleted) when the alias target was not a constituent."""
+        import pytest
+
+        from nornicdb_tpu.errors import NotFoundError
+
+        db = nornicdb_tpu.open_db("")
+        try:
+            db.cypher("CREATE DATABASE d1")
+            db.cypher("CREATE DATABASE d2")
+            db.cypher("CREATE COMPOSITE DATABASE comp")
+            db.cypher("CREATE ALIAS a2 FOR DATABASE d2")  # NOT a constituent
+            with pytest.raises(NotFoundError):
+                db.cypher("ALTER COMPOSITE DATABASE comp DROP ALIAS a2")
+            # the global alias survived the failed command
+            assert db.database_manager.resolve("a2") == "d2"
+        finally:
+            db.close()
+
     def test_http_viewer_cannot_call_mutating_procedure(self):
         db = nornicdb_tpu.open_db("")
         auth = Authenticator(MemoryEngine())

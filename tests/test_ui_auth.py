@@ -268,6 +268,47 @@ class TestSecurityPage:
         assert status == 200
         _login(server, "bob", "newpw")  # new password works
 
+    def test_change_password_bruteforce_locks_out(self, auth_server):
+        """Advisor round-2: failed old-password verifications must count
+        toward the account lockout (unthrottled brute-forcing through
+        POST /auth/password from a hijacked session)."""
+        server, auth = auth_server
+        _, cookie = _login(server, "bob", "bobpw")
+        for _ in range(auth.config.lockout_threshold):
+            with pytest.raises(urllib.error.HTTPError) as e:
+                _req(server.port, "/auth/password", "POST",
+                     {"old_password": "wrong", "new_password": "x"},
+                     headers={"Cookie": cookie})
+            assert e.value.code == 401
+        # account now locked: even the CORRECT old password is refused
+        with pytest.raises(urllib.error.HTTPError) as e:
+            _req(server.port, "/auth/password", "POST",
+                 {"old_password": "bobpw", "new_password": "newpw"},
+                 headers={"Cookie": cookie})
+        assert e.value.code == 401
+        # and fresh logins are refused for the lockout duration
+        with pytest.raises(urllib.error.HTTPError) as e:
+            _req(server.port, "/auth/token", "POST",
+                 {"username": "bob", "password": "bobpw"})
+        assert e.value.code in (401, 423)
+
+    def test_session_cookie_attributes(self, auth_server):
+        """Cookie Max-Age tracks the JWT TTL; Secure only when configured."""
+        server, auth = auth_server
+        _, _, headers = _req(
+            server.port, "/auth/token", "POST",
+            {"username": "bob", "password": "bobpw"},
+        )
+        cookie = headers.get("Set-Cookie", "")
+        assert f"Max-Age={int(auth.config.token_ttl)}" in cookie
+        assert "Secure" not in cookie  # plain-HTTP test server
+        server.cookie_secure = True
+        _, _, headers = _req(
+            server.port, "/auth/token", "POST",
+            {"username": "bob", "password": "bobpw"},
+        )
+        assert "Secure" in headers.get("Set-Cookie", "")
+
     def test_api_token_admin_only(self, auth_server):
         server, _ = auth_server
         _, bob_cookie = _login(server, "bob", "bobpw")
