@@ -222,23 +222,26 @@ def main() -> None:
         _, out = jax.lax.scan(one, 0, qbatches)
         return out
 
-    @functools.partial(jax.jit, static_argnames=("k",))
-    def scan_search_streaming(qchunks, corpus, valid, k):
+    @functools.partial(jax.jit, static_argnames=("k", "epilogue"))
+    def scan_search_streaming(qchunks, corpus, valid, k, epilogue="sort"):
         def one(carry, q):
             v, i = streaming_cosine_topk(
                 q, corpus, valid, k, tile_n=STILE, rows=SROWS,
+                epilogue=epilogue,
             )
             return carry, (v, i)
 
         _, out = jax.lax.scan(one, 0, qchunks)
         return out
 
-    @functools.partial(jax.jit, static_argnames=("k",))
-    def scan_search_int8(qi_chunks, qs_chunks, c_i8, c_scale, valid, k):
+    @functools.partial(jax.jit, static_argnames=("k", "epilogue"))
+    def scan_search_int8(qi_chunks, qs_chunks, c_i8, c_scale, valid, k,
+                         epilogue="sort"):
         def one(carry, qc):
             qi, qs = qc
             v, i = streaming_cosine_topk_int8(
                 qi, qs, c_i8, c_scale, valid, k, tile_n=STILE, rows=SROWS,
+                epilogue=epilogue,
             )
             return carry, (v, i)
 
@@ -279,6 +282,23 @@ def main() -> None:
             )
         except Exception as e:
             errors["int8"] = f"{type(e).__name__}: {e}"[:200]
+        # the bin top-k epilogue is the measured hot spot beyond the GEMM:
+        # A/B the in-VMEM Pallas extraction and approx_max_k against the
+        # XLA sort used by the plain int8 path above
+        for ep in ("pallas", "approx"):
+            key = f"int8_{ep}_ep"
+            try:
+                v, _ = scan_search_int8(
+                    qi, qscale, c_i8, c_scale, valid, K, epilogue=ep
+                )
+                np.asarray(v)
+                results[key] = _best5(
+                    lambda: scan_search_int8(
+                        qi, qscale, c_i8, c_scale, valid, K, epilogue=ep
+                    )[0]
+                )
+            except Exception as e:
+                errors[key] = f"{type(e).__name__}: {e}"[:200]
 
     path = min(results, key=results.get)
     dt = results[path]

@@ -19,6 +19,7 @@ TPU-first design notes:
 from __future__ import annotations
 
 import functools
+import os
 from typing import Optional
 
 import jax
@@ -104,6 +105,17 @@ def cosine_topk(
 # dispatch overhead
 STREAMING_MIN_ROWS = 65_536
 
+# bin-reduction strategy for the streaming kernels ("sort" | "approx" |
+# "pallas", see pallas_kernels._topk_bins). Overridable per-deployment while
+# autotune data accumulates (benchmarks/kernel_autotune.py). Validated here
+# so a config typo fails at import, not inside the first jitted query.
+TOPK_EPILOGUE = os.environ.get("NORNICDB_TOPK_EPILOGUE", "sort")
+if TOPK_EPILOGUE not in ("sort", "approx", "pallas"):
+    raise ValueError(
+        f"NORNICDB_TOPK_EPILOGUE={TOPK_EPILOGUE!r}: "
+        "must be one of sort|approx|pallas"
+    )
+
 
 def topk_backend(
     queries: jax.Array,
@@ -150,11 +162,12 @@ def topk_backend(
                 return streaming_cosine_topk_int8(
                     q_i8, q_scale, quantized[0], quantized[1], valid,
                     min(k, n), tile_n=tile, rows=rows,
-                    interpret=not on_tpu,
+                    interpret=not on_tpu, epilogue=TOPK_EPILOGUE,
                 )
             return streaming_cosine_topk(
                 queries, corpus, valid, min(k, n),
                 tile_n=tile, rows=rows, interpret=not on_tpu,
+                epilogue=TOPK_EPILOGUE,
             )
     return cosine_topk(
         queries, corpus, valid, k, normalized=True, use_bf16=use_bf16,
