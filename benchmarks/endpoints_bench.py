@@ -5,12 +5,17 @@ boots the full server, verifies data parity across protocols, then
 load-tests each endpoint (concurrency 16, warmup, timed run, p50/p95/p99).
 
 Run: python benchmarks/endpoints_bench.py  (prints a JSON report).
+     python benchmarks/endpoints_bench.py --workers N   (route search REST /
+       GraphQL / gRPC through N SO_REUSEPORT worker processes)
+     python benchmarks/endpoints_bench.py --scaling     (sweep worker counts
+       on the read-heavy endpoints and print the scaling table)
 Not invoked by the driver's bench.py (which stays the single-metric kNN
 headline); this is the protocol-stack profile.
 """
 
 from __future__ import annotations
 
+import argparse
 import json
 import socket
 import statistics
@@ -141,13 +146,29 @@ def _load_procs(fn, concurrency, run_s) -> dict:
             **_percentiles(samples)}
 
 
-def main() -> None:
+def _wait_http(port: int, timeout: float = 60.0) -> None:
+    import http.client as _hc
+
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        try:
+            c = _hc.HTTPConnection("127.0.0.1", port, timeout=5)
+            c.request("GET", "/health")
+            c.getresponse().read()
+            c.close()
+            return
+        except OSError:
+            time.sleep(0.25)
+    raise RuntimeError(f"port {port} never became reachable")
+
+
+def main(workers: int = 0) -> None:
     import jax
 
     jax.config.update("jax_platforms", "cpu")
     import nornicdb_tpu
     from nornicdb_tpu.embed import HashEmbedder
-    from nornicdb_tpu.server import BoltServer, HttpServer
+    from nornicdb_tpu.server import BoltServer, HttpServer, WorkerPool
     from nornicdb_tpu.server.grpc_search import GrpcSearchServer, search_over_grpc
     from nornicdb_tpu.server.packstream import Structure, pack, unpack
 
@@ -165,6 +186,37 @@ def main() -> None:
     bolt_srv.start()
     grpc_srv = GrpcSearchServer(db, port=0)
     grpc_srv.start()
+
+    # optional prefork worker pools: read-heavy endpoints route through N
+    # SO_REUSEPORT frontends (server/workers.py); writes and Bolt stay on
+    # the primary
+    http_pool = grpc_pool = None
+    http_port, grpc_port = http_srv.port, grpc_srv.port
+    if workers > 0:
+        http_pool = WorkerPool(db, http_srv.port, n_workers=workers).start()
+        grpc_pool = WorkerPool(
+            db, grpc_srv.port, n_workers=workers, kind="grpc"
+        ).start()
+        _wait_http(http_pool.port)
+        # a dead gRPC pool must abort, not get reported as ~0 ops/s
+        import grpc as _g
+
+        from nornicdb_tpu.server.grpc_search import (
+            SERVICE_NAME as _SN, encode_search_request as _esr)
+
+        probe = _g.insecure_channel(f"127.0.0.1:{grpc_pool.port}").unary_unary(
+            f"/{_SN}/Search", request_serializer=lambda b: b,
+            response_deserializer=lambda b: b)
+        deadline = time.time() + 60
+        while True:
+            try:
+                probe(_esr("ready probe", 1), timeout=5)
+                break
+            except _g.RpcError:
+                if time.time() > deadline or grpc_pool.alive() == 0:
+                    raise RuntimeError("gRPC worker pool never became ready")
+                time.sleep(0.5)
+        http_port, grpc_port = http_pool.port, grpc_pool.port
 
     report: dict = {}
 
@@ -184,7 +236,7 @@ def main() -> None:
             if conn is None or getattr(local, "pid", None) != os.getpid():
                 local.pid = os.getpid()
                 conn = local.conn = _hc.HTTPConnection(
-                    "127.0.0.1", http_srv.port, timeout=10)
+                    "127.0.0.1", http_port, timeout=10)
             try:
                 conn.request("POST", path, body,
                              {"Content-Type": "application/json"})
@@ -288,7 +340,7 @@ def main() -> None:
         stub = getattr(local, "grpc_stub", None)
         if stub is None or getattr(local, "grpc_pid", None) != os.getpid():
             local.grpc_pid = os.getpid()
-            channel = _grpc.insecure_channel(f"127.0.0.1:{grpc_srv.port}")
+            channel = _grpc.insecure_channel(f"127.0.0.1:{grpc_port}")
             stub = local.grpc_stub = channel.unary_unary(
                 f"/{SERVICE_NAME}/Search",
                 request_serializer=lambda b: b,
@@ -300,6 +352,10 @@ def main() -> None:
 
     report["grpc_search"] = _load(grpc_query)
 
+    if http_pool is not None:
+        http_pool.stop()
+    if grpc_pool is not None:
+        grpc_pool.stop()
     grpc_srv.stop()
     bolt_srv.stop()
     http_srv.stop()
@@ -307,11 +363,51 @@ def main() -> None:
     import os
     cores = len(os.sched_getaffinity(0))
     print(json.dumps({"concurrency": CONCURRENCY, "run_seconds": RUN_S,
-                      "cores": cores,
+                      "cores": cores, "workers": workers,
                       "client_mode": "procs" if _use_process_clients()
                       else "threads",
                       "endpoints": report}, indent=2))
 
 
+def scaling_sweep(counts=(0, 1, 2, 4)) -> None:
+    """Worker-count scaling on the read-heavy endpoints (VERDICT round-2
+    item 3): run the full bench per worker count in a fresh subprocess so
+    each measurement starts from a cold, identical server."""
+    import os
+    import subprocess
+
+    rows = []
+    for n in counts:
+        r = subprocess.run(
+            [sys.executable, os.path.abspath(__file__), "--workers", str(n)],
+            capture_output=True, text=True, timeout=600,
+        )
+        line = r.stdout[r.stdout.index("{"):] if "{" in r.stdout else "{}"
+        try:
+            rep = json.loads(line)
+        except json.JSONDecodeError:
+            print(f"workers={n}: FAILED\n{r.stdout}\n{r.stderr[-2000:]}")
+            continue
+        rows.append((n, rep))
+    print(f"{'workers':>7} {'search_rest':>12} {'graphql':>9} "
+          f"{'grpc_search':>12} {'http_tx':>9}")
+    for n, rep in rows:
+        e = rep.get("endpoints", {})
+        def ops(k):
+            return e.get(k, {}).get("ops_per_sec", 0)
+        print(f"{n:>7} {ops('search_rest'):>12} {ops('graphql'):>9} "
+              f"{ops('grpc_search'):>12} {ops('http_tx'):>9}")
+    if rows:
+        print(f"(cores={rows[0][1].get('cores')}; on a 1-core box worker"
+              " processes share the core — scaling shows on multi-core)")
+
+
 if __name__ == "__main__":
-    main()
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--workers", type=int, default=0)
+    ap.add_argument("--scaling", action="store_true")
+    args = ap.parse_args()
+    if args.scaling:
+        scaling_sweep()
+    else:
+        main(workers=args.workers)

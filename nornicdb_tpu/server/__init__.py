@@ -3,5 +3,9 @@
 from nornicdb_tpu.server.bolt import BoltServer
 from nornicdb_tpu.server.http import HttpServer
 from nornicdb_tpu.server.packstream import Structure, pack, to_wire, unpack
+from nornicdb_tpu.server.workers import WorkerPool
 
-__all__ = ["BoltServer", "HttpServer", "Structure", "pack", "to_wire", "unpack"]
+__all__ = [
+    "BoltServer", "HttpServer", "Structure", "pack", "to_wire", "unpack",
+    "WorkerPool",
+]

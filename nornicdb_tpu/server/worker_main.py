@@ -1,0 +1,10 @@
+"""Worker subprocess entry point (kept separate from workers.py so
+`python -m nornicdb_tpu.server.worker_main` doesn't re-execute a module the
+server package already imported — runpy warns about that double life)."""
+
+import sys
+
+from nornicdb_tpu.server.workers import _subproc_entry
+
+if __name__ == "__main__":
+    _subproc_entry(sys.argv[1:])

@@ -1,0 +1,440 @@
+"""Prefork protocol workers: multi-core scale-out for the protocol surface.
+
+The reference's Go runtime spreads its protocol handling across cores for
+free (goroutines; the testing/e2e/README.md numbers come from a multi-core
+box). A CPython server needs worker PROCESSES for the same effect — this
+module provides them for the HTTP surface (search REST + GraphQL + the rest
+of the REST API) and the native gRPC search service.
+
+Architecture
+------------
+The primary process owns the DB — and the TPU client: the chip has one
+owner, so compute stays centralized while the GIL-bound protocol work
+(socket accept, HTTP parse, JSON/protobuf encode) fans out.
+
+N worker processes bind the SAME public port with SO_REUSEPORT; the kernel
+load-balances connection accepts across them. Workers are protocol
+frontends:
+
+- hot read endpoints (/nornicdb/search, /nornicdb/similar, read-only
+  /graphql documents, /metrics, /health, /status) are served from a
+  generation-stamped response cache. The generation is a shared-memory
+  counter the primary bumps on every storage event, so worker caches die
+  the moment anything mutates — the exact contract of the in-process
+  ResponseCache (server/respcache.py), stretched across processes.
+- everything else (writes, Cypher tx, auth, admin, cache misses) is
+  proxied to the primary's loopback listener over per-thread keep-alive
+  connections.
+
+Workers never touch JAX: they are plain subprocesses running
+`python -m nornicdb_tpu.server.workers <json-config>` (no inherited TPU
+client state, no fork-unsafety with the primary's background threads, and
+— unlike multiprocessing's spawn — no re-import of the parent's __main__,
+so the pool works from REPLs and stdin scripts too). The shared generation
+counter lives in an mmap'd temp file both sides map.
+"""
+
+from __future__ import annotations
+
+import json
+import mmap
+import os
+import re
+import socket
+import subprocess
+import sys
+import tempfile
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Optional
+
+from nornicdb_tpu.server.respcache import ResponseCache
+
+
+class GenerationFile:
+    """A cross-process monotonic counter in an mmap'd 8-byte file.
+
+    Single writer (the primary), many readers (workers). The 8-byte aligned
+    store is a single mov on every platform we run on; the reader still
+    double-reads until stable so even a torn read cannot surface."""
+
+    def __init__(self, path: Optional[str] = None):
+        self._own = path is None
+        if path is None:
+            fd, path = tempfile.mkstemp(prefix="nornic-gen-")
+            os.write(fd, b"\x00" * 8)
+            os.close(fd)
+        self.path = path
+        self._f = open(path, "r+b")
+        self._mm = mmap.mmap(self._f.fileno(), 8)
+        self._local = 0
+
+    @property
+    def value(self) -> int:
+        while True:
+            a = bytes(self._mm[:8])
+            b = bytes(self._mm[:8])
+            if a == b:
+                return int.from_bytes(a, "little")
+
+    def bump(self) -> None:
+        self._local += 1
+        self._mm[:8] = self._local.to_bytes(8, "little")
+
+    def close(self) -> None:
+        try:
+            self._mm.close()
+            self._f.close()
+        except Exception:
+            pass
+        if self._own:
+            try:
+                os.unlink(self.path)
+            except OSError:
+                pass
+
+_MUTATION_RE = re.compile(r"\bmutation\b")
+
+# endpoints a worker may answer from its generation-stamped cache; every
+# other path is proxied to the primary untouched
+_CACHEABLE_GET = ("/metrics", "/health", "/status")
+_CACHEABLE_POST = ("/nornicdb/search", "/nornicdb/similar")
+
+
+def _cacheable(method: str, path: str, body: bytes) -> bool:
+    p = path.split("?", 1)[0]
+    if method == "GET":
+        return p in _CACHEABLE_GET
+    if method != "POST":
+        return False
+    if p in _CACHEABLE_POST:
+        return True
+    if p == "/graphql":
+        # conservative: any document mentioning `mutation` goes to the
+        # primary, even inside a string literal — correctness over hit rate
+        try:
+            q = json.loads(body or b"{}").get("query", "")
+        except Exception:
+            return False
+        return not _MUTATION_RE.search(q)
+    return False
+
+
+class _ReuseportHTTPServer(ThreadingHTTPServer):
+    allow_reuse_address = True
+    daemon_threads = True
+
+    def server_bind(self):
+        self.socket.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEPORT, 1)
+        super().server_bind()
+
+
+class _FrontendHandler(BaseHTTPRequestHandler):
+    protocol_version = "HTTP/1.1"
+    server_version = "NornicDB-TPU-worker"
+    # response writes must flush immediately: header block and body go out
+    # as separate send()s, and Nagle + the client's delayed ACK turns that
+    # into a ~40ms stall per request (same fix as the primary HTTP server)
+    disable_nagle_algorithm = True
+    _local = threading.local()
+
+    def log_message(self, *a):  # quiet
+        pass
+
+    # -- primary connection (per handler thread, keep-alive) -----------
+    def _primary(self):
+        import http.client
+
+        conn = getattr(self._local, "conn", None)
+        if conn is None:
+            conn = http.client.HTTPConnection(
+                "127.0.0.1", self.server.primary_port, timeout=30
+            )
+            conn.connect()
+            # proxy requests also go out as header+body send() pairs;
+            # without NODELAY each proxied call eats the Nagle stall twice
+            conn.sock.setsockopt(
+                socket.IPPROTO_TCP, socket.TCP_NODELAY, 1
+            )
+            self._local.conn = conn
+        return conn
+
+    # hop-by-hop headers stay ours; everything else from the primary
+    # (Set-Cookie for logins, Location for redirects, CORS headers...)
+    # relays through untouched
+    _SKIP_RESP_HEADERS = frozenset(
+        ("connection", "keep-alive", "transfer-encoding", "content-length")
+    )
+    _IDEMPOTENT = frozenset(("GET", "HEAD", "OPTIONS"))
+
+    def _proxy(
+        self, method: str, body: bytes
+    ) -> tuple[int, list[tuple[str, str]], bytes]:
+        headers = {}
+        for h in ("Content-Type", "Authorization", "Cookie", "Accept",
+                  "Origin", "Access-Control-Request-Method",
+                  "Access-Control-Request-Headers"):
+            v = self.headers.get(h)
+            if v:
+                headers[h] = v
+        # retry a dropped keep-alive connection only for idempotent methods:
+        # a POST whose connection died mid-response may already have
+        # executed on the primary, and replaying it would run the write twice
+        attempts = (0, 1) if method in self._IDEMPOTENT else (1,)
+        for attempt in attempts:
+            conn = self._primary()
+            try:
+                conn.request(method, self.path, body or None, headers)
+                resp = conn.getresponse()
+                data = resp.read()
+                out_headers = [
+                    (k, v) for k, v in resp.getheaders()
+                    if k.lower() not in self._SKIP_RESP_HEADERS
+                ]
+                return resp.status, out_headers, data
+            except Exception:
+                try:
+                    conn.close()
+                except Exception:
+                    pass
+                self._local.conn = None
+                if attempt:
+                    raise
+        raise RuntimeError("unreachable")
+
+    def _respond(self, status: int, headers: list[tuple[str, str]],
+                 data: bytes, cache_state: str) -> None:
+        self.send_response(status)
+        for k, v in headers:
+            self.send_header(k, v)
+        self.send_header("Content-Length", str(len(data)))
+        self.send_header("X-Nornic-Worker", str(self.server.worker_id))
+        self.send_header("X-Nornic-Cache", cache_state)
+        self.end_headers()
+        self.wfile.write(data)
+
+    def _handle(self, method: str) -> None:
+        length = int(self.headers.get("Content-Length") or 0)
+        body = self.rfile.read(length) if length else b""
+        try:
+            if _cacheable(method, self.path, body):
+                # auth material is part of the key: a cached response must
+                # never leak across differently-privileged tokens
+                key = (
+                    method,
+                    self.path,
+                    body,
+                    self.headers.get("Authorization", ""),
+                    self.headers.get("Cookie", ""),
+                )
+                cached = self.server.cache.get(key)
+                if cached is not None:
+                    status, headers, data = cached
+                    self._respond(status, headers, data, "hit")
+                    return
+                gen_before = self.server.cache.generation()
+                status, headers, data = self._proxy(method, body)
+                if status == 200:
+                    self.server.cache.put(
+                        key, (status, headers, data), gen_before
+                    )
+                self._respond(status, headers, data, "miss")
+                return
+            status, headers, data = self._proxy(method, body)
+            self._respond(status, headers, data, "proxy")
+        except Exception as e:
+            msg = json.dumps({"error": f"worker proxy failure: {e}"}).encode()
+            try:
+                self._respond(
+                    502, [("Content-Type", "application/json")], msg, "error"
+                )
+            except Exception:
+                pass
+
+    def do_GET(self):
+        self._handle("GET")
+
+    def do_POST(self):
+        self._handle("POST")
+
+    def do_PUT(self):
+        self._handle("PUT")
+
+    def do_DELETE(self):
+        self._handle("DELETE")
+
+    def do_PATCH(self):
+        self._handle("PATCH")
+
+    def do_OPTIONS(self):  # CORS preflight must reach the primary
+        self._handle("OPTIONS")
+
+    def do_HEAD(self):
+        self._handle("HEAD")
+
+
+def _http_worker_main(host: str, public_port: int, primary_port: int,
+                      gen: GenerationFile, worker_id: int) -> None:
+    srv = _ReuseportHTTPServer((host, public_port), _FrontendHandler)
+    srv.primary_port = primary_port
+    srv.cache = ResponseCache(lambda: gen.value)
+    srv.worker_id = worker_id
+    srv.serve_forever(poll_interval=0.1)
+
+
+def _grpc_worker_main(host: str, public_port: int, primary_port: int,
+                      gen: GenerationFile, worker_id: int) -> None:
+    from concurrent import futures
+
+    import grpc
+
+    from nornicdb_tpu.server.grpc_search import SERVICE_NAME
+
+    channel = grpc.insecure_channel(f"127.0.0.1:{primary_port}")
+    forward = channel.unary_unary(
+        f"/{SERVICE_NAME}/Search",
+        request_serializer=lambda b: b,
+        response_deserializer=lambda b: b,
+    )
+    cache = ResponseCache(lambda: gen.value)
+
+    def call(request: bytes, context) -> bytes:
+        hit = cache.get(request)
+        if hit is not None:
+            return hit
+        gen_before = cache.generation()
+        resp = forward(request)
+        cache.put(request, resp, gen_before)
+        return resp
+
+    class Handler(grpc.GenericRpcHandler):
+        def service(self, details):
+            if details.method == f"/{SERVICE_NAME}/Search":
+                return grpc.unary_unary_rpc_method_handler(
+                    call,
+                    request_deserializer=lambda b: b,
+                    response_serializer=lambda b: b,
+                )
+            return None
+
+    server = grpc.server(
+        futures.ThreadPoolExecutor(max_workers=4),
+        options=[("grpc.so_reuseport", 1)],
+    )
+    server.add_generic_rpc_handlers((Handler(),))
+    bound = server.add_insecure_port(f"{host}:{public_port}")
+    if bound != public_port:
+        raise RuntimeError(
+            f"worker {worker_id}: wanted port {public_port}, got {bound}"
+        )
+    server.start()
+    server.wait_for_termination()
+
+
+def _reserve_port(host: str) -> tuple[socket.socket, int]:
+    """Bind (without listening) a SO_REUSEPORT socket on an ephemeral port
+    and keep it open: the port stays ours while every worker binds it too."""
+    s = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+    s.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEPORT, 1)
+    s.bind((host, 0))
+    return s, s.getsockname()[1]
+
+
+class WorkerPool:
+    """Manage N protocol worker subprocesses in front of a primary.
+
+    kind="http" fronts an HttpServer (primary_port = its .port);
+    kind="grpc" fronts a GrpcSearchServer. The pool wires the primary
+    db's storage events to the shared generation counter so worker caches
+    invalidate on any mutation.
+    """
+
+    def __init__(self, db, primary_port: int, n_workers: int = 2,
+                 host: str = "127.0.0.1", kind: str = "http",
+                 public_port: int = 0):
+        if kind not in ("http", "grpc"):
+            raise ValueError(f"unknown worker kind {kind!r}")
+        self.kind = kind
+        self.host = host
+        self.n_workers = n_workers
+        self.primary_port = primary_port
+        self.generation = GenerationFile()
+        self._reserved: Optional[socket.socket] = None
+        if public_port == 0:
+            self._reserved, public_port = _reserve_port(host)
+        self.port = public_port
+        self._procs: list[subprocess.Popen] = []
+        self._db = db
+        self._bump_cb = None
+        if db is not None:
+            gen = self.generation
+            lock = threading.Lock()
+
+            def _bump(kind_, entity):
+                with lock:  # single-writer contract of GenerationFile
+                    gen.bump()
+
+            self._bump_cb = _bump
+            db.storage.on_event(_bump)
+
+    def start(self) -> "WorkerPool":
+        for i in range(self.n_workers):
+            cfg = json.dumps({
+                "kind": self.kind,
+                "host": self.host,
+                "port": self.port,
+                "primary_port": self.primary_port,
+                "gen_path": self.generation.path,
+                "worker_id": i,
+            })
+            # the package may live off sys.path-only locations (sys.path
+            # edits don't propagate to subprocesses) — point the worker at
+            # wherever THIS nornicdb_tpu was imported from
+            import nornicdb_tpu
+
+            pkg_parent = os.path.dirname(os.path.dirname(
+                os.path.abspath(nornicdb_tpu.__file__)))
+            env = dict(os.environ)
+            env["PYTHONPATH"] = pkg_parent + (
+                os.pathsep + env["PYTHONPATH"] if env.get("PYTHONPATH") else ""
+            )
+            p = subprocess.Popen(
+                [sys.executable, "-m", "nornicdb_tpu.server.worker_main", cfg],
+                stdin=subprocess.DEVNULL,
+                env=env,
+            )
+            self._procs.append(p)
+        return self
+
+    def alive(self) -> int:
+        return sum(1 for p in self._procs if p.poll() is None)
+
+    def stop(self) -> None:
+        for p in self._procs:
+            p.terminate()
+        for p in self._procs:
+            try:
+                p.wait(timeout=5)
+            except subprocess.TimeoutExpired:
+                p.kill()
+        self._procs.clear()
+        if self._reserved is not None:
+            self._reserved.close()
+            self._reserved = None
+        if self._bump_cb is not None and self._db is not None:
+            # unhook before closing the mmap: a leaked listener would write
+            # to a closed buffer on every later mutation
+            try:
+                self._db.storage.off_event(self._bump_cb)
+            except Exception:
+                pass
+            self._bump_cb = None
+        self.generation.close()
+
+
+def _subproc_entry(argv: list[str]) -> None:
+    cfg = json.loads(argv[0])
+    gen = GenerationFile(cfg["gen_path"])
+    main = _http_worker_main if cfg["kind"] == "http" else _grpc_worker_main
+    main(cfg["host"], cfg["port"], cfg["primary_port"], gen,
+         cfg["worker_id"])

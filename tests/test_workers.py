@@ -1,0 +1,241 @@
+"""Prefork worker-pool tests: SO_REUSEPORT distribution, write proxying,
+shared-generation cache invalidation, gRPC frontend workers.
+
+Behavioral reference: the reference gets multi-core protocol scaling from
+the Go runtime (testing/e2e/README.md ran on a multi-core box); here worker
+processes provide it, so the tests assert the architecture's contracts:
+connections are spread across >=2 worker processes, writes through any
+worker land on the primary, and a mutation anywhere invalidates every
+worker's response cache.
+"""
+
+import json
+import http.client
+import time
+
+import pytest
+
+import nornicdb_tpu
+from nornicdb_tpu.embed import HashEmbedder
+from nornicdb_tpu.server import HttpServer, WorkerPool
+
+
+@pytest.fixture(scope="module")
+def pool_setup():
+    db = nornicdb_tpu.open_db("")
+    db.set_embedder(HashEmbedder(64))
+    for i in range(20):
+        db.store(f"worker pool document {i} about topic{i % 4}")
+    db.process_pending_embeddings()
+    primary = HttpServer(db, port=0)
+    primary.start()
+    pool = WorkerPool(db, primary.port, n_workers=2).start()
+    # wait for both workers to come up (spawn: fresh interpreter each)
+    deadline = time.time() + 60
+    up = False
+    while time.time() < deadline:
+        try:
+            _req(pool.port, "GET", "/health")
+            up = True
+            break
+        except OSError:
+            time.sleep(0.25)
+    assert up, "workers never started listening"
+    yield db, primary, pool
+    pool.stop()
+    primary.stop()
+    db.close()
+
+
+def _req(port, method, path, body=None):
+    conn = http.client.HTTPConnection("127.0.0.1", port, timeout=30)
+    try:
+        conn.request(
+            method, path,
+            json.dumps(body).encode() if body is not None else None,
+            {"Content-Type": "application/json"},
+        )
+        r = conn.getresponse()
+        data = r.read()
+        return r.status, dict(r.getheaders()), data
+    finally:
+        conn.close()
+
+
+class TestWorkerPool:
+    def test_connections_spread_across_workers(self, pool_setup):
+        _, _, pool = pool_setup
+        assert pool.alive() == 2
+        seen = set()
+        for _ in range(40):  # fresh connection each time: kernel rebalances
+            _, headers, _ = _req(pool.port, "GET", "/health")
+            seen.add(headers.get("X-Nornic-Worker"))
+            if len(seen) >= 2:
+                break
+        assert len(seen) >= 2, f"all 40 connections hit one worker: {seen}"
+
+    def test_search_cached_after_first_miss(self, pool_setup):
+        _, _, pool = pool_setup
+        body = {"query": "topic1 document", "limit": 5}
+        # drive the same query through ONE worker connection twice: the
+        # second must be a cache hit with identical bytes
+        conn = http.client.HTTPConnection("127.0.0.1", pool.port, timeout=30)
+        try:
+            states, payloads = [], []
+            for _ in range(2):
+                conn.request("POST", "/nornicdb/search",
+                             json.dumps(body).encode(),
+                             {"Content-Type": "application/json"})
+                r = conn.getresponse()
+                payloads.append(r.read())
+                states.append(r.getheader("X-Nornic-Cache"))
+            assert states[0] in ("miss", "hit")
+            assert states[1] == "hit"
+            assert payloads[0] == payloads[1]
+        finally:
+            conn.close()
+
+    def test_write_through_worker_is_proxied_and_fresh(self, pool_setup):
+        db, _, pool = pool_setup
+        # write via the worker port (Cypher over the tx endpoint = proxy)
+        status, headers, data = _req(
+            pool.port, "POST", "/db/neo4j/tx/commit",
+            {"statements": [
+                {"statement":
+                 "CREATE (:WorkerDoc {content: 'fresh worker write'})"}
+            ]},
+        )
+        assert status == 200, data
+        assert headers.get("X-Nornic-Cache") == "proxy"
+        r = db.cypher("MATCH (n:WorkerDoc) RETURN count(n) AS c")
+        assert r.rows[0][0] == 1  # landed on the primary's storage
+
+    def test_mutation_invalidates_worker_caches(self, pool_setup):
+        db, _, pool = pool_setup
+        db.set_embedder(HashEmbedder(64))
+        body = {"query": "invalidation probe xyz", "limit": 3}
+        _req(pool.port, "POST", "/nornicdb/search", body)  # warm the cache
+        gen0 = pool.generation.value
+        doc = db.store("invalidation probe xyz target document")
+        db.process_pending_embeddings()
+        assert pool.generation.value > gen0, "storage event did not bump gen"
+        # cached entry is dead: the fresh result must include the new doc
+        deadline = time.time() + 10
+        found = False
+        while time.time() < deadline and not found:
+            _, headers, data = _req(pool.port, "POST", "/nornicdb/search", body)
+            hits = json.loads(data).get("results", [])
+            found = any(h.get("id") == doc.id for h in hits)
+            if not found:
+                time.sleep(0.2)
+        assert found, "worker served stale results after mutation"
+
+    def test_login_cookie_and_preflight_relay_through_worker(self):
+        """Response headers (Set-Cookie) and CORS preflight must survive the
+        worker hop — a frontend that strips them breaks browser clients."""
+        from nornicdb_tpu.auth import Authenticator, ROLE_VIEWER
+        from nornicdb_tpu.storage import MemoryEngine
+
+        db = nornicdb_tpu.open_db("")
+        auth = Authenticator(MemoryEngine())
+        auth.create_user("bob", "bobpw", ROLE_VIEWER)
+        primary = HttpServer(db, port=0, authenticator=auth,
+                             auth_required=True)
+        primary.start()
+        pool = WorkerPool(db, primary.port, n_workers=1).start()
+        try:
+            deadline = time.time() + 60
+            while time.time() < deadline:
+                try:
+                    _req(pool.port, "GET", "/auth/config")
+                    break
+                except OSError:
+                    time.sleep(0.25)
+            status, headers, _ = _req(
+                pool.port, "POST", "/auth/token",
+                {"username": "bob", "password": "bobpw"},
+            )
+            assert status == 200
+            cookie = headers.get("Set-Cookie", "")
+            assert cookie.startswith("nornicdb_token="), headers
+            # the relayed cookie authenticates a follow-up via the worker
+            conn = http.client.HTTPConnection("127.0.0.1", pool.port,
+                                              timeout=30)
+            try:
+                conn.request("GET", "/auth/me",
+                             headers={"Cookie": cookie.split(";")[0]})
+                r = conn.getresponse()
+                me = json.loads(r.read())
+                assert me["username"] == "bob"
+            finally:
+                conn.close()
+            # CORS preflight reaches the primary's do_OPTIONS
+            status, headers, _ = _req(pool.port, "OPTIONS", "/nornicdb/search")
+            assert status < 500
+        finally:
+            pool.stop()
+            primary.stop()
+            db.close()
+
+    def test_worker_error_path_when_primary_down(self):
+        db = nornicdb_tpu.open_db("")
+        primary = HttpServer(db, port=0)
+        primary.start()
+        pool = WorkerPool(db, primary.port, n_workers=1).start()
+        try:
+            deadline = time.time() + 60
+            while time.time() < deadline:
+                try:
+                    _req(pool.port, "GET", "/health")
+                    break
+                except OSError:
+                    time.sleep(0.25)
+            primary.stop()
+            status, _, data = _req(pool.port, "GET", "/admin/stats")
+            assert status == 502
+            assert b"worker proxy failure" in data
+        finally:
+            pool.stop()
+            db.close()
+
+
+class TestGrpcWorkerPool:
+    def test_grpc_frontend_forwards_and_caches(self):
+        grpc = pytest.importorskip("grpc")
+        from nornicdb_tpu.server.grpc_search import (
+            GrpcSearchServer, SERVICE_NAME, decode_search_response,
+            encode_search_request)
+
+        db = nornicdb_tpu.open_db("")
+        db.set_embedder(HashEmbedder(64))
+        for i in range(10):
+            db.store(f"grpc doc {i} quantum widgets")
+        db.process_pending_embeddings()
+        primary = GrpcSearchServer(db)
+        primary._server.start()
+        pool = WorkerPool(db, primary.port, n_workers=2, kind="grpc").start()
+        try:
+            channel = grpc.insecure_channel(f"127.0.0.1:{pool.port}")
+            call = channel.unary_unary(
+                f"/{SERVICE_NAME}/Search",
+                request_serializer=lambda b: b,
+                response_deserializer=lambda b: b,
+            )
+            req = encode_search_request("quantum widgets", limit=3)
+            deadline = time.time() + 60
+            resp = None
+            while time.time() < deadline:
+                try:
+                    resp = call(req, timeout=10)
+                    break
+                except grpc.RpcError:
+                    time.sleep(0.5)
+            assert resp is not None, "gRPC workers never became reachable"
+            out = decode_search_response(resp)
+            assert out["hits"], "no hits through the worker frontend"
+            # repeat: served from the worker cache, identical bytes
+            assert call(req, timeout=10) == resp
+        finally:
+            pool.stop()
+            primary._server.stop(0)
+            db.close()
