@@ -36,8 +36,19 @@ def cmd_serve(args) -> int:
     from nornicdb_tpu.server import BoltServer, HttpServer
 
     db = _open_db(args)
-    # embedder: TPU bge-m3 when requested, hash fallback otherwise
-    if args.embedder == "tpu":
+    # embedder: trained checkpoint > TPU bge-m3 preset > hash fallback
+    if args.embedder == "trained" or (
+        args.embedder == "tpu" and os.environ.get("NORNICDB_EMBEDDER_MODEL")
+    ):
+        from nornicdb_tpu.models.pretrain import load_embedder
+
+        model_dir = os.environ.get("NORNICDB_EMBEDDER_MODEL", "")
+        if not model_dir:
+            raise SystemExit(
+                "--embedder trained requires NORNICDB_EMBEDDER_MODEL=<dir>"
+            )
+        embedder = load_embedder(model_dir)
+    elif args.embedder == "tpu":
         from nornicdb_tpu.models import bge_m3
 
         cfg_name = getattr(bge_m3, args.model_preset.upper().replace("-", "_"))
@@ -261,6 +272,22 @@ def cmd_decay(args) -> int:
     return 0
 
 
+def cmd_train(args) -> int:
+    """(replaces the reference's offline neural/train.py pipeline with
+    first-class in-image training; see models/pretrain.py)"""
+    from nornicdb_tpu.models import pretrain
+
+    if args.model == "assistant":
+        stats = pretrain.train_assistant(
+            args.out, steps=args.steps or 700, batch=24, seq_len=64,
+            hidden=128, lr=2e-3,
+        )
+    else:
+        stats = pretrain.train_encoder(args.out, steps=args.steps or 250)
+    print(json.dumps({"model": args.model, "out": args.out, **stats}))
+    return 0
+
+
 def main(argv=None) -> int:
     p = argparse.ArgumentParser(prog="nornicdb", description="NornicDB-TPU")
     p.add_argument("--data-dir", default=os.environ.get("NORNICDB_DATA_DIR", ""),
@@ -272,7 +299,8 @@ def main(argv=None) -> int:
     s.add_argument("--bolt-port", type=int, default=7687)
     s.add_argument("--http-port", type=int, default=7474)
     s.add_argument("--auth", action="store_true", help="require authentication")
-    s.add_argument("--embedder", choices=["hash", "tpu"], default="tpu")
+    s.add_argument("--embedder", choices=["hash", "tpu", "trained"],
+                   default="tpu")
     s.add_argument("--embed-dims", type=int, default=1024)
     s.add_argument("--model-preset", default="bge_small")
     s.add_argument("--log-queries", action="store_true",
@@ -313,6 +341,18 @@ def main(argv=None) -> int:
     s = sub.add_parser("decay", help="memory decay operations")
     s.add_argument("action", choices=["recalculate", "archive", "stats"])
     s.set_defaults(fn=cmd_decay)
+
+    s = sub.add_parser(
+        "train",
+        help="train in-image model checkpoints (assistant decoder via LM "
+             "loss, embedding encoder via InfoNCE) on the synthetic domain "
+             "corpus — the zero-egress replacement for mounting GGUF weights",
+    )
+    s.add_argument("model", choices=["assistant", "encoder"])
+    s.add_argument("--out", required=True, help="checkpoint output directory")
+    s.add_argument("--steps", type=int, default=0,
+                   help="train steps (default: per-model preset)")
+    s.set_defaults(fn=cmd_train)
 
     args = p.parse_args(argv)
     return args.fn(args)

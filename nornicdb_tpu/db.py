@@ -270,11 +270,29 @@ class DB:
 
     @property
     def heimdall(self):
-        """(ref: pkg/heimdall manager wiring)"""
+        """(ref: pkg/heimdall manager wiring). With a trained checkpoint
+        mounted (NORNICDB_ASSISTANT_MODEL=<dir>, produced by
+        `nornicdb train` / models.pretrain.train_assistant) the assistant
+        runs the real prefill+KV-cache decode path; otherwise the
+        deterministic template fallback (ref: llama_stub.go builds)."""
         if self._heimdall is None:
             from nornicdb_tpu.heimdall import HeimdallManager, TemplateGenerator
 
-            self._heimdall = HeimdallManager(TemplateGenerator(self), db=self)
+            generator = None
+            model_dir = os.environ.get("NORNICDB_ASSISTANT_MODEL", "")
+            if model_dir:
+                try:
+                    from nornicdb_tpu.models.pretrain import load_generator
+
+                    generator = load_generator(model_dir)
+                except Exception as e:  # bad checkpoint: fall back, loudly
+                    print(
+                        f"assistant checkpoint {model_dir!r} failed to "
+                        f"load ({e}); using template generator"
+                    )
+            if generator is None:
+                generator = TemplateGenerator(self)
+            self._heimdall = HeimdallManager(generator, db=self)
         return self._heimdall
 
     def set_heimdall_generator(self, generator) -> None:

@@ -58,6 +58,39 @@ def make_train_step(cfg: bge_m3.BgeConfig, optimizer):
     return step
 
 
+def lm_loss(params, cfg, batch: dict) -> jax.Array:
+    """Next-token cross-entropy for the Qwen2 decoder. batch["ids"]: (B, T)
+    int32; batch["mask"]: (B, T) 1 where a PREDICTION target is real (the
+    loss at position t predicts token t+1)."""
+    from nornicdb_tpu.models import qwen2
+
+    logits = qwen2.forward(params, cfg, batch["ids"][:, :-1])
+    targets = batch["ids"][:, 1:]
+    mask = batch["mask"][:, 1:].astype(jnp.float32)
+    ce = optax.softmax_cross_entropy_with_integer_labels(logits, targets)
+    return jnp.sum(ce * mask) / jnp.maximum(jnp.sum(mask), 1.0)
+
+
+def make_lm_train_step(cfg, optimizer):
+    """Plain jit LM train step for the assistant decoder."""
+
+    @jax.jit
+    def step(state: TrainState, batch: dict):
+        loss, grads = jax.value_and_grad(lm_loss)(state.params, cfg, batch)
+        updates, opt_state = optimizer.update(grads, state.opt_state, state.params)
+        params = optax.apply_updates(state.params, updates)
+        return TrainState(params, opt_state, state.step + 1), loss
+
+    return step
+
+
+def init_lm_train_state(cfg, optimizer, seed: int = 0) -> TrainState:
+    from nornicdb_tpu.models import qwen2
+
+    params = qwen2.init_params(cfg, jax.random.PRNGKey(seed))
+    return TrainState(params, optimizer.init(params), jnp.zeros((), jnp.int32))
+
+
 def make_sharded_train_step(
     cfg: bge_m3.BgeConfig,
     optimizer,

@@ -1,0 +1,155 @@
+"""In-image pretraining tests: the weight lifecycle the reference exercises
+with real GGUF checkpoints (pkg/localllm/llama.go:498-748, neural/train.py),
+reproduced without egress — train → checkpoint → load → serve, with
+assertions random weights cannot pass (learned completions, retrieval).
+
+Micro settings keep this fast; `nornicdb train` uses the bigger presets
+(700 steps / hidden 128) which reach 5/5 conditional-answer accuracy.
+"""
+
+import json
+import os
+import urllib.request
+
+import numpy as np
+import pytest
+
+import nornicdb_tpu
+from nornicdb_tpu.models import pretrain
+
+
+@pytest.fixture(scope="module")
+def assistant_ckpt(tmp_path_factory):
+    out = str(tmp_path_factory.mktemp("assistant"))
+    stats = pretrain.train_assistant(
+        out, steps=250, batch=16, seq_len=48, hidden=96, log_every=50,
+    )
+    return out, stats
+
+
+@pytest.fixture(scope="module")
+def encoder_ckpt(tmp_path_factory):
+    out = str(tmp_path_factory.mktemp("encoder"))
+    stats = pretrain.train_encoder(
+        out, steps=120, batch=16, hidden=64, dims=32, log_every=40,
+    )
+    return out, stats
+
+
+class TestVocabTokenizer:
+    def test_roundtrip_and_decode(self, tmp_path):
+        tok = pretrain.VocabTokenizer.from_corpus(
+            ["the capital of norway is oslo.", "match ( n ) return n"]
+        )
+        ids = tok.encode("the capital of norway", add_special=False)
+        assert tok.decode(ids) == "the capital of norway"
+        # punctuation re-attaches on decode
+        ids = tok.encode("norway is oslo .", add_special=False)
+        assert tok.decode(ids) == "norway is oslo."
+        # unknown words map to <unk>, never crash
+        assert tok.unk_id in tok.encode("zzzunseen", add_special=False)
+        p = tmp_path / "vocab.json"
+        tok.save(str(p))
+        tok2 = pretrain.VocabTokenizer.load(str(p))
+        assert tok2.itos == tok.itos
+        assert tok2.encode("match ( n )") == tok.encode("match ( n )")
+
+
+class TestAssistantTraining:
+    def test_loss_drops_and_facts_learned(self, assistant_ckpt):
+        out, stats = assistant_ckpt
+        assert stats["loss_last"] < stats["loss_first"] * 0.3, stats
+        gen = pretrain.load_generator(out)
+        ids = gen.tokenizer.encode("the capital of norway is",
+                                   add_special=False)
+        toks = gen.qwen2.generate(
+            gen.params, gen.cfg, ids, max_new_tokens=4,
+            eos_id=gen.tokenizer.eos_id,
+        )
+        text = gen.tokenizer.decode(toks)
+        assert "oslo" in text, f"random-weight output leaked: {text!r}"
+
+    def test_checkpoint_rejects_wrong_kind(self, encoder_ckpt):
+        out, _ = encoder_ckpt
+        with pytest.raises(ValueError):
+            pretrain.load_generator(out)
+
+    def test_chat_e2e_serves_model_output(self, assistant_ckpt):
+        """Full stack: NORNICDB_ASSISTANT_MODEL → db.heimdall →
+        /v1/chat/completions → trained-model tokens through the
+        prefill + KV-cache decode path (not the template generator)."""
+        from nornicdb_tpu.heimdall.manager import QwenGenerator
+        from nornicdb_tpu.server import HttpServer
+
+        out, _ = assistant_ckpt
+        os.environ["NORNICDB_ASSISTANT_MODEL"] = out
+        try:
+            db = nornicdb_tpu.open_db("")
+            assert isinstance(db.heimdall.generator, QwenGenerator)
+            server = HttpServer(db, port=0)
+            server.start()
+            try:
+                req = urllib.request.Request(
+                    f"http://127.0.0.1:{server.port}/v1/chat/completions",
+                    data=json.dumps({
+                        "messages": [
+                            {"role": "user", "content": "capital of norway"}
+                        ],
+                        "raw": True,
+                    }).encode(),
+                    headers={"Content-Type": "application/json"},
+                    method="POST",
+                )
+                body = json.loads(urllib.request.urlopen(req).read())
+                text = body["choices"][0]["message"]["content"]
+                # decoded model vocabulary, not a template string
+                assert "I am Heimdall" not in text
+                assert text.strip(), body
+            finally:
+                server.stop()
+                db.close()
+        finally:
+            os.environ.pop("NORNICDB_ASSISTANT_MODEL", None)
+
+    def test_bad_checkpoint_falls_back_to_template(self, tmp_path):
+        from nornicdb_tpu.heimdall.manager import TemplateGenerator
+
+        os.environ["NORNICDB_ASSISTANT_MODEL"] = str(tmp_path)  # empty dir
+        try:
+            db = nornicdb_tpu.open_db("")
+            assert isinstance(db.heimdall.generator, TemplateGenerator)
+            db.close()
+        finally:
+            os.environ.pop("NORNICDB_ASSISTANT_MODEL", None)
+
+
+class TestEncoderTraining:
+    def test_loss_drops_and_retrieval_works(self, encoder_ckpt):
+        out, stats = encoder_ckpt
+        assert stats["loss_last"] < stats["loss_first"], stats
+        emb = pretrain.load_embedder(out)
+        docs = [
+            "the capital of norway is oslo.",
+            "match finds nodes and return sends them back.",
+            "memory decay lowers the score of unused memories over time.",
+        ]
+        queries = ["capital norway oslo", "match return nodes",
+                   "decay unused memories"]
+        dv = np.stack(emb.embed_batch(docs))
+        qv = np.stack(emb.embed_batch(queries))
+        top1 = (qv @ dv.T).argmax(axis=1)
+        assert (top1 == np.arange(3)).sum() >= 2, top1
+
+    def test_trained_embedder_serves_recall(self, encoder_ckpt):
+        out, _ = encoder_ckpt
+        emb = pretrain.load_embedder(out)
+        db = nornicdb_tpu.open_db("")
+        try:
+            db.set_embedder(emb)
+            a = db.store("the capital of norway is oslo.")
+            db.store("match finds nodes and return sends them back.")
+            db.process_pending_embeddings()
+            hits = db.recall("capital of norway", limit=1)
+            assert hits and hits[0]["id"] == a.id
+        finally:
+            db.close()
