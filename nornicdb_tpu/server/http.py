@@ -266,9 +266,24 @@ class HttpServer:
                 self.send_header("Content-Length", "0")
                 self.end_headers()
 
+            def _client_ip(self) -> str:
+                # Worker-pool proxies (server/workers.py) connect from
+                # loopback and carry the real peer in X-Forwarded-For.
+                # Trust the header ONLY for loopback peers — an external
+                # client must not be able to spoof its rate-limit bucket.
+                peer = self.client_address[0]
+                if peer in ("127.0.0.1", "::1"):
+                    fwd = (self.headers.get("X-Forwarded-For") or "").strip()
+                    if fwd:
+                        # rightmost entry = the hop our trusted loopback
+                        # worker appended; earlier entries are client-supplied
+                        # and spoofable
+                        return fwd.split(",")[-1].strip()
+                return peer
+
             def _limited(self) -> bool:
                 rl = server_self.rate_limiter
-                if rl is not None and not rl.allow(self.client_address[0]):
+                if rl is not None and not rl.allow(self._client_ip()):
                     self._send(429, {"error": "rate limit exceeded"})
                     return True
                 return False

@@ -27,11 +27,17 @@ frontends:
   connections.
 
 Workers never touch JAX: they are plain subprocesses running
-`python -m nornicdb_tpu.server.workers <json-config>` (no inherited TPU
+`python -m nornicdb_tpu.server.worker_main <json-config>` (no inherited TPU
 client state, no fork-unsafety with the primary's background threads, and
 — unlike multiprocessing's spawn — no re-import of the parent's __main__,
 so the pool works from REPLs and stdin scripts too). The shared generation
 counter lives in an mmap'd temp file both sides map.
+
+Client identity: every proxied request carries X-Forwarded-For with the
+real peer address, and the primary prefers that header for loopback peers
+when keying its rate limiter (http.py _client_ip). Workers additionally
+apply the same token-bucket rate limit BEFORE cache lookup when the pool
+is configured with one, so cache hits cannot bypass limiting.
 """
 
 from __future__ import annotations
@@ -52,34 +58,53 @@ from nornicdb_tpu.server.respcache import ResponseCache
 
 
 class GenerationFile:
-    """A cross-process monotonic counter in an mmap'd 8-byte file.
+    """A cross-process monotonic counter in an mmap'd 16-byte seqlock.
 
-    Single writer (the primary), many readers (workers). The 8-byte aligned
-    store is a single mov on every platform we run on; the reader still
-    double-reads until stable so even a torn read cannot surface."""
+    Single writer (the primary), many readers (workers). mmap slice
+    assignment is a memcpy with no atomicity guarantee, so a bare
+    double-read can still snapshot a *stable* torn value if the writer is
+    descheduled mid-copy. Layout instead is a seqlock:
+    bytes [0:4) sequence, [4:12) value. The writer bumps seq to odd,
+    writes the value, bumps seq to even; a reader retries while seq is odd
+    or changed across the value read — a mid-copy writer can never
+    produce a stable-looking torn value."""
+
+    _SIZE = 16  # 4B seq + 8B value + 4B pad
 
     def __init__(self, path: Optional[str] = None):
         self._own = path is None
         if path is None:
             fd, path = tempfile.mkstemp(prefix="nornic-gen-")
-            os.write(fd, b"\x00" * 8)
+            os.write(fd, b"\x00" * self._SIZE)
             os.close(fd)
         self.path = path
         self._f = open(path, "r+b")
-        self._mm = mmap.mmap(self._f.fileno(), 8)
+        self._mm = mmap.mmap(self._f.fileno(), self._SIZE)
         self._local = 0
+        self._seq = 0
 
     @property
     def value(self) -> int:
-        while True:
-            a = bytes(self._mm[:8])
-            b = bytes(self._mm[:8])
-            if a == b:
-                return int.from_bytes(a, "little")
+        # bounded: if the writer died mid-write (seq stuck odd), return the
+        # value anyway — a possibly-torn generation only mis-keys a cache
+        # entry, and with the writer gone there will be no more bumps
+        for _ in range(1000):
+            s1 = int.from_bytes(self._mm[0:4], "little")
+            if s1 & 1:
+                continue
+            v = bytes(self._mm[4:12])
+            s2 = int.from_bytes(self._mm[0:4], "little")
+            if s1 == s2:
+                return int.from_bytes(v, "little")
+        return int.from_bytes(self._mm[4:12], "little")
 
     def bump(self) -> None:
         self._local += 1
-        self._mm[:8] = self._local.to_bytes(8, "little")
+        self._seq += 1
+        self._mm[0:4] = (self._seq & 0xFFFFFFFF).to_bytes(4, "little")  # odd
+        self._mm[4:12] = self._local.to_bytes(8, "little")
+        self._seq += 1
+        self._mm[0:4] = (self._seq & 0xFFFFFFFF).to_bytes(4, "little")  # even
 
     def close(self) -> None:
         try:
@@ -177,6 +202,11 @@ class _FrontendHandler(BaseHTTPRequestHandler):
             v = self.headers.get(h)
             if v:
                 headers[h] = v
+        # the primary keys its rate limiter and audit on the real client,
+        # not the worker's loopback socket (http.py _client_ip)
+        prior = self.headers.get("X-Forwarded-For")
+        peer = self.client_address[0]
+        headers["X-Forwarded-For"] = f"{prior}, {peer}" if prior else peer
         # retry a dropped keep-alive connection only for idempotent methods:
         # a POST whose connection died mid-response may already have
         # executed on the primary, and replaying it would run the write twice
@@ -216,6 +246,14 @@ class _FrontendHandler(BaseHTTPRequestHandler):
     def _handle(self, method: str) -> None:
         length = int(self.headers.get("Content-Length") or 0)
         body = self.rfile.read(length) if length else b""
+        # mirror the primary's token bucket BEFORE the cache lookup, so a
+        # hot cached endpoint cannot be hammered past the configured limit
+        rl = self.server.rate_limiter
+        if rl is not None and not rl.allow(self.client_address[0]):
+            msg = json.dumps({"error": "rate limit exceeded"}).encode()
+            self._respond(429, [("Content-Type", "application/json")],
+                          msg, "limited")
+            return
         try:
             if _cacheable(method, self.path, body):
                 # auth material is part of the key: a cached response must
@@ -274,11 +312,23 @@ class _FrontendHandler(BaseHTTPRequestHandler):
 
 
 def _http_worker_main(host: str, public_port: int, primary_port: int,
-                      gen: GenerationFile, worker_id: int) -> None:
+                      gen: GenerationFile, worker_id: int,
+                      rate_limit: Optional[tuple] = None) -> None:
     srv = _ReuseportHTTPServer((host, public_port), _FrontendHandler)
     srv.primary_port = primary_port
     srv.cache = ResponseCache(lambda: gen.value)
     srv.worker_id = worker_id
+    if rate_limit:
+        from nornicdb_tpu.server.http import RateLimiter
+
+        # per-worker bucket: the kernel spreads a client's connections
+        # across workers, so the effective limit is ≤ n_workers × rate —
+        # a ceiling, not a precise global bucket, which matches the goal
+        # (cache hits must not be unlimited)
+        srv.rate_limiter = RateLimiter(rate=rate_limit[0],
+                                       burst=int(rate_limit[1]))
+    else:
+        srv.rate_limiter = None
     srv.serve_forever(poll_interval=0.1)
 
 
@@ -299,12 +349,21 @@ def _grpc_worker_main(host: str, public_port: int, primary_port: int,
     cache = ResponseCache(lambda: gen.value)
 
     def call(request: bytes, context) -> bytes:
-        hit = cache.get(request)
+        # credentials are part of the cache key and travel with the proxied
+        # call — GrpcSearchServer has no auth today, but the moment auth
+        # metadata appears on this surface, cached responses must not leak
+        # across clients and proxied calls must not drop credentials
+        meta = tuple(
+            (k, v) for k, v in (context.invocation_metadata() or ())
+            if k in ("authorization", "cookie", "x-api-key")
+        )
+        key = (request, meta)
+        hit = cache.get(key)
         if hit is not None:
             return hit
         gen_before = cache.generation()
-        resp = forward(request)
-        cache.put(request, resp, gen_before)
+        resp = forward(request, metadata=meta or None)
+        cache.put(key, resp, gen_before)
         return resp
 
     class Handler(grpc.GenericRpcHandler):
@@ -351,10 +410,12 @@ class WorkerPool:
 
     def __init__(self, db, primary_port: int, n_workers: int = 2,
                  host: str = "127.0.0.1", kind: str = "http",
-                 public_port: int = 0):
+                 public_port: int = 0,
+                 rate_limit: Optional[tuple] = None):
         if kind not in ("http", "grpc"):
             raise ValueError(f"unknown worker kind {kind!r}")
         self.kind = kind
+        self.rate_limit = rate_limit
         self.host = host
         self.n_workers = n_workers
         self.primary_port = primary_port
@@ -386,6 +447,8 @@ class WorkerPool:
                 "primary_port": self.primary_port,
                 "gen_path": self.generation.path,
                 "worker_id": i,
+                "rate_limit": list(self.rate_limit) if self.rate_limit
+                              else None,
             })
             # the package may live off sys.path-only locations (sys.path
             # edits don't propagate to subprocesses) — point the worker at
@@ -435,6 +498,11 @@ class WorkerPool:
 def _subproc_entry(argv: list[str]) -> None:
     cfg = json.loads(argv[0])
     gen = GenerationFile(cfg["gen_path"])
-    main = _http_worker_main if cfg["kind"] == "http" else _grpc_worker_main
-    main(cfg["host"], cfg["port"], cfg["primary_port"], gen,
-         cfg["worker_id"])
+    if cfg["kind"] == "http":
+        rl = cfg.get("rate_limit")
+        _http_worker_main(cfg["host"], cfg["port"], cfg["primary_port"], gen,
+                          cfg["worker_id"],
+                          rate_limit=tuple(rl) if rl else None)
+    else:
+        _grpc_worker_main(cfg["host"], cfg["port"], cfg["primary_port"], gen,
+                          cfg["worker_id"])

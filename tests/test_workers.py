@@ -199,6 +199,111 @@ class TestWorkerPool:
             db.close()
 
 
+class TestGenerationFile:
+    def test_seqlock_roundtrip(self):
+        from nornicdb_tpu.server.workers import GenerationFile
+
+        gen = GenerationFile()
+        reader = GenerationFile(gen.path)
+        try:
+            assert reader.value == 0
+            for i in range(1, 50):
+                gen.bump()
+                assert reader.value == i
+        finally:
+            reader.close()
+            gen.close()
+
+    def test_odd_seq_does_not_hang_reader(self):
+        """A writer that died mid-write (seq left odd) must not spin the
+        reader forever — it falls back to the raw value after a bounded
+        number of retries."""
+        from nornicdb_tpu.server.workers import GenerationFile
+
+        gen = GenerationFile()
+        try:
+            gen.bump()
+            # simulate a mid-write crash: seq odd, value already written
+            gen._mm[0:4] = (3).to_bytes(4, "little")
+            gen._mm[4:12] = (2).to_bytes(8, "little")
+            assert gen.value == 2
+        finally:
+            gen.close()
+
+
+class TestWorkerClientIdentity:
+    def test_proxied_request_carries_x_forwarded_for(self):
+        """The primary's rate limiter keys on the real client, so every
+        proxied request must carry the peer in X-Forwarded-For (advisor
+        finding: without it, all clients collapse into the worker's
+        loopback bucket and audit loses real IPs)."""
+        import threading
+        from http.server import BaseHTTPRequestHandler, HTTPServer
+
+        seen = {}
+
+        class Probe(BaseHTTPRequestHandler):
+            protocol_version = "HTTP/1.1"
+
+            def log_message(self, *a):
+                pass
+
+            def do_GET(self):
+                seen["xff"] = self.headers.get("X-Forwarded-For")
+                body = b"{}"
+                self.send_response(200)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+        probe = HTTPServer(("127.0.0.1", 0), Probe)
+        t = threading.Thread(target=probe.serve_forever, daemon=True)
+        t.start()
+        pool = WorkerPool(None, probe.server_port, n_workers=1).start()
+        try:
+            deadline = time.time() + 60
+            status = None
+            while time.time() < deadline:
+                try:
+                    status, _, _ = _req(pool.port, "GET", "/admin/stats")
+                    break
+                except OSError:
+                    time.sleep(0.25)
+            assert status == 200
+            assert seen.get("xff") == "127.0.0.1"
+        finally:
+            pool.stop()
+            probe.shutdown()
+
+    def test_worker_rate_limits_before_cache(self):
+        """Cache hits must not bypass rate limiting when the pool is
+        configured with a limit (advisor finding)."""
+        db = nornicdb_tpu.open_db("")
+        primary = HttpServer(db, port=0)
+        primary.start()
+        pool = WorkerPool(db, primary.port, n_workers=1,
+                          rate_limit=(5.0, 5)).start()
+        try:
+            deadline = time.time() + 60
+            while time.time() < deadline:
+                try:
+                    _req(pool.port, "GET", "/health")
+                    break
+                except OSError:
+                    time.sleep(0.25)
+            # burst=5: hammer the cacheable endpoint; a 429 must appear even
+            # though every request after the first is a cache hit
+            statuses = [
+                _req(pool.port, "GET", "/health")[0] for _ in range(20)
+            ]
+            assert 429 in statuses, statuses
+        finally:
+            pool.stop()
+            primary.stop()
+            db.close()
+
+
 class TestGrpcWorkerPool:
     def test_grpc_frontend_forwards_and_caches(self):
         grpc = pytest.importorskip("grpc")
