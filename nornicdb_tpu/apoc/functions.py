@@ -875,20 +875,14 @@ def atomic_insert(m, key, value):
 def load_json(url):
     """file:// JSON loader, gated like the reference's import setting
     (requires NORNICDB_APOC_IMPORT_ENABLED=true — arbitrary local file reads
-    must be an explicit operator decision, not a default)."""
-    import os as _os
+    must be an explicit operator decision, not a default; NORNICDB_IMPORT_DIR
+    confines paths when set)."""
+    from nornicdb_tpu.config import resolve_import_url
 
-    if _os.environ.get("NORNICDB_APOC_IMPORT_ENABLED", "").lower() not in (
-        "1", "true", "yes",
-    ):
-        raise ValueError(
-            "apoc.load.json is disabled; set NORNICDB_APOC_IMPORT_ENABLED=true"
-        )
-    path = str(url)
-    if path.startswith("file://"):
-        path = path[7:]
-    elif "://" in path:
-        raise ValueError("only file:// URLs are supported (zero-egress)")
+    try:
+        path = resolve_import_url(str(url))
+    except PermissionError as e:
+        raise ValueError(str(e)) from None
     with open(path) as f:
         return _json.load(f)
 

@@ -575,7 +575,11 @@ def search_node_reduced(ex, label, props):
 
 @_graph_fn("apoc.search.regex")
 def search_regex(ex, label, prop, pattern):
-    pat = re.compile(str(pattern))
+    # bounded engine (see cypher/expr.py): a catastrophic pattern over a
+    # large label must error, not wedge the query thread
+    from nornicdb_tpu.cypher.expr import _compiled
+
+    pat = _compiled(str(pattern))
     return [n for n in _label_nodes(ex, label)
             if isinstance(n.properties.get(prop), str)
             and pat.fullmatch(n.properties[prop])]

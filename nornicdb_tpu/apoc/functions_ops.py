@@ -37,14 +37,25 @@ from nornicdb_tpu.storage.types import Edge, Node
 # =============================================================== apoc.load
 
 
-def _read_local(path: str) -> str:
+def _gated_path(path) -> str:
+    """Shared import gate for every apoc.load.* local read: explicit
+    operator opt-in, confinable via NORNICDB_IMPORT_DIR (config.py)."""
+    from nornicdb_tpu.config import resolve_import_url
+
     p = str(path)
     if p.startswith(("http://", "https://", "s3://", "gs://")):
         raise NornicError(
             "remote URLs are not loadable in this build (zero-egress); "
             "use a local path"
         )
-    with open(p, "r", encoding="utf-8") as f:
+    try:
+        return resolve_import_url(p)
+    except PermissionError as e:
+        raise NornicError(str(e)) from None
+
+
+def _read_local(path: str) -> str:
+    with open(_gated_path(path), "r", encoding="utf-8") as f:
         return f.read()
 
 
@@ -157,7 +168,7 @@ def load_directory(path, pattern="*"):
     import fnmatch
 
     return sorted(
-        f for f in os.listdir(str(path))
+        f for f in os.listdir(_gated_path(path))
         if fnmatch.fnmatch(f, str(pattern))
     )
 
@@ -165,7 +176,7 @@ def load_directory(path, pattern="*"):
 @register("apoc.load.directoryTree")
 def load_directory_tree(path):
     out = []
-    for root, _dirs, files in os.walk(str(path)):
+    for root, _dirs, files in os.walk(_gated_path(path)):
         for f in sorted(files):
             out.append(os.path.join(root, f))
     return sorted(out)
@@ -173,10 +184,10 @@ def load_directory_tree(path):
 
 @register("apoc.load.binary")
 def load_binary(path):
-    """Local file bytes as base64."""
+    """Local file bytes as base64 (import-gated)."""
     import base64
 
-    with open(str(path), "rb") as f:
+    with open(_gated_path(path), "rb") as f:
         return base64.b64encode(f.read()).decode()
 
 

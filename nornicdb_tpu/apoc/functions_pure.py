@@ -437,7 +437,11 @@ def util_md5_b64(s):
 def util_validate_pattern(value, pattern):
     if value is None or pattern is None:
         return None
-    return re.fullmatch(str(pattern), str(value)) is not None
+    # bounded engine: user-supplied patterns must not wedge the query
+    # thread via catastrophic backtracking (same guarantee as Cypher =~)
+    from nornicdb_tpu.cypher.expr import regex_fullmatch
+
+    return regex_fullmatch(str(pattern), str(value))
 
 
 @register("apoc.util.repeat")

@@ -263,3 +263,42 @@ class FeatureFlags:
 
 
 flags = FeatureFlags()
+
+
+def resolve_import_url(url: str) -> str:
+    """Gate + resolve a file-import URL for LOAD CSV / apoc.load.*.
+
+    The reference refuses LOAD CSV outright in embedded mode
+    (pkg/cypher/clauses.go:1800) and gates apoc file access behind its
+    import setting; this framework supports local file import as an
+    explicit operator opt-in:
+
+    - NORNICDB_APOC_IMPORT_ENABLED=true must be set, else any file import
+      raises (arbitrary local file reads are never a default capability).
+    - Non-file URL schemes are refused (zero-egress).
+    - If NORNICDB_IMPORT_DIR is set, the resolved real path must live
+      under it (the reference's server.directories.import confinement);
+      symlinks cannot escape because the check runs on os.path.realpath.
+    """
+    if os.environ.get("NORNICDB_APOC_IMPORT_ENABLED", "").lower() not in (
+        "1", "true", "yes",
+    ):
+        raise PermissionError(
+            "file import is disabled; set NORNICDB_APOC_IMPORT_ENABLED=true"
+        )
+    path = str(url)
+    if path.startswith("file://"):
+        path = path[7:]
+    elif "://" in path:
+        raise PermissionError(
+            "only file:// URLs are supported for import (zero-egress)"
+        )
+    real = os.path.realpath(path)
+    import_dir = os.environ.get("NORNICDB_IMPORT_DIR")
+    if import_dir:
+        root = os.path.realpath(import_dir)
+        if not (real == root or real.startswith(root + os.sep)):
+            raise PermissionError(
+                f"import path escapes NORNICDB_IMPORT_DIR: {url}"
+            )
+    return real

@@ -16,6 +16,7 @@ import logging
 
 import csv as csv_mod
 import io
+import threading
 import time
 import uuid
 from dataclasses import dataclass, field
@@ -106,7 +107,12 @@ class CypherExecutor:
         self.matcher = PatternMatcher(storage, self.schema, self)
         self._plugin_functions: dict[str, Callable] = {}
         # explicit transaction state (ref: executor.go tx statements :611)
-        self._tx_undo: Optional[list[Callable[[], None]]] = None
+        # Undo frames are THREAD-LOCAL: protocol servers run concurrent
+        # statements on one executor, and a shared frame would let thread
+        # A's rollback undo thread B's committed writes (the race
+        # TestRollback_ConcurrentWritesDuringRollback exercises). Explicit
+        # transactions are per-connection-thread too (Bolt session model).
+        self._tx_state = threading.local()
         self._last_call_columns: list[str] = []
         self.query_count = 0
         self._colindex: Any = None  # lazy ColumnarScanIndex; False = unusable
@@ -194,7 +200,7 @@ class CypherExecutor:
                 if stmt.explain:
                     return Result(["plan"], [[plan]], plan=plan)
             t0 = time.time()
-            result = self._run_query(stmt, params)
+            result = self._run_query_atomic(stmt, params)
             if stmt.profile:
                 result.plan = (self._explain(stmt)
                                + f"\nruntime: {(time.time()-t0)*1000:.2f} ms"
@@ -1443,6 +1449,12 @@ class CypherExecutor:
             txid = str(uuid.uuid4())
             if callable(tx_begin):
                 tx_begin(txid)
+            # checkpoint the implicit statement frame: once this batch
+            # commits, its mutations are durable and must NOT be undone by a
+            # later batch's failure (ON ERROR FAIL: earlier batches stay)
+            mark = (len(self._tx_undo)
+                    if self._tx_implicit and self._tx_undo is not None
+                    else None)
             try:
                 for row in chunk:
                     res = self._run_query(
@@ -1461,6 +1473,8 @@ class CypherExecutor:
                 raise
             if callable(tx_commit):
                 tx_commit(txid)
+            if mark is not None:
+                del self._tx_undo[mark:]
         return out
 
     def _foreach(self, clause: ast.ForeachClause, rows, params, stats) -> list[dict]:
@@ -1479,16 +1493,19 @@ class CypherExecutor:
         return rows
 
     def _load_csv(self, clause: ast.LoadCsvClause, rows, params) -> list[dict]:
+        # The reference refuses LOAD CSV in embedded mode outright
+        # (clauses.go:1800 "not supported"); here it exists as an opt-in
+        # superset gated exactly like apoc.load.* — never a default
+        # capability, confinable to an import directory.
+        from nornicdb_tpu.config import resolve_import_url
+
         out = []
         for row in rows:
             url = evaluate(clause.url, EvalContext(row, params, self))
-            path = str(url)
-            if path.startswith("file://"):
-                path = path[7:]
-            elif "://" in path:
-                raise CypherTypeError(
-                    "only file:// URLs are supported for LOAD CSV (zero-egress)"
-                )
+            try:
+                path = resolve_import_url(str(url))
+            except PermissionError as e:
+                raise CypherTypeError(str(e)) from None
             with open(path, newline="") as f:
                 reader = csv_mod.reader(f, delimiter=clause.field_terminator)
                 data = list(reader)
@@ -1590,9 +1607,70 @@ class CypherExecutor:
             self._tx_undo = None
         return Result([], [])
 
+    # thread-local views over _tx_state (see __init__ for why)
+    @property
+    def _tx_undo(self) -> Optional[list]:
+        return getattr(self._tx_state, "undo", None)
+
+    @_tx_undo.setter
+    def _tx_undo(self, v: Optional[list]) -> None:
+        self._tx_state.undo = v
+
+    @property
+    def _tx_implicit(self) -> bool:
+        return getattr(self._tx_state, "implicit", False)
+
+    @_tx_implicit.setter
+    def _tx_implicit(self, v: bool) -> None:
+        self._tx_state.implicit = v
+
+    @property
+    def _tx_id(self) -> Optional[str]:
+        return getattr(self._tx_state, "txid", None)
+
+    @_tx_id.setter
+    def _tx_id(self, v: Optional[str]) -> None:
+        self._tx_state.txid = v
+
     def _record_undo(self, fn: Callable[[], None]) -> None:
         if self._tx_undo is not None:
             self._tx_undo.append(fn)
+
+    def _run_query_atomic(self, stmt: ast.Query, params: dict) -> Result:
+        """Statement-level atomicity (ref: chaos_injection_test.go
+        TestRollback_* — 'partial writes are rolled back on error,
+        preventing data corruption from failed queries').
+
+        Outside an explicit transaction, every statement runs in an
+        implicit undo frame: if any clause fails mid-statement (undefined
+        function in a later SET, type error after a CREATE...), the
+        mutations already applied are undone in reverse order, so a failed
+        statement leaves storage exactly as it found it. Inside an explicit
+        transaction the open frame already accumulates undos, and
+        BEGIN/ROLLBACK owns the decision.
+
+        Memory: the frame holds one undo closure (and, for SET/DELETE, the
+        pre-image copy) per mutation until the statement finishes — the
+        price of atomicity, same as the reference's rollback tracking. For
+        bulk imports, CALL { ... } IN TRANSACTIONS OF n ROWS both bounds
+        this (committed batches drop their undos) and matches the tool the
+        reference points bulk writers at."""
+        if self._tx_undo is not None:
+            return self._run_query(stmt, params)
+        self._tx_undo = []
+        self._tx_implicit = True
+        try:
+            return self._run_query(stmt, params)
+        except Exception:
+            for undo in reversed(self._tx_undo):
+                try:
+                    undo()
+                except Exception:
+                    pass  # best effort: keep unwinding
+            raise
+        finally:
+            self._tx_undo = None
+            self._tx_implicit = False
 
     # -- DDL / admin ------------------------------------------------------------------
     def _create_index(self, stmt: ast.CreateIndex) -> Result:

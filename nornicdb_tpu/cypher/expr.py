@@ -7,6 +7,7 @@ neo4j_compat_test.go).
 
 from __future__ import annotations
 
+import functools
 import re
 from typing import Any, Optional
 
@@ -14,6 +15,61 @@ from nornicdb_tpu.cypher import ast
 from nornicdb_tpu.cypher.functions import FUNCTIONS
 from nornicdb_tpu.errors import CypherSyntaxError, CypherTypeError
 from nornicdb_tpu.storage.types import Edge, Node
+
+try:  # the `regex` engine supports a hard match timeout
+    import regex as _regex_mod
+except ImportError:  # pragma: no cover - regex is in the standard image
+    _regex_mod = None
+
+# Bound on a single =~ evaluation. The reference runs on Go's RE2, which is
+# linear-time by construction (chaos_injection_test.go TestInjection_RegexReDoS
+# relies on that); CPython's `re` backtracks exponentially, so a catastrophic
+# pattern like (a+)+$ would hang the executor for hours. The `regex` module's
+# timeout gives the same guarantee operationally: evil patterns error out
+# instead of wedging the query thread.
+REGEX_TIMEOUT_S = 2.0
+
+
+class BoundedPattern:
+    """A compiled regex whose matches are time-bounded. Compile once, match
+    per row (the columnar WHERE path scans whole property columns)."""
+
+    def __init__(self, pattern):
+        try:
+            if _regex_mod is not None:
+                self._pat = _regex_mod.compile(pattern)
+            else:  # pragma: no cover - regex is in the standard image
+                self._pat = re.compile(pattern)
+        except Exception:
+            raise CypherSyntaxError(f"invalid regex: {pattern!r}")
+        self._pattern = pattern
+
+    def fullmatch(self, value) -> bool:
+        try:
+            if _regex_mod is not None:
+                return self._pat.fullmatch(
+                    value, timeout=REGEX_TIMEOUT_S) is not None
+            return self._pat.fullmatch(value) is not None
+        except TimeoutError:
+            raise CypherSyntaxError(
+                f"regex timed out after {REGEX_TIMEOUT_S}s "
+                f"(catastrophic backtracking?): {self._pattern!r}"
+            )
+
+
+@functools.lru_cache(maxsize=256)
+def _compiled(pattern) -> BoundedPattern:
+    return BoundedPattern(pattern)
+
+
+def regex_fullmatch(pattern, value) -> bool:
+    """Cypher `=~`: full match, bounded runtime, CypherSyntaxError on a bad
+    pattern. Raises TypeError for non-string subjects (caller semantics)."""
+    try:
+        pat = _compiled(pattern)
+    except TypeError:  # unhashable pattern (caller passed a non-string)
+        pat = BoundedPattern(pattern)
+    return pat.fullmatch(value)
 
 
 class EvalContext:
@@ -314,10 +370,7 @@ def _binary(e: ast.BinaryOp, ctx: EvalContext) -> Any:
     if op == "=~":
         if a is None or b is None:
             return None
-        try:
-            return re.fullmatch(b, a) is not None
-        except re.error:
-            raise CypherSyntaxError(f"invalid regex: {b!r}")
+        return regex_fullmatch(b, a)
     if a is None or b is None:
         return None
     # temporal arithmetic: datetime/date ± duration, duration ± duration

@@ -299,19 +299,17 @@ def _compile_leaf(e: ast.Expr, var: str) -> Optional[Callable]:
             it = (v is not None and const is not None
                   and str(const) in str(v) for v in vals)
         elif op == "=~":
-            import re
+            # bounded engine shared with the row evaluator — a catastrophic
+            # pattern must error, not wedge the scan pool (see expr.py).
+            # _compiled: eager invalid-pattern error + cross-query memo.
+            from nornicdb_tpu.cypher.expr import _compiled
 
             if const is None:
                 return np.zeros(len(vals), bool)
-            try:
-                pat = re.compile(const)
-            except re.error:
-                from nornicdb_tpu.errors import CypherSyntaxError
-
-                raise CypherSyntaxError(f"invalid regex: {const!r}")
+            pat = _compiled(const)
             # non-string values raise TypeError in fullmatch, matching the
             # row evaluator's behavior exactly
-            it = (v is not None and pat.fullmatch(v) is not None
+            it = (v is not None and pat.fullmatch(v)
                   for v in vals)
         else:  # pragma: no cover
             return None

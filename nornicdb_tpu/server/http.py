@@ -25,7 +25,9 @@ from nornicdb_tpu.errors import AuthError, NornicError
 from nornicdb_tpu.storage.types import Edge, Node
 
 
+from nornicdb_tpu.cypher import ast as cypher_ast
 from nornicdb_tpu.cypher.executor import classify_query_text
+from nornicdb_tpu.cypher.parser import parse as cypher_parse
 
 
 def _jsonable(v: Any) -> Any:
@@ -1016,6 +1018,23 @@ class HttpServer:
         for stmt in body.get("statements", []):
             query = stmt.get("statement", "")
             params = stmt.get("parameters", {})
+            # each /tx/commit request is its own implicit transaction
+            # (Neo4j semantics); explicit tx control here would open a
+            # frame on one handler thread that no later request — served
+            # by a different thread — could ever commit or roll back.
+            # Gate on the parsed AST, not string prefixes: "BEGIN;",
+            # "/* c */ BEGIN" etc. must not slip through (parse() is
+            # memoized, so the executor's own parse stays a cache hit).
+            try:
+                if isinstance(cypher_parse(query), cypher_ast.TxCommand):
+                    errors.append({
+                        "code": "Neo.ClientError.Transaction.Invalid",
+                        "message": "explicit transaction control is not "
+                                   "available on the stateless tx endpoint",
+                    })
+                    break
+            except Exception:
+                pass  # unparseable: fall through, execute() reports it
             t0 = time.time()
             try:
                 ex = self.db.executor_for(database)

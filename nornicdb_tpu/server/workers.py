@@ -333,7 +333,8 @@ def _http_worker_main(host: str, public_port: int, primary_port: int,
 
 
 def _grpc_worker_main(host: str, public_port: int, primary_port: int,
-                      gen: GenerationFile, worker_id: int) -> None:
+                      gen: GenerationFile, worker_id: int,
+                      rate_limit: Optional[tuple] = None) -> None:
     from concurrent import futures
 
     import grpc
@@ -347,8 +348,21 @@ def _grpc_worker_main(host: str, public_port: int, primary_port: int,
         response_deserializer=lambda b: b,
     )
     cache = ResponseCache(lambda: gen.value)
+    limiter = None
+    if rate_limit:
+        from nornicdb_tpu.server.http import RateLimiter
+
+        # same per-worker-bucket caveat as the HTTP worker: effective
+        # ceiling is <= n_workers x rate, which is the point (cache hits
+        # must not be unlimited)
+        limiter = RateLimiter(rate=rate_limit[0], burst=int(rate_limit[1]))
 
     def call(request: bytes, context) -> bytes:
+        if limiter is not None:
+            peer = (context.peer() or "").rsplit(":", 1)[0]
+            if not limiter.allow(peer):
+                context.abort(grpc.StatusCode.RESOURCE_EXHAUSTED,
+                              "rate limit exceeded")
         # credentials are part of the cache key and travel with the proxied
         # call — GrpcSearchServer has no auth today, but the moment auth
         # metadata appears on this surface, cached responses must not leak
@@ -498,11 +512,7 @@ class WorkerPool:
 def _subproc_entry(argv: list[str]) -> None:
     cfg = json.loads(argv[0])
     gen = GenerationFile(cfg["gen_path"])
-    if cfg["kind"] == "http":
-        rl = cfg.get("rate_limit")
-        _http_worker_main(cfg["host"], cfg["port"], cfg["primary_port"], gen,
-                          cfg["worker_id"],
-                          rate_limit=tuple(rl) if rl else None)
-    else:
-        _grpc_worker_main(cfg["host"], cfg["port"], cfg["primary_port"], gen,
-                          cfg["worker_id"])
+    rl = tuple(cfg["rate_limit"]) if cfg.get("rate_limit") else None
+    main = _http_worker_main if cfg["kind"] == "http" else _grpc_worker_main
+    main(cfg["host"], cfg["port"], cfg["primary_port"], gen,
+         cfg["worker_id"], rate_limit=rl)

@@ -198,7 +198,8 @@ class TestGraphCategories:
 
 
 class TestOpsCategories:
-    def test_load_local_and_placeholders(self, tmp_path):
+    def test_load_local_and_placeholders(self, tmp_path, monkeypatch):
+        monkeypatch.setenv("NORNICDB_APOC_IMPORT_ENABLED", "true")
         f = tmp_path / "x.csv"
         f.write_text("a,b\n1,2\n3,4\n")
         rows = lookup("apoc.load.csv")(str(f))

@@ -1,0 +1,200 @@
+"""Port of pkg/cypher/function_match_chaos_test.go.
+
+The reference's keyword-dispatch parser detects function calls with string
+helpers (matchFuncStart / isFunctionCallWS / extractFuncArgs) and chaos-tests
+them with random whitespace/case. This framework parses Cypher into an AST,
+so the same assertion intent lands at the parse/eval level:
+
+- a function call parses and evaluates no matter what ASCII whitespace
+  separates the name from its paren (TestMatchFuncStartChaos,
+  TestChaosEdgeCases)
+- similarly-named identifiers are NOT confused for a function
+  (TestMatchFuncStartNegativeChaos)
+- nested calls bind to the right function (TestChaosNestedFunctions)
+- complex argument lists — strings containing parens, map/array literals,
+  multi-args — survive extraction (TestChaosComplexArguments)
+- realistic query patterns with random formatting execute
+  (TestChaosQueryPatterns)
+"""
+
+import random
+
+import pytest
+
+from nornicdb_tpu.cypher import CypherExecutor
+from nornicdb_tpu.errors import NornicError
+from nornicdb_tpu.storage import MemoryEngine
+
+SEED = 0xC4A05  # deterministic: the reference logs its seed for repro
+
+WHITESPACE = [" ", "  ", "\t", "\n", "\r\n", " \t ", "\n\n", ""]
+
+
+def rand_ws(rng):
+    return rng.choice(WHITESPACE)
+
+
+def rand_case(rng, s):
+    return "".join(c.upper() if rng.random() < 0.5 else c.lower() for c in s)
+
+
+@pytest.fixture
+def ex():
+    e = CypherExecutor(MemoryEngine())
+    e.execute("CREATE (:P {name: 'Ada', title: 'Countess', v: 5})")
+    return e
+
+
+class TestFunctionCallWhitespaceChaos:
+    """TestMatchFuncStartChaos + TestChaosEdgeCases: random whitespace and
+    case between function name and paren must not change parsing."""
+
+    @pytest.mark.parametrize("call,expected", [
+        ("count{ws}(n)", 1),
+        ("sum{ws}(n.v)", 5),
+        ("min{ws}(n.v)", 5),
+        ("max{ws}(n.v)", 5),
+        ("collect{ws}(n.name)", [["Ada"]][0]),
+        ("tolower{ws}(n.name)", "ada"),
+        ("toupper{ws}(n.name)", "ADA"),
+        ("trim{ws}('  x  ')", "x"),
+        ("substring{ws}(n.name, 0, 2)", "Ad"),
+        ("replace{ws}(n.name, 'A', 'O')", "Oda"),
+        ("split{ws}('a,b', ',')", ["a", "b"]),
+        ("tostring{ws}(n.v)", "5"),
+        ("tointeger{ws}('7')", 7),
+        ("tofloat{ws}('2.5')", 2.5),
+        ("toboolean{ws}('true')", True),
+        ("head{ws}([1,2])", 1),
+        ("last{ws}([1,2])", 2),
+        ("reverse{ws}([1,2])", [2, 1]),
+        ("size{ws}(n.name)", 3),
+        ("labels{ws}(n)", ["P"]),
+        ("keys{ws}(n)", None),  # presence-only check
+    ])
+    def test_whitespace_and_case_variants(self, ex, call, expected):
+        rng = random.Random(SEED)
+        for _ in range(6):
+            ws = rand_ws(rng)
+            name, rest = call.split("{ws}", 1)
+            expr = rand_case(rng, name) + ws + rest
+            r = ex.execute(f"MATCH (n:P) RETURN {expr} AS out")
+            assert len(r.rows) == 1
+            if expected is not None:
+                assert r.rows[0][0] == expected, expr
+
+    @pytest.mark.parametrize("ws", ["\t", "\n", "\n\n", " \t ", "\r\n"])
+    def test_ascii_whitespace_before_paren(self, ex, ws):
+        r = ex.execute(f"MATCH (n:P) RETURN count{ws}(n)")
+        assert r.rows == [[1]]
+
+    def test_space_inside_args(self, ex):
+        assert ex.execute("MATCH (n:P) RETURN count( n )").rows == [[1]]
+
+    def test_empty_args(self, ex):
+        r = ex.execute("RETURN timestamp ()")
+        assert len(r.rows) == 1 and isinstance(r.rows[0][0], int)
+
+
+class TestNoFalsePositives:
+    """TestMatchFuncStartNegativeChaos + TestChaosNoFalsePositiveInExpressions:
+    identifiers that merely share a prefix with a function name must resolve
+    as their own (unknown) function / property, never as the shorter one."""
+
+    @pytest.mark.parametrize("expr", [
+        "counter(1)", "counting(1)", "xcount(1)", "my_count(1)",
+        "sum_total(1)", "summary(1)", "average(1)", "tostringify(1)",
+        "pointer(1)", "distance_km(1)",
+    ])
+    def test_prefix_named_functions_are_unknown(self, ex, expr):
+        with pytest.raises(NornicError):
+            ex.execute(f"RETURN {expr}")
+
+    def test_property_named_like_function_is_property(self, ex):
+        """n.count is a property access, not the count() aggregate."""
+        ex.execute("CREATE (:Q {count: 99})")
+        assert ex.execute("MATCH (m:Q) RETURN m.count").rows == [[99]]
+
+    def test_string_containing_call_is_literal(self, ex):
+        r = ex.execute("RETURN 'count(n)' AS s")
+        assert r.rows == [["count(n)"]]
+
+
+class TestNestedFunctions:
+    """TestChaosNestedFunctions: nesting binds inner args to inner calls."""
+
+    def test_nested_with_random_ws(self, ex):
+        rng = random.Random(SEED)
+        for _ in range(10):
+            ws1, ws2 = rand_ws(rng), rand_ws(rng)
+            q = (f"MATCH (n:P) RETURN toLower{ws1}(substring{ws2}"
+                 f"(n.name, 0, 2)) AS out")
+            assert ex.execute(q).rows == [["ad"]]
+
+    def test_triple_nesting(self, ex):
+        assert ex.execute(
+            "RETURN toupper(tolower(toupper('MiXeD')))").rows == [["MIXED"]]
+
+
+class TestComplexArguments:
+    """TestChaosComplexArguments: arguments containing parens-in-strings,
+    map/list literals, and multiple args evaluate correctly."""
+
+    def test_string_with_parens(self, ex):
+        r = ex.execute("RETURN substring('hello(world)', 0, 5)")
+        assert r.rows == [["hello"]]
+
+    def test_nested_call_argument(self, ex):
+        r = ex.execute("MATCH (n:P) RETURN tolower(substring(n.name, 0, 5))")
+        assert r.rows == [["ada"]]
+
+    def test_map_literal_argument(self, ex):
+        r = ex.execute("RETURN keys({x: 10, y: 20})")
+        assert sorted(r.rows[0][0]) == ["x", "y"]
+
+    def test_array_literal_argument(self, ex):
+        assert ex.execute("RETURN size([1, 2, 3])").rows == [[3]]
+
+    def test_multiple_arguments(self, ex):
+        r = ex.execute(
+            "MATCH (n:P) RETURN coalesce(n.missing, n.title, 'default')")
+        assert r.rows == [["Countess"]]
+
+    def test_ws_inside_complex_args(self, ex):
+        rng = random.Random(SEED)
+        for _ in range(5):
+            ws = rand_ws(rng)
+            r = ex.execute(f"RETURN coalesce{ws}(null, 'found', 'x')")
+            assert r.rows == [["found"]]
+
+
+class TestChaosQueryPatterns:
+    """TestChaosQueryPatterns: realistic query shapes with random formatting."""
+
+    def test_count_star_formats(self, ex):
+        rng = random.Random(SEED)
+        for _ in range(8):
+            ws = rand_ws(rng)
+            r = ex.execute(f"MATCH (n:P) RETURN count{ws}(*) AS c")
+            assert r.rows == [[1]]
+
+    def test_aggregate_in_with_random_ws(self, ex):
+        rng = random.Random(SEED)
+        for _ in range(5):
+            ws1, ws2 = rand_ws(rng), rand_ws(rng)
+            q = (f"MATCH (n:P) WITH count{ws1}(n) AS c, "
+                 f"collect{ws2}(n.name) AS names RETURN c, names")
+            assert ex.execute(q).rows == [[1, ["Ada"]]]
+
+    def test_function_in_where(self, ex):
+        rng = random.Random(SEED)
+        for _ in range(5):
+            ws = rand_ws(rng)
+            q = f"MATCH (n:P) WHERE tolower{ws}(n.name) = 'ada' RETURN n.name"
+            assert ex.execute(q).rows == [["Ada"]]
+
+    def test_function_in_order_by(self, ex):
+        ex.execute("CREATE (:P {name: 'zed', v: 1})")
+        r = ex.execute(
+            "MATCH (n:P) RETURN n.name ORDER BY toupper (n.name)")
+        assert [row[0] for row in r.rows] == ["Ada", "zed"]

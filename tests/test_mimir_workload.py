@@ -1,0 +1,489 @@
+"""Port of the reference's Mimir workload regression suite.
+
+Mimir is the reference's flagship external client (a file-indexing
+knowledge base); these tests run the EXACT query shapes its index-api.ts
+issues, with production-shaped data. Maps to:
+- pkg/cypher/mimir_exact_test.go (stats/extension/byType exact queries +
+  the AsyncEngine-embedding-persistence e2e)
+- pkg/cypher/mimir_queries_test.go (connection, schema DDL, node/edge/
+  embedding/chunk operations, SET += edge cases)
+- pkg/cypher/mimir_stats_test.go (aggregate stats on partial data)
+
+The interesting assertions are semantic: OPTIONAL MATCH row
+multiplication makes the stats query count file embeddings once PER
+CHUNK (totalEmbeddings == 12 below, not 9) — a wrong-but-faithful
+behavior Mimir depends on, documented in mimir_exact_test.go:456-460.
+"""
+
+import pytest
+
+from nornicdb_tpu.cypher import CypherExecutor
+from nornicdb_tpu.storage import (
+    AsyncEngine,
+    MemoryEngine,
+    NamespacedEngine,
+    Node,
+    open_storage,
+)
+
+STATS_QUERY = """
+    MATCH (f:File)
+    OPTIONAL MATCH (f)-[:HAS_CHUNK]->(c:FileChunk)
+    WITH f, c,
+      CASE WHEN c IS NOT NULL AND c.embedding IS NOT NULL THEN 1 ELSE 0 END as chunkHasEmbedding,
+      CASE WHEN f.embedding IS NOT NULL THEN 1 ELSE 0 END as fileHasEmbedding
+    WITH
+      COUNT(DISTINCT f) as totalFiles,
+      COUNT(DISTINCT c) as totalChunks,
+      SUM(chunkHasEmbedding) + SUM(fileHasEmbedding) as totalEmbeddings,
+      COLLECT(DISTINCT f.extension) as extensions
+    RETURN
+      totalFiles,
+      totalChunks,
+      totalEmbeddings,
+      extensions
+"""
+
+EXTENSION_QUERY = """
+    MATCH (f:File)
+    WHERE f.extension IS NOT NULL
+    WITH f.extension as ext, COUNT(f) as count
+    RETURN ext, count
+    ORDER BY count DESC
+"""
+
+BY_TYPE_QUERY = """
+    MATCH (f:File)
+    WITH f, [label IN labels(f) WHERE label <> 'File'] as filteredLabels
+    UNWIND filteredLabels as label
+    WITH label, COUNT(f) as count
+    RETURN label as type, count
+    ORDER BY count DESC
+"""
+
+
+def _executor():
+    return CypherExecutor(NamespacedEngine(MemoryEngine(), "test"))
+
+
+def _stats(ex):
+    res = ex.execute(STATS_QUERY)
+    assert len(res.rows) == 1
+    return dict(zip(res.columns, res.rows[0]))
+
+
+def _create_files(ex):
+    """10 files: 8 .md, 1 .ts, 1 .js — production-shaped (311/313 are .md)."""
+    for i in range(1, 9):
+        ex.execute(
+            f"CREATE (:File:Node {{path: '/test/doc{i}.md', extension: '.md', "
+            f"name: 'doc{i}.md'}})"
+        )
+    ex.execute("CREATE (:File:Node {path: '/test/app.ts', extension: '.ts', name: 'app.ts'})")
+    ex.execute("CREATE (:File:Node {path: '/test/util.js', extension: '.js', name: 'util.js'})")
+
+
+class TestMimirExactQueries:
+    """mimir_exact_test.go TestMimirExactQueries"""
+
+    def test_stats_query_without_chunks(self):
+        ex = _executor()
+        _create_files(ex)
+        s = _stats(ex)
+        assert s["totalFiles"] == 10
+        assert s["totalChunks"] == 0
+        assert s["totalEmbeddings"] == 0
+        assert sorted(s["extensions"]) == [".js", ".md", ".ts"]
+
+    def test_extension_query(self):
+        ex = _executor()
+        _create_files(ex)
+        res = ex.execute(EXTENSION_QUERY)
+        by_ext = {row[0]: row[1] for row in res.rows}
+        assert by_ext == {".md": 8, ".ts": 1, ".js": 1}
+        # ORDER BY count DESC: .md first
+        assert res.rows[0][0] == ".md"
+
+    def test_by_type_query(self):
+        ex = _executor()
+        _create_files(ex)
+        res = ex.execute(BY_TYPE_QUERY)
+        by_type = {row[0]: row[1] for row in res.rows}
+        assert by_type.get("Node") == 10
+        assert "File" not in by_type, "File label must be filtered out"
+
+
+class TestMimirExactQueriesWithEmbeddings:
+    """mimir_exact_test.go TestMimirExactQueriesWithEmbeddings"""
+
+    def test_stats_counts_file_embedding_markers(self):
+        eng = NamespacedEngine(MemoryEngine(), "test")
+        ex = CypherExecutor(eng)
+        for i in range(1, 4):
+            ex.execute(
+                f"CREATE (:File:Node {{path: '/test/doc{i}.md', "
+                f"extension: '.md', name: 'doc{i}.md'}})"
+            )
+        nodes = eng.get_nodes_by_label("File")
+        assert len(nodes) == 3
+        for n in sorted(nodes, key=lambda n: n.properties["path"])[:2]:
+            n.properties["has_embedding"] = True
+            n.properties["embedding"] = True  # marker for IS NOT NULL
+            eng.update_node(n)
+        s = _stats(ex)
+        assert s["totalFiles"] == 3
+        assert s["totalChunks"] == 0
+        assert s["totalEmbeddings"] == 2
+
+
+class TestMimirSchemaInitialization:
+    """mimir_queries_test.go TestMimirSchemaInitialization — every DDL the
+    client issues on startup must succeed (or no-op)."""
+
+    @pytest.mark.parametrize("ddl", [
+        "CREATE CONSTRAINT node_id_unique IF NOT EXISTS "
+        "FOR (n:Node) REQUIRE n.id IS UNIQUE",
+        "CREATE FULLTEXT INDEX node_search IF NOT EXISTS "
+        "FOR (n:Node) ON EACH [n.properties]",
+        "CREATE INDEX node_type IF NOT EXISTS FOR (n:Node) ON (n.type)",
+        "CREATE CONSTRAINT watch_config_id_unique IF NOT EXISTS "
+        "FOR (w:WatchConfig) REQUIRE w.id IS UNIQUE",
+        "CREATE INDEX watch_config_path IF NOT EXISTS "
+        "FOR (w:WatchConfig) ON (w.path)",
+        "CREATE INDEX file_path IF NOT EXISTS FOR (f:File) ON (f.path)",
+        "CREATE FULLTEXT INDEX file_metadata_search IF NOT EXISTS "
+        "FOR (f:File) ON EACH [f.path, f.name, f.language]",
+        "CREATE FULLTEXT INDEX file_chunk_content_search IF NOT EXISTS "
+        "FOR (c:FileChunk) ON EACH [c.text]",
+    ])
+    def test_schema_ddl(self, ddl):
+        _executor().execute(ddl)
+
+    def test_vector_index_ddl(self):
+        _executor().execute("""
+            CREATE VECTOR INDEX node_embedding_index IF NOT EXISTS
+            FOR (n:Node) ON (n.embedding)
+            OPTIONS {indexConfig: {
+              `vector.dimensions`: 768,
+              `vector.similarity_function`: 'cosine'
+            }}
+        """)
+
+
+class TestMimirNodeOperations:
+    """mimir_queries_test.go TestMimirNodeOperations — the CRITICAL ones."""
+
+    def test_full_node_lifecycle(self):
+        ex = _executor()
+        # addNode
+        res = ex.execute("""
+            CREATE (n:Node {
+                id: 'todo-1-1734202000000',
+                type: 'todo',
+                created: '2025-12-14T18:00:00.000Z',
+                updated: '2025-12-14T18:00:00.000Z',
+                has_embedding: false,
+                taskId: 'audit-translation',
+                title: 'Audit Translation Quality',
+                status: 'pending'
+            }) RETURN n
+        """)
+        node = res.rows[0][0]
+        assert isinstance(node, Node), "RETURN n must yield a Node object"
+        assert node.properties["id"] == "todo-1-1734202000000"
+        assert node.properties["status"] == "pending"
+        assert "Node" in node.labels
+
+        # getNode
+        res = ex.execute("MATCH (n:Node {id: 'todo-1-1734202000000'}) RETURN n")
+        assert res.rows[0][0].properties["id"] == "todo-1-1734202000000"
+
+        # updateNode with SET += (CRITICAL for the client)
+        res = ex.execute("""
+            MATCH (n:Node {id: 'todo-1-1734202000000'})
+            SET n += {status: 'worker_executing', updated: '2025-12-14T18:00:01.000Z'}
+            RETURN n
+        """)
+        node = res.rows[0][0]
+        assert node.properties["status"] == "worker_executing"
+        assert node.properties["updated"] == "2025-12-14T18:00:01.000Z"
+        assert node.properties["type"] == "todo"  # originals preserved
+        assert node.properties["title"] == "Audit Translation Quality"
+
+        # alternative SET syntax
+        res = ex.execute("""
+            MATCH (n:Node {id: 'todo-1-1734202000000'})
+            SET n.status = 'completed', n.updated = '2025-12-14T18:02:00.000Z'
+            RETURN n
+        """)
+        assert res.rows[0][0].properties["status"] == "completed"
+
+        # deleteNode with DETACH DELETE
+        ex.execute("""
+            MATCH (n:Node {id: 'todo-1-1734202000000'})
+            DETACH DELETE n
+        """)
+        res = ex.execute("MATCH (n:Node {id: 'todo-1-1734202000000'}) RETURN n")
+        assert res.rows == []
+
+
+class TestMimirEdgeOperations:
+    """mimir_queries_test.go TestMimirEdgeOperations"""
+
+    def test_edge_lifecycle(self):
+        ex = _executor()
+        ex.execute("CREATE (s:Node {id: 'source-node-id', type: 'task'})")
+        ex.execute("CREATE (t:Node {id: 'target-node-id', type: 'task'})")
+        res = ex.execute("""
+            MATCH (s:Node {id: 'source-node-id'}), (t:Node {id: 'target-node-id'})
+            CREATE (s)-[e:EDGE {id: 'edge-1-1734202000000', type: 'depends_on',
+                                created: '2025-12-14T18:00:00.000Z'}]->(t)
+            RETURN e
+        """)
+        assert len(res.rows) == 1
+        ex.execute("""
+            MATCH ()-[e:EDGE {id: 'edge-1-1734202000000'}]->()
+            DELETE e
+        """)
+        res = ex.execute("MATCH ()-[e:EDGE]->() RETURN count(e)")
+        assert res.rows[0][0] == 0
+
+
+class TestMimirEmbeddingUpdates:
+    """mimir_queries_test.go TestMimirEmbeddingUpdates"""
+
+    def test_set_embedding_array_and_flags(self):
+        ex = _executor()
+        ex.execute("CREATE (n:Node {id: 'test-node-1', type: 'document'})")
+        res = ex.execute("""
+            MATCH (n:Node {id: 'test-node-1'})
+            SET n.embedding = [0.1, 0.2, 0.3],
+                n.embedding_dimensions = 768,
+                n.embedding_model = 'nomic-embed-text',
+                n.has_embedding = true
+            RETURN n
+        """)
+        node = res.rows[0][0]
+        assert node.properties["has_embedding"] is True
+        assert node.properties["embedding_model"] == "nomic-embed-text"
+        res = ex.execute("""
+            MATCH (n:Node {id: 'test-node-1'})
+            SET n.has_embedding = true, n.has_chunks = true
+            RETURN n
+        """)
+        node = res.rows[0][0]
+        assert node.properties["has_chunks"] is True
+
+
+class TestMimirChunkOperations:
+    """mimir_queries_test.go TestMimirChunkOperations"""
+
+    def test_merge_chunk_with_on_create_set(self):
+        ex = _executor()
+        ex.execute("CREATE (n:Node {id: 'parent-node-id', type: 'document'})")
+        res = ex.execute("""
+            MATCH (n:Node {id: 'parent-node-id'})
+            MERGE (c:NodeChunk:Node {id: 'chunk-parent-node-id-0'})
+            ON CREATE SET
+              c.chunk_index = 0,
+              c.text = 'chunk text here',
+              c.start_offset = 0,
+              c.end_offset = 768,
+              c.type = 'node_chunk',
+              c.parentNodeId = 'parent-node-id',
+              c.has_embedding = true
+            MERGE (n)-[:HAS_CHUNK {index: 0}]->(c)
+            RETURN c.id AS chunk_id
+        """)
+        assert res.rows == [["chunk-parent-node-id-0"]]
+
+        # delete chunks via OPTIONAL MATCH
+        ex.execute("""
+            MATCH (n:Node {id: 'parent-node-id'})
+            OPTIONAL MATCH (n)-[r:HAS_CHUNK]->(chunk:NodeChunk)
+            DELETE r, chunk
+        """)
+        res = ex.execute("MATCH (c:NodeChunk) RETURN count(c)")
+        assert res.rows[0][0] == 0
+
+
+class TestSetPlusEqualsEdgeCases:
+    """mimir_queries_test.go TestSetPlusEqualsEdgeCases"""
+
+    def test_set_plus_equals_multiple_properties(self):
+        ex = _executor()
+        ex.execute("CREATE (n:Node {id: 'nested-test', data: 'original'})")
+        res = ex.execute("""
+            MATCH (n:Node {id: 'nested-test'})
+            SET n += {
+                status: 'active',
+                count: 42,
+                enabled: true,
+                tags: 'tag1,tag2'
+            }
+            RETURN n
+        """)
+        node = res.rows[0][0]
+        assert node.properties["status"] == "active"
+        assert node.properties["count"] == 42
+        assert node.properties["enabled"] is True
+        assert node.properties["data"] == "original"
+
+    def test_set_plus_equals_without_return(self):
+        ex = _executor()
+        ex.execute("CREATE (n:Node {id: 'no-return-test'})")
+        ex.execute("MATCH (n:Node {id: 'no-return-test'}) SET n += {updated: true}")
+        res = ex.execute("MATCH (n:Node {id: 'no-return-test'}) RETURN n.updated")
+        assert res.rows == [[True]]
+
+    def test_set_plus_equals_nonexistent_returns_empty(self):
+        ex = _executor()
+        res = ex.execute("""
+            MATCH (n:Node {id: 'does-not-exist'})
+            SET n += {status: 'updated'}
+            RETURN n
+        """)
+        assert res.rows == []
+
+
+class TestMimirStatsQueries:
+    """mimir_stats_test.go TestMimirStatsQueries — partial data (a file with
+    no extension) must not break the aggregate shapes."""
+
+    @pytest.fixture
+    def ex(self):
+        ex = _executor()
+        ex.execute("CREATE (f:File:Node {path: '/t/f1.ts', extension: '.ts', name: 'f1.ts'})")
+        ex.execute("CREATE (f:File:Node {path: '/t/f2.ts', extension: '.ts', name: 'f2.ts'})")
+        ex.execute("CREATE (f:File:Node {path: '/t/f3.md', extension: '.md', name: 'f3.md'})")
+        ex.execute("CREATE (f:File:Node {path: '/t/f4.js', extension: '.js', name: 'f4.js'})")
+        ex.execute("CREATE (f:File:Node {path: '/t/f5.txt', name: 'f5.txt'})")  # no ext
+        return ex
+
+    def test_aggregate_stats(self, ex):
+        s = _stats(ex)
+        assert s["totalFiles"] == 5
+        assert s["totalChunks"] == 0
+        assert s["totalEmbeddings"] == 0
+
+    def test_extension_groups_skip_missing(self, ex):
+        res = ex.execute(EXTENSION_QUERY)
+        by_ext = {row[0]: row[1] for row in res.rows}
+        assert by_ext == {".ts": 2, ".md": 1, ".js": 1}
+        assert res.rows[0][0] == ".ts"  # DESC order
+
+    def test_by_type(self, ex):
+        res = ex.execute(BY_TYPE_QUERY)
+        assert res.rows[0][0] == "Node"
+        assert res.rows[0][1] == 5
+
+
+class TestMimirE2EWithAsyncStorageAndEmbeddings:
+    """mimir_exact_test.go TestMimirE2EWithAsyncStorageAndEmbeddings —
+    the production stack (durable engine + namespacing + AsyncEngine), chunk
+    graph via Cypher MERGE, embeddings set through the async overlay, and
+    the regression the reference fixed: embeddings must persist through the
+    async flush to disk."""
+
+    def test_full_e2e(self, tmp_path):
+        base = open_storage(str(tmp_path / "data"))
+        eng = AsyncEngine(NamespacedEngine(base, "test"), flush_interval=0.1)
+        ex = CypherExecutor(eng)
+        try:
+            for i in range(1, 9):
+                ex.execute(
+                    f"CREATE (:File:Node {{id: 'file{i}', path: '/test/doc{i}.md', "
+                    f"extension: '.md', name: 'doc{i}.md', content: 'content {i}'}})"
+                )
+            ex.execute("CREATE (:File:Node {id: 'file9', path: '/test/app.ts', "
+                       "extension: '.ts', name: 'app.ts', content: 'typescript'})")
+            ex.execute("CREATE (:File:Node {id: 'file10', path: '/test/util.js', "
+                       "extension: '.js', name: 'util.js', content: 'javascript'})")
+
+            # chunks for files 1-5, 2 each, via the client's MERGE shape
+            for i in range(1, 6):
+                for j, suffix in enumerate(("a", "b")):
+                    ex.execute(f"""
+                        MATCH (f:File {{path: '/test/doc{i}.md'}})
+                        MERGE (c:FileChunk:Node {{id: 'chunk{i}{suffix}'}})
+                        SET c.chunk_index = {j}, c.text = 'chunk {i}{suffix} text',
+                            c.parent_file_id = 'file{i}', c.type = 'file_chunk',
+                            c.total_chunks = 2
+                        MERGE (f)-[:HAS_CHUNK {{index: {j}}}]->(c)
+                    """)
+            eng.flush()
+
+            files = {n.properties["path"]: n for n in eng.get_nodes_by_label("File")}
+            chunks = {n.properties["id"]: n for n in eng.get_nodes_by_label("FileChunk")}
+            assert len(files) == 10 and len(chunks) == 10
+
+            # embeddings: 3 files + 6 chunks, via the async overlay
+            import numpy as np
+
+            for path in ["/test/doc1.md", "/test/doc2.md", "/test/doc3.md"]:
+                n = files[path]
+                n.chunk_embeddings = [np.array([0.1, 0.2, 0.3, 0.4], np.float32)]
+                n.properties["embedding"] = [0.1, 0.2, 0.3, 0.4]
+                n.properties["has_embedding"] = True
+                eng.update_node(n)
+            for cid in ["chunk1a", "chunk1b", "chunk2a", "chunk2b", "chunk3a", "chunk3b"]:
+                c = chunks[cid]
+                c.chunk_embeddings = [np.array([0.5, 0.6, 0.7, 0.8], np.float32)]
+                c.properties["embedding"] = [0.5, 0.6, 0.7, 0.8]
+                c.properties["has_embedding"] = True
+                eng.update_node(c)
+            eng.flush()
+
+            # exact stats: totalEmbeddings is 12, NOT 9 — OPTIONAL MATCH
+            # multiplies each file row by its chunks, so 3 embedded files
+            # x2 chunks + 6 embedded chunks (mimir_exact_test.go:456-460)
+            s = _stats(ex)
+            assert s["totalFiles"] == 10
+            assert s["totalChunks"] == 10
+            assert s["totalEmbeddings"] == 12
+
+            res = ex.execute(EXTENSION_QUERY)
+            assert {r[0]: r[1] for r in res.rows} == {".md": 8, ".ts": 1, ".js": 1}
+            res = ex.execute(BY_TYPE_QUERY)
+            by_type = {r[0]: r[1] for r in res.rows}
+            assert by_type.get("Node") == 10  # only (f:File) rows counted
+
+            # the regression the reference fixed: embeddings must have
+            # persisted THROUGH the async flush to the durable engine
+            files_embedded = sum(
+                1 for n in base.get_nodes_by_label("File")
+                if n.chunk_embeddings
+            )
+            chunks_embedded = sum(
+                1 for n in base.get_nodes_by_label("FileChunk")
+                if n.chunk_embeddings
+            )
+            assert files_embedded == 3
+            assert chunks_embedded == 6
+        finally:
+            eng.close()
+            base.close()
+
+
+class TestMimirQuickSuite:
+    """mimir_queries_test.go TestMimirConnectionTest + TestMimirQuickTestSuite"""
+
+    def test_connection(self):
+        assert _executor().execute("RETURN 1 as test").rows == [[1]]
+
+    def test_critical_sequence(self):
+        ex = _executor()
+        ex.execute("CREATE (n:Node {id: 'seq-1', type: 'task', status: 'pending'})")
+        ex.execute("MATCH (n:Node {id: 'seq-1'}) SET n += {status: 'running'}")
+        assert ex.execute(
+            "MATCH (n:Node {id: 'seq-1'}) RETURN n.status").rows == [["running"]]
+        ex.execute("CREATE (m:Node {id: 'seq-2', type: 'task'})")
+        ex.execute("""
+            MATCH (a:Node {id: 'seq-1'}), (b:Node {id: 'seq-2'})
+            CREATE (a)-[:DEPENDS_ON]->(b)
+        """)
+        assert ex.execute(
+            "MATCH (:Node {id: 'seq-1'})-[r:DEPENDS_ON]->() RETURN count(r)"
+        ).rows == [[1]]
+        ex.execute("MATCH (n:Node {id: 'seq-1'}) DETACH DELETE n")
+        assert ex.execute("MATCH (n:Node {id: 'seq-1'}) RETURN n").rows == []

@@ -1,0 +1,361 @@
+"""Port of the reference's storage corruption + race regression suites.
+
+Maps to:
+- pkg/storage/wal_corruption_test.go (CRC behavior, corrupted-entry
+  detection, replay tracking, round-trip integrity, corrupt-tail recovery)
+- pkg/storage/async_engine_count_flush_race_test.go
+  (TestAsyncEngine_NodeCount_BlocksDuringFlush)
+- pkg/gpu/score_subset_race_test.go
+  (TestEmbeddingIndex_ScoreSubset_ConcurrentRemoveDoesNotPanic)
+
+The framework's WAL is binary-framed (magic/version/len + CRC32 footer)
+rather than JSON-lines, so corruption is injected at the byte level; the
+assertion intent is identical: corrupted entries must be detected — never
+silently applied — a corrupt middle with intact records after it must flag
+degraded mode (committed data lost), and a torn tail must be benign.
+"""
+
+import struct
+import threading
+import zlib
+
+import numpy as np
+import pytest
+
+from nornicdb_tpu.errors import WALCorruptionError
+from nornicdb_tpu.storage import AsyncEngine, MemoryEngine, Node
+from nornicdb_tpu.storage.wal import (
+    _FOOTER,
+    _HEADER,
+    MAGIC,
+    OP_CREATE_NODE,
+    WAL,
+    WALEntry,
+)
+
+
+def _wal_with_nodes(tmp_path, n=3):
+    wal = WAL(str(tmp_path))
+    for i in range(n):
+        wal.append(OP_CREATE_NODE, Node(id=f"n{i}", labels=["Test"]).to_dict())
+    wal.close()
+    return str(tmp_path / "wal.log")
+
+
+def _records(buf):
+    """Split a WAL buffer into (offset, length) framed records."""
+    out = []
+    off = 0
+    while off + _HEADER.size <= len(buf):
+        magic, ver, oplen = _HEADER.unpack_from(buf, off)
+        if magic != MAGIC:
+            break
+        body_end = off + _HEADER.size + oplen + _FOOTER.size
+        total = body_end - off
+        total += (-total) % 8
+        out.append((off, total))
+        off += total
+    return out
+
+
+# =============================================================================
+# CRC32 (TestCRC32ProperImplementation / MatchesStandardLibrary /
+# Deterministic / TestVerifyCRC32)
+# =============================================================================
+class TestCRC32:
+    @pytest.mark.parametrize("a,b", [
+        (bytes([0, 0, 0, 0]), bytes([0, 0, 0, 1])),      # single bit flip
+        (bytes([1, 2, 3, 4]), bytes([4, 3, 2, 1])),      # byte swap
+        (b"hello", b"hellp"),                            # off by one
+        (b"test", b"test\x00"),                          # length difference
+    ])
+    def test_no_weak_collisions(self, a, b):
+        """TestCRC32ProperImplementation — a weak XOR checksum collides on
+        these; real CRC32 must not."""
+        assert zlib.crc32(a) != zlib.crc32(b)
+
+    def test_record_crc_matches_stdlib(self, tmp_path):
+        """TestCRC32MatchesStandardLibrary — the CRC stored in each record
+        footer is exactly stdlib crc32 of the payload."""
+        path = _wal_with_nodes(tmp_path)
+        buf = open(path, "rb").read()
+        assert len(_records(buf)) == 3
+        for off, _ in _records(buf):
+            _, _, oplen = _HEADER.unpack_from(buf, off)
+            payload = buf[off + _HEADER.size: off + _HEADER.size + oplen]
+            crc, _ = _FOOTER.unpack_from(buf, off + _HEADER.size + oplen)
+            assert crc == zlib.crc32(payload) & 0xFFFFFFFF
+
+    def test_deterministic(self, tmp_path):
+        """TestCRC32Deterministic — identical entries encode identically."""
+        e = WALEntry(seq=7, op=OP_CREATE_NODE, data={"id": "n1"})
+        first = e.encode()
+        for _ in range(100):
+            assert WALEntry(seq=7, op=OP_CREATE_NODE,
+                            data={"id": "n1"}).encode() == first
+
+    def test_verify_integrity_helper(self, tmp_path):
+        """TestVerifyCRC32 — verify_integrity is ok for a clean log; after a
+        byte of corruption the open quarantines the damaged file (keeping it
+        for forensics), flags degraded, and the rewritten log holds only the
+        valid prefix."""
+        import os
+
+        path = _wal_with_nodes(tmp_path)
+        wal = WAL(str(tmp_path))
+        n, ok = wal.verify_integrity()
+        assert (n, ok) == (3, True)
+        wal.close()
+        buf = bytearray(open(path, "rb").read())
+        buf[_records(bytes(buf))[1][0] + _HEADER.size] ^= 0xFF
+        open(path, "wb").write(bytes(buf))
+        wal = WAL(str(tmp_path))
+        assert wal.stats.degraded, "corruption must be flagged on open"
+        assert os.path.exists(path + ".corrupt-1"), "damaged log preserved"
+        n, ok = wal.verify_integrity()
+        assert (n, ok) == (1, True), "rewritten log holds the valid prefix"
+        wal.close()
+
+
+# =============================================================================
+# CORRUPTED ENTRY DETECTION (TestWALDetectsCorruptedChecksum,
+# TestWALSkipsCorruptedEmbeddingEntries)
+# =============================================================================
+class TestCorruptionDetection:
+    def test_detects_corrupted_checksum(self, tmp_path):
+        """TestWALDetectsCorruptedChecksum — an entry whose stored CRC does
+        not match its payload must error in strict mode and never be
+        returned as valid."""
+        path = _wal_with_nodes(tmp_path, n=2)
+        buf = bytearray(open(path, "rb").read())
+        recs = _records(bytes(buf))
+        # flip a bit in the SECOND record's stored checksum
+        off, total = recs[1]
+        _, _, oplen = _HEADER.unpack_from(bytes(buf), off)
+        buf[off + _HEADER.size + oplen] ^= 0x01
+        open(path, "wb").write(bytes(buf))
+
+        wal = WAL(str(tmp_path))
+        with pytest.raises(WALCorruptionError):
+            wal.read_all(strict=True)
+        wal.close()
+        # non-strict: the corrupted entry is never surfaced as data
+        wal = WAL(str(tmp_path))
+        entries = wal.read_all()
+        assert [e.data["id"] for e in entries] == ["n0"]
+        wal.close()
+
+    def test_corrupt_middle_with_valid_after_is_degraded(self, tmp_path):
+        """TestWALSkipsCorruptedEmbeddingEntries intent, mapped to this
+        framework's contract: a corrupt record FOLLOWED by intact records
+        means committed data was lost — recovery continues but flags
+        degraded mode (wal_degraded.go)."""
+        path = _wal_with_nodes(tmp_path, n=3)
+        buf = bytearray(open(path, "rb").read())
+        recs = _records(bytes(buf))
+        off, _ = recs[1]
+        buf[off + _HEADER.size + 2] ^= 0xFF  # corrupt middle payload
+        open(path, "wb").write(bytes(buf))
+
+        wal = WAL(str(tmp_path))
+        entries = wal.read_all()
+        assert [e.data["id"] for e in entries] == ["n0"]
+        assert wal.stats.degraded, "intact records after corruption = degraded"
+        assert "offset" in (wal.stats.corruption_info or "")
+        wal.close()
+
+    def test_corrupt_tail_only_is_benign(self, tmp_path):
+        """Counterpart: a torn FINAL record (crash mid-append) is expected
+        and must NOT flag degraded mode."""
+        path = _wal_with_nodes(tmp_path, n=2)
+        with open(path, "ab") as f:
+            f.write(_HEADER.pack(MAGIC, 1, 9999))  # header promising bytes
+        wal = WAL(str(tmp_path))
+        entries = wal.read_all()
+        assert [e.data["id"] for e in entries] == ["n0", "n1"]
+        assert not wal.stats.degraded
+        wal.close()
+
+
+# =============================================================================
+# REPLAY TRACKING (TestReplayResultTracking, TestRecoverFromWAL...)
+# =============================================================================
+class TestReplayTracking:
+    def test_replay_applies_and_tolerates_duplicates(self, tmp_path):
+        """TestReplayResultTracking — duplicates / checkpoint-class entries
+        must be skipped without failing recovery."""
+        wal = WAL(str(tmp_path))
+        n1 = Node(id="n1", labels=["Test"])
+        n2 = Node(id="n2", labels=["Test"])
+        wal.append(OP_CREATE_NODE, n1.to_dict())
+        wal.append(OP_CREATE_NODE, n2.to_dict())
+        wal.append(OP_CREATE_NODE, n1.to_dict())  # duplicate — must skip
+        wal.close()
+
+        wal = WAL(str(tmp_path))
+        engine = MemoryEngine()
+        applied = wal.recover(engine)
+        assert applied == 3  # three entries processed...
+        assert engine.node_count() == 2  # ...two landed, duplicate skipped
+        wal.close()
+
+    def test_recovery_tracks_errors_but_keeps_valid_data(self, tmp_path):
+        """TestRecoverFromWALWithResultTracksErrors — an edge whose endpoints
+        do not exist must not poison recovery of the valid node."""
+        from nornicdb_tpu.storage import Edge
+        from nornicdb_tpu.storage.wal import OP_CREATE_EDGE
+
+        wal = WAL(str(tmp_path))
+        wal.append(OP_CREATE_NODE, Node(id="valid-node", labels=["Test"]).to_dict())
+        wal.append(OP_CREATE_EDGE, Edge(
+            id="e1", start_node="nonexistent1", end_node="nonexistent2",
+            type="LINKS").to_dict())
+        wal.close()
+
+        wal = WAL(str(tmp_path))
+        engine = MemoryEngine()
+        wal.recover(engine)
+        assert engine.get_node("valid-node") is not None
+        assert engine.edge_count() == 0
+        wal.close()
+
+
+# =============================================================================
+# ROUND-TRIP INTEGRITY (TestWALEntryIntegrity)
+# =============================================================================
+class TestEntryIntegrity:
+    def test_full_round_trip(self, tmp_path):
+        """TestWALEntryIntegrity — append, reopen, decode, verify checksums
+        and node payloads byte-for-byte."""
+        nodes = [
+            Node(id="n1", labels=["Person"], properties={"name": "Alice"}),
+            Node(id="n2", labels=["Person"], properties={"name": "Bob"}),
+        ]
+        wal = WAL(str(tmp_path), sync=True)
+        for n in nodes:
+            wal.append(OP_CREATE_NODE, n.to_dict())
+        wal.close()
+
+        wal = WAL(str(tmp_path))
+        entries = wal.read_all()
+        assert len(entries) == 2
+        for entry, node in zip(entries, nodes):
+            assert entry.op == OP_CREATE_NODE
+            assert entry.data["id"] == node.id
+            assert entry.data["properties"]["name"] == node.properties["name"]
+        n, ok = wal.verify_integrity()
+        assert (n, ok) == (2, True)
+        wal.close()
+
+
+# =============================================================================
+# ASYNC ENGINE COUNT/FLUSH RACE
+# (pkg/storage/async_engine_count_flush_race_test.go)
+# =============================================================================
+class _BlockingBase(MemoryEngine):
+    """Base engine whose create_node blocks until released — freezes a
+    flush mid-apply, exactly like the reference's blockingBulkCreateEngine."""
+
+    def __init__(self):
+        super().__init__()
+        self.create_started = threading.Event()
+        self.allow_create = threading.Event()
+        self._passthrough = True
+
+    def arm(self):
+        self._passthrough = False
+
+    def create_node(self, node):
+        if not self._passthrough:
+            self.create_started.set()
+            assert self.allow_create.wait(timeout=30), "never released"
+        return super().create_node(node)
+
+
+class TestAsyncCountFlushRace:
+    def test_node_count_blocks_during_flush(self):
+        """TestAsyncEngine_NodeCount_BlocksDuringFlush — node_count must not
+        return a count that misses entries a concurrent flush has already
+        popped from the overlay but not yet applied to the base."""
+        base = _BlockingBase()
+        ae = AsyncEngine(base, flush_interval=3600.0)  # manual flush only
+        try:
+            ae.create_node(Node(id="nornic:node-1", labels=["N"]))
+            ae.create_node(Node(id="nornic:node-2", labels=["N"]))
+            base.arm()
+
+            flush_done = threading.Event()
+            threading.Thread(target=lambda: (ae.flush(), flush_done.set()),
+                             daemon=True).start()
+            assert base.create_started.wait(timeout=5), "flush never started"
+
+            # node_count must BLOCK while the flush holds the lock
+            count_result = []
+            t = threading.Thread(
+                target=lambda: count_result.append(ae.node_count()),
+                daemon=True)
+            t.start()
+            t.join(timeout=0.2)
+            assert t.is_alive(), (
+                "node_count returned mid-flush — the popped-but-unapplied "
+                "window escaped the count"
+            )
+
+            base.allow_create.set()
+            assert flush_done.wait(timeout=10)
+            t.join(timeout=10)
+            assert count_result == [2]
+        finally:
+            base.allow_create.set()
+            ae.close()
+
+
+# =============================================================================
+# SCORE-SUBSET CONCURRENT REMOVE RACE (pkg/gpu/score_subset_race_test.go)
+# =============================================================================
+class TestScoreSubsetRace:
+    def test_concurrent_remove_does_not_crash(self):
+        """TestEmbeddingIndex_ScoreSubset_ConcurrentRemoveDoesNotPanic —
+        score_subset racing remove/re-add of the same id must never raise
+        or attribute a score to the wrong id."""
+        from nornicdb_tpu.ops.similarity import DeviceCorpus
+
+        idx = DeviceCorpus(dims=4)
+        idx.add("a", np.array([1, 0, 0, 0], np.float32))
+        idx.add("b", np.array([0, 1, 0, 0], np.float32))
+        query = np.array([0, 1, 0, 0], np.float32)
+
+        errors = []
+        stop = threading.Event()
+
+        def scorer():
+            try:
+                for _ in range(300):
+                    results = idx.score_subset(query, ["b"])
+                    if len(results) > 1:
+                        errors.append(f"unexpected results length {len(results)}")
+                        return
+                    if len(results) == 1 and results[0][0] != "b":
+                        errors.append(f"unexpected result id {results[0][0]}")
+                        return
+            except Exception as e:  # noqa: BLE001 — the test IS about crashes
+                errors.append(f"scorer raised: {e!r}")
+            finally:
+                stop.set()
+
+        def churner():
+            try:
+                vec = np.array([0, 1, 0, 0], np.float32)
+                while not stop.is_set():
+                    idx.remove("b")
+                    idx.add("b", vec)
+            except Exception as e:  # noqa: BLE001
+                errors.append(f"churner raised: {e!r}")
+
+        ts = [threading.Thread(target=scorer, daemon=True),
+              threading.Thread(target=churner, daemon=True)]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join(timeout=120)
+        assert not errors, errors
