@@ -90,11 +90,18 @@ def load_json_stream(data):
 
 @register("apoc.load.jsonParams")
 def load_json_params(path_or_data, params=None):
-    """Load JSON after ${param} substitution."""
-    try:
-        text = _read_local(path_or_data)
-    except (OSError, NornicError):
-        text = str(path_or_data)
+    """Load JSON after ${param} substitution. Accepts a file path (import-
+    gated) or inline JSON data; a gated path must surface the gate error,
+    not fall through to 'parse the path as JSON'."""
+    data = str(path_or_data)
+    looks_inline = data.lstrip()[:1] in ("{", "[", '"')
+    if looks_inline:
+        text = data
+    else:
+        try:
+            text = _read_local(data)
+        except OSError:
+            text = data  # not a readable file: treat as inline data
     for k, v in (params or {}).items():
         text = text.replace("${" + str(k) + "}", str(v))
     return _json.loads(text)

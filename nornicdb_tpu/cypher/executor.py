@@ -113,6 +113,11 @@ class CypherExecutor:
         # TestRollback_ConcurrentWritesDuringRollback exercises). Explicit
         # transactions are per-connection-thread too (Bolt session model).
         self._tx_state = threading.local()
+        # Write statements serialize while their undo frame is live:
+        # rollback restores whole-entity pre-images, so a concurrent write
+        # statement committing between another's mutation and its unwind
+        # would be silently erased (lost update). Reads never take this.
+        self._write_stmt_lock = threading.RLock()
         self._last_call_columns: list[str] = []
         self.query_count = 0
         self._colindex: Any = None  # lazy ColumnarScanIndex; False = unusable
@@ -1657,20 +1662,28 @@ class CypherExecutor:
         reference points bulk writers at."""
         if self._tx_undo is not None:
             return self._run_query(stmt, params)
-        self._tx_undo = []
-        self._tx_implicit = True
-        try:
+        if not _is_write_query(stmt):
             return self._run_query(stmt, params)
-        except Exception:
-            for undo in reversed(self._tx_undo):
-                try:
-                    undo()
-                except Exception:
-                    pass  # best effort: keep unwinding
-            raise
-        finally:
-            self._tx_undo = None
-            self._tx_implicit = False
+        # single-writer while a frame is live: see _write_stmt_lock. An
+        # explicit BEGIN..COMMIT still interleaves with other writers
+        # between ITS statements (a session lock held across client round
+        # trips would let an abandoned connection wedge every writer) —
+        # same read-committed caveat as the reference's executor.
+        with self._write_stmt_lock:
+            self._tx_undo = []
+            self._tx_implicit = True
+            try:
+                return self._run_query(stmt, params)
+            except Exception:
+                for undo in reversed(self._tx_undo):
+                    try:
+                        undo()
+                    except Exception:
+                        pass  # best effort: keep unwinding
+                raise
+            finally:
+                self._tx_undo = None
+                self._tx_implicit = False
 
     # -- DDL / admin ------------------------------------------------------------------
     def _create_index(self, stmt: ast.CreateIndex) -> Result:

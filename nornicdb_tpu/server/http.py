@@ -355,6 +355,27 @@ class HttpServer:
 
             h._send(200, UI_HTML, content_type="text/html; charset=utf-8")
             return
+        if path in ("/openapi.json", "/openapi.yaml", "/docs"):
+            # machine-readable API description + embedded explorer
+            # (ref: docs/api-reference/openapi.yaml + cmd/swagger-ui).
+            # Behind serve_ui: the reference ships swagger-ui as a separate
+            # binary, so a headless build exposes no docs/HTML surface —
+            # and the spec enumerates every endpoint, which a locked-down
+            # deployment may not want served unauthenticated.
+            if not self.serve_ui:
+                h._send(404, {"error": "ui disabled"})
+                return
+            from nornicdb_tpu.server import openapi
+
+            if path == "/docs":
+                h._send(200, openapi.DOCS_HTML,
+                        content_type="text/html; charset=utf-8")
+            elif path == "/openapi.yaml":
+                h._send(200, openapi.spec_yaml(),
+                        content_type="application/yaml; charset=utf-8")
+            else:
+                h._send(200, openapi.build_spec())
+            return
         if path.startswith("/auth/oauth/authorize"):
             # OAuth2 authorization-code flow, resource-owner-credential
             # variant (ref: pkg/auth/oauth.go + cmd/oauth-provider): GET

@@ -9,6 +9,13 @@ login (cookie session via POST /auth/token), Cypher console, hybrid
 search, Heimdall chat, admin (user management + live server stats), and
 security (change password, generate API tokens) — all speaking the same
 HTTP endpoints as the reference UI's utils/api.ts.
+
+Browser-parity affordances (ref: ui/src/pages/Browser.tsx Edit/Trash/
+History + DB switcher): query history in localStorage (click to restore,
+clear), per-node edit/delete buttons on node-shaped result cells (edit
+prompts for a properties JSON then issues `SET n = $props` by id; delete
+issues DETACH DELETE), and a database switcher in the header populated
+from SHOW DATABASES that retargets /db/{name}/tx/commit.
 """
 
 UI_HTML = """<!DOCTYPE html>
@@ -65,6 +72,8 @@ UI_HTML = """<!DOCTYPE html>
     <a data-view="security" href="/security" onclick="return go(event,'security')">Security</a>
   </nav>
   <div class="row">
+    <select id="db-select" class="hidden" style="width:auto"
+            onchange="switchDb(this.value)"></select>
     <div id="whoami"></div>
     <button id="logout-btn" class="small hidden" onclick="logout()">logout</button>
     <div id="stats">loading…</div>
@@ -87,7 +96,17 @@ UI_HTML = """<!DOCTYPE html>
   <section class="wide">
     <h2>Cypher</h2>
     <textarea id="cypher">MATCH (n) RETURN n LIMIT 10</textarea>
-    <button onclick="runCypher()">Run (Ctrl-Enter)</button>
+    <div class="row">
+      <button onclick="runCypher()">Run (Ctrl-Enter)</button>
+      <button class="small" onclick="toggleHistory()">History</button>
+    </div>
+    <div id="history-panel" class="hidden">
+      <div class="row" style="justify-content:space-between">
+        <h2 style="margin:10px 0 4px">Query history</h2>
+        <button class="small danger" onclick="clearHistory()">clear</button>
+      </div>
+      <div id="history-list"></div>
+    </div>
     <pre id="cypher-out"></pre>
   </section>
   <section>
@@ -150,7 +169,7 @@ UI_HTML = """<!DOCTYPE html>
 </main>
 
 <script>
-let ME = null, AUTH_ON = false;
+let ME = null, AUTH_ON = false, DB = 'neo4j';
 
 async function post(path, body) {
   const r = await fetch(path, {method:'POST', credentials:'include',
@@ -212,6 +231,7 @@ async function boot() {
   go(null, path === '/admin' && isAdmin ? 'admin'
         : path === '/security' ? 'security' : 'console');
   refreshStats();
+  loadDatabases();
 }
 
 async function doLogin() {
@@ -248,22 +268,151 @@ async function refreshStats() {
   } catch (e) {}
 }
 
-async function runCypher() {
+// -- database switcher (ref: Browser.tsx DB selector) ------------------------
+async function loadDatabases() {
+  try {
+    const r = await post(`/db/${DB}/tx/commit`,
+      {statements:[{statement:'SHOW DATABASES'}]});
+    const res = (r.results||[])[0];
+    if (!res) return;
+    const nameIdx = res.columns.indexOf('name');
+    const sel = document.getElementById('db-select');
+    sel.innerHTML = '';
+    for (const row of res.data) {
+      const o = document.createElement('option');
+      o.value = o.text = row.row[nameIdx];
+      o.selected = (o.value === DB);
+      sel.add(o);
+    }
+    sel.classList.remove('hidden');
+  } catch (e) {}
+}
+function switchDb(name) {
+  DB = name;
+  document.getElementById('cypher-out').innerHTML = '';
+  refreshStats();
+}
+
+// -- query history (ref: Browser.tsx History affordance) ---------------------
+const HIST_KEY = 'nornic_query_history', HIST_MAX = 50;
+function loadHistory() {
+  try { return JSON.parse(localStorage.getItem(HIST_KEY)) || []; }
+  catch (e) { return []; }
+}
+function pushHistory(stmt) {
+  stmt = stmt.trim();
+  if (!stmt) return;
+  const h = loadHistory().filter(q => q !== stmt);
+  h.unshift(stmt);
+  localStorage.setItem(HIST_KEY, JSON.stringify(h.slice(0, HIST_MAX)));
+  renderHistory();
+}
+function clearHistory() {
+  localStorage.removeItem(HIST_KEY);
+  renderHistory();
+}
+function toggleHistory() {
+  document.getElementById('history-panel').classList.toggle('hidden');
+  renderHistory();
+}
+function renderHistory() {
+  const box = document.getElementById('history-list');
+  box.innerHTML = '';
+  const h = loadHistory();
+  if (!h.length) { box.innerText = '(empty)'; return; }
+  for (const q of h) {
+    const a = document.createElement('a');
+    a.href = '#';
+    a.style.display = 'block';
+    a.style.color = 'var(--muted)';
+    a.innerText = q.length > 120 ? q.slice(0, 120) + '…' : q;
+    a.addEventListener('click', ev => {
+      ev.preventDefault();
+      document.getElementById('cypher').value = q;
+    });
+    box.appendChild(a);
+  }
+}
+
+// -- node affordances in results (ref: Browser.tsx Edit/Trash) ---------------
+function isNodeValue(v) {
+  return v && typeof v === 'object' && !Array.isArray(v) &&
+    typeof v.id === 'string' && Array.isArray(v.labels) &&
+    typeof v.properties === 'object';
+}
+function txFailed(r) {
+  // the tx API reports statement failures in errors[]; auth/transport
+  // failures come back as {error: ...} — surface either, never swallow
+  if (r && r.errors && r.errors.length) return r.errors[0].message;
+  if (r && r.error) return r.error;
+  return null;
+}
+async function editNode(node) {
+  const txt = prompt('properties JSON for (' + node.labels.join(':') + ')',
+                     JSON.stringify(node.properties));
+  if (txt === null) return;
+  let props;
+  try { props = JSON.parse(txt); }
+  catch (e) { alert('invalid JSON: ' + e); return; }
+  const r = await post(`/db/${DB}/tx/commit`, {statements:[{
+    statement: 'MATCH (n) WHERE id(n) = $id SET n = $props',
+    parameters: {id: node.id, props}}]});
+  const err = txFailed(r);
+  if (err) { alert('edit failed: ' + err); return; }
+  runCypher(true);
+}
+async function deleteNode(node) {
+  if (!confirm('DETACH DELETE node ' + node.id + '?')) return;
+  const r = await post(`/db/${DB}/tx/commit`, {statements:[{
+    statement: 'MATCH (n) WHERE id(n) = $id DETACH DELETE n',
+    parameters: {id: node.id}}]});
+  const err = txFailed(r);
+  if (err) { alert('delete failed: ' + err); return; }
+  runCypher(true);
+}
+
+async function runCypher(rerun) {
   const out = document.getElementById('cypher-out');
   const stmt = document.getElementById('cypher').value;
+  if (!rerun) pushHistory(stmt);
   try {
-    const r = await post('/db/neo4j/tx/commit', {statements:[{statement:stmt}]});
+    const r = await post(`/db/${DB}/tx/commit`, {statements:[{statement:stmt}]});
     if (r.errors && r.errors.length) {
       out.innerHTML = '<span class="err">' + esc(r.errors[0].message) + '</span>';
     } else {
       const res = r.results[0] || {columns:[], data:[]};
-      let html = '<table><tr>' + res.columns.map(c=>'<th>'+esc(c)+'</th>').join('') + '</tr>';
+      const table = document.createElement('table');
+      const head = document.createElement('tr');
+      head.innerHTML = res.columns.map(c=>'<th>'+esc(c)+'</th>').join('');
+      table.appendChild(head);
       for (const row of res.data) {
-        html += '<tr>' + row.row.map(v=>'<td>'+esc(JSON.stringify(v))+'</td>').join('') + '</tr>';
+        const tr = document.createElement('tr');
+        for (const v of row.row) {
+          const td = document.createElement('td');
+          td.innerText = JSON.stringify(v);
+          if (isNodeValue(v)) {
+            td.append(document.createElement('br'));
+            const ed = document.createElement('button');
+            ed.className = 'small';
+            ed.innerText = 'edit';
+            ed.addEventListener('click', () => editNode(v));
+            const del = document.createElement('button');
+            del.className = 'small danger';
+            del.innerText = 'delete';
+            del.addEventListener('click', () => deleteNode(v));
+            td.append(ed, del);
+          }
+          tr.appendChild(td);
+        }
+        table.appendChild(tr);
       }
-      out.innerHTML = html + '</table>' +
-        (res.stats && Object.keys(res.stats).length
-          ? '<div>'+esc(JSON.stringify(res.stats))+'</div>' : '');
+      out.innerHTML = '';
+      out.appendChild(table);
+      if (res.stats && Object.keys(res.stats).length) {
+        const d = document.createElement('div');
+        d.innerText = JSON.stringify(res.stats);
+        out.appendChild(d);
+      }
     }
   } catch (e) { out.innerHTML = '<span class="err">'+esc(String(e))+'</span>'; }
   refreshStats();

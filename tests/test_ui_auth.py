@@ -351,6 +351,65 @@ class TestSpaServing:
                        "/auth/api-token"):
             assert marker in body
 
+    def test_spa_has_browser_parity_affordances(self, open_server):
+        """ref: ui/src/pages/Browser.tsx — query history, node edit/delete,
+        DB switcher (VERDICT round-2 item 9)."""
+        _, body, _ = _req(open_server.port, "/")
+        for marker in ("nornic_query_history", "pushHistory", "clearHistory",
+                       "renderHistory", "db-select", "SHOW DATABASES",
+                       "switchDb", "editNode", "deleteNode",
+                       "DETACH DELETE n", "SET n = $props"):
+            assert marker in body
+
+    def test_node_edit_delete_flow_via_tx_api(self, open_server):
+        """The exact statements the console's edit/delete buttons issue."""
+        port = open_server.port
+        _, r, _ = _req(port, "/db/neo4j/tx/commit", "POST", {
+            "statements": [{"statement":
+                            "CREATE (n:UiEdit {k: 1}) RETURN n"}]})
+        node = r["results"][0]["data"][0]["row"][0]
+        assert node["labels"] == ["UiEdit"] and node["properties"] == {"k": 1}
+        # edit: SET n = $props by id (what editNode() sends)
+        _, r, _ = _req(port, "/db/neo4j/tx/commit", "POST", {
+            "statements": [{
+                "statement": "MATCH (n) WHERE id(n) = $id SET n = $props",
+                "parameters": {"id": node["id"], "props": {"k": 2, "x": "y"}},
+            }]})
+        assert not r["errors"]
+        _, r, _ = _req(port, "/db/neo4j/tx/commit", "POST", {
+            "statements": [{"statement":
+                            "MATCH (n:UiEdit) RETURN n.k, n.x"}]})
+        assert r["results"][0]["data"][0]["row"] == [2, "y"]
+        # delete: DETACH DELETE by id (what deleteNode() sends)
+        _, r, _ = _req(port, "/db/neo4j/tx/commit", "POST", {
+            "statements": [{
+                "statement": "MATCH (n) WHERE id(n) = $id DETACH DELETE n",
+                "parameters": {"id": node["id"]},
+            }]})
+        assert not r["errors"]
+        _, r, _ = _req(port, "/db/neo4j/tx/commit", "POST", {
+            "statements": [{"statement":
+                            "MATCH (n:UiEdit) RETURN count(n)"}]})
+        assert r["results"][0]["data"][0]["row"] == [0]
+
+    def test_db_switcher_flow_via_tx_api(self, open_server):
+        """SHOW DATABASES lists switchable DBs and /db/{name}/tx/commit
+        routes to the named database (what switchDb() relies on)."""
+        port = open_server.port
+        _, r, _ = _req(port, "/db/neo4j/tx/commit", "POST", {
+            "statements": [{"statement": "SHOW DATABASES"}]})
+        res = r["results"][0]
+        name_idx = res["columns"].index("name")
+        names = [row["row"][name_idx] for row in res["data"]]
+        assert "neo4j" in names and "system" in names
+        # writes to the default DB are not visible via another DB route
+        _req(port, "/db/neo4j/tx/commit", "POST", {
+            "statements": [{"statement": "CREATE (:UiDbScope {v: 1})"}]})
+        _, r, _ = _req(port, "/db/system/tx/commit", "POST", {
+            "statements": [{"statement":
+                            "MATCH (n:UiDbScope) RETURN count(n)"}]})
+        assert r["results"][0]["data"][0]["row"] == [0]
+
     def test_headless_disables_ui(self):
         db = nornicdb_tpu.open_db("")
         server = HttpServer(db, port=0, serve_ui=False)
