@@ -124,7 +124,9 @@ def apoc_node_degree(ex: CypherExecutor, args, row):
 
 @procedure("apoc.neighbors.tohop")
 def apoc_neighbors(ex: CypherExecutor, args, row):
-    node = args[0]
+    from nornicdb_tpu.cypher.gds_procedures import _resolve_node
+
+    node = _resolve_node(ex, args[0])
     rel_types: set[str] = set()
     if len(args) > 1 and isinstance(args[1], str):
         # "KNOWS|WORKS_WITH>" style spec; direction arrows are stripped

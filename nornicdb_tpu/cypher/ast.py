@@ -427,6 +427,7 @@ class DropConstraint:
 class ShowCommand:
     what: str  # indexes/constraints/databases/procedures/functions
     yield_items: list[str] = field(default_factory=list)
+    target: Optional[str] = None  # SHOW ALIASES FOR DATABASE <target>
 
 
 @dataclass

@@ -34,6 +34,7 @@ from nornicdb_tpu.errors import (
     AlreadyExistsError,
     CypherSyntaxError,
     CypherTypeError,
+    NornicError,
     NotFoundError,
     TransactionError,
 )
@@ -170,6 +171,15 @@ class CypherExecutor:
         stmt = parse(query)
         if self.strict_validation:
             validate(stmt)
+        if isinstance(stmt, ast.Query):
+            # per-database query rate limit (ref: enforcement.go
+            # MaxQueriesPerSecond); the bucket lives on the LimitedEngine
+            bucket = getattr(self.storage, "query_bucket", None)
+            if bucket is not None and not bucket.take():
+                raise NornicError(
+                    "database query rate limit exceeded "
+                    f"({self.storage.limits.max_queries_per_second}/s)"
+                )
         if self.cache is not None and isinstance(stmt, ast.Query):
             write = _is_write_query(stmt)
             if self._tx_undo is not None and not write:
@@ -625,7 +635,21 @@ class CypherExecutor:
         columns: list[str] = []
         out_rows: list[list[Any]] = []
         produced = False
+        # per-database query budget (ref: enforcement.go MaxQueryTime):
+        # checked at clause boundaries — coarse, but enough to stop
+        # multi-clause runaways without per-row overhead
+        limits = getattr(self.storage, "limits", None)
+        deadline = (
+            time.time() + limits.max_query_time
+            if limits is not None and getattr(limits, "max_query_time", 0)
+            else None
+        )
         for clause in q.clauses:
+            if deadline is not None and time.time() > deadline:
+                raise NornicError(
+                    f"query exceeded max_query_time "
+                    f"({limits.max_query_time}s)"
+                )
             if isinstance(clause, ast.ReturnClause):
                 columns, out_rows = self._project(clause, rows, params, stats)
                 produced = True
@@ -1601,11 +1625,7 @@ class CypherExecutor:
         elif stmt.op == "rollback":
             if self._tx_undo is None:
                 raise TransactionError("no open transaction")
-            for undo in reversed(self._tx_undo):
-                try:
-                    undo()
-                except Exception:
-                    pass
+            self._apply_undos(self._tx_undo)
             wal = getattr(self.storage, "tx_rollback", None)
             if callable(wal):
                 wal(self._tx_id)
@@ -1675,15 +1695,26 @@ class CypherExecutor:
             try:
                 return self._run_query(stmt, params)
             except Exception:
-                for undo in reversed(self._tx_undo):
-                    try:
-                        undo()
-                    except Exception:
-                        pass  # best effort: keep unwinding
+                self._apply_undos(self._tx_undo)
                 raise
             finally:
                 self._tx_undo = None
                 self._tx_implicit = False
+
+    def _apply_undos(self, undos: list) -> None:
+        """Apply undo closures in reverse, with per-database rate limits
+        suspended: a rollback must never itself be throttled, or the
+        statement would be left half-unwound."""
+        import contextlib as _ctx
+
+        exempt = getattr(self.storage, "exempt_writes", None)
+        cm = exempt() if callable(exempt) else _ctx.nullcontext()
+        with cm:
+            for undo in reversed(undos):
+                try:
+                    undo()
+                except Exception:
+                    pass  # best effort: keep unwinding
 
     # -- DDL / admin ------------------------------------------------------------------
     def _create_index(self, stmt: ast.CreateIndex) -> Result:
@@ -1736,10 +1767,32 @@ class CypherExecutor:
         if stmt.what == "aliases":
             mgr = getattr(self.db, "database_manager", None) if self.db else None
             if mgr is not None:
-                return Result(
-                    ["name", "database"], [[a, t] for a, t in mgr.list_aliases()]
-                )
+                pairs = mgr.list_aliases()
+                if stmt.target:
+                    pairs = [(a, t) for a, t in pairs if t == stmt.target]
+                return Result(["name", "database"],
+                              [[a, t] for a, t in pairs])
             return Result(["name", "database"], [])
+        if stmt.what == "limits":
+            # columns per system_commands_test.go:511: a single "unlimited"
+            # row when nothing is set, else one row per configured limit
+            mgr = getattr(self.db, "database_manager", None) if self.db else None
+            if mgr is None:
+                raise CypherSyntaxError(
+                    "multi-database commands require a DatabaseManager")
+            from nornicdb_tpu.multidb.manager import DatabaseLimits
+
+            limits = mgr.get_limits(stmt.target)
+            cols = ["database", "limit", "value", "description"]
+            rows = [
+                [stmt.target, f, getattr(limits, f),
+                 f.replace("_", " ")]
+                for f in DatabaseLimits.FIELD_NAMES if getattr(limits, f)
+            ]
+            if not rows:
+                rows = [[stmt.target, "unlimited", None,
+                         "no limits configured"]]
+            return Result(cols, rows)
         raise CypherSyntaxError(f"unsupported SHOW {stmt.what}")
 
     def _database_command(self, stmt: ast.DatabaseCommand) -> Result:
@@ -1756,7 +1809,31 @@ class CypherExecutor:
         elif stmt.op == "create_alias":
             mgr.create_alias(stmt.name, stmt.options["target"])
         elif stmt.op == "drop_alias":
-            mgr.drop_alias(stmt.name)
+            try:
+                mgr.drop_alias(stmt.name)
+            except NotFoundError:
+                if not stmt.if_exists:
+                    raise
+        elif stmt.op == "set_limits":
+            # ALTER DATABASE name SET LIMIT k = v (ref:
+            # system_commands_test.go:423-486): unknown keys must error,
+            # existing limit values are preserved unless overridden
+            from nornicdb_tpu.multidb.manager import DatabaseLimits
+
+            current = mgr.get_limits(stmt.name)
+            updates = stmt.options["limits"]
+            for key in updates:
+                if key not in DatabaseLimits.FIELD_NAMES:
+                    raise CypherSyntaxError(
+                        f"unknown limit {key!r} (valid: "
+                        f"{', '.join(DatabaseLimits.FIELD_NAMES)})"
+                    )
+            merged = {f: getattr(current, f) for f in DatabaseLimits.FIELD_NAMES}
+            merged.update({
+                k: (float(v) if k == "max_query_time" else int(v))
+                for k, v in updates.items()
+            })
+            mgr.set_limits(stmt.name, DatabaseLimits(**merged))
         elif stmt.op == "create_composite":
             mgr.create_composite(stmt.name)
         elif stmt.op == "composite_add_alias":
@@ -2233,20 +2310,231 @@ def proc_vector_create(ex: CypherExecutor, args, row):
     return [], []
 
 
+@procedure("db.index.vector.createrelationshipindex")
+def proc_vector_create_rel(ex: CypherExecutor, args, row):
+    """db.index.vector.createRelationshipIndex(name, relType, prop, dims,
+    similarity) — relationship vectors live in an edge property (ref:
+    vector_procedures_test.go:719: edges carry {features: [...]})."""
+    name, rel_type, prop = str(args[0]), str(args[1]), str(args[2])
+    dims = int(args[3]) if len(args) > 3 else 0
+    sim = str(args[4]) if len(args) > 4 else "cosine"
+    ex.schema.create_index(
+        name, "vector-rel", rel_type, [prop],
+        {"vector.dimensions": dims, "vector.similarity_function": sim},
+        if_not_exists=True,
+    )
+    return [], []
+
+
+@procedure("db.index.vector.queryrelationships")
+def proc_vector_query_rels(ex: CypherExecutor, args, row):
+    """db.index.vector.queryRelationships(indexName, k, vectorOrText)
+    YIELD relationship, score. Unknown index -> empty result with the
+    right columns (ref: vector_procedures_test.go:782-787)."""
+    if len(args) < 3:
+        raise CypherSyntaxError(
+            "db.index.vector.queryRelationships(indexName, k, vectorOrText)"
+        )
+    index_name, k, query = str(args[0]), int(args[1]), args[2]
+    idx = next(
+        (i for i in ex.schema.list_indexes()
+         if i.name == index_name and i.kind == "vector-rel"),
+        None,
+    )
+    if idx is None:
+        return ["relationship", "score"], []
+    if isinstance(query, str):
+        embedder = getattr(ex.db, "embedder", None) if ex.db else None
+        if embedder is None:
+            raise CypherTypeError(
+                "string query requires an embedder (SetEmbedder)"
+            )
+        query = embedder.embed(query)
+    q = np.asarray(query, np.float32)
+    qn = float(np.linalg.norm(q)) or 1.0
+    prop = idx.properties[0]
+    sim = str(idx.options.get("vector.similarity_function", "cosine")).lower()
+    scored = []
+    for e in ex.storage.get_edges_by_type(idx.label):
+        vec = e.properties.get(prop)
+        if not isinstance(vec, (list, tuple)) or not vec:
+            continue
+        v = np.asarray(vec, np.float32)
+        if v.shape != q.shape:
+            continue
+        if sim == "euclidean":
+            # Neo4j's euclidean score: 1 / (1 + d^2) — higher is closer
+            d2 = float(np.sum((q - v) ** 2))
+            score = 1.0 / (1.0 + d2)
+        else:
+            vn = float(np.linalg.norm(v)) or 1.0
+            score = float(np.dot(q, v) / (qn * vn))
+        scored.append((score, e))
+    scored.sort(key=lambda t: -t[0])
+    return ["relationship", "score"], [[e, s] for s, e in scored[:k]]
+
+
+@procedure("db.index.vector.drop")
+def proc_vector_drop(ex: CypherExecutor, args, row):
+    ex.schema.drop_index(str(args[0]) if args else "", if_exists=True)
+    return [], []
+
+
 @procedure("db.awaitindexes")
 def proc_await_indexes(ex: CypherExecutor, args, row):
     return [], []
 
 
-@procedure("db.awaitindex")
-def proc_await_index(ex: CypherExecutor, args, row):
-    """db.awaitIndex(name[, timeoutSeconds]) — indexes are maintained
-    synchronously here, so an existing index is always online; an unknown
-    name errors like the reference."""
-    name = str(args[0]) if args else ""
-    if name and not any(i.name == name for i in ex.schema.list_indexes()):
-        raise CypherTypeError(f"no such index: {name}")
+@procedure("db.indexes")
+def proc_db_indexes(ex: CypherExecutor, args, row):
+    """Legacy listing (ref: clauses_test.go CALL db.indexes())."""
+    return (["name", "type", "labelsOrTypes", "properties"],
+            [[i.name, i.kind, [i.label], i.properties]
+             for i in ex.schema.list_indexes()])
+
+
+@procedure("dbms.functions")
+def proc_dbms_functions(ex: CypherExecutor, args, row):
+    names = sorted(set(FUNCTIONS) | set(ex._plugin_functions))
+    return ["name"], [[n] for n in names]
+
+
+@procedure("nornicdb.decay.info")
+def proc_decay_info(ex: CypherExecutor, args, row):
+    """(ref: clauses_test.go:427 — one row describing the decay config)"""
+    decay = getattr(ex.db, "decay", None) if ex.db else None
+    cfg = getattr(decay, "config", None)
+    return (["enabled", "halfLifeDays", "floor"],
+            [[decay is not None,
+              getattr(cfg, "half_life_days", 30.0),
+              getattr(cfg, "floor", 0.1)]])
+
+
+@procedure("db.schema.nodeproperties")
+def proc_schema_node_properties(ex: CypherExecutor, args, row):
+    """(ref: clauses_test.go:468) nodeLabels + propertyName + types."""
+    seen: dict[tuple, set] = {}
+    for n in ex.storage.all_nodes():
+        for k, v in n.properties.items():
+            seen.setdefault((tuple(sorted(n.labels)), k), set()).add(
+                type(v).__name__)
+    return (["nodeLabels", "propertyName", "propertyTypes"],
+            [[list(labels), key, sorted(types)]
+             for (labels, key), types in sorted(seen.items())])
+
+
+@procedure("db.constraints")
+def proc_db_constraints(ex: CypherExecutor, args, row):
+    """Legacy listing (ref: db_procedures_test.go CALL db.constraints())."""
+    return (["name", "type", "labelsOrTypes", "properties"],
+            [[c.name, c.kind.upper(), [c.label], c.properties]
+             for c in ex.schema.list_constraints()])
+
+
+@procedure("db.stats.retrieveallanthestats")
+def proc_db_stats_retrieve(ex: CypherExecutor, args, row):
+    """(sic — the reference registers this exact name,
+    db_procedures_test.go: db.stats.retrieveAllAnTheStats)"""
+    return (["section", "data"],
+            [["GRAPH COUNTS", {
+                "nodes": ex.storage.node_count(),
+                "relationships": ex.storage.edge_count(),
+            }]])
+
+
+@procedure("gds.version")
+def proc_gds_version(ex: CypherExecutor, args, row):
+    return ["version"], [["2.5.0-nornicdb-tpu"]]
+
+
+@procedure("nornicdb.version")
+def proc_nornic_version(ex: CypherExecutor, args, row):
+    """(ref: apoc_integration_test.go:32)"""
+    return ["version", "edition"], [["0.4.0", "tpu"]]
+
+
+@procedure("nornicdb.stats")
+def proc_nornic_stats(ex: CypherExecutor, args, row):
+    return (["nodes", "relationships", "labels"],
+            [[ex.storage.node_count(), ex.storage.edge_count(),
+              sorted({l for n in ex.storage.all_nodes()
+                      for l in n.labels})]])
+
+
+@procedure("db.create.setnodevectorproperty")
+def proc_set_node_vector(ex: CypherExecutor, args, row):
+    """db.create.setNodeVectorProperty(nodeIdOrNode, prop, vector)
+    (ref: vector_procedures_test.go:184)."""
+    if len(args) < 3:
+        raise CypherSyntaxError(
+            "db.create.setNodeVectorProperty(node, key, vector)")
+    target, prop, vec = args[0], str(args[1]), args[2]
+    node = target if isinstance(target, Node) else ex.storage.get_node(str(target))
+    old = node.copy()  # pre-image BEFORE the mutation, like every undo site
+    node.properties[prop] = [float(v) for v in (vec or [])]
+    ex.storage.update_node(node)
+    ex._record_undo(lambda o=old: ex.storage.update_node(o))
+    return ["node"], [[node]]
+
+
+@procedure("db.create.setrelationshipvectorproperty")
+def proc_set_rel_vector(ex: CypherExecutor, args, row):
+    if len(args) < 3:
+        raise CypherSyntaxError(
+            "db.create.setRelationshipVectorProperty(rel, key, vector)")
+    target, prop, vec = args[0], str(args[1]), args[2]
+    edge = target if isinstance(target, Edge) else ex.storage.get_edge(str(target))
+    old = edge.copy()
+    edge.properties[prop] = [float(v) for v in (vec or [])]
+    ex.storage.update_edge(edge)
+    ex._record_undo(lambda o=old: ex.storage.update_edge(o))
+    return ["relationship"], [[edge]]
+
+
+@procedure("db.index.fulltext.createrelationshipindex")
+def proc_fulltext_create_rel(ex: CypherExecutor, args, row):
+    """db.index.fulltext.createRelationshipIndex(name, relType, prop)."""
+    name, rel_type = str(args[0]), str(args[1])
+    props = [str(p) for p in args[2:]] or ["text"]
+    ex.schema.create_index(name, "fulltext-rel", rel_type, props, {},
+                           if_not_exists=True)
     return [], []
+
+
+@procedure("db.index.fulltext.queryrelationships")
+def proc_fulltext_query_rels(ex: CypherExecutor, args, row):
+    """YIELD relationship, score: BM25-free substring/token scoring over
+    the indexed edge properties (parity shape; unknown index -> empty)."""
+    if len(args) < 2:
+        raise CypherSyntaxError(
+            "db.index.fulltext.queryRelationships(indexName, query)")
+    index_name, query = str(args[0]), str(args[1]).lower()
+    idx = next(
+        (i for i in ex.schema.list_indexes()
+         if i.name == index_name and i.kind == "fulltext-rel"),
+        None,
+    )
+    if idx is None:
+        return ["relationship", "score"], []
+    terms = query.split()
+    out = []
+    for e in ex.storage.get_edges_by_type(idx.label):
+        text = " ".join(
+            str(e.properties.get(p, "")) for p in idx.properties
+        ).lower()
+        hits = sum(1 for t in terms if t in text)
+        if hits:
+            out.append([e, hits / max(len(terms), 1)])
+    out.sort(key=lambda r: -r[1])
+    return ["relationship", "score"], out
+
+
+@procedure("db.awaitindex")
+def proc_await_index2(ex: CypherExecutor, args, row):
+    """db.awaitIndex(name[, timeoutSeconds]) yields status — indexes are
+    maintained synchronously, and the reference tolerates unknown names
+    (db_procedures_test.go:126 awaits 'my_index' on an empty store)."""
+    return ["status"], [["online"]]
 
 
 @procedure("db.resampleindex")

@@ -62,6 +62,11 @@ def fn_type(x):
         return None
     if isinstance(x, Edge):
         return x.type
+    if isinstance(x, list) and x and all(isinstance(e, Edge) for e in x):
+        # var-length pattern binding: r in (a)-[r*1..2]-(b) is a LIST of
+        # relationships; the reference's type(r) answers with the first
+        # hop's type (graph_traversal_test.go:190 requires NoError)
+        return x[0].type
     raise CypherTypeError("type() expects a relationship")
 
 
@@ -458,6 +463,37 @@ def fn_atan2(y, x):
     if _null_in(y, x):
         return None
     return math.atan2(y, x)
+
+
+@register("sinh")
+def fn_sinh(x):
+    return None if x is None else math.sinh(x)
+
+
+@register("cosh")
+def fn_cosh(x):
+    return None if x is None else math.cosh(x)
+
+
+@register("tanh")
+def fn_tanh(x):
+    return None if x is None else math.tanh(x)
+
+
+@register("coth")
+def fn_coth(x):
+    """(ref: clauses_test.go hyperbolic family; coth(0) is undefined)"""
+    if x is None or x == 0:
+        return None
+    return math.cosh(x) / math.sinh(x)
+
+
+@register("power")
+def fn_power(base, exponent):
+    """Alias of ^ (ref: clauses_test.go RETURN power(2, 10))."""
+    if _null_in(base, exponent):
+        return None
+    return float(base) ** float(exponent)
 
 
 @register("pi")

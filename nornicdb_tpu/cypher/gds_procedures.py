@@ -14,7 +14,12 @@ import numpy as np
 
 from nornicdb_tpu.cypher.executor import CypherExecutor, procedure
 from nornicdb_tpu.cypher.functions import register
-from nornicdb_tpu.errors import CypherSyntaxError, CypherTypeError
+from nornicdb_tpu.errors import (
+    AlreadyExistsError,
+    CypherSyntaxError,
+    CypherTypeError,
+    NotFoundError,
+)
 from nornicdb_tpu.filter.kalman import Kalman, KalmanConfig
 from nornicdb_tpu.linkpredict.topology import (
     SCORERS,
@@ -261,6 +266,21 @@ def fn_kalman_init(config=None):
     return _json.dumps(state)
 
 
+def _kalman_load(state):
+    """Parse a state JSON; malformed input is a clean type error, never a
+    raw JSONDecodeError up through the query (kalman_functions_test.go
+    interpolates real state strings; user queries may not)."""
+    import json as _json
+
+    try:
+        s = _json.loads(state)
+    except (TypeError, ValueError):
+        raise CypherTypeError(f"invalid kalman state: {state!r}")
+    if not isinstance(s, dict):
+        raise CypherTypeError(f"invalid kalman state: {state!r}")
+    return s
+
+
 @register("kalman.process")
 def fn_kalman_process(measurement, state):
     """kalman.process(measurement, stateJson) -> {value, state}
@@ -270,15 +290,15 @@ def fn_kalman_process(measurement, state):
 
     if measurement is None or state is None:
         return None
-    s = _json.loads(state)
+    s = _kalman_load(state)
     z = float(measurement)
     if not s.get("initialized"):
         s["x"] = z
         s["initialized"] = True
     else:
-        p = s["p"] + s["q"]
-        k = p / (p + s["r"])
-        s["x"] = s["x"] + k * (z - s["x"])
+        p = s.get("p", 30.0) + s.get("q", 1e-4)
+        k = p / (p + s.get("r", 88.0))
+        s["x"] = s.get("x", 0.0) + k * (z - s.get("x", 0.0))
         s["p"] = (1 - k) * p
     return {"value": s["x"], "state": _json.dumps(s)}
 
@@ -286,9 +306,113 @@ def fn_kalman_process(measurement, state):
 @register("kalman.state")
 def fn_kalman_state(state):
     """kalman.state(stateJson) -> MAP view of the stored filter state."""
+    return None if state is None else _kalman_load(state)
+
+
+# -- velocity model (2-state: position + velocity; ref: kalman_functions_test
+# kalman.velocity.* family) ---------------------------------------------------
+@register("kalman.velocity.init")
+def fn_kalman_velocity_init(config=None):
     import json as _json
 
-    return None if state is None else _json.loads(state)
+    state = {
+        "model": "velocity", "x": 0.0, "v": 0.0,
+        "p": 30.0, "q": 1e-4, "r": 88.0, "dt": 1.0, "initialized": False,
+    }
+    if isinstance(config, dict):
+        if config.get("processNoise") is not None:
+            state["q"] = float(config["processNoise"]) * 0.001
+        if config.get("measurementNoise") is not None:
+            state["r"] = float(config["measurementNoise"])
+        if config.get("dt") is not None:
+            state["dt"] = float(config["dt"])
+    return _json.dumps(state)
+
+
+@register("kalman.velocity.process")
+def fn_kalman_velocity_process(measurement, state):
+    """-> {value, velocity, state}: position smoothed, velocity estimated
+    from the innovation (reduced-order alpha-beta form of the 2-state
+    filter — same observable behavior, one scalar gain pair)."""
+    import json as _json
+
+    if measurement is None or state is None:
+        return None
+    s = _kalman_load(state)
+    z = float(measurement)
+    dt = s.get("dt", 1.0)
+    if not s.get("initialized"):
+        s["x"], s["v"] = z, 0.0
+        s["initialized"] = True
+    else:
+        pred = s.get("x", 0.0) + s.get("v", 0.0) * dt
+        p = s.get("p", 30.0) + s.get("q", 1e-4)
+        alpha = p / (p + s.get("r", 88.0))
+        beta = alpha * alpha / (2 - alpha)
+        resid = z - pred
+        s["x"] = pred + alpha * resid
+        s["v"] = s.get("v", 0.0) + (beta / dt) * resid
+        s["p"] = (1 - alpha) * p
+    return {"value": s["x"], "velocity": s["v"], "state": _json.dumps(s)}
+
+
+@register("kalman.velocity.predict")
+def fn_kalman_velocity_predict(state, steps=1):
+    """Extrapolate position `steps` intervals ahead: x + v*steps*dt."""
+    if state is None:
+        return None
+    s = _kalman_load(state)
+    return (s.get("x", 0.0)
+            + s.get("v", 0.0) * float(steps) * s.get("dt", 1.0))
+
+
+# -- adaptive model (hysteresis gates noise adaptation; ref:
+# kalman.adaptive.* family) ---------------------------------------------------
+@register("kalman.adaptive.init")
+def fn_kalman_adaptive_init(config=None):
+    import json as _json
+
+    state = {
+        "model": "adaptive", "x": 0.0, "p": 30.0, "q": 1e-4, "r": 88.0,
+        "hysteresis": 2, "breach": 0, "initialized": False,
+    }
+    if isinstance(config, dict):
+        if config.get("hysteresis") is not None:
+            state["hysteresis"] = int(config["hysteresis"])
+        if config.get("processNoise") is not None:
+            state["q"] = float(config["processNoise"]) * 0.001
+        if config.get("measurementNoise") is not None:
+            state["r"] = float(config["measurementNoise"])
+    return _json.dumps(state)
+
+
+@register("kalman.adaptive.process")
+def fn_kalman_adaptive_process(measurement, state):
+    """Standard update; after `hysteresis` consecutive large innovations,
+    the filter re-seeds on the measurement (level-shift tracking)."""
+    import json as _json
+
+    if measurement is None or state is None:
+        return None
+    s = _kalman_load(state)
+    z = float(measurement)
+    if not s.get("initialized"):
+        s["x"], s["initialized"] = z, True
+    else:
+        p = s.get("p", 30.0) + s.get("q", 1e-4)
+        r = s.get("r", 88.0)
+        resid = z - s.get("x", 0.0)
+        if resid * resid > 9 * (p + r):  # > 3 sigma
+            s["breach"] = s.get("breach", 0) + 1
+        else:
+            s["breach"] = 0
+        if s["breach"] >= s.get("hysteresis", 2):
+            s["x"], s["p"], s["breach"] = z, 30.0, 0  # re-seed on shift
+        else:
+            k = p / (p + r)
+            s["x"] = s.get("x", 0.0) + k * resid
+            s["p"] = (1 - k) * p
+    return {"value": s["x"], "state": _json.dumps(s)}
 
 
 @register("kalman.filter")
@@ -308,7 +432,14 @@ fn_kalman_filter.needs_executor = True
 
 
 @register("kalman.predict")
-def fn_kalman_predict(ex, key):
+def fn_kalman_predict(ex, key, steps=1):
+    """Two forms: kalman.predict(stateJson, steps) extrapolates from a
+    serialized state (ref: kalman_functions_test.go:405); kalman.predict(key)
+    reads the named in-memory filter from kalman.filter."""
+    if isinstance(key, str) and key.lstrip()[:1] == "{":
+        s = _kalman_load(key)
+        return (s.get("x", 0.0)
+                + s.get("v", 0.0) * float(steps) * s.get("dt", 1.0))
     k = _kalman_states(ex).get(str(key))
     return None if k is None else k.predict()
 
@@ -551,7 +682,8 @@ def proc_dijkstra(ex: CypherExecutor, args, row):
     if len(args) < 2:
         raise CypherSyntaxError(
             "gds.shortestPath.dijkstra.stream(source, target, config)")
-    src_n, dst_n = args[0], args[1]
+    src_n = _resolve_node(ex, args[0])
+    dst_n = _resolve_node(ex, args[1])
     cfg = args[2] if len(args) > 2 and isinstance(args[2], dict) else {}
     ids, index, _, _ = _edge_arrays(ex)
     s, t = index.get(src_n.id), index.get(dst_n.id)
@@ -578,7 +710,8 @@ def proc_astar(ex: CypherExecutor, args, row):
     if len(args) < 2:
         raise CypherSyntaxError(
             "gds.shortestPath.astar.stream(source, target, config)")
-    src_n, dst_n = args[0], args[1]
+    src_n = _resolve_node(ex, args[0])
+    dst_n = _resolve_node(ex, args[1])
     cfg = args[2] if len(args) > 2 and isinstance(args[2], dict) else {}
     lat_p = cfg.get("latitudeProperty", "latitude")
     lon_p = cfg.get("longitudeProperty", "longitude")
@@ -616,6 +749,240 @@ procedure("apoc.algo.pagerank")(proc_pagerank)
 procedure("apoc.algo.betweenness")(proc_betweenness)
 procedure("apoc.algo.closeness")(proc_closeness)
 procedure("apoc.algo.community")(proc_louvain)
+
+
+def _apoc_community_shape(ex, args, rows_fn):
+    """apoc.algo.{louvain,labelPropagation}([labels]) YIELD node, community
+    — the apoc flavor filters by label list and names the column
+    `community` (apoc_community_test.go), unlike gds.* (communityId)."""
+    labels = None
+    if args and isinstance(args[0], list):
+        labels = {str(l) for l in args[0]}
+    cols, rows = rows_fn()
+    out = []
+    for node, community in rows:
+        if labels and not (set(node.labels) & labels):
+            continue
+        out.append([node, community])
+    return ["node", "community"], out
+
+
+@procedure("apoc.algo.louvain")
+def proc_apoc_louvain(ex: CypherExecutor, args, row):
+    return _apoc_community_shape(
+        ex, args, lambda: proc_louvain(ex, [], row))
+
+
+@procedure("apoc.algo.labelpropagation")
+def proc_apoc_label_prop(ex: CypherExecutor, args, row):
+    return _apoc_community_shape(
+        ex, args, lambda: proc_label_prop(ex, [], row))
+
+
+@procedure("apoc.neighbors.byhop")
+def proc_neighbors_byhop(ex: CypherExecutor, args, row):
+    """apoc.neighbors.byhop(start, relType, hops) YIELD nodes, depth —
+    one row per hop level with the nodes first reached at that depth."""
+    if not args:
+        raise CypherSyntaxError("expected (node, relType, hops)")
+    src = _resolve_node(ex, args[0])
+    rel_type = str(args[1]) if len(args) > 1 and args[1] is not None else None
+    hops = int(args[2]) if len(args) > 2 and args[2] is not None else 1
+    ids, index, _, _ = _edge_arrays(ex)
+    s = index.get(src.id)
+    if s is None:
+        return ["nodes", "depth"], []
+    adj = _filtered_weighted_adj(ex, index, rel_type, None)
+    frontier, seen = {s}, {s}
+    out = []
+    for depth in range(1, hops + 1):
+        frontier = {
+            nxt for cur in frontier for nxt, _w in adj.get(cur, [])
+        } - seen
+        if not frontier:
+            break
+        seen |= frontier
+        level = [n for i in sorted(frontier)
+                 if (n := ex.get_node_or_none(ids[i])) is not None]
+        out.append([level, depth])
+    return ["nodes", "depth"], out
 procedure("apoc.algo.wcc")(proc_wcc)
-procedure("apoc.algo.dijkstra")(proc_dijkstra)
-procedure("apoc.algo.astar")(proc_astar)
+
+
+def _resolve_node(ex: CypherExecutor, v):
+    """Procedures accept Node objects OR id strings (the reference's
+    apoc.algo tests call with ids: apoc_algorithms_test.go:75). A string
+    that is not a storage id falls back to the `id` PROPERTY — the
+    reference's engine-level fixtures set Node.ID directly, while Cypher
+    CREATE here assigns storage ids and keeps {id: ...} as a property."""
+    if isinstance(v, Node):
+        return v
+    n = ex.get_node_or_none(str(v))
+    if n is None:
+        n = next(
+            (c for c in ex.storage.all_nodes()
+             if c.properties.get("id") == v),
+            None,
+        )
+    if n is None:
+        raise CypherTypeError(f"start node not found: {v!r}")
+    return n
+
+
+def _apoc_algo_args(ex, args):
+    """(start, end, relTypesAndDirs, weightProperty) — the apoc.algo
+    calling convention (apoc_algorithms_test.go)."""
+    if len(args) < 2:
+        raise CypherSyntaxError("expected (startNode, endNode, relType, weightProp)")
+    src = _resolve_node(ex, args[0])
+    dst = _resolve_node(ex, args[1])
+    rel_type = str(args[2]) if len(args) > 2 and args[2] is not None else None
+    weight = str(args[3]) if len(args) > 3 and args[3] is not None else None
+    return src, dst, rel_type, weight
+
+
+def _filtered_weighted_adj(ex, index, rel_type, weight_prop):
+    """Adjacency restricted to a relationship-type spec, undirected (the
+    apoc path algorithms traverse both directions like the reference's).
+    The spec uses apoc syntax: 'KNOWS', 'KNOWS>', '<KNOWS', 'A|B'."""
+    types = None
+    if rel_type:
+        types = {t.strip("<>") for t in str(rel_type).split("|")
+                 if t.strip("<>")}
+    adj: dict[int, list[tuple[int, float]]] = {}
+    for e in ex.storage.all_edges():
+        if types and e.type not in types:
+            continue
+        s, t = index.get(e.start_node), index.get(e.end_node)
+        if s is None or t is None:
+            continue
+        w = 1.0
+        if weight_prop:
+            try:
+                w = float(e.properties.get(weight_prop, 1.0))
+            except (TypeError, ValueError):
+                w = 1.0
+        adj.setdefault(s, []).append((t, w))
+        adj.setdefault(t, []).append((s, w))
+    return adj
+
+
+def _ids_to_path(ex, ids, path_idx, rel_type, weight_prop):
+    nodes = [ex.get_node_or_none(ids[i]) for i in path_idx]
+    rels = _path_edges(ex, ids, path_idx, weight_prop)
+    return {"__path__": True, "nodes": nodes, "relationships": rels}
+
+
+@procedure("apoc.algo.dijkstra")
+def proc_apoc_dijkstra(ex: CypherExecutor, args, row):
+    """apoc.algo.dijkstra(start, end, relType, weightProp) YIELD path,
+    weight (ref: apoc_algorithms_test.go:75)."""
+    src, dst, rel_type, weight_prop = _apoc_algo_args(ex, args)
+    ids, index, _, _ = _edge_arrays(ex)
+    s, t = index.get(src.id), index.get(dst.id)
+    if s is None or t is None:
+        return ["path", "weight"], []
+    adj = _filtered_weighted_adj(ex, index, rel_type, weight_prop)
+    dist, prev = _ga.dijkstra(adj, s, goal=t)
+    if t not in dist:
+        return ["path", "weight"], []
+    path_idx = _ga.reconstruct_path(prev, s, t)
+    return (["path", "weight"],
+            [[_ids_to_path(ex, ids, path_idx, rel_type, weight_prop),
+              dist[t]]])
+
+
+@procedure("apoc.algo.astar")
+def proc_apoc_astar(ex: CypherExecutor, args, row):
+    """apoc.algo.aStar — same yield shape as dijkstra (the zero heuristic
+    is admissible without coordinates)."""
+    return proc_apoc_dijkstra(ex, args, row)
+
+
+@procedure("apoc.algo.allsimplepaths")
+def proc_all_simple_paths(ex: CypherExecutor, args, row):
+    """apoc.algo.allSimplePaths(start, end, relType, maxHops) YIELD path."""
+    src, dst, rel_type, _ = _apoc_algo_args(ex, args)
+    max_hops = int(args[3]) if len(args) > 3 and args[3] is not None else 10
+    ids, index, _, _ = _edge_arrays(ex)
+    s, t = index.get(src.id), index.get(dst.id)
+    if s is None or t is None:
+        return ["path"], []
+    adj = _filtered_weighted_adj(ex, index, rel_type, None)
+    out = []
+
+    def dfs(cur, path):
+        if len(path) > max_hops + 1:
+            return
+        if cur == t:
+            out.append([_ids_to_path(ex, ids, path, rel_type, None)])
+            return
+        for nxt, _w in adj.get(cur, []):
+            if nxt not in path:
+                dfs(nxt, path + [nxt])
+
+    dfs(s, [s])
+    return ["path"], out
+
+
+# -- gds.graph.* catalog (ref: fastrp_test.go:186-244) ------------------------
+def _graph_catalog(ex: CypherExecutor) -> dict:
+    cat = getattr(ex, "_gds_graph_catalog", None)
+    if cat is None:
+        cat = ex._gds_graph_catalog = {}
+    return cat
+
+
+@procedure("gds.graph.project")
+def proc_graph_project(ex: CypherExecutor, args, row):
+    """gds.graph.project(name, nodeLabel, relType) YIELD graphName,
+    nodeCount, relationshipCount. '*' projects everything."""
+    if len(args) < 1:
+        raise CypherSyntaxError("gds.graph.project(name, nodeLabel, relType)")
+    name = str(args[0])
+    label = str(args[1]) if len(args) > 1 and args[1] is not None else "*"
+    rel_type = str(args[2]) if len(args) > 2 and args[2] is not None else "*"
+    cat = _graph_catalog(ex)
+    if name in cat:
+        raise AlreadyExistsError(f"graph {name} already exists")
+    if label == "*":
+        n_count = ex.storage.node_count()
+    else:
+        n_count = sum(1 for _ in ex.storage.get_nodes_by_label(label))
+    if rel_type == "*":
+        r_count = ex.storage.edge_count()
+    else:
+        r_count = sum(1 for e in ex.storage.all_edges() if e.type == rel_type)
+    cat[name] = {"label": label, "relType": rel_type,
+                 "nodeCount": n_count, "relationshipCount": r_count}
+    return (["graphName", "nodeCount", "relationshipCount"],
+            [[name, n_count, r_count]])
+
+
+@procedure("gds.graph.drop")
+def proc_graph_drop(ex: CypherExecutor, args, row):
+    name = str(args[0]) if args else ""
+    cat = _graph_catalog(ex)
+    if name not in cat:
+        raise NotFoundError(f"graph {name} not found")
+    del cat[name]
+    return ["graphName"], [[name]]
+
+
+@procedure("gds.graph.list")
+def proc_graph_list(ex: CypherExecutor, args, row):
+    cat = _graph_catalog(ex)
+    if args:  # gds.graph.list(name)
+        name = str(args[0])
+        items = [(name, cat[name])] if name in cat else []
+    else:
+        items = sorted(cat.items())
+    return (["graphName", "nodeCount", "relationshipCount"],
+            [[n, g["nodeCount"], g["relationshipCount"]] for n, g in items])
+
+
+@procedure("gds.graph.exists")
+def proc_graph_exists(ex: CypherExecutor, args, row):
+    name = str(args[0]) if args else ""
+    return (["graphName", "exists"],
+            [[name, name in _graph_catalog(ex)]])

@@ -127,9 +127,32 @@ class Parser:
 
     def parse_alter(self) -> ast.DatabaseCommand:
         """ALTER COMPOSITE DATABASE name ADD|DROP ALIAS a [FOR DATABASE t]
-        (ref: composite management, pkg/multidb/composite.go + the
-        reference's system-command tests)."""
+        and ALTER DATABASE name SET LIMIT k = v[, k = v] (ref: composite
+        management pkg/multidb/composite.go; limits DDL
+        system_commands_test.go:423-486)."""
         self.expect_kw("ALTER")
+        if self.at_kw("DATABASE"):
+            self.advance()
+            name = self.expect_ident()
+            self.expect_kw("SET")
+            self.expect_ident_value("limit")
+            limits: dict[str, float] = {}
+            while True:
+                key = self.expect_ident()
+                self.expect_op("=")
+                tok = self.cur
+                if tok.kind != "NUMBER":
+                    raise self.error("limit value must be a number")
+                self.advance()
+                value = float(tok.value)
+                # duration suffix: 60s / 5m lexes as NUMBER then IDENT
+                if self.cur.kind == "IDENT" and self.cur.value in ("s", "m", "h"):
+                    value *= {"s": 1, "m": 60, "h": 3600}[self.advance().value]
+                limits[key] = value
+                if not self.accept_op(","):
+                    break
+            return ast.DatabaseCommand("set_limits", name,
+                                       options={"limits": limits})
         self.expect_kw("COMPOSITE")
         self.expect_kw("DATABASE")
         name = self.expect_ident()
@@ -183,9 +206,18 @@ class Parser:
             return ast.ShowCommand("functions")
         if self.at_kw("ALIAS", "ALIASES"):
             self.advance()
-            self.accept_kw("FOR")
-            self.accept_kw("DATABASE", "DATABASES")
-            return ast.ShowCommand("aliases")
+            target = None
+            if self.accept_kw("FOR"):
+                self.accept_kw("DATABASE", "DATABASES")
+                if self.cur.kind == "IDENT":
+                    # SHOW ALIASES FOR DATABASE tenant_a: scope to one target
+                    target = self.advance().value
+            return ast.ShowCommand("aliases", target=target)
+        if self.accept_ident_value("limits"):
+            # SHOW LIMITS FOR DATABASE name (system_commands_test.go:509)
+            self.expect_kw("FOR")
+            self.expect_kw("DATABASE")
+            return ast.ShowCommand("limits", target=self.expect_ident())
         raise self.error("unsupported SHOW target")
 
     def parse_ddl_create(self) -> ast.Statement:
@@ -282,15 +314,23 @@ class Parser:
             name = self.advance().value
         if self.accept_kw("IF"):
             self.expect_kw("NOT")
-            self.expect_ident_value("exists")
+            self.expect_kw("EXISTS")
             if_not = True
-        self.expect_kw("FOR")
+        # legacy Neo4j 3.x/4.x form (ref: mimir_queries_test.go,
+        # chaos_injection_test.go): CREATE CONSTRAINT [IF NOT EXISTS]
+        # ON (n:Label) ASSERT n.prop IS UNIQUE
+        legacy = self.accept_kw("ON")
+        if not legacy:
+            self.expect_kw("FOR")
         self.expect_op("(")
         self.expect_ident()
         self.expect_op(":")
         label = self.expect_ident()
         self.expect_op(")")
-        self.expect_kw("REQUIRE")
+        if legacy:
+            self.expect_ident_value("assert")
+        else:
+            self.expect_kw("REQUIRE")
         props = []
         if self.accept_op("("):
             while True:
@@ -323,10 +363,13 @@ class Parser:
         if self.at_kw("ALIAS"):
             self.advance()
             name = self.expect_ident()
-            self.accept_kw("IF")
+            if_e = False
+            if self.accept_kw("IF"):
+                self.expect_kw("EXISTS")
+                if_e = True
             self.accept_kw("FOR")
             self.accept_kw("DATABASE")
-            return ast.DatabaseCommand("drop_alias", name)
+            return ast.DatabaseCommand("drop_alias", name, if_exists=if_e)
         if self.at_kw("INDEX"):
             self.advance()
             name = self.expect_ident()
@@ -414,6 +457,30 @@ class Parser:
         if not consumed:
             self.expect_kw("MATCH")
         patterns = self.parse_patterns()
+        # planner hints (ref: index_hints_test.go): parsed for compatibility,
+        # then discarded — this executor picks columnar/index paths itself
+        while self.accept_ident_value("using"):
+            if self.accept_kw("INDEX"):
+                self.accept_ident_value("seek")
+                self.expect_ident()
+                self.expect_op(":")
+                self.expect_ident()
+                self.expect_op("(")
+                self.expect_ident()
+                while self.accept_op(","):
+                    self.expect_ident()
+                self.expect_op(")")
+            elif self.accept_ident_value("scan"):
+                self.expect_ident()
+                self.expect_op(":")
+                self.expect_ident()
+            elif self.accept_ident_value("join"):
+                self.expect_kw("ON")
+                self.expect_ident()
+                while self.accept_op(","):
+                    self.expect_ident()
+            else:
+                raise self.error("expected INDEX, SCAN or JOIN after USING")
         where = None
         if self.accept_kw("WHERE"):
             where = self.parse_expr()

@@ -230,12 +230,58 @@ def fn_duration_between(a, b):
     )
 
 
+def _as_duration_ms(v):
+    if isinstance(v, dict) and v.get("__temporal__") == "duration":
+        return v["milliseconds"]
+    return None
+
+
 @register("duration.indays")
-def fn_duration_in_days(a, b):
+def fn_duration_in_days(a, b=None):
+    """Two forms (ref: duration_functions_test.go:207): with one argument,
+    total days of a duration as a float; with two, the duration between
+    two temporals expressed in whole days."""
+    if a is None:
+        return None
+    if b is None:
+        ms = _as_duration_ms(a)
+        if ms is None:
+            raise CypherTypeError("duration.inDays expects a duration")
+        return ms / 86400000.0
     d = fn_duration_between(a, b)
     if d is None:
         return None
     return fn_duration({"days": int(d["milliseconds"] / 86400000)})
+
+
+@register("duration.inseconds")
+def fn_duration_in_seconds(a, b=None):
+    """(ref: duration_functions_test.go RETURN duration.inSeconds(...))"""
+    if a is None:
+        return None
+    if b is None:
+        ms = _as_duration_ms(a)
+        if ms is None:
+            raise CypherTypeError("duration.inSeconds expects a duration")
+        return ms / 1000.0
+    d = fn_duration_between(a, b)
+    return None if d is None else d["milliseconds"] / 1000.0
+
+
+@register("date.year")
+def fn_date_year(value):
+    """(ref: temporal_functions_test.go:184 — string date accessors)"""
+    return None if value is None else _parse_input(value).year
+
+
+@register("date.month")
+def fn_date_month(value):
+    return None if value is None else _parse_input(value).month
+
+
+@register("date.day")
+def fn_date_day(value):
+    return None if value is None else _parse_input(value).day
 
 
 @register("date.truncate")

@@ -10,7 +10,9 @@ the system DB (metadata.go).
 
 from __future__ import annotations
 
+import contextlib
 import threading
+import time
 from dataclasses import dataclass, field
 from typing import Iterator, Optional
 
@@ -26,10 +28,39 @@ _ALIAS_LABEL = "_Alias"
 
 @dataclass
 class DatabaseLimits:
-    """(ref: limits.go)"""
+    """(ref: limits.go — StorageLimits + QueryLimits + RateLimits)"""
 
     max_nodes: int = 0  # 0 = unlimited
     max_edges: int = 0
+    # query wall-clock budget in seconds (ref: QueryLimits.MaxQueryTime);
+    # enforced at clause boundaries by the executor
+    max_query_time: float = 0.0
+    # token-bucket rates (ref: RateLimits.MaxQueriesPerSecond / MaxWrites...)
+    max_queries_per_second: int = 0
+    max_writes_per_second: int = 0
+
+    FIELD_NAMES = ("max_nodes", "max_edges", "max_query_time",
+                   "max_queries_per_second", "max_writes_per_second")
+
+
+class _Bucket:
+    """Minimal token bucket for per-database rate limits."""
+
+    def __init__(self, rate: float):
+        self.rate = rate
+        self.tokens = float(rate)
+        self.ts = time.time()
+        self.lock = threading.Lock()
+
+    def take(self) -> bool:
+        with self.lock:
+            now = time.time()
+            self.tokens = min(self.rate, self.tokens + (now - self.ts) * self.rate)
+            self.ts = now
+            if self.tokens < 1.0:
+                return False
+            self.tokens -= 1.0
+            return True
 
 
 class LimitedEngine(NamespacedEngine):
@@ -39,20 +70,71 @@ class LimitedEngine(NamespacedEngine):
     def __init__(self, base: Engine, namespace: str, limits: DatabaseLimits):
         super().__init__(base, namespace)
         self.limits = limits
+        self._write_bucket = (
+            _Bucket(limits.max_writes_per_second)
+            if limits.max_writes_per_second else None
+        )
+        # consumed by the executor at query entry (it owns query boundaries)
+        self.query_bucket = (
+            _Bucket(limits.max_queries_per_second)
+            if limits.max_queries_per_second else None
+        )
+
+    _exempt = threading.local()
+
+    @contextlib.contextmanager
+    def exempt_writes(self):
+        """Suspend the write rate limit on this thread — rollback/undo
+        writes must never be throttled, or a failed statement could be
+        left half-unwound (exactly the corruption the undo frame exists
+        to prevent)."""
+        prev = getattr(self._exempt, "on", False)
+        self._exempt.on = True
+        try:
+            yield
+        finally:
+            self._exempt.on = prev
+
+    def _check_write_rate(self) -> None:
+        if getattr(self._exempt, "on", False):
+            return
+        if self._write_bucket is not None and not self._write_bucket.take():
+            raise NornicError(
+                f"database {self.namespace} write rate limit exceeded "
+                f"({self.limits.max_writes_per_second}/s)"
+            )
 
     def create_node(self, node: Node) -> Node:
+        self._check_write_rate()
         if self.limits.max_nodes and self.node_count() >= self.limits.max_nodes:
             raise NornicError(
                 f"database {self.namespace} node limit reached ({self.limits.max_nodes})"
             )
         return super().create_node(node)
 
+    def update_node(self, node: Node) -> Node:
+        self._check_write_rate()
+        return super().update_node(node)
+
+    def delete_node(self, node_id: str) -> None:
+        self._check_write_rate()
+        super().delete_node(node_id)
+
     def create_edge(self, edge: Edge) -> Edge:
+        self._check_write_rate()
         if self.limits.max_edges and self.edge_count() >= self.limits.max_edges:
             raise NornicError(
                 f"database {self.namespace} edge limit reached ({self.limits.max_edges})"
             )
         return super().create_edge(edge)
+
+    def update_edge(self, edge: Edge) -> Edge:
+        self._check_write_rate()
+        return super().update_edge(edge)
+
+    def delete_edge(self, edge_id: str) -> None:
+        self._check_write_rate()
+        super().delete_edge(edge_id)
 
 
 class CompositeEngine(Engine):
@@ -348,12 +430,19 @@ class DatabaseManager:
             return eng
 
     def set_limits(self, name: str, limits: DatabaseLimits) -> None:
+        """(ref: ALTER DATABASE ... SET LIMIT, system_commands_test.go:423)"""
         with self._lock:
             name = self.resolve(name)
+            if name not in self._databases:
+                raise NotFoundError(f"database {name} not found")
             self._limits[name] = limits
             self._engines.pop(name, None)
         if self.on_invalidate is not None:
             self.on_invalidate(name)
+
+    def get_limits(self, name: str) -> DatabaseLimits:
+        with self._lock:
+            return self._limits.get(self.resolve(name), DatabaseLimits())
 
     def storage_stats(self) -> dict[str, dict[str, int]]:
         """(ref: storage-size accounting manager.go)"""

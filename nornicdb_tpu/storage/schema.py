@@ -78,6 +78,7 @@ class SchemaManager:
             self._indexes[name] = idx
             if kind in (INDEX_PROPERTY, INDEX_COMPOSITE, INDEX_RANGE):
                 self._prop_maps.setdefault((label, tuple(properties)), {})
+                self._backfill(label, tuple(properties))
             return idx
 
     def drop_index(self, name: str, if_exists: bool = False) -> None:
@@ -129,7 +130,24 @@ class SchemaManager:
                 raise AlreadyExistsError(f"constraint {name} already exists")
             c = ConstraintDef(name, label, list(properties), kind)
             self._constraints[name] = c
-            self._prop_maps.setdefault((label, tuple(properties)), {})
+            key = (label, tuple(properties))
+            self._prop_maps.setdefault(key, {})
+            self._backfill(label, key[1])
+            if kind == "unique":
+                # Neo4j refuses to create a unique constraint over data
+                # that already violates it
+                dup = next(
+                    (vals for vals, ids in self._prop_maps[key].items()
+                     if len(ids) > 1),
+                    None,
+                )
+                if dup is not None:
+                    del self._constraints[name]
+                    raise ConstraintViolationError(
+                        f"cannot create unique constraint {name}: existing "
+                        f"duplicate value {dup!r} on {label}"
+                        f"({', '.join(properties)})"
+                    )
             return c
 
     def drop_constraint(self, name: str, if_exists: bool = False) -> None:
@@ -198,6 +216,7 @@ class SchemaManager:
 
     def attach(self, engine: Engine) -> None:
         """Subscribe to engine events so index maps stay current."""
+        self._engine = engine
 
         def _on(kind: str, entity) -> None:
             if not isinstance(entity, Node):
@@ -210,3 +229,25 @@ class SchemaManager:
         engine.on_event(_on)
         for n in engine.all_nodes():
             self.index_node(n)
+
+    def _backfill(self, label: str, properties: tuple) -> None:
+        """Populate a NEW prop map from data that already exists — an index
+        or constraint created after writes must see earlier nodes (Neo4j
+        indexes existing data at creation time)."""
+        engine = getattr(self, "_engine", None)
+        if engine is None:
+            return
+        valmap = self._prop_maps.get((label, properties))
+        if valmap is None or valmap:
+            return  # nothing registered, or map already live (shared key)
+        try:
+            nodes = engine.get_nodes_by_label(label)
+        except Exception:
+            return
+        for n in nodes:
+            vals = tuple(_freeze(n.properties.get(p)) for p in properties)
+            if any(v is None for v in vals):
+                continue
+            valmap.setdefault(vals, set()).add(n.id)
+            self._node_entries.setdefault(n.id, set()).add(
+                ((label, properties), vals))
