@@ -44,22 +44,33 @@ def measure(cfg: BgeConfig, params, B: int, T: int, iters: int = 4, reps: int = 
     return toks, toks / T  # tok/s, emb/s at this doc length
 
 
-def main():
-    cfg = BgeConfig()
+def sweep(name: str, cfg: BgeConfig, grid):
     params = init_params(cfg, jax.random.PRNGKey(0))
     params = jax.tree.map(lambda x: x.astype(jnp.bfloat16), params)
-    print(f"device={jax.devices()[0]}", flush=True)
+    print(f"\n## {name} ({cfg.layers}L/{cfg.hidden}h)", flush=True)
     print("| B | T | tok/s | emb/s |", flush=True)
     print("|---|---|---|---|", flush=True)
-    for T in (64, 128, 256):
-        for B in (32, 64, 128):
-            if B * T > 32 * 512 * 2:  # keep activation memory bounded
-                continue
-            try:
-                toks, embs = measure(cfg, params, B, T)
-                print(f"| {B} | {T} | {toks/1e3:.1f}k | {embs:.0f} |", flush=True)
-            except Exception as e:  # OOM etc. — record and continue
-                print(f"| {B} | {T} | ERR {type(e).__name__} | - |", flush=True)
+    for B, T in grid:
+        try:
+            toks, embs = measure(cfg, params, B, T)
+            print(f"| {B} | {T} | {toks/1e3:.1f}k | {embs:.0f} |", flush=True)
+        except Exception as e:  # OOM etc. — record and continue
+            print(f"| {B} | {T} | ERR {type(e).__name__} | - |", flush=True)
+
+
+def main():
+    from nornicdb_tpu.models.bge_m3 import BGE_DISTILL_6L, BGE_DISTILL_12L_512
+
+    print(f"device={jax.devices()[0]}", flush=True)
+    # teacher short-seq grid (the rows deferred in PROGRESS.md)
+    sweep("bge-m3 teacher", BgeConfig(),
+          [(B, T) for T in (64, 128, 256) for B in (32, 64, 128)
+           if B * T <= 32 * 512 * 2])
+    # distilled serving shapes (VERDICT item 6): measure the emb/s the
+    # small-encoder path buys at the 512-token north-star length
+    for name, cfg in (("distill-6L", BGE_DISTILL_6L),
+                      ("distill-12L-512h", BGE_DISTILL_12L_512)):
+        sweep(name, cfg, [(32, 512), (64, 512), (128, 128), (64, 128)])
 
 
 if __name__ == "__main__":

@@ -354,6 +354,18 @@ def main(argv=None) -> int:
                    help="train steps (default: per-model preset)")
     s.set_defaults(fn=cmd_train)
 
+    s = sub.add_parser(
+        "oauth-provider",
+        help="run the standalone OAuth 2.0 test provider "
+             "(ref: cmd/oauth-provider — local OAuth integration testing)",
+    )
+    s.add_argument("--port", type=int, default=8888)
+    s.add_argument("--client-id", default="nornicdb-local-test")
+    s.add_argument("--client-secret", default="local-test-secret-123")
+    s.set_defaults(fn=lambda a: __import__(
+        "nornicdb_tpu.server.oauth_provider", fromlist=["main"]
+    ).main(a.port, a.client_id, a.client_secret))
+
     args = p.parse_args(argv)
     return args.fn(args)
 

@@ -173,12 +173,14 @@ class CypherExecutor:
             validate(stmt)
         if isinstance(stmt, ast.Query):
             # per-database query rate limit (ref: enforcement.go
-            # MaxQueriesPerSecond); the bucket lives on the LimitedEngine
-            bucket = getattr(self.storage, "query_bucket", None)
+            # MaxQueriesPerSecond); the bucket lives on the LimitedEngine —
+            # except for the DEFAULT database, whose executor runs on the
+            # main facade chain, so its state comes from the manager
+            limits, bucket = self._query_limits()
             if bucket is not None and not bucket.take():
                 raise NornicError(
                     "database query rate limit exceeded "
-                    f"({self.storage.limits.max_queries_per_second}/s)"
+                    f"({limits.max_queries_per_second}/s)"
                 )
         if self.cache is not None and isinstance(stmt, ast.Query):
             write = _is_write_query(stmt)
@@ -637,15 +639,16 @@ class CypherExecutor:
         produced = False
         # per-database query budget (ref: enforcement.go MaxQueryTime):
         # checked at clause boundaries — coarse, but enough to stop
-        # multi-clause runaways without per-row overhead
-        limits = getattr(self.storage, "limits", None)
+        # multi-clause runaways without per-row overhead. Monotonic clock:
+        # wall-time steps must not expire (or extend) the budget.
+        limits, _ = self._query_limits()
         deadline = (
-            time.time() + limits.max_query_time
+            time.monotonic() + limits.max_query_time
             if limits is not None and getattr(limits, "max_query_time", 0)
             else None
         )
         for clause in q.clauses:
-            if deadline is not None and time.time() > deadline:
+            if deadline is not None and time.monotonic() > deadline:
                 raise NornicError(
                     f"query exceeded max_query_time "
                     f"({limits.max_query_time}s)"
@@ -1701,6 +1704,22 @@ class CypherExecutor:
                 self._tx_undo = None
                 self._tx_implicit = False
 
+    def _query_limits(self):
+        """(limits, query_bucket) for this executor's database. LimitedEngine
+        carries both; the default database's executor (main facade chain)
+        consults the manager instead."""
+        limits = getattr(self.storage, "limits", None)
+        if limits is not None:
+            return limits, getattr(self.storage, "query_bucket", None)
+        db = self.db
+        # lazily-created manager: only consult it if DDL ever instantiated
+        # it, and only for executors on the default facade chain (per-DB
+        # executors carry a LimitedEngine and returned above)
+        if db is not None and getattr(db, "_dbmanager", None) is not None \
+                and self.storage is getattr(db, "storage", None):
+            return db._dbmanager.query_limit_state(db.default_database)
+        return None, None
+
     def _apply_undos(self, undos: list) -> None:
         """Apply undo closures in reverse, with per-database rate limits
         suspended: a rollback must never itself be throttled, or the
@@ -1827,6 +1846,19 @@ class CypherExecutor:
                     raise CypherSyntaxError(
                         f"unknown limit {key!r} (valid: "
                         f"{', '.join(DatabaseLimits.FIELD_NAMES)})"
+                    )
+            # the default database is served by the main facade chain, not
+            # a LimitedEngine: write-side limits cannot be enforced there —
+            # refuse rather than confirm-and-ignore (query-side limits ARE
+            # enforced via the manager's query_limit_state)
+            if mgr.resolve(stmt.name) == mgr.default_database:
+                inert = {"max_nodes", "max_edges",
+                         "max_writes_per_second"} & set(updates)
+                if inert:
+                    raise CypherSyntaxError(
+                        f"limits {sorted(inert)} are not enforceable on the "
+                        "default database; create a dedicated database for "
+                        "write-side quotas"
                     )
             merged = {f: getattr(current, f) for f in DatabaseLimits.FIELD_NAMES}
             merged.update({

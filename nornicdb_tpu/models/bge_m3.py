@@ -48,6 +48,14 @@ class BgeConfig:
 
 
 BGE_M3 = BgeConfig()
+# Serving-scale distillation target (VERDICT item 6: the >=10k emb/s/chip
+# north star needs a smaller encoder). 6L/1024h keeps the teacher's hidden
+# and output dims so a distilled checkpoint is a drop-in for serving;
+# analytic compute is 24/6 = 4x less than the teacher per token.
+BGE_DISTILL_6L = BgeConfig(layers=6)
+# deeper shrink: 12L at half width = ~8x less compute, dims preserved
+BGE_DISTILL_12L_512 = BgeConfig(layers=12, hidden=512, heads=8,
+                                intermediate=2048)
 BGE_SMALL = BgeConfig(
     vocab_size=1024, hidden=128, layers=2, heads=4, intermediate=256,
     max_positions=512, dims=128,

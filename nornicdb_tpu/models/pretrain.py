@@ -380,7 +380,11 @@ def distill_encoder(
     max_len = t_cfg.max_positions - 8
     texts = corpus if corpus is not None else synth_corpus(seed, repeats=10)
     texts = sorted(set(texts))
+    # genuinely held out: the agreement metric must measure generalization,
+    # so these docs are EXCLUDED from the training pool
     held_out = texts[:: max(len(texts) // 32, 1)][:32]
+    held_set = set(held_out)
+    texts = [t for t in texts if t not in held_set] or held_out
 
     def encode_side(docs):
         ids, masks = tok.encode_batch(docs, max_len=max_len)

@@ -44,17 +44,18 @@ class DatabaseLimits:
 
 
 class _Bucket:
-    """Minimal token bucket for per-database rate limits."""
+    """Minimal token bucket for per-database rate limits. Monotonic clock:
+    a wall-clock step (NTP) must not drain tokens or mint free ones."""
 
     def __init__(self, rate: float):
         self.rate = rate
         self.tokens = float(rate)
-        self.ts = time.time()
+        self.ts = time.monotonic()
         self.lock = threading.Lock()
 
     def take(self) -> bool:
         with self.lock:
-            now = time.time()
+            now = time.monotonic()
             self.tokens = min(self.rate, self.tokens + (now - self.ts) * self.rate)
             self.ts = now
             if self.tokens < 1.0:
@@ -238,6 +239,7 @@ class DatabaseManager:
         self.on_invalidate = on_invalidate
         self._lock = threading.RLock()
         self._limits: dict[str, DatabaseLimits] = {}
+        self._query_buckets: dict[str, _Bucket] = {}
         self._composites: dict[str, list[str]] = {}
         self._engines: dict[str, Engine] = {}
         self._system = NamespacedEngine(base, SYSTEM_DB)
@@ -437,12 +439,29 @@ class DatabaseManager:
                 raise NotFoundError(f"database {name} not found")
             self._limits[name] = limits
             self._engines.pop(name, None)
+            self._query_buckets.pop(name, None)
         if self.on_invalidate is not None:
             self.on_invalidate(name)
 
     def get_limits(self, name: str) -> DatabaseLimits:
         with self._lock:
             return self._limits.get(self.resolve(name), DatabaseLimits())
+
+    def query_limit_state(self, name: str):
+        """(limits, query_bucket) for databases that are NOT served through
+        a LimitedEngine — the default database's executor runs on the main
+        facade chain, so the executor consults this instead. The bucket is
+        cached per database and dies on set_limits."""
+        with self._lock:
+            name = self.resolve(name)
+            limits = self._limits.get(name)
+            if limits is None:
+                return None, None
+            bucket = self._query_buckets.get(name)
+            if bucket is None and limits.max_queries_per_second:
+                bucket = _Bucket(limits.max_queries_per_second)
+                self._query_buckets[name] = bucket
+            return limits, bucket
 
     def storage_stats(self) -> dict[str, dict[str, int]]:
         """(ref: storage-size accounting manager.go)"""

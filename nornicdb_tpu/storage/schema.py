@@ -131,6 +131,7 @@ class SchemaManager:
             c = ConstraintDef(name, label, list(properties), kind)
             self._constraints[name] = c
             key = (label, tuple(properties))
+            created_map = key not in self._prop_maps
             self._prop_maps.setdefault(key, {})
             self._backfill(label, key[1])
             if kind == "unique":
@@ -143,6 +144,18 @@ class SchemaManager:
                 )
                 if dup is not None:
                     del self._constraints[name]
+                    if created_map and not any(
+                        (i.label, tuple(i.properties)) == key
+                        for i in self._indexes.values()
+                    ):
+                        # drop the map we just created, or index_node would
+                        # maintain it forever for a constraint that doesn't
+                        # exist (every entry also leaves _node_entries)
+                        for vals, ids in self._prop_maps[key].items():
+                            for nid in ids:
+                                self._node_entries.get(nid, set()).discard(
+                                    (key, vals))
+                        del self._prop_maps[key]
                     raise ConstraintViolationError(
                         f"cannot create unique constraint {name}: existing "
                         f"duplicate value {dup!r} on {label}"

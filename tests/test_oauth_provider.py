@@ -1,0 +1,171 @@
+"""Standalone OAuth test provider (ref: cmd/oauth-provider, 650 LoC).
+
+Covers the full RFC 6749 authorization-code flow end-to-end: discovery,
+consent form, code issuance, token exchange (client_secret_post AND
+client_secret_basic), userinfo, and the negative paths (wrong client,
+replayed code, expired/invalid tokens, redirect_uri mismatch).
+"""
+
+import json
+import urllib.error
+import urllib.parse
+import urllib.request
+
+import pytest
+
+from nornicdb_tpu.server.oauth_provider import DEFAULT_USERS, OAuthTestProvider
+
+
+@pytest.fixture(scope="module")
+def provider():
+    p = OAuthTestProvider(port=0).start()
+    yield p
+    p.stop()
+
+
+def _get(url, headers=None):
+    req = urllib.request.Request(url, headers=headers or {})
+    resp = urllib.request.urlopen(req, timeout=30)
+    return resp.status, resp.read(), dict(resp.headers)
+
+
+def _post_form(url, form, headers=None):
+    data = urllib.parse.urlencode(form).encode()
+    req = urllib.request.Request(
+        url, data=data, method="POST",
+        headers={"Content-Type": "application/x-www-form-urlencoded",
+                 **(headers or {})})
+
+    class NoRedirect(urllib.request.HTTPRedirectHandler):
+        def redirect_request(self, *a, **k):
+            return None
+
+    opener = urllib.request.build_opener(NoRedirect)
+    try:
+        resp = opener.open(req, timeout=30)
+        return resp.status, resp.read(), dict(resp.headers)
+    except urllib.error.HTTPError as e:
+        return e.code, e.read(), dict(e.headers)
+
+
+def _obtain_code(provider, redirect_uri="http://localhost:7474/cb",
+                 username="admin", state="xyz"):
+    status, _, headers = _post_form(
+        f"{provider.issuer}/oauth2/v1/authorize/consent",
+        {"username": username, "redirect_uri": redirect_uri, "state": state})
+    assert status == 302
+    loc = urllib.parse.urlparse(headers["Location"])
+    q = urllib.parse.parse_qs(loc.query)
+    assert q["state"] == [state]
+    return q["code"][0]
+
+
+class TestDiscoveryAndHealth:
+    def test_health(self, provider):
+        status, body, _ = _get(f"{provider.issuer}/health")
+        assert status == 200
+        assert json.loads(body) == {"status": "ok",
+                                    "users": len(DEFAULT_USERS)}
+
+    def test_discovery_metadata(self, provider):
+        _, body, _ = _get(
+            f"{provider.issuer}/.well-known/oauth-authorization-server")
+        meta = json.loads(body)
+        assert meta["issuer"] == provider.issuer
+        assert meta["authorization_endpoint"].endswith("/oauth2/v1/authorize")
+        assert "authorization_code" in meta["grant_types_supported"]
+
+
+class TestAuthorizationCodeFlow:
+    def test_consent_form_lists_test_users(self, provider):
+        q = urllib.parse.urlencode({
+            "response_type": "code", "client_id": provider.client_id,
+            "redirect_uri": "http://localhost:7474/cb", "state": "s1"})
+        status, body, _ = _get(f"{provider.issuer}/oauth2/v1/authorize?{q}")
+        assert status == 200
+        page = body.decode()
+        for u in DEFAULT_USERS:
+            assert u.preferred_username in page
+
+    def test_authorize_rejects_wrong_client(self, provider):
+        q = urllib.parse.urlencode({
+            "response_type": "code", "client_id": "evil",
+            "redirect_uri": "http://localhost:7474/cb"})
+        with pytest.raises(urllib.error.HTTPError) as e:
+            _get(f"{provider.issuer}/oauth2/v1/authorize?{q}")
+        assert e.value.code == 400
+
+    def test_full_flow_post_auth(self, provider):
+        code = _obtain_code(provider, username="developer")
+        status, body, _ = _post_form(f"{provider.issuer}/oauth2/v1/token", {
+            "grant_type": "authorization_code", "code": code,
+            "redirect_uri": "http://localhost:7474/cb",
+            "client_id": provider.client_id,
+            "client_secret": provider.client_secret})
+        assert status == 200
+        tok = json.loads(body)
+        assert tok["token_type"] == "Bearer"
+        status, body, _ = _get(
+            f"{provider.issuer}/oauth2/v1/userinfo",
+            headers={"Authorization": f"Bearer {tok['access_token']}"})
+        info = json.loads(body)
+        assert info["preferred_username"] == "developer"
+        assert info["roles"] == ["developer"]
+
+    def test_full_flow_basic_auth(self, provider):
+        import base64
+
+        code = _obtain_code(provider, username="viewer")
+        basic = base64.b64encode(
+            f"{provider.client_id}:{provider.client_secret}".encode()
+        ).decode()
+        status, body, _ = _post_form(
+            f"{provider.issuer}/oauth2/v1/token",
+            {"grant_type": "authorization_code", "code": code,
+             "redirect_uri": "http://localhost:7474/cb"},
+            headers={"Authorization": f"Basic {basic}"})
+        assert status == 200
+        assert "access_token" in json.loads(body)
+
+    def test_code_single_use(self, provider):
+        code = _obtain_code(provider)
+        form = {"grant_type": "authorization_code", "code": code,
+                "redirect_uri": "http://localhost:7474/cb",
+                "client_id": provider.client_id,
+                "client_secret": provider.client_secret}
+        assert _post_form(f"{provider.issuer}/oauth2/v1/token", form)[0] == 200
+        status, body, _ = _post_form(f"{provider.issuer}/oauth2/v1/token", form)
+        assert status == 400
+        assert json.loads(body)["error"] == "invalid_grant"
+
+    def test_token_rejects_bad_secret(self, provider):
+        code = _obtain_code(provider)
+        status, body, _ = _post_form(f"{provider.issuer}/oauth2/v1/token", {
+            "grant_type": "authorization_code", "code": code,
+            "redirect_uri": "http://localhost:7474/cb",
+            "client_id": provider.client_id, "client_secret": "wrong"})
+        assert status == 401
+
+    def test_redirect_uri_mismatch_rejected(self, provider):
+        code = _obtain_code(provider, redirect_uri="http://a/cb")
+        status, body, _ = _post_form(f"{provider.issuer}/oauth2/v1/token", {
+            "grant_type": "authorization_code", "code": code,
+            "redirect_uri": "http://EVIL/cb",
+            "client_id": provider.client_id,
+            "client_secret": provider.client_secret})
+        assert status == 400
+
+    def test_userinfo_rejects_bad_token(self, provider):
+        with pytest.raises(urllib.error.HTTPError) as e:
+            _get(f"{provider.issuer}/oauth2/v1/userinfo",
+                 headers={"Authorization": "Bearer nope"})
+        assert e.value.code == 401
+
+
+class TestCliWiring:
+    def test_subcommand_registered(self):
+        from nornicdb_tpu.cli import main as cli_main
+
+        with pytest.raises(SystemExit) as e:
+            cli_main(["oauth-provider", "--help"])
+        assert e.value.code == 0
