@@ -363,7 +363,8 @@ READONLY_PROCEDURES = (
     "db.labels", "db.relationshiptypes", "db.propertykeys",
     "dbms.components", "db.index.vector.querynodes",
     "db.index.fulltext.querynodes", "apoc.help",
-    # every gds.* procedure streams read-only results
+    # every gds.* STREAM procedure is read-only; the graph catalog is not
+    # (see MUTATING_PROCEDURE_EXCEPTIONS)
     "gds.",
     # read-only graph scans/traversals; NOT apoc.lock./apoc.export. etc. —
     # side-effectful-but-non-mutating procedures must stay write-classified
@@ -371,6 +372,18 @@ READONLY_PROCEDURES = (
     "apoc.search.", "apoc.path.", "apoc.meta.",
     "apoc.schema.nodes", "apoc.schema.relationships",
 )
+
+# procedures under a read-only prefix that DO mutate state — classified as
+# writes so the result cache never serves a stale catalog and RBAC treats
+# them as writes (gds.graph.project registers, drop removes)
+MUTATING_PROCEDURE_EXCEPTIONS = ("gds.graph.project", "gds.graph.drop")
+
+
+def procedure_is_readonly(name: str) -> bool:
+    name = name.lower()
+    if name.startswith(MUTATING_PROCEDURE_EXCEPTIONS):
+        return False
+    return name.startswith(READONLY_PROCEDURES)
 
 
 def has_updating_clause(q: "Query") -> bool:
@@ -382,8 +395,8 @@ def has_updating_clause(q: "Query") -> bool:
     for c in q.clauses:
         if isinstance(c, _UPDATING_CLAUSES):
             return True
-        if isinstance(c, CallClause) and not c.procedure.startswith(
-            READONLY_PROCEDURES
+        if isinstance(c, CallClause) and not procedure_is_readonly(
+            c.procedure
         ):
             return True
         if isinstance(c, CallSubquery) and has_updating_clause(c.query):

@@ -1924,8 +1924,6 @@ _WRITE_CLAUSES = ast._UPDATING_CLAUSES
 
 # procedures known to be pure reads; everything else is treated as a write
 # (single source of truth in ast.py, shared with has_updating_clause)
-_READONLY_PROCEDURES = ast.READONLY_PROCEDURES
-
 _NONDETERMINISTIC_FNS = {
     "rand", "randomuuid", "timestamp",
     "apoc.create.uuid", "apoc.text.random", "apoc.date.currenttimestamp",
@@ -1938,9 +1936,10 @@ def classify_query_text(query: str) -> str:
     """Permission class ("read" | "write") of a raw query string.
 
     AST-based, shared by the HTTP tx API and Bolt RBAC gates: any CALL of a
-    procedure not in _READONLY_PROCEDURES counts as a write, so mutating
-    procedures (CALL apoc.refactor.*, apoc.trigger.add, ...) can't slip past
-    a keyword regex under a viewer token (ref: auth gating of
+    procedure ast.procedure_is_readonly rejects counts as a write (readonly
+    prefixes minus MUTATING_PROCEDURE_EXCEPTIONS like gds.graph.project),
+    so mutating procedures (CALL apoc.refactor.*, apoc.trigger.add, ...)
+    can't slip past a keyword regex under a viewer token (ref: auth gating of
     /db/{db}/tx/commit, server_middleware.go). Unparseable input classifies
     as write — the executor rejects it anyway, and the conservative class
     cannot leak privileges.
@@ -1970,8 +1969,8 @@ def _is_write_query(q: ast.Query) -> bool:
     for c in q.clauses:
         if isinstance(c, _WRITE_CLAUSES):
             return True
-        if isinstance(c, ast.CallClause) and not c.procedure.startswith(
-            _READONLY_PROCEDURES
+        if isinstance(c, ast.CallClause) and not ast.procedure_is_readonly(
+            c.procedure
         ):
             return True  # index DDL procs / apoc.create / unknown may mutate
         if isinstance(c, ast.CallSubquery) and _is_write_query(c.query):
@@ -2096,8 +2095,8 @@ def _write_labels(q: ast.Query) -> set[str]:
                 labels.update(item.labels)
         if isinstance(c, ast.ForeachClause):
             unscoped = True  # nested updates: play safe
-        if isinstance(c, ast.CallClause) and not c.procedure.startswith(
-            _READONLY_PROCEDURES
+        if isinstance(c, ast.CallClause) and not ast.procedure_is_readonly(
+            c.procedure
         ):
             unscoped = True
         if isinstance(c, ast.CallSubquery):

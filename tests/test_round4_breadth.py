@@ -1,0 +1,289 @@
+"""Focused assertions for the round-4 parity additions — the corpus probe
+executes these surfaces; these tests pin their exact semantics.
+
+References: fastrp_test.go (gds.graph catalog), apoc_algorithms_test.go
+(apoc.algo conventions), vector_procedures_test.go (relationship
+indexes), kalman_functions_test.go, duration/temporal_functions_test.go,
+index_hints_test.go, clauses_test.go math family.
+"""
+
+import pytest
+
+import nornicdb_tpu
+from nornicdb_tpu.errors import NornicError
+
+
+@pytest.fixture
+def db():
+    d = nornicdb_tpu.open_db("")
+    yield d
+    d.close()
+
+
+@pytest.fixture
+def transit(db):
+    """The apoc_algorithms_test.go transit graph: A→B→D cheap, A→C direct
+    expensive; ROAD edges form the alternative A→C→D."""
+    db.cypher("""
+        CREATE (a:Stop {id: 'A'}), (b:Stop {id: 'B'}),
+               (c:Stop {id: 'C'}), (d:Stop {id: 'D'}),
+               (a)-[:CONNECTS {weight: 1}]->(b),
+               (b)-[:CONNECTS {weight: 2}]->(d),
+               (a)-[:CONNECTS {weight: 9}]->(d),
+               (a)-[:ROAD {distance: 3}]->(c),
+               (c)-[:ROAD {distance: 1}]->(d)
+    """)
+    return db
+
+
+class TestGdsGraphCatalog:
+    def test_project_counts_and_yields(self, transit):
+        r = transit.cypher("CALL gds.graph.project('g', 'Stop', 'CONNECTS')")
+        assert r.columns == ["graphName", "nodeCount", "relationshipCount"]
+        assert r.rows == [["g", 4, 3]]
+
+    def test_project_star(self, transit):
+        r = transit.cypher("CALL gds.graph.project('all', '*', '*')")
+        assert r.rows == [["all", 4, 5]]
+
+    def test_duplicate_project_errors(self, transit):
+        transit.cypher("CALL gds.graph.project('g', 'Stop', 'CONNECTS')")
+        with pytest.raises(NornicError):
+            transit.cypher("CALL gds.graph.project('g', 'Stop', 'CONNECTS')")
+
+    def test_list_exists_drop(self, transit):
+        transit.cypher("CALL gds.graph.project('g1', 'Stop', 'CONNECTS')")
+        transit.cypher("CALL gds.graph.project('g2', 'Stop', 'ROAD')")
+        assert len(transit.cypher("CALL gds.graph.list()").rows) == 2
+        assert transit.cypher(
+            "CALL gds.graph.exists('g1')").rows == [["g1", True]]
+        transit.cypher("CALL gds.graph.drop('g1')")
+        assert transit.cypher(
+            "CALL gds.graph.exists('g1')").rows == [["g1", False]]
+        with pytest.raises(NornicError):
+            transit.cypher("CALL gds.graph.drop('g1')")
+
+
+class TestApocAlgoConventions:
+    def test_dijkstra_string_ids_and_weight(self, transit):
+        r = transit.cypher(
+            "CALL apoc.algo.dijkstra('A', 'D', 'CONNECTS', 'weight') "
+            "YIELD path, weight RETURN weight")
+        assert r.rows == [[3.0]]  # A→B→D beats the direct 9.0 edge
+
+    def test_dijkstra_reverse_direction(self, transit):
+        """Undirected traversal, like the reference's."""
+        r = transit.cypher(
+            "CALL apoc.algo.dijkstra('D', 'A', 'CONNECTS', 'weight') "
+            "YIELD path, weight RETURN weight")
+        assert r.rows == [[3.0]]
+
+    def test_dijkstra_respects_rel_type(self, transit):
+        r = transit.cypher(
+            "CALL apoc.algo.dijkstra('A', 'D', 'ROAD', 'distance') "
+            "YIELD path, weight RETURN weight")
+        assert r.rows == [[4.0]]  # A→C→D on ROAD edges only
+
+    def test_all_simple_paths(self, transit):
+        r = transit.cypher(
+            "CALL apoc.algo.allSimplePaths('A', 'D', 'CONNECTS', 10) "
+            "YIELD path RETURN count(path)")
+        assert r.rows == [[2]]  # A→B→D and A→D
+
+    def test_neighbors_tohop_and_byhop(self, transit):
+        r = transit.cypher(
+            "CALL apoc.neighbors.tohop('A', 'CONNECTS', 1) "
+            "YIELD node RETURN count(node)")
+        assert r.rows == [[2]]  # B and D (direct edge)
+        r = transit.cypher(
+            "CALL apoc.neighbors.byhop('A', 'ROAD', 2) "
+            "YIELD nodes, depth RETURN depth, size(nodes) ORDER BY depth")
+        assert r.rows == [[1, 1], [2, 1]]  # C at hop 1, D at hop 2
+
+    def test_byhop_direction_spec_normalized(self, transit):
+        """'KNOWS>' style arrows must match like the tohop variant."""
+        r = transit.cypher(
+            "CALL apoc.neighbors.byhop('A', 'ROAD>', 1) "
+            "YIELD nodes RETURN size(nodes)")
+        assert r.rows == [[1]]
+
+    def test_community_yields_node_community(self, transit):
+        r = transit.cypher(
+            "CALL apoc.algo.louvain() YIELD node, community "
+            "RETURN count(node)")
+        assert r.rows[0][0] >= 4
+        r = transit.cypher(
+            "CALL apoc.algo.labelPropagation(['Stop']) "
+            "YIELD node, community RETURN count(node)")
+        assert r.rows[0][0] == 4
+
+
+class TestRelationshipIndexes:
+    def test_vector_rel_index_similarity_functions(self, db):
+        db.cypher("CALL db.index.vector.createRelationshipIndex("
+                  "'cos_idx', 'SIM', 'feat', 2, 'cosine')")
+        db.cypher("CALL db.index.vector.createRelationshipIndex("
+                  "'euc_idx', 'SIM', 'feat', 2, 'euclidean')")
+        db.cypher("CREATE (:A {id: 'a'})-[:SIM {feat: [1.0, 0.0]}]->(:B)")
+        db.cypher("CREATE (:A {id: 'b'})-[:SIM {feat: [10.0, 0.0]}]->(:B)")
+        # cosine: both edges score 1.0 against [1, 0] (same direction)
+        r = db.cypher("CALL db.index.vector.queryRelationships("
+                      "'cos_idx', 2, [1.0, 0.0]) YIELD score RETURN score")
+        assert all(abs(row[0] - 1.0) < 1e-5 for row in r.rows)
+        # euclidean: the [1,0] edge must rank first (distance 0)
+        r = db.cypher("CALL db.index.vector.queryRelationships("
+                      "'euc_idx', 2, [1.0, 0.0]) "
+                      "YIELD relationship, score RETURN score")
+        assert r.rows[0][0] == 1.0 and r.rows[1][0] < 0.1
+
+    def test_unknown_index_returns_empty_with_columns(self, db):
+        r = db.cypher("CALL db.index.vector.queryRelationships("
+                      "'nope', 5, [0.1, 0.2]) YIELD relationship, score "
+                      "RETURN relationship, score")
+        assert r.rows == []
+
+    def test_fulltext_rel_index(self, db):
+        db.cypher("CALL db.index.fulltext.createRelationshipIndex("
+                  "'ft', 'MENTIONS', 'description')")
+        db.cypher("CREATE (:A)-[:MENTIONS {description: "
+                  "'quantum computing review'}]->(:B)")
+        db.cypher("CREATE (:A)-[:MENTIONS {description: "
+                  "'cooking recipes'}]->(:B)")
+        r = db.cypher("CALL db.index.fulltext.queryRelationships("
+                      "'ft', 'quantum') YIELD relationship, score "
+                      "RETURN relationship.description")
+        assert r.rows == [["quantum computing review"]]
+
+    def test_set_vector_property_procedures(self, db):
+        db.cypher("CREATE (:VN {id: 'n1'})-[:VR {id: 'r1'}]->(:VN)")
+        nid = db.cypher("MATCH (n:VN {id: 'n1'}) RETURN id(n)").rows[0][0]
+        db.cypher("CALL db.create.setNodeVectorProperty($id, 'emb', "
+                  "[0.1, 0.2])", {"id": nid})
+        assert db.cypher("MATCH (n:VN {id: 'n1'}) RETURN n.emb").rows == \
+            [[[0.1, 0.2]]]
+
+
+class TestKalmanFamilies:
+    def test_scalar_state_roundtrip(self, db):
+        st = db.cypher("RETURN kalman.init()").rows[0][0]
+        out = db.cypher("RETURN kalman.process(100.0, $s)",
+                        {"s": st}).rows[0][0]
+        assert out["value"] == 100.0  # first measurement seeds the filter
+        st2 = out["state"]
+        out2 = db.cypher("RETURN kalman.process(0.0, $s)",
+                         {"s": st2}).rows[0][0]
+        assert 0.0 < out2["value"] < 100.0  # smoothed, not raw
+
+    def test_predict_from_state_json(self, db):
+        st = db.cypher("RETURN kalman.init()").rows[0][0]
+        for v in (10, 20, 30, 40, 50):
+            st = db.cypher("RETURN kalman.process($v, $s)",
+                           {"v": float(v), "s": st}).rows[0][0]["state"]
+        pred = db.cypher("RETURN kalman.predict($s, 3)",
+                         {"s": st}).rows[0][0]
+        assert 10.0 <= pred <= 70.0  # reference's plausibility window
+
+    def test_velocity_tracks_trend(self, db):
+        st = db.cypher("RETURN kalman.velocity.init()").rows[0][0]
+        out = None
+        for v in (10, 20, 30, 40):
+            out = db.cypher("RETURN kalman.velocity.process($v, $s)",
+                            {"v": float(v), "s": st}).rows[0][0]
+            st = out["state"]
+        assert out["velocity"] > 0
+        pred = db.cypher("RETURN kalman.velocity.predict($s, 2)",
+                         {"s": st}).rows[0][0]
+        assert pred > out["value"]
+
+    def test_adaptive_reseeds_on_level_shift(self, db):
+        st = db.cypher("RETURN kalman.adaptive.init({hysteresis: 2})"
+                       ).rows[0][0]
+        for v in (10.0, 10.0, 10.0):
+            st = db.cypher("RETURN kalman.adaptive.process($v, $s)",
+                           {"v": v, "s": st}).rows[0][0]["state"]
+        # two consecutive large innovations re-seed onto the new level
+        for v in (500.0, 500.0):
+            out = db.cypher("RETURN kalman.adaptive.process($v, $s)",
+                            {"v": v, "s": st}).rows[0][0]
+            st = out["state"]
+        assert out["value"] == 500.0
+
+    def test_malformed_state_is_clean_error(self, db):
+        for q in ("RETURN kalman.process(1.0, 'junk')",
+                  "RETURN kalman.state('junk')",
+                  "RETURN kalman.velocity.predict('junk', 2)"):
+            with pytest.raises(NornicError):
+                db.cypher(q)
+
+
+class TestFunctionAdditions:
+    @pytest.mark.parametrize("q,expected", [
+        ("RETURN power(2, 10)", 1024.0),
+        ("RETURN power(4, 0.5)", 2.0),
+        ("RETURN coth(0)", None),
+        ("RETURN duration.inDays(duration('P10D'))", 10.0),
+        ("RETURN duration.inSeconds(duration('PT1H'))", 3600.0),
+        ("RETURN date.year('2025-11-27')", 2025),
+        ("RETURN date.month('2025-11-27')", 11),
+        ("RETURN date.day('2025-11-27')", 27),
+    ])
+    def test_values(self, db, q, expected):
+        assert db.cypher(q).rows == [[expected]]
+
+    def test_hyperbolic_identity(self, db):
+        r = db.cypher("RETURN cosh(0.7)*cosh(0.7) - sinh(0.7)*sinh(0.7)")
+        assert abs(r.rows[0][0] - 1.0) < 1e-9
+
+    def test_type_on_var_length_rel_list(self, db):
+        db.cypher("CREATE (:T {id: 1})-[:NEXT]->(:T {id: 2})"
+                  "-[:NEXT]->(:T {id: 3})")
+        r = db.cypher("MATCH (a:T {id: 1})-[r*1..2]->(b:T) "
+                      "RETURN type(r) ORDER BY b.id")
+        assert all(row[0] == "NEXT" for row in r.rows)
+
+
+class TestUsingHints:
+    def test_hints_parse_and_do_not_change_results(self, db):
+        db.cypher("CREATE (:H {name: 'x', email: 'e'})")
+        base = db.cypher("MATCH (n:H) WHERE n.name = 'x' RETURN n.name").rows
+        for hint in (
+            "USING INDEX n:H(name)",
+            "USING INDEX SEEK n:H(name)",
+            "USING SCAN n:H",
+        ):
+            r = db.cypher(f"MATCH (n:H) {hint} WHERE n.name = 'x' "
+                          "RETURN n.name")
+            assert r.rows == base
+
+    def test_join_hint_on_two_vars(self, db):
+        db.cypher("CREATE (:H2 {name: 'a'})-[:K]->(:H2 {name: 'b'})")
+        r = db.cypher("MATCH (a:H2)-[:K]->(b:H2) USING JOIN ON a "
+                      "WHERE a.name = 'a' RETURN b.name")
+        assert r.rows == [["b"]]
+
+    def test_bad_hint_errors(self, db):
+        with pytest.raises(NornicError):
+            db.cypher("MATCH (n:H) USING NONSENSE n RETURN n")
+
+
+class TestConstraintBackfill:
+    def test_index_created_after_data_serves_lookups(self, db):
+        db.cypher("CREATE (:BF {k: 'v1'})")
+        db.cypher("CREATE INDEX bf_idx FOR (n:BF) ON (n.k)")
+        # the lookup path must see the pre-existing node
+        assert db.executor.schema.lookup("BF", ["k"], ["v1"])
+
+    def test_constraint_over_duplicates_refused(self, db):
+        db.cypher("CREATE (:BF2 {k: 1})")
+        db.cypher("CREATE (:BF2 {k: 1})")
+        with pytest.raises(NornicError, match="duplicate"):
+            db.cypher("CREATE CONSTRAINT FOR (n:BF2) REQUIRE n.k IS UNIQUE")
+        # rejected constraint must not linger
+        assert not any(c.label == "BF2"
+                       for c in db.executor.schema.list_constraints())
+
+    def test_constraint_after_clean_data_enforces(self, db):
+        db.cypher("CREATE (:BF3 {k: 1})")
+        db.cypher("CREATE CONSTRAINT FOR (n:BF3) REQUIRE n.k IS UNIQUE")
+        with pytest.raises(NornicError, match="unique"):
+            db.cypher("CREATE (:BF3 {k: 1})")
