@@ -362,7 +362,9 @@ _UPDATING_CLAUSES = (
 READONLY_PROCEDURES = (
     "db.labels", "db.relationshiptypes", "db.propertykeys",
     "dbms.components", "db.index.vector.querynodes",
-    "db.index.fulltext.querynodes", "apoc.help",
+    "db.index.vector.queryrelationships",
+    "db.index.fulltext.querynodes",
+    "db.index.fulltext.queryrelationships", "apoc.help",
     # every gds.* STREAM procedure is read-only; the graph catalog is not
     # (see MUTATING_PROCEDURE_EXCEPTIONS)
     "gds.",

@@ -1922,8 +1922,7 @@ class CypherExecutor:
 _WRITE_CLAUSES = ast._UPDATING_CLAUSES
 
 
-# procedures known to be pure reads; everything else is treated as a write
-# (single source of truth in ast.py, shared with has_updating_clause)
+# functions whose results must never be served from the query cache
 _NONDETERMINISTIC_FNS = {
     "rand", "randomuuid", "timestamp",
     "apoc.create.uuid", "apoc.text.random", "apoc.date.currenttimestamp",

@@ -136,6 +136,19 @@ class TestRelationshipIndexes:
                       "YIELD relationship, score RETURN score")
         assert r.rows[0][0] == 1.0 and r.rows[1][0] < 0.1
 
+    def test_query_relationships_classify_as_reads(self):
+        """A viewer token must be able to call the relationship query
+        procedures — they mutate nothing (RBAC classification)."""
+        from nornicdb_tpu.cypher.executor import classify_query_text
+
+        for q in (
+            "CALL db.index.vector.queryRelationships('i', 5, [0.1]) "
+            "YIELD relationship, score RETURN score",
+            "CALL db.index.fulltext.queryRelationships('i', 'x') "
+            "YIELD relationship, score RETURN score",
+        ):
+            assert classify_query_text(q) == "read", q
+
     def test_unknown_index_returns_empty_with_columns(self, db):
         r = db.cypher("CALL db.index.vector.queryRelationships("
                       "'nope', 5, [0.1, 0.2]) YIELD relationship, score "
