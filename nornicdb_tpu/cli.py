@@ -288,6 +288,60 @@ def cmd_train(args) -> int:
     return 0
 
 
+def cmd_kmeans_test_data(args) -> int:
+    """K-means test-data generator (ref: cmd/kmeans-test-data, 884 LoC —
+    synthetic/clustered embedding corpora for clustering benchmarks; the
+    download/movies modes need egress, so this build ships the two
+    deterministic generators plus optional direct DB import)."""
+    import numpy as np
+
+    rng = np.random.default_rng(args.seed)
+    if args.mode == "clusters":
+        centers = rng.normal(0, 1.0, (args.clusters, args.dims))
+        assign = rng.integers(0, args.clusters, args.count)
+        emb = centers[assign] + rng.normal(0, 0.15, (args.count, args.dims))
+    else:  # synthetic: isotropic Gaussian -> uniform directions on the sphere
+        assign = None
+        emb = rng.normal(0, 1.0, (args.count, args.dims))
+    emb = emb / np.maximum(
+        np.linalg.norm(emb, axis=1, keepdims=True), 1e-12)
+
+    os.makedirs(args.out, exist_ok=True)
+    path = os.path.join(args.out, "embeddings.npz")
+    if assign is not None:
+        np.savez_compressed(path, embeddings=emb.astype(np.float32),
+                            cluster=assign.astype(np.int32))
+    else:
+        np.savez_compressed(path, embeddings=emb.astype(np.float32))
+    print(json.dumps({"mode": args.mode, "count": args.count,
+                      "dims": args.dims, "out": path}))
+
+    # --db overrides, else the global --data-dir (the flag pattern every
+    # other subcommand uses); neither set = generate files only
+    target = args.db or args.data_dir
+    if target:
+        args = argparse.Namespace(**{**vars(args), "data_dir": target})
+        db = _open_db(args)
+        try:
+            from nornicdb_tpu.storage import Node
+
+            for i in range(args.count):
+                props = {"kind": "kmeans-test"}
+                if assign is not None:
+                    props["cluster"] = int(assign[i])
+                db.storage.create_node(Node(
+                    id=f"kmtest-{args.seed}-{i}",
+                    labels=["KMeansTest"],
+                    properties=props,
+                    embedding=emb[i].astype(np.float32),
+                ))
+            db.flush()
+            print(json.dumps({"imported": args.count, "db": target}))
+        finally:
+            db.close()
+    return 0
+
+
 def main(argv=None) -> int:
     p = argparse.ArgumentParser(prog="nornicdb", description="NornicDB-TPU")
     p.add_argument("--data-dir", default=os.environ.get("NORNICDB_DATA_DIR", ""),
@@ -353,6 +407,22 @@ def main(argv=None) -> int:
     s.add_argument("--steps", type=int, default=0,
                    help="train steps (default: per-model preset)")
     s.set_defaults(fn=cmd_train)
+
+    s = sub.add_parser(
+        "kmeans-test-data",
+        help="generate synthetic/clustered embedding corpora for k-means "
+             "benchmarks (ref: cmd/kmeans-test-data)",
+    )
+    s.add_argument("--mode", choices=["synthetic", "clusters"],
+                   default="clusters")
+    s.add_argument("--count", type=int, default=5000)
+    s.add_argument("--dims", type=int, default=1024)
+    s.add_argument("--clusters", type=int, default=20)
+    s.add_argument("--out", default="./data/kmeans-test")
+    s.add_argument("--db", default="",
+                   help="NornicDB data directory (if set, imports directly)")
+    s.add_argument("--seed", type=int, default=42)
+    s.set_defaults(fn=cmd_kmeans_test_data)
 
     s = sub.add_parser(
         "oauth-provider",

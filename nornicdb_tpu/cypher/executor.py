@@ -224,19 +224,24 @@ class CypherExecutor:
                                + f", rows: {len(result.rows)}")
             return result
         if isinstance(stmt, ast.CreateIndex):
-            return self._create_index(stmt)
+            r = self._create_index(stmt)
+            self._invalidate_cache_for_ddl()
+            return r
         if isinstance(stmt, ast.DropIndex):
             self.schema.drop_index(stmt.name, stmt.if_exists)
+            self._invalidate_cache_for_ddl()
             return Result([], [])
         if isinstance(stmt, ast.CreateConstraint):
             self.schema.create_constraint(
                 stmt.name, stmt.label, stmt.properties, stmt.kind, stmt.if_not_exists
             )
+            self._invalidate_cache_for_ddl()
             r = Result([], [])
             r.stats.constraints_added = 1
             return r
         if isinstance(stmt, ast.DropConstraint):
             self.schema.drop_constraint(stmt.name, stmt.if_exists)
+            self._invalidate_cache_for_ddl()
             return Result([], [])
         if isinstance(stmt, ast.ShowCommand):
             return self._show(stmt)
@@ -1703,6 +1708,14 @@ class CypherExecutor:
             finally:
                 self._tx_undo = None
                 self._tx_implicit = False
+
+    def _invalidate_cache_for_ddl(self) -> None:
+        """Index/constraint DDL changes what reads can see (a fulltext CALL
+        cached as empty before CREATE INDEX must not survive it), but DDL
+        statements bypass the write-classified cache path — clear
+        explicitly."""
+        if self.cache is not None:
+            self.cache.clear()
 
     def _query_limits(self):
         """(limits, query_bucket) for this executor's database. LimitedEngine

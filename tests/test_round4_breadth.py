@@ -300,3 +300,35 @@ class TestConstraintBackfill:
         db.cypher("CREATE CONSTRAINT FOR (n:BF3) REQUIRE n.k IS UNIQUE")
         with pytest.raises(NornicError, match="unique"):
             db.cypher("CREATE (:BF3 {k: 1})")
+
+
+class TestDdlCacheInvalidation:
+    """Index/constraint DDL must clear the query cache: a fulltext CALL
+    cached as empty before CREATE INDEX must not survive it."""
+
+    def test_create_index_invalidates_cached_call(self):
+        db = nornicdb_tpu.open_db("")
+        try:
+            db.cypher("CREATE (:A)-[:MENT {description: 'quantum notes'}]->(:B)")
+            q = ("CALL db.index.fulltext.queryRelationships('late_idx', "
+                 "'quantum') YIELD relationship, score RETURN score")
+            assert db.cypher(q).rows == []  # unknown index -> cached empty
+            db.cypher("CALL db.index.fulltext.createRelationshipIndex("
+                      "'late_idx', 'MENT', 'description')")
+            assert db.cypher(q).rows, "stale cached empty survived DDL"
+        finally:
+            db.close()
+
+    def test_drop_index_invalidates(self):
+        db = nornicdb_tpu.open_db("")
+        try:
+            db.cypher("CALL db.index.fulltext.createRelationshipIndex("
+                      "'tmp_idx', 'MENT', 'description')")
+            db.cypher("CREATE (:A)-[:MENT {description: 'findable'}]->(:B)")
+            q = ("CALL db.index.fulltext.queryRelationships('tmp_idx', "
+                 "'findable') YIELD relationship, score RETURN score")
+            assert db.cypher(q).rows
+            db.cypher("DROP INDEX tmp_idx")
+            assert db.cypher(q).rows == [], "cached hit survived DROP INDEX"
+        finally:
+            db.close()

@@ -290,3 +290,48 @@ class TestHeimdall:
         finally:
             server.stop()
             db.close()
+
+
+class TestKmeansTestData:
+    """ref: cmd/kmeans-test-data — deterministic corpora generators."""
+
+    def test_clusters_mode_generates_and_imports(self, tmp_path):
+        from nornicdb_tpu.cli import main as cli_main
+        import numpy as np
+
+        out = str(tmp_path / "gen")
+        dbdir = str(tmp_path / "db")
+        rc = cli_main([
+            "kmeans-test-data", "--mode", "clusters", "--count", "200",
+            "--dims", "16", "--clusters", "4", "--out", out,
+            "--db", dbdir, "--seed", "7",
+        ])
+        assert rc == 0
+        data = np.load(f"{out}/embeddings.npz")
+        assert data["embeddings"].shape == (200, 16)
+        assert set(np.unique(data["cluster"])) <= set(range(4))
+        # unit-normalized rows (cosine-ready)
+        norms = np.linalg.norm(data["embeddings"], axis=1)
+        assert np.allclose(norms, 1.0, atol=1e-5)
+        # imported nodes carry embeddings + cluster labels
+        import nornicdb_tpu
+
+        db = nornicdb_tpu.open_db(dbdir)
+        try:
+            nodes = db.storage.get_nodes_by_label("KMeansTest")
+            assert len(nodes) == 200
+            assert nodes[0].embedding is not None
+        finally:
+            db.close()
+
+    def test_synthetic_mode(self, tmp_path):
+        from nornicdb_tpu.cli import main as cli_main
+        import numpy as np
+
+        out = str(tmp_path / "gen2")
+        rc = cli_main(["kmeans-test-data", "--mode", "synthetic",
+                       "--count", "50", "--dims", "8", "--out", out])
+        assert rc == 0
+        data = np.load(f"{out}/embeddings.npz")
+        assert data["embeddings"].shape == (50, 8)
+        assert "cluster" not in data
