@@ -325,18 +325,26 @@ def cmd_kmeans_test_data(args) -> int:
         try:
             from nornicdb_tpu.storage import Node
 
+            from nornicdb_tpu.errors import AlreadyExistsError
+
+            imported = skipped = 0
             for i in range(args.count):
                 props = {"kind": "kmeans-test"}
                 if assign is not None:
                     props["cluster"] = int(assign[i])
-                db.storage.create_node(Node(
-                    id=f"kmtest-{args.seed}-{i}",
-                    labels=["KMeansTest"],
-                    properties=props,
-                    embedding=emb[i].astype(np.float32),
-                ))
+                try:
+                    db.storage.create_node(Node(
+                        id=f"kmtest-{args.seed}-{i}",
+                        labels=["KMeansTest"],
+                        properties=props,
+                        embedding=emb[i].astype(np.float32),
+                    ))
+                    imported += 1
+                except AlreadyExistsError:
+                    skipped += 1  # re-run with the same seed: idempotent
             db.flush()
-            print(json.dumps({"imported": args.count, "db": target}))
+            print(json.dumps({"imported": imported, "skipped": skipped,
+                              "db": target}))
         finally:
             db.close()
     return 0

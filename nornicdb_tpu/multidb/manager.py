@@ -138,25 +138,213 @@ class LimitedEngine(NamespacedEngine):
         super().delete_edge(edge_id)
 
 
-class CompositeEngine(Engine):
-    """Read-only federated view over constituent databases
-    (ref: pkg/storage/composite_engine.go, pkg/multidb/composite.go)."""
+def _hash_string(s: str) -> int:
+    """The reference's 31-multiplier string hash (composite_engine.go
+    hashString) masked to 64-bit signed so routing indexes agree."""
+    h = 0
+    for ch in s:
+        h = (h * 31 + ord(ch)) & 0xFFFFFFFFFFFFFFFF
+    if h >= 1 << 63:
+        h -= 1 << 64
+    return h
 
-    def __init__(self, constituents: dict[str, Engine]):
+
+def _hash_value(v) -> int:
+    """(ref: hashValue composite_engine.go:265) — integers hash to their
+    absolute value, everything else stringifies; keeps routing index-
+    compatible with the reference for numeric tenant ids."""
+    if isinstance(v, bool):  # bool is an int subclass; stringify like Go %v
+        return _hash_string(str(v).lower())
+    if isinstance(v, int):
+        return abs(v)
+    if isinstance(v, str):
+        return _hash_string(v)
+    return _hash_string(str(v))
+
+
+class CompositeEngine(Engine):
+    """Federated view over constituent databases with deterministic write
+    routing (ref: pkg/storage/composite_engine.go, pkg/multidb/composite.go).
+
+    Reads fan out / route by the `db.` id prefix. Writes route to a
+    writable constituent by the reference's rules (routeWrite :160):
+      0. properties.database_id exactly names a writable constituent
+      1. first label matches a constituent alias (case-insensitive)
+      2. properties.database_id consistent-hashes over writables
+      3. first label consistent-hashes over writables
+      4. first writable constituent
+    Per-constituent access modes: "read", "write", "read_write".
+    """
+
+    def __init__(self, constituents: dict[str, Engine],
+                 access_modes: Optional[dict[str, str]] = None):
         super().__init__()
         self.constituents = constituents
+        self.access_modes = {
+            name: (access_modes or {}).get(name, "read_write")
+            for name in constituents
+        }
+        for name, mode in self.access_modes.items():
+            if mode not in ("read", "write", "read_write"):
+                raise NornicError(
+                    f"access mode must be 'read', 'write', or 'read_write' "
+                    f"(constituent {name}: {mode!r})"
+                )
 
-    def _no_write(self, *a, **k):
-        raise NornicError("composite databases are read-only")
+    # -- write routing -------------------------------------------------------
+    def _writables(self) -> list[str]:
+        # deterministic order: routing hashes index into this list
+        return sorted(n for n, m in self.access_modes.items()
+                      if m in ("write", "read_write"))
 
-    create_node = _no_write
-    update_node = _no_write
-    delete_node = _no_write
-    create_edge = _no_write
-    update_edge = _no_write
-    delete_edge = _no_write
-    mark_pending_embed = _no_write
-    unmark_pending_embed = _no_write
+    def _readables(self) -> dict[str, Engine]:
+        """Constituents visible to reads — 'write'-only ones are excluded,
+        like the reference's getConstituentsForRead
+        (composite_engine.go:112-126)."""
+        return {n: e for n, e in self.constituents.items()
+                if self.access_modes.get(n) in ("read", "read_write")}
+
+    def _route_write(self, labels: list[str], properties: dict) -> str:
+        writable = self._writables()
+        if not writable:
+            raise NornicError("composite has no writable constituents")
+        db_val = (properties or {}).get("database_id")
+        if isinstance(db_val, str) and db_val in writable:
+            return db_val
+        if labels:
+            first = labels[0].lower()
+            for alias in writable:
+                if alias.lower() == first:
+                    return alias
+        if db_val is not None:
+            idx = abs(_hash_value(db_val)) % len(writable)
+            return writable[idx]
+        if labels:
+            idx = abs(_hash_string(labels[0])) % len(writable)
+            return writable[idx]
+        return writable[0]
+
+    def create_node(self, node: Node) -> Node:
+        # an id qualified with a constituent prefix IS the routing request:
+        # storing "west.w2" in a different constituent would make the
+        # caller's addressed id name nothing on later reads
+        prefix = node.id.split(".", 1)[0] if "." in node.id else None
+        if prefix in self.constituents:
+            name = prefix
+            self._check_writable(name)
+        else:
+            name = self._route_write(node.labels, node.properties)
+        bare = node.copy()
+        if bare.id.startswith(f"{name}."):
+            bare.id = bare.id.split(".", 1)[1]
+        created = self.constituents[name].create_node(bare)
+        return self._qualify(name, created)
+
+    def update_node(self, node: Node) -> Node:
+        name, bare_id, _ = self._locate(node.id, kind="node", for_write=True)
+        self._check_writable(name)
+        bare = node.copy()
+        bare.id = bare_id
+        return self._qualify(name, self.constituents[name].update_node(bare))
+
+    def delete_node(self, node_id: str) -> None:
+        name, bare_id, _ = self._locate(node_id, kind="node", for_write=True)
+        self._check_writable(name)
+        self.constituents[name].delete_node(bare_id)
+
+    def create_edge(self, edge: Edge) -> Edge:
+        # an edge lives with its endpoints: both must resolve to ONE
+        # writable constituent (cross-constituent edges don't exist in the
+        # reference either)
+        s_name, s_bare, _ = self._locate(edge.start_node, kind="node",
+                                         for_write=True)
+        t_name, t_bare, _ = self._locate(edge.end_node, kind="node",
+                                         for_write=True)
+        if s_name != t_name:
+            raise NornicError(
+                "cannot create an edge across composite constituents "
+                f"({s_name} -> {t_name})"
+            )
+        self._check_writable(s_name)
+        bare = edge.copy()
+        bare.start_node, bare.end_node = s_bare, t_bare
+        if "." in bare.id:
+            prefix = bare.id.split(".", 1)[0]
+            if prefix == s_name:
+                bare.id = bare.id.split(".", 1)[1]
+            elif prefix in self.constituents:
+                # honoring a FOREIGN prefix would store an id the caller
+                # can never address again — refuse, like create_node's
+                # prefix-is-the-routing-request contract
+                raise NornicError(
+                    f"edge id is qualified for {prefix!r} but its endpoints "
+                    f"live in {s_name!r}"
+                )
+        return self._qualify(s_name, self.constituents[s_name].create_edge(bare))
+
+    def update_edge(self, edge: Edge) -> Edge:
+        name, bare_id, _ = self._locate(edge.id, kind="edge", for_write=True)
+        self._check_writable(name)
+        bare = edge.copy()
+        bare.id = bare_id
+        if bare.start_node.startswith(f"{name}."):
+            bare.start_node = bare.start_node.split(".", 1)[1]
+        if bare.end_node.startswith(f"{name}."):
+            bare.end_node = bare.end_node.split(".", 1)[1]
+        return self._qualify(name, self.constituents[name].update_edge(bare))
+
+    def delete_edge(self, edge_id: str) -> None:
+        name, bare_id, _ = self._locate(edge_id, kind="edge", for_write=True)
+        self._check_writable(name)
+        self.constituents[name].delete_edge(bare_id)
+
+    def _check_writable(self, name: str) -> None:
+        if self.access_modes.get(name) == "read":
+            raise NornicError(
+                f"constituent {name} is read-only in this composite"
+            )
+
+    def _locate(self, qualified_id: str, kind: str,
+                for_write: bool = False):
+        """Resolve an id to (constituent, bare_id, entity_or_None).
+
+        Visibility follows the access mode: reads only see 'read'/
+        'read_write' constituents (a 'write'-only constituent is invisible
+        even by qualified id — the scan and point-read views must agree);
+        writes locate across 'write'/'read_write' constituents. The entity
+        is returned when the search branch already fetched it, so callers
+        don't pay a second point lookup."""
+        # writes locate across EVERY constituent so that a write against a
+        # read-only one fails with the permission error from _check_writable,
+        # not a misleading not-found; reads only see readable constituents
+        # ('write'-only data is invisible even by qualified id, so the scan
+        # and point-read views agree)
+        pool = self.constituents if for_write else self._readables()
+        if "." in qualified_id:
+            db, bare = qualified_id.split(".", 1)
+            if db in self.constituents:
+                if db not in pool:
+                    raise NotFoundError(
+                        f"id {qualified_id} not found in composite")
+                return db, bare, None
+        for name, eng in pool.items():
+            try:
+                entity = (eng.get_node(qualified_id) if kind == "node"
+                          else eng.get_edge(qualified_id))
+                return name, qualified_id, entity
+            except NotFoundError:
+                continue
+        raise NotFoundError(f"id {qualified_id} not found in composite")
+
+    def mark_pending_embed(self, node_id: str) -> None:
+        name, bare, _ = self._locate(node_id, kind="node", for_write=True)
+        self._check_writable(name)
+        self.constituents[name].mark_pending_embed(bare)
+
+    def unmark_pending_embed(self, node_id: str) -> None:
+        name, bare, _ = self._locate(node_id, kind="node", for_write=True)
+        self._check_writable(name)
+        self.constituents[name].unmark_pending_embed(bare)
 
     def _qualify(self, name: str, entity):
         out = entity.copy()
@@ -166,62 +354,55 @@ class CompositeEngine(Engine):
             out.end_node = f"{name}.{entity.end_node}"
         return out
 
-    def _route(self, qualified_id: str) -> tuple[Engine, str]:
-        """(ref: routing.go:13 — constituent routing by id prefix)"""
-        if "." in qualified_id:
-            db, bare = qualified_id.split(".", 1)
-            eng = self.constituents.get(db)
-            if eng is not None:
-                return eng, bare
-        raise NotFoundError(f"id {qualified_id} not found in composite")
-
     def get_node(self, node_id: str) -> Node:
-        eng, bare = self._route(node_id)
-        db = node_id.split(".", 1)[0]
-        return self._qualify(db, eng.get_node(bare))
+        name, bare, entity = self._locate(node_id, kind="node")
+        if entity is None:
+            entity = self.constituents[name].get_node(bare)
+        return self._qualify(name, entity)
 
     def get_edge(self, edge_id: str) -> Edge:
-        eng, bare = self._route(edge_id)
-        db = edge_id.split(".", 1)[0]
-        return self._qualify(db, eng.get_edge(bare))
+        name, bare, entity = self._locate(edge_id, kind="edge")
+        if entity is None:
+            entity = self.constituents[name].get_edge(bare)
+        return self._qualify(name, entity)
 
     def get_nodes_by_label(self, label: str) -> list[Node]:
         out = []
-        for name, eng in self.constituents.items():
+        for name, eng in self._readables().items():
             out.extend(self._qualify(name, n) for n in eng.get_nodes_by_label(label))
         return out
 
     def all_nodes(self) -> Iterator[Node]:
-        for name, eng in self.constituents.items():
+        for name, eng in self._readables().items():
             for n in eng.all_nodes():
                 yield self._qualify(name, n)
 
     def all_edges(self) -> Iterator[Edge]:
-        for name, eng in self.constituents.items():
+        for name, eng in self._readables().items():
             for e in eng.all_edges():
                 yield self._qualify(name, e)
 
     def get_edges_by_type(self, edge_type: str) -> list[Edge]:
         out = []
-        for name, eng in self.constituents.items():
+        for name, eng in self._readables().items():
             out.extend(self._qualify(name, e) for e in eng.get_edges_by_type(edge_type))
         return out
 
     def get_outgoing_edges(self, node_id: str) -> list[Edge]:
-        eng, bare = self._route(node_id)
-        db = node_id.split(".", 1)[0]
-        return [self._qualify(db, e) for e in eng.get_outgoing_edges(bare)]
+        name, bare, _ = self._locate(node_id, kind="node")
+        eng = self.constituents[name]
+        return [self._qualify(name, e) for e in eng.get_outgoing_edges(bare)]
 
     def get_incoming_edges(self, node_id: str) -> list[Edge]:
-        eng, bare = self._route(node_id)
-        db = node_id.split(".", 1)[0]
-        return [self._qualify(db, e) for e in eng.get_incoming_edges(bare)]
+        name, bare, _ = self._locate(node_id, kind="node")
+        eng = self.constituents[name]
+        return [self._qualify(name, e) for e in eng.get_incoming_edges(bare)]
 
     def node_count(self) -> int:
-        return sum(e.node_count() for e in self.constituents.values())
+        return sum(e.node_count() for e in self._readables().values())
 
     def edge_count(self) -> int:
-        return sum(e.edge_count() for e in self.constituents.values())
+        return sum(e.edge_count() for e in self._readables().values())
 
     def pending_embed_ids(self, limit: int = 0) -> list[str]:
         return []
@@ -241,6 +422,9 @@ class DatabaseManager:
         self._limits: dict[str, DatabaseLimits] = {}
         self._query_buckets: dict[str, _Bucket] = {}
         self._composites: dict[str, list[str]] = {}
+        # per (composite, constituent) access mode (ref: ConstituentRef.
+        # AccessMode composite.go:24); absent = read_write
+        self._composite_modes: dict[str, dict[str, str]] = {}
         self._engines: dict[str, Engine] = {}
         self._system = NamespacedEngine(base, SYSTEM_DB)
         self._load_metadata()
@@ -260,11 +444,16 @@ class DatabaseManager:
                 self._composites[n.properties["name"]] = list(
                     n.properties.get("constituents", [])
                 )
+                self._composite_modes[n.properties["name"]] = dict(
+                    n.properties.get("access_modes", {})
+                )
         for n in self._system.get_nodes_by_label(_ALIAS_LABEL):
             self._aliases[n.properties["alias"]] = n.properties["target"]
 
     def _persist_db(self, name: str, composite: Optional[list[str]] = None) -> None:
         props = {"name": name}
+        if composite is not None and self._composite_modes.get(name):
+            props["access_modes"] = dict(self._composite_modes[name])
         if composite is not None:
             props["composite"] = True
             props["constituents"] = composite
@@ -330,34 +519,53 @@ class DatabaseManager:
             self._composites[name] = constituents
             self._persist_db(name, composite=constituents)
 
-    def add_constituent(self, composite: str, database: str) -> None:
+    def add_constituent(self, composite: str, database: str,
+                        access_mode: str = "read_write") -> None:
+        if access_mode not in ("read", "write", "read_write"):
+            raise NornicError(
+                "access mode must be 'read', 'write', or 'read_write'")
         with self._lock:
             if composite not in self._composites:
                 raise NotFoundError(f"composite {composite} not found")
             if database not in self._databases:
                 raise NotFoundError(f"database {database} not found")
+            changed = False
             if database not in self._composites[composite]:
                 self._composites[composite].append(database)
+                changed = True
+            modes = self._composite_modes.setdefault(composite, {})
+            if modes.get(database, "read_write") != access_mode:
+                modes[database] = access_mode
+                changed = True
+            if changed:
                 try:
                     self._system.delete_node(f"db-{composite}")
                 except NotFoundError:
                     pass
                 self._persist_db(composite, composite=self._composites[composite])
                 self._engines.pop(composite, None)
+        if changed and self.on_invalidate is not None:
+            # cached per-DB executors hold the OLD CompositeEngine (and its
+            # old access modes) — same eviction contract as set_limits
+            self.on_invalidate(composite)
 
     def remove_constituent(self, composite: str, database: str) -> None:
         """(ref: ALTER COMPOSITE DATABASE ... DROP ALIAS, composite.go)"""
         with self._lock:
             if composite not in self._composites:
                 raise NotFoundError(f"composite {composite} not found")
-            if database in self._composites[composite]:
+            removed = database in self._composites[composite]
+            if removed:
                 self._composites[composite].remove(database)
+                self._composite_modes.get(composite, {}).pop(database, None)
                 try:
                     self._system.delete_node(f"db-{composite}")
                 except NotFoundError:
                     pass
                 self._persist_db(composite, composite=self._composites[composite])
                 self._engines.pop(composite, None)
+        if removed and self.on_invalidate is not None:
+            self.on_invalidate(composite)
 
     # -- aliases -------------------------------------------------------------------
     def create_alias(self, alias: str, target: str) -> None:
@@ -420,7 +628,8 @@ class DatabaseManager:
                         {
                             c: self.get_storage(c)
                             for c in self._composites[name]
-                        }
+                        },
+                        access_modes=self._composite_modes.get(name),
                     )
                 else:
                     limits = self._limits.get(name)

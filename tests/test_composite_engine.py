@@ -1,0 +1,301 @@
+"""Port of pkg/storage/composite_engine_test.go (1,754 LoC) — the writable
+federated engine: CRUD routed across constituents, access modes,
+deterministic write routing (database_id exact > label-alias >
+database_id hash > label hash > first writable), and the not-found paths.
+"""
+
+import pytest
+
+from nornicdb_tpu.errors import NornicError, NotFoundError
+from nornicdb_tpu.multidb.manager import CompositeEngine, _hash_string
+from nornicdb_tpu.storage import Edge, MemoryEngine, Node
+
+
+@pytest.fixture
+def setup():
+    e1, e2 = MemoryEngine(), MemoryEngine()
+    comp = CompositeEngine({"db1": e1, "db2": e2})
+    return comp, e1, e2
+
+
+class TestCompositeCrud:
+    def test_create_node_lands_in_one_constituent(self, setup):
+        """TestCompositeEngine_CreateNode"""
+        comp, e1, e2 = setup
+        comp.create_node(Node(id="node1", labels=["Person"],
+                              properties={"name": "Alice"}))
+        found = sum(1 for e in (e1, e2)
+                    if any(n.id == "node1" for n in e.all_nodes()))
+        assert found == 1
+
+    def test_get_node_searches_constituents(self, setup):
+        """TestCompositeEngine_GetNode — unqualified ids resolve by search;
+        unknown ids raise."""
+        comp, e1, e2 = setup
+        e1.create_node(Node(id="node1", labels=["Person"]))
+        e2.create_node(Node(id="node2", labels=["Person"]))
+        assert comp.get_node("node1").id.endswith("node1")
+        assert comp.get_node("node2").id.endswith("node2")
+        with pytest.raises(NotFoundError):
+            comp.get_node("nonexistent")
+
+    def test_edge_lifecycle_same_constituent(self, setup):
+        """TestCompositeEngine_CreateEdge/UpdateEdge/DeleteEdge"""
+        comp, e1, _ = setup
+        e1.create_node(Node(id="a"))
+        e1.create_node(Node(id="b"))
+        created = comp.create_edge(Edge(id="e1", start_node="a",
+                                        end_node="b", type="KNOWS"))
+        assert created.id == "db1.e1"
+        got = comp.get_edge("db1.e1")
+        assert got.type == "KNOWS"
+        got.properties["w"] = 2
+        comp.update_edge(got)
+        assert e1.get_edge("e1").properties["w"] == 2
+        comp.delete_edge("db1.e1")
+        with pytest.raises(NotFoundError):
+            comp.get_edge("db1.e1")
+
+    def test_cross_constituent_edge_refused(self, setup):
+        comp, e1, e2 = setup
+        e1.create_node(Node(id="a"))
+        e2.create_node(Node(id="b"))
+        with pytest.raises(NornicError):
+            comp.create_edge(Edge(id="x", start_node="a", end_node="b"))
+
+    def test_update_delete_node(self, setup):
+        """TestCompositeEngine_UpdateNode/DeleteNode (+ NotFound variants)"""
+        comp, e1, _ = setup
+        e1.create_node(Node(id="u1", properties={"v": 1}))
+        n = comp.get_node("db1.u1")
+        n.properties["v"] = 2
+        comp.update_node(n)
+        assert e1.get_node("u1").properties["v"] == 2
+        comp.delete_node("db1.u1")
+        with pytest.raises(NotFoundError):
+            comp.get_node("db1.u1")
+        with pytest.raises(NotFoundError):
+            comp.delete_node("ghost")
+
+    def test_label_scan_and_counts_fan_out(self, setup):
+        """TestCompositeEngine_GetNodesByLabel/AllNodes/AllEdges"""
+        comp, e1, e2 = setup
+        e1.create_node(Node(id="p1", labels=["Person"]))
+        e2.create_node(Node(id="p2", labels=["Person"]))
+        e2.create_node(Node(id="c1", labels=["City"]))
+        assert {n.id for n in comp.get_nodes_by_label("Person")} == {
+            "db1.p1", "db2.p2"}
+        assert comp.node_count() == 3
+        assert len(list(comp.all_nodes())) == 3
+
+    def test_degrees_through_composite(self, setup):
+        """TestCompositeEngine_GetInDegree/GetOutDegree"""
+        comp, e1, _ = setup
+        e1.create_node(Node(id="a"))
+        e1.create_node(Node(id="b"))
+        e1.create_edge(Edge(id="e", start_node="a", end_node="b"))
+        assert len(comp.get_outgoing_edges("db1.a")) == 1
+        assert len(comp.get_incoming_edges("db1.b")) == 1
+
+
+class TestWriteRouting:
+    """TestCompositeEngine_routeWrite_* — the deterministic routing rules."""
+
+    def test_property_database_id_exact(self, setup):
+        comp, e1, e2 = setup
+        created = comp.create_node(Node(
+            id="n", labels=["Anything"], properties={"database_id": "db2"}))
+        assert created.id == "db2.n"
+        assert any(n.id == "n" for n in e2.all_nodes())
+
+    def test_label_matches_alias(self, setup):
+        comp, e1, _ = setup
+        created = comp.create_node(Node(id="n", labels=["Db1"]))
+        assert created.id == "db1.n"  # case-insensitive alias match
+
+    def test_property_database_id_hash_fallback(self, setup):
+        """An unknown database_id consistent-hashes over writables."""
+        comp, _, _ = setup
+        writable = comp._writables()
+        val = "tenant-xyz"
+        expect = writable[abs(_hash_string(val)) % len(writable)]
+        created = comp.create_node(Node(
+            id="n", properties={"database_id": val}))
+        assert created.id.split(".")[0] == expect
+        # deterministic: same value routes the same way again
+        created2 = comp.create_node(Node(
+            id="n2", properties={"database_id": val}))
+        assert created2.id.split(".")[0] == expect
+
+    def test_label_hash_fallback(self, setup):
+        comp, _, _ = setup
+        writable = comp._writables()
+        expect = writable[abs(_hash_string("Zebra")) % len(writable)]
+        created = comp.create_node(Node(id="n", labels=["Zebra"]))
+        assert created.id.split(".")[0] == expect
+
+    def test_no_labels_no_properties_first_writable(self, setup):
+        """TestCompositeEngine_routeWrite_NoLabelsNoProperties"""
+        comp, _, _ = setup
+        created = comp.create_node(Node(id="bare"))
+        assert created.id.split(".")[0] == comp._writables()[0]
+
+
+class TestAccessModes:
+    def test_read_only_constituent_not_routed(self):
+        """TestCompositeEngine_ReadOnlyConstituent — writes skip 'read'
+        constituents and updates to them are refused."""
+        e1, e2 = MemoryEngine(), MemoryEngine()
+        comp = CompositeEngine({"db1": e1, "db2": e2},
+                               access_modes={"db1": "read",
+                                             "db2": "read_write"})
+        for i in range(6):
+            created = comp.create_node(Node(id=f"n{i}",
+                                            labels=[f"L{i}"]))
+            assert created.id.split(".")[0] == "db2"
+        e1.create_node(Node(id="ro", properties={"v": 1}))
+        n = comp.get_node("db1.ro")
+        n.properties["v"] = 2
+        with pytest.raises(NornicError):
+            comp.update_node(n)
+        with pytest.raises(NornicError):
+            comp.delete_node("db1.ro")
+
+    def test_no_writable_constituents(self):
+        """TestCompositeEngine_CreateNode_NoWritableConstituents"""
+        comp = CompositeEngine({"db1": MemoryEngine()},
+                               access_modes={"db1": "read"})
+        with pytest.raises(NornicError):
+            comp.create_node(Node(id="n"))
+
+    def test_invalid_access_mode_rejected(self):
+        with pytest.raises(NornicError):
+            CompositeEngine({"db1": MemoryEngine()},
+                            access_modes={"db1": "sometimes"})
+
+    def test_write_only_constituent_invisible_to_reads(self):
+        """'write' mode means write-ONLY: reads must not see its data
+        (ref: getConstituentsForRead composite_engine.go:112-126)."""
+        e1, e2 = MemoryEngine(), MemoryEngine()
+        e1.create_node(Node(id="hidden", labels=["X"]))
+        e2.create_node(Node(id="visible", labels=["X"]))
+        comp = CompositeEngine({"staging": e1, "main": e2},
+                               access_modes={"staging": "write",
+                                             "main": "read_write"})
+        assert comp.node_count() == 1
+        assert {n.id for n in comp.get_nodes_by_label("X")} == {"main.visible"}
+        with pytest.raises(NotFoundError):
+            comp.get_node("hidden")  # unqualified search skips write-only
+        # ...but writes CAN land there when routed explicitly
+        created = comp.create_node(Node(id="w1", labels=["Staging"]))
+        assert created.id == "staging.w1"
+
+    def test_unmark_pending_embed_respects_read_only(self):
+        e1 = MemoryEngine()
+        e1.create_node(Node(id="n"))
+        e1.mark_pending_embed("n")
+        comp = CompositeEngine({"db1": e1}, access_modes={"db1": "read"})
+        with pytest.raises(NornicError):
+            comp.unmark_pending_embed("db1.n")
+
+
+class TestRoutingHashParity:
+    def test_numeric_database_id_hashes_like_reference(self, setup):
+        """hashValue: integers hash to abs(value), so tenant id 12 with two
+        writables routes to index 12 % 2 == 0 (composite_engine.go:265)."""
+        comp, _, _ = setup
+        writable = comp._writables()
+        created = comp.create_node(Node(
+            id="n12", properties={"database_id": 12}))
+        assert created.id.split(".")[0] == writable[12 % len(writable)]
+        created = comp.create_node(Node(
+            id="n13", properties={"database_id": 13}))
+        assert created.id.split(".")[0] == writable[13 % len(writable)]
+
+    def test_qualified_id_create_honors_prefix(self, setup):
+        """An id qualified for a constituent routes THERE, so the caller's
+        addressed id stays reachable."""
+        comp, _, e2 = setup
+        created = comp.create_node(Node(id="db2.w2"))
+        assert created.id == "db2.w2"
+        assert comp.get_node("db2.w2").id == "db2.w2"
+        assert any(n.id == "w2" for n in e2.all_nodes())
+
+    def test_unqualified_traversal(self, setup):
+        """get_outgoing_edges must resolve unqualified ids like get_node
+        (TestCompositeEngine_GetOutgoingEdges searches constituents)."""
+        comp, e1, _ = setup
+        e1.create_node(Node(id="a"))
+        e1.create_node(Node(id="b"))
+        e1.create_edge(Edge(id="e", start_node="a", end_node="b"))
+        assert len(comp.get_outgoing_edges("a")) == 1
+        assert len(comp.get_incoming_edges("b")) == 1
+
+
+class TestManagerAccessModeWiring:
+    """The manager persists per-constituent access modes and builds the
+    composite engine with them (ref: manager.go:406, ConstituentRef)."""
+
+    def test_access_mode_flows_and_survives_reload(self):
+        from nornicdb_tpu.multidb.manager import DatabaseManager
+
+        base = MemoryEngine()
+        mgr = DatabaseManager(base)
+        mgr.create_database("hot")
+        mgr.create_database("cold")
+        mgr.create_composite("tiered", [])
+        mgr.add_constituent("tiered", "hot", access_mode="read_write")
+        mgr.add_constituent("tiered", "cold", access_mode="read")
+        comp = mgr.get_storage("tiered")
+        # writes never route to the read-only constituent
+        for i in range(6):
+            created = comp.create_node(Node(id=f"n{i}", labels=[f"L{i}"]))
+            assert created.id.split(".")[0] == "hot"
+        # metadata survives a manager reload over the same base engine
+        mgr2 = DatabaseManager(base)
+        comp2 = mgr2.get_storage("tiered")
+        assert comp2.access_modes == {"hot": "read_write", "cold": "read"}
+
+
+class TestReviewPinnedSemantics:
+    def test_write_only_invisible_even_by_qualified_id(self):
+        """Scan and point-read views must agree: a 'write'-only constituent
+        is invisible to reads, qualified or not."""
+        e1 = MemoryEngine()
+        e1.create_node(Node(id="n"))
+        comp = CompositeEngine({"logs": e1}, access_modes={"logs": "write"})
+        with pytest.raises(NotFoundError):
+            comp.get_node("logs.n")
+        # ...but write operations on it work (locate-for-write sees it)
+        got = Node(id="logs.n", properties={"v": 1})
+        comp.update_node(got)
+        assert e1.get_node("n").properties["v"] == 1
+        comp.delete_node("logs.n")
+
+    def test_foreign_edge_prefix_refused(self, setup):
+        comp, e1, _ = setup
+        e1.create_node(Node(id="a"))
+        e1.create_node(Node(id="b"))
+        with pytest.raises(NornicError, match="qualified for"):
+            comp.create_edge(Edge(id="db2.e9", start_node="db1.a",
+                                  end_node="db1.b"))
+
+    def test_add_constituent_invalidates_manager_cache(self):
+        """Mode changes must evict cached engines/executors — a demotion to
+        read-only takes effect immediately (ref: set_limits contract)."""
+        from nornicdb_tpu.multidb.manager import DatabaseManager
+
+        evicted = []
+        base = MemoryEngine()
+        mgr = DatabaseManager(base, on_invalidate=evicted.append)
+        mgr.create_database("hot")
+        mgr.create_composite("c", ["hot"])
+        comp1 = mgr.get_storage("c")
+        assert comp1.access_modes == {"hot": "read_write"}
+        mgr.add_constituent("c", "hot", access_mode="read")
+        assert "c" in evicted
+        comp2 = mgr.get_storage("c")
+        assert comp2 is not comp1
+        assert comp2.access_modes == {"hot": "read"}
+        mgr.remove_constituent("c", "hot")
+        assert evicted.count("c") == 2

@@ -97,8 +97,18 @@ class TestDatabaseManager:
         assert labels == {"east.e1", "west.w1"}
         # routing by qualified id
         assert comp.get_node("east.e1").id == "east.e1"
-        with pytest.raises(NornicError):
-            comp.create_node(Node(id="nope"))
+        # writes route deterministically (ref composite_engine.go routeWrite):
+        # a label matching a constituent alias lands there
+        created = comp.create_node(Node(id="ne1", labels=["east"]))
+        assert created.id == "east.ne1"
+        assert mgr.get_storage("east").get_node("ne1") is not None
+        # database_id property names the target exactly
+        created = comp.create_node(Node(
+            id="nw1", labels=["City"], properties={"database_id": "west"}))
+        assert created.id == "west.nw1"
+        # no labels/properties: deterministic first-writable fallback
+        assert comp.create_node(Node(id="plain")).id.split(".")[0] in (
+            "east", "west")
 
     def test_storage_stats(self):
         mgr = DatabaseManager(MemoryEngine())
