@@ -494,7 +494,9 @@ class DatabaseManager:
             self._databases.discard(name)
             self._engines.pop(name, None)
             self._composites.pop(name, None)
+            self._composite_modes.pop(name, None)
             self._limits.pop(name, None)  # a re-created DB must not inherit
+            self._query_buckets.pop(name, None)
             try:
                 self._system.delete_node(f"db-{name}")
             except NotFoundError:
@@ -520,8 +522,12 @@ class DatabaseManager:
             self._persist_db(name, composite=constituents)
 
     def add_constituent(self, composite: str, database: str,
-                        access_mode: str = "read_write") -> None:
-        if access_mode not in ("read", "write", "read_write"):
+                        access_mode: Optional[str] = None) -> None:
+        """access_mode None = ensure membership, KEEP any configured mode —
+        an idempotent ALTER ... ADD ALIAS re-run must not silently promote
+        a read-only constituent back to read_write."""
+        if access_mode is not None and access_mode not in (
+                "read", "write", "read_write"):
             raise NornicError(
                 "access mode must be 'read', 'write', or 'read_write'")
         with self._lock:
@@ -534,7 +540,8 @@ class DatabaseManager:
                 self._composites[composite].append(database)
                 changed = True
             modes = self._composite_modes.setdefault(composite, {})
-            if modes.get(database, "read_write") != access_mode:
+            if access_mode is not None and \
+                    modes.get(database, "read_write") != access_mode:
                 modes[database] = access_mode
                 changed = True
             if changed:

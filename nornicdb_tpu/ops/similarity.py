@@ -301,6 +301,71 @@ class HostCorpus:
             self._compact()
         return True
 
+    # -- inspection / lifecycle (ref: EmbeddingIndex Has/Get/Clear/Stats/
+    # MemoryUsage/Serialize, pkg/gpu/gpu.go + gpu_test.go:630-800) ---------
+    def has(self, id_: str) -> bool:
+        return id_ in self._slot_of
+
+    def get(self, id_: str) -> Optional[np.ndarray]:
+        """The stored (normalized) vector, or None when absent."""
+        slot = self._slot_of.get(id_)
+        if slot is None:
+            return None
+        return self._host[slot].copy()
+
+    def clear(self) -> None:
+        cap = self.capacity
+        self._ids = []
+        self._slot_of = {}
+        self._host = np.zeros((cap, self.dims), np.float32)
+        self._valid = np.zeros(cap, bool)
+        self._tombstones = 0
+        self._dirty = True
+        self._epoch += 1
+        # slot space was remapped: derived cluster layouts (DeviceCorpus
+        # _assignments/IVF blocks) would index the wrong rows — same reason
+        # _grow/_compact invalidate them
+        clear_clusters = getattr(self, "clear_clusters", None)
+        if callable(clear_clusters):
+            clear_clusters()
+
+    def stats(self) -> dict:
+        return {
+            "count": len(self._slot_of),
+            "capacity": self.capacity,
+            "dims": self.dims,
+            "tombstones": self._tombstones,
+            "epoch": self._epoch,
+            "memory_bytes": self.memory_usage(),
+        }
+
+    def memory_usage(self) -> int:
+        return int(self._host.nbytes + self._valid.nbytes)
+
+    def save(self, path: str) -> None:
+        """Persist live ids + vectors (tombstones are not serialized —
+        matches the reference's compact-on-serialize behavior)."""
+        live = [(i, id_) for i, id_ in enumerate(self._ids)
+                if id_ is not None]
+        ids = np.asarray([id_ for _, id_ in live])
+        vecs = (self._host[[i for i, _ in live]]
+                if live else np.zeros((0, self.dims), np.float32))
+        np.savez_compressed(path, ids=ids, vectors=vecs,
+                            dims=np.asarray(self.dims))
+
+    @classmethod
+    def load(cls, path: str, **kwargs) -> "HostCorpus":
+        with np.load(path, allow_pickle=False) as data:
+            if any(k not in data for k in ("vectors", "ids", "dims")):
+                raise ValueError(f"{path} is not a corpus checkpoint")
+            dims = int(data["dims"])
+            out = cls(dims=dims, **kwargs)
+            vecs = data["vectors"]
+            ids = [str(i) for i in data["ids"]]
+            if ids:
+                out.add_batch(ids, vecs)
+        return out
+
     def _grow(self, min_capacity: int = 0) -> None:
         need = max(self.capacity * 2, min_capacity, self.align)
         new_cap = ((need + self.align - 1) // self.align) * self.align

@@ -299,3 +299,31 @@ class TestReviewPinnedSemantics:
         assert comp2.access_modes == {"hot": "read"}
         mgr.remove_constituent("c", "hot")
         assert evicted.count("c") == 2
+
+
+class TestDropRecreateHygiene:
+    def test_recreated_composite_does_not_inherit_modes(self):
+        from nornicdb_tpu.multidb.manager import DatabaseManager
+
+        base = MemoryEngine()
+        mgr = DatabaseManager(base)
+        mgr.create_database("hot")
+        mgr.create_composite("c", [])
+        mgr.add_constituent("c", "hot", access_mode="read")
+        mgr.drop_database("c")
+        mgr.create_composite("c", ["hot"])
+        comp = mgr.get_storage("c")
+        assert comp.access_modes == {"hot": "read_write"}
+        comp.create_node(Node(id="ok"))  # writable again
+
+    def test_membership_rerun_keeps_configured_mode(self):
+        """An idempotent ADD ALIAS re-run (no explicit mode) must not
+        promote a read-only constituent back to read_write."""
+        from nornicdb_tpu.multidb.manager import DatabaseManager
+
+        mgr = DatabaseManager(MemoryEngine())
+        mgr.create_database("hot")
+        mgr.create_composite("c", [])
+        mgr.add_constituent("c", "hot", access_mode="read")
+        mgr.add_constituent("c", "hot")  # membership-only re-run
+        assert mgr.get_storage("c").access_modes == {"hot": "read"}

@@ -415,3 +415,84 @@ class TestStreamingTopK:
         assert a[0][0][0] == "v7"
         assert abs(a[0][0][1] - 1.0) < 0.02
         assert "v8" not in {id_ for id_, _ in a[0]}
+
+
+class TestCorpusLifecycle:
+    """ref: gpu_test.go:630-800 — EmbeddingIndex Has/Get/Clear/Stats/
+    MemoryUsage/Serialize/Deserialize."""
+
+    def _corpus(self):
+        from nornicdb_tpu.ops.similarity import DeviceCorpus
+
+        c = DeviceCorpus(dims=4)
+        c.add("a", np.array([1, 0, 0, 0], np.float32))
+        c.add("b", np.array([0, 1, 0, 0], np.float32))
+        return c
+
+    def test_has_and_get(self):
+        c = self._corpus()
+        assert c.has("a") and not c.has("zz")
+        v = c.get("a")
+        assert v is not None and abs(float(v[0]) - 1.0) < 1e-6
+        assert c.get("zz") is None
+        c.remove("a")
+        assert not c.has("a") and c.get("a") is None
+
+    def test_clear(self):
+        c = self._corpus()
+        c.clear()
+        assert len(c) == 0 and not c.has("a")
+        # usable after clear
+        c.add("x", np.array([0, 0, 1, 0], np.float32))
+        assert c.search(np.array([0, 0, 1, 0], np.float32), k=1)[0][0][0] == "x"
+
+    def test_stats_and_memory(self):
+        c = self._corpus()
+        s = c.stats()
+        assert s["count"] == 2 and s["dims"] == 4
+        assert s["memory_bytes"] == c.memory_usage() > 0
+        c.remove("a")
+        assert c.stats()["count"] == 1
+
+    def test_save_load_roundtrip(self, tmp_path):
+        from nornicdb_tpu.ops.similarity import DeviceCorpus
+
+        c = self._corpus()
+        c.add("c", np.array([0, 0, 1, 0], np.float32))
+        c.remove("b")  # tombstones must not round-trip
+        path = str(tmp_path / "corpus.npz")
+        c.save(path)
+        loaded = DeviceCorpus.load(path)
+        assert len(loaded) == 2
+        assert loaded.has("a") and loaded.has("c") and not loaded.has("b")
+        hits = loaded.search(np.array([0, 0, 1, 0], np.float32), k=1)[0]
+        assert hits[0][0] == "c"
+
+    def test_save_empty_and_bad_file(self, tmp_path):
+        from nornicdb_tpu.ops.similarity import DeviceCorpus
+
+        c = DeviceCorpus(dims=4)
+        path = str(tmp_path / "empty.npz")
+        c.save(path)
+        assert len(DeviceCorpus.load(path)) == 0
+        bad = tmp_path / "bad.npz"
+        np.savez_compressed(str(bad), junk=np.zeros(3))
+        with pytest.raises(ValueError):
+            DeviceCorpus.load(str(bad))
+
+    def test_clear_invalidates_clusters(self):
+        """clear() remaps the slot space: stale cluster assignments would
+        prune re-added vectors into the wrong buckets."""
+        from nornicdb_tpu.ops.similarity import DeviceCorpus
+
+        rng = np.random.default_rng(1)
+        c = DeviceCorpus(dims=8)
+        c.add_batch([f"o{i}" for i in range(40)],
+                    rng.normal(size=(40, 8)).astype(np.float32))
+        c.cluster(k=4)
+        c.clear()
+        assert c._centroids is None and c._assignments is None
+        c.add("fresh", np.array([1, 0, 0, 0, 0, 0, 0, 0], np.float32))
+        hits = c.search(np.array([1, 0, 0, 0, 0, 0, 0, 0], np.float32),
+                        k=1, n_probe=2)[0]
+        assert hits and hits[0][0] == "fresh"

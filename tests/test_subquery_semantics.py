@@ -1,0 +1,401 @@
+"""Port of pkg/cypher/subquery_test.go (2,216 LoC) — exact-result pinning
+for the three subquery families (EXISTS { }, COUNT { }, CALL { }) plus
+COLLECT { }: comparison operators, direction, correlation with the outer
+row, UNION inside CALL, writes inside CALL, aggregation isolation,
+whitespace robustness, and parameters.
+"""
+
+import pytest
+
+from nornicdb_tpu.cypher import CypherExecutor
+from nornicdb_tpu.storage import MemoryEngine, NamespacedEngine
+
+
+@pytest.fixture
+def ex():
+    """Alice -KNOWS-> Bob, Charlie, Dave; Bob -KNOWS-> Charlie;
+    Eve is isolated. Alice -WORKS_AT-> Acme."""
+    e = CypherExecutor(NamespacedEngine(MemoryEngine(), "test"))
+    e.execute("""
+        CREATE (a:Person {name: 'Alice', age: 30}),
+               (b:Person {name: 'Bob', age: 25}),
+               (c:Person {name: 'Charlie', age: 35}),
+               (d:Person {name: 'Dave', age: 28}),
+               (e:Person {name: 'Eve', age: 22}),
+               (co:Company {name: 'Acme'}),
+               (a)-[:KNOWS]->(b), (a)-[:KNOWS]->(c), (a)-[:KNOWS]->(d),
+               (b)-[:KNOWS]->(c),
+               (a)-[:WORKS_AT]->(co)
+    """)
+    return e
+
+
+def names(r):
+    return sorted(row[0] for row in r.rows)
+
+
+class TestCountSubquery:
+    """TestCountSubquery* — every comparison operator, both directions."""
+
+    def test_greater_than(self, ex):
+        r = ex.execute("""
+            MATCH (p:Person)
+            WHERE COUNT { MATCH (p)-[:KNOWS]->(other) } > 2
+            RETURN p.name
+        """)
+        assert names(r) == ["Alice"]
+
+    def test_equals(self, ex):
+        r = ex.execute("""
+            MATCH (p:Person)
+            WHERE COUNT { MATCH (p)-[:KNOWS]->(other) } = 1
+            RETURN p.name
+        """)
+        assert names(r) == ["Bob"]
+
+    def test_zero(self, ex):
+        r = ex.execute("""
+            MATCH (p:Person)
+            WHERE COUNT { MATCH (p)-[:KNOWS]->(other) } = 0
+            RETURN p.name
+        """)
+        assert names(r) == ["Charlie", "Dave", "Eve"]
+
+    def test_gte_lte_lt_ne(self, ex):
+        gte = ex.execute("MATCH (p:Person) WHERE COUNT { MATCH (p)-[:KNOWS]->(o) } >= 1 RETURN p.name")
+        assert names(gte) == ["Alice", "Bob"]
+        lte = ex.execute("MATCH (p:Person) WHERE COUNT { MATCH (p)-[:KNOWS]->(o) } <= 1 RETURN p.name")
+        assert names(lte) == ["Bob", "Charlie", "Dave", "Eve"]
+        lt = ex.execute("MATCH (p:Person) WHERE COUNT { MATCH (p)-[:KNOWS]->(o) } < 1 RETURN p.name")
+        assert names(lt) == ["Charlie", "Dave", "Eve"]
+        ne = ex.execute("MATCH (p:Person) WHERE COUNT { MATCH (p)-[:KNOWS]->(o) } <> 0 RETURN p.name")
+        assert names(ne) == ["Alice", "Bob"]
+
+    def test_incoming_direction(self, ex):
+        """TestCountSubqueryIncoming — Charlie is known by Alice AND Bob."""
+        r = ex.execute("""
+            MATCH (p:Person)
+            WHERE COUNT { MATCH (p)<-[:KNOWS]-(other) } = 2
+            RETURN p.name
+        """)
+        assert names(r) == ["Charlie"]
+
+    def test_multiple_rel_types(self, ex):
+        """TestCountSubqueryMultipleRelTypes"""
+        r = ex.execute("""
+            MATCH (p:Person)
+            WHERE COUNT { MATCH (p)-[:KNOWS|WORKS_AT]->(x) } = 4
+            RETURN p.name
+        """)
+        assert names(r) == ["Alice"]
+
+    def test_in_expression_position(self, ex):
+        """TestCountSubqueryInExpression — COUNT {} as a RETURN value."""
+        r = ex.execute("""
+            MATCH (p:Person {name: 'Alice'})
+            RETURN COUNT { MATCH (p)-[:KNOWS]->(o) } AS friends
+        """)
+        assert r.rows == [[3]]
+
+    def test_zero_matches_is_zero_not_null(self, ex):
+        """TestCountSubqueryWithZeroMatches"""
+        r = ex.execute("""
+            MATCH (p:Person {name: 'Eve'})
+            RETURN COUNT { MATCH (p)-[:KNOWS]->(o) } AS friends
+        """)
+        assert r.rows == [[0]]
+
+
+class TestExistsSubquery:
+    def test_multiple_rel_types(self, ex):
+        """TestExistsSubqueryMultipleRelTypes"""
+        r = ex.execute("""
+            MATCH (p:Person)
+            WHERE EXISTS { MATCH (p)-[:KNOWS|WORKS_AT]->(x) }
+            RETURN p.name
+        """)
+        assert names(r) == ["Alice", "Bob"]
+
+    def test_bidirectional(self, ex):
+        """TestExistsSubqueryBidirectional — everyone connected by KNOWS."""
+        r = ex.execute("""
+            MATCH (p:Person)
+            WHERE EXISTS { MATCH (p)-[:KNOWS]-(x) }
+            RETURN p.name
+        """)
+        assert names(r) == ["Alice", "Bob", "Charlie", "Dave"]
+
+    def test_specific_label(self, ex):
+        """TestExistsSubqueryWithSpecificLabel"""
+        r = ex.execute("""
+            MATCH (p:Person)
+            WHERE EXISTS { MATCH (p)-[:WORKS_AT]->(c:Company) }
+            RETURN p.name
+        """)
+        assert names(r) == ["Alice"]
+
+    def test_not_exists(self, ex):
+        """TestNotExistsSubqueryMultipleRelTypes / SpecificType"""
+        r = ex.execute("""
+            MATCH (p:Person)
+            WHERE NOT EXISTS { MATCH (p)-[:KNOWS]->(x) }
+            RETURN p.name
+        """)
+        assert names(r) == ["Charlie", "Dave", "Eve"]
+
+    def test_exists_with_where_property_comparison(self, ex):
+        """TestExistsSubqueryWithWherePropertyComparison — the inner WHERE
+        correlates inner and outer rows."""
+        r = ex.execute("""
+            MATCH (p:Person)
+            WHERE EXISTS { MATCH (p)-[:KNOWS]->(o) WHERE o.age > p.age }
+            RETURN p.name
+        """)
+        # Alice(30) knows Charlie(35); Bob(25) knows Charlie(35)
+        assert names(r) == ["Alice", "Bob"]
+
+    def test_empty_graph_exists_false(self):
+        """TestExistsSubqueryEmptyResult"""
+        e = CypherExecutor(MemoryEngine())
+        e.execute("CREATE (:Lone {name: 'solo'})")
+        r = e.execute("""
+            MATCH (p:Lone)
+            WHERE EXISTS { MATCH (p)-[:ANY]->(x) }
+            RETURN p.name
+        """)
+        assert r.rows == []
+
+    def test_combined_exists_and_count(self, ex):
+        """TestCombinedExistsAndCount + TestMultipleSubqueriesInWhere"""
+        r = ex.execute("""
+            MATCH (p:Person)
+            WHERE EXISTS { MATCH (p)-[:WORKS_AT]->(c) }
+              AND COUNT { MATCH (p)-[:KNOWS]->(o) } >= 3
+            RETURN p.name
+        """)
+        assert names(r) == ["Alice"]
+
+    def test_exists_or_not_exists(self, ex):
+        """TestExistsOrNotExists"""
+        r = ex.execute("""
+            MATCH (p:Person)
+            WHERE EXISTS { MATCH (p)-[:WORKS_AT]->(c) }
+               OR NOT EXISTS { MATCH (p)-[:KNOWS]-(x) }
+            RETURN p.name
+        """)
+        assert names(r) == ["Alice", "Eve"]
+
+
+class TestCallSubquery:
+    def test_basic(self, ex):
+        """TestCallSubqueryBasic"""
+        r = ex.execute("""
+            CALL { MATCH (p:Person) RETURN p.name AS name }
+            RETURN name ORDER BY name
+        """)
+        assert [row[0] for row in r.rows] == [
+            "Alice", "Bob", "Charlie", "Dave", "Eve"]
+
+    def test_correlated_with_outer_match(self, ex):
+        """TestCallSubqueryWithOuterMatch — importing WITH binds the row."""
+        r = ex.execute("""
+            MATCH (p:Person {name: 'Alice'})
+            CALL {
+                WITH p
+                MATCH (p)-[:KNOWS]->(f)
+                RETURN f.name AS friend
+            }
+            RETURN friend ORDER BY friend
+        """)
+        assert [row[0] for row in r.rows] == ["Bob", "Charlie", "Dave"]
+
+    def test_union_inside_call(self, ex):
+        """TestCallSubqueryUnion"""
+        r = ex.execute("""
+            CALL {
+                MATCH (p:Person) RETURN p.name AS name
+                UNION
+                MATCH (c:Company) RETURN c.name AS name
+            }
+            RETURN name ORDER BY name
+        """)
+        assert r.columns == ["name"]
+        assert [row[0] for row in r.rows] == [
+            "Acme", "Alice", "Bob", "Charlie", "Dave", "Eve"]
+
+    def test_union_all_and_rename(self, ex):
+        r = ex.execute("""
+            CALL {
+                MATCH (p:Person) RETURN p.name AS name
+                UNION ALL
+                MATCH (c:Company) RETURN c.name AS name
+            }
+            RETURN name AS entityName ORDER BY entityName
+        """)
+        assert r.columns == ["entityName"]
+        assert len(r.rows) == 6
+
+    def test_aggregation_isolated_per_row(self, ex):
+        """TestCallSubqueryWithAggregation — the inner aggregate runs once
+        per outer row, not globally."""
+        r = ex.execute("""
+            MATCH (p:Person)
+            CALL {
+                WITH p
+                MATCH (p)-[:KNOWS]->(f)
+                RETURN count(f) AS friends
+            }
+            RETURN p.name, friends ORDER BY p.name
+        """)
+        assert r.rows == [["Alice", 3], ["Bob", 1], ["Charlie", 0],
+                          ["Dave", 0], ["Eve", 0]]
+
+    def test_multiple_columns(self, ex):
+        """TestCallSubqueryMultipleColumns"""
+        r = ex.execute("""
+            CALL {
+                MATCH (p:Person {name: 'Alice'})
+                RETURN p.name AS name, p.age AS age
+            }
+            RETURN name, age
+        """)
+        assert r.rows == [["Alice", 30]]
+
+    def test_write_inside_call(self, ex):
+        """TestCallSubqueryWithCreate / WithMerge"""
+        ex.execute("""
+            MATCH (p:Person {name: 'Eve'})
+            CALL {
+                WITH p
+                CREATE (p)-[:OWNS]->(:Pet {name: 'Rex'})
+            }
+            RETURN p
+        """)
+        r = ex.execute("MATCH (:Person {name: 'Eve'})-[:OWNS]->(pet) RETURN pet.name")
+        assert r.rows == [["Rex"]]
+
+    def test_delete_inside_call(self, ex):
+        """TestCallSubqueryWithDelete"""
+        ex.execute("CREATE (:Temp {id: 1}), (:Temp {id: 2})")
+        ex.execute("""
+            MATCH (t:Temp)
+            CALL { WITH t DELETE t }
+            RETURN count(*)
+        """)
+        assert ex.execute("MATCH (t:Temp) RETURN count(t)").rows == [[0]]
+
+    def test_order_by_skip_tails(self, ex):
+        """TestCallSubqueryWithSkip / WithOrderByOnly"""
+        r = ex.execute("""
+            CALL {
+                MATCH (p:Person)
+                RETURN p.name AS name
+                ORDER BY name
+                SKIP 2
+            }
+            RETURN name
+        """)
+        assert [row[0] for row in r.rows] == ["Charlie", "Dave", "Eve"]
+
+    def test_unwind_inside_call(self, ex):
+        """TestCallSubqueryWithUnwind"""
+        r = ex.execute("""
+            CALL { UNWIND [3, 1, 2] AS x RETURN x ORDER BY x }
+            RETURN x
+        """)
+        assert [row[0] for row in r.rows] == [1, 2, 3]
+
+    def test_optional_match_inside_call(self, ex):
+        """TestCallSubqueryWithOptionalMatch"""
+        r = ex.execute("""
+            MATCH (p:Person {name: 'Eve'})
+            CALL {
+                WITH p
+                OPTIONAL MATCH (p)-[:KNOWS]->(f)
+                RETURN f.name AS friend
+            }
+            RETURN p.name, friend
+        """)
+        assert r.rows == [["Eve", None]]
+
+    def test_nested_call(self, ex):
+        """TestCallSubqueryNested"""
+        r = ex.execute("""
+            CALL {
+                MATCH (p:Person {name: 'Alice'})
+                CALL {
+                    WITH p
+                    MATCH (p)-[:KNOWS]->(f)
+                    RETURN count(f) AS inner_count
+                }
+                RETURN p.name AS name, inner_count
+            }
+            RETURN name, inner_count
+        """)
+        assert r.rows == [["Alice", 3]]
+
+    def test_empty_inner_result(self, ex):
+        """TestCallSubqueryEmptyResult — rows with no inner matches drop."""
+        r = ex.execute("""
+            MATCH (p:Person {name: 'Eve'})
+            CALL {
+                WITH p
+                MATCH (p)-[:KNOWS]->(f)
+                RETURN f.name AS friend
+            }
+            RETURN friend
+        """)
+        assert r.rows == []
+
+
+class TestCollectSubquery:
+    def test_collect(self, ex):
+        """TestCollectSubquery"""
+        r = ex.execute("""
+            MATCH (p:Person {name: 'Alice'})
+            RETURN COLLECT { MATCH (p)-[:KNOWS]->(f) RETURN f.name } AS friends
+        """)
+        assert sorted(r.rows[0][0]) == ["Bob", "Charlie", "Dave"]
+
+
+class TestSubqueryWhitespace:
+    """TestExistsSubqueryWithNewlines/Tabs, TestSubqueryMinimalWhitespace,
+    TestCountSubqueryNoSpaceBeforeBrace, TestCallSubqueryOnSingleLine."""
+
+    def test_newlines_and_tabs(self, ex):
+        r = ex.execute("MATCH (p:Person)\nWHERE\tEXISTS\n{\n\tMATCH (p)-[:WORKS_AT]->(c)\n}\nRETURN p.name")
+        assert names(r) == ["Alice"]
+
+    def test_no_space_before_brace(self, ex):
+        r = ex.execute("MATCH (p:Person) WHERE COUNT{ MATCH (p)-[:KNOWS]->(o) } > 2 RETURN p.name")
+        assert names(r) == ["Alice"]
+        r = ex.execute("MATCH (p:Person) WHERE EXISTS{ MATCH (p)-[:WORKS_AT]->(c) } RETURN p.name")
+        assert names(r) == ["Alice"]
+
+    def test_call_on_single_line(self, ex):
+        r = ex.execute("CALL { MATCH (p:Person) RETURN count(p) AS n } RETURN n")
+        assert r.rows == [[5]]
+
+
+class TestSubqueryParameters:
+    def test_parameters_inside_subqueries(self, ex):
+        """TestSubqueriesWithParameters"""
+        r = ex.execute("""
+            MATCH (p:Person)
+            WHERE COUNT { MATCH (p)-[:KNOWS]->(o) WHERE o.age > $minAge } >= $minFriends
+            RETURN p.name
+        """, {"minAge": 24, "minFriends": 2})
+        assert names(r) == ["Alice"]
+
+    def test_nested_exists(self, ex):
+        """TestNestedExistsSubquery — a person who knows someone who knows
+        someone."""
+        r = ex.execute("""
+            MATCH (p:Person)
+            WHERE EXISTS {
+                MATCH (p)-[:KNOWS]->(f)
+                WHERE EXISTS { MATCH (f)-[:KNOWS]->(g) }
+            }
+            RETURN p.name
+        """)
+        assert names(r) == ["Alice"]
