@@ -109,8 +109,41 @@ class QwenGenerator(Generator):
         ids = self.tokenizer.encode(prompt, add_special=False)[-256:] or [1]
         out = self.qwen2.generate(
             self.params, self.cfg, ids, max_new_tokens=max_tokens,
+            eos_id=getattr(self.tokenizer, "eos_id", -1),
         )
         return self.tokenizer.decode(out)
+
+    def generate_stream(self, prompt: str, max_tokens: int = 128):
+        """TRUE incremental decode (ref: GenerationModel streaming,
+        llama.go:748 + generate.go): prefill once, then one jitted
+        decode_step per yielded delta. Deltas are text diffs of the running
+        decode so any tokenizer's spacing/punctuation rules hold."""
+        import jax.numpy as jnp
+
+        ids = self.tokenizer.encode(prompt, add_special=False)[-256:] or [1]
+        max_len = len(ids) + max_tokens
+        logits, caches = self.qwen2.prefill(
+            self.params, self.cfg, jnp.asarray([ids], jnp.int32), max_len
+        )
+        eos = getattr(self.tokenizer, "eos_id", -1)
+        tok = int(jnp.argmax(logits, axis=-1)[0])
+        out: list[int] = []
+        prev_text = ""
+        pos = len(ids)
+        while len(out) < max_tokens and tok != eos:
+            out.append(tok)
+            text = self.tokenizer.decode(out)
+            if text != prev_text:
+                yield text[len(prev_text):]
+                prev_text = text
+            if len(out) >= max_tokens:
+                break
+            logits, caches = self.qwen2.decode_step(
+                self.params, self.cfg, jnp.asarray([tok], jnp.int32),
+                caches, jnp.asarray(pos),
+            )
+            tok = int(jnp.argmax(logits, axis=-1)[0])
+            pos += 1
 
 
 class TemplateGenerator(Generator):
@@ -207,6 +240,10 @@ class HeimdallManager:
         # a PluginHost installs itself here so chat-path actions run through
         # the pre/post-execute hooks (incl. veto)
         self.action_dispatcher: Optional[Callable[[dict], Any]] = None
+        # identity until a PluginHost installs pre_prompt hooks; the
+        # streaming path routes prompts through this so stream=true cannot
+        # evade plugin redaction/veto guards
+        self.pre_prompt_transform: Callable[[str], str] = lambda p: p
         self.plugin_host = None  # set by PluginHost.__init__
         self._lock = threading.Lock()
         # built-in actions (ref: plugins/heimdall reference plugin actions)
@@ -468,7 +505,41 @@ class HeimdallManager:
                     ) -> Iterator[dict]:
         """Streaming chunks (ref: streaming handler.go:561; queued
         notifications are flushed ahead of content chunks to preserve
-        ordering, ref: notificationQueue types.go:321-324)."""
+        ordering, ref: notificationQueue types.go:321-324).
+
+        Generators that implement a REAL generate_stream (the Qwen decode
+        loop) stream token deltas as produced; the accumulated text is
+        action-sniffed at the end like the reference's buffered streaming
+        handler. Template/backoff generators fall back to word-chunking
+        the full response."""
+        generator = self.generator
+        if model and model not in ("heimdall", ""):
+            try:
+                maybe = self.models.acquire(model)
+                msg = f"model {model!r} has no loaded backend"
+            except KeyError:
+                maybe = None
+                msg = f"model {model!r} not found"
+            if maybe is None:
+                # unknown or unloaded model: same error contract as chat(),
+                # never a silent fallback to the default backend
+                yield {
+                    "object": "chat.completion.chunk",
+                    "choices": [],
+                    "error": {"message": msg,
+                              "type": "invalid_request_error"},
+                }
+                return
+            generator = maybe
+        else:
+            self.models.acquire("heimdall")  # last_used bookkeeping
+        streams_natively = (
+            type(generator).generate_stream is not Generator.generate_stream
+        )
+        if streams_natively:
+            yield from self._chat_stream_native(
+                generator, messages, max_tokens, model)
+            return
         full = self.chat(messages, max_tokens, model=model)
         if "choices" not in full:
             # error response (unknown model etc.): one error event, done
@@ -498,6 +569,72 @@ class HeimdallManager:
                     }
                 ],
             }
+        yield {
+            "object": "chat.completion.chunk",
+            "choices": [{"index": 0, "delta": {}, "finish_reason": "stop"}],
+        }
+
+    def _chat_stream_native(self, generator, messages, max_tokens, model
+                            ) -> Iterator[dict]:
+        ctx = self.build_context(messages)
+        if ctx.cancelled:
+            yield {
+                "object": "chat.completion.chunk",
+                "choices": [],
+                "error": {"message": f"Request cancelled: {ctx.cancel_reason}"},
+            }
+            return
+        for note in [vars(n) for n in ctx.drain_notifications()]:
+            yield {"object": "chat.completion.chunk", "choices": [],
+                   "notification": note}
+        prompt_parts = [ctx.build_final_prompt()]
+        for m in messages:
+            prompt_parts.append(f"{m.get('role', 'user')}: {m.get('content', '')}")
+        prompt_parts.append("assistant:")
+        # plugin guards (redaction, veto) apply to streamed prompts too
+        prompt = self.pre_prompt_transform("\n".join(prompt_parts))
+        pieces: list[str] = []
+        t0 = time.time()
+        try:
+            for delta in generator.generate_stream(prompt, max_tokens):
+                pieces.append(delta)
+                yield {
+                    "object": "chat.completion.chunk",
+                    "choices": [{"index": 0, "delta": {"content": delta},
+                                 "finish_reason": None}],
+                }
+        except Exception as e:  # noqa: BLE001 — headers are already sent;
+            # the client must see a terminal error event, not a cut stream
+            self.metrics.errors += 1
+            yield {"object": "chat.completion.chunk", "choices": [],
+                   "error": {"message": str(e)}}
+            yield {"object": "chat.completion.chunk",
+                   "choices": [{"index": 0, "delta": {},
+                                "finish_reason": "error"}]}
+            return
+        text = "".join(pieces)
+        self.metrics.generations += 1
+        self.metrics.tokens_generated += estimate_tokens(text)
+        self.metrics.total_latency += time.time() - t0
+        self.metrics_registry.inc("chat_requests")
+        self.metrics_registry.inc("prompt_tokens", estimate_tokens(prompt))
+        self.metrics_registry.inc("completion_tokens", estimate_tokens(text))
+        self.bifrost.broadcast("chat", {"content": text[:200]})
+        # buffered action sniff over the COMPLETE text, like the reference's
+        # streaming handler (tryParseAction handler.go:516)
+        action = self.try_parse_action(text)
+        if action is not None:
+            fn = self._actions.get(str(action.get("action")))
+            dispatch = self.action_dispatcher or (
+                (lambda a: fn(a.get("params") or {})) if fn else None)
+            if dispatch is not None:
+                try:
+                    result = dispatch(action)
+                    self.metrics.actions_executed += 1
+                except Exception as e:  # noqa: BLE001 — surfaced to client
+                    result = {"error": str(e)}
+                yield {"object": "chat.completion.chunk", "choices": [],
+                       "action_result": _brief(result, 2000)}
         yield {
             "object": "chat.completion.chunk",
             "choices": [{"index": 0, "delta": {}, "finish_reason": "stop"}],

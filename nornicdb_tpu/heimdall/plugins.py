@@ -176,8 +176,7 @@ class PluginHost:
         mgr.action_dispatcher = self.run_action  # chat-path actions get hooks
         original_generate = mgr.generate
 
-        def generate_with_hooks(prompt: str, max_tokens: int = 128,
-                                **kwargs) -> str:
+        def apply_pre_prompt(prompt: str) -> str:
             with self._lock:
                 plugins = list(self._plugins.values())
             for p in plugins:
@@ -185,9 +184,18 @@ class PluginHost:
                     prompt = p.pre_prompt(prompt)
                 except Exception:
                     pass
-            return original_generate(prompt, max_tokens, **kwargs)
+            return prompt
+
+        def generate_with_hooks(prompt: str, max_tokens: int = 128,
+                                **kwargs) -> str:
+            return original_generate(apply_pre_prompt(prompt), max_tokens,
+                                     **kwargs)
 
         mgr.generate = generate_with_hooks  # type: ignore[method-assign]
+        # the streaming path builds its own prompt and calls the backend's
+        # generate_stream — it must apply the SAME guards (redaction, veto)
+        # or stream=true would evade them
+        mgr.pre_prompt_transform = apply_pre_prompt
 
         # PromptContext hooks (ref: PrePrompt with *PromptContext):
         # every plugin gets a chance to mutate/cancel the request context

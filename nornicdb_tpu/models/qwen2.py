@@ -195,16 +195,8 @@ def decode(
 
     def step(carry, _):
         tok, caches, pos, key, done = carry
-        h = params["tok_emb"][tok[:, None]]  # (B, 1, H)
-        angles = jax.lax.dynamic_slice(full_angles, (pos, 0), (1, full_angles.shape[1]))
-        k_pos = jax.lax.broadcasted_iota(jnp.int32, (1, max_len), 1)
-        mask = jnp.where(k_pos <= pos, 0.0, -1e30)[None, None]
-        new_caches = []
-        for blk, cache in zip(params["blocks"], caches):
-            h, cache = _block(cfg, blk, h, angles, mask, kv_cache=cache, pos=pos)
-            new_caches.append(cache)
-        h = rms_norm(params["final_norm"], h, cfg.rms_eps)
-        logits = _logits(params, cfg, h)[:, 0, :]
+        logits, new_caches = _cached_step(
+            params, cfg, tok, caches, pos, full_angles)
         key, sub = jax.random.split(key)
         if temperature > 0:
             nxt = jax.random.categorical(sub, logits / temperature, axis=-1)
@@ -217,6 +209,37 @@ def decode(
     init = (first_token, caches, start_pos, key, jnp.zeros((b,), bool))
     _, toks = jax.lax.scan(step, init, None, length=steps)
     return jnp.transpose(toks)  # (B, steps)
+
+
+def _cached_step(params, cfg: QwenConfig, token: jax.Array, caches,
+                 pos: jax.Array, full_angles: jax.Array):
+    """Shared single-token cached decoder body — the ONE implementation
+    behind both decode()'s scan and the streaming decode_step, so the
+    mask/rope slicing can never diverge between the two paths."""
+    max_len = caches[0][0].shape[1]
+    h = params["tok_emb"][token[:, None]]
+    angles = jax.lax.dynamic_slice(
+        full_angles, (pos, 0), (1, full_angles.shape[1]))
+    k_pos = jax.lax.broadcasted_iota(jnp.int32, (1, max_len), 1)
+    mask = jnp.where(k_pos <= pos, 0.0, -1e30)[None, None]
+    new_caches = []
+    for blk, cache in zip(params["blocks"], caches):
+        h, cache = _block(cfg, blk, h, angles, mask, kv_cache=cache, pos=pos)
+        new_caches.append(cache)
+    h = rms_norm(params["final_norm"], h, cfg.rms_eps)
+    return _logits(params, cfg, h)[:, 0, :], new_caches
+
+
+@functools.partial(jax.jit, static_argnames=("cfg",))
+def decode_step(params, cfg: QwenConfig, token: jax.Array, caches,
+                pos: jax.Array):
+    """ONE cached decode step: (B,) token at position `pos` -> ((B, V)
+    logits, advanced caches). The streaming generation path
+    (heimdall QwenGenerator.generate_stream) calls this per yielded token;
+    the jit caches one program per max_len bucket."""
+    max_len = caches[0][0].shape[1]
+    full_angles = rope_freqs(cfg.hidden // cfg.heads, max_len, cfg.rope_theta)
+    return _cached_step(params, cfg, token, caches, pos, full_angles)
 
 
 def generate(

@@ -380,3 +380,71 @@ class TestHttpSurface:
         ) as resp:
             text = resp.read().decode()
         assert "heimdall_chat_requests" in text
+
+
+class TestStreamingPluginGuards:
+    """stream=true must not evade pre_prompt hooks (review finding: the
+    native streaming path builds its own prompt)."""
+
+    def test_pre_prompt_applies_to_native_stream(self, db):
+        from nornicdb_tpu.heimdall import HeimdallManager
+        from nornicdb_tpu.heimdall.manager import Generator
+        from nornicdb_tpu.heimdall.plugins import PluginHost
+
+        seen = {}
+
+        class EchoStream(Generator):
+            def generate(self, prompt, max_tokens=128):
+                return "full"
+
+            def generate_stream(self, prompt, max_tokens=128):
+                seen["prompt"] = prompt
+                yield "chunk"
+
+        class Redactor:
+            name = "redactor"
+
+            def pre_prompt(self, prompt):
+                return prompt.replace("SECRET", "[redacted]")
+
+        mgr = HeimdallManager(EchoStream(), db=db)
+        host = PluginHost(mgr)
+        host._plugins["redactor"] = Redactor()
+        host._install_hooks()
+        list(mgr.chat_stream([{"role": "user", "content": "tell SECRET"}]))
+        assert "SECRET" not in seen["prompt"]
+        assert "[redacted]" in seen["prompt"]
+
+    def test_stream_error_event_on_backend_failure(self, db):
+        from nornicdb_tpu.heimdall import HeimdallManager
+        from nornicdb_tpu.heimdall.manager import Generator
+
+        class Exploder(Generator):
+            def generate(self, prompt, max_tokens=128):
+                return "x"
+
+            def generate_stream(self, prompt, max_tokens=128):
+                yield "partial"
+                raise RuntimeError("decode blew up")
+
+        mgr = HeimdallManager(Exploder(), db=db)
+        chunks = list(mgr.chat_stream([{"role": "user", "content": "x"}]))
+        assert any("error" in c for c in chunks)
+        assert chunks[-1]["choices"][0]["finish_reason"] == "error"
+        assert mgr.metrics.errors == 1
+
+    def test_unknown_model_streams_error_not_fallback(self, db):
+        from nornicdb_tpu.heimdall import HeimdallManager
+        from nornicdb_tpu.heimdall.manager import Generator
+
+        class Native(Generator):
+            def generate(self, prompt, max_tokens=128):
+                return "x"
+
+            def generate_stream(self, prompt, max_tokens=128):
+                yield "should not run"
+
+        mgr = HeimdallManager(Native(), db=db)
+        chunks = list(mgr.chat_stream([{"role": "user", "content": "x"}],
+                                      model="ghost"))
+        assert len(chunks) == 1 and "error" in chunks[0]

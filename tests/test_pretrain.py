@@ -198,3 +198,31 @@ class TestDistillation:
         teacher_dir, _ = assistant_ckpt
         with pytest.raises(ValueError):
             pretrain.distill_encoder(teacher_dir, str(tmp_path / "x"))
+
+
+class TestTokenStreaming:
+    """Real incremental decode (ref: GenerationModel streaming path +
+    handler.go:561 buffered streaming): deltas arrive token-by-token and
+    concatenate to exactly the non-streaming output."""
+
+    def test_stream_deltas_match_generate(self, assistant_ckpt):
+        ckpt_dir, _ = assistant_ckpt
+        gen = pretrain.load_generator(ckpt_dir)
+        prompt = "user: what is the capital of norway ? assistant:"
+        full = gen.generate(prompt, max_tokens=12)
+        deltas = list(gen.generate_stream(prompt, max_tokens=12))
+        assert len(deltas) > 1, "true streaming must yield multiple deltas"
+        assert "".join(deltas) == full
+
+    def test_chat_stream_uses_native_streaming(self, assistant_ckpt):
+        from nornicdb_tpu.heimdall import HeimdallManager
+
+        ckpt_dir, _ = assistant_ckpt
+        mgr = HeimdallManager(pretrain.load_generator(ckpt_dir))
+        chunks = list(mgr.chat_stream(
+            [{"role": "user", "content": "what is the capital of norway ?"}],
+            max_tokens=12))
+        content = [c["choices"][0]["delta"].get("content", "")
+                   for c in chunks if c.get("choices")]
+        assert sum(1 for c in content if c) > 1
+        assert chunks[-1]["choices"][0]["finish_reason"] == "stop"
