@@ -121,7 +121,9 @@ class QwenGenerator(Generator):
         import jax.numpy as jnp
 
         ids = self.tokenizer.encode(prompt, add_special=False)[-256:] or [1]
-        max_len = len(ids) + max_tokens
+        # bucketed cache length: one compiled program per power-of-two
+        # bucket instead of one per distinct prompt length
+        max_len = self.qwen2.round_up_pow2(len(ids) + max_tokens)
         logits, caches = self.qwen2.prefill(
             self.params, self.cfg, jnp.asarray([ids], jnp.int32), max_len
         )
@@ -394,6 +396,34 @@ class HeimdallManager:
                 break
         return ctx
 
+    def _build_prompt(self, ctx, messages: list[dict[str, str]]) -> str:
+        """One prompt assembly for streamed AND non-streamed chat — the two
+        paths must never drift in format."""
+        prompt_parts = [ctx.build_final_prompt()]
+        for m in messages:
+            prompt_parts.append(f"{m.get('role', 'user')}: {m.get('content', '')}")
+        prompt_parts.append("assistant:")
+        return "\n".join(prompt_parts)
+
+    def _dispatch_action(self, action: dict):
+        """Shared action dispatch; returns the raw result (or error dict)."""
+        if self.action_dispatcher is not None:
+            try:
+                result = self.action_dispatcher(action)
+                self.metrics.actions_executed += 1
+                return result
+            except Exception as e:  # noqa: BLE001 — surfaced to the client
+                return {"error": str(e)}
+        fn = self._actions.get(str(action.get("action")))
+        if fn is None:
+            return None
+        try:
+            result = fn(action.get("params") or {})
+            self.metrics.actions_executed += 1
+            return result
+        except Exception as e:  # noqa: BLE001
+            return {"error": str(e)}
+
     def chat(
         self,
         messages: list[dict[str, str]],
@@ -421,11 +451,7 @@ class HeimdallManager:
                 }],
                 "cancelled_by": ctx.cancelled_by,
             }
-        prompt_parts = [ctx.build_final_prompt()]
-        for m in messages:
-            prompt_parts.append(f"{m.get('role', 'user')}: {m.get('content', '')}")
-        prompt_parts.append("assistant:")
-        prompt = "\n".join(prompt_parts)
+        prompt = self._build_prompt(ctx, messages)
         # model selection through the registry (ref: ChatRequest.Model)
         generator = self.generator
         if model and model not in ("heimdall", ""):
@@ -452,20 +478,7 @@ class HeimdallManager:
         action_result = None
         action = self.try_parse_action(text)
         if action is not None:
-            if self.action_dispatcher is not None:
-                try:
-                    action_result = self.action_dispatcher(action)
-                    self.metrics.actions_executed += 1
-                except Exception as e:
-                    action_result = {"error": str(e)}
-            else:
-                fn = self._actions.get(str(action.get("action")))
-                if fn is not None:
-                    try:
-                        action_result = fn(action.get("params") or {})
-                        self.metrics.actions_executed += 1
-                    except Exception as e:
-                        action_result = {"error": str(e)}
+            action_result = self._dispatch_action(action)
         self.bifrost.broadcast("chat", {"content": text[:200]})
         response = {
             "id": f"chatcmpl-{ctx.request_id}",
@@ -540,7 +553,18 @@ class HeimdallManager:
             yield from self._chat_stream_native(
                 generator, messages, max_tokens, model)
             return
-        full = self.chat(messages, max_tokens, model=model)
+        try:
+            full = self.chat(messages, max_tokens, model=model)
+        except Exception as e:  # noqa: BLE001 — SSE headers already sent:
+            # the client must get a terminal error event, matching the
+            # native path's contract
+            self.metrics.errors += 1
+            yield {"object": "chat.completion.chunk", "choices": [],
+                   "error": {"message": str(e)}}
+            yield {"object": "chat.completion.chunk",
+                   "choices": [{"index": 0, "delta": {},
+                                "finish_reason": "error"}]}
+            return
         if "choices" not in full:
             # error response (unknown model etc.): one error event, done
             yield {
@@ -578,21 +602,22 @@ class HeimdallManager:
                             ) -> Iterator[dict]:
         ctx = self.build_context(messages)
         if ctx.cancelled:
+            self.metrics_registry.inc("requests_cancelled")
             yield {
                 "object": "chat.completion.chunk",
                 "choices": [],
                 "error": {"message": f"Request cancelled: {ctx.cancel_reason}"},
             }
+            yield {"object": "chat.completion.chunk",
+                   "choices": [{"index": 0, "delta": {},
+                                "finish_reason": "cancelled"}]}
             return
         for note in [vars(n) for n in ctx.drain_notifications()]:
             yield {"object": "chat.completion.chunk", "choices": [],
                    "notification": note}
-        prompt_parts = [ctx.build_final_prompt()]
-        for m in messages:
-            prompt_parts.append(f"{m.get('role', 'user')}: {m.get('content', '')}")
-        prompt_parts.append("assistant:")
         # plugin guards (redaction, veto) apply to streamed prompts too
-        prompt = self.pre_prompt_transform("\n".join(prompt_parts))
+        prompt = self.pre_prompt_transform(
+            self._build_prompt(ctx, messages))
         pieces: list[str] = []
         t0 = time.time()
         try:
@@ -614,7 +639,8 @@ class HeimdallManager:
             return
         text = "".join(pieces)
         self.metrics.generations += 1
-        self.metrics.tokens_generated += estimate_tokens(text)
+        # same unit as generate() (word count) so the counter stays summable
+        self.metrics.tokens_generated += len(text.split())
         self.metrics.total_latency += time.time() - t0
         self.metrics_registry.inc("chat_requests")
         self.metrics_registry.inc("prompt_tokens", estimate_tokens(prompt))
@@ -624,15 +650,8 @@ class HeimdallManager:
         # streaming handler (tryParseAction handler.go:516)
         action = self.try_parse_action(text)
         if action is not None:
-            fn = self._actions.get(str(action.get("action")))
-            dispatch = self.action_dispatcher or (
-                (lambda a: fn(a.get("params") or {})) if fn else None)
-            if dispatch is not None:
-                try:
-                    result = dispatch(action)
-                    self.metrics.actions_executed += 1
-                except Exception as e:  # noqa: BLE001 — surfaced to client
-                    result = {"error": str(e)}
+            result = self._dispatch_action(action)
+            if result is not None:
                 yield {"object": "chat.completion.chunk", "choices": [],
                        "action_result": _brief(result, 2000)}
         yield {

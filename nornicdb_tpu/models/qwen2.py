@@ -230,13 +230,25 @@ def _cached_step(params, cfg: QwenConfig, token: jax.Array, caches,
     return _logits(params, cfg, h)[:, 0, :], new_caches
 
 
-@functools.partial(jax.jit, static_argnames=("cfg",))
+def round_up_pow2(n: int, floor: int = 64) -> int:
+    """Bucket a KV-cache length so jits stay bounded: without this, every
+    distinct prompt length compiles a fresh prefill + decode_step (the
+    same policy as TPUEmbedder's length buckets, embed/base.py)."""
+    out = floor
+    while out < n:
+        out *= 2
+    return out
+
+
+@functools.partial(jax.jit, static_argnames=("cfg",), donate_argnums=(3,))
 def decode_step(params, cfg: QwenConfig, token: jax.Array, caches,
                 pos: jax.Array):
     """ONE cached decode step: (B,) token at position `pos` -> ((B, V)
     logits, advanced caches). The streaming generation path
-    (heimdall QwenGenerator.generate_stream) calls this per yielded token;
-    the jit caches one program per max_len bucket."""
+    (heimdall QwenGenerator.generate_stream) calls this per yielded token.
+    Caches are DONATED: XLA aliases the input/output KV buffers, so each
+    step updates in place instead of copying the whole cache (the caller
+    must not reuse the passed-in caches)."""
     max_len = caches[0][0].shape[1]
     full_angles = rope_freqs(cfg.hidden // cfg.heads, max_len, cfg.rope_theta)
     return _cached_step(params, cfg, token, caches, pos, full_angles)

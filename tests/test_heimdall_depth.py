@@ -408,9 +408,8 @@ class TestStreamingPluginGuards:
                 return prompt.replace("SECRET", "[redacted]")
 
         mgr = HeimdallManager(EchoStream(), db=db)
-        host = PluginHost(mgr)
+        host = PluginHost(mgr)  # __init__ installs the hooks
         host._plugins["redactor"] = Redactor()
-        host._install_hooks()
         list(mgr.chat_stream([{"role": "user", "content": "tell SECRET"}]))
         assert "SECRET" not in seen["prompt"]
         assert "[redacted]" in seen["prompt"]
