@@ -1,5 +1,20 @@
 """Temporal access tracking (ref: /root/reference/pkg/temporal/)."""
 
+from nornicdb_tpu.temporal.evolution import (
+    RelationshipConfig,
+    RelationshipEvolution,
+    RelationshipTrend,
+)
+from nornicdb_tpu.temporal.patterns import (
+    PATTERN_BURST,
+    PATTERN_DAILY,
+    PATTERN_DECAYING,
+    PATTERN_GROWING,
+    PATTERN_WEEKLY,
+    DetectedPattern,
+    PatternDetector,
+    PatternDetectorConfig,
+)
 from nornicdb_tpu.temporal.tracker import (
     AccessRecord,
     SessionDetector,
@@ -7,4 +22,10 @@ from nornicdb_tpu.temporal.tracker import (
     TrackerConfig,
 )
 
-__all__ = ["AccessRecord", "SessionDetector", "TemporalTracker", "TrackerConfig"]
+__all__ = [
+    "AccessRecord", "SessionDetector", "TemporalTracker", "TrackerConfig",
+    "PatternDetector", "PatternDetectorConfig", "DetectedPattern",
+    "PATTERN_DAILY", "PATTERN_WEEKLY", "PATTERN_BURST", "PATTERN_GROWING",
+    "PATTERN_DECAYING",
+    "RelationshipEvolution", "RelationshipConfig", "RelationshipTrend",
+]

@@ -65,9 +65,11 @@ class QueryLoadTracker:
         }
 
 
-class RelationshipEvolution:
-    """Evolve auto-generated edge strength with use; decay the unused
-    (ref: relationship_evolution.go)."""
+class EdgeStrengthEvolver:
+    """Evolve auto-generated edge strength with use; decay the unused —
+    the STORAGE side of relationship evolution (ref:
+    relationship_evolution.go edge maintenance); trend tracking and
+    prediction live in temporal.evolution.RelationshipEvolution."""
 
     def __init__(self, storage: Engine, strengthen: float = 0.05,
                  decay: float = 0.01, now_fn: Callable[[], float] = time.time):

@@ -21,7 +21,7 @@ from nornicdb_tpu.inference.integrations import (
     TopologyIntegration,
 )
 from nornicdb_tpu.storage import Edge, MemoryEngine, Node
-from nornicdb_tpu.temporal.query_load import QueryLoadTracker, RelationshipEvolution
+from nornicdb_tpu.temporal.query_load import EdgeStrengthEvolver, QueryLoadTracker
 from nornicdb_tpu.vectorspace import (
     BACKEND_TPU,
     VectorSpaceKey,
@@ -198,7 +198,7 @@ class TestQueryLoad:
         eng.create_edge(
             Edge(id="manual", start_node="a", end_node="b", confidence=1.0)
         )
-        evo = RelationshipEvolution(eng, strengthen=0.1, decay=0.02)
+        evo = EdgeStrengthEvolver(eng, strengthen=0.1, decay=0.02)
         assert evo.on_traversal("auto") == pytest.approx(0.16)
         out = evo.decay_pass(min_confidence=0.1)  # 0.16 -> 0.14: weakened
         assert out == {"weakened": 1, "removed": 0}

@@ -1,0 +1,166 @@
+"""Port of pkg/temporal pattern_detector_test.go + relationship_evolution
+_test.go: periodic/burst/trend pattern detection and edge-strength
+evolution trends.
+"""
+
+import time
+
+import pytest
+
+from nornicdb_tpu.temporal import (
+    PATTERN_BURST,
+    PATTERN_DAILY,
+    PATTERN_DECAYING,
+    PATTERN_GROWING,
+    PATTERN_WEEKLY,
+    PatternDetector,
+    PatternDetectorConfig,
+    RelationshipConfig,
+    RelationshipEvolution,
+)
+
+DAY = 86400.0
+HOUR = 3600.0
+
+
+class TestPatternDetector:
+    def test_daily_pattern_at_peak_hour(self):
+        """Accesses concentrated at 09:00 UTC across two weeks -> daily
+        pattern with peak_hour 9."""
+        pd = PatternDetector()
+        base = 1_700_000_000 - (1_700_000_000 % DAY)  # midnight UTC
+        for day in range(14):
+            pd.record_access("n", base + day * DAY + 9 * HOUR)
+        patterns = pd.detect_patterns("n")
+        daily = next(p for p in patterns if p.type == PATTERN_DAILY)
+        assert daily.peak_hour == 9
+        assert daily.confidence > 0.9  # fully concentrated
+        hour, _, conf = pd.peak_access_time("n")
+        assert hour == 9 and conf > 0.9
+
+    def test_uniform_access_no_daily_pattern(self):
+        pd = PatternDetector()
+        base = 1_700_000_000 - (1_700_000_000 % DAY)
+        for i in range(48):  # every hour for two days: uniform
+            pd.record_access("n", base + i * HOUR)
+        assert not any(p.type == PATTERN_DAILY
+                       for p in pd.detect_patterns("n"))
+
+    def test_weekly_pattern(self):
+        """Every Sunday for 8 weeks -> weekly pattern, peak_day 0."""
+        pd = PatternDetector()
+        # 1_700_000_000 is a Tuesday; find the next Sunday 10:00
+        base = 1_700_000_000 - (1_700_000_000 % DAY)
+        import datetime
+
+        dt = datetime.datetime.fromtimestamp(base, datetime.timezone.utc)
+        days_to_sunday = (6 - dt.weekday()) % 7
+        sunday = base + days_to_sunday * DAY + 10 * HOUR
+        for week in range(10):
+            pd.record_access("n", sunday + week * 7 * DAY)
+        weekly = next(p for p in pd.detect_patterns("n")
+                      if p.type == PATTERN_WEEKLY)
+        assert weekly.peak_day == 0  # Sunday=0 convention
+        assert weekly.confidence > 0.9
+
+    def test_burst_pattern(self):
+        pd = PatternDetector()
+        now = time.time()
+        for i in range(12):
+            pd.record_access("n", now - 30 + i * 2)  # 12 hits in 30s
+        assert pd.has_pattern("n", PATTERN_BURST)
+
+    def test_trend_patterns_from_velocity(self):
+        """Trends report only ABOVE the sample gate (the reference's
+        DetectPatterns returns nil below it, even with a velocity)."""
+        pd = PatternDetector()
+        assert not pd.detect_patterns("unknown", velocity=0.5)
+        now = time.time()
+        for i in range(12):
+            pd.record_access("n", now - i * 7200)  # spread out: no burst
+        assert pd.has_pattern("n", PATTERN_GROWING, velocity=0.5)
+        assert pd.has_pattern("n", PATTERN_DECAYING, velocity=-0.5)
+
+    def test_burst_expires_with_wall_clock(self):
+        """A burst that ended long ago must stop being reported."""
+        pd = PatternDetector()
+        old = time.time() - 7 * DAY
+        for i in range(12):
+            pd.record_access("n", old + i * 2)
+        assert not pd.has_pattern("n", PATTERN_BURST)
+
+    def test_unknown_node_peak_sentinel(self):
+        assert PatternDetector().peak_access_time("ghost") == (-1, -1, 0.0)
+
+    def test_min_samples_gate(self):
+        pd = PatternDetector(PatternDetectorConfig(min_samples_for_pattern=10))
+        for i in range(5):
+            pd.record_access("n", 1_700_000_000 + i * DAY)
+        assert pd.detect_patterns("n") == []
+
+
+class TestRelationshipEvolution:
+    def test_strengthening_trend(self):
+        re_ = RelationshipEvolution()
+        t0 = 1_700_000_000.0
+        for i in range(8):
+            re_.update_weight("a", "b", 1.0 + i * 0.5, ts=t0 + i * 60)
+        trend = re_.get_trend("a", "b")
+        assert trend.direction == "strengthening"
+        assert trend.velocity > 0
+        assert trend.predicted_strength > trend.current_strength
+        assert 0 < trend.confidence < 1
+
+    def test_weakening_trend(self):
+        re_ = RelationshipEvolution()
+        t0 = 1_700_000_000.0
+        for i in range(8):
+            re_.update_weight("a", "b", 5.0 - i * 0.5, ts=t0 + i * 60)
+        trend = re_.get_trend("a", "b")
+        assert trend.direction == "weakening"
+        assert trend.velocity < 0
+
+    def test_unknown_below_min_observations(self):
+        re_ = RelationshipEvolution(RelationshipConfig(
+            min_observations_for_trend=5))
+        re_.update_weight("a", "b", 1.0, ts=1_700_000_000.0)
+        re_.update_weight("a", "b", 2.0, ts=1_700_000_060.0)
+        assert re_.get_trend("a", "b").direction == "unknown"
+
+    def test_undirected_key(self):
+        re_ = RelationshipEvolution()
+        re_.record_co_access("a", "b", ts=1_700_000_000.0)
+        re_.record_co_access("b", "a", ts=1_700_000_060.0)
+        assert re_.get_trend("a", "b").observation_count == 2
+
+    def test_rankings(self):
+        re_ = RelationshipEvolution()
+        t0 = 1_700_000_000.0
+        for i in range(6):
+            re_.update_weight("up1", "x", 1.0 + i, ts=t0 + i * 60)
+            re_.update_weight("up2", "x", 1.0 + 2 * i, ts=t0 + i * 60)
+            re_.update_weight("down", "x", 9.0 - i, ts=t0 + i * 60)
+        stronger = re_.strengthening(limit=5)
+        assert [t.source for t in stronger][0] == "up2"  # fastest first
+        assert {t.source for t in stronger} == {"up1", "up2"}
+        weaker = re_.weakening(limit=5)
+        assert [t.source for t in weaker] == ["down"]
+
+    def test_lru_eviction_bound(self):
+        re_ = RelationshipEvolution(RelationshipConfig(max_tracked=3))
+        for i in range(6):
+            re_.update_weight(f"s{i}", "t", 1.0, ts=1_700_000_000.0 + i)
+        assert re_.get_trend("s0", "t") is None  # evicted
+        assert re_.get_trend("s5", "t") is not None
+
+    def test_predict_unknown_edge_is_zero(self):
+        assert RelationshipEvolution().predict_strength("x", "y") == 0.0
+
+    def test_co_access_accumulates(self):
+        re_ = RelationshipEvolution()
+        t0 = 1_700_000_000.0
+        for i in range(6):
+            re_.record_co_access("a", "b", weight=1.0, ts=t0 + i * 10)
+        trend = re_.get_trend("a", "b")
+        assert trend.current_strength > 1.0  # accumulated, not replaced
+        assert trend.direction == "strengthening"
