@@ -74,12 +74,26 @@ class DecayManager:
         self._timer: Optional[threading.Timer] = None
         self._stopped = False
         self._kalman: dict[str, Kalman] = {}
+        # optional temporal modulation hook: node_id -> multiplier where
+        # 0.5 halves the decay speed and 2.0 doubles it (ref: pkg/temporal
+        # decay_integration.go; wire temporal.DecayIntegration
+        # .get_decay_modifier(...).multiplier here)
+        self.rate_modifier: Optional[Callable[[str], float]] = None
 
     # -- scoring -------------------------------------------------------------
     def calculate_score(self, node: Node, now: Optional[float] = None) -> float:
         """(ref: CalculateScore decay.go:503; weights db.go:951-959)"""
         now = self.now() if now is None else now
         hl = half_life(node.memory_type)
+        if self.rate_modifier is not None:
+            # multiplier scales decay SPEED, so it divides the half-life
+            # (x0.5 = memories live twice as long)
+            try:
+                mult = float(self.rate_modifier(node.id))
+            except Exception:
+                mult = 1.0
+            if mult > 0:
+                hl = hl / mult
         age = max(now - node.last_accessed, 0.0)
         recency = math.exp(-math.log(2.0) * age / hl)
         # frequency: saturating log scale (10+ accesses ~ 1.0)

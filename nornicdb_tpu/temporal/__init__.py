@@ -1,5 +1,13 @@
 """Temporal access tracking (ref: /root/reference/pkg/temporal/)."""
 
+from nornicdb_tpu.temporal.decay_integration import (
+    DecayComponent,
+    DecayIntegration,
+    DecayIntegrationConfig,
+    DecayModifier,
+    aggressive_decay_config,
+    conservative_decay_config,
+)
 from nornicdb_tpu.temporal.evolution import (
     RelationshipConfig,
     RelationshipEvolution,
@@ -28,4 +36,6 @@ __all__ = [
     "PATTERN_DAILY", "PATTERN_WEEKLY", "PATTERN_BURST", "PATTERN_GROWING",
     "PATTERN_DECAYING",
     "RelationshipEvolution", "RelationshipConfig", "RelationshipTrend",
+    "DecayIntegration", "DecayIntegrationConfig", "DecayModifier",
+    "DecayComponent", "conservative_decay_config", "aggressive_decay_config",
 ]

@@ -114,6 +114,31 @@ class TemporalTracker:
             k = self._rate.get(node_id)
             return k.position if k is not None and k.initialized else None
 
+    def access_rate_trend(self, node_id: str) -> tuple[float, str]:
+        """(velocity, trend) (ref: GetAccessRateTrend tracker.go:712) —
+        velocity is positive when access is ACCELERATING, dimensionless
+        (relative interval change: +1 = intervals halved between the first
+        and second half of the history). trend: increasing / decreasing /
+        stable. Computed from the raw access history so it stays robust to
+        filter tuning."""
+        with self._lock:
+            hist = self._history.get(node_id)
+            if hist is None or len(hist) < 4:
+                return 0.0, "stable"
+            ts = list(hist)
+        intervals = [b - a for a, b in zip(ts, ts[1:])]
+        half = len(intervals) // 2
+        early = sum(intervals[:half]) / half
+        late = sum(intervals[half:]) / (len(intervals) - half)
+        if early <= 0 or late <= 0:
+            return 0.0, "stable"
+        v = early / late - 1.0  # +1 = intervals halved (2x faster access)
+        if v > 0.1:
+            return min(v, 10.0), "increasing"
+        if v < -0.1:
+            return max(v, -10.0), "decreasing"
+        return v, "stable"
+
     def predict_next_access(self, node_id: str) -> Optional[float]:
         """(ref: PredictNextAccess tracker.go:521) — last access + predicted
         interval (velocity-extrapolated)."""

@@ -164,3 +164,120 @@ class TestRelationshipEvolution:
         trend = re_.get_trend("a", "b")
         assert trend.current_strength > 1.0  # accumulated, not replaced
         assert trend.direction == "strengthening"
+
+
+class TestDecayIntegration:
+    """Port of pkg/temporal decay_integration_test.go intent: temporal
+    signals blend into one clamped, smoothed decay-rate multiplier, and
+    the DecayManager hook actually stretches half-lives."""
+
+    def test_frequent_access_slows_decay(self):
+        from nornicdb_tpu.temporal import DecayIntegration
+
+        di = DecayIntegration()
+        now = time.time()
+        for i in range(30):
+            di.record_access("hot", now - (30 - i) * 60)  # steady hits
+        mod = di.get_decay_modifier("hot")
+        assert mod.multiplier < 1.0, mod
+        assert mod.confidence > 0.5
+        assert any(c.name == "velocity" for c in mod.components)
+
+    def test_unknown_node_is_baseline_and_clamped(self):
+        from nornicdb_tpu.temporal import (DecayIntegration,
+                                           DecayIntegrationConfig)
+
+        cfg = DecayIntegrationConfig()
+        di = DecayIntegration(cfg)
+        mod = di.get_decay_modifier("ghost")
+        assert cfg.min_decay_multiplier <= mod.multiplier <= \
+            cfg.max_decay_multiplier
+        assert mod.confidence <= 0.2
+
+    def test_burst_boost_expires(self):
+        from nornicdb_tpu.temporal import DecayIntegration
+
+        di = DecayIntegration()
+        now = time.time()
+        for i in range(12):
+            di.record_access("bursty", now - 20 + i)
+        assert any(c.name == "burst"
+                   for c in di.get_decay_modifier("bursty").components)
+        # simulate expiry
+        di._burst_start["bursty"] = now - 10_000
+        assert not any(c.name == "burst"
+                       for c in di.get_decay_modifier("bursty").components)
+
+    def test_conservative_vs_aggressive_presets(self):
+        from nornicdb_tpu.temporal import (aggressive_decay_config,
+                                           conservative_decay_config)
+
+        cons, aggr = conservative_decay_config(), aggressive_decay_config()
+        assert cons.min_decay_multiplier < aggr.min_decay_multiplier
+        assert cons.max_decay_multiplier < aggr.max_decay_multiplier
+
+    def test_decay_manager_hook_stretches_half_life(self):
+        from nornicdb_tpu.decay import DecayManager
+        from nornicdb_tpu.storage import MemoryEngine, Node
+
+        eng = MemoryEngine()
+        now = time.time()
+        node = Node(id="m", properties={"importance": 0.5})
+        node.last_accessed = now - 7 * 86400
+        eng.create_node(node)
+        mgr = DecayManager(eng, now_fn=lambda: now)
+        mgr.config.kalman_smoothing = False
+        base = mgr.calculate_score(eng.get_node("m"), now)
+        mgr.rate_modifier = lambda nid: 0.1  # 10x slower decay
+        slowed = mgr.calculate_score(eng.get_node("m"), now)
+        assert slowed > base
+        mgr.rate_modifier = lambda nid: 5.0  # 5x faster decay
+        sped = mgr.calculate_score(eng.get_node("m"), now)
+        assert sped < base
+
+    def test_access_rate_trend_directions(self):
+        """access_rate_trend: positive velocity = accelerating access
+        (ref: GetAccessRateTrend tracker.go:712)."""
+        from nornicdb_tpu.temporal import TemporalTracker
+
+        tr = TemporalTracker()
+        t = 1_700_000_000.0
+        for i in range(40):
+            tr.record_access("accel", t)
+            t += 300 * (0.93 ** i)
+        v, trend = tr.access_rate_trend("accel")
+        assert trend == "increasing" and v > 0
+        t = 1_700_000_000.0
+        for i in range(40):
+            tr.record_access("decel", t)
+            t += 20 * (1.08 ** i)
+        v, trend = tr.access_rate_trend("decel")
+        assert trend == "decreasing" and v < 0
+        t = 1_700_000_000.0
+        for i in range(40):
+            tr.record_access("steady", t)
+            t += 60
+        assert tr.access_rate_trend("steady")[1] == "stable"
+        assert tr.access_rate_trend("unknown") == (0.0, "stable")
+
+    def test_rare_access_penalized_vs_frequent(self):
+        """The decay modifier must penalize decelerating access and boost
+        accelerating access (the semantic the unit-confusion review
+        finding flagged as inverted)."""
+        from nornicdb_tpu.temporal import DecayIntegration
+
+        di = DecayIntegration()
+        t = time.time() - 7200
+        for i in range(40):
+            di.record_access("accel", t)
+            t += 300 * (0.93 ** i)
+        t = time.time() - 7200
+        for i in range(40):
+            di.record_access("decel", t)
+            t += 20 * (1.08 ** i)
+        accel = di.get_decay_modifier("accel")
+        decel = di.get_decay_modifier("decel")
+        a_vel = next(c for c in accel.components if c.name == "velocity")
+        d_vel = next(c for c in decel.components if c.name == "velocity")
+        assert a_vel.multiplier < 1.0 < d_vel.multiplier
+        assert accel.multiplier < decel.multiplier
