@@ -92,7 +92,7 @@ class DecayManager:
                 mult = float(self.rate_modifier(node.id))
             except Exception:
                 mult = 1.0
-            if mult > 0:
+            if mult > 0 and math.isfinite(mult):
                 hl = hl / mult
         age = max(now - node.last_accessed, 0.0)
         recency = math.exp(-math.log(2.0) * age / hl)

@@ -11,6 +11,7 @@ from __future__ import annotations
 
 import threading
 import time
+from collections import deque
 from dataclasses import dataclass, field
 from typing import Optional
 
@@ -88,15 +89,20 @@ class DecayIntegration:
         self.tracker = tracker or TemporalTracker()
         self.patterns = patterns or PatternDetector()
         self._burst_start: dict[str, float] = {}
-        self._recent_hits: dict[str, list[float]] = {}
+        self._recent_hits: dict[str, deque] = {}
         self._filters: dict[str, Kalman] = {}
         self._lock = threading.Lock()
+
+    def _now(self) -> float:
+        """One clock for the whole integration: the tracker's now_fn, so
+        simulated time and historical replays stay coherent."""
+        return self.tracker.now()
 
     def record_access(self, node_id: str,
                       ts: Optional[float] = None) -> None:
         """(ref: RecordAccess :229) — feeds both the tracker and the
         pattern detector, and arms the burst boost when a burst fires."""
-        ts = time.time() if ts is None else ts
+        ts = self._now() if ts is None else ts
         self.tracker.record_access(node_id, ts)
         self.patterns.record_access(node_id, ts)
         # burst arming is a direct window count anchored at THIS access —
@@ -104,15 +110,18 @@ class DecayIntegration:
         # detection), and correct for historical timestamps too
         # (ref: RecordAccessAt decay_integration.go:251)
         with self._lock:
-            recent = self._recent_hits.setdefault(node_id, [])
+            recent = self._recent_hits.setdefault(node_id, deque())
             recent.append(ts)
-            cutoff = ts - self.config.burst_boost_duration
+            cutoff = ts - self.patterns.config.burst_window_seconds
             while recent and recent[0] < cutoff:
-                recent.pop(0)
-            window = [t for t in recent
-                      if t >= ts - self.patterns.config.burst_window_seconds]
-            if len(window) >= self.patterns.config.burst_min_accesses:
-                self._burst_start.setdefault(node_id, ts)
+                recent.popleft()
+            if len(recent) >= self.patterns.config.burst_min_accesses:
+                start = self._burst_start.get(node_id)
+                if start is None or ts - start >= self.config.burst_boost_duration:
+                    # a NEW burst (or one whose boost already expired)
+                    # re-arms; an in-flight burst keeps its start so the
+                    # boost window is measured from burst onset
+                    self._burst_start[node_id] = ts
 
     def get_decay_modifier(self, node_id: str) -> DecayModifier:
         """(ref: GetDecayModifier :262) — weighted blend of velocity,
@@ -137,7 +146,7 @@ class DecayIntegration:
         # detector would pin EVERY node in-session under steady load)
         last = self.tracker.last_access(node_id)
         gap = getattr(self.tracker.config, "session_gap", 1800.0)
-        in_session = last is not None and (time.time() - last) < gap
+        in_session = last is not None and (self._now() - last) < gap
         if in_session:
             components.append(DecayComponent(
                 "session", cfg.session_boost_multiplier, 0.5))
@@ -145,7 +154,7 @@ class DecayIntegration:
         with self._lock:
             burst_start = self._burst_start.get(node_id)
             if burst_start is not None:
-                if time.time() - burst_start < cfg.burst_boost_duration:
+                if self._now() - burst_start < cfg.burst_boost_duration:
                     components.append(DecayComponent(
                         "burst", cfg.burst_boost_multiplier, 0.3))
                 else:
@@ -163,9 +172,17 @@ class DecayIntegration:
             mult = min(max(smoothed, cfg.min_decay_multiplier),
                        cfg.max_decay_multiplier)
 
-        dominant = min(components, key=lambda c: c.multiplier)
-        reason = (f"{dominant.name} (x{dominant.multiplier:.2f})"
-                  if dominant.multiplier < 1.0 else "baseline")
+        import math as _math
+
+        # dominant = furthest from neutral in EITHER direction, so a
+        # penalty-driven speedup is named, not reported as "baseline"
+        dominant = max(components,
+                       key=lambda c: abs(_math.log(max(c.multiplier, 1e-9))))
+        if abs(_math.log(max(dominant.multiplier, 1e-9))) < 0.05:
+            reason = "baseline"
+        else:
+            kind = "boost" if dominant.multiplier < 1.0 else "penalty"
+            reason = f"{dominant.name} {kind} (x{dominant.multiplier:.2f})"
         count = self.tracker.access_count(node_id)
         confidence = min(count / 20.0, 1.0) if count else 0.1
         return DecayModifier(mult, reason, confidence, components)
@@ -175,7 +192,7 @@ class DecayIntegration:
         last = self.tracker.last_access(node_id)
         if last is None:
             return float("inf")
-        return max(time.time() - last, 0.0) / 3600.0
+        return max(self._now() - last, 0.0) / 3600.0
 
     def _velocity_mult(self, velocity: float, trend: str) -> float:
         """(ref: calculateVelocityMultiplier :376). velocity is the

@@ -1,0 +1,144 @@
+"""Port of pkg/inference evidence_test.go + cooldown_test.go intent —
+the evidence/cooldown gate in front of auto-edge creation: thresholds,
+TTL expiry, suppression accounting, concurrency, per-rel-type keying,
+and the resulting edge's confidence/metadata.
+"""
+
+import threading
+import time
+
+import pytest
+
+from nornicdb_tpu.inference import InferenceConfig, InferenceEngine
+from nornicdb_tpu.storage import MemoryEngine, Node
+
+
+@pytest.fixture
+def setup():
+    eng = MemoryEngine()
+    for nid in ("a", "b", "c", "d"):
+        eng.create_node(Node(id=nid))
+    clock = {"t": 1_700_000_000.0}
+    inf = InferenceEngine(eng, config=InferenceConfig(
+        min_evidence=3, cooldown=300.0, evidence_ttl=3600.0),
+        now_fn=lambda: clock["t"])
+    return inf, eng, clock
+
+
+class TestEvidenceThreshold:
+    def test_requires_threshold(self, setup):
+        """TestEvidenceBuffer_RequiresThreshold — below min_evidence no
+        edge materializes; at the threshold it does."""
+        inf, eng, _ = setup
+        assert inf.process_suggestion("a", "b", "SIMILAR_TO", 0.9) is None
+        assert inf.process_suggestion("a", "b", "SIMILAR_TO", 0.9) is None
+        assert eng.edge_count() == 0
+        edge = inf.process_suggestion("a", "b", "SIMILAR_TO", 0.9)
+        assert edge is not None
+        assert eng.edge_count() == 1
+        assert edge.auto_generated
+        assert edge.properties["evidence_count"] == 3
+
+    def test_confidence_averaged_across_evidence(self, setup):
+        """TestEvidenceBuffer_CheckThreshold — the materialized edge
+        carries the MEAN confidence of its evidence."""
+        inf, _, _ = setup
+        inf.process_suggestion("a", "b", "SIMILAR_TO", 0.6)
+        inf.process_suggestion("a", "b", "SIMILAR_TO", 0.8)
+        edge = inf.process_suggestion("a", "b", "SIMILAR_TO", 1.0)
+        assert edge.confidence == pytest.approx(0.8, abs=1e-4)
+
+    def test_expired_evidence_restarts(self, setup):
+        """TestEvidenceBuffer_ExpiredEvidence — evidence older than the
+        TTL does not count toward the threshold."""
+        inf, eng, clock = setup
+        inf.process_suggestion("a", "b", "SIMILAR_TO", 0.9)
+        inf.process_suggestion("a", "b", "SIMILAR_TO", 0.9)
+        clock["t"] += 3601.0  # TTL passes
+        assert inf.process_suggestion("a", "b", "SIMILAR_TO", 0.9) is None
+        assert eng.edge_count() == 0  # count restarted at 1, not 3
+
+    def test_different_rel_types_keyed_separately(self, setup):
+        """TestEvidenceBuffer_DifferentLabels"""
+        inf, eng, _ = setup
+        inf.process_suggestion("a", "b", "SIMILAR_TO", 0.9)
+        inf.process_suggestion("a", "b", "SIMILAR_TO", 0.9)
+        # different type: its own evidence chain, no cross-contamination
+        assert inf.process_suggestion("a", "b", "RELATED_TO", 0.9) is None
+        edge = inf.process_suggestion("a", "b", "SIMILAR_TO", 0.9)
+        assert edge is not None and edge.type == "SIMILAR_TO"
+
+
+class TestCooldown:
+    def test_cooldown_suppresses_after_creation(self, setup):
+        """cooldown_test.go intent — once an edge lands, the pair is
+        suppressed for the cooldown window (prevents edge churn)."""
+        inf, _, clock = setup
+        for _ in range(3):
+            inf.process_suggestion("a", "b", "SIMILAR_TO", 0.9)
+        before = inf.stats.suppressed_cooldown
+        assert inf.process_suggestion("a", "b", "RELATED_TO", 0.9) is None
+        assert inf.stats.suppressed_cooldown == before + 1
+
+    def test_cooldown_expires(self, setup):
+        inf, eng, clock = setup
+        for _ in range(3):
+            inf.process_suggestion("a", "b", "SIMILAR_TO", 0.9)
+        clock["t"] += 301.0  # cooldown passes
+        for _ in range(3):
+            inf.process_suggestion("a", "b", "RELATED_TO", 0.9)
+        assert eng.edge_count() == 2  # second type created after cooldown
+
+    def test_existing_edge_suppressed_and_cooled(self, setup):
+        """An existing edge of the same type suppresses the suggestion AND
+        arms the cooldown."""
+        inf, eng, _ = setup
+        from nornicdb_tpu.storage import Edge
+
+        eng.create_edge(Edge(id="e", start_node="a", end_node="b",
+                             type="SIMILAR_TO"))
+        assert inf.process_suggestion("a", "b", "SIMILAR_TO", 0.9) is None
+        assert inf.stats.suppressed_existing == 1
+        # pair is now cooled for every type
+        assert inf.process_suggestion("b", "a", "RELATED_TO", 0.9) is None
+        assert inf.stats.suppressed_cooldown == 1
+
+    def test_pair_key_is_undirected(self, setup):
+        inf, eng, _ = setup
+        inf.process_suggestion("a", "b", "SIMILAR_TO", 0.9)
+        inf.process_suggestion("b", "a", "SIMILAR_TO", 0.9)
+        edge = inf.process_suggestion("a", "b", "SIMILAR_TO", 0.9)
+        assert edge is not None  # both directions fed one evidence chain
+
+
+class TestConcurrency:
+    def test_concurrent_suggestions_create_exactly_one_edge(self):
+        """TestEvidenceBuffer_Concurrent — racing suggestions for one pair
+        must produce exactly one edge."""
+        eng = MemoryEngine()
+        eng.create_node(Node(id="a"))
+        eng.create_node(Node(id="b"))
+        inf = InferenceEngine(eng, config=InferenceConfig(
+            min_evidence=3, cooldown=300.0))
+        threads = [
+            threading.Thread(target=lambda: inf.process_suggestion(
+                "a", "b", "SIMILAR_TO", 0.9))
+            for _ in range(24)
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(30)
+        assert eng.edge_count() == 1
+        assert inf.stats.edges_created == 1
+
+
+class TestStatsAccounting:
+    def test_stats_track_every_path(self, setup):
+        inf, _, _ = setup
+        for _ in range(3):
+            inf.process_suggestion("a", "b", "SIMILAR_TO", 0.9)
+        inf.process_suggestion("a", "b", "SIMILAR_TO", 0.9)  # cooled
+        assert inf.stats.suggestions == 4
+        assert inf.stats.edges_created == 1
+        assert inf.stats.suppressed_cooldown == 1
