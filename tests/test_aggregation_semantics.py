@@ -1,0 +1,205 @@
+"""Port of aggregation_bugs_test.go + aggregation_adjacent_test.go —
+the aggregate semantics the reference pinned after production bugs:
+WHERE interplay with WITH aggregation, null handling in every aggregate,
+grouping by null keys, multi-key grouping, DISTINCT collect, HAVING-style
+post-aggregate WHERE, and ORDER BY on aggregated values.
+"""
+
+import pytest
+
+from nornicdb_tpu.cypher import CypherExecutor
+from nornicdb_tpu.storage import MemoryEngine, NamespacedEngine
+
+
+@pytest.fixture
+def ex():
+    """5 files with extensions (.ts x2, .md x3), 2 without — the exact
+    production-shaped fixture the bug reports used."""
+    e = CypherExecutor(NamespacedEngine(MemoryEngine(), "test"))
+    for i, ext in enumerate([".ts", ".ts", ".md", ".md", ".md"], 1):
+        e.execute(f"CREATE (:File {{name: 'file{i}', extension: '{ext}'}})")
+    e.execute("CREATE (:File {name: 'file6'})")
+    e.execute("CREATE (:File {name: 'file7'})")
+    return e
+
+
+@pytest.fixture
+def records():
+    """Deliberate nulls: A/10, A/20, A/null, B/30, null/40."""
+    e = CypherExecutor(NamespacedEngine(MemoryEngine(), "test"))
+    e.execute("CREATE (:Record {group: 'A', value: 10})")
+    e.execute("CREATE (:Record {group: 'A', value: 20})")
+    e.execute("CREATE (:Record {group: 'A'})")
+    e.execute("CREATE (:Record {group: 'B', value: 30})")
+    e.execute("CREATE (:Record {value: 40})")
+    return e
+
+
+class TestWhereWithAggregation:
+    """TestBug_WhereIsNotNullWithAggregation — the production bug: WHERE
+    IS NOT NULL before a WITH aggregation returned 0 rows."""
+
+    def test_where_before_with_aggregation(self, ex):
+        r = ex.execute("""
+            MATCH (f:File)
+            WHERE f.extension IS NOT NULL
+            WITH f.extension as ext, COUNT(f) as count
+            RETURN ext, count
+            ORDER BY count DESC
+        """)
+        got = {row[0]: row[1] for row in r.rows}
+        assert got == {".md": 3, ".ts": 2}
+        assert r.rows[0][0] == ".md"  # DESC order
+
+    def test_where_with_inline_aggregate_return(self, ex):
+        r = ex.execute("""
+            MATCH (f:File)
+            WHERE f.extension IS NOT NULL
+            RETURN f.extension as ext, count(*) as count
+        """)
+        assert {row[0]: row[1] for row in r.rows} == {".md": 3, ".ts": 2}
+
+    def test_filtered_total_count(self, ex):
+        r = ex.execute("""
+            MATCH (f:File)
+            WHERE f.extension IS NOT NULL
+            RETURN count(f) as count_with_ext
+        """)
+        assert r.rows == [[5]]
+
+    def test_count_in_with_clause_not_null(self, ex):
+        """TestBug_CountInWithClauseReturnsNull"""
+        r = ex.execute("""
+            MATCH (f:File)
+            WITH count(f) AS total
+            RETURN total
+        """)
+        assert r.rows == [[7]]
+
+
+class TestNullHandling:
+    def test_count_star_vs_count_prop(self, records):
+        """COUNT(*) includes null-prop rows, COUNT(prop) excludes them."""
+        r = records.execute("""
+            MATCH (r:Record)
+            RETURN count(*) as total, count(r.value) as with_value
+        """)
+        assert r.rows == [[5, 4]]
+
+    def test_group_by_null_key(self, records):
+        """Rows with a null grouping key form their own group."""
+        r = records.execute("""
+            MATCH (r:Record)
+            RETURN r.group as grp, count(*) as cnt
+        """)
+        got = {row[0]: row[1] for row in r.rows}
+        assert got == {"A": 3, "B": 1, None: 1}
+
+    def test_sum_avg_min_max_ignore_nulls(self, records):
+        r = records.execute("""
+            MATCH (r:Record)
+            WHERE r.group = 'A'
+            RETURN sum(r.value), avg(r.value), min(r.value), max(r.value)
+        """)
+        row = r.rows[0]
+        assert float(row[0]) == 30.0  # sum(10, 20, null)
+        assert float(row[1]) == 15.0  # avg over the 2 non-null values
+        assert row[2] == 10 and row[3] == 20
+
+    def test_aggregates_over_all_nulls(self, records):
+        """sum of no values is 0; avg/min/max of no values are null."""
+        r = records.execute("""
+            MATCH (r:Record)
+            WHERE r.group = 'ghost'
+            RETURN count(r), sum(r.value), avg(r.value)
+        """)
+        assert r.rows[0][0] == 0
+        assert float(r.rows[0][1]) == 0.0
+        assert r.rows[0][2] is None
+
+    def test_collect_skips_nulls(self, records):
+        r = records.execute("""
+            MATCH (r:Record)
+            RETURN collect(r.value) AS vals
+        """)
+        assert sorted(r.rows[0][0]) == [10, 20, 30, 40]  # null dropped
+
+
+class TestGroupingAndOrdering:
+    def test_multiple_group_keys(self, records):
+        """TestAggregation_MultipleGroupByColumns — every non-aggregate
+        projection is a grouping key."""
+        records.execute("CREATE (:Record {group: 'A', value: 10})")  # dup row
+        r = records.execute("""
+            MATCH (r:Record)
+            WHERE r.group IS NOT NULL AND r.value IS NOT NULL
+            RETURN r.group AS g, r.value AS v, count(*) AS c
+            ORDER BY g, v
+        """)
+        assert r.rows == [["A", 10, 2], ["A", 20, 1], ["B", 30, 1]]
+
+    def test_order_by_aggregate(self, ex):
+        """TestAggregation_OrderByAggregatedValue"""
+        r = ex.execute("""
+            MATCH (f:File)
+            WHERE f.extension IS NOT NULL
+            WITH f.extension AS ext, count(*) AS c
+            RETURN ext, c ORDER BY c ASC
+        """)
+        assert [row[1] for row in r.rows] == [2, 3]
+
+    def test_post_aggregate_where(self, ex):
+        """TestAggregation_WhereOnAggregatedResult — HAVING via WITH."""
+        r = ex.execute("""
+            MATCH (f:File)
+            WHERE f.extension IS NOT NULL
+            WITH f.extension AS ext, count(*) AS c
+            WHERE c > 2
+            RETURN ext, c
+        """)
+        assert r.rows == [[".md", 3]]
+
+    def test_multiple_aggregates_one_row(self, records):
+        """TestAggregation_WithMultipleAggregates"""
+        r = records.execute("""
+            MATCH (r:Record)
+            WITH count(*) AS cnt, sum(r.value) AS total, avg(r.value) AS mean
+            RETURN cnt, total, mean
+        """)
+        assert r.rows[0][0] == 5
+        assert float(r.rows[0][1]) == 100.0
+        assert float(r.rows[0][2]) == 25.0
+
+    def test_collect_distinct(self, ex):
+        """TestAggregation_CollectDistinct"""
+        r = ex.execute("""
+            MATCH (f:File)
+            WHERE f.extension IS NOT NULL
+            RETURN collect(DISTINCT f.extension) AS exts
+        """)
+        assert sorted(r.rows[0][0]) == [".md", ".ts"]
+
+    def test_chained_with_aggregates(self, ex):
+        """TestAggregation_ChainedWith — aggregate of an aggregate."""
+        r = ex.execute("""
+            MATCH (f:File)
+            WHERE f.extension IS NOT NULL
+            WITH f.extension AS ext, count(*) AS per_ext
+            WITH sum(per_ext) AS total_with_ext
+            RETURN total_with_ext
+        """)
+        assert r.rows == [[5]]
+
+    def test_count_distinct(self, ex):
+        r = ex.execute("""
+            MATCH (f:File)
+            RETURN count(DISTINCT f.extension) AS distinct_exts
+        """)
+        assert r.rows == [[2]]  # nulls excluded from count(prop)
+
+    def test_empty_match_aggregate_row(self):
+        """TestAggregation_EdgeCases — aggregates over an empty match still
+        produce ONE row."""
+        e = CypherExecutor(MemoryEngine())
+        r = e.execute("MATCH (x:Nothing) RETURN count(x), collect(x.v)")
+        assert r.rows == [[0, []]]
