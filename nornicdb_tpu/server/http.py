@@ -605,7 +605,64 @@ class HttpServer:
                 stats["wal"] = wal
             h._send(200, stats)
             return
+        if path == "/admin/config":
+            # (ref: handleAdminConfig server_admin.go:64 — running config
+            # view + runtime feature flags)
+            h._auth("admin")
+            from nornicdb_tpu.config import flags
+
+            # secret material never leaves the process, even for admins:
+            # the response flows through proxies and ends up in logs
+            secret = ("passphrase", "password", "secret", "token", "api_key")
+            cfg = {
+                k: ("<redacted>" if v and any(s in k for s in secret) else v)
+                for k, v in vars(self.db.config).items()
+                # feature_flags on Config is an inert seed field; the live
+                # registry is the top-level feature_flags key below
+                if not k.startswith("_") and k != "feature_flags"
+            }
+            h._send(200, {"config": cfg, "feature_flags": flags.all()})
+            return
+        if path == "/admin/tpu/status":
+            # the reference's /admin/gpu/status analogue: accelerator
+            # availability WITHOUT forcing backend init (a down relay
+            # would hang the admin surface for minutes)
+            h._auth("admin")
+            h._send(200, self._tpu_status())
+            return
         h._send(404, {"error": f"not found: {path}"})
+
+    def _tpu_status(self) -> dict:
+        """(ref: server_gpu.go:14 handleGPUStatus). Reports from already-
+        initialised JAX state only — probing an uninitialised backend can
+        block for minutes when the device relay is down."""
+        import jax
+
+        out = {"framework": "jax", "backend_initialized": False,
+               "devices": [], "platform": None}
+        try:
+            # backends are registered only after first real device use
+            from jax._src import xla_bridge
+
+            if hasattr(xla_bridge, "backends_are_initialized"):
+                initialized = xla_bridge.backends_are_initialized()
+            else:  # older/newer jax without the public check
+                initialized = bool(getattr(xla_bridge, "_backends", {}))
+        except Exception:
+            initialized = False
+        if not initialized:
+            out["note"] = ("backend not initialised yet; first search or "
+                           "embed will initialise it")
+            return out
+        try:
+            devs = jax.devices()
+            out["backend_initialized"] = True
+            out["platform"] = devs[0].platform if devs else None
+            out["devices"] = [str(d) for d in devs]
+            out["device_count"] = len(devs)
+        except Exception as e:  # relay flapped mid-call
+            out["error"] = str(e)[:200]
+        return out
 
     def _prometheus(self) -> str:
         """(ref: server_public.go:141-200 — hand-rendered text format)"""
@@ -986,6 +1043,38 @@ class HttpServer:
             result = self.db.heimdall.chat(messages, max_tokens, model=model)
             # OpenAI-compatible: invalid_request_error -> 404/400 status
             h._send(404 if "error" in result else 200, result)
+            return
+        if path == "/admin/config":
+            # runtime feature-flag updates (ref: handleAdminConfig POST —
+            # the reference's runtime flag registry); static config stays
+            # immutable at runtime
+            h._auth("admin")
+            from nornicdb_tpu.config import flags
+
+            body = h._body()
+            # only absent/null means "no updates": `or {}` would let falsy
+            # non-dicts ([], false, 0) skip the shape check below
+            updates = body.get("feature_flags")
+            if updates is None:
+                updates = {}
+            if not isinstance(updates, dict):
+                h._send(400, {"error": "feature_flags must be an object"})
+                return
+            unknown = [k for k in updates if k not in flags.all()]
+            if unknown:
+                h._send(400, {"error": f"unknown feature flags: {unknown}",
+                              "valid": sorted(flags.all())})
+                return
+            # strict booleans only: bool("false") is True, so coercing
+            # would silently ENABLE a flag the client meant to disable
+            bad = [k for k, v in updates.items() if not isinstance(v, bool)]
+            if bad:
+                h._send(400, {"error":
+                              f"feature flag values must be booleans: {bad}"})
+                return
+            for k, v in updates.items():
+                flags.set(k, v)
+            h._send(200, {"feature_flags": flags.all()})
             return
         h._send(404, {"error": f"not found: {path}"})
 

@@ -245,6 +245,18 @@ def build_spec(version: str = "0.4.0") -> dict:
             req={"type": "object",
                  "required": ["path"],
                  "properties": {"path": {"type": "string"}}})},
+        "/admin/config": {
+            "get": _op("Running configuration + runtime feature flags",
+                       tag="admin"),
+            "post": _op(
+                "Toggle runtime feature flags", tag="admin",
+                req={"type": "object",
+                     "properties": {"feature_flags": {"type": "object"}}}),
+        },
+        "/admin/tpu/status": {"get": _op(
+            "Accelerator status (the reference's /admin/gpu/status "
+            "analogue); reports initialised-backend state only, never "
+            "blocks on a down device relay", tag="admin")},
         # -- compliance ------------------------------------------------------
         "/gdpr/export": {"post": _op(
             "Export all data for a subject (GDPR right of access)",

@@ -125,3 +125,93 @@ class TestSpecMatchesHandlers:
         # auth'd ops reference the schemes
         tx = spec["paths"]["/db/{database}/tx/commit"]["post"]
         assert any("bearerAuth" in s for s in tx["security"])
+
+
+class TestAdminConfigEndpoints:
+    """ref: server_admin.go handleAdminConfig + server_gpu.go status."""
+
+    def test_get_config_and_flags(self, server):
+        status = _call(server.port, "GET", "/admin/config")
+        assert status == 200
+        raw = json.loads(urllib.request.urlopen(
+            f"http://127.0.0.1:{server.port}/admin/config").read())
+        assert "config" in raw and "feature_flags" in raw
+        assert isinstance(raw["feature_flags"], dict)
+
+    def test_post_toggles_flag_and_rejects_unknown(self, server):
+        import urllib.error as _err
+
+        url = f"http://127.0.0.1:{server.port}/admin/config"
+        flags = json.loads(urllib.request.urlopen(url).read())["feature_flags"]
+        name = sorted(flags)[0]
+
+        def post(payload):
+            req = urllib.request.Request(
+                url, data=json.dumps(payload).encode(),
+                method="POST", headers={"Content-Type": "application/json"})
+            return json.loads(urllib.request.urlopen(req).read())
+
+        try:
+            out = post({"feature_flags": {name: not flags[name]}})
+            assert out["feature_flags"][name] == (not flags[name])
+        finally:
+            # the flags registry is process-global — always restore, or a
+            # failure here poisons every later test in the run
+            post({"feature_flags": {name: flags[name]}})
+        # unknown flag -> 400 with the valid set
+        with pytest.raises(_err.HTTPError) as e:
+            post({"feature_flags": {"bogus_flag": True}})
+        assert e.value.code == 400
+        # non-boolean value -> 400 (bool("false") is True; coercion would
+        # silently enable a flag the client asked to disable)
+        with pytest.raises(_err.HTTPError) as e:
+            post({"feature_flags": {name: "false"}})
+        assert e.value.code == 400
+        after = json.loads(urllib.request.urlopen(url).read())
+        assert after["feature_flags"][name] == flags[name]
+
+    def test_config_redacts_secret_material(self):
+        """encryption_passphrase etc. must never appear in responses —
+        they flow through proxies and logs. Uses its own server with a
+        passphrase actually SET, so the assertion is never vacuous."""
+        db = nornicdb_tpu.open_db("")
+        db.config.encryption_passphrase = "hunter2-redact-probe"
+        s = HttpServer(db, port=0)
+        s.start()
+        try:
+            raw = urllib.request.urlopen(
+                f"http://127.0.0.1:{s.port}/admin/config").read().decode()
+            assert "hunter2-redact-probe" not in raw
+            cfg = json.loads(raw)["config"]
+            assert cfg["encryption_passphrase"] == "<redacted>"
+            # the inert Config.feature_flags seed must not shadow the live
+            # top-level registry
+            assert "feature_flags" not in cfg
+        finally:
+            s.stop()
+            db.close()
+
+    def test_post_falsy_non_dict_feature_flags_rejected(self, server):
+        """[] / false / 0 must 400 like any other non-object, not be
+        silently coerced to 'no updates'."""
+        import urllib.error as _err
+
+        url = f"http://127.0.0.1:{server.port}/admin/config"
+        for bad in ([], False, 0, "x"):
+            req = urllib.request.Request(
+                url, data=json.dumps({"feature_flags": bad}).encode(),
+                method="POST", headers={"Content-Type": "application/json"})
+            with pytest.raises(_err.HTTPError) as e:
+                urllib.request.urlopen(req)
+            assert e.value.code == 400, bad
+
+    def test_tpu_status_never_blocks(self, server):
+        import time as _time
+
+        t0 = _time.time()
+        raw = json.loads(urllib.request.urlopen(
+            f"http://127.0.0.1:{server.port}/admin/tpu/status",
+            timeout=10).read())
+        assert _time.time() - t0 < 5, "status endpoint must not block"
+        assert raw["framework"] == "jax"
+        assert "backend_initialized" in raw
