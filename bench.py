@@ -47,12 +47,20 @@ import time
 # stderr.
 
 _CHILD_ENV = "NORNICDB_BENCH_CHILD"
+_CPU_FB_ENV = "NORNICDB_BENCH_CPU_FALLBACK"
 # r03 exhausted a 900s budget while the relay stayed down; observed
 # down-windows run for hours, so the official capture waits much longer —
 # a zeroed BENCH artifact costs the round more than the wait costs the run
 ACQUIRE_BUDGET_S = float(os.environ.get("NORNICDB_BENCH_ACQUIRE_BUDGET_S", "2400"))
-PROBE_TIMEOUT_S = 150.0  # jax.devices() hangs >90s when the relay is down
+PROBE_TIMEOUT_S = float(os.environ.get(
+    "NORNICDB_BENCH_PROBE_TIMEOUT_S", "150"
+))  # jax.devices() hangs >90s when the relay is down
 CHILD_TIMEOUT_S = float(os.environ.get("NORNICDB_BENCH_CHILD_TIMEOUT_S", "1500"))
+# measured full-size cpu fallback: ~3 min end to end; 600s is ample and is
+# reserved out of ACQUIRE_BUDGET_S so the total stays inside the budget
+FALLBACK_TIMEOUT_S = float(
+    os.environ.get("NORNICDB_BENCH_FALLBACK_TIMEOUT_S", "600")
+)
 
 _BACKEND_ERR_MARKERS = (
     "UNAVAILABLE",
@@ -105,19 +113,17 @@ def _acquire_backend(deadline: float) -> str | None:
         delay = min(delay * 1.7, 120.0)
 
 
-def _run_child() -> int | None:
-    """Run the real bench in a child; forward its stdout JSON line through.
-
-    Returns the final exit code, or None when the attempt is retryable
-    (timeout, backend-unavailable error, or signal death — a crashing TPU
-    client is a relay symptom too)."""
-    env = dict(os.environ, **{_CHILD_ENV: "1"})
+def _spawn_child(extra_env: dict, timeout_s: float):
+    """Run this file as a child bench process. Returns the CompletedProcess,
+    or None on timeout (after forwarding whatever the child printed — the
+    only diagnostics a killed child leaves)."""
+    env = dict(os.environ, **{_CHILD_ENV: "1"}, **extra_env)
     try:
-        r = subprocess.run(
+        return subprocess.run(
             [sys.executable, os.path.abspath(__file__)],
             capture_output=True,
             text=True,
-            timeout=CHILD_TIMEOUT_S,
+            timeout=timeout_s,
             env=env,
         )
     except subprocess.TimeoutExpired as e:
@@ -126,15 +132,30 @@ def _run_child() -> int | None:
                 sys.stderr.write(
                     buf if isinstance(buf, str) else buf.decode(errors="replace")
                 )
-        _log(f"bench child exceeded {CHILD_TIMEOUT_S:.0f}s; will retry if budget allows")
+        _log(f"bench child exceeded {timeout_s:.0f}s")
+        return None
+
+
+def _forward_result(stdout: str) -> None:
+    for line in stdout.splitlines():
+        if line.startswith("{"):
+            print(line, flush=True)
+
+
+def _run_child() -> int | None:
+    """Run the real bench in a child; forward its stdout JSON line through.
+
+    Returns the final exit code, or None when the attempt is retryable
+    (timeout, backend-unavailable error, or signal death — a crashing TPU
+    client is a relay symptom too)."""
+    r = _spawn_child({}, CHILD_TIMEOUT_S)
+    if r is None:
+        _log("will retry if budget allows")
         return None
     if r.stderr:
         sys.stderr.write(r.stderr)
     if r.returncode == 0:
-        # forward only the result line(s) to stdout
-        for line in r.stdout.splitlines():
-            if line.startswith("{"):
-                print(line, flush=True)
+        _forward_result(r.stdout)
         return 0
     if r.returncode < 0:
         _log(f"bench child died with signal {-r.returncode}; retryable")
@@ -148,18 +169,44 @@ def _run_child() -> int | None:
     return r.returncode
 
 
+def _run_fallback_child() -> int:
+    """TPU never came up: measure the identical workload on the host CPU so
+    the round still records a real number. The JSON labels itself
+    cpu_fallback (metric name suffixed _cpu) and compares against the
+    reference's published CPU figure (20 qps AVX2 @1M x 1024d), never the
+    A100 one — an honest artifact beats an empty one."""
+    r = _spawn_child({_CPU_FB_ENV: "1"}, FALLBACK_TIMEOUT_S)
+    if r is None:
+        _log("cpu fallback bench timed out")
+        return 2
+    if r.stderr:
+        sys.stderr.write(r.stderr)
+    if r.returncode != 0:
+        _log(f"cpu fallback bench failed rc={r.returncode}")
+        sys.stderr.write(r.stdout)
+        return 2
+    _forward_result(r.stdout)
+    return 0
+
+
 def _orchestrate() -> int:
-    deadline = time.monotonic() + ACQUIRE_BUDGET_S
+    # the fallback leg's time is CARVED OUT of the overall budget, so the
+    # worst-case wall clock stays ~ACQUIRE_BUDGET_S and the driver never
+    # kills the process mid-fallback (which would zero the artifact — the
+    # exact failure the fallback exists to prevent)
+    deadline = time.monotonic() + ACQUIRE_BUDGET_S - FALLBACK_TIMEOUT_S
     while True:
         if _acquire_backend(deadline) is None:
-            _log(f"backend never came up within {ACQUIRE_BUDGET_S:.0f}s; giving up")
-            return 2
+            _log("backend never came up within the acquire window; "
+                 "falling back to a cpu-labeled capture")
+            return _run_fallback_child()
         rc = _run_child()
         if rc is not None:
             return rc
         if time.monotonic() >= deadline:
-            _log("retry budget exhausted after child failure; giving up")
-            return 2
+            _log("retry budget exhausted after child failure; "
+                 "falling back to a cpu-labeled capture")
+            return _run_fallback_child()
 
 N = 1_000_000
 D = 1024
@@ -188,8 +235,107 @@ def _best5(fn) -> float:
     return min(times)
 
 
+def _build_xla_search(jax, jnp, l2_normalize, n_pad: int, n_valid: int,
+                      exact: bool):
+    """Corpus + jit'd batched GEMM top-k shared by the TPU xla path and the
+    CPU fallback. `exact` picks lax.top_k (CPU: approx_max_k adds nothing)
+    over approx_max_k (TPU: avoids the full sort)."""
+
+    @jax.jit
+    def make_corpus(key):
+        return l2_normalize(jax.random.normal(key, (n_pad, D), jnp.bfloat16))
+
+    corpus = make_corpus(jax.random.PRNGKey(0))
+    valid = jnp.arange(n_pad) < n_valid
+
+    @functools.partial(jax.jit, static_argnames=("k",))
+    def scan_search(qbatches, corpus, valid, k):
+        def one(carry, q):
+            s = jax.lax.dot_general(
+                q, corpus,
+                dimension_numbers=(((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            )
+            s = jnp.where(valid[None, :], s, -jnp.inf)
+            if exact:
+                v, i = jax.lax.top_k(s, k)
+            else:
+                v, i = jax.lax.approx_max_k(s, k, recall_target=0.95)
+            return carry, (v, i)
+
+        _, out = jax.lax.scan(one, 0, qbatches)
+        return out
+
+    return corpus, valid, scan_search
+
+
+def _cpu_fallback_bench(jax, jnp, np, l2_normalize, dev) -> None:
+    """Same corpus scale (1M x 1024d, top-100) on the host CPU via XLA.
+
+    Smaller query load than the TPU run (CPU GEMM is ~2 orders slower) and
+    compared against the reference's published CPU number at this exact
+    scale: 20 qps / 50 ms AVX2 (gpu-acceleration.md:117-123) — CPU vs CPU,
+    never CPU vs A100. A reduced corpus (NORNICDB_BENCH_FB_N, tests only)
+    is labeled by row count and gets NO baseline ratio: the 20 qps figure
+    only applies at the full scale."""
+    n = int(os.environ.get("NORNICDB_BENCH_FB_N", str(N)))
+    np_pad = ((n + STILE - 1) // STILE) * STILE
+    batch, iters = 64, 2
+    k = min(K, n)
+    full_scale = n == N
+
+    corpus, valid, scan_search = _build_xla_search(
+        jax, jnp, l2_normalize, np_pad, n, exact=True)
+
+    total_q = batch * iters
+    qb = l2_normalize(
+        jax.random.normal(jax.random.PRNGKey(1), (iters, batch, D),
+                          jnp.bfloat16))
+    v, _ = scan_search(qb, corpus, valid, k)
+    np.asarray(v)  # compile + sync
+    times = []
+    for _ in range(3):
+        t0 = time.perf_counter()
+        np.asarray(scan_search(qb, corpus, valid, k)[0])
+        times.append(time.perf_counter() - t0)
+    dt = min(times)
+    qps = total_q / dt
+    cpu_baseline_qps = 20.0  # reference CPU AVX2 @1M x 1024d
+    scale = f"{n // 1_000_000}M" if full_scale else f"{n}rows"
+    note = ("tpu relay unreachable for the whole acquire budget; measured "
+            "on host cpu, vs_baseline is against the reference's published "
+            "CPU AVX2 figure (20 qps) at the same 1M x 1024d scale — not "
+            "the A100 figure") if full_scale else (
+            "reduced-scale cpu run (NORNICDB_BENCH_FB_N set); no baseline "
+            "ratio — the reference CPU figure only applies at 1M x 1024d")
+    print(json.dumps({
+        "metric": f"knn_top{k}_{scale}_{D}d_qps_cpu",
+        "value": round(qps, 1),
+        "unit": "queries/sec",
+        "vs_baseline": round(qps / cpu_baseline_qps, 2) if full_scale
+        else 0.0,
+        "detail": {
+            "backend": "cpu_fallback",
+            "note": note,
+            "batch": batch,
+            "batches": iters,
+            "ms_per_batch": round(dt / iters * 1000.0, 3),
+            "device": str(dev),
+            "path": "xla",
+        },
+    }))
+
+
 def main() -> None:
     import jax
+
+    cpu_fallback = os.environ.get(_CPU_FB_ENV) == "1"
+    if cpu_fallback:
+        # the axon sitecustomize overrides the JAX_PLATFORMS env var, so the
+        # backend must be pinned in-process BEFORE first device use — this
+        # also stops jax from touching the down relay at all
+        jax.config.update("jax_platforms", "cpu")
+
     import jax.numpy as jnp
     import numpy as np
 
@@ -202,28 +348,13 @@ def main() -> None:
 
     dev = jax.devices()[0]
     on_tpu = dev.platform == "tpu"
+    if cpu_fallback:
+        _cpu_fallback_bench(jax, jnp, np, l2_normalize, dev)
+        return
 
-    @jax.jit
-    def make_corpus(key):
-        return l2_normalize(jax.random.normal(key, (NP, D), jnp.bfloat16))
-
-    corpus = make_corpus(jax.random.PRNGKey(0))
-    valid = jnp.arange(NP) < N  # padding rows masked out of every search
-
-    @functools.partial(jax.jit, static_argnames=("k",))
-    def scan_search(qbatches, corpus, valid, k):
-        def one(carry, q):
-            s = jax.lax.dot_general(
-                q, corpus,
-                dimension_numbers=(((1,), (1,)), ((), ())),
-                preferred_element_type=jnp.float32,
-            )
-            s = jnp.where(valid[None, :], s, -jnp.inf)
-            v, i = jax.lax.approx_max_k(s, k, recall_target=0.95)
-            return carry, (v, i)
-
-        _, out = jax.lax.scan(one, 0, qbatches)
-        return out
+    # padding rows masked out of every search
+    corpus, valid, scan_search = _build_xla_search(
+        jax, jnp, l2_normalize, NP, N, exact=False)
 
     @functools.partial(jax.jit, static_argnames=("k", "epilogue"))
     def scan_search_streaming(qchunks, corpus, valid, k, epilogue="sort"):

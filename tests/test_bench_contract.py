@@ -1,0 +1,59 @@
+"""bench.py artifact contract.
+
+The driver records bench.py's stdout as the round's official benchmark
+artifact. Rounds 2 and 3 recorded NOTHING because the device relay was down
+for the whole acquire budget and bench.py exited non-zero without printing.
+The contract pinned here: the CPU-fallback leg always produces exactly one
+JSON line with the required keys, honestly labeled (backend=cpu_fallback,
+vs_baseline computed against the reference's published CPU figure).
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+BENCH = os.path.join(os.path.dirname(__file__), "..", "bench.py")
+
+
+class TestCpuFallback:
+    def test_fallback_child_prints_one_json_line(self):
+        env = dict(
+            os.environ,
+            NORNICDB_BENCH_CHILD="1",
+            NORNICDB_BENCH_CPU_FALLBACK="1",
+            NORNICDB_BENCH_FB_N="2048",  # tiny corpus: contract, not perf
+        )
+        r = subprocess.run(
+            [sys.executable, BENCH], capture_output=True, text=True,
+            timeout=240, env=env,
+        )
+        assert r.returncode == 0, r.stderr[-2000:]
+        lines = [ln for ln in r.stdout.splitlines() if ln.strip()]
+        assert len(lines) == 1, lines
+        out = json.loads(lines[0])
+        assert set(out) >= {"metric", "value", "unit", "vs_baseline"}
+        assert out["value"] > 0
+        assert out["detail"]["backend"] == "cpu_fallback"
+        # a cpu number must never masquerade as the tpu metric series
+        assert out["metric"].endswith("_qps_cpu")
+        # reduced scale (FB_N != 1M): labeled by row count and NO baseline
+        # ratio — the reference CPU figure only applies at full scale
+        assert "2048rows" in out["metric"]
+        assert out["vs_baseline"] == 0.0
+        assert "reduced-scale" in out["detail"]["note"]
+
+    def test_orchestrator_constants_sane(self):
+        """The acquire budget bounds the whole run — the fallback leg is
+        carved OUT of it, not appended — and the probe timeout must exceed
+        the observed 90s relay hang."""
+        import importlib.util
+
+        spec = importlib.util.spec_from_file_location("bench_mod", BENCH)
+        mod = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(mod)
+        assert mod.PROBE_TIMEOUT_S > 90
+        assert 0 < mod.ACQUIRE_BUDGET_S <= 3600
+        assert mod.CHILD_TIMEOUT_S >= 600
+        # the fallback must fit inside the budget with acquire time left over
+        assert mod.FALLBACK_TIMEOUT_S < mod.ACQUIRE_BUDGET_S / 2
