@@ -1,0 +1,218 @@
+"""LDBC-SNB-flavored + Northwind query benchmark.
+
+The reference publishes these headline numbers (README.md:208-225, M3 Max)
+without shipping the harness, so this reimplements the standard query
+shapes behind each row and measures them on this engine:
+
+LDBC (social graph: persons/cities/messages/tags):
+  message_lookup    MATCH (m:Message {id: $id}) RETURN m.content
+  recent_messages   friend's messages, ORDER BY created DESC LIMIT 10
+  avg_friends_city  two-hop aggregate grouped by city
+  tag_cooccurrence  shared-message tag pairs, counted + ranked
+
+Northwind (products):
+  index_lookup      MATCH (p:Product {sku: $sku}) RETURN p.name
+  count_nodes       MATCH (p:Product) RETURN count(p)
+  write_node        CREATE a product
+  write_edge        CREATE supplier->product edge between matched nodes
+
+Lookups and writes draw fresh params per iteration so the query-result cache
+cannot serve them; the two heavy aggregates are reported BOTH ways
+(cold = cache bypassed per call, cached = steady-state repeat of the same
+query, which is how a dashboard actually hits it).
+
+Run: python benchmarks/ldbc_bench.py [--scale N] [--seconds S] [--json]
+Reference ops/s are from different hardware (M3 Max); ratios are printed
+for orientation, not as a same-hardware claim.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+
+sys.path.insert(0, __file__.rsplit("/benchmarks", 1)[0])
+
+import numpy as np
+
+from nornicdb_tpu.cache import QueryCache
+from nornicdb_tpu.cypher import CypherExecutor
+from nornicdb_tpu.storage import MemoryEngine
+from nornicdb_tpu.storage.types import Edge, Node
+
+REFERENCE = {  # README.md:208-225 (M3 Max)
+    "message_lookup": 6389.0,
+    "recent_messages": 2769.0,
+    "avg_friends_city": 4713.0,
+    "tag_cooccurrence": 2076.0,
+    "index_lookup": 7623.0,
+    "count_nodes": 5253.0,
+    "write_node": 5578.0,
+    "write_edge": 6626.0,
+}
+
+
+def build_social(scale: int) -> CypherExecutor:
+    """persons=scale, messages=10*scale, tags=scale//10, cities=20."""
+    rng = np.random.default_rng(7)
+    eng = MemoryEngine()
+    n_person, n_msg = scale, 10 * scale
+    n_tag, n_city = max(scale // 10, 5), 20
+    for c in range(n_city):
+        eng.create_node(Node(id=f"city{c}", labels=["City"],
+                             properties={"name": f"City{c}"}))
+    for t in range(n_tag):
+        eng.create_node(Node(id=f"tag{t}", labels=["Tag"],
+                             properties={"name": f"tag{t}"}))
+    for p in range(n_person):
+        eng.create_node(Node(id=f"p{p}", labels=["Person"],
+                             properties={"id": p, "name": f"Person {p}"}))
+        eng.create_edge(Edge(id=f"lv{p}", start_node=f"p{p}",
+                             end_node=f"city{rng.integers(n_city)}",
+                             type="LIVES_IN"))
+    # KNOWS: avg degree ~10, undirected-by-convention single edge
+    k = 0
+    for p in range(n_person):
+        for q in rng.choice(n_person, 5, replace=False):
+            if int(q) != p:
+                eng.create_edge(Edge(id=f"k{k}", start_node=f"p{p}",
+                                     end_node=f"p{int(q)}", type="KNOWS"))
+                k += 1
+    created = rng.integers(0, 1_000_000, n_msg)
+    for m in range(n_msg):
+        eng.create_node(Node(
+            id=f"m{m}", labels=["Message"],
+            properties={"id": m, "content": f"message body {m}",
+                        "created": int(created[m])}))
+        eng.create_edge(Edge(id=f"po{m}", start_node=f"p{rng.integers(n_person)}",
+                             end_node=f"m{m}", type="POSTED"))
+        for t in rng.choice(n_tag, 2, replace=False):
+            eng.create_edge(Edge(id=f"ht{m}_{t}", start_node=f"m{m}",
+                                 end_node=f"tag{int(t)}", type="HAS_TAG"))
+    ex = CypherExecutor(eng, cache=QueryCache())
+    ex.execute("CREATE INDEX FOR (m:Message) ON (m.id)")
+    ex.execute("CREATE INDEX FOR (p:Person) ON (p.id)")
+    return ex
+
+
+def build_northwind(scale: int) -> CypherExecutor:
+    eng = MemoryEngine()
+    for i in range(scale):
+        eng.create_node(Node(id=f"prod{i}", labels=["Product"],
+                             properties={"sku": f"SKU-{i}",
+                                         "name": f"Product {i}"}))
+    for s in range(max(scale // 20, 2)):
+        eng.create_node(Node(id=f"sup{s}", labels=["Supplier"],
+                             properties={"id": s, "name": f"Supplier {s}"}))
+    ex = CypherExecutor(eng, cache=QueryCache())
+    ex.execute("CREATE INDEX FOR (p:Product) ON (p.sku)")
+    ex.execute("CREATE INDEX FOR (s:Supplier) ON (s.id)")
+    return ex
+
+
+def timed(fn, seconds: float, warmup: int = 20):
+    for _ in range(warmup):
+        fn(-1)
+    n, t0 = 0, time.perf_counter()
+    deadline = t0 + seconds
+    while time.perf_counter() < deadline:
+        fn(n)
+        n += 1
+    dt = time.perf_counter() - t0
+    return n / dt, dt / n * 1000.0
+
+
+def run(scale: int, seconds: float) -> dict:
+    rng = np.random.default_rng(11)
+    social = build_social(scale)
+    north = build_northwind(scale * 2)
+    n_person, n_msg = scale, 10 * scale
+    out = {}
+
+    def rec(name, fn, **extra):
+        qps, ms = timed(fn, seconds)
+        ref = REFERENCE[name]
+        out[name] = {"ops_per_sec": round(qps, 1), "ms_per_op": round(ms, 4),
+                     "reference_ops_per_sec": ref,
+                     "vs_reference": round(qps / ref, 2), **extra}
+
+    rec("message_lookup", lambda i: social.execute(
+        "MATCH (m:Message {id: $id}) RETURN m.content",
+        {"id": int(rng.integers(n_msg))}))
+    rec("recent_messages", lambda i: social.execute(
+        "MATCH (p:Person {id: $id})-[:KNOWS]-(f:Person)-[:POSTED]->(m:Message) "
+        "RETURN m.content, m.created ORDER BY m.created DESC LIMIT 10",
+        {"id": int(rng.integers(n_person))}))
+
+    agg_friends = (
+        "MATCH (c:City)<-[:LIVES_IN]-(p:Person)-[:KNOWS]-(f:Person) "
+        "WITH c.name AS city, p, count(f) AS friends "
+        "RETURN city, avg(friends) AS avg_friends ORDER BY city")
+    agg_tags = (
+        "MATCH (t1:Tag)<-[:HAS_TAG]-(m:Message)-[:HAS_TAG]->(t2:Tag) "
+        "WHERE t1.name < t2.name "
+        "RETURN t1.name, t2.name, count(m) AS c ORDER BY c DESC LIMIT 10")
+    for name, q in (("avg_friends_city", agg_friends),
+                    ("tag_cooccurrence", agg_tags)):
+        # cold: distinct no-op param per call defeats the result cache
+        cold_qps, cold_ms = timed(
+            lambda i, q=q: social.execute(q, {"nonce": i}), seconds)
+        rec(name, lambda i, q=q: social.execute(q),
+            cold_ops_per_sec=round(cold_qps, 1),
+            cold_ms_per_op=round(cold_ms, 4))
+
+    rec("index_lookup", lambda i: north.execute(
+        "MATCH (p:Product {sku: $sku}) RETURN p.name",
+        {"sku": f"SKU-{int(rng.integers(scale * 2))}"}))
+    rec("count_nodes", lambda i: north.execute(
+        "MATCH (p:Product) RETURN count(p)"))
+    rec("write_node", lambda i: north.execute(
+        "CREATE (:Product {sku: $sku, name: 'bench'})",
+        {"sku": f"W-{i}-{int(rng.integers(1 << 30))}"}))
+    n_sup = max(scale * 2 // 20, 2)
+    rec("write_edge", lambda i: north.execute(
+        "MATCH (s:Supplier {id: $sid}), (p:Product {sku: $sku}) "
+        "CREATE (s)-[:SUPPLIES]->(p)",
+        {"sid": int(rng.integers(n_sup)),
+         "sku": f"SKU-{int(rng.integers(scale * 2))}"}))
+    return out
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--scale", type=int, default=1000,
+                    help="persons; messages = 10x this")
+    ap.add_argument("--seconds", type=float, default=2.0,
+                    help="timed window per query")
+    ap.add_argument("--json", action="store_true")
+    args = ap.parse_args()
+    t0 = time.time()
+    results = run(args.scale, args.seconds)
+    report = {
+        "suite": "ldbc_northwind",
+        "scale": {"persons": args.scale, "messages": 10 * args.scale},
+        "note": ("reference figures are the published M3 Max numbers "
+                 "(README.md:208-225); different hardware — ratios are "
+                 "orientation, not a same-box claim"),
+        "results": results,
+        "wall_s": round(time.time() - t0, 1),
+    }
+    if args.json:
+        print(json.dumps(report))
+        return
+    print(f"scale: {report['scale']}  ({report['wall_s']}s total)")
+    hdr = f"{'query':20} {'ops/s':>10} {'ms/op':>9} {'ref ops/s':>10} {'vs ref':>7}"
+    print(hdr)
+    print("-" * len(hdr))
+    for name, r in results.items():
+        print(f"{name:20} {r['ops_per_sec']:>10} {r['ms_per_op']:>9} "
+              f"{r['reference_ops_per_sec']:>10} {r['vs_reference']:>7}")
+        if "cold_ops_per_sec" in r:
+            print(f"{'  (cold/uncached)':20} {r['cold_ops_per_sec']:>10} "
+                  f"{r['cold_ms_per_op']:>9}")
+
+
+if __name__ == "__main__":
+    main()

@@ -99,7 +99,16 @@ class CypherExecutor:
         log_queries: bool = False,
     ):
         self.storage = storage
-        self.schema = schema or SchemaManager()
+        if schema is None:
+            # a self-created schema must hear the engine's write events, or
+            # an index created before data silently never indexes anything.
+            # Lazy: the subscription (and any node scan) only happens at
+            # the first index/constraint DDL, so per-request executors over
+            # a shared engine cost nothing (the DB facade attaches its own
+            # schema eagerly; a passed-in one is the caller's to wire).
+            schema = SchemaManager()
+            schema.attach_lazy(storage)
+        self.schema = schema
         self.db = db  # DB facade: embedder, search service, multidb hooks
         self.cache = cache  # QueryCache (ref: pkg/cache wiring main.go:320)
         # per-executor (NOT process-global: two DBs in one process must not
@@ -264,28 +273,29 @@ class CypherExecutor:
             return None
         if not isinstance(ret, ast.ReturnClause):
             return None
-        if (
-            ret.distinct
-            or ret.order_by
-            or ret.skip is not None
-            or ret.limit is not None
-            or ret.star
-            or len(match.patterns) != 1
-        ):
+        if ret.star or len(match.patterns) != 1:
             return None
         pattern = match.patterns[0]
         if pattern.name or pattern.shortest:
             return None
         els = pattern.elements
-        for detector in (
-            self._fp_count,
-            self._fp_group_count,
-            self._fp_edge_agg,
-            self._fp_mutual_rel,
+        if not (
+            ret.distinct
+            or ret.order_by
+            or ret.skip is not None
+            or ret.limit is not None
         ):
-            r = detector(match, ret, els, params)
-            if r is not None:
-                return r
+            for detector in (
+                self._fp_count,
+                self._fp_group_count,
+                self._fp_edge_agg,
+                self._fp_mutual_rel,
+            ):
+                r = detector(match, ret, els, params)
+                if r is not None:
+                    return r
+        if not ret.distinct:
+            return self._fp_anchored_traverse(match, ret, els, params)
         return None
 
     def _fp_count(self, match, ret, els, params) -> Optional[Result]:
@@ -598,6 +608,202 @@ class CypherExecutor:
             else:
                 total += c * cnt.get((d, s), 0)
         return Result([ret.items[0].key], [[total]])
+
+    _FP_TRAVERSE_MAX_ANCHORS = 64
+
+    def _fp_anchored_traverse(self, match, ret, els, params) -> Optional[Result]:
+        """Anchored fixed-length chain with property projections, e.g.
+        MATCH (p:Person {id: $id})-[:KNOWS]-(f)-[:POSTED]->(m)
+        RETURN m.content ORDER BY m.created DESC LIMIT 10
+        — walked directly on the adjacency store: no per-row binding dicts,
+        no generic expression evaluation (ref: optimized_executors.go
+        anchored traversal family). Relationship isomorphism is enforced
+        (an edge binds at most one hop); node repeats are allowed."""
+        if match.where is not None:
+            return None
+        if ret.order_by is None and ret.limit is None:
+            # without ORDER BY/LIMIT the generic path covers more shapes;
+            # this detector exists for the hot sorted/limited traversal
+            return None
+        n_els = len(els)
+        if n_els < 3 or n_els % 2 == 0:
+            return None
+        nodes = els[0::2]
+        rels = els[1::2]
+        if not all(isinstance(n, ast.NodePattern) for n in nodes):
+            return None
+        if not all(isinstance(r, ast.RelPattern) for r in rels):
+            return None
+        anchor = nodes[0]
+        if anchor.properties is None or anchor.where is not None:
+            return None
+        for n in nodes[1:]:
+            if n.properties is not None or n.where is not None:
+                return None
+        for r in rels:
+            if (r.variable or r.properties or r.var_length
+                    or r.min_hops != 1 or r.max_hops != 1 or not r.types):
+                return None
+        # variable positions; all named vars must be distinct node vars
+        positions: dict[str, int] = {}
+        for i, n in enumerate(nodes):
+            if n.variable:
+                if n.variable in positions:
+                    return None  # repeated var = join constraint; generic
+                positions[n.variable] = i
+
+        def compile_value(expr):
+            """node-property / whole-node accessors only."""
+            if (isinstance(expr, ast.Property)
+                    and isinstance(expr.subject, ast.Variable)
+                    and expr.subject.name in positions):
+                pos, prop = positions[expr.subject.name], expr.key
+                return lambda path: path[pos].properties.get(prop)
+            if isinstance(expr, ast.Variable) and expr.name in positions:
+                pos = positions[expr.name]
+
+                def whole(path, pos=pos):
+                    # path nodes may be live stored objects (node_entry);
+                    # a whole-node projection must hand out a copy
+                    n = path[pos]
+                    try:
+                        return self.storage.get_node(n.id)
+                    except Exception:
+                        return n.copy()
+
+                return whole
+            return None
+
+        getters = []
+        for item in ret.items:
+            g = compile_value(item.expr)
+            if g is None:
+                return None
+            getters.append(g)
+        aliases = {item.key: i for i, item in enumerate(ret.items)}
+        key_getters, descs = [], []
+        for oi in (ret.order_by or ()):
+            # the generic path's ORDER BY binding overlays RETURN columns
+            # on top of pattern variables, so an alias shadowing a pattern
+            # var WINS — resolve aliases first here too
+            if isinstance(oi.expr, ast.Variable) and oi.expr.name in aliases:
+                g = getters[aliases[oi.expr.name]]
+            elif (isinstance(oi.expr, ast.Property)
+                  and isinstance(oi.expr.subject, ast.Variable)
+                  and oi.expr.subject.name in aliases):
+                return None  # property-of-alias: generic path semantics
+            else:
+                g = compile_value(oi.expr)
+            if g is None:
+                return None
+            key_getters.append(g)
+            descs.append(oi.descending)
+
+        def static_int(expr):
+            if expr is None:
+                return None, True
+            if isinstance(expr, ast.Literal) and isinstance(expr.value, int):
+                return expr.value, True
+            if isinstance(expr, ast.Parameter):
+                v = params.get(expr.name)
+                return (v, True) if isinstance(v, int) else (None, False)
+            return None, False
+
+        skip, ok = static_int(ret.skip)
+        if not ok:
+            return None
+        limit, ok = static_int(ret.limit)
+        if not ok:
+            return None
+
+        anchors = self.matcher._candidates(anchor, {}, params)
+        if len(anchors) > self._FP_TRAVERSE_MAX_ANCHORS:
+            return None  # unselective anchor: generic path, no blowup here
+
+        # no-copy reads where the engine offers them (the copying accessors
+        # dominate this path otherwise); probe once — NamespacedEngine
+        # surfaces AttributeError when its base lacks fast adjacency
+        iter_adj = getattr(self.storage, "iter_adjacency", None)
+        if iter_adj is not None:
+            try:
+                iter_adj("\x00fp-probe\x00", "out")
+            except AttributeError:
+                iter_adj = None
+            except Exception:
+                pass
+        raw_entry = getattr(self.storage, "node_entry", None)
+        node_cache: dict[str, Node] = {}
+
+        def get_node(nid: str) -> Optional[Node]:
+            n = node_cache.get(nid)
+            if n is None:
+                if raw_entry is not None:
+                    n = raw_entry(nid)  # read-only: labels + property gets
+                else:
+                    try:
+                        n = self.storage.get_node(nid)
+                    except NotFoundError:
+                        return None
+                if n is None:
+                    return None
+                node_cache[nid] = n
+            return n
+
+        def expand(nid: str, rel: ast.RelPattern):
+            out = []
+            types = rel.types
+            if iter_adj is not None:
+                if rel.direction in ("out", "both"):
+                    for eid, t, oid in iter_adj(nid, "out"):
+                        if t in types:
+                            out.append((eid, oid))
+                if rel.direction in ("in", "both"):
+                    for eid, t, oid in iter_adj(nid, "in"):
+                        if t in types:
+                            out.append((eid, oid))
+                out.sort()  # matcher expands in edge-id order; with LIMIT
+                return out  # and tied keys, set order would leak through
+            if rel.direction in ("out", "both"):
+                for e in self.storage.get_outgoing_edges(nid):
+                    if e.type in types:
+                        out.append((e.id, e.end_node))
+            if rel.direction in ("in", "both"):
+                for e in self.storage.get_incoming_edges(nid):
+                    if e.type in types:
+                        out.append((e.id, e.start_node))
+            out.sort()
+            return out
+
+        paths: list[tuple] = []
+
+        def walk(path: tuple, used: tuple, hop: int) -> None:
+            if hop == len(rels):
+                paths.append(path)
+                return
+            for eid, other_id in expand(path[-1].id, rels[hop]):
+                if eid in used:
+                    continue
+                n = get_node(other_id)
+                if n is None:
+                    continue
+                pat = nodes[hop + 1]
+                if pat.labels and not any(
+                        l in n.labels for l in pat.labels):
+                    continue
+                walk(path + (n,), used + (eid,), hop + 1)
+
+        for a in anchors:
+            walk((a,), (), 0)
+
+        if key_getters:
+            keyed = [([g(p) for g in key_getters], p) for p in paths]
+            paths = _multisort(keyed, descs)
+        if skip:
+            paths = paths[skip:]
+        if limit is not None:
+            paths = paths[:limit]
+        data = [[g(p) for g in getters] for p in paths]
+        return Result([item.key for item in ret.items], data)
 
     # -- query pipeline -----------------------------------------------------------
     def _run_query(
@@ -1223,9 +1429,15 @@ class CypherExecutor:
         return columns, data
 
     def _order_by(self, order_items, columns, data, source_rows, params):
-        # ORDER BY may reference output columns OR pre-projection variables
-        def sort_key(pair):
-            row_vals, src = pair
+        # ORDER BY may reference output columns OR pre-projection variables.
+        # Keys are evaluated ONCE per row, then sorted with one stable pass
+        # per key (last key first — stability composes them). A pass whose
+        # values are all-numeric or all-string sorts on the native value;
+        # only mixed-type/entity passes pay for the _SortKey comparison
+        # wrapper (profiled: wrapper comparisons dominated traversal+sort
+        # query time before this).
+        keyed = []
+        for row_vals, src in zip(data, source_rows):
             binding = dict(src)
             binding.update(dict(zip(columns, row_vals)))
             keys = []
@@ -1234,10 +1446,10 @@ class CypherExecutor:
                     v = binding[oi.expr.name]
                 else:
                     v = evaluate(oi.expr, EvalContext(binding, params, self))
-                keys.append(_SortKey(v, oi.descending))
-            return keys
+                keys.append(v)
+            keyed.append((keys, row_vals))
 
-        return [d for d, _ in sorted(zip(data, source_rows), key=sort_key)]
+        return _multisort(keyed, [oi.descending for oi in order_items])
 
     def _aggregate_project(self, items, rows, params) -> list[list[Any]]:
         group_idx = [i for i, it in enumerate(items) if not _contains_aggregate(it.expr)]
@@ -2128,7 +2340,8 @@ def _write_labels(q: ast.Query) -> set[str]:
 
 class _SortKey:
     """Comparable wrapper: mixed-type tolerant, nulls sort last (asc),
-    honours per-key DESC."""
+    honours per-key DESC. Used only for mixed-type sort passes — see
+    _multisort."""
 
     __slots__ = ("v", "desc")
 
@@ -2165,6 +2378,26 @@ class _SortKey:
 
     def __eq__(self, other) -> bool:
         return self._cmp(other) == 0
+
+
+def _multisort(keyed: list, descs: list) -> list:
+    """Stable multi-key sort of (keys, payload) pairs: one stable pass per
+    key, last key first (stability composes them). A pass whose non-null
+    values are all-numeric or all-string sorts natively; only
+    mixed-type/entity passes pay for the _SortKey comparison wrapper.
+    Null is the largest value: last in ASC, first in DESC (Neo4j order)."""
+    for ki in range(len(descs) - 1, -1, -1):
+        desc = descs[ki]
+        nonnull = [t for t in keyed if t[0][ki] is not None]
+        vals = [t[0][ki] for t in nonnull]
+        if all(isinstance(v, (int, float)) and not isinstance(v, bool)
+               for v in vals) or all(isinstance(v, str) for v in vals):
+            nulls = [t for t in keyed if t[0][ki] is None]
+            nonnull.sort(key=lambda t, ki=ki: t[0][ki], reverse=desc)
+            keyed = (nulls + nonnull) if desc else (nonnull + nulls)
+        else:
+            keyed.sort(key=lambda t, ki=ki, desc=desc: _SortKey(t[0][ki], desc))
+    return [payload for _, payload in keyed]
 
 
 def _pattern_variables(pattern: ast.PatternPath) -> list[str]:

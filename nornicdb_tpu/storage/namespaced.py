@@ -165,6 +165,16 @@ class NamespacedEngine(Engine):
             self._strip_edge(e) for e in self.base.get_incoming_edges(self._add(node_id))
         ]
 
+    def iter_adjacency(self, node_id: str, direction: str) -> list[tuple]:
+        """No-copy adjacency (see MemoryEngine.iter_adjacency) with prefix
+        translation. Raises AttributeError when the base engine has no
+        fast adjacency — callers probe and fall back to edge accessors."""
+        return [
+            (self._strip(eid), t, self._strip(oid))
+            for eid, t, oid in self.base.iter_adjacency(
+                self._add(node_id), direction)
+        ]
+
     def all_edges(self) -> Iterator[Edge]:
         return (self._strip_edge(e) for e in self.base.all_edges() if self._owns(e.id))
 

@@ -58,6 +58,8 @@ class SchemaManager:
         # node id -> set of (map-key, value-tuple) it is indexed under,
         # so updates can drop stale entries
         self._node_entries: dict[str, set[tuple]] = {}
+        self._engine = None
+        self._subscribed = False
 
     # -- index DDL ---------------------------------------------------------
     def create_index(
@@ -77,6 +79,7 @@ class SchemaManager:
             idx = IndexDef(name, kind, label, list(properties), options or {})
             self._indexes[name] = idx
             if kind in (INDEX_PROPERTY, INDEX_COMPOSITE, INDEX_RANGE):
+                self._ensure_subscribed()
                 self._prop_maps.setdefault((label, tuple(properties)), {})
                 self._backfill(label, tuple(properties))
             return idx
@@ -130,6 +133,7 @@ class SchemaManager:
                 raise AlreadyExistsError(f"constraint {name} already exists")
             c = ConstraintDef(name, label, list(properties), kind)
             self._constraints[name] = c
+            self._ensure_subscribed()
             key = (label, tuple(properties))
             created_map = key not in self._prop_maps
             self._prop_maps.setdefault(key, {})
@@ -228,8 +232,25 @@ class SchemaManager:
             return set(valmap.get(tuple(_freeze(v) for v in values), set()))
 
     def attach(self, engine: Engine) -> None:
-        """Subscribe to engine events so index maps stay current."""
+        """Subscribe to engine events so index maps stay current, and index
+        whatever the engine already holds."""
         self._engine = engine
+        self._subscribe()
+        for n in engine.all_nodes():
+            self.index_node(n)
+
+    def attach_lazy(self, engine: Engine) -> None:
+        """Remember the engine but defer the event subscription (and any
+        node scan) until the first index/constraint DDL. Per-request
+        CypherExecutor construction over a shared long-lived engine must
+        not accumulate dead subscriptions or pay O(N) scans when no index
+        is ever created; _backfill covers pre-existing data at DDL time."""
+        self._engine = engine
+
+    def _subscribe(self) -> None:
+        if self._subscribed or self._engine is None:
+            return
+        self._subscribed = True
 
         def _on(kind: str, entity) -> None:
             if not isinstance(entity, Node):
@@ -239,9 +260,10 @@ class SchemaManager:
             elif kind == "node_deleted":
                 self.unindex_node(entity)
 
-        engine.on_event(_on)
-        for n in engine.all_nodes():
-            self.index_node(n)
+        self._engine.on_event(_on)
+
+    def _ensure_subscribed(self) -> None:
+        self._subscribe()
 
     def _backfill(self, label: str, properties: tuple) -> None:
         """Populate a NEW prop map from data that already exists — an index

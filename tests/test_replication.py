@@ -27,7 +27,9 @@ from nornicdb_tpu.replication.transport import MSG_REQUEST
 from nornicdb_tpu.storage import MemoryEngine, Node
 
 
-def _wait(pred, timeout=5.0, interval=0.02):
+def _wait(pred, timeout=20.0, interval=0.02):
+    # generous default: election + cross-region ship timings stretch badly
+    # when the host is saturated (e.g. a CPU bench running in parallel)
     deadline = time.time() + timeout
     while time.time() < deadline:
         if pred():

@@ -10,7 +10,9 @@ index_hints_test.go, clauses_test.go math family.
 import pytest
 
 import nornicdb_tpu
+from nornicdb_tpu.cypher import CypherExecutor
 from nornicdb_tpu.errors import NornicError
+from nornicdb_tpu.storage import MemoryEngine
 
 
 @pytest.fixture
@@ -332,3 +334,37 @@ class TestDdlCacheInvalidation:
             assert db.cypher(q).rows == [], "cached hit survived DROP INDEX"
         finally:
             db.close()
+
+
+class TestIndexLiveMaintenance:
+    """A standalone CypherExecutor's self-created SchemaManager must hear
+    engine write events: an index created BEFORE the data it should serve
+    otherwise returns empty from the inline-property fastpath while the
+    WHERE scan path finds the row (the divergence that exposed this)."""
+
+    def test_index_before_data_sees_later_writes(self):
+        ex = CypherExecutor(MemoryEngine())
+        ex.execute("CREATE INDEX FOR (m:Message) ON (m.id)")
+        ex.execute("CREATE (:Message {id: 2, content: 'yo'})")
+        assert ex.execute(
+            "MATCH (m:Message {id: 2}) RETURN m.content").rows == [["yo"]]
+
+    def test_update_moves_index_bucket_and_delete_unindexes(self):
+        ex = CypherExecutor(MemoryEngine())
+        ex.execute("CREATE INDEX FOR (m:M) ON (m.k)")
+        ex.execute("CREATE (:M {k: 1, v: 'a'})")
+        ex.execute("MATCH (m:M {k: 1}) SET m.k = 9")
+        assert ex.execute("MATCH (m:M {k: 9}) RETURN m.v").rows == [["a"]]
+        assert ex.execute("MATCH (m:M {k: 1}) RETURN m.v").rows == []
+        ex.execute("MATCH (m:M {k: 9}) DELETE m")
+        assert ex.execute("MATCH (m:M {k: 9}) RETURN m").rows == []
+
+    def test_fastpath_agrees_with_scan(self):
+        ex = CypherExecutor(MemoryEngine())
+        ex.execute("CREATE INDEX FOR (p:P) ON (p.k)")
+        for i in range(50):
+            ex.execute(f"CREATE (:P {{k: {i % 10}, i: {i}}})")
+        fast = ex.execute("MATCH (p:P {k: 3}) RETURN p.i ORDER BY p.i").rows
+        scan = ex.execute(
+            "MATCH (p:P) WHERE p.k = 3 RETURN p.i ORDER BY p.i").rows
+        assert fast == scan and len(fast) == 5

@@ -1,0 +1,229 @@
+"""Anchored-traversal fastpath (_fp_anchored_traverse) — the reference's
+pattern-detect fastpath family (ref: query_patterns.go DetectQueryPattern,
+optimized_executors.go). The contract: for every shape the detector
+accepts, results are IDENTICAL to the generic matcher pipeline; shapes it
+cannot handle fall through untouched.
+"""
+
+import pytest
+
+from nornicdb_tpu.cypher import CypherExecutor
+from nornicdb_tpu.cypher.executor import CypherExecutor as _CE
+from nornicdb_tpu.storage import MemoryEngine, NamespacedEngine
+from nornicdb_tpu.storage.types import Edge, Node
+
+
+def _social(engine=None):
+    eng = engine or MemoryEngine()
+    for p in range(20):
+        eng.create_node(Node(id=f"p{p}", labels=["Person"],
+                             properties={"id": p, "name": f"P{p:02d}"}))
+    for m in range(40):
+        eng.create_node(Node(id=f"m{m}", labels=["Message"],
+                             properties={"id": m, "content": f"c{m}",
+                                         "created": (m * 37) % 100}))
+        eng.create_edge(Edge(id=f"po{m}", start_node=f"p{m % 20}",
+                             end_node=f"m{m}", type="POSTED"))
+    k = 0
+    for p in range(20):
+        for q in ((p + 1) % 20, (p + 7) % 20):
+            eng.create_edge(Edge(id=f"k{k}", start_node=f"p{p}",
+                                 end_node=f"p{q}", type="KNOWS"))
+            k += 1
+    ex = CypherExecutor(eng)
+    ex.execute("CREATE INDEX FOR (p:Person) ON (p.id)")
+    return ex
+
+
+QUERIES = [
+    ("two-hop ordered limited",
+     "MATCH (p:Person {id: $id})-[:KNOWS]-(f:Person)-[:POSTED]->(m:Message) "
+     "RETURN m.content, m.created ORDER BY m.created DESC LIMIT 5",
+     {"id": 3}),
+    ("multi-key order",
+     "MATCH (p:Person {id: $id})-[:KNOWS]-(f:Person)-[:POSTED]->(m:Message) "
+     "RETURN m.content ORDER BY m.created DESC, m.content ASC LIMIT 4",
+     {"id": 9}),
+    ("one-hop directed",
+     "MATCH (p:Person {id: $id})-[:KNOWS]->(f:Person) "
+     "RETURN f.name ORDER BY f.name LIMIT 3", {"id": 0}),
+    ("skip and whole node",
+     "MATCH (p:Person {id: $id})-[:KNOWS]-(f:Person) "
+     "RETURN f.name, f ORDER BY f.name SKIP 1 LIMIT 2", {"id": 0}),
+    ("same rel type both hops (edge isomorphism)",
+     "MATCH (p:Person {id: $id})-[:KNOWS]-(f)-[:KNOWS]-(g:Person) "
+     "RETURN g.id ORDER BY g.id LIMIT 10", {"id": 4}),
+    ("alias in order by",
+     "MATCH (p:Person {id: $id})-[:POSTED]->(m:Message) "
+     "RETURN m.content AS c ORDER BY c DESC", {"id": 2}),
+    ("missing anchor",
+     "MATCH (p:Person {id: 999})-[:KNOWS]-(f) "
+     "RETURN f.name ORDER BY f.name", {}),
+]
+
+
+def _both_ways(ex, query, params):
+    """Run with the fastpath, then with it disabled; return both row sets."""
+    if ex.cache:
+        ex.cache.clear()
+    fast = ex.execute(query, dict(params)).rows
+    orig = _CE._fp_anchored_traverse
+    _CE._fp_anchored_traverse = lambda self, *a, **k: None
+    try:
+        if ex.cache:
+            ex.cache.clear()
+        slow = ex.execute(query, dict(params)).rows
+    finally:
+        _CE._fp_anchored_traverse = orig
+    return fast, slow
+
+
+class TestFastpathAgreesWithGeneric:
+    @pytest.mark.parametrize("name,query,params", QUERIES,
+                             ids=[q[0] for q in QUERIES])
+    def test_differential(self, name, query, params):
+        ex = _social()
+        fast, slow = _both_ways(ex, query, params)
+        assert len(fast) == len(slow)
+        assert sorted(map(repr, fast)) == sorted(map(repr, slow)), name
+
+    def test_differential_on_namespaced_engine(self):
+        ex = _social(NamespacedEngine(MemoryEngine(), "ns"))
+        fast, slow = _both_ways(
+            ex,
+            "MATCH (p:Person {id: $id})-[:KNOWS]-(f:Person)-[:POSTED]->"
+            "(m:Message) RETURN m.content ORDER BY m.created DESC LIMIT 5",
+            {"id": 3})
+        assert fast == slow != []
+
+    def test_namespaced_whole_node_id_is_bare(self):
+        ex = _social(NamespacedEngine(MemoryEngine(), "ns"))
+        r = ex.execute("MATCH (p:Person {id: 0})-[:KNOWS]->(f:Person) "
+                       "RETURN f ORDER BY f.name LIMIT 1")
+        assert not r.rows[0][0].id.startswith("ns:")
+
+
+class TestFastpathEngages:
+    def _hits(self, ex, query, params=None):
+        hits = [0]
+        orig = _CE._fp_anchored_traverse
+
+        def spy(self, *a, **k):
+            r = orig(self, *a, **k)
+            if r is not None:
+                hits[0] += 1
+            return r
+
+        _CE._fp_anchored_traverse = spy
+        try:
+            ex.execute(query, params or {})
+        finally:
+            _CE._fp_anchored_traverse = orig
+        return hits[0]
+
+    def test_hot_shape_uses_fastpath(self):
+        ex = _social()
+        assert self._hits(
+            ex,
+            "MATCH (p:Person {id: 1})-[:KNOWS]-(f)-[:POSTED]->(m:Message) "
+            "RETURN m.content ORDER BY m.created DESC LIMIT 5") == 1
+
+    def test_where_clause_falls_through(self):
+        ex = _social()
+        assert self._hits(
+            ex,
+            "MATCH (p:Person {id: 1})-[:KNOWS]-(f) WHERE f.name <> 'x' "
+            "RETURN f.name ORDER BY f.name") == 0
+
+    def test_var_length_falls_through(self):
+        ex = _social()
+        assert self._hits(
+            ex,
+            "MATCH (p:Person {id: 1})-[:KNOWS*1..2]-(f) "
+            "RETURN f.name ORDER BY f.name LIMIT 3") == 0
+
+    def test_repeated_variable_falls_through(self):
+        ex = _social()
+        assert self._hits(
+            ex,
+            "MATCH (p:Person {id: 1})-[:KNOWS]-(f)-[:KNOWS]-(p) "
+            "RETURN f.name ORDER BY f.name") == 0
+
+    def test_whole_node_result_does_not_alias_storage(self):
+        ex = _social()
+        r = ex.execute("MATCH (p:Person {id: 0})-[:KNOWS]->(f:Person) "
+                       "RETURN f ORDER BY f.name LIMIT 1")
+        r.rows[0][0].properties["name"] = "EVIL"
+        if ex.cache:
+            ex.cache.clear()
+        r2 = ex.execute("MATCH (p:Person {id: 0})-[:KNOWS]->(f:Person) "
+                        "RETURN f ORDER BY f.name LIMIT 1")
+        assert r2.rows[0][0].properties["name"] != "EVIL"
+
+
+class TestNoCopyStorageReads:
+    def test_iter_adjacency_matches_edge_accessors(self):
+        eng = MemoryEngine()
+        eng.create_node(Node(id="a"))
+        eng.create_node(Node(id="b"))
+        eng.create_edge(Edge(id="e1", start_node="a", end_node="b", type="R"))
+        eng.create_edge(Edge(id="e2", start_node="b", end_node="a", type="S"))
+        assert eng.iter_adjacency("a", "out") == [("e1", "R", "b")]
+        assert eng.iter_adjacency("a", "in") == [("e2", "S", "b")]
+        assert eng.iter_adjacency("ghost", "out") == []
+
+    def test_namespaced_iter_adjacency_strips_prefix(self):
+        eng = NamespacedEngine(MemoryEngine(), "ns")
+        eng.create_node(Node(id="a"))
+        eng.create_node(Node(id="b"))
+        eng.create_edge(Edge(id="e1", start_node="a", end_node="b", type="R"))
+        assert eng.iter_adjacency("a", "out") == [("e1", "R", "b")]
+
+    def test_node_entry_is_read_path_only(self):
+        eng = MemoryEngine()
+        eng.create_node(Node(id="a", properties={"k": 1}))
+        entry = eng.node_entry("a")
+        assert entry.properties["k"] == 1
+        assert eng.node_entry("ghost") is None
+
+
+class TestReviewRegressions:
+    def test_alias_shadowing_pattern_var_sorts_by_column(self):
+        """ORDER BY resolves RETURN aliases BEFORE pattern variables (the
+        generic binding overlays columns on top of source vars)."""
+        ex = _social()
+        q = ("MATCH (p:Person {id: 1})-[:KNOWS]->(f) "
+             "RETURN f.name AS f ORDER BY f DESC LIMIT 3")
+        fast, slow = _both_ways(ex, q, {})
+        assert fast == slow
+        assert fast == sorted(fast, reverse=True)
+
+    def test_tied_sort_keys_with_limit_are_deterministic(self):
+        """With tied keys + LIMIT the fastpath must pick the same rows as
+        the generic matcher (edge-id order), not set-iteration order."""
+        eng = MemoryEngine()
+        eng.create_node(Node(id="a", labels=["A"], properties={"id": 1}))
+        for i in range(8):
+            eng.create_node(Node(id=f"b{i}", labels=["B"],
+                                 properties={"n": f"b{i}", "tie": 0}))
+            eng.create_edge(Edge(id=f"e{i}", start_node="a",
+                                 end_node=f"b{i}", type="R"))
+        ex = CypherExecutor(eng)
+        ex.execute("CREATE INDEX FOR (a:A) ON (a.id)")
+        q = "MATCH (a:A {id: 1})-[:R]->(b:B) RETURN b.n ORDER BY b.tie LIMIT 4"
+        fast, slow = _both_ways(ex, q, {})
+        assert fast == slow == [["b0"], ["b1"], ["b2"], ["b3"]]
+
+    def test_executor_construction_does_not_subscribe(self):
+        """Per-request executors over a shared engine must not accumulate
+        event subscriptions; the schema subscribes at first DDL only."""
+        eng = MemoryEngine()
+        before = len(eng._callbacks)
+        for _ in range(20):
+            CypherExecutor(eng)
+        assert len(eng._callbacks) == before
+        ex = CypherExecutor(eng)
+        ex.execute("CREATE INDEX FOR (x:X) ON (x.k)")
+        assert len(eng._callbacks) == before + 1
+        ex.execute("CREATE (:X {k: 1, v: 'hit'})")
+        assert ex.execute("MATCH (x:X {k: 1}) RETURN x.v").rows == [["hit"]]
