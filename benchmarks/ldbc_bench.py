@@ -154,20 +154,23 @@ def run(scale: int, seconds: float) -> dict:
         "MATCH (t1:Tag)<-[:HAS_TAG]-(m:Message)-[:HAS_TAG]->(t2:Tag) "
         "WHERE t1.name < t2.name "
         "RETURN t1.name, t2.name, count(m) AS c ORDER BY c DESC LIMIT 10")
-    for name, q in (("avg_friends_city", agg_friends),
-                    ("tag_cooccurrence", agg_tags)):
-        # cold: distinct no-op param per call defeats the result cache
+
+    def both_ways(name, ex_, q):
+        """Parameterless reads serve from the result cache on repeat; report
+        the steady-state (cached) rate AND the cache-busted engine rate."""
         cold_qps, cold_ms = timed(
-            lambda i, q=q: social.execute(q, {"nonce": i}), seconds)
-        rec(name, lambda i, q=q: social.execute(q),
+            lambda i, q=q: ex_.execute(q, {"nonce": i}), seconds)
+        rec(name, lambda i, q=q: ex_.execute(q),
             cold_ops_per_sec=round(cold_qps, 1),
             cold_ms_per_op=round(cold_ms, 4))
+
+    both_ways("avg_friends_city", social, agg_friends)
+    both_ways("tag_cooccurrence", social, agg_tags)
 
     rec("index_lookup", lambda i: north.execute(
         "MATCH (p:Product {sku: $sku}) RETURN p.name",
         {"sku": f"SKU-{int(rng.integers(scale * 2))}"}))
-    rec("count_nodes", lambda i: north.execute(
-        "MATCH (p:Product) RETURN count(p)"))
+    both_ways("count_nodes", north, "MATCH (p:Product) RETURN count(p)")
     rec("write_node", lambda i: north.execute(
         "CREATE (:Product {sku: $sku, name: 'bench'})",
         {"sku": f"W-{i}-{int(rng.integers(1 << 30))}"}))

@@ -14,6 +14,7 @@ from __future__ import annotations
 
 import logging
 
+import copy
 import csv as csv_mod
 import io
 import threading
@@ -200,7 +201,7 @@ class CypherExecutor:
             if not write:
                 hit = self.cache.get(query, params)
                 if hit is not None:
-                    return hit
+                    return _copy_result(hit)
                 result = self.execute_statement(stmt, params)
                 if not _is_nondeterministic(stmt):
                     # reads with unlabeled dependencies get EMPTY label sets,
@@ -209,6 +210,11 @@ class CypherExecutor:
                     self.cache.put(
                         query, params, result, _read_cache_labels(stmt)
                     )
+                    # the caller gets a COPY on the miss too: the cached
+                    # object must never be reachable from callers, or one
+                    # mutating a row — or a returned node's properties
+                    # dict — would poison every later hit
+                    return _copy_result(result)
                 return result
             result = self.execute_statement(stmt, params)
             labels = _write_labels(stmt)
@@ -716,6 +722,24 @@ class CypherExecutor:
         if not ok:
             return None
 
+        # cheap selectivity probe BEFORE materializing candidates: an
+        # unindexed unselective anchor must not pay a full label scan here
+        # and then a second one in the generic path it falls back to
+        prop_keys = sorted(anchor.properties.items.keys())
+        indexed = self.schema is not None and any(
+            self.schema.has_prop_index(label, prop_keys)
+            or any(self.schema.has_prop_index(label, [k])
+                   for k in prop_keys)
+            for label in anchor.labels
+        )
+        if not indexed:
+            if anchor.labels:
+                est = min(self.storage.count_nodes_by_label(l)
+                          for l in anchor.labels)
+            else:
+                est = self.storage.node_count()
+            if est > self._FP_TRAVERSE_MAX_ANCHORS:
+                return None
         anchors = self.matcher._candidates(anchor, {}, params)
         if len(anchors) > self._FP_TRAVERSE_MAX_ANCHORS:
             return None  # unselective anchor: generic path, no blowup here
@@ -2378,6 +2402,35 @@ class _SortKey:
 
     def __eq__(self, other) -> bool:
         return self._cmp(other) == 0
+
+
+def _copy_cached_value(v):
+    """Deep enough that no mutable state is shared with the cache: entity
+    copies get their list/dict property VALUES copied too (Node.copy is
+    shallow on values), and bare list/dict row values (collect(), maps)
+    are deep-copied."""
+    if isinstance(v, (Node, Edge)):
+        c = v.copy()
+        c.properties = {
+            k: (copy.deepcopy(x) if isinstance(x, (list, dict)) else x)
+            for k, x in c.properties.items()
+        }
+        return c
+    if isinstance(v, (list, dict)):
+        return copy.deepcopy(v)
+    return v
+
+
+def _copy_result(r: "Result") -> "Result":
+    """Structural copy deep enough that mutating the returned rows, a
+    returned node/edge's properties, or a collected list cannot reach the
+    cached object."""
+    return Result(
+        list(r.columns),
+        [[_copy_cached_value(v) for v in row] for row in r.rows],
+        r.stats,
+        r.plan,
+    )
 
 
 def _multisort(keyed: list, descs: list) -> list:

@@ -79,7 +79,7 @@ class SchemaManager:
             idx = IndexDef(name, kind, label, list(properties), options or {})
             self._indexes[name] = idx
             if kind in (INDEX_PROPERTY, INDEX_COMPOSITE, INDEX_RANGE):
-                self._ensure_subscribed()
+                self._subscribe()
                 self._prop_maps.setdefault((label, tuple(properties)), {})
                 self._backfill(label, tuple(properties))
             return idx
@@ -110,6 +110,13 @@ class SchemaManager:
     def vector_indexes(self) -> list[IndexDef]:
         return [i for i in self.list_indexes() if i.kind == INDEX_VECTOR]
 
+    def has_prop_index(self, label: str, properties: list[str]) -> bool:
+        """True when an equality-lookup map exists for (label, properties)
+        — i.e. lookup() would answer (property/composite/range/constraint
+        maps, NOT fulltext/vector defs)."""
+        with self._lock:
+            return (label, tuple(properties)) in self._prop_maps
+
     def find_index(self, label: str, properties: list[str]) -> Optional[IndexDef]:
         with self._lock:
             for i in self._indexes.values():
@@ -133,7 +140,7 @@ class SchemaManager:
                 raise AlreadyExistsError(f"constraint {name} already exists")
             c = ConstraintDef(name, label, list(properties), kind)
             self._constraints[name] = c
-            self._ensure_subscribed()
+            self._subscribe()
             key = (label, tuple(properties))
             created_map = key not in self._prop_maps
             self._prop_maps.setdefault(key, {})
@@ -261,9 +268,6 @@ class SchemaManager:
                 self.unindex_node(entity)
 
         self._engine.on_event(_on)
-
-    def _ensure_subscribed(self) -> None:
-        self._subscribe()
 
     def _backfill(self, label: str, properties: tuple) -> None:
         """Populate a NEW prop map from data that already exists — an index

@@ -227,3 +227,65 @@ class TestReviewRegressions:
         assert len(eng._callbacks) == before + 1
         ex.execute("CREATE (:X {k: 1, v: 'hit'})")
         assert ex.execute("MATCH (x:X {k: 1}) RETURN x.v").rows == [["hit"]]
+
+
+class TestResultCacheIsolation:
+    """The cached Result must never be reachable from callers: mutating a
+    returned row, or a returned node's properties, must not poison later
+    hits (on the miss path the cached object is the freshly computed one,
+    so both paths must copy)."""
+
+    def test_mutating_returned_rows_does_not_poison_cache(self):
+        from nornicdb_tpu.cache import QueryCache
+
+        ex = CypherExecutor(MemoryEngine(), cache=QueryCache())
+        ex.execute("CREATE (:P {id: 1, name: 'good'})")
+        q = "MATCH (p:P {id: 1}) RETURN p"
+        r1 = ex.execute(q)  # miss
+        r1.rows[0][0].properties["name"] = "EVIL"
+        r1.rows.append(["junk"])
+        r2 = ex.execute(q)  # hit
+        assert r2.rows[0][0].properties["name"] == "good"
+        assert len(r2.rows) == 1
+        r2.rows[0][0].properties["name"] = "EVIL2"
+        assert ex.execute(q).rows[0][0].properties["name"] == "good"
+
+    def test_collected_lists_and_list_properties_isolated(self):
+        """copy must reach list/dict row values and list/dict property
+        values — Node.copy is shallow on values."""
+        from nornicdb_tpu.cache import QueryCache
+
+        ex = CypherExecutor(MemoryEngine(), cache=QueryCache())
+        ex.execute("CREATE (:P {name: 'x', tags: ['a']})")
+        r = ex.execute("MATCH (p:P) RETURN collect(p.name)")
+        r.rows[0][0].append("EVIL")
+        assert ex.execute(
+            "MATCH (p:P) RETURN collect(p.name)").rows[0][0] == ["x"]
+        r = ex.execute("MATCH (p:P) RETURN p")
+        r.rows[0][0].properties["tags"].append("EVIL")
+        assert ex.execute(
+            "MATCH (p:P) RETURN p").rows[0][0].properties["tags"] == ["a"]
+
+    def test_unindexed_anchor_bails_without_scanning(self):
+        """The fastpath must not pay a label scan it will then repeat in
+        the generic path — it pre-bails on label count when no equality
+        index covers the anchor."""
+        eng = MemoryEngine()
+        for i in range(100):
+            eng.create_node(Node(id=f"n{i}", labels=["L"],
+                                 properties={"k": i}))
+        eng.create_edge(Edge(id="e", start_node="n0", end_node="n1",
+                             type="R"))
+        ex = CypherExecutor(eng)
+        calls = [0]
+        orig = ex.matcher._candidates
+
+        def spy(*a, **k):
+            calls[0] += 1
+            return orig(*a, **k)
+
+        ex.matcher._candidates = spy
+        r = ex.execute(
+            "MATCH (a:L {k: 0})-[:R]->(b) RETURN b.k ORDER BY b.k LIMIT 5")
+        assert r.rows == [[1]]
+        assert calls[0] == 1
