@@ -16,10 +16,12 @@ import logging
 
 import copy
 import csv as csv_mod
+import functools
 import io
 import threading
 import time
 import uuid
+import dataclasses
 from dataclasses import dataclass, field
 from typing import Any, Callable, Iterable, Optional
 
@@ -2182,8 +2184,28 @@ _NONDETERMINISTIC_FNS = {
 
 def classify_query_text(query: str) -> str:
     """Permission class ("read" | "write") of a raw query string.
+    Memoized for normal-sized texts: Bolt calls this on EVERY RUN under
+    auth and the class of a fixed text never changes — but oversized
+    texts bypass the cache, or a client could pin gigabytes of RAM by
+    sending thousands of unique multi-megabyte queries as cache keys."""
+    try:
+        if len(query) > 4096:
+            return _classify_query(query)
+        return _classify_query_cached(query)
+    except RecursionError:
+        # pathologically nested expressions blow the AST walk — the
+        # conservative class cannot leak privileges, and the executor
+        # will reject the query on its own terms
+        return "write"
 
-    AST-based, shared by the HTTP tx API and Bolt RBAC gates: any CALL of a
+
+@functools.lru_cache(maxsize=4096)
+def _classify_query_cached(query: str) -> str:
+    return _classify_query(query)
+
+
+def _classify_query(query: str) -> str:
+    """AST-based, shared by the HTTP tx API and Bolt RBAC gates: any CALL of a
     procedure ast.procedure_is_readonly rejects counts as a write (readonly
     prefixes minus MUTATING_PROCEDURE_EXCEPTIONS like gds.graph.project),
     so mutating procedures (CALL apoc.refactor.*, apoc.trigger.add, ...)
@@ -2428,7 +2450,7 @@ def _copy_result(r: "Result") -> "Result":
     return Result(
         list(r.columns),
         [[_copy_cached_value(v) for v in row] for row in r.rows],
-        r.stats,
+        dataclasses.replace(r.stats),  # Stats is mutable too
         r.plan,
     )
 

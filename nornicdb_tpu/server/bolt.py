@@ -407,7 +407,12 @@ class BoltServer:
                 for tag, meta in responses:
                     payload = pack(Structure(tag, [meta]))
                     writer.write(self._chunk(payload))
-                await writer.drain()
+                # drain() only matters for flow control; awaiting it per
+                # message costs an event-loop round-trip per op (measured
+                # ~2x op latency at RETURN-1 scale). Await only when the
+                # transport's buffer actually backs up.
+                if writer.transport.get_write_buffer_size() > 65536:
+                    await writer.drain()
         except Exception:
             pass
         finally:

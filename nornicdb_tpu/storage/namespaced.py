@@ -179,6 +179,11 @@ class NamespacedEngine(Engine):
         return (self._strip_edge(e) for e in self.base.all_edges() if self._owns(e.id))
 
     def count_nodes_by_label(self, label: str) -> int:
+        ids_fn = getattr(self.base, "node_ids_by_label", None)
+        if ids_fn is not None:
+            # id-only membership scan: no per-node copies (the copying path
+            # clones embedding arrays just to count)
+            return sum(1 for i in ids_fn(label) if i.startswith(self._prefix))
         return sum(
             1 for n in self.base.get_nodes_by_label(label) if self._owns(n.id)
         )

@@ -40,6 +40,14 @@ class ConstraintDef:
     kind: str = "unique"
 
 
+def _norm(properties) -> tuple:
+    """Internal prop-map keys are SORTED property tuples: equality lookup
+    over (a, b) and (b, a) is the same index, and callers (the matcher,
+    the fastpath probe) present keys sorted — a composite index declared
+    in non-alphabetical order must not be invisible to them."""
+    return tuple(sorted(properties))
+
+
 def _freeze(v: Any) -> Any:
     if isinstance(v, list):
         return tuple(_freeze(x) for x in v)
@@ -80,8 +88,8 @@ class SchemaManager:
             self._indexes[name] = idx
             if kind in (INDEX_PROPERTY, INDEX_COMPOSITE, INDEX_RANGE):
                 self._subscribe()
-                self._prop_maps.setdefault((label, tuple(properties)), {})
-                self._backfill(label, tuple(properties))
+                self._prop_maps.setdefault((label, _norm(properties)), {})
+                self._backfill(label, _norm(properties))
             return idx
 
     def drop_index(self, name: str, if_exists: bool = False) -> None:
@@ -91,9 +99,9 @@ class SchemaManager:
                 if if_exists:
                     return
                 raise NotFoundError(f"index {name} not found")
-            key = (idx.label, tuple(idx.properties))
+            key = (idx.label, _norm(idx.properties))
             if not any(
-                (i.label, tuple(i.properties)) == key
+                (i.label, _norm(i.properties)) == key
                 for i in self._indexes.values()
                 if i.kind in (INDEX_PROPERTY, INDEX_COMPOSITE, INDEX_RANGE)
             ):
@@ -113,9 +121,9 @@ class SchemaManager:
     def has_prop_index(self, label: str, properties: list[str]) -> bool:
         """True when an equality-lookup map exists for (label, properties)
         — i.e. lookup() would answer (property/composite/range/constraint
-        maps, NOT fulltext/vector defs)."""
+        maps, NOT fulltext/vector defs). Order-insensitive."""
         with self._lock:
-            return (label, tuple(properties)) in self._prop_maps
+            return (label, _norm(properties)) in self._prop_maps
 
     def find_index(self, label: str, properties: list[str]) -> Optional[IndexDef]:
         with self._lock:
@@ -141,7 +149,7 @@ class SchemaManager:
             c = ConstraintDef(name, label, list(properties), kind)
             self._constraints[name] = c
             self._subscribe()
-            key = (label, tuple(properties))
+            key = (label, _norm(properties))
             created_map = key not in self._prop_maps
             self._prop_maps.setdefault(key, {})
             self._backfill(label, key[1])
@@ -156,7 +164,7 @@ class SchemaManager:
                 if dup is not None:
                     del self._constraints[name]
                     if created_map and not any(
-                        (i.label, tuple(i.properties)) == key
+                        (i.label, _norm(i.properties)) == key
                         for i in self._indexes.values()
                     ):
                         # drop the map we just created, or index_node would
@@ -191,10 +199,11 @@ class SchemaManager:
             for c in self._constraints.values():
                 if c.kind != "unique" or c.label not in node.labels:
                     continue
-                vals = tuple(_freeze(node.properties.get(p)) for p in c.properties)
+                props = _norm(c.properties)
+                vals = tuple(_freeze(node.properties.get(p)) for p in props)
                 if any(v is None for v in vals):
                     continue
-                ids = self._prop_maps.get((c.label, tuple(c.properties)), {}).get(vals)
+                ids = self._prop_maps.get((c.label, props), {}).get(vals)
                 if ids and any(i != (exclude_id or node.id) for i in ids):
                     raise ConstraintViolationError(
                         f"unique constraint {c.name} violated on {c.label}"
@@ -231,12 +240,15 @@ class SchemaManager:
             self._unindex_locked(node.id)
 
     def lookup(self, label: str, properties: list[str], values: list[Any]) -> Optional[set[str]]:
-        """Index-backed equality lookup; None when no such index exists."""
+        """Index-backed equality lookup; None when no such index exists.
+        Property order is irrelevant: (prop, value) pairs are normalized
+        to the sorted-key layout the maps use."""
+        pairs = sorted(zip(properties, values))
         with self._lock:
-            valmap = self._prop_maps.get((label, tuple(properties)))
+            valmap = self._prop_maps.get((label, tuple(p for p, _ in pairs)))
             if valmap is None:
                 return None
-            return set(valmap.get(tuple(_freeze(v) for v in values), set()))
+            return set(valmap.get(tuple(_freeze(v) for _, v in pairs), set()))
 
     def attach(self, engine: Engine) -> None:
         """Subscribe to engine events so index maps stay current, and index

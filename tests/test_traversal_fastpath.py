@@ -289,3 +289,51 @@ class TestResultCacheIsolation:
             "MATCH (a:L {k: 0})-[:R]->(b) RETURN b.k ORDER BY b.k LIMIT 5")
         assert r.rows == [[1]]
         assert calls[0] == 1
+
+    def test_stats_not_shared_with_cache(self):
+        from nornicdb_tpu.cache import QueryCache
+
+        ex = CypherExecutor(MemoryEngine(), cache=QueryCache())
+        ex.execute("CREATE (:S {v: 1})")
+        r1 = ex.execute("MATCH (s:S) RETURN s.v")
+        r1.stats.properties_set += 99
+        assert ex.execute("MATCH (s:S) RETURN s.v").stats.properties_set == 0
+
+    def test_composite_index_order_insensitive(self):
+        """A composite index declared in non-alphabetical property order
+        must serve equality lookups and the fastpath selectivity probe
+        (internal maps are keyed by sorted property tuples)."""
+        ex = CypherExecutor(MemoryEngine())
+        ex.execute("CREATE INDEX c FOR (n:P2) ON (n.zz, n.aa)")
+        for i in range(80):
+            ex.execute(f"CREATE (:P2 {{zz: 'z{i % 8}', aa: {i}}})")
+        r = ex.execute("MATCH (p:P2 {zz: 'z3', aa: 3}) RETURN p.aa")
+        assert r.rows == [[3]]
+        calls = [0]
+        orig = ex.matcher._candidates
+
+        def spy(*a, **k):
+            calls[0] += 1
+            return orig(*a, **k)
+
+        ex.execute("MATCH (a:P2 {zz: 'z3', aa: 3}) CREATE (a)-[:R]->(:X2 {v: 1})")
+        ex.matcher._candidates = spy
+        r = ex.execute("MATCH (a:P2 {zz: 'z3', aa: 3})-[:R]->(x:X2) "
+                       "RETURN x.v ORDER BY x.v LIMIT 5")
+        assert r.rows == [[1]] and calls[0] == 1
+
+    def test_classify_memo_bounds_and_recursion_guard(self):
+        from nornicdb_tpu.cypher.executor import (
+            _classify_query_cached,
+            classify_query_text,
+        )
+
+        _classify_query_cached.cache_clear()
+        huge_flat = "RETURN 1 // " + "x" * 10_000
+        assert classify_query_text(huge_flat) == "read"
+        assert _classify_query_cached.cache_info().currsize == 0
+        deep = "RETURN " + "1 + " * 100_000 + "1"
+        assert classify_query_text(deep) == "write"  # conservative
+        classify_query_text("RETURN 1")
+        classify_query_text("RETURN 1")
+        assert _classify_query_cached.cache_info().hits >= 1
