@@ -23,12 +23,29 @@ def make_path(nodes: list[Node], rels: list[Edge]) -> dict[str, Any]:
     return {"__path__": True, "nodes": nodes, "relationships": rels}
 
 
+def _rel_id(e) -> str:
+    """path_rels holds full Edge objects where materialization is needed
+    (rel variable bound, named path) and bare edge-id strings elsewhere —
+    isomorphism checks work uniformly through this."""
+    return e if isinstance(e, str) else e.id
+
+
 class PatternMatcher:
     def __init__(self, storage: Engine, schema: Optional[SchemaManager] = None,
                  executor=None):
         self.storage = storage
         self.schema = schema
         self.executor = executor
+        # no-copy adjacency where the engine offers it (probe once:
+        # NamespacedEngine surfaces AttributeError when its base lacks it)
+        self._iter_adj = getattr(storage, "iter_adjacency", None)
+        if self._iter_adj is not None:
+            try:
+                self._iter_adj("\x00probe\x00", "out")
+            except AttributeError:
+                self._iter_adj = None
+            except Exception:
+                pass
 
     # -- public --------------------------------------------------------------
     def match_path(
@@ -58,8 +75,15 @@ class PatternMatcher:
     def _node_matches(
         self, node: Node, node_pat: ast.NodePattern, props: Optional[dict]
     ) -> bool:
-        if node_pat.labels and not any(l in node.labels for l in node_pat.labels):
-            return False
+        labels = node_pat.labels
+        if labels:
+            # single-label is the overwhelmingly common shape; skip the
+            # genexpr machinery (profiled top cost of unanchored scans)
+            if len(labels) == 1:
+                if labels[0] not in node.labels:
+                    return False
+            elif not any(l in node.labels for l in labels):
+                return False
         if props:
             for k, v in props.items():
                 if not _value_eq(node.properties.get(k), v):
@@ -139,10 +163,30 @@ class PatternMatcher:
         return True
 
     def _expand(
-        self, node_id: str, rel_pat: ast.RelPattern, props
-    ) -> list[tuple[Edge, str]]:
-        """Edges leaving `node_id` per the pattern direction -> (edge, other_id)."""
-        out: list[tuple[Edge, str]] = []
+        self, node_id: str, rel_pat: ast.RelPattern, props,
+        materialize: bool = True,
+    ) -> list[tuple]:
+        """Edges leaving `node_id` per the pattern direction.
+
+        materialize=True -> (Edge, other_id) pairs (needed when the rel
+        binds a variable, the path is named, or the pattern filters on
+        edge properties). materialize=False with fast adjacency ->
+        (edge_id, other_id) pairs, skipping per-edge defensive copies —
+        the dominant cost of unanchored traversal scans."""
+        if not materialize and props is None and self._iter_adj is not None:
+            out = []
+            types = rel_pat.types
+            if rel_pat.direction in ("out", "both"):
+                for eid, t, oid in self._iter_adj(node_id, "out"):
+                    if not types or t in types:
+                        out.append((eid, oid))
+            if rel_pat.direction in ("in", "both"):
+                for eid, t, oid in self._iter_adj(node_id, "in"):
+                    if not types or t in types:
+                        out.append((eid, oid))
+            out.sort()
+            return out
+        out = []
         if rel_pat.direction in ("out", "both"):
             for e in self.storage.get_outgoing_edges(node_id):
                 if self._rel_matches(e, rel_pat, props):
@@ -200,8 +244,12 @@ class PatternMatcher:
                 target_pat, props, tprops, src,
             )
             return
-        for edge, other_id in self._expand(src.id, rel_pat, props):
-            if any(e.id == edge.id for e in path_rels):
+        need_edges = bool(rel_pat.variable or path.name)
+        for edge, other_id in self._expand(
+            src.id, rel_pat, props, materialize=need_edges
+        ):
+            eid = _rel_id(edge)
+            if any(_rel_id(e) == eid for e in path_rels):
                 continue  # relationship isomorphism
             try:
                 other = self.storage.get_node(other_id)
@@ -233,6 +281,7 @@ class PatternMatcher:
         (ref: findPaths traversal.go:1127)."""
         max_h = min(rel_pat.max_hops, MAX_VAR_LENGTH)
         min_h = rel_pat.min_hops
+        need_edges = bool(rel_pat.variable or path.name)
 
         def walk(curr: Node, hops: int, rels: list[Edge], nodes: list[Node]):
             if hops >= min_h:
@@ -252,9 +301,12 @@ class PatternMatcher:
                         yield new_row, list(nodes), list(rels)
             if hops >= max_h:
                 return
-            for edge, other_id in self._expand(curr.id, rel_pat, props):
-                if any(e.id == edge.id for e in rels) or any(
-                    e.id == edge.id for e in path_rels
+            for edge, other_id in self._expand(
+                curr.id, rel_pat, props, materialize=need_edges
+            ):
+                eid = _rel_id(edge)
+                if any(_rel_id(e) == eid for e in rels) or any(
+                    _rel_id(e) == eid for e in path_rels
                 ):
                     continue
                 try:
