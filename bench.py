@@ -1,7 +1,15 @@
 """Headline benchmark: brute-force cosine top-100 over 1M x 1024d vectors.
 
-Prints exactly ONE JSON line:
+Prints one JSON line per captured leg:
   {"metric": ..., "value": N, "unit": ..., "vs_baseline": N}
+
+ARTIFACT-FIRST ordering (round-5 contract): the CPU-labeled capture runs
+FIRST and prints its JSON line within the first few minutes, so even if the
+driver kills the process mid-run the artifact is never empty. Only then does
+the orchestrator poll for the flaky TPU relay and, if it answers inside the
+remaining budget, append a second (TPU-labeled) JSON line. Total wall clock
+is hard-capped at TOTAL_BUDGET_S (default 1,380s) — observed driver kills
+land between ~1,780s and 2,400s, so the cap leaves ≥400s of headroom.
 
 Baseline: the reference's published vector-search numbers at the same scale
 (1M vectors, 1024 dims) — CUDA on A100: 1 ms / 1000 qps, Metal M2: 2 ms /
@@ -48,18 +56,19 @@ import time
 
 _CHILD_ENV = "NORNICDB_BENCH_CHILD"
 _CPU_FB_ENV = "NORNICDB_BENCH_CPU_FALLBACK"
-# r03 exhausted a 900s budget while the relay stayed down; observed
-# down-windows run for hours, so the official capture waits much longer —
-# a zeroed BENCH artifact costs the round more than the wait costs the run
-ACQUIRE_BUDGET_S = float(os.environ.get("NORNICDB_BENCH_ACQUIRE_BUDGET_S", "2400"))
+# Hard cap on the whole orchestration. r04's acquire budget (2,400s) exceeded
+# the driver's kill window (kill observed between ~1,780s and ~2,400s after
+# start), so the process died before the fallback leg ever ran. 1,380s keeps
+# ≥400s of headroom under the earliest observed kill.
+TOTAL_BUDGET_S = float(os.environ.get("NORNICDB_BENCH_TOTAL_BUDGET_S", "1380"))
 PROBE_TIMEOUT_S = float(os.environ.get(
     "NORNICDB_BENCH_PROBE_TIMEOUT_S", "150"
 ))  # jax.devices() hangs >90s when the relay is down
-CHILD_TIMEOUT_S = float(os.environ.get("NORNICDB_BENCH_CHILD_TIMEOUT_S", "1500"))
-# measured full-size cpu fallback: ~3 min end to end; 600s is ample and is
-# reserved out of ACQUIRE_BUDGET_S so the total stays inside the budget
+CHILD_TIMEOUT_S = float(os.environ.get("NORNICDB_BENCH_CHILD_TIMEOUT_S", "900"))
+# measured full-size cpu capture: ~3 min end to end; this cap only bounds the
+# pathological case — the leg runs FIRST so its line lands early regardless
 FALLBACK_TIMEOUT_S = float(
-    os.environ.get("NORNICDB_BENCH_FALLBACK_TIMEOUT_S", "600")
+    os.environ.get("NORNICDB_BENCH_FALLBACK_TIMEOUT_S", "420")
 )
 
 _BACKEND_ERR_MARKERS = (
@@ -94,23 +103,32 @@ def _probe_backend() -> str | None:
     return None
 
 
-def _acquire_backend(deadline: float) -> str | None:
-    """Poll until the backend answers or the budget runs out."""
+def _acquire_tpu(deadline: float) -> bool:
+    """Poll until a TPU backend answers or the budget runs out.
+
+    A prompt NON-tpu answer (e.g. plain cpu) means this host has no device
+    tunnel at all — polling further cannot help, so stop immediately. Only a
+    hang/error (the relay-down signature) is worth retrying."""
     delay = 20.0
     attempt = 0
-    while True:
+    while deadline - time.monotonic() > PROBE_TIMEOUT_S:
         attempt += 1
         platform = _probe_backend()
+        if platform == "tpu":
+            _log(f"tpu backend up after {attempt} probe(s)")
+            return True
         if platform is not None:
-            _log(f"backend up (platform={platform}) after {attempt} probe(s)")
-            return platform
+            _log(f"backend answered platform={platform}: no tpu tunnel on "
+                 "this host, not retrying")
+            return False
         remaining = deadline - time.monotonic()
-        if remaining <= 0:
-            return None
-        sleep_s = min(delay, remaining)
-        _log(f"backend down; retrying in {sleep_s:.0f}s ({remaining:.0f}s budget left)")
+        if remaining <= PROBE_TIMEOUT_S:
+            break
+        sleep_s = min(delay, remaining - PROBE_TIMEOUT_S)
+        _log(f"relay down; retrying in {sleep_s:.0f}s ({remaining:.0f}s budget left)")
         time.sleep(sleep_s)
         delay = min(delay * 1.7, 120.0)
+    return False
 
 
 def _spawn_child(extra_env: dict, timeout_s: float):
@@ -142,13 +160,13 @@ def _forward_result(stdout: str) -> None:
             print(line, flush=True)
 
 
-def _run_child() -> int | None:
+def _run_tpu_child(timeout_s: float) -> int | None:
     """Run the real bench in a child; forward its stdout JSON line through.
 
     Returns the final exit code, or None when the attempt is retryable
     (timeout, backend-unavailable error, or signal death — a crashing TPU
     client is a relay symptom too)."""
-    r = _spawn_child({}, CHILD_TIMEOUT_S)
+    r = _spawn_child({}, timeout_s)
     if r is None:
         _log("will retry if budget allows")
         return None
@@ -169,20 +187,19 @@ def _run_child() -> int | None:
     return r.returncode
 
 
-def _run_fallback_child() -> int:
-    """TPU never came up: measure the identical workload on the host CPU so
-    the round still records a real number. The JSON labels itself
+def _run_cpu_child(timeout_s: float) -> int:
+    """Measure the workload on the host CPU. The JSON labels itself
     cpu_fallback (metric name suffixed _cpu) and compares against the
     reference's published CPU figure (20 qps AVX2 @1M x 1024d), never the
     A100 one — an honest artifact beats an empty one."""
-    r = _spawn_child({_CPU_FB_ENV: "1"}, FALLBACK_TIMEOUT_S)
+    r = _spawn_child({_CPU_FB_ENV: "1"}, timeout_s)
     if r is None:
-        _log("cpu fallback bench timed out")
+        _log("cpu capture timed out")
         return 2
     if r.stderr:
         sys.stderr.write(r.stderr)
     if r.returncode != 0:
-        _log(f"cpu fallback bench failed rc={r.returncode}")
+        _log(f"cpu capture failed rc={r.returncode}")
         sys.stderr.write(r.stdout)
         return 2
     _forward_result(r.stdout)
@@ -190,23 +207,42 @@ def _run_fallback_child() -> int:
 
 
 def _orchestrate() -> int:
-    # the fallback leg's time is CARVED OUT of the overall budget, so the
-    # worst-case wall clock stays ~ACQUIRE_BUDGET_S and the driver never
-    # kills the process mid-fallback (which would zero the artifact — the
-    # exact failure the fallback exists to prevent)
-    deadline = time.monotonic() + ACQUIRE_BUDGET_S - FALLBACK_TIMEOUT_S
-    while True:
-        if _acquire_backend(deadline) is None:
-            _log("backend never came up within the acquire window; "
-                 "falling back to a cpu-labeled capture")
-            return _run_fallback_child()
-        rc = _run_child()
-        if rc is not None:
-            return rc
-        if time.monotonic() >= deadline:
-            _log("retry budget exhausted after child failure; "
-                 "falling back to a cpu-labeled capture")
-            return _run_fallback_child()
+    """ARTIFACT-FIRST: capture the CPU line before touching the relay.
+
+    Four consecutive rounds recorded an empty official artifact because the
+    TPU leg ran first and the relay stayed down past every budget (r04: the
+    kill landed mid-retry, before the fallback leg was reached). Sequencing
+    the CPU capture first makes an empty artifact impossible short of the
+    driver killing the process inside the first ~3 minutes."""
+    deadline = time.monotonic() + TOTAL_BUDGET_S
+    cpu_rc = _run_cpu_child(min(FALLBACK_TIMEOUT_S, TOTAL_BUDGET_S))
+    if cpu_rc == 0:
+        _log("cpu-labeled line captured; now trying for a tpu line with "
+             f"{deadline - time.monotonic():.0f}s of budget left")
+    else:
+        _log("cpu capture failed — continuing to the tpu attempt anyway")
+    # minimum useful TPU attempt: one probe + compile + a few timed batches
+    min_attempt_s = 300.0
+    tpu_rc: int | None = None
+    while deadline - time.monotonic() > min_attempt_s:
+        if not _acquire_tpu(deadline - min_attempt_s + PROBE_TIMEOUT_S):
+            break
+        remaining = deadline - time.monotonic()
+        if remaining <= min_attempt_s - PROBE_TIMEOUT_S:
+            # a slow-but-successful probe ate the window: a child spawned
+            # now could not compile + run, it would only burn the budget
+            break
+        tpu_rc = _run_tpu_child(min(CHILD_TIMEOUT_S, remaining))
+        if tpu_rc is not None:
+            break
+    if tpu_rc == 0:
+        return 0
+    if tpu_rc is not None:
+        _log(f"tpu leg failed rc={tpu_rc}; cpu line stands as the artifact")
+    else:
+        _log("tpu relay never yielded a capture inside the budget; "
+             "cpu line stands as the artifact")
+    return cpu_rc
 
 N = 1_000_000
 D = 1024

@@ -1,0 +1,49 @@
+#!/bin/bash
+# Capture every outstanding on-chip number during a relay-up window.
+#
+# Priority order (highest-value first — the relay can die at any moment):
+#   1. bench.py TPU leg      — headline knn qps + epilogue A/B self-select
+#   2. benchmarks/ivf_bench.py     — fused IVF vs full scan (small batches)
+#   3. benchmarks/embed_sweep.py   — teacher short-seq grid + distilled rows
+#
+# Every line of output is appended to RELAY_LOG.md AS IT IS PRODUCED
+# (stdbuf line-buffered tee), never batched at the end: a mid-run relay
+# death still leaves everything captured so far on disk.
+#
+# Usage: scripts/capture_window.sh   (idempotent; safe to re-run)
+set -u
+cd "$(dirname "$0")/.."
+LOG=RELAY_LOG.md
+ts() { date -u +%H:%M:%S; }
+note() { echo "[$(ts)] $*" | tee -a "$LOG" >&2; }
+
+echo "" >> "$LOG"
+echo "## capture window $(date -u +%Y-%m-%dT%H:%M:%SZ)" >> "$LOG"
+
+note "probing relay..."
+if ! timeout 120 python -c "import jax; assert jax.devices()[0].platform=='tpu'" 2>/dev/null; then
+  note "relay DOWN — aborting capture (nothing recorded)"
+  exit 1
+fi
+note "relay UP — starting priority captures"
+
+run_step() {
+  local name="$1" tmo="$2"; shift 2
+  note "=== $name (timeout ${tmo}s) ==="
+  stdbuf -oL -eL timeout "$tmo" "$@" 2>&1 | stdbuf -oL tee -a "$LOG"
+  local rc=${PIPESTATUS[0]}
+  note "=== $name done rc=$rc ==="
+  return "$rc"
+}
+
+# 1. headline bench: run the TPU child directly (skip the cpu-first
+#    orchestration — this script only fires when the relay is already up)
+run_step "bench.py tpu leg" 900 env NORNICDB_BENCH_CHILD=1 python bench.py
+
+# 2. fused IVF vs full scan
+run_step "ivf_bench" 900 python benchmarks/ivf_bench.py
+
+# 3. embedding sweep: teacher short-seq grid + distilled student rows
+run_step "embed_sweep" 1200 python benchmarks/embed_sweep.py
+
+note "capture window complete"
