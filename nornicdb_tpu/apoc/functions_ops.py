@@ -94,7 +94,17 @@ def load_json_params(path_or_data, params=None):
     gated) or inline JSON data; a gated path must surface the gate error,
     not fall through to 'parse the path as JSON'."""
     data = str(path_or_data)
+    # inline sniff covers every JSON start token — objects/arrays/strings by
+    # prefix, bare scalars (123, -4.5, true, null) by an actual parse so a
+    # digit-leading *path* ("2024/data.json" fails json.loads) still routes
+    # to the gated file read
     looks_inline = data.lstrip()[:1] in ("{", "[", '"')
+    if not looks_inline:
+        try:
+            _json.loads(data)
+            looks_inline = True
+        except ValueError:
+            pass
     if looks_inline:
         text = data
     else:

@@ -80,8 +80,9 @@ class LimitedEngine(NamespacedEngine):
             _Bucket(limits.max_queries_per_second)
             if limits.max_queries_per_second else None
         )
-
-    _exempt = threading.local()
+        # per-instance: a rollback exemption on one database must not
+        # suspend rate checks on other databases touched by the same thread
+        self._exempt = threading.local()
 
     @contextlib.contextmanager
     def exempt_writes(self):

@@ -64,11 +64,9 @@ _CONSENT_HTML = """<!DOCTYPE html>
 <style>body{{font:14px sans-serif;max-width:420px;margin:60px auto}}
 button{{display:block;width:100%;margin:6px 0;padding:10px}}</style></head>
 <body><h2>Sign in as a test user</h2>
-<p>client: <code>{client_id}</code></p>
+<p>client: <code>{client_id}</code> &rarr; <code>{redirect_uri}</code></p>
 <form method="POST" action="/oauth2/v1/authorize/consent">
-<input type="hidden" name="redirect_uri" value="{redirect_uri}">
-<input type="hidden" name="state" value="{state}">
-<input type="hidden" name="scope" value="{scope}">
+<input type="hidden" name="rid" value="{rid}">
 {buttons}
 </form></body></html>
 """
@@ -86,6 +84,11 @@ class OAuthTestProvider:
         self.users = list(users) if users is not None else list(DEFAULT_USERS)
         self._codes: dict[str, _Grant] = {}
         self._tokens: dict[str, _Grant] = {}
+        # authorize requests awaiting consent, keyed by one-time request id:
+        # the consent POST carries only the rid, so redirect_uri/state/scope
+        # are bound server-side to the validated /authorize request and a
+        # direct POST cannot mint a code for an arbitrary redirect_uri
+        self._pending: dict[str, dict] = {}
         self._lock = threading.Lock()
         provider = self
 
@@ -187,13 +190,26 @@ class OAuthTestProvider:
             f"({esc(', '.join(u.roles))})</button>"
             for u in self.users
         )
-        # every query-derived value is escaped: redirect_uri/state/scope are
-        # attacker-controlled and would otherwise reflect into attributes
+        rid = secrets.token_urlsafe(16)
+        with self._lock:
+            # sweep expired entries so abandoned authorize requests can't
+            # grow the dict without bound in a long-lived process
+            now = time.time()
+            for stale in [r for r, p in self._pending.items()
+                          if p["expires"] < now]:
+                del self._pending[stale]
+            self._pending[rid] = {
+                "redirect_uri": redirect_uri,
+                "state": (q.get("state") or [""])[0],
+                "scope": (q.get("scope") or [""])[0],
+                "expires": time.time() + CODE_TTL_S,
+            }
+        # query-derived values reflected into the page are escaped; the
+        # consent form itself carries only the opaque one-time rid
         h._send(200, _CONSENT_HTML.format(
             client_id=esc(self.client_id),
             redirect_uri=esc(redirect_uri),
-            state=esc((q.get("state") or [""])[0]),
-            scope=esc((q.get("scope") or [""])[0]),
+            rid=esc(rid),
             buttons=buttons,
         ), content_type="text/html; charset=utf-8")
 
@@ -203,19 +219,24 @@ class OAuthTestProvider:
              if u.preferred_username == form.get("username")),
             None,
         )
-        redirect_uri = form.get("redirect_uri", "")
-        if user is None or not redirect_uri:
+        with self._lock:
+            pending = self._pending.pop(form.get("rid", ""), None)
+        if user is None or pending is None or pending["expires"] < time.time():
             h._send(400, {"error": "invalid_request"})
             return
+        redirect_uri = pending["redirect_uri"]
         code = secrets.token_urlsafe(24)
         with self._lock:
             self._codes[code] = _Grant(
                 user, redirect_uri, time.time() + CODE_TTL_S,
-                form.get("scope", ""))
+                pending["scope"])
+        # urlencode: state may contain '&', '#', spaces, or CR/LF — raw
+        # interpolation would corrupt the redirect or inject headers
+        params = {"code": code}
+        if pending["state"]:
+            params["state"] = pending["state"]
         sep = "&" if "?" in redirect_uri else "?"
-        target = f"{redirect_uri}{sep}code={code}"
-        if form.get("state"):
-            target += f"&state={form['state']}"
+        target = f"{redirect_uri}{sep}{urlencode(params)}"
         h._send(302, b"", headers=[("Location", target)])
 
     def _client_ok(self, h, form: dict) -> bool:

@@ -214,6 +214,26 @@ class TestOpsCategories:
         assert lookup("apoc.load.jdbc")("jdbc:x", "SELECT 1") == []
         assert lookup("apoc.load.kafka")("b", "t") == []
 
+    def test_json_params_inline_scalars_bypass_import_gate(self, monkeypatch):
+        """Bare JSON scalars are inline data even with the import gate
+        closed (the default): they must parse, not raise the gate error."""
+        monkeypatch.delenv("NORNICDB_APOC_IMPORT_ENABLED", raising=False)
+        jp = lookup("apoc.load.jsonParams")
+        assert jp("123") == 123
+        assert jp("-4.5") == -4.5
+        assert jp("true") is True
+        assert jp("null") is None
+        assert jp('{"v":"${x}"}', {"x": "y"}) == {"v": "y"}
+
+    def test_json_params_digit_leading_path_still_gated(self, tmp_path,
+                                                        monkeypatch):
+        """A digit-leading file path is NOT inline JSON — it must route to
+        the gated file read, not die inside json.loads."""
+        monkeypatch.setenv("NORNICDB_APOC_IMPORT_ENABLED", "true")
+        p = tmp_path / "2024-data.json"
+        p.write_text('{"year": 2024}')
+        assert lookup("apoc.load.jsonParams")(str(p)) == {"year": 2024}
+
     def test_log_category(self):
         lookup("apoc.log.clear")()
         lookup("apoc.log.info")("hello world")

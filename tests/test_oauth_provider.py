@@ -48,11 +48,26 @@ def _post_form(url, form, headers=None):
         return e.code, e.read(), dict(e.headers)
 
 
+def _start_authorize(provider, redirect_uri, state="xyz", scope=""):
+    """GET /authorize and pull the one-time rid out of the consent form."""
+    q = urllib.parse.urlencode({
+        "response_type": "code", "client_id": provider.client_id,
+        "redirect_uri": redirect_uri, "state": state, "scope": scope})
+    status, body, _ = _get(f"{provider.issuer}/oauth2/v1/authorize?{q}")
+    assert status == 200
+    import re
+
+    m = re.search(r'name="rid" value="([^"]+)"', body.decode())
+    assert m, "consent form must carry the request id"
+    return m.group(1)
+
+
 def _obtain_code(provider, redirect_uri="http://localhost:7474/cb",
                  username="admin", state="xyz"):
+    rid = _start_authorize(provider, redirect_uri, state=state)
     status, _, headers = _post_form(
         f"{provider.issuer}/oauth2/v1/authorize/consent",
-        {"username": username, "redirect_uri": redirect_uri, "state": state})
+        {"username": username, "rid": rid})
     assert status == 302
     loc = urllib.parse.urlparse(headers["Location"])
     q = urllib.parse.parse_qs(loc.query)
@@ -154,6 +169,42 @@ class TestAuthorizationCodeFlow:
             "client_id": provider.client_id,
             "client_secret": provider.client_secret})
         assert status == 400
+
+    def test_consent_requires_bound_authorize_request(self, provider):
+        """A direct consent POST (no rid, or a forged one) must not mint a
+        code for an arbitrary redirect_uri (RFC 6749 binding)."""
+        for form in (
+            {"username": "admin", "redirect_uri": "http://evil/cb"},
+            {"username": "admin", "rid": "forged-rid"},
+        ):
+            status, body, _ = _post_form(
+                f"{provider.issuer}/oauth2/v1/authorize/consent", form)
+            assert status == 400
+            assert json.loads(body)["error"] == "invalid_request"
+
+    def test_rid_single_use(self, provider):
+        rid = _start_authorize(provider, "http://localhost:7474/cb")
+        form = {"username": "admin", "rid": rid}
+        url = f"{provider.issuer}/oauth2/v1/authorize/consent"
+        assert _post_form(url, form)[0] == 302
+        assert _post_form(url, form)[0] == 400
+
+    def test_state_with_metacharacters_is_urlencoded(self, provider):
+        """state containing &, #, spaces, CR/LF must round-trip intact and
+        must not corrupt the redirect or inject headers."""
+        evil_state = "a&b #c\r\nSet-Cookie: x=1"
+        rid = _start_authorize(provider, "http://localhost:7474/cb",
+                               state=evil_state)
+        status, _, headers = _post_form(
+            f"{provider.issuer}/oauth2/v1/authorize/consent",
+            {"username": "admin", "rid": rid})
+        assert status == 302
+        loc = headers["Location"]
+        assert "\r" not in loc and "\n" not in loc
+        assert "Set-Cookie" not in headers or "x=1" not in headers.get(
+            "Set-Cookie", "")
+        q = urllib.parse.parse_qs(urllib.parse.urlparse(loc).query)
+        assert q["state"] == [evil_state]
 
     def test_userinfo_rejects_bad_token(self, provider):
         with pytest.raises(urllib.error.HTTPError) as e:
