@@ -1,0 +1,33 @@
+#!/bin/sh
+# NornicDB-TPU container entrypoint (ref: /root/reference/docker/entrypoint.sh
+# behavior: first-boot init of the data dir, then exec the service so it
+# receives signals directly).
+set -e
+
+DATA_DIR="${NORNICDB_DATA_DIR:-/data}"
+HTTP_PORT="${NORNICDB_HTTP_PORT:-7474}"
+BOLT_PORT="${NORNICDB_BOLT_PORT:-7687}"
+
+if [ "$1" = "serve" ]; then
+    shift
+    if [ ! -d "$DATA_DIR" ] || [ -z "$(ls -A "$DATA_DIR" 2>/dev/null)" ]; then
+        echo "initializing data directory $DATA_DIR"
+        python -m nornicdb_tpu.cli init --data-dir "$DATA_DIR"
+    fi
+    EXTRA=""
+    if [ "${NORNICDB_NO_AUTH:-true}" != "true" ]; then
+        EXTRA="$EXTRA --auth"
+    fi
+    if [ "${NORNICDB_HEADLESS:-false}" = "true" ]; then
+        EXTRA="$EXTRA --headless"
+    fi
+    # shellcheck disable=SC2086
+    exec python -m nornicdb_tpu.cli serve \
+        --host 0.0.0.0 \
+        --data-dir "$DATA_DIR" \
+        --http-port "$HTTP_PORT" \
+        --bolt-port "$BOLT_PORT" \
+        $EXTRA "$@"
+fi
+
+exec python -m nornicdb_tpu.cli "$@"

@@ -1,0 +1,8 @@
+"""`python -m nornicdb_tpu` — same CLI as the `nornicdb` console script."""
+
+import sys
+
+from nornicdb_tpu.cli import main
+
+if __name__ == "__main__":
+    sys.exit(main())

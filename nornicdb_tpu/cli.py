@@ -72,6 +72,7 @@ def cmd_serve(args) -> int:
     http_server = HttpServer(
         db, host=args.host, port=args.http_port,
         authenticator=authenticator, auth_required=args.auth,
+        serve_ui=not args.headless,
     )
     http_server.start()
     bolt_server = BoltServer(
@@ -98,10 +99,29 @@ def cmd_serve(args) -> int:
             if args.data_dir else None,
         )
         qdrant_server.start()
+    # native gRPC search on :50051, feature-flagged like the reference's
+    # nornicgrpc service (ref: search_service.go)
+    grpc_server = None
+    if os.environ.get("NORNICDB_GRPC_ENABLED", "").lower() in (
+        "1", "true", "yes",
+    ):
+        try:
+            from nornicdb_tpu.server.grpc_search import GrpcSearchServer
+
+            grpc_server = GrpcSearchServer(
+                db, host=args.host,
+                port=int(os.environ.get("NORNICDB_GRPC_PORT", "50051")),
+            )
+            grpc_server.start()
+        except ImportError:
+            print("NORNICDB_GRPC_ENABLED set but grpcio is not installed; "
+                  "native gRPC disabled", file=sys.stderr)
     print(f"NornicDB-TPU serving: bolt://{args.host}:{bolt_server.port} "
           f"http://{args.host}:{http_server.port}"
           + (f" qdrant-grpc://{args.host}:{qdrant_server.port}"
              if qdrant_server else "")
+          + (f" grpc://{args.host}:{grpc_server.port}"
+             if grpc_server else "")
           + f" (data: {args.data_dir or 'memory'})")
 
     stop = []
@@ -112,6 +132,8 @@ def cmd_serve(args) -> int:
             time.sleep(0.2)
     finally:
         print("shutting down...")
+        if grpc_server is not None:
+            grpc_server.stop()
         if qdrant_server is not None:
             qdrant_server.stop()
         bolt_server.stop()
@@ -361,6 +383,8 @@ def main(argv=None) -> int:
     s.add_argument("--bolt-port", type=int, default=7687)
     s.add_argument("--http-port", type=int, default=7474)
     s.add_argument("--auth", action="store_true", help="require authentication")
+    s.add_argument("--headless", action="store_true",
+                   help="no browser UI (ref: -tags noui builds)")
     s.add_argument("--embedder", choices=["hash", "tpu", "trained"],
                    default="tpu")
     s.add_argument("--embed-dims", type=int, default=1024)

@@ -24,7 +24,9 @@ import subprocess
 import threading
 from typing import Optional
 
-_NATIVE_DIR = os.path.join(
+# NORNICDB_NATIVE_DIR overrides for installed deployments (Docker image
+# places prebuilt .so files outside the source tree)
+_NATIVE_DIR = os.environ.get("NORNICDB_NATIVE_DIR") or os.path.join(
     os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__)))),
     "native",
 )

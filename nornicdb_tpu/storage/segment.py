@@ -30,7 +30,9 @@ from typing import Iterable, Iterator, Optional
 from nornicdb_tpu.errors import AlreadyExistsError, NornicError, NotFoundError
 from nornicdb_tpu.storage.types import Edge, Engine, Node
 
-_NATIVE_DIR = os.path.join(
+# NORNICDB_NATIVE_DIR overrides for installed deployments (Docker image
+# places prebuilt .so files outside the source tree)
+_NATIVE_DIR = os.environ.get("NORNICDB_NATIVE_DIR") or os.path.join(
     os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__)))),
     "native",
 )

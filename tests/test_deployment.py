@@ -1,0 +1,150 @@
+"""Deployment packaging contract (ref: /root/reference/docker-compose.yml,
+/root/reference/docker/ — CPU/CUDA Dockerfiles, entrypoint, healthcheck).
+
+Docker cannot run inside the build image, so these tests pin the structure:
+compose exposes every protocol port, Dockerfiles only COPY paths that exist,
+the entrypoint only uses CLI flags the argparse parser actually defines, and
+the headless flag + module entry the image relies on really work.
+"""
+
+import os
+import re
+import subprocess
+import sys
+
+import pytest
+import yaml
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+COMPOSE = os.path.join(ROOT, "docker-compose.yml")
+DOCKER_DIR = os.path.join(ROOT, "docker")
+
+
+class TestCompose:
+    @pytest.fixture(scope="class")
+    def compose(self):
+        with open(COMPOSE) as f:
+            return yaml.safe_load(f)
+
+    def test_service_defined_with_build_and_volume(self, compose):
+        svc = compose["services"]["nornicdb"]
+        assert svc["build"]["dockerfile"] == "docker/Dockerfile.cpu"
+        assert any("/data" in v for v in svc["volumes"])
+        assert "nornic-data" in compose["volumes"]
+
+    def test_all_protocol_ports_mapped(self, compose):
+        """7474 HTTP/UI/MCP/GraphQL, 7687 Bolt, 6334 Qdrant gRPC,
+        50051 native gRPC — the full protocol surface of serve."""
+        ports = {p.split(":")[-1] for p in
+                 compose["services"]["nornicdb"]["ports"]}
+        assert {"7474", "7687", "6334", "50051"} <= ports
+
+    def test_protocol_feature_flags_enabled(self, compose):
+        env = dict(e.split("=", 1) for e in
+                   compose["services"]["nornicdb"]["environment"])
+        assert env["NORNICDB_QDRANT_GRPC_ENABLED"] == "true"
+        assert env["NORNICDB_GRPC_ENABLED"] == "true"
+        assert env["NORNICDB_DATA_DIR"] == "/data"
+        # headless flag is surfaced, defaulting to the UI build
+        assert "NORNICDB_HEADLESS" in env
+
+    def test_healthcheck_targets_health_endpoint(self, compose):
+        hc = compose["services"]["nornicdb"]["healthcheck"]["test"]
+        assert "/health" in " ".join(hc)
+
+
+class TestDockerfiles:
+    @pytest.mark.parametrize("name", ["Dockerfile.cpu", "Dockerfile.tpu"])
+    def test_copy_sources_exist(self, name):
+        """Every COPY source in the build context must exist, or the build
+        fails at docker time where CI can't see it."""
+        with open(os.path.join(DOCKER_DIR, name)) as f:
+            content = f.read()
+        for line in content.splitlines():
+            m = re.match(r"^COPY\s+(?!--from)(.+)\s+\S+$", line.strip())
+            if not m:
+                continue
+            for src in m.group(1).split():
+                assert os.path.exists(os.path.join(ROOT, src)), (name, src)
+
+    @pytest.mark.parametrize("name", ["Dockerfile.cpu", "Dockerfile.tpu"])
+    def test_ports_unprivileged_user_healthcheck(self, name):
+        with open(os.path.join(DOCKER_DIR, name)) as f:
+            content = f.read()
+        m = re.search(r"^EXPOSE\s+(.+)$", content, re.M)
+        assert m and {"7474", "7687", "6334", "50051"} <= set(
+            m.group(1).split())
+        assert re.search(r"^USER\s+nornic", content, re.M)
+        assert "HEALTHCHECK" in content
+        assert "NORNICDB_NATIVE_DIR=/app/native" in content
+
+    def test_cpu_image_pins_cpu_backend(self):
+        with open(os.path.join(DOCKER_DIR, "Dockerfile.cpu")) as f:
+            assert "JAX_PLATFORMS=cpu" in f.read()
+
+
+class TestEntrypoint:
+    PATH = os.path.join(DOCKER_DIR, "entrypoint.sh")
+
+    def test_shell_syntax(self):
+        r = subprocess.run(["sh", "-n", self.PATH], capture_output=True)
+        assert r.returncode == 0, r.stderr
+
+    def test_flags_exist_in_cli(self):
+        """Flags the entrypoint passes must be defined by the parser —
+        a drifted flag would crash the container at boot."""
+        with open(self.PATH) as f:
+            content = f.read()
+        with open(os.path.join(ROOT, "nornicdb_tpu", "cli.py")) as f:
+            cli_src = f.read()
+        for flag in re.findall(r"--[a-z-]+", content):
+            assert f'"{flag}"' in cli_src, flag
+
+    def test_execs_service_for_signal_delivery(self):
+        with open(self.PATH) as f:
+            content = f.read()
+        assert "exec python -m nornicdb_tpu.cli serve" in content
+
+
+class TestImageEntrySurface:
+    def test_module_entry_help(self):
+        r = subprocess.run(
+            [sys.executable, "-m", "nornicdb_tpu", "--help"],
+            capture_output=True, text=True, timeout=120,
+            cwd=ROOT,
+        )
+        assert r.returncode == 0, r.stderr[-500:]
+        assert "serve" in r.stdout
+
+    def test_serve_accepts_headless(self):
+        from nornicdb_tpu.cli import main as cli_main
+
+        with pytest.raises(SystemExit) as e:
+            cli_main(["serve", "--help"])
+        assert e.value.code == 0
+
+    def test_headless_http_has_no_ui(self):
+        """--headless wires serve_ui=False: / returns no SPA."""
+        import json
+        import urllib.error
+        import urllib.request
+
+        import nornicdb_tpu
+        from nornicdb_tpu.server import HttpServer
+
+        db = nornicdb_tpu.open_db("")
+        try:
+            srv = HttpServer(db, port=0, serve_ui=False)
+            srv.start()
+            try:
+                with pytest.raises(urllib.error.HTTPError) as e:
+                    urllib.request.urlopen(
+                        f"http://127.0.0.1:{srv.port}/", timeout=10)
+                assert e.value.code == 404
+                body = json.loads(urllib.request.urlopen(
+                    f"http://127.0.0.1:{srv.port}/health", timeout=10).read())
+                assert body["status"] == "ok"
+            finally:
+                srv.stop()
+        finally:
+            db.close()
