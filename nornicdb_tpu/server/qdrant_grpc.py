@@ -160,6 +160,22 @@ def _floats(raw: bytes) -> list[float]:
     return list(struct.unpack(f"<{len(raw) // 4}f", raw[: len(raw) // 4 * 4]))
 
 
+def _varint_list(entries: list[tuple[int, Any]]) -> list[int]:
+    """repeated int64 values: canonical proto3 encoders PACK them (one
+    length-delimited blob of varints, wiretype 2) while lenient encoders may
+    emit one varint field per element — accept both, like protobuf does."""
+    out: list[int] = []
+    for wire, v in entries:
+        if isinstance(v, bytes):
+            pos = 0
+            while pos < len(v):
+                val, pos = _read_varint(v, pos)
+                out.append(_i64(val))
+        else:
+            out.append(_i64(v))
+    return out
+
+
 # ----------------------------------------------------- qdrant.Value codec
 # json_with_int.proto: Value oneof kind { NullValue null_value=1;
 # double double_value=2; int64 integer_value=3; string string_value=4;
@@ -257,10 +273,10 @@ def _dec_match(raw: bytes) -> dict:
         return {"any": [r.decode("utf-8") for _, r in rs.get(1, [])]}
     if 6 in f:
         ri = _parse(f[6][0][1])
-        return {"any": [_i64(v) for _, v in ri.get(1, [])]}
+        return {"any": _varint_list(ri.get(1, []))}
     if 7 in f:
         ri = _parse(f[7][0][1])
-        return {"except": [_i64(v) for _, v in ri.get(1, [])]}
+        return {"except": _varint_list(ri.get(1, []))}
     if 8 in f:
         rs = _parse(f[8][0][1])
         return {"except": [r.decode("utf-8") for _, r in rs.get(1, [])]}
