@@ -43,7 +43,9 @@ class BgeConfig:
     max_positions: int = 8194
     type_vocab: int = 1
     pad_token_id: int = 1
-    dims: int = 1024  # output embedding dims (== hidden for bge-m3 dense)
+    # output embedding dims; when != hidden, a learned projection head maps
+    # the CLS state to dims so width-shrunk students stay serving drop-ins
+    dims: int = 1024
     dtype: str = "bfloat16"
 
 
@@ -53,7 +55,8 @@ BGE_M3 = BgeConfig()
 # and output dims so a distilled checkpoint is a drop-in for serving;
 # analytic compute is 24/6 = 4x less than the teacher per token.
 BGE_DISTILL_6L = BgeConfig(layers=6)
-# deeper shrink: 12L at half width = ~8x less compute, dims preserved
+# deeper shrink: 12L at half width = ~8x less compute; the projection head
+# (dims=1024 != hidden=512) keeps the output space identical to the teacher
 BGE_DISTILL_12L_512 = BgeConfig(layers=12, hidden=512, heads=8,
                                 intermediate=2048)
 BGE_SMALL = BgeConfig(
@@ -64,7 +67,7 @@ BGE_SMALL = BgeConfig(
 
 def init_params(cfg: BgeConfig, key: jax.Array) -> dict:
     dtype = jnp.dtype(cfg.dtype)
-    keys = jax.random.split(key, cfg.layers + 4)
+    keys = jax.random.split(key, cfg.layers + 5)
     params = {
         "tok_emb": normal_init(keys[0], (cfg.vocab_size, cfg.hidden), dtype=dtype),
         "pos_emb": normal_init(keys[1], (cfg.max_positions, cfg.hidden), dtype=dtype),
@@ -86,6 +89,9 @@ def init_params(cfg: BgeConfig, key: jax.Array) -> dict:
                 "mlp_ln": init_layer_norm(cfg.hidden),
             }
         )
+    if cfg.dims != cfg.hidden:
+        params["proj"] = init_dense(
+            keys[cfg.layers + 4], cfg.hidden, cfg.dims, dtype=dtype)
     return params
 
 
@@ -117,7 +123,10 @@ def forward(
         h = layer_norm(blk["attn_ln"], h + dense(blk["o"], o))  # post-LN
         m = dense(blk["down"], jax.nn.gelu(dense(blk["up"], h)))
         h = layer_norm(blk["mlp_ln"], h + m)
-    cls = h[:, 0, :].astype(jnp.float32)  # CLS pooling (bge dense head)
+    cls = h[:, 0, :]  # CLS pooling (bge dense head)
+    if cfg.dims != cfg.hidden:
+        cls = dense(params["proj"], cls)  # width-shrunk student -> dims
+    cls = cls.astype(jnp.float32)
     norm = jnp.linalg.norm(cls, axis=-1, keepdims=True)
     return cls / jnp.maximum(norm, 1e-12)
 
