@@ -91,7 +91,8 @@ class Generator:
 class QwenGenerator(Generator):
     """Qwen2-on-TPU backend (replaces llama.cpp generation)."""
 
-    def __init__(self, cfg=None, params=None, tokenizer=None, seed: int = 0):
+    def __init__(self, cfg=None, params=None, tokenizer=None, seed: int = 0,
+                 max_context: int = 256):
         import jax
 
         from nornicdb_tpu.models import qwen2
@@ -104,14 +105,26 @@ class QwenGenerator(Generator):
         )
         self.tokenizer = tokenizer or HashTokenizer(self.cfg.vocab_size)
         self.qwen2 = qwen2
+        # prompts are trimmed to the model's trained window: for in-image
+        # checkpoints rope positions beyond it were never seen in training
+        self.max_context = max_context
 
     def generate(self, prompt: str, max_tokens: int = 128) -> str:
-        ids = self.tokenizer.encode(prompt, add_special=False)[-256:] or [1]
+        ids = self.tokenizer.encode(
+            prompt, add_special=False)[-self.max_context:] or [1]
         out = self.qwen2.generate(
-            self.params, self.cfg, ids, max_new_tokens=max_tokens,
+            self.params, self.cfg, ids,
+            max_new_tokens=self._cap_new_tokens(max_tokens),
             eos_id=getattr(self.tokenizer, "eos_id", -1),
         )
         return self.tokenizer.decode(out)
+
+    def _cap_new_tokens(self, max_tokens: int) -> int:
+        """Bound decode length to one trained window beyond the prompt:
+        positions past 2x max_context are deep rope extrapolation for an
+        in-image from-scratch model (held-out action rates were measured
+        at prompt<=window + window new tokens)."""
+        return max(1, min(max_tokens, self.max_context))
 
     def generate_stream(self, prompt: str, max_tokens: int = 128):
         """TRUE incremental decode (ref: GenerationModel streaming,
@@ -120,7 +133,9 @@ class QwenGenerator(Generator):
         decode so any tokenizer's spacing/punctuation rules hold."""
         import jax.numpy as jnp
 
-        ids = self.tokenizer.encode(prompt, add_special=False)[-256:] or [1]
+        ids = self.tokenizer.encode(
+            prompt, add_special=False)[-self.max_context:] or [1]
+        max_tokens = self._cap_new_tokens(max_tokens)
         # bucketed cache length: one compiled program per power-of-two
         # bucket instead of one per distinct prompt length
         max_len = self.qwen2.round_up_pow2(len(ids) + max_tokens)
@@ -311,7 +326,22 @@ class HeimdallManager:
     @staticmethod
     def try_parse_action(text: str) -> Optional[dict[str, Any]]:
         """Extract a JSON action from model output (ref: tryParseAction
-        handler.go:516)."""
+        handler.go:516).
+
+        In-image generators decode through a word-level tokenizer that
+        spaces out punctuation ('{ " action " : ...'), so when the direct
+        scan finds nothing, retry with quote-adjacent whitespace collapsed —
+        interior spaces (e.g. inside a cypher string) are preserved."""
+        out = HeimdallManager._try_parse_action_exact(text)
+        if out is not None:
+            return out
+        normalized = re.sub(r'\s+"', '"', re.sub(r'"\s+', '"', text))
+        if normalized != text:
+            return HeimdallManager._try_parse_action_exact(normalized)
+        return None
+
+    @staticmethod
+    def _try_parse_action_exact(text: str) -> Optional[dict[str, Any]]:
         marker = text.find('"action"')
         if marker == -1:
             return None

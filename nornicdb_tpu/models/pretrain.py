@@ -159,6 +159,156 @@ def synth_corpus(seed: int = 0, repeats: int = 40) -> list[str]:
     return [lines[i] for i in idx]
 
 
+# ------------------------------------------------- action-mode corpus
+# Chat turns whose assistant side is a JSON action (the reference's ACTION
+# MODE, pkg/heimdall/handler.go:516 tryParseAction): the model must LEARN to
+# emit machine-parseable {"action": ...} objects for database-operation
+# prompts. Phrasing x label combinations are split train/held-out so the
+# action-parse rate is measured on prompts never seen in training
+# (`action_eval_cases`).
+_ACTION_INTENTS = [
+    # (intent, phrasing templates, cypher template or None for status)
+    ("count", [
+        "how many {l} nodes are there ?",
+        "count the {l} nodes",
+        "what is the number of {l} nodes ?",
+        "give me the {l} node count",
+    ], "match ( n : {l} ) return count ( n )"),
+    ("find_all", [
+        "show me all {l} nodes",
+        "list the {l} nodes",
+        "find every {l} node",
+        "fetch all {l} nodes please",
+    ], "match ( n : {l} ) return n limit 25"),
+    ("named", [
+        "find {l} nodes that have a name",
+        "which {l} nodes are named ?",
+        "show {l} nodes with a name property",
+    ], "match ( n : {l} ) where n.name is not null return n"),
+    ("neighbors", [
+        "what is connected to the {l} nodes ?",
+        "show the neighbors of {l} nodes",
+        "which nodes link to a {l} node ?",
+    ], "match ( n : {l} ) - [ r ] - ( m ) return m limit 25"),
+]
+
+_STATUS_PROMPTS = [
+    "is the database healthy ?",
+    "what is the database status ?",
+    "how big is the graph ?",
+    "give me a status report",
+    "are things running ok ?",
+]
+
+# wider label set than _LABELS: label copying (prompt -> cypher) only beats
+# label memorization when enough distinct labels share each template
+_ACTION_LABELS = _LABELS + [
+    "user", "order", "product", "article", "meeting", "note", "team",
+    "ticket", "region", "device", "session", "invoice",
+]
+
+
+def _action_json(cypher: Optional[str]) -> str:
+    """Action JSON in the word-tokenizer's native spacing, so the training
+    text round-trips through encode/decode unchanged."""
+    if cypher is None:
+        return '{ " action " : " status " , " params " : { } }'
+    return ('{ " action " : " query " , " params " : '
+            '{ " cypher " : " ' + cypher + ' " } }')
+
+
+def _action_pairs():
+    """Every (prompt, cypher-or-None) pair in the action domain."""
+    pairs = []
+    for intent, templates, cy in _ACTION_INTENTS:
+        for ti, tpl in enumerate(templates):
+            for li, label in enumerate(_ACTION_LABELS):
+                pairs.append((intent, ti, li, tpl.format(l=label),
+                              cy.format(l=label)))
+    for i, p in enumerate(_STATUS_PROMPTS):
+        pairs.append(("status", i, -1, p, None))
+    return pairs
+
+
+def _is_held_out(intent: str, ti: int, li: int) -> bool:
+    # hold out (template, label) combinations — both the phrasing and the
+    # label appear in training, their pairing does not (compositional split);
+    # for status (no label) one phrasing is held out entirely
+    if li < 0:
+        return ti == len(_STATUS_PROMPTS) - 1
+    return (ti + li) % 5 == 0
+
+
+def _serving_preamble_lines() -> list[str]:
+    """The REAL Heimdall serving context (PromptContext._build_full_prompt +
+    CYPHER_PRIMER), as corpus lines: training on it keeps the served system
+    prompt fully in-vocab (no <unk> floods at chat time) and teaches the
+    model the text that precedes every real user turn."""
+    from nornicdb_tpu.heimdall.context import CYPHER_PRIMER
+
+    lines = [
+        "You are Heimdall, the AI assistant for NornicDB - a "
+        "high-performance graph database.",
+        "Your role is to help users manage the database by executing "
+        "actions and running Cypher queries.",
+        "AVAILABLE ACTIONS:",
+        "- heal: re-embed nodes with missing vectors",
+        "- query: run a read-only Cypher query. params: "
+        '{"action": "query", "params": {"cypher": "MATCH ..."}}',
+        "- status: database health and node/edge counts. params: "
+        '{"action": "status", "params": {}}',
+        "RESPONSE MODES:",
+        "1. ACTION MODE - For database operations, respond with JSON:",
+        '{"action": "status", "params": {}}',
+        '{"action": "query", "params": {"cypher": "MATCH (n) RETURN '
+        'count(n)"}}',
+        "2. HELP MODE - For Cypher questions, explain with examples.",
+        "IMPORTANT: Always complete your JSON responses with proper "
+        "closing braces.",
+        "Respond with JSON action command only. No explanations, "
+        "no markdown.",
+    ] + [ln for ln in CYPHER_PRIMER.splitlines() if ln.strip()]
+    return lines
+
+
+_SERVED_TAIL = ("respond with json action command only . no explanations , "
+                "no markdown .")
+
+
+def synth_action_corpus(seed: int = 0, repeats: int = 6) -> list[str]:
+    """Training lines for ACTION MODE: 'user: <prompt> assistant: <json>'.
+
+    Every pair is also emitted in SERVED form — prefixed with the closing
+    line of the real system prompt — so the chat path (full context prompt,
+    trimmed to the trained window) is in-distribution, not just the bare
+    generator path. Held-out combinations are excluded — see
+    action_eval_cases."""
+    rng = np.random.default_rng(seed + 7)
+    lines = []
+    for _ in range(repeats):
+        lines.extend(_serving_preamble_lines())
+        for intent, ti, li, prompt, cypher in _action_pairs():
+            if _is_held_out(intent, ti, li):
+                continue
+            bare = f"user: {prompt} assistant: {_action_json(cypher)}"
+            lines.append(bare)
+            lines.append(f"{_SERVED_TAIL} user: {prompt} assistant: "
+                         f"{_action_json(cypher)}")
+    idx = rng.permutation(len(lines))
+    return [lines[i] for i in idx]
+
+
+def action_eval_cases() -> list[dict]:
+    """Held-out (never-trained) prompts with their expected action."""
+    cases = []
+    for intent, ti, li, prompt, cypher in _action_pairs():
+        if _is_held_out(intent, ti, li):
+            cases.append({"prompt": prompt, "intent": intent,
+                          "action": "status" if cypher is None else "query",
+                          "cypher": cypher})
+    return cases
+
+
 # ------------------------------------------------------------- LM training
 def train_assistant(
     out_dir: str,
@@ -222,6 +372,9 @@ def train_assistant(
             "kv_heads": cfg.kv_heads, "intermediate": cfg.intermediate,
             "max_positions": cfg.max_positions,
             "rope_theta": cfg.rope_theta,
+            # rope positions beyond the training window are OOD for a
+            # from-scratch model: serving trims prompts to this length
+            "trained_seq_len": seq_len,
         }, f)
     return {
         "loss_first": losses[0], "loss_last": losses[-1],
@@ -241,12 +394,14 @@ def load_generator(model_dir: str):
         c = json.load(f)
     if c.pop("kind") != "qwen2":
         raise ValueError(f"{model_dir} is not an assistant checkpoint")
+    trained_seq_len = c.pop("trained_seq_len", 0)
     cfg = qwen2.QwenConfig(**c)
     template = qwen2.init_params(cfg, jax.random.PRNGKey(0))
     params = weights.load_params(
         os.path.join(model_dir, "model.safetensors"), template)
     tok = VocabTokenizer.load(os.path.join(model_dir, "vocab.json"))
-    return QwenGenerator(cfg=cfg, params=params, tokenizer=tok)
+    return QwenGenerator(cfg=cfg, params=params, tokenizer=tok,
+                         max_context=trained_seq_len or 256)
 
 
 # --------------------------------------------------------- encoder training
@@ -361,10 +516,12 @@ def distill_encoder(
     if tc.pop("kind") != "bge":
         raise ValueError(f"{teacher_dir} is not an encoder checkpoint")
     tc.pop("distilled_from", None)  # chained distillation: 24L -> 4L -> 2L
+    t_flat = weights.load_safetensors(
+        os.path.join(teacher_dir, "model.safetensors"))
+    _reconcile_pre_projection_checkpoint(tc, t_flat)
     t_cfg = bge_m3.BgeConfig(**tc)
-    t_params = weights.load_params(
-        os.path.join(teacher_dir, "model.safetensors"),
-        bge_m3.init_params(t_cfg, jax.random.PRNGKey(0)))
+    t_params = weights.unflatten_params(
+        t_flat, bge_m3.init_params(t_cfg, jax.random.PRNGKey(0)))
     tok = VocabTokenizer.load(os.path.join(teacher_dir, "vocab.json"))
 
     s_cfg = bge_m3.BgeConfig(
@@ -445,6 +602,16 @@ def distill_encoder(
             "teacher_layers": t_cfg.layers, "student_layers": s_cfg.layers}
 
 
+def _reconcile_pre_projection_checkpoint(cfg_dict: dict, flat: dict) -> None:
+    """Checkpoints saved before the dims-projection head existed carry
+    dims != hidden but no proj tensors (forward used to ignore dims and
+    output hidden width). Restore their true output width so the template
+    matches the file instead of KeyError'ing on proj.*."""
+    if cfg_dict.get("dims") != cfg_dict.get("hidden") and not any(
+            k.startswith("proj") for k in flat):
+        cfg_dict["dims"] = cfg_dict["hidden"]
+
+
 def load_embedder(model_dir: str, **kwargs):
     """Checkpoint dir -> embed.TPUEmbedder running the trained encoder."""
     import jax
@@ -457,10 +624,12 @@ def load_embedder(model_dir: str, **kwargs):
     if c.pop("kind") != "bge":
         raise ValueError(f"{model_dir} is not an encoder checkpoint")
     c.pop("distilled_from", None)  # provenance metadata, not architecture
+    flat = weights.load_safetensors(
+        os.path.join(model_dir, "model.safetensors"))
+    _reconcile_pre_projection_checkpoint(c, flat)
     cfg = bge_m3.BgeConfig(**c)
     template = bge_m3.init_params(cfg, jax.random.PRNGKey(0))
-    params = weights.load_params(
-        os.path.join(model_dir, "model.safetensors"), template)
+    params = weights.unflatten_params(flat, template)
     tok = VocabTokenizer.load(os.path.join(model_dir, "vocab.json"))
     kwargs.setdefault("max_len", cfg.max_positions - 8)
     return TPUEmbedder(cfg=cfg, params=params, tokenizer=tok, **kwargs)

@@ -415,10 +415,14 @@ class SegmentEngine(Engine):
         return out
 
     def all_nodes(self) -> Iterator[Node]:
-        for key in self._kv.keys(b"n:"):
-            raw = self._kv.get(key)
-            if raw is not None:
-                yield Node.from_dict(json.loads(raw))
+        # snapshot at call time (the badger engine iterates inside one txn
+        # view, ref: badger_test.go TestGetAllNodes): a concurrent delete
+        # must not change what an already-started iteration yields
+        with self._lock:
+            snapshot = [raw for raw in
+                        (self._kv.get(k) for k in self._kv.keys(b"n:"))
+                        if raw is not None]
+        return iter([Node.from_dict(json.loads(r)) for r in snapshot])
 
     # -- edges -----------------------------------------------------------------
     def create_edge(self, edge: Edge) -> Edge:
@@ -499,10 +503,14 @@ class SegmentEngine(Engine):
             return None
 
     def all_edges(self) -> Iterator[Edge]:
-        for key in self._kv.keys(b"e:"):
-            raw = self._kv.get(key)
-            if raw is not None:
-                yield Edge.from_dict(json.loads(raw))
+        # snapshot at call time, same contract as all_nodes (badger
+        # iterates inside one txn view): concurrent deletes must not
+        # change what an already-started iteration yields
+        with self._lock:
+            snapshot = [raw for raw in
+                        (self._kv.get(k) for k in self._kv.keys(b"e:"))
+                        if raw is not None]
+        return iter([Edge.from_dict(json.loads(r)) for r in snapshot])
 
     # -- counts / pending ---------------------------------------------------------
     def node_count(self) -> int:

@@ -1,0 +1,211 @@
+"""DB facade unit depth (ref: pkg/nornicdb/db_test.go, 1,684 LoC — the
+reference's per-method facade suite: Store defaults/tiers/props, Recall
+access reinforcement, Remember, Link confidence/auto-generated, Neighbors
+depth/direction, Forget cascade, stats, open/close lifecycle, backup and
+restore roundtrip)."""
+
+import os
+import time
+
+import numpy as np
+import pytest
+
+import nornicdb_tpu
+from nornicdb_tpu.embed import HashEmbedder
+from nornicdb_tpu.errors import NotFoundError
+
+
+@pytest.fixture
+def db():
+    d = nornicdb_tpu.open_db("")
+    d.set_embedder(HashEmbedder(32))
+    yield d
+    d.close()
+
+
+class TestStore:
+    def test_defaults(self, db):
+        """ref: TestStore 'stores memory with defaults'"""
+        n = db.store("Test content")
+        assert n.id
+        assert n.labels == ["Memory"]
+        assert n.properties["content"] == "Test content"
+        assert n.memory_type == "semantic"
+        assert n.decay_score == 1.0
+        assert n.access_count == 0
+        assert n.created_at > 0
+        assert n.last_accessed > 0
+
+    def test_explicit_memory_type(self, db):
+        """ref: 'stores memory with explicit tier'"""
+        n = db.store("Important skill", memory_type="procedural")
+        assert n.memory_type == "procedural"
+        assert db.storage.get_node(n.id).memory_type == "procedural"
+
+    def test_custom_labels_and_properties(self, db):
+        """ref: 'stores memory with tags and properties'"""
+        n = db.store("Tagged content", labels=["Doc", "Tagged"],
+                     properties={"source": "test-source", "custom": "value"})
+        assert n.labels == ["Doc", "Tagged"]
+        assert n.properties["source"] == "test-source"
+        # content default does not clobber an explicit property
+        n2 = db.store("ignored", properties={"content": "explicit"})
+        assert n2.properties["content"] == "explicit"
+
+    def test_store_queues_embedding(self, db):
+        n = db.store("embed me")
+        assert n.id in db.storage.pending_embed_ids()
+        db.process_pending_embeddings()
+        assert db.storage.get_node(n.id).embedding is not None
+
+    def test_explicit_node_id(self, db):
+        n = db.store("with id", node_id="custom-id-1")
+        assert n.id == "custom-id-1"
+        assert db.storage.get_node("custom-id-1")
+
+
+class TestRecallRememberTouch:
+    def test_recall_returns_relevant_and_reinforces(self, db):
+        """ref: TestRecall — hits bump access_count + last_accessed."""
+        a = db.store("norse mythology and ravens")
+        db.store("cooking pasta recipes")
+        db.process_pending_embeddings()
+        results = db.recall("norse ravens", limit=5)
+        assert results
+        assert results[0]["id"] == a.id
+        assert db.storage.get_node(a.id).access_count >= 1
+
+    def test_remember_fetches_and_reinforces(self, db):
+        """ref: TestRemember"""
+        n = db.store("a fact")
+        before = db.storage.get_node(n.id)
+        time.sleep(0.01)
+        got = db.remember(n.id)
+        assert got.id == n.id
+        assert got.access_count == before.access_count + 1
+        assert got.last_accessed > before.last_accessed
+
+    def test_remember_missing_raises(self, db):
+        with pytest.raises(NotFoundError):
+            db.remember("ghost")
+
+
+class TestLink:
+    def test_link_with_metadata(self, db):
+        """ref: TestLink — confidence + auto_generated persist."""
+        a, b = db.store("a"), db.store("b")
+        e = db.link(a.id, b.id, "CAUSES", properties={"weight": 0.8},
+                    confidence=0.7, auto_generated=True)
+        stored = db.storage.get_edge(e.id)
+        assert stored.type == "CAUSES"
+        assert stored.confidence == 0.7
+        assert stored.auto_generated is True
+        assert stored.properties["weight"] == 0.8
+
+    def test_link_missing_endpoint_raises(self, db):
+        a = db.store("a")
+        with pytest.raises(NotFoundError):
+            db.link(a.id, "ghost", "R")
+
+    def test_default_relation_type(self, db):
+        a, b = db.store("a"), db.store("b")
+        assert db.link(a.id, b.id).type == "RELATED_TO"
+
+
+class TestNeighbors:
+    def test_depth_one_both_directions(self, db):
+        """ref: TestNeighbors — outgoing AND incoming count."""
+        center = db.store("center")
+        out_n = db.store("out")
+        in_n = db.store("in")
+        db.link(center.id, out_n.id, "TO")
+        db.link(in_n.id, center.id, "FROM")
+        got = {n.id for n in db.neighbors(center.id)}
+        assert got == {out_n.id, in_n.id}
+
+    def test_depth_two_bfs_no_revisit(self, db):
+        a, b, c = db.store("a"), db.store("b"), db.store("c")
+        db.link(a.id, b.id)
+        db.link(b.id, c.id)
+        db.link(c.id, a.id)  # cycle back
+        d1 = {n.id for n in db.neighbors(a.id, depth=1)}
+        d2 = {n.id for n in db.neighbors(a.id, depth=2)}
+        assert d1 == {b.id, c.id}  # both directions at depth 1
+        assert d2 == {b.id, c.id}  # cycle must not duplicate or loop
+
+    def test_isolated_node_empty(self, db):
+        a = db.store("lonely")
+        assert db.neighbors(a.id) == []
+
+
+class TestForget:
+    def test_forget_cascades_and_removes_from_search(self, db):
+        """ref: TestForget"""
+        a, b = db.store("target phrase unique"), db.store("other")
+        db.link(a.id, b.id)
+        db.process_pending_embeddings()
+        assert any(r["id"] == a.id for r in db.recall("target phrase"))
+        db.forget(a.id)
+        with pytest.raises(NotFoundError):
+            db.storage.get_node(a.id)
+        assert db.storage.edge_count() == 0
+        assert all(r["id"] != a.id for r in db.recall("target phrase"))
+
+    def test_forget_missing_raises(self, db):
+        with pytest.raises(NotFoundError):
+            db.forget("ghost")
+
+
+class TestCypherAndLifecycle:
+    def test_cypher_roundtrip_through_facade(self, db):
+        """ref: TestCypher / TestExecuteCypher"""
+        db.cypher("CREATE (n:Facade {k: 1})")
+        res = db.cypher("MATCH (n:Facade) RETURN n.k AS k")
+        assert res.rows == [[1]]
+        assert res.columns == ["k"]
+        assert db.execute_cypher is db.cypher or callable(db.execute_cypher)
+
+    def test_context_manager_closes(self):
+        with nornicdb_tpu.open_db("") as d:
+            d.store("x")
+        # second close is harmless
+        d.close()
+
+    def test_durable_open_close_reopen(self, tmp_path):
+        """ref: TestOpen/TestClose — reopen recovers state."""
+        p = str(tmp_path / "data")
+        d = nornicdb_tpu.open_db(p)
+        n = d.store("durable memory")
+        d.flush()
+        d.close()
+        d2 = nornicdb_tpu.open_db(p)
+        try:
+            assert d2.storage.get_node(n.id).properties["content"] == \
+                "durable memory"
+        finally:
+            d2.close()
+
+
+class TestBackupRestore:
+    def test_backup_restore_roundtrip(self, db, tmp_path):
+        """ref: TestBackup / TestRestore"""
+        a = db.store("keep me", properties={"k": [1, 2]})
+        b = db.store("and me")
+        db.link(a.id, b.id, "R")
+        db.process_pending_embeddings()
+        dest = str(tmp_path / "bk.json.gz")
+        path = db.backup(dest)
+        assert os.path.exists(path)
+        fresh = nornicdb_tpu.open_db("")
+        try:
+            stats = fresh.restore(path)
+            assert fresh.storage.node_count() == 2
+            assert fresh.storage.edge_count() == 1
+            restored = fresh.storage.get_node(a.id)
+            assert restored.properties["k"] == [1, 2]
+            # embeddings survive the roundtrip
+            assert restored.embedding is not None
+            assert np.allclose(restored.embedding,
+                               db.storage.get_node(a.id).embedding)
+        finally:
+            fresh.close()

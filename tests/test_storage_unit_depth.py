@@ -1,0 +1,374 @@
+"""Per-method storage-engine unit depth, parametrized over BOTH engines.
+
+Behavioral port of the reference's two largest storage suites —
+pkg/storage/memory_test.go (1,407 LoC: per-method subtests for CRUD, label
+index maintenance, cascade semantics, deep-copy isolation incl. named/chunk
+embeddings, bulk ops, degree, concurrency) and pkg/storage/badger_test.go
+(1,408 LoC: the same contract against the durable engine) — re-asserted
+against MemoryEngine and the native C++ SegmentEngine so the Engine contract
+is pinned once and enforced on both backends, the way the reference runs its
+suite per engine.
+"""
+
+import threading
+
+import numpy as np
+import pytest
+
+from nornicdb_tpu.errors import AlreadyExistsError, NotFoundError
+from nornicdb_tpu.storage import MemoryEngine
+from nornicdb_tpu.storage.segment import SegmentEngine
+from nornicdb_tpu.storage.types import Edge, Node
+
+
+@pytest.fixture(params=["memory", "segment"])
+def engine(request, tmp_path):
+    if request.param == "memory":
+        eng = MemoryEngine()
+    else:
+        eng = SegmentEngine(str(tmp_path / "seg"))
+        if getattr(eng, "_kv", None) is None and not hasattr(eng, "_nodes"):
+            pytest.skip("native segstore unavailable")
+    yield eng
+    eng.close()
+
+
+def _mk_nodes(engine, *ids, labels=()):
+    out = []
+    for i in ids:
+        out.append(engine.create_node(Node(id=i, labels=list(labels))))
+    return out
+
+
+# ------------------------------------------------------------- node CRUD
+class TestCreateNode:
+    def test_success_stores_labels_and_properties(self, engine):
+        engine.create_node(Node(id="node-1", labels=["Person", "Employee"],
+                                properties={"name": "Alice", "age": 30}))
+        stored = engine.get_node("node-1")
+        assert stored.labels == ["Person", "Employee"]
+        assert stored.properties == {"name": "Alice", "age": 30}
+
+    def test_duplicate_id_raises(self, engine):
+        engine.create_node(Node(id="node-1"))
+        with pytest.raises(AlreadyExistsError):
+            engine.create_node(Node(id="node-1"))
+
+    def test_deep_copy_prevents_caller_mutation(self, engine):
+        props = {"key": "original"}
+        n = Node(id="node-1", properties=props)
+        engine.create_node(n)
+        props["key"] = "mutated"
+        n.properties["new"] = "value"
+        n.labels.append("Sneaky")
+        stored = engine.get_node("node-1")
+        assert stored.properties.get("key") == "original"
+        assert "new" not in stored.properties
+        assert stored.labels == []
+
+    def test_returned_node_is_isolated(self, engine):
+        created = engine.create_node(Node(id="node-1",
+                                          properties={"k": "v"}))
+        created.properties["k"] = "tampered"
+        assert engine.get_node("node-1").properties["k"] == "v"
+
+
+class TestGetNode:
+    def test_missing_raises_not_found(self, engine):
+        with pytest.raises(NotFoundError):
+            engine.get_node("nonexistent")
+
+    def test_returned_copy_is_isolated(self, engine):
+        engine.create_node(Node(id="node-1", properties={"a": 1}))
+        got = engine.get_node("node-1")
+        got.properties["a"] = 999
+        assert engine.get_node("node-1").properties["a"] == 1
+
+    def test_embedding_copy_is_isolated(self, engine):
+        engine.create_node(Node(
+            id="node-1", embedding=np.asarray([1.0, 2.0], np.float32)))
+        got = engine.get_node("node-1")
+        got.embedding[0] = -5.0
+        assert engine.get_node("node-1").embedding[0] == 1.0
+
+    def test_named_embeddings_copy_is_isolated(self, engine):
+        """ref: TestMemoryEngine_CopyNodeWithNamedEmbeddings"""
+        engine.create_node(Node(
+            id="node-1",
+            named_embeddings={"title": np.asarray([0.5], np.float32)},
+            chunk_embeddings=[np.asarray([1.5], np.float32)]))
+        got = engine.get_node("node-1")
+        got.named_embeddings["title"][0] = 9.0
+        got.chunk_embeddings[0][0] = 9.0
+        fresh = engine.get_node("node-1")
+        assert fresh.named_embeddings["title"][0] == 0.5
+        assert fresh.chunk_embeddings[0][0] == 1.5
+
+
+class TestUpdateNode:
+    def test_missing_raises_not_found(self, engine):
+        with pytest.raises(NotFoundError):
+            engine.update_node(Node(id="nonexistent"))
+
+    def test_preserves_created_at_bumps_updated_at(self, engine):
+        created = engine.create_node(Node(id="node-1"))
+        updated = engine.update_node(Node(id="node-1",
+                                          properties={"v": 2}))
+        assert updated.created_at == created.created_at
+        assert updated.updated_at >= created.updated_at
+        assert engine.get_node("node-1").properties == {"v": 2}
+
+    def test_label_change_reindexes(self, engine):
+        engine.create_node(Node(id="node-1", labels=["Old"]))
+        engine.update_node(Node(id="node-1", labels=["New"]))
+        assert engine.get_nodes_by_label("Old") == []
+        assert [n.id for n in engine.get_nodes_by_label("New")] == ["node-1"]
+
+    def test_replaces_properties_wholesale(self, engine):
+        engine.create_node(Node(id="node-1", properties={"a": 1, "b": 2}))
+        engine.update_node(Node(id="node-1", properties={"a": 10}))
+        assert engine.get_node("node-1").properties == {"a": 10}
+
+
+class TestDeleteNode:
+    def test_missing_raises_not_found(self, engine):
+        with pytest.raises(NotFoundError):
+            engine.delete_node("nonexistent")
+
+    def test_removes_from_label_index(self, engine):
+        engine.create_node(Node(id="node-1", labels=["TestLabel"]))
+        engine.delete_node("node-1")
+        assert engine.get_nodes_by_label("TestLabel") == []
+
+    @pytest.mark.parametrize("victim", ["source", "target"])
+    def test_cascades_edges_both_directions(self, engine, victim):
+        _mk_nodes(engine, "source", "target")
+        engine.create_edge(Edge(id="edge-1", start_node="source",
+                                end_node="target", type="KNOWS"))
+        engine.delete_node(victim)
+        with pytest.raises(NotFoundError):
+            engine.get_edge("edge-1")
+        assert engine.edge_count() == 0
+        survivor = "target" if victim == "source" else "source"
+        assert engine.degree(survivor) == 0
+
+
+# ------------------------------------------------------------- edge CRUD
+class TestCreateEdge:
+    def test_success_and_adjacency(self, engine):
+        _mk_nodes(engine, "a", "b")
+        engine.create_edge(Edge(id="e1", start_node="a", end_node="b",
+                                type="KNOWS", properties={"w": 1.5}))
+        stored = engine.get_edge("e1")
+        assert stored.type == "KNOWS"
+        assert stored.properties == {"w": 1.5}
+        assert [e.id for e in engine.get_outgoing_edges("a")] == ["e1"]
+        assert [e.id for e in engine.get_incoming_edges("b")] == ["e1"]
+
+    def test_missing_endpoints_raise(self, engine):
+        engine.create_node(Node(id="a"))
+        with pytest.raises(NotFoundError):
+            engine.create_edge(Edge(id="e1", start_node="a",
+                                    end_node="ghost", type="T"))
+        with pytest.raises(NotFoundError):
+            engine.create_edge(Edge(id="e2", start_node="ghost",
+                                    end_node="a", type="T"))
+        assert engine.edge_count() == 0
+
+    def test_duplicate_id_raises(self, engine):
+        _mk_nodes(engine, "a", "b")
+        engine.create_edge(Edge(id="e1", start_node="a", end_node="b",
+                                type="T"))
+        with pytest.raises(AlreadyExistsError):
+            engine.create_edge(Edge(id="e1", start_node="a", end_node="b",
+                                    type="T"))
+
+    def test_self_loop_counts_in_and_out(self, engine):
+        engine.create_node(Node(id="a"))
+        engine.create_edge(Edge(id="loop", start_node="a", end_node="a",
+                                type="SELF"))
+        assert [e.id for e in engine.get_outgoing_edges("a")] == ["loop"]
+        assert [e.id for e in engine.get_incoming_edges("a")] == ["loop"]
+
+
+class TestUpdateEdge:
+    def test_missing_raises(self, engine):
+        with pytest.raises(NotFoundError):
+            engine.update_edge(Edge(id="ghost", start_node="a",
+                                    end_node="b", type="T"))
+
+    def test_type_change_reindexes(self, engine):
+        _mk_nodes(engine, "a", "b")
+        engine.create_edge(Edge(id="e1", start_node="a", end_node="b",
+                                type="OLD"))
+        engine.update_edge(Edge(id="e1", start_node="a", end_node="b",
+                                type="NEW"))
+        assert engine.get_edges_by_type("OLD") == []
+        assert [e.id for e in engine.get_edges_by_type("NEW")] == ["e1"]
+
+    def test_preserves_created_at(self, engine):
+        _mk_nodes(engine, "a", "b")
+        created = engine.create_edge(Edge(id="e1", start_node="a",
+                                          end_node="b", type="T"))
+        updated = engine.update_edge(Edge(id="e1", start_node="a",
+                                          end_node="b", type="T",
+                                          properties={"x": 1}))
+        assert updated.created_at == created.created_at
+
+
+class TestDeleteEdge:
+    def test_missing_raises(self, engine):
+        with pytest.raises(NotFoundError):
+            engine.delete_edge("ghost")
+
+    def test_clears_adjacency_and_type_index(self, engine):
+        _mk_nodes(engine, "a", "b")
+        engine.create_edge(Edge(id="e1", start_node="a", end_node="b",
+                                type="T"))
+        engine.delete_edge("e1")
+        assert engine.get_outgoing_edges("a") == []
+        assert engine.get_incoming_edges("b") == []
+        assert engine.get_edges_by_type("T") == []
+        assert engine.degree("a") == 0
+        # endpoints survive
+        assert engine.get_node("a").id == "a"
+
+
+# ----------------------------------------------------- queries and counts
+class TestLabelAndTypeQueries:
+    def test_get_nodes_by_label_multiple(self, engine):
+        _mk_nodes(engine, "p1", "p2", labels=["Person"])
+        _mk_nodes(engine, "c1", labels=["City"])
+        assert sorted(n.id for n in engine.get_nodes_by_label("Person")) == \
+            ["p1", "p2"]
+        assert engine.get_nodes_by_label("Ghost") == []
+        assert engine.count_nodes_by_label("Person") == 2
+        assert engine.count_nodes_by_label("Ghost") == 0
+
+    def test_edges_between_and_by_type(self, engine):
+        _mk_nodes(engine, "a", "b", "c")
+        engine.create_edge(Edge(id="ab", start_node="a", end_node="b",
+                                type="KNOWS"))
+        engine.create_edge(Edge(id="ac", start_node="a", end_node="c",
+                                type="KNOWS"))
+        engine.create_edge(Edge(id="ba", start_node="b", end_node="a",
+                                type="LIKES"))
+        between = [e.id for e in engine.get_outgoing_edges("a")
+                   if e.end_node == "b"]
+        assert between == ["ab"]
+        assert sorted(e.id for e in engine.get_edges_by_type("KNOWS")) == \
+            ["ab", "ac"]
+        assert engine.count_edges_by_type("KNOWS") == 2
+        assert engine.count_edges_by_type("LIKES") == 1
+
+    def test_degree_directions(self, engine):
+        """ref: TestGetInDegree / TestGetOutDegree"""
+        _mk_nodes(engine, "hub", "x", "y", "z")
+        engine.create_edge(Edge(id="e1", start_node="hub", end_node="x",
+                                type="T"))
+        engine.create_edge(Edge(id="e2", start_node="hub", end_node="y",
+                                type="T"))
+        engine.create_edge(Edge(id="e3", start_node="z", end_node="hub",
+                                type="T"))
+        assert engine.degree("hub", "out") == 2
+        assert engine.degree("hub", "in") == 1
+        assert engine.degree("hub") == 3
+        assert engine.degree("x", "in") == 1
+        assert engine.degree("ghost-node", "both") == 0
+
+
+class TestBulkAndCounts:
+    def test_batch_create_nodes_and_counts(self, engine):
+        created = engine.batch_create_nodes(
+            [Node(id=f"n{i}", labels=["Bulk"]) for i in range(25)])
+        assert len(created) == 25
+        assert engine.node_count() == 25
+        assert engine.count_nodes_by_label("Bulk") == 25
+
+    def test_batch_get_preserves_order_skips_missing(self, engine):
+        _mk_nodes(engine, "a", "b", "c")
+        got = engine.batch_get_nodes(["c", "ghost", "a"])
+        assert [n.id for n in got] == ["c", "a"]
+
+    def test_batch_create_edges(self, engine):
+        _mk_nodes(engine, *[f"n{i}" for i in range(5)])
+        edges = [Edge(id=f"e{i}", start_node=f"n{i}",
+                      end_node=f"n{(i + 1) % 5}", type="RING")
+                 for i in range(5)]
+        assert len(engine.batch_create_edges(edges)) == 5
+        assert engine.edge_count() == 5
+
+    def test_all_nodes_snapshot_is_stable_under_mutation(self, engine):
+        _mk_nodes(engine, *[f"n{i}" for i in range(10)])
+        it = engine.all_nodes()
+        engine.delete_node("n0")
+        assert len(list(it)) == 10  # snapshot taken at call time
+
+    def test_all_edges_snapshot_is_stable_under_mutation(self, engine):
+        _mk_nodes(engine, *[f"n{i}" for i in range(6)])
+        for i in range(5):
+            engine.create_edge(Edge(id=f"e{i}", start_node=f"n{i}",
+                                    end_node=f"n{i + 1}", type="R"))
+        it = engine.all_edges()
+        engine.delete_edge("e0")
+        assert len(list(it)) == 5  # snapshot taken at call time
+
+    def test_counts_track_deletes(self, engine):
+        _mk_nodes(engine, "a", "b")
+        engine.create_edge(Edge(id="e1", start_node="a", end_node="b",
+                                type="T"))
+        assert (engine.node_count(), engine.edge_count()) == (2, 1)
+        engine.delete_edge("e1")
+        engine.delete_node("a")
+        assert (engine.node_count(), engine.edge_count()) == (1, 0)
+
+
+# ----------------------------------------------------------- concurrency
+class TestConcurrency:
+    def test_parallel_creates_all_land(self, engine):
+        """ref: TestMemoryEngine_Concurrency — N writers, no lost writes."""
+        errs = []
+
+        def writer(base):
+            try:
+                for i in range(20):
+                    engine.create_node(Node(id=f"w{base}-n{i}"))
+            except Exception as e:  # noqa: BLE001
+                errs.append(e)
+
+        threads = [threading.Thread(target=writer, args=(t,))
+                   for t in range(8)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert not errs
+        assert engine.node_count() == 160
+
+    def test_parallel_read_write_mix(self, engine):
+        _mk_nodes(engine, *[f"seed{i}" for i in range(10)])
+        stop = threading.Event()
+        errs = []
+
+        def reader():
+            while not stop.is_set():
+                try:
+                    for n in engine.all_nodes():
+                        _ = n.id
+                    engine.node_count()
+                except Exception as e:  # noqa: BLE001
+                    errs.append(e)
+                    return
+
+        r = threading.Thread(target=reader)
+        r.start()
+        try:
+            for i in range(50):
+                engine.create_node(Node(id=f"rw{i}", labels=["RW"]))
+                if i % 5 == 0:
+                    engine.delete_node(f"rw{i}")
+        finally:
+            stop.set()
+            r.join()
+        assert not errs
+        assert engine.count_nodes_by_label("RW") == 40
