@@ -80,7 +80,7 @@ def evaluate_checkpoint(model_dir, docs, queries, relevant_ids, k=10):
 
 
 def run(workdir, steps_teacher=500, steps_distill=400, quick=False,
-        seed=0):
+        seed=0, lr_teacher=0.0):
     from nornicdb_tpu.models import pretrain
 
     rng = np.random.default_rng(seed + 1)
@@ -98,12 +98,15 @@ def run(workdir, steps_teacher=500, steps_distill=400, quick=False,
         queries, relevant = queries[:24], relevant[:24]
 
     t_layers, t_hidden = (4, 64) if quick else (8, 128)
+    # deeper teachers diverge at the shallow default lr (measured: 8L/128h
+    # at 1e-3 went 2.52 -> 3.34 over 600 steps); scale down with depth
+    lr = lr_teacher or (1e-3 if quick else 3e-4)
     teacher_dir = os.path.join(workdir, "teacher")
     t0 = time.perf_counter()
     t_stats = pretrain.train_encoder(
         teacher_dir, steps=steps_teacher, batch=32, hidden=t_hidden,
         layers=t_layers, dims=64 if not quick else 32, seed=seed,
-        corpus=texts)
+        corpus=texts, lr=lr)
     print(f"teacher {t_layers}L/{t_hidden}h trained in "
           f"{time.perf_counter() - t0:.0f}s loss "
           f"{t_stats['loss_first']:.3f}->{t_stats['loss_last']:.3f}",

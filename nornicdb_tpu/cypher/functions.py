@@ -561,6 +561,99 @@ def fn_vec_euclidean(a, b):
     return float(1.0 / (1.0 + np.sum((va - vb) ** 2)))
 
 
+# -------------------------------------------------------------- spatial fns
+# (ref: functions_eval_math.go:716-930 — point maps with x/y[/z] cartesian
+# or latitude/longitude WGS84 coordinates; distance picks euclidean vs
+# haversine by coordinate kind; accessors return None off-kind)
+_EARTH_RADIUS_M = 6_371_000.0
+
+
+def _coord(m, *names):
+    if not isinstance(m, dict):
+        return None
+    out = []
+    for n in names:
+        v = m.get(n)
+        if not isinstance(v, (int, float)) or isinstance(v, bool):
+            return None
+        out.append(float(v))
+    return out
+
+
+@register("point")
+def fn_point(m):
+    if m is None:
+        return None
+    if not isinstance(m, dict):
+        raise CypherTypeError("point() expects a map of coordinates")
+    if _coord(m, "x", "y") is None and _coord(
+            m, "latitude", "longitude") is None:
+        raise CypherTypeError(
+            "point() needs x/y or latitude/longitude coordinates")
+    return dict(m)
+
+
+@register("distance")
+@register("point.distance")
+def fn_distance(p1, p2):
+    if _null_in(p1, p2):
+        return None
+    xy1, xy2 = _coord(p1, "x", "y"), _coord(p2, "x", "y")
+    if xy1 is not None and xy2 is not None:
+        dz = 0.0
+        z1, z2 = _coord(p1, "z"), _coord(p2, "z")
+        if z1 is not None and z2 is not None:
+            dz = z1[0] - z2[0]
+        return math.sqrt((xy1[0] - xy2[0]) ** 2
+                         + (xy1[1] - xy2[1]) ** 2 + dz * dz)
+    ll1 = _coord(p1, "latitude", "longitude")
+    ll2 = _coord(p2, "latitude", "longitude")
+    if ll1 is not None and ll2 is not None:
+        lat1, lon1, lat2, lon2 = map(math.radians,
+                                     (ll1[0], ll1[1], ll2[0], ll2[1]))
+        a = (math.sin((lat2 - lat1) / 2) ** 2
+             + math.cos(lat1) * math.cos(lat2)
+             * math.sin((lon2 - lon1) / 2) ** 2)
+        return _EARTH_RADIUS_M * 2 * math.asin(min(math.sqrt(a), 1.0))
+    return None
+
+
+@register("withinbbox")
+@register("point.withinbbox")
+def fn_within_bbox(p, lower_left, upper_right):
+    coords = [_coord(m, "x", "y") for m in (p, lower_left, upper_right)]
+    if all(c is not None for c in coords):
+        (px, py), (llx, lly), (urx, ury) = coords
+        return llx <= px <= urx and lly <= py <= ury
+    coords = [_coord(m, "latitude", "longitude")
+              for m in (p, lower_left, upper_right)]
+    if all(c is not None for c in coords):
+        (plat, plon), (lllat, lllon), (urlat, urlon) = coords
+        return lllat <= plat <= urlat and lllon <= plon <= urlon
+    return False
+
+
+def _point_accessor(key):
+    def fn(p):
+        c = _coord(p, key)
+        return c[0] if c is not None else None
+
+    return fn
+
+
+for _key in ("x", "y", "z", "latitude", "longitude"):
+    register(f"point.{_key}")(_point_accessor(_key))
+
+
+@register("point.srid")
+def fn_point_srid(p):
+    if not isinstance(p, dict):
+        return None
+    if "srid" in p:
+        return p["srid"]
+    return 4326 if "latitude" in p else 7203  # WGS84 vs cartesian 2D
+
+
 AGGREGATES = {"count", "sum", "avg", "min", "max", "collect", "stdev",
               "stdevp", "percentilecont", "percentiledisc"}
 

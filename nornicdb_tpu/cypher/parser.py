@@ -1104,10 +1104,27 @@ class Parser:
             if t.value == "SHORTESTPATH" or t.value == "ALLSHORTESTPATHS":
                 pp = self.parse_pattern_path()
                 return ast.PatternPredicate(pp)
-            # keyword used as function name / identifier
-            if self.peek().kind == "OP" and self.peek().value == "(":
-                name = self.advance().value.lower()
-                return self.parse_function_call(name)
+            # keyword used as function name / identifier — including dotted
+            # namespaces whose head lexes as a keyword (point.x(...),
+            # vector.similarity.cosine(...)), same lookahead as the IDENT
+            # branch below
+            if self.peek().kind == "OP" and self.peek().value in ("(", "."):
+                save = self.pos
+                name = self.advance().value
+                dotted = name
+                while self.at_op(".") and self.peek().kind in (
+                        "IDENT", "KEYWORD"):
+                    self.advance()
+                    dotted += "." + self.advance().value
+                    if self.at_op("("):
+                        break
+                    if not self.at_op("."):
+                        self.pos = save
+                        dotted = None
+                        break
+                if dotted and self.at_op("("):
+                    return self.parse_function_call(dotted.lower())
+                self.pos = save
             self.advance()
             return ast.Variable(t.value.lower())
         if t.kind == "IDENT":

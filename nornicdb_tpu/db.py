@@ -361,12 +361,13 @@ class DB:
         from nornicdb_tpu.cypher.executor import CypherExecutor
 
         if database and self.database_manager.resolve(database) != self.default_database:
-            storage = self.database_manager.get_storage(database)
-            from nornicdb_tpu.storage import SchemaManager
-
-            schema = SchemaManager()
-            schema.attach(storage)
-            return CypherExecutor(storage, schema=schema, db=self,
+            # share the database's CACHED schema (executor_for builds and
+            # attaches it once): a fresh SchemaManager per session would
+            # forget indexes/constraints created by earlier requests and
+            # leak a permanent on_event subscription + full-store scan
+            # per session
+            base = self.executor_for(database)
+            return CypherExecutor(base.storage, schema=base.schema, db=self,
                                   log_queries=self.config.log_queries)
         cache = self.query_cache if self.config.query_cache_enabled else None
         return CypherExecutor(self.storage, schema=self.schema, db=self,

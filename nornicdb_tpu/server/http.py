@@ -1122,19 +1122,56 @@ class HttpServer:
         h._send(405, {"error": f"{method} not allowed on {path}"})
 
     def _tx_commit(self, h, database: str, body: dict) -> None:
-        """Neo4j HTTP transaction API (ref: server_db.go)."""
+        """Neo4j HTTP transaction API (ref: server_db.go).
+
+        The whole statement batch is ONE implicit transaction (Neo4j
+        semantics): a failing statement rolls back every earlier statement's
+        writes. A fresh session executor scopes the tx to this request —
+        sharing the facade executor would entangle tx frames across handler
+        threads."""
         out_results = []
         errors = []
+        ex = self.db.session_executor(database)
+        ex.execute("BEGIN", {})
+        finished = False
+        try:
+            self._tx_run_statements(ex, body, out_results, errors)
+            finished = True
+        finally:
+            if not finished:
+                # an unexpected exception escaped the statement loop (e.g.
+                # a non-dict statements entry): the tx must not be left
+                # half-applied with its undo log garbage-collected
+                try:
+                    ex.execute("ROLLBACK", {})
+                except Exception:
+                    pass
+        try:
+            ex.execute("ROLLBACK" if errors else "COMMIT", {})
+        except Exception as e:  # a failed commit voids the batch's results
+            errors.append({
+                "code": "Neo.DatabaseError.Transaction.TransactionCommitFailed",
+                "message": str(e),
+            })
+            out_results = []
+        h._send(200, {"results": out_results, "errors": errors})
+
+    def _tx_run_statements(self, ex, body: dict, out_results: list,
+                           errors: list) -> None:
         for stmt in body.get("statements", []):
+            if not isinstance(stmt, dict):
+                errors.append({
+                    "code": "Neo.ClientError.Request.InvalidFormat",
+                    "message": "each statements entry must be an object",
+                })
+                return
             query = stmt.get("statement", "")
             params = stmt.get("parameters", {})
-            # each /tx/commit request is its own implicit transaction
-            # (Neo4j semantics); explicit tx control here would open a
-            # frame on one handler thread that no later request — served
-            # by a different thread — could ever commit or roll back.
-            # Gate on the parsed AST, not string prefixes: "BEGIN;",
-            # "/* c */ BEGIN" etc. must not slip through (parse() is
-            # memoized, so the executor's own parse stays a cache hit).
+            # User-issued tx control is still rejected: the batch already
+            # runs in a transaction, and a client COMMIT would detach the
+            # rollback-on-error contract. Gate on the parsed AST, not
+            # string prefixes ("BEGIN;", "/* c */ BEGIN" must not slip
+            # through; parse() is memoized so this stays a cache hit).
             try:
                 if isinstance(cypher_parse(query), cypher_ast.TxCommand):
                     errors.append({
@@ -1142,18 +1179,17 @@ class HttpServer:
                         "message": "explicit transaction control is not "
                                    "available on the stateless tx endpoint",
                     })
-                    break
+                    return
             except Exception:
                 pass  # unparseable: fall through, execute() reports it
             t0 = time.time()
             try:
-                ex = self.db.executor_for(database)
                 result = ex.execute(query, params)
             except Exception as e:
                 errors.append(
                     {"code": "Neo.ClientError.Statement.SyntaxError", "message": str(e)}
                 )
-                break
+                return
             if time.time() - t0 > self.slow_threshold:
                 self.slow_queries += 1
             out_results.append(
@@ -1166,7 +1202,6 @@ class HttpServer:
                     "stats": result.stats.as_dict(),
                 }
             )
-        h._send(200, {"results": out_results, "errors": errors})
 
     # -- MCP (ref: pkg/mcp/tools.go:63-332 — 6 tools) -----------------------------
     MCP_TOOLS = [

@@ -422,7 +422,10 @@ class SegmentEngine(Engine):
             snapshot = [raw for raw in
                         (self._kv.get(k) for k in self._kv.keys(b"n:"))
                         if raw is not None]
-        return iter([Node.from_dict(json.loads(r)) for r in snapshot])
+        # decode lazily: consumers that stop early (LIMIT 1 scans) must not
+        # pay a full-store JSON parse; the raw snapshot above already gives
+        # the call-time view
+        return (Node.from_dict(json.loads(r)) for r in snapshot)
 
     # -- edges -----------------------------------------------------------------
     def create_edge(self, edge: Edge) -> Edge:
@@ -510,7 +513,7 @@ class SegmentEngine(Engine):
             snapshot = [raw for raw in
                         (self._kv.get(k) for k in self._kv.keys(b"e:"))
                         if raw is not None]
-        return iter([Edge.from_dict(json.loads(r)) for r in snapshot])
+        return (Edge.from_dict(json.loads(r)) for r in snapshot)
 
     # -- counts / pending ---------------------------------------------------------
     def node_count(self) -> int:

@@ -1,0 +1,238 @@
+"""Decay manager unit depth (ref: pkg/decay/decay_test.go +
+kalman_adapter_test.go — per-tier half-life formula exactness, score
+composition, archive boundary, reinforce/resurrect, stats accounting,
+scheduler lifecycle, concurrency, Kalman smoothing on/off)."""
+
+import math
+import threading
+
+import pytest
+
+from nornicdb_tpu.decay.decay import (
+    ARCHIVED_LABEL,
+    DAY,
+    HALF_LIVES,
+    DecayConfig,
+    DecayManager,
+    half_life,
+)
+from nornicdb_tpu.storage import MemoryEngine
+from nornicdb_tpu.storage.types import EPISODIC, PROCEDURAL, SEMANTIC, Node
+
+T0 = 1_000_000_000.0
+
+
+def _mgr(config=None, now=T0):
+    state = {"now": now}
+    m = DecayManager(MemoryEngine(), config=config,
+                     now_fn=lambda: state["now"])
+    return m, state
+
+
+def _node(engine, nid, mtype=SEMANTIC, accessed=T0, count=0, **props):
+    n = Node(id=nid, memory_type=mtype, properties=props)
+    n.last_accessed = accessed
+    n.access_count = count
+    return engine.create_node(n)
+
+
+class TestHalfLife:
+    """ref: TestHalfLife / TestTierLambdaValues"""
+
+    def test_tier_values(self):
+        assert half_life(EPISODIC) == 7 * DAY
+        assert half_life(SEMANTIC) == 69 * DAY
+        assert half_life(PROCEDURAL) == 693 * DAY
+
+    def test_unknown_tier_falls_back_to_semantic(self):
+        assert half_life("no-such-tier") == HALF_LIVES[SEMANTIC]
+
+    def test_ordering_episodic_fastest(self):
+        assert half_life(EPISODIC) < half_life(SEMANTIC) < \
+            half_life(PROCEDURAL)
+
+
+class TestDecayFormula:
+    """ref: TestDecayFormula / TestManager_CalculateScore"""
+
+    def test_fresh_max_importance_scores_near_one(self):
+        m, st = _mgr()
+        n = Node(id="n", memory_type=SEMANTIC,
+                 properties={"importance": 1.0})
+        n.last_accessed = T0
+        n.access_count = 100
+        assert m.calculate_score(n, now=T0) == pytest.approx(1.0, abs=1e-6)
+
+    def test_recency_component_halves_at_half_life(self):
+        cfg = DecayConfig(recency_weight=1.0, frequency_weight=0.0,
+                          importance_weight=0.0)
+        m, _ = _mgr(cfg)
+        n = Node(id="n", memory_type=EPISODIC)
+        n.last_accessed = T0
+        n.access_count = 0
+        assert m.calculate_score(n, now=T0) == pytest.approx(1.0, abs=1e-9)
+        assert m.calculate_score(n, now=T0 + 7 * DAY) == \
+            pytest.approx(0.5, abs=1e-9)
+        assert m.calculate_score(n, now=T0 + 14 * DAY) == \
+            pytest.approx(0.25, abs=1e-9)
+
+    def test_frequency_saturates_at_ten_accesses(self):
+        cfg = DecayConfig(recency_weight=0.0, frequency_weight=1.0,
+                          importance_weight=0.0)
+        m, _ = _mgr(cfg)
+        n = Node(id="n")
+        n.last_accessed = T0
+        n.access_count = 10
+        assert m.calculate_score(n, now=T0) == pytest.approx(1.0, abs=1e-9)
+        n.access_count = 1000
+        assert m.calculate_score(n, now=T0) == 1.0
+        n.access_count = 0
+        assert m.calculate_score(n, now=T0) == 0.0
+
+    def test_importance_clamped_to_unit_interval(self):
+        cfg = DecayConfig(recency_weight=0.0, frequency_weight=0.0,
+                          importance_weight=1.0)
+        m, _ = _mgr(cfg)
+        for raw, expect in ((2.5, 1.0), (-1.0, 0.0), (0.3, 0.3)):
+            n = Node(id="n", properties={"importance": raw})
+            n.last_accessed = T0
+            assert m.calculate_score(n, now=T0) == pytest.approx(expect)
+
+    def test_future_last_accessed_does_not_exceed_one(self):
+        m, _ = _mgr()
+        n = Node(id="n", properties={"importance": 1.0})
+        n.last_accessed = T0 + 999.0  # clock skew
+        n.access_count = 50
+        assert m.calculate_score(n, now=T0) <= 1.0
+
+    def test_rate_modifier_halves_decay_speed(self):
+        cfg = DecayConfig(recency_weight=1.0, frequency_weight=0.0,
+                          importance_weight=0.0)
+        m, _ = _mgr(cfg)
+        m.rate_modifier = lambda nid: 0.5  # memories live twice as long
+        n = Node(id="n", memory_type=EPISODIC)
+        n.last_accessed = T0
+        assert m.calculate_score(n, now=T0 + 14 * DAY) == \
+            pytest.approx(0.5, abs=1e-9)
+
+    def test_kalman_smoothing_damps_step_change(self):
+        """ref: TestKalmanAdapter_CalculateScore_Smoothing — with smoothing
+        on, a sudden score drop moves gradually."""
+        cfg = DecayConfig(recency_weight=1.0, frequency_weight=0.0,
+                          importance_weight=0.0, kalman_smoothing=True)
+        m, _ = _mgr(cfg)
+        n = Node(id="n", memory_type=EPISODIC)
+        n.last_accessed = T0
+        first = m.calculate_score(n, now=T0)
+        # raw would be 0.5; the filter keeps it closer to the prior 1.0
+        smoothed = m.calculate_score(n, now=T0 + 7 * DAY)
+        assert 0.5 < smoothed < first
+
+
+class TestReinforceAndArchive:
+    def test_reinforce_bumps_and_caps(self):
+        """ref: TestManager_Reinforce"""
+        m, _ = _mgr()
+        n = _node(m.storage, "n")
+        n.decay_score = 0.95
+        m.storage.update_node(n)
+        assert m.reinforce("n") == 1.0  # capped
+        stored = m.storage.get_node("n")
+        assert stored.access_count == 1
+        assert m.stats.reinforced == 1
+
+    def test_reinforce_resurrects_archived(self, ):
+        m, _ = _mgr()
+        n = _node(m.storage, "n")
+        n.labels.append(ARCHIVED_LABEL)
+        m.storage.update_node(n)
+        m.reinforce("n")
+        assert ARCHIVED_LABEL not in m.storage.get_node("n").labels
+
+    def test_recalculate_archives_below_threshold(self):
+        """ref: TestManager_ShouldArchive — stale episodic memory crosses
+        the archive threshold, fresh one does not."""
+        m, st = _mgr()
+        _node(m.storage, "stale", mtype=EPISODIC, accessed=T0 - 300 * DAY,
+              importance=0.0)
+        _node(m.storage, "fresh", mtype=EPISODIC, accessed=T0,
+              importance=0.9, count=5)
+        scored, archived = m.recalculate_all()
+        assert scored == 2
+        assert archived == 1
+        archived_ids = [n.id for n in m.archived_nodes()]
+        assert archived_ids == ["stale"]
+        assert m.storage.get_node("stale").decay_score < \
+            m.config.archive_threshold
+
+    def test_recalculate_is_idempotent_on_archived(self):
+        m, _ = _mgr()
+        _node(m.storage, "stale", mtype=EPISODIC, accessed=T0 - 300 * DAY,
+              importance=0.0)
+        m.recalculate_all()
+        scored, archived = m.recalculate_all()
+        assert archived == 0  # already archived, not double counted
+        assert m.storage.get_node("stale").labels.count(ARCHIVED_LABEL) == 1
+
+    def test_stats_accumulate(self):
+        """ref: TestManager_GetStats"""
+        m, _ = _mgr()
+        for i in range(3):
+            _node(m.storage, f"n{i}")
+        m.recalculate_all()
+        m.recalculate_all()
+        assert m.stats.recalculations == 2
+        assert m.stats.nodes_scored == 6
+
+
+class TestLifecycle:
+    def test_start_stop_scheduler(self):
+        """ref: TestManager_StartStop — ticks run on the interval and stop
+        cancels cleanly."""
+        m, _ = _mgr(DecayConfig(interval=0.05))
+        _node(m.storage, "n")
+        m.start()
+        try:
+            import time as _t
+
+            deadline = _t.monotonic() + 5.0
+            while m.stats.recalculations < 2 and _t.monotonic() < deadline:
+                _t.sleep(0.02)
+            assert m.stats.recalculations >= 2
+        finally:
+            m.stop()
+        after = m.stats.recalculations
+        import time as _t
+
+        _t.sleep(0.15)
+        assert m.stats.recalculations == after  # no ticks after stop
+
+    def test_concurrent_reinforce_and_recalculate(self):
+        """ref: TestManager_Concurrency"""
+        m, _ = _mgr()
+        for i in range(20):
+            _node(m.storage, f"n{i}")
+        errs = []
+
+        def reinforcer():
+            try:
+                for i in range(50):
+                    m.reinforce(f"n{i % 20}")
+            except Exception as e:  # noqa: BLE001
+                errs.append(e)
+
+        def recalcer():
+            try:
+                for _ in range(10):
+                    m.recalculate_all()
+            except Exception as e:  # noqa: BLE001
+                errs.append(e)
+
+        threads = [threading.Thread(target=reinforcer) for _ in range(3)] + \
+            [threading.Thread(target=recalcer)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert not errs
+        assert m.stats.reinforced == 150

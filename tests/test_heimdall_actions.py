@@ -55,7 +55,7 @@ class TestActionCorpus:
             for tpl in templates:
                 stem = tpl.split("{l}")[0].strip()
                 assert stem in train, stem
-        for label in pretrain._LABELS:
+        for label in pretrain._ACTION_LABELS:
             assert label in train
 
     def test_action_json_roundtrips_tokenizer(self):
