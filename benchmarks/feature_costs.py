@@ -1,0 +1,121 @@
+"""Per-feature cost breakdown of the round-4 protocol regressions.
+
+VERDICT r04 weak #5: the Bolt/HTTP throughput drop vs round 1 has known,
+deliberate causes — HTTP-batch atomicity (undo frames), per-statement RBAC
+classification, and cached-result copy isolation — but their individual
+costs were never measured, so the regression read as drift. This bench
+isolates each feature's per-query cost on the SAME workload, CPU-pinned
+(protocol stack cost is backend-independent):
+
+  copy_isolation  — cache-hit serve with _copy_result vs returning the
+                    cached object raw (the pre-round-4 unsound behavior)
+  rbac_classify   — classify_query_text per statement (the Bolt RUN gate)
+  tx_atomicity    — the same statement executed inside BEGIN/COMMIT undo
+                    framing vs autocommit
+
+Prints a markdown table + one JSON line. Run:
+  python benchmarks/feature_costs.py
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def _best(fn, reps=5, inner=200):
+    fn()  # warm
+    best = float("inf")
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        for _ in range(inner):
+            fn()
+        best = min(best, (time.perf_counter() - t0) / inner)
+    return best * 1e6  # us/op
+
+
+def main():
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    import nornicdb_tpu
+    from nornicdb_tpu.cypher import executor as ex_mod
+    from nornicdb_tpu.cypher.executor import classify_query_text
+
+    db = nornicdb_tpu.open_db("")
+    for i in range(200):
+        db.cypher(f"CREATE (:Doc {{idx: {i}, body: 'text {i}', "
+                  f"tags: ['a', 'b']}})")
+
+    rows = {}
+
+    # -- copy isolation on cache hits -----------------------------------
+    q = "MATCH (n:Doc) WHERE n.idx < 50 RETURN n.idx, n.tags"
+    db.cypher(q)  # populate cache
+    cache = db.query_cache
+    hit = cache.get(q, {})
+    assert hit is not None
+    with_copy = _best(lambda: ex_mod._copy_result(hit))
+    raw = _best(lambda: hit)
+    rows["copy_isolation"] = (with_copy - raw, "per cached-result serve "
+                              "(50 rows x 2 cols, one list col)")
+
+    # -- RBAC statement classification -----------------------------------
+    write_q = "CREATE (n:X) SET n.v = 1"
+    rows["rbac_classify_memo"] = (
+        (_best(lambda: classify_query_text(q))
+         + _best(lambda: classify_query_text(write_q))) / 2,
+        "repeated statement text (memo hit — the steady-state cost)")
+    # unique texts pay the full parse: the honest cost for workloads with
+    # inline literals (every statement text distinct)
+    counter = iter(range(10_000_000))
+
+    def classify_unique():
+        classify_query_text(f"MATCH (n:Doc) WHERE n.idx = {next(counter)} "
+                            "RETURN n")
+
+    rows["rbac_classify_cold"] = (
+        _best(classify_unique, inner=100),
+        "unique statement text (full parse per classify)")
+
+    # -- tx atomicity (undo framing) -------------------------------------
+    ex = db.session_executor()
+    probe = "CREATE (n:TxCost {v: 1})"
+
+    def autocommit():
+        db.cypher(probe)
+
+    def framed():
+        ex.execute("BEGIN", {})
+        ex.execute(probe, {})
+        ex.execute("COMMIT", {})
+
+    auto_us = _best(autocommit, inner=50)
+    framed_us = _best(framed, inner=50)
+    rows["tx_atomicity"] = (framed_us - auto_us,
+                            "BEGIN+COMMIT undo framing around one CREATE")
+
+    # -- baseline query costs for scale ----------------------------------
+    read_us = _best(lambda: db.cypher(q), inner=50)
+    rows["_read_query_total"] = (read_us, "full cached read query, "
+                                 "for scale")
+
+    print("| feature | cost (us/op) | note |")
+    print("|---|---|---|")
+    for name, (us, note) in rows.items():
+        print(f"| {name} | {us:.1f} | {note} |")
+    print(json.dumps({
+        "metric": "feature_costs_us",
+        "value": round(rows["copy_isolation"][0], 2),
+        "unit": "us/op (copy_isolation headline)",
+        "detail": {k: round(v[0], 2) for k, v in rows.items()},
+    }))
+    db.close()
+
+
+if __name__ == "__main__":
+    main()

@@ -294,15 +294,45 @@ def cmd_decay(args) -> int:
     return 0
 
 
+def cmd_dataset(args) -> int:
+    """(ref: neural/scripts dataset tooling)"""
+    from itertools import chain
+
+    from nornicdb_tpu.models import dataset
+
+    if args.action == "validate":
+        report = dataset.validate_jsonl(args.file)
+        print(json.dumps(report, indent=2))
+        return 0 if report["invalid"] == 0 else 1
+    gens = []
+    if args.kind in ("cypher", "all"):
+        gens.append(dataset.generate_cypher_examples(
+            args.count if args.kind == "cypher"
+            else args.count - args.count // 2,  # odd counts stay exact
+            seed=args.seed))
+    if args.kind in ("heimdall", "all"):
+        gens.append(dataset.generate_heimdall_examples(
+            args.count if args.kind == "heimdall" else args.count // 2,
+            seed=args.seed))
+    n = dataset.write_jsonl(args.file, chain(*gens))
+    print(f"wrote {n} examples to {args.file}")
+    return 0
+
+
 def cmd_train(args) -> int:
     """(replaces the reference's offline neural/train.py pipeline with
     first-class in-image training; see models/pretrain.py)"""
     from nornicdb_tpu.models import pretrain
 
     if args.model == "assistant":
+        # facts + ACTION-MODE corpus: the served assistant must emit
+        # machine-parseable query/status actions (measured held-out rates
+        # in tests/test_heimdall_actions.py)
+        corpus = (pretrain.synth_corpus(0, repeats=6)
+                  + pretrain.synth_action_corpus(0, repeats=6))
         stats = pretrain.train_assistant(
-            args.out, steps=args.steps or 700, batch=24, seq_len=64,
-            hidden=128, lr=2e-3,
+            args.out, steps=args.steps or 1400, batch=24, seq_len=64,
+            hidden=128, lr=2e-3, corpus=corpus,
         )
     else:
         stats = pretrain.train_encoder(args.out, steps=args.steps or 250)
@@ -455,6 +485,20 @@ def main(argv=None) -> int:
                    help="NornicDB data directory (if set, imports directly)")
     s.add_argument("--seed", type=int, default=42)
     s.set_defaults(fn=cmd_kmeans_test_data)
+
+    s = sub.add_parser(
+        "dataset",
+        help="generate / validate instruction-tuning datasets "
+             "(ref: neural/scripts/generate_*_dataset.py, "
+             "validate_dataset.py)",
+    )
+    s.add_argument("action", choices=["generate", "validate"])
+    s.add_argument("file", help="JSONL path")
+    s.add_argument("--kind", choices=["cypher", "heimdall", "all"],
+                   default="all")
+    s.add_argument("--count", type=int, default=1000)
+    s.add_argument("--seed", type=int, default=42)
+    s.set_defaults(fn=cmd_dataset)
 
     s = sub.add_parser(
         "oauth-provider",

@@ -1,0 +1,181 @@
+"""Offline training-dataset tooling (ref: neural/scripts/
+generate_cypher_dataset.py + generate_heimdall_dataset.py +
+validate_dataset.py — instruction-tuning JSONL with {"instruction",
+"input", "output"} rows).
+
+Differences from the reference, by design: generation reuses the in-image
+action corpus (pretrain._ACTION_INTENTS) plus an enumerated Cypher pattern
+matrix, and validation runs every emitted query through the REAL Cypher
+parser (`cypher.parser.parse`) — the reference validates with regexes; a
+parser round-trip catches malformed outputs those miss."""
+
+from __future__ import annotations
+
+import json
+import random
+from typing import Iterator
+
+INSTRUCTION_NL2CYPHER = "Convert this natural language query to Cypher"
+INSTRUCTION_ACTION = ("Respond with a JSON action command for this "
+                      "database request")
+
+_LABELS = ["Person", "User", "Employee", "Customer", "Product", "Order",
+           "Company", "Project", "Task", "Document", "Event", "Topic"]
+_PROPS = {
+    "Person": [("name", "string"), ("age", "integer"), ("city", "string")],
+    "User": [("username", "string"), ("status", "string")],
+    "Employee": [("department", "string"), ("salary", "integer")],
+    "Product": [("price", "float"), ("category", "string")],
+    "Order": [("total", "float"), ("status", "string")],
+}
+_REL_TYPES = ["KNOWS", "WORKS_AT", "OWNS", "RELATED_TO", "REPORTS_TO"]
+
+# (natural-language template, cypher template)
+_MATCH_TEMPLATES = [
+    ("Find all {label} nodes", "MATCH (n:{label}) RETURN n"),
+    ("Show me every {label}", "MATCH (n:{label}) RETURN n LIMIT 25"),
+    ("How many {label} nodes are there?",
+     "MATCH (n:{label}) RETURN count(n)"),
+    ("List the labels in the graph", "CALL db.labels()"),
+]
+_PROP_TEMPLATES = [
+    ("Find {label} nodes where {prop} is {value}",
+     "MATCH (n:{label}) WHERE n.{prop} = {value} RETURN n"),
+    ("Which {label} nodes have a {prop} greater than {value}?",
+     "MATCH (n:{label}) WHERE n.{prop} > {value} RETURN n"),
+    ("Get the {prop} of every {label}",
+     "MATCH (n:{label}) RETURN n.{prop}"),
+]
+_REL_TEMPLATES = [
+    ("What is connected to {label} nodes?",
+     "MATCH (n:{label})-[r]-(m) RETURN m LIMIT 25"),
+    ("Find pairs linked by {rel}",
+     "MATCH (a)-[r:{rel}]->(b) RETURN a, b"),
+    ("Count {rel} relationships",
+     "MATCH ()-[r:{rel}]->() RETURN count(r)"),
+]
+_AGG_TEMPLATES = [
+    ("What is the average {prop} of {label} nodes?",
+     "MATCH (n:{label}) RETURN avg(n.{prop})"),
+    ("Group {label} nodes by {prop} and count them",
+     "MATCH (n:{label}) RETURN n.{prop}, count(n) ORDER BY count(n) DESC"),
+]
+
+
+def _value_for(kind: str, rng: random.Random) -> str:
+    if kind == "integer":
+        return str(rng.randint(1, 90))
+    if kind == "float":
+        return f"{rng.uniform(1, 500):.2f}"
+    return f"'{rng.choice(['alpha', 'beta', 'gamma', 'oslo', 'active'])}'"
+
+
+def generate_cypher_examples(count: int, seed: int = 42) -> Iterator[dict]:
+    """NL -> Cypher instruction rows (ref: generate_cypher_dataset.py)."""
+    rng = random.Random(seed)
+    emitted = 0
+    while emitted < count:
+        family = rng.randrange(4)
+        label = rng.choice(_LABELS)
+        if family == 0:
+            nl, cy = rng.choice(_MATCH_TEMPLATES)
+            row = {"input": nl.format(label=label),
+                   "output": cy.format(label=label)}
+        elif family == 1:
+            label = rng.choice(list(_PROPS))
+            prop, kind = rng.choice(_PROPS[label])
+            nl, cy = rng.choice(_PROP_TEMPLATES)
+            v = _value_for(kind, rng)
+            row = {"input": nl.format(label=label, prop=prop, value=v),
+                   "output": cy.format(label=label, prop=prop, value=v)}
+        elif family == 2:
+            nl, cy = rng.choice(_REL_TEMPLATES)
+            rel = rng.choice(_REL_TYPES)
+            row = {"input": nl.format(label=label, rel=rel),
+                   "output": cy.format(label=label, rel=rel)}
+        else:
+            label = rng.choice(list(_PROPS))
+            prop, _ = rng.choice(_PROPS[label])
+            nl, cy = rng.choice(_AGG_TEMPLATES)
+            row = {"input": nl.format(label=label, prop=prop),
+                   "output": cy.format(label=label, prop=prop)}
+        yield {"instruction": INSTRUCTION_NL2CYPHER, **row}
+        emitted += 1
+
+
+def generate_heimdall_examples(count: int, seed: int = 42) -> Iterator[dict]:
+    """Chat-prompt -> action-JSON rows from the in-image ACTION MODE domain
+    (ref: generate_heimdall_dataset.py)."""
+    from nornicdb_tpu.models import pretrain
+
+    rng = random.Random(seed)
+    pairs = pretrain._action_pairs()
+    emitted = 0
+    while emitted < count:
+        intent, ti, li, prompt, cypher = pairs[rng.randrange(len(pairs))]
+        if cypher is None:
+            action = {"action": "status", "params": {}}
+        else:
+            action = {"action": "query", "params": {"cypher": cypher}}
+        yield {"instruction": INSTRUCTION_ACTION, "input": prompt,
+               "output": json.dumps(action)}
+        emitted += 1
+
+
+def write_jsonl(path: str, rows: Iterator[dict]) -> int:
+    n = 0
+    with open(path, "w") as f:
+        for row in rows:
+            f.write(json.dumps(row) + "\n")
+            n += 1
+    return n
+
+
+def validate_jsonl(path: str, max_errors: int = 20) -> dict:
+    """Validate a dataset file: JSONL shape + every Cypher output parses
+    through the REAL parser; action outputs must be valid JSON with a
+    known action (ref: validate_dataset.py, upgraded from regexes)."""
+    from nornicdb_tpu.cypher.parser import parse as cypher_parse
+
+    total = valid = 0
+    errors: list[dict] = []
+
+    def err(line_no, reason):
+        if len(errors) < max_errors:
+            errors.append({"line": line_no, "reason": reason})
+
+    with open(path) as f:
+        for line_no, line in enumerate(f, 1):
+            line = line.strip()
+            if not line:
+                continue
+            total += 1
+            try:
+                row = json.loads(line)
+            except ValueError as e:
+                err(line_no, f"bad json: {e}")
+                continue
+            if not {"instruction", "input", "output"} <= set(row):
+                err(line_no, "missing instruction/input/output keys")
+                continue
+            out = row["output"]
+            try:
+                if row["instruction"] == INSTRUCTION_ACTION:
+                    action = json.loads(out)
+                    if action.get("action") not in ("query", "status"):
+                        raise ValueError(f"unknown action {action.get('action')!r}")
+                    cy = (action.get("params") or {}).get("cypher")
+                    if action["action"] == "query":
+                        if not cy:
+                            raise ValueError("query action without cypher")
+                        cypher_parse(cy)
+                    elif cy:
+                        cypher_parse(cy)
+                else:
+                    cypher_parse(out)
+            except Exception as e:  # noqa: BLE001 — recorded, not fatal
+                err(line_no, f"output invalid: {e}")
+                continue
+            valid += 1
+    return {"total": total, "valid": valid, "invalid": total - valid,
+            "errors": errors}

@@ -1,0 +1,96 @@
+"""Offline dataset tooling (ref: neural/scripts/generate_cypher_dataset.py,
+generate_heimdall_dataset.py, validate_dataset.py — instruction JSONL
+generation + validation; here validation parses every output through the
+real Cypher parser instead of regexes)."""
+
+import json
+import subprocess
+import sys
+
+import pytest
+
+from nornicdb_tpu.models import dataset
+
+
+class TestGeneration:
+    def test_cypher_rows_shape_and_validity(self):
+        rows = list(dataset.generate_cypher_examples(120, seed=1))
+        assert len(rows) == 120
+        from nornicdb_tpu.cypher.parser import parse
+
+        for r in rows:
+            assert set(r) == {"instruction", "input", "output"}
+            assert r["instruction"] == dataset.INSTRUCTION_NL2CYPHER
+            parse(r["output"])  # every emitted query parses
+
+    def test_cypher_generation_is_deterministic_per_seed(self):
+        a = list(dataset.generate_cypher_examples(30, seed=7))
+        b = list(dataset.generate_cypher_examples(30, seed=7))
+        c = list(dataset.generate_cypher_examples(30, seed=8))
+        assert a == b
+        assert a != c
+
+    def test_cypher_rows_cover_pattern_families(self):
+        outs = " ".join(r["output"] for r in
+                        dataset.generate_cypher_examples(300, seed=2))
+        for marker in ("count(", "WHERE", "-[r", "avg(", "LIMIT"):
+            assert marker in outs, marker
+
+    def test_heimdall_rows_parse_as_actions(self):
+        rows = list(dataset.generate_heimdall_examples(60, seed=3))
+        assert len(rows) == 60
+        kinds = set()
+        for r in rows:
+            action = json.loads(r["output"])
+            kinds.add(action["action"])
+            assert action["action"] in ("query", "status")
+        assert kinds == {"query", "status"}
+
+
+class TestValidation:
+    def test_roundtrip_validates_clean(self, tmp_path):
+        p = str(tmp_path / "ds.jsonl")
+        from itertools import chain
+
+        n = dataset.write_jsonl(p, chain(
+            dataset.generate_cypher_examples(40, seed=4),
+            dataset.generate_heimdall_examples(20, seed=4)))
+        assert n == 60
+        report = dataset.validate_jsonl(p)
+        assert report == {"total": 60, "valid": 60, "invalid": 0,
+                          "errors": []}
+
+    def test_validation_catches_bad_rows(self, tmp_path):
+        p = tmp_path / "bad.jsonl"
+        p.write_text("\n".join([
+            "not json at all",
+            json.dumps({"instruction": "x", "input": "y"}),  # missing output
+            json.dumps({"instruction": dataset.INSTRUCTION_NL2CYPHER,
+                        "input": "q", "output": "MATCH (n WHERE"}),
+            json.dumps({"instruction": dataset.INSTRUCTION_ACTION,
+                        "input": "q", "output": '{"action": "rm -rf"}'}),
+            json.dumps({"instruction": dataset.INSTRUCTION_NL2CYPHER,
+                        "input": "ok", "output": "MATCH (n) RETURN n"}),
+        ]) + "\n")
+        report = dataset.validate_jsonl(str(p))
+        assert report["total"] == 5
+        assert report["valid"] == 1
+        assert len(report["errors"]) == 4
+
+
+class TestCli:
+    def test_generate_then_validate_via_cli(self, tmp_path):
+        p = str(tmp_path / "cli.jsonl")
+        r = subprocess.run(
+            [sys.executable, "-m", "nornicdb_tpu", "dataset", "generate",
+             p, "--count", "40"],
+            capture_output=True, text=True, timeout=180,
+        )
+        assert r.returncode == 0, r.stderr[-400:]
+        assert "wrote 40 examples" in r.stdout
+        r = subprocess.run(
+            [sys.executable, "-m", "nornicdb_tpu", "dataset", "validate", p],
+            capture_output=True, text=True, timeout=180,
+        )
+        assert r.returncode == 0, r.stdout[-400:]
+        assert json.loads(r.stdout)["invalid"] == 0
