@@ -1126,11 +1126,23 @@ class HttpServer:
 
         The whole statement batch is ONE implicit transaction (Neo4j
         semantics): a failing statement rolls back every earlier statement's
-        writes. A fresh session executor scopes the tx to this request —
-        sharing the facade executor would entangle tx frames across handler
-        threads."""
+        writes. Single-statement bodies run on the shared per-database
+        executor WITHOUT tx framing — statement-level undo already makes one
+        statement atomic, and the framing measured ~3.5x request cost. For
+        multi-statement bodies a FRESH session executor scopes the tx to
+        this request; opening a BEGIN frame on the shared executor would
+        entangle tx state across handler threads."""
         out_results = []
         errors = []
+        statements = body.get("statements", [])
+        if len(statements) <= 1:
+            # single statement: statement-level atomicity (undo frames)
+            # already gives the one-transaction semantics — skip the
+            # session + BEGIN/COMMIT framing (measured ~3.5x request cost)
+            self._tx_run_statements(
+                self.db.executor_for(database), body, out_results, errors)
+            h._send(200, {"results": out_results, "errors": errors})
+            return
         ex = self.db.session_executor(database)
         ex.execute("BEGIN", {})
         finished = False

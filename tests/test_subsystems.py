@@ -58,6 +58,38 @@ class TestQueryCache:
         assert c.get("qb") == 2
         assert c.get("qc") is None  # agnostic entries always dropped
 
+    def test_served_copies_isolate_every_mutable_kind(self):
+        """A caller mutating a served value — including ndarrays, tuples'
+        contents, sets, and nested entity properties — must never reach the
+        cached object (cache poisoning)."""
+        import numpy as np
+
+        from nornicdb_tpu.cypher.executor import _copy_result
+        from nornicdb_tpu.cypher.executor import Result, Stats
+
+        node = Node(id="n1", properties={"tags": ["a"], "m": {"k": [1]}})
+        row = [
+            node,
+            [np.asarray([1.0, 2.0], np.float32)],
+            (np.asarray([3.0], np.float32), "x"),
+            {"inner": {1, 2}},
+        ]
+        cached = Result(["n", "l", "t", "s"], [row], Stats(), None)
+        served = _copy_result(cached)
+        s_node, s_list, s_tup, s_set = served.rows[0]
+        # mutate everything the caller can reach
+        s_node.properties["tags"].append("EVIL")
+        s_node.properties["m"]["k"].append(99)
+        s_list[0][0] = -1.0
+        s_tup[0][0] = -1.0
+        s_set["inner"].add(3)
+        # the cached source is untouched
+        assert node.properties["tags"] == ["a"]
+        assert node.properties["m"]["k"] == [1]
+        assert float(row[1][0][0]) == 1.0
+        assert float(row[2][0][0]) == 3.0
+        assert row[3]["inner"] == {1, 2}
+
     def test_executor_integration(self):
         db = nornicdb_tpu.open_db("")
         db.cypher("CREATE (:C {v: 1})")
