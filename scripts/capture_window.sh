@@ -5,6 +5,7 @@
 #   1. bench.py TPU leg      — headline knn qps + epilogue A/B self-select
 #   2. benchmarks/ivf_bench.py     — fused IVF vs full scan (small batches)
 #   3. benchmarks/embed_sweep.py   — teacher short-seq grid + distilled rows
+#   4. benchmarks/ring_bench.py    — ring-attention on-chip wall times
 #
 # Every line of output is appended to RELAY_LOG.md AS IT IS PRODUCED
 # (stdbuf line-buffered tee), never batched at the end: a mid-run relay
@@ -45,5 +46,9 @@ run_step "ivf_bench" 900 python benchmarks/ivf_bench.py
 
 # 3. embedding sweep: teacher short-seq grid + distilled student rows
 run_step "embed_sweep" 1200 python benchmarks/embed_sweep.py
+
+# 4. ring attention on-chip wall times (CPU-mesh parity already proven;
+#    this records the ICI-ring timing at real scale)
+run_step "ring_bench" 600 python benchmarks/ring_bench.py
 
 note "capture window complete"
