@@ -120,6 +120,10 @@ class EmbedWorker:
         # process the same node twice
         self._claimed: set[str] = set()
         self._claim_lock = threading.Lock()
+        # stats counters are read-modify-write from every consumer thread
+        # (workers>1, or drain() alongside the background worker): unlocked
+        # increments lose counts under GIL preemption
+        self._stats_lock = threading.Lock()
 
     # -- lifecycle ---------------------------------------------------------
     def start(self) -> None:
@@ -205,7 +209,8 @@ class EmbedWorker:
         vectors = self._embed_with_retry(flat)
         if vectors is None:
             # batch failed terminally: mark failures, keep pending for later
-            self.stats.failed += len(jobs)
+            with self._stats_lock:
+                self.stats.failed += len(jobs)
             return skipped
         processed = 0
         pos = 0
@@ -219,7 +224,8 @@ class EmbedWorker:
                 # overlay the embedding fields onto the fresh copy.
                 fresh = self.storage.get_node(node.id)
                 if len(vecs) > 1:
-                    self.stats.chunked_nodes += 1
+                    with self._stats_lock:
+                        self.stats.chunked_nodes += 1
                     fresh.chunk_embeddings = [np.asarray(v, np.float32) for v in vecs]
                 fresh.embedding = np.asarray(emb, np.float32)
                 updated = self.storage.update_node(fresh)
@@ -232,8 +238,9 @@ class EmbedWorker:
                         pass
             except NotFoundError:
                 self.storage.unmark_pending_embed(node.id)
-        self.stats.processed += processed
-        self.stats.batches += 1
+        with self._stats_lock:
+            self.stats.processed += processed
+            self.stats.batches += 1
         with self._cluster_lock:
             self._since_cluster += processed
             self._last_embed_ts = time.time()
@@ -246,7 +253,8 @@ class EmbedWorker:
             try:
                 return self.embedder.embed_batch(texts)
             except Exception:
-                self.stats.retries += 1
+                with self._stats_lock:
+                    self.stats.retries += 1
                 if attempt == self.config.max_retries - 1:
                     return None
                 time.sleep(delay)

@@ -1,0 +1,145 @@
+"""Embed queue depth BEYOND test_embed_search.py's coverage (ref:
+pkg/nornicdb/embed_queue_test.go 1,316 LoC): chunk window/boundary math,
+retry accounting, terminal-failure semantics, claim-set behavior under
+concurrent drains, and the pre-write re-read race."""
+
+import threading
+
+import numpy as np
+
+from nornicdb_tpu.embed.base import HashEmbedder
+from nornicdb_tpu.embed.queue import (
+    EmbedWorker,
+    EmbedWorkerConfig,
+    average_embeddings,
+    chunk_text,
+)
+from nornicdb_tpu.storage import MemoryEngine
+from nornicdb_tpu.storage.types import Node
+
+
+class TestChunkBoundaryMath:
+    """ref: TestChunkText — window/overlap arithmetic NOT covered by
+    test_embed_search.py (which pins the short-text and basic-overlap
+    cases): exact boundary, window starts, degenerate overlap."""
+
+    def test_exact_boundary_no_extra_chunk(self):
+        words = " ".join(f"w{i}" for i in range(512))
+        assert len(chunk_text(words, 512, 50)) == 1
+
+    def test_overlap_windows_exact_starts(self):
+        words = " ".join(f"w{i}" for i in range(1000))
+        chunks = chunk_text(words, 512, 50)
+        assert len(chunks) == 3  # starts at 0, 462, 924
+        first_words = chunks[0].split()
+        second_words = chunks[1].split()
+        assert second_words[0] == "w462"  # step = 512 - 50
+        assert first_words[-50:] == second_words[:50]  # exact overlap
+
+    def test_degenerate_overlap_still_advances(self):
+        words = " ".join(f"w{i}" for i in range(30))
+        chunks = chunk_text(words, 10, 10)  # step clamps to 1
+        assert len(chunks) >= 3
+        assert chunks[0].split()[0] == "w0"
+
+    def test_zero_vector_average_safe(self):
+        z = np.zeros(4, np.float32)
+        assert np.all(np.isfinite(average_embeddings([z, z])))
+
+
+class _FlakyEmbedder(HashEmbedder):
+    def __init__(self, dims, fail_times):
+        super().__init__(dims)
+        self.fail_times = fail_times
+        self.calls = 0
+
+    def embed_batch(self, texts):
+        self.calls += 1
+        if self.calls <= self.fail_times:
+            raise RuntimeError("transient backend failure")
+        return super().embed_batch(texts)
+
+
+class TestWorkerProcessing:
+    def _worker(self, embedder=None, **cfg):
+        eng = MemoryEngine()
+        w = EmbedWorker(eng, embedder or HashEmbedder(16),
+                        config=EmbedWorkerConfig(
+                            retry_backoff=0.01, **cfg))
+        return eng, w
+
+    def test_retry_then_success_counts_retries(self):
+        """ref: embedWithRetry — transient failures retry with backoff."""
+        emb = _FlakyEmbedder(16, fail_times=2)
+        eng, w = self._worker(embedder=emb, max_retries=3)
+        eng.create_node(Node(id="n1", properties={"content": "retry me"}))
+        eng.mark_pending_embed("n1")
+        w.drain()
+        assert eng.get_node("n1").embedding is not None
+        assert w.stats.retries == 2
+
+    def test_terminal_failure_keeps_pending(self):
+        emb = _FlakyEmbedder(16, fail_times=99)
+        eng, w = self._worker(embedder=emb, max_retries=2)
+        eng.create_node(Node(id="n1", properties={"content": "doomed"}))
+        eng.mark_pending_embed("n1")
+        w.process_batch()
+        assert w.stats.failed == 1
+        assert "n1" in eng.pending_embed_ids()  # retried on a later scan
+        assert eng.get_node("n1").embedding is None
+
+    def test_concurrent_drains_no_duplicate_processing(self):
+        """ref: TestEmbedWorkerConcurrency / TestRaceConditionPrevention —
+        the claim set stops two drains from double-embedding a node."""
+        eng, w = self._worker()
+        for i in range(40):
+            eng.create_node(Node(id=f"n{i}",
+                                 properties={"content": f"doc {i}"}))
+            eng.mark_pending_embed(f"n{i}")
+        totals = []
+        lock = threading.Lock()
+
+        def drain():
+            n = w.drain()
+            with lock:
+                totals.append(n)
+
+        threads = [threading.Thread(target=drain) for _ in range(4)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert sum(totals) == 40  # every node handled exactly once
+        assert w.stats.processed == 40
+        assert eng.pending_embed_ids() == []
+
+    def test_concurrent_touch_not_clobbered(self):
+        """The pre-write re-read: an access-count bump landing between the
+        worker's read and write must survive the embedding update."""
+        eng, w = self._worker()
+        eng.create_node(Node(id="n1", properties={"content": "hot doc"}))
+        eng.mark_pending_embed("n1")
+
+        real_get = eng.get_node
+        bumped = {"done": False}
+
+        def racing_get(nid):
+            node = real_get(nid)
+            if not bumped["done"] and node.embedding is None:
+                # simulate a touch() landing AFTER the worker's first read:
+                # the worker must not write back the stale pre-bump copy
+                fresh = real_get(nid)
+                fresh.access_count = 7
+                eng.update_node(fresh)
+                bumped["done"] = True
+                return node  # the STALE copy — the re-read must rescue this
+            return node
+
+        eng.get_node = racing_get
+        try:
+            w.drain()
+        finally:
+            eng.get_node = real_get
+        stored = eng.get_node("n1")
+        assert stored.embedding is not None
+        assert stored.access_count == 7
