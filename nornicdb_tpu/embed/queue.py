@@ -213,6 +213,7 @@ class EmbedWorker:
                 self.stats.failed += len(jobs)
             return skipped
         processed = 0
+        chunked = 0
         pos = 0
         for node, chunks in jobs:
             vecs = vectors[pos : pos + len(chunks)]
@@ -224,8 +225,7 @@ class EmbedWorker:
                 # overlay the embedding fields onto the fresh copy.
                 fresh = self.storage.get_node(node.id)
                 if len(vecs) > 1:
-                    with self._stats_lock:
-                        self.stats.chunked_nodes += 1
+                    chunked += 1
                     fresh.chunk_embeddings = [np.asarray(v, np.float32) for v in vecs]
                 fresh.embedding = np.asarray(emb, np.float32)
                 updated = self.storage.update_node(fresh)
@@ -241,6 +241,7 @@ class EmbedWorker:
         with self._stats_lock:
             self.stats.processed += processed
             self.stats.batches += 1
+            self.stats.chunked_nodes += chunked
         with self._cluster_lock:
             self._since_cluster += processed
             self._last_embed_ts = time.time()

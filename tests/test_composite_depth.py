@@ -1,0 +1,146 @@
+"""Composite engine per-method depth — the rows of
+pkg/storage/composite_engine_test.go not already pinned by
+test_composite_engine.py: bulk creates through routing, iteration fan-out,
+edges-by-type/between across constituents, update_edge routing, and
+degree aggregation with multi-constituent adjacency."""
+
+import pytest
+
+from nornicdb_tpu.errors import NornicError, NotFoundError
+from nornicdb_tpu.multidb import DatabaseManager
+from nornicdb_tpu.storage import MemoryEngine
+from nornicdb_tpu.storage.types import Edge, Node
+
+
+@pytest.fixture
+def comp():
+    mgr = DatabaseManager(MemoryEngine())
+    mgr.create_database("alpha")
+    mgr.create_database("beta")
+    mgr.create_composite("fed", ["alpha", "beta"])
+    return mgr, mgr.get_storage("fed")
+
+
+class TestBulkOps:
+    def test_bulk_create_nodes_routes_each(self, comp):
+        """ref: TestCompositeEngine_BulkCreateNodes — every node lands in
+        exactly one constituent, chosen by the routing rules."""
+        mgr, fed = comp
+        nodes = [Node(id=f"bulk{i}", labels=["Bulk"],
+                      properties={"database_id": "alpha" if i % 2 == 0
+                                  else "beta"})
+                 for i in range(10)]
+        created = fed.batch_create_nodes(nodes)
+        assert len(created) == 10
+        a = mgr.get_storage("alpha")
+        b = mgr.get_storage("beta")
+        assert a.count_nodes_by_label("Bulk") == 5
+        assert b.count_nodes_by_label("Bulk") == 5
+        assert fed.count_nodes_by_label("Bulk") == 10
+
+    def test_bulk_create_edges_same_constituent(self, comp):
+        """ref: TestCompositeEngine_BulkCreateEdges"""
+        mgr, fed = comp
+        for i in range(4):
+            fed.create_node(Node(id=f"n{i}",
+                                 properties={"database_id": "alpha"}))
+        all_ids = sorted(n.id for n in fed.all_nodes())
+        edges = [Edge(id=f"e{i}", start_node=all_ids[i],
+                      end_node=all_ids[i + 1], type="CHAIN")
+                 for i in range(3)]
+        assert len(fed.batch_create_edges(edges)) == 3
+        assert fed.edge_count() == 3
+
+
+class TestIterationFanOut:
+    def test_all_nodes_spans_constituents(self, comp):
+        """ref: TestCompositeEngine_AllNodes"""
+        mgr, fed = comp
+        fed.create_node(Node(id="a1", properties={"database_id": "alpha"}))
+        fed.create_node(Node(id="b1", properties={"database_id": "beta"}))
+        ids = {n.id for n in fed.all_nodes()}
+        # qualified ids carry their constituent prefix through the view
+        assert any("a1" in i for i in ids)
+        assert any("b1" in i for i in ids)
+        assert fed.node_count() == 2
+
+    def test_all_edges_spans_constituents(self, comp):
+        """ref: TestCompositeEngine_AllEdges"""
+        mgr, fed = comp
+        for db in ("alpha", "beta"):
+            s = mgr.get_storage(db)
+            s.create_node(Node(id="x"))
+            s.create_node(Node(id="y"))
+            s.create_edge(Edge(id=f"{db}-edge", start_node="x",
+                               end_node="y", type="LOCAL"))
+        assert len(list(fed.all_edges())) == 2
+        assert fed.edge_count() == 2
+
+    def test_get_edges_by_type_fans_out(self, comp):
+        """ref: TestCompositeEngine_GetEdgesByType"""
+        mgr, fed = comp
+        for db in ("alpha", "beta"):
+            s = mgr.get_storage(db)
+            s.create_node(Node(id="x"))
+            s.create_node(Node(id="y"))
+            s.create_edge(Edge(id="typed", start_node="x", end_node="y",
+                               type="SHARED_TYPE"))
+        assert len(fed.get_edges_by_type("SHARED_TYPE")) == 2
+        assert fed.count_edges_by_type("SHARED_TYPE") == 2
+        assert fed.get_edges_by_type("GHOST") == []
+
+
+class TestEdgeMethods:
+    def test_update_edge_routes_to_owner(self, comp):
+        """ref: TestCompositeEngine_UpdateEdge"""
+        mgr, fed = comp
+        fed.create_node(Node(id="s", properties={"database_id": "alpha"}))
+        fed.create_node(Node(id="t", properties={"database_id": "alpha"}))
+        sid, tid = sorted(n.id for n in fed.all_nodes())
+        e = fed.create_edge(Edge(id="upd", start_node=sid, end_node=tid,
+                                 type="OLD"))
+        e.type = "NEW"
+        e.properties["w"] = 2
+        updated = fed.update_edge(e)
+        assert updated.type == "NEW"
+        got = fed.get_edge(e.id)
+        assert got.properties["w"] == 2
+        # the owning constituent sees the same update
+        assert mgr.get_storage("alpha").count_edges_by_type("NEW") == 1
+
+    def test_update_missing_edge_raises(self, comp):
+        mgr, fed = comp
+        with pytest.raises((NotFoundError, NornicError)):
+            fed.update_edge(Edge(id="ghost", start_node="a",
+                                 end_node="b", type="T"))
+
+    def test_outgoing_incoming_through_view(self, comp):
+        """ref: TestCompositeEngine_GetOutgoingEdges/GetIncomingEdges"""
+        mgr, fed = comp
+        s = mgr.get_storage("beta")
+        s.create_node(Node(id="hub"))
+        s.create_node(Node(id="leaf"))
+        s.create_edge(Edge(id="he", start_node="hub", end_node="leaf",
+                           type="T"))
+        hub_q = next(i for i in (n.id for n in fed.all_nodes())
+                     if "hub" in i)
+        leaf_q = next(i for i in (n.id for n in fed.all_nodes())
+                      if "leaf" in i)
+        assert len(fed.get_outgoing_edges(hub_q)) == 1
+        assert len(fed.get_incoming_edges(leaf_q)) == 1
+        assert fed.degree(hub_q, "out") == 1
+        assert fed.degree(leaf_q, "in") == 1
+        assert fed.degree(hub_q, "both") == 1
+
+
+class TestDegreeAggregation:
+    def test_counts_aggregate_across_constituents(self, comp):
+        """ref: TestCompositeEngine_GetInDegree/GetOutDegree + counts"""
+        mgr, fed = comp
+        for db, n in (("alpha", 3), ("beta", 2)):
+            s = mgr.get_storage(db)
+            for i in range(n):
+                s.create_node(Node(id=f"c{i}", labels=["Counted"]))
+        assert fed.node_count() == 5
+        assert fed.count_nodes_by_label("Counted") == 5
+        assert fed.count_nodes_by_label("Ghost") == 0
