@@ -60,16 +60,27 @@ class NamespacedEngine(Engine):
         return full_id.startswith(self._prefix)
 
     def _strip_node(self, n: Node) -> Node:
-        out = n.copy()
-        out.id = self._strip(n.id)
-        return out
+        """Copying strip — ONLY for shared objects (event entities go to
+        every subscriber; mutating them would corrupt sibling namespaces)."""
+        return self._restrip_node(n.copy())
 
     def _strip_edge(self, e: Edge) -> Edge:
-        out = e.copy()
-        out.id = self._strip(e.id)
-        out.start_node = self._strip(e.start_node)
-        out.end_node = self._strip(e.end_node)
-        return out
+        return self._restrip_edge(e.copy())
+
+    def _restrip_node(self, n: Node) -> Node:
+        """In-place strip for base-engine RETURN values: the Engine contract
+        (pinned by test_storage_unit_depth deep-copy tests on both engines)
+        makes those caller-owned fresh copies, so a second full copy here —
+        embeddings included — was pure overhead. Profiled at ~1/3 of an
+        uncached label-scan query's node copies."""
+        n.id = self._strip(n.id)
+        return n
+
+    def _restrip_edge(self, e: Edge) -> Edge:
+        e.id = self._strip(e.id)
+        e.start_node = self._strip(e.start_node)
+        e.end_node = self._strip(e.end_node)
+        return e
 
     def _forward_event(self, kind: str, entity) -> None:
         if isinstance(entity, Node):
@@ -98,32 +109,42 @@ class NamespacedEngine(Engine):
     def create_node(self, node: Node) -> Node:
         stored = node.copy()
         stored.id = self._add(node.id)
-        return self._strip_node(self.base.create_node(stored))
+        return self._restrip_node(self.base.create_node(stored))
 
     def get_node(self, node_id: str) -> Node:
-        return self._strip_node(self.base.get_node(self._add(node_id)))
+        return self._restrip_node(self.base.get_node(self._add(node_id)))
 
     def update_node(self, node: Node) -> Node:
         stored = node.copy()
         stored.id = self._add(node.id)
-        return self._strip_node(self.base.update_node(stored))
+        return self._restrip_node(self.base.update_node(stored))
 
     def delete_node(self, node_id: str) -> None:
         self.base.delete_node(self._add(node_id))
 
     def get_nodes_by_label(self, label: str) -> list[Node]:
+        ids_fn = getattr(self.base, "node_ids_by_label", None)
+        if ids_fn is not None:
+            ids = ids_fn(label)
+            owned = [i for i in ids if i.startswith(self._prefix)]
+            if len(owned) < len(ids):
+                # foreign namespaces share this label: fetch only ours —
+                # the bulk scan would deep-copy their nodes (embeddings
+                # included) just to discard them in the _owns filter
+                return [self._restrip_node(n)
+                        for n in self.base.batch_get_nodes(owned)]
         return [
-            self._strip_node(n)
+            self._restrip_node(n)
             for n in self.base.get_nodes_by_label(label)
             if self._owns(n.id)
         ]
 
     def all_nodes(self) -> Iterator[Node]:
-        return (self._strip_node(n) for n in self.base.all_nodes() if self._owns(n.id))
+        return (self._restrip_node(n) for n in self.base.all_nodes() if self._owns(n.id))
 
     def batch_get_nodes(self, ids: Iterable[str]) -> list[Node]:
         return [
-            self._strip_node(n)
+            self._restrip_node(n)
             for n in self.base.batch_get_nodes(self._add(i) for i in ids)
         ]
 
@@ -133,36 +154,36 @@ class NamespacedEngine(Engine):
         stored.id = self._add(edge.id)
         stored.start_node = self._add(edge.start_node)
         stored.end_node = self._add(edge.end_node)
-        return self._strip_edge(self.base.create_edge(stored))
+        return self._restrip_edge(self.base.create_edge(stored))
 
     def get_edge(self, edge_id: str) -> Edge:
-        return self._strip_edge(self.base.get_edge(self._add(edge_id)))
+        return self._restrip_edge(self.base.get_edge(self._add(edge_id)))
 
     def update_edge(self, edge: Edge) -> Edge:
         stored = edge.copy()
         stored.id = self._add(edge.id)
         stored.start_node = self._add(edge.start_node)
         stored.end_node = self._add(edge.end_node)
-        return self._strip_edge(self.base.update_edge(stored))
+        return self._restrip_edge(self.base.update_edge(stored))
 
     def delete_edge(self, edge_id: str) -> None:
         self.base.delete_edge(self._add(edge_id))
 
     def get_edges_by_type(self, edge_type: str) -> list[Edge]:
         return [
-            self._strip_edge(e)
+            self._restrip_edge(e)
             for e in self.base.get_edges_by_type(edge_type)
             if self._owns(e.id)
         ]
 
     def get_outgoing_edges(self, node_id: str) -> list[Edge]:
         return [
-            self._strip_edge(e) for e in self.base.get_outgoing_edges(self._add(node_id))
+            self._restrip_edge(e) for e in self.base.get_outgoing_edges(self._add(node_id))
         ]
 
     def get_incoming_edges(self, node_id: str) -> list[Edge]:
         return [
-            self._strip_edge(e) for e in self.base.get_incoming_edges(self._add(node_id))
+            self._restrip_edge(e) for e in self.base.get_incoming_edges(self._add(node_id))
         ]
 
     def iter_adjacency(self, node_id: str, direction: str) -> list[tuple]:
@@ -176,7 +197,7 @@ class NamespacedEngine(Engine):
         ]
 
     def all_edges(self) -> Iterator[Edge]:
-        return (self._strip_edge(e) for e in self.base.all_edges() if self._owns(e.id))
+        return (self._restrip_edge(e) for e in self.base.all_edges() if self._owns(e.id))
 
     def count_nodes_by_label(self, label: str) -> int:
         ids_fn = getattr(self.base, "node_ids_by_label", None)
