@@ -25,6 +25,7 @@ the caller to match the generic path's id-ordered scans.
 from __future__ import annotations
 
 import threading
+from collections import OrderedDict
 from typing import Any, Optional
 
 import numpy as np
@@ -99,12 +100,19 @@ class _LabelColumns:
 
 
 class ColumnarScanIndex:
-    """Lazily built per-label column store, kept fresh by engine events."""
+    """Lazily built per-label column store, kept fresh by engine events.
+
+    The label set is LRU-capped: _on_event walks every cached label per
+    node write, so an unbounded set (a workload touching hundreds of
+    small queried-once labels) would grow write-path cost and resident
+    columns without bound."""
+
+    MAX_LABELS = 64
 
     def __init__(self, storage):
         self.storage = storage
         self._lock = threading.RLock()
-        self._labels: dict[str, _LabelColumns] = {}
+        self._labels: "OrderedDict[str, _LabelColumns]" = OrderedDict()
         self._epoch = 0
         storage.on_event(self._on_event)
 
@@ -130,6 +138,7 @@ class ColumnarScanIndex:
         with self._lock:
             lc = self._labels.get(label)
             if lc is not None:
+                self._labels.move_to_end(label)
                 return lc
         for _ in range(2):  # one retry if a write races the snapshot
             with self._lock:
@@ -139,6 +148,9 @@ class ColumnarScanIndex:
             with self._lock:
                 if self._epoch == epoch:
                     self._labels[label] = built
+                    self._labels.move_to_end(label)
+                    while len(self._labels) > self.MAX_LABELS:
+                        self._labels.popitem(last=False)
                     return built
         return None  # busy write window — caller falls back to generic scan
 

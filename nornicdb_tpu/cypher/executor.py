@@ -1006,7 +1006,10 @@ class CypherExecutor:
             and node_pat.properties is None
         ):
             label = node_pat.labels[0]
-            if self.storage.count_nodes_by_label(label) < cfg.min_batch_size:
+            # the columnar mask is one vectorized numpy op — profitable far
+            # below cfg.min_batch_size (that gate prices THREAD dispatch;
+            # parallel_filter still applies it to any residual predicate)
+            if self.storage.count_nodes_by_label(label) < cfg.columnar_min_rows:
                 return None
             idx = self._scan_index()
             if idx is not None:

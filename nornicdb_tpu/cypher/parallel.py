@@ -49,6 +49,10 @@ class ParallelConfig:
     enabled: bool = True
     max_workers: int = 0  # 0 -> os.cpu_count()
     min_batch_size: int = 1000  # parallelize only above this (parallel.go:60)
+    # the columnar masked scan is one vectorized numpy op, profitable far
+    # below the THREAD-dispatch gate above; separately tunable so operators
+    # can still force the generic path without killing all parallelism
+    columnar_min_rows: int = 64
 
     def workers(self) -> int:
         return self.max_workers or (os.cpu_count() or 1)
@@ -72,6 +76,8 @@ def set_parallel_config(config: ParallelConfig) -> None:
         config.max_workers = 0
     if config.min_batch_size <= 0:
         config.min_batch_size = 1000
+    if config.columnar_min_rows <= 0:
+        config.columnar_min_rows = 64
     with _config_lock:
         _config = config
 

@@ -192,8 +192,11 @@ class TestFastpathEquivalence:
         assert called.get("yes")
 
     def test_optional_match_empty_scan(self):
+        # columnar_min_rows=1 keeps the 50-node label on the columnar fast
+        # path so its optional-empty branch stays regression-covered
         ex = _executor(n=50, seed=1)
-        set_parallel_config(ParallelConfig(min_batch_size=1))
+        set_parallel_config(ParallelConfig(min_batch_size=1,
+                                           columnar_min_rows=1))
         res = ex.execute(
             "OPTIONAL MATCH (n:P) WHERE n.age > 1000 RETURN n")
         assert res.rows == [[None]]
