@@ -348,7 +348,16 @@ class CypherExecutor:
                 cw = compile_where(where, node.variable)
                 if not cw.has_columnar or cw.residual is not None:
                     return None
-                if len(node.labels) == 1:
+                from nornicdb_tpu.cypher.parallel import get_parallel_config
+
+                cfg = get_parallel_config()
+                if (
+                    len(node.labels) == 1
+                    # same operator escape hatch as _match_scan_fast: raising
+                    # columnar_min_rows bypasses the scan index everywhere
+                    and self.storage.count_nodes_by_label(node.labels[0])
+                    >= cfg.columnar_min_rows
+                ):
                     idx = self._scan_index()
                     if idx is not None:
                         n = idx.count(node.labels[0], cw, params)

@@ -76,10 +76,52 @@ class TestParallelPrimitives:
     def test_gates(self):
         set_parallel_config(ParallelConfig(enabled=False))
         assert parallel_filter([1, 2, 3], lambda x: True) == [1, 2, 3]
-        set_parallel_config(ParallelConfig(min_batch_size=0, max_workers=-1))
+        set_parallel_config(ParallelConfig(min_batch_size=0, max_workers=-1,
+                                           columnar_min_rows=0))
         cfg = get_parallel_config()
         assert cfg.min_batch_size == 1000  # zero values fall back, parallel.go:68
         assert cfg.max_workers == 0
+        assert cfg.columnar_min_rows == 64  # zero value falls back too
+
+    def test_columnar_min_rows_gate_is_independent(self, monkeypatch):
+        """Raising columnar_min_rows forces the index-free path (the
+        operator escape hatch) on BOTH the scan and count fastpaths,
+        without touching thread-pool parallelism; results agree."""
+        from nornicdb_tpu.cypher import colindex as ci
+
+        ex = _executor(n=120, seed=5)
+        queries = ["MATCH (n:P) WHERE n.age > 30 RETURN count(n)",
+                   "MATCH (n:P) WHERE n.age > 30 RETURN n.i"]
+        set_parallel_config(ParallelConfig(min_batch_size=1,
+                                           columnar_min_rows=1))
+        fast = [sorted(map(tuple, ex.execute(q).rows)) for q in queries]
+        set_parallel_config(ParallelConfig(min_batch_size=1,
+                                           columnar_min_rows=10**6))
+        # with the threshold raised, the scan index must never be consulted
+        def boom(self, label, *a, **k):
+            raise AssertionError("scan index consulted despite gate")
+
+        monkeypatch.setattr(ci.ColumnarScanIndex, "masked_ids", boom)
+        monkeypatch.setattr(ci.ColumnarScanIndex, "count", boom)
+        generic = [sorted(map(tuple, ex.execute(q).rows)) for q in queries]
+        assert fast == generic
+
+    def test_colindex_label_set_lru_capped(self):
+        """Hundreds of queried-once labels must not grow the per-write
+        event walk without bound."""
+        from nornicdb_tpu.cypher.colindex import ColumnarScanIndex
+
+        eng = MemoryEngine()
+        for li in range(ColumnarScanIndex.MAX_LABELS + 10):
+            for i in range(3):
+                eng.create_node(Node(id=f"l{li}-n{i}", labels=[f"L{li}"],
+                                     properties={"v": i}))
+        idx = ColumnarScanIndex(eng)
+        for li in range(ColumnarScanIndex.MAX_LABELS + 10):
+            assert idx._get(f"L{li}") is not None
+        assert len(idx._labels) == ColumnarScanIndex.MAX_LABELS
+        # evicted labels rebuild on demand (correctness unaffected)
+        assert len(idx._get("L0").ids) == 3
 
 
 class TestCompileWhere:
