@@ -1,9 +1,15 @@
 # NornicDB-TPU (ref: the reference's Makefile test/build targets)
 
-.PHONY: test test-fast bench native e2e-bench clean
+.PHONY: test test-fast lint lint-baseline bench native e2e-bench clean
 
 test:
 	python -m pytest tests/ -q
+
+lint:
+	python -m nornicdb_tpu.tools.nornlint nornicdb_tpu --baseline tools/nornlint_baseline.json
+
+lint-baseline:
+	python -m nornicdb_tpu.tools.nornlint nornicdb_tpu --baseline tools/nornlint_baseline.json --update-baseline
 
 test-fast:
 	python -m pytest tests/ -q -x

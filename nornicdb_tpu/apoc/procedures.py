@@ -657,7 +657,9 @@ def _acquire(reg, eid: str, owner, timeout_s: float) -> bool:
         if holder is not None and holder[0] == owner:
             reg["owners"][eid] = (owner, holder[1] + 1)
             return True
-    got = lk.acquire(timeout=timeout_s) if timeout_s > 0 else lk.acquire(
+    # session-scoped ownership: the lock is held across procedure calls and
+    # released by the paired apoc.lock.release procedure, not try/finally
+    got = lk.acquire(timeout=timeout_s) if timeout_s > 0 else lk.acquire(  # nornlint: disable=NL-CC01
         blocking=False)
     if got:
         with reg["mu"]:

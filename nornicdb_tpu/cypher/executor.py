@@ -233,11 +233,11 @@ class CypherExecutor:
                 plan = self._explain(stmt)
                 if stmt.explain:
                     return Result(["plan"], [[plan]], plan=plan)
-            t0 = time.time()
+            t0 = time.perf_counter()
             result = self._run_query_atomic(stmt, params)
             if stmt.profile:
                 result.plan = (self._explain(stmt)
-                               + f"\nruntime: {(time.time()-t0)*1000:.2f} ms"
+                               + f"\nruntime: {(time.perf_counter()-t0)*1000:.2f} ms"
                                + f", rows: {len(result.rows)}")
             return result
         if isinstance(stmt, ast.CreateIndex):

@@ -244,7 +244,7 @@ class EmbedWorker:
             self.stats.chunked_nodes += chunked
         with self._cluster_lock:
             self._since_cluster += processed
-            self._last_embed_ts = time.time()
+            self._last_embed_ts = time.monotonic()
         return processed + skipped
 
     def _embed_with_retry(self, texts: list[str]) -> Optional[list[np.ndarray]]:
@@ -270,7 +270,7 @@ class EmbedWorker:
         with self._cluster_lock:
             if (
                 self._since_cluster >= self.config.cluster_min_new
-                and time.time() - self._last_embed_ts >= self.config.cluster_quiet_period
+                and time.monotonic() - self._last_embed_ts >= self.config.cluster_quiet_period
             ):
                 self._since_cluster = 0
             else:

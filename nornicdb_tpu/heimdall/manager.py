@@ -376,7 +376,7 @@ class HeimdallManager:
         must also flow through here, never call a backend directly, or
         plugin guards (redaction, veto) become evadable by picking a
         different registered model."""
-        t0 = time.time()
+        t0 = time.perf_counter()
         backend = generator if generator is not None else self.generator
         try:
             out = backend.generate(prompt, max_tokens)
@@ -387,7 +387,7 @@ class HeimdallManager:
             self.metrics.errors += 1
             raise
         finally:
-            self.metrics.total_latency += time.time() - t0
+            self.metrics.total_latency += time.perf_counter() - t0
 
     def build_context(
         self, messages: list[dict[str, str]]
@@ -649,7 +649,7 @@ class HeimdallManager:
         prompt = self.pre_prompt_transform(
             self._build_prompt(ctx, messages))
         pieces: list[str] = []
-        t0 = time.time()
+        t0 = time.perf_counter()
         try:
             for delta in generator.generate_stream(prompt, max_tokens):
                 pieces.append(delta)
@@ -671,7 +671,7 @@ class HeimdallManager:
         self.metrics.generations += 1
         # same unit as generate() (word count) so the counter stays summable
         self.metrics.tokens_generated += len(text.split())
-        self.metrics.total_latency += time.time() - t0
+        self.metrics.total_latency += time.perf_counter() - t0
         self.metrics_registry.inc("chat_requests")
         self.metrics_registry.inc("prompt_tokens", estimate_tokens(prompt))
         self.metrics_registry.inc("completion_tokens", estimate_tokens(text))

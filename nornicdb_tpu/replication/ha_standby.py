@@ -7,6 +7,7 @@ standby promote (:159). Storage bridging mirrors storage_adapter.go.
 
 from __future__ import annotations
 
+import logging
 import threading
 import time
 from dataclasses import dataclass, field
@@ -31,6 +32,8 @@ from nornicdb_tpu.storage.wal import (
     OP_UPDATE_NODE,
     apply_storage_op,
 )
+
+log = logging.getLogger(__name__)
 
 
 def apply_op(engine: Engine, op: str, data: dict[str, Any]) -> None:
@@ -218,6 +221,7 @@ class HAPrimary:
             return 0
         except Exception:
             # never let a bad response kill the ship loop thread
+            log.warning("WAL ship attempt failed; will retry", exc_info=True)
             return 0
 
     def _heartbeat_loop(self) -> None:
@@ -257,7 +261,7 @@ class HAStandby:
         self.primary_id = primary_id
         self.config = config or HAConfig()
         self.applied_seq = 0
-        self.last_heartbeat = time.time()
+        self.last_heartbeat = time.monotonic()
         self.promoted = False
         self._lock = threading.Lock()
         transport.set_handler(self._on_message)
@@ -266,7 +270,7 @@ class HAStandby:
         if msg.type == MSG_WAL_BATCH:
             return self._apply_batch(msg)
         if msg.type == MSG_HEARTBEAT:
-            self.last_heartbeat = time.time()
+            self.last_heartbeat = time.monotonic()
             return None
         if msg.type == MSG_PROMOTE:
             self.promote()
@@ -300,7 +304,7 @@ class HAStandby:
             return Message(0, {"acked_seq": self.applied_seq})
 
     def heartbeat_healthy(self) -> bool:
-        return (time.time() - self.last_heartbeat) < self.config.heartbeat_timeout
+        return (time.monotonic() - self.last_heartbeat) < self.config.heartbeat_timeout
 
     def promote(self) -> ReplicatedEngine:
         """Become the writable primary (ref: promote :159): fence the old

@@ -10,6 +10,7 @@ device plane is untouched (SURVEY.md §2.3).
 from __future__ import annotations
 
 import json
+import logging
 import os
 import random
 import threading
@@ -26,6 +27,8 @@ from nornicdb_tpu.replication.transport import (
     Transport,
 )
 from nornicdb_tpu.storage.types import Engine
+
+log = logging.getLogger(__name__)
 
 FOLLOWER = "follower"
 CANDIDATE = "candidate"
@@ -416,7 +419,10 @@ class RaftNode:
                 try:
                     self.on_apply(entry)
                 except Exception:
-                    pass
+                    # the log entry IS applied; an observer callback crash
+                    # must not stall commit advancement, but it is a bug
+                    log.exception(
+                        "on_apply callback failed at index %d", self.last_applied)
 
     # -- RPC handlers ----------------------------------------------------------------
     def _on_message(self, msg: Message) -> Optional[Message]:

@@ -16,6 +16,7 @@ ICI inside jit'd programs (SURVEY.md §5).
 from __future__ import annotations
 
 import json
+import logging
 import socket
 import socketserver
 import struct
@@ -26,6 +27,8 @@ from dataclasses import dataclass, field
 from typing import Any, Callable, Optional
 
 from nornicdb_tpu.errors import ReplicationError
+
+log = logging.getLogger(__name__)
 
 # message types (ref: transport.go message type byte)
 MSG_REQUEST = 1
@@ -133,8 +136,10 @@ class Transport:
                 reply.sender = self.node_id
                 try:
                     self.send(msg.sender, reply)
-                except Exception:
-                    pass
+                except (ReplicationError, OSError) as e:
+                    # reply path down (InProc raises ReplicationError, TCP
+                    # raw socket errors): caller retries; don't kill delivery
+                    log.warning("reply to %s dropped: %s", msg.sender, e)
 
 
 class InProcNetwork:
@@ -213,7 +218,11 @@ class TcpTransport(Transport):
                     body = _read_exact(self.request, length)
                     outer._deliver(Message.decode(header + body))
                 except Exception:
-                    pass
+                    # one bad frame must not kill the listener thread, but
+                    # a corrupt/truncated peer stream is worth a trace
+                    log.warning(
+                        "dropped undecodable frame from %s",
+                        self.client_address, exc_info=True)
 
         self._server = socketserver.ThreadingTCPServer(bind, _Handler)
         self._server.daemon_threads = True

@@ -13,6 +13,7 @@ streamed on PULL (qid-less, single-query-at-a-time like Bolt 4 autocommit).
 from __future__ import annotations
 
 import asyncio
+import logging
 import struct
 import threading
 from typing import Any, Optional
@@ -20,6 +21,8 @@ from typing import Any, Optional
 from nornicdb_tpu.cypher.executor import classify_query_text
 from nornicdb_tpu.errors import AuthError
 from nornicdb_tpu.server.packstream import Structure, pack, to_wire, unpack
+
+log = logging.getLogger(__name__)
 
 MAGIC = b"\x60\x60\xb0\x17"
 
@@ -207,7 +210,7 @@ class BoltSession:
             if self.authenticated:
                 try:
                     self.role = self.server.authenticator.get_user(user).role
-                except Exception:
+                except AuthError:
                     self.role = "none"
         elif scheme == "bearer":
             token = meta.get("credentials", "")
@@ -233,7 +236,7 @@ class BoltSession:
         try:
             self._execute("ROLLBACK", {})
         except Exception:
-            pass
+            log.warning("implicit rollback failed", exc_info=True)
 
     def _execute(self, query: str, params: dict):
         factory = self.server.session_executor_factory
@@ -423,18 +426,21 @@ class BoltServer:
                 if writer.transport.get_write_buffer_size() > 65536:
                     await writer.drain()
         except Exception:
-            pass
+            # client gone mid-conversation is routine; anything else in the
+            # message loop should leave a trace before we drop the session
+            log.debug("bolt session ended abnormally", exc_info=True)
         finally:
             self.connections -= 1
             if session is not None:
                 try:
                     session.abort_tx()  # dropped connection mid-tx: roll back
                 except Exception:
-                    pass
+                    log.warning("abort_tx on dropped connection failed",
+                                exc_info=True)
             try:
                 writer.close()
-            except Exception:
-                pass
+            except OSError:
+                pass  # socket already torn down
 
     # -- lifecycle ---------------------------------------------------------------
     async def _serve(self) -> None:

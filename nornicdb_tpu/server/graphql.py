@@ -271,7 +271,7 @@ def parse_operation(query: str) -> str:
     failure (the executor will produce the real error)."""
     try:
         return parse_document_cached(query)["operation"]
-    except Exception:
+    except Exception:  # nornlint: disable=NL-ERR02
         return "query"
 
 

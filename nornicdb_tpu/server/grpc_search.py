@@ -24,6 +24,8 @@ import struct
 import time
 from typing import Any, Iterator, Optional
 
+from nornicdb_tpu.errors import NotFoundError
+
 SERVICE_NAME = "nornicdb.SearchService"
 
 
@@ -220,8 +222,8 @@ class GrpcSearchServer:
                 node = None
                 try:
                     node = self.db.storage.get_node(nid)
-                except Exception:
-                    pass
+                except NotFoundError:
+                    pass  # hit evicted between search and fetch: skip detail
                 out.append(
                     {
                         "id": nid,

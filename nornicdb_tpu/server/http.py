@@ -12,6 +12,7 @@ from __future__ import annotations
 
 import base64
 import json
+import logging
 import os
 import re
 import threading
@@ -28,6 +29,8 @@ from nornicdb_tpu.storage.types import Edge, Node
 from nornicdb_tpu.cypher import ast as cypher_ast
 from nornicdb_tpu.cypher.executor import classify_query_text
 from nornicdb_tpu.cypher.parser import parse as cypher_parse
+
+log = logging.getLogger(__name__)
 
 
 def _jsonable(v: Any) -> Any:
@@ -75,7 +78,7 @@ class RateLimiter:
     MAX_BUCKETS = 10_000
 
     def allow(self, client: str) -> bool:
-        now = time.time()
+        now = time.monotonic()
         with self._lock:
             if len(self._buckets) > self.MAX_BUCKETS:
                 # prune clients whose buckets have refilled (idle long enough)
@@ -118,7 +121,7 @@ class HttpServer:
         self.port = port
         self.authenticator = authenticator
         self.auth_required = auth_required
-        self.started_at = time.time()
+        self.started_at = time.monotonic()
         self.requests = 0
         self.errors = 0
         self.slow_queries = 0
@@ -412,7 +415,7 @@ class HttpServer:
             degraded = bool(wal and wal.get("degraded"))
             body = {
                 "status": "degraded" if degraded else "running",
-                "uptime_seconds": round(time.time() - self.started_at, 1),
+                "uptime_seconds": round(time.monotonic() - self.started_at, 1),
                 "nodes": self.db.storage.node_count(),
                 "edges": self.db.storage.edge_count(),
                 "version": "1.0.0",
@@ -648,8 +651,8 @@ class HttpServer:
                 initialized = xla_bridge.backends_are_initialized()
             else:  # older/newer jax without the public check
                 initialized = bool(getattr(xla_bridge, "_backends", {}))
-        except Exception:
-            initialized = False
+        except Exception:  # nornlint: disable=NL-ERR02
+            initialized = False  # private-API drift: report uninitialised
         if not initialized:
             out["note"] = ("backend not initialised yet; first search or "
                            "embed will initialise it")
@@ -668,7 +671,7 @@ class HttpServer:
         """(ref: server_public.go:141-200 — hand-rendered text format)"""
         lines = [
             "# TYPE nornicdb_uptime_seconds gauge",
-            f"nornicdb_uptime_seconds {time.time() - self.started_at:.1f}",
+            f"nornicdb_uptime_seconds {time.monotonic() - self.started_at:.1f}",
             "# TYPE nornicdb_requests_total counter",
             f"nornicdb_requests_total {self.requests}",
             "# TYPE nornicdb_errors_total counter",
@@ -1157,7 +1160,7 @@ class HttpServer:
                 try:
                     ex.execute("ROLLBACK", {})
                 except Exception:
-                    pass
+                    log.warning("post-failure rollback failed", exc_info=True)
         try:
             ex.execute("ROLLBACK" if errors else "COMMIT", {})
         except Exception as e:  # a failed commit voids the batch's results
@@ -1192,9 +1195,9 @@ class HttpServer:
                                    "available on the stateless tx endpoint",
                     })
                     return
-            except Exception:
+            except Exception:  # nornlint: disable=NL-ERR02
                 pass  # unparseable: fall through, execute() reports it
-            t0 = time.time()
+            t0 = time.perf_counter()
             try:
                 result = ex.execute(query, params)
             except Exception as e:
@@ -1202,7 +1205,7 @@ class HttpServer:
                     {"code": "Neo.ClientError.Statement.SyntaxError", "message": str(e)}
                 )
                 return
-            if time.time() - t0 > self.slow_threshold:
+            if time.perf_counter() - t0 > self.slow_threshold:
                 self.slow_queries += 1
             out_results.append(
                 {

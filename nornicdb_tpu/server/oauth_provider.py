@@ -250,8 +250,8 @@ class OAuthTestProvider:
                 try:
                     cid, _, secret = base64.b64decode(
                         auth[6:]).decode().partition(":")
-                except Exception:
-                    return False
+                except (ValueError, UnicodeDecodeError):
+                    return False  # malformed base64: not authenticated
         return cid == self.client_id and secret == self.client_secret
 
     def _handle_token(self, h, form: dict) -> None:

@@ -20,7 +20,7 @@ from typing import Any, Optional
 
 import numpy as np
 
-from nornicdb_tpu.errors import NornicError, NotFoundError
+from nornicdb_tpu.errors import AlreadyExistsError, NornicError, NotFoundError
 from nornicdb_tpu.ops.similarity import DeviceCorpus
 from nornicdb_tpu.storage.types import Engine, Node
 
@@ -304,7 +304,7 @@ class QdrantCollections:
             )
             try:
                 self.storage.create_node(node)
-            except Exception:
+            except AlreadyExistsError:
                 existing = self.storage.get_node(nid)
                 existing.properties = dict(node.properties)
                 existing.embedding = vec

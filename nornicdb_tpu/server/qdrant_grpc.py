@@ -33,7 +33,7 @@ from typing import Any, Callable, Optional
 
 import numpy as np
 
-from nornicdb_tpu.errors import NornicError, NotFoundError
+from nornicdb_tpu.errors import AuthError, NornicError, NotFoundError
 from nornicdb_tpu.server.qdrant import POINT_LABEL, QdrantCollections
 
 SERVICE_COLLECTIONS = "qdrant.Collections"
@@ -555,13 +555,13 @@ class QdrantGrpcServer:
         if header.startswith("Basic "):
             try:
                 user, pw = base64.b64decode(header[6:]).decode().split(":", 1)
-            except Exception:
-                return None
+            except (ValueError, UnicodeDecodeError):
+                return None  # malformed basic-auth header
             if auth.check_password(user, pw):
                 try:
                     return {"sub": user, "role": auth.get_user(user).role}
-                except Exception:
-                    return None
+                except AuthError:
+                    return None  # user deleted between check and fetch
             return None
         api_key = md.get("api-key", "")
         if api_key:

@@ -11,8 +11,11 @@ search/service.py gen_before).
 
 from __future__ import annotations
 
+import logging
 import time
 from typing import Callable, Hashable, Optional
+
+log = logging.getLogger(__name__)
 
 
 class ResponseCache:
@@ -27,6 +30,11 @@ class ResponseCache:
         try:
             return self._generation_fn()
         except Exception:
+            # sentinel: both get() and put() treat -1 as "cache unusable"
+            # (fail open = serve uncached) — a -1 must never match a -1, or
+            # a persistently-broken probe would serve stale hits forever
+            log.warning("generation probe failed; cache disabled this request",
+                        exc_info=True)
             return -1
 
     def get(self, key: Hashable) -> Optional[bytes]:
@@ -34,7 +42,8 @@ class ResponseCache:
         if entry is None:
             return None
         payload, gen, expires = entry
-        if gen != self.generation() or time.time() > expires:
+        current = self.generation()
+        if current == -1 or gen != current or time.time() > expires:
             self._entries.pop(key, None)
             return None
         return payload
@@ -43,6 +52,8 @@ class ResponseCache:
         """`generation` must be the value snapshotted before the search
         ran; an entry built from pre-mutation data then mismatches the
         bumped counter and dies on first lookup."""
+        if generation == -1:
+            return  # probe failed before the search: staleness unknowable
         if len(self._entries) >= self.max_entries:
             self._entries.clear()  # cheap wholesale eviction
         self._entries[key] = (payload, generation, time.time() + self.ttl)

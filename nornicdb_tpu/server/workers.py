@@ -43,6 +43,7 @@ is configured with one, so cache hits cannot bypass limiting.
 from __future__ import annotations
 
 import json
+import logging
 import mmap
 import os
 import re
@@ -55,6 +56,8 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Optional
 
 from nornicdb_tpu.server.respcache import ResponseCache
+
+log = logging.getLogger(__name__)
 
 
 class GenerationFile:
@@ -110,8 +113,8 @@ class GenerationFile:
         try:
             self._mm.close()
             self._f.close()
-        except Exception:
-            pass
+        except (OSError, ValueError):
+            pass  # already closed
         if self._own:
             try:
                 os.unlink(self.path)
@@ -139,8 +142,10 @@ def _cacheable(method: str, path: str, body: bytes) -> bool:
         # primary, even inside a string literal — correctness over hit rate
         try:
             q = json.loads(body or b"{}").get("query", "")
-        except Exception:
-            return False
+        except (ValueError, AttributeError, UnicodeDecodeError):
+            return False  # unparseable body: route to primary, never cache
+        if not isinstance(q, str):
+            return False  # e.g. {"query": null}: primary's problem, not ours
         return not _MUTATION_RE.search(q)
     return False
 
@@ -225,11 +230,13 @@ class _FrontendHandler(BaseHTTPRequestHandler):
             except Exception:
                 try:
                     conn.close()
-                except Exception:
+                except OSError:
                     pass
                 self._local.conn = None
                 if attempt:
                     raise
+                log.debug("proxy connection failed; retrying once",
+                          exc_info=True)
         raise RuntimeError("unreachable")
 
     def _respond(self, status: int, headers: list[tuple[str, str]],
@@ -286,8 +293,8 @@ class _FrontendHandler(BaseHTTPRequestHandler):
                 self._respond(
                     502, [("Content-Type", "application/json")], msg, "error"
                 )
-            except Exception:
-                pass
+            except OSError:
+                pass  # client hung up before the error could be written
 
     def do_GET(self):
         self._handle("GET")
@@ -504,7 +511,8 @@ class WorkerPool:
             try:
                 self._db.storage.off_event(self._bump_cb)
             except Exception:
-                pass
+                log.warning("off_event failed during worker stop",
+                            exc_info=True)
             self._bump_cb = None
         self.generation.close()
 

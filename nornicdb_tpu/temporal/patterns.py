@@ -148,7 +148,9 @@ class PatternDetector:
             return None
         # anchored at NOW (ref: pattern_detector.go:296): a burst that
         # ended long ago must stop being reported once its window passes
-        cutoff = time.time() - self.config.burst_window_seconds
+        # wall clock on purpose: `recent` holds caller-supplied event
+        # timestamps (epoch seconds), so the window must be anchored there
+        cutoff = time.time() - self.config.burst_window_seconds  # nornlint: disable=NL-TM01
         in_window = sum(1 for t in data.recent if t >= cutoff)
         if in_window < self.config.burst_min_accesses:
             return None

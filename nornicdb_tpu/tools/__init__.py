@@ -1,0 +1,1 @@
+"""Project-native developer tooling (static analysis, maintenance scripts)."""

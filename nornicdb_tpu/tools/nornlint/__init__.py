@@ -1,0 +1,37 @@
+"""nornlint — project-native static analysis for NornicDB-TPU.
+
+A stdlib-only (``ast``-based) linter encoding this codebase's real failure
+modes as machine-checked rules:
+
+* **JAX hot-path rules** — host syncs inside ``@jit`` (NL-JAX01), Python
+  loops over ``jnp`` arrays (NL-JAX02), unhashable / per-call-formatted
+  static args that force recompiles (NL-JAX03).
+* **Concurrency rules** — ``Lock.acquire()`` without ``with``/try-finally
+  (NL-CC01), unlocked mutation of module-level mutable state in threaded
+  modules (NL-CC02).
+* **Error hygiene** — bare ``except:`` (NL-ERR01), silently swallowed
+  ``except Exception`` (NL-ERR02), mutable default args (NL-ERR03).
+* **Timing** — wall-clock ``time.time()`` used for durations (NL-TM01).
+
+Run ``python -m nornicdb_tpu.tools.nornlint nornicdb_tpu`` or ``make lint``.
+Suppress a single finding with ``# nornlint: disable=RULE`` on the flagged
+line; freeze legacy findings in ``tools/nornlint_baseline.json`` (regenerate
+with ``--update-baseline``).  See ``docs/linting.md``.
+"""
+
+from .core import Finding, ModuleContext, Rule, RULES, lint_paths, lint_source
+from .baseline import Baseline, diff_against_baseline
+
+# Importing rules registers them with the RULES registry.
+from . import rules as _rules  # noqa: F401
+
+__all__ = [
+    "Finding",
+    "ModuleContext",
+    "Rule",
+    "RULES",
+    "lint_paths",
+    "lint_source",
+    "Baseline",
+    "diff_against_baseline",
+]

@@ -1,0 +1,98 @@
+"""Baseline handling: freeze legacy findings, fail only on new ones.
+
+The baseline is *count-based* per ``(file, rule)`` — robust to line drift
+from unrelated edits, while any net-new violation in a file still trips the
+gate.  ``--update-baseline`` rewrites the file from the current scan (counts
+only ever shrink on a healthy codebase; review the diff like any other).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+from pathlib import Path
+from typing import Iterable
+
+from .core import Finding
+
+BASELINE_VERSION = 1
+DEFAULT_BASELINE_RELPATH = Path("tools") / "nornlint_baseline.json"
+
+
+@dataclasses.dataclass
+class Baseline:
+    counts: dict[str, dict[str, int]]  # relpath -> rule -> frozen count
+
+    @classmethod
+    def empty(cls) -> "Baseline":
+        return cls(counts={})
+
+    @classmethod
+    def from_findings(cls, findings: Iterable[Finding]) -> "Baseline":
+        counts: dict[str, dict[str, int]] = {}
+        for f in findings:
+            counts.setdefault(f.path, {})[f.rule] = (
+                counts.get(f.path, {}).get(f.rule, 0) + 1
+            )
+        return cls(counts=counts)
+
+    @classmethod
+    def load(cls, path: Path) -> "Baseline":
+        data = json.loads(path.read_text(encoding="utf-8"))
+        if data.get("version") != BASELINE_VERSION:
+            raise ValueError(
+                f"unsupported baseline version {data.get('version')!r} in {path}"
+            )
+        counts = {
+            str(file): {str(r): int(n) for r, n in rules.items()}
+            for file, rules in data.get("counts", {}).items()
+        }
+        return cls(counts=counts)
+
+    def save(self, path: Path) -> None:
+        payload = {
+            "version": BASELINE_VERSION,
+            "_comment": (
+                "Frozen legacy nornlint findings (count per file+rule). "
+                "New violations beyond these counts fail the lint gate. "
+                "Regenerate with: python -m nornicdb_tpu.tools.nornlint "
+                "nornicdb_tpu --update-baseline"
+            ),
+            "counts": {
+                file: dict(sorted(rules.items()))
+                for file, rules in sorted(self.counts.items())
+            },
+        }
+        path.parent.mkdir(parents=True, exist_ok=True)
+        path.write_text(json.dumps(payload, indent=2) + "\n", encoding="utf-8")
+
+    def allowance(self, path: str, rule: str) -> int:
+        return self.counts.get(path, {}).get(rule, 0)
+
+    def total(self) -> int:
+        return sum(n for rules in self.counts.values() for n in rules.values())
+
+
+def diff_against_baseline(
+    findings: list[Finding], baseline: Baseline
+) -> tuple[list[Finding], int]:
+    """(findings exceeding the baseline, count of baselined findings).
+
+    When a (file, rule) bucket holds more findings than its frozen count,
+    the surplus is reported from the bottom of the file — newly added code
+    is more often *appended* than prepended, so this usually points at the
+    new site; either way the count is exact and the gate trips.
+    """
+    by_key: dict[tuple[str, str], list[Finding]] = {}
+    for f in findings:
+        by_key.setdefault((f.path, f.rule), []).append(f)
+    new: list[Finding] = []
+    baselined = 0
+    for (path, rule), bucket in by_key.items():
+        allowed = baseline.allowance(path, rule)
+        bucket.sort(key=lambda f: (f.line, f.col))
+        baselined += min(allowed, len(bucket))
+        if len(bucket) > allowed:
+            new.extend(bucket[allowed:])
+    new.sort(key=lambda f: (f.path, f.line, f.col, f.rule))
+    return new, baselined

@@ -1,0 +1,138 @@
+"""nornlint command line: ``python -m nornicdb_tpu.tools.nornlint [paths]``.
+
+Exit codes: 0 clean (or fully baselined), 1 new findings, 2 usage error.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+from pathlib import Path
+from typing import Optional, Sequence
+
+from .baseline import Baseline, DEFAULT_BASELINE_RELPATH, diff_against_baseline
+from .core import RULES, find_repo_root, iter_py_files, lint_paths, relpath_for
+
+
+def _default_baseline(root: Path) -> Path:
+    return root / DEFAULT_BASELINE_RELPATH
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="nornlint",
+        description="NornicDB-TPU project-native static analysis "
+        "(JAX hot paths, concurrency, error hygiene).",
+    )
+    p.add_argument("paths", nargs="*", default=["nornicdb_tpu"],
+                   help="files or directories to lint (default: nornicdb_tpu)")
+    p.add_argument("--baseline", type=Path, default=None,
+                   help="baseline JSON (default: <repo>/tools/nornlint_baseline.json)")
+    p.add_argument("--no-baseline", action="store_true",
+                   help="report every finding, ignoring any baseline file")
+    p.add_argument("--update-baseline", action="store_true",
+                   help="rewrite the baseline from this scan and exit 0")
+    p.add_argument("--select", default=None,
+                   help="comma-separated rule ids to run (default: all)")
+    p.add_argument("--format", choices=("text", "json"), default="text")
+    p.add_argument("--list-rules", action="store_true")
+    p.add_argument("--quiet", action="store_true",
+                   help="suppress the per-finding lines, print the summary only")
+    return p
+
+
+def main(argv: Optional[Sequence[str]] = None) -> int:
+    args = build_parser().parse_args(argv)
+
+    if args.list_rules:
+        for rule in sorted(RULES.values(), key=lambda r: r.id):
+            print(f"{rule.id:10} [{rule.severity:7}] {rule.description}")
+        return 0
+
+    paths = [Path(p) for p in args.paths]
+    missing = [p for p in paths if not p.exists()]
+    if missing:
+        print(f"nornlint: no such path: {', '.join(map(str, missing))}",
+              file=sys.stderr)
+        return 2
+
+    select = None
+    if args.select:
+        if args.update_baseline:
+            # a rule-subset scan would clobber the scanned files' frozen
+            # counts for every other rule; the merge below is per-file only
+            print("nornlint: --select cannot be combined with "
+                  "--update-baseline", file=sys.stderr)
+            return 2
+        select = {r.strip() for r in args.select.split(",") if r.strip()}
+        unknown = select - set(RULES)
+        if unknown:
+            print(f"nornlint: unknown rule(s): {', '.join(sorted(unknown))}",
+                  file=sys.stderr)
+            return 2
+
+    common = Path(os.path.commonpath([p.resolve() for p in paths]))
+    root = find_repo_root(common)
+    findings = lint_paths(paths, root=root, select=select)
+
+    baseline_path = args.baseline or _default_baseline(root)
+    if args.update_baseline:
+        updated = Baseline.from_findings(findings)
+        if baseline_path.exists():
+            # partial scan: refresh only the scanned files' counts — frozen
+            # allowances for everything outside `paths` must survive, or a
+            # scoped cleanup run would resurrect every other legacy finding
+            try:
+                old = Baseline.load(baseline_path)
+            except (ValueError, json.JSONDecodeError) as e:
+                print(f"nornlint: bad baseline {baseline_path}: {e}",
+                      file=sys.stderr)
+                return 2
+            scanned = {relpath_for(f, root) for f in iter_py_files(paths)}
+            merged = {
+                p: dict(r) for p, r in old.counts.items()
+                if p not in scanned and (root / p).exists()  # prune deleted
+            }
+            merged.update(updated.counts)
+            updated = Baseline(counts=merged)
+        updated.save(baseline_path)
+        print(f"nornlint: baseline written to {baseline_path} "
+              f"({updated.total()} finding(s) frozen)")
+        return 0
+
+    baseline = Baseline.empty()
+    if not args.no_baseline and baseline_path.exists():
+        try:
+            baseline = Baseline.load(baseline_path)
+        except (ValueError, json.JSONDecodeError) as e:
+            print(f"nornlint: bad baseline {baseline_path}: {e}", file=sys.stderr)
+            return 2
+
+    new, baselined = diff_against_baseline(findings, baseline)
+
+    if args.format == "json":
+        print(json.dumps(
+            {
+                "new": [f.__dict__ for f in new],
+                "baselined": baselined,
+                "total": len(findings),
+            },
+            indent=2,
+        ))
+    else:
+        if not args.quiet:
+            for f in new:
+                print(f.format())
+        errors = sum(1 for f in new if f.severity == "error")
+        print(
+            f"nornlint: {len(new)} new finding(s) "
+            f"({errors} error(s)), {baselined} baselined, "
+            f"{len(findings)} total"
+        )
+    return 1 if new else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,204 @@
+"""nornlint core: rule registry, module context, suppressions, drivers.
+
+Stdlib only — the linter must be runnable in any environment the package
+itself runs in (CI images, TPU pods, dev laptops) with no extra installs.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import re
+from pathlib import Path
+from typing import Callable, Iterable, Iterator, Optional
+
+_SUPPRESS_RE = re.compile(r"#\s*nornlint:\s*disable=([A-Z0-9,\-\s]+)")
+_SUPPRESS_FILE_RE = re.compile(r"#\s*nornlint:\s*disable-file=([A-Z0-9,\-\s]+)")
+
+
+@dataclasses.dataclass(frozen=True)
+class Finding:
+    rule: str
+    severity: str  # "error" | "warning"
+    path: str  # repo-relative posix path
+    line: int
+    col: int
+    message: str
+
+    def format(self) -> str:
+        return f"{self.path}:{self.line}:{self.col}: {self.rule} [{self.severity}] {self.message}"
+
+
+class ModuleContext:
+    """One parsed module plus everything rules need to inspect it."""
+
+    def __init__(self, relpath: str, source: str, tree: ast.Module):
+        self.relpath = relpath
+        self.source = source
+        self.lines = source.splitlines()
+        self.tree = tree
+        self.parents: dict[ast.AST, ast.AST] = {}
+        for node in ast.walk(tree):
+            for child in ast.iter_child_nodes(node):
+                self.parents[child] = node
+        self.line_suppressions: dict[int, set[str]] = {}
+        self.file_suppressions: set[str] = set()
+        for lineno, text in enumerate(self.lines, start=1):
+            m = _SUPPRESS_FILE_RE.search(text)
+            if m:
+                self.file_suppressions |= _split_rules(m.group(1))
+                continue
+            m = _SUPPRESS_RE.search(text)
+            if m:
+                self.line_suppressions[lineno] = _split_rules(m.group(1))
+        self.imports: set[str] = set()
+        for node in ast.walk(tree):
+            if isinstance(node, ast.Import):
+                self.imports |= {a.name.split(".")[0] for a in node.names}
+            elif isinstance(node, ast.ImportFrom) and node.module:
+                self.imports.add(node.module.split(".")[0])
+
+    def ancestors(self, node: ast.AST) -> Iterator[ast.AST]:
+        cur = self.parents.get(node)
+        while cur is not None:
+            yield cur
+            cur = self.parents.get(cur)
+
+    def is_suppressed(self, rule: str, line: int) -> bool:
+        if rule in self.file_suppressions or "ALL" in self.file_suppressions:
+            return True
+        for probe in (line, line - 1):  # flagged line or the line above it
+            rules = self.line_suppressions.get(probe)
+            if rules and (rule in rules or "ALL" in rules):
+                return True
+        return False
+
+    def finding(self, rule: "Rule", node: ast.AST, message: str) -> Finding:
+        return Finding(
+            rule=rule.id,
+            severity=rule.severity,
+            path=self.relpath,
+            line=getattr(node, "lineno", 1),
+            col=getattr(node, "col_offset", 0),
+            message=message,
+        )
+
+
+def _split_rules(spec: str) -> set[str]:
+    return {r.strip() for r in spec.split(",") if r.strip()}
+
+
+@dataclasses.dataclass(frozen=True)
+class Rule:
+    id: str
+    severity: str
+    description: str
+    check: Callable[[ModuleContext], Iterable[Finding]]
+
+
+RULES: dict[str, Rule] = {}
+
+
+def register(rule_id: str, severity: str, description: str):
+    """Decorator: register ``check(ctx)`` under ``rule_id``."""
+
+    def deco(fn: Callable[[ModuleContext], Iterable[Finding]]) -> Rule:
+        rule = Rule(id=rule_id, severity=severity, description=description, check=fn)
+        if rule_id in RULES:
+            raise ValueError(f"duplicate nornlint rule id {rule_id}")
+        RULES[rule_id] = rule
+        return rule
+
+    return deco
+
+
+def dotted_name(node: ast.AST) -> Optional[str]:
+    """``a.b.c`` for Name/Attribute chains, else None."""
+    parts: list[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+def lint_source(
+    source: str,
+    relpath: str = "<string>",
+    select: Optional[set[str]] = None,
+) -> list[Finding]:
+    """Lint one module's source text; used by the CLI and the self-tests."""
+    try:
+        tree = ast.parse(source, filename=relpath)
+    except SyntaxError as e:
+        return [
+            Finding(
+                rule="NL-SYNTAX",
+                severity="error",
+                path=relpath,
+                line=e.lineno or 1,
+                col=e.offset or 0,
+                message=f"syntax error: {e.msg}",
+            )
+        ]
+    ctx = ModuleContext(relpath, source, tree)
+    findings: list[Finding] = []
+    for rule in RULES.values():
+        if select is not None and rule.id not in select:
+            continue
+        for f in rule.check(ctx):
+            if not ctx.is_suppressed(f.rule, f.line):
+                findings.append(f)
+    # Finding is frozen/hashable: dedupe identical hits from overlapping scans
+    findings = sorted(set(findings), key=lambda f: (f.path, f.line, f.col, f.rule))
+    return findings
+
+
+def iter_py_files(paths: Iterable[Path]) -> Iterator[Path]:
+    for p in paths:
+        if p.is_dir():
+            yield from sorted(p.rglob("*.py"))
+        elif p.suffix == ".py":
+            yield p
+
+
+def relpath_for(path: Path, root: Path) -> str:
+    resolved = path.resolve()
+    try:
+        return resolved.relative_to(root).as_posix()
+    except ValueError:
+        return resolved.as_posix()
+
+
+def lint_paths(
+    paths: Iterable[Path],
+    root: Optional[Path] = None,
+    select: Optional[set[str]] = None,
+) -> list[Finding]:
+    """Lint files/trees; finding paths are reported relative to ``root``."""
+    root = (root or Path.cwd()).resolve()
+    findings: list[Finding] = []
+    for path in iter_py_files(paths):
+        rel = relpath_for(path, root)
+        try:
+            source = path.read_text(encoding="utf-8")
+        except (OSError, UnicodeDecodeError) as e:
+            findings.append(
+                Finding("NL-IO", "error", rel, 1, 0, f"unreadable: {e}")
+            )
+            continue
+        findings.extend(lint_source(source, rel, select=select))
+    return findings
+
+
+def find_repo_root(start: Path) -> Path:
+    """Nearest ancestor holding pyproject.toml or .git; else ``start``."""
+    cur = start.resolve()
+    if cur.is_file():
+        cur = cur.parent
+    for candidate in (cur, *cur.parents):
+        if (candidate / "pyproject.toml").exists() or (candidate / ".git").exists():
+            return candidate
+    return cur

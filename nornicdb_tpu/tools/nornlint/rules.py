@@ -1,0 +1,538 @@
+"""nornlint rule set — NornicDB-TPU's machine-checked invariants.
+
+Each rule is a generator over one :class:`ModuleContext`.  Rules are
+heuristic by design: a false positive is silenced with
+``# nornlint: disable=RULE`` on the offending line, or frozen in the
+baseline; the payoff is that the *true* positives — a host sync inside a
+jit, a lock leaked on an exception path, an error swallowed with no trace —
+fail CI instead of shipping.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator, Optional
+
+from .core import Finding, ModuleContext, dotted_name, register
+
+# ---------------------------------------------------------------------------
+# JAX helpers
+# ---------------------------------------------------------------------------
+
+_JIT_NAMES = {"jit", "jax.jit", "pjit", "jax.pjit"}
+_HOST_SYNC_METHODS = {"item", "tolist", "block_until_ready"}
+_HOST_SYNC_BUILTINS = {"float", "int", "bool", "complex"}
+_NUMPY_ROOTS = {"np", "numpy", "onp"}
+_MUTATOR_METHODS = {
+    "append", "add", "update", "pop", "popitem", "setdefault", "extend",
+    "insert", "remove", "discard", "clear", "appendleft",
+}
+_LOG_METHODS = {
+    "debug", "info", "warning", "warn", "error", "exception", "critical", "log",
+}
+
+
+def _is_jit_decorator(dec: ast.expr) -> bool:
+    """True for @jit, @jax.jit, @jax.jit(...), @functools.partial(jax.jit, ...)."""
+    if isinstance(dec, ast.Call):
+        name = dotted_name(dec.func)
+        if name in _JIT_NAMES:
+            return True
+        if name in {"functools.partial", "partial"} and dec.args:
+            return dotted_name(dec.args[0]) in _JIT_NAMES
+        return False
+    return dotted_name(dec) in _JIT_NAMES
+
+
+def _jit_functions(ctx: ModuleContext) -> list[ast.AST]:
+    """All FunctionDefs decorated with a jit variant (sync or async)."""
+    out = []
+    for node in ast.walk(ctx.tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            if any(_is_jit_decorator(d) for d in node.decorator_list):
+                out.append(node)
+    return out
+
+
+def _is_literal_spec(node: ast.expr) -> bool:
+    """str/int constant, or tuple/list of them — a stable jit cache key."""
+    if isinstance(node, ast.Constant):
+        return isinstance(node.value, (str, int))
+    if isinstance(node, (ast.Tuple, ast.List)):
+        return all(
+            isinstance(e, ast.Constant) and isinstance(e.value, (str, int))
+            for e in node.elts
+        )
+    return False
+
+
+def _jit_static_argnames(dec: ast.expr) -> Optional[set[str]]:
+    """Literal static_argnames of a jit decorator call, if extractable."""
+    if not isinstance(dec, ast.Call):
+        return None
+    for kw in dec.keywords:
+        if kw.arg == "static_argnames":
+            names: set[str] = set()
+            values = (
+                kw.value.elts
+                if isinstance(kw.value, (ast.Tuple, ast.List))
+                else [kw.value]
+            )
+            for v in values:
+                if isinstance(v, ast.Constant) and isinstance(v.value, str):
+                    names.add(v.value)
+                else:
+                    return None  # non-literal: NL-JAX03 flags the decorator itself
+            return names
+    return None
+
+
+# ---------------------------------------------------------------------------
+# NL-JAX01 — host syncs inside jit
+# ---------------------------------------------------------------------------
+
+@register(
+    "NL-JAX01",
+    "error",
+    "host sync (float()/.item()/np.asarray/...) inside a @jit-compiled function",
+)
+def nl_jax01(ctx: ModuleContext) -> Iterator[Finding]:
+    rule = nl_jax01
+    for fn in _jit_functions(ctx):
+        for node in ast.walk(fn):
+            if not isinstance(node, ast.Call):
+                continue
+            func = node.func
+            if isinstance(func, ast.Name) and func.id in _HOST_SYNC_BUILTINS:
+                yield ctx.finding(
+                    rule, node,
+                    f"{func.id}() on a traced value inside jit forces a host "
+                    "sync (or a ConcretizationTypeError); keep values on "
+                    "device or hoist the conversion out of the jit boundary",
+                )
+            elif isinstance(func, ast.Attribute):
+                name = dotted_name(func)
+                if func.attr in _HOST_SYNC_METHODS:
+                    yield ctx.finding(
+                        rule, node,
+                        f".{func.attr}() inside jit blocks on device->host "
+                        "transfer; return the array and convert at the caller",
+                    )
+                elif (
+                    name
+                    and name.split(".")[0] in _NUMPY_ROOTS
+                    and func.attr in {"asarray", "array"}
+                ):
+                    yield ctx.finding(
+                        rule, node,
+                        f"{name}() inside jit materialises the array on host; "
+                        "use jnp equivalents inside compiled code",
+                    )
+
+
+# ---------------------------------------------------------------------------
+# NL-JAX02 — Python loops over jnp arrays
+# ---------------------------------------------------------------------------
+
+def _mentions_jnp(node: ast.AST) -> bool:
+    for sub in ast.walk(node):
+        if isinstance(sub, ast.Name) and sub.id == "jnp":
+            return True
+    return False
+
+
+@register(
+    "NL-JAX02",
+    "warning",
+    "Python for-loop iterating a jnp array (one dispatch per element)",
+)
+def nl_jax02(ctx: ModuleContext) -> Iterator[Finding]:
+    for node in ast.walk(ctx.tree):
+        if isinstance(node, (ast.For, ast.AsyncFor)) and _mentions_jnp(node.iter):
+            yield ctx.finding(
+                nl_jax02, node,
+                "iterating a jnp array in Python dispatches one op per "
+                "element; vectorise (jnp ops / vmap) or use lax.fori_loop",
+            )
+
+
+# ---------------------------------------------------------------------------
+# NL-JAX03 — static args that defeat the jit cache
+# ---------------------------------------------------------------------------
+
+@register(
+    "NL-JAX03",
+    "warning",
+    "jit static args that are unhashable or formatted per call (recompile each call)",
+)
+def nl_jax03(ctx: ModuleContext) -> Iterator[Finding]:
+    rule = nl_jax03
+    # Map jit-decorated function name -> literal static_argnames.
+    static_by_fn: dict[str, set[str]] = {}
+    for fn in _jit_functions(ctx):
+        for dec in fn.decorator_list:
+            if not (_is_jit_decorator(dec) and isinstance(dec, ast.Call)):
+                continue
+            # partial(jax.jit, ...) keeps its kwargs on the partial call
+            for kw in dec.keywords:
+                if kw.arg in {"static_argnames", "static_argnums"} and not _is_literal_spec(kw.value):
+                    yield ctx.finding(
+                        rule, kw.value,
+                        f"{kw.arg} should be a literal str/int/tuple so the "
+                        "jit cache key is stable across calls",
+                    )
+            names = _jit_static_argnames(dec)
+            if names:
+                static_by_fn[fn.name] = names
+    if not static_by_fn:
+        return
+    for node in ast.walk(ctx.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        callee = dotted_name(node.func)
+        statics = static_by_fn.get(callee or "")
+        if not statics:
+            continue
+        for kw in node.keywords:
+            if kw.arg not in statics:
+                continue
+            v = kw.value
+            bad = None
+            if isinstance(v, (ast.List, ast.Dict, ast.Set)):
+                bad = "an unhashable literal"
+            elif isinstance(v, ast.JoinedStr):
+                bad = "an f-string (new cache key per distinct string)"
+            elif (
+                isinstance(v, ast.Call)
+                and isinstance(v.func, ast.Name)
+                and v.func.id in {"str", "repr", "format"}
+            ):
+                bad = "a per-call formatted string"
+            if bad:
+                yield ctx.finding(
+                    rule, kw.value,
+                    f"static arg '{kw.arg}' of {callee}() is {bad}; every "
+                    "distinct value compiles a fresh executable",
+                )
+
+
+# ---------------------------------------------------------------------------
+# NL-CC01 — lock acquired without with / try-finally
+# ---------------------------------------------------------------------------
+
+def _release_targets(stmts: list[ast.stmt]) -> set[str]:
+    out: set[str] = set()
+    for stmt in stmts:
+        for node in ast.walk(stmt):
+            if (
+                isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Attribute)
+                and node.func.attr == "release"
+            ):
+                name = dotted_name(node.func.value)
+                if name:
+                    out.add(name)
+    return out
+
+
+@register(
+    "NL-CC01",
+    "error",
+    "Lock.acquire() without `with` or a try/finally release (leaks on exception)",
+)
+def nl_cc01(ctx: ModuleContext) -> Iterator[Finding]:
+    for node in ast.walk(ctx.tree):
+        if not (
+            isinstance(node, ast.Call)
+            and isinstance(node.func, ast.Attribute)
+            and node.func.attr == "acquire"
+        ):
+            continue
+        receiver = dotted_name(node.func.value)
+        if receiver is None:
+            continue
+        # threading's acquire() only takes blocking/timeout (bools/numbers);
+        # a string or arbitrary positional arg means some other .acquire()
+        # protocol (e.g. a registry), not a lock
+        if any(
+            not (isinstance(a, ast.Constant) and isinstance(a.value, (bool, int, float)))
+            for a in node.args
+        ) or any(kw.arg not in {"blocking", "timeout"} for kw in node.keywords):
+            continue
+        covered = False
+        # (a) an enclosing try whose finally releases the same receiver
+        for anc in ctx.ancestors(node):
+            if isinstance(anc, ast.Try) and receiver in _release_targets(anc.finalbody):
+                covered = True
+                break
+        # (b) `lock.acquire()` immediately followed by such a try, either as
+        # the next sibling statement (`x = l.acquire(); try: ... finally:`)
+        # or as the first statement of an `if l.acquire(...):` body
+        if not covered:
+            stmt: ast.AST = node
+            parent = ctx.parents.get(stmt)
+            while parent is not None and not isinstance(stmt, ast.stmt):
+                stmt, parent = parent, ctx.parents.get(parent)
+            candidates: list[ast.stmt] = []
+            if isinstance(stmt, (ast.If, ast.While)) and stmt.body:
+                candidates.append(stmt.body[0])
+            if isinstance(stmt, ast.stmt) and parent is not None:
+                for field in ("body", "orelse", "finalbody"):
+                    body = getattr(parent, field, None)
+                    if isinstance(body, list) and stmt in body:
+                        after = body[body.index(stmt) + 1:]
+                        if after:
+                            candidates.append(after[0])
+            covered = any(
+                isinstance(c, ast.Try) and receiver in _release_targets(c.finalbody)
+                for c in candidates
+            )
+        if not covered:
+            yield ctx.finding(
+                nl_cc01, node,
+                f"{receiver}.acquire() is not paired with a try/finally "
+                "release; an exception between acquire and release deadlocks "
+                "every other thread — use `with` or try/finally",
+            )
+
+
+# ---------------------------------------------------------------------------
+# NL-CC02 — unlocked mutation of module-level mutable state
+# ---------------------------------------------------------------------------
+
+_MUTABLE_FACTORY = {
+    "list", "dict", "set", "defaultdict", "OrderedDict", "deque", "Counter",
+}
+_LOCK_FACTORY = {"Lock", "RLock", "Condition", "Semaphore", "BoundedSemaphore"}
+
+
+def _module_level_state(ctx: ModuleContext) -> tuple[set[str], set[str]]:
+    """(mutable global names, lock global names) bound at module top level."""
+    mutables: set[str] = set()
+    locks: set[str] = set()
+    for stmt in ctx.tree.body:
+        if not isinstance(stmt, (ast.Assign, ast.AnnAssign)):
+            continue
+        targets = stmt.targets if isinstance(stmt, ast.Assign) else [stmt.target]
+        value = stmt.value
+        if value is None:
+            continue
+        names = [t.id for t in targets if isinstance(t, ast.Name)]
+        if not names:
+            continue
+        if isinstance(value, (ast.List, ast.Dict, ast.Set)):
+            mutables.update(names)
+        elif isinstance(value, ast.Call):
+            callee = dotted_name(value.func) or ""
+            leaf = callee.split(".")[-1]
+            if leaf in _MUTABLE_FACTORY:
+                mutables.update(names)
+            elif leaf in _LOCK_FACTORY:
+                locks.update(names)
+    return mutables, locks
+
+
+def _under_lock(ctx: ModuleContext, node: ast.AST, locks: set[str]) -> bool:
+    for anc in ctx.ancestors(node):
+        if isinstance(anc, (ast.With, ast.AsyncWith)):
+            for item in anc.items:
+                expr = item.context_expr
+                if isinstance(expr, ast.Call):
+                    expr = expr.func
+                name = dotted_name(expr) or ""
+                leaf = name.split(".")[-1].lower()
+                if name in locks or "lock" in leaf or "mutex" in leaf:
+                    return True
+    return False
+
+
+@register(
+    "NL-CC02",
+    "warning",
+    "module-level mutable state mutated outside a lock in a threading module",
+)
+def nl_cc02(ctx: ModuleContext) -> Iterator[Finding]:
+    if "threading" not in ctx.imports:
+        return
+    mutables, locks = _module_level_state(ctx)
+    if not mutables:
+        return
+    for fn in ast.walk(ctx.tree):
+        if not isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        for node in ast.walk(fn):
+            target_name: Optional[str] = None
+            if (
+                isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Attribute)
+                and node.func.attr in _MUTATOR_METHODS
+                and isinstance(node.func.value, ast.Name)
+            ):
+                target_name = node.func.value.id
+            elif isinstance(node, (ast.Assign, ast.AugAssign, ast.Delete)):
+                targets = (
+                    node.targets if isinstance(node, ast.Assign)
+                    else node.targets if isinstance(node, ast.Delete)
+                    else [node.target]
+                )
+                for t in targets:
+                    if isinstance(t, ast.Subscript) and isinstance(t.value, ast.Name):
+                        target_name = t.value.id
+            if target_name in mutables and not _under_lock(ctx, node, locks):
+                yield ctx.finding(
+                    nl_cc02, node,
+                    f"module global '{target_name}' is mutated without "
+                    "holding a lock in a module that spawns threads; guard "
+                    "the mutation or make the state thread-local",
+                )
+
+
+# ---------------------------------------------------------------------------
+# NL-ERR01 — bare except
+# ---------------------------------------------------------------------------
+
+@register("NL-ERR01", "error", "bare `except:` (catches SystemExit/KeyboardInterrupt)")
+def nl_err01(ctx: ModuleContext) -> Iterator[Finding]:
+    for node in ast.walk(ctx.tree):
+        if isinstance(node, ast.ExceptHandler) and node.type is None:
+            yield ctx.finding(
+                nl_err01, node,
+                "bare `except:` also catches SystemExit and "
+                "KeyboardInterrupt; catch Exception (or narrower) instead",
+            )
+
+
+# ---------------------------------------------------------------------------
+# NL-ERR02 — except Exception that swallows silently
+# ---------------------------------------------------------------------------
+
+def _handler_catches_broad(node: ast.ExceptHandler) -> bool:
+    types = (
+        node.type.elts if isinstance(node.type, ast.Tuple) else [node.type]
+        if node.type is not None else []
+    )
+    for t in types:
+        name = dotted_name(t) or ""
+        if name.split(".")[-1] in {"Exception", "BaseException"}:
+            return True
+    return False
+
+
+def _body_handles(node: ast.ExceptHandler) -> bool:
+    """True if the handler re-raises, logs, or otherwise uses the exception."""
+    bound = node.name
+    for sub in ast.walk(ast.Module(body=node.body, type_ignores=[])):
+        if isinstance(sub, ast.Raise):
+            return True
+        if bound and isinstance(sub, ast.Name) and sub.id == bound:
+            return True
+        if isinstance(sub, ast.Call):
+            func = sub.func
+            if isinstance(func, ast.Name) and func.id == "print":
+                return True
+            if isinstance(func, ast.Attribute):
+                chain = dotted_name(func) or func.attr
+                root = chain.split(".")[0]
+                if func.attr in _LOG_METHODS and root in {
+                    "log", "logger", "logging", "self", "cls", "_log", "_logger",
+                }:
+                    return True
+                if root in {"warnings", "traceback"}:
+                    return True
+    return False
+
+
+@register(
+    "NL-ERR02",
+    "warning",
+    "`except Exception` that swallows the error without logging or re-raising",
+)
+def nl_err02(ctx: ModuleContext) -> Iterator[Finding]:
+    for node in ast.walk(ctx.tree):
+        if (
+            isinstance(node, ast.ExceptHandler)
+            and _handler_catches_broad(node)
+            and not _body_handles(node)
+        ):
+            yield ctx.finding(
+                nl_err02, node,
+                "broad except swallows the error with no log/re-raise; "
+                "narrow the exception type, or log via the module logger "
+                "so operators can see the failure",
+            )
+
+
+# ---------------------------------------------------------------------------
+# NL-ERR03 — mutable default arguments
+# ---------------------------------------------------------------------------
+
+@register("NL-ERR03", "error", "mutable default argument (shared across calls)")
+def nl_err03(ctx: ModuleContext) -> Iterator[Finding]:
+    for fn in ast.walk(ctx.tree):
+        if not isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        defaults = list(fn.args.defaults) + [d for d in fn.args.kw_defaults if d]
+        for d in defaults:
+            bad = isinstance(d, (ast.List, ast.Dict, ast.Set)) or (
+                isinstance(d, ast.Call)
+                and (dotted_name(d.func) or "").split(".")[-1] in _MUTABLE_FACTORY
+                and not d.args
+                and not d.keywords
+            )
+            if bad:
+                yield ctx.finding(
+                    nl_err03, d,
+                    f"mutable default in {fn.name}() is evaluated once and "
+                    "shared by every call; default to None and create inside",
+                )
+
+
+# ---------------------------------------------------------------------------
+# NL-TM01 — wall-clock time used for durations
+# ---------------------------------------------------------------------------
+
+def _is_time_time(node: ast.AST) -> bool:
+    return isinstance(node, ast.Call) and dotted_name(node.func) == "time.time"
+
+
+def _walk_scope(body: list[ast.stmt]) -> Iterator[ast.AST]:
+    """Walk statements without descending into nested function scopes."""
+    _OWN_SCOPE = (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)
+    stack: list[ast.AST] = [n for n in body if not isinstance(n, _OWN_SCOPE)]
+    while stack:
+        node = stack.pop()
+        yield node
+        for child in ast.iter_child_nodes(node):
+            if not isinstance(child, _OWN_SCOPE):
+                stack.append(child)
+
+
+@register(
+    "NL-TM01",
+    "warning",
+    "time.time() used to measure a duration (wall clock is not monotonic)",
+)
+def nl_tm01(ctx: ModuleContext) -> Iterator[Finding]:
+    scopes: list[ast.AST] = [ctx.tree] + [
+        n for n in ast.walk(ctx.tree)
+        if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))
+    ]
+    for scope in scopes:
+        stamped: set[str] = set()
+        for node in _walk_scope(scope.body):
+            if isinstance(node, ast.Assign) and _is_time_time(node.value):
+                stamped |= {
+                    t.id for t in node.targets if isinstance(t, ast.Name)
+                }
+        for node in _walk_scope(scope.body):
+            if isinstance(node, ast.BinOp) and isinstance(node.op, ast.Sub):
+                operands = (node.left, node.right)
+                if any(_is_time_time(o) for o in operands) or any(
+                    isinstance(o, ast.Name) and o.id in stamped for o in operands
+                ):
+                    yield ctx.finding(
+                        nl_tm01, node,
+                        "duration computed from time.time(); NTP steps make "
+                        "wall clock jump — use time.perf_counter() (or "
+                        "time.monotonic()) for elapsed-time measurement",
+                    )

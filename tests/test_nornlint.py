@@ -1,0 +1,533 @@
+"""nornlint self-tests: known-bad / known-clean fixtures per rule,
+suppression and baseline mechanics, and the package-wide gate.
+
+These are tier-1: the lint gate failing here means a new violation landed
+without either a fix, an inline suppression, or a baseline regeneration.
+"""
+
+from __future__ import annotations
+
+import json
+import textwrap
+from pathlib import Path
+
+import pytest
+
+from nornicdb_tpu.tools.nornlint import (
+    Baseline,
+    RULES,
+    diff_against_baseline,
+    lint_paths,
+    lint_source,
+)
+from nornicdb_tpu.tools.nornlint.cli import main as nornlint_main
+
+REPO_ROOT = Path(__file__).resolve().parent.parent
+
+
+def findings_for(src: str, rule: str) -> list:
+    return [f for f in lint_source(textwrap.dedent(src)) if f.rule == rule]
+
+
+# ---------------------------------------------------------------------------
+# Per-rule fixtures: one known-bad and one known-clean each
+# ---------------------------------------------------------------------------
+
+BAD_CLEAN_FIXTURES = {
+    "NL-JAX01": (
+        """
+        import jax
+
+        @jax.jit
+        def step(x):
+            return float(x.sum()) + x.mean().item()
+        """,
+        """
+        import jax
+
+        @jax.jit
+        def step(x):
+            return x.sum() + x.mean()
+
+        def host_side(x):
+            return float(x.sum())  # outside jit: boundary conversion is fine
+        """,
+    ),
+    "NL-JAX02": (
+        """
+        import jax.numpy as jnp
+
+        def total(xs):
+            acc = 0.0
+            for row in jnp.stack(xs):
+                acc = acc + row
+            return acc
+        """,
+        """
+        import jax.numpy as jnp
+
+        def total(xs):
+            return jnp.stack(xs).sum(axis=0)
+        """,
+    ),
+    "NL-JAX03": (
+        """
+        import functools
+        import jax
+
+        @functools.partial(jax.jit, static_argnames=("mode",))
+        def run(x, mode):
+            return x
+
+        def caller(x, k):
+            return run(x, mode=f"mode-{k}")
+        """,
+        """
+        import functools
+        import jax
+
+        @functools.partial(jax.jit, static_argnames=("mode",))
+        def run(x, mode):
+            return x
+
+        def caller(x):
+            return run(x, mode="fast")
+        """,
+    ),
+    "NL-CC01": (
+        """
+        import threading
+
+        lock = threading.Lock()
+
+        def update(state):
+            lock.acquire()
+            state["n"] += 1
+            lock.release()
+        """,
+        """
+        import threading
+
+        lock = threading.Lock()
+
+        def update(state):
+            lock.acquire()
+            try:
+                state["n"] += 1
+            finally:
+                lock.release()
+
+        def update2(state):
+            with lock:
+                state["n"] += 1
+        """,
+    ),
+    "NL-CC02": (
+        """
+        import threading
+
+        _registry = {}
+        _lock = threading.Lock()
+
+        def add(name, value):
+            _registry[name] = value
+        """,
+        """
+        import threading
+
+        _registry = {}
+        _lock = threading.Lock()
+
+        def add(name, value):
+            with _lock:
+                _registry[name] = value
+        """,
+    ),
+    "NL-ERR01": (
+        """
+        def load(path):
+            try:
+                return open(path).read()
+            except:
+                return None
+        """,
+        """
+        def load(path):
+            try:
+                return open(path).read()
+            except OSError:
+                return None
+        """,
+    ),
+    "NL-ERR02": (
+        """
+        def probe(fn):
+            try:
+                return fn()
+            except Exception:
+                return None
+        """,
+        """
+        import logging
+
+        log = logging.getLogger(__name__)
+
+        def probe(fn):
+            try:
+                return fn()
+            except Exception:
+                log.warning("probe failed", exc_info=True)
+                return None
+        """,
+    ),
+    "NL-ERR03": (
+        """
+        def collect(item, acc=[]):
+            acc.append(item)
+            return acc
+        """,
+        """
+        def collect(item, acc=None):
+            if acc is None:
+                acc = []
+            acc.append(item)
+            return acc
+        """,
+    ),
+    "NL-TM01": (
+        """
+        import time
+
+        def timed(fn):
+            t0 = time.time()
+            fn()
+            return time.time() - t0
+        """,
+        """
+        import time
+
+        def timed(fn):
+            t0 = time.perf_counter()
+            fn()
+            return time.perf_counter() - t0
+
+        def stamp():
+            return time.time()  # absolute timestamps are wall-clock's job
+        """,
+    ),
+}
+
+
+@pytest.mark.parametrize("rule", sorted(BAD_CLEAN_FIXTURES))
+def test_rule_flags_known_bad(rule):
+    bad, _ = BAD_CLEAN_FIXTURES[rule]
+    assert findings_for(bad, rule), f"{rule} missed its known-bad fixture"
+
+
+@pytest.mark.parametrize("rule", sorted(BAD_CLEAN_FIXTURES))
+def test_rule_passes_known_clean(rule):
+    _, clean = BAD_CLEAN_FIXTURES[rule]
+    hits = findings_for(clean, rule)
+    assert not hits, f"{rule} false-positived on its clean fixture: {hits}"
+
+
+def test_every_registered_rule_has_fixtures():
+    assert set(BAD_CLEAN_FIXTURES) == set(RULES), (
+        "every rule needs a known-bad/known-clean fixture pair"
+    )
+
+
+def test_at_least_six_rules_across_all_three_families():
+    assert len(RULES) >= 6
+    prefixes = {r.removeprefix("NL-")[:3] for r in RULES}
+    assert {"JAX", "CC0", "ERR"} <= prefixes
+
+
+# ---------------------------------------------------------------------------
+# Rule edge cases worth pinning
+# ---------------------------------------------------------------------------
+
+def test_cc01_if_acquire_with_following_try_is_clean():
+    src = """
+    import threading
+
+    lock = threading.Lock()
+
+    def update(state):
+        if lock.acquire(timeout=1.0):
+            try:
+                state["n"] += 1
+            finally:
+                lock.release()
+    """
+    assert not findings_for(src, "NL-CC01")
+
+
+def test_cc01_ignores_non_lock_acquire_protocols():
+    src = """
+    def pick(registry, model):
+        return registry.acquire(model)
+    """
+    assert not findings_for(src, "NL-CC01")
+
+
+def test_err02_reraise_and_named_use_are_clean():
+    src = """
+    def a(fn):
+        try:
+            return fn()
+        except Exception:
+            raise RuntimeError("wrapped")
+
+    def b(fn):
+        try:
+            return fn()
+        except Exception as e:
+            return {"error": str(e)}
+    """
+    assert not findings_for(src, "NL-ERR02")
+
+
+def test_jax01_partial_jit_and_bare_jit_names_detected():
+    src = """
+    from functools import partial
+    from jax import jit
+
+    @partial(jit, static_argnames=("k",))
+    def top(x, k):
+        return float(x.max())
+    """
+    assert findings_for(src, "NL-JAX01")
+
+
+def test_jax03_literal_static_argnums_is_clean():
+    src = """
+    import functools
+    import jax
+
+    @functools.partial(jax.jit, static_argnums=(1,))
+    def run(x, k):
+        return x
+    """
+    assert not findings_for(src, "NL-JAX03")
+
+
+def test_syntax_error_reported_not_raised():
+    out = lint_source("def broken(:\n")
+    assert [f.rule for f in out] == ["NL-SYNTAX"]
+
+
+# ---------------------------------------------------------------------------
+# Suppressions
+# ---------------------------------------------------------------------------
+
+def test_inline_suppression_same_line():
+    src = """
+    def load(path):
+        try:
+            return open(path).read()
+        except:  # nornlint: disable=NL-ERR01
+            return None
+    """
+    assert not findings_for(src, "NL-ERR01")
+
+
+def test_inline_suppression_line_above():
+    src = """
+    def load(path):
+        try:
+            return open(path).read()
+        # nornlint: disable=NL-ERR01
+        except:
+            return None
+    """
+    assert not findings_for(src, "NL-ERR01")
+
+
+def test_file_level_suppression():
+    src = """
+    # nornlint: disable-file=NL-ERR01
+
+    def a(path):
+        try:
+            return open(path).read()
+        except:
+            return None
+
+    def b(path):
+        try:
+            return open(path).read()
+        except:
+            return None
+    """
+    assert not findings_for(src, "NL-ERR01")
+
+
+def test_suppression_is_rule_specific():
+    src = """
+    def load(path, acc=[]):  # nornlint: disable=NL-ERR01
+        acc.append(path)
+        return acc
+    """
+    assert findings_for(src, "NL-ERR03"), "unrelated rule must still fire"
+
+
+# ---------------------------------------------------------------------------
+# Baseline mechanics
+# ---------------------------------------------------------------------------
+
+BAD_MODULE = textwrap.dedent(
+    """
+    def probe(fn):
+        try:
+            return fn()
+        except Exception:
+            return None
+    """
+)
+
+
+def test_baseline_freezes_then_fails_on_new_violation(tmp_path):
+    mod = tmp_path / "pkg" / "m.py"
+    mod.parent.mkdir()
+    mod.write_text(BAD_MODULE)
+
+    findings = lint_paths([mod.parent], root=tmp_path)
+    assert [f.rule for f in findings] == ["NL-ERR02"]
+
+    frozen = Baseline.from_findings(findings)
+    new, baselined = diff_against_baseline(findings, frozen)
+    assert new == [] and baselined == 1
+
+    # a second violation in the same file exceeds the frozen count
+    mod.write_text(BAD_MODULE + BAD_MODULE.replace("probe", "probe2"))
+    findings2 = lint_paths([mod.parent], root=tmp_path)
+    new2, _ = diff_against_baseline(findings2, frozen)
+    assert len(new2) == 1 and new2[0].rule == "NL-ERR02"
+
+
+def test_baseline_roundtrip(tmp_path):
+    path = tmp_path / "baseline.json"
+    b = Baseline(counts={"a.py": {"NL-ERR02": 2}})
+    b.save(path)
+    loaded = Baseline.load(path)
+    assert loaded.counts == b.counts
+    assert loaded.total() == 2
+
+
+def test_cli_exit_codes_with_baseline(tmp_path):
+    mod = tmp_path / "pkg" / "m.py"
+    mod.parent.mkdir()
+    mod.write_text(BAD_MODULE)
+    baseline = tmp_path / "baseline.json"
+
+    # no baseline: the finding is new -> exit 1
+    assert nornlint_main([str(mod.parent), "--baseline", str(baseline),
+                          "--quiet"]) == 1
+    # freeze it -> exit 0
+    assert nornlint_main([str(mod.parent), "--baseline", str(baseline),
+                          "--update-baseline"]) == 0
+    assert nornlint_main([str(mod.parent), "--baseline", str(baseline),
+                          "--quiet"]) == 0
+    # introduce a NEW violation -> exit 1 again
+    mod.write_text(BAD_MODULE + "\n\ndef f(x, acc=[]):\n    return acc\n")
+    assert nornlint_main([str(mod.parent), "--baseline", str(baseline),
+                          "--quiet"]) == 1
+
+
+def test_cli_usage_errors(tmp_path):
+    assert nornlint_main([str(tmp_path / "nope")]) == 2
+    (tmp_path / "x.py").write_text("pass\n")
+    assert nornlint_main([str(tmp_path / "x.py"), "--select", "NL-BOGUS"]) == 2
+
+
+# ---------------------------------------------------------------------------
+# The package-wide gate (the actual CI guardrail)
+# ---------------------------------------------------------------------------
+
+def test_package_is_clean_against_checked_in_baseline():
+    rc = nornlint_main([
+        str(REPO_ROOT / "nornicdb_tpu"),
+        "--baseline", str(REPO_ROOT / "tools" / "nornlint_baseline.json"),
+        "--quiet",
+    ])
+    assert rc == 0, (
+        "new nornlint finding(s): run `make lint` for details; fix them, "
+        "suppress with `# nornlint: disable=RULE`, or regenerate the "
+        "baseline (docs/linting.md)"
+    )
+
+
+def test_checked_in_baseline_is_not_stale():
+    """Counts may only shrink via --update-baseline, never silently drift up;
+    a baseline entry larger than reality means someone fixed findings without
+    regenerating — keep the ratchet tight."""
+    baseline = Baseline.load(REPO_ROOT / "tools" / "nornlint_baseline.json")
+    findings = lint_paths([REPO_ROOT / "nornicdb_tpu"], root=REPO_ROOT)
+    current = Baseline.from_findings(findings)
+    slack = [
+        (path, rule, n, current.counts.get(path, {}).get(rule, 0))
+        for path, rules in baseline.counts.items()
+        for rule, n in rules.items()
+        if current.counts.get(path, {}).get(rule, 0) < n
+    ]
+    assert not slack, (
+        f"baseline is stale (frozen > actual) for {slack}; regenerate with "
+        "python -m nornicdb_tpu.tools.nornlint nornicdb_tpu --update-baseline"
+    )
+
+
+def test_update_baseline_on_subset_keeps_other_files(tmp_path):
+    """A scoped --update-baseline run must not erase frozen allowances for
+    files outside the scanned paths (that would resurrect every legacy
+    finding elsewhere), but must prune entries for deleted files."""
+    # repo marker so the baseline's relative keys stay stable across runs
+    # that scan different subsets (as pyproject.toml does for the real repo)
+    (tmp_path / "pyproject.toml").write_text("")
+    pkg_a = tmp_path / "a"
+    pkg_b = tmp_path / "b"
+    pkg_a.mkdir(), pkg_b.mkdir()
+    (pkg_a / "m.py").write_text(BAD_MODULE)
+    (pkg_b / "m.py").write_text(BAD_MODULE)
+    baseline = tmp_path / "baseline.json"
+
+    # full freeze: both packages
+    assert nornlint_main([str(pkg_a), str(pkg_b),
+                          "--baseline", str(baseline),
+                          "--update-baseline"]) == 0
+    # clean up a/ only, re-freeze scanning a/ only
+    (pkg_a / "m.py").write_text("def ok():\n    return 1\n")
+    assert nornlint_main([str(pkg_a), "--baseline", str(baseline),
+                          "--update-baseline"]) == 0
+    frozen = Baseline.load(baseline)
+    assert "a/m.py" not in frozen.counts, "cleaned file must leave the baseline"
+    assert frozen.counts.get("b/m.py", {}).get("NL-ERR02") == 1, (
+        "unscanned file's allowance must survive a scoped update"
+    )
+    # and the gate over both packages still passes
+    assert nornlint_main([str(pkg_a), str(pkg_b),
+                          "--baseline", str(baseline), "--quiet"]) == 0
+
+
+def test_tm01_module_pass_does_not_leak_into_function_scopes():
+    """Module-scope TM01 must not collect names stamped inside one function
+    and flag subtractions inside another (cross-scope false positive)."""
+    src = """
+    import time
+
+    def stamp():
+        t0 = time.time()  # absolute timestamp, never subtracted here
+        return t0
+
+    def elapsed(start):
+        t0 = time.monotonic()
+        return t0 - start
+    """
+    assert not findings_for(src, "NL-TM01")
+
+
+def test_select_with_update_baseline_rejected(tmp_path):
+    (tmp_path / "x.py").write_text("pass\n")
+    assert nornlint_main([str(tmp_path / "x.py"), "--select", "NL-ERR02",
+                          "--baseline", str(tmp_path / "b.json"),
+                          "--update-baseline"]) == 2

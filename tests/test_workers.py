@@ -344,3 +344,50 @@ class TestGrpcWorkerPool:
             pool.stop()
             primary._server.stop(0)
             db.close()
+
+
+class TestResponseCacheGenerationProbe:
+    """A broken generation probe must fail open (serve uncached), never
+    serve a stale hit by matching its own -1 sentinel."""
+
+    def test_probe_failure_disables_hits_and_puts(self):
+        from nornicdb_tpu.server.respcache import ResponseCache
+
+        state = {"gen": 7, "broken": False}
+
+        def probe():
+            if state["broken"]:
+                raise RuntimeError("mmap closed")
+            return state["gen"]
+
+        cache = ResponseCache(probe, ttl=60.0)
+        cache.put("k", b"payload", generation=7)
+        assert cache.get("k") == b"payload"
+
+        # probe breaks: the stored entry must NOT be served (gen unknowable)
+        state["broken"] = True
+        assert cache.get("k") is None
+
+        # and a put stamped with the failure sentinel must not be stored
+        cache.put("k2", b"stale", generation=cache.generation())
+        state["broken"] = False
+        assert cache.get("k2") is None
+
+    def test_healthy_probe_still_hits(self):
+        from nornicdb_tpu.server.respcache import ResponseCache
+
+        cache = ResponseCache(lambda: 3, ttl=60.0)
+        cache.put("k", b"v", generation=3)
+        assert cache.get("k") == b"v"
+
+
+class TestCacheableBodySniff:
+    def test_non_string_query_routes_to_primary(self):
+        from nornicdb_tpu.server.workers import _cacheable
+
+        assert not _cacheable("POST", "/graphql", b'{"query": null}')
+        assert not _cacheable("POST", "/graphql", b'{"query": 7}')
+        assert not _cacheable("POST", "/graphql", b"not json")
+        assert _cacheable("POST", "/graphql", b'{"query": "{ nodes }"}')
+        assert not _cacheable(
+            "POST", "/graphql", b'{"query": "mutation { x }"}')
