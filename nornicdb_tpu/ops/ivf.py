@@ -54,7 +54,11 @@ class IVFLayout:
     residual_valid: Optional[jax.Array]  # (Rp,) device mask, built once
     cmax: int
     k: int
-    epoch: int               # corpus mutation epoch at build time
+    # corpus LAYOUT epoch at build time: the layout serves while this
+    # matches HostCorpus._layout_epoch, which bumps only when a covered row
+    # is overwritten in place or the slot space remaps (grow/compact/clear)
+    # — plain adds/removes leave a fitted layout valid
+    epoch: int
 
     @property
     def n_rows(self) -> int:

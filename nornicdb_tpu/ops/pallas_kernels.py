@@ -29,7 +29,7 @@ LANE = 128
 def _on_tpu() -> bool:
     try:
         return jax.devices()[0].platform == "tpu"
-    except Exception:
+    except (RuntimeError, IndexError):  # backend init failed / no devices
         return False
 
 

@@ -18,13 +18,20 @@ TPU-first design notes:
 
 from __future__ import annotations
 
+import contextlib
 import functools
+import logging
 import os
-from typing import Optional
+import threading
+import time
+from dataclasses import asdict, dataclass
+from typing import Optional, Sequence
 
 import jax
 import jax.numpy as jnp
 import numpy as np
+
+logger = logging.getLogger(__name__)
 
 LANE = 128  # TPU lane width; min tile second dim
 
@@ -212,11 +219,98 @@ def merge_topk(
     return best_v, best_i
 
 
+# ------------------------------------------------------------- device sync
+# dirty-tracking granularity: one block = one LANE-aligned row group. Writes
+# mark only the blocks they touch; sync patches only dirty blocks.
+BLOCK_ROWS = LANE
+
+# above this fraction of dirty blocks, one contiguous full transfer beats
+# many small patch dispatches (each patch pays launch + slice overhead and
+# the runs re-upload their padding rows)
+FULL_SYNC_DIRTY_FRACTION = 0.5
+
+
+@dataclass
+class SyncStats:
+    """H2D sync accounting for one corpus (exposed via stats()["sync"] and
+    the server's /admin/stats + /metrics)."""
+
+    patches: int = 0          # incremental patch syncs (1 per sync pass)
+    full_uploads: int = 0     # whole-corpus transfers (first sync/grow/…)
+    bytes_uploaded: int = 0   # total host bytes shipped to the device
+    patch_bytes: int = 0      # subset of bytes_uploaded moved by patching
+    rows_patched: int = 0
+    uploader_runs: int = 0    # write-behind background sync cycles
+    query_stall_s: float = 0.0  # time the query path spent blocked in sync
+
+    def as_dict(self) -> dict:
+        return asdict(self)
+
+
+def _coalesce_runs(
+    blocks: Sequence[int], cap_blocks: int
+) -> list[tuple[int, int]]:
+    """Coalesce sorted dirty block ids into (start_block, n_blocks) upload
+    runs. Blocks separated by <= 2 clean blocks merge into one run (a couple
+    of redundant blocks cost less than another dispatch), and run lengths
+    round up to powers of two so the jitted patch program caches O(log N)
+    shapes instead of one per burst size; the start shifts back when the
+    padding would overrun capacity. Padding rows rewrite identical host
+    bytes, so overlap between padded runs is harmless."""
+    runs: list[tuple[int, int]] = []
+    i = 0
+    while i < len(blocks):
+        j = i
+        while j + 1 < len(blocks) and blocks[j + 1] - blocks[j] <= 3:
+            j += 1
+        start, n = blocks[i], blocks[j] - blocks[i] + 1
+        n = min(1 << (n - 1).bit_length(), cap_blocks)
+        runs.append((min(start, cap_blocks - n), n))
+        i = j + 1
+    return runs
+
+
+def _patch_rows_impl(dev: jax.Array, rows: jax.Array, start) -> jax.Array:
+    return jax.lax.dynamic_update_slice(dev, rows, (start, 0))
+
+
+def _patch_valid_impl(dev_valid: jax.Array, rows: jax.Array, start) -> jax.Array:
+    return jax.lax.dynamic_update_slice(dev_valid, rows, (start,))
+
+
+def _patch_i8_impl(dev_i8, dev_scale, rows, start):
+    """Requantize ONLY the patched rows: quantization is per-row symmetric
+    (ops.pallas_kernels.quantize_rows), so block-local requantization
+    matches requantizing the whole corpus (int8 codes exactly; scales to
+    within a float ulp of XLA codegen variance)."""
+    from nornicdb_tpu.ops.pallas_kernels import quantize_rows
+
+    i8, s = quantize_rows(rows)
+    return (
+        jax.lax.dynamic_update_slice(dev_i8, i8, (start, 0)),
+        jax.lax.dynamic_update_slice(dev_scale, s, (start,)),
+    )
+
+
+# donated variants update the resident buffer in place on TPU (no 2x HBM
+# spike during the patch); the non-donated twins run while a search still
+# borrows the buffer (HostCorpus._borrow_device reader guard)
+_patch_rows = jax.jit(_patch_rows_impl)
+_patch_rows_donated = jax.jit(_patch_rows_impl, donate_argnums=(0,))
+_patch_valid = jax.jit(_patch_valid_impl)
+_patch_valid_donated = jax.jit(_patch_valid_impl, donate_argnums=(0,))
+_patch_i8 = jax.jit(_patch_i8_impl)
+_patch_i8_donated = jax.jit(_patch_i8_impl, donate_argnums=(0, 1))
+
+
 # ----------------------------------------------------------------- host API
 class HostCorpus:
     """Host-side state machine shared by DeviceCorpus (single chip) and
     parallel.ShardedCorpus (mesh): id->slot map, padded row matrix, tombstone
-    removal, ratio-triggered compaction, capacity growth.
+    removal, deferred ratio-triggered compaction, capacity growth, plus the
+    block-granular dirty tracking + incremental H2D sync driver (subclasses
+    supply _upload_full/_apply_patch for their device layout) and the
+    write-behind uploader thread.
 
     Mirrors gpu.EmbeddingIndex host bookkeeping (ref: pkg/gpu/gpu.go:1224,
     Add/Remove :1378-1460; the reference's HNSW uses the same
@@ -241,11 +335,36 @@ class HostCorpus:
         self._host = np.zeros((cap, dims), np.float32)
         self._valid = np.zeros(cap, bool)
         self._tombstones = 0
-        self._dirty = True
-        # mutation epoch: consumers holding derived layouts (IVF blocks)
-        # compare epochs to detect staleness (stale layout would serve
-        # stale vectors, not just degraded recall)
+        # dirty tracking is block-granular: mutators mark only the
+        # BLOCK_ROWS-row blocks they touch; _full_dirty forces a whole-corpus
+        # upload (first sync, grow/compact/clear, dtype change)
+        self._dirty_blocks: set[int] = set()
+        self._full_dirty = True
+        self._compact_pending = False
+        # guards host arrays + dirty sets + device-buffer swaps against the
+        # write-behind uploader thread and concurrent searchers
+        self._sync_lock = threading.RLock()
+        # searches borrowing the device buffer; while > 0 the patcher must
+        # not donate (free) the buffer they hold. device_arrays() leaks an
+        # unscoped reference and clears _donation_ok for good.
+        self._readers = 0
+        self._donation_ok = True
+        self.sync_stats = SyncStats()
+        # mutation epoch: bumps on every write (stats / cache invalidation)
         self._epoch = 0
+        # layout epoch: bumps ONLY when a mutation invalidates derived
+        # layouts (IVF blocks hold row copies) — i.e. in-place overwrite of
+        # a covered slot, or any slot-space remap (grow/compact/clear). New
+        # ids and removals leave fitted layouts valid: fresh slots are in no
+        # block, and removed slots filter out host-side at result time.
+        self._layout_epoch = 0
+        self._layout_slots: Optional[np.ndarray] = None  # bool per slot
+        # write-behind uploader (start_uploader): coalesces dirty blocks in
+        # the background so the query path usually finds a clean buffer
+        self._uploader: Optional[threading.Thread] = None
+        self._uploader_stop = threading.Event()
+        self._uploader_wake = threading.Event()
+        self._uploader_interval = 0.002
 
     def __len__(self) -> int:
         return len(self._slot_of)
@@ -254,51 +373,120 @@ class HostCorpus:
     def capacity(self) -> int:
         return self._host.shape[0]
 
+    # -- dirty-block bookkeeping (all called under _sync_lock) -------------
+    def _mark_rows_dirty(self, start: int, stop: int) -> None:
+        self._dirty_blocks.update(
+            range(start // BLOCK_ROWS, (stop - 1) // BLOCK_ROWS + 1)
+        )
+
+    def _mark_all_dirty(self) -> None:
+        self._full_dirty = True
+        self._dirty_blocks.clear()
+
+    def _note_overwrite(self, slot: int) -> None:
+        """In-place update of a slot covered by a derived layout: the IVF
+        blocks hold a COPY of the row, so the layout would serve the stale
+        vector — it must rebuild (layout epoch bump)."""
+        ls = self._layout_slots
+        if ls is not None and slot < ls.size and ls[slot]:
+            self._layout_epoch += 1
+
     def add(self, id_: str, vector: np.ndarray) -> None:
         v = np.asarray(vector, np.float32)
         norm = float(np.linalg.norm(v))
         if norm > 1e-12:
             v = v / norm
-        slot = self._slot_of.get(id_)
-        if slot is None:
-            slot = len(self._ids)
-            if slot >= self.capacity:
-                self._grow()
-            self._ids.append(id_)
-            self._slot_of[id_] = slot
-        self._host[slot] = v
-        self._valid[slot] = True
-        self._dirty = True
-        self._epoch += 1
-
-    def add_batch(self, ids: list[str], vectors: np.ndarray) -> None:
-        vectors = np.asarray(vectors, np.float32)
-        norms = np.linalg.norm(vectors, axis=1, keepdims=True)
-        vectors = vectors / np.maximum(norms, 1e-12)
-        for i, id_ in enumerate(ids):
+        with self._sync_lock:
             slot = self._slot_of.get(id_)
             if slot is None:
+                if len(self._ids) >= self.capacity and self._compact_pending:
+                    # reclaim tombstoned slots before paying for a capacity
+                    # doubling: a write-only churn workload (no searches to
+                    # trigger the deferred compaction) must stay bounded
+                    self._compact()
                 slot = len(self._ids)
                 if slot >= self.capacity:
-                    self._grow(min_capacity=slot + len(ids) - i)
+                    self._grow()
                 self._ids.append(id_)
                 self._slot_of[id_] = slot
-            self._host[slot] = vectors[i]
+            else:
+                self._note_overwrite(slot)
+            self._host[slot] = v
             self._valid[slot] = True
-        self._dirty = True
-        self._epoch += 1
+            self._mark_rows_dirty(slot, slot + 1)
+            self._epoch += 1
+        self._wake_uploader()
+
+    def add_batch(self, ids: list[str], vectors: np.ndarray) -> None:
+        if not ids:
+            return
+        vectors = np.atleast_2d(np.asarray(vectors, np.float32))
+        norms = np.linalg.norm(vectors, axis=1, keepdims=True)
+        vectors = vectors / np.maximum(norms, 1e-12)
+        with self._sync_lock:
+            all_new = len(set(ids)) == len(ids) and not any(
+                i in self._slot_of for i in ids
+            )
+            if all_new:
+                # bulk-ingest fast path: one slice assignment into the slot
+                # tail instead of a Python loop per row
+                if (
+                    len(self._ids) + len(ids) > self.capacity
+                    and self._compact_pending
+                ):
+                    self._compact()  # reclaim tombstones before growing
+                start = len(self._ids)
+                end = start + len(ids)
+                if end > self.capacity:
+                    self._grow(min_capacity=end)
+                self._host[start:end] = vectors
+                self._valid[start:end] = True
+                self._ids.extend(ids)
+                self._slot_of.update(
+                    (id_, start + i) for i, id_ in enumerate(ids)
+                )
+                self._mark_rows_dirty(start, end)
+            else:
+                for i, id_ in enumerate(ids):
+                    slot = self._slot_of.get(id_)
+                    if slot is None:
+                        if (
+                            len(self._ids) >= self.capacity
+                            and self._compact_pending
+                        ):
+                            self._compact()
+                        slot = len(self._ids)
+                        if slot >= self.capacity:
+                            self._grow(min_capacity=slot + len(ids) - i)
+                        self._ids.append(id_)
+                        self._slot_of[id_] = slot
+                    else:
+                        self._note_overwrite(slot)
+                    self._host[slot] = vectors[i]
+                    self._valid[slot] = True
+                    self._mark_rows_dirty(slot, slot + 1)
+            self._epoch += 1
+        self._wake_uploader()
 
     def remove(self, id_: str) -> bool:
-        slot = self._slot_of.pop(id_, None)
-        if slot is None:
-            return False
-        self._ids[slot] = None
-        self._valid[slot] = False
-        self._tombstones += 1
-        self._dirty = True
-        self._epoch += 1
-        if self._ids and self._tombstones / len(self._ids) > self.compact_ratio:
-            self._compact()
+        with self._sync_lock:
+            slot = self._slot_of.pop(id_, None)
+            if slot is None:
+                return False
+            self._ids[slot] = None
+            self._valid[slot] = False
+            self._tombstones += 1
+            self._mark_rows_dirty(slot, slot + 1)
+            self._epoch += 1
+            if (
+                self._ids
+                and self._tombstones / len(self._ids) > self.compact_ratio
+            ):
+                # deferred: the full rewrite + full re-upload runs coalesced
+                # on the write-behind uploader (or the next sync), never on
+                # the caller's write path
+                self._compact_pending = True
+        self._wake_uploader()
         return True
 
     # -- inspection / lifecycle (ref: EmbeddingIndex Has/Get/Clear/Stats/
@@ -314,20 +502,23 @@ class HostCorpus:
         return self._host[slot].copy()
 
     def clear(self) -> None:
-        cap = self.capacity
-        self._ids = []
-        self._slot_of = {}
-        self._host = np.zeros((cap, self.dims), np.float32)
-        self._valid = np.zeros(cap, bool)
-        self._tombstones = 0
-        self._dirty = True
-        self._epoch += 1
-        # slot space was remapped: derived cluster layouts (DeviceCorpus
-        # _assignments/IVF blocks) would index the wrong rows — same reason
-        # _grow/_compact invalidate them
-        clear_clusters = getattr(self, "clear_clusters", None)
-        if callable(clear_clusters):
-            clear_clusters()
+        with self._sync_lock:
+            cap = self.capacity
+            self._ids = []
+            self._slot_of = {}
+            self._host = np.zeros((cap, self.dims), np.float32)
+            self._valid = np.zeros(cap, bool)
+            self._tombstones = 0
+            self._compact_pending = False
+            self._mark_all_dirty()
+            self._epoch += 1
+            self._layout_epoch += 1
+            # slot space was remapped: derived cluster layouts (DeviceCorpus
+            # _assignments/IVF blocks) would index the wrong rows — same
+            # reason _grow/_compact invalidate them
+            clear_clusters = getattr(self, "clear_clusters", None)
+            if callable(clear_clusters):
+                clear_clusters()
 
     def stats(self) -> dict:
         return {
@@ -336,7 +527,10 @@ class HostCorpus:
             "dims": self.dims,
             "tombstones": self._tombstones,
             "epoch": self._epoch,
+            "layout_epoch": self._layout_epoch,
+            "dirty_blocks": len(self._dirty_blocks),
             "memory_bytes": self.memory_usage(),
+            "sync": self.sync_stats.as_dict(),
         }
 
     def memory_usage(self) -> int:
@@ -374,6 +568,9 @@ class HostCorpus:
         host[: self._host.shape[0]] = self._host
         valid[: self._valid.shape[0]] = self._valid
         self._host, self._valid = host, valid
+        # shape change: the resident device buffer cannot be patched in place
+        self._mark_all_dirty()
+        self._layout_epoch += 1
 
     def _compact(self) -> None:
         live = [(i, id_) for i, id_ in enumerate(self._ids) if id_ is not None]
@@ -389,8 +586,161 @@ class HostCorpus:
         self._host, self._valid = host, valid
         self._ids, self._slot_of = ids, slot_of
         self._tombstones = 0
-        self._dirty = True
+        self._compact_pending = False
+        self._mark_all_dirty()
         self._epoch += 1
+        self._layout_epoch += 1
+
+    # -- device sync engine ------------------------------------------------
+    # Subclasses provide the actual device buffers through three hooks:
+    # _device_ready (is there a patchable resident buffer), _upload_full
+    # (whole-corpus transfer) and _apply_patch (jitted dynamic_update_slice
+    # of one contiguous row run). The driver below owns the policy: deferred
+    # compaction, patch-vs-full choice, run coalescing, stats.
+    def _device_ready(self) -> bool:
+        dev = getattr(self, "_dev", None)
+        return dev is not None and int(dev.shape[0]) == self.capacity
+
+    def _upload_full(self) -> None:
+        raise NotImplementedError
+
+    def _apply_patch(
+        self, start_row: int, rows: np.ndarray, valid_rows: np.ndarray,
+        donate: bool,
+    ) -> None:
+        raise NotImplementedError
+
+    def _sync(self, _record_stall: bool = True) -> None:
+        """Bring the resident device buffer up to date with the host.
+
+        Incremental path: dirty BLOCK_ROWS-row blocks coalesce into
+        contiguous runs patched into the resident buffer via jitted
+        dynamic_update_slice — O(dirty rows) transferred, not O(capacity).
+        Full upload only on first sync, grow/compact/clear, or when most of
+        the corpus is dirty. In-flight searches always see either the
+        pre-patch or post-patch buffer, never a half-patched one: a patch
+        builds a new (immutable) array while borrowers hold the old one, and
+        the old buffer is donated back to the allocator only when nobody
+        borrows it (ref: shouldAutoSync gpu.go:1473 — which re-uploaded the
+        whole corpus on any write)."""
+        with self._sync_lock:
+            if self._compact_pending:
+                self._compact()  # coalesced: one rewrite for the whole burst
+            needs_full = self._full_dirty or not self._device_ready()
+            if not needs_full and not self._dirty_blocks:
+                return
+            t0 = time.perf_counter()
+            s = self.sync_stats
+            cap_blocks = max(1, self.capacity // BLOCK_ROWS)
+            if (
+                not needs_full
+                and len(self._dirty_blocks)
+                > cap_blocks * FULL_SYNC_DIRTY_FRACTION
+            ):
+                needs_full = True
+            if needs_full:
+                self._upload_full()
+                s.full_uploads += 1
+                s.bytes_uploaded += int(
+                    self._host.nbytes + self._valid.nbytes
+                )
+            else:
+                donate = self._readers == 0 and self._donation_ok
+                for start_b, n_b in _coalesce_runs(
+                    sorted(self._dirty_blocks), cap_blocks
+                ):
+                    r0 = start_b * BLOCK_ROWS
+                    r1 = min((start_b + n_b) * BLOCK_ROWS, self.capacity)
+                    rows, vrows = self._host[r0:r1], self._valid[r0:r1]
+                    self._apply_patch(r0, rows, vrows, donate)
+                    nbytes = int(rows.nbytes + vrows.nbytes)
+                    s.patch_bytes += nbytes
+                    s.bytes_uploaded += nbytes
+                    s.rows_patched += r1 - r0
+                s.patches += 1
+            self._full_dirty = False
+            self._dirty_blocks.clear()
+            if _record_stall:
+                s.query_stall_s += time.perf_counter() - t0
+
+    @contextlib.contextmanager
+    def _borrow_device(self):
+        """Sync, then pin the serving buffer for the duration of a search.
+        While any borrower is active the patcher will not donate the buffer
+        out from under it — this is what lets the write-behind uploader
+        double-buffer: readers keep the old snapshot, the patch lands in a
+        new one.
+
+        Yields (dev, valid, i8, ids, slot_of). ids/slot_of are the host
+        mappings captured under the lock: compaction/clear REBIND them (new
+        list/dict), so a borrower resolving slots of the borrowed buffer
+        through these references can never see a background compaction's
+        remapped slot space mid-search. In-place mutations (remove's
+        tombstone, add's append) remain visible, which only ever hides
+        just-removed ids — never misattributes."""
+        with self._sync_lock:
+            self._sync()
+            self._readers += 1
+            dev, valid = self._dev, self._dev_valid
+            i8 = getattr(self, "_dev_i8", None)
+            ids, slot_of = self._ids, self._slot_of
+        try:
+            yield dev, valid, i8, ids, slot_of
+        finally:
+            with self._sync_lock:
+                self._readers -= 1
+
+    # -- write-behind uploader ---------------------------------------------
+    def start_uploader(self, interval: float = 0.002) -> None:
+        """Start the write-behind H2D sync thread: it coalesces dirty blocks
+        and stages them between queries, so a query arriving after a write
+        burst waits only for whatever the uploader has not staged yet (a
+        bounded patch), never a full transfer. `interval` is the coalescing
+        window after the first write of a burst."""
+        with self._sync_lock:
+            if self._uploader is not None:
+                return
+            self._uploader_interval = interval
+            self._uploader_stop = threading.Event()
+            self._uploader_wake = threading.Event()
+            self._uploader = threading.Thread(
+                target=self._uploader_loop, name="nornicdb-uploader",
+                daemon=True,
+            )
+            self._uploader.start()
+
+    def stop_uploader(self) -> None:
+        with self._sync_lock:
+            t, self._uploader = self._uploader, None
+            # capture THIS thread's events under the lock: a concurrent
+            # start_uploader() swaps in fresh ones, and signalling those
+            # would kill the new thread while the old one runs forever
+            stop, wake = self._uploader_stop, self._uploader_wake
+        if t is None:
+            return
+        stop.set()
+        wake.set()
+        t.join(timeout=5.0)
+
+    def _wake_uploader(self) -> None:
+        if self._uploader is not None:
+            self._uploader_wake.set()
+
+    def _uploader_loop(self) -> None:
+        stop, wake = self._uploader_stop, self._uploader_wake
+        while not stop.is_set():
+            if not wake.wait(timeout=0.25):
+                continue
+            wake.clear()
+            # coalescing window: let the write burst accumulate so one patch
+            # covers it, instead of one dispatch per row
+            if stop.wait(self._uploader_interval):
+                break
+            try:
+                self._sync(_record_stall=False)
+                self.sync_stats.uploader_runs += 1
+            except Exception:
+                logger.exception("write-behind device sync failed")
 
     def _format_results(
         self,
@@ -399,14 +749,20 @@ class HostCorpus:
         n_queries: int,
         k: int,
         min_similarity: float,
+        ids: Optional[list[Optional[str]]] = None,
     ) -> list[list[tuple[str, float]]]:
+        """Resolve slot indices to ids. `ids` must be the slot map captured
+        with the buffer the indices came from (_borrow_device) — resolving
+        against live self._ids would misattribute results if a background
+        compaction remapped the slot space mid-search."""
+        ids = self._ids if ids is None else ids
         out: list[list[tuple[str, float]]] = []
         for qi in range(n_queries):
             row: list[tuple[str, float]] = []
             for v, i in zip(vals[qi], idx[qi]):
                 if not np.isfinite(v) or v < min_similarity:
                     continue
-                id_ = self._ids[i] if i < len(self._ids) else None
+                id_ = ids[i] if i < len(ids) else None
                 if id_ is not None:
                     row.append((id_, float(v)))
             out.append(row[:k])
@@ -415,8 +771,10 @@ class HostCorpus:
 
 class DeviceCorpus(HostCorpus):
     """Single-device resident, padded, normalized embedding matrix with
-    dirty-tracking host sync (ref: gpu.EmbeddingIndex pkg/gpu/gpu.go:1224 —
-    flat buffer, shouldAutoSync :1473, Search :1519, ScoreSubset :1554).
+    incremental dirty-block host sync: writes patch only the 128-row blocks
+    they touched into the resident buffer (ref: gpu.EmbeddingIndex
+    pkg/gpu/gpu.go:1224 — flat buffer, shouldAutoSync :1473 which re-uploads
+    everything, Search :1519, ScoreSubset :1554).
 
     Optional IVF-style cluster pruning (ref: ClusterIndex kmeans.go:144,
     SearchWithClusters :816, search-side candidate gen
@@ -454,21 +812,44 @@ class DeviceCorpus(HostCorpus):
     # -- cluster pruning ----------------------------------------------------
     def cluster(self, k: int = 0, iters: int = 10, seed: int = 0) -> int:
         """Fit k-means over live rows (ref: ClusterIndex.Cluster kmeans.go:232).
-        Returns the cluster count."""
+        Returns the cluster count; 0 when nothing was installed (too few
+        rows, or the corpus mutated underneath the fit).
+
+        The fit itself runs outside the lock (it can take seconds at
+        scale); install is optimistic: snapshot the rows + layout epoch
+        under the lock, and install only if the epoch is unchanged — a
+        background compaction (write-behind uploader) or an overwrite of a
+        snapshot row during the fit would otherwise stamp a layout built
+        from stale slots as current."""
         from nornicdb_tpu.ops.kmeans import kmeans_fit
 
-        live = [i for i, id_ in enumerate(self._ids) if id_ is not None]
-        if len(live) < 2:
-            return 0
-        data = self._host[live]
+        with self._sync_lock:
+            live = [i for i, id_ in enumerate(self._ids) if id_ is not None]
+            if len(live) < 2:
+                return 0
+            data = self._host[live]  # fancy indexing copies: stable snapshot
+            epoch_at_read = self._layout_epoch
+            # widen the overwrite guard to the snapshot rows so an in-place
+            # update during the fit bumps the epoch and voids the install
+            mask = np.zeros(self.capacity, bool)
+            mask[live] = True
+            if (
+                self._layout_slots is not None
+                and self._layout_slots.size == self.capacity
+            ):
+                mask |= self._layout_slots
+            self._layout_slots = mask
         res = kmeans_fit(data, k=k, iters=iters, seed=seed)
-        assignments = np.full(self.capacity, -1, np.int32)
-        for row, slot in enumerate(live):
-            assignments[slot] = res.assignments[row]
-        self._centroids = jnp.asarray(res.centroids, dtype=self.dtype)
-        self._assignments = assignments
-        self._build_ivf_layout(np.asarray(live), res.assignments,
-                               res.centroids)
+        with self._sync_lock:
+            if self._layout_epoch != epoch_at_read:
+                return 0  # slot space moved mid-fit: caller may recluster
+            assignments = np.full(self.capacity, -1, np.int32)
+            for row, slot in enumerate(live):
+                assignments[slot] = res.assignments[row]
+            self._centroids = jnp.asarray(res.centroids, dtype=self.dtype)
+            self._assignments = assignments
+            self._build_ivf_layout(np.asarray(live), res.assignments,
+                                   res.centroids)
         return res.k
 
     def _build_ivf_layout(self, live_slots: np.ndarray,
@@ -478,35 +859,48 @@ class DeviceCorpus(HostCorpus):
         path (ops/ivf.py). Invalidated by any corpus mutation."""
         from nornicdb_tpu.ops.ivf import build_ivf_layout
 
-        self._ivf = build_ivf_layout(
-            self._host[live_slots], live_slots, live_assignments,
-            centroids, dtype=self.dtype, epoch=self._epoch,
-        )
+        with self._sync_lock:
+            self._ivf = build_ivf_layout(
+                self._host[live_slots], live_slots, live_assignments,
+                centroids, dtype=self.dtype, epoch=self._layout_epoch,
+            )
+            # slots the layout copied rows from: an in-place overwrite of
+            # any of these bumps _layout_epoch (invalidates the layout);
+            # writes to OTHER slots leave it serving correct vectors
+            mask = np.zeros(self.capacity, bool)
+            mask[live_slots] = True
+            self._layout_slots = mask
 
     def clear_clusters(self) -> None:
         self._centroids = None
         self._assignments = None
         self._ivf = None
+        self._layout_slots = None
 
     def set_clusters(
         self, centroids: np.ndarray, assignments_by_id: dict[str, int]
     ) -> None:
         """Install externally computed clusters (e.g. the search service's
-        fit) without re-running k-means."""
-        slot_assignments = np.full(self.capacity, -1, np.int32)
-        for id_, c in assignments_by_id.items():
-            slot = self._slot_of.get(id_)
-            if slot is not None:
-                slot_assignments[slot] = c
-        self._centroids = jnp.asarray(centroids, dtype=self.dtype)
-        self._assignments = slot_assignments
-        # the old layout describes the replaced clustering — drop it even
-        # when no live rows match (else the epoch guard keeps serving it)
-        self._ivf = None
-        live = np.nonzero((slot_assignments >= 0) & self._valid)[0]
-        if live.size:
-            self._build_ivf_layout(live, slot_assignments[live],
-                                   np.asarray(centroids, np.float32))
+        fit) without re-running k-means. Runs under the sync lock: the
+        id->slot resolution and layout build must see one consistent slot
+        space (the write-behind uploader may compact concurrently)."""
+        with self._sync_lock:
+            slot_assignments = np.full(self.capacity, -1, np.int32)
+            for id_, c in assignments_by_id.items():
+                slot = self._slot_of.get(id_)
+                if slot is not None:
+                    slot_assignments[slot] = c
+            self._centroids = jnp.asarray(centroids, dtype=self.dtype)
+            self._assignments = slot_assignments
+            # the old layout describes the replaced clustering — drop it
+            # even when no live rows match (else the epoch guard keeps
+            # serving it)
+            self._ivf = None
+            self._layout_slots = None
+            live = np.nonzero((slot_assignments >= 0) & self._valid)[0]
+            if live.size:
+                self._build_ivf_layout(live, slot_assignments[live],
+                                       np.asarray(centroids, np.float32))
 
     def _grow(self, min_capacity: int = 0) -> None:
         super()._grow(min_capacity)
@@ -523,40 +917,78 @@ class DeviceCorpus(HostCorpus):
         self, q: np.ndarray, k: int, min_similarity: float, n_probe: int,
         exact: bool,
     ) -> Optional[list[list[tuple[str, float]]]]:
-        """Score only rows in the n_probe nearest clusters; None when the
-        candidate set is too small to be worth it."""
+        """Score only rows in the n_probe nearest clusters; None when no
+        cluster index is fitted (caller falls back to the full scan).
+
+        Buffer, id map, cluster state and the layout-epoch check are all
+        captured under ONE lock hold (and the sync — including any pending
+        compaction — runs first), so a background compaction racing this
+        search can only ever rebind state we no longer read: everything
+        below resolves against the captured snapshot."""
+        with self._sync_lock:
+            self._sync()
+            self._readers += 1
+            corpus = self._dev
+            ids, valid_host = self._ids, self._valid
+            centroids, assignments = self._centroids, self._assignments
+            layout = self._ivf
+            layout_ok = (
+                layout is not None and layout.epoch == self._layout_epoch
+            )
+        try:
+            if centroids is None or assignments is None:
+                return None
+            # fused one-program path: valid while the layout matches the
+            # LAYOUT epoch, which bumps only when a covered row was
+            # overwritten in place or the slot space remapped
+            # (grow/compact/clear). Plain adds and removes keep the layout
+            # serving: new rows are merely invisible to pruned search until
+            # the next recluster (recall, not correctness) and removed rows
+            # filter out through the captured id map below.
+            if layout_ok:
+                from nornicdb_tpu.ops.ivf import ivf_search
+
+                vals, slots = ivf_search(layout, q, k, n_probe)
+                out: list[list[tuple[str, float]]] = []
+                for qi in range(vals.shape[0]):
+                    row: list[tuple[str, float]] = []
+                    for s, slot in zip(vals[qi], slots[qi]):
+                        if (
+                            slot < 0 or not np.isfinite(s)
+                            or s < min_similarity
+                        ):
+                            continue
+                        id_ = ids[slot] if slot < len(ids) else None
+                        if id_ is not None:
+                            row.append((id_, float(s)))
+                    out.append(row[:k])
+                return out
+            n_probe = min(n_probe, int(centroids.shape[0]))
+            return self._pruned_scan(
+                q, k, min_similarity, n_probe, corpus, ids, valid_host,
+                centroids, assignments,
+            )
+        finally:
+            with self._sync_lock:
+                self._readers -= 1
+
+    def _pruned_scan(
+        self, q: np.ndarray, k: int, min_similarity: float, n_probe: int,
+        corpus: jax.Array, ids: list[Optional[str]], valid_host: np.ndarray,
+        centroids: jax.Array, assignments: np.ndarray,
+    ) -> list[list[tuple[str, float]]]:
+        """Assignment-mask fallback pruning over the synced device corpus.
+        All host state comes in as the snapshot captured with the buffer."""
         from nornicdb_tpu.ops.kmeans import nearest_clusters
 
-        if self._centroids is None or self._assignments is None:
-            return None
-        # fused one-program path: valid only while the layout matches the
-        # corpus epoch (a stale layout would serve stale VECTORS — worse
-        # than stale assignments, which only degrade recall)
-        if self._ivf is not None and self._ivf.epoch == self._epoch:
-            from nornicdb_tpu.ops.ivf import ivf_search
-
-            vals, slots = ivf_search(self._ivf, q, k, n_probe)
-            out: list[list[tuple[str, float]]] = []
-            for qi in range(vals.shape[0]):
-                row: list[tuple[str, float]] = []
-                for s, slot in zip(vals[qi], slots[qi]):
-                    if slot < 0 or not np.isfinite(s) or s < min_similarity:
-                        continue
-                    id_ = self._ids[slot] if slot < len(self._ids) else None
-                    if id_ is not None:
-                        row.append((id_, float(s)))
-                out.append(row[:k])
-            return out
-        n_probe = min(n_probe, int(self._centroids.shape[0]))
         out: list[list[tuple[str, float]]] = []
-        corpus, _ = self.device_arrays()
         for qi in range(q.shape[0]):
             probes = np.asarray(
                 nearest_clusters(
-                    jnp.asarray(q[qi], dtype=self.dtype), self._centroids, n_probe
+                    jnp.asarray(q[qi], dtype=self.dtype), centroids, n_probe
                 )
             )
-            mask = np.isin(self._assignments, probes) & self._valid
+            mask = np.isin(assignments, probes) & valid_host
             slots = np.nonzero(mask)[0]
             if slots.size == 0:
                 out.append([])
@@ -577,26 +1009,53 @@ class DeviceCorpus(HostCorpus):
                 s = float(scores[j])
                 if s < min_similarity:
                     continue
-                id_ = self._ids[slots[j]]
+                id_ = ids[slots[j]]
                 if id_ is not None:
                     row.append((id_, s))
             out.append(row)
         return out
 
-    def _sync(self) -> None:
-        """H2D upload when dirty (ref: shouldAutoSync gpu.go:1473)."""
-        if self._dirty or self._dev is None:
-            self._dev = jnp.asarray(self._host, dtype=self.dtype)
-            self._dev_valid = jnp.asarray(self._valid)
-            if self.quantize:
-                from nornicdb_tpu.ops.pallas_kernels import quantize_rows
+    def _upload_full(self) -> None:
+        """Whole-corpus H2D transfer (first sync / grow / compact / clear)."""
+        self._dev = jnp.asarray(self._host, dtype=self.dtype)
+        self._dev_valid = jnp.asarray(self._valid)
+        if self.quantize:
+            from nornicdb_tpu.ops.pallas_kernels import quantize_rows
 
-                self._dev_i8 = quantize_rows(self._dev)
-            self._dirty = False
+            self._dev_i8 = quantize_rows(self._dev)
+
+    def _apply_patch(
+        self, start_row: int, rows: np.ndarray, valid_rows: np.ndarray,
+        donate: bool,
+    ) -> None:
+        """Patch one contiguous dirty run into the resident buffers; the
+        int8 serving mirror requantizes only the patched rows."""
+        start = np.int32(start_row)
+        # one H2D conversion feeds both the f32/bf16 patch and the int8
+        # requantization — the rows transfer once, not per consumer
+        rows_dev = jnp.asarray(rows, dtype=self.dtype)
+        patch = _patch_rows_donated if donate else _patch_rows
+        self._dev = patch(self._dev, rows_dev, start)
+        vpatch = _patch_valid_donated if donate else _patch_valid
+        self._dev_valid = vpatch(
+            self._dev_valid, jnp.asarray(valid_rows), start
+        )
+        if self.quantize and self._dev_i8 is not None:
+            qpatch = _patch_i8_donated if donate else _patch_i8
+            self._dev_i8 = qpatch(
+                self._dev_i8[0], self._dev_i8[1], rows_dev, start,
+            )
 
     def device_arrays(self) -> tuple[jax.Array, jax.Array]:
-        self._sync()
-        return self._dev, self._dev_valid
+        """Legacy unguarded access to the resident buffers. Callers may hold
+        the returned arrays indefinitely, so donation is permanently
+        disabled for this corpus the moment anyone uses this — otherwise a
+        later patch would free a buffer the caller still reads. Prefer
+        _borrow_device, which scopes the pin to the search."""
+        with self._sync_lock:
+            self._donation_ok = False
+            self._sync()
+            return self._dev, self._dev_valid
 
     def search(
         self,
@@ -624,16 +1083,18 @@ class DeviceCorpus(HostCorpus):
             pruned = self._pruned_search(q, k, min_similarity, n_probe, exact)
             if pruned is not None:
                 return pruned
-        corpus, valid = self.device_arrays()
-        kk = min(k, self.capacity)
-        vals, idx = topk_backend(
-            l2_normalize(jnp.asarray(q, dtype=self.dtype)), corpus, valid, kk,
-            exact=exact, streaming=streaming,
-            quantized=self._dev_i8 if self.quantize else None,
-        )
+        with self._borrow_device() as (corpus, valid, dev_i8, ids, _):
+            kk = min(k, self.capacity)
+            vals, idx = topk_backend(
+                l2_normalize(jnp.asarray(q, dtype=self.dtype)), corpus, valid,
+                kk, exact=exact, streaming=streaming,
+                quantized=dev_i8 if self.quantize else None,
+            )
+            # materialize INSIDE the borrow: the computation must finish
+            # before the patcher may donate the buffer it reads
+            vals_np, idx_np = np.asarray(vals, np.float32), np.asarray(idx)
         return self._format_results(
-            np.asarray(vals, np.float32), np.asarray(idx), q.shape[0], k,
-            min_similarity,
+            vals_np, idx_np, q.shape[0], k, min_similarity, ids=ids,
         )
 
     def score_subset(
@@ -641,14 +1102,13 @@ class DeviceCorpus(HostCorpus):
     ) -> list[tuple[str, float]]:
         """Exact re-score of the given ids; unknown/removed ids are omitted
         from the returned (id, score) pairs so results stay attributable."""
-        corpus, _ = self.device_arrays()
-        present = [(i, self._slot_of[i]) for i in ids if i in self._slot_of]
-        if not present:
-            return []
-        q = l2_normalize(jnp.asarray(query, dtype=self.dtype).reshape(-1))
-        slots = jnp.asarray([s for _, s in present])
-        scores = score_subset(q, corpus, slots)
-        return [
-            (id_, float(s))
-            for (id_, _), s in zip(present, np.asarray(scores, np.float32))
-        ]
+        with self._borrow_device() as (corpus, _, _i8, _ids, slot_of):
+            # slot_of is the snapshot consistent with the borrowed buffer —
+            # a racing background compaction rebinds, never mutates, it
+            present = [(i, slot_of[i]) for i in ids if i in slot_of]
+            if not present:
+                return []
+            q = l2_normalize(jnp.asarray(query, dtype=self.dtype).reshape(-1))
+            slots = jnp.asarray([s for _, s in present])
+            scores = np.asarray(score_subset(q, corpus, slots), np.float32)
+        return [(id_, float(s)) for (id_, _), s in zip(present, scores)]

@@ -23,16 +23,19 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
-from jax import shard_map
 
 from nornicdb_tpu.ops.similarity import (
     HostCorpus,
+    _patch_rows,
+    _patch_rows_donated,
+    _patch_valid,
+    _patch_valid_donated,
     cosine_topk,
     l2_normalize,
     merge_topk,
     topk_backend,
 )
-from nornicdb_tpu.parallel.mesh import make_mesh
+from nornicdb_tpu.parallel.mesh import make_mesh, shard_map_compat
 
 
 @functools.partial(
@@ -70,12 +73,11 @@ def _sharded_search(
         idx_all = jax.lax.all_gather(gidx, axis)
         return merge_topk(vals_all, idx_all, min(k, local_k * n_shards))
 
-    return shard_map(
+    return shard_map_compat(
         shard_fn,
         mesh=mesh_static,
         in_specs=(P(), P(axis, None), P(axis)),
         out_specs=(P(), P()),
-        check_vma=False,
     )(queries, corpus, valid)
 
 
@@ -113,17 +115,39 @@ class ShardedCorpus(HostCorpus):
         )
         self._dev = None
         self._dev_valid = None
+        self._sharding = NamedSharding(self.mesh, P(self.axis, None))
+        self._vsharding = NamedSharding(self.mesh, P(self.axis))
 
     # -- device sync -------------------------------------------------------
-    def _sync(self) -> None:
-        if self._dirty or self._dev is None:
-            sharding = NamedSharding(self.mesh, P(self.axis, None))
-            vsharding = NamedSharding(self.mesh, P(self.axis))
-            self._dev = jax.device_put(
-                jnp.asarray(self._host, dtype=self.dtype), sharding
-            )
-            self._dev_valid = jax.device_put(jnp.asarray(self._valid), vsharding)
-            self._dirty = False
+    # The generic HostCorpus._sync driver (dirty-block coalescing, deferred
+    # compaction, patch-vs-full policy, stats) drives these two hooks.
+    def _upload_full(self) -> None:
+        self._dev = jax.device_put(
+            jnp.asarray(self._host, dtype=self.dtype), self._sharding
+        )
+        self._dev_valid = jax.device_put(
+            jnp.asarray(self._valid), self._vsharding
+        )
+
+    def _apply_patch(
+        self, start_row: int, rows: np.ndarray, valid_rows: np.ndarray,
+        donate: bool,
+    ) -> None:
+        """Patch one dirty run into the mesh-sharded buffer. XLA partitions
+        the dynamic_update_slice, so a run touches only the shards it
+        overlaps; device_put re-pins the P(axis, None) layout (a no-op when
+        GSPMD already kept it, which it does for update-slice)."""
+        start = np.int32(start_row)
+        patch = _patch_rows_donated if donate else _patch_rows
+        self._dev = jax.device_put(
+            patch(self._dev, jnp.asarray(rows, dtype=self.dtype), start),
+            self._sharding,
+        )
+        vpatch = _patch_valid_donated if donate else _patch_valid
+        self._dev_valid = jax.device_put(
+            vpatch(self._dev_valid, jnp.asarray(valid_rows), start),
+            self._vsharding,
+        )
 
     # -- search ------------------------------------------------------------
     def search(
@@ -141,13 +165,15 @@ class ShardedCorpus(HostCorpus):
         q = np.atleast_2d(np.asarray(queries, np.float32))
         if len(self._slot_of) == 0:
             return [[] for _ in range(q.shape[0])]
-        self._sync()
-        qd = l2_normalize(jnp.asarray(q, dtype=self.dtype))
-        vals, idx = _sharded_search(
-            qd, self._dev, self._dev_valid, min(k, self.capacity),
-            self.axis, self.mesh, exact=exact, streaming=streaming,
-        )
+        with self._borrow_device() as (dev, dev_valid, _i8, ids, _):
+            qd = l2_normalize(jnp.asarray(q, dtype=self.dtype))
+            vals, idx = _sharded_search(
+                qd, dev, dev_valid, min(k, self.capacity),
+                self.axis, self.mesh, exact=exact, streaming=streaming,
+            )
+            # materialize inside the borrow so the patcher can't donate the
+            # buffers this program is still reading
+            vals_np, idx_np = np.asarray(vals, np.float32), np.asarray(idx)
         return self._format_results(
-            np.asarray(vals, np.float32), np.asarray(idx), q.shape[0], k,
-            min_similarity,
+            vals_np, idx_np, q.shape[0], k, min_similarity, ids=ids,
         )

@@ -42,6 +42,16 @@ class BatcherStats:
     def avg_batch(self) -> float:
         return self.queries / self.batches if self.batches else 0.0
 
+    def as_dict(self) -> dict:
+        """For the server stats/metrics surface: lets operators tune the
+        batch window from observed batch sizes."""
+        return {
+            "queries": self.queries,
+            "batches": self.batches,
+            "max_batch": self.max_batch,
+            "avg_batch": self.avg_batch,
+        }
+
 
 class QueryBatcher:
     """Coalesce concurrent search calls into one device dispatch.

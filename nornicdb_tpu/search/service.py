@@ -59,6 +59,11 @@ class SearchConfig:
     batching_enabled: bool = False
     batch_window: float = 0.002
     batch_max: int = 256
+    # write-behind device sync: a background thread coalesces dirty corpus
+    # blocks and patches them between queries, so a query after a write
+    # burst waits for a bounded patch instead of staging the whole burst
+    write_behind: bool = False
+    write_behind_interval: float = 0.002
 
 
 class SearchService:
@@ -86,8 +91,8 @@ class SearchService:
         self._vectors: dict[str, np.ndarray] = {}  # normalized, for MMR
         # id -> (text-digest, embedding-digest): lets no-op updates (e.g. the
         # access-count touch recall() performs per result) skip re-indexing,
-        # which would otherwise dirty the device corpus and force a full H2D
-        # re-upload per search
+        # which would otherwise dirty corpus blocks (and, for clustered
+        # rows, invalidate the fitted IVF layout) on every search
         self._fingerprints: dict[str, tuple[bytes, bytes]] = {}
         self.cluster_result = None
         self.cluster_assignments: dict[str, int] = {}
@@ -124,6 +129,8 @@ class SearchService:
                 self._corpus = DeviceCorpus(dims=dims)
             else:
                 self._hnsw = HNSWIndex(dims=dims)
+            if self._corpus is not None and self.config.write_behind:
+                self._corpus.start_uploader(self.config.write_behind_interval)
 
     def index_node(self, node: Node) -> None:
         """(ref: IndexNode search.go:651; event wiring db.go:1020-1033)"""
@@ -228,6 +235,23 @@ class SearchService:
                     if s >= min_similarity
                 ]
             return []
+
+    def stats_snapshot(self) -> dict:
+        """Search-stack observability bundle for the server stats/metrics
+        surface: index/search counters, the corpus's device-sync accounting
+        (patches vs full uploads, bytes, query stall), and the query
+        batcher's observed batch sizes — the numbers the batch window and
+        uploader cadence are tuned from."""
+        from dataclasses import asdict
+
+        out: dict = asdict(self.stats)
+        with self._lock:
+            corpus, batcher = self._corpus, getattr(self, "_batcher", None)
+        if corpus is not None:
+            out["corpus"] = corpus.stats()
+        if batcher is not None:
+            out["batcher"] = batcher.stats.as_dict()
+        return out
 
     def search(
         self,

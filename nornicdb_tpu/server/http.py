@@ -603,6 +603,11 @@ class HttpServer:
             }
             if self.db._embed_worker is not None:
                 stats["embed_worker"] = vars(self.db._embed_worker.stats)
+            search = getattr(self.db, "search", None)
+            if search is not None and hasattr(search, "stats_snapshot"):
+                # index/search counters + device-sync patching + query
+                # batcher sizes (tune batch_window / uploader cadence here)
+                stats["search"] = search.stats_snapshot()
             wal = self.db.wal_stats()
             if wal is not None:
                 stats["wal"] = wal
@@ -693,6 +698,31 @@ class HttpServer:
                 "# TYPE nornicdb_embeddings_failed_total counter",
                 f"nornicdb_embeddings_failed_total {s.failed}",
             ]
+        search = getattr(self.db, "search", None)
+        if search is not None and hasattr(search, "stats_snapshot"):
+            snap = search.stats_snapshot()
+            sync = (snap.get("corpus") or {}).get("sync")
+            if sync:
+                lines += [
+                    "# TYPE nornicdb_device_sync_bytes_total counter",
+                    f"nornicdb_device_sync_bytes_total {sync['bytes_uploaded']}",
+                    "# TYPE nornicdb_device_sync_patches_total counter",
+                    f"nornicdb_device_sync_patches_total {sync['patches']}",
+                    "# TYPE nornicdb_device_sync_full_uploads_total counter",
+                    f"nornicdb_device_sync_full_uploads_total {sync['full_uploads']}",
+                    "# TYPE nornicdb_device_sync_query_stall_seconds_total counter",
+                    f"nornicdb_device_sync_query_stall_seconds_total {sync['query_stall_s']:.6f}",
+                ]
+            batcher = snap.get("batcher")
+            if batcher:
+                lines += [
+                    "# TYPE nornicdb_batched_queries_total counter",
+                    f"nornicdb_batched_queries_total {batcher['queries']}",
+                    "# TYPE nornicdb_query_batches_total counter",
+                    f"nornicdb_query_batches_total {batcher['batches']}",
+                    "# TYPE nornicdb_query_batch_max gauge",
+                    f"nornicdb_query_batch_max {batcher['max_batch']}",
+                ]
         # heimdall named metrics when the assistant has been used
         # (ref: pkg/heimdall/metrics.go Prometheus rendering)
         if self.db._heimdall is not None:

@@ -111,26 +111,31 @@ class TestDeviceCorpusIntegration:
         )
         assert agree >= 18  # ≥90% top-1 agreement at n_probe=4/6
 
-    def test_mutation_invalidates_layout(self):
+    def test_overwrite_invalidates_layout_plain_add_does_not(self):
         c, rows = self._corpus()
         c.cluster(k=6)
-        epoch = c._ivf.epoch
+        layout = c._ivf
+        # a NEW id lands in a fresh slot no block covers: the fitted layout
+        # stays valid (block-aware invalidation) and keeps serving
         c.add("extra", np.ones(32, np.float32))
-        assert c._epoch != epoch
-        # the fused path must NOT serve the stale layout; fallback still
-        # finds the new row via the mask path (stale assignments only)
-        res = c.search(np.ones(32, np.float32), k=1, n_probe=6)
-        # fallback path can't know the new row's cluster (assignment -1),
-        # but a full search must find it
+        assert c._ivf is layout and layout.epoch == c._layout_epoch
+        # the new row is invisible to pruned search until recluster (recall,
+        # not correctness), but a full search must find it
         res_full = c.search(np.ones(32, np.float32), k=1)
         assert res_full[0][0][0] == "extra"
+        # overwriting a CLUSTERED row in place would make the layout serve
+        # the stale copied vector — that must invalidate it
+        c.add("n5", np.ones(32, np.float32))
+        assert layout.epoch != c._layout_epoch
+        res = c.search(rows[5], k=1, n_probe=6)  # falls back, no stale serve
+        assert res[0][0][0] != "n5"
 
     def test_recluster_rebuilds_layout(self):
         c, rows = self._corpus()
         c.cluster(k=6)
         c.add("extra", rows[0] * -1.0)
         c.cluster(k=6)
-        assert c._ivf is not None and c._ivf.epoch == c._epoch
+        assert c._ivf is not None and c._ivf.epoch == c._layout_epoch
         res = c.search(rows[0] * -1.0, k=1, n_probe=6)
         assert res[0][0][0] == "extra"
 

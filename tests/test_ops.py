@@ -108,10 +108,14 @@ class TestDeviceCorpus:
             dc.add(f"n{i}", v)
         for i in range(10):
             dc.remove(f"n{i}")
-        assert dc._tombstones <= 1  # compaction ran (last removal may re-tombstone)
-        assert len(dc._ids) < 20  # slots were reclaimed
-        res = dc.search(data[15], k=1)
+        # compaction no longer runs on the remove() caller path: it is
+        # deferred and coalesced into the next device sync
+        assert dc._compact_pending
+        assert dc._tombstones == 10
+        res = dc.search(data[15], k=1)  # sync runs the pending compaction
         assert res[0][0][0] == "n15"
+        assert dc._tombstones == 0  # one rewrite covered the whole burst
+        assert len(dc._ids) == 10  # slots were reclaimed
 
     def test_update_in_place(self):
         dc = DeviceCorpus(dims=4)

@@ -146,7 +146,10 @@ class TestMeshPrograms:
     def test_psum_all_gather_equivalence(self):
         """The two collective formulations the search merge can use must
         agree: psum of masked locals == sum over all-gathered shards."""
-        from jax import shard_map
+        try:
+            from jax import shard_map
+        except ImportError:  # jax < 0.5 exports it under experimental
+            from jax.experimental.shard_map import shard_map
 
         mesh = make_mesh()
         x = jnp.arange(32, dtype=jnp.float32).reshape(8, 4)
