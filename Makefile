@@ -1,6 +1,6 @@
 # NornicDB-TPU (ref: the reference's Makefile test/build targets)
 
-.PHONY: test test-fast lint lint-baseline bench native e2e-bench clean
+.PHONY: test test-fast lint lint-baseline sanitize bench native e2e-bench clean
 
 test:
 	python -m pytest tests/ -q
@@ -10,6 +10,10 @@ lint:
 
 lint-baseline:
 	python -m nornicdb_tpu.tools.nornlint nornicdb_tpu --baseline tools/nornlint_baseline.json --update-baseline
+
+# runtime lock sanitizer over the threaded suites (docs/linting.md#nornsan)
+sanitize:
+	NORNSAN=1 python -m pytest tests/test_concurrency.py tests/test_replication.py tests/test_replication_scenarios.py tests/test_nornsan.py -q
 
 test-fast:
 	python -m pytest tests/ -q -x

@@ -319,7 +319,8 @@ def log_set_level(level):
     lvl = str(level).upper()
     if lvl not in _LOG_LEVELS:
         raise NornicError(f"unknown log level {level!r}")
-    _log_state["level"] = lvl
+    with _LOG_LOCK:
+        _log_state["level"] = lvl
     return lvl
 
 
@@ -385,9 +386,11 @@ def log_timer(name, stop=False):
     """Start (or stop and report) a named timer; returns elapsed ms."""
     now = time.perf_counter()
     if not stop:
-        _log_timers[str(name)] = now
+        with _LOG_LOCK:
+            _log_timers[str(name)] = now
         return 0.0
-    t0 = _log_timers.pop(str(name), now)
+    with _LOG_LOCK:
+        t0 = _log_timers.pop(str(name), now)
     ms = (now - t0) * 1000.0
     _log_emit("INFO", f"timer {name}: {ms:.2f}ms", "timer")
     return ms
@@ -588,6 +591,7 @@ def detect_deadlock():
 
 
 # ============================================================ apoc.warmup
+_warmup_lock = threading.Lock()
 _warmup_state = {"last": None}
 
 
@@ -638,7 +642,8 @@ def warmup_cache(ex):
 def warmup_run(ex):
     out = {**warmup_nodes(ex), **warmup_relationships(ex),
            **warmup_properties(ex), **warmup_cache(ex)}
-    _warmup_state["last"] = {"ts": int(time.time() * 1000), **out}
+    with _warmup_lock:
+        _warmup_state["last"] = {"ts": int(time.time() * 1000), **out}
     return out
 
 
@@ -654,7 +659,8 @@ def warmup_run_with_params(ex, config=None):
         out.update(warmup_properties(ex))
     if cfg.get("cache", False):
         out.update(warmup_cache(ex))
-    _warmup_state["last"] = {"ts": int(time.time() * 1000), **out}
+    with _warmup_lock:
+        _warmup_state["last"] = {"ts": int(time.time() * 1000), **out}
     return out
 
 
@@ -690,7 +696,8 @@ def warmup_stats():
 
 @register("apoc.warmup.clear")
 def warmup_clear():
-    _warmup_state["last"] = None
+    with _warmup_lock:
+        _warmup_state["last"] = None
     return True
 
 
@@ -887,12 +894,14 @@ def periodic_submit(ex, name, statement):
     """Run once, record as a completed job (the reference's Submit also
     executes immediately in the background)."""
     ex.execute(str(statement))
-    jobs = _jobs_state.setdefault(id(ex), {})
-    jobs[str(name)] = {"name": str(name), "statement": str(statement),
-                       "done": True, "cancelled": False}
-    return jobs[str(name)]
+    with _jobs_lock:
+        jobs = _jobs_state.setdefault(id(ex), {})
+        jobs[str(name)] = {"name": str(name), "statement": str(statement),
+                           "done": True, "cancelled": False}
+        return jobs[str(name)]
 
 
+_jobs_lock = threading.Lock()
 _jobs_state: dict[int, dict] = {}
 
 
@@ -901,11 +910,12 @@ _jobs_state: dict[int, dict] = {}
 def periodic_repeat(ex, name, statement, interval_s=60):
     """Records the schedule; execution rides the DB's decay/maintenance
     timer rather than an unmanaged thread."""
-    jobs = _jobs_state.setdefault(id(ex), {})
-    jobs[str(name)] = {"name": str(name), "statement": str(statement),
-                       "intervalSeconds": int(interval_s), "done": False,
-                       "cancelled": False}
-    return jobs[str(name)]
+    with _jobs_lock:
+        jobs = _jobs_state.setdefault(id(ex), {})
+        jobs[str(name)] = {"name": str(name), "statement": str(statement),
+                           "intervalSeconds": int(interval_s), "done": False,
+                           "cancelled": False}
+        return jobs[str(name)]
 
 
 @_graph_fn("apoc.periodic.cancel")

@@ -249,53 +249,72 @@ class Authenticator:
         the account lockout and are audited — otherwise a hijacked session
         could brute-force the current password unthrottled through
         POST /auth/password while authenticate()'s lockout never engages."""
-        with self._lock:
-            try:
-                user = self.get_user(username)
-            except AuthError:
-                return False
-            now = time.time()
-            if user.locked_until > now:
-                self._audit(
-                    "password_verify_rejected",
-                    {"username": username, "reason": "locked"},
-                )
-                return False
-            if not verify_password(password, user.password_hash):
-                user.failed_attempts += 1
-                if user.failed_attempts >= self.config.lockout_threshold:
-                    user.locked_until = now + self.config.lockout_duration
+        # audit events collected under the lock, emitted after release: the
+        # hook is externally supplied code (nornlint NL-LK03) — an audit
+        # sink that logged back through this Authenticator would deadlock,
+        # and a slow sink would serialize every login behind it
+        events: list[tuple[str, dict]] = []
+        try:
+            with self._lock:
+                try:
+                    user = self.get_user(username)
+                except AuthError:
+                    return False
+                now = time.time()
+                if user.locked_until > now:
+                    events.append((
+                        "password_verify_rejected",
+                        {"username": username, "reason": "locked"},
+                    ))
+                    return False
+                if not verify_password(password, user.password_hash):
+                    user.failed_attempts += 1
+                    if user.failed_attempts >= self.config.lockout_threshold:
+                        user.locked_until = now + self.config.lockout_duration
+                        user.failed_attempts = 0
+                    self._save_user(user)
+                    events.append(
+                        ("password_verify_failed", {"username": username}))
+                    return False
+                if user.failed_attempts:
                     user.failed_attempts = 0
-                self._save_user(user)
-                self._audit("password_verify_failed", {"username": username})
-                return False
-            if user.failed_attempts:
-                user.failed_attempts = 0
-                self._save_user(user)
-            return True
+                    self._save_user(user)
+                return True
+        finally:
+            for event, detail in events:
+                self._audit(event, detail)
 
     def authenticate(self, username: str, password: str) -> str:
         """Returns a JWT on success (ref: Authenticate auth.go:970)."""
-        with self._lock:
-            user = self.get_user(username)
-            now = time.time()
-            if user.disabled:
-                self._audit("login_rejected", {"username": username, "reason": "disabled"})
-                raise AuthError("account disabled")
-            if user.locked_until > now:
-                self._audit("login_rejected", {"username": username, "reason": "locked"})
-                raise AuthError("account locked")
-            if not verify_password(password, user.password_hash):
-                user.failed_attempts += 1
-                if user.failed_attempts >= self.config.lockout_threshold:
-                    user.locked_until = now + self.config.lockout_duration
+        # same deferred-audit contract as verify_current_password: the hook
+        # never runs under self._lock
+        events: list[tuple[str, dict]] = []
+        try:
+            with self._lock:
+                user = self.get_user(username)
+                now = time.time()
+                if user.disabled:
+                    events.append(("login_rejected",
+                                   {"username": username, "reason": "disabled"}))
+                    raise AuthError("account disabled")
+                if user.locked_until > now:
+                    events.append(("login_rejected",
+                                   {"username": username, "reason": "locked"}))
+                    raise AuthError("account locked")
+                if not verify_password(password, user.password_hash):
+                    user.failed_attempts += 1
+                    if user.failed_attempts >= self.config.lockout_threshold:
+                        user.locked_until = now + self.config.lockout_duration
+                        user.failed_attempts = 0
+                    self._save_user(user)
+                    events.append(("login_failed", {"username": username}))
+                    raise AuthError("invalid credentials")
+                if user.failed_attempts:
                     user.failed_attempts = 0
-                self._save_user(user)
-                self._audit("login_failed", {"username": username})
-                raise AuthError("invalid credentials")
-            if user.failed_attempts:
-                user.failed_attempts = 0
-                self._save_user(user)
+                    self._save_user(user)
+        finally:
+            for event, detail in events:
+                self._audit(event, detail)
         token = self.issue_token(username, user.role)
         self._audit("login_ok", {"username": username})
         return token

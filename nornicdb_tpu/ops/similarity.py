@@ -492,14 +492,20 @@ class HostCorpus:
     # -- inspection / lifecycle (ref: EmbeddingIndex Has/Get/Clear/Stats/
     # MemoryUsage/Serialize, pkg/gpu/gpu.go + gpu_test.go:630-800) ---------
     def has(self, id_: str) -> bool:
-        return id_ in self._slot_of
+        with self._sync_lock:
+            return id_ in self._slot_of
 
     def get(self, id_: str) -> Optional[np.ndarray]:
         """The stored (normalized) vector, or None when absent."""
-        slot = self._slot_of.get(id_)
-        if slot is None:
-            return None
-        return self._host[slot].copy()
+        # slot lookup and row read must be one atomic view: the write-behind
+        # uploader thread's deferred _compact() rebinds _slot_of/_host with a
+        # remapped slot space, and a stale slot indexed into the new _host
+        # would silently return another id's vector
+        with self._sync_lock:
+            slot = self._slot_of.get(id_)
+            if slot is None:
+                return None
+            return self._host[slot].copy()
 
     def clear(self) -> None:
         with self._sync_lock:
@@ -539,11 +545,15 @@ class HostCorpus:
     def save(self, path: str) -> None:
         """Persist live ids + vectors (tombstones are not serialized —
         matches the reference's compact-on-serialize behavior)."""
-        live = [(i, id_) for i, id_ in enumerate(self._ids)
-                if id_ is not None]
-        ids = np.asarray([id_ for _, id_ in live])
-        vecs = (self._host[[i for i, _ in live]]
-                if live else np.zeros((0, self.dims), np.float32))
+        # same atomic-view contract as get(): the uploader thread's deferred
+        # _compact() rebinds _ids/_host with remapped slots, and a snapshot
+        # torn across that rebind would checkpoint ids against other rows
+        with self._sync_lock:
+            live = [(i, id_) for i, id_ in enumerate(self._ids)
+                    if id_ is not None]
+            ids = np.asarray([id_ for _, id_ in live])
+            vecs = (self._host[[i for i, _ in live]].copy()
+                    if live else np.zeros((0, self.dims), np.float32))
         np.savez_compressed(path, ids=ids, vectors=vecs,
                             dims=np.asarray(self.dims))
 

@@ -180,7 +180,9 @@ class RaftNode:
         try:
             fd = os.open(self._state_dir, os.O_RDONLY)
             try:
-                os.fsync(fd)
+                # deliberate fsync under the RPC lock: the rename must be
+                # durable before the reply leaves (Raft election safety)
+                os.fsync(fd)  # nornlint: disable=NL-LK02
             finally:
                 os.close(fd)
         except OSError:
@@ -196,7 +198,10 @@ class RaftNode:
                 f,
             )
             f.flush()
-            os.fsync(f.fileno())
+            # deliberate fsync under the RPC lock: a vote/term must be
+            # durable BEFORE the reply leaves, or a restarted node can vote
+            # twice in one term (Raft election safety)
+            os.fsync(f.fileno())  # nornlint: disable=NL-LK02
         os.replace(tmp, self._state_path)
         self._fsync_dir()
 
@@ -210,7 +215,9 @@ class RaftNode:
                 ).encode() + b"\n"
             )
         self._log_f.flush()
-        os.fsync(self._log_f.fileno())
+        # deliberate fsync under the RPC lock: the AppendEntries ack is a
+        # durability promise, and appends must hit the file in log order
+        os.fsync(self._log_f.fileno())  # nornlint: disable=NL-LK02
 
     def _persist_log_rewrite(self) -> None:
         """Full rewrite after a conflict truncation (rare path)."""
@@ -227,7 +234,9 @@ class RaftNode:
                     ).encode() + b"\n"
                 )
             f.flush()
-            os.fsync(f.fileno())
+            # deliberate fsync under the RPC lock: conflict truncation must
+            # be durable before the reject reply triggers a leader resend
+            os.fsync(f.fileno())  # nornlint: disable=NL-LK02
         os.replace(tmp, self._log_path)
         self._fsync_dir()
         self._log_f = open(self._log_path, "ab")
@@ -330,6 +339,7 @@ class RaftNode:
     # -- log replication --------------------------------------------------------
     def propose(self, op: str, data: dict[str, Any]) -> int:
         """Leader-only: append an op, replicate, return its index."""
+        applied: list[LogEntry] = []
         with self._lock:
             if self.state != LEADER:
                 raise ReplicationError(f"not the leader (leader={self.leader_id})")
@@ -339,7 +349,8 @@ class RaftNode:
             index = entry.index
             if not self.peer_ids:
                 # single-node cluster: a majority of one holds it already
-                self._advance_commit()
+                applied = self._advance_commit()
+        self._notify_applied(applied)
         self._broadcast_append_entries()
         return index
 
@@ -382,6 +393,7 @@ class RaftNode:
             return
         payload = resp.payload if isinstance(resp.payload, dict) else {}
         rterm = payload.get("term", 0)
+        applied: list[LogEntry] = []
         with self._lock:
             if isinstance(rterm, int) and rterm > self.current_term:
                 self._step_down(rterm)
@@ -392,12 +404,14 @@ class RaftNode:
                 match = prev_idx + len(entries)
                 self.match_index[peer] = max(self.match_index.get(peer, 0), match)
                 self.next_index[peer] = self.match_index[peer] + 1
-                self._advance_commit()
+                applied = self._advance_commit()
             else:
                 self.next_index[peer] = max(1, self.next_index.get(peer, 1) - 1)
+        self._notify_applied(applied)
 
-    def _advance_commit(self) -> None:
-        """Commit entries replicated to a majority (current-term only)."""
+    def _advance_commit(self) -> list[LogEntry]:
+        """Commit entries replicated to a majority (current-term only).
+        Returns the newly applied entries for post-lock notification."""
         for idx in range(len(self.log), self.commit_index, -1):
             if self.log[idx - 1].term != self.current_term:
                 continue
@@ -406,23 +420,42 @@ class RaftNode:
             )
             if count >= (len(self.peer_ids) + 1) // 2 + 1:
                 self.commit_index = idx
-                self._apply_committed()
-                break
+                return self._apply_committed()
+        return []
 
-    def _apply_committed(self) -> None:
+    def _apply_committed(self) -> list[LogEntry]:
+        """Apply committed entries to storage (still under ``_lock``: the
+        state machine must advance in log order).  ``on_apply`` observers are
+        NOT invoked here — the callback is externally supplied code that may
+        take its own locks (e.g. Region._on_local_apply takes the outbox
+        lock) or block, and running it under the RPC lock stalls every
+        vote/append in flight (nornlint NL-LK03).  Callers collect the
+        returned entries and hand them to :meth:`_notify_applied` after
+        releasing ``_lock``."""
+        applied: list[LogEntry] = []
         while self.last_applied < self.commit_index:
             self.last_applied += 1
             entry = self.log[self.last_applied - 1]
             if self.storage is not None and entry.op:
                 apply_op(self.storage, entry.op, entry.data)
-            if self.on_apply is not None:
-                try:
-                    self.on_apply(entry)
-                except Exception:
-                    # the log entry IS applied; an observer callback crash
-                    # must not stall commit advancement, but it is a bug
-                    log.exception(
-                        "on_apply callback failed at index %d", self.last_applied)
+            applied.append(entry)
+        return applied
+
+    def _notify_applied(self, entries: list[LogEntry]) -> None:
+        """Fire on_apply outside ``_lock``.  Entries within one batch are
+        delivered in log order; batches acked on different transport threads
+        may overlap (observers needing total order must key by entry.index,
+        as Region's outbox does)."""
+        if self.on_apply is None:
+            return
+        for entry in entries:
+            try:
+                self.on_apply(entry)
+            except Exception:
+                # the log entry IS applied; an observer callback crash
+                # must not stall commit advancement, but it is a bug
+                log.exception(
+                    "on_apply callback failed at index %d", entry.index)
 
     # -- RPC handlers ----------------------------------------------------------------
     def _on_message(self, msg: Message) -> Optional[Message]:
@@ -525,10 +558,14 @@ class RaftNode:
             elif appended:
                 self._persist_log_append(appended)
             leader_commit = p.get("leader_commit", 0)
+            applied: list[LogEntry] = []
             if isinstance(leader_commit, int) and leader_commit > self.commit_index:
                 self.commit_index = min(leader_commit, len(self.log))
-                self._apply_committed()
-            return Message(0, {"term": self.current_term, "success": True})
+                applied = self._apply_committed()
+            reply = Message(0, {"term": self.current_term, "success": True})
+        # observers run after the RPC lock is released (see _apply_committed)
+        self._notify_applied(applied)
+        return reply
 
     # -- membership (ref: AddVoter raft.go:1368) -----------------------------------
     def add_voter(self, node_id: str) -> None:

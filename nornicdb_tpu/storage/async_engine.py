@@ -10,11 +10,14 @@ async_engine_count_flush_race_test.go, async_count_bug_test.go).
 
 from __future__ import annotations
 
+import logging
 import threading
 from typing import Iterable, Iterator, Optional
 
 from nornicdb_tpu.errors import AlreadyExistsError, NotFoundError
 from nornicdb_tpu.storage.types import Edge, Engine, Node
+
+log = logging.getLogger(__name__)
 
 _TOMBSTONE = object()
 
@@ -53,7 +56,10 @@ class AsyncEngine(Engine):
             try:
                 self.flush()
             except Exception:
-                pass
+                # the loop must survive, but a failing flush means the
+                # overlay is not draining — writes pile up silently
+                log.warning("background flush failed; retrying next tick",
+                            exc_info=True)
 
     def flush(self) -> None:
         """Drain the overlay into the base engine, preserving op order per id.
@@ -86,7 +92,9 @@ class AsyncEngine(Engine):
                 else:
                     self.base.update_node(val)  # type: ignore[arg-type]
             except Exception:
-                pass
+                # the overlay entry is already popped: this node write is
+                # LOST if we stay silent
+                log.error("flush dropped node op for %s", nid, exc_info=True)
         for eid, val in edges:
             try:
                 if val is _TOMBSTONE:
@@ -99,7 +107,8 @@ class AsyncEngine(Engine):
                 else:
                     self.base.update_edge(val)  # type: ignore[arg-type]
             except Exception:
-                pass
+                # same contract as the node loop above: dropped == lost
+                log.error("flush dropped edge op for %s", eid, exc_info=True)
         self.base.flush()
 
     # -- nodes -------------------------------------------------------------

@@ -10,7 +10,7 @@ from __future__ import annotations
 import json
 from typing import Any, Optional
 
-from nornicdb_tpu.errors import AlreadyExistsError
+from nornicdb_tpu.errors import AlreadyExistsError, NotFoundError
 from nornicdb_tpu.storage.types import Edge, Engine, Node
 
 
@@ -117,6 +117,8 @@ def load_mimir(engine: Engine, path: str) -> tuple[int, int]:
                 try:
                     engine.create_edge(edge)
                     n_edges += 1
-                except Exception:
+                except (AlreadyExistsError, NotFoundError):
+                    # duplicate relation, or a relation whose endpoint was
+                    # not part of the import — skip it, count the rest
                     pass
     return n_nodes, n_edges

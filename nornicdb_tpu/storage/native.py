@@ -42,12 +42,16 @@ def _build() -> bool:
     if not os.path.exists(src):
         return False
     try:
-        subprocess.run(
+        # deliberate subprocess under the module load lock: this is the
+        # build-once gate — it runs a single time per process, at load()
+        # time, and engines resolve the codec at init (WAL.__init__), never
+        # inside their own append/flush critical sections
+        subprocess.run(  # nornlint: disable=NL-LK02
             ["make", "-C", _NATIVE_DIR],
             check=True, capture_output=True, timeout=120,
         )
         return os.path.exists(_LIB_PATH)
-    except Exception:
+    except (subprocess.SubprocessError, OSError):
         return False
 
 
@@ -92,7 +96,11 @@ def enabled() -> bool:
 
 
 def encode(payload: bytes, seq: int) -> Optional[bytes]:
-    lib = load()
+    # hot path: uses the handle cached by a prior load()/enabled() call and
+    # never takes the module lock — WAL.append runs this under its own lock,
+    # and re-entering load() there would put the (first-call) compiler build
+    # inside the WAL critical section
+    lib = _lib
     if lib is None:
         return None
     cap = len(payload) + 32
@@ -109,7 +117,7 @@ _MIN_RECORD = 24  # header(9) + footer(12) padded to 8
 def scan(buf: bytes, max_records: int = 0):
     """Returns (records, valid_bytes) where records = [(payload, seq), ...],
     or None when the native library is unavailable."""
-    lib = load()
+    lib = _lib  # cached by a prior load()/enabled(); see encode()
     if lib is None:
         return None
     if max_records <= 0:

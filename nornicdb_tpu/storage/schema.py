@@ -9,12 +9,15 @@ this from key-prefix scans; a TPU-host build keeps it as a hash index).
 
 from __future__ import annotations
 
+import logging
 import threading
 from dataclasses import dataclass, field
 from typing import Any, Optional
 
 from nornicdb_tpu.errors import AlreadyExistsError, ConstraintViolationError, NotFoundError
 from nornicdb_tpu.storage.types import Engine, Node
+
+log = logging.getLogger(__name__)
 
 INDEX_PROPERTY = "property"
 INDEX_COMPOSITE = "composite"
@@ -294,6 +297,10 @@ class SchemaManager:
         try:
             nodes = engine.get_nodes_by_label(label)
         except Exception:
+            # an index created over a broken engine scan starts empty; log
+            # it, or the missing backfill looks like silent data loss later
+            log.warning("index backfill scan failed for label %r", label,
+                        exc_info=True)
             return
         for n in nodes:
             vals = tuple(_freeze(n.properties.get(p)) for p in properties)

@@ -23,12 +23,15 @@ from __future__ import annotations
 
 import ctypes
 import json
+import logging
 import os
 import threading
 from typing import Iterable, Iterator, Optional
 
 from nornicdb_tpu.errors import AlreadyExistsError, NornicError, NotFoundError
 from nornicdb_tpu.storage.types import Edge, Engine, Node
+
+log = logging.getLogger(__name__)
 
 # NORNICDB_NATIVE_DIR overrides for installed deployments (Docker image
 # places prebuilt .so files outside the source tree)
@@ -56,10 +59,14 @@ def _load_lib():
             import subprocess
 
             try:
-                subprocess.run(["make", "-C", _NATIVE_DIR], check=True,
+                # deliberate subprocess under the module load lock: the
+                # build-once gate runs a single time per process at first
+                # open(); engine locks are never held around _load_lib()
+                subprocess.run(["make", "-C", _NATIVE_DIR], check=True,  # nornlint: disable=NL-LK02
                                capture_output=True, timeout=120)
-            except Exception:
+            except (subprocess.SubprocessError, OSError) as e:
                 if not os.path.exists(_LIB_PATH):
+                    log.warning("segstore native build failed: %s", e)
                     return None
         try:
             lib = ctypes.CDLL(_LIB_PATH)
@@ -246,6 +253,11 @@ class SegmentEngine(Engine):
                         ok = (enc.decrypt(chk, aad=self._CHK_KEY)
                               == self._CHK_PLAINTEXT)
                     except Exception:
+                        # expected on a wrong passphrase (AEAD tag mismatch)
+                        # but the trace distinguishes that from a corrupt
+                        # check blob when operators debug an unopenable store
+                        log.debug("passphrase check decrypt failed",
+                                  exc_info=True)
                         ok = False
                     if not ok:
                         raise NornicError(
@@ -301,7 +313,9 @@ class SegmentEngine(Engine):
                         > self.COMPACT_RATIO):
                     self._kv.compact()
             except Exception:
-                pass  # storage may be mid-close; the next tick retries
+                # storage may be mid-close; the next tick retries
+                log.warning("background segment compaction failed",
+                            exc_info=True)
 
     # -- recovery ------------------------------------------------------------
     def _rebuild_indexes(self) -> None:

@@ -18,6 +18,7 @@ the last good record; preceding records are preserved.
 from __future__ import annotations
 
 import json
+import logging
 import os
 import struct
 import threading
@@ -28,6 +29,8 @@ from typing import Any, Callable, Optional
 from nornicdb_tpu.errors import WALCorruptionError
 from nornicdb_tpu.storage import native as _native
 from nornicdb_tpu.storage.types import Edge, Engine, Node
+
+log = logging.getLogger(__name__)
 
 MAGIC = b"NWAL"
 VERSION = 1
@@ -55,14 +58,19 @@ class WALEntry:
     data: dict[str, Any] = field(default_factory=dict)
     txid: Optional[str] = None
 
-    def encode(self, encryptor=None) -> bytes:
+    def encode(self, encryptor=None, use_native: bool = False) -> bytes:
+        """Frame one record. ``use_native`` is resolved ONCE by the owning
+        WAL at init (outside any lock): deciding here via _native.enabled()
+        would put the first-call dlopen — and possibly a compiler build —
+        inside WAL.append's critical section. Both codecs emit identical
+        bytes, so a bare encode() (tests, tooling) is format-compatible."""
         payload = json.dumps(
             {"op": self.op, "data": self.data, "txid": self.txid},
             separators=(",", ":"),
         ).encode("utf-8")
         if encryptor is not None:
             payload = encryptor.encrypt(payload)
-        if _native.enabled():
+        if use_native:
             native_rec = _native.encode(payload, self.seq)
             if native_rec is not None:
                 return native_rec
@@ -97,7 +105,9 @@ def apply_storage_op(engine: Engine, op: str, d: dict[str, Any]) -> None:
         elif op == OP_UNMARK_PENDING:
             engine.unmark_pending_embed(d["id"])
     except Exception:
-        pass
+        # tolerated (duplicate create / missing delete after a snapshot
+        # race), but silent data divergence is undebuggable — leave a trace
+        log.debug("replayed op %s skipped", op, exc_info=True)
 
 
 @dataclass
@@ -135,6 +145,10 @@ class WAL:
         os.makedirs(directory, exist_ok=True)
         self._path = os.path.join(directory, self.LOG_NAME)
         self._lock = threading.Lock()
+        # resolve the native codec HERE, before any append can run: the
+        # first _native.enabled() call dlopens (and may `make`-build) the
+        # library — work that must never happen inside the append lock
+        self._use_native = _native.enabled()
         self.stats = WALStats()
         self._encryptor = None
         if passphrase:
@@ -152,7 +166,9 @@ class WAL:
             if snap is not None:
                 self._seq = max(self._seq, int(snap.get("seq", 0)))
         except Exception:
-            pass  # corrupt/locked snapshot surfaces at recover(), not here
+            # corrupt/locked snapshot surfaces at recover(), not here
+            log.debug("snapshot seq probe failed during WAL open",
+                      exc_info=True)
         if self.stats.degraded:
             self._quarantine_corrupt_log()
         self._f = open(self._path, "ab")
@@ -162,11 +178,13 @@ class WAL:
         with self._lock:
             self._seq += 1
             entry = WALEntry(seq=self._seq, op=op, data=data, txid=txid)
-            raw = entry.encode(self._encryptor)
+            raw = entry.encode(self._encryptor, use_native=self._use_native)
             self._f.write(raw)
             self._f.flush()
             if self.sync:
-                os.fsync(self._f.fileno())
+                # deliberate fsync under the WAL lock: sync=True is the
+                # durability mode — records must hit disk in seq order
+                os.fsync(self._f.fileno())  # nornlint: disable=NL-LK02
             self.stats.entries += 1
             self.stats.bytes_written += len(raw)
             return self._seq
@@ -302,7 +320,7 @@ class WAL:
             buf = b""
         with open(self._path, "wb") as out:
             for e in self._parse_buffer(buf):
-                out.write(e.encode(self._encryptor))
+                out.write(e.encode(self._encryptor, use_native=self._use_native))
             out.flush()
             os.fsync(out.fileno())
         self.stats.corruption_info += (
@@ -328,6 +346,9 @@ class WAL:
             try:
                 obj = json.loads(self._decrypt(payload).decode("utf-8"))
             except Exception:
+                # corrupt record: keep only the prefix (quarantine semantics)
+                log.warning("undecodable WAL record at offset %d stops the "
+                            "salvage scan", off, exc_info=True)
                 break
             entries.append(WALEntry(seq=seq, op=obj["op"],
                                     data=obj.get("data", {}),
@@ -371,7 +392,10 @@ class WAL:
         with open(tmp, "wb") as f:
             f.write(blob)
             f.flush()
-            os.fsync(f.fileno())
+            # deliberate fsync under the compact lock (never the mutation
+            # lock): _compact_lock exists to host exactly this slow disk
+            # work so concurrent appends don't stall — see WALEngine.compact
+            os.fsync(f.fileno())  # nornlint: disable=NL-LK02
         os.replace(tmp, path)
         self.stats.snapshots += 1
         return path
@@ -393,9 +417,12 @@ class WAL:
             tmp = self._path + ".tmp"
             with open(tmp, "wb") as f:
                 for e in keep:
-                    f.write(e.encode(self._encryptor))
+                    f.write(e.encode(self._encryptor, use_native=self._use_native))
                 f.flush()
-                os.fsync(f.fileno())
+                # deliberate fsync under the WAL lock: truncation races an
+                # in-flight append otherwise — same serialized-durability
+                # contract as append() itself
+                os.fsync(f.fileno())  # nornlint: disable=NL-LK02
             os.replace(tmp, self._path)
             self._f = open(self._path, "ab")
 
@@ -505,7 +532,10 @@ class WALEngine(Engine):
         try:
             self.compact()
         except Exception:
-            pass
+            # the next tick retries, but a persistently failing compaction
+            # means unbounded log growth — operators need the trace
+            log.warning("WAL auto-compaction failed; will retry",
+                        exc_info=True)
         self._schedule_compact()
 
     def compact(self) -> None:

@@ -9,6 +9,11 @@ modes as machine-checked rules:
 * **Concurrency rules** — ``Lock.acquire()`` without ``with``/try-finally
   (NL-CC01), unlocked mutation of module-level mutable state in threaded
   modules (NL-CC02).
+* **Interprocedural lock rules** (v2, ``interproc.py``) — lock-order
+  inversion cycles across the package call graph (NL-LK01), blocking
+  I/O/RPC/join/device-sync under a held lock (NL-LK02), callbacks invoked
+  under a lock they may re-acquire (NL-LK03).  Runtime counterpart:
+  ``nornicdb_tpu.tools.nornsan`` (``NORNSAN=1``).
 * **Error hygiene** — bare ``except:`` (NL-ERR01), silently swallowed
   ``except Exception`` (NL-ERR02), mutable default args (NL-ERR03).
 * **Timing** — wall-clock ``time.time()`` used for durations (NL-TM01).
@@ -22,14 +27,18 @@ with ``--update-baseline``).  See ``docs/linting.md``.
 from .core import Finding, ModuleContext, Rule, RULES, lint_paths, lint_source
 from .baseline import Baseline, diff_against_baseline
 
-# Importing rules registers them with the RULES registry.
+# Importing rules registers them with the RULES registry; importing
+# interproc registers the project-level (interprocedural) rules.
 from . import rules as _rules  # noqa: F401
+from .interproc import PROJECT_RULES, ProjectContext
 
 __all__ = [
     "Finding",
     "ModuleContext",
+    "ProjectContext",
     "Rule",
     "RULES",
+    "PROJECT_RULES",
     "lint_paths",
     "lint_source",
     "Baseline",

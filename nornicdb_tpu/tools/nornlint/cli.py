@@ -14,6 +14,7 @@ from typing import Optional, Sequence
 
 from .baseline import Baseline, DEFAULT_BASELINE_RELPATH, diff_against_baseline
 from .core import RULES, find_repo_root, iter_py_files, lint_paths, relpath_for
+from .interproc import PROJECT_RULES
 
 
 def _default_baseline(root: Path) -> Path:
@@ -47,7 +48,8 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
     args = build_parser().parse_args(argv)
 
     if args.list_rules:
-        for rule in sorted(RULES.values(), key=lambda r: r.id):
+        all_rules = {**RULES, **PROJECT_RULES}
+        for rule in sorted(all_rules.values(), key=lambda r: r.id):
             print(f"{rule.id:10} [{rule.severity:7}] {rule.description}")
         return 0
 
@@ -67,7 +69,7 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
                   "--update-baseline", file=sys.stderr)
             return 2
         select = {r.strip() for r in args.select.split(",") if r.strip()}
-        unknown = select - set(RULES)
+        unknown = select - set(RULES) - set(PROJECT_RULES)
         if unknown:
             print(f"nornlint: unknown rule(s): {', '.join(sorted(unknown))}",
                   file=sys.stderr)

@@ -124,26 +124,23 @@ def dotted_name(node: ast.AST) -> Optional[str]:
     return None
 
 
-def lint_source(
-    source: str,
-    relpath: str = "<string>",
-    select: Optional[set[str]] = None,
-) -> list[Finding]:
-    """Lint one module's source text; used by the CLI and the self-tests."""
+def _parse_module(source: str, relpath: str):
+    """(ModuleContext, None) or (None, syntax Finding)."""
     try:
         tree = ast.parse(source, filename=relpath)
     except SyntaxError as e:
-        return [
-            Finding(
-                rule="NL-SYNTAX",
-                severity="error",
-                path=relpath,
-                line=e.lineno or 1,
-                col=e.offset or 0,
-                message=f"syntax error: {e.msg}",
-            )
-        ]
-    ctx = ModuleContext(relpath, source, tree)
+        return None, Finding(
+            rule="NL-SYNTAX",
+            severity="error",
+            path=relpath,
+            line=e.lineno or 1,
+            col=e.offset or 0,
+            message=f"syntax error: {e.msg}",
+        )
+    return ModuleContext(relpath, source, tree), None
+
+
+def _module_findings(ctx: ModuleContext, select: Optional[set[str]]) -> list[Finding]:
     findings: list[Finding] = []
     for rule in RULES.values():
         if select is not None and rule.id not in select:
@@ -151,6 +148,26 @@ def lint_source(
         for f in rule.check(ctx):
             if not ctx.is_suppressed(f.rule, f.line):
                 findings.append(f)
+    return findings
+
+
+def lint_source(
+    source: str,
+    relpath: str = "<string>",
+    select: Optional[set[str]] = None,
+) -> list[Finding]:
+    """Lint one module's source text; used by the CLI and the self-tests.
+
+    Project (interprocedural) rules run too, over a one-module project —
+    enough for intra-module inversions; cross-module analysis needs
+    lint_paths over the whole package."""
+    from .interproc import run_project_rules  # local: avoids import cycle
+
+    ctx, syntax = _parse_module(source, relpath)
+    if ctx is None:
+        return [syntax]
+    findings = _module_findings(ctx, select)
+    findings.extend(run_project_rules([ctx], select=select))
     # Finding is frozen/hashable: dedupe identical hits from overlapping scans
     findings = sorted(set(findings), key=lambda f: (f.path, f.line, f.col, f.rule))
     return findings
@@ -177,9 +194,17 @@ def lint_paths(
     root: Optional[Path] = None,
     select: Optional[set[str]] = None,
 ) -> list[Finding]:
-    """Lint files/trees; finding paths are reported relative to ``root``."""
+    """Lint files/trees; finding paths are reported relative to ``root``.
+
+    Module rules run per file; project (interprocedural) rules run once
+    over every parsed module together, so cross-module lock-order cycles
+    and propagated held-lock sets are visible. A scoped scan only sees the
+    relations inside its scope — the CI gate scans the whole package."""
+    from .interproc import run_project_rules  # local: avoids import cycle
+
     root = (root or Path.cwd()).resolve()
     findings: list[Finding] = []
+    ctxs: list[ModuleContext] = []
     for path in iter_py_files(paths):
         rel = relpath_for(path, root)
         try:
@@ -189,7 +214,14 @@ def lint_paths(
                 Finding("NL-IO", "error", rel, 1, 0, f"unreadable: {e}")
             )
             continue
-        findings.extend(lint_source(source, rel, select=select))
+        ctx, syntax = _parse_module(source, rel)
+        if ctx is None:
+            findings.append(syntax)
+            continue
+        ctxs.append(ctx)
+        findings.extend(_module_findings(ctx, select))
+    findings.extend(run_project_rules(ctxs, select=select))
+    findings = sorted(set(findings), key=lambda f: (f.path, f.line, f.col, f.rule))
     return findings
 
 

@@ -1,0 +1,937 @@
+"""nornlint v2 — interprocedural lock analysis over a whole package.
+
+Per-module rules (rules.py) see one function at a time; the failure modes
+that actually take down a threaded serving deployment are *relational*:
+thread 1 takes lock A then B, thread 2 takes B then A; an RPC is issued
+while a registry lock is held three frames up the stack; a user callback
+fires under a state lock and re-enters the object. This module builds the
+package-wide structures those rules need:
+
+* a **class table** — every class, its (import-resolved) bases, the locks it
+  binds on ``self``, and attribute/parameter/local types recovered from
+  annotations and direct ``ClassName(...)`` construction;
+* a **call graph** — call sites resolved through ``self.method``, module
+  functions, imported names, typed ``self.attr.method`` chains, and locally
+  typed variables;
+* a **lock-order graph** — which lock *identities* (class attribute or
+  module global, not instances) are held at every acquisition and call
+  site, propagated through the call graph to a bounded depth.
+
+On top of these, three project rules (registered in ``PROJECT_RULES``):
+
+* **NL-LK01** — lock-order inversion: a cycle in the acquisition-order
+  graph.  Reported once per cycle with a witness site per edge.
+* **NL-LK02** — blocking call under lock: network/process I/O, fsync,
+  ``Thread.join``, untimed ``queue.get``/``.wait()``, ``time.sleep``, or a
+  device sync (``jax.block_until_ready`` / ``.item()``) while any lock is
+  held, directly or via callers.
+* **NL-LK03** — lock-scope escape: a callback / externally supplied
+  callable invoked while holding a lock it may re-acquire.
+
+The runtime counterpart (tools/nornsan) observes *actual* acquisition
+orders during the concurrency/replication tests; a static NL-LK01 hit that
+nornsan never observes is a candidate false positive, and a nornsan cycle
+that NL-LK01 missed is a resolution gap worth closing.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+from typing import Iterable, Iterator, Optional
+
+from .core import Finding, ModuleContext, Rule, dotted_name
+
+# Locks held through more than this many call-graph hops are not reported:
+# long chains are increasingly likely to cross a dispatch boundary the
+# resolver got wrong, and the report becomes unactionable.
+MAX_HELD_DEPTH = 4
+
+_LOCK_FACTORY = {"Lock", "RLock", "Condition", "Semaphore", "BoundedSemaphore"}
+_LOCKISH_FRAGMENTS = ("lock", "mutex")
+_CALLBACKISH_NAMES = {
+    "fn", "cb", "callback", "func", "handler", "hook", "target", "listener",
+    "thunk",
+}
+# Injected time sources (`self.now = now_fn`) are callables by signature but
+# pure by convention — the pervasive testability pattern would drown NL-LK03
+# in noise, so they are exempt.
+_CLOCK_NAMES = {"now", "clock", "now_fn", "time_fn"}
+
+
+def _is_lockish(name: str) -> bool:
+    leaf = name.split(".")[-1].lower()
+    return any(f in leaf for f in _LOCKISH_FRAGMENTS)
+
+
+def _callbackish(name: str) -> bool:
+    leaf = name.split(".")[-1]
+    return leaf in _CALLBACKISH_NAMES or leaf.startswith("on_")
+
+
+def _annotation_class(node: Optional[ast.expr]) -> Optional[str]:
+    """First plausible class name inside an annotation: ``Transport``,
+    ``Optional[Transport]``, ``"Transport"`` — skipping typing wrappers."""
+    _TYPING = {
+        "Optional", "Union", "List", "Dict", "Tuple", "Set", "Iterable",
+        "Iterator", "Sequence", "Mapping", "Any", "Callable", "list", "dict",
+        "tuple", "set", "type", "Type", "None",
+    }
+    if node is None:
+        return None
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        name = node.value.strip()
+        return name if name.isidentifier() else None
+    for sub in ast.walk(node):
+        d = dotted_name(sub)
+        if d and d.split(".")[-1] not in _TYPING and d.split(".")[0] not in _TYPING:
+            return d
+    return None
+
+
+def _annotation_is_callable(node: Optional[ast.expr]) -> bool:
+    if node is None:
+        return False
+    for sub in ast.walk(node):
+        if isinstance(sub, ast.Name) and sub.id == "Callable":
+            return True
+        if isinstance(sub, ast.Attribute) and sub.attr == "Callable":
+            return True
+        if isinstance(sub, ast.Constant) and isinstance(sub.value, str) \
+                and "Callable" in sub.value:
+            return True
+    return True if (dotted_name(node) or "") == "Handler" else False
+
+
+@dataclasses.dataclass
+class ClassInfo:
+    key: str                 # "relpath::ClassName"
+    name: str
+    relpath: str
+    node: ast.ClassDef
+    base_refs: list[str] = dataclasses.field(default_factory=list)
+    attr_locks: dict[str, str] = dataclasses.field(default_factory=dict)
+    attr_types: dict[str, str] = dataclasses.field(default_factory=dict)
+    attr_callbacks: set[str] = dataclasses.field(default_factory=set)
+    methods: dict[str, "FunctionInfo"] = dataclasses.field(default_factory=dict)
+
+
+@dataclasses.dataclass
+class Acquisition:
+    lock: str
+    held: tuple[str, ...]    # syntactically held at this point
+    node: ast.AST
+    fn: "FunctionInfo"
+
+
+@dataclasses.dataclass
+class CallSite:
+    callees: tuple[str, ...]  # resolved FunctionInfo qualnames
+    held: tuple[str, ...]
+    node: ast.AST
+    fn: "FunctionInfo"
+
+
+@dataclasses.dataclass
+class BlockingCall:
+    reason: str
+    held: tuple[str, ...]
+    node: ast.AST
+    fn: "FunctionInfo"
+
+
+@dataclasses.dataclass
+class EscapeCall:
+    what: str
+    held: tuple[str, ...]
+    node: ast.AST
+    fn: "FunctionInfo"
+
+
+@dataclasses.dataclass
+class FunctionInfo:
+    qualname: str            # "relpath::Class.meth" | "relpath::func"
+    relpath: str
+    name: str
+    node: ast.AST
+    cls: Optional[str] = None          # ClassInfo key
+    acquisitions: list[Acquisition] = dataclasses.field(default_factory=list)
+    calls: list[CallSite] = dataclasses.field(default_factory=list)
+    blocking: list[BlockingCall] = dataclasses.field(default_factory=list)
+    escapes: list[EscapeCall] = dataclasses.field(default_factory=list)
+
+    def display(self) -> str:
+        return self.qualname.split("::", 1)[-1]
+
+
+class ModuleInfo:
+    """Import maps + module-level state for one ModuleContext."""
+
+    def __init__(self, ctx: ModuleContext):
+        self.ctx = ctx
+        self.relpath = ctx.relpath
+        self.modname = ctx.relpath.removesuffix(".py").removesuffix("/__init__") \
+            .replace("/", ".")
+        self.import_alias: dict[str, str] = {}   # local name -> dotted module
+        self.from_imports: dict[str, tuple[str, str]] = {}  # name -> (mod, attr)
+        self.module_locks: set[str] = set()
+        self.functions: dict[str, str] = {}      # local fn name -> qualname
+        self.classes: dict[str, str] = {}        # local class name -> class key
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, ast.Import):
+                for a in node.names:
+                    self.import_alias[a.asname or a.name.split(".")[0]] = (
+                        a.name if a.asname else a.name.split(".")[0]
+                    )
+            elif isinstance(node, ast.ImportFrom) and node.module and node.level == 0:
+                for a in node.names:
+                    self.from_imports[a.asname or a.name] = (node.module, a.name)
+        for stmt in ctx.tree.body:
+            if isinstance(stmt, (ast.Assign, ast.AnnAssign)):
+                targets = stmt.targets if isinstance(stmt, ast.Assign) else [stmt.target]
+                value = stmt.value
+                if isinstance(value, ast.Call):
+                    leaf = (dotted_name(value.func) or "").split(".")[-1]
+                    if leaf in _LOCK_FACTORY:
+                        for t in targets:
+                            if isinstance(t, ast.Name):
+                                self.module_locks.add(t.id)
+
+
+class ProjectContext:
+    """Every scanned module, plus the package-wide tables built from them."""
+
+    def __init__(self, ctxs: list[ModuleContext]):
+        self.ctxs = {c.relpath: c for c in ctxs}
+        self.modules: dict[str, ModuleInfo] = {}
+        self.by_modname: dict[str, ModuleInfo] = {}
+        self.classes: dict[str, ClassInfo] = {}
+        self.class_by_name: dict[str, list[str]] = {}
+        self.functions: dict[str, FunctionInfo] = {}
+        for ctx in ctxs:
+            mi = ModuleInfo(ctx)
+            self.modules[ctx.relpath] = mi
+            self.by_modname[mi.modname] = mi
+        for mi in self.modules.values():
+            self._collect_defs(mi)
+        for mi in self.modules.values():
+            self._collect_class_attrs(mi)
+        for fi in self.functions.values():
+            _FunctionWalker(self, fi).run()
+        self.entry_held: dict[str, dict[str, tuple[int, Optional[tuple[str, int]]]]] = {}
+        self._propagate_held()
+
+    # -- definition collection ---------------------------------------------
+    def _collect_defs(self, mi: ModuleInfo) -> None:
+        for stmt in mi.ctx.tree.body:
+            if isinstance(stmt, ast.ClassDef):
+                key = f"{mi.relpath}::{stmt.name}"
+                ci = ClassInfo(key=key, name=stmt.name, relpath=mi.relpath,
+                               node=stmt)
+                ci.base_refs = [dotted_name(b) or "" for b in stmt.bases]
+                self.classes[key] = ci
+                self.class_by_name.setdefault(stmt.name, []).append(key)
+                mi.classes[stmt.name] = key
+                for sub in stmt.body:
+                    if isinstance(sub, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                        q = f"{mi.relpath}::{stmt.name}.{sub.name}"
+                        fi = FunctionInfo(qualname=q, relpath=mi.relpath,
+                                          name=sub.name, node=sub, cls=key)
+                        ci.methods[sub.name] = fi
+                        self.functions[q] = fi
+            elif isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                q = f"{mi.relpath}::{stmt.name}"
+                fi = FunctionInfo(qualname=q, relpath=mi.relpath,
+                                  name=stmt.name, node=stmt)
+                self.functions[q] = fi
+                mi.functions[stmt.name] = q
+
+    def _collect_class_attrs(self, mi: ModuleInfo) -> None:
+        for key in mi.classes.values():
+            ci = self.classes[key]
+            for meth in ci.methods.values():
+                params = _param_annotations(meth.node)
+                for node in ast.walk(meth.node):
+                    target = None
+                    value = None
+                    annotation = None
+                    if isinstance(node, ast.Assign) and len(node.targets) == 1:
+                        target, value = node.targets[0], node.value
+                    elif isinstance(node, ast.AnnAssign):
+                        target, value, annotation = node.target, node.value, node.annotation
+                    if not (
+                        isinstance(target, ast.Attribute)
+                        and isinstance(target.value, ast.Name)
+                        and target.value.id == "self"
+                    ):
+                        continue
+                    attr = target.attr
+                    if _annotation_is_callable(annotation):
+                        ci.attr_callbacks.add(attr)
+                    if isinstance(value, ast.Call):
+                        leaf_name = dotted_name(value.func) or ""
+                        leaf = leaf_name.split(".")[-1]
+                        if leaf in _LOCK_FACTORY:
+                            ci.attr_locks[attr] = leaf
+                            continue
+                        resolved = self.resolve_class_ref(leaf_name, mi)
+                        if resolved:
+                            ci.attr_types[attr] = resolved
+                            continue
+                    if isinstance(value, ast.Name):
+                        pann = params.get(value.id)
+                        if pann is not None:
+                            if _annotation_is_callable(pann):
+                                ci.attr_callbacks.add(attr)
+                            cname = _annotation_class(pann)
+                            resolved = self.resolve_class_ref(cname or "", mi)
+                            if resolved:
+                                ci.attr_types[attr] = resolved
+                        elif _callbackish(value.id):
+                            ci.attr_callbacks.add(attr)
+                    cname = _annotation_class(annotation)
+                    if cname:
+                        resolved = self.resolve_class_ref(cname, mi)
+                        if resolved:
+                            ci.attr_types[attr] = resolved
+                    if attr.startswith("on_") and attr not in ci.attr_types:
+                        ci.attr_callbacks.add(attr)
+
+    # -- resolution ---------------------------------------------------------
+    def resolve_module_ref(self, dotted: str, mi: ModuleInfo) -> Optional[ModuleInfo]:
+        if dotted in self.by_modname:
+            return self.by_modname[dotted]
+        alias = mi.import_alias.get(dotted)
+        if alias and alias in self.by_modname:
+            return self.by_modname[alias]
+        pair = mi.from_imports.get(dotted)
+        if pair:
+            full = f"{pair[0]}.{pair[1]}"
+            if full in self.by_modname:
+                return self.by_modname[full]
+        return None
+
+    def resolve_class_ref(self, ref: str, mi: ModuleInfo) -> Optional[str]:
+        """Class key for a (possibly dotted) class reference in module mi."""
+        if not ref:
+            return None
+        parts = ref.split(".")
+        leaf = parts[-1]
+        if len(parts) == 1:
+            if ref in mi.classes:
+                return mi.classes[ref]
+            pair = mi.from_imports.get(ref)
+            if pair:
+                target = self.by_modname.get(pair[0])
+                if target and pair[1] in target.classes:
+                    return target.classes[pair[1]]
+        else:
+            owner = self.resolve_module_ref(".".join(parts[:-1]), mi)
+            if owner and leaf in owner.classes:
+                return owner.classes[leaf]
+        # unique global fallback (class imported indirectly / re-exported)
+        keys = self.class_by_name.get(leaf, [])
+        if len(keys) == 1:
+            return keys[0]
+        return None
+
+    def mro(self, key: str) -> Iterator[ClassInfo]:
+        """The class and its package-resolvable bases, subclass first."""
+        seen: set[str] = set()
+        stack = [key]
+        while stack:
+            k = stack.pop(0)
+            if k in seen or k not in self.classes:
+                continue
+            seen.add(k)
+            ci = self.classes[k]
+            yield ci
+            mi = self.modules[ci.relpath]
+            for b in ci.base_refs:
+                bk = self.resolve_class_ref(b, mi)
+                if bk:
+                    stack.append(bk)
+
+    def find_method(self, cls_key: str, name: str) -> Optional[FunctionInfo]:
+        for ci in self.mro(cls_key):
+            if name in ci.methods:
+                return ci.methods[name]
+        return None
+
+    def find_attr_lock(self, cls_key: str, attr: str) -> Optional[str]:
+        """Lock id for self.<attr>, anchored at the defining class."""
+        for ci in self.mro(cls_key):
+            if attr in ci.attr_locks:
+                return f"{ci.name}.{attr}@{ci.relpath}"
+        return None
+
+    def find_attr_type(self, cls_key: str, attr: str) -> Optional[str]:
+        for ci in self.mro(cls_key):
+            if attr in ci.attr_types:
+                return ci.attr_types[attr]
+        return None
+
+    def find_attr_callback(self, cls_key: str, attr: str) -> bool:
+        return any(attr in ci.attr_callbacks for ci in self.mro(cls_key))
+
+    # -- interprocedural held-lock propagation ------------------------------
+    def _propagate_held(self) -> None:
+        """Fixed point: locks held at a call site (syntactically, or already
+        held at the caller's entry) are held at the callee's entry, up to
+        MAX_HELD_DEPTH hops.  entry_held[fn][lock] = (depth, provenance)."""
+        entry = {q: {} for q in self.functions}
+        worklist = list(self.functions.values())
+        while worklist:
+            fi = worklist.pop()
+            base = entry[fi.qualname]
+            for site in fi.calls:
+                line = getattr(site.node, "lineno", 0)
+                incoming: dict[str, tuple[int, Optional[tuple[str, int]]]] = {}
+                for lock in site.held:
+                    incoming[lock] = (1, (fi.qualname, line))
+                for lock, (depth, _prov) in base.items():
+                    if depth + 1 <= MAX_HELD_DEPTH and (
+                        lock not in incoming or incoming[lock][0] > depth + 1
+                    ):
+                        incoming[lock] = (depth + 1, (fi.qualname, line))
+                if not incoming:
+                    continue
+                for callee in site.callees:
+                    dest = entry.get(callee)
+                    if dest is None:
+                        continue
+                    changed = False
+                    for lock, (depth, prov) in incoming.items():
+                        if lock not in dest or dest[lock][0] > depth:
+                            dest[lock] = (depth, prov)
+                            changed = True
+                    if changed:
+                        worklist.append(self.functions[callee])
+        self.entry_held = entry
+
+    def held_at(self, fi: FunctionInfo, syntactic: tuple[str, ...]) -> dict[str, Optional[tuple[str, int]]]:
+        """All locks held at a site: syntactic plus caller-propagated."""
+        out: dict[str, Optional[tuple[str, int]]] = {l: None for l in syntactic}
+        for lock, (_depth, prov) in self.entry_held.get(fi.qualname, {}).items():
+            out.setdefault(lock, prov)
+        return out
+
+    def provenance_chain(self, fi: FunctionInfo, lock: str, limit: int = 4) -> str:
+        """Human-readable 'held since' chain for a propagated lock."""
+        steps: list[str] = []
+        q = fi.qualname
+        for _ in range(limit):
+            info = self.entry_held.get(q, {}).get(lock)
+            if info is None or info[1] is None:
+                break
+            caller, line = info[1]
+            cfi = self.functions.get(caller)
+            steps.append(f"{cfi.display() if cfi else caller}:{line}")
+            if cfi is None or lock not in self.entry_held.get(caller, {}):
+                break
+            q = caller
+        return " <- ".join(steps)
+
+
+def _param_annotations(fn_node: ast.AST) -> dict[str, Optional[ast.expr]]:
+    args = fn_node.args
+    out: dict[str, Optional[ast.expr]] = {}
+    for a in list(args.posonlyargs) + list(args.args) + list(args.kwonlyargs):
+        out[a.arg] = a.annotation
+    return out
+
+
+def lock_display(lock_id: str) -> str:
+    """'RaftNode._lock (replication/raft.py)' from the internal id."""
+    if "@" in lock_id:
+        name, rel = lock_id.rsplit("@", 1)
+        short = rel.split("/", 1)[-1] if "/" in rel else rel
+        return f"{name} ({short})"
+    return lock_id
+
+
+# ---------------------------------------------------------------------------
+# Per-function walker: held ranges, acquisitions, call sites, blocking calls
+# ---------------------------------------------------------------------------
+
+_BLOCKING_ROOTS = {"socket", "requests", "urllib", "subprocess"}
+_SOCKET_METHODS = {"recv", "recv_into", "accept", "sendall", "makefile"}
+
+
+class _FunctionWalker:
+    def __init__(self, project: ProjectContext, fi: FunctionInfo):
+        self.project = project
+        self.fi = fi
+        self.mi = project.modules[fi.relpath]
+        self.params = _param_annotations(fi.node)
+        self.local_types: dict[str, str] = {}   # var -> class key
+        self.local_locks: set[str] = set()
+        self.callbackish_locals: set[str] = set()
+        for name, ann in self.params.items():
+            cname = _annotation_class(ann)
+            key = project.resolve_class_ref(cname or "", self.mi)
+            if key:
+                self.local_types[name] = key
+        self._prescan()
+
+    def _prescan(self) -> None:
+        """Local lock creations, local ClassName(...) types, return-typed
+        locals, and loop vars over callback collections."""
+        for node in ast.walk(self.fi.node):
+            if isinstance(node, ast.Assign) and len(node.targets) == 1 \
+                    and isinstance(node.targets[0], ast.Name):
+                name = node.targets[0].id
+                v = node.value
+                if isinstance(v, ast.Call):
+                    ref = dotted_name(v.func) or ""
+                    leaf = ref.split(".")[-1]
+                    if leaf in _LOCK_FACTORY:
+                        self.local_locks.add(name)
+                        continue
+                    key = self.project.resolve_class_ref(ref, self.mi)
+                    if key:
+                        self.local_types[name] = key
+                        continue
+                    target = self._resolve_callee(v)
+                    if len(target) == 1:
+                        ret = getattr(
+                            self.project.functions[target[0]].node, "returns", None
+                        )
+                        rkey = self.project.resolve_class_ref(
+                            _annotation_class(ret) or "",
+                            self.project.modules[
+                                self.project.functions[target[0]].relpath],
+                        )
+                        if rkey:
+                            self.local_types[name] = rkey
+            elif isinstance(node, (ast.For, ast.AsyncFor)):
+                if isinstance(node.target, ast.Name):
+                    src = dotted_name(node.iter) or ""
+                    if _callbackish(node.target.id) or "callback" in src.lower() \
+                            or "listener" in src.lower() or "hook" in src.lower():
+                        if _callbackish(node.target.id):
+                            self.callbackish_locals.add(node.target.id)
+
+    # -- lock identity ------------------------------------------------------
+    def resolve_lock(self, expr: ast.expr) -> Optional[str]:
+        d = dotted_name(expr)
+        if d is None:
+            return None
+        parts = d.split(".")
+        if parts[0] == "self" and self.fi.cls and len(parts) == 2:
+            found = self.project.find_attr_lock(self.fi.cls, parts[1])
+            if found:
+                return found
+            if _is_lockish(parts[1]):
+                cname = self.project.classes[self.fi.cls].name
+                return f"{cname}.{parts[1]}@{self.fi.relpath}"
+            return None
+        if len(parts) == 1:
+            if d in self.mi.module_locks:
+                return f"{d}@{self.fi.relpath}"
+            if d in self.local_locks:
+                return f"{self.fi.display()}.{d}@{self.fi.relpath}"
+            if _is_lockish(d):
+                return f"{d}@{self.fi.relpath}"
+            return None
+        if _is_lockish(parts[-1]):
+            return f"{d}@{self.fi.relpath}"
+        return None
+
+    def _lock_kind(self, lock_id: str) -> Optional[str]:
+        """'Lock'/'RLock'/'Condition' when the identity is a known binding."""
+        name = lock_id.split("@", 1)[0]
+        if "." in name:
+            cls_name, attr = name.rsplit(".", 1)
+            for keys in self.project.class_by_name.get(cls_name, []):
+                kind = self.project.classes[keys].attr_locks.get(attr)
+                if kind:
+                    return kind
+        return None
+
+    # -- call resolution ----------------------------------------------------
+    def _resolve_callee(self, call: ast.Call) -> tuple[str, ...]:
+        d = dotted_name(call.func)
+        if d is None:
+            return ()
+        parts = d.split(".")
+        project, mi = self.project, self.mi
+        if parts[0] == "self" and self.fi.cls:
+            if len(parts) == 2:
+                m = project.find_method(self.fi.cls, parts[1])
+                return (m.qualname,) if m else ()
+            if len(parts) == 3:
+                t = project.find_attr_type(self.fi.cls, parts[1])
+                if t:
+                    m = project.find_method(t, parts[2])
+                    return (m.qualname,) if m else ()
+            return ()
+        if len(parts) == 1:
+            if d in mi.functions:
+                return (mi.functions[d],)
+            pair = mi.from_imports.get(d)
+            if pair:
+                target = project.by_modname.get(pair[0])
+                if target and pair[1] in target.functions:
+                    return (target.functions[pair[1]],)
+            key = project.resolve_class_ref(d, mi)
+            if key:
+                m = project.find_method(key, "__init__")
+                return (m.qualname,) if m else ()
+            if d in self.local_types:
+                return ()
+            return ()
+        if len(parts) == 2 and parts[0] in self.local_types:
+            m = project.find_method(self.local_types[parts[0]], parts[1])
+            return (m.qualname,) if m else ()
+        owner = project.resolve_module_ref(".".join(parts[:-1]), mi)
+        if owner:
+            if parts[-1] in owner.functions:
+                return (owner.functions[parts[-1]],)
+            if parts[-1] in owner.classes:
+                m = project.find_method(owner.classes[parts[-1]], "__init__")
+                return (m.qualname,) if m else ()
+        return ()
+
+    # -- blocking classification -------------------------------------------
+    def _classify_blocking(self, call: ast.Call) -> Optional[str]:
+        func = call.func
+        d = dotted_name(func)
+        kwnames = {k.arg for k in call.keywords}
+        if d:
+            root = d.split(".")[0]
+            if root in _BLOCKING_ROOTS and isinstance(func, ast.Attribute):
+                return f"{d}() performs network/process I/O"
+            if d == "time.sleep":
+                return "time.sleep() stalls every thread waiting on the lock"
+            if d in ("os.fsync", "os.fdatasync"):
+                return f"{d}() blocks on storage flush"
+            if d == "jax.block_until_ready":
+                return "jax.block_until_ready() synchronises with the device"
+        if not isinstance(func, ast.Attribute):
+            return None
+        attr = func.attr
+        recv = dotted_name(func.value) or ""
+        if attr == "block_until_ready":
+            return ".block_until_ready() synchronises with the device"
+        if attr == "item" and not call.args and not call.keywords \
+                and ("jax" in self.mi.ctx.imports or "jnp" in recv):
+            return ".item() forces a device->host sync"
+        if attr in _SOCKET_METHODS and not isinstance(func.value, ast.Constant):
+            return f".{attr}() blocks on socket I/O"
+        if attr == "request" and "transport" in recv.lower():
+            return "transport RPC blocks until the peer replies (or times out)"
+        if attr == "join" and not recv.endswith("path") \
+                and not isinstance(func.value, ast.Constant):
+            arg_ok = (not call.args) or (
+                len(call.args) == 1
+                and isinstance(call.args[0], ast.Constant)
+                and isinstance(call.args[0].value, (int, float))
+            )
+            if arg_ok and (not kwnames or kwnames <= {"timeout"}):
+                return "Thread.join() waits for another thread while holding the lock"
+        if attr == "get" and "timeout" not in kwnames and kwnames <= {"block"}:
+            # untimed blocking forms: get(), get(True), get(block=True) —
+            # dict.get(key[, default]) always passes a non-True positional
+            block_false = any(
+                k.arg == "block"
+                and isinstance(k.value, ast.Constant) and k.value.value is False
+                for k in call.keywords
+            )
+            positional_ok = not call.args or (
+                len(call.args) == 1
+                and isinstance(call.args[0], ast.Constant)
+                and call.args[0].value is True
+            )
+            if positional_ok and not block_false:
+                leaf = recv.split(".")[-1].lower()
+                typed_queue = False
+                if recv.startswith("self.") and self.fi.cls and recv.count(".") == 1:
+                    t = self.project.find_attr_type(self.fi.cls, recv.split(".")[1])
+                    typed_queue = bool(t and "queue" in t.lower())
+                if typed_queue or "queue" in leaf or leaf in ("q", "_q", "inbox"):
+                    return "queue.get() with no timeout blocks forever under the lock"
+        if attr == "wait" and not call.args and "timeout" not in kwnames:
+            lock_id = self.resolve_lock(func.value)
+            if lock_id and self._lock_kind(lock_id) == "Condition":
+                return None  # cond.wait() releases the condition's own lock
+            return ".wait() with no timeout blocks indefinitely under the lock"
+        return None
+
+    # -- escape (callback under lock) classification -------------------------
+    def _classify_escape(self, call: ast.Call) -> Optional[str]:
+        func = call.func
+        if isinstance(func, ast.Name):
+            if func.id in _CLOCK_NAMES:
+                return None
+            if func.id in self.params and (
+                _callbackish(func.id)
+                or _annotation_is_callable(self.params[func.id])
+            ):
+                return f"parameter-supplied callable {func.id}()"
+            if func.id in self.callbackish_locals:
+                return f"callback {func.id}() from a registered-listener collection"
+            return None
+        if isinstance(func, ast.Attribute) and isinstance(func.value, ast.Name) \
+                and func.value.id == "self" and self.fi.cls:
+            if func.attr in _CLOCK_NAMES:
+                return None
+            if self.project.find_attr_callback(self.fi.cls, func.attr) \
+                    and not self.project.find_method(self.fi.cls, func.attr):
+                return f"externally supplied self.{func.attr}() callback"
+        return None
+
+    # -- the walk -----------------------------------------------------------
+    def run(self) -> None:
+        self._visit_body(list(self.fi.node.body), ())
+
+    def _visit_body(self, stmts: list[ast.stmt], held: tuple[str, ...]) -> None:
+        for stmt in stmts:
+            self._visit(stmt, held)
+
+    def _visit(self, node: ast.AST, held: tuple[str, ...]) -> None:
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda,
+                             ast.ClassDef)):
+            return  # nested scopes run later, not under this lock
+        if isinstance(node, (ast.With, ast.AsyncWith)):
+            new_held = held
+            for item in node.items:
+                expr = item.context_expr
+                if isinstance(expr, ast.Call):
+                    self._visit(expr, new_held)
+                    continue
+                lid = self.resolve_lock(expr)
+                if lid is not None:
+                    if lid not in new_held:
+                        self.fi.acquisitions.append(
+                            Acquisition(lid, new_held, expr, self.fi))
+                        new_held = new_held + (lid,)
+                else:
+                    self._visit(expr, new_held)
+            self._visit_body(node.body, new_held)
+            return
+        if isinstance(node, ast.Call):
+            self._handle_call(node, held)
+        for child in ast.iter_child_nodes(node):
+            self._visit(child, held)
+
+    def _handle_call(self, call: ast.Call, held: tuple[str, ...]) -> None:
+        func = call.func
+        if isinstance(func, ast.Attribute) and func.attr == "acquire":
+            lid = self.resolve_lock(func.value)
+            if lid is not None and _looks_like_lock_acquire(call):
+                if lid not in held:
+                    self.fi.acquisitions.append(Acquisition(lid, held, call, self.fi))
+                return
+        callees = self._resolve_callee(call)
+        if callees:
+            self.fi.calls.append(CallSite(callees, held, call, self.fi))
+        reason = self._classify_blocking(call)
+        if reason:
+            self.fi.blocking.append(BlockingCall(reason, held, call, self.fi))
+        what = self._classify_escape(call)
+        if what:
+            self.fi.escapes.append(EscapeCall(what, held, call, self.fi))
+
+
+def _looks_like_lock_acquire(call: ast.Call) -> bool:
+    """Same discrimination NL-CC01 uses: threading acquire() args only."""
+    if any(
+        not (isinstance(a, ast.Constant) and isinstance(a.value, (bool, int, float)))
+        for a in call.args
+    ):
+        return False
+    return all(k.arg in {"blocking", "timeout"} for k in call.keywords)
+
+
+# ---------------------------------------------------------------------------
+# Project rule registry
+# ---------------------------------------------------------------------------
+
+PROJECT_RULES: dict[str, Rule] = {}
+
+
+def register_project(rule_id: str, severity: str, description: str):
+    def deco(fn):
+        rule = Rule(id=rule_id, severity=severity, description=description,
+                    check=fn)
+        if rule_id in PROJECT_RULES:
+            raise ValueError(f"duplicate nornlint project rule id {rule_id}")
+        PROJECT_RULES[rule_id] = rule
+        return rule
+    return deco
+
+
+def _finding(rule: Rule, fi: FunctionInfo, node: ast.AST, message: str) -> Finding:
+    return Finding(
+        rule=rule.id,
+        severity=rule.severity,
+        path=fi.relpath,
+        line=getattr(node, "lineno", 1),
+        col=getattr(node, "col_offset", 0),
+        message=message,
+    )
+
+
+# -- NL-LK01: lock-order inversion -------------------------------------------
+
+@register_project(
+    "NL-LK01",
+    "error",
+    "lock-order inversion: two locks are acquired in opposite orders on "
+    "different paths (deadlock when the paths race)",
+)
+def nl_lk01(project: ProjectContext) -> Iterator[Finding]:
+    rule = nl_lk01
+    # edges[(a, b)] = (relpath, line, via) — first witness of a->b
+    edges: dict[tuple[str, str], tuple[FunctionInfo, ast.AST, str]] = {}
+    for fi in project.functions.values():
+        for acq in fi.acquisitions:
+            all_held = project.held_at(fi, acq.held)
+            for held_lock, prov in sorted(all_held.items()):
+                if held_lock == acq.lock:
+                    continue
+                key = (held_lock, acq.lock)
+                if key in edges:
+                    continue
+                via = ""
+                if prov is not None:
+                    chain = project.provenance_chain(fi, held_lock)
+                    if chain:
+                        via = f" [held via {chain}]"
+                edges[key] = (fi, acq.node, via)
+    # cycle detection over the order graph
+    adj: dict[str, list[str]] = {}
+    for (a, b) in edges:
+        adj.setdefault(a, []).append(b)
+    for dests in adj.values():
+        dests.sort()
+    reported: set[tuple[str, ...]] = set()
+    for (a, b) in sorted(edges):
+        # find a path b ~> a (BFS, deterministic order); a->b closes a cycle
+        if a == b:
+            continue
+        prev: dict[str, Optional[str]] = {b: None}
+        queue = [b]
+        found = False
+        while queue and not found:
+            cur = queue.pop(0)
+            for nxt in adj.get(cur, ()):
+                if nxt == a:
+                    prev[a] = cur
+                    found = True
+                    break
+                if nxt not in prev:
+                    prev[nxt] = cur
+                    queue.append(nxt)
+        if not found:
+            continue
+        path = [a]
+        cur: Optional[str] = prev[a]
+        while cur is not None:
+            path.append(cur)
+            cur = prev[cur]
+        path.reverse()          # b ... a
+        cycle = [a, *path]      # a -> b -> ... -> a
+        canon = tuple(sorted(set(cycle)))
+        if canon in reported:
+            continue
+        reported.add(canon)
+        fi, node, via = edges[(a, b)]
+        legs = []
+        for x, y in zip(cycle, cycle[1:]):
+            wfi, wnode, wvia = edges[(x, y)]
+            legs.append(
+                f"{lock_display(x)} -> {lock_display(y)} at "
+                f"{wfi.relpath}:{getattr(wnode, 'lineno', 0)}"
+                f" in {wfi.display()}{wvia}"
+            )
+        yield _finding(
+            rule, fi, node,
+            "lock-order inversion cycle: " + "; ".join(legs) +
+            " — threads taking these locks in opposite orders deadlock; "
+            "pick one global order (docs/linting.md#lock-order)",
+        )
+
+
+# -- NL-LK02: blocking call under lock ---------------------------------------
+
+@register_project(
+    "NL-LK02",
+    "warning",
+    "blocking call (I/O, RPC, join, untimed get/wait, device sync) while "
+    "holding a lock — every thread needing the lock stalls behind it",
+)
+def nl_lk02(project: ProjectContext) -> Iterator[Finding]:
+    rule = nl_lk02
+    for fi in project.functions.values():
+        for blk in fi.blocking:
+            all_held = project.held_at(fi, blk.held)
+            if not all_held:
+                continue
+            locks = sorted(all_held)
+            details = []
+            for lock in locks[:3]:
+                prov = all_held[lock]
+                if prov is None:
+                    details.append(lock_display(lock))
+                else:
+                    chain = project.provenance_chain(fi, lock)
+                    details.append(
+                        f"{lock_display(lock)} (held via {chain})" if chain
+                        else lock_display(lock)
+                    )
+            yield _finding(
+                rule, fi, blk.node,
+                f"{blk.reason} while holding {', '.join(details)}; move the "
+                "blocking call outside the critical section or snapshot "
+                "state under the lock and do the slow work after release",
+            )
+
+
+# -- NL-LK03: lock-scope escape ----------------------------------------------
+
+@register_project(
+    "NL-LK03",
+    "warning",
+    "callback / externally supplied callable invoked while holding a lock "
+    "it may re-acquire (re-entrancy deadlock, unbounded critical section)",
+)
+def nl_lk03(project: ProjectContext) -> Iterator[Finding]:
+    rule = nl_lk03
+    for fi in project.functions.values():
+        for esc in fi.escapes:
+            all_held = project.held_at(fi, esc.held)
+            if not all_held:
+                continue
+            locks = ", ".join(lock_display(l) for l in sorted(all_held)[:3])
+            yield _finding(
+                rule, fi, esc.node,
+                f"{esc.what} invoked while holding {locks}; the callee is "
+                "outside this module's control and may re-enter and "
+                "re-acquire the lock (or block it) — snapshot under the "
+                "lock, invoke after release",
+            )
+
+
+def run_project_rules(
+    ctxs: list[ModuleContext], select: Optional[set[str]] = None
+) -> list[Finding]:
+    """Build the ProjectContext and run every (selected) project rule,
+    honouring per-module suppressions at each finding's witness site."""
+    wanted = [
+        r for r in PROJECT_RULES.values()
+        if select is None or r.id in select
+    ]
+    if not wanted:
+        return []
+    project = ProjectContext(ctxs)
+    by_path = {c.relpath: c for c in ctxs}
+    findings: list[Finding] = []
+    for rule in wanted:
+        for f in rule.check(project):
+            ctx = by_path.get(f.path)
+            if ctx is not None and ctx.is_suppressed(f.rule, f.line):
+                continue
+            findings.append(f)
+    return findings

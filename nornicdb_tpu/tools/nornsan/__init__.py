@@ -1,0 +1,344 @@
+"""nornsan — runtime lock sanitizer for NornicDB-TPU's threaded stack.
+
+The dynamic counterpart of nornlint's NL-LK01/LK02 static rules: instead of
+*predicting* lock orders from the AST, nornsan observes the orders a real
+run actually takes.  An instrumented-lock shim (opt-in, ``NORNSAN=1``)
+wraps every ``threading.Lock``/``RLock``/``Condition`` **created by package
+or test code** and records:
+
+* the **acquisition-order graph** over live lock instances — when a thread
+  acquires lock B while holding lock A, edge A→B is recorded with the
+  creation sites of both locks and the witnessing thread.  The moment an
+  edge closes a cycle (B was already ordered before A on some other path),
+  the cycle is captured: that is an AB/BA inversion that WILL deadlock when
+  the two paths race.
+* **held-lock blocking durations** — an ``acquire`` that waited longer than
+  ``NORNSAN_BLOCK_MS`` (default 50 ms) while the thread already held other
+  locks, i.e. a convoy in the making (the runtime shadow of NL-LK02).
+
+Usage (wired into tests/conftest.py):
+
+    NORNSAN=1 python -m pytest tests/test_concurrency.py tests/test_replication.py
+
+Each test fails if it introduced a new order cycle; a summary of edges,
+cycles and blocking events prints at session end.  Static findings that
+nornsan never witnesses are false-positive candidates; nornsan cycles the
+static pass missed are resolution gaps — the two tools ratchet each other.
+
+Only stdlib is used, and the module is import-safe WITHOUT the parent
+package (tests/conftest.py loads it by file path so ``install()`` can run
+before ``import nornicdb_tpu`` creates any module-level lock).
+"""
+
+from __future__ import annotations
+
+# nornlint: disable-file=NL-CC01 — this module IS the lock implementation:
+# the wrapper's acquire/release/_release_save plumbing makes bare .acquire()
+# calls by design (pairing happens in the caller's with-statement, exactly
+# what NL-CC01 enforces everywhere else).
+
+import os
+import sys
+import threading
+import time
+from typing import Any, Optional
+
+__all__ = [
+    "Tracker", "install", "uninstall", "active", "tracker", "report",
+    "reset", "wrap_lock",
+]
+
+_ORIG_LOCK = threading.Lock
+_ORIG_RLOCK = threading.RLock
+_ORIG_CONDITION = threading.Condition
+
+_BLOCK_THRESHOLD_S = float(os.environ.get("NORNSAN_BLOCK_MS", "50")) / 1000.0
+_MAX_EVENTS = 1000
+
+
+def _creation_site(depth: int = 2) -> str:
+    f = sys._getframe(depth)
+    path = f.f_code.co_filename
+    for marker in ("nornicdb_tpu", "tests"):
+        i = path.find(os.sep + marker + os.sep)
+        if i >= 0:
+            path = path[i + 1:]
+            break
+    return f"{path}:{f.f_lineno}"
+
+
+def _in_scope(depth: int = 2) -> bool:
+    """Only locks created by package/test code are instrumented — stdlib
+    and third-party locks (logging, jax, http.server...) stay native, both
+    for overhead and so their internal ordering doesn't drown the report."""
+    path = sys._getframe(depth).f_code.co_filename
+    return "nornicdb_tpu" in path or (os.sep + "tests" + os.sep) in path \
+        or path.endswith(os.sep + "conftest.py")
+
+
+class Tracker:
+    """Order-graph + blocking recorder.  One global instance backs the
+    installed shim; tests may build private Trackers with wrap_lock()."""
+
+    def __init__(self) -> None:
+        self._mu = _ORIG_LOCK()
+        self._tls = threading.local()
+        self._next_id = 0
+        self.sites: dict[int, str] = {}
+        # edges[(a, b)] = {"count", "thread", "a_site", "b_site"}
+        self.edges: dict[tuple[int, int], dict[str, Any]] = {}
+        self._adj: dict[int, set[int]] = {}
+        self.cycles: list[dict[str, Any]] = []
+        self.blocking: list[dict[str, Any]] = []
+
+    # -- per-instance registration -----------------------------------------
+    def register(self, site: str) -> int:
+        with self._mu:
+            self._next_id += 1
+            self.sites[self._next_id] = site
+            return self._next_id
+
+    # -- thread-held stack --------------------------------------------------
+    def _stack(self) -> list[int]:
+        st = getattr(self._tls, "stack", None)
+        if st is None:
+            st = self._tls.stack = []
+        return st
+
+    def on_acquired(self, lock_id: int, waited_s: float) -> None:
+        stack = self._stack()
+        held = [i for i in stack if i != lock_id]
+        if lock_id not in stack:  # re-entrant RLock acquire adds no edges
+            for h in dict.fromkeys(held):  # de-dup, preserve order
+                self._add_edge(h, lock_id)
+        if waited_s >= _BLOCK_THRESHOLD_S and held:
+            with self._mu:
+                if len(self.blocking) < _MAX_EVENTS:
+                    self.blocking.append({
+                        "lock": self.sites.get(lock_id, "?"),
+                        "held": [self.sites.get(h, "?") for h in dict.fromkeys(held)],
+                        "waited_s": round(waited_s, 4),
+                        "thread": threading.current_thread().name,
+                    })
+        stack.append(lock_id)
+
+    def on_released(self, lock_id: int) -> None:
+        stack = self._stack()
+        for i in range(len(stack) - 1, -1, -1):  # tolerate out-of-order release
+            if stack[i] == lock_id:
+                del stack[i]
+                break
+
+    def pop_all(self, lock_id: int) -> int:
+        """Remove every recursion level of lock_id (Condition.wait)."""
+        stack = self._stack()
+        n = stack.count(lock_id)
+        if n:
+            self._tls.stack = [i for i in stack if i != lock_id]
+        return n
+
+    def push_n(self, lock_id: int, n: int) -> None:
+        self._stack().extend([lock_id] * n)
+
+    # -- order graph --------------------------------------------------------
+    def _add_edge(self, a: int, b: int) -> None:
+        with self._mu:
+            key = (a, b)
+            rec = self.edges.get(key)
+            if rec is not None:
+                rec["count"] += 1
+                return
+            self.edges[key] = {
+                "count": 1,
+                "thread": threading.current_thread().name,
+                "a_site": self.sites.get(a, "?"),
+                "b_site": self.sites.get(b, "?"),
+            }
+            self._adj.setdefault(a, set()).add(b)
+            path = self._find_path(b, a)
+            if path is not None:  # a->b closed a cycle b ~> a
+                cyc = [a, b] if path == [b, a] else [a] + path
+                self.cycles.append({
+                    "locks": [self.sites.get(i, "?") for i in cyc],
+                    "thread": threading.current_thread().name,
+                })
+
+    def _find_path(self, src: int, dst: int) -> Optional[list[int]]:
+        """BFS path src ~> dst in the order graph (caller holds _mu)."""
+        if src == dst:
+            return [src]
+        prev: dict[int, int] = {}
+        queue = [src]
+        seen = {src}
+        while queue:
+            cur = queue.pop(0)
+            for nxt in self._adj.get(cur, ()):
+                if nxt in seen:
+                    continue
+                prev[nxt] = cur
+                if nxt == dst:
+                    path = [dst]
+                    while path[-1] != src:
+                        path.append(prev[path[-1]])
+                    path.reverse()
+                    return path
+                seen.add(nxt)
+                queue.append(nxt)
+        return None
+
+    # -- reporting ----------------------------------------------------------
+    def report(self) -> dict[str, Any]:
+        with self._mu:
+            return {
+                "locks": len(self.sites),
+                "edges": len(self.edges),
+                "cycles": [dict(c) for c in self.cycles],
+                "blocking": [dict(b) for b in self.blocking],
+            }
+
+    def reset(self) -> None:
+        with self._mu:
+            self.edges.clear()
+            self._adj.clear()
+            self.cycles.clear()
+            self.blocking.clear()
+
+
+class InstrumentedLock:
+    """Wraps a Lock/RLock, reporting to a Tracker.  Exposes the protocol
+    threading.Condition needs (_is_owned/_release_save/_acquire_restore) so
+    instrumented locks can back conditions."""
+
+    __slots__ = ("_inner", "_tracker", "_id", "site")
+
+    def __init__(self, inner, tracker: Tracker, site: str):
+        self._inner = inner
+        self._tracker = tracker
+        self.site = site
+        self._id = tracker.register(site)
+
+    def acquire(self, blocking: bool = True, timeout: float = -1) -> bool:
+        t0 = time.perf_counter()
+        ok = self._inner.acquire(blocking, timeout)
+        if ok:
+            self._tracker.on_acquired(self._id, time.perf_counter() - t0)
+        return ok
+
+    def release(self) -> None:
+        self._tracker.on_released(self._id)
+        self._inner.release()
+
+    def __enter__(self) -> bool:
+        return self.acquire()
+
+    def __exit__(self, *exc) -> None:
+        self.release()
+
+    def locked(self) -> bool:
+        probe = getattr(self._inner, "locked", None)
+        if probe is not None:
+            return probe()
+        if self._inner.acquire(False):  # RLock without locked()
+            self._inner.release()
+            return False
+        return True
+
+    # -- Condition protocol -------------------------------------------------
+    def _is_owned(self) -> bool:
+        owned = getattr(self._inner, "_is_owned", None)
+        if owned is not None:
+            return owned()
+        if self._inner.acquire(False):
+            self._inner.release()
+            return False
+        return True
+
+    def _release_save(self):
+        n = self._tracker.pop_all(self._id)
+        save = getattr(self._inner, "_release_save", None)
+        if save is not None:
+            return (save(), n)
+        self._inner.release()
+        return (None, n)
+
+    def _acquire_restore(self, state) -> None:
+        saved, n = state
+        restore = getattr(self._inner, "_acquire_restore", None)
+        if restore is not None:
+            restore(saved)
+        else:
+            self._inner.acquire()
+        # restore held-stack accounting; a wait() re-acquire repeats an
+        # order already recorded at first acquire, so no new edges
+        self._tracker.push_n(self._id, n)
+
+    def __repr__(self) -> str:
+        return f"<nornsan {self._inner!r} @ {self.site}>"
+
+
+def wrap_lock(tracker: Tracker, rlock: bool = False,
+              site: Optional[str] = None) -> InstrumentedLock:
+    """Explicitly instrumented lock bound to a private Tracker — the
+    self-test hook (no global install needed)."""
+    inner = _ORIG_RLOCK() if rlock else _ORIG_LOCK()
+    return InstrumentedLock(inner, tracker, site or _creation_site(2))
+
+
+# ---------------------------------------------------------------------------
+# Global shim
+# ---------------------------------------------------------------------------
+
+tracker = Tracker()
+_installed = False
+
+
+def _make_lock():
+    if _in_scope():
+        return InstrumentedLock(_ORIG_LOCK(), tracker, _creation_site())
+    return _ORIG_LOCK()
+
+
+def _make_rlock():
+    if _in_scope():
+        return InstrumentedLock(_ORIG_RLOCK(), tracker, _creation_site())
+    return _ORIG_RLOCK()
+
+
+def _make_condition(lock=None):
+    if lock is None and _in_scope():
+        lock = InstrumentedLock(_ORIG_RLOCK(), tracker, _creation_site())
+    return _ORIG_CONDITION(lock)
+
+
+def install() -> None:
+    """Patch threading's lock factories.  Locks created before install()
+    stay native — call it before importing nornicdb_tpu (conftest does)."""
+    global _installed
+    if _installed:
+        return
+    threading.Lock = _make_lock
+    threading.RLock = _make_rlock
+    threading.Condition = _make_condition
+    _installed = True
+
+
+def uninstall() -> None:
+    global _installed
+    if not _installed:
+        return
+    threading.Lock = _ORIG_LOCK
+    threading.RLock = _ORIG_RLOCK
+    threading.Condition = _ORIG_CONDITION
+    _installed = False
+
+
+def active() -> bool:
+    return _installed
+
+
+def report() -> dict[str, Any]:
+    return tracker.report()
+
+
+def reset() -> None:
+    tracker.reset()

@@ -8,11 +8,69 @@ devices, mirroring how the reference exercises replication without a cluster
 import os
 import sys
 
+import pytest
+
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (
         _flags + " --xla_force_host_platform_device_count=8"
     ).strip()
+
+# -- nornsan: runtime lock sanitizer (opt-in, NORNSAN=1) ---------------------
+# Must install BEFORE `import nornicdb_tpu` creates any module-level lock,
+# so the module is loaded by file path (importing it through the package
+# would execute nornicdb_tpu/__init__.py first). docs/linting.md#nornsan.
+nornsan = None
+if os.environ.get("NORNSAN") == "1":
+    import importlib.util
+
+    _nornsan_path = os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        "nornicdb_tpu", "tools", "nornsan", "__init__.py",
+    )
+    _spec = importlib.util.spec_from_file_location(
+        "nornicdb_tpu.tools.nornsan", _nornsan_path
+    )
+    nornsan = importlib.util.module_from_spec(_spec)
+    # pre-seed so later `from nornicdb_tpu.tools import nornsan` resolves to
+    # THIS instance (two trackers would split the observed order graph)
+    sys.modules["nornicdb_tpu.tools.nornsan"] = nornsan
+    _spec.loader.exec_module(nornsan)
+    nornsan.install()
+
+
+@pytest.fixture(autouse=True)
+def _nornsan_cycle_gate(request):
+    """With NORNSAN=1, fail any test whose execution introduced a new lock
+    acquisition-order cycle — an AB/BA inversion observed live."""
+    if nornsan is None:
+        yield
+        return
+    before = len(nornsan.tracker.report()["cycles"])
+    yield
+    rep = nornsan.tracker.report()
+    fresh = rep["cycles"][before:]
+    assert not fresh, (
+        "nornsan: lock-order cycle(s) observed during this test "
+        f"(deadlock when the orders race): {fresh}"
+    )
+
+
+def pytest_terminal_summary(terminalreporter, exitstatus, config):
+    if nornsan is None:
+        return
+    rep = nornsan.report()
+    terminalreporter.write_sep(
+        "-", f"nornsan: {rep['locks']} instrumented locks, "
+        f"{rep['edges']} order edges, {len(rep['cycles'])} cycle(s), "
+        f"{len(rep['blocking'])} held-lock blocking event(s) "
+        f">= {os.environ.get('NORNSAN_BLOCK_MS', '50')}ms"
+    )
+    for b in rep["blocking"][:10]:
+        terminalreporter.write_line(
+            f"  blocked {b['waited_s']*1000:.0f}ms acquiring {b['lock']} "
+            f"while holding {', '.join(b['held'])} [{b['thread']}]"
+        )
 
 # The axon sitecustomize registers the TPU platform and overrides
 # JAX_PLATFORMS from the environment, so force CPU via jax.config instead

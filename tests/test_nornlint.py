@@ -15,6 +15,7 @@ import pytest
 
 from nornicdb_tpu.tools.nornlint import (
     Baseline,
+    PROJECT_RULES,
     RULES,
     diff_against_baseline,
     lint_paths,
@@ -215,6 +216,96 @@ BAD_CLEAN_FIXTURES = {
             return time.time()  # absolute timestamps are wall-clock's job
         """,
     ),
+    # -- interprocedural (project) rules ------------------------------------
+    "NL-LK01": (
+        """
+        import threading
+
+        _a = threading.Lock()
+        _b = threading.Lock()
+
+        def one():
+            with _a:
+                with _b:
+                    pass
+
+        def two():
+            with _b:
+                with _a:
+                    pass
+        """,
+        """
+        import threading
+
+        _a = threading.Lock()
+        _b = threading.Lock()
+
+        def one():
+            with _a:
+                with _b:
+                    pass
+
+        def two():
+            with _a:  # same global order everywhere: no inversion
+                with _b:
+                    pass
+        """,
+    ),
+    "NL-LK02": (
+        """
+        import socket
+        import threading
+
+        _lock = threading.Lock()
+
+        def send(addr, data):
+            with _lock:
+                with socket.create_connection(addr) as s:
+                    s.sendall(data)
+        """,
+        """
+        import socket
+        import threading
+
+        _lock = threading.Lock()
+        _queue = []
+
+        def send(addr):
+            with _lock:
+                data = _queue.pop()  # snapshot under the lock...
+            with socket.create_connection(addr) as s:
+                s.sendall(data)  # ...slow I/O after release
+        """,
+    ),
+    "NL-LK03": (
+        """
+        import threading
+
+        class Notifier:
+            def __init__(self, on_apply=None):
+                self._lock = threading.Lock()
+                self.on_apply = on_apply
+
+            def fire(self, entry):
+                with self._lock:
+                    if self.on_apply is not None:
+                        self.on_apply(entry)
+        """,
+        """
+        import threading
+
+        class Notifier:
+            def __init__(self, on_apply=None):
+                self._lock = threading.Lock()
+                self.on_apply = on_apply
+
+            def fire(self, entry):
+                with self._lock:
+                    snapshot = self.on_apply
+                if snapshot is not None:
+                    snapshot(entry)
+        """,
+    ),
 }
 
 
@@ -232,8 +323,9 @@ def test_rule_passes_known_clean(rule):
 
 
 def test_every_registered_rule_has_fixtures():
-    assert set(BAD_CLEAN_FIXTURES) == set(RULES), (
-        "every rule needs a known-bad/known-clean fixture pair"
+    assert set(BAD_CLEAN_FIXTURES) == set(RULES) | set(PROJECT_RULES), (
+        "every rule (module-level AND project-level) needs a known-bad/"
+        "known-clean fixture pair"
     )
 
 
@@ -298,6 +390,188 @@ def test_jax01_partial_jit_and_bare_jit_names_detected():
         return float(x.max())
     """
     assert findings_for(src, "NL-JAX01")
+
+
+def test_lk01_cross_module_inversion_detected(tmp_path):
+    """The lock-order graph must span modules: module a holds its lock and
+    calls into b (propagated hold); b's own path takes the locks the other
+    way round."""
+    (tmp_path / "pyproject.toml").write_text("")
+    pkg = tmp_path / "pkg"
+    pkg.mkdir()
+    (pkg / "__init__.py").write_text("")
+    (pkg / "a.py").write_text(textwrap.dedent("""
+        import threading
+
+        from pkg.b import poke
+
+        _a_lock = threading.Lock()
+
+        def locked_call():
+            with _a_lock:
+                poke()
+
+        def take_a():
+            with _a_lock:
+                pass
+    """))
+    (pkg / "b.py").write_text(textwrap.dedent("""
+        import threading
+
+        _b_lock = threading.Lock()
+
+        def poke():
+            with _b_lock:
+                pass
+
+        def reverse():
+            from pkg.a import take_a
+            with _b_lock:
+                take_a()
+    """))
+    findings = [
+        f for f in lint_paths([pkg], root=tmp_path) if f.rule == "NL-LK01"
+    ]
+    assert findings, "cross-module AB/BA inversion must be reported"
+    assert "_a_lock" in findings[0].message and "_b_lock" in findings[0].message
+
+
+def test_lk01_consistent_cross_module_order_is_clean(tmp_path):
+    (tmp_path / "pyproject.toml").write_text("")
+    pkg = tmp_path / "pkg"
+    pkg.mkdir()
+    (pkg / "__init__.py").write_text("")
+    (pkg / "a.py").write_text(textwrap.dedent("""
+        import threading
+
+        from pkg.b import poke
+
+        _a_lock = threading.Lock()
+
+        def locked_call():
+            with _a_lock:
+                poke()
+    """))
+    (pkg / "b.py").write_text(textwrap.dedent("""
+        import threading
+
+        _b_lock = threading.Lock()
+
+        def poke():
+            with _b_lock:
+                pass
+    """))
+    findings = [
+        f for f in lint_paths([pkg], root=tmp_path) if f.rule == "NL-LK01"
+    ]
+    assert not findings
+
+
+def test_lk02_held_lock_propagates_through_self_calls():
+    src = """
+    import time
+    import threading
+
+    class Worker:
+        def __init__(self):
+            self._lock = threading.Lock()
+
+        def entry(self):
+            with self._lock:
+                self.middle()
+
+        def middle(self):
+            self.slow()
+
+        def slow(self):
+            time.sleep(1)
+    """
+    hits = findings_for(src, "NL-LK02")
+    assert len(hits) == 1
+    assert "held via" in hits[0].message
+
+
+def test_lk02_timed_queue_get_and_join_are_clean():
+    src = """
+    import queue
+    import threading
+
+    _lock = threading.Lock()
+    _q = queue.Queue()
+
+    def drain(sep, parts):
+        with _lock:
+            item = _q.get(timeout=0.5)
+            label = sep.join(parts)      # str.join, not Thread.join
+            path = ", ".join(parts)
+        return item, label, path
+    """
+    assert not findings_for(src, "NL-LK02")
+
+
+def test_lk02_untimed_queue_get_under_lock_flagged():
+    src = """
+    import queue
+    import threading
+
+    _lock = threading.Lock()
+    _q = queue.Queue()
+
+    def drain():
+        with _lock:
+            return _q.get()
+
+    def drain_positional():
+        with _lock:
+            return _q.get(True)
+
+    def drain_keyword():
+        with _lock:
+            return _q.get(block=True)
+    """
+    assert len(findings_for(src, "NL-LK02")) == 3, (
+        "all three untimed blocking get() spellings must be flagged"
+    )
+
+
+def test_lk03_clock_attributes_exempt():
+    src = """
+    import threading
+    import time
+
+    class Tracker:
+        def __init__(self, now_fn=time.time):
+            self._lock = threading.Lock()
+            self.now = now_fn
+
+        def stamp(self):
+            with self._lock:
+                return self.now()
+    """
+    assert not findings_for(src, "NL-LK03")
+
+
+def test_project_rule_suppression_at_witness_site():
+    src = """
+    import threading
+
+    _a = threading.Lock()
+    _b = threading.Lock()
+
+    def one():
+        with _a:
+            with _b:  # nornlint: disable=NL-LK01
+                pass
+
+    def two():
+        with _b:
+            with _a:
+                pass
+    """
+    assert not findings_for(src, "NL-LK01"), (
+        "a suppression on the reported witness acquisition must silence "
+        "the cycle finding"
+    )
 
 
 def test_jax03_literal_static_argnums_is_clean():

@@ -1,0 +1,194 @@
+"""nornsan self-tests: the runtime lock sanitizer must catch a seeded AB/BA
+order cycle, record held-lock blocking, stay quiet on consistent orders and
+RLock re-entry, and back a threading.Condition correctly.
+
+All tests use PRIVATE Tracker instances via wrap_lock(), so they neither
+require NORNSAN=1 nor pollute the globally installed tracker (whose per-test
+cycle gate in conftest.py would otherwise fail the deliberately provoked
+inversion here).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+
+from nornicdb_tpu.tools import nornsan
+from nornicdb_tpu.tools.nornsan import Tracker, wrap_lock
+
+
+def _run(fn):
+    t = threading.Thread(target=fn, daemon=True)
+    t.start()
+    t.join(timeout=5)
+    assert not t.is_alive(), "worker thread hung"
+
+
+class TestOrderCycle:
+    def test_seeded_ab_ba_cycle_is_detected(self):
+        tracker = Tracker()
+        a = wrap_lock(tracker, site="fake.py:1")
+        b = wrap_lock(tracker, site="fake.py:2")
+
+        def order_ab():
+            with a:
+                with b:
+                    pass
+
+        def order_ba():
+            with b:
+                with a:
+                    pass
+
+        # sequential threads: both orders get RECORDED without actually
+        # deadlocking — exactly the near-miss nornsan exists to catch
+        _run(order_ab)
+        _run(order_ba)
+        rep = tracker.report()
+        assert len(rep["cycles"]) == 1
+        assert set(rep["cycles"][0]["locks"]) == {"fake.py:1", "fake.py:2"}
+
+    def test_consistent_order_is_clean(self):
+        tracker = Tracker()
+        a = wrap_lock(tracker, site="fake.py:1")
+        b = wrap_lock(tracker, site="fake.py:2")
+
+        def order_ab():
+            with a:
+                with b:
+                    pass
+
+        _run(order_ab)
+        _run(order_ab)
+        rep = tracker.report()
+        assert rep["cycles"] == []
+        assert rep["edges"] == 1  # deduped
+
+    def test_three_lock_cycle_detected(self):
+        tracker = Tracker()
+        locks = [wrap_lock(tracker, site=f"fake.py:{i}") for i in range(3)]
+        for i in range(3):  # 0->1, 1->2, 2->0
+            first, second = locks[i], locks[(i + 1) % 3]
+
+            def chain(first=first, second=second):
+                with first:
+                    with second:
+                        pass
+
+            _run(chain)
+        assert len(tracker.report()["cycles"]) == 1
+
+    def test_rlock_reentry_is_not_an_edge(self):
+        tracker = Tracker()
+        r = wrap_lock(tracker, rlock=True, site="fake.py:1")
+        with r:
+            with r:
+                pass
+        rep = tracker.report()
+        assert rep["edges"] == 0 and rep["cycles"] == []
+
+
+class TestBlocking:
+    def test_held_lock_blocking_event_recorded(self):
+        tracker = Tracker()
+        a = wrap_lock(tracker, site="fake.py:1")
+        b = wrap_lock(tracker, site="fake.py:2")
+        b_held = threading.Event()
+        release_b = threading.Event()
+
+        def holder():
+            with b:
+                b_held.set()
+                release_b.wait(5)
+
+        t = threading.Thread(target=holder, daemon=True)
+        t.start()
+        assert b_held.wait(5)
+
+        def delayed_release():
+            time.sleep(0.15)  # comfortably past the 50ms default threshold
+            release_b.set()
+
+        threading.Thread(target=delayed_release, daemon=True).start()
+        with a:
+            with b:  # blocks ~150ms while holding a
+                pass
+        t.join(timeout=5)
+        rep = tracker.report()
+        assert rep["blocking"], "blocked-under-lock acquire must be recorded"
+        evt = rep["blocking"][0]
+        assert evt["lock"] == "fake.py:2"
+        assert "fake.py:1" in evt["held"]
+        assert evt["waited_s"] >= 0.05
+
+    def test_fast_uncontended_acquire_not_recorded(self):
+        tracker = Tracker()
+        a = wrap_lock(tracker, site="fake.py:1")
+        b = wrap_lock(tracker, site="fake.py:2")
+        with a:
+            with b:
+                pass
+        assert tracker.report()["blocking"] == []
+
+
+class TestConditionCompat:
+    def test_condition_backed_by_instrumented_rlock(self):
+        tracker = Tracker()
+        lk = wrap_lock(tracker, rlock=True, site="fake.py:1")
+        cond = threading.Condition(lk)
+        ready = []
+
+        def waiter():
+            with cond:
+                while not ready:
+                    cond.wait(timeout=5)
+
+        t = threading.Thread(target=waiter, daemon=True)
+        t.start()
+        time.sleep(0.05)
+        with cond:
+            ready.append(1)
+            cond.notify()
+        t.join(timeout=5)
+        assert not t.is_alive()
+        # wait() released and re-acquired through the shim without
+        # corrupting the held-stack accounting
+        assert tracker.report()["cycles"] == []
+        with lk:  # still usable
+            pass
+
+
+class TestShim:
+    def test_install_scopes_to_package_and_test_code(self):
+        # under NORNSAN=1 the shim is session-installed by conftest; this
+        # test must leave that state exactly as it found it, or every later
+        # test would run with native, unobserved locks
+        was_active = nornsan.active()
+        nornsan.install()
+        try:
+            src = "import threading\nlk = threading.Lock()\n"
+            in_scope: dict = {}
+            exec(compile(src, __file__, "exec"), in_scope)
+            assert isinstance(in_scope["lk"], nornsan.InstrumentedLock)
+
+            foreign: dict = {}
+            exec(compile(src, "/usr/lib/python3/site-packages/x.py", "exec"),
+                 foreign)
+            assert not isinstance(foreign["lk"], nornsan.InstrumentedLock)
+        finally:
+            if not was_active:
+                nornsan.uninstall()
+        if was_active:
+            assert threading.Lock is not nornsan._ORIG_LOCK
+        else:
+            assert threading.Lock is nornsan._ORIG_LOCK
+
+    def test_wrapper_supports_lock_protocol(self):
+        tracker = Tracker()
+        lk = wrap_lock(tracker, site="fake.py:1")
+        assert lk.acquire(timeout=1)
+        assert lk.locked()
+        lk.release()
+        assert not lk.locked()
+        assert lk.acquire(blocking=False)
+        lk.release()

@@ -3,6 +3,7 @@ testing strategy (pkg/replication/replication_test.go mocks,
 chaos_test.go:446 ChaosTransport, scenario_test.go election/failover/
 promote/fencing scenarios). No real cluster needed."""
 
+import os
 import time
 
 import pytest
@@ -29,7 +30,12 @@ from nornicdb_tpu.storage import MemoryEngine, Node
 
 def _wait(pred, timeout=20.0, interval=0.02):
     # generous default: election + cross-region ship timings stretch badly
-    # when the host is saturated (e.g. a CPU bench running in parallel)
+    # when the host is saturated (e.g. a CPU bench running in parallel).
+    # Under the nornsan lock shim every acquisition pays instrumentation
+    # overhead, so convergence waits get a sanitizer multiplier (the same
+    # convention as TSAN timeout scaling).
+    if os.environ.get("NORNSAN") == "1":
+        timeout *= 3
     deadline = time.time() + timeout
     while time.time() < deadline:
         if pred():

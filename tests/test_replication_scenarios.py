@@ -8,6 +8,7 @@ process; here the same intent runs against the real engines over
 InProcNetwork, with ChaosTransport supplying latency/loss.
 """
 
+import os
 import threading
 import time
 
@@ -33,6 +34,8 @@ FAST = RaftConfig(heartbeat_interval=0.03, election_timeout_min=0.15,
 
 
 def _wait(pred, timeout=8.0, interval=0.02):
+    if os.environ.get("NORNSAN") == "1":
+        timeout *= 3  # lock-shim overhead: same scaling as test_replication
     deadline = time.time() + timeout
     while time.time() < deadline:
         if pred():
