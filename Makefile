@@ -13,7 +13,7 @@ lint-baseline:
 
 # runtime lock sanitizer over the threaded suites (docs/linting.md#nornsan)
 sanitize:
-	NORNSAN=1 python -m pytest tests/test_concurrency.py tests/test_replication.py tests/test_replication_scenarios.py tests/test_nornsan.py -q
+	NORNSAN=1 python -m pytest tests/test_concurrency.py tests/test_replication.py tests/test_replication_scenarios.py tests/test_nornsan.py tests/test_adjacency.py -q -m 'not slow'
 
 test-fast:
 	python -m pytest tests/ -q -x

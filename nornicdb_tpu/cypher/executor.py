@@ -88,6 +88,7 @@ def procedure(name: str):
 
 
 _query_log = logging.getLogger("nornicdb.query")
+_log = logging.getLogger(__name__)
 
 
 class CypherExecutor:
@@ -148,6 +149,8 @@ class CypherExecutor:
 
                 self._colindex = ColumnarScanIndex(self.storage)
             except Exception:
+                _log.debug("columnar scan index unavailable; label "
+                                "scans use the engine path", exc_info=True)
                 self._colindex = False
         return self._colindex or None
 
@@ -755,9 +758,13 @@ class CypherExecutor:
         if len(anchors) > self._FP_TRAVERSE_MAX_ANCHORS:
             return None  # unselective anchor: generic path, no blowup here
 
-        # no-copy reads where the engine offers them (the copying accessors
+        # already-built CSR snapshot first (event-fresh, no engine locks);
+        # then no-copy engine reads where offered (the copying accessors
         # dominate this path otherwise); probe once — NamespacedEngine
         # surfaces AttributeError when its base lacks fast adjacency
+        snap = getattr(self.storage, "_adjacency_snapshot", None)
+        if snap is not None and not snap.ready():
+            snap = None  # a one-hop fastpath must not pay the first build
         iter_adj = getattr(self.storage, "iter_adjacency", None)
         if iter_adj is not None:
             try:
@@ -765,7 +772,8 @@ class CypherExecutor:
             except AttributeError:
                 iter_adj = None
             except Exception:
-                pass
+                _log.debug("iter_adjacency probe failed; keeping "
+                                "fast path", exc_info=True)
         raw_entry = getattr(self.storage, "node_entry", None)
         node_cache: dict[str, Node] = {}
 
@@ -785,6 +793,10 @@ class CypherExecutor:
             return n
 
         def expand(nid: str, rel: ast.RelPattern):
+            if snap is not None:
+                pairs = snap.expand_pairs(nid, rel.direction, rel.types)
+                if pairs is not None:
+                    return pairs  # already (edge_id, other_id) sorted
             out = []
             types = rel.types
             if iter_adj is not None:

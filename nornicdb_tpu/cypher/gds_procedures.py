@@ -8,6 +8,7 @@ fastrp.go:361-652 (gds.fastRP.* node embeddings).
 
 from __future__ import annotations
 
+import logging
 from typing import Any
 
 import numpy as np
@@ -29,6 +30,27 @@ from nornicdb_tpu.linkpredict.topology import (
 )
 from nornicdb_tpu.storage.types import Node
 
+log = logging.getLogger(__name__)
+
+
+def _adj_snapshot(ex: CypherExecutor):
+    """The engine's shared CSR adjacency snapshot (storage/adjacency.py),
+    attached on first GDS/link-prediction call. After its first build the
+    topology stays event-maintained — repeated procedures never rescan
+    `all_edges()`."""
+    snap = getattr(ex, "_adj_snapshot_cache", None)
+    if snap is None:
+        try:
+            from nornicdb_tpu.storage.adjacency import attach_snapshot
+
+            snap = attach_snapshot(ex.storage)
+        except Exception:
+            log.debug("adjacency snapshot unavailable; GDS uses the "
+                      "engine-scan path", exc_info=True)
+            snap = False
+        ex._adj_snapshot_cache = snap
+    return snap or None
+
 
 def _method_from_name(proc_name: str) -> str:
     # gds.linkprediction.adamicadar -> adamicAdar
@@ -40,9 +62,15 @@ def _method_from_name(proc_name: str) -> str:
 
 
 def _cached_graph(ex: CypherExecutor):
-    """Per-executor graph projection cache, invalidated by count changes —
-    avoids a full O(N+E) rebuild per input row (the reference builds one
-    projection per procedure call too, graph_builder.go)."""
+    """Graph projection served from the CSR snapshot when available —
+    generation-tagged, so repeated calls on an unchanged graph reuse the
+    same projection and any mutation (even one that leaves the counts
+    unchanged, e.g. paired CREATE+DELETE) is visible. The count-keyed
+    per-executor cache remains as the fallback for engines without a
+    snapshot."""
+    snap = _adj_snapshot(ex)
+    if snap is not None and snap.ensure():
+        return snap.graph_view()
     key = (ex.storage.node_count(), ex.storage.edge_count())
     cached = getattr(ex, "_lp_graph_cache", None)
     if cached is not None and cached[0] == key:
@@ -174,7 +202,7 @@ def proc_lp_suggest(ex: CypherExecutor, args, row):
     """Top non-adjacent candidate pairs (ref: linkprediction.go suggest)."""
     method = str(args[0]) if args else "adamicAdar"
     limit = int(args[1]) if len(args) > 1 else 20
-    g = build_graph(ex.storage)
+    g = _cached_graph(ex)  # generation-tagged: always current topology
     rows = []
     for a_id, b_id, score in top_candidates(g, method, limit):
         na, nb = ex.get_node_or_none(a_id), ex.get_node_or_none(b_id)
@@ -203,7 +231,7 @@ def proc_fastrp(ex: CypherExecutor, args, row):
     dims = int(cfg.get("embeddingDimension", 128))
     iterations = int(cfg.get("iterationWeights") and len(cfg["iterationWeights"]) or 3)
     weights = cfg.get("iterationWeights") or [0.0, 1.0, 1.0][:iterations]
-    g = build_graph(ex.storage)
+    g = _cached_graph(ex)
     if g.n == 0:
         return ["nodeId", "embedding"], []
     rng = np.random.default_rng(int(cfg.get("randomSeed", 42)))
@@ -475,9 +503,15 @@ from nornicdb_tpu.ops import graph_algos as _ga  # noqa: E402
 
 
 def _edge_arrays(ex: CypherExecutor):
-    """Directed (src, dst) index arrays + sorted id list, cached per
-    executor and invalidated on count change (same policy as
-    _cached_graph)."""
+    """Directed (src, dst) index arrays + sorted id list, served from the
+    CSR snapshot (generation-tagged: repeated calls on an unchanged graph
+    reuse the same arrays, mutations — including count-neutral ones — are
+    always visible, and no `all_edges()` rescan ever runs after the first
+    snapshot build). Count-keyed executor cache kept as the fallback."""
+    snap = _adj_snapshot(ex)
+    if snap is not None and snap.ensure():
+        view = snap.edge_arrays()
+        return view.ids, view.index, view.src, view.dst
     key = (ex.storage.node_count(), ex.storage.edge_count())
     cached = getattr(ex, "_algo_graph_cache", None)
     if cached is not None and cached[0] == key:

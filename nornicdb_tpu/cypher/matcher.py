@@ -8,6 +8,7 @@ pattern fastpaths, optimized_executors.go).
 
 from __future__ import annotations
 
+import logging
 from typing import Any, Iterator, Optional
 
 from nornicdb_tpu.cypher import ast
@@ -16,7 +17,16 @@ from nornicdb_tpu.errors import CypherTypeError, NotFoundError
 from nornicdb_tpu.storage.schema import SchemaManager
 from nornicdb_tpu.storage.types import Edge, Engine, Node
 
+log = logging.getLogger(__name__)
+
 MAX_VAR_LENGTH = 15  # traversal depth cap (ref: traversal.go bounds)
+
+# Live partial paths the batched var-length walk may hold before handing
+# the query back to the lazy generic DFS: the batched walk materializes a
+# whole frontier level at once, so a dense deep pattern (branching^hops)
+# must not trade the generic path's O(depth) walk state for unbounded
+# memory. Tests lower this to force the fallback.
+MAX_BATCHED_PATHS = 100_000
 
 
 def make_path(nodes: list[Node], rels: list[Edge]) -> dict[str, Any]:
@@ -45,7 +55,34 @@ class PatternMatcher:
             except AttributeError:
                 self._iter_adj = None
             except Exception:
-                pass
+                log.debug("iter_adjacency probe failed; keeping fast path",
+                          exc_info=True)
+        # shared CSR topology snapshot (storage/adjacency.py): resolved on
+        # first traversal; False = engine cannot host one
+        self._snapshot: Any = None
+
+    def _snap(self):
+        """The engine's adjacency snapshot, attaching on first use."""
+        if self._snapshot is None:
+            try:
+                from nornicdb_tpu.storage.adjacency import attach_snapshot
+
+                self._snapshot = attach_snapshot(self.storage)
+            except Exception:
+                log.debug("adjacency snapshot unavailable; traversals use "
+                          "the engine-scan path", exc_info=True)
+                self._snapshot = False
+        return self._snapshot or None
+
+    def _snap_ready(self):
+        """Snapshot only if already built — plain one-hop expansion must
+        not pay the first full build. Falls through to a snapshot another
+        consumer (GDS, link prediction) already attached to the engine."""
+        if self._snapshot is False:
+            return None
+        snap = self._snapshot or \
+            getattr(self.storage, "_adjacency_snapshot", None)
+        return snap if (snap is not None and snap.ready()) else None
 
     # -- public --------------------------------------------------------------
     def match_path(
@@ -173,6 +210,13 @@ class PatternMatcher:
         edge properties). materialize=False with fast adjacency ->
         (edge_id, other_id) pairs, skipping per-edge defensive copies —
         the dominant cost of unanchored traversal scans."""
+        if not materialize and props is None:
+            snap = self._snap_ready()
+            if snap is not None:
+                pairs = snap.expand_pairs(
+                    node_id, rel_pat.direction, rel_pat.types)
+                if pairs is not None:
+                    return pairs
         if not materialize and props is None and self._iter_adj is not None:
             out = []
             types = rel_pat.types
@@ -277,11 +321,33 @@ class PatternMatcher:
         self, path, params, idx, row, path_nodes, path_rels,
         rel_pat, target_pat, props, tprops, src,
     ) -> Iterator[dict[str, Any]]:
-        """Variable-length expansion via DFS with edge-set de-dup
-        (ref: findPaths traversal.go:1127)."""
+        """Variable-length expansion (ref: findPaths traversal.go:1127).
+
+        With an edge-property filter or no usable snapshot this is the
+        original DFS over per-node engine expansion; otherwise the
+        frontier-batched CSR walk (_var_length_batched) produces the same
+        paths — sorted back into the DFS's lexicographic edge-id order —
+        with node/edge materialization only for surviving bindings."""
         max_h = min(rel_pat.max_hops, MAX_VAR_LENGTH)
         min_h = rel_pat.min_hops
         need_edges = bool(rel_pat.variable or path.name)
+
+        if props is None:
+            snap = self._snap()
+            if snap is not None and snap.ensure():
+                found = self._var_length_batched(
+                    snap, params, row, path_rels, rel_pat, target_pat,
+                    tprops, src, min_h, max_h, need_edges)
+                if found is not None:
+                    # no zero-edge filter needed: the batched walk only
+                    # yields at hops >= min_h, one edge per hop
+                    start_nodes = list(path_nodes)
+                    for new_row, nodes, rels in found:
+                        yield from self._match_elements(
+                            path, row, params, idx + 2, new_row,
+                            start_nodes + nodes, path_rels + rels,
+                        )
+                    return
 
         def walk(curr: Node, hops: int, rels: list[Edge], nodes: list[Node]):
             if hops >= min_h:
@@ -332,6 +398,114 @@ class PatternMatcher:
                 start_nodes + nodes, path_rels + rels,
             )
 
+    def _var_length_batched(
+        self, snap, params, row, path_rels, rel_pat, target_pat,
+        tprops, src, min_h: int, max_h: int, need_edges: bool,
+    ) -> Optional[list[tuple[dict, list[Node], list]]]:
+        """Frontier-batched var-length walk over CSR slices: each hop is
+        one batched gather over the unique frontier endpoints (rel-type
+        filtering via the code column), partial paths stay as index/edge-id
+        tuples, and Nodes/Edges are fetched only for paths that survive the
+        target checks. Results are sorted by their edge-id sequence, which
+        reproduces the generic DFS's yield order exactly. None -> caller
+        falls back to the generic walk."""
+        src_idx = snap.index_of(src.id)
+        if src_idx is None:
+            return None
+        codes = snap.type_codes(rel_pat.types)
+        excluded = {_rel_id(e) for e in path_rels}
+        bound_idx = -1  # -1 = unbound; None = bound to a node not in vocab
+        if target_pat.variable and target_pat.variable in row:
+            bound = row[target_pat.variable]
+            if not isinstance(bound, Node):
+                return []
+            bound_idx = snap.index_of(bound.id)
+        node_cache: dict[int, Node] = {src_idx: src}
+        edge_cache: dict[str, Edge] = {}
+
+        def fetch_nodes(idxs) -> None:
+            missing = [i for i in idxs if i not in node_cache]
+            if not missing:
+                return
+            ids = snap.ids_of(missing)
+            got = {n.id: n for n in self.storage.batch_get_nodes(ids)}
+            for i, nid in zip(missing, ids):
+                n = got.get(nid)
+                if n is not None:
+                    node_cache[i] = n
+
+        # partial path: (endpoint idx, edge-id tuple, node-idx tuple)
+        matched: list[tuple[tuple, tuple, Node]] = []
+        level: list[tuple[int, tuple, tuple]] = [(src_idx, (), ())]
+        hops = 0
+        while True:
+            if hops >= min_h and level:
+                # bound target: only paths ending AT the bound node can
+                # yield — filter on indices before materializing anything
+                check = level if bound_idx == -1 else \
+                    [p for p in level if p[0] == bound_idx]
+                fetch_nodes({p[0] for p in check})
+                for last, eids, nidxs in check:
+                    curr = node_cache.get(last)
+                    if curr is None:
+                        continue  # vanished mid-walk: generic skips it too
+                    if not self._node_matches(curr, target_pat, tprops):
+                        continue
+                    if not self._passes_inline_where(curr, target_pat,
+                                                     row, params):
+                        continue
+                    matched.append((eids, nidxs, curr))
+            if hops >= max_h or not level:
+                break
+            endpoints = list(dict.fromkeys(p[0] for p in level))
+            adj = snap.expand_frontier(endpoints, rel_pat.direction, codes)
+            nxt = []
+            for last, eids, nidxs in level:
+                for eid, oidx in adj.get(last, ()):
+                    if eid in excluded or eid in eids:
+                        continue  # relationship isomorphism
+                    nxt.append((oidx, eids + (eid,), nidxs + (oidx,)))
+            if len(nxt) + len(matched) > MAX_BATCHED_PATHS:
+                return None  # combinatorial blowup: lazy generic DFS instead
+            level = nxt
+            hops += 1
+        matched.sort(key=lambda t: t[0])
+        out = []
+        for eids, nidxs, curr in matched:
+            fetch_nodes(set(nidxs))
+            nodes: list[Node] = []
+            ok = True
+            for i in nidxs:
+                n = node_cache.get(i)
+                if n is None:
+                    ok = False
+                    break
+                nodes.append(n)
+            if not ok:
+                continue
+            rels: list = []
+            if need_edges:
+                for eid in eids:
+                    e = edge_cache.get(eid)
+                    if e is None:
+                        try:
+                            e = self.storage.get_edge(eid)
+                        except NotFoundError:
+                            break
+                        edge_cache[eid] = e
+                    rels.append(e)
+                if len(rels) != len(eids):
+                    continue
+            else:
+                rels = list(eids)
+            new_row = dict(row)
+            if rel_pat.variable:
+                new_row[rel_pat.variable] = list(rels)
+            if target_pat.variable:
+                new_row[target_pat.variable] = curr
+            out.append((new_row, nodes, rels))
+        return out
+
     # -- shortest path -------------------------------------------------------------
     def _match_shortest(
         self, path: ast.PatternPath, row: dict, params: dict
@@ -363,6 +537,96 @@ class PatternMatcher:
                     yield out
 
     def _bfs_shortest(
+        self, start: Node, end: Node, rel_pat, props, max_h: int,
+        all_paths: bool = False,
+    ) -> list[tuple[list[Node], list[Edge]]]:
+        if start.id == end.id:
+            return [([start], [])]
+        if props is None:
+            snap = self._snap()
+            if snap is not None and snap.ensure():
+                res = self._bfs_shortest_batched(
+                    snap, start, end, rel_pat, max_h, all_paths)
+                if res is not None:
+                    return res
+        return self._bfs_shortest_generic(
+            start, end, rel_pat, props, max_h, all_paths)
+
+    def _bfs_shortest_batched(
+        self, snap, start: Node, end: Node, rel_pat, max_h: int,
+        all_paths: bool,
+    ) -> Optional[list[tuple[list[Node], list[Edge]]]]:
+        """BFS over CSR slices: one batched expansion per level over the
+        unique frontier endpoints; partial paths are index/edge-id tuples
+        and only result paths materialize Nodes/Edges. Frontier order and
+        per-node edge-id order match the generic BFS, so the first path
+        found (and the all-shortest set) is identical."""
+        si = snap.index_of(start.id)
+        ei = snap.index_of(end.id)
+        if si is None or ei is None:
+            return None  # snapshot lagging the engine: generic path decides
+        codes = snap.type_codes(rel_pat.types)
+        frontier: list[tuple[int, tuple, tuple]] = [(si, (), ())]
+        visited = {si}
+        found: list[tuple[tuple, tuple]] = []
+        for _ in range(max_h):
+            endpoints = list(dict.fromkeys(p[0] for p in frontier))
+            adj = snap.expand_frontier(endpoints, rel_pat.direction, codes)
+            nxt: list[tuple[int, tuple, tuple]] = []
+            level_visited: set[int] = set()
+            for nid, eids, nidxs in frontier:
+                for eid, oidx in adj.get(nid, ()):
+                    if oidx in visited:
+                        continue
+                    p = (eids + (eid,), nidxs + (oidx,))
+                    if oidx == ei:
+                        found.append(p)
+                        if not all_paths:
+                            return self._materialize_index_paths(
+                                snap, start, found)
+                        continue
+                    level_visited.add(oidx)
+                    nxt.append((oidx, p[0], p[1]))
+            if found:
+                break
+            visited |= level_visited
+            frontier = nxt
+            if not frontier:
+                break
+        return self._materialize_index_paths(snap, start, found)
+
+    def _materialize_index_paths(
+        self, snap, start: Node, items: list[tuple[tuple, tuple]],
+    ) -> list[tuple[list[Node], list[Edge]]]:
+        node_cache: dict[int, Node] = {}
+        out: list[tuple[list[Node], list[Edge]]] = []
+        for eids, nidxs in items:
+            nodes = [start]
+            ok = True
+            for i in nidxs:
+                n = node_cache.get(i)
+                if n is None:
+                    try:
+                        n = self.storage.get_node(snap.id_of(i))
+                    except NotFoundError:
+                        ok = False
+                        break
+                    node_cache[i] = n
+                nodes.append(n)
+            if not ok:
+                continue
+            rels: list[Edge] = []
+            for eid in eids:
+                try:
+                    rels.append(self.storage.get_edge(eid))
+                except NotFoundError:
+                    ok = False
+                    break
+            if ok:
+                out.append((nodes, rels))
+        return out
+
+    def _bfs_shortest_generic(
         self, start: Node, end: Node, rel_pat, props, max_h: int,
         all_paths: bool = False,
     ) -> list[tuple[list[Node], list[Edge]]]:

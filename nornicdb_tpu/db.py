@@ -528,6 +528,12 @@ class DB:
             eng = getattr(eng, "base", None)
         return None
 
+    def adjacency_stats(self) -> Optional[dict[str, Any]]:
+        """CSR adjacency snapshot counters (storage/adjacency.py), or None
+        before the first traversal/GDS query attaches one."""
+        snap = getattr(self.storage, "_adjacency_snapshot", None)
+        return snap.stats_snapshot() if snap is not None else None
+
     # -- backup / restore (ref: badger_backup.go + /admin/backup,
     # db_admin.go admin ops) -----------------------------------------------
     def backup(self, dest_path: Optional[str] = None) -> str:

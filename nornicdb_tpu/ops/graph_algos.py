@@ -161,6 +161,58 @@ def _adj(src, dst, n, undirected=True) -> list[list[int]]:
     return adj
 
 
+def build_csr(src: np.ndarray, dst: np.ndarray, n: int,
+              undirected: bool = True) -> tuple[np.ndarray, np.ndarray]:
+    """(offsets, neighbors) CSR arrays — the frontier-batched BFS input
+    shape the adjacency snapshot (storage/adjacency.py) also serves."""
+    s = np.asarray(src, np.int64)
+    d = np.asarray(dst, np.int64)
+    if undirected:
+        s, d = np.concatenate([s, d]), np.concatenate([d, s])
+    counts = np.bincount(s, minlength=n) if s.size else np.zeros(n, np.int64)
+    offsets = np.zeros(n + 1, np.int64)
+    offsets[1:] = np.cumsum(counts)
+    order = np.argsort(s, kind="stable")
+    return offsets, d[order].astype(np.int32)
+
+
+def _frontier_neighbors(offsets: np.ndarray, neighbors: np.ndarray,
+                        frontier: np.ndarray
+                        ) -> tuple[np.ndarray, np.ndarray]:
+    """One batched gather: (heads, nbrs) for every CSR entry of `frontier`
+    — replaces a per-node Python adjacency loop per BFS level."""
+    starts = offsets[frontier]
+    cnts = offsets[frontier + 1] - starts
+    total = int(cnts.sum())
+    if total == 0:
+        empty = np.zeros(0, np.int64)
+        return empty, empty
+    shift = np.repeat(np.cumsum(cnts) - cnts, cnts)
+    gather = np.repeat(starts, cnts) + np.arange(total) - shift
+    return np.repeat(frontier, cnts), neighbors[gather].astype(np.int64)
+
+
+def bfs_distances_csr(offsets: np.ndarray, neighbors: np.ndarray,
+                      source: int, n: int) -> np.ndarray:
+    """Unweighted hop distances from `source` (-1 unreached), one numpy
+    gather + dedup per level."""
+    dist = np.full(n, -1, np.int32)
+    dist[source] = 0
+    frontier = np.asarray([source], np.int64)
+    level = 0
+    while frontier.size:
+        _, nbrs = _frontier_neighbors(offsets, neighbors, frontier)
+        if not nbrs.size:
+            break
+        nbrs = nbrs[dist[nbrs] < 0]
+        if not nbrs.size:
+            break
+        frontier = np.unique(nbrs)
+        level += 1
+        dist[frontier] = level
+    return dist
+
+
 def degree_centrality(src: np.ndarray, dst: np.ndarray, n: int,
                       direction: str = "both") -> np.ndarray:
     out = np.zeros((n,), dtype=np.float32)
@@ -173,54 +225,61 @@ def degree_centrality(src: np.ndarray, dst: np.ndarray, n: int,
 
 def closeness_centrality(src, dst, n) -> np.ndarray:
     """closeness(v) = (reachable-1) / sum(dist) scaled by reachable/n
-    (the Wasserman-Faust variant the reference uses)."""
-    adj = _adj(src, dst, n)
+    (the Wasserman-Faust variant the reference uses). Per-source BFS runs
+    over CSR arrays with batched frontier gathers instead of a Python
+    adjacency loop per node."""
     out = np.zeros((n,), dtype=np.float32)
+    if n == 0:
+        return out
+    offsets, neighbors = build_csr(src, dst, n)
     for v in range(n):
-        dist = {v: 0}
-        frontier = [v]
-        while frontier:
-            nxt = []
-            for u in frontier:
-                for w in adj[u]:
-                    if w not in dist:
-                        dist[w] = dist[u] + 1
-                        nxt.append(w)
-            frontier = nxt
-        total = sum(dist.values())
-        reach = len(dist) - 1
+        dist = bfs_distances_csr(offsets, neighbors, v, n)
+        reached = dist > 0
+        total = int(dist[reached].sum())
+        reach = int(reached.sum())
         if total > 0 and reach > 0:
             out[v] = (reach / total) * (reach / max(n - 1, 1))
     return out
 
 
 def betweenness_centrality(src, dst, n) -> np.ndarray:
-    """Brandes' algorithm (exact, unweighted)."""
-    adj = _adj(src, dst, n)
+    """Brandes' algorithm (exact, unweighted) over CSR arrays: the forward
+    pass is a frontier-batched BFS per source (sigma accumulated with
+    scatter-adds over each level's edge batch), the backward pass replays
+    the recorded level batches in reverse — no per-edge Python loops."""
     bc = np.zeros((n,), dtype=np.float64)
+    if n == 0:
+        return bc.astype(np.float32)
+    offsets, neighbors = build_csr(src, dst, n)
     for s in range(n):
-        stack: list[int] = []
-        preds: list[list[int]] = [[] for _ in range(n)]
-        sigma = np.zeros((n,)); sigma[s] = 1.0
-        dist = np.full((n,), -1); dist[s] = 0
-        queue = [s]
-        qi = 0
-        while qi < len(queue):
-            v = queue[qi]; qi += 1
-            stack.append(v)
-            for w in adj[v]:
-                if dist[w] < 0:
-                    dist[w] = dist[v] + 1
-                    queue.append(w)
-                if dist[w] == dist[v] + 1:
-                    sigma[w] += sigma[v]
-                    preds[w].append(v)
-        delta = np.zeros((n,))
-        for w in reversed(stack):
-            for v in preds[w]:
-                delta[v] += sigma[v] / sigma[w] * (1.0 + delta[w])
-            if w != s:
-                bc[w] += delta[w]
+        sigma = np.zeros(n)
+        sigma[s] = 1.0
+        dist = np.full(n, -1, np.int64)
+        dist[s] = 0
+        frontier = np.asarray([s], np.int64)
+        level_edges: list[tuple[np.ndarray, np.ndarray]] = []
+        level = 0
+        while frontier.size:
+            heads, nbrs = _frontier_neighbors(offsets, neighbors, frontier)
+            if not nbrs.size:
+                break
+            newly = nbrs[dist[nbrs] < 0]
+            if newly.size:
+                newly = np.unique(newly)
+                dist[newly] = level + 1
+            keep = dist[nbrs] == level + 1
+            h, w = heads[keep], nbrs[keep]
+            if w.size:
+                np.add.at(sigma, w, sigma[h])
+                level_edges.append((h, w))
+            frontier = newly
+            level += 1
+        delta = np.zeros(n)
+        for h, w in reversed(level_edges):
+            np.add.at(delta, h, sigma[h] / sigma[w] * (1.0 + delta[w]))
+        visited = dist >= 0
+        visited[s] = False
+        bc[visited] += delta[visited]
     return (bc / 2.0).astype(np.float32)  # undirected double-count
 
 

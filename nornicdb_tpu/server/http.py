@@ -611,6 +611,11 @@ class HttpServer:
             wal = self.db.wal_stats()
             if wal is not None:
                 stats["wal"] = wal
+            adjacency = self.db.adjacency_stats()
+            if adjacency is not None:
+                # CSR topology snapshot health: builds / delta merges /
+                # epoch retries / resident bytes (tune merge_threshold here)
+                stats["adjacency"] = adjacency
             h._send(200, stats)
             return
         if path == "/admin/config":
@@ -723,6 +728,22 @@ class HttpServer:
                     "# TYPE nornicdb_query_batch_max gauge",
                     f"nornicdb_query_batch_max {batcher['max_batch']}",
                 ]
+        adjacency = self.db.adjacency_stats()
+        if adjacency is not None:
+            lines += [
+                "# TYPE nornicdb_adjacency_builds_total counter",
+                f"nornicdb_adjacency_builds_total {adjacency['builds']}",
+                "# TYPE nornicdb_adjacency_delta_merges_total counter",
+                f"nornicdb_adjacency_delta_merges_total {adjacency['delta_merges']}",
+                "# TYPE nornicdb_adjacency_merged_edges_total counter",
+                f"nornicdb_adjacency_merged_edges_total {adjacency['merged_edges']}",
+                "# TYPE nornicdb_adjacency_epoch_retries_total counter",
+                f"nornicdb_adjacency_epoch_retries_total {adjacency['epoch_retries']}",
+                "# TYPE nornicdb_adjacency_bytes gauge",
+                f"nornicdb_adjacency_bytes {adjacency['bytes']}",
+                "# TYPE nornicdb_adjacency_delta_pending gauge",
+                f"nornicdb_adjacency_delta_pending {adjacency['delta_pending']}",
+            ]
         # heimdall named metrics when the assistant has been used
         # (ref: pkg/heimdall/metrics.go Prometheus rendering)
         if self.db._heimdall is not None:

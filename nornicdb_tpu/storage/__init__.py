@@ -15,6 +15,7 @@ import os
 from typing import Optional
 
 from nornicdb_tpu.errors import NornicError
+from nornicdb_tpu.storage.adjacency import AdjacencySnapshot, attach_snapshot
 from nornicdb_tpu.storage.async_engine import AsyncEngine
 from nornicdb_tpu.storage.namespaced import NamespacedEngine
 from nornicdb_tpu.storage.schema import (
@@ -46,8 +47,10 @@ from nornicdb_tpu.storage.types import (
 from nornicdb_tpu.storage.wal import WAL, WALEngine, WALEntry
 
 __all__ = [
+    "AdjacencySnapshot",
     "AsyncEngine",
     "NamespacedEngine",
+    "attach_snapshot",
     "SchemaManager",
     "IndexDef",
     "ConstraintDef",

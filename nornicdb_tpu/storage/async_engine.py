@@ -37,15 +37,28 @@ class AsyncEngine(Engine):
         self._closed = False
         # Creates/updates are emitted by THIS engine at write time; the base
         # engine's events for those same ops fire later at flush and would
-        # double-notify listeners. Deletes are the opposite: they run
-        # directly against the base (incl. edge cascades), so only the
-        # base's delete events are authoritative.
+        # double-notify listeners. Node deletes run directly against the
+        # base (incl. edge cascades), so the base's events are
+        # authoritative there. Edge deletes are emitted at write time too —
+        # a tombstoned edge is already invisible to reads, and event-
+        # maintained indexes (adjacency snapshot, namespaced counts) must
+        # not serve it until flush — so the base's flush-time replay of the
+        # same delete is suppressed by id. A create deleted before it ever
+        # flushed never reaches the base at all; without the write-time
+        # emit no listener would ever hear about its deletion.
+        self._deleted_emitted: set[str] = set()
         base.on_event(self._forward_base_event)
         self._flusher = threading.Thread(target=self._flush_loop, daemon=True)
         self._flusher.start()
 
     def _forward_base_event(self, kind: str, entity) -> None:
-        if kind in ("node_deleted", "edge_deleted"):
+        if kind == "edge_deleted":
+            with self._lock:
+                if entity.id in self._deleted_emitted:
+                    self._deleted_emitted.discard(entity.id)
+                    return  # already announced at write time
+            self._emit(kind, entity)
+        elif kind == "node_deleted":
             self._emit(kind, entity)
 
     # -- flush loop --------------------------------------------------------
@@ -103,7 +116,13 @@ class AsyncEngine(Engine):
                     except NotFoundError:
                         pass
                 elif eid in edge_creates:
-                    self.base.create_edge(val)  # type: ignore[arg-type]
+                    try:
+                        self.base.create_edge(val)  # type: ignore[arg-type]
+                    except AlreadyExistsError:
+                        # this create overwrote a same-id tombstone in the
+                        # overlay, so the delete never reached the base:
+                        # apply as an update, not a lost write
+                        self.base.update_edge(val)  # type: ignore[arg-type]
                 else:
                     self.base.update_edge(val)  # type: ignore[arg-type]
             except Exception:
@@ -177,6 +196,10 @@ class AsyncEngine(Engine):
         self.flush()
         return self.base.all_nodes()
 
+    def all_node_ids(self) -> list[str]:
+        self.flush()
+        return self.base.all_node_ids()  # AttributeError -> caller fallback
+
     # -- edges -------------------------------------------------------------
     def create_edge(self, edge: Edge) -> Edge:
         # Endpoint validation must see overlay nodes too.
@@ -186,6 +209,11 @@ class AsyncEngine(Engine):
             existing = self._edges.get(edge.id)
             if existing is not None and existing is not _TOMBSTONE:
                 raise AlreadyExistsError(f"edge {edge.id} already exists")
+            if existing is _TOMBSTONE:
+                # the tombstone this create overwrites will never reach the
+                # base, so its flush-replay suppression must not linger and
+                # swallow a future genuine delete of this id
+                self._deleted_emitted.discard(edge.id)
             stored = edge.copy()
             self._edges[edge.id] = stored
             self._edge_is_create.add(edge.id)
@@ -214,17 +242,31 @@ class AsyncEngine(Engine):
         return stored.copy()
 
     def delete_edge(self, edge_id: str) -> None:
+        try:
+            self._delete_edge_once(edge_id)
+        except NotFoundError:
+            # a background flush may have popped the create from the overlay
+            # but not yet applied it to the base (same window get_node
+            # handles); drain the in-flight flush OUTSIDE self._lock —
+            # flush takes _flush_lock then _lock — and retry once
+            with self._flush_lock:
+                pass
+            self._delete_edge_once(edge_id)
+
+    def _delete_edge_once(self, edge_id: str) -> None:
         with self._lock:
             val = self._edges.get(edge_id)
             if val is _TOMBSTONE:
                 raise NotFoundError(f"edge {edge_id} not found")
-            if val is None:
-                self.base.get_edge(edge_id)
+            entity = val.copy() if val is not None else self.base.get_edge(edge_id)
             if edge_id in self._edge_is_create:
                 self._edges.pop(edge_id, None)
                 self._edge_is_create.discard(edge_id)
             else:
                 self._edges[edge_id] = _TOMBSTONE
+                # the base replays this delete at flush; don't notify twice
+                self._deleted_emitted.add(edge_id)
+        self._emit("edge_deleted", entity)
 
     def get_edges_by_type(self, edge_type: str) -> list[Edge]:
         self.flush()

@@ -142,6 +142,13 @@ class NamespacedEngine(Engine):
     def all_nodes(self) -> Iterator[Node]:
         return (self._restrip_node(n) for n in self.base.all_nodes() if self._owns(n.id))
 
+    def all_node_ids(self) -> list[str]:
+        """Id-only scan with prefix translation (see MemoryEngine
+        .all_node_ids). Raises AttributeError when the base engine lacks
+        it — callers probe and fall back to all_nodes."""
+        return [self._strip(i) for i in self.base.all_node_ids()
+                if self._owns(i)]
+
     def batch_get_nodes(self, ids: Iterable[str]) -> list[Node]:
         return [
             self._restrip_node(n)

@@ -632,6 +632,9 @@ class WALEngine(Engine):
     def all_nodes(self):
         return self.base.all_nodes()
 
+    def all_node_ids(self):
+        return self.base.all_node_ids()  # AttributeError -> caller fallback
+
     def batch_get_nodes(self, ids):
         return self.base.batch_get_nodes(ids)
 

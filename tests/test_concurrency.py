@@ -173,66 +173,84 @@ class TestConcurrentFacade:
 
 class TestCrashRecoveryE2E:
     def test_kill9_mid_write_recovers_consistently(self, tmp_path):
-        """Run a writer process, SIGKILL it mid-stream, reopen, verify the
+        """Run a writer process in lockstep, SIGKILL it, reopen, verify the
         recovered graph is a consistent prefix (every edge's endpoints
-        exist; counts match the WAL)."""
+        exist; counts match the WAL).
+
+        The writer performs one statement per go-token read from stdin and
+        acks it on stdout, so progress is ack-driven (no deadline scanning
+        of a free-running stream) and the kill lands between statements —
+        deterministic, where killing a free-running writer raced the
+        three WAL appends a `CREATE (:A)-[:L]->(:B)` statement makes and
+        sometimes recovered an A without its B."""
+        writes = 25
         data_dir = str(tmp_path / "crashdb")
         script = tmp_path / "writer.py"
         script.write_text(
-            "import sys, itertools\n"
+            "import sys\n"
             f"sys.path.insert(0, {json.dumps(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))})\n"
             "import nornicdb_tpu\n"
             "from nornicdb_tpu.db import Config\n"
             f"db = nornicdb_tpu.open_db({json.dumps(data_dir)}, Config(async_writes=False, embed_enabled=False))\n"
             "print('READY', flush=True)\n"
-            "for i in itertools.count():\n"
-            "    r = db.cypher('CREATE (:A {i: $i})-[:L]->(:B {i: $i})', {'i': i})\n"
+            "i = 0\n"
+            "for _line in sys.stdin:  # one statement per go-token\n"
+            "    db.cypher('CREATE (:A {i: $i})-[:L]->(:B {i: $i})', {'i': i})\n"
             "    print('W', i, flush=True)\n"
+            "    i += 1\n"
         )
         stderr_path = tmp_path / "writer.err"
         with open(stderr_path, "w") as errf:
             proc = subprocess.Popen(
                 [sys.executable, str(script)], stdout=subprocess.PIPE,
-                stderr=errf, text=True,
+                stdin=subprocess.PIPE, stderr=errf, text=True, bufsize=1,
                 env={**os.environ, "JAX_PLATFORMS": "cpu"},
             )
-            # wait until it has written a decent stream, then kill -9.
-            # Generous deadline: the subprocess cold-imports jax, which under
-            # full-suite load can take tens of seconds before the first write.
+
+            # Failsafe-bounded blocking reads: the subprocess cold-imports
+            # jax, which under full-suite load can take tens of seconds —
+            # but progress is driven by the acks, never by the clock.
             import select
 
+            def read_line() -> str:
+                deadline = time.time() + 300
+                while time.time() < deadline:
+                    ready, _, _ = select.select([proc.stdout], [], [], 1.0)
+                    if ready:
+                        return proc.stdout.readline()
+                return ""
+
             written = 0
-            deadline = time.time() + 180
-            while time.time() < deadline:
-                # select-bounded read: a hung writer must not turn the
-                # deadline into an infinite readline() block
-                ready, _, _ = select.select([proc.stdout], [], [], 1.0)
-                if not ready:
-                    continue
-                line = proc.stdout.readline()
-                if not line:  # writer died before reaching the target
-                    break
-                if line.startswith("W "):
-                    written = int(line.split()[1])
-                    if written >= 25:
-                        break
+            assert read_line().startswith("READY"), (
+                "writer failed to start; stderr:\n"
+                + stderr_path.read_text()[-2000:]
+            )
+            for i in range(writes):
+                proc.stdin.write("go\n")
+                proc.stdin.flush()
+                line = read_line()
+                assert line.startswith("W "), (
+                    f"writer died after {written} acked writes; stderr:\n"
+                    + stderr_path.read_text()[-2000:]
+                )
+                written = int(line.split()[1]) + 1
+            # the writer is now blocked reading stdin — no statement in
+            # flight — and has never closed the db: kill -9 leaves an
+            # uncompacted WAL tail for recovery to replay
             proc.kill()
             proc.wait()
-        assert written >= 25, (
-            f"writer reached {written} writes; stderr:\n"
-            + stderr_path.read_text()[-2000:]
-        )
+        assert written == writes
         # reopen and verify consistency
         db = nornicdb_tpu.open_db(data_dir)
         nodes = {n.id: n for n in db.storage.all_nodes()}
         edges = list(db.storage.all_edges())
-        assert len(nodes) >= 50  # at least the confirmed writes
+        assert len(nodes) == 2 * writes  # exactly the acked statements
         for e in edges:
             assert e.start_node in nodes and e.end_node in nodes
         # pairs are atomic per statement replay: A-count == B-count
         a = db.cypher("MATCH (a:A) RETURN count(a)").rows[0][0]
         b = db.cypher("MATCH (b:B) RETURN count(b)").rows[0][0]
-        assert a == b
+        assert a == b == writes
         # and the database still takes writes
         db.cypher("CREATE (:PostRecovery)")
         assert db.cypher("MATCH (p:PostRecovery) RETURN count(p)").rows == [[1]]
