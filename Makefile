@@ -1,6 +1,6 @@
 # NornicDB-TPU (ref: the reference's Makefile test/build targets)
 
-.PHONY: test test-fast lint lint-baseline sanitize bench native e2e-bench clean
+.PHONY: test test-fast lint lint-baseline sanitize smoke bench native e2e-bench clean
 
 test:
 	python -m pytest tests/ -q
@@ -13,7 +13,11 @@ lint-baseline:
 
 # runtime lock sanitizer over the threaded suites (docs/linting.md#nornsan)
 sanitize:
-	NORNSAN=1 python -m pytest tests/test_concurrency.py tests/test_replication.py tests/test_replication_scenarios.py tests/test_nornsan.py tests/test_adjacency.py -q -m 'not slow'
+	NORNSAN=1 python -m pytest tests/test_concurrency.py tests/test_replication.py tests/test_replication_scenarios.py tests/test_nornsan.py tests/test_adjacency.py tests/test_telemetry.py -q -m 'not slow'
+
+# live-server /metrics + /admin/traces smoke (docs/observability.md)
+smoke:
+	python scripts/telemetry_smoke.py
 
 test-fast:
 	python -m pytest tests/ -q -x

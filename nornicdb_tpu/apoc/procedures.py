@@ -8,11 +8,15 @@ the way the reference routes CALL apoc.* via its registry
 
 from __future__ import annotations
 
+import logging
 from typing import Any
 
 from nornicdb_tpu.cypher.executor import CypherExecutor, procedure
 from nornicdb_tpu.errors import CypherSyntaxError
 from nornicdb_tpu.storage.types import Edge, Node, new_id
+from nornicdb_tpu.telemetry.metrics import count_error
+
+log = logging.getLogger(__name__)
 
 
 @procedure("apoc.create.node")
@@ -214,6 +218,11 @@ def apoc_periodic_iterate(ex: CypherExecutor, args, row):
         try:
             ex._run_query(inner_stmt, {}, start_rows=batch_rows)
         except Exception:
+            # contract: iterate continues past failed batches, but operators
+            # need to see WHY batches failed, not just the count
+            log.warning("apoc.periodic.iterate batch %d failed", batches,
+                        exc_info=True)
+            count_error("apoc.periodic_iterate")
             failed += 1
     return (
         ["batches", "total", "errorMessages", "failedBatches"],

@@ -20,11 +20,15 @@ triggers), matching the reference's post-tx semantics in effect.
 
 from __future__ import annotations
 
+import logging
 import threading
 from dataclasses import dataclass, field
 from typing import Any, Optional
 
 from nornicdb_tpu.storage.types import Edge, Engine, Node
+from nornicdb_tpu.telemetry.metrics import count_error
+
+log = logging.getLogger(__name__)
 
 _EVENT_PARAM = {
     "node_created": "createdNodes",
@@ -178,6 +182,10 @@ class TriggerManager:
                     self.executor.execute(t.statement, params)
                     t.fired += 1
                 except Exception:
-                    t.errors += 1  # a broken trigger must not break writes
+                    # a broken trigger must not break writes — but its
+                    # failures must be visible, not just a silent counter
+                    log.warning("trigger %s failed", t.name, exc_info=True)
+                    count_error("apoc.trigger")
+                    t.errors += 1
         finally:
             self._firing.active = False

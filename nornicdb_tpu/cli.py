@@ -30,10 +30,16 @@ def _open_db(args):
 
 def cmd_serve(args) -> int:
     """(ref: runServe main.go:210)"""
+    import nornicdb_tpu.telemetry as telemetry
     from nornicdb_tpu.auth import Authenticator, ROLE_ADMIN
+    from nornicdb_tpu.config import load as load_app_config
     from nornicdb_tpu.embed import CachedEmbedder, HashEmbedder, TPUEmbedder
     from nornicdb_tpu.multidb import SYSTEM_DB
     from nornicdb_tpu.server import BoltServer, HttpServer
+
+    # apply nornicdb.yaml/env telemetry knobs to the process-global
+    # tracer / slow-query log before any server starts taking traffic
+    telemetry.configure(**vars(load_app_config().telemetry))
 
     db = _open_db(args)
     # embedder: trained checkpoint > TPU bge-m3 preset > hash fallback

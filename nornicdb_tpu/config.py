@@ -71,12 +71,27 @@ class ComplianceConfig:
 
 
 @dataclass
+class TelemetryConfig:
+    """Knobs for the process-global telemetry layer (nornicdb_tpu.telemetry):
+    applied via ``telemetry.configure(**vars(cfg.telemetry))`` at server
+    startup; the same knobs are env-readable at import time
+    (NORNICDB_TRACING / NORNICDB_TRACE_SAMPLE / NORNICDB_SLOW_QUERY_MS)."""
+
+    tracing_enabled: bool = True
+    trace_sample: float = 1.0  # fraction of ingress requests traced
+    trace_buffer: int = 256  # completed traces kept for /admin/traces
+    slow_query_ms: float = 1000.0  # 0 disables slow-query capture
+    slow_buffer: int = 128  # entries kept for /admin/slow-queries
+
+
+@dataclass
 class AppConfig:
     server: ServerConfig = field(default_factory=ServerConfig)
     database: DatabaseConfig = field(default_factory=DatabaseConfig)
     embedding: EmbeddingConfig = field(default_factory=EmbeddingConfig)
     memory: MemoryConfig = field(default_factory=MemoryConfig)
     compliance: ComplianceConfig = field(default_factory=ComplianceConfig)
+    telemetry: TelemetryConfig = field(default_factory=TelemetryConfig)
 
 
 def find_config_file(start_dir: str = ".") -> Optional[str]:
@@ -146,6 +161,11 @@ ENV_ALIASES: dict[str, tuple[str, str]] = {
     "NORNICDB_AUDIT_ENABLED": ("compliance", "audit_enabled"),
     "NORNICDB_AUDIT_LOG_PATH": ("compliance", "audit_path"),
     "NORNICDB_RETENTION_ENABLED": ("compliance", "retention_enabled"),
+    "NORNICDB_TRACING": ("telemetry", "tracing_enabled"),
+    "NORNICDB_TRACE_SAMPLE": ("telemetry", "trace_sample"),
+    "NORNICDB_TRACE_BUFFER": ("telemetry", "trace_buffer"),
+    "NORNICDB_SLOW_QUERY_MS": ("telemetry", "slow_query_ms"),
+    "NORNICDB_SLOW_QUERY_BUFFER": ("telemetry", "slow_buffer"),
 }
 
 

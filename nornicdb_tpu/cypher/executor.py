@@ -43,6 +43,24 @@ from nornicdb_tpu.errors import (
 )
 from nornicdb_tpu.storage.schema import SchemaManager
 from nornicdb_tpu.storage.types import Edge, Engine, Node, new_id
+from nornicdb_tpu.telemetry import slowlog as _slowlog
+from nornicdb_tpu.telemetry.metrics import REGISTRY as _REGISTRY
+from nornicdb_tpu.telemetry.tracing import tracer as _tracer
+
+# stage cells resolved once at import: the per-query cost is one
+# perf_counter pair + one cell observe per stage, plus a single
+# contextvar read for the (usually no-op) span
+_STAGE_HIST = _REGISTRY.histogram(
+    "nornicdb_cypher_stage_seconds",
+    "Cypher execute latency by stage",
+    labels=("stage",),
+)
+_STAGE_PARSE = _STAGE_HIST.labels("parse")
+_STAGE_PLAN = _STAGE_HIST.labels("plan")
+_STAGE_MATCH = _STAGE_HIST.labels("match")
+_STAGE_PROJECT = _STAGE_HIST.labels("project")
+_STAGE_EXECUTE = _STAGE_HIST.labels("execute")
+_slow_log = _slowlog.slow_log
 
 
 @dataclass
@@ -156,17 +174,65 @@ class CypherExecutor:
 
     # -- public ----------------------------------------------------------------
     def execute(self, query: str, params: Optional[dict[str, Any]] = None) -> Result:
-        """(ref: Execute executor.go:490 — analyze -> cache -> route)"""
-        if not self.log_queries:
-            return self._execute_traced(query, params)
-        # --log-queries (ref: cmd/nornicdb/main.go:137): every statement with
-        # wall time, through the standard logging module
+        """(ref: Execute executor.go:490 — analyze -> cache -> route)
+
+        Telemetry wrapper: every statement lands in the cypher-stage
+        latency histogram and opens a ``cypher.execute`` span (a no-op
+        handle unless an ingress started a trace on this context);
+        statements over the slow-query threshold are captured with plan,
+        span breakdown, and adjacency/device-sync counter deltas."""
         t0 = time.perf_counter()
+        probe = (
+            _slowlog.counters_probe(self.db) if _slow_log.enabled else None
+        )
+        with _tracer.span("cypher.execute") as sp:
+            if sp.trace_id is not None:
+                sp.set_attr("query", _slowlog.redact_query(query))
+            try:
+                return self._execute_traced(query, params)
+            finally:
+                duration = time.perf_counter() - t0
+                _STAGE_EXECUTE.observe(duration)
+                if self.log_queries:
+                    # --log-queries (ref: cmd/nornicdb/main.go:137): every
+                    # statement with wall time, via the logging module
+                    _query_log.info("%.1fms %s", duration * 1e3,
+                                    " ".join(query.split()))
+                if _slow_log.enabled and duration >= _slow_log.threshold_s:
+                    self._record_slow_query(query, params, duration, probe)
+
+    def _record_slow_query(
+        self,
+        query: str,
+        params: Optional[dict[str, Any]],
+        duration: float,
+        probe_before: Optional[dict],
+    ) -> None:
+        """Capture one over-threshold statement into the global slow-query
+        ring.  Plan summary is computed here — only slow queries pay for
+        EXPLAIN — and must never break the caller's result path."""
         try:
-            return self._execute_traced(query, params)
-        finally:
-            _query_log.info("%.1fms %s", (time.perf_counter() - t0) * 1e3,
-                            " ".join(query.split()))
+            plan = None
+            try:
+                stmt = parse(query)  # memoized: cache hit for this query
+                if isinstance(stmt, ast.Query):
+                    plan = self._explain(stmt)
+            except Exception:  # unparseable/plan-less statements: no plan
+                _log.debug("no plan for slow query", exc_info=True)
+                plan = None
+            cur = _tracer.capture()
+            _slow_log.maybe_record(
+                query,
+                params,
+                duration,
+                plan=plan,
+                probe_before=probe_before,
+                probe_after=_slowlog.counters_probe(self.db),
+                trace_spans=cur.trace.spans if cur is not None else None,
+                trace_id=cur.trace_id if cur is not None else None,
+            )
+        except Exception:
+            _log.warning("slow-query capture failed", exc_info=True)
 
     def _execute_traced(self, query: str,
                         params: Optional[dict[str, Any]] = None) -> Result:
@@ -183,7 +249,10 @@ class CypherExecutor:
             query = f"USE {parts[0]}" + (
                 f" {parts[1]}" if len(parts) > 1 else ""
             )
-        stmt = parse(query)
+        _t_parse = time.perf_counter()
+        with _tracer.span("cypher.parse"):
+            stmt = parse(query)
+        _STAGE_PARSE.observe(time.perf_counter() - _t_parse)
         if self.strict_validation:
             validate(stmt)
         if isinstance(stmt, ast.Query):
@@ -233,7 +302,10 @@ class CypherExecutor:
     def execute_statement(self, stmt: ast.Statement, params: dict[str, Any]) -> Result:
         if isinstance(stmt, ast.Query):
             if stmt.explain or stmt.profile:
-                plan = self._explain(stmt)
+                _t_plan = time.perf_counter()
+                with _tracer.span("cypher.plan"):
+                    plan = self._explain(stmt)
+                _STAGE_PLAN.observe(time.perf_counter() - _t_plan)
                 if stmt.explain:
                     return Result(["plan"], [[plan]], plan=plan)
             t0 = time.perf_counter()
@@ -958,6 +1030,13 @@ class CypherExecutor:
 
     # -- MATCH -----------------------------------------------------------------
     def _match(self, clause: ast.MatchClause, rows: list[dict], params: dict) -> list[dict]:
+        _t_match = time.perf_counter()
+        with _tracer.span("cypher.match"):
+            out = self._match_inner(clause, rows, params)
+        _STAGE_MATCH.observe(time.perf_counter() - _t_match)
+        return out
+
+    def _match_inner(self, clause: ast.MatchClause, rows: list[dict], params: dict) -> list[dict]:
         fast = self._match_scan_fast(clause, rows, params)
         if fast is not None:
             return fast
@@ -1429,6 +1508,22 @@ class CypherExecutor:
         return out
 
     def _project(
+        self,
+        clause: ast.ReturnClause,
+        rows: list[dict],
+        params: dict,
+        stats: Stats,
+        star_keep: bool = False,
+        original_rows: Optional[list[dict]] = None,
+    ) -> tuple[list[str], list[list[Any]]]:
+        _t_proj = time.perf_counter()
+        with _tracer.span("cypher.project"):
+            out = self._project_inner(clause, rows, params, stats,
+                                      star_keep, original_rows)
+        _STAGE_PROJECT.observe(time.perf_counter() - _t_proj)
+        return out
+
+    def _project_inner(
         self,
         clause: ast.ReturnClause,
         rows: list[dict],

@@ -11,6 +11,7 @@ the storage layer present.
 
 from __future__ import annotations
 
+import logging
 import os
 import threading
 import time
@@ -26,6 +27,9 @@ from nornicdb_tpu.storage import (
     new_id,
     open_storage,
 )
+from nornicdb_tpu.telemetry.metrics import count_error
+
+log = logging.getLogger(__name__)
 
 
 @dataclass
@@ -245,6 +249,14 @@ class DB:
                         try:
                             labels.update(self.storage.get_node(nid).labels)
                         except Exception:
+                            # endpoint vanished mid-event: we can't scope the
+                            # invalidation, so drop everything (sound) — but
+                            # leave a trace + counter so a hot loop of these
+                            # (cache thrash) is visible to operators
+                            log.debug("query-cache label scope lookup failed "
+                                      "for %s; clearing cache", nid,
+                                      exc_info=True)
+                            count_error("db.query_cache_invalidate")
                             cache.clear()
                             return
                     if labels:
@@ -285,11 +297,12 @@ class DB:
                     from nornicdb_tpu.models.pretrain import load_generator
 
                     generator = load_generator(model_dir)
-                except Exception as e:  # bad checkpoint: fall back, loudly
-                    print(
-                        f"assistant checkpoint {model_dir!r} failed to "
-                        f"load ({e}); using template generator"
+                except Exception:  # bad checkpoint: fall back, loudly
+                    log.warning(
+                        "assistant checkpoint %r failed to load; using "
+                        "template generator", model_dir, exc_info=True,
                     )
+                    count_error("heimdall.checkpoint_load")
             if generator is None:
                 generator = TemplateGenerator(self)
             self._heimdall = HeimdallManager(generator, db=self)

@@ -12,11 +12,16 @@ per-plugin panic isolation).
 
 from __future__ import annotations
 
+import logging
 import queue
 import threading
 import time
 from dataclasses import dataclass, field
 from typing import Any, Callable, Optional
+
+from nornicdb_tpu.telemetry.metrics import count_error
+
+log = logging.getLogger(__name__)
 
 # model kinds (ref: types.go:23-29)
 MODEL_EMBEDDING = "embedding"
@@ -156,17 +161,23 @@ class MetricsRegistry:
         with self._lock:
             return {**self._counters, **self._gauges}
 
-    def render_prometheus(self) -> str:
-        lines = []
+    def prometheus_families(self) -> list[tuple[str, str, str, float]]:
+        """Typed samples for the telemetry registry's families_callback:
+        [(metric_name, kind, help, value)] — keeps counter/gauge typing
+        when the unified /metrics exposition renders these."""
+        out: list[tuple[str, str, str, float]] = []
         with self._lock:
             for name, v in sorted(self._counters.items()):
-                full = f"{self.prefix}_{name}"
-                lines.append(f"# TYPE {full} counter")
-                lines.append(f"{full} {v:g}")
+                out.append((f"{self.prefix}_{name}", "counter", "", v))
             for name, v in sorted(self._gauges.items()):
-                full = f"{self.prefix}_{name}"
-                lines.append(f"# TYPE {full} gauge")
-                lines.append(f"{full} {v:g}")
+                out.append((f"{self.prefix}_{name}", "gauge", "", v))
+        return out
+
+    def render_prometheus(self) -> str:
+        lines = []
+        for full, kind, _help, v in self.prometheus_families():
+            lines.append(f"# TYPE {full} {kind}")
+            lines.append(f"{full} {v:g}")
         return "\n".join(lines) + ("\n" if lines else "")
 
 
@@ -298,5 +309,9 @@ class EventDispatcher:
                 try:
                     fn(event)
                 except Exception:
-                    pass  # a broken subscriber must not stall delivery
+                    # a broken subscriber must not stall delivery, but a
+                    # permanently crashing one should be visible
+                    log.warning("event subscriber %r failed",
+                                getattr(fn, "__name__", fn), exc_info=True)
+                    count_error("heimdall.event_subscriber")
             self.delivered += 1

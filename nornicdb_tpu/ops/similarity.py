@@ -224,6 +224,30 @@ def merge_topk(
 # mark only the blocks they touch; sync patches only dirty blocks.
 BLOCK_ROWS = LANE
 
+# H2D sync telemetry: duration + bytes histograms by mode (patch vs full
+# upload), plus a `device.sync` span when a traced request pays the sync
+from nornicdb_tpu.telemetry.metrics import (  # noqa: E402
+    BYTE_BUCKETS as _BYTE_BUCKETS,
+    REGISTRY as _REGISTRY,
+)
+from nornicdb_tpu.telemetry.tracing import tracer as _tracer  # noqa: E402
+
+_SYNC_HIST = _REGISTRY.histogram(
+    "nornicdb_device_sync_seconds",
+    "Host-to-device corpus sync duration by mode",
+    labels=("mode",),
+)
+_SYNC_PATCH_CELL = _SYNC_HIST.labels("patch")
+_SYNC_FULL_CELL = _SYNC_HIST.labels("full")
+_SYNC_BYTES_HIST = _REGISTRY.histogram(
+    "nornicdb_device_sync_transfer_bytes",
+    "Bytes shipped per host-to-device sync by mode",
+    labels=("mode",),
+    buckets=_BYTE_BUCKETS,
+)
+_SYNC_PATCH_BYTES_CELL = _SYNC_BYTES_HIST.labels("patch")
+_SYNC_FULL_BYTES_CELL = _SYNC_BYTES_HIST.labels("full")
+
 # above this fraction of dirty blocks, one contiguous full transfer beats
 # many small patch dispatches (each patch pays launch + slice overhead and
 # the runs re-upload their padding rows)
@@ -649,25 +673,33 @@ class HostCorpus:
             ):
                 needs_full = True
             if needs_full:
-                self._upload_full()
+                with _tracer.span("device.sync", {"mode": "full"}):
+                    self._upload_full()
                 s.full_uploads += 1
-                s.bytes_uploaded += int(
-                    self._host.nbytes + self._valid.nbytes
-                )
+                nbytes = int(self._host.nbytes + self._valid.nbytes)
+                s.bytes_uploaded += nbytes
+                _SYNC_FULL_CELL.observe(time.perf_counter() - t0)
+                _SYNC_FULL_BYTES_CELL.observe(nbytes)
             else:
                 donate = self._readers == 0 and self._donation_ok
-                for start_b, n_b in _coalesce_runs(
-                    sorted(self._dirty_blocks), cap_blocks
-                ):
-                    r0 = start_b * BLOCK_ROWS
-                    r1 = min((start_b + n_b) * BLOCK_ROWS, self.capacity)
-                    rows, vrows = self._host[r0:r1], self._valid[r0:r1]
-                    self._apply_patch(r0, rows, vrows, donate)
-                    nbytes = int(rows.nbytes + vrows.nbytes)
-                    s.patch_bytes += nbytes
-                    s.bytes_uploaded += nbytes
-                    s.rows_patched += r1 - r0
+                patch_bytes = 0
+                with _tracer.span("device.sync", {"mode": "patch"}) as sp:
+                    for start_b, n_b in _coalesce_runs(
+                        sorted(self._dirty_blocks), cap_blocks
+                    ):
+                        r0 = start_b * BLOCK_ROWS
+                        r1 = min((start_b + n_b) * BLOCK_ROWS, self.capacity)
+                        rows, vrows = self._host[r0:r1], self._valid[r0:r1]
+                        self._apply_patch(r0, rows, vrows, donate)
+                        nbytes = int(rows.nbytes + vrows.nbytes)
+                        patch_bytes += nbytes
+                        s.patch_bytes += nbytes
+                        s.bytes_uploaded += nbytes
+                        s.rows_patched += r1 - r0
+                    sp.set_attr("bytes", patch_bytes)
                 s.patches += 1
+                _SYNC_PATCH_CELL.observe(time.perf_counter() - t0)
+                _SYNC_PATCH_BYTES_CELL.observe(patch_bytes)
             self._full_dirty = False
             self._dirty_blocks.clear()
             if _record_stall:

@@ -9,6 +9,7 @@ device plane is untouched (SURVEY.md §2.3).
 
 from __future__ import annotations
 
+import contextvars
 import json
 import logging
 import os
@@ -19,6 +20,7 @@ from dataclasses import dataclass, field
 from typing import Any, Callable, Optional
 
 from nornicdb_tpu.errors import ReplicationError
+from nornicdb_tpu.telemetry.tracing import tracer as _tracer
 from nornicdb_tpu.replication.ha_standby import apply_op
 from nornicdb_tpu.replication.transport import (
     MSG_APPEND_ENTRIES,
@@ -340,24 +342,31 @@ class RaftNode:
     def propose(self, op: str, data: dict[str, Any]) -> int:
         """Leader-only: append an op, replicate, return its index."""
         applied: list[LogEntry] = []
-        with self._lock:
-            if self.state != LEADER:
-                raise ReplicationError(f"not the leader (leader={self.leader_id})")
-            entry = LogEntry(self.current_term, len(self.log) + 1, op, data)
-            self.log.append(entry)
-            self._persist_log_append([entry])
-            index = entry.index
-            if not self.peer_ids:
-                # single-node cluster: a majority of one holds it already
-                applied = self._advance_commit()
-        self._notify_applied(applied)
-        self._broadcast_append_entries()
+        with _tracer.span("replication.propose", {"op": op}):
+            with self._lock:
+                if self.state != LEADER:
+                    raise ReplicationError(
+                        f"not the leader (leader={self.leader_id})"
+                    )
+                entry = LogEntry(self.current_term, len(self.log) + 1, op, data)
+                self.log.append(entry)
+                self._persist_log_append([entry])
+                index = entry.index
+                if not self.peer_ids:
+                    # single-node cluster: a majority of one holds it already
+                    applied = self._advance_commit()
+            self._notify_applied(applied)
+            self._broadcast_append_entries()
         return index
 
     def _broadcast_append_entries(self) -> None:
         for peer in self.peer_ids:
+            # copy_context: the sender threads inherit the proposer's trace
+            # context, so transport.request stamps the AppendEntries frames
+            # with the originating request's traceparent
+            ctx = contextvars.copy_context()
             threading.Thread(
-                target=self._send_append, args=(peer,), daemon=True
+                target=ctx.run, args=(self._send_append, peer), daemon=True
             ).start()
 
     def _send_append(self, peer: str) -> None:
@@ -433,12 +442,13 @@ class RaftNode:
         returned entries and hand them to :meth:`_notify_applied` after
         releasing ``_lock``."""
         applied: list[LogEntry] = []
-        while self.last_applied < self.commit_index:
-            self.last_applied += 1
-            entry = self.log[self.last_applied - 1]
-            if self.storage is not None and entry.op:
-                apply_op(self.storage, entry.op, entry.data)
-            applied.append(entry)
+        with _tracer.span("replication.commit"):
+            while self.last_applied < self.commit_index:
+                self.last_applied += 1
+                entry = self.log[self.last_applied - 1]
+                if self.storage is not None and entry.op:
+                    apply_op(self.storage, entry.op, entry.data)
+                applied.append(entry)
         return applied
 
     def _notify_applied(self, entries: list[LogEntry]) -> None:
@@ -495,6 +505,14 @@ class RaftNode:
         term = p.get("term")
         if not isinstance(term, int):
             return Message(0, {"term": self.current_term, "success": False})
+        # child of the transport-continued trace when the leader's
+        # AppendEntries carried a traceparent; no-op otherwise
+        with _tracer.span("replication.append",
+                          {"entries": len(p.get("entries") or [])}):
+            return self._handle_append_locked(p)
+
+    def _handle_append_locked(self, p: dict) -> Message:
+        term = p["term"]  # validated by _handle_append
         with self._lock:
             if term < self.current_term:
                 return Message(0, {"term": self.current_term, "success": False})

@@ -27,6 +27,7 @@ from dataclasses import dataclass, field
 from typing import Any, Callable, Optional
 
 from nornicdb_tpu.errors import ReplicationError
+from nornicdb_tpu.telemetry.tracing import tracer as _tracer
 
 log = logging.getLogger(__name__)
 
@@ -50,13 +51,17 @@ class Message:
     payload: dict[str, Any] = field(default_factory=dict)
     request_id: str = ""
     sender: str = ""
+    # W3C traceparent carried across the wire so a replication RPC keeps
+    # its originating request's trace id (telemetry tentpole); empty on
+    # untraced messages and omitted from the frame
+    traceparent: str = ""
 
     def encode(self) -> bytes:
-        body = json.dumps(
-            {"payload": self.payload, "request_id": self.request_id,
-             "sender": self.sender},
-            separators=(",", ":"),
-        ).encode()
+        obj = {"payload": self.payload, "request_id": self.request_id,
+               "sender": self.sender}
+        if self.traceparent:
+            obj["tp"] = self.traceparent
+        body = json.dumps(obj, separators=(",", ":")).encode()
         return bytes([self.type]) + struct.pack(">I", len(body)) + body
 
     @staticmethod
@@ -69,7 +74,7 @@ class Message:
         obj = json.loads(body)
         return Message(
             mtype, obj.get("payload", {}), obj.get("request_id", ""),
-            obj.get("sender", ""),
+            obj.get("sender", ""), obj.get("tp", ""),
         )
 
 
@@ -103,6 +108,10 @@ class Transport:
     def request(self, peer: str, msg: Message, timeout: float = 5.0) -> Message:
         msg.request_id = str(uuid.uuid4())
         msg.sender = self.node_id
+        if not msg.traceparent:
+            # attach the caller's trace id so the peer's handler spans join
+            # this request's trace (None -> stays empty, zero overhead)
+            msg.traceparent = _tracer.current_traceparent() or ""
         ev = threading.Event()
         with self._plock:
             self._pending[msg.request_id] = ev
@@ -129,7 +138,18 @@ class Transport:
                     ev.set()
                     return
         if self.handler is not None:
-            reply = self.handler(msg)
+            if msg.traceparent:
+                # continue the sender's trace on this node: the handler's
+                # spans (raft append/commit, storage ops) record under the
+                # originating request's trace id
+                with _tracer.start_trace(
+                    f"replication.handle.{msg.type}",
+                    traceparent=msg.traceparent,
+                    attrs={"sender": msg.sender},
+                ):
+                    reply = self.handler(msg)
+            else:
+                reply = self.handler(msg)
             if reply is not None and msg.request_id:
                 reply.type = MSG_RESPONSE
                 reply.request_id = msg.request_id

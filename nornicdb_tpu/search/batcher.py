@@ -16,10 +16,26 @@ results back out. Under low concurrency a query waits at most `window`
 from __future__ import annotations
 
 import threading
+import time
 from dataclasses import dataclass, field
 from typing import Any, Callable, Optional
 
 import numpy as np
+
+from nornicdb_tpu.telemetry.metrics import REGISTRY as _REGISTRY
+from nornicdb_tpu.telemetry.tracing import tracer as _tracer
+
+# queue wait (enqueue -> batch dispatch) vs device time (the batched GEMM
+# itself): the two halves of a batched query's latency, the numbers the
+# batch window is tuned from
+_QUEUE_WAIT_HIST = _REGISTRY.histogram(
+    "nornicdb_search_queue_wait_seconds",
+    "Time a batched search waited for its batch to dispatch",
+)
+_DEVICE_HIST = _REGISTRY.histogram(
+    "nornicdb_search_device_seconds",
+    "Device dispatch time per search batch",
+)
 
 
 @dataclass
@@ -30,6 +46,8 @@ class _Pending:
     event: threading.Event = field(default_factory=threading.Event)
     result: Optional[list] = None
     error: Optional[Exception] = None
+    enqueued: float = 0.0  # perf_counter at submit
+    ctx: Any = None  # caller's trace span, carried across the worker hop
 
 
 @dataclass
@@ -78,6 +96,8 @@ class QueryBatcher:
         self, query: np.ndarray, k: int, min_similarity: float = -1.0
     ) -> list:
         p = _Pending(np.asarray(query, np.float32).reshape(-1), k, min_similarity)
+        p.enqueued = time.perf_counter()
+        p.ctx = _tracer.capture()  # None when the caller isn't traced
         with self._lock:
             self._pending.append(p)
             if self._flusher is None:
@@ -108,7 +128,25 @@ class QueryBatcher:
             queries = np.stack([p.query for p in pending])
             k = max(p.k for p in pending)
             min_sim = min(p.min_similarity for p in pending)
-            results = self.search_batch_fn(queries, k, min_sim)
+            t_dispatch = time.perf_counter()
+            for p in pending:
+                _QUEUE_WAIT_HIST.observe(t_dispatch - p.enqueued)
+                # per-caller queue-wait span, recorded into the CALLER's
+                # trace (the worker-hop propagation the ISSUE requires)
+                if p.ctx is not None:
+                    _tracer.add_span(
+                        "search.queue_wait", p.enqueued, t_dispatch,
+                        parent=p.ctx,
+                    )
+            # device work attributes to the batch leader's trace; followers
+            # still get their queue-wait span above
+            leader_ctx = pending[0].ctx
+            with _tracer.attach(leader_ctx):
+                with _tracer.span(
+                    "search.batch", {"batch_size": len(pending)}
+                ):
+                    results = self.search_batch_fn(queries, k, min_sim)
+            _DEVICE_HIST.observe(time.perf_counter() - t_dispatch)
             with self._lock:
                 self.stats.queries += len(pending)
                 self.stats.batches += 1

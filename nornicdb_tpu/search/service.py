@@ -29,6 +29,21 @@ from nornicdb_tpu.search.bm25 import BM25Index
 from nornicdb_tpu.search.fusion import adaptive_rrf_weights, apply_mmr, fuse_rrf
 from nornicdb_tpu.search.hnsw import HNSWIndex
 from nornicdb_tpu.storage.types import Engine, Node
+from nornicdb_tpu.telemetry.metrics import REGISTRY as _REGISTRY
+from nornicdb_tpu.telemetry.tracing import tracer as _tracer
+
+# same families the QueryBatcher feeds (idempotent re-resolution by
+# name, so neither module depends on the other's import order or private
+# cells): unbatched corpus dispatches report device time too, and the
+# queue-wait family is registered even before batching is ever enabled
+_DEVICE_HIST = _REGISTRY.histogram(
+    "nornicdb_search_device_seconds",
+    "Device dispatch time per search batch",
+)
+_REGISTRY.histogram(
+    "nornicdb_search_queue_wait_seconds",
+    "Time a batched search waited for its batch to dispatch",
+)
 
 
 @dataclass
@@ -224,9 +239,16 @@ class SearchService:
                 kwargs = {}
                 if self.config.n_probe > 0 and hasattr(self._corpus, "cluster"):
                     kwargs["n_probe"] = self.config.n_probe
-                res = self._corpus.search(
-                    embedding, k=k, min_similarity=min_similarity, **kwargs
-                )
+                t0 = time.perf_counter()
+                with _tracer.span("search.vector"):
+                    res = self._corpus.search(
+                        embedding, k=k, min_similarity=min_similarity,
+                        **kwargs
+                    )
+                # unbatched dispatches land in the same device-time
+                # histogram the batcher feeds, so the default (non-batched)
+                # configuration still reports device time
+                _DEVICE_HIST.observe(time.perf_counter() - t0)
                 return res[0] if res else []
             if self._hnsw is not None:
                 return [
@@ -305,11 +327,22 @@ class SearchService:
     ) -> list[tuple[str, float, Optional[float], Optional[float]]]:
         """The expensive half of a search: embed + vector + BM25 + fusion
         (+ rerank/MMR). Returns ordered (id, score, vec_score, ft_score)."""
+        with _tracer.span("search.rank"):
+            return self._rank_inner(query, limit, min_sim, query_embedding)
+
+    def _rank_inner(
+        self,
+        query: str,
+        limit: int,
+        min_sim: float,
+        query_embedding: Optional[np.ndarray],
+    ) -> list[tuple[str, float, Optional[float], Optional[float]]]:
         n_cand = max(limit * self.config.candidates_multiplier, limit)
         ranked: dict[str, list[str]] = {}
         vec_scores: dict[str, float] = {}
         if query_embedding is None and self.embedder is not None and query:
-            query_embedding = self.embedder.embed(query)
+            with _tracer.span("search.embed"):
+                query_embedding = self.embedder.embed(query)
         if query_embedding is not None:
             vec = self.vector_candidates(query_embedding, n_cand, min_sim)
             ranked["vector"] = [i for i, _ in vec]

@@ -16,13 +16,21 @@ import asyncio
 import logging
 import struct
 import threading
+import time
 from typing import Any, Optional
 
 from nornicdb_tpu.cypher.executor import classify_query_text
 from nornicdb_tpu.errors import AuthError
 from nornicdb_tpu.server.packstream import Structure, pack, to_wire, unpack
+from nornicdb_tpu.telemetry.metrics import REGISTRY as _REGISTRY
+from nornicdb_tpu.telemetry.tracing import tracer as _tracer
 
 log = logging.getLogger(__name__)
+
+_BOLT_HIST = _REGISTRY.histogram(
+    "nornicdb_bolt_request_seconds",
+    "Bolt RUN latency (query execution, excluding PULL streaming)",
+)
 
 MAGIC = b"\x60\x60\xb0\x17"
 
@@ -259,7 +267,19 @@ class BoltSession:
                 raise AuthError(
                     f"permission {perm} denied for role {self.role}"
                 )
-        result = self._execute(query, params or {})
+        # Bolt ingress root span: drivers may hand a W3C traceparent via
+        # the RUN extra's tx_metadata (no header channel on Bolt); the
+        # executor / storage / device spans below nest under this root
+        meta = extra.get("tx_metadata") if isinstance(extra, dict) else None
+        traceparent = (
+            meta.get("traceparent") if isinstance(meta, dict) else None
+        )
+        t0 = time.perf_counter()
+        with _tracer.start_trace("bolt.run", traceparent=traceparent) as root:
+            if root.trace_id is not None:
+                root.set_attr("db", self.database or "neo4j")
+            result = self._execute(query, params or {})
+        _BOLT_HIST.observe(time.perf_counter() - t0)
         self.streaming = {
             "columns": result.columns,
             "rows": result.rows,

@@ -25,8 +25,15 @@ import time
 from typing import Any, Iterator, Optional
 
 from nornicdb_tpu.errors import NotFoundError
+from nornicdb_tpu.telemetry.metrics import REGISTRY as _REGISTRY
+from nornicdb_tpu.telemetry.tracing import tracer as _tracer
 
 SERVICE_NAME = "nornicdb.SearchService"
+
+_GRPC_HIST = _REGISTRY.histogram(
+    "nornicdb_grpc_request_seconds",
+    "gRPC Search latency (incl. cache hits)",
+)
 
 
 # ---------------------------------------------------------------- protobuf
@@ -201,6 +208,24 @@ class GrpcSearchServer:
         self.host = host
 
     def _search(self, request: bytes, context) -> bytes:
+        # ingress trace root; clients may attach a W3C traceparent as gRPC
+        # metadata, carrying their trace across the process boundary
+        traceparent = None
+        try:
+            for key, value in context.invocation_metadata() or ():
+                if key == "traceparent":
+                    traceparent = value
+                    break
+        except (AttributeError, TypeError):  # doubles without metadata
+            traceparent = None
+        t_req = time.perf_counter()
+        try:
+            with _tracer.start_trace("grpc.search", traceparent=traceparent):
+                return self._search_traced(request)
+        finally:
+            _GRPC_HIST.observe(time.perf_counter() - t_req)
+
+    def _search_traced(self, request: bytes) -> bytes:
         # serialized-response cache: generation-invalidated + short TTL,
         # shared policy with the HTTP search cache (server/respcache.py) —
         # skips decode, rank, node fetch, and protobuf encode on hits

@@ -29,6 +29,12 @@ from nornicdb_tpu.storage.types import Edge, Node
 from nornicdb_tpu.cypher import ast as cypher_ast
 from nornicdb_tpu.cypher.executor import classify_query_text
 from nornicdb_tpu.cypher.parser import parse as cypher_parse
+from nornicdb_tpu.telemetry.metrics import (
+    REGISTRY as _TELEMETRY_REGISTRY,
+    Registry as _Registry,
+)
+from nornicdb_tpu.telemetry.slowlog import slow_log as _slow_log
+from nornicdb_tpu.telemetry.tracing import tracer as _tracer
 
 log = logging.getLogger(__name__)
 
@@ -135,6 +141,22 @@ class HttpServer:
         self._qdrant = None
         self._httpd: Optional[ThreadingHTTPServer] = None
         self._thread: Optional[threading.Thread] = None
+        # per-server child registry: instrumentation-site families from the
+        # process-global REGISTRY render first, then this server's
+        # db-specific callbacks — so several servers in one process (tests)
+        # never fight over one namespace
+        self.registry = _Registry(parent=_TELEMETRY_REGISTRY)
+        self._http_hist = self.registry.histogram(
+            "nornicdb_http_request_seconds",
+            "HTTP request latency by method and route family",
+            labels=("method", "route"),
+        )
+        self._http_by_code = self.registry.counter(
+            "nornicdb_http_requests_by_code_total",
+            "HTTP requests by method and status code",
+            labels=("method", "code"),
+        )
+        self._register_db_metrics()
 
     @staticmethod
     def _parse_body(raw: bytes) -> dict:
@@ -208,9 +230,15 @@ class HttpServer:
                 extra_headers: Optional[dict[str, str]] = None,
             ) -> None:
                 """Pre-encoded body with the standard header set."""
+                self._status = code
                 self.send_response(code)
                 self.send_header("Content-Type", content_type)
                 self.send_header("Content-Length", str(len(data)))
+                root = getattr(self, "_trace_root", None)
+                if root is not None and root.trace_id is not None:
+                    # propagate the (possibly ingested) trace id back to the
+                    # caller (W3C trace-context response propagation)
+                    self.send_header("traceparent", root.traceparent())
                 for k, v in (extra_headers or {}).items():
                     self.send_header(k, v)
                 self.send_header("Access-Control-Allow-Origin", "*")
@@ -297,24 +325,51 @@ class HttpServer:
                 server_self.requests += 1
                 if self._limited():
                     return
-                try:
-                    path = self.path.split("?")[0]
-                    if path.startswith("/collections"):
-                        server_self._route_qdrant(self, method, path)
-                        return
-                    if method == "GET":
-                        server_self._route_get(self)
-                    elif method == "POST":
-                        server_self._route_post(self)
-                    elif path.startswith("/auth/users/"):
-                        server_self._route_user_by_name(self, method, path)
-                    else:
-                        self._send(405, {"error": f"{method} not allowed on {path}"})
-                except AuthError as e:
-                    self._send(401, {"error": str(e)})
-                except Exception as e:
-                    server_self.errors += 1
-                    self._send(400 if method != "GET" else 500, {"error": str(e)})
+                path = self.path.split("?")[0]
+                route = server_self._route_label(path)
+                self._status = 200
+                t0 = time.perf_counter()
+                # ingress tracing: ingest W3C traceparent, open the root
+                # span every downstream span (executor, storage, device
+                # sync) hangs off; the id is echoed on the response by
+                # _send_raw
+                with _tracer.start_trace(
+                    f"http.{method}", traceparent=self.headers.get("traceparent")
+                ) as root:
+                    if root.trace_id is not None:
+                        root.set_attr("path", path)
+                        root.set_attr("route", route)
+                    self._trace_root = root
+                    try:
+                        if path.startswith("/collections"):
+                            server_self._route_qdrant(self, method, path)
+                            return
+                        if method == "GET":
+                            server_self._route_get(self)
+                        elif method == "POST":
+                            server_self._route_post(self)
+                        elif path.startswith("/auth/users/"):
+                            server_self._route_user_by_name(self, method, path)
+                        else:
+                            self._send(405, {"error": f"{method} not allowed on {path}"})
+                    except AuthError as e:
+                        self._send(401, {"error": str(e)})
+                    except Exception as e:
+                        server_self.errors += 1
+                        self._send(400 if method != "GET" else 500, {"error": str(e)})
+                    finally:
+                        # a keep-alive connection reuses this handler:
+                        # responses sent before the NEXT request's trace
+                        # opens (e.g. the rate limiter's 429) must not echo
+                        # this request's traceparent
+                        self._trace_root = None
+                        elapsed = time.perf_counter() - t0
+                        server_self._http_hist.labels(method, route).observe(
+                            elapsed
+                        )
+                        server_self._http_by_code.labels(
+                            method, str(self._status)
+                        ).inc()
 
             def do_GET(self):
                 self._dispatch("GET")
@@ -425,7 +480,8 @@ class HttpServer:
             h._send(200, body)
             return
         if path == "/metrics":
-            h._send(200, self._prometheus(), content_type="text/plain; version=0.0.4")
+            h._send(200, self.registry.render_prometheus(),
+                    content_type="text/plain; version=0.0.4")
             return
         if path == "/auth/config":
             # UI bootstrap: is auth on, which OAuth providers exist
@@ -590,12 +646,40 @@ class HttpServer:
                 bus.unsubscribe(q)
             h.close_connection = True
             return
+        if path == "/admin/traces":
+            # recent completed traces, newest first (tentpole pillar 2)
+            h._auth("admin")
+            h._send(200, {"traces": _tracer.traces()})
+            return
+        if path.startswith("/admin/traces/"):
+            h._auth("admin")
+            trace_id = path[len("/admin/traces/"):]
+            tree = _tracer.trace(trace_id)
+            if tree is None:
+                h._send(404, {"error": f"trace {trace_id} not found"})
+            else:
+                h._send(200, tree)
+            return
+        if path == "/admin/slow-queries":
+            # over-threshold statements with redacted text, plan summary,
+            # span breakdown and counter deltas (tentpole pillar 3)
+            h._auth("admin")
+            h._send(200, {
+                "threshold_ms": _slow_log.threshold_s * 1e3,
+                "recorded": _slow_log.recorded,
+                "slow_queries": _slow_log.snapshot(),
+            })
+            return
         if path == "/admin/stats":
             h._auth("admin")
             stats = {
                 "requests": self.requests,
                 "errors": self.errors,
                 "slow_queries": self.slow_queries,
+                "telemetry": {
+                    "traces_buffered": _tracer.count(),
+                    "slow_queries_recorded": _slow_log.recorded,
+                },
                 "nodes": self.db.storage.node_count(),
                 "edges": self.db.storage.edge_count(),
                 "pending_embeddings": len(self.db.storage.pending_embed_ids()),
@@ -677,80 +761,159 @@ class HttpServer:
             out["error"] = str(e)[:200]
         return out
 
-    def _prometheus(self) -> str:
-        """(ref: server_public.go:141-200 — hand-rendered text format)"""
-        lines = [
-            "# TYPE nornicdb_uptime_seconds gauge",
-            f"nornicdb_uptime_seconds {time.monotonic() - self.started_at:.1f}",
-            "# TYPE nornicdb_requests_total counter",
-            f"nornicdb_requests_total {self.requests}",
-            "# TYPE nornicdb_errors_total counter",
-            f"nornicdb_errors_total {self.errors}",
-            "# TYPE nornicdb_nodes gauge",
-            f"nornicdb_nodes {self.db.storage.node_count()}",
-            "# TYPE nornicdb_edges gauge",
-            f"nornicdb_edges {self.db.storage.edge_count()}",
-            "# TYPE nornicdb_pending_embeddings gauge",
-            f"nornicdb_pending_embeddings {len(self.db.storage.pending_embed_ids())}",
-            "# TYPE nornicdb_slow_queries_total counter",
-            f"nornicdb_slow_queries_total {self.slow_queries}",
-        ]
-        if self.db._embed_worker is not None:
-            s = self.db._embed_worker.stats
-            lines += [
-                "# TYPE nornicdb_embeddings_processed_total counter",
-                f"nornicdb_embeddings_processed_total {s.processed}",
-                "# TYPE nornicdb_embeddings_failed_total counter",
-                f"nornicdb_embeddings_failed_total {s.failed}",
-            ]
-        search = getattr(self.db, "search", None)
-        if search is not None and hasattr(search, "stats_snapshot"):
-            snap = search.stats_snapshot()
-            sync = (snap.get("corpus") or {}).get("sync")
-            if sync:
-                lines += [
-                    "# TYPE nornicdb_device_sync_bytes_total counter",
-                    f"nornicdb_device_sync_bytes_total {sync['bytes_uploaded']}",
-                    "# TYPE nornicdb_device_sync_patches_total counter",
-                    f"nornicdb_device_sync_patches_total {sync['patches']}",
-                    "# TYPE nornicdb_device_sync_full_uploads_total counter",
-                    f"nornicdb_device_sync_full_uploads_total {sync['full_uploads']}",
-                    "# TYPE nornicdb_device_sync_query_stall_seconds_total counter",
-                    f"nornicdb_device_sync_query_stall_seconds_total {sync['query_stall_s']:.6f}",
-                ]
-            batcher = snap.get("batcher")
-            if batcher:
-                lines += [
-                    "# TYPE nornicdb_batched_queries_total counter",
-                    f"nornicdb_batched_queries_total {batcher['queries']}",
-                    "# TYPE nornicdb_query_batches_total counter",
-                    f"nornicdb_query_batches_total {batcher['batches']}",
-                    "# TYPE nornicdb_query_batch_max gauge",
-                    f"nornicdb_query_batch_max {batcher['max_batch']}",
-                ]
-        adjacency = self.db.adjacency_stats()
-        if adjacency is not None:
-            lines += [
-                "# TYPE nornicdb_adjacency_builds_total counter",
-                f"nornicdb_adjacency_builds_total {adjacency['builds']}",
-                "# TYPE nornicdb_adjacency_delta_merges_total counter",
-                f"nornicdb_adjacency_delta_merges_total {adjacency['delta_merges']}",
-                "# TYPE nornicdb_adjacency_merged_edges_total counter",
-                f"nornicdb_adjacency_merged_edges_total {adjacency['merged_edges']}",
-                "# TYPE nornicdb_adjacency_epoch_retries_total counter",
-                f"nornicdb_adjacency_epoch_retries_total {adjacency['epoch_retries']}",
-                "# TYPE nornicdb_adjacency_bytes gauge",
-                f"nornicdb_adjacency_bytes {adjacency['bytes']}",
-                "# TYPE nornicdb_adjacency_delta_pending gauge",
-                f"nornicdb_adjacency_delta_pending {adjacency['delta_pending']}",
-            ]
-        # heimdall named metrics when the assistant has been used
-        # (ref: pkg/heimdall/metrics.go Prometheus rendering)
-        if self.db._heimdall is not None:
-            rendered = self.db._heimdall.metrics_registry.render_prometheus()
-            if rendered:
-                lines.append(rendered.rstrip("\n"))
-        return "\n".join(lines) + "\n"
+    # -- telemetry wiring (ref: server_public.go:141-200, now rendered
+    # entirely by the telemetry registry instead of a hand-built string) ----
+    def _register_db_metrics(self) -> None:
+        """Register this server's db-level providers as render-time
+        callbacks.  Subsystem stats() dicts plug in via stats_callback
+        (numeric leaves flattened to gauges) with exact-name renames for
+        the documented/asserted metric names."""
+        reg = self.registry
+        reg.gauge_callback(
+            "nornicdb_uptime_seconds", "Server uptime in seconds",
+            lambda: time.monotonic() - self.started_at,
+        )
+        reg.counter_callback(
+            "nornicdb_requests_total", "HTTP requests served",
+            lambda: self.requests,
+        )
+        reg.counter_callback(
+            "nornicdb_errors_total", "HTTP requests that raised",
+            lambda: self.errors,
+        )
+        reg.counter_callback(
+            "nornicdb_slow_queries_total",
+            "Statements captured by the slow-query log",
+            lambda: _slow_log.recorded,
+        )
+        reg.gauge_callback(
+            "nornicdb_nodes", "Nodes in the default database view",
+            lambda: self.db.storage.node_count(),
+        )
+        reg.gauge_callback(
+            "nornicdb_edges", "Edges in the default database view",
+            lambda: self.db.storage.edge_count(),
+        )
+        reg.gauge_callback(
+            "nornicdb_pending_embeddings", "Nodes awaiting embedding",
+            lambda: len(self.db.storage.pending_embed_ids()),
+        )
+
+        def _embed_stats() -> Optional[dict]:
+            w = self.db._embed_worker
+            return None if w is None else vars(w.stats)
+
+        reg.stats_callback(
+            "nornicdb_embed", _embed_stats,
+            help_="Embed-worker counters",
+            rename={
+                "nornicdb_embed_processed":
+                    "nornicdb_embeddings_processed_total",
+                "nornicdb_embed_failed": "nornicdb_embeddings_failed_total",
+            },
+            counters={"nornicdb_embed_processed", "nornicdb_embed_failed"},
+        )
+
+        def _search_stats() -> Optional[dict]:
+            # the LAZY slot, never the property: /metrics must not force
+            # search-service construction (and a full index build)
+            search = self.db._search
+            if search is None or not hasattr(search, "stats_snapshot"):
+                return None
+            return search.stats_snapshot()
+
+        reg.stats_callback(
+            "nornicdb_search", _search_stats,
+            help_="Search service / device-sync / query-batcher counters",
+            rename={
+                "nornicdb_search_corpus_sync_bytes_uploaded":
+                    "nornicdb_device_sync_bytes_total",
+                "nornicdb_search_corpus_sync_patches":
+                    "nornicdb_device_sync_patches_total",
+                "nornicdb_search_corpus_sync_full_uploads":
+                    "nornicdb_device_sync_full_uploads_total",
+                "nornicdb_search_corpus_sync_query_stall_s":
+                    "nornicdb_device_sync_query_stall_seconds_total",
+                "nornicdb_search_batcher_queries":
+                    "nornicdb_batched_queries_total",
+                "nornicdb_search_batcher_batches":
+                    "nornicdb_query_batches_total",
+                "nornicdb_search_batcher_max_batch":
+                    "nornicdb_query_batch_max",
+            },
+            counters={
+                "nornicdb_search_corpus_sync_bytes_uploaded",
+                "nornicdb_search_corpus_sync_patches",
+                "nornicdb_search_corpus_sync_full_uploads",
+                "nornicdb_search_corpus_sync_query_stall_s",
+                "nornicdb_search_batcher_queries",
+                "nornicdb_search_batcher_batches",
+                "nornicdb_search_searches",
+                "nornicdb_search_indexed",
+                "nornicdb_search_removed",
+                "nornicdb_search_vector_candidates",
+                "nornicdb_search_fulltext_candidates",
+            },
+        )
+        reg.stats_callback(
+            "nornicdb_wal", lambda: self.db.wal_stats(),
+            help_="Write-ahead-log health counters",
+            counters={
+                "nornicdb_wal_entries", "nornicdb_wal_bytes_written",
+                "nornicdb_wal_snapshots", "nornicdb_wal_recovered_entries",
+                "nornicdb_wal_truncated_tail_records",
+            },
+        )
+        reg.stats_callback(
+            "nornicdb_adjacency", lambda: self.db.adjacency_stats(),
+            help_="CSR adjacency snapshot counters",
+            rename={
+                "nornicdb_adjacency_builds": "nornicdb_adjacency_builds_total",
+                "nornicdb_adjacency_delta_merges":
+                    "nornicdb_adjacency_delta_merges_total",
+                "nornicdb_adjacency_merged_edges":
+                    "nornicdb_adjacency_merged_edges_total",
+                "nornicdb_adjacency_epoch_retries":
+                    "nornicdb_adjacency_epoch_retries_total",
+            },
+            counters={
+                "nornicdb_adjacency_builds",
+                "nornicdb_adjacency_delta_merges",
+                "nornicdb_adjacency_merged_edges",
+                "nornicdb_adjacency_epoch_retries",
+            },
+        )
+
+        def _heimdall_families() -> list:
+            # heimdall named metrics when the assistant has been used
+            # (ref: pkg/heimdall/metrics.go Prometheus rendering)
+            mgr = self.db._heimdall
+            if mgr is None:
+                return []
+            return mgr.metrics_registry.prometheus_families()
+
+        reg.families_callback("heimdall", _heimdall_families)
+
+    ROUTE_FAMILIES = (
+        ("/db/", "tx_commit"),
+        ("/nornicdb/", "nornicdb"),
+        ("/admin/", "admin"),
+        ("/auth/", "auth"),
+        ("/collections", "qdrant"),
+        ("/api/bifrost", "bifrost"),
+        ("/v1/", "openai"),
+        ("/gdpr/", "gdpr"),
+    )
+
+    @classmethod
+    def _route_label(cls, path: str) -> str:
+        """Bounded-cardinality route family for metric labels."""
+        if path in ("/metrics", "/health", "/status", "/mcp", "/graphql"):
+            return path.lstrip("/")
+        for prefix, label in cls.ROUTE_FAMILIES:
+            if path.startswith(prefix):
+                return label
+        return "other"
 
     # -- POST routes ---------------------------------------------------------------
     def _route_post(self, h) -> None:

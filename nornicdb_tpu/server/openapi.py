@@ -257,6 +257,16 @@ def build_spec(version: str = "0.4.0") -> dict:
             "Accelerator status (the reference's /admin/gpu/status "
             "analogue); reports initialised-backend state only, never "
             "blocks on a down device relay", tag="admin")},
+        "/admin/traces": {"get": _op(
+            "Recent completed request traces (newest first): trace id, "
+            "root span, duration, span count", tag="admin")},
+        "/admin/traces/{trace_id}": {"get": _op(
+            "One trace as a span tree (W3C trace id; see "
+            "docs/observability.md for the propagation map)", tag="admin")},
+        "/admin/slow-queries": {"get": _op(
+            "Slow-query capture ring: over-threshold statements with "
+            "redacted text, plan summary, span breakdown and "
+            "adjacency/device-sync counter deltas", tag="admin")},
         # -- compliance ------------------------------------------------------
         "/gdpr/export": {"post": _op(
             "Export all data for a subject (GDPR right of access)",

@@ -42,6 +42,7 @@ from __future__ import annotations
 
 import logging
 import threading
+import time
 from typing import Any, Iterable, Optional
 
 import numpy as np
@@ -55,8 +56,18 @@ from nornicdb_tpu.storage.types import (
     Edge,
     Node,
 )
+from nornicdb_tpu.telemetry.metrics import REGISTRY as _REGISTRY
+from nornicdb_tpu.telemetry.tracing import tracer as _tracer
 
 log = logging.getLogger(__name__)
+
+_ADJ_HIST = _REGISTRY.histogram(
+    "nornicdb_adjacency_maintenance_seconds",
+    "CSR snapshot maintenance duration by phase (build / delta merge)",
+    labels=("phase",),
+)
+_ADJ_BUILD_CELL = _ADJ_HIST.labels("build")
+_ADJ_MERGE_CELL = _ADJ_HIST.labels("merge")
 
 _EDGE_EVENTS = (EDGE_CREATED, EDGE_UPDATED, EDGE_DELETED)
 _NODE_EVENTS = (NODE_CREATED, NODE_DELETED)
@@ -378,6 +389,14 @@ class AdjacencySnapshot:
 
     def _install_locked(self, node_ids: list[str],
                         edges: list[tuple[str, str, str, str]]) -> None:
+        t0 = time.perf_counter()
+        with _tracer.span("adjacency.build",
+                          {"nodes": len(node_ids), "edges": len(edges)}):
+            self._install_locked_inner(node_ids, edges)
+        _ADJ_BUILD_CELL.observe(time.perf_counter() - t0)
+
+    def _install_locked_inner(self, node_ids: list[str],
+                              edges: list[tuple[str, str, str, str]]) -> None:
         self._ids = list(node_ids)
         self._idx = {id_: i for i, id_ in enumerate(self._ids)}
         self._alive = [True] * len(self._ids)
@@ -439,6 +458,12 @@ class AdjacencySnapshot:
     def _merge_locked(self) -> None:
         """Fold tombstones + delta adds into fresh canonical arrays. Node
         indices are preserved (vocab is append-only); edge rows renumber."""
+        t0 = time.perf_counter()
+        with _tracer.span("adjacency.merge", {"pending": self._pending}):
+            self._merge_locked_inner()
+        _ADJ_MERGE_CELL.observe(time.perf_counter() - t0)
+
+    def _merge_locked_inner(self) -> None:
         keep = np.nonzero(self._row_alive)[0]
         d_keep = [j for j, a in enumerate(self._d_alive) if a]
         merged = len(d_keep) + self._tombstones

@@ -12,12 +12,24 @@ from __future__ import annotations
 
 import logging
 import threading
+import time
 from typing import Iterable, Iterator, Optional
 
 from nornicdb_tpu.errors import AlreadyExistsError, NotFoundError
 from nornicdb_tpu.storage.types import Edge, Engine, Node
+from nornicdb_tpu.telemetry.metrics import REGISTRY as _REGISTRY
+from nornicdb_tpu.telemetry.tracing import tracer as _tracer
 
 log = logging.getLogger(__name__)
+
+_FLUSH_HIST = _REGISTRY.histogram(
+    "nornicdb_async_flush_seconds",
+    "AsyncEngine overlay flush duration (only flushes that drained ops)",
+)
+_FLUSH_OPS = _REGISTRY.counter(
+    "nornicdb_async_flush_ops_total",
+    "Overlay operations drained to the base engine",
+)
 
 _TOMBSTONE = object()
 
@@ -35,6 +47,11 @@ class AsyncEngine(Engine):
         self._edge_is_create: set[str] = set()
         self._flush_lock = threading.Lock()
         self._closed = False
+        # trace hand-off across the flush hop: the FIRST writer into an
+        # empty overlay becomes the batch leader — the (often background)
+        # flush that drains the batch attaches that writer's span so
+        # storage.flush lands in the originating request's trace
+        self._flush_ctx = None
         # Creates/updates are emitted by THIS engine at write time; the base
         # engine's events for those same ops fire later at flush and would
         # double-notify listeners. Node deletes run directly against the
@@ -93,6 +110,25 @@ class AsyncEngine(Engine):
             self._edges.clear()
             self._node_is_create.clear()
             self._edge_is_create.clear()
+            ctx, self._flush_ctx = self._flush_ctx, None
+        n_ops = len(nodes) + len(edges)
+        if n_ops == 0:
+            # read-path flushes with an empty overlay are the common case;
+            # recording them would drown the histogram in ~0 samples
+            self.base.flush()
+            return
+        t0 = time.perf_counter()
+        # the batch leader's trace (first writer into this overlay window)
+        # adopts the flush — a background drain still shows up in the
+        # request trace that caused it
+        with _tracer.attach(ctx):
+            with _tracer.span("storage.flush", {"ops": n_ops}):
+                self._apply_ops(nodes, node_creates, edges, edge_creates)
+                self.base.flush()
+        _FLUSH_HIST.observe(time.perf_counter() - t0)
+        _FLUSH_OPS.inc(n_ops)
+
+    def _apply_ops(self, nodes, node_creates, edges, edge_creates) -> None:
         for nid, val in nodes:
             try:
                 if val is _TOMBSTONE:
@@ -128,7 +164,12 @@ class AsyncEngine(Engine):
             except Exception:
                 # same contract as the node loop above: dropped == lost
                 log.error("flush dropped edge op for %s", eid, exc_info=True)
-        self.base.flush()
+
+    def _note_writer_locked(self) -> None:
+        """First writer into an empty overlay claims flush-trace leadership
+        (one contextvar read; None when the writer isn't traced)."""
+        if self._flush_ctx is None:
+            self._flush_ctx = _tracer.capture()
 
     # -- nodes -------------------------------------------------------------
     def create_node(self, node: Node) -> Node:
@@ -145,6 +186,7 @@ class AsyncEngine(Engine):
             stored = node.copy()
             self._nodes[node.id] = stored
             self._node_is_create.add(node.id)
+            self._note_writer_locked()
         self._emit("node_created", stored.copy())
         return stored.copy()
 
@@ -176,6 +218,7 @@ class AsyncEngine(Engine):
             self._nodes[node.id] = stored
             if was_create:
                 self._node_is_create.add(node.id)
+            self._note_writer_locked()
         self._emit("node_updated", stored.copy())
         return stored.copy()
 
@@ -217,6 +260,7 @@ class AsyncEngine(Engine):
             stored = edge.copy()
             self._edges[edge.id] = stored
             self._edge_is_create.add(edge.id)
+            self._note_writer_locked()
         self._emit("edge_created", stored.copy())
         return stored.copy()
 
@@ -238,6 +282,7 @@ class AsyncEngine(Engine):
                 self.base.get_edge(edge.id)
             stored = edge.copy()
             self._edges[edge.id] = stored
+            self._note_writer_locked()
         self._emit("edge_updated", stored.copy())
         return stored.copy()
 
@@ -266,6 +311,7 @@ class AsyncEngine(Engine):
                 self._edges[edge_id] = _TOMBSTONE
                 # the base replays this delete at flush; don't notify twice
                 self._deleted_emitted.add(edge_id)
+                self._note_writer_locked()
         self._emit("edge_deleted", entity)
 
     def get_edges_by_type(self, edge_type: str) -> list[Edge]:

@@ -22,6 +22,7 @@ import logging
 import os
 import struct
 import threading
+import time as _time
 import zlib
 from dataclasses import dataclass, field
 from typing import Any, Callable, Optional
@@ -29,8 +30,19 @@ from typing import Any, Callable, Optional
 from nornicdb_tpu.errors import WALCorruptionError
 from nornicdb_tpu.storage import native as _native
 from nornicdb_tpu.storage.types import Edge, Engine, Node
+from nornicdb_tpu.telemetry.metrics import REGISTRY as _REGISTRY
+from nornicdb_tpu.telemetry.tracing import tracer as _tracer
 
 log = logging.getLogger(__name__)
+
+_WAL_APPEND_HIST = _REGISTRY.histogram(
+    "nornicdb_wal_append_seconds",
+    "WAL append latency (encode + write + flush, incl. fsync when sync=True)",
+)
+_WAL_FSYNC_HIST = _REGISTRY.histogram(
+    "nornicdb_wal_fsync_seconds",
+    "WAL fsync latency (sync=True appends only)",
+)
 
 MAGIC = b"NWAL"
 VERSION = 1
@@ -175,19 +187,25 @@ class WAL:
 
     # -- append ------------------------------------------------------------
     def append(self, op: str, data: dict[str, Any], txid: Optional[str] = None) -> int:
-        with self._lock:
-            self._seq += 1
-            entry = WALEntry(seq=self._seq, op=op, data=data, txid=txid)
-            raw = entry.encode(self._encryptor, use_native=self._use_native)
-            self._f.write(raw)
-            self._f.flush()
-            if self.sync:
-                # deliberate fsync under the WAL lock: sync=True is the
-                # durability mode — records must hit disk in seq order
-                os.fsync(self._f.fileno())  # nornlint: disable=NL-LK02
-            self.stats.entries += 1
-            self.stats.bytes_written += len(raw)
-            return self._seq
+        t0 = _time.perf_counter()
+        with _tracer.span("wal.append", {"op": op}):
+            with self._lock:
+                self._seq += 1
+                entry = WALEntry(seq=self._seq, op=op, data=data, txid=txid)
+                raw = entry.encode(self._encryptor, use_native=self._use_native)
+                self._f.write(raw)
+                self._f.flush()
+                if self.sync:
+                    # deliberate fsync under the WAL lock: sync=True is the
+                    # durability mode — records must hit disk in seq order
+                    t_fsync = _time.perf_counter()
+                    os.fsync(self._f.fileno())  # nornlint: disable=NL-LK02
+                    _WAL_FSYNC_HIST.observe(_time.perf_counter() - t_fsync)
+                self.stats.entries += 1
+                self.stats.bytes_written += len(raw)
+                seq = self._seq
+        _WAL_APPEND_HIST.observe(_time.perf_counter() - t0)
+        return seq
 
     @property
     def last_seq(self) -> int:

@@ -7,6 +7,7 @@ co-access; decaying unused relationships).
 
 from __future__ import annotations
 
+import logging
 import threading
 import time
 from collections import deque
@@ -15,6 +16,9 @@ from typing import Callable, Optional
 
 from nornicdb_tpu.filter.kalman import LATENCY, Kalman
 from nornicdb_tpu.storage.types import Engine
+from nornicdb_tpu.telemetry.metrics import count_error
+
+log = logging.getLogger(__name__)
 
 
 class QueryLoadTracker:
@@ -98,7 +102,12 @@ class EdgeStrengthEvolver:
                     self.storage.delete_edge(edge.id)
                     removed += 1
                 except Exception:
-                    pass
+                    # raced a concurrent delete, most likely; the edge is
+                    # gone either way — but count it so a systematically
+                    # failing decay pass is visible
+                    log.debug("decay delete of edge %s failed", edge.id,
+                              exc_info=True)
+                    count_error("temporal.decay_delete")
             else:
                 self.storage.update_edge(edge)
                 weakened += 1

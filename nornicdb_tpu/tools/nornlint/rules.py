@@ -507,6 +507,68 @@ def _walk_scope(body: list[ast.stmt]) -> Iterator[ast.AST]:
                 stack.append(child)
 
 
+# ---------------------------------------------------------------------------
+# NL-OBS01 — print() in library code
+# ---------------------------------------------------------------------------
+
+# CLI surfaces where stdout IS the interface: the package CLI, module
+# entry points, and the linter/sanitizer tooling itself
+_OBS01_EXEMPT_SUFFIXES = ("cli.py", "__main__.py")
+_OBS01_EXEMPT_PARTS = ("/tools/",)
+
+
+def _obs01_exempt_path(relpath: str) -> bool:
+    posix = relpath.replace("\\", "/")
+    if posix.endswith(_OBS01_EXEMPT_SUFFIXES):
+        return True
+    return any(part in posix for part in _OBS01_EXEMPT_PARTS)
+
+
+@register(
+    "NL-OBS01",
+    "warning",
+    "print() in library code — use a module logger or telemetry instead",
+)
+def nl_obs01(ctx: ModuleContext) -> Iterator[Finding]:
+    if _obs01_exempt_path(ctx.relpath):
+        return
+    for node in ast.walk(ctx.tree):
+        if not (
+            isinstance(node, ast.Call)
+            and isinstance(node.func, ast.Name)
+            and node.func.id == "print"
+        ):
+            continue
+        # a conventional CLI entry function is stdout's legitimate home
+        in_main = any(
+            isinstance(a, (ast.FunctionDef, ast.AsyncFunctionDef))
+            and a.name == "main"
+            for a in ctx.ancestors(node)
+        )
+        # ... as is an `if __name__ == "__main__":` block
+        in_main_guard = any(
+            isinstance(a, ast.If)
+            and isinstance(a.test, ast.Compare)
+            and isinstance(a.test.left, ast.Name)
+            and a.test.left.id == "__name__"
+            for a in ctx.ancestors(node)
+        )
+        if in_main or in_main_guard:
+            continue
+        yield ctx.finding(
+            nl_obs01, node,
+            "print() writes to stdout from library code; route "
+            "diagnostics through the module logger (operators can't "
+            "filter, timestamp, or ship stdout prints) or a telemetry "
+            "counter",
+        )
+
+
+# ---------------------------------------------------------------------------
+# NL-TM01 — wall-clock time used for durations
+# ---------------------------------------------------------------------------
+
+
 @register(
     "NL-TM01",
     "warning",

@@ -1,0 +1,96 @@
+#!/usr/bin/env python
+"""CI telemetry smoke: boot a live server, drive one traced request, then
+curl /metrics and /admin/traces and fail on non-200 or empty payloads.
+
+Run: JAX_PLATFORMS=cpu python scripts/telemetry_smoke.py
+Exit 0 = healthy; any other exit fails the CI step.
+
+Uses the system `curl` when present (the exposition must be reachable by a
+plain HTTP client, not just our own urllib), falling back to urllib on
+images without it.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import shutil
+import subprocess
+import sys
+import urllib.request
+
+# runnable from a checkout without an editable install
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def fetch(url: str) -> tuple[int, bytes]:
+    if shutil.which("curl"):
+        proc = subprocess.run(
+            ["curl", "-s", "-o", "-", "-w", "\n%{http_code}", url],
+            capture_output=True, timeout=30,
+        )
+        body, _, code = proc.stdout.rpartition(b"\n")
+        return int(code or b"0"), body
+    try:
+        with urllib.request.urlopen(url, timeout=30) as resp:
+            return resp.status, resp.read()
+    except urllib.error.HTTPError as e:  # non-2xx still has a status
+        return e.code, e.read()
+
+
+def main() -> int:
+    import nornicdb_tpu
+    from nornicdb_tpu.embed.base import HashEmbedder
+    from nornicdb_tpu.server.http import HttpServer
+
+    db = nornicdb_tpu.open_db("")
+    db.set_embedder(HashEmbedder(64))
+    server = HttpServer(db, port=0)
+    server.start()
+    base = f"http://127.0.0.1:{server.port}"
+    failures: list[str] = []
+    try:
+        # one traced write so /admin/traces has something to show
+        req = urllib.request.Request(
+            base + "/db/neo4j/tx/commit",
+            data=json.dumps({"statements": [
+                {"statement": "CREATE (:Smoke {ok: true}) RETURN 1"},
+            ]}).encode(),
+            headers={"Content-Type": "application/json"},
+        )
+        with urllib.request.urlopen(req, timeout=30) as resp:
+            if resp.status != 200:
+                failures.append(f"tx/commit -> {resp.status}")
+
+        code, body = fetch(base + "/metrics")
+        if code != 200:
+            failures.append(f"/metrics -> {code}")
+        elif not body.strip():
+            failures.append("/metrics returned an empty exposition")
+        elif b"# TYPE" not in body or b"nornicdb_" not in body:
+            failures.append("/metrics exposition has no nornicdb families")
+
+        code, body = fetch(base + "/admin/traces")
+        if code != 200:
+            failures.append(f"/admin/traces -> {code}")
+        else:
+            traces = json.loads(body).get("traces", [])
+            if not traces:
+                failures.append("/admin/traces is empty after a request")
+
+        code, body = fetch(base + "/admin/slow-queries")
+        if code != 200:
+            failures.append(f"/admin/slow-queries -> {code}")
+    finally:
+        server.stop()
+        db.close()
+    if failures:
+        for f in failures:
+            print(f"SMOKE FAIL: {f}", file=sys.stderr)
+        return 1
+    print("telemetry smoke ok: /metrics + /admin/traces + /admin/slow-queries")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -175,8 +175,17 @@ class TestCompileWhere:
 
 
 def _rows(res):
+    # floats canonicalize through 9 significant digits: the fastpath and the
+    # generic pipeline may SUM in different orders (hash-seed-dependent scan
+    # order), and float addition is not associative — ulp-level noise like
+    # 194.38789001697194 vs ...88 is equivalence, not a bug
+    def _canon(v):
+        if isinstance(v, float):
+            return f"{v:.9g}"
+        return repr(v)
+
     return sorted(
-        tuple(repr(v) for v in row) for row in res.rows
+        tuple(_canon(v) for v in row) for row in res.rows
     )
 
 

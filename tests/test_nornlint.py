@@ -216,6 +216,34 @@ BAD_CLEAN_FIXTURES = {
             return time.time()  # absolute timestamps are wall-clock's job
         """,
     ),
+    "NL-OBS01": (
+        """
+        def load_checkpoint(path):
+            try:
+                return open(path).read()
+            except OSError as e:
+                print(f"checkpoint {path} failed: {e}")
+                return None
+        """,
+        """
+        import logging
+
+        log = logging.getLogger(__name__)
+
+        def load_checkpoint(path):
+            try:
+                return open(path).read()
+            except OSError:
+                log.warning("checkpoint %s failed", path, exc_info=True)
+                return None
+
+        def main():
+            print("usage: tool <path>")  # CLI entry: stdout is the UI
+
+        if __name__ == "__main__":
+            print("running")  # module-run guard: also a CLI surface
+        """,
+    ),
     # -- interprocedural (project) rules ------------------------------------
     "NL-LK01": (
         """
@@ -338,6 +366,21 @@ def test_at_least_six_rules_across_all_three_families():
 # ---------------------------------------------------------------------------
 # Rule edge cases worth pinning
 # ---------------------------------------------------------------------------
+
+def test_obs01_cli_paths_are_exempt():
+    src = textwrap.dedent("""
+        def run():
+            print("status: ok")
+        """)
+    for exempt in ("nornicdb_tpu/cli.py", "nornicdb_tpu/__main__.py",
+                   "nornicdb_tpu/tools/nornlint/cli.py"):
+        hits = [f for f in lint_source(src, relpath=exempt)
+                if f.rule == "NL-OBS01"]
+        assert not hits, exempt
+    hits = [f for f in lint_source(src, relpath="nornicdb_tpu/db.py")
+            if f.rule == "NL-OBS01"]
+    assert hits, "library path must be flagged"
+
 
 def test_cc01_if_acquire_with_following_try_is_clean():
     src = """

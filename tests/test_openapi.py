@@ -89,6 +89,7 @@ class TestSpecMatchesHandlers:
                 # 400/401/404-for-entity are handler-level responses.
                 if status in (404, 405) and path not in (
                     "/auth/users/{username}",  # probe user doesn't exist
+                    "/admin/traces/{trace_id}",  # probe trace doesn't exist
                 ):
                     misses.append(f"{method.upper()} {path} -> {status}")
         assert not misses, misses

@@ -78,6 +78,36 @@ class TestTransport:
         assert resp.payload == {"pong": True}
         t1.close(); t2.close()
 
+    def test_rpc_carries_trace_id_across_transport(self):
+        """Telemetry contract: a request() issued inside a trace stamps the
+        frame with the caller's traceparent, and the peer's handler runs
+        under the SAME trace id (over the real TCP codec, not just
+        in-process shortcuts)."""
+        from nornicdb_tpu.telemetry.tracing import tracer
+
+        t1 = TcpTransport("t1", ("127.0.0.1", 0), {})
+        t2 = TcpTransport("t2", ("127.0.0.1", 0), {})
+        t1.peer_addrs["t2"] = t2.bind
+        t2.peer_addrs["t1"] = t1.bind
+        seen = {}
+
+        def handler(msg):
+            seen["traceparent"] = msg.traceparent
+            seen["trace_id"] = tracer.current_trace_id()
+            return Message(0, {"ok": True})
+
+        t2.set_handler(handler)
+        try:
+            with tracer.start_trace("replicated.write") as root:
+                t1.request("t2", Message(MSG_REQUEST, {"op": 1}), timeout=3)
+            assert seen["trace_id"] == root.trace_id
+            assert root.trace_id in seen["traceparent"]
+            # untraced requests stay unstamped (no empty-field bloat)
+            t1.request("t2", Message(MSG_REQUEST, {"op": 2}), timeout=3)
+            assert seen["traceparent"] == ""
+        finally:
+            t1.close(); t2.close()
+
 
 class TestHAStandby:
     def _pair(self, chaos: ChaosConfig = None):

@@ -118,6 +118,11 @@ class TestSegmentThroughFacade:
 # -- at-rest encryption (ref: db.go:781-809 — Badger built-in encryption) ----
 
 class TestSegmentEncryption:
+    @pytest.fixture(autouse=True)
+    def _needs_cryptography(self):
+        # optional dep: a bare tier-1 image skips, not errors
+        pytest.importorskip("cryptography")
+
     def _open(self, d, passphrase=None):
         from nornicdb_tpu.storage.segment import SegmentEngine
         return SegmentEngine(d, passphrase=passphrase)

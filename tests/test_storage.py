@@ -380,6 +380,11 @@ class TestOpenStorage:
 class TestEncryptedWAL:
     """At-rest encryption (ref: encryption_e2e_test.go in the reference)."""
 
+    @pytest.fixture(autouse=True)
+    def _needs_cryptography(self):
+        # optional dep: a bare tier-1 image skips, not errors
+        pytest.importorskip("cryptography")
+
     def test_roundtrip_and_ciphertext_on_disk(self, tmp_path):
         import nornicdb_tpu
         from nornicdb_tpu.db import Config

@@ -11,7 +11,6 @@ import nornicdb_tpu
 from nornicdb_tpu.audit import AuditLog
 from nornicdb_tpu.cache import QueryCache
 from nornicdb_tpu.config import AppConfig, FeatureFlags, load_from_env, load_from_file
-from nornicdb_tpu.encryption import Encryptor, derive_key, new_salt
 from nornicdb_tpu.eval import EvalCase, Harness, mrr, ndcg_at_k, precision_at_k
 from nornicdb_tpu.heimdall import HeimdallManager, TemplateGenerator
 from nornicdb_tpu.retention import (
@@ -126,15 +125,28 @@ class TestConfig:
         assert not f.is_enabled("kalman")
 
 
+@pytest.fixture
+def encryption_mod():
+    """nornicdb_tpu.encryption needs the optional `cryptography` package;
+    a bare-deps tier-1 run must skip, not error (module-level import would
+    take the whole file's collection down with it)."""
+    pytest.importorskip("cryptography")
+    from nornicdb_tpu import encryption
+
+    return encryption
+
+
 class TestEncryption:
-    def test_roundtrip(self):
+    def test_roundtrip(self, encryption_mod):
+        Encryptor, new_salt = encryption_mod.Encryptor, encryption_mod.new_salt
         salt = new_salt()
         enc = Encryptor.from_passphrase("hunter2", salt, iterations=1000)
         blob = enc.encrypt(b"secret payload")
         assert blob != b"secret payload"
         assert enc.decrypt(blob) == b"secret payload"
 
-    def test_wrong_key_fails(self):
+    def test_wrong_key_fails(self, encryption_mod):
+        Encryptor, new_salt = encryption_mod.Encryptor, encryption_mod.new_salt
         salt = new_salt()
         enc1 = Encryptor.from_passphrase("right", salt, iterations=1000)
         enc2 = Encryptor.from_passphrase("wrong", salt, iterations=1000)
@@ -142,7 +154,8 @@ class TestEncryption:
         with pytest.raises(Exception):
             enc2.decrypt(blob)
 
-    def test_derive_deterministic(self):
+    def test_derive_deterministic(self, encryption_mod):
+        derive_key = encryption_mod.derive_key
         salt = b"x" * 16
         assert derive_key("pw", salt, 1000) == derive_key("pw", salt, 1000)
 
