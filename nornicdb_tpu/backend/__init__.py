@@ -1,0 +1,122 @@
+"""nornicdb_tpu.backend — device acquisition + health for the process.
+
+Public surface:
+
+* :func:`manager` — the process-default :class:`BackendManager` (created
+  lazily; honors ``NORNICDB_FAKE_BACKEND`` fault injection and the
+  ``BackendConfig`` applied via :func:`configure`).
+* :func:`configure` — apply a ``config.BackendConfig`` (called by
+  ``cli serve`` before servers take traffic).
+* :func:`devices` — gated ``jax.devices()``: awaits readiness (bounded)
+  first, so callers can never cold-init PJRT on their own thread.
+* :func:`manager_stats` — stats dict or None when nothing started (the
+  ``/admin/stats`` ``backend`` section; never forces manager start).
+
+Consumers (``ops/similarity`` corpora, ``parallel``, ``embed``) gate
+device paths through the manager and fall back to CPU host arrays while
+it reports DEGRADED_CPU — see docs/backend.md for the state machine and
+failure playbook.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Optional
+
+from nornicdb_tpu.backend.manager import (
+    BackendManager,
+    DEGRADED_CPU,
+    FakeHooks,
+    PROBING,
+    READY,
+    RECOVERING,
+    RealHooks,
+    STATES,
+    hooks_from_env,
+)
+from nornicdb_tpu.errors import BackendLockHeldError, DeviceUnavailable
+
+__all__ = [
+    "BackendManager", "BackendLockHeldError", "DeviceUnavailable",
+    "FakeHooks", "RealHooks", "hooks_from_env",
+    "PROBING", "READY", "DEGRADED_CPU", "RECOVERING", "STATES",
+    "manager", "configure", "devices", "manager_stats", "reset_default",
+]
+
+_default: Optional[BackendManager] = None
+_default_kwargs: dict = {}
+_mu = threading.Lock()
+
+_CFG_FIELDS = (
+    "acquire_timeout", "probe_interval", "probe_timeout",
+    "probe_latency_threshold", "degrade_after", "recover_after",
+    "fallback", "recovery_reupload",
+)
+
+
+def configure(cfg=None, **overrides) -> None:
+    """Set construction kwargs for the process-default manager.  ``cfg``
+    is a ``config.BackendConfig`` (or any object with matching attrs);
+    keyword overrides win.  Must run before the first :func:`manager`
+    call to take effect (``cli serve`` does)."""
+    global _default_kwargs
+    kwargs: dict = {}
+    if cfg is not None:
+        for name in _CFG_FIELDS:
+            if hasattr(cfg, name):
+                kwargs[name] = getattr(cfg, name)
+    kwargs.update(overrides)
+    with _mu:
+        _default_kwargs = kwargs
+
+
+def manager() -> BackendManager:
+    """The process-default manager (lazily created; publishes metrics).
+    Construction kwargs come from :func:`configure` when it ran, layered
+    over the env-derived ``BackendConfig`` (NORNICDB_BACKEND_* /
+    NORNICDB_DEVICE_* variables), so embedded and test processes that
+    never call ``cli serve`` still honor the environment."""
+    global _default
+    with _mu:
+        if _default is None:
+            from nornicdb_tpu.config import AppConfig, load_from_env
+
+            base = load_from_env(AppConfig()).backend
+            kwargs = {name: getattr(base, name) for name in _CFG_FIELDS}
+            kwargs.update(_default_kwargs)
+            _default = BackendManager(publish=True, **kwargs)
+        return _default
+
+
+def manager_stats() -> Optional[dict]:
+    """Stats for the default manager, or None if nothing created one yet
+    (observability surfaces must not force backend management to start)."""
+    with _mu:
+        mgr = _default
+    return None if mgr is None else mgr.stats()
+
+
+def reset_default() -> None:
+    """Drop the process-default manager (tests).  The old manager's
+    threads are stopped; corpora registered with it re-register on their
+    next device gate."""
+    global _default
+    with _mu:
+        mgr, _default = _default, None
+    if mgr is not None:
+        mgr.stop()
+
+
+def devices(timeout: Optional[float] = None):
+    """Gated ``jax.devices()``: ensure the backend is acquired (bounded
+    wait on the manager's worker thread) before touching JAX from the
+    calling thread.  Raises :class:`DeviceUnavailable` when degraded."""
+    mgr = manager()
+    if not mgr.await_ready(timeout):
+        raise DeviceUnavailable(
+            f"backend {mgr.state}: device list unavailable "
+            "(serving continues on CPU fallback paths)"
+        )
+    import jax
+
+    return jax.devices()

@@ -37,9 +37,18 @@ def cmd_serve(args) -> int:
     from nornicdb_tpu.multidb import SYSTEM_DB
     from nornicdb_tpu.server import BoltServer, HttpServer
 
-    # apply nornicdb.yaml/env telemetry knobs to the process-global
-    # tracer / slow-query log before any server starts taking traffic
-    telemetry.configure(**vars(load_app_config().telemetry))
+    # apply nornicdb.yaml/env telemetry + backend-lifecycle knobs to the
+    # process-global tracer / slow-query log / device manager before any
+    # server starts taking traffic
+    app_cfg = load_app_config()
+    telemetry.configure(**vars(app_cfg.telemetry))
+    from nornicdb_tpu import backend as backend_mod
+
+    backend_mod.configure(app_cfg.backend)
+    # kick off PJRT init + first-touch on the manager's worker thread NOW,
+    # so the first search/embed finds a READY (or already-degraded) backend
+    # instead of paying the acquire timeout inline
+    backend_mod.manager().ensure_started()
 
     db = _open_db(args)
     # embedder: trained checkpoint > TPU bge-m3 preset > hash fallback

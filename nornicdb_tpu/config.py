@@ -85,6 +85,36 @@ class TelemetryConfig:
 
 
 @dataclass
+class BackendConfig:
+    """Device lifecycle knobs (nornicdb_tpu.backend.BackendManager):
+    applied by ``cli serve`` via ``backend.configure(cfg.backend)`` before
+    servers take traffic.  See docs/backend.md for the state machine and
+    the failure playbook these knobs tune."""
+
+    # seconds a caller waits for PJRT init + first-touch before serving
+    # from CPU host arrays (the init keeps running on the manager's
+    # worker thread; recovery is automatic when it completes)
+    acquire_timeout: float = 15.0
+    # health-probe cadence and per-probe budget
+    probe_interval: float = 5.0
+    probe_timeout: float = 5.0
+    # a green probe slower than this counts as a failure (sick-but-alive
+    # accelerators must degrade too, not just dead ones)
+    probe_latency_threshold: float = 1.0
+    # hysteresis: consecutive failures before READY -> DEGRADED_CPU, and
+    # consecutive green probes before DEGRADED_CPU -> RECOVERING
+    degrade_after: int = 3
+    recover_after: int = 2
+    # "cpu" serves degraded requests from host arrays; "fail" raises
+    # DeviceUnavailable to the caller instead (strict deployments)
+    fallback: str = "cpu"
+    # recovery re-upload: "full" re-ships the whole corpus (device memory
+    # assumed lost), "dirty" trusts a surviving resident buffer and only
+    # patches blocks written while degraded
+    recovery_reupload: str = "full"
+
+
+@dataclass
 class AppConfig:
     server: ServerConfig = field(default_factory=ServerConfig)
     database: DatabaseConfig = field(default_factory=DatabaseConfig)
@@ -92,6 +122,7 @@ class AppConfig:
     memory: MemoryConfig = field(default_factory=MemoryConfig)
     compliance: ComplianceConfig = field(default_factory=ComplianceConfig)
     telemetry: TelemetryConfig = field(default_factory=TelemetryConfig)
+    backend: BackendConfig = field(default_factory=BackendConfig)
 
 
 def find_config_file(start_dir: str = ".") -> Optional[str]:
@@ -161,6 +192,13 @@ ENV_ALIASES: dict[str, tuple[str, str]] = {
     "NORNICDB_AUDIT_ENABLED": ("compliance", "audit_enabled"),
     "NORNICDB_AUDIT_LOG_PATH": ("compliance", "audit_path"),
     "NORNICDB_RETENTION_ENABLED": ("compliance", "retention_enabled"),
+    # device lifecycle (the generic NORNICDB_BACKEND_<FIELD> forms work
+    # too; these shorter aliases match the reference's GPU knob style)
+    "NORNICDB_DEVICE_ACQUIRE_TIMEOUT": ("backend", "acquire_timeout"),
+    "NORNICDB_DEVICE_PROBE_INTERVAL": ("backend", "probe_interval"),
+    "NORNICDB_DEVICE_PROBE_TIMEOUT": ("backend", "probe_timeout"),
+    "NORNICDB_DEVICE_FALLBACK": ("backend", "fallback"),
+    "NORNICDB_DEVICE_RECOVERY_REUPLOAD": ("backend", "recovery_reupload"),
     "NORNICDB_TRACING": ("telemetry", "tracing_enabled"),
     "NORNICDB_TRACE_SAMPLE": ("telemetry", "trace_sample"),
     "NORNICDB_TRACE_BUFFER": ("telemetry", "trace_buffer"),

@@ -198,21 +198,34 @@ class DB:
     @property
     def search(self):
         with self._lock:
-            if self._search is None:
-                from nornicdb_tpu.search.service import SearchService
+            svc = self._search
+        if svc is not None:
+            return svc
+        from nornicdb_tpu.search.service import SearchService
 
-                svc = SearchService(
-                    self.storage,
-                    embedder=self._embedder,
-                    brute_force_max=self.config.search_brute_force_max,
-                    vectorspaces=self.vectorspaces,
-                )
-                # wire storage events + backfill existing nodes
-                # (ref: db.go:1020-1033, EnsureSearchIndexesBuilt db.go:1044)
-                svc.attach(self.storage)
-                svc.build_indexes()
+        # construct + backfill OUTSIDE the db lock: the index build may
+        # cold-acquire the device backend (bounded by the lifecycle
+        # manager, but still seconds — NL-DEV01 bans it under any lock)
+        # and can itself take seconds on a large corpus. Losers of the
+        # creation race detach their event subscription and discard.
+        svc = SearchService(
+            self.storage,
+            embedder=self._embedder,
+            brute_force_max=self.config.search_brute_force_max,
+            vectorspaces=self.vectorspaces,
+        )
+        # wire storage events + backfill existing nodes
+        # (ref: db.go:1020-1033, EnsureSearchIndexesBuilt db.go:1044)
+        svc.attach(self.storage)
+        svc.build_indexes()
+        with self._lock:
+            if self._search is None:
                 self._search = svc
-        return self._search
+                return svc
+            winner = self._search
+        svc.detach(self.storage)
+        svc.shutdown()  # stop the loser's uploader thread; let it GC
+        return winner
 
     @property
     def vectorspaces(self):

@@ -91,25 +91,96 @@ class TPUEmbedder(Embedder):
         max_len: int = 512,
         seed: int = 0,
         opt_batch: int = 32,
+        backend=None,
     ):
         import jax
 
         from nornicdb_tpu.models import bge_m3
         from nornicdb_tpu.models.tokenizer import HashTokenizer
 
+        # device lifecycle manager: parameter init is a cold first-touch,
+        # and every forward gates through it — while DEGRADED_CPU the
+        # encoder keeps serving on the JAX CPU backend (the reference's
+        # device-failure CPU retry, local_gguf.go:202-294)
+        from nornicdb_tpu import backend as _backend_mod
+
+        self._backend = backend if backend is not None else _backend_mod.manager()
         self.cfg = cfg if cfg is not None else bge_m3.BGE_SMALL
-        self.params = (
-            params
-            if params is not None
-            else bge_m3.init_params(self.cfg, jax.random.PRNGKey(seed))
-        )
+        with self._device_scope():
+            self.params = (
+                params
+                if params is not None
+                else bge_m3.init_params(self.cfg, jax.random.PRNGKey(seed))
+            )
         self.tokenizer = tokenizer or HashTokenizer(self.cfg.vocab_size)
         self.max_len = max_len
         self.opt_batch = max(1, opt_batch)
         self._fwd = jax.jit(
             lambda p, ids, mask: bge_m3.forward(p, self.cfg, ids, mask)
         )
-        self.stats = {"embedded": 0, "batches": 0}
+        # host mirror of the weights, captured while the device is still
+        # reachable: jax.default_device(cpu) does NOT relocate params
+        # committed to a dead accelerator, so a real device loss needs a
+        # host-side copy to serve from (WindVE-style host staging; 1x
+        # extra host RAM). _cpu_params materializes from it lazily on the
+        # first degraded batch.
+        self._host_params = jax.tree.map(np.asarray, self.params)
+        self._cpu_params = None
+        # recovery hook (same registry the corpora use): after a device
+        # loss, self.params are committed to the DEAD device incarnation —
+        # the next READY forward must re-materialize them from the mirror
+        self._params_stale = False
+        self._backend.register_corpus(self)
+        self.stats = {"embedded": 0, "batches": 0, "cpu_fallback_batches": 0}
+
+    def _on_backend_recovered(self, mode: str) -> None:
+        """Manager recovery notification: whatever device the old params
+        were committed to is gone (or suspect) — re-materialize from the
+        host mirror on the next READY forward."""
+        self._params_stale = True
+
+    def _on_backend_ready(self) -> None:
+        pass  # re-materialization is lazy (next forward), nothing to wake
+
+    def _serving_params(self):
+        """Device-path weights; re-materialized from the host mirror after
+        a recovery (a warm transfer on a freshly re-acquired backend)."""
+        if self._params_stale:
+            import jax
+            import jax.numpy as jnp
+
+            self.params = jax.tree.map(jnp.asarray, self._host_params)
+            self._cpu_params = None
+            self._params_stale = False
+        return self.params
+
+    def _device_scope(self):
+        """Accelerator when the backend manager reports READY (bounded
+        wait on ITS worker thread — this caller never cold-inits PJRT);
+        otherwise pin to the always-available JAX CPU backend so embedding
+        keeps serving while degraded.  Honors the fallback policy: under
+        ``fallback="fail"`` a degraded backend raises DeviceUnavailable
+        instead of silently serving from CPU."""
+        import contextlib
+
+        import jax
+
+        self._backend.require_ready()  # raises under the "fail" policy
+        if self._backend.ready():
+            return contextlib.nullcontext()
+        self._backend.note_fallback("embed")
+        return jax.default_device(jax.local_devices(backend="cpu")[0])
+
+    def _fallback_params(self):
+        """CPU-committed weights for degraded serving, materialized from
+        the host mirror (never from the possibly-dead device)."""
+        import jax
+        import jax.numpy as jnp
+
+        if self._cpu_params is None:
+            with jax.default_device(jax.local_devices(backend="cpu")[0]):
+                self._cpu_params = jax.tree.map(jnp.asarray, self._host_params)
+        return self._cpu_params
 
     def _bucket_len(self, n: int) -> int:
         for b in self._LEN_BUCKETS:
@@ -138,23 +209,31 @@ class TPUEmbedder(Embedder):
             buckets.setdefault(self._bucket_len(len(s)), []).append(i)
         out: list[Optional[np.ndarray]] = [None] * len(texts)
         pad_id = self.tokenizer.pad_id
-        for blen, positions in sorted(buckets.items()):
-            for start in range(0, len(positions), self.opt_batch):
-                chunk = positions[start:start + self.opt_batch]
-                bcls = self._batch_class(len(chunk))
-                ids = np.full((bcls, blen), pad_id, np.int32)
-                mask = np.zeros((bcls, blen), np.int32)
-                for row, pos in enumerate(chunk):
-                    s = seqs[pos]
-                    ids[row, : len(s)] = s
-                    mask[row, : len(s)] = 1
-                emb = self._fwd(
-                    self.params, jnp.asarray(ids), jnp.asarray(mask)
-                )
-                emb = np.asarray(emb, np.float32)
-                for row, pos in enumerate(chunk):
-                    out[pos] = emb[row]
-                self.stats["batches"] += 1
+        scope = self._device_scope()
+        import contextlib
+
+        degraded = not isinstance(scope, contextlib.nullcontext)
+        params = self._fallback_params() if degraded else self._serving_params()
+        with scope:
+            for blen, positions in sorted(buckets.items()):
+                for start in range(0, len(positions), self.opt_batch):
+                    chunk = positions[start:start + self.opt_batch]
+                    bcls = self._batch_class(len(chunk))
+                    ids = np.full((bcls, blen), pad_id, np.int32)
+                    mask = np.zeros((bcls, blen), np.int32)
+                    for row, pos in enumerate(chunk):
+                        s = seqs[pos]
+                        ids[row, : len(s)] = s
+                        mask[row, : len(s)] = 1
+                    emb = self._fwd(
+                        params, jnp.asarray(ids), jnp.asarray(mask)
+                    )
+                    emb = np.asarray(emb, np.float32)
+                    for row, pos in enumerate(chunk):
+                        out[pos] = emb[row]
+                    self.stats["batches"] += 1
+                    if degraded:
+                        self.stats["cpu_fallback_batches"] += 1
         self.stats["embedded"] += len(texts)
         return out  # type: ignore[return-value]
 

@@ -14,6 +14,7 @@ iteration; batch dispatch is how TPUs reach >=10k emb/s).
 
 from __future__ import annotations
 
+import logging
 import threading
 import time
 from dataclasses import dataclass, field
@@ -24,6 +25,9 @@ import numpy as np
 from nornicdb_tpu.embed.base import Embedder
 from nornicdb_tpu.errors import NotFoundError
 from nornicdb_tpu.storage.types import Engine, Node
+from nornicdb_tpu.telemetry.metrics import count_error as _count_error
+
+logger = logging.getLogger(__name__)
 
 # Properties whose text gets embedded, in priority order
 # (ref: buildEmbeddingText embed_queue.go:779).
@@ -235,7 +239,10 @@ class EmbedWorker:
                     try:
                         self.on_embedded(updated)
                     except Exception:
-                        pass
+                        logger.exception(
+                            "on_embedded callback failed for %s", node.id
+                        )
+                        _count_error("embed_queue")
             except NotFoundError:
                 self.storage.unmark_pending_embed(node.id)
         with self._stats_lock:
@@ -254,6 +261,11 @@ class EmbedWorker:
             try:
                 return self.embedder.embed_batch(texts)
             except Exception:
+                logger.warning(
+                    "embed_batch failed (attempt %d/%d)",
+                    attempt + 1, self.config.max_retries, exc_info=True,
+                )
+                _count_error("embed_queue")
                 with self._stats_lock:
                     self.stats.retries += 1
                 if attempt == self.config.max_retries - 1:
@@ -278,4 +290,5 @@ class EmbedWorker:
         try:
             self.on_cluster_trigger()
         except Exception:
-            pass
+            logger.exception("debounced cluster trigger failed")
+            _count_error("embed_queue")

@@ -48,3 +48,20 @@ class ReplicationError(NornicError):
 
 class WALCorruptionError(NornicError):
     """WAL record failed CRC / magic validation."""
+
+
+class DeviceUnavailable(NornicError):
+    """The accelerator backend is not serving (degraded / acquiring).
+
+    Raised by device-touching paths when the BackendManager
+    (nornicdb_tpu.backend) reports the device cannot be used right now and
+    the configured fallback policy is "fail". With the default "cpu"
+    policy consumers catch this internally and serve from host arrays."""
+
+
+class BackendLockHeldError(NornicError):
+    """A backend acquisition ran while the caller held a lock (the
+    round-5 deadlock shape, NL-DEV01). Detection requires the NORNSAN
+    instrumented-lock shim, so this raises in sanitizer runs only; in
+    production builds the invariant is enforced statically by the
+    NL-DEV01 lint gate (no runtime detection happens there)."""

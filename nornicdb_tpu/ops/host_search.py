@@ -1,0 +1,59 @@
+"""Pure-NumPy search fallbacks for DEGRADED_CPU serving.
+
+When the BackendManager (nornicdb_tpu.backend) reports the accelerator
+lost, the corpora in ops/similarity.py answer from their host arrays
+through these routines instead of blocking on a device that may never
+come back — the reference's device-failure CPU retry
+(pkg/embed/local_gguf.go:202-294) and WindVE's host-side takeover
+(PAPERS.md) as one module.
+
+Contract parity with the device path: inputs are L2-normalized rows, so
+cosine == dot; scores are EXACT and candidate membership is exact too
+(a full argpartition — CPU fallback trades throughput, never recall).
+Results are (values, indices) in the same shape/ordering contract as
+``ops.similarity.topk_backend`` so ``HostCorpus._format_results``
+resolves them identically.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+def host_topk(
+    queries: np.ndarray,
+    corpus: np.ndarray,
+    valid: np.ndarray,
+    k: int,
+) -> tuple[np.ndarray, np.ndarray]:
+    """(Q, D) x (N, D) -> exact top-k (values (Q, k), indices (Q, k)).
+
+    ``valid`` masks padding/tombstone rows to -inf, mirroring the device
+    kernels; k is clamped to the corpus size."""
+    q = np.atleast_2d(np.asarray(queries, np.float32))
+    n = corpus.shape[0]
+    k = max(1, min(k, n))
+    scores = q @ corpus.T  # (Q, N); rows are normalized -> cosine
+    scores = np.where(valid[None, :], scores, -np.inf)
+    if k >= n:
+        idx = np.argsort(-scores, axis=1)
+        return np.take_along_axis(scores, idx, axis=1), idx
+    part = np.argpartition(-scores, k - 1, axis=1)[:, :k]
+    part_scores = np.take_along_axis(scores, part, axis=1)
+    order = np.argsort(-part_scores, axis=1)
+    return (
+        np.take_along_axis(part_scores, order, axis=1),
+        np.take_along_axis(part, order, axis=1),
+    )
+
+
+def host_score_rows(
+    query: np.ndarray, corpus: np.ndarray, rows: np.ndarray
+) -> np.ndarray:
+    """Exact re-score of candidate rows (host twin of
+    ops.similarity.score_subset); query is normalized first."""
+    q = np.asarray(query, np.float32).reshape(-1)
+    n = float(np.linalg.norm(q))
+    if n > 1e-12:
+        q = q / n
+    return corpus[rows] @ q

@@ -31,6 +31,10 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from nornicdb_tpu import backend as _backend
+from nornicdb_tpu.errors import DeviceUnavailable
+from nornicdb_tpu.ops.host_search import host_score_rows, host_topk
+
 logger = logging.getLogger(__name__)
 
 LANE = 128  # TPU lane width; min tile second dim
@@ -348,10 +352,17 @@ class HostCorpus:
         align: int = LANE,
         capacity: int = 0,
         compact_ratio: float = 0.3,
+        backend=None,
     ):
         self.dims = dims
         self.align = align
         self.compact_ratio = compact_ratio
+        # device lifecycle manager (nornicdb_tpu.backend): every device
+        # path gates through it BEFORE taking any lock, and serves from
+        # the host arrays while it reports DEGRADED_CPU. None -> the
+        # process-default manager, resolved lazily on first device use.
+        self._backend = backend
+        self._backend_registered = False
         cap = max(capacity, align)
         cap = ((cap + align - 1) // align) * align
         self._ids: list[Optional[str]] = []
@@ -625,6 +636,91 @@ class HostCorpus:
         self._epoch += 1
         self._layout_epoch += 1
 
+    # -- backend lifecycle gate --------------------------------------------
+    def _backend_mgr(self):
+        """This corpus's BackendManager (process default unless injected),
+        registered for recovery re-upload on first resolution."""
+        mgr = self._backend
+        if mgr is None:
+            mgr = self._backend = _backend.manager()
+        if not self._backend_registered:
+            self._backend_registered = True
+            mgr.register_corpus(self)
+        return mgr
+
+    def _device_ok_nowait(self) -> bool:
+        """Non-blocking state read for code already inside a lock
+        (``_sync``), where *waiting* on acquisition is exactly the bug
+        NL-DEV01 bans."""
+        return self._backend_mgr().ready()
+
+    def _device_gate(self) -> bool:
+        """Is the device serving?  The cold entry of a search: blocks —
+        bounded by the manager's acquire timeout, on the manager's worker
+        thread, with NO caller lock held — and honors the fallback policy
+        (raises DeviceUnavailable under "fail")."""
+        mgr = self._backend_mgr()
+        mgr.require_ready()
+        return mgr.ready()
+
+    def _on_backend_recovered(self, mode: str) -> None:
+        """The manager re-acquired the device: schedule the re-upload.
+        ``mode="full"`` assumes device memory was lost — drop the resident
+        buffers and mark everything dirty (next sync is a whole-corpus
+        transfer).  ``mode="dirty"`` trusts a surviving resident buffer
+        (transient hang) and only patches the blocks written while
+        degraded, which the dirty tracking already holds."""
+        with self._sync_lock:
+            if not (mode == "dirty" and self._device_ready()):
+                self._dev = None
+                self._dev_valid = None
+                if getattr(self, "_dev_i8", None) is not None:
+                    self._dev_i8 = None
+                self._mark_all_dirty()
+                # device-resident cluster state (IVF blocks, centroids)
+                # died with the device too — a post-recovery pruned search
+                # must not dereference buffers of the lost incarnation.
+                # Drop it and queue the last fit's HOST copy (id-based, so
+                # it survives slot remaps) for re-install once READY.
+                clear = getattr(self, "clear_clusters", None)
+                if callable(clear):
+                    last_fit = getattr(self, "_last_fit_host", None)
+                    clear()
+                    self._pending_clusters = last_fit
+        self._wake_uploader()
+
+    def _on_backend_ready(self) -> None:
+        """Called by the manager AFTER the READY transition lands (the
+        _on_backend_recovered wake can be consumed by an uploader that
+        still observed RECOVERING): guarantees the re-upload runs in the
+        background instead of inline on the first post-recovery query."""
+        self._wake_uploader()
+
+    def _search_host(
+        self, q: np.ndarray, k: int, min_similarity: float
+    ) -> list[list[tuple[str, float]]]:
+        """DEGRADED_CPU serving: exact NumPy top-k over the host arrays.
+
+        Scoring holds _sync_lock: writers mutate _host rows IN PLACE, and
+        a scan racing an overwrite would read torn vectors (half-old,
+        half-new — the atomic-view contract get()/save() keep for the
+        same reason; the device path reads immutable buffers instead).
+        Writers briefly queue behind a degraded-mode scan — correctness
+        over throughput while the accelerator is down."""
+        self._backend_mgr().note_fallback("search")
+        norms = np.linalg.norm(q, axis=1, keepdims=True)
+        qn = q / np.maximum(norms, 1e-12)
+        with self._sync_lock:
+            if self._compact_pending:
+                self._compact()
+            vals, idx = host_topk(
+                qn, self._host, self._valid, min(k, self.capacity)
+            )
+            ids = self._ids
+        return self._format_results(
+            vals, idx, q.shape[0], k, min_similarity, ids=ids,
+        )
+
     # -- device sync engine ------------------------------------------------
     # Subclasses provide the actual device buffers through three hooks:
     # _device_ready (is there a patchable resident buffer), _upload_full
@@ -657,6 +753,12 @@ class HostCorpus:
         the old buffer is donated back to the allocator only when nobody
         borrows it (ref: shouldAutoSync gpu.go:1473 — which re-uploaded the
         whole corpus on any write)."""
+        if not self._device_ok_nowait():
+            # backend degraded: keep accumulating dirty state on the host;
+            # the manager's recovery notification re-uploads when the
+            # device comes back. NEVER wait here — this runs under
+            # _sync_lock, the exact shape of the round-5 deadlock.
+            return
         with self._sync_lock:
             if self._compact_pending:
                 self._compact()  # coalesced: one rewrite for the whole burst
@@ -726,6 +828,13 @@ class HostCorpus:
             dev, valid = self._dev, self._dev_valid
             i8 = getattr(self, "_dev_i8", None)
             ids, slot_of = self._ids, self._slot_of
+        if dev is None:
+            # the backend degraded between the caller's gate and the sync
+            # (or was never acquired): there is no resident buffer to
+            # borrow — callers catch this and serve the host path
+            with self._sync_lock:
+                self._readers -= 1
+            raise DeviceUnavailable("no resident device buffer (degraded)")
         try:
             yield dev, valid, i8, ids, slot_of
         finally:
@@ -834,9 +943,10 @@ class DeviceCorpus(HostCorpus):
         dtype=jnp.float32,
         compact_ratio: float = 0.3,
         quantize: bool = False,
+        backend=None,
     ):
         super().__init__(dims, align=LANE, capacity=capacity,
-                         compact_ratio=compact_ratio)
+                         compact_ratio=compact_ratio, backend=backend)
         self.dtype = dtype
         # int8 serving mirror (ref: the CUDA path's fp16 storage trade-off,
         # gpu-acceleration.md — here int8 runs the MXU at 2x the bf16 rate)
@@ -850,6 +960,13 @@ class DeviceCorpus(HostCorpus):
         # fused cluster-contiguous layout (ops/ivf.py); valid only while
         # its epoch matches the corpus mutation epoch
         self._ivf = None
+        # cluster fit delivered while DEGRADED_CPU: the device install is
+        # deferred, not dropped — applied by _on_backend_ready on recovery
+        self._pending_clusters: Optional[tuple] = None
+        # host copy (centroids ndarray, id->cluster map) of the last
+        # installed fit: full-mode recovery re-installs from this after
+        # dropping the device-resident cluster buffers
+        self._last_fit_host: Optional[tuple] = None
 
     # -- cluster pruning ----------------------------------------------------
     def cluster(self, k: int = 0, iters: int = 10, seed: int = 0) -> int:
@@ -865,6 +982,8 @@ class DeviceCorpus(HostCorpus):
         from stale slots as current."""
         from nornicdb_tpu.ops.kmeans import kmeans_fit
 
+        if not self._device_gate():
+            return 0  # degraded: pruning is a device-path optimization
         with self._sync_lock:
             live = [i for i, id_ in enumerate(self._ids) if id_ is not None]
             if len(live) < 2:
@@ -882,67 +1001,140 @@ class DeviceCorpus(HostCorpus):
                 mask |= self._layout_slots
             self._layout_slots = mask
         res = kmeans_fit(data, k=k, iters=iters, seed=seed)
+        # H2D transfer OUTSIDE the lock (NL-DEV01): only the pointer
+        # install runs in the critical section
+        centroids_dev = jnp.asarray(res.centroids, dtype=self.dtype)
         with self._sync_lock:
             if self._layout_epoch != epoch_at_read:
                 return 0  # slot space moved mid-fit: caller may recluster
             assignments = np.full(self.capacity, -1, np.int32)
             for row, slot in enumerate(live):
                 assignments[slot] = res.assignments[row]
-            self._centroids = jnp.asarray(res.centroids, dtype=self.dtype)
+            self._centroids = centroids_dev
             self._assignments = assignments
-            self._build_ivf_layout(np.asarray(live), res.assignments,
-                                   res.centroids)
+            # id-based host copy: full-mode recovery re-installs from this
+            self._last_fit_host = (
+                np.asarray(res.centroids, np.float32),
+                {
+                    self._ids[slot]: int(res.assignments[row])
+                    for row, slot in enumerate(live)
+                    if slot < len(self._ids) and self._ids[slot] is not None
+                },
+            )
+        self._build_ivf_layout(np.asarray(live), res.assignments,
+                               res.centroids, expect_epoch=epoch_at_read)
         return res.k
 
     def _build_ivf_layout(self, live_slots: np.ndarray,
                           live_assignments: np.ndarray,
-                          centroids: np.ndarray) -> None:
+                          centroids: np.ndarray,
+                          expect_epoch: Optional[int] = None) -> None:
         """Cluster-contiguous block layout for the fused one-program IVF
-        path (ops/ivf.py). Invalidated by any corpus mutation."""
+        path (ops/ivf.py). Invalidated by any corpus mutation.
+
+        The build (and its H2D transfers) runs OUTSIDE the lock
+        (NL-DEV01); install is optimistic: the row snapshot pins the
+        layout epoch, and the built layout installs only if the epoch is
+        unchanged — an overwrite/compaction during the build voids it
+        (the widened ``_layout_slots`` mask makes covered-row overwrites
+        bump the epoch, same contract as ``cluster()``)."""
         from nornicdb_tpu.ops.ivf import build_ivf_layout
 
         with self._sync_lock:
-            self._ivf = build_ivf_layout(
-                self._host[live_slots], live_slots, live_assignments,
-                centroids, dtype=self.dtype, epoch=self._layout_epoch,
-            )
-            # slots the layout copied rows from: an in-place overwrite of
+            if expect_epoch is not None and self._layout_epoch != expect_epoch:
+                return  # slot space moved since the caller resolved slots
+            epoch_at_read = self._layout_epoch
+            rows = self._host[live_slots]  # fancy indexing copies: snapshot
+            # slots the layout copies rows from: an in-place overwrite of
             # any of these bumps _layout_epoch (invalidates the layout);
             # writes to OTHER slots leave it serving correct vectors
             mask = np.zeros(self.capacity, bool)
             mask[live_slots] = True
             self._layout_slots = mask
+        layout = build_ivf_layout(
+            rows, live_slots, live_assignments, centroids,
+            dtype=self.dtype, epoch=epoch_at_read,
+        )
+        with self._sync_lock:
+            if self._layout_epoch != epoch_at_read:
+                return  # mutated mid-build: discard the stale layout
+            self._ivf = layout
 
     def clear_clusters(self) -> None:
         self._centroids = None
         self._assignments = None
         self._ivf = None
         self._layout_slots = None
+        self._pending_clusters = None
+
+    def _on_backend_ready(self) -> None:
+        """Post-recovery: wake the uploader (base) and install any cluster
+        fit that arrived while degraded.  The install's device transfers
+        run on a throwaway thread, NEVER on the manager's probe thread —
+        if the flaky device hangs again mid-install, the watchdog that
+        detects hangs must not be the thread that hung (the install
+        thread strands harmlessly: set_clusters holds no lock across its
+        device ops)."""
+        super()._on_backend_ready()
+        with self._sync_lock:
+            pending, self._pending_clusters = self._pending_clusters, None
+        if pending is None:
+            return
+
+        def _install() -> None:
+            try:
+                self.set_clusters(pending[0], pending[1])
+            except Exception:
+                logger.exception("post-recovery cluster install failed")
+
+        threading.Thread(
+            target=_install, name="nornicdb-cluster-reinstall", daemon=True,
+        ).start()
 
     def set_clusters(
         self, centroids: np.ndarray, assignments_by_id: dict[str, int]
     ) -> None:
         """Install externally computed clusters (e.g. the search service's
-        fit) without re-running k-means. Runs under the sync lock: the
-        id->slot resolution and layout build must see one consistent slot
-        space (the write-behind uploader may compact concurrently)."""
+        fit) without re-running k-means. The id->slot resolution sees one
+        consistent slot space under the sync lock; the H2D transfer and
+        layout build run OUTSIDE it (NL-DEV01) with an optimistic
+        epoch-checked install (the write-behind uploader may compact
+        concurrently — a remap voids the stale layout)."""
+        if not self._device_ok_nowait():
+            # degraded: the fit is NOT discarded — stash it host-side and
+            # install on recovery (_on_backend_ready), so pruned search
+            # comes back with the device instead of waiting for the next
+            # periodic re-cluster. Full scan keeps serving meanwhile.
+            with self._sync_lock:
+                self._pending_clusters = (
+                    np.asarray(centroids, np.float32),
+                    dict(assignments_by_id),
+                )
+                self._last_fit_host = self._pending_clusters
+            return
+        fit_host = (np.asarray(centroids, np.float32), dict(assignments_by_id))
+        centroids_dev = jnp.asarray(centroids, dtype=self.dtype)
         with self._sync_lock:
+            self._last_fit_host = fit_host
             slot_assignments = np.full(self.capacity, -1, np.int32)
             for id_, c in assignments_by_id.items():
                 slot = self._slot_of.get(id_)
                 if slot is not None:
                     slot_assignments[slot] = c
-            self._centroids = jnp.asarray(centroids, dtype=self.dtype)
+            self._centroids = centroids_dev
             self._assignments = slot_assignments
             # the old layout describes the replaced clustering — drop it
             # even when no live rows match (else the epoch guard keeps
-            # serving it)
+            # serving it); a stashed degraded-era fit is superseded too
             self._ivf = None
             self._layout_slots = None
+            self._pending_clusters = None
             live = np.nonzero((slot_assignments >= 0) & self._valid)[0]
-            if live.size:
-                self._build_ivf_layout(live, slot_assignments[live],
-                                       np.asarray(centroids, np.float32))
+            epoch_at_read = self._layout_epoch
+        if live.size:
+            self._build_ivf_layout(live, slot_assignments[live],
+                                   np.asarray(centroids, np.float32),
+                                   expect_epoch=epoch_at_read)
 
     def _grow(self, min_capacity: int = 0) -> None:
         super()._grow(min_capacity)
@@ -978,7 +1170,7 @@ class DeviceCorpus(HostCorpus):
                 layout is not None and layout.epoch == self._layout_epoch
             )
         try:
-            if centroids is None or assignments is None:
+            if corpus is None or centroids is None or assignments is None:
                 return None
             # fused one-program path: valid while the layout matches the
             # LAYOUT epoch, which bumps only when a covered row was
@@ -1058,9 +1250,16 @@ class DeviceCorpus(HostCorpus):
         return out
 
     def _upload_full(self) -> None:
-        """Whole-corpus H2D transfer (first sync / grow / compact / clear)."""
-        self._dev = jnp.asarray(self._host, dtype=self.dtype)
-        self._dev_valid = jnp.asarray(self._valid)
+        """Whole-corpus H2D transfer (first sync / grow / compact / clear).
+
+        NL-DEV01 suppressions: these transfers run under _sync_lock by
+        design — they must see the host arrays and dirty bookkeeping as
+        one atomic view. They are WARM, never cold: _sync gates on
+        _device_ok_nowait() first, so the backend was acquired by the
+        manager's worker thread before any of these can execute."""
+        self._dev = jnp.asarray(  # nornlint: disable=NL-DEV01
+            self._host, dtype=self.dtype)
+        self._dev_valid = jnp.asarray(self._valid)  # nornlint: disable=NL-DEV01
         if self.quantize:
             from nornicdb_tpu.ops.pallas_kernels import quantize_rows
 
@@ -1071,16 +1270,22 @@ class DeviceCorpus(HostCorpus):
         donate: bool,
     ) -> None:
         """Patch one contiguous dirty run into the resident buffers; the
-        int8 serving mirror requantizes only the patched rows."""
+        int8 serving mirror requantizes only the patched rows.
+
+        NL-DEV01 suppressions: warm transfers under _sync_lock by design
+        (same rationale as _upload_full — gated upstream, atomic view)."""
         start = np.int32(start_row)
         # one H2D conversion feeds both the f32/bf16 patch and the int8
         # requantization — the rows transfer once, not per consumer
-        rows_dev = jnp.asarray(rows, dtype=self.dtype)
+        rows_dev = jnp.asarray(  # nornlint: disable=NL-DEV01
+            rows, dtype=self.dtype)
         patch = _patch_rows_donated if donate else _patch_rows
         self._dev = patch(self._dev, rows_dev, start)
         vpatch = _patch_valid_donated if donate else _patch_valid
         self._dev_valid = vpatch(
-            self._dev_valid, jnp.asarray(valid_rows), start
+            self._dev_valid,
+            jnp.asarray(valid_rows),  # nornlint: disable=NL-DEV01
+            start,
         )
         if self.quantize and self._dev_i8 is not None:
             qpatch = _patch_i8_donated if donate else _patch_i8
@@ -1094,9 +1299,14 @@ class DeviceCorpus(HostCorpus):
         disabled for this corpus the moment anyone uses this — otherwise a
         later patch would free a buffer the caller still reads. Prefer
         _borrow_device, which scopes the pin to the search."""
+        self._device_gate()  # cold acquisition happens HERE, not under lock
         with self._sync_lock:
             self._donation_ok = False
             self._sync()
+            if self._dev is None:
+                raise DeviceUnavailable(
+                    "backend degraded: no resident device buffer"
+                )
             return self._dev, self._dev_valid
 
     def search(
@@ -1121,20 +1331,32 @@ class DeviceCorpus(HostCorpus):
         if len(self._slot_of) == 0:
             return [[] for _ in range(np.atleast_2d(queries).shape[0])]
         q = np.atleast_2d(np.asarray(queries, np.float32))
-        if n_probe > 0:
-            pruned = self._pruned_search(q, k, min_similarity, n_probe, exact)
-            if pruned is not None:
-                return pruned
-        with self._borrow_device() as (corpus, valid, dev_i8, ids, _):
-            kk = min(k, self.capacity)
-            vals, idx = topk_backend(
-                l2_normalize(jnp.asarray(q, dtype=self.dtype)), corpus, valid,
-                kk, exact=exact, streaming=streaming,
-                quantized=dev_i8 if self.quantize else None,
-            )
-            # materialize INSIDE the borrow: the computation must finish
-            # before the patcher may donate the buffer it reads
-            vals_np, idx_np = np.asarray(vals, np.float32), np.asarray(idx)
+        # lifecycle gate FIRST, before any lock: a cold backend acquires on
+        # the manager's worker thread (bounded by the config timeout), a
+        # degraded one routes this search to the exact host path
+        if not self._device_gate():
+            return self._search_host(q, k, min_similarity)
+        try:
+            if n_probe > 0:
+                pruned = self._pruned_search(
+                    q, k, min_similarity, n_probe, exact
+                )
+                if pruned is not None:
+                    return pruned
+            with self._borrow_device() as (corpus, valid, dev_i8, ids, _):
+                kk = min(k, self.capacity)
+                vals, idx = topk_backend(
+                    l2_normalize(jnp.asarray(q, dtype=self.dtype)), corpus,
+                    valid, kk, exact=exact, streaming=streaming,
+                    quantized=dev_i8 if self.quantize else None,
+                )
+                # materialize INSIDE the borrow: the computation must
+                # finish before the patcher may donate the buffer it reads
+                vals_np = np.asarray(vals, np.float32)
+                idx_np = np.asarray(idx)
+        except DeviceUnavailable:
+            # degraded between the gate and the borrow
+            return self._search_host(q, k, min_similarity)
         return self._format_results(
             vals_np, idx_np, q.shape[0], k, min_similarity, ids=ids,
         )
@@ -1144,13 +1366,35 @@ class DeviceCorpus(HostCorpus):
     ) -> list[tuple[str, float]]:
         """Exact re-score of the given ids; unknown/removed ids are omitted
         from the returned (id, score) pairs so results stay attributable."""
-        with self._borrow_device() as (corpus, _, _i8, _ids, slot_of):
-            # slot_of is the snapshot consistent with the borrowed buffer —
-            # a racing background compaction rebinds, never mutates, it
-            present = [(i, slot_of[i]) for i in ids if i in slot_of]
+        if not self._device_gate():
+            return self._score_subset_host(query, ids)
+        try:
+            with self._borrow_device() as (corpus, _, _i8, _ids, slot_of):
+                # slot_of is the snapshot consistent with the borrowed
+                # buffer — a racing background compaction rebinds, never
+                # mutates, it
+                present = [(i, slot_of[i]) for i in ids if i in slot_of]
+                if not present:
+                    return []
+                q = l2_normalize(
+                    jnp.asarray(query, dtype=self.dtype).reshape(-1)
+                )
+                slots = jnp.asarray([s for _, s in present])
+                scores = np.asarray(score_subset(q, corpus, slots), np.float32)
+        except DeviceUnavailable:
+            return self._score_subset_host(query, ids)
+        return [(id_, float(s)) for (id_, _), s in zip(present, scores)]
+
+    def _score_subset_host(
+        self, query: np.ndarray, ids: list[str]
+    ) -> list[tuple[str, float]]:
+        """DEGRADED_CPU twin of score_subset over the host arrays."""
+        self._backend_mgr().note_fallback("search")
+        with self._sync_lock:
+            present = [(i, self._slot_of[i]) for i in ids if i in self._slot_of]
             if not present:
                 return []
-            q = l2_normalize(jnp.asarray(query, dtype=self.dtype).reshape(-1))
-            slots = jnp.asarray([s for _, s in present])
-            scores = np.asarray(score_subset(q, corpus, slots), np.float32)
+            scores = host_score_rows(
+                query, self._host, np.asarray([s for _, s in present])
+            )
         return [(id_, float(s)) for (id_, _), s in zip(present, scores)]

@@ -39,12 +39,32 @@ def shard_map_compat(f, mesh: Mesh, in_specs, out_specs):
 def make_mesh(
     axis_shapes: Optional[dict[str, int]] = None,
     devices: Optional[Sequence[jax.Device]] = None,
+    backend=None,
 ) -> Mesh:
     """Build a Mesh. Default: all devices on one "data" axis.
 
     make_mesh({"data": 4, "model": 2}) lays an 8-device mesh as 4x2.
+
+    Enumerating devices is a COLD backend acquisition (PJRT init on a
+    fresh process), so the default goes through the backend lifecycle
+    manager: bounded wait on its worker thread, DeviceUnavailable when
+    the backend is degraded — never an unbounded hang on the caller.
+    ``backend`` injects a specific BackendManager (tests).
     """
-    devs = list(devices if devices is not None else jax.devices())
+    if devices is not None:
+        devs = list(devices)
+    elif backend is not None:
+        if not backend.await_ready():
+            from nornicdb_tpu.errors import DeviceUnavailable
+
+            raise DeviceUnavailable(
+                f"backend {backend.state}: cannot enumerate mesh devices"
+            )
+        devs = list(jax.devices())
+    else:
+        from nornicdb_tpu import backend as _backend
+
+        devs = list(_backend.devices())
     if not axis_shapes:
         axis_shapes = {"data": len(devs)}
     names = tuple(axis_shapes)
@@ -66,4 +86,7 @@ def replicated(mesh: Mesh) -> NamedSharding:
 
 
 def local_device_count() -> int:
-    return len(jax.devices())
+    # gated: device enumeration is a cold backend acquisition
+    from nornicdb_tpu import backend as _backend
+
+    return len(_backend.devices())

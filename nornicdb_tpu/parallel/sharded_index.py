@@ -24,6 +24,7 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from nornicdb_tpu.errors import DeviceUnavailable
 from nornicdb_tpu.ops.similarity import (
     HostCorpus,
     _patch_rows,
@@ -100,8 +101,14 @@ class ShardedCorpus(HostCorpus):
         axis: str = "data",
         dtype=jnp.bfloat16,
         compact_ratio: float = 0.3,
+        backend=None,
     ):
-        self.mesh = mesh if mesh is not None else make_mesh()
+        # building a mesh enumerates devices — a COLD backend acquisition.
+        # make_mesh gates through the BackendManager (bounded wait on its
+        # worker thread) and raises DeviceUnavailable when degraded; the
+        # search service catches that and falls back to a single-device
+        # corpus, which itself serves from host arrays until recovery.
+        self.mesh = mesh if mesh is not None else make_mesh(backend=backend)
         self.axis = axis
         self.dtype = dtype
         self.n_shards = self.mesh.shape[axis]
@@ -112,6 +119,7 @@ class ShardedCorpus(HostCorpus):
             # cannot divide the local row count
             align=128 * self.n_shards,
             compact_ratio=compact_ratio,
+            backend=backend,
         )
         self._dev = None
         self._dev_valid = None
@@ -122,11 +130,17 @@ class ShardedCorpus(HostCorpus):
     # The generic HostCorpus._sync driver (dirty-block coalescing, deferred
     # compaction, patch-vs-full policy, stats) drives these two hooks.
     def _upload_full(self) -> None:
-        self._dev = jax.device_put(
-            jnp.asarray(self._host, dtype=self.dtype), self._sharding
+        # NL-DEV01 suppressions: warm transfers under _sync_lock by design
+        # (gated upstream by _sync's _device_ok_nowait; the mesh was
+        # enumerated through the manager at construction) — same rationale
+        # as DeviceCorpus._upload_full
+        self._dev = jax.device_put(  # nornlint: disable=NL-DEV01
+            jnp.asarray(self._host, dtype=self.dtype),  # nornlint: disable=NL-DEV01
+            self._sharding,
         )
-        self._dev_valid = jax.device_put(
-            jnp.asarray(self._valid), self._vsharding
+        self._dev_valid = jax.device_put(  # nornlint: disable=NL-DEV01
+            jnp.asarray(self._valid),  # nornlint: disable=NL-DEV01
+            self._vsharding,
         )
 
     def _apply_patch(
@@ -137,15 +151,21 @@ class ShardedCorpus(HostCorpus):
         the dynamic_update_slice, so a run touches only the shards it
         overlaps; device_put re-pins the P(axis, None) layout (a no-op when
         GSPMD already kept it, which it does for update-slice)."""
+        # NL-DEV01 suppressions: warm patches under _sync_lock by design
+        # (same rationale as _upload_full above)
         start = np.int32(start_row)
         patch = _patch_rows_donated if donate else _patch_rows
-        self._dev = jax.device_put(
-            patch(self._dev, jnp.asarray(rows, dtype=self.dtype), start),
+        self._dev = jax.device_put(  # nornlint: disable=NL-DEV01
+            patch(self._dev,
+                  jnp.asarray(rows, dtype=self.dtype),  # nornlint: disable=NL-DEV01
+                  start),
             self._sharding,
         )
         vpatch = _patch_valid_donated if donate else _patch_valid
-        self._dev_valid = jax.device_put(
-            vpatch(self._dev_valid, jnp.asarray(valid_rows), start),
+        self._dev_valid = jax.device_put(  # nornlint: disable=NL-DEV01
+            vpatch(self._dev_valid,
+                   jnp.asarray(valid_rows),  # nornlint: disable=NL-DEV01
+                   start),
             self._vsharding,
         )
 
@@ -165,15 +185,23 @@ class ShardedCorpus(HostCorpus):
         q = np.atleast_2d(np.asarray(queries, np.float32))
         if len(self._slot_of) == 0:
             return [[] for _ in range(q.shape[0])]
-        with self._borrow_device() as (dev, dev_valid, _i8, ids, _):
-            qd = l2_normalize(jnp.asarray(q, dtype=self.dtype))
-            vals, idx = _sharded_search(
-                qd, dev, dev_valid, min(k, self.capacity),
-                self.axis, self.mesh, exact=exact, streaming=streaming,
-            )
-            # materialize inside the borrow so the patcher can't donate the
-            # buffers this program is still reading
-            vals_np, idx_np = np.asarray(vals, np.float32), np.asarray(idx)
+        # same lifecycle gate as DeviceCorpus.search: cold acquisition on
+        # the manager's worker thread, degraded -> exact host fallback
+        if not self._device_gate():
+            return self._search_host(q, k, min_similarity)
+        try:
+            with self._borrow_device() as (dev, dev_valid, _i8, ids, _):
+                qd = l2_normalize(jnp.asarray(q, dtype=self.dtype))
+                vals, idx = _sharded_search(
+                    qd, dev, dev_valid, min(k, self.capacity),
+                    self.axis, self.mesh, exact=exact, streaming=streaming,
+                )
+                # materialize inside the borrow so the patcher can't donate
+                # the buffers this program is still reading
+                vals_np = np.asarray(vals, np.float32)
+                idx_np = np.asarray(idx)
+        except DeviceUnavailable:
+            return self._search_host(q, k, min_similarity)
         return self._format_results(
             vals_np, idx_np, q.shape[0], k, min_similarity, ids=ids,
         )

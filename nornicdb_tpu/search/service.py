@@ -13,6 +13,7 @@ GEMM; approx_max_k membership), and HNSW is the no-accelerator fallback.
 
 from __future__ import annotations
 
+import logging
 import threading
 import time
 from collections import OrderedDict
@@ -31,6 +32,8 @@ from nornicdb_tpu.search.hnsw import HNSWIndex
 from nornicdb_tpu.storage.types import Engine, Node
 from nornicdb_tpu.telemetry.metrics import REGISTRY as _REGISTRY
 from nornicdb_tpu.telemetry.tracing import tracer as _tracer
+
+logger = logging.getLogger(__name__)
 
 # same families the QueryBatcher feeds (idempotent re-resolution by
 # name, so neither module depends on the other's import order or private
@@ -128,38 +131,70 @@ class SearchService:
 
     # -- index plumbing ----------------------------------------------------
     def _ensure_vector_index(self, dims: int) -> None:
-        if self._corpus is None and self._hnsw is None:
+        """Create the vector index on first use.  MUST be called with no
+        lock held: building a sharded corpus enumerates mesh devices — a
+        cold backend acquisition that may block for the manager's acquire
+        timeout (NL-DEV01).  Construction races resolve under the lock;
+        the loser's corpus is discarded before it holds any resource."""
+        with self._lock:
+            if self._corpus is not None or self._hnsw is not None:
+                return
+        corpus = hnsw = None
+        if self.config.backend == "sharded":
+            # corpus rows sharded over the device mesh, per-shard top-k
+            # merged via ICI all-gather (parallel.ShardedCorpus). A
+            # degraded backend cannot enumerate mesh devices — serve on
+            # a single-device corpus (itself host-backed while degraded)
+            # instead of refusing to index; recovery re-uploads it.
+            from nornicdb_tpu.errors import DeviceUnavailable
+            from nornicdb_tpu.parallel import ShardedCorpus
+
+            try:
+                corpus = ShardedCorpus(dims=dims)
+            except DeviceUnavailable:
+                logger.warning(
+                    "backend degraded: sharded corpus unavailable, "
+                    "falling back to single-device corpus"
+                )
+                corpus = DeviceCorpus(dims=dims)
+        elif self.config.backend in ("auto", "tpu"):
+            corpus = DeviceCorpus(dims=dims)
+        else:
+            hnsw = HNSWIndex(dims=dims)
+        with self._lock:
+            if self._corpus is not None or self._hnsw is not None:
+                return  # lost the creation race: drop ours, nothing started
             self._dims = dims
             if self.vectorspaces is not None:
                 from nornicdb_tpu.vectorspace import VectorSpaceKey
 
                 self.vectorspaces.register(VectorSpaceKey("default", dims))
-            if self.config.backend == "sharded":
-                # corpus rows sharded over the device mesh, per-shard top-k
-                # merged via ICI all-gather (parallel.ShardedCorpus)
-                from nornicdb_tpu.parallel import ShardedCorpus
-
-                self._corpus = ShardedCorpus(dims=dims)
-            elif self.config.backend in ("auto", "tpu"):
-                self._corpus = DeviceCorpus(dims=dims)
-            else:
-                self._hnsw = HNSWIndex(dims=dims)
-            if self._corpus is not None and self.config.write_behind:
-                self._corpus.start_uploader(self.config.write_behind_interval)
+            self._corpus, self._hnsw = corpus, hnsw
+            if corpus is not None and self.config.write_behind:
+                corpus.start_uploader(self.config.write_behind_interval)
 
     def index_node(self, node: Node) -> None:
         """(ref: IndexNode search.go:651; event wiring db.go:1020-1033)"""
         import hashlib
 
         text = build_embedding_text(node)
+        emb = (
+            np.asarray(node.embedding, np.float32)
+            if node.embedding is not None else None
+        )
         fp = (
             hashlib.blake2s(text.encode()).digest(),
-            hashlib.blake2s(
-                np.asarray(node.embedding, np.float32).tobytes()
-            ).digest()
-            if node.embedding is not None
+            hashlib.blake2s(emb.tobytes()).digest() if emb is not None
             else b"",
         )
+        if emb is not None and self._corpus is None and self._hnsw is None:
+            # index creation happens OUTSIDE the service lock: a sharded
+            # corpus enumerates mesh devices, and a cold/lost backend
+            # would otherwise hang acquisition while every search and
+            # index event waits on this lock (the round-5 deadlock shape,
+            # NL-DEV01). The unlocked None-check is a benign race:
+            # _ensure_vector_index is idempotent and double-checked.
+            self._ensure_vector_index(emb.shape[0])
         with self._lock:
             if self._fingerprints.get(node.id) == fp:
                 return  # unchanged: keep device corpus clean
@@ -169,9 +204,8 @@ class SearchService:
                 self._bm25.index(node.id, text)
             else:
                 self._bm25.remove(node.id)  # text dropped on update
-            if node.embedding is not None:
-                v = np.asarray(node.embedding, np.float32)
-                self._ensure_vector_index(v.shape[0])
+            if emb is not None:
+                v = emb
                 n = np.linalg.norm(v)
                 vn = v / n if n > 1e-12 else v
                 self._vectors[node.id] = vn
@@ -233,30 +267,37 @@ class SearchService:
                 )
             self.stats.vector_candidates += 1
             return batcher.search(embedding, k, min_similarity)
+        # snapshot index refs under the lock, dispatch OUTSIDE it: the
+        # round-5 deadlock was exactly a device acquisition hanging while
+        # this lock was held, wedging every later search/index call. The
+        # corpus has its own consistency story (_borrow_device snapshots);
+        # holding the service lock across the dispatch adds nothing but
+        # the deadlock. Enforced by NL-DEV01 + the manager's NORNSAN guard.
         with self._lock:
             self.stats.vector_candidates += 1
-            if self._corpus is not None:
-                kwargs = {}
-                if self.config.n_probe > 0 and hasattr(self._corpus, "cluster"):
-                    kwargs["n_probe"] = self.config.n_probe
-                t0 = time.perf_counter()
-                with _tracer.span("search.vector"):
-                    res = self._corpus.search(
-                        embedding, k=k, min_similarity=min_similarity,
-                        **kwargs
-                    )
-                # unbatched dispatches land in the same device-time
-                # histogram the batcher feeds, so the default (non-batched)
-                # configuration still reports device time
-                _DEVICE_HIST.observe(time.perf_counter() - t0)
-                return res[0] if res else []
-            if self._hnsw is not None:
-                return [
-                    (i, s)
-                    for i, s in self._hnsw.search(embedding, k)
-                    if s >= min_similarity
-                ]
-            return []
+            corpus, hnsw = self._corpus, self._hnsw
+        if corpus is not None:
+            kwargs = {}
+            if self.config.n_probe > 0 and hasattr(corpus, "cluster"):
+                kwargs["n_probe"] = self.config.n_probe
+            t0 = time.perf_counter()
+            with _tracer.span("search.vector"):
+                res = corpus.search(
+                    embedding, k=k, min_similarity=min_similarity,
+                    **kwargs
+                )
+            # unbatched dispatches land in the same device-time
+            # histogram the batcher feeds, so the default (non-batched)
+            # configuration still reports device time
+            _DEVICE_HIST.observe(time.perf_counter() - t0)
+            return res[0] if res else []
+        if hnsw is not None:
+            return [
+                (i, s)
+                for i, s in hnsw.search(embedding, k)
+                if s >= min_similarity
+            ]
+        return []
 
     def stats_snapshot(self) -> dict:
         """Search-stack observability bundle for the server stats/metrics
@@ -271,6 +312,13 @@ class SearchService:
             corpus, batcher = self._corpus, getattr(self, "_batcher", None)
         if corpus is not None:
             out["corpus"] = corpus.stats()
+            mgr = getattr(corpus, "_backend", None)
+            if mgr is not None:
+                # lifecycle state + fallback/recovery counters for the
+                # corpus's backend manager (the /admin/stats "backend"
+                # section mirrors the process default; this one follows
+                # an injected test manager too)
+                out["backend"] = mgr.stats()
         if batcher is not None:
             out["batcher"] = batcher.stats.as_dict()
         return out
@@ -462,4 +510,23 @@ class SearchService:
             elif kind == "node_deleted":
                 self.remove_node(entity.id)
 
+        self._event_cb = _on
         engine.on_event(_on)
+
+    def detach(self, engine: Engine) -> None:
+        """Unsubscribe (a service that lost the DB's creation race must
+        not keep shadow-indexing every storage event forever)."""
+        cb = getattr(self, "_event_cb", None)
+        if cb is not None:
+            engine.off_event(cb)
+            self._event_cb = None
+
+    def shutdown(self) -> None:
+        """Stop background resources: the corpus's write-behind uploader
+        thread (a discarded service that keeps one alive also keeps its
+        corpus referenced, so the backend manager's weakref registry
+        would re-upload the zombie corpus on every recovery)."""
+        with self._lock:
+            corpus = self._corpus
+        if corpus is not None and hasattr(corpus, "stop_uploader"):
+            corpus.stop_uploader()

@@ -700,6 +700,14 @@ class HttpServer:
                 # CSR topology snapshot health: builds / delta merges /
                 # epoch retries / resident bytes (tune merge_threshold here)
                 stats["adjacency"] = adjacency
+            from nornicdb_tpu import backend as _backend_mod
+
+            backend_stats = _backend_mod.manager_stats()
+            if backend_stats is not None:
+                # device lifecycle: state machine position, fallback /
+                # recovery counters, probe latency, recent transitions
+                # (docs/backend.md failure playbook reads from here)
+                stats["backend"] = backend_stats
             h._send(200, stats)
             return
         if path == "/admin/config":
@@ -737,6 +745,14 @@ class HttpServer:
 
         out = {"framework": "jax", "backend_initialized": False,
                "devices": [], "platform": None}
+        from nornicdb_tpu import backend as _backend_mod
+
+        lifecycle = _backend_mod.manager_stats()
+        if lifecycle is not None:
+            # lifecycle-manager view: state machine position + counters
+            # (reported even pre-init — the manager probes on its own
+            # worker thread, so this never blocks the admin surface)
+            out["lifecycle"] = lifecycle
         try:
             # backends are registered only after first real device use
             from jax._src import xla_bridge

@@ -231,7 +231,11 @@ def build_spec(version: str = "0.4.0") -> dict:
             "Rebuild the search indexes from storage", tag="memory")},
         # -- admin -----------------------------------------------------------
         "/admin/stats": {"get": _op(
-            "Server statistics: storage, cache, query counters, uptime",
+            "Server statistics: storage, cache, query counters, uptime, "
+            "search/device-sync/adjacency sections, and the `backend` "
+            "section (device lifecycle state PROBING/READY/DEGRADED_CPU/"
+            "RECOVERING, fallbacks_total, recoveries_total, probe latency, "
+            "recent transitions — docs/backend.md)",
             tag="admin")},
         "/admin/backup": {"post": _op(
             "Write a full backup archive (gzip) server-side; returns the "
@@ -255,8 +259,9 @@ def build_spec(version: str = "0.4.0") -> dict:
         },
         "/admin/tpu/status": {"get": _op(
             "Accelerator status (the reference's /admin/gpu/status "
-            "analogue); reports initialised-backend state only, never "
-            "blocks on a down device relay", tag="admin")},
+            "analogue); reports initialised-backend state plus the "
+            "lifecycle manager's view, never blocks on a down device "
+            "relay", tag="admin")},
         "/admin/traces": {"get": _op(
             "Recent completed request traces (newest first): trace id, "
             "root span, duration, span count", tag="admin")},

@@ -149,6 +149,14 @@ class EscapeCall:
 
 
 @dataclasses.dataclass
+class DeviceAcqCall:
+    what: str
+    held: tuple[str, ...]
+    node: ast.AST
+    fn: "FunctionInfo"
+
+
+@dataclasses.dataclass
 class FunctionInfo:
     qualname: str            # "relpath::Class.meth" | "relpath::func"
     relpath: str
@@ -159,6 +167,7 @@ class FunctionInfo:
     calls: list[CallSite] = dataclasses.field(default_factory=list)
     blocking: list[BlockingCall] = dataclasses.field(default_factory=list)
     escapes: list[EscapeCall] = dataclasses.field(default_factory=list)
+    device_acqs: list[DeviceAcqCall] = dataclasses.field(default_factory=list)
 
     def display(self) -> str:
         return self.qualname.split("::", 1)[-1]
@@ -214,6 +223,16 @@ class ProjectContext:
             self.by_modname[mi.modname] = mi
         for mi in self.modules.values():
             self._collect_defs(mi)
+        # reverse of mro(): class key -> package-resolvable subclasses.
+        # self.method dispatches to overrides at runtime, so held-lock
+        # propagation must follow the DOWNWARD edges too (a base method
+        # holding a lock calls self._hook(); the subclass's _hook does the
+        # device op — the dominant template-method pattern here).
+        self.subclasses: dict[str, list[str]] = {k: [] for k in self.classes}
+        for key in self.classes:
+            for anc in self.mro(key):
+                if anc.key != key:
+                    self.subclasses[anc.key].append(key)
         for mi in self.modules.values():
             self._collect_class_attrs(mi)
         for fi in self.functions.values():
@@ -558,8 +577,17 @@ class _FunctionWalker:
         project, mi = self.project, self.mi
         if parts[0] == "self" and self.fi.cls:
             if len(parts) == 2:
+                targets: list[str] = []
                 m = project.find_method(self.fi.cls, parts[1])
-                return (m.qualname,) if m else ()
+                if m is not None:
+                    targets.append(m.qualname)
+                # virtual dispatch: overrides in subclasses run with the
+                # same held locks as the base-class call site
+                for sub_key in project.subclasses.get(self.fi.cls, ()):
+                    sm = project.classes[sub_key].methods.get(parts[1])
+                    if sm is not None:
+                        targets.append(sm.qualname)
+                return tuple(dict.fromkeys(targets))
             if len(parts) == 3:
                 t = project.find_attr_type(self.fi.cls, parts[1])
                 if t:
@@ -658,6 +686,50 @@ class _FunctionWalker:
             return ".wait() with no timeout blocks indefinitely under the lock"
         return None
 
+    # -- device acquisition (NL-DEV01) classification ------------------------
+    _DEVICE_ACQ_DOTTED = {
+        "jax.devices": "jax.devices() (PJRT backend init)",
+        "jax.local_devices": "jax.local_devices() (PJRT backend init)",
+        "jax.device_count": "jax.device_count() (PJRT backend init)",
+        "jax.device_put": "jax.device_put() (H2D transfer; cold = PJRT init)",
+        "jnp.asarray": "jnp.asarray() (H2D transfer; cold = PJRT init)",
+        "jnp.array": "jnp.array() (H2D transfer; cold = PJRT init)",
+        "make_mesh": "make_mesh() (device enumeration)",
+    }
+    _DEVICE_ACQ_ATTRS = {
+        "device_put": "device_put() (H2D transfer; cold = PJRT init)",
+        "device_arrays": ".device_arrays() (resident-buffer sync)",
+    }
+    # gate methods of the backend lifecycle manager: they may WAIT for
+    # acquisition by design — waiting under a lock recreates the bug the
+    # manager exists to kill
+    _BACKEND_GATE_ATTRS = {"await_ready", "require_ready", "ensure_started"}
+
+    def _classify_device_acq(self, call: ast.Call) -> Optional[str]:
+        func = call.func
+        d = dotted_name(func)
+        if d in self._DEVICE_ACQ_DOTTED:
+            # resolve only when jax is actually in play for the bare names
+            if d == "make_mesh" and "jax" not in self.mi.ctx.imports \
+                    and not any(m.startswith("jax") for m in self.mi.ctx.imports):
+                return None
+            return self._DEVICE_ACQ_DOTTED[d]
+        if not isinstance(func, ast.Attribute):
+            return None
+        attr = func.attr
+        recv = (dotted_name(func.value) or "").lower()
+        if attr in self._DEVICE_ACQ_ATTRS:
+            return self._DEVICE_ACQ_ATTRS[attr]
+        if attr in self._BACKEND_GATE_ATTRS and (
+            "backend" in recv or "mgr" in recv or "manager" in recv
+        ):
+            return f".{attr}() (backend acquisition gate)"
+        if attr == "devices" and "backend" in recv:
+            return ".devices() (gated device enumeration)"
+        if attr == "_device_gate":
+            return "._device_gate() (waiting backend acquisition gate)"
+        return None
+
     # -- escape (callback under lock) classification -------------------------
     def _classify_escape(self, call: ast.Call) -> Optional[str]:
         func = call.func
@@ -732,6 +804,9 @@ class _FunctionWalker:
         what = self._classify_escape(call)
         if what:
             self.fi.escapes.append(EscapeCall(what, held, call, self.fi))
+        dev = self._classify_device_acq(call)
+        if dev:
+            self.fi.device_acqs.append(DeviceAcqCall(dev, held, call, self.fi))
 
 
 def _looks_like_lock_acquire(call: ast.Call) -> bool:
@@ -911,6 +986,45 @@ def nl_lk03(project: ProjectContext) -> Iterator[Finding]:
                 "outside this module's control and may re-enter and "
                 "re-acquire the lock (or block it) — snapshot under the "
                 "lock, invoke after release",
+            )
+
+
+# -- NL-DEV01: device op / backend acquisition under a held lock --------------
+
+@register_project(
+    "NL-DEV01",
+    "error",
+    "device op / backend acquisition while holding a lock — a cold PJRT "
+    "init here hangs forever with the lock held (the round-5 deadlock); "
+    "gate through the BackendManager BEFORE locking",
+)
+def nl_dev01(project: ProjectContext) -> Iterator[Finding]:
+    rule = nl_dev01
+    for fi in project.functions.values():
+        for acq in fi.device_acqs:
+            all_held = project.held_at(fi, acq.held)
+            if not all_held:
+                continue
+            locks = sorted(all_held)
+            details = []
+            for lock in locks[:3]:
+                prov = all_held[lock]
+                if prov is None:
+                    details.append(lock_display(lock))
+                else:
+                    chain = project.provenance_chain(fi, lock)
+                    details.append(
+                        f"{lock_display(lock)} (held via {chain})" if chain
+                        else lock_display(lock)
+                    )
+            yield _finding(
+                rule, fi, acq.node,
+                f"{acq.what} while holding {', '.join(details)}; if the "
+                "backend is cold or lost this blocks in PJRT init with the "
+                "lock held and every waiter deadlocks — gate through "
+                "nornicdb_tpu.backend (await_ready) before taking the lock, "
+                "or move the device op outside the critical section "
+                "(docs/backend.md)",
             )
 
 

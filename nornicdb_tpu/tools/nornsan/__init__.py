@@ -105,6 +105,15 @@ class Tracker:
             st = self._tls.stack = []
         return st
 
+    def held_sites(self) -> list[str]:
+        """Creation sites of instrumented locks the CALLING thread holds
+        right now — the runtime NL-DEV01 check: backend acquisition
+        (nornicdb_tpu.backend BackendManager.await_ready) refuses to run
+        while the caller holds any instrumented lock."""
+        return [
+            self.sites.get(i, "?") for i in dict.fromkeys(self._stack())
+        ]
+
     def on_acquired(self, lock_id: int, waited_s: float) -> None:
         stack = self._stack()
         held = [i for i in stack if i != lock_id]

@@ -334,6 +334,52 @@ BAD_CLEAN_FIXTURES = {
                     snapshot(entry)
         """,
     ),
+    "NL-DEV01": (
+        """
+        import threading
+
+        import jax
+        import jax.numpy as jnp
+
+        class Corpus:
+            def __init__(self):
+                self._sync_lock = threading.Lock()
+                self._host = None
+                self._dev = None
+
+            def sync(self):
+                with self._sync_lock:
+                    # cold first-touch under the lock: PJRT init can hang
+                    # here forever with every waiter wedged (round-5 bug)
+                    self._dev = jnp.asarray(self._host)
+
+            def pick(self):
+                with self._sync_lock:
+                    return jax.devices()[0]
+        """,
+        """
+        import threading
+
+        import jax
+        import jax.numpy as jnp
+
+        class Corpus:
+            def __init__(self):
+                self._sync_lock = threading.Lock()
+                self._host = None
+                self._dev = None
+
+            def sync(self):
+                staged = jnp.asarray(self._host)  # transfer outside the lock
+                with self._sync_lock:
+                    self._dev = staged  # install is a pointer swap
+
+            def pick(self):
+                devs = jax.devices()  # acquisition before locking
+                with self._sync_lock:
+                    return devs[0]
+        """,
+    ),
 }
 
 
@@ -592,6 +638,114 @@ def test_lk03_clock_attributes_exempt():
                 return self.now()
     """
     assert not findings_for(src, "NL-LK03")
+
+
+def test_dev01_held_lock_propagates_to_device_op():
+    """The round-5 shape exactly: search() holds the service lock and the
+    sync it calls does the cold H2D transfer two frames down."""
+    src = """
+    import threading
+
+    import jax.numpy as jnp
+
+    class Service:
+        def __init__(self):
+            self._lock = threading.Lock()
+            self._host = None
+            self._dev = None
+
+        def _sync(self):
+            self._dev = jnp.asarray(self._host)
+
+        def search(self, q):
+            with self._lock:
+                self._sync()
+                return self._dev
+    """
+    hits = findings_for(src, "NL-DEV01")
+    assert hits and "held via" in hits[0].message, hits
+
+
+def test_dev01_propagates_into_subclass_overrides():
+    """Template-method dispatch: a locked base method calls self._upload()
+    and only the SUBCLASS override does the device op — the dominant
+    pattern in ops/similarity.py (HostCorpus._sync -> _upload_full)."""
+    src = """
+    import threading
+
+    import jax.numpy as jnp
+
+    class Base:
+        def __init__(self):
+            self._sync_lock = threading.Lock()
+            self._host = None
+
+        def _upload(self):
+            raise NotImplementedError
+
+        def sync(self):
+            with self._sync_lock:
+                self._upload()
+
+    class Child(Base):
+        def _upload(self):
+            self._dev = jnp.asarray(self._host)
+    """
+    hits = findings_for(src, "NL-DEV01")
+    assert hits and "held via" in hits[0].message, hits
+
+
+def test_dev01_backend_gate_and_device_put_under_lock_flagged():
+    src = """
+    import threading
+
+    import jax
+
+    _lock = threading.Lock()
+
+    def install(mgr, host):
+        with _lock:
+            mgr.await_ready()
+            return jax.device_put(host)
+    """
+    msgs = [f.message for f in findings_for(src, "NL-DEV01")]
+    assert any("await_ready" in m for m in msgs), msgs
+    assert any("device_put" in m for m in msgs), msgs
+
+
+def test_dev01_gate_before_lock_is_clean():
+    src = """
+    import threading
+
+    import jax
+
+    _lock = threading.Lock()
+
+    def install(mgr, host):
+        mgr.await_ready()
+        dev = jax.device_put(host)
+        with _lock:
+            return dev
+    """
+    assert not findings_for(src, "NL-DEV01")
+
+
+def test_dev01_non_jax_make_mesh_not_flagged():
+    """A domain make_mesh() in a module that never imports jax is not a
+    device acquisition."""
+    src = """
+    import threading
+
+    _lock = threading.Lock()
+
+    def make_mesh(rows, cols):
+        return [[0] * cols for _ in range(rows)]
+
+    def grid():
+        with _lock:
+            return make_mesh(2, 2)
+    """
+    assert not findings_for(src, "NL-DEV01")
 
 
 def test_project_rule_suppression_at_witness_site():

@@ -21,8 +21,13 @@ from nornicdb_tpu.models import pretrain
 @pytest.fixture(scope="module")
 def assistant_ckpt(tmp_path_factory):
     out = str(tmp_path_factory.mktemp("assistant"))
+    # 450 steps (was 250): at 250 the country->capital association often
+    # fails to form at all (the model answers one fixed capital for every
+    # country — observed 1/12 accuracy consistently on some hosts, since
+    # XLA CPU reduction order varies with thread count); 450 reaches 12/12
+    # reliably for ~7s more training time
     stats = pretrain.train_assistant(
-        out, steps=250, batch=16, seq_len=48, hidden=96, log_every=50,
+        out, steps=450, batch=16, seq_len=48, hidden=96, log_every=100,
     )
     return out, stats
 
@@ -60,14 +65,29 @@ class TestAssistantTraining:
         out, stats = assistant_ckpt
         assert stats["loss_last"] < stats["loss_first"] * 0.3, stats
         gen = pretrain.load_generator(out)
-        ids = gen.tokenizer.encode("the capital of norway is",
-                                   add_special=False)
-        toks = gen.qwen2.generate(
-            gen.params, gen.cfg, ids, max_new_tokens=4,
-            eos_id=gen.tokenizer.eos_id,
+        # XLA CPU reductions are thread-count nondeterministic, so at
+        # these micro training settings one individual capital can come
+        # out confused run-to-run (e.g. norway -> copenhagen). Assert a
+        # statistical bound over ALL capitals instead: random weights
+        # score ~1/12 expected accuracy, a trained model lands far above
+        # — the test still cannot pass without learning, but no single
+        # confusion flakes it.
+        correct = 0
+        answers = {}
+        for country, capital in pretrain._CAPITALS.items():
+            ids = gen.tokenizer.encode(f"the capital of {country} is",
+                                       add_special=False)
+            toks = gen.qwen2.generate(
+                gen.params, gen.cfg, ids, max_new_tokens=4,
+                eos_id=gen.tokenizer.eos_id,
+            )
+            answers[country] = gen.tokenizer.decode(toks)
+            if capital in answers[country]:
+                correct += 1
+        assert correct >= 8, (
+            f"only {correct}/{len(pretrain._CAPITALS)} capitals learned "
+            f"(random weights would score ~1): {answers}"
         )
-        text = gen.tokenizer.decode(toks)
-        assert "oslo" in text, f"random-weight output leaked: {text!r}"
 
     def test_checkpoint_rejects_wrong_kind(self, encoder_ckpt):
         out, _ = encoder_ckpt
