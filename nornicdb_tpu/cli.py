@@ -45,6 +45,12 @@ def cmd_serve(args) -> int:
     from nornicdb_tpu import backend as backend_mod
 
     backend_mod.configure(app_cfg.backend)
+    # vector-serving knobs (backend selection, sharded promotion, recall
+    # tuning) become the defaults for every SearchService this process
+    # builds — docs/operations.md "Sharded serving tuning"
+    from nornicdb_tpu.search import service as search_service
+
+    search_service.configure_defaults(**vars(app_cfg.search))
     # kick off PJRT init + first-touch on the manager's worker thread NOW,
     # so the first search/embed finds a READY (or already-degraded) backend
     # instead of paying the acquire timeout inline

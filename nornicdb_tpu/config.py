@@ -115,6 +115,30 @@ class BackendConfig:
 
 
 @dataclass
+class SearchTuningConfig:
+    """Vector-serving knobs (nornicdb_tpu.search.SearchConfig): applied by
+    ``cli serve`` via ``search.service.configure_defaults`` before the
+    first SearchService is built.  The same knobs are env-readable as
+    ``NORNICDB_SEARCH_<FIELD>`` for embedded processes.  See
+    docs/operations.md "Sharded serving tuning"."""
+
+    # auto | tpu | sharded | hnsw — "auto" starts single-device and
+    # promotes to the mesh-sharded path past sharded_min_rows
+    backend: str = "auto"
+    sharded_min_rows: int = 100_000
+    # recall knobs: exact full-sort, per-shard candidate oversampling,
+    # IVF probe count (0 = full scan)
+    exact: bool = False
+    local_k: int = 0
+    n_probe: int = 0
+    # micro-batching + write-behind sync (PR 2)
+    batching_enabled: bool = False
+    batch_window: float = 0.002
+    batch_max: int = 256
+    write_behind: bool = False
+
+
+@dataclass
 class AppConfig:
     server: ServerConfig = field(default_factory=ServerConfig)
     database: DatabaseConfig = field(default_factory=DatabaseConfig)
@@ -123,6 +147,7 @@ class AppConfig:
     compliance: ComplianceConfig = field(default_factory=ComplianceConfig)
     telemetry: TelemetryConfig = field(default_factory=TelemetryConfig)
     backend: BackendConfig = field(default_factory=BackendConfig)
+    search: SearchTuningConfig = field(default_factory=SearchTuningConfig)
 
 
 def find_config_file(start_dir: str = ".") -> Optional[str]:

@@ -10,6 +10,7 @@ Kalman-smoothed variant (kalman_adapter.go).
 
 from __future__ import annotations
 
+import logging
 import math
 import threading
 import time
@@ -18,6 +19,8 @@ from typing import Callable, Optional
 
 from nornicdb_tpu.filter.kalman import DECAY_PREDICTION, Kalman
 from nornicdb_tpu.storage.types import EPISODIC, PROCEDURAL, SEMANTIC, Engine, Node
+
+logger = logging.getLogger(__name__)
 
 DAY = 86400.0
 
@@ -79,6 +82,8 @@ class DecayManager:
         # decay_integration.go; wire temporal.DecayIntegration
         # .get_decay_modifier(...).multiplier here)
         self.rate_modifier: Optional[Callable[[str], float]] = None
+        self._modifier_errors = 0
+        self._modifier_error_logged_at = float("-inf")
 
     # -- scoring -------------------------------------------------------------
     def calculate_score(self, node: Node, now: Optional[float] = None) -> float:
@@ -91,6 +96,20 @@ class DecayManager:
             try:
                 mult = float(self.rate_modifier(node.id))
             except Exception:
+                # per-node call site inside recalculate_all: a persistent
+                # modifier failure (storage down) would otherwise emit one
+                # traceback PER NODE per pass — rate-limit to one per 60s
+                # with a suppressed-failure count
+                self._modifier_errors += 1
+                mono = time.monotonic()
+                if mono - self._modifier_error_logged_at >= 60.0:
+                    self._modifier_error_logged_at = mono
+                    logger.exception(
+                        "decay rate modifier failed for %s; using 1.0 "
+                        "(%d failure(s) since last report)",
+                        node.id, self._modifier_errors,
+                    )
+                    self._modifier_errors = 0
                 mult = 1.0
             if mult > 0 and math.isfinite(mult):
                 hl = hl / mult
@@ -164,7 +183,9 @@ class DecayManager:
         try:
             self.recalculate_all()
         except Exception:
-            pass
+            # the periodic timer must survive a bad pass, but silently
+            # eating it hid real storage failures from operators
+            logger.exception("periodic decay recalculation failed")
         self._schedule()
 
     def stop(self) -> None:

@@ -14,6 +14,13 @@ from nornicdb_tpu.ops.kmeans import (
     optimal_k,
     pairwise_sq_dists,
 )
+from nornicdb_tpu.ops.ivf import (
+    IVFLayout,
+    ShardedIVFLayout,
+    build_ivf_layout,
+    build_sharded_ivf_layout,
+    ivf_search,
+)
 from nornicdb_tpu.ops.pallas_kernels import fused_cosine_scores, fused_cosine_topk
 from nornicdb_tpu.ops.similarity import (
     LANE,
@@ -27,6 +34,7 @@ from nornicdb_tpu.ops.similarity import (
     merge_topk,
     pad_to_multiple,
     score_subset,
+    topk_backend,
 )
 
 __all__ = [
@@ -41,6 +49,12 @@ __all__ = [
     "merge_topk",
     "pad_to_multiple",
     "score_subset",
+    "topk_backend",
+    "IVFLayout",
+    "ShardedIVFLayout",
+    "build_ivf_layout",
+    "build_sharded_ivf_layout",
+    "ivf_search",
     "KMeansResult",
     "assign_clusters",
     "kmeans_fit",

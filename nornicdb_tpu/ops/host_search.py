@@ -35,16 +35,50 @@ def host_topk(
     k = max(1, min(k, n))
     scores = q @ corpus.T  # (Q, N); rows are normalized -> cosine
     scores = np.where(valid[None, :], scores, -np.inf)
-    if k >= n:
-        idx = np.argsort(-scores, axis=1)
-        return np.take_along_axis(scores, idx, axis=1), idx
-    part = np.argpartition(-scores, k - 1, axis=1)[:, :k]
-    part_scores = np.take_along_axis(scores, part, axis=1)
-    order = np.argsort(-part_scores, axis=1)
-    return (
-        np.take_along_axis(part_scores, order, axis=1),
-        np.take_along_axis(part, order, axis=1),
-    )
+    # NaN scores (NaN query components survive normalization's
+    # divide-by-norm) break the boundary-widening selection below: every
+    # `s >= kth` comparison is False, so fewer than k candidates survive
+    # and the fixed-shape write raises.  Map them to -inf — callers
+    # already drop non-finite values (_format_results), so a NaN query
+    # degrades to "matches nothing" instead of a 500.
+    np.copyto(scores, -np.inf, where=np.isnan(scores))
+    # ties must keep ascending row order, matching lax.top_k's tie rule
+    # on the device path (so degraded serving returns the SAME ids as the
+    # device would, not an argpartition-arbitrary tied subset).  A full
+    # stable argsort over N rows per query is O(N log N) — too slow for
+    # the 10M-row degraded scenario, and it runs under _sync_lock.
+    # Instead: O(N) argpartition to the kth score, widen to ALL rows tied
+    # at that boundary, and stable-sort only that subset.
+    out_v = np.empty((q.shape[0], k), np.float32)
+    out_i = np.empty((q.shape[0], k), np.int64)
+    for qi in range(q.shape[0]):
+        s = scores[qi]
+        if k < n:
+            kth = s[np.argpartition(-s, k - 1)[k - 1]]
+            if kth == -np.inf:
+                # fewer than k finite scores: `s >= -inf` holds for EVERY
+                # row (-inf >= -inf is True), and the boundary widening
+                # would stable-sort the whole corpus — O(N log N) under
+                # _sync_lock at a 10M-row capacity with a handful of live
+                # rows. Only the finite rows can surface (callers drop
+                # non-finite scores); sort those and pad below.
+                cand = np.nonzero(np.isfinite(s))[0]
+            else:
+                cand = np.nonzero(s >= kth)[0]  # ascending row order
+        else:
+            cand = np.arange(n)
+        order = np.argsort(-s[cand], kind="stable")[:k]
+        sel = cand[order]
+        if sel.size < k:
+            # fixed-shape pad with the lowest-index unselected rows; their
+            # scores are -inf, which _format_results filters out
+            mask = np.ones(n, bool)
+            mask[sel] = False
+            pad = np.nonzero(mask)[0][: k - sel.size]
+            sel = np.concatenate([sel, pad])
+        out_i[qi] = sel
+        out_v[qi] = s[sel]
+    return out_v, out_i
 
 
 def host_score_rows(

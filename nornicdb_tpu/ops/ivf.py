@@ -229,3 +229,158 @@ def ivf_search(
         vals = np.pad(vals, ((0, 0), (0, pad)), constant_values=-np.inf)
         slots = np.pad(slots, ((0, 0), (0, pad)), constant_values=-1)
     return vals, slots
+
+
+# ------------------------------------------------------------ sharded IVF
+#
+# IVF composed with mesh sharding (ROADMAP item 2): centroids are
+# REPLICATED (every shard probes identically — the centroid GEMM is tiny),
+# inverted lists are PER-SHARD (each shard owns the cluster members that
+# live in its slot range), and n_probe pruning happens INSIDE the shard
+# program, so the fused sharded search gets the same ~P/K FLOP/HBM cut per
+# shard that the single-device layout gets. All shards share one static
+# (K, Cmax, D) block shape (shard_map needs uniform shapes); skew between
+# shards pads with dead rows that the count masks exclude, and per-shard
+# overflow spills into a shared-width residual segment scanned brute-force.
+#
+# The slotmap rides the device this time (the single-device layout resolves
+# slots host-side): each shard must translate its local (probe, col) hits
+# into GLOBAL corpus slots BEFORE the all-gather merge, so the merge
+# exchanges only (vals, global_slot) pairs — the same wire format as the
+# dense sharded path.
+#
+# Invalidation contract == PR 2's layout epoch: the layout serves while its
+# build-time epoch matches HostCorpus._layout_epoch (bumped by covered-row
+# overwrites and slot remaps; plain adds/removes keep it serving — new rows
+# are invisible to pruned search until recluster, removals filter at
+# format time through the captured id map).
+
+
+@dataclass
+class ShardedIVFLayout:
+    """Per-shard cluster-contiguous layout for the fused sharded IVF path.
+
+    Built by build_sharded_ivf_layout; consumed by the shard_map program in
+    parallel.sharded_index (kept there — this module stays mesh-agnostic;
+    the device arrays arrive pre-placed via the shardings the caller
+    passes in).
+    """
+
+    blocks: jax.Array        # (S, K, Cmax, D) zero-padded, P(axis,...)
+    counts: jax.Array        # (S, K) int32 live rows per shard-cluster
+    slotmap: jax.Array       # (S, K, Cmax) int32 GLOBAL slot, -1 = pad
+    centroids: jax.Array     # (K, D) replicated
+    residual: Optional[jax.Array]      # (S, Rmax, D) per-shard spill
+    residual_slots: Optional[jax.Array]  # (S, Rmax) int32 global slot, -1
+    cmax: int
+    rmax: int
+    k: int                   # cluster count
+    n_shards: int
+    epoch: int               # corpus layout epoch at build time
+
+    @property
+    def n_rows(self) -> int:
+        n = int(np.asarray(jnp.sum(self.slotmap >= 0)))
+        if self.residual_slots is not None:
+            n += int(np.asarray(jnp.sum(self.residual_slots >= 0)))
+        return n
+
+
+def build_sharded_ivf_layout(
+    rows: np.ndarray,
+    slots: np.ndarray,
+    assignments: np.ndarray,
+    centroids: np.ndarray,
+    n_shards: int,
+    local_n: int,
+    shard_sharding,
+    replicated_sharding,
+    dtype=jnp.float32,
+    epoch: int = 0,
+    max_block_factor: float = 2.0,
+) -> ShardedIVFLayout:
+    """Build the per-shard inverted lists.
+
+    rows:        (N, D) float32, L2-normalized live rows
+    slots:       (N,) GLOBAL corpus slot per row; shard = slot // local_n
+    assignments: (N,) cluster id per row
+    n_shards/local_n: the corpus's mesh layout (capacity = S * local_n)
+    shard_sharding: NamedSharding partitioning the leading shard axis
+        (trailing dims replicated) — placed on every (S, ...) array;
+    replicated_sharding: NamedSharding for the replicated centroids.
+    """
+    n, d = rows.shape
+    k = centroids.shape[0]
+    shard_of = slots // local_n
+    in_range = (
+        (assignments >= 0) & (assignments < k)
+        & (shard_of >= 0) & (shard_of < n_shards)
+    )
+    rows_v = rows[in_range]
+    slots_v = slots[in_range]
+    assign_v = assignments[in_range]
+    shard_v = shard_of[in_range]
+    # shared Cmax across shards: ~factor x the mean shard-cluster size, so
+    # one skewed shard pads instead of inflating every shard's block array
+    mean = max(1, rows_v.shape[0] // max(1, n_shards * k))
+    cmax = _next_pow2(min(max(int(mean * max_block_factor), 8),
+                          max(local_n, 1)))
+    # vectorized scatter, same trick as the single-device build but keyed
+    # by (shard, cluster): sort, rank within the pair, rank < Cmax lands
+    # in the block, the rest spills per shard
+    pair = shard_v.astype(np.int64) * k + assign_v
+    order = np.argsort(pair, kind="stable")
+    sorted_pair = pair[order]
+    counts_all = np.bincount(sorted_pair, minlength=n_shards * k)
+    starts = np.concatenate(([0], np.cumsum(counts_all)[:-1]))
+    rank = np.arange(sorted_pair.size) - starts[sorted_pair]
+    in_block = rank < cmax
+    blocks = np.zeros((n_shards, k, cmax, d), np.float32)
+    slotmap = np.full((n_shards, k, cmax), -1, np.int32)
+    s_idx = (sorted_pair // k)[in_block]
+    c_idx = (sorted_pair % k)[in_block]
+    p_idx = rank[in_block]
+    blocks[s_idx, c_idx, p_idx] = rows_v[order][in_block]
+    slotmap[s_idx, c_idx, p_idx] = slots_v[order][in_block]
+    counts = np.minimum(
+        counts_all.reshape(n_shards, k), cmax
+    ).astype(np.int32)
+    # per-shard residual spill, padded to a shared LANE-multiple width
+    spill_rows = rows_v[order][~in_block]
+    spill_slots = slots_v[order][~in_block]
+    spill_shard = (sorted_pair // k)[~in_block]
+    residual_dev = residual_slots_dev = None
+    rmax = 0
+    if spill_rows.shape[0]:
+        per_shard = np.bincount(spill_shard, minlength=n_shards)
+        rmax = ((int(per_shard.max()) + LANE - 1) // LANE) * LANE
+        residual = np.zeros((n_shards, rmax, d), np.float32)
+        residual_slots = np.full((n_shards, rmax), -1, np.int32)
+        # spill rows are already grouped by shard (sorted by pair)
+        for s in range(n_shards):
+            m = spill_shard == s
+            cnt = int(m.sum())
+            if cnt:
+                residual[s, :cnt] = spill_rows[m]
+                residual_slots[s, :cnt] = spill_slots[m]
+        residual_dev = jax.device_put(
+            jnp.asarray(residual, dtype=dtype), shard_sharding
+        )
+        residual_slots_dev = jax.device_put(
+            jnp.asarray(residual_slots), shard_sharding
+        )
+    return ShardedIVFLayout(
+        blocks=jax.device_put(jnp.asarray(blocks, dtype=dtype),
+                              shard_sharding),
+        counts=jax.device_put(jnp.asarray(counts), shard_sharding),
+        slotmap=jax.device_put(jnp.asarray(slotmap), shard_sharding),
+        centroids=jax.device_put(jnp.asarray(centroids, dtype=dtype),
+                                 replicated_sharding),
+        residual=residual_dev,
+        residual_slots=residual_slots_dev,
+        cmax=cmax,
+        rmax=rmax,
+        k=k,
+        n_shards=n_shards,
+        epoch=epoch,
+    )

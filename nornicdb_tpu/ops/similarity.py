@@ -214,12 +214,28 @@ def merge_topk(
 
     values/indices: (S, Q, k) stacked partial results with GLOBAL indices.
     Returns (Q, k). Used for the ICI all-gather merge of sharded search.
+
+    Sentinel contract: any merged entry whose value is not finite (the
+    -inf padding a near-empty shard emits when ``k`` exceeds its live
+    rows) gets index -1, so a padding slot's index can NEVER surface as
+    a candidate — even through a caller that forgets to filter by score.
+    (Before this guard a -inf entry kept whatever index the per-shard
+    top-k happened to assign it, and ``ids[idx]`` on a negative or
+    recycled index could attribute a live id to a sentinel score.)
+
+    Tie-breaking is stable vs the single-device path: the flattened
+    candidate axis is shard-major (shard s, rank j -> s*k + j), and
+    lax.top_k breaks value ties by the lowest flattened position — i.e.
+    lowest shard first, then best per-shard rank. Because row slots are
+    laid out contiguously per shard, that is exactly ascending global
+    row index, the same order lax.top_k yields on one device.
     """
     s, q, kk = values.shape
     flat_v = jnp.transpose(values, (1, 0, 2)).reshape(q, s * kk)
     flat_i = jnp.transpose(indices, (1, 0, 2)).reshape(q, s * kk)
     best_v, pos = jax.lax.top_k(flat_v, k)
     best_i = jnp.take_along_axis(flat_i, pos, axis=1)
+    best_i = jnp.where(jnp.isfinite(best_v), best_i, -1)
     return best_v, best_i
 
 
@@ -251,6 +267,35 @@ _SYNC_BYTES_HIST = _REGISTRY.histogram(
 )
 _SYNC_PATCH_BYTES_CELL = _SYNC_BYTES_HIST.labels("patch")
 _SYNC_FULL_BYTES_CELL = _SYNC_BYTES_HIST.labels("full")
+
+# mesh-sharded serving telemetry (parallel.ShardedCorpus): registered here —
+# not in parallel/ — so the families render in the /metrics catalog of every
+# process (the sharded module imports lazily, only when a mesh exists)
+_SHARDED_SEARCH_HIST = _REGISTRY.histogram(
+    "nornicdb_sharded_search_seconds",
+    "Fused per-shard scoring + local top-k + ICI all-gather merge: one "
+    "device dispatch per (possibly batched) sharded search",
+)
+_SHARDED_MERGE_HIST = _REGISTRY.histogram(
+    "nornicdb_sharded_merge_seconds",
+    "Host-side merge epilogue of a sharded search (sentinel filtering, "
+    "id resolution, IVF block+residual candidate merge)",
+)
+_SHARD_REBALANCES = _REGISTRY.counter(
+    "nornicdb_shard_rebalances_total",
+    "Shard-boundary remaps (grow/compact/recovery) that forced a full "
+    "re-shard re-upload of the mesh corpus",
+)
+_SHARD_LOCALK_OVERFLOWS = _REGISTRY.counter(
+    "nornicdb_shard_local_k_overflows_total",
+    "Approx sharded searches where one shard's local_k candidate list "
+    "saturated the merged top-k (raise local_k to recover recall)",
+)
+_SHARD_ROWS_GAUGE = _REGISTRY.gauge(
+    "nornicdb_shard_rows",
+    "Live corpus rows resident on each mesh shard",
+    labels=("shard",),
+)
 
 # above this fraction of dirty blocks, one contiguous full transfer beats
 # many small patch dispatches (each patch pays launch + slice overhead and
@@ -911,7 +956,11 @@ class HostCorpus:
         for qi in range(n_queries):
             row: list[tuple[str, float]] = []
             for v, i in zip(vals[qi], idx[qi]):
-                if not np.isfinite(v) or v < min_similarity:
+                # i < 0 is the merge_topk/IVF sentinel for "no candidate"
+                # (padding rows of a near-empty shard / short cluster);
+                # a negative index must never reach ids[i] — Python's
+                # negative indexing would attribute the LAST id to it
+                if i < 0 or not np.isfinite(v) or v < min_similarity:
                     continue
                 id_ = ids[i] if i < len(ids) else None
                 if id_ is not None:
@@ -1078,6 +1127,14 @@ class DeviceCorpus(HostCorpus):
         super()._on_backend_ready()
         with self._sync_lock:
             pending, self._pending_clusters = self._pending_clusters, None
+            if pending is None and self._ivf is None:
+                # a degraded-era grow/compact ran clear_clusters(), which
+                # drops the stash along with the layout — but the id-based
+                # host copy survives slot remaps and still describes the
+                # newest fit. Reinstall it instead of serving full scans
+                # until the next periodic recluster (the set_clusters
+                # contract: a degraded-era fit is NOT discarded).
+                pending = self._last_fit_host
         if pending is None:
             return
 

@@ -9,6 +9,7 @@ Two communication planes (SURVEY.md §5 "Distributed communication backend"):
 
 from nornicdb_tpu.parallel.dp_embed import DataParallelEmbedder
 from nornicdb_tpu.parallel.mesh import (
+    can_shard,
     data_sharding,
     local_device_count,
     make_mesh,
@@ -18,10 +19,11 @@ from nornicdb_tpu.parallel.ring_attention import (
     make_ring_attention,
     reference_attention,
 )
-from nornicdb_tpu.parallel.sharded_index import ShardedCorpus
+from nornicdb_tpu.parallel.sharded_index import ShardedCorpus, ShardStats
 
 __all__ = [
     "DataParallelEmbedder",
+    "can_shard",
     "data_sharding",
     "local_device_count",
     "make_mesh",
@@ -29,4 +31,5 @@ __all__ = [
     "make_ring_attention",
     "reference_attention",
     "ShardedCorpus",
+    "ShardStats",
 ]

@@ -90,3 +90,11 @@ def local_device_count() -> int:
     from nornicdb_tpu import backend as _backend
 
     return len(_backend.devices())
+
+
+def can_shard() -> bool:
+    """True when a mesh data plane is worth building: more than one
+    device is reachable through the backend manager.  Raises
+    DeviceUnavailable (from the gated enumeration) while degraded — the
+    caller decides whether to retry or pin single-device serving."""
+    return local_device_count() > 1

@@ -6,17 +6,44 @@ This realises the reference's *planned* sharded vector index
 Planned") as the framework's primary ANN path — at TPU-pod scale, sharded
 brute-force scoring beats HNSW for corpora ≤ tens of millions (SURVEY.md §7
 step 4). Scores are always exact; candidate membership defaults to
-approx_max_k (recall_target 0.95 per shard, the TPU-native top-k) with an
-exact=True full-sort opt-in for recall 1.0.
+approx_max_k / the streaming Pallas bin-reduce kernel (recall_target 0.95
+per shard, the TPU-native top-k) with an exact=True full-sort opt-in for
+recall 1.0.
 
 Data plane: XLA collectives over ICI inside one jit'd program (shard_map).
 No host-side shard coordinator exists — the "merge" is an all_gather + top_k
-epilogue compiled into the same program as the scoring GEMM.
+epilogue compiled into the same program as the scoring GEMM, so one search
+(of any batch size) is ONE device dispatch.
+
+local_k sizing contract
+-----------------------
+Each shard contributes ``local_k = clamp(max(k, requested_local_k),
+1, local_n)`` candidates to the merge.  In exact mode this is provably
+lossless for any live-row distribution: a shard can contribute at most k
+rows to the global top-k, and a shard with fewer than local_k live rows
+returns ALL of them (the remainder are -inf sentinels whose indices are
+masked to -1 before the merge, so padding can never surface as a
+candidate — see ops.similarity.merge_topk).  In approx mode local_k is a
+recall knob: per-shard bin-reduce membership is ~0.95 at local_k == k, and
+oversampling (SearchConfig.local_k > k) buys recall back at the cost of a
+wider all-gather.  The shard_local_k_overflows metric counts merges where
+one shard's list saturated — the signal to raise it.
+
+IVF under sharding: centroids are replicated (every shard probes the same
+n_probe clusters in-program), inverted lists are per-shard
+(ops.ivf.build_sharded_ivf_layout), and the layout serves only while its
+build-time epoch matches the corpus layout epoch (PR 2's invalidation
+contract — covered-row overwrites and slot remaps kill it, plain
+adds/removes don't).
 """
 
 from __future__ import annotations
 
 import functools
+import logging
+import threading
+import time
+from dataclasses import asdict, dataclass, field
 from typing import Optional
 
 import jax
@@ -25,54 +52,83 @@ import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from nornicdb_tpu.errors import DeviceUnavailable
+from nornicdb_tpu.ops.ivf import _next_pow2
 from nornicdb_tpu.ops.similarity import (
+    _SHARD_LOCALK_OVERFLOWS,
+    _SHARD_REBALANCES,
+    _SHARD_ROWS_GAUGE,
+    _SHARDED_MERGE_HIST,
+    _SHARDED_SEARCH_HIST,
     HostCorpus,
     _patch_rows,
     _patch_rows_donated,
     _patch_valid,
     _patch_valid_donated,
     cosine_topk,
+    dot_scores,
     l2_normalize,
     merge_topk,
     topk_backend,
 )
 from nornicdb_tpu.parallel.mesh import make_mesh, shard_map_compat
 
+logger = logging.getLogger(__name__)
+
+# Collective programs launched from two host threads can interleave their
+# per-device enqueue order and deadlock at the all_gather rendezvous
+# (reproduced live on the 8-device CPU mesh: a recall() on the main thread
+# racing the embed worker's dispatch left every device waiting for a
+# participant enqueued behind the OTHER program). The same out-of-order
+# enqueue hazard exists on a real mesh, so every sharded serving dispatch
+# in the process serializes through this leaf lock. It guards only WARM,
+# already-gated dispatches (never backend acquisition — NL-DEV01-safe) and
+# nothing else is ever acquired while holding it; result materialization
+# happens inside so the program has fully retired before the next launch.
+_COLLECTIVE_DISPATCH_LOCK = threading.Lock()
+
 
 @functools.partial(
     jax.jit,
-    static_argnames=("k", "axis", "mesh_static", "use_bf16", "exact",
-                     "streaming"),
+    static_argnames=("k", "local_k", "axis", "mesh_static", "use_bf16",
+                     "exact", "streaming"),
 )
 def _sharded_search(
     queries: jax.Array,
     corpus: jax.Array,
     valid: jax.Array,
     k: int,
+    local_k: int,
     axis: str,
     mesh_static: Mesh,
     use_bf16: bool = True,
     exact: bool = False,
     streaming: Optional[bool] = None,
 ):
-    """One XLA program: per-shard GEMM + top-k, ICI all-gather, global merge.
-    Per-shard scoring dispatches through topk_backend, so on TPU at scale
-    each chip runs the streaming Pallas kernel over its corpus shard."""
+    """One XLA program: per-shard GEMM + top-local_k, ICI all-gather of
+    (vals, global_idx) only, global merge.  Per-shard scoring dispatches
+    through topk_backend, so on TPU at scale each chip runs the streaming
+    Pallas bin-reduce kernel over its corpus shard (TPU-KNN shape); the
+    exact=True fallback full-sorts per shard instead."""
 
     def shard_fn(q, c, v):
         local_n = c.shape[0]
         n_shards = mesh_static.shape[axis]
-        local_k = min(k, local_n)  # a shard holds at most local_n candidates
+        lk = max(1, min(local_k, local_n))
         vals, idx = topk_backend(
-            q, c, v, local_k, exact=exact, use_bf16=use_bf16,
+            q, c, v, lk, exact=exact, use_bf16=use_bf16,
             streaming=streaming,
         )
         shard = jax.lax.axis_index(axis)
         gidx = idx + shard * local_n
+        # sentinel at the source: a near-empty shard pads its list with
+        # -inf entries whose per-shard indices are arbitrary — mask them
+        # to -1 BEFORE they cross the interconnect, so no consumer can
+        # resolve a padding slot into an id
+        gidx = jnp.where(jnp.isfinite(vals), gidx, -1)
         # (S, Q, local_k) partials on every chip, then merged identically
         vals_all = jax.lax.all_gather(vals, axis)
         idx_all = jax.lax.all_gather(gidx, axis)
-        return merge_topk(vals_all, idx_all, min(k, local_k * n_shards))
+        return merge_topk(vals_all, idx_all, min(k, lk * n_shards))
 
     return shard_map_compat(
         shard_fn,
@@ -82,16 +138,104 @@ def _sharded_search(
     )(queries, corpus, valid)
 
 
+@functools.partial(
+    jax.jit,
+    static_argnames=("k", "n_probe", "axis", "mesh_static", "has_residual"),
+)
+def _sharded_ivf_topk(
+    queries: jax.Array,        # (B, D) L2-normalized, replicated
+    centroids: jax.Array,      # (K, D) replicated
+    blocks: jax.Array,         # (S, K, Cmax, D) sharded on S
+    counts: jax.Array,         # (S, K) sharded
+    slotmap: jax.Array,        # (S, K, Cmax) GLOBAL slots, sharded
+    residual: jax.Array,       # (S, Rmax, D) sharded (dummy when absent)
+    residual_slots: jax.Array,  # (S, Rmax) sharded (dummy when absent)
+    k: int,
+    n_probe: int,
+    axis: str,
+    mesh_static: Mesh,
+    has_residual: bool,
+):
+    """Fused sharded IVF: replicated centroid probe → per-shard block
+    gather + bf16 scoring → per-shard residual scan → local top-k over
+    GLOBAL slots → all_gather merge.  One device dispatch per batch, same
+    wire format ((vals, global_slot) pairs) as the dense sharded path."""
+
+    def shard_fn(q, cent, blk, cnt, smap, res, rslots):
+        blk, cnt, smap = blk[0], cnt[0], smap[0]
+        cmax = blk.shape[1]
+        cscores = dot_scores(q, cent)                 # (B, K), replicated
+        _, probes = jax.lax.top_k(cscores, n_probe)    # (B, P) same on all
+        gathered = blk[probes]                         # (B, P, Cmax, D)
+        scores = jnp.einsum(
+            "bd,bpcd->bpc",
+            q.astype(jnp.bfloat16),
+            gathered.astype(jnp.bfloat16),
+            preferred_element_type=jnp.float32,
+        )
+        live = jnp.arange(cmax)[None, None, :] < cnt[probes][:, :, None]
+        scores = jnp.where(live, scores, -jnp.inf)
+        cand = smap[probes]                            # (B, P, Cmax)
+        b = scores.shape[0]
+        flat_v = scores.reshape(b, -1)
+        flat_s = cand.reshape(b, -1)
+        if has_residual:
+            r, rs = res[0], rslots[0]
+            rscores = dot_scores(q, r)
+            rscores = jnp.where((rs >= 0)[None, :], rscores, -jnp.inf)
+            flat_v = jnp.concatenate([flat_v, rscores], axis=1)
+            flat_s = jnp.concatenate(
+                [flat_s, jnp.broadcast_to(rs[None, :], rscores.shape)],
+                axis=1,
+            )
+        kk = min(k, flat_v.shape[1])
+        vals, pos = jax.lax.top_k(flat_v, kk)
+        slots_top = jnp.take_along_axis(flat_s, pos, axis=1)
+        vals_all = jax.lax.all_gather(vals, axis)
+        slots_all = jax.lax.all_gather(slots_top, axis)
+        n_shards = mesh_static.shape[axis]
+        return merge_topk(vals_all, slots_all, min(k, kk * n_shards))
+
+    rspec = P(axis) if has_residual else P()
+    return shard_map_compat(
+        shard_fn,
+        mesh=mesh_static,
+        in_specs=(P(), P(), P(axis), P(axis), P(axis), rspec, rspec),
+        out_specs=(P(), P()),
+    )(queries, centroids, blocks, counts, slotmap, residual, residual_slots)
+
+
+@dataclass
+class ShardStats:
+    """Mesh-serving accounting for one ShardedCorpus (stats()["shard"],
+    /admin/stats, and the nornicdb_shard_* metric families)."""
+
+    dispatches: int = 0          # fused dense dispatches (1 per batch)
+    ivf_dispatches: int = 0      # fused IVF dispatches (1 per batch)
+    rebalances: int = 0          # grow/compact/recovery full re-shards
+    local_k_overflows: int = 0   # approx merges saturated by one shard
+    promotions: int = 0          # auto single-device -> sharded swaps
+    last_dispatch_s: float = 0.0
+    last_merge_s: float = 0.0
+    rows_per_shard: list = field(default_factory=list)
+
+    def as_dict(self) -> dict:
+        return asdict(self)
+
+
 class ShardedCorpus(HostCorpus):
     """Mesh-sharded, device-resident embedding corpus.
 
     Host keeps the (ids, vectors) truth (HostCorpus); the device copy is a
-    padded (Np, D) matrix laid out P("data", None) across the mesh, with rows
-    aligned to lcm(128, n_shards) so every shard stays lane-aligned.
+    padded (Np, D) matrix laid out P("data", None) across the mesh, with
+    rows aligned to 128 * n_shards so every shard stays lane-aligned.
 
     Mirrors gpu.EmbeddingIndex semantics (Add/Remove/Search, dirty-tracking
     resync — /root/reference/pkg/gpu/gpu.go:1224-1619) but the buffer spans
-    every chip on the mesh instead of one GPU.
+    every chip on the mesh instead of one GPU.  Grow/compact remap the
+    shard boundaries (every shard's slice changes), which the sync driver
+    serves as one full re-shard upload — counted as a rebalance; steady-
+    state writes keep PR 2's incremental per-shard patching.
     """
 
     def __init__(
@@ -125,6 +269,18 @@ class ShardedCorpus(HostCorpus):
         self._dev_valid = None
         self._sharding = NamedSharding(self.mesh, P(self.axis, None))
         self._vsharding = NamedSharding(self.mesh, P(self.axis))
+        self._repsharding = NamedSharding(self.mesh, P())
+        self.shard_stats = ShardStats()
+        # sharded IVF layout (ops.ivf.ShardedIVFLayout) + the recovery
+        # contract fields HostCorpus._on_backend_recovered drives
+        self._sivf = None
+        self._pending_clusters: Optional[tuple] = None
+        self._last_fit_host: Optional[tuple] = None
+
+    @property
+    def local_n(self) -> int:
+        """Rows resident per shard (capacity / n_shards; lane-aligned)."""
+        return self.capacity // self.n_shards
 
     # -- device sync -------------------------------------------------------
     # The generic HostCorpus._sync driver (dirty-block coalescing, deferred
@@ -142,6 +298,7 @@ class ShardedCorpus(HostCorpus):
             jnp.asarray(self._valid),  # nornlint: disable=NL-DEV01
             self._vsharding,
         )
+        self._update_shard_rows()
 
     def _apply_patch(
         self, start_row: int, rows: np.ndarray, valid_rows: np.ndarray,
@@ -152,22 +309,303 @@ class ShardedCorpus(HostCorpus):
         overlaps; device_put re-pins the P(axis, None) layout (a no-op when
         GSPMD already kept it, which it does for update-slice)."""
         # NL-DEV01 suppressions: warm patches under _sync_lock by design
-        # (same rationale as _upload_full above)
+        # (same rationale as _upload_full above).
+        # Dispatch lock: GSPMD lowers a dynamic_update_slice whose start
+        # falls on the PARTITIONED dim to an all_gather + update + reslice,
+        # so the patch is itself a collective program — it must not race a
+        # search dispatch (observed pool-starvation deadlock on the CPU
+        # mesh: the patch's rendezvous and a search's rendezvous each held
+        # half the device threads). Order is always _sync_lock -> dispatch
+        # lock; the dispatch lock is a leaf.
         start = np.int32(start_row)
-        patch = _patch_rows_donated if donate else _patch_rows
-        self._dev = jax.device_put(  # nornlint: disable=NL-DEV01
-            patch(self._dev,
-                  jnp.asarray(rows, dtype=self.dtype),  # nornlint: disable=NL-DEV01
-                  start),
-            self._sharding,
+        with _COLLECTIVE_DISPATCH_LOCK:
+            patch = _patch_rows_donated if donate else _patch_rows
+            self._dev = jax.device_put(  # nornlint: disable=NL-DEV01
+                patch(self._dev,
+                      jnp.asarray(rows, dtype=self.dtype),  # nornlint: disable=NL-DEV01
+                      start),
+                self._sharding,
+            )
+            vpatch = _patch_valid_donated if donate else _patch_valid
+            self._dev_valid = jax.device_put(  # nornlint: disable=NL-DEV01
+                vpatch(self._dev_valid,
+                       jnp.asarray(valid_rows),  # nornlint: disable=NL-DEV01
+                       start),
+                self._vsharding,
+            )
+            # retire BOTH patches before releasing: the valid-mask patch is
+            # its own collective program enqueued after the row patch — an
+            # async collective still enqueueing while a search launches
+            # reintroduces the race
+            self._dev.block_until_ready()  # nornlint: disable=NL-LK02
+            self._dev_valid.block_until_ready()  # nornlint: disable=NL-LK02
+
+    # -- shard lifecycle ---------------------------------------------------
+    def _note_rebalance(self, reason: str) -> None:
+        self.shard_stats.rebalances += 1
+        _SHARD_REBALANCES.inc()
+        logger.info("sharded corpus rebalance (%s): capacity=%d shards=%d",
+                    reason, self.capacity, self.n_shards)
+
+    def _grow(self, min_capacity: int = 0) -> None:
+        # capacity change moves every shard boundary: the next sync is a
+        # full re-shard upload (re-pinned NamedSharding), and any fitted
+        # per-shard inverted lists describe the old boundaries
+        super()._grow(min_capacity)
+        self.clear_clusters()
+        self._note_rebalance("grow")
+
+    def _compact(self) -> None:
+        # compaction remaps slots across shard boundaries (live rows pack
+        # to the front): full re-shard, stale layouts dropped
+        super()._compact()
+        self.clear_clusters()
+        self._note_rebalance("compact")
+
+    def _on_backend_recovered(self, mode: str) -> None:
+        """Recovery re-upload goes through the same per-shard path: "full"
+        drops the mesh-resident buffers and the next sync re-shards the
+        whole corpus (counted as a rebalance); "dirty" trusts surviving
+        shard buffers and patches only degraded-era blocks."""
+        had_dev = self._dev is not None
+        super()._on_backend_recovered(mode)
+        if mode != "dirty" and had_dev:
+            self._note_rebalance("recovery")
+
+    def _on_backend_ready(self) -> None:
+        """Post-recovery: wake the uploader (base) and re-install any
+        cluster fit stashed while degraded — on a throwaway thread, never
+        the manager's probe thread (same rationale as DeviceCorpus)."""
+        super()._on_backend_ready()
+        with self._sync_lock:
+            pending, self._pending_clusters = self._pending_clusters, None
+            if pending is None and self._sivf is None:
+                # a degraded-era rebalance (grow/compact) ran
+                # clear_clusters(), dropping the stash with the layout;
+                # the id-based host copy survives slot remaps — reinstall
+                # it rather than serving full sharded scans until the next
+                # periodic recluster (the set_clusters stash contract)
+                pending = self._last_fit_host
+        if pending is None:
+            return
+
+        def _install() -> None:
+            try:
+                self.set_clusters(pending[0], pending[1])
+            except Exception:
+                logger.exception(
+                    "post-recovery sharded cluster install failed"
+                )
+
+        threading.Thread(
+            target=_install, name="nornicdb-shard-cluster-reinstall",
+            daemon=True,
+        ).start()
+
+    def _update_shard_rows(self) -> list[int]:
+        """Per-shard live-row counts -> stats + the shard gauge. Called
+        under _sync_lock (full upload) and lock-free from stats(): the
+        mask scan is O(capacity), and a /metrics scrape must not stall
+        searches/writes queued on _sync_lock for it. The single ref read
+        is atomic and in-place bit flips only skew counts by in-flight
+        writes — stats-grade accuracy."""
+        valid = self._valid
+        per = valid.reshape(self.n_shards, -1).sum(axis=1)
+        rows = [int(x) for x in per]
+        self.shard_stats.rows_per_shard = rows
+        for s, n in enumerate(rows):
+            _SHARD_ROWS_GAUGE.labels(str(s)).set(float(n))
+        return rows
+
+    def stats(self) -> dict:
+        out = super().stats()
+        rows = self._update_shard_rows()
+        shard = self.shard_stats.as_dict()
+        shard.update(
+            n_shards=self.n_shards,
+            local_n=self.local_n,
+            rows_per_shard=rows,
+            ivf_fitted=self._sivf is not None,
         )
-        vpatch = _patch_valid_donated if donate else _patch_valid
-        self._dev_valid = jax.device_put(  # nornlint: disable=NL-DEV01
-            vpatch(self._dev_valid,
-                   jnp.asarray(valid_rows),  # nornlint: disable=NL-DEV01
-                   start),
-            self._vsharding,
+        out["shard"] = shard
+        return out
+
+    # -- IVF under sharding ------------------------------------------------
+    def clear_clusters(self) -> None:
+        self._sivf = None
+        self._layout_slots = None
+        self._pending_clusters = None
+
+    def cluster(self, k: int = 0, iters: int = 10, seed: int = 0) -> int:
+        """Fit k-means over live rows and install the per-shard inverted
+        lists.  Same optimistic-install dance as DeviceCorpus.cluster: the
+        fit and the layout build (device transfers included) run OUTSIDE
+        _sync_lock; a layout-epoch change during either voids the
+        install."""
+        from nornicdb_tpu.ops.kmeans import kmeans_fit
+
+        if not self._device_gate():
+            return 0  # degraded: pruning is a device-path optimization
+        with self._sync_lock:
+            live = [i for i, id_ in enumerate(self._ids) if id_ is not None]
+            if len(live) < 2:
+                return 0
+            data = self._host[live]  # fancy indexing copies: snapshot
+            epoch_at_read = self._layout_epoch
+            mask = np.zeros(self.capacity, bool)
+            mask[live] = True
+            if (
+                self._layout_slots is not None
+                and self._layout_slots.size == self.capacity
+            ):
+                mask |= self._layout_slots
+            self._layout_slots = mask
+        res = kmeans_fit(data, k=k, iters=iters, seed=seed)
+        with self._sync_lock:
+            if self._layout_epoch != epoch_at_read:
+                return 0  # slot space moved mid-fit: caller may recluster
+            # id-based host copy: full-mode recovery re-installs from this
+            self._last_fit_host = (
+                np.asarray(res.centroids, np.float32),
+                {
+                    self._ids[slot]: int(res.assignments[row])
+                    for row, slot in enumerate(live)
+                    if slot < len(self._ids) and self._ids[slot] is not None
+                },
+            )
+        self._install_sharded_layout(
+            np.asarray(live), res.assignments,
+            np.asarray(res.centroids, np.float32),
+            expect_epoch=epoch_at_read,
         )
+        return res.k
+
+    def set_clusters(
+        self, centroids: np.ndarray, assignments_by_id: dict[str, int]
+    ) -> None:
+        """Install externally computed clusters (the search service's fit)
+        as per-shard inverted lists.  Degraded backends stash the fit and
+        install it on recovery (_on_backend_ready) — full scan keeps
+        serving meanwhile."""
+        if not self._device_ok_nowait():
+            with self._sync_lock:
+                self._pending_clusters = (
+                    np.asarray(centroids, np.float32),
+                    dict(assignments_by_id),
+                )
+                self._last_fit_host = self._pending_clusters
+            return
+        fit_host = (np.asarray(centroids, np.float32),
+                    dict(assignments_by_id))
+        with self._sync_lock:
+            self._last_fit_host = fit_host
+            slot_assignments = np.full(self.capacity, -1, np.int32)
+            for id_, c in assignments_by_id.items():
+                slot = self._slot_of.get(id_)
+                if slot is not None:
+                    slot_assignments[slot] = c
+            # the old layout describes the replaced clustering — drop it
+            # even when no live rows match; a stashed degraded-era fit is
+            # superseded too
+            self._sivf = None
+            self._layout_slots = None
+            self._pending_clusters = None
+            live = np.nonzero((slot_assignments >= 0) & self._valid)[0]
+            epoch_at_read = self._layout_epoch
+        if live.size:
+            self._install_sharded_layout(
+                live, slot_assignments[live],
+                np.asarray(centroids, np.float32),
+                expect_epoch=epoch_at_read,
+            )
+
+    def _install_sharded_layout(
+        self,
+        live_slots: np.ndarray,
+        live_assignments: np.ndarray,
+        centroids: np.ndarray,
+        expect_epoch: Optional[int] = None,
+    ) -> None:
+        """Build + optimistically install the per-shard IVF layout.  The
+        build (H2D transfers included) runs OUTSIDE the lock (NL-DEV01);
+        the snapshot pins the layout epoch and the install is skipped if
+        the epoch moved (the widened _layout_slots mask makes covered-row
+        overwrites bump it, same contract as DeviceCorpus)."""
+        from nornicdb_tpu.ops.ivf import build_sharded_ivf_layout
+
+        with self._sync_lock:
+            if expect_epoch is not None and self._layout_epoch != expect_epoch:
+                return
+            epoch_at_read = self._layout_epoch
+            rows = self._host[live_slots]  # fancy indexing copies: snapshot
+            mask = np.zeros(self.capacity, bool)
+            mask[live_slots] = True
+            self._layout_slots = mask
+        layout = build_sharded_ivf_layout(
+            rows, live_slots.astype(np.int32),
+            np.asarray(live_assignments, np.int32), centroids,
+            n_shards=self.n_shards, local_n=self.local_n,
+            shard_sharding=self._vsharding,
+            replicated_sharding=self._repsharding,
+            dtype=self.dtype, epoch=epoch_at_read,
+        )
+        with self._sync_lock:
+            if self._layout_epoch != epoch_at_read:
+                return  # mutated mid-build: discard the stale layout
+            self._sivf = layout
+
+    def _pruned_search(
+        self, q: np.ndarray, k: int, min_similarity: float, n_probe: int,
+    ) -> Optional[list[list[tuple[str, float]]]]:
+        """Fused sharded IVF path; None when no valid layout is installed
+        (caller falls back to the full sharded scan — recall unaffected)."""
+        with self._sync_lock:
+            # a pending compaction would remap slots out from under the
+            # layout's epoch check — run the sync first, like the dense path
+            self._sync()
+            ids = self._ids
+            layout = self._sivf
+            layout_ok = (
+                layout is not None and layout.epoch == self._layout_epoch
+            )
+        if not layout_ok:
+            return None
+        b = q.shape[0]
+        b_pad = _next_pow2(b)
+        q2 = q
+        if b_pad != b:
+            q2 = np.concatenate(
+                [q, np.zeros((b_pad - b, q.shape[1]), np.float32)]
+            )
+        k_prog = _next_pow2(max(k, 8))
+        qn = l2_normalize(jnp.asarray(q2, dtype=self.dtype))
+        n_probe = max(1, min(n_probe, layout.k))
+        has_res = layout.residual is not None
+        dummy = jnp.zeros((1, 1), self.dtype)
+        dummy_i = jnp.zeros((1, 1), jnp.int32)
+        t0 = time.perf_counter()
+        with _COLLECTIVE_DISPATCH_LOCK:
+            vals, slots = _sharded_ivf_topk(
+                qn, layout.centroids, layout.blocks, layout.counts,
+                layout.slotmap,
+                layout.residual if has_res else dummy,
+                layout.residual_slots if has_res else dummy_i,
+                k=k_prog, n_probe=n_probe, axis=self.axis,
+                mesh_static=self.mesh, has_residual=has_res,
+            )
+            vals_np = np.asarray(vals, np.float32)[:b, :k]
+            slots_np = np.asarray(slots)[:b, :k]
+        t1 = time.perf_counter()
+        self.shard_stats.ivf_dispatches += 1
+        self.shard_stats.last_dispatch_s = t1 - t0
+        _SHARDED_SEARCH_HIST.observe(t1 - t0)
+        out = self._format_results(
+            vals_np, slots_np, b, k, min_similarity, ids=ids,
+        )
+        merge_s = time.perf_counter() - t1
+        self.shard_stats.last_merge_s = merge_s
+        _SHARDED_MERGE_HIST.observe(merge_s)
+        return out
 
     # -- search ------------------------------------------------------------
     def search(
@@ -176,12 +614,19 @@ class ShardedCorpus(HostCorpus):
         k: int,
         min_similarity: float = -1.0,
         exact: bool = False,
+        n_probe: int = 0,
         streaming: Optional[bool] = None,
+        local_k: int = 0,
     ) -> list[list[tuple[str, float]]]:
-        """Sharded cosine top-k: per-shard GEMM + top-k, ICI all-gather merge.
-        Scores are exact; with the default exact=False per-shard candidate
-        membership uses approx_max_k or the streaming Pallas kernel
-        (recall ~0.95+); exact=True gives recall 1.0."""
+        """Sharded cosine top-k: per-shard GEMM + top-local_k, ICI
+        all-gather merge — one device dispatch for the whole (possibly
+        batched) query block.  Scores are exact; with the default
+        exact=False per-shard candidate membership uses approx_max_k or
+        the streaming Pallas kernel (recall ~0.95+, tunable via local_k
+        oversampling); exact=True gives recall 1.0 with tie-breaking
+        identical to the single-device full scan.  n_probe > 0 with a
+        fitted cluster index routes through the fused sharded IVF
+        program instead."""
         q = np.atleast_2d(np.asarray(queries, np.float32))
         if len(self._slot_of) == 0:
             return [[] for _ in range(q.shape[0])]
@@ -190,18 +635,93 @@ class ShardedCorpus(HostCorpus):
         if not self._device_gate():
             return self._search_host(q, k, min_similarity)
         try:
-            with self._borrow_device() as (dev, dev_valid, _i8, ids, _):
-                qd = l2_normalize(jnp.asarray(q, dtype=self.dtype))
-                vals, idx = _sharded_search(
-                    qd, dev, dev_valid, min(k, self.capacity),
-                    self.axis, self.mesh, exact=exact, streaming=streaming,
+            if n_probe > 0:
+                pruned = self._pruned_search(q, k, min_similarity, n_probe)
+                if pruned is not None:
+                    return pruned
+            b = q.shape[0]
+            # power-of-two shape classes for batch, k, and local_k: the
+            # program is shape-keyed jit over a collective, and the
+            # QueryBatcher hands us every coalesced batch size from
+            # 1..batch_max — without padding each one compiles a fresh
+            # XLA program on the serving hot path (same rationale and
+            # scheme as _pruned_search).  Padding lk upward only widens
+            # each shard's contribution, so exact mode stays lossless
+            # (lk >= min(k, local_n) still holds) and approx recall can
+            # only improve; padded query rows are zeros, sliced off the
+            # result before formatting.
+            b_pad = _next_pow2(b)
+            q2 = q
+            if b_pad != b:
+                q2 = np.concatenate(
+                    [q, np.zeros((b_pad - b, q.shape[1]), np.float32)]
                 )
-                # materialize inside the borrow so the patcher can't donate
-                # the buffers this program is still reading
-                vals_np = np.asarray(vals, np.float32)
-                idx_np = np.asarray(idx)
+            with self._borrow_device() as (dev, dev_valid, _i8, ids, _):
+                # shard geometry comes from the BORROWED buffer, not self:
+                # _borrow_device's sync may have just grown/re-sharded the
+                # corpus (and a concurrent grow can rebind self._dev
+                # again mid-search) — lk sized off a stale local_n would
+                # silently cut exact-mode candidates on the new shards,
+                # and overflow attribution would divide by the wrong width
+                cap = int(dev.shape[0])
+                local_n = cap // self.n_shards
+                k_prog = min(_next_pow2(max(k, 8)), cap)
+                lk = max(1, min(_next_pow2(max(k, local_k, 8)), local_n))
+                qd = l2_normalize(jnp.asarray(q2, dtype=self.dtype))
+                t0 = time.perf_counter()
+                with _COLLECTIVE_DISPATCH_LOCK:
+                    vals, idx = _sharded_search(
+                        qd, dev, dev_valid, k_prog, lk,
+                        self.axis, self.mesh, exact=exact,
+                        streaming=streaming,
+                    )
+                    # materialize inside the borrow so the patcher can't
+                    # donate the buffers this program is still reading (and
+                    # inside the dispatch lock so the collective retires
+                    # before another program may enqueue)
+                    vals_np = np.asarray(vals, np.float32)[:b]
+                    idx_np = np.asarray(idx)[:b]
+                t1 = time.perf_counter()
         except DeviceUnavailable:
             return self._search_host(q, k, min_similarity)
-        return self._format_results(
-            vals_np, idx_np, q.shape[0], k, min_similarity, ids=ids,
+        self.shard_stats.dispatches += 1
+        self.shard_stats.last_dispatch_s = t1 - t0
+        _SHARDED_SEARCH_HIST.observe(t1 - t0)
+        if not exact and lk < local_n:
+            # detect saturation on the UNSLICED merged width: a shard
+            # contributing all lk of its oversampled candidates is the
+            # truncation signal, regardless of the caller's k
+            self._note_local_k_overflows(idx_np, lk, local_n)
+        out = self._format_results(
+            vals_np[:, :k], idx_np[:, :k], q.shape[0], k, min_similarity,
+            ids=ids,
         )
+        merge_s = time.perf_counter() - t1
+        self.shard_stats.last_merge_s = merge_s
+        _SHARDED_MERGE_HIST.observe(merge_s)
+        return out
+
+    def _note_local_k_overflows(
+        self, idx: np.ndarray, lk: int, local_n: int
+    ) -> None:
+        """Count merged results where a single shard saturated its
+        local_k contribution: in approx mode that shard's bin-reduce list
+        was truncated exactly where real candidates may have been cut, so
+        the operator signal is "raise SearchConfig.local_k"."""
+        # a shard can contribute at most the merged width idx.shape[1]
+        # (k_prog) entries — with local_k oversampled past that, `>= lk`
+        # would be unreachable and the counter would read 0 forever,
+        # silencing the exact signal the knob is tuned by. Saturating the
+        # whole merged output is the strongest observable truncation sign.
+        sat = min(lk, idx.shape[1])
+        hits = 0
+        for qi in range(idx.shape[0]):
+            live = idx[qi][idx[qi] >= 0]
+            if live.size == 0:
+                continue
+            per_shard = np.bincount(live // local_n, minlength=self.n_shards)
+            if int(per_shard.max()) >= sat:
+                hits += 1
+        if hits:
+            self.shard_stats.local_k_overflows += hits
+            _SHARD_LOCALK_OVERFLOWS.inc(hits)

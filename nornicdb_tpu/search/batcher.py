@@ -36,6 +36,14 @@ _DEVICE_HIST = _REGISTRY.histogram(
     "nornicdb_search_device_seconds",
     "Device dispatch time per search batch",
 )
+# observed coalesced batch sizes: the distribution (not just max/avg) is
+# what batch_window tuning needs — a bimodal histogram means the window is
+# too short for the arrival pattern
+_BATCH_SIZE_HIST = _REGISTRY.histogram(
+    "nornicdb_search_batch_size",
+    "Queries coalesced per batched device dispatch",
+    buckets=(1, 2, 4, 8, 16, 32, 64, 128, 256),
+)
 
 
 @dataclass
@@ -147,6 +155,7 @@ class QueryBatcher:
                 ):
                     results = self.search_batch_fn(queries, k, min_sim)
             _DEVICE_HIST.observe(time.perf_counter() - t_dispatch)
+            _BATCH_SIZE_HIST.observe(len(pending))
             with self._lock:
                 self.stats.queries += len(pending)
                 self.stats.batches += 1

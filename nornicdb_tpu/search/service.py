@@ -65,7 +65,21 @@ class SearchConfig:
     mmr_enabled: bool = False
     mmr_lambda: float = 0.7
     candidates_multiplier: int = 4  # fetch k*mult candidates per modality
-    backend: str = "auto"  # auto | tpu | sharded | hnsw
+    # auto | tpu | sharded | hnsw.  "sharded" pins the mesh path from the
+    # start; "auto" starts single-device and promotes to the sharded path
+    # once the corpus crosses sharded_min_rows on a >1-device mesh
+    # (docs/operations.md "Sharded serving tuning")
+    backend: str = "auto"
+    # auto-promotion threshold: rows at which one chip's HBM stops being
+    # the right home for the corpus.  0 disables promotion.
+    sharded_min_rows: int = 100_000
+    # exact=True full-sorts per shard/device (recall 1.0, slower);
+    # the default approx membership honors the ~0.95 recall contract
+    exact: bool = False
+    # per-shard candidate count for the sharded merge (0 = k). Raising it
+    # above k oversamples each shard's approx top-k — the recall knob the
+    # shard_local_k_overflows metric tunes.
+    local_k: int = 0
     # cross-encoder second stage (ref: applyCrossEncoderRerank search.go:1639,
     # feature-flag-gated like the reference)
     rerank_enabled: bool = False
@@ -84,6 +98,49 @@ class SearchConfig:
     write_behind_interval: float = 0.002
 
 
+# -- default-config layering -------------------------------------------------
+# `cli serve` installs the operator's AppConfig.search section here before
+# any SearchService exists; embedded processes (tests, workers, notebooks)
+# can set the same knobs via NORNICDB_SEARCH_<FIELD> env vars, read once per
+# service construction. Precedence: explicit SearchService(config=...) >
+# configure_defaults() > env > dataclass defaults.
+_DEFAULTS_LOCK = threading.Lock()
+_CONFIG_DEFAULTS: dict[str, Any] = {}
+
+
+def configure_defaults(**kwargs) -> None:
+    """Set process-wide SearchConfig defaults (unknown keys rejected)."""
+    from dataclasses import fields as _fields
+
+    known = {f.name for f in _fields(SearchConfig)}
+    bad = set(kwargs) - known
+    if bad:
+        raise ValueError(f"unknown SearchConfig field(s): {sorted(bad)}")
+    with _DEFAULTS_LOCK:
+        _CONFIG_DEFAULTS.update(kwargs)
+
+
+def default_search_config() -> SearchConfig:
+    from dataclasses import fields as _fields
+    import os
+
+    from nornicdb_tpu.config import _coerce_env
+
+    cfg = SearchConfig()
+    for f in _fields(SearchConfig):
+        raw = os.environ.get(f"NORNICDB_SEARCH_{f.name.upper()}")
+        if raw is None:
+            continue
+        # same coercion rules as AppConfig's load_from_env, so the same
+        # env value parses identically in served and embedded processes
+        setattr(cfg, f.name, _coerce_env(getattr(cfg, f.name), raw))
+    with _DEFAULTS_LOCK:
+        overrides = dict(_CONFIG_DEFAULTS)
+    for name, value in overrides.items():
+        setattr(cfg, name, value)
+    return cfg
+
+
 class SearchService:
     """(ref: search.Service pkg/search/search.go:236)"""
 
@@ -98,7 +155,7 @@ class SearchService:
     ):
         self.storage = storage
         self.embedder = embedder
-        self.config = config or SearchConfig()
+        self.config = config or default_search_config()
         self.stats = SearchStats()
         self.vectorspaces = vectorspaces
         self._lock = threading.RLock()
@@ -128,6 +185,10 @@ class SearchService:
         )
         self._rank_cache_max = 2048
         self._rank_cache_ttl = 30.0
+        # backend="auto" shard promotion: None = not attempted, "running",
+        # "done", "unavailable" (single device / promotion disabled)
+        self._promo_state: Optional[str] = None
+        self._promo_retry_at = 0.0
 
     # -- index plumbing ----------------------------------------------------
     def _ensure_vector_index(self, dims: int) -> None:
@@ -146,11 +207,19 @@ class SearchService:
             # degraded backend cannot enumerate mesh devices — serve on
             # a single-device corpus (itself host-backed while degraded)
             # instead of refusing to index; recovery re-uploads it.
+            import jax.numpy as jnp
+
             from nornicdb_tpu.errors import DeviceUnavailable
             from nornicdb_tpu.parallel import ShardedCorpus
 
             try:
-                corpus = ShardedCorpus(dims=dims)
+                # f32 storage, NOT ShardedCorpus's bf16 default: the
+                # serving contract (docs/operations.md) is that exact
+                # mode returns ids/scores identical to the single-device
+                # DeviceCorpus full scan, and DeviceCorpus stores f32.
+                # bf16 sharding stays an explicit opt-in for direct
+                # constructor callers chasing peak MXU FLOP/s.
+                corpus = ShardedCorpus(dims=dims, dtype=jnp.float32)
             except DeviceUnavailable:
                 logger.warning(
                     "backend degraded: sharded corpus unavailable, "
@@ -220,6 +289,10 @@ class SearchService:
                 if self._hnsw is not None:
                     self._hnsw.remove(node.id)
             self.stats.indexed += 1
+        # OUTSIDE the lock (mesh enumeration is a cold backend
+        # acquisition): promote to the sharded mesh path once the corpus
+        # outgrows one chip (backend="auto", docs/operations.md)
+        self._maybe_promote_sharded()
 
     def remove_node(self, node_id: str) -> None:
         with self._lock:
@@ -242,16 +315,171 @@ class SearchService:
             n += 1
         return n
 
+    # -- shard promotion ---------------------------------------------------
+    def _maybe_promote_sharded(self) -> None:
+        """backend="auto": once the corpus crosses sharded_min_rows, swap
+        the single-device corpus for a mesh-sharded one on a background
+        thread.  Must be called with NO lock held (the thread it spawns
+        enumerates mesh devices — a cold backend acquisition)."""
+        cfg = self.config
+        if cfg.backend != "auto" or cfg.sharded_min_rows <= 0:
+            return
+        with self._lock:
+            corpus = self._corpus
+            if (
+                corpus is None
+                or hasattr(corpus, "n_shards")  # already sharded
+                or self._promo_state in ("running", "done", "unavailable")
+                or len(corpus) < cfg.sharded_min_rows
+                or time.monotonic() < self._promo_retry_at
+            ):
+                return
+            self._promo_state = "running"
+        threading.Thread(
+            target=self._promote_sharded, name="nornicdb-shard-promote",
+            daemon=True,
+        ).start()
+
+    def _promote_sharded(self) -> None:
+        from nornicdb_tpu.errors import DeviceUnavailable
+
+        try:
+            from nornicdb_tpu.parallel import ShardedCorpus, can_shard
+
+            if not can_shard():
+                with self._lock:
+                    self._promo_state = "unavailable"
+                logger.info(
+                    "sharded promotion skipped: single-device backend"
+                )
+                return
+            # carry the single-device corpus's storage dtype (f32 by
+            # default) so the promotion swap never changes scoring:
+            # exact-mode results must be identical before and after
+            with self._lock:
+                cur = self._corpus
+                cur_dtype = getattr(cur, "dtype", None)
+            if cur_dtype is None:
+                import jax.numpy as jnp
+
+                cur_dtype = jnp.float32
+            sharded = ShardedCorpus(dims=self._dims, dtype=cur_dtype)
+        except DeviceUnavailable:
+            # degraded backend: retry after a cooldown instead of pinning
+            # the corpus to one chip forever
+            with self._lock:
+                self._promo_state = None
+                self._promo_retry_at = time.monotonic() + 60.0
+            logger.warning(
+                "sharded promotion deferred: backend degraded"
+            )
+            return
+        except Exception:
+            with self._lock:
+                self._promo_state = "unavailable"
+            logger.exception("sharded promotion failed")
+            return
+        # bulk-load from a snapshot, then replay the (bounded) diff and
+        # swap under the service lock — writers queue only for the diff.
+        # Any failure here must reset _promo_state: leaving it "running"
+        # would permanently block every future promotion attempt.
+        try:
+            with self._lock:
+                snap = dict(self._vectors)
+            if snap:
+                sharded.add_batch(list(snap.keys()),
+                                  np.stack(list(snap.values())))
+            with self._lock:
+                cur = self._vectors
+                for id_, v in cur.items():
+                    # index_node stores a NEW array object on every real
+                    # change, so identity inequality == changed-since-snapshot
+                    if snap.get(id_) is not v:
+                        sharded.add(id_, v)
+                for id_ in snap:
+                    if id_ not in cur:
+                        sharded.remove(id_)
+                old, self._corpus = self._corpus, sharded
+                self._generation += 1  # cached rankings die with the old corpus
+                if self.config.write_behind:
+                    sharded.start_uploader(self.config.write_behind_interval)
+                sharded.shard_stats.promotions += 1
+                self._promo_state = "done"
+        except DeviceUnavailable:
+            with self._lock:
+                self._promo_state = None
+                self._promo_retry_at = time.monotonic() + 60.0
+            logger.warning("sharded promotion deferred: backend degraded")
+            return
+        except Exception:
+            with self._lock:
+                self._promo_state = "unavailable"
+            logger.exception("sharded promotion failed")
+            return
+        if old is not None and hasattr(old, "stop_uploader"):
+            old.stop_uploader()
+        # carry the installed cluster fit across the swap: without it the
+        # sharded corpus has no inverted lists and every n_probe search
+        # silently full-scans until the next embed-triggered recluster —
+        # on a read-heavy workload, indefinitely, exactly at the corpus
+        # size where pruning matters. set_clusters runs OUTSIDE the
+        # service lock (device transfers) and stashes itself if the
+        # backend degraded mid-promotion.
+        with self._lock:
+            res = self.cluster_result
+            assignments = dict(self.cluster_assignments)
+        if res is not None and assignments:
+            try:
+                sharded.set_clusters(
+                    np.asarray(res.centroids, np.float32), assignments
+                )
+            except Exception:
+                logger.exception(
+                    "cluster fit carry-over failed after sharded promotion"
+                )
+        logger.info(
+            "search corpus promoted to mesh-sharded serving "
+            "(%d rows, %d shards)", len(sharded), sharded.n_shards,
+        )
+
     # -- queries -----------------------------------------------------------
+    def _corpus_search_kwargs(self, corpus) -> dict:
+        """Per-dispatch knobs the config enables for this corpus type:
+        exact full-sort, IVF n_probe (any clustered corpus), per-shard
+        local_k oversampling (sharded only)."""
+        kwargs: dict = {}
+        if self.config.exact:
+            kwargs["exact"] = True
+        if self.config.n_probe > 0 and hasattr(corpus, "cluster"):
+            kwargs["n_probe"] = self.config.n_probe
+        if self.config.local_k > 0 and hasattr(corpus, "n_shards"):
+            kwargs["local_k"] = self.config.local_k
+        return kwargs
+
     def _batched_corpus_search(
         self, queries: np.ndarray, k: int, min_similarity: float
     ) -> list:
-        return self._corpus.search(queries, k=k, min_similarity=min_similarity)
+        """One device dispatch for the whole batch: the corpus search
+        (single-device or mesh-sharded) takes the stacked (B, D) block."""
+        with self._lock:
+            corpus = self._corpus  # promotion may swap it mid-flight
+        return corpus.search(
+            queries, k=k, min_similarity=min_similarity,
+            **self._corpus_search_kwargs(corpus),
+        )
 
     def vector_candidates(
         self, embedding: np.ndarray, k: int = 10, min_similarity: float = -1.0
     ) -> list[tuple[str, float]]:
         """(ref: VectorSearchCandidates search.go:1005)"""
+        if self._promo_state is None:
+            # a promotion deferred while the backend was degraded must be
+            # retryable from the READ path too: on a read-only workload
+            # index_node never runs again, and the corpus would stay
+            # pinned to one chip after recovery. Unlocked read is a
+            # benign race — _maybe_promote_sharded re-checks under _lock
+            # and the cooldown gate keeps the retry cheap.
+            self._maybe_promote_sharded()
         if (
             self.config.batching_enabled
             and self._corpus is not None
@@ -277,9 +505,7 @@ class SearchService:
             self.stats.vector_candidates += 1
             corpus, hnsw = self._corpus, self._hnsw
         if corpus is not None:
-            kwargs = {}
-            if self.config.n_probe > 0 and hasattr(corpus, "cluster"):
-                kwargs["n_probe"] = self.config.n_probe
+            kwargs = self._corpus_search_kwargs(corpus)
             t0 = time.perf_counter()
             with _tracer.span("search.vector"):
                 res = corpus.search(
@@ -310,6 +536,8 @@ class SearchService:
         out: dict = asdict(self.stats)
         with self._lock:
             corpus, batcher = self._corpus, getattr(self, "_batcher", None)
+            if self._promo_state is not None:
+                out["sharded_promotion"] = self._promo_state
         if corpus is not None:
             out["corpus"] = corpus.stats()
             mgr = getattr(corpus, "_backend", None)

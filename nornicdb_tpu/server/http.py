@@ -869,6 +869,12 @@ class HttpServer:
                 "nornicdb_search_removed",
                 "nornicdb_search_vector_candidates",
                 "nornicdb_search_fulltext_candidates",
+                # mesh-sharded serving (ShardedCorpus.shard_stats)
+                "nornicdb_search_corpus_shard_dispatches",
+                "nornicdb_search_corpus_shard_ivf_dispatches",
+                "nornicdb_search_corpus_shard_rebalances",
+                "nornicdb_search_corpus_shard_local_k_overflows",
+                "nornicdb_search_corpus_shard_promotions",
             },
         )
         reg.stats_callback(

@@ -232,10 +232,13 @@ def build_spec(version: str = "0.4.0") -> dict:
         # -- admin -----------------------------------------------------------
         "/admin/stats": {"get": _op(
             "Server statistics: storage, cache, query counters, uptime, "
-            "search/device-sync/adjacency sections, and the `backend` "
-            "section (device lifecycle state PROBING/READY/DEGRADED_CPU/"
-            "RECOVERING, fallbacks_total, recoveries_total, probe latency, "
-            "recent transitions — docs/backend.md)",
+            "search/device-sync/adjacency sections (the search corpus's "
+            "`shard` block reports mesh dispatches, rows per shard, "
+            "rebalances, local_k overflows — docs/operations.md \"Sharded "
+            "serving tuning\"), and the `backend` section (device "
+            "lifecycle state PROBING/READY/DEGRADED_CPU/RECOVERING, "
+            "fallbacks_total, recoveries_total, probe latency, recent "
+            "transitions — docs/backend.md)",
             tag="admin")},
         "/admin/backup": {"post": _op(
             "Write a full backup archive (gzip) server-side; returns the "

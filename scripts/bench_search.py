@@ -1,0 +1,221 @@
+"""Sharded-vs-single-device search benchmark (ISSUE 7 satellite).
+
+Measures p50/p99 single-query latency and batched qps for the two serving
+paths (DeviceCorpus full scan vs ShardedCorpus fused shard_map program) at
+three corpus sizes, in exact, approx, and IVF modes, and writes the
+trajectory artifact ``BENCH_search.json``.
+
+Runs anywhere: with no accelerator it forces the 8-device virtual CPU mesh
+(``XLA_FLAGS=--xla_force_host_platform_device_count=8``), which exercises
+the identical partitioning/collective program XLA emits for a real mesh —
+the numbers are CPU numbers, labeled as such in ``meta.platform``, and the
+trajectory tracks the RELATIVE single-vs-sharded shape over PRs, not
+absolute TPU latency (bench.py owns the headline TPU figure).
+
+stdout stays EMPTY (the round artifact contract reserves it for bench.py's
+JSON lines when driven via ``make bench``); progress goes to stderr and the
+results to the --out file.
+
+Also proves two serving invariants and records them in the artifact:
+  - one fused device dispatch per batched sharded search (dispatch counter
+    delta == 1 for a 64-query batch);
+  - a single-row write after first sync patches per-shard instead of
+    re-uploading the corpus (PR 2's incremental-sync guarantee under
+    sharding).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+# force the virtual mesh BEFORE jax initialises (no-op if the operator
+# already set a device count, e.g. on a real TPU host)
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _REPO not in sys.path:  # runnable without an editable install
+    sys.path.insert(0, _REPO)
+
+import numpy as np  # noqa: E402
+
+
+def log(msg: str) -> None:
+    print(msg, file=sys.stderr, flush=True)
+
+
+def pctl(xs, p):
+    return float(np.percentile(np.asarray(xs, np.float64), p))
+
+
+def recall(got: list, want: list) -> float:
+    ws = {i for i, _ in want}
+    if not ws:
+        return 1.0
+    return len({i for i, _ in got} & ws) / len(ws)
+
+
+def bench_corpus(corpus, queries, k, repeats, batch, kwargs) -> dict:
+    """Warm, then time single-query latency (p50/p99) and batched qps."""
+    corpus.search(queries[0], k=k, **kwargs)  # warm: compile + first sync
+    lat = []
+    for i in range(repeats):
+        q = queries[i % len(queries)]
+        t0 = time.perf_counter()
+        corpus.search(q, k=k, **kwargs)
+        lat.append(time.perf_counter() - t0)
+    qblock = queries[:batch]
+    corpus.search(qblock, k=k, **kwargs)  # warm the batched shape
+    t0 = time.perf_counter()
+    reps = 3
+    for _ in range(reps):
+        corpus.search(qblock, k=k, **kwargs)
+    dt = time.perf_counter() - t0
+    return {
+        "p50_ms": round(pctl(lat, 50) * 1e3, 3),
+        "p99_ms": round(pctl(lat, 99) * 1e3, 3),
+        "qps": round(reps * len(qblock) / dt, 1),
+    }
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--out", default=os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        "BENCH_search.json"))
+    ap.add_argument("--quick", action="store_true",
+                    help="small sizes/repeats for the non-gating CI step")
+    ap.add_argument("--dims", type=int,
+                    default=int(os.environ.get("NORNICDB_BENCH_SEARCH_DIMS",
+                                               "64")))
+    ap.add_argument("--k", type=int, default=100)
+    args = ap.parse_args()
+
+    sizes_env = os.environ.get("NORNICDB_BENCH_SEARCH_SIZES")
+    if sizes_env:
+        sizes = [int(s) for s in sizes_env.split(",")]
+    elif args.quick:
+        sizes = [1024, 4096]
+    else:
+        sizes = [4096, 16384, 65536]
+    repeats = 5 if args.quick else 20
+    batch = 32 if args.quick else 64
+
+    import jax
+    import jax.numpy as jnp
+
+    from nornicdb_tpu.ops.similarity import DeviceCorpus
+    from nornicdb_tpu.parallel import ShardedCorpus, make_mesh
+
+    mesh = make_mesh()
+    n_shards = int(mesh.devices.size)
+    platform = jax.devices()[0].platform
+    log(f"bench_search: platform={platform} shards={n_shards} "
+        f"sizes={sizes} dims={args.dims} k={args.k}")
+
+    rng = np.random.default_rng(7)
+    results = []
+    invariants = {}
+    for n in sizes:
+        data = rng.standard_normal((n, args.dims)).astype(np.float32)
+        ids = [f"v{i}" for i in range(n)]
+        queries = rng.standard_normal((max(batch, 64), args.dims)).astype(
+            np.float32)
+        k = min(args.k, n)
+        dc = DeviceCorpus(dims=args.dims, dtype=jnp.float32)
+        dc.add_batch(ids, data)
+        sc = ShardedCorpus(dims=args.dims, mesh=mesh, dtype=jnp.float32)
+        sc.add_batch(ids, data)
+        # exact reference for recall accounting
+        ref = dc.search(queries[:8], k=k, exact=True)
+        kmeans_k = max(8, int(n ** 0.5) // 4)
+        n_probe = max(2, kmeans_k // 8)
+        dc.cluster(k=kmeans_k, iters=5)
+        sc.cluster(k=kmeans_k, iters=5)
+        for backend, corpus in (("single", dc), ("sharded", sc)):
+            for mode, kwargs in (
+                ("exact", {"exact": True}),
+                ("approx", {}),
+                ("ivf", {"n_probe": n_probe}),
+            ):
+                row = bench_corpus(corpus, queries, k, repeats, batch,
+                                   kwargs)
+                got = corpus.search(queries[:8], k=k, **kwargs)
+                row.update(
+                    backend=backend, mode=mode, rows=n, dims=args.dims,
+                    k=k,
+                    recall_at_k=round(
+                        float(np.mean([recall(g, w)
+                                       for g, w in zip(got, ref)])), 4),
+                )
+                if mode == "ivf":
+                    row["n_probe"] = n_probe
+                    row["kmeans_k"] = kmeans_k
+                results.append(row)
+                log(f"  {backend:7s} {mode:6s} n={n:>7d} "
+                    f"p50={row['p50_ms']}ms p99={row['p99_ms']}ms "
+                    f"qps={row['qps']} recall={row['recall_at_k']}")
+        if n == sizes[-1]:
+            # invariant 1: one fused dispatch per batched sharded search
+            before = sc.shard_stats.dispatches
+            sc.search(queries[:batch], k=k)
+            invariants["dispatches_per_batch"] = (
+                sc.shard_stats.dispatches - before
+            )
+            # invariant 2: a single-row write after first sync patches
+            # per-shard instead of re-uploading the whole corpus (an
+            # overwrite of an existing id — a brand-new id at exactly-full
+            # capacity would legitimately grow, which IS a full re-shard)
+            full_before = sc.sync_stats.full_uploads
+            patch_before = sc.sync_stats.patches
+            sc.add(ids[0], data[1])
+            sc.search(queries[0], k=k)
+            invariants["single_write_patches"] = (
+                sc.sync_stats.patches - patch_before
+            )
+            invariants["single_write_full_uploads"] = (
+                sc.sync_stats.full_uploads - full_before
+            )
+            invariants["shard_stats"] = sc.shard_stats.as_dict()
+
+    out = {
+        "meta": {
+            "platform": platform,
+            "n_shards": n_shards,
+            "dims": args.dims,
+            "k": args.k,
+            "repeats": repeats,
+            "batch": batch,
+            "quick": bool(args.quick),
+            "note": (
+                "virtual CPU mesh when platform=cpu: relative "
+                "single-vs-sharded trajectory, not absolute TPU latency"
+            ),
+        },
+        "invariants": invariants,
+        "results": results,
+    }
+    with open(args.out, "w") as f:
+        json.dump(out, f, indent=1, sort_keys=True)
+        f.write("\n")
+    log(f"bench_search: wrote {args.out} ({len(results)} rows)")
+    ok = (
+        invariants.get("dispatches_per_batch") == 1
+        and invariants.get("single_write_full_uploads") == 0
+        and invariants.get("single_write_patches", 0) >= 1
+    )
+    if not ok:
+        log(f"bench_search: INVARIANT FAILURE {invariants}")
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -37,6 +37,11 @@ class TestMesh:
         with pytest.raises(ValueError):
             make_mesh({"data": 3})
 
+    def test_can_shard_on_virtual_mesh(self):
+        from nornicdb_tpu.parallel import can_shard
+
+        assert can_shard() is True  # conftest forces 8 virtual devices
+
 
 class TestShardedCorpus:
     def test_matches_single_device(self):
