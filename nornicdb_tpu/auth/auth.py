@@ -13,6 +13,7 @@ JWT is HS256 implemented with hmac/hashlib (no external jwt dependency).
 from __future__ import annotations
 
 import base64
+import logging
 import hashlib
 import hmac
 import json
@@ -26,6 +27,9 @@ from typing import Any, Callable, Optional
 
 from nornicdb_tpu.errors import AuthError, NotFoundError
 from nornicdb_tpu.storage.types import Engine, Node
+from nornicdb_tpu.telemetry.metrics import count_error as _count_error
+
+logger = logging.getLogger(__name__)
 
 # roles (ref: auth.go:160-163)
 ROLE_ADMIN = "admin"
@@ -81,7 +85,9 @@ def verify_password(password: str, stored: str) -> bool:
             password.encode(), salt=salt, n=2**14, r=8, p=1, dklen=32
         )
         return hmac.compare_digest(got, digest)
-    except Exception:
+    except (ValueError, TypeError):
+        # malformed stored hash (wrong field count, bad base64, bad
+        # scrypt params): treat as a non-match, never an auth crash
         return False
 
 
@@ -127,7 +133,8 @@ class Authenticator:
             try:
                 self.audit_hook(event, detail)
             except Exception:
-                pass
+                logger.exception("audit hook failed for event %s", event)
+                _count_error("auth")
 
     # -- user management (users as system-DB nodes, ref: auth.go:634-747) ------
     def _user_node_id(self, username: str) -> str:
@@ -350,7 +357,9 @@ class Authenticator:
             if payload.get("jti") in self._revoked:
                 return None
             return payload
-        except Exception:
+        except (ValueError, TypeError, KeyError):
+            # malformed token (field count, base64, JSON, digest types):
+            # invalid credential, not an error path worth logging
             return None
 
     def logout(self, token: str) -> None:

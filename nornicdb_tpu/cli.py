@@ -76,10 +76,60 @@ def cmd_serve(args) -> int:
         embedder = TPUEmbedder(cfg=cfg_name)
     else:
         embedder = HashEmbedder(args.embed_dims)
+    # distilled production embedder, behind the eval gate: the student
+    # checkpoint only replaces the full encoder when its retrieval MRR
+    # clears serving.student_min_mrr — otherwise the config is REJECTED
+    # at startup with the measured number (docs/operations.md "Embed
+    # serving tuning"; serving/student_gate.py)
+    from nornicdb_tpu.errors import StudentGateError
+    from nornicdb_tpu.serving import ServingEngine, gate_student
+    from nornicdb_tpu.serving.stats import set_embedder_selection
+
+    serving_cfg = app_cfg.serving
+    if args.embedder == "student":
+        # CLI shorthand for serving.embedder=student (config/env also work)
+        serving_cfg.embedder = "student"
+        if not serving_cfg.student_model_dir:
+            serving_cfg.student_model_dir = os.environ.get(
+                "NORNICDB_EMBEDDER_MODEL", ""
+            )
+    if serving_cfg.embedder == "student":
+        from nornicdb_tpu.models.pretrain import load_embedder
+
+        student_dir = serving_cfg.student_model_dir
+        if not student_dir:
+            raise SystemExit(
+                "serving.embedder=student requires "
+                "serving.student_model_dir (NORNICDB_STUDENT_MODEL)"
+            )
+        student = load_embedder(student_dir)
+        try:
+            report = gate_student(
+                student,
+                serving_cfg.student_min_mrr,
+                serving_cfg.student_eval_suite,
+            )
+        except StudentGateError as e:
+            raise SystemExit(f"serving config rejected: {e}")
+        print(
+            f"student embedder admitted: eval MRR "
+            f"{report.metrics.mrr:.4f} >= {serving_cfg.student_min_mrr}"
+        )
+        embedder = student
+        set_embedder_selection("student")
+    else:
+        set_embedder_selection("full")
+    if serving_cfg.enabled:
+        # continuous ragged batching engine fronts every embed path
+        # (HTTP /nornicdb/embed, query embedding, EmbedWorker drains);
+        # the cache sits outside so hits skip the queue entirely
+        embedder = ServingEngine(embedder, serving_cfg)
     db.set_embedder(CachedEmbedder(embedder))
 
     authenticator = None
     if args.auth:
+        from nornicdb_tpu.errors import AlreadyExistsError
+
         system = db.database_manager.get_storage(SYSTEM_DB)
         authenticator = Authenticator(system)
         try:
@@ -87,7 +137,7 @@ def cmd_serve(args) -> int:
                 "admin", os.environ.get("NORNICDB_ADMIN_PASSWORD", "admin"),
                 ROLE_ADMIN,
             )
-        except Exception:
+        except AlreadyExistsError:
             pass  # exists from a previous run
 
     http_server = HttpServer(
@@ -436,7 +486,7 @@ def main(argv=None) -> int:
     s.add_argument("--auth", action="store_true", help="require authentication")
     s.add_argument("--headless", action="store_true",
                    help="no browser UI (ref: -tags noui builds)")
-    s.add_argument("--embedder", choices=["hash", "tpu", "trained"],
+    s.add_argument("--embedder", choices=["hash", "tpu", "trained", "student"],
                    default="tpu")
     s.add_argument("--embed-dims", type=int, default=1024)
     s.add_argument("--model-preset", default="bge_small")

@@ -115,6 +115,43 @@ class BackendConfig:
 
 
 @dataclass
+class ServingConfig:
+    """Continuous batching engine knobs (nornicdb_tpu.serving): applied by
+    ``cli serve`` — the engine wraps the production embedder, so every
+    embed path (HTTP /nornicdb/embed, query embedding, the background
+    EmbedWorker) batches continuously with admission control.  Env form:
+    ``NORNICDB_SERVING_<FIELD>``.  See docs/operations.md "Embed serving
+    tuning"."""
+
+    # master switch for the continuous batching engine
+    enabled: bool = True
+    # production embedder selection: "full" = the configured encoder as
+    # is; "student" = the distilled checkpoint at student_model_dir,
+    # admitted ONLY when its eval MRR clears student_min_mrr (the config
+    # is rejected at startup otherwise — serving/student_gate.py)
+    embedder: str = "full"
+    student_model_dir: str = ""
+    student_min_mrr: float = 0.6
+    student_eval_suite: str = ""  # JSON suite path; "" = builtin suite
+    # admission control: queued texts/tokens beyond these shed new
+    # requests with 429/RESOURCE_EXHAUSTED (an empty queue always admits)
+    max_queue: int = 4096
+    max_queue_tokens: int = 262144
+    # per-request deadline; expired work is shed pre-dispatch and waiting
+    # callers give up at deadline + grace. 0 disables (not recommended
+    # for serving — the deadline is the no-indefinite-block guarantee)
+    deadline_ms: float = 2000.0
+    # batch window under low queue depth (a deep queue dispatches
+    # immediately at max_batch_tokens)
+    batch_wait_ms: float = 2.0
+    # ragged scheduler: token budget per packed dispatch + row-grid bound
+    max_batch_tokens: int = 8192
+    max_rows: int = 16
+    # host staging pipeline depth (double buffering; >=1)
+    staging_depth: int = 2
+
+
+@dataclass
 class SearchTuningConfig:
     """Vector-serving knobs (nornicdb_tpu.search.SearchConfig): applied by
     ``cli serve`` via ``search.service.configure_defaults`` before the
@@ -135,6 +172,11 @@ class SearchTuningConfig:
     batching_enabled: bool = False
     batch_window: float = 0.002
     batch_max: int = 256
+    # batched-search admission: pending queries beyond batch_max_queue
+    # shed with 429/RESOURCE_EXHAUSTED (0 = unbounded); queries older
+    # than batch_deadline_ms at dispatch are shed too (0 disables)
+    batch_max_queue: int = 1024
+    batch_deadline_ms: float = 0.0
     write_behind: bool = False
 
 
@@ -148,6 +190,7 @@ class AppConfig:
     telemetry: TelemetryConfig = field(default_factory=TelemetryConfig)
     backend: BackendConfig = field(default_factory=BackendConfig)
     search: SearchTuningConfig = field(default_factory=SearchTuningConfig)
+    serving: ServingConfig = field(default_factory=ServingConfig)
 
 
 def find_config_file(start_dir: str = ".") -> Optional[str]:
@@ -224,6 +267,12 @@ ENV_ALIASES: dict[str, tuple[str, str]] = {
     "NORNICDB_DEVICE_PROBE_TIMEOUT": ("backend", "probe_timeout"),
     "NORNICDB_DEVICE_FALLBACK": ("backend", "fallback"),
     "NORNICDB_DEVICE_RECOVERY_REUPLOAD": ("backend", "recovery_reupload"),
+    # continuous batching engine (generic NORNICDB_SERVING_<FIELD> forms
+    # work too; these short aliases cover the common operational knobs)
+    "NORNICDB_EMBED_DEADLINE_MS": ("serving", "deadline_ms"),
+    "NORNICDB_EMBED_MAX_QUEUE": ("serving", "max_queue"),
+    "NORNICDB_STUDENT_MODEL": ("serving", "student_model_dir"),
+    "NORNICDB_STUDENT_MIN_MRR": ("serving", "student_min_mrr"),
     "NORNICDB_TRACING": ("telemetry", "tracing_enabled"),
     "NORNICDB_TRACE_SAMPLE": ("telemetry", "trace_sample"),
     "NORNICDB_TRACE_BUFFER": ("telemetry", "trace_buffer"),

@@ -154,7 +154,12 @@ class DB:
     # -- subsystem wiring --------------------------------------------------
     def set_embedder(self, embedder) -> None:
         """(ref: DB.SetEmbedder db.go:1074) — also starts the embed worker."""
+        old_engine = self.serving_engine()
         self._embedder = embedder
+        if old_engine is not None and old_engine is not self.serving_engine():
+            # the replaced chain carried a continuous batching engine:
+            # stop its pipeline threads instead of leaking them
+            old_engine.stop()
         if self._search is not None:
             self._search.embedder = embedder
         if self._embed_worker is not None:
@@ -194,6 +199,21 @@ class DB:
     @property
     def embedder(self):
         return self._embedder
+
+    def serving_engine(self):
+        """The continuous batching ServingEngine in the embedder chain
+        (CachedEmbedder(ServingEngine(inner)) is the `cli serve` stack),
+        or None when serving isn't engine-fronted."""
+        from nornicdb_tpu.serving import ServingEngine
+
+        e = self._embedder
+        seen = 0
+        while e is not None and seen < 8:
+            if isinstance(e, ServingEngine):
+                return e
+            e = getattr(e, "inner", None)
+            seen += 1
+        return None
 
     @property
     def search(self):
@@ -676,6 +696,11 @@ class DB:
         self._closed = True
         if self._embed_worker is not None:
             self._embed_worker.stop()
+        engine = self.serving_engine()
+        if engine is not None:
+            # stop the continuous batching pipeline; queued requests fail
+            # fast with ClosedError instead of stranding callers
+            engine.stop()
         if self._decay is not None:
             self._decay.stop()
         self._base_storage.close()

@@ -118,6 +118,17 @@ class TPUEmbedder(Embedder):
         self._fwd = jax.jit(
             lambda p, ids, mask: bge_m3.forward(p, self.cfg, ids, mask)
         )
+        # ragged token-packed forward (serving engine path): one program
+        # per (R, C, S_cap) shape class — the scheduler quantizes packs to
+        # a bounded class grid, so this cache stays small (NL-JAX03)
+        self._fwd_packed = jax.jit(
+            lambda p, ids, seg, pos, cr, cc: bge_m3.forward_packed(
+                p, self.cfg, ids, seg, pos, cr, cc
+            )
+        )
+        # shape classes the packed program compiled for (the bench's
+        # one-program-per-packed-batch invariant reads this)
+        self.packed_shapes: set[tuple[int, int, int]] = set()
         # host mirror of the weights, captured while the device is still
         # reachable: jax.default_device(cpu) does NOT relocate params
         # committed to a dead accelerator, so a real device loss needs a
@@ -131,7 +142,10 @@ class TPUEmbedder(Embedder):
         # the next READY forward must re-materialize them from the mirror
         self._params_stale = False
         self._backend.register_corpus(self)
-        self.stats = {"embedded": 0, "batches": 0, "cpu_fallback_batches": 0}
+        self.stats = {
+            "embedded": 0, "batches": 0, "cpu_fallback_batches": 0,
+            "packed_dispatches": 0, "packed_tokens": 0,
+        }
 
     def _on_backend_recovered(self, mode: str) -> None:
         """Manager recovery notification: whatever device the old params
@@ -236,6 +250,41 @@ class TPUEmbedder(Embedder):
                         self.stats["cpu_fallback_batches"] += 1
         self.stats["embedded"] += len(texts)
         return out  # type: ignore[return-value]
+
+    def embed_packed(self, packed) -> np.ndarray:
+        """Embed one ragged token-packed grid (serving.PackedBatch) in a
+        SINGLE device program: segment-masked attention + per-segment CLS
+        pooling, numerically equivalent to the per-request path.
+
+        Device lifecycle matches embed_batch: gated through the backend
+        manager, CPU-pinned while degraded, params re-materialized from
+        the host mirror after a recovery.  Returns (S_cap, dims) float32;
+        callers slice the live segments via ``packed.order``."""
+        import contextlib
+
+        import jax.numpy as jnp
+
+        scope = self._device_scope()
+        degraded = not isinstance(scope, contextlib.nullcontext)
+        params = self._fallback_params() if degraded else self._serving_params()
+        with scope:
+            emb = self._fwd_packed(
+                params,
+                jnp.asarray(packed.ids),
+                jnp.asarray(packed.seg),
+                jnp.asarray(packed.positions),
+                jnp.asarray(packed.cls_rows),
+                jnp.asarray(packed.cls_cols),
+            )
+            emb = np.asarray(emb, np.float32)
+        self.packed_shapes.add(packed.shape_class)
+        self.stats["packed_dispatches"] += 1
+        self.stats["packed_tokens"] += packed.tokens
+        self.stats["batches"] += 1
+        self.stats["embedded"] += packed.n_segments
+        if degraded:
+            self.stats["cpu_fallback_batches"] += 1
+        return emb
 
     def dimensions(self) -> int:
         return self.cfg.dims

@@ -25,9 +25,18 @@ import numpy as np
 from nornicdb_tpu.embed.base import Embedder
 from nornicdb_tpu.errors import NotFoundError
 from nornicdb_tpu.storage.types import Engine, Node
+from nornicdb_tpu.telemetry.metrics import REGISTRY as _REGISTRY
 from nornicdb_tpu.telemetry.metrics import count_error as _count_error
 
 logger = logging.getLogger(__name__)
+
+# retry/fallback visibility: attempts that failed and were retried used
+# to vanish into debug logs — operators saw only the terminal `failed`
+# stat.  Same family serving/stats.py registers (idempotent by name).
+_RETRIES = _REGISTRY.counter(
+    "nornicdb_embed_retries_total",
+    "EmbedWorker embed_batch attempts that failed and were retried",
+)
 
 # Properties whose text gets embedded, in priority order
 # (ref: buildEmbeddingText embed_queue.go:779).
@@ -210,7 +219,7 @@ class EmbedWorker:
             return skipped
         # One flat batch through the embedder (all chunks of all nodes).
         flat = [c for _, chunks in jobs for c in chunks]
-        vectors = self._embed_with_retry(flat)
+        vectors = self._embed_with_retry(flat, [n.id for n, _ in jobs])
         if vectors is None:
             # batch failed terminally: mark failures, keep pending for later
             with self._stats_lock:
@@ -254,13 +263,23 @@ class EmbedWorker:
             self._last_embed_ts = time.monotonic()
         return processed + skipped
 
-    def _embed_with_retry(self, texts: list[str]) -> Optional[list[np.ndarray]]:
-        """(ref: embedWithRetry :714; crash recovery local_gguf.go:202)"""
+    def _embed_with_retry(
+        self, texts: list[str], node_ids: Optional[list[str]] = None
+    ) -> Optional[list[np.ndarray]]:
+        """(ref: embedWithRetry :714; crash recovery local_gguf.go:202)
+
+        Every failed attempt is counted (`nornicdb_embed_retries_total` +
+        component error counter) and the TERMINAL failure names the node
+        batch it strands — previously retries and the final give-up were
+        indistinguishable in the metrics and the affected nodes were
+        invisible.  A serving-engine shed (ResourceExhausted backpressure)
+        retries on the same backoff: the queue is the retry buffer."""
         delay = self.config.retry_backoff
         for attempt in range(self.config.max_retries):
             try:
                 return self.embedder.embed_batch(texts)
             except Exception:
+                terminal = attempt == self.config.max_retries - 1
                 logger.warning(
                     "embed_batch failed (attempt %d/%d)",
                     attempt + 1, self.config.max_retries, exc_info=True,
@@ -268,8 +287,16 @@ class EmbedWorker:
                 _count_error("embed_queue")
                 with self._stats_lock:
                     self.stats.retries += 1
-                if attempt == self.config.max_retries - 1:
+                if terminal:
+                    logger.error(
+                        "embedding batch failed terminally after %d "
+                        "attempts; %d node(s) stay pending: %s",
+                        self.config.max_retries,
+                        len(node_ids or ()),
+                        ",".join(node_ids or ("<unknown>",)),
+                    )
                     return None
+                _RETRIES.inc()
                 time.sleep(delay)
                 delay *= 2
         return None

@@ -50,6 +50,24 @@ class WALCorruptionError(NornicError):
     """WAL record failed CRC / magic validation."""
 
 
+class ResourceExhausted(NornicError):
+    """Serving admission control shed this request (queue full or deadline
+    passed).  Surfaced as HTTP 429, gRPC RESOURCE_EXHAUSTED, and Bolt
+    ``Neo.TransientError.Request.ResourceExhausted`` — clients should back
+    off and retry.  Raised by the continuous batching engine
+    (nornicdb_tpu.serving) and the bounded QueryBatcher."""
+
+    def __init__(self, message: str, reason: str = "queue_full"):
+        super().__init__(message)
+        self.reason = reason  # queue_full | deadline
+
+
+class StudentGateError(NornicError):
+    """A distilled student embedder failed its eval gate (eval.py MRR below
+    the configured threshold) — the serving config is rejected at startup
+    rather than silently serving lower-quality embeddings."""
+
+
 class DeviceUnavailable(NornicError):
     """The accelerator backend is not serving (degraded / acquiring).
 

@@ -131,6 +131,65 @@ def forward(
     return cls / jnp.maximum(norm, 1e-12)
 
 
+def forward_packed(
+    params: dict,
+    cfg: BgeConfig,
+    input_ids: jax.Array,
+    seg_ids: jax.Array,
+    positions: jax.Array,
+    cls_rows: jax.Array,
+    cls_cols: jax.Array,
+) -> jax.Array:
+    """Ragged token-packed forward: several texts share each row of a
+    (R, C) grid, delimited by segment ids (0 = padding, 1..S = texts).
+
+    Numerically equivalent to running :func:`forward` per text: attention
+    is block-diagonal over segments (a token attends only within its own
+    segment, exactly the key set the per-request path sees), positions
+    restart per segment with the same XLM-R formula, and pooling gathers
+    each segment's first (CLS) token.  ``cls_rows``/``cls_cols`` index the
+    segment starts (padded slots gather garbage rows the host slices off).
+
+    Shapes are static per (R, C, len(cls_rows)) class — the scheduler
+    quantizes packs to a small class set so the jit cache stays bounded
+    (same contract as forward()'s bucket grid; NL-JAX03).
+    Returns (S_cap, dims) L2-normalized embeddings.
+    """
+    r, c = input_ids.shape
+    h = (
+        params["tok_emb"][input_ids]
+        + params["pos_emb"][positions]
+        + params["type_emb"][jnp.zeros_like(input_ids)]
+    )
+    h = layer_norm(params["emb_ln"], h)
+    # block-diagonal additive mask (R, 1, C, C): key visible to query iff
+    # same nonzero segment. Fully-masked pad queries softmax to uniform
+    # garbage that nothing gathers (no NaN: softmax is max-subtracted).
+    neg = jnp.asarray(-1e30, jnp.float32)
+    valid = seg_ids > 0
+    allowed = (
+        (seg_ids[:, :, None] == seg_ids[:, None, :])
+        & valid[:, :, None]
+        & valid[:, None, :]
+    )
+    amask = jnp.where(allowed[:, None, :, :], 0.0, neg)
+    head_dim = cfg.hidden // cfg.heads
+    for blk in params["blocks"]:
+        q = dense(blk["q"], h).reshape(r, c, cfg.heads, head_dim)
+        k = dense(blk["k"], h).reshape(r, c, cfg.heads, head_dim)
+        v = dense(blk["v"], h).reshape(r, c, cfg.heads, head_dim)
+        o = attention(q, k, v, amask).reshape(r, c, cfg.hidden)
+        h = layer_norm(blk["attn_ln"], h + dense(blk["o"], o))  # post-LN
+        m = dense(blk["down"], jax.nn.gelu(dense(blk["up"], h)))
+        h = layer_norm(blk["mlp_ln"], h + m)
+    cls = h[cls_rows, cls_cols, :]  # (S_cap, hidden): segment CLS pooling
+    if cfg.dims != cfg.hidden:
+        cls = dense(params["proj"], cls)
+    cls = cls.astype(jnp.float32)
+    norm = jnp.linalg.norm(cls, axis=-1, keepdims=True)
+    return cls / jnp.maximum(norm, 1e-12)
+
+
 def shardings(cfg: BgeConfig) -> dict:
     """PartitionSpecs for TP over the "model" mesh axis (per-block specs are
     shared across the `blocks` list)."""

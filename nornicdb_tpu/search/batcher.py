@@ -22,6 +22,7 @@ from typing import Any, Callable, Optional
 
 import numpy as np
 
+from nornicdb_tpu.errors import ResourceExhausted
 from nornicdb_tpu.telemetry.metrics import REGISTRY as _REGISTRY
 from nornicdb_tpu.telemetry.tracing import tracer as _tracer
 
@@ -44,6 +45,13 @@ _BATCH_SIZE_HIST = _REGISTRY.histogram(
     "Queries coalesced per batched device dispatch",
     buckets=(1, 2, 4, 8, 16, 32, 64, 128, 256),
 )
+# admission-control sheds (same family the serving engine feeds for the
+# embed path; idempotent by-name resolution)
+_SHEDS = _REGISTRY.counter(
+    "nornicdb_serving_sheds_total",
+    "Requests shed by serving admission control",
+    labels=("path", "reason"),
+)
 
 
 @dataclass
@@ -55,6 +63,7 @@ class _Pending:
     result: Optional[list] = None
     error: Optional[Exception] = None
     enqueued: float = 0.0  # perf_counter at submit
+    deadline: float = 0.0  # monotonic; 0 = none
     ctx: Any = None  # caller's trace span, carried across the worker hop
 
 
@@ -63,6 +72,8 @@ class BatcherStats:
     queries: int = 0
     batches: int = 0
     max_batch: int = 0
+    sheds_queue_full: int = 0
+    sheds_deadline: int = 0
 
     @property
     def avg_batch(self) -> float:
@@ -76,6 +87,8 @@ class BatcherStats:
             "batches": self.batches,
             "max_batch": self.max_batch,
             "avg_batch": self.avg_batch,
+            "sheds_queue_full": self.sheds_queue_full,
+            "sheds_deadline": self.sheds_deadline,
         }
 
 
@@ -91,10 +104,19 @@ class QueryBatcher:
         search_batch_fn: Callable[[np.ndarray, int, float], list],
         window: float = 0.002,
         max_batch: int = 256,
+        max_queue: int = 0,
+        deadline: float = 0.0,
     ):
         self.search_batch_fn = search_batch_fn
         self.window = window
         self.max_batch = max_batch
+        # admission control (ROADMAP item 3): pending queries beyond
+        # max_queue shed at submit instead of growing an unbounded list
+        # (0 = unbounded, the pre-serving behavior); queries older than
+        # `deadline` seconds at dispatch are shed rather than served
+        # stale (0 disables)
+        self.max_queue = max_queue
+        self.deadline = deadline
         self.stats = BatcherStats()
         self._lock = threading.Lock()
         self._pending: list[_Pending] = []
@@ -105,8 +127,17 @@ class QueryBatcher:
     ) -> list:
         p = _Pending(np.asarray(query, np.float32).reshape(-1), k, min_similarity)
         p.enqueued = time.perf_counter()
+        if self.deadline > 0:
+            p.deadline = time.monotonic() + self.deadline
         p.ctx = _tracer.capture()  # None when the caller isn't traced
         with self._lock:
+            if self.max_queue > 0 and len(self._pending) >= self.max_queue:
+                self.stats.sheds_queue_full += 1
+                _SHEDS.labels("search", "queue_full").inc()
+                raise ResourceExhausted(
+                    f"search batch queue full ({len(self._pending)} "
+                    "pending); retry with backoff", reason="queue_full",
+                )
             self._pending.append(p)
             if self._flusher is None:
                 # first caller of the window becomes responsible for flushing
@@ -118,7 +149,21 @@ class QueryBatcher:
                 threading.Thread(
                     target=self._run_batch, args=(pending,), daemon=True
                 ).start()
-        p.event.wait()
+        # bounded wait: the dispatch path is time-bounded (the backend
+        # manager degrades a hung device within its acquire timeout), and
+        # a deadline-carrying caller gives up past deadline + grace — a
+        # batched search can never wedge its caller indefinitely
+        if p.deadline:
+            if not p.event.wait(
+                max(0.05, p.deadline - time.monotonic()) + 1.0
+            ):
+                self.stats.sheds_deadline += 1
+                _SHEDS.labels("search", "deadline").inc()
+                raise ResourceExhausted(
+                    "search deadline exceeded", reason="deadline"
+                )
+        else:
+            p.event.wait()
         if p.error is not None:
             raise p.error
         return p.result
@@ -132,6 +177,25 @@ class QueryBatcher:
             self._run_batch(pending)
 
     def _run_batch(self, pending: list[_Pending]) -> None:
+        # deadline shedding at dispatch: work that already expired is
+        # answered with ResourceExhausted instead of occupying the batch
+        if self.deadline > 0:
+            now = time.monotonic()
+            live = []
+            for p in pending:
+                if p.deadline and now > p.deadline:
+                    self.stats.sheds_deadline += 1
+                    _SHEDS.labels("search", "deadline").inc()
+                    p.error = ResourceExhausted(
+                        "search deadline exceeded before dispatch",
+                        reason="deadline",
+                    )
+                    p.event.set()
+                else:
+                    live.append(p)
+            pending = live
+            if not pending:
+                return
         try:
             queries = np.stack([p.query for p in pending])
             k = max(p.k for p in pending)

@@ -91,6 +91,12 @@ class SearchConfig:
     batching_enabled: bool = False
     batch_window: float = 0.002
     batch_max: int = 256
+    # batched-search admission control (ROADMAP item 3): pending queries
+    # beyond batch_max_queue shed with ResourceExhausted (0 = unbounded);
+    # queries older than batch_deadline_ms at dispatch are shed too
+    # (0 disables). Surfaced as 429/RESOURCE_EXHAUSTED at the edges.
+    batch_max_queue: int = 1024
+    batch_deadline_ms: float = 0.0
     # write-behind device sync: a background thread coalesces dirty corpus
     # blocks and patches them between queries, so a query after a write
     # burst waits for a bounded patch instead of staging the whole burst
@@ -492,6 +498,8 @@ class SearchService:
                     self._batched_corpus_search,
                     window=self.config.batch_window,
                     max_batch=self.config.batch_max,
+                    max_queue=self.config.batch_max_queue,
+                    deadline=self.config.batch_deadline_ms / 1000.0,
                 )
             self.stats.vector_candidates += 1
             return batcher.search(embedding, k, min_similarity)

@@ -159,6 +159,12 @@ class BoltSession:
                 code = "Neo.ClientError.Schema.ConstraintValidationFailed"
             elif "Auth" in name:
                 code = "Neo.ClientError.Security.Unauthorized"
+            elif "ResourceExhausted" in name:
+                # serving admission control shed work under this statement
+                # (embed/search queue full or deadline): a TRANSIENT code,
+                # so neo4j drivers retry with backoff instead of failing
+                # the transaction permanently
+                code = "Neo.TransientError.Request.ResourceExhausted"
             return [(MSG_FAILURE, {"code": code, "message": str(e)})]
 
     def _hello(self, fields: list[Any]) -> list[tuple[int, Any]]:

@@ -24,7 +24,7 @@ import struct
 import time
 from typing import Any, Iterator, Optional
 
-from nornicdb_tpu.errors import NotFoundError
+from nornicdb_tpu.errors import NotFoundError, ResourceExhausted
 from nornicdb_tpu.telemetry.metrics import REGISTRY as _REGISTRY
 from nornicdb_tpu.telemetry.tracing import tracer as _tracer
 
@@ -222,6 +222,12 @@ class GrpcSearchServer:
         try:
             with _tracer.start_trace("grpc.search", traceparent=traceparent):
                 return self._search_traced(request)
+        except ResourceExhausted as e:
+            # serving admission control shed this query: surface the
+            # canonical gRPC backpressure status so clients back off
+            import grpc
+
+            context.abort(grpc.StatusCode.RESOURCE_EXHAUSTED, str(e))
         finally:
             _GRPC_HIST.observe(time.perf_counter() - t_req)
 

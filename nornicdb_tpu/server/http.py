@@ -22,13 +22,18 @@ from typing import Any, Optional
 
 import numpy as np
 
-from nornicdb_tpu.errors import AuthError, NornicError
+from nornicdb_tpu.errors import AuthError, NornicError, ResourceExhausted
 from nornicdb_tpu.storage.types import Edge, Node
 
 
 from nornicdb_tpu.cypher import ast as cypher_ast
 from nornicdb_tpu.cypher.executor import classify_query_text
 from nornicdb_tpu.cypher.parser import parse as cypher_parse
+# registers the serving-engine metric families (packed tokens, pack
+# efficiency, sheds, staging overlap, embedder selection) so the tested
+# docs/observability.md catalog renders in every server process, whether
+# or not a ServingEngine was constructed
+from nornicdb_tpu.serving import stats as _serving_stats  # noqa: F401
 from nornicdb_tpu.telemetry.metrics import (
     REGISTRY as _TELEMETRY_REGISTRY,
     Registry as _Registry,
@@ -354,6 +359,15 @@ class HttpServer:
                             self._send(405, {"error": f"{method} not allowed on {path}"})
                     except AuthError as e:
                         self._send(401, {"error": str(e)})
+                    except ResourceExhausted as e:
+                        # serving admission control shed this request
+                        # (embed/search queue full or deadline passed):
+                        # backpressure, not failure — clients back off
+                        self._send(
+                            429,
+                            {"error": str(e), "reason": e.reason},
+                            extra_headers={"Retry-After": "1"},
+                        )
                     except Exception as e:
                         server_self.errors += 1
                         self._send(400 if method != "GET" else 500, {"error": str(e)})
@@ -687,6 +701,12 @@ class HttpServer:
             }
             if self.db._embed_worker is not None:
                 stats["embed_worker"] = vars(self.db._embed_worker.stats)
+            engine = self.db.serving_engine()
+            if engine is not None:
+                # continuous batching engine health: pack efficiency,
+                # sheds, staging overlap (docs/operations.md "Embed
+                # serving tuning" reads these)
+                stats["serving"] = engine.stats_snapshot()
             search = getattr(self.db, "search", None)
             if search is not None and hasattr(search, "stats_snapshot"):
                 # index/search counters + device-sync patching + query

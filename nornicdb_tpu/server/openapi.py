@@ -86,7 +86,7 @@ _TX_RESP = {
 
 def _op(summary: str, *, tag: str, req: Any = None, resp: Any = None,
         params: list | None = None, auth: bool = True,
-        method_desc: str = "") -> dict:
+        method_desc: str = "", shed: bool = False) -> dict:
     op: dict = {
         "summary": summary,
         "tags": [tag],
@@ -99,6 +99,15 @@ def _op(summary: str, *, tag: str, req: Any = None, resp: Any = None,
     if resp is not None:
         op["responses"]["200"]["content"] = {
             "application/json": {"schema": resp}
+        }
+    if shed:
+        # serving admission control (docs/operations.md "Embed serving
+        # tuning"): bounded queues + deadlines shed under overload
+        op["responses"]["429"] = {
+            "description": "shed by serving admission control (embed/"
+                           "search queue full or deadline exceeded); "
+                           "retry with backoff",
+            "content": {"application/json": {"schema": _ERR}},
         }
     if auth:
         op["responses"]["401"] = {
@@ -215,7 +224,7 @@ def build_spec(version: str = "0.4.0") -> dict:
         # -- memory / search -------------------------------------------------
         "/nornicdb/search": {"post": _op(
             "Hybrid search: vector + BM25 + RRF fusion over stored memories",
-            tag="memory", req=_SEARCH_REQ, resp=_SEARCH_RESP)},
+            tag="memory", req=_SEARCH_REQ, resp=_SEARCH_RESP, shed=True)},
         "/nornicdb/similar": {"post": _op(
             "Find memories similar to a given node",
             tag="memory",
@@ -225,8 +234,8 @@ def build_spec(version: str = "0.4.0") -> dict:
                                 "limit": {"type": "integer"}}},
             resp=_SEARCH_RESP)},
         "/nornicdb/embed": {"post": _op(
-            "Trigger processing of the pending-embedding queue",
-            tag="memory")},
+            "Embed a text through the continuous batching engine",
+            tag="memory", shed=True)},
         "/nornicdb/search/rebuild": {"post": _op(
             "Rebuild the search indexes from storage", tag="memory")},
         # -- admin -----------------------------------------------------------

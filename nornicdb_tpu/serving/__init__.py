@@ -1,0 +1,45 @@
+"""nornicdb_tpu.serving — continuous ragged batching for embed + search.
+
+The subsystem that owns the production request path (ROADMAP item 3):
+
+* :class:`ServingEngine` — continuous batching engine; an
+  :class:`~nornicdb_tpu.embed.base.Embedder` wrapper with admission
+  control, deadline shedding, ragged token packing, and double-buffered
+  host staging (serving/engine.py).
+* :class:`RaggedPacker` / :class:`PackedBatch` — token-concatenated
+  variable-length packing over static shape classes (serving/ragged.py).
+* :func:`gate_student` — the eval-gated distilled-embedder admission
+  check (serving/student_gate.py).
+* :mod:`~nornicdb_tpu.serving.stats` — the metric families in the tested
+  docs/observability.md catalog.
+
+See docs/operations.md "Embed serving tuning" for the knobs
+(``ServingConfig`` / ``NORNICDB_SERVING_*``).
+"""
+
+from nornicdb_tpu.serving.engine import EngineStats, ServingEngine
+from nornicdb_tpu.serving.ragged import (
+    CAPACITY_CLASSES,
+    PackedBatch,
+    RaggedPacker,
+    unpack_results,
+)
+from nornicdb_tpu.serving.student_gate import (
+    builtin_eval_suite,
+    evaluate_embedder,
+    gate_student,
+    load_eval_suite,
+)
+
+__all__ = [
+    "CAPACITY_CLASSES",
+    "EngineStats",
+    "PackedBatch",
+    "RaggedPacker",
+    "ServingEngine",
+    "builtin_eval_suite",
+    "evaluate_embedder",
+    "gate_student",
+    "load_eval_suite",
+    "unpack_results",
+]

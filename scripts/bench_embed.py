@@ -1,0 +1,279 @@
+"""Embed-path bench: ragged-packed continuous batching vs the padded
+fixed-batch path, at mixed text lengths (ISSUE 8 satellite — the embed
+bench trajectory was empty).
+
+Two paths over the same corpus and the same model weights:
+
+* **padded** — ``TPUEmbedder.embed_batch``: every text padded to a
+  power-of-two length bucket, buckets chunked to ``opt_batch`` rows,
+  one synchronous dispatch per chunk (the pre-PR-8 production path).
+* **ragged**  — ``ServingEngine``: texts token-packed into (R, C) grids
+  with segment-masked attention, one program per packed batch, host
+  staging double-buffered against device compute.
+
+The corpus models graph-node text (the workload this database embeds):
+mostly short name/title/tag strings, a minority of sentence-length
+descriptions, a tail of paragraph-length content.  Document-length text
+is excluded on purpose — the EmbedWorker chunks node text to 512-token
+windows upstream (embed/queue.chunk_text), and a full 512-token chunk
+pads perfectly in both paths, so including it only measures the model,
+not the scheduler.
+
+Writes BENCH_embed.json (committed artifact) and asserts the
+one-program-per-packed-batch invariant at exit: every engine batch was a
+single packed dispatch, and the jit cache holds one program per shape
+class actually used, not one per batch.
+
+Usage: python scripts/bench_embed.py [--quick] [--texts N] [--seed S]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+# realistic graph-node text mix: (weight, min_words, max_words) —
+# name/title/tag strings dominate a graph corpus; sentence descriptions
+# and paragraph content form the tail (document-length text arrives as
+# 512-token chunks upstream and pads equally well in both paths)
+MIX = (
+    ("title", 0.85, 2, 5),
+    ("description", 0.12, 10, 18),
+    ("paragraph", 0.03, 40, 60),
+)
+
+WORDS = (
+    "graph node edge vector search index memory storage engine query "
+    "batch token device shard corpus embed serve latency throughput "
+    "append commit probe replica quorum trace metric histogram cache "
+    "segment packed ragged schedule deadline admission queue stream"
+).split()
+
+
+def build_corpus(n: int, seed: int) -> list[str]:
+    rng = np.random.default_rng(seed)
+    texts = []
+    weights = np.array([m[1] for m in MIX])
+    weights = weights / weights.sum()
+    kinds = rng.choice(len(MIX), size=n, p=weights)
+    for i in range(n):
+        _, _, lo, hi = MIX[kinds[i]]
+        k = int(rng.integers(lo, hi + 1))
+        texts.append(" ".join(rng.choice(WORDS, size=k)))
+    return texts
+
+
+def pctl(samples: list[float], p: float) -> float:
+    return float(np.percentile(np.asarray(samples), p)) if samples else 0.0
+
+
+def bench_padded(embedder, corpus: list[str], batch: int) -> dict:
+    # full warm pass: compile every bucket/batch class outside the timed
+    # region, same as a warmed server process (both paths get this)
+    done = 0
+    while done < len(corpus):
+        embedder.embed_batch(corpus[done : done + batch])
+        done += batch
+    embedder.stats["batches"] = 0
+    t0 = time.perf_counter()
+    done = 0
+    while done < len(corpus):
+        embedder.embed_batch(corpus[done : done + batch])
+        done += batch
+    elapsed = time.perf_counter() - t0
+    # single-request serving latency (warm the single-row classes first)
+    for t in corpus[:3]:
+        embedder.embed(t)
+    lat = []
+    for t in corpus[:40]:
+        s = time.perf_counter()
+        embedder.embed(t)
+        lat.append((time.perf_counter() - s) * 1e3)
+    padded_tokens = 0
+    real_tokens = 0
+    for t in corpus:
+        seq = embedder.tokenizer.encode(t, max_len=embedder.max_len)
+        real_tokens += len(seq)
+        padded_tokens += embedder._bucket_len(len(seq))
+    return {
+        "emb_s": round(len(corpus) / elapsed, 1),
+        "elapsed_s": round(elapsed, 3),
+        "p50_ms": round(pctl(lat, 50), 3),
+        "p99_ms": round(pctl(lat, 99), 3),
+        "real_tokens": real_tokens,
+        "padded_tokens": padded_tokens,
+        "pad_efficiency": round(real_tokens / padded_tokens, 4),
+        "dispatches": embedder.stats["batches"],
+    }
+
+
+def bench_ragged(engine, corpus: list[str], batch: int) -> dict:
+    # full warm pass compiles every packed shape class the corpus will
+    # exercise (the jit cache is bounded by the class grid, so the warm
+    # set is the steady-state set)
+    done = 0
+    while done < len(corpus):
+        engine.embed_batch(corpus[done : done + batch])
+        done += batch
+    embedder = engine.inner
+    programs_after_warm = len(embedder.packed_shapes)
+    batches_before = engine.stats.batches
+    t0 = time.perf_counter()
+    done = 0
+    while done < len(corpus):
+        engine.embed_batch(corpus[done : done + batch])
+        done += batch
+    elapsed = time.perf_counter() - t0
+    timed_batches = engine.stats.batches - batches_before
+    programs_after_timed = len(embedder.packed_shapes)
+    for t in corpus[:3]:  # warm the single-text classes
+        engine.embed_batch([t])
+    lat = []
+    for t in corpus[:40]:
+        s = time.perf_counter()
+        engine.embed_batch([t])
+        lat.append((time.perf_counter() - s) * 1e3)
+    snap = engine.stats_snapshot()
+    return {
+        "emb_s": round(len(corpus) / elapsed, 1),
+        "elapsed_s": round(elapsed, 3),
+        "p50_ms": round(pctl(lat, 50), 3),
+        "p99_ms": round(pctl(lat, 99), 3),
+        "pack_efficiency": snap["pack_efficiency"],
+        "staging_overlap_ratio": snap["staging_overlap_ratio"],
+        "packed_batches": snap["batches"],
+        "timed_batches": timed_batches,
+        "programs_after_warm": programs_after_warm,
+        "programs_after_timed": programs_after_timed,
+        "packed_programs": [list(s) for s in snap.get("packed_programs", [])],
+    }
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--quick", action="store_true",
+                    help="small corpus, no artifact commit expectations")
+    ap.add_argument("--texts", type=int, default=0)
+    ap.add_argument("--seed", type=int, default=7)
+    ap.add_argument("--out", default=os.path.join(REPO, "BENCH_embed.json"))
+    args = ap.parse_args()
+    n = args.texts or (600 if args.quick else 3000)
+
+    from nornicdb_tpu.embed.base import TPUEmbedder
+    from nornicdb_tpu.models import bge_m3
+    from nornicdb_tpu.serving import ServingEngine
+    from nornicdb_tpu.serving.engine import EngineStats  # noqa: F401
+
+    # f32 serving-shaped config: wide enough that per-token dense compute
+    # dominates (like the real 1024h encoder), small enough for CPU CI
+    cfg = bge_m3.BgeConfig(
+        vocab_size=4096, hidden=256, layers=2, heads=4, intermediate=512,
+        max_positions=512, dims=256, dtype="float32",
+    )
+    corpus = build_corpus(n, args.seed)
+    print(f"bench_embed: {n} texts, model {cfg.layers}L/{cfg.hidden}h f32",
+          file=sys.stderr)
+
+    # both paths consume the corpus as one continuous stream: the padded
+    # path chunks internally at opt_batch, the engine's scheduler packs
+    # from the live queue — neither gets artificial drain points
+    padded_embedder = TPUEmbedder(cfg=cfg)
+    padded = bench_padded(padded_embedder, corpus, batch=n)
+    print(f"padded fixed-batch: {padded['emb_s']} emb/s "
+          f"(pad efficiency {padded['pad_efficiency']})", file=sys.stderr)
+
+    # same weights, fresh jit caches/stats for the ragged side
+    ragged_embedder = TPUEmbedder(
+        cfg=cfg, params=padded_embedder.params,
+        tokenizer=padded_embedder.tokenizer,
+    )
+
+    class _Cfg:
+        enabled = True
+        max_queue = 1 << 20
+        max_queue_tokens = 1 << 24
+        deadline_ms = 0.0
+        batch_wait_ms = 0.5
+        max_batch_tokens = 8192
+        max_rows = 64
+        staging_depth = 2
+
+    engine = ServingEngine(ragged_embedder, _Cfg())
+    try:
+        ragged = bench_ragged(engine, corpus, batch=n)
+    finally:
+        engine.stop()
+    print(f"ragged packed:      {ragged['emb_s']} emb/s "
+          f"(pack efficiency {ragged['pack_efficiency']}, overlap "
+          f"{ragged['staging_overlap_ratio']})", file=sys.stderr)
+
+    # equivalence sanity on a sample (the tolerance-bounded contract is
+    # tests/test_serving.py's job; the bench just guards against timing a
+    # numerically-divergent path)
+    sample = corpus[:: max(1, n // 16)][:16]
+    ref = padded_embedder.embed_batch(sample)
+    eng2 = ServingEngine(ragged_embedder, _Cfg())
+    try:
+        got = eng2.embed_batch(sample)
+    finally:
+        eng2.stop()
+    worst = min(float(np.dot(a, b)) for a, b in zip(ref, got))
+    assert worst > 1.0 - 1e-4, f"ragged/padded divergence: cos {worst}"
+
+    # one-program-per-packed-batch invariant: every engine batch was ONE
+    # packed dispatch (no per-bucket loops), the timed pass ran entirely
+    # on cached programs (steady-state = one program per shape CLASS, not
+    # per batch), and the class grid stays bounded
+    st = engine.stats
+    assert st.batches == st.packed_batches, (
+        f"unpacked batches slipped in: {st.batches} != {st.packed_batches}")
+    assert ragged_embedder.stats["packed_dispatches"] >= st.packed_batches
+    assert ragged["programs_after_timed"] == ragged["programs_after_warm"], (
+        "timed pass compiled fresh programs: "
+        f"{ragged['programs_after_warm']} -> {ragged['programs_after_timed']}")
+    n_programs = len(ragged_embedder.packed_shapes)
+    assert n_programs <= 24, (
+        f"jit cache grew past the shape-class bound: {n_programs} programs")
+
+    speedup = ragged["emb_s"] / max(padded["emb_s"], 1e-9)
+    out = {
+        "bench": "embed_ragged_vs_padded",
+        "texts": n,
+        "seed": args.seed,
+        "mix": [
+            {"kind": k, "weight": w, "words": [lo, hi]}
+            for k, w, lo, hi in MIX
+        ],
+        "model": {
+            "layers": cfg.layers, "hidden": cfg.hidden,
+            "intermediate": cfg.intermediate, "dims": cfg.dims,
+            "dtype": cfg.dtype,
+        },
+        "padded_fixed_batch": padded,
+        "ragged_packed": ragged,
+        "speedup_emb_s": round(speedup, 2),
+        "equivalence_worst_cos": round(worst, 8),
+        "invariant_one_program_per_packed_batch": True,
+        "packed_program_count": n_programs,
+    }
+    if not args.quick:
+        assert speedup >= 3.0, (
+            f"ragged speedup {speedup:.2f}x < 3x acceptance floor")
+        with open(args.out, "w") as f:
+            json.dump(out, f, indent=2)
+            f.write("\n")
+        print(f"wrote {args.out}", file=sys.stderr)
+    print(json.dumps(out))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
