@@ -1,6 +1,6 @@
 # NornicDB-TPU (ref: the reference's Makefile test/build targets)
 
-.PHONY: test test-fast lint lint-baseline sanitize smoke chaos bench bench-search bench-embed native e2e-bench clean
+.PHONY: test test-fast lint lint-baseline sanitize smoke chaos soak soak-ci soak-nornsan bench bench-search bench-embed native e2e-bench clean
 
 test:
 	python -m pytest tests/ -q
@@ -23,6 +23,20 @@ chaos:
 # live-server /metrics + /admin/traces smoke (docs/observability.md)
 smoke:
 	python scripts/telemetry_smoke.py
+
+# 5-minute chaos/load soak: mixed Bolt/HTTP/gRPC/Qdrant traffic under
+# composed replication+backend+storage fault injection, telemetry-backed
+# invariants, SOAK_report.json artifact (docs/chaos.md)
+soak:
+	python -m nornicdb_tpu.soak --scenario full --report SOAK_report.json
+
+# ~60 s seeded CI soak profile (gating; same fault planes, compressed)
+soak-ci:
+	python -m nornicdb_tpu.soak --scenario ci --report SOAK_report_ci.json
+
+# CI soak under the runtime lock sanitizer (docs/linting.md#nornsan)
+soak-nornsan:
+	NORNSAN=1 python -m nornicdb_tpu.soak --scenario ci --report SOAK_report_ci.json
 
 test-fast:
 	python -m pytest tests/ -q -x

@@ -21,4 +21,16 @@ Layer map (mirrors reference SURVEY.md §1):
 
 __version__ = "0.1.0"
 
+import os as _os
+
+if _os.environ.get("NORNSAN") == "1":
+    # opt-in runtime lock sanitizer for NON-pytest entry points (the soak
+    # CLI's `NORNSAN=1 make soak-ci`): install the instrumented-lock shim
+    # BEFORE any package module creates a module-level lock.  pytest runs
+    # load nornsan even earlier via tests/conftest.py, which pre-seeds
+    # sys.modules — the double-install guard makes this a no-op there.
+    from nornicdb_tpu.tools import nornsan as _nornsan  # noqa: E402
+
+    _nornsan.install()
+
 from nornicdb_tpu.db import DB, open as open_db  # noqa: E402,F401

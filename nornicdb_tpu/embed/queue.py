@@ -160,7 +160,18 @@ class EmbedWorker:
 
     def _run(self) -> None:
         while not self._stop.is_set():
-            n = self.process_batch()
+            try:
+                n = self.process_batch()
+            except Exception:
+                # a transient batch failure (storage DurabilityError under
+                # ENOSPC, an embedder hiccup) must not kill the worker
+                # thread forever — the queue would silently stop draining.
+                # Log, count, back off, retry next tick.
+                logger.warning("embed batch failed; backing off",
+                               exc_info=True)
+                _count_error("embed_queue")
+                self._stop.wait(self.config.poll_interval)
+                continue
             if n == 0:
                 self._maybe_trigger_cluster()
                 self._stop.wait(self.config.poll_interval)

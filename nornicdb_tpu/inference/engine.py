@@ -10,6 +10,7 @@ churn); evidence.go, cooldown.go; integration adapters
 
 from __future__ import annotations
 
+import logging
 import threading
 import time
 from dataclasses import dataclass, field
@@ -17,7 +18,11 @@ from typing import Callable, Optional
 
 import numpy as np
 
+from nornicdb_tpu.errors import NotFoundError
 from nornicdb_tpu.storage.types import Edge, Engine, Node
+from nornicdb_tpu.telemetry.metrics import count_error
+
+log = logging.getLogger(__name__)
 
 SIMILAR_TO = "SIMILAR_TO"
 RELATED_TO = "RELATED_TO"
@@ -73,6 +78,13 @@ class InferenceEngine:
         self._lock = threading.RLock()
         self._evidence: dict[tuple[str, str, str], _Evidence] = {}
         self._cooldown: dict[tuple[str, str], float] = {}
+        # rate-limited similarity-failure logging (one traceback per 60s
+        # with a suppressed count — same pattern as decay.rate_modifier)
+        self._sim_errors = 0
+        # -inf, not 0.0: monotonic() has an arbitrary epoch that can start
+        # near zero, which would silently suppress the FIRST traceback for
+        # up to 60s of process life (decay.py uses the same sentinel)
+        self._sim_error_logged_at = float("-inf")
         self._co_access: dict[tuple[str, str], int] = {}
         self._last_access: list[tuple[str, float]] = []
 
@@ -88,6 +100,21 @@ class InferenceEngine:
                 self.config.max_suggestions_per_store + 1,
             )
         except Exception:
+            # a similarity backend hiccup must not fail the store, but a
+            # silently dead suggestion path is undebuggable — count every
+            # failure, log one traceback per 60s (a persistently-down
+            # backend would otherwise emit one per stored node)
+            count_error("inference.similarity")
+            self._sim_errors += 1
+            mono = time.monotonic()
+            if mono - self._sim_error_logged_at >= 60.0:
+                self._sim_error_logged_at = mono
+                log.warning(
+                    "similarity lookup failed during on_store "
+                    "(%d failure(s) since last report)",
+                    self._sim_errors, exc_info=True,
+                )
+                self._sim_errors = 0
             return []
         created = []
         for other_id, score in candidates:
@@ -190,6 +217,12 @@ class InferenceEngine:
         try:
             created = self.storage.create_edge(edge)
         except Exception:
+            # endpoint vanished / duplicate under race: the inference is
+            # simply stale — but count it so a systematic failure shows up
+            log.debug("inferred edge %s-[%s]->%s not created",
+                      edge.start_node, edge.type, edge.end_node,
+                      exc_info=True)
+            count_error("inference.create_edge")
             return None
         self.stats.edges_created += 1
         return created
@@ -213,6 +246,6 @@ class InferenceEngine:
                 try:
                     self.storage.delete_edge(e.id)
                     removed += 1
-                except Exception:
-                    pass
+                except NotFoundError:
+                    pass  # already gone (concurrent decay/delete)
         return removed

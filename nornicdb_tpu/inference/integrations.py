@@ -11,12 +11,17 @@ gated by NORNICDB_AUTO_TLP_LLM_QC_ENABLED.
 from __future__ import annotations
 
 import json
+import logging
 import os
 from typing import Callable, Optional
 
+from nornicdb_tpu.errors import NotFoundError
 from nornicdb_tpu.inference.engine import InferenceEngine
 from nornicdb_tpu.linkpredict.topology import build_graph, score_pair
 from nornicdb_tpu.storage.types import Engine
+from nornicdb_tpu.telemetry.metrics import count_error
+
+log = logging.getLogger(__name__)
 
 
 class TopologyIntegration:
@@ -118,8 +123,8 @@ class HeimdallQC:
             try:
                 a = self.storage.get_node(from_id)
                 b = self.storage.get_node(to_id)
-            except Exception:
-                out.append(False)
+            except NotFoundError:
+                out.append(False)  # endpoint deleted since suggestion
                 continue
             prompt = (
                 "Should these two memories be linked as "
@@ -130,7 +135,12 @@ class HeimdallQC:
             try:
                 text = self.manager.generate(prompt, max_tokens=16)
             except Exception:
-                out.append(True)  # QC failure must not block learning
+                # QC failure must not block learning — but a QC model
+                # that is ALWAYS down silently approves everything
+                log.warning("link-QC generation failed; keeping edge",
+                            exc_info=True)
+                count_error("inference.link_qc")
+                out.append(True)
                 continue
             self.reviewed += 1
             keep = True
@@ -139,8 +149,8 @@ class HeimdallQC:
                 if start >= 0:
                     obj = json.loads(text[start : text.rfind("}") + 1])
                     keep = bool(obj.get("keep", True))
-            except Exception:
-                keep = True
+            except ValueError:
+                keep = True  # non-JSON reply: fail open (keep the edge)
             if not keep:
                 self.rejected += 1
             out.append(keep)

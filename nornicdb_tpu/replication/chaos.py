@@ -1,19 +1,40 @@
-"""Chaos transport: fault injection for replication tests.
+"""Chaos transport: fault injection for replication tests and the soak
+harness.
 
 Behavioral reference: /root/reference/pkg/replication/chaos_test.go:446
 (ChaosTransport) — packet loss, latency (incl. cross-region spikes), data
 corruption, connection drops, duplication, reordering, mixed failures.
+
+Beyond the reference shape this transport also injects **receive-path**
+faults (drop/delay on delivery, independent of the send path) and
+**asymmetric partitions** (A→B blocked while B→A flows — the classic
+one-way network split that splits Raft quorums without either side
+noticing).  Fault counters live in the process metrics registry as
+``nornicdb_chaos_events_total{event=...}`` so a soak run reads them from
+``/metrics`` next to every other family; the per-instance ``stats`` dict
+remains for direct test introspection.
 """
 
 from __future__ import annotations
 
 import random
 import threading
-import time
 from dataclasses import dataclass
 
 from nornicdb_tpu.errors import ReplicationError
 from nornicdb_tpu.replication.transport import Message, Transport
+from nornicdb_tpu.telemetry.metrics import REGISTRY as _REGISTRY
+
+_EVENTS = (
+    "sent", "dropped", "duplicated", "corrupted", "reordered",
+    "rx_dropped", "rx_delayed", "partitioned",
+)
+_CHAOS_EVENTS = _REGISTRY.counter(
+    "nornicdb_chaos_events_total",
+    "Faults injected by ChaosTransport instances (send + receive path)",
+    labels=("event",),
+)
+_EVENT_CELLS = {e: _CHAOS_EVENTS.labels(e) for e in _EVENTS}
 
 
 @dataclass
@@ -25,24 +46,82 @@ class ChaosConfig:
     latency: float = 0.0  # fixed added latency (s)
     latency_jitter: float = 0.0
     drop_connections: bool = False  # every send raises
+    # receive-path faults: applied to DELIVERY on this node, after the
+    # sender's transport already did its work — models asymmetric links
+    # and NIC-side loss the sender cannot observe
+    rx_loss_rate: float = 0.0
+    rx_delay: float = 0.0
+    rx_delay_jitter: float = 0.0
     seed: int = 0
 
 
 class ChaosTransport(Transport):
-    """Wraps any Transport, injecting faults on the send path."""
+    """Wraps any Transport, injecting faults on the send AND receive path."""
 
     def __init__(self, inner: Transport, config: ChaosConfig):
         super().__init__(inner.node_id)
         self.inner = inner
         self.config = config
         self.rng = random.Random(config.seed)
-        self.stats = {"sent": 0, "dropped": 0, "duplicated": 0, "corrupted": 0,
-                      "reordered": 0}
+        # separate stream for delivery-side decisions: send and receive run
+        # on different threads, and sharing one RNG would make either path's
+        # sequence depend on the other's interleaving
+        self.rng_rx = random.Random(config.seed + 0x5EED)
+        self.stats = {e: 0 for e in _EVENTS}
+        # asymmetric partition: directed (src, dst) pairs that are blocked.
+        # Checked on the send path for (me -> peer) and on the receive path
+        # for (sender -> me), so one ChaosTransport can cut either direction
+        # of a link independently.
+        self._partitions: set[tuple[str, str]] = set()
+        self._plock = threading.Lock()
         # our handler chain must observe inner deliveries
         inner.set_handler(self._on_inner)
 
+    def _count(self, event: str) -> None:
+        self.stats[event] += 1
+        _EVENT_CELLS[event].inc()
+
+    # -- partitions ---------------------------------------------------------
+    def partition(self, src: str, dst: str) -> None:
+        """Block messages flowing src → dst (asymmetric: the reverse
+        direction keeps working unless partitioned separately)."""
+        with self._plock:
+            self._partitions.add((src, dst))
+
+    def partition_both(self, a: str, b: str) -> None:
+        with self._plock:
+            self._partitions.add((a, b))
+            self._partitions.add((b, a))
+
+    def heal(self, src: str | None = None, dst: str | None = None) -> None:
+        """Remove one directed block, or every block when called bare."""
+        with self._plock:
+            if src is None and dst is None:
+                self._partitions.clear()
+            else:
+                self._partitions.discard((src, dst))
+
+    def _blocked(self, src: str, dst: str) -> bool:
+        with self._plock:
+            return (src, dst) in self._partitions
+
+    # -- receive path -------------------------------------------------------
     def _on_inner(self, msg: Message):
-        self._deliver(msg)
+        cfg = self.config
+        if msg.sender and self._blocked(msg.sender, self.node_id):
+            self._count("partitioned")
+            return None
+        if cfg.rx_loss_rate and self.rng_rx.random() < cfg.rx_loss_rate:
+            self._count("rx_dropped")
+            return None
+        delay = cfg.rx_delay
+        if cfg.rx_delay_jitter:
+            delay += self.rng_rx.random() * cfg.rx_delay_jitter
+        if delay > 0:
+            self._count("rx_delayed")
+            threading.Timer(delay, self._deliver, args=(msg,)).start()
+        else:
+            self._deliver(msg)
         return None
 
     def set_handler(self, handler):
@@ -54,24 +133,28 @@ class ChaosTransport(Transport):
     def close(self):
         self.inner.close()
 
+    # -- send path ----------------------------------------------------------
     def send(self, peer: str, msg: Message) -> None:
         cfg = self.config
-        self.stats["sent"] += 1
+        self._count("sent")
         if cfg.drop_connections:
             raise ReplicationError("connection dropped (chaos)")
+        if self._blocked(self.node_id, peer):
+            self._count("partitioned")
+            return  # silently eaten by the split
         if self.rng.random() < cfg.loss_rate:
-            self.stats["dropped"] += 1
+            self._count("dropped")
             return  # silently lost
         if self.rng.random() < cfg.corrupt_rate:
-            self.stats["corrupted"] += 1
+            self._count("corrupted")
             msg = self._corrupt(msg)
         sends = 1
         if self.rng.random() < cfg.duplicate_rate:
-            self.stats["duplicated"] += 1
+            self._count("duplicated")
             sends = 2
         delay = cfg.latency + self.rng.random() * cfg.latency_jitter
         if self.rng.random() < cfg.reorder_rate:
-            self.stats["reordered"] += 1
+            self._count("reordered")
             delay += self.rng.random() * 0.05
         for _ in range(sends):
             if delay > 0:

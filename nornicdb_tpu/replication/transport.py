@@ -138,18 +138,29 @@ class Transport:
                     ev.set()
                     return
         if self.handler is not None:
-            if msg.traceparent:
-                # continue the sender's trace on this node: the handler's
-                # spans (raft append/commit, storage ops) record under the
-                # originating request's trace id
-                with _tracer.start_trace(
-                    f"replication.handle.{msg.type}",
-                    traceparent=msg.traceparent,
-                    attrs={"sender": msg.sender},
-                ):
+            try:
+                if msg.traceparent:
+                    # continue the sender's trace on this node: the
+                    # handler's spans (raft append/commit, storage ops)
+                    # record under the originating request's trace id
+                    with _tracer.start_trace(
+                        f"replication.handle.{msg.type}",
+                        traceparent=msg.traceparent,
+                        attrs={"sender": msg.sender},
+                    ):
+                        reply = self.handler(msg)
+                else:
                     reply = self.handler(msg)
-            else:
-                reply = self.handler(msg)
+            except Exception:
+                # a handler blown up by a garbage payload (chaos-corrupted
+                # frame, malformed peer) must not kill the delivery thread;
+                # the message is lost, which the sender already tolerates
+                from nornicdb_tpu.telemetry.metrics import count_error
+
+                count_error("replication.handler")
+                log.warning("message handler failed for type %s from %s",
+                            msg.type, msg.sender, exc_info=True)
+                return
             if reply is not None and msg.request_id:
                 reply.type = MSG_RESPONSE
                 reply.request_id = msg.request_id

@@ -68,8 +68,9 @@ def _is_tx_keyword(query: str) -> bool:
 class BoltSession:
     """Per-connection state machine (ref: Session server.go:815)."""
 
-    def __init__(self, server: "BoltServer"):
+    def __init__(self, server: "BoltServer", conn_no: int = 0):
         self.server = server
+        self.conn_no = conn_no
         self.authenticated = not server.auth_required
         # RBAC: role resolved at HELLO/LOGON, enforced per-RUN with the same
         # AST-based write classification as the HTTP tx endpoint (ref: Bolt
@@ -159,6 +160,11 @@ class BoltSession:
                 code = "Neo.ClientError.Schema.ConstraintValidationFailed"
             elif "Auth" in name:
                 code = "Neo.ClientError.Security.Unauthorized"
+            elif "Durability" in name:
+                # a WAL append failed durability (disk error / ENOSPC /
+                # injected storage fault): nothing was acked; transient so
+                # drivers back off and retry once the disk recovers
+                code = "Neo.TransientError.General.DatabaseUnavailable"
             elif "ResourceExhausted" in name:
                 # serving admission control shed work under this statement
                 # (embed/search queue full or deadline): a TRANSIENT code,
@@ -190,7 +196,11 @@ class BoltSession:
                 MSG_SUCCESS,
                 {
                     "server": f"NornicDB-TPU/{self.server.version}",
-                    "connection_id": f"bolt-{id(self):x}",
+                    # monotonic accept counter, not id() and not the
+                    # active-connection gauge (which decrements and would
+                    # reuse ids): deterministic for the transcribed wire
+                    # fixtures and collision-free for log correlation
+                    "connection_id": f"bolt-{self.conn_no}",
                 },
             )
         ]
@@ -365,7 +375,8 @@ class BoltServer:
         self._loop: Optional[asyncio.AbstractEventLoop] = None
         self._thread: Optional[threading.Thread] = None
         self._started = threading.Event()
-        self.connections = 0
+        self.connections = 0  # active-connection gauge (dec on close)
+        self._conn_seq = 0    # monotonic accept counter (never reused)
 
     # -- wire helpers --------------------------------------------------------
     @staticmethod
@@ -419,7 +430,8 @@ class BoltServer:
             if chosen == (0, 0):
                 writer.close()
                 return
-            session = BoltSession(self)
+            self._conn_seq += 1  # single-threaded: the server's event loop
+            session = BoltSession(self, conn_no=self._conn_seq)
             while True:
                 try:
                     raw = await self._read_message(reader)

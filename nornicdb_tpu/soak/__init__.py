@@ -1,0 +1,36 @@
+"""Sustained chaos/load soak harness (ROADMAP item 5b).
+
+Drives concurrent mixed-protocol traffic (Bolt, HTTP, gRPC search,
+Qdrant) plus replication and embed load against a live server stack
+while a seeded fault scheduler composes injectors across three planes —
+replication (``ChaosTransport``), backend (``FakeHooks`` lifecycle
+faults), and storage (deterministic WAL fsync/torn-tail/ENOSPC) — then
+asserts telemetry-backed invariants and emits ``SOAK_report.json``.
+
+Entry points::
+
+    python -m nornicdb_tpu.soak --scenario ci      # ~60 s gating profile
+    python -m nornicdb_tpu.soak --scenario full    # 5-minute scenario
+    make soak / make soak-ci
+
+See docs/chaos.md for the scenario spec, fault planes, invariant catalog,
+and how to reproduce a failed soak from its seed.
+"""
+
+from nornicdb_tpu.soak.harness import SoakHarness, run_scenario
+from nornicdb_tpu.soak.report import Collector, InvariantResult, SoakReport
+from nornicdb_tpu.soak.spec import (
+    CI,
+    FULL,
+    MICRO,
+    SCENARIOS,
+    FaultWindow,
+    ScenarioSpec,
+    WorkloadSpec,
+)
+
+__all__ = [
+    "SoakHarness", "run_scenario", "Collector", "InvariantResult",
+    "SoakReport", "ScenarioSpec", "WorkloadSpec", "FaultWindow",
+    "SCENARIOS", "CI", "FULL", "MICRO",
+]

@@ -1,0 +1,70 @@
+"""Soak CLI: ``python -m nornicdb_tpu.soak --scenario ci|full|micro``.
+
+Exit 0 when every invariant holds; 1 on any violation (the gating CI
+step keys off this).  ``--spec file.json`` runs a custom scenario;
+``--seed`` overrides the spec seed for reproduction runs.
+"""
+
+from __future__ import annotations
+
+import argparse
+import dataclasses
+import logging
+import sys
+import tempfile
+
+from nornicdb_tpu.soak.harness import run_scenario
+from nornicdb_tpu.soak.spec import SCENARIOS, ScenarioSpec
+
+
+def main(argv: list[str] | None = None) -> int:
+    ap = argparse.ArgumentParser(prog="python -m nornicdb_tpu.soak")
+    ap.add_argument("--scenario", default="ci", choices=sorted(SCENARIOS),
+                    help="built-in scenario profile (default: ci)")
+    ap.add_argument("--spec", default="",
+                    help="path to a custom scenario spec JSON")
+    ap.add_argument("--seed", type=int, default=None,
+                    help="override the spec seed (reproduction runs)")
+    ap.add_argument("--report", default="SOAK_report.json",
+                    help="report artifact path (default: SOAK_report.json)")
+    ap.add_argument("--workdir", default="",
+                    help="working directory (default: fresh tempdir)")
+    ap.add_argument("-v", "--verbose", action="store_true")
+    args = ap.parse_args(argv)
+
+    logging.basicConfig(
+        level=logging.INFO if args.verbose else logging.WARNING,
+        format="%(asctime)s %(name)s %(levelname)s %(message)s",
+    )
+
+    if args.spec:
+        with open(args.spec) as f:
+            spec = ScenarioSpec.from_json(f.read())
+    else:
+        spec = SCENARIOS[args.scenario]
+    if args.seed is not None:
+        spec = dataclasses.replace(spec, seed=args.seed)
+
+    print(f"soak: scenario={spec.name} seed={spec.seed} "
+          f"duration={spec.duration_s:.0f}s faults={len(spec.faults)}",
+          flush=True)
+    if args.workdir:
+        report = run_scenario(spec, args.workdir, args.report)
+    else:
+        with tempfile.TemporaryDirectory(prefix="nornicdb-soak-") as wd:
+            report = run_scenario(spec, wd, args.report)
+
+    for r in report.invariants:
+        mark = "PASS" if r.ok else "FAIL"
+        print(f"  [{mark}] {r.name}" + (f" — {r.detail}" if r.detail else ""))
+    for proto, summary in sorted(report.protocols.items()):
+        print(f"  {proto}: {summary['requests']} req "
+              f"p50={summary['p50_ms']}ms p99={summary['p99_ms']}ms "
+              f"outcomes={summary['outcomes']}")
+    print(f"soak: {'OK' if report.ok else 'INVARIANT VIOLATIONS'} "
+          f"in {report.wall_s:.1f}s; report -> {args.report}")
+    return 0 if report.ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

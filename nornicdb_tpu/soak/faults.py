@@ -1,0 +1,160 @@
+"""Seeded fault scheduler: composes injectors across the three planes.
+
+The scheduler owns the soak's fault timeline.  Windows come from the
+scenario spec as fixed offsets; at each boundary it calls the plane
+driver (provided by the harness) to start or clear the fault, records
+what actually executed (with real timestamps, for the report), and runs
+the plane's post-window recovery probe so a fault that never heals is
+caught at its own boundary instead of five minutes later.
+
+This module is deliberately mechanism-free: every actual injector lives
+with its subsystem (replication ``ChaosTransport``, backend ``FakeHooks``,
+``storage.faults.INJECTOR``) — the scheduler only sequences them, which
+is what makes three planes composable in one run.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+import time
+from typing import Any, Callable, Optional
+
+from nornicdb_tpu.soak.spec import FaultWindow
+
+log = logging.getLogger(__name__)
+
+
+class PlaneDriver:
+    """Interface the harness implements per fault plane."""
+
+    def start_fault(self, window: FaultWindow) -> None:
+        raise NotImplementedError
+
+    def clear_fault(self, window: FaultWindow) -> None:
+        raise NotImplementedError
+
+    def post_window_probe(self, window: FaultWindow) -> Optional[str]:
+        """Bounded recovery probe after the window clears.  Returns None
+        when healthy, else a violation description."""
+        return None
+
+
+class FaultScheduler:
+    """Runs the window timeline on its own thread."""
+
+    def __init__(self, windows: tuple, drivers: dict[str, PlaneDriver]):
+        self.windows = sorted(windows, key=lambda w: (w.at_s, w.end_s))
+        self.drivers = drivers
+        self.executed: list[dict[str, Any]] = []
+        self.probe_failures: list[str] = []
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self._t0 = 0.0
+
+    def last_fault_end_s(self) -> float:
+        return max((w.end_s for w in self.windows), default=0.0)
+
+    def start(self, t0: float) -> None:
+        self._t0 = t0
+        self._thread = threading.Thread(
+            target=self._run, name="soak-fault-scheduler", daemon=True)
+        self._thread.start()
+
+    def stop(self, join_timeout: float = 10.0) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(join_timeout)
+
+    def _now(self) -> float:
+        return time.monotonic() - self._t0
+
+    def _sleep_until(self, at_s: float) -> bool:
+        """False when stopping."""
+        while True:
+            delta = at_s - self._now()
+            if delta <= 0:
+                return not self._stop.is_set()
+            if self._stop.wait(min(delta, 0.2)):
+                return False
+
+    def _run(self) -> None:
+        # expand to boundary events, stable-ordered: starts before ends at
+        # identical timestamps would un-compose overlapping windows, so
+        # order purely by time then by kind of boundary (end first when
+        # simultaneous: a window must not bleed into its successor)
+        events: list[tuple[float, int, FaultWindow]] = []
+        for w in self.windows:
+            events.append((w.at_s, 1, w))
+            events.append((w.end_s, 0, w))
+        events.sort(key=lambda e: (e[0], e[1]))
+        for at_s, is_start, w in events:
+            if not self._sleep_until(at_s):
+                # harness is shutting down early: clear anything active
+                self._clear_all_active()
+                return
+            driver = self.drivers.get(w.plane)
+            if driver is None:
+                continue
+            if is_start:
+                log.info("soak fault start: %s/%s at t+%.1fs (%s)",
+                         w.plane, w.kind, self._now(), w.params)
+                rec = {"plane": w.plane, "kind": w.kind,
+                       "params": dict(w.params),
+                       "scheduled_at_s": w.at_s,
+                       "started_at_s": round(self._now(), 2)}
+                self.executed.append(rec)
+                try:
+                    driver.start_fault(w)
+                except Exception as e:
+                    rec["start_error"] = f"{type(e).__name__}: {e}"
+                    log.exception("fault start failed: %s/%s",
+                                  w.plane, w.kind)
+            else:
+                rec = self._find_record(w)
+                log.info("soak fault clear: %s/%s at t+%.1fs",
+                         w.plane, w.kind, self._now())
+                try:
+                    driver.clear_fault(w)
+                except Exception as e:
+                    if rec is not None:
+                        rec["clear_error"] = f"{type(e).__name__}: {e}"
+                    log.exception("fault clear failed: %s/%s",
+                                  w.plane, w.kind)
+                if rec is not None:
+                    rec["cleared_at_s"] = round(self._now(), 2)
+                try:
+                    problem = driver.post_window_probe(w)
+                except Exception as e:
+                    problem = f"probe raised {type(e).__name__}: {e}"
+                if problem:
+                    detail = f"{w.plane}/{w.kind} t+{w.at_s:.0f}s: {problem}"
+                    self.probe_failures.append(detail)
+                    if rec is not None:
+                        rec["probe_failure"] = problem
+                elif rec is not None:
+                    rec["recovered"] = True
+
+    def _find_record(self, w: FaultWindow) -> Optional[dict[str, Any]]:
+        for rec in reversed(self.executed):
+            if (rec["plane"] == w.plane and rec["kind"] == w.kind
+                    and rec["scheduled_at_s"] == w.at_s):
+                return rec
+        return None
+
+    def _clear_all_active(self) -> None:
+        cleared = {(r["plane"], r["kind"], r["scheduled_at_s"])
+                   for r in self.executed if "cleared_at_s" in r}
+        for w in self.windows:
+            if (w.plane, w.kind, w.at_s) in cleared:
+                continue
+            rec = self._find_record(w)
+            if rec is None:
+                continue  # never started
+            driver = self.drivers.get(w.plane)
+            try:
+                if driver is not None:
+                    driver.clear_fault(w)
+                rec["cleared_at_s"] = round(self._now(), 2)
+            except Exception:
+                log.exception("early-shutdown fault clear failed")

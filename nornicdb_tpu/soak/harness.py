@@ -1,0 +1,737 @@
+"""The soak harness: live serving stack + three fault planes + invariants.
+
+One :class:`SoakHarness` run boots a real server stack (HTTP, Bolt, gRPC
+search, Qdrant-over-HTTP) on a WAL-backed DB, a 3-node Raft cluster over
+chaos transports with WAL-backed state machines, and a fault-injected
+backend lifecycle manager — then drives mixed traffic through all of it
+while the seeded :class:`~nornicdb_tpu.soak.faults.FaultScheduler`
+composes faults across the planes.  After the drain phase it runs the
+telemetry-backed invariant catalog (soak/invariants.py) plus the two
+state-based invariants that need engine access:
+
+* **WAL crash recovery** — a crash-image copy of the serving WAL is
+  recovered into a fresh engine; every write acked to a client must be
+  present.  The same check runs in-soak for a crash-restarted Raft
+  leader (acceptance: "on both leader and reconverged follower").
+* **Replica convergence** — after failover/partition windows, all live
+  Raft nodes must reconverge to identical query results (node-id sets +
+  property checksums).
+
+Exit contract: ``run()`` returns a SoakReport; ``report.ok`` is the SLO.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+import shutil
+import threading
+import time
+import urllib.request
+import uuid
+from typing import Any, Optional
+
+from nornicdb_tpu.errors import NotFoundError
+from nornicdb_tpu.replication import (
+    ChaosConfig,
+    ChaosTransport,
+    InProcNetwork,
+    InProcTransport,
+    RaftConfig,
+    RaftNode,
+)
+from nornicdb_tpu.replication.raft import LEADER
+from nornicdb_tpu.soak import invariants as inv
+from nornicdb_tpu.soak.faults import FaultScheduler, PlaneDriver
+from nornicdb_tpu.soak.report import (
+    Collector,
+    SoakReport,
+    failed,
+    passed,
+    summarize,
+)
+from nornicdb_tpu.soak.spec import FaultWindow, ScenarioSpec
+from nornicdb_tpu.soak.workload import WorkloadRunner
+from nornicdb_tpu.storage import MemoryEngine, WAL, WALEngine
+from nornicdb_tpu.storage.faults import INJECTOR as _STORAGE_FAULTS
+
+log = logging.getLogger(__name__)
+
+_RAFT_CONFIG = RaftConfig(
+    heartbeat_interval=0.05,
+    election_timeout_min=0.3,
+    election_timeout_max=0.6,
+)
+
+
+def _wait(pred, timeout: float, interval: float = 0.05) -> bool:
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if pred():
+            return True
+        time.sleep(interval)
+    return bool(pred())
+
+
+# ---------------------------------------------------------------------------
+# Replication plane: 3-node Raft over chaos transports, WAL state machines
+# ---------------------------------------------------------------------------
+class ReplicationPlane(PlaneDriver):
+    N = 3
+
+    def __init__(self, workdir: str, seed: int, collector: Collector,
+                 deadline_s: float):
+        self.workdir = workdir
+        self.seed = seed
+        self.collector = collector
+        self.deadline_s = deadline_s
+        self.net = InProcNetwork()
+        self.ids = [f"node-{i}" for i in range(self.N)]
+        self.nodes: dict[str, RaftNode] = {}
+        self.chaos: dict[str, ChaosTransport] = {}
+        self.engines: dict[str, WALEngine] = {}
+        self.killed: Optional[str] = None
+        self._lock = threading.Lock()
+        self.checks: list[dict[str, Any]] = []  # in-soak recovery evidence
+        for i, nid in enumerate(self.ids):
+            self._build_node(i, nid, recovered=False)
+
+    # -- construction / restart --------------------------------------------
+    def _wal_dir(self, nid: str) -> str:
+        return os.path.join(self.workdir, f"raft-wal-{nid}")
+
+    def _build_node(self, i: int, nid: str, recovered: bool) -> WALEngine:
+        wal = WAL(self._wal_dir(nid))
+        base = MemoryEngine()
+        wal.recover(base)
+        eng = WALEngine(base, wal)
+        t = ChaosTransport(InProcTransport(nid, self.net),
+                           ChaosConfig(seed=self.seed + i))
+        node = RaftNode(nid, t, self.ids, storage=eng, config=_RAFT_CONFIG,
+                        seed=self.seed + i,
+                        state_dir=os.path.join(self.workdir, "raft-state"))
+        with self._lock:
+            self.nodes[nid] = node
+            self.chaos[nid] = t
+            self.engines[nid] = eng
+        return eng
+
+    def start(self) -> None:
+        for node in self.nodes.values():
+            node.start()
+
+    def stop(self) -> None:
+        for node in list(self.nodes.values()):
+            node.stop()
+        for t in list(self.chaos.values()):
+            t.close()
+        for eng in list(self.engines.values()):
+            try:
+                eng.wal.close()
+            except Exception:
+                log.debug("raft WAL close failed", exc_info=True)
+
+    def live_ids(self) -> list[str]:
+        with self._lock:
+            return [n for n in self.ids if n != self.killed]
+
+    def leader(self, timeout: float = 5.0) -> Optional[RaftNode]:
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            with self._lock:
+                live = [self.nodes[n] for n in self.ids
+                        if n != self.killed and n in self.nodes]
+            leaders = [n for n in live if n.state == LEADER]
+            if len(leaders) == 1:
+                return leaders[0]
+            time.sleep(0.02)
+        return None
+
+    # -- workload: the replication writer ----------------------------------
+    def write(self, uid: str) -> tuple[str, str]:
+        """Propose one write and wait for majority visibility (the ack).
+        Returns (outcome, detail); acked writes go into the collector."""
+        leader = self.leader(timeout=min(2.0, self.deadline_s / 2))
+        if leader is None:
+            return "unavailable", "no stable leader"
+        try:
+            leader.propose("create_node",
+                           {"id": uid, "labels": ["SoakR"],
+                            "properties": {"uid": uid}})
+        except Exception as e:
+            return "unavailable", f"propose: {type(e).__name__}"
+        majority = self.N // 2 + 1
+
+        def _visible() -> bool:
+            with self._lock:
+                engines = [self.engines[n] for n in self.ids
+                           if n != self.killed and n in self.engines]
+            seen = 0
+            for eng in engines:
+                try:
+                    eng.get_node(uid)
+                    seen += 1
+                except NotFoundError:
+                    continue  # not applied on this replica yet
+            return seen >= majority
+
+        if _wait(_visible, self.deadline_s):
+            self.collector.ack_write("raft", uid)
+            return "ok", ""
+        return "timeout", "no majority ack"
+
+    # -- convergence --------------------------------------------------------
+    def _node_fingerprint(self, eng: WALEngine) -> tuple[int, int]:
+        ids = [n.id for n in eng.all_nodes() if "SoakR" in n.labels]
+        return len(ids), hash(tuple(sorted(ids)))
+
+    def converged(self, timeout: float) -> tuple[bool, str]:
+        def _same() -> bool:
+            with self._lock:
+                engines = [self.engines[n] for n in self.ids
+                           if n != self.killed and n in self.engines]
+            prints = {self._node_fingerprint(e) for e in engines}
+            return len(prints) == 1
+
+        if _wait(_same, timeout, interval=0.1):
+            return True, ""
+        with self._lock:
+            detail = {
+                n: self._node_fingerprint(self.engines[n])[0]
+                for n in self.ids
+                if n != self.killed and n in self.engines
+            }
+        return False, f"node counts diverged: {detail}"
+
+    def acked_missing(self, eng: WALEngine, acked: set[str]) -> list[str]:
+        have = {n.id for n in eng.all_nodes()}
+        return sorted(acked - have)
+
+    # -- PlaneDriver --------------------------------------------------------
+    def start_fault(self, w: FaultWindow) -> None:
+        if w.kind == "chaos":
+            for i, nid in enumerate(self.ids):
+                t = self.chaos.get(nid)
+                if t is not None:
+                    t.config = ChaosConfig(seed=self.seed + i, **w.params)
+        elif w.kind == "partition":
+            # an election from a preceding window may still be in flight;
+            # a failed start now gates the soak, so wait it out (bounded)
+            leader = self.leader(timeout=10.0)
+            if leader is None:
+                raise RuntimeError("partition window with no stable leader")
+            direction = w.params.get("direction", "leader_to_followers")
+            lid = leader.node_id
+            followers = [n for n in self.live_ids() if n != lid]
+            for fid in followers:
+                if direction in ("leader_to_followers", "both"):
+                    self.chaos[lid].partition(lid, fid)
+                if direction in ("followers_to_leader", "both"):
+                    self.chaos[fid].partition(fid, lid)
+        elif w.kind == "leader_kill":
+            self._kill_leader()
+
+    def clear_fault(self, w: FaultWindow) -> None:
+        if w.kind == "chaos":
+            for i, nid in enumerate(self.ids):
+                t = self.chaos.get(nid)
+                if t is not None:
+                    t.config = ChaosConfig(seed=self.seed + i)
+        elif w.kind == "partition":
+            for t in self.chaos.values():
+                t.heal()
+        elif w.kind == "leader_kill":
+            self._restart_killed()
+
+    def post_window_probe(self, w: FaultWindow) -> Optional[str]:
+        ok, detail = self.converged(timeout=15.0)
+        if not ok:
+            return f"no reconvergence after window: {detail}"
+        # the cluster must also accept writes again WITHIN A BOUND — not
+        # instantly: an election can legitimately still be in flight the
+        # moment a chaos window clears, so retry until the bound
+        deadline = time.monotonic() + 20.0
+        attempt = 0
+        last = ""
+        while time.monotonic() < deadline:
+            attempt += 1
+            probe_uid = (f"probe-{w.kind}-{int(w.at_s)}-{attempt}-"
+                         f"{uuid.uuid4().hex[:6]}")
+            outcome, detail = self.write(probe_uid)
+            if outcome == "ok":
+                return None
+            last = f"{outcome}: {detail}"
+            time.sleep(0.5)
+        return f"post-window writes still failing after 20s ({last})"
+
+    # -- leader crash / crash-restart ---------------------------------------
+    def _kill_leader(self) -> None:
+        leader = self.leader(timeout=10.0)
+        if leader is None:
+            raise RuntimeError("leader_kill window with no stable leader")
+        nid = leader.node_id
+        log.info("soak: crashing raft leader %s", nid)
+        # snapshot what was acked BEFORE the crash, then wait (bounded)
+        # until that set has propagated to every live node: the recovery
+        # invariant is exact only against a set the doomed node had fully
+        # applied — writes acked by the NEW leader during the down window
+        # legitimately miss its WAL
+        acked_before = self.collector.acked("raft")
+
+        def _all_have() -> bool:
+            with self._lock:
+                engines = list(self.engines.values())
+            return all(not self.acked_missing(e, acked_before)
+                       for e in engines)
+
+        propagated = _wait(_all_have, 10.0)
+        self._acked_at_crash = acked_before
+        self._acked_propagated = propagated
+        leader.stop()
+        self.chaos[nid].close()
+        with self._lock:
+            eng = self.engines.pop(nid)
+            self.nodes.pop(nid)
+            self.chaos.pop(nid)
+            self.killed = nid
+        # crash semantics: close ONLY the file handle (no compaction, no
+        # snapshot) — the log must be replayable exactly as it was at the
+        # moment of death
+        eng.wal.close()
+        self.checks.append({
+            "check": "leader_crash", "node": nid,
+            "acked_at_crash": len(acked_before),
+            "acked_propagated_before_crash": propagated,
+        })
+
+    def _restart_killed(self) -> None:
+        with self._lock:
+            nid = self.killed
+        if nid is None:
+            return
+        i = self.ids.index(nid)
+        acked_before = getattr(self, "_acked_at_crash", set())
+        propagated = getattr(self, "_acked_propagated", True)
+        eng = self._build_node(i, nid, recovered=True)
+        # WAL-recovery invariant, leader side: every write acked before the
+        # crash must already be present from recovery alone, BEFORE the
+        # raft log resync tops the node up.  Only exact when the pre-crash
+        # propagation wait completed — if the doomed node still lagged (a
+        # preceding chaos window can delay commit propagation past the
+        # bound), the check is inconclusive, not a durability violation
+        missing = self.acked_missing(eng, acked_before)
+        self.checks.append({
+            "check": "leader_wal_recovery", "node": nid,
+            "acked": len(acked_before), "missing": missing[:10],
+            "propagated": propagated,
+            "ok": not missing or not propagated,
+            "inconclusive": bool(missing) and not propagated,
+        })
+        with self._lock:
+            self.killed = None
+        self.nodes[nid].start()
+        log.info("soak: crash-restarted raft node %s (recovered %d acked "
+                 "writes, %d missing)", nid, len(acked_before), len(missing))
+
+    def stats(self) -> dict[str, Any]:
+        with self._lock:
+            chaos_stats = {nid: dict(t.stats)
+                           for nid, t in self.chaos.items()}
+            counts = {nid: self.engines[nid].node_count()
+                      for nid in self.engines}
+        return {"chaos": chaos_stats, "node_counts": counts,
+                "checks": self.checks}
+
+
+# ---------------------------------------------------------------------------
+# Backend plane: FakeHooks on the process-default lifecycle manager
+# ---------------------------------------------------------------------------
+class BackendPlane(PlaneDriver):
+    def __init__(self):
+        from nornicdb_tpu import backend
+        from nornicdb_tpu.backend import FakeHooks
+
+        self.backend = backend
+        self.hooks = FakeHooks(mode="ok", delay=0.5)
+        backend.reset_default()
+        backend.configure(
+            acquire_timeout=2.0, probe_interval=0.2, probe_timeout=1.0,
+            probe_latency_threshold=5.0, degrade_after=2, recover_after=2,
+            hooks=self.hooks,
+        )
+        self.manager = backend.manager()
+        self.manager.ensure_started()
+
+    def await_ready(self, timeout: float = 10.0) -> bool:
+        return _wait(lambda: self.manager.state == "READY", timeout)
+
+    def start_fault(self, w: FaultWindow) -> None:
+        self.hooks.set_mode(w.kind)  # hang | fail | slow
+
+    def clear_fault(self, w: FaultWindow) -> None:
+        self.hooks.set_mode("ok")
+        self.hooks.release()
+
+    def post_window_probe(self, w: FaultWindow) -> Optional[str]:
+        # recovery needs degrade_after probe failures to have landed and
+        # recover_after green probes after the heal: bounded, not instant
+        if not self.await_ready(timeout=20.0):
+            return (f"backend stuck in {self.manager.state} after "
+                    f"{w.kind} window cleared")
+        return None
+
+    def shutdown(self) -> None:
+        self.hooks.set_mode("ok")
+        self.hooks.release()
+        self.backend.reset_default()
+        self.backend.configure()  # drop soak kwargs for later consumers
+
+    def stats(self) -> dict[str, Any]:
+        return self.manager.stats()
+
+
+# ---------------------------------------------------------------------------
+# Storage plane: deterministic WAL fault windows on the serving DB
+# ---------------------------------------------------------------------------
+class StoragePlane(PlaneDriver):
+    def __init__(self, db, wal_path_prefix: str):
+        self.db = db
+        self.prefix = wal_path_prefix
+
+    def start_fault(self, w: FaultWindow) -> None:
+        count = int(w.params.get("count", 10_000))
+        _STORAGE_FAULTS.arm(w.kind, count=count, path_prefix=self.prefix)
+
+    def clear_fault(self, w: FaultWindow) -> None:
+        _STORAGE_FAULTS.disarm(w.kind)
+
+    def post_window_probe(self, w: FaultWindow) -> Optional[str]:
+        # the WAL must accept writes again immediately after disarm
+        try:
+            self.db.cypher("CREATE (:SoakProbe {k: 1})")
+        except Exception as e:
+            return f"write after {w.kind} window failed: {e}"
+        return None
+
+    def fired(self) -> dict[str, int]:
+        return dict(_STORAGE_FAULTS.fired)
+
+
+# ---------------------------------------------------------------------------
+# The harness
+# ---------------------------------------------------------------------------
+class SoakHarness:
+    def __init__(self, spec: ScenarioSpec, workdir: str,
+                 report_path: Optional[str] = None):
+        self.spec = spec
+        self.workdir = workdir
+        self.report_path = report_path
+        self.notes: list[str] = []
+
+    # -- serving stack ------------------------------------------------------
+    def _boot_stack(self):
+        import nornicdb_tpu
+        from nornicdb_tpu.db import Config
+        from nornicdb_tpu.embed.base import HashEmbedder
+        from nornicdb_tpu.server.bolt import BoltServer
+        from nornicdb_tpu.server.http import HttpServer
+
+        serving_dir = os.path.join(self.workdir, "serving")
+        cfg = Config(
+            # sync chain + fsync'd WAL: an HTTP/Bolt ack must imply the
+            # record is durable (the crash-recovery invariant is ack-
+            # based), and the fsync seam must be live for the
+            # fsync_fail storage fault windows to inject anything
+            async_writes=False,
+            wal_sync=True,
+            inference_enabled=False,
+            auto_compact=False,
+        )
+        db = nornicdb_tpu.DB(serving_dir, cfg)
+        db.set_embedder(HashEmbedder(64))
+        http = HttpServer(db, port=0, serve_ui=False)
+        http.start()
+        bolt = BoltServer(
+            lambda q, p, d: db.executor.execute(q, p),
+            port=0,
+            session_executor_factory=db.session_executor,
+        )
+        bolt.start()
+        grpc_srv = None
+        if self.spec.workload.grpc_workers > 0:
+            try:
+                from nornicdb_tpu.server.grpc_search import GrpcSearchServer
+
+                grpc_srv = GrpcSearchServer(db, port=0)
+                grpc_srv.start()
+            except ImportError:
+                self.notes.append("grpcio unavailable: gRPC plane skipped")
+        # the Qdrant workload needs its collection up front
+        from nornicdb_tpu.soak.workload import VECTOR_DIM
+
+        body = json.dumps(
+            {"vectors": {"size": VECTOR_DIM, "distance": "Cosine"}}).encode()
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{http.port}/collections/soak",
+            data=body, method="PUT",
+            headers={"Content-Type": "application/json"})
+        with urllib.request.urlopen(req, timeout=10) as resp:
+            if resp.status != 200:
+                raise RuntimeError("qdrant collection bootstrap failed")
+        return db, http, bolt, grpc_srv, serving_dir
+
+    def _fetch(self, port: int, path: str) -> bytes:
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}{path}", timeout=30) as resp:
+            return resp.read()
+
+    # -- serving WAL crash-recovery check -----------------------------------
+    def _check_serving_wal_recovery(self, serving_dir: str,
+                                    acked: set[str]):
+        """Copy the live WAL as a crash image (the serving chain is
+        synchronous, so every acked write has been appended+flushed),
+        recover it into a fresh engine, and require every acked uid."""
+        wal_dir = os.path.join(serving_dir, "wal")
+        crash_dir = os.path.join(self.workdir, "crash-image")
+        shutil.copytree(wal_dir, crash_dir)
+        wal = WAL(crash_dir)
+        base = MemoryEngine()
+        wal.recover(base)
+        wal.close()
+        have = set()
+        for n in base.all_nodes():
+            uid = n.properties.get("uid")
+            if uid:
+                have.add(uid)
+        missing = sorted(acked - have)
+        if missing:
+            return failed(
+                "wal_crash_recovery",
+                f"{len(missing)}/{len(acked)} acked writes missing after "
+                f"crash recovery: {missing[:5]}",
+            )
+        return passed("wal_crash_recovery",
+                      f"all {len(acked)} acked writes recovered")
+
+    # -- the run ------------------------------------------------------------
+    def run(self) -> SoakReport:
+        spec = self.spec
+        t_start = time.monotonic()
+        report = SoakReport(scenario=spec.to_dict())
+        report.notes = self.notes
+        collector = Collector(t_start)
+
+        backend_plane = BackendPlane()
+        db, http, bolt, grpc_srv, serving_dir = self._boot_stack()
+        repl = ReplicationPlane(self.workdir, spec.seed, collector,
+                                spec.workload.deadline_s)
+        storage_plane = StoragePlane(
+            db, os.path.join(serving_dir, "wal"))
+        scheduler = FaultScheduler(spec.faults, drivers={
+            "replication": repl,
+            "backend": backend_plane,
+            "storage": storage_plane,
+        })
+        runner = WorkloadRunner(
+            spec,
+            {"http": http.port, "bolt": bolt.port,
+             "grpc": grpc_srv.port if grpc_srv is not None else 0},
+            collector, spec.seed)
+
+        repl_stop = threading.Event()
+        repl_threads: list[threading.Thread] = []
+
+        def _repl_writer(idx: int) -> None:
+            import random as _random
+
+            rng = _random.Random(spec.seed * 5000 + idx)
+            n = 0
+            name = f"repl-{idx}"
+            while not repl_stop.is_set():
+                runner.heartbeat.beat(name)
+                n += 1
+                uid = f"r{idx}-{n}-{uuid.uuid4().hex[:8]}"
+                t0 = time.monotonic()
+                outcome, detail = repl.write(uid)
+                collector.record("replication", "propose", outcome,
+                                 time.monotonic() - t0, detail)
+                repl_stop.wait(max(0.02, spec.workload.think_s)
+                               * (0.5 + rng.random()))
+            runner.heartbeat.forget(name)
+
+        try:
+            backend_plane.await_ready(10.0)
+            repl.start()
+            if repl.leader(timeout=15.0) is None:
+                raise RuntimeError("raft cluster failed to elect a leader")
+            runner.start()
+            if spec.workload.replication_writers > 0:
+                runner.protocols.append("replication")
+            for i in range(spec.workload.replication_writers):
+                t = threading.Thread(target=_repl_writer, args=(i,),
+                                     name=f"soak-repl-{i}", daemon=True)
+                t.start()
+                repl_threads.append(t)
+            scheduler.start(t_start)
+
+            # watchdog: a worker silent past deadline+grace is a wedge
+            wedge_bound = spec.workload.deadline_s + spec.workload.grace_s
+            wedged_live: set[str] = set()
+            end = t_start + spec.duration_s
+            while time.monotonic() < end:
+                time.sleep(0.25)
+                for name in runner.heartbeat.stale(wedge_bound):
+                    wedged_live.add(name)
+
+            # -- shutdown of traffic ----------------------------------------
+            scheduler.stop()
+            repl_stop.set()
+            join_bound = spec.workload.deadline_s + spec.workload.grace_s
+            wedged = runner.stop(join_timeout=join_bound)
+            for t in repl_threads:
+                t.join(join_bound)
+                if t.is_alive():
+                    wedged.append(t.name)
+
+            # -- invariants --------------------------------------------------
+            samples = collector.samples()
+            report.protocols = summarize(samples)
+            report.faults_executed = scheduler.executed
+            w = spec.workload
+
+            if wedged or wedged_live:
+                report.invariants.append(failed(
+                    "no_wedged_threads",
+                    f"wedged at join: {wedged}; "
+                    f"silent past bound mid-run: {sorted(wedged_live)}"))
+            else:
+                report.invariants.append(passed(
+                    "no_wedged_threads",
+                    f"{len(runner.threads) + len(repl_threads)} workers "
+                    "exited cleanly"))
+            report.invariants.append(
+                inv.check_bounded_latency(samples, w.deadline_s, w.grace_s))
+            report.invariants.append(inv.check_no_illegal_errors(samples))
+            report.invariants.append(inv.check_protocol_liveness(
+                samples, runner.protocols, scheduler.last_fault_end_s()))
+            for pf in scheduler.probe_failures:
+                report.invariants.append(failed("post_window_recovery", pf))
+            if not scheduler.probe_failures and spec.faults:
+                report.invariants.append(passed(
+                    "post_window_recovery",
+                    f"{len(scheduler.executed)} fault windows recovered"))
+            # a fault window that failed to START (or clear) means the
+            # coverage this soak claims never executed — that must gate,
+            # not hide in the report
+            broken = [
+                f"{r['plane']}/{r['kind']}@{r['scheduled_at_s']}s: "
+                + r.get("start_error", r.get("clear_error", ""))
+                for r in scheduler.executed
+                if "start_error" in r or "clear_error" in r
+            ]
+            if broken:
+                report.invariants.append(failed(
+                    "faults_injected", "; ".join(broken)))
+            elif spec.faults:
+                report.invariants.append(passed(
+                    "faults_injected",
+                    f"all {len(scheduler.executed)} windows started and "
+                    "cleared"))
+
+            # telemetry-backed checks against the live exposition
+            metrics_text = self._fetch(http.port, "/metrics").decode()
+            traces = json.loads(self._fetch(http.port, "/admin/traces"))
+            report.invariants.append(
+                inv.check_metrics_wellformed(metrics_text))
+            report.invariants.append(inv.check_traces_wellformed(traces))
+            report.invariants.append(inv.check_backend_ready(metrics_text))
+            report.invariants.append(inv.check_chaos_in_metrics(
+                metrics_text,
+                [dict(t.stats) for t in repl.chaos.values()]))
+            fams = inv.parse_prometheus(metrics_text)
+            report.chaos_events = {
+                "".join(k): v for k, v in
+                fams.get("nornicdb_chaos_events_total", {}).items()
+            }
+            report.storage_faults = {
+                "".join(k): v for k, v in
+                fams.get("nornicdb_storage_faults_injected_total",
+                         {}).items()
+            }
+
+            # replication: final convergence + acked-write presence on
+            # every node (leader AND followers — the reconverged-follower
+            # half of the acceptance criterion)
+            ok, detail = repl.converged(timeout=20.0)
+            acked_raft = collector.acked("raft")
+            if not ok:
+                report.invariants.append(
+                    failed("replica_convergence", detail))
+            else:
+                missing_by_node = {
+                    nid: repl.acked_missing(eng, acked_raft)
+                    for nid, eng in repl.engines.items()
+                }
+                bad = {n: m[:5] for n, m in missing_by_node.items() if m}
+                if bad:
+                    report.invariants.append(failed(
+                        "replica_convergence",
+                        f"acked raft writes missing after convergence: "
+                        f"{bad}"))
+                else:
+                    report.invariants.append(passed(
+                        "replica_convergence",
+                        f"{len(acked_raft)} acked writes on all "
+                        f"{len(repl.engines)} replicas"))
+            # the in-soak leader crash-recovery evidence recorded by the
+            # restart handler
+            for chk in repl.checks:
+                if chk.get("check") == "leader_wal_recovery":
+                    if chk.get("inconclusive"):
+                        report.invariants.append(passed(
+                            "leader_wal_recovery",
+                            f"inconclusive: node {chk['node']} had not "
+                            "fully caught up when crashed (propagation "
+                            "wait timed out); final convergence check "
+                            "still covers its acked writes"))
+                    elif chk["ok"]:
+                        report.invariants.append(passed(
+                            "leader_wal_recovery",
+                            f"node {chk['node']} recovered "
+                            f"{chk['acked']} acked writes from its WAL"))
+                    else:
+                        report.invariants.append(failed(
+                            "leader_wal_recovery",
+                            f"node {chk['node']} missing {chk['missing']}"))
+
+            report.backend = backend_plane.stats()
+            report.replication = repl.stats()
+
+        finally:
+            repl_stop.set()
+            runner.stop_event.set()
+            scheduler.stop()
+            _STORAGE_FAULTS.disarm()
+            if grpc_srv is not None:
+                grpc_srv.stop()
+            bolt.stop()
+            http.stop()
+            repl.stop()
+
+        # serving WAL crash image BEFORE db.close() (close compacts — a
+        # clean shutdown, not a crash)
+        report.invariants.append(self._check_serving_wal_recovery(
+            serving_dir, collector.acked("serving")))
+        db.close()
+        backend_plane.shutdown()
+
+        report.wall_s = time.monotonic() - t_start
+        if self.report_path:
+            report.write(self.report_path)
+        return report
+
+
+def run_scenario(spec: ScenarioSpec, workdir: str,
+                 report_path: Optional[str] = None) -> SoakReport:
+    return SoakHarness(spec, workdir, report_path).run()

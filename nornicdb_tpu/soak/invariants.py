@@ -1,0 +1,202 @@
+"""Telemetry-backed soak invariants.
+
+Each check returns :class:`~nornicdb_tpu.soak.report.InvariantResult`; the
+harness runs the full catalog after the drain phase (plus targeted checks
+at fault-window boundaries) and fails the soak on any violation.  The
+catalog is sourced from the tested telemetry stack (PR 5): if /metrics or
+/admin/traces can't prove the property, the soak can't pass it.
+
+Catalog:
+
+* ``bounded_latency``   — no request exceeded deadline+grace wall time
+                          (a call past its bound means a wedged thread)
+* ``no_illegal_errors`` — every failure is in the legal taxonomy
+                          (rejected/unavailable/timeout); ``error`` = 0
+* ``protocol_liveness`` — each protocol served at least one ``ok`` request
+                          AFTER the last fault window ended (recovered)
+* ``metrics_wellformed``— /metrics parses strictly; every histogram's
+                          +Inf bucket equals its _count and buckets are
+                          monotone; request counters cover recorded samples
+* ``traces_wellformed`` — /admin/traces parses; every entry has identity,
+                          duration and span_count; recent traffic is there
+* ``backend_ready``     — nornicdb_backend_state one-hot with READY=1
+* ``chaos_in_metrics``  — nornicdb_chaos_events_total in /metrics covers
+                          the per-instance stats (the registry is the
+                          source of truth for soak reports)
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+from nornicdb_tpu.soak.report import (
+    InvariantResult,
+    Sample,
+    failed,
+    metric_total,
+    parse_prometheus,
+    passed,
+)
+
+
+def check_bounded_latency(samples: list[Sample], deadline_s: float,
+                          grace_s: float) -> InvariantResult:
+    bound = deadline_s + grace_s
+    over = [s for s in samples if s.latency_s > bound]
+    if over:
+        worst = max(over, key=lambda s: s.latency_s)
+        return failed(
+            "bounded_latency",
+            f"{len(over)} requests exceeded {bound:.1f}s wall time; worst "
+            f"{worst.protocol}/{worst.op} at {worst.latency_s:.2f}s",
+        )
+    return passed("bounded_latency",
+                  f"all {len(samples)} requests within {bound:.1f}s")
+
+
+def check_no_illegal_errors(samples: list[Sample]) -> InvariantResult:
+    bad = [s for s in samples if s.outcome == "error"]
+    if bad:
+        heads = {s.detail or f"{s.protocol}/{s.op}" for s in bad[:20]}
+        return failed(
+            "no_illegal_errors",
+            f"{len(bad)} requests failed outside the legal taxonomy: "
+            f"{sorted(heads)[:5]}",
+        )
+    return passed("no_illegal_errors")
+
+
+def check_protocol_liveness(samples: list[Sample], protocols: list[str],
+                            after_s: float) -> InvariantResult:
+    """Every active protocol must have served OK traffic after the last
+    fault window — proves the stack recovered, not just survived."""
+    missing = []
+    for proto in protocols:
+        if not any(s.protocol == proto and s.outcome == "ok"
+                   and s.at_s >= after_s for s in samples):
+            missing.append(proto)
+    if missing:
+        return failed(
+            "protocol_liveness",
+            f"no successful request after t+{after_s:.0f}s on: {missing}",
+        )
+    return passed("protocol_liveness",
+                  f"all of {protocols} recovered after t+{after_s:.0f}s")
+
+
+def check_metrics_wellformed(metrics_text: str,
+                             min_requests: int = 0) -> InvariantResult:
+    try:
+        fams = parse_prometheus(metrics_text)
+    except ValueError as e:
+        return failed("metrics_wellformed", str(e))
+    if not fams:
+        return failed("metrics_wellformed", "empty exposition")
+    # histogram consistency: group _bucket families by base name
+    problems: list[str] = []
+    for name in [n for n in fams if n.endswith("_bucket")]:
+        base = name[: -len("_bucket")]
+        cells = fams[name]
+        count_fam = fams.get(base + "_count", {})
+        # group buckets by their non-le labels
+        groups: dict[tuple, list[tuple[float, float]]] = {}
+        for labels, v in cells.items():
+            le = None
+            rest = []
+            for lab in labels:
+                if lab.startswith("le="):
+                    raw = lab[4:-1]
+                    le = float("inf") if raw == "+Inf" else float(raw)
+                else:
+                    rest.append(lab)
+            groups.setdefault(tuple(rest), []).append((le, v))
+        for rest, buckets in groups.items():
+            buckets.sort(key=lambda x: x[0])
+            vals = [v for _, v in buckets]
+            if any(b > a for a, b in zip(vals[1:], vals)):
+                problems.append(f"{base}{rest}: non-monotone buckets")
+                continue
+            inf_v = buckets[-1][1] if buckets else 0.0
+            cnt = count_fam.get(tuple(rest))
+            if cnt is not None and cnt != inf_v:
+                problems.append(
+                    f"{base}{rest}: _count {cnt} != +Inf bucket {inf_v}"
+                )
+    if problems:
+        return failed("metrics_wellformed", "; ".join(problems[:5]))
+    detail = f"{len(fams)} families"
+    if min_requests:
+        served = metric_total(fams, "nornicdb_http_requests_total")
+        if served is not None and served < min_requests:
+            return failed(
+                "metrics_wellformed",
+                f"http request counter {served} < recorded {min_requests}",
+            )
+    return passed("metrics_wellformed", detail)
+
+
+def check_traces_wellformed(traces_payload: dict[str, Any]) -> InvariantResult:
+    traces = traces_payload.get("traces")
+    if not isinstance(traces, list):
+        return failed("traces_wellformed", "payload has no traces list")
+    if not traces:
+        return failed("traces_wellformed", "no traces captured under load")
+    for t in traces:
+        for key in ("trace_id", "root", "duration_ms", "span_count"):
+            if key not in t:
+                return failed("traces_wellformed",
+                              f"trace entry missing {key!r}: {t}")
+        if not t["trace_id"]:
+            return failed("traces_wellformed", "empty trace_id")
+        if t["duration_ms"] < 0:
+            return failed("traces_wellformed",
+                          f"negative duration in {t['trace_id']}")
+    return passed("traces_wellformed", f"{len(traces)} traces")
+
+
+def check_backend_ready(metrics_text: str) -> InvariantResult:
+    try:
+        fams = parse_prometheus(metrics_text)
+    except ValueError as e:
+        return failed("backend_ready", f"metrics unparseable: {e}")
+    states = fams.get("nornicdb_backend_state")
+    if not states:
+        return failed("backend_ready", "nornicdb_backend_state not exposed")
+    hot = {labels[0]: v for labels, v in states.items() if v == 1.0}
+    if list(hot) != ['state="READY"']:
+        return failed("backend_ready",
+                      f"backend state one-hot is {hot or 'all-zero'}, "
+                      "want READY=1")
+    return passed("backend_ready")
+
+
+def check_chaos_in_metrics(metrics_text: str,
+                           instance_stats: list[dict[str, int]]
+                           ) -> InvariantResult:
+    """The registry counters must cover (>=) the per-instance stats dicts:
+    soak reports read /metrics, so an event that only lives in an instance
+    dict would be invisible to operators."""
+    try:
+        fams = parse_prometheus(metrics_text)
+    except ValueError as e:
+        return failed("chaos_in_metrics", f"metrics unparseable: {e}")
+    fam = fams.get("nornicdb_chaos_events_total")
+    if fam is None:
+        return failed("chaos_in_metrics",
+                      "nornicdb_chaos_events_total not exposed")
+    by_event: dict[str, float] = {}
+    for labels, v in fam.items():
+        for lab in labels:
+            if lab.startswith("event="):
+                by_event[lab[7:-1]] = v
+    want: dict[str, int] = {}
+    for st in instance_stats:
+        for k, v in st.items():
+            want[k] = want.get(k, 0) + v
+    short = {k: (by_event.get(k, 0.0), v) for k, v in want.items()
+             if by_event.get(k, 0.0) < v}
+    if short:
+        return failed("chaos_in_metrics",
+                      f"registry counters below instance stats: {short}")
+    total = sum(want.values())
+    return passed("chaos_in_metrics", f"{total} instance events covered")

@@ -1,0 +1,227 @@
+"""Soak results: thread-safe sample collection, error taxonomy, and the
+committed ``SOAK_report.json`` artifact.
+
+Outcome taxonomy (every request lands in exactly one bucket):
+
+* ``ok``          — completed successfully within its deadline
+* ``rejected``    — legal shed: HTTP 429, gRPC RESOURCE_EXHAUSTED, Bolt
+                    ``Neo.TransientError.*`` (admission control / backoff)
+* ``unavailable`` — typed transient failure while a fault window held the
+                    resource (durability errors, replication leaderless
+                    spans, connection refused during a kill window)
+* ``timeout``     — the client-side deadline fired and the call returned
+                    at the bound (bounded, so not a wedge by itself)
+* ``error``       — anything else: unexpected status, exception class, or
+                    malformed response.  Always an invariant violation.
+
+Latency for every bucket counts toward the wedge invariant: a call whose
+wall time exceeds deadline+grace means a thread was stuck past its bound.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Any, Optional
+
+OUTCOMES = ("ok", "rejected", "unavailable", "timeout", "error")
+
+
+@dataclass
+class Sample:
+    protocol: str
+    op: str
+    outcome: str
+    latency_s: float
+    at_s: float          # offset from soak start
+    detail: str = ""     # error code / short message for non-ok outcomes
+
+
+class Collector:
+    """Append-only sample sink shared by every workload worker."""
+
+    def __init__(self, t0: float):
+        self._lock = threading.Lock()
+        self._samples: list[Sample] = []
+        self._acked: dict[str, set[str]] = {}  # plane -> acked write ids
+        self.t0 = t0
+
+    def record(self, protocol: str, op: str, outcome: str,
+               latency_s: float, detail: str = "") -> None:
+        if outcome not in OUTCOMES:
+            raise ValueError(f"unknown outcome {outcome!r}")
+        s = Sample(protocol, op, outcome, latency_s,
+                   time.monotonic() - self.t0, detail)
+        with self._lock:
+            self._samples.append(s)
+
+    def ack_write(self, plane: str, write_id: str) -> None:
+        """A write was acked to the client — it must survive recovery."""
+        with self._lock:
+            self._acked.setdefault(plane, set()).add(write_id)
+
+    def acked(self, plane: str) -> set[str]:
+        with self._lock:
+            return set(self._acked.get(plane, ()))
+
+    def samples(self) -> list[Sample]:
+        with self._lock:
+            return list(self._samples)
+
+
+def percentile(sorted_vals: list[float], q: float) -> float:
+    """Nearest-rank percentile on a pre-sorted list (q in [0, 1])."""
+    if not sorted_vals:
+        return 0.0
+    idx = min(len(sorted_vals) - 1, max(0, int(q * len(sorted_vals))))
+    return sorted_vals[idx]
+
+
+def summarize(samples: list[Sample]) -> dict[str, Any]:
+    """Per-protocol p50/p99/max + outcome counts + error details."""
+    by_proto: dict[str, list[Sample]] = {}
+    for s in samples:
+        by_proto.setdefault(s.protocol, []).append(s)
+    out: dict[str, Any] = {}
+    for proto, ss in sorted(by_proto.items()):
+        lat = sorted(x.latency_s for x in ss)
+        outcomes = {o: 0 for o in OUTCOMES}
+        details: dict[str, int] = {}
+        for x in ss:
+            outcomes[x.outcome] += 1
+            if x.outcome != "ok" and x.detail:
+                details[x.detail] = details.get(x.detail, 0) + 1
+        out[proto] = {
+            "requests": len(ss),
+            "outcomes": outcomes,
+            "p50_ms": round(percentile(lat, 0.50) * 1e3, 3),
+            "p99_ms": round(percentile(lat, 0.99) * 1e3, 3),
+            "max_ms": round((lat[-1] if lat else 0.0) * 1e3, 3),
+            "errors": dict(sorted(details.items(),
+                                  key=lambda kv: -kv[1])[:10]),
+        }
+    return out
+
+
+@dataclass
+class InvariantResult:
+    name: str
+    ok: bool
+    detail: str = ""
+
+    def as_dict(self) -> dict[str, Any]:
+        return {"name": self.name, "ok": self.ok, "detail": self.detail}
+
+
+@dataclass
+class SoakReport:
+    scenario: dict[str, Any]
+    protocols: dict[str, Any] = field(default_factory=dict)
+    invariants: list[InvariantResult] = field(default_factory=list)
+    faults_executed: list[dict[str, Any]] = field(default_factory=list)
+    chaos_events: dict[str, float] = field(default_factory=dict)
+    storage_faults: dict[str, float] = field(default_factory=dict)
+    backend: dict[str, Any] = field(default_factory=dict)
+    replication: dict[str, Any] = field(default_factory=dict)
+    notes: list[str] = field(default_factory=list)
+    wall_s: float = 0.0
+
+    @property
+    def ok(self) -> bool:
+        return all(r.ok for r in self.invariants)
+
+    def as_dict(self) -> dict[str, Any]:
+        return {
+            "ok": self.ok,
+            "scenario": self.scenario,
+            "wall_s": round(self.wall_s, 2),
+            "protocols": self.protocols,
+            "invariants": [r.as_dict() for r in self.invariants],
+            "faults_executed": self.faults_executed,
+            "chaos_events": self.chaos_events,
+            "storage_faults": self.storage_faults,
+            "backend": self.backend,
+            "replication": self.replication,
+            "notes": self.notes,
+        }
+
+    def write(self, path: str) -> None:
+        with open(path, "w") as f:
+            json.dump(self.as_dict(), f, indent=2, sort_keys=True)
+            f.write("\n")
+
+    def violations(self) -> list[InvariantResult]:
+        return [r for r in self.invariants if not r.ok]
+
+
+def failed(name: str, detail: str) -> InvariantResult:
+    return InvariantResult(name, False, detail)
+
+
+def passed(name: str, detail: str = "") -> InvariantResult:
+    return InvariantResult(name, True, detail)
+
+
+def parse_prometheus(text: str) -> dict[str, dict[tuple, float]]:
+    """Minimal exposition parser: name -> {sorted-label-tuple: value}.
+    Strict enough to catch malformed lines (the telemetry-completeness
+    invariant): a non-comment line that doesn't split into
+    ``name{labels} value`` raises ValueError."""
+    out: dict[str, dict[tuple, float]] = {}
+    for line in text.splitlines():
+        line = line.strip()
+        if not line or line.startswith("#"):
+            continue
+        # name{l="v",...} value   |   name value
+        if "}" in line:
+            head, _, tail = line.partition("}")
+            name, _, labelstr = head.partition("{")
+            value = tail.strip()
+            labels = []
+            # split on "," outside quotes; honor \" escapes in values
+            in_quotes, escaped, cur = False, False, ""
+            for ch in labelstr:
+                if escaped:
+                    cur += ch
+                    escaped = False
+                elif ch == "\\":
+                    cur += ch
+                    escaped = True
+                elif ch == '"':
+                    in_quotes = not in_quotes
+                    cur += ch
+                elif ch == "," and not in_quotes:
+                    if cur:
+                        labels.append(cur)
+                    cur = ""
+                else:
+                    cur += ch
+            if cur:
+                labels.append(cur)
+            key = tuple(sorted(labels))
+        else:
+            parts = line.split()
+            if len(parts) != 2:
+                raise ValueError(f"malformed metric line: {line!r}")
+            name, value = parts
+            key = ()
+        name = name.strip()
+        try:
+            v = float(value)
+        except ValueError:
+            if value in ("+Inf", "-Inf", "NaN"):
+                v = float(value.replace("Inf", "inf"))
+            else:
+                raise ValueError(f"malformed metric value: {line!r}")
+        out.setdefault(name, {})[key] = v
+    return out
+
+
+def metric_total(families: dict[str, dict[tuple, float]],
+                 name: str) -> Optional[float]:
+    fam = families.get(name)
+    if fam is None:
+        return None
+    return sum(fam.values())

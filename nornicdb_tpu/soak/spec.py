@@ -1,0 +1,190 @@
+"""Declarative soak scenario spec: workload mix + fault schedule + seed.
+
+A scenario is fully reproducible from its JSON form: the seed drives every
+random choice (per-worker op mix, chaos RNGs), and fault windows are fixed
+offsets from soak start.  Ship profiles:
+
+* ``full``  — the 5-minute mixed-protocol scenario with every fault plane
+  exercised (replication loss/reorder/corrupt, asymmetric partition,
+  leader kill + crash-restart, backend hang→recover, storage fsync /
+  torn-tail / ENOSPC windows).
+* ``ci``    — the ~60 s gating profile: same fault planes, compressed.
+* ``micro`` — a few seconds, for tier-1 tests of the harness itself.
+
+See docs/chaos.md for the scenario format and the invariant catalog.
+"""
+
+from __future__ import annotations
+
+import json
+from dataclasses import asdict, dataclass, field
+from typing import Any
+
+PLANES = ("replication", "backend", "storage")
+
+# plane -> legal fault kinds (validated at spec load so a typo'd scenario
+# fails before it burns five minutes of soak time)
+KINDS = {
+    "replication": (
+        "chaos",          # params: ChaosConfig field overrides
+        "partition",      # params: {"direction": "leader_to_followers" |
+                          #          "followers_to_leader" | "both"}
+        "leader_kill",    # crash the current leader at window start,
+                          # crash-restart it at window end
+    ),
+    "backend": ("hang", "fail", "slow"),   # FakeHooks modes; recovers at end
+    "storage": ("fsync_fail", "torn_tail", "enospc"),  # params: {"count": n}
+}
+
+
+@dataclass(frozen=True)
+class FaultWindow:
+    at_s: float          # offset from soak start
+    duration_s: float
+    plane: str           # replication | backend | storage
+    kind: str
+    params: dict = field(default_factory=dict)
+
+    def __post_init__(self):
+        if self.plane not in PLANES:
+            raise ValueError(f"unknown fault plane {self.plane!r}")
+        if self.kind not in KINDS[self.plane]:
+            raise ValueError(
+                f"unknown {self.plane} fault kind {self.kind!r} "
+                f"(have {KINDS[self.plane]})"
+            )
+
+    @property
+    def end_s(self) -> float:
+        return self.at_s + self.duration_s
+
+
+@dataclass(frozen=True)
+class WorkloadSpec:
+    # worker threads per protocol; 0 disables the protocol
+    http_workers: int = 2
+    bolt_workers: int = 1
+    grpc_workers: int = 1
+    qdrant_workers: int = 1
+    replication_writers: int = 1
+    # client-side bound on every request; exceeding deadline+grace wall
+    # time is an invariant violation (a wedged call, not a slow one)
+    deadline_s: float = 5.0
+    grace_s: float = 10.0
+    # pacing between requests per worker (0 = as fast as possible)
+    think_s: float = 0.01
+
+
+@dataclass(frozen=True)
+class ScenarioSpec:
+    name: str
+    seed: int
+    duration_s: float
+    workload: WorkloadSpec = field(default_factory=WorkloadSpec)
+    faults: tuple = ()
+    # quiet tail with no active faults so recovery invariants have room
+    # to converge before the final checks
+    drain_s: float = 5.0
+
+    def __post_init__(self):
+        for w in self.faults:
+            if w.end_s > self.duration_s - self.drain_s + 1e-9:
+                raise ValueError(
+                    f"fault window {w.kind}@{w.at_s}s ends at {w.end_s}s, "
+                    f"inside the {self.drain_s}s drain tail of a "
+                    f"{self.duration_s}s scenario"
+                )
+
+    # -- (de)serialization -------------------------------------------------
+    def to_dict(self) -> dict[str, Any]:
+        d = asdict(self)
+        d["faults"] = [asdict(w) for w in self.faults]
+        return d
+
+    def to_json(self) -> str:
+        return json.dumps(self.to_dict(), indent=2, sort_keys=True)
+
+    @staticmethod
+    def from_dict(d: dict[str, Any]) -> "ScenarioSpec":
+        return ScenarioSpec(
+            name=d["name"],
+            seed=int(d["seed"]),
+            duration_s=float(d["duration_s"]),
+            workload=WorkloadSpec(**d.get("workload", {})),
+            faults=tuple(FaultWindow(**w) for w in d.get("faults", [])),
+            drain_s=float(d.get("drain_s", 5.0)),
+        )
+
+    @staticmethod
+    def from_json(s: str) -> "ScenarioSpec":
+        return ScenarioSpec.from_dict(json.loads(s))
+
+
+def _scale(windows: list[FaultWindow], k: float) -> tuple:
+    return tuple(
+        FaultWindow(round(w.at_s * k, 2), round(w.duration_s * k, 2),
+                    w.plane, w.kind, dict(w.params))
+        for w in windows
+    )
+
+
+# The full 5-minute storyline.  Windows overlap deliberately — the whole
+# point is all three fault planes live at once (e.g. storage ENOSPC while
+# replication runs lossy, backend hang while a partition heals).
+_FULL_WINDOWS = [
+    FaultWindow(20, 40, "replication", "chaos",
+                {"loss_rate": 0.15, "reorder_rate": 0.2, "corrupt_rate": 0.05,
+                 "latency": 0.01, "latency_jitter": 0.02}),
+    FaultWindow(35, 25, "storage", "enospc", {"count": 200}),
+    FaultWindow(70, 35, "backend", "hang", {}),
+    FaultWindow(85, 30, "replication", "partition",
+                {"direction": "leader_to_followers"}),
+    FaultWindow(130, 40, "replication", "leader_kill", {}),
+    FaultWindow(145, 20, "storage", "fsync_fail", {"count": 200}),
+    FaultWindow(185, 25, "replication", "chaos",
+                {"rx_loss_rate": 0.2, "rx_delay": 0.005,
+                 "rx_delay_jitter": 0.02}),
+    FaultWindow(200, 20, "backend", "fail", {}),
+    FaultWindow(230, 20, "storage", "torn_tail", {"count": 50}),
+    FaultWindow(255, 25, "replication", "chaos",
+                {"loss_rate": 0.3, "duplicate_rate": 0.2}),
+]
+
+FULL = ScenarioSpec(
+    name="full", seed=20260803, duration_s=300.0,
+    workload=WorkloadSpec(),
+    faults=tuple(_FULL_WINDOWS),
+    drain_s=15.0,
+)
+
+# ~60 s CI profile: the same storyline compressed 5x (windows shortened,
+# same composition/overlaps), smaller storage fault budgets.
+_CI_WINDOWS = _scale(_FULL_WINDOWS, 0.2)
+CI = ScenarioSpec(
+    name="ci", seed=1337, duration_s=60.0,
+    workload=WorkloadSpec(think_s=0.02),
+    faults=tuple(
+        FaultWindow(w.at_s, w.duration_s, w.plane, w.kind,
+                    ({**w.params, "count": max(10, w.params["count"] // 5)}
+                     if "count" in w.params else dict(w.params)))
+        for w in _CI_WINDOWS
+    ),
+    drain_s=4.0,
+)
+
+# tier-1 micro profile: seconds, one window per plane, tiny budgets
+MICRO = ScenarioSpec(
+    name="micro", seed=7, duration_s=8.0,
+    workload=WorkloadSpec(http_workers=1, bolt_workers=1, grpc_workers=0,
+                          qdrant_workers=1, replication_writers=1,
+                          deadline_s=5.0, grace_s=15.0, think_s=0.0),
+    faults=(
+        FaultWindow(1.0, 2.0, "replication", "chaos",
+                    {"loss_rate": 0.2, "reorder_rate": 0.2}),
+        FaultWindow(1.5, 1.5, "storage", "enospc", {"count": 20}),
+        FaultWindow(2.0, 2.0, "backend", "hang", {}),
+    ),
+    drain_s=3.0,
+)
+
+SCENARIOS = {"full": FULL, "ci": CI, "micro": MICRO}

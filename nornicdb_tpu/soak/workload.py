@@ -1,0 +1,496 @@
+"""Mixed-protocol soak traffic: Bolt, HTTP, gRPC search, Qdrant workers.
+
+Every request is bounded by the scenario deadline (socket/channel
+timeouts) and classified into the report taxonomy.  Workers are plain
+threads with a heartbeat: the harness watchdog treats a silent worker as
+a wedged thread (the exact failure mode chaos is supposed to surface).
+
+Writes that the server ACKS are registered with the collector — the WAL
+crash-recovery invariant replays them against a recovered engine at the
+end of the soak.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import random
+import socket
+import struct
+import threading
+import time
+import urllib.error
+import urllib.request
+import uuid
+from typing import Any, Optional
+
+from nornicdb_tpu.server.packstream import Structure, pack, unpack
+from nornicdb_tpu.soak.report import Collector
+
+log = logging.getLogger(__name__)
+
+# Bolt message tags (mirrors server/bolt.py)
+_RUN, _PULL, _HELLO, _RESET = 0x10, 0x3F, 0x01, 0x0F
+_SUCCESS, _RECORD, _IGNORED, _FAILURE = 0x70, 0x71, 0x7E, 0x7F
+
+_LEGAL_TRANSIENT = ("Neo.TransientError", "ResourceExhausted")
+_UNAVAILABLE_HINTS = (
+    "not durable", "storage fault", "ENOSPC", "DatabaseUnavailable",
+    "Durability", "no space left",
+)
+
+# one vector space for the whole soak: Qdrant point vectors must match the
+# embedder dimensionality (HashEmbedder(64) in the harness) or the shared
+# search corpus rejects the mixed-dim adds
+VECTOR_DIM = 64
+
+
+def _classify_http(status: int, payload: dict) -> tuple[str, str]:
+    """Status+body -> (outcome, detail) for non-cypher HTTP endpoints."""
+    if status == 200:
+        return "ok", ""
+    if status == 429:
+        return "rejected", "http.429"
+    if status == 503:
+        return "unavailable", "http.503"
+    blob = json.dumps(payload)[:200]
+    if any(h in blob for h in _UNAVAILABLE_HINTS):
+        return "unavailable", f"http.{status}.durability"
+    return "error", f"http.{status}:{payload.get('error', '')!s:.80}"
+
+
+def classify_error_text(code: str, message: str) -> str:
+    """Map a protocol error (code + message) onto the report taxonomy."""
+    blob = f"{code} {message}"
+    if any(h in blob for h in _UNAVAILABLE_HINTS):
+        return "unavailable"
+    if any(h in blob for h in _LEGAL_TRANSIENT):
+        return "rejected"
+    return "error"
+
+
+class _Heartbeat:
+    """Per-worker liveness stamp for the wedge watchdog."""
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._beats: dict[str, float] = {}
+
+    def beat(self, name: str) -> None:
+        with self._lock:
+            self._beats[name] = time.monotonic()
+
+    def stale(self, older_than_s: float) -> list[str]:
+        now = time.monotonic()
+        with self._lock:
+            return [n for n, t in self._beats.items()
+                    if now - t > older_than_s]
+
+    def forget(self, name: str) -> None:
+        with self._lock:
+            self._beats.pop(name, None)
+
+
+class BoltSoakClient:
+    """Minimal synchronous Bolt client (socket-level, like the depth-test
+    client) with a hard socket timeout and FAILURE→RESET recovery."""
+
+    def __init__(self, port: int, timeout: float):
+        self.sock = socket.create_connection(("127.0.0.1", port),
+                                             timeout=timeout)
+        self.sock.settimeout(timeout)
+        self.sock.sendall(b"\x60\x60\xb0\x17")
+        self.sock.sendall(b"".join(
+            struct.pack(">I", v) for v in (0x00000405, 0x00000404, 0, 0)))
+        self._recv_exact(4)
+        msgs = self.request(_HELLO, [{"user_agent": "nornicdb-soak/1.0"}])
+        if msgs[0].tag != _SUCCESS:
+            raise ConnectionError("bolt HELLO failed")
+
+    def _recv_exact(self, n: int) -> bytes:
+        buf = b""
+        while len(buf) < n:
+            part = self.sock.recv(n - len(buf))
+            if not part:
+                raise ConnectionError("bolt connection closed")
+            buf += part
+        return buf
+
+    def send(self, tag: int, fields: list[Any]) -> None:
+        payload = pack(Structure(tag, fields))
+        msg = b""
+        for i in range(0, len(payload), 0xFFFF):
+            part = payload[i:i + 0xFFFF]
+            msg += struct.pack(">H", len(part)) + part
+        self.sock.sendall(msg + b"\x00\x00")
+
+    def recv(self):
+        chunks = b""
+        while True:
+            (size,) = struct.unpack(">H", self._recv_exact(2))
+            if size == 0:
+                if chunks:
+                    return unpack(chunks)
+                continue
+            chunks += self._recv_exact(size)
+
+    def request(self, tag: int, fields: list[Any]) -> list[Any]:
+        self.send(tag, fields)
+        return [self.recv()]
+
+    def run_pull(self, query: str, params: dict) -> tuple[str, str]:
+        """RUN + PULL; returns (outcome, detail).  Drains the record
+        stream; a FAILURE triggers RESET so the session stays usable."""
+        msgs = self.request(_RUN, [query, params, {}])
+        head = msgs[0]
+        if head.tag == _FAILURE:
+            meta = head.fields[0] if head.fields else {}
+            self.reset()
+            return (
+                classify_error_text(str(meta.get("code", "")),
+                                    str(meta.get("message", ""))),
+                str(meta.get("code", "bolt.failure")),
+            )
+        if head.tag != _SUCCESS:
+            return "error", f"unexpected RUN reply tag 0x{head.tag:02X}"
+        self.send(_PULL, [{"n": -1}])
+        while True:
+            m = self.recv()
+            if m.tag == _RECORD:
+                continue
+            if m.tag == _SUCCESS:
+                return "ok", ""
+            if m.tag == _FAILURE:
+                meta = m.fields[0] if m.fields else {}
+                self.reset()
+                return (
+                    classify_error_text(str(meta.get("code", "")),
+                                        str(meta.get("message", ""))),
+                    str(meta.get("code", "bolt.failure")),
+                )
+            return "error", f"unexpected PULL reply tag 0x{m.tag:02X}"
+
+    def reset(self) -> None:
+        msgs = self.request(_RESET, [])
+        if msgs and msgs[0].tag == _IGNORED:  # server may IGNORE then ack
+            self.recv()
+
+    def close(self) -> None:
+        try:
+            self.sock.close()
+        except OSError:
+            pass
+
+
+def _http_json(base: str, path: str, body: Optional[dict], timeout: float,
+               method: str = "POST") -> tuple[int, dict]:
+    data = json.dumps(body).encode() if body is not None else None
+    req = urllib.request.Request(
+        base + path, data=data, method=method,
+        headers={"Content-Type": "application/json"},
+    )
+    try:
+        with urllib.request.urlopen(req, timeout=timeout) as resp:
+            return resp.status, json.loads(resp.read() or b"{}")
+    except urllib.error.HTTPError as e:
+        try:
+            payload = json.loads(e.read() or b"{}")
+        except ValueError:  # non-JSON error body: status alone classifies
+            payload = {}
+        return e.code, payload
+
+
+class WorkloadRunner:
+    """Owns every traffic worker thread for one soak run."""
+
+    def __init__(self, spec, ports: dict[str, int], collector: Collector,
+                 seed: int):
+        self.spec = spec
+        self.ports = ports  # {"http": p, "bolt": p, "grpc": p or 0}
+        self.collector = collector
+        self.seed = seed
+        self.stop_event = threading.Event()
+        self.heartbeat = _Heartbeat()
+        self.threads: list[threading.Thread] = []
+        self._uid_lock = threading.Lock()
+        self._recent_uids: list[str] = []  # traversal targets
+        self.protocols: list[str] = []
+
+    # -- shared helpers ----------------------------------------------------
+    def _note_uid(self, uid: str) -> None:
+        with self._uid_lock:
+            self._recent_uids.append(uid)
+            del self._recent_uids[:-500]
+
+    def _pick_uid(self, rng: random.Random) -> Optional[str]:
+        with self._uid_lock:
+            if not self._recent_uids:
+                return None
+            return rng.choice(self._recent_uids)
+
+    def _record(self, proto: str, op: str, outcome: str, t0: float,
+                detail: str = "") -> None:
+        self.collector.record(proto, op, outcome,
+                              time.monotonic() - t0, detail)
+
+    # -- HTTP --------------------------------------------------------------
+    def _http_cypher(self, base: str, statements: list[dict],
+                     timeout: float) -> tuple[str, str]:
+        status, payload = _http_json(
+            base, "/db/neo4j/tx/commit", {"statements": statements}, timeout)
+        if status == 429:
+            return "rejected", "http.429"
+        if status == 503:
+            return "unavailable", "http.503"
+        if status != 200:
+            return "error", f"http.{status}"
+        errors = payload.get("errors", [])
+        if errors:
+            e0 = errors[0]
+            return (
+                classify_error_text(str(e0.get("code", "")),
+                                    str(e0.get("message", ""))),
+                str(e0.get("code", "cypher.error")),
+            )
+        return "ok", ""
+
+    def _http_worker(self, idx: int) -> None:
+        name = f"http-{idx}"
+        rng = random.Random(self.seed * 1000 + idx)
+        base = f"http://127.0.0.1:{self.ports['http']}"
+        deadline = self.spec.workload.deadline_s
+        n = 0
+        while not self.stop_event.is_set():
+            self.heartbeat.beat(name)
+            n += 1
+            roll = rng.random()
+            t0 = time.monotonic()
+            try:
+                if roll < 0.35:  # write: CREATE node (+ chain edge)
+                    uid = f"h{idx}-{n}-{uuid.uuid4().hex[:8]}"
+                    prev = self._pick_uid(rng)
+                    stmts = [{
+                        "statement": "CREATE (:SoakW {uid: $uid, w: $w})",
+                        "parameters": {"uid": uid, "w": idx},
+                    }]
+                    if prev is not None and rng.random() < 0.5:
+                        stmts.append({
+                            "statement": (
+                                "MATCH (a:SoakW {uid: $a}), "
+                                "(b:SoakW {uid: $b}) "
+                                "CREATE (a)-[:NEXT]->(b)"),
+                            "parameters": {"a": uid, "b": prev},
+                        })
+                    outcome, detail = self._http_cypher(base, stmts, deadline)
+                    if outcome == "ok":
+                        self.collector.ack_write("serving", uid)
+                        self._note_uid(uid)
+                    self._record("http", "write", outcome, t0, detail)
+                elif roll < 0.55:  # var-length traversal
+                    uid = self._pick_uid(rng)
+                    if uid is None:
+                        continue
+                    outcome, detail = self._http_cypher(base, [{
+                        "statement": (
+                            "MATCH (a:SoakW {uid: $uid})-[:NEXT*1..3]->(b) "
+                            "RETURN count(b) AS c"),
+                        "parameters": {"uid": uid},
+                    }], deadline)
+                    self._record("http", "traverse", outcome, t0, detail)
+                elif roll < 0.8:  # vector search
+                    status, payload = _http_json(
+                        base, "/nornicdb/search",
+                        {"query": f"soak query {rng.randint(0, 50)}",
+                         "limit": 5},
+                        deadline)
+                    outcome, detail = _classify_http(status, payload)
+                    if outcome == "ok" and "results" not in payload:
+                        outcome, detail = "error", "search: no results key"
+                    self._record("http", "search", outcome, t0, detail)
+                else:  # embed
+                    status, payload = _http_json(
+                        base, "/nornicdb/embed",
+                        {"text": f"soak embed text {rng.randint(0, 1000)}"},
+                        deadline)
+                    outcome, detail = _classify_http(status, payload)
+                    if outcome == "ok" and not payload.get("dimensions"):
+                        outcome, detail = "error", "embed: no dimensions"
+                    self._record("http", "embed", outcome, t0, detail)
+            except (socket.timeout, TimeoutError):
+                self._record("http", "request", "timeout", t0, "timeout")
+            except (urllib.error.URLError, ConnectionError, OSError) as e:
+                self._record("http", "request", "unavailable", t0,
+                             type(e).__name__)
+            self._pace(rng)
+        self.heartbeat.forget(name)
+
+    # -- Bolt --------------------------------------------------------------
+    def _bolt_worker(self, idx: int) -> None:
+        name = f"bolt-{idx}"
+        rng = random.Random(self.seed * 2000 + idx)
+        deadline = self.spec.workload.deadline_s
+        client: Optional[BoltSoakClient] = None
+        n = 0
+        while not self.stop_event.is_set():
+            self.heartbeat.beat(name)
+            n += 1
+            t0 = time.monotonic()
+            try:
+                if client is None:
+                    client = BoltSoakClient(self.ports["bolt"], deadline)
+                if rng.random() < 0.5:  # write
+                    uid = f"b{idx}-{n}-{uuid.uuid4().hex[:8]}"
+                    outcome, detail = client.run_pull(
+                        "CREATE (:SoakW {uid: $uid, via: 'bolt'})",
+                        {"uid": uid})
+                    if outcome == "ok":
+                        self.collector.ack_write("serving", uid)
+                        self._note_uid(uid)
+                    self._record("bolt", "write", outcome, t0, detail)
+                else:  # read
+                    outcome, detail = client.run_pull(
+                        "MATCH (n:SoakW) RETURN count(n) AS c", {})
+                    self._record("bolt", "read", outcome, t0, detail)
+            except (socket.timeout, TimeoutError):
+                self._record("bolt", "request", "timeout", t0, "timeout")
+                if client is not None:
+                    client.close()
+                client = None
+            except (ConnectionError, OSError) as e:
+                self._record("bolt", "request", "unavailable", t0,
+                             type(e).__name__)
+                if client is not None:
+                    client.close()
+                client = None
+            self._pace(rng)
+        if client is not None:
+            client.close()
+        self.heartbeat.forget(name)
+
+    # -- gRPC search -------------------------------------------------------
+    def _grpc_worker(self, idx: int) -> None:
+        name = f"grpc-{idx}"
+        rng = random.Random(self.seed * 3000 + idx)
+        deadline = self.spec.workload.deadline_s
+        import grpc
+
+        from nornicdb_tpu.server.grpc_search import (
+            SERVICE_NAME,
+            decode_search_response,
+            encode_search_request,
+        )
+
+        channel = grpc.insecure_channel(f"127.0.0.1:{self.ports['grpc']}")
+        call = channel.unary_unary(
+            f"/{SERVICE_NAME}/Search",
+            request_serializer=lambda b: b,
+            response_deserializer=lambda b: b,
+        )
+        while not self.stop_event.is_set():
+            self.heartbeat.beat(name)
+            t0 = time.monotonic()
+            try:
+                req = encode_search_request(
+                    f"soak grpc {rng.randint(0, 50)}", 5, None, 0.0)
+                resp = call(req, timeout=deadline)
+                decode_search_response(resp)
+                self._record("grpc", "search", "ok", t0)
+            except grpc.RpcError as e:
+                code = e.code()
+                if code == grpc.StatusCode.RESOURCE_EXHAUSTED:
+                    self._record("grpc", "search", "rejected", t0,
+                                 "RESOURCE_EXHAUSTED")
+                elif code == grpc.StatusCode.DEADLINE_EXCEEDED:
+                    self._record("grpc", "search", "timeout", t0,
+                                 "DEADLINE_EXCEEDED")
+                elif code == grpc.StatusCode.UNAVAILABLE:
+                    self._record("grpc", "search", "unavailable", t0,
+                                 "UNAVAILABLE")
+                else:
+                    self._record("grpc", "search", "error", t0, str(code))
+            except Exception as e:
+                self._record("grpc", "search", "error", t0,
+                             type(e).__name__)
+            self._pace(rng)
+        channel.close()
+        self.heartbeat.forget(name)
+
+    # -- Qdrant (HTTP API) -------------------------------------------------
+    def _qdrant_worker(self, idx: int) -> None:
+        name = f"qdrant-{idx}"
+        rng = random.Random(self.seed * 4000 + idx)
+        base = f"http://127.0.0.1:{self.ports['http']}"
+        deadline = self.spec.workload.deadline_s
+        n = 0
+        while not self.stop_event.is_set():
+            self.heartbeat.beat(name)
+            n += 1
+            t0 = time.monotonic()
+            try:
+                if rng.random() < 0.5:  # upsert
+                    uid = f"q{idx}-{n}-{uuid.uuid4().hex[:8]}"
+                    status, payload = _http_json(
+                        base, "/collections/soak/points",
+                        {"points": [{
+                            "id": idx * 1_000_000 + n,
+                            "vector": [rng.random()
+                                       for _ in range(VECTOR_DIM)],
+                            "payload": {"uid": uid},
+                        }]},
+                        deadline, method="PUT")
+                    outcome, detail = _classify_http(status, payload)
+                    if outcome == "ok":
+                        self.collector.ack_write("serving", uid)
+                    self._record("qdrant", "upsert", outcome, t0, detail)
+                else:  # vector search
+                    status, payload = _http_json(
+                        base, "/collections/soak/points/search",
+                        {"vector": [rng.random() for _ in range(VECTOR_DIM)],
+                         "limit": 5},
+                        deadline)
+                    outcome, detail = _classify_http(status, payload)
+                    self._record("qdrant", "search", outcome, t0, detail)
+            except (socket.timeout, TimeoutError):
+                self._record("qdrant", "request", "timeout", t0, "timeout")
+            except (urllib.error.URLError, ConnectionError, OSError) as e:
+                self._record("qdrant", "request", "unavailable", t0,
+                             type(e).__name__)
+            self._pace(rng)
+        self.heartbeat.forget(name)
+
+    def _pace(self, rng: random.Random) -> None:
+        think = self.spec.workload.think_s
+        if think > 0:
+            # jittered pacing so workers don't phase-lock on the server
+            self.stop_event.wait(think * (0.5 + rng.random()))
+
+    # -- lifecycle ---------------------------------------------------------
+    def start(self) -> None:
+        w = self.spec.workload
+        plan = [
+            ("http", w.http_workers, self._http_worker),
+            ("bolt", w.bolt_workers, self._bolt_worker),
+            ("grpc", w.grpc_workers if self.ports.get("grpc") else 0,
+             self._grpc_worker),
+            ("qdrant", w.qdrant_workers, self._qdrant_worker),
+        ]
+        for proto, count, fn in plan:
+            if count > 0:
+                self.protocols.append(proto)
+            for i in range(count):
+                t = threading.Thread(target=fn, args=(i,),
+                                     name=f"soak-{proto}-{i}", daemon=True)
+                t.start()
+                self.threads.append(t)
+
+    def stop(self, join_timeout: float) -> list[str]:
+        """Signal stop and join; returns the names of wedged threads that
+        failed to exit within the bound (an invariant violation)."""
+        self.stop_event.set()
+        wedged = []
+        deadline = time.monotonic() + join_timeout
+        for t in self.threads:
+            t.join(max(0.1, deadline - time.monotonic()))
+            if t.is_alive():
+                wedged.append(t.name)
+        return wedged

@@ -44,9 +44,13 @@ from nornicdb_tpu.storage.types import (
     Node,
     new_id,
 )
+from nornicdb_tpu.storage.faults import INJECTOR as FAULT_INJECTOR
+from nornicdb_tpu.storage.faults import StorageFaultInjector
 from nornicdb_tpu.storage.wal import WAL, WALEngine, WALEntry
 
 __all__ = [
+    "FAULT_INJECTOR",
+    "StorageFaultInjector",
     "AdjacencySnapshot",
     "AsyncEngine",
     "NamespacedEngine",

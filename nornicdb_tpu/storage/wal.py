@@ -17,6 +17,7 @@ the last good record; preceding records are preserved.
 
 from __future__ import annotations
 
+import errno as _errno
 import json
 import logging
 import os
@@ -27,8 +28,9 @@ import zlib
 from dataclasses import dataclass, field
 from typing import Any, Callable, Optional
 
-from nornicdb_tpu.errors import WALCorruptionError
+from nornicdb_tpu.errors import DurabilityError, WALCorruptionError
 from nornicdb_tpu.storage import native as _native
+from nornicdb_tpu.storage.faults import INJECTOR as _FAULTS
 from nornicdb_tpu.storage.types import Edge, Engine, Node
 from nornicdb_tpu.telemetry.metrics import REGISTRY as _REGISTRY
 from nornicdb_tpu.telemetry.tracing import tracer as _tracer
@@ -43,6 +45,15 @@ _WAL_FSYNC_HIST = _REGISTRY.histogram(
     "nornicdb_wal_fsync_seconds",
     "WAL fsync latency (sync=True appends only)",
 )
+_WAL_APPEND_FAILURES = _REGISTRY.counter(
+    "nornicdb_wal_append_failures_total",
+    "WAL appends that failed durability (write/fsync error, ENOSPC) and "
+    "were rolled back off the tail — surfaced to callers as DurabilityError",
+    labels=("kind",),
+)
+for _k in ("enospc", "io", "fsync"):
+    _WAL_APPEND_FAILURES.labels(_k)  # eager cells: render 0, not absent
+del _k
 
 MAGIC = b"NWAL"
 VERSION = 1
@@ -129,6 +140,9 @@ class WALStats:
     snapshots: int = 0
     recovered_entries: int = 0
     truncated_tail_records: int = 0
+    # appends that failed durability and were rolled back (DurabilityError
+    # surfaced to the caller; nothing was acked)
+    append_failures: int = 0
     # degraded mode (ref: wal_degraded.go): recovery stopped at MID-FILE
     # corruption with real records after it — data was lost, unlike the
     # benign torn-tail case. Surfaced via /status and /admin/stats.
@@ -157,6 +171,11 @@ class WAL:
         os.makedirs(directory, exist_ok=True)
         self._path = os.path.join(directory, self.LOG_NAME)
         self._lock = threading.Lock()
+        self._tail_dirty = False
+        # (scan end offset, file length) from the most recent read_all —
+        # lets the open-time misalignment check reuse the scan it already
+        # paid for instead of re-reading the whole log
+        self._tail_scan = (0, 0)
         # resolve the native codec HERE, before any append can run: the
         # first _native.enabled() call dlopens (and may `make`-build) the
         # library — work that must never happen inside the append lock
@@ -183,6 +202,18 @@ class WAL:
                       exc_info=True)
         if self.stats.degraded:
             self._quarantine_corrupt_log()
+        # benign torn tail (crash mid-append): the partial record must be
+        # repaired before the FIRST append — otherwise the new record
+        # lands on the torn bytes and every later record is stranded
+        # behind them on the following replay (same contract as the raft
+        # durable log's open path, raft.py).  Detection compares the file
+        # length against the aligned end of the intact prefix, which also
+        # catches a crash INSIDE the final record's alignment padding
+        # (the record parses fine, so truncated_tail_records alone would
+        # miss it).  Deferred to append() so read-only opens keep the
+        # damaged bytes for strict-mode corruption diagnostics.
+        self._needs_chop = (not self.stats.degraded
+                            and self._tail_misaligned())
         self._f = open(self._path, "ab")
 
     # -- append ------------------------------------------------------------
@@ -190,22 +221,87 @@ class WAL:
         t0 = _time.perf_counter()
         with _tracer.span("wal.append", {"op": op}):
             with self._lock:
+                if self._tail_dirty:
+                    # a failed append could not be repaired: appending past
+                    # the damaged region would strand every new record
+                    # behind it on replay (read_all stops at corruption)
+                    raise DurabilityError(
+                        "WAL tail damaged by an unrepaired append failure; "
+                        "reopen the WAL to recover", kind="wal_disabled",
+                    )
+                if self._needs_chop:
+                    self._needs_chop = False
+                    self._f.close()
+                    repaired = self._chop_torn_tail()
+                    self._f = open(self._path, "ab")
+                    if not repaired:
+                        raise DurabilityError(
+                            "WAL tail repair failed at first append; "
+                            "reopen the WAL to retry", kind="wal_disabled",
+                        )
                 self._seq += 1
                 entry = WALEntry(seq=self._seq, op=op, data=data, txid=txid)
                 raw = entry.encode(self._encryptor, use_native=self._use_native)
-                self._f.write(raw)
-                self._f.flush()
-                if self.sync:
-                    # deliberate fsync under the WAL lock: sync=True is the
-                    # durability mode — records must hit disk in seq order
-                    t_fsync = _time.perf_counter()
-                    os.fsync(self._f.fileno())  # nornlint: disable=NL-LK02
-                    _WAL_FSYNC_HIST.observe(_time.perf_counter() - t_fsync)
+                pos = self._f.tell()
+                try:
+                    _FAULTS.check_write(self._path, self._f, raw)
+                    self._f.write(raw)
+                    self._f.flush()
+                    if self.sync:
+                        # deliberate fsync under the WAL lock: sync=True is
+                        # the durability mode — records must hit disk in
+                        # seq order
+                        t_fsync = _time.perf_counter()
+                        try:
+                            _FAULTS.check_fsync(self._path)
+                            os.fsync(self._f.fileno())  # nornlint: disable=NL-LK02
+                        except OSError as e:
+                            # tag the failing stage: the message of a real
+                            # fsync EIO carries no hint of where it came
+                            # from, and the failure-kind metric must not
+                            # depend on string contents
+                            e.nornicdb_stage = "fsync"
+                            raise
+                        _WAL_FSYNC_HIST.observe(_time.perf_counter() - t_fsync)
+                except OSError as e:
+                    self._abort_append(pos, e)  # raises DurabilityError
                 self.stats.entries += 1
                 self.stats.bytes_written += len(raw)
                 seq = self._seq
         _WAL_APPEND_HIST.observe(_time.perf_counter() - t0)
         return seq
+
+    def _abort_append(self, pos: int, cause: OSError) -> None:
+        """A record failed to become durable (write error, torn tail,
+        ENOSPC, fsync failure).  Roll the append back so the log ends at
+        its last good record: the seq is un-issued (recovery filters on
+        seq ordering, so a hole would silently drop later replays) and any
+        partially-written tail bytes are truncated away.  Always raises
+        :class:`DurabilityError` — the caller must NOT ack the write."""
+        self._seq -= 1
+        self.stats.append_failures += 1
+        kind = ("enospc" if cause.errno == _errno.ENOSPC
+                else getattr(cause, "nornicdb_stage", None) or "io")
+        _WAL_APPEND_FAILURES.labels(kind).inc()
+        repairable = getattr(cause, "nornicdb_repairable", True)
+        if repairable:
+            try:
+                self._f.seek(pos)
+                self._f.truncate(pos)
+                self._f.flush()
+            except OSError:
+                log.error("WAL tail repair after failed append at offset %d "
+                          "also failed; disabling appends until reopen",
+                          pos, exc_info=True)
+                self._tail_dirty = True
+        else:
+            # crash-shaped: the torn bytes stay on disk; replay stops at
+            # the last good record (benign torn tail) but appending past
+            # them would strand new records — require a reopen
+            self._tail_dirty = True
+        raise DurabilityError(
+            f"WAL append not durable: {cause}", kind=kind,
+        ) from cause
 
     @property
     def last_seq(self) -> int:
@@ -226,11 +322,13 @@ class WAL:
             with open(self._path, "rb") as f:
                 buf = f.read()
         except FileNotFoundError:
+            self._tail_scan = (0, 0)
             return entries
         # opt-in native path: C++ does framing + CRC sweep; Python parses JSON
         native_out = _native.scan(buf) if _native.enabled() else None
         if native_out is not None:
             records, valid_bytes = native_out
+            self._tail_scan = (valid_bytes, len(buf))
             if valid_bytes < len(buf):
                 if strict:
                     raise WALCorruptionError(
@@ -290,6 +388,10 @@ class WAL:
                 WALEntry(seq=seq, op=obj["op"], data=obj.get("data", {}), txid=obj.get("txid"))
             )
             off = body_end + ((-(body_end - off)) % 8)
+        # where the scan actually stopped vs the file length: the open-time
+        # misalignment check compares these (clean exit leaves off at the
+        # aligned end; any break leaves it at the bad record's start)
+        self._tail_scan = (off, n)
         return entries
 
     def _note_corruption(self, offset: int, total: int,
@@ -346,33 +448,103 @@ class WAL:
             f"{os.path.basename(self._path)}.corrupt-{n}"
         )
 
-    def _parse_buffer(self, buf: bytes) -> list[WALEntry]:
-        """Parse records from a raw buffer (decrypted), stopping at the
-        first unreadable record. Used by quarantine; does not touch stats."""
-        entries: list[WALEntry] = []
+    @staticmethod
+    def _iter_frames(buf: bytes):
+        """Yield ``(payload, seq, end_off)`` for each intact leading
+        record, stopping at the first bad header / short body / CRC
+        mismatch — the frame-walk shared by the torn-tail repair and the
+        quarantine salvage scan.  (``read_all`` keeps its own walk: it
+        needs per-stop diagnostics — WHICH offset failed and why — for
+        strict mode and degraded-mode classification.)  The last yielded
+        ``end_off`` is the aligned intact-prefix length and may exceed
+        ``len(buf)`` when the final record's padding was cut short."""
         off = 0
         n = len(buf)
         while off + _HEADER.size <= n:
             magic, ver, oplen = _HEADER.unpack_from(buf, off)
             body_end = off + _HEADER.size + oplen + _FOOTER.size
             if magic != MAGIC or ver != VERSION or body_end > n:
-                break
+                return
             payload = buf[off + _HEADER.size : off + _HEADER.size + oplen]
             crc, seq = _FOOTER.unpack_from(buf, off + _HEADER.size + oplen)
             if zlib.crc32(payload) & 0xFFFFFFFF != crc:
-                break
+                return
+            off = body_end + ((-(body_end - off)) % 8)
+            yield payload, seq, off
+
+    def _parse_buffer(self, buf: bytes) -> list[WALEntry]:
+        """Parse records from a raw buffer (decrypted), stopping at the
+        first unreadable record. Used by quarantine; does not touch stats."""
+        entries: list[WALEntry] = []
+        for payload, seq, off in self._iter_frames(buf):
             try:
                 obj = json.loads(self._decrypt(payload).decode("utf-8"))
             except Exception:
                 # corrupt record: keep only the prefix (quarantine semantics)
-                log.warning("undecodable WAL record at offset %d stops the "
-                            "salvage scan", off, exc_info=True)
+                log.warning("undecodable WAL record before offset %d stops "
+                            "the salvage scan", off, exc_info=True)
                 break
             entries.append(WALEntry(seq=seq, op=obj["op"],
                                     data=obj.get("data", {}),
                                     txid=obj.get("txid")))
-            off = body_end + ((-(body_end - off)) % 8)
         return entries
+
+    def _intact_prefix_end(self, buf: bytes) -> int:
+        """Aligned end offset of the intact leading records.  May exceed
+        ``len(buf)`` when a crash cut the final record's alignment
+        padding short (the record itself is whole)."""
+        off = 0
+        for _payload, _seq, off in self._iter_frames(buf):
+            pass
+        return off
+
+    def _tail_misaligned(self) -> bool:
+        """True when the file does not end exactly at the aligned end of
+        its intact prefix — torn garbage after it, or short padding.
+        Reuses the scan _scan_last_seq already paid for (``_tail_scan``)
+        instead of re-reading the log."""
+        end, n = self._tail_scan
+        return n > 0 and end != n
+
+    def _chop_torn_tail(self) -> bool:
+        """Repair the log tail before the first append: truncate torn
+        bytes after the last intact record, or complete a final record's
+        crash-shortened alignment padding with zeros.  Only reached for a
+        benign torn tail — mid-file corruption takes the quarantine path.
+        Returns False (and poisons the tail) when the repair itself
+        failed: appending past unrepaired damage would strand every new
+        record on the next replay."""
+        try:
+            with open(self._path, "rb") as f:
+                buf = f.read()
+        except FileNotFoundError:
+            return True
+        n = len(buf)
+        end = self._intact_prefix_end(buf)
+        try:
+            if end < n:
+                log.warning("chopping %d torn tail bytes off %s at offset "
+                            "%d", n - end, self._path, end)
+                os.truncate(self._path, end)
+            elif end > n:
+                # crash inside the trailing padding: the record is whole,
+                # only zero-padding is missing — complete it in place
+                log.warning("completing %d missing padding bytes on %s",
+                            end - n, self._path)
+                with open(self._path, "ab") as f:
+                    f.write(b"\x00" * (end - n))
+                    f.flush()
+                    # deliberate fsync under the WAL lock: this one-time
+                    # open repair must be durable before the append that
+                    # triggered it lands — same serialized-durability
+                    # contract as append() itself
+                    os.fsync(f.fileno())  # nornlint: disable=NL-LK02
+        except OSError:
+            log.error("torn-tail repair failed; disabling appends until "
+                      "reopen", exc_info=True)
+            self._tail_dirty = True
+            return False
+        return True
 
     def _scan_last_seq(self) -> int:
         last = 0
@@ -424,6 +596,8 @@ class WAL:
         with self._lock:
             self._f.close()
             self._f = open(self._path, "wb")
+            self._tail_dirty = False  # fresh file: damaged tail is gone
+            self._needs_chop = False
 
     def truncate_up_to(self, seq: int) -> None:
         """Rewrite the log keeping only entries with seq > `seq` (appended
@@ -443,6 +617,8 @@ class WAL:
                 os.fsync(f.fileno())  # nornlint: disable=NL-LK02
             os.replace(tmp, self._path)
             self._f = open(self._path, "ab")
+            self._tail_dirty = False  # rewrite kept only intact records
+            self._needs_chop = False
 
     def load_snapshot(self) -> Optional[dict[str, Any]]:
         path = os.path.join(self.dir, self.SNAPSHOT_NAME)

@@ -1,0 +1,189 @@
+"""ChaosTransport receive-path faults, asymmetric partitions, and the
+nornicdb_chaos_* registry counters (ISSUE 10 satellite)."""
+
+import threading
+import time
+
+import pytest
+
+from nornicdb_tpu.errors import ReplicationError
+from nornicdb_tpu.replication import (
+    ChaosConfig,
+    ChaosTransport,
+    InProcNetwork,
+    InProcTransport,
+    Message,
+)
+from nornicdb_tpu.telemetry.metrics import REGISTRY
+
+
+def _wait(pred, timeout=5.0):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if pred():
+            return True
+        time.sleep(0.01)
+    return pred()
+
+
+def _pair(cfg_a=None, cfg_b=None):
+    net = InProcNetwork()
+    a = ChaosTransport(InProcTransport("a", net), cfg_a or ChaosConfig())
+    b = ChaosTransport(InProcTransport("b", net), cfg_b or ChaosConfig())
+    return a, b
+
+
+class TestReceivePathFaults:
+    def test_rx_loss_drops_on_delivery(self):
+        # sender is clean; the RECEIVER drops everything on delivery
+        a, b = _pair(cfg_b=ChaosConfig(rx_loss_rate=1.0, seed=1))
+        got = []
+        b.set_handler(lambda m: got.append(m) or None)
+        for i in range(10):
+            a.send("b", Message(3, {"i": i}))
+        time.sleep(0.2)
+        assert got == []
+        assert b.stats["rx_dropped"] == 10
+        # the send side saw nothing wrong
+        assert a.stats["dropped"] == 0
+
+    def test_rx_delay_defers_delivery(self):
+        a, b = _pair(cfg_b=ChaosConfig(rx_delay=0.15, seed=2))
+        got = []
+        b.set_handler(lambda m: got.append(time.monotonic()) or None)
+        t0 = time.monotonic()
+        a.send("b", Message(3, {}))
+        assert _wait(lambda: len(got) == 1)
+        assert got[0] - t0 >= 0.12
+        assert b.stats["rx_delayed"] == 1
+
+    def test_rx_faults_do_not_affect_send_path(self):
+        a, b = _pair(cfg_a=ChaosConfig(rx_loss_rate=1.0, seed=3))
+        got = []
+        b.set_handler(lambda m: got.append(m) or None)
+        a.send("b", Message(3, {"x": 1}))  # a's rx faults irrelevant here
+        assert _wait(lambda: len(got) == 1)
+
+
+class TestAsymmetricPartition:
+    def test_one_way_block_send_side(self):
+        a, b = _pair()
+        got_a, got_b = [], []
+        a.set_handler(lambda m: got_a.append(m) or None)
+        b.set_handler(lambda m: got_b.append(m) or None)
+        a.partition("a", "b")  # a -> b dead; b -> a alive
+        a.send("b", Message(3, {}))
+        b.send("a", Message(3, {}))
+        assert _wait(lambda: len(got_a) == 1)
+        time.sleep(0.1)
+        assert got_b == []
+        assert a.stats["partitioned"] == 1
+
+    def test_one_way_block_receive_side(self):
+        a, b = _pair()
+        got_b = []
+        b.set_handler(lambda m: got_b.append(m) or None)
+        # block on the RECEIVER: b refuses deliveries from a — models a
+        # split where a believes it sent successfully
+        b.partition("a", "b")
+        a.send("b", Message(3, {}))
+        time.sleep(0.1)
+        assert got_b == []
+        assert b.stats["partitioned"] == 1
+        assert a.stats["partitioned"] == 0
+
+    def test_heal_restores_flow(self):
+        a, b = _pair()
+        got = []
+        b.set_handler(lambda m: got.append(m) or None)
+        a.partition("a", "b")
+        a.send("b", Message(3, {}))
+        time.sleep(0.05)
+        assert got == []
+        a.heal("a", "b")
+        a.send("b", Message(3, {}))
+        assert _wait(lambda: len(got) == 1)
+
+    def test_partition_both_and_bare_heal(self):
+        a, b = _pair()
+        got_a, got_b = [], []
+        a.set_handler(lambda m: got_a.append(m) or None)
+        b.set_handler(lambda m: got_b.append(m) or None)
+        a.partition_both("a", "b")
+        a.send("b", Message(3, {}))
+        # the reverse direction is blocked on a's rx side
+        b.send("a", Message(3, {}))
+        time.sleep(0.1)
+        assert got_b == [] and got_a == []
+        a.heal()
+        a.send("b", Message(3, {}))
+        assert _wait(lambda: len(got_b) == 1)
+
+
+class TestRegistryCounters:
+    def test_chaos_events_render_in_metrics(self):
+        a, b = _pair(cfg_a=ChaosConfig(loss_rate=1.0, seed=4))
+        before = dict(a.stats)
+        for _ in range(5):
+            a.send("b", Message(3, {}))
+        assert a.stats["dropped"] == before["dropped"] + 5
+        text = REGISTRY.render_prometheus()
+        assert "nornicdb_chaos_events_total" in text
+        # every instance-stat key is a labeled cell in the family
+        for event in a.stats:
+            assert f'nornicdb_chaos_events_total{{event="{event}"}}' in text
+
+    def test_registry_covers_instance_stats(self):
+        """The registry counter for an event is always >= any single
+        instance's count (it aggregates across transports)."""
+        from nornicdb_tpu.soak.invariants import check_chaos_in_metrics
+
+        a, b = _pair(cfg_a=ChaosConfig(loss_rate=1.0, seed=5))
+        for _ in range(3):
+            a.send("b", Message(3, {}))
+        res = check_chaos_in_metrics(
+            REGISTRY.render_prometheus(), [dict(a.stats), dict(b.stats)])
+        assert res.ok, res.detail
+
+
+class TestSendPathStillWorks:
+    """The pre-existing send-path semantics must be unchanged."""
+
+    def test_loss_and_corrupt(self):
+        a, b = _pair(cfg_a=ChaosConfig(corrupt_rate=1.0, seed=6))
+        got = []
+        b.set_handler(lambda m: got.append(m) or None)
+        a.send("b", Message(3, {"k": "clean"}))
+        assert _wait(lambda: len(got) == 1)
+        assert got[0].payload["k"] == "\x00CORRUPT\xff"
+
+    def test_drop_connections_raises(self):
+        a, b = _pair(cfg_a=ChaosConfig(drop_connections=True))
+        with pytest.raises(ReplicationError):
+            a.send("b", Message(3, {}))
+
+    def test_request_response_through_chaos(self):
+        a, b = _pair()
+        b.set_handler(lambda m: Message(0, {"echo": m.payload.get("x")}))
+        reply = a.request("b", Message(1, {"x": 7}), timeout=5)
+        assert reply.payload["echo"] == 7
+
+
+class TestHandlerRobustness:
+    def test_handler_exception_does_not_kill_delivery(self):
+        """A garbage payload (chaos corruption) blowing up the handler is
+        logged+counted, and the transport keeps delivering."""
+        a, b = _pair()
+        calls = []
+
+        def bad_then_good(m):
+            calls.append(m)
+            if len(calls) == 1:
+                raise TypeError("corrupted payload reached handler")
+            return None
+
+        b.set_handler(bad_then_good)
+        a.send("b", Message(3, {"n": 1}))
+        assert _wait(lambda: len(calls) == 1)
+        a.send("b", Message(3, {"n": 2}))
+        assert _wait(lambda: len(calls) == 2)

@@ -1,0 +1,290 @@
+"""Soak harness (ISSUE 10 tentpole): scenario spec mechanics, report
+math, invariant checkers, fault scheduler sequencing, and one full
+micro-scenario run through the real harness (live servers, raft cluster,
+all three fault planes)."""
+
+import json
+import threading
+import time
+
+import pytest
+
+from nornicdb_tpu.soak.faults import FaultScheduler, PlaneDriver
+from nornicdb_tpu.soak.invariants import (
+    check_backend_ready,
+    check_bounded_latency,
+    check_metrics_wellformed,
+    check_no_illegal_errors,
+    check_traces_wellformed,
+)
+from nornicdb_tpu.soak.report import (
+    Collector,
+    Sample,
+    SoakReport,
+    parse_prometheus,
+    percentile,
+    summarize,
+)
+from nornicdb_tpu.soak.spec import (
+    CI,
+    FULL,
+    MICRO,
+    SCENARIOS,
+    FaultWindow,
+    ScenarioSpec,
+    WorkloadSpec,
+)
+
+
+class TestScenarioSpec:
+    def test_builtin_scenarios_valid(self):
+        assert FULL.duration_s == 300.0
+        assert 55 <= CI.duration_s <= 65
+        for spec in SCENARIOS.values():
+            planes = {w.plane for w in spec.faults}
+            assert planes == {"replication", "backend", "storage"}, (
+                f"{spec.name} must compose all three fault planes")
+
+    def test_json_round_trip(self):
+        for spec in (FULL, CI, MICRO):
+            again = ScenarioSpec.from_json(spec.to_json())
+            assert again == spec
+
+    def test_unknown_plane_rejected(self):
+        with pytest.raises(ValueError):
+            FaultWindow(0, 1, "network", "chaos")
+
+    def test_unknown_kind_rejected(self):
+        with pytest.raises(ValueError):
+            FaultWindow(0, 1, "storage", "bitrot")
+
+    def test_window_inside_drain_rejected(self):
+        with pytest.raises(ValueError):
+            ScenarioSpec(name="bad", seed=1, duration_s=10.0,
+                         faults=(FaultWindow(6, 3, "backend", "hang"),),
+                         drain_s=5.0)
+
+    def test_full_scenario_overlaps_planes(self):
+        """The tentpole property: at least one instant has two planes
+        faulted at once."""
+        for spec in (FULL, CI):
+            overlapping = False
+            ws = spec.faults
+            for a in ws:
+                for b in ws:
+                    if a is not b and a.plane != b.plane \
+                            and a.at_s < b.end_s and b.at_s < a.end_s:
+                        overlapping = True
+            assert overlapping, f"{spec.name} has no cross-plane overlap"
+
+
+class TestReportMath:
+    def test_percentile_nearest_rank(self):
+        vals = sorted(float(i) for i in range(1, 101))
+        assert percentile(vals, 0.50) == 51.0
+        assert percentile(vals, 0.99) == 100.0
+        assert percentile([], 0.5) == 0.0
+
+    def test_summarize_buckets_outcomes(self):
+        samples = [
+            Sample("http", "write", "ok", 0.01, 1.0),
+            Sample("http", "write", "rejected", 0.02, 2.0, "http.429"),
+            Sample("bolt", "read", "ok", 0.005, 1.5),
+        ]
+        out = summarize(samples)
+        assert out["http"]["requests"] == 2
+        assert out["http"]["outcomes"]["rejected"] == 1
+        assert out["http"]["errors"] == {"http.429": 1}
+        assert out["bolt"]["p50_ms"] == 5.0
+
+    def test_collector_ack_sets(self):
+        c = Collector(time.monotonic())
+        c.ack_write("serving", "a")
+        c.ack_write("serving", "b")
+        c.ack_write("raft", "r1")
+        assert c.acked("serving") == {"a", "b"}
+        assert c.acked("raft") == {"r1"}
+        assert c.acked("nope") == set()
+
+    def test_report_ok_and_json(self, tmp_path):
+        from nornicdb_tpu.soak.report import failed, passed
+
+        rep = SoakReport(scenario={"name": "t"})
+        rep.invariants = [passed("a"), passed("b")]
+        assert rep.ok
+        rep.invariants.append(failed("c", "boom"))
+        assert not rep.ok
+        path = str(tmp_path / "r.json")
+        rep.write(path)
+        with open(path) as f:
+            data = json.load(f)
+        assert data["ok"] is False
+        assert [i["name"] for i in data["invariants"]] == ["a", "b", "c"]
+
+
+class TestPrometheusParser:
+    def test_parses_labels_and_histograms(self):
+        text = (
+            "# HELP x_seconds latency\n"
+            "# TYPE x_seconds histogram\n"
+            'x_seconds_bucket{le="0.1"} 3\n'
+            'x_seconds_bucket{le="+Inf"} 5\n'
+            "x_seconds_sum 0.42\n"
+            "x_seconds_count 5\n"
+            'y_total{event="sent",node="a"} 7\n'
+        )
+        fams = parse_prometheus(text)
+        assert fams["x_seconds_count"][()] == 5
+        assert fams["y_total"][('event="sent"', 'node="a"')] == 7.0
+
+    def test_malformed_line_raises(self):
+        with pytest.raises(ValueError):
+            parse_prometheus("not a metric line at all\n")
+
+    def test_histogram_count_mismatch_detected(self):
+        text = (
+            'h_bucket{le="1"} 3\n'
+            'h_bucket{le="+Inf"} 3\n'
+            "h_sum 1.0\n"
+            "h_count 4\n"  # != +Inf bucket
+        )
+        res = check_metrics_wellformed(text)
+        assert not res.ok
+        assert "_count" in res.detail
+
+    def test_live_registry_passes(self):
+        from nornicdb_tpu.telemetry.metrics import REGISTRY
+
+        res = check_metrics_wellformed(REGISTRY.render_prometheus())
+        assert res.ok, res.detail
+
+
+class TestInvariantCheckers:
+    def test_bounded_latency(self):
+        ok = [Sample("http", "w", "ok", 1.0, 0.0)]
+        assert check_bounded_latency(ok, 5.0, 10.0).ok
+        bad = ok + [Sample("bolt", "w", "timeout", 16.0, 1.0)]
+        res = check_bounded_latency(bad, 5.0, 10.0)
+        assert not res.ok and "bolt" in res.detail
+
+    def test_no_illegal_errors(self):
+        legal = [Sample("http", "w", o, 0.1, 0.0)
+                 for o in ("ok", "rejected", "unavailable", "timeout")]
+        assert check_no_illegal_errors(legal).ok
+        res = check_no_illegal_errors(
+            legal + [Sample("http", "w", "error", 0.1, 0.0, "http.500")])
+        assert not res.ok
+
+    def test_traces_wellformed(self):
+        good = {"traces": [{"trace_id": "abc", "root": "http.request",
+                            "duration_ms": 1.2, "span_count": 3,
+                            "started": 0, "dropped_spans": 0}]}
+        assert check_traces_wellformed(good).ok
+        assert not check_traces_wellformed({"traces": []}).ok
+        assert not check_traces_wellformed({}).ok
+        assert not check_traces_wellformed(
+            {"traces": [{"trace_id": ""}]}).ok
+
+    def test_backend_ready_one_hot(self):
+        up = ('nornicdb_backend_state{state="READY"} 1\n'
+              'nornicdb_backend_state{state="DEGRADED_CPU"} 0\n')
+        assert check_backend_ready(up).ok
+        down = up.replace('READY"} 1', 'READY"} 0').replace(
+            'DEGRADED_CPU"} 0', 'DEGRADED_CPU"} 1')
+        assert not check_backend_ready(down).ok
+        assert not check_backend_ready("other_metric 1\n").ok
+
+
+class _RecordingDriver(PlaneDriver):
+    def __init__(self, fail_probe=False):
+        self.events = []
+        self.fail_probe = fail_probe
+        self._lock = threading.Lock()
+
+    def start_fault(self, w):
+        with self._lock:
+            self.events.append(("start", w.kind))
+
+    def clear_fault(self, w):
+        with self._lock:
+            self.events.append(("clear", w.kind))
+
+    def post_window_probe(self, w):
+        with self._lock:
+            self.events.append(("probe", w.kind))
+        return "still broken" if self.fail_probe else None
+
+
+class TestFaultScheduler:
+    def _run(self, windows, driver, wall=2.0):
+        sched = FaultScheduler(windows, {"backend": driver})
+        sched.start(time.monotonic())
+        time.sleep(wall)
+        sched.stop()
+        return sched
+
+    def test_start_clear_probe_ordering(self):
+        d = _RecordingDriver()
+        sched = self._run(
+            (FaultWindow(0.1, 0.3, "backend", "hang"),), d, wall=1.0)
+        assert d.events == [("start", "hang"), ("clear", "hang"),
+                            ("probe", "hang")]
+        assert sched.executed[0]["recovered"] is True
+
+    def test_probe_failure_recorded(self):
+        d = _RecordingDriver(fail_probe=True)
+        sched = self._run(
+            (FaultWindow(0.1, 0.2, "backend", "fail"),), d, wall=1.0)
+        assert sched.probe_failures
+        assert "still broken" in sched.probe_failures[0]
+
+    def test_overlapping_windows_compose(self):
+        d = _RecordingDriver()
+        self._run((
+            FaultWindow(0.1, 0.6, "backend", "hang"),
+            FaultWindow(0.3, 0.2, "backend", "slow"),
+        ), d, wall=1.2)
+        # slow starts while hang is active and clears before it
+        idx = {e: i for i, e in enumerate(d.events)}
+        assert idx[("start", "slow")] > idx[("start", "hang")]
+        assert idx[("clear", "slow")] < idx[("clear", "hang")]
+
+    def test_early_stop_clears_active_faults(self):
+        d = _RecordingDriver()
+        sched = FaultScheduler(
+            (FaultWindow(0.1, 30.0, "backend", "hang"),), {"backend": d})
+        sched.start(time.monotonic())
+        time.sleep(0.4)
+        sched.stop()
+        assert ("start", "hang") in d.events
+        assert ("clear", "hang") in d.events  # not left active
+
+
+class TestMicroSoakEndToEnd:
+    """One real harness run: live HTTP/Bolt/Qdrant traffic, 3-node raft
+    over chaos transports, backend hang window, storage ENOSPC window,
+    full invariant catalog, report artifact."""
+
+    def test_micro_scenario_all_invariants_pass(self, tmp_path):
+        from nornicdb_tpu.soak.harness import run_scenario
+
+        report_path = str(tmp_path / "SOAK_report.json")
+        report = run_scenario(MICRO, str(tmp_path / "wd"), report_path)
+        violations = {r.name: r.detail for r in report.violations()}
+        assert not violations, violations
+        # the artifact is committed-shape: parseable, self-describing
+        with open(report_path) as f:
+            data = json.load(f)
+        assert data["ok"] is True
+        assert data["scenario"]["seed"] == MICRO.seed
+        assert set(data["protocols"]) >= {"http", "bolt", "qdrant",
+                                          "replication"}
+        names = {i["name"] for i in data["invariants"]}
+        assert {"no_wedged_threads", "bounded_latency",
+                "no_illegal_errors", "metrics_wellformed",
+                "traces_wellformed", "backend_ready",
+                "replica_convergence", "wal_crash_recovery"} <= names
+        # faults actually fired on every plane
+        fired = {(f["plane"], f["kind"]) for f in data["faults_executed"]}
+        assert {("replication", "chaos"), ("storage", "enospc"),
+                ("backend", "hang")} <= fired
