@@ -1,6 +1,6 @@
 # NornicDB-TPU (ref: the reference's Makefile test/build targets)
 
-.PHONY: test test-fast lint lint-baseline sanitize smoke chaos soak soak-ci soak-nornsan bench bench-search bench-embed native e2e-bench clean
+.PHONY: test test-fast lint lint-baseline sanitize smoke chaos soak soak-ci soak-nornsan bench bench-search bench-embed bench-generate native e2e-bench clean
 
 test:
 	python -m pytest tests/ -q
@@ -13,12 +13,12 @@ lint-baseline:
 
 # runtime lock sanitizer over the threaded suites (docs/linting.md#nornsan)
 sanitize:
-	NORNSAN=1 python -m pytest tests/test_concurrency.py tests/test_replication.py tests/test_replication_scenarios.py tests/test_nornsan.py tests/test_adjacency.py tests/test_telemetry.py tests/test_backend.py tests/test_sharded_serving.py tests/test_serving.py -q -m 'not slow'
+	NORNSAN=1 python -m pytest tests/test_concurrency.py tests/test_replication.py tests/test_replication_scenarios.py tests/test_nornsan.py tests/test_adjacency.py tests/test_telemetry.py tests/test_backend.py tests/test_sharded_serving.py tests/test_serving.py tests/test_genserve.py -q -m 'not slow'
 
 # search/embed suite with the accelerator backend forced to hang: the
 # lifecycle manager must keep the stack serving from CPU (docs/backend.md)
 chaos:
-	NORNICDB_FAKE_BACKEND=hang NORNICDB_DEVICE_ACQUIRE_TIMEOUT=2 python -m pytest tests/test_embed_search.py tests/test_search_unit_depth.py tests/test_sharded_serving.py tests/test_serving.py -q -m 'not slow'
+	NORNICDB_FAKE_BACKEND=hang NORNICDB_DEVICE_ACQUIRE_TIMEOUT=2 python -m pytest tests/test_embed_search.py tests/test_search_unit_depth.py tests/test_sharded_serving.py tests/test_serving.py tests/test_genserve.py -q -m 'not slow'
 
 # live-server /metrics + /admin/traces smoke (docs/observability.md)
 smoke:
@@ -48,6 +48,7 @@ bench:
 	python bench.py
 	python scripts/bench_search.py
 	python scripts/bench_embed.py
+	python scripts/bench_generate.py
 
 bench-search:
 	python scripts/bench_search.py
@@ -57,6 +58,12 @@ bench-search:
 # batch invariant at exit)
 bench-embed:
 	python scripts/bench_embed.py
+
+# sequential generate() vs paged-KV continuous batching at mixed prompt/
+# output lengths (writes BENCH_generate.json; asserts the bounded
+# compiled-program-count invariant at exit)
+bench-generate:
+	python scripts/bench_generate.py
 
 e2e-bench:
 	python benchmarks/endpoints_bench.py

@@ -51,6 +51,12 @@ def cmd_serve(args) -> int:
     from nornicdb_tpu.search import service as search_service
 
     search_service.configure_defaults(**vars(app_cfg.search))
+    # generation-serving knobs (paged-KV geometry, concurrency, deadline,
+    # degraded-backend policy) become the defaults for the genserve engine
+    # this process builds behind Heimdall/GraphRAG — docs/generation.md
+    from nornicdb_tpu import genserve as genserve_mod
+
+    genserve_mod.configure(app_cfg.genserve)
     # kick off PJRT init + first-touch on the manager's worker thread NOW,
     # so the first search/embed finds a READY (or already-degraded) backend
     # instead of paying the acquire timeout inline
@@ -125,6 +131,15 @@ def cmd_serve(args) -> int:
         # the cache sits outside so hits skip the queue entirely
         embedder = ServingEngine(embedder, serving_cfg)
     db.set_embedder(CachedEmbedder(embedder))
+    # with an assistant checkpoint mounted, build + warm the generation
+    # engine now: the paged prefill/decode programs compile before traffic
+    # instead of inside the first request's deadline
+    if os.environ.get("NORNICDB_ASSISTANT_MODEL") and \
+            app_cfg.genserve.enabled:
+        _ = db.heimdall
+        gen_engine = db.genserve_engine()
+        if gen_engine is not None:
+            gen_engine.warmup()
 
     authenticator = None
     if args.auth:

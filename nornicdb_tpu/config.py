@@ -152,6 +152,48 @@ class ServingConfig:
 
 
 @dataclass
+class GenServeConfig:
+    """Continuous-batching generation engine knobs (nornicdb_tpu.genserve):
+    applied by ``cli serve`` via ``genserve.configure(cfg.genserve)``.  The
+    engine serves Heimdall chat/QC and the GraphRAG answer endpoint from a
+    paged KV cache with prefill/decode interleaving — see
+    docs/generation.md.  Env form: ``NORNICDB_GENSERVE_<FIELD>`` (e.g.
+    ``NORNICDB_GENSERVE_PAGE_SIZE``, ``NORNICDB_GENSERVE_POOL_PAGES``,
+    ``NORNICDB_GENSERVE_MAX_SEQS``, ``NORNICDB_GENSERVE_DEADLINE_MS``,
+    ``NORNICDB_GENSERVE_FALLBACK``)."""
+
+    # master switch: off = Heimdall keeps the synchronous per-request path
+    enabled: bool = True
+    # "paged" = paged-KV continuous batching; "dense" = the per-sequence
+    # dense-cache fallback path (numerically equivalent, no cross-request
+    # decode batching — the equivalence reference and escape hatch)
+    mode: str = "paged"
+    # KV page geometry: slots per page and physical pages in the pool
+    # (one page is reserved as the null/scratch page)
+    page_size: int = 16
+    pool_pages: int = 129
+    # concurrency + per-sequence bound (prompt + generated tokens; the
+    # page-table width is max_seq_tokens / page_size)
+    max_seqs: int = 8
+    max_seq_tokens: int = 256
+    # max tokens per interleaved prefill chunk (bucketed to powers of two
+    # so jits stay bounded)
+    prefill_chunk: int = 64
+    # admission control: queued requests beyond this shed with
+    # 429/RESOURCE_EXHAUSTED (an empty queue always admits)
+    max_queue: int = 64
+    # per-request deadline; expired requests are shed (0 disables — not
+    # recommended: the deadline is the no-indefinite-block guarantee)
+    deadline_ms: float = 10000.0
+    # degraded backend policy: "cpu" re-prefills and decodes on host,
+    # "fail" raises DeviceUnavailable instead (strict deployments)
+    fallback: str = "cpu"
+    # GraphRAG answer endpoint: retrieved context nodes + decode budget
+    rag_context_nodes: int = 5
+    rag_max_new_tokens: int = 64
+
+
+@dataclass
 class SearchTuningConfig:
     """Vector-serving knobs (nornicdb_tpu.search.SearchConfig): applied by
     ``cli serve`` via ``search.service.configure_defaults`` before the
@@ -191,6 +233,7 @@ class AppConfig:
     backend: BackendConfig = field(default_factory=BackendConfig)
     search: SearchTuningConfig = field(default_factory=SearchTuningConfig)
     serving: ServingConfig = field(default_factory=ServingConfig)
+    genserve: GenServeConfig = field(default_factory=GenServeConfig)
 
 
 def find_config_file(start_dir: str = ".") -> Optional[str]:

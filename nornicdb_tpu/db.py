@@ -114,6 +114,8 @@ class DB:
         self._db_executors: dict[str, Any] = {}
         self._query_cache = None
         self._heimdall = None
+        self._genserve = None
+        self._graphrag = None
         self._vectorspaces = None
         if self.config.decay_enabled:
             _ = self.decay  # starts the periodic recalculation ticker
@@ -338,13 +340,59 @@ class DB:
                     count_error("heimdall.checkpoint_load")
             if generator is None:
                 generator = TemplateGenerator(self)
-            self._heimdall = HeimdallManager(generator, db=self)
+            self._heimdall = HeimdallManager(
+                self._wire_genserve(generator), db=self)
         return self._heimdall
 
     def set_heimdall_generator(self, generator) -> None:
         from nornicdb_tpu.heimdall import HeimdallManager
 
-        self._heimdall = HeimdallManager(generator, db=self)
+        self._heimdall = HeimdallManager(
+            self._wire_genserve(generator), db=self)
+
+    def _wire_genserve(self, generator):
+        """Front a weights-backed generator with the genserve
+        continuous-batching engine (paged-KV decode, admission control,
+        deadline shedding — docs/generation.md).  Template/stub
+        generators pass through unchanged; so does genserve.enabled=False
+        (the synchronous per-request path stays the escape hatch)."""
+        if self._genserve is not None:
+            self._genserve.stop()
+            self._genserve = None
+        self._graphrag = None  # rebuilt against the new engine on demand
+        if not all(hasattr(generator, a)
+                   for a in ("params", "cfg", "tokenizer")):
+            return generator
+        from nornicdb_tpu import genserve
+
+        gcfg = genserve.current_config()
+        if not getattr(gcfg, "enabled", True):
+            return generator
+        from nornicdb_tpu.heimdall import EngineGenerator
+
+        self._genserve = genserve.GenerationEngine(
+            generator.params, generator.cfg,
+            tokenizer=generator.tokenizer, config=gcfg)
+        return EngineGenerator(
+            self._genserve,
+            max_context=getattr(generator, "max_context", 256))
+
+    def genserve_engine(self):
+        """The generation engine behind Heimdall, or None when generation
+        is template-backed / disabled (observability surfaces must not
+        force the assistant to build)."""
+        return self._genserve
+
+    def graphrag(self):
+        """GraphRAG answer service over this DB's search + adjacency +
+        generation engine (``POST /nornicdb/rag/answer``).  Cached: the
+        service resolves its config once, not per request."""
+        if self._graphrag is None:
+            from nornicdb_tpu.genserve import GraphRAGService
+
+            _ = self.heimdall  # builds the engine when weights exist
+            self._graphrag = GraphRAGService(self, engine=self._genserve)
+        return self._graphrag
 
     @property
     def decay(self):
@@ -703,6 +751,10 @@ class DB:
             engine.stop()
         if self._decay is not None:
             self._decay.stop()
+        if self._genserve is not None:
+            # generation engine: queued/running requests fail fast with
+            # ClosedError instead of stranding callers
+            self._genserve.stop()
         self._base_storage.close()
 
     def __enter__(self) -> "DB":

@@ -1,0 +1,53 @@
+"""nornicdb_tpu.genserve — paged-KV continuous-batching generation.
+
+Public surface:
+
+* :class:`GenerationEngine` / :class:`GenHandle` — the continuous
+  batching decode engine over the paged KV cache (engine.py).
+* :class:`GraphRAGService` — graph-context retrieval -> packed prompt ->
+  generation (graphrag.py; ``POST /nornicdb/rag/answer``).
+* :func:`configure` / :func:`current_config` — process-default
+  :class:`~nornicdb_tpu.config.GenServeConfig` (``cli serve`` applies the
+  ``genserve:`` config section here before servers take traffic; embedded
+  processes fall back to the env-derived config).
+
+Import-light by design: jax and the model modules load lazily inside the
+engine, so importing this package (e.g. for the metric families in
+stats.py) never triggers backend init.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Optional
+
+from nornicdb_tpu.genserve.engine import GenerationEngine, GenHandle, GenStats
+from nornicdb_tpu.genserve.graphrag import GraphRAGService
+
+__all__ = [
+    "GenerationEngine", "GenHandle", "GenStats", "GraphRAGService",
+    "configure", "current_config",
+]
+
+_config = None
+_mu = threading.Lock()
+
+
+def configure(cfg=None) -> None:
+    """Set the process-default GenServeConfig (``cli serve`` calls this
+    with the loaded ``genserve:`` section).  ``None`` resets to the
+    env-derived defaults."""
+    global _config
+    with _mu:
+        _config = cfg
+
+
+def current_config():
+    """The configured process default, else a fresh env-derived
+    GenServeConfig (NORNICDB_GENSERVE_* variables apply either way)."""
+    with _mu:
+        if _config is not None:
+            return _config
+    from nornicdb_tpu.config import AppConfig, load_from_env
+
+    return load_from_env(AppConfig()).genserve

@@ -1,0 +1,954 @@
+"""Continuous-batching generation engine over a paged KV cache.
+
+The synchronous path this replaces (``heimdall.QwenGenerator.generate``)
+runs one prompt at a time against a dense per-request ``(B, Tmax)`` KV
+cache: admitting a second request means waiting for the first to finish,
+and every distinct prompt length compiles a fresh cache shape.  This
+engine owns the generation path end to end:
+
+* **Paged KV cache** (Ragged Paged Attention, PAPERS.md).  One pooled
+  buffer of fixed-size pages shared by every sequence, with per-sequence
+  page tables (``models/qwen2.py`` ``init_kv_pages`` /
+  ``paged_prefill_chunk`` / ``paged_decode_step``).  Attention
+  block-gathers each sequence's pages; sequences join and leave the
+  running batch at step boundaries by allocating/freeing pages — no
+  cache reallocation, no cross-request shape coupling.  A
+  numerically-equivalent dense fallback path (``mode="dense"``) keeps a
+  per-sequence dense cache for escape-hatch deployments and as the
+  equivalence reference the test suite holds the paged path to.
+* **Prefill/decode interleaving.**  Each scheduler iteration runs at
+  most one prompt-prefill chunk (power-of-two bucketed, so jits stay
+  bounded — the ``round_up_pow2`` discipline from models/qwen2.py) and
+  then one decode step for the whole running batch: long prompts never
+  stall tokens for sequences mid-decode.
+* **Admission / eviction on page-pool pressure.**  A bounded queue sheds
+  at submit with :class:`ResourceExhausted` (HTTP 429 / gRPC
+  RESOURCE_EXHAUSTED / Bolt transient at the edges); a sequence that
+  needs a page when the pool is empty evicts the youngest other running
+  sequence, which is requeued and re-prefilled from its prompt plus the
+  tokens it already produced (greedy decode makes the continuation
+  identical — tolerance-tested).
+* **Deadline shedding.**  Requests carry a deadline: queued work expired
+  before admission is shed, running work is shed at step boundaries,
+  and waiting callers give up at deadline + grace — no caller blocks
+  indefinitely, even with a hung accelerator.
+* **Backend gating** (PR 6).  Every device dispatch is gated through the
+  :mod:`nornicdb_tpu.backend` lifecycle manager BEFORE any lock: while
+  the backend is degraded the engine re-prefills and decodes on CPU from
+  a host parameter mirror (``fallback="cpu"``), or sheds cleanly with
+  :class:`DeviceUnavailable` (``fallback="fail"``) — never a wedge.
+* **Per-request streaming.**  ``submit`` returns a :class:`GenHandle`
+  whose token/text streams deliver each token as the scheduler produces
+  it (the Heimdall SSE path rides this).
+
+Thread model: caller threads do admission and block on their handle; the
+single scheduler thread owns the page pool, page tables and running set
+exclusively, so no lock is ever held across a device op (NL-DEV01) or a
+blocking decode (NL-LK02).  The engine lock guards only the queue and
+gauges.
+"""
+
+from __future__ import annotations
+
+import logging
+import queue as queue_mod
+import threading
+import time
+from collections import deque
+from dataclasses import dataclass
+from typing import Iterator, Optional, Sequence
+
+import numpy as np
+
+from nornicdb_tpu.errors import (
+    ClosedError,
+    DeviceUnavailable,
+    ResourceExhausted,
+)
+from nornicdb_tpu.genserve import stats as _stats
+from nornicdb_tpu.telemetry.tracing import tracer as _tracer
+
+logger = logging.getLogger(__name__)
+
+# sequence states (scheduler-owned)
+_QUEUED, _PREFILL, _DECODE = "queued", "prefill", "decode"
+
+
+@dataclass
+class GenStats:
+    requests: int = 0
+    completed: int = 0
+    generated_tokens: int = 0
+    prefill_chunks: int = 0
+    decode_steps: int = 0
+    decode_lane_tokens: int = 0  # real (non-padding) lanes stepped
+    admissions: int = 0
+    readmissions: int = 0
+    evictions: int = 0
+    sheds_queue_full: int = 0
+    sheds_deadline: int = 0
+    sheds_pool: int = 0
+    sheds_device: int = 0
+    cancelled: int = 0
+    errors: int = 0
+    pool_resets: int = 0
+    cpu_steps: int = 0
+
+    def as_dict(self) -> dict:
+        return {k: getattr(self, k) for k in self.__dataclass_fields__}
+
+
+class GenHandle:
+    """Caller-side surface of one generation request.
+
+    Tokens accumulate on the handle as the scheduler produces them;
+    callers either stream (:meth:`stream_tokens` / :meth:`stream_text`)
+    or wait for the full result (:meth:`result` / :meth:`text`).  The
+    per-token stream queue (and its thread wakeups) exists only once a
+    consumer actually streams — batch consumers (QC, GraphRAG, the
+    bench's throughput pass) wait on one completion event and cost the
+    scheduler a list append per token, not a wakeup per token.  Every
+    wait is bounded by the request deadline plus a grace window — a
+    caller never blocks indefinitely on a wedged pipeline.
+    """
+
+    _GRACE = 1.0
+
+    def __init__(self, engine: "GenerationEngine", deadline: float):
+        self._engine = engine
+        self._mu = threading.Lock()
+        self._tokens: list[int] = []
+        self._stream_q: Optional[queue_mod.Queue] = None
+        self._done = threading.Event()
+        self.deadline = deadline  # monotonic; 0 = none
+        self.error: Optional[Exception] = None
+        self.shed = False  # terminal: scheduler must drop this sequence
+
+    # -- scheduler side ----------------------------------------------------
+    def _deliver(self, tok: int) -> None:
+        with self._mu:
+            self._tokens.append(tok)
+            q = self._stream_q
+        if q is not None:
+            q.put(tok)
+
+    def _finish(self, error: Optional[Exception] = None) -> None:
+        with self._mu:
+            if self._done.is_set():
+                return
+            self.error = error
+            self._done.set()
+            q = self._stream_q
+        if q is not None:
+            q.put(None)
+
+    # -- caller side -------------------------------------------------------
+    @property
+    def done(self) -> bool:
+        return self._done.is_set()
+
+    @property
+    def tokens(self) -> list[int]:
+        with self._mu:
+            return list(self._tokens)
+
+    def _time_left(self) -> float:
+        if not self.deadline:
+            return 1.0
+        return min(1.0, max(0.01,
+                            self.deadline + self._GRACE - time.monotonic()))
+
+    def _mark_shed(self) -> bool:
+        """Atomically transition to shed; True only for the ONE thread
+        (caller or scheduler) that made the transition — the shed
+        counters increment exactly once per request."""
+        with self._mu:
+            if self.shed:
+                return False
+            self.shed = True
+            return True
+
+    def _give_up(self) -> Exception:
+        """Caller-side deadline expiry: the scheduler sees .shed and
+        frees the sequence's pages at the next step boundary."""
+        if self._mark_shed():
+            self._engine.stats.sheds_deadline += 1
+            _stats.SHEDS.labels("deadline").inc()
+        self.error = ResourceExhausted(
+            "generation deadline exceeded", reason="deadline")
+        return self.error
+
+    def stream_tokens(self) -> Iterator[int]:
+        """Yield token ids as the scheduler produces them (tokens already
+        generated are replayed first).  Raises the request's terminal
+        error (shed/closed) when generation failed."""
+        with self._mu:
+            if self._stream_q is None:
+                self._stream_q = queue_mod.Queue()
+                for tok in self._tokens:
+                    self._stream_q.put(tok)
+                if self._done.is_set():
+                    self._stream_q.put(None)
+            q = self._stream_q
+        while True:
+            try:
+                tok = q.get(timeout=self._time_left())
+            except queue_mod.Empty:
+                if self._done.is_set():
+                    continue  # race: sentinel arriving; loop re-polls
+                if self.deadline and time.monotonic() > (
+                        self.deadline + self._GRACE):
+                    raise self._give_up()
+                continue
+            if tok is None:
+                if self.error is not None:
+                    raise self.error
+                return
+            yield tok
+
+    def stream_text(self) -> Iterator[str]:
+        """Decoded text deltas (diffs of the running decode, so any
+        tokenizer's spacing rules hold — same contract as the synchronous
+        QwenGenerator.generate_stream)."""
+        tokenizer = self._engine.tokenizer
+        if tokenizer is None:
+            raise ValueError("engine has no tokenizer; stream tokens instead")
+        prev = ""
+        out: list[int] = []
+        for tok in self.stream_tokens():
+            out.append(tok)
+            text = tokenizer.decode(out)
+            if text != prev:
+                yield text[len(prev):]
+                prev = text
+
+    def result(self, partial_ok: bool = False) -> list[int]:
+        """All generated token ids (bounded wait on the completion event
+        — no per-token stream consumption).  With ``partial_ok`` a
+        shed/failed request returns what it produced instead of
+        raising."""
+        while not self._done.wait(timeout=self._time_left()):
+            if self.deadline and time.monotonic() > (
+                    self.deadline + self._GRACE):
+                err = self._give_up()
+                if not partial_ok:
+                    raise err
+                break
+        if self._done.is_set() and self.error is not None and not partial_ok:
+            raise self.error
+        return self.tokens
+
+    def text(self, partial_ok: bool = False) -> str:
+        tokenizer = self._engine.tokenizer
+        if tokenizer is None:
+            raise ValueError("engine has no tokenizer")
+        return tokenizer.decode(self.result(partial_ok=partial_ok))
+
+
+class _Seq:
+    """Scheduler-internal state of one admitted-or-queued request."""
+
+    __slots__ = (
+        "handle", "prompt", "out", "max_new", "eos_id", "state",
+        "prefill_tokens", "prefill_pos", "page_ids", "page_table",
+        "cache_len", "admit_no", "dense_cache", "dense_len",
+        "submitted_at", "first_token_at", "counted",
+    )
+
+    def __init__(self, handle: GenHandle, prompt: list[int], max_new: int,
+                 eos_id: int):
+        self.handle = handle
+        self.prompt = prompt
+        self.out: list[int] = []
+        self.max_new = max_new
+        self.eos_id = eos_id
+        self.state = _QUEUED
+        self.prefill_tokens: list[int] = []
+        self.prefill_pos = 0
+        self.page_ids: list[int] = []
+        self.page_table: Optional[np.ndarray] = None
+        self.cache_len = 0
+        self.admit_no = -1
+        self.dense_cache = None  # mode="dense": per-seq dense KV caches
+        self.dense_len = 0
+        self.submitted_at = time.monotonic()
+        self.first_token_at = 0.0
+        self.counted = False
+
+
+class GenerationEngine:
+    """Paged-KV continuous-batching decode engine for one Qwen2 model."""
+
+    def __init__(self, params, cfg, tokenizer=None, config=None,
+                 manager=None):
+        if config is None:
+            from nornicdb_tpu.genserve import current_config
+
+            config = current_config()
+        self.params = params
+        self.cfg = cfg
+        self.tokenizer = tokenizer
+        self.config = config
+        self.stats = GenStats()
+        # compiled-program ledger: (kind, static shape) per jit entry the
+        # engine has dispatched — the bench asserts this stays bounded and
+        # that a warmed engine compiles nothing new in its timed pass
+        self.programs: set = set()
+        self._manager = manager
+        self._page_size = max(1, int(config.page_size))
+        from nornicdb_tpu.models.qwen2 import pages_for, round_up_pow2
+
+        self._table_width = pages_for(int(config.max_seq_tokens),
+                                      self._page_size)
+        self._usable_pages = int(config.pool_pages) - 1  # page 0 = null
+        if self._usable_pages < self._table_width:
+            raise ValueError(
+                f"genserve pool_pages={config.pool_pages} cannot hold one "
+                f"max_seq_tokens={config.max_seq_tokens} sequence "
+                f"({self._table_width} pages needed + the null page)")
+        self._prefill_chunk = round_up_pow2(
+            max(16, int(config.prefill_chunk)), 16)
+        self._max_seqs = max(1, int(config.max_seqs))
+        self._lock = threading.Lock()
+        self._cond = threading.Condition(self._lock)
+        self._queue: deque[_Seq] = deque()
+        self._stop = threading.Event()
+        self._started = False
+        self._thread: Optional[threading.Thread] = None
+        # scheduler-owned (no lock: single owner thread)
+        self._running: list[_Seq] = []
+        self._free_pages: list[int] = list(
+            range(1, self._usable_pages + 1))
+        self._pages = None
+        self._admit_counter = 0
+        self._device_kind: Optional[str] = None  # "default" | "cpu"
+        self._cpu_params = None
+        self._host_params = None
+        self._cpu_device = None
+
+    # -- lifecycle ---------------------------------------------------------
+    def start(self) -> None:
+        with self._lock:
+            if self._started:
+                return
+            self._started = True
+        t = threading.Thread(target=self._loop, name="nornicdb-genserve",
+                             daemon=True)
+        t.start()
+        self._thread = t
+
+    def stop(self) -> None:
+        """Stop the scheduler; queued and running requests fail fast with
+        ClosedError rather than stranding their callers."""
+        self._stop.set()
+        with self._cond:
+            queued = list(self._queue)
+            self._queue.clear()
+            # the gauge is process-global: a replaced engine must not
+            # leave its drained queue's depth behind as phantom backlog
+            _stats.QUEUE_DEPTH.set(0)
+            self._cond.notify_all()
+        for seq in queued:
+            self._finish_seq(seq, error=ClosedError("generation engine "
+                                                    "stopped"), drop=False)
+        if self._thread is not None:
+            # the scheduler fails its own running set on exit (it owns
+            # those structures); a join timeout means a hung device call —
+            # callers stay bounded by their handle deadline + grace
+            self._thread.join(timeout=5)
+
+    @property
+    def running(self) -> bool:
+        return self._thread is not None and self._thread.is_alive()
+
+    def warmup(self, timeout: float = 60.0) -> None:
+        """Compile EVERY program class the configured engine can dispatch
+        — each prefill chunk bucket (16..prefill_chunk) and each pow2
+        decode batch size (1..max_seqs) — before taking traffic, so no
+        live request pays an XLA compile inside its deadline (the soak
+        harness and ``cli serve`` call this at boot).
+
+        Paged mode compiles directly against a THROWAWAY pool on the
+        caller thread (the jit cache is shared; the scheduler's pool and
+        state are never touched, so warmup is safe while serving), GATED
+        through the backend manager first — a wedged accelerator at boot
+        degrades warmup to the CPU programs (or skips it under
+        ``fallback="fail"``) instead of hanging startup in a raw
+        dispatch.  ``timeout`` bounds both the gate and the compile loop
+        (checked between compiles; one compile itself is uninterruptible,
+        like any jit dispatch).  Dense mode falls back to one tiny
+        end-to-end request."""
+        deadline = time.monotonic() + timeout
+        if self.config.mode == "dense":
+            handle = self.submit([1, 2, 3], max_new_tokens=2, deadline_ms=0)
+            while not handle.done and time.monotonic() < deadline:
+                time.sleep(0.01)
+            return
+        ready = self._mgr().await_ready(timeout)
+        if not ready and (self.config.fallback or "cpu") != "cpu":
+            return  # degraded + fail policy: requests will shed anyway
+        kind = "default" if ready else "cpu"
+        from nornicdb_tpu.models import qwen2
+        import contextlib
+        import jax
+        import jax.numpy as jnp
+
+        params = self._params_for(kind)
+        ctx = (jax.default_device(self._cpu_dev()) if kind == "cpu"
+               else contextlib.nullcontext())
+        w = self._table_width
+        with ctx:
+            pool = qwen2.init_kv_pages(self.cfg, self._usable_pages + 1,
+                                       self._page_size)
+            table = np.zeros((w,), np.int32)
+            table[0] = 1
+            c = 16
+            while time.monotonic() < deadline:
+                _, pool = qwen2.paged_prefill_chunk(
+                    params, self.cfg, jnp.zeros((c,), jnp.int32), pool,
+                    jnp.asarray(table), jnp.asarray(0), jnp.asarray(1))
+                self.programs.add(("prefill", c, w))
+                if c >= self._prefill_chunk:
+                    break
+                c *= 2
+            b = 1
+            while time.monotonic() < deadline:
+                _, pool = qwen2.paged_decode_step(
+                    params, self.cfg, jnp.zeros((b,), jnp.int32), pool,
+                    jnp.zeros((b, w), jnp.int32), jnp.zeros((b,), jnp.int32))
+                self.programs.add(("decode", b, w))
+                if b >= self._max_seqs:
+                    break
+                b *= 2
+
+    # -- submission --------------------------------------------------------
+    def submit(self, prompt_ids: Sequence[int], max_new_tokens: int = 64,
+               deadline_ms: Optional[float] = None) -> GenHandle:
+        """Enqueue one generation request; returns its streaming handle.
+
+        Sheds with :class:`ResourceExhausted` when the queue is full (an
+        empty queue always admits) or the engine is stopped."""
+        if self._stop.is_set():
+            raise ClosedError("generation engine stopped")
+        self.start()
+        prompt = [int(t) for t in prompt_ids] or [1]
+        # bound to the page table: keep the prompt TAIL (the recency rule
+        # heimdall's synchronous generator already applies) and leave room
+        # for at least one generated token
+        limit = int(self.config.max_seq_tokens)
+        if len(prompt) > limit - 1:
+            prompt = prompt[-(limit - 1):]
+        max_new = max(1, min(int(max_new_tokens), limit - len(prompt)))
+        if deadline_ms is None:
+            deadline_ms = float(self.config.deadline_ms)
+        deadline = (time.monotonic() + deadline_ms / 1000.0
+                    if deadline_ms and deadline_ms > 0 else 0.0)
+        handle = GenHandle(self, deadline)
+        eos = getattr(self.tokenizer, "eos_id", -1) if self.tokenizer else -1
+        seq = _Seq(handle, prompt, max_new, eos)
+        with self._cond:
+            # re-check under the lock stop() drains the queue with: a seq
+            # appended after the drain would never be processed by anyone
+            if self._stop.is_set():
+                raise ClosedError("generation engine stopped")
+            if self._queue and len(self._queue) + 1 > int(
+                    self.config.max_queue):
+                self.stats.sheds_queue_full += 1
+                _stats.SHEDS.labels("queue_full").inc()
+                _stats.REQUESTS.labels("shed").inc()
+                raise ResourceExhausted(
+                    f"generation queue full ({len(self._queue)} queued); "
+                    "retry with backoff", reason="queue_full")
+            self.stats.requests += 1
+            self._queue.append(seq)
+            _stats.QUEUE_DEPTH.set(len(self._queue))
+            self._cond.notify_all()
+        return handle
+
+    def generate(self, prompt_ids: Sequence[int], max_new_tokens: int = 64,
+                 deadline_ms: Optional[float] = None) -> list[int]:
+        """Synchronous convenience: submit + wait for the full result."""
+        return self.submit(prompt_ids, max_new_tokens, deadline_ms).result()
+
+    def generate_text(self, prompt: str, max_new_tokens: int = 64,
+                      deadline_ms: Optional[float] = None) -> str:
+        if self.tokenizer is None:
+            raise ValueError("engine has no tokenizer")
+        ids = self.tokenizer.encode(prompt, add_special=False)
+        return self.submit(ids, max_new_tokens, deadline_ms).text()
+
+    # -- scheduler ---------------------------------------------------------
+    def _loop(self) -> None:
+        while not self._stop.is_set():
+            with self._cond:
+                while (not self._queue and not self._running
+                       and not self._stop.is_set()):
+                    self._cond.wait(0.25)
+                if self._stop.is_set():
+                    break
+                self._shed_expired_queued()
+            if self._stop.is_set():
+                break
+            try:
+                self._step()
+            except Exception as e:  # a broken step must not strand callers:
+                # fail everything resident (running AND queued) — new
+                # submits retry against a possibly-recovered backend, and
+                # nobody waits out a full deadline on a dead step
+                if isinstance(e, DeviceUnavailable):
+                    logger.warning("genserve step shed: %s", e)
+                    self.stats.sheds_device += 1
+                    _stats.SHEDS.labels("device").inc()
+                else:
+                    logger.exception("genserve scheduler step failed")
+                for seq in list(self._running):
+                    self._finish_seq(seq, error=e)
+                # the failing call may have CONSUMED the donated pool
+                # (donate_argnums): a poisoned buffer must not survive
+                # into the next step, so rebuild from scratch
+                self._pages = None
+                self._free_pages = list(range(1, self._usable_pages + 1))
+                with self._cond:
+                    queued = list(self._queue)
+                    self._queue.clear()
+                    _stats.QUEUE_DEPTH.set(0)
+                for seq in queued:
+                    self._finish_seq(seq, error=e, drop=False)
+        # scheduler exit: fail whatever is still resident so no caller
+        # waits out its full deadline on a stopped engine
+        for seq in list(self._running):
+            self._finish_seq(seq, error=ClosedError(
+                "generation engine stopped"))
+
+    def _shed_expired_queued(self) -> None:
+        """Drop queued requests whose deadline already passed (under the
+        lock; no device work here)."""
+        if not self._queue:
+            return
+        now = time.monotonic()
+        keep: deque[_Seq] = deque()
+        for seq in self._queue:
+            h = seq.handle
+            if h.shed:
+                self._count_outcome(seq, "shed")
+                h._finish(h.error or ResourceExhausted(
+                    "generation request cancelled", reason="deadline"))
+            elif h.deadline and now > h.deadline:
+                if h._mark_shed():
+                    self.stats.sheds_deadline += 1
+                    _stats.SHEDS.labels("deadline").inc()
+                self._count_outcome(seq, "shed")
+                h._finish(ResourceExhausted(
+                    "generation deadline exceeded before admission",
+                    reason="deadline"))
+            else:
+                keep.append(seq)
+        self._queue = keep
+        _stats.QUEUE_DEPTH.set(len(self._queue))
+
+    def _count_outcome(self, seq: _Seq, outcome: str) -> None:
+        if seq.counted:
+            return
+        seq.counted = True
+        if outcome == "ok":
+            self.stats.completed += 1
+        elif outcome == "error":
+            self.stats.errors += 1
+        _stats.REQUESTS.labels(outcome).inc()
+
+    def _finish_seq(self, seq: _Seq, error: Optional[Exception] = None,
+                    drop: bool = True) -> None:
+        """Terminal bookkeeping for one sequence (scheduler thread, or
+        stop()): free pages, count the outcome, wake the caller."""
+        if drop and seq in self._running:
+            self._running.remove(seq)
+        self._release_pages(seq)
+        seq.dense_cache = None
+        if error is None:
+            self._count_outcome(seq, "ok")
+        elif isinstance(error, ResourceExhausted):
+            self._count_outcome(seq, "shed")
+        else:
+            self._count_outcome(seq, "error")
+        seq.handle._finish(error)
+
+    def _release_pages(self, seq: _Seq) -> None:
+        if seq.page_ids:
+            self._free_pages.extend(seq.page_ids)
+            seq.page_ids = []
+        seq.page_table = None
+        seq.cache_len = 0
+        seq.prefill_pos = 0
+
+    # -- device gating -----------------------------------------------------
+    def _mgr(self):
+        if self._manager is None:
+            from nornicdb_tpu import backend
+
+            self._manager = backend.manager()
+        return self._manager
+
+    def _gate(self) -> str:
+        """Bounded backend gate BEFORE any device dispatch (no locks held:
+        the scheduler thread owns everything it touches here).  Returns
+        the platform to serve this step from."""
+        mgr = self._mgr()
+        if mgr.await_ready():
+            return "default"
+        if (self.config.fallback or "cpu") != "cpu":
+            raise DeviceUnavailable(
+                f"backend {mgr.state}; genserve fallback policy is "
+                f"{self.config.fallback!r}")
+        mgr.note_fallback("generate")
+        return "cpu"
+
+    def _active_params(self):
+        return self._params_for(self._device_kind)
+
+    def _params_for(self, kind):
+        if kind != "cpu":
+            return self.params
+        if self._cpu_params is None:
+            import jax
+
+            if self._host_params is None:
+                # host mirror: params committed to a dead accelerator
+                # cannot be relocated by jax.default_device (the
+                # TPUEmbedder lesson, PR 6)
+                self._host_params = jax.tree.map(np.asarray, self.params)
+            self._cpu_params = jax.tree.map(
+                lambda a: jax.device_put(a, self._cpu_dev()),
+                self._host_params)
+        return self._cpu_params
+
+    def _cpu_dev(self):
+        if self._cpu_device is None:
+            import jax
+
+            self._cpu_device = jax.local_devices(backend="cpu")[0]
+        return self._cpu_device
+
+    def _platform_ctx(self):
+        import contextlib
+
+        if self._device_kind == "cpu":
+            import jax
+
+            return jax.default_device(self._cpu_dev())
+        return contextlib.nullcontext()
+
+    def _apply_platform(self, kind: str) -> None:
+        """Handle a READY<->DEGRADED transition: the pool on the old
+        platform is unreachable (or stale), so rebuild it and requeue
+        every running sequence for re-prefill from prompt + emitted
+        tokens (greedy continuation is identical)."""
+        if kind == self._device_kind:
+            return
+        if self._device_kind is not None:
+            self.stats.pool_resets += 1
+            logger.warning("genserve: backend platform %s -> %s; "
+                           "re-prefilling %d running sequences",
+                           self._device_kind, kind, len(self._running))
+        self._device_kind = kind
+        self._pages = None
+        self._free_pages = list(range(1, self._usable_pages + 1))
+        requeue = list(self._running)
+        self._running = []
+        with self._cond:
+            for seq in reversed(requeue):
+                seq.page_ids = []
+                seq.page_table = None
+                seq.cache_len = 0
+                seq.prefill_pos = 0
+                seq.dense_cache = None
+                seq.state = _QUEUED
+                self._queue.appendleft(seq)
+            _stats.QUEUE_DEPTH.set(len(self._queue))
+
+    def _ensure_pool(self):
+        if self._pages is None and self.config.mode != "dense":
+            from nornicdb_tpu.models import qwen2
+
+            with self._platform_ctx():
+                self._pages = qwen2.init_kv_pages(
+                    self.cfg, self._usable_pages + 1, self._page_size)
+        return self._pages
+
+    # -- one scheduler iteration -------------------------------------------
+    def _step(self) -> None:
+        kind = self._gate()
+        self._apply_platform(kind)
+        if kind == "cpu":
+            self.stats.cpu_steps += 1
+        self._ensure_pool()
+        self._admit()
+        self._prefill_one()
+        self._decode_step()
+        self._publish_gauges()
+
+    def _publish_gauges(self) -> None:
+        _stats.RUNNING_SEQS.set(len(self._running))
+        used = self._usable_pages - len(self._free_pages)
+        _stats.PAGE_POOL_UTIL.set(used / max(1, self._usable_pages))
+
+    def _admit(self) -> None:
+        from nornicdb_tpu.models.qwen2 import pages_for
+
+        while len(self._running) < self._max_seqs:
+            with self._cond:
+                if not self._queue:
+                    return
+                seq = self._queue[0]
+                need = (0 if self.config.mode == "dense" else
+                        pages_for(len(seq.prompt) + len(seq.out) + 1,
+                                  self._page_size))
+                if need > len(self._free_pages):
+                    return  # pool pressure: wait for a finisher/evictor
+                self._queue.popleft()
+                _stats.QUEUE_DEPTH.set(len(self._queue))
+            if seq.handle.shed:
+                self._finish_seq(seq, error=seq.handle.error or
+                                 ResourceExhausted("cancelled",
+                                                   reason="deadline"),
+                                 drop=False)
+                continue
+            seq.prefill_tokens = seq.prompt + seq.out
+            seq.prefill_pos = 0
+            seq.cache_len = 0
+            seq.state = _PREFILL
+            seq.admit_no = self._admit_counter
+            self._admit_counter += 1
+            if need:
+                seq.page_ids = [self._free_pages.pop()
+                                for _ in range(need)]
+                table = np.zeros((self._table_width,), np.int32)
+                table[:len(seq.page_ids)] = seq.page_ids
+                seq.page_table = table
+            if seq.out:
+                self.stats.readmissions += 1
+            self.stats.admissions += 1
+            self._running.append(seq)
+
+    def _grow(self, seq: _Seq) -> bool:
+        """Ensure the sequence has a page for cache slot ``cache_len``.
+        On an empty free list, evict the youngest OTHER running sequence
+        (requeued at the queue head for readmission).  Returns False only
+        when the sequence had to be shed (cannot happen for a lone
+        sequence: its own bound fits the pool by construction)."""
+        from nornicdb_tpu.models.qwen2 import pages_for
+
+        need = pages_for(seq.cache_len + 1, self._page_size)
+        while len(seq.page_ids) < need:
+            if not self._free_pages:
+                victims = [s for s in self._running
+                           if s is not seq and s.page_ids]
+                if not victims:
+                    self.stats.sheds_pool += 1
+                    _stats.SHEDS.labels("pool_exhausted").inc()
+                    self._finish_seq(seq, error=ResourceExhausted(
+                        "page pool exhausted", reason="pool_exhausted"))
+                    return False
+                victim = max(victims, key=lambda s: s.admit_no)
+                self._evict(victim)
+                continue
+            pid = self._free_pages.pop()
+            seq.page_ids.append(pid)
+            seq.page_table[len(seq.page_ids) - 1] = pid
+        return True
+
+    def _evict(self, victim: _Seq) -> None:
+        self.stats.evictions += 1
+        _stats.EVICTIONS.inc()
+        self._running.remove(victim)
+        self._release_pages(victim)
+        victim.dense_cache = None
+        victim.state = _QUEUED
+        with self._cond:
+            self._queue.appendleft(victim)
+            _stats.QUEUE_DEPTH.set(len(self._queue))
+
+    # -- prefill -----------------------------------------------------------
+    def _prefill_one(self) -> None:
+        """Run ONE prompt chunk for the oldest sequence still prefilling
+        — interleaved with decode steps so prefill never starves the
+        running batch."""
+        pre = [s for s in self._running if s.state == _PREFILL]
+        if not pre:
+            return
+        seq = min(pre, key=lambda s: s.admit_no)
+        if self._expired(seq):
+            return
+        if self.config.mode == "dense":
+            self._dense_prefill(seq)
+            return
+        from nornicdb_tpu.models import qwen2
+        import jax.numpy as jnp
+
+        remaining = len(seq.prefill_tokens) - seq.prefill_pos
+        chunk = min(self._prefill_chunk,
+                    qwen2.round_up_pow2(remaining, 16))
+        piece = seq.prefill_tokens[seq.prefill_pos:seq.prefill_pos + chunk]
+        n_valid = len(piece)
+        padded = piece + [0] * (chunk - n_valid)
+        t0 = time.perf_counter()
+        params = self._active_params()
+        self.programs.add(("prefill", chunk, self._table_width))
+        final = seq.prefill_pos + n_valid >= len(seq.prefill_tokens)
+        with self._platform_ctx():
+            with _tracer.span("genserve.prefill",
+                              {"chunk": chunk, "valid": n_valid}):
+                logits, self._pages = qwen2.paged_prefill_chunk(
+                    params, self.cfg,
+                    jnp.asarray(padded, jnp.int32), self._pages,
+                    jnp.asarray(seq.page_table),
+                    jnp.asarray(seq.prefill_pos),
+                    jnp.asarray(n_valid))
+                # argmax ON DEVICE: only the winning token id crosses to
+                # host, never the (V,) logits row (and intermediate
+                # chunks transfer nothing at all)
+                tok = int(jnp.argmax(logits)) if final else None
+        _stats.PREFILL_HIST.observe(time.perf_counter() - t0)
+        self.stats.prefill_chunks += 1
+        seq.prefill_pos += n_valid
+        seq.cache_len = seq.prefill_pos
+        if final:
+            # final chunk: its last-position logits pick the continuation
+            self._emit(seq, tok)
+
+    def _dense_prefill(self, seq: _Seq) -> None:
+        """mode="dense" fallback: per-sequence dense (1, Tmax) cache, the
+        pre-genserve decode path — the numeric reference."""
+        from nornicdb_tpu.models import qwen2
+        import jax.numpy as jnp
+
+        toks = seq.prefill_tokens
+        max_len = qwen2.round_up_pow2(
+            min(len(toks) + seq.max_new, int(self.config.max_seq_tokens)))
+        t0 = time.perf_counter()
+        params = self._active_params()
+        self.programs.add(("dense_prefill", len(toks), max_len))
+        with self._platform_ctx():
+            logits, seq.dense_cache = qwen2.prefill(
+                params, self.cfg, jnp.asarray([toks], jnp.int32), max_len)
+            tok = int(jnp.argmax(logits[0]))
+        _stats.PREFILL_HIST.observe(time.perf_counter() - t0)
+        self.stats.prefill_chunks += 1
+        seq.prefill_pos = len(toks)
+        seq.dense_len = len(toks)
+        seq.cache_len = len(toks)
+        self._emit(seq, tok)
+
+    def _emit(self, seq: _Seq, tok: int) -> None:
+        """Deliver one generated token and advance lifecycle state."""
+        seq.out.append(tok)
+        if seq.first_token_at == 0.0:
+            seq.first_token_at = time.monotonic()
+        self.stats.generated_tokens += 1
+        _stats.TOKENS.inc()
+        seq.handle._deliver(tok)
+        if (tok == seq.eos_id and seq.eos_id >= 0) or \
+                len(seq.out) >= seq.max_new:
+            self._finish_seq(seq)
+        else:
+            seq.state = _DECODE
+
+    def _expired(self, seq: _Seq) -> bool:
+        h = seq.handle
+        if h.shed:
+            self.stats.cancelled += 1
+            self._finish_seq(seq, error=h.error or ResourceExhausted(
+                "generation request cancelled", reason="deadline"))
+            return True
+        if h.deadline and time.monotonic() > h.deadline:
+            if h._mark_shed():
+                self.stats.sheds_deadline += 1
+                _stats.SHEDS.labels("deadline").inc()
+            self._finish_seq(seq, error=ResourceExhausted(
+                "generation deadline exceeded", reason="deadline"))
+            return True
+        return False
+
+    # -- decode ------------------------------------------------------------
+    def _decode_step(self) -> None:
+        active = [s for s in self._running if s.state == _DECODE]
+        active = [s for s in active if not self._expired(s)]
+        if not active:
+            return
+        if self.config.mode == "dense":
+            for seq in active:
+                self._dense_decode(seq)
+            return
+        from nornicdb_tpu.models import qwen2
+        import jax.numpy as jnp
+
+        # page growth first, for side effects only: a shed or evicted
+        # sequence leaves self._running and the re-filter below drops it
+        for seq in list(active):
+            if seq in self._running:
+                self._grow(seq)
+        active = [s for s in active if s in self._running
+                  and s.state == _DECODE]
+        if not active:
+            return
+        b_real = len(active)
+        b = qwen2.round_up_pow2(b_real, 1)
+        tokens = np.zeros((b,), np.int32)
+        tables = np.zeros((b, self._table_width), np.int32)
+        lengths = np.zeros((b,), np.int32)
+        for i, seq in enumerate(active):
+            tokens[i] = seq.out[-1]
+            tables[i] = seq.page_table
+            lengths[i] = seq.cache_len
+        t0 = time.perf_counter()
+        params = self._active_params()
+        self.programs.add(("decode", b, self._table_width))
+        with self._platform_ctx():
+            with _tracer.span("genserve.decode", {"batch": b_real}):
+                logits, self._pages = qwen2.paged_decode_step(
+                    params, self.cfg, jnp.asarray(tokens), self._pages,
+                    jnp.asarray(tables), jnp.asarray(lengths))
+                # greedy argmax on device: (B,) ints cross to host, not
+                # the (B, V) logits matrix (~MBs/step at real vocabs)
+                host = np.asarray(jnp.argmax(logits, axis=-1))
+        _stats.DECODE_HIST.observe(time.perf_counter() - t0)
+        self.stats.decode_steps += 1
+        self.stats.decode_lane_tokens += b_real
+        for i, seq in enumerate(active):
+            seq.cache_len += 1
+            self._emit(seq, int(host[i]))
+
+    def _dense_decode(self, seq: _Seq) -> None:
+        from nornicdb_tpu.models import qwen2
+        import jax.numpy as jnp
+
+        t0 = time.perf_counter()
+        params = self._active_params()
+        max_len = seq.dense_cache[0][0].shape[1]
+        self.programs.add(("dense_step", max_len))
+        with self._platform_ctx():
+            logits, seq.dense_cache = qwen2.decode_step(
+                params, self.cfg, jnp.asarray([seq.out[-1]], jnp.int32),
+                seq.dense_cache, jnp.asarray(seq.dense_len))
+            tok = int(jnp.argmax(logits[0]))
+        _stats.DECODE_HIST.observe(time.perf_counter() - t0)
+        self.stats.decode_steps += 1
+        self.stats.decode_lane_tokens += 1
+        seq.dense_len += 1
+        seq.cache_len += 1
+        self._emit(seq, tok)
+
+    # -- observability -----------------------------------------------------
+    def stats_snapshot(self) -> dict:
+        out = self.stats.as_dict()
+        with self._lock:
+            out["queue_depth"] = len(self._queue)
+        out["running_seqs"] = len(self._running)
+        out["free_pages"] = len(self._free_pages)
+        out["usable_pages"] = self._usable_pages
+        out["page_size"] = self._page_size
+        out["mode"] = self.config.mode
+        out["device_kind"] = self._device_kind or "unstarted"
+        out["max_seqs"] = self._max_seqs
+        # copy first: the scheduler thread adds to the ledger concurrently
+        out["programs"] = sorted(str(p) for p in self.programs.copy())
+        return out

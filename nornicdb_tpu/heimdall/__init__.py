@@ -10,6 +10,7 @@ from nornicdb_tpu.heimdall.context import (
 )
 from nornicdb_tpu.heimdall.manager import (
     Bifrost,
+    EngineGenerator,
     Generator,
     HeimdallManager,
     HeimdallMetrics,
@@ -28,8 +29,8 @@ from nornicdb_tpu.heimdall.registry import (
 )
 
 __all__ = [
-    "Bifrost", "Generator", "HeimdallManager", "HeimdallMetrics",
-    "QwenGenerator", "TemplateGenerator",
+    "Bifrost", "EngineGenerator", "Generator", "HeimdallManager",
+    "HeimdallMetrics", "QwenGenerator", "TemplateGenerator",
     "PromptContext", "PromptExample", "TokenBudget", "GenerateParams",
     "CYPHER_PRIMER", "estimate_tokens",
     "ModelInfo", "ModelRegistry", "MetricsRegistry",

@@ -15,12 +15,17 @@ deterministic template fallback when no weights are mounted.
 from __future__ import annotations
 
 import json
+import logging
 import queue
 import re
 import threading
 import time
 from dataclasses import dataclass, field
 from typing import Any, Callable, Iterator, Optional
+
+from nornicdb_tpu.telemetry.metrics import count_error as _count_error
+
+log = logging.getLogger(__name__)
 
 from nornicdb_tpu.heimdall.context import (
     GenerateParams,
@@ -87,6 +92,30 @@ class Generator:
     def generate_stream(self, prompt: str, max_tokens: int = 128) -> Iterator[str]:
         yield self.generate(prompt, max_tokens)
 
+    def generate_many(self, prompts: list[str],
+                      max_tokens: int = 128) -> list[str]:
+        """Batch generation.  The base fallback is sequential; backends
+        with a serving engine (EngineGenerator) overlap the whole batch
+        through continuous batching — Heimdall QC rides this."""
+        return [self.generate(p, max_tokens) for p in prompts]
+
+
+def _trim_prompt_ids(tokenizer, prompt: str, max_context: int) -> list[int]:
+    """Shared weights-backed prompt policy: keep the prompt TAIL within
+    the model's trained window — for in-image checkpoints rope positions
+    beyond it were never seen in training."""
+    return tokenizer.encode(prompt, add_special=False)[-max_context:] or [1]
+
+
+def _cap_new_tokens(max_tokens: int, max_context: int) -> int:
+    """Bound decode length to one trained window beyond the prompt:
+    positions past 2x max_context are deep rope extrapolation for an
+    in-image from-scratch model (held-out action rates were measured at
+    prompt<=window + window new tokens).  ONE implementation for both
+    weights-backed generators (QwenGenerator, EngineGenerator) so the
+    window policy can never diverge between the sync and engine paths."""
+    return max(1, min(max_tokens, max_context))
+
 
 class QwenGenerator(Generator):
     """Qwen2-on-TPU backend (replaces llama.cpp generation)."""
@@ -110,8 +139,7 @@ class QwenGenerator(Generator):
         self.max_context = max_context
 
     def generate(self, prompt: str, max_tokens: int = 128) -> str:
-        ids = self.tokenizer.encode(
-            prompt, add_special=False)[-self.max_context:] or [1]
+        ids = _trim_prompt_ids(self.tokenizer, prompt, self.max_context)
         out = self.qwen2.generate(
             self.params, self.cfg, ids,
             max_new_tokens=self._cap_new_tokens(max_tokens),
@@ -120,11 +148,7 @@ class QwenGenerator(Generator):
         return self.tokenizer.decode(out)
 
     def _cap_new_tokens(self, max_tokens: int) -> int:
-        """Bound decode length to one trained window beyond the prompt:
-        positions past 2x max_context are deep rope extrapolation for an
-        in-image from-scratch model (held-out action rates were measured
-        at prompt<=window + window new tokens)."""
-        return max(1, min(max_tokens, self.max_context))
+        return _cap_new_tokens(max_tokens, self.max_context)
 
     def generate_stream(self, prompt: str, max_tokens: int = 128):
         """TRUE incremental decode (ref: GenerationModel streaming,
@@ -133,8 +157,7 @@ class QwenGenerator(Generator):
         decode so any tokenizer's spacing/punctuation rules hold."""
         import jax.numpy as jnp
 
-        ids = self.tokenizer.encode(
-            prompt, add_special=False)[-self.max_context:] or [1]
+        ids = _trim_prompt_ids(self.tokenizer, prompt, self.max_context)
         max_tokens = self._cap_new_tokens(max_tokens)
         # bucketed cache length: one compiled program per power-of-two
         # bucket instead of one per distinct prompt length
@@ -161,6 +184,54 @@ class QwenGenerator(Generator):
             )
             tok = int(jnp.argmax(logits, axis=-1)[0])
             pos += 1
+
+
+class EngineGenerator(Generator):
+    """Generator served by the genserve continuous-batching engine.
+
+    Replaces the synchronous QwenGenerator path when genserve is enabled:
+    every chat/QC generation becomes a submit into the shared paged-KV
+    engine, so concurrent requests decode in ONE running batch instead of
+    serializing, and admission control / deadline shedding apply
+    (ResourceExhausted surfaces as HTTP 429 / Bolt transient at the
+    edges).  Streaming is native: tokens are yielded as the scheduler
+    produces them."""
+
+    def __init__(self, engine, max_context: int = 256):
+        self.engine = engine
+        self.tokenizer = engine.tokenizer
+        # same trained-window recency trim as QwenGenerator
+        self.max_context = max_context
+        # expose the backing model like QwenGenerator (pretrain tooling
+        # and the model registry read these)
+        self.cfg = engine.cfg
+        self.params = engine.params
+
+    def _ids(self, prompt: str) -> list[int]:
+        return _trim_prompt_ids(self.tokenizer, prompt, self.max_context)
+
+    def _cap(self, max_tokens: int) -> int:
+        return _cap_new_tokens(max_tokens, self.max_context)
+
+    def generate(self, prompt: str, max_tokens: int = 128) -> str:
+        return self.tokenizer.decode(self.engine.generate(
+            self._ids(prompt), max_new_tokens=self._cap(max_tokens)))
+
+    def generate_stream(self, prompt: str, max_tokens: int = 128):
+        handle = self.engine.submit(
+            self._ids(prompt), max_new_tokens=self._cap(max_tokens))
+        yield from handle.stream_text()
+
+    def generate_many(self, prompts: list[str],
+                      max_tokens: int = 128) -> list[str]:
+        """Submit the whole batch up front: the engine's scheduler decodes
+        every prompt in one continuous batch (this is the Heimdall QC
+        path — previously one synchronous generate() per suggested
+        edge)."""
+        cap = self._cap(max_tokens)
+        handles = [self.engine.submit(self._ids(p), max_new_tokens=cap)
+                   for p in prompts]
+        return [self.tokenizer.decode(h.result()) for h in handles]
 
 
 class TemplateGenerator(Generator):
@@ -389,6 +460,31 @@ class HeimdallManager:
         finally:
             self.metrics.total_latency += time.perf_counter() - t0
 
+    def generate_many(self, prompts: list[str], max_tokens: int = 128,
+                      generator: Optional[Generator] = None) -> list[str]:
+        """Batch generation with the same guard + metric contract as
+        :meth:`generate`.  PluginHost wraps ``generate`` (not this), so
+        pre_prompt guards are applied here explicitly via
+        ``pre_prompt_transform`` — a batch path must never evade plugin
+        redaction/veto.  Backends with a serving engine overlap the whole
+        batch through continuous batching."""
+        if not prompts:
+            return []
+        t0 = time.perf_counter()
+        backend = generator if generator is not None else self.generator
+        guarded = [self.pre_prompt_transform(p) for p in prompts]
+        try:
+            outs = backend.generate_many(guarded, max_tokens)
+            self.metrics.generations += len(outs)
+            self.metrics.tokens_generated += sum(
+                len(o.split()) for o in outs)
+            return outs
+        except Exception:
+            self.metrics.errors += 1
+            raise
+        finally:
+            self.metrics.total_latency += time.perf_counter() - t0
+
     def build_context(
         self, messages: list[dict[str, str]]
     ) -> PromptContext:
@@ -416,12 +512,18 @@ class HeimdallManager:
                     f"{self.db.storage.edge_count()} relationships."
                 )
             except Exception:
-                pass
+                # context enrichment is best-effort, but a storage engine
+                # that can't count is worth surfacing
+                log.warning("heimdall DB-context injection failed",
+                            exc_info=True)
+                _count_error("heimdall.context")
         for hook in list(self.context_hooks):
             try:
                 hook(ctx)
             except Exception:
-                pass
+                log.warning("heimdall context hook %r failed", hook,
+                            exc_info=True)
+                _count_error("heimdall.context_hook")
             if ctx.cancelled:
                 break
         return ctx

@@ -10,11 +10,16 @@ pkg/nornicdb/plugins.go:56 (Python modules instead of Go .so files).
 from __future__ import annotations
 
 import importlib.util
+import logging
 import os
 import threading
 import time
 from dataclasses import dataclass, field
 from typing import Any, Callable, Optional
+
+from nornicdb_tpu.telemetry.metrics import count_error as _count_error
+
+log = logging.getLogger(__name__)
 
 
 @dataclass
@@ -154,7 +159,12 @@ class PluginHost:
                 if isinstance(plugin, HeimdallPlugin):
                     out.append(self.register(plugin))
             except Exception:
-                continue  # a broken plugin must not break the host
+                # a broken plugin must not break the host — but a plugin
+                # that silently never loads is an operator mystery
+                log.warning("heimdall plugin %s failed to load", mod_path,
+                            exc_info=True)
+                _count_error("heimdall.plugin_load")
+                continue
         return out
 
     # -- status ------------------------------------------------------------
@@ -167,6 +177,8 @@ class PluginHost:
                 try:
                     info.healthy = bool(plugin.health())
                 except Exception:
+                    log.warning("heimdall plugin %s health check failed",
+                                info.name, exc_info=True)
                     info.healthy = False
         return infos
 
@@ -183,7 +195,12 @@ class PluginHost:
                 try:
                     prompt = p.pre_prompt(prompt)
                 except Exception:
-                    pass
+                    # a failing guard plugin falls back to the unmodified
+                    # prompt; log it — redaction silently not applying is
+                    # exactly what an operator needs to know
+                    log.warning("heimdall plugin %s pre_prompt failed",
+                                p.name, exc_info=True)
+                    _count_error("heimdall.plugin_hook")
             return prompt
 
         def generate_with_hooks(prompt: str, max_tokens: int = 128,
@@ -206,7 +223,9 @@ class PluginHost:
                 try:
                     p.pre_prompt_context(ctx)
                 except Exception:
-                    pass
+                    log.warning("heimdall plugin %s pre_prompt_context "
+                                "failed", p.name, exc_info=True)
+                    _count_error("heimdall.plugin_hook")
                 if ctx.cancelled:
                     if not ctx.cancelled_by:
                         ctx.cancel(ctx.cancel_reason, p.name)
@@ -223,6 +242,9 @@ class PluginHost:
             try:
                 modified = p.pre_execute(action)
             except Exception:
+                log.warning("heimdall plugin %s pre_execute failed",
+                            p.name, exc_info=True)
+                _count_error("heimdall.plugin_hook")
                 continue
             if modified is None:
                 return {"vetoed_by": p.name}
@@ -233,7 +255,9 @@ class PluginHost:
             try:
                 result = p.post_execute(action, result)
             except Exception:
-                pass
+                log.warning("heimdall plugin %s post_execute failed",
+                            p.name, exc_info=True)
+                _count_error("heimdall.plugin_hook")
         return result
 
     def _emit_storage_event(self, kind: str, entity: Any) -> None:
@@ -262,7 +286,9 @@ class PluginHost:
             try:
                 p.on_db_event(event.type, event)
             except Exception:
-                pass
+                log.warning("heimdall plugin %s on_db_event failed",
+                            p.name, exc_info=True)
+                _count_error("heimdall.plugin_event")
 
     def _on_db_event(self, kind: str, entity: Any) -> None:
         with self._lock:
@@ -271,7 +297,9 @@ class PluginHost:
             try:
                 p.on_db_event(kind, entity)
             except Exception:
-                pass
+                log.warning("heimdall plugin %s on_db_event failed",
+                            p.name, exc_info=True)
+                _count_error("heimdall.plugin_event")
 
 
 class WatcherPlugin(HeimdallPlugin):

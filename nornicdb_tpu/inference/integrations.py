@@ -117,30 +117,46 @@ class HeimdallQC:
         self.rejected = 0
 
     def review(self, pairs: list[tuple[str, str, str]]) -> list[bool]:
-        """pairs: (from_id, to_id, rel_type) -> keep? per pair."""
-        out = []
-        for from_id, to_id, rel_type in pairs:
+        """pairs: (from_id, to_id, rel_type) -> keep? per pair.
+
+        The whole batch is submitted through the manager's
+        ``generate_many`` in one call: with the genserve continuous
+        batching engine behind Heimdall, every pair's review decodes
+        concurrently in the shared paged-KV batch instead of serializing
+        one synchronous ``generate()`` per edge (the pre-genserve
+        behavior, and still the fallback for template backends)."""
+        out: list[Optional[bool]] = [None] * len(pairs)
+        prompts: list[str] = []
+        prompt_slots: list[int] = []
+        for i, (from_id, to_id, rel_type) in enumerate(pairs):
             try:
                 a = self.storage.get_node(from_id)
                 b = self.storage.get_node(to_id)
             except NotFoundError:
-                out.append(False)  # endpoint deleted since suggestion
+                out[i] = False  # endpoint deleted since suggestion
                 continue
-            prompt = (
+            prompts.append(
                 "Should these two memories be linked as "
                 f"{rel_type}? Reply JSON {{\"keep\": true/false}}.\n"
                 f"A: {a.properties.get('content', '')[:200]}\n"
                 f"B: {b.properties.get('content', '')[:200]}"
             )
+            prompt_slots.append(i)
+        texts: list[Optional[str]] = []
+        if prompts:
             try:
-                text = self.manager.generate(prompt, max_tokens=16)
+                texts = list(self.manager.generate_many(
+                    prompts, max_tokens=16))
             except Exception:
                 # QC failure must not block learning — but a QC model
                 # that is ALWAYS down silently approves everything
-                log.warning("link-QC generation failed; keeping edge",
-                            exc_info=True)
+                log.warning("link-QC batch generation failed; keeping "
+                            "%d edges", len(prompts), exc_info=True)
                 count_error("inference.link_qc")
-                out.append(True)
+                texts = [None] * len(prompts)
+        for slot, text in zip(prompt_slots, texts):
+            if text is None:
+                out[slot] = True  # fail open
                 continue
             self.reviewed += 1
             keep = True
@@ -153,8 +169,8 @@ class HeimdallQC:
                 keep = True  # non-JSON reply: fail open (keep the edge)
             if not keep:
                 self.rejected += 1
-            out.append(keep)
-        return out
+            out[slot] = keep
+        return [bool(k) for k in out]
 
     def attach(self, engine: InferenceEngine) -> None:
         if not qc_enabled():

@@ -254,6 +254,165 @@ def decode_step(params, cfg: QwenConfig, token: jax.Array, caches,
     return _cached_step(params, cfg, token, caches, pos, full_angles)
 
 
+# -- paged KV cache (genserve continuous-batching decode) --------------------
+#
+# The dense cache above is per-request (B, Tmax): admitting a new request
+# into a running batch means reallocating/copying every sequence's cache to
+# a common Tmax.  The paged layout (Ragged Paged Attention, PAPERS.md)
+# instead keeps ONE pool of fixed-size pages shared by every sequence, plus
+# a per-sequence page table mapping logical pages -> physical pool slots.
+# Sequences join/leave the batch by allocating/freeing pages; attention
+# block-gathers each sequence's pages into contiguous (S = P*page_size)
+# keys and masks by true length.  Physical page 0 is RESERVED as the null/
+# scratch page: padded lanes and padded chunk positions route their writes
+# there, so a static-shape program never corrupts a live page.
+
+NULL_PAGE = 0
+
+
+def pages_for(n_tokens: int, page_size: int) -> int:
+    """Logical pages needed to hold n_tokens cache slots."""
+    return max(1, -(-n_tokens // page_size))
+
+
+def init_kv_pages(cfg: QwenConfig, num_pages: int, page_size: int) -> jax.Array:
+    """One pooled KV buffer: (layers, 2[k|v], num_pages, page_size,
+    kv_heads, head_dim).  Page 0 is the null page (see module note)."""
+    head_dim = cfg.hidden // cfg.heads
+    return jnp.zeros(
+        (cfg.layers, 2, num_pages, page_size, cfg.kv_heads, head_dim),
+        jnp.dtype(cfg.dtype),
+    )
+
+
+def _apply_rope_rows(x: jax.Array, angles: jax.Array) -> jax.Array:
+    """apply_rope with PER-SEQUENCE positions: x (B, T, H, Dh), angles
+    (B, T, Dh/2) — the batched decode step rotates each lane at its own
+    cache length, where the dense path's shared scalar pos cannot."""
+    xf = x.astype(jnp.float32)
+    d2 = x.shape[-1] // 2
+    x1, x2 = xf[..., :d2], xf[..., d2:]
+    cos = jnp.cos(angles)[:, :, None, :]
+    sin = jnp.sin(angles)[:, :, None, :]
+    return jnp.concatenate(
+        [x1 * cos - x2 * sin, x2 * cos + x1 * sin], axis=-1
+    ).astype(x.dtype)
+
+
+def _paged_attention(cfg: QwenConfig, pages, li, page_tables, q, mask):
+    """Block-gather one layer's K/V pages for every sequence and attend.
+    page_tables: (B, P) physical page ids; q: (B, T, H, Dh)."""
+    b, p = page_tables.shape
+    ps = pages.shape[3]
+    n_rep = cfg.heads // cfg.kv_heads
+    head_dim = cfg.hidden // cfg.heads
+    k_all = pages[li, 0][page_tables].reshape(
+        b, p * ps, cfg.kv_heads, head_dim)
+    v_all = pages[li, 1][page_tables].reshape(
+        b, p * ps, cfg.kv_heads, head_dim)
+    return attention(q, repeat_kv(k_all, n_rep), repeat_kv(v_all, n_rep), mask)
+
+
+@functools.partial(jax.jit, static_argnames=("cfg",), donate_argnums=(3,))
+def paged_decode_step(params, cfg: QwenConfig, tokens: jax.Array,
+                      pages: jax.Array, page_tables: jax.Array,
+                      lengths: jax.Array):
+    """ONE decode step for a whole running batch over the paged pool.
+
+    tokens: (B,) current token per sequence (position = lengths[b]);
+    page_tables: (B, P) physical page per logical page (NULL_PAGE pads);
+    lengths: (B,) cache slots already written per sequence (padding lanes
+    carry length 0 and an all-null table; their logits are garbage the
+    scheduler discards).  Returns ((B, V) logits, advanced pages).
+
+    ``pages`` is DONATED: XLA aliases the pool in/out so each step writes
+    the two (B, Hkv, Dh) cache lines in place instead of copying the whole
+    pool (the caller must drop its reference to the passed-in pool).
+    """
+    b = tokens.shape[0]
+    p = page_tables.shape[1]
+    ps = pages.shape[3]
+    max_len = p * ps
+    head_dim = cfg.hidden // cfg.heads
+    full_angles = rope_freqs(head_dim, max_len, cfg.rope_theta)
+    angles = full_angles[lengths][:, None, :]  # (B, 1, Dh/2)
+    page_idx = jnp.clip(lengths // ps, 0, p - 1)
+    phys = jnp.take_along_axis(page_tables, page_idx[:, None], axis=1)[:, 0]
+    off = lengths % ps
+    slot = jax.lax.broadcasted_iota(jnp.int32, (1, max_len), 1)
+    mask = jnp.where(slot <= lengths[:, None], 0.0, -1e30)[:, None, None, :]
+    h = params["tok_emb"][tokens[:, None]]
+    for li, blk in enumerate(params["blocks"]):
+        x = rms_norm(blk["attn_norm"], h, cfg.rms_eps)
+        q = dense(blk["q"], x).reshape(b, 1, cfg.heads, head_dim)
+        k = dense(blk["k"], x).reshape(b, 1, cfg.kv_heads, head_dim)
+        v = dense(blk["v"], x).reshape(b, 1, cfg.kv_heads, head_dim)
+        q = _apply_rope_rows(q, angles)
+        k = _apply_rope_rows(k, angles)
+        pages = pages.at[li, 0, phys, off].set(k[:, 0])
+        pages = pages.at[li, 1, phys, off].set(v[:, 0])
+        o = _paged_attention(cfg, pages, li, page_tables, q, mask)
+        h = h + dense(blk["o"], o.reshape(b, 1, cfg.heads * head_dim))
+        x = rms_norm(blk["mlp_norm"], h, cfg.rms_eps)
+        h = h + dense(
+            blk["down"], jax.nn.silu(dense(blk["gate"], x)) * dense(blk["up"], x)
+        )
+    h = rms_norm(params["final_norm"], h, cfg.rms_eps)
+    return _logits(params, cfg, h)[:, 0, :], pages
+
+
+@functools.partial(jax.jit, static_argnames=("cfg",), donate_argnums=(3,))
+def paged_prefill_chunk(params, cfg: QwenConfig, chunk_ids: jax.Array,
+                        pages: jax.Array, page_table: jax.Array,
+                        start: jax.Array, n_valid: jax.Array):
+    """Prefill ONE chunk of one sequence's prompt into its pages.
+
+    chunk_ids: (C,) tokens at positions start..start+C-1 (padded past
+    n_valid; padded positions write to the null page); page_table: (P,)
+    this sequence's table.  The chunk's queries attend every cache slot
+    <= their own position, so a prompt split across chunks sees all
+    earlier chunks through the pool — the scheduler interleaves these
+    chunks with decode steps of the running batch.  Returns ((V,) logits
+    at the last valid position, advanced pages); the logits pick the
+    first generated token when this is the final chunk.
+    """
+    c = chunk_ids.shape[0]
+    p = page_table.shape[0]
+    ps = pages.shape[3]
+    max_len = p * ps
+    head_dim = cfg.hidden // cfg.heads
+    full_angles = rope_freqs(head_dim, max_len, cfg.rope_theta)
+    idx = jax.lax.iota(jnp.int32, c)
+    pos = jnp.clip(start + idx, 0, max_len - 1)
+    valid = idx < n_valid
+    angles = full_angles[pos][None]  # (1, C, Dh/2)
+    phys = jnp.where(valid, page_table[jnp.clip(pos // ps, 0, p - 1)],
+                     NULL_PAGE)
+    off = pos % ps
+    slot = jax.lax.broadcasted_iota(jnp.int32, (c, max_len), 1)
+    mask = jnp.where(slot <= pos[:, None], 0.0, -1e30)[None, None]
+    h = params["tok_emb"][chunk_ids][None]  # (1, C, hidden)
+    for li, blk in enumerate(params["blocks"]):
+        x = rms_norm(blk["attn_norm"], h, cfg.rms_eps)
+        q = dense(blk["q"], x).reshape(1, c, cfg.heads, head_dim)
+        k = dense(blk["k"], x).reshape(1, c, cfg.kv_heads, head_dim)
+        v = dense(blk["v"], x).reshape(1, c, cfg.kv_heads, head_dim)
+        q = _apply_rope_rows(q, angles)
+        k = _apply_rope_rows(k, angles)
+        pages = pages.at[li, 0, phys, off].set(k[0])
+        pages = pages.at[li, 1, phys, off].set(v[0])
+        o = _paged_attention(cfg, pages, li, page_table[None], q, mask)
+        h = h + dense(blk["o"], o.reshape(1, c, cfg.heads * head_dim))
+        x = rms_norm(blk["mlp_norm"], h, cfg.rms_eps)
+        h = h + dense(
+            blk["down"], jax.nn.silu(dense(blk["gate"], x)) * dense(blk["up"], x)
+        )
+    h = rms_norm(params["final_norm"], h, cfg.rms_eps)
+    logits = _logits(params, cfg, h)[0]  # (C, V)
+    last = jnp.clip(n_valid - 1, 0, c - 1)
+    return logits[last], pages
+
+
 def generate(
     params,
     cfg: QwenConfig,

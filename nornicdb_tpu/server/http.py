@@ -22,7 +22,12 @@ from typing import Any, Optional
 
 import numpy as np
 
-from nornicdb_tpu.errors import AuthError, NornicError, ResourceExhausted
+from nornicdb_tpu.errors import (
+    AuthError,
+    DurabilityError,
+    NornicError,
+    ResourceExhausted,
+)
 from nornicdb_tpu.storage.types import Edge, Node
 
 
@@ -34,6 +39,10 @@ from nornicdb_tpu.cypher.parser import parse as cypher_parse
 # docs/observability.md catalog renders in every server process, whether
 # or not a ServingEngine was constructed
 from nornicdb_tpu.serving import stats as _serving_stats  # noqa: F401
+# likewise the generation-engine families (queue depth, page-pool
+# utilization, prefill/decode latency, sheds, tokens) — the tested
+# observability catalog must render them in every serving process
+from nornicdb_tpu.genserve import stats as _genserve_stats  # noqa: F401
 from nornicdb_tpu.telemetry.metrics import (
     REGISTRY as _TELEMETRY_REGISTRY,
     Registry as _Registry,
@@ -366,6 +375,20 @@ class HttpServer:
                         self._send(
                             429,
                             {"error": str(e), "reason": e.reason},
+                            extra_headers={"Retry-After": "1"},
+                        )
+                    except DurabilityError as e:
+                        # the write was NOT acked and the WAL tail was
+                        # repaired: transient storage unavailability, not
+                        # a client error — 503 mirrors Bolt's
+                        # Neo.TransientError.General.DatabaseUnavailable
+                        # mapping (statement-level durability failures
+                        # are already reported in-body by the tx API;
+                        # this catches the ones raised outside a
+                        # statement, e.g. lazy system-DB writes)
+                        self._send(
+                            503,
+                            {"error": str(e), "kind": e.kind},
                             extra_headers={"Retry-After": "1"},
                         )
                     except Exception as e:
@@ -707,6 +730,12 @@ class HttpServer:
                 # sheds, staging overlap (docs/operations.md "Embed
                 # serving tuning" reads these)
                 stats["serving"] = engine.stats_snapshot()
+            gen_engine = self.db.genserve_engine()
+            if gen_engine is not None:
+                # paged-KV generation engine health: queue depth, page
+                # pool pressure, evictions, sheds by reason
+                # (docs/generation.md reads these)
+                stats["genserve"] = gen_engine.stats_snapshot()
             search = getattr(self.db, "search", None)
             if search is not None and hasattr(search, "stats_snapshot"):
                 # index/search counters + device-sync patching + query
@@ -1037,6 +1066,26 @@ class HttpServer:
                 return
             vec = self.db.embedder.embed(body.get("text", ""))
             h._send(200, {"embedding": _jsonable(vec), "dimensions": len(vec)})
+            return
+        if path == "/nornicdb/rag/answer":
+            # GraphRAG: graph-context retrieval -> packed prompt ->
+            # generation through the genserve engine (docs/generation.md).
+            # A shed generation surfaces as 429 via the ResourceExhausted
+            # handler in _dispatch, like every serving admission edge.
+            h._auth("read")
+            body = h._body()
+            question = str(body.get("question", body.get("query", "")))
+            if not question.strip():
+                h._send(400, {"error": "question required"})
+                return
+            svc = self.db.graphrag()
+            result = svc.answer(
+                question,
+                limit=body.get("limit"),
+                max_new_tokens=body.get("max_tokens"),
+                deadline_ms=body.get("deadline_ms"),
+            )
+            h._send(200, result)
             return
         if path == "/nornicdb/search/rebuild":
             h._auth("admin")

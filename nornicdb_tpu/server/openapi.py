@@ -238,6 +238,31 @@ def build_spec(version: str = "0.4.0") -> dict:
             tag="memory", shed=True)},
         "/nornicdb/search/rebuild": {"post": _op(
             "Rebuild the search indexes from storage", tag="memory")},
+        "/nornicdb/rag/answer": {"post": _op(
+            "GraphRAG answer: hybrid search + one-hop graph expansion "
+            "assemble a token-budgeted context prompt, generated through "
+            "the paged-KV continuous-batching engine (docs/generation.md)."
+            " Without generation weights the answer is extractive from "
+            "the retrieved context.",
+            tag="memory", shed=True,
+            req={"type": "object",
+                 "required": ["question"],
+                 "properties": {
+                     "question": {"type": "string"},
+                     "limit": {"type": "integer",
+                               "description": "context nodes to retrieve"},
+                     "max_tokens": {"type": "integer"},
+                     "deadline_ms": {"type": "number"}}},
+            resp={"type": "object",
+                  "properties": {
+                      "answer": {"type": "string"},
+                      "mode": {"type": "string",
+                               "enum": ["paged", "dense", "extractive"]},
+                      "sources": {"type": "array",
+                                  "items": {"type": "object"}},
+                      "context": {"type": "object"},
+                      "generated_tokens": {"type": "integer"},
+                      "timings_ms": {"type": "object"}}})},
         # -- admin -----------------------------------------------------------
         "/admin/stats": {"get": _op(
             "Server statistics: storage, cache, query counters, uptime, "
@@ -247,7 +272,9 @@ def build_spec(version: str = "0.4.0") -> dict:
             "serving tuning\"), and the `backend` section (device "
             "lifecycle state PROBING/READY/DEGRADED_CPU/RECOVERING, "
             "fallbacks_total, recoveries_total, probe latency, recent "
-            "transitions — docs/backend.md)",
+            "transitions — docs/backend.md), plus the `genserve` section "
+            "when the generation engine is live (queue depth, page-pool "
+            "pressure, evictions, sheds by reason — docs/generation.md)",
             tag="admin")},
         "/admin/backup": {"post": _op(
             "Write a full backup archive (gzip) server-side; returns the "

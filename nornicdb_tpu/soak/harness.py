@@ -437,6 +437,10 @@ class SoakHarness:
         from nornicdb_tpu.server.bolt import BoltServer
         from nornicdb_tpu.server.http import HttpServer
 
+        from nornicdb_tpu import genserve
+        from nornicdb_tpu.config import GenServeConfig
+        from nornicdb_tpu.heimdall import QwenGenerator
+
         serving_dir = os.path.join(self.workdir, "serving")
         cfg = Config(
             # sync chain + fsync'd WAL: an HTTP/Bolt ack must imply the
@@ -450,6 +454,20 @@ class SoakHarness:
         )
         db = nornicdb_tpu.DB(serving_dir, cfg)
         db.set_embedder(HashEmbedder(64))
+        if self.spec.workload.generate_workers > 0:
+            # generation plane: a QWEN_SMALL-backed genserve engine behind
+            # Heimdall (chat + GraphRAG ride the paged-KV batch).  Engine
+            # deadline sits under the client deadline so overload sheds
+            # 429 (rejected) instead of client timeouts; warmup compiles
+            # the prefill/decode programs before traffic starts.
+            genserve.configure(GenServeConfig(
+                page_size=16, pool_pages=33, max_seqs=4,
+                max_seq_tokens=128, prefill_chunk=32,
+                deadline_ms=min(3000.0,
+                                self.spec.workload.deadline_s * 600),
+                max_queue=32))
+            db.set_heimdall_generator(QwenGenerator(max_context=96))
+            db.genserve_engine().warmup()
         http = HttpServer(db, port=0, serve_ui=False)
         http.start()
         bolt = BoltServer(
@@ -646,6 +664,12 @@ class SoakHarness:
                 inv.check_metrics_wellformed(metrics_text))
             report.invariants.append(inv.check_traces_wellformed(traces))
             report.invariants.append(inv.check_backend_ready(metrics_text))
+            if spec.workload.generate_workers > 0:
+                # generation served, shed legally, and drained — plus the
+                # liveness half: protocol_liveness above already requires
+                # an OK generate request AFTER the last fault window
+                report.invariants.append(
+                    inv.check_genserve_live(metrics_text))
             report.invariants.append(inv.check_chaos_in_metrics(
                 metrics_text,
                 [dict(t.stats) for t in repl.chaos.values()]))
@@ -725,6 +749,9 @@ class SoakHarness:
             serving_dir, collector.acked("serving")))
         db.close()
         backend_plane.shutdown()
+        from nornicdb_tpu import genserve as _genserve
+
+        _genserve.configure(None)  # drop soak genserve kwargs
 
         report.wall_s = time.monotonic() - t_start
         if self.report_path:

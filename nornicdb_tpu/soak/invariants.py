@@ -170,6 +170,38 @@ def check_backend_ready(metrics_text: str) -> InvariantResult:
     return passed("backend_ready")
 
 
+def check_genserve_live(metrics_text: str) -> InvariantResult:
+    """The generation engine must have actually served under the soak
+    (tokens generated), shed only through the legal reasons its counter
+    enumerates, and ended with a drained queue — a nonzero terminal
+    queue depth means requests were stranded past traffic shutdown."""
+    try:
+        fams = parse_prometheus(metrics_text)
+    except ValueError as e:
+        return failed("genserve_live", f"metrics unparseable: {e}")
+    tokens = metric_total(fams, "nornicdb_genserve_generated_tokens_total")
+    if not tokens:
+        return failed("genserve_live",
+                      "no tokens generated under the generation workload")
+    depth = fams.get("nornicdb_genserve_queue_depth")
+    if depth and any(v != 0.0 for v in depth.values()):
+        return failed("genserve_live",
+                      f"terminal generation queue depth {depth} != 0 "
+                      "(stranded requests)")
+    legal = {'reason="queue_full"', 'reason="deadline"',
+             'reason="pool_exhausted"', 'reason="device"'}
+    sheds = fams.get("nornicdb_genserve_sheds_total", {})
+    rogue = {labels for labels, v in sheds.items()
+             if v > 0 and not (set(labels) <= legal)}
+    if rogue:
+        return failed("genserve_live", f"sheds outside the legal reasons: "
+                                       f"{sorted(rogue)}")
+    shed_total = sum(sheds.values())
+    return passed("genserve_live",
+                  f"{int(tokens)} tokens generated, {int(shed_total)} "
+                  "legal sheds, queue drained")
+
+
 def check_chaos_in_metrics(metrics_text: str,
                            instance_stats: list[dict[str, int]]
                            ) -> InvariantResult:

@@ -66,6 +66,9 @@ class WorkloadSpec:
     bolt_workers: int = 1
     grpc_workers: int = 1
     qdrant_workers: int = 1
+    # generation traffic class: Heimdall chat (QC-shaped) + GraphRAG
+    # answers through the genserve continuous-batching engine
+    generate_workers: int = 1
     replication_writers: int = 1
     # client-side bound on every request; exceeding deadline+grace wall
     # time is an invariant violation (a wedged call, not a slow one)

@@ -458,6 +458,55 @@ class WorkloadRunner:
             self._pace(rng)
         self.heartbeat.forget(name)
 
+    # -- generation (Heimdall chat + GraphRAG through genserve) ------------
+    def _generate_worker(self, idx: int) -> None:
+        """QC-shaped chat completions and GraphRAG answers: both ride the
+        paged-KV continuous-batching engine, so backend fault windows hit
+        the generation path too.  429s (engine admission/deadline sheds)
+        classify as ``rejected`` — the legal-shed invariant."""
+        name = f"generate-{idx}"
+        rng = random.Random(self.seed * 6000 + idx)
+        base = f"http://127.0.0.1:{self.ports['http']}"
+        deadline = self.spec.workload.deadline_s
+        n = 0
+        while not self.stop_event.is_set():
+            self.heartbeat.beat(name)
+            n += 1
+            t0 = time.monotonic()
+            try:
+                if rng.random() < 0.5:  # Heimdall chat (QC review shape)
+                    status, payload = _http_json(
+                        base, "/api/bifrost/chat/completions",
+                        {"messages": [{
+                            "role": "user",
+                            "content": ("Should these two memories be "
+                                        f"linked as NEXT? item {n} "
+                                        "Reply JSON."),
+                        }], "max_tokens": 8},
+                        deadline)
+                    outcome, detail = _classify_http(status, payload)
+                    if outcome == "ok" and "choices" not in payload:
+                        outcome, detail = "error", "chat: no choices"
+                    self._record("generate", "chat", outcome, t0, detail)
+                else:  # GraphRAG answer
+                    status, payload = _http_json(
+                        base, "/nornicdb/rag/answer",
+                        {"question": (f"what do we know about soak item "
+                                      f"{rng.randint(0, 50)}?"),
+                         "max_tokens": 8},
+                        deadline)
+                    outcome, detail = _classify_http(status, payload)
+                    if outcome == "ok" and "answer" not in payload:
+                        outcome, detail = "error", "rag: no answer"
+                    self._record("generate", "rag", outcome, t0, detail)
+            except (socket.timeout, TimeoutError):
+                self._record("generate", "request", "timeout", t0, "timeout")
+            except (urllib.error.URLError, ConnectionError, OSError) as e:
+                self._record("generate", "request", "unavailable", t0,
+                             type(e).__name__)
+            self._pace(rng)
+        self.heartbeat.forget(name)
+
     def _pace(self, rng: random.Random) -> None:
         think = self.spec.workload.think_s
         if think > 0:
@@ -473,6 +522,8 @@ class WorkloadRunner:
             ("grpc", w.grpc_workers if self.ports.get("grpc") else 0,
              self._grpc_worker),
             ("qdrant", w.qdrant_workers, self._qdrant_worker),
+            ("generate", getattr(w, "generate_workers", 0),
+             self._generate_worker),
         ]
         for proto, count, fn in plan:
             if count > 0:

@@ -1,0 +1,316 @@
+"""Generation bench: sequential synchronous generate() vs the paged-KV
+continuous-batching engine, at mixed prompt/output lengths (ISSUE 11
+satellite — the generation bench trajectory was empty).
+
+Two paths over the same weights and the same request set:
+
+* **sequential** — the pre-genserve Heimdall path: one request at a
+  time, dense per-request KV cache (``qwen2.prefill`` +
+  ``qwen2.decode_step`` per token, cache length bucketed pow2), next
+  request starts when the previous finishes.
+* **continuous** — ``genserve.GenerationEngine``: every request
+  submitted up front, the scheduler interleaves prefill chunks with ONE
+  batched decode step per iteration over the shared page pool.
+
+All requests are treated as arriving at t=0 (a burst), so sequential
+time-to-first-token includes queueing behind earlier requests — exactly
+the serving condition continuous batching exists to fix.  Prompt lengths
+are drawn from a small discrete set so the dense path's per-length
+prefill programs stay bounded and the warm pass covers the steady state
+for BOTH paths.
+
+Writes BENCH_generate.json (committed artifact) and asserts the bounded
+compiled-program-count invariant at exit: the engine's timed pass runs
+entirely on programs compiled during the warm pass, and the program
+ledger holds one entry per (kind, static-shape) class, not one per
+request.
+
+Usage: python scripts/bench_generate.py [--quick] [--requests N] [--seed S]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import threading
+import time
+
+import numpy as np
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+# (prompt_len, max_new, weight): Heimdall QC reviews are short prompt /
+# short answer; chat turns are medium; GraphRAG packs long context and
+# decodes a sentence or two
+MIX = (
+    ("qc", 12, 16, 0.4),
+    ("chat", 24, 32, 0.35),
+    ("rag", 80, 48, 0.25),
+)
+
+
+def build_requests(n: int, seed: int, vocab: int) -> list[tuple[list[int], int]]:
+    rng = np.random.default_rng(seed)
+    weights = np.array([m[3] for m in MIX])
+    kinds = rng.choice(len(MIX), size=n, p=weights / weights.sum())
+    out = []
+    for i in range(n):
+        _, plen, max_new, _ = MIX[kinds[i]]
+        prompt = [int(x) for x in rng.integers(4, vocab, plen)]
+        out.append((prompt, max_new))
+    return out
+
+
+def pctl(samples: list[float], p: float) -> float:
+    return float(np.percentile(np.asarray(samples), p)) if samples else 0.0
+
+
+def bench_sequential(params, cfg, requests, eos_id: int) -> dict:
+    """One request at a time through the dense prefill + per-token
+    decode_step loop (the QwenGenerator.generate_stream shape)."""
+    import jax.numpy as jnp
+
+    from nornicdb_tpu.models import qwen2
+
+    def run_one(prompt, max_new):
+        max_len = qwen2.round_up_pow2(len(prompt) + max_new)
+        logits, caches = qwen2.prefill(
+            params, cfg, jnp.asarray([prompt], jnp.int32), max_len)
+        tok = int(np.asarray(logits)[0].argmax())
+        out = [tok]
+        gaps = []
+        pos = len(prompt)
+        while len(out) < max_new and tok != eos_id:
+            s = time.perf_counter()
+            lg, caches = qwen2.decode_step(
+                params, cfg, jnp.asarray([tok], jnp.int32), caches,
+                jnp.asarray(pos))
+            tok = int(np.asarray(lg)[0].argmax())
+            gaps.append((time.perf_counter() - s) * 1e3)
+            out.append(tok)
+            pos += 1
+        return out, gaps
+
+    for prompt, max_new in requests:  # warm pass: compile every class
+        run_one(prompt, max_new)
+    t0 = time.perf_counter()
+    ttft, per_token, total_tokens = [], [], 0
+    outputs = []
+    for prompt, max_new in requests:
+        r0 = time.perf_counter()
+        out, gaps = run_one(prompt, max_new)
+        outputs.append(out)
+        # burst arrival: TTFT counts from t0-of-burst for queued requests
+        ttft.append((time.perf_counter() - t0) * 1e3 - sum(gaps))
+        per_token.extend(gaps)
+        total_tokens += len(out)
+        _ = r0
+    elapsed = time.perf_counter() - t0
+    return {
+        "tok_s": round(total_tokens / elapsed, 1),
+        "elapsed_s": round(elapsed, 3),
+        "total_tokens": total_tokens,
+        "ttft_p50_ms": round(pctl(ttft, 50), 2),
+        "ttft_p99_ms": round(pctl(ttft, 99), 2),
+        "per_token_p50_ms": round(pctl(per_token, 50), 3),
+        "per_token_p99_ms": round(pctl(per_token, 99), 3),
+    }, outputs
+
+
+def bench_continuous(engine, requests) -> dict:
+    """Three burst passes: warm (compile every shape class), a streaming
+    latency pass (per-request reader threads timestamp first-token and
+    inter-token arrivals — the SSE serving shape), and a result()-only
+    throughput pass (the QC/GraphRAG batch shape: completion-event
+    waiters, no per-token stream wakeups)."""
+    # warm pass
+    for h in [engine.submit(p, max_new_tokens=m) for p, m in requests]:
+        h.result()
+    programs_after_warm = len(engine.programs)
+
+    # latency pass (streaming)
+    t0 = time.perf_counter()
+    ttft, per_token = [], []
+    lock = threading.Lock()
+
+    def reader(handle):
+        last = t0
+        gaps = []
+        first = None
+        for _ in handle.stream_tokens():
+            now = time.perf_counter()
+            if first is None:
+                first = (now - t0) * 1e3
+            else:
+                gaps.append((now - last) * 1e3)
+            last = now
+        with lock:
+            ttft.append(first if first is not None else 0.0)
+            per_token.extend(gaps)
+
+    threads = []
+    for prompt, max_new in requests:
+        h = engine.submit(prompt, max_new_tokens=max_new)
+        t = threading.Thread(target=reader, args=(h,), daemon=True)
+        t.start()
+        threads.append(t)
+    for t in threads:
+        t.join()
+    stream_elapsed = time.perf_counter() - t0
+
+    # throughput pass (result-only burst)
+    steps_before = engine.stats.decode_steps
+    chunks_before = engine.stats.prefill_chunks
+    t0 = time.perf_counter()
+    handles = [engine.submit(p, max_new_tokens=m) for p, m in requests]
+    outputs = [h.result() for h in handles]
+    elapsed = time.perf_counter() - t0
+    total = sum(len(o) for o in outputs)
+    steps_timed = engine.stats.decode_steps - steps_before
+    chunks_timed = engine.stats.prefill_chunks - chunks_before
+    return {
+        "tok_s": round(total / elapsed, 1),
+        "elapsed_s": round(elapsed, 3),
+        "stream_elapsed_s": round(stream_elapsed, 3),
+        "total_tokens": total,
+        "ttft_p50_ms": round(pctl(ttft, 50), 2),
+        "ttft_p99_ms": round(pctl(ttft, 99), 2),
+        "per_token_p50_ms": round(pctl(per_token, 50), 3),
+        "per_token_p99_ms": round(pctl(per_token, 99), 3),
+        "decode_steps_timed": steps_timed,
+        "avg_batch_lanes": round(total / max(1, steps_timed +
+                                             chunks_timed), 2),
+        "programs_after_warm": programs_after_warm,
+        "programs_after_timed": len(engine.programs),
+        "evictions": engine.stats.evictions,
+    }, outputs
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--quick", action="store_true",
+                    help="small request set, no artifact commit expectations")
+    ap.add_argument("--requests", type=int, default=0)
+    ap.add_argument("--seed", type=int, default=11)
+    ap.add_argument("--concurrency", type=int, default=8)
+    ap.add_argument("--out", default=os.path.join(REPO,
+                                                  "BENCH_generate.json"))
+    args = ap.parse_args()
+    n = args.requests or (16 if args.quick else 64)
+
+    import jax
+
+    from nornicdb_tpu.backend import BackendManager, FakeHooks
+    from nornicdb_tpu.config import GenServeConfig
+    from nornicdb_tpu.genserve import GenerationEngine
+    from nornicdb_tpu.models import qwen2
+    from nornicdb_tpu.models.tokenizer import HashTokenizer
+
+    # serving-shaped f32 model: wide enough that per-token dense compute
+    # is realistic, small enough for CPU CI (same discipline as
+    # bench_embed's encoder)
+    cfg = qwen2.QwenConfig(
+        vocab_size=2048, hidden=128, layers=2, heads=4, kv_heads=2,
+        intermediate=256, max_positions=1024, rope_theta=10000.0,
+        dtype="float32",
+    )
+    params = qwen2.init_params(cfg, jax.random.PRNGKey(args.seed))
+    tok = HashTokenizer(cfg.vocab_size)
+    requests = build_requests(n, args.seed, cfg.vocab_size)
+    print(f"bench_generate: {n} requests, model {cfg.layers}L/{cfg.hidden}h "
+          f"f32, concurrency {args.concurrency}", file=sys.stderr)
+
+    seq_result, seq_outputs = bench_sequential(params, cfg, requests,
+                                               tok.eos_id)
+    print(f"sequential:  {seq_result['tok_s']} tok/s "
+          f"(ttft p99 {seq_result['ttft_p99_ms']}ms)", file=sys.stderr)
+
+    gcfg = GenServeConfig(
+        page_size=16, pool_pages=args.concurrency * 8 + 1,
+        max_seqs=args.concurrency, max_seq_tokens=128, prefill_chunk=64,
+        max_queue=4 * n, deadline_ms=0.0,
+    )
+    engine = GenerationEngine(
+        params, cfg, tokenizer=tok, config=gcfg,
+        manager=BackendManager(hooks=FakeHooks("ok"), acquire_timeout=5))
+    try:
+        cont_result, cont_outputs = bench_continuous(engine, requests)
+    finally:
+        engine.stop()
+    print(f"continuous:  {cont_result['tok_s']} tok/s "
+          f"(ttft p99 {cont_result['ttft_p99_ms']}ms, avg lanes "
+          f"{cont_result['avg_batch_lanes']})", file=sys.stderr)
+
+    # equivalence sanity at matched cache width (the tolerance-bounded
+    # contract is tests/test_genserve.py's job): sequential buckets its
+    # dense cache per request, so compare the engine against a dense run
+    # at the ENGINE's width for a sample
+    import jax.numpy as jnp
+
+    for i in range(0, n, max(1, n // 6)):
+        prompt, max_new = requests[i]
+        logits, caches = qwen2.prefill(
+            params, cfg, jnp.asarray([prompt], jnp.int32), 128)
+        t = int(np.asarray(logits)[0].argmax())
+        ref = [t]
+        pos = len(prompt)
+        while len(ref) < max_new and t != tok.eos_id:
+            lg, caches = qwen2.decode_step(
+                params, cfg, jnp.asarray([t], jnp.int32), caches,
+                jnp.asarray(pos))
+            t = int(np.asarray(lg)[0].argmax())
+            ref.append(t)
+            pos += 1
+        assert cont_outputs[i] == ref, (
+            f"engine output diverged from dense-at-width for request {i}")
+
+    # bounded compiled-program-count invariant: the timed pass compiled
+    # NOTHING (steady state reached in warm), and the ledger is one
+    # program per shape class
+    assert cont_result["programs_after_timed"] == \
+        cont_result["programs_after_warm"], (
+        "timed pass compiled fresh programs: "
+        f"{cont_result['programs_after_warm']} -> "
+        f"{cont_result['programs_after_timed']}")
+    assert cont_result["programs_after_timed"] <= 16, (
+        f"program ledger grew past the shape-class bound: "
+        f"{sorted(engine.programs)}")
+
+    speedup = cont_result["tok_s"] / max(seq_result["tok_s"], 1e-9)
+    out = {
+        "bench": "generate_continuous_vs_sequential",
+        "requests": n,
+        "concurrency": args.concurrency,
+        "seed": args.seed,
+        "mix": [{"kind": k, "prompt_len": p, "max_new": m, "weight": w}
+                for k, p, m, w in MIX],
+        "model": {"layers": cfg.layers, "hidden": cfg.hidden,
+                  "heads": cfg.heads, "kv_heads": cfg.kv_heads,
+                  "vocab": cfg.vocab_size, "dtype": cfg.dtype},
+        "genserve": {"page_size": gcfg.page_size,
+                     "pool_pages": gcfg.pool_pages,
+                     "max_seqs": gcfg.max_seqs,
+                     "prefill_chunk": gcfg.prefill_chunk},
+        "sequential": seq_result,
+        "continuous": cont_result,
+        "speedup_tok_s": round(speedup, 2),
+        "invariant_bounded_program_count": True,
+        "program_count": cont_result["programs_after_timed"],
+    }
+    if not args.quick:
+        assert speedup >= 2.0, (
+            f"continuous speedup {speedup:.2f}x < 2x acceptance floor "
+            f"at concurrency {args.concurrency}")
+        with open(args.out, "w") as f:
+            json.dump(out, f, indent=2)
+            f.write("\n")
+        print(f"wrote {args.out}", file=sys.stderr)
+    print(json.dumps(out))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -98,14 +98,21 @@ class TestAssistantTraining:
         """Full stack: NORNICDB_ASSISTANT_MODEL → db.heimdall →
         /v1/chat/completions → trained-model tokens through the
         prefill + KV-cache decode path (not the template generator)."""
-        from nornicdb_tpu.heimdall.manager import QwenGenerator
+        from nornicdb_tpu.heimdall.manager import (
+            EngineGenerator,
+            QwenGenerator,
+        )
         from nornicdb_tpu.server import HttpServer
 
         out, _ = assistant_ckpt
         os.environ["NORNICDB_ASSISTANT_MODEL"] = out
         try:
             db = nornicdb_tpu.open_db("")
-            assert isinstance(db.heimdall.generator, QwenGenerator)
+            # weights-backed path: either the synchronous QwenGenerator
+            # (genserve disabled) or the genserve continuous-batching
+            # EngineGenerator fronting the same weights — never template
+            assert isinstance(db.heimdall.generator,
+                              (QwenGenerator, EngineGenerator))
             server = HttpServer(db, port=0)
             server.start()
             try:
