@@ -1,6 +1,6 @@
 # NornicDB-TPU (ref: the reference's Makefile test/build targets)
 
-.PHONY: test test-fast lint lint-baseline sanitize smoke chaos soak soak-ci soak-nornsan bench bench-search bench-embed bench-generate native e2e-bench clean
+.PHONY: test test-fast lint lint-baseline sanitize smoke chaos soak soak-ci soak-nornsan soak-multiworker bench bench-search bench-embed bench-generate bench-workers native e2e-bench clean
 
 test:
 	python -m pytest tests/ -q
@@ -13,12 +13,12 @@ lint-baseline:
 
 # runtime lock sanitizer over the threaded suites (docs/linting.md#nornsan)
 sanitize:
-	NORNSAN=1 python -m pytest tests/test_concurrency.py tests/test_replication.py tests/test_replication_scenarios.py tests/test_nornsan.py tests/test_adjacency.py tests/test_telemetry.py tests/test_backend.py tests/test_sharded_serving.py tests/test_serving.py tests/test_genserve.py -q -m 'not slow'
+	NORNSAN=1 python -m pytest tests/test_concurrency.py tests/test_replication.py tests/test_replication_scenarios.py tests/test_nornsan.py tests/test_adjacency.py tests/test_telemetry.py tests/test_backend.py tests/test_sharded_serving.py tests/test_serving.py tests/test_genserve.py tests/test_broker.py tests/test_shm_readplane.py tests/test_workers.py -q -m 'not slow'
 
 # search/embed suite with the accelerator backend forced to hang: the
 # lifecycle manager must keep the stack serving from CPU (docs/backend.md)
 chaos:
-	NORNICDB_FAKE_BACKEND=hang NORNICDB_DEVICE_ACQUIRE_TIMEOUT=2 python -m pytest tests/test_embed_search.py tests/test_search_unit_depth.py tests/test_sharded_serving.py tests/test_serving.py tests/test_genserve.py -q -m 'not slow'
+	NORNICDB_FAKE_BACKEND=hang NORNICDB_DEVICE_ACQUIRE_TIMEOUT=2 python -m pytest tests/test_embed_search.py tests/test_search_unit_depth.py tests/test_sharded_serving.py tests/test_serving.py tests/test_genserve.py tests/test_broker.py tests/test_shm_readplane.py tests/test_workers.py -q -m 'not slow'
 
 # live-server /metrics + /admin/traces smoke (docs/observability.md)
 smoke:
@@ -34,9 +34,16 @@ soak:
 soak-ci:
 	python -m nornicdb_tpu.soak --scenario ci --report SOAK_report_ci.json
 
-# CI soak under the runtime lock sanitizer (docs/linting.md#nornsan)
+# CI soak under the runtime lock sanitizer (docs/linting.md#nornsan);
+# skips the multiworker phase (covered by the plain soak-ci run)
 soak-nornsan:
-	NORNSAN=1 python -m nornicdb_tpu.soak --scenario ci --report SOAK_report_ci.json
+	NORNSAN=1 python -m nornicdb_tpu.soak --scenario ci --no-multiworker --report SOAK_report_ci.json
+
+# multi-process serving soak: prefork worker pool under mixed traffic
+# with worker kills + backend hang (respawn / broker-reconnect /
+# shared-memory fallback invariants; docs/operations.md)
+soak-multiworker:
+	python -m nornicdb_tpu.soak --scenario multiworker --report SOAK_report_multiworker.json
 
 test-fast:
 	python -m pytest tests/ -q -x
@@ -64,6 +71,12 @@ bench-embed:
 # compiled-program-count invariant at exit)
 bench-generate:
 	python scripts/bench_generate.py
+
+# 1/2/4/8-worker prefork scaling sweep under mixed search+embed+Cypher
+# load (writes BENCH_multiproc.json; asserts the one-program-per-fused-
+# batch invariant and the 4-worker >= 2x scaling floor at exit)
+bench-workers:
+	python scripts/bench_workers.py
 
 e2e-bench:
 	python benchmarks/endpoints_bench.py

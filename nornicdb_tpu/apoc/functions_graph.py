@@ -26,7 +26,10 @@ from nornicdb_tpu.errors import NornicError, NotFoundError
 from nornicdb_tpu.storage.types import Edge, Node
 
 _atomic_lock = threading.RLock()
+# parsed-predicate memo: concurrent Cypher sessions evaluate apoc
+# predicates on their own threads, so reads/writes go under a lock
 _expr_memo: dict[str, Any] = {}
+_expr_memo_lock = threading.Lock()
 
 
 def _graph_fn(name):
@@ -66,11 +69,13 @@ def _eval_pred(ex, expr_text: str, bindings: dict) -> Any:
     from nornicdb_tpu.cypher.expr import EvalContext, evaluate
     from nornicdb_tpu.cypher.parser import parse
 
-    e = _expr_memo.get(expr_text)
+    with _expr_memo_lock:
+        e = _expr_memo.get(expr_text)
     if e is None:
         q = parse(f"RETURN {expr_text}")
         e = q.clauses[0].items[0].expr
-        _expr_memo[expr_text] = e
+        with _expr_memo_lock:
+            _expr_memo[expr_text] = e
     return evaluate(e, EvalContext(bindings, {}, ex))
 
 

@@ -202,12 +202,55 @@ def cmd_serve(args) -> int:
         except ImportError:
             print("NORNICDB_GRPC_ENABLED set but grpcio is not installed; "
                   "native gRPC disabled", file=sys.stderr)
+    # prefork protocol workers: N subprocesses on a shared SO_REUSEPORT
+    # public port, serving vector search through the device broker with a
+    # shared-memory fallback (docs/operations.md "Multi-process serving")
+    workers_cfg = app_cfg.workers
+    n_http_workers = (args.workers if args.workers is not None
+                      else workers_cfg.http)
+    http_pool = grpc_pool = None
+    rate = ((workers_cfg.rate_limit, workers_cfg.rate_burst)
+            if workers_cfg.rate_limit > 0 else None)
+    if n_http_workers > 0:
+        from nornicdb_tpu.server.workers import WorkerPool
+
+        http_pool = WorkerPool(
+            db, http_server.port, n_workers=n_http_workers,
+            host="127.0.0.1" if args.host == "0.0.0.0" else args.host,
+            kind="http", public_port=workers_cfg.port,
+            rate_limit=rate, broker=workers_cfg.broker,
+            read_plane=workers_cfg.read_plane,
+            respawn=workers_cfg.respawn,
+            publish_interval=workers_cfg.publish_interval,
+            auth_required=args.auth,
+        ).start()
+    if workers_cfg.grpc > 0 and grpc_server is not None:
+        from nornicdb_tpu.server.workers import WorkerPool
+
+        grpc_pool = WorkerPool(
+            db, grpc_server.port, n_workers=workers_cfg.grpc,
+            host="127.0.0.1" if args.host == "0.0.0.0" else args.host,
+            kind="grpc", public_port=workers_cfg.grpc_port,
+            rate_limit=rate,
+            # share the HTTP pool's broker: one device owner per host
+            broker=(http_pool.broker if http_pool is not None
+                    and http_pool.broker is not None
+                    else workers_cfg.broker),
+            read_plane=workers_cfg.read_plane,
+            respawn=workers_cfg.respawn,
+            publish_interval=workers_cfg.publish_interval,
+            auth_required=args.auth,
+        ).start()
     print(f"NornicDB-TPU serving: bolt://{args.host}:{bolt_server.port} "
           f"http://{args.host}:{http_server.port}"
           + (f" qdrant-grpc://{args.host}:{qdrant_server.port}"
              if qdrant_server else "")
           + (f" grpc://{args.host}:{grpc_server.port}"
              if grpc_server else "")
+          + (f" http-workers://{http_pool.host}:{http_pool.port}"
+             f" x{http_pool.n_workers}" if http_pool else "")
+          + (f" grpc-workers://{grpc_pool.host}:{grpc_pool.port}"
+             f" x{grpc_pool.n_workers}" if grpc_pool else "")
           + f" (data: {args.data_dir or 'memory'})")
 
     stop = []
@@ -218,6 +261,10 @@ def cmd_serve(args) -> int:
             time.sleep(0.2)
     finally:
         print("shutting down...")
+        if grpc_pool is not None:
+            grpc_pool.stop()
+        if http_pool is not None:
+            http_pool.stop()
         if grpc_server is not None:
             grpc_server.stop()
         if qdrant_server is not None:
@@ -507,6 +554,9 @@ def main(argv=None) -> int:
     s.add_argument("--model-preset", default="bge_small")
     s.add_argument("--log-queries", action="store_true",
                    help="log every Cypher statement with wall time")
+    s.add_argument("--workers", type=int, default=None,
+                   help="prefork HTTP protocol workers (overrides the "
+                        "workers.http config; 0 disables)")
     s.set_defaults(fn=cmd_serve)
 
     s = sub.add_parser("init", help="initialize a data directory")

@@ -194,6 +194,45 @@ class GenServeConfig:
 
 
 @dataclass
+class WorkersConfig:
+    """Prefork protocol workers (server/workers.py): multi-core scale-out
+    for the protocol surface, applied by ``cli serve``.  Workers are
+    subprocesses binding a shared public port with SO_REUSEPORT; vector
+    search is served through the primary's device broker (fused
+    cross-worker device dispatch) with a shared-memory host-search
+    fallback.  Env form: ``NORNICDB_WORKERS_<FIELD>``.  See
+    docs/operations.md "Multi-process serving"."""
+
+    # worker processes fronting the HTTP surface (0 disables the pool)
+    http: int = 0
+    # worker processes fronting the native gRPC search surface (needs
+    # NORNICDB_GRPC_ENABLED; they share the HTTP pool's device broker)
+    grpc: int = 0
+    # public port the HTTP worker pool binds (0 = ephemeral, printed at
+    # startup); gRPC workers use grpc_port the same way
+    port: int = 0
+    grpc_port: int = 0
+    # device broker (one PJRT owner, fused cross-worker search/embed
+    # batches over a Unix socket) — disabling it degrades workers to
+    # cache + proxy only
+    broker: bool = True
+    # shared-memory read plane (corpus + CSR adjacency segments): the
+    # workers' host-search fallback when the broker is down or the
+    # backend is DEGRADED_CPU
+    read_plane: bool = True
+    # respawn crashed workers automatically
+    respawn: bool = True
+    # shared-segment republish cadence in seconds: worker reads are at
+    # most this stale; each publish copies the corpus host arrays, so
+    # raise it for very large corpora under constant writes
+    publish_interval: float = 0.05
+    # per-worker token bucket mirrored BEFORE the response cache
+    # (effective ceiling is n_workers x rate); 0 disables
+    rate_limit: float = 0.0
+    rate_burst: float = 0.0
+
+
+@dataclass
 class SearchTuningConfig:
     """Vector-serving knobs (nornicdb_tpu.search.SearchConfig): applied by
     ``cli serve`` via ``search.service.configure_defaults`` before the
@@ -234,6 +273,7 @@ class AppConfig:
     search: SearchTuningConfig = field(default_factory=SearchTuningConfig)
     serving: ServingConfig = field(default_factory=ServingConfig)
     genserve: GenServeConfig = field(default_factory=GenServeConfig)
+    workers: WorkersConfig = field(default_factory=WorkersConfig)
 
 
 def find_config_file(start_dir: str = ".") -> Optional[str]:
@@ -316,6 +356,11 @@ ENV_ALIASES: dict[str, tuple[str, str]] = {
     "NORNICDB_EMBED_MAX_QUEUE": ("serving", "max_queue"),
     "NORNICDB_STUDENT_MODEL": ("serving", "student_model_dir"),
     "NORNICDB_STUDENT_MIN_MRR": ("serving", "student_min_mrr"),
+    # prefork worker pool (the generic NORNICDB_WORKERS_<FIELD> forms
+    # work too; these aliases match the reference's worker knob style)
+    "NORNICDB_HTTP_WORKERS": ("workers", "http"),
+    "NORNICDB_GRPC_WORKERS": ("workers", "grpc"),
+    "NORNICDB_WORKER_PORT": ("workers", "port"),
     "NORNICDB_TRACING": ("telemetry", "tracing_enabled"),
     "NORNICDB_TRACE_SAMPLE": ("telemetry", "trace_sample"),
     "NORNICDB_TRACE_BUFFER": ("telemetry", "trace_buffer"),

@@ -95,11 +95,15 @@ class Result:
 
 ProcedureFn = Callable[["CypherExecutor", list[Any], dict[str, Any]], tuple[list[str], list[list[Any]]]]
 PROCEDURES: dict[str, ProcedureFn] = {}
+# registration is import-time in practice, but apoc extension modules may
+# load lazily from executing sessions — serialize writers
+_PROCEDURES_LOCK = threading.Lock()
 
 
 def procedure(name: str):
     def deco(fn):
-        PROCEDURES[name.lower()] = fn
+        with _PROCEDURES_LOCK:
+            PROCEDURES[name.lower()] = fn
         return fn
 
     return deco
@@ -756,11 +760,13 @@ class CypherExecutor:
 
                 def whole(path, pos=pos):
                     # path nodes may be live stored objects (node_entry);
-                    # a whole-node projection must hand out a copy
+                    # a whole-node projection must hand out a copy. A node
+                    # deleted since matching falls back to the path's own
+                    # snapshot; anything else is a real storage failure
                     n = path[pos]
                     try:
                         return self.storage.get_node(n.id)
-                    except Exception:
+                    except NotFoundError:
                         return n.copy()
 
                 return whole
@@ -1470,7 +1476,8 @@ class CypherExecutor:
                 try:
                     self.storage.create_edge(e)
                 except Exception:
-                    pass
+                    _log.debug("undo: cascaded-edge restore failed",
+                               exc_info=True)
 
         self._record_undo(undo_node)
 
@@ -2103,7 +2110,11 @@ class CypherExecutor:
                 try:
                     undo()
                 except Exception:
-                    pass  # best effort: keep unwinding
+                    # best effort: keep unwinding, but a failed undo step
+                    # means a partially rolled-back tx — operators must
+                    # be able to see it
+                    _log.warning("tx rollback: undo step failed",
+                                 exc_info=True)
 
     # -- DDL / admin ------------------------------------------------------------------
     def _create_index(self, stmt: ast.CreateIndex) -> Result:
@@ -2336,6 +2347,10 @@ def _classify_query(query: str) -> str:
     try:
         stmt = parse(query)
     except Exception:
+        # deliberate conservative class: unparseable input is treated as a
+        # write (the executor will reject it anyway); log at debug so the
+        # classification is traceable without flooding on bad clients
+        _log.debug("unparseable query classified as write", exc_info=True)
         return "write"
     if isinstance(stmt, ast.Query):
         return "write" if _is_write_query(stmt) else "read"

@@ -81,6 +81,39 @@ def host_topk(
     return out_v, out_i
 
 
+def format_topk_results(
+    vals: np.ndarray,
+    idx: np.ndarray,
+    n_queries: int,
+    k: int,
+    min_similarity: float,
+    ids: list,
+) -> list[list[tuple[str, float]]]:
+    """Resolve top-k slot indices to (id, score) rows — the one shared
+    epilogue for the device path, the DEGRADED_CPU host path, and the
+    cross-process shared-memory read plane (server/readplane.py), so every
+    serving surface resolves results identically by construction.
+
+    ``ids`` must be the slot map captured with the buffer the indices came
+    from — resolving against a live map would misattribute results if a
+    background compaction remapped the slot space mid-search."""
+    out: list[list[tuple[str, float]]] = []
+    for qi in range(n_queries):
+        row: list[tuple[str, float]] = []
+        for v, i in zip(vals[qi], idx[qi]):
+            # i < 0 is the merge_topk/IVF sentinel for "no candidate"
+            # (padding rows of a near-empty shard / short cluster);
+            # a negative index must never reach ids[i] — Python's
+            # negative indexing would attribute the LAST id to it
+            if i < 0 or not np.isfinite(v) or v < min_similarity:
+                continue
+            id_ = ids[i] if i < len(ids) else None
+            if id_ is not None:
+                row.append((id_, float(v)))
+        out.append(row[:k])
+    return out
+
+
 def host_score_rows(
     query: np.ndarray, corpus: np.ndarray, rows: np.ndarray
 ) -> np.ndarray:

@@ -33,7 +33,11 @@ import numpy as np
 
 from nornicdb_tpu import backend as _backend
 from nornicdb_tpu.errors import DeviceUnavailable
-from nornicdb_tpu.ops.host_search import host_score_rows, host_topk
+from nornicdb_tpu.ops.host_search import (
+    format_topk_results,
+    host_score_rows,
+    host_topk,
+)
 
 logger = logging.getLogger(__name__)
 
@@ -315,6 +319,10 @@ class SyncStats:
     rows_patched: int = 0
     uploader_runs: int = 0    # write-behind background sync cycles
     query_stall_s: float = 0.0  # time the query path spent blocked in sync
+    # device search programs launched (one per fused batch when queries go
+    # through the QueryBatcher) — the counter the multi-process bench's
+    # one-program-per-fused-batch invariant is asserted against
+    device_dispatches: int = 0
 
     def as_dict(self) -> dict:
         return asdict(self)
@@ -621,6 +629,24 @@ class HostCorpus:
 
     def memory_usage(self) -> int:
         return int(self._host.nbytes + self._valid.nbytes)
+
+    def export_host_state(self) -> dict:
+        """Consistent copies of the host arrays + slot map for the
+        cross-process shared-memory read plane (server/readplane.py):
+        ``{"rows", "valid", "ids", "epoch", "count", "dims"}``.  The copy
+        runs under _sync_lock so a racing in-place row overwrite can never
+        tear an exported vector; the slot layout is exported AS IS (no
+        forced compaction) so exported indices mean the same thing they
+        mean to the in-process host/device search paths."""
+        with self._sync_lock:
+            return {
+                "rows": self._host.copy(),
+                "valid": self._valid.copy(),
+                "ids": list(self._ids),
+                "epoch": self._epoch,
+                "count": len(self._slot_of),
+                "dims": self.dims,
+            }
 
     def save(self, path: str) -> None:
         """Persist live ids + vectors (tombstones are not serialized —
@@ -950,23 +976,13 @@ class HostCorpus:
         """Resolve slot indices to ids. `ids` must be the slot map captured
         with the buffer the indices came from (_borrow_device) — resolving
         against live self._ids would misattribute results if a background
-        compaction remapped the slot space mid-search."""
+        compaction remapped the slot space mid-search. Delegates to the
+        shared epilogue (ops.host_search.format_topk_results) so the
+        cross-process read plane resolves identically by construction."""
         ids = self._ids if ids is None else ids
-        out: list[list[tuple[str, float]]] = []
-        for qi in range(n_queries):
-            row: list[tuple[str, float]] = []
-            for v, i in zip(vals[qi], idx[qi]):
-                # i < 0 is the merge_topk/IVF sentinel for "no candidate"
-                # (padding rows of a near-empty shard / short cluster);
-                # a negative index must never reach ids[i] — Python's
-                # negative indexing would attribute the LAST id to it
-                if i < 0 or not np.isfinite(v) or v < min_similarity:
-                    continue
-                id_ = ids[i] if i < len(ids) else None
-                if id_ is not None:
-                    row.append((id_, float(v)))
-            out.append(row[:k])
-        return out
+        return format_topk_results(
+            vals, idx, n_queries, k, min_similarity, ids
+        )
 
 
 class DeviceCorpus(HostCorpus):
@@ -1399,6 +1415,7 @@ class DeviceCorpus(HostCorpus):
                     q, k, min_similarity, n_probe, exact
                 )
                 if pruned is not None:
+                    self.sync_stats.device_dispatches += 1
                     return pruned
             with self._borrow_device() as (corpus, valid, dev_i8, ids, _):
                 kk = min(k, self.capacity)
@@ -1411,6 +1428,7 @@ class DeviceCorpus(HostCorpus):
                 # finish before the patcher may donate the buffer it reads
                 vals_np = np.asarray(vals, np.float32)
                 idx_np = np.asarray(idx)
+            self.sync_stats.device_dispatches += 1
         except DeviceUnavailable:
             # degraded between the gate and the borrow
             return self._search_host(q, k, min_similarity)

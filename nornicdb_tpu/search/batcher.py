@@ -97,7 +97,17 @@ class QueryBatcher:
 
     search_batch_fn(queries (N, D), k, min_similarity) -> list of per-query
     [(id, score)] — the DeviceCorpus/ShardedCorpus.search signature.
-    """
+
+    Dispatch is CONTINUOUS batching (one long-lived dispatcher thread, one
+    in-flight device program at a time): each batch drains everything that
+    queued while the previous program ran, up to max_batch. Under low
+    concurrency a query waits at most `window` for companions; under load
+    the fused batch size adapts to (dispatch time x arrival rate) instead
+    of being capped at (window x arrival rate) — the original
+    flusher-per-window design stalled at ~2 queries per program under
+    saturation while overlapping flushers piled small programs onto the
+    device, which is why the multiproc bench could not scale past the
+    per-program overhead."""
 
     def __init__(
         self,
@@ -119,12 +129,19 @@ class QueryBatcher:
         self.deadline = deadline
         self.stats = BatcherStats()
         self._lock = threading.Lock()
+        self._cond = threading.Condition(self._lock)
         self._pending: list[_Pending] = []
-        self._flusher: Optional[threading.Thread] = None
+        self._dispatcher: Optional[threading.Thread] = None
+        self._closed = False
 
-    def search(
+    def submit(
         self, query: np.ndarray, k: int, min_similarity: float = -1.0
-    ) -> list:
+    ) -> _Pending:
+        """Enqueue one query without blocking — the cross-process device
+        broker (server/broker.py) submits a whole worker batch this way,
+        then waits on every ticket, so queries from ALL workers coalesce
+        into the same fused device dispatch. Raises ResourceExhausted at
+        admission when the queue is full."""
         p = _Pending(np.asarray(query, np.float32).reshape(-1), k, min_similarity)
         p.enqueued = time.perf_counter()
         if self.deadline > 0:
@@ -139,16 +156,18 @@ class QueryBatcher:
                     "pending); retry with backoff", reason="queue_full",
                 )
             self._pending.append(p)
-            if self._flusher is None:
-                # first caller of the window becomes responsible for flushing
-                self._flusher = threading.Thread(target=self._flush_after_window,
-                                                 daemon=True)
-                self._flusher.start()
-            elif len(self._pending) >= self.max_batch:
-                pending, self._pending = self._pending, []
-                threading.Thread(
-                    target=self._run_batch, args=(pending,), daemon=True
-                ).start()
+            if self._dispatcher is None:
+                self._dispatcher = threading.Thread(
+                    target=self._dispatch_loop,
+                    name="nornicdb-query-batcher", daemon=True,
+                )
+                self._dispatcher.start()
+            self._cond.notify()
+        return p
+
+    def wait(self, p: _Pending) -> list:
+        """Block until a submitted query's batch dispatched; the other half
+        of search(). Deadline-carrying tickets give up at deadline+grace."""
         # bounded wait: the dispatch path is time-bounded (the backend
         # manager degrades a hung device within its acquire timeout), and
         # a deadline-carrying caller gives up past deadline + grace — a
@@ -168,13 +187,43 @@ class QueryBatcher:
             raise p.error
         return p.result
 
-    def _flush_after_window(self) -> None:
-        threading.Event().wait(self.window)
+    def search(
+        self, query: np.ndarray, k: int, min_similarity: float = -1.0
+    ) -> list:
+        return self.wait(self.submit(query, k, min_similarity))
+
+    def close(self) -> None:
+        """Stop the dispatcher thread (drains nothing: callers of an
+        already-closed batcher get their tickets flushed by the final
+        loop pass before it exits)."""
         with self._lock:
-            pending, self._pending = self._pending, []
-            self._flusher = None
-        if pending:
-            self._run_batch(pending)
+            self._closed = True
+            self._cond.notify_all()
+        t = self._dispatcher
+        if t is not None:
+            t.join(timeout=5)
+
+    def _dispatch_loop(self) -> None:
+        while True:
+            with self._lock:
+                while not self._pending and not self._closed:
+                    self._cond.wait()
+                if not self._pending and self._closed:
+                    return
+                # low-concurrency coalescing: give the FIRST waiter's
+                # companions up to `window` to arrive; a full batch (or
+                # close()) cuts the wait short. Under load this wait never
+                # triggers — the queue already holds a dispatch's worth.
+                deadline = self._pending[0].enqueued + self.window
+                while (len(self._pending) < self.max_batch
+                       and not self._closed):
+                    remaining = deadline - time.perf_counter()
+                    if remaining <= 0:
+                        break
+                    self._cond.wait(remaining)
+                batch = self._pending[: self.max_batch]
+                del self._pending[: self.max_batch]
+            self._run_batch(batch)
 
     def _run_batch(self, pending: list[_Pending]) -> None:
         # deadline shedding at dispatch: work that already expired is

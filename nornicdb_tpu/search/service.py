@@ -474,6 +474,35 @@ class SearchService:
             **self._corpus_search_kwargs(corpus),
         )
 
+    def corpus(self):
+        """The live vector corpus (None before first indexed embedding).
+        Promotion may swap it — hold the returned reference, don't re-read
+        mid-operation."""
+        with self._lock:
+            return self._corpus
+
+    def ensure_batcher(self):
+        """The service's QueryBatcher, created on first use with the
+        config's batching knobs.  The device broker (server/broker.py)
+        calls this even when ``batching_enabled`` is off for in-process
+        callers: cross-worker traffic must coalesce into fused device
+        dispatches regardless of how the primary's own callers dispatch."""
+        batcher = getattr(self, "_batcher", None)
+        if batcher is None:
+            with self._lock:
+                batcher = getattr(self, "_batcher", None)
+                if batcher is None:
+                    from nornicdb_tpu.search.batcher import QueryBatcher
+
+                    batcher = self._batcher = QueryBatcher(
+                        self._batched_corpus_search,
+                        window=self.config.batch_window,
+                        max_batch=self.config.batch_max,
+                        max_queue=self.config.batch_max_queue,
+                        deadline=self.config.batch_deadline_ms / 1000.0,
+                    )
+        return batcher
+
     def vector_candidates(
         self, embedding: np.ndarray, k: int = 10, min_similarity: float = -1.0
     ) -> list[tuple[str, float]]:
@@ -490,19 +519,8 @@ class SearchService:
             self.config.batching_enabled
             and self._corpus is not None
         ):
-            batcher = getattr(self, "_batcher", None)
-            if batcher is None:
-                from nornicdb_tpu.search.batcher import QueryBatcher
-
-                batcher = self._batcher = QueryBatcher(
-                    self._batched_corpus_search,
-                    window=self.config.batch_window,
-                    max_batch=self.config.batch_max,
-                    max_queue=self.config.batch_max_queue,
-                    deadline=self.config.batch_deadline_ms / 1000.0,
-                )
             self.stats.vector_candidates += 1
-            return batcher.search(embedding, k, min_similarity)
+            return self.ensure_batcher().search(embedding, k, min_similarity)
         # snapshot index refs under the lock, dispatch OUTSIDE it: the
         # round-5 deadlock was exactly a device acquisition hanging while
         # this lock was held, wedging every later search/index call. The
@@ -764,5 +782,8 @@ class SearchService:
         would re-upload the zombie corpus on every recovery)."""
         with self._lock:
             corpus = self._corpus
+            batcher = getattr(self, "_batcher", None)
         if corpus is not None and hasattr(corpus, "stop_uploader"):
             corpus.stop_uploader()
+        if batcher is not None:
+            batcher.close()

@@ -1,11 +1,18 @@
 """Protocol servers (ref: /root/reference/pkg/bolt, pkg/server, pkg/mcp)."""
 
 from nornicdb_tpu.server.bolt import BoltServer
+from nornicdb_tpu.server.broker import BrokerClient, DeviceBroker
 from nornicdb_tpu.server.http import HttpServer
 from nornicdb_tpu.server.packstream import Structure, pack, to_wire, unpack
+from nornicdb_tpu.server.readplane import (
+    ReadPlanePublisher,
+    SharedAdjacencyReader,
+    SharedCorpusReader,
+)
 from nornicdb_tpu.server.workers import WorkerPool
 
 __all__ = [
-    "BoltServer", "HttpServer", "Structure", "pack", "to_wire", "unpack",
-    "WorkerPool",
+    "BoltServer", "BrokerClient", "DeviceBroker", "HttpServer",
+    "ReadPlanePublisher", "SharedAdjacencyReader", "SharedCorpusReader",
+    "Structure", "WorkerPool", "pack", "to_wire", "unpack",
 ]

@@ -39,6 +39,18 @@ from nornicdb_tpu.cypher.parser import parse as cypher_parse
 # docs/observability.md catalog renders in every server process, whether
 # or not a ServingEngine was constructed
 from nornicdb_tpu.serving import stats as _serving_stats  # noqa: F401
+# same deal for the device-broker and shared-memory read-plane families
+# (nornicdb_broker_* / nornicdb_shm_*): registered at import so the tested
+# catalog renders even in a single-process server with no worker pool
+from nornicdb_tpu.server import broker as _broker_mod  # noqa: F401
+from nornicdb_tpu.server import shm as _shm_mod  # noqa: F401
+
+
+def _worker_pool_stats() -> list[dict]:
+    # lazy: workers.py lazily imports RateLimiter from this module
+    from nornicdb_tpu.server import workers as _workers_mod
+
+    return _workers_mod.active_pool_stats()
 # likewise the generation-engine families (queue depth, page-pool
 # utilization, prefill/decode latency, sheds, tokens) — the tested
 # observability catalog must render them in every serving process
@@ -757,6 +769,24 @@ class HttpServer:
                 # recovery counters, probe latency, recent transitions
                 # (docs/backend.md failure playbook reads from here)
                 stats["backend"] = backend_stats
+            brokers = _broker_mod.active_broker_stats()
+            if brokers:
+                # cross-process device broker: worker connections, request
+                # outcomes (ok/shed/degraded), queries fused downstream
+                # (docs/operations.md "Multi-process serving" reads these)
+                stats["broker"] = brokers[0] if len(brokers) == 1 else brokers
+            from nornicdb_tpu.server import readplane as _readplane_mod
+
+            publishers = _readplane_mod.active_publisher_stats()
+            if publishers:
+                # shared-memory read plane: per-segment generation /
+                # publish counts / payload bytes
+                stats["shm"] = (publishers[0] if len(publishers) == 1
+                                else publishers)
+            pools = _worker_pool_stats()
+            if pools:
+                # prefork worker pool: live workers, respawns, ports
+                stats["workers"] = pools[0] if len(pools) == 1 else pools
             h._send(200, stats)
             return
         if path == "/admin/config":
@@ -1017,6 +1047,39 @@ class HttpServer:
             # must make this entry dead on arrival
             gen_before = cache.generation()
             body = self._parse_body(raw)
+            vector = body.get("vector")
+            if vector:
+                # raw-vector search (the gRPC SearchRequest.vector shape on
+                # the REST surface): the worker-servable hot path — prefork
+                # workers answer it through the device broker and fall back
+                # to the shared-memory host scan, bit-identical ids/scores
+                # to this in-process path
+                from nornicdb_tpu.errors import NotFoundError
+
+                hits = self.db.search.vector_candidates(
+                    np.asarray(vector, np.float32),
+                    k=int(body.get("limit", 10)),
+                    min_similarity=float(body.get("min_score", -1.0)),
+                )
+                # include_content=false skips the per-hit node fetch —
+                # the knob high-qps clients use when ids/scores suffice
+                enrich = bool(body.get("include_content", True))
+                out = []
+                for nid, score in hits:
+                    content = ""
+                    if enrich:
+                        try:
+                            node = self.db.storage.get_node(nid)
+                            content = node.properties.get("content", "")
+                        except NotFoundError:
+                            pass  # hit evicted between search and fetch
+                    out.append(
+                        {"id": nid, "score": score, "content": content}
+                    )
+                payload = json.dumps({"results": out}).encode()
+                cache.put((path, raw), payload, gen_before)
+                h._send_raw(200, payload)
+                return
             results = self.db.search.search(
                 body.get("query", ""), limit=int(body.get("limit", 10))
             )

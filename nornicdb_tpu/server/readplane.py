@@ -1,0 +1,416 @@
+"""Shared-memory read plane: one copy of the hot read state for N workers.
+
+The prefork workers (server/workers.py) are protocol frontends with no DB.
+Before this module, every read they could not answer from the response
+cache crossed back into the primary — so "add workers" only scaled cache
+hits. The read plane exports the primary's two flat, read-mostly indexes
+through generation-stamped shared-memory segments (server/shm.py):
+
+* **corpus** — the search corpus host mirror: f32 rows + validity + slot→id
+  map, plus the int8 serving mirror (per-row symmetric codes + scales, the
+  same quantization the device kernels use). Workers serve exact host
+  search from the f32 block — bit-identical to the primary's DEGRADED_CPU
+  path because both run the same ``host_topk`` + ``format_topk_results``
+  routines over the same slot layout.
+* **adjacency** — the merged CSR topology snapshot (storage/adjacency.py):
+  offsets/neighbors/edge-rows per direction + vocab. Workers expand
+  traversals through the same ``_gather_csr`` gather the in-process
+  snapshot uses, so expansions are bit-identical too.
+
+The :class:`ReadPlanePublisher` republishes a segment when its source
+generation moves; readers remap lazily on their next access (seqlock
+header check — the mid-read case is safe because an already-mapped
+snapshot stays valid until dropped).
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import threading
+import time
+import weakref
+from typing import Any, Callable, Optional
+
+import numpy as np
+
+from nornicdb_tpu.ops.host_search import format_topk_results, host_topk
+from nornicdb_tpu.server.shm import (
+    SegmentReader,
+    SegmentUnavailable,
+    SegmentWriter,
+)
+from nornicdb_tpu.storage.adjacency import _gather_csr
+
+log = logging.getLogger(__name__)
+
+CORPUS_SEGMENT = "corpus"
+ADJACENCY_SEGMENT = "adjacency"
+
+
+# -- string-table packing ----------------------------------------------------
+def pack_strings(strs: list) -> tuple[np.ndarray, np.ndarray]:
+    """Pack a list of strings (None → empty) into (u8 bytes, u32 offsets);
+    offsets has len(strs)+1 entries."""
+    blobs = [(s or "").encode() for s in strs]
+    off = np.zeros(len(blobs) + 1, np.uint32)
+    if blobs:
+        off[1:] = np.cumsum([len(b) for b in blobs], dtype=np.uint64).astype(
+            np.uint32
+        )
+    data = np.frombuffer(b"".join(blobs), np.uint8).copy() if blobs else \
+        np.zeros(0, np.uint8)
+    return data, off
+
+
+def unpack_strings(data: np.ndarray, off: np.ndarray) -> list[str]:
+    raw = data.tobytes()
+    o = off.tolist()
+    return [raw[o[i]:o[i + 1]].decode() for i in range(len(o) - 1)]
+
+
+# -- exporters ---------------------------------------------------------------
+def export_corpus_segment(corpus) -> tuple[dict, dict]:
+    """Corpus host state → (arrays, meta) for SegmentWriter.publish."""
+    state = corpus.export_host_state()
+    rows = state["rows"]
+    # int8 serving mirror: per-row symmetric quantization, the exact math
+    # of ops.pallas_kernels.quantize_rows on host (codes identical; scales
+    # within a float ulp) — the compact block for memory-lean consumers
+    scale = (127.0 / np.maximum(np.max(np.abs(rows), axis=1), 1e-9)).astype(
+        np.float32
+    )
+    codes = np.round(rows * scale[:, None]).astype(np.int8)
+    id_bytes, id_off = pack_strings(state["ids"])
+    arrays = {
+        "rows": rows,
+        "valid": state["valid"],
+        "rows_i8": codes,
+        "scales_i8": scale,
+        "id_bytes": id_bytes,
+        "id_off": id_off,
+    }
+    meta = {
+        "epoch": state["epoch"],
+        "count": state["count"],
+        "dims": state["dims"],
+    }
+    return arrays, meta
+
+
+def export_adjacency_segment(snap) -> Optional[tuple[dict, dict]]:
+    """AdjacencySnapshot → (arrays, meta); None while unbuilt."""
+    exported = snap.export_arrays()
+    if exported is None:
+        return None
+    arrays, vocab = exported
+    for name in ("ids", "row_ids", "type_names"):
+        data, off = pack_strings(vocab[name])
+        arrays[f"{name}_bytes"] = data
+        arrays[f"{name}_off"] = off
+    meta = {
+        "source_generation": vocab["generation"],
+        "n_csr": vocab["n_csr"],
+    }
+    return arrays, meta
+
+
+# -- shared readers ----------------------------------------------------------
+class SharedCorpusReader:
+    """Worker-side exact host search over the shared corpus segment.
+
+    ``search`` mirrors ``HostCorpus._search_host`` — same query
+    normalization, same ``host_topk`` selection (including its tie rule),
+    same ``format_topk_results`` epilogue — over the one shared copy, so
+    results are bit-identical to the primary's host path at the same
+    generation."""
+
+    def __init__(self, prefix: str):
+        self._reader = SegmentReader(prefix, CORPUS_SEGMENT)
+        self._ids_cache: tuple[int, list[str]] = (-1, [])
+        self._lock = threading.Lock()
+
+    def generation(self) -> int:
+        return self._reader.snapshot().generation
+
+    def _ids_for(self, snap) -> list[str]:
+        with self._lock:
+            gen, ids = self._ids_cache
+            if gen == snap.generation:
+                return ids
+        ids = unpack_strings(snap.arrays["id_bytes"], snap.arrays["id_off"])
+        with self._lock:
+            self._ids_cache = (snap.generation, ids)
+        return ids
+
+    def search(
+        self, queries: np.ndarray, k: int, min_similarity: float = -1.0,
+        precision: str = "f32",
+    ) -> list[list[tuple[str, float]]]:
+        snap = self._reader.snapshot()  # remaps on generation bump
+        q = np.atleast_2d(np.asarray(queries, np.float32))
+        norms = np.linalg.norm(q, axis=1, keepdims=True)
+        qn = q / np.maximum(norms, 1e-12)
+        valid = snap.arrays["valid"]
+        ids = self._ids_for(snap)
+        if precision == "int8":
+            # compact block: int8 codes scored in int32, de-scaled per row.
+            # Approximate (quantization error), for memory-lean consumers;
+            # serving fallback uses the exact f32 block below.
+            codes = snap.arrays["rows_i8"]
+            scales = snap.arrays["scales_i8"]
+            approx = codes.astype(np.float32) / np.maximum(scales, 1e-9)[
+                :, None
+            ]
+            vals, idx = host_topk(qn, approx, valid,
+                                  min(k, codes.shape[0]))
+        else:
+            rows = snap.arrays["rows"]
+            vals, idx = host_topk(qn, rows, valid, min(k, rows.shape[0]))
+        return format_topk_results(
+            vals, idx, q.shape[0], k, min_similarity, ids
+        )
+
+    def stats(self) -> dict[str, Any]:
+        return {"remaps": self._reader.remaps}
+
+    def close(self) -> None:
+        self._reader.close()
+
+
+class _AdjView:
+    """Decoded per-generation adjacency state (vocab maps + array refs)."""
+
+    __slots__ = ("snap", "ids", "idx", "alive", "row_ids", "type_code",
+                 "n_csr")
+
+    def __init__(self, snap):
+        self.snap = snap
+        a = snap.arrays
+        self.ids = unpack_strings(a["ids_bytes"], a["ids_off"])
+        self.idx = {id_: i for i, id_ in enumerate(self.ids)}
+        self.alive = a["node_alive"]
+        self.row_ids = unpack_strings(a["row_ids_bytes"], a["row_ids_off"])
+        names = unpack_strings(a["type_names_bytes"], a["type_names_off"])
+        self.type_code = {n: c for c, n in enumerate(names)}
+        self.n_csr = int(snap.meta["n_csr"])
+
+
+class SharedAdjacencyReader:
+    """Worker-side CSR traversal over the shared adjacency segment.
+
+    Expansion runs the same ``_gather_csr`` gather as the in-process
+    AdjacencySnapshot (the exported CSR is pre-merged, so no delta overlay
+    is needed) and sorts pairs by edge id exactly like
+    ``expand_frontier`` — bit-identical expansions at the same source
+    generation."""
+
+    def __init__(self, prefix: str):
+        self._reader = SegmentReader(prefix, ADJACENCY_SEGMENT)
+        self._view: Optional[_AdjView] = None
+        self._lock = threading.Lock()
+
+    def _current(self) -> _AdjView:
+        snap = self._reader.snapshot()
+        with self._lock:
+            if self._view is not None and self._view.snap is snap:
+                return self._view
+        view = _AdjView(snap)
+        with self._lock:
+            self._view = view
+        return view
+
+    def generation(self) -> int:
+        """The SOURCE snapshot generation this view was exported from."""
+        return int(self._current().snap.meta["source_generation"])
+
+    def index_of(self, node_id: str) -> Optional[int]:
+        v = self._current()
+        i = v.idx.get(node_id)
+        if i is None or not v.alive[i]:
+            return None
+        return i
+
+    def ids_of(self, idxs) -> list[str]:
+        v = self._current()
+        return [v.ids[i] for i in idxs]
+
+    def type_codes(self, types) -> Optional[list[int]]:
+        if not types:
+            return None
+        v = self._current()
+        return [c for t in types
+                if (c := v.type_code.get(t)) is not None]
+
+    def expand_frontier(
+        self, idxs: list[int], direction: str,
+        codes: Optional[list[int]] = None,
+    ) -> dict[int, list[tuple[str, int]]]:
+        v = self._current()
+        a = v.snap.arrays
+        dirs = (("out",) if direction == "out"
+                else ("in",) if direction == "in" else ("out", "in"))
+        out: dict[int, list[tuple[str, int]]] = {i: [] for i in idxs}
+        arr_all = np.fromiter(idxs, np.int64, len(idxs))
+        for d in dirs:
+            heads, r, nb = _gather_csr(
+                a[f"{d}_off"], a[f"{d}_nbr"], a[f"{d}_rows"],
+                a["row_alive"], a["erow_type"], v.n_csr, arr_all, codes,
+            )
+            for j in range(heads.size):
+                out[int(heads[j])].append((v.row_ids[int(r[j])],
+                                           int(nb[j])))
+        for lst in out.values():
+            lst.sort()
+        return out
+
+    def expand_pairs(self, node_id: str, direction: str,
+                     types=None) -> Optional[list[tuple[str, str]]]:
+        """(edge_id, other_node_id) pairs, sorted — the AdjacencySnapshot
+        ``expand_pairs`` contract over the shared segment."""
+        idx = self.index_of(node_id)
+        if idx is None:
+            return None
+        codes = self.type_codes(types)
+        if types and not codes:
+            return []
+        adj = self.expand_frontier([idx], direction, codes)
+        v = self._current()
+        out = [(eid, v.ids[o]) for eid, o in adj.get(idx, ())]
+        out.sort()
+        return out
+
+    def stats(self) -> dict[str, Any]:
+        return {"remaps": self._reader.remaps}
+
+    def close(self) -> None:
+        self._reader.close()
+
+
+# -- the publisher -----------------------------------------------------------
+_ACTIVE: "list[weakref.ref]" = []
+_ACTIVE_LOCK = threading.Lock()
+
+
+def active_publisher_stats() -> list[dict]:
+    """Stats for every live publisher (the /admin/stats "shm" section)."""
+    out = []
+    with _ACTIVE_LOCK:
+        refs = list(_ACTIVE)
+    for ref in refs:
+        pub = ref()
+        if pub is not None:
+            out.append(pub.stats())
+    return out
+
+
+class ReadPlanePublisher:
+    """Primary-side background publisher for the corpus + adjacency
+    segments. Republishes a segment when its source generation/epoch moves
+    (checked every ``interval`` seconds — cheap integer reads), so worker
+    reads are at most one interval stale, the exact staleness contract of
+    the workers' generation-stamped response cache."""
+
+    def __init__(
+        self,
+        directory: str,
+        corpus_fn: Callable[[], Any],
+        adjacency_fn: Optional[Callable[[], Any]] = None,
+        interval: float = 0.05,
+    ):
+        self.directory = directory
+        os.makedirs(directory, exist_ok=True)
+        self.corpus_fn = corpus_fn
+        self.adjacency_fn = adjacency_fn
+        self.interval = interval
+        self.paths = {
+            CORPUS_SEGMENT: os.path.join(directory, "corpus.seg"),
+            ADJACENCY_SEGMENT: os.path.join(directory, "adjacency.seg"),
+        }
+        self._corpus_writer = SegmentWriter(self.paths[CORPUS_SEGMENT],
+                                            CORPUS_SEGMENT)
+        self._adj_writer = SegmentWriter(self.paths[ADJACENCY_SEGMENT],
+                                         ADJACENCY_SEGMENT)
+        # weakref, not id(): a promoted-away corpus can be freed and its
+        # address reused by the replacement — an id match plus an equal
+        # epoch would then silently skip republishing the new corpus
+        self._last_corpus_ref: Optional["weakref.ref"] = None
+        self._last_corpus_epoch = -1
+        self._last_adj = -1
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self.errors = 0
+        with _ACTIVE_LOCK:
+            _ACTIVE[:] = [r for r in _ACTIVE if r() is not None]
+            _ACTIVE.append(weakref.ref(self))
+
+    # -- publish decisions --------------------------------------------------
+    def publish_now(self) -> dict[str, int]:
+        """Export + publish any segment whose source moved; returns the
+        generations published this call (empty when nothing moved)."""
+        published: dict[str, int] = {}
+        corpus = self.corpus_fn()
+        if corpus is not None:
+            # unlocked epoch read is a benign race: a publish decision one
+            # tick late is within the staleness contract, and the export
+            # itself snapshots under the corpus sync lock
+            last = (self._last_corpus_ref()
+                    if self._last_corpus_ref is not None else None)
+            if last is not corpus or \
+                    corpus._epoch != self._last_corpus_epoch:
+                arrays, meta = export_corpus_segment(corpus)
+                gen = self._corpus_writer.publish(arrays, meta)
+                self._last_corpus_ref = weakref.ref(corpus)
+                self._last_corpus_epoch = meta["epoch"]
+                published[CORPUS_SEGMENT] = gen
+        snap = self.adjacency_fn() if self.adjacency_fn is not None else None
+        if snap is not None and snap.ready():
+            src_gen = snap.generation()
+            if src_gen != self._last_adj:
+                exported = export_adjacency_segment(snap)
+                if exported is not None:
+                    gen = self._adj_writer.publish(*exported)
+                    self._last_adj = src_gen
+                    published[ADJACENCY_SEGMENT] = gen
+        return published
+
+    def _loop(self) -> None:
+        while not self._stop.wait(self.interval):
+            try:
+                self.publish_now()
+            except Exception:
+                self.errors += 1
+                log.exception("read-plane publish failed")
+
+    def start(self) -> "ReadPlanePublisher":
+        if self._thread is None:
+            try:
+                self.publish_now()
+            except Exception:
+                self.errors += 1
+                log.exception("initial read-plane publish failed")
+            self._thread = threading.Thread(
+                target=self._loop, name="nornicdb-readplane", daemon=True
+            )
+            self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        t = self._thread
+        if t is not None:
+            t.join(timeout=5)
+            self._thread = None
+        self._corpus_writer.close()
+        self._adj_writer.close()
+
+    def stats(self) -> dict[str, Any]:
+        return {
+            "directory": self.directory,
+            "interval_s": self.interval,
+            "errors": self.errors,
+            "segments": {
+                CORPUS_SEGMENT: self._corpus_writer.stats(),
+                ADJACENCY_SEGMENT: self._adj_writer.stats(),
+            },
+        }

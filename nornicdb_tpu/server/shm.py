@@ -1,0 +1,357 @@
+"""Generation-stamped shared-memory segments: the cross-process read plane.
+
+N prefork workers (server/workers.py) must serve reads from ONE copy of the
+primary's flat read-mostly state — the CSR adjacency snapshot and the search
+corpus mirror — instead of N private rebuilds. This module is the transport:
+a writer (the primary) publishes named numpy arrays plus a JSON meta block
+as an mmap'd payload file per generation, and readers (worker subprocesses)
+map the current payload read-only and remap when the generation moves.
+
+Layout
+------
+``<prefix>.hdr`` — fixed 64-byte seqlock header, single writer:
+
+    [0:8)   sequence (u64 LE; odd while a publish is in flight)
+    [8:16)  generation (u64)
+    [16:24) payload byte length (u64)
+    [24:64) reserved
+
+``<prefix>.g<generation>`` — the payload: ``magic | u32 json_len | json
+directory | pad to 64 | raw array bytes``. The directory lists each array's
+name/dtype/shape/offset plus the writer's ``meta`` dict. Payload files are
+immutable once published: the writer creates ``.tmp`` then renames, updates
+the header under the seqlock, and unlinks the PREVIOUS generation's file.
+A reader that loses the race (header read → file already unlinked) simply
+retries the header; a reader that already mapped an old generation keeps
+its views alive through the open mapping (POSIX unlink semantics) until it
+drops the snapshot — remapping is the reader's choice of WHEN, never a
+correctness hazard mid-read.
+
+The seqlock discipline is the same as workers.GenerationFile: mmap slice
+assignment is a plain memcpy with no atomicity guarantee, so readers retry
+while the sequence is odd or moved across the read. The bounded fallback
+(writer died mid-publish) returns the last even snapshot seen or fails the
+map — a worker then falls back to proxying, never serves torn state.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import mmap
+import os
+import struct
+import threading
+from typing import Any, Optional
+
+import numpy as np
+
+from nornicdb_tpu.telemetry.metrics import REGISTRY as _REGISTRY
+
+log = logging.getLogger(__name__)
+
+_MAGIC = b"NSHM"
+_HDR_SIZE = 64
+_PAYLOAD_ALIGN = 64
+
+# -- metrics (eager cells for the two shipped segments so the tested
+#    observability catalog renders before first publish) --------------------
+_PUBLISHES = _REGISTRY.counter(
+    "nornicdb_shm_publishes_total",
+    "Shared-memory segment generations published by the primary",
+    labels=("segment",),
+)
+_REMAPS = _REGISTRY.counter(
+    "nornicdb_shm_remaps_total",
+    "Reader remaps onto a newer shared-segment generation",
+    labels=("segment",),
+)
+_BYTES = _REGISTRY.gauge(
+    "nornicdb_shm_bytes",
+    "Payload bytes of the current shared-segment generation",
+    labels=("segment",),
+)
+_GENERATION = _REGISTRY.gauge(
+    "nornicdb_shm_generation",
+    "Current published generation per shared segment",
+    labels=("segment",),
+)
+for _seg in ("corpus", "adjacency"):
+    _PUBLISHES.labels(_seg)
+    _REMAPS.labels(_seg)
+    _BYTES.labels(_seg)
+    _GENERATION.labels(_seg)
+
+
+class SegmentUnavailable(RuntimeError):
+    """No published generation could be mapped (writer absent, mid-crash,
+    or the prefix never existed). Readers fall back to their proxy path."""
+
+
+def _encode_payload(arrays: dict[str, np.ndarray], meta: dict) -> bytes:
+    """``magic | u32 json_len | u64 data_origin | json | pad | arrays``.
+    Array offsets in the directory are relative to ``data_origin`` so the
+    directory's own length never feeds back into the offsets."""
+    directory = {"arrays": [], "meta": meta}
+    blobs: list[bytes] = []
+    rel = 0
+    for name, arr in arrays.items():
+        a = np.ascontiguousarray(arr)
+        raw = a.tobytes()
+        directory["arrays"].append({
+            "name": name,
+            "dtype": a.dtype.str,
+            "shape": list(a.shape),
+            "offset": rel,
+            "nbytes": len(raw),
+        })
+        blobs.append(raw)
+        rel = (rel + len(raw) + 7) & ~7  # 8-byte align every array
+    dir_json = json.dumps(directory, separators=(",", ":")).encode()
+    head_len = len(_MAGIC) + 4 + 8 + len(dir_json)
+    origin = (head_len + _PAYLOAD_ALIGN - 1) // _PAYLOAD_ALIGN \
+        * _PAYLOAD_ALIGN
+    out = bytearray(
+        _MAGIC + struct.pack("<IQ", len(dir_json), origin) + dir_json
+    )
+    out += b"\x00" * (origin - len(out))
+    for entry, raw in zip(directory["arrays"], blobs):
+        at = origin + entry["offset"]
+        if len(out) < at:
+            out += b"\x00" * (at - len(out))
+        out += raw
+    return bytes(out)
+
+
+class SegmentSnapshot:
+    """One mapped generation: read-only numpy views over the mmap plus the
+    writer's meta dict. Holding the snapshot keeps the mapping (and thus
+    every view) valid even after the writer publishes — and unlinks — newer
+    generations."""
+
+    __slots__ = ("generation", "arrays", "meta", "_mm", "_f")
+
+    def __init__(self, generation: int, arrays: dict[str, np.ndarray],
+                 meta: dict, mm: mmap.mmap, f):
+        self.generation = generation
+        self.arrays = arrays
+        self.meta = meta
+        self._mm = mm
+        self._f = f
+
+    def close(self) -> None:
+        self.arrays = {}
+        try:
+            self._mm.close()
+            self._f.close()
+        except (OSError, ValueError):
+            pass  # already closed
+
+
+class SegmentWriter:
+    """Single-writer publisher for one named segment."""
+
+    def __init__(self, prefix: str, segment: str = "corpus"):
+        self.prefix = prefix
+        self.segment = segment
+        self.generation = 0
+        self._lock = threading.Lock()
+        self._hdr_path = prefix + ".hdr"
+        os.makedirs(os.path.dirname(prefix) or ".", exist_ok=True)
+        with open(self._hdr_path, "wb") as f:
+            f.write(b"\x00" * _HDR_SIZE)
+        self._hdr_f = open(self._hdr_path, "r+b")
+        self._hdr = mmap.mmap(self._hdr_f.fileno(), _HDR_SIZE)
+        self._seq = 0
+        self._prev_path: Optional[str] = None
+        self.publishes = 0
+        self.payload_bytes = 0
+
+    def _payload_path(self, gen: int) -> str:
+        return f"{self.prefix}.g{gen}"
+
+    def publish(self, arrays: dict[str, np.ndarray],
+                meta: Optional[dict] = None) -> int:
+        """Write a new generation and swing the header to it. Returns the
+        published generation."""
+        payload = _encode_payload(arrays, meta or {})
+        with self._lock:
+            gen = self.generation + 1
+            path = self._payload_path(gen)
+            tmp = path + ".tmp"
+            with open(tmp, "wb") as f:
+                f.write(payload)
+            os.rename(tmp, path)
+            self._seq += 1  # odd: publish in flight
+            self._hdr[0:8] = struct.pack("<Q", self._seq & (2**64 - 1))
+            self._hdr[8:16] = struct.pack("<Q", gen)
+            self._hdr[16:24] = struct.pack("<Q", len(payload))
+            self._seq += 1  # even: stable
+            self._hdr[0:8] = struct.pack("<Q", self._seq & (2**64 - 1))
+            self.generation = gen
+            prev, self._prev_path = self._prev_path, path
+            self.publishes += 1
+            self.payload_bytes = len(payload)
+        if prev is not None:
+            try:
+                os.unlink(prev)
+            except OSError:
+                log.debug("stale segment payload unlink failed: %s", prev,
+                          exc_info=True)
+        _PUBLISHES.labels(self.segment).inc()
+        _BYTES.labels(self.segment).set(float(len(payload)))
+        _GENERATION.labels(self.segment).set(float(gen))
+        return gen
+
+    def stats(self) -> dict[str, Any]:
+        with self._lock:
+            return {
+                "segment": self.segment,
+                "generation": self.generation,
+                "publishes": self.publishes,
+                "payload_bytes": self.payload_bytes,
+            }
+
+    def close(self, unlink: bool = True) -> None:
+        with self._lock:
+            try:
+                self._hdr.close()
+                self._hdr_f.close()
+            except (OSError, ValueError):
+                pass  # already closed
+            paths = [self._hdr_path]
+            if self._prev_path is not None:
+                paths.append(self._prev_path)
+            if unlink:
+                for p in paths:
+                    try:
+                        os.unlink(p)
+                    except OSError:
+                        pass  # best-effort cleanup of our own temp files
+
+
+class SegmentReader:
+    """Maps the writer's current generation; remaps on generation bump.
+
+    ``snapshot()`` is cheap when the generation hasn't moved (one seqlock
+    header read). Thread-safe: concurrent callers share one cached
+    SegmentSnapshot per generation."""
+
+    def __init__(self, prefix: str, segment: str = "corpus"):
+        self.prefix = prefix
+        self.segment = segment
+        self._lock = threading.Lock()
+        self._hdr: Optional[mmap.mmap] = None
+        self._hdr_f = None
+        self._snap: Optional[SegmentSnapshot] = None
+        self.remaps = 0
+
+    def _ensure_header(self) -> mmap.mmap:
+        if self._hdr is None:
+            try:
+                self._hdr_f = open(self.prefix + ".hdr", "rb")
+                self._hdr = mmap.mmap(self._hdr_f.fileno(), _HDR_SIZE,
+                                      prot=mmap.PROT_READ)
+            except (OSError, ValueError) as e:
+                raise SegmentUnavailable(
+                    f"segment header missing: {self.prefix}.hdr ({e})"
+                )
+        return self._hdr
+
+    def _read_header(self) -> tuple[int, int]:
+        """(generation, payload_len) via bounded seqlock retry."""
+        hdr = self._ensure_header()
+        for _ in range(1000):
+            s1 = struct.unpack_from("<Q", hdr, 0)[0]
+            if s1 & 1:
+                continue
+            gen = struct.unpack_from("<Q", hdr, 8)[0]
+            ln = struct.unpack_from("<Q", hdr, 16)[0]
+            s2 = struct.unpack_from("<Q", hdr, 0)[0]
+            if s1 == s2:
+                return gen, ln
+        raise SegmentUnavailable(
+            f"segment header unstable (writer died mid-publish?): "
+            f"{self.prefix}"
+        )
+
+    def _map(self, gen: int, ln: int) -> SegmentSnapshot:
+        path = f"{self.prefix}.g{gen}"
+        f = open(path, "rb")
+        try:
+            mm = mmap.mmap(f.fileno(), 0, prot=mmap.PROT_READ)
+        except (OSError, ValueError):
+            f.close()
+            raise
+        try:
+            if mm[:4] != _MAGIC:
+                raise SegmentUnavailable(f"bad segment magic in {path}")
+            dir_len, origin = struct.unpack_from("<IQ", mm, 4)
+            directory = json.loads(mm[16:16 + dir_len].decode())
+            buf = memoryview(mm)
+            arrays: dict[str, np.ndarray] = {}
+            for entry in directory["arrays"]:
+                dt = np.dtype(entry["dtype"])
+                count = int(np.prod(entry["shape"])) if entry["shape"] else 1
+                a = np.frombuffer(
+                    buf, dtype=dt, count=count,
+                    offset=origin + entry["offset"],
+                ).reshape(entry["shape"])
+                a.flags.writeable = False
+                arrays[entry["name"]] = a
+            return SegmentSnapshot(gen, arrays, directory.get("meta", {}),
+                                   mm, f)
+        except SegmentUnavailable:
+            mm.close()
+            f.close()
+            raise
+        except Exception:
+            mm.close()
+            f.close()
+            raise
+
+    def snapshot(self) -> SegmentSnapshot:
+        """The current generation's arrays+meta; remaps if the writer
+        published since the last call. Raises SegmentUnavailable when no
+        generation can be mapped."""
+        with self._lock:
+            for _ in range(8):
+                gen, ln = self._read_header()
+                if gen == 0:
+                    raise SegmentUnavailable(
+                        f"no generation published yet: {self.prefix}"
+                    )
+                if self._snap is not None and self._snap.generation == gen:
+                    return self._snap
+                try:
+                    snap = self._map(gen, ln)
+                except FileNotFoundError:
+                    # writer raced ahead and unlinked this generation
+                    # between our header read and the open — retry
+                    continue
+                old, self._snap = self._snap, snap
+                if old is not None:
+                    # the OLD snapshot object stays valid for anyone still
+                    # holding it (its mapping is open); we only drop OUR
+                    # cached reference
+                    self.remaps += 1
+                    _REMAPS.labels(self.segment).inc()
+                return snap
+            raise SegmentUnavailable(
+                f"could not map a stable generation: {self.prefix}"
+            )
+
+    def close(self) -> None:
+        with self._lock:
+            if self._snap is not None:
+                self._snap.close()
+                self._snap = None
+            try:
+                if self._hdr is not None:
+                    self._hdr.close()
+                if self._hdr_f is not None:
+                    self._hdr_f.close()
+            except (OSError, ValueError):
+                pass  # already closed
+            self._hdr = None
+            self._hdr_f = None

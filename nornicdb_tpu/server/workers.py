@@ -33,6 +33,27 @@ client state, no fork-unsafety with the primary's background threads, and
 so the pool works from REPLs and stdin scripts too). The shared generation
 counter lives in an mmap'd temp file both sides map.
 
+Device access without device ownership (the worker-scaling hot path):
+
+- **vector search** (REST ``/nornicdb/search`` with a ``vector`` body;
+  native gRPC SearchRequest.vector) is served through the primary's
+  device broker (server/broker.py): the worker ships a compact binary
+  query block over a Unix socket and the broker fuses queries from ALL
+  workers into one device program per batch window. A shed comes back as
+  429 / RESOURCE_EXHAUSTED (the PR 8 taxonomy, end to end).
+- **degraded / broker-down fallback**: when the broker answers DEGRADED
+  (backend serving from host arrays) or the socket is gone, the worker
+  serves an exact host search from the shared-memory read plane
+  (server/readplane.py) — the same one copy of the corpus every worker
+  maps — and only proxies to the primary when no segment is published.
+- every response says how it was served (``X-Nornic-Served``:
+  cache | broker | shm | proxy) so benches and soak invariants can prove
+  the intended path actually ran.
+
+The pool also owns worker lifecycle: a monitor thread respawns crashed
+workers (same worker id, same config) so a kill -9 during a fault window
+costs capacity for under a second, not forever.
+
 Client identity: every proxied request carries X-Forwarded-For with the
 real peer address, and the primary prefers that header for loopback peers
 when keying its rate limiter (http.py _client_ip). Workers additionally
@@ -47,17 +68,35 @@ import logging
 import mmap
 import os
 import re
+import signal
 import socket
 import subprocess
 import sys
 import tempfile
 import threading
+import weakref
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
-from typing import Optional
+from typing import Any, Optional
 
 from nornicdb_tpu.server.respcache import ResponseCache
 
 log = logging.getLogger(__name__)
+
+_ACTIVE_POOLS: "list[weakref.ref]" = []
+_ACTIVE_POOLS_LOCK = threading.Lock()
+
+
+def active_pool_stats() -> list[dict]:
+    """Stats of every live WorkerPool (the /admin/stats "workers"
+    section)."""
+    out = []
+    with _ACTIVE_POOLS_LOCK:
+        refs = list(_ACTIVE_POOLS)
+    for ref in refs:
+        pool = ref()
+        if pool is not None:
+            out.append(pool.stats())
+    return out
 
 
 class GenerationFile:
@@ -120,6 +159,76 @@ class GenerationFile:
                 os.unlink(self.path)
             except OSError:
                 pass
+
+class WorkerReadPath:
+    """A worker's device-access bundle: the broker client plus the
+    shared-memory fallback readers, built lazily from the pool config.
+
+    ``search`` implements the serving ladder: broker (fused device
+    dispatch) → shared-memory exact host scan (broker down / backend
+    degraded) → raise LookupError (caller proxies to the primary).
+    Sheds (ResourceExhausted) propagate — they are backpressure, not
+    unavailability, and must surface as 429/RESOURCE_EXHAUSTED."""
+
+    def __init__(self, broker_path: Optional[str],
+                 corpus_seg: Optional[str],
+                 adjacency_seg: Optional[str] = None):
+        self.broker_path = broker_path
+        self.corpus_seg = corpus_seg
+        self.adjacency_seg = adjacency_seg
+        self._client = None
+        self._corpus_reader = None
+        self.served = {"broker": 0, "shm": 0}
+
+    def _broker(self):
+        if self._client is None and self.broker_path:
+            from nornicdb_tpu.server.broker import BrokerClient
+
+            self._client = BrokerClient(self.broker_path)
+        return self._client
+
+    def _shared_corpus(self):
+        if self._corpus_reader is None and self.corpus_seg:
+            from nornicdb_tpu.server.readplane import SharedCorpusReader
+
+            self._corpus_reader = SharedCorpusReader(self.corpus_seg)
+        return self._corpus_reader
+
+    def search(
+        self, vector, k: int, min_score: float, with_content: bool,
+    ) -> tuple[list, str]:
+        """One query → ([(id, score, content)], served_by). Raises
+        ResourceExhausted on a shed, LookupError when neither the broker
+        nor a shared segment can answer."""
+        import numpy as np
+
+        from nornicdb_tpu.server.broker import (
+            BrokerDegraded,
+            BrokerUnavailable,
+        )
+
+        q = np.asarray(vector, np.float32).reshape(1, -1)
+        client = self._broker()
+        if client is not None:
+            try:
+                rows = client.search(q, k, min_score,
+                                     with_content=with_content)
+                self.served["broker"] += 1
+                return rows[0], "broker"
+            except (BrokerDegraded, BrokerUnavailable) as e:
+                log.debug("broker unavailable for search: %s", e)
+        reader = self._shared_corpus()
+        if reader is not None:
+            from nornicdb_tpu.server.shm import SegmentUnavailable
+
+            try:
+                rows = reader.search(q, k, min_score)
+                self.served["shm"] += 1
+                return [(i, s, "") for i, s in rows[0]], "shm"
+            except SegmentUnavailable as e:
+                log.debug("shared corpus segment unavailable: %s", e)
+        raise LookupError("no broker and no shared corpus segment")
+
 
 _MUTATION_RE = re.compile(r"\bmutation\b")
 
@@ -262,6 +371,12 @@ class _FrontendHandler(BaseHTTPRequestHandler):
                           msg, "limited")
             return
         try:
+            if method == "POST" and \
+                    self.path.split("?", 1)[0] == "/nornicdb/search":
+                parsed = self._sniff_vector(body)
+                if parsed is not None and \
+                        self._serve_vector(method, body, parsed):
+                    return
             if _cacheable(method, self.path, body):
                 # auth material is part of the key: a cached response must
                 # never leak across differently-privileged tokens
@@ -296,6 +411,82 @@ class _FrontendHandler(BaseHTTPRequestHandler):
             except OSError:
                 pass  # client hung up before the error could be written
 
+    # -- broker-served vector search -----------------------------------
+    @staticmethod
+    def _sniff_vector(body: bytes) -> Optional[dict]:
+        """The worker-servable request shape: a JSON body with a non-empty
+        ``vector`` list. Anything else (hybrid text search, malformed
+        JSON) returns None and takes the cache/proxy path untouched."""
+        try:
+            parsed = json.loads(body or b"{}")
+        except (ValueError, UnicodeDecodeError):
+            return None
+        if not isinstance(parsed, dict):
+            return None
+        v = parsed.get("vector")
+        if not isinstance(v, list) or not v:
+            return None
+        return parsed
+
+    def _serve_vector(self, method: str, body: bytes,
+                      parsed: dict) -> bool:
+        """Serve a raw-vector search without touching the primary's
+        protocol stack: response cache, then the WorkerReadPath ladder
+        (broker → shared segment). Returns False when neither source is
+        available — the caller falls through to the proxy path."""
+        from nornicdb_tpu.errors import ResourceExhausted
+
+        read_path = self.server.read_path
+        if read_path is None:
+            return False
+        cache = self.server.cache
+        key = (
+            method, self.path, body,
+            self.headers.get("Authorization", ""),
+            self.headers.get("Cookie", ""),
+        )
+        cached = cache.get(key)
+        if cached is not None:
+            status, headers, data = cached
+            self._respond(status, headers, data, "hit")
+            return True
+        gen_before = cache.generation()
+        try:
+            hits, served = read_path.search(
+                parsed["vector"], int(parsed.get("limit", 10)),
+                float(parsed.get("min_score", -1.0)),
+                with_content=bool(parsed.get("include_content", True)),
+            )
+        except ResourceExhausted as e:
+            msg = json.dumps(
+                {"error": str(e), "reason": e.reason}
+            ).encode()
+            self._respond(
+                429,
+                [("Content-Type", "application/json"),
+                 ("Retry-After", "1")],
+                msg, "limited",
+            )
+            return True
+        except LookupError:
+            return False  # no broker, no segment: proxy to the primary
+        except Exception:
+            log.warning("worker vector search failed; proxying",
+                        exc_info=True)
+            return False
+        payload = json.dumps({
+            "results": [
+                {"id": i, "score": s, "content": c} for i, s, c in hits
+            ]
+        }).encode()
+        headers = [("Content-Type", "application/json"),
+                   ("X-Nornic-Served", served)]
+        # the shm fallback serves without content enrichment — still
+        # cacheable (generation-stamped, so any index mutation kills it)
+        cache.put(key, (200, headers, payload), gen_before)
+        self._respond(200, headers, payload, "miss")
+        return True
+
     def do_GET(self):
         self._handle("GET")
 
@@ -320,11 +511,13 @@ class _FrontendHandler(BaseHTTPRequestHandler):
 
 def _http_worker_main(host: str, public_port: int, primary_port: int,
                       gen: GenerationFile, worker_id: int,
-                      rate_limit: Optional[tuple] = None) -> None:
+                      rate_limit: Optional[tuple] = None,
+                      read_path: Optional[WorkerReadPath] = None) -> None:
     srv = _ReuseportHTTPServer((host, public_port), _FrontendHandler)
     srv.primary_port = primary_port
     srv.cache = ResponseCache(lambda: gen.value)
     srv.worker_id = worker_id
+    srv.read_path = read_path
     if rate_limit:
         from nornicdb_tpu.server.http import RateLimiter
 
@@ -341,12 +534,18 @@ def _http_worker_main(host: str, public_port: int, primary_port: int,
 
 def _grpc_worker_main(host: str, public_port: int, primary_port: int,
                       gen: GenerationFile, worker_id: int,
-                      rate_limit: Optional[tuple] = None) -> None:
+                      rate_limit: Optional[tuple] = None,
+                      read_path: Optional[WorkerReadPath] = None) -> None:
+    import time as _time
     from concurrent import futures
 
     import grpc
 
-    from nornicdb_tpu.server.grpc_search import SERVICE_NAME
+    from nornicdb_tpu.server.grpc_search import (
+        SERVICE_NAME,
+        decode_search_request,
+        encode_search_response,
+    )
 
     channel = grpc.insecure_channel(f"127.0.0.1:{primary_port}")
     forward = channel.unary_unary(
@@ -363,6 +562,42 @@ def _grpc_worker_main(host: str, public_port: int, primary_port: int,
         # ceiling is <= n_workers x rate, which is the point (cache hits
         # must not be unlimited)
         limiter = RateLimiter(rate=rate_limit[0], burst=int(rate_limit[1]))
+
+    def _vector_local(request: bytes, context) -> Optional[bytes]:
+        """Serve a vector SearchRequest through the broker / shared
+        segment without the primary's gRPC stack; None → proxy."""
+        if read_path is None:
+            return None
+        try:
+            req = decode_search_request(request)
+        except Exception:
+            # undecodable: proxy it — the primary owns the error reply
+            log.debug("worker could not decode SearchRequest; proxying",
+                      exc_info=True)
+            return None
+        if not len(req["vector"]):
+            return None  # text search needs embedder + BM25: proxy
+        from nornicdb_tpu.errors import ResourceExhausted
+
+        t0 = _time.perf_counter()
+        try:
+            hits, _served = read_path.search(
+                req["vector"], req["limit"], req["min_score"],
+                with_content=True,
+            )
+        except ResourceExhausted as e:
+            context.abort(grpc.StatusCode.RESOURCE_EXHAUSTED, str(e))
+        except LookupError:
+            return None
+        except Exception:
+            log.warning("worker grpc vector search failed; proxying",
+                        exc_info=True)
+            return None
+        took = int((_time.perf_counter() - t0) * 1e6)
+        return encode_search_response(
+            [{"id": i, "score": s, "content": c} for i, s, c in hits],
+            took,
+        )
 
     def call(request: bytes, context) -> bytes:
         if limiter is not None:
@@ -383,7 +618,9 @@ def _grpc_worker_main(host: str, public_port: int, primary_port: int,
         if hit is not None:
             return hit
         gen_before = cache.generation()
-        resp = forward(request, metadata=meta or None)
+        resp = _vector_local(request, context)
+        if resp is None:
+            resp = forward(request, metadata=meta or None)
         cache.put(key, resp, gen_before)
         return resp
 
@@ -411,6 +648,62 @@ def _grpc_worker_main(host: str, public_port: int, primary_port: int,
     server.wait_for_termination()
 
 
+_READ_PLANE_LOCK = threading.Lock()
+
+
+def _ensure_read_plane(db, workdir: str, interval: float = 0.05):
+    """One ReadPlanePublisher per db object, refcounted across pools: the
+    HTTP and gRPC pools front the SAME primary, and two publishers would
+    export the same corpus twice per epoch."""
+    from nornicdb_tpu.server.readplane import ReadPlanePublisher
+
+    def _corpus():
+        # the LAZY search slot, never the property: the publisher must not
+        # force search-service construction (and a full index build) on a
+        # db that never indexed anything
+        svc = getattr(db, "_search", None)
+        if svc is None or not hasattr(svc, "corpus"):
+            return None
+        return svc.corpus()
+
+    def _adjacency():
+        from nornicdb_tpu.storage.adjacency import attach_snapshot
+
+        snap = attach_snapshot(db.storage)
+        if not snap.ready():
+            # first export builds the CSR (an engine scan, on the
+            # publisher thread) — the same work the first traversal
+            # would do in-process, paid once for all workers
+            snap.ensure()
+        return snap
+
+    with _READ_PLANE_LOCK:
+        rp = getattr(db, "_read_plane_publisher", None)
+        if rp is None:
+            rp = ReadPlanePublisher(
+                os.path.join(workdir, "readplane"),
+                corpus_fn=_corpus,
+                adjacency_fn=_adjacency,
+                interval=interval,
+            ).start()
+            db._read_plane_publisher = rp
+            db._read_plane_refs = 0
+        db._read_plane_refs += 1
+        return rp
+
+
+def _release_read_plane(db, rp) -> None:
+    if rp is None or db is None:
+        return
+    with _READ_PLANE_LOCK:
+        if getattr(db, "_read_plane_publisher", None) is not rp:
+            return
+        db._read_plane_refs -= 1
+        if db._read_plane_refs <= 0:
+            rp.stop()
+            db._read_plane_publisher = None
+
+
 def _reserve_port(host: str) -> tuple[socket.socket, int]:
     """Bind (without listening) a SO_REUSEPORT socket on an ephemeral port
     and keep it open: the port stays ours while every worker binds it too."""
@@ -432,7 +725,13 @@ class WorkerPool:
     def __init__(self, db, primary_port: int, n_workers: int = 2,
                  host: str = "127.0.0.1", kind: str = "http",
                  public_port: int = 0,
-                 rate_limit: Optional[tuple] = None):
+                 rate_limit: Optional[tuple] = None,
+                 broker: "Any" = True,
+                 read_plane: bool = True,
+                 respawn: bool = True,
+                 workdir: Optional[str] = None,
+                 publish_interval: float = 0.05,
+                 auth_required: bool = False):
         if kind not in ("http", "grpc"):
             raise ValueError(f"unknown worker kind {kind!r}")
         self.kind = kind
@@ -445,10 +744,46 @@ class WorkerPool:
         if public_port == 0:
             self._reserved, public_port = _reserve_port(host)
         self.port = public_port
-        self._procs: list[subprocess.Popen] = []
+        self._procs: list[Optional[subprocess.Popen]] = []
         self._db = db
         self._bump_cb = None
+        self.respawns = 0
+        self._stopping = threading.Event()
+        self._monitor: Optional[threading.Thread] = None
+        self._respawn = respawn
+        self._proc_lock = threading.Lock()
+        self._workdir = workdir or tempfile.mkdtemp(prefix="nornic-pool-")
+        self._own_workdir = workdir is None
+        # with auth enforced on the primary, workers must NOT answer from
+        # the device plane: the broker/shm ladder has no authenticator, so
+        # serving it would hand unauthenticated clients search results the
+        # primary would 401. Auth'd deployments keep cache+proxy (cached
+        # entries are auth-keyed and only stored after the primary said 200).
+        self.auth_required = auth_required
+        # device plane: the broker (one PJRT owner serving every worker's
+        # search/embed batches) and the shared-memory read plane (one copy
+        # of the corpus + CSR adjacency for every worker's fallback reads).
+        # `broker` may also be an existing DeviceBroker to share between
+        # pools (cli serve fronts HTTP and gRPC pools with ONE broker).
+        self.broker = None
+        self.read_plane = None
         if db is not None:
+            from nornicdb_tpu.server.broker import DeviceBroker
+
+            if isinstance(broker, DeviceBroker):
+                self.broker = broker
+                self._own_broker = False
+            elif broker:
+                self.broker = DeviceBroker(
+                    db, os.path.join(self._workdir, "broker.sock")
+                )
+                self._own_broker = True
+            else:
+                self._own_broker = False
+            if read_plane:
+                self.read_plane = _ensure_read_plane(
+                    db, self._workdir, publish_interval
+                )
             gen = self.generation
             lock = threading.Lock()
 
@@ -458,53 +793,149 @@ class WorkerPool:
 
             self._bump_cb = _bump
             db.storage.on_event(_bump)
+        else:
+            self._own_broker = False
+        with _ACTIVE_POOLS_LOCK:
+            _ACTIVE_POOLS[:] = [
+                r for r in _ACTIVE_POOLS if r() is not None
+            ]
+            _ACTIVE_POOLS.append(weakref.ref(self))
+
+    # -- worker process management ------------------------------------------
+    def _worker_cfg(self, worker_id: int) -> str:
+        rp = self.read_plane
+        return json.dumps({
+            "kind": self.kind,
+            "host": self.host,
+            "port": self.port,
+            "primary_port": self.primary_port,
+            "gen_path": self.generation.path,
+            "worker_id": worker_id,
+            "rate_limit": list(self.rate_limit) if self.rate_limit
+                          else None,
+            "broker_path": (self.broker.path
+                            if self.broker and not self.auth_required
+                            else None),
+            "corpus_seg": (rp.paths["corpus"]
+                           if rp and not self.auth_required else None),
+            "adjacency_seg": (rp.paths["adjacency"]
+                              if rp and not self.auth_required else None),
+        })
+
+    def _spawn(self, worker_id: int) -> subprocess.Popen:
+        # the package may live off sys.path-only locations (sys.path
+        # edits don't propagate to subprocesses) — point the worker at
+        # wherever THIS nornicdb_tpu was imported from
+        import nornicdb_tpu
+
+        pkg_parent = os.path.dirname(os.path.dirname(
+            os.path.abspath(nornicdb_tpu.__file__)))
+        env = dict(os.environ)
+        env["PYTHONPATH"] = pkg_parent + (
+            os.pathsep + env["PYTHONPATH"] if env.get("PYTHONPATH") else ""
+        )
+        return subprocess.Popen(
+            [sys.executable, "-m", "nornicdb_tpu.server.worker_main",
+             self._worker_cfg(worker_id)],
+            stdin=subprocess.DEVNULL,
+            env=env,
+        )
 
     def start(self) -> "WorkerPool":
-        for i in range(self.n_workers):
-            cfg = json.dumps({
-                "kind": self.kind,
-                "host": self.host,
-                "port": self.port,
-                "primary_port": self.primary_port,
-                "gen_path": self.generation.path,
-                "worker_id": i,
-                "rate_limit": list(self.rate_limit) if self.rate_limit
-                              else None,
-            })
-            # the package may live off sys.path-only locations (sys.path
-            # edits don't propagate to subprocesses) — point the worker at
-            # wherever THIS nornicdb_tpu was imported from
-            import nornicdb_tpu
-
-            pkg_parent = os.path.dirname(os.path.dirname(
-                os.path.abspath(nornicdb_tpu.__file__)))
-            env = dict(os.environ)
-            env["PYTHONPATH"] = pkg_parent + (
-                os.pathsep + env["PYTHONPATH"] if env.get("PYTHONPATH") else ""
+        # spawn OUTSIDE the proc lock (Popen is process I/O; the monitor
+        # polls under this lock — NL-LK02)
+        procs = [self._spawn(i) for i in range(self.n_workers)]
+        with self._proc_lock:
+            self._procs.extend(procs)
+        if self._respawn and self._monitor is None:
+            self._monitor = threading.Thread(
+                target=self._monitor_loop, name="nornicdb-pool-monitor",
+                daemon=True,
             )
-            p = subprocess.Popen(
-                [sys.executable, "-m", "nornicdb_tpu.server.worker_main", cfg],
-                stdin=subprocess.DEVNULL,
-                env=env,
-            )
-            self._procs.append(p)
+            self._monitor.start()
         return self
 
+    def _monitor_loop(self) -> None:
+        """Respawn crashed workers: a kill -9 (or an OOM) during a fault
+        window must cost capacity for under a second, not until restart."""
+        while not self._stopping.wait(0.25):
+            with self._proc_lock:
+                procs = list(enumerate(self._procs))
+            for i, p in procs:
+                if p is None or p.poll() is None:
+                    continue
+                if self._stopping.is_set():
+                    return
+                log.warning(
+                    "worker %d (pid %s) exited with %s; respawning",
+                    i, p.pid, p.returncode,
+                )
+                try:
+                    fresh = self._spawn(i)
+                except OSError:
+                    log.exception("worker %d respawn failed", i)
+                    continue
+                with self._proc_lock:
+                    if self._stopping.is_set():
+                        fresh.terminate()
+                        return
+                    self._procs[i] = fresh
+                    self.respawns += 1
+
     def alive(self) -> int:
-        return sum(1 for p in self._procs if p.poll() is None)
+        with self._proc_lock:
+            return sum(
+                1 for p in self._procs if p is not None and p.poll() is None
+            )
+
+    def kill_worker(self, index: int = 0) -> Optional[int]:
+        """SIGKILL one worker (crash injection for tests and the soak
+        harness's worker_kill fault). Returns the killed pid."""
+        with self._proc_lock:
+            if index >= len(self._procs) or self._procs[index] is None:
+                return None
+            p = self._procs[index]
+        if p.poll() is not None:
+            return None
+        p.send_signal(signal.SIGKILL)
+        return p.pid
+
+    def stats(self) -> dict:
+        out = {
+            "kind": self.kind,
+            "port": self.port,
+            "n_workers": self.n_workers,
+            "alive": self.alive(),
+            "respawns": self.respawns,
+        }
+        if self.broker is not None:
+            out["broker"] = self.broker.stats()
+        if self.read_plane is not None:
+            out["read_plane"] = self.read_plane.stats()
+        return out
 
     def stop(self) -> None:
-        for p in self._procs:
+        self._stopping.set()
+        if self._monitor is not None:
+            self._monitor.join(timeout=5)
+            self._monitor = None
+        with self._proc_lock:
+            procs = [p for p in self._procs if p is not None]
+            self._procs.clear()
+        for p in procs:
             p.terminate()
-        for p in self._procs:
+        for p in procs:
             try:
                 p.wait(timeout=5)
             except subprocess.TimeoutExpired:
                 p.kill()
-        self._procs.clear()
         if self._reserved is not None:
             self._reserved.close()
             self._reserved = None
+        if self.broker is not None and self._own_broker:
+            self.broker.stop()
+        _release_read_plane(self._db, self.read_plane)
+        self.read_plane = None
         if self._bump_cb is not None and self._db is not None:
             # unhook before closing the mmap: a leaked listener would write
             # to a closed buffer on every later mutation
@@ -515,12 +946,26 @@ class WorkerPool:
                             exc_info=True)
             self._bump_cb = None
         self.generation.close()
+        # remove our temp workdir ONLY when nothing shared still lives in
+        # it: another pool on the same db may hold the refcounted read
+        # plane whose segments are rooted here
+        if self._own_workdir and getattr(
+                self._db, "_read_plane_publisher", None) is None:
+            import shutil
+
+            shutil.rmtree(self._workdir, ignore_errors=True)
 
 
 def _subproc_entry(argv: list[str]) -> None:
     cfg = json.loads(argv[0])
     gen = GenerationFile(cfg["gen_path"])
     rl = tuple(cfg["rate_limit"]) if cfg.get("rate_limit") else None
+    read_path = None
+    if cfg.get("broker_path") or cfg.get("corpus_seg"):
+        read_path = WorkerReadPath(
+            cfg.get("broker_path"), cfg.get("corpus_seg"),
+            cfg.get("adjacency_seg"),
+        )
     main = _http_worker_main if cfg["kind"] == "http" else _grpc_worker_main
     main(cfg["host"], cfg["port"], cfg["primary_port"], gen,
-         cfg["worker_id"], rate_limit=rl)
+         cfg["worker_id"], rate_limit=rl, read_path=read_path)

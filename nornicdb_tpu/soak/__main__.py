@@ -10,6 +10,7 @@ from __future__ import annotations
 import argparse
 import dataclasses
 import logging
+import os
 import sys
 import tempfile
 
@@ -29,6 +30,9 @@ def main(argv: list[str] | None = None) -> int:
                     help="report artifact path (default: SOAK_report.json)")
     ap.add_argument("--workdir", default="",
                     help="working directory (default: fresh tempdir)")
+    ap.add_argument("--no-multiworker", action="store_true",
+                    help="skip the multiworker phase the ci scenario adds "
+                         "on multi-core runners")
     ap.add_argument("-v", "--verbose", action="store_true")
     args = ap.parse_args(argv)
 
@@ -45,25 +49,43 @@ def main(argv: list[str] | None = None) -> int:
     if args.seed is not None:
         spec = dataclasses.replace(spec, seed=args.seed)
 
-    print(f"soak: scenario={spec.name} seed={spec.seed} "
-          f"duration={spec.duration_s:.0f}s faults={len(spec.faults)}",
-          flush=True)
-    if args.workdir:
-        report = run_scenario(spec, args.workdir, args.report)
-    else:
-        with tempfile.TemporaryDirectory(prefix="nornicdb-soak-") as wd:
-            report = run_scenario(spec, wd, args.report)
+    # the ci profile proves multi-process serving too, when the runner has
+    # the cores for it: the multiworker scenario (prefork pool + worker
+    # kills + backend hang) runs as a second gating phase
+    specs = [spec]
+    if (spec.name == "ci" and not args.no_multiworker
+            and (os.cpu_count() or 1) > 1):
+        specs.append(SCENARIOS["multiworker"])
 
-    for r in report.invariants:
-        mark = "PASS" if r.ok else "FAIL"
-        print(f"  [{mark}] {r.name}" + (f" — {r.detail}" if r.detail else ""))
-    for proto, summary in sorted(report.protocols.items()):
-        print(f"  {proto}: {summary['requests']} req "
-              f"p50={summary['p50_ms']}ms p99={summary['p99_ms']}ms "
-              f"outcomes={summary['outcomes']}")
-    print(f"soak: {'OK' if report.ok else 'INVARIANT VIOLATIONS'} "
-          f"in {report.wall_s:.1f}s; report -> {args.report}")
-    return 0 if report.ok else 1
+    ok = True
+    for i, sp in enumerate(specs):
+        report_path = args.report if i == 0 else (
+            args.report.replace(".json", "") + f"_{sp.name}.json"
+        )
+        print(f"soak: scenario={sp.name} seed={sp.seed} "
+              f"duration={sp.duration_s:.0f}s faults={len(sp.faults)}",
+              flush=True)
+        if args.workdir:
+            wd = os.path.join(args.workdir, sp.name) if i else args.workdir
+            os.makedirs(wd, exist_ok=True)
+            report = run_scenario(sp, wd, report_path)
+        else:
+            with tempfile.TemporaryDirectory(
+                    prefix="nornicdb-soak-") as wd:
+                report = run_scenario(sp, wd, report_path)
+
+        for r in report.invariants:
+            mark = "PASS" if r.ok else "FAIL"
+            print(f"  [{mark}] {r.name}"
+                  + (f" — {r.detail}" if r.detail else ""))
+        for proto, summary in sorted(report.protocols.items()):
+            print(f"  {proto}: {summary['requests']} req "
+                  f"p50={summary['p50_ms']}ms p99={summary['p99_ms']}ms "
+                  f"outcomes={summary['outcomes']}")
+        print(f"soak: {'OK' if report.ok else 'INVARIANT VIOLATIONS'} "
+              f"in {report.wall_s:.1f}s; report -> {report_path}")
+        ok = ok and report.ok
+    return 0 if ok else 1
 
 
 if __name__ == "__main__":

@@ -392,6 +392,80 @@ class BackendPlane(PlaneDriver):
 
 
 # ---------------------------------------------------------------------------
+# Workers plane: SIGKILL prefork protocol workers under load
+# ---------------------------------------------------------------------------
+class WorkersPlane(PlaneDriver):
+    """worker_kill: crash `count` workers at window start. There is no
+    clear action — the pool's monitor respawns them — so the post-window
+    probe IS the fault's contract: full strength back within a bound, and
+    a vector search through the pool port served by the device plane
+    (broker, or its shared-memory fallback while the backend is down)."""
+
+    def __init__(self, pool, vector_dim: int):
+        self.pool = pool
+        self.vector_dim = vector_dim
+        self.kills = 0
+
+    def start_fault(self, w: FaultWindow) -> None:
+        want = int(w.params.get("count", 1))
+        killed = 0
+        for i in range(self.pool.n_workers):
+            if killed >= want:
+                break
+            if self.pool.kill_worker(i) is not None:
+                killed += 1
+        self.kills += killed
+        if killed < want:
+            raise RuntimeError(
+                f"worker_kill wanted {want}, only {killed} were running"
+            )
+
+    def clear_fault(self, w: FaultWindow) -> None:
+        pass  # respawn is the monitor's job; the probe asserts it happened
+
+    def post_window_probe(self, w: FaultWindow) -> Optional[str]:
+        deadline = time.monotonic() + 20.0
+        while time.monotonic() < deadline:
+            if self.pool.alive() >= self.pool.n_workers:
+                break
+            time.sleep(0.2)
+        if self.pool.alive() < self.pool.n_workers:
+            return (f"pool at {self.pool.alive()}/{self.pool.n_workers} "
+                    "workers 20s after worker_kill cleared")
+        # broker-reconnect probe: the respawned worker must answer a
+        # vector search through the device plane (fresh random vector so
+        # a pre-window cache hit can't fake it)
+        import random as _random
+
+        rng = _random.Random(int(w.at_s * 1000) + 17)
+        body = json.dumps({
+            "vector": [rng.uniform(-1, 1) for _ in range(self.vector_dim)],
+            "limit": 3,
+        }).encode()
+        last = ""
+        while time.monotonic() < deadline:
+            try:
+                req = urllib.request.Request(
+                    f"http://127.0.0.1:{self.pool.port}/nornicdb/search",
+                    data=body,
+                    headers={"Content-Type": "application/json"})
+                with urllib.request.urlopen(req, timeout=10) as resp:
+                    served = resp.headers.get("X-Nornic-Served", "")
+                    if resp.status == 200 and served in ("broker", "shm"):
+                        return None
+                    last = f"status={resp.status} served={served!r}"
+            except (OSError, ValueError) as e:
+                last = f"{type(e).__name__}: {e}"
+            time.sleep(0.3)
+        return f"respawned worker never served the device plane ({last})"
+
+    def stats(self) -> dict[str, Any]:
+        out = self.pool.stats()
+        out["kills"] = self.kills
+        return out
+
+
+# ---------------------------------------------------------------------------
 # Storage plane: deterministic WAL fault windows on the serving DB
 # ---------------------------------------------------------------------------
 class StoragePlane(PlaneDriver):
@@ -485,6 +559,28 @@ class SoakHarness:
                 grpc_srv.start()
             except ImportError:
                 self.notes.append("grpcio unavailable: gRPC plane skipped")
+        pool = None
+        if self.spec.workload.front_workers > 0:
+            # prefork worker pool fronting the HTTP surface: ALL workload
+            # HTTP traffic (including Qdrant-over-HTTP) goes through it,
+            # with vector search riding the device broker + shared-memory
+            # read plane (docs/operations.md "Multi-process serving")
+            from nornicdb_tpu.server.workers import WorkerPool
+
+            pool = WorkerPool(
+                db, http.port, n_workers=self.spec.workload.front_workers,
+            ).start()
+            deadline = time.monotonic() + 60
+            up = False
+            while time.monotonic() < deadline:
+                try:
+                    self._fetch(pool.port, "/health")
+                    up = True
+                    break
+                except OSError:
+                    time.sleep(0.25)
+            if not up:
+                raise RuntimeError("prefork workers never started listening")
         # the Qdrant workload needs its collection up front
         from nornicdb_tpu.soak.workload import VECTOR_DIM
 
@@ -497,7 +593,7 @@ class SoakHarness:
         with urllib.request.urlopen(req, timeout=10) as resp:
             if resp.status != 200:
                 raise RuntimeError("qdrant collection bootstrap failed")
-        return db, http, bolt, grpc_srv, serving_dir
+        return db, http, bolt, grpc_srv, pool, serving_dir
 
     def _fetch(self, port: int, path: str) -> bytes:
         with urllib.request.urlopen(
@@ -541,19 +637,26 @@ class SoakHarness:
         collector = Collector(t_start)
 
         backend_plane = BackendPlane()
-        db, http, bolt, grpc_srv, serving_dir = self._boot_stack()
+        db, http, bolt, grpc_srv, pool, serving_dir = self._boot_stack()
         repl = ReplicationPlane(self.workdir, spec.seed, collector,
                                 spec.workload.deadline_s)
         storage_plane = StoragePlane(
             db, os.path.join(serving_dir, "wal"))
-        scheduler = FaultScheduler(spec.faults, drivers={
+        drivers = {
             "replication": repl,
             "backend": backend_plane,
             "storage": storage_plane,
-        })
+        }
+        workers_plane = None
+        if pool is not None:
+            workers_plane = WorkersPlane(pool, spec.workload.vector_dim)
+            drivers["workers"] = workers_plane
+        scheduler = FaultScheduler(spec.faults, drivers=drivers)
         runner = WorkloadRunner(
             spec,
-            {"http": http.port, "bolt": bolt.port,
+            # the pool IS the HTTP surface when front_workers > 0
+            {"http": pool.port if pool is not None else http.port,
+             "bolt": bolt.port,
              "grpc": grpc_srv.port if grpc_srv is not None else 0},
             collector, spec.seed)
 
@@ -729,6 +832,42 @@ class SoakHarness:
                             "leader_wal_recovery",
                             f"node {chk['node']} missing {chk['missing']}"))
 
+            # worker-pool invariants: full strength + the device plane
+            # actually carried traffic (X-Nornic-Served counters live in
+            # the broker; a pool serving ONLY cache/proxy would pass
+            # liveness while silently abandoning the architecture)
+            if pool is not None and workers_plane is not None:
+                wstats = workers_plane.stats()
+                report.workers = wstats
+                n = spec.workload.front_workers
+                if wstats["alive"] < n:
+                    report.invariants.append(failed(
+                        "worker_pool_strength",
+                        f"{wstats['alive']}/{n} workers alive at soak end"))
+                elif workers_plane.kills and \
+                        wstats["respawns"] < workers_plane.kills:
+                    report.invariants.append(failed(
+                        "worker_pool_strength",
+                        f"{workers_plane.kills} kills but only "
+                        f"{wstats['respawns']} respawns"))
+                else:
+                    report.invariants.append(passed(
+                        "worker_pool_strength",
+                        f"{wstats['alive']}/{n} alive, "
+                        f"{wstats['respawns']} respawns for "
+                        f"{workers_plane.kills} kills"))
+                broker_ok = wstats.get("broker", {}).get(
+                    "counters", {}).get("search_ok", 0)
+                if broker_ok > 0:
+                    report.invariants.append(passed(
+                        "broker_served_traffic",
+                        f"{broker_ok} vector searches served through the "
+                        "device broker"))
+                else:
+                    report.invariants.append(failed(
+                        "broker_served_traffic",
+                        "no vector search ever rode the broker"))
+
             report.backend = backend_plane.stats()
             report.replication = repl.stats()
 
@@ -737,6 +876,8 @@ class SoakHarness:
             runner.stop_event.set()
             scheduler.stop()
             _STORAGE_FAULTS.disarm()
+            if pool is not None:
+                pool.stop()
             if grpc_srv is not None:
                 grpc_srv.stop()
             bolt.stop()

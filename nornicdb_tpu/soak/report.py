@@ -125,6 +125,7 @@ class SoakReport:
     storage_faults: dict[str, float] = field(default_factory=dict)
     backend: dict[str, Any] = field(default_factory=dict)
     replication: dict[str, Any] = field(default_factory=dict)
+    workers: dict[str, Any] = field(default_factory=dict)
     notes: list[str] = field(default_factory=list)
     wall_s: float = 0.0
 
@@ -144,6 +145,7 @@ class SoakReport:
             "storage_faults": self.storage_faults,
             "backend": self.backend,
             "replication": self.replication,
+            "workers": self.workers,
             "notes": self.notes,
         }
 

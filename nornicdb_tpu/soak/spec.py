@@ -20,7 +20,7 @@ import json
 from dataclasses import asdict, dataclass, field
 from typing import Any
 
-PLANES = ("replication", "backend", "storage")
+PLANES = ("replication", "backend", "storage", "workers")
 
 # plane -> legal fault kinds (validated at spec load so a typo'd scenario
 # fails before it burns five minutes of soak time)
@@ -34,6 +34,10 @@ KINDS = {
     ),
     "backend": ("hang", "fail", "slow"),   # FakeHooks modes; recovers at end
     "storage": ("fsync_fail", "torn_tail", "enospc"),  # params: {"count": n}
+    # prefork worker pool (needs workload.front_workers > 0): SIGKILL
+    # `count` workers at window start; the pool monitor must respawn them
+    # and the respawned workers must reconnect to the device broker
+    "workers": ("worker_kill",),  # params: {"count": n}
 }
 
 
@@ -70,6 +74,15 @@ class WorkloadSpec:
     # answers through the genserve continuous-batching engine
     generate_workers: int = 1
     replication_writers: int = 1
+    # prefork protocol workers fronting the HTTP surface (0 = traffic hits
+    # the primary directly, the pre-PR-12 stacks). With front_workers > 0
+    # ALL HTTP traffic — including Qdrant-over-HTTP — goes through the
+    # worker pool's SO_REUSEPORT port, and the pool's device broker +
+    # shared-memory read plane serve the vector path.
+    front_workers: int = 0
+    # raw-vector search op mixed into the HTTP traffic (0 disables): the
+    # dimensionality must match the serving stack's embedder
+    vector_dim: int = 0
     # client-side bound on every request; exceeding deadline+grace wall
     # time is an invariant violation (a wedged call, not a slow one)
     deadline_s: float = 5.0
@@ -175,6 +188,26 @@ CI = ScenarioSpec(
     drain_s=4.0,
 )
 
+# The multi-process serving scenario: mixed traffic through a prefork
+# worker pool (front_workers) while workers are SIGKILLed mid-load and the
+# backend hangs — proving worker respawn, broker reconnect, and the
+# shared-memory host-search fallback under fire.  Runs as part of the CI
+# soak when the runner has more than one core (soak/__main__.py).
+MULTIWORKER = ScenarioSpec(
+    name="multiworker", seed=20260804, duration_s=30.0,
+    workload=WorkloadSpec(
+        http_workers=2, bolt_workers=1, grpc_workers=0, qdrant_workers=1,
+        generate_workers=0, replication_writers=1,
+        front_workers=2, vector_dim=64, think_s=0.01,
+    ),
+    faults=(
+        FaultWindow(6.0, 4.0, "workers", "worker_kill", {"count": 1}),
+        FaultWindow(14.0, 5.0, "backend", "hang", {}),
+        FaultWindow(21.0, 2.0, "workers", "worker_kill", {"count": 1}),
+    ),
+    drain_s=6.0,
+)
+
 # tier-1 micro profile: seconds, one window per plane, tiny budgets
 MICRO = ScenarioSpec(
     name="micro", seed=7, duration_s=8.0,
@@ -190,4 +223,5 @@ MICRO = ScenarioSpec(
     drain_s=3.0,
 )
 
-SCENARIOS = {"full": FULL, "ci": CI, "micro": MICRO}
+SCENARIOS = {"full": FULL, "ci": CI, "micro": MICRO,
+             "multiworker": MULTIWORKER}

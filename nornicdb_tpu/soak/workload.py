@@ -297,16 +297,28 @@ class WorkloadRunner:
                         "parameters": {"uid": uid},
                     }], deadline)
                     self._record("http", "traverse", outcome, t0, detail)
-                elif roll < 0.8:  # vector search
+                elif roll < 0.8:  # search: hybrid text, or raw-vector
+                    vdim = getattr(self.spec.workload, "vector_dim", 0)
+                    if vdim and rng.random() < 0.5:
+                        # raw-vector search: THE worker-servable shape —
+                        # behind a front_workers pool this rides the
+                        # device broker (or its shared-memory fallback)
+                        # instead of proxying to the primary
+                        body = {"vector": [rng.uniform(-1, 1)
+                                           for _ in range(vdim)],
+                                "limit": 5}
+                        op = "vector_search"
+                    else:
+                        body = {"query":
+                                f"soak query {rng.randint(0, 50)}",
+                                "limit": 5}
+                        op = "search"
                     status, payload = _http_json(
-                        base, "/nornicdb/search",
-                        {"query": f"soak query {rng.randint(0, 50)}",
-                         "limit": 5},
-                        deadline)
+                        base, "/nornicdb/search", body, deadline)
                     outcome, detail = _classify_http(status, payload)
                     if outcome == "ok" and "results" not in payload:
                         outcome, detail = "error", "search: no results key"
-                    self._record("http", "search", outcome, t0, detail)
+                    self._record("http", op, outcome, t0, detail)
                 else:  # embed
                     status, payload = _http_json(
                         base, "/nornicdb/embed",

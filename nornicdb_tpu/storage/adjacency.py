@@ -677,6 +677,41 @@ class AdjacencySnapshot:
             dist[frontier] = level
         return dist
 
+    def export_arrays(self) -> Optional[tuple[dict, dict]]:
+        """Merged, self-contained copies of the CSR arrays + vocab for the
+        cross-process shared-memory read plane (server/readplane.py).
+
+        Returns ``(arrays, vocab)`` or None when the snapshot was never
+        built. Any pending delta/tombstones are folded first (exports are
+        infrequent relative to merges), so readers need no delta-overlay
+        logic: the exported CSR alone answers every expansion the
+        in-process snapshot would — the twin-path equivalence the worker
+        traversal tests assert."""
+        with self._lock:
+            if not self._built:
+                return None
+            if self._pending or self._tombstones:
+                self._merge_locked()
+            arrays = {
+                "out_off": self._out_off.copy(),
+                "out_nbr": self._out_nbr.copy(),
+                "out_rows": self._out_rows.copy(),
+                "in_off": self._in_off.copy(),
+                "in_nbr": self._in_nbr.copy(),
+                "in_rows": self._in_rows.copy(),
+                "erow_type": self._erow_type.copy(),
+                "row_alive": self._row_alive.copy(),
+                "node_alive": np.asarray(self._alive, bool),
+            }
+            vocab = {
+                "ids": list(self._ids),
+                "row_ids": list(self._row_ids),
+                "type_names": list(self._type_names),
+                "generation": self._generation,
+                "n_csr": self._n_csr,
+            }
+        return arrays, vocab
+
     # -- derived views ------------------------------------------------------
     def edge_arrays(self) -> EdgeArraysView:
         """Sorted-id (ids, index, src, dst) projection — the `_edge_arrays`

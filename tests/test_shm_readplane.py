@@ -1,0 +1,356 @@
+"""Shared-memory read plane tests: generation-stamped segments, seqlock
+torn-read behavior, and the twin-path equivalence contracts — a worker
+serving from the shared corpus / adjacency segments must return results
+bit-identical to the primary's in-process paths, including across a
+mid-read generation-bump remap."""
+
+import os
+import struct
+import threading
+
+import numpy as np
+import pytest
+
+from nornicdb_tpu.ops.similarity import DeviceCorpus
+from nornicdb_tpu.server.readplane import (
+    ReadPlanePublisher,
+    SharedAdjacencyReader,
+    SharedCorpusReader,
+    export_adjacency_segment,
+    export_corpus_segment,
+    pack_strings,
+    unpack_strings,
+)
+from nornicdb_tpu.server.shm import (
+    SegmentReader,
+    SegmentUnavailable,
+    SegmentWriter,
+)
+from nornicdb_tpu.storage import MemoryEngine
+from nornicdb_tpu.storage.adjacency import attach_snapshot
+from nornicdb_tpu.storage.types import Edge, Node
+
+
+# ---------------------------------------------------------------- segments
+class TestSegments:
+    def test_publish_and_map_roundtrip(self, tmp_path):
+        w = SegmentWriter(str(tmp_path / "t.seg"), "corpus")
+        a = np.arange(12, dtype=np.float32).reshape(3, 4)
+        b = np.array([True, False, True])
+        gen = w.publish({"a": a, "b": b}, {"k": "v"})
+        assert gen == 1
+        r = SegmentReader(str(tmp_path / "t.seg"), "corpus")
+        snap = r.snapshot()
+        assert snap.generation == 1
+        assert snap.meta == {"k": "v"}
+        np.testing.assert_array_equal(snap.arrays["a"], a)
+        np.testing.assert_array_equal(snap.arrays["b"], b)
+        w.close()
+
+    def test_views_are_readonly(self, tmp_path):
+        w = SegmentWriter(str(tmp_path / "t.seg"), "corpus")
+        w.publish({"a": np.zeros(4, np.float32)}, {})
+        snap = SegmentReader(str(tmp_path / "t.seg"), "corpus").snapshot()
+        with pytest.raises((ValueError, RuntimeError)):
+            snap.arrays["a"][0] = 1.0
+
+    def test_remap_on_generation_bump_keeps_old_views_valid(self, tmp_path):
+        """The mid-read remap contract: a reader holding generation N's
+        arrays keeps reading stable data while the writer publishes (and
+        unlinks) N+1; its next snapshot() returns N+1."""
+        w = SegmentWriter(str(tmp_path / "t.seg"), "corpus")
+        w.publish({"a": np.full(8, 1.0, np.float32)}, {"gen": 1})
+        r = SegmentReader(str(tmp_path / "t.seg"), "corpus")
+        old = r.snapshot()
+        old_view = old.arrays["a"]
+        w.publish({"a": np.full(8, 2.0, np.float32)}, {"gen": 2})
+        # the old payload file is unlinked on disk now; the mapping lives
+        assert not os.path.exists(str(tmp_path / "t.seg") + ".g1")
+        np.testing.assert_array_equal(old_view, np.full(8, 1.0, np.float32))
+        fresh = r.snapshot()
+        assert fresh.generation == 2
+        np.testing.assert_array_equal(
+            fresh.arrays["a"], np.full(8, 2.0, np.float32)
+        )
+        assert r.remaps == 1
+
+    def test_unpublished_prefix_raises(self, tmp_path):
+        r = SegmentReader(str(tmp_path / "never.seg"), "corpus")
+        with pytest.raises(SegmentUnavailable):
+            r.snapshot()
+
+    def test_header_exists_but_no_generation(self, tmp_path):
+        w = SegmentWriter(str(tmp_path / "t.seg"), "corpus")
+        r = SegmentReader(str(tmp_path / "t.seg"), "corpus")
+        with pytest.raises(SegmentUnavailable):
+            r.snapshot()  # header present, generation still 0
+        w.close()
+
+    def test_torn_header_is_never_served(self, tmp_path):
+        """Seqlock discipline: a header frozen mid-publish (odd sequence)
+        must fail the map, not serve a torn generation/length pair."""
+        w = SegmentWriter(str(tmp_path / "t.seg"), "corpus")
+        w.publish({"a": np.zeros(4, np.float32)}, {})
+        # simulate a writer dying mid-publish: force the sequence odd
+        w._hdr[0:8] = struct.pack("<Q", 7)
+        r = SegmentReader(str(tmp_path / "t.seg"), "corpus")
+        with pytest.raises(SegmentUnavailable):
+            r.snapshot()
+        # writer recovers (even sequence again): reads come back
+        w._hdr[0:8] = struct.pack("<Q", 8)
+        assert r.snapshot().generation == 1
+
+    def test_concurrent_publish_and_read_never_tears(self, tmp_path):
+        """Hammer publish on one thread while readers remap on others:
+        every mapped snapshot must be internally consistent (the payload
+        checksum meta matches the array contents)."""
+        w = SegmentWriter(str(tmp_path / "t.seg"), "corpus")
+        stop = threading.Event()
+        errors = []
+
+        def writer():
+            i = 0
+            while not stop.is_set():
+                i += 1
+                arr = np.full(64, float(i), np.float32)
+                w.publish({"a": arr}, {"value": i})
+
+        def reader():
+            r = SegmentReader(str(tmp_path / "t.seg"), "corpus")
+            while not stop.is_set():
+                try:
+                    snap = r.snapshot()
+                except SegmentUnavailable:
+                    continue  # racing the very first publish
+                a = snap.arrays["a"]
+                if not np.all(a == float(snap.meta["value"])):
+                    errors.append(
+                        (snap.generation, snap.meta, float(a[0]))
+                    )
+
+        threads = [threading.Thread(target=writer)] + [
+            threading.Thread(target=reader) for _ in range(3)
+        ]
+        for t in threads:
+            t.start()
+        import time
+
+        time.sleep(1.0)
+        stop.set()
+        for t in threads:
+            t.join(10)
+        assert not errors, f"torn reads observed: {errors[:3]}"
+
+    def test_pack_unpack_strings(self):
+        strs = ["", "a", "héllo", None, "z" * 1000]
+        data, off = pack_strings(strs)
+        assert unpack_strings(data, off) == ["", "a", "héllo", "", "z" * 1000]
+
+
+# ---------------------------------------------------------------- corpus
+def _build_corpus(n=200, dims=32, seed=0):
+    rng = np.random.default_rng(seed)
+    c = DeviceCorpus(dims=dims)
+    for i in range(n):
+        v = rng.normal(size=dims).astype(np.float32)
+        v /= np.linalg.norm(v)
+        c.add(f"id{i}", v)
+    return c, rng
+
+
+class TestSharedCorpus:
+    def test_twin_path_bit_identical(self, tmp_path):
+        """Shared-segment host search == the primary's host path, bit for
+        bit (same slot layout, same tie rule, same epilogue)."""
+        corpus, rng = _build_corpus()
+        w = SegmentWriter(str(tmp_path / "c.seg"), "corpus")
+        w.publish(*export_corpus_segment(corpus))
+        reader = SharedCorpusReader(str(tmp_path / "c.seg"))
+        for k in (1, 5, 100):
+            q = rng.normal(size=(4, 32)).astype(np.float32)
+            got = reader.search(q, k=k)
+            want = corpus._search_host(np.atleast_2d(q), k, -1.0)
+            assert got == want
+
+    def test_twin_path_after_removals_and_overwrites(self, tmp_path):
+        corpus, rng = _build_corpus()
+        for i in range(0, 50, 3):
+            corpus.remove(f"id{i}")
+        v = rng.normal(size=32).astype(np.float32)
+        corpus.add("id60", v / np.linalg.norm(v))  # in-place overwrite
+        w = SegmentWriter(str(tmp_path / "c.seg"), "corpus")
+        w.publish(*export_corpus_segment(corpus))
+        reader = SharedCorpusReader(str(tmp_path / "c.seg"))
+        q = rng.normal(size=(3, 32)).astype(np.float32)
+        assert reader.search(q, k=10) == \
+            corpus._search_host(np.atleast_2d(q), 10, -1.0)
+
+    def test_min_similarity_filter_matches(self, tmp_path):
+        corpus, rng = _build_corpus()
+        w = SegmentWriter(str(tmp_path / "c.seg"), "corpus")
+        w.publish(*export_corpus_segment(corpus))
+        reader = SharedCorpusReader(str(tmp_path / "c.seg"))
+        q = rng.normal(size=32).astype(np.float32)
+        assert reader.search(q, k=50, min_similarity=0.2) == \
+            corpus._search_host(np.atleast_2d(q), 50, 0.2)
+
+    def test_mid_read_generation_bump_remap(self, tmp_path):
+        """A reader that searched at generation N keeps getting coherent
+        results while the writer publishes N+1 with different rows, and
+        its next search reflects N+1 — bit-identical to the primary at
+        the same generation."""
+        corpus, rng = _build_corpus(n=50)
+        w = SegmentWriter(str(tmp_path / "c.seg"), "corpus")
+        w.publish(*export_corpus_segment(corpus))
+        reader = SharedCorpusReader(str(tmp_path / "c.seg"))
+        q = rng.normal(size=(2, 32)).astype(np.float32)
+        before = reader.search(q, k=5)
+        assert before == corpus._search_host(np.atleast_2d(q), 5, -1.0)
+        # mutate + republish (generation bump) mid-"session"
+        for i in range(20):
+            v = rng.normal(size=32).astype(np.float32)
+            corpus.add(f"new{i}", v / np.linalg.norm(v))
+        corpus.remove("id3")
+        w.publish(*export_corpus_segment(corpus))
+        after = reader.search(q, k=5)
+        assert after == corpus._search_host(np.atleast_2d(q), 5, -1.0)
+        assert reader._reader.remaps == 1
+
+    def test_int8_mirror_consistent_with_quantize_rows(self, tmp_path):
+        """The exported int8 block must be the SAME quantization the
+        device mirror uses (codes identical, scales within a float ulp)."""
+        corpus, _ = _build_corpus(n=64)
+        arrays, _meta = export_corpus_segment(corpus)
+        from nornicdb_tpu.ops.pallas_kernels import quantize_rows
+
+        dev_codes, dev_scales = quantize_rows(corpus.export_host_state()["rows"])
+        np.testing.assert_array_equal(
+            arrays["rows_i8"], np.asarray(dev_codes)
+        )
+        np.testing.assert_allclose(
+            arrays["scales_i8"], np.asarray(dev_scales), rtol=1e-6
+        )
+
+    def test_int8_search_close_to_f32(self, tmp_path):
+        corpus, rng = _build_corpus()
+        w = SegmentWriter(str(tmp_path / "c.seg"), "corpus")
+        w.publish(*export_corpus_segment(corpus))
+        reader = SharedCorpusReader(str(tmp_path / "c.seg"))
+        q = rng.normal(size=32).astype(np.float32)
+        exact = [i for i, _ in reader.search(q, k=10)[0]]
+        approx = [i for i, _ in
+                  reader.search(q, k=10, precision="int8")[0]]
+        # int8 is approximate: require high overlap, not identity
+        assert len(set(exact) & set(approx)) >= 8
+
+
+# ---------------------------------------------------------------- adjacency
+def _build_graph(n_nodes=25, n_edges=80, seed=7):
+    import random
+
+    rng = random.Random(seed)
+    eng = MemoryEngine()
+    for i in range(n_nodes):
+        eng.create_node(Node(id=f"n{i}", labels=["X"], properties={}))
+    for j in range(n_edges):
+        a, b = rng.sample(range(n_nodes), 2)
+        eng.create_edge(Edge(id=f"e{j}", start_node=f"n{a}",
+                             end_node=f"n{b}",
+                             type=rng.choice(["A", "B", "C"]),
+                             properties={}))
+    snap = attach_snapshot(eng)
+    assert snap.ensure()
+    return eng, snap
+
+
+class TestSharedAdjacency:
+    def test_twin_path_expansions_bit_identical(self, tmp_path):
+        _eng, snap = _build_graph()
+        w = SegmentWriter(str(tmp_path / "a.seg"), "adjacency")
+        w.publish(*export_adjacency_segment(snap))
+        reader = SharedAdjacencyReader(str(tmp_path / "a.seg"))
+        for i in range(25):
+            for direction in ("out", "in", "both"):
+                for types in (None, ["A"], ["A", "B"], ["nope"]):
+                    assert reader.expand_pairs(f"n{i}", direction, types) \
+                        == snap.expand_pairs(f"n{i}", direction, types)
+
+    def test_unknown_node_returns_none(self, tmp_path):
+        _eng, snap = _build_graph()
+        w = SegmentWriter(str(tmp_path / "a.seg"), "adjacency")
+        w.publish(*export_adjacency_segment(snap))
+        reader = SharedAdjacencyReader(str(tmp_path / "a.seg"))
+        assert reader.expand_pairs("ghost", "out") is None
+        assert snap.expand_pairs("ghost", "out") is None
+
+    def test_mid_read_generation_bump_remap(self, tmp_path):
+        """Traversals stay coherent across a republish: same answers as
+        the in-process snapshot before AND after a topology change that
+        bumps the exported generation."""
+        eng, snap = _build_graph()
+        w = SegmentWriter(str(tmp_path / "a.seg"), "adjacency")
+        w.publish(*export_adjacency_segment(snap))
+        reader = SharedAdjacencyReader(str(tmp_path / "a.seg"))
+        assert reader.expand_pairs("n0", "both") == \
+            snap.expand_pairs("n0", "both")
+        eng.create_edge(Edge(id="e_fresh", start_node="n0",
+                             end_node="n9", type="A", properties={}))
+        eng.delete_edge("e0")
+        w.publish(*export_adjacency_segment(snap))
+        assert reader.generation() == snap.generation()
+        for i in (0, 9):
+            assert reader.expand_pairs(f"n{i}", "both") == \
+                snap.expand_pairs(f"n{i}", "both")
+
+    def test_export_folds_pending_delta(self, tmp_path):
+        """Edges still sitting in the delta buffer must be visible through
+        the export (the reader has no delta-overlay logic by design)."""
+        eng, snap = _build_graph(n_edges=10)
+        eng.create_edge(Edge(id="delta_edge", start_node="n1",
+                             end_node="n2", type="A", properties={}))
+        exported = export_adjacency_segment(snap)
+        w = SegmentWriter(str(tmp_path / "a.seg"), "adjacency")
+        w.publish(*exported)
+        reader = SharedAdjacencyReader(str(tmp_path / "a.seg"))
+        pairs = reader.expand_pairs("n1", "out", ["A"])
+        assert ("delta_edge", "n2") in pairs
+        assert pairs == snap.expand_pairs("n1", "out", ["A"])
+
+
+# ---------------------------------------------------------------- publisher
+class TestPublisher:
+    def test_publishes_on_epoch_change_only(self, tmp_path):
+        corpus, rng = _build_corpus(n=20)
+        pub = ReadPlanePublisher(
+            str(tmp_path / "rp"), corpus_fn=lambda: corpus,
+            interval=10.0,  # manual ticks only
+        )
+        assert "corpus" in pub.publish_now()
+        assert pub.publish_now() == {}  # nothing moved
+        v = rng.normal(size=32).astype(np.float32)
+        corpus.add("fresh", v / np.linalg.norm(v))
+        assert "corpus" in pub.publish_now()
+        pub.stop()
+
+    def test_adjacency_published_and_readable(self, tmp_path):
+        _eng, snap = _build_graph()
+        pub = ReadPlanePublisher(
+            str(tmp_path / "rp"), corpus_fn=lambda: None,
+            adjacency_fn=lambda: snap, interval=10.0,
+        )
+        assert "adjacency" in pub.publish_now()
+        reader = SharedAdjacencyReader(pub.paths["adjacency"])
+        assert reader.expand_pairs("n0", "both") == \
+            snap.expand_pairs("n0", "both")
+        pub.stop()
+
+    def test_stats_shape(self, tmp_path):
+        corpus, _ = _build_corpus(n=20)
+        pub = ReadPlanePublisher(
+            str(tmp_path / "rp"), corpus_fn=lambda: corpus, interval=10.0,
+        )
+        pub.publish_now()
+        s = pub.stats()
+        assert s["segments"]["corpus"]["generation"] == 1
+        assert s["segments"]["corpus"]["payload_bytes"] > 0
+        pub.stop()

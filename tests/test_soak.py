@@ -29,6 +29,7 @@ from nornicdb_tpu.soak.spec import (
     CI,
     FULL,
     MICRO,
+    MULTIWORKER,
     SCENARIOS,
     FaultWindow,
     ScenarioSpec,
@@ -42,11 +43,18 @@ class TestScenarioSpec:
         assert 55 <= CI.duration_s <= 65
         for spec in SCENARIOS.values():
             planes = {w.plane for w in spec.faults}
-            assert planes == {"replication", "backend", "storage"}, (
-                f"{spec.name} must compose all three fault planes")
+            if spec.name == "multiworker":
+                # the multi-process scenario: worker kills composed with a
+                # backend outage (broker DEGRADED → shared-memory fallback)
+                assert planes == {"workers", "backend"}
+                assert spec.workload.front_workers > 0
+                assert spec.workload.vector_dim > 0
+            else:
+                assert planes == {"replication", "backend", "storage"}, (
+                    f"{spec.name} must compose all three fault planes")
 
     def test_json_round_trip(self):
-        for spec in (FULL, CI, MICRO):
+        for spec in (FULL, CI, MICRO, MULTIWORKER):
             again = ScenarioSpec.from_json(spec.to_json())
             assert again == spec
 
