@@ -13,12 +13,12 @@ lint-baseline:
 
 # runtime lock sanitizer over the threaded suites (docs/linting.md#nornsan)
 sanitize:
-	NORNSAN=1 python -m pytest tests/test_concurrency.py tests/test_replication.py tests/test_replication_scenarios.py tests/test_nornsan.py tests/test_adjacency.py tests/test_telemetry.py tests/test_backend.py tests/test_sharded_serving.py tests/test_serving.py tests/test_genserve.py tests/test_broker.py tests/test_shm_readplane.py tests/test_workers.py -q -m 'not slow'
+	NORNSAN=1 python -m pytest tests/test_concurrency.py tests/test_replication.py tests/test_replication_scenarios.py tests/test_nornsan.py tests/test_adjacency.py tests/test_telemetry.py tests/test_backend.py tests/test_sharded_serving.py tests/test_int8_residency.py tests/test_ivf_tuner.py tests/test_serving.py tests/test_genserve.py tests/test_broker.py tests/test_shm_readplane.py tests/test_workers.py -q -m 'not slow'
 
 # search/embed suite with the accelerator backend forced to hang: the
 # lifecycle manager must keep the stack serving from CPU (docs/backend.md)
 chaos:
-	NORNICDB_FAKE_BACKEND=hang NORNICDB_DEVICE_ACQUIRE_TIMEOUT=2 python -m pytest tests/test_embed_search.py tests/test_search_unit_depth.py tests/test_sharded_serving.py tests/test_serving.py tests/test_genserve.py tests/test_broker.py tests/test_shm_readplane.py tests/test_workers.py -q -m 'not slow'
+	NORNICDB_FAKE_BACKEND=hang NORNICDB_DEVICE_ACQUIRE_TIMEOUT=2 python -m pytest tests/test_embed_search.py tests/test_search_unit_depth.py tests/test_sharded_serving.py tests/test_int8_residency.py tests/test_ivf_tuner.py tests/test_serving.py tests/test_genserve.py tests/test_broker.py tests/test_shm_readplane.py tests/test_workers.py -q -m 'not slow'
 
 # live-server /metrics + /admin/traces smoke (docs/observability.md)
 smoke:
@@ -57,8 +57,13 @@ bench:
 	python scripts/bench_embed.py
 	python scripts/bench_generate.py
 
+# passthrough: `make bench-search ROWS=10000000 DIMS=64 MODE=exact,ivf
+# BACKENDS=sharded_int8` regenerates the artifact at any scale; the
+# committed BENCH_search.json carries a 10M-row int8-resident run plus
+# the trajectory sizes. Exit invariants include the recall floor and the
+# int8 exact-rescore bit-match (docs/operations.md "Recall tuning").
 bench-search:
-	python scripts/bench_search.py
+	python scripts/bench_search.py $(if $(ROWS),--rows $(ROWS)) $(if $(DIMS),--dims $(DIMS)) $(if $(MODE),--mode $(MODE)) $(if $(BACKENDS),--backends $(BACKENDS)) $(BENCH_SEARCH_ARGS)
 
 # ragged-packed vs padded fixed-batch embedding throughput at mixed text
 # lengths (writes BENCH_embed.json; asserts the one-program-per-packed-

@@ -245,10 +245,27 @@ class SearchTuningConfig:
     backend: str = "auto"
     sharded_min_rows: int = 100_000
     # recall knobs: exact full-sort, per-shard candidate oversampling,
-    # IVF probe count (0 = full scan)
+    # IVF probe count (0 = tuner-governed; explicit values bypass the
+    # recall eval gate — debugging only, see docs/operations.md
+    # "Recall tuning")
     exact: bool = False
     local_k: int = 0
     n_probe: int = 0
+    # recall-governed IVF autotuning: operators set the floor, the tuner
+    # measures and picks (n_probe, local_k); floors it can't meet serve
+    # full scan (nornicdb_ivf_tunes_total{outcome="floor_unmet"})
+    recall_target: float = 0.95
+    tune_enabled: bool = True
+    tune_sample: int = 64
+    tune_k: int = 100
+    tune_min_rows: int = 4096
+    drift_threshold: float = 0.25
+    cluster_fit_sample: int = 262_144
+    # int8 compressed residency for the sharded corpus: device holds int8
+    # codes + scales (≈4x rows/HBM byte), merged candidates exact-rescored
+    # in f32 from the host mirror (oversampled rescore_factor × k)
+    int8_residency: bool = False
+    rescore_factor: int = 4
     # micro-batching + write-behind sync (PR 2)
     batching_enabled: bool = False
     batch_window: float = 0.002

@@ -117,6 +117,7 @@ class DB:
         self._genserve = None
         self._graphrag = None
         self._vectorspaces = None
+        self._qdrant = None
         if self.config.decay_enabled:
             _ = self.decay  # starts the periodic recalculation ticker
 
@@ -258,6 +259,33 @@ class DB:
 
                 self._vectorspaces = VectorSpaceRegistry()
             return self._vectorspaces
+
+    def qdrant_registry(self):
+        """The ONE QdrantCollections registry for this db: the HTTP
+        /collections/* surface, the Qdrant gRPC services, and the device
+        broker's worker-side search path must share it — per-transport
+        registries would each build their own per-collection device
+        corpora (double residency) and drift on upserts (ref: the
+        reference's "single unified vector index", pkg/qdrantgrpc
+        server.go).
+
+        Constructed OUTSIDE the db lock (the `search` property's
+        pattern): the registry rebuild scans every persisted point and
+        builds per-collection device corpora — seconds on a large point
+        set, and every db-lock user would stall behind it. Losers of the
+        creation race discard their registry before it serves anything."""
+        with self._lock:
+            if self._qdrant is not None:
+                return self._qdrant
+        from nornicdb_tpu.server.qdrant import QdrantCollections
+
+        registry = QdrantCollections(
+            self.storage, vectorspaces=self.vectorspaces
+        )
+        with self._lock:
+            if self._qdrant is None:
+                self._qdrant = registry
+            return self._qdrant
 
     @property
     def query_cache(self):

@@ -114,13 +114,50 @@ def format_topk_results(
     return out
 
 
+def rescore_rows(rows: np.ndarray, qn: np.ndarray) -> np.ndarray:
+    """Deterministic exact f32 dot of each row with a NORMALIZED query.
+
+    This — not a BLAS call — is the canonical f32 rescore: BLAS GEMM/GEMV
+    kernels change their summation order with the call's shape (measured:
+    the same (row, query) dot differs in the last ulp between M=5 and
+    M=512 gemv at D>=64), so two differently-shaped calls cannot
+    bit-agree. NumPy's pairwise ``sum`` over a fixed D is shape-
+    independent, so every consumer of this function — the int8-residency
+    rescore epilogue, score_subset's host twin, the bench's rescore
+    invariant — produces bit-identical scores for the same (row, query)
+    regardless of candidate-set size."""
+    return (np.asarray(rows, np.float32) * qn).sum(
+        axis=1, dtype=np.float32
+    ).astype(np.float32)
+
+
 def host_score_rows(
     query: np.ndarray, corpus: np.ndarray, rows: np.ndarray
 ) -> np.ndarray:
     """Exact re-score of candidate rows (host twin of
-    ops.similarity.score_subset); query is normalized first."""
+    ops.similarity.score_subset); query is normalized first. Scores come
+    from the deterministic ``rescore_rows`` kernel, so they bit-match the
+    int8-residency rescore path for the same rows."""
     q = np.asarray(query, np.float32).reshape(-1)
     n = float(np.linalg.norm(q))
     if n > 1e-12:
         q = q / n
-    return corpus[rows] @ q
+    return rescore_rows(corpus[rows], q)
+
+
+def quantize_rows_np(rows: np.ndarray) -> tuple[np.ndarray, np.ndarray]:
+    """Per-row symmetric int8 quantization on the host: the one definition
+    of the int8 mirror contract, shared by the compressed-residency upload
+    path (parallel.ShardedCorpus), the shared-memory read plane's
+    ``rows_i8``/``scales_i8`` export, and anything else that must agree
+    bit-for-bit with the device kernels' quantization.
+
+    Matches ops.pallas_kernels.quantize_rows exactly in the codes
+    (np.round and jnp.round are both round-half-to-even) and to within a
+    float ulp in the scales: x ~= int8 / scale."""
+    r = np.asarray(rows, np.float32)
+    scale = (127.0 / np.maximum(np.max(np.abs(r), axis=1), 1e-9)).astype(
+        np.float32
+    )
+    codes = np.round(r * scale[:, None]).astype(np.int8)
+    return codes, scale

@@ -264,6 +264,13 @@ class ShardedIVFLayout:
     parallel.sharded_index (kept there — this module stays mesh-agnostic;
     the device arrays arrive pre-placed via the shardings the caller
     passes in).
+
+    ``quantized=True`` stores the blocks (and residual) as int8 codes with
+    per-row dequant MULTIPLIERS (1/scale, 0 for pad rows) so the block
+    array costs 1 byte/element instead of 4 — the compressed-residency
+    twin of ShardedCorpus's int8 serving mode. Device scores then carry
+    int8 rounding noise; the corpus rescores the merged candidate set
+    exactly from its host f32 mirror.
     """
 
     blocks: jax.Array        # (S, K, Cmax, D) zero-padded, P(axis,...)
@@ -277,6 +284,10 @@ class ShardedIVFLayout:
     k: int                   # cluster count
     n_shards: int
     epoch: int               # corpus layout epoch at build time
+    quantized: bool = False
+    # int8 mode only: per-row dequant multipliers (0 = dead/pad row)
+    block_scales: Optional[jax.Array] = None     # (S, K, Cmax) f32
+    residual_scales: Optional[jax.Array] = None  # (S, Rmax) f32
 
     @property
     def n_rows(self) -> int:
@@ -298,6 +309,7 @@ def build_sharded_ivf_layout(
     dtype=jnp.float32,
     epoch: int = 0,
     max_block_factor: float = 2.0,
+    quantize: bool = False,
 ) -> ShardedIVFLayout:
     """Build the per-shard inverted lists.
 
@@ -308,6 +320,8 @@ def build_sharded_ivf_layout(
     shard_sharding: NamedSharding partitioning the leading shard axis
         (trailing dims replicated) — placed on every (S, ...) array;
     replicated_sharding: NamedSharding for the replicated centroids.
+    quantize: store blocks/residual as int8 codes + per-row dequant
+        multipliers (compressed residency — see ShardedIVFLayout).
     """
     n, d = rows.shape
     k = centroids.shape[0]
@@ -335,27 +349,45 @@ def build_sharded_ivf_layout(
     starts = np.concatenate(([0], np.cumsum(counts_all)[:-1]))
     rank = np.arange(sorted_pair.size) - starts[sorted_pair]
     in_block = rank < cmax
-    blocks = np.zeros((n_shards, k, cmax, d), np.float32)
+    if quantize:
+        from nornicdb_tpu.ops.host_search import quantize_rows_np
+
+        # one pass over the live rows; the scatter then moves 1-byte codes
+        # plus a (row,) multiplier column instead of f32 row copies
+        codes_v, scale_v = quantize_rows_np(rows_v)
+        mult_v = (1.0 / np.maximum(scale_v, 1e-30)).astype(np.float32)
+        store_v = codes_v
+        blocks = np.zeros((n_shards, k, cmax, d), np.int8)
+        block_scales = np.zeros((n_shards, k, cmax), np.float32)
+    else:
+        store_v = rows_v
+        blocks = np.zeros((n_shards, k, cmax, d), np.float32)
+        block_scales = None
     slotmap = np.full((n_shards, k, cmax), -1, np.int32)
     s_idx = (sorted_pair // k)[in_block]
     c_idx = (sorted_pair % k)[in_block]
     p_idx = rank[in_block]
-    blocks[s_idx, c_idx, p_idx] = rows_v[order][in_block]
+    blocks[s_idx, c_idx, p_idx] = store_v[order][in_block]
     slotmap[s_idx, c_idx, p_idx] = slots_v[order][in_block]
+    if quantize:
+        block_scales[s_idx, c_idx, p_idx] = mult_v[order][in_block]
     counts = np.minimum(
         counts_all.reshape(n_shards, k), cmax
     ).astype(np.int32)
     # per-shard residual spill, padded to a shared LANE-multiple width
-    spill_rows = rows_v[order][~in_block]
+    spill_rows = store_v[order][~in_block]
     spill_slots = slots_v[order][~in_block]
     spill_shard = (sorted_pair // k)[~in_block]
-    residual_dev = residual_slots_dev = None
+    residual_dev = residual_slots_dev = residual_scales_dev = None
     rmax = 0
     if spill_rows.shape[0]:
         per_shard = np.bincount(spill_shard, minlength=n_shards)
         rmax = ((int(per_shard.max()) + LANE - 1) // LANE) * LANE
-        residual = np.zeros((n_shards, rmax, d), np.float32)
+        residual = np.zeros((n_shards, rmax, d), spill_rows.dtype)
         residual_slots = np.full((n_shards, rmax), -1, np.int32)
+        residual_scales = (np.zeros((n_shards, rmax), np.float32)
+                           if quantize else None)
+        spill_mult = mult_v[order][~in_block] if quantize else None
         # spill rows are already grouped by shard (sorted by pair)
         for s in range(n_shards):
             m = spill_shard == s
@@ -363,15 +395,26 @@ def build_sharded_ivf_layout(
             if cnt:
                 residual[s, :cnt] = spill_rows[m]
                 residual_slots[s, :cnt] = spill_slots[m]
+                if quantize:
+                    residual_scales[s, :cnt] = spill_mult[m]
         residual_dev = jax.device_put(
-            jnp.asarray(residual, dtype=dtype), shard_sharding
+            jnp.asarray(residual) if quantize
+            else jnp.asarray(residual, dtype=dtype),
+            shard_sharding,
         )
         residual_slots_dev = jax.device_put(
             jnp.asarray(residual_slots), shard_sharding
         )
+        if quantize:
+            residual_scales_dev = jax.device_put(
+                jnp.asarray(residual_scales), shard_sharding
+            )
     return ShardedIVFLayout(
-        blocks=jax.device_put(jnp.asarray(blocks, dtype=dtype),
-                              shard_sharding),
+        blocks=jax.device_put(
+            jnp.asarray(blocks) if quantize
+            else jnp.asarray(blocks, dtype=dtype),
+            shard_sharding,
+        ),
         counts=jax.device_put(jnp.asarray(counts), shard_sharding),
         slotmap=jax.device_put(jnp.asarray(slotmap), shard_sharding),
         centroids=jax.device_put(jnp.asarray(centroids, dtype=dtype),
@@ -383,4 +426,9 @@ def build_sharded_ivf_layout(
         k=k,
         n_shards=n_shards,
         epoch=epoch,
+        quantized=quantize,
+        block_scales=(jax.device_put(jnp.asarray(block_scales),
+                                     shard_sharding)
+                      if quantize else None),
+        residual_scales=residual_scales_dev,
     )

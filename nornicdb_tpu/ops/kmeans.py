@@ -117,18 +117,59 @@ class KMeansResult:
     k: int
 
 
+_ASSIGN_CHUNK = 1 << 18  # rows per chunked full-set assignment program
+
+
 def kmeans_fit(
     data: np.ndarray,
     k: int = 0,
     iters: int = 10,
     seed: int = 0,
+    sample: int = 0,
 ) -> KMeansResult:
-    """Full fit: k-means++ init + Lloyd (ref: ClusterIndex.Cluster kmeans.go:232)."""
-    x = jnp.asarray(np.asarray(data, np.float32))
-    n = x.shape[0]
+    """Full fit: k-means++ init + Lloyd (ref: ClusterIndex.Cluster kmeans.go:232).
+
+    ``sample > 0`` caps the Lloyd fit at that many uniformly-sampled rows,
+    then assigns the FULL set against the fitted centroids in fixed-shape
+    chunks (one compiled program reused across chunks). At 10M×1024 a full
+    Lloyd pass is iters × N × K × D FLOPs — O(10^13) — while the sampled
+    fit plus one chunked assignment sweep is ~50x cheaper with centroid
+    quality statistically indistinguishable for recall purposes (the IVF
+    tuner measures the layout that comes out either way)."""
+    x_np = np.ascontiguousarray(np.asarray(data, np.float32))
+    n = x_np.shape[0]
     if k <= 0:
         k = optimal_k(n)
     k = min(k, n)
+    if sample and n > sample and sample >= k:
+        rng = np.random.default_rng(seed)
+        pick = rng.choice(n, size=sample, replace=False)
+        sub = kmeans_fit(x_np[pick], k=k, iters=iters, seed=seed)
+        cent = jnp.asarray(sub.centroids)
+        assignments = np.empty(n, np.int32)
+        d = x_np.shape[1]
+        for s in range(0, n, _ASSIGN_CHUNK):
+            e = min(s + _ASSIGN_CHUNK, n)
+            blk = x_np[s:e]
+            if e - s < _ASSIGN_CHUNK:
+                # pad the tail to a power-of-two bucket, not the full
+                # chunk: a few-thousand-row tail (or a barely-over-sample
+                # corpus) must not materialize a mostly-zero 256k×D block;
+                # the jit caches O(log chunk) shapes either way
+                bucket = 1 << max(0, (e - s - 1).bit_length())
+                blk = np.concatenate(
+                    [blk, np.zeros((bucket - (e - s), d), np.float32)]
+                )
+            assignments[s:e] = np.asarray(
+                assign_clusters(jnp.asarray(blk), cent)
+            )[: e - s]
+        return KMeansResult(
+            centroids=sub.centroids,
+            assignments=assignments,
+            drift=sub.drift,
+            k=sub.k,
+        )
+    x = jnp.asarray(x_np)
     key = jax.random.PRNGKey(seed)
     init = kmeans_pp_init(key, x, k)
     centroids, assign, drift = lloyd(x, init, k, iters)

@@ -190,6 +190,75 @@ def topk_backend(
     )
 
 
+@functools.partial(jax.jit, static_argnames=("k",))
+def cosine_topk_int8_xla(
+    queries: jax.Array,
+    c_i8: jax.Array,
+    c_scale: jax.Array,
+    valid: jax.Array,
+    k: int,
+) -> tuple[jax.Array, jax.Array]:
+    """XLA fallback scoring over an int8-resident corpus: dequantize the
+    codes into the bf16 GEMM (int8 values are exactly representable in
+    bf16), apply the per-row dequant multiplier in the f32 epilogue.
+
+    Engages where the streaming int8 Pallas kernel doesn't (non-TPU
+    backends, small corpora, tile-indivisible shard slices). Queries stay
+    f32/bf16 — only the CORPUS is quantized, so candidate membership is at
+    least as accurate as the both-sides-int8 kernel. Always approximate:
+    there is deliberately NO exact int8 device mode — the recall-1.0
+    contract is served from the host f32 mirror, and served scores come
+    from the caller's exact f32 host rescore either way."""
+    scores = jax.lax.dot_general(
+        queries.astype(jnp.bfloat16),
+        c_i8.astype(jnp.bfloat16),
+        dimension_numbers=(((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    ) / jnp.maximum(c_scale, 1e-9)[None, :]
+    scores = jnp.where(valid[None, :], scores, -jnp.inf)
+    return jax.lax.approx_max_k(scores, k, recall_target=0.95)
+
+
+def topk_backend_int8(
+    queries: jax.Array,
+    c_i8: jax.Array,
+    c_scale: jax.Array,
+    valid: jax.Array,
+    k: int,
+    streaming: Optional[bool] = None,
+) -> tuple[jax.Array, jax.Array]:
+    """Top-k dispatch for an int8-RESIDENT corpus (no f32/bf16 device copy
+    exists — compressed residency, 4x the rows per HBM byte). On TPU at
+    scale the streaming int8 Pallas bin-reduce kernel runs the MXU at the
+    int8 rate over the codes; elsewhere the XLA dequant-GEMM fallback.
+    ``c_scale`` follows the quantize_rows convention (x ~= int8 / scale).
+    Candidate scores are approximate (int8 + bf16 noise); callers rescore
+    the candidate set exactly from the host f32 mirror."""
+    from nornicdb_tpu.ops.pallas_kernels import (
+        _on_tpu,
+        pick_tile_n,
+        quantize_rows,
+        streaming_cosine_topk_int8,
+        streaming_rows_for,
+    )
+
+    n = int(c_i8.shape[0])
+    on_tpu = _on_tpu()
+    if streaming is None:
+        streaming = on_tpu and n >= STREAMING_MIN_ROWS
+    if streaming:
+        tile = pick_tile_n(n)
+        rows = min(streaming_rows_for(k, tile), max(n // tile, 1))
+        if n % tile == 0 and rows * tile >= k:
+            q_i8, q_scale = quantize_rows(queries)
+            return streaming_cosine_topk_int8(
+                q_i8, q_scale, c_i8, c_scale, valid,
+                min(k, n), tile_n=tile, rows=rows,
+                interpret=not on_tpu, epilogue=TOPK_EPILOGUE,
+            )
+    return cosine_topk_int8_xla(queries, c_i8, c_scale, valid, min(k, n))
+
+
 @functools.partial(jax.jit, static_argnames=("use_bf16",))
 def score_subset(
     query: jax.Array, corpus: jax.Array, indices: jax.Array, use_bf16: bool = True
@@ -779,6 +848,15 @@ class HostCorpus:
         Writers briefly queue behind a degraded-mode scan — correctness
         over throughput while the accelerator is down."""
         self._backend_mgr().note_fallback("search")
+        return self._host_exact_topk(q, k, min_similarity)
+
+    def _host_exact_topk(
+        self, q: np.ndarray, k: int, min_similarity: float
+    ) -> list[list[tuple[str, float]]]:
+        """Exact f32 top-k over the host arrays — the scoring core of
+        ``_search_host``, reusable without the degraded-fallback accounting
+        (the int8-resident corpus serves its ``exact=True`` contract here:
+        quantized device membership can't be exact, the host mirror is)."""
         norms = np.linalg.norm(q, axis=1, keepdims=True)
         qn = q / np.maximum(norms, 1e-12)
         with self._sync_lock:
@@ -1034,10 +1112,12 @@ class DeviceCorpus(HostCorpus):
         self._last_fit_host: Optional[tuple] = None
 
     # -- cluster pruning ----------------------------------------------------
-    def cluster(self, k: int = 0, iters: int = 10, seed: int = 0) -> int:
+    def cluster(self, k: int = 0, iters: int = 10, seed: int = 0,
+                sample: int = 0) -> int:
         """Fit k-means over live rows (ref: ClusterIndex.Cluster kmeans.go:232).
         Returns the cluster count; 0 when nothing was installed (too few
-        rows, or the corpus mutated underneath the fit).
+        rows, or the corpus mutated underneath the fit).  ``sample`` caps
+        the Lloyd fit (ops.kmeans.kmeans_fit) for very large corpora.
 
         The fit itself runs outside the lock (it can take seconds at
         scale); install is optimistic: snapshot the rows + layout epoch
@@ -1065,7 +1145,7 @@ class DeviceCorpus(HostCorpus):
             ):
                 mask |= self._layout_slots
             self._layout_slots = mask
-        res = kmeans_fit(data, k=k, iters=iters, seed=seed)
+        res = kmeans_fit(data, k=k, iters=iters, seed=seed, sample=sample)
         # H2D transfer OUTSIDE the lock (NL-DEV01): only the pointer
         # install runs in the critical section
         centroids_dev = jnp.asarray(res.centroids, dtype=self.dtype)

@@ -35,6 +35,16 @@ n_probe clusters in-program), inverted lists are per-shard
 build-time epoch matches the corpus layout epoch (PR 2's invalidation
 contract — covered-row overwrites and slot remaps kill it, plain
 adds/removes don't).
+
+int8 compressed residency (``quantized=True``): device HBM holds int8
+codes + per-row scales instead of f32 rows (≈4x the rows per HBM byte;
+the IVF block array quantizes too), candidate selection oversamples
+``rescore_factor × k`` per query, and the merged candidate set is
+exact-rescored in f32 from the host mirror — served (id, score) pairs
+bit-match the deterministic f32 rescore (ops.host_search.rescore_rows).
+The f32 truth never leaves the host; WindVE's CPU↔accelerator split as a
+storage policy (PAPERS.md). docs/operations.md "Recall tuning" has the
+memory math.
 """
 
 from __future__ import annotations
@@ -52,6 +62,7 @@ import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from nornicdb_tpu.errors import DeviceUnavailable
+from nornicdb_tpu.ops.host_search import quantize_rows_np, rescore_rows
 from nornicdb_tpu.ops.ivf import _next_pow2
 from nornicdb_tpu.ops.similarity import (
     _SHARD_LOCALK_OVERFLOWS,
@@ -69,6 +80,7 @@ from nornicdb_tpu.ops.similarity import (
     l2_normalize,
     merge_topk,
     topk_backend,
+    topk_backend_int8,
 )
 from nornicdb_tpu.parallel.mesh import make_mesh, shard_map_compat
 
@@ -140,28 +152,81 @@ def _sharded_search(
 
 @functools.partial(
     jax.jit,
-    static_argnames=("k", "n_probe", "axis", "mesh_static", "has_residual"),
+    static_argnames=("k", "local_k", "axis", "mesh_static", "streaming"),
+)
+def _sharded_search_int8(
+    queries: jax.Array,   # (B, D) f32 L2-normalized, replicated
+    codes: jax.Array,     # (N, D) int8 corpus codes, sharded on N
+    scales: jax.Array,    # (N,) f32 quantize_rows scales, sharded
+    valid: jax.Array,     # (N,) bool, sharded
+    k: int,
+    local_k: int,
+    axis: str,
+    mesh_static: Mesh,
+    streaming: Optional[bool] = None,
+):
+    """Compressed-residency sharded search: each shard scores its int8
+    code slice (streaming int8 Pallas kernel on TPU, dequant-GEMM XLA
+    fallback elsewhere) — no f32/bf16 corpus copy exists on device. Same
+    all-gather merge and (vals, global_idx) wire format as the dense
+    program; candidate scores carry int8 noise and the caller rescores
+    the merged set exactly from the host f32 mirror."""
+
+    def shard_fn(q, c8, sc, v):
+        local_n = c8.shape[0]
+        n_shards = mesh_static.shape[axis]
+        lk = max(1, min(local_k, local_n))
+        vals, idx = topk_backend_int8(q, c8, sc, v, lk, streaming=streaming)
+        shard = jax.lax.axis_index(axis)
+        gidx = idx + shard * local_n
+        gidx = jnp.where(jnp.isfinite(vals), gidx, -1)
+        vals_all = jax.lax.all_gather(vals, axis)
+        idx_all = jax.lax.all_gather(gidx, axis)
+        return merge_topk(vals_all, idx_all, min(k, lk * n_shards))
+
+    return shard_map_compat(
+        shard_fn,
+        mesh=mesh_static,
+        in_specs=(P(), P(axis, None), P(axis), P(axis)),
+        out_specs=(P(), P()),
+    )(queries, codes, scales, valid)
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("k", "n_probe", "axis", "mesh_static", "has_residual",
+                     "quantized"),
 )
 def _sharded_ivf_topk(
     queries: jax.Array,        # (B, D) L2-normalized, replicated
     centroids: jax.Array,      # (K, D) replicated
-    blocks: jax.Array,         # (S, K, Cmax, D) sharded on S
+    blocks: jax.Array,         # (S, K, Cmax, D) sharded on S (int8 when
+                               # quantized)
     counts: jax.Array,         # (S, K) sharded
     slotmap: jax.Array,        # (S, K, Cmax) GLOBAL slots, sharded
     residual: jax.Array,       # (S, Rmax, D) sharded (dummy when absent)
     residual_slots: jax.Array,  # (S, Rmax) sharded (dummy when absent)
+    block_scales: jax.Array,   # (S, K, Cmax) f32 dequant multipliers
+                               # (dummy unless quantized)
+    residual_scales: jax.Array,  # (S, Rmax) f32 (dummy unless quantized)
     k: int,
     n_probe: int,
     axis: str,
     mesh_static: Mesh,
     has_residual: bool,
+    quantized: bool,
 ):
     """Fused sharded IVF: replicated centroid probe → per-shard block
     gather + bf16 scoring → per-shard residual scan → local top-k over
     GLOBAL slots → all_gather merge.  One device dispatch per batch, same
-    wire format ((vals, global_slot) pairs) as the dense sharded path."""
+    wire format ((vals, global_slot) pairs) as the dense sharded path.
 
-    def shard_fn(q, cent, blk, cnt, smap, res, rslots):
+    ``quantized=True``: the blocks hold int8 codes (exactly representable
+    in bf16, so the same einsum runs) and the per-row dequant multiplier
+    rides the f32 epilogue — dead/pad rows carry multiplier 0 and are
+    masked by the live-count test anyway."""
+
+    def shard_fn(q, cent, blk, cnt, smap, res, rslots, bsc, rsc):
         blk, cnt, smap = blk[0], cnt[0], smap[0]
         cmax = blk.shape[1]
         cscores = dot_scores(q, cent)                 # (B, K), replicated
@@ -173,6 +238,8 @@ def _sharded_ivf_topk(
             gathered.astype(jnp.bfloat16),
             preferred_element_type=jnp.float32,
         )
+        if quantized:
+            scores = scores * bsc[0][probes]           # (B, P, Cmax)
         live = jnp.arange(cmax)[None, None, :] < cnt[probes][:, :, None]
         scores = jnp.where(live, scores, -jnp.inf)
         cand = smap[probes]                            # (B, P, Cmax)
@@ -182,6 +249,8 @@ def _sharded_ivf_topk(
         if has_residual:
             r, rs = res[0], rslots[0]
             rscores = dot_scores(q, r)
+            if quantized:
+                rscores = rscores * rsc[0][None, :]
             rscores = jnp.where((rs >= 0)[None, :], rscores, -jnp.inf)
             flat_v = jnp.concatenate([flat_v, rscores], axis=1)
             flat_s = jnp.concatenate(
@@ -197,12 +266,16 @@ def _sharded_ivf_topk(
         return merge_topk(vals_all, slots_all, min(k, kk * n_shards))
 
     rspec = P(axis) if has_residual else P()
+    bspec = P(axis) if quantized else P()
+    rsspec = P(axis) if (quantized and has_residual) else P()
     return shard_map_compat(
         shard_fn,
         mesh=mesh_static,
-        in_specs=(P(), P(), P(axis), P(axis), P(axis), rspec, rspec),
+        in_specs=(P(), P(), P(axis), P(axis), P(axis), rspec, rspec,
+                  bspec, rsspec),
         out_specs=(P(), P()),
-    )(queries, centroids, blocks, counts, slotmap, residual, residual_slots)
+    )(queries, centroids, blocks, counts, slotmap, residual, residual_slots,
+      block_scales, residual_scales)
 
 
 @dataclass
@@ -215,8 +288,10 @@ class ShardStats:
     rebalances: int = 0          # grow/compact/recovery full re-shards
     local_k_overflows: int = 0   # approx merges saturated by one shard
     promotions: int = 0          # auto single-device -> sharded swaps
+    rescored_queries: int = 0    # int8-residency queries exact-rescored
     last_dispatch_s: float = 0.0
     last_merge_s: float = 0.0
+    last_rescore_s: float = 0.0
     rows_per_shard: list = field(default_factory=list)
 
     def as_dict(self) -> dict:
@@ -246,7 +321,18 @@ class ShardedCorpus(HostCorpus):
         dtype=jnp.bfloat16,
         compact_ratio: float = 0.3,
         backend=None,
+        quantized: bool = False,
+        rescore_factor: int = 4,
     ):
+        # int8 compressed residency (WindVE's CPU↔accelerator split as a
+        # storage policy): with quantized=True only int8 codes + per-row
+        # scales live on device (≈4x the rows per HBM byte; the f32 truth
+        # stays in the host mirror), candidate selection oversamples
+        # rescore_factor × k on device, and the merged candidate set is
+        # re-scored exactly in f32 from the host mirror — served scores
+        # bit-match the f32 exact path for the same ids.
+        self.quantized = bool(quantized)
+        self.rescore_factor = max(1, int(rescore_factor))
         # building a mesh enumerates devices — a COLD backend acquisition.
         # make_mesh gates through the BackendManager (bounded wait on its
         # worker thread) and raises DeviceUnavailable when degraded; the
@@ -267,6 +353,7 @@ class ShardedCorpus(HostCorpus):
         )
         self._dev = None
         self._dev_valid = None
+        self._dev_i8: Optional[tuple[jax.Array, jax.Array]] = None
         self._sharding = NamedSharding(self.mesh, P(self.axis, None))
         self._vsharding = NamedSharding(self.mesh, P(self.axis))
         self._repsharding = NamedSharding(self.mesh, P())
@@ -285,11 +372,40 @@ class ShardedCorpus(HostCorpus):
     # -- device sync -------------------------------------------------------
     # The generic HostCorpus._sync driver (dirty-block coalescing, deferred
     # compaction, patch-vs-full policy, stats) drives these two hooks.
+    def _device_ready(self) -> bool:
+        if self.quantized:
+            i8 = self._dev_i8
+            return i8 is not None and int(i8[0].shape[0]) == self.capacity
+        return super()._device_ready()
+
     def _upload_full(self) -> None:
         # NL-DEV01 suppressions: warm transfers under _sync_lock by design
         # (gated upstream by _sync's _device_ok_nowait; the mesh was
         # enumerated through the manager at construction) — same rationale
         # as DeviceCorpus._upload_full
+        if self.quantized:
+            # compressed residency: quantize on the HOST so the f32 corpus
+            # never materializes in device memory — the transfer and the
+            # resident footprint are both N*D bytes + 4N scales, 4x less
+            # than the f32 layout this mode exists to avoid
+            codes, scales = quantize_rows_np(self._host)
+            self._dev_i8 = (
+                jax.device_put(  # nornlint: disable=NL-DEV01
+                    jnp.asarray(codes),  # nornlint: disable=NL-DEV01
+                    self._sharding,
+                ),
+                jax.device_put(  # nornlint: disable=NL-DEV01
+                    jnp.asarray(scales),  # nornlint: disable=NL-DEV01
+                    self._vsharding,
+                ),
+            )
+            self._dev = None
+            self._dev_valid = jax.device_put(  # nornlint: disable=NL-DEV01
+                jnp.asarray(self._valid),  # nornlint: disable=NL-DEV01
+                self._vsharding,
+            )
+            self._update_shard_rows()
+            return
         self._dev = jax.device_put(  # nornlint: disable=NL-DEV01
             jnp.asarray(self._host, dtype=self.dtype),  # nornlint: disable=NL-DEV01
             self._sharding,
@@ -320,24 +436,49 @@ class ShardedCorpus(HostCorpus):
         start = np.int32(start_row)
         with _COLLECTIVE_DISPATCH_LOCK:
             patch = _patch_rows_donated if donate else _patch_rows
-            self._dev = jax.device_put(  # nornlint: disable=NL-DEV01
-                patch(self._dev,
-                      jnp.asarray(rows, dtype=self.dtype),  # nornlint: disable=NL-DEV01
-                      start),
-                self._sharding,
-            )
             vpatch = _patch_valid_donated if donate else _patch_valid
+            if self.quantized:
+                # requantize ONLY the patched rows on the host (per-row
+                # symmetric quantization is block-local by construction —
+                # the _requantize_rows contract of the single-device int8
+                # mirror) and patch codes + scales in place
+                codes, scales = quantize_rows_np(rows)
+                self._dev_i8 = (
+                    jax.device_put(  # nornlint: disable=NL-DEV01
+                        patch(self._dev_i8[0],
+                              jnp.asarray(codes),  # nornlint: disable=NL-DEV01
+                              start),
+                        self._sharding,
+                    ),
+                    jax.device_put(  # nornlint: disable=NL-DEV01
+                        vpatch(self._dev_i8[1],
+                               jnp.asarray(scales),  # nornlint: disable=NL-DEV01
+                               start),
+                        self._vsharding,
+                    ),
+                )
+            else:
+                self._dev = jax.device_put(  # nornlint: disable=NL-DEV01
+                    patch(self._dev,
+                          jnp.asarray(rows, dtype=self.dtype),  # nornlint: disable=NL-DEV01
+                          start),
+                    self._sharding,
+                )
             self._dev_valid = jax.device_put(  # nornlint: disable=NL-DEV01
                 vpatch(self._dev_valid,
                        jnp.asarray(valid_rows),  # nornlint: disable=NL-DEV01
                        start),
                 self._vsharding,
             )
-            # retire BOTH patches before releasing: the valid-mask patch is
+            # retire EVERY patch before releasing: the valid-mask patch is
             # its own collective program enqueued after the row patch — an
             # async collective still enqueueing while a search launches
             # reintroduces the race
-            self._dev.block_until_ready()  # nornlint: disable=NL-LK02
+            if self.quantized:
+                self._dev_i8[0].block_until_ready()  # nornlint: disable=NL-LK02
+                self._dev_i8[1].block_until_ready()  # nornlint: disable=NL-LK02
+            else:
+                self._dev.block_until_ready()  # nornlint: disable=NL-LK02
             self._dev_valid.block_until_ready()  # nornlint: disable=NL-LK02
 
     # -- shard lifecycle ---------------------------------------------------
@@ -417,6 +558,26 @@ class ShardedCorpus(HostCorpus):
             _SHARD_ROWS_GAUGE.labels(str(s)).set(float(n))
         return rows
 
+    def _device_bytes(self) -> int:
+        """Resident device bytes across the mesh (corpus + IVF layout):
+        the number the int8 residency math in docs/operations.md is
+        checked against."""
+        n = 0
+        for arr in (self._dev, self._dev_valid):
+            if arr is not None:
+                n += int(arr.size) * arr.dtype.itemsize
+        if self._dev_i8 is not None:
+            for arr in self._dev_i8:
+                n += int(arr.size) * arr.dtype.itemsize
+        sivf = self._sivf
+        if sivf is not None:
+            for arr in (sivf.blocks, sivf.counts, sivf.slotmap,
+                        sivf.centroids, sivf.residual, sivf.residual_slots,
+                        sivf.block_scales, sivf.residual_scales):
+                if arr is not None:
+                    n += int(arr.size) * arr.dtype.itemsize
+        return n
+
     def stats(self) -> dict:
         out = super().stats()
         rows = self._update_shard_rows()
@@ -426,6 +587,9 @@ class ShardedCorpus(HostCorpus):
             local_n=self.local_n,
             rows_per_shard=rows,
             ivf_fitted=self._sivf is not None,
+            quantized=self.quantized,
+            rescore_factor=self.rescore_factor,
+            device_bytes=self._device_bytes(),
         )
         out["shard"] = shard
         return out
@@ -436,12 +600,14 @@ class ShardedCorpus(HostCorpus):
         self._layout_slots = None
         self._pending_clusters = None
 
-    def cluster(self, k: int = 0, iters: int = 10, seed: int = 0) -> int:
+    def cluster(self, k: int = 0, iters: int = 10, seed: int = 0,
+                sample: int = 0) -> int:
         """Fit k-means over live rows and install the per-shard inverted
         lists.  Same optimistic-install dance as DeviceCorpus.cluster: the
         fit and the layout build (device transfers included) run OUTSIDE
         _sync_lock; a layout-epoch change during either voids the
-        install."""
+        install.  ``sample`` caps the Lloyd fit (ops.kmeans.kmeans_fit)
+        for 10M-row-class corpora."""
         from nornicdb_tpu.ops.kmeans import kmeans_fit
 
         if not self._device_gate():
@@ -460,7 +626,7 @@ class ShardedCorpus(HostCorpus):
             ):
                 mask |= self._layout_slots
             self._layout_slots = mask
-        res = kmeans_fit(data, k=k, iters=iters, seed=seed)
+        res = kmeans_fit(data, k=k, iters=iters, seed=seed, sample=sample)
         with self._sync_lock:
             if self._layout_epoch != epoch_at_read:
                 return 0  # slot space moved mid-fit: caller may recluster
@@ -548,22 +714,74 @@ class ShardedCorpus(HostCorpus):
             shard_sharding=self._vsharding,
             replicated_sharding=self._repsharding,
             dtype=self.dtype, epoch=epoch_at_read,
+            quantize=self.quantized,
         )
         with self._sync_lock:
             if self._layout_epoch != epoch_at_read:
                 return  # mutated mid-build: discard the stale layout
             self._sivf = layout
 
+    def _rescore_host(
+        self, q: np.ndarray, slots: np.ndarray, host: np.ndarray, k: int,
+    ) -> tuple[np.ndarray, np.ndarray]:
+        """Exact f32 re-score of device-selected candidates from the host
+        mirror: the epilogue that makes int8 residency serve EXACT scores.
+        ``host`` must be the array captured with the buffers the slots came
+        from (a racing compaction REBINDS self._host; the captured array
+        keeps the slot space the device scored). The gather runs under
+        _sync_lock because in-place overwrites mutate rows without
+        rebinding — same torn-read rule as _search_host.
+
+        Returns (vals (B, k), slots (B, k)) with -inf/-1 padding; ties
+        break by ascending slot, the host_topk/lax.top_k rule. Scores come
+        from ops.host_search.rescore_rows — the deterministic f32 kernel
+        score_subset's host twin uses — so the same (id, query) pair
+        rescored anywhere yields the same bits."""
+        norms = np.linalg.norm(q, axis=1, keepdims=True)
+        qn = (q / np.maximum(norms, 1e-12)).astype(np.float32)
+        b = q.shape[0]
+        out_v = np.full((b, k), -np.inf, np.float32)
+        out_s = np.full((b, k), -1, np.int64)
+        t0 = time.perf_counter()
+        # only the GATHER needs the lock (fancy indexing copies, so the
+        # torn-read hazard is the in-place overwrite during the copy);
+        # scoring + sorting run on the copies with no lock, so a batch's
+        # rescore epilogue never serializes writers or other searches
+        with self._sync_lock:
+            gathered = []
+            for qi in range(b):
+                sel = slots[qi][slots[qi] >= 0]
+                gathered.append((sel, host[sel] if sel.size else None))
+        for qi, (sel, rows_sel) in enumerate(gathered):
+            if rows_sel is None:
+                continue
+            scores = rescore_rows(rows_sel, qn[qi])
+            order = np.lexsort((sel, -scores))[:k]
+            out_v[qi, :order.size] = scores[order]
+            out_s[qi, :order.size] = sel[order]
+        self.shard_stats.rescored_queries += b
+        self.shard_stats.last_rescore_s = time.perf_counter() - t0
+        return out_v, out_s
+
     def _pruned_search(
         self, q: np.ndarray, k: int, min_similarity: float, n_probe: int,
+        local_k: int = 0,
     ) -> Optional[list[list[tuple[str, float]]]]:
         """Fused sharded IVF path; None when no valid layout is installed
-        (caller falls back to the full sharded scan — recall unaffected)."""
+        (caller falls back to the full sharded scan — recall unaffected).
+        ``local_k`` oversamples each shard's pre-merge contribution (the
+        per-shard top-k over its probed blocks + residual) past k — the
+        same recall knob it is on the dense path, here recovering true
+        neighbors a shard-local truncation at k would cut. With a
+        quantized layout the device program additionally oversamples
+        rescore_factor × k and the merged set is exact-rescored from the
+        host mirror before formatting."""
         with self._sync_lock:
             # a pending compaction would remap slots out from under the
             # layout's epoch check — run the sync first, like the dense path
             self._sync()
             ids = self._ids
+            host = self._host
             layout = self._sivf
             layout_ok = (
                 layout is not None and layout.epoch == self._layout_epoch
@@ -577,12 +795,16 @@ class ShardedCorpus(HostCorpus):
             q2 = np.concatenate(
                 [q, np.zeros((b_pad - b, q.shape[1]), np.float32)]
             )
-        k_prog = _next_pow2(max(k, 8))
-        qn = l2_normalize(jnp.asarray(q2, dtype=self.dtype))
+        quantized = layout.quantized
+        k_dev = k * self.rescore_factor if quantized else k
+        k_prog = _next_pow2(max(k_dev, local_k, 8))
+        qdtype = jnp.float32 if quantized else self.dtype
+        qn = l2_normalize(jnp.asarray(q2, dtype=qdtype))
         n_probe = max(1, min(n_probe, layout.k))
         has_res = layout.residual is not None
         dummy = jnp.zeros((1, 1), self.dtype)
         dummy_i = jnp.zeros((1, 1), jnp.int32)
+        dummy_f = jnp.zeros((1, 1), jnp.float32)
         t0 = time.perf_counter()
         with _COLLECTIVE_DISPATCH_LOCK:
             vals, slots = _sharded_ivf_topk(
@@ -590,22 +812,94 @@ class ShardedCorpus(HostCorpus):
                 layout.slotmap,
                 layout.residual if has_res else dummy,
                 layout.residual_slots if has_res else dummy_i,
+                layout.block_scales if quantized else dummy_f,
+                (layout.residual_scales if (quantized and has_res)
+                 else dummy_f),
                 k=k_prog, n_probe=n_probe, axis=self.axis,
                 mesh_static=self.mesh, has_residual=has_res,
+                quantized=quantized,
             )
-            vals_np = np.asarray(vals, np.float32)[:b, :k]
-            slots_np = np.asarray(slots)[:b, :k]
+            keep = max(k_dev, local_k)
+            vals_np = np.asarray(vals, np.float32)[:b, :keep]
+            slots_np = np.asarray(slots)[:b, :keep]
         t1 = time.perf_counter()
         self.shard_stats.ivf_dispatches += 1
         self.shard_stats.last_dispatch_s = t1 - t0
         _SHARDED_SEARCH_HIST.observe(t1 - t0)
+        if quantized:
+            vals_np, slots_np = self._rescore_host(q, slots_np, host, k)
         out = self._format_results(
-            vals_np, slots_np, b, k, min_similarity, ids=ids,
+            vals_np[:, :k], slots_np[:, :k], b, k, min_similarity, ids=ids,
         )
         merge_s = time.perf_counter() - t1
         self.shard_stats.last_merge_s = merge_s
         _SHARDED_MERGE_HIST.observe(merge_s)
         return out
+
+    def _quantized_search(
+        self, q: np.ndarray, k: int, min_similarity: float,
+        local_k: int, streaming: Optional[bool],
+    ) -> list[list[tuple[str, float]]]:
+        """Compressed-residency full scan: the int8 sharded program
+        selects rescore_factor × k candidates per query (one fused device
+        dispatch), then the merged set is exact-rescored from the host f32
+        mirror. Served (id, score) pairs bit-match the f32 exact path for
+        every returned id; only candidate MEMBERSHIP carries int8 noise,
+        which the oversample is sized to absorb."""
+        b = q.shape[0]
+        b_pad = _next_pow2(b)
+        q2 = q
+        if b_pad != b:
+            q2 = np.concatenate(
+                [q, np.zeros((b_pad - b, q.shape[1]), np.float32)]
+            )
+        # inline borrow (the _pruned_search idiom): the host mirror must be
+        # captured ATOMICALLY with the int8 buffers — a background
+        # compaction rebinds self._host, and slots of the old buffer
+        # resolved through the new array would read other rows' vectors
+        with self._sync_lock:
+            self._sync()
+            self._readers += 1
+            i8 = self._dev_i8
+            dev_valid = self._dev_valid
+            ids = self._ids
+            host = self._host
+        try:
+            if i8 is None or dev_valid is None:
+                raise DeviceUnavailable(
+                    "no resident int8 buffer (degraded)"
+                )
+            cap = int(i8[0].shape[0])
+            local_n = cap // self.n_shards
+            k_dev = min(_next_pow2(max(k * self.rescore_factor, 8)), cap)
+            lk = max(1, min(_next_pow2(max(k_dev, local_k, 8)), local_n))
+            qd = l2_normalize(jnp.asarray(q2, dtype=jnp.float32))
+            t0 = time.perf_counter()
+            with _COLLECTIVE_DISPATCH_LOCK:
+                _vals, idx = _sharded_search_int8(
+                    qd, i8[0], i8[1], dev_valid, k_dev, lk,
+                    self.axis, self.mesh, streaming=streaming,
+                )
+                # materialize inside the borrow + dispatch lock, same
+                # rationale as the dense path
+                idx_np = np.asarray(idx)[:b]
+            t1 = time.perf_counter()
+            self.shard_stats.dispatches += 1
+            self.shard_stats.last_dispatch_s = t1 - t0
+            _SHARDED_SEARCH_HIST.observe(t1 - t0)
+            if lk < local_n:
+                self._note_local_k_overflows(idx_np, lk, local_n)
+            vals_np, slots_np = self._rescore_host(q, idx_np, host, k)
+            out = self._format_results(
+                vals_np, slots_np, b, k, min_similarity, ids=ids,
+            )
+            merge_s = time.perf_counter() - t1
+            self.shard_stats.last_merge_s = merge_s
+            _SHARDED_MERGE_HIST.observe(merge_s)
+            return out
+        finally:
+            with self._sync_lock:
+                self._readers -= 1
 
     # -- search ------------------------------------------------------------
     def search(
@@ -626,7 +920,9 @@ class ShardedCorpus(HostCorpus):
         oversampling); exact=True gives recall 1.0 with tie-breaking
         identical to the single-device full scan.  n_probe > 0 with a
         fitted cluster index routes through the fused sharded IVF
-        program instead."""
+        program instead.  quantized=True corpora select candidates from
+        the int8 codes and exact-rescore the merged set from the host
+        f32 mirror (exact=True serves the host mirror directly)."""
         q = np.atleast_2d(np.asarray(queries, np.float32))
         if len(self._slot_of) == 0:
             return [[] for _ in range(q.shape[0])]
@@ -636,9 +932,21 @@ class ShardedCorpus(HostCorpus):
             return self._search_host(q, k, min_similarity)
         try:
             if n_probe > 0:
-                pruned = self._pruned_search(q, k, min_similarity, n_probe)
+                pruned = self._pruned_search(
+                    q, k, min_similarity, n_probe, local_k=local_k
+                )
                 if pruned is not None:
                     return pruned
+            if self.quantized:
+                if exact:
+                    # quantized device membership cannot honor the
+                    # recall-1.0 contract; the host f32 mirror can —
+                    # identical ids/scores/tie order to a DeviceCorpus
+                    # full sort, by construction
+                    return self._host_exact_topk(q, k, min_similarity)
+                return self._quantized_search(
+                    q, k, min_similarity, local_k, streaming
+                )
             b = q.shape[0]
             # power-of-two shape classes for batch, k, and local_k: the
             # program is shape-keyed jit over a collective, and the

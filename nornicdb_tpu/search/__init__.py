@@ -4,6 +4,7 @@ from nornicdb_tpu.search.bm25 import BM25Index, tokenize
 from nornicdb_tpu.search.fusion import adaptive_rrf_weights, apply_mmr, fuse_rrf
 from nornicdb_tpu.search.hnsw import HNSWIndex
 from nornicdb_tpu.search.service import SearchConfig, SearchService, SearchStats
+from nornicdb_tpu.search.tuner import IVFTuner, TuneState
 
 __all__ = [
     "BM25Index",
@@ -12,7 +13,9 @@ __all__ = [
     "apply_mmr",
     "fuse_rrf",
     "HNSWIndex",
+    "IVFTuner",
     "SearchConfig",
     "SearchService",
     "SearchStats",
+    "TuneState",
 ]

@@ -29,6 +29,7 @@ from nornicdb_tpu.ops.similarity import DeviceCorpus
 from nornicdb_tpu.search.bm25 import BM25Index
 from nornicdb_tpu.search.fusion import adaptive_rrf_weights, apply_mmr, fuse_rrf
 from nornicdb_tpu.search.hnsw import HNSWIndex
+from nornicdb_tpu.search.tuner import TUNE_OUTCOMES, IVFTuner, TuneState
 from nornicdb_tpu.storage.types import Engine, Node
 from nornicdb_tpu.telemetry.metrics import REGISTRY as _REGISTRY
 from nornicdb_tpu.telemetry.tracing import tracer as _tracer
@@ -84,8 +85,39 @@ class SearchConfig:
     # feature-flag-gated like the reference)
     rerank_enabled: bool = False
     rerank_candidates: int = 20
-    # IVF cluster pruning (ref: kmeans_candidate_gen.go): 0 = full scan
+    # IVF cluster pruning — EXPLICIT OVERRIDE ONLY (0 = tuner-governed).
+    # The supported operator contract is recall_target below: the tuner
+    # measures recall@tune_k of the fitted layout against exact ground
+    # truth at recluster/promotion time and picks the smallest
+    # (n_probe, local_k) meeting the floor. Setting n_probe here bypasses
+    # the eval gate — a hand-tuned speed knob with an unmeasured recall
+    # cost, the exact footgun the tuner exists to kill.
     n_probe: int = 0
+    # recall-governed IVF autotuning (search/tuner.py, TPU-KNN's
+    # recall-vs-FLOPs accounting): operators set the floor, never probe
+    # counts. A layout that can't meet the floor serves full scan and
+    # increments nornicdb_ivf_tunes_total{outcome="floor_unmet"}.
+    recall_target: float = 0.95
+    tune_enabled: bool = True
+    tune_sample: int = 64        # held-out corpus rows per measurement
+    tune_k: int = 100            # recall@k the floor is measured at
+    tune_min_rows: int = 4096    # below this, full scan is the right plan
+    # drift-triggered re-tune: fraction of the corpus mutated (adds +
+    # removes) since the last tune that schedules a background
+    # recluster + re-tune (0 disables)
+    drift_threshold: float = 0.25
+    # k-means fit sample cap for recluster (ops.kmeans.kmeans_fit): past
+    # this many live rows the Lloyd fit runs on a uniform sample and the
+    # full set chunk-assigns against the fitted centroids — at 10M×1024
+    # a full fit is an O(10^13)-FLOP pass the drift re-tune would
+    # otherwise pay in the background. 0 = always fit everything.
+    cluster_fit_sample: int = 262_144
+    # int8 compressed residency (sharded corpus only): device HBM holds
+    # int8 codes + per-row scales (≈4x rows per byte); the merged
+    # candidate set (rescore_factor × k oversample) is exact-rescored in
+    # f32 from the host mirror, so served scores stay exact
+    int8_residency: bool = False
+    rescore_factor: int = 4
     # micro-batching of concurrent searches into one device dispatch
     # (SURVEY §7 hard part f)
     batching_enabled: bool = False
@@ -195,6 +227,13 @@ class SearchService:
         # "done", "unavailable" (single device / promotion disabled)
         self._promo_state: Optional[str] = None
         self._promo_retry_at = 0.0
+        # recall-governed IVF tuner state (search/tuner.py): the serving
+        # plan (n_probe/local_k) + its measured-recall evidence, plus the
+        # drift bookkeeping that schedules background re-tunes
+        self._tune_state: Optional[TuneState] = None
+        self.tune_counts: dict[str, int] = {o: 0 for o in TUNE_OUTCOMES}
+        self._churn_since_tune = 0
+        self._retuning = False
 
     # -- index plumbing ----------------------------------------------------
     def _ensure_vector_index(self, dims: int) -> None:
@@ -225,7 +264,11 @@ class SearchService:
                 # DeviceCorpus full scan, and DeviceCorpus stores f32.
                 # bf16 sharding stays an explicit opt-in for direct
                 # constructor callers chasing peak MXU FLOP/s.
-                corpus = ShardedCorpus(dims=dims, dtype=jnp.float32)
+                corpus = ShardedCorpus(
+                    dims=dims, dtype=jnp.float32,
+                    quantized=self.config.int8_residency,
+                    rescore_factor=self.config.rescore_factor,
+                )
             except DeviceUnavailable:
                 logger.warning(
                     "backend degraded: sharded corpus unavailable, "
@@ -299,6 +342,7 @@ class SearchService:
         # acquisition): promote to the sharded mesh path once the corpus
         # outgrows one chip (backend="auto", docs/operations.md)
         self._maybe_promote_sharded()
+        self._note_churn()
 
     def remove_node(self, node_id: str) -> None:
         with self._lock:
@@ -311,6 +355,7 @@ class SearchService:
             if self._hnsw is not None:
                 self._hnsw.remove(node_id)
             self.stats.removed += 1
+        self._note_churn()
 
     def build_indexes(self) -> int:
         """Full rebuild from storage (ref: BuildIndexes / EnsureSearchIndexesBuilt
@@ -369,7 +414,11 @@ class SearchService:
                 import jax.numpy as jnp
 
                 cur_dtype = jnp.float32
-            sharded = ShardedCorpus(dims=self._dims, dtype=cur_dtype)
+            sharded = ShardedCorpus(
+                dims=self._dims, dtype=cur_dtype,
+                quantized=self.config.int8_residency,
+                rescore_factor=self.config.rescore_factor,
+            )
         except DeviceUnavailable:
             # degraded backend: retry after a cooldown instead of pinning
             # the corpus to one chip forever
@@ -439,6 +488,10 @@ class SearchService:
                 sharded.set_clusters(
                     np.asarray(res.centroids, np.float32), assignments
                 )
+                # re-tune against the SHARDED layout: per-shard inverted
+                # lists + local_k change the recall-vs-FLOPs curve, so the
+                # single-device plan does not carry over
+                self.run_tune(sharded)
             except Exception:
                 logger.exception(
                     "cluster fit carry-over failed after sharded promotion"
@@ -450,14 +503,33 @@ class SearchService:
 
     # -- queries -----------------------------------------------------------
     def _corpus_search_kwargs(self, corpus) -> dict:
-        """Per-dispatch knobs the config enables for this corpus type:
-        exact full-sort, IVF n_probe (any clustered corpus), per-shard
-        local_k oversampling (sharded only)."""
+        """Per-dispatch knobs for this corpus type: exact full-sort,
+        IVF pruning, per-shard local_k oversampling (sharded only).
+
+        The pruning plan comes from the TUNER (recall-governed, measured
+        against the floor) unless the operator explicitly set n_probe —
+        a bypass of the eval gate kept for debugging, not a supported
+        knob. A tune whose outcome isn't "ok" (floor_unmet / degraded /
+        no_layout / ...) contributes nothing: the search full-scans, which
+        is always recall-correct."""
         kwargs: dict = {}
         if self.config.exact:
             kwargs["exact"] = True
-        if self.config.n_probe > 0 and hasattr(corpus, "cluster"):
+        clustered = hasattr(corpus, "cluster")
+        if self.config.n_probe > 0 and clustered:
             kwargs["n_probe"] = self.config.n_probe
+        elif clustered and not self.config.exact:
+            # exact=True is the recall-1.0 contract and the corpora take
+            # the pruned branch before honoring exact — the tuner must
+            # never inject pruning under it
+            tune = self._tune_state
+            if tune is not None and tune.serving_pruned:
+                # staleness is the corpus's problem, not ours: a layout
+                # whose epoch moved makes _pruned_search return None and
+                # the search full-scans regardless of what we pass here
+                kwargs["n_probe"] = tune.n_probe
+                if tune.local_k > 0 and hasattr(corpus, "n_shards"):
+                    kwargs["local_k"] = tune.local_k
         if self.config.local_k > 0 and hasattr(corpus, "n_shards"):
             kwargs["local_k"] = self.config.local_k
         return kwargs
@@ -564,6 +636,19 @@ class SearchService:
             corpus, batcher = self._corpus, getattr(self, "_batcher", None)
             if self._promo_state is not None:
                 out["sharded_promotion"] = self._promo_state
+            # active recall-governed tuner state: the serving plan, its
+            # measured-recall evidence, outcome counts, and how far the
+            # corpus has drifted from it (docs/observability.md)
+            tuner: dict = {
+                "tunes": dict(self.tune_counts),
+                "churn_since_tune": self._churn_since_tune,
+                "drift_threshold": self.config.drift_threshold,
+                "recall_target": self.config.recall_target,
+                "retuning": self._retuning,
+            }
+            if self._tune_state is not None:
+                tuner["active"] = self._tune_state.as_dict()
+            out["ivf_tuner"] = tuner
         if corpus is not None:
             out["corpus"] = corpus.stats()
             mgr = getattr(corpus, "_backend", None)
@@ -738,19 +823,187 @@ class SearchService:
             if len(ids) < 2:
                 return None
             mat = np.stack([self._vectors[i] for i in ids])
+            # drift resets HERE, at the fit snapshot — not after the tune:
+            # mutations landing while the fit/tune runs are invisible to
+            # the new layout and must still count as churn against it
+            # (the drift-retune loop's settle check reads this)
+            self._churn_since_tune = 0
         from nornicdb_tpu.ops.kmeans import kmeans_fit
 
-        res = kmeans_fit(mat, k=k, iters=iters)
+        res = kmeans_fit(mat, k=k, iters=iters,
+                         sample=self.config.cluster_fit_sample)
         assignments = {id_: int(c) for id_, c in zip(ids, res.assignments)}
         with self._lock:
             self.cluster_result = res
             self.cluster_assignments = assignments
             corpus = self._corpus
         if corpus is not None and hasattr(corpus, "set_clusters"):
+            # cold-gate BEFORE the install: on a never-acquired backend
+            # set_clusters would stash the fit for the recovery thread and
+            # the tune right after would measure a layout that isn't there
+            # yet. The bounded acquisition is legal here — no lock held,
+            # and recluster already runs on background threads. Degraded
+            # stays degraded: the stash path below still applies.
+            from nornicdb_tpu.errors import DeviceUnavailable
+
+            try:
+                corpus._device_gate()
+            except DeviceUnavailable:
+                pass  # fallback-policy "fail": stash + degraded tune
             # reuse the one fit: map assignments onto corpus slots (no second
             # k-means, and nothing heavy runs under the service lock)
             corpus.set_clusters(res.centroids, assignments)
+            # eval-gate the fresh layout before it serves: measure recall
+            # against the floor and pick (n_probe, local_k) — or record
+            # that the floor is unreachable and keep full-scanning
+            self.run_tune(corpus)
         return assignments
+
+    def run_tune(self, corpus=None) -> Optional[TuneState]:
+        """Measure the fitted IVF layout against the recall floor and
+        install the resulting serving plan (search/tuner.py). Runs with
+        no service lock held — the tuner dispatches real searches. Also
+        the drift-retune entry point; callers may pass the corpus they
+        already hold to dodge the promotion-swap race."""
+        cfg = self.config
+        if not cfg.tune_enabled:
+            return None
+        if corpus is None:
+            with self._lock:
+                corpus = self._corpus
+        if corpus is None or not hasattr(corpus, "cluster"):
+            return None
+        if len(corpus) < cfg.tune_min_rows:
+            # a corpus this small full-scans in the noise floor; recording
+            # too_small (rather than silence) keeps /admin/stats honest
+            # about WHY nothing is pruned
+            from nornicdb_tpu.search.tuner import count_tune_outcome
+
+            state = TuneState(outcome="too_small",
+                              recall_target=cfg.recall_target,
+                              corpus_rows=len(corpus))
+            count_tune_outcome("too_small")
+        else:
+            tuner = IVFTuner(
+                recall_target=cfg.recall_target,
+                sample=cfg.tune_sample,
+                k=cfg.tune_k,
+            )
+            state = tuner.tune(corpus)
+        self._install_tune(state, corpus)
+        return state
+
+    def _install_tune(self, state: TuneState, corpus) -> None:
+        """Install a tune verdict as the serving plan.
+
+        Transient failures (a tune racing churn, a crashed tune, a
+        degraded backend) must not evict a measured-good plan — but a
+        kept plan must still describe the layout that is actually
+        serving: it survives only while it was measured on THIS corpus
+        and the corpus's layout epoch still matches (a post-churn or
+        post-promotion layout is epoch-valid to the corpus's own guard,
+        so an unmeasured old plan against it would be exactly the silent
+        recall degradation the tuner exists to kill). Real verdicts (ok,
+        floor_unmet, no_layout, too_small) always replace."""
+        from nornicdb_tpu.search.tuner import publish_plan
+
+        import weakref
+
+        layout = IVFTuner._layout_of(corpus)[0] if corpus is not None \
+            else None
+        with self._lock:
+            transient = state.outcome in ("stale", "error", "degraded")
+            old = self._tune_state
+            old_layout_ref = getattr(self, "_tuned_layout_ref", None)
+            # the plan is pinned to the LAYOUT OBJECT it was measured on
+            # (epochs alone don't discriminate: a re-fitted layout after
+            # plain adds shares the old epoch, and a promoted corpus
+            # starts a fresh epoch space)
+            keep_old = (
+                transient
+                and old is not None
+                and old.outcome == "ok"
+                and layout is not None
+                and old_layout_ref is not None
+                and old_layout_ref() is layout
+            )
+            if not keep_old:
+                self._tune_state = state
+                self._tuned_layout_ref = (
+                    weakref.ref(layout)
+                    if state.outcome == "ok" and layout is not None
+                    else None
+                )
+            self.tune_counts[state.outcome] = (
+                self.tune_counts.get(state.outcome, 0) + 1
+            )
+            serving = self._tune_state
+        # gauges reflect the plan the service actually SERVES (post
+        # keep/replace), not whatever the last tune attempt measured
+        publish_plan(serving)
+
+    def _note_churn(self) -> None:
+        """Drift tracking: every index mutation ages the tuned plan (new
+        rows are invisible to the fitted layout; removals thin it). Past
+        drift_threshold × corpus size, schedule a background recluster +
+        re-tune so the measured recall floor is restored without an
+        operator in the loop."""
+        cfg = self.config
+        if not cfg.tune_enabled or cfg.drift_threshold <= 0:
+            return
+        with self._lock:
+            self._churn_since_tune += 1
+            tune = self._tune_state
+            corpus = self._corpus
+            if (
+                tune is None         # nothing tuned yet: recluster's job
+                or self._retuning
+                or corpus is None
+            ):
+                return
+            # a too_small verdict does NOT pin full scan forever: once
+            # the corpus grows past tune_min_rows, churn since that
+            # verdict schedules the first real tune like any other drift
+            n = len(corpus)
+            if n < cfg.tune_min_rows:
+                return
+            if self._churn_since_tune < max(32, int(cfg.drift_threshold * n)):
+                return
+            self._retuning = True
+        threading.Thread(
+            target=self._drift_retune, name="nornicdb-ivf-retune",
+            daemon=True,
+        ).start()
+
+    def _drift_retune(self) -> None:
+        """Background drift response: refit k-means over the current
+        vector set (recluster installs the layout and re-runs the tuner).
+        Loops while the write burst is still landing — a layout fitted
+        mid-burst is stale the moment it installs (measured: a re-tune
+        racing the tail of a churn burst reports floor_unmet because the
+        tune sampled rows the fit never saw) — and stops once churn
+        settles. Failures leave the old plan serving; the corpus's
+        layout-epoch guard already full-scans anything stale."""
+        try:
+            for _ in range(3):
+                self.recluster()
+                with self._lock:
+                    churn = self._churn_since_tune
+                    corpus = self._corpus
+                # settle threshold scales WITH the trigger (a tenth of
+                # it), not an absolute count: a steady write trickle on a
+                # 10M corpus lands far more than 32 rows during one
+                # recluster, and re-fitting three times over 0.001% drift
+                # is pure background burn
+                n = len(corpus) if corpus is not None else 0
+                trigger = max(32, int(self.config.drift_threshold * n))
+                if churn < max(32, trigger // 10):
+                    break
+        except Exception:
+            logger.exception("drift-triggered IVF re-tune failed")
+        finally:
+            with self._lock:
+                self._retuning = False
 
     # -- wiring ------------------------------------------------------------
     def attach(self, engine: Engine) -> None:

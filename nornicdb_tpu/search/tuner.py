@@ -1,0 +1,336 @@
+"""Recall-governed IVF autotuning: operators set a recall floor, the
+tuner spends FLOPs against it.
+
+The production footgun this kills: ``n_probe`` is a speed knob whose
+recall cost is invisible until someone measures it (BENCH_search.json
+recorded recall@100 ≈ 0.30 at a hand-tuned n_probe for two PRs running —
+exactly the silent-degradation class TPU-KNN's recall-vs-FLOPs accounting
+exists to prevent, PAPERS.md). So the knobs invert: operators configure
+``SearchConfig.recall_target`` (default 0.95) and the tuner — run at
+recluster/promotion time and re-run when drift-tracking trips — *measures*
+recall@k of the fitted IVF layout against exact f32 ground truth on a
+held-out query sample (the corpus rows themselves, TPU-KNN-style) and
+picks the smallest ``(n_probe, local_k)`` meeting the floor.
+
+Eval-gating, same contract as the PR 8 student embedder: a layout that
+cannot meet the floor is not served — the tune records
+``outcome="floor_unmet"`` (``nornicdb_ivf_tunes_total{outcome}``), the
+service drops back to the full scan, and the operator sees WHY in
+``/admin/stats`` instead of discovering a recall cliff in production.
+
+Cost model: probing P of K clusters scores ~P/K of the corpus, so the
+candidate ladder walks n_probe geometrically (then local_k, which only
+widens the merge) and stops at the first configuration whose measured
+recall clears the floor — the TPU-KNN "smallest FLOP budget that buys the
+recall" search, run against the corpus actually being served (layout
+skew, residual spill, int8 rescoring and all).
+"""
+
+from __future__ import annotations
+
+import logging
+import time
+from dataclasses import asdict, dataclass, field
+
+import numpy as np
+
+from nornicdb_tpu.ops.host_search import host_topk
+from nornicdb_tpu.telemetry.metrics import REGISTRY as _REGISTRY
+
+logger = logging.getLogger(__name__)
+
+# every outcome pre-registered so the tested observability catalog renders
+# the full family before the first tune
+TUNE_OUTCOMES = (
+    "ok",            # floor met: (n_probe, local_k) installed for serving
+    "floor_unmet",   # no config met the floor: serve full scan
+    "degraded",      # backend down: nothing to tune, full scan serves
+    "no_layout",     # no fitted IVF layout (or epoch-invalidated mid-fit)
+    "stale",         # corpus layout epoch moved mid-tune: result discarded
+    "too_small",     # corpus under tune_min_rows: full scan is the right
+                     # plan at this size, nothing recorded for serving
+    "error",         # tune crashed; full scan serves (never a worse plan)
+)
+
+_TUNES = _REGISTRY.counter(
+    "nornicdb_ivf_tunes_total",
+    "Recall-governed IVF tunes by outcome (outcome!=ok serves full scan)",
+    labels=("outcome",),
+)
+for _o in TUNE_OUTCOMES:
+    _TUNES.labels(_o)
+_MEASURED_RECALL = _REGISTRY.gauge(
+    "nornicdb_ivf_measured_recall",
+    "Recall@k of the served IVF configuration, measured against exact "
+    "f32 ground truth on the held-out corpus-row sample at tune time",
+)
+_ACTIVE_NPROBE = _REGISTRY.gauge(
+    "nornicdb_ivf_n_probe",
+    "n_probe the tuner picked for serving (0 = full scan)",
+)
+_ACTIVE_LOCALK = _REGISTRY.gauge(
+    "nornicdb_ivf_local_k",
+    "Per-shard candidate width the tuner picked (0 = default k)",
+)
+
+
+def count_tune_outcome(outcome: str) -> None:
+    """Bump the outcome counter for tunes decided OUTSIDE IVFTuner.tune
+    (e.g. the service's too_small short-circuit) so the metric family
+    stays the single source of tune-outcome truth."""
+    _TUNES.labels(outcome).inc()
+
+
+def publish_plan(state) -> None:
+    """Point the serving-plan gauges at what is ACTUALLY being served.
+
+    Called by the service after its keep-or-replace decision — never by
+    tune() itself, which only *measures*: a transient tune that keeps
+    the old plan must not zero the gauges, and a service-side verdict
+    (too_small) must not leave stale ones. ``state`` may be None (no
+    plan at all = full scan)."""
+    if state is not None and state.serving_pruned:
+        _MEASURED_RECALL.set(state.measured_recall)
+        _ACTIVE_NPROBE.set(float(state.n_probe))
+        _ACTIVE_LOCALK.set(float(state.local_k))
+    else:
+        _MEASURED_RECALL.set(0.0)
+        _ACTIVE_NPROBE.set(0.0)
+        _ACTIVE_LOCALK.set(0.0)
+
+
+@dataclass
+class TuneState:
+    """One tune's verdict — the serving plan plus its evidence.
+
+    Surfaced verbatim in ``/admin/stats`` → ``search.ivf_tuner`` and the
+    slow-query capture's counter probe, so a recall regression is
+    diagnosable from the observability surface alone."""
+
+    outcome: str
+    n_probe: int = 0
+    local_k: int = 0
+    measured_recall: float = 0.0
+    recall_target: float = 0.95
+    k: int = 0
+    sample: int = 0
+    clusters: int = 0          # K of the tuned layout
+    flop_fraction: float = 1.0  # ~n_probe/K of a full scan (1.0 = full)
+    layout_epoch: int = -1
+    corpus_rows: int = 0
+    ladder_evals: int = 0      # (n_probe, local_k) configs measured
+    tune_seconds: float = 0.0
+    detail: str = ""
+
+    def as_dict(self) -> dict:
+        return asdict(self)
+
+    @property
+    def serving_pruned(self) -> bool:
+        return self.outcome == "ok" and self.n_probe > 0
+
+
+def _probe_ladder(k_clusters: int) -> list[int]:
+    """Geometric n_probe candidates, 1..K (K last: probing every cluster
+    still skips nothing — it is the layout's own upper recall bound)."""
+    ladder = []
+    p = 1
+    while p < k_clusters:
+        ladder.append(p)
+        p *= 2
+    ladder.append(k_clusters)
+    return ladder
+
+
+def _recall(got: list[list[tuple[str, float]]],
+            truth: list[set]) -> float:
+    vals = []
+    for row, want in zip(got, truth):
+        if not want:
+            continue
+        vals.append(len({i for i, _ in row} & want) / len(want))
+    return float(np.mean(vals)) if vals else 1.0
+
+
+@dataclass
+class IVFTuner:
+    """Measure-and-pick autotuner over a fitted corpus (DeviceCorpus or
+    ShardedCorpus). Stateless between calls — the service owns the
+    returned TuneState and the drift bookkeeping."""
+
+    recall_target: float = 0.95
+    sample: int = 64
+    k: int = 100
+    seed: int = 7
+    # local_k ladder: multiples of k tried per n_probe on sharded corpora
+    local_k_factors: tuple = (1, 2, 4)
+    # verify each passing candidate on a SECOND, independent held-out
+    # sample before serving it (the eval-gated-student split): a config
+    # that merely over-fits the tune sample's cluster geometry fails the
+    # verification sample and the ladder keeps climbing. Measured at 10M:
+    # single-sample tuning picked n_probe=2 at 0.984 on its sample that
+    # landed 0.941 on independent queries.
+    verify: bool = True
+    rng: np.random.Generator = field(init=False, repr=False)
+
+    def __post_init__(self):
+        self.rng = np.random.default_rng(self.seed)
+
+    # -- layout introspection ------------------------------------------------
+    @staticmethod
+    def _layout_of(corpus):
+        """(layout, epoch_ok) for either corpus flavor; layout is None when
+        nothing is fitted."""
+        layout = getattr(corpus, "_sivf", None)
+        if layout is None:
+            layout = getattr(corpus, "_ivf", None)
+        if layout is None:
+            return None, False
+        return layout, layout.epoch == corpus._layout_epoch
+
+    # -- the tune ------------------------------------------------------------
+    def tune(self, corpus, k: int = 0) -> TuneState:
+        """Measure recall@k of the corpus's fitted IVF layout against exact
+        ground truth and return the smallest passing (n_probe, local_k).
+        Never raises: every failure mode is an outcome the caller can
+        serve around (full scan is always a correct plan)."""
+        t0 = time.perf_counter()
+        k = int(k) if k > 0 else self.k
+        try:
+            state = self._tune_inner(corpus, k)
+        except Exception as e:  # noqa: BLE001 — tune must never take
+            # serving down; the fallback plan (full scan) is always correct
+            logger.exception("IVF tune failed")
+            state = TuneState(outcome="error", recall_target=self.recall_target,
+                              k=k, detail=str(e)[:200])
+        state.tune_seconds = time.perf_counter() - t0
+        _TUNES.labels(state.outcome).inc()
+        # serving-plan gauges are published by the OWNER of the plan
+        # (SearchService._install_tune, after its keep-or-replace
+        # decision) — tune() only measures. Standalone users (the bench)
+        # may call publish_plan themselves.
+        logger.info(
+            "IVF tune: outcome=%s n_probe=%d local_k=%d recall=%.4f "
+            "target=%.2f k=%d clusters=%d evals=%d (%.2fs) %s",
+            state.outcome, state.n_probe, state.local_k,
+            state.measured_recall, state.recall_target, state.k,
+            state.clusters, state.ladder_evals, state.tune_seconds,
+            state.detail,
+        )
+        return state
+
+    def _tune_inner(self, corpus, k: int) -> TuneState:
+        base = TuneState(outcome="error", recall_target=self.recall_target,
+                         k=k, corpus_rows=len(corpus))
+        # the COLD gate, not the nowait read: a tune runs with no lock
+        # held and may legitimately pay the bounded backend acquisition
+        # (a fresh process tunes before its first search). Degraded stays
+        # untunable: the host fallback ignores n_probe entirely, so any
+        # measurement would be a full-scan measuring itself.
+        from nornicdb_tpu.errors import DeviceUnavailable
+
+        try:
+            ready = corpus._device_gate()
+        except DeviceUnavailable:  # the "fail" fallback policy raises
+            ready = False
+        if not ready:
+            base.outcome = "degraded"
+            return base
+        layout, epoch_ok = self._layout_of(corpus)
+        if layout is None or not epoch_ok:
+            base.outcome = "no_layout"
+            return base
+        base.clusters = int(layout.k)
+        epoch_at_start = corpus._layout_epoch
+
+        # held-out query samples: the corpus rows themselves (TPU-KNN's
+        # recall accounting), snapshotted under the sync lock so a racing
+        # overwrite can't tear a sampled vector. Two independent draws:
+        # the ladder measures against the first; a passing candidate must
+        # ALSO pass the second before it serves (over-fit guard).
+        with corpus._sync_lock:
+            live = np.nonzero(corpus._valid)[0]
+            if live.size == 0:
+                base.outcome = "no_layout"
+                return base
+            n_sample = int(min(self.sample, live.size))
+            n_draw = int(min(2 * n_sample, live.size))
+            pick = self.rng.choice(live, size=n_draw, replace=False)
+            queries = corpus._host[pick[:n_sample]].copy()
+            vqueries = (corpus._host[pick[n_sample:]].copy()
+                        if self.verify and n_draw > n_sample else None)
+            host, valid, ids = corpus._host, corpus._valid, corpus._ids
+        base.sample = n_sample
+        kk = min(k, int(live.size))
+        base.k = kk
+
+        # exact f32 ground truth over the host mirror (unlocked reads of
+        # host/valid are measurement-grade: a row mutated mid-scan skews
+        # one membership test, not the plan)
+        def _truth_for(qs):
+            _, t_idx = host_topk(qs, host, valid, kk)
+            return [{ids[i] for i in row
+                     if 0 <= i < len(ids) and ids[i] is not None}
+                    for row in t_idx]
+
+        truth = _truth_for(queries)
+        vtruth = _truth_for(vqueries) if vqueries is not None else None
+
+        sharded = hasattr(corpus, "n_shards")
+        # local_k ladder: 0 (the path's default width) plus only the
+        # values that actually WIDEN something. The sharded programs
+        # already run at max(k, …) — and a quantized corpus at
+        # rescore_factor × k — so smaller entries are bit-identical
+        # re-runs of the same program
+        lk_ladder = [0]
+        if sharded:
+            floor = kk * (getattr(corpus, "rescore_factor", 1)
+                          if getattr(corpus, "quantized", False) else 1)
+            lk_ladder += [kk * f for f in self.local_k_factors
+                          if kk * f > floor]
+        best_recall, best = -1.0, (0, 0)
+        evals = 0
+        for n_probe in _probe_ladder(base.clusters):
+            for lk in lk_ladder:
+                kwargs = {"n_probe": n_probe}
+                if lk:
+                    kwargs["local_k"] = lk
+                got = corpus.search(queries, k=kk, **kwargs)
+                evals += 1
+                eff = _recall(got, truth)
+                if eff >= self.recall_target and vtruth is not None:
+                    # passed the tune sample: must also pass the
+                    # independent verification sample or it's an over-fit
+                    # pick and the ladder keeps climbing
+                    vgot = corpus.search(vqueries, k=kk, **kwargs)
+                    evals += 1
+                    eff = min(eff, _recall(vgot, vtruth))
+                if eff > best_recall:
+                    best_recall, best = eff, (n_probe, lk)
+                if eff < self.recall_target:
+                    continue
+                if corpus._layout_epoch != epoch_at_start:
+                    base.outcome = "stale"
+                    base.ladder_evals = evals
+                    return base
+                base.outcome = "ok"
+                base.n_probe = n_probe
+                base.local_k = lk
+                base.measured_recall = eff
+                base.flop_fraction = round(
+                    n_probe / max(base.clusters, 1), 4
+                )
+                base.layout_epoch = epoch_at_start
+                base.ladder_evals = evals
+                return base
+        # nothing met the floor — eval-gated: serve the full scan and say
+        # so, never a layout that silently under-recalls
+        base.outcome = "floor_unmet"
+        base.n_probe, base.local_k = best
+        base.measured_recall = best_recall
+        base.ladder_evals = evals
+        base.detail = (
+            f"best recall {best_recall:.4f} at n_probe={best[0]} "
+            f"local_k={best[1]} < target {self.recall_target}"
+        )
+        return base

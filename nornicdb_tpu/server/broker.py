@@ -71,6 +71,15 @@ log = logging.getLogger(__name__)
 MSG_SEARCH = 0x01
 MSG_EMBED = 0x02
 MSG_STATUS = 0x03
+# Qdrant collection search (ROADMAP 1b): the points/search surface takes
+# raw vectors, so workers ship it over the broker instead of proxying the
+# whole HTTP request — the primary answers from the SHARED
+# db.qdrant_registry() (the same per-collection device corpora the REST
+# and gRPC transports serve). Payload: u8 flags (bit0 with_payload) |
+# u32 limit | f32 score_threshold | u16 coll_len | coll utf-8 | u32 D |
+# D f32 vector. OK payload: u32 len | JSON hits (registry.search output
+# verbatim, so worker responses are body-identical to the primary's).
+MSG_QDRANT = 0x04
 RESP = 0x80
 
 # response statuses
@@ -84,7 +93,7 @@ _REQUESTS = _REGISTRY.counter(
     "Device-broker requests by operation and outcome",
     labels=("op", "outcome"),
 )
-for _op in ("search", "embed", "status"):
+for _op in ("search", "embed", "status", "qdrant"):
     for _out in ("ok", "shed", "degraded", "error"):
         _REQUESTS.labels(_op, _out)
 _REQ_HIST = _REGISTRY.histogram(
@@ -94,6 +103,7 @@ _REQ_HIST = _REGISTRY.histogram(
 )
 _REQ_HIST.labels("search")
 _REQ_HIST.labels("embed")
+_REQ_HIST.labels("qdrant")
 _CONNECTIONS = _REGISTRY.gauge(
     "nornicdb_broker_connections",
     "Worker connections currently attached to the device broker",
@@ -241,6 +251,34 @@ def decode_search_response(payload: bytes) -> list[list[tuple]]:
     return out
 
 
+def encode_qdrant_request(
+    collection: str, vector: np.ndarray, limit: int,
+    score_threshold: float, with_payload: bool,
+) -> bytes:
+    coll = collection.encode()
+    vec = np.ascontiguousarray(np.asarray(vector, np.float32).reshape(-1))
+    return (
+        struct.pack("<BIfH", 1 if with_payload else 0, int(limit),
+                    float(score_threshold), len(coll))
+        + coll
+        + struct.pack("<I", vec.shape[0])
+        + vec.tobytes()
+    )
+
+
+def decode_qdrant_request(
+    payload: bytes,
+) -> tuple[str, np.ndarray, int, float, bool]:
+    flags, limit, thresh, coll_len = struct.unpack_from("<BIfH", payload)
+    off = struct.calcsize("<BIfH")
+    coll = payload[off:off + coll_len].decode()
+    off += coll_len
+    (d,) = struct.unpack_from("<I", payload, off)
+    off += 4
+    vec = np.frombuffer(payload, np.float32, d, off)
+    return coll, vec, int(limit), float(thresh), bool(flags & 1)
+
+
 def encode_embed_request(texts: list[str]) -> bytes:
     out = bytearray(struct.pack("<I", len(texts)))
     for t in texts:
@@ -308,7 +346,9 @@ class DeviceBroker:
         self.counters = {
             "search_ok": 0, "search_shed": 0, "search_degraded": 0,
             "search_error": 0, "embed_ok": 0, "embed_shed": 0,
-            "embed_error": 0, "status": 0, "queries": 0, "connections": 0,
+            "embed_error": 0, "qdrant_ok": 0, "qdrant_shed": 0,
+            "qdrant_error": 0,
+            "status": 0, "queries": 0, "connections": 0,
         }
         self._accept_thread = threading.Thread(
             target=self._accept_loop, name="nornicdb-broker-accept",
@@ -363,6 +403,8 @@ class DeviceBroker:
             return self._handle_search(payload)
         if mtype == MSG_EMBED:
             return self._handle_embed(payload)
+        if mtype == MSG_QDRANT:
+            return self._handle_qdrant(payload)
         if mtype == MSG_STATUS:
             self.counters["status"] += 1
             _REQUESTS.labels("status", "ok").inc()
@@ -437,6 +479,62 @@ class DeviceBroker:
         _REQUESTS.labels("search", "ok").inc()
         _REQ_HIST.labels("search").observe(time.perf_counter() - t0)
         return encode_search_response(results, with_content)
+
+    def _handle_qdrant(self, payload: bytes) -> bytes:
+        """Worker-shipped Qdrant points/search: answer from the SHARED
+        collection registry (db.qdrant_registry()), whose per-collection
+        DeviceCorpus dispatch is the same fused device path the REST/gRPC
+        transports serve — so worker hits are id/score/payload-identical
+        to the primary's by construction. A degraded backend needs no
+        redirect here: collection corpora serve their exact host fallback
+        internally, and workers hold no shared-memory mirror of
+        collection corpora (only the default search corpus rides the shm
+        plane today — ROADMAP 1b residual)."""
+        t0 = time.perf_counter()
+        try:
+            coll, vec, limit, thresh, with_payload = decode_qdrant_request(
+                payload
+            )
+        except Exception as e:
+            self.counters["qdrant_error"] += 1
+            _REQUESTS.labels("qdrant", "error").inc()
+            return _status_payload(STATUS_ERROR, f"bad qdrant frame: {e}")
+        registry_fn = getattr(self.db, "qdrant_registry", None)
+        if not callable(registry_fn):
+            self.counters["qdrant_error"] += 1
+            _REQUESTS.labels("qdrant", "error").inc()
+            return _status_payload(STATUS_ERROR, "no qdrant registry")
+        self.counters["queries"] += 1
+        _QUERIES.inc()
+        try:
+            hits = registry_fn().search(
+                coll, vec, limit=limit, score_threshold=thresh,
+                with_payload=with_payload,
+            )
+        except ResourceExhausted as e:
+            # backpressure, not failure: the worker surfaces 429 +
+            # Retry-After instead of proxying onto the overloaded primary
+            self.counters["qdrant_shed"] = (
+                self.counters.get("qdrant_shed", 0) + 1
+            )
+            _REQUESTS.labels("qdrant", "shed").inc()
+            return _status_payload(STATUS_RESOURCE_EXHAUSTED, str(e))
+        except NotFoundError as e:
+            # unknown collection: a real error reply, not a proxy fallback
+            # (the primary would 404 the same request)
+            self.counters["qdrant_error"] += 1
+            _REQUESTS.labels("qdrant", "error").inc()
+            return _status_payload(STATUS_ERROR, str(e))
+        except Exception as e:
+            self.counters["qdrant_error"] += 1
+            _REQUESTS.labels("qdrant", "error").inc()
+            log.exception("broker qdrant search failed")
+            return _status_payload(STATUS_ERROR, f"qdrant search failed: {e}")
+        blob = json.dumps(hits).encode()
+        self.counters["qdrant_ok"] += 1
+        _REQUESTS.labels("qdrant", "ok").inc()
+        _REQ_HIST.labels("qdrant").observe(time.perf_counter() - t0)
+        return bytes([OK]) + struct.pack("<I", len(blob)) + blob
 
     def _content(self, node_id: str) -> str:
         try:
@@ -610,6 +708,20 @@ class BrokerClient:
             encode_search_request(queries, k, min_similarity, with_content),
         )
         return decode_search_response(self._check(body))
+
+    def qdrant_search(
+        self, collection: str, vector, limit: int = 10,
+        score_threshold: float = -1.0, with_payload: bool = True,
+    ) -> list[dict]:
+        """Qdrant points/search via the broker: returns the registry's
+        hit dicts ({"id", "score", "version"[, "payload"]}) verbatim."""
+        body = self._check(self._call(
+            MSG_QDRANT,
+            encode_qdrant_request(collection, np.asarray(vector, np.float32),
+                                  limit, score_threshold, with_payload),
+        ))
+        (ln,) = struct.unpack_from("<I", body, 0)
+        return json.loads(body[4:4 + ln].decode())
 
     def embed(self, texts: list[str]) -> np.ndarray:
         body = self._check(self._call(MSG_EMBED,

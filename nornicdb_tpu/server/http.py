@@ -214,12 +214,20 @@ class HttpServer:
     @property
     def qdrant(self):
         if self._qdrant is None:
-            from nornicdb_tpu.server.qdrant import QdrantCollections
+            # prefer the db facade's SHARED registry — the device broker
+            # serves worker-side Qdrant searches from it, and a private
+            # per-server registry would double the collection corpora and
+            # miss broker-visible upserts
+            shared = getattr(self.db, "qdrant_registry", None)
+            if callable(shared):
+                self._qdrant = shared()
+            else:  # bare-engine test doubles without the facade
+                from nornicdb_tpu.server.qdrant import QdrantCollections
 
-            self._qdrant = QdrantCollections(
-                self.db.storage,
-                vectorspaces=getattr(self.db, 'vectorspaces', None),
-            )
+                self._qdrant = QdrantCollections(
+                    self.db.storage,
+                    vectorspaces=getattr(self.db, 'vectorspaces', None),
+                )
         return self._qdrant
 
     # -- request handling ----------------------------------------------------

@@ -34,7 +34,11 @@ from typing import Any, Callable, Optional
 
 import numpy as np
 
-from nornicdb_tpu.ops.host_search import format_topk_results, host_topk
+from nornicdb_tpu.ops.host_search import (
+    format_topk_results,
+    host_topk,
+    quantize_rows_np,
+)
 from nornicdb_tpu.server.shm import (
     SegmentReader,
     SegmentUnavailable,
@@ -74,13 +78,13 @@ def export_corpus_segment(corpus) -> tuple[dict, dict]:
     """Corpus host state → (arrays, meta) for SegmentWriter.publish."""
     state = corpus.export_host_state()
     rows = state["rows"]
-    # int8 serving mirror: per-row symmetric quantization, the exact math
-    # of ops.pallas_kernels.quantize_rows on host (codes identical; scales
-    # within a float ulp) — the compact block for memory-lean consumers
-    scale = (127.0 / np.maximum(np.max(np.abs(rows), axis=1), 1e-9)).astype(
-        np.float32
-    )
-    codes = np.round(rows * scale[:, None]).astype(np.int8)
+    # int8 serving mirror: ops.host_search.quantize_rows_np — the ONE
+    # definition of the per-row symmetric quantization (shared with the
+    # compressed-residency upload path, so an int8-resident corpus's
+    # exported codes are bit-identical to what its device HBM holds; vs
+    # the device-side ops.pallas_kernels.quantize_rows the codes are
+    # identical and the scales within a float ulp)
+    codes, scale = quantize_rows_np(rows)
     id_bytes, id_off = pack_strings(state["ids"])
     arrays = {
         "rows": rows,
@@ -94,6 +98,9 @@ def export_corpus_segment(corpus) -> tuple[dict, dict]:
         "epoch": state["epoch"],
         "count": state["count"],
         "dims": state["dims"],
+        # residency of the SOURCE corpus's device plane: consumers sizing
+        # against HBM (or asserting the int8 mirror contract) read this
+        "int8_residency": bool(getattr(corpus, "quantized", False)),
     }
     return arrays, meta
 

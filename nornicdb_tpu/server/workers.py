@@ -194,6 +194,37 @@ class WorkerReadPath:
             self._corpus_reader = SharedCorpusReader(self.corpus_seg)
         return self._corpus_reader
 
+    def qdrant_search(
+        self, collection: str, vector, limit: int, score_threshold: float,
+        with_payload: bool,
+    ) -> tuple[list, str]:
+        """Qdrant points/search through the device plane: the broker
+        answers from the primary's shared collection registry (fused
+        device dispatch, payload enrichment included). Collection corpora
+        have no shared-memory mirror yet (ROADMAP 1b residual: only the
+        default search corpus rides the shm plane), so the ladder here is
+        broker → LookupError (caller proxies). Raises ResourceExhausted
+        on a shed, BrokerError for a real error reply (unknown
+        collection) — the caller proxies so the primary owns the 404."""
+        from nornicdb_tpu.server.broker import (
+            BrokerDegraded,
+            BrokerUnavailable,
+        )
+
+        client = self._broker()
+        if client is None:
+            raise LookupError("no broker for qdrant search")
+        try:
+            hits = client.qdrant_search(
+                collection, vector, limit=limit,
+                score_threshold=score_threshold, with_payload=with_payload,
+            )
+        except (BrokerDegraded, BrokerUnavailable) as e:
+            log.debug("broker unavailable for qdrant search: %s", e)
+            raise LookupError("broker down for qdrant search") from e
+        self.served["broker"] += 1
+        return hits, "broker"
+
     def search(
         self, vector, k: int, min_score: float, with_content: bool,
     ) -> tuple[list, str]:
@@ -231,6 +262,9 @@ class WorkerReadPath:
 
 
 _MUTATION_RE = re.compile(r"\bmutation\b")
+# worker-servable Qdrant surface: points/search is read-only and takes a
+# raw vector — the broker answers it from the primary's shared registry
+_QDRANT_SEARCH_RE = re.compile(r"/collections/([^/]+)/points/search")
 
 # endpoints a worker may answer from its generation-stamped cache; every
 # other path is proxied to the primary untouched
@@ -377,6 +411,13 @@ class _FrontendHandler(BaseHTTPRequestHandler):
                 if parsed is not None and \
                         self._serve_vector(method, body, parsed):
                     return
+            if method == "POST":
+                qm = _QDRANT_SEARCH_RE.fullmatch(self.path.split("?", 1)[0])
+                if qm is not None:
+                    parsed = self._sniff_qdrant(body)
+                    if parsed is not None and self._serve_qdrant(
+                            qm.group(1), method, body, parsed):
+                        return
             if _cacheable(method, self.path, body):
                 # auth material is part of the key: a cached response must
                 # never leak across differently-privileged tokens
@@ -483,6 +524,83 @@ class _FrontendHandler(BaseHTTPRequestHandler):
                    ("X-Nornic-Served", served)]
         # the shm fallback serves without content enrichment — still
         # cacheable (generation-stamped, so any index mutation kills it)
+        cache.put(key, (200, headers, payload), gen_before)
+        self._respond(200, headers, payload, "miss")
+        return True
+
+    # -- broker-served qdrant points/search ----------------------------
+    @staticmethod
+    def _sniff_qdrant(body: bytes) -> Optional[dict]:
+        """The worker-servable Qdrant search shape: a plain (unnamed)
+        vector list and NO payload filter. Filters need a payload scan
+        over storage and named vectors need the name-resolved corpus —
+        both stay with the primary's protocol stack (proxy)."""
+        try:
+            parsed = json.loads(body or b"{}")
+        except (ValueError, UnicodeDecodeError):
+            return None
+        if not isinstance(parsed, dict) or parsed.get("filter"):
+            return None
+        v = parsed.get("vector")
+        if not isinstance(v, list) or not v:
+            return None
+        return parsed
+
+    def _serve_qdrant(self, collection: str, method: str, body: bytes,
+                      parsed: dict) -> bool:
+        """Serve Qdrant points/search through the broker (the primary's
+        shared collection registry — fused device dispatch, payloads
+        included), response-shaped exactly like handle_qdrant's reply so
+        worker and primary answers are body-identical. Returns False to
+        fall through to the proxy path (broker down, unknown collection —
+        the primary owns the 404 shape)."""
+        from nornicdb_tpu.errors import ResourceExhausted
+        from nornicdb_tpu.server.broker import BrokerError
+
+        read_path = self.server.read_path
+        if read_path is None:
+            return False
+        cache = self.server.cache
+        key = (
+            method, self.path, body,
+            self.headers.get("Authorization", ""),
+            self.headers.get("Cookie", ""),
+        )
+        cached = cache.get(key)
+        if cached is not None:
+            status, headers, data = cached
+            self._respond(status, headers, data, "hit")
+            return True
+        gen_before = cache.generation()
+        try:
+            hits, served = read_path.qdrant_search(
+                collection, parsed["vector"],
+                int(parsed.get("limit", 10)),
+                float(parsed.get("score_threshold", -1.0)),
+                bool(parsed.get("with_payload", True)),
+            )
+        except ResourceExhausted as e:
+            msg = json.dumps({"error": str(e), "reason": e.reason}).encode()
+            self._respond(
+                429,
+                [("Content-Type", "application/json"),
+                 ("Retry-After", "1")],
+                msg, "limited",
+            )
+            return True
+        except LookupError:
+            return False  # no broker: proxy to the primary
+        except BrokerError:
+            return False  # e.g. collection unknown: primary owns the 404
+        except Exception:
+            log.warning("worker qdrant search failed; proxying",
+                        exc_info=True)
+            return False
+        payload = json.dumps(
+            {"result": hits, "status": "ok", "time": 0.0}
+        ).encode()
+        headers = [("Content-Type", "application/json"),
+                   ("X-Nornic-Served", served)]
         cache.put(key, (200, headers, payload), gen_before)
         self._respond(200, headers, payload, "miss")
         return True

@@ -85,6 +85,19 @@ def counters_probe(db) -> Optional[dict[str, float]]:
         out["sync_full_uploads"] = sync.full_uploads
         out["sync_bytes_uploaded"] = sync.bytes_uploaded
         out["sync_query_stall_s"] = sync.query_stall_s
+    # active recall-governed IVF plan: a slow search whose probe deltas
+    # show a tune (or a drift re-tune) landing mid-query explains itself
+    tune = getattr(search, "_tune_state", None)
+    if tune is not None:
+        out["ivf_n_probe"] = float(tune.n_probe if tune.serving_pruned
+                                   else 0)
+        out["ivf_local_k"] = float(tune.local_k if tune.serving_pruned
+                                   else 0)
+        out["ivf_measured_recall"] = float(tune.measured_recall)
+        out["ivf_layout_epoch"] = float(tune.layout_epoch)
+    counts = getattr(search, "tune_counts", None)
+    if counts:
+        out["ivf_tunes_total"] = float(sum(counts.values()))
     return out or None
 
 

@@ -1,0 +1,319 @@
+"""Recall-governed IVF autotuning (search/tuner.py + service wiring).
+
+The contract under test (ISSUE 13 / ROADMAP item 3): operators set
+``SearchConfig.recall_target``, never n_probe/local_k — the tuner measures
+recall@k of the fitted layout against exact f32 ground truth on held-out
+corpus rows and picks the smallest passing configuration; a layout that
+cannot meet the floor serves the full scan and says so
+(``nornicdb_ivf_tunes_total{outcome="floor_unmet"}``). Drift-triggered
+re-tunes restore the floor after churn. Chaos-aware: under
+``NORNICDB_FAKE_BACKEND=hang`` the degraded backend tunes to outcome
+"degraded" and serving stays on the exact host path.
+"""
+
+from __future__ import annotations
+
+import os
+import time
+
+import numpy as np
+import pytest
+
+from nornicdb_tpu.ops.similarity import DeviceCorpus
+from nornicdb_tpu.search.service import SearchConfig, SearchService
+from nornicdb_tpu.search.tuner import IVFTuner
+from nornicdb_tpu.storage import MemoryEngine
+from nornicdb_tpu.storage.types import Node
+
+_CHAOS = bool(os.environ.get("NORNICDB_FAKE_BACKEND"))
+
+
+def _clustered(n, d, n_centers, seed=0, spread=0.2):
+    rng = np.random.default_rng(seed)
+    centers = rng.normal(size=(n_centers, d)).astype(np.float32)
+    rows = centers[rng.integers(0, n_centers, n)] + spread * rng.normal(
+        size=(n, d)
+    ).astype(np.float32)
+    return rows.astype(np.float32), centers
+
+
+class TestTunerUnit:
+    def _fitted_corpus(self, n=4096, d=32, k=32, seed=0, capacity=0):
+        rows, _ = _clustered(n, d, k, seed)
+        c = DeviceCorpus(dims=d, capacity=capacity or 128)
+        c.add_batch([f"v{i}" for i in range(n)], rows)
+        fitted = c.cluster(k=k, iters=5)
+        # degraded backend: pruning is a device-path feature, nothing fits
+        assert (fitted == 0) if _CHAOS else (fitted > 0)
+        return c, rows
+
+    def test_picks_smallest_passing_n_probe(self):
+        c, _rows = self._fitted_corpus()
+        state = IVFTuner(recall_target=0.9, sample=32, k=50).tune(c)
+        if _CHAOS:
+            assert state.outcome == "degraded"
+            return
+        assert state.outcome == "ok"
+        assert 1 <= state.n_probe < 32  # pruning actually engaged
+        assert state.measured_recall >= 0.9
+        assert 0.0 < state.flop_fraction < 1.0
+        # smallest: halving n_probe must fail the floor (or n_probe == 1)
+        if state.n_probe > 1:
+            truth_tuner = IVFTuner(recall_target=1.01, sample=32, k=50)
+            probe_state = truth_tuner.tune(c)  # floor unreachable: best
+            assert probe_state.outcome == "floor_unmet"
+
+    def test_no_layout_outcome(self):
+        c = DeviceCorpus(dims=16)
+        c.add_batch([f"a{i}" for i in range(64)],
+                    np.random.default_rng(0).normal(
+                        size=(64, 16)).astype(np.float32))
+        state = IVFTuner().tune(c)
+        assert state.outcome == ("degraded" if _CHAOS else "no_layout")
+        assert not state.serving_pruned
+
+    def test_floor_unmet_when_layout_misses_rows(self):
+        # fit over the first half, then add the second half WITHIN
+        # capacity (no grow → the layout stays epoch-valid but covers
+        # half the corpus): even probing every cluster cannot reach the
+        # floor, so the tuner must refuse to serve the layout
+        rows, _ = _clustered(4096, 32, 32, seed=1)
+        c = DeviceCorpus(dims=32, capacity=8192)
+        c.add_batch([f"v{i}" for i in range(2048)], rows[:2048])
+        fitted = c.cluster(k=32, iters=5)
+        c.add_batch([f"w{i}" for i in range(2048)], rows[2048:])
+        state = IVFTuner(recall_target=0.95, sample=32, k=50).tune(c)
+        if _CHAOS:
+            assert fitted == 0 and state.outcome == "degraded"
+            return
+        assert fitted > 0
+        assert c._ivf is not None  # plain adds keep the layout serving
+        assert state.outcome == "floor_unmet"
+        assert state.measured_recall < 0.95
+        assert not state.serving_pruned
+
+    def test_sharded_tunes_local_k(self):
+        from nornicdb_tpu.errors import DeviceUnavailable
+        from nornicdb_tpu.parallel import ShardedCorpus, make_mesh
+
+        rows, _ = _clustered(4096, 32, 32, seed=2)
+        try:
+            c = ShardedCorpus(dims=32)
+        except DeviceUnavailable:
+            import jax
+
+            c = ShardedCorpus(dims=32, mesh=make_mesh(devices=jax.devices()))
+        c.add_batch([f"v{i}" for i in range(4096)], rows)
+        fitted = c.cluster(k=32, iters=5)
+        state = IVFTuner(recall_target=0.9, sample=24, k=40).tune(c)
+        if _CHAOS:
+            assert fitted == 0 and state.outcome == "degraded"
+            return
+        assert fitted > 0
+        assert state.outcome == "ok"
+        # the ladder only records WIDENING local_k values (0 = the
+        # path's default width; smaller entries are bit-identical)
+        assert state.local_k == 0 or state.local_k >= 80
+
+    def test_tuner_never_raises(self):
+        class Broken:
+            def __len__(self):
+                return 10_000
+
+            def __getattr__(self, name):
+                raise RuntimeError("boom")
+
+        state = IVFTuner().tune(Broken())
+        # a broken corpus must land on a non-serving outcome, not raise
+        assert state.outcome in ("error", "degraded")
+        assert not state.serving_pruned
+
+
+def _service(dims=32, **cfg_kwargs) -> tuple[SearchService, MemoryEngine]:
+    cfg = SearchConfig(
+        tune_min_rows=cfg_kwargs.pop("tune_min_rows", 256),
+        tune_sample=cfg_kwargs.pop("tune_sample", 16),
+        tune_k=cfg_kwargs.pop("tune_k", 20),
+        recall_target=cfg_kwargs.pop("recall_target", 0.9),
+        **cfg_kwargs,
+    )
+    eng = MemoryEngine()
+    return SearchService(eng, dims=dims, config=cfg), eng
+
+
+def _index(svc, eng, vecs, prefix="n"):
+    for i, v in enumerate(vecs):
+        node = Node(id=f"{prefix}{i}", labels=["D"],
+                    properties={"content": f"doc {prefix}{i}"}, embedding=v)
+        eng.create_node(node)
+        svc.index_node(node)
+
+
+class TestServiceTuning:
+    def test_recluster_installs_tuned_plan(self):
+        svc, eng = _service()
+        rows, centers = _clustered(600, 32, 16, seed=3)
+        _index(svc, eng, rows)
+        try:
+            svc.recluster(k=16, iters=4)
+            state = svc._tune_state
+            assert state is not None
+            if _CHAOS:
+                # degraded backend: no pruned plan, full scan serves
+                assert state.outcome == "degraded"
+                assert svc._corpus_search_kwargs(svc.corpus()) == {}
+                assert svc.vector_candidates(centers[2], k=3)
+                return
+            assert state.outcome == "ok", state.as_dict()
+            kwargs = svc._corpus_search_kwargs(svc.corpus())
+            assert kwargs.get("n_probe") == state.n_probe > 0
+            # twin-path: tuned pruned serving vs exact, on corpus rows
+            corpus = svc.corpus()
+            exact = corpus.search(rows[:8], k=10, exact=True)
+            tuned = corpus.search(rows[:8], k=10, **kwargs)
+            rec = np.mean([
+                len({i for i, _ in g} & {i for i, _ in w}) / len(w)
+                for g, w in zip(tuned, exact)
+            ])
+            assert rec >= 0.9, rec
+            # observability: /admin/stats shape
+            snap = svc.stats_snapshot()
+            assert snap["ivf_tuner"]["tunes"]["ok"] >= 1
+            assert snap["ivf_tuner"]["active"]["n_probe"] == state.n_probe
+            assert snap["ivf_tuner"]["recall_target"] == 0.9
+        finally:
+            svc.shutdown()
+
+    def test_explicit_n_probe_overrides_tuner(self):
+        svc, eng = _service(n_probe=3)
+        rows, _ = _clustered(400, 32, 8, seed=4)
+        _index(svc, eng, rows)
+        try:
+            svc.recluster(k=8, iters=3)
+            kwargs = svc._corpus_search_kwargs(svc.corpus())
+            assert kwargs.get("n_probe") == 3  # operator escape hatch wins
+        finally:
+            svc.shutdown()
+
+    def test_too_small_corpus_skips_tuning(self):
+        svc, eng = _service(tune_min_rows=10_000)
+        rows, _ = _clustered(300, 32, 8, seed=5)
+        _index(svc, eng, rows)
+        try:
+            svc.recluster(k=8, iters=3)
+            state = svc._tune_state
+            assert state is not None and state.outcome == "too_small"
+            assert svc._corpus_search_kwargs(svc.corpus()) == {}
+        finally:
+            svc.shutdown()
+
+    def test_slowlog_probe_surfaces_tuner_state(self):
+        from nornicdb_tpu.telemetry.slowlog import counters_probe
+
+        svc, eng = _service()
+        rows, _ = _clustered(600, 32, 16, seed=6)
+        _index(svc, eng, rows)
+        try:
+            svc.recluster(k=16, iters=4)
+
+            class Db:
+                _search = svc
+                storage = None
+
+            probed = counters_probe(Db())
+            assert probed is not None
+            assert "ivf_tunes_total" in probed
+            assert "ivf_measured_recall" in probed
+            if not _CHAOS:
+                assert probed["ivf_n_probe"] >= 1
+        finally:
+            svc.shutdown()
+
+    def test_tune_metric_families_registered(self):
+        from nornicdb_tpu.telemetry.metrics import REGISTRY
+
+        text = REGISTRY.render_prometheus()
+        for family in ("nornicdb_ivf_tunes_total",
+                       "nornicdb_ivf_measured_recall",
+                       "nornicdb_ivf_n_probe",
+                       "nornicdb_ivf_local_k"):
+            assert family in text, family
+        # every outcome label pre-registered (the catalog contract)
+        for outcome in ("ok", "floor_unmet", "degraded", "no_layout"):
+            assert f'outcome="{outcome}"' in text, outcome
+
+
+class TestDriftRetune:
+    def test_churn_past_threshold_triggers_background_retune(self):
+        """Interleaved add/remove churn past the drift threshold must
+        schedule a background re-tune whose fresh layout+plan restores
+        the recall floor — without any operator call. Chaos-aware: under
+        a hung backend the re-tune still runs but lands "degraded" and
+        serving stays on the exact host path (recall 1.0 by
+        construction)."""
+        svc, eng = _service(drift_threshold=0.2)
+        rows, _ = _clustered(1500, 32, 16, seed=7, spread=0.25)
+        _index(svc, eng, rows[:900])
+        try:
+            svc.recluster(k=16, iters=4)
+            first = svc._tune_state
+            assert first is not None
+            tunes_before = sum(svc.tune_counts.values())
+            # churn: remove a slice, add the remainder (new rows are
+            # invisible to the fitted layout — the recall-drift source)
+            for i in range(0, 150):
+                svc.remove_node(f"n{i}")
+                eng.delete_node(f"n{i}")
+            _index(svc, eng, rows[900:], prefix="m")
+            deadline = time.time() + 60
+            while time.time() < deadline:
+                with svc._lock:
+                    done = (
+                        sum(svc.tune_counts.values()) > tunes_before
+                        and not svc._retuning
+                        and svc._churn_since_tune < 32
+                    )
+                if done:
+                    break
+                time.sleep(0.1)
+            assert sum(svc.tune_counts.values()) > tunes_before, (
+                "drift never triggered a re-tune", svc.tune_counts,
+                svc._churn_since_tune,
+            )
+            state = svc._tune_state
+            if _CHAOS:
+                assert state.outcome == "degraded"
+                # degraded serving is the exact host scan: floor holds
+                got = svc.vector_candidates(rows[1000], k=5)
+                assert got and got[0][0] == "m100"
+                return
+            assert state.outcome == "ok", state.as_dict()
+            # the floor is restored over the POST-churn corpus: tuned
+            # serving must see the new rows (twin-path vs exact)
+            corpus = svc.corpus()
+            kwargs = svc._corpus_search_kwargs(corpus)
+            assert kwargs.get("n_probe", 0) > 0
+            eval_rows = rows[900:][:16]
+            exact = corpus.search(eval_rows, k=10, exact=True)
+            tuned = corpus.search(eval_rows, k=10, **kwargs)
+            rec = np.mean([
+                len({i for i, _ in g} & {i for i, _ in w}) / len(w)
+                for g, w in zip(tuned, exact)
+            ])
+            assert rec >= 0.9, rec
+        finally:
+            svc.shutdown()
+
+    def test_no_retune_below_threshold(self):
+        svc, eng = _service(drift_threshold=0.9)
+        rows, _ = _clustered(800, 32, 16, seed=8)
+        _index(svc, eng, rows[:700])
+        try:
+            svc.recluster(k=16, iters=4)
+            tunes_before = sum(svc.tune_counts.values())
+            _index(svc, eng, rows[700:], prefix="x")
+            time.sleep(0.5)
+            assert sum(svc.tune_counts.values()) == tunes_before
+            assert svc._churn_since_tune == 100
+        finally:
+            svc.shutdown()

@@ -453,6 +453,125 @@ class TestWorkerDevicePlane:
         assert s["read_plane"]["segments"]["corpus"]["generation"] >= 1
 
 
+class TestQdrantWorkerDevicePlane:
+    """Qdrant points/search rides the broker worker path (ROADMAP 1b):
+    the surface already takes raw vectors, so workers ship the query over
+    the DeviceBroker instead of proxying the whole HTTP request — with
+    the X-Nornic-Served proof header and body-identical results."""
+
+    def _setup_collection(self, db, pool_port, n=24, dims=64):
+        import numpy as np
+
+        rng = np.random.default_rng(5)
+        vecs = rng.normal(size=(n, dims)).astype(np.float32)
+        status, _, data = _req(
+            pool_port, "PUT", "/collections/workerq",
+            {"vectors": {"size": dims, "distance": "Cosine"}},
+        )
+        assert status == 200, data
+        points = [
+            {"id": i, "vector": [float(x) for x in vecs[i]],
+             "payload": {"tag": f"t{i % 3}"}}
+            for i in range(n)
+        ]
+        status, _, data = _req(
+            pool_port, "PUT", "/collections/workerq/points",
+            {"points": points},
+        )
+        assert status == 200, data
+        return vecs
+
+    def test_qdrant_search_served_by_broker_twin_path(self, device_pool):
+        db, primary, pool = device_pool
+        vecs = self._setup_collection(db, pool.port)
+        body = {"vector": [float(x) for x in vecs[7]], "limit": 5}
+        status, headers, data = _req(
+            pool.port, "POST", "/collections/workerq/points/search", body
+        )
+        assert status == 200, data
+        # proof header: the broker answered, not the HTTP proxy (the
+        # qdrant broker path serves under chaos too — collection corpora
+        # host-fallback inside the primary, no DEGRADED redirect)
+        assert headers.get("X-Nornic-Served") == "broker"
+        p_status, p_headers, p_data = _req(
+            primary.port, "POST", "/collections/workerq/points/search", body
+        )
+        assert p_status == 200
+        assert p_headers.get("X-Nornic-Served") is None  # primary's own path
+        worker_hits = json.loads(data)["result"]
+        primary_hits = json.loads(p_data)["result"]
+        # twin-path equivalence: ids, scores AND payloads identical —
+        # both sides answered from the one shared registry
+        assert worker_hits == primary_hits
+        assert worker_hits[0]["id"] == 7
+        assert worker_hits[0]["payload"]["tag"] == "t1"
+        assert pool.broker.counters["qdrant_ok"] >= 1
+
+    def test_qdrant_filtered_search_proxies(self, device_pool):
+        db, _primary, pool = device_pool
+        vecs = self._setup_collection(db, pool.port)
+        body = {
+            "vector": [float(x) for x in vecs[3]], "limit": 5,
+            "filter": {"must": [{"key": "tag", "match": {"value": "t0"}}]},
+        }
+        status, headers, data = _req(
+            pool.port, "POST", "/collections/workerq/points/search", body
+        )
+        assert status == 200, data
+        # filters need the primary's payload scan: proxied, not broker
+        assert headers.get("X-Nornic-Served") is None
+        hits = json.loads(data)["result"]
+        assert hits and all(h["payload"]["tag"] == "t0" for h in hits)
+
+    def test_qdrant_unknown_collection_proxies_primary_error(
+            self, device_pool):
+        db, primary, pool = device_pool
+        self._setup_collection(db, pool.port)
+        body = {"vector": [0.0, 1.0], "limit": 3}
+        status, headers, data = _req(
+            pool.port, "POST", "/collections/nosuch/points/search", body
+        )
+        p_status, _, p_data = _req(
+            primary.port, "POST", "/collections/nosuch/points/search", body
+        )
+        # the primary owns the error shape; the worker must not invent one
+        assert status == p_status and status >= 400
+        assert data == p_data
+        assert headers.get("X-Nornic-Served") is None
+
+    def test_qdrant_upsert_invalidates_worker_cache(self, device_pool):
+        import numpy as np
+
+        db, _primary, pool = device_pool
+        vecs = self._setup_collection(db, pool.port)
+        body = {"vector": [float(x) for x in vecs[2]], "limit": 3}
+        _req(pool.port, "POST", "/collections/workerq/points/search", body)
+        _status, headers, _ = _req(
+            pool.port, "POST", "/collections/workerq/points/search", body
+        )
+        assert headers.get("X-Nornic-Cache") == "hit"
+        # upsert a point matching the query almost exactly: the
+        # generation bump must kill the cached entry and the fresh broker
+        # answer must surface the new point
+        new_vec = vecs[2] + np.float32(1e-4)
+        _req(pool.port, "PUT", "/collections/workerq/points", {
+            "points": [{"id": 999,
+                        "vector": [float(x) for x in new_vec],
+                        "payload": {"tag": "fresh"}}]})
+        deadline = time.time() + 10
+        found = False
+        while time.time() < deadline and not found:
+            _s, h2, data = _req(
+                pool.port, "POST", "/collections/workerq/points/search",
+                body,
+            )
+            hits = json.loads(data).get("result", [])
+            found = any(h.get("id") == 999 for h in hits)
+            if not found:
+                time.sleep(0.2)
+        assert found, "worker served stale qdrant results after upsert"
+
+
 class TestGrpcWorkerDevicePlane:
     def test_grpc_vector_served_without_primary_grpc_hop(self):
         """A gRPC worker answers vector SearchRequests through the broker
