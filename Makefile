@@ -1,6 +1,6 @@
 # NornicDB-TPU (ref: the reference's Makefile test/build targets)
 
-.PHONY: test test-fast lint lint-baseline sanitize smoke chaos soak soak-ci soak-nornsan soak-multiworker bench bench-search bench-embed bench-generate bench-workers native e2e-bench clean
+.PHONY: test test-fast lint lint-baseline sanitize smoke chaos soak soak-ci soak-nornsan soak-multiworker bench bench-search bench-embed bench-generate bench-workers bench-cypher native e2e-bench clean
 
 test:
 	python -m pytest tests/ -q
@@ -13,12 +13,12 @@ lint-baseline:
 
 # runtime lock sanitizer over the threaded suites (docs/linting.md#nornsan)
 sanitize:
-	NORNSAN=1 python -m pytest tests/test_concurrency.py tests/test_replication.py tests/test_replication_scenarios.py tests/test_nornsan.py tests/test_adjacency.py tests/test_telemetry.py tests/test_backend.py tests/test_sharded_serving.py tests/test_int8_residency.py tests/test_ivf_tuner.py tests/test_serving.py tests/test_genserve.py tests/test_broker.py tests/test_shm_readplane.py tests/test_workers.py -q -m 'not slow'
+	NORNSAN=1 python -m pytest tests/test_concurrency.py tests/test_replication.py tests/test_replication_scenarios.py tests/test_nornsan.py tests/test_adjacency.py tests/test_telemetry.py tests/test_backend.py tests/test_sharded_serving.py tests/test_int8_residency.py tests/test_ivf_tuner.py tests/test_serving.py tests/test_genserve.py tests/test_broker.py tests/test_shm_readplane.py tests/test_workers.py tests/test_columnar.py -q -m 'not slow'
 
 # search/embed suite with the accelerator backend forced to hang: the
 # lifecycle manager must keep the stack serving from CPU (docs/backend.md)
 chaos:
-	NORNICDB_FAKE_BACKEND=hang NORNICDB_DEVICE_ACQUIRE_TIMEOUT=2 python -m pytest tests/test_embed_search.py tests/test_search_unit_depth.py tests/test_sharded_serving.py tests/test_int8_residency.py tests/test_ivf_tuner.py tests/test_serving.py tests/test_genserve.py tests/test_broker.py tests/test_shm_readplane.py tests/test_workers.py -q -m 'not slow'
+	NORNICDB_FAKE_BACKEND=hang NORNICDB_DEVICE_ACQUIRE_TIMEOUT=2 python -m pytest tests/test_embed_search.py tests/test_search_unit_depth.py tests/test_sharded_serving.py tests/test_int8_residency.py tests/test_ivf_tuner.py tests/test_serving.py tests/test_genserve.py tests/test_broker.py tests/test_shm_readplane.py tests/test_workers.py tests/test_columnar.py -q -m 'not slow'
 
 # live-server /metrics + /admin/traces smoke (docs/observability.md)
 smoke:
@@ -56,6 +56,7 @@ bench:
 	python scripts/bench_search.py
 	python scripts/bench_embed.py
 	python scripts/bench_generate.py
+	python scripts/bench_cypher.py
 
 # passthrough: `make bench-search ROWS=10000000 DIMS=64 MODE=exact,ivf
 # BACKENDS=sharded_int8` regenerates the artifact at any scale; the
@@ -82,6 +83,13 @@ bench-generate:
 # batch invariant and the 4-worker >= 2x scaling floor at exit)
 bench-workers:
 	python scripts/bench_workers.py
+
+# columnar Cypher pipeline vs the row-at-a-time interpreter at 100k
+# nodes / 500k edges (writes BENCH_cypher.json; exit invariants: zero
+# fresh compiles + zero all_edges() rescans in the timed pass, >=3x p50
+# on two shapes — docs/operations.md "Columnar Cypher execution")
+bench-cypher:
+	python scripts/bench_cypher.py $(BENCH_CYPHER_ARGS)
 
 e2e-bench:
 	python benchmarks/endpoints_bench.py

@@ -172,3 +172,55 @@ class ColumnarScanIndex:
             return None
         with self._lock:
             return int(compiled.mask(lc, params).sum())
+
+    def prop_match_ids(self, label: str,
+                       props: dict) -> Optional[list[str]]:
+        """Ids of label members whose property columns equal every entry
+        of ``props`` under the matcher's prop-map semantics (_value_eq:
+        ``{k: null}`` matches a missing property — deliberately NOT the
+        WHERE evaluator's three-valued ``_eq``). None when the index
+        can't serve. Unindexed anchored scans ride this instead of
+        materializing every label member."""
+        from nornicdb_tpu.cypher.matcher import _value_eq
+
+        lc = self._get(label)
+        if lc is None:
+            return None
+        with self._lock:
+            items = [(lc.column(k), v) for k, v in props.items()]
+            return [
+                lc.ids[i] for i in range(len(lc.ids))
+                if all(_value_eq(col[i], v) for col, v in items)
+            ]
+
+    def column_values(self, label: str, key: str,
+                      ids: list) -> Optional[list]:
+        """Property values for `ids` (all carrying `label`), aligned with
+        the input order; None when the index can't serve. The columnar
+        pipeline's projections/sort-keys/group-keys ride this instead of
+        materializing Node copies for every surviving row."""
+        lc = self._get(label)
+        if lc is None:
+            return None
+        with self._lock:
+            col = lc.cols.get(key)
+            if col is None:
+                # property never seen on any member of this label
+                return [None] * len(ids)
+            pos = lc.pos
+            out = []
+            for s in ids:
+                i = pos.get(s)
+                out.append(col[i] if i is not None else None)
+            return out
+
+    def label_ids(self, label: str) -> Optional[list[str]]:
+        """Ids of every node carrying `label` (unsorted — callers order),
+        or None when the index can't serve (busy build window). Feeds the
+        columnar pipeline's label scans and membership masks without
+        materializing a single Node."""
+        lc = self._get(label)
+        if lc is None:
+            return None
+        with self._lock:
+            return list(lc.ids)

@@ -1,0 +1,1406 @@
+"""Columnar Cypher operator pipeline over the CSR adjacency snapshot.
+
+This module retires the executor's ad-hoc pattern-fastpath family
+(``query_patterns.go`` / ``optimized_executors.go`` in the reference) into
+one architecture: a planner pattern-compiles a ``Query`` AST into a DAG of
+batched array operators — NodeScan / Filter / Expand / Aggregate /
+Project / Sort-Limit — evaluated over:
+
+* the PR 4 CSR snapshot (``storage/adjacency.py``): per-direction
+  ``offsets``/``neighbors``/``edge_rows`` arrays plus per-edge
+  src/dst/type columns, captured per query as a delta-folded
+  :class:`~nornicdb_tpu.storage.adjacency.CSRView`;
+* the colindex property columns (``cypher/colindex.py``) for label-scan
+  WHERE masks, via the same :func:`~nornicdb_tpu.cypher.parallel.compile_where`
+  compiler the scan fastpath uses — bit-identical three-valued semantics;
+* batched node/edge materialization (one ``batch_get_nodes`` per variable,
+  never a per-row engine call) for property gathers and projections.
+
+**Equivalence contract** (the PR 4 discipline, enforced by
+``tests/test_columnar.py``): every columnar result is bit-identical to the
+generic interpreter, *including row order*.  Scans emit id-sorted
+candidates; expansions order each frontier node's edges by edge id (the
+``erow_rank`` array), nested hops compose lexicographically — exactly the
+generic DFS order.  Aggregation groups in first-encounter order, float
+sums run left-to-right per group (Python ``sum``, not pairwise
+``np.sum``), and sorting reuses the executor's ``_multisort``.
+
+**Per-operator fallback**: any unsupported expression or clause ends the
+columnar prefix with a ``FallbackOp`` that materializes the partial
+binding table into generic rows and hands them to the interpreter for the
+remaining clauses (plus any residual WHERE conjuncts — sound to apply
+late because WHERE is conjunctive and every filter here is
+order-stable).  Shapes with no plannable prefix return to the generic
+engine untouched.
+
+**Device offload**: scoring-heavy Sort/Limit plans (large N, small K,
+single numeric key) use the accelerator's ``top_k`` to find the boundary
+value, then host-sort only the surviving candidate set — results remain
+bit-identical because ties at the boundary are widened before the exact
+stable sort.  The offload gates on the PR 6 backend manager's
+*non-blocking* readiness check: a hung device means host columnar, never
+a wedged query (the soak's hang-window invariant).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import logging
+import os
+import threading
+import time
+import weakref
+from typing import Any, Callable, Optional
+
+import numpy as np
+
+from nornicdb_tpu.cypher import ast
+from nornicdb_tpu.cypher import parallel as _parallel
+from nornicdb_tpu.cypher.parallel import (
+    CompiledWhere,
+    NodeListSource,
+    _join_and,
+    _split_and,
+)
+from nornicdb_tpu.cypher.plan import (
+    OFFLOAD_CELLS,
+    OP_CELLS,
+    Q_CELLS,
+    ROWS_HIST,
+    PlanCache,
+    key_hash,
+    merge_lits,
+    normalize_query,
+)
+from nornicdb_tpu.errors import NotFoundError
+from nornicdb_tpu.telemetry.tracing import tracer as _tracer
+
+log = logging.getLogger(__name__)
+
+_AGG_FNS = ("count", "sum", "avg", "min", "max", "collect")
+
+
+class _Bail(Exception):
+    """Capability bail: hand the whole query back to the generic engine.
+    Never used for real query errors — those propagate unchanged."""
+
+
+# ---------------------------------------------------------------- helpers
+def _expr_vars(e: Any, out: set) -> None:
+    """Every Variable name under ``e`` (conservative: shadowed comprehension
+    locals count too, which only routes the conjunct to the residual)."""
+    if isinstance(e, ast.Variable):
+        out.add(e.name)
+        return
+    if dataclasses.is_dataclass(e) and not isinstance(e, type):
+        for f in dataclasses.fields(e):
+            _expr_vars(getattr(e, f.name), out)
+    elif isinstance(e, (list, tuple)):
+        for x in e:
+            _expr_vars(x, out)
+    elif isinstance(e, dict):
+        for v in e.values():
+            _expr_vars(v, out)
+
+
+class _ObjSource:
+    """Column access over a materialized per-row entity list (the
+    compile_where source protocol; None entities read as all-null)."""
+
+    def __init__(self, objs: list):
+        self.objs = objs
+
+    def __len__(self) -> int:
+        return len(self.objs)
+
+    def column(self, key: str) -> list:
+        return [o.properties.get(key) if o is not None else None
+                for o in self.objs]
+
+
+def _const_getter(e: ast.Expr) -> Optional[Callable[[dict], Any]]:
+    if isinstance(e, ast.Literal):
+        return lambda params, v=e.value: v
+    if isinstance(e, ast.Parameter):
+        return lambda params, n=e.name: params.get(n)
+    return None
+
+
+def _colindex_for(ex, label: str):
+    """The executor's columnar scan index, honoring the operator escape
+    hatch: raising ``ParallelConfig.columnar_min_rows`` bypasses the scan
+    index everywhere (the `_match_scan_fast`/`colindex` contract) — the
+    pipeline then serves the same results through engine label scans."""
+    if ex.storage.count_nodes_by_label(label) < \
+            _parallel.get_parallel_config().columnar_min_rows:
+        return None
+    return ex._scan_index()
+
+
+# ---------------------------------------------------------------- state
+class _State:
+    """Mutable execution state: the columnar binding table.
+
+    ``node_cols[var]`` is an int64 array of snapshot vocab indices;
+    ``edge_cols[var]`` an int64 array of CSR edge-row numbers valid for
+    the pinned ``view``.  Row order IS the generic engine's row order."""
+
+    def __init__(self, ex, q, params, stats, snap, view, trace):
+        self.ex = ex
+        self.q = q
+        self.params = params
+        self.stats = stats
+        self.snap = snap
+        self.view = view
+        self.trace = trace
+        self.n = 0
+        self.node_cols: dict[str, np.ndarray] = {}
+        self.edge_cols: dict[str, np.ndarray] = {}
+        self.version = 0
+        self.peak_rows = 0
+        # var -> single label every row of that column is known to carry
+        # (scan label / enforced dst-label mask): lets property gathers
+        # ride the colindex columns instead of materializing Node copies
+        self.var_label: dict[str, str] = {}
+        self._objs: dict[tuple[str, int], list] = {}
+        self._edge_objs: dict[tuple[str, int], list] = {}
+        self._row_ids: dict[tuple[str, int], list] = {}
+        self._label_idx: dict[tuple, np.ndarray] = {}
+
+    # -- table mutation ----------------------------------------------------
+    def set_initial(self, var: str, idx: np.ndarray,
+                    objs: Optional[list] = None,
+                    label: Optional[str] = None) -> None:
+        self.n = len(idx)
+        self.node_cols = {var: idx}
+        self.edge_cols = {}
+        self.version += 1
+        self.peak_rows = max(self.peak_rows, self.n)
+        if objs is not None:
+            self._objs[(var, self.version)] = objs
+        if label is not None:
+            self.var_label[var] = label
+
+    def apply_mask(self, mask: np.ndarray) -> None:
+        sel = np.nonzero(mask)[0]
+        old_version = self.version
+        self.version += 1
+        for k, col in self.node_cols.items():
+            self.node_cols[k] = col[sel]
+        for k, col in self.edge_cols.items():
+            self.edge_cols[k] = col[sel]
+        # re-key surviving materializations instead of refetching
+        sel_list = sel.tolist()
+        for (var, ver), objs in list(self._objs.items()):
+            if ver == old_version:
+                self._objs[(var, self.version)] = [objs[i] for i in sel_list]
+        for (var, ver), objs in list(self._edge_objs.items()):
+            if ver == old_version:
+                self._edge_objs[(var, self.version)] = [objs[i]
+                                                        for i in sel_list]
+        for (var, ver), ids in list(self._row_ids.items()):
+            if ver == old_version:
+                self._row_ids[(var, self.version)] = [ids[i]
+                                                      for i in sel_list]
+        self.n = len(sel)
+
+    def apply_expand(self, src_row: np.ndarray, dst_var: Optional[str],
+                     dst_idx: Optional[np.ndarray], edge_var: str,
+                     edge_rows: np.ndarray) -> None:
+        self.version += 1
+        self._objs.clear()   # refetched lazily against the new row set
+        self._edge_objs.clear()
+        self._row_ids.clear()
+        for k, col in self.node_cols.items():
+            self.node_cols[k] = col[src_row]
+        for k, col in self.edge_cols.items():
+            self.edge_cols[k] = col[src_row]
+        if dst_var is not None and dst_idx is not None:
+            self.node_cols[dst_var] = dst_idx
+        self.edge_cols[edge_var] = edge_rows
+        self.n = len(src_row)
+        self.peak_rows = max(self.peak_rows, self.n)
+
+    # -- gathers -----------------------------------------------------------
+    def node_objects(self, var: str) -> list:
+        key = (var, self.version)
+        hit = self._objs.get(key)
+        if hit is not None:
+            return hit
+        idxs = self.node_cols[var]
+        uniq = np.unique(idxs) if len(idxs) else np.zeros(0, np.int64)
+        ids_list = self.view.ids
+        uid_pairs = [(i, ids_list[i]) for i in uniq.tolist()]
+        by_id = {n.id: n for n in self.ex.storage.batch_get_nodes(
+            sorted(p[1] for p in uid_pairs))}
+        by_idx = {i: by_id.get(s) for i, s in uid_pairs}
+        out = [by_idx[i] for i in idxs.tolist()]
+        self._objs[key] = out
+        return out
+
+    def edge_objects(self, var: str) -> list:
+        key = (var, self.version)
+        hit = self._edge_objs.get(key)
+        if hit is not None:
+            return hit
+        rows = self.edge_cols[var]
+        uniq = np.unique(rows) if len(rows) else np.zeros(0, np.int64)
+        row_ids = self.view.row_ids
+        by_row: dict[int, Any] = {}
+        for r in uniq.tolist():
+            try:
+                by_row[r] = self.ex.storage.get_edge(row_ids[r])
+            except NotFoundError:
+                by_row[r] = None  # deleted mid-query: reads as null
+        out = [by_row[r] for r in rows.tolist()]
+        self._edge_objs[key] = out
+        return out
+
+    def row_ids_for(self, var: str) -> list:
+        memo_key = (var, self.version)
+        hit = self._row_ids.get(memo_key)
+        if hit is None:
+            ids_list = self.view.ids
+            hit = [ids_list[i] for i in self.node_cols[var].tolist()]
+            self._row_ids[memo_key] = hit
+        return hit
+
+    def prop_column(self, var: str, key: str) -> list:
+        if var not in self.node_cols:
+            return _ObjSource(self.edge_objects(var)).column(key)
+        label = self.var_label.get(var)
+        if label is not None and (var, self.version) not in self._objs:
+            colind = _colindex_for(self.ex, label)
+            if colind is not None:
+                vals = colind.column_values(label, key,
+                                            self.row_ids_for(var))
+                if vals is not None:
+                    return vals
+        return _ObjSource(self.node_objects(var)).column(key)
+
+    def label_member_idx(self, labels: tuple) -> np.ndarray:
+        """Vocab indices of every node carrying any of ``labels``."""
+        hit = self._label_idx.get(labels)
+        if hit is not None:
+            return hit
+        ids: set[str] = set()
+        for label in labels:
+            colind = _colindex_for(self.ex, label)
+            got = colind.label_ids(label) if colind is not None else None
+            if got is None:
+                got = [n.id for n in
+                       self.ex.storage.get_nodes_by_label(label)]
+            ids.update(got)
+        idx = self.snap.indices_of(sorted(ids)) if ids else \
+            np.zeros(0, np.int64)
+        idx = idx[idx >= 0]
+        self._label_idx[labels] = idx
+        return idx
+
+    # -- generic-row materialization --------------------------------------
+    def materialize_rows(self, named_node_vars: list[str],
+                         named_edge_vars: list[str]) -> list[dict]:
+        cols: dict[str, list] = {}
+        for var in named_node_vars:
+            cols[var] = self.node_objects(var)
+        for var in named_edge_vars:
+            cols[var] = self.edge_objects(var)
+        names = list(cols)
+        lists = [cols[v] for v in names]
+        return [dict(zip(names, vals)) for vals in zip(*lists)] \
+            if names else [{} for _ in range(self.n)]
+
+
+# ---------------------------------------------------------------- operators
+class _Op:
+    kind = "scan"
+    engine = "columnar"
+    label = ""
+    self_timed = False  # ReturnOp observes its own sub-phase cells
+
+    def run(self, st: _State):  # pragma: no cover - interface
+        raise NotImplementedError
+
+
+def _ids_to_idx(st: _State, ids: list[str]) -> np.ndarray:
+    idx = st.snap.indices_of(ids)
+    if len(idx) and (idx < 0).any():
+        # a scan source knows a node the snapshot doesn't: stale event
+        # window — serve this query generically rather than drop rows
+        raise _Bail("scan id missing from snapshot vocab")
+    return idx
+
+
+class AnchorScanOp(_Op):
+    """Anchor with a property map: index-backed candidate lookup through
+    the matcher (schema equality indexes), id-sorted by contract."""
+
+    kind = "scan"
+
+    def __init__(self, var: str, node_pat: ast.NodePattern):
+        self.var = var
+        self.pat = ast.NodePattern(node_pat.variable, node_pat.labels,
+                                   node_pat.properties)
+        props = ", ".join(node_pat.properties.items.keys()) \
+            if node_pat.properties else ""
+        self.label = f"AnchorScan({var}:{':'.join(node_pat.labels)} " \
+                     f"{{{props}}})"
+
+    def run(self, st: _State):
+        ex = st.ex
+        if len(self.pat.labels) == 1 and self.pat.properties is not None:
+            label = self.pat.labels[0]
+            keys = sorted(self.pat.properties.items.keys())
+            indexed = ex.schema is not None and (
+                ex.schema.has_prop_index(label, keys)
+                or any(ex.schema.has_prop_index(label, [k]) for k in keys))
+            colind = None if indexed else _colindex_for(ex, label)
+            if colind is not None:
+                # unindexed anchor: equality mask over the label columns —
+                # survivors only, no per-candidate Node materialization
+                props = ex.matcher._node_props(self.pat, {}, st.params)
+                ids = colind.prop_match_ids(label, props or {})
+                if ids is not None:
+                    st.set_initial(self.var, _ids_to_idx(st, sorted(ids)),
+                                   label=label)
+                    return
+        nodes = ex.matcher._candidates(self.pat, {}, st.params)
+        idx = _ids_to_idx(st, [n.id for n in nodes])
+        st.set_initial(self.var, idx, objs=nodes,
+                       label=self.pat.labels[0]
+                       if len(self.pat.labels) == 1 else None)
+
+
+class LabelScanOp(_Op):
+    kind = "scan"
+
+    def __init__(self, var: str, labels: list[str]):
+        self.var = var
+        self.labels = list(labels)
+        self.label = f"NodeScan({var}:{':'.join(labels)})"
+
+    def run(self, st: _State):
+        ids: Optional[set[str]] = set()
+        for label in self.labels:
+            colind = _colindex_for(st.ex, label)
+            got = colind.label_ids(label) if colind is not None else None
+            if got is None:
+                ids = None
+                break
+            ids.update(got)
+        objs = None
+        if ids is None:
+            seen: dict[str, Any] = {}
+            for label in self.labels:
+                for n in st.ex.storage.get_nodes_by_label(label):
+                    seen[n.id] = n
+            ordered = sorted(seen)
+            objs = [seen[i] for i in ordered]
+        else:
+            ordered = sorted(ids)
+        st.set_initial(self.var, _ids_to_idx(st, ordered), objs=objs,
+                       label=self.labels[0]
+                       if len(self.labels) == 1 else None)
+
+
+class AllScanOp(_Op):
+    kind = "scan"
+
+    def __init__(self, var: str):
+        self.var = var
+        self.label = f"NodeScan({var})"
+
+    def run(self, st: _State):
+        view = st.view
+        alive = np.nonzero(view.node_alive)[0]
+        pairs = sorted((view.ids[i], i) for i in alive.tolist())
+        idx = np.fromiter((p[1] for p in pairs), np.int64, len(pairs))
+        st.set_initial(self.var, idx)
+
+
+class MaskedLabelScanOp(_Op):
+    """Fused label scan + fully-columnar WHERE mask over the colindex
+    property columns — survivors only ever materialize as ids."""
+
+    kind = "scan"
+
+    def __init__(self, var: str, label: str, cw: CompiledWhere,
+                 where_text: str):
+        self.var = var
+        self.lbl = label
+        self.cw = cw
+        self.label = f"NodeScan({var}:{label} WHERE {where_text})"
+
+    def run(self, st: _State):
+        colind = _colindex_for(st.ex, self.lbl)
+        ids = colind.masked_ids(self.lbl, self.cw, st.params) \
+            if colind is not None else None
+        objs = None
+        if ids is None:  # busy build window / no index: engine scan + mask
+            nodes = st.ex.storage.get_nodes_by_label(self.lbl)
+            nodes.sort(key=lambda n: n.id)
+            mask = self.cw.mask(NodeListSource(nodes), st.params)
+            objs = [n for n, m in zip(nodes, mask) if m]
+            ordered = [n.id for n in objs]
+        else:
+            ordered = sorted(ids)
+        st.set_initial(self.var, _ids_to_idx(st, ordered), objs=objs,
+                       label=self.lbl)
+
+
+class FilterOp(_Op):
+    kind = "filter"
+
+    def __init__(self, var: str, cw: CompiledWhere, where_text: str):
+        self.var = var
+        self.cw = cw
+        self.label = f"Filter({var}: {where_text})"
+
+    def run(self, st: _State):
+        if not st.n:
+            return
+
+        class _Src:  # compile_where column protocol over state gathers
+            def __init__(self, state, var):
+                self.state, self.var = state, var
+
+            def __len__(self):
+                return self.state.n
+
+            def column(self, key):
+                return self.state.prop_column(self.var, key)
+
+        st.apply_mask(self.cw.mask(_Src(st, self.var), st.params))
+
+
+class ExpandOp(_Op):
+    kind = "expand"
+
+    def __init__(self, src_var: str, rel: ast.RelPattern, dst_var: str,
+                 dst_join: bool, dst_labels: list[str], edge_var: str,
+                 prior_edge_vars: list[str]):
+        self.src_var = src_var
+        self.types = list(rel.types)
+        self.direction = rel.direction
+        self.dst_var = dst_var
+        self.dst_join = dst_join
+        self.dst_labels = tuple(dst_labels)
+        self.edge_var = edge_var
+        self.prior = list(prior_edge_vars)
+        arrow = {"out": "-%s->", "in": "<-%s-", "both": "-%s-"}[rel.direction]
+        t = (":" + "|".join(rel.types)) if rel.types else ""
+        rel_txt = arrow % (f"[{t}]" if t else "[]")
+        self.label = f"Expand(({src_var}){rel_txt}({dst_var}))"
+
+    def run(self, st: _State):
+        if not st.n:
+            st.apply_expand(np.zeros(0, np.int64), None
+                            if self.dst_join else self.dst_var,
+                            np.zeros(0, np.int64), self.edge_var,
+                            np.zeros(0, np.int64))
+            return
+        view = st.view
+        codes = view.codes_for(self.types)
+        src = st.node_cols[self.src_var]
+        if self.types and not codes:
+            empty = np.zeros(0, np.int64)
+            st.apply_expand(empty, None if self.dst_join else self.dst_var,
+                            empty, self.edge_var, empty)
+            return
+        uniq, inv = np.unique(src, return_inverse=True)
+        counts, rows, nbrs = view.expand_unique(uniq, self.direction, codes)
+        seg_start = np.zeros(len(counts), np.int64)
+        if len(counts) > 1:
+            seg_start[1:] = np.cumsum(counts)[:-1]
+        row_counts = counts[inv]
+        total = int(row_counts.sum())
+        if not total:
+            empty = np.zeros(0, np.int64)
+            st.apply_expand(empty, None if self.dst_join else self.dst_var,
+                            empty, self.edge_var, empty)
+            return
+        src_row = np.repeat(np.arange(st.n, dtype=np.int64), row_counts)
+        shift = np.repeat(np.cumsum(row_counts) - row_counts, row_counts)
+        flat = seg_start[inv][src_row] + (np.arange(total) - shift)
+        new_rows = rows[flat]
+        new_dst = nbrs[flat]
+        keep: Optional[np.ndarray] = None
+        for prev_var in self.prior:  # relationship isomorphism per path
+            m = new_rows != st.edge_cols[prev_var][src_row]
+            keep = m if keep is None else keep & m
+        if self.dst_join:
+            m = new_dst == st.node_cols[self.dst_var][src_row]
+            keep = m if keep is None else keep & m
+        if self.dst_labels:
+            member = st.label_member_idx(self.dst_labels)
+            m = np.isin(new_dst, member)
+            keep = m if keep is None else keep & m
+        if keep is not None and not keep.all():
+            sel = np.nonzero(keep)[0]
+            src_row, new_rows, new_dst = \
+                src_row[sel], new_rows[sel], new_dst[sel]
+        st.apply_expand(src_row, None if self.dst_join else self.dst_var,
+                        new_dst, self.edge_var, new_rows)
+        if not self.dst_join and len(self.dst_labels) == 1:
+            # every surviving dst row passed the label mask: property
+            # gathers for this var may ride the colindex columns
+            st.var_label[self.dst_var] = self.dst_labels[0]
+
+
+class EdgeCountOp(_Op):
+    """MATCH ()-[r:T]->() RETURN count(r|*): one vectorized pass over the
+    per-edge type column (the retired ``_fp_count`` edge shape)."""
+
+    kind = "scan"
+
+    def __init__(self, types: list[str], direction: str, out_key: str):
+        self.types = list(types)
+        self.direction = direction
+        self.out_key = out_key
+        t = (":" + "|".join(types)) if types else ""
+        self.label = f"EdgeCount([{t}] {direction})"
+
+    def run(self, st: _State):
+        from nornicdb_tpu.cypher.executor import Result
+
+        view = st.view
+        alive = view.row_alive
+        if self.types:
+            codes = view.codes_for(self.types)
+            n = int((alive & np.isin(view.erow_type, codes)).sum()) \
+                if codes else 0
+        else:
+            n = int(alive.sum())
+        if self.direction == "both":
+            n *= 2  # each edge matches once per orientation
+        return Result([self.out_key], [[n]])
+
+
+class NodeCountOp(_Op):
+    """MATCH (n[:L]) RETURN count(n|*) without WHERE: O(1) engine counts
+    (the retired ``_fp_count`` node shape)."""
+
+    kind = "scan"
+
+    def __init__(self, labels: list[str], out_key: str):
+        self.labels = list(labels)
+        self.out_key = out_key
+        self.label = f"NodeCount({':'.join(labels) or '*'})"
+
+    def run(self, st: _State):
+        from nornicdb_tpu.cypher.executor import Result
+
+        storage = st.ex.storage
+        if not self.labels:
+            n = storage.node_count()
+        elif len(self.labels) == 1:
+            n = storage.count_nodes_by_label(self.labels[0])
+        else:
+            seen: set[str] = set()
+            for label in self.labels:
+                colind = _colindex_for(st.ex, label)
+                got = colind.label_ids(label) if colind is not None else None
+                if got is None:
+                    got = [nd.id for nd in storage.get_nodes_by_label(label)]
+                seen.update(got)
+            n = len(seen)
+        return Result([self.out_key], [[n]])
+
+
+class FallbackOp(_Op):
+    """Per-operator fallback: materialize the partial binding table into
+    generic rows, apply any residual WHERE conjuncts, and hand the
+    remaining clauses to the interpreter — results bit-identical because
+    every columnar filter upstream was order-stable and conjunctive."""
+
+    kind = "fallback"
+    engine = "generic"
+
+    def __init__(self, clause_idx: int, residual: Optional[ast.Expr],
+                 named_node_vars: list[str], named_edge_vars: list[str]):
+        self.clause_idx = clause_idx
+        self.residual = residual
+        self.node_vars = named_node_vars
+        self.edge_vars = named_edge_vars
+        extra = " +residual WHERE" if residual is not None else ""
+        self.label = f"GenericTail(clauses[{clause_idx}:]{extra})"
+
+    def run(self, st: _State):
+        from nornicdb_tpu.cypher.expr import EvalContext, evaluate
+
+        rows = st.materialize_rows(self.node_vars, self.edge_vars)
+        if self.residual is not None:
+            rows = [
+                r for r in rows
+                if evaluate(self.residual,
+                            EvalContext(r, st.params, st.ex)) is True
+            ]
+        return st.ex._finish_clauses(st.q, st.params, rows,
+                                     self.clause_idx, st.stats)
+
+
+# ---------------------------------------------------------------- RETURN op
+class ReturnOp(_Op):
+    """Terminal projection: aggregate or plain projection, then the
+    DISTINCT / ORDER BY / SKIP / LIMIT tail with generic-identical
+    semantics (shared ``_multisort`` / ``_hashable``)."""
+
+    kind = "project"
+    self_timed = True
+
+    def __init__(self, clause: ast.ReturnClause, item_specs, group_idx,
+                 agg_idx, order_specs, sublabels):
+        self.clause = clause
+        self.item_specs = item_specs
+        self.group_idx = group_idx
+        self.agg_idx = agg_idx
+        self.order_specs = order_specs  # None => fully generic-eval path
+        self.has_agg = bool(agg_idx)
+        self.label = sublabels[0]
+        self.sublabels = sublabels
+
+    # -- column evaluation -------------------------------------------------
+    def _value_column(self, st: _State, spec) -> list:
+        kind = spec[0]
+        if kind == "node":
+            return st.node_objects(spec[1])
+        if kind == "edge":
+            return st.edge_objects(spec[1])
+        if kind == "nprop" or kind == "eprop":
+            return st.prop_column(spec[1], spec[2])
+        if kind == "const":
+            v = spec[1](st.params)
+            return [v] * st.n
+        raise _Bail(f"unknown column spec {kind}")  # pragma: no cover
+
+    def run(self, st: _State):
+        from nornicdb_tpu.cypher.executor import Result
+
+        t0 = time.perf_counter()
+        if self.has_agg:
+            columns, data = self._aggregate(st)
+            src_for_order = None
+            OP_CELLS["aggregate"].observe(time.perf_counter() - t0)
+        else:
+            columns, data, row_idx = self._project(st)
+            src_for_order = row_idx
+            OP_CELLS["project"].observe(time.perf_counter() - t0)
+        clause = self.clause
+        if clause.distinct:
+            from nornicdb_tpu.cypher.executor import _hashable
+
+            seen = set()
+            uniq_rows, uniq_src = [], []
+            for pos, r in enumerate(data):
+                k = _hashable(r)
+                if k not in seen:
+                    seen.add(k)
+                    uniq_rows.append(r)
+                    if src_for_order is not None:
+                        uniq_src.append(src_for_order[pos])
+            data = uniq_rows
+            if src_for_order is not None:
+                src_for_order = uniq_src
+        if clause.order_by:
+            t1 = time.perf_counter()
+            data = self._order(st, columns, data, src_for_order)
+            OP_CELLS["sort"].observe(time.perf_counter() - t1)
+        data = self._slice(st, data)
+        return Result(columns, data)
+
+    def _project(self, st: _State):
+        columns = [it.key for it in self.clause.items]
+        cols = [self._value_column(st, spec) for _, spec in self.item_specs]
+        data = [list(vals) for vals in zip(*cols)] if cols and st.n else []
+        return columns, data, list(range(len(data)))
+
+    # -- aggregation -------------------------------------------------------
+    def _aggregate(self, st: _State):
+        from nornicdb_tpu.cypher.executor import _hashable
+
+        items = self.clause.items
+        columns = [it.key for it in items]
+        n = st.n
+        # group rows
+        if not self.group_idx:
+            groups = [np.arange(n, dtype=np.int64)]
+        else:
+            key_cols = []
+            int_only = True
+            for i in self.group_idx:
+                spec = self.item_specs[i][1]
+                if spec[0] == "node":
+                    key_cols.append(("int", st.node_cols[spec[1]]))
+                elif spec[0] == "edge":
+                    key_cols.append(("int", st.edge_cols[spec[1]]))
+                else:
+                    key_cols.append(("obj", self._value_column(st, spec)))
+                    int_only = False
+            if n == 0:
+                groups = []
+            elif len(key_cols) == 1 and int_only:
+                col = key_cols[0][1]
+                uniq, first, inv = np.unique(
+                    col, return_index=True, return_inverse=True)
+                order = np.argsort(inv, kind="stable")
+                bounds = np.cumsum(np.bincount(inv))
+                segs = np.split(order, bounds[:-1])
+                enc = np.argsort(first, kind="stable")  # first-encounter
+                groups = [segs[g] for g in enc.tolist()]
+            else:
+                by_key: dict[Any, list] = {}
+                mats = [c[1] if c[0] == "obj" else c[1].tolist()
+                        for c in key_cols]
+                for r in range(n):
+                    k = _hashable([m[r] for m in mats])
+                    by_key.setdefault(k, []).append(r)
+                groups = [np.asarray(rows, np.int64)
+                          for rows in by_key.values()]
+        if not groups and not self.group_idx:
+            groups = [np.zeros(0, np.int64)]  # RETURN count(*) on empty
+        # value columns needed by aggs / group outputs
+        out = []
+        val_cache: dict[int, list] = {}
+
+        def vals_for(i):
+            if i not in val_cache:
+                val_cache[i] = self._value_column(st, self.item_specs[i][1])
+            return val_cache[i]
+
+        for g in groups:
+            rows = g.tolist()
+            row_vals: list[Any] = [None] * len(items)
+            for i in self.group_idx:
+                row_vals[i] = vals_for(i)[rows[0]] if rows else None
+            for i in self.agg_idx:
+                agg, spec = self.item_specs[i]
+                if agg in ("count_star", "count_ent"):
+                    row_vals[i] = len(rows)
+                    continue
+                col = vals_for(i)
+                vals = [v for r in rows
+                        if (v := col[r]) is not None]
+                if agg == "count":
+                    row_vals[i] = len(vals)
+                elif agg == "sum":
+                    row_vals[i] = sum(vals) if vals else 0
+                elif agg == "avg":
+                    row_vals[i] = sum(vals) / len(vals) if vals else None
+                elif agg == "min":
+                    row_vals[i] = min(vals) if vals else None
+                elif agg == "max":
+                    row_vals[i] = max(vals) if vals else None
+                else:  # collect
+                    row_vals[i] = vals
+            out.append(row_vals)
+        return columns, out
+
+    # -- ordering ----------------------------------------------------------
+    def _order(self, st: _State, columns, data, src_for_order):
+        from nornicdb_tpu.cypher.executor import _multisort
+        from nornicdb_tpu.cypher.expr import EvalContext, evaluate
+
+        order_by = self.clause.order_by
+        descs = [oi.descending for oi in order_by]
+        if self.has_agg or self.order_specs is None:
+            # aggregated outputs: generic evaluation over the (few) group
+            # rows, exactly the interpreter's column-overlay binding
+            keyed = []
+            for row_vals in data:
+                binding = dict(zip(columns, row_vals))
+                keys = []
+                for oi in order_by:
+                    if isinstance(oi.expr, ast.Variable) \
+                            and oi.expr.name in binding:
+                        keys.append(binding[oi.expr.name])
+                    else:
+                        keys.append(evaluate(
+                            oi.expr, EvalContext(binding, st.params, st.ex)))
+                keyed.append((keys, row_vals))
+            return _multisort(keyed, descs)
+        key_cols = []
+        for spec in self.order_specs:
+            if spec[0] == "col":
+                key_cols.append([row[spec[1]] for row in data])
+            else:
+                col = self._value_column(st, spec)
+                key_cols.append([col[i] for i in src_for_order])
+        if len(order_by) == 1:
+            cut = self._offload_candidates(st, key_cols[0], descs[0])
+            if cut is not None:
+                data = [data[i] for i in cut]
+                key_cols = [[key_cols[0][i] for i in cut]]
+        keyed = [([kc[i] for kc in key_cols], row)
+                 for i, row in enumerate(data)]
+        return _multisort(keyed, descs)
+
+    def _slice(self, st: _State, data):
+        from nornicdb_tpu.cypher.expr import EvalContext, evaluate
+
+        clause = self.clause
+        if clause.skip is not None:
+            n = evaluate(clause.skip, EvalContext({}, st.params, st.ex))
+            data = data[int(n):]
+        if clause.limit is not None:
+            n = evaluate(clause.limit, EvalContext({}, st.params, st.ex))
+            data = data[: int(n)]
+        return data
+
+    # -- device offload ----------------------------------------------------
+    def _static_k(self, st: _State) -> Optional[int]:
+        from nornicdb_tpu.cypher.expr import EvalContext, evaluate
+
+        clause = self.clause
+        if clause.limit is None:
+            return None
+        try:
+            k = int(evaluate(clause.limit, EvalContext({}, st.params, st.ex)))
+            if clause.skip is not None:
+                k += int(evaluate(clause.skip,
+                                  EvalContext({}, st.params, st.ex)))
+        except (TypeError, ValueError):
+            # non-static/non-integer LIMIT: the slice tail will raise the
+            # user-facing error; the offload simply doesn't engage
+            return None
+        return k if k >= 0 else None
+
+    def _offload_candidates(self, st: _State, keys: list,
+                            desc: bool) -> Optional[list[int]]:
+        """Device top-k boundary for a single-numeric-key ORDER BY ...
+        LIMIT: returns the (order-preserving) candidate row positions
+        whose keys reach the boundary incl. ties, or None for the host
+        path.  The caller still runs the exact stable host sort over the
+        survivors, so served rows are bit-identical to the full sort."""
+        n = len(keys)
+        k = self._static_k(st)
+        if k is None or n < _offload_min_rows() or k * 4 > n or k == 0:
+            return None
+        for v in keys:
+            if isinstance(v, bool) or not isinstance(v, (int, float)):
+                return None
+        try:
+            from nornicdb_tpu import backend
+
+            if backend.manager_stats() is None or not backend.manager().ready():
+                OFFLOAD_CELLS["unavailable"].inc()
+                return None
+            import jax
+            import jax.numpy as jnp
+
+            vals = np.asarray(keys, np.float64)
+            if np.isnan(vals).any():
+                OFFLOAD_CELLS["unavailable"].inc()
+                return None
+            v = jnp.asarray(vals if desc else -vals, jnp.float32)
+            top, _ = jax.lax.top_k(v, min(k, n))
+            boundary = float(top[-1])
+            # f32 rounding must only ever WIDEN the candidate set
+            boundary = np.nextafter(boundary, -np.inf)
+            cand = vals >= boundary if desc else -vals >= boundary
+            if int(cand.sum()) < min(k, n):
+                # a candidate count below k cannot prove the boundary sits
+                # at or under the true kth key — host path, never a wrong
+                # (under-inclusive) cut
+                OFFLOAD_CELLS["unavailable"].inc()
+                return None
+            OFFLOAD_CELLS["used"].inc()
+            return np.nonzero(cand)[0].tolist()
+        except Exception:
+            log.debug("device top-k offload unavailable", exc_info=True)
+            OFFLOAD_CELLS["unavailable"].inc()
+            return None
+
+
+def _offload_min_rows() -> int:
+    try:
+        return int(os.environ.get("NORNICDB_CYPHER_OFFLOAD_MIN_ROWS",
+                                  "100000"))
+    except ValueError:
+        return 100000
+
+
+# ---------------------------------------------------------------- plan
+class CompiledPlan:
+    __slots__ = ("ops", "q", "full", "key")
+
+    def __init__(self, ops: list, q: ast.Query, full: bool, key: str):
+        self.ops = ops
+        self.q = q
+        self.full = full
+        self.key = key
+
+    def describe(self) -> list[str]:
+        lines = []
+        for op in self.ops:
+            if isinstance(op, ReturnOp):
+                lines.extend(f"{lbl} [columnar]" for lbl in op.sublabels)
+            else:
+                lines.append(f"{op.label} [{op.engine}]")
+        return lines
+
+
+# ---------------------------------------------------------------- planner
+def _classify_item(expr, node_vars: set, edge_vars: set):
+    """(agg_kind|None, spec) — spec is a column spec; None = unsupported."""
+    if isinstance(expr, ast.Variable):
+        if expr.name in node_vars:
+            return None, ("node", expr.name)
+        if expr.name in edge_vars:
+            return None, ("edge", expr.name)
+        return None, None
+    if isinstance(expr, ast.Property) and isinstance(expr.subject,
+                                                     ast.Variable):
+        v = expr.subject.name
+        if expr.key == "id":
+            return None, None  # evaluator falls back to entity id: generic
+        if v in node_vars:
+            return None, ("nprop", v, expr.key)
+        if v in edge_vars:
+            return None, ("eprop", v, expr.key)
+        return None, None
+    getter = _const_getter(expr)
+    if getter is not None:
+        return None, ("const", getter)
+    return None, None
+
+
+def _classify_agg(expr, node_vars: set, edge_vars: set):
+    if not (isinstance(expr, ast.FunctionCall) and expr.name in _AGG_FNS
+            and not expr.distinct and len(expr.args) == 1):
+        return None, None
+    arg = expr.args[0]
+    if expr.name == "count":
+        if isinstance(arg, ast.Literal) and arg.value == "*":
+            return "count_star", ("const", lambda p: None)
+        if isinstance(arg, ast.Variable) and (arg.name in node_vars
+                                              or arg.name in edge_vars):
+            return "count_ent", ("const", lambda p: None)
+        if (isinstance(arg, ast.Property)
+                and isinstance(arg.subject, ast.Variable)
+                and arg.subject.name in node_vars and arg.key != "id"):
+            return "count", ("nprop", arg.subject.name, arg.key)
+        return None, None
+    # sum/avg/min/max/collect over a NODE property column (edge-property
+    # aggregation stays on the generic/_fp_edge_agg path)
+    if (isinstance(arg, ast.Property)
+            and isinstance(arg.subject, ast.Variable)
+            and arg.subject.name in node_vars and arg.key != "id"):
+        return expr.name, ("nprop", arg.subject.name, arg.key)
+    return None, None
+
+
+def _plan_return(clause: ast.ReturnClause, node_vars: set, edge_vars: set):
+    """ReturnOp for a supported RETURN, else a FallbackOp reason string."""
+    from nornicdb_tpu.cypher.executor import _contains_aggregate
+
+    if clause.star:
+        return None, "RETURN *"
+    item_specs = []
+    group_idx, agg_idx = [], []
+    for i, it in enumerate(clause.items):
+        if _contains_aggregate(it.expr):
+            agg, spec = _classify_agg(it.expr, node_vars, edge_vars)
+            if agg is None:
+                return None, f"aggregate `{it.key}`"
+            item_specs.append((agg, spec))
+            agg_idx.append(i)
+        else:
+            _, spec = _classify_item(it.expr, node_vars, edge_vars)
+            if spec is None:
+                return None, f"projection `{it.key}`"
+            item_specs.append((None, spec))
+            group_idx.append(i)
+    has_agg = bool(agg_idx)
+    columns = [it.key for it in clause.items]
+    order_specs: Optional[list] = []
+    if clause.order_by and not has_agg:
+        for oi in clause.order_by:
+            if isinstance(oi.expr, ast.Variable):
+                if oi.expr.name in columns:
+                    # LAST duplicate wins: the generic binding overlays
+                    # columns via dict(zip(...)), so a repeated alias
+                    # resolves to its final occurrence
+                    idx = len(columns) - 1 - columns[::-1].index(oi.expr.name)
+                    order_specs.append(("col", idx))
+                    continue
+                return None, "ORDER BY entity variable"
+            if (isinstance(oi.expr, ast.Property)
+                    and isinstance(oi.expr.subject, ast.Variable)):
+                v = oi.expr.subject.name
+                if v in columns:
+                    return None, "ORDER BY property of alias"
+                if oi.expr.key != "id" and (v in node_vars
+                                            or v in edge_vars):
+                    order_specs.append(
+                        ("nprop" if v in node_vars else "eprop",
+                         v, oi.expr.key))
+                    continue
+            getter = _const_getter(oi.expr)
+            if getter is not None:
+                order_specs.append(("const", getter))
+                continue
+            return None, "ORDER BY expression"
+    sublabels = []
+    if has_agg:
+        aggs = ", ".join(clause.items[i].key for i in agg_idx)
+        sublabels.append(f"Aggregate({aggs})")
+    else:
+        sublabels.append("Project(" + ", ".join(columns) + ")")
+    if clause.distinct:
+        sublabels.append("Distinct")
+    if clause.order_by:
+        sublabels.append("Sort(" + ", ".join(
+            ("DESC " if oi.descending else "") +
+            ast.expr_text(oi.expr) for oi in clause.order_by) + ")")
+    if clause.skip is not None or clause.limit is not None:
+        sublabels.append("Slice(skip/limit)")
+    return ReturnOp(clause, item_specs, group_idx, agg_idx,
+                    order_specs if not has_agg else None, sublabels), ""
+
+
+def compile_query(q: ast.Query, ex) -> tuple[Optional[CompiledPlan], str]:
+    """Pattern-compile a canonical (literal-lifted) Query into an operator
+    DAG, or (None, reason) when no columnar prefix exists."""
+    cls = q.clauses
+    if not cls or not isinstance(cls[0], ast.MatchClause):
+        return None, "no leading MATCH"
+    m = cls[0]
+    if m.optional:
+        return None, "OPTIONAL MATCH"
+    if len(m.patterns) != 1:
+        return None, "multiple patterns"
+    pat = m.patterns[0]
+    if pat.name or pat.shortest:
+        return None, "named path / shortestPath"
+    els = pat.elements
+    if len(els) % 2 == 0 or not els:
+        return None, "malformed pattern"
+    nodes = els[0::2]
+    rels = els[1::2]
+    if not all(isinstance(n, ast.NodePattern) for n in nodes) or \
+            not all(isinstance(r, ast.RelPattern) for r in rels):
+        return None, "malformed pattern"
+    for r in rels:
+        if r.var_length or r.min_hops != 1 or r.max_hops != 1:
+            return None, "variable-length relationship"
+        if r.properties is not None:
+            return None, "relationship property map"
+    for nd in nodes[1:]:
+        if nd.properties is not None:
+            return None, "non-anchor property map"
+    anchor = nodes[0]
+
+    # -- variable naming (anonymous get § internal names) -------------------
+    node_names: list[str] = []
+    first_pos: dict[str, int] = {}
+    for i, nd in enumerate(nodes):
+        name = nd.variable or f"§n{i}"
+        node_names.append(name)
+        first_pos.setdefault(name, i)
+    edge_names: list[str] = []
+    for i, r in enumerate(rels):
+        name = r.variable or f"§e{i}"
+        if name in edge_names or name in first_pos:
+            return None, "repeated relationship variable"
+        edge_names.append(name)
+    node_vars = {n for n in node_names if not n.startswith("§")}
+    edge_vars = {n for n in edge_names if not n.startswith("§")}
+    named_nodes = sorted(node_vars)
+    named_edges = sorted(edge_vars)
+
+    # -- WHERE conjunct split ----------------------------------------------
+    per_var: dict[str, list] = {}
+    residual_parts: list = []
+    if m.where is not None:
+        for part in _split_and(m.where):
+            vs: set = set()
+            _expr_vars(part, vs)
+            if len(vs) == 1 and (v := next(iter(vs))) in node_vars:
+                per_var.setdefault(v, []).append(part)
+            else:
+                residual_parts.append(part)
+    for nd, name in zip(nodes, node_names):
+        if nd.where is not None:
+            if not nd.variable:
+                return None, "inline WHERE on anonymous node"
+            per_var.setdefault(name, []).append(nd.where)
+    var_cw: dict[str, CompiledWhere] = {}
+    for v, parts in per_var.items():
+        cw = _parallel.compile_where(_join_and(parts), v)
+        if cw.residual is not None:
+            residual_parts.append(cw.residual)
+        if cw.has_columnar:
+            var_cw[v] = cw
+    residual = _join_and(residual_parts)
+
+    ret = cls[1] if len(cls) == 2 and isinstance(cls[1], ast.ReturnClause) \
+        else None
+    plain_ret = (ret is not None and not ret.distinct and not ret.order_by
+                 and ret.skip is None and ret.limit is None and not ret.star
+                 and len(ret.items) == 1)
+
+    # -- retired-fastpath short circuits ------------------------------------
+    if (plain_ret and m.where is None and anchor.where is None
+            and residual is None):
+        e = ret.items[0].expr
+        is_count = (isinstance(e, ast.FunctionCall) and e.name == "count"
+                    and not e.distinct and len(e.args) == 1)
+        if is_count and len(els) == 1 and anchor.properties is None:
+            arg = e.args[0]
+            counts_node = (isinstance(arg, ast.Literal) and arg.value == "*") \
+                or (isinstance(arg, ast.Variable)
+                    and arg.name == anchor.variable)
+            if counts_node:
+                op = NodeCountOp(anchor.labels, ret.items[0].key)
+                return CompiledPlan([op], q, True, ""), ""
+        if is_count and len(els) == 3:
+            a, rel, b = els
+            bare = not (a.labels or a.properties or a.where or b.labels
+                        or b.properties or b.where)
+            if bare:
+                arg = e.args[0]
+                counts_rel = (isinstance(arg, ast.Literal)
+                              and arg.value == "*") \
+                    or (isinstance(arg, ast.Variable)
+                        and (arg.name == rel.variable
+                             or arg.name == a.variable
+                             or arg.name == b.variable))
+                if counts_rel and not (a.variable and a.variable == b.variable):
+                    op = EdgeCountOp(rel.types, rel.direction,
+                                     ret.items[0].key)
+                    return CompiledPlan([op], q, True, ""), ""
+
+    # -- scan + filter + expand pipeline ------------------------------------
+    ops: list[_Op] = []
+    anchor_name = node_names[0]
+    anchor_cw = var_cw.pop(anchor_name, None)
+    if anchor.properties is not None:
+        ops.append(AnchorScanOp(anchor_name, anchor))
+        if anchor_cw is not None:
+            ops.append(FilterOp(anchor_name, anchor_cw,
+                                _cw_text(per_var.get(anchor_name))))
+    elif anchor_cw is not None and len(anchor.labels) == 1:
+        ops.append(MaskedLabelScanOp(anchor_name, anchor.labels[0],
+                                     anchor_cw,
+                                     _cw_text(per_var.get(anchor_name))))
+    elif anchor.labels:
+        ops.append(LabelScanOp(anchor_name, anchor.labels))
+        if anchor_cw is not None:
+            ops.append(FilterOp(anchor_name, anchor_cw,
+                                _cw_text(per_var.get(anchor_name))))
+    else:
+        ops.append(AllScanOp(anchor_name))
+        if anchor_cw is not None:
+            ops.append(FilterOp(anchor_name, anchor_cw,
+                                _cw_text(per_var.get(anchor_name))))
+    seen = {anchor_name}
+    for i, rel in enumerate(rels):
+        src = node_names[i]
+        dst = node_names[i + 1]
+        dst_join = dst in seen
+        ops.append(ExpandOp(src, rel, dst, dst_join,
+                            nodes[i + 1].labels, edge_names[i],
+                            edge_names[:i]))
+        seen.add(dst)
+        if not dst_join:
+            cw = var_cw.pop(dst, None)
+            if cw is not None:
+                ops.append(FilterOp(dst, cw, _cw_text(per_var.get(dst))))
+        else:
+            cw = var_cw.pop(dst, None)
+            if cw is not None:  # join var filtered after re-binding
+                ops.append(FilterOp(dst, cw, _cw_text(per_var.get(dst))))
+
+    if ret is not None and residual is None:
+        rop, reason = _plan_return(ret, node_vars, edge_vars)
+        if rop is not None:
+            ops.append(rop)
+            return CompiledPlan(ops, q, True, ""), ""
+        ops.append(FallbackOp(1, None, named_nodes, named_edges))
+        return CompiledPlan(ops, q, False, ""), reason
+    ops.append(FallbackOp(1, residual, named_nodes, named_edges))
+    return CompiledPlan(ops, q, False, ""), "generic tail"
+
+
+def _cw_text(parts) -> str:
+    if not parts:
+        return "…"
+    return " AND ".join(ast.expr_text(p) for p in parts)
+
+
+# ---------------------------------------------------------------- engine
+def _env_enabled() -> bool:
+    return os.environ.get("NORNICDB_CYPHER_COLUMNAR", "1").lower() not in (
+        "0", "false", "no", "off")
+
+
+class ColumnarEngine:
+    """Per-executor columnar pipeline: shape-keyed plan cache + operator
+    execution + trace capture for EXPLAIN/PROFILE and the slow-query log."""
+
+    def __init__(self, ex):
+        self.ex = ex
+        self.enabled = _env_enabled()
+        self.cache = PlanCache(ex.schema)
+        self._tls = threading.local()
+        self.outcomes = {"full": 0, "fallback": 0, "bail": 0,
+                         "unsupported": 0}
+
+    # -- shape path (from _run_single) --------------------------------------
+    def try_query(self, q: ast.Query, params: dict, stats) -> Optional[Any]:
+        if not self.enabled:
+            return None
+        norm = normalize_query(q)
+        if norm is None:
+            return None
+        key, canon, lits = norm
+        hit = True
+        entry = self.cache.shape_lookup(key)
+        if entry is None:
+            hit = False
+            plan, reason = compile_query(canon, self.ex)
+            if plan is not None:
+                plan.key = key
+            entry = self.cache.shape_store(key, plan, reason)
+        if entry.plan is None:
+            self.outcomes["unsupported"] += 1
+            Q_CELLS["unsupported"].inc()
+            return None
+        merged = merge_lits(params, lits)
+        res, outcome = self._execute(entry.plan, merged, stats, q, hit)
+        if res is None:
+            return None
+        if outcome == "full":
+            self._tls.note = (weakref.ref(q), key, entry.plan, lits)
+        return res
+
+    # -- text path (from _execute_traced) ------------------------------------
+    def run_text_entry(self, entry, params: dict, stats) -> Optional[Any]:
+        merged = merge_lits(params, entry.lits)
+        res, _ = self._execute(entry.plan, merged, stats, None, True)
+        return res
+
+    def maybe_bind_text(self, text: str, stmt) -> None:
+        """Bind query text -> full-columnar plan after a successful run,
+        so repeat traffic skips parse+plan entirely.  Only full plans are
+        bindable: the text fast path bypasses the write-statement
+        machinery, and full plans are read-only by construction."""
+        note = getattr(self._tls, "note", None)
+        if note is None:
+            return
+        qref, key, plan, lits = note
+        if qref() is not stmt or not plan.full:
+            return
+        if stmt.unions or stmt.explain or stmt.profile:
+            # a union query's full-columnar note covers only the MAIN
+            # branch — binding its text would drop the union rows on the
+            # fast path; EXPLAIN/PROFILE must keep their wrappers
+            self._tls.note = None
+            return
+        self._tls.note = None
+        from nornicdb_tpu.cypher.executor import (
+            _is_nondeterministic,
+            _read_cache_labels,
+        )
+
+        canon = plan.q
+        self.cache.bind_text(
+            text, key, canon, lits, plan,
+            cacheable=not _is_nondeterministic(canon),
+            labels=frozenset(_read_cache_labels(canon)))
+
+    # -- execution -----------------------------------------------------------
+    def _execute(self, plan: CompiledPlan, params: dict, stats,
+                 orig_q, cache_hit: bool):
+        ex = self.ex
+        snap = ex.matcher._snap()
+        if snap is None:
+            self._note_outcome("bail")
+            return None, "bail"
+        trace_ops: list[tuple] = []
+        t_start = time.perf_counter()
+        try:
+            if not snap.ensure():
+                raise _Bail("snapshot build raced out")
+            view = snap.csr_view()
+            if view is None:
+                raise _Bail("snapshot unavailable")
+            st = _State(ex, plan.q, params, stats, snap, view, trace_ops)
+            result = None
+            with _tracer.span("cypher.columnar"):
+                for op in plan.ops:
+                    t0 = time.perf_counter()
+                    result = op.run(st)
+                    dt = time.perf_counter() - t0
+                    if not op.self_timed:
+                        OP_CELLS[op.kind].observe(dt)
+                    trace_ops.append((op.label, op.engine, st.n,
+                                      round(dt * 1e3, 3)))
+                    if result is not None:
+                        break
+            if result is None:  # pragma: no cover - planner guarantees
+                raise _Bail("plan produced no result")
+            ROWS_HIST.observe(st.peak_rows)
+            outcome = "full" if plan.full else "fallback"
+            self._note_outcome(outcome)
+            self._tls.trace = {
+                "qref": weakref.ref(orig_q) if orig_q is not None else None,
+                "key": key_hash(plan.key) if plan.key else "",
+                "outcome": outcome,
+                "cache": "hit" if cache_hit else "miss",
+                "total_ms": round((time.perf_counter() - t_start) * 1e3, 3),
+                "ops": trace_ops,
+            }
+            return result, outcome
+        except _Bail as b:
+            log.debug("columnar bail: %s", b)
+            self._note_outcome("bail")
+            return None, "bail"
+
+    def _note_outcome(self, outcome: str) -> None:
+        self.outcomes[outcome] += 1
+        Q_CELLS[outcome].inc()
+
+    # -- introspection -------------------------------------------------------
+    def begin_statement(self) -> None:
+        """Drop this thread's trace so slow-query capture never attributes
+        a previous statement's columnar execution to the current one."""
+        self._tls.trace = None
+
+    def last_trace(self, stmt=None) -> Optional[dict]:
+        tr = getattr(self._tls, "trace", None)
+        if tr is None:
+            return None
+        if stmt is not None:
+            qref = tr.get("qref")
+            if qref is None or qref() is not stmt:
+                return None
+        return tr
+
+    def explain_lines(self, q: ast.Query) -> list[str]:
+        if not self.enabled:
+            return ["columnar: disabled"]
+        norm = normalize_query(q)
+        if norm is None:
+            return ["columnar: generic (unnormalizable query)"]
+        key, canon, _lits = norm
+        entry = self.cache.shape_lookup(key)
+        hit = entry is not None
+        if entry is None:
+            plan, reason = compile_query(canon, self.ex)
+            if plan is not None:
+                plan.key = key
+            entry = self.cache.shape_store(key, plan, reason)
+        if entry.plan is None:
+            return [f"columnar: generic ({entry.reason})"]
+        status = "hit" if hit else "miss"
+        lines = [f"columnar plan [cache {status}, shape={key_hash(key)}]:"]
+        lines.extend(f"  {line}" for line in entry.plan.describe())
+        return lines
+
+    def stats_snapshot(self) -> dict[str, Any]:
+        return {
+            "enabled": self.enabled,
+            "plan_cache": self.cache.stats_snapshot(),
+            "outcomes": dict(self.outcomes),
+        }

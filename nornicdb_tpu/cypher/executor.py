@@ -157,6 +157,11 @@ class CypherExecutor:
         self._last_call_columns: list[str] = []
         self.query_count = 0
         self._colindex: Any = None  # lazy ColumnarScanIndex; False = unusable
+        # columnar operator pipeline + shape-keyed plan cache
+        # (cypher/columnar.py; NORNICDB_CYPHER_COLUMNAR=0 disables)
+        from nornicdb_tpu.cypher.columnar import ColumnarEngine
+
+        self.columnar = ColumnarEngine(self)
         # opt-in strict OpenCypher semantic validation (ref: the ANTLR
         # validation mode, executor.go:1572-1584, NORNICDB_PARSER=antlr;
         # here NORNICDB_PARSER=strict, with `antlr` accepted as an alias)
@@ -189,6 +194,7 @@ class CypherExecutor:
         probe = (
             _slowlog.counters_probe(self.db) if _slow_log.enabled else None
         )
+        self.columnar.begin_statement()
         with _tracer.span("cypher.execute") as sp:
             if sp.trace_id is not None:
                 sp.set_attr("query", _slowlog.redact_query(query))
@@ -225,6 +231,23 @@ class CypherExecutor:
                 _log.debug("no plan for slow query", exc_info=True)
                 plan = None
             cur = _tracer.capture()
+            col_trace = self.columnar.last_trace()
+            columnar = None
+            if col_trace is not None:
+                # plan-cache key + per-operator timings of the LAST
+                # columnar execution on this thread — the slow statement,
+                # when it ran columnar at all
+                columnar = {
+                    "plan_key": col_trace["key"],
+                    "outcome": col_trace["outcome"],
+                    "cache": col_trace["cache"],
+                    "total_ms": col_trace["total_ms"],
+                    "operators": [
+                        {"op": label, "engine": engine, "rows": rows_n,
+                         "ms": ms}
+                        for label, engine, rows_n, ms in col_trace["ops"]
+                    ],
+                }
             _slow_log.maybe_record(
                 query,
                 params,
@@ -234,6 +257,7 @@ class CypherExecutor:
                 probe_after=_slowlog.counters_probe(self.db),
                 trace_spans=cur.trace.spans if cur is not None else None,
                 trace_id=cur.trace_id if cur is not None else None,
+                columnar=columnar,
             )
         except Exception:
             _log.warning("slow-query capture failed", exc_info=True)
@@ -253,6 +277,18 @@ class CypherExecutor:
             query = f"USE {parts[0]}" + (
                 f" {parts[1]}" if len(parts) > 1 else ""
             )
+        # plan-cache text fast path: repeat read traffic skips parse,
+        # validation, classification AND planning. Only full-columnar
+        # read-only plans are ever text-bound (maybe_bind_text), so the
+        # write-statement machinery below cannot be bypassed. A None from
+        # the runner (snapshot momentarily unable to serve) falls through
+        # to the normal path.
+        if self.columnar.enabled and self._tx_undo is None:
+            entry = self.columnar.cache.text_probe(query)
+            if entry is not None:
+                res = self._execute_text_plan(entry, query, params)
+                if res is not None:
+                    return res
         _t_parse = time.perf_counter()
         with _tracer.span("cypher.parse"):
             stmt = parse(query)
@@ -281,6 +317,7 @@ class CypherExecutor:
                 if hit is not None:
                     return _copy_result(hit)
                 result = self.execute_statement(stmt, params)
+                self.columnar.maybe_bind_text(query, stmt)
                 if not _is_nondeterministic(stmt):
                     # reads with unlabeled dependencies get EMPTY label sets,
                     # which invalidate_labels always drops — soundness over
@@ -301,7 +338,34 @@ class CypherExecutor:
             else:
                 self.cache.clear()  # unscoped write: drop everything
             return result
-        return self.execute_statement(stmt, params)
+        result = self.execute_statement(stmt, params)
+        if isinstance(stmt, ast.Query):
+            # cache-less executors still get the plan-cache text fast path
+            self.columnar.maybe_bind_text(query, stmt)
+        return result
+
+    def _execute_text_plan(
+        self, entry, query: str, params: dict[str, Any]
+    ) -> Optional[Result]:
+        """Run a text-bound (full-columnar, read-only) plan, replicating
+        the normal read path's rate-limit and result-cache interplay."""
+        limits, bucket = self._query_limits()
+        if bucket is not None and not bucket.take():
+            raise NornicError(
+                "database query rate limit exceeded "
+                f"({limits.max_queries_per_second}/s)"
+            )
+        if self.cache is not None:
+            hit = self.cache.get(query, params)
+            if hit is not None:
+                return _copy_result(hit)
+        result = self.columnar.run_text_entry(entry, params, Stats())
+        if result is None:
+            return None  # momentary bail: the generic path re-runs it
+        if self.cache is not None and entry.cacheable:
+            self.cache.put(query, params, result, set(entry.labels))
+            return _copy_result(result)
+        return result
 
     def execute_statement(self, stmt: ast.Statement, params: dict[str, Any]) -> Result:
         if isinstance(stmt, ast.Query):
@@ -318,6 +382,15 @@ class CypherExecutor:
                 result.plan = (self._explain(stmt)
                                + f"\nruntime: {(time.perf_counter()-t0)*1000:.2f} ms"
                                + f", rows: {len(result.rows)}")
+                trace = self.columnar.last_trace(stmt)
+                if trace is not None:
+                    # measured per-operator timings from THIS execution
+                    result.plan += (
+                        f"\ncolumnar execution [{trace['outcome']}, cache "
+                        f"{trace['cache']}, {trace['total_ms']} ms]:")
+                    for label, engine, rows_n, ms in trace["ops"]:
+                        result.plan += \
+                            f"\n  {label} [{engine}] rows={rows_n} {ms} ms"
             return result
         if isinstance(stmt, ast.CreateIndex):
             r = self._create_index(stmt)
@@ -350,8 +423,11 @@ class CypherExecutor:
         raise CypherSyntaxError(f"unsupported statement {type(stmt).__name__}")
 
     # -- pattern fastpaths (ref: DetectQueryPattern query_patterns.go,
-    # ExecuteOptimized optimized_executors.go — the reference's hottest
-    # shapes skip the general pipeline) ------------------------------------
+    # ExecuteOptimized optimized_executors.go). The former detector family
+    # (_fp_count/_fp_group_count/_fp_mutual_rel/_fp_anchored_traverse) is
+    # RETIRED into the columnar operator pipeline (cypher/columnar.py) —
+    # only the edge-property aggregation shape remains, because edge
+    # property columns are not resident in the CSR snapshot. ---------------
     def _try_fastpath(self, q: ast.Query, params: dict) -> Optional[Result]:
         if q.unions or len(q.clauses) != 2:
             return None
@@ -365,137 +441,14 @@ class CypherExecutor:
         pattern = match.patterns[0]
         if pattern.name or pattern.shortest:
             return None
-        els = pattern.elements
-        if not (
+        if (
             ret.distinct
             or ret.order_by
             or ret.skip is not None
             or ret.limit is not None
         ):
-            for detector in (
-                self._fp_count,
-                self._fp_group_count,
-                self._fp_edge_agg,
-                self._fp_mutual_rel,
-            ):
-                r = detector(match, ret, els, params)
-                if r is not None:
-                    return r
-        if not ret.distinct:
-            return self._fp_anchored_traverse(match, ret, els, params)
-        return None
-
-    def _fp_count(self, match, ret, els, params) -> Optional[Result]:
-        """count(n)/count(r)/count(*) single-scan counts; node counts with a
-        fully-columnar WHERE count via the compiled mask without
-        materializing rows (ref: PatternIncomingCountAgg family sits below;
-        this is the plain count shape)."""
-        if len(ret.items) != 1:
             return None
-        item = ret.items[0]
-        expr = item.expr
-        if not (
-            isinstance(expr, ast.FunctionCall)
-            and expr.name == "count"
-            and not expr.distinct
-            and len(expr.args) == 1
-        ):
-            return None
-        arg = expr.args[0]
-
-        def count_result(n: int) -> Result:
-            return Result([item.key], [[n]])
-
-        # MATCH (n[:L]) [WHERE <columnar>] RETURN count(n|*)
-        if len(els) == 1 and isinstance(els[0], ast.NodePattern):
-            node = els[0]
-            if node.properties is not None:
-                return None
-            counts_node = (
-                isinstance(arg, ast.Literal) and arg.value == "*"
-            ) or (
-                isinstance(arg, ast.Variable) and arg.name == node.variable
-            )
-            if not counts_node:
-                return None
-            where = _and_exprs(node.where, match.where)
-            if where is not None:
-                if not node.variable:
-                    return None
-                from nornicdb_tpu.cypher.parallel import compile_where
-
-                cw = compile_where(where, node.variable)
-                if not cw.has_columnar or cw.residual is not None:
-                    return None
-                from nornicdb_tpu.cypher.parallel import get_parallel_config
-
-                cfg = get_parallel_config()
-                if (
-                    len(node.labels) == 1
-                    # same operator escape hatch as _match_scan_fast: raising
-                    # columnar_min_rows bypasses the scan index everywhere
-                    and self.storage.count_nodes_by_label(node.labels[0])
-                    >= cfg.columnar_min_rows
-                ):
-                    idx = self._scan_index()
-                    if idx is not None:
-                        n = idx.count(node.labels[0], cw, params)
-                        if n is not None:
-                            return count_result(n)
-                candidates = self.matcher._candidates(
-                    ast.NodePattern(node.variable, node.labels, None),
-                    {}, params,
-                )
-                return count_result(int(cw.mask(candidates, params).sum()))
-            if not node.labels:
-                return count_result(self.storage.node_count())
-            if len(node.labels) == 1:
-                return count_result(
-                    self.storage.count_nodes_by_label(node.labels[0])
-                )
-            seen: set[str] = set()
-            for lbl in node.labels:
-                seen.update(n.id for n in self.storage.get_nodes_by_label(lbl))
-            return count_result(len(seen))
-        # MATCH ()-[r[:T]]->() RETURN count(r|*)
-        if match.where is not None:
-            return None
-        if (
-            len(els) == 3
-            and isinstance(els[0], ast.NodePattern)
-            and isinstance(els[1], ast.RelPattern)
-            and isinstance(els[2], ast.NodePattern)
-        ):
-            a, rel, b = els
-            if (
-                a.labels or a.properties or a.where
-                or b.labels or b.properties or b.where
-                or rel.properties or rel.var_length
-                or rel.direction != "out"
-            ):
-                return None
-            counts_rel = (
-                isinstance(arg, ast.Literal) and arg.value == "*"
-            ) or (
-                isinstance(arg, ast.Variable) and arg.name == rel.variable
-            )
-            if not counts_rel:
-                return None
-            if not rel.types:
-                return count_result(self.storage.edge_count())
-            if len(rel.types) == 1:
-                return count_result(
-                    self.storage.count_edges_by_type(rel.types[0])
-                )
-            total = 0
-            seen_e: set[str] = set()
-            for t in rel.types:
-                for edge in self.storage.get_edges_by_type(t):
-                    if edge.id not in seen_e:
-                        seen_e.add(edge.id)
-                        total += 1
-            return count_result(total)
-        return None
+        return self._fp_edge_agg(match, ret, pattern.elements, params)
 
     @staticmethod
     def _bare_rel_triple(els) -> Optional[tuple]:
@@ -516,70 +469,6 @@ class CypherExecutor:
         ):
             return None
         return a, rel, b
-
-    def _fp_group_count(self, match, ret, els, params) -> Optional[Result]:
-        """MATCH (x)<-[:T]-(y) / (x)-[:T]->(y) RETURN x[.prop], count(y|*) —
-        one pass over the type-T edges instead of per-node expansion
-        (ref: detectIncomingCountAgg/detectOutgoingCountAgg
-        query_patterns.go:283,315)."""
-        if match.where is not None or len(ret.items) != 2:
-            return None
-        triple = self._bare_rel_triple(els)
-        if triple is None:
-            return None
-        a, rel, b = triple
-        if len(rel.types) != 1 or rel.direction == "both":
-            return None
-        if not a.variable or not b.variable or a.variable == b.variable:
-            return None
-        key_item, cnt_item = ret.items
-        cexpr = cnt_item.expr
-        if not (
-            isinstance(cexpr, ast.FunctionCall)
-            and cexpr.name == "count"
-            and not cexpr.distinct
-            and len(cexpr.args) == 1
-        ):
-            return None
-        carg = cexpr.args[0]
-        counts_other = (
-            isinstance(carg, ast.Literal) and carg.value == "*"
-        ) or (
-            isinstance(carg, ast.Variable) and carg.name == b.variable
-        )
-        # the rel variable also counts one-per-row
-        if not counts_other and rel.variable:
-            counts_other = (
-                isinstance(carg, ast.Variable) and carg.name == rel.variable
-            )
-        if not counts_other:
-            return None
-        kexpr = key_item.expr
-        if isinstance(kexpr, ast.Variable) and kexpr.name == a.variable:
-            key_of = None  # whole node
-        elif (
-            isinstance(kexpr, ast.Property)
-            and isinstance(kexpr.subject, ast.Variable)
-            and kexpr.subject.name == a.variable
-        ):
-            key_of = kexpr.key
-        else:
-            return None
-        # group on the anchor side: 'out' anchors the start node of each
-        # edge, 'in' the end node ((x)<-[:T]-(y): x is the edge's target)
-        anchor_is_start = rel.direction == "out"
-        counts: dict[str, int] = {}
-        for edge in self.storage.get_edges_by_type(rel.types[0]):
-            nid = edge.start_node if anchor_is_start else edge.end_node
-            counts[nid] = counts.get(nid, 0) + 1
-        rows_out: list[list[Any]] = []
-        for nid in sorted(counts):
-            node = self.get_node_or_none(nid)
-            if node is None:
-                continue
-            keyv = node if key_of is None else node.properties.get(key_of)
-            rows_out.append([keyv, counts[nid]])
-        return Result([key_item.key, cnt_item.key], rows_out)
 
     def _fp_edge_agg(self, match, ret, els, params) -> Optional[Result]:
         """MATCH ()-[r:T]-() RETURN agg(r.prop), ... — one edge scan per
@@ -621,6 +510,10 @@ class CypherExecutor:
                 plan.append((e.name, arg.key))
                 continue
             return None
+        if all(agg == "count_rows" for agg, _ in plan):
+            # pure edge counts are covered by the columnar planner's
+            # EdgeCountOp — retired there, not shadowed here
+            return None
         mult = 2 if rel.direction == "both" else 1
         edges = (
             self.storage.get_edges_by_type(rel.types[0])
@@ -652,283 +545,6 @@ class CypherExecutor:
             else:
                 out.append(max(vals) if vals else None)
         return Result([it.key for it in ret.items], [out])
-
-    def _fp_mutual_rel(self, match, ret, els, params) -> Optional[Result]:
-        """MATCH (a)-[:T]->(b)-[:T]->(a) RETURN count(*) — single-pass edge
-        set intersection instead of nested expansion (ref:
-        detectMutualRelationship query_patterns.go:238). Multiplicity
-        follows relationship isomorphism: pairs of distinct edges."""
-        if match.where is not None or len(ret.items) != 1:
-            return None
-        if not (
-            len(els) == 5
-            and isinstance(els[0], ast.NodePattern)
-            and isinstance(els[1], ast.RelPattern)
-            and isinstance(els[2], ast.NodePattern)
-            and isinstance(els[3], ast.RelPattern)
-            and isinstance(els[4], ast.NodePattern)
-        ):
-            return None
-        a, r1, b, r2, a2 = els
-        for n in (a, b, a2):
-            if n.labels or n.properties or n.where:
-                return None
-        for r in (r1, r2):
-            if r.properties or r.var_length or r.variable or r.direction != "out":
-                return None
-        if not (
-            a.variable and a2.variable == a.variable
-            and b.variable and b.variable != a.variable
-        ):
-            return None
-        if len(r1.types) != 1 or r1.types != r2.types:
-            return None
-        e = ret.items[0].expr
-        if not (
-            isinstance(e, ast.FunctionCall)
-            and e.name == "count"
-            and not e.distinct
-            and len(e.args) == 1
-            and isinstance(e.args[0], ast.Literal)
-            and e.args[0].value == "*"
-        ):
-            return None
-        cnt: dict[tuple[str, str], int] = {}
-        for edge in self.storage.get_edges_by_type(r1.types[0]):
-            k = (edge.start_node, edge.end_node)
-            cnt[k] = cnt.get(k, 0) + 1
-        total = 0
-        for (s, d), c in cnt.items():
-            if s == d:
-                total += c * (c - 1)  # same edge can't bind both rels
-            else:
-                total += c * cnt.get((d, s), 0)
-        return Result([ret.items[0].key], [[total]])
-
-    _FP_TRAVERSE_MAX_ANCHORS = 64
-
-    def _fp_anchored_traverse(self, match, ret, els, params) -> Optional[Result]:
-        """Anchored fixed-length chain with property projections, e.g.
-        MATCH (p:Person {id: $id})-[:KNOWS]-(f)-[:POSTED]->(m)
-        RETURN m.content ORDER BY m.created DESC LIMIT 10
-        — walked directly on the adjacency store: no per-row binding dicts,
-        no generic expression evaluation (ref: optimized_executors.go
-        anchored traversal family). Relationship isomorphism is enforced
-        (an edge binds at most one hop); node repeats are allowed."""
-        if match.where is not None:
-            return None
-        if ret.order_by is None and ret.limit is None:
-            # without ORDER BY/LIMIT the generic path covers more shapes;
-            # this detector exists for the hot sorted/limited traversal
-            return None
-        n_els = len(els)
-        if n_els < 3 or n_els % 2 == 0:
-            return None
-        nodes = els[0::2]
-        rels = els[1::2]
-        if not all(isinstance(n, ast.NodePattern) for n in nodes):
-            return None
-        if not all(isinstance(r, ast.RelPattern) for r in rels):
-            return None
-        anchor = nodes[0]
-        if anchor.properties is None or anchor.where is not None:
-            return None
-        for n in nodes[1:]:
-            if n.properties is not None or n.where is not None:
-                return None
-        for r in rels:
-            if (r.variable or r.properties or r.var_length
-                    or r.min_hops != 1 or r.max_hops != 1 or not r.types):
-                return None
-        # variable positions; all named vars must be distinct node vars
-        positions: dict[str, int] = {}
-        for i, n in enumerate(nodes):
-            if n.variable:
-                if n.variable in positions:
-                    return None  # repeated var = join constraint; generic
-                positions[n.variable] = i
-
-        def compile_value(expr):
-            """node-property / whole-node accessors only."""
-            if (isinstance(expr, ast.Property)
-                    and isinstance(expr.subject, ast.Variable)
-                    and expr.subject.name in positions):
-                pos, prop = positions[expr.subject.name], expr.key
-                return lambda path: path[pos].properties.get(prop)
-            if isinstance(expr, ast.Variable) and expr.name in positions:
-                pos = positions[expr.name]
-
-                def whole(path, pos=pos):
-                    # path nodes may be live stored objects (node_entry);
-                    # a whole-node projection must hand out a copy. A node
-                    # deleted since matching falls back to the path's own
-                    # snapshot; anything else is a real storage failure
-                    n = path[pos]
-                    try:
-                        return self.storage.get_node(n.id)
-                    except NotFoundError:
-                        return n.copy()
-
-                return whole
-            return None
-
-        getters = []
-        for item in ret.items:
-            g = compile_value(item.expr)
-            if g is None:
-                return None
-            getters.append(g)
-        aliases = {item.key: i for i, item in enumerate(ret.items)}
-        key_getters, descs = [], []
-        for oi in (ret.order_by or ()):
-            # the generic path's ORDER BY binding overlays RETURN columns
-            # on top of pattern variables, so an alias shadowing a pattern
-            # var WINS — resolve aliases first here too
-            if isinstance(oi.expr, ast.Variable) and oi.expr.name in aliases:
-                g = getters[aliases[oi.expr.name]]
-            elif (isinstance(oi.expr, ast.Property)
-                  and isinstance(oi.expr.subject, ast.Variable)
-                  and oi.expr.subject.name in aliases):
-                return None  # property-of-alias: generic path semantics
-            else:
-                g = compile_value(oi.expr)
-            if g is None:
-                return None
-            key_getters.append(g)
-            descs.append(oi.descending)
-
-        def static_int(expr):
-            if expr is None:
-                return None, True
-            if isinstance(expr, ast.Literal) and isinstance(expr.value, int):
-                return expr.value, True
-            if isinstance(expr, ast.Parameter):
-                v = params.get(expr.name)
-                return (v, True) if isinstance(v, int) else (None, False)
-            return None, False
-
-        skip, ok = static_int(ret.skip)
-        if not ok:
-            return None
-        limit, ok = static_int(ret.limit)
-        if not ok:
-            return None
-
-        # cheap selectivity probe BEFORE materializing candidates: an
-        # unindexed unselective anchor must not pay a full label scan here
-        # and then a second one in the generic path it falls back to
-        prop_keys = sorted(anchor.properties.items.keys())
-        indexed = self.schema is not None and any(
-            self.schema.has_prop_index(label, prop_keys)
-            or any(self.schema.has_prop_index(label, [k])
-                   for k in prop_keys)
-            for label in anchor.labels
-        )
-        if not indexed:
-            if anchor.labels:
-                est = min(self.storage.count_nodes_by_label(l)
-                          for l in anchor.labels)
-            else:
-                est = self.storage.node_count()
-            if est > self._FP_TRAVERSE_MAX_ANCHORS:
-                return None
-        anchors = self.matcher._candidates(anchor, {}, params)
-        if len(anchors) > self._FP_TRAVERSE_MAX_ANCHORS:
-            return None  # unselective anchor: generic path, no blowup here
-
-        # already-built CSR snapshot first (event-fresh, no engine locks);
-        # then no-copy engine reads where offered (the copying accessors
-        # dominate this path otherwise); probe once — NamespacedEngine
-        # surfaces AttributeError when its base lacks fast adjacency
-        snap = getattr(self.storage, "_adjacency_snapshot", None)
-        if snap is not None and not snap.ready():
-            snap = None  # a one-hop fastpath must not pay the first build
-        iter_adj = getattr(self.storage, "iter_adjacency", None)
-        if iter_adj is not None:
-            try:
-                iter_adj("\x00fp-probe\x00", "out")
-            except AttributeError:
-                iter_adj = None
-            except Exception:
-                _log.debug("iter_adjacency probe failed; keeping "
-                                "fast path", exc_info=True)
-        raw_entry = getattr(self.storage, "node_entry", None)
-        node_cache: dict[str, Node] = {}
-
-        def get_node(nid: str) -> Optional[Node]:
-            n = node_cache.get(nid)
-            if n is None:
-                if raw_entry is not None:
-                    n = raw_entry(nid)  # read-only: labels + property gets
-                else:
-                    try:
-                        n = self.storage.get_node(nid)
-                    except NotFoundError:
-                        return None
-                if n is None:
-                    return None
-                node_cache[nid] = n
-            return n
-
-        def expand(nid: str, rel: ast.RelPattern):
-            if snap is not None:
-                pairs = snap.expand_pairs(nid, rel.direction, rel.types)
-                if pairs is not None:
-                    return pairs  # already (edge_id, other_id) sorted
-            out = []
-            types = rel.types
-            if iter_adj is not None:
-                if rel.direction in ("out", "both"):
-                    for eid, t, oid in iter_adj(nid, "out"):
-                        if t in types:
-                            out.append((eid, oid))
-                if rel.direction in ("in", "both"):
-                    for eid, t, oid in iter_adj(nid, "in"):
-                        if t in types:
-                            out.append((eid, oid))
-                out.sort()  # matcher expands in edge-id order; with LIMIT
-                return out  # and tied keys, set order would leak through
-            if rel.direction in ("out", "both"):
-                for e in self.storage.get_outgoing_edges(nid):
-                    if e.type in types:
-                        out.append((e.id, e.end_node))
-            if rel.direction in ("in", "both"):
-                for e in self.storage.get_incoming_edges(nid):
-                    if e.type in types:
-                        out.append((e.id, e.start_node))
-            out.sort()
-            return out
-
-        paths: list[tuple] = []
-
-        def walk(path: tuple, used: tuple, hop: int) -> None:
-            if hop == len(rels):
-                paths.append(path)
-                return
-            for eid, other_id in expand(path[-1].id, rels[hop]):
-                if eid in used:
-                    continue
-                n = get_node(other_id)
-                if n is None:
-                    continue
-                pat = nodes[hop + 1]
-                if pat.labels and not any(
-                        l in n.labels for l in pat.labels):
-                    continue
-                walk(path + (n,), used + (eid,), hop + 1)
-
-        for a in anchors:
-            walk((a,), (), 0)
-
-        if key_getters:
-            keyed = [([g(p) for g in key_getters], p) for p in paths]
-            paths = _multisort(keyed, descs)
-        if skip:
-            paths = paths[skip:]
-        if limit is not None:
-            paths = paths[:limit]
-        data = [[g(p) for g in getters] for p in paths]
-        return Result([item.key for item in ret.items], data)
 
     # -- query pipeline -----------------------------------------------------------
     def _run_query(
@@ -962,14 +578,34 @@ class CypherExecutor:
         start_rows: Optional[list[dict]] = None,
         stats: Optional[Stats] = None,
     ) -> Result:
+        stats = stats if stats is not None else Stats()
         if start_rows is None:
             fast = self._try_fastpath(q, params)
             if fast is not None:
                 return fast
+            # columnar operator pipeline (cypher/columnar.py): compiled
+            # plans over the CSR snapshot with per-operator fallback; a
+            # None return means "serve it generically" (unsupported shape
+            # or the snapshot cannot serve this engine/query right now)
+            res = self.columnar.try_query(q, params, stats)
+            if res is not None:
+                return res
         rows: list[dict[str, Any]] = (
             [dict(r) for r in start_rows] if start_rows is not None else [{}]
         )
-        stats = stats if stats is not None else Stats()
+        return self._finish_clauses(q, params, rows, 0, stats)
+
+    def _finish_clauses(
+        self,
+        q: ast.Query,
+        params: dict[str, Any],
+        rows: list[dict],
+        start_idx: int,
+        stats: Stats,
+    ) -> Result:
+        """Run clauses from ``start_idx`` over generic binding rows — the
+        whole query when called from _run_single, the generic tail when
+        the columnar pipeline hands a partial binding table back."""
         columns: list[str] = []
         out_rows: list[list[Any]] = []
         produced = False
@@ -983,7 +619,7 @@ class CypherExecutor:
             if limits is not None and getattr(limits, "max_query_time", 0)
             else None
         )
-        for clause in q.clauses:
+        for clause in q.clauses[start_idx:]:
             if deadline is not None and time.monotonic() > deadline:
                 raise NornicError(
                     f"query exceeded max_query_time "
@@ -2080,6 +1716,11 @@ class CypherExecutor:
         explicitly."""
         if self.cache is not None:
             self.cache.clear()
+        # DDL moves planning decisions (index-backed anchors): drop every
+        # cached columnar plan (counted as invalidations; the schema
+        # generation stamp also catches DDL issued via another executor
+        # sharing this SchemaManager)
+        self.columnar.cache.clear()
 
     def _query_limits(self):
         """(limits, query_bucket) for this executor's database. LimitedEngine
@@ -2294,6 +1935,13 @@ class CypherExecutor:
         lines = ["Query plan:"]
         for c in q.clauses:
             lines.append(f"  {type(c).__name__}")
+        # per-operator engine report (columnar vs generic) + plan-cache
+        # hit/miss for the columnar pipeline (docs/operations.md
+        # "Columnar Cypher execution")
+        try:
+            lines.extend(self.columnar.explain_lines(q))
+        except Exception:
+            _log.debug("columnar explain failed", exc_info=True)
         return "\n".join(lines)
 
 

@@ -656,6 +656,13 @@ class DB:
         snap = getattr(self.storage, "_adjacency_snapshot", None)
         return snap.stats_snapshot() if snap is not None else None
 
+    def cypher_stats(self) -> Optional[dict[str, Any]]:
+        """Columnar Cypher engine counters (plan-cache hit/miss/
+        invalidations + per-outcome query counts), or None before the
+        executor exists — stats must never force its lazy construction."""
+        col = getattr(self._executor, "columnar", None)
+        return col.stats_snapshot() if col is not None else None
+
     # -- backup / restore (ref: badger_backup.go + /admin/backup,
     # db_admin.go admin ops) -----------------------------------------------
     def backup(self, dest_path: Optional[str] = None) -> str:

@@ -34,6 +34,10 @@ from nornicdb_tpu.storage.types import Edge, Node
 from nornicdb_tpu.cypher import ast as cypher_ast
 from nornicdb_tpu.cypher.executor import classify_query_text
 from nornicdb_tpu.cypher.parser import parse as cypher_parse
+# registers the columnar-Cypher families (plan-cache hits/misses/
+# invalidations, per-operator latency, columnar rows, offloads) so the
+# tested docs/observability.md catalog renders in every server process
+from nornicdb_tpu.cypher import plan as _cypher_plan  # noqa: F401
 # registers the serving-engine metric families (packed tokens, pack
 # efficiency, sheds, staging overlap, embedder selection) so the tested
 # docs/observability.md catalog renders in every server process, whether
@@ -769,6 +773,12 @@ class HttpServer:
                 # CSR topology snapshot health: builds / delta merges /
                 # epoch retries / resident bytes (tune merge_threshold here)
                 stats["adjacency"] = adjacency
+            cypher_stats = self.db.cypher_stats()
+            if cypher_stats is not None:
+                # columnar Cypher engine: plan-cache hits/misses/
+                # invalidations + full/fallback/unsupported outcomes
+                # (docs/operations.md "Columnar Cypher execution")
+                stats["cypher"] = cypher_stats
             from nornicdb_tpu import backend as _backend_mod
 
             backend_stats = _backend_mod.manager_stats()

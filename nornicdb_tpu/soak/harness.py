@@ -773,6 +773,11 @@ class SoakHarness:
                 # an OK generate request AFTER the last fault window
                 report.invariants.append(
                     inv.check_genserve_live(metrics_text))
+            if getattr(spec.workload, "cypher_workers", 0) > 0:
+                # the repeated-shape cypher class must ride the columnar
+                # plan cache warm, with a bounded slow-query tail
+                report.invariants.append(
+                    inv.check_plan_cache_effective(samples, metrics_text))
             report.invariants.append(inv.check_chaos_in_metrics(
                 metrics_text,
                 [dict(t.stats) for t in repl.chaos.values()]))

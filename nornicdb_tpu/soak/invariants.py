@@ -23,6 +23,10 @@ Catalog:
 * ``chaos_in_metrics``  — nornicdb_chaos_events_total in /metrics covers
                           the per-instance stats (the registry is the
                           source of truth for soak reports)
+* ``plan_cache_effective`` — with a cypher-heavy traffic class, the
+                          columnar plan cache serves repeat shapes warm
+                          (hit ratio over threshold) and the class's
+                          ok-request p99 stays bounded
 """
 
 from __future__ import annotations
@@ -36,6 +40,7 @@ from nornicdb_tpu.soak.report import (
     metric_total,
     parse_prometheus,
     passed,
+    percentile,
 )
 
 
@@ -200,6 +205,51 @@ def check_genserve_live(metrics_text: str) -> InvariantResult:
     return passed("genserve_live",
                   f"{int(tokens)} tokens generated, {int(shed_total)} "
                   "legal sheds, queue drained")
+
+
+def check_plan_cache_effective(
+    samples: list[Sample], metrics_text: str,
+    min_hit_ratio: float = 0.5, p99_bound_s: float = 2.0,
+    min_requests: int = 20,
+) -> InvariantResult:
+    """The cypher-heavy traffic class repeats a small shape repertoire —
+    after warmup the columnar plan cache must serve it (hit ratio over
+    ``min_hit_ratio``), and the class's ok-request p99 must stay under
+    ``p99_bound_s`` (slow-query tail bounded; the deadline+grace wedge
+    bound is checked separately by bounded_latency)."""
+    cy = [s for s in samples if s.protocol == "cypher"]
+    oks = sorted(s.latency_s for s in cy if s.outcome == "ok")
+    if len(cy) < min_requests or not oks:
+        return failed(
+            "plan_cache_effective",
+            f"cypher traffic class too thin to judge: {len(cy)} requests, "
+            f"{len(oks)} ok")
+    try:
+        fams = parse_prometheus(metrics_text)
+    except ValueError as e:
+        return failed("plan_cache_effective", f"metrics unparseable: {e}")
+    hits = metric_total(fams, "nornicdb_cypher_plan_cache_hits_total") or 0.0
+    misses = metric_total(
+        fams, "nornicdb_cypher_plan_cache_misses_total") or 0.0
+    total = hits + misses
+    if not total:
+        return failed("plan_cache_effective",
+                      "plan cache never consulted under cypher traffic")
+    ratio = hits / total
+    if ratio < min_hit_ratio:
+        return failed(
+            "plan_cache_effective",
+            f"plan-cache hit ratio {ratio:.2f} < {min_hit_ratio} "
+            f"({int(hits)} hits / {int(misses)} misses)")
+    p99 = percentile(oks, 0.99)
+    if p99 > p99_bound_s:
+        return failed(
+            "plan_cache_effective",
+            f"cypher ok-request p99 {p99:.2f}s > {p99_bound_s}s bound")
+    return passed(
+        "plan_cache_effective",
+        f"hit ratio {ratio:.2f} ({int(hits)}/{int(total)}), "
+        f"cypher p99 {p99 * 1e3:.0f}ms over {len(oks)} ok requests")
 
 
 def check_chaos_in_metrics(metrics_text: str,

@@ -73,6 +73,12 @@ class WorkloadSpec:
     # generation traffic class: Heimdall chat (QC-shaped) + GraphRAG
     # answers through the genserve continuous-batching engine
     generate_workers: int = 1
+    # cypher-heavy traffic class: a small repertoire of repeated
+    # MATCH/WHERE/aggregate/traverse shapes over HTTP — repeat shapes by
+    # design, so the columnar plan cache must serve them warm (the
+    # plan_cache_effective invariant reads its hit ratio + this class's
+    # latency tail)
+    cypher_workers: int = 0
     replication_writers: int = 1
     # prefork protocol workers fronting the HTTP surface (0 = traffic hits
     # the primary directly, the pre-PR-12 stacks). With front_workers > 0
@@ -168,7 +174,7 @@ _FULL_WINDOWS = [
 
 FULL = ScenarioSpec(
     name="full", seed=20260803, duration_s=300.0,
-    workload=WorkloadSpec(),
+    workload=WorkloadSpec(cypher_workers=1),
     faults=tuple(_FULL_WINDOWS),
     drain_s=15.0,
 )
@@ -178,7 +184,7 @@ FULL = ScenarioSpec(
 _CI_WINDOWS = _scale(_FULL_WINDOWS, 0.2)
 CI = ScenarioSpec(
     name="ci", seed=1337, duration_s=60.0,
-    workload=WorkloadSpec(think_s=0.02),
+    workload=WorkloadSpec(think_s=0.02, cypher_workers=1),
     faults=tuple(
         FaultWindow(w.at_s, w.duration_s, w.plane, w.kind,
                     ({**w.params, "count": max(10, w.params["count"] // 5)}

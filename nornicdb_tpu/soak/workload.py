@@ -336,6 +336,59 @@ class WorkloadRunner:
             self._pace(rng)
         self.heartbeat.forget(name)
 
+    # -- cypher-heavy (columnar plan-cache) --------------------------------
+    # a DELIBERATELY small repertoire of repeated read shapes: the plan
+    # cache must serve them warm after the first round, and the
+    # plan_cache_effective invariant asserts exactly that against
+    # /metrics. Shapes span scan+WHERE, aggregate, group-count, and an
+    # anchored traverse — the columnar pipeline's operator set.
+    _CYPHER_SHAPES = [
+        ("agg_count",
+         "MATCH (n:SoakW) WHERE n.w >= $w RETURN count(n)",
+         lambda self, rng: {"w": rng.randint(0, 3)}),
+        ("edge_count",
+         "MATCH ()-[r:NEXT]->() RETURN count(r)",
+         lambda self, rng: {}),
+        ("group_count",
+         "MATCH (a:SoakW)-[:NEXT]->(b) RETURN a.w, count(b)",
+         lambda self, rng: {}),
+        ("anchored",
+         "MATCH (a:SoakW {uid: $uid})-[:NEXT]->(b) "
+         "RETURN b.uid ORDER BY b.uid LIMIT 5",
+         lambda self, rng: {"uid": self._pick_uid(rng) or "none"}),
+    ]
+
+    def _cypher_worker(self, idx: int) -> None:
+        name = f"cypher-{idx}"
+        rng = random.Random(self.seed * 7000 + idx)
+        base = f"http://127.0.0.1:{self.ports['http']}"
+        deadline = self.spec.workload.deadline_s
+        while not self.stop_event.is_set():
+            self.heartbeat.beat(name)
+            op, stmt, mk = self._CYPHER_SHAPES[
+                rng.randrange(len(self._CYPHER_SHAPES))]
+            t0 = time.monotonic()
+            try:
+                outcome, detail = self._http_cypher(base, [{
+                    "statement": stmt, "parameters": mk(self, rng),
+                }], deadline)
+                self._record("cypher", op, outcome, t0, detail)
+            except (socket.timeout, TimeoutError):
+                self._record("cypher", "request", "timeout", t0, "timeout")
+            except (urllib.error.URLError, ConnectionError, OSError) as e:
+                self._record("cypher", "request", "unavailable", t0,
+                             type(e).__name__)
+            # adaptive pacing: aggregate shapes get costlier as the SoakW
+            # graph grows, and everything here shares one GIL with the
+            # raft cluster — cap this class's duty cycle at ~1/3 so it
+            # proves plan-cache effectiveness without starving
+            # replication catch-up during lossy windows
+            self._pace(rng)
+            elapsed = time.monotonic() - t0
+            self.stop_event.wait(
+                max(self.spec.workload.think_s, 2 * elapsed))
+        self.heartbeat.forget(name)
+
     # -- Bolt --------------------------------------------------------------
     def _bolt_worker(self, idx: int) -> None:
         name = f"bolt-{idx}"
@@ -536,6 +589,8 @@ class WorkloadRunner:
             ("qdrant", w.qdrant_workers, self._qdrant_worker),
             ("generate", getattr(w, "generate_workers", 0),
              self._generate_worker),
+            ("cypher", getattr(w, "cypher_workers", 0),
+             self._cypher_worker),
         ]
         for proto, count, fn in plan:
             if count > 0:

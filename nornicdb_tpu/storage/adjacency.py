@@ -76,6 +76,14 @@ _NODE_EVENTS = (NODE_CREATED, NODE_DELETED)
 # (docs/operations.md "Adjacency snapshot tuning")
 DEFAULT_MERGE_THRESHOLD = 4096
 
+# csr_view() fold economics: graphs at or under the eager floor always
+# fold pending delta adds before serving (the rebuild is cheap); larger
+# graphs wait for VIEW_FOLD_MIN_PENDING buffered events so a trickle of
+# single writes can't force an O(m log m) rank rebuild per read — interim
+# reads serve generically through the matcher's delta overlay instead
+VIEW_FOLD_EAGER_EDGES = 32_768
+VIEW_FOLD_MIN_PENDING = 512
+
 _attach_lock = threading.Lock()
 
 
@@ -129,6 +137,87 @@ def attach_snapshot(storage, merge_threshold: Optional[int] = None):
     if merge_threshold is not None:
         snap.merge_threshold = max(int(merge_threshold), 1)
     return snap
+
+
+class CSRView:
+    """Generation-pinned capture of the merged CSR arrays for the columnar
+    Cypher pipeline (cypher/columnar.py).  Built under the snapshot lock
+    with the delta buffer folded first, so a view needs no overlay logic:
+    the CSR arrays alone answer every expansion.  Arrays are replaced —
+    never resized — by later merges, so holding a view across a query is
+    safe; ``row_alive``/``node_alive`` are copies pinned at capture (a
+    concurrent delete must not tear a half-executed operator pipeline).
+
+    ``erow_rank[r]`` is the dense rank of edge row ``r`` in edge-ID-sorted
+    order — expansions order each frontier node's edges by this integer
+    instead of sorting edge-id strings per query (the generic matcher's
+    per-edge ``sort()`` contract at array speed)."""
+
+    __slots__ = ("generation", "n_csr", "ids", "node_alive", "row_alive",
+                 "erow_type", "erow_rank", "row_ids", "type_code", "_csr")
+
+    def __init__(self, generation, n_csr, ids, node_alive, row_alive,
+                 erow_type, erow_rank, row_ids, type_code, csr):
+        self.generation = generation
+        self.n_csr = n_csr
+        self.ids = ids              # vocab list ref (append-only)
+        self.node_alive = node_alive
+        self.row_alive = row_alive
+        self.erow_type = erow_type
+        self.erow_rank = erow_rank
+        self.row_ids = row_ids      # row -> edge id (list ref; replaced by merges)
+        self.type_code = type_code  # name -> code (copy)
+        self._csr = csr             # {"out": (off, nbr, rows), "in": ...}
+
+    def codes_for(self, types) -> Optional[list[int]]:
+        """Codes for a rel-type filter; None = no filter. An empty list
+        means the types were never seen on any edge (matches nothing)."""
+        if not types:
+            return None
+        return [c for t in types if (c := self.type_code.get(t)) is not None]
+
+    def expand_unique(
+        self, uniq: np.ndarray, direction: str,
+        codes: Optional[list[int]],
+    ) -> tuple[np.ndarray, np.ndarray, np.ndarray]:
+        """Batched expansion of a SORTED array of unique node indices.
+
+        Returns ``(counts, rows, nbrs)``: ``counts[i]`` edges for
+        ``uniq[i]``, with the flat ``rows``/``nbrs`` arrays segmented per
+        unique node and each segment ordered by edge id (via erow_rank) —
+        the order the generic matcher's per-node expansion produces.
+        Edges to dead neighbor nodes are dropped (the generic walk skips
+        them at get_node time)."""
+        dirs = (("out",) if direction == "out"
+                else ("in",) if direction == "in" else ("out", "in"))
+        h_parts, r_parts, n_parts = [], [], []
+        for d in dirs:
+            off, nbr, rows = self._csr[d]
+            h, r, nb = _gather_csr(off, nbr, rows, self.row_alive,
+                                   self.erow_type, self.n_csr, uniq, codes)
+            if h.size:
+                h_parts.append(h)
+                r_parts.append(r)
+                n_parts.append(nb)
+        zero = np.zeros(len(uniq), np.int64)
+        if not h_parts:
+            empty = np.zeros(0, np.int64)
+            return zero, empty, empty
+        heads = np.concatenate(h_parts)
+        rows = np.concatenate(r_parts)
+        nbrs = np.concatenate(n_parts)
+        keep = self.node_alive[nbrs]
+        if not keep.all():
+            sel = np.nonzero(keep)[0]
+            heads, rows, nbrs = heads[sel], rows[sel], nbrs[sel]
+        if not heads.size:
+            empty = np.zeros(0, np.int64)
+            return zero, empty, empty
+        pos = np.searchsorted(uniq, heads)
+        order = np.lexsort((self.erow_rank[rows], pos))
+        pos = pos[order]
+        counts = np.bincount(pos, minlength=len(uniq)).astype(np.int64)
+        return counts, rows[order], nbrs[order]
 
 
 class EdgeArraysView:
@@ -212,6 +301,12 @@ class AdjacencySnapshot:
         # -- generation-tagged derived views -------------------------------
         self._view_cache: Optional[EdgeArraysView] = None
         self._graph_cache: dict[Any, tuple[int, Any]] = {}
+        # columnar-pipeline view cache: the CSRView itself is keyed on
+        # generation; the edge-id rank array on the _row_ids list identity
+        # (merges replace the list, everything else leaves it alone)
+        self._csr_view: Optional[CSRView] = None
+        self._rank_src: Optional[list] = None
+        self._rank_arr: Optional[np.ndarray] = None
         storage.on_event(self._on_event)
 
     # -- event handler (writer threads; touches ONLY snapshot state) -------
@@ -676,6 +771,72 @@ class AdjacencySnapshot:
             level += 1
             dist[frontier] = level
         return dist
+
+    def indices_of(self, ids: list[str]) -> np.ndarray:
+        """Batched id -> vocab index lookup (-1 for unknown/dead nodes) —
+        one locked pass instead of a locked call per node."""
+        with self._lock:
+            idx = self._idx
+            alive = self._alive
+            out = np.empty(len(ids), np.int64)
+            for k, s in enumerate(ids):
+                i = idx.get(s)
+                out[k] = i if (i is not None and alive[i]) else -1
+            return out
+
+    def csr_view(self) -> Optional[CSRView]:
+        """Delta-folded, generation-pinned :class:`CSRView` for the
+        columnar Cypher pipeline, or None before the first build.
+
+        A pure-array consumer has no delta-overlay logic, so pending delta
+        ADDS must be folded into the CSR before it reads.  But a fold
+        rebuilds the canonical arrays AND the edge-id rank (O(m log m) —
+        measured ~250ms at 500k edges), so a single trickled write must
+        not pay that per read: small graphs fold eagerly (cheap), large
+        graphs wait for the delta to amortize the rebuild and serve the
+        interim reads generically (returning None — the matcher's
+        existing delta overlay answers them).  Tombstoned deletes need no
+        fold (the pinned ``row_alive`` copy filters them).  Repeat
+        queries on an unchanged graph reuse the cached view (and its
+        rank array) for free."""
+        with self._lock:
+            if not self._built:
+                return None
+            if self._d_ids:
+                if self._m <= VIEW_FOLD_EAGER_EDGES \
+                        or self._pending >= VIEW_FOLD_MIN_PENDING:
+                    self._merge_locked()
+                else:
+                    return None
+            view = self._csr_view
+            if view is not None and view.generation == self._generation:
+                return view
+            if self._rank_src is not self._row_ids:
+                # dense rank of each edge row in edge-ID-sorted order;
+                # one C-speed argsort per merge, reused by every query
+                if self._m:
+                    order = np.argsort(np.asarray(self._row_ids))
+                    rank = np.empty(self._m, np.int64)
+                    rank[order] = np.arange(self._m)
+                else:
+                    rank = np.zeros(0, np.int64)
+                self._rank_src = self._row_ids
+                self._rank_arr = rank
+            view = CSRView(
+                generation=self._generation,
+                n_csr=self._n_csr,
+                ids=self._ids,
+                node_alive=np.asarray(self._alive, bool),
+                row_alive=self._row_alive.copy(),
+                erow_type=self._erow_type,
+                erow_rank=self._rank_arr,
+                row_ids=self._row_ids,
+                type_code=dict(self._type_code),
+                csr={"out": (self._out_off, self._out_nbr, self._out_rows),
+                     "in": (self._in_off, self._in_nbr, self._in_rows)},
+            )
+            self._csr_view = view
+            return view
 
     def export_arrays(self) -> Optional[tuple[dict, dict]]:
         """Merged, self-contained copies of the CSR arrays + vocab for the

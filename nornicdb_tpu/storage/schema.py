@@ -71,6 +71,11 @@ class SchemaManager:
         self._node_entries: dict[str, set[tuple]] = {}
         self._engine = None
         self._subscribed = False
+        # DDL generation: bumped on index/constraint create/drop so the
+        # columnar plan cache (cypher/plan.py) can invalidate plans whose
+        # anchor strategy was chosen against a different index set —
+        # including DDL issued via another executor sharing this manager
+        self.generation = 0
 
     # -- index DDL ---------------------------------------------------------
     def create_index(
@@ -89,6 +94,7 @@ class SchemaManager:
                 raise AlreadyExistsError(f"index {name} already exists")
             idx = IndexDef(name, kind, label, list(properties), options or {})
             self._indexes[name] = idx
+            self.generation += 1
             if kind in (INDEX_PROPERTY, INDEX_COMPOSITE, INDEX_RANGE):
                 self._subscribe()
                 self._prop_maps.setdefault((label, _norm(properties)), {})
@@ -102,6 +108,7 @@ class SchemaManager:
                 if if_exists:
                     return
                 raise NotFoundError(f"index {name} not found")
+            self.generation += 1
             key = (idx.label, _norm(idx.properties))
             if not any(
                 (i.label, _norm(i.properties)) == key
@@ -151,6 +158,7 @@ class SchemaManager:
                 raise AlreadyExistsError(f"constraint {name} already exists")
             c = ConstraintDef(name, label, list(properties), kind)
             self._constraints[name] = c
+            self.generation += 1
             self._subscribe()
             key = (label, _norm(properties))
             created_map = key not in self._prop_maps
@@ -189,6 +197,7 @@ class SchemaManager:
         with self._lock:
             if self._constraints.pop(name, None) is None and not if_exists:
                 raise NotFoundError(f"constraint {name} not found")
+            self.generation += 1
 
     def list_constraints(self) -> list[ConstraintDef]:
         with self._lock:

@@ -98,6 +98,16 @@ def counters_probe(db) -> Optional[dict[str, float]]:
     counts = getattr(search, "tune_counts", None)
     if counts:
         out["ivf_tunes_total"] = float(sum(counts.values()))
+    # columnar plan-cache counters (cypher/plan.py): a slow statement
+    # whose deltas show a plan-cache miss just paid a fresh compile.
+    # _executor, never the executor property — probing must not force
+    # lazy executor construction
+    ex = getattr(db, "_executor", None)
+    pc = getattr(getattr(ex, "columnar", None), "cache", None)
+    if pc is not None:
+        out["cypher_plan_cache_hits"] = pc.hits
+        out["cypher_plan_cache_misses"] = pc.misses
+        out["cypher_plan_cache_invalidations"] = pc.invalidations
     return out or None
 
 
@@ -137,6 +147,7 @@ class SlowQueryLog:
         probe_after: Optional[dict[str, float]] = None,
         trace_spans: Optional[list[dict]] = None,
         trace_id: Optional[str] = None,
+        columnar: Optional[dict[str, Any]] = None,
     ) -> bool:
         if not self.enabled or duration_s < self.threshold_s:
             return False
@@ -166,6 +177,10 @@ class SlowQueryLog:
             "span_breakdown": breakdown or None,
             "counter_deltas": deltas,
             "plan": (plan[:_MAX_PLAN_CHARS] if plan else None),
+            # columnar engine report: plan-cache key hash, outcome, and
+            # measured per-operator timings (value-free — operator labels
+            # render lifted literals as §N placeholders)
+            "columnar": columnar,
         }
         self._ring.append(entry)  # deque.append: atomic under the GIL
         self.recorded += 1

@@ -1,0 +1,229 @@
+#!/usr/bin/env python
+"""Columnar Cypher pipeline vs the row-at-a-time interpreter.
+
+Representative MATCH/expand/aggregate shapes at 100k nodes / 500k edges
+(defaults; ``--quick`` shrinks to 10k/50k for the non-gating CI step),
+run through BOTH engines over the SAME storage, plus a plan-cache
+cold-vs-warm comparison.  Writes BENCH_cypher.json (``--out``).
+
+Exit invariants (non-zero exit on violation):
+
+* the timed warm pass compiles ZERO fresh plans and the text fast path
+  serves every repeat (plan-cache counters asserted);
+* ZERO full ``all_edges()`` rescans during any timed pass — the CSR
+  snapshot is built once in warmup and event-maintained after;
+* results identical between engines for every shape (spot equivalence);
+* p50 speedup >= 3x on at least two MATCH/aggregate shapes (the
+  ROADMAP/ISSUE acceptance bar; relaxed to 2x under ``--quick``, where
+  fixed per-query overheads dominate the small corpus).
+
+stderr carries progress; stdout stays clean (artifact written to disk).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import random
+import statistics
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from nornicdb_tpu.cypher import CypherExecutor  # noqa: E402
+from nornicdb_tpu.storage import MemoryEngine  # noqa: E402
+from nornicdb_tpu.storage.types import Edge, Node  # noqa: E402
+
+
+class CountingEngine(MemoryEngine):
+    """all_edges() call counter: proves the no-rescan invariant."""
+
+    def __init__(self):
+        super().__init__()
+        self.all_edges_calls = 0
+
+    def all_edges(self):
+        self.all_edges_calls += 1
+        return super().all_edges()
+
+
+def log(msg: str) -> None:
+    print(msg, file=sys.stderr, flush=True)
+
+
+def build_graph(eng, n_nodes: int, n_edges: int, seed: int = 20260804):
+    rng = random.Random(seed)
+    cities = ["Oslo", "Bergen", "Narvik", "Tromso", None]
+    t0 = time.perf_counter()
+    for i in range(n_nodes):
+        eng.create_node(Node(
+            id=f"p{i:07d}", labels=["Person"],
+            properties={"i": i, "name": f"P{i:07d}", "age": (i * 7) % 90,
+                        "score": rng.random() * 100,
+                        "city": cities[i % len(cities)]}))
+    for e in range(n_edges):
+        s = rng.randrange(n_nodes)
+        d = rng.randrange(n_nodes)
+        eng.create_edge(Edge(
+            id=f"k{e:07d}", start_node=f"p{s:07d}", end_node=f"p{d:07d}",
+            type="KNOWS", properties={"w": rng.random()}))
+    log(f"built {n_nodes} nodes / {n_edges} edges in "
+        f"{time.perf_counter() - t0:.1f}s")
+
+
+SHAPES = [
+    ("filter_count",
+     "MATCH (n:Person) WHERE n.age > 40 RETURN count(n)", {}),
+    ("filter_project",
+     "MATCH (n:Person) WHERE n.age > 80 AND n.city = 'Oslo' RETURN n.i",
+     {}),
+    ("group_count",
+     "MATCH (a:Person)-[:KNOWS]->(b) RETURN a.age, count(b)", {}),
+    ("edge_count",
+     "MATCH ()-[r:KNOWS]->() RETURN count(r)", {}),
+    ("expand_filter_count",
+     "MATCH (a:Person)-[:KNOWS]->(b) WHERE b.age > 45 RETURN count(*)",
+     {}),
+    ("order_limit",
+     "MATCH (n:Person) WHERE n.age > 30 "
+     "RETURN n.name ORDER BY n.score DESC LIMIT 10", {}),
+    ("anchored_two_hop",
+     "MATCH (p:Person {i: $i})-[:KNOWS]->(f)-[:KNOWS]->(g) "
+     "RETURN g.i ORDER BY g.i LIMIT 10", {"i": 12345}),
+]
+
+
+def time_query(ex, query, params, iters):
+    lat = []
+    rows = None
+    for _ in range(iters):
+        t0 = time.perf_counter()
+        r = ex.execute(query, dict(params))
+        lat.append((time.perf_counter() - t0) * 1e3)
+        rows = r
+    lat.sort()
+    return {
+        "p50_ms": round(statistics.median(lat), 3),
+        "p99_ms": round(lat[max(0, int(len(lat) * 0.99) - 1)]
+                        if len(lat) > 1 else lat[0], 3),
+        "iters": iters,
+    }, rows
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--nodes", type=int, default=100_000)
+    ap.add_argument("--edges", type=int, default=500_000)
+    ap.add_argument("--iters", type=int, default=9)
+    ap.add_argument("--interp-iters", type=int, default=3)
+    ap.add_argument("--quick", action="store_true",
+                    help="10k/50k corpus for the non-gating CI step")
+    ap.add_argument("--out", default=os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        "BENCH_cypher.json"))
+    args = ap.parse_args()
+    if args.quick:
+        args.nodes, args.edges = 10_000, 50_000
+    speedup_bar = 2.0 if args.quick else 3.0
+
+    eng = CountingEngine()
+    build_graph(eng, args.nodes, args.edges)
+    ex_col = CypherExecutor(eng)       # columnar pipeline (default-on)
+    ex_int = CypherExecutor(eng)       # row-at-a-time interpreter
+    ex_int.columnar.enabled = False
+    if not ex_col.columnar.enabled:
+        log("NORNICDB_CYPHER_COLUMNAR=0 set — bench needs it on")
+        return 1
+    params_i = {"i": args.nodes // 8}
+
+    # -- warmup: build the CSR snapshot + colindex, compile every plan ----
+    log("warmup (snapshot build + plan compile)...")
+    for name, query, params in SHAPES:
+        p = params_i if "$i" in query else params
+        r_c = ex_col.execute(query, dict(p))
+        r_i = ex_int.execute(query, dict(p))
+        if repr(r_c.rows) != repr(r_i.rows):
+            log(f"EQUIVALENCE VIOLATION on {name}")
+            log(f"  columnar: {r_c.rows[:3]}")
+            log(f"  interp  : {r_i.rows[:3]}")
+            return 1
+        tr = ex_col.columnar.last_trace()
+        log(f"  {name}: outcome="
+            f"{tr['outcome'] if tr else 'generic'} rows={len(r_c.rows)}")
+
+    pc = ex_col.columnar.cache
+    compiles_before = pc.compiles
+    hits_before = pc.hits
+    rescans_before = eng.all_edges_calls
+
+    # -- timed passes ------------------------------------------------------
+    results = []
+    for name, query, params in SHAPES:
+        p = params_i if "$i" in query else params
+        col, _ = time_query(ex_col, query, p, args.iters)
+        log(f"{name}: columnar p50={col['p50_ms']}ms")
+        interp, _ = time_query(ex_int, query, p, args.interp_iters)
+        log(f"{name}: interpreter p50={interp['p50_ms']}ms")
+        speedup = (interp["p50_ms"] / col["p50_ms"]
+                   if col["p50_ms"] > 0 else float("inf"))
+        results.append({
+            "shape": name, "query": query,
+            "columnar": col, "interpreter": interp,
+            "speedup_p50": round(speedup, 2),
+        })
+
+    # -- plan cache cold vs warm ------------------------------------------
+    cold_q = "MATCH (a:Person)-[:KNOWS]->(b) WHERE b.age > $a RETURN count(*)"
+    t0 = time.perf_counter()
+    fresh = CypherExecutor(eng)  # empty plan cache: parse+normalize+compile
+    fresh.execute(cold_q, {"a": 50})
+    cold_ms = (time.perf_counter() - t0) * 1e3
+    warm_lat = []
+    for _ in range(max(args.iters, 5)):
+        t0 = time.perf_counter()
+        fresh.execute(cold_q, {"a": 50})
+        warm_lat.append((time.perf_counter() - t0) * 1e3)
+    warm_ms = statistics.median(warm_lat)
+
+    # -- exit invariants ---------------------------------------------------
+    invariants = {}
+    compiled_during_timed = pc.compiles - compiles_before
+    invariants["zero_fresh_compiles_timed_pass"] = compiled_during_timed == 0
+    invariants["text_fast_path_served"] = pc.hits > hits_before
+    rescans = eng.all_edges_calls - rescans_before
+    invariants["zero_all_edges_rescans_timed_pass"] = rescans == 0
+    fast_enough = [r["shape"] for r in results
+                   if r["speedup_p50"] >= speedup_bar]
+    invariants[f"speedup_{speedup_bar:g}x_on_two_shapes"] = \
+        len(fast_enough) >= 2
+    fresh_pc = fresh.columnar.cache.stats_snapshot()
+
+    artifact = {
+        "bench": "cypher_columnar_vs_interpreter",
+        "corpus": {"nodes": args.nodes, "edges": args.edges,
+                   "quick": args.quick},
+        "shapes": results,
+        "plan_cache": {
+            "cold_first_exec_ms": round(cold_ms, 3),
+            "warm_p50_ms": round(warm_ms, 3),
+            "warm_speedup": round(cold_ms / warm_ms, 2) if warm_ms else None,
+            "fresh_executor_counters": fresh_pc,
+            "main_executor_counters": pc.stats_snapshot(),
+        },
+        "invariants": invariants,
+        "all_edges_calls_total": eng.all_edges_calls,
+        "shapes_meeting_bar": fast_enough,
+    }
+    with open(args.out, "w") as f:
+        json.dump(artifact, f, indent=2, sort_keys=True)
+        f.write("\n")
+    log(f"wrote {args.out}")
+    for k, v in invariants.items():
+        log(f"invariant {k}: {'PASS' if v else 'FAIL'}")
+    return 0 if all(invariants.values()) else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,692 @@
+"""Twin-engine equivalence suite for the columnar Cypher pipeline
+(cypher/columnar.py) — the PR 4 discipline: every supported
+MATCH/WHERE/aggregate/ORDER BY shape runs through the columnar AND
+generic engines under interleaved create/retype/delete churn, and the
+results must be identical INCLUDING tie order.  Fallback-trigger shapes
+are asserted to actually fall back; former `_try_fastpath` shapes are
+asserted to route through the columnar pipeline (migration proof); the
+plan cache's warm path, literal lifting, and DDL invalidation are
+counter-asserted; device offload must degrade to host columnar under a
+hung backend (this suite runs in the chaos CI step under
+NORNICDB_FAKE_BACKEND=hang).
+"""
+
+import os
+import random
+
+import pytest
+
+from nornicdb_tpu.cypher import CypherExecutor
+from nornicdb_tpu.storage import MemoryEngine, NamespacedEngine
+from nornicdb_tpu.storage.types import Edge, Node
+
+
+def _build_graph(eng, n_people=40, n_msgs=60, seed=11):
+    rng = random.Random(seed)
+    cities = ["Oslo", "Bergen", "Narvik", None]
+    for p in range(n_people):
+        eng.create_node(Node(
+            id=f"p{p:03d}", labels=["Person"],
+            properties={"i": p, "name": f"P{p:03d}",
+                        "age": (p * 7) % 61,
+                        "score": round(rng.random() * 10, 3),
+                        "city": rng.choice(cities)}))
+    for m in range(n_msgs):
+        eng.create_node(Node(
+            id=f"m{m:03d}", labels=["Message"],
+            properties={"i": m, "content": f"c{m}",
+                        "created": (m * 37) % 100}))
+        eng.create_edge(Edge(
+            id=f"po{m:03d}", start_node=f"p{m % n_people:03d}",
+            end_node=f"m{m:03d}", type="POSTED",
+            properties={"w": round(rng.random(), 3)}))
+    k = 0
+    for p in range(n_people):
+        for q in ((p + 1) % n_people, (p + 9) % n_people):
+            eng.create_edge(Edge(
+                id=f"k{k:03d}", start_node=f"p{p:03d}",
+                end_node=f"p{q:03d}", type="KNOWS",
+                properties={"w": (k % 7) / 3.0}))
+            k += 1
+
+
+def _twin(engine=None, **kw):
+    eng = engine if engine is not None else MemoryEngine()
+    _build_graph(eng, **kw)
+    ex = CypherExecutor(eng)
+    gen = CypherExecutor(eng)
+    gen.columnar.enabled = False
+    return eng, ex, gen
+
+
+def _run(ex, query, params):
+    try:
+        r = ex.execute(query, dict(params))
+        return ("ok", r.columns, repr(r.rows))
+    except Exception as exc:  # identical error classes/messages count too
+        return ("err", type(exc).__name__, str(exc))
+
+
+def _churn(eng, round_no):
+    """Interleaved create/retype/delete between comparison rounds."""
+    base = 1000 + round_no * 50
+    for j in range(6):
+        eng.create_node(Node(id=f"p{base + j}", labels=["Person"],
+                             properties={"i": base + j,
+                                         "name": f"P{base + j}",
+                                         "age": (base + j) % 61,
+                                         "score": 1.5, "city": "Oslo"}))
+    eng.create_edge(Edge(id=f"ke{base}", start_node=f"p{base}",
+                         end_node=f"p{base + 1}", type="KNOWS",
+                         properties={"w": 0.5}))
+    # retype: KNOWS -> FOLLOWS for one edge (may already be deleted by an
+    # earlier round's churn)
+    try:
+        e = eng.get_edge(f"k{(round_no * 3) % 70:03d}")
+        e.type = "FOLLOWS"
+        eng.update_edge(e)
+    except Exception:
+        pass
+    # deletes: one node (cascading its edges), one edge
+    try:
+        eng.delete_edge(f"k{(round_no * 5 + 1) % 70:03d}")
+    except Exception:
+        pass
+    try:
+        eng.delete_node(f"m{(round_no * 7) % 55:03d}")
+    except Exception:
+        pass
+
+
+SHAPES = [
+    # scans + columnar WHERE
+    ("MATCH (n:Person) WHERE n.age > 30 RETURN n.i", {}),
+    ("MATCH (n:Person) WHERE n.age >= 10 AND n.city = 'Oslo' "
+     "RETURN n.i, n.age", {}),
+    ("MATCH (n:Person) WHERE n.city IS NULL RETURN n.i", {}),
+    ("MATCH (n:Person) WHERE n.city IN ['Oslo', $c] OR n.age < 5 "
+     "RETURN n.i", {"c": "Bergen"}),
+    ("MATCH (n:Person) WHERE n.name STARTS WITH 'P00' RETURN n.name", {}),
+    ("MATCH (n) WHERE n.created IS NOT NULL RETURN n.i", {}),
+    # counts (former _fp_count family)
+    ("MATCH (n:Person) RETURN count(n)", {}),
+    ("MATCH (n) RETURN count(*)", {}),
+    ("MATCH (n:Person) WHERE n.age > 40 RETURN count(*)", {}),
+    ("MATCH ()-[r:KNOWS]->() RETURN count(r)", {}),
+    ("MATCH ()-[r:KNOWS|FOLLOWS]->() RETURN count(*)", {}),
+    # group counts (former _fp_group_count family)
+    ("MATCH (x)-[:KNOWS]->(y) RETURN x.i, count(y)", {}),
+    ("MATCH (x)<-[:KNOWS]-(y) RETURN x.i, count(*)", {}),
+    ("MATCH (x)-[r:KNOWS]->(y) RETURN x, count(r)", {}),
+    # mutual rel (former _fp_mutual_rel)
+    ("MATCH (a)-[:KNOWS]->(b)-[:KNOWS]->(a) RETURN count(*)", {}),
+    # expand chains + projections + sort/limit
+    ("MATCH (p:Person)-[:POSTED]->(m:Message) "
+     "RETURN m.content ORDER BY m.created DESC LIMIT 7", {}),
+    ("MATCH (p:Person)-[:KNOWS]-(f:Person)-[:POSTED]->(m:Message) "
+     "RETURN f.name, m.created ORDER BY m.created, f.name LIMIT 9", {}),
+    ("MATCH (a:Person)-[:KNOWS]->(b) WHERE b.age > 20 "
+     "RETURN a.name, b.age ORDER BY b.age DESC, a.name SKIP 2 LIMIT 6", {}),
+    ("MATCH (p:Person {i: $i})-[:KNOWS]-(f:Person)-[:POSTED]->(m:Message) "
+     "RETURN m.content, m.created ORDER BY m.created DESC LIMIT 5",
+     {"i": 3}),
+    ("MATCH (a:Person {i: 0})-[:KNOWS]-(f) "
+     "RETURN f.name, f ORDER BY f.name SKIP 1 LIMIT 2", {}),
+    # aggregates over node property columns
+    ("MATCH (a:Person)-[:KNOWS]->(b) "
+     "RETURN avg(b.age), min(a.name), max(b.i), sum(a.age)", {}),
+    ("MATCH (a:Person)-[:POSTED]->(m) RETURN a.city, count(m), "
+     "collect(m.created)", {}),
+    ("MATCH (n:Person) RETURN n.city, count(*)", {}),
+    # distinct
+    ("MATCH (m:Message) RETURN DISTINCT m.created ORDER BY m.created "
+     "LIMIT 6", {}),
+    ("MATCH (a:Person)-[:KNOWS]-(b) RETURN DISTINCT b.city", {}),
+    # both directions / typeless / unseen types
+    ("MATCH (a:Person {i: 1})-[]-(b) RETURN b.i ORDER BY b.i", {}),
+    ("MATCH (a)-[:NEVER_SEEN]->(b) RETURN count(*)", {}),
+    ("MATCH (n:NoSuchLabel) RETURN count(n)", {}),
+    # parameters in every position
+    ("MATCH (n:Person) WHERE n.age > $a RETURN n.i ORDER BY n.i LIMIT $l",
+     {"a": 33, "l": 4}),
+]
+
+FALLBACK_SHAPES = [
+    # residual WHERE (function call)
+    ("MATCH (n:Person) WHERE toLower(n.name) = 'p003' RETURN n.name", {}),
+    # cross-variable conjunct
+    ("MATCH (a:Person)-[:KNOWS]->(b) WHERE b.age > a.age "
+     "RETURN count(*)", {}),
+    # WITH tail
+    ("MATCH (a:Person) WITH a.age AS ag RETURN max(ag)", {}),
+    # RETURN *
+    ("MATCH (a:Person {i: 1})-[:KNOWS]->(b) RETURN *", {}),
+    # edge-property aggregation (labeled anchor, so _fp_edge_agg skips too)
+    ("MATCH (a:Person)-[r:KNOWS]->(b) RETURN sum(r.w)", {}),
+    # whole-entity projection with entity ORDER BY
+    ("MATCH (p:Person) RETURN p ORDER BY p.name LIMIT 3", {}),
+]
+
+GENERIC_SHAPES = [
+    ("OPTIONAL MATCH (n:Person) WHERE n.age > 1000 RETURN n", {}),
+    ("MATCH (a:Person)-[:KNOWS*1..2]->(b) RETURN count(*)", {}),
+    ("MATCH (a:Person {i: 1}), (b:Message {i: 2}) RETURN a.name, b.i", {}),
+    ("MATCH p = (a:Person {i: 1})-[:KNOWS]->(b) RETURN length(p)", {}),
+]
+
+
+class TestTwinEngineEquivalence:
+    @pytest.mark.parametrize("query,params", SHAPES,
+                             ids=[q[0][:48] for q in SHAPES])
+    def test_shape_identical(self, query, params):
+        _, ex, gen = _twin()
+        assert _run(ex, query, params) == _run(gen, query, params)
+
+    def test_all_shapes_under_churn(self):
+        eng, ex, gen = _twin()
+        for rnd in range(4):
+            _churn(eng, rnd)
+            for query, params in SHAPES + FALLBACK_SHAPES:
+                got = _run(ex, query, params)
+                want = _run(gen, query, params)
+                assert got == want, f"round {rnd}: {query}"
+
+    def test_namespaced_engine(self):
+        _, ex, gen = _twin(engine=NamespacedEngine(MemoryEngine(), "ns"))
+        for query, params in SHAPES[:12]:
+            assert _run(ex, query, params) == _run(gen, query, params)
+
+    def test_small_merge_threshold_delta_folding(self):
+        """A tiny merge threshold forces CSR merges mid-churn; csr_view
+        must fold pending delta adds so the columnar expansion sees every
+        edge the generic engine sees."""
+        from nornicdb_tpu.storage.adjacency import attach_snapshot
+
+        eng, ex, gen = _twin()
+        attach_snapshot(eng, merge_threshold=2)
+        for rnd in range(3):
+            _churn(eng, rnd + 10)
+            for query, params in SHAPES[9:18]:
+                assert _run(ex, query, params) == _run(gen, query, params)
+
+    def test_tied_sort_keys_with_limit_deterministic(self):
+        eng = MemoryEngine()
+        eng.create_node(Node(id="a", labels=["A"], properties={"i": 1}))
+        for i in range(8):
+            eng.create_node(Node(id=f"b{i}", labels=["B"],
+                                 properties={"n": f"b{i}", "tie": 0}))
+            eng.create_edge(Edge(id=f"e{i}", start_node="a",
+                                 end_node=f"b{i}", type="R"))
+        ex = CypherExecutor(eng)
+        r = ex.execute(
+            "MATCH (a:A {i: 1})-[:R]->(b:B) RETURN b.n ORDER BY b.tie "
+            "LIMIT 4")
+        assert r.rows == [["b0"], ["b1"], ["b2"], ["b3"]]
+        tr = ex.columnar.last_trace()
+        assert tr is not None and tr["outcome"] == "full"
+
+    def test_order_by_duplicated_alias_uses_last_column(self):
+        """The generic binding overlays columns via dict(zip(...)), so a
+        duplicated RETURN alias in ORDER BY resolves to its LAST
+        occurrence — the columnar sort must pick the same column."""
+        eng = MemoryEngine()
+        for i in range(8):
+            eng.create_node(Node(id=f"d{i}", labels=["D"],
+                                 properties={"i": i, "j": 7 - i}))
+        ex = CypherExecutor(eng)
+        gen = CypherExecutor(eng)
+        gen.columnar.enabled = False
+        q = "MATCH (a:D) RETURN a.i AS k, a.j AS k ORDER BY k LIMIT 4"
+        assert _run(ex, q, {}) == _run(gen, q, {})
+
+    def test_whole_node_result_does_not_alias_storage(self):
+        _, ex, _ = _twin()
+        r = ex.execute("MATCH (p:Person {i: 0})-[:KNOWS]->(f:Person) "
+                       "RETURN f ORDER BY f.name LIMIT 1")
+        r.rows[0][0].properties["name"] = "EVIL"
+        r2 = ex.execute("MATCH (p:Person {i: 0})-[:KNOWS]->(f:Person) "
+                        "RETURN f ORDER BY f.name LIMIT 1")
+        assert r2.rows[0][0].properties["name"] != "EVIL"
+
+
+class TestFallbackDiscipline:
+    def _outcome(self, ex, query, params):
+        ex.execute(query, dict(params))
+        tr = ex.columnar.last_trace()
+        return tr["outcome"] if tr is not None else "generic"
+
+    @pytest.mark.parametrize("query,params", FALLBACK_SHAPES,
+                             ids=[q[0][:48] for q in FALLBACK_SHAPES])
+    def test_partial_fallback_engages(self, query, params):
+        """These shapes must run a columnar prefix, then hand the partial
+        binding table to the generic engine (results already proven
+        identical above)."""
+        _, ex, _ = _twin()
+        assert self._outcome(ex, query, params) == "fallback"
+
+    @pytest.mark.parametrize("query,params", GENERIC_SHAPES,
+                             ids=[q[0][:48] for q in GENERIC_SHAPES])
+    def test_unsupported_goes_generic(self, query, params):
+        _, ex, gen = _twin()
+        assert self._outcome(ex, query, params) == "generic"
+        assert _run(ex, query, params) == _run(gen, query, params)
+
+    def test_fallback_results_identical(self):
+        _, ex, gen = _twin()
+        for query, params in FALLBACK_SHAPES:
+            assert _run(ex, query, params) == _run(gen, query, params)
+
+
+class TestPlanCache:
+    def test_warm_traffic_compiles_once(self):
+        _, ex, _ = _twin()
+        q = "MATCH (n:Person) WHERE n.age > 30 RETURN count(n)"
+        ex.execute(q)
+        pc = ex.columnar.cache
+        compiles_after_first = pc.compiles
+        for _ in range(5):
+            ex.execute(q)
+        assert pc.compiles == compiles_after_first
+        assert pc.hits >= 5
+
+    def test_text_fast_path_skips_parse_and_plan(self):
+        """After the first execution the exact text is bound; repeats must
+        hit the text probe (no shape normalization, no compile)."""
+        _, ex, _ = _twin()
+        q = "MATCH (a:Person)-[:KNOWS]->(b) RETURN a.i, count(b)"
+        r1 = ex.execute(q)
+        assert ex.columnar.cache.stats_snapshot()["text_entries"] >= 1
+        misses_before = ex.columnar.cache.misses
+        hits_before = ex.columnar.cache.hits
+        r2 = ex.execute(q)
+        assert r2.columns == r1.columns and r2.rows == r1.rows
+        assert ex.columnar.cache.misses == misses_before
+        assert ex.columnar.cache.hits > hits_before
+
+    def test_literal_lifting_shares_plans(self):
+        """Texts differing only in literals share one compiled plan."""
+        _, ex, gen = _twin()
+        ex.execute("MATCH (n:Person) WHERE n.age > 30 RETURN count(n)")
+        compiles = ex.columnar.cache.compiles
+        r = ex.execute("MATCH (n:Person) WHERE n.age > 50 RETURN count(n)")
+        assert ex.columnar.cache.compiles == compiles  # shape hit
+        want = gen.execute(
+            "MATCH (n:Person) WHERE n.age > 50 RETURN count(n)")
+        assert r.rows == want.rows  # and the literal value still applies
+
+    def test_ddl_invalidates_plan_cache(self):
+        _, ex, _ = _twin()
+        q = "MATCH (n:Person) WHERE n.age > 30 RETURN count(n)"
+        ex.execute(q)
+        assert ex.columnar.cache.stats_snapshot()["entries"] >= 1
+        ex.execute("CREATE INDEX FOR (p:Person) ON (p.i)")
+        snap = ex.columnar.cache.stats_snapshot()
+        assert snap["entries"] == 0 and snap["text_entries"] == 0
+        assert snap["invalidations"] >= 1
+        # re-execution recompiles and still serves correct results
+        r = ex.execute(q)
+        assert r.rows[0][0] > 0
+
+    def test_schema_generation_catches_foreign_ddl(self):
+        """DDL issued through ANOTHER executor sharing the SchemaManager
+        must invalidate this executor's cached plans (generation stamp)."""
+        eng, ex, _ = _twin()
+        other = CypherExecutor(eng, schema=ex.schema)
+        q = "MATCH (p:Person {i: 3})-[:KNOWS]->(f) RETURN f.i ORDER BY f.i"
+        before = ex.execute(q)
+        other.execute("CREATE INDEX FOR (p:Person) ON (p.i)")
+        inv_before = ex.columnar.cache.invalidations
+        after = ex.execute(q)
+        assert after.rows == before.rows
+        assert ex.columnar.cache.invalidations > inv_before
+
+    def test_params_do_not_leak_into_shape_key(self):
+        from nornicdb_tpu.cypher.parser import parse
+        from nornicdb_tpu.cypher.plan import normalize_query
+
+        k1 = normalize_query(parse(
+            "MATCH (n:P) WHERE n.x > 5 RETURN count(n)"))[0]
+        k2 = normalize_query(parse(
+            "MATCH (n:P) WHERE n.x > 99 RETURN count(n)"))[0]
+        k3 = normalize_query(parse(
+            "MATCH (n:Q) WHERE n.x > 5 RETURN count(n)"))[0]
+        assert k1 == k2
+        assert k1 != k3
+
+    def test_count_star_not_lifted(self):
+        from nornicdb_tpu.cypher.parser import parse
+        from nornicdb_tpu.cypher.plan import normalize_query
+
+        key, canon, lits = normalize_query(parse(
+            "MATCH (n:P) RETURN count(*)"))
+        assert "*" in key and lits == []
+
+
+class TestExplainProfile:
+    def test_explain_reports_engine_per_operator(self):
+        _, ex, _ = _twin()
+        r = ex.execute("EXPLAIN MATCH (a:Person)-[:KNOWS]->(b) "
+                       "WHERE a.age > 10 RETURN a.name, count(b)")
+        plan = r.rows[0][0]
+        assert "columnar plan [cache miss" in plan
+        assert "[columnar]" in plan
+        assert "Expand((a)-[:KNOWS]->(b))" in plan
+        # second EXPLAIN of the same shape reports a cache hit
+        r2 = ex.execute("EXPLAIN MATCH (a:Person)-[:KNOWS]->(b) "
+                        "WHERE a.age > 10 RETURN a.name, count(b)")
+        assert "columnar plan [cache hit" in r2.rows[0][0]
+
+    def test_explain_reports_generic_with_reason(self):
+        _, ex, _ = _twin()
+        r = ex.execute("EXPLAIN MATCH (a:Person)-[:KNOWS*1..3]->(b) "
+                       "RETURN count(*)")
+        assert "columnar: generic" in r.rows[0][0]
+
+    def test_explain_reports_generic_tail_operator(self):
+        _, ex, _ = _twin()
+        r = ex.execute("EXPLAIN MATCH (a:Person) WITH a.age AS ag "
+                       "RETURN max(ag)")
+        assert "GenericTail" in r.rows[0][0]
+        assert "[generic]" in r.rows[0][0]
+
+    def test_profile_includes_measured_operator_timings(self):
+        _, ex, _ = _twin()
+        r = ex.execute("PROFILE MATCH (a:Person)-[:KNOWS]->(b) "
+                       "RETURN a.i, count(b)")
+        assert "columnar execution [full" in r.plan
+        assert "rows=" in r.plan and " ms" in r.plan
+
+
+class TestTelemetrySurfaces:
+    def test_metric_families_render(self):
+        from nornicdb_tpu.telemetry.metrics import REGISTRY
+
+        _, ex, _ = _twin()
+        ex.execute("MATCH (n:Person) RETURN count(n)")
+        text = REGISTRY.render_prometheus()
+        for name in (
+            "nornicdb_cypher_plan_cache_hits_total",
+            "nornicdb_cypher_plan_cache_misses_total",
+            "nornicdb_cypher_plan_cache_invalidations_total",
+            "nornicdb_cypher_columnar_rows",
+            "nornicdb_cypher_operator_seconds",
+            "nornicdb_cypher_columnar_queries_total",
+            "nornicdb_cypher_offloads_total",
+        ):
+            assert name in text, name
+
+    def test_slowlog_captures_plan_key_and_operator_timings(self):
+        from nornicdb_tpu.telemetry.slowlog import slow_log
+
+        _, ex, _ = _twin()
+        old_thr = slow_log.threshold_s
+        slow_log.configure(threshold_s=1e-9)
+        try:
+            slow_log.clear()
+            ex.execute("MATCH (a:Person)-[:KNOWS]->(b) "
+                       "RETURN a.i, count(b)")
+            entries = slow_log.snapshot()
+            col = next((e["columnar"] for e in entries
+                        if e.get("columnar")), None)
+            assert col is not None
+            assert col["plan_key"] and col["outcome"] == "full"
+            assert col["operators"] and all(
+                "ms" in op for op in col["operators"])
+        finally:
+            slow_log.configure(threshold_s=old_thr)
+            slow_log.clear()
+
+    def test_counters_probe_reports_plan_cache(self):
+        from nornicdb_tpu.telemetry import slowlog as sl
+
+        class FakeDB:
+            pass
+
+        eng, ex, _ = _twin()
+        db = FakeDB()
+        db._executor = ex
+        db.storage = eng
+        ex.execute("MATCH (n:Person) RETURN count(n)")
+        probe = sl.counters_probe(db)
+        assert probe is not None
+        assert "cypher_plan_cache_hits" in probe
+        assert "cypher_plan_cache_misses" in probe
+
+
+class TestResultCacheInterplay:
+    def test_text_fast_path_respects_result_cache_isolation(self):
+        from nornicdb_tpu.cache import QueryCache
+
+        eng = MemoryEngine()
+        _build_graph(eng)
+        ex = CypherExecutor(eng, cache=QueryCache())
+        q = "MATCH (p:Person {i: 0})-[:KNOWS]->(f) RETURN f"
+        r1 = ex.execute(q)
+        r1.rows[0][0].properties["name"] = "EVIL"
+        r2 = ex.execute(q)  # result-cache hit via the text fast path
+        assert r2.rows[0][0].properties["name"] != "EVIL"
+
+    def test_text_fast_path_sees_writes(self):
+        """A write invalidating the result cache must not leave the text
+        fast path serving stale rows (plans bind data per execution)."""
+        from nornicdb_tpu.cache import QueryCache
+
+        eng = MemoryEngine()
+        _build_graph(eng)
+        ex = CypherExecutor(eng, cache=QueryCache())
+        q = "MATCH (n:Person) RETURN count(n)"
+        n0 = ex.execute(q).rows[0][0]
+        ex.execute("CREATE (:Person {i: 9999, name: 'new'})")
+        assert ex.execute(q).rows[0][0] == n0 + 1
+
+
+class TestDeviceOffloadDegradation:
+    def test_offload_path_equal_or_host_under_hang(self, monkeypatch):
+        """With the offload threshold forced to 1, ORDER BY numeric LIMIT
+        must return generic-identical rows whether the backend serves the
+        top-k (READY) or the host path runs (hang/absent backend). This
+        suite runs under NORNICDB_FAKE_BACKEND=hang in the chaos step —
+        the query must complete promptly either way, never wedge."""
+        monkeypatch.setenv("NORNICDB_CYPHER_OFFLOAD_MIN_ROWS", "1")
+        _, ex, gen = _twin()
+        q = ("MATCH (n:Person) WHERE n.age > 5 "
+             "RETURN n.name ORDER BY n.score DESC LIMIT 4")
+        assert _run(ex, q, {}) == _run(gen, q, {})
+        tr = ex.columnar.last_trace()
+        assert tr is not None and tr["outcome"] == "full"
+
+    def test_offload_boundary_ties_included(self, monkeypatch):
+        monkeypatch.setenv("NORNICDB_CYPHER_OFFLOAD_MIN_ROWS", "1")
+        eng = MemoryEngine()
+        for i in range(32):
+            eng.create_node(Node(id=f"t{i:02d}", labels=["T"],
+                                 properties={"v": i // 8, "n": i}))
+        ex = CypherExecutor(eng)
+        gen = CypherExecutor(eng)
+        gen.columnar.enabled = False
+        q = "MATCH (t:T) RETURN t.n ORDER BY t.v DESC LIMIT 5"
+        assert _run(ex, q, {}) == _run(gen, q, {})
+
+
+class TestMigrationFromFastpaths:
+    """Each former `_try_fastpath` family member routes through the
+    columnar pipeline and returns identical results (the fastpath methods
+    themselves are deleted — see test_traversal_fastpath.py)."""
+
+    FORMER = [
+        ("MATCH (n:Person) RETURN count(n)", {}),
+        ("MATCH (n) RETURN count(*)", {}),
+        ("MATCH ()-[r:KNOWS]->() RETURN count(r)", {}),
+        ("MATCH (x)-[:KNOWS]->(y) RETURN x.i, count(y)", {}),
+        ("MATCH (x)<-[:KNOWS]-(y) RETURN x, count(*)", {}),
+        ("MATCH (a)-[:KNOWS]->(b)-[:KNOWS]->(a) RETURN count(*)", {}),
+        ("MATCH (p:Person {i: 2})-[:KNOWS]-(f)-[:POSTED]->(m:Message) "
+         "RETURN m.content ORDER BY m.created DESC LIMIT 5", {}),
+    ]
+
+    @pytest.mark.parametrize("query,params", FORMER,
+                             ids=[q[0][:48] for q in FORMER])
+    def test_routes_columnar_and_identical(self, query, params):
+        _, ex, gen = _twin()
+        got = _run(ex, query, params)
+        tr = ex.columnar.last_trace()
+        assert tr is not None and tr["outcome"] == "full", query
+        assert got == _run(gen, query, params)
+
+    def test_edge_prop_agg_fastpath_retained(self):
+        """The one surviving fastpath: bare-endpoint edge-property
+        aggregation (edge property columns are not CSR-resident)."""
+        _, ex, gen = _twin()
+        q = ("MATCH ()-[r:KNOWS]->() RETURN avg(r.w), sum(r.w), count(r), "
+             "min(r.w), max(r.w)")
+        got = _run(ex, q, {})
+        assert got == _run(gen, q, {})
+        assert ex.columnar.last_trace() is None  # served by _fp_edge_agg
+
+
+class TestTopologyEdgeCases:
+    def test_self_loops_both_directions(self):
+        eng = MemoryEngine()
+        for i in range(4):
+            eng.create_node(Node(id=f"s{i}", labels=["S"],
+                                 properties={"i": i}))
+        eng.create_edge(Edge(id="loop", start_node="s0", end_node="s0",
+                             type="L"))
+        eng.create_edge(Edge(id="l01", start_node="s0", end_node="s1",
+                             type="L"))
+        ex = CypherExecutor(eng)
+        gen = CypherExecutor(eng)
+        gen.columnar.enabled = False
+        for q in [
+            "MATCH (a:S {i: 0})-[:L]-(b) RETURN b.i ORDER BY b.i",
+            "MATCH (a:S)-[:L]-(b) RETURN count(*)",
+            "MATCH ()-[r:L]-() RETURN count(r)",
+            "MATCH (a:S)-[:L]->(a) RETURN count(*)",
+        ]:
+            assert _run(ex, q, {}) == _run(gen, q, {}), q
+
+    def test_empty_graph(self):
+        eng = MemoryEngine()
+        ex = CypherExecutor(eng)
+        gen = CypherExecutor(eng)
+        gen.columnar.enabled = False
+        for q in [
+            "MATCH (n) RETURN count(*)",
+            "MATCH (n:L) RETURN count(n)",
+            "MATCH ()-[r:T]->() RETURN count(r)",
+            "MATCH (a:L)-[:T]->(b) RETURN a.x, count(b)",
+        ]:
+            assert _run(ex, q, {}) == _run(gen, q, {}), q
+
+    def test_null_property_map_matches_missing(self):
+        """Anchor prop map {k: null} matches nodes WITHOUT the property —
+        the matcher's _value_eq semantics, not WHERE's three-valued _eq."""
+        eng = MemoryEngine()
+        eng.create_node(Node(id="a", labels=["N"], properties={"k": 1}))
+        eng.create_node(Node(id="b", labels=["N"], properties={}))
+        eng.create_edge(Edge(id="e", start_node="b", end_node="a",
+                             type="T"))
+        ex = CypherExecutor(eng)
+        gen = CypherExecutor(eng)
+        gen.columnar.enabled = False
+        q = "MATCH (n:N {k: null})-[:T]->(m) RETURN m.k"
+        assert _run(ex, q, {}) == _run(gen, q, {})
+
+
+class TestUnionAndWrappers:
+    def test_union_query_stable_across_repeats(self):
+        """A UNION query's main branch may run full-columnar, but its
+        text must NEVER be bound to the text fast path (which would drop
+        the union rows on repeat traffic)."""
+        _, ex, gen = _twin()
+        q = ("MATCH (n:Person) WHERE n.age > 10 RETURN count(n) AS c "
+             "UNION ALL MATCH (m:Message) RETURN count(m) AS c")
+        want = gen.execute(q).rows
+        assert ex.execute(q).rows == want
+        assert ex.execute(q).rows == want  # repeat: no truncated fast path
+
+    def test_profile_repeats_keep_plan_output(self):
+        _, ex, _ = _twin()
+        q = "PROFILE MATCH (n:Person) RETURN count(n)"
+        r1 = ex.execute(q)
+        r2 = ex.execute(q)
+        assert r1.plan and "runtime:" in r1.plan
+        assert r2.plan and "runtime:" in r2.plan
+
+
+class TestSoakInvariant:
+    def _samples(self, n=30, lat=0.01):
+        from nornicdb_tpu.soak.report import Sample
+
+        return [Sample("cypher", "agg_count", "ok", lat, float(i))
+                for i in range(n)]
+
+    def _metrics(self, hits, misses):
+        return (
+            "# TYPE nornicdb_cypher_plan_cache_hits_total counter\n"
+            f"nornicdb_cypher_plan_cache_hits_total {hits}\n"
+            "# TYPE nornicdb_cypher_plan_cache_misses_total counter\n"
+            f"nornicdb_cypher_plan_cache_misses_total {misses}\n")
+
+    def test_plan_cache_effective_passes_on_warm_cache(self):
+        from nornicdb_tpu.soak.invariants import check_plan_cache_effective
+
+        r = check_plan_cache_effective(self._samples(),
+                                       self._metrics(90, 10))
+        assert r.ok, r.detail
+
+    def test_plan_cache_effective_fails_on_cold_cache(self):
+        from nornicdb_tpu.soak.invariants import check_plan_cache_effective
+
+        r = check_plan_cache_effective(self._samples(),
+                                       self._metrics(1, 99))
+        assert not r.ok
+
+    def test_plan_cache_effective_fails_on_slow_tail(self):
+        from nornicdb_tpu.soak.invariants import check_plan_cache_effective
+
+        r = check_plan_cache_effective(self._samples(lat=5.0),
+                                       self._metrics(90, 10))
+        assert not r.ok
+
+    def test_csr_view_fold_economics(self, monkeypatch):
+        """Past the eager floor, a tiny pending delta must NOT refold per
+        read (csr_view returns None; the query serves generically) and
+        the columnar query still returns correct rows; the fold happens
+        once the delta amortizes the rebuild."""
+        from nornicdb_tpu.storage import adjacency as adj
+
+        monkeypatch.setattr(adj, "VIEW_FOLD_EAGER_EDGES", 0)
+        monkeypatch.setattr(adj, "VIEW_FOLD_MIN_PENDING", 4)
+        eng, ex, gen = _twin()
+        q = "MATCH (a:Person)-[:KNOWS]->(b) RETURN count(*)"
+        ex.execute(q)  # builds + folds the initial view
+        snap = eng._adjacency_snapshot
+        eng.create_edge(Edge(id="fold0", start_node="p000",
+                             end_node="p001", type="KNOWS"))
+        assert snap._d_ids and snap.csr_view() is None
+        # the query still serves (generically) with identical results
+        assert _run(ex, q, {}) == _run(gen, q, {})
+        for j in range(1, 5):
+            eng.create_edge(Edge(id=f"fold{j}", start_node="p000",
+                                 end_node=f"p00{j+1}", type="KNOWS"))
+        assert snap.csr_view() is not None  # amortized: folds now
+        assert _run(ex, q, {}) == _run(gen, q, {})
+
+    def test_ci_profile_has_cypher_class(self):
+        from nornicdb_tpu.soak.spec import CI, FULL
+
+        assert CI.workload.cypher_workers > 0
+        assert FULL.workload.cypher_workers > 0
+
+
+class TestDisableSwitch:
+    def test_env_disable(self, monkeypatch):
+        monkeypatch.setenv("NORNICDB_CYPHER_COLUMNAR", "0")
+        eng = MemoryEngine()
+        _build_graph(eng)
+        ex = CypherExecutor(eng)
+        assert not ex.columnar.enabled
+        r = ex.execute("MATCH (n:Person) RETURN count(n)")
+        assert r.rows[0][0] == 40
+        assert ex.columnar.last_trace() is None

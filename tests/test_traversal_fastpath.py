@@ -1,14 +1,16 @@
-"""Anchored-traversal fastpath (_fp_anchored_traverse) — the reference's
-pattern-detect fastpath family (ref: query_patterns.go DetectQueryPattern,
-optimized_executors.go). The contract: for every shape the detector
-accepts, results are IDENTICAL to the generic matcher pipeline; shapes it
-cannot handle fall through untouched.
+"""Anchored-traversal acceleration — formerly the `_fp_anchored_traverse`
+pattern fastpath (ref: query_patterns.go DetectQueryPattern,
+optimized_executors.go), now RETIRED into the columnar operator pipeline
+(cypher/columnar.py). The contract is unchanged: for every shape the
+planner accepts, results are IDENTICAL to the generic matcher pipeline —
+including tie order under LIMIT — and shapes it cannot handle fall
+through untouched. These tests double as the migration proof that each
+former fastpath query routes through the columnar pipeline.
 """
 
 import pytest
 
 from nornicdb_tpu.cypher import CypherExecutor
-from nornicdb_tpu.cypher.executor import CypherExecutor as _CE
 from nornicdb_tpu.storage import MemoryEngine, NamespacedEngine
 from nornicdb_tpu.storage.types import Edge, Node
 
@@ -63,18 +65,18 @@ QUERIES = [
 
 
 def _both_ways(ex, query, params):
-    """Run with the fastpath, then with it disabled; return both row sets."""
+    """Run with the columnar pipeline, then with it disabled; return both
+    row sets."""
     if ex.cache:
         ex.cache.clear()
     fast = ex.execute(query, dict(params)).rows
-    orig = _CE._fp_anchored_traverse
-    _CE._fp_anchored_traverse = lambda self, *a, **k: None
+    ex.columnar.enabled = False
     try:
         if ex.cache:
             ex.cache.clear()
         slow = ex.execute(query, dict(params)).rows
     finally:
-        _CE._fp_anchored_traverse = orig
+        ex.columnar.enabled = True
     return fast, slow
 
 
@@ -103,51 +105,51 @@ class TestFastpathAgreesWithGeneric:
         assert not r.rows[0][0].id.startswith("ns:")
 
 
-class TestFastpathEngages:
-    def _hits(self, ex, query, params=None):
-        hits = [0]
-        orig = _CE._fp_anchored_traverse
+class TestColumnarEngages:
+    """The migration proof: former `_fp_anchored_traverse` shapes now run
+    fully columnar (plus some it could never take, like repeated
+    variables); shapes outside the planner go generic."""
 
-        def spy(self, *a, **k):
-            r = orig(self, *a, **k)
-            if r is not None:
-                hits[0] += 1
-            return r
+    def _outcome(self, ex, query, params=None):
+        if ex.cache:
+            ex.cache.clear()
+        ex.execute(query, params or {})
+        tr = ex.columnar.last_trace()
+        return tr["outcome"] if tr is not None else "generic"
 
-        _CE._fp_anchored_traverse = spy
-        try:
-            ex.execute(query, params or {})
-        finally:
-            _CE._fp_anchored_traverse = orig
-        return hits[0]
-
-    def test_hot_shape_uses_fastpath(self):
+    def test_hot_shape_runs_columnar(self):
         ex = _social()
-        assert self._hits(
+        assert self._outcome(
             ex,
             "MATCH (p:Person {id: 1})-[:KNOWS]-(f)-[:POSTED]->(m:Message) "
-            "RETURN m.content ORDER BY m.created DESC LIMIT 5") == 1
+            "RETURN m.content ORDER BY m.created DESC LIMIT 5") == "full"
+        from nornicdb_tpu.cypher.executor import CypherExecutor as _CE
 
-    def test_where_clause_falls_through(self):
+        # retired, not shadowed: the detector family is gone
+        for name in ("_fp_anchored_traverse", "_fp_count",
+                     "_fp_group_count", "_fp_mutual_rel"):
+            assert not hasattr(_CE, name), name
+
+    def test_where_clause_now_columnar_too(self):
         ex = _social()
-        assert self._hits(
+        assert self._outcome(
             ex,
             "MATCH (p:Person {id: 1})-[:KNOWS]-(f) WHERE f.name <> 'x' "
-            "RETURN f.name ORDER BY f.name") == 0
+            "RETURN f.name ORDER BY f.name") == "full"
 
     def test_var_length_falls_through(self):
         ex = _social()
-        assert self._hits(
+        assert self._outcome(
             ex,
             "MATCH (p:Person {id: 1})-[:KNOWS*1..2]-(f) "
-            "RETURN f.name ORDER BY f.name LIMIT 3") == 0
+            "RETURN f.name ORDER BY f.name LIMIT 3") == "generic"
 
-    def test_repeated_variable_falls_through(self):
+    def test_repeated_variable_runs_columnar(self):
         ex = _social()
-        assert self._hits(
+        assert self._outcome(
             ex,
             "MATCH (p:Person {id: 1})-[:KNOWS]-(f)-[:KNOWS]-(p) "
-            "RETURN f.name ORDER BY f.name") == 0
+            "RETURN f.name ORDER BY f.name") == "full"
 
     def test_whole_node_result_does_not_alias_storage(self):
         ex = _social()
@@ -267,9 +269,10 @@ class TestResultCacheIsolation:
             "MATCH (p:P) RETURN p").rows[0][0].properties["tags"] == ["a"]
 
     def test_unindexed_anchor_bails_without_scanning(self):
-        """The fastpath must not pay a label scan it will then repeat in
-        the generic path — it pre-bails on label count when no equality
-        index covers the anchor."""
+        """An unindexed anchor must never pay a label scan that is then
+        repeated (the old fastpath double-scan hazard); the columnar
+        pipeline serves it via the colindex equality mask — at most one
+        candidate materialization end to end."""
         eng = MemoryEngine()
         for i in range(100):
             eng.create_node(Node(id=f"n{i}", labels=["L"],
@@ -288,7 +291,7 @@ class TestResultCacheIsolation:
         r = ex.execute(
             "MATCH (a:L {k: 0})-[:R]->(b) RETURN b.k ORDER BY b.k LIMIT 5")
         assert r.rows == [[1]]
-        assert calls[0] == 1
+        assert calls[0] <= 1
 
     def test_stats_not_shared_with_cache(self):
         from nornicdb_tpu.cache import QueryCache
