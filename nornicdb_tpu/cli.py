@@ -223,6 +223,8 @@ def cmd_serve(args) -> int:
             respawn=workers_cfg.respawn,
             publish_interval=workers_cfg.publish_interval,
             auth_required=args.auth,
+            metrics=workers_cfg.metrics,
+            metrics_interval=workers_cfg.metrics_interval,
         ).start()
     if workers_cfg.grpc > 0 and grpc_server is not None:
         from nornicdb_tpu.server.workers import WorkerPool
@@ -240,6 +242,8 @@ def cmd_serve(args) -> int:
             respawn=workers_cfg.respawn,
             publish_interval=workers_cfg.publish_interval,
             auth_required=args.auth,
+            metrics=workers_cfg.metrics,
+            metrics_interval=workers_cfg.metrics_interval,
         ).start()
     print(f"NornicDB-TPU serving: bolt://{args.host}:{bolt_server.port} "
           f"http://{args.host}:{http_server.port}"

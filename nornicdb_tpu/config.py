@@ -82,6 +82,12 @@ class TelemetryConfig:
     trace_buffer: int = 256  # completed traces kept for /admin/traces
     slow_query_ms: float = 1000.0  # 0 disables slow-query capture
     slow_buffer: int = 128  # entries kept for /admin/slow-queries
+    # fleet federation: a worker exposition older than this at scrape
+    # time is dropped from the merged /metrics (dead worker / wedged
+    # publisher segments must age out, not flatline forever)
+    fleet_staleness_s: float = 10.0
+    # upper bound for POST /admin/profile?seconds=N jax.profiler captures
+    profile_max_seconds: float = 60.0
 
 
 @dataclass
@@ -226,6 +232,12 @@ class WorkersConfig:
     # most this stale; each publish copies the corpus host arrays, so
     # raise it for very large corpora under constant writes
     publish_interval: float = 0.05
+    # fleet telemetry: workers publish their metrics registry (and
+    # slow-query ring) into per-proc shm segments the primary's /metrics
+    # merges under a proc label (docs/observability.md "Metrics
+    # federation & staleness")
+    metrics: bool = True
+    metrics_interval: float = 0.5
     # per-worker token bucket mirrored BEFORE the response cache
     # (effective ceiling is n_workers x rate); 0 disables
     rate_limit: float = 0.0

@@ -891,9 +891,16 @@ class ReturnOp(_Op):
             if np.isnan(vals).any():
                 OFFLOAD_CELLS["unavailable"].inc()
                 return None
+            from nornicdb_tpu.telemetry import deviceprof as _deviceprof
+
+            t0 = time.perf_counter()
             v = jnp.asarray(vals if desc else -vals, jnp.float32)
             top, _ = jax.lax.top_k(v, min(k, n))
             boundary = float(top[-1])
+            # unified device-program ledger (fleet telemetry plane)
+            _deviceprof.record_execute(
+                "cypher", "topk_offload", _deviceprof.pow2_class(n, "n"),
+                time.perf_counter() - t0)
             # f32 rounding must only ever WIDEN the candidate set
             boundary = np.nextafter(boundary, -np.inf)
             cand = vals >= boundary if desc else -vals >= boundary

@@ -146,6 +146,23 @@ class TPUEmbedder(Embedder):
             "embedded": 0, "batches": 0, "cpu_fallback_batches": 0,
             "packed_dispatches": 0, "packed_tokens": 0,
         }
+        # fleet telemetry: encoder parameter residency (weakref'd; summed
+        # per component at /metrics render — telemetry/deviceprof.py)
+        from nornicdb_tpu.telemetry import deviceprof as _deviceprof
+
+        _deviceprof.register_hbm(self, TPUEmbedder._hbm_bytes)
+
+    @staticmethod
+    def _hbm_bytes(self) -> dict:
+        import jax
+
+        total = 0
+        for leaf in jax.tree.leaves(self.params):
+            size = getattr(leaf, "size", None)
+            dtype = getattr(leaf, "dtype", None)
+            if size is not None and dtype is not None:
+                total += int(size) * dtype.itemsize
+        return {"embedder_params": total}
 
     def _on_backend_recovered(self, mode: str) -> None:
         """Manager recovery notification: whatever device the old params

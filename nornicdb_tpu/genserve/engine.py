@@ -66,6 +66,7 @@ from nornicdb_tpu.errors import (
     ResourceExhausted,
 )
 from nornicdb_tpu.genserve import stats as _stats
+from nornicdb_tpu.telemetry import deviceprof as _deviceprof
 from nornicdb_tpu.telemetry.tracing import tracer as _tracer
 
 logger = logging.getLogger(__name__)
@@ -253,6 +254,7 @@ class _Seq:
         "prefill_tokens", "prefill_pos", "page_ids", "page_table",
         "cache_len", "admit_no", "dense_cache", "dense_len",
         "submitted_at", "first_token_at", "counted",
+        "trace_ctx", "submitted_perf",
     )
 
     def __init__(self, handle: GenHandle, prompt: list[int], max_new: int,
@@ -274,6 +276,16 @@ class _Seq:
         self.submitted_at = time.monotonic()
         self.first_token_at = 0.0
         self.counted = False
+        # the submitting request's trace context: scheduler spans attach
+        # to it (prefill/decode, queue-wait, eviction) so a GraphRAG
+        # answer shows its full generation path in /admin/traces
+        self.trace_ctx = None
+        self.submitted_perf = 0.0
+
+    @property
+    def trace_id(self) -> Optional[str]:
+        ctx = self.trace_ctx
+        return None if ctx is None else ctx.trace_id
 
 
 class GenerationEngine:
@@ -325,6 +337,16 @@ class GenerationEngine:
         self._cpu_params = None
         self._host_params = None
         self._cpu_device = None
+        # fleet telemetry: the KV page pool's HBM residency (weakref'd
+        # provider, summed at /metrics render — telemetry/deviceprof.py)
+        _deviceprof.register_hbm(self, GenerationEngine._hbm_bytes)
+
+    @staticmethod
+    def _hbm_bytes(self) -> dict:
+        pool = self._pages
+        if pool is None:
+            return {"kv_pages": 0}
+        return {"kv_pages": int(pool.size) * pool.dtype.itemsize}
 
     # -- lifecycle ---------------------------------------------------------
     def start(self) -> None:
@@ -408,6 +430,8 @@ class GenerationEngine:
                     params, self.cfg, jnp.zeros((c,), jnp.int32), pool,
                     jnp.asarray(table), jnp.asarray(0), jnp.asarray(1))
                 self.programs.add(("prefill", c, w))
+                _deviceprof.record_compile("genserve", "prefill",
+                                           f"c{c}x{w}")
                 if c >= self._prefill_chunk:
                     break
                 c *= 2
@@ -417,6 +441,8 @@ class GenerationEngine:
                     params, self.cfg, jnp.zeros((b,), jnp.int32), pool,
                     jnp.zeros((b, w), jnp.int32), jnp.zeros((b,), jnp.int32))
                 self.programs.add(("decode", b, w))
+                _deviceprof.record_compile("genserve", "decode",
+                                           f"b{b}x{w}")
                 if b >= self._max_seqs:
                     break
                 b *= 2
@@ -446,23 +472,33 @@ class GenerationEngine:
         handle = GenHandle(self, deadline)
         eos = getattr(self.tokenizer, "eos_id", -1) if self.tokenizer else -1
         seq = _Seq(handle, prompt, max_new, eos)
-        with self._cond:
-            # re-check under the lock stop() drains the queue with: a seq
-            # appended after the drain would never be processed by anyone
-            if self._stop.is_set():
-                raise ClosedError("generation engine stopped")
-            if self._queue and len(self._queue) + 1 > int(
-                    self.config.max_queue):
-                self.stats.sheds_queue_full += 1
-                _stats.SHEDS.labels("queue_full").inc()
-                _stats.REQUESTS.labels("shed").inc()
-                raise ResourceExhausted(
-                    f"generation queue full ({len(self._queue)} queued); "
-                    "retry with backoff", reason="queue_full")
-            self.stats.requests += 1
-            self._queue.append(seq)
-            _stats.QUEUE_DEPTH.set(len(self._queue))
-            self._cond.notify_all()
+        # the submitting request's trace rides the sequence: scheduler
+        # spans (prefill/decode/queue-wait/eviction) attach to it, and
+        # the admission decision itself records in the CALLER's trace
+        seq.trace_ctx = _tracer.capture()
+        seq.submitted_perf = time.perf_counter()
+        with _tracer.span("genserve.admit",
+                          {"prompt_tokens": len(prompt),
+                           "max_new": max_new}) as admit_span:
+            with self._cond:
+                # re-check under the lock stop() drains the queue with: a
+                # seq appended after the drain would never be processed
+                if self._stop.is_set():
+                    raise ClosedError("generation engine stopped")
+                if self._queue and len(self._queue) + 1 > int(
+                        self.config.max_queue):
+                    self.stats.sheds_queue_full += 1
+                    _stats.SHEDS.labels("queue_full").inc()
+                    _stats.REQUESTS.labels("shed").inc()
+                    admit_span.set_attr("outcome", "shed")
+                    raise ResourceExhausted(
+                        f"generation queue full ({len(self._queue)} "
+                        "queued); retry with backoff", reason="queue_full")
+                self.stats.requests += 1
+                self._queue.append(seq)
+                admit_span.set_attr("queue_depth", len(self._queue))
+                _stats.QUEUE_DEPTH.set(len(self._queue))
+                self._cond.notify_all()
         return handle
 
     def generate(self, prompt_ids: Sequence[int], max_new_tokens: int = 64,
@@ -728,6 +764,14 @@ class GenerationEngine:
                 self.stats.readmissions += 1
             self.stats.admissions += 1
             self._running.append(seq)
+            # queue wait lands retroactively in the SUBMITTER's trace
+            # (the QueryBatcher pattern — per-caller attribution)
+            if seq.trace_ctx is not None:
+                _tracer.add_span(
+                    "genserve.queue_wait", seq.submitted_perf,
+                    time.perf_counter(), parent=seq.trace_ctx,
+                    attrs={"readmission": bool(seq.out)},
+                )
 
     def _grow(self, seq: _Seq) -> bool:
         """Ensure the sequence has a page for cache slot ``cache_len``.
@@ -759,6 +803,15 @@ class GenerationEngine:
     def _evict(self, victim: _Seq) -> None:
         self.stats.evictions += 1
         _stats.EVICTIONS.inc()
+        # the eviction is an event in the VICTIM's request trace: its
+        # caller is still blocked waiting, so the span explains why the
+        # answer took a re-prefill
+        if victim.trace_ctx is not None:
+            now = time.perf_counter()
+            _tracer.add_span(
+                "genserve.evicted", now, now, parent=victim.trace_ctx,
+                attrs={"generated_tokens": len(victim.out)},
+            )
         self._running.remove(victim)
         self._release_pages(victim)
         victim.dense_cache = None
@@ -793,21 +846,30 @@ class GenerationEngine:
         t0 = time.perf_counter()
         params = self._active_params()
         self.programs.add(("prefill", chunk, self._table_width))
+        _deviceprof.record_compile("genserve", "prefill",
+                                   f"c{chunk}x{self._table_width}")
         final = seq.prefill_pos + n_valid >= len(seq.prefill_tokens)
+        # the chunk belongs to exactly one request: attach its captured
+        # context so genserve.prefill lands in the SUBMITTER's trace
+        # instead of floating detached on the scheduler thread
         with self._platform_ctx():
-            with _tracer.span("genserve.prefill",
-                              {"chunk": chunk, "valid": n_valid}):
-                logits, self._pages = qwen2.paged_prefill_chunk(
-                    params, self.cfg,
-                    jnp.asarray(padded, jnp.int32), self._pages,
-                    jnp.asarray(seq.page_table),
-                    jnp.asarray(seq.prefill_pos),
-                    jnp.asarray(n_valid))
-                # argmax ON DEVICE: only the winning token id crosses to
-                # host, never the (V,) logits row (and intermediate
-                # chunks transfer nothing at all)
-                tok = int(jnp.argmax(logits)) if final else None
-        _stats.PREFILL_HIST.observe(time.perf_counter() - t0)
+            with _tracer.attach(seq.trace_ctx):
+                with _tracer.span("genserve.prefill",
+                                  {"chunk": chunk, "valid": n_valid}):
+                    logits, self._pages = qwen2.paged_prefill_chunk(
+                        params, self.cfg,
+                        jnp.asarray(padded, jnp.int32), self._pages,
+                        jnp.asarray(seq.page_table),
+                        jnp.asarray(seq.prefill_pos),
+                        jnp.asarray(n_valid))
+                    # argmax ON DEVICE: only the winning token id crosses
+                    # to host, never the (V,) logits row (and
+                    # intermediate chunks transfer nothing at all)
+                    tok = int(jnp.argmax(logits)) if final else None
+        dt = time.perf_counter() - t0
+        _stats.PREFILL_HIST.observe(dt)
+        _deviceprof.record_execute("genserve", "prefill",
+                                   f"c{chunk}x{self._table_width}", dt)
         self.stats.prefill_chunks += 1
         seq.prefill_pos += n_valid
         seq.cache_len = seq.prefill_pos
@@ -902,15 +964,31 @@ class GenerationEngine:
         t0 = time.perf_counter()
         params = self._active_params()
         self.programs.add(("decode", b, self._table_width))
+        _deviceprof.record_compile("genserve", "decode",
+                                   f"b{b}x{self._table_width}")
+        # the batched step serves MANY requests: the span attaches to the
+        # leader's trace (oldest running, the QueryBatcher convention)
+        # and links every other batched request's trace id, so each
+        # request's tree can find the shared device work
+        leader_ctx = next(
+            (s.trace_ctx for s in active if s.trace_ctx is not None), None)
+        links = sorted({tid for s in active
+                        if (tid := s.trace_id) is not None})
         with self._platform_ctx():
-            with _tracer.span("genserve.decode", {"batch": b_real}):
-                logits, self._pages = qwen2.paged_decode_step(
-                    params, self.cfg, jnp.asarray(tokens), self._pages,
-                    jnp.asarray(tables), jnp.asarray(lengths))
-                # greedy argmax on device: (B,) ints cross to host, not
-                # the (B, V) logits matrix (~MBs/step at real vocabs)
-                host = np.asarray(jnp.argmax(logits, axis=-1))
-        _stats.DECODE_HIST.observe(time.perf_counter() - t0)
+            with _tracer.attach(leader_ctx):
+                with _tracer.span("genserve.decode",
+                                  {"batch": b_real, "links": links}):
+                    logits, self._pages = qwen2.paged_decode_step(
+                        params, self.cfg, jnp.asarray(tokens),
+                        self._pages,
+                        jnp.asarray(tables), jnp.asarray(lengths))
+                    # greedy argmax on device: (B,) ints cross to host,
+                    # not the (B, V) logits (~MBs/step at real vocabs)
+                    host = np.asarray(jnp.argmax(logits, axis=-1))
+        dt = time.perf_counter() - t0
+        _stats.DECODE_HIST.observe(dt)
+        _deviceprof.record_execute("genserve", "decode",
+                                   f"b{b}x{self._table_width}", dt)
         self.stats.decode_steps += 1
         self.stats.decode_lane_tokens += b_real
         for i, seq in enumerate(active):

@@ -1110,6 +1110,33 @@ class DeviceCorpus(HostCorpus):
         # installed fit: full-mode recovery re-installs from this after
         # dropping the device-resident cluster buffers
         self._last_fit_host: Optional[tuple] = None
+        # fleet telemetry: HBM residency provider (weakref'd; summed per
+        # component at /metrics render — telemetry/deviceprof.py)
+        from nornicdb_tpu.telemetry import deviceprof as _deviceprof
+
+        _deviceprof.register_hbm(self, DeviceCorpus._hbm_bytes)
+
+    @staticmethod
+    def _hbm_bytes(self) -> dict:
+        """Lock-free device-resident byte accounting (scrape thread)."""
+        out = {"corpus_f32": 0, "corpus_int8": 0, "ivf": 0}
+        dev, valid, i8, ivf = (self._dev, self._dev_valid, self._dev_i8,
+                               self._ivf)
+        for arr in (dev, valid):
+            if arr is not None:
+                out["corpus_f32"] += int(arr.size) * arr.dtype.itemsize
+        if i8 is not None:
+            for arr in i8:
+                out["corpus_int8"] += int(arr.size) * arr.dtype.itemsize
+        if ivf is not None:
+            for name in ("blocks", "counts", "slotmap", "centroids",
+                         "residual", "residual_slots", "residual_valid",
+                         "block_scales", "residual_scales"):
+                arr = getattr(ivf, name, None)
+                # host-side layout fields (np slotmaps) are not HBM
+                if arr is not None and not isinstance(arr, np.ndarray):
+                    out["ivf"] += int(arr.size) * arr.dtype.itemsize
+        return out
 
     # -- cluster pruning ----------------------------------------------------
     def cluster(self, k: int = 0, iters: int = 10, seed: int = 0,
@@ -1489,14 +1516,26 @@ class DeviceCorpus(HostCorpus):
         # degraded one routes this search to the exact host path
         if not self._device_gate():
             return self._search_host(q, k, min_similarity)
+        from nornicdb_tpu.telemetry import deviceprof as _deviceprof
+
         try:
             if n_probe > 0:
+                t0 = time.perf_counter()
                 pruned = self._pruned_search(
                     q, k, min_similarity, n_probe, exact
                 )
                 if pruned is not None:
                     self.sync_stats.device_dispatches += 1
+                    # unified program ledger (fleet telemetry plane):
+                    # shape class = pow2 batch, bounded like the jit
+                    # shape classes themselves
+                    _deviceprof.record_execute(
+                        "search", "ivf",
+                        _deviceprof.pow2_class(q.shape[0], "b"),
+                        time.perf_counter() - t0,
+                    )
                     return pruned
+            t0 = time.perf_counter()
             with self._borrow_device() as (corpus, valid, dev_i8, ids, _):
                 kk = min(k, self.capacity)
                 vals, idx = topk_backend(
@@ -1509,6 +1548,10 @@ class DeviceCorpus(HostCorpus):
                 vals_np = np.asarray(vals, np.float32)
                 idx_np = np.asarray(idx)
             self.sync_stats.device_dispatches += 1
+            _deviceprof.record_execute(
+                "search", "dense", _deviceprof.pow2_class(q.shape[0], "b"),
+                time.perf_counter() - t0,
+            )
         except DeviceUnavailable:
             # degraded between the gate and the borrow
             return self._search_host(q, k, min_similarity)

@@ -63,6 +63,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from nornicdb_tpu.errors import DeviceUnavailable
 from nornicdb_tpu.ops.host_search import quantize_rows_np, rescore_rows
+from nornicdb_tpu.telemetry import deviceprof as _deviceprof
 from nornicdb_tpu.ops.ivf import _next_pow2
 from nornicdb_tpu.ops.similarity import (
     _SHARD_LOCALK_OVERFLOWS,
@@ -363,6 +364,31 @@ class ShardedCorpus(HostCorpus):
         self._sivf = None
         self._pending_clusters: Optional[tuple] = None
         self._last_fit_host: Optional[tuple] = None
+        # fleet telemetry: mesh-resident byte accounting per component
+        # (summed with any other live corpora at /metrics render)
+        _deviceprof.register_hbm(self, ShardedCorpus._hbm_bytes)
+
+    @staticmethod
+    def _hbm_bytes(self) -> dict:
+        """Lock-free HBM accounting (scrape thread): f32 buffers, int8
+        codes+scales, and the sharded IVF layout's device arrays."""
+        out = {"corpus_f32": 0, "corpus_int8": 0, "ivf": 0}
+        dev, valid, i8, sivf = (self._dev, self._dev_valid, self._dev_i8,
+                                self._sivf)
+        for arr in (dev, valid):
+            if arr is not None:
+                out["corpus_f32"] += int(arr.size) * arr.dtype.itemsize
+        if i8 is not None:
+            for arr in i8:
+                out["corpus_int8"] += int(arr.size) * arr.dtype.itemsize
+        if sivf is not None:
+            for name in ("blocks", "counts", "slotmap", "centroids",
+                         "residual", "residual_slots", "block_scales",
+                         "residual_scales"):
+                arr = getattr(sivf, name, None)
+                if arr is not None and not isinstance(arr, np.ndarray):
+                    out["ivf"] += int(arr.size) * arr.dtype.itemsize
+        return out
 
     @property
     def local_n(self) -> int:
@@ -826,6 +852,9 @@ class ShardedCorpus(HostCorpus):
         self.shard_stats.ivf_dispatches += 1
         self.shard_stats.last_dispatch_s = t1 - t0
         _SHARDED_SEARCH_HIST.observe(t1 - t0)
+        _deviceprof.record_execute(
+            "search", "sharded_ivf", _deviceprof.pow2_class(b, "b"),
+            t1 - t0)
         if quantized:
             vals_np, slots_np = self._rescore_host(q, slots_np, host, k)
         out = self._format_results(
@@ -887,6 +916,9 @@ class ShardedCorpus(HostCorpus):
             self.shard_stats.dispatches += 1
             self.shard_stats.last_dispatch_s = t1 - t0
             _SHARDED_SEARCH_HIST.observe(t1 - t0)
+            _deviceprof.record_execute(
+                "search", "sharded_int8", _deviceprof.pow2_class(b, "b"),
+                t1 - t0)
             if lk < local_n:
                 self._note_local_k_overflows(idx_np, lk, local_n)
             vals_np, slots_np = self._rescore_host(q, idx_np, host, k)
@@ -995,6 +1027,8 @@ class ShardedCorpus(HostCorpus):
         self.shard_stats.dispatches += 1
         self.shard_stats.last_dispatch_s = t1 - t0
         _SHARDED_SEARCH_HIST.observe(t1 - t0)
+        _deviceprof.record_execute(
+            "search", "sharded", _deviceprof.pow2_class(b, "b"), t1 - t0)
         if not exact and lk < local_n:
             # detect saturation on the UNSLICED merged width: a shard
             # contributing all lk of its oversampled candidates is the

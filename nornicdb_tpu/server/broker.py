@@ -27,11 +27,17 @@ of hammering a device that is not there.
 
 Wire protocol (all little-endian)
 ---------------------------------
-Frame: ``u32 length | u8 msg_type | u64 request_id | payload`` where
-``length`` covers everything after itself. Responses echo the request id
-with ``msg_type | 0x80``. Response payloads begin with a status byte:
-``0`` OK, ``1`` RESOURCE_EXHAUSTED, ``2`` DEGRADED, ``3`` ERROR; non-OK
-payloads carry ``u32 len | utf-8 message``.
+Frame: ``u32 length | u8 msg_type | u64 request_id | u8 tp_len |
+traceparent | payload`` where ``length`` covers everything after itself
+and ``tp_len`` (0 = untraced) carries an optional W3C traceparent — the
+trace-context hop that makes a worker's request and the primary's fused
+dispatch ONE trace (the replication ``Message.tp`` pattern, PR 5): the
+broker handler continues the worker's trace id, so QueryBatcher
+queue-wait and fused-batch spans attribute to the worker's caller and
+``/admin/traces/<id>`` renders one cross-process span tree. Responses
+echo the request id with ``msg_type | 0x80``. Response payloads begin
+with a status byte: ``0`` OK, ``1`` RESOURCE_EXHAUSTED, ``2`` DEGRADED,
+``3`` ERROR; non-OK payloads carry ``u32 len | utf-8 message``.
 
 SEARCH (0x01): ``u8 dtype (0=f32, 1=int8) | u8 flags (bit0: with_content)
 | u32 B | u32 D | u32 k | f32 min_similarity | data`` — data is ``B*D``
@@ -64,6 +70,7 @@ import numpy as np
 
 from nornicdb_tpu.errors import NotFoundError, ResourceExhausted
 from nornicdb_tpu.telemetry.metrics import REGISTRY as _REGISTRY
+from nornicdb_tpu.telemetry.tracing import tracer as _tracer
 
 log = logging.getLogger(__name__)
 
@@ -71,6 +78,12 @@ log = logging.getLogger(__name__)
 MSG_SEARCH = 0x01
 MSG_EMBED = 0x02
 MSG_STATUS = 0x03
+# finished-trace shipment (fleet telemetry plane): a worker whose traced
+# request crossed the broker ships its completed span records back so the
+# primary's /admin/traces renders ONE tree spanning both processes.
+# Payload: u32 len | JSON {trace_id, root, started, duration_ms, proc,
+# spans: [...]}. OK payload: empty.
+MSG_SPANS = 0x05
 # Qdrant collection search (ROADMAP 1b): the points/search surface takes
 # raw vectors, so workers ship it over the broker instead of proxying the
 # whole HTTP request — the primary answers from the SHARED
@@ -149,20 +162,26 @@ def _recv_exact(sock: socket.socket, n: int) -> bytes:
     return bytes(buf)
 
 
-def _read_frame(sock: socket.socket) -> tuple[int, int, bytes]:
+def _read_frame(sock: socket.socket) -> tuple[int, int, str, bytes]:
     head = _recv_exact(sock, 4)
     (length,) = struct.unpack("<I", head)
-    if length < 9 or length > (1 << 30):
+    if length < 10 or length > (1 << 30):
         raise ConnectionError(f"bad frame length {length}")
     body = _recv_exact(sock, length)
     mtype = body[0]
     (req_id,) = struct.unpack_from("<Q", body, 1)
-    return mtype, req_id, body[9:]
+    tp_len = body[9]
+    if 10 + tp_len > length:
+        raise ConnectionError(f"bad traceparent length {tp_len}")
+    tp = body[10:10 + tp_len].decode("ascii", "replace") if tp_len else ""
+    return mtype, req_id, tp, body[10 + tp_len:]
 
 
 def _send_frame(sock: socket.socket, mtype: int, req_id: int,
-                payload: bytes) -> int:
-    frame = struct.pack("<IBQ", 9 + len(payload), mtype, req_id) + payload
+                payload: bytes, traceparent: str = "") -> int:
+    tp = traceparent.encode("ascii", "replace")[:255]
+    frame = struct.pack("<IBQB", 10 + len(tp) + len(payload), mtype,
+                        req_id, len(tp)) + tp + payload
     sock.sendall(frame)
     return len(frame)
 
@@ -379,11 +398,13 @@ class DeviceBroker:
         try:
             while not self._stop.is_set():
                 try:
-                    mtype, req_id, payload = _read_frame(conn)
+                    mtype, req_id, tp, payload = _read_frame(conn)
                 except (ConnectionError, OSError):
                     return
-                _BYTES.labels("rx").inc(13 + len(payload))
-                resp = self._dispatch(mtype, payload)
+                # 4B length + 1B type + 8B req id + 1B tp_len + tp
+                # (ascii: chars == bytes) + payload
+                _BYTES.labels("rx").inc(14 + len(tp) + len(payload))
+                resp = self._dispatch(mtype, payload, tp)
                 try:
                     n = _send_frame(conn, mtype | RESP, req_id, resp)
                 except OSError:
@@ -398,19 +419,60 @@ class DeviceBroker:
             except OSError:
                 pass  # peer already gone
 
-    def _dispatch(self, mtype: int, payload: bytes) -> bytes:
+    def _dispatch(self, mtype: int, payload: bytes,
+                  traceparent: str = "") -> bytes:
         if mtype == MSG_SEARCH:
-            return self._handle_search(payload)
+            # continue the WORKER's trace: the root span's parent is the
+            # worker-side span that sent the frame, so the shipped-back
+            # worker spans and this handler's spans (queue-wait, fused
+            # batch) render as one tree at /admin/traces/<id>
+            with _tracer.start_trace(
+                "broker.search", traceparent=traceparent or None,
+            ):
+                return self._handle_search(payload)
         if mtype == MSG_EMBED:
-            return self._handle_embed(payload)
+            with _tracer.start_trace(
+                "broker.embed", traceparent=traceparent or None,
+            ):
+                return self._handle_embed(payload)
         if mtype == MSG_QDRANT:
-            return self._handle_qdrant(payload)
+            with _tracer.start_trace(
+                "broker.qdrant", traceparent=traceparent or None,
+            ):
+                return self._handle_qdrant(payload)
+        if mtype == MSG_SPANS:
+            return self._handle_spans(payload)
         if mtype == MSG_STATUS:
             self.counters["status"] += 1
             _REQUESTS.labels("status", "ok").inc()
             blob = json.dumps(self.status_snapshot()).encode()
             return bytes([OK]) + struct.pack("<I", len(blob)) + blob
         return _status_payload(STATUS_ERROR, f"unknown message {mtype}")
+
+    def _handle_spans(self, payload: bytes) -> bytes:
+        """Merge a worker's finished-trace span records into the local
+        ring (telemetry.tracing.Tracer.merge_remote) — best-effort: a
+        malformed shipment is an error reply, never a crash."""
+        try:
+            (ln,) = struct.unpack_from("<I", payload, 0)
+            data = json.loads(payload[4:4 + ln].decode())
+            merged = _tracer.merge_remote(
+                str(data.get("trace_id") or ""),
+                data.get("spans") or [],
+                root=data.get("root"),
+                started=data.get("started"),
+                duration_ms=data.get("duration_ms"),
+                proc=data.get("proc"),
+            )
+        except Exception as e:
+            self.counters["spans_error"] = (
+                self.counters.get("spans_error", 0) + 1
+            )
+            return _status_payload(STATUS_ERROR, f"bad spans frame: {e}")
+        self.counters["spans_merged"] = (
+            self.counters.get("spans_merged", 0) + (1 if merged else 0)
+        )
+        return bytes([OK])
 
     # -- handlers ------------------------------------------------------------
     def _handle_search(self, payload: bytes) -> bytes:
@@ -667,11 +729,14 @@ class BrokerClient:
 
     def _call(self, mtype: int, payload: bytes) -> bytes:
         req_id = self._next_id()
+        # the caller's active span (if any) rides the frame header, so
+        # the primary-side handler continues the SAME trace id
+        tp = _tracer.current_traceparent() or ""
         for attempt in (0, 1):
             try:
                 sock = self._conn()
-                _send_frame(sock, mtype, req_id, payload)
-                rtype, rid, body = _read_frame(sock)
+                _send_frame(sock, mtype, req_id, payload, tp)
+                rtype, rid, _tp, body = _read_frame(sock)
                 if rtype != (mtype | RESP) or rid != req_id:
                     raise ConnectionError(
                         f"broker protocol desync (type {rtype}, id {rid})"
@@ -733,6 +798,26 @@ class BrokerClient:
         body = self._check(self._call(MSG_STATUS, b""))
         (ln,) = struct.unpack_from("<I", body, 0)
         return json.loads(body[4:4 + ln].decode())
+
+    def ship_spans(self, entry: dict, proc: str) -> None:
+        """Ship a finished trace's span records to the primary so its
+        /admin/traces renders one cross-process tree. Best-effort:
+        failure to ship must never fail the request that produced the
+        trace."""
+        blob = json.dumps({
+            "trace_id": entry.get("trace_id"),
+            "root": entry.get("root"),
+            "started": entry.get("started"),
+            "duration_ms": entry.get("duration_ms"),
+            "proc": proc,
+            "spans": entry.get("spans") or [],
+        }).encode()
+        try:
+            self._check(self._call(
+                MSG_SPANS, struct.pack("<I", len(blob)) + blob,
+            ))
+        except (BrokerError, OSError) as e:
+            log.debug("trace shipment failed: %s", e)
 
     def close(self) -> None:
         self._drop()

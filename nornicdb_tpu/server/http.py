@@ -59,6 +59,13 @@ def _worker_pool_stats() -> list[dict]:
 # utilization, prefill/decode latency, sheds, tokens) — the tested
 # observability catalog must render them in every serving process
 from nornicdb_tpu.genserve import stats as _genserve_stats  # noqa: F401
+# fleet telemetry plane: the federation module registers the worker
+# serving-ladder + fleet-membership families and owns the /metrics merge
+# collector; deviceprof registers the device program ledger + HBM
+# residency families and the /admin/profile capture — imported here so
+# the tested observability catalog renders them in every server process
+from nornicdb_tpu.telemetry import deviceprof as _deviceprof
+from nornicdb_tpu.telemetry import federation as _federation
 from nornicdb_tpu.telemetry.metrics import (
     REGISTRY as _TELEMETRY_REGISTRY,
     Registry as _Registry,
@@ -541,7 +548,12 @@ class HttpServer:
             h._send(200, body)
             return
         if path == "/metrics":
-            h._send(200, self.registry.render_prometheus(),
+            # fleet federation: with registered worker segments the body
+            # is the structural merge of every live worker's exposition
+            # under a proc label; with none it is byte-identical to the
+            # single-process exposition (telemetry/federation.py)
+            h._send(200, _federation.FLEET.merged_exposition(
+                self.registry.render_prometheus),
                     content_type="text/plain; version=0.0.4")
             return
         if path == "/auth/config":
@@ -723,12 +735,20 @@ class HttpServer:
             return
         if path == "/admin/slow-queries":
             # over-threshold statements with redacted text, plan summary,
-            # span breakdown and counter deltas (tentpole pillar 3)
+            # span breakdown and counter deltas (tentpole pillar 3);
+            # worker-side entries (vector searches with served-path
+            # attribution, federated via the fleet segments) merge in
+            # tagged with their proc
             h._auth("admin")
+            entries = [dict(e, proc="primary")
+                       for e in _slow_log.snapshot()]
+            entries.extend(_federation.FLEET.slow_queries())
+            entries.sort(key=lambda e: e.get("timestamp", 0.0),
+                         reverse=True)
             h._send(200, {
                 "threshold_ms": _slow_log.threshold_s * 1e3,
                 "recorded": _slow_log.recorded,
-                "slow_queries": _slow_log.snapshot(),
+                "slow_queries": entries,
             })
             return
         if path == "/admin/stats":
@@ -805,6 +825,20 @@ class HttpServer:
             if pools:
                 # prefork worker pool: live workers, respawns, ports
                 stats["workers"] = pools[0] if len(pools) == 1 else pools
+            if pools or _federation.FLEET.members():
+                # fleet telemetry plane: per-worker exposition freshness
+                # (federation half) + per-worker liveness/respawn state
+                # (pool half) — the one place an operator reads "which
+                # workers are alive and reporting"
+                from nornicdb_tpu.server import workers as _workers_mod
+
+                fleet = _federation.FLEET.stats()
+                fleet["pools"] = _workers_mod.active_pool_fleet_states()
+                stats["fleet"] = fleet
+            # device-time & HBM profiler: program ledger by
+            # (subsystem, kind, shape) + residency by component
+            # (docs/observability.md "Device-time & HBM profiler")
+            stats["deviceprof"] = _deviceprof.snapshot()
             h._send(200, stats)
             return
         if path == "/admin/config":
@@ -1172,6 +1206,40 @@ class HttpServer:
             h._auth("admin")
             n = self.db.search.build_indexes()
             h._send(200, {"indexed": n})
+            return
+        if path == "/admin/profile":
+            # on-demand device profiler: single-flight jax.profiler
+            # capture over ?seconds=N, returned as a downloadable
+            # .tar.gz artifact (telemetry/deviceprof.py; playbook in
+            # docs/observability.md "Device-time & HBM profiler")
+            h._auth("admin")
+            from urllib.parse import parse_qs, urlparse
+
+            import nornicdb_tpu.telemetry as _telemetry
+
+            qs = parse_qs(urlparse(h.path).query)
+            try:
+                seconds = float((qs.get("seconds") or ["1.0"])[0])
+            except ValueError:
+                h._send(400, {"error": "seconds must be a number"})
+                return
+            try:
+                artifact = _deviceprof.capture_profile(
+                    seconds, max_seconds=_telemetry.profile_max_s)
+            except _deviceprof.ProfileBusy as e:
+                h._send(409, {"error": str(e)})
+                return
+            except Exception as e:
+                log.exception("profile capture failed")
+                h._send(503, {"error": f"profile capture failed: {e}"})
+                return
+            h._send_raw(
+                200, artifact, content_type="application/gzip",
+                extra_headers={
+                    "Content-Disposition":
+                        'attachment; filename="nornicdb-profile.tar.gz"',
+                },
+            )
             return
         if path == "/admin/backup":
             # (ref: server_router.go /admin/backup -> badger_backup.go)

@@ -306,11 +306,22 @@ def build_spec(version: str = "0.4.0") -> dict:
             "root span, duration, span count", tag="admin")},
         "/admin/traces/{trace_id}": {"get": _op(
             "One trace as a span tree (W3C trace id; see "
-            "docs/observability.md for the propagation map)", tag="admin")},
+            "docs/observability.md for the propagation map). Spans "
+            "shipped from prefork worker processes merge into the same "
+            "tree, tagged with their proc", tag="admin")},
         "/admin/slow-queries": {"get": _op(
             "Slow-query capture ring: over-threshold statements with "
             "redacted text, plan summary, span breakdown and "
-            "adjacency/device-sync counter deltas", tag="admin")},
+            "adjacency/device-sync counter deltas; worker-side vector "
+            "search captures merge in with proc + served-path "
+            "attribution", tag="admin")},
+        "/admin/profile": {"post": _op(
+            "On-demand device profiler: single-flight jax.profiler "
+            "capture over ?seconds=N (clamped to the configured "
+            "maximum), returned as a downloadable .tar.gz artifact; "
+            "409 while another capture is in flight "
+            "(docs/observability.md \"Device-time & HBM profiler\")",
+            tag="admin")},
         # -- compliance ------------------------------------------------------
         "/gdpr/export": {"post": _op(
             "Export all data for a subject (GDPR right of access)",

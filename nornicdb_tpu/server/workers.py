@@ -74,11 +74,19 @@ import subprocess
 import sys
 import tempfile
 import threading
+import time
 import weakref
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Any, Optional
 
 from nornicdb_tpu.server.respcache import ResponseCache
+from nornicdb_tpu.telemetry.federation import (
+    FLEET,
+    WORKER_BROKER_RTT,
+    WORKER_REQUESTS,
+)
+from nornicdb_tpu.telemetry.slowlog import slow_log as _slow_log
+from nornicdb_tpu.telemetry.tracing import tracer as _tracer
 
 log = logging.getLogger(__name__)
 
@@ -96,6 +104,26 @@ def active_pool_stats() -> list[dict]:
         pool = ref()
         if pool is not None:
             out.append(pool.stats())
+    return out
+
+
+def active_pool_fleet_states() -> list[dict]:
+    """Per-worker liveness/respawn state of every live pool — the
+    /admin/stats ``fleet`` section's pool half (kept OUT of
+    active_pool_stats so the response carries it once)."""
+    out = []
+    with _ACTIVE_POOLS_LOCK:
+        refs = list(_ACTIVE_POOLS)
+    for ref in refs:
+        pool = ref()
+        if pool is not None:
+            out.append({
+                "kind": pool.kind,
+                "n_workers": pool.n_workers,
+                "alive": pool.alive(),
+                "respawns": pool.respawns,
+                "workers": pool.worker_states(),
+            })
     return out
 
 
@@ -172,13 +200,20 @@ class WorkerReadPath:
 
     def __init__(self, broker_path: Optional[str],
                  corpus_seg: Optional[str],
-                 adjacency_seg: Optional[str] = None):
+                 adjacency_seg: Optional[str] = None,
+                 proc: str = "worker"):
         self.broker_path = broker_path
         self.corpus_seg = corpus_seg
         self.adjacency_seg = adjacency_seg
+        self.proc = proc
         self._client = None
         self._corpus_reader = None
         self.served = {"broker": 0, "shm": 0}
+        # async trace shipment (ship_trace): lazily-started single
+        # shipper thread + bounded queue; drops counted, never blocking
+        self._ship_queue = None
+        self._ship_lock = threading.Lock()
+        self.ship_drops = 0
 
     def _broker(self):
         if self._client is None and self.broker_path:
@@ -242,8 +277,12 @@ class WorkerReadPath:
         client = self._broker()
         if client is not None:
             try:
-                rows = client.search(q, k, min_score,
-                                     with_content=with_content)
+
+                t0 = time.perf_counter()
+                with _tracer.span("worker.broker_call"):
+                    rows = client.search(q, k, min_score,
+                                         with_content=with_content)
+                WORKER_BROKER_RTT.observe(time.perf_counter() - t0)
                 self.served["broker"] += 1
                 return rows[0], "broker"
             except (BrokerDegraded, BrokerUnavailable) as e:
@@ -253,12 +292,56 @@ class WorkerReadPath:
             from nornicdb_tpu.server.shm import SegmentUnavailable
 
             try:
-                rows = reader.search(q, k, min_score)
+                with _tracer.span("worker.shm_search"):
+                    rows = reader.search(q, k, min_score)
                 self.served["shm"] += 1
                 return [(i, s, "") for i, s in rows[0]], "shm"
             except SegmentUnavailable as e:
                 log.debug("shared corpus segment unavailable: %s", e)
         raise LookupError("no broker and no shared corpus segment")
+
+    def ship_trace(self, trace_id: Optional[str]) -> None:
+        """Queue a finished worker trace's spans for shipment to the
+        primary, so /admin/traces/<trace_id> renders one tree spanning
+        both processes. Shipment runs on a single background thread
+        (bounded queue, drop-on-full): the handler thread must never pay
+        a broker round trip AFTER the response it already sent, and a
+        dropped shipment under burst costs a trace detail, never a
+        request. Best-effort end to end."""
+        if not trace_id or self.broker_path is None:
+            return
+        entry = _tracer.trace(trace_id)
+        if entry is None:
+            return
+        import queue as _queue
+
+        q = self._ship_queue
+        if q is None:
+            with self._ship_lock:
+                q = self._ship_queue
+                if q is None:
+                    q = self._ship_queue = _queue.Queue(maxsize=64)
+                    threading.Thread(
+                        target=self._ship_loop,
+                        name="nornicdb-worker-trace-ship", daemon=True,
+                    ).start()
+        try:
+            q.put_nowait({k: entry.get(k) for k in
+                          ("trace_id", "root", "started", "duration_ms",
+                           "spans")})
+        except _queue.Full:
+            self.ship_drops += 1
+
+    def _ship_loop(self) -> None:
+        while True:
+            payload = self._ship_queue.get()
+            client = self._broker()
+            if client is None:
+                continue
+            try:
+                client.ship_spans(payload, proc=self.proc)
+            except Exception:
+                log.debug("worker trace shipment failed", exc_info=True)
 
 
 _MUTATION_RE = re.compile(r"\bmutation\b")
@@ -392,6 +475,16 @@ class _FrontendHandler(BaseHTTPRequestHandler):
         self.send_header("X-Nornic-Cache", cache_state)
         self.end_headers()
         self.wfile.write(data)
+        # serving-ladder attribution for the federated exposition: every
+        # worker response counts exactly once, by HOW it was served
+        if cache_state == "hit":
+            served = "cache"
+        elif cache_state in ("limited", "error"):
+            served = cache_state
+        else:
+            served = next((v for k, v in headers
+                           if k == "X-Nornic-Served"), "proxy")
+        WORKER_REQUESTS.labels(served).inc()
 
     def _handle(self, method: str) -> None:
         length = int(self.headers.get("Content-Length") or 0)
@@ -404,13 +497,23 @@ class _FrontendHandler(BaseHTTPRequestHandler):
             self._respond(429, [("Content-Type", "application/json")],
                           msg, "limited")
             return
+        vec_meta = None  # (k, dims, t0): proxy-served vector search
         try:
             if method == "POST" and \
                     self.path.split("?", 1)[0] == "/nornicdb/search":
                 parsed = self._sniff_vector(body)
-                if parsed is not None and \
-                        self._serve_vector(method, body, parsed):
-                    return
+                if parsed is not None:
+                    if self._serve_vector(method, body, parsed):
+                        return
+                    # device plane could not answer — the primary serves
+                    # it via the proxy path below; keep the slow-query
+                    # attribution complete (served="proxy"). Malformed
+                    # limit/vector values proxy WITHOUT capture — the
+                    # primary owns their validation error.
+
+                    shape = self._vec_shape(parsed)
+                    if shape is not None:
+                        vec_meta = (*shape, time.perf_counter())
             if method == "POST":
                 qm = _QDRANT_SEARCH_RE.fullmatch(self.path.split("?", 1)[0])
                 if qm is not None:
@@ -451,8 +554,26 @@ class _FrontendHandler(BaseHTTPRequestHandler):
                 )
             except OSError:
                 pass  # client hung up before the error could be written
+        finally:
+            if vec_meta is not None:
+
+                k, dims, t0 = vec_meta
+                _slow_log.maybe_record(
+                    f"VECTOR SEARCH k={k} dims={dims}", None,
+                    time.perf_counter() - t0, served="proxy",
+                )
 
     # -- broker-served vector search -----------------------------------
+    @staticmethod
+    def _vec_shape(parsed: dict) -> Optional[tuple[int, int]]:
+        """(k, dims) of a sniffed vector request, or None when the
+        values are malformed — the primary owns the validation error
+        shape, so malformed requests must PROXY, never 502 here."""
+        try:
+            return int(parsed.get("limit", 10)), len(parsed["vector"])
+        except (TypeError, ValueError):
+            return None
+
     @staticmethod
     def _sniff_vector(body: bytes) -> Optional[dict]:
         """The worker-servable request shape: a JSON body with a non-empty
@@ -474,7 +595,15 @@ class _FrontendHandler(BaseHTTPRequestHandler):
         """Serve a raw-vector search without touching the primary's
         protocol stack: response cache, then the WorkerReadPath ladder
         (broker → shared segment). Returns False when neither source is
-        available — the caller falls through to the proxy path."""
+        available — the caller falls through to the proxy path.
+
+        Device-plane serves run under a root trace (continuing the
+        client's ``traceparent`` when present): the broker frame carries
+        it across the process hop, the finished worker spans ship back
+        via MSG_SPANS, and slow searches land in the worker's slow-query
+        ring with served-path attribution — federated to the primary's
+        /admin/slow-queries by the metrics publisher."""
+
         from nornicdb_tpu.errors import ResourceExhausted
 
         read_path = self.server.read_path
@@ -492,15 +621,39 @@ class _FrontendHandler(BaseHTTPRequestHandler):
             self._respond(status, headers, data, "hit")
             return True
         gen_before = cache.generation()
-        try:
-            hits, served = read_path.search(
-                parsed["vector"], int(parsed.get("limit", 10)),
-                float(parsed.get("min_score", -1.0)),
-                with_content=bool(parsed.get("include_content", True)),
-            )
-        except ResourceExhausted as e:
+        shape = self._vec_shape(parsed)
+        if shape is None:
+            return False  # malformed limit/vector: the primary validates
+        k, dims = shape
+        t0 = time.perf_counter()
+        hits = served = shed = None
+        root = _tracer.start_trace(
+            "worker.search",
+            traceparent=self.headers.get("traceparent"),
+            attrs={"proc": read_path.proc, "k": k, "dims": dims},
+        )
+        with root:
+            try:
+                hits, served = read_path.search(
+                    parsed["vector"], k,
+                    float(parsed.get("min_score", -1.0)),
+                    with_content=bool(
+                        parsed.get("include_content", True)),
+                )
+                root.set_attr("served", served)
+            except ResourceExhausted as e:
+                shed = e
+                root.set_attr("served", "shed")
+            except LookupError:
+                pass  # no broker, no segment: proxy to the primary
+            except Exception:
+                log.warning("worker vector search failed; proxying",
+                            exc_info=True)
+        duration = time.perf_counter() - t0
+        trace_id = getattr(root, "trace_id", None)
+        if shed is not None:
             msg = json.dumps(
-                {"error": str(e), "reason": e.reason}
+                {"error": str(shed), "reason": shed.reason}
             ).encode()
             self._respond(
                 429,
@@ -509,12 +662,8 @@ class _FrontendHandler(BaseHTTPRequestHandler):
                 msg, "limited",
             )
             return True
-        except LookupError:
-            return False  # no broker, no segment: proxy to the primary
-        except Exception:
-            log.warning("worker vector search failed; proxying",
-                        exc_info=True)
-            return False
+        if served is None:
+            return False  # ladder empty: proxy to the primary
         payload = json.dumps({
             "results": [
                 {"id": i, "score": s, "content": c} for i, s, c in hits
@@ -526,6 +675,13 @@ class _FrontendHandler(BaseHTTPRequestHandler):
         # cacheable (generation-stamped, so any index mutation kills it)
         cache.put(key, (200, headers, payload), gen_before)
         self._respond(200, headers, payload, "miss")
+        # satellite: worker-side slow-query capture with served-path
+        # attribution (the vector text itself never enters the ring)
+        _slow_log.maybe_record(
+            f"VECTOR SEARCH k={k} dims={dims}", None, duration,
+            trace_id=trace_id, served=served,
+        )
+        read_path.ship_trace(trace_id)
         return True
 
     # -- broker-served qdrant points/search ----------------------------
@@ -654,7 +810,6 @@ def _grpc_worker_main(host: str, public_port: int, primary_port: int,
                       gen: GenerationFile, worker_id: int,
                       rate_limit: Optional[tuple] = None,
                       read_path: Optional[WorkerReadPath] = None) -> None:
-    import time as _time
     from concurrent import futures
 
     import grpc
@@ -697,21 +852,39 @@ def _grpc_worker_main(host: str, public_port: int, primary_port: int,
             return None  # text search needs embedder + BM25: proxy
         from nornicdb_tpu.errors import ResourceExhausted
 
-        t0 = _time.perf_counter()
-        try:
-            hits, _served = read_path.search(
-                req["vector"], req["limit"], req["min_score"],
-                with_content=True,
-            )
-        except ResourceExhausted as e:
-            context.abort(grpc.StatusCode.RESOURCE_EXHAUSTED, str(e))
-        except LookupError:
+        t0 = time.perf_counter()
+        hits = served = shed = None
+        root = _tracer.start_trace(
+            "worker.search",
+            attrs={"proc": read_path.proc, "k": req["limit"],
+                   "dims": int(len(req["vector"]))},
+        )
+        with root:
+            try:
+                hits, served = read_path.search(
+                    req["vector"], req["limit"], req["min_score"],
+                    with_content=True,
+                )
+                root.set_attr("served", served)
+            except ResourceExhausted as e:
+                shed = e
+            except LookupError:
+                pass
+            except Exception:
+                log.warning("worker grpc vector search failed; proxying",
+                            exc_info=True)
+        duration = time.perf_counter() - t0
+        if shed is not None:
+            context.abort(grpc.StatusCode.RESOURCE_EXHAUSTED, str(shed))
+        if served is None:
             return None
-        except Exception:
-            log.warning("worker grpc vector search failed; proxying",
-                        exc_info=True)
-            return None
-        took = int((_time.perf_counter() - t0) * 1e6)
+        _slow_log.maybe_record(
+            f"VECTOR SEARCH k={req['limit']} dims={len(req['vector'])}",
+            None, duration,
+            trace_id=getattr(root, "trace_id", None), served=served,
+        )
+        read_path.ship_trace(getattr(root, "trace_id", None))
+        took = int(duration * 1e6)
         return encode_search_response(
             [{"id": i, "score": s, "content": c} for i, s, c in hits],
             took,
@@ -849,7 +1022,9 @@ class WorkerPool:
                  respawn: bool = True,
                  workdir: Optional[str] = None,
                  publish_interval: float = 0.05,
-                 auth_required: bool = False):
+                 auth_required: bool = False,
+                 metrics: bool = True,
+                 metrics_interval: float = 0.5):
         if kind not in ("http", "grpc"):
             raise ValueError(f"unknown worker kind {kind!r}")
         self.kind = kind
@@ -878,6 +1053,20 @@ class WorkerPool:
         # primary would 401. Auth'd deployments keep cache+proxy (cached
         # entries are auth-keyed and only stored after the primary said 200).
         self.auth_required = auth_required
+        # fleet telemetry: each worker publishes its registry exposition
+        # into a per-proc shm segment; the primary's FLEET collector
+        # merges them into /metrics (telemetry/federation.py)
+        self.metrics = metrics
+        self.metrics_interval = metrics_interval
+        self._fleet_procs: list[tuple[str, str]] = []
+        if metrics:
+            fleet_dir = os.path.join(self._workdir, "fleet")
+            os.makedirs(fleet_dir, exist_ok=True)
+            for i in range(n_workers):
+                proc = self._proc_name(i)
+                prefix = os.path.join(fleet_dir, f"{proc}.seg")
+                FLEET.register(proc, prefix)
+                self._fleet_procs.append((proc, prefix))
         # device plane: the broker (one PJRT owner serving every worker's
         # search/embed batches) and the shared-memory read plane (one copy
         # of the corpus + CSR adjacency for every worker's fallback reads).
@@ -920,8 +1109,12 @@ class WorkerPool:
             _ACTIVE_POOLS.append(weakref.ref(self))
 
     # -- worker process management ------------------------------------------
+    def _proc_name(self, worker_id: int) -> str:
+        return f"{self.kind}-worker-{worker_id}"
+
     def _worker_cfg(self, worker_id: int) -> str:
         rp = self.read_plane
+        proc = self._proc_name(worker_id)
         return json.dumps({
             "kind": self.kind,
             "host": self.host,
@@ -929,6 +1122,7 @@ class WorkerPool:
             "primary_port": self.primary_port,
             "gen_path": self.generation.path,
             "worker_id": worker_id,
+            "proc": proc,
             "rate_limit": list(self.rate_limit) if self.rate_limit
                           else None,
             "broker_path": (self.broker.path
@@ -938,6 +1132,21 @@ class WorkerPool:
                            if rp and not self.auth_required else None),
             "adjacency_seg": (rp.paths["adjacency"]
                               if rp and not self.auth_required else None),
+            # fleet telemetry segment this worker publishes into
+            # (trace shipment rides the broker, so only metrics need it)
+            "metrics_seg": (os.path.join(self._workdir, "fleet",
+                                         f"{proc}.seg")
+                            if self.metrics else None),
+            "metrics_interval": self.metrics_interval,
+            # the PRIMARY's applied telemetry knobs (YAML/CLI config is
+            # applied to its singletons before pools start; env alone
+            # would miss nornicdb.yaml): workers must capture slow
+            # queries and sample traces under the SAME policy
+            "telemetry": {
+                "slow_query_ms": _slow_log.threshold_s * 1000.0,
+                "tracing_enabled": _tracer.enabled,
+                "trace_sample": _tracer.sample_rate,
+            },
         })
 
     def _spawn(self, worker_id: int) -> subprocess.Popen:
@@ -1032,6 +1241,20 @@ class WorkerPool:
             out["read_plane"] = self.read_plane.stats()
         return out
 
+    def worker_states(self) -> list[dict]:
+        """Per-worker liveness/respawn state (the /admin/stats ``fleet``
+        section's pool half)."""
+        with self._proc_lock:
+            procs = list(self._procs)
+        out = []
+        for i, p in enumerate(procs):
+            out.append({
+                "proc": self._proc_name(i),
+                "alive": p is not None and p.poll() is None,
+                "pid": p.pid if p is not None else None,
+            })
+        return out
+
     def stop(self) -> None:
         self._stopping.set()
         if self._monitor is not None:
@@ -1050,6 +1273,11 @@ class WorkerPool:
         if self._reserved is not None:
             self._reserved.close()
             self._reserved = None
+        for proc, prefix in self._fleet_procs:
+            # prefix-guarded: a newer pool re-registering the same proc
+            # name must not be evicted by this pool's shutdown
+            FLEET.unregister(proc, prefix=prefix)
+        self._fleet_procs = []
         if self.broker is not None and self._own_broker:
             self.broker.stop()
         _release_read_plane(self._db, self.read_plane)
@@ -1078,12 +1306,30 @@ def _subproc_entry(argv: list[str]) -> None:
     cfg = json.loads(argv[0])
     gen = GenerationFile(cfg["gen_path"])
     rl = tuple(cfg["rate_limit"]) if cfg.get("rate_limit") else None
+    proc = cfg.get("proc") or f"{cfg['kind']}-worker-{cfg['worker_id']}"
+    if cfg.get("telemetry"):
+        # adopt the primary's applied telemetry policy (slow-query
+        # threshold, trace sampling) — env defaults alone would miss
+        # YAML/CLI configuration the primary applied at startup
+        import nornicdb_tpu.telemetry as _telemetry
+
+        _telemetry.configure(**cfg["telemetry"])
     read_path = None
     if cfg.get("broker_path") or cfg.get("corpus_seg"):
         read_path = WorkerReadPath(
             cfg.get("broker_path"), cfg.get("corpus_seg"),
-            cfg.get("adjacency_seg"),
+            cfg.get("adjacency_seg"), proc=proc,
         )
+    if cfg.get("metrics_seg"):
+        # fleet telemetry: publish this worker's registry exposition +
+        # slow-query ring into its shm segment; the primary merges it
+        # into /metrics with a proc label (telemetry/federation.py)
+        from nornicdb_tpu.telemetry.federation import MetricsPublisher
+
+        MetricsPublisher(
+            cfg["metrics_seg"], proc,
+            interval=float(cfg.get("metrics_interval") or 0.5),
+        ).start()
     main = _http_worker_main if cfg["kind"] == "http" else _grpc_worker_main
     main(cfg["host"], cfg["port"], cfg["primary_port"], gen,
          cfg["worker_id"], rate_limit=rl, read_path=read_path)

@@ -65,6 +65,9 @@ class _Request:
     error: Optional[Exception] = None
     deadline: float = 0.0  # monotonic; 0 = none
     shed: bool = False     # terminally shed (dispatcher must skip)
+    ctx: object = None     # caller's trace span (cross-thread hand-off)
+    enqueued: float = 0.0  # perf_counter at submit (queue-wait span)
+    queue_wait_recorded: bool = False  # once per request, not per batch
 
 
 @dataclass
@@ -215,6 +218,11 @@ class ServingEngine(Embedder):
         cfg = self.config
         est = [len(t.split()) + 2 for t in texts]
         req = _Request(results=[None] * len(texts), remaining=len(texts))
+        # worker-hop trace propagation (the QueryBatcher pattern): the
+        # compute thread attaches this to record serving.batch and the
+        # retroactive queue-wait span in the CALLER's trace
+        req.ctx = _tracer.capture()
+        req.enqueued = time.perf_counter()
         if cfg.deadline_ms > 0:
             req.deadline = time.monotonic() + cfg.deadline_ms / 1000.0
         with self._cond:
@@ -458,8 +466,27 @@ class ServingEngine(Embedder):
                 continue
             self._device_busy = True
             t0 = time.perf_counter()
+            # per-caller queue wait recorded retroactively into EACH
+            # batched request's trace; the device span attaches to the
+            # batch leader's (the QueryBatcher convention)
+            reqs = []
+            seen_req_ids = set()
+            for item in items:
+                if id(item.req) not in seen_req_ids:
+                    seen_req_ids.add(id(item.req))
+                    reqs.append(item.req)
+            for req in reqs:
+                # once per REQUEST: a request split across several fused
+                # batches must not re-record queue wait spanning earlier
+                # batches' device compute
+                if req.ctx is not None and not req.queue_wait_recorded:
+                    req.queue_wait_recorded = True
+                    _tracer.add_span("serving.queue_wait", req.enqueued,
+                                     t0, parent=req.ctx)
+            leader_ctx = next(
+                (r.ctx for r in reqs if r.ctx is not None), None)
             try:
-                with _tracer.span(
+                with _tracer.attach(leader_ctx), _tracer.span(
                     "serving.batch", {"texts": len(items)}
                 ):
                     if pack is not None:

@@ -760,7 +760,14 @@ class SoakHarness:
                     f"all {len(scheduler.executed)} windows started and "
                     "cleared"))
 
-            # telemetry-backed checks against the live exposition
+            # telemetry-backed checks against the live exposition.
+            # Chaos instance stats snapshot BEFORE the scrape: the raft
+            # cluster is still heartbeating, so a post-scrape snapshot
+            # can drift a few events past the scraped registry and fail
+            # chaos_in_metrics on a race, not a real under-count — the
+            # registry only ever counts FORWARD from the snapshot.
+            chaos_instance_stats = [dict(t.stats)
+                                    for t in repl.chaos.values()]
             metrics_text = self._fetch(http.port, "/metrics").decode()
             traces = json.loads(self._fetch(http.port, "/admin/traces"))
             report.invariants.append(
@@ -779,8 +786,7 @@ class SoakHarness:
                 report.invariants.append(
                     inv.check_plan_cache_effective(samples, metrics_text))
             report.invariants.append(inv.check_chaos_in_metrics(
-                metrics_text,
-                [dict(t.stats) for t in repl.chaos.values()]))
+                metrics_text, chaos_instance_stats))
             fams = inv.parse_prometheus(metrics_text)
             report.chaos_events = {
                 "".join(k): v for k, v in
@@ -872,6 +878,30 @@ class SoakHarness:
                     report.invariants.append(failed(
                         "broker_served_traffic",
                         "no vector search ever rode the broker"))
+                # fleet telemetry plane: every live worker federated into
+                # the final scrape (stale killed-worker segments dropped),
+                # and at least one broker-served search rendered as one
+                # cross-process span tree
+                expected_procs = [
+                    f"http-worker-{i}"
+                    for i in range(spec.workload.front_workers)
+                ]
+                # re-scrape: the earlier metrics_text may predate the
+                # last respawned worker's first publish
+                fleet_text = self._fetch(http.port, "/metrics").decode()
+                report.invariants.append(inv.check_fleet_metrics_present(
+                    fleet_text, expected_procs))
+                details = []
+                for t in traces.get("traces", [])[:100]:
+                    try:
+                        details.append(json.loads(self._fetch(
+                            http.port,
+                            f"/admin/traces/{t['trace_id']}")))
+                    except Exception:
+                        log.debug("trace detail fetch failed",
+                                  exc_info=True)
+                report.invariants.append(
+                    inv.check_trace_plane_coherent(details))
 
             report.backend = backend_plane.stats()
             report.replication = repl.stats()

@@ -27,6 +27,13 @@ Catalog:
                           columnar plan cache serves repeat shapes warm
                           (hit ratio over threshold) and the class's
                           ok-request p99 stays bounded
+* ``fleet_metrics_present`` — every live worker's exposition is merged
+                          into the final /metrics scrape under its
+                          ``proc`` label (fleet membership one-hot), and
+                          no stale member is still claiming membership
+* ``trace_plane_coherent`` — at least one broker-served search rendered
+                          as ONE span tree with spans from BOTH
+                          processes (worker spans tagged ``proc``)
 """
 
 from __future__ import annotations
@@ -250,6 +257,95 @@ def check_plan_cache_effective(
         "plan_cache_effective",
         f"hit ratio {ratio:.2f} ({int(hits)}/{int(total)}), "
         f"cypher p99 {p99 * 1e3:.0f}ms over {len(oks)} ok requests")
+
+
+def check_fleet_metrics_present(metrics_text: str,
+                                expected_procs: list[str]
+                                ) -> InvariantResult:
+    """The federated /metrics must carry every live worker's exposition:
+    fleet membership one-hot at 1 per expected proc, at least one
+    proc-labeled worker family per member, and no UNEXPECTED proc still
+    claiming membership (a killed worker's stale segment must age out of
+    the merge, not flatline in it)."""
+    try:
+        fams = parse_prometheus(metrics_text)
+    except ValueError as e:
+        return failed("fleet_metrics_present", f"metrics unparseable: {e}")
+    members = fams.get("nornicdb_fleet_members")
+    if not members:
+        return failed("fleet_metrics_present",
+                      "nornicdb_fleet_members not exposed")
+    live = set()
+    for labels, v in members.items():
+        for lab in labels:
+            if lab.startswith("proc=") and v == 1.0:
+                live.add(lab[6:-1])
+    missing = [p for p in expected_procs if p not in live]
+    if missing:
+        return failed("fleet_metrics_present",
+                      f"workers missing from the merged scrape: {missing}")
+    stale = sorted(live - set(expected_procs) - {"primary"})
+    if stale:
+        return failed("fleet_metrics_present",
+                      f"stale members still in the merge: {stale}")
+    # a membership gauge alone is not federation: each worker's own
+    # families must be present under its proc label
+    worker_fam = fams.get("nornicdb_worker_requests_total", {})
+    federated = set()
+    for labels, _v in worker_fam.items():
+        for lab in labels:
+            if lab.startswith("proc="):
+                federated.add(lab[6:-1])
+    unfederated = [p for p in expected_procs if p not in federated]
+    if unfederated:
+        return failed(
+            "fleet_metrics_present",
+            f"no proc-labeled worker families for: {unfederated}")
+    return passed("fleet_metrics_present",
+                  f"all of {expected_procs} federated in the final scrape")
+
+
+def check_trace_plane_coherent(trace_details: list[dict]
+                               ) -> InvariantResult:
+    """At least one broker-served search must render as ONE tree with
+    spans from two processes: the shipped worker spans carry a ``proc``
+    tag, the primary's handler spans don't."""
+    scanned = 0
+    for detail in trace_details:
+        spans = detail.get("spans") or []
+        if not spans:
+            continue
+        scanned += 1
+        names = {s.get("name") for s in spans}
+        if "broker.search" not in names:
+            continue
+        worker_spans = [s for s in spans if s.get("proc")]
+        primary_spans = [s for s in spans if not s.get("proc")]
+        if not (worker_spans and primary_spans):
+            continue
+        if "worker.search" not in {s.get("name") for s in worker_spans}:
+            continue
+        # the primary handler must nest under a shipped worker span
+        # (one tree, not two forests sharing an id)
+        by_id = {s.get("span_id"): s for s in spans}
+        for s in primary_spans:
+            if s.get("name") != "broker.search":
+                continue
+            cur, seen = s, set()
+            while cur is not None and cur.get("span_id") not in seen:
+                seen.add(cur.get("span_id"))
+                if cur.get("proc"):
+                    return passed(
+                        "trace_plane_coherent",
+                        f"cross-process tree in trace "
+                        f"{detail.get('trace_id')} "
+                        f"({len(worker_spans)} worker + "
+                        f"{len(primary_spans)} primary spans)")
+                cur = by_id.get(cur.get("parent_id") or "")
+    return failed(
+        "trace_plane_coherent",
+        f"no broker-served search rendered a cross-process span tree "
+        f"({scanned} traces scanned)")
 
 
 def check_chaos_in_metrics(metrics_text: str,

@@ -47,6 +47,8 @@ def configure(
     trace_buffer=None,
     slow_query_ms=None,
     slow_buffer=None,
+    fleet_staleness_s=None,
+    profile_max_seconds=None,
 ) -> None:
     """Apply config-file / CLI settings to the process-global telemetry
     singletons (config.TelemetryConfig maps 1:1 onto these arguments)."""
@@ -59,3 +61,15 @@ def configure(
         threshold_s=None if slow_query_ms is None else slow_query_ms / 1000.0,
         capacity=slow_buffer,
     )
+    if fleet_staleness_s is not None:
+        from nornicdb_tpu.telemetry.federation import FLEET
+
+        FLEET.configure(staleness_s=fleet_staleness_s)
+    if profile_max_seconds is not None:
+        global profile_max_s
+        profile_max_s = float(profile_max_seconds)
+
+
+#: upper bound for POST /admin/profile?seconds=N captures (configurable
+#: via TelemetryConfig.profile_max_seconds)
+profile_max_s = 60.0

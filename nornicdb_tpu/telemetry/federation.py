@@ -1,0 +1,398 @@
+"""Cross-process metrics federation: one /metrics for the whole fleet.
+
+The prefork workers (server/workers.py) are separate processes with
+their own ``telemetry.REGISTRY`` — before this module their counters
+(broker round-trips, cache hits, shm-plane serves, 429 sheds) were
+invisible: the primary's /metrics knew nothing about them and the
+workers' own /metrics was a cached copy of the primary's.  The
+federation closes the loop with the machinery the read plane already
+proved (server/shm.py generation-stamped seqlock segments), flowing the
+OTHER direction:
+
+- Each worker runs a :class:`MetricsPublisher`: every ``interval``
+  seconds it renders its registry exposition (plus its slow-query ring)
+  into a per-worker shm segment.
+- The primary's :class:`FleetCollector` (the ``FLEET`` singleton) maps
+  every registered worker segment at scrape time, drops stale ones
+  (dead worker, publisher wedged — staleness is wall-clock because
+  monotonic clocks are not comparable across processes), and
+  structurally merges the live expositions into the primary's: every
+  worker sample gains a ``proc`` label (``http-worker-N`` /
+  ``grpc-worker-N``), families are grouped so TYPE renders once, and
+  the merged text still passes the strict parser
+  (telemetry/promparse.py) — asserted by tests and the CI smoke.
+
+Worker-side instrumentation families (``nornicdb_worker_*``) live here
+too so the tested docs/observability.md catalog renders them in every
+process (server/http.py imports this module for exactly that reason).
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import threading
+import time
+from typing import Any, Optional
+
+import numpy as np
+
+from nornicdb_tpu.telemetry.metrics import REGISTRY as _REGISTRY
+from nornicdb_tpu.telemetry.promparse import parse_exposition
+from nornicdb_tpu.telemetry.slowlog import slow_log as _slow_log
+
+log = logging.getLogger(__name__)
+
+FLEET_SEGMENT = "fleet"
+
+# -- worker-side serving-ladder families (rendered with a proc label once
+#    federated; registered here so the catalog renders in every process)
+WORKER_REQUESTS = _REGISTRY.counter(
+    "nornicdb_worker_requests_total",
+    "Worker frontend requests by how they were served "
+    "(cache hit / device broker / shm read plane / proxy / shed)",
+    labels=("served",),
+)
+for _served in ("cache", "broker", "shm", "proxy", "limited", "error"):
+    WORKER_REQUESTS.labels(_served)
+WORKER_BROKER_RTT = _REGISTRY.histogram(
+    "nornicdb_worker_broker_roundtrip_seconds",
+    "Worker-side device-broker call round trip (encode + socket + fused "
+    "dispatch + decode)",
+)
+# -- primary-side fleet families
+FLEET_MEMBERS = _REGISTRY.gauge(
+    "nornicdb_fleet_members",
+    "Live fleet members by process (1 = exposition merged this scrape)",
+    labels=("proc",),
+)
+FLEET_MEMBERS.labels("primary").set(1.0)
+FLEET_AGE = _REGISTRY.gauge(
+    "nornicdb_fleet_exposition_age_seconds",
+    "Age of each worker's last published exposition at scrape time",
+    labels=("proc",),
+)
+FLEET_STALE_DROPS = _REGISTRY.counter(
+    "nornicdb_fleet_stale_drops_total",
+    "Worker expositions dropped from a merge because the segment was "
+    "stale (dead worker / wedged publisher)",
+)
+FLEET_MERGE_ERRORS = _REGISTRY.counter(
+    "nornicdb_fleet_merge_errors_total",
+    "Worker expositions skipped because they failed the strict parse",
+)
+
+
+class MetricsPublisher:
+    """Worker-side: publish this process's exposition + slow-query ring
+    into a generation-stamped shm segment every ``interval`` seconds."""
+
+    def __init__(self, prefix: str, proc: str, interval: float = 0.5,
+                 registry=None):
+        from nornicdb_tpu.server.shm import SegmentWriter
+
+        self.proc = proc
+        self.interval = interval
+        self.registry = registry if registry is not None else _REGISTRY
+        self._writer = SegmentWriter(prefix, FLEET_SEGMENT)
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self.publishes = 0
+        self.errors = 0
+
+    def publish_now(self) -> None:
+        text = self.registry.render_prometheus()
+        arrays = {
+            "exposition": np.frombuffer(text.encode(), np.uint8).copy()
+            if text else np.zeros(0, np.uint8),
+        }
+        meta = {
+            "proc": self.proc,
+            "pid": os.getpid(),
+            # wall clock ON PURPOSE: the collector compares this stamp
+            # across processes, where monotonic clocks share no epoch
+            "ts": time.time(),  # nornlint: disable=NL-TM01
+            "slow_queries": _slow_log.snapshot(limit=32),
+            "slow_recorded": _slow_log.recorded,
+        }
+        self._writer.publish(arrays, meta)
+        self.publishes += 1
+
+    def _loop(self) -> None:
+        while not self._stop.wait(self.interval):
+            try:
+                self.publish_now()
+            except Exception:
+                self.errors += 1
+                log.exception("fleet metrics publish failed")
+
+    def start(self) -> "MetricsPublisher":
+        if self._thread is None:
+            try:
+                self.publish_now()
+            except Exception:
+                self.errors += 1
+                log.exception("initial fleet metrics publish failed")
+            self._thread = threading.Thread(
+                target=self._loop, name="nornicdb-fleet-metrics",
+                daemon=True,
+            )
+            self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        t = self._thread
+        if t is not None:
+            t.join(timeout=5)
+            self._thread = None
+        self._writer.close()
+
+
+class WorkerExposition:
+    """One live worker's collected exposition."""
+
+    __slots__ = ("proc", "text", "slow_queries", "slow_recorded", "age",
+                 "generation", "pid")
+
+    def __init__(self, proc, text, slow_queries, slow_recorded, age,
+                 generation, pid):
+        self.proc = proc
+        self.text = text
+        self.slow_queries = slow_queries
+        self.slow_recorded = slow_recorded
+        self.age = age
+        self.generation = generation
+        self.pid = pid
+
+
+class FleetCollector:
+    """Primary-side: registered worker segments → merged exposition.
+
+    ``register``/``unregister`` are driven by the WorkerPool lifecycle;
+    a registered-but-never-published segment (worker still booting) and
+    a stale segment (worker dead, publisher wedged) are both skipped —
+    the merge only ever carries expositions fresher than
+    ``staleness_s``, so a killed worker's numbers age out of /metrics
+    instead of flatlining forever."""
+
+    def __init__(self, staleness_s: float = 10.0):
+        self.staleness_s = staleness_s
+        self._lock = threading.Lock()
+        # proc -> (prefix, SegmentReader-or-None lazily)
+        self._members: dict[str, dict[str, Any]] = {}
+        self.stale_drops = 0
+        self.merges = 0
+
+    def configure(self, staleness_s: Optional[float] = None) -> None:
+        if staleness_s is not None:
+            self.staleness_s = float(staleness_s)
+
+    def register(self, proc: str, prefix: str) -> None:
+        with self._lock:
+            old = self._members.pop(proc, None)
+            self._members[proc] = {"prefix": prefix, "reader": None}
+        if old is not None and old.get("reader") is not None:
+            old["reader"].close()
+
+    def unregister(self, proc: str, prefix: Optional[str] = None) -> None:
+        """Drop a member; with ``prefix`` given, only when it still maps
+        to that prefix — a stopping pool must not evict a newer pool's
+        registration under the same proc name."""
+        with self._lock:
+            member = self._members.get(proc)
+            if member is None:
+                return
+            if prefix is not None and member["prefix"] != prefix:
+                return
+            self._members.pop(proc, None)
+        # the membership one-hot must drop with the member: a stopped
+        # pool's workers must not flatline as live forever
+        FLEET_MEMBERS.labels(proc).set(0.0)
+        FLEET_AGE.labels(proc).set(0.0)
+        if member.get("reader") is not None:
+            member["reader"].close()
+
+    def members(self) -> list[str]:
+        with self._lock:
+            return list(self._members)
+
+    def _reader(self, member: dict[str, Any]):
+        from nornicdb_tpu.server.shm import SegmentReader
+
+        with self._lock:  # concurrent scrapes must share one reader
+            if member["reader"] is None:
+                member["reader"] = SegmentReader(member["prefix"],
+                                                 FLEET_SEGMENT)
+            return member["reader"]
+
+    def collect(self, count_stale: bool = True) -> list[WorkerExposition]:
+        """Map every registered segment; skip unpublished/stale ones and
+        refresh the fleet gauges.  ``count_stale=False`` for the
+        structured read paths (/admin/stats, /admin/slow-queries): the
+        stale-drop counter must mean "dropped from a /metrics merge",
+        not "a dashboard polled stats while a worker was down"."""
+        from nornicdb_tpu.server.shm import SegmentUnavailable
+
+        with self._lock:
+            members = list(self._members.items())
+        out: list[WorkerExposition] = []
+        now = time.time()  # nornlint: disable=NL-TM01  (cross-process)
+        for proc, member in members:
+            try:
+                snap = self._reader(member).snapshot()
+            except SegmentUnavailable:
+                FLEET_MEMBERS.labels(proc).set(0.0)
+                continue
+            except Exception:
+                log.debug("fleet segment read failed: %s", proc,
+                          exc_info=True)
+                FLEET_MEMBERS.labels(proc).set(0.0)
+                continue
+            # wall-clock delta ON PURPOSE: the stamp comes from another
+            # process, where monotonic clocks share no epoch
+            age = max(  # nornlint: disable=NL-TM01
+                0.0, now - float(snap.meta.get("ts", 0.0)))
+            FLEET_AGE.labels(proc).set(age)
+            if age > self.staleness_s:
+                if count_stale:
+                    self.stale_drops += 1
+                    FLEET_STALE_DROPS.inc()
+                FLEET_MEMBERS.labels(proc).set(0.0)
+                continue
+            FLEET_MEMBERS.labels(proc).set(1.0)
+            expo = snap.arrays.get("exposition")
+            text = expo.tobytes().decode("utf-8", "replace") \
+                if expo is not None and expo.size else ""
+            out.append(WorkerExposition(
+                proc=str(snap.meta.get("proc", proc)),
+                text=text,
+                slow_queries=snap.meta.get("slow_queries") or [],
+                slow_recorded=int(snap.meta.get("slow_recorded", 0)),
+                age=age,
+                generation=snap.generation,
+                pid=int(snap.meta.get("pid", 0)),
+            ))
+        return out
+
+    # -- merging -----------------------------------------------------------
+    def merged_exposition(self, primary) -> str:
+        """The federated /metrics body: the primary's exposition with
+        every live worker's families spliced in under a ``proc`` label.
+        With no registered members this is the identity function — the
+        single-process exposition is byte-identical to before.
+
+        ``primary`` may be the rendered text or a zero-arg render
+        callable; pass the callable so the fleet gauges this collect
+        refreshes land in the SAME scrape, not the next one."""
+        with self._lock:
+            have_members = bool(self._members)
+        if not have_members:
+            return primary() if callable(primary) else primary
+        workers = self.collect()
+        self.merges += 1
+        text = primary() if callable(primary) else primary
+        if not workers:
+            return text
+        return merge_expositions(text, workers)
+
+    def slow_queries(self) -> list[dict[str, Any]]:
+        """Worker slow-query entries (each tagged with its proc) for the
+        merged /admin/slow-queries view."""
+        out: list[dict[str, Any]] = []
+        for w in self.collect(count_stale=False):
+            for entry in w.slow_queries:
+                if isinstance(entry, dict):
+                    e = dict(entry)
+                    e["proc"] = w.proc
+                    out.append(e)
+        return out
+
+    def stats(self) -> dict[str, Any]:
+        """The /admin/stats ``fleet`` section's federation half."""
+        workers = {}
+        for w in self.collect(count_stale=False):
+            workers[w.proc] = {
+                "fresh": True,
+                "age_s": round(w.age, 3),
+                "generation": w.generation,
+                "pid": w.pid,
+                "slow_queries_recorded": w.slow_recorded,
+            }
+        with self._lock:
+            for proc in self._members:
+                if proc not in workers:
+                    workers[proc] = {"fresh": False}
+        return {
+            "members": workers,
+            "staleness_s": self.staleness_s,
+            "stale_drops": self.stale_drops,
+            "merges": self.merges,
+        }
+
+
+def merge_expositions(primary_text: str, workers) -> str:
+    """Structural merge: group every family once (TYPE-once invariant),
+    primary samples verbatim, worker samples with ``proc="<name>"``
+    appended.  A worker exposition that fails the strict structural
+    parse is skipped and counted — never spliced in broken."""
+    try:
+        fams = parse_exposition(primary_text)
+    except ValueError:
+        # the primary's own exposition must never fail; if it somehow
+        # does, serve it untouched rather than drop the scrape
+        log.exception("primary exposition failed structural parse")
+        return primary_text
+    # (family -> [(proc, FamilyBlock)]) for worker-only families, keyed
+    # in first-seen order after the primary's
+    extra_order: list[str] = []
+    extra: dict[str, list] = {}
+    appended: dict[str, list] = {}
+    for w in workers:
+        if not w.text:
+            continue
+        try:
+            wfams = parse_exposition(w.text)
+        except ValueError:
+            FLEET_MERGE_ERRORS.inc()
+            log.warning("worker %s exposition failed parse; skipped",
+                        w.proc)
+            continue
+        label = f'proc="{w.proc}"'
+        for name, fam in wfams.items():
+            if not fam.samples:
+                continue
+            if name.startswith("nornicdb_fleet_"):
+                # fleet-membership gauges are primary-side semantics; a
+                # worker's own (empty-collector) cells would only shadow
+                # them under a proc label
+                continue
+            if name in fams:
+                if fams[name].kind != fam.kind:
+                    FLEET_MERGE_ERRORS.inc()
+                    continue
+                appended.setdefault(name, []).append((label, fam))
+            else:
+                if name not in extra:
+                    extra_order.append(name)
+                    extra[name] = []
+                extra[name].append((label, fam))
+    out: list[str] = []
+    for name, fam in fams.items():
+        fam.render(out)
+        for label, wfam in appended.get(name, ()):
+            wfam.render_samples_only(out, label)
+    for name in extra_order:
+        first = True
+        for label, wfam in extra[name]:
+            if first:
+                wfam.render(out, label)
+                first = False
+            else:
+                wfam.render_samples_only(out, label)
+    return "\n".join(out) + ("\n" if out else "")
+
+
+#: process-global collector (the primary's WorkerPool registers into it;
+#: /metrics merges through it)
+FLEET = FleetCollector()

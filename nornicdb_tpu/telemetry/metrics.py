@@ -306,6 +306,11 @@ class Registry:
         self._family_callbacks: dict[
             str, Callable[[], list[tuple[str, str, str, float]]]
         ] = {}
+        # key -> fn(), invoked BEFORE families render: refresh hooks for
+        # labeled gauge families whose values are derived at scrape time
+        # (the deviceprof HBM residency collector) — the family itself
+        # renders through the normal path afterwards
+        self._collect_hooks: dict[str, Callable[[], None]] = {}
 
     # -- family creation (idempotent: instrumentation sites may re-run) ----
     def _family(
@@ -386,6 +391,14 @@ class Registry:
         with self._lock:
             self._family_callbacks[key] = fn
 
+    def collect_hook(self, key: str, fn: Callable[[], None]) -> None:
+        """Register a refresh hook run at the START of every render —
+        for labeled families whose cell values are derived at scrape
+        time (a plain gauge_callback cannot carry labels).  Hooks must
+        be cheap and lock-light: they run on the scrape thread."""
+        with self._lock:
+            self._collect_hooks[key] = fn
+
     # -- rendering ----------------------------------------------------------
     def render_prometheus(self) -> str:
         out: list[str] = []
@@ -396,6 +409,14 @@ class Registry:
     def _render_into(self, out: list[str], seen: set[str]) -> None:
         if self.parent is not None:
             self.parent._render_into(out, seen)
+        with self._lock:
+            collect_hooks = list(self._collect_hooks.items())
+        for key, hook in collect_hooks:
+            try:
+                hook()
+            except Exception:
+                # a dead refresher must not take the exposition down
+                log.debug("collect hook %s failed", key, exc_info=True)
         with self._lock:
             families = sorted(self._families.items())
             callbacks = sorted(self._callbacks.items())

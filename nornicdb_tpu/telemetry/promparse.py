@@ -1,0 +1,200 @@
+"""Prometheus text-exposition parsing: the one strict reader.
+
+Two consumers, one grammar:
+
+- :func:`parse_prometheus_strict` — the PR 5 validation parser (moved
+  here from tests/test_telemetry.py so the CI smoke script and the fleet
+  federation tests share it): TYPE declared exactly once per family and
+  before its samples, label escaping round-trips, histogram families
+  carry cumulative ``_bucket`` series whose ``+Inf`` equals ``_count``.
+  Raises :class:`ValueError` on any violation.
+- :func:`parse_exposition` — the structural parser the cross-process
+  metrics federation (telemetry/federation.py) merges worker expositions
+  with: it keeps families in first-seen order with their HELP/TYPE
+  comments and raw sample triples so a merged exposition re-renders
+  byte-faithfully (modulo the injected ``proc`` label).
+
+Stdlib-only, import-light (telemetry package contract).
+"""
+
+from __future__ import annotations
+
+import re
+from dataclasses import dataclass, field
+
+SAMPLE_RE = re.compile(
+    r'^([a-zA-Z_:][a-zA-Z0-9_:]*)(\{(.*)\})?\s+'
+    r'(-?[0-9.e+\-]+|\+Inf|-Inf|NaN)$'
+)
+LABEL_PAIR_RE = re.compile(r'([a-zA-Z_][a-zA-Z0-9_]*)="((?:[^"\\]|\\.)*)"')
+
+_KINDS = ("counter", "gauge", "histogram", "summary", "untyped")
+_HIST_SUFFIXES = ("_bucket", "_sum", "_count")
+
+
+@dataclass
+class FamilyBlock:
+    """One metric family as parsed text: identity + raw sample lines.
+
+    ``samples`` holds ``(name, labelstr, value)`` triples — ``labelstr``
+    is the raw inside-the-braces text (no braces; empty for unlabeled
+    samples) so re-rendering preserves the producer's exact escaping."""
+
+    name: str
+    kind: str
+    help: str = ""
+    samples: list[tuple[str, str, str]] = field(default_factory=list)
+
+    def render(self, out: list[str], extra_label: str = "") -> None:
+        """Append this family's lines; ``extra_label`` (e.g.
+        ``proc="http-worker-0"``) is injected into every sample."""
+        if self.help:
+            out.append(f"# HELP {self.name} {self.help}")
+        out.append(f"# TYPE {self.name} {self.kind}")
+        self.render_samples_only(out, extra_label)
+
+    def render_samples_only(self, out: list[str],
+                            extra_label: str = "") -> None:
+        """Samples without HELP/TYPE — for appending a second producer's
+        cells to a family already declared in the output."""
+        extra_name = extra_label.split("=", 1)[0] if extra_label else ""
+        for name, labelstr, value in self.samples:
+            labels = labelstr
+            if extra_label:
+                if extra_name and f'{extra_name}="' in labelstr:
+                    # the producer already carries this label (e.g. a
+                    # re-federated exposition): drop the stale pair so
+                    # the injected identity wins and names stay unique
+                    pairs = [p for p in LABEL_PAIR_RE.findall(labelstr)
+                             if p[0] != extra_name]
+                    labelstr = ",".join(f'{k}="{v}"' for k, v in pairs)
+                labels = (f"{labelstr},{extra_label}" if labelstr
+                          else extra_label)
+            if labels:
+                out.append(f"{name}{{{labels}}} {value}")
+            else:
+                out.append(f"{name} {value}")
+
+
+def _family_of(name: str, types: dict[str, str]) -> str:
+    """Resolve a sample name to its family (histogram suffix folding)."""
+    if name in types:
+        return name
+    for suffix in _HIST_SUFFIXES:
+        if name.endswith(suffix) and name[: -len(suffix)] in types:
+            return name[: -len(suffix)]
+    return name
+
+
+def parse_exposition(text: str) -> dict[str, FamilyBlock]:
+    """Structural parse preserving family order and raw sample text.
+
+    Raises ValueError on malformed lines, duplicate TYPE declarations,
+    or samples without a preceding TYPE — the federation merge must
+    never splice an unparseable worker exposition into /metrics."""
+    fams: dict[str, FamilyBlock] = {}
+    helps: dict[str, str] = {}
+    types: dict[str, str] = {}
+    for line in text.splitlines():
+        if not line.strip():
+            continue
+        if line.startswith("# HELP "):
+            name, _, help_ = line[len("# HELP "):].partition(" ")
+            helps[name] = help_
+            continue
+        if line.startswith("# TYPE "):
+            name, _, kind = line[len("# TYPE "):].partition(" ")
+            if name in types:
+                raise ValueError(f"TYPE for {name} declared twice")
+            if kind not in _KINDS:
+                raise ValueError(f"unknown TYPE kind: {line!r}")
+            types[name] = kind
+            fams[name] = FamilyBlock(name, kind, helps.get(name, ""))
+            continue
+        if line.startswith("#"):
+            raise ValueError(f"unknown comment line: {line!r}")
+        m = SAMPLE_RE.match(line)
+        if not m:
+            raise ValueError(f"unparseable sample line: {line!r}")
+        name, _, labelstr, value = m.groups()
+        base = _family_of(name, types)
+        fam = fams.get(base)
+        if fam is None:
+            raise ValueError(f"sample {name} has no TYPE declaration")
+        fam.samples.append((name, labelstr or "", value))
+    return fams
+
+
+def parse_prometheus_strict(
+    text: str,
+) -> tuple[dict[str, str], list[tuple[str, dict, float]]]:
+    """Strict text-exposition reader (the PR 5 golden-test parser):
+    TYPE declared exactly once per family and before its samples; samples
+    parse; label escaping round-trips; histogram families carry
+    cumulative ``_bucket`` series with a trailing ``+Inf`` equal to
+    ``_count``.  Returns ``(types, samples)``; raises ValueError on any
+    violation."""
+    types: dict[str, str] = {}
+    samples: list[tuple[str, dict, float]] = []
+    for line in text.splitlines():
+        if not line.strip():
+            continue
+        if line.startswith("# HELP "):
+            continue
+        if line.startswith("# TYPE "):
+            _, _, rest = line.partition("# TYPE ")
+            name, _, kind = rest.partition(" ")
+            if name in types:
+                raise ValueError(f"TYPE for {name} declared twice")
+            if kind not in ("counter", "gauge", "histogram", "summary"):
+                raise ValueError(f"bad TYPE line: {line!r}")
+            types[name] = kind
+            continue
+        if line.startswith("#"):
+            raise ValueError(f"unknown comment line: {line!r}")
+        m = SAMPLE_RE.match(line)
+        if not m:
+            raise ValueError(f"unparseable sample line: {line!r}")
+        name, _, labelstr, value = m.groups()
+        labels = dict(LABEL_PAIR_RE.findall(labelstr or ""))
+        if labelstr:
+            reconstructed = ",".join(
+                f'{k}="{v}"' for k, v in LABEL_PAIR_RE.findall(labelstr)
+            )
+            if reconstructed != labelstr:
+                raise ValueError(f"bad label escaping: {line!r}")
+        samples.append((name, labels, float(value)))
+    # every sample belongs to a declared family
+    for name, labels, _ in samples:
+        base = _family_of(name, types)
+        if base not in types:
+            raise ValueError(f"sample {name} has no TYPE declaration")
+        if base != name and types[base] != "histogram":
+            raise ValueError(
+                f"suffixed sample {name} on non-histogram family {base}"
+            )
+    # histogram triple consistency (per non-le labelset)
+    hist_names = [n for n, k in types.items() if k == "histogram"]
+    for hname in hist_names:
+        series: dict[tuple, list[tuple[float, float]]] = {}
+        counts: dict[tuple, float] = {}
+        for name, labels, value in samples:
+            key = tuple(sorted(
+                (k, v) for k, v in labels.items() if k != "le"
+            ))
+            if name == f"{hname}_bucket":
+                series.setdefault(key, []).append(
+                    (float(labels["le"]), value)
+                )
+            elif name == f"{hname}_count":
+                counts[key] = value
+        for key, buckets in series.items():
+            buckets.sort(key=lambda b: b[0])
+            cum = [c for _, c in buckets]
+            if cum != sorted(cum):
+                raise ValueError(f"{hname} buckets not cumulative")
+            if buckets[-1][0] != float("inf"):
+                raise ValueError(f"{hname} missing +Inf bucket")
+            if key not in counts or buckets[-1][1] != counts[key]:
+                raise ValueError(f"{hname} +Inf bucket != _count")
+    return types, samples

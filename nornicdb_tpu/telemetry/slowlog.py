@@ -148,6 +148,7 @@ class SlowQueryLog:
         trace_spans: Optional[list[dict]] = None,
         trace_id: Optional[str] = None,
         columnar: Optional[dict[str, Any]] = None,
+        served: Optional[str] = None,
     ) -> bool:
         if not self.enabled or duration_s < self.threshold_s:
             return False
@@ -182,6 +183,11 @@ class SlowQueryLog:
             # render lifted literals as §N placeholders)
             "columnar": columnar,
         }
+        if served is not None:
+            # served-path attribution for worker-side vector searches
+            # (broker / shm / proxy — the serving-ladder step that
+            # actually answered)
+            entry["served"] = served
         self._ring.append(entry)  # deque.append: atomic under the GIL
         self.recorded += 1
         return True

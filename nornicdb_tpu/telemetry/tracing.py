@@ -304,6 +304,62 @@ class Tracer:
         cur = self._var.get()
         return None if cur is None else cur.trace.trace_id
 
+    # -- cross-process merge ------------------------------------------------
+    def merge_remote(
+        self,
+        trace_id: str,
+        spans: list[dict],
+        root: Optional[str] = None,
+        started: Optional[float] = None,
+        duration_ms: Optional[float] = None,
+        proc: Optional[str] = None,
+    ) -> bool:
+        """Merge span records exported by ANOTHER process (a prefork
+        worker shipping its finished trace over the device broker) into
+        the local ring, so ``/admin/traces/<id>`` renders one tree
+        spanning both processes.
+
+        Spans keep their own ``span_id``/``parent_id`` identities — the
+        worker's traceparent hand-off means local spans already point at
+        the remote caller's span id, so the tree builder nests them
+        without any re-parenting.  Each merged record is tagged with the
+        originating ``proc`` so the tree says which process ran what.
+        Records land in the NEWEST ring entry with this trace id, or a
+        fresh entry when the local process never recorded one (e.g. a
+        shm-served worker search that never touched the primary)."""
+        if not self.enabled or not trace_id:
+            return False
+        clean: list[dict[str, Any]] = []
+        for rec in list(spans)[:MAX_SPANS_PER_TRACE]:
+            if not isinstance(rec, dict) or not rec.get("span_id"):
+                continue
+            r = dict(rec)
+            if proc:
+                r["proc"] = proc
+            clean.append(r)
+        if not clean:
+            return False
+        found = None
+        # snapshot: iterating the live deque races root-span finishes
+        for t in list(self._ring):
+            if t["trace_id"] == trace_id:
+                found = t  # latest entry with this id wins
+        if found is not None:
+            found["spans"].extend(clean)  # list.extend: atomic under GIL
+            return True
+        self._ring.append({
+            "trace_id": trace_id,
+            "root": root or (clean[0].get("name") or "remote"),
+            "started": started if started is not None
+            else (clean[0].get("start") or time.time()),
+            "duration_ms": duration_ms if duration_ms is not None
+            else max((s.get("duration_ms") or 0.0) for s in clean),
+            "spans": clean,
+            "dropped_spans": 0,
+            "remote_parent": None,
+        })
+        return True
+
     # -- ring buffer -------------------------------------------------------
     def _finish(self, trace: _Trace, root_name: str, duration: float) -> None:
         self._ring.append({
@@ -336,30 +392,48 @@ class Tracer:
 
     def trace(self, trace_id: str) -> Optional[dict[str, Any]]:
         """Full span tree for /admin/traces/<id> (children nested under
-        parents; spans with a missing parent surface at the top level)."""
-        found = None
+        parents; spans with a missing parent surface at the top level).
+
+        A trace id may own SEVERAL ring entries — a worker's root and the
+        broker handler continuing it in-process, or a replication peer's
+        handler entries — so the detail view merges every matching
+        entry's spans (deduped by span id) into one tree; identity
+        fields come from the latest entry, preserving the old
+        single-entry behavior."""
         # snapshot first: iterating the live deque would raise if another
         # thread's root span finishes (ring append) mid-scan
-        for t in list(self._ring):
-            if t["trace_id"] == trace_id:
-                found = t  # keep scanning: latest trace with this id wins
-        if found is None:
+        matches = [t for t in list(self._ring)
+                   if t["trace_id"] == trace_id]
+        if not matches:
             return None
-        spans = list(found["spans"])
+        found = matches[-1]  # latest entry wins the identity fields
+        if len(matches) == 1:
+            spans = list(found["spans"])
+        else:
+            seen_ids: set = set()
+            spans = []
+            for t in matches:
+                for rec in list(t["spans"]):
+                    sid = rec.get("span_id")
+                    if sid in seen_ids:
+                        continue
+                    seen_ids.add(sid)
+                    spans.append(rec)
         nodes = {
             rec["span_id"]: dict(rec, children=[]) for rec in spans
         }
         roots = []
         for rec in spans:
             node = nodes[rec["span_id"]]
-            parent = nodes.get(rec["parent_id"] or "")
+            # .get(): remote-merged records may omit parent_id entirely
+            parent = nodes.get(rec.get("parent_id") or "")
             if parent is not None and parent is not node:
                 parent["children"].append(node)
             else:
                 roots.append(node)
         for node in nodes.values():
-            node["children"].sort(key=lambda n: n["start"])
-        roots.sort(key=lambda n: n["start"])
+            node["children"].sort(key=lambda n: n.get("start", 0.0))
+        roots.sort(key=lambda n: n.get("start", 0.0))
         return {
             "trace_id": found["trace_id"],
             "root": found["root"],

@@ -2,6 +2,12 @@
 """CI telemetry smoke: boot a live server, drive one traced request, then
 curl /metrics and /admin/traces and fail on non-200 or empty payloads.
 
+Phase 2 (fleet, multi-core runners only): boot the same stack with a
+2-worker prefork pool, drive a broker-served vector search, and assert
+the FEDERATED exposition — worker families present under ``proc``
+labels, ``nornicdb_hbm_bytes`` components rendered — strict-parsed with
+the PR 5 Prometheus parser (telemetry/promparse.py).
+
 Run: JAX_PLATFORMS=cpu python scripts/telemetry_smoke.py
 Exit 0 = healthy; any other exit fails the CI step.
 
@@ -17,6 +23,7 @@ import os
 import shutil
 import subprocess
 import sys
+import urllib.error
 import urllib.request
 
 # runnable from a checkout without an editable install
@@ -89,6 +96,125 @@ def main() -> int:
             print(f"SMOKE FAIL: {f}", file=sys.stderr)
         return 1
     print("telemetry smoke ok: /metrics + /admin/traces + /admin/slow-queries")
+    if os.cpu_count() and os.cpu_count() > 1:
+        return fleet_smoke()
+    print("fleet smoke skipped: single-core runner")
+    return 0
+
+
+def fleet_smoke() -> int:
+    """Phase 2: 2-worker pool, broker-served search, federated /metrics
+    strict-parsed with proc-labeled worker families present."""
+    import time
+
+    import numpy as np
+
+    import nornicdb_tpu
+    from nornicdb_tpu.embed.base import HashEmbedder
+    from nornicdb_tpu.server.http import HttpServer
+    from nornicdb_tpu.server.workers import WorkerPool
+    from nornicdb_tpu.telemetry.promparse import parse_prometheus_strict
+
+    db = nornicdb_tpu.open_db("")
+    db.set_embedder(HashEmbedder(64))
+    for i in range(16):
+        db.store(f"fleet smoke document {i}")
+    db.process_pending_embeddings()
+    server = HttpServer(db, port=0)
+    server.start()
+    pool = WorkerPool(db, server.port, n_workers=2,
+                      metrics_interval=0.2).start()
+    base = f"http://127.0.0.1:{server.port}"
+    failures: list[str] = []
+    try:
+        deadline = time.time() + 60
+        up = False
+        while time.time() < deadline:
+            try:
+                urllib.request.urlopen(
+                    f"http://127.0.0.1:{pool.port}/health", timeout=5)
+                up = True
+                break
+            except OSError:
+                time.sleep(0.25)
+        if not up:
+            failures.append("workers never started listening")
+        rng = np.random.default_rng(0)
+        served = ""
+        while up and time.time() < deadline:
+            req = urllib.request.Request(
+                f"http://127.0.0.1:{pool.port}/nornicdb/search",
+                data=json.dumps({
+                    "vector": [float(x) for x in rng.normal(size=64)],
+                    "limit": 3,
+                }).encode(),
+                headers={"Content-Type": "application/json"},
+            )
+            with urllib.request.urlopen(req, timeout=30) as resp:
+                served = resp.headers.get("X-Nornic-Served", "")
+            if served == "broker":
+                break
+            time.sleep(0.1)
+        if served != "broker":
+            failures.append(
+                f"no broker-served vector search (last: {served!r})")
+        text = ""
+        while time.time() < deadline:
+            code, body = fetch(base + "/metrics")
+            if code != 200:
+                failures.append(f"federated /metrics -> {code}")
+                break
+            text = body.decode()
+            if ('proc="http-worker-0"' in text
+                    and 'proc="http-worker-1"' in text):
+                break
+            time.sleep(0.25)
+        if 'proc="http-worker-0"' not in text or \
+                'proc="http-worker-1"' not in text:
+            failures.append(
+                "worker proc labels never appeared in the federation")
+        else:
+            try:
+                types, samples = parse_prometheus_strict(text)
+            except ValueError as e:
+                failures.append(f"federated exposition not strict: {e}")
+            else:
+                if not any(n == "nornicdb_worker_requests_total"
+                           and l.get("proc", "").startswith("http-worker-")
+                           for n, l, _v in samples):
+                    failures.append(
+                        "no proc-labeled worker family in the merge")
+                if "nornicdb_hbm_bytes" not in types:
+                    failures.append("nornicdb_hbm_bytes not exposed")
+                elif not any(n == "nornicdb_hbm_bytes"
+                             and l.get("component") == "corpus_f32"
+                             and v > 0 for n, l, v in samples):
+                    failures.append(
+                        "nornicdb_hbm_bytes{component=corpus_f32} "
+                        "never moved off zero")
+        # on-demand device profiler: the capture must return a non-empty
+        # jax.profiler artifact (gzip magic)
+        req = urllib.request.Request(base + "/admin/profile?seconds=0.3",
+                                     data=b"", method="POST")
+        try:
+            with urllib.request.urlopen(req, timeout=60) as resp:
+                artifact = resp.read()
+            # urlopen raises HTTPError for non-2xx, so reaching here
+            # means 200 — only the body needs checking
+            if artifact[:2] != b"\x1f\x8b":
+                failures.append("/admin/profile artifact is not gzip")
+        except urllib.error.HTTPError as e:
+            failures.append(f"/admin/profile -> {e.code}")
+    finally:
+        pool.stop()
+        server.stop()
+        db.close()
+    if failures:
+        for f in failures:
+            print(f"FLEET SMOKE FAIL: {f}", file=sys.stderr)
+        return 1
+    print("fleet smoke ok: 2-worker federated /metrics strict-parsed "
+          "with proc-labeled worker families")
     return 0
 
 

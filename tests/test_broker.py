@@ -256,15 +256,63 @@ class TestBrokerTaxonomy:
         s = socket_mod.socket(socket_mod.AF_UNIX, socket_mod.SOCK_STREAM)
         s.connect(broker.path)
         payload = b"\xff" * 4  # undecodable SEARCH body
-        s.sendall(struct.pack("<IBQ", 9 + len(payload),
-                              broker_mod.MSG_SEARCH, 1) + payload)
+        # frame: u32 len | u8 type | u64 req_id | u8 tp_len | payload
+        s.sendall(struct.pack("<IBQB", 10 + len(payload),
+                              broker_mod.MSG_SEARCH, 1, 0) + payload)
         head = s.recv(4)
         (ln,) = struct.unpack("<I", head)
         body = b""
         while len(body) < ln:
             body += s.recv(ln - len(body))
-        assert body[9] == broker_mod.STATUS_ERROR
+        assert body[10] == broker_mod.STATUS_ERROR
         s.close()
+
+    def test_traced_search_continues_worker_trace(self, stack):
+        """A traceparent in the frame header makes the broker handler's
+        spans land under the caller's trace id (the cross-process hop)."""
+        from nornicdb_tpu.telemetry.tracing import tracer
+
+        _db, _broker, client, rng = stack
+        q = rng.normal(size=(1, 32)).astype(np.float32)
+        with tracer.start_trace("worker.search") as root:
+            client.search(q, k=3)
+            tid = root.trace_id
+        import time
+
+        deadline = time.monotonic() + 5
+        names: set = set()
+        while time.monotonic() < deadline:
+            entry = tracer.trace(tid)
+            names = ({s["name"] for s in entry["spans"]}
+                     if entry else set())
+            if "broker.search" in names and "search.batch" in names:
+                break
+            time.sleep(0.02)
+        assert "broker.search" in names, names
+        assert "search.batch" in names, names
+
+    def test_ship_spans_merges_remote_tree(self, stack):
+        """MSG_SPANS: a worker-shipped finished trace merges into the
+        primary ring tagged with its proc."""
+        from nornicdb_tpu.telemetry.tracing import tracer
+
+        _db, _broker, client, _rng = stack
+        entry = {
+            "trace_id": "fe" * 16,
+            "root": "worker.search",
+            "started": 1000.0,
+            "duration_ms": 4.2,
+            "spans": [{
+                "name": "worker.search", "span_id": "ab" * 8,
+                "parent_id": None, "start": 1000.0, "duration_ms": 4.2,
+            }],
+        }
+        client.ship_spans(entry, proc="http-worker-0")
+        merged = tracer.trace("fe" * 16)
+        assert merged is not None
+        rec = next(s for s in merged["spans"]
+                   if s["name"] == "worker.search")
+        assert rec["proc"] == "http-worker-0"
 
     def test_active_broker_stats_registry(self, stack):
         _db, broker, client, rng = stack

@@ -537,3 +537,49 @@ class TestGenServeConfig:
                 config=GenServeConfig(page_size=16, pool_pages=4,
                                       max_seq_tokens=256),
                 manager=_mgr())
+
+
+# ---------------------------------------------------------------------------
+# trace stitching (fleet telemetry plane): scheduler spans attach to the
+# submitting request's trace instead of floating detached
+# ---------------------------------------------------------------------------
+class TestTraceStitching:
+    def test_request_trace_carries_generation_path(self):
+        from nornicdb_tpu.telemetry.tracing import tracer
+
+        eng = _engine()
+        with tracer.start_trace("rag.answer") as root:
+            out = eng.generate(_prompt(12), max_new_tokens=4)
+        assert out
+        entry = tracer.trace(root.trace_id)
+        assert entry is not None
+        names = {s["name"] for s in entry["spans"]}
+        # admission decision + queue wait in the caller's trace, and the
+        # scheduler's prefill attached through the captured context
+        assert "genserve.admit" in names, names
+        assert "genserve.queue_wait" in names, names
+        assert "genserve.prefill" in names, names
+        # the batched decode step links the request's trace id
+        decode = [s for s in entry["spans"]
+                  if s["name"] == "genserve.decode"]
+        assert decode, names
+        assert root.trace_id in decode[0]["attrs"]["links"]
+
+    def test_eviction_lands_in_victim_trace(self):
+        from nornicdb_tpu.telemetry.tracing import tracer
+
+        # pool sized so two full-length sequences cannot coexist:
+        # max_seq_tokens 64 -> 4-page tables, 7 usable pages — the
+        # second sequence's growth must evict the first
+        eng = _engine(pool_pages=8, max_seq_tokens=64, max_seqs=2,
+                      deadline_ms=60000)
+        with tracer.start_trace("victim.request") as root:
+            h1 = eng.submit(_prompt(40, seed=1), max_new_tokens=24)
+            h2 = eng.submit(_prompt(40, seed=2), max_new_tokens=24)
+            h1.result(partial_ok=True)
+            h2.result(partial_ok=True)
+        if eng.stats.evictions == 0:
+            pytest.skip("pool pressure never forced an eviction")
+        entry = tracer.trace(root.trace_id)
+        names = {s["name"] for s in entry["spans"]}
+        assert "genserve.evicted" in names, names

@@ -430,75 +430,12 @@ class TestHttpTelemetry:
 
 
 # ------------------------------------------------------- golden exposition
-_SAMPLE_RE = re.compile(
-    r'^([a-zA-Z_:][a-zA-Z0-9_:]*)(\{(.*)\})?\s+(-?[0-9.e+\-]+|\+Inf|-Inf|NaN)$'
+# The strict parser is now library code (telemetry/promparse.py) shared
+# with the fleet federation merge and the CI smoke script — this suite
+# remains its golden consumer.
+from nornicdb_tpu.telemetry.promparse import (  # noqa: E402
+    parse_prometheus_strict,
 )
-_LABEL_PAIR_RE = re.compile(r'([a-zA-Z_][a-zA-Z0-9_]*)="((?:[^"\\]|\\.)*)"')
-
-
-def parse_prometheus_strict(text: str):
-    """Strict text-exposition reader: TYPE declared exactly once per family
-    and before its samples; samples parse; histogram families carry
-    cumulative _bucket series with a trailing +Inf equal to _count."""
-    types: dict[str, str] = {}
-    samples: list[tuple[str, dict, float]] = []
-    for line in text.splitlines():
-        if not line.strip():
-            continue
-        if line.startswith("# HELP "):
-            continue
-        if line.startswith("# TYPE "):
-            _, _, rest = line.partition("# TYPE ")
-            name, _, kind = rest.partition(" ")
-            assert name not in types, f"TYPE for {name} declared twice"
-            assert kind in ("counter", "gauge", "histogram", "summary"), line
-            types[name] = kind
-            continue
-        assert not line.startswith("#"), f"unknown comment line: {line}"
-        m = _SAMPLE_RE.match(line)
-        assert m, f"unparseable sample line: {line!r}"
-        name, _, labelstr, value = m.groups()
-        labels = dict(_LABEL_PAIR_RE.findall(labelstr or ""))
-        if labelstr:
-            reconstructed = ",".join(
-                f'{k}="{v}"' for k, v in _LABEL_PAIR_RE.findall(labelstr)
-            )
-            assert reconstructed == labelstr, f"bad label escaping: {line!r}"
-        samples.append((name, labels, float(value)))
-    # every sample belongs to a declared family
-    for name, labels, _ in samples:
-        base = name
-        for suffix in ("_bucket", "_sum", "_count"):
-            if name.endswith(suffix) and name[: -len(suffix)] in types:
-                base = name[: -len(suffix)]
-                break
-        assert base in types, f"sample {name} has no TYPE declaration"
-        if base != name:
-            assert types[base] == "histogram", name
-    # histogram triple consistency
-    hist_names = [n for n, k in types.items() if k == "histogram"]
-    for hname in hist_names:
-        series: dict[tuple, list[tuple[float, float]]] = {}
-        counts: dict[tuple, float] = {}
-        for name, labels, value in samples:
-            key = tuple(sorted(
-                (k, v) for k, v in labels.items() if k != "le"
-            ))
-            if name == f"{hname}_bucket":
-                series.setdefault(key, []).append(
-                    (float(labels["le"]), value)
-                )
-            elif name == f"{hname}_count":
-                counts[key] = value
-        for key, buckets in series.items():
-            buckets.sort(key=lambda b: b[0])
-            cum = [c for _, c in buckets]
-            assert cum == sorted(cum), f"{hname} buckets not cumulative"
-            assert buckets[-1][0] == float("inf"), f"{hname} missing +Inf"
-            assert key in counts and buckets[-1][1] == counts[key], (
-                f"{hname} +Inf bucket != _count"
-            )
-    return types, samples
 
 
 class TestPrometheusGolden:
