@@ -1,6 +1,6 @@
 # NornicDB-TPU (ref: the reference's Makefile test/build targets)
 
-.PHONY: test test-fast lint lint-baseline sanitize smoke chaos soak soak-ci soak-nornsan soak-multiworker bench bench-search bench-embed bench-generate bench-workers bench-cypher native e2e-bench clean
+.PHONY: test test-fast lint lint-baseline sanitize jitgate smoke chaos soak soak-ci soak-nornsan soak-multiworker bench bench-search bench-embed bench-generate bench-workers bench-cypher native e2e-bench clean
 
 test:
 	python -m pytest tests/ -q
@@ -14,6 +14,12 @@ lint-baseline:
 # runtime lock sanitizer over the threaded suites (docs/linting.md#nornsan)
 sanitize:
 	NORNSAN=1 python -m pytest tests/test_concurrency.py tests/test_replication.py tests/test_replication_scenarios.py tests/test_nornsan.py tests/test_adjacency.py tests/test_telemetry.py tests/test_backend.py tests/test_sharded_serving.py tests/test_int8_residency.py tests/test_ivf_tuner.py tests/test_serving.py tests/test_genserve.py tests/test_broker.py tests/test_shm_readplane.py tests/test_workers.py tests/test_columnar.py tests/test_fleet_telemetry.py -q -m 'not slow'
+
+# runtime recompile sentinel over the serving suites: every fresh XLA
+# compile is attributed to a (subsystem, kind, shape) key and any test
+# that compiles after its declared warmup fails (docs/linting.md#nornjit)
+jitgate:
+	NORNJIT=1 python -m pytest tests/test_serving.py tests/test_genserve.py tests/test_sharded_serving.py tests/test_nornjit.py -q -m 'not slow'
 
 # search/embed suite with the accelerator backend forced to hang: the
 # lifecycle manager must keep the stack serving from CPU (docs/backend.md)

@@ -514,6 +514,7 @@ class GenerationEngine:
         return self.submit(ids, max_new_tokens, deadline_ms).text()
 
     # -- scheduler ---------------------------------------------------------
+    # nornlint: thread-role=scheduler
     def _loop(self) -> None:
         while not self._stop.is_set():
             with self._cond:
@@ -842,7 +843,9 @@ class GenerationEngine:
                     qwen2.round_up_pow2(remaining, 16))
         piece = seq.prefill_tokens[seq.prefill_pos:seq.prefill_pos + chunk]
         n_valid = len(piece)
-        padded = piece + [0] * (chunk - n_valid)
+        # pad-then-truncate so the operand length is the pow2-bucketed
+        # `chunk` by construction, never the request-dependent n_valid
+        padded = (piece + [0] * chunk)[:chunk]
         t0 = time.perf_counter()
         params = self._active_params()
         self.programs.add(("prefill", chunk, self._table_width))
@@ -856,15 +859,25 @@ class GenerationEngine:
             with _tracer.attach(seq.trace_ctx):
                 with _tracer.span("genserve.prefill",
                                   {"chunk": chunk, "valid": n_valid}):
-                    logits, self._pages = qwen2.paged_prefill_chunk(
-                        params, self.cfg,
-                        jnp.asarray(padded, jnp.int32), self._pages,
-                        jnp.asarray(seq.page_table),
-                        jnp.asarray(seq.prefill_pos),
-                        jnp.asarray(n_valid))
+                    try:
+                        logits, self._pages = qwen2.paged_prefill_chunk(
+                            params, self.cfg,
+                            jnp.asarray(padded, jnp.int32), self._pages,
+                            jnp.asarray(seq.page_table),
+                            jnp.asarray(seq.prefill_pos),
+                            jnp.asarray(n_valid))
+                    except Exception:
+                        # the failing dispatch may have CONSUMED the
+                        # donated pool (donate_argnums): drop it at the
+                        # dispatch site so _ensure_pool rebuilds from
+                        # scratch, whatever the caller does (NL-JAX04)
+                        self._pages = None
+                        raise
                     # argmax ON DEVICE: only the winning token id crosses
                     # to host, never the (V,) logits row (and
-                    # intermediate chunks transfer nothing at all)
+                    # intermediate chunks transfer nothing at all) — a
+                    # deliberately bounded 4-byte sync, the step's output
+                    # nornlint: disable=NL-JAX06
                     tok = int(jnp.argmax(logits)) if final else None
         dt = time.perf_counter() - t0
         _stats.PREFILL_HIST.observe(dt)
@@ -892,6 +905,8 @@ class GenerationEngine:
         with self._platform_ctx():
             logits, seq.dense_cache = qwen2.prefill(
                 params, self.cfg, jnp.asarray([toks], jnp.int32), max_len)
+            # bounded sync: one token id, the prefill's output
+            # nornlint: disable=NL-JAX06
             tok = int(jnp.argmax(logits[0]))
         _stats.PREFILL_HIST.observe(time.perf_counter() - t0)
         self.stats.prefill_chunks += 1
@@ -978,12 +993,21 @@ class GenerationEngine:
             with _tracer.attach(leader_ctx):
                 with _tracer.span("genserve.decode",
                                   {"batch": b_real, "links": links}):
-                    logits, self._pages = qwen2.paged_decode_step(
-                        params, self.cfg, jnp.asarray(tokens),
-                        self._pages,
-                        jnp.asarray(tables), jnp.asarray(lengths))
+                    try:
+                        logits, self._pages = qwen2.paged_decode_step(
+                            params, self.cfg, jnp.asarray(tokens),
+                            self._pages,
+                            jnp.asarray(tables), jnp.asarray(lengths))
+                    except Exception:
+                        # failing step may have CONSUMED the donated
+                        # pool: drop it here so _ensure_pool rebuilds,
+                        # whatever the caller does (NL-JAX04)
+                        self._pages = None
+                        raise
                     # greedy argmax on device: (B,) ints cross to host,
-                    # not the (B, V) logits (~MBs/step at real vocabs)
+                    # not the (B, V) logits (~MBs/step at real vocabs) —
+                    # a bounded 4B-per-lane sync, the step's output
+                    # nornlint: disable=NL-JAX06
                     host = np.asarray(jnp.argmax(logits, axis=-1))
         dt = time.perf_counter() - t0
         _stats.DECODE_HIST.observe(dt)
@@ -1004,9 +1028,18 @@ class GenerationEngine:
         max_len = seq.dense_cache[0][0].shape[1]
         self.programs.add(("dense_step", max_len))
         with self._platform_ctx():
-            logits, seq.dense_cache = qwen2.decode_step(
-                params, self.cfg, jnp.asarray([seq.out[-1]], jnp.int32),
-                seq.dense_cache, jnp.asarray(seq.dense_len))
+            try:
+                logits, seq.dense_cache = qwen2.decode_step(
+                    params, self.cfg, jnp.asarray([seq.out[-1]], jnp.int32),
+                    seq.dense_cache, jnp.asarray(seq.dense_len))
+            except Exception:
+                # the donated per-sequence cache may be consumed: drop it
+                # so a requeue re-prefills instead of reading a poisoned
+                # buffer (NL-JAX04)
+                seq.dense_cache = None
+                raise
+            # bounded sync: one token id, the step's output
+            # nornlint: disable=NL-JAX06
             tok = int(jnp.argmax(logits[0]))
         _stats.DECODE_HIST.observe(time.perf_counter() - t0)
         self.stats.decode_steps += 1

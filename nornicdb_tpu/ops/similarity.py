@@ -1459,19 +1459,29 @@ class DeviceCorpus(HostCorpus):
         # requantization — the rows transfer once, not per consumer
         rows_dev = jnp.asarray(  # nornlint: disable=NL-DEV01
             rows, dtype=self.dtype)
-        patch = _patch_rows_donated if donate else _patch_rows
-        self._dev = patch(self._dev, rows_dev, start)
-        vpatch = _patch_valid_donated if donate else _patch_valid
-        self._dev_valid = vpatch(
-            self._dev_valid,
-            jnp.asarray(valid_rows),  # nornlint: disable=NL-DEV01
-            start,
-        )
-        if self.quantize and self._dev_i8 is not None:
-            qpatch = _patch_i8_donated if donate else _patch_i8
-            self._dev_i8 = qpatch(
-                self._dev_i8[0], self._dev_i8[1], rows_dev, start,
+        try:
+            patch = _patch_rows_donated if donate else _patch_rows
+            self._dev = patch(self._dev, rows_dev, start)
+            vpatch = _patch_valid_donated if donate else _patch_valid
+            self._dev_valid = vpatch(
+                self._dev_valid,
+                jnp.asarray(valid_rows),  # nornlint: disable=NL-DEV01
+                start,
             )
+            if self.quantize and self._dev_i8 is not None:
+                qpatch = _patch_i8_donated if donate else _patch_i8
+                self._dev_i8 = qpatch(
+                    self._dev_i8[0], self._dev_i8[1], rows_dev, start,
+                )
+        except Exception:
+            # a failing donated patch has CONSUMED an unknown subset of
+            # the resident buffers — drop them all so _device_ready()
+            # reports false and the next _sync rebuilds via _upload_full
+            # instead of patching a poisoned buffer (NL-JAX04)
+            self._dev = None
+            self._dev_valid = None
+            self._dev_i8 = None
+            raise
 
     def device_arrays(self) -> tuple[jax.Array, jax.Array]:
         """Legacy unguarded access to the resident buffers. Callers may hold

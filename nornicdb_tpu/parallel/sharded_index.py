@@ -461,41 +461,52 @@ class ShardedCorpus(HostCorpus):
         # lock; the dispatch lock is a leaf.
         start = np.int32(start_row)
         with _COLLECTIVE_DISPATCH_LOCK:
-            patch = _patch_rows_donated if donate else _patch_rows
-            vpatch = _patch_valid_donated if donate else _patch_valid
-            if self.quantized:
-                # requantize ONLY the patched rows on the host (per-row
-                # symmetric quantization is block-local by construction —
-                # the _requantize_rows contract of the single-device int8
-                # mirror) and patch codes + scales in place
-                codes, scales = quantize_rows_np(rows)
-                self._dev_i8 = (
-                    jax.device_put(  # nornlint: disable=NL-DEV01
-                        patch(self._dev_i8[0],
-                              jnp.asarray(codes),  # nornlint: disable=NL-DEV01
+            try:
+                patch = _patch_rows_donated if donate else _patch_rows
+                vpatch = _patch_valid_donated if donate else _patch_valid
+                if self.quantized:
+                    # requantize ONLY the patched rows on the host
+                    # (per-row symmetric quantization is block-local by
+                    # construction — the _requantize_rows contract of the
+                    # single-device int8 mirror) and patch codes + scales
+                    # in place
+                    codes, scales = quantize_rows_np(rows)
+                    self._dev_i8 = (
+                        jax.device_put(  # nornlint: disable=NL-DEV01
+                            patch(self._dev_i8[0],
+                                  jnp.asarray(codes),  # nornlint: disable=NL-DEV01
+                                  start),
+                            self._sharding,
+                        ),
+                        jax.device_put(  # nornlint: disable=NL-DEV01
+                            vpatch(self._dev_i8[1],
+                                   jnp.asarray(scales),  # nornlint: disable=NL-DEV01
+                                   start),
+                            self._vsharding,
+                        ),
+                    )
+                else:
+                    self._dev = jax.device_put(  # nornlint: disable=NL-DEV01
+                        patch(self._dev,
+                              jnp.asarray(rows, dtype=self.dtype),  # nornlint: disable=NL-DEV01
                               start),
                         self._sharding,
-                    ),
-                    jax.device_put(  # nornlint: disable=NL-DEV01
-                        vpatch(self._dev_i8[1],
-                               jnp.asarray(scales),  # nornlint: disable=NL-DEV01
-                               start),
-                        self._vsharding,
-                    ),
+                    )
+                self._dev_valid = jax.device_put(  # nornlint: disable=NL-DEV01
+                    vpatch(self._dev_valid,
+                           jnp.asarray(valid_rows),  # nornlint: disable=NL-DEV01
+                           start),
+                    self._vsharding,
                 )
-            else:
-                self._dev = jax.device_put(  # nornlint: disable=NL-DEV01
-                    patch(self._dev,
-                          jnp.asarray(rows, dtype=self.dtype),  # nornlint: disable=NL-DEV01
-                          start),
-                    self._sharding,
-                )
-            self._dev_valid = jax.device_put(  # nornlint: disable=NL-DEV01
-                vpatch(self._dev_valid,
-                       jnp.asarray(valid_rows),  # nornlint: disable=NL-DEV01
-                       start),
-                self._vsharding,
-            )
+            except Exception:
+                # a failing donated patch has CONSUMED an unknown subset
+                # of the sharded buffers — drop them all so
+                # _device_ready() reports false and the next _sync
+                # rebuilds via _upload_full (NL-JAX04)
+                self._dev = None
+                self._dev_valid = None
+                self._dev_i8 = None
+                raise
             # retire EVERY patch before releasing: the valid-mask patch is
             # its own collective program enqueued after the row patch — an
             # async collective still enqueueing while a search launches

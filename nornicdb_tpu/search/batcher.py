@@ -203,6 +203,7 @@ class QueryBatcher:
         if t is not None:
             t.join(timeout=5)
 
+    # nornlint: thread-role=dispatcher
     def _dispatch_loop(self) -> None:
         while True:
             with self._lock:

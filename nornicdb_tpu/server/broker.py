@@ -394,6 +394,7 @@ class DeviceBroker:
                 name="nornicdb-broker-conn", daemon=True,
             ).start()
 
+    # nornlint: thread-role=serve-loop
     def _serve_conn(self, conn: socket.socket) -> None:
         try:
             while not self._stop.is_set():

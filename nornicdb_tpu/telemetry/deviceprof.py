@@ -108,6 +108,33 @@ class DeviceProfiler:
         self._hbm_providers: dict[int, tuple] = {}
         self._capture_lock = threading.Lock()
         self.captures = 0
+        # observers see every record_compile/record_execute key on the
+        # RECORDING thread, before/around the dispatch it annotates —
+        # nornjit's compile sentinel attributes fresh XLA compiles to
+        # the last key announced on the compiling thread
+        self._observers: list[Callable[[str, str, str], None]] = []
+
+    def add_observer(self, fn: Callable[[str, str, str], None]) -> None:
+        """Register ``fn(subsystem, kind, shape)`` called synchronously
+        on every ledger record.  Observers must be cheap and must not
+        raise (failures are swallowed at notify time)."""
+        with self._lock:
+            if fn not in self._observers:
+                self._observers.append(fn)
+
+    def remove_observer(self, fn: Callable[[str, str, str], None]) -> None:
+        with self._lock:
+            try:
+                self._observers.remove(fn)
+            except ValueError:
+                pass
+
+    def _notify(self, key: tuple[str, str, str]) -> None:
+        for fn in list(self._observers):
+            try:
+                fn(*key)
+            except Exception:
+                log.debug("deviceprof observer failed", exc_info=True)
 
     # -- program ledger ----------------------------------------------------
     def record_compile(self, subsystem: str, kind: str, shape) -> None:
@@ -121,6 +148,7 @@ class DeviceProfiler:
             if entry.compiles == 0:
                 entry.compiles = 1
                 _PROGRAMS.labels(*key).inc()
+        self._notify(key)
 
     def record_execute(self, subsystem: str, kind: str, shape,
                        seconds: float) -> None:
@@ -137,6 +165,7 @@ class DeviceProfiler:
             entry.executes += 1
             entry.total_s += seconds
         _EXEC_HIST.labels(*key).observe(seconds)
+        self._notify(key)
 
     # -- HBM residency -----------------------------------------------------
     def register_hbm(self, owner, fn: Callable[[object], dict]) -> None:

@@ -1,0 +1,250 @@
+"""nornjit — runtime recompile sentinel for NornicDB-TPU's JAX programs.
+
+The dynamic counterpart of nornlint's NL-JAX04/05 dataflow rules: instead
+of *predicting* shape churn from the AST, nornjit observes the compiles a
+real run actually performs.  A ``jax.monitoring`` listener (opt-in,
+``NORNJIT=1``) sees every **fresh** XLA compile — cache hits never fire
+the event — and attributes it to a ``(subsystem, kind, shape)`` ledger key
+using :mod:`nornicdb_tpu.telemetry.deviceprof`'s observer hook: the last
+key a thread announced via ``record_compile``/``record_execute`` names the
+program that thread is dispatching, so a compile event landing on that
+thread belongs to that key (``record_compile`` fires *before* dispatch —
+genserve's convention — and attribution is retroactive for paths that only
+call ``record_execute`` afterwards).
+
+Per test (wired into tests/conftest.py), compiles split into two phases:
+
+* **warmup** — from test start until the test calls
+  :func:`declare_warmup_done`.  Fresh compiles are expected and recorded.
+* **steady** — after the declaration.  Any fresh compile is a
+  **violation**: the per-test gate fails the test with the attributed
+  key, turning the per-bench "timed pass compiled nothing" assertions
+  into a reusable test-time gate (``make jitgate``).
+
+A test that never declares warmup has an all-warmup phase and cannot
+fail — the gate is strictly opt-in per test.  Benches share the same
+ledger through ``scripts/_bench_common.py`` (:func:`compile_count`
+snapshots around the timed pass).
+
+Usage:
+
+    NORNJIT=1 python -m pytest tests/test_serving.py tests/test_genserve.py
+
+Only stdlib is used at import time; ``install()`` imports jax and (when
+importable) deviceprof.  See docs/linting.md#nornjit.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any, Optional
+
+__all__ = [
+    "Sentinel", "sentinel", "install", "uninstall", "active", "report",
+    "reset", "declare_warmup_done", "compile_count",
+]
+
+#: the monitoring event that fires once per fresh backend compile
+#: (cache hits are silent), synchronously on the dispatching thread
+COMPILE_EVENT = "/jax/core/compile/backend_compile_duration"
+
+_UNATTRIBUTED = ("unattributed", "compile", "?")
+_MAX_EVENTS = 4096
+
+
+class Sentinel:
+    """Fresh-compile recorder with per-test warmup/steady phases.
+
+    Self-contained and passive: feed it with :meth:`on_record` (a
+    deviceprof observer) and :meth:`on_event` (a jax.monitoring duration
+    listener).  The module-level :data:`sentinel` is the instance
+    ``install()`` wires to the real hooks; tests may drive private
+    instances synthetically.
+    """
+
+    def __init__(self) -> None:
+        self._mu = threading.Lock()
+        self._tls = threading.local()
+        # every fresh compile, in order: {key, duration_s, thread,
+        # phase, test} — dicts are shared with `violations`, so
+        # retroactive attribution updates both views
+        self.compiles: list[dict[str, Any]] = []
+        self.violations: list[dict[str, Any]] = []
+        self._test: Optional[str] = None
+        self._steady = False
+        self._steady_note = ""
+
+    # -- hook inputs -------------------------------------------------------
+    def on_record(self, subsystem: str, kind: str, shape: str) -> None:
+        """deviceprof observer: the calling thread is dispatching (or just
+        dispatched) the program with this ledger key."""
+        key = (str(subsystem), str(kind), str(shape))
+        self._tls.key = key
+        pending = getattr(self._tls, "pending", None)
+        if pending:
+            # compiles seen on this thread before any key was announced
+            # (record_execute-only call sites run the dispatch first):
+            # re-attribute them to the key that showed up
+            with self._mu:
+                for rec in pending:
+                    if rec["key"] == _UNATTRIBUTED:
+                        rec["key"] = key
+            self._tls.pending = []
+
+    def on_event(self, event: str, duration_s: float, **_kw) -> None:
+        """jax.monitoring duration listener: record fresh compiles."""
+        if event != COMPILE_EVENT:
+            return
+        key = getattr(self._tls, "key", None)
+        rec = {
+            "key": key or _UNATTRIBUTED,
+            "duration_s": round(float(duration_s), 6),
+            "thread": threading.current_thread().name,
+            "phase": "steady" if self._steady else "warmup",
+            "test": self._test,
+        }
+        with self._mu:
+            if len(self.compiles) >= _MAX_EVENTS:
+                return
+            self.compiles.append(rec)
+            if self._steady:
+                self.violations.append(rec)
+        if key is None:
+            pending = getattr(self._tls, "pending", None)
+            if pending is None:
+                pending = self._tls.pending = []
+            pending.append(rec)
+
+    # -- phase control -----------------------------------------------------
+    def begin_test(self, name: str) -> None:
+        """Enter a new test: phase resets to warmup."""
+        with self._mu:
+            self._test = name
+            self._steady = False
+            self._steady_note = ""
+
+    def declare_warmup_done(self, note: str = "") -> None:
+        """All shape classes this test exercises are now compiled; any
+        further fresh compile is a violation.  No-op outside a test."""
+        with self._mu:
+            if self._test is None:
+                return
+            self._steady = True
+            self._steady_note = note
+
+    def end_test(self) -> list[dict[str, Any]]:
+        """Leave the current test, returning its steady-phase violations."""
+        with self._mu:
+            name = self._test
+            self._test = None
+            self._steady = False
+            return [dict(v) for v in self.violations if v["test"] == name]
+
+    # -- reporting ---------------------------------------------------------
+    def compile_count(self) -> int:
+        with self._mu:
+            return len(self.compiles)
+
+    def ledger(self) -> dict[tuple[str, str, str], int]:
+        """Fresh-compile counts by attributed key (NOT deviceprof's
+        idempotent program registry: a shape-churning program counts once
+        per recompile here)."""
+        out: dict[tuple[str, str, str], int] = {}
+        with self._mu:
+            for rec in self.compiles:
+                out[rec["key"]] = out.get(rec["key"], 0) + 1
+        return out
+
+    def report(self) -> dict[str, Any]:
+        with self._mu:
+            return {
+                "compiles": len(self.compiles),
+                "violations": [dict(v) for v in self.violations],
+                "ledger": {
+                    "/".join(k): n for k, n in sorted(self.ledger_nolock().items())
+                },
+            }
+
+    def ledger_nolock(self) -> dict[tuple[str, str, str], int]:
+        out: dict[tuple[str, str, str], int] = {}
+        for rec in self.compiles:
+            out[rec["key"]] = out.get(rec["key"], 0) + 1
+        return out
+
+    def reset(self) -> None:
+        with self._mu:
+            self.compiles.clear()
+            self.violations.clear()
+            self._test = None
+            self._steady = False
+
+
+# ---------------------------------------------------------------------------
+# Global hook wiring
+# ---------------------------------------------------------------------------
+
+sentinel = Sentinel()
+_installed = False
+_listener_registered = False
+
+
+def _listener(event: str, duration_s: float, **kw) -> None:
+    # jax.monitoring listeners cannot be unregistered individually, so
+    # the registration is permanent and gated on the install flag
+    if _installed:
+        sentinel.on_event(event, duration_s, **kw)
+
+
+def install() -> None:
+    """Register the compile listener + deviceprof observer.  Idempotent;
+    call before the warmup whose compiles you want attributed."""
+    global _installed, _listener_registered
+    if _installed:
+        return
+    import jax
+
+    if not _listener_registered:
+        jax.monitoring.register_event_duration_secs_listener(_listener)
+        _listener_registered = True
+    try:
+        from nornicdb_tpu.telemetry import deviceprof
+
+        deviceprof.PROFILER.add_observer(sentinel.on_record)
+    except ImportError:  # pragma: no cover - deviceprof optional
+        pass  # attribution degrades to "unattributed", counting still works
+    _installed = True
+
+
+def uninstall() -> None:
+    global _installed
+    if not _installed:
+        return
+    try:
+        from nornicdb_tpu.telemetry import deviceprof
+
+        deviceprof.PROFILER.remove_observer(sentinel.on_record)
+    except ImportError:  # pragma: no cover
+        pass
+    _installed = False
+
+
+def active() -> bool:
+    return _installed
+
+
+def report() -> dict[str, Any]:
+    return sentinel.report()
+
+
+def reset() -> None:
+    sentinel.reset()
+
+
+def declare_warmup_done(note: str = "") -> None:
+    """Module-level convenience: tests call this after their warmup pass;
+    a no-op when the sentinel is not installed or no test is active."""
+    sentinel.declare_warmup_done(note)
+
+
+def compile_count() -> int:
+    return sentinel.compile_count()

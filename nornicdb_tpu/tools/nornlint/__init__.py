@@ -14,6 +14,12 @@ modes as machine-checked rules:
   I/O/RPC/join/device-sync under a held lock (NL-LK02), callbacks invoked
   under a lock they may re-acquire (NL-LK03).  Runtime counterpart:
   ``nornicdb_tpu.tools.nornsan`` (``NORNSAN=1``).
+* **JAX dataflow rules** (v3, ``dataflow.py``) — use-after-donate through
+  locals/attrs/wrappers (NL-JAX04), unbounded shape-class dispatch from
+  unbucketed request-dependent sizes (NL-JAX05), host-device syncs
+  reachable from ``# nornlint: thread-role=`` annotated owner/dispatcher
+  loops (NL-JAX06).  Runtime counterpart: ``nornicdb_tpu.tools.nornjit``
+  (``NORNJIT=1``), the compile sentinel.
 * **Error hygiene** — bare ``except:`` (NL-ERR01), silently swallowed
   ``except Exception`` (NL-ERR02), mutable default args (NL-ERR03).
 * **Timing** — wall-clock ``time.time()`` used for durations (NL-TM01).
@@ -28,9 +34,10 @@ from .core import Finding, ModuleContext, Rule, RULES, lint_paths, lint_source
 from .baseline import Baseline, diff_against_baseline
 
 # Importing rules registers them with the RULES registry; importing
-# interproc registers the project-level (interprocedural) rules.
+# interproc/dataflow registers the project-level (interprocedural) rules.
 from . import rules as _rules  # noqa: F401
 from .interproc import PROJECT_RULES, ProjectContext
+from . import dataflow as _dataflow  # noqa: F401
 
 __all__ = [
     "Finding",

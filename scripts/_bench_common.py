@@ -1,0 +1,117 @@
+"""Shared bench exit machinery: the bounded-program-ledger gate.
+
+Every serving bench proves the same steady-state contract at exit — the
+timed pass ran entirely on programs compiled during the warm pass, and
+the compiled-program ledger stays bounded by the shape-class grid — but
+each script used to carry its own copy of the assertions (ISSUE 16
+satellite).  This module is the one implementation, built on the
+``nornicdb_tpu.tools.nornjit`` compile sentinel so benches and the
+``NORNJIT=1`` test gate (tests/conftest.py) share the same fresh-compile
+accounting: the bench ledgers count *announced* program keys, the
+sentinel counts *actual* XLA compiles, and :class:`SteadyStateGate`
+checks both.
+
+Import from a bench script (scripts/ is the script dir, so a plain
+``import _bench_common`` resolves)::
+
+    gate = _bench_common.SteadyStateGate("embed_ragged")
+    ...warm pass...
+    gate.mark_warm(len(embedder.packed_shapes))
+    ...timed pass...
+    gate.assert_steady(len(embedder.packed_shapes))
+    gate.assert_bounded(len(embedder.packed_shapes), bound=24)
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import sys
+from typing import Optional
+
+log = logging.getLogger("bench")
+
+
+def eprint(*args) -> None:
+    print(*args, file=sys.stderr)
+
+
+def install_sentinel():
+    """Install the nornjit compile sentinel (idempotent), returning the
+    module — or None when it cannot install (no jax backend yet, trimmed
+    checkout); the ledger gate then rests on the bench's own program
+    counts alone."""
+    try:
+        from nornicdb_tpu.tools import nornjit
+
+        nornjit.install()
+        return nornjit
+    except ImportError as exc:  # pragma: no cover - trimmed environments
+        log.debug("nornjit unavailable: %s", exc)
+        return None
+
+
+class SteadyStateGate:
+    """Warm→timed steady-state assertions over a compiled-program ledger.
+
+    ``mark_warm(count)`` after the warm pass snapshots the bench's own
+    program count AND the process-wide nornjit fresh-compile count;
+    ``assert_steady(count)`` after the timed pass asserts neither moved —
+    the "timed pass compiled nothing" invariant every serving bench
+    promises.  ``assert_bounded(count, bound)`` is the shape-class-grid
+    ratchet.  Construct the gate BEFORE the warm pass so the sentinel
+    sees the warm compiles too."""
+
+    def __init__(self, bench: str, sentinel=None) -> None:
+        self.bench = bench
+        self.nornjit = sentinel if sentinel is not None \
+            else install_sentinel()
+        self._warm_programs: Optional[int] = None
+        self._warm_compiles: Optional[int] = None
+
+    def mark_warm(self, programs: int) -> None:
+        self._warm_programs = int(programs)
+        if self.nornjit is not None:
+            self._warm_compiles = self.nornjit.compile_count()
+
+    def assert_steady(self, programs: int) -> None:
+        assert self._warm_programs is not None, (
+            f"{self.bench}: assert_steady() before mark_warm()")
+        assert int(programs) == self._warm_programs, (
+            f"{self.bench}: timed pass compiled fresh programs: "
+            f"{self._warm_programs} -> {programs}")
+        if self.nornjit is not None and self._warm_compiles is not None:
+            fresh = self.nornjit.compile_count() - self._warm_compiles
+            assert fresh == 0, (
+                f"{self.bench}: nornjit observed {fresh} fresh XLA "
+                f"compile(s) during the timed pass (ledger keys: "
+                f"{self.nornjit.report()['ledger']})")
+
+    def assert_bounded(self, programs: int, bound: int,
+                       detail: str = "") -> None:
+        assert int(programs) <= int(bound), (
+            f"{self.bench}: program ledger grew past the shape-class "
+            f"bound: {programs} > {bound}"
+            + (f" ({detail})" if detail else ""))
+
+
+def finish(bench: str, failures: list[str], log_fn=eprint) -> int:
+    """Shared failure-report exit: print every invariant failure, return
+    the process exit code (0 clean, 1 any failure)."""
+    if failures:
+        log_fn(f"[{bench}] INVARIANT FAILURES:")
+        for msg in failures:
+            log_fn("  - " + msg)
+        return 1
+    log_fn(f"[{bench}] invariants OK")
+    return 0
+
+
+def hard_exit(rc: int) -> None:
+    """Exit WITHOUT interpreter teardown: the artifact is written and the
+    invariants are decided — teardown with backend-manager daemon threads
+    still inside XLA can abort ("terminate called without an active
+    exception") and turn a green run into exit 134."""
+    sys.stdout.flush()
+    sys.stderr.flush()
+    os._exit(rc)

@@ -40,6 +40,9 @@ import time
 import numpy as np
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+import _bench_common  # noqa: E402
 sys.path.insert(0, REPO)
 
 # (prompt_len, max_new, weight): Heimdall QC reviews are short prompt /
@@ -120,7 +123,8 @@ def bench_sequential(params, cfg, requests, eos_id: int) -> dict:
     }, outputs
 
 
-def bench_continuous(engine, requests) -> dict:
+def bench_continuous(engine, requests,
+                     gate: _bench_common.SteadyStateGate = None) -> dict:
     """Three burst passes: warm (compile every shape class), a streaming
     latency pass (per-request reader threads timestamp first-token and
     inter-token arrivals — the SSE serving shape), and a result()-only
@@ -130,6 +134,8 @@ def bench_continuous(engine, requests) -> dict:
     for h in [engine.submit(p, max_new_tokens=m) for p, m in requests]:
         h.result()
     programs_after_warm = len(engine.programs)
+    if gate is not None:
+        gate.mark_warm(programs_after_warm)
 
     # latency pass (streaming)
     t0 = time.perf_counter()
@@ -171,6 +177,11 @@ def bench_continuous(engine, requests) -> dict:
     total = sum(len(o) for o in outputs)
     steps_timed = engine.stats.decode_steps - steps_before
     chunks_timed = engine.stats.prefill_chunks - chunks_before
+    programs_after_timed = len(engine.programs)
+    if gate is not None:
+        # checked HERE, before main()'s equivalence pass compiles its own
+        # (legitimately new) dense-at-width programs
+        gate.assert_steady(programs_after_timed)
     return {
         "tok_s": round(total / elapsed, 1),
         "elapsed_s": round(elapsed, 3),
@@ -184,7 +195,7 @@ def bench_continuous(engine, requests) -> dict:
         "avg_batch_lanes": round(total / max(1, steps_timed +
                                              chunks_timed), 2),
         "programs_after_warm": programs_after_warm,
-        "programs_after_timed": len(engine.programs),
+        "programs_after_timed": programs_after_timed,
         "evictions": engine.stats.evictions,
     }, outputs
 
@@ -233,11 +244,13 @@ def main() -> int:
         max_seqs=args.concurrency, max_seq_tokens=128, prefill_chunk=64,
         max_queue=4 * n, deadline_ms=0.0,
     )
+    gate = _bench_common.SteadyStateGate("bench_generate")
     engine = GenerationEngine(
         params, cfg, tokenizer=tok, config=gcfg,
         manager=BackendManager(hooks=FakeHooks("ok"), acquire_timeout=5))
     try:
-        cont_result, cont_outputs = bench_continuous(engine, requests)
+        cont_result, cont_outputs = bench_continuous(engine, requests,
+                                                     gate=gate)
     finally:
         engine.stop()
     print(f"continuous:  {cont_result['tok_s']} tok/s "
@@ -268,16 +281,11 @@ def main() -> int:
             f"engine output diverged from dense-at-width for request {i}")
 
     # bounded compiled-program-count invariant: the timed pass compiled
-    # NOTHING (steady state reached in warm), and the ledger is one
+    # NOTHING (steady state reached in warm — checked inside
+    # bench_continuous via the shared gate), and the ledger is one
     # program per shape class
-    assert cont_result["programs_after_timed"] == \
-        cont_result["programs_after_warm"], (
-        "timed pass compiled fresh programs: "
-        f"{cont_result['programs_after_warm']} -> "
-        f"{cont_result['programs_after_timed']}")
-    assert cont_result["programs_after_timed"] <= 16, (
-        f"program ledger grew past the shape-class bound: "
-        f"{sorted(engine.programs)}")
+    gate.assert_bounded(cont_result["programs_after_timed"], 16,
+                        detail=f"{sorted(engine.programs)}")
 
     speedup = cont_result["tok_s"] / max(seq_result["tok_s"], 1e-9)
     out = {

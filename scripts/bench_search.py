@@ -51,6 +51,10 @@ if "xla_force_host_platform_device_count" not in _flags:
     ).strip()
 
 _REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+import _bench_common  # noqa: E402
+
 if _REPO not in sys.path:  # runnable without an editable install
     sys.path.insert(0, _REPO)
 
@@ -420,24 +424,27 @@ def main() -> int:
         json.dump(out, f, indent=1, sort_keys=True)
         f.write("\n")
     log(f"bench_search: wrote {args.out} ({len(results)} rows)")
-    ok = (
-        invariants.get("dispatches_per_batch", 1) == 1
-        and invariants.get("single_write_full_uploads", 0) == 0
-        and invariants.get("single_write_patches", 1) >= 1
-        and invariants["recall_floor_violations"] == 0
-        and invariants["int8_rescore_mismatches"] == 0
-    )
-    if not ok:
-        log(f"bench_search: INVARIANT FAILURE {invariants}")
-        return 1
-    return 0
+    failures = []
+    if invariants.get("dispatches_per_batch", 1) != 1:
+        failures.append(
+            "batched sharded search was not ONE fused dispatch: "
+            f"{invariants['dispatches_per_batch']}")
+    if invariants.get("single_write_full_uploads", 0) != 0:
+        failures.append(
+            "single-row write re-uploaded the corpus instead of patching: "
+            f"{invariants['single_write_full_uploads']} full upload(s)")
+    if invariants.get("single_write_patches", 1) < 1:
+        failures.append("single-row write produced no per-shard patch")
+    if invariants["recall_floor_violations"]:
+        failures.append(
+            f"{invariants['recall_floor_violations']} approx/IVF row(s) "
+            "below the recall floor")
+    if invariants["int8_rescore_mismatches"]:
+        failures.append(
+            f"{invariants['int8_rescore_mismatches']} int8-served score(s) "
+            "!= exact f32 rescore")
+    return _bench_common.finish("bench_search", failures, log_fn=log)
 
 
 if __name__ == "__main__":
-    rc = main()
-    # hard exit: the artifact is written and invariants are decided —
-    # interpreter teardown with backend-manager daemon threads still
-    # inside XLA can abort ("terminate called without an active
-    # exception") and turn a green run into exit 134
-    sys.stderr.flush()
-    os._exit(rc)
+    _bench_common.hard_exit(main())

@@ -34,6 +34,10 @@ import threading
 import time
 
 _REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+import _bench_common  # noqa: E402
+
 if _REPO not in sys.path:  # runnable without an editable install
     sys.path.insert(0, _REPO)
 
@@ -333,13 +337,10 @@ def main(argv=None) -> int:
         eprint(f"[bench_workers] {cfg['workers']}w: search {s['qps']} qps "
                f"p50={s['p50_ms']}ms p99={s['p99_ms']}ms "
                f"fused_avg={cfg['broker']['avg_fused_batch']}")
-    if failures:
-        eprint("[bench_workers] INVARIANT FAILURES:")
-        for fmsg in failures:
-            eprint("  - " + fmsg)
-        return 1
-    eprint(f"[bench_workers] OK -> {args.out}")
-    return 0
+    rc = _bench_common.finish("bench_workers", failures, log_fn=eprint)
+    if rc == 0:
+        eprint(f"[bench_workers] -> {args.out}")
+    return rc
 
 
 if __name__ == "__main__":

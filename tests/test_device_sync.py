@@ -498,3 +498,73 @@ class TestSyncMicrobench:
         with capsys.disabled():
             print(json.dumps(record))
         assert patched_s < full_s
+
+
+class TestDonationExceptionPaths:
+    """NL-JAX04 regression: a failing donated patch must not leave the
+    consumed buffer referenced.  _apply_patch drops the resident buffers
+    on ANY exception so _device_ready() reports false and the next sync
+    rebuilds via _upload_full instead of patching a poisoned buffer.
+
+    Red without the try/except in _apply_patch: the assertion that the
+    buffers were dropped fails (self._dev still points at the donated
+    input)."""
+
+    def _boom(self, *a, **k):
+        raise RuntimeError("injected patch failure")
+
+    def test_device_corpus_failed_patch_drops_and_recovers(
+            self, monkeypatch):
+        from nornicdb_tpu.ops import similarity as sim
+
+        dims = 16
+        dc = DeviceCorpus(dims=dims, capacity=512)
+        data = _rand(300, dims, 20)
+        dc.add_batch([f"n{i}" for i in range(300)], data)
+        dc.search(data[0], k=1)  # full sync: resident buffers exist
+        assert dc._dev is not None
+
+        monkeypatch.setattr(sim, "_patch_rows_donated", self._boom)
+        monkeypatch.setattr(sim, "_patch_rows", self._boom)
+        with pytest.raises(RuntimeError, match="injected"):
+            dc._apply_patch(
+                0, data[:1], np.ones(1, bool), donate=True)
+        # the donated inputs may be CONSUMED: no reference survives
+        assert dc._dev is None
+        assert dc._dev_valid is None
+        assert dc._dev_i8 is None
+
+        # recovery: with the failure gone, the next search rebuilds via
+        # _upload_full and serves the same results
+        monkeypatch.undo()
+        dc.add("late", _rand(1, dims, 21)[0])
+        res = dc.search(dc.get("late"), k=1)
+        assert res[0][0][0] == "late"
+        assert dc.sync_stats.full_uploads >= 2
+
+    def test_sharded_corpus_failed_patch_drops_and_recovers(
+            self, monkeypatch):
+        from nornicdb_tpu.parallel import ShardedCorpus, make_mesh
+        from nornicdb_tpu.parallel import sharded_index as si
+
+        sc = ShardedCorpus(dims=16, mesh=make_mesh(), dtype=jnp.float32)
+        data = _rand(1200, 16, 22)
+        sc.add_batch([f"n{i}" for i in range(1200)], data)
+        sc.search(data[0], k=1)
+        assert sc._dev is not None
+
+        monkeypatch.setattr(si, "_patch_rows_donated", self._boom)
+        monkeypatch.setattr(si, "_patch_rows", self._boom)
+        with pytest.raises(RuntimeError, match="injected"):
+            sc._apply_patch(
+                0, data[:1], np.ones(1, bool), donate=True)
+        assert sc._dev is None
+        assert sc._dev_valid is None
+        assert sc._dev_i8 is None
+
+        monkeypatch.undo()
+        nv = _rand(1, 16, 23)[0]
+        sc.add("fresh", nv)
+        res = sc.search(nv, k=1)
+        assert res[0][0][0] == "fresh"
+        assert sc.sync_stats.full_uploads >= 2

@@ -583,3 +583,63 @@ class TestTraceStitching:
         entry = tracer.trace(root.trace_id)
         names = {s["name"] for s in entry["spans"]}
         assert "genserve.evicted" in names, names
+
+
+# ---------------------------------------------------------------------------
+# donation exception paths (NL-JAX04 regression)
+# ---------------------------------------------------------------------------
+class TestDonationExceptionPaths:
+    """A failing donated dispatch must drop the consumed buffer AT THE
+    DISPATCH SITE — not rely on _loop's blanket handler — so any caller
+    (direct step, warmup, future refactors) recovers through
+    _ensure_pool instead of reading a poisoned pool.
+
+    Red without the try/except around the paged dispatches: after the
+    injected failure self._pages still references the donated input."""
+
+    def _manual_engine(self, monkeypatch, **cfg_kw):
+        """Engine whose scheduler never starts: the test drives _step()
+        on its own thread, so exceptions propagate here instead of being
+        swallowed by _loop's handler."""
+        eng = _engine(**cfg_kw)
+        monkeypatch.setattr(GenerationEngine, "start", lambda self: None)
+        return eng
+
+    def _boom(self, *a, **k):
+        raise RuntimeError("injected dispatch failure")
+
+    def test_prefill_failure_drops_donated_pool(self, monkeypatch):
+        eng = self._manual_engine(monkeypatch)
+        eng.submit([1, 2, 3], max_new_tokens=2)
+        monkeypatch.setattr(qwen2, "paged_prefill_chunk", self._boom)
+        with pytest.raises(RuntimeError, match="injected"):
+            eng._step()
+        assert eng._pages is None, (
+            "failing donated prefill left self._pages referencing the "
+            "consumed pool"
+        )
+
+    def test_decode_failure_drops_donated_pool(self, monkeypatch):
+        eng = self._manual_engine(monkeypatch)
+        eng.submit([1, 2, 3], max_new_tokens=4)
+        monkeypatch.setattr(qwen2, "paged_decode_step", self._boom)
+        # one _step admits + prefills (chunk 32 covers the prompt), then
+        # runs the decode step, which raises
+        with pytest.raises(RuntimeError, match="injected"):
+            eng._step()
+        assert eng._pages is None, (
+            "failing donated decode left self._pages referencing the "
+            "consumed pool"
+        )
+
+    def test_dense_decode_failure_drops_donated_cache(self, monkeypatch):
+        eng = self._manual_engine(monkeypatch, mode="dense")
+        eng.submit([1, 2, 3], max_new_tokens=4)
+        monkeypatch.setattr(qwen2, "decode_step", self._boom)
+        with pytest.raises(RuntimeError, match="injected"):
+            eng._step()
+        seq = eng._running[0]
+        assert seq.dense_cache is None, (
+            "failing donated dense step left seq.dense_cache referencing "
+            "the consumed cache"
+        )

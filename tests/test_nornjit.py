@@ -1,0 +1,196 @@
+"""nornjit recompile-sentinel tests (ISSUE 16).
+
+Unit coverage drives a private :class:`Sentinel` with synthetic hook
+inputs (deterministic, no jax needed); integration coverage installs the
+real jax.monitoring listener and checks attribution over actual XLA
+compiles.  The seeded shape-churn fixture at the bottom runs only under
+``NORNJIT=1`` (the `make jitgate` CI step) and proves the per-test gate
+FAILS a test that compiles fresh programs after declaring warmup done —
+the red half of the red-green pair; its marker inverts the conftest gate
+so the suite stays green while the violation machinery is exercised.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+
+import pytest
+
+from nornicdb_tpu.tools import nornjit
+from nornicdb_tpu.tools.nornjit import COMPILE_EVENT, Sentinel
+
+
+def _compile(s: Sentinel, duration: float = 0.01) -> None:
+    s.on_event(COMPILE_EVENT, duration)
+
+
+# ---------------------------------------------------------------------------
+# unit: synthetic hook inputs
+# ---------------------------------------------------------------------------
+class TestWarmupAccounting:
+    def test_phases_split_at_declaration(self):
+        s = Sentinel()
+        s.begin_test("t")
+        _compile(s)                      # warmup
+        s.declare_warmup_done("shapes ladder complete")
+        _compile(s)                      # steady -> violation
+        _compile(s)
+        vios = s.end_test()
+        assert s.compile_count() == 3
+        assert [c["phase"] for c in s.compiles] == [
+            "warmup", "steady", "steady"]
+        assert len(vios) == 2 and all(v["test"] == "t" for v in vios)
+
+    def test_no_declaration_means_all_warmup(self):
+        s = Sentinel()
+        s.begin_test("t")
+        for _ in range(5):
+            _compile(s)
+        assert s.end_test() == []
+
+    def test_declare_outside_test_is_noop(self):
+        s = Sentinel()
+        s.declare_warmup_done()          # no begin_test: must not arm
+        _compile(s)
+        assert s.violations == []
+        assert s.compiles[0]["phase"] == "warmup"
+
+    def test_phase_resets_between_tests(self):
+        s = Sentinel()
+        s.begin_test("a")
+        s.declare_warmup_done()
+        _compile(s)
+        assert len(s.end_test()) == 1
+        s.begin_test("b")                # fresh warmup phase
+        _compile(s)
+        assert s.end_test() == []
+
+    def test_reset_clears_everything(self):
+        s = Sentinel()
+        s.begin_test("t")
+        s.declare_warmup_done()
+        _compile(s)
+        s.reset()
+        assert s.compile_count() == 0 and s.violations == []
+
+
+class TestAttribution:
+    def test_announced_key_labels_the_compile(self):
+        s = Sentinel()
+        s.on_record("genserve", "decode", "b4x8")
+        _compile(s)
+        assert s.compiles[0]["key"] == ("genserve", "decode", "b4x8")
+        assert s.ledger() == {("genserve", "decode", "b4x8"): 1}
+
+    def test_unannounced_compile_is_unattributed(self):
+        s = Sentinel()
+        _compile(s)
+        assert s.compiles[0]["key"] == ("unattributed", "compile", "?")
+
+    def test_retroactive_attribution_from_record_execute(self):
+        """Call sites that only record AFTER the dispatch (the corpora)
+        still get their thread's earlier anonymous compiles labeled."""
+        s = Sentinel()
+        _compile(s)                       # dispatch compiles first...
+        s.on_record("search", "topk", "1024")   # ...record_execute after
+        assert s.compiles[0]["key"] == ("search", "topk", "1024")
+
+    def test_keys_are_thread_local(self):
+        s = Sentinel()
+        s.on_record("main", "prog", "1")
+        done = threading.Event()
+
+        def other():
+            _compile(s)                   # no key announced on THIS thread
+            done.set()
+
+        t = threading.Thread(target=other)
+        t.start()
+        t.join(5)
+        assert done.is_set()
+        assert s.compiles[0]["key"] == ("unattributed", "compile", "?")
+
+    def test_non_compile_events_ignored(self):
+        s = Sentinel()
+        s.on_event("/jax/core/something_else", 1.0)
+        assert s.compile_count() == 0
+
+    def test_report_shape(self):
+        s = Sentinel()
+        s.on_record("a", "b", "c")
+        _compile(s)
+        rep = s.report()
+        assert rep["compiles"] == 1
+        assert rep["ledger"] == {"a/b/c": 1}
+        assert rep["violations"] == []
+
+
+# ---------------------------------------------------------------------------
+# integration: the real jax.monitoring hook
+# ---------------------------------------------------------------------------
+class TestInstalledSentinel:
+    @pytest.fixture()
+    def installed(self):
+        was_active = nornjit.active()
+        nornjit.install()
+        yield nornjit.sentinel
+        if not was_active:   # NORNJIT=1 sessions keep their sentinel
+            nornjit.uninstall()
+
+    def test_fresh_compile_recorded_and_attributed(self, installed):
+        import jax.numpy as jnp
+
+        from nornicdb_tpu.telemetry import deviceprof
+
+        before = installed.compile_count()
+        deviceprof.record_compile("nornjit_test", "square", "96")
+        x = jnp.ones((96, 96))
+        (x @ x).block_until_ready()
+        after = installed.compile_count()
+        assert after > before, "fresh XLA compile produced no event"
+        keys = {c["key"] for c in installed.compiles[before:after]}
+        assert ("nornjit_test", "square", "96") in keys
+
+    def test_cache_hit_compiles_nothing(self, installed):
+        import jax.numpy as jnp
+
+        x = jnp.ones((96, 96))
+        (x @ x).block_until_ready()      # warm (possibly already warm)
+        mark = installed.compile_count()
+        (x @ x).block_until_ready()      # identical program: cache hit
+        assert installed.compile_count() == mark
+
+    def test_uninstalled_listener_goes_inert(self):
+        if nornjit.active():
+            pytest.skip("NORNJIT=1 session owns the installed sentinel")
+        import jax.numpy as jnp
+
+        nornjit.install()
+        nornjit.uninstall()
+        mark = nornjit.compile_count()
+        y = jnp.ones((33, 33))
+        (y @ y).block_until_ready()      # fresh shape, but inert listener
+        assert nornjit.compile_count() == mark
+
+
+# ---------------------------------------------------------------------------
+# the seeded shape-churn fixture (NORNJIT=1 red-green)
+# ---------------------------------------------------------------------------
+@pytest.mark.skipif(os.environ.get("NORNJIT") != "1",
+                    reason="needs the conftest-installed sentinel")
+@pytest.mark.nornjit_expect_violations
+def test_seeded_shape_churn_fails_the_gate():
+    """Deliberate recompile churn AFTER declaring warmup done: without
+    the inverting marker the conftest gate fails this test — proving the
+    sentinel catches exactly the class the bench ledgers only sample.
+    (The marker flips the assertion: the test fails if NO violation was
+    observed.)"""
+    import jax.numpy as jnp
+
+    (jnp.ones((8, 8)) * 2.0).block_until_ready()   # warmup shape
+    nornjit.declare_warmup_done("churn fixture warmed")
+    # un-pow2'd, request-dependent-looking sizes: each is a fresh shape
+    # class, each forces a fresh compile in the steady phase
+    for n in (17, 33, 65):
+        (jnp.ones((n, n)) * 2.0).block_until_ready()

@@ -380,6 +380,112 @@ BAD_CLEAN_FIXTURES = {
                     return devs[0]
         """,
     ),
+    # -- dataflow (v3) rules -------------------------------------------------
+    "NL-JAX04": (
+        """
+        import jax
+        import jax.numpy as jnp
+
+        def _patch_impl(buf, rows):
+            return buf.at[0].set(rows)
+
+        _patch_donated = jax.jit(_patch_impl, donate_argnums=(0,))
+
+        class Corpus:
+            def __init__(self):
+                self._dev = jnp.zeros((8, 8))
+
+            def apply(self, rows):
+                out = _patch_donated(self._dev, rows)
+                norm = self._dev.sum()  # reads the CONSUMED buffer
+                self._dev = out
+                return norm
+        """,
+        """
+        import jax
+        import jax.numpy as jnp
+
+        def _patch_impl(buf, rows):
+            return buf.at[0].set(rows)
+
+        _patch_donated = jax.jit(_patch_impl, donate_argnums=(0,))
+
+        class Corpus:
+            def __init__(self):
+                self._dev = jnp.zeros((8, 8))
+
+            def apply(self, rows):
+                try:
+                    self._dev = _patch_donated(self._dev, rows)
+                except Exception:
+                    self._dev = None  # consumed: drop, rebuild on sync
+                    raise
+                return self._dev.sum()  # the REBOUND result, not the input
+        """,
+    ),
+    "NL-JAX05": (
+        """
+        import jax
+        import jax.numpy as jnp
+
+        def _score_impl(x):
+            return x.sum(axis=-1)
+
+        score = jax.jit(_score_impl)
+
+        def run(texts):
+            n = len(texts)  # request-dependent size...
+            return score(jnp.zeros((n, 8)))  # ...baked into the shape
+        """,
+        """
+        import jax
+        import jax.numpy as jnp
+
+        def round_up_pow2(n, m=1):
+            return max(m, 1 << (max(1, n) - 1).bit_length())
+
+        def _score_impl(x):
+            return x.sum(axis=-1)
+
+        score = jax.jit(_score_impl)
+
+        def run(texts):
+            n = round_up_pow2(len(texts), 8)  # bucketed: bounded classes
+            return score(jnp.zeros((n, 8)))
+        """,
+    ),
+    "NL-JAX06": (
+        """
+        import jax.numpy as jnp
+
+        class Engine:
+            # nornlint: thread-role=scheduler
+            def _loop(self):
+                while True:
+                    self._step()
+
+            def _step(self):
+                logits = jnp.ones((4,))
+                return int(jnp.argmax(logits))  # host sync on the loop
+        """,
+        """
+        import jax.numpy as jnp
+
+        class Engine:
+            # nornlint: thread-role=scheduler
+            def _loop(self):
+                while True:
+                    self._emit(self._step())
+
+            def _step(self):
+                logits = jnp.ones((4,))
+                return jnp.argmax(logits)  # stays on device; the handle
+                # crosses threads, the VALUE syncs on the consumer side
+
+            def _emit(self, token):
+                pass
+        """,
+    ),
 }
 
 

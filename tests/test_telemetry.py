@@ -390,7 +390,15 @@ class TestHttpTelemetry:
         db, srv = traced_server
         _post(srv.port, "/db/neo4j/tx/commit",
               {"statements": [{"statement": "RETURN 1"}]})
-        listing = _get_json(srv.port, "/admin/traces")
+        # the root span rings a hair after the response bytes reach the
+        # client (see _wait_trace) — poll the listing instead of racing
+        # the handler thread
+        deadline = time.monotonic() + 5.0
+        while True:
+            listing = _get_json(srv.port, "/admin/traces")
+            if listing["traces"] or time.monotonic() >= deadline:
+                break
+            time.sleep(0.01)
         assert listing["traces"], "no traces recorded"
         tid = listing["traces"][0]["trace_id"]
         tree = _get_json(srv.port, f"/admin/traces/{tid}")
