@@ -16,11 +16,28 @@ engine owns the generation path end to end:
   numerically-equivalent dense fallback path (``mode="dense"``) keeps a
   per-sequence dense cache for escape-hatch deployments and as the
   equivalence reference the test suite holds the paged path to.
-* **Prefill/decode interleaving.**  Each scheduler iteration runs at
-  most one prompt-prefill chunk (power-of-two bucketed, so jits stay
-  bounded — the ``round_up_pow2`` discipline from models/qwen2.py) and
-  then one decode step for the whole running batch: long prompts never
-  stall tokens for sequences mid-decode.
+* **One fused ragged step per iteration** (genserve v2).  Each
+  scheduler iteration submits a SINGLE device program
+  (``models/qwen2.py`` ``ragged_fused_step``) serving every decode lane
+  plus at most one prompt-prefill chunk as ragged per-lane metadata —
+  no per-phase prefill/decode program split, half the dispatch overhead
+  per generated token.  The flat token batch and the chunk width are
+  power-of-two bucketed (the ``round_up_pow2`` discipline), so the
+  program-class ledger stays bounded at one entry per (F, Tq) bucket
+  pair, not one per (prefill, decode) shape combination.  On TPU the
+  attention inner loop is the ragged paged Pallas kernel
+  (``ops/pallas_kernels.py``); elsewhere the bit-identical XLA
+  block-gather fallback serves.
+* **Shared-prefix KV caching.**  Full prompt pages are content-hashed
+  (a chained digest, so a page's key commits to everything before it)
+  and kept resident after their sequence finishes; a new prompt whose
+  leading pages hit the cache skips prefilling them entirely and
+  attends to the shared physical pages through its own page table.
+  Pages are refcounted: eviction and release only free a page when its
+  last holder drops it, and cache-resident idle pages are reclaimed LRU
+  under pool pressure — a shared page is never freed out from under a
+  second sequence.  GraphRAG/HeimdallQC prompts share long
+  system/context preambles, so this attacks ttft directly.
 * **Admission / eviction on page-pool pressure.**  A bounded queue sheds
   at submit with :class:`ResourceExhausted` (HTTP 429 / gRPC
   RESOURCE_EXHAUSTED / Bolt transient at the edges); a sequence that
@@ -50,11 +67,12 @@ gauges.
 
 from __future__ import annotations
 
+import hashlib
 import logging
 import queue as queue_mod
 import threading
 import time
-from collections import deque
+from collections import OrderedDict, deque
 from dataclasses import dataclass
 from typing import Iterator, Optional, Sequence
 
@@ -83,6 +101,16 @@ class GenStats:
     prefill_chunks: int = 0
     decode_steps: int = 0
     decode_lane_tokens: int = 0  # real (non-padding) lanes stepped
+    # prefill-token accounting by pass: first-pass prompt tokens vs
+    # tokens RE-prefilled after an eviction/re-platform readmission —
+    # kept separate so bench prefill throughput is honest (a thrashing
+    # pool re-prefilling the same prompt is not extra useful work)
+    prefill_tokens_first: int = 0
+    prefill_tokens_re: int = 0
+    # shared-prefix cache: pages reused at admission + the prompt
+    # tokens those pages made prefill skip
+    prefix_hits: int = 0
+    prefix_reused_tokens: int = 0
     admissions: int = 0
     readmissions: int = 0
     evictions: int = 0
@@ -124,6 +152,9 @@ class GenHandle:
         self.deadline = deadline  # monotonic; 0 = none
         self.error: Optional[Exception] = None
         self.shed = False  # terminal: scheduler must drop this sequence
+        # prompt tokens the shared-prefix cache let prefill skip (set at
+        # admission; GraphRAG surfaces it in the answer payload)
+        self.prefix_reused_tokens = 0
 
     # -- scheduler side ----------------------------------------------------
     def _deliver(self, tok: int) -> None:
@@ -254,7 +285,7 @@ class _Seq:
         "prefill_tokens", "prefill_pos", "page_ids", "page_table",
         "cache_len", "admit_no", "dense_cache", "dense_len",
         "submitted_at", "first_token_at", "counted",
-        "trace_ctx", "submitted_perf",
+        "trace_ctx", "submitted_perf", "prefix_keys", "re_prefill",
     )
 
     def __init__(self, handle: GenHandle, prompt: list[int], max_new: int,
@@ -281,6 +312,11 @@ class _Seq:
         # answer shows its full generation path in /admin/traces
         self.trace_ctx = None
         self.submitted_perf = 0.0
+        # chained page-content keys over this admission's prefill tokens
+        # (full pages only); registered into the prefix cache when the
+        # final chunk lands
+        self.prefix_keys: Optional[list[bytes]] = None
+        self.re_prefill = False  # this admission re-prefills prior work
 
     @property
     def trace_id(self) -> Optional[str]:
@@ -321,6 +357,13 @@ class GenerationEngine:
         self._prefill_chunk = round_up_pow2(
             max(16, int(config.prefill_chunk)), 16)
         self._max_seqs = max(1, int(config.max_seqs))
+        # attention-lane count of the fused ragged step: decode lanes
+        # 0..max_seqs-1, the chunk lane, and a reserved dump lane for
+        # padding rows — ONE constant per engine, never a program-shape
+        # degree of freedom, so no bucketing: every extra lane is real
+        # attention work on every step
+        self._lmax = self._max_seqs + 2
+        self._attn_impl: Optional[str] = None  # resolved at first dispatch
         self._lock = threading.Lock()
         self._cond = threading.Condition(self._lock)
         self._queue: deque[_Seq] = deque()
@@ -333,6 +376,15 @@ class GenerationEngine:
             range(1, self._usable_pages + 1))
         self._pages = None
         self._admit_counter = 0
+        # shared-prefix page cache (scheduler-owned, like the pool):
+        #   _page_refs     pid -> live holders (sequences sharing it)
+        #   _prefix_cache  chain-key -> pid, LRU order (oldest first);
+        #                  a cached page with refcount 0 stays RESIDENT
+        #                  and reclaimable, it is not on the free list
+        #   _page_hash     pid -> chain-key (reverse index for reclaim)
+        self._page_refs: dict[int, int] = {}
+        self._prefix_cache: "OrderedDict[bytes, int]" = OrderedDict()
+        self._page_hash: dict[int, bytes] = {}
         self._device_kind: Optional[str] = None  # "default" | "cpu"
         self._cpu_params = None
         self._host_params = None
@@ -345,8 +397,13 @@ class GenerationEngine:
     def _hbm_bytes(self) -> dict:
         pool = self._pages
         if pool is None:
-            return {"kv_pages": 0}
-        return {"kv_pages": int(pool.size) * pool.dtype.itemsize}
+            return {"kv_pages": 0, "kv_prefix": 0}
+        total = int(pool.size) * pool.dtype.itemsize
+        # kv_prefix is the prefix-cache-resident SUBSET of kv_pages (not
+        # additive residency): how much of the pool is pinned shareable
+        per_page = total // max(1, pool.shape[2])
+        return {"kv_pages": total,
+                "kv_prefix": len(self._prefix_cache) * per_page}
 
     # -- lifecycle ---------------------------------------------------------
     def start(self) -> None:
@@ -383,12 +440,51 @@ class GenerationEngine:
     def running(self) -> bool:
         return self._thread is not None and self._thread.is_alive()
 
+    def _ragged_classes(self) -> list[tuple[int, int]]:
+        """Every (F, Tq) shape class the fused scheduler can dispatch.
+
+        Decode-only steps collapse Tq to 1 with F = pow2(ndec), ndec in
+        1..max_seqs.  A step carrying a chunk of bucket Tq=c has
+        n_valid in [c/2+1, c] (or [1, 16] for the first bucket) plus
+        0..max_seqs-1 decode rows, so the reachable F buckets for that c
+        are the CONTIGUOUS pow2 range between those bounds — the warmup
+        ladder walks all of them, not just the endpoints, or a mid-range
+        step would pay a steady-state compile."""
+        from nornicdb_tpu.models.qwen2 import round_up_pow2
+
+        classes: list[tuple[int, int]] = []
+        f = 8
+        while True:
+            classes.append((f, 1))
+            if f >= round_up_pow2(self._max_seqs, 8):
+                break
+            f *= 2
+        c = 16
+        while True:
+            # the bucket-edge clamp in _fused_step can shrink a Tq=c
+            # chunk down to exactly c//2 flat rows, so lo starts there
+            lo = 1 if c == 16 else c // 2
+            hi = c + max(0, self._max_seqs - 1)
+            f = round_up_pow2(lo, 8)
+            top = round_up_pow2(hi, 8)
+            while True:
+                classes.append((f, c))
+                if f >= top:
+                    break
+                f *= 2
+            if c >= self._prefill_chunk:
+                break
+            c *= 2
+        return classes
+
     def warmup(self, timeout: float = 60.0) -> None:
         """Compile EVERY program class the configured engine can dispatch
-        — each prefill chunk bucket (16..prefill_chunk) and each pow2
-        decode batch size (1..max_seqs) — before taking traffic, so no
-        live request pays an XLA compile inside its deadline (the soak
-        harness and ``cli serve`` call this at boot).
+        — each (F, Tq) fused ragged-step bucket pair from
+        :meth:`_ragged_classes` — before taking traffic, so no live
+        request pays an XLA compile inside its deadline (the soak
+        harness and ``cli serve`` call this at boot; benches call it
+        before their timed passes and then assert the steady-state
+        program set never grows).
 
         Paged mode compiles directly against a THROWAWAY pool on the
         caller thread (the jit cache is shared; the scheduler's pool and
@@ -419,33 +515,34 @@ class GenerationEngine:
         ctx = (jax.default_device(self._cpu_dev()) if kind == "cpu"
                else contextlib.nullcontext())
         w = self._table_width
+        lmax = self._lmax
+        impl = self._attn_for(kind)
         with ctx:
             pool = qwen2.init_kv_pages(self.cfg, self._usable_pages + 1,
                                        self._page_size)
-            table = np.zeros((w,), np.int32)
-            table[0] = 1
-            c = 16
-            while time.monotonic() < deadline:
-                _, pool = qwen2.paged_prefill_chunk(
-                    params, self.cfg, jnp.zeros((c,), jnp.int32), pool,
-                    jnp.asarray(table), jnp.asarray(0), jnp.asarray(1))
-                self.programs.add(("prefill", c, w))
-                _deviceprof.record_compile("genserve", "prefill",
-                                           f"c{c}x{w}")
-                if c >= self._prefill_chunk:
+            for f, tq in self._ragged_classes():
+                if time.monotonic() >= deadline:
                     break
-                c *= 2
-            b = 1
-            while time.monotonic() < deadline:
-                _, pool = qwen2.paged_decode_step(
-                    params, self.cfg, jnp.zeros((b,), jnp.int32), pool,
-                    jnp.zeros((b, w), jnp.int32), jnp.zeros((b,), jnp.int32))
-                self.programs.add(("decode", b, w))
-                _deviceprof.record_compile("genserve", "decode",
-                                           f"b{b}x{w}")
-                if b >= self._max_seqs:
-                    break
-                b *= 2
+                meta, (tokens, lane_id, lane_pos, positions, logit_rows,
+                       lane_tables) = qwen2.pack_ragged_meta(lmax, w, f)
+                tokens[:] = 0
+                lane_id[:] = lmax - 1
+                lane_pos[:] = 0
+                positions[:] = -1
+                logit_rows[:] = 0
+                lane_tables[:] = 0
+                # one real row (writes throwaway page 1) so the compiled
+                # program exercises the full scatter/attend path
+                lane_id[0] = 0
+                positions[0] = 0
+                lane_tables[0, 0] = 1
+                self.programs.add(("ragged", f, tq, w))
+                _deviceprof.record_compile("genserve", "ragged",
+                                           f"f{f}q{tq}x{w}")
+                ids, _lg, pool = qwen2.ragged_fused_step(
+                    params, self.cfg, jnp.asarray(meta), pool,
+                    lmax=lmax, w=w, tq=tq, attn_impl=impl)
+                np.asarray(ids)  # force execution before serving
 
     # -- submission --------------------------------------------------------
     def submit(self, prompt_ids: Sequence[int], max_new_tokens: int = 64,
@@ -542,9 +639,12 @@ class GenerationEngine:
                     self._finish_seq(seq, error=e)
                 # the failing call may have CONSUMED the donated pool
                 # (donate_argnums): a poisoned buffer must not survive
-                # into the next step, so rebuild from scratch
+                # into the next step, so rebuild from scratch — and the
+                # prefix cache indexes CONTENT of the dropped pool, so
+                # it must go with it
                 self._pages = None
                 self._free_pages = list(range(1, self._usable_pages + 1))
+                self._reset_prefix_cache()
                 with self._cond:
                     queued = list(self._queue)
                     self._queue.clear()
@@ -610,12 +710,89 @@ class GenerationEngine:
         seq.handle._finish(error)
 
     def _release_pages(self, seq: _Seq) -> None:
-        if seq.page_ids:
-            self._free_pages.extend(seq.page_ids)
-            seq.page_ids = []
+        for pid in seq.page_ids:
+            refs = self._page_refs.get(pid, 1) - 1
+            if refs > 0:
+                # still shared with another live sequence — eviction/
+                # finish NEVER frees a page out from under its co-holder
+                self._page_refs[pid] = refs
+                continue
+            self._page_refs.pop(pid, None)
+            if pid not in self._page_hash:
+                self._free_pages.append(pid)
+            # else: prefix-cached page goes idle-resident (refcount 0),
+            # reclaimable LRU by _alloc_page under pool pressure
+        seq.page_ids = []
         seq.page_table = None
         seq.cache_len = 0
         seq.prefill_pos = 0
+
+    def _alloc_page(self) -> Optional[int]:
+        """One physical page for a new holder: the free list first, then
+        the least-recently-used IDLE prefix-cached page (evicting it
+        from the cache — a page some sequence still holds is never
+        reclaimed).  None means genuine pool pressure."""
+        if self._free_pages:
+            return self._free_pages.pop()
+        victim_key = None
+        for key, pid in self._prefix_cache.items():  # oldest first
+            if self._page_refs.get(pid, 0) == 0:
+                victim_key = key
+                break
+        if victim_key is None:
+            return None
+        pid = self._prefix_cache.pop(victim_key)
+        self._page_hash.pop(pid, None)
+        return pid
+
+    def _available_pages(self) -> int:
+        """Pages an admission could claim: free + idle prefix-cached."""
+        idle = sum(1 for pid in self._prefix_cache.values()
+                   if self._page_refs.get(pid, 0) == 0)
+        return len(self._free_pages) + idle
+
+    def _reset_prefix_cache(self) -> None:
+        """Pool content invalidated (re-platform / failed donated step):
+        every cached key now describes bytes that no longer exist."""
+        self._prefix_cache.clear()
+        self._page_hash.clear()
+        self._page_refs.clear()
+
+    def _prefix_page_keys(self, toks: list[int]) -> list[bytes]:
+        """Chained content keys, one per FULL page of ``toks``: key i
+        commits to every token in pages 0..i, so matching key i implies
+        the whole prefix matches — page-granular prefix matching with
+        one dict probe per page."""
+        ps = self._page_size
+        h = hashlib.sha1(b"nornic-prefix")
+        keys: list[bytes] = []
+        for i in range(len(toks) // ps):
+            h.update(np.asarray(toks[i * ps:(i + 1) * ps],
+                                np.int64).tobytes())
+            keys.append(h.digest())
+        return keys
+
+    def _register_prefix(self, seq: _Seq) -> None:
+        """Final prefill chunk landed: publish this sequence's full
+        prompt pages into the prefix cache.  Pages already cached (the
+        hits this admission reused, or a concurrent same-prompt
+        registration) are skipped — first writer wins, the loser's page
+        simply stays private."""
+        if seq.prefix_keys is None or seq.page_table is None:
+            return
+        ps = self._page_size
+        n_full = min(len(seq.prefix_keys),
+                     len(seq.prefill_tokens) // ps, len(seq.page_ids))
+        for idx in range(n_full):
+            key = seq.prefix_keys[idx]
+            pid = int(seq.page_table[idx])
+            if key in self._prefix_cache:
+                self._prefix_cache.move_to_end(key)
+                continue
+            if pid in self._page_hash:
+                continue
+            self._prefix_cache[key] = pid
+            self._page_hash[pid] = key
 
     # -- device gating -----------------------------------------------------
     def _mgr(self):
@@ -674,6 +851,20 @@ class GenerationEngine:
             return jax.default_device(self._cpu_dev())
         return contextlib.nullcontext()
 
+    def _attn_for(self, kind) -> str:
+        """Attention implementation of the fused step for this platform:
+        the ragged Pallas kernel on a real TPU, the bit-identical XLA
+        block-gather everywhere else (including CPU fallback steps of a
+        TPU process — interpret-mode Pallas is a debug path, not a
+        serving path)."""
+        if kind == "cpu":
+            return "xla"
+        if self._attn_impl is None:
+            from nornicdb_tpu.ops import pallas_kernels as _pk
+
+            self._attn_impl = "pallas" if _pk._on_tpu() else "xla"
+        return self._attn_impl
+
     def _apply_platform(self, kind: str) -> None:
         """Handle a READY<->DEGRADED transition: the pool on the old
         platform is unreachable (or stale), so rebuild it and requeue
@@ -689,6 +880,8 @@ class GenerationEngine:
         self._device_kind = kind
         self._pages = None
         self._free_pages = list(range(1, self._usable_pages + 1))
+        # cached prefix pages lived in the dropped pool: forget them
+        self._reset_prefix_cache()
         requeue = list(self._running)
         self._running = []
         with self._cond:
@@ -719,27 +912,51 @@ class GenerationEngine:
             self.stats.cpu_steps += 1
         self._ensure_pool()
         self._admit()
-        self._prefill_one()
-        self._decode_step()
+        if self.config.mode == "dense":
+            self._prefill_one()
+            self._decode_step()
+        else:
+            self._fused_step()
         self._publish_gauges()
 
     def _publish_gauges(self) -> None:
         _stats.RUNNING_SEQS.set(len(self._running))
         used = self._usable_pages - len(self._free_pages)
         _stats.PAGE_POOL_UTIL.set(used / max(1, self._usable_pages))
+        _stats.PREFIX_PAGES.set(len(self._prefix_cache))
 
     def _admit(self) -> None:
         from nornicdb_tpu.models.qwen2 import pages_for
 
+        paged = self.config.mode != "dense"
         while len(self._running) < self._max_seqs:
+            hits: list[int] = []
+            keys: list[bytes] = []
             with self._cond:
                 if not self._queue:
                     return
                 seq = self._queue[0]
-                need = (0 if self.config.mode == "dense" else
-                        pages_for(len(seq.prompt) + len(seq.out) + 1,
-                                  self._page_size))
-                if need > len(self._free_pages):
+                toks = seq.prompt + seq.out
+                need = (pages_for(len(toks) + 1, self._page_size)
+                        if paged else 0)
+                if paged:
+                    keys = self._prefix_page_keys(toks)
+                    # cap reuse below the full prompt: the final chunk
+                    # must prefill at least one token to produce the
+                    # first-token logits
+                    cap = (len(toks) - 1) // self._page_size
+                    for idx in range(min(len(keys), cap)):
+                        pid = self._prefix_cache.get(keys[idx])
+                        if pid is None:
+                            break
+                        hits.append(pid)
+                # idle cached hits count as "available" but adopting
+                # them consumes that availability — exclude them before
+                # comparing against the fresh-page requirement
+                idle_hits = sum(1 for pid in hits
+                                if self._page_refs.get(pid, 0) == 0)
+                if (need - len(hits)
+                        > self._available_pages() - idle_hits):
                     return  # pool pressure: wait for a finisher/evictor
                 self._queue.popleft()
                 _stats.QUEUE_DEPTH.set(len(self._queue))
@@ -749,18 +966,39 @@ class GenerationEngine:
                                                    reason="deadline"),
                                  drop=False)
                 continue
-            seq.prefill_tokens = seq.prompt + seq.out
+            seq.prefill_tokens = toks
             seq.prefill_pos = 0
             seq.cache_len = 0
             seq.state = _PREFILL
             seq.admit_no = self._admit_counter
             self._admit_counter += 1
             if need:
-                seq.page_ids = [self._free_pages.pop()
-                                for _ in range(need)]
+                seq.prefix_keys = keys
                 table = np.zeros((self._table_width,), np.int32)
+                seq.page_ids = []
+                for pid in hits:
+                    # shared pages: take a reference, refresh LRU
+                    self._page_refs[pid] = \
+                        self._page_refs.get(pid, 0) + 1
+                    self._prefix_cache.move_to_end(self._page_hash[pid])
+                    seq.page_ids.append(pid)
+                for _ in range(need - len(hits)):
+                    pid = self._alloc_page()  # availability checked above
+                    self._page_refs[pid] = 1
+                    seq.page_ids.append(pid)
                 table[:len(seq.page_ids)] = seq.page_ids
                 seq.page_table = table
+                if hits:
+                    reused = len(hits) * self._page_size
+                    # cached pages already hold these tokens' KV:
+                    # prefill starts at the novel suffix
+                    seq.prefill_pos = reused
+                    seq.cache_len = reused
+                    seq.handle.prefix_reused_tokens = reused
+                    self.stats.prefix_hits += len(hits)
+                    self.stats.prefix_reused_tokens += reused
+                    _stats.PREFIX_HITS.inc(len(hits))
+            seq.re_prefill = bool(seq.out)
             if seq.out:
                 self.stats.readmissions += 1
             self.stats.admissions += 1
@@ -784,7 +1022,11 @@ class GenerationEngine:
 
         need = pages_for(seq.cache_len + 1, self._page_size)
         while len(seq.page_ids) < need:
-            if not self._free_pages:
+            pid = self._alloc_page()
+            if pid is None:
+                # an eviction may free ZERO pages (every victim page
+                # shared or cache-resident), so alloc-then-evict loops:
+                # each round removes one victim, so it terminates
                 victims = [s for s in self._running
                            if s is not seq and s.page_ids]
                 if not victims:
@@ -796,7 +1038,7 @@ class GenerationEngine:
                 victim = max(victims, key=lambda s: s.admit_no)
                 self._evict(victim)
                 continue
-            pid = self._free_pages.pop()
+            self._page_refs[pid] = 1
             seq.page_ids.append(pid)
             seq.page_table[len(seq.page_ids) - 1] = pid
         return True
@@ -821,74 +1063,176 @@ class GenerationEngine:
             self._queue.appendleft(victim)
             _stats.QUEUE_DEPTH.set(len(self._queue))
 
-    # -- prefill -----------------------------------------------------------
+    # -- the fused ragged step (paged mode) --------------------------------
+    def _fused_step(self) -> None:
+        """ONE device program per scheduler iteration: every running
+        decode lane plus at most one prompt-prefill chunk (the oldest
+        admitted sequence still prefilling), as ragged per-lane metadata
+        into ``qwen2.ragged_fused_step``.  Long prompts never stall the
+        running batch — they ride the same program — and decode lanes
+        never pay a separate dispatch while any prompt is prefilling."""
+        from nornicdb_tpu.models import qwen2
+        import jax.numpy as jnp
+
+        active = [s for s in self._running if s.state == _DECODE]
+        active = [s for s in active if not self._expired(s)]
+        # page growth first, for side effects only: a shed or evicted
+        # sequence leaves self._running and the re-filter below drops it
+        for seq in list(active):
+            if seq in self._running:
+                self._grow(seq)
+        active = [s for s in active if s in self._running
+                  and s.state == _DECODE]
+        pre = [s for s in self._running if s.state == _PREFILL]
+        chunk_seq = min(pre, key=lambda s: s.admit_no) if pre else None
+        if chunk_seq is not None and self._expired(chunk_seq):
+            chunk_seq = None
+        if not active and chunk_seq is None:
+            return
+        ndec = len(active)
+        if chunk_seq is not None:
+            remaining = (len(chunk_seq.prefill_tokens)
+                         - chunk_seq.prefill_pos)
+            tq = min(self._prefill_chunk,
+                     qwen2.round_up_pow2(remaining, 16))
+            n_valid = min(remaining, tq)
+            f = qwen2.round_up_pow2(ndec + n_valid, 8)
+            half = f // 2
+            if (ndec + n_valid < f and half >= 8
+                    and half - ndec >= (n_valid + 1) // 2):
+                # decode rows pushed the flat bucket over a pow2 edge:
+                # fill the LOWER bucket exactly and leave the chunk tail
+                # for the next step — half the GEMM rows for one extra
+                # dispatch.  Only when the clamp keeps at least half the
+                # chunk: a thinner clamp fragments the tail into
+                # near-empty steps, which costs far more than padding.
+                n_valid = half - ndec
+                f = half
+            piece = chunk_seq.prefill_tokens[
+                chunk_seq.prefill_pos:chunk_seq.prefill_pos + n_valid]
+            final = (chunk_seq.prefill_pos + n_valid
+                     >= len(chunk_seq.prefill_tokens))
+        else:
+            tq, piece, n_valid, final = 1, [], 0, False
+            # flat token rows: decode lanes first, then the chunk, then
+            # padding up to the pow2 bucket — F scales with REAL tokens
+            f = qwen2.round_up_pow2(ndec, 8)
+        lmax, w = self._lmax, self._table_width
+        # ONE packed int32 host array per step (one H2D transfer); the
+        # names below are writable views into it
+        meta, (tokens, lane_id, lane_pos, positions, logit_rows,
+               lane_tables) = qwen2.pack_ragged_meta(lmax, w, f)
+        tokens[:] = 0
+        lane_id[:] = lmax - 1                        # dump lane default
+        lane_pos[:] = 0
+        positions[:] = -1                            # -1 = padding row
+        lane_tables[:] = 0
+        # logits are projected only for rows that pick a token: the
+        # decode rows and the chunk's last valid row (Lmax rows, not F —
+        # at real vocabs that is the difference between a (Lmax, V) and
+        # an (F, V) vocab GEMM every step)
+        logit_rows[:] = 0
+        for i, seq in enumerate(active):
+            tokens[i] = seq.out[-1]
+            lane_id[i] = i
+            positions[i] = seq.cache_len
+            lane_tables[i] = seq.page_table
+            logit_rows[i] = i
+        chunk_lane = lmax - 2  # THE chunk lane, fixed by convention
+        for j in range(n_valid):
+            fi = ndec + j
+            tokens[fi] = piece[j]
+            lane_id[fi] = chunk_lane
+            lane_pos[fi] = j
+            positions[fi] = chunk_seq.prefill_pos + j
+        if chunk_seq is not None:
+            lane_tables[chunk_lane] = chunk_seq.page_table
+            logit_rows[ndec] = ndec + n_valid - 1
+        t0 = time.perf_counter()
+        params = self._active_params()
+        shape = f"f{f}q{tq}x{w}"
+        self.programs.add(("ragged", f, tq, w))
+        _deviceprof.record_compile("genserve", "ragged", shape)
+        with self._platform_ctx():
+            try:
+                ids, _logits, self._pages = qwen2.ragged_fused_step(
+                    params, self.cfg, jnp.asarray(meta), self._pages,
+                    lmax=lmax, w=w, tq=tq,
+                    attn_impl=self._attn_for(self._device_kind))
+            except Exception:
+                # the failing dispatch may have CONSUMED the donated
+                # pool (donate_argnums): drop it at the dispatch site so
+                # _ensure_pool rebuilds from scratch, whatever the
+                # caller does (NL-JAX04) — and the prefix cache indexes
+                # the dropped pool's content, so it goes too
+                self._pages = None
+                self._reset_prefix_cache()
+                raise
+            # greedy argmax runs inside the program: (Lmax,) ints cross
+            # to host, not the (Lmax, V) logits (~MBs/step at real
+            # vocabs) — a bounded 4B-per-row sync, the step's output
+            # nornlint: disable=NL-JAX06
+            host = np.asarray(ids)
+        t1 = time.perf_counter()
+        dt = t1 - t0
+        _deviceprof.record_execute("genserve", "ragged", shape, dt)
+        # the one dispatch served both phases: observability stays
+        # per-phase (retroactive spans in each submitter's trace, the
+        # QueryBatcher convention), so dashboards and the trace tests
+        # keep their shape across the v1 -> v2 rewire
+        if chunk_seq is not None:
+            _stats.PREFILL_HIST.observe(dt)
+            self.stats.prefill_chunks += 1
+            if chunk_seq.re_prefill:
+                self.stats.prefill_tokens_re += n_valid
+                _stats.PREFILL_TOKENS.labels("re").inc(n_valid)
+            else:
+                self.stats.prefill_tokens_first += n_valid
+                _stats.PREFILL_TOKENS.labels("first").inc(n_valid)
+            if chunk_seq.trace_ctx is not None:
+                _tracer.add_span(
+                    "genserve.prefill", t0, t1,
+                    parent=chunk_seq.trace_ctx,
+                    attrs={"chunk": tq, "valid": n_valid,
+                           "fused_decode_lanes": ndec})
+        if active:
+            _stats.DECODE_HIST.observe(dt)
+            self.stats.decode_steps += 1
+            self.stats.decode_lane_tokens += ndec
+            leader_ctx = next(
+                (s.trace_ctx for s in active if s.trace_ctx is not None),
+                None)
+            links = sorted({tid for s in active
+                            if (tid := s.trace_id) is not None})
+            if leader_ctx is not None:
+                _tracer.add_span(
+                    "genserve.decode", t0, t1, parent=leader_ctx,
+                    attrs={"batch": ndec, "links": links})
+        for i, seq in enumerate(active):
+            seq.cache_len += 1
+            self._emit(seq, int(host[i]))
+        if chunk_seq is not None:
+            chunk_seq.prefill_pos += n_valid
+            chunk_seq.cache_len = chunk_seq.prefill_pos
+            if final:
+                # full prompt resident: publish its pages for sharing,
+                # then the last valid row's logits (logit_rows[ndec])
+                # pick the first token
+                self._register_prefix(chunk_seq)
+                self._emit(chunk_seq, int(host[ndec]))
+
+    # -- prefill (dense mode) ----------------------------------------------
     def _prefill_one(self) -> None:
-        """Run ONE prompt chunk for the oldest sequence still prefilling
-        — interleaved with decode steps so prefill never starves the
-        running batch."""
+        """Run ONE prompt prefill for the oldest sequence still waiting
+        (dense escape-hatch mode only; paged mode fuses prefill into
+        :meth:`_fused_step`)."""
         pre = [s for s in self._running if s.state == _PREFILL]
         if not pre:
             return
         seq = min(pre, key=lambda s: s.admit_no)
         if self._expired(seq):
             return
-        if self.config.mode == "dense":
-            self._dense_prefill(seq)
-            return
-        from nornicdb_tpu.models import qwen2
-        import jax.numpy as jnp
-
-        remaining = len(seq.prefill_tokens) - seq.prefill_pos
-        chunk = min(self._prefill_chunk,
-                    qwen2.round_up_pow2(remaining, 16))
-        piece = seq.prefill_tokens[seq.prefill_pos:seq.prefill_pos + chunk]
-        n_valid = len(piece)
-        # pad-then-truncate so the operand length is the pow2-bucketed
-        # `chunk` by construction, never the request-dependent n_valid
-        padded = (piece + [0] * chunk)[:chunk]
-        t0 = time.perf_counter()
-        params = self._active_params()
-        self.programs.add(("prefill", chunk, self._table_width))
-        _deviceprof.record_compile("genserve", "prefill",
-                                   f"c{chunk}x{self._table_width}")
-        final = seq.prefill_pos + n_valid >= len(seq.prefill_tokens)
-        # the chunk belongs to exactly one request: attach its captured
-        # context so genserve.prefill lands in the SUBMITTER's trace
-        # instead of floating detached on the scheduler thread
-        with self._platform_ctx():
-            with _tracer.attach(seq.trace_ctx):
-                with _tracer.span("genserve.prefill",
-                                  {"chunk": chunk, "valid": n_valid}):
-                    try:
-                        logits, self._pages = qwen2.paged_prefill_chunk(
-                            params, self.cfg,
-                            jnp.asarray(padded, jnp.int32), self._pages,
-                            jnp.asarray(seq.page_table),
-                            jnp.asarray(seq.prefill_pos),
-                            jnp.asarray(n_valid))
-                    except Exception:
-                        # the failing dispatch may have CONSUMED the
-                        # donated pool (donate_argnums): drop it at the
-                        # dispatch site so _ensure_pool rebuilds from
-                        # scratch, whatever the caller does (NL-JAX04)
-                        self._pages = None
-                        raise
-                    # argmax ON DEVICE: only the winning token id crosses
-                    # to host, never the (V,) logits row (and
-                    # intermediate chunks transfer nothing at all) — a
-                    # deliberately bounded 4-byte sync, the step's output
-                    # nornlint: disable=NL-JAX06
-                    tok = int(jnp.argmax(logits)) if final else None
-        dt = time.perf_counter() - t0
-        _stats.PREFILL_HIST.observe(dt)
-        _deviceprof.record_execute("genserve", "prefill",
-                                   f"c{chunk}x{self._table_width}", dt)
-        self.stats.prefill_chunks += 1
-        seq.prefill_pos += n_valid
-        seq.cache_len = seq.prefill_pos
-        if final:
-            # final chunk: its last-position logits pick the continuation
-            self._emit(seq, tok)
+        self._dense_prefill(seq)
 
     def _dense_prefill(self, seq: _Seq) -> None:
         """mode="dense" fallback: per-sequence dense (1, Tmax) cache, the
@@ -910,6 +1254,12 @@ class GenerationEngine:
             tok = int(jnp.argmax(logits[0]))
         _stats.PREFILL_HIST.observe(time.perf_counter() - t0)
         self.stats.prefill_chunks += 1
+        if seq.re_prefill:
+            self.stats.prefill_tokens_re += len(toks)
+            _stats.PREFILL_TOKENS.labels("re").inc(len(toks))
+        else:
+            self.stats.prefill_tokens_first += len(toks)
+            _stats.PREFILL_TOKENS.labels("first").inc(len(toks))
         seq.prefill_pos = len(toks)
         seq.dense_len = len(toks)
         seq.cache_len = len(toks)
@@ -945,79 +1295,12 @@ class GenerationEngine:
             return True
         return False
 
-    # -- decode ------------------------------------------------------------
+    # -- decode (dense mode) -----------------------------------------------
     def _decode_step(self) -> None:
         active = [s for s in self._running if s.state == _DECODE]
         active = [s for s in active if not self._expired(s)]
-        if not active:
-            return
-        if self.config.mode == "dense":
-            for seq in active:
-                self._dense_decode(seq)
-            return
-        from nornicdb_tpu.models import qwen2
-        import jax.numpy as jnp
-
-        # page growth first, for side effects only: a shed or evicted
-        # sequence leaves self._running and the re-filter below drops it
-        for seq in list(active):
-            if seq in self._running:
-                self._grow(seq)
-        active = [s for s in active if s in self._running
-                  and s.state == _DECODE]
-        if not active:
-            return
-        b_real = len(active)
-        b = qwen2.round_up_pow2(b_real, 1)
-        tokens = np.zeros((b,), np.int32)
-        tables = np.zeros((b, self._table_width), np.int32)
-        lengths = np.zeros((b,), np.int32)
-        for i, seq in enumerate(active):
-            tokens[i] = seq.out[-1]
-            tables[i] = seq.page_table
-            lengths[i] = seq.cache_len
-        t0 = time.perf_counter()
-        params = self._active_params()
-        self.programs.add(("decode", b, self._table_width))
-        _deviceprof.record_compile("genserve", "decode",
-                                   f"b{b}x{self._table_width}")
-        # the batched step serves MANY requests: the span attaches to the
-        # leader's trace (oldest running, the QueryBatcher convention)
-        # and links every other batched request's trace id, so each
-        # request's tree can find the shared device work
-        leader_ctx = next(
-            (s.trace_ctx for s in active if s.trace_ctx is not None), None)
-        links = sorted({tid for s in active
-                        if (tid := s.trace_id) is not None})
-        with self._platform_ctx():
-            with _tracer.attach(leader_ctx):
-                with _tracer.span("genserve.decode",
-                                  {"batch": b_real, "links": links}):
-                    try:
-                        logits, self._pages = qwen2.paged_decode_step(
-                            params, self.cfg, jnp.asarray(tokens),
-                            self._pages,
-                            jnp.asarray(tables), jnp.asarray(lengths))
-                    except Exception:
-                        # failing step may have CONSUMED the donated
-                        # pool: drop it here so _ensure_pool rebuilds,
-                        # whatever the caller does (NL-JAX04)
-                        self._pages = None
-                        raise
-                    # greedy argmax on device: (B,) ints cross to host,
-                    # not the (B, V) logits (~MBs/step at real vocabs) —
-                    # a bounded 4B-per-lane sync, the step's output
-                    # nornlint: disable=NL-JAX06
-                    host = np.asarray(jnp.argmax(logits, axis=-1))
-        dt = time.perf_counter() - t0
-        _stats.DECODE_HIST.observe(dt)
-        _deviceprof.record_execute("genserve", "decode",
-                                   f"b{b}x{self._table_width}", dt)
-        self.stats.decode_steps += 1
-        self.stats.decode_lane_tokens += b_real
-        for i, seq in enumerate(active):
-            seq.cache_len += 1
-            self._emit(seq, int(host[i]))
+        for seq in active:
+            self._dense_decode(seq)
 
     def _dense_decode(self, seq: _Seq) -> None:
         from nornicdb_tpu.models import qwen2
@@ -1055,6 +1338,7 @@ class GenerationEngine:
             out["queue_depth"] = len(self._queue)
         out["running_seqs"] = len(self._running)
         out["free_pages"] = len(self._free_pages)
+        out["prefix_pages"] = len(self._prefix_cache)
         out["usable_pages"] = self._usable_pages
         out["page_size"] = self._page_size
         out["mode"] = self.config.mode

@@ -23,8 +23,22 @@ from nornicdb_tpu.errors import NotFoundError
 
 logger = logging.getLogger(__name__)
 
+# Standardized instruction preamble shared VERBATIM by every GraphRAG
+# prompt: its token ids are identical across requests, so the engine's
+# shared-prefix KV cache turns the whole block into page-table hits
+# after the first request — deliberately long enough to span multiple
+# KV pages at the default page_size. Keep it byte-stable: any edit
+# invalidates every cached prefix page at once.
 _PROMPT_HEADER = (
-    "Answer the question from the graph context below. Be concise.\n"
+    "You are the NornicDB graph assistant. Answer the question strictly "
+    "from the graph context below; do not invent nodes, relationships, "
+    "or properties that are not present. Context lines are ranked most "
+    "relevant first and each one is prefixed with its node id in square "
+    "brackets. Relationship lines describe directed edges between node "
+    "ids in the form start -TYPE-> end. Prefer information from "
+    "higher-ranked lines when sources conflict, cite node ids where "
+    "they support the answer, and if the context does not contain the "
+    "answer, say so plainly instead of guessing. Be concise.\n"
 )
 
 
@@ -125,12 +139,14 @@ class GraphRAGService:
             32, int(self.config.max_seq_tokens) - max_new - 8)
         prompt = self.build_prompt(question, hits, edges, budget)
         generated = 0
+        prefix_reused = 0
         if engine is not None:
             handle = engine.submit(
                 engine.tokenizer.encode(prompt, add_special=False),
                 max_new_tokens=max_new, deadline_ms=deadline_ms)
             answer = handle.text()  # ResourceExhausted -> 429 at the edge
             generated = len(handle.tokens)
+            prefix_reused = getattr(handle, "prefix_reused_tokens", 0)
             mode = engine.config.mode
         else:
             # extractive fallback: no generation weights mounted — answer
@@ -157,6 +173,7 @@ class GraphRAGService:
                 "prompt_tokens_est": len(prompt.split()),
             },
             "generated_tokens": generated,
+            "prefix_reused_tokens": prefix_reused,
             "timings_ms": {
                 "retrieve": round(t_retrieve * 1e3, 3),
                 "total": round((time.perf_counter() - t0) * 1e3, 3),

@@ -65,3 +65,29 @@ REQUESTS = _REGISTRY.counter(
 )
 for _outcome in ("ok", "shed", "error"):
     REQUESTS.labels(_outcome)
+# prefill tokens split by pass: "first" is the initial prompt pass,
+# "re" is tokens re-prefilled after a youngest-eviction requeue — the
+# bench's prefill-throughput number must use "first" only (counting
+# re-prefill inflates it with work the pool pressure forced, not work
+# the offered load asked for)
+PREFILL_TOKENS = _REGISTRY.counter(
+    "nornicdb_genserve_prefill_tokens_total",
+    "Prompt tokens prefilled, split by pass (first = initial prompt "
+    "pass, re = re-prefill after eviction requeue)",
+    labels=("pass",),
+)
+for _pass in ("first", "re"):
+    PREFILL_TOKENS.labels(_pass)
+# shared-prefix KV cache: a hit means one whole prompt-prefix page was
+# adopted from the pool instead of re-prefilled; hits * page_size is the
+# prefill work the cache elided (ttft saved is roughly proportional)
+PREFIX_HITS = _REGISTRY.counter(
+    "nornicdb_genserve_prefix_hits_total",
+    "Shared-prefix cache hits (whole KV pages adopted at admission "
+    "instead of prefilled)",
+)
+PREFIX_PAGES = _REGISTRY.gauge(
+    "nornicdb_genserve_prefix_pages",
+    "KV pages currently indexed by the shared-prefix cache (resident "
+    "and adoptable, whether or not any sequence holds them)",
+)

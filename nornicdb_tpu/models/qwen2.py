@@ -17,6 +17,7 @@ from dataclasses import dataclass
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 from nornicdb_tpu.models.layers import (
     apply_rope,
@@ -411,6 +412,167 @@ def paged_prefill_chunk(params, cfg: QwenConfig, chunk_ids: jax.Array,
     logits = _logits(params, cfg, h)[0]  # (C, V)
     last = jnp.clip(n_valid - 1, 0, c - 1)
     return logits[last], pages
+
+
+# -- ragged fused step (genserve v2) -----------------------------------------
+#
+# ONE device program per scheduler iteration serving mixed prefill + decode
+# (Ragged Paged Attention, PAPERS.md): the per-phase paged_prefill_chunk /
+# paged_decode_step pair above is kept as the primitive the equivalence
+# suite drives directly, but the engine now submits a single fused step.
+#
+# Layout: everything row-independent (embeddings, norms, QKV/O/MLP GEMMs,
+# rope) runs on a FLAT (F, 1, hidden) token batch — F is the pow2 bucket
+# of (#decode lanes + prefill-chunk valid tokens), so the GEMM work
+# scales with real tokens, not lanes x chunk. Only attention needs lane
+# structure, and the two ragged shapes are served by two SMALL padded
+# blocks inside the one program (one device dispatch) instead of one
+# (Lmax, Tq) cross-product block whose Lmax*Tq padded query rows would
+# dwarf the ~Lmax+Tq real ones:
+#   decode block (Lmax, 1)  single-token lanes, scattered by lane_id
+#   chunk  block (1, Tq)    the prefill chunk, scattered by lane_pos
+# Lane roles are FIXED by lane_id so the split needs no dynamic count:
+# rows with lane_id < Lmax-2 are decode lanes, lane_id == Lmax-2 is THE
+# chunk lane, lane_id == Lmax-1 is the dump lane for padding rows.
+# Per-row metadata:
+#   lane_id (F,)   attention lane for the row (see roles above)
+#   lane_pos (F,)  query slot within the lane (decode rows 0, chunk rows
+#                  their chunk offset)
+#   positions (F,) cache slot the row writes+attends at; -1 = padding
+#   logit_rows (Lmax,) flat row indices whose logits the caller wants
+#                  (the decode rows + the chunk's last valid row) — the
+#                  vocab projection runs on Lmax rows, not F
+# All int32 metadata travels in ONE packed host array (one H2D per step
+# instead of six — the scheduler dispatches this thousands of times a
+# second), and the greedy argmax runs inside the program, so a steady
+# step is exactly one dispatch and one (Lmax,) device->host read.
+# Padding rows route their page writes to NULL_PAGE and mask every key
+# slot; their attention output is garbage never gathered. Masked slots
+# add -1e30 before the f32 softmax, so exp underflows to exactly 0.0 and
+# null/foreign page content contributes nothing — the fused logits stay
+# bit-identical to the sequential chunk-then-decode programs.
+
+
+def pack_ragged_meta(lmax: int, w: int, f: int):
+    """Allocate the packed int32 metadata array for one fused step and
+    return (meta, views): views are writable slices (tokens, lane_id,
+    lane_pos, positions, logit_rows, lane_tables) of ``meta``."""
+    meta = np.empty((4 * f + lmax + lmax * w,), np.int32)
+    tokens = meta[:f]
+    lane_id = meta[f:2 * f]
+    lane_pos = meta[2 * f:3 * f]
+    positions = meta[3 * f:4 * f]
+    logit_rows = meta[4 * f:4 * f + lmax]
+    lane_tables = meta[4 * f + lmax:].reshape(lmax, w)
+    return meta, (tokens, lane_id, lane_pos, positions, logit_rows,
+                  lane_tables)
+
+
+@functools.partial(
+    jax.jit, static_argnames=("cfg", "lmax", "w", "tq", "attn_impl"),
+    donate_argnums=(3,),
+)
+def ragged_fused_step(params, cfg: QwenConfig, meta: jax.Array,
+                      pages: jax.Array, *, lmax: int, w: int, tq: int,
+                      attn_impl: str = "xla"):
+    """One fused prefill+decode step over the paged pool.
+
+    meta: the packed int32 array from :func:`pack_ragged_meta` —
+    (F,) tokens/lane_id/lane_pos/positions flat rows (see module note),
+    (Lmax,) logit_rows, and the (Lmax, P) per-lane page tables (row
+    Lmax-2 is the chunk lane's table); ``tq`` is the static query width
+    of the chunk attention block — ``tq == 1`` declares a decode-only
+    step (no row may carry the chunk lane id); ``attn_impl`` picks "xla"
+    (block-gather reference), "pallas" (ragged TPU kernel) or
+    "pallas_interpret" (kernel under the CPU interpreter, tests).
+    Returns ((Lmax,) greedy token ids, (Lmax, V) f32 logits for
+    ``logit_rows``, advanced pages); ``pages`` is DONATED.
+    """
+    f = (meta.shape[0] - lmax - lmax * w) // 4
+    tokens = meta[:f]
+    lane_id = meta[f:2 * f]
+    lane_pos = meta[2 * f:3 * f]
+    positions = meta[3 * f:4 * f]
+    logit_rows = meta[4 * f:4 * f + lmax]
+    lane_tables = meta[4 * f + lmax:].reshape(lmax, w)
+    p = w
+    ps = pages.shape[3]
+    max_len = p * ps
+    head_dim = cfg.hidden // cfg.heads
+    full_angles = rope_freqs(head_dim, max_len, cfg.rope_theta)
+    valid = positions >= 0
+    pos_c = jnp.clip(positions, 0, max_len - 1)
+    angles = full_angles[pos_c][:, None, :]          # (F, 1, Dh/2)
+    lane_c = jnp.clip(lane_id, 0, lmax - 1)
+    slot_c = jnp.clip(lane_pos, 0, tq - 1)
+    is_chunk = lane_id == lmax - 2
+    # non-decode rows scatter to the dump lane; chunk/pad collisions
+    # there are harmless (masked, never gathered)
+    dec_lane = jnp.where(is_chunk, lmax - 1, lane_c)
+    phys = jnp.where(
+        valid, lane_tables[lane_c, jnp.clip(pos_c // ps, 0, p - 1)],
+        NULL_PAGE)
+    off = pos_c % ps
+    pos_dec = jnp.full((lmax, 1), -1, jnp.int32).at[dec_lane, 0].set(
+        jnp.where(valid & ~is_chunk, positions, -1))
+    slot = jax.lax.broadcasted_iota(jnp.int32, (1, max_len), 1)
+    mask_dec = jnp.where(slot[None] <= pos_dec[:, :, None],
+                         0.0, -1e30)[:, None]
+    if tq > 1:
+        # chunk rows scatter into the (1, Tq) block; every other row's
+        # index lands out of bounds on the lane axis and is dropped
+        chunk_row = jnp.where(is_chunk & valid, 0, 1)
+        pos_chk = jnp.full((1, tq), -1, jnp.int32).at[
+            chunk_row, slot_c].set(positions, mode="drop")
+        slot_q = jax.lax.broadcasted_iota(jnp.int32, (tq, max_len), 1)
+        mask_chk = jnp.where(slot_q[None] <= pos_chk[:, :, None],
+                             0.0, -1e30)[:, None]
+        chunk_table = lane_tables[lmax - 2][None]
+    h = params["tok_emb"][tokens][:, None]           # (F, 1, hidden)
+    for li, blk in enumerate(params["blocks"]):
+        x = rms_norm(blk["attn_norm"], h, cfg.rms_eps)
+        q = dense(blk["q"], x).reshape(f, 1, cfg.heads, head_dim)
+        k = dense(blk["k"], x).reshape(f, 1, cfg.kv_heads, head_dim)
+        v = dense(blk["v"], x).reshape(f, 1, cfg.kv_heads, head_dim)
+        q = _apply_rope_rows(q, angles)
+        k = _apply_rope_rows(k, angles)
+        pages = pages.at[li, 0, phys, off].set(k[:, 0])
+        pages = pages.at[li, 1, phys, off].set(v[:, 0])
+        q_dec = jnp.zeros((lmax, 1, cfg.heads, head_dim), q.dtype)
+        q_dec = q_dec.at[dec_lane, 0].set(q[:, 0])
+        if attn_impl == "xla":
+            o_dec = _paged_attention(cfg, pages, li, lane_tables, q_dec,
+                                     mask_dec)
+        else:
+            from nornicdb_tpu.ops import pallas_kernels as _pk
+
+            o_dec = _pk.ragged_paged_attention(
+                q_dec, pages[li, 0], pages[li, 1], lane_tables, pos_dec,
+                interpret=(attn_impl == "pallas_interpret"))
+        o = o_dec[dec_lane, 0]                       # (F, H, Dh)
+        if tq > 1:
+            q_chk = jnp.zeros((1, tq, cfg.heads, head_dim), q.dtype)
+            q_chk = q_chk.at[chunk_row, slot_c].set(q[:, 0], mode="drop")
+            if attn_impl == "xla":
+                o_chk = _paged_attention(cfg, pages, li, chunk_table,
+                                         q_chk, mask_chk)
+            else:
+                from nornicdb_tpu.ops import pallas_kernels as _pk
+
+                o_chk = _pk.ragged_paged_attention(
+                    q_chk, pages[li, 0], pages[li, 1], chunk_table,
+                    pos_chk, interpret=(attn_impl == "pallas_interpret"))
+            o = jnp.where(is_chunk[:, None, None], o_chk[0, slot_c], o)
+        o = o[:, None]                               # (F, 1, H, Dh)
+        h = h + dense(blk["o"], o.reshape(f, 1, cfg.heads * head_dim))
+        x = rms_norm(blk["mlp_norm"], h, cfg.rms_eps)
+        h = h + dense(
+            blk["down"], jax.nn.silu(dense(blk["gate"], x)) * dense(blk["up"], x)
+        )
+    h = rms_norm(params["final_norm"], h, cfg.rms_eps)
+    h_sel = h[jnp.clip(logit_rows, 0, f - 1)]        # (Lmax, 1, hidden)
+    logits = _logits(params, cfg, h_sel)[:, 0, :]
+    return jnp.argmax(logits, axis=-1), logits, pages
 
 
 def generate(

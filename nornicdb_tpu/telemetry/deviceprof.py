@@ -52,14 +52,15 @@ log = logging.getLogger(__name__)
 # components rendered eagerly so the tested docs/observability.md catalog
 # exposes the family (at 0) before any device buffer exists
 HBM_COMPONENTS = (
-    "corpus_f32", "corpus_int8", "ivf", "kv_pages", "embedder_params",
+    "corpus_f32", "corpus_int8", "ivf", "kv_pages", "kv_prefix",
+    "embedder_params",
 )
 
 _HBM = _REGISTRY.gauge(
     "nornicdb_hbm_bytes",
     "Device-resident bytes by component (corpus f32 buffers, int8 "
-    "codes+scales, IVF block arrays, genserve KV page pool, embedder "
-    "params)",
+    "codes+scales, IVF block arrays, genserve KV page pool, the pool "
+    "slice held by the shared-prefix cache, embedder params)",
     labels=("component",),
 )
 _HBM_CELLS = {c: _HBM.labels(c) for c in HBM_COMPONENTS}

@@ -611,20 +611,24 @@ class TestDonationExceptionPaths:
     def test_prefill_failure_drops_donated_pool(self, monkeypatch):
         eng = self._manual_engine(monkeypatch)
         eng.submit([1, 2, 3], max_new_tokens=2)
-        monkeypatch.setattr(qwen2, "paged_prefill_chunk", self._boom)
+        monkeypatch.setattr(qwen2, "ragged_fused_step", self._boom)
         with pytest.raises(RuntimeError, match="injected"):
             eng._step()
         assert eng._pages is None, (
             "failing donated prefill left self._pages referencing the "
             "consumed pool"
         )
+        assert eng._prefix_cache == {}, (
+            "prefix cache survived the pool it indexes being dropped"
+        )
 
     def test_decode_failure_drops_donated_pool(self, monkeypatch):
         eng = self._manual_engine(monkeypatch)
         eng.submit([1, 2, 3], max_new_tokens=4)
-        monkeypatch.setattr(qwen2, "paged_decode_step", self._boom)
-        # one _step admits + prefills (chunk 32 covers the prompt), then
-        # runs the decode step, which raises
+        # first _step admits + prefills (chunk covers the prompt) and
+        # emits the first token; the SECOND fused step is pure-decode
+        eng._step()
+        monkeypatch.setattr(qwen2, "ragged_fused_step", self._boom)
         with pytest.raises(RuntimeError, match="injected"):
             eng._step()
         assert eng._pages is None, (
