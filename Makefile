@@ -19,7 +19,7 @@ sanitize:
 # compile is attributed to a (subsystem, kind, shape) key and any test
 # that compiles after its declared warmup fails (docs/linting.md#nornjit)
 jitgate:
-	NORNJIT=1 python -m pytest tests/test_serving.py tests/test_genserve.py tests/test_sharded_serving.py tests/test_nornjit.py -q -m 'not slow'
+	NORNJIT=1 python -m pytest tests/test_serving.py tests/test_genserve.py tests/test_sharded_serving.py tests/test_nornjit.py tests/test_columnar.py -q -m 'not slow'
 
 # search/embed suite with the accelerator backend forced to hang: the
 # lifecycle manager must keep the stack serving from CPU (docs/backend.md)

@@ -55,15 +55,14 @@ def main():
         ("residual mix", "MATCH (p:Person) WHERE p.age > 40 AND (p.i % 2) = 0 RETURN p.i"),
     ]
     print(f"{n} nodes")
-    orig_fp = ex._try_fastpath
     orig_scan = ex._match_scan_fast
     for name, q in queries:
-        # generic baseline: every shortcut off (the enabled flag alone does
-        # not gate the count fastpaths)
-        ex._try_fastpath = lambda q_, p: None
+        # generic baseline: columnar engine + scan shortcut off (the old
+        # executor pattern-fastpath family is retired into columnar)
+        ex.columnar.enabled = False
         ex._match_scan_fast = lambda c, r, p: None
         g_ms, g_rows = bench(ex, q)
-        ex._try_fastpath = orig_fp
+        ex.columnar.enabled = True
         ex._match_scan_fast = orig_scan
         set_parallel_config(ParallelConfig())
         f_ms, f_rows = bench(ex, q)

@@ -214,6 +214,26 @@ class ColumnarScanIndex:
                 out.append(col[i] if i is not None else None)
             return out
 
+    def epoch(self) -> int:
+        """Monotone node-event counter: any cached derivation of column
+        state (e.g. the VectorTopK embedding matrix) is valid exactly
+        while the epoch it was built under still holds."""
+        with self._lock:
+            return self._epoch
+
+    def embedding_snapshot(
+        self, label: str, key: str
+    ) -> Optional[tuple[int, list[str], list]]:
+        """(epoch, ids, values) for one label property column — shallow
+        copies taken under the lock, so the caller can run the expensive
+        float conversion/normalization outside it and re-validate against
+        ``epoch()`` before caching. None in a busy build window."""
+        lc = self._get(label)
+        if lc is None:
+            return None
+        with self._lock:
+            return self._epoch, list(lc.ids), list(lc.column(key))
+
     def label_ids(self, label: str) -> Optional[list[str]]:
         """Ids of every node carrying `label` (unsorted — callers order),
         or None when the index can't serve (busy build window). Feeds the

@@ -3,8 +3,9 @@
 This module retires the executor's ad-hoc pattern-fastpath family
 (``query_patterns.go`` / ``optimized_executors.go`` in the reference) into
 one architecture: a planner pattern-compiles a ``Query`` AST into a DAG of
-batched array operators — NodeScan / Filter / Expand / Aggregate /
-Project / Sort-Limit — evaluated over:
+batched array operators — NodeScan / Filter / Expand / VarLenExpand /
+JoinCheck / With / Aggregate / Project / Sort-Limit / VectorTopK —
+evaluated over:
 
 * the PR 4 CSR snapshot (``storage/adjacency.py``): per-direction
   ``offsets``/``neighbors``/``edge_rows`` arrays plus per-edge
@@ -33,6 +34,14 @@ late because WHERE is conjunctive and every filter here is
 order-stable).  Shapes with no plannable prefix return to the generic
 engine untouched.
 
+**Clause boundaries don't stop the pipeline** (PR 19): multi-MATCH
+queries hash-join/cartesian against the standing id columns, ``WITH``
+projects or aggregates the table in place (value columns cross the
+boundary as plain row-aligned lists), bounded var-length hops
+(``*min..max``) run as batched per-level CSR gathers with rank-lexsorted
+emission, and edge-property filters/aggregates ride the CSR-resident
+edge property columns.
+
 **Device offload**: scoring-heavy Sort/Limit plans (large N, small K,
 single numeric key) use the accelerator's ``top_k`` to find the boundary
 value, then host-sort only the surviving candidate set — results remain
@@ -40,6 +49,16 @@ bit-identical because ties at the boundary are widened before the exact
 stable sort.  The offload gates on the PR 6 backend manager's
 *non-blocking* readiness check: a hung device means host columnar, never
 a wedged query (the soak's hang-window invariant).
+
+**VectorTopK** (PR 19 headline): ``MATCH ... WHERE <preds> ORDER BY
+vector.similarity.cosine(n.emb, $q) [DESC] LIMIT k`` plans the ranking
+as a device GEMM operator: graph-predicate survivors become a validity
+mask over a cached label-wide normalized embedding matrix
+(epoch-validated against the colindex), ``masked_dot_topk`` finds the
+k-th boundary on device (host numpy GEMM when the backend isn't ready),
+and the widened boundary cut is exact-rescored on host with the real
+``vector.similarity.cosine`` so ordering — ties, nulls, errors included
+— bit-matches the interpreter.
 """
 
 from __future__ import annotations
@@ -156,6 +175,10 @@ class _State:
         self.n = 0
         self.node_cols: dict[str, np.ndarray] = {}
         self.edge_cols: dict[str, np.ndarray] = {}
+        # WITH-projected value columns (plain Python lists, row-aligned):
+        # aggregates, property projections and constants that survive a
+        # clause boundary without ever becoming generic binding rows
+        self.val_cols: dict[str, list] = {}
         self.version = 0
         self.peak_rows = 0
         # var -> single label every row of that column is known to carry
@@ -174,6 +197,7 @@ class _State:
         self.n = len(idx)
         self.node_cols = {var: idx}
         self.edge_cols = {}
+        self.val_cols = {}
         self.version += 1
         self.peak_rows = max(self.peak_rows, self.n)
         if objs is not None:
@@ -181,8 +205,40 @@ class _State:
         if label is not None:
             self.var_label[var] = label
 
-    def apply_mask(self, mask: np.ndarray) -> None:
-        sel = np.nonzero(mask)[0]
+    def root(self, var: str, idx: np.ndarray,
+             objs: Optional[list] = None,
+             label: Optional[str] = None) -> None:
+        """Root a new pattern chain: first chain seeds the table, later
+        chains cartesian-join against it (row-major × id-sorted candidate
+        order — exactly the generic nested-loop enumeration)."""
+        if not self.node_cols and not self.edge_cols and not self.val_cols:
+            self.set_initial(var, idx, objs, label)
+            return
+        n_old, m = self.n, len(idx)
+        sel = np.repeat(np.arange(n_old, dtype=np.int64), m)
+        self.version += 1
+        self._objs.clear()
+        self._edge_objs.clear()
+        self._row_ids.clear()
+        for k, col in self.node_cols.items():
+            self.node_cols[k] = col[sel]
+        for k, col in self.edge_cols.items():
+            self.edge_cols[k] = col[sel]
+        if self.val_cols:
+            sel_list = sel.tolist()
+            for k, col in self.val_cols.items():
+                self.val_cols[k] = [col[i] for i in sel_list]
+        self.node_cols[var] = np.tile(idx, n_old)
+        self.n = n_old * m
+        self.peak_rows = max(self.peak_rows, self.n)
+        if objs is not None:
+            self._objs[(var, self.version)] = objs * n_old
+        if label is not None:
+            self.var_label[var] = label
+
+    def apply_sel(self, sel: np.ndarray) -> None:
+        """Gather every column through ``sel`` (filter survivors, sort
+        permutation, slice) — memoized materializations re-key along."""
         old_version = self.version
         self.version += 1
         for k, col in self.node_cols.items():
@@ -191,6 +247,8 @@ class _State:
             self.edge_cols[k] = col[sel]
         # re-key surviving materializations instead of refetching
         sel_list = sel.tolist()
+        for k, col in self.val_cols.items():
+            self.val_cols[k] = [col[i] for i in sel_list]
         for (var, ver), objs in list(self._objs.items()):
             if ver == old_version:
                 self._objs[(var, self.version)] = [objs[i] for i in sel_list]
@@ -204,9 +262,13 @@ class _State:
                                                       for i in sel_list]
         self.n = len(sel)
 
+    def apply_mask(self, mask: np.ndarray) -> None:
+        self.apply_sel(np.nonzero(mask)[0])
+
     def apply_expand(self, src_row: np.ndarray, dst_var: Optional[str],
-                     dst_idx: Optional[np.ndarray], edge_var: str,
-                     edge_rows: np.ndarray) -> None:
+                     dst_idx: Optional[np.ndarray],
+                     edge_var: Optional[str],
+                     edge_rows: Optional[np.ndarray]) -> None:
         self.version += 1
         self._objs.clear()   # refetched lazily against the new row set
         self._edge_objs.clear()
@@ -215,11 +277,31 @@ class _State:
             self.node_cols[k] = col[src_row]
         for k, col in self.edge_cols.items():
             self.edge_cols[k] = col[src_row]
+        if self.val_cols:
+            src_list = src_row.tolist()
+            for k, col in self.val_cols.items():
+                self.val_cols[k] = [col[i] for i in src_list]
         if dst_var is not None and dst_idx is not None:
             self.node_cols[dst_var] = dst_idx
-        self.edge_cols[edge_var] = edge_rows
+        if edge_var is not None and edge_rows is not None:
+            self.edge_cols[edge_var] = edge_rows
         self.n = len(src_row)
         self.peak_rows = max(self.peak_rows, self.n)
+
+    def replace_table(self, node_cols: dict, edge_cols: dict,
+                      val_cols: dict, var_label: dict, n: int) -> None:
+        """Swap in a WITH projection's binding table: the old variable
+        namespace is gone, only the projected aliases survive."""
+        self.version += 1
+        self._objs.clear()
+        self._edge_objs.clear()
+        self._row_ids.clear()
+        self.node_cols = node_cols
+        self.edge_cols = edge_cols
+        self.val_cols = val_cols
+        self.var_label = var_label
+        self.n = n
+        self.peak_rows = max(self.peak_rows, n)
 
     # -- gathers -----------------------------------------------------------
     def node_objects(self, var: str) -> list:
@@ -267,7 +349,13 @@ class _State:
 
     def prop_column(self, var: str, key: str) -> list:
         if var not in self.node_cols:
-            return _ObjSource(self.edge_objects(var)).column(key)
+            # CSR-resident edge property columns: one row-aligned gather,
+            # no per-edge materialization (the retired _fp_edge_agg scan)
+            rows = self.edge_cols[var]
+            col = self.view.edge_prop_column(key)
+            if col is None:
+                return [None] * len(rows)
+            return [col[r] for r in rows.tolist()]
         label = self.var_label.get(var)
         if label is not None and (var, self.version) not in self._objs:
             colind = _colindex_for(self.ex, label)
@@ -299,12 +387,16 @@ class _State:
 
     # -- generic-row materialization --------------------------------------
     def materialize_rows(self, named_node_vars: list[str],
-                         named_edge_vars: list[str]) -> list[dict]:
+                         named_edge_vars: list[str],
+                         named_val_vars: Optional[list[str]] = None,
+                         ) -> list[dict]:
         cols: dict[str, list] = {}
         for var in named_node_vars:
             cols[var] = self.node_objects(var)
         for var in named_edge_vars:
             cols[var] = self.edge_objects(var)
+        for var in (named_val_vars or ()):
+            cols[var] = self.val_cols[var]
         names = list(cols)
         lists = [cols[v] for v in names]
         return [dict(zip(names, vals)) for vals in zip(*lists)] \
@@ -329,6 +421,49 @@ def _ids_to_idx(st: _State, ids: list[str]) -> np.ndarray:
         # window — serve this query generically rather than drop rows
         raise _Bail("scan id missing from snapshot vocab")
     return idx
+
+
+def _scan_cache_get(st: _State, labels: tuple) -> Optional[np.ndarray]:
+    """Cross-query memo of a sorted label scan's vocab indices.  Sound
+    because the entry pins both the snapshot object (vocab identity) and
+    every label's colindex epoch (membership): any node event bumps the
+    epoch, any vocab rebuild replaces the snapshot."""
+    ceng = getattr(st.ex, "columnar", None)
+    if ceng is None:
+        return None
+    with ceng._scan_lock:
+        hold = ceng._scan_cache
+        if hold is None or hold[0] is not st.snap:
+            return None
+        hit = hold[1].get(labels)
+    if hit is None:
+        return None
+    epochs, idx = hit
+    for label, ep in zip(labels, epochs):
+        colind = _colindex_for(st.ex, label)
+        if colind is None or colind.epoch() != ep:
+            return None
+    return idx
+
+
+def _scan_cache_put(st: _State, labels: tuple, idx: np.ndarray) -> None:
+    ceng = getattr(st.ex, "columnar", None)
+    if ceng is None:
+        return
+    epochs = []
+    for label in labels:
+        colind = _colindex_for(st.ex, label)
+        if colind is None:
+            return
+        epochs.append(colind.epoch())
+    with ceng._scan_lock:
+        hold = ceng._scan_cache
+        if hold is None or hold[0] is not st.snap:
+            hold = (st.snap, {})
+            ceng._scan_cache = hold
+        if len(hold[1]) >= 16:
+            hold[1].clear()
+        hold[1][labels] = (tuple(epochs), idx)
 
 
 class AnchorScanOp(_Op):
@@ -361,14 +496,14 @@ class AnchorScanOp(_Op):
                 props = ex.matcher._node_props(self.pat, {}, st.params)
                 ids = colind.prop_match_ids(label, props or {})
                 if ids is not None:
-                    st.set_initial(self.var, _ids_to_idx(st, sorted(ids)),
-                                   label=label)
+                    st.root(self.var, _ids_to_idx(st, sorted(ids)),
+                            label=label)
                     return
         nodes = ex.matcher._candidates(self.pat, {}, st.params)
         idx = _ids_to_idx(st, [n.id for n in nodes])
-        st.set_initial(self.var, idx, objs=nodes,
-                       label=self.pat.labels[0]
-                       if len(self.pat.labels) == 1 else None)
+        st.root(self.var, idx, objs=nodes,
+                label=self.pat.labels[0]
+                if len(self.pat.labels) == 1 else None)
 
 
 class LabelScanOp(_Op):
@@ -380,6 +515,12 @@ class LabelScanOp(_Op):
         self.label = f"NodeScan({var}:{':'.join(labels)})"
 
     def run(self, st: _State):
+        lbl = self.labels[0] if len(self.labels) == 1 else None
+        key = tuple(self.labels)
+        idx = _scan_cache_get(st, key)
+        if idx is not None:
+            st.root(self.var, idx, label=lbl)
+            return
         ids: Optional[set[str]] = set()
         for label in self.labels:
             colind = _colindex_for(st.ex, label)
@@ -396,11 +537,11 @@ class LabelScanOp(_Op):
                     seen[n.id] = n
             ordered = sorted(seen)
             objs = [seen[i] for i in ordered]
+            idx = _ids_to_idx(st, ordered)
         else:
-            ordered = sorted(ids)
-        st.set_initial(self.var, _ids_to_idx(st, ordered), objs=objs,
-                       label=self.labels[0]
-                       if len(self.labels) == 1 else None)
+            idx = _ids_to_idx(st, sorted(ids))
+            _scan_cache_put(st, key, idx)
+        st.root(self.var, idx, objs=objs, label=lbl)
 
 
 class AllScanOp(_Op):
@@ -415,7 +556,7 @@ class AllScanOp(_Op):
         alive = np.nonzero(view.node_alive)[0]
         pairs = sorted((view.ids[i], i) for i in alive.tolist())
         idx = np.fromiter((p[1] for p in pairs), np.int64, len(pairs))
-        st.set_initial(self.var, idx)
+        st.root(self.var, idx)
 
 
 class MaskedLabelScanOp(_Op):
@@ -444,8 +585,8 @@ class MaskedLabelScanOp(_Op):
             ordered = [n.id for n in objs]
         else:
             ordered = sorted(ids)
-        st.set_initial(self.var, _ids_to_idx(st, ordered), objs=objs,
-                       label=self.lbl)
+        st.root(self.var, _ids_to_idx(st, ordered), objs=objs,
+                label=self.lbl)
 
 
 class FilterOp(_Op):
@@ -547,6 +688,149 @@ class ExpandOp(_Op):
             st.var_label[self.dst_var] = self.dst_labels[0]
 
 
+class JoinCheckOp(_Op):
+    """A later MATCH clause re-anchoring on an already-bound variable with
+    extra labels: one membership mask over the id column — the hash-join
+    equivalent of the generic engine's bound-candidate label check."""
+
+    kind = "join"
+
+    def __init__(self, var: str, labels: list[str]):
+        self.var = var
+        self.labels = tuple(labels)
+        self.label = f"JoinCheck({var}:{':'.join(labels)})"
+
+    def run(self, st: _State):
+        member = st.label_member_idx(self.labels)
+        st.apply_mask(np.isin(st.node_cols[self.var], member))
+
+
+class VarLenExpandOp(_Op):
+    """Bounded-hop var-length expansion (``*min..max``) as batched CSR
+    gathers: each hop is one ``expand_unique`` over the unique frontier,
+    partial paths stay as (state-row, endpoint, per-hop rank) arrays, and
+    relationship isomorphism is a per-hop rank-inequality mask.  Emitted
+    paths are lexsorted by their edge-id rank sequence (−1-padded, so
+    shorter prefixes sort first) under a stable state-row major key —
+    exactly the generic walk's ``matched.sort(key=eids)`` yield order."""
+
+    kind = "varlen"
+
+    def __init__(self, src_var: str, rel: ast.RelPattern, dst_var: str,
+                 dst_join: bool, dst_labels: list[str],
+                 prior_edge_vars: list[str]):
+        from nornicdb_tpu.cypher.matcher import MAX_VAR_LENGTH
+
+        self.src_var = src_var
+        self.types = list(rel.types)
+        self.direction = rel.direction
+        self.min_hops = rel.min_hops
+        self.max_hops = min(rel.max_hops, MAX_VAR_LENGTH)
+        self.dst_var = dst_var
+        self.dst_join = dst_join
+        self.dst_labels = tuple(dst_labels)
+        self.prior = list(prior_edge_vars)
+        arrow = {"out": "-%s->", "in": "<-%s-", "both": "-%s-"}[rel.direction]
+        t = (":" + "|".join(rel.types)) if rel.types else ""
+        rel_txt = arrow % f"[{t}*{rel.min_hops}..{rel.max_hops}]"
+        self.label = f"VarLenExpand(({src_var}){rel_txt}({dst_var}))"
+
+    def run(self, st: _State):
+        from nornicdb_tpu.cypher.matcher import MAX_BATCHED_PATHS
+
+        empty = np.zeros(0, np.int64)
+        if not st.n:
+            st.apply_expand(empty, None if self.dst_join else self.dst_var,
+                            empty, None, None)
+            return
+        view = st.view
+        codes = view.codes_for(self.types)
+        no_edges = bool(self.types) and not codes
+        path_row = np.arange(st.n, dtype=np.int64)
+        cur = st.node_cols[self.src_var]
+        hist_rows: list[np.ndarray] = []   # per-hop edge rows (identity)
+        hist_ranks: list[np.ndarray] = []  # per-hop erow_rank (sort keys)
+        out_rows: list[np.ndarray] = []
+        out_cur: list[np.ndarray] = []
+        out_hist: list[list[np.ndarray]] = []
+        emitted = 0
+        for level in range(self.max_hops + 1):
+            if level >= self.min_hops:
+                out_rows.append(path_row)
+                out_cur.append(cur)
+                out_hist.append(list(hist_ranks))
+                emitted += len(path_row)
+            if level == self.max_hops or not len(path_row) or no_edges:
+                break
+            uniq, inv = np.unique(cur, return_inverse=True)
+            counts, rows, nbrs = view.expand_unique(uniq, self.direction,
+                                                    codes)
+            seg_start = np.zeros(len(counts), np.int64)
+            if len(counts) > 1:
+                seg_start[1:] = np.cumsum(counts)[:-1]
+            pc = counts[inv]
+            total = int(pc.sum())
+            if not total:
+                path_row = empty
+                cur = empty
+                hist_rows, hist_ranks = [], []
+                continue
+            src_pos = np.repeat(np.arange(len(path_row), dtype=np.int64), pc)
+            shift = np.repeat(np.cumsum(pc) - pc, pc)
+            flat = seg_start[inv][src_pos] + (np.arange(total) - shift)
+            new_rows = rows[flat]
+            new_dst = nbrs[flat]
+            keep = np.ones(total, bool)
+            for h in hist_rows:  # within-path relationship isomorphism
+                keep &= new_rows != h[src_pos]
+            for prev in self.prior:  # prior fixed hops of the same chain
+                keep &= new_rows != st.edge_cols[prev][path_row[src_pos]]
+            if not keep.all():
+                sel = np.nonzero(keep)[0]
+                src_pos, new_rows, new_dst = \
+                    src_pos[sel], new_rows[sel], new_dst[sel]
+            if emitted + len(src_pos) > MAX_BATCHED_PATHS:
+                raise _Bail("var-length path blowup")
+            hist_rows = [h[src_pos] for h in hist_rows] + [new_rows]
+            hist_ranks = [h[src_pos] for h in hist_ranks] \
+                + [view.erow_rank[new_rows]]
+            path_row = path_row[src_pos]
+            cur = new_dst
+        if not emitted:
+            st.apply_expand(empty, None if self.dst_join else self.dst_var,
+                            empty, None, None)
+            return
+        rows_cat = np.concatenate(out_rows)
+        cur_cat = np.concatenate(out_cur)
+        max_len = max(len(h) for h in out_hist)
+        rank_cols = []
+        for d in range(max_len):
+            parts = [h[d] if d < len(h)
+                     else np.full(len(r), -1, np.int64)
+                     for r, h in zip(out_rows, out_hist)]
+            rank_cols.append(np.concatenate(parts))
+        keep = None
+        if self.dst_join:
+            keep = cur_cat == st.node_cols[self.dst_var][rows_cat]
+        if self.dst_labels:
+            member = st.label_member_idx(self.dst_labels)
+            m = np.isin(cur_cat, member)
+            keep = m if keep is None else keep & m
+        if keep is not None and not keep.all():
+            sel = np.nonzero(keep)[0]
+            rows_cat, cur_cat = rows_cat[sel], cur_cat[sel]
+            rank_cols = [c[sel] for c in rank_cols]
+        if max_len:
+            order = np.lexsort(tuple(reversed(rank_cols)) + (rows_cat,))
+        else:
+            order = np.argsort(rows_cat, kind="stable")
+        st.apply_expand(rows_cat[order],
+                        None if self.dst_join else self.dst_var,
+                        cur_cat[order], None, None)
+        if not self.dst_join and len(self.dst_labels) == 1:
+            st.var_label[self.dst_var] = self.dst_labels[0]
+
+
 class EdgeCountOp(_Op):
     """MATCH ()-[r:T]->() RETURN count(r|*): one vectorized pass over the
     per-edge type column (the retired ``_fp_count`` edge shape)."""
@@ -617,18 +901,21 @@ class FallbackOp(_Op):
     engine = "generic"
 
     def __init__(self, clause_idx: int, residual: Optional[ast.Expr],
-                 named_node_vars: list[str], named_edge_vars: list[str]):
+                 named_node_vars: list[str], named_edge_vars: list[str],
+                 named_val_vars: Optional[list[str]] = None):
         self.clause_idx = clause_idx
         self.residual = residual
         self.node_vars = named_node_vars
         self.edge_vars = named_edge_vars
+        self.val_vars = list(named_val_vars or ())
         extra = " +residual WHERE" if residual is not None else ""
         self.label = f"GenericTail(clauses[{clause_idx}:]{extra})"
 
     def run(self, st: _State):
         from nornicdb_tpu.cypher.expr import EvalContext, evaluate
 
-        rows = st.materialize_rows(self.node_vars, self.edge_vars)
+        rows = st.materialize_rows(self.node_vars, self.edge_vars,
+                                   self.val_vars)
         if self.residual is not None:
             rows = [
                 r for r in rows
@@ -637,6 +924,367 @@ class FallbackOp(_Op):
             ]
         return st.ex._finish_clauses(st.q, st.params, rows,
                                      self.clause_idx, st.stats)
+
+
+# ------------------------------------------------------------ shared columns
+def _value_column(st: _State, spec) -> list:
+    """Evaluate one column spec over the state: entity columns, property
+    gathers, WITH value columns, or parameter/literal constants."""
+    kind = spec[0]
+    if kind == "node":
+        return st.node_objects(spec[1])
+    if kind == "edge":
+        return st.edge_objects(spec[1])
+    if kind == "nprop" or kind == "eprop":
+        return st.prop_column(spec[1], spec[2])
+    if kind == "val":
+        return st.val_cols[spec[1]]
+    if kind == "const":
+        v = spec[1](st.params)
+        return [v] * st.n
+    raise _Bail(f"unknown column spec {kind}")  # pragma: no cover
+
+
+def _fold_agg(agg: str, rows: list[int], col: Optional[list]):
+    """One aggregate over one group — the generic ``_eval_aggregate``
+    fold bit-for-bit (non-null collection order, Python left-to-right
+    float sums, sum []->0 / avg|min|max []->None)."""
+    if agg in ("count_star", "count_ent"):
+        return len(rows)
+    vals = [v for r in rows if (v := col[r]) is not None]
+    if agg == "count":
+        return len(vals)
+    if agg == "sum":
+        return sum(vals) if vals else 0
+    if agg == "avg":
+        return sum(vals) / len(vals) if vals else None
+    if agg == "min":
+        return min(vals) if vals else None
+    if agg == "max":
+        return max(vals) if vals else None
+    return vals  # collect
+
+
+def _encounter_groups(st: _State, item_specs, group_idx, vals_for):
+    """Aggregation groups as row-index arrays in first-encounter order
+    (the generic dict-insertion grouping).  Entity group keys use the
+    int columns directly: a vocab index / edge row is exactly as
+    distinct as the ``("__ent__", id)`` key ``_hashable`` produces."""
+    from nornicdb_tpu.cypher.executor import _hashable
+
+    n = st.n
+    if not group_idx:
+        return [np.arange(n, dtype=np.int64)]
+    key_cols = []
+    int_only = True
+    for i in group_idx:
+        spec = item_specs[i][1]
+        if spec[0] == "node":
+            key_cols.append(("int", st.node_cols[spec[1]]))
+        elif spec[0] == "edge":
+            key_cols.append(("int", st.edge_cols[spec[1]]))
+        else:
+            key_cols.append(("obj", vals_for(i)))
+            int_only = False
+    if n == 0:
+        return []
+    if len(key_cols) == 1 and int_only:
+        col = key_cols[0][1]
+        uniq, first, inv = np.unique(
+            col, return_index=True, return_inverse=True)
+        order = np.argsort(inv, kind="stable")
+        bounds = np.cumsum(np.bincount(inv))
+        segs = np.split(order, bounds[:-1])
+        enc = np.argsort(first, kind="stable")  # first-encounter
+        return [segs[g] for g in enc.tolist()]
+    by_key: dict[Any, list] = {}
+    mats = [c[1] if c[0] == "obj" else c[1].tolist() for c in key_cols]
+    for r in range(n):
+        k = _hashable([m[r] for m in mats])
+        by_key.setdefault(k, []).append(r)
+    return [np.asarray(rows, np.int64) for rows in by_key.values()]
+
+
+def _static_limit(st: _State, clause) -> Optional[int]:
+    """skip+limit when both are statically evaluable non-negative ints
+    (the top-k window size), else None."""
+    from nornicdb_tpu.cypher.expr import EvalContext, evaluate
+
+    if clause.limit is None:
+        return None
+    try:
+        k = int(evaluate(clause.limit, EvalContext({}, st.params, st.ex)))
+        if clause.skip is not None:
+            k += int(evaluate(clause.skip,
+                              EvalContext({}, st.params, st.ex)))
+    except (TypeError, ValueError):
+        # non-static/non-integer LIMIT: the slice tail will raise the
+        # user-facing error; the offload simply doesn't engage
+        return None
+    return k if k >= 0 else None
+
+
+# ------------------------------------------------------------- VectorTopK
+_VEC_FN = "vector.similarity.cosine"
+
+
+def _vector_min_rows() -> int:
+    try:
+        return int(os.environ.get("NORNICDB_VECTOR_TOPK_MIN_ROWS", "8192"))
+    except ValueError:
+        return 8192
+
+
+def _vector_cutover() -> float:
+    """k/n selectivity above which the full host sort beats masked-GEMM
+    candidate selection (docs/operations.md "Graph×vector fusion")."""
+    try:
+        return float(os.environ.get("NORNICDB_VECTOR_TOPK_CUTOVER", "0.25"))
+    except ValueError:
+        return 0.25
+
+
+def _vec_order_spec(expr, node_vars: set):
+    """('vec', var, key, getter, swap) for ``ORDER BY
+    vector.similarity.cosine(n.emb, $q)`` (either argument order) over a
+    pattern node property vs a parameter/literal — else None.  ``swap``
+    records the original argument order so the exact rescore reproduces
+    the interpreter's evaluation (including its errors) verbatim."""
+    if not (isinstance(expr, ast.FunctionCall) and expr.name == _VEC_FN
+            and not expr.distinct and len(expr.args) == 2):
+        return None
+    for swap in (False, True):
+        prop = expr.args[1] if swap else expr.args[0]
+        other = expr.args[0] if swap else expr.args[1]
+        if (isinstance(prop, ast.Property)
+                and isinstance(prop.subject, ast.Variable)
+                and prop.subject.name in node_vars
+                and prop.key != "id"):
+            getter = _const_getter(other)
+            if getter is not None:
+                return ("vec", prop.subject.name, prop.key, getter, swap)
+    return None
+
+
+class _EmbMatrix:
+    """Label-wide normalized embedding matrix for VectorTopK, cached on
+    the engine and validated against the colindex epoch.  ``lookup`` maps
+    snapshot vocab index -> matrix row (-1 = not a clean member);
+    ``null`` marks rows the GEMM must not score (missing / malformed /
+    wrong-dim values — they rejoin the candidate set unconditionally so
+    the exact rescore reproduces interpreter nulls and errors).  ``dev``
+    is the one-slot device-corpus cache ``graph_masked_scores`` fills."""
+
+    __slots__ = ("epoch", "lookup", "matrix", "null", "dev")
+
+    def __init__(self, epoch, lookup, matrix, null):
+        self.epoch = epoch
+        self.lookup = lookup
+        self.matrix = matrix
+        self.null = null
+        self.dev = [None]
+
+
+def _emb_matrix(st: _State, var: str, key: str) -> Optional[_EmbMatrix]:
+    label = st.var_label.get(var)
+    if label is None:
+        return None
+    colind = _colindex_for(st.ex, label)
+    if colind is None:
+        return None
+    eng = getattr(st.ex, "columnar", None)
+    if eng is None:
+        return None
+    ck = (label, key)
+    ep = colind.epoch()
+    with eng._emb_lock:
+        ent = eng._emb.get(ck)
+        if ent is not None and ent.epoch == ep:
+            return ent
+    snap = colind.embedding_snapshot(label, key)
+    if snap is None:
+        return None
+    ep0, ids, vals = snap
+    if not ids:
+        return None
+    # float conversion + row normalization OUTSIDE the colindex lock
+    null: Optional[np.ndarray] = None
+    try:
+        mat = np.asarray(vals, np.float32)
+        if mat.ndim != 2 or not mat.shape[1]:
+            raise ValueError("not a clean matrix")
+        null = ~np.isfinite(mat).all(axis=1)
+    except (ValueError, TypeError):
+        # ragged / missing / non-numeric rows: per-row salvage — bad rows
+        # are null (never scored, always candidates)
+        dim = None
+        rows_f: list[Optional[np.ndarray]] = []
+        for v in vals:
+            a = None
+            if v is not None:
+                try:
+                    cand = np.asarray(v, np.float32)
+                    if cand.ndim == 1 and len(cand) \
+                            and np.isfinite(cand).all() \
+                            and (dim is None or len(cand) == dim):
+                        a = cand
+                        dim = len(cand) if dim is None else dim
+                except (ValueError, TypeError):
+                    a = None
+            rows_f.append(a)
+        if dim is None:
+            return None
+        mat = np.zeros((len(vals), dim), np.float32)
+        null = np.ones(len(vals), bool)
+        for i, a in enumerate(rows_f):
+            if a is not None:
+                mat[i] = a
+                null[i] = False
+    mat = np.ascontiguousarray(mat)
+    norms = np.linalg.norm(mat, axis=1)
+    nz = norms >= 1e-12
+    mat[nz] /= norms[nz, None]
+    mat[~nz & ~null] = 0.0  # zero-norm rows score 0.0, like the fn
+    vidx = st.snap.indices_of(ids)
+    lookup = np.full(len(st.view.ids), -1, np.int64)
+    ok = vidx >= 0
+    lookup[vidx[ok]] = np.nonzero(ok)[0]
+    if colind.epoch() != ep0:
+        return None  # raced a write: a stale matrix must never drive a cut
+    ent = _EmbMatrix(ep0, lookup, mat, null)
+    with eng._emb_lock:
+        eng._emb[ck] = ent
+        while len(eng._emb) > 8:
+            eng._emb.pop(next(iter(eng._emb)))
+    return ent
+
+
+def _prop_values_at(st: _State, var: str, key: str,
+                    poss: list[int]) -> list:
+    """Raw property values for a SUBSET of table rows — the survivor
+    rescore after a top-k cut fetches k+ties values, not the corpus."""
+    label = st.var_label.get(var)
+    if label is not None and (var, st.version) not in st._objs:
+        colind = _colindex_for(st.ex, label)
+        if colind is not None:
+            ids_list = st.view.ids
+            idxs = st.node_cols[var][np.asarray(poss, np.int64)]
+            vals = colind.column_values(
+                label, key, [ids_list[i] for i in idxs.tolist()])
+            if vals is not None:
+                return vals
+    col = st.prop_column(var, key)
+    return [col[i] for i in poss]
+
+
+def _vector_rank(st: _State, vspec, positions, desc: bool,
+                 k: Optional[int]):
+    """Sort keys for an ``ORDER BY cosine(...)`` row set.
+
+    Returns ``(sel, keys)``: ``sel`` is an order-preserving subset of
+    positions-in-``positions`` guaranteed to contain the whole skip+limit
+    window under generic ordering semantics (nulls included — ASC nulls
+    last, DESC nulls first), and ``keys`` are the EXACT per-row function
+    values for those rows, so the host stable sort over the survivors
+    bit-matches the interpreter, tie order included.  With no engageable
+    top-k cut, ``sel`` covers every row and this degrades to host exact
+    scoring.  Scoring errors raise ``_Bail`` so the generic engine
+    reproduces the user-facing exception."""
+    _, var, key, getter, swap = vspec
+    from nornicdb_tpu.cypher.functions import fn_vec_cosine as _fn
+
+    pos_list = list(positions)
+    q = getter(st.params)
+    m = len(pos_list)
+    col = None  # full raw column: only the degrade paths ever fetch it
+
+    def exact(sel=None):
+        nonlocal col
+        if sel is None:
+            if col is None:
+                col = st.prop_column(var, key)
+            vals = [col[i] for i in pos_list]
+        elif col is not None:
+            vals = [col[pos_list[i]] for i in sel]
+        else:
+            # cut engaged: rescore survivors only, never the full column
+            vals = _prop_values_at(st, var, key,
+                                   [pos_list[i] for i in sel])
+        try:
+            if swap:
+                return [_fn(q, v) for v in vals]
+            return [_fn(v, q) for v in vals]
+        except Exception as e:
+            raise _Bail(f"vector scoring error: {e!r}")
+
+    full = list(range(m))
+    if (k is None or k <= 0 or k >= m or q is None
+            or m < _vector_min_rows() or k > m * _vector_cutover()):
+        return full, exact()
+    try:
+        qa = np.asarray(q, np.float32)
+    except (ValueError, TypeError):
+        return full, exact()
+    if qa.ndim != 1 or not len(qa) or not np.isfinite(qa).all():
+        return full, exact()
+    qnorm = float(np.linalg.norm(qa))
+    if qnorm < 1e-12:
+        return full, exact()
+    qn = (qa / np.float32(qnorm)).astype(np.float32)
+    ent = _emb_matrix(st, var, key)
+    if ent is None or ent.matrix.shape[1] != len(qn):
+        return full, exact()
+    rows = st.node_cols[var][np.asarray(pos_list, np.int64)]
+    if int(rows.max()) >= len(ent.lookup):
+        return full, exact()  # nodes newer than the cached vocab window
+    mrows = ent.lookup[rows]
+    if (mrows < 0).any():
+        return full, exact()
+    isnull = ent.null[mrows]
+    valid = np.zeros(len(ent.matrix), bool)
+    valid[mrows[~isnull]] = True
+    n_valid = int(valid.sum())
+    if n_valid < k:
+        return full, exact()
+    got = None
+    try:
+        from nornicdb_tpu.search.service import graph_masked_scores
+        got = graph_masked_scores(qn, ent.matrix, valid, k, desc,
+                                  dev_ref=ent.dev)
+    except Exception:
+        log.debug("vector_topk device offload failed; host GEMM",
+                  exc_info=True)
+        got = None
+    if got is not None:
+        scores, boundary = got
+        OFFLOAD_CELLS["used"].inc()
+    else:
+        # hang/absent backend degradation: host columnar scoring — one
+        # numpy GEMM over the normalized rows, never a device wait
+        OFFLOAD_CELLS["unavailable"].inc()
+        scores = ent.matrix @ qn
+        mvals = scores[valid]
+        if desc:
+            boundary = float(np.partition(mvals, len(mvals) - k)
+                             [len(mvals) - k])
+        else:
+            boundary = float(np.partition(mvals, k - 1)[k - 1])
+    # the boundary is over DISTINCT nodes; duplicates only push the true
+    # row-wise kth value further inside it, so the widened cut is always
+    # a superset of the interpreter's first skip+limit rows
+    dim = ent.matrix.shape[1]
+    eps = dim * 3.0e-7 + 1.0e-6
+    row_scores = scores[mrows]
+    if desc:
+        cand = row_scores >= boundary - 2.0 * eps
+    else:
+        cand = row_scores <= boundary + 2.0 * eps
+    cand |= isnull  # nulls sort first (DESC) / pad short windows (ASC)
+    sel = np.nonzero(cand)[0]
+    if len(sel) < min(k, m):
+        return full, exact()  # the cut cannot prove window coverage
+    sel_list = sel.tolist()
+    return sel_list, exact(sel_list)
 
 
 # ---------------------------------------------------------------- RETURN op
@@ -656,26 +1304,35 @@ class ReturnOp(_Op):
         self.agg_idx = agg_idx
         self.order_specs = order_specs  # None => fully generic-eval path
         self.has_agg = bool(agg_idx)
+        self.has_vec = bool(order_specs) and \
+            any(s[0] == "vec" for s in order_specs)
         self.label = sublabels[0]
         self.sublabels = sublabels
 
     # -- column evaluation -------------------------------------------------
     def _value_column(self, st: _State, spec) -> list:
-        kind = spec[0]
-        if kind == "node":
-            return st.node_objects(spec[1])
-        if kind == "edge":
-            return st.edge_objects(spec[1])
-        if kind == "nprop" or kind == "eprop":
-            return st.prop_column(spec[1], spec[2])
-        if kind == "const":
-            v = spec[1](st.params)
-            return [v] * st.n
-        raise _Bail(f"unknown column spec {kind}")  # pragma: no cover
+        return _value_column(st, spec)
 
     def run(self, st: _State):
         from nornicdb_tpu.cypher.executor import Result
 
+        clause = self.clause
+        if (not self.has_agg and not clause.distinct and clause.order_by
+                and self.order_specs is not None
+                and all(s[0] != "col" for s in self.order_specs)):
+            # deferred projection: every ORDER BY key reads source
+            # columns, so order + slice the binding table FIRST and only
+            # ever materialize output values for the served window
+            t1 = time.perf_counter()
+            perm = self._order_rows(st)
+            OP_CELLS["vector_topk" if self.has_vec else "sort"].observe(
+                time.perf_counter() - t1)
+            perm = self._slice(st, perm)
+            st.apply_sel(np.asarray(perm, np.int64))
+            t0 = time.perf_counter()
+            columns, data, _ = self._project(st)
+            OP_CELLS["project"].observe(time.perf_counter() - t0)
+            return Result(columns, data)
         t0 = time.perf_counter()
         if self.has_agg:
             columns, data = self._aggregate(st)
@@ -704,7 +1361,8 @@ class ReturnOp(_Op):
         if clause.order_by:
             t1 = time.perf_counter()
             data = self._order(st, columns, data, src_for_order)
-            OP_CELLS["sort"].observe(time.perf_counter() - t1)
+            OP_CELLS["vector_topk" if self.has_vec else "sort"].observe(
+                time.perf_counter() - t1)
         data = self._slice(st, data)
         return Result(columns, data)
 
@@ -716,50 +1374,8 @@ class ReturnOp(_Op):
 
     # -- aggregation -------------------------------------------------------
     def _aggregate(self, st: _State):
-        from nornicdb_tpu.cypher.executor import _hashable
-
         items = self.clause.items
         columns = [it.key for it in items]
-        n = st.n
-        # group rows
-        if not self.group_idx:
-            groups = [np.arange(n, dtype=np.int64)]
-        else:
-            key_cols = []
-            int_only = True
-            for i in self.group_idx:
-                spec = self.item_specs[i][1]
-                if spec[0] == "node":
-                    key_cols.append(("int", st.node_cols[spec[1]]))
-                elif spec[0] == "edge":
-                    key_cols.append(("int", st.edge_cols[spec[1]]))
-                else:
-                    key_cols.append(("obj", self._value_column(st, spec)))
-                    int_only = False
-            if n == 0:
-                groups = []
-            elif len(key_cols) == 1 and int_only:
-                col = key_cols[0][1]
-                uniq, first, inv = np.unique(
-                    col, return_index=True, return_inverse=True)
-                order = np.argsort(inv, kind="stable")
-                bounds = np.cumsum(np.bincount(inv))
-                segs = np.split(order, bounds[:-1])
-                enc = np.argsort(first, kind="stable")  # first-encounter
-                groups = [segs[g] for g in enc.tolist()]
-            else:
-                by_key: dict[Any, list] = {}
-                mats = [c[1] if c[0] == "obj" else c[1].tolist()
-                        for c in key_cols]
-                for r in range(n):
-                    k = _hashable([m[r] for m in mats])
-                    by_key.setdefault(k, []).append(r)
-                groups = [np.asarray(rows, np.int64)
-                          for rows in by_key.values()]
-        if not groups and not self.group_idx:
-            groups = [np.zeros(0, np.int64)]  # RETURN count(*) on empty
-        # value columns needed by aggs / group outputs
-        out = []
         val_cache: dict[int, list] = {}
 
         def vals_for(i):
@@ -767,6 +1383,11 @@ class ReturnOp(_Op):
                 val_cache[i] = self._value_column(st, self.item_specs[i][1])
             return val_cache[i]
 
+        groups = _encounter_groups(st, self.item_specs, self.group_idx,
+                                   vals_for)
+        if not groups and not self.group_idx:
+            groups = [np.zeros(0, np.int64)]  # RETURN count(*) on empty
+        out = []
         for g in groups:
             rows = g.tolist()
             row_vals: list[Any] = [None] * len(items)
@@ -774,28 +1395,35 @@ class ReturnOp(_Op):
                 row_vals[i] = vals_for(i)[rows[0]] if rows else None
             for i in self.agg_idx:
                 agg, spec = self.item_specs[i]
-                if agg in ("count_star", "count_ent"):
-                    row_vals[i] = len(rows)
-                    continue
-                col = vals_for(i)
-                vals = [v for r in rows
-                        if (v := col[r]) is not None]
-                if agg == "count":
-                    row_vals[i] = len(vals)
-                elif agg == "sum":
-                    row_vals[i] = sum(vals) if vals else 0
-                elif agg == "avg":
-                    row_vals[i] = sum(vals) / len(vals) if vals else None
-                elif agg == "min":
-                    row_vals[i] = min(vals) if vals else None
-                elif agg == "max":
-                    row_vals[i] = max(vals) if vals else None
-                else:  # collect
-                    row_vals[i] = vals
+                col = None if agg in ("count_star", "count_ent") \
+                    else vals_for(i)
+                row_vals[i] = _fold_agg(agg, rows, col)
             out.append(row_vals)
         return columns, out
 
     # -- ordering ----------------------------------------------------------
+    def _order_rows(self, st: _State) -> list[int]:
+        """Stable row permutation (incl. any top-k cut) over the source
+        binding table — the deferred-projection path's sort."""
+        from nornicdb_tpu.cypher.executor import _multisort
+
+        descs = [oi.descending for oi in self.clause.order_by]
+        if self.has_vec:
+            sel, keys = _vector_rank(st, self.order_specs[0],
+                                     range(st.n), descs[0],
+                                     _static_limit(st, self.clause))
+            keyed = [([keys[j]], i) for j, i in enumerate(sel)]
+            return _multisort(keyed, descs)
+        key_cols = [self._value_column(st, spec)
+                    for spec in self.order_specs]
+        positions = range(st.n)
+        if len(descs) == 1:
+            cut = self._offload_candidates(st, key_cols[0], descs[0])
+            if cut is not None:
+                positions = cut
+        keyed = [([kc[i] for kc in key_cols], i) for i in positions]
+        return _multisort(keyed, descs)
+
     def _order(self, st: _State, columns, data, src_for_order):
         from nornicdb_tpu.cypher.executor import _multisort
         from nornicdb_tpu.cypher.expr import EvalContext, evaluate
@@ -817,6 +1445,15 @@ class ReturnOp(_Op):
                         keys.append(evaluate(
                             oi.expr, EvalContext(binding, st.params, st.ex)))
                 keyed.append((keys, row_vals))
+            return _multisort(keyed, descs)
+        if self.has_vec:
+            # VectorTopK: device/host masked scoring picks an order-
+            # preserving candidate superset of the skip+limit window,
+            # exact fn values key the final stable host sort
+            sel, keys = _vector_rank(st, self.order_specs[0],
+                                     src_for_order, descs[0],
+                                     _static_limit(st, self.clause))
+            keyed = [([keys[j]], data[i]) for j, i in enumerate(sel)]
             return _multisort(keyed, descs)
         key_cols = []
         for spec in self.order_specs:
@@ -847,23 +1484,6 @@ class ReturnOp(_Op):
         return data
 
     # -- device offload ----------------------------------------------------
-    def _static_k(self, st: _State) -> Optional[int]:
-        from nornicdb_tpu.cypher.expr import EvalContext, evaluate
-
-        clause = self.clause
-        if clause.limit is None:
-            return None
-        try:
-            k = int(evaluate(clause.limit, EvalContext({}, st.params, st.ex)))
-            if clause.skip is not None:
-                k += int(evaluate(clause.skip,
-                                  EvalContext({}, st.params, st.ex)))
-        except (TypeError, ValueError):
-            # non-static/non-integer LIMIT: the slice tail will raise the
-            # user-facing error; the offload simply doesn't engage
-            return None
-        return k if k >= 0 else None
-
     def _offload_candidates(self, st: _State, keys: list,
                             desc: bool) -> Optional[list[int]]:
         """Device top-k boundary for a single-numeric-key ORDER BY ...
@@ -872,7 +1492,7 @@ class ReturnOp(_Op):
         path.  The caller still runs the exact stable host sort over the
         survivors, so served rows are bit-identical to the full sort."""
         n = len(keys)
-        k = self._static_k(st)
+        k = _static_limit(st, self.clause)
         if k is None or n < _offload_min_rows() or k * 4 > n or k == 0:
             return None
         for v in keys:
@@ -918,6 +1538,182 @@ class ReturnOp(_Op):
             return None
 
 
+class WithOp(_Op):
+    """Columnar WITH: project/aggregate into a REPLACEMENT binding table
+    (entity items stay int columns, property projections / aggregates /
+    constants become value columns — no Node dicts cross the clause
+    boundary), then DISTINCT / ORDER BY / SKIP / LIMIT / WHERE with the
+    generic ``_with`` ordering exactly: WHERE runs LAST, after the
+    slice, over output-column-only bindings."""
+
+    kind = "project"
+    self_timed = True
+
+    def __init__(self, clause: ast.WithClause, item_specs, group_idx,
+                 agg_idx, order_specs, sublabels):
+        self.clause = clause
+        self.item_specs = item_specs
+        self.group_idx = group_idx
+        self.agg_idx = agg_idx
+        self.order_specs = order_specs
+        self.has_agg = bool(agg_idx)
+        self.has_vec = bool(order_specs) and \
+            any(s[0] == "vec" for s in order_specs)
+        self.label = sublabels[0]
+        self.sublabels = sublabels
+
+    def run(self, st: _State):
+        t0 = time.perf_counter()
+        if self.has_agg:
+            self._aggregate_into(st)
+            OP_CELLS["aggregate"].observe(time.perf_counter() - t0)
+        else:
+            self._project_into(st)
+            OP_CELLS["project"].observe(time.perf_counter() - t0)
+        clause = self.clause
+        if clause.distinct:
+            self._distinct(st)
+        if clause.order_by:
+            t1 = time.perf_counter()
+            self._order(st)
+            OP_CELLS["vector_topk" if self.has_vec else "sort"].observe(
+                time.perf_counter() - t1)
+        self._slice(st)
+        if clause.where is not None:
+            self._where(st)
+        return None
+
+    # -- projection / aggregation into the replacement table ---------------
+    def _project_into(self, st: _State):
+        node_cols: dict[str, np.ndarray] = {}
+        edge_cols: dict[str, np.ndarray] = {}
+        val_cols: dict[str, list] = {}
+        var_label: dict[str, str] = {}
+        for it, (agg, spec) in zip(self.clause.items, self.item_specs):
+            alias = it.key
+            if spec[0] == "node":
+                node_cols[alias] = st.node_cols[spec[1]]
+                lbl = st.var_label.get(spec[1])
+                if lbl is not None:
+                    var_label[alias] = lbl
+            elif spec[0] == "edge":
+                edge_cols[alias] = st.edge_cols[spec[1]]
+            else:
+                val_cols[alias] = _value_column(st, spec)
+        st.replace_table(node_cols, edge_cols, val_cols, var_label, st.n)
+
+    def _aggregate_into(self, st: _State):
+        items = self.clause.items
+        val_cache: dict[int, list] = {}
+
+        def vals_for(i):
+            if i not in val_cache:
+                val_cache[i] = _value_column(st, self.item_specs[i][1])
+            return val_cache[i]
+
+        groups = _encounter_groups(st, self.item_specs, self.group_idx,
+                                   vals_for)
+        if not groups and not self.group_idx:
+            groups = [np.zeros(0, np.int64)]  # count(*) over empty input
+        rows_l = [g.tolist() for g in groups]
+        node_cols: dict[str, np.ndarray] = {}
+        edge_cols: dict[str, np.ndarray] = {}
+        val_cols: dict[str, list] = {}
+        var_label: dict[str, str] = {}
+        first = np.asarray([r[0] for r in rows_l], np.int64) \
+            if self.group_idx else None
+        for i in self.group_idx:
+            alias = items[i].key
+            spec = self.item_specs[i][1]
+            if spec[0] == "node":
+                node_cols[alias] = st.node_cols[spec[1]][first]
+                lbl = st.var_label.get(spec[1])
+                if lbl is not None:
+                    var_label[alias] = lbl
+            elif spec[0] == "edge":
+                edge_cols[alias] = st.edge_cols[spec[1]][first]
+            else:
+                col = vals_for(i)
+                val_cols[alias] = [col[r[0]] for r in rows_l]
+        for i in self.agg_idx:
+            agg, spec = self.item_specs[i]
+            col = None if agg in ("count_star", "count_ent") \
+                else vals_for(i)
+            val_cols[items[i].key] = [_fold_agg(agg, r, col)
+                                      for r in rows_l]
+        st.replace_table(node_cols, edge_cols, val_cols, var_label,
+                         len(rows_l))
+
+    # -- tail --------------------------------------------------------------
+    def _distinct(self, st: _State):
+        from nornicdb_tpu.cypher.executor import _hashable
+
+        cols = []
+        for it in self.clause.items:
+            alias = it.key
+            if alias in st.node_cols:
+                cols.append(("i", st.node_cols[alias]))
+            elif alias in st.edge_cols:
+                cols.append(("i", st.edge_cols[alias]))
+            else:
+                cols.append(("o", st.val_cols[alias]))
+        seen = set()
+        keep = []
+        for r in range(st.n):
+            kk = tuple(int(c[r]) if t == "i" else _hashable([c[r]])
+                       for t, c in cols)
+            if kk not in seen:
+                seen.add(kk)
+                keep.append(r)
+        if len(keep) != st.n:
+            st.apply_sel(np.asarray(keep, np.int64))
+
+    def _order(self, st: _State):
+        from nornicdb_tpu.cypher.executor import _multisort
+
+        descs = [oi.descending for oi in self.clause.order_by]
+        if self.has_vec:
+            sel, keys = _vector_rank(st, self.order_specs[0],
+                                     range(st.n), descs[0],
+                                     _static_limit(st, self.clause))
+            keyed = [([keys[j]], i) for j, i in enumerate(sel)]
+            perm = _multisort(keyed, descs)
+            st.apply_sel(np.asarray(perm, np.int64))
+            return
+        key_cols = [_value_column(st, spec) for spec in self.order_specs]
+        keyed = [([kc[i] for kc in key_cols], i) for i in range(st.n)]
+        perm = _multisort(keyed, descs)
+        st.apply_sel(np.asarray(perm, np.int64))
+
+    def _slice(self, st: _State):
+        from nornicdb_tpu.cypher.expr import EvalContext, evaluate
+
+        clause = self.clause
+        if clause.skip is None and clause.limit is None:
+            return
+        idx = list(range(st.n))  # Python slice semantics, verbatim
+        if clause.skip is not None:
+            n = evaluate(clause.skip, EvalContext({}, st.params, st.ex))
+            idx = idx[int(n):]
+        if clause.limit is not None:
+            n = evaluate(clause.limit, EvalContext({}, st.params, st.ex))
+            idx = idx[: int(n)]
+        if len(idx) != st.n:
+            st.apply_sel(np.asarray(idx, np.int64))
+
+    def _where(self, st: _State):
+        from nornicdb_tpu.cypher.expr import EvalContext, evaluate
+
+        rows = st.materialize_rows(list(st.node_cols), list(st.edge_cols),
+                                   list(st.val_cols))
+        w = self.clause.where
+        mask = np.array(
+            [evaluate(w, EvalContext(r, st.params, st.ex)) is True
+             for r in rows], dtype=bool)
+        if not mask.all():
+            st.apply_mask(mask)
+
+
 def _offload_min_rows() -> int:
     try:
         return int(os.environ.get("NORNICDB_CYPHER_OFFLOAD_MIN_ROWS",
@@ -939,7 +1735,7 @@ class CompiledPlan:
     def describe(self) -> list[str]:
         lines = []
         for op in self.ops:
-            if isinstance(op, ReturnOp):
+            if isinstance(op, (ReturnOp, WithOp)):
                 lines.extend(f"{lbl} [columnar]" for lbl in op.sublabels)
             else:
                 lines.append(f"{op.label} [{op.engine}]")
@@ -947,13 +1743,16 @@ class CompiledPlan:
 
 
 # ---------------------------------------------------------------- planner
-def _classify_item(expr, node_vars: set, edge_vars: set):
+def _classify_item(expr, node_vars: set, edge_vars: set,
+                   val_vars: frozenset = frozenset()):
     """(agg_kind|None, spec) — spec is a column spec; None = unsupported."""
     if isinstance(expr, ast.Variable):
         if expr.name in node_vars:
             return None, ("node", expr.name)
         if expr.name in edge_vars:
             return None, ("edge", expr.name)
+        if expr.name in val_vars:
+            return None, ("val", expr.name)
         return None, None
     if isinstance(expr, ast.Property) and isinstance(expr.subject,
                                                      ast.Variable):
@@ -971,7 +1770,8 @@ def _classify_item(expr, node_vars: set, edge_vars: set):
     return None, None
 
 
-def _classify_agg(expr, node_vars: set, edge_vars: set):
+def _classify_agg(expr, node_vars: set, edge_vars: set,
+                  val_vars: frozenset = frozenset()):
     if not (isinstance(expr, ast.FunctionCall) and expr.name in _AGG_FNS
             and not expr.distinct and len(expr.args) == 1):
         return None, None
@@ -979,24 +1779,38 @@ def _classify_agg(expr, node_vars: set, edge_vars: set):
     if expr.name == "count":
         if isinstance(arg, ast.Literal) and arg.value == "*":
             return "count_star", ("const", lambda p: None)
-        if isinstance(arg, ast.Variable) and (arg.name in node_vars
-                                              or arg.name in edge_vars):
-            return "count_ent", ("const", lambda p: None)
+        if isinstance(arg, ast.Variable):
+            if arg.name in node_vars or arg.name in edge_vars:
+                return "count_ent", ("const", lambda p: None)
+            if arg.name in val_vars:
+                return "count", ("val", arg.name)
         if (isinstance(arg, ast.Property)
                 and isinstance(arg.subject, ast.Variable)
-                and arg.subject.name in node_vars and arg.key != "id"):
-            return "count", ("nprop", arg.subject.name, arg.key)
+                and arg.key != "id"):
+            v = arg.subject.name
+            if v in node_vars:
+                return "count", ("nprop", v, arg.key)
+            if v in edge_vars:
+                return "count", ("eprop", v, arg.key)
         return None, None
-    # sum/avg/min/max/collect over a NODE property column (edge-property
-    # aggregation stays on the generic/_fp_edge_agg path)
+    # sum/avg/min/max/collect over a node OR edge property column (edge
+    # properties are CSR-resident: storage/adjacency.py edge_prop_column)
+    # or over a WITH-projected value column
     if (isinstance(arg, ast.Property)
             and isinstance(arg.subject, ast.Variable)
-            and arg.subject.name in node_vars and arg.key != "id"):
-        return expr.name, ("nprop", arg.subject.name, arg.key)
+            and arg.key != "id"):
+        v = arg.subject.name
+        if v in node_vars:
+            return expr.name, ("nprop", v, arg.key)
+        if v in edge_vars:
+            return expr.name, ("eprop", v, arg.key)
+    if isinstance(arg, ast.Variable) and arg.name in val_vars:
+        return expr.name, ("val", arg.name)
     return None, None
 
 
-def _plan_return(clause: ast.ReturnClause, node_vars: set, edge_vars: set):
+def _plan_return(clause: ast.ReturnClause, node_vars: set, edge_vars: set,
+                 val_vars: frozenset = frozenset()):
     """ReturnOp for a supported RETURN, else a FallbackOp reason string."""
     from nornicdb_tpu.cypher.executor import _contains_aggregate
 
@@ -1006,13 +1820,15 @@ def _plan_return(clause: ast.ReturnClause, node_vars: set, edge_vars: set):
     group_idx, agg_idx = [], []
     for i, it in enumerate(clause.items):
         if _contains_aggregate(it.expr):
-            agg, spec = _classify_agg(it.expr, node_vars, edge_vars)
+            agg, spec = _classify_agg(it.expr, node_vars, edge_vars,
+                                      val_vars)
             if agg is None:
                 return None, f"aggregate `{it.key}`"
             item_specs.append((agg, spec))
             agg_idx.append(i)
         else:
-            _, spec = _classify_item(it.expr, node_vars, edge_vars)
+            _, spec = _classify_item(it.expr, node_vars, edge_vars,
+                                     val_vars)
             if spec is None:
                 return None, f"projection `{it.key}`"
             item_specs.append((None, spec))
@@ -1030,17 +1846,38 @@ def _plan_return(clause: ast.ReturnClause, node_vars: set, edge_vars: set):
                     idx = len(columns) - 1 - columns[::-1].index(oi.expr.name)
                     order_specs.append(("col", idx))
                     continue
+                if oi.expr.name in val_vars:
+                    order_specs.append(("val", oi.expr.name))
+                    continue
                 return None, "ORDER BY entity variable"
             if (isinstance(oi.expr, ast.Property)
                     and isinstance(oi.expr.subject, ast.Variable)):
                 v = oi.expr.subject.name
                 if v in columns:
                     return None, "ORDER BY property of alias"
+                if v in val_vars:
+                    return None, "ORDER BY property of value alias"
                 if oi.expr.key != "id" and (v in node_vars
                                             or v in edge_vars):
                     order_specs.append(
                         ("nprop" if v in node_vars else "eprop",
                          v, oi.expr.key))
+                    continue
+            if len(clause.order_by) == 1:
+                vspec = _vec_order_spec(oi.expr, node_vars)
+                if vspec is not None:
+                    v = vspec[1]
+                    if v in columns:
+                        # generic ORDER BY binding overlays output columns
+                        # over the source row (output wins) — the vec var
+                        # only survives the overlay when its last aliased
+                        # item is the variable itself
+                        idx = len(columns) - 1 - columns[::-1].index(v)
+                        shadow = clause.items[idx].expr
+                        if not (isinstance(shadow, ast.Variable)
+                                and shadow.name == v):
+                            return None, "ORDER BY property of alias"
+                    order_specs.append(vspec)
                     continue
             getter = _const_getter(oi.expr)
             if getter is not None:
@@ -1056,80 +1893,165 @@ def _plan_return(clause: ast.ReturnClause, node_vars: set, edge_vars: set):
     if clause.distinct:
         sublabels.append("Distinct")
     if clause.order_by:
-        sublabels.append("Sort(" + ", ".join(
-            ("DESC " if oi.descending else "") +
-            ast.expr_text(oi.expr) for oi in clause.order_by) + ")")
+        if order_specs and any(s[0] == "vec" for s in order_specs):
+            oi = clause.order_by[0]
+            sublabels.append("VectorTopK(" + ast.expr_text(oi.expr)
+                             + (" DESC" if oi.descending else "") + ")")
+        else:
+            sublabels.append("Sort(" + ", ".join(
+                ("DESC " if oi.descending else "") +
+                ast.expr_text(oi.expr) for oi in clause.order_by) + ")")
     if clause.skip is not None or clause.limit is not None:
         sublabels.append("Slice(skip/limit)")
     return ReturnOp(clause, item_specs, group_idx, agg_idx,
                     order_specs if not has_agg else None, sublabels), ""
 
 
-def compile_query(q: ast.Query, ex) -> tuple[Optional[CompiledPlan], str]:
-    """Pattern-compile a canonical (literal-lifted) Query into an operator
-    DAG, or (None, reason) when no columnar prefix exists."""
-    cls = q.clauses
-    if not cls or not isinstance(cls[0], ast.MatchClause):
-        return None, "no leading MATCH"
-    m = cls[0]
-    if m.optional:
-        return None, "OPTIONAL MATCH"
-    if len(m.patterns) != 1:
-        return None, "multiple patterns"
+def _retired_fastpaths(q: ast.Query, cls) -> Optional[CompiledPlan]:
+    """The count short-circuit shapes (NodeCountOp/EdgeCountOp) as planner
+    special cases — the executor-level ``_try_fastpath`` these replace is
+    deleted, not shadowed."""
+    if len(cls) != 2 or not isinstance(cls[1], ast.ReturnClause):
+        return None
+    m, ret = cls
+    if m.optional or len(m.patterns) != 1:
+        return None
     pat = m.patterns[0]
     if pat.name or pat.shortest:
-        return None, "named path / shortestPath"
+        return None
     els = pat.elements
     if len(els) % 2 == 0 or not els:
-        return None, "malformed pattern"
+        return None
+    if not all(isinstance(n, ast.NodePattern) for n in els[0::2]) or \
+            not all(isinstance(r, ast.RelPattern) for r in els[1::2]):
+        return None
+    plain_ret = (not ret.distinct and not ret.order_by and ret.skip is None
+                 and ret.limit is None and not ret.star
+                 and len(ret.items) == 1)
+    anchor = els[0]
+    if not plain_ret or m.where is not None or anchor.where is not None:
+        return None
+    e = ret.items[0].expr
+    if not (isinstance(e, ast.FunctionCall) and e.name == "count"
+            and not e.distinct and len(e.args) == 1):
+        return None
+    arg = e.args[0]
+    if len(els) == 1 and anchor.properties is None:
+        counts_node = (isinstance(arg, ast.Literal) and arg.value == "*") \
+            or (isinstance(arg, ast.Variable)
+                and arg.name == anchor.variable)
+        if counts_node:
+            op = NodeCountOp(anchor.labels, ret.items[0].key)
+            return CompiledPlan([op], q, True, "")
+    if len(els) == 3:
+        a, rel, b = els
+        if rel.var_length or rel.min_hops != 1 or rel.max_hops != 1 \
+                or rel.properties is not None:
+            return None
+        bare = not (a.labels or a.properties or a.where or b.labels
+                    or b.properties or b.where)
+        if not bare:
+            return None
+        counts_rel = (isinstance(arg, ast.Literal) and arg.value == "*") \
+            or (isinstance(arg, ast.Variable)
+                and (arg.name == rel.variable or arg.name == a.variable
+                     or arg.name == b.variable))
+        if counts_rel \
+                and not (a.variable and a.variable == b.variable) \
+                and not (rel.variable
+                         and rel.variable in (a.variable, b.variable)):
+            op = EdgeCountOp(rel.types, rel.direction, ret.items[0].key)
+            return CompiledPlan([op], q, True, "")
+    return None
+
+
+def _plan_match_clause(m: ast.MatchClause, ci: int, ops: list,
+                       node_vars: set, edge_vars: set, val_vars: set,
+                       rooted: bool):
+    """Plan one MATCH clause into scan/join/filter/expand ops appended to
+    ``ops``.  Returns ``("ok", None)`` or ``("residual", expr)`` — ops
+    committed, variable sets updated (residual WHERE conjuncts must run
+    on the generic tail) — or ``("no", reason)`` with nothing committed."""
+    if m.optional:
+        return "no", "OPTIONAL MATCH"
+    if len(m.patterns) != 1:
+        return "no", "multiple patterns"
+    pat = m.patterns[0]
+    if pat.name or pat.shortest:
+        return "no", "named path / shortestPath"
+    els = pat.elements
+    if len(els) % 2 == 0 or not els:
+        return "no", "malformed pattern"
     nodes = els[0::2]
     rels = els[1::2]
     if not all(isinstance(n, ast.NodePattern) for n in nodes) or \
             not all(isinstance(r, ast.RelPattern) for r in rels):
-        return None, "malformed pattern"
-    for r in rels:
-        if r.var_length or r.min_hops != 1 or r.max_hops != 1:
-            return None, "variable-length relationship"
+        return "no", "malformed pattern"
+    last = len(rels) - 1
+    for i, r in enumerate(rels):
         if r.properties is not None:
-            return None, "relationship property map"
+            return "no", "relationship property map"
+        if r.var_length or r.min_hops != 1 or r.max_hops != 1:
+            if i != last:
+                return "no", "variable-length hop mid-chain"
+            if r.variable:
+                return "no", "named variable-length relationship"
     for nd in nodes[1:]:
         if nd.properties is not None:
-            return None, "non-anchor property map"
+            return "no", "non-anchor property map"
     anchor = nodes[0]
 
-    # -- variable naming (anonymous get § internal names) -------------------
+    # -- variable naming (anonymous get clause-scoped § names) --------------
     node_names: list[str] = []
-    first_pos: dict[str, int] = {}
+    local_first: dict[str, int] = {}
     for i, nd in enumerate(nodes):
-        name = nd.variable or f"§n{i}"
+        name = nd.variable or f"§n{ci}_{i}"
         node_names.append(name)
-        first_pos.setdefault(name, i)
+        local_first.setdefault(name, i)
     edge_names: list[str] = []
     for i, r in enumerate(rels):
-        name = r.variable or f"§e{i}"
-        if name in edge_names or name in first_pos:
-            return None, "repeated relationship variable"
+        name = r.variable or f"§e{ci}_{i}"
+        if name in edge_names or name in local_first or name in node_vars \
+                or name in edge_vars or name in val_vars:
+            return "no", "repeated relationship variable"
         edge_names.append(name)
-    node_vars = {n for n in node_names if not n.startswith("§")}
-    edge_vars = {n for n in edge_names if not n.startswith("§")}
-    named_nodes = sorted(node_vars)
-    named_edges = sorted(edge_vars)
+    for name in node_names:
+        if name in edge_vars or name in val_vars:
+            return "no", "variable name collision"
+    anchor_name = node_names[0]
+    bound_anchor = anchor_name in node_vars
+    if bound_anchor and (anchor.properties is not None
+                         or anchor.where is not None):
+        return "no", "bound anchor with inline predicate"
+    if not bound_anchor and rooted and anchor.properties is not None:
+        pvars: set = set()
+        for pv in anchor.properties.items.values():
+            _expr_vars(pv, pvars)
+        if pvars:
+            # AnchorScanOp evaluates the prop map with an EMPTY binding —
+            # correct only when nothing upstream could be referenced
+            return "no", "anchor property map references variables"
 
     # -- WHERE conjunct split ----------------------------------------------
+    known_nodes = node_vars | set(node_names)
+    known_edges = edge_vars | set(edge_names)
     per_var: dict[str, list] = {}
     residual_parts: list = []
     if m.where is not None:
         for part in _split_and(m.where):
             vs: set = set()
             _expr_vars(part, vs)
-            if len(vs) == 1 and (v := next(iter(vs))) in node_vars:
-                per_var.setdefault(v, []).append(part)
-            else:
-                residual_parts.append(part)
+            if len(vs) == 1:
+                v = next(iter(vs))
+                if (v in known_nodes or v in known_edges) \
+                        and not v.startswith("§"):
+                    per_var.setdefault(v, []).append(part)
+                    continue
+            residual_parts.append(part)
     for nd, name in zip(nodes, node_names):
         if nd.where is not None:
             if not nd.variable:
-                return None, "inline WHERE on anonymous node"
+                return "no", "inline WHERE on anonymous node"
             per_var.setdefault(name, []).append(nd.where)
     var_cw: dict[str, CompiledWhere] = {}
     for v, parts in per_var.items():
@@ -1138,95 +2060,220 @@ def compile_query(q: ast.Query, ex) -> tuple[Optional[CompiledPlan], str]:
             residual_parts.append(cw.residual)
         if cw.has_columnar:
             var_cw[v] = cw
-    residual = _join_and(residual_parts)
 
-    ret = cls[1] if len(cls) == 2 and isinstance(cls[1], ast.ReturnClause) \
-        else None
-    plain_ret = (ret is not None and not ret.distinct and not ret.order_by
-                 and ret.skip is None and ret.limit is None and not ret.star
-                 and len(ret.items) == 1)
-
-    # -- retired-fastpath short circuits ------------------------------------
-    if (plain_ret and m.where is None and anchor.where is None
-            and residual is None):
-        e = ret.items[0].expr
-        is_count = (isinstance(e, ast.FunctionCall) and e.name == "count"
-                    and not e.distinct and len(e.args) == 1)
-        if is_count and len(els) == 1 and anchor.properties is None:
-            arg = e.args[0]
-            counts_node = (isinstance(arg, ast.Literal) and arg.value == "*") \
-                or (isinstance(arg, ast.Variable)
-                    and arg.name == anchor.variable)
-            if counts_node:
-                op = NodeCountOp(anchor.labels, ret.items[0].key)
-                return CompiledPlan([op], q, True, ""), ""
-        if is_count and len(els) == 3:
-            a, rel, b = els
-            bare = not (a.labels or a.properties or a.where or b.labels
-                        or b.properties or b.where)
-            if bare:
-                arg = e.args[0]
-                counts_rel = (isinstance(arg, ast.Literal)
-                              and arg.value == "*") \
-                    or (isinstance(arg, ast.Variable)
-                        and (arg.name == rel.variable
-                             or arg.name == a.variable
-                             or arg.name == b.variable))
-                if counts_rel and not (a.variable and a.variable == b.variable):
-                    op = EdgeCountOp(rel.types, rel.direction,
-                                     ret.items[0].key)
-                    return CompiledPlan([op], q, True, ""), ""
-
-    # -- scan + filter + expand pipeline ------------------------------------
-    ops: list[_Op] = []
-    anchor_name = node_names[0]
-    anchor_cw = var_cw.pop(anchor_name, None)
-    if anchor.properties is not None:
-        ops.append(AnchorScanOp(anchor_name, anchor))
-        if anchor_cw is not None:
-            ops.append(FilterOp(anchor_name, anchor_cw,
-                                _cw_text(per_var.get(anchor_name))))
-    elif anchor_cw is not None and len(anchor.labels) == 1:
-        ops.append(MaskedLabelScanOp(anchor_name, anchor.labels[0],
-                                     anchor_cw,
-                                     _cw_text(per_var.get(anchor_name))))
-    elif anchor.labels:
-        ops.append(LabelScanOp(anchor_name, anchor.labels))
-        if anchor_cw is not None:
-            ops.append(FilterOp(anchor_name, anchor_cw,
-                                _cw_text(per_var.get(anchor_name))))
+    # -- scan / join + filter + expand pipeline ------------------------------
+    temp: list[_Op] = []
+    if bound_anchor:
+        # re-anchoring on an already-bound id column: membership mask
+        if anchor.labels:
+            temp.append(JoinCheckOp(anchor_name, anchor.labels))
     else:
-        ops.append(AllScanOp(anchor_name))
-        if anchor_cw is not None:
-            ops.append(FilterOp(anchor_name, anchor_cw,
-                                _cw_text(per_var.get(anchor_name))))
-    seen = {anchor_name}
+        anchor_cw = var_cw.pop(anchor_name, None)
+        if anchor.properties is not None:
+            temp.append(AnchorScanOp(anchor_name, anchor))
+            if anchor_cw is not None:
+                temp.append(FilterOp(anchor_name, anchor_cw,
+                                     _cw_text(per_var.get(anchor_name))))
+        elif anchor_cw is not None and len(anchor.labels) == 1:
+            temp.append(MaskedLabelScanOp(anchor_name, anchor.labels[0],
+                                          anchor_cw,
+                                          _cw_text(per_var.get(anchor_name))))
+        elif anchor.labels:
+            temp.append(LabelScanOp(anchor_name, anchor.labels))
+            if anchor_cw is not None:
+                temp.append(FilterOp(anchor_name, anchor_cw,
+                                     _cw_text(per_var.get(anchor_name))))
+        else:
+            temp.append(AllScanOp(anchor_name))
+            if anchor_cw is not None:
+                temp.append(FilterOp(anchor_name, anchor_cw,
+                                     _cw_text(per_var.get(anchor_name))))
+        if rooted:
+            # a scan under a non-empty table is a cartesian join root
+            temp[0].kind = "join"
+    seen = set(node_vars) | {anchor_name}
     for i, rel in enumerate(rels):
         src = node_names[i]
         dst = node_names[i + 1]
         dst_join = dst in seen
-        ops.append(ExpandOp(src, rel, dst, dst_join,
-                            nodes[i + 1].labels, edge_names[i],
-                            edge_names[:i]))
-        seen.add(dst)
-        if not dst_join:
-            cw = var_cw.pop(dst, None)
-            if cw is not None:
-                ops.append(FilterOp(dst, cw, _cw_text(per_var.get(dst))))
+        is_vl = rel.var_length or rel.min_hops != 1 or rel.max_hops != 1
+        if is_vl:
+            temp.append(VarLenExpandOp(src, rel, dst, dst_join,
+                                       nodes[i + 1].labels,
+                                       edge_names[:i]))
         else:
-            cw = var_cw.pop(dst, None)
-            if cw is not None:  # join var filtered after re-binding
-                ops.append(FilterOp(dst, cw, _cw_text(per_var.get(dst))))
+            temp.append(ExpandOp(src, rel, dst, dst_join,
+                                 nodes[i + 1].labels, edge_names[i],
+                                 edge_names[:i]))
+        seen.add(dst)
+        cw = var_cw.pop(dst, None)
+        if cw is not None:  # join vars filtered after re-binding
+            temp.append(FilterOp(dst, cw, _cw_text(per_var.get(dst))))
+    for v in sorted(var_cw):  # edge vars / re-filtered earlier bindings
+        temp.append(FilterOp(v, var_cw[v], _cw_text(per_var.get(v))))
 
-    if ret is not None and residual is None:
-        rop, reason = _plan_return(ret, node_vars, edge_vars)
-        if rop is not None:
-            ops.append(rop)
-            return CompiledPlan(ops, q, True, ""), ""
-        ops.append(FallbackOp(1, None, named_nodes, named_edges))
-        return CompiledPlan(ops, q, False, ""), reason
-    ops.append(FallbackOp(1, residual, named_nodes, named_edges))
-    return CompiledPlan(ops, q, False, ""), "generic tail"
+    ops.extend(temp)
+    node_vars.update(n for n in node_names if not n.startswith("§"))
+    edge_vars.update(n for n in edge_names if not n.startswith("§"))
+    if residual_parts:
+        return "residual", _join_and(residual_parts)
+    return "ok", None
+
+
+def _plan_with(clause: ast.WithClause, node_vars: set, edge_vars: set,
+               val_vars: frozenset):
+    """(WithOp, "", (nodes, edges, vals)) for a supported WITH — the sets
+    are the POST-projection variable namespace — else (None, reason, None)."""
+    from nornicdb_tpu.cypher.executor import _contains_aggregate
+
+    if clause.star:
+        return None, "WITH *", None
+    aliases = [it.key for it in clause.items]
+    if len(set(aliases)) != len(aliases):
+        return None, "duplicate WITH alias", None
+    item_specs = []
+    group_idx, agg_idx = [], []
+    for i, it in enumerate(clause.items):
+        if _contains_aggregate(it.expr):
+            agg, spec = _classify_agg(it.expr, node_vars, edge_vars,
+                                      val_vars)
+            if agg is None:
+                return None, f"WITH aggregate `{it.key}`", None
+            item_specs.append((agg, spec))
+            agg_idx.append(i)
+        else:
+            _, spec = _classify_item(it.expr, node_vars, edge_vars,
+                                     val_vars)
+            if spec is None:
+                return None, f"WITH projection `{it.key}`", None
+            item_specs.append((None, spec))
+            group_idx.append(i)
+    new_nodes: set = set()
+    new_edges: set = set()
+    new_vals: set = set()
+    for i, (agg, spec) in enumerate(item_specs):
+        if agg is None and spec[0] == "node":
+            new_nodes.add(aliases[i])
+        elif agg is None and spec[0] == "edge":
+            new_edges.add(aliases[i])
+        else:
+            new_vals.add(aliases[i])
+    # ORDER BY resolves in the POST-projection namespace only (the generic
+    # overlay favors output columns; anything needing a source-row var
+    # stays generic)
+    order_specs: list = []
+    if clause.order_by:
+        for oi in clause.order_by:
+            expr = oi.expr
+            spec = None
+            if isinstance(expr, ast.Variable) and expr.name in new_vals:
+                spec = ("val", expr.name)
+            elif (isinstance(expr, ast.Property)
+                    and isinstance(expr.subject, ast.Variable)
+                    and expr.key != "id"):
+                v = expr.subject.name
+                if v in new_nodes:
+                    spec = ("nprop", v, expr.key)
+                elif v in new_edges:
+                    spec = ("eprop", v, expr.key)
+            if spec is None and len(clause.order_by) == 1:
+                spec = _vec_order_spec(expr, new_nodes)
+            if spec is None:
+                getter = _const_getter(expr)
+                if getter is not None:
+                    spec = ("const", getter)
+            if spec is None:
+                return None, "WITH ORDER BY expression", None
+            order_specs.append(spec)
+    sublabels = []
+    if agg_idx:
+        sublabels.append("WithAggregate(" + ", ".join(
+            aliases[i] for i in agg_idx) + ")")
+    else:
+        sublabels.append("WithProject(" + ", ".join(aliases) + ")")
+    if clause.distinct:
+        sublabels.append("Distinct")
+    if clause.order_by:
+        if any(s[0] == "vec" for s in order_specs):
+            oi = clause.order_by[0]
+            sublabels.append("VectorTopK(" + ast.expr_text(oi.expr)
+                             + (" DESC" if oi.descending else "") + ")")
+        else:
+            sublabels.append("Sort(" + ", ".join(
+                ("DESC " if oi.descending else "") +
+                ast.expr_text(oi.expr) for oi in clause.order_by) + ")")
+    if clause.skip is not None or clause.limit is not None:
+        sublabels.append("Slice(skip/limit)")
+    if clause.where is not None:
+        sublabels.append("Filter(WHERE " + ast.expr_text(clause.where)
+                         + ")")
+    op = WithOp(clause, item_specs, group_idx, agg_idx, order_specs,
+                sublabels)
+    return op, "", (new_nodes, new_edges, new_vals)
+
+
+def compile_query(q: ast.Query, ex) -> tuple[Optional[CompiledPlan], str]:
+    """Pattern-compile a canonical (literal-lifted) Query into an operator
+    DAG, or (None, reason) when no columnar prefix exists.  Clause
+    boundaries don't stop the pipeline: MATCH chains join against the
+    standing table, WITH projects/aggregates it in place, and the first
+    unsupported construct plants a FallbackOp that hands the *current*
+    binding table to the interpreter for the remaining clauses."""
+    cls = q.clauses
+    if not cls or not isinstance(cls[0], ast.MatchClause):
+        return None, "no leading MATCH"
+    fast = _retired_fastpaths(q, cls)
+    if fast is not None:
+        return fast, ""
+
+    ops: list[_Op] = []
+    node_vars: set = set()
+    edge_vars: set = set()
+    val_vars: set = set()
+
+    def fallback(idx: int, residual=None) -> CompiledPlan:
+        ops.append(FallbackOp(idx, residual, sorted(node_vars),
+                              sorted(edge_vars), sorted(val_vars)))
+        return CompiledPlan(ops, q, False, "")
+
+    rooted = False
+    ci = 0
+    while ci < len(cls):
+        c = cls[ci]
+        if isinstance(c, ast.MatchClause):
+            status, extra = _plan_match_clause(
+                c, ci, ops, node_vars, edge_vars, val_vars, rooted)
+            if status == "no":
+                if ci == 0:
+                    return None, extra
+                return fallback(ci), extra
+            rooted = True
+            if status == "residual":
+                return fallback(ci + 1, extra), "residual WHERE"
+            ci += 1
+            continue
+        if isinstance(c, ast.ReturnClause):
+            if ci != len(cls) - 1:
+                return fallback(ci), "RETURN not final"
+            rop, reason = _plan_return(c, node_vars, edge_vars,
+                                       frozenset(val_vars))
+            if rop is not None:
+                ops.append(rop)
+                return CompiledPlan(ops, q, True, ""), ""
+            return fallback(ci), reason
+        if isinstance(c, ast.WithClause):
+            if ci == len(cls) - 1:
+                return fallback(ci), "trailing WITH"
+            wop, reason, sets = _plan_with(c, node_vars, edge_vars,
+                                           frozenset(val_vars))
+            if wop is None:
+                return fallback(ci), reason
+            ops.append(wop)
+            node_vars, edge_vars, val_vars = sets
+            ci += 1
+            continue
+        return fallback(ci), f"unsupported clause {type(c).__name__}"
+    return fallback(len(cls)), "no RETURN tail"
 
 
 def _cw_text(parts) -> str:
@@ -1252,6 +2299,14 @@ class ColumnarEngine:
         self._tls = threading.local()
         self.outcomes = {"full": 0, "fallback": 0, "bail": 0,
                          "unsupported": 0}
+        # VectorTopK embedding-matrix cache: (label, key) -> _EmbMatrix,
+        # epoch-validated against the colindex on every use
+        self._emb: dict[tuple[str, str], _EmbMatrix] = {}
+        self._emb_lock = threading.Lock()
+        # label-scan memo: (snapshot, {labels: (epochs, sorted idx)}) —
+        # one snapshot generation at a time, validated on every get
+        self._scan_cache: Optional[tuple] = None
+        self._scan_lock = threading.Lock()
 
     # -- shape path (from _run_single) --------------------------------------
     def try_query(self, q: ast.Query, params: dict, stats) -> Optional[Any]:

@@ -422,131 +422,14 @@ class CypherExecutor:
             return self._tx_command(stmt)
         raise CypherSyntaxError(f"unsupported statement {type(stmt).__name__}")
 
-    # -- pattern fastpaths (ref: DetectQueryPattern query_patterns.go,
-    # ExecuteOptimized optimized_executors.go). The former detector family
-    # (_fp_count/_fp_group_count/_fp_mutual_rel/_fp_anchored_traverse) is
-    # RETIRED into the columnar operator pipeline (cypher/columnar.py) —
-    # only the edge-property aggregation shape remains, because edge
-    # property columns are not resident in the CSR snapshot. ---------------
-    def _try_fastpath(self, q: ast.Query, params: dict) -> Optional[Result]:
-        if q.unions or len(q.clauses) != 2:
-            return None
-        match, ret = q.clauses
-        if not isinstance(match, ast.MatchClause) or match.optional:
-            return None
-        if not isinstance(ret, ast.ReturnClause):
-            return None
-        if ret.star or len(match.patterns) != 1:
-            return None
-        pattern = match.patterns[0]
-        if pattern.name or pattern.shortest:
-            return None
-        if (
-            ret.distinct
-            or ret.order_by
-            or ret.skip is not None
-            or ret.limit is not None
-        ):
-            return None
-        return self._fp_edge_agg(match, ret, pattern.elements, params)
-
-    @staticmethod
-    def _bare_rel_triple(els) -> Optional[tuple]:
-        """(a, rel, b) when els is a single-hop pattern with unadorned
-        endpoints (no labels/props/inline where) and a plain rel."""
-        if not (
-            len(els) == 3
-            and isinstance(els[0], ast.NodePattern)
-            and isinstance(els[1], ast.RelPattern)
-            and isinstance(els[2], ast.NodePattern)
-        ):
-            return None
-        a, rel, b = els
-        if (
-            a.labels or a.properties or a.where
-            or b.labels or b.properties or b.where
-            or rel.properties or rel.var_length
-        ):
-            return None
-        return a, rel, b
-
-    def _fp_edge_agg(self, match, ret, els, params) -> Optional[Result]:
-        """MATCH ()-[r:T]-() RETURN agg(r.prop), ... — one edge scan per
-        query, no node expansion (ref: detectEdgePropertyAgg
-        query_patterns.go:393). Undirected patterns double each edge, same
-        as the generic two-orientation expansion."""
-        if match.where is not None or not ret.items:
-            return None
-        triple = self._bare_rel_triple(els)
-        if triple is None:
-            return None
-        a, rel, b = triple
-        if a.variable or b.variable:
-            return None  # endpoint vars could be grouped on — generic path
-        if len(rel.types) > 1:
-            return None
-        plan: list[tuple[str, Optional[str]]] = []  # (agg, prop|None)
-        for item in ret.items:
-            e = item.expr
-            if not (
-                isinstance(e, ast.FunctionCall)
-                and e.name in ("count", "sum", "avg", "min", "max")
-                and not e.distinct
-                and len(e.args) == 1
-            ):
-                return None
-            arg = e.args[0]
-            if e.name == "count" and (
-                (isinstance(arg, ast.Literal) and arg.value == "*")
-                or (isinstance(arg, ast.Variable) and arg.name == rel.variable)
-            ):
-                plan.append(("count_rows", None))
-                continue
-            if (
-                isinstance(arg, ast.Property)
-                and isinstance(arg.subject, ast.Variable)
-                and arg.subject.name == rel.variable
-            ):
-                plan.append((e.name, arg.key))
-                continue
-            return None
-        if all(agg == "count_rows" for agg, _ in plan):
-            # pure edge counts are covered by the columnar planner's
-            # EdgeCountOp — retired there, not shadowed here
-            return None
-        mult = 2 if rel.direction == "both" else 1
-        edges = (
-            self.storage.get_edges_by_type(rel.types[0])
-            if rel.types
-            else self.storage.all_edges()
-        )
-        n_rows = 0
-        values: dict[str, list] = {p: [] for _, p in plan if p is not None}
-        for edge in edges:
-            n_rows += mult
-            for prop in values:
-                v = edge.properties.get(prop)
-                if v is not None:
-                    values[prop].extend([v] * mult)
-        out: list[Any] = []
-        for agg, prop in plan:
-            if agg == "count_rows":
-                out.append(n_rows)
-                continue
-            vals = values[prop]
-            if agg == "count":
-                out.append(len(vals))
-            elif agg == "sum":
-                out.append(sum(vals) if vals else 0)
-            elif agg == "avg":
-                out.append(sum(vals) / len(vals) if vals else None)
-            elif agg == "min":
-                out.append(min(vals) if vals else None)
-            else:
-                out.append(max(vals) if vals else None)
-        return Result([it.key for it in ret.items], [out])
-
     # -- query pipeline -----------------------------------------------------------
+    # The executor-level pattern-fastpath family (ref: DetectQueryPattern
+    # query_patterns.go, ExecuteOptimized optimized_executors.go) is fully
+    # RETIRED into the columnar operator pipeline (cypher/columnar.py):
+    # counts are planner short circuits (NodeCountOp/EdgeCountOp) and
+    # edge-property aggregation runs over the CSR-resident edge property
+    # columns (storage/adjacency.py edge_prop_column) — deleted here, not
+    # shadowed.
     def _run_query(
         self,
         q: ast.Query,
@@ -580,9 +463,6 @@ class CypherExecutor:
     ) -> Result:
         stats = stats if stats is not None else Stats()
         if start_rows is None:
-            fast = self._try_fastpath(q, params)
-            if fast is not None:
-                return fast
             # columnar operator pipeline (cypher/columnar.py): compiled
             # plans over the CSR snapshot with per-operator fallback; a
             # None return means "serve it generically" (unsupported shape

@@ -64,8 +64,9 @@ OP_HIST = _REGISTRY.histogram(
     "Columnar operator latency by operator kind",
     labels=("op",))
 OP_CELLS = {op: OP_HIST.labels(op)
-            for op in ("scan", "filter", "expand", "aggregate", "project",
-                       "sort", "fallback")}
+            for op in ("scan", "filter", "expand", "join", "varlen",
+                       "aggregate", "project", "sort", "vector_topk",
+                       "fallback")}
 Q_TOTAL = _REGISTRY.counter(
     "nornicdb_cypher_columnar_queries_total",
     "Columnar pipeline outcomes per attempted query",

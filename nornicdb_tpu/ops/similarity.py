@@ -115,6 +115,25 @@ def cosine_topk(
     return jax.lax.approx_max_k(scores, k, recall_target=recall_target)
 
 
+@functools.partial(jax.jit, static_argnames=("k",))
+def masked_dot_topk(
+    query: jax.Array, corpus: jax.Array, valid: jax.Array, k: int
+) -> tuple[jax.Array, jax.Array]:
+    """Graph-filtered top-k for the Cypher ``VectorTopK`` operator: one
+    (1, D) x (Np, D) GEMM over a pre-normalized corpus with the surviving
+    graph-predicate rows as a validity mask (False -> -inf, covering both
+    pad rows and mask-rejected rows), plus the exact k largest masked
+    scores for the rescore boundary.
+
+    Returns ``(scores (Np,), top_vals (k,))``.  f32 end to end — the
+    caller's widened-boundary rescore contract budgets for f32 GEMM
+    rounding only, not bf16.
+    """
+    s = dot_scores(query[None, :], corpus, use_bf16=False)[0]
+    s = jnp.where(valid, s, -jnp.inf)
+    return s, jax.lax.top_k(s, k)[0]
+
+
 # streaming Pallas top-k engages above this corpus size; below it the (Q, N)
 # score matrix is small enough that the XLA GEMM+approx_max_k path wins on
 # dispatch overhead

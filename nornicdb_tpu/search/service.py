@@ -179,6 +179,80 @@ def default_search_config() -> SearchConfig:
     return cfg
 
 
+# -- graph×vector fusion -----------------------------------------------------
+def _pow2_row_bucket(n: int) -> int:
+    """Row/k counts padded to power-of-two shape classes so the VectorTopK
+    GEMM compiles once per bucket, never per exact corpus size (the
+    nornjit recompile-sentinel contract)."""
+    return 1 << (int(n) - 1).bit_length() if n > 1 else 1
+
+
+def graph_masked_scores(
+    qn: np.ndarray,
+    corpus: np.ndarray,
+    valid: np.ndarray,
+    k: int,
+    desc: bool,
+    dev_ref: Optional[list] = None,
+):
+    """Device scoring for the Cypher ``VectorTopK`` operator: one masked
+    GEMM over a row-normalized ``corpus`` (n, d) with the graph-predicate
+    survivors as ``valid``, returning ``(scores, boundary)`` — per-row
+    cosine scores (length n, original orientation) and the kth best
+    masked score in that orientation.  ``desc=False`` (ORDER BY ... ASC)
+    rides the same kernel on the negated query.  None when no device
+    manager is serving (caller scores on host) — the gate never blocks,
+    so a hung backend degrades to host scoring instead of wedging the
+    query.  ``dev_ref`` is a one-slot list caching the padded
+    device-resident corpus across queries of the same shape bucket.
+    """
+    from nornicdb_tpu import backend as _bk
+
+    try:
+        if _bk.manager_stats() is None or not _bk.manager().ready():
+            return None
+        import jax.numpy as jnp
+
+        from nornicdb_tpu.ops.similarity import LANE, masked_dot_topk
+        from nornicdb_tpu.telemetry import deviceprof as _deviceprof
+
+        n = corpus.shape[0]
+        rows_pad = max(_pow2_row_bucket(n), LANE)
+        k_pad = min(_pow2_row_bucket(max(k, 1)), rows_pad)
+        t0 = time.perf_counter()
+        dev = None
+        if dev_ref and dev_ref[0] is not None:
+            cached_pad, cached = dev_ref[0]
+            if cached_pad == rows_pad:
+                dev = cached
+        if dev is None:
+            buf = np.zeros((rows_pad, corpus.shape[1]), np.float32)
+            buf[:n] = corpus
+            dev = jnp.asarray(buf)
+            if dev_ref is not None:
+                dev_ref[0] = (rows_pad, dev)
+        vpad = np.zeros(rows_pad, bool)
+        vpad[:n] = valid
+        q = np.asarray(qn if desc else -qn, np.float32)
+        scores, top = masked_dot_topk(
+            jnp.asarray(q), dev, jnp.asarray(vpad), k_pad)
+        scores = np.asarray(scores[:n], np.float64)
+        boundary = float(np.asarray(top)[min(k, k_pad) - 1])
+        _deviceprof.record_execute(
+            "cypher", "vector_topk", _deviceprof.pow2_class(rows_pad, "n"),
+            time.perf_counter() - t0)
+        if not desc:
+            # undo the ASC negation; masked rows become +inf, which can
+            # never pass the caller's `score <= boundary + eps` cut
+            scores = -scores
+            boundary = -boundary
+        return scores, boundary
+    except Exception:
+        logger.debug("graph-masked device scoring unavailable",
+                     exc_info=True)
+        return None
+
+
 class SearchService:
     """(ref: search.Service pkg/search/search.go:236)"""
 

@@ -785,6 +785,11 @@ class SoakHarness:
                 # plan cache warm, with a bounded slow-query tail
                 report.invariants.append(
                     inv.check_plan_cache_effective(samples, metrics_text))
+                # the vector-ranked shape in the same rotation must ride
+                # the fused VectorTopK operator without unseating the
+                # plan cache (PR 19 graph x vector fusion)
+                report.invariants.append(
+                    inv.check_graph_vector_fused(metrics_text))
             report.invariants.append(inv.check_chaos_in_metrics(
                 metrics_text, chaos_instance_stats))
             fams = inv.parse_prometheus(metrics_text)

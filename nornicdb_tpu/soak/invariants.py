@@ -27,6 +27,10 @@ Catalog:
                           columnar plan cache serves repeat shapes warm
                           (hit ratio over threshold) and the class's
                           ok-request p99 stays bounded
+* ``graph_vector_fused`` — the vector-ranked cypher shape is served
+                          through the fused VectorTopK operator at least
+                          once, and the plan-cache hit ratio holds with
+                          that shape in rotation
 * ``fleet_metrics_present`` — every live worker's exposition is merged
                           into the final /metrics scrape under its
                           ``proc`` label (fleet membership one-hot), and
@@ -257,6 +261,46 @@ def check_plan_cache_effective(
         "plan_cache_effective",
         f"hit ratio {ratio:.2f} ({int(hits)}/{int(total)}), "
         f"cypher p99 {p99 * 1e3:.0f}ms over {len(oks)} ok requests")
+
+
+def check_graph_vector_fused(
+    metrics_text: str, min_hit_ratio: float = 0.5,
+) -> InvariantResult:
+    """With the vector-ranked cypher shape in rotation, at least one
+    query must have been served through the fused VectorTopK operator
+    (``nornicdb_cypher_operator_seconds{op="vector_topk"}``), and pulling
+    vector ranking into the planner must not unseat the plan cache: the
+    hit ratio holds at the same floor ``plan_cache_effective`` enforces
+    (one plan per shape, literals lifted — a ratio collapse here means
+    the vector shape is recompiling per query)."""
+    try:
+        fams = parse_prometheus(metrics_text)
+    except ValueError as e:
+        return failed("graph_vector_fused", f"metrics unparseable: {e}")
+    fam = fams.get("nornicdb_cypher_operator_seconds_count", {})
+    served = sum(v for labels, v in fam.items()
+                 if 'op="vector_topk"' in labels)
+    if served < 1:
+        return failed(
+            "graph_vector_fused",
+            "no query was served through the VectorTopK operator")
+    hits = metric_total(fams, "nornicdb_cypher_plan_cache_hits_total") or 0.0
+    misses = metric_total(
+        fams, "nornicdb_cypher_plan_cache_misses_total") or 0.0
+    total = hits + misses
+    if not total:
+        return failed("graph_vector_fused",
+                      "plan cache never consulted under cypher traffic")
+    ratio = hits / total
+    if ratio < min_hit_ratio:
+        return failed(
+            "graph_vector_fused",
+            f"plan-cache hit ratio {ratio:.2f} < {min_hit_ratio} with the "
+            f"vector shape in rotation")
+    return passed(
+        "graph_vector_fused",
+        f"{int(served)} VectorTopK-served queries, plan-cache hit ratio "
+        f"{ratio:.2f}")
 
 
 def check_fleet_metrics_present(metrics_text: str,

@@ -270,8 +270,16 @@ class WorkloadRunner:
                     uid = f"h{idx}-{n}-{uuid.uuid4().hex[:8]}"
                     prev = self._pick_uid(rng)
                     stmts = [{
-                        "statement": "CREATE (:SoakW {uid: $uid, w: $w})",
-                        "parameters": {"uid": uid, "w": idx},
+                        "statement": (
+                            "CREATE (:SoakW {uid: $uid, w: $w, emb: $emb})"),
+                        "parameters": {
+                            "uid": uid, "w": idx,
+                            # small per-node embedding so the vector_topk
+                            # cypher shape ranks over a live churning
+                            # corpus (bolt-created nodes stay emb-less:
+                            # null-score rows are part of the contract)
+                            "emb": [round(rng.random() * 2 - 1, 6)
+                                    for _ in range(8)]},
                     }]
                     if prev is not None and rng.random() < 0.5:
                         stmts.append({
@@ -356,6 +364,11 @@ class WorkloadRunner:
          "MATCH (a:SoakW {uid: $uid})-[:NEXT]->(b) "
          "RETURN b.uid ORDER BY b.uid LIMIT 5",
          lambda self, rng: {"uid": self._pick_uid(rng) or "none"}),
+        ("vector_topk",
+         "MATCH (n:SoakW) RETURN n.uid ORDER BY "
+         "vector.similarity.cosine(n.emb, $q) DESC LIMIT 5",
+         lambda self, rng: {"q": [round(rng.random() * 2 - 1, 6)
+                                  for _ in range(8)]}),
     ]
 
     def _cypher_worker(self, idx: int) -> None:

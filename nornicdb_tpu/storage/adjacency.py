@@ -154,10 +154,11 @@ class CSRView:
     per-edge ``sort()`` contract at array speed)."""
 
     __slots__ = ("generation", "n_csr", "ids", "node_alive", "row_alive",
-                 "erow_type", "erow_rank", "row_ids", "type_code", "_csr")
+                 "erow_type", "erow_rank", "row_ids", "type_code", "eprops",
+                 "_csr")
 
     def __init__(self, generation, n_csr, ids, node_alive, row_alive,
-                 erow_type, erow_rank, row_ids, type_code, csr):
+                 erow_type, erow_rank, row_ids, type_code, eprops, csr):
         self.generation = generation
         self.n_csr = n_csr
         self.ids = ids              # vocab list ref (append-only)
@@ -167,7 +168,16 @@ class CSRView:
         self.erow_rank = erow_rank
         self.row_ids = row_ids      # row -> edge id (list ref; replaced by merges)
         self.type_code = type_code  # name -> code (copy)
+        self.eprops = eprops        # key -> row-aligned column (list refs)
         self._csr = csr             # {"out": (off, nbr, rows), "in": ...}
+
+    def edge_prop_column(self, key: str):
+        """Row-aligned edge property column, or None when the key was never
+        present on any edge at capture (callers synthesize all-null).  The
+        list is shared with the snapshot: in-place property updates are
+        visible until the next merge replaces it — the same mid-query
+        read-latest semantics the node colindex columns have."""
+        return self.eprops.get(key)
 
     def codes_for(self, types) -> Optional[list[int]]:
         """Codes for a rel-type filter; None = no filter. An empty list
@@ -282,6 +292,10 @@ class AdjacencySnapshot:
         self._row_ids: list[str] = []
         self._row_of: dict[str, int] = {}
         self._row_alive = np.zeros(0, bool)
+        # row-aligned edge property columns (key -> list, length _m); the
+        # columnar pipeline's edge-prop filters/aggregates read these via
+        # CSRView.edge_prop_column instead of per-row get_edge fetches
+        self._eprops: dict[str, list] = {}
         self._tombstones = 0
         self._out_off = np.zeros(1, np.int32)
         self._out_nbr = np.zeros(0, np.int32)
@@ -295,6 +309,7 @@ class AdjacencySnapshot:
         self._d_dst: list[int] = []
         self._d_type: list[int] = []
         self._d_alive: list[bool] = []
+        self._d_props: list[Optional[dict]] = []
         self._d_out: dict[int, list[int]] = {}
         self._d_in: dict[int, list[int]] = {}
         self._pending = 0  # delta events since last merge (adds + removes)
@@ -320,7 +335,8 @@ class AdjacencySnapshot:
                     return
                 if kind == EDGE_CREATED:
                     self._add_edge_locked(entity.id, entity.start_node,
-                                          entity.end_node, entity.type)
+                                          entity.end_node, entity.type,
+                                          entity.properties)
                 elif kind == EDGE_DELETED:
                     self._remove_edge_locked(entity.id)
                 else:  # EDGE_UPDATED: re-link only if topology changed
@@ -367,7 +383,8 @@ class AdjacencySnapshot:
         return c
 
     def _add_edge_locked(self, eid: str, src_id: str, dst_id: str,
-                         type_name: str) -> None:
+                         type_name: str,
+                         props: Optional[dict] = None) -> None:
         row = self._row_of.get(eid)
         if row is not None and self._edge_alive_locked(row):
             return  # duplicate create event
@@ -380,6 +397,7 @@ class AdjacencySnapshot:
         self._d_dst.append(d)
         self._d_type.append(t)
         self._d_alive.append(True)
+        self._d_props.append(dict(props) if props else None)
         self._d_out.setdefault(s, []).append(j)
         self._d_in.setdefault(d, []).append(j)
         self._row_of[eid] = self._m + j
@@ -428,17 +446,37 @@ class AdjacencySnapshot:
         if row is None or not self._edge_alive_locked(row):
             # update for an edge we never saw created: treat as add
             self._add_edge_locked(edge.id, edge.start_node, edge.end_node,
-                                  edge.type)
+                                  edge.type, edge.properties)
             return
         s, d, t = self._edge_record_locked(row)
         ns = self._idx.get(edge.start_node)
         nd = self._idx.get(edge.end_node)
         nt = self._type_code.get(edge.type)
         if (ns, nd, nt) == (s, d, t):
-            return  # property-only update: topology unchanged
+            # property-only update: topology unchanged, refresh columns
+            self._set_edge_props_locked(row, edge.properties)
+            return
         self._remove_edge_locked(edge.id)
         self._add_edge_locked(edge.id, edge.start_node, edge.end_node,
-                              edge.type)
+                              edge.type, edge.properties)
+
+    def _set_edge_props_locked(self, row: int, props: dict) -> None:
+        """Overwrite an alive edge row's property columns in place (keys
+        absent from ``props`` are cleared — update replaces the map)."""
+        if row >= self._m:
+            self._d_props[row - self._m] = dict(props) if props else None
+            return
+        for k, col in self._eprops.items():
+            col[row] = props.get(k) if props else None
+        if props:
+            for k, v in props.items():
+                if k not in self._eprops:
+                    col = [None] * self._m
+                    col[row] = v
+                    self._eprops[k] = col
+                    # a brand-new key isn't in the cached view's shallow
+                    # column dict: drop the view so the next capture sees it
+                    self._csr_view = None
 
     # -- build / merge ------------------------------------------------------
     def ready(self) -> bool:
@@ -460,7 +498,8 @@ class AdjacencySnapshot:
             with self._lock:
                 epoch0 = self._epoch
             node_ids = self._scan_node_ids()
-            edges = [(e.id, e.start_node, e.end_node, e.type)
+            edges = [(e.id, e.start_node, e.end_node, e.type,
+                      e.properties or None)
                      for e in self.storage.all_edges()]
             with self._lock:
                 if self._built:
@@ -483,7 +522,7 @@ class AdjacencySnapshot:
         return [n.id for n in self.storage.all_nodes()]
 
     def _install_locked(self, node_ids: list[str],
-                        edges: list[tuple[str, str, str, str]]) -> None:
+                        edges: list[tuple]) -> None:
         t0 = time.perf_counter()
         with _tracer.span("adjacency.build",
                           {"nodes": len(node_ids), "edges": len(edges)}):
@@ -491,7 +530,7 @@ class AdjacencySnapshot:
         _ADJ_BUILD_CELL.observe(time.perf_counter() - t0)
 
     def _install_locked_inner(self, node_ids: list[str],
-                              edges: list[tuple[str, str, str, str]]) -> None:
+                              edges: list[tuple]) -> None:
         self._ids = list(node_ids)
         self._idx = {id_: i for i, id_ in enumerate(self._ids)}
         self._alive = [True] * len(self._ids)
@@ -502,13 +541,21 @@ class AdjacencySnapshot:
         typ = np.zeros(m, np.int32)
         self._row_ids = [""] * m
         self._row_of = {}
-        for r, (eid, s_id, d_id, t_name) in enumerate(edges):
+        eprops: dict[str, list] = {}
+        for r, (eid, s_id, d_id, t_name, props) in enumerate(edges):
             src[r] = self._intern_node_locked(s_id)
             dst[r] = self._intern_node_locked(d_id)
             typ[r] = self._type_code_locked(t_name)
             self._row_ids[r] = eid
             self._row_of[eid] = r
+            if props:
+                for k, v in props.items():
+                    col = eprops.get(k)
+                    if col is None:
+                        col = eprops[k] = [None] * m
+                    col[r] = v
         self._erow_src, self._erow_dst, self._erow_type = src, dst, typ
+        self._eprops = eprops
         self._m = m
         self._row_alive = np.ones(m, bool)
         self._tombstones = 0
@@ -525,6 +572,7 @@ class AdjacencySnapshot:
         self._d_dst = []
         self._d_type = []
         self._d_alive = []
+        self._d_props = []
         self._d_out = {}
         self._d_in = {}
         self._pending = 0
@@ -574,9 +622,27 @@ class AdjacencySnapshot:
             self._erow_type[keep],
             np.asarray([self._d_type[j] for j in d_keep], np.int32),
         ]).astype(np.int32)
-        row_ids = [self._row_ids[r] for r in keep.tolist()]
+        keep_l = keep.tolist()
+        row_ids = [self._row_ids[r] for r in keep_l]
         row_ids += [self._d_ids[j] for j in d_keep]
+        # re-gather property columns in the same keep + delta order (fresh
+        # lists: views pinned pre-merge keep reading their own copies)
+        keys = set(self._eprops)
+        for j in d_keep:
+            p = self._d_props[j]
+            if p:
+                keys.update(p)
+        eprops: dict[str, list] = {}
+        for k in keys:
+            old = self._eprops.get(k)
+            col = ([old[r] for r in keep_l] if old is not None
+                   else [None] * len(keep_l))
+            for j in d_keep:
+                p = self._d_props[j]
+                col.append(p.get(k) if p else None)
+            eprops[k] = col
         self._erow_src, self._erow_dst, self._erow_type = src, dst, typ
+        self._eprops = eprops
         self._row_ids = row_ids
         self._row_of = {eid: r for r, eid in enumerate(row_ids)}
         self._m = len(row_ids)
@@ -832,6 +898,7 @@ class AdjacencySnapshot:
                 erow_rank=self._rank_arr,
                 row_ids=self._row_ids,
                 type_code=dict(self._type_code),
+                eprops=dict(self._eprops),
                 csr={"out": (self._out_off, self._out_nbr, self._out_rows),
                      "in": (self._in_off, self._in_nbr, self._in_rows)},
             )

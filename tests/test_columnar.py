@@ -30,7 +30,9 @@ def _build_graph(eng, n_people=40, n_msgs=60, seed=11):
             properties={"i": p, "name": f"P{p:03d}",
                         "age": (p * 7) % 61,
                         "score": round(rng.random() * 10, 3),
-                        "city": rng.choice(cities)}))
+                        "city": rng.choice(cities),
+                        "emb": [round(rng.random() * 2 - 1, 6)
+                                for _ in range(8)]}))
     for m in range(n_msgs):
         eng.create_node(Node(
             id=f"m{m:03d}", labels=["Message"],
@@ -149,6 +151,37 @@ SHAPES = [
     # parameters in every position
     ("MATCH (n:Person) WHERE n.age > $a RETURN n.i ORDER BY n.i LIMIT $l",
      {"a": 33, "l": 4}),
+    # WITH projection/aggregation across the clause boundary
+    ("MATCH (a:Person) WITH a.age AS ag RETURN max(ag)", {}),
+    ("MATCH (a:Person)-[:KNOWS]->(b) WITH b, count(a) AS deg "
+     "WHERE deg > 1 RETURN b.i, deg ORDER BY deg DESC, b.i LIMIT 5", {}),
+    ("MATCH (a:Person) WITH DISTINCT a.city AS c ORDER BY c SKIP 1 "
+     "RETURN c", {}),
+    ("MATCH (p:Person)-[:POSTED]->(m) WITH p, m ORDER BY m.created DESC "
+     "LIMIT 4 RETURN p.i, m.content", {}),
+    # multi-MATCH hash joins over id columns
+    ("MATCH (a:Person {i: 1}) MATCH (b:Message {i: 2}) "
+     "RETURN a.name, b.i", {}),
+    ("MATCH (a:Person)-[:KNOWS]->(b) MATCH (b)-[:POSTED]->(m) "
+     "RETURN b.i, count(m) ORDER BY b.i LIMIT 6", {}),
+    # var-length expansion as bounded-hop batched CSR gathers
+    ("MATCH (a:Person)-[:KNOWS*1..2]->(b) RETURN count(*)", {}),
+    ("MATCH (a:Person {i: 0})-[:KNOWS*1..3]->(b:Person) "
+     "RETURN b.i ORDER BY b.i LIMIT 10", {}),
+    ("MATCH (a:Person {i: 2})-[:KNOWS|FOLLOWS*2..2]-(b) "
+     "RETURN count(*)", {}),
+    # CSR-resident edge property columns
+    ("MATCH (a:Person)-[r:KNOWS]->(b) RETURN sum(r.w)", {}),
+    ("MATCH ()-[r:KNOWS]->() WHERE r.w > 0.5 RETURN count(r)", {}),
+    ("MATCH (a:Person)-[r:POSTED]->(m) RETURN a.city, min(r.w), "
+     "count(r.w)", {}),
+    # vector ranking (host-exact at this scale; the device cut path has
+    # its own suite below)
+    ("MATCH (n:Person) WHERE n.age > 10 RETURN n.i ORDER BY "
+     "vector.similarity.cosine(n.emb, $q) DESC LIMIT 5",
+     {"q": [0.5] * 8}),
+    ("MATCH (n:Person) RETURN n.i ORDER BY "
+     "vector.similarity.cosine($q, n.emb) LIMIT 4", {"q": [1.0] * 8}),
 ]
 
 FALLBACK_SHAPES = [
@@ -157,19 +190,19 @@ FALLBACK_SHAPES = [
     # cross-variable conjunct
     ("MATCH (a:Person)-[:KNOWS]->(b) WHERE b.age > a.age "
      "RETURN count(*)", {}),
-    # WITH tail
-    ("MATCH (a:Person) WITH a.age AS ag RETURN max(ag)", {}),
+    # WITH projection the planner can't columnarize
+    ("MATCH (a:Person) WITH toLower(a.name) AS l RETURN l", {}),
+    # WITH ORDER BY over a computed expression
+    ("MATCH (a:Person) WITH a.age AS x ORDER BY x + 1 RETURN max(x)", {}),
     # RETURN *
     ("MATCH (a:Person {i: 1})-[:KNOWS]->(b) RETURN *", {}),
-    # edge-property aggregation (labeled anchor, so _fp_edge_agg skips too)
-    ("MATCH (a:Person)-[r:KNOWS]->(b) RETURN sum(r.w)", {}),
     # whole-entity projection with entity ORDER BY
     ("MATCH (p:Person) RETURN p ORDER BY p.name LIMIT 3", {}),
 ]
 
 GENERIC_SHAPES = [
     ("OPTIONAL MATCH (n:Person) WHERE n.age > 1000 RETURN n", {}),
-    ("MATCH (a:Person)-[:KNOWS*1..2]->(b) RETURN count(*)", {}),
+    ("MATCH (a:Person)-[r:KNOWS*1..2]->(b) RETURN count(r)", {}),
     ("MATCH (a:Person {i: 1}), (b:Message {i: 2}) RETURN a.name, b.i", {}),
     ("MATCH p = (a:Person {i: 1})-[:KNOWS]->(b) RETURN length(p)", {}),
 ]
@@ -378,16 +411,24 @@ class TestExplainProfile:
 
     def test_explain_reports_generic_with_reason(self):
         _, ex, _ = _twin()
-        r = ex.execute("EXPLAIN MATCH (a:Person)-[:KNOWS*1..3]->(b) "
-                       "RETURN count(*)")
+        r = ex.execute("EXPLAIN MATCH p = (a:Person)-[:KNOWS]->(b) "
+                       "RETURN length(p)")
         assert "columnar: generic" in r.rows[0][0]
 
     def test_explain_reports_generic_tail_operator(self):
         _, ex, _ = _twin()
-        r = ex.execute("EXPLAIN MATCH (a:Person) WITH a.age AS ag "
-                       "RETURN max(ag)")
+        r = ex.execute("EXPLAIN MATCH (a:Person) "
+                       "WITH toLower(a.name) AS l RETURN l")
         assert "GenericTail" in r.rows[0][0]
         assert "[generic]" in r.rows[0][0]
+
+    def test_explain_reports_vector_topk_operator(self):
+        _, ex, _ = _twin()
+        r = ex.execute("EXPLAIN MATCH (n:Person) RETURN n.i ORDER BY "
+                       "vector.similarity.cosine(n.emb, $q) DESC LIMIT 3",
+                       {"q": [0.1] * 8})
+        plan = r.rows[0][0]
+        assert "VectorTopK(" in plan and "[columnar]" in plan
 
     def test_profile_includes_measured_operator_timings(self):
         _, ex, _ = _twin()
@@ -522,6 +563,14 @@ class TestMigrationFromFastpaths:
         ("MATCH (a)-[:KNOWS]->(b)-[:KNOWS]->(a) RETURN count(*)", {}),
         ("MATCH (p:Person {i: 2})-[:KNOWS]-(f)-[:POSTED]->(m:Message) "
          "RETURN m.content ORDER BY m.created DESC LIMIT 5", {}),
+        ("MATCH (a:Person) WITH a.age AS ag RETURN max(ag)", {}),
+        ("MATCH (a:Person)-[:KNOWS*1..2]->(b) RETURN count(*)", {}),
+        ("MATCH (a:Person {i: 1}) MATCH (b:Message {i: 2}) "
+         "RETURN a.name, b.i", {}),
+        ("MATCH (a:Person)-[r:KNOWS]->(b) RETURN sum(r.w)", {}),
+        ("MATCH (n:Person) RETURN n.i ORDER BY "
+         "vector.similarity.cosine(n.emb, $q) DESC LIMIT 3",
+         {"q": [0.25] * 8}),
     ]
 
     @pytest.mark.parametrize("query,params", FORMER,
@@ -533,15 +582,20 @@ class TestMigrationFromFastpaths:
         assert tr is not None and tr["outcome"] == "full", query
         assert got == _run(gen, query, params)
 
-    def test_edge_prop_agg_fastpath_retained(self):
-        """The one surviving fastpath: bare-endpoint edge-property
-        aggregation (edge property columns are not CSR-resident)."""
+    def test_edge_prop_agg_runs_columnar_fastpath_deleted(self):
+        """Edge-property aggregation — the last executor fastpath — now
+        runs over the CSR-resident edge property columns, and the
+        `_fp_edge_agg` / `_try_fastpath` methods are deleted, not
+        shadowed."""
         _, ex, gen = _twin()
         q = ("MATCH ()-[r:KNOWS]->() RETURN avg(r.w), sum(r.w), count(r), "
              "min(r.w), max(r.w)")
         got = _run(ex, q, {})
         assert got == _run(gen, q, {})
-        assert ex.columnar.last_trace() is None  # served by _fp_edge_agg
+        tr = ex.columnar.last_trace()
+        assert tr is not None and tr["outcome"] == "full"
+        assert not hasattr(ex, "_fp_edge_agg")
+        assert not hasattr(ex, "_try_fastpath")
 
 
 class TestTopologyEdgeCases:
@@ -649,6 +703,32 @@ class TestSoakInvariant:
                                        self._metrics(90, 10))
         assert not r.ok
 
+    def _vec_metrics(self, served, hits=90, misses=10):
+        return (self._metrics(hits, misses) +
+                "# TYPE nornicdb_cypher_operator_seconds histogram\n"
+                "nornicdb_cypher_operator_seconds_count"
+                f'{{op="vector_topk"}} {served}\n'
+                'nornicdb_cypher_operator_seconds_count{op="sort"} 7\n')
+
+    def test_graph_vector_fused_passes_when_served(self):
+        from nornicdb_tpu.soak.invariants import check_graph_vector_fused
+
+        r = check_graph_vector_fused(self._vec_metrics(3))
+        assert r.ok, r.detail
+
+    def test_graph_vector_fused_fails_when_never_served(self):
+        from nornicdb_tpu.soak.invariants import check_graph_vector_fused
+
+        r = check_graph_vector_fused(self._vec_metrics(0))
+        assert not r.ok
+
+    def test_graph_vector_fused_fails_on_cache_collapse(self):
+        from nornicdb_tpu.soak.invariants import check_graph_vector_fused
+
+        r = check_graph_vector_fused(self._vec_metrics(3, hits=1,
+                                                       misses=99))
+        assert not r.ok
+
     def test_csr_view_fold_economics(self, monkeypatch):
         """Past the eager floor, a tiny pending delta must NOT refold per
         read (csr_view returns None; the query serves generically) and
@@ -690,3 +770,158 @@ class TestDisableSwitch:
         r = ex.execute("MATCH (n:Person) RETURN count(n)")
         assert r.rows[0][0] == 40
         assert ex.columnar.last_trace() is None
+
+
+# ---------------------------------------------------------------- PR 19
+def _build_vec_graph(n=64, dim=6, seed=7, dup_every=8, miss_every=13):
+    """Label-V corpus with deliberate tie groups (duplicate vectors every
+    ``dup_every`` nodes) and missing embeddings (every ``miss_every``)."""
+    rng = random.Random(seed)
+    eng = MemoryEngine()
+    base = [[round(rng.random() * 2 - 1, 6) for _ in range(dim)]
+            for _ in range(dup_every)]
+    for i in range(n):
+        props = {"i": i}
+        if i % miss_every != 0:
+            props["emb"] = list(base[i % dup_every]) if i % 2 == 0 else \
+                [round(rng.random() * 2 - 1, 6) for _ in range(dim)]
+        eng.create_node(Node(id=f"v{i:03d}", labels=["V"],
+                             properties=props))
+    for i in range(n):
+        eng.create_edge(Edge(id=f"r{i:03d}", start_node=f"v{i:03d}",
+                             end_node=f"v{(i + 1) % n:03d}", type="R"))
+    ex = CypherExecutor(eng)
+    gen = CypherExecutor(eng)
+    gen.columnar.enabled = False
+    return eng, ex, gen
+
+
+class TestGraphVectorFusion:
+    """PR 19 headline: ``ORDER BY vector.similarity.cosine(...) LIMIT k``
+    plans into the masked device top-k (exact host rescore, tie-stable)
+    and must bit-match the interpreter under every degradation: ties,
+    nulls, malformed rows, churned embeddings, and a hung / absent
+    accelerator backend (chaos CI runs this under
+    NORNICDB_FAKE_BACKEND=hang)."""
+
+    Q = [0.3, -0.2, 0.9, 0.1, -0.7, 0.4]
+
+    @pytest.fixture(autouse=True)
+    def _engage_cut(self, monkeypatch):
+        # corpus is tiny; drop the floor so the top-k cut engages
+        monkeypatch.setenv("NORNICDB_VECTOR_TOPK_MIN_ROWS", "1")
+        monkeypatch.setenv("NORNICDB_VECTOR_TOPK_CUTOVER", "0.5")
+
+    def test_desc_topk_bitmatch_and_planned(self):
+        _, ex, gen = _build_vec_graph()
+        q = ("MATCH (v:V) RETURN v.i ORDER BY "
+             "vector.similarity.cosine(v.emb, $q) DESC LIMIT 5")
+        assert _run(ex, q, {"q": self.Q}) == _run(gen, q, {"q": self.Q})
+        tr = ex.columnar.last_trace()
+        assert tr is not None and tr["outcome"] == "full"
+        plan = ex.execute("EXPLAIN " + q, {"q": self.Q}).rows[0][0]
+        assert "VectorTopK(" in plan and "[columnar]" in plan
+
+    def test_asc_topk_bitmatch(self):
+        _, ex, gen = _build_vec_graph()
+        q = ("MATCH (v:V) RETURN v.i ORDER BY "
+             "vector.similarity.cosine($q, v.emb) LIMIT 4")
+        assert _run(ex, q, {"q": self.Q}) == _run(gen, q, {"q": self.Q})
+        assert ex.columnar.last_trace()["outcome"] == "full"
+
+    def test_tie_groups_cross_boundary(self):
+        # duplicate vectors guarantee score ties; sweep k so the cut
+        # boundary lands inside a tie group at least once
+        _, ex, gen = _build_vec_graph(dup_every=4)
+        for k in (2, 3, 5, 8, 13):
+            for d in ("DESC", "ASC"):
+                q = ("MATCH (v:V) RETURN v.i, v.emb ORDER BY "
+                     f"vector.similarity.cosine(v.emb, $q) {d} LIMIT {k}")
+                assert _run(ex, q, {"q": self.Q}) == \
+                    _run(gen, q, {"q": self.Q}), (k, d)
+
+    def test_filtered_topk_mask_pushdown(self):
+        _, ex, gen = _build_vec_graph()
+        for cut in (8, 32, 60):
+            q = (f"MATCH (v:V) WHERE v.i < {cut} RETURN v.i ORDER BY "
+                 "vector.similarity.cosine(v.emb, $q) DESC LIMIT 5")
+            assert _run(ex, q, {"q": self.Q}) == \
+                _run(gen, q, {"q": self.Q}), cut
+            assert ex.columnar.last_trace()["outcome"] == "full"
+
+    def test_nulls_order_like_interpreter(self):
+        # k large enough that missing-emb (null-score) rows enter the
+        # window: DESC puts nulls first generically, ASC last
+        _, ex, gen = _build_vec_graph(miss_every=5)
+        for d in ("DESC", "ASC"):
+            q = ("MATCH (v:V) RETURN v.i ORDER BY "
+                 f"vector.similarity.cosine(v.emb, $q) {d} LIMIT 20")
+            assert _run(ex, q, {"q": self.Q}) == \
+                _run(gen, q, {"q": self.Q}), d
+
+    def test_malformed_row_reproduces_interpreter_error(self):
+        eng, ex, gen = _build_vec_graph()
+        n = eng.get_node("v002")
+        n.properties["emb"] = [1.0, 2.0]  # wrong dim: interpreter raises
+        eng.update_node(n)
+        q = ("MATCH (v:V) RETURN v.i ORDER BY "
+             "vector.similarity.cosine(v.emb, $q) DESC LIMIT 5")
+        got, want = _run(ex, q, {"q": self.Q}), _run(gen, q, {"q": self.Q})
+        assert got == want
+        assert got[0] == "err"
+
+    def test_churn_epoch_invalidation(self):
+        rng = random.Random(3)
+        eng, ex, gen = _build_vec_graph()
+        q = ("MATCH (v:V) RETURN v.i ORDER BY "
+             "vector.similarity.cosine(v.emb, $q) DESC LIMIT 6")
+        for rnd in range(4):
+            # rewrite some embeddings + add a node: cached matrix must
+            # invalidate via the colindex epoch, never serve stale scores
+            for i in (rnd, rnd + 17, rnd + 40):
+                n = eng.get_node(f"v{i:03d}")
+                n.properties["emb"] = [round(rng.random(), 6)
+                                       for _ in range(6)]
+                eng.update_node(n)
+            eng.create_node(Node(
+                id=f"vx{rnd}", labels=["V"],
+                properties={"i": 100 + rnd,
+                            "emb": [round(rng.random(), 6)
+                                    for _ in range(6)]}))
+            assert _run(ex, q, {"q": self.Q}) == \
+                _run(gen, q, {"q": self.Q}), rnd
+
+    def test_host_degradation_when_device_unavailable(self, monkeypatch):
+        from nornicdb_tpu.cypher.plan import OFFLOAD_CELLS
+        from nornicdb_tpu.search import service as svc
+
+        monkeypatch.setattr(svc, "graph_masked_scores",
+                            lambda *a, **k: None)
+        before = OFFLOAD_CELLS["unavailable"].value
+        _, ex, gen = _build_vec_graph()
+        q = ("MATCH (v:V) RETURN v.i ORDER BY "
+             "vector.similarity.cosine(v.emb, $q) DESC LIMIT 5")
+        assert _run(ex, q, {"q": self.Q}) == _run(gen, q, {"q": self.Q})
+        assert ex.columnar.last_trace()["outcome"] == "full"
+        assert OFFLOAD_CELLS["unavailable"].value > before
+
+    def test_fused_with_then_expand(self):
+        _, ex, gen = _build_vec_graph()
+        q = ("MATCH (v:V) WITH v ORDER BY "
+             "vector.similarity.cosine(v.emb, $q) DESC LIMIT 5 "
+             "MATCH (v)-[:R]->(w) RETURN v.i, w.i")
+        assert _run(ex, q, {"q": self.Q}) == _run(gen, q, {"q": self.Q})
+        tr = ex.columnar.last_trace()
+        assert tr is not None and tr["outcome"] == "full"
+        plan = ex.execute("EXPLAIN " + q, {"q": self.Q}).rows[0][0]
+        assert "VectorTopK(" in plan
+
+    def test_operator_metric_observed(self):
+        from nornicdb_tpu.cypher.plan import OP_CELLS
+
+        before = OP_CELLS["vector_topk"].count
+        _, ex, _ = _build_vec_graph()
+        ex.execute("MATCH (v:V) RETURN v.i ORDER BY "
+                   "vector.similarity.cosine(v.emb, $q) DESC LIMIT 5",
+                   {"q": self.Q})
+        assert OP_CELLS["vector_topk"].count > before

@@ -218,8 +218,10 @@ class TestFastpathEquivalence:
         params = {"a": 33}
         set_parallel_config(ParallelConfig(min_batch_size=1, max_workers=4))
         fast = ex.execute(query, params)
-        # generic: disable every shortcut
-        monkeypatch.setattr(ex, "_try_fastpath", lambda q, p: None)
+        # generic: disable every shortcut (the pattern-fastpath family is
+        # retired into the columnar engine, so turning that off is the
+        # whole story now)
+        monkeypatch.setattr(ex.columnar, "enabled", False)
         monkeypatch.setattr(ex, "_match_scan_fast", lambda c, r, p: None)
         generic = ex.execute(query, params)
         assert fast.columns == generic.columns

@@ -137,11 +137,19 @@ class TestColumnarEngages:
             "MATCH (p:Person {id: 1})-[:KNOWS]-(f) WHERE f.name <> 'x' "
             "RETURN f.name ORDER BY f.name") == "full"
 
-    def test_var_length_falls_through(self):
+    def test_var_length_now_columnar(self):
+        """Bounded unnamed var-length runs as batched CSR gathers."""
         ex = _social()
         assert self._outcome(
             ex,
             "MATCH (p:Person {id: 1})-[:KNOWS*1..2]-(f) "
+            "RETURN f.name ORDER BY f.name LIMIT 3") == "full"
+
+    def test_named_var_length_falls_through(self):
+        ex = _social()
+        assert self._outcome(
+            ex,
+            "MATCH (p:Person {id: 1})-[r:KNOWS*1..2]-(f) "
             "RETURN f.name ORDER BY f.name LIMIT 3") == "generic"
 
     def test_repeated_variable_runs_columnar(self):
