@@ -1,6 +1,6 @@
 # NornicDB-TPU (ref: the reference's Makefile test/build targets)
 
-.PHONY: test test-fast lint lint-baseline sanitize jitgate smoke chaos soak soak-ci soak-nornsan soak-multiworker bench bench-search bench-embed bench-generate bench-generate-smoke bench-workers bench-cypher native e2e-bench clean
+.PHONY: test test-fast lint lint-baseline sanitize jitgate smoke capacity-report chaos soak soak-ci soak-nornsan soak-multiworker bench bench-search bench-embed bench-generate bench-generate-smoke bench-workers bench-cypher native e2e-bench clean
 
 test:
 	python -m pytest tests/ -q
@@ -29,6 +29,11 @@ chaos:
 # live-server /metrics + /admin/traces smoke (docs/observability.md)
 smoke:
 	python scripts/telemetry_smoke.py
+
+# live-server /admin/capacity cost-table report: per-program EWMA costs,
+# headroom (max sustainable qps), SLO window state (docs/capacity.md)
+capacity-report:
+	python scripts/capacity_report.py
 
 # 5-minute chaos/load soak: mixed Bolt/HTTP/gRPC/Qdrant traffic under
 # composed replication+backend+storage fault injection, telemetry-backed

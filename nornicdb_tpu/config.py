@@ -88,6 +88,18 @@ class TelemetryConfig:
     fleet_staleness_s: float = 10.0
     # upper bound for POST /admin/profile?seconds=N jax.profiler captures
     profile_max_seconds: float = 60.0
+    # predictive admission (telemetry/costmodel.py): predictions are
+    # multiplied by cost_conservatism before the deadline comparison, and
+    # admission fails OPEN while model confidence sits below
+    # cost_min_confidence (a cold model must never turn traffic away)
+    cost_conservatism: float = 1.5
+    cost_min_confidence: float = 0.25
+    predictive_admission: bool = True
+    # per-route latency SLO targets, "route=ms,route=ms" — feeds the
+    # nornicdb_slo_burn_rate gauges (docs/capacity.md)
+    slo_targets: str = "embed=250,search=250,generate=5000"
+    # SLO objective: burn rate = miss fraction / (1 - objective)
+    slo_objective: float = 0.99
 
 
 @dataclass

@@ -84,6 +84,8 @@ from nornicdb_tpu.errors import (
     ResourceExhausted,
 )
 from nornicdb_tpu.genserve import stats as _stats
+from nornicdb_tpu.telemetry import budget as _budget
+from nornicdb_tpu.telemetry import costmodel as _costmodel
 from nornicdb_tpu.telemetry import deviceprof as _deviceprof
 from nornicdb_tpu.telemetry.tracing import tracer as _tracer
 
@@ -118,6 +120,7 @@ class GenStats:
     sheds_deadline: int = 0
     sheds_pool: int = 0
     sheds_device: int = 0
+    sheds_predicted: int = 0
     cancelled: int = 0
     errors: int = 0
     pool_resets: int = 0
@@ -591,6 +594,44 @@ class GenerationEngine:
                     raise ResourceExhausted(
                         f"generation queue full ({len(self._queue)} "
                         "queued); retry with backoff", reason="queue_full")
+                if deadline:
+                    # predictive admission: prefill chunks + first decode
+                    # step for THIS request, behind every queued request's
+                    # same cost (the queue is bounded by max_queue, so
+                    # this walk is O(64) worst case under the lock)
+                    chunk = self._prefill_chunk
+                    own_steps = (len(prompt) + chunk - 1) // chunk + 1
+                    backlog = sum(
+                        (len(s.prompt) + chunk - 1) // chunk + 1
+                        for s in self._queue)
+                    # units=None on purpose: a decode step (1 token) costs
+                    # roughly a full prefill chunk (both are one forward
+                    # pass), so the kind's per-token slope is meaningless
+                    # for ragged programs — per-dispatch EWMA x dispatch
+                    # count is the honest estimator
+                    decision = _costmodel.COST_MODEL.decide(
+                        "generate", "genserve", "ragged",
+                        units=None,
+                        slack_s=deadline_ms / 1000.0,
+                        dispatches_ahead=own_steps - 1 + backlog)
+                    if not decision.admit:
+                        self.stats.sheds_predicted += 1
+                        _stats.SHEDS.labels("predicted_deadline").inc()
+                        _stats.REQUESTS.labels("shed").inc()
+                        admit_span.set_attr("outcome", "shed")
+                        raise ResourceExhausted(
+                            "predicted time-to-first-token "
+                            f"{decision.predicted_s * 1e3:.0f}ms exceeds "
+                            f"the {deadline_ms:.0f}ms deadline budget; "
+                            "retry with backoff",
+                            reason="predicted_deadline")
+                    per_step, _conf = _costmodel.predict(
+                        "genserve", "ragged")
+                    _budget.open_budget(
+                        _tracer.current_trace_id(), "generate",
+                        deadline_ms / 1000.0,
+                        {"prefill": per_step * (own_steps - 1),
+                         "decode": per_step})
                 self.stats.requests += 1
                 self._queue.append(seq)
                 admit_span.set_attr("queue_depth", len(self._queue))
@@ -689,6 +730,9 @@ class GenerationEngine:
         seq.counted = True
         if outcome == "ok":
             self.stats.completed += 1
+            if seq.submitted_perf:
+                _costmodel.record_latency(
+                    "generate", time.perf_counter() - seq.submitted_perf)
         elif outcome == "error":
             self.stats.errors += 1
         _stats.REQUESTS.labels(outcome).inc()

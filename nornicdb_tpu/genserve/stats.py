@@ -40,13 +40,15 @@ DECODE_HIST = _REGISTRY.histogram(
 )
 # admission-control + lifecycle sheds by reason: queue_full at submit,
 # deadline pre-dispatch/at the caller, pool_exhausted when a lone request
-# cannot fit, device when fallback="fail" and the backend is degraded
+# cannot fit, device when fallback="fail" and the backend is degraded,
+# predicted_deadline when the cost model shed the request at submit
 SHEDS = _REGISTRY.counter(
     "nornicdb_genserve_sheds_total",
     "Generation requests shed by admission control or deadline",
     labels=("reason",),
 )
-for _reason in ("queue_full", "deadline", "pool_exhausted", "device"):
+for _reason in ("queue_full", "deadline", "pool_exhausted", "device",
+                "predicted_deadline"):
     SHEDS.labels(_reason)  # eager cells: render at 0
 # rate() of this counter is the aggregate tokens/s the engine sustains
 TOKENS = _REGISTRY.counter(

@@ -23,6 +23,8 @@ from typing import Any, Callable, Optional
 import numpy as np
 
 from nornicdb_tpu.errors import ResourceExhausted
+from nornicdb_tpu.telemetry import budget as _budget
+from nornicdb_tpu.telemetry import costmodel as _costmodel
 from nornicdb_tpu.telemetry.metrics import REGISTRY as _REGISTRY
 from nornicdb_tpu.telemetry.tracing import tracer as _tracer
 
@@ -74,6 +76,7 @@ class BatcherStats:
     max_batch: int = 0
     sheds_queue_full: int = 0
     sheds_deadline: int = 0
+    sheds_predicted: int = 0
 
     @property
     def avg_batch(self) -> float:
@@ -89,6 +92,7 @@ class BatcherStats:
             "avg_batch": self.avg_batch,
             "sheds_queue_full": self.sheds_queue_full,
             "sheds_deadline": self.sheds_deadline,
+            "sheds_predicted": self.sheds_predicted,
         }
 
 
@@ -116,10 +120,15 @@ class QueryBatcher:
         max_batch: int = 256,
         max_queue: int = 0,
         deadline: float = 0.0,
+        cost_kind: str = "dense",
     ):
         self.search_batch_fn = search_batch_fn
         self.window = window
         self.max_batch = max_batch
+        # deviceprof kind the predictive-admission check prices a batch
+        # dispatch against ("dense" covers the single-device corpus; a
+        # sharded deployment can pass its own kind)
+        self.cost_kind = cost_kind
         # admission control (ROADMAP item 3): pending queries beyond
         # max_queue shed at submit instead of growing an unbounded list
         # (0 = unbounded, the pre-serving behavior); queries older than
@@ -155,6 +164,29 @@ class QueryBatcher:
                     f"search batch queue full ({len(self._pending)} "
                     "pending); retry with backoff", reason="queue_full",
                 )
+            if p.deadline:
+                # predictive admission: queries ahead mostly coalesce into
+                # the same dispatch, so the wait is the batches that must
+                # run before ours plus our own fused dispatch
+                batches_ahead = len(self._pending) // max(1, self.max_batch)
+                decision = _costmodel.COST_MODEL.decide(
+                    "search", "search", self.cost_kind, units=None,
+                    slack_s=self.deadline,
+                    dispatches_ahead=float(batches_ahead),
+                )
+                if not decision.admit:
+                    self.stats.sheds_predicted += 1
+                    _SHEDS.labels("search", "predicted_deadline").inc()
+                    raise ResourceExhausted(
+                        "predicted search completion "
+                        f"{decision.predicted_s * 1e3:.0f}ms exceeds the "
+                        f"{self.deadline * 1e3:.0f}ms deadline budget; "
+                        "retry with backoff", reason="predicted_deadline",
+                    )
+                _budget.open_budget(
+                    _tracer.current_trace_id(), "search", self.deadline,
+                    {"device_sync": decision.predicted_s},
+                )
             self._pending.append(p)
             if self._dispatcher is None:
                 self._dispatcher = threading.Thread(
@@ -185,6 +217,8 @@ class QueryBatcher:
             p.event.wait()
         if p.error is not None:
             raise p.error
+        _costmodel.record_latency(
+            "search", time.perf_counter() - p.enqueued)
         return p.result
 
     def search(

@@ -64,6 +64,11 @@ from nornicdb_tpu.genserve import stats as _genserve_stats  # noqa: F401
 # collector; deviceprof registers the device program ledger + HBM
 # residency families and the /admin/profile capture — imported here so
 # the tested observability catalog renders them in every server process
+from nornicdb_tpu.telemetry import budget as _budget
+# the cost-model module registers the nornicdb_cost_model_* / SLO-burn /
+# build-info families and answers GET /admin/capacity — imported here so
+# the tested observability catalog renders them in every server process
+from nornicdb_tpu.telemetry import costmodel as _costmodel
 from nornicdb_tpu.telemetry import deviceprof as _deviceprof
 from nornicdb_tpu.telemetry import federation as _federation
 from nornicdb_tpu.telemetry.metrics import (
@@ -731,7 +736,21 @@ class HttpServer:
             if tree is None:
                 h._send(404, {"error": f"trace {trace_id} not found"})
             else:
+                # deadline-budget attribution: predicted vs actual per
+                # named stage, when admission opened a budget for this
+                # trace (satellite: budget breakdown on trace detail)
+                budget = _budget.breakdown_for(trace_id,
+                                               tree.get("spans", []))
+                if budget is not None:
+                    tree["budget"] = budget
                 h._send(200, tree)
+            return
+        if path == "/admin/capacity":
+            # cost-model table + headroom (max sustainable qps per
+            # workload class) + SLO window state — the closed-loop
+            # capacity surface the predictive admission decides from
+            h._auth("admin")
+            h._send(200, _costmodel.capacity_snapshot())
             return
         if path == "/admin/slow-queries":
             # over-threshold statements with redacted text, plan summary,

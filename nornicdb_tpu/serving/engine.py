@@ -50,6 +50,9 @@ from nornicdb_tpu.embed.base import Embedder
 from nornicdb_tpu.errors import ClosedError, ResourceExhausted
 from nornicdb_tpu.serving import stats as _stats
 from nornicdb_tpu.serving.ragged import RaggedPacker, unpack_results
+from nornicdb_tpu.telemetry import budget as _budget
+from nornicdb_tpu.telemetry import costmodel as _costmodel
+from nornicdb_tpu.telemetry import deviceprof as _deviceprof
 from nornicdb_tpu.telemetry.tracing import tracer as _tracer
 
 logger = logging.getLogger(__name__)
@@ -90,6 +93,7 @@ class EngineStats:
     padded_tokens: int = 0
     sheds_queue_full: int = 0
     sheds_deadline: int = 0
+    sheds_predicted: int = 0
     staging_seconds: float = 0.0
     overlap_seconds: float = 0.0
     device_seconds: float = 0.0
@@ -110,6 +114,7 @@ class EngineStats:
             "pack_efficiency": round(eff, 4),
             "sheds_queue_full": self.sheds_queue_full,
             "sheds_deadline": self.sheds_deadline,
+            "sheds_predicted": self.sheds_predicted,
             "staging_overlap_ratio": round(overlap, 4),
             "device_seconds": round(self.device_seconds, 4),
         }
@@ -240,6 +245,31 @@ class ServingEngine(Embedder):
                     f"{self._queued_tokens} tokens queued); retry with "
                     "backoff", reason="queue_full",
                 )
+            if req.deadline:
+                # predictive admission: the learned per-token cost of
+                # the queued backlog plus this request, conservatively
+                # scaled, must fit the deadline — shed at SUBMIT instead
+                # of after the queue burns device time (fails open while
+                # the cost model is cold)
+                decision = _costmodel.COST_MODEL.decide(
+                    "embed", "serving", "embed", units=sum(est),
+                    slack_s=cfg.deadline_ms / 1000.0,
+                    units_ahead=self._queued_tokens,
+                )
+                if not decision.admit:
+                    self.stats.sheds_predicted += 1
+                    _stats.SHEDS.labels("embed", "predicted_deadline").inc()
+                    raise ResourceExhausted(
+                        f"predicted completion "
+                        f"{decision.predicted_s * 1e3:.0f}ms exceeds the "
+                        f"{cfg.deadline_ms:.0f}ms deadline budget; retry "
+                        "with backoff", reason="predicted_deadline",
+                    )
+                _budget.open_budget(
+                    _tracer.current_trace_id(), "embed",
+                    cfg.deadline_ms / 1000.0,
+                    {"device_sync": decision.predicted_s},
+                )
             for i, t in enumerate(texts):
                 self._queue.append(_Item(t, req, i, est[i]))
             self._queued_texts += len(texts)
@@ -248,6 +278,8 @@ class ServingEngine(Embedder):
             _stats.QUEUE_TOKENS.set(self._queued_tokens)
             self._cond.notify_all()
         self._await(req)
+        _costmodel.record_latency(
+            "embed", time.perf_counter() - req.enqueued)
         if req.error is not None:
             raise req.error
         return list(req.results)
@@ -502,7 +534,15 @@ class ServingEngine(Embedder):
                     self._fail(item.req, e)
                 continue
             self._device_busy = False
-            self.stats.device_seconds += time.perf_counter() - t0
+            dt = time.perf_counter() - t0
+            # the embed path joins the deviceprof ledger (and with it
+            # the cost model) keyed by packed-token pow2 class
+            tokens = (pack.tokens if pack is not None
+                      else sum(i.est_tokens for i in items))
+            _deviceprof.record_execute(
+                "serving", "embed",
+                _deviceprof.pow2_class(max(tokens, 1), "t"), dt)
+            self.stats.device_seconds += dt
             self.stats.batches += 1
             self.stats.texts += len(items)
             _stats.BATCHES.inc()

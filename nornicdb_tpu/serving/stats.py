@@ -27,14 +27,15 @@ PACK_EFFICIENCY_HIST = _REGISTRY.histogram(
     buckets=(0.25, 0.5, 0.625, 0.75, 0.875, 0.9375, 1.0),
 )
 # admission-control sheds by path (embed engine vs search batcher) and
-# reason (queue_full at submit, deadline at/after dispatch)
+# reason (queue_full at submit, deadline at/after dispatch,
+# predicted_deadline = the cost model shed it at submit)
 SHEDS = _REGISTRY.counter(
     "nornicdb_serving_sheds_total",
     "Requests shed by serving admission control",
     labels=("path", "reason"),
 )
 for _path in ("embed", "search"):
-    for _reason in ("queue_full", "deadline"):
+    for _reason in ("queue_full", "deadline", "predicted_deadline"):
         SHEDS.labels(_path, _reason)  # eager cells: render at 0
 # host-staging overlap: fraction of tokenize+pack wall time that ran
 # while the device was busy with the previous batch (WindVE-style

@@ -600,6 +600,81 @@ class SoakHarness:
                 f"http://127.0.0.1:{port}{path}", timeout=30) as resp:
             return resp.read()
 
+    # -- overload burst: predictive admission past measured capacity --------
+    def _overload_burst(self, db) -> dict[str, Any]:
+        """Drive the generation engine at ~2x the cost model's measured
+        capacity (runs after traffic shutdown, before the final scrape,
+        so the sheds land in the scraped exposition).  Returns the
+        evidence dict ``check_predictive_admission`` judges."""
+        from nornicdb_tpu.errors import ResourceExhausted
+        from nornicdb_tpu.telemetry import costmodel as _costmodel
+
+        engine = db.genserve_engine()
+        cfg = engine.config
+        chunk = max(1, int(cfg.prefill_chunk))
+        prompt = list(range(2, 2 + min(48, int(cfg.max_seq_tokens) // 2)))
+        steps = (len(prompt) + chunk - 1) // chunk + 1
+        per_step, conf = _costmodel.predict("genserve", "ragged")
+        per_req_s = max(per_step, 1e-4) * steps
+        # a deadline the measured capacity can only HALF satisfy, sized
+        # INSIDE the queue bound — a deadline wide enough for the whole
+        # queue would fill max_queue first and every shed would read
+        # queue_full, never exercising the predictive path this phase
+        # exists to prove
+        capacity = max(2, min(16, int(cfg.max_queue) // 2))
+        deadline_ms = per_req_s * capacity * 1e3
+        n_burst = min(2 * capacity, int(cfg.max_queue), 400)
+        before = engine.stats.as_dict()
+        probes_before = _costmodel.ADMISSIONS.labels(
+            "generate", "probe").get()
+        handles = []
+        shed_predicted = shed_other = 0
+        for _ in range(n_burst):
+            try:
+                handles.append(engine.submit(
+                    prompt, max_new_tokens=2, deadline_ms=deadline_ms))
+            except ResourceExhausted as e:
+                if getattr(e, "reason", "") == "predicted_deadline":
+                    shed_predicted += 1
+                else:
+                    shed_other += 1
+        completed = misses = 0
+        # result() is deadline-bounded internally (deadline + grace);
+        # the handles share one submit instant, so the sequential drain
+        # is bounded by ONE deadline window, not one per handle
+        for h in handles:
+            try:
+                h.result()
+                completed += 1
+            except ResourceExhausted as e:
+                if getattr(e, "reason", "") == "deadline":
+                    misses += 1
+                else:
+                    shed_other += 1
+            except Exception:
+                log.warning("overload-burst drain failed", exc_info=True)
+                shed_other += 1
+        after = engine.stats.as_dict()
+        return {
+            "burst_requests": n_burst,
+            "model_confidence": round(conf, 4),
+            "predicted_seconds_per_request": round(per_req_s, 6),
+            "deadline_ms": deadline_ms,
+            "measured_capacity_per_deadline": capacity,
+            "admitted": len(handles),
+            "completed_ok": completed,
+            "shed_predicted": shed_predicted,
+            "shed_other": shed_other,
+            "post_dispatch_deadline_misses": misses,
+            "probe_admissions": int(_costmodel.ADMISSIONS.labels(
+                "generate", "probe").get() - probes_before),
+            "engine_stats_delta": {
+                k: after[k] - before[k]
+                for k in after
+                if isinstance(after.get(k), int) and after[k] != before[k]
+            },
+        }
+
     # -- serving WAL crash-recovery check -----------------------------------
     def _check_serving_wal_recovery(self, serving_dir: str,
                                     acked: set[str]):
@@ -635,6 +710,13 @@ class SoakHarness:
         report = SoakReport(scenario=spec.to_dict())
         report.notes = self.notes
         collector = Collector(t_start)
+
+        # a scenario's capacity story must be self-contained: start the
+        # process-global cost model cold so predictions reflect THIS
+        # run's traffic, not whatever the process did before (prior
+        # scenarios, or a test suite's pathological fault embedders)
+        from nornicdb_tpu.telemetry.costmodel import COST_MODEL
+        COST_MODEL.reset()
 
         backend_plane = BackendPlane()
         db, http, bolt, grpc_srv, pool, serving_dir = self._boot_stack()
@@ -759,6 +841,16 @@ class SoakHarness:
                     "faults_injected",
                     f"all {len(scheduler.executed)} windows started and "
                     "cleared"))
+
+            # overload-burst phase: AFTER traffic shutdown (a quiesced
+            # engine gives the burst a clean queue) and BEFORE the final
+            # scrape (the predicted_deadline sheds must land in the
+            # scraped exposition the genserve_live check reads)
+            if (spec.overload_burst_s > 0
+                    and spec.workload.generate_workers > 0):
+                report.overload = self._overload_burst(db)
+                report.invariants.append(
+                    inv.check_predictive_admission(report.overload))
 
             # telemetry-backed checks against the live exposition.
             # Chaos instance stats snapshot BEFORE the scrape: the raft

@@ -205,7 +205,8 @@ def check_genserve_live(metrics_text: str) -> InvariantResult:
                       f"terminal generation queue depth {depth} != 0 "
                       "(stranded requests)")
     legal = {'reason="queue_full"', 'reason="deadline"',
-             'reason="pool_exhausted"', 'reason="device"'}
+             'reason="pool_exhausted"', 'reason="device"',
+             'reason="predicted_deadline"'}
     sheds = fams.get("nornicdb_genserve_sheds_total", {})
     rogue = {labels for labels, v in sheds.items()
              if v > 0 and not (set(labels) <= legal)}
@@ -216,6 +217,52 @@ def check_genserve_live(metrics_text: str) -> InvariantResult:
     return passed("genserve_live",
                   f"{int(tokens)} tokens generated, {int(shed_total)} "
                   "legal sheds, queue drained")
+
+
+def check_predictive_admission(burst: dict[str, Any],
+                               max_miss_rate: float = 0.01
+                               ) -> InvariantResult:
+    """Overload-burst contract (PR 20 closed-loop capacity): a burst
+    sized ~2x the cost model's measured capacity must shed at SUBMIT
+    (``reason="predicted_deadline"``), admit a non-empty prefix that
+    actually fits the deadline budget, and the admitted requests'
+    post-dispatch deadline-miss rate must stay under ``max_miss_rate``
+    — early rejection instead of queue-burned deadlines."""
+    n = burst.get("burst_requests", 0)
+    shed = burst.get("shed_predicted", 0)
+    admitted = burst.get("admitted", 0)
+    misses = burst.get("post_dispatch_deadline_misses", 0)
+    probes = burst.get("probe_admissions", 0)
+    conf = burst.get("model_confidence", 0.0)
+    if not n:
+        return failed("predictive_admission",
+                      "overload burst submitted no requests")
+    if shed <= 0:
+        return failed(
+            "predictive_admission",
+            f"no predicted_deadline sheds across a {n}-request burst at "
+            f"~2x measured capacity (model confidence {conf})")
+    if admitted <= 0:
+        return failed(
+            "predictive_admission",
+            f"burst admitted nothing ({shed} predicted sheds of {n}) — "
+            "the cost model over-shed the entire burst")
+    # half-open probe admissions are deliberate exploration — each one
+    # is a request the model WOULD have shed, so its deadline miss is
+    # expected and excluded from the accuracy budget
+    budgeted = max(0, misses - probes)
+    rate = budgeted / admitted
+    if rate > max_miss_rate:
+        return failed(
+            "predictive_admission",
+            f"post-dispatch deadline misses {misses}/{admitted} "
+            f"({probes} probe-budgeted, net {rate:.1%}) > "
+            f"{max_miss_rate:.0%} despite {shed} predictive sheds")
+    return passed(
+        "predictive_admission",
+        f"{shed}/{n} shed at submit, {admitted} admitted "
+        f"({probes} probes), {misses} post-dispatch misses "
+        f"(net {rate:.2%}), confidence {conf}")
 
 
 def check_plan_cache_effective(

@@ -126,6 +126,7 @@ class SoakReport:
     backend: dict[str, Any] = field(default_factory=dict)
     replication: dict[str, Any] = field(default_factory=dict)
     workers: dict[str, Any] = field(default_factory=dict)
+    overload: dict[str, Any] = field(default_factory=dict)
     notes: list[str] = field(default_factory=list)
     wall_s: float = 0.0
 
@@ -146,6 +147,7 @@ class SoakReport:
             "backend": self.backend,
             "replication": self.replication,
             "workers": self.workers,
+            "overload": self.overload,
             "notes": self.notes,
         }
 

@@ -49,6 +49,11 @@ def configure(
     slow_buffer=None,
     fleet_staleness_s=None,
     profile_max_seconds=None,
+    cost_conservatism=None,
+    cost_min_confidence=None,
+    predictive_admission=None,
+    slo_targets=None,
+    slo_objective=None,
 ) -> None:
     """Apply config-file / CLI settings to the process-global telemetry
     singletons (config.TelemetryConfig maps 1:1 onto these arguments)."""
@@ -68,6 +73,18 @@ def configure(
     if profile_max_seconds is not None:
         global profile_max_s
         profile_max_s = float(profile_max_seconds)
+    if any(v is not None for v in (cost_conservatism, cost_min_confidence,
+                                   predictive_admission, slo_targets,
+                                   slo_objective)):
+        from nornicdb_tpu.telemetry.costmodel import COST_MODEL
+
+        COST_MODEL.configure(
+            conservatism=cost_conservatism,
+            min_confidence=cost_min_confidence,
+            predictive_admission=predictive_admission,
+            slo_targets=slo_targets,
+            slo_objective=slo_objective,
+        )
 
 
 #: upper bound for POST /admin/profile?seconds=N captures (configurable

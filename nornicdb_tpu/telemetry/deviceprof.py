@@ -114,6 +114,13 @@ class DeviceProfiler:
         # nornjit's compile sentinel attributes fresh XLA compiles to
         # the last key announced on the compiling thread
         self._observers: list[Callable[[str, str, str], None]] = []
+        # time observers additionally receive the execute duration —
+        # the per-program cost model (telemetry/costmodel.py) learns its
+        # EWMAs from these without touching the key-only observer
+        # contract nornjit's compile sentinel depends on
+        self._time_observers: list[
+            Callable[[str, str, str, float], None]
+        ] = []
 
     def add_observer(self, fn: Callable[[str, str, str], None]) -> None:
         """Register ``fn(subsystem, kind, shape)`` called synchronously
@@ -130,12 +137,39 @@ class DeviceProfiler:
             except ValueError:
                 pass
 
+    def add_time_observer(
+        self, fn: Callable[[str, str, str, float], None],
+    ) -> None:
+        """Register ``fn(subsystem, kind, shape, seconds)`` called on
+        every :meth:`record_execute`.  Same contract as observers:
+        cheap, never raises (failures swallowed at notify time)."""
+        with self._lock:
+            if fn not in self._time_observers:
+                self._time_observers.append(fn)
+
+    def remove_time_observer(
+        self, fn: Callable[[str, str, str, float], None],
+    ) -> None:
+        with self._lock:
+            try:
+                self._time_observers.remove(fn)
+            except ValueError:
+                pass
+
     def _notify(self, key: tuple[str, str, str]) -> None:
         for fn in list(self._observers):
             try:
                 fn(*key)
             except Exception:
                 log.debug("deviceprof observer failed", exc_info=True)
+
+    def _notify_time(self, key: tuple[str, str, str],
+                     seconds: float) -> None:
+        for fn in list(self._time_observers):
+            try:
+                fn(key[0], key[1], key[2], seconds)
+            except Exception:
+                log.debug("deviceprof time observer failed", exc_info=True)
 
     # -- program ledger ----------------------------------------------------
     def record_compile(self, subsystem: str, kind: str, shape) -> None:
@@ -167,6 +201,7 @@ class DeviceProfiler:
             entry.total_s += seconds
         _EXEC_HIST.labels(*key).observe(seconds)
         self._notify(key)
+        self._notify_time(key, seconds)
 
     # -- HBM residency -----------------------------------------------------
     def register_hbm(self, owner, fn: Callable[[object], dict]) -> None:
@@ -288,6 +323,7 @@ class DeviceProfiler:
 PROFILER = DeviceProfiler()
 _REGISTRY.collect_hook("deviceprof_hbm", PROFILER.refresh_hbm)
 
+add_time_observer = PROFILER.add_time_observer
 record_compile = PROFILER.record_compile
 record_execute = PROFILER.record_execute
 register_hbm = PROFILER.register_hbm

@@ -25,6 +25,8 @@ import time
 from collections import deque
 from typing import Any, Optional
 
+from nornicdb_tpu.telemetry import budget as _budget
+
 _STRING_LIT_RE = re.compile(
     r"""'(?:[^'\\]|\\.)*'|"(?:[^"\\]|\\.)*\"""", re.S
 )
@@ -176,6 +178,9 @@ class SlowQueryLog:
             "database": database,
             "trace_id": trace_id,
             "span_breakdown": breakdown or None,
+            # deadline-budget stage attribution (predicted at admission
+            # vs actual from the spans) for offloaded device programs
+            "budget": _budget.breakdown_for(trace_id, trace_spans),
             "counter_deltas": deltas,
             "plan": (plan[:_MAX_PLAN_CHARS] if plan else None),
             # columnar engine report: plan-cache key hash, outcome, and

@@ -598,3 +598,89 @@ def nl_tm01(ctx: ModuleContext) -> Iterator[Finding]:
                         "wall clock jump — use time.perf_counter() (or "
                         "time.monotonic()) for elapsed-time measurement",
                     )
+
+
+# ---------------------------------------------------------------------------
+# NL-OBS02 — latency observation fed from a wall-clock delta
+# ---------------------------------------------------------------------------
+# NL-TM01 catches `time.time() - t0` when the stamp lives in the same
+# scope.  The latency-histogram pattern usually doesn't: the stamp is
+# stored on an object at enqueue (`self.enqueued = time.time()`) and the
+# `.observe()` happens in another method, another file even.  This rule
+# tracks wall-clock-stamped ATTRIBUTE names module-wide and flags any
+# metric observation whose value subtracts one — the recorded latency
+# would jump with NTP steps, poisoning histograms and the cost model
+# that learns from them.
+
+_OBSERVE_METHODS = ("observe",)
+
+
+def _tm_stamped_attrs(tree: ast.Module) -> set[str]:
+    """Attribute names assigned from time.time() anywhere in the module."""
+    stamped: set[str] = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Assign) and _is_time_time(node.value):
+            stamped |= {
+                t.attr for t in node.targets if isinstance(t, ast.Attribute)
+            }
+    return stamped
+
+
+def _is_wall_delta(node: ast.AST, stamped_names: set[str],
+                   stamped_attrs: set[str]) -> bool:
+    if not (isinstance(node, ast.BinOp) and isinstance(node.op, ast.Sub)):
+        return False
+    for o in (node.left, node.right):
+        if _is_time_time(o):
+            return True
+        if isinstance(o, ast.Name) and o.id in stamped_names:
+            return True
+        if isinstance(o, ast.Attribute) and o.attr in stamped_attrs:
+            return True
+    return False
+
+
+@register(
+    "NL-OBS02",
+    "warning",
+    "latency observation computed from a time.time() delta — stamp with "
+    "time.perf_counter() / time.monotonic()",
+)
+def nl_obs02(ctx: ModuleContext) -> Iterator[Finding]:
+    stamped_attrs = _tm_stamped_attrs(ctx.tree)
+    scopes: list[ast.AST] = [ctx.tree] + [
+        n for n in ast.walk(ctx.tree)
+        if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))
+    ]
+    for scope in scopes:
+        stamped: set[str] = set()
+        deltas: set[str] = set()
+        for node in _walk_scope(scope.body):
+            if not isinstance(node, ast.Assign):
+                continue
+            if _is_time_time(node.value):
+                stamped |= {
+                    t.id for t in node.targets if isinstance(t, ast.Name)
+                }
+            elif _is_wall_delta(node.value, stamped, stamped_attrs):
+                deltas |= {
+                    t.id for t in node.targets if isinstance(t, ast.Name)
+                }
+        for node in _walk_scope(scope.body):
+            if not (
+                isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Attribute)
+                and node.func.attr in _OBSERVE_METHODS
+                and node.args
+            ):
+                continue
+            arg = node.args[0]
+            if _is_wall_delta(arg, stamped, stamped_attrs) or (
+                isinstance(arg, ast.Name) and arg.id in deltas
+            ):
+                yield ctx.finding(
+                    nl_obs02, node,
+                    "histogram latency fed from a time.time() delta; NTP "
+                    "steps corrupt the observation — stamp the start with "
+                    "time.perf_counter() (or time.monotonic()) instead",
+                )

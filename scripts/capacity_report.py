@@ -1,0 +1,104 @@
+#!/usr/bin/env python
+"""CI capacity report: boot a live server, drive mixed traffic, print the
+``GET /admin/capacity`` cost table.
+
+Non-gating on content — the per-program costs on a shared CI runner are
+noise — but the surface itself is the contract: exit 1 only when
+/admin/capacity is non-200 or the cost table comes back empty after
+traffic that must have fed the deviceprof ledger.
+
+Run: JAX_PLATFORMS=cpu python scripts/capacity_report.py
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import urllib.request
+
+# runnable from a checkout without an editable install
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+TRAFFIC_ROUNDS = 24
+
+
+def _post(base: str, path: str, body: dict, timeout: float = 30) -> int:
+    req = urllib.request.Request(
+        base + path, data=json.dumps(body).encode(),
+        headers={"Content-Type": "application/json"})
+    try:
+        with urllib.request.urlopen(req, timeout=timeout) as resp:
+            return resp.status
+    except urllib.error.HTTPError as e:
+        return e.code
+
+
+def main() -> int:
+    import nornicdb_tpu
+    from nornicdb_tpu.embed.base import HashEmbedder
+    from nornicdb_tpu.server.http import HttpServer
+
+    db = nornicdb_tpu.open_db("")
+    db.set_embedder(HashEmbedder(64))
+    server = HttpServer(db, port=0)
+    server.start()
+    base = f"http://127.0.0.1:{server.port}"
+    try:
+        # mixed traffic: writes (feed the corpus), embeds, searches and a
+        # cypher shape — enough dispatches that the cost model has
+        # observations for the serving and search program kinds
+        for i in range(TRAFFIC_ROUNDS):
+            _post(base, "/db/neo4j/tx/commit", {"statements": [{
+                "statement": "CREATE (:Cap {i: $i, text: $t})",
+                "parameters": {"i": i, "t": f"capacity doc {i} " * 4},
+            }]})
+        db.process_pending_embeddings()
+        for i in range(TRAFFIC_ROUNDS):
+            _post(base, "/nornicdb/embed",
+                  {"text": f"capacity probe text {i}"})
+            _post(base, "/nornicdb/search",
+                  {"query": f"capacity doc {i % 8}", "limit": 3})
+
+        with urllib.request.urlopen(base + "/admin/capacity",
+                                    timeout=30) as resp:
+            if resp.status != 200:
+                print(f"CAPACITY FAIL: /admin/capacity -> {resp.status}",
+                      file=sys.stderr)
+                return 1
+            cap = json.loads(resp.read())
+    finally:
+        server.stop()
+        db.close()
+
+    programs = cap.get("programs") or []
+    headroom = cap.get("headroom") or {}
+    if not programs or not headroom:
+        print("CAPACITY FAIL: empty cost table after mixed traffic "
+              f"(programs={len(programs)}, headroom={len(headroom)})",
+              file=sys.stderr)
+        print(json.dumps(cap, indent=2), file=sys.stderr)
+        return 1
+
+    print("== /admin/capacity cost table ==")
+    print(f"{'program':<38}{'ewma_ms':>10}{'n':>6}{'conf':>7}"
+          f"{'med_rel_err':>13}")
+    for p in programs:
+        med = p.get("median_rel_error")
+        print(f"{p['subsystem'] + '.' + p['kind'] + '/' + p['shape']:<38}"
+              f"{p['ewma_seconds'] * 1e3:>10.3f}{p['observations']:>6}"
+              f"{p['confidence']:>7.2f}"
+              f"{('%.3f' % med) if med is not None else '-':>13}")
+    print("\n== headroom (max sustainable qps, device-serialized) ==")
+    for name, h in headroom.items():
+        qps = h.get("max_sustainable_qps")
+        print(f"{name:<24}{(('%.1f' % qps) if qps else '-'):>10} qps  "
+              f"(conf {h['confidence']:.2f}, n={h['observations']})")
+    slo = cap.get("slo", {})
+    print(f"\nSLO objective {slo.get('objective')}, targets "
+          f"{slo.get('targets_s')}, admission {cap.get('admission')}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

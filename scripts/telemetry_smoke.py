@@ -76,6 +76,31 @@ def main() -> int:
             failures.append("/metrics returned an empty exposition")
         elif b"# TYPE" not in body or b"nornicdb_" not in body:
             failures.append("/metrics exposition has no nornicdb families")
+        else:
+            # build-identity info-gauge: exactly one cell at 1 with the
+            # version/backend/mesh_devices labels populated
+            if b"# TYPE nornicdb_build_info gauge" not in body:
+                failures.append("nornicdb_build_info family not exposed")
+            elif not any(
+                line.startswith(b"nornicdb_build_info{")
+                and line.rstrip().endswith(b" 1")
+                and b'version="' in line and b'backend="' in line
+                and b'mesh_devices="' in line
+                for line in body.splitlines()
+            ):
+                failures.append(
+                    "nornicdb_build_info has no populated cell at 1")
+
+        code, body = fetch(base + "/admin/capacity")
+        if code != 200:
+            failures.append(f"/admin/capacity -> {code}")
+        else:
+            cap = json.loads(body)
+            for key in ("programs", "headroom", "slo", "admission"):
+                if key not in cap:
+                    failures.append(f"/admin/capacity missing {key!r}")
+            if not cap.get("slo", {}).get("targets_s"):
+                failures.append("/admin/capacity has no SLO targets")
 
         code, body = fetch(base + "/admin/traces")
         if code != 200:
@@ -95,7 +120,8 @@ def main() -> int:
         for f in failures:
             print(f"SMOKE FAIL: {f}", file=sys.stderr)
         return 1
-    print("telemetry smoke ok: /metrics + /admin/traces + /admin/slow-queries")
+    print("telemetry smoke ok: /metrics (+build_info) + /admin/traces "
+          "+ /admin/slow-queries + /admin/capacity")
     if os.cpu_count() and os.cpu_count() > 1:
         return fleet_smoke()
     print("fleet smoke skipped: single-core runner")

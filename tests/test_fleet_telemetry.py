@@ -152,6 +152,100 @@ class TestFederationMerge:
         # the broken worker family never spliced in
         assert "# TYPE a counter" not in merged
 
+    def test_conflicting_help_primary_wins(self):
+        # the primary and a worker can disagree on HELP text (e.g. a
+        # rolling deploy with an old worker binary); the merge must
+        # render the primary's HELP once, never the worker's variant,
+        # and for worker-only families the first worker's HELP wins
+        primary = ("# HELP shared_total primary wording\n"
+                   "# TYPE shared_total counter\n"
+                   "shared_total 1\n")
+
+        class W:
+            def __init__(self, proc, text):
+                self.proc = proc
+                self.text = text
+
+        w0 = W("http-worker-0",
+               "# HELP shared_total old worker wording\n"
+               "# TYPE shared_total counter\n"
+               "shared_total 2\n"
+               "# HELP w_only_total first wording\n"
+               "# TYPE w_only_total counter\n"
+               "w_only_total 7\n")
+        w1 = W("http-worker-1",
+               "# HELP w_only_total second wording\n"
+               "# TYPE w_only_total counter\n"
+               "w_only_total 9\n")
+        merged = merge_expositions(primary, [w0, w1])
+        parse_prometheus_strict(merged)
+        assert merged.count("# HELP shared_total") == 1
+        assert "# HELP shared_total primary wording" in merged
+        assert "old worker wording" not in merged
+        # both workers' cells spliced under one family declaration
+        assert 'shared_total{proc="http-worker-0"} 2' in merged
+        assert merged.count("# TYPE w_only_total") == 1
+        assert "# HELP w_only_total first wording" in merged
+        assert "second wording" not in merged
+        assert 'w_only_total{proc="http-worker-1"} 9' in merged
+
+    def test_conflicting_kind_skipped_and_counted(self):
+        # same family name, different TYPE kind: the worker's cells must
+        # NOT splice in (they'd corrupt the family) and the skip must be
+        # visible in the merge-error counter
+        from nornicdb_tpu.telemetry.federation import FLEET_MERGE_ERRORS
+
+        primary = ("# TYPE shared_total counter\n"
+                   "shared_total 1\n")
+
+        class W:
+            proc = "http-worker-0"
+            text = ("# TYPE shared_total gauge\n"
+                    "shared_total 5\n")
+
+        errs0 = FLEET_MERGE_ERRORS.labels().get()
+        merged = merge_expositions(primary, [W()])
+        parse_prometheus_strict(merged)
+        assert 'proc="http-worker-0"' not in merged
+        assert "shared_total 1" in merged
+        assert FLEET_MERGE_ERRORS.labels().get() == errs0 + 1
+
+    def test_stale_ageout_rejoins_on_fresh_publish(self, tmp_path):
+        # ageout race: a worker whose publisher stalls ages out of the
+        # merge (counted once per dropped scrape), then REJOINS as soon
+        # as a fresh publish lands — staleness is a per-scrape decision,
+        # not a permanent eviction
+        from nornicdb_tpu.telemetry.federation import FLEET_MEMBERS
+
+        pub = MetricsPublisher(str(tmp_path / "w.seg"), "http-worker-0",
+                               registry=self._worker_registry())
+        pub.publish_now()
+        col = FleetCollector(staleness_s=0.05)
+        col.register("http-worker-0", str(tmp_path / "w.seg"))
+        marker = 'w_only_total{proc="http-worker-0"}'
+        try:
+            primary = REGISTRY.render_prometheus()
+            assert marker in col.merged_exposition(primary)
+            assert FLEET_MEMBERS.labels("http-worker-0").get() == 1.0
+            time.sleep(0.1)  # let the published stamp age past 0.05s
+            drops0 = col.stale_drops
+            assert marker not in col.merged_exposition(primary)
+            assert col.stale_drops == drops0 + 1
+            assert FLEET_MEMBERS.labels("http-worker-0").get() == 0.0
+            # the structured read paths poll while stale WITHOUT bumping
+            # the drop counter: it means "dropped from a /metrics merge"
+            assert not col.stats()["members"]["http-worker-0"]["fresh"]
+            assert col.slow_queries() == []
+            assert col.stale_drops == drops0 + 1
+            # fresh publish -> the very next scrape carries the worker
+            pub.publish_now()
+            assert marker in col.merged_exposition(primary)
+            assert col.stale_drops == drops0 + 1
+            assert FLEET_MEMBERS.labels("http-worker-0").get() == 1.0
+        finally:
+            col.unregister("http-worker-0")
+            pub.stop()
+
     def test_slow_queries_tagged_with_proc(self, tmp_path):
         from nornicdb_tpu.telemetry.slowlog import slow_log
 

@@ -241,6 +241,11 @@ class TestEngineScheduling:
     def test_deadline_shed_never_wedges(self):
         """A queued request whose deadline passes before admission is
         shed within deadline+grace; the running request completes."""
+        from nornicdb_tpu.telemetry.costmodel import COST_MODEL
+
+        # cold model -> submit fails open, so the queued request reaches
+        # the post-admission deadline path this test asserts on
+        COST_MODEL.reset()
         eng = _engine(max_seqs=1)
         h1 = eng.submit(_prompt(8), max_new_tokens=200)
         h2 = eng.submit(_prompt(4, seed=9), max_new_tokens=4,

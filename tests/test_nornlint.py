@@ -216,6 +216,34 @@ BAD_CLEAN_FIXTURES = {
             return time.time()  # absolute timestamps are wall-clock's job
         """,
     ),
+    "NL-OBS02": (
+        """
+        import time
+
+        class Pending:
+            def __init__(self):
+                self.enqueued = time.time()
+
+        class Batcher:
+            def finish(self, hist, p):
+                hist.observe(time.time() - p.enqueued)
+        """,
+        """
+        import time
+
+        class Pending:
+            def __init__(self):
+                self.enqueued = time.perf_counter()
+                self.created_at = time.time()  # wall stamp, never observed
+
+        class Batcher:
+            def finish(self, hist, p):
+                hist.observe(time.perf_counter() - p.enqueued)
+
+            def age(self, p):
+                return time.time() - p.created_at  # not an observation
+        """,
+    ),
     "NL-OBS01": (
         """
         def load_checkpoint(path):
@@ -1101,6 +1129,62 @@ def test_tm01_module_pass_does_not_leak_into_function_scopes():
         return t0 - start
     """
     assert not findings_for(src, "NL-TM01")
+
+
+def test_obs02_flags_local_delta_variable():
+    """A wall-clock delta parked in a local before the observe() is the
+    same bug as observing the subtraction inline."""
+    src = """
+    import time
+
+    def handle(hist):
+        t0 = time.time()
+        work()
+        elapsed = time.time() - t0
+        hist.observe(elapsed)
+    """
+    assert len(findings_for(src, "NL-OBS02")) == 1
+
+
+def test_obs02_cross_method_attr_stamp():
+    """The stamp lives in __init__, the observation in another method —
+    outside NL-TM01's per-scope reach, exactly the case OBS02 exists
+    for."""
+    src = """
+    import time
+
+    class Req:
+        def __init__(self):
+            self.start = time.time()
+
+    def finish(hist, req):
+        hist.observe(time.time() - req.start)
+    """
+    assert findings_for(src, "NL-OBS02")
+
+
+def test_obs02_ignores_monotonic_observations():
+    src = """
+    import time
+
+    def handle(hist):
+        t0 = time.perf_counter()
+        work()
+        hist.observe(time.perf_counter() - t0)
+        hist.observe(0.5)
+    """
+    assert not findings_for(src, "NL-OBS02")
+
+
+def test_obs02_inline_suppression_honored():
+    src = """
+    import time
+
+    def handle(hist, req):
+        # cross-process stamp: monotonic clocks share no epoch
+        hist.observe(time.time() - req.remote_ts)  # nornlint: disable=NL-OBS02
+    """
+    assert not findings_for(src, "NL-OBS02")
 
 
 def test_select_with_update_baseline_rejected(tmp_path):

@@ -386,6 +386,11 @@ class TestServingEngine:
                 time.sleep(5.0)
                 return super().embed_batch(texts)
 
+        # this test exercises the POST-dispatch deadline shed; a model
+        # warmed on earlier slow-embedder tests would shed at submit
+        # (predicted_deadline) before the path under test is reached
+        from nornicdb_tpu.telemetry.costmodel import COST_MODEL
+        COST_MODEL.reset()
         eng = _engine(StuckEmbedder(8), deadline_ms=300.0, batch_wait_ms=0.0)
         t0 = time.monotonic()
         with pytest.raises(ResourceExhausted) as ei:
@@ -563,7 +568,11 @@ class TestQueryBatcherAdmission:
 
     def test_dispatch_time_shedding(self):
         from nornicdb_tpu.search.batcher import QueryBatcher
+        from nornicdb_tpu.telemetry.costmodel import COST_MODEL
 
+        # cold model -> predictive admission fails open, so the
+        # POST-dispatch deadline path under test is actually reached
+        COST_MODEL.reset()
         calls = []
 
         def search_fn(queries, k, min_sim):
